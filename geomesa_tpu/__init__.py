@@ -1,0 +1,44 @@
+"""geomesa_tpu — a TPU-native spatio-temporal indexing & query framework.
+
+Re-materializes GeoMesa's three load-bearing seams (see SURVEY.md §1):
+
+- **Top**: a Python query API over CQL-style filters (:mod:`geomesa_tpu.store`,
+  :mod:`geomesa_tpu.filter`) — the GeoTools ``DataStore`` role.
+- **Middle**: a pure-function index layer — space-filling curves, filter→range
+  planning, cost-based strategy selection (:mod:`geomesa_tpu.curve`,
+  :mod:`geomesa_tpu.index`, :mod:`geomesa_tpu.planning`).
+- **Bottom**: pluggable execution backends — a brute-force CPU oracle for parity
+  testing and a sharded columnar TPU backend with fused scan/refine/aggregate
+  kernels merged over ICI collectives (:mod:`geomesa_tpu.store.oracle`,
+  :mod:`geomesa_tpu.store.tpu_backend`, :mod:`geomesa_tpu.parallel`).
+
+Reference capability map: /root/reference (GeoMesa 2.4.0-SNAPSHOT). This is a
+from-scratch TPU-first design, not a port — see SURVEY.md §7.
+"""
+
+import jax as _jax
+
+# 64-bit mode: spatio-temporal keys are 62/63-bit Morton codes and timestamps are
+# epoch-millis int64; coordinates are f64 on the host side of the seam. The device
+# (TPU) hot path is explicitly typed int32/f32/bf16 throughout (see
+# geomesa_tpu/store/tpu_backend.py) so MXU/VPU work never silently widens.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy top-level API so `import geomesa_tpu` stays light and avoids
+    # circular imports between schema/store/planning.
+    try:
+        if name in ("FeatureType", "parse_spec"):
+            from geomesa_tpu.schema import sft
+
+            return getattr(sft, name)
+        if name == "DataStore":
+            from geomesa_tpu.store.datastore import DataStore
+
+            return DataStore
+    except ImportError as e:  # keep hasattr()/introspection well-behaved
+        raise AttributeError(name) from e
+    raise AttributeError(name)

@@ -1,0 +1,24 @@
+"""Space-filling-curve index math (the reference's ``geomesa-z3`` + ``sfcurve``).
+
+Pure numpy, host-side, NumPy-testable — the "middle seam" of SURVEY.md §7.
+Device-side (jax) variants of the hot encode ops live in
+:mod:`geomesa_tpu.ops.zcurve`.
+"""
+
+from geomesa_tpu.curve.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curve.sfc import Z2SFC, Z3SFC, z3_sfc
+from geomesa_tpu.curve.xz import XZSFC, xz2_sfc, xz3_sfc
+from geomesa_tpu.curve.zranges import merge_ranges, zranges
+
+__all__ = [
+    "BinnedTime",
+    "TimePeriod",
+    "Z2SFC",
+    "Z3SFC",
+    "z3_sfc",
+    "XZSFC",
+    "xz2_sfc",
+    "xz3_sfc",
+    "merge_ranges",
+    "zranges",
+]

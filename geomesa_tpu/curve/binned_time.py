@@ -1,0 +1,112 @@
+"""Epoch-binned time: (bin, offset) pairs per Day/Week/Month/Year period.
+
+Capability parity with the reference's ``BinnedTime``
+(``geomesa-z3/src/main/scala/org/locationtech/geomesa/curve/BinnedTime.scala:46``):
+a timestamp is represented as a small bin number (periods since the Unix epoch,
+fits in 16 bits) plus a bounded offset into the bin (Day→ms, Week/Month→s,
+Year→min). Bounded per-bin offsets are what keep Z3 keys inside 21 bits/dim —
+this is the reference's long-time-axis scaling trick (SURVEY.md §5) and ours:
+time bins are also the coarse partitioning axis for device array groups.
+
+All conversions are vectorized over int64 epoch-millis numpy arrays; calendar
+(month/year) bins use ``numpy.datetime64`` calendar arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+import numpy as np
+
+EPOCH_MS_PER_DAY = 86_400_000
+SECONDS_PER_WEEK = 604_800
+
+
+class TimePeriod(str, Enum):
+    DAY = "day"
+    WEEK = "week"
+    MONTH = "month"
+    YEAR = "year"
+
+
+# Max offset within a bin, in the period's offset unit (used as the time
+# dimension's normalization max — reference Z3SFC.scala:24-28).
+MAX_OFFSET = {
+    TimePeriod.DAY: 86_400_000.0,  # ms / day
+    TimePeriod.WEEK: 604_800.0,  # s / week
+    TimePeriod.MONTH: 31 * 86_400.0,  # s / longest month
+    TimePeriod.YEAR: 366 * 1_440.0,  # min / leap year
+}
+
+# Largest bin number such that dates stay indexable with a 16-bit bin
+# (reference caps bins at Short.MaxValue).
+MAX_BIN = 0x7FFF
+
+
+@dataclass(frozen=True)
+class BinnedTime:
+    """Vectorized (epoch-millis ↔ (bin, offset)) codec for one period."""
+
+    period: TimePeriod
+
+    def to_bin_and_offset(self, millis) -> tuple[np.ndarray, np.ndarray]:
+        """int64 epoch-ms → (int32 bin, int64 offset-in-period-units)."""
+        ms = np.asarray(millis, dtype=np.int64)
+        if self.period == TimePeriod.DAY:
+            b = np.floor_divide(ms, EPOCH_MS_PER_DAY)
+            off = ms - b * EPOCH_MS_PER_DAY
+        elif self.period == TimePeriod.WEEK:
+            secs = np.floor_divide(ms, 1000)
+            b = np.floor_divide(secs, SECONDS_PER_WEEK)
+            off = secs - b * SECONDS_PER_WEEK
+        elif self.period == TimePeriod.MONTH:
+            dt = ms.astype("datetime64[ms]")
+            months = dt.astype("datetime64[M]")
+            b = months.astype(np.int64)
+            off = np.floor_divide(ms, 1000) - months.astype("datetime64[s]").astype(np.int64)
+        else:  # YEAR
+            dt = ms.astype("datetime64[ms]")
+            years = dt.astype("datetime64[Y]")
+            b = years.astype(np.int64)
+            secs = np.floor_divide(ms, 1000)
+            off = np.floor_divide(secs - years.astype("datetime64[s]").astype(np.int64), 60)
+        if b.size and (int(b.max(initial=0)) > MAX_BIN or int(b.min(initial=0)) < 0):
+            raise ValueError(
+                f"date outside indexable range for period {self.period.value}: "
+                f"bins must be in [0, {MAX_BIN}]"
+            )
+        return b.astype(np.int32), off.astype(np.int64)
+
+    def bin_start_millis(self, bins) -> np.ndarray:
+        """int bin numbers → int64 epoch-ms of each bin's start."""
+        b = np.asarray(bins, dtype=np.int64)
+        if self.period == TimePeriod.DAY:
+            return b * EPOCH_MS_PER_DAY
+        if self.period == TimePeriod.WEEK:
+            return b * SECONDS_PER_WEEK * 1000
+        if self.period == TimePeriod.MONTH:
+            return b.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+        return b.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+
+    def from_bin_and_offset(self, bins, offsets) -> np.ndarray:
+        """(bin, offset) → int64 epoch-ms."""
+        start = self.bin_start_millis(bins)
+        off = np.asarray(offsets, dtype=np.int64)
+        if self.period == TimePeriod.DAY:
+            return start + off
+        if self.period in (TimePeriod.WEEK, TimePeriod.MONTH):
+            return start + off * 1000
+        return start + off * 60_000
+
+    def offset_unit_millis(self, bins=None) -> int:
+        """Milliseconds per offset unit (for converting query endpoints)."""
+        if self.period == TimePeriod.DAY:
+            return 1
+        if self.period == TimePeriod.YEAR:
+            return 60_000
+        return 1000
+
+    @property
+    def max_offset(self) -> float:
+        return MAX_OFFSET[self.period]

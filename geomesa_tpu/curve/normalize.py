@@ -1,0 +1,78 @@
+"""Double ↔ fixed-point normalization per curve dimension.
+
+Capability parity with the reference's ``NormalizedDimension``
+(``geomesa-z3/src/main/scala/org/locationtech/geomesa/curve/NormalizedDimension.scala:14``):
+maps a double in ``[min, max]`` to an int in ``[0, 2^precision - 1]`` by equi-width
+binning (floor), with the top edge clamped into the last bin. Vectorized over
+numpy arrays; these ints are both the Morton-curve inputs and the device-resident
+int-domain coordinates used for exact-superset refinement (the ``Z3Filter`` trick,
+``geomesa-index-api/.../index/filters/Z3Filter.scala:24-55``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class NormalizedDimension:
+    """Equi-width binning of ``[min, max]`` into ``2**precision`` bins."""
+
+    min: float
+    max: float
+    precision: int  # bits; in [1, 31]
+
+    def __post_init__(self):
+        if not (1 <= self.precision <= 31):
+            raise ValueError(f"precision must be in [1, 31]: {self.precision}")
+
+    @property
+    def bins(self) -> int:
+        return 1 << self.precision
+
+    @property
+    def max_index(self) -> int:
+        return self.bins - 1
+
+    def normalize(self, x) -> np.ndarray:
+        """Map doubles to bin indices; values >= max clamp to the last bin.
+
+        NaN coordinates are rejected — a NaN would otherwise cast to an
+        arbitrary bin and ingest a feature under a random, unfindable key
+        (the reference's curves likewise reject invalid bounds).
+        """
+        x = np.asarray(x, dtype=np.float64)
+        if np.isnan(x).any():
+            raise ValueError("NaN coordinate cannot be normalized to a curve index")
+        scaled = np.floor((x - self.min) * (self.bins / (self.max - self.min)))
+        out = np.clip(scaled, 0, self.max_index).astype(np.int64)
+        return out
+
+    def denormalize(self, i) -> np.ndarray:
+        """Map bin indices to the bin's midpoint."""
+        i = np.minimum(np.asarray(i, dtype=np.float64), self.max_index)
+        return self.min + (i + 0.5) * ((self.max - self.min) / self.bins)
+
+    def bin_lo(self, i) -> np.ndarray:
+        """Inclusive lower edge of bin ``i`` (for loose-range → exact refine math)."""
+        i = np.asarray(i, dtype=np.float64)
+        return self.min + i * ((self.max - self.min) / self.bins)
+
+    def bin_hi(self, i) -> np.ndarray:
+        """Exclusive upper edge of bin ``i`` (the last bin includes ``max``)."""
+        i = np.asarray(i, dtype=np.float64)
+        return self.min + (i + 1.0) * ((self.max - self.min) / self.bins)
+
+
+def lon(precision: int) -> NormalizedDimension:
+    return NormalizedDimension(-180.0, 180.0, precision)
+
+
+def lat(precision: int) -> NormalizedDimension:
+    return NormalizedDimension(-90.0, 90.0, precision)
+
+
+def time(precision: int, max_offset: float) -> NormalizedDimension:
+    return NormalizedDimension(0.0, max_offset, precision)

@@ -1,0 +1,150 @@
+"""Space-filling curve implementations: Z2 (2-D points) and Z3 (points + time).
+
+Capability parity with the reference's ``SpaceFillingCurve`` /
+``SpaceTimeFillingCurve`` contracts
+(``geomesa-z3/src/main/scala/org/locationtech/geomesa/curve/SpaceFillingCurve.scala:13,44``;
+``Z2SFC.scala:15``; ``Z3SFC.scala:22``): ``index(x, y[, t]) → key``, ``invert``,
+and ``ranges(boxes[, times], max_ranges)``. Everything is vectorized numpy so
+a whole ingest batch encodes in one pass; range planning delegates to
+:mod:`geomesa_tpu.curve.zranges`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+from geomesa_tpu.curve import normalize, zorder
+from geomesa_tpu.curve.binned_time import MAX_OFFSET, TimePeriod
+from geomesa_tpu.curve.zranges import merge_ranges, zranges
+
+
+def split_antimeridian(bboxes):
+    """Split (xmin, ymin, xmax, ymax) boxes whose lon bounds wrap the
+    antimeridian (xmin > xmax) into two non-wrapping boxes; reject inverted
+    latitude bounds. The reference handles this during CQL geometry extraction
+    (``FilterHelper``); we normalize here so every curve sees ordered boxes."""
+    out = []
+    for xmin, ymin, xmax, ymax in bboxes:
+        if ymin > ymax:
+            raise ValueError(f"inverted latitude bounds: [{ymin}, {ymax}]")
+        if xmin > xmax:
+            out.append((xmin, ymin, 180.0, ymax))
+            out.append((-180.0, ymin, xmax, ymax))
+        else:
+            out.append((xmin, ymin, xmax, ymax))
+    return out
+
+
+@dataclass(frozen=True)
+class Z2SFC:
+    """2-D Morton curve over (lon, lat); 31 bits/dim (``Z2SFC.scala:15``)."""
+
+    precision: int = 31
+
+    @property
+    def lon(self) -> normalize.NormalizedDimension:
+        return normalize.lon(self.precision)
+
+    @property
+    def lat(self) -> normalize.NormalizedDimension:
+        return normalize.lat(self.precision)
+
+    def index(self, x, y) -> np.ndarray:
+        """(lon, lat) f64 arrays → uint64 z2 codes."""
+        return zorder.encode2(self.lon.normalize(x), self.lat.normalize(y))
+
+    def normalized(self, x, y) -> tuple[np.ndarray, np.ndarray]:
+        """Fixed-point int coords (device-resident refine domain)."""
+        return self.lon.normalize(x), self.lat.normalize(y)
+
+    def invert(self, z) -> tuple[np.ndarray, np.ndarray]:
+        ix, iy = zorder.decode2(z)
+        return self.lon.denormalize(ix), self.lat.denormalize(iy)
+
+    def ranges(self, bboxes, max_ranges: int = 2000) -> np.ndarray:
+        """Cover (xmin, ymin, xmax, ymax) boxes with z2 intervals (uint64 (R,2))."""
+        bboxes = split_antimeridian(bboxes)
+        out = []
+        budget = max(1, max_ranges // max(1, len(bboxes)))
+        for xmin, ymin, xmax, ymax in bboxes:
+            lo = (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin)))
+            hi = (int(self.lon.normalize(xmax)), int(self.lat.normalize(ymax)))
+            r = zranges(lo, hi, self.precision, budget)
+            out.extend((int(a), int(b)) for a, b in r)
+        return merge_ranges(out)
+
+
+@dataclass(frozen=True)
+class Z3SFC:
+    """3-D Morton curve over (lon, lat, binned-time-offset); 21 bits/dim.
+
+    One curve instance per time period (``Z3SFC.scala:65-77`` keeps a singleton
+    per period); the time bin itself lives *outside* the curve, as the leading
+    component of the index key (SURVEY.md §2.3 row-key layout).
+    """
+
+    period: TimePeriod = TimePeriod.WEEK
+    precision: int = 21
+
+    @property
+    def lon(self) -> normalize.NormalizedDimension:
+        return normalize.lon(self.precision)
+
+    @property
+    def lat(self) -> normalize.NormalizedDimension:
+        return normalize.lat(self.precision)
+
+    @property
+    def time(self) -> normalize.NormalizedDimension:
+        return normalize.time(self.precision, MAX_OFFSET[self.period])
+
+    def index(self, x, y, t_offset) -> np.ndarray:
+        """(lon, lat, offset-in-bin) → uint64 z3 codes."""
+        return zorder.encode3(
+            self.lon.normalize(x), self.lat.normalize(y), self.time.normalize(t_offset)
+        )
+
+    def normalized(self, x, y, t_offset):
+        return (
+            self.lon.normalize(x),
+            self.lat.normalize(y),
+            self.time.normalize(t_offset),
+        )
+
+    def invert(self, z) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        ix, iy, it = zorder.decode3(z)
+        return (
+            self.lon.denormalize(ix),
+            self.lat.denormalize(iy),
+            self.time.denormalize(it),
+        )
+
+    def ranges(self, bboxes, time_offsets, max_ranges: int = 2000) -> np.ndarray:
+        """Cover boxes × [tmin, tmax] offset windows with z3 intervals.
+
+        ``time_offsets`` is (tmin, tmax) in the period's offset units — the
+        caller (Z3 key space) iterates time bins and calls this once per bin
+        with that bin's clipped window, splitting the range budget across bins
+        exactly like ``Z3IndexKeySpace.scala:165-177``.
+        """
+        bboxes = split_antimeridian(bboxes)
+        tmin, tmax = time_offsets
+        tlo = int(self.time.normalize(tmin))
+        thi = int(self.time.normalize(tmax))
+        out = []
+        budget = max(1, max_ranges // max(1, len(bboxes)))
+        for xmin, ymin, xmax, ymax in bboxes:
+            lo = (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin)), tlo)
+            hi = (int(self.lon.normalize(xmax)), int(self.lat.normalize(ymax)), thi)
+            r = zranges(lo, hi, self.precision, budget)
+            out.extend((int(a), int(b)) for a, b in r)
+        return merge_ranges(out)
+
+
+@lru_cache(maxsize=None)
+def z3_sfc(period: TimePeriod) -> Z3SFC:
+    """Singleton Z3 curve per time period (``Z3SFC.scala:65-77``)."""
+    return Z3SFC(period=period)

@@ -1,0 +1,106 @@
+"""Morton (Z-order) bit interleaving for 2 and 3 dimensions.
+
+This replaces the reference's external ``org.locationtech.sfcurve`` dependency
+(the 64-bit ``Z2``/``Z3`` bit-interleave used by
+``geomesa-z3/src/main/scala/org/locationtech/geomesa/curve/Z2SFC.scala`` and
+``Z3SFC.scala`` — see SURVEY.md §2.1). Implemented as vectorized numpy uint64
+magic-mask spreads; every function maps element-wise over arrays so encode of a
+whole ingest batch is one fused pass.
+
+Bit layouts (least-significant-bit first), matching the classic convention:
+
+- 2D: ``z = spread2(x) | spread2(y) << 1`` — x occupies even bits. 31 bits/dim
+  → 62-bit codes (``Z2SFC.scala:15``).
+- 3D: ``z = spread3(x) | spread3(y) << 1 | spread3(t) << 2`` — 21 bits/dim →
+  63-bit codes (``Z3SFC.scala:22``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# masks for 2D spread: 31 -> 62 bits (each source bit separated by one zero bit)
+_M2 = (
+    np.uint64(0x00000000FFFFFFFF),
+    np.uint64(0x0000FFFF0000FFFF),
+    np.uint64(0x00FF00FF00FF00FF),
+    np.uint64(0x0F0F0F0F0F0F0F0F),
+    np.uint64(0x3333333333333333),
+    np.uint64(0x5555555555555555),
+)
+
+# masks for 3D spread: 21 -> 63 bits (each source bit separated by two zero bits)
+_M3 = (
+    np.uint64(0x00000000001FFFFF),
+    np.uint64(0x001F00000000FFFF),
+    np.uint64(0x001F0000FF0000FF),
+    np.uint64(0x100F00F00F00F00F),
+    np.uint64(0x10C30C30C30C30C3),
+    np.uint64(0x1249249249249249),
+)
+
+_U = np.uint64
+
+
+def spread2(x: np.ndarray) -> np.ndarray:
+    """Insert one zero bit between each of the low 31 bits of ``x``."""
+    x = x.astype(np.uint64) & _M2[0]
+    x = (x | (x << _U(16))) & _M2[1]
+    x = (x | (x << _U(8))) & _M2[2]
+    x = (x | (x << _U(4))) & _M2[3]
+    x = (x | (x << _U(2))) & _M2[4]
+    x = (x | (x << _U(1))) & _M2[5]
+    return x
+
+
+def compact2(z: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`spread2`: extract even-position bits."""
+    z = z.astype(np.uint64) & _M2[5]
+    z = (z | (z >> _U(1))) & _M2[4]
+    z = (z | (z >> _U(2))) & _M2[3]
+    z = (z | (z >> _U(4))) & _M2[2]
+    z = (z | (z >> _U(8))) & _M2[1]
+    z = (z | (z >> _U(16))) & _M2[0]
+    return z
+
+
+def spread3(x: np.ndarray) -> np.ndarray:
+    """Insert two zero bits between each of the low 21 bits of ``x``."""
+    x = x.astype(np.uint64) & _M3[0]
+    x = (x | (x << _U(32))) & _M3[1]
+    x = (x | (x << _U(16))) & _M3[2]
+    x = (x | (x << _U(8))) & _M3[3]
+    x = (x | (x << _U(4))) & _M3[4]
+    x = (x | (x << _U(2))) & _M3[5]
+    return x
+
+
+def compact3(z: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`spread3`: extract every-third-position bits."""
+    z = z.astype(np.uint64) & _M3[5]
+    z = (z | (z >> _U(2))) & _M3[4]
+    z = (z | (z >> _U(4))) & _M3[3]
+    z = (z | (z >> _U(8))) & _M3[2]
+    z = (z | (z >> _U(16))) & _M3[1]
+    z = (z | (z >> _U(32))) & _M3[0]
+    return z
+
+
+def encode2(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Interleave two <=31-bit int arrays into 62-bit Morton codes (uint64)."""
+    return spread2(x) | (spread2(y) << _U(1))
+
+
+def decode2(z: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    z = np.asarray(z, dtype=np.uint64)
+    return compact2(z), compact2(z >> _U(1))
+
+
+def encode3(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """Interleave three <=21-bit int arrays into 63-bit Morton codes (uint64)."""
+    return spread3(x) | (spread3(y) << _U(1)) | (spread3(t) << _U(2))
+
+
+def decode3(z: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    z = np.asarray(z, dtype=np.uint64)
+    return compact3(z), compact3(z >> _U(1)), compact3(z >> _U(2))

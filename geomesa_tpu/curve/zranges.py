@@ -1,0 +1,142 @@
+"""Z-range decomposition: cover an axis-aligned query box with curve intervals.
+
+This replaces the reference's external ``sfcurve`` ``zranges`` routine
+(``Z3.zranges(zbounds, precision, maxRanges)``, used at
+``geomesa-z3/.../curve/Z3SFC.scala:54-61`` — SURVEY.md §2.1 "CRITICAL external
+dependency"). Host-side planning code: a BFS over the implicit quad/oct tree of
+Morton prefix cells, classifying each cell against the query box as contained
+(emit exact), disjoint (drop), or overlapping (split — or emit loosely once the
+range budget / precision floor is hit), then sorting and merging adjacent
+intervals.
+
+TPU-first note (SURVEY.md §7 "hard parts"): TPUs prefer fewer, fatter ranges —
+false positives inside a loose range are removed by the device-side int-domain
+refine kernel, so the budget here trades planning latency against scan volume,
+not correctness. ``max_recurse`` bounds tree depth the same way the reference's
+``ZRange`` decomposition does.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from geomesa_tpu.curve import zorder
+
+__all__ = ["zranges", "merge_ranges"]
+
+
+def merge_ranges(ranges: list[tuple[int, int]]) -> np.ndarray:
+    """Sort (lo, hi) inclusive intervals and coalesce overlapping/adjacent ones."""
+    if not ranges:
+        return np.empty((0, 2), dtype=np.uint64)
+    ranges.sort()
+    merged = [list(ranges[0])]
+    for lo, hi in ranges[1:]:
+        if lo <= merged[-1][1] + 1:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return np.array(merged, dtype=np.uint64)
+
+
+def zranges(
+    lows: tuple[int, ...],
+    highs: tuple[int, ...],
+    precision: int,
+    max_ranges: int = 2000,
+    max_recurse: int = 32,
+) -> np.ndarray:
+    """Cover the box ``[lows, highs]`` (inclusive, normalized ints) with z intervals.
+
+    Args:
+      lows/highs: per-dimension inclusive normalized bounds; dim 0 is the
+        least-significant interleave position (x/lon by convention).
+      precision: bits per dimension (31 for Z2, 21 for Z3).
+      max_ranges: soft budget on the number of returned intervals (the
+        reference's ``ScanRangesTarget``, default 2000 —
+        ``geomesa-index-api/.../conf/QueryProperties.scala:22``).
+      max_recurse: depth cutoff for the prefix-tree search.
+
+    Returns:
+      ``(R, 2) uint64`` array of inclusive ``[zlo, zhi]`` intervals whose union
+      is a superset of the z-codes of every point in the box.
+    """
+    dims = len(lows)
+    assert dims == len(highs)
+    if any(h < l for l, h in zip(lows, highs)):
+        return np.empty((0, 2), dtype=np.uint64)
+
+    if dims == 2:
+        encode = lambda c: int(zorder.encode2(np.uint64(c[0]), np.uint64(c[1])))
+    elif dims == 3:
+        encode = lambda c: int(
+            zorder.encode3(np.uint64(c[0]), np.uint64(c[1]), np.uint64(c[2]))
+        )
+    else:  # pragma: no cover - only 2/3-D curves exist
+        raise ValueError(f"unsupported dims: {dims}")
+
+    lows = tuple(int(v) for v in lows)
+    highs = tuple(int(v) for v in highs)
+
+    # Short-circuit: whole-domain query -> single full-curve range.
+    full = (1 << precision) - 1
+    if all(l == 0 for l in lows) and all(h == full for h in highs):
+        return np.array([[0, (1 << (dims * precision)) - 1]], dtype=np.uint64)
+
+    out: list[tuple[int, int]] = []
+    # Frontier cells: (per-dim prefix values, level). A cell at `level` spans
+    # per-dim intervals [v << s, (v << s) | ones(s)] with s = precision - level.
+    frontier: deque[tuple[tuple[int, ...], int]] = deque([((0,) * dims, 0)])
+    max_level = min(precision, max_recurse)
+
+    def cell_z_span(cell: tuple[int, ...], level: int) -> tuple[int, int]:
+        s = precision - level
+        lo_corner = tuple(v << s for v in cell)
+        zlo = encode(lo_corner)
+        # All points of the cell share the prefix; the span is the prefix
+        # followed by all-zeros .. all-ones in the low dims*s interleaved bits.
+        return zlo, zlo | ((1 << (dims * s)) - 1)
+
+    while frontier:
+        # Budget check: if splitting every frontier cell could blow the budget,
+        # emit the remaining frontier as loose (clipped-at-this-level) ranges —
+        # still classifying, so disjoint siblings don't become scan ranges.
+        if len(out) + len(frontier) >= max_ranges:
+            while frontier:
+                cell, level = frontier.popleft()
+                s = precision - level
+                if not any(
+                    ((cell[d] << s) | ((1 << s) - 1)) < lows[d]
+                    or (cell[d] << s) > highs[d]
+                    for d in range(dims)
+                ):
+                    out.append(cell_z_span(cell, level))
+            break
+
+        cell, level = frontier.popleft()
+        s = precision - level
+        contained = True
+        disjoint = False
+        for d in range(dims):
+            clo = cell[d] << s
+            chi = clo | ((1 << s) - 1)
+            if chi < lows[d] or clo > highs[d]:
+                disjoint = True
+                break
+            if clo < lows[d] or chi > highs[d]:
+                contained = False
+        if disjoint:
+            continue
+        if contained or level >= max_level:
+            out.append(cell_z_span(cell, level))
+            continue
+        # Split into 2^dims children (next bit of each dimension).
+        for child_bits in range(1 << dims):
+            child = tuple(
+                (cell[d] << 1) | ((child_bits >> d) & 1) for d in range(dims)
+            )
+            frontier.append((child, level + 1))
+
+    return merge_ranges(out)

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's two-tier test scheme (SURVEY.md §4): pure-math units
+run on numpy; planner/kernel/sharding suites run the real code paths on a
+virtual 8-device CPU mesh (the analog of Accumulo's MockInstance in-JVM
+backend), so multi-chip behavior is exercised without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,247 @@
+"""Curve-math invariants, modeled on the reference's pure-math suites
+(``geomesa-z3/src/test/scala/.../curve/{Z2Test,Z3Test,XZ2SFCTest,BinnedTimeTest,
+NormalizedDimensionTest}.scala`` — SURVEY.md §4): encode/invert round-trips,
+range-cover correctness over random boxes, and known-value tables."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import TimePeriod, Z2SFC, merge_ranges, z3_sfc, zranges
+from geomesa_tpu.curve import xz2_sfc, xz3_sfc, zorder
+from geomesa_tpu.curve.binned_time import BinnedTime
+from geomesa_tpu.curve.normalize import NormalizedDimension
+
+
+class TestZOrder:
+    def test_known_values_2d(self):
+        # interleave convention: x in even (LSB) positions
+        assert int(zorder.encode2(np.uint64(1), np.uint64(0))) == 0b01
+        assert int(zorder.encode2(np.uint64(0), np.uint64(1))) == 0b10
+        assert int(zorder.encode2(np.uint64(3), np.uint64(0))) == 0b0101
+        assert int(zorder.encode2(np.uint64(0b11), np.uint64(0b11))) == 0b1111
+
+    def test_known_values_3d(self):
+        assert int(zorder.encode3(np.uint64(1), np.uint64(0), np.uint64(0))) == 0b001
+        assert int(zorder.encode3(np.uint64(0), np.uint64(1), np.uint64(0))) == 0b010
+        assert int(zorder.encode3(np.uint64(0), np.uint64(0), np.uint64(1))) == 0b100
+        assert int(zorder.encode3(np.uint64(7), np.uint64(7), np.uint64(7))) == 0b111111111
+
+    def test_roundtrip_2d(self, rng):
+        x = rng.integers(0, 1 << 31, size=10_000).astype(np.uint64)
+        y = rng.integers(0, 1 << 31, size=10_000).astype(np.uint64)
+        z = zorder.encode2(x, y)
+        dx, dy = zorder.decode2(z)
+        np.testing.assert_array_equal(dx, x)
+        np.testing.assert_array_equal(dy, y)
+
+    def test_roundtrip_3d(self, rng):
+        x = rng.integers(0, 1 << 21, size=10_000).astype(np.uint64)
+        y = rng.integers(0, 1 << 21, size=10_000).astype(np.uint64)
+        t = rng.integers(0, 1 << 21, size=10_000).astype(np.uint64)
+        z = zorder.encode3(x, y, t)
+        dx, dy, dt = zorder.decode3(z)
+        np.testing.assert_array_equal(dx, x)
+        np.testing.assert_array_equal(dy, y)
+        np.testing.assert_array_equal(dt, t)
+
+    def test_monotone_in_each_dim(self):
+        # fixing y, z is monotone in x (and vice versa) for same-magnitude prefixes
+        x = np.arange(100, dtype=np.uint64)
+        z = zorder.encode2(x, np.uint64(0))
+        assert np.all(np.diff(z.astype(np.int64)) > 0)
+
+
+class TestNormalize:
+    def test_bounds(self):
+        d = NormalizedDimension(-180.0, 180.0, 21)
+        assert int(d.normalize(-180.0)) == 0
+        assert int(d.normalize(180.0)) == d.max_index
+        assert int(d.normalize(200.0)) == d.max_index  # clamp
+        assert int(d.normalize(-200.0)) == 0
+
+    def test_roundtrip_within_bin(self, rng):
+        d = NormalizedDimension(-90.0, 90.0, 21)
+        x = rng.uniform(-90, 90, size=1000)
+        i = d.normalize(x)
+        mid = d.denormalize(i)
+        # midpoint is within half a bin of the original
+        assert np.max(np.abs(mid - x)) <= (180.0 / (1 << 21))
+
+    def test_monotone(self, rng):
+        d = NormalizedDimension(-180.0, 180.0, 21)
+        x = np.sort(rng.uniform(-180, 180, size=1000))
+        i = d.normalize(x)
+        assert np.all(np.diff(i) >= 0)
+
+
+class TestBinnedTime:
+    MS = np.array(
+        [0, 1, 86_399_999, 86_400_000, 1_234_567_890_123, 1_700_000_000_000],
+        dtype=np.int64,
+    )
+
+    @pytest.mark.parametrize("period", list(TimePeriod))
+    def test_roundtrip(self, period):
+        bt = BinnedTime(period)
+        b, off = bt.to_bin_and_offset(self.MS)
+        back = bt.from_bin_and_offset(b, off)
+        unit = bt.offset_unit_millis()
+        # lossy only below the offset resolution
+        assert np.all(np.abs(back - self.MS) < unit)
+        assert np.all(off >= 0)
+        assert np.all(off < int(bt.max_offset) + 1)
+
+    def test_day_known(self):
+        bt = BinnedTime(TimePeriod.DAY)
+        b, off = bt.to_bin_and_offset(np.array([86_400_000 + 123], dtype=np.int64))
+        assert b[0] == 1 and off[0] == 123
+
+    def test_month_calendar(self):
+        bt = BinnedTime(TimePeriod.MONTH)
+        # 1970-03-01T00:00:00Z = 59 days
+        ms = np.array([59 * 86_400_000], dtype=np.int64)
+        b, off = bt.to_bin_and_offset(ms)
+        assert b[0] == 2 and off[0] == 0
+
+
+def brute_force_cover_check(ranges, zs_in_box):
+    """Every z of a point inside the box must fall in some returned range."""
+    if len(zs_in_box) == 0:
+        return True
+    lo = ranges[:, 0]
+    hi = ranges[:, 1]
+    idx = np.searchsorted(lo, zs_in_box, side="right") - 1
+    ok = (idx >= 0) & (zs_in_box <= hi[np.clip(idx, 0, len(hi) - 1)])
+    return bool(np.all(ok))
+
+
+class TestZRanges:
+    def test_full_domain(self):
+        r = zranges((0, 0), ((1 << 31) - 1, (1 << 31) - 1), 31)
+        assert r.shape == (1, 2)
+        assert int(r[0, 0]) == 0 and int(r[0, 1]) == (1 << 62) - 1
+
+    def test_single_cell(self):
+        r = zranges((5, 7), (5, 7), 31)
+        z = int(zorder.encode2(np.uint64(5), np.uint64(7)))
+        assert brute_force_cover_check(r, np.array([z], dtype=np.uint64))
+
+    def test_cover_correctness_2d(self, rng):
+        for _ in range(20):
+            lo = rng.integers(0, 1 << 16, size=2)
+            ext = rng.integers(1, 1 << 12, size=2)
+            lows = (int(lo[0]), int(lo[1]))
+            highs = (int(lo[0] + ext[0]), int(lo[1] + ext[1]))
+            r = zranges(lows, highs, 31, max_ranges=64)
+            assert len(r) <= 2 * 64  # merge may keep it under; budget is soft
+            # sample points inside the box
+            xs = rng.integers(lows[0], highs[0] + 1, size=200).astype(np.uint64)
+            ys = rng.integers(lows[1], highs[1] + 1, size=200).astype(np.uint64)
+            zs = zorder.encode2(xs, ys)
+            assert brute_force_cover_check(r, np.sort(zs))
+
+    def test_cover_correctness_3d(self, rng):
+        for _ in range(10):
+            lo = rng.integers(0, 1 << 12, size=3)
+            ext = rng.integers(1, 1 << 8, size=3)
+            lows = tuple(int(v) for v in lo)
+            highs = tuple(int(a + b) for a, b in zip(lo, ext))
+            r = zranges(lows, highs, 21, max_ranges=100)
+            xs = rng.integers(lows[0], highs[0] + 1, size=100).astype(np.uint64)
+            ys = rng.integers(lows[1], highs[1] + 1, size=100).astype(np.uint64)
+            ts = rng.integers(lows[2], highs[2] + 1, size=100).astype(np.uint64)
+            zs = zorder.encode3(xs, ys, ts)
+            assert brute_force_cover_check(r, np.sort(zs))
+
+    def test_ranges_sorted_disjoint(self, rng):
+        r = zranges((100, 200), (5000, 9000), 31, max_ranges=500)
+        assert np.all(r[:, 0] <= r[:, 1])
+        assert np.all(r[1:, 0].astype(np.int64) > r[:-1, 1].astype(np.int64) + 1 - 1)
+
+    def test_budget_respected_loosely(self):
+        r = zranges((0, 0), ((1 << 20), (1 << 20) + 12345), 31, max_ranges=16)
+        # hitting the budget coarsens ranges rather than dropping coverage
+        assert len(r) <= 64
+
+
+class TestSFC:
+    def test_z2_index_invert(self, rng):
+        sfc = Z2SFC()
+        x = rng.uniform(-180, 180, size=1000)
+        y = rng.uniform(-90, 90, size=1000)
+        z = sfc.index(x, y)
+        ix, iy = sfc.invert(z)
+        assert np.max(np.abs(ix - x)) <= 360.0 / (1 << 31) * 1.01
+        assert np.max(np.abs(iy - y)) <= 180.0 / (1 << 31) * 1.01
+
+    def test_z2_ranges_cover(self, rng):
+        sfc = Z2SFC()
+        bbox = (-10.0, -10.0, 10.0, 10.0)
+        r = sfc.ranges([bbox], max_ranges=200)
+        x = rng.uniform(-10, 10, size=500)
+        y = rng.uniform(-10, 10, size=500)
+        zs = np.sort(sfc.index(x, y))
+        assert brute_force_cover_check(r, zs)
+
+    def test_z3_ranges_cover(self, rng):
+        sfc = z3_sfc(TimePeriod.WEEK)
+        r = sfc.ranges([(-5.0, -5.0, 5.0, 5.0)], (1000.0, 200000.0), max_ranges=500)
+        x = rng.uniform(-5, 5, size=500)
+        y = rng.uniform(-5, 5, size=500)
+        t = rng.uniform(1000, 200000, size=500)
+        zs = np.sort(sfc.index(x, y, t))
+        assert brute_force_cover_check(r, zs)
+
+
+class TestXZ:
+    def test_index_range_of_codes(self, rng):
+        sfc = xz2_sfc(12)
+        n = 500
+        xmin = rng.uniform(-179, 178, size=n)
+        ymin = rng.uniform(-89, 88, size=n)
+        xmax = xmin + rng.uniform(0, 1, size=n)
+        ymax = ymin + rng.uniform(0, 1, size=n)
+        codes = sfc.index((xmin, ymin), (xmax, ymax))
+        assert np.all(codes < sfc.max_code)
+
+    def test_point_boxes_get_max_depth(self):
+        sfc = xz2_sfc(12)
+        c1 = sfc.index((np.array([10.0]), np.array([10.0])), (np.array([10.0]), np.array([10.0])))
+        assert int(c1[0]) > 0
+
+    def test_ranges_cover_intersecting_objects(self, rng):
+        sfc = xz2_sfc(12)
+        window = ((-20.0, -20.0), (20.0, 20.0))
+        r = sfc.ranges([window], max_ranges=500)
+        # objects that intersect the window must have covered codes
+        n = 300
+        xmin = rng.uniform(-30, 15, size=n)
+        ymin = rng.uniform(-30, 15, size=n)
+        xmax = xmin + rng.uniform(0, 10, size=n)
+        ymax = ymin + rng.uniform(0, 10, size=n)
+        inter = (xmax >= -20) & (xmin <= 20) & (ymax >= -20) & (ymin <= 20)
+        codes = sfc.index((xmin, ymin), (xmax, ymax))
+        assert brute_force_cover_check(r, np.sort(codes[inter]))
+
+    def test_xz3_ranges_cover(self, rng):
+        sfc = xz3_sfc(TimePeriod.WEEK, 8)
+        window = ((-20.0, -20.0, 0.0), (20.0, 20.0, 300000.0))
+        r = sfc.ranges([window], max_ranges=500)
+        n = 200
+        xmin = rng.uniform(-25, 15, size=n)
+        ymin = rng.uniform(-25, 15, size=n)
+        tmin = rng.uniform(0, 250000, size=n)
+        xmax = xmin + rng.uniform(0, 5, size=n)
+        ymax = ymin + rng.uniform(0, 5, size=n)
+        tmax = tmin + rng.uniform(0, 10000, size=n)
+        codes = sfc.index((xmin, ymin, tmin), (xmax, ymax, tmax))
+        inter = (xmax >= -20) & (xmin <= 20) & (ymax >= -20) & (ymin <= 20) & (tmax >= 0)
+        assert brute_force_cover_check(r, np.sort(codes[inter]))
+
+
+class TestMergeRanges:
+    def test_merge(self):
+        r = merge_ranges([(5, 10), (0, 3), (11, 20), (25, 30)])
+        np.testing.assert_array_equal(
+            r, np.array([[0, 3], [5, 20], [25, 30]], dtype=np.uint64)
+        )
