@@ -1,0 +1,211 @@
+"""Benchmark: GDELT-like Z3 bbox+time query throughput, TPU vs CPU brute force.
+
+Exercises BASELINE.md config #2 (Z3 spatio-temporal range queries): a batch of
+64 distinct bbox+time-window count queries over synthetic GDELT-shaped events,
+executed with the sharded batched scan step (one device launch + one readback
+per batch — the SPMD fan-out of SURVEY.md §2.20 P4). Prints ONE JSON line:
+
+  {"metric": ..., "value": per_query_p50_ms, "unit": "ms", "vs_baseline": x}
+
+``vs_baseline`` = CPU per-query p50 / TPU per-query p50 on identical data +
+queries (the reference publishes no numbers — BASELINE.md — so the measured
+in-memory CPU path is the baseline, standing in for GeoCQEngine).
+
+Parity: TPU counts are asserted EQUAL to the CPU evaluating the same
+int-domain semantics; the f64-vs-int boundary row count is reported (time is
+exact under the DAY period since offsets are millisecond-resolution).
+
+Env knobs: GEOMESA_BENCH_N (default 10M), GEOMESA_BENCH_Q (64),
+GEOMESA_BENCH_ITERS (20).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import geomesa_tpu  # noqa: F401  (x64 on)
+from geomesa_tpu.curve.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+from geomesa_tpu.curve.sfc import z3_sfc
+from geomesa_tpu.ops.refine import pack_boxes, pack_times
+
+N = int(os.environ.get("GEOMESA_BENCH_N", 10_000_000))
+Q = int(os.environ.get("GEOMESA_BENCH_Q", 64))
+ITERS = int(os.environ.get("GEOMESA_BENCH_ITERS", 20))
+T0 = 1_498_867_200_000  # 2017-07-01, GDELT-era
+PERIOD = TimePeriod.DAY  # ms offsets: time predicate exact in int domain
+SPAN_DAYS = 30
+
+CITIES = np.array(
+    [[-74, 40.7], [0.1, 51.5], [2.3, 48.8], [116.4, 39.9], [37.6, 55.7],
+     [-99.1, 19.4], [28.0, -26.2], [77.2, 28.6], [139.7, 35.7], [31.2, 30.0]]
+)
+
+
+def synth_gdelt(n: int, seed: int = 42):
+    """GDELT-shaped events: population-center clusters + uniform background."""
+    rng = np.random.default_rng(seed)
+    k = n // 2
+    which = rng.integers(0, len(CITIES), k)
+    lon = np.empty(n)
+    lat = np.empty(n)
+    lon[:k] = CITIES[which, 0] + rng.normal(0, 3.0, k)
+    lat[:k] = CITIES[which, 1] + rng.normal(0, 2.0, k)
+    lon[k:] = rng.uniform(-180, 180, n - k)
+    lat[k:] = rng.uniform(-60, 75, n - k)
+    np.clip(lon, -180, 180, out=lon)
+    np.clip(lat, -90, 90, out=lat)
+    t = T0 + rng.integers(0, SPAN_DAYS * 86_400_000, n)
+    return lon, lat, t
+
+
+def make_queries(q: int, seed: int = 7):
+    """q realistic bbox+window queries: city-centered boxes, 2-7 day windows."""
+    rng = np.random.default_rng(seed)
+    boxes_f64 = []
+    windows_ms = []
+    for i in range(q):
+        cx, cy = CITIES[rng.integers(0, len(CITIES))]
+        w = float(rng.uniform(2, 20))
+        h = float(rng.uniform(2, 15))
+        x1 = max(-180.0, cx - w / 2)
+        x2 = min(180.0, cx + w / 2)
+        y1 = max(-90.0, cy - h / 2)
+        y2 = min(90.0, cy + h / 2)
+        lo = T0 + int(rng.integers(0, (SPAN_DAYS - 7) * 86_400_000))
+        hi = lo + int(rng.integers(2, 7)) * 86_400_000
+        boxes_f64.append((x1, y1, x2, y2))
+        windows_ms.append((lo, hi))
+    return boxes_f64, windows_ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel.mesh import make_mesh, shard_columns
+    from geomesa_tpu.parallel.query import make_batched_count_step
+
+    lon, lat, t_ms = synth_gdelt(N)
+
+    # --- build (host ingest path): encode + sort ---
+    binned = BinnedTime(PERIOD)
+    sfc = z3_sfc(PERIOD)
+    t_build = time.perf_counter()
+    bins, offs = binned.to_bin_and_offset(t_ms)
+    z = sfc.index(lon, lat, offs)
+    perm = np.lexsort((z, bins))
+    nlon, nlat = norm_lon(31), norm_lat(31)
+    xi = nlon.normalize(lon).astype(np.int32)
+    yi = nlat.normalize(lat).astype(np.int32)
+    x_s = xi[perm]
+    y_s = yi[perm]
+    bins_s = bins[perm].astype(np.int32)
+    offs_s = offs[perm].astype(np.int32)
+    build_s = time.perf_counter() - t_build
+
+    mesh = make_mesh()  # all local devices (1 real chip; 8 on CPU-sim)
+    cols, padded, rows_per_shard = shard_columns(
+        mesh, {"x": x_s, "y": y_s, "bins": bins_s, "offs": offs_s}
+    )
+    step = make_batched_count_step(mesh)
+
+    # --- query payloads ---
+    boxes_f64, windows_ms = make_queries(Q)
+    qboxes = np.stack(
+        [
+            pack_boxes(
+                np.array(
+                    [[int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+                      int(nlat.normalize(y1)), int(nlat.normalize(y2))]],
+                    dtype=np.int32,
+                )
+            )
+            for x1, y1, x2, y2 in boxes_f64
+        ]
+    )
+    qtimes = []
+    for lo, hi in windows_ms:
+        (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
+        (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
+        qtimes.append(pack_times(np.array([[blo, olo, bhi, ohi]], dtype=np.int32)))
+    qtimes = np.stack(qtimes)
+    dev_boxes = jnp.asarray(qboxes)
+    dev_times = jnp.asarray(qtimes)
+    true_n = jnp.int32(N)
+
+    def run_batch():
+        counts = step(
+            cols["x"], cols["y"], cols["bins"], cols["offs"],
+            true_n, dev_boxes, dev_times,
+        )
+        return np.asarray(counts)
+
+    counts = run_batch()  # compile + warmup
+    run_batch()
+
+    lat_ms = []
+    for _ in range(ITERS):
+        s = time.perf_counter()
+        run_batch()
+        lat_ms.append((time.perf_counter() - s) * 1e3)
+    tpu_batch_p50 = float(np.percentile(lat_ms, 50))
+    tpu_per_query = tpu_batch_p50 / Q
+
+    # --- CPU baseline: per-query f64 brute force (GeoCQEngine stand-in) ---
+    cpu_times = []
+    cpu_counts_f64 = np.zeros(Q, dtype=np.int64)
+    for rep in range(2):
+        s = time.perf_counter()
+        for qi, ((x1, y1, x2, y2), (lo, hi)) in enumerate(zip(boxes_f64, windows_ms)):
+            m = (
+                (lon >= x1) & (lon <= x2) & (lat >= y1) & (lat <= y2)
+                & (t_ms >= lo) & (t_ms <= hi)
+            )
+            cpu_counts_f64[qi] = int(m.sum())
+        cpu_times.append((time.perf_counter() - s) * 1e3)
+    cpu_per_query = float(np.percentile(cpu_times, 50)) / Q
+
+    # --- parity: CPU evaluating the identical int-domain semantics ---
+    cpu_counts_int = np.zeros(Q, dtype=np.int64)
+    for qi in range(Q):
+        bx = qboxes[qi, 0]
+        bt = qtimes[qi, 0]
+        m = (xi >= bx[0]) & (xi <= bx[1]) & (yi >= bx[2]) & (yi <= bx[3])
+        after = (bins > bt[0]) | ((bins == bt[0]) & (offs >= bt[1]))
+        before = (bins < bt[2]) | ((bins == bt[2]) & (offs <= bt[3]))
+        cpu_counts_int[qi] = int((m & after & before).sum())
+    parity = bool((counts.astype(np.int64) == cpu_counts_int).all())
+    boundary_rows = int(np.abs(cpu_counts_int - cpu_counts_f64).sum())
+
+    result = {
+        "metric": "gdelt_z3_bbox_time_batched_query_p50_latency",
+        "value": round(tpu_per_query, 4),
+        "unit": "ms/query",
+        "vs_baseline": round(cpu_per_query / tpu_per_query, 2),
+        "detail": {
+            "n_points": N,
+            "n_queries": Q,
+            "devices": jax.device_count(),
+            "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+            "tpu_batch_p50_ms": round(tpu_batch_p50, 3),
+            "cpu_per_query_p50_ms": round(cpu_per_query, 3),
+            "int_domain_parity": parity,
+            "f64_boundary_rows": boundary_rows,
+            "total_hits": int(counts.sum()),
+            "build_seconds": round(build_s, 2),
+        },
+    }
+    assert parity, (
+        "TPU counts diverge from int-domain CPU referee: "
+        f"{counts.tolist()} vs {cpu_counts_int.tolist()}"
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Filter layer: AST, CQL parser, bounds extraction (``geomesa-filter`` role)."""
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import Extraction, extract
+from geomesa_tpu.filter.cql import CQLError, parse
+
+__all__ = ["ast", "parse", "CQLError", "extract", "Extraction"]

@@ -1,0 +1,351 @@
+"""Filter AST: the query predicate language (the OGC ``Filter`` role).
+
+A drastically simplified, typed re-design of the reference's GeoTools filter
+objects + CNF/DNF rewriting (``geomesa-filter/.../filter/package.scala``,
+SURVEY.md §2.2). Nodes are immutable; evaluation against a
+:class:`~geomesa_tpu.schema.columnar.FeatureTable` is *vectorized* — every node
+evaluates to a boolean mask over the whole table (this is the CPU-oracle
+semantics the device kernels must match).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from geomesa_tpu.geometry import predicates as P
+from geomesa_tpu.geometry.types import Geometry
+from geomesa_tpu.schema.columnar import FeatureTable, GeometryColumn
+from geomesa_tpu.schema.sft import AttributeType
+
+
+class Filter:
+    """Base node; ``mask(table)`` is the vectorized truth function."""
+
+    def mask(self, table: FeatureTable) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- combinators ---------------------------------------------------------
+    def __and__(self, other: "Filter") -> "Filter":
+        return And([self, other])
+
+    def __or__(self, other: "Filter") -> "Filter":
+        return Or([self, other])
+
+    def __invert__(self) -> "Filter":
+        return Not(self)
+
+
+@dataclass(frozen=True)
+class Include(Filter):
+    """Matches everything (CQL ``INCLUDE``)."""
+
+    def mask(self, table):
+        return np.ones(len(table), dtype=bool)
+
+
+@dataclass(frozen=True)
+class Exclude(Filter):
+    def mask(self, table):
+        return np.zeros(len(table), dtype=bool)
+
+
+@dataclass(frozen=True)
+class And(Filter):
+    children: Sequence[Filter]
+
+    def __post_init__(self):
+        object.__setattr__(self, "children", tuple(_flatten(And, self.children)))
+
+    def mask(self, table):
+        m = np.ones(len(table), dtype=bool)
+        for c in self.children:
+            m &= c.mask(table)
+        return m
+
+
+@dataclass(frozen=True)
+class Or(Filter):
+    children: Sequence[Filter]
+
+    def __post_init__(self):
+        object.__setattr__(self, "children", tuple(_flatten(Or, self.children)))
+
+    def mask(self, table):
+        m = np.zeros(len(table), dtype=bool)
+        for c in self.children:
+            m |= c.mask(table)
+        return m
+
+
+@dataclass(frozen=True)
+class Not(Filter):
+    child: Filter
+
+    def mask(self, table):
+        return ~self.child.mask(table)
+
+
+def _flatten(cls, children):
+    out = []
+    for c in children:
+        if isinstance(c, cls):
+            out.extend(c.children)
+        else:
+            out.append(c)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spatial predicates
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BBox(Filter):
+    """``BBOX(geom, xmin, ymin, xmax, ymax)`` — geometry bbox intersects box."""
+
+    prop: str
+    xmin: float
+    ymin: float
+    xmax: float
+    ymax: float
+
+    @property
+    def bounds(self):
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    def mask(self, table):
+        col: GeometryColumn = table.columns[self.prop]  # type: ignore[assignment]
+        b = col.bounds
+        if self.xmin > self.xmax:  # antimeridian wrap: lon > xmin OR lon < xmax
+            mx = (b[:, 2] >= self.xmin) | (b[:, 0] <= self.xmax)
+        else:
+            mx = (b[:, 2] >= self.xmin) & (b[:, 0] <= self.xmax)
+        m = mx & (b[:, 3] >= self.ymin) & (b[:, 1] <= self.ymax)
+        return m & col.is_valid()
+
+
+@dataclass(frozen=True)
+class SpatialOp(Filter):
+    """intersects / within / contains / disjoint / dwithin against a literal."""
+
+    op: str  # "intersects" | "within" | "contains" | "disjoint" | "dwithin"
+    prop: str
+    geometry: Geometry
+    distance: float = 0.0  # dwithin only (degrees)
+
+    def mask(self, table):
+        col: GeometryColumn = table.columns[self.prop]  # type: ignore[assignment]
+        valid = col.is_valid()
+        if col.type == AttributeType.POINT and col.x is not None:
+            m = self._points_mask(col.x, col.y)
+        else:
+            geoms = col.geometries()
+            m = np.zeros(len(table), dtype=bool)
+            for i in range(len(table)):
+                if not valid[i]:
+                    continue
+                m[i] = self._scalar(geoms[i])
+        # null geometries never match, including for disjoint (JTS semantics)
+        return m & valid
+
+    def _points_mask(self, xs, ys):
+        g = self.geometry
+        if self.op == "intersects":
+            return P.points_intersect_geom(xs, ys, g)
+        if self.op == "within":
+            return P.points_within_geom(xs, ys, g)
+        if self.op == "contains":
+            # a point can only contain an equal point
+            return P.points_intersect_geom(xs, ys, g) if g.is_point else np.zeros(len(xs), bool)
+        if self.op == "disjoint":
+            return ~P.points_intersect_geom(xs, ys, g)
+        if self.op == "dwithin":
+            return P.points_dist2_geom(xs, ys, g) <= self.distance**2
+        raise ValueError(f"unknown spatial op: {self.op}")
+
+    def _scalar(self, geom) -> bool:
+        g = self.geometry
+        if self.op == "intersects":
+            return P.intersects(geom, g)
+        if self.op == "within":
+            return P.within(geom, g)
+        if self.op == "contains":
+            return P.contains(geom, g)
+        if self.op == "disjoint":
+            return P.disjoint(geom, g)
+        if self.op == "dwithin":
+            return P.dwithin(geom, g, self.distance)
+        raise ValueError(f"unknown spatial op: {self.op}")
+
+
+# ---------------------------------------------------------------------------
+# temporal predicates (epoch-millis semantics; CQL DURING/BEFORE/AFTER/TEQUALS)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class During(Filter):
+    """``prop DURING t1/t2`` — exclusive endpoints, per CQL temporal semantics
+    (the reference converts DURING to exclusive bounds —
+    ``Z3IndexKeySpace.scala:110-112``)."""
+
+    prop: str
+    lo_millis: int
+    hi_millis: int
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        v = col.values
+        return (v > self.lo_millis) & (v < self.hi_millis) & col.is_valid()
+
+
+@dataclass(frozen=True)
+class TempOp(Filter):
+    """BEFORE (<), AFTER (>), TEQUALS (==)."""
+
+    op: str
+    prop: str
+    millis: int
+
+    def mask(self, table):
+        v = table.columns[self.prop].values
+        valid = table.columns[self.prop].is_valid()
+        if self.op == "before":
+            return (v < self.millis) & valid
+        if self.op == "after":
+            return (v > self.millis) & valid
+        if self.op == "tequals":
+            return (v == self.millis) & valid
+        raise ValueError(f"unknown temporal op: {self.op}")
+
+
+# ---------------------------------------------------------------------------
+# attribute predicates
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    "=": lambda v, x: v == x,
+    "<>": lambda v, x: v != x,
+    "<": lambda v, x: v < x,
+    "<=": lambda v, x: v <= x,
+    ">": lambda v, x: v > x,
+    ">=": lambda v, x: v >= x,
+}
+
+
+@dataclass(frozen=True)
+class Compare(Filter):
+    op: str  # =, <>, <, <=, >, >=
+    prop: str
+    literal: Any
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        v = col.values
+        lit = self.literal
+        if col.type == AttributeType.DATE and not isinstance(lit, (int, np.integer)):
+            from geomesa_tpu.schema.columnar import _to_millis
+
+            lit = _to_millis(lit)
+        if v.dtype == object:
+            f = _CMP[self.op]
+            out = np.zeros(len(v), dtype=bool)
+            valid = col.is_valid()
+            for i in range(len(v)):
+                if valid[i]:
+                    try:
+                        out[i] = bool(f(v[i], lit))
+                    except TypeError:
+                        out[i] = False
+            return out
+        return _CMP[self.op](v, lit) & col.is_valid()
+
+
+@dataclass(frozen=True)
+class Between(Filter):
+    """``prop BETWEEN lo AND hi`` — inclusive both ends (CQL)."""
+
+    prop: str
+    lo: Any
+    hi: Any
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        lo, hi = self.lo, self.hi
+        if col.type == AttributeType.DATE:
+            from geomesa_tpu.schema.columnar import _to_millis
+
+            lo = lo if isinstance(lo, (int, np.integer)) else _to_millis(lo)
+            hi = hi if isinstance(hi, (int, np.integer)) else _to_millis(hi)
+        v = col.values
+        if v.dtype == object:
+            return Compare(">=", self.prop, lo).mask(table) & Compare(
+                "<=", self.prop, hi
+            ).mask(table)
+        return (v >= lo) & (v <= hi) & col.is_valid()
+
+
+@dataclass(frozen=True)
+class In(Filter):
+    prop: str
+    literals: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "literals", tuple(self.literals))
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        out = np.zeros(len(col), dtype=bool)
+        for lit in self.literals:
+            out |= Compare("=", self.prop, lit).mask(table)
+        return out
+
+
+@dataclass(frozen=True)
+class Like(Filter):
+    """``prop LIKE pattern`` with ``%``/``_`` wildcards."""
+
+    prop: str
+    pattern: str
+
+    def _regex(self):
+        import re
+
+        esc = re.escape(self.pattern).replace("%", ".*").replace("_", ".")
+        return re.compile("^" + esc + "$")
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        rx = self._regex()
+        valid = col.is_valid()
+        out = np.zeros(len(col), dtype=bool)
+        for i, v in enumerate(col.values):
+            if valid[i] and isinstance(v, str):
+                out[i] = rx.match(v) is not None
+        return out
+
+
+@dataclass(frozen=True)
+class IsNull(Filter):
+    prop: str
+
+    def mask(self, table):
+        return ~table.columns[self.prop].is_valid()
+
+
+@dataclass(frozen=True)
+class FidIn(Filter):
+    """``IN ('fid1', 'fid2')`` on feature ids (the ID index path)."""
+
+    fids: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "fids", tuple(self.fids))
+
+    def mask(self, table):
+        want = set(self.fids)
+        return np.fromiter(
+            (f in want for f in table.fids), dtype=bool, count=len(table)
+        )

@@ -1,0 +1,210 @@
+"""Filter → (spatial boxes, time intervals) extraction for index planning.
+
+The ``FilterHelper.extractGeometries`` / ``extractIntervals`` role
+(``geomesa-filter/.../FilterHelper.scala``, used by every key space —
+``Z3IndexKeySpace.scala:100-112``; SURVEY.md §2.2): walk the AST and compute,
+per indexed attribute, a *sound over-approximation* of where matching rows can
+live. Unextractable subtrees (NOT, attribute predicates, cross-attribute ORs)
+widen to "unconstrained" — soundness comes from the algebra:
+
+- AND intersects child bounds (any child's bounds alone are already a cover);
+- OR unions child bounds, and becomes unconstrained if any child is;
+- NOT / non-indexed predicates are unconstrained.
+
+so the returned bounds always satisfy ``rows(filter) ⊆ rows(bounds)``; the
+full original filter is re-applied as the residual ("secondary") predicate
+after the scan, exactly like the reference's iterator stack.
+
+Temporal bounds are inclusive int epoch-millis intervals: CQL ``DURING`` is
+exclusive (→ ``[lo+1, hi-1]``), matching ``Z3IndexKeySpace.scala:110-112``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+
+# an interval is (lo_ms, hi_ms) inclusive; None bound = unbounded
+MIN_MS = -(2**62)
+MAX_MS = 2**62
+
+
+@dataclass(frozen=True)
+class Extraction:
+    """Bounds for one (geom_field, dtg_field) pair.
+
+    ``boxes``: None = spatially unconstrained; else list of (xmin, ymin, xmax,
+    ymax) whose union covers all matching rows. ``intervals``: None =
+    temporally unconstrained; else list of inclusive (lo_ms, hi_ms).
+    """
+
+    boxes: list | None
+    intervals: list | None
+
+    @property
+    def spatially_bounded(self) -> bool:
+        return self.boxes is not None
+
+    @property
+    def temporally_bounded(self) -> bool:
+        return self.intervals is not None
+
+    @property
+    def disjoint(self) -> bool:
+        """True when bounds prove the filter matches nothing."""
+        return (self.boxes is not None and len(self.boxes) == 0) or (
+            self.intervals is not None and len(self.intervals) == 0
+        )
+
+
+def extract(f: ast.Filter, geom_field: str | None, dtg_field: str | None) -> Extraction:
+    boxes, intervals = _walk(f, geom_field, dtg_field)
+    if boxes is not None:
+        boxes = _dedupe_boxes(boxes)
+    if intervals is not None:
+        intervals = _merge_intervals(intervals)
+    return Extraction(boxes, intervals)
+
+
+def _walk(f: ast.Filter, geom: str | None, dtg: str | None):
+    """Returns (boxes|None, intervals|None)."""
+    if isinstance(f, ast.And):
+        boxes, intervals = None, None
+        for c in f.children:
+            cb, ci = _walk(c, geom, dtg)
+            boxes = _intersect_boxes(boxes, cb)
+            intervals = _intersect_intervals(intervals, ci)
+        return boxes, intervals
+    if isinstance(f, ast.Or):
+        boxes_list, iv_list = [], []
+        any_unbounded_space = False
+        any_unbounded_time = False
+        for c in f.children:
+            cb, ci = _walk(c, geom, dtg)
+            if cb is None:
+                any_unbounded_space = True
+            else:
+                boxes_list.extend(cb)
+            if ci is None:
+                any_unbounded_time = True
+            else:
+                iv_list.extend(ci)
+        return (
+            None if any_unbounded_space else boxes_list,
+            None if any_unbounded_time else iv_list,
+        )
+    if isinstance(f, ast.BBox) and f.prop == geom:
+        return _split_lon([f.bounds]), None
+    if isinstance(f, ast.SpatialOp) and f.prop == geom:
+        if f.op == "disjoint":
+            return None, None  # complement of a box: unconstrained
+        xmin, ymin, xmax, ymax = f.geometry.bbox
+        if f.op == "dwithin":
+            d = f.distance
+            xmin, ymin, xmax, ymax = xmin - d, ymin - d, xmax + d, ymax + d
+        return _split_lon([(xmin, ymin, xmax, ymax)]), None
+    if isinstance(f, ast.During) and f.prop == dtg:
+        return None, [(f.lo_millis + 1, f.hi_millis - 1)]
+    if isinstance(f, ast.TempOp) and f.prop == dtg:
+        if f.op == "before":
+            return None, [(MIN_MS, f.millis - 1)]
+        if f.op == "after":
+            return None, [(f.millis + 1, MAX_MS)]
+        return None, [(f.millis, f.millis)]  # tequals
+    if isinstance(f, ast.Between) and f.prop == dtg:
+        from geomesa_tpu.schema.columnar import _to_millis
+
+        lo = f.lo if isinstance(f.lo, (int, np.integer)) else _to_millis(f.lo)
+        hi = f.hi if isinstance(f.hi, (int, np.integer)) else _to_millis(f.hi)
+        return None, [(int(lo), int(hi))]
+    if isinstance(f, ast.Compare) and f.prop == dtg:
+        from geomesa_tpu.schema.columnar import _to_millis
+
+        lit = f.literal if isinstance(f.literal, (int, np.integer)) else _to_millis(f.literal)
+        lit = int(lit)
+        if f.op == "=":
+            return None, [(lit, lit)]
+        if f.op == "<":
+            return None, [(MIN_MS, lit - 1)]
+        if f.op == "<=":
+            return None, [(MIN_MS, lit)]
+        if f.op == ">":
+            return None, [(lit + 1, MAX_MS)]
+        if f.op == ">=":
+            return None, [(lit, MAX_MS)]
+        return None, None
+    if isinstance(f, ast.Exclude):
+        return [], []
+    # Include, Not, attribute predicates, fid filters: unconstrained
+    return None, None
+
+
+def _split_lon(boxes):
+    """Clamp to the world and split antimeridian-wrapping boxes."""
+    out = []
+    for xmin, ymin, xmax, ymax in boxes:
+        ymin = max(ymin, -90.0)
+        ymax = min(ymax, 90.0)
+        if ymin > ymax:
+            continue
+        if xmin > xmax:  # antimeridian wrap
+            out.append((max(xmin, -180.0), ymin, 180.0, ymax))
+            out.append((-180.0, ymin, min(xmax, 180.0), ymax))
+        else:
+            out.append((max(xmin, -180.0), ymin, min(xmax, 180.0), ymax))
+    return out
+
+
+def _intersect_boxes(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out = []
+    for ax1, ay1, ax2, ay2 in a:
+        for bx1, by1, bx2, by2 in b:
+            x1, y1 = max(ax1, bx1), max(ay1, by1)
+            x2, y2 = min(ax2, bx2), min(ay2, by2)
+            if x1 <= x2 and y1 <= y2:
+                out.append((x1, y1, x2, y2))
+    return out
+
+
+def _intersect_intervals(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out = []
+    for alo, ahi in a:
+        for blo, bhi in b:
+            lo, hi = max(alo, blo), min(ahi, bhi)
+            if lo <= hi:
+                out.append((lo, hi))
+    return out
+
+
+def _merge_intervals(ivs):
+    if not ivs:
+        return []
+    ivs = sorted(ivs)
+    out = [list(ivs[0])]
+    for lo, hi in ivs[1:]:
+        if lo <= out[-1][1] + 1:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return [tuple(iv) for iv in out]
+
+
+def _dedupe_boxes(boxes):
+    seen = set()
+    out = []
+    for b in boxes:
+        if b not in seen:
+            seen.add(b)
+            out.append(b)
+    return out

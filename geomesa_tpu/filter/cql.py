@@ -1,0 +1,347 @@
+"""CQL(-subset) text parser → :mod:`geomesa_tpu.filter.ast` nodes.
+
+The role of GeoTools' ECQL parser as used throughout the reference (queries
+arrive as CQL strings in tools/tests: ``bbox(geom,-10,-10,10,10) AND dtg
+DURING 2018-01-01T00:00:00.000Z/2018-01-02T00:00:00.000Z``). Supported:
+
+- ``INCLUDE`` / ``EXCLUDE``
+- ``BBOX(geom, xmin, ymin, xmax, ymax)``
+- ``INTERSECTS/WITHIN/CONTAINS/DISJOINT(geom, <WKT>)``, ``DWITHIN(geom, <WKT>, dist, units)``
+- ``dtg DURING t1/t2``, ``dtg BEFORE t``, ``dtg AFTER t``, ``dtg TEQUALS t``
+- comparisons ``= <> < <= > >=``, ``BETWEEN ... AND ...``, ``IN (...)``,
+  ``LIKE``, ``IS [NOT] NULL``
+- ``AND`` / ``OR`` / ``NOT``, parentheses
+- bare ``IN ('id1', ...)`` as a feature-id filter
+
+Recursive-descent over a cursor (WKT literals need balanced-paren scanning).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geometry.wkt import from_wkt
+
+_WS = re.compile(r"\s+")
+_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_.]*")
+_NUMBER = re.compile(r"[-+]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][-+]?\d+)?")
+_DATETIME = re.compile(
+    r"\d{4}-\d{2}-\d{2}(?:T\d{2}:\d{2}:\d{2}(?:\.\d+)?Z?)?"
+)
+_GEOM_KEYWORDS = (
+    "POINT",
+    "LINESTRING",
+    "POLYGON",
+    "MULTIPOINT",
+    "MULTILINESTRING",
+    "MULTIPOLYGON",
+)
+_SPATIAL_OPS = {
+    "INTERSECTS": "intersects",
+    "WITHIN": "within",
+    "CONTAINS": "contains",
+    "DISJOINT": "disjoint",
+}
+
+
+class CQLError(ValueError):
+    pass
+
+
+def parse(cql: str) -> ast.Filter:
+    p = _Parser(cql)
+    f = p.parse_or()
+    p.skip_ws()
+    if p.pos != len(p.s):
+        raise CQLError(f"trailing input at {p.pos}: {p.s[p.pos:p.pos+30]!r}")
+    return f
+
+
+def datetime_to_millis(s: str) -> int:
+    """ISO-8601 (subset) → epoch millis."""
+    s = s.strip().rstrip("Z")
+    return int(np.datetime64(s, "ms").astype(np.int64))
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.pos = 0
+
+    # -- low-level -----------------------------------------------------------
+    def skip_ws(self):
+        m = _WS.match(self.s, self.pos)
+        if m:
+            self.pos = m.end()
+
+    def peek_word(self) -> str:
+        self.skip_ws()
+        m = _IDENT.match(self.s, self.pos)
+        return m.group(0).upper() if m else ""
+
+    def take_word(self) -> str:
+        self.skip_ws()
+        m = _IDENT.match(self.s, self.pos)
+        if not m:
+            raise CQLError(f"expected identifier at {self.pos}: {self.s[self.pos:self.pos+20]!r}")
+        self.pos = m.end()
+        return m.group(0)
+
+    def expect(self, ch: str):
+        self.skip_ws()
+        if not self.s.startswith(ch, self.pos):
+            raise CQLError(f"expected {ch!r} at {self.pos}: {self.s[self.pos:self.pos+20]!r}")
+        self.pos += len(ch)
+
+    def try_take(self, ch: str) -> bool:
+        self.skip_ws()
+        if self.s.startswith(ch, self.pos):
+            self.pos += len(ch)
+            return True
+        return False
+
+    def number(self) -> float:
+        self.skip_ws()
+        m = _NUMBER.match(self.s, self.pos)
+        if not m:
+            raise CQLError(f"expected number at {self.pos}: {self.s[self.pos:self.pos+20]!r}")
+        self.pos = m.end()
+        return float(m.group(0))
+
+    def quoted(self) -> str:
+        self.skip_ws()
+        q = self.s[self.pos]
+        if q not in "'\"":
+            raise CQLError(f"expected quote at {self.pos}")
+        end = self.s.find(q, self.pos + 1)
+        # CQL doubles quotes to escape: 'it''s'
+        while end != -1 and self.s[end + 1 : end + 2] == q:
+            end = self.s.find(q, end + 2)
+        if end == -1:
+            raise CQLError("unterminated string literal")
+        raw = self.s[self.pos + 1 : end].replace(q + q, q)
+        self.pos = end + 1
+        return raw
+
+    def wkt(self):
+        self.skip_ws()
+        up = self.s[self.pos :].upper()
+        for kw in _GEOM_KEYWORDS:
+            if up.startswith(kw):
+                # scan balanced parens
+                i = self.pos + len(kw)
+                while self.s[i] in " \t\n":
+                    i += 1
+                if self.s[i] != "(":
+                    raise CQLError(f"bad WKT at {self.pos}")
+                depth = 0
+                j = i
+                while j < len(self.s):
+                    if self.s[j] == "(":
+                        depth += 1
+                    elif self.s[j] == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                if depth != 0:
+                    raise CQLError("unbalanced parens in WKT")
+                text = self.s[self.pos : j + 1]
+                self.pos = j + 1
+                return from_wkt(text)
+        raise CQLError(f"expected WKT geometry at {self.pos}: {self.s[self.pos:self.pos+20]!r}")
+
+    def datetime_millis(self) -> int:
+        self.skip_ws()
+        if self.s[self.pos] in "'\"":
+            return datetime_to_millis(self.quoted())
+        m = _DATETIME.match(self.s, self.pos)
+        if not m:
+            raise CQLError(f"expected datetime at {self.pos}: {self.s[self.pos:self.pos+25]!r}")
+        self.pos = m.end()
+        return datetime_to_millis(m.group(0))
+
+    def literal(self):
+        self.skip_ws()
+        ch = self.s[self.pos]
+        if ch in "'\"":
+            return self.quoted()
+        m = _DATETIME.match(self.s, self.pos)
+        if m and "-" in m.group(0)[1:]:
+            self.pos = m.end()
+            return datetime_to_millis(m.group(0))
+        m = _NUMBER.match(self.s, self.pos)
+        if m:
+            self.pos = m.end()
+            txt = m.group(0)
+            return float(txt) if ("." in txt or "e" in txt or "E" in txt) else int(txt)
+        w = self.take_word()
+        if w.upper() == "TRUE":
+            return True
+        if w.upper() == "FALSE":
+            return False
+        return w
+
+    # -- grammar ---------------------------------------------------------------
+    def parse_or(self) -> ast.Filter:
+        left = self.parse_and()
+        parts = [left]
+        while self.peek_word() == "OR":
+            self.take_word()
+            parts.append(self.parse_and())
+        return parts[0] if len(parts) == 1 else ast.Or(parts)
+
+    def parse_and(self) -> ast.Filter:
+        left = self.parse_unary()
+        parts = [left]
+        while self.peek_word() == "AND":
+            self.take_word()
+            parts.append(self.parse_unary())
+        return parts[0] if len(parts) == 1 else ast.And(parts)
+
+    def parse_unary(self) -> ast.Filter:
+        w = self.peek_word()
+        if w == "NOT":
+            self.take_word()
+            return ast.Not(self.parse_unary())
+        self.skip_ws()
+        if self.s.startswith("(", self.pos):
+            self.expect("(")
+            f = self.parse_or()
+            self.expect(")")
+            return f
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ast.Filter:
+        w = self.peek_word()
+        if w == "INCLUDE":
+            self.take_word()
+            return ast.Include()
+        if w == "EXCLUDE":
+            self.take_word()
+            return ast.Exclude()
+        if w == "BBOX":
+            self.take_word()
+            self.expect("(")
+            prop = self.take_word()
+            self.expect(",")
+            xmin = self.number()
+            self.expect(",")
+            ymin = self.number()
+            self.expect(",")
+            xmax = self.number()
+            self.expect(",")
+            ymax = self.number()
+            # optional CRS argument
+            if self.try_take(","):
+                self.quoted()
+            self.expect(")")
+            return ast.BBox(prop, xmin, ymin, xmax, ymax)
+        if w in _SPATIAL_OPS:
+            self.take_word()
+            self.expect("(")
+            prop = self.take_word()
+            self.expect(",")
+            geom = self.wkt()
+            self.expect(")")
+            return ast.SpatialOp(_SPATIAL_OPS[w], prop, geom)
+        if w == "DWITHIN":
+            self.take_word()
+            self.expect("(")
+            prop = self.take_word()
+            self.expect(",")
+            geom = self.wkt()
+            self.expect(",")
+            dist = self.number()
+            self.expect(",")
+            units = self.take_word().lower()
+            self.expect(")")
+            dist = _to_degrees(dist, units)
+            return ast.SpatialOp("dwithin", prop, geom, distance=dist)
+        if w == "IN":  # bare fid filter
+            self.take_word()
+            self.expect("(")
+            fids = [str(self.literal())]
+            while self.try_take(","):
+                fids.append(str(self.literal()))
+            self.expect(")")
+            return ast.FidIn(tuple(fids))
+
+        # property-led predicates
+        prop = self.take_word()
+        nxt = self.peek_word()
+        if nxt == "DURING":
+            self.take_word()
+            lo = self.datetime_millis()
+            self.expect("/")
+            hi = self.datetime_millis()
+            return ast.During(prop, lo, hi)
+        if nxt in ("BEFORE", "AFTER", "TEQUALS"):
+            self.take_word()
+            t = self.datetime_millis()
+            return ast.TempOp(nxt.lower(), prop, t)
+        if nxt == "BETWEEN":
+            self.take_word()
+            lo = self.literal()
+            if self.peek_word() != "AND":
+                raise CQLError("expected AND in BETWEEN")
+            self.take_word()
+            hi = self.literal()
+            return ast.Between(prop, lo, hi)
+        if nxt == "IN":
+            self.take_word()
+            self.expect("(")
+            lits = [self.literal()]
+            while self.try_take(","):
+                lits.append(self.literal())
+            self.expect(")")
+            return ast.In(prop, tuple(lits))
+        if nxt == "LIKE":
+            self.take_word()
+            return ast.Like(prop, self.quoted())
+        if nxt == "IS":
+            self.take_word()
+            if self.peek_word() == "NOT":
+                self.take_word()
+                if self.take_word().upper() != "NULL":
+                    raise CQLError("expected NULL")
+                return ast.Not(ast.IsNull(prop))
+            if self.take_word().upper() != "NULL":
+                raise CQLError("expected NULL")
+            return ast.IsNull(prop)
+
+        # comparison operators
+        self.skip_ws()
+        for op in ("<>", "<=", ">=", "=", "<", ">"):
+            if self.s.startswith(op, self.pos):
+                self.pos += len(op)
+                lit = self.literal()
+                return ast.Compare(op, prop, lit)
+        raise CQLError(
+            f"cannot parse predicate at {self.pos}: {self.s[self.pos:self.pos+30]!r}"
+        )
+
+
+_METERS_PER_DEGREE = 111_320.0
+
+
+def _to_degrees(dist: float, units: str) -> float:
+    """DWithin distance → degrees (planar approximation at the equator, the
+    same simplification the reference applies for geodesic DWithin buffering
+    in ``GeometryProcessing.scala``)."""
+    if units in ("meters", "metres", "m"):
+        return dist / _METERS_PER_DEGREE
+    if units in ("kilometers", "km"):
+        return dist * 1000.0 / _METERS_PER_DEGREE
+    if units in ("feet", "ft"):
+        return dist * 0.3048 / _METERS_PER_DEGREE
+    if units in ("statute_miles", "miles", "mi"):
+        return dist * 1609.344 / _METERS_PER_DEGREE
+    if units in ("nautical_miles", "nm"):
+        return dist * 1852.0 / _METERS_PER_DEGREE
+    if units in ("degrees", "deg"):
+        return dist
+    raise CQLError(f"unknown distance units: {units!r}")

@@ -1,0 +1,26 @@
+"""Geometry value model, WKT codec, and spatial predicates (JTS role)."""
+
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    box,
+)
+from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
+
+__all__ = [
+    "Geometry",
+    "Point",
+    "LineString",
+    "Polygon",
+    "MultiPoint",
+    "MultiLineString",
+    "MultiPolygon",
+    "box",
+    "from_wkt",
+    "to_wkt",
+]

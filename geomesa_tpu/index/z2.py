@@ -1,0 +1,129 @@
+"""Z2 (points) and XZ2 (extended geometries) spatial-only indexes.
+
+Reference: ``geomesa-index-api/.../index/z2/Z2IndexKeySpace.scala`` (row =
+``[shard][8B z2][id]``) and ``XZ2IndexKeySpace.scala``. Same TPU re-design as
+:mod:`geomesa_tpu.index.z3`: sort order over the columnar snapshot + row
+intervals, no byte rows or shard prefixes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.curve.sfc import Z2SFC
+from geomesa_tpu.curve.xz import xz2_sfc
+from geomesa_tpu.filter.bounds import Extraction
+from geomesa_tpu.index.api import (
+    DEFAULT_MAX_RANGES,
+    FeatureIndex,
+    IndexPlan,
+    intervals_from_key_ranges,
+    merge_intervals,
+)
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+
+
+class Z2Index(FeatureIndex):
+    name = "z2"
+
+    def __init__(self, sft: FeatureType):
+        super().__init__(sft)
+        self.sfc = Z2SFC()
+        self.zs: np.ndarray | None = None
+
+    @classmethod
+    def supports(cls, sft: FeatureType) -> bool:
+        return sft.geom_is_points
+
+    def can_serve(self, e: Extraction) -> bool:
+        return True
+
+    def build(self, table: FeatureTable) -> np.ndarray:
+        col = table.geom_column()
+        z = self.sfc.index(col.x, col.y)
+        perm = np.argsort(z, kind="stable")
+        self.perm = perm
+        self.zs = z[perm]
+        self.n = len(table)
+        return perm
+
+    def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
+        if e.disjoint:
+            return IndexPlan.empty()
+        if e.boxes is None:
+            return IndexPlan.full(self.n)
+        zranges = self.sfc.ranges(e.boxes, max_ranges)
+        out = intervals_from_key_ranges(self.zs, zranges)
+        return IndexPlan(merge_intervals(out))
+
+
+class XZ2Index(FeatureIndex):
+    name = "xz2"
+
+    def __init__(self, sft: FeatureType):
+        super().__init__(sft)
+        self.sfc = xz2_sfc(sft.xz_precision)
+        self.codes: np.ndarray | None = None
+
+    @classmethod
+    def supports(cls, sft: FeatureType) -> bool:
+        return sft.geom_field is not None and not sft.geom_is_points
+
+    def can_serve(self, e: Extraction) -> bool:
+        return True
+
+    def build(self, table: FeatureTable) -> np.ndarray:
+        b = table.geom_column().bounds
+        codes = self.sfc.index((b[:, 0], b[:, 1]), (b[:, 2], b[:, 3]))
+        perm = np.argsort(codes, kind="stable")
+        self.perm = perm
+        self.codes = codes[perm]
+        self.n = len(table)
+        return perm
+
+    def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
+        if e.disjoint:
+            return IndexPlan.empty()
+        if e.boxes is None:
+            return IndexPlan.full(self.n)
+        windows = [((x1, y1), (x2, y2)) for x1, y1, x2, y2 in e.boxes]
+        cranges = self.sfc.ranges(windows, max_ranges)
+        out = intervals_from_key_ranges(self.codes, cranges)
+        return IndexPlan(merge_intervals(out))
+
+
+class IdIndex(FeatureIndex):
+    """Feature-id index (``geomesa-index-api/.../index/id/``): sort by fid."""
+
+    name = "id"
+
+    def __init__(self, sft: FeatureType):
+        super().__init__(sft)
+        self.fids: np.ndarray | None = None
+
+    @classmethod
+    def supports(cls, sft: FeatureType) -> bool:
+        return True
+
+    def can_serve(self, e: Extraction) -> bool:
+        return True
+
+    def build(self, table: FeatureTable) -> np.ndarray:
+        perm = np.argsort(table.fids, kind="stable")
+        self.perm = perm
+        self.fids = table.fids[perm]
+        self.n = len(table)
+        return perm
+
+    def plan_fids(self, fids) -> IndexPlan:
+        out = []
+        for fid in fids:
+            lo = int(np.searchsorted(self.fids, fid, side="left"))
+            hi = int(np.searchsorted(self.fids, fid, side="right"))
+            if hi > lo:
+                out.append((lo, hi))
+        return IndexPlan(merge_intervals(out), exact=True)
+
+    def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
+        return IndexPlan.full(self.n)

@@ -1,0 +1,203 @@
+"""Z3 (points + time) and XZ3 (extended geometries + time) indexes.
+
+Reference: ``geomesa-index-api/.../index/z3/Z3Index.scala:19`` with key layout
+``[shard][2B time-bin][8B z3][id]`` and ``Z3IndexKeySpace.scala`` (toIndexKey:64,
+getIndexValues:98, getRanges:162) / ``XZ3IndexKeySpace.scala``. TPU re-design:
+no byte rows — the sort order is ``(time-bin, z3)`` over the columnar snapshot,
+bins are tracked as contiguous sorted-row spans (they double as the coarse
+partition axis), and planning splits the range budget across bins exactly like
+``Z3IndexKeySpace.scala:165-177``. Hash shards (``ShardStrategy.scala``) are
+unnecessary on a device mesh — sharding happens by slicing the sorted store
+(SURVEY.md §2.20 P1/P2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.curve.binned_time import BinnedTime
+from geomesa_tpu.curve.sfc import z3_sfc
+from geomesa_tpu.curve.xz import xz3_sfc
+from geomesa_tpu.filter.bounds import Extraction
+from geomesa_tpu.index.api import (
+    DEFAULT_MAX_RANGES,
+    FeatureIndex,
+    IndexPlan,
+    intervals_from_key_ranges,
+    merge_intervals,
+)
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+
+WORLD = (-180.0, -90.0, 180.0, 90.0)
+
+
+def time_windows(
+    binned: BinnedTime, bin_values: np.ndarray, intervals
+) -> list[tuple[int, int, int]]:
+    """Expand temporal bounds into per-bin (bin, off_lo, off_hi) windows,
+    clipped to bins actually present in the data (shared by Z3 and XZ3 —
+    the per-bin budget split of ``Z3IndexKeySpace.scala:165-177``)."""
+    if len(bin_values) == 0:
+        return []
+    max_off = int(binned.max_offset)
+    if intervals is None:
+        return [(int(b), 0, max_off) for b in bin_values]
+    out = []
+    for lo_ms, hi_ms in intervals:
+        lo_ms = max(int(lo_ms), 0)
+        # clamp to the last millisecond of the last bin present in the data
+        hi_ms = min(
+            int(hi_ms),
+            int(binned.bin_start_millis(np.array([int(bin_values[-1]) + 1]))[0]) - 1,
+        )
+        if hi_ms < lo_ms:
+            continue
+        (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo_ms]))
+        (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi_ms]))
+        sel = (bin_values >= blo) & (bin_values <= bhi)
+        for b in bin_values[sel]:
+            w_lo = int(olo) if b == blo else 0
+            w_hi = int(ohi) if b == bhi else max_off
+            out.append((int(b), w_lo, w_hi))
+    return out
+
+
+class Z3Index(FeatureIndex):
+    name = "z3"
+
+    def __init__(self, sft: FeatureType):
+        super().__init__(sft)
+        self.period = sft.z3_interval
+        self.binned = BinnedTime(self.period)
+        self.sfc = z3_sfc(self.period)
+        # build products
+        self.bins: np.ndarray | None = None  # sorted (n,) int32
+        self.zs: np.ndarray | None = None  # sorted (n,) uint64
+        self.offsets: np.ndarray | None = None  # sorted (n,) int64 offsets
+        self.bin_values: np.ndarray | None = None  # unique bins present
+        self.bin_starts: np.ndarray | None = None  # row span starts per bin
+
+    @classmethod
+    def supports(cls, sft: FeatureType) -> bool:
+        return sft.geom_is_points and sft.dtg_field is not None
+
+    def can_serve(self, e: Extraction) -> bool:
+        return True  # full-domain scan degrades gracefully
+
+    def build(self, table: FeatureTable) -> np.ndarray:
+        col = table.geom_column()
+        t_ms = table.dtg_millis()
+        bins, offs = self.binned.to_bin_and_offset(t_ms)
+        z = self.sfc.index(col.x, col.y, offs)
+        perm = np.lexsort((z, bins))
+        self.perm = perm
+        self.bins = bins[perm]
+        self.offsets = offs[perm]
+        self.zs = z[perm]
+        self.n = len(table)
+        self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
+        return perm
+
+    # -- planning ------------------------------------------------------------
+    def _bin_span(self, b: int) -> tuple[int, int]:
+        i = np.searchsorted(self.bin_values, b)
+        if i == len(self.bin_values) or self.bin_values[i] != b:
+            return (0, 0)
+        start = int(self.bin_starts[i])
+        end = int(self.bin_starts[i + 1]) if i + 1 < len(self.bin_starts) else self.n
+        return (start, end)
+
+    def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
+        if e.disjoint or self.n == 0:
+            return IndexPlan.empty()
+        boxes = e.boxes if e.boxes is not None else [WORLD]
+        windows = time_windows(self.binned, self.bin_values, e.intervals)
+        if not windows:
+            return IndexPlan.empty()
+        budget = max(1, max_ranges // max(1, len(windows)))
+        out: list[tuple[int, int]] = []
+        for b, w_lo, w_hi in windows:
+            start, end = self._bin_span(b)
+            if end <= start:
+                continue
+            zranges = self.sfc.ranges(boxes, (float(w_lo), float(w_hi)), budget)
+            out.extend(
+                intervals_from_key_ranges(self.zs[start:end], zranges, offset=start)
+            )
+        return IndexPlan(merge_intervals(out))
+
+
+class XZ3Index(FeatureIndex):
+    """XZ3: bbox-of-geometry + time instant, for non-point default geometries."""
+
+    name = "xz3"
+
+    def __init__(self, sft: FeatureType):
+        super().__init__(sft)
+        self.period = sft.z3_interval
+        self.binned = BinnedTime(self.period)
+        self.sfc = xz3_sfc(self.period, sft.xz_precision)
+        self.bins: np.ndarray | None = None
+        self.codes: np.ndarray | None = None
+        self.bin_values: np.ndarray | None = None
+        self.bin_starts: np.ndarray | None = None
+
+    @classmethod
+    def supports(cls, sft: FeatureType) -> bool:
+        return (
+            sft.geom_field is not None
+            and not sft.geom_is_points
+            and sft.dtg_field is not None
+        )
+
+    def can_serve(self, e: Extraction) -> bool:
+        return True
+
+    def build(self, table: FeatureTable) -> np.ndarray:
+        col = table.geom_column()
+        b = col.bounds  # (n, 4)
+        t_ms = table.dtg_millis()
+        bins, offs = self.binned.to_bin_and_offset(t_ms)
+        o = offs.astype(np.float64)
+        codes = self.sfc.index(
+            (b[:, 0], b[:, 1], o), (b[:, 2], b[:, 3], o)
+        )
+        perm = np.lexsort((codes, bins))
+        self.perm = perm
+        self.bins = bins[perm]
+        self.codes = codes[perm]
+        self.n = len(table)
+        self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
+        return perm
+
+    def _bin_span(self, b: int) -> tuple[int, int]:
+        i = np.searchsorted(self.bin_values, b)
+        if i == len(self.bin_values) or self.bin_values[i] != b:
+            return (0, 0)
+        start = int(self.bin_starts[i])
+        end = int(self.bin_starts[i + 1]) if i + 1 < len(self.bin_starts) else self.n
+        return (start, end)
+
+    def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
+        if e.disjoint or self.n == 0:
+            return IndexPlan.empty()
+        boxes = e.boxes if e.boxes is not None else [WORLD]
+        windows = time_windows(self.binned, self.bin_values, e.intervals)
+        if not windows:
+            return IndexPlan.empty()
+        budget = max(1, max_ranges // max(1, len(windows)))
+        out: list[tuple[int, int]] = []
+        for b, w_lo, w_hi in windows:
+            start, end = self._bin_span(b)
+            if end <= start:
+                continue
+            wins = [
+                ((x1, y1, float(w_lo)), (x2, y2, float(w_hi)))
+                for x1, y1, x2, y2 in boxes
+            ]
+            cranges = self.sfc.ranges(wins, budget)
+            out.extend(
+                intervals_from_key_ranges(self.codes[start:end], cranges, offset=start)
+            )
+        return IndexPlan(merge_intervals(out))

@@ -1,0 +1,53 @@
+"""Density (heatmap) aggregation kernel: the ``DensityScan`` role.
+
+Reference: server-side density iterators snapping each feature into a
+``RenderingGrid`` of weighted counts, partial grids merged client-side
+(``geomesa-index-api/.../iterators/DensityScan.scala:28``,
+``utils/geotools/RenderingGrid`` — SURVEY.md §2.3/§3.4). TPU re-design: a
+fixed-shape scatter-add over candidate slots; per-shard partial grids are
+``psum``-merged over ICI (:mod:`geomesa_tpu.parallel.query`) instead of
+client-side fold. Default grid 256×256 (``QueryHints.scala:30-31``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_GRID = (256, 256)  # (width, height)
+
+
+@partial(jax.jit, static_argnames=("width", "height"))
+def density_grid(x, y, idx, mask, grid_bounds, width: int = 256, height: int = 256):
+    """Accumulate masked candidate slots into a (height, width) f32 grid.
+
+    Args:
+      x, y: (N,) int32 normalized coords (index order, device-resident).
+      idx: (C,) int32 candidate slots.
+      mask: (C,) bool — refine survivors.
+      grid_bounds: (4,) int32 [xlo, xhi, ylo, yhi] in the same normalized
+        int domain (inclusive).
+      width, height: output resolution (static).
+
+    Returns:
+      (height, width) float32 weighted counts; row 0 = ymin edge.
+    """
+    xi = x[idx].astype(jnp.float32)
+    yi = y[idx].astype(jnp.float32)
+    xlo = grid_bounds[0].astype(jnp.float32)
+    xhi = grid_bounds[1].astype(jnp.float32)
+    ylo = grid_bounds[2].astype(jnp.float32)
+    yhi = grid_bounds[3].astype(jnp.float32)
+
+    sx = width / (xhi - xlo + 1.0)
+    sy = height / (yhi - ylo + 1.0)
+    cx = jnp.clip(((xi - xlo) * sx).astype(jnp.int32), 0, width - 1)
+    cy = jnp.clip(((yi - ylo) * sy).astype(jnp.int32), 0, height - 1)
+
+    in_grid = (xi >= xlo) & (xi <= xhi) & (yi >= ylo) & (yi <= yhi)
+    w = (mask & in_grid).astype(jnp.float32)
+    flat = jnp.zeros(width * height, dtype=jnp.float32)
+    flat = flat.at[cy * width + cx].add(w)
+    return flat.reshape(height, width)

@@ -1,0 +1,110 @@
+"""Device-side candidate refinement: the ``Z3Iterator``/``Z2Iterator`` role.
+
+Reference: the server-side push-down filters that decode z-cells and compare in
+*normalized int space* (``geomesa-index-api/.../index/filters/Z3Filter.scala:24-55``,
+``Z2Filter``; deployed in Accumulo iterators / HBase filters — SURVEY.md §2.9).
+TPU re-design: one fused, fixed-shape jitted kernel over gathered candidate
+slots — int32 compares on the VPU, no byte decoding, no per-range dispatch.
+
+Int-domain compares are a *superset* test (normalization is monotone, so query
+bounds normalized outward can only admit extra boundary-cell rows, never drop a
+match); the exact f64 residual filter runs downstream on the survivors.
+
+All inputs are explicitly int32 — this kernel must never silently widen under
+x64 mode.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_BOXES = 8  # padded box-slot count (static shape)
+MAX_TIMES = 16  # padded time-window slot count
+
+# sentinel rows make padded slots always-false (lo > hi)
+_BOX_PAD = np.array([1, 0, 1, 0], dtype=np.int32)
+_TIME_PAD = np.array([1, 0, 0, -1], dtype=np.int32)
+
+
+def pack_boxes(boxes_i32: np.ndarray | None) -> np.ndarray:
+    """(B, 4) [xlo, xhi, ylo, yhi] int32 → padded (MAX_BOXES, 4).
+
+    More boxes than slots → collapse to the bounding envelope (still a
+    superset; residual recovers exactness).
+    """
+    if boxes_i32 is None or len(boxes_i32) == 0:
+        full = np.array([[0, 2**31 - 1, 0, 2**31 - 1]], dtype=np.int32)
+        boxes_i32 = full
+    b = np.asarray(boxes_i32, dtype=np.int32)
+    if len(b) > MAX_BOXES:
+        b = np.array(
+            [[b[:, 0].min(), b[:, 1].max(), b[:, 2].min(), b[:, 3].max()]],
+            dtype=np.int32,
+        )
+    pad = np.broadcast_to(_BOX_PAD, (MAX_BOXES - len(b), 4))
+    return np.vstack([b, pad])
+
+
+def pack_times(times_i32: np.ndarray | None) -> np.ndarray:
+    """(T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 → padded (MAX_TIMES, 4)."""
+    if times_i32 is None or len(times_i32) == 0:
+        full = np.array([[0, 0, 2**31 - 1, 2**31 - 1]], dtype=np.int32)
+        times_i32 = full
+    t = np.asarray(times_i32, dtype=np.int32)
+    if len(t) > MAX_TIMES:
+        t = np.array(
+            [[t[:, 0].min(), 0, t[:, 2].max(), 2**31 - 1]], dtype=np.int32
+        )
+    pad = np.broadcast_to(_TIME_PAD, (MAX_TIMES - len(t), 4))
+    return np.vstack([t, pad])
+
+
+@jax.jit
+def refine_points(x, y, bins, offs, idx, count, boxes, times):
+    """Fused gather + int-domain bbox/time refine over candidate slots.
+
+    Args:
+      x, y: (N,) int32 normalized coords, sorted in index order (device-resident).
+      bins, offs: (N,) int32 time bin / offset-in-bin, same order.
+      idx: (C,) int32 candidate slot → sorted-row position (host-planned).
+      count: () int32 — number of real (non-padding) slots.
+      boxes: (MAX_BOXES, 4) int32 [xlo, xhi, ylo, yhi] inclusive.
+      times: (MAX_TIMES, 4) int32 [bin_lo, off_lo, bin_hi, off_hi] inclusive.
+
+    Returns:
+      (C,) bool mask of candidates passing the int-domain superset test.
+    """
+    xi = x[idx][:, None]  # (C, 1)
+    yi = y[idx][:, None]
+    bi = bins[idx][:, None]
+    oi = offs[idx][:, None]
+
+    in_box = (
+        (xi >= boxes[None, :, 0])
+        & (xi <= boxes[None, :, 1])
+        & (yi >= boxes[None, :, 2])
+        & (yi <= boxes[None, :, 3])
+    ).any(axis=1)
+
+    after_lo = (bi > times[None, :, 0]) | (
+        (bi == times[None, :, 0]) & (oi >= times[None, :, 1])
+    )
+    before_hi = (bi < times[None, :, 2]) | (
+        (bi == times[None, :, 2]) & (oi <= times[None, :, 3])
+    )
+    in_time = (after_lo & before_hi).any(axis=1)
+
+    valid = jnp.arange(idx.shape[0], dtype=jnp.int32) < count
+    return in_box & in_time & valid
+
+
+@jax.jit
+def count_points(x, y, bins, offs, idx, count, boxes, times):
+    """Candidate count after refine — the aggregation fast path (no gather-out)."""
+    return refine_points(x, y, bins, offs, idx, count, boxes, times).sum(
+        dtype=jnp.int32
+    )

@@ -1,0 +1,30 @@
+"""The flagship single-device query step: fused gather → refine → aggregate.
+
+This is the framework's "forward pass": one jittable function composing the
+refine kernel (:func:`geomesa_tpu.ops.refine.refine_points`) with the density
+kernel (:func:`geomesa_tpu.ops.density.density_grid`) — XLA fuses the shared
+gathers under jit. The sharded variant lives in
+:mod:`geomesa_tpu.parallel.query`.
+"""
+
+from __future__ import annotations
+
+from geomesa_tpu.ops.density import density_grid
+from geomesa_tpu.ops.refine import refine_points
+
+
+def query_step(x, y, bins, offs, idx, count, boxes, times, grid_bounds,
+               width: int = 256, height: int = 256):
+    """Single-device fused scan step (jittable; shapes static per bucket).
+
+    Args mirror :func:`geomesa_tpu.ops.refine.refine_points` plus
+    ``grid_bounds`` (4,) int32 for the density grid.
+
+    Returns (count int32, grid (height, width) f32, mask (C,) bool).
+    """
+    import jax.numpy as jnp
+
+    mask = refine_points(x, y, bins, offs, idx, count, boxes, times)
+    n = mask.sum(dtype=jnp.int32)
+    grid = density_grid(x, y, idx, mask, grid_bounds, width=width, height=height)
+    return n, grid, mask

@@ -1,0 +1,63 @@
+"""Device-mesh construction and store sharding (SURVEY.md §2.20 strategy table).
+
+The reference distributes by range-partitioning sorted row keys across tablet
+servers (P1) plus hash shards (P2). TPU-native: the z-sorted columnar store is
+split *contiguously* across the mesh's ``data`` axis (curve order = ring
+order — the "sequence parallel" axis of SURVEY.md §5), and batched queries are
+split across an optional ``query`` axis (the DP axis). Collectives: ``psum``
+over ``data`` merges per-shard partial aggregates — the role of the
+client-side fold over tablet-server partials.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+QUERY_AXIS = "query"
+
+
+def make_mesh(n_devices: int | None = None, query_parallel: int = 1) -> Mesh:
+    """A (data × query) mesh. ``query_parallel`` must divide ``n_devices``."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % query_parallel != 0:
+        raise ValueError(f"query_parallel {query_parallel} must divide {n} devices")
+    arr = np.array(devices).reshape(n // query_parallel, query_parallel)
+    return Mesh(arr, (DATA_AXIS, QUERY_AXIS))
+
+
+def data_shards(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def pad_rows(n: int, shards: int) -> int:
+    """Row count padded so every shard gets an equal contiguous slice."""
+    return ((n + shards - 1) // shards) * shards
+
+
+def shard_columns(mesh: Mesh, columns: dict[str, np.ndarray], pad_value=0):
+    """Pad + device_put columns sharded along the mesh ``data`` axis.
+
+    Returns (sharded jnp arrays dict, padded_n, rows_per_shard). Padding rows
+    carry ``pad_value`` and must be masked by the caller (they never appear in
+    scan intervals because intervals are bounded by the true row count).
+    """
+    shards = data_shards(mesh)
+    n = len(next(iter(columns.values())))
+    padded = pad_rows(max(n, shards), shards)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out = {}
+    for name, arr in columns.items():
+        if len(arr) != n:
+            raise ValueError(f"column {name} length mismatch")
+        if padded != n:
+            pad = np.full(padded - n, pad_value, dtype=arr.dtype)
+            arr = np.concatenate([arr, pad])
+        out[name] = jax.device_put(arr, sharding)
+    return out, padded, padded // shards

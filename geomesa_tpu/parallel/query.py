@@ -1,0 +1,202 @@
+"""SPMD query execution over a device mesh: shard_map + collectives.
+
+The reference's scan fan-out is a BatchScanner RPC to tablet servers, each
+running an iterator stack, with partials folded client-side (SURVEY.md §3.3,
+§2.20 P4-P6). TPU-native: the sorted store is contiguously sharded over the
+mesh ``data`` axis; every shard runs the same fused refine/aggregate kernel on
+its slice; partial counts/grids are ``psum``-merged over ICI. Batched queries
+ride the ``query`` mesh axis (DP): each query-column of the mesh scans the
+whole (replicated-over-query) store for its slice of the query batch.
+
+Two execution shapes:
+
+- :func:`make_batched_count_step` / :func:`make_batched_density_step` —
+  throughput path: Q queries × full-shard masked scan, no host planning.
+- :func:`make_select_step` — latency path: host-planned candidate slots
+  (z-range intervals → per-shard gather indices), device refine, psum count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from geomesa_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, data_shards
+
+
+def split_intervals_by_shard(
+    intervals: np.ndarray, rows_per_shard: int, n_shards: int, bucket: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Global [start, end) row intervals → per-shard local gather indices.
+
+    Returns (idx (D, C) int32 local positions, counts (D,) int32). ``bucket``
+    is the common padded slot count C (max per-shard candidates, rounded up by
+    the caller).
+    """
+    idx = np.zeros((n_shards, bucket), dtype=np.int32)
+    counts = np.zeros(n_shards, dtype=np.int32)
+    for d in range(n_shards):
+        lo = d * rows_per_shard
+        hi = lo + rows_per_shard
+        pos_list = []
+        for s, e in intervals:
+            s2, e2 = max(int(s), lo), min(int(e), hi)
+            if e2 > s2:
+                pos_list.append(np.arange(s2 - lo, e2 - lo, dtype=np.int32))
+        if pos_list:
+            pos = np.concatenate(pos_list)
+            if len(pos) > bucket:
+                raise ValueError(f"shard {d}: {len(pos)} candidates > bucket {bucket}")
+            idx[d, : len(pos)] = pos
+            counts[d] = len(pos)
+    return idx, counts
+
+
+def max_shard_candidates(intervals: np.ndarray, rows_per_shard: int, n_shards: int) -> int:
+    best = 0
+    for d in range(n_shards):
+        lo, hi = d * rows_per_shard, (d + 1) * rows_per_shard
+        tot = 0
+        for s, e in intervals:
+            tot += max(0, min(int(e), hi) - max(int(s), lo))
+        best = max(best, tot)
+    return best
+
+
+def make_select_step(mesh: Mesh):
+    """Latency path: per-shard gather + refine; returns (mask (D,C), count)."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS),
+            P(),
+            P(),
+        ),
+        out_specs=(P(DATA_AXIS, None), P()),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, idx, count, boxes, times):
+        from geomesa_tpu.ops.refine import refine_points
+
+        mask = refine_points(x, y, bins, offs, idx[0], count[0], boxes, times)
+        total = jax.lax.psum(mask.sum(dtype=jnp.int32), DATA_AXIS)
+        # query axis replicates the work; collective over it is identity-safe
+        return mask[None, :], total
+
+    return step
+
+
+def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
+    """(Ql, Nl) bool: query q matches local row r (int-domain superset test)."""
+    xi = x[None, None, :]  # (1, 1, Nl)
+    yi = y[None, None, :]
+    bi = bins[None, None, :]
+    oi = offs[None, None, :]
+    in_box = (
+        (xi >= boxes[:, :, 0, None])
+        & (xi <= boxes[:, :, 1, None])
+        & (yi >= boxes[:, :, 2, None])
+        & (yi <= boxes[:, :, 3, None])
+    ).any(axis=1)
+    after = (bi > times[:, :, 0, None]) | (
+        (bi == times[:, :, 0, None]) & (oi >= times[:, :, 1, None])
+    )
+    before = (bi < times[:, :, 2, None]) | (
+        (bi == times[:, :, 2, None]) & (oi <= times[:, :, 3, None])
+    )
+    in_time = (after & before).any(axis=1)
+    rows_valid = (base + jnp.arange(x.shape[0], dtype=jnp.int32)) < true_n
+    return in_box & in_time & rows_valid[None, :]
+
+
+def make_batched_count_step(mesh: Mesh):
+    """Throughput path: Q queries full-scan counts, psum over data shards.
+
+    fn(x, y, bins, offs, true_n, boxes (Q, B, 4), times (Q, T, 4)) → (Q,) int32.
+    """
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+        ),
+        out_specs=P(QUERY_AXIS),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, boxes, times):
+        base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
+        m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)
+        return jax.lax.psum(m.sum(axis=1, dtype=jnp.int32), DATA_AXIS)
+
+    return step
+
+
+def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
+    """Q queries full-scan density grids: (Q, H, W) f32, psum over data shards.
+
+    ``grid_bounds``: (Q, 4) int32 [xlo, xhi, ylo, yhi] per query.
+    """
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None),
+        ),
+        out_specs=P(QUERY_AXIS, None, None),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, boxes, times, grid_bounds):
+        base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
+        m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)  # (Ql, Nl)
+
+        def one(mask_q, gb):
+            xi = x.astype(jnp.float32)
+            yi = y.astype(jnp.float32)
+            xlo = gb[0].astype(jnp.float32)
+            xhi = gb[1].astype(jnp.float32)
+            ylo = gb[2].astype(jnp.float32)
+            yhi = gb[3].astype(jnp.float32)
+            sx = width / (xhi - xlo + 1.0)
+            sy = height / (yhi - ylo + 1.0)
+            cx = jnp.clip(((xi - xlo) * sx).astype(jnp.int32), 0, width - 1)
+            cy = jnp.clip(((yi - ylo) * sy).astype(jnp.int32), 0, height - 1)
+            w = mask_q.astype(jnp.float32)
+            flat = jnp.zeros(width * height, dtype=jnp.float32)
+            flat = flat.at[cy * width + cx].add(w)
+            return flat.reshape(height, width)
+
+        grids = jax.vmap(one)(m, grid_bounds)  # (Ql, H, W)
+        return jax.lax.psum(grids, DATA_AXIS)
+
+    return step

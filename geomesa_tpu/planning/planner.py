@@ -1,0 +1,179 @@
+"""Query planner: configure → decide strategy → plan ranges → execute → reduce.
+
+The ``QueryPlanner`` / ``StrategyDecider`` / ``FilterSplitter`` roles
+(``geomesa-index-api/.../planning/QueryPlanner.scala:43,63``,
+``StrategyDecider.scala:41-67``; call stack SURVEY.md §3.3). Planning is
+host-side Python; execution is a backend call (brute-force oracle or TPU
+kernels). The residual ("secondary") filter is always the full original filter
+— cheap to re-apply vectorized, and it makes every scan plan trivially sound.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import Extraction, extract
+from geomesa_tpu.filter.cql import parse as parse_cql
+from geomesa_tpu.index.api import DEFAULT_MAX_RANGES, FeatureIndex, IndexPlan
+from geomesa_tpu.index.z2 import IdIndex, XZ2Index, Z2Index
+from geomesa_tpu.index.z3 import XZ3Index, Z3Index
+from geomesa_tpu.schema.sft import FeatureType
+
+ALL_INDEX_TYPES = [Z3Index, XZ3Index, Z2Index, XZ2Index, IdIndex]
+INDEX_BY_NAME = {c.name: c for c in ALL_INDEX_TYPES}
+
+
+@dataclass
+class Query:
+    """A query against one feature type (OGC ``Query`` role).
+
+    ``filter``: CQL string or AST node. ``properties``: projection (None = all).
+    ``hints``: QueryHints analog (``index/conf/QueryHints.scala``) — e.g.
+    ``{"index": "z2"}`` to force an index, ``{"loose_bbox": True}``,
+    aggregation hints (``density``, ``stats``, ``bin``...).
+    """
+
+    filter: Any = None
+    properties: list[str] | None = None
+    sort_by: tuple[str, bool] | None = None  # (field, descending)
+    limit: int | None = None
+    hints: dict = field(default_factory=dict)
+
+    def resolved_filter(self) -> ast.Filter:
+        if self.filter is None:
+            return ast.Include()
+        if isinstance(self.filter, str):
+            return parse_cql(self.filter)
+        return self.filter
+
+
+@dataclass
+class QueryPlanInfo:
+    """Explain output (``Explainer`` role, ``index/utils/Explainer.scala:16``)."""
+
+    type_name: str
+    filter_str: str
+    index_name: str
+    extraction: Extraction
+    n_intervals: int
+    n_candidates: int
+    plan_ms: float
+    notes: list[str] = field(default_factory=list)
+
+    def explain(self) -> str:
+        lines = [
+            f"Planning '{self.type_name}' {self.filter_str}",
+            f"  Index: {self.index_name}",
+            f"  Spatial bounds: {self.extraction.boxes}",
+            f"  Temporal bounds: {self.extraction.intervals}",
+            f"  Scan intervals: {self.n_intervals} covering {self.n_candidates} rows",
+            f"  Planning time: {self.plan_ms:.2f} ms",
+        ]
+        lines += [f"  Note: {n}" for n in self.notes]
+        return "\n".join(lines)
+
+
+def _extract_fids(f: ast.Filter):
+    """Top-level fid filter (possibly AND'd): the ID-index fast path."""
+    if isinstance(f, ast.FidIn):
+        return f.fids
+    if isinstance(f, ast.And):
+        for c in f.children:
+            if isinstance(c, ast.FidIn):
+                return c.fids
+    return None
+
+
+class StrategyDecider:
+    """Pick the best index for an extraction (heuristic cost model).
+
+    Reference: ``StrategyDecider.scala`` — cost-based with stats when
+    available; this version scores by specificity (id > z3 > z2 > full scan),
+    mirroring the reference's heuristic fallback; stats-backed costing plugs in
+    via :mod:`geomesa_tpu.stats` (SURVEY.md §2.3).
+    """
+
+    @staticmethod
+    def choose(
+        indices: dict[str, FeatureIndex],
+        e: Extraction,
+        f: ast.Filter,
+        hints: dict,
+    ) -> tuple[str, Any]:
+        forced = hints.get("index")
+        if forced:
+            if forced not in indices:
+                raise ValueError(f"forced index {forced!r} not available")
+            return forced, None
+        fids = _extract_fids(f)
+        if fids is not None and "id" in indices:
+            return "id", fids
+        spatial = e.spatially_bounded
+        temporal = e.temporally_bounded
+        if temporal and ("z3" in indices or "xz3" in indices):
+            return ("z3" if "z3" in indices else "xz3"), None
+        if spatial and ("z2" in indices or "xz2" in indices):
+            return ("z2" if "z2" in indices else "xz2"), None
+        if "z3" in indices or "xz3" in indices:
+            return ("z3" if "z3" in indices else "xz3"), None
+        if "z2" in indices or "xz2" in indices:
+            return ("z2" if "z2" in indices else "xz2"), None
+        return "id", None
+
+
+class QueryPlanner:
+    """Plans one query over one feature type's built indexes."""
+
+    def __init__(self, sft: FeatureType, indices: dict[str, FeatureIndex]):
+        self.sft = sft
+        self.indices = indices
+
+    def plan(
+        self, q: Query, max_ranges: int = DEFAULT_MAX_RANGES
+    ) -> tuple[IndexPlan, ast.Filter, QueryPlanInfo]:
+        t0 = time.perf_counter()
+        f = q.resolved_filter()
+        e = extract(f, self.sft.geom_field, self.sft.dtg_field)
+        name, fids = StrategyDecider.choose(self.indices, e, f, q.hints)
+        index = self.indices[name]
+        notes = []
+        if fids is not None and isinstance(index, IdIndex):
+            plan = index.plan_fids(fids)
+            notes.append(f"id lookup on {len(fids)} fids")
+        else:
+            plan = index.plan(e, max_ranges)
+        info = QueryPlanInfo(
+            type_name=self.sft.name,
+            filter_str=str(q.filter) if q.filter is not None else "INCLUDE",
+            index_name=name,
+            extraction=e,
+            n_intervals=len(plan.intervals),
+            n_candidates=plan.n_candidates,
+            plan_ms=(time.perf_counter() - t0) * 1e3,
+            notes=notes,
+        )
+        return plan, f, info
+
+
+def build_indices(sft: FeatureType) -> dict[str, FeatureIndex]:
+    """Instantiate the index set for a schema (``IndexManager`` role).
+
+    Respects ``geomesa.indices`` user-data override; defaults to every index
+    whose ``supports`` matches (reference default: z3+z2[+attr]+id for points,
+    xz3+xz2+id for extended geometries).
+    """
+    configured = sft.configured_indices
+    out: dict[str, FeatureIndex] = {}
+    for cls in ALL_INDEX_TYPES:
+        if configured is not None and cls.name not in configured:
+            continue
+        if cls.supports(sft):
+            out[cls.name] = cls(sft)
+    if not out:
+        out["id"] = IdIndex(sft)
+    return out

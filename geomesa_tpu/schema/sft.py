@@ -1,0 +1,241 @@
+"""Feature-type schema DSL: the ``name:Type:opt=...`` spec string.
+
+Capability parity with the reference's ``SimpleFeatureTypes`` spec system
+(``geomesa-utils/src/main/scala/org/locationtech/geomesa/utils/geotools/
+SimpleFeatureTypes.scala`` — SURVEY.md §2.18, "the de-facto schema DSL"):
+
+    "name:String:index=true,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval='week'"
+
+- ``*`` marks the default geometry attribute.
+- per-attribute options after a second ``:`` (``index=true``, ``srid=4326``,
+  ``cardinality=high``...).
+- schema-level user data after ``;`` (``geomesa.z3.interval``,
+  ``geomesa.xz.precision``, ``geomesa.z.splits``, ``geomesa.indices``...).
+
+The schema drives index selection, key-space configuration and the columnar
+layout (:mod:`geomesa_tpu.schema.columnar`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from geomesa_tpu.curve.binned_time import TimePeriod
+
+
+class AttributeType(str, Enum):
+    STRING = "String"
+    INT = "Integer"
+    LONG = "Long"
+    FLOAT = "Float"
+    DOUBLE = "Double"
+    BOOLEAN = "Boolean"
+    DATE = "Date"
+    UUID = "UUID"
+    BYTES = "Bytes"
+    POINT = "Point"
+    LINESTRING = "LineString"
+    POLYGON = "Polygon"
+    MULTIPOINT = "MultiPoint"
+    MULTILINESTRING = "MultiLineString"
+    MULTIPOLYGON = "MultiPolygon"
+    GEOMETRY = "Geometry"
+
+    @property
+    def is_geometry(self) -> bool:
+        return self in _GEOM_TYPES
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (
+            AttributeType.INT,
+            AttributeType.LONG,
+            AttributeType.FLOAT,
+            AttributeType.DOUBLE,
+        )
+
+
+_GEOM_TYPES = {
+    AttributeType.POINT,
+    AttributeType.LINESTRING,
+    AttributeType.POLYGON,
+    AttributeType.MULTIPOINT,
+    AttributeType.MULTILINESTRING,
+    AttributeType.MULTIPOLYGON,
+    AttributeType.GEOMETRY,
+}
+
+_TYPE_ALIASES = {t.value.lower(): t for t in AttributeType}
+_TYPE_ALIASES.update({"int": AttributeType.INT, "str": AttributeType.STRING})
+
+
+@dataclass(frozen=True)
+class AttributeDescriptor:
+    name: str
+    type: AttributeType
+    options: dict = field(default_factory=dict)
+
+    @property
+    def indexed(self) -> bool:
+        v = str(self.options.get("index", "false")).lower()
+        return v in ("true", "full", "join")
+
+
+@dataclass
+class FeatureType:
+    """Schema: ordered attributes + index configuration user-data."""
+
+    name: str
+    attributes: list[AttributeDescriptor]
+    default_geom: str | None = None
+    user_data: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        names = [a.name for a in self.attributes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate attribute names in schema: {names}")
+        if self.default_geom is None:
+            for a in self.attributes:
+                if a.type.is_geometry:
+                    self.default_geom = a.name
+                    break
+
+    # -- lookups ------------------------------------------------------------
+    def attr(self, name: str) -> AttributeDescriptor:
+        for a in self.attributes:
+            if a.name == name:
+                return a
+        raise KeyError(f"no such attribute: {name!r} in {self.name}")
+
+    def index_of(self, name: str) -> int:
+        for i, a in enumerate(self.attributes):
+            if a.name == name:
+                return i
+        raise KeyError(f"no such attribute: {name!r} in {self.name}")
+
+    def __contains__(self, name: str) -> bool:
+        return any(a.name == name for a in self.attributes)
+
+    @property
+    def geom_field(self) -> str | None:
+        return self.default_geom
+
+    @property
+    def dtg_field(self) -> str | None:
+        """Default date attribute: explicit user-data override, else first Date."""
+        explicit = self.user_data.get("geomesa.index.dtg")
+        if explicit:
+            return explicit
+        for a in self.attributes:
+            if a.type == AttributeType.DATE:
+                return a.name
+        return None
+
+    @property
+    def geom_is_points(self) -> bool:
+        return (
+            self.default_geom is not None
+            and self.attr(self.default_geom).type == AttributeType.POINT
+        )
+
+    # -- index configuration (reference: RichSimpleFeatureType) -------------
+    @property
+    def z3_interval(self) -> TimePeriod:
+        return TimePeriod(self.user_data.get("geomesa.z3.interval", "week"))
+
+    @property
+    def xz_precision(self) -> int:
+        return int(self.user_data.get("geomesa.xz.precision", 12))
+
+    @property
+    def shards(self) -> int:
+        """Hash-shard count for hot-spot spreading (``geomesa.z.splits``)."""
+        return int(self.user_data.get("geomesa.z.splits", 4))
+
+    @property
+    def configured_indices(self) -> list[str] | None:
+        v = self.user_data.get("geomesa.indices")
+        if not v:
+            return None
+        return [s.strip() for s in v.split(",") if s.strip()]
+
+    # -- spec round-trip -----------------------------------------------------
+    def to_spec(self) -> str:
+        parts = []
+        for a in self.attributes:
+            star = "*" if a.name == self.default_geom and a.type.is_geometry else ""
+            s = f"{star}{a.name}:{a.type.value}"
+            if a.options:
+                s += ":" + ":".join(f"{k}={v}" for k, v in a.options.items())
+            parts.append(s)
+        spec = ",".join(parts)
+        if self.user_data:
+            spec += ";" + ",".join(f"{k}='{v}'" for k, v in self.user_data.items())
+        return spec
+
+
+def parse_spec(name: str, spec: str) -> FeatureType:
+    """Parse a ``SimpleFeatureTypes``-style spec string into a FeatureType."""
+    spec = spec.strip()
+    if ";" in spec:
+        attr_part, ud_part = spec.split(";", 1)
+    else:
+        attr_part, ud_part = spec, ""
+
+    attributes: list[AttributeDescriptor] = []
+    default_geom = None
+    for chunk in _split_top(attr_part, ","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        is_default = chunk.startswith("*")
+        if is_default:
+            chunk = chunk[1:]
+        fields = chunk.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"invalid attribute spec: {chunk!r}")
+        aname, atype = fields[0].strip(), fields[1].strip()
+        try:
+            typ = _TYPE_ALIASES[atype.lower()]
+        except KeyError:
+            raise ValueError(f"unknown attribute type {atype!r} in {chunk!r}") from None
+        options = {}
+        for opt in fields[2:]:
+            if "=" in opt:
+                k, v = opt.split("=", 1)
+                options[k.strip()] = v.strip()
+        attributes.append(AttributeDescriptor(aname, typ, options))
+        if is_default:
+            if not typ.is_geometry:
+                raise ValueError(f"default-geometry marker on non-geometry: {chunk!r}")
+            default_geom = aname
+
+    user_data = {}
+    if ud_part:
+        for kv in _split_top(ud_part, ","):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                user_data[k.strip()] = v.strip().strip("'\"")
+
+    return FeatureType(name, attributes, default_geom, user_data)
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    """Split on ``sep`` outside of quotes."""
+    out, cur, q = [], [], None
+    for ch in s:
+        if q:
+            if ch == q:
+                q = None
+            cur.append(ch)
+        elif ch in "'\"":
+            q = ch
+            cur.append(ch)
+        elif ch == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out

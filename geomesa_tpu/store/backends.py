@@ -1,0 +1,191 @@
+"""Execution backends: the bottom seam (``IndexAdapter`` role, SURVEY.md §1).
+
+Two implementations, mirroring the reference's two-tier test architecture
+(SURVEY.md §4 "lesson"):
+
+- :class:`OracleBackend` — brute-force vectorized filter evaluation over the
+  host columnar table. The result-set parity referee (the ``GeoCQEngine`` /
+  ``TestGeoMesaDataStore`` role).
+- :class:`TpuBackend` — device-resident int32 columns per index order; scans
+  gather host-planned candidate slots and run the fused jit refine kernel
+  (:mod:`geomesa_tpu.ops.refine`), then apply the exact f64 residual filter to
+  the survivors on the host (the coprocessor/iterator stack role).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from geomesa_tpu.curve.binned_time import BinnedTime
+from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import Extraction
+from geomesa_tpu.index.api import FeatureIndex, IndexPlan, gather_indices, pad_bucket
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+
+REFINE_PRECISION = 31  # device coords are 31-bit fixed point (Z2 resolution)
+
+
+class ExecutionBackend:
+    name = "base"
+
+    def load(self, sft: FeatureType, table: FeatureTable, indices: dict) -> Any:
+        """(Re)build backend state for a snapshot of the data."""
+        raise NotImplementedError
+
+    def select(
+        self,
+        state: Any,
+        index: FeatureIndex,
+        plan: IndexPlan,
+        extraction: Extraction,
+        residual: ast.Filter,
+        table: FeatureTable,
+    ) -> np.ndarray:
+        """Execute a scan plan → matching global row indices (unsorted)."""
+        raise NotImplementedError
+
+
+class OracleBackend(ExecutionBackend):
+    """Brute force: evaluate the full filter over every row (referee)."""
+
+    name = "oracle"
+
+    def load(self, sft, table, indices):
+        return None
+
+    def select(self, state, index, plan, extraction, residual, table):
+        return np.nonzero(residual.mask(table))[0]
+
+
+@dataclass
+class _DeviceIndexState:
+    """Per-index device columns, sorted in index order (points only)."""
+
+    x: Any  # jnp int32 (n,)
+    y: Any
+    bins: Any
+    offs: Any
+
+
+class TpuBackend(ExecutionBackend):
+    """Sharded-columnar device execution (single-device v1; mesh in parallel/)."""
+
+    name = "tpu"
+
+    def load(self, sft, table, indices):
+        import jax.numpy as jnp
+
+        state: dict[str, _DeviceIndexState | None] = {}
+        nlon = norm_lon(REFINE_PRECISION)
+        nlat = norm_lat(REFINE_PRECISION)
+        binned = BinnedTime(sft.z3_interval) if sft.dtg_field else None
+        for name, index in indices.items():
+            col = table.geom_column() if sft.geom_field else None
+            if (
+                col is None
+                or col.x is None
+                or len(table) == 0
+                or name in ("id",)
+            ):
+                state[name] = None  # host path
+                continue
+            perm = index.perm
+            xi = nlon.normalize(col.x[perm]).astype(np.int32)
+            yi = nlat.normalize(col.y[perm]).astype(np.int32)
+            if binned is not None:
+                bins, offs = binned.to_bin_and_offset(table.dtg_millis()[perm])
+                bins = bins.astype(np.int32)
+                offs = offs.astype(np.int32)
+            else:
+                bins = np.zeros(len(table), dtype=np.int32)
+                offs = np.zeros(len(table), dtype=np.int32)
+            state[name] = _DeviceIndexState(
+                x=jnp.asarray(xi),
+                y=jnp.asarray(yi),
+                bins=jnp.asarray(bins),
+                offs=jnp.asarray(offs),
+            )
+        return state
+
+    # -- refine payload (int-domain superset bounds) -------------------------
+    def _payload(self, sft: FeatureType, e: Extraction):
+        from geomesa_tpu.ops.refine import pack_boxes, pack_times
+
+        nlon = norm_lon(REFINE_PRECISION)
+        nlat = norm_lat(REFINE_PRECISION)
+        boxes = None
+        if e.boxes is not None:
+            boxes = np.array(
+                [
+                    [
+                        int(nlon.normalize(x1)),
+                        int(nlon.normalize(x2)),
+                        int(nlat.normalize(y1)),
+                        int(nlat.normalize(y2)),
+                    ]
+                    for x1, y1, x2, y2 in e.boxes
+                ],
+                dtype=np.int32,
+            )
+        times = None
+        if e.intervals is not None and sft.dtg_field:
+            binned = BinnedTime(sft.z3_interval)
+            max_off = int(binned.max_offset)
+            from geomesa_tpu.curve.binned_time import MAX_BIN
+
+            quads = []
+            for lo, hi in e.intervals:
+                lo = max(int(lo), 0)
+                # last indexable millisecond: one before the start of bin MAX_BIN+1
+                hi_cap = int(binned.bin_start_millis(np.array([MAX_BIN + 1]))[0]) - 1
+                hi = min(int(hi), hi_cap)
+                if hi < lo:
+                    continue
+                (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
+                (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
+                quads.append([int(blo), int(olo), int(bhi), int(ohi)])
+            times = np.array(quads, dtype=np.int32) if quads else np.empty((0, 4), np.int32)
+        return pack_boxes(boxes), pack_times(times)
+
+    def select(self, state, index, plan, extraction, residual, table):
+        intervals = plan.intervals
+        if len(intervals) == 0:
+            return np.empty(0, dtype=np.int64)
+        dev = state.get(index.name) if state else None
+        if dev is None:
+            # host path (extended geometries, id index): expand + residual
+            positions, total = gather_indices(intervals)
+            rows = index.perm[positions[:total]]
+            sub = table.take(rows)
+            return rows[residual.mask(sub)]
+
+        import jax.numpy as jnp
+
+        from geomesa_tpu.ops.refine import refine_points
+
+        positions, total = gather_indices(intervals)
+        bucket = pad_bucket(max(total, 1))
+        idx = np.zeros(bucket, dtype=np.int32)
+        idx[:total] = positions[:total]
+        boxes, times = self._payload(index.sft, extraction)
+        mask = refine_points(
+            dev.x,
+            dev.y,
+            dev.bins,
+            dev.offs,
+            jnp.asarray(idx),
+            jnp.int32(total),
+            jnp.asarray(boxes),
+            jnp.asarray(times),
+        )
+        mask = np.asarray(mask)[:total]
+        rows = index.perm[positions[:total][mask]]
+        if isinstance(residual, ast.Include):
+            return rows
+        sub = table.take(rows)
+        return rows[residual.mask(sub)]

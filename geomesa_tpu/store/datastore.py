@@ -1,0 +1,196 @@
+"""DataStore: the top seam — schema CRUD, writes, queries (GeoTools role).
+
+Reference: ``GeoMesaDataStore`` (``geomesa-index-api/.../geotools/
+GeoMesaDataStore.scala:49``) + ``QueryPlanner.runQuery`` (SURVEY.md §3.3).
+Host-side orchestration: schemas and the canonical columnar tables live here;
+each write rebuilds index permutations and backend device state (bulk-load
+semantics v1 — the streaming LSM delta tier is the lambda-pattern follow-up,
+SURVEY.md §2.11).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.index.api import FeatureIndex
+from geomesa_tpu.planning.planner import Query, QueryPlanner, build_indices
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+from geomesa_tpu.store.backends import ExecutionBackend, OracleBackend, TpuBackend
+
+_BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
+
+
+@dataclass
+class QueryResult:
+    """Materialized query result + plan trace."""
+
+    table: FeatureTable
+    row_ids: np.ndarray
+    plan_info: Any = None
+
+    @property
+    def count(self) -> int:
+        return len(self.table)
+
+    def records(self) -> list[dict]:
+        return [self.table.record(i) for i in range(len(self.table))]
+
+
+@dataclass
+class _TypeState:
+    sft: FeatureType
+    table: FeatureTable | None = None
+    indices: dict[str, FeatureIndex] = field(default_factory=dict)
+    backend_state: Any = None
+
+
+class DataStore:
+    """An in-process spatio-temporal datastore over a pluggable backend."""
+
+    def __init__(self, backend: str | ExecutionBackend = "tpu"):
+        if isinstance(backend, str):
+            backend = _BACKENDS[backend]()
+        self.backend = backend
+        self._types: dict[str, _TypeState] = {}
+
+    # -- schema CRUD (MetadataBackedDataStore role) --------------------------
+    def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
+        if isinstance(sft, str):
+            if spec is None:
+                raise ValueError("create_schema('name', 'spec string') requires a spec")
+            sft = parse_spec(sft, spec)
+        if sft.name in self._types:
+            raise ValueError(f"schema already exists: {sft.name}")
+        self._types[sft.name] = _TypeState(sft=sft, indices=build_indices(sft))
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._state(name).sft
+
+    def list_schemas(self) -> list[str]:
+        return sorted(self._types)
+
+    def delete_schema(self, name: str) -> None:
+        del self._types[name]
+
+    def _state(self, name: str) -> _TypeState:
+        if name not in self._types:
+            raise KeyError(f"no such schema: {name!r}")
+        return self._types[name]
+
+    # -- writes (GeoMesaFeatureWriter role; bulk semantics) ------------------
+    def write(self, type_name: str, data, fids=None) -> int:
+        """Append features (FeatureTable or list of record dicts); rebuilds
+        index order and backend state for the new snapshot.
+
+        Validation before commit (the reference's all-indices-validate-before-
+        write pattern, ``IndexAdapter.scala:139-149``): rows with a null
+        default geometry or null dtg are rejected — and state is only swapped
+        in after every index builds successfully, so a failed write never
+        leaves the store half-applied.
+        """
+        st = self._state(type_name)
+        if isinstance(data, list):
+            if fids is None:
+                base = 0 if st.table is None else len(st.table)
+                fids = [f"{type_name}.{base + i}" for i in range(len(data))]
+            data = FeatureTable.from_records(st.sft, data, fids)
+        self._validate(st.sft, data)
+        table = (
+            data if st.table is None else FeatureTable.concat([st.table, data])
+        )
+        # build into fresh index instances; commit only on success (atomic)
+        indices = build_indices(st.sft)
+        for index in indices.values():
+            index.build(table)
+        backend_state = self.backend.load(st.sft, table, indices)
+        st.table = table
+        st.indices = indices
+        st.backend_state = backend_state
+        return len(data)
+
+    @staticmethod
+    def _validate(sft: FeatureType, table: FeatureTable) -> None:
+        if sft.geom_field is not None:
+            col = table.columns[sft.geom_field]
+            if not col.is_valid().all():
+                bad = int((~col.is_valid()).sum())
+                raise ValueError(
+                    f"{bad} feature(s) with null geometry {sft.geom_field!r}: "
+                    "indexed geometries must be non-null"
+                )
+        if sft.dtg_field is not None:
+            col = table.columns[sft.dtg_field]
+            if not col.is_valid().all():
+                bad = int((~col.is_valid()).sum())
+                raise ValueError(
+                    f"{bad} feature(s) with null date {sft.dtg_field!r}: "
+                    "indexed dates must be non-null"
+                )
+
+    # -- queries (QueryPlanner.runQuery role) --------------------------------
+    def query(
+        self, type_name: str, q: Query | str | None = None, **kwargs
+    ) -> QueryResult:
+        st = self._state(type_name)
+        if isinstance(q, str) or q is None:
+            q = Query(filter=q, **kwargs)
+        elif kwargs:
+            raise ValueError(
+                "pass query options inside the Query object, not as kwargs: "
+                f"{sorted(kwargs)}"
+            )
+        if st.table is None or len(st.table) == 0:
+            empty = FeatureTable.from_records(st.sft, [])
+            return QueryResult(empty, np.empty(0, dtype=np.int64))
+
+        f = q.resolved_filter()
+        if isinstance(self.backend, OracleBackend):
+            # referee path: no planning, brute force
+            rows = self.backend.select(None, None, None, None, f, st.table)
+            info = None
+        else:
+            planner = QueryPlanner(st.sft, st.indices)
+            plan, f, info = planner.plan(q)
+            index = st.indices[info.index_name]
+            rows = self.backend.select(
+                st.backend_state, index, plan, info.extraction, f, st.table
+            )
+
+        rows = np.sort(rows)  # deterministic order before transforms
+        table = st.table.take(rows)
+
+        # client-side reduce: sort / limit / projection (QueryPlanner.scala:75-98)
+        if q.sort_by is not None:
+            fld, desc = q.sort_by
+            keys = table.fids if fld == "id" else table.columns[fld].values
+            order = np.argsort(keys, kind="stable")
+            if desc:
+                order = order[::-1]
+            table = table.take(order)
+            rows = rows[order]
+        if q.limit is not None:
+            table = table.take(np.arange(min(q.limit, len(table))))
+            rows = rows[: q.limit]
+        if q.properties is not None:
+            keep = {p: table.columns[p] for p in q.properties}
+            table = FeatureTable(table.sft, table.fids, {**keep})
+
+        return QueryResult(table, rows, info)
+
+    def explain(self, type_name: str, q: Query | str) -> str:
+        st = self._state(type_name)
+        if isinstance(q, str):
+            q = Query(filter=q)
+        planner = QueryPlanner(st.sft, st.indices)
+        _, _, info = planner.plan(q)
+        return info.explain()
+
+    def stats_count(self, type_name: str) -> int:
+        st = self._state(type_name)
+        return 0 if st.table is None else len(st.table)

@@ -8,12 +8,19 @@ backend), so multi-chip behavior is exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests always run the CPU mesh
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The axon site hook (sitecustomize) force-registers the TPU relay backend and
+# sets jax_platforms="axon,cpu" at interpreter start, overriding the env var —
+# override it back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,233 @@
+"""Schema DSL, columnar table, geometry, CQL parse and bounds-extraction tests
+(modeled on the reference's filter/feature suites — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import ast, extract, parse
+from geomesa_tpu.filter.cql import CQLError, datetime_to_millis
+from geomesa_tpu.geometry import LineString, Point, Polygon, box, from_wkt, to_wkt
+from geomesa_tpu.geometry import predicates as P
+from geomesa_tpu.schema.columnar import FeatureTable, point_column
+from geomesa_tpu.schema.sft import AttributeType, parse_spec
+
+SPEC = "name:String:index=true,age:Integer,dtg:Date,*geom:Point:srid=4326"
+
+
+def make_table(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("test", SPEC)
+    recs = [
+        {
+            "name": f"name{i % 10}",
+            "age": int(i % 50),
+            "dtg": int(1_500_000_000_000 + i * 3_600_000),
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"fid{i}" for i in range(n)])
+
+
+class TestSFT:
+    def test_parse_spec(self):
+        sft = parse_spec("gdelt", SPEC + ";geomesa.z3.interval='day',geomesa.z.splits='8'")
+        assert [a.name for a in sft.attributes] == ["name", "age", "dtg", "geom"]
+        assert sft.default_geom == "geom"
+        assert sft.dtg_field == "dtg"
+        assert sft.attr("name").indexed
+        assert sft.z3_interval.value == "day"
+        assert sft.shards == 8
+        assert sft.geom_is_points
+
+    def test_spec_roundtrip(self):
+        sft = parse_spec("t", SPEC)
+        sft2 = parse_spec("t", sft.to_spec())
+        assert [a.name for a in sft2.attributes] == [a.name for a in sft.attributes]
+        assert sft2.default_geom == sft.default_geom
+
+    def test_bad_specs(self):
+        with pytest.raises(ValueError):
+            parse_spec("t", "name:Bogus")
+        with pytest.raises(ValueError):
+            parse_spec("t", "*name:String,geom:Point")
+        with pytest.raises(ValueError):
+            parse_spec("t", "a:String,a:Integer")
+
+
+class TestColumnar:
+    def test_from_records_roundtrip(self):
+        t = make_table(10)
+        assert len(t) == 10
+        rec = t.record(3)
+        assert rec["name"] == "name3"
+        assert isinstance(rec["geom"], Point)
+
+    def test_nulls(self):
+        sft = parse_spec("t", "a:Integer,*geom:Point")
+        t = FeatureTable.from_records(
+            sft, [{"a": 1, "geom": Point(0, 0)}, {"a": None, "geom": None}]
+        )
+        assert t.record(1)["a"] is None
+        assert t.record(1)["geom"] is None
+        assert t.columns["a"].is_valid().tolist() == [True, False]
+
+    def test_take_concat(self):
+        t = make_table(20)
+        a = t.take(np.arange(5))
+        b = t.take(np.arange(5, 20))
+        c = FeatureTable.concat([a, b])
+        assert len(c) == 20
+        assert c.fids[7] == t.fids[7]
+
+    def test_point_column_fast_path(self):
+        sft = parse_spec("t", "*geom:Point")
+        col = point_column(np.array([1.0, 2.0]), np.array([3.0, 4.0]))
+        t = FeatureTable.from_columns(sft, ["a", "b"], {"geom": col})
+        assert t.record(1)["geom"] == Point(2.0, 4.0)
+
+
+class TestGeometry:
+    def test_wkt_roundtrip(self):
+        for wkt in [
+            "POINT (30 10)",
+            "LINESTRING (30 10, 10 30, 40 40)",
+            "POLYGON ((30 10, 40 40, 20 40, 10 20, 30 10))",
+            "POLYGON ((35 10, 45 45, 15 40, 10 20, 35 10), (20 30, 35 35, 30 20, 20 30))",
+            "MULTIPOINT ((10 40), (40 30))",
+            "MULTILINESTRING ((10 10, 20 20), (40 40, 30 30))",
+            "MULTIPOLYGON (((30 20, 45 40, 10 40, 30 20)), ((15 5, 40 10, 10 20, 15 5)))",
+        ]:
+            g = from_wkt(wkt)
+            g2 = from_wkt(to_wkt(g))
+            assert to_wkt(g) == to_wkt(g2)
+
+    def test_point_in_polygon(self):
+        poly = from_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        xs = np.array([5.0, 15.0, 0.0, 10.0, -1.0])
+        ys = np.array([5.0, 5.0, 5.0, 10.0, -1.0])
+        cls = P.classify_points_polygon(xs, ys, poly)
+        assert cls.tolist() == [P.INTERIOR, P.EXTERIOR, P.BOUNDARY, P.BOUNDARY, P.EXTERIOR]
+
+    def test_polygon_with_hole(self):
+        poly = from_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))")
+        assert P.points_within_geom(np.array([5.0]), np.array([5.0]), poly)[0] == False  # noqa: E712
+        assert P.points_within_geom(np.array([2.0]), np.array([2.0]), poly)[0] == True  # noqa: E712
+
+    def test_intersects_line_polygon(self):
+        poly = from_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        crossing = from_wkt("LINESTRING (-5 5, 15 5)")
+        outside = from_wkt("LINESTRING (20 20, 30 30)")
+        inside = from_wkt("LINESTRING (2 2, 8 8)")
+        assert P.intersects(crossing, poly)
+        assert not P.intersects(outside, poly)
+        assert P.intersects(inside, poly)  # fully inside still intersects
+
+    def test_distance(self):
+        a = Point(0, 0)
+        b = Point(3, 4)
+        assert P.distance(a, b) == pytest.approx(5.0)
+        line = from_wkt("LINESTRING (0 10, 10 10)")
+        assert P.distance(Point(5, 0), line) == pytest.approx(10.0)
+
+    def test_dwithin(self):
+        assert P.dwithin(Point(0, 0), Point(0, 3), 3.0)
+        assert not P.dwithin(Point(0, 0), Point(0, 3.1), 3.0)
+
+
+class TestCQL:
+    def test_bbox_and_during(self):
+        f = parse(
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2017-07-14T00:00:00.000Z/2017-07-15T00:00:00.000Z"
+        )
+        assert isinstance(f, ast.And)
+        t = make_table(200)
+        m = f.mask(t)
+        col = t.geom_column()
+        expected = (
+            (col.x >= -10) & (col.x <= 10) & (col.y >= -10) & (col.y <= 10)
+            & (t.dtg_millis() > datetime_to_millis("2017-07-14T00:00:00"))
+            & (t.dtg_millis() < datetime_to_millis("2017-07-15T00:00:00"))
+        )
+        np.testing.assert_array_equal(m, expected)
+
+    def test_intersects(self):
+        f = parse("INTERSECTS(geom, POLYGON ((0 0, 20 0, 20 20, 0 20, 0 0)))")
+        t = make_table(100)
+        m = f.mask(t)
+        col = t.geom_column()
+        exp = (col.x >= 0) & (col.x <= 20) & (col.y >= 0) & (col.y <= 20)
+        np.testing.assert_array_equal(m, exp)
+
+    def test_attribute_ops(self):
+        t = make_table(100)
+        assert parse("name = 'name3'").mask(t).sum() == 10
+        assert parse("age < 10").mask(t).sum() == 20
+        assert parse("age BETWEEN 0 AND 9").mask(t).sum() == 20
+        assert parse("name IN ('name1', 'name2')").mask(t).sum() == 20
+        assert parse("name LIKE 'name%'").mask(t).sum() == 100
+        assert parse("NOT name = 'name3'").mask(t).sum() == 90
+        assert parse("INCLUDE").mask(t).all()
+        assert not parse("EXCLUDE").mask(t).any()
+
+    def test_fid_filter(self):
+        t = make_table(10)
+        m = parse("IN ('fid1', 'fid5')").mask(t)
+        assert m.sum() == 2 and m[1] and m[5]
+
+    def test_parse_errors(self):
+        for bad in ["BBOX(geom, 1, 2)", "name ~ 'x'", "dtg DURING x/y", "(a = 1"]:
+            with pytest.raises(CQLError):
+                parse(bad)
+
+    def test_dwithin_units(self):
+        f = parse("DWITHIN(geom, POINT (0 0), 111320, meters)")
+        assert isinstance(f, ast.SpatialOp)
+        assert f.distance == pytest.approx(1.0)
+
+
+class TestExtraction:
+    def test_bbox_and_during(self):
+        f = parse(
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2017-07-14T00:00:00.000Z/2017-07-15T00:00:00.000Z"
+        )
+        e = extract(f, "geom", "dtg")
+        assert e.boxes == [(-10.0, -10.0, 10.0, 10.0)]
+        lo = datetime_to_millis("2017-07-14T00:00:00") + 1
+        hi = datetime_to_millis("2017-07-15T00:00:00") - 1
+        assert e.intervals == [(lo, hi)]
+
+    def test_or_union(self):
+        f = parse("BBOX(geom, 0, 0, 5, 5) OR BBOX(geom, 20, 20, 25, 25)")
+        e = extract(f, "geom", "dtg")
+        assert len(e.boxes) == 2
+
+    def test_mixed_or_unconstrained(self):
+        f = parse("BBOX(geom, 0, 0, 5, 5) OR name = 'x'")
+        e = extract(f, "geom", "dtg")
+        assert e.boxes is None
+
+    def test_and_intersection(self):
+        f = parse("BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 5, 5, 20, 20)")
+        e = extract(f, "geom", "dtg")
+        assert e.boxes == [(5.0, 5.0, 10.0, 10.0)]
+
+    def test_not_unconstrained(self):
+        e = extract(parse("NOT BBOX(geom, 0, 0, 5, 5)"), "geom", "dtg")
+        assert e.boxes is None and e.intervals is None
+
+    def test_disjoint_proof(self):
+        f = parse("BBOX(geom, 0, 0, 5, 5) AND BBOX(geom, 10, 10, 20, 20)")
+        e = extract(f, "geom", "dtg")
+        assert e.disjoint
+
+    def test_temporal_ops(self):
+        e = extract(parse("dtg BEFORE 2017-01-01T00:00:00Z"), "geom", "dtg")
+        assert e.intervals[0][1] == datetime_to_millis("2017-01-01T00:00:00") - 1
+        e = extract(parse("dtg AFTER 2017-01-01T00:00:00Z"), "geom", "dtg")
+        assert e.intervals[0][0] == datetime_to_millis("2017-01-01T00:00:00") + 1
+
+    def test_dwithin_expansion(self):
+        f = parse("DWITHIN(geom, POINT (0 0), 1, degrees)")
+        e = extract(f, "geom", "dtg")
+        assert e.boxes == [(-1.0, -1.0, 1.0, 1.0)]
