@@ -32,17 +32,29 @@ MIN_MS = -(2**62)
 MAX_MS = 2**62
 
 
+# Attribute value bounds are lists of (lo, hi, lo_inc, hi_inc); None endpoint
+# = unbounded on that side. The same sound over-approximation algebra as
+# boxes/intervals (the ``FilterHelper.extractAttributeBounds`` role used by
+# ``AttributeIndexKeySpace``).
+
+
 @dataclass(frozen=True)
 class Extraction:
-    """Bounds for one (geom_field, dtg_field) pair.
+    """Bounds for one (geom_field, dtg_field) pair plus indexed attributes.
 
     ``boxes``: None = spatially unconstrained; else list of (xmin, ymin, xmax,
     ymax) whose union covers all matching rows. ``intervals``: None =
     temporally unconstrained; else list of inclusive (lo_ms, hi_ms).
+    ``attributes``: per-attribute value intervals (None = unconstrained).
     """
 
     boxes: list | None
     intervals: list | None
+    attributes: dict = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.attributes is None:
+            object.__setattr__(self, "attributes", {})
 
     @property
     def spatially_bounded(self) -> bool:
@@ -52,21 +64,176 @@ class Extraction:
     def temporally_bounded(self) -> bool:
         return self.intervals is not None
 
+    def attr_bounded(self, name: str) -> bool:
+        return self.attributes.get(name) is not None
+
     @property
     def disjoint(self) -> bool:
         """True when bounds prove the filter matches nothing."""
-        return (self.boxes is not None and len(self.boxes) == 0) or (
-            self.intervals is not None and len(self.intervals) == 0
+        return (
+            (self.boxes is not None and len(self.boxes) == 0)
+            or (self.intervals is not None and len(self.intervals) == 0)
+            or any(v is not None and len(v) == 0 for v in self.attributes.values())
         )
 
 
-def extract(f: ast.Filter, geom_field: str | None, dtg_field: str | None) -> Extraction:
+def extract(
+    f: ast.Filter,
+    geom_field: str | None,
+    dtg_field: str | None,
+    attrs: tuple = (),
+) -> Extraction:
     boxes, intervals = _walk(f, geom_field, dtg_field)
     if boxes is not None:
         boxes = _dedupe_boxes(boxes)
     if intervals is not None:
         intervals = _merge_intervals(intervals)
-    return Extraction(boxes, intervals)
+    attributes = {a: _walk_attr(f, a) for a in attrs}
+    return Extraction(boxes, intervals, attributes)
+
+
+def _walk_attr(f: ast.Filter, attr: str):
+    """Value intervals for one attribute: None = unconstrained, [] = disjoint."""
+    if isinstance(f, ast.And):
+        out = None
+        for c in f.children:
+            out = _intersect_attr(out, _walk_attr(c, attr))
+        return out
+    if isinstance(f, ast.Or):
+        out = []
+        for c in f.children:
+            ci = _walk_attr(c, attr)
+            if ci is None:
+                return None
+            out.extend(ci)
+        return out
+    if isinstance(f, ast.Compare) and f.prop == attr:
+        v = f.literal
+        if f.op == "=":
+            return [(v, v, True, True)]
+        if f.op == "<":
+            return [(None, v, True, False)]
+        if f.op == "<=":
+            return [(None, v, True, True)]
+        if f.op == ">":
+            return [(v, None, False, True)]
+        if f.op == ">=":
+            return [(v, None, True, True)]
+        return None  # <> : unconstrained
+    if isinstance(f, ast.Between) and f.prop == attr:
+        return [(f.lo, f.hi, True, True)]
+    if isinstance(f, ast.In) and f.prop == attr:
+        return [(v, v, True, True) for v in f.literals]
+    if isinstance(f, ast.Like) and f.prop == attr:
+        # prefix pattern -> range [prefix, next_prefix): the upper bound is the
+        # prefix with its last char incremented, so EVERY string starting with
+        # the prefix (including supplementary-plane chars) stays inside the
+        # cover — bounds must over-approximate
+        p = f.pattern
+        i = min(
+            (p.index(c) for c in "%_" if c in p), default=len(p)
+        )
+        prefix = p[:i]
+        if not prefix:
+            return None
+        return [(prefix, _prefix_upper(prefix), True, False)]
+    if isinstance(f, ast.Exclude):
+        return []
+    return None
+
+
+def _prefix_upper(prefix: str) -> str | None:
+    """Smallest string greater than every string with this prefix (None if the
+    prefix is all U+10FFFF — then the range is unbounded above)."""
+    chars = list(prefix)
+    while chars:
+        if ord(chars[-1]) < 0x10FFFF:
+            chars[-1] = chr(ord(chars[-1]) + 1)
+            return "".join(chars)
+        chars.pop()
+    return None
+
+
+def coerce_attr_bounds(sft, extraction: "Extraction") -> "Extraction":
+    """Normalize extracted attribute bounds to column value types: quoted CQL
+    date literals arrive as strings but DATE columns store int64 millis."""
+    from geomesa_tpu.schema.sft import AttributeType
+
+    out = {}
+    changed = False
+    for name, bounds in extraction.attributes.items():
+        if bounds is None or name not in sft:
+            out[name] = bounds
+            continue
+        if sft.attr(name).type == AttributeType.DATE:
+            from geomesa_tpu.schema.columnar import _to_millis
+
+            def conv(v):
+                return _to_millis(v) if isinstance(v, str) else v
+
+            bounds = [
+                (conv(lo) if lo is not None else None, conv(hi) if hi is not None else None, li, ri)
+                for lo, hi, li, ri in bounds
+            ]
+            changed = True
+        out[name] = bounds
+    if not changed:
+        return extraction
+    return Extraction(extraction.boxes, extraction.intervals, out)
+
+
+def _intersect_attr(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out = []
+    for alo, ahi, ali, ari in a:
+        for blo, bhi, bli, bri in b:
+            lo, li = _max_lo((alo, ali), (blo, bli))
+            hi, ri = _min_hi((ahi, ari), (bhi, bri))
+            if _nonempty(lo, hi, li, ri):
+                out.append((lo, hi, li, ri))
+    return out
+
+
+def _max_lo(a, b):
+    (alo, ai), (blo, bi) = a, b
+    if alo is None:
+        return blo, bi
+    if blo is None:
+        return alo, ai
+    if alo == blo:
+        return alo, ai and bi
+    return (alo, ai) if _gt(alo, blo) else (blo, bi)
+
+
+def _min_hi(a, b):
+    (ahi, ai), (bhi, bi) = a, b
+    if ahi is None:
+        return bhi, bi
+    if bhi is None:
+        return ahi, ai
+    if ahi == bhi:
+        return ahi, ai and bi
+    return (ahi, ai) if _gt(bhi, ahi) else (bhi, bi)
+
+
+def _gt(a, b):
+    try:
+        return a > b
+    except TypeError:
+        return str(a) > str(b)
+
+
+def _nonempty(lo, hi, li, ri):
+    if lo is None or hi is None:
+        return True
+    if _gt(lo, hi):
+        return False
+    if lo == hi and not (li and ri):
+        return False
+    return True
 
 
 def _walk(f: ast.Filter, geom: str | None, dtg: str | None):

@@ -16,10 +16,12 @@ from typing import Any
 
 import numpy as np
 
+from geomesa_tpu.curve.binned_time import BinnedTime
 from geomesa_tpu.filter import ast
 from geomesa_tpu.filter.bounds import Extraction, extract
 from geomesa_tpu.filter.cql import parse as parse_cql
 from geomesa_tpu.index.api import DEFAULT_MAX_RANGES, FeatureIndex, IndexPlan
+from geomesa_tpu.index.attribute import AttributeIndex
 from geomesa_tpu.index.z2 import IdIndex, XZ2Index, Z2Index
 from geomesa_tpu.index.z3 import XZ3Index, Z3Index
 from geomesa_tpu.schema.sft import FeatureType
@@ -90,13 +92,16 @@ def _extract_fids(f: ast.Filter):
 
 
 class StrategyDecider:
-    """Pick the best index for an extraction (heuristic cost model).
+    """Pick the best index for an extraction.
 
-    Reference: ``StrategyDecider.scala`` — cost-based with stats when
-    available; this version scores by specificity (id > z3 > z2 > full scan),
-    mirroring the reference's heuristic fallback; stats-backed costing plugs in
-    via :mod:`geomesa_tpu.stats` (SURVEY.md §2.3).
+    Reference: ``StrategyDecider.scala:41-140`` — cost-based via stats
+    estimates when available (``CostBasedStrategyDecider``), falling back to a
+    specificity heuristic (id > attr-equality > z3 > z2 > full scan) without
+    stats. Attribute-index costs get a residual-work multiplier (the
+    reference's join-cost penalty for reduced attribute indexes).
     """
+
+    ATTR_COST_MULTIPLIER = 2.0
 
     @staticmethod
     def choose(
@@ -104,6 +109,7 @@ class StrategyDecider:
         e: Extraction,
         f: ast.Filter,
         hints: dict,
+        stats=None,
     ) -> tuple[str, Any]:
         forced = hints.get("index")
         if forced:
@@ -113,33 +119,109 @@ class StrategyDecider:
         fids = _extract_fids(f)
         if fids is not None and "id" in indices:
             return "id", fids
-        spatial = e.spatially_bounded
+        if stats is not None and stats.count > 0:
+            name = StrategyDecider._cost_based(indices, e, stats)
+            if name is not None:
+                return name, None
+        return StrategyDecider._heuristic(indices, e), None
+
+    @staticmethod
+    def _cost_based(indices, e: Extraction, stats) -> str | None:
+        costs: dict[str, float] = {}
+        for name, index in indices.items():
+            if name == "id":
+                continue  # only via fid fast path
+            if name in ("z3", "xz3"):
+                # z3 competes only when the filter has temporal bounds — a
+                # spatial-only query would pay a per-time-bin range
+                # decomposition for the same selectivity z2 gets in one pass
+                # (the reference offers z3 strategies only for dtg-bounded
+                # filters, Z3IndexKeySpace.getIndexValues)
+                if e.spatially_bounded and not e.temporally_bounded and (
+                    "z2" in indices or "xz2" in indices
+                ):
+                    continue
+                if not (e.spatially_bounded or e.temporally_bounded):
+                    costs[name] = float(stats.count)
+                else:
+                    # estimation always uses the point z3 curve against the
+                    # Z3Histogram (built only for point schemas; otherwise
+                    # falls back to total count inside the estimator)
+                    costs[name] = stats.estimate_spatiotemporal(
+                        e, _z3_est_sfc(index), index.binned
+                    )
+            elif name in ("z2", "xz2"):
+                if not e.spatially_bounded:
+                    costs[name] = float(stats.count)
+                else:
+                    # spatial-only estimate: all bins, coarse cover
+                    e_sp = Extraction(e.boxes, None, {})
+                    costs[name] = stats.estimate_spatiotemporal(
+                        e_sp, _z3_est_sfc(index), BinnedTime(index.sft.z3_interval)
+                    )
+            elif name.startswith("attr:"):
+                attr = name.split(":", 1)[1]
+                bounds = e.attributes.get(attr)
+                if bounds is None:
+                    continue  # can't serve
+                est = stats.estimate_attr(attr, bounds)
+                costs[name] = est * StrategyDecider.ATTR_COST_MULTIPLIER
+        if not costs:
+            return None
+        return min(costs.items(), key=lambda kv: kv[1])[0]
+
+    @staticmethod
+    def _heuristic(indices, e: Extraction) -> str:
+        for name in indices:
+            if name.startswith("attr:") and e.attr_bounded(name.split(":", 1)[1]):
+                bounds = e.attributes[name.split(":", 1)[1]]
+                if all(lo is not None and lo == hi for lo, hi, _, _ in bounds):
+                    return name  # equality on an indexed attribute
         temporal = e.temporally_bounded
+        spatial = e.spatially_bounded
         if temporal and ("z3" in indices or "xz3" in indices):
-            return ("z3" if "z3" in indices else "xz3"), None
+            return "z3" if "z3" in indices else "xz3"
         if spatial and ("z2" in indices or "xz2" in indices):
-            return ("z2" if "z2" in indices else "xz2"), None
-        if "z3" in indices or "xz3" in indices:
-            return ("z3" if "z3" in indices else "xz3"), None
-        if "z2" in indices or "xz2" in indices:
-            return ("z2" if "z2" in indices else "xz2"), None
-        return "id", None
+            return "z2" if "z2" in indices else "xz2"
+        for name in ("z3", "xz3", "z2", "xz2", "id"):
+            if name in indices:
+                return name
+        return next(iter(indices))  # whatever is configured (full scan)
+
+
+def _z3_est_sfc(index):
+    """The point z3 curve used for selectivity estimation (shared by the z3
+    and z2 costing branches)."""
+    from geomesa_tpu.curve.sfc import z3_sfc
+
+    return z3_sfc(index.sft.z3_interval)
 
 
 class QueryPlanner:
     """Plans one query over one feature type's built indexes."""
 
-    def __init__(self, sft: FeatureType, indices: dict[str, FeatureIndex]):
+    def __init__(
+        self, sft: FeatureType, indices: dict[str, FeatureIndex], stats=None
+    ):
         self.sft = sft
         self.indices = indices
+        self.stats = stats
+        self.indexed_attrs = tuple(
+            name.split(":", 1)[1] for name in indices if name.startswith("attr:")
+        )
 
     def plan(
         self, q: Query, max_ranges: int = DEFAULT_MAX_RANGES
     ) -> tuple[IndexPlan, ast.Filter, QueryPlanInfo]:
         t0 = time.perf_counter()
         f = q.resolved_filter()
-        e = extract(f, self.sft.geom_field, self.sft.dtg_field)
-        name, fids = StrategyDecider.choose(self.indices, e, f, q.hints)
+        from geomesa_tpu.filter.bounds import coerce_attr_bounds
+
+        e = extract(
+            f, self.sft.geom_field, self.sft.dtg_field, attrs=self.indexed_attrs
+        )
+        e = coerce_attr_bounds(self.sft, e)
+        name, fids = StrategyDecider.choose(self.indices, e, f, q.hints, self.stats)
         index = self.indices[name]
         notes = []
         if fids is not None and isinstance(index, IdIndex):
@@ -174,6 +256,10 @@ def build_indices(sft: FeatureType) -> dict[str, FeatureIndex]:
             continue
         if cls.supports(sft):
             out[cls.name] = cls(sft)
+    for attr in AttributeIndex.indexed_attributes(sft):
+        if configured is None or "attr" in configured or f"attr:{attr}" in configured:
+            idx = AttributeIndex(sft, attr)
+            out[idx.name] = idx
     if not out:
         out["id"] = IdIndex(sft)
     return out

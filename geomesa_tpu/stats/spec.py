@@ -1,0 +1,81 @@
+"""Stat-spec DSL: parse "MinMax(age);Count();TopK(name)" into sketches.
+
+The ``Stat.apply`` parser role (``geomesa-utils/.../utils/stats/Stat.scala``,
+SURVEY.md §2.18): semicolon-separated constructors, attribute names optionally
+quoted. Used by stats query hints and the CLI ``stats-analyze`` commands.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.stats.sketches import (
+    Cardinality,
+    CountStat,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+)
+
+_CALL = re.compile(r"^\s*(\w+)\s*\(\s*([^)]*)\s*\)\s*$")
+
+
+def _args(argstr: str) -> list[str]:
+    return [a.strip().strip("'\"") for a in argstr.split(",") if a.strip()]
+
+
+def parse_stats(spec: str) -> list[tuple[str, str | None, object]]:
+    """Spec → list of (label, attribute|None, sketch instance)."""
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        m = _CALL.match(part)
+        if not m:
+            raise ValueError(f"invalid stat spec: {part!r}")
+        name = m.group(1).lower()
+        args = _args(m.group(2))
+        attr = args[0] if args else None
+        if name == "count":
+            out.append((part, None, CountStat()))
+        elif name == "minmax":
+            out.append((part, attr, MinMax()))
+        elif name == "topk":
+            out.append((part, attr, TopK(int(args[1]) if len(args) > 1 else 10)))
+        elif name == "enumeration":
+            out.append((part, attr, EnumerationStat()))
+        elif name == "frequency":
+            out.append((part, attr, Frequency()))
+        elif name == "cardinality":
+            out.append((part, attr, Cardinality()))
+        elif name == "histogram":
+            bins = int(args[1]) if len(args) > 1 else 20
+            lo = float(args[2]) if len(args) > 2 else 0.0
+            hi = float(args[3]) if len(args) > 3 else 1.0
+            out.append((part, attr, Histogram(lo, hi, bins)))
+        elif name in ("descriptivestats", "stats"):
+            out.append((part, attr, DescriptiveStats()))
+        else:
+            raise ValueError(f"unknown stat: {name!r}")
+    return out
+
+
+def compute_stats(table: FeatureTable, spec: str) -> dict[str, object]:
+    """Evaluate a stat spec over a result table → {label: sketch}."""
+    out = {}
+    for label, attr, sketch in parse_stats(spec):
+        if attr is None:
+            sketch.observe(np.arange(len(table)))
+        else:
+            col = table.columns[attr]
+            vals = col.values[col.is_valid()]
+            sketch.observe(vals)
+        out[label] = sketch
+    return out

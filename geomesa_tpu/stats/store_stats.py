@@ -1,0 +1,136 @@
+"""Per-feature-type statistics: maintenance + selectivity estimation.
+
+The ``GeoMesaStats`` / ``StatsBasedEstimator`` / ``MetadataBackedStats`` roles
+(``geomesa-index-api/.../stats/GeoMesaStats.scala:33``,
+``StatsBasedEstimator.scala`` — SURVEY.md §2.3): sketches maintained at write
+time feed cost-based index selection; the same sketches answer stats queries
+(count/bounds/min-max/histogram) without scanning.
+
+Recomputed per snapshot on write (our writes are bulk rebuilds), reusing the
+Z3 index's build products for the spatio-temporal histogram.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from geomesa_tpu.filter.bounds import Extraction
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+from geomesa_tpu.stats.sketches import (
+    Cardinality,
+    CountStat,
+    DescriptiveStats,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+    Z3Histogram,
+)
+
+HIST_BINS = 1000
+
+
+@dataclass
+class AttributeStats:
+    minmax: MinMax = field(default_factory=MinMax)
+    histogram: Histogram | None = None  # numeric/date only
+    frequency: Frequency = field(default_factory=Frequency)
+    topk: TopK = field(default_factory=lambda: TopK(10))
+    cardinality: Cardinality = field(default_factory=Cardinality)
+    descriptive: DescriptiveStats | None = None
+
+
+class StoreStats:
+    """Sketch set for one feature type snapshot."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self.count = 0
+        self.attrs: dict[str, AttributeStats] = {}
+        self.z3hist: Z3Histogram | None = None
+
+    # -- maintenance ---------------------------------------------------------
+    def rebuild(self, table, z3_index=None) -> None:
+        self.count = len(table)
+        self.attrs = {}
+        for a in self.sft.attributes:
+            if a.type.is_geometry:
+                continue
+            col = table.columns[a.name]
+            valid = col.is_valid()
+            vals = col.values[valid]
+            st = AttributeStats()
+            if a.type.is_numeric or a.type == AttributeType.DATE:
+                v = vals.astype(np.float64)
+                st.minmax.observe(vals)
+                if len(v) and st.minmax.min is not None:
+                    lo = float(st.minmax.min)
+                    hi = float(st.minmax.max)
+                    st.histogram = Histogram(lo, max(hi, lo + 1e-9), HIST_BINS)
+                    st.histogram.observe(v)
+                st.descriptive = DescriptiveStats()
+                st.descriptive.observe(v)
+            else:
+                st.minmax.observe(vals) if len(vals) else None
+            st.frequency.observe(vals)
+            st.topk.observe(vals)
+            st.cardinality.observe(vals)
+            self.attrs[a.name] = st
+        if z3_index is not None and z3_index.n and z3_index.zs is not None:
+            self.z3hist = Z3Histogram()
+            self.z3hist.observe_binned(z3_index.bins, z3_index.zs)
+            self._z3_index = z3_index
+
+    # -- estimation (StatsBasedEstimator role) --------------------------------
+    def estimate_spatiotemporal(self, e: Extraction, sfc, binned) -> float:
+        """Estimated rows matching spatial∩temporal bounds via Z3Histogram."""
+        if self.z3hist is None:
+            return float(self.count)
+        if not e.spatially_bounded and not e.temporally_bounded:
+            return float(self.count)
+        from geomesa_tpu.index.z3 import WORLD, time_windows
+
+        boxes = e.boxes if e.boxes is not None else [WORLD]
+        bin_values = np.array(sorted(self.z3hist.counts), dtype=np.int64)
+        windows = time_windows(binned, bin_values, e.intervals)
+        est = 0.0
+        for b, w_lo, w_hi in windows:
+            # coarse cover is fine for estimation
+            zr = sfc.ranges(boxes, (float(w_lo), float(w_hi)), max_ranges=64)
+            est += self.z3hist.estimate_zranges(b, zr)
+        return est
+
+    def estimate_attr(self, name: str, bounds) -> float:
+        """Estimated rows matching attribute value intervals."""
+        if bounds is None:
+            return float(self.count)
+        st = self.attrs.get(name)
+        if st is None:
+            return float(self.count)
+        est = 0.0
+        for lo, hi, li, ri in bounds:
+            if lo is not None and lo == hi:
+                est += st.frequency.count(lo)
+            elif st.histogram is not None:
+                flo = float(st.histogram.lo if lo is None else lo)
+                fhi = float(st.histogram.hi if hi is None else hi)
+                est += st.histogram.estimate_range(flo, fhi)
+            else:
+                # string range: fall back to a fixed selectivity fraction
+                est += self.count * 0.1
+        return min(est, float(self.count))
+
+    # -- public stats API (GeoMesaStats.getCount/getBounds/getMinMax) --------
+    def min_max(self, attr: str) -> MinMax:
+        return self.attrs[attr].minmax
+
+    def top_k(self, attr: str, k: int = 10):
+        return self.attrs[attr].topk.top(k)
+
+    def histogram(self, attr: str) -> Histogram | None:
+        return self.attrs[attr].histogram
+
+    def cardinality(self, attr: str) -> float:
+        return self.attrs[attr].cardinality.estimate()

@@ -27,11 +27,19 @@ _BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
 
 @dataclass
 class QueryResult:
-    """Materialized query result + plan trace."""
+    """Materialized query result + plan trace + optional aggregates.
+
+    Aggregates mirror the reference's push-down scan flavors (SURVEY.md §2.3):
+    ``density`` (DensityScan grid), ``stats`` (StatsScan sketches), and
+    ``bin_data`` (BinAggregatingScan byte stream).
+    """
 
     table: FeatureTable
     row_ids: np.ndarray
     plan_info: Any = None
+    density: np.ndarray | None = None  # (height, width) f64 weighted counts
+    stats: dict | None = None  # label -> sketch
+    bin_data: bytes | None = None
 
     @property
     def count(self) -> int:
@@ -47,6 +55,7 @@ class _TypeState:
     table: FeatureTable | None = None
     indices: dict[str, FeatureIndex] = field(default_factory=dict)
     backend_state: Any = None
+    stats: Any = None  # StoreStats
 
 
 class DataStore:
@@ -109,9 +118,14 @@ class DataStore:
         for index in indices.values():
             index.build(table)
         backend_state = self.backend.load(st.sft, table, indices)
+        from geomesa_tpu.stats.store_stats import StoreStats
+
+        stats = StoreStats(st.sft)
+        stats.rebuild(table, indices.get("z3"))
         st.table = table
         st.indices = indices
         st.backend_state = backend_state
+        st.stats = stats
         return len(data)
 
     @staticmethod
@@ -155,7 +169,7 @@ class DataStore:
             rows = self.backend.select(None, None, None, None, f, st.table)
             info = None
         else:
-            planner = QueryPlanner(st.sft, st.indices)
+            planner = QueryPlanner(st.sft, st.indices, st.stats)
             plan, f, info = planner.plan(q)
             index = st.indices[info.index_name]
             rows = self.backend.select(
@@ -163,7 +177,29 @@ class DataStore:
             )
 
         rows = np.sort(rows)  # deterministic order before transforms
+
+        # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of
+        # matches, optionally per-group (deterministic every-nth)
+        sample = q.hints.get("sample")
+        if sample:
+            rows = _sample_rows(st.table, rows, float(sample), q.hints.get("sample_by"))
+
         table = st.table.take(rows)
+
+        # aggregation hints (density/stats/bin push-down flavors)
+        density = stats_out = bin_data = None
+        if "density" in q.hints:
+            density = _density(table, q.hints["density"] or {})
+        if "stats" in q.hints:
+            from geomesa_tpu.stats.spec import compute_stats
+
+            stats_out = compute_stats(table, q.hints["stats"])
+        if "bin" in q.hints:
+            bin_data = _bin_encode(table, q.hints["bin"] or {})
+        if density is not None or stats_out is not None or bin_data is not None:
+            return QueryResult(
+                table, rows, info, density=density, stats=stats_out, bin_data=bin_data
+            )
 
         # client-side reduce: sort / limit / projection (QueryPlanner.scala:75-98)
         if q.sort_by is not None:
@@ -187,10 +223,118 @@ class DataStore:
         st = self._state(type_name)
         if isinstance(q, str):
             q = Query(filter=q)
-        planner = QueryPlanner(st.sft, st.indices)
+        planner = QueryPlanner(st.sft, st.indices, st.stats)
         _, _, info = planner.plan(q)
         return info.explain()
 
-    def stats_count(self, type_name: str) -> int:
+    # -- stats API (GeoMesaStats role: exact or estimated) -------------------
+    def stats_count(self, type_name: str, cql: str | None = None, exact: bool = False):
+        """Row count: stored total, sketch estimate, or exact via query."""
         st = self._state(type_name)
-        return 0 if st.table is None else len(st.table)
+        if st.table is None:
+            return 0
+        if cql is None:
+            return len(st.table)
+        if exact:
+            return self.query(type_name, cql).count
+        from geomesa_tpu.curve.binned_time import BinnedTime
+        from geomesa_tpu.curve.sfc import z3_sfc
+        from geomesa_tpu.filter.bounds import extract as _extract
+        from geomesa_tpu.filter.cql import parse as _parse
+
+        e = _extract(
+            _parse(cql), st.sft.geom_field, st.sft.dtg_field,
+            attrs=tuple(st.stats.attrs) if st.stats else (),
+        )
+        est = st.stats.estimate_spatiotemporal(
+            e, z3_sfc(st.sft.z3_interval), BinnedTime(st.sft.z3_interval)
+        )
+        for name, bounds in e.attributes.items():
+            if bounds is not None:
+                est = min(est, st.stats.estimate_attr(name, bounds))
+        return est
+
+    def _stats(self, type_name: str):
+        st = self._state(type_name)
+        if st.stats is None:
+            raise ValueError(f"no statistics for {type_name!r}: no data written yet")
+        return st.stats
+
+    def stats_bounds(self, type_name: str, attr: str):
+        """(min, max) of an attribute from sketches."""
+        mm = self._stats(type_name).min_max(attr)
+        return (mm.min, mm.max)
+
+    def stats_top_k(self, type_name: str, attr: str, k: int = 10):
+        return self._stats(type_name).top_k(attr, k)
+
+    def stats_histogram(self, type_name: str, attr: str):
+        return self._stats(type_name).histogram(attr)
+
+    def stats_cardinality(self, type_name: str, attr: str) -> float:
+        return self._stats(type_name).cardinality(attr)
+
+
+def _sample_rows(table, rows, fraction, sample_by):
+    if fraction <= 0 or fraction >= 1 or len(rows) == 0:
+        return rows
+    nth = int(round(1.0 / fraction))
+    if nth <= 1:  # fractions near 1 round to keep-everything
+        return rows
+    if sample_by is None:
+        return rows[::nth]
+    keys = table.columns[sample_by].values[rows]
+    keep = np.zeros(len(rows), dtype=bool)
+    seen: dict = {}
+    for i, k in enumerate(keys):
+        c = seen.get(k, 0)
+        if c % nth == 0:
+            keep[i] = True
+        seen[k] = c + 1
+    return rows[keep]
+
+
+def _xy(table):
+    """Representative point coords: true points, or bbox centroids for
+    extended geometries (shared by the density and BIN aggregates)."""
+    col = table.geom_column()
+    if col.x is not None:
+        return col.x, col.y
+    b = col.bounds
+    return (b[:, 0] + b[:, 2]) * 0.5, (b[:, 1] + b[:, 3]) * 0.5
+
+
+def _density(table, opts) -> np.ndarray:
+    """Exact f64 heatmap over the result set (DensityScan role); the sharded
+    device path computes the same grid via ops.density + psum."""
+    width = int(opts.get("width", 256))
+    height = int(opts.get("height", 256))
+    xs, ys = _xy(table)
+    bbox = opts.get("bbox")
+    if bbox is None:
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+    xmin, ymin, xmax, ymax = bbox
+    weight = opts.get("weight_by")
+    w = None
+    if weight:
+        w = table.columns[weight].values.astype(np.float64)
+    grid, _, _ = np.histogram2d(
+        ys, xs, bins=[height, width], range=[[ymin, ymax], [xmin, xmax]], weights=w
+    )
+    return grid
+
+
+def _bin_encode(table, opts) -> bytes:
+    from geomesa_tpu.utils import bin_format
+
+    xs, ys = _xy(table)
+    track = opts.get("track")
+    label = opts.get("label")
+    return bin_format.encode(
+        xs,
+        ys,
+        table.dtg_millis(),
+        track_values=table.columns[track].values if track else table.fids,
+        label_values=table.columns[label].values if label else None,
+        sort_by_time=bool(opts.get("sort", False)),
+    )

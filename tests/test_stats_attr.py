@@ -1,0 +1,312 @@
+"""Attribute index, stats sketches, cost-based strategy, aggregation hints
+(reference suites: AttributeIndexTest, stats/*Test, DensityScan/BinAggregating
+tests — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.stats.sketches import (
+    Cardinality,
+    DescriptiveStats,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+    Z3Histogram,
+)
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.utils import bin_format
+
+T0 = 1_498_867_200_000
+SPEC = (
+    "name:String:index=true,age:Integer:index=true,dtg:Date,*geom:Point"
+    ";geomesa.z3.interval='week'"
+)
+
+
+def records(n=3000, seed=9):
+    rng = np.random.default_rng(seed)
+    lon = rng.uniform(-180, 180, n)
+    lat = rng.uniform(-90, 90, n)
+    t = T0 + rng.integers(0, 30 * 86_400_000, n)
+    return [
+        {
+            "name": f"name{i % 40}",
+            "age": int(rng.integers(0, 100)),
+            "dtg": int(t[i]),
+            "geom": Point(float(lon[i]), float(lat[i])),
+        }
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def stores():
+    recs = records()
+    oracle = DataStore(backend="oracle")
+    tpu = DataStore(backend="tpu")
+    for ds in (oracle, tpu):
+        ds.create_schema("t", SPEC)
+        ds.write("t", recs, fids=[f"t.{i}" for i in range(len(recs))])
+    return oracle, tpu
+
+
+ATTR_QUERIES = [
+    "name = 'name7'",
+    "name IN ('name1', 'name2', 'name39')",
+    "age BETWEEN 10 AND 20",
+    "age >= 95",
+    "name = 'name3' AND age < 50",
+    "name LIKE 'name1%'",
+    "name = 'name5' AND BBOX(geom, -90, -45, 90, 45)",
+    "name = 'name5' AND dtg DURING 2017-07-03T00:00:00Z/2017-07-20T00:00:00Z",
+    "name > 'name35'",
+]
+
+
+class TestAttributeIndex:
+    @pytest.mark.parametrize("cql", ATTR_QUERIES)
+    def test_parity(self, stores, cql):
+        oracle, tpu = stores
+        a = set(oracle.query("t", cql).table.fids.tolist())
+        b = set(tpu.query("t", cql).table.fids.tolist())
+        assert a == b, f"parity failure for {cql!r}"
+        assert len(a) > 0  # non-vacuous
+
+    def test_attr_index_selected_for_equality(self, stores):
+        _, tpu = stores
+        s = tpu.explain("t", "name = 'name7'")
+        assert "attr:name" in s, s
+
+    def test_z3_selected_for_spatiotemporal(self, stores):
+        _, tpu = stores
+        s = tpu.explain(
+            "t", "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2017-07-03T00:00:00Z/2017-07-10T00:00:00Z"
+        )
+        assert "Index: z3" in s, s
+
+    def test_cost_based_prefers_selective_attr(self, stores):
+        # equality on one of 40 names (~2.5%) should beat a whole-world bbox
+        _, tpu = stores
+        s = tpu.explain("t", "name = 'name7' AND BBOX(geom, -170, -85, 170, 85)")
+        assert "attr:name" in s, s
+
+    def test_forced_index_beats_cost(self, stores):
+        _, tpu = stores
+        r = tpu.query("t", Query(filter="name = 'name7'", hints={"index": "z2"}))
+        assert r.plan_info.index_name == "z2"
+
+
+class TestSketches:
+    def test_minmax_merge(self):
+        a, b = MinMax(), MinMax()
+        a.observe(np.array([3, 5, 9]))
+        b.observe(np.array([1, 22]))
+        m = a + b
+        assert (m.min, m.max) == (1, 22)
+
+    def test_histogram_estimate(self):
+        h = Histogram(0.0, 100.0, 100)
+        h.observe(np.random.default_rng(0).uniform(0, 100, 10000))
+        est = h.estimate_range(25.0, 75.0)
+        assert abs(est - 5000) < 300
+        assert h.merge(h).total == 20000
+
+    def test_frequency(self):
+        f = Frequency()
+        f.observe(np.array(["a"] * 50 + ["b"] * 10, dtype=object))
+        assert f.count("a") >= 50  # CMS overestimates only
+        assert f.count("b") >= 10
+        m = f + f
+        assert m.count("a") >= 100
+
+    def test_cardinality(self):
+        c = Cardinality()
+        vals = np.array([f"v{i}" for i in range(5000)], dtype=object)
+        c.observe(vals)
+        c.observe(vals)  # duplicates don't add
+        assert abs(c.estimate() - 5000) / 5000 < 0.1
+
+    def test_topk(self):
+        t = TopK(3)
+        t.observe(np.array(["x"] * 30 + ["y"] * 20 + ["z"] * 10 + ["w"], dtype=object))
+        top = t.top(3)
+        assert [k for k, _ in top] == ["x", "y", "z"]
+
+    def test_descriptive_merge(self):
+        rng = np.random.default_rng(1)
+        v = rng.normal(10, 2, 1000)
+        a, b = DescriptiveStats(), DescriptiveStats()
+        a.observe(v[:500])
+        b.observe(v[500:])
+        m = a + b
+        assert abs(m.mean - v.mean()) < 1e-9
+        assert abs(m.variance - v.var(ddof=1)) < 1e-6
+
+    def test_z3_histogram(self):
+        zh = Z3Histogram(bits=8)
+        bins = np.array([5, 5, 5, 6], dtype=np.int32)
+        zs = np.array([0, 1, 1 << 55, 42], dtype=np.uint64)
+        zh.observe_binned(bins, zs)
+        assert zh.total == 4
+        full = zh.estimate_zranges(5, np.array([[0, (1 << 63) - 1]], dtype=np.uint64))
+        assert abs(full - 3) < 1e-6
+
+
+class TestStatsAPI:
+    def test_count_and_bounds(self, stores):
+        _, tpu = stores
+        assert tpu.stats_count("t") == 3000
+        lo, hi = tpu.stats_bounds("t", "age")
+        assert lo == 0 and hi == 99
+
+    def test_estimated_count(self, stores):
+        _, tpu = stores
+        est = tpu.stats_count("t", "name = 'name7'")
+        exact = tpu.stats_count("t", "name = 'name7'", exact=True)
+        assert exact > 0
+        assert est >= exact  # CMS overestimates only
+        assert est < exact * 3
+
+    def test_spatiotemporal_estimate(self, stores):
+        _, tpu = stores
+        cql = "BBOX(geom, -90, -45, 90, 45) AND dtg DURING 2017-07-03T00:00:00Z/2017-07-17T00:00:00Z"
+        est = tpu.stats_count("t", cql)
+        exact = tpu.stats_count("t", cql, exact=True)
+        assert exact > 0
+        assert 0.3 < est / exact < 3.0, (est, exact)
+
+    def test_topk_and_cardinality(self, stores):
+        _, tpu = stores
+        top = tpu.stats_top_k("t", "name", 5)
+        assert len(top) == 5
+        card = tpu.stats_cardinality("t", "name")
+        assert abs(card - 40) / 40 < 0.2
+
+
+class TestAggregationHints:
+    def test_density(self, stores):
+        oracle, tpu = stores
+        q = Query(
+            filter="BBOX(geom, -90, -45, 90, 45)",
+            hints={"density": {"bbox": (-90, -45, 90, 45), "width": 64, "height": 32}},
+        )
+        r = tpu.query("t", q)
+        assert r.density.shape == (32, 64)
+        assert r.density.sum() == r.count
+
+    def test_stats_hint(self, stores):
+        _, tpu = stores
+        r = tpu.query("t", Query(filter="age < 50", hints={"stats": "MinMax(age);Count()"}))
+        mm = r.stats["MinMax(age)"]
+        assert mm.max <= 49
+        assert r.stats["Count()"].count == r.count
+
+    def test_bin_hint(self, stores):
+        _, tpu = stores
+        r = tpu.query(
+            "t",
+            Query(filter="BBOX(geom, 0, 0, 90, 45)", hints={"bin": {"track": "name", "sort": True}}),
+        )
+        dec = bin_format.decode(r.bin_data)
+        assert len(dec["lat"]) == r.count
+        assert np.all(np.diff(dec["dtg_secs"]) >= 0)  # time sorted
+        # coordinates survive the f32 roundtrip
+        assert dec["lon"].min() >= -0.01 and dec["lon"].max() <= 90.01
+
+    def test_sampling(self, stores):
+        _, tpu = stores
+        full = tpu.query("t", "INCLUDE").count
+        r = tpu.query("t", Query(filter="INCLUDE", hints={"sample": 0.1}))
+        assert 0.05 * full < r.count < 0.15 * full
+
+    def test_sampling_by_group(self, stores):
+        _, tpu = stores
+        r = tpu.query(
+            "t", Query(filter="INCLUDE", hints={"sample": 0.5, "sample_by": "name"})
+        )
+        assert 0.3 * 3000 < r.count < 0.7 * 3000
+
+
+class TestBinFormat:
+    def test_roundtrip(self):
+        lon = np.array([10.5, -20.25])
+        lat = np.array([45.0, -30.5])
+        dtg = np.array([1_500_000_000_000, 1_500_000_060_000], dtype=np.int64)
+        data = bin_format.encode(lon, lat, dtg, track_values=["a", "b"])
+        assert len(data) == 32
+        dec = bin_format.decode(data)
+        np.testing.assert_allclose(dec["lon"], lon.astype(np.float32))
+        np.testing.assert_allclose(dec["lat"], lat.astype(np.float32))
+        assert dec["dtg_secs"].tolist() == [1_500_000_000, 1_500_000_060]
+
+    def test_labeled(self):
+        data = bin_format.encode(
+            np.array([1.0]), np.array([2.0]), np.array([1_500_000_000_000]),
+            track_values=["t"], label_values=["label"],
+        )
+        assert len(data) == 24
+        dec = bin_format.decode(data, labeled=True)
+        assert "label" in dec
+
+    def test_merge_sorted(self):
+        a = bin_format.encode(
+            np.array([1.0]), np.array([1.0]), np.array([2_000_000], dtype=np.int64) * 1000
+        )
+        b = bin_format.encode(
+            np.array([2.0]), np.array([2.0]), np.array([1_000_000], dtype=np.int64) * 1000
+        )
+        m = bin_format.decode(bin_format.merge_sorted([a, b]))
+        assert m["dtg_secs"].tolist() == [1_000_000, 2_000_000]
+
+
+class TestReviewRegressions:
+    """Regressions for review findings on the attr/stats milestone."""
+
+    def test_like_supplementary_plane(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("lk", "name:String:index=true,dtg:Date,*geom:Point")
+        ds.write("lk", [
+            {"name": "ab\U0001F600", "dtg": T0, "geom": Point(1, 1)},
+            {"name": "abc", "dtg": T0, "geom": Point(2, 2)},
+            {"name": "zz", "dtg": T0, "geom": Point(3, 3)},
+        ])
+        r = ds.query("lk", "name LIKE 'ab%'")
+        assert r.count == 2  # emoji suffix must not fall outside the range
+
+    def test_indexed_date_attribute_query(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("dt", "d:Date:index=true,dtg:Date,*geom:Point")
+        ds.write("dt", [
+            {"d": T0 + i * 1000, "dtg": T0, "geom": Point(i, i)} for i in range(10)
+        ])
+        # quoted date literal against an indexed DATE attribute
+        r = ds.query("dt", "d < '2017-07-01T00:00:05Z'")
+        assert r.count == 5
+
+    def test_attr_only_index_config_full_scan(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema(
+            "ao", "name:String:index=true,dtg:Date,*geom:Point;geomesa.indices='attr:name'"
+        )
+        ds.write("ao", [{"name": None if i == 0 else f"n{i}", "dtg": T0, "geom": Point(i, i)}
+                         for i in range(5)])
+        # INCLUDE via the only (attribute) index must still see the null-name row
+        assert ds.query("ao", "INCLUDE").count == 5
+        assert ds.query("ao", "BBOX(geom, 0.5, 0.5, 10, 10)").count == 4
+
+    def test_sample_large_fraction(self, stores):
+        _, tpu = stores
+        full = tpu.query("t", "INCLUDE").count
+        r = tpu.query("t", Query(filter="INCLUDE", hints={"sample": 0.9}))
+        assert r.count == full  # ~1 rounds to keep-everything, not half
+
+    def test_stats_before_write(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("nb", "a:Integer,dtg:Date,*geom:Point")
+        import pytest as _pt
+
+        with _pt.raises(ValueError, match="no statistics"):
+            ds.stats_bounds("nb", "a")
