@@ -1,0 +1,296 @@
+"""Command-line tools: the ``geomesa-tools`` role (SURVEY.md §2.17).
+
+Command families mirror the reference's JCommander runner
+(``geomesa-tools/.../Runner.scala:47``): schema CRUD, ingest, export
+(csv/json/arrow/bin), explain, stats. State lives in a ``--catalog`` directory
+(:mod:`geomesa_tpu.store.persistence`).
+
+    python -m geomesa_tpu.cli create-schema -c /tmp/cat -n gdelt --spec '...'
+    python -m geomesa_tpu.cli ingest -c /tmp/cat -n gdelt --converter gdelt f.tsv
+    python -m geomesa_tpu.cli export -c /tmp/cat -n gdelt -q "BBOX(geom,...)" --format csv
+    python -m geomesa_tpu.cli explain -c /tmp/cat -n gdelt -q "..."
+    python -m geomesa_tpu.cli stats-analyze -c /tmp/cat -n gdelt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def _load(args):
+    from geomesa_tpu.store import persistence
+
+    if not (Path(args.catalog) / persistence.MANIFEST).exists():
+        from geomesa_tpu.store.datastore import DataStore
+
+        return DataStore(backend=args.backend)
+    return persistence.load(args.catalog, backend=args.backend)
+
+
+def _save(ds, args):
+    from geomesa_tpu.store import persistence
+
+    persistence.save(ds, args.catalog)
+
+
+def cmd_version(args):
+    import geomesa_tpu
+
+    print(f"geomesa-tpu {geomesa_tpu.__version__}")
+
+
+def cmd_create_schema(args):
+    ds = _load(args)
+    ds.create_schema(args.name, args.spec)
+    _save(ds, args)
+    print(f"created schema {args.name!r}")
+
+
+def cmd_get_type_names(args):
+    ds = _load(args)
+    for n in ds.list_schemas():
+        print(n)
+
+
+def cmd_describe_schema(args):
+    ds = _load(args)
+    sft = ds.get_schema(args.name)
+    for a in sft.attributes:
+        star = "*" if a.name == sft.default_geom else " "
+        opts = " " + ",".join(f"{k}={v}" for k, v in a.options.items()) if a.options else ""
+        print(f"{star}{a.name:<24}{a.type.value}{opts}")
+    if sft.user_data:
+        print("user-data:", json.dumps(sft.user_data))
+    print(f"features: {ds.stats_count(args.name)}")
+
+
+def cmd_delete_schema(args):
+    ds = _load(args)
+    ds.delete_schema(args.name)
+    _save(ds, args)
+    print(f"deleted schema {args.name!r}")
+
+
+def cmd_ingest(args):
+    from geomesa_tpu.convert.delimited import DelimitedConverter, EvaluationContext
+
+    ds = _load(args)
+    if args.converter == "gdelt":
+        from geomesa_tpu.convert.gdelt import gdelt_converter, gdelt_sft
+
+        if args.name not in ds.list_schemas():
+            ds.create_schema(gdelt_sft(args.name))
+        conv = gdelt_converter(ds.get_schema(args.name))
+    else:
+        sft = ds.get_schema(args.name)
+        fields = dict(kv.split("=", 1) for kv in (args.field or []))
+        conv = DelimitedConverter(
+            sft,
+            fields=fields,
+            id_field=args.id_field,
+            delimiter="\t" if args.format == "tsv" else ",",
+            header=args.header,
+            error_mode=args.error_mode,
+        )
+    ctx = EvaluationContext()
+    # convert all files first, then a single write: each write rebuilds all
+    # indexes + device state over the cumulative table, so per-file writes
+    # would be quadratic in file count
+    tables = []
+    for fi, path in enumerate(args.files):
+        t = conv.convert_path(path, ctx)
+        if conv.id_field is None and len(args.files) > 1:
+            # row-number fids collide across files; qualify with the file index
+            t.fids = np.asarray([f"{fi}.{f}" for f in t.fids], dtype=object)
+        tables.append(t)
+    if len(tables) == 1:
+        total = ds.write(args.name, tables[0])
+    else:
+        from geomesa_tpu.schema.columnar import FeatureTable
+
+        total = ds.write(args.name, FeatureTable.concat(tables))
+    _save(ds, args)
+    print(f"ingested {total} features ({ctx.failure} failed) into {args.name!r}")
+
+
+def _query_of(args):
+    from geomesa_tpu.planning.planner import Query
+
+    hints = {}
+    if getattr(args, "hints", None):
+        hints = json.loads(args.hints)
+    return Query(
+        filter=args.cql,
+        limit=getattr(args, "max", None),
+        hints=hints,
+        properties=args.attributes.split(",") if getattr(args, "attributes", None) else None,
+    )
+
+
+def cmd_export(args):
+    ds = _load(args)
+    r = ds.query(args.name, _query_of(args))
+    out = sys.stdout.buffer if args.output is None else open(args.output, "wb")
+    try:
+        if args.format == "csv":
+            import pandas as pd
+
+            rows = r.records()
+            df = {c: [str(rec.get(c)) for rec in rows] for c in (rows[0] if rows else {})}
+            pd.DataFrame(df).to_csv(out, index=False)
+        elif args.format == "json":
+            for rec in r.records():
+                out.write((json.dumps({k: str(v) for k, v in rec.items()}) + "\n").encode())
+        elif args.format == "arrow":
+            from geomesa_tpu.io.arrow import to_ipc_bytes
+
+            out.write(to_ipc_bytes(r.table))
+        elif args.format == "bin":
+            from geomesa_tpu.store.datastore import _bin_encode
+
+            out.write(_bin_encode(r.table, {"track": args.bin_track, "sort": True}))
+        else:
+            raise SystemExit(f"unknown format: {args.format}")
+    finally:
+        if args.output is not None:
+            out.close()
+    print(f"exported {r.count} features", file=sys.stderr)
+
+
+def cmd_explain(args):
+    ds = _load(args)
+    print(ds.explain(args.name, args.cql))
+
+
+def cmd_stats_analyze(args):
+    ds = _load(args)
+    sft = ds.get_schema(args.name)
+    print(f"count: {ds.stats_count(args.name)}")
+    for a in sft.attributes:
+        if a.type.is_geometry:
+            continue
+        try:
+            lo, hi = ds.stats_bounds(args.name, a.name)
+            card = ds.stats_cardinality(args.name, a.name)
+            print(f"{a.name}: bounds=[{lo}, {hi}] cardinality~{card:.0f}")
+        except Exception:
+            pass
+
+
+def cmd_stats_count(args):
+    ds = _load(args)
+    print(ds.stats_count(args.name, args.cql, exact=not args.estimate))
+
+
+def cmd_stats_top_k(args):
+    ds = _load(args)
+    for v, c in ds.stats_top_k(args.name, args.attribute, args.k):
+        print(f"{v}\t{c}")
+
+
+def cmd_stats_histogram(args):
+    ds = _load(args)
+    h = ds.stats_histogram(args.name, args.attribute)
+    if h is None:
+        raise SystemExit(f"no histogram for {args.attribute!r} (non-numeric?)")
+    step = (h.hi - h.lo) / h.bins
+    for i in range(0, h.bins, max(1, h.bins // args.bins)):
+        lo = h.lo + i * step
+        c = int(h.counts[i : i + max(1, h.bins // args.bins)].sum())
+        print(f"[{lo:.4g}, {lo + step * max(1, h.bins // args.bins):.4g}): {c}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, name=True):
+        sp.add_argument("-c", "--catalog", required=True, help="catalog directory")
+        sp.add_argument("--backend", default="tpu", choices=["tpu", "oracle"])
+        if name:
+            sp.add_argument("-n", "--name", required=True, help="feature type name")
+
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("create-schema")
+    common(sp)
+    sp.add_argument("--spec", required=True)
+    sp.set_defaults(fn=cmd_create_schema)
+
+    sp = sub.add_parser("get-type-names")
+    common(sp, name=False)
+    sp.set_defaults(fn=cmd_get_type_names)
+
+    sp = sub.add_parser("describe-schema")
+    common(sp)
+    sp.set_defaults(fn=cmd_describe_schema)
+
+    sp = sub.add_parser("delete-schema")
+    common(sp)
+    sp.set_defaults(fn=cmd_delete_schema)
+
+    sp = sub.add_parser("ingest")
+    common(sp)
+    sp.add_argument("--converter", default="delimited", help="'gdelt' or 'delimited'")
+    sp.add_argument("--format", default="csv", choices=["csv", "tsv"])
+    sp.add_argument("--field", action="append", help="attr=expression mapping")
+    sp.add_argument("--id-field", default=None)
+    sp.add_argument("--header", action="store_true")
+    sp.add_argument("--error-mode", default="skip", choices=["skip", "raise"])
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_ingest)
+
+    sp = sub.add_parser("export")
+    common(sp)
+    sp.add_argument("-q", "--cql", default=None)
+    sp.add_argument("--format", default="csv", choices=["csv", "json", "arrow", "bin"])
+    sp.add_argument("-m", "--max", type=int, default=None)
+    sp.add_argument("-a", "--attributes", default=None)
+    sp.add_argument("--hints", default=None, help="query hints as JSON")
+    sp.add_argument("--bin-track", default=None)
+    sp.add_argument("-o", "--output", default=None)
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("explain")
+    common(sp)
+    sp.add_argument("-q", "--cql", required=True)
+    sp.set_defaults(fn=cmd_explain)
+
+    sp = sub.add_parser("stats-analyze")
+    common(sp)
+    sp.set_defaults(fn=cmd_stats_analyze)
+
+    sp = sub.add_parser("stats-count")
+    common(sp)
+    sp.add_argument("-q", "--cql", default=None)
+    sp.add_argument("--estimate", action="store_true")
+    sp.set_defaults(fn=cmd_stats_count)
+
+    sp = sub.add_parser("stats-top-k")
+    common(sp)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("-k", type=int, default=10)
+    sp.set_defaults(fn=cmd_stats_top_k)
+
+    sp = sub.add_parser("stats-histogram")
+    common(sp)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("--bins", type=int, default=10)
+    sp.set_defaults(fn=cmd_stats_histogram)
+
+    args = p.parse_args(argv)
+    try:
+        args.fn(args)
+    except (KeyError, ValueError) as e:
+        # user-facing errors (unknown schema, bad spec/CQL): message, not traceback
+        raise SystemExit(f"error: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+"""Config-driven delimited-text ingest converters.
+
+The ``geomesa-convert`` role (SURVEY.md §2.16): declarative field mappings
+from delimited columns to typed SFT attributes, with a transform-expression
+mini-language (``$n`` column refs, ``point()``, ``date()``, ``concat()``,
+casts), error modes (skip-bad-records / raise), and per-file evaluation
+counters — re-designed around *columnar* evaluation: each transform maps whole
+numpy columns, not per-record closures.
+
+Transform grammar (subset of the reference's transformer functions):
+
+    $0              whole-record id / $1.. column by 1-based index
+    point($4, $5)   lon, lat columns → Point geometry column
+    date('%Y%m%d', $2)  strptime parse → epoch millis
+    dateHourMinSec / isodate / millisToDate($3)   common date presets
+    int($3) long($3) float($3) double($3) string($3) concat($1, '-', $2)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from geomesa_tpu.schema.columnar import Column, FeatureTable, point_column
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+_NUMERIC_DTYPES = {
+    AttributeType.INT: np.int32,
+    AttributeType.LONG: np.int64,
+    AttributeType.FLOAT: np.float32,
+    AttributeType.DOUBLE: np.float64,
+}
+
+
+@dataclass
+class EvaluationContext:
+    """Ingest counters (the reference's ``EvaluationContext`` role)."""
+
+    success: int = 0
+    failure: int = 0
+    errors: list = field(default_factory=list)
+
+
+class DelimitedConverter:
+    """CSV/TSV → FeatureTable for one schema.
+
+    ``fields``: {attribute: transform expression}; unlisted attributes default
+    to a same-named column if the file has headers. ``id_field``: transform for
+    feature ids (default: row number).
+    """
+
+    def __init__(
+        self,
+        sft: FeatureType,
+        fields: dict[str, str],
+        id_field: str | None = None,
+        delimiter: str = ",",
+        header: bool = False,
+        error_mode: str = "skip",  # skip | raise
+    ):
+        self.sft = sft
+        self.fields = fields
+        self.id_field = id_field
+        self.delimiter = delimiter
+        self.header = header
+        if error_mode not in ("skip", "raise"):
+            raise ValueError(f"error_mode must be skip|raise: {error_mode}")
+        self.error_mode = error_mode
+
+    def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        df = pd.read_csv(
+            path,
+            sep=self.delimiter,
+            header=0 if self.header else None,
+            dtype=str,
+            keep_default_na=False,
+            na_values=[],
+            engine="c",
+        )
+        return self.convert_frame(df, ctx)
+
+    def convert_frame(self, df, ctx: EvaluationContext | None = None) -> FeatureTable:
+        ctx = ctx if ctx is not None else EvaluationContext()
+        n = len(df)
+        cols: dict[str, Column] = {}
+        bad = np.zeros(n, dtype=bool)
+        for a in self.sft.attributes:
+            expr = self.fields.get(a.name, a.name if self.header else None)
+            if expr is None:
+                raise ValueError(f"no transform for attribute {a.name!r}")
+            try:
+                col, col_bad = _eval(expr, df, a.type, self)
+            except Exception as e:
+                raise ValueError(f"transform {expr!r} for {a.name!r} failed: {e}") from e
+            cols[a.name] = col
+            bad |= col_bad
+        if bad.any():
+            if self.error_mode == "raise":
+                idx = int(np.nonzero(bad)[0][0])
+                raise ValueError(f"bad record at row {idx}")
+            ctx.failure += int(bad.sum())
+            good = ~bad
+            cols = {k: c.take(good) for k, c in cols.items()}
+            n = int(good.sum())
+        else:
+            good = slice(None)
+        ctx.success += n
+        if self.id_field:
+            fid_col, _ = _eval(self.id_field, df, AttributeType.STRING, self)
+            fids = fid_col.values[good] if bad.any() else fid_col.values
+        else:
+            fids = np.arange(len(df))[good].astype(str).astype(object)
+        return FeatureTable(self.sft, np.asarray(fids, dtype=object), cols)
+
+
+_CALL = re.compile(r"^(\w+)\s*\((.*)\)$", re.S)
+_COLREF = re.compile(r"^\$(\d+)$")
+
+
+def _split_args(s: str) -> list[str]:
+    out, depth, cur, q = [], 0, [], None
+    for ch in s:
+        if q:
+            cur.append(ch)
+            if ch == q:
+                q = None
+        elif ch in "'\"":
+            q = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return out
+
+
+def _raw(expr: str, df, conv) -> np.ndarray:
+    """Evaluate a sub-expression to a raw string object array."""
+    expr = expr.strip()
+    m = _COLREF.match(expr)
+    if m:
+        i = int(m.group(1))
+        if i == 0:
+            return np.arange(len(df)).astype(str).astype(object)
+        series = df.iloc[:, i - 1]
+        return series.astype(str).to_numpy(dtype=object)
+    if expr.startswith(("'", '"')):
+        lit = expr[1:-1]
+        out = np.empty(len(df), dtype=object)
+        out[:] = lit
+        return out
+    if conv.header and expr in getattr(df, "columns", []):
+        return df[expr].astype(str).to_numpy(dtype=object)
+    m = _CALL.match(expr)
+    if m and m.group(1) == "concat":
+        parts = [_raw(a, df, conv) for a in _split_args(m.group(2))]
+        out = parts[0]
+        for p in parts[1:]:
+            out = np.char.add(out.astype(str), p.astype(str)).astype(object)
+        return out
+    raise ValueError(f"cannot evaluate expression: {expr!r}")
+
+
+def _eval(expr: str, df, typ: AttributeType, conv) -> tuple[Column, np.ndarray]:
+    """Expression → (Column, bad-row mask)."""
+    expr = expr.strip()
+    n = len(df)
+    m = _CALL.match(expr)
+    fn = m.group(1).lower() if m else None
+
+    if fn == "point":
+        ax, ay = _split_args(m.group(2))
+        xs = pd.to_numeric(pd.Series(_raw(ax, df, conv)), errors="coerce").to_numpy(np.float64)
+        ys = pd.to_numeric(pd.Series(_raw(ay, df, conv)), errors="coerce").to_numpy(np.float64)
+        bad = ~(np.isfinite(xs) & np.isfinite(ys))
+        bad |= (np.abs(xs) > 180) | (np.abs(ys) > 90)
+        xs = np.where(bad, 0.0, xs)
+        ys = np.where(bad, 0.0, ys)
+        return point_column(xs, ys), bad
+
+    if fn == "date":
+        fmt_arg, col_arg = _split_args(m.group(2))
+        fmt = fmt_arg.strip("'\"")
+        raw = _raw(col_arg, df, conv)
+        parsed = pd.to_datetime(pd.Series(raw), format=fmt, errors="coerce", utc=True)
+        return _date_column(raw, parsed)
+
+    if fn == "millistodate":
+        (col_arg,) = _split_args(m.group(2))
+        raw = _raw(col_arg, df, conv)
+        nums = pd.to_numeric(pd.Series(raw), errors="coerce")
+        empty = np.array([s == "" for s in raw])
+        nan = nums.isna().to_numpy()
+        return (
+            Column(AttributeType.DATE, nums.fillna(0).to_numpy(np.int64),
+                   None if (~nan).all() else ~nan),
+            nan & ~empty,
+        )
+
+    if fn == "isodate":
+        (col_arg,) = _split_args(m.group(2))
+        raw = _raw(col_arg, df, conv)
+        parsed = pd.to_datetime(pd.Series(raw), errors="coerce", utc=True, format="ISO8601")
+        return _date_column(raw, parsed)
+
+    if fn in ("int", "integer", "long", "float", "double"):
+        (col_arg,) = _split_args(m.group(2))
+        raw = _raw(col_arg, df, conv)
+        t = {
+            "int": AttributeType.INT,
+            "integer": AttributeType.INT,
+            "long": AttributeType.LONG,
+            "float": AttributeType.FLOAT,
+            "double": AttributeType.DOUBLE,
+        }[fn]
+        return _numeric_column(raw, t)
+
+    if fn == "string":
+        (col_arg,) = _split_args(m.group(2))
+        return Column(AttributeType.STRING, _raw(col_arg, df, conv)), np.zeros(n, bool)
+
+    # bare expression: raw string (or typed cast for numeric targets)
+    raw = _raw(expr, df, conv)
+    if typ in _NUMERIC_DTYPES:
+        return _numeric_column(raw, typ)
+    if typ == AttributeType.DATE:
+        parsed = pd.to_datetime(pd.Series(raw), errors="coerce", utc=True)
+        return _date_column(raw, parsed)
+    valid = np.array([v != "" for v in raw])
+    return Column(typ, raw, None if valid.all() else valid), np.zeros(n, bool)
+
+
+def _numeric_column(raw: np.ndarray, typ: AttributeType) -> tuple[Column, np.ndarray]:
+    """Numeric parse where empty cells become nulls and only non-empty
+    unparseable cells mark the record bad (the reference converter ingests
+    rows with empty optional fields as null attributes)."""
+    nums = pd.to_numeric(pd.Series(raw), errors="coerce")
+    empty = np.array([s == "" for s in raw])
+    nan = nums.isna().to_numpy()
+    valid = ~nan
+    col = Column(
+        typ, nums.fillna(0).to_numpy(_NUMERIC_DTYPES[typ]), None if valid.all() else valid
+    )
+    return col, nan & ~empty
+
+
+def _date_column(raw: np.ndarray, parsed) -> tuple[Column, np.ndarray]:
+    """Date parse with the same empty→null / garbage→bad split."""
+    nan = parsed.isna().to_numpy()
+    empty = np.array([s == "" for s in raw])
+    vals = np.where(nan, 0, parsed.values.astype("datetime64[ms]").astype(np.int64))
+    valid = ~nan
+    col = Column(AttributeType.DATE, vals.astype(np.int64), None if valid.all() else valid)
+    return col, nan & ~empty

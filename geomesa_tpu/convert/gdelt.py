@@ -1,0 +1,68 @@
+"""GDELT predefined schema + converter (the benchmark dataset).
+
+Mirrors the reference's predefined GDELT config
+(``geomesa-tools/conf/sfts/gdelt/reference.conf`` — SURVEY.md §2.16): the
+(v1) event schema keyed on ``globalEventId`` with CAMEO codes, actors,
+Goldstein scale, tone, and ``dtg``/``geom`` from SQLDATE +
+ActionGeo_Lat/Long. Raw GDELT v1 events export is tab-delimited, 57 columns.
+"""
+
+from __future__ import annotations
+
+from geomesa_tpu.convert.delimited import DelimitedConverter
+from geomesa_tpu.schema.sft import parse_spec
+
+GDELT_SPEC = (
+    "globalEventId:String,eventCode:String,eventBaseCode:String,"
+    "eventRootCode:String,isRootEvent:Integer,"
+    "actor1Name:String:index=true,actor1Code:String,actor1CountryCode:String,"
+    "actor2Name:String:index=true,actor2Code:String,actor2CountryCode:String,"
+    "quadClass:Integer,goldsteinScale:Double,numMentions:Integer,"
+    "numSources:Integer,numArticles:Integer,avgTone:Double,"
+    "dtg:Date,*geom:Point:srid=4326"
+    ";geomesa.z3.interval='week'"
+)
+
+
+def gdelt_sft(name: str = "gdelt"):
+    return parse_spec(name, GDELT_SPEC)
+
+
+def gdelt_converter(sft=None) -> DelimitedConverter:
+    """Converter for the raw GDELT v1 daily export (TSV, no header).
+
+    Column map (1-based, GDELT v1 event table): 1=GLOBALEVENTID, 2=SQLDATE
+    (yyyyMMdd), 7=Actor1Name, 6=Actor1Code, 8=Actor1CountryCode, 17=Actor2Name,
+    16=Actor2Code, 18=Actor2CountryCode, 26=IsRootEvent, 27=EventCode,
+    28=EventBaseCode, 29=EventRootCode, 30=QuadClass, 31=GoldsteinScale,
+    32=NumMentions, 33=NumSources, 34=NumArticles, 35=AvgTone,
+    40=ActionGeo_Lat, 41=ActionGeo_Long.
+    """
+    sft = sft or gdelt_sft()
+    return DelimitedConverter(
+        sft,
+        fields={
+            "globalEventId": "$1",
+            "dtg": "date('%Y%m%d', $2)",
+            "actor1Code": "$6",
+            "actor1Name": "$7",
+            "actor1CountryCode": "$8",
+            "actor2Code": "$16",
+            "actor2Name": "$17",
+            "actor2CountryCode": "$18",
+            "isRootEvent": "int($26)",
+            "eventCode": "$27",
+            "eventBaseCode": "$28",
+            "eventRootCode": "$29",
+            "quadClass": "int($30)",
+            "goldsteinScale": "double($31)",
+            "numMentions": "int($32)",
+            "numSources": "int($33)",
+            "numArticles": "int($34)",
+            "avgTone": "double($35)",
+            "geom": "point($41, $40)",
+        },
+        id_field="$1",
+        delimiter="\t",
+        header=False,
+    )

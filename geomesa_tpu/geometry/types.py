@@ -63,7 +63,7 @@ class Geometry:
         return hash(to_wkt(self))
 
 
-@dataclass(frozen=True, eq=False)
+@dataclass(frozen=True, eq=False, repr=False)
 class Point(Geometry):
     x: float
     y: float
@@ -74,7 +74,7 @@ class Point(Geometry):
         return (self.x, self.y, self.x, self.y)
 
 
-@dataclass(frozen=True, eq=False)
+@dataclass(frozen=True, eq=False, repr=False)
 class LineString(Geometry):
     coords: np.ndarray  # (N, 2) f64
     geom_type = "LineString"
@@ -88,7 +88,7 @@ class LineString(Geometry):
         return (c[:, 0].min(), c[:, 1].min(), c[:, 0].max(), c[:, 1].max())
 
 
-@dataclass(frozen=True, eq=False)
+@dataclass(frozen=True, eq=False, repr=False)
 class Polygon(Geometry):
     """Shell + holes; rings need not be explicitly closed (we close them)."""
 
@@ -120,7 +120,7 @@ def _close_ring(c: np.ndarray) -> np.ndarray:
     return c
 
 
-@dataclass(frozen=True, eq=False)
+@dataclass(frozen=True, eq=False, repr=False)
 class _Multi(Geometry):
     parts: tuple[Geometry, ...]
 

@@ -1,0 +1,161 @@
+"""Arrow interchange: FeatureTable ↔ pyarrow, IPC stream export.
+
+Capability parity with ``geomesa-arrow`` (SURVEY.md §2.13): the reference maps
+SimpleFeatures into Arrow vectors (``SimpleFeatureVector``, points as
+fixed-size lists, dictionary-encoded strings) and streams record batches as
+IPC. Here the columnar table *is already* Arrow layout, so conversion is a
+re-labeling: points become ``fixed_size_list<f64, 2>``, dates become
+``timestamp[ms]``, strings are dictionary-encoded, extended geometries ship as
+WKT (dictionary-encodable) plus a bbox struct for client-side filtering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
+from geomesa_tpu.schema.columnar import Column, FeatureTable, GeometryColumn, point_column
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+_SCALAR_ARROW = {
+    AttributeType.INT: pa.int32(),
+    AttributeType.LONG: pa.int64(),
+    AttributeType.FLOAT: pa.float32(),
+    AttributeType.DOUBLE: pa.float64(),
+    AttributeType.BOOLEAN: pa.bool_(),
+    AttributeType.STRING: pa.string(),
+    AttributeType.UUID: pa.string(),
+    AttributeType.BYTES: pa.binary(),
+}
+
+
+def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
+    """FeatureTable → pyarrow Table (zero-copy where dtypes allow)."""
+    fields = []
+    arrays = []
+    fields.append(pa.field("__fid__", pa.string()))
+    arrays.append(pa.array([str(f) for f in table.fids], type=pa.string()))
+    for a in table.sft.attributes:
+        if a.name not in table.columns:
+            continue  # projected out
+        col = table.columns[a.name]
+        mask = None if col.valid is None else ~col.is_valid()
+        if a.type == AttributeType.POINT:
+            gc: GeometryColumn = col  # type: ignore[assignment]
+            xy = np.empty(2 * len(table), dtype=np.float64)
+            xy[0::2] = np.nan_to_num(gc.x)
+            xy[1::2] = np.nan_to_num(gc.y)
+            arr = pa.FixedSizeListArray.from_arrays(
+                pa.array(xy), 2, mask=None if mask is None else pa.array(mask)
+            )
+            fields.append(pa.field(a.name, arr.type))
+            arrays.append(arr)
+        elif a.type.is_geometry:
+            gc = col  # type: ignore[assignment]
+            wkts = [
+                None if g is None else to_wkt(g) for g in gc.geometries()
+            ]
+            arr = pa.array(wkts, type=pa.string())
+            if dictionary_encode:
+                arr = arr.dictionary_encode()
+            fields.append(pa.field(a.name, arr.type, metadata={b"geom": b"wkt"}))
+            arrays.append(arr)
+        elif a.type == AttributeType.DATE:
+            arr = pa.array(col.values, type=pa.timestamp("ms"), mask=mask)
+            fields.append(pa.field(a.name, arr.type))
+            arrays.append(arr)
+        else:
+            typ = _SCALAR_ARROW[a.type]
+            vals = col.values
+            if vals.dtype == object:
+                arr = pa.array(vals.tolist(), type=typ, mask=mask)
+            else:
+                arr = pa.array(vals, type=typ, mask=mask)
+            if dictionary_encode and a.type == AttributeType.STRING:
+                arr = arr.dictionary_encode()
+                typ = arr.type
+            fields.append(pa.field(a.name, typ))
+            arrays.append(arr)
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def from_arrow(sft: FeatureType, atable: pa.Table) -> FeatureTable:
+    """pyarrow Table (as produced by :func:`to_arrow`) → FeatureTable."""
+    n = atable.num_rows
+    fids = atable.column("__fid__").to_pylist() if "__fid__" in atable.column_names else [
+        str(i) for i in range(n)
+    ]
+    cols: dict[str, Column] = {}
+    for a in sft.attributes:
+        if a.name not in atable.column_names:
+            continue
+        ac = atable.column(a.name).combine_chunks()
+        if a.type == AttributeType.POINT:
+            valid_mask = ~np.asarray(ac.is_null())
+            flat = np.asarray(ac.flatten(), dtype=np.float64)
+            if valid_mask.all():
+                cols[a.name] = point_column(flat[0::2], flat[1::2])
+            else:
+                # null slots were mask-compacted out of flatten(); re-expand
+                xs = np.full(n, np.nan)
+                ys = np.full(n, np.nan)
+                xs[valid_mask] = flat[0::2]
+                ys[valid_mask] = flat[1::2]
+                cols[a.name] = point_column(xs, ys, valid=valid_mask)
+        elif a.type.is_geometry:
+            vals = ac.to_pylist()
+            geoms = np.empty(n, dtype=object)
+            valid = np.ones(n, dtype=bool)
+            bounds = np.full((n, 4), np.nan)
+            for i, w in enumerate(vals):
+                if w is None:
+                    valid[i] = False
+                else:
+                    g = from_wkt(w)
+                    geoms[i] = g
+                    bounds[i] = g.bbox
+            cols[a.name] = GeometryColumn(
+                a.type, geoms, None if valid.all() else valid, bounds=bounds
+            )
+        elif a.type == AttributeType.DATE:
+            ms = ac.cast(pa.int64())
+            valid_mask = ~np.asarray(ac.is_null())
+            cols[a.name] = Column(
+                a.type,
+                np.asarray(ms.fill_null(0), dtype=np.int64),
+                None if valid_mask.all() else valid_mask,
+            )
+        else:
+            valid_mask = ~np.asarray(ac.is_null())
+            if isinstance(ac.type, pa.DictionaryType):
+                ac = ac.cast(ac.type.value_type)
+            if a.type in (AttributeType.STRING, AttributeType.UUID, AttributeType.BYTES):
+                vals = np.empty(n, dtype=object)
+                vals[:] = ac.to_pylist()
+                cols[a.name] = Column(a.type, vals, None if valid_mask.all() else valid_mask)
+            else:
+                from geomesa_tpu.schema.columnar import _NUMERIC_DTYPES
+
+                fill = False if a.type == AttributeType.BOOLEAN else 0
+                np_vals = np.asarray(ac.fill_null(fill)).astype(_NUMERIC_DTYPES[a.type])
+                cols[a.name] = Column(
+                    a.type, np_vals, None if valid_mask.all() else valid_mask
+                )
+    return FeatureTable(sft, np.asarray(fids, dtype=object), cols)
+
+
+def to_ipc_bytes(table: FeatureTable) -> bytes:
+    """Arrow IPC stream bytes (the ``ArrowScan`` wire format role)."""
+    at = to_arrow(table)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, at.schema) as w:
+        w.write_table(at)
+    return sink.getvalue().to_pybytes()
+
+
+def from_ipc_bytes(sft: FeatureType, data: bytes) -> FeatureTable:
+    with pa.ipc.open_stream(pa.BufferReader(data)) as r:
+        at = r.read_all()
+    return from_arrow(sft, at)

@@ -1,0 +1,60 @@
+"""Spatial join: points × polygons (the ``JoinProcess`` / batched ST_Within role).
+
+Two paths (SURVEY.md §2.14 TPU mapping):
+
+- :func:`join_within` — exact: per-polygon index-planned scan (z2 ranges) +
+  f64 residual predicate. The oracle-parity path.
+- :func:`join_within_device` — bulk: whole point store against all polygons
+  via the f32 device kernel (:mod:`geomesa_tpu.ops.join`), returning counts;
+  ~1e-5 deg edge tolerance (BASELINE config #4's throughput shape).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.planning.planner import Query
+
+
+def join_within(ds, type_name: str, polygons, filter=None):
+    """Exact join: returns list of (polygon_index, row fids ndarray)."""
+    sft = ds.get_schema(type_name)
+    base = None
+    if filter is not None:
+        from geomesa_tpu.filter.cql import parse
+
+        base = parse(filter) if isinstance(filter, str) else filter
+    out = []
+    for i, poly in enumerate(polygons):
+        f = ast.SpatialOp("within", sft.geom_field, poly)
+        if base is not None:
+            f = ast.And([f, base])
+        r = ds.query(type_name, Query(filter=f))
+        out.append((i, r.table.fids))
+    return out
+
+
+def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):
+    """Bulk join counts: (K,) ndarray of points-inside counts per polygon.
+
+    Runs the f32 crossing-number kernel over the full point store on device.
+    """
+    import jax.numpy as jnp
+
+    from geomesa_tpu.ops.join import pack_polygons, points_in_polygons_count
+
+    st = ds._state(type_name)
+    if st.table is None or len(st.table) == 0:
+        return np.zeros(len(polygons), dtype=np.int32)
+    col = st.table.geom_column()
+    if col.x is None:
+        raise ValueError("device join requires a point geometry store")
+    verts, bbox, _ = pack_polygons(polygons, max_vertices)
+    counts = points_in_polygons_count(
+        jnp.asarray(col.x.astype(np.float32)),
+        jnp.asarray(col.y.astype(np.float32)),
+        jnp.asarray(verts),
+        jnp.asarray(bbox),
+    )
+    return np.asarray(counts)

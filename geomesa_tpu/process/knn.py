@@ -1,0 +1,81 @@
+"""K-nearest-neighbor search via expanding-window index scans.
+
+Reference: ``geomesa-process/.../KNearestNeighborSearchProcess`` (583 LoC;
+SURVEY.md §2.15) — iterative-deepening geo window search: query a window
+around the point, and if fewer than k candidates are found, double the window
+and retry; final distances ranked exactly. Same shape here, with the window
+scans going through the normal (index-planned, device-refined) query path and
+the distance ranking vectorized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+
+
+def knn(
+    ds,
+    type_name: str,
+    point: Point,
+    k: int = 10,
+    filter=None,
+    initial_radius_deg: float = 0.5,
+    max_radius_deg: float = 45.0,
+):
+    """Returns (table, distances_deg) of the k nearest features to ``point``.
+
+    ``filter``: optional extra CQL/AST predicate AND'ed with the window.
+    """
+    base = None
+    if filter is not None:
+        from geomesa_tpu.filter.cql import parse
+
+        base = parse(filter) if isinstance(filter, str) else filter
+
+    sft = ds.get_schema(type_name)
+    geom_field = sft.geom_field
+    radius = initial_radius_deg
+    result = None
+    while True:
+        window = ast.BBox(
+            geom_field,
+            point.x - radius,
+            max(point.y - radius, -90.0),
+            point.x + radius,
+            min(point.y + radius, 90.0),
+        )
+        f = window if base is None else ast.And([window, base])
+        r = ds.query(type_name, Query(filter=f))
+        # enough candidates, and the k-th distance is inside the window's
+        # inscribed circle (otherwise a nearer point could hide outside)
+        if r.count >= k:
+            d = _distances(r, point)
+            kth = np.partition(d, k - 1)[k - 1]
+            if kth <= radius or radius >= max_radius_deg:
+                result = (r, d)
+                break
+        elif radius >= max_radius_deg:
+            result = (r, _distances(r, point))
+            break
+        radius = min(radius * 2.0, max_radius_deg)
+
+    r, d = result
+    take = min(k, r.count)
+    order = np.argsort(d, kind="stable")[:take]
+    return r.table.take(order), d[order]
+
+
+def _distances(r, point: Point) -> np.ndarray:
+    col = r.table.geom_column()
+    if col.x is not None:
+        return np.sqrt((col.x - point.x) ** 2 + (col.y - point.y) ** 2)
+    from geomesa_tpu.geometry import predicates as P
+
+    geoms = col.geometries()
+    return np.array(
+        [P.distance(point, g) if g is not None else np.inf for g in geoms]
+    )

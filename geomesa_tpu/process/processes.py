@@ -1,0 +1,143 @@
+"""Geoprocessing operations: unique, proximity, tube-select, point2point.
+
+Reference: ``geomesa-process`` WPS processes (SURVEY.md §2.15):
+``UniqueProcess`` (301), ``ProximitySearchProcess``, ``TubeSelectProcess``
+(183) + ``TubeBuilder`` (270), ``Point2PointProcess``. Each pushes work into
+normal (index-planned) queries where possible and vectorizes the rest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geometry.types import LineString, Point
+from geomesa_tpu.planning.planner import Query
+
+
+def unique(ds, type_name: str, attribute: str, filter=None, sort: bool = True):
+    """Distinct values + counts of an attribute (``UniqueProcess`` role)."""
+    r = ds.query(type_name, Query(filter=filter))
+    col = r.table.columns[attribute]
+    vals = col.values[col.is_valid()]
+    values, counts = np.unique(vals.astype(object), return_counts=True)
+    out = list(zip(values.tolist(), counts.tolist()))
+    if sort:
+        out.sort(key=lambda vc: (-vc[1], str(vc[0])))
+    return out
+
+
+def proximity(ds, type_name: str, geometries, distance_deg: float, filter=None):
+    """Features within ``distance_deg`` of any input geometry
+    (``ProximitySearchProcess`` role): bbox-expanded index scan + exact
+    distance refine."""
+    sft = ds.get_schema(type_name)
+    parts = []
+    for g in geometries:
+        x1, y1, x2, y2 = g.bbox
+        parts.append(
+            ast.SpatialOp("dwithin", sft.geom_field, g, distance=distance_deg)
+        )
+    f = parts[0] if len(parts) == 1 else ast.Or(parts)
+    if filter is not None:
+        from geomesa_tpu.filter.cql import parse
+
+        base = parse(filter) if isinstance(filter, str) else filter
+        f = ast.And([f, base])
+    return ds.query(type_name, Query(filter=f)).table
+
+
+def point2point(table, sort_field: str, group_field: str | None = None):
+    """Convert point sequences into track LineStrings (``Point2PointProcess``):
+    order by ``sort_field`` (within ``group_field`` groups) and connect.
+    Extended geometries contribute their bbox centroids."""
+    from geomesa_tpu.schema.columnar import representative_xy
+
+    xs, ys = representative_xy(table)
+    keys = table.columns[sort_field].values
+    if group_field is None:
+        order = np.argsort(keys, kind="stable")
+        coords = np.stack([xs[order], ys[order]], axis=1)
+        return {None: LineString(coords)} if len(coords) >= 2 else {}
+    groups = table.columns[group_field].values
+    out = {}
+    for g in np.unique(groups.astype(object)):
+        sel = np.nonzero(groups == g)[0]
+        if len(sel) < 2:
+            continue
+        order = sel[np.argsort(keys[sel], kind="stable")]
+        out[g] = LineString(np.stack([xs[order], ys[order]], axis=1))
+    return out
+
+
+def tube_select(
+    ds,
+    type_name: str,
+    track: list[tuple[float, float, int]],
+    buffer_deg: float,
+    time_buffer_ms: int,
+    filter=None,
+):
+    """Spatio-temporal corridor search (``TubeSelectProcess``/``TubeBuilder``):
+    features within ``buffer_deg`` of the track's path AND within
+    ``time_buffer_ms`` of the track's local time.
+
+    ``track``: [(lon, lat, epoch_ms), ...] ordered waypoints. Implemented as
+    one OR-of-segments query (each segment = bbox+time window primary bounds)
+    followed by an exact per-segment (distance, time-interpolation) refine.
+    """
+    sft = ds.get_schema(type_name)
+    if len(track) < 2:
+        raise ValueError("tube requires at least 2 waypoints")
+    pts = np.asarray([(x, y) for x, y, _ in track], dtype=np.float64)
+    ts = np.asarray([t for _, _, t in track], dtype=np.int64)
+
+    # primary scan: OR of per-segment bbox+time windows
+    parts = []
+    for i in range(len(track) - 1):
+        x1 = min(pts[i, 0], pts[i + 1, 0]) - buffer_deg
+        x2 = max(pts[i, 0], pts[i + 1, 0]) + buffer_deg
+        y1 = min(pts[i, 1], pts[i + 1, 1]) - buffer_deg
+        y2 = max(pts[i, 1], pts[i + 1, 1]) + buffer_deg
+        t1 = int(min(ts[i], ts[i + 1]) - time_buffer_ms)
+        t2 = int(max(ts[i], ts[i + 1]) + time_buffer_ms)
+        parts.append(
+            ast.And(
+                [
+                    ast.BBox(sft.geom_field, x1, y1, x2, y2),
+                    ast.During(sft.dtg_field, t1 - 1, t2 + 1),
+                ]
+            )
+        )
+    f = ast.Or(parts)
+    if filter is not None:
+        from geomesa_tpu.filter.cql import parse
+
+        base = parse(filter) if isinstance(filter, str) else filter
+        f = ast.And([f, base])
+    r = ds.query(type_name, Query(filter=f))
+    if r.count == 0:
+        return r.table
+
+    # exact refine: distance to segment AND time within the segment's
+    # (time-extended) span, vectorized over candidates × segments (extended
+    # geometries refine by bbox centroid)
+    from geomesa_tpu.schema.columnar import representative_xy
+
+    xs, ys = representative_xy(r.table)
+    cx = xs[:, None]
+    cy = ys[:, None]
+    ct = r.table.dtg_millis()[:, None]
+    x1, y1 = pts[:-1, 0][None, :], pts[:-1, 1][None, :]
+    x2, y2 = pts[1:, 0][None, :], pts[1:, 1][None, :]
+    dx, dy = x2 - x1, y2 - y1
+    len2 = dx * dx + dy * dy
+    with np.errstate(divide="ignore", invalid="ignore"):
+        tproj = np.where(len2 > 0, ((cx - x1) * dx + (cy - y1) * dy) / len2, 0.0)
+    tproj = np.clip(tproj, 0.0, 1.0)
+    d2 = (cx - (x1 + tproj * dx)) ** 2 + (cy - (y1 + tproj * dy)) ** 2
+    t_lo = np.minimum(ts[:-1], ts[1:])[None, :] - time_buffer_ms
+    t_hi = np.maximum(ts[:-1], ts[1:])[None, :] + time_buffer_ms
+    ok = (d2 <= buffer_deg**2) & (ct >= t_lo) & (ct <= t_hi)
+    keep = ok.any(axis=1)
+    return r.table.take(np.nonzero(keep)[0])

@@ -50,7 +50,7 @@ class Column:
 
     def is_valid(self) -> np.ndarray:
         if self.valid is None:
-            return np.ones(len(self.values), dtype=bool)
+            return np.ones(len(self), dtype=bool)  # len() works for lazy geometry columns too
         return self.valid
 
 
@@ -302,3 +302,14 @@ def _cat(arrs):
     if any(a is None for a in arrs):
         return None
     return np.concatenate(arrs)
+
+
+def representative_xy(table: FeatureTable) -> tuple[np.ndarray, np.ndarray]:
+    """Representative point coords for each feature: true point coords, or
+    bbox centroids for extended geometries (shared by density/BIN aggregates
+    and the track-oriented processes)."""
+    col = table.geom_column()
+    if col.x is not None:
+        return col.x, col.y
+    b = col.bounds
+    return (b[:, 0] + b[:, 2]) * 0.5, (b[:, 1] + b[:, 3]) * 0.5

@@ -254,6 +254,18 @@ class DataStore:
                 est = min(est, st.stats.estimate_attr(name, bounds))
         return est
 
+    # -- persistence (checkpoint/resume) -------------------------------------
+    def save(self, path: str) -> dict:
+        from geomesa_tpu.store import persistence
+
+        return persistence.save(self, path)
+
+    @staticmethod
+    def load(path: str, backend: str = "tpu") -> "DataStore":
+        from geomesa_tpu.store import persistence
+
+        return persistence.load(path, backend=backend)
+
     def _stats(self, type_name: str):
         st = self._state(type_name)
         if st.stats is None:
@@ -294,14 +306,7 @@ def _sample_rows(table, rows, fraction, sample_by):
     return rows[keep]
 
 
-def _xy(table):
-    """Representative point coords: true points, or bbox centroids for
-    extended geometries (shared by the density and BIN aggregates)."""
-    col = table.geom_column()
-    if col.x is not None:
-        return col.x, col.y
-    b = col.bounds
-    return (b[:, 0] + b[:, 2]) * 0.5, (b[:, 1] + b[:, 3]) * 0.5
+from geomesa_tpu.schema.columnar import representative_xy as _xy  # noqa: E402
 
 
 def _density(table, opts) -> np.ndarray:

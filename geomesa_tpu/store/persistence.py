@@ -1,0 +1,108 @@
+"""Catalog persistence: save/load a DataStore to a directory (checkpoint/resume).
+
+The reference's durable state is the store itself plus catalog metadata
+(schema specs, stats) — ``metadata/TableBasedMetadata.scala``,
+``fs/.../FileBasedMetadata.scala`` (SURVEY.md §5 "checkpoint/resume"). TPU
+equivalent: persisted Arrow/Parquet shard files + a JSON manifest; device
+arrays are rebuilt from the manifest on load. Layout:
+
+    catalog/
+      manifest.json                  # schema specs + file lists + counts
+      <type>/part-<bin>.parquet      # one file per time partition (or part-all)
+
+Time-partitioned files are the ``TablePartition``/``DateTimeScheme`` role
+(SURVEY.md §2.12): queries could prune partitions at load; compaction is a
+rewrite of the manifest + files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from geomesa_tpu.io.arrow import from_arrow, to_arrow
+from geomesa_tpu.schema.sft import parse_spec
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+
+def save(ds, path: str, partition_by_time: bool = True) -> dict:
+    """Persist every schema + table of a DataStore; returns the manifest."""
+    root = Path(path)
+    root.mkdir(parents=True, exist_ok=True)
+    manifest = {"version": FORMAT_VERSION, "types": {}}
+    for name in ds.list_schemas():
+        st = ds._state(name)
+        tdir = root / name
+        tdir.mkdir(exist_ok=True)
+        files = []
+        count = 0
+        if st.table is not None and len(st.table):
+            count = len(st.table)
+            parts = _partitions(st) if partition_by_time else {"all": np.arange(count)}
+            for key, rows in parts.items():
+                at = to_arrow(st.table.take(rows))
+                fn = f"part-{key}.parquet"
+                pq.write_table(at, tdir / fn)
+                files.append({"file": fn, "rows": int(len(rows)), "partition": str(key)})
+        manifest["types"][name] = {
+            "spec": st.sft.to_spec(),
+            "count": count,
+            "files": files,
+        }
+        # drop stale shards from prior saves (compaction = manifest + files)
+        keep = {f["file"] for f in files}
+        for p in tdir.glob("part-*.parquet"):
+            if p.name not in keep:
+                p.unlink()
+    # drop directories of schemas that no longer exist
+    for p in root.iterdir():
+        if p.is_dir() and p.name not in manifest["types"]:
+            import shutil
+
+            shutil.rmtree(p)
+    (root / MANIFEST).write_text(json.dumps(manifest, indent=2))
+    return manifest
+
+
+def _partitions(st) -> dict:
+    """Rows grouped by z3 time bin (coarse time partitioning)."""
+    sft = st.sft
+    if sft.dtg_field is None:
+        return {"all": np.arange(len(st.table))}
+    from geomesa_tpu.curve.binned_time import BinnedTime
+
+    bins, _ = BinnedTime(sft.z3_interval).to_bin_and_offset(st.table.dtg_millis())
+    out = {}
+    for b in np.unique(bins):
+        out[int(b)] = np.nonzero(bins == b)[0]
+    return out
+
+
+def load(path: str, backend: str = "tpu"):
+    """Restore a DataStore (device state rebuilt) from a catalog directory."""
+    from geomesa_tpu.schema.columnar import FeatureTable
+    from geomesa_tpu.store.datastore import DataStore
+
+    root = Path(path)
+    manifest = json.loads((root / MANIFEST).read_text())
+    if manifest.get("version") != FORMAT_VERSION:
+        raise ValueError(f"unsupported catalog version: {manifest.get('version')}")
+    ds = DataStore(backend=backend)
+    for name, meta in manifest["types"].items():
+        sft = parse_spec(name, meta["spec"])
+        ds.create_schema(sft)
+        tables = []
+        for f in meta["files"]:
+            at = pq.read_table(root / name / f["file"])
+            tables.append(from_arrow(sft, at))
+        if tables:
+            table = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
+            ds.write(name, table)
+    return ds

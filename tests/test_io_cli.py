@@ -1,0 +1,305 @@
+"""Arrow IPC, catalog persistence, converters, and CLI end-to-end tests
+(reference suites: arrow io tests, fs-storage metadata tests, convert tests,
+tools Ingest/Export command tests — SURVEY.md §4)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.delimited import DelimitedConverter, EvaluationContext
+from geomesa_tpu.convert.gdelt import gdelt_converter, gdelt_sft
+from geomesa_tpu.geometry import LineString, Point
+from geomesa_tpu.io.arrow import from_arrow, from_ipc_bytes, to_arrow, to_ipc_bytes
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,age:Integer,score:Double,flag:Boolean,dtg:Date,*geom:Point"
+
+
+def table(n=50):
+    rng = np.random.default_rng(2)
+    sft = parse_spec("t", SPEC)
+    recs = [
+        {
+            "name": f"n{i}" if i % 7 else None,
+            "age": int(i),
+            "score": float(i) * 1.5,
+            "flag": bool(i % 2),
+            "dtg": T0 + i * 1000,
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)])
+
+
+class TestArrow:
+    def test_roundtrip(self):
+        t = table()
+        at = to_arrow(t)
+        assert at.num_rows == 50
+        t2 = from_arrow(t.sft, at)
+        for i in (0, 7, 49):
+            assert t.record(i) == t2.record(i)
+        assert t2.fids.tolist() == t.fids.tolist()
+
+    def test_ipc_roundtrip(self):
+        t = table()
+        data = to_ipc_bytes(t)
+        t2 = from_ipc_bytes(t.sft, data)
+        assert len(t2) == len(t)
+        assert t2.record(3) == t.record(3)
+
+    def test_point_fixed_size_list(self):
+        t = table()
+        at = to_arrow(t)
+        import pyarrow as pa
+
+        assert pa.types.is_fixed_size_list(at.schema.field("geom").type)
+
+    def test_linestring_wkt(self):
+        sft = parse_spec("l", "dtg:Date,*geom:LineString")
+        t = FeatureTable.from_records(
+            sft,
+            [{"dtg": T0, "geom": LineString(np.array([[0, 0], [1, 1], [2, 0]], float))}],
+        )
+        t2 = from_arrow(sft, to_arrow(t))
+        assert t2.record(0)["geom"] == t.record(0)["geom"]
+
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        ds = DataStore(backend="tpu")
+        sft = parse_spec("pts", SPEC + ";geomesa.z3.interval='day'")
+        ds.create_schema(sft)
+        t = table()
+        ds.write("pts", t)
+        manifest = ds.save(str(tmp_path / "cat"))
+        assert manifest["types"]["pts"]["count"] == 50
+        assert len(manifest["types"]["pts"]["files"]) >= 1  # time-partitioned
+
+        ds2 = DataStore.load(str(tmp_path / "cat"))
+        assert ds2.list_schemas() == ["pts"]
+        assert ds2.get_schema("pts").z3_interval.value == "day"
+        r1 = ds.query("pts", "BBOX(geom, -90, -45, 90, 45)")
+        r2 = ds2.query("pts", "BBOX(geom, -90, -45, 90, 45)")
+        assert set(r1.table.fids.tolist()) == set(r2.table.fids.tolist())
+
+    def test_empty_store(self, tmp_path):
+        ds = DataStore()
+        ds.create_schema("e", "dtg:Date,*geom:Point")
+        ds.save(str(tmp_path / "cat"))
+        ds2 = DataStore.load(str(tmp_path / "cat"))
+        assert ds2.list_schemas() == ["e"]
+        assert ds2.query("e", "INCLUDE").count == 0
+
+
+GDELT_ROW = (
+    "123456\t20170714\t201707\t2017\t2017.5342\tUSA\tUNITED STATES\tUSA\t\t\t\t\t\t\t\t"
+    "RUS\tRUSSIA\tRUS\t\t\t\t\t\t\t\t1\t042\t042\t04\t1\t1.5\t10\t2\t5\t-2.3\t"
+    "3\tWashington DC\tUS\tUSDC\t38.9072\t-77.0369\t531871\t"
+    "3\tMoscow\tRU\tRUMOW\t55.7558\t37.6173\t524901\t"
+    "3\tParis\tFR\tFR00\t48.85\t2.35\t2988507\t"
+    "20170714\thttp://example.com"
+)
+
+
+class TestConverters:
+    def test_gdelt_converter(self, tmp_path):
+        f = tmp_path / "gdelt.tsv"
+        rows = []
+        for i in range(10):
+            parts = GDELT_ROW.split("\t")
+            parts[0] = str(100 + i)
+            rows.append("\t".join(parts))
+        f.write_text("\n".join(rows))
+        conv = gdelt_converter()
+        ctx = EvaluationContext()
+        t = conv.convert_path(str(f), ctx)
+        assert len(t) == 10 and ctx.success == 10
+        rec = t.record(0)
+        assert rec["actor1Name"] == "UNITED STATES"
+        assert rec["goldsteinScale"] == 1.5
+        assert rec["geom"] == Point(-77.0369, 38.9072)
+        # dtg parsed from yyyyMMdd
+        assert rec["dtg"] == int(np.datetime64("2017-07-14", "ms").astype(np.int64))
+        assert t.fids[0] == "100"
+
+    def test_bad_records_skipped(self, tmp_path):
+        sft = parse_spec("x", "a:Integer,dtg:Date,*geom:Point")
+        conv = DelimitedConverter(
+            sft,
+            fields={"a": "int($1)", "dtg": "millisToDate($2)", "geom": "point($3, $4)"},
+        )
+        f = tmp_path / "x.csv"
+        f.write_text("1,1500000000000,10,20\nbad,1500000000000,10,20\n2,1500000000000,200,20\n")
+        ctx = EvaluationContext()
+        t = conv.convert_path(str(f), ctx)
+        assert len(t) == 1  # row 2: bad int; row 3: lon 200 out of bounds
+        assert ctx.failure == 2
+
+    def test_raise_mode(self, tmp_path):
+        sft = parse_spec("x", "a:Integer,dtg:Date,*geom:Point")
+        conv = DelimitedConverter(
+            sft,
+            fields={"a": "int($1)", "dtg": "millisToDate($2)", "geom": "point($3, $4)"},
+            error_mode="raise",
+        )
+        f = tmp_path / "x.csv"
+        f.write_text("bad,1500000000000,10,20\n")
+        with pytest.raises(ValueError, match="bad record"):
+            conv.convert_path(str(f))
+
+    def test_concat_and_literals(self, tmp_path):
+        sft = parse_spec("x", "k:String,dtg:Date,*geom:Point")
+        conv = DelimitedConverter(
+            sft,
+            fields={
+                "k": "concat($1, '-', $2)",
+                "dtg": "millisToDate($3)",
+                "geom": "point($4, $5)",
+            },
+        )
+        f = tmp_path / "x.csv"
+        f.write_text("a,b,1500000000000,1,2\n")
+        t = conv.convert_path(str(f))
+        assert t.record(0)["k"] == "a-b"
+
+
+def run_cli(*argv):
+    from geomesa_tpu.cli.__main__ import main
+
+    main(list(argv))
+
+
+class TestCLI:
+    def test_end_to_end(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        # build a gdelt file
+        f = tmp_path / "g.tsv"
+        rows = []
+        for i in range(20):
+            parts = GDELT_ROW.split("\t")
+            parts[0] = str(i)
+            parts[39] = str(30 + i)  # lat spread
+            rows.append("\t".join(parts))
+        f.write_text("\n".join(rows))
+
+        run_cli("ingest", "-c", cat, "-n", "gdelt", "--converter", "gdelt", str(f))
+        out = capsys.readouterr().out
+        assert "ingested 20" in out
+
+        run_cli("get-type-names", "-c", cat)
+        assert "gdelt" in capsys.readouterr().out
+
+        run_cli("describe-schema", "-c", cat, "-n", "gdelt")
+        out = capsys.readouterr().out
+        assert "*geom" in out and "features: 20" in out
+
+        run_cli("explain", "-c", cat, "-n", "gdelt", "-q", "BBOX(geom, -80, 30, -70, 45)")
+        assert "Index:" in capsys.readouterr().out
+
+        run_cli(
+            "export", "-c", cat, "-n", "gdelt",
+            "-q", "BBOX(geom, -80, 30, -70, 45)", "--format", "json",
+            "-o", str(tmp_path / "out.json"),
+        )
+        lines = (tmp_path / "out.json").read_text().strip().splitlines()
+        assert len(lines) >= 1
+        assert json.loads(lines[0])["actor1Name"] == "UNITED STATES"
+
+        run_cli("stats-count", "-c", cat, "-n", "gdelt")
+        assert capsys.readouterr().out.strip() == "20"
+
+        run_cli("stats-top-k", "-c", cat, "-n", "gdelt", "-a", "actor1Name", "-k", "3")
+        assert "UNITED STATES" in capsys.readouterr().out
+
+        run_cli("stats-analyze", "-c", cat, "-n", "gdelt")
+        assert "count: 20" in capsys.readouterr().out
+
+        run_cli("version")
+        assert "geomesa-tpu" in capsys.readouterr().out
+
+    def test_export_arrow_and_bin(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        f = tmp_path / "g.tsv"
+        f.write_text(GDELT_ROW)
+        run_cli("ingest", "-c", cat, "-n", "g", "--converter", "gdelt", str(f))
+        capsys.readouterr()
+
+        run_cli("export", "-c", cat, "-n", "g", "--format", "arrow",
+                "-o", str(tmp_path / "o.arrow"))
+        capsys.readouterr()
+        data = (tmp_path / "o.arrow").read_bytes()
+        t = from_ipc_bytes(gdelt_sft("g"), data)
+        assert len(t) == 1
+
+        run_cli("export", "-c", cat, "-n", "g", "--format", "bin",
+                "--bin-track", "actor1Name", "-o", str(tmp_path / "o.bin"))
+        capsys.readouterr()
+        assert len((tmp_path / "o.bin").read_bytes()) == 16
+
+
+class TestReviewRegressions:
+    def test_arrow_null_point_roundtrip(self):
+        sft = parse_spec("np2", "p2:Point,dtg:Date,*geom:Point")
+        t = FeatureTable.from_records(
+            sft,
+            [
+                {"p2": Point(1, 2), "dtg": T0, "geom": Point(5, 5)},
+                {"p2": None, "dtg": T0, "geom": Point(6, 6)},
+            ],
+        )
+        t2 = from_arrow(sft, to_arrow(t))
+        assert t2.record(0)["p2"] == Point(1, 2)
+        assert t2.record(1)["p2"] is None  # not Point(nan, nan)
+
+    def test_converter_empty_optional_numeric(self, tmp_path):
+        sft = parse_spec("x", "a:Integer,s:Double,dtg:Date,*geom:Point")
+        conv = DelimitedConverter(
+            sft,
+            fields={"a": "int($1)", "s": "double($2)", "dtg": "millisToDate($3)",
+                    "geom": "point($4, $5)"},
+        )
+        f = tmp_path / "x.csv"
+        # row 1: empty optional double -> kept with null; row 2: garbage -> dropped
+        f.write_text("1,,1500000000000,10,20\n2,zzz,1500000000000,10,20\n")
+        ctx = EvaluationContext()
+        t = conv.convert_path(str(f), ctx)
+        assert len(t) == 1 and ctx.failure == 1
+        assert t.record(0)["s"] is None
+        assert t.record(0)["a"] == 1
+
+    def test_persistence_stale_cleanup(self, tmp_path):
+        cat = str(tmp_path / "cat")
+        ds = DataStore()
+        ds.create_schema("a", "dtg:Date,*geom:Point")
+        ds.create_schema("b", "dtg:Date,*geom:Point")
+        ds.write("a", [{"dtg": T0, "geom": Point(1, 1)}])
+        ds.write("b", [{"dtg": T0, "geom": Point(1, 1)}])
+        ds.save(cat)
+        ds.delete_schema("b")
+        ds.save(cat)
+        assert not (Path(cat) / "b").exists()
+        ds2 = DataStore.load(cat)
+        assert ds2.list_schemas() == ["a"]
+
+    def test_tube_on_linestring_schema(self):
+        from geomesa_tpu.geometry import LineString as LS
+        from geomesa_tpu.process.processes import tube_select
+
+        ds = DataStore()
+        ds.create_schema("ls", "dtg:Date,*geom:LineString")
+        ds.write("ls", [
+            {"dtg": T0 + 86_400_000, "geom": LS(np.array([[0.0, 0.0], [0.5, 0.5]]))},
+            {"dtg": T0 + 86_400_000, "geom": LS(np.array([[50.0, 50.0], [51.0, 51.0]]))},
+        ])
+        track = [(-1.0, -1.0, T0), (1.0, 1.0, T0 + 2 * 86_400_000)]
+        t = tube_select(ds, "ls", track, buffer_deg=1.0, time_buffer_ms=86_400_000)
+        assert len(t) == 1  # centroid of the first line is near the track

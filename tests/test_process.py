@@ -1,0 +1,142 @@
+"""Geoprocess tests: KNN, unique, proximity, tube-select, point2point, joins
+(reference: geomesa-process suites — SURVEY.md §2.15/§4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point, Polygon, box, from_wkt
+from geomesa_tpu.geometry import predicates as P
+from geomesa_tpu.process.join import join_within, join_within_device
+from geomesa_tpu.process.knn import knn
+from geomesa_tpu.process.processes import point2point, proximity, tube_select, unique
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(21)
+    n = 5000
+    lon = rng.uniform(-60, 60, n)
+    lat = rng.uniform(-60, 60, n)
+    t = T0 + rng.integers(0, 10 * 86_400_000, n)
+    recs = [
+        {"name": f"trk{i % 12}", "dtg": int(t[i]), "geom": Point(float(lon[i]), float(lat[i]))}
+        for i in range(n)
+    ]
+    store = DataStore(backend="tpu")
+    store.create_schema("p", SPEC)
+    store.write("p", recs, fids=[f"p.{i}" for i in range(n)])
+    return store
+
+
+class TestKNN:
+    def test_knn_exact(self, ds):
+        q = Point(10.0, 10.0)
+        table, dists = knn(ds, "p", q, k=15)
+        assert len(table) == 15
+        # compare against brute force over everything
+        r = ds.query("p", "INCLUDE")
+        col = r.table.geom_column()
+        all_d = np.sqrt((col.x - q.x) ** 2 + (col.y - q.y) ** 2)
+        expected = np.sort(all_d)[:15]
+        np.testing.assert_allclose(np.sort(dists), expected)
+        assert np.all(np.diff(dists) >= 0)
+
+    def test_knn_with_filter(self, ds):
+        table, _ = knn(ds, "p", Point(0.0, 0.0), k=5, filter="name = 'trk3'")
+        assert len(table) == 5
+        assert all(v == "trk3" for v in table.columns["name"].values)
+
+    def test_knn_more_than_available(self, ds):
+        table, _ = knn(ds, "p", Point(0.0, 0.0), k=3, filter="name = 'trk3' AND dtg BEFORE 2017-07-02T00:00:00Z")
+        # may be fewer matches than k in total; returns what exists
+        r = ds.query("p", "name = 'trk3' AND dtg BEFORE 2017-07-02T00:00:00Z")
+        assert len(table) == min(3, r.count)
+
+
+class TestUnique:
+    def test_unique_counts(self, ds):
+        vals = unique(ds, "p", "name")
+        assert len(vals) == 12
+        assert sum(c for _, c in vals) == 5000
+
+    def test_unique_filtered(self, ds):
+        vals = unique(ds, "p", "name", filter="BBOX(geom, 0, 0, 30, 30)")
+        total = ds.query("p", "BBOX(geom, 0, 0, 30, 30)").count
+        assert sum(c for _, c in vals) == total
+
+
+class TestProximity:
+    def test_proximity(self, ds):
+        t = proximity(ds, "p", [Point(5.0, 5.0)], 3.0)
+        r = ds.query("p", "INCLUDE")
+        col = r.table.geom_column()
+        d = np.sqrt((col.x - 5) ** 2 + (col.y - 5) ** 2)
+        assert len(t) == int((d <= 3.0).sum())
+
+
+class TestTube:
+    def test_tube_select(self, ds):
+        track = [
+            (-30.0, -30.0, T0 + 1 * 86_400_000),
+            (0.0, 0.0, T0 + 3 * 86_400_000),
+            (30.0, 30.0, T0 + 5 * 86_400_000),
+        ]
+        t = tube_select(ds, "p", track, buffer_deg=2.0, time_buffer_ms=86_400_000)
+        # every result is within 2 deg of the path and inside the time corridor
+        col = t.geom_column()
+        pts = np.asarray([(x, y) for x, y, _ in track])
+        from geomesa_tpu.geometry.types import LineString
+
+        path = LineString(pts)
+        d = np.sqrt(P.points_dist2_geom(col.x, col.y, path))
+        assert len(t) > 0
+        assert np.all(d <= 2.0 + 1e-9)
+        ts = t.dtg_millis()
+        assert ts.min() >= T0
+        assert ts.max() <= T0 + 6 * 86_400_000
+
+    def test_point2point(self, ds):
+        r = ds.query("p", "name = 'trk5'")
+        tracks = point2point(r.table, "dtg", "name")
+        assert "trk5" in tracks
+        line = tracks["trk5"]
+        assert len(line.coords) == r.count
+
+
+class TestJoin:
+    POLYS = [
+        box(0, 0, 20, 20),
+        box(-50, -50, -30, -30),
+        from_wkt("POLYGON ((30 30, 50 30, 50 50, 30 50, 30 30))"),
+        box(100, 100, 110, 110),  # empty (outside data range)
+    ]
+
+    def test_join_exact(self, ds):
+        out = join_within(ds, "p", self.POLYS)
+        r = ds.query("p", "INCLUDE")
+        col = r.table.geom_column()
+        for i, fids in out:
+            expected = P.points_within_geom(col.x, col.y, self.POLYS[i]).sum()
+            assert len(fids) == expected, f"polygon {i}"
+        assert len(out[3][1]) == 0
+
+    def test_join_device_matches_exact(self, ds):
+        exact = join_within(ds, "p", self.POLYS)
+        counts = join_within_device(ds, "p", self.POLYS)
+        for (i, fids), c in zip(exact, counts):
+            assert len(fids) == c, f"polygon {i}"  # data is far from edges (uniform random)
+
+    def test_join_device_scales_vertices(self, ds):
+        # a polygon with many vertices (circle approximation)
+        theta = np.linspace(0, 2 * np.pi, 33)
+        ring = np.stack([10 + 5 * np.cos(theta), 10 + 5 * np.sin(theta)], axis=1)
+        poly = Polygon(ring)
+        counts = join_within_device(ds, "p", [poly])
+        r = ds.query("p", "INCLUDE")
+        col = r.table.geom_column()
+        expected = int(P.points_within_geom(col.x, col.y, poly).sum())
+        assert abs(int(counts[0]) - expected) <= 2  # f32 edge tolerance
