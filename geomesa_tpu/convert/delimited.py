@@ -231,8 +231,14 @@ def _eval(expr: str, df, typ: AttributeType, conv) -> tuple[Column, np.ndarray]:
         (col_arg,) = _split_args(m.group(2))
         return Column(AttributeType.STRING, _raw(col_arg, df, conv)), np.zeros(n, bool)
 
-    # bare expression: raw string (or typed cast for numeric targets)
+    if fn in ("bool", "boolean"):
+        (col_arg,) = _split_args(m.group(2))
+        return _boolean_column(_raw(col_arg, df, conv))
+
+    # bare expression: raw string (or typed cast for typed targets)
     raw = _raw(expr, df, conv)
+    if typ == AttributeType.BOOLEAN:
+        return _boolean_column(raw)
     if typ in _NUMERIC_DTYPES:
         return _numeric_column(raw, typ)
     if typ == AttributeType.DATE:
@@ -264,3 +270,29 @@ def _date_column(raw: np.ndarray, parsed) -> tuple[Column, np.ndarray]:
     valid = ~nan
     col = Column(AttributeType.DATE, vals.astype(np.int64), None if valid.all() else valid)
     return col, nan & ~empty
+
+
+_TRUE = {"true", "t", "1", "yes", "y"}
+_FALSE = {"false", "f", "0", "no", "n"}
+
+
+def _boolean_column(raw: np.ndarray) -> tuple[Column, np.ndarray]:
+    """Boolean parse: true/false (& t/f/1/0/yes/no), empty→null, garbage→bad."""
+    n = len(raw)
+    vals = np.zeros(n, dtype=np.bool_)
+    valid = np.ones(n, dtype=bool)
+    bad = np.zeros(n, dtype=bool)
+    for i, s in enumerate(raw):
+        ls = s.strip().lower()
+        if ls in _TRUE:
+            vals[i] = True
+        elif ls in _FALSE:
+            vals[i] = False
+        elif ls == "":
+            valid[i] = False
+        else:
+            valid[i] = False
+            bad[i] = True
+    from geomesa_tpu.schema.sft import AttributeType as _AT
+
+    return Column(_AT.BOOLEAN, vals, None if valid.all() else valid), bad

@@ -68,6 +68,13 @@ def zranges(
     if any(h < l for l, h in zip(lows, highs)):
         return np.empty((0, 2), dtype=np.uint64)
 
+    # native (C++) fast path — bit-identical BFS, ~20-50x faster planning
+    from geomesa_tpu import native
+
+    r = native.zranges_native(lows, highs, precision, max_ranges, max_recurse)
+    if r is not None:
+        return r
+
     if dims == 2:
         encode = lambda c: int(zorder.encode2(np.uint64(c[0]), np.uint64(c[1])))
     elif dims == 3:

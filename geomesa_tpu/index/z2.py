@@ -49,7 +49,7 @@ class Z2Index(FeatureIndex):
         return perm
 
     def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
-        if e.disjoint:
+        if e.disjoint or self.n == 0:
             return IndexPlan.empty()
         if e.boxes is None:
             return IndexPlan.full(self.n)
@@ -83,7 +83,7 @@ class XZ2Index(FeatureIndex):
         return perm
 
     def plan(self, e: Extraction, max_ranges: int = DEFAULT_MAX_RANGES) -> IndexPlan:
-        if e.disjoint:
+        if e.disjoint or self.n == 0:
             return IndexPlan.empty()
         if e.boxes is None:
             return IndexPlan.full(self.n)

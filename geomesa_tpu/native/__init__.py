@@ -1,0 +1,105 @@
+"""Native (C++) planner components, loaded via ctypes.
+
+Build-on-first-use: ``g++ -O2`` compiles :file:`zranges.cpp` into the package
+directory the first time it's needed (cached by mtime); everything degrades to
+the pure-Python implementations when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "zranges.cpp"
+_LIB = _DIR / "libzranges.so"
+
+_lib = None
+_load_failed = False
+
+
+def _ensure_built() -> bool:
+    if _LIB.exists() and (
+        not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime
+    ):
+        return True  # prebuilt .so shipped without source is fine
+    if not _SRC.exists():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if not _ensure_built():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        lib.geomesa_zranges.restype = ctypes.c_long
+        lib.geomesa_zranges.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_long,
+        ]
+        _lib = lib
+    except OSError:
+        _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def zranges_native(
+    lows, highs, precision: int, max_ranges: int = 2000, max_recurse: int = 32
+):
+    """C++ z-range decomposition; returns (R, 2) uint64 or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    dims = len(lows)
+    lo = (ctypes.c_uint64 * dims)(*[int(v) for v in lows])
+    hi = (ctypes.c_uint64 * dims)(*[int(v) for v in highs])
+    cap = max(int(max_ranges) * 4 + 64, 256)
+    out = np.empty(cap * 2, dtype=np.uint64)
+    n = lib.geomesa_zranges(
+        dims,
+        lo,
+        hi,
+        precision,
+        int(max_ranges),
+        int(max_recurse),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cap,
+    )
+    if n < 0:  # output buffer too small: retry once with a big buffer
+        cap = cap * 8
+        out = np.empty(cap * 2, dtype=np.uint64)
+        n = lib.geomesa_zranges(
+            dims, lo, hi, precision, int(max_ranges), int(max_recurse),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), cap,
+        )
+        if n < 0:
+            return None
+    return out[: 2 * n].reshape(n, 2).copy()

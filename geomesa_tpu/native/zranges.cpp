@@ -1,0 +1,157 @@
+// Native z-range decomposition: cover a query box with Morton-curve intervals.
+//
+// The C++ core of the planner's hot spot (the external sfcurve library role —
+// SURVEY.md §2.1 "CRITICAL external dependency"): a BFS over the implicit
+// quad/oct tree of Morton prefix cells, bit-identical to the Python fallback
+// in geomesa_tpu/curve/zranges.py (the tests assert exact agreement). Exposed
+// through ctypes (geomesa_tpu/native/__init__.py builds and loads it).
+//
+// Build: g++ -O2 -shared -fPIC -o libzranges.so zranges.cpp
+
+#include <cstdint>
+#include <vector>
+#include <algorithm>
+#include <cstring>
+
+namespace {
+
+struct Cell {
+    uint64_t dims[3];
+    int level;
+};
+
+inline uint64_t spread2(uint64_t x) {
+    x &= 0x00000000FFFFFFFFULL;
+    x = (x | (x << 16)) & 0x0000FFFF0000FFFFULL;
+    x = (x | (x << 8)) & 0x00FF00FF00FF00FFULL;
+    x = (x | (x << 4)) & 0x0F0F0F0F0F0F0F0FULL;
+    x = (x | (x << 2)) & 0x3333333333333333ULL;
+    x = (x | (x << 1)) & 0x5555555555555555ULL;
+    return x;
+}
+
+inline uint64_t spread3(uint64_t x) {
+    x &= 0x00000000001FFFFFULL;
+    x = (x | (x << 32)) & 0x001F00000000FFFFULL;
+    x = (x | (x << 16)) & 0x001F0000FF0000FFULL;
+    x = (x | (x << 8)) & 0x100F00F00F00F00FULL;
+    x = (x | (x << 4)) & 0x10C30C30C30C30C3ULL;
+    x = (x | (x << 2)) & 0x1249249249249249ULL;
+    return x;
+}
+
+inline uint64_t encode(int dims, const uint64_t* v) {
+    if (dims == 2) return spread2(v[0]) | (spread2(v[1]) << 1);
+    return spread3(v[0]) | (spread3(v[1]) << 1) | (spread3(v[2]) << 2);
+}
+
+// classify cell vs box per dim: 0 disjoint, 1 overlap, 2 contained
+inline int classify(const Cell& c, int dims, int precision,
+                    const uint64_t* lows, const uint64_t* highs) {
+    int s = precision - c.level;
+    bool contained = true;
+    for (int d = 0; d < dims; d++) {
+        uint64_t clo = c.dims[d] << s;
+        uint64_t chi = clo | ((s >= 64) ? ~0ULL : ((1ULL << s) - 1));
+        if (chi < lows[d] || clo > highs[d]) return 0;
+        if (clo < lows[d] || chi > highs[d]) contained = false;
+    }
+    return contained ? 2 : 1;
+}
+
+inline void emit(std::vector<std::pair<uint64_t, uint64_t>>& out, const Cell& c,
+                 int dims, int precision) {
+    int s = precision - c.level;
+    uint64_t corner[3];
+    for (int d = 0; d < dims; d++) corner[d] = c.dims[d] << s;
+    uint64_t zlo = encode(dims, corner);
+    uint64_t span = (dims * s >= 64) ? ~0ULL : ((1ULL << (dims * s)) - 1);
+    out.emplace_back(zlo, zlo | span);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of (lo, hi) pairs written to `out` (capacity `cap`
+// pairs), or -1 if `out` was too small. Inclusive uint64 intervals, sorted
+// and merged. Inverted boxes return 0.
+long geomesa_zranges(int dims, const uint64_t* lows, const uint64_t* highs,
+                     int precision, long max_ranges, long max_recurse,
+                     uint64_t* out, long cap) {
+    if (dims < 2 || dims > 3 || precision < 1 || precision > 31) return -1;
+    for (int d = 0; d < dims; d++)
+        if (highs[d] < lows[d]) return 0;
+
+    // whole-domain short-circuit
+    uint64_t full = (1ULL << precision) - 1;
+    bool whole = true;
+    for (int d = 0; d < dims; d++)
+        if (lows[d] != 0 || highs[d] != full) { whole = false; break; }
+    if (whole) {
+        if (cap < 1) return -1;
+        out[0] = 0;
+        out[1] = (dims * precision >= 64) ? ~0ULL : ((1ULL << (dims * precision)) - 1);
+        return 1;
+    }
+
+    int max_level = precision < max_recurse ? precision : (int)max_recurse;
+    std::vector<std::pair<uint64_t, uint64_t>> ranges;
+    ranges.reserve(max_ranges > 0 ? max_ranges : 64);
+    std::vector<Cell> frontier;
+    frontier.push_back(Cell{{0, 0, 0}, 0});
+    size_t head = 0;
+
+    while (head < frontier.size()) {
+        long remaining = (long)(frontier.size() - head);
+        if ((long)ranges.size() + remaining >= max_ranges) {
+            // budget: drain, still classifying so disjoint cells are dropped
+            for (; head < frontier.size(); head++) {
+                const Cell& c = frontier[head];
+                if (classify(c, dims, precision, lows, highs) != 0)
+                    emit(ranges, c, dims, precision);
+            }
+            break;
+        }
+        Cell c = frontier[head++];
+        int cls = classify(c, dims, precision, lows, highs);
+        if (cls == 0) continue;
+        if (cls == 2 || c.level >= max_level) {
+            emit(ranges, c, dims, precision);
+            continue;
+        }
+        for (int child = 0; child < (1 << dims); child++) {
+            Cell nc;
+            nc.level = c.level + 1;
+            for (int d = 0; d < dims; d++)
+                nc.dims[d] = (c.dims[d] << 1) | ((child >> d) & 1);
+            frontier.push_back(nc);
+        }
+        // compact the consumed prefix occasionally to bound memory
+        if (head > 4096) {
+            frontier.erase(frontier.begin(), frontier.begin() + head);
+            head = 0;
+        }
+    }
+
+    std::sort(ranges.begin(), ranges.end());
+    long n = 0;
+    for (size_t i = 0; i < ranges.size(); i++) {
+        if (n > 0 && ranges[i].first <= out[2 * (n - 1) + 1] + 1 &&
+            (out[2 * (n - 1) + 1] != ~0ULL)) {
+            uint64_t hi = ranges[i].second;
+            if (hi > out[2 * (n - 1) + 1]) out[2 * (n - 1) + 1] = hi;
+        } else if (n > 0 && ranges[i].first <= out[2 * (n - 1) + 1]) {
+            uint64_t hi = ranges[i].second;
+            if (hi > out[2 * (n - 1) + 1]) out[2 * (n - 1) + 1] = hi;
+        } else {
+            if (n >= cap) return -1;
+            out[2 * n] = ranges[i].first;
+            out[2 * n + 1] = ranges[i].second;
+            n++;
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -51,6 +51,26 @@ def pack_polygons(polygons, max_vertices: int = 64):
     return verts, bbox, nverts
 
 
+def _membership(x, y, ring, bb):
+    """(N,) bool: points inside one closed ring ∩ its bbox (crossing number).
+
+    The single shared kernel body — count and mask variants derive from it so
+    precision/edge fixes can never diverge between them.
+    """
+    in_bb = (x >= bb[0]) & (x <= bb[2]) & (y >= bb[1]) & (y <= bb[3])
+    x1 = ring[:-1, 0][:, None]  # (V-1, 1)
+    y1 = ring[:-1, 1][:, None]
+    x2 = ring[1:, 0][:, None]
+    y2 = ring[1:, 1][:, None]
+    straddle = (y1 > y[None, :]) != (y2 > y[None, :])
+    dy = y2 - y1
+    safe_dy = jnp.where(dy == 0, 1.0, dy)
+    xint = x1 + (y[None, :] - y1) * (x2 - x1) / safe_dy
+    crossing = straddle & (x[None, :] < xint)
+    inside = (crossing.sum(axis=0) % 2).astype(bool)
+    return inside & in_bb
+
+
 @jax.jit
 def points_in_polygons_count(x, y, verts, bbox):
     """Counts of points strictly inside each polygon (f32 crossing number).
@@ -62,41 +82,13 @@ def points_in_polygons_count(x, y, verts, bbox):
 
     Returns (K,) int32 counts. jittable / shard_map-able (psum the counts).
     """
-
-    def one(poly):
-        ring, bb = poly
-        in_bb = (x >= bb[0]) & (x <= bb[2]) & (y >= bb[1]) & (y <= bb[3])
-        x1 = ring[:-1, 0][:, None]  # (V-1, 1)
-        y1 = ring[:-1, 1][:, None]
-        x2 = ring[1:, 0][:, None]
-        y2 = ring[1:, 1][:, None]
-        straddle = (y1 > y[None, :]) != (y2 > y[None, :])
-        dy = y2 - y1
-        safe_dy = jnp.where(dy == 0, 1.0, dy)
-        xint = x1 + (y[None, :] - y1) * (x2 - x1) / safe_dy
-        crossing = straddle & (x[None, :] < xint)
-        inside = (crossing.sum(axis=0) % 2).astype(bool)
-        return (inside & in_bb).sum(dtype=jnp.int32)
-
-    return jax.lax.map(one, (verts, bbox))
+    return jax.lax.map(
+        lambda poly: _membership(x, y, poly[0], poly[1]).sum(dtype=jnp.int32),
+        (verts, bbox),
+    )
 
 
 @jax.jit
 def points_in_polygons_mask(x, y, verts, bbox):
     """(K, N) bool membership masks — for small K where the full matrix fits."""
-
-    def one(poly):
-        ring, bb = poly
-        in_bb = (x >= bb[0]) & (x <= bb[2]) & (y >= bb[1]) & (y <= bb[3])
-        x1 = ring[:-1, 0][:, None]
-        y1 = ring[:-1, 1][:, None]
-        x2 = ring[1:, 0][:, None]
-        y2 = ring[1:, 1][:, None]
-        straddle = (y1 > y[None, :]) != (y2 > y[None, :])
-        dy = y2 - y1
-        safe_dy = jnp.where(dy == 0, 1.0, dy)
-        xint = x1 + (y[None, :] - y1) * (x2 - x1) / safe_dy
-        crossing = straddle & (x[None, :] < xint)
-        return (crossing.sum(axis=0) % 2).astype(bool) & in_bb
-
-    return jax.lax.map(one, (verts, bbox))
+    return jax.lax.map(lambda poly: _membership(x, y, poly[0], poly[1]), (verts, bbox))

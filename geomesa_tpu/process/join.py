@@ -44,6 +44,7 @@ def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):
 
     from geomesa_tpu.ops.join import pack_polygons, points_in_polygons_count
 
+    ds.compact(type_name)  # bulk path scans the main tier only
     st = ds._state(type_name)
     if st.table is None or len(st.table) == 0:
         return np.zeros(len(polygons), dtype=np.int32)

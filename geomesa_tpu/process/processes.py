@@ -32,12 +32,10 @@ def proximity(ds, type_name: str, geometries, distance_deg: float, filter=None):
     (``ProximitySearchProcess`` role): bbox-expanded index scan + exact
     distance refine."""
     sft = ds.get_schema(type_name)
-    parts = []
-    for g in geometries:
-        x1, y1, x2, y2 = g.bbox
-        parts.append(
-            ast.SpatialOp("dwithin", sft.geom_field, g, distance=distance_deg)
-        )
+    parts = [
+        ast.SpatialOp("dwithin", sft.geom_field, g, distance=distance_deg)
+        for g in geometries
+    ]
     f = parts[0] if len(parts) == 1 else ast.Or(parts)
     if filter is not None:
         from geomesa_tpu.filter.cql import parse

@@ -52,10 +52,25 @@ class QueryResult:
 @dataclass
 class _TypeState:
     sft: FeatureType
-    table: FeatureTable | None = None
+    table: FeatureTable | None = None  # main (sorted, device-resident) tier
     indices: dict[str, FeatureIndex] = field(default_factory=dict)
     backend_state: Any = None
     stats: Any = None  # StoreStats
+    delta: Any = None  # DeltaTier (hot append buffer)
+
+    def __post_init__(self):
+        if self.delta is None:
+            from geomesa_tpu.store.delta import DeltaTier
+
+            self.delta = DeltaTier()
+
+    @property
+    def main_rows(self) -> int:
+        return 0 if self.table is None else len(self.table)
+
+    @property
+    def total_rows(self) -> int:
+        return self.main_rows + self.delta.rows
 
 
 class DataStore:
@@ -92,28 +107,41 @@ class DataStore:
             raise KeyError(f"no such schema: {name!r}")
         return self._types[name]
 
-    # -- writes (GeoMesaFeatureWriter role; bulk semantics) ------------------
+    # -- writes (GeoMesaFeatureWriter + lambda hot-tier roles) ---------------
     def write(self, type_name: str, data, fids=None) -> int:
-        """Append features (FeatureTable or list of record dicts); rebuilds
-        index order and backend state for the new snapshot.
+        """Append features (FeatureTable or list of record dicts).
 
-        Validation before commit (the reference's all-indices-validate-before-
-        write pattern, ``IndexAdapter.scala:139-149``): rows with a null
-        default geometry or null dtg are rejected — and state is only swapped
-        in after every index builds successfully, so a failed write never
-        leaves the store half-applied.
+        Writes land in the hot delta tier (immediately queryable, scanned
+        brute-force) and are merged into the sorted main tier when the delta
+        passes the compaction threshold — the lambda-architecture pattern
+        (SURVEY.md §2.11). Validation before commit (the reference's
+        all-indices-validate-before-write pattern, ``IndexAdapter.scala:
+        139-149``): rows with a null default geometry or null dtg are
+        rejected, and main-tier state only swaps in after every index builds,
+        so a failed write never leaves the store half-applied.
         """
         st = self._state(type_name)
         if isinstance(data, list):
             if fids is None:
-                base = 0 if st.table is None else len(st.table)
+                base = st.total_rows
                 fids = [f"{type_name}.{base + i}" for i in range(len(data))]
             data = FeatureTable.from_records(st.sft, data, fids)
         self._validate(st.sft, data)
+        st.delta.append(data)
+        if st.delta.should_compact(st.main_rows):
+            self.compact(type_name)
+        return len(data)
+
+    def compact(self, type_name: str) -> None:
+        """Merge the delta tier into the sorted main tier (re-sort + device
+        reload + stats rebuild). Atomic: state swaps only on success."""
+        st = self._state(type_name)
+        delta = st.delta.merged()
+        if delta is None:
+            return
         table = (
-            data if st.table is None else FeatureTable.concat([st.table, data])
+            delta if st.table is None else FeatureTable.concat([st.table, delta])
         )
-        # build into fresh index instances; commit only on success (atomic)
         indices = build_indices(st.sft)
         for index in indices.values():
             index.build(table)
@@ -126,7 +154,7 @@ class DataStore:
         st.indices = indices
         st.backend_state = backend_state
         st.stats = stats
-        return len(data)
+        st.delta.clear()
 
     @staticmethod
     def _validate(sft: FeatureType, table: FeatureTable) -> None:
@@ -159,15 +187,18 @@ class DataStore:
                 "pass query options inside the Query object, not as kwargs: "
                 f"{sorted(kwargs)}"
             )
-        if st.table is None or len(st.table) == 0:
+        if st.total_rows == 0:
             empty = FeatureTable.from_records(st.sft, [])
             return QueryResult(empty, np.empty(0, dtype=np.int64))
 
         f = q.resolved_filter()
-        if isinstance(self.backend, OracleBackend):
+        info = None
+        main_n = st.main_rows
+        if main_n == 0:
+            rows = np.empty(0, dtype=np.int64)
+        elif isinstance(self.backend, OracleBackend):
             # referee path: no planning, brute force
             rows = self.backend.select(None, None, None, None, f, st.table)
-            info = None
         else:
             planner = QueryPlanner(st.sft, st.indices, st.stats)
             plan, f, info = planner.plan(q)
@@ -175,16 +206,28 @@ class DataStore:
             rows = self.backend.select(
                 st.backend_state, index, plan, info.extraction, f, st.table
             )
+        rows = np.sort(rows)
 
-        rows = np.sort(rows)  # deterministic order before transforms
+        # hot-tier merge (LambdaQueryRunner role): brute-force the small
+        # unsorted delta and append, with row ids offset past the main tier
+        delta_table = st.delta.merged()
+        if delta_table is not None:
+            dmask = f.mask(delta_table)
+            drows = np.nonzero(dmask)[0]
+            if main_n == 0:
+                rows = drows + main_n
+            else:
+                rows = np.concatenate([rows, drows + main_n])
+
+        table = _take_combined(st, delta_table, rows)
 
         # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of
         # matches, optionally per-group (deterministic every-nth)
         sample = q.hints.get("sample")
         if sample:
-            rows = _sample_rows(st.table, rows, float(sample), q.hints.get("sample_by"))
-
-        table = st.table.take(rows)
+            keep = _sample_rows(table, np.arange(len(table)), float(sample), q.hints.get("sample_by"))
+            table = table.take(keep)
+            rows = rows[keep]
 
         # aggregation hints (density/stats/bin push-down flavors)
         density = stats_out = bin_data = None
@@ -231,11 +274,13 @@ class DataStore:
     def stats_count(self, type_name: str, cql: str | None = None, exact: bool = False):
         """Row count: stored total, sketch estimate, or exact via query."""
         st = self._state(type_name)
-        if st.table is None:
+        if st.total_rows == 0:
             return 0
         if cql is None:
-            return len(st.table)
+            return st.total_rows
         if exact:
+            return self.query(type_name, cql).count
+        if st.stats is None:  # only delta-tier data so far: count it exactly
             return self.query(type_name, cql).count
         from geomesa_tpu.curve.binned_time import BinnedTime
         from geomesa_tpu.curve.sfc import z3_sfc
@@ -252,6 +297,11 @@ class DataStore:
         for name, bounds in e.attributes.items():
             if bounds is not None:
                 est = min(est, st.stats.estimate_attr(name, bounds))
+        # stats cover the main tier only; the hot delta is small enough to
+        # count exactly so fresh writes stay visible to estimates
+        delta_table = st.delta.merged()
+        if delta_table is not None:
+            est += float(_parse(cql).mask(delta_table).sum())
         return est
 
     # -- persistence (checkpoint/resume) -------------------------------------
@@ -285,6 +335,21 @@ class DataStore:
 
     def stats_cardinality(self, type_name: str, attr: str) -> float:
         return self._stats(type_name).cardinality(attr)
+
+
+def _take_combined(st, delta_table, rows: np.ndarray) -> FeatureTable:
+    """Materialize rows addressed in the virtual (main ++ delta) row space."""
+    main_n = st.main_rows
+    parts = []
+    main_sel = rows[rows < main_n]
+    delta_sel = rows[rows >= main_n] - main_n
+    if len(main_sel):
+        parts.append(st.table.take(main_sel))
+    if len(delta_sel):
+        parts.append(delta_table.take(delta_sel))
+    if not parts:
+        return FeatureTable.from_records(st.sft, [])
+    return parts[0] if len(parts) == 1 else FeatureTable.concat(parts)
 
 
 def _sample_rows(table, rows, fraction, sample_by):

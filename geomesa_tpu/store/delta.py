@@ -1,0 +1,55 @@
+"""Streaming hot tier: append buffer + background-style compaction.
+
+The lambda-architecture role (``geomesa-lambda`` — SURVEY.md §2.11) and the
+Kafka live-cache role (§2.10): recent writes land in a small, unsorted
+*delta tier* that is scanned brute-force (it is the "transient tier"), while
+the bulk of the data lives in the sorted, device-resident *main tier*.
+Compaction merges the delta into the main tier (one global re-sort + device
+reload) when it grows past a threshold — the LSM-ish pattern SURVEY.md §7
+flags for sorted ingest under appends.
+
+Queries = main-tier index scan ∪ delta-tier vectorized filter; both sides
+already produce row-id sets, so the merge is a concatenation (the
+``LambdaQueryRunner`` merged-read role).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from geomesa_tpu.schema.columnar import FeatureTable
+
+DEFAULT_COMPACT_FRACTION = 0.25  # compact when delta > 25% of main
+DEFAULT_COMPACT_MIN_ROWS = 100_000  # ... or when delta alone exceeds this
+
+
+@dataclass
+class DeltaTier:
+    """Unsorted append buffer for one feature type."""
+
+    tables: list[FeatureTable] = field(default_factory=list)
+    rows: int = 0
+
+    def append(self, table: FeatureTable) -> None:
+        self.tables.append(table)
+        self.rows += len(table)
+
+    def merged(self) -> FeatureTable | None:
+        if not self.tables:
+            return None
+        if len(self.tables) > 1:
+            self.tables = [FeatureTable.concat(self.tables)]
+        return self.tables[0]
+
+    def clear(self) -> None:
+        self.tables = []
+        self.rows = 0
+
+    def should_compact(self, main_rows: int) -> bool:
+        if self.rows == 0:
+            return False
+        if self.rows >= DEFAULT_COMPACT_MIN_ROWS:
+            return True
+        return self.rows > max(1024, int(main_rows * DEFAULT_COMPACT_FRACTION))

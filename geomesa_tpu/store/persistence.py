@@ -37,7 +37,9 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
     manifest = {"version": FORMAT_VERSION, "types": {}}
+    staged: list[tuple[Path, Path]] = []  # (tmp, final) shard renames
     for name in ds.list_schemas():
+        ds.compact(name)  # fold the hot tier in so the catalog is fully sorted
         st = ds._state(name)
         tdir = root / name
         tdir.mkdir(exist_ok=True)
@@ -49,25 +51,37 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
             for key, rows in parts.items():
                 at = to_arrow(st.table.take(rows))
                 fn = f"part-{key}.parquet"
-                pq.write_table(at, tdir / fn)
+                tmp = tdir / (fn + ".tmp")
+                pq.write_table(at, tmp)
+                staged.append((tmp, tdir / fn))
                 files.append({"file": fn, "rows": int(len(rows)), "partition": str(key)})
         manifest["types"][name] = {
             "spec": st.sft.to_spec(),
             "count": count,
             "files": files,
         }
-        # drop stale shards from prior saves (compaction = manifest + files)
-        keep = {f["file"] for f in files}
-        for p in tdir.glob("part-*.parquet"):
+
+    # crash-safe commit order: new shards land under temp names above; only
+    # once all writes succeed do we rename them into place, replace the
+    # manifest atomically, and lastly garbage-collect stale files — a crash at
+    # any point leaves either the old or the new checkpoint loadable
+    for tmp, final in staged:
+        os.replace(tmp, final)
+    mtmp = root / (MANIFEST + ".tmp")
+    mtmp.write_text(json.dumps(manifest, indent=2))
+    os.replace(mtmp, root / MANIFEST)
+
+    for name, meta in manifest["types"].items():
+        keep = {f["file"] for f in meta["files"]}
+        tdir = root / name
+        for p in tdir.glob("part-*.parquet*"):
             if p.name not in keep:
                 p.unlink()
-    # drop directories of schemas that no longer exist
     for p in root.iterdir():
         if p.is_dir() and p.name not in manifest["types"]:
             import shutil
 
             shutil.rmtree(p)
-    (root / MANIFEST).write_text(json.dumps(manifest, indent=2))
     return manifest
 
 
@@ -105,4 +119,5 @@ def load(path: str, backend: str = "tpu"):
         if tables:
             table = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
             ds.write(name, table)
+            ds.compact(name)  # restored data is the main tier, not hot writes
     return ds

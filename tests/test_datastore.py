@@ -258,3 +258,54 @@ class TestWriteValidation:
             c = FeatureTable.concat(order)
             got = {c.record(0)["geom"], c.record(1)["geom"]}
             assert got == {Point(1, 2), Point(3, 4)}
+
+
+class TestDeltaTier:
+    """Streaming hot tier (lambda role): immediate queryability + compaction."""
+
+    def test_small_writes_stay_hot_and_query(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("d", "name:String,dtg:Date,*geom:Point")
+        for i in range(5):
+            ds.write("d", [{"name": f"n{i}", "dtg": T0 + i * 1000, "geom": Point(i, i)}])
+        st = ds._state("d")
+        assert st.delta.rows == 5 and st.main_rows == 0  # below threshold
+        assert ds.query("d", "INCLUDE").count == 5
+        assert ds.query("d", "BBOX(geom, 1.5, 1.5, 3.5, 3.5)").count == 2
+        assert ds.query("d", "name = 'n4'").count == 1
+
+    def test_mixed_tiers_query(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("m", "dtg:Date,*geom:Point")
+        bulk = [{"dtg": T0 + i, "geom": Point(i * 0.01, i * 0.01)} for i in range(2000)]
+        ds.write("m", bulk)  # over threshold -> compacted into main
+        st = ds._state("m")
+        assert st.main_rows == 2000 and st.delta.rows == 0
+        ds.write("m", [{"dtg": T0, "geom": Point(5.0, 5.0)}])  # hot
+        assert st.delta.rows == 1
+        r = ds.query("m", "BBOX(geom, 4.9, 4.9, 19.99, 19.99)")
+        # main-tier matches (x in [4.9, 19.99]) + the hot row
+        assert r.count == 1 + sum(1 for i in range(2000) if 4.9 <= i * 0.01 <= 19.99)
+
+    def test_explicit_compact(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema("c", "dtg:Date,*geom:Point")
+        ds.write("c", [{"dtg": T0, "geom": Point(1, 1)}])
+        ds.compact("c")
+        st = ds._state("c")
+        assert st.main_rows == 1 and st.delta.rows == 0
+        assert ds.query("c", "INCLUDE").count == 1
+
+    def test_delta_parity_with_oracle(self):
+        recs = point_records(300)
+        oracle = DataStore(backend="oracle")
+        tpu = DataStore(backend="tpu")
+        for ds in (oracle, tpu):
+            ds.create_schema("dp", SPEC)
+            # drip-feed so some data stays in the delta tier
+            for i in range(0, 300, 50):
+                ds.write("dp", recs[i : i + 50], fids=[f"dp.{j}" for j in range(i, i + 50)])
+        for cql in QUERIES[:8]:
+            a = set(oracle.query("dp", cql).table.fids.tolist())
+            b = set(tpu.query("dp", cql).table.fids.tolist())
+            assert a == b, f"delta parity failure for {cql!r}"

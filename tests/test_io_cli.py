@@ -303,3 +303,37 @@ class TestReviewRegressions:
         track = [(-1.0, -1.0, T0), (1.0, 1.0, T0 + 2 * 86_400_000)]
         t = tube_select(ds, "ls", track, buffer_deg=1.0, time_buffer_ms=86_400_000)
         assert len(t) == 1  # centroid of the first line is near the track
+
+    def test_converter_boolean(self, tmp_path):
+        sft = parse_spec("b", "flag:Boolean,dtg:Date,*geom:Point")
+        conv = DelimitedConverter(
+            sft, fields={"flag": "$1", "dtg": "millisToDate($2)", "geom": "point($3, $4)"}
+        )
+        f = tmp_path / "b.csv"
+        f.write_text("true,1500000000000,1,1\nfalse,1500000000000,2,2\n,1500000000000,3,3\nxx,1500000000000,4,4\n")
+        ctx = EvaluationContext()
+        t = conv.convert_path(str(f), ctx)
+        assert len(t) == 3 and ctx.failure == 1  # 'xx' dropped, empty -> null
+        assert t.record(0)["flag"] is True
+        assert t.record(1)["flag"] is False
+        assert t.record(2)["flag"] is None
+        to_arrow(t)  # must not raise
+
+    def test_atomic_save_leaves_loadable_catalog(self, tmp_path):
+        cat = str(tmp_path / "cat")
+        ds = DataStore()
+        ds.create_schema("a", "dtg:Date,*geom:Point")
+        ds.write("a", [{"dtg": T0, "geom": Point(1, 1)}])
+        ds.save(cat)
+        # no temp droppings after a clean save
+        assert not list(Path(cat).rglob("*.tmp"))
+        assert DataStore.load(cat).query("a", "INCLUDE").count == 1
+
+    def test_stats_estimate_sees_delta(self):
+        ds = DataStore()
+        ds.create_schema("sd", "dtg:Date,*geom:Point")
+        bulk = [{"dtg": T0, "geom": Point(i * 0.01, 0.0)} for i in range(2000)]
+        ds.write("sd", bulk)  # compacts
+        ds.write("sd", [{"dtg": T0, "geom": Point(150.0, 80.0)}])  # hot
+        est = ds.stats_count("sd", "BBOX(geom, 149, 79, 151, 81)")
+        assert est >= 1  # the delta-only row is visible to estimates

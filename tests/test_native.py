@@ -1,0 +1,69 @@
+"""Native C++ zranges: exact agreement with the pure-Python BFS + speedup."""
+
+import time
+
+import numpy as np
+import pytest
+
+import importlib
+
+from geomesa_tpu import native
+
+# the curve package re-exports the zranges *function*, which shadows the
+# submodule on `from ... import`; load the module explicitly
+zr_mod = importlib.import_module("geomesa_tpu.curve.zranges")
+
+
+def python_zranges(lows, highs, precision, max_ranges=2000):
+    """Call the pure-Python path directly (bypassing the native fast path)."""
+    native_fn = native.zranges_native
+    native.zranges_native = lambda *a, **k: None
+    try:
+        return zr_mod.zranges(lows, highs, precision, max_ranges)
+    finally:
+        native.zranges_native = native_fn
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+class TestNativeZRanges:
+    def test_exact_agreement_2d(self, rng):
+        for _ in range(25):
+            lo = rng.integers(0, 1 << 20, size=2)
+            ext = rng.integers(1, 1 << 16, size=2)
+            lows = tuple(int(v) for v in lo)
+            highs = tuple(int(a + b) for a, b in zip(lo, ext))
+            for budget in (16, 200, 2000):
+                a = native.zranges_native(lows, highs, 31, budget)
+                b = python_zranges(lows, highs, 31, budget)
+                np.testing.assert_array_equal(a, b, err_msg=f"{lows} {highs} {budget}")
+
+    def test_exact_agreement_3d(self, rng):
+        for _ in range(15):
+            lo = rng.integers(0, 1 << 12, size=3)
+            ext = rng.integers(1, 1 << 9, size=3)
+            lows = tuple(int(v) for v in lo)
+            highs = tuple(int(a + b) for a, b in zip(lo, ext))
+            a = native.zranges_native(lows, highs, 21, 500)
+            b = python_zranges(lows, highs, 21, 500)
+            np.testing.assert_array_equal(a, b)
+
+    def test_full_domain(self):
+        m = (1 << 31) - 1
+        r = native.zranges_native((0, 0), (m, m), 31)
+        assert r.shape == (1, 2) and int(r[0, 1]) == (1 << 62) - 1
+
+    def test_inverted_box(self):
+        assert len(native.zranges_native((10, 10), (5, 5), 31)) == 0
+
+    def test_speedup(self):
+        lows, highs = (100_000, 200_000), (900_000, 700_000)
+        native.zranges_native(lows, highs, 31, 2000)  # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            native.zranges_native(lows, highs, 31, 2000)
+        t_native = (time.perf_counter() - t0) / 5
+        t0 = time.perf_counter()
+        t_py = None
+        python_zranges(lows, highs, 31, 2000)
+        t_py = time.perf_counter() - t0
+        assert t_native < t_py  # typically 20-50x
