@@ -278,21 +278,10 @@ _FALSE = {"false", "f", "0", "no", "n"}
 
 def _boolean_column(raw: np.ndarray) -> tuple[Column, np.ndarray]:
     """Boolean parse: true/false (& t/f/1/0/yes/no), empty→null, garbage→bad."""
-    n = len(raw)
-    vals = np.zeros(n, dtype=np.bool_)
-    valid = np.ones(n, dtype=bool)
-    bad = np.zeros(n, dtype=bool)
-    for i, s in enumerate(raw):
-        ls = s.strip().lower()
-        if ls in _TRUE:
-            vals[i] = True
-        elif ls in _FALSE:
-            vals[i] = False
-        elif ls == "":
-            valid[i] = False
-        else:
-            valid[i] = False
-            bad[i] = True
-    from geomesa_tpu.schema.sft import AttributeType as _AT
-
-    return Column(_AT.BOOLEAN, vals, None if valid.all() else valid), bad
+    low = np.char.lower(np.char.strip(raw.astype(str)))
+    vals = np.isin(low, sorted(_TRUE))
+    is_false = np.isin(low, sorted(_FALSE))
+    empty = low == ""
+    valid = vals | is_false
+    bad = ~valid & ~empty
+    return Column(AttributeType.BOOLEAN, vals, None if valid.all() else valid), bad

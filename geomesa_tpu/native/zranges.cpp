@@ -137,11 +137,11 @@ long geomesa_zranges(int dims, const uint64_t* lows, const uint64_t* highs,
     std::sort(ranges.begin(), ranges.end());
     long n = 0;
     for (size_t i = 0; i < ranges.size(); i++) {
-        if (n > 0 && ranges[i].first <= out[2 * (n - 1) + 1] + 1 &&
-            (out[2 * (n - 1) + 1] != ~0ULL)) {
-            uint64_t hi = ranges[i].second;
-            if (hi > out[2 * (n - 1) + 1]) out[2 * (n - 1) + 1] = hi;
-        } else if (n > 0 && ranges[i].first <= out[2 * (n - 1) + 1]) {
+        // overflow-safe adjacency: merge when first <= prev_hi, or when
+        // first == prev_hi + 1 and prev_hi + 1 does not wrap past 2^64-1
+        if (n > 0 && (ranges[i].first <= out[2 * (n - 1) + 1] ||
+                      (out[2 * (n - 1) + 1] != ~0ULL &&
+                       ranges[i].first <= out[2 * (n - 1) + 1] + 1))) {
             uint64_t hi = ranges[i].second;
             if (hi > out[2 * (n - 1) + 1]) out[2 * (n - 1) + 1] = hi;
         } else {

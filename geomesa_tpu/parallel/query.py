@@ -123,11 +123,20 @@ def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
     return in_box & in_time & rows_valid[None, :]
 
 
-def make_batched_count_step(mesh: Mesh):
+def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     """Throughput path: Q queries full-scan counts, psum over data shards.
 
     fn(x, y, bins, offs, true_n, boxes (Q, B, 4), times (Q, T, 4)) → (Q,) int32.
+
+    ``impl``: ``"pallas"`` uses the fused Pallas scan kernel
+    (:func:`geomesa_tpu.ops.pallas_kernels.batched_count` — one HBM pass per
+    query batch, VMEM-resident accumulator), ``"jnp"`` the XLA broadcast
+    version, ``"auto"`` picks pallas on TPU backends (interpret-mode pallas on
+    CPU is orders of magnitude slower than XLA, so auto never picks it there).
     """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    interpret = jax.default_backend() != "tpu"
 
     @jax.jit
     @partial(
@@ -147,8 +156,17 @@ def make_batched_count_step(mesh: Mesh):
     )
     def step(x, y, bins, offs, true_n, boxes, times):
         base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
-        m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)
-        return jax.lax.psum(m.sum(axis=1, dtype=jnp.int32), DATA_AXIS)
+        if impl == "pallas":
+            from geomesa_tpu.ops.pallas_kernels import batched_count
+
+            counts = batched_count(
+                x, y, bins, offs, base, true_n, boxes, times,
+                interpret=interpret,
+            )
+        else:
+            m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)
+            counts = m.sum(axis=1, dtype=jnp.int32)
+        return jax.lax.psum(counts, DATA_AXIS)
 
     return step
 

@@ -93,6 +93,11 @@ class GeometryColumn(Column):
 
 def _geometry_column(typ: AttributeType, geoms: Iterable[Any]) -> GeometryColumn:
     geoms = list(geoms)
+    if any(isinstance(g, str) for g in geoms):
+        # WKT strings accepted anywhere a geometry is (GeoTools convention)
+        from geomesa_tpu.geometry.wkt import from_wkt
+
+        geoms = [from_wkt(g) if isinstance(g, str) else g for g in geoms]
     n = len(geoms)
     if typ == AttributeType.POINT:
         x = np.empty(n, dtype=np.float64)

@@ -214,10 +214,7 @@ class DataStore:
         if delta_table is not None:
             dmask = f.mask(delta_table)
             drows = np.nonzero(dmask)[0]
-            if main_n == 0:
-                rows = drows + main_n
-            else:
-                rows = np.concatenate([rows, drows + main_n])
+            rows = np.concatenate([rows, drows + main_n])
 
         table = _take_combined(st, delta_table, rows)
 
@@ -318,6 +315,10 @@ class DataStore:
 
     def _stats(self, type_name: str):
         st = self._state(type_name)
+        if st.stats is None and st.delta.rows > 0:
+            # delta-only data: fold the hot tier in so sketches exist (writes
+            # below the compaction threshold don't build stats eagerly)
+            self.compact(type_name)
         if st.stats is None:
             raise ValueError(f"no statistics for {type_name!r}: no data written yet")
         return st.stats

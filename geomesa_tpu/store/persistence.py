@@ -36,7 +36,17 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
     """Persist every schema + table of a DataStore; returns the manifest."""
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
-    manifest = {"version": FORMAT_VERSION, "types": {}}
+    # generation-unique shard names: renames must never clobber files the
+    # *live* manifest references, or a crash between shard renames and the
+    # manifest flip would leave a hybrid (old manifest → new data) checkpoint
+    gen = 0
+    mpath = root / MANIFEST
+    if mpath.exists():
+        try:
+            gen = int(json.loads(mpath.read_text()).get("generation", 0)) + 1
+        except (ValueError, json.JSONDecodeError):
+            gen = 1
+    manifest = {"version": FORMAT_VERSION, "generation": gen, "types": {}}
     staged: list[tuple[Path, Path]] = []  # (tmp, final) shard renames
     for name in ds.list_schemas():
         ds.compact(name)  # fold the hot tier in so the catalog is fully sorted
@@ -50,7 +60,7 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
             parts = _partitions(st) if partition_by_time else {"all": np.arange(count)}
             for key, rows in parts.items():
                 at = to_arrow(st.table.take(rows))
-                fn = f"part-{key}.parquet"
+                fn = f"part-{key}-g{gen}.parquet"
                 tmp = tdir / (fn + ".tmp")
                 pq.write_table(at, tmp)
                 staged.append((tmp, tdir / fn))
@@ -61,10 +71,11 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
             "files": files,
         }
 
-    # crash-safe commit order: new shards land under temp names above; only
-    # once all writes succeed do we rename them into place, replace the
-    # manifest atomically, and lastly garbage-collect stale files — a crash at
-    # any point leaves either the old or the new checkpoint loadable
+    # crash-safe commit order: new shards land under temp names above and
+    # rename into generation-unique final names (never overwriting a file the
+    # old manifest references); the manifest then replaces atomically, and
+    # lastly stale generations are garbage-collected — a crash at any point
+    # leaves either the old or the new checkpoint loadable intact
     for tmp, final in staged:
         os.replace(tmp, final)
     mtmp = root / (MANIFEST + ".tmp")

@@ -296,6 +296,17 @@ class TestDeltaTier:
         assert st.main_rows == 1 and st.delta.rows == 0
         assert ds.query("c", "INCLUDE").count == 1
 
+    def test_stats_accessors_on_delta_only_data(self):
+        """Sketch accessors must work when all data is still in the hot tier
+        (regression: _stats() raised 'no data written yet')."""
+        ds = DataStore(backend="tpu")
+        ds.create_schema("sd", "age:Integer,dtg:Date,*geom:Point")
+        ds.write("sd", [{"age": i, "dtg": T0 + i, "geom": Point(i, i)}
+                        for i in range(5)])
+        assert ds._state("sd").main_rows == 0  # still hot
+        assert ds.stats_bounds("sd", "age") == (0, 4)
+        assert ds.stats_cardinality("sd", "age") > 0
+
     def test_delta_parity_with_oracle(self):
         recs = point_records(300)
         oracle = DataStore(backend="oracle")

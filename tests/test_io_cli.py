@@ -91,6 +91,28 @@ class TestPersistence:
         r2 = ds2.query("pts", "BBOX(geom, -90, -45, 90, 45)")
         assert set(r1.table.fids.tolist()) == set(r2.table.fids.tolist())
 
+    def test_resave_uses_fresh_generation(self, tmp_path):
+        """A second save must never rename over shards the live manifest
+        references (hybrid-checkpoint crash safety): filenames are
+        generation-unique and stale generations are GC'd after the flip."""
+        ds = DataStore(backend="tpu")
+        sft = parse_spec("pts", SPEC + ";geomesa.z3.interval='day'")
+        ds.create_schema(sft)
+        ds.write("pts", table())
+        m1 = ds.save(str(tmp_path / "cat"))
+        files1 = {f["file"] for f in m1["types"]["pts"]["files"]}
+        t2 = table()
+        t2.fids[:] = [f"x.{i}" for i in range(50)]
+        ds.write("pts", t2)
+        m2 = ds.save(str(tmp_path / "cat"))
+        files2 = {f["file"] for f in m2["types"]["pts"]["files"]}
+        assert m2["generation"] == m1["generation"] + 1
+        assert files1.isdisjoint(files2)
+        on_disk = {p.name for p in (tmp_path / "cat" / "pts").glob("*.parquet")}
+        assert on_disk == files2  # old generation GC'd
+        ds2 = DataStore.load(str(tmp_path / "cat"))
+        assert ds2.query("pts", "INCLUDE").count == 100
+
     def test_empty_store(self, tmp_path):
         ds = DataStore()
         ds.create_schema("e", "dtg:Date,*geom:Point")

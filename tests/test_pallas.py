@@ -1,0 +1,131 @@
+"""Pallas kernel parity tests (interpret mode on the CPU test mesh).
+
+The kernels are the TPU re-materialization of the reference's server-side hot
+loops (``Z3Filter.inBounds`` int-domain compares, ``sfcurve`` Morton spreads —
+SURVEY.md §2.9). Interpret mode runs the same kernel code the TPU compiles;
+parity is asserted against independent numpy referees.
+"""
+
+import numpy as np
+import pytest
+
+import geomesa_tpu  # noqa: F401
+from geomesa_tpu.curve import zorder
+from geomesa_tpu.ops.pallas_kernels import batched_count, z2_encode, z3_encode
+from geomesa_tpu.ops.refine import MAX_BOXES, MAX_TIMES, pack_boxes, pack_times
+
+
+def _referee(x, y, b, o, boxes, times):
+    q = len(boxes)
+    out = np.zeros(q, np.int64)
+    for qi in range(q):
+        inb = np.zeros(len(x), bool)
+        for k in range(MAX_BOXES):
+            bx = boxes[qi, k]
+            inb |= (x >= bx[0]) & (x <= bx[1]) & (y >= bx[2]) & (y <= bx[3])
+        int_ = np.zeros(len(x), bool)
+        for k in range(MAX_TIMES):
+            tt = times[qi, k]
+            after = (b > tt[0]) | ((b == tt[0]) & (o >= tt[1]))
+            before = (b < tt[2]) | ((b == tt[2]) & (o <= tt[3]))
+            int_ |= after & before
+        out[qi] = (inb & int_).sum()
+    return out
+
+
+@pytest.fixture(scope="module")
+def cols(rng):
+    n = 4000
+    return (
+        rng.integers(0, 2**31 - 1, n).astype(np.int32),
+        rng.integers(0, 2**31 - 1, n).astype(np.int32),
+        rng.integers(0, 50, n).astype(np.int32),
+        rng.integers(0, 86_400_000, n).astype(np.int32),
+    )
+
+
+def _payload(rng, q):
+    boxes, times = [], []
+    for _ in range(q):
+        xs = np.sort(rng.integers(0, 2**31 - 1, 2).astype(np.int32))
+        ys = np.sort(rng.integers(0, 2**31 - 1, 2).astype(np.int32))
+        boxes.append(pack_boxes(np.array([[xs[0], xs[1], ys[0], ys[1]]], np.int32)))
+        blo, bhi = np.sort(rng.integers(0, 50, 2).astype(np.int32))
+        times.append(
+            pack_times(np.array([[blo, 0, bhi, 50_000_000]], np.int32))
+        )
+    return np.stack(boxes), np.stack(times)
+
+
+class TestBatchedCount:
+    def test_parity(self, rng, cols):
+        x, y, b, o = cols
+        boxes, times = _payload(rng, 5)
+        got = np.asarray(
+            batched_count(x, y, b, o, 0, len(x), boxes, times, interpret=True)
+        )
+        ref = _referee(x, y, b, o, boxes, times)
+        assert (got == ref).all()
+
+    def test_base_offset_and_padding_masked(self, rng, cols):
+        """Interior-shard tile padding must not alias the next shard's rows."""
+        x, y, b, o = cols
+        boxes = np.stack([pack_boxes(None)])  # whole world
+        times = np.stack([pack_times(None)])
+        # slice is 4000 rows at base 0 of a 3500-row "global" store: rows
+        # >= 3500 are global-tail padding; tile pads (4000->4096) are local
+        got = np.asarray(
+            batched_count(x, y, b, o, 0, 3500, boxes, times, interpret=True)
+        )
+        assert got[0] == 3500
+        # interior shard: base 4000, global n huge — every local row counts,
+        # tile padding (rows 4000..4095) must NOT
+        got = np.asarray(
+            batched_count(x, y, b, o, 4000, 10**9, boxes, times, interpret=True)
+        )
+        assert got[0] == 4000
+
+    def test_multi_slot_or_semantics(self, rng, cols):
+        x, y, b, o = cols
+        b1 = np.array([[0, 2**30, 0, 2**30], [2**30, 2**31 - 1, 0, 2**31 - 1]],
+                      np.int32)
+        boxes = np.stack([pack_boxes(b1)])
+        times = np.stack([pack_times(np.array([[0, 0, 10, 0], [20, 0, 50, 10**8]],
+                                              np.int32))])
+        got = np.asarray(
+            batched_count(x, y, b, o, 0, len(x), boxes, times, interpret=True)
+        )
+        ref = _referee(x, y, b, o, boxes, times)
+        assert (got == ref).all()
+
+
+class TestZEncode:
+    def test_z3_matches_zorder(self, rng):
+        n = 3000
+        xs = rng.integers(0, 2**21, n).astype(np.uint32)
+        ys = rng.integers(0, 2**21, n).astype(np.uint32)
+        ts = rng.integers(0, 2**21, n).astype(np.uint32)
+        hi, lo = z3_encode(xs, ys, ts, interpret=True)
+        z = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+            lo
+        ).astype(np.uint64)
+        assert (z == zorder.encode3(xs, ys, ts)).all()
+
+    def test_z2_matches_zorder(self, rng):
+        n = 3000
+        xs = rng.integers(0, 2**31, n).astype(np.uint32)
+        ys = rng.integers(0, 2**31, n).astype(np.uint32)
+        hi, lo = z2_encode(xs, ys, interpret=True)
+        z = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+            lo
+        ).astype(np.uint64)
+        assert (z == zorder.encode2(xs, ys)).all()
+
+    def test_edge_values(self):
+        xs = np.array([0, 1, 2**21 - 1], np.uint32)
+        hi, lo = z3_encode(xs, xs, xs, interpret=True)
+        z = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+            lo
+        ).astype(np.uint64)
+        assert (z == zorder.encode3(xs, xs, xs)).all()
+        assert z[2] == np.uint64(0x7FFFFFFFFFFFFFFF)

@@ -102,6 +102,24 @@ class TestShardedQueries:
         np.testing.assert_array_equal(np.asarray(counts), expected)
         assert expected.sum() > 0  # non-vacuous
 
+    def test_batched_count_pallas_impl(self, store_arrays):
+        """shard_map + interpret-mode Pallas kernel agrees with brute force."""
+        xi, yi, bins, offs = store_arrays
+        mesh = make_mesh()
+        cols, padded, rows_per_shard = shard_columns(
+            mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs}
+        )
+        step = make_batched_count_step(mesh, impl="pallas")
+        boxes, times = make_queries(2)
+        import jax.numpy as jnp
+
+        counts = step(
+            cols["x"], cols["y"], cols["bins"], cols["offs"],
+            jnp.int32(len(xi)), jnp.asarray(boxes), jnp.asarray(times),
+        )
+        expected = brute_counts(xi, yi, bins, offs, boxes, times)
+        np.testing.assert_array_equal(np.asarray(counts), expected)
+
     def test_select_step_parity(self, store_arrays):
         xi, yi, bins, offs = store_arrays
         mesh = make_mesh()
