@@ -1,0 +1,911 @@
+"""Geometry measures, constructive ops, and DE-9IM topology.
+
+Role parity: the JTS operations backing the reference's ST_* Spark UDF library
+(``geomesa-spark-jts/.../udf/GeometricAccessorFunctions.scala``,
+``GeometricProcessingFunctions.scala``, ``SpatialRelationFunctions.scala``,
+SURVEY.md §2.14) and geometry utils (``geomesa-utils/.../GeometryUtils.scala``).
+Everything here is from-scratch planar computational geometry over numpy
+arrays; :func:`relate` computes the DE-9IM intersection matrix by splitting
+each geometry's skeleton at crossings with the other and classifying the
+resulting pieces/points against interior/boundary/exterior.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from geomesa_tpu.geometry.predicates import (
+    BOUNDARY,
+    EXTERIOR,
+    INTERIOR,
+    _points_dist2_segments,
+    classify_points_polygon,
+    distance,
+    intersects,
+)
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    _Multi,
+)
+
+__all__ = [
+    "area",
+    "length",
+    "length_sphere",
+    "distance_sphere",
+    "centroid",
+    "convex_hull",
+    "envelope",
+    "boundary",
+    "closest_point",
+    "translate",
+    "buffer_point",
+    "antimeridian_safe",
+    "is_closed",
+    "is_ring",
+    "is_simple",
+    "is_valid",
+    "is_empty",
+    "dimension",
+    "num_points",
+    "num_geometries",
+    "geometry_n",
+    "point_n",
+    "exterior_ring",
+    "interior_ring_n",
+    "relate",
+    "relate_bool",
+    "equals",
+    "touches",
+    "crosses",
+    "overlaps",
+    "covers",
+    "covered_by",
+]
+
+EARTH_RADIUS_M = 6371008.7714  # WGS84 mean radius
+
+
+# ---------------------------------------------------------------------------
+# measures
+# ---------------------------------------------------------------------------
+
+def _ring_signed_area(c: np.ndarray) -> float:
+    x, y = c[:, 0], c[:, 1]
+    return 0.5 * float(np.sum(x[:-1] * y[1:] - x[1:] * y[:-1]))
+
+
+def area(g: Geometry) -> float:
+    """Planar area (squared degrees); holes subtracted; 0 for points/lines."""
+    if isinstance(g, Polygon):
+        a = abs(_ring_signed_area(g.shell))
+        for h in g.holes:
+            a -= abs(_ring_signed_area(h))
+        return a
+    if isinstance(g, _Multi):
+        return sum(area(p) for p in g.parts)
+    return 0.0
+
+
+def _polyline_length(c: np.ndarray) -> float:
+    d = np.diff(c, axis=0)
+    return float(np.sqrt((d * d).sum(axis=1)).sum())
+
+
+def length(g: Geometry) -> float:
+    """Planar length: path length for lines, perimeter for polygons (JTS)."""
+    if isinstance(g, LineString):
+        return _polyline_length(g.coords)
+    if isinstance(g, Polygon):
+        return sum(_polyline_length(r) for r in g.rings)
+    if isinstance(g, _Multi):
+        return sum(length(p) for p in g.parts)
+    return 0.0
+
+
+def _haversine_m(lon1, lat1, lon2, lat2):
+    lon1, lat1, lon2, lat2 = (np.radians(np.asarray(v, dtype=np.float64)) for v in (lon1, lat1, lon2, lat2))
+    dlon, dlat = lon2 - lon1, lat2 - lat1
+    h = np.sin(dlat / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2) ** 2
+    return 2.0 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(h, 0.0, 1.0)))
+
+
+def distance_sphere(a: Geometry, b: Geometry) -> float:
+    """Great-circle distance in meters between representative nearest points.
+
+    Exact for point×point (``st_distanceSphere``); for extended geometries the
+    planar nearest points are projected onto the sphere.
+    """
+    pa, pb = closest_point(a, b), closest_point(b, a)
+    return float(_haversine_m(pa.x, pa.y, pb.x, pb.y))
+
+
+def length_sphere(g: Geometry) -> float:
+    """Great-circle path length in meters (``st_lengthSphere``)."""
+    if isinstance(g, LineString):
+        c = g.coords
+        return float(_haversine_m(c[:-1, 0], c[:-1, 1], c[1:, 0], c[1:, 1]).sum())
+    if isinstance(g, Polygon):
+        return sum(
+            float(_haversine_m(r[:-1, 0], r[:-1, 1], r[1:, 0], r[1:, 1]).sum())
+            for r in g.rings
+        )
+    if isinstance(g, _Multi):
+        return sum(length_sphere(p) for p in g.parts)
+    return 0.0
+
+
+def centroid(g: Geometry) -> Point:
+    """Area/length/count-weighted centroid per highest dimension present."""
+    if isinstance(g, Point):
+        return g
+    if isinstance(g, Polygon):
+        cx = cy = asum = 0.0
+        for ring, sign in [(g.shell, 1.0), *[(h, -1.0) for h in g.holes]]:
+            x, y = ring[:, 0], ring[:, 1]
+            cr = x[:-1] * y[1:] - x[1:] * y[:-1]
+            a = 0.5 * float(cr.sum())
+            if a == 0.0:
+                continue
+            cx += sign * abs(a) * (float(((x[:-1] + x[1:]) * cr).sum()) / (6.0 * a))
+            cy += sign * abs(a) * (float(((y[:-1] + y[1:]) * cr).sum()) / (6.0 * a))
+            asum += sign * abs(a)
+        if asum == 0.0:
+            return centroid(LineString(g.shell))
+        return Point(cx / asum, cy / asum)
+    if isinstance(g, LineString):
+        d = np.diff(g.coords, axis=0)
+        w = np.sqrt((d * d).sum(axis=1))
+        if w.sum() == 0.0:
+            return Point(float(g.coords[:, 0].mean()), float(g.coords[:, 1].mean()))
+        mids = 0.5 * (g.coords[:-1] + g.coords[1:])
+        return Point(
+            float((mids[:, 0] * w).sum() / w.sum()),
+            float((mids[:, 1] * w).sum() / w.sum()),
+        )
+    if isinstance(g, _Multi):
+        dim = dimension(g)
+        weights, cents = [], []
+        for p in g.parts:
+            if dimension(p) != dim:
+                continue
+            c = centroid(p)
+            w = {2: area(p), 1: length(p), 0: 1.0}[dim]
+            weights.append(w)
+            cents.append((c.x, c.y))
+        w = np.asarray(weights)
+        c = np.asarray(cents)
+        if w.sum() == 0.0:
+            return Point(float(c[:, 0].mean()), float(c[:, 1].mean()))
+        return Point(float((c[:, 0] * w).sum() / w.sum()), float((c[:, 1] * w).sum() / w.sum()))
+    raise TypeError(type(g).__name__)
+
+
+# ---------------------------------------------------------------------------
+# constructive ops
+# ---------------------------------------------------------------------------
+
+def _all_vertices(g: Geometry) -> np.ndarray:
+    if isinstance(g, Point):
+        return np.array([[g.x, g.y]], dtype=np.float64)
+    if isinstance(g, LineString):
+        return g.coords
+    if isinstance(g, Polygon):
+        return np.vstack(g.rings)
+    if isinstance(g, _Multi):
+        return np.vstack([_all_vertices(p) for p in g.parts])
+    raise TypeError(type(g).__name__)
+
+
+def convex_hull(g: Geometry) -> Geometry:
+    """Andrew monotone-chain convex hull (``st_convexhull``)."""
+    pts = np.unique(_all_vertices(g), axis=0)
+    if len(pts) == 1:
+        return Point(float(pts[0, 0]), float(pts[0, 1]))
+    # sort by (x, y)
+    order = np.lexsort((pts[:, 1], pts[:, 0]))
+    pts = pts[order]
+
+    def half(points):
+        out: list[np.ndarray] = []
+        for p in points:
+            while len(out) >= 2:
+                u, v = out[-1] - out[-2], p - out[-2]
+                if u[0] * v[1] - u[1] * v[0] <= 0:
+                    out.pop()
+                else:
+                    break
+            out.append(p)
+        return out
+
+    lower = half(pts)
+    upper = half(pts[::-1])
+    hull = np.array(lower[:-1] + upper[:-1])
+    if len(hull) == 2:
+        return LineString(hull)
+    return Polygon(hull)
+
+
+def envelope(g: Geometry) -> Geometry:
+    xmin, ymin, xmax, ymax = g.bbox
+    if xmin == xmax and ymin == ymax:
+        return Point(xmin, ymin)
+    if xmin == xmax or ymin == ymax:
+        return LineString(np.array([[xmin, ymin], [xmax, ymax]]))
+    from geomesa_tpu.geometry.types import box
+
+    return box(xmin, ymin, xmax, ymax)
+
+
+def boundary(g: Geometry) -> Geometry | None:
+    """Topological boundary; ``None`` for points (empty set)."""
+    if isinstance(g, Point) or isinstance(g, MultiPoint):
+        return None
+    if isinstance(g, LineString):
+        if is_closed(g):
+            return None
+        c = g.coords
+        return MultiPoint((Point(*c[0]), Point(*c[-1])))
+    if isinstance(g, Polygon):
+        rings = [LineString(r) for r in g.rings]
+        return rings[0] if len(rings) == 1 else MultiLineString(tuple(rings))
+    if isinstance(g, _Multi):
+        parts = [boundary(p) for p in g.parts]
+        flat: list[Geometry] = []
+        for b in parts:
+            if b is None:
+                continue
+            flat.extend(b.parts if isinstance(b, _Multi) else [b])
+        if not flat:
+            return None
+        if all(isinstance(p, Point) for p in flat):
+            return MultiPoint(tuple(flat))
+        return MultiLineString(tuple(p for p in flat if isinstance(p, LineString)))
+    raise TypeError(type(g).__name__)
+
+
+def closest_point(a: Geometry, b: Geometry) -> Point:
+    """The point ON ``a`` closest to ``b`` (``st_closestPoint``)."""
+    vb = _all_vertices(b)
+    if isinstance(a, Point):
+        return a
+    if intersects(a, b):
+        # any intersection witness is a valid (distance-0) closest point
+        cb = _classify_region(vb[:, 0], vb[:, 1], a)
+        hit = np.nonzero(cb != EXTERIOR)[0]
+        if len(hit):
+            return Point(float(vb[hit[0], 0]), float(vb[hit[0], 1]))
+        va = _all_vertices(a)
+        ca = _classify_region(va[:, 0], va[:, 1], b)
+        hit = np.nonzero(ca != EXTERIOR)[0]
+        if len(hit):
+            return Point(float(va[hit[0], 0]), float(va[hit[0], 1]))
+        for la in _skeleton_lines(a):
+            for lb in _skeleton_lines(b):
+                _, pts, _ = _pairwise_splits(la, lb)
+                if pts:
+                    return Point(float(pts[0][0]), float(pts[0][1]))
+    # candidate: for every vertex of b, its projection onto a's segments;
+    # plus a's vertices scored against b
+    best, best_d2 = None, np.inf
+    for seg_src in _skeleton_lines(a):
+        x1, y1 = seg_src[:-1, 0][None, :], seg_src[:-1, 1][None, :]
+        x2, y2 = seg_src[1:, 0][None, :], seg_src[1:, 1][None, :]
+        px, py = vb[:, 0][:, None], vb[:, 1][:, None]
+        dx, dy = x2 - x1, y2 - y1
+        len2 = dx * dx + dy * dy
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = np.where(len2 > 0, ((px - x1) * dx + (py - y1) * dy) / len2, 0.0)
+        t = np.clip(t, 0.0, 1.0)
+        cx, cy = x1 + t * dx, y1 + t * dy
+        d2 = (px - cx) ** 2 + (py - cy) ** 2
+        i = int(np.argmin(d2))
+        if d2.flat[i] < best_d2:
+            best_d2 = float(d2.flat[i])
+            best = Point(float(cx.flat[i]), float(cy.flat[i]))
+    va = _all_vertices(a)
+    from geomesa_tpu.geometry.predicates import points_dist2_geom
+
+    d2v = points_dist2_geom(va[:, 0], va[:, 1], b)
+    i = int(np.argmin(d2v))
+    if best is None or d2v[i] < best_d2:
+        best = Point(float(va[i, 0]), float(va[i, 1]))
+    return best
+
+
+def translate(g: Geometry, dx: float, dy: float) -> Geometry:
+    if isinstance(g, Point):
+        return Point(g.x + dx, g.y + dy)
+    if isinstance(g, LineString):
+        return LineString(g.coords + [dx, dy])
+    if isinstance(g, Polygon):
+        return Polygon(g.shell + [dx, dy], tuple(h + [dx, dy] for h in g.holes))
+    if isinstance(g, _Multi):
+        return type(g)(tuple(translate(p, dx, dy) for p in g.parts))
+    raise TypeError(type(g).__name__)
+
+
+def buffer_point(p: Point, meters: float, segments: int = 32) -> Polygon:
+    """Geodesic point buffer as a polygon in degrees (``st_bufferPoint``).
+
+    Matches the reference's use for DWithin acceleration: a small circle around
+    a lon/lat point, radius in meters, local-scale approximation.
+    """
+    dlat = math.degrees(meters / EARTH_RADIUS_M)
+    coslat = max(math.cos(math.radians(p.y)), 1e-12)
+    dlon = dlat / coslat
+    ang = np.linspace(0.0, 2.0 * math.pi, segments, endpoint=False)
+    ring = np.stack([p.x + dlon * np.cos(ang), p.y + dlat * np.sin(ang)], axis=1)
+    return Polygon(ring)
+
+
+def antimeridian_safe(g: Geometry) -> Geometry:
+    """Split geometries whose bbox spans the antimeridian (``st_idlSafeGeom``).
+
+    Heuristic matching the reference's ``st_antimeridianSafeGeom``: if the
+    geometry's longitudinal extent exceeds 180°, shift the negative-lon part by
+    +360, split at lon=180, and shift the right half back.
+    """
+    xmin, _, xmax, _ = g.bbox
+    if xmax - xmin <= 180.0:
+        return g
+    if not isinstance(g, Polygon):
+        return g  # only polygons are split (the reference's supported case)
+    shifted = Polygon(
+        np.where(g.shell[:, :1] < 0, g.shell + [360.0, 0.0], g.shell),
+        tuple(np.where(h[:, :1] < 0, h + [360.0, 0.0], h) for h in g.holes),
+    )
+    west = _clip_halfplane(shifted, 180.0, keep_left=True)
+    east = _clip_halfplane(shifted, 180.0, keep_left=False)
+    parts = []
+    if west is not None:
+        parts.append(west)
+    if east is not None:
+        parts.append(translate(east, -360.0, 0.0))
+    if len(parts) == 1:
+        return parts[0]
+    return MultiPolygon(tuple(parts))
+
+
+def _clip_halfplane(poly: Polygon, xcut: float, keep_left: bool) -> Polygon | None:
+    """Sutherland–Hodgman clip (shell and holes) against a vertical line."""
+
+    def inside(pt):
+        return pt[0] <= xcut if keep_left else pt[0] >= xcut
+
+    def isect(p1, p2):
+        t = (xcut - p1[0]) / (p2[0] - p1[0])
+        return np.array([xcut, p1[1] + t * (p2[1] - p1[1])])
+
+    def clip_ring(ring: np.ndarray) -> np.ndarray | None:
+        out: list[np.ndarray] = []
+        for i in range(len(ring) - 1):
+            p1, p2 = ring[i], ring[i + 1]
+            if inside(p1):
+                out.append(p1)
+                if not inside(p2):
+                    out.append(isect(p1, p2))
+            elif inside(p2):
+                out.append(isect(p1, p2))
+        return np.array(out) if len(out) >= 3 else None
+
+    shell = clip_ring(poly.shell)
+    if shell is None:
+        return None
+    holes = tuple(h for h in map(clip_ring, poly.holes) if h is not None)
+    return Polygon(shell, holes)
+
+
+# ---------------------------------------------------------------------------
+# simple accessors / validity
+# ---------------------------------------------------------------------------
+
+def is_empty(g: Geometry | None) -> bool:
+    return g is None or (isinstance(g, _Multi) and len(g.parts) == 0)
+
+
+def dimension(g: Geometry) -> int:
+    if isinstance(g, Point) or isinstance(g, MultiPoint):
+        return 0
+    if isinstance(g, (LineString, MultiLineString)):
+        return 1
+    if isinstance(g, (Polygon, MultiPolygon)):
+        return 2
+    if isinstance(g, _Multi):
+        return max((dimension(p) for p in g.parts), default=0)
+    raise TypeError(type(g).__name__)
+
+
+def num_points(g: Geometry) -> int:
+    if isinstance(g, Point):
+        return 1
+    if isinstance(g, LineString):
+        return len(g.coords)
+    if isinstance(g, Polygon):
+        return sum(len(r) for r in g.rings)
+    if isinstance(g, _Multi):
+        return sum(num_points(p) for p in g.parts)
+    raise TypeError(type(g).__name__)
+
+
+def num_geometries(g: Geometry) -> int:
+    return len(g.parts) if isinstance(g, _Multi) else 1
+
+
+def geometry_n(g: Geometry, n: int) -> Geometry:
+    """1-based part accessor (OGC convention, ``st_geometryN``)."""
+    if isinstance(g, _Multi):
+        return g.parts[n - 1]
+    if n == 1:
+        return g
+    raise IndexError(n)
+
+
+def point_n(g: LineString, n: int) -> Point:
+    """1-based vertex accessor; negative counts from the end (``st_pointN``)."""
+    c = g.coords
+    idx = n - 1 if n > 0 else len(c) + n
+    return Point(float(c[idx, 0]), float(c[idx, 1]))
+
+
+def exterior_ring(g: Polygon) -> LineString:
+    return LineString(g.shell)
+
+
+def interior_ring_n(g: Polygon, n: int) -> LineString:
+    return LineString(g.holes[n - 1])
+
+
+def is_closed(g: Geometry) -> bool:
+    if isinstance(g, LineString):
+        return bool(np.array_equal(g.coords[0], g.coords[-1]))
+    if isinstance(g, (MultiLineString,)):
+        return all(is_closed(p) for p in g.parts)
+    return True  # points/polygons are closed by definition (JTS)
+
+
+def is_ring(g: Geometry) -> bool:
+    return isinstance(g, LineString) and is_closed(g) and is_simple(g)
+
+
+def _cross2(u, v) -> float:
+    return float(u[0] * v[1] - u[1] * v[0])
+
+
+def _polyline_self_intersects(c: np.ndarray, closed: bool) -> bool:
+    n = len(c) - 1
+    for i in range(n):
+        for j in range(i + 1, n):
+            adjacent = j == i + 1 or (closed and i == 0 and j == n - 1)
+            a1, a2, b1, b2 = c[i], c[i + 1], c[j], c[j + 1]
+            d = _cross2(a2 - a1, b2 - b1)
+            if d != 0:
+                t = _cross2(b1 - a1, b2 - b1) / d
+                u = _cross2(b1 - a1, a2 - a1) / d
+                if 0 <= t <= 1 and 0 <= u <= 1:
+                    if not adjacent:
+                        return True
+                    # adjacent segments legitimately share one endpoint
+                    pt = a1 + t * (a2 - a1)
+                    shared = c[j] if j == i + 1 else c[0]
+                    if not np.allclose(pt, shared):
+                        return True
+            else:
+                # parallel: collinear overlap?
+                if _cross2(b1 - a1, a2 - a1) == 0:
+                    axis = 0 if a1[0] != a2[0] else 1
+                    lo1, hi1 = sorted((a1[axis], a2[axis]))
+                    lo2, hi2 = sorted((b1[axis], b2[axis]))
+                    if min(hi1, hi2) - max(lo1, lo2) > 0:
+                        return True
+    return False
+
+
+def is_simple(g: Geometry) -> bool:
+    if isinstance(g, (Point, MultiPoint, Polygon, MultiPolygon)):
+        return True
+    if isinstance(g, LineString):
+        return not _polyline_self_intersects(g.coords, is_closed(g))
+    if isinstance(g, MultiLineString):
+        return all(is_simple(p) for p in g.parts)
+    raise TypeError(type(g).__name__)
+
+
+def is_valid(g: Geometry) -> bool:
+    """Basic OGC validity: simple rings, holes inside shell."""
+    if isinstance(g, Polygon):
+        for r in g.rings:
+            if _polyline_self_intersects(r, closed=True):
+                return False
+        for h in g.holes:
+            cls = classify_points_polygon(h[:-1, 0], h[:-1, 1], Polygon(g.shell))
+            if (cls == EXTERIOR).any():
+                return False
+        return True
+    if isinstance(g, _Multi):
+        return all(is_valid(p) for p in g.parts)
+    if isinstance(g, LineString):
+        return len(g.coords) >= 2
+    return True
+
+
+# ---------------------------------------------------------------------------
+# DE-9IM relate
+# ---------------------------------------------------------------------------
+
+_F = -1  # dim of an empty intersection
+
+
+def _skeleton_lines(g: Geometry) -> list[np.ndarray]:
+    if isinstance(g, LineString):
+        return [g.coords]
+    if isinstance(g, Polygon):
+        return list(g.rings)
+    if isinstance(g, _Multi):
+        out = []
+        for p in g.parts:
+            out.extend(_skeleton_lines(p))
+        return out
+    return []
+
+
+def _boundary_points(g: Geometry) -> np.ndarray:
+    """Endpoints of line parts (mod-2 rule approximated as raw endpoints)."""
+    pts = []
+    if isinstance(g, LineString):
+        if not is_closed(g):
+            pts = [g.coords[0], g.coords[-1]]
+    elif isinstance(g, MultiLineString):
+        for p in g.parts:
+            if not is_closed(p):
+                pts.extend([p.coords[0], p.coords[-1]])
+    return np.array(pts).reshape(-1, 2)
+
+
+def _pairwise_splits(A: np.ndarray, B: np.ndarray):
+    """Intersections of polyline A with polyline B.
+
+    Returns ``(t_by_seg, points, overlap)`` where ``t_by_seg[i]`` is a list of
+    split parameters on A's segment ``i``, ``points`` the isolated intersection
+    coordinates, and ``overlap`` True if a 1D collinear overlap exists.
+    """
+    nA, nB = len(A) - 1, len(B) - 1
+    t_by_seg: list[list[float]] = [[] for _ in range(nA)]
+    points: list[np.ndarray] = []
+    overlap = False
+    a1 = A[:-1][:, None, :]
+    a2 = A[1:][:, None, :]
+    b1 = B[:-1][None, :, :]
+    b2 = B[1:][None, :, :]
+    da = a2 - a1
+    db = b2 - b1
+    denom = da[..., 0] * db[..., 1] - da[..., 1] * db[..., 0]
+    diff = b1 - a1
+    cross1 = diff[..., 0] * db[..., 1] - diff[..., 1] * db[..., 0]
+    cross2 = diff[..., 0] * da[..., 1] - diff[..., 1] * da[..., 0]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t = np.where(denom != 0, cross1 / denom, np.nan)
+        u = np.where(denom != 0, cross2 / denom, np.nan)
+    hit = (denom != 0) & (t >= 0) & (t <= 1) & (u >= 0) & (u <= 1)
+    for i, j in zip(*np.nonzero(hit)):
+        tv = float(t[i, j])
+        t_by_seg[i].append(tv)
+        points.append(A[i] + tv * (A[i + 1] - A[i]))
+    # parallel & collinear
+    par = (denom == 0) & (cross1 == 0)
+    for i, j in zip(*np.nonzero(par)):
+        d = A[i + 1] - A[i]
+        len2 = float(d @ d)
+        if len2 == 0:
+            continue
+        t0 = float((B[j] - A[i]) @ d) / len2
+        t1 = float((B[j + 1] - A[i]) @ d) / len2
+        lo, hi = min(t0, t1), max(t0, t1)
+        lo, hi = max(lo, 0.0), min(hi, 1.0)
+        if hi < lo:
+            continue
+        if hi == lo:
+            t_by_seg[i].append(lo)
+            points.append(A[i] + lo * d)
+        else:
+            overlap = True
+            t_by_seg[i].extend([lo, hi])
+    return t_by_seg, points, overlap
+
+
+def _pieces(A: np.ndarray, others: list[np.ndarray]):
+    """Split polyline A at all crossings with `others`; return midpoints of the
+    resulting sub-segments (for piece classification) + isolated touch points."""
+    nA = len(A) - 1
+    t_all: list[list[float]] = [[0.0, 1.0] for _ in range(nA)]
+    pts: list[np.ndarray] = []
+    overlap = False
+    for B in others:
+        tb, p, ov = _pairwise_splits(A, B)
+        overlap = overlap or ov
+        pts.extend(p)
+        for i in range(nA):
+            t_all[i].extend(tb[i])
+    mids = []
+    for i in range(nA):
+        ts = np.unique(np.clip(np.array(t_all[i]), 0.0, 1.0))
+        seg = A[i + 1] - A[i]
+        if float(seg @ seg) == 0.0:
+            continue
+        for t0, t1 in zip(ts[:-1], ts[1:]):
+            if t1 > t0:
+                mids.append(A[i] + 0.5 * (t0 + t1) * seg)
+    mids_arr = np.array(mids).reshape(-1, 2)
+    pts_arr = np.array(pts).reshape(-1, 2) if pts else np.empty((0, 2))
+    return mids_arr, pts_arr, overlap
+
+
+def _classify_region(xs, ys, g: Geometry) -> np.ndarray:
+    """0 exterior / 1 interior / 2 boundary of points vs any geometry."""
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.asarray(ys, dtype=np.float64)
+    if isinstance(g, (Polygon, MultiPolygon)):
+        polys = g.parts if isinstance(g, MultiPolygon) else (g,)
+        cls = np.full(len(xs), EXTERIOR, dtype=np.int8)
+        for p in polys:
+            c = classify_points_polygon(xs, ys, p)
+            cls = np.where(cls == INTERIOR, cls, np.maximum(cls, c))
+            cls = np.where((cls == BOUNDARY) & (c == INTERIOR), INTERIOR, cls)
+        return cls
+    if isinstance(g, (LineString, MultiLineString)):
+        from geomesa_tpu.geometry.predicates import points_intersect_geom
+
+        on = points_intersect_geom(xs, ys, g)
+        bp = _boundary_points(g)
+        cls = np.where(on, INTERIOR, EXTERIOR).astype(np.int8)
+        if len(bp):
+            at_end = ((xs[:, None] == bp[None, :, 0]) & (ys[:, None] == bp[None, :, 1])).any(axis=1)
+            cls = np.where(on & at_end, BOUNDARY, cls)
+        return cls
+    if isinstance(g, Point):
+        return np.where((xs == g.x) & (ys == g.y), INTERIOR, EXTERIOR).astype(np.int8)
+    if isinstance(g, MultiPoint):
+        cls = np.full(len(xs), EXTERIOR, dtype=np.int8)
+        for p in g.parts:
+            cls = np.maximum(cls, _classify_region(xs, ys, p))
+        return cls
+    raise TypeError(type(g).__name__)
+
+
+def representative_point(poly: Polygon) -> Point:
+    """A point guaranteed strictly inside a valid polygon (point-on-surface).
+
+    Casts a horizontal chord at a y midway between two distinct vertex
+    ordinates and takes the midpoint of the first interior interval.
+    """
+    yv = np.unique(np.concatenate([r[:, 1] for r in poly.rings]))
+    candidates = 0.5 * (yv[:-1] + yv[1:]) if len(yv) > 1 else yv
+    for y in candidates:
+        xs = []
+        for r in poly.rings:
+            y1, y2 = r[:-1, 1], r[1:, 1]
+            x1, x2 = r[:-1, 0], r[1:, 0]
+            straddle = (y1 > y) != (y2 > y)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xi = x1 + (y - y1) * (x2 - x1) / (y2 - y1)
+            xs.extend(xi[straddle].tolist())
+        xs = sorted(xs)
+        for x0, x1 in zip(xs[0::2], xs[1::2]):
+            if x1 > x0:
+                cand = Point(0.5 * (x0 + x1), float(y))
+                if classify_points_polygon([cand.x], [cand.y], poly)[0] == INTERIOR:
+                    return cand
+    return centroid(poly)  # degenerate fallback
+
+
+def _im_set(M, row, col, d):
+    i = {"I": 0, "B": 1, "E": 2}[row]
+    j = {"I": 0, "B": 1, "E": 2}[col]
+    M[i][j] = max(M[i][j], d)
+
+
+def _accumulate_points(M, pts: np.ndarray, a: Geometry, b: Geometry, dim0: int = 0):
+    """Classify isolated points against both geometries; bump matrix cells."""
+    if len(pts) == 0:
+        return
+    ca = _classify_region(pts[:, 0], pts[:, 1], a)
+    cb = _classify_region(pts[:, 0], pts[:, 1], b)
+    names = {INTERIOR: "I", BOUNDARY: "B", EXTERIOR: "E"}
+    for ra, rb in zip(ca, cb):
+        _im_set(M, names[int(ra)], names[int(rb)], dim0)
+
+
+def relate(a: Geometry, b: Geometry) -> str:
+    """DE-9IM intersection matrix of ``a`` vs ``b`` as a 9-char string.
+
+    From-scratch implementation: skeleton polylines of each geometry are split
+    at every crossing with the other's skeleton; sub-segment midpoints and
+    isolated intersection points are classified against each geometry's
+    interior/boundary/exterior, and each classified piece bumps the dimension
+    of its matrix cell. Areal interior-vs-interior/exterior cells are derived
+    from the boundary-piece classification (a boundary arc of one polygon lying
+    strictly inside the other implies 2D overlap on both sides of the arc).
+    """
+    M = [[_F] * 3 for _ in range(3)]
+    dim_a, dim_b = dimension(a), dimension(b)
+    _im_set(M, "E", "E", 2)
+
+    # --- point components of a vs b and vice versa
+    def point_parts(g):
+        if isinstance(g, Point):
+            return [g]
+        if isinstance(g, MultiPoint):
+            return list(g.parts)
+        return []
+
+    pa, pb = point_parts(a), point_parts(b)
+    names = {INTERIOR: "I", BOUNDARY: "B", EXTERIOR: "E"}
+    if pa:
+        pts = np.array([[p.x, p.y] for p in pa])
+        cb = _classify_region(pts[:, 0], pts[:, 1], b)
+        for c in cb:
+            _im_set(M, "I", names[int(c)], 0)
+        if dim_b > 0:
+            # b's interior minus a finite point set keeps its dimension
+            _im_set(M, "E", "I", dim_b if dim_b == 2 else 1)
+            if dim_b == 2:
+                _im_set(M, "E", "B", 1)
+    if pb and not pa:
+        pts = np.array([[p.x, p.y] for p in pb])
+        ca = _classify_region(pts[:, 0], pts[:, 1], a)
+        for c in ca:
+            _im_set(M, names[int(c)], "I", 0)
+        if dim_a > 0:
+            _im_set(M, "I", "E", dim_a if dim_a == 2 else 1)
+            if dim_a == 2:
+                _im_set(M, "B", "E", 1)
+    if pa and dim_b == 0 and pb:
+        # point-set vs point-set exteriors
+        set_a = {(p.x, p.y) for p in pa}
+        set_b = {(q.x, q.y) for q in pb}
+        if set_a - set_b:
+            _im_set(M, "I", "E", 0)
+        if set_b - set_a:
+            _im_set(M, "E", "I", 0)
+
+    # boundary endpoints of line parts, classified exactly against the other
+    bp_a = _boundary_points(a)
+    if len(bp_a):
+        cb = _classify_region(bp_a[:, 0], bp_a[:, 1], b)
+        for c in cb:
+            _im_set(M, "B", names[int(c)], 0)
+    bp_b = _boundary_points(b)
+    if len(bp_b):
+        ca = _classify_region(bp_b[:, 0], bp_b[:, 1], a)
+        for c in ca:
+            _im_set(M, names[int(c)], "B", 0)
+
+    lines_a, lines_b = _skeleton_lines(a), _skeleton_lines(b)
+    if lines_a and (lines_b or pb):
+        # pieces of a's skeleton classified against both geometries
+        all_mids, all_pts = [], []
+        for la in lines_a:
+            m, p, _ = _pieces(la, lines_b)
+            all_mids.append(m)
+            all_pts.append(p)
+        mids = np.vstack(all_mids) if all_mids else np.empty((0, 2))
+        pts = np.vstack(all_pts) if all_pts else np.empty((0, 2))
+
+        if len(mids):
+            ca = _classify_region(mids[:, 0], mids[:, 1], a)
+            cb = _classify_region(mids[:, 0], mids[:, 1], b)
+            names = {INTERIOR: "I", BOUNDARY: "B", EXTERIOR: "E"}
+            for ra, rb in zip(ca, cb):
+                _im_set(M, names[int(ra)], names[int(rb)], 1)
+                if dim_a == 2 and ra == BOUNDARY:
+                    # a is areal: its boundary arc has a's interior alongside
+                    if rb == INTERIOR:
+                        _im_set(M, "I", "I", 2)
+                    if rb == EXTERIOR:
+                        _im_set(M, "I", "E", 2)
+        _accumulate_points(M, pts, a, b)
+
+    if lines_b and (lines_a or pa):
+        all_mids, all_pts = [], []
+        for lb in lines_b:
+            m, p, _ = _pieces(lb, lines_a)
+            all_mids.append(m)
+            all_pts.append(p)
+        mids = np.vstack(all_mids) if all_mids else np.empty((0, 2))
+        pts = np.vstack(all_pts) if all_pts else np.empty((0, 2))
+        if len(mids):
+            ca = _classify_region(mids[:, 0], mids[:, 1], a)
+            cb = _classify_region(mids[:, 0], mids[:, 1], b)
+            names = {INTERIOR: "I", BOUNDARY: "B", EXTERIOR: "E"}
+            for ra, rb in zip(ca, cb):
+                _im_set(M, names[int(ra)], names[int(rb)], 1)
+                if dim_b == 2 and rb == BOUNDARY:
+                    if ra == INTERIOR:
+                        _im_set(M, "I", "I", 2)
+                    if ra == EXTERIOR:
+                        _im_set(M, "E", "I", 2)
+        _accumulate_points(M, pts, a, b)
+
+    # areal interiors with no boundary interaction at all (equal or nested)
+    if dim_a == 2 and dim_b == 2 and M[0][0] < 2:
+        for poly_src, other in ((a, b), (b, a)):
+            polys = poly_src.parts if isinstance(poly_src, MultiPolygon) else (poly_src,)
+            rp = representative_point(polys[0])
+            if _classify_region([rp.x], [rp.y], other)[0] == INTERIOR:
+                _im_set(M, "I", "I", 2)
+                break
+
+    # line/areal vs anything: does any piece of its skeleton avoid the other
+    # entirely? covered above via midpoints (they classify as E on the other
+    # side). Nothing further needed.
+
+    out = []
+    for i in range(3):
+        for j in range(3):
+            out.append("F" if M[i][j] == _F else str(M[i][j]))
+    return "".join(out)
+
+
+def relate_bool(a: Geometry, b: Geometry, pattern: str) -> bool:
+    """Match a DE-9IM pattern (``T``/``F``/``*``/``0``/``1``/``2``)."""
+    m = relate(a, b)
+    for mc, pc in zip(m, pattern):
+        if pc == "*":
+            continue
+        if pc == "T":
+            if mc == "F":
+                return False
+        elif pc != mc:
+            return False
+    return True
+
+
+def equals(a: Geometry, b: Geometry) -> bool:
+    return relate_bool(a, b, "T*F**FFF*")
+
+
+def touches(a: Geometry, b: Geometry) -> bool:
+    if not intersects(a, b):
+        return False
+    m = relate(a, b)
+    return m[0] == "F" and (m[1] != "F" or m[3] != "F" or m[4] != "F")
+
+
+def crosses(a: Geometry, b: Geometry) -> bool:
+    da, db = dimension(a), dimension(b)
+    m = relate(a, b)
+    if da < db:
+        return m[0] != "F" and m[2] != "F"
+    if da > db:
+        return m[0] != "F" and m[6] != "F"
+    if da == 1 and db == 1:
+        return m[0] == "0"
+    return False
+
+
+def overlaps(a: Geometry, b: Geometry) -> bool:
+    da, db = dimension(a), dimension(b)
+    if da != db:
+        return False
+    m = relate(a, b)
+    if da == 1:
+        return m[0] == "1" and m[2] != "F" and m[6] != "F"
+    return m[0] != "F" and m[2] != "F" and m[6] != "F"
+
+
+def covers(a: Geometry, b: Geometry) -> bool:
+    m = relate(a, b)
+    some = m[0] != "F" or m[1] != "F" or m[3] != "F" or m[4] != "F"
+    return some and m[6] == "F" and m[7] == "F"
+
+
+def covered_by(a: Geometry, b: Geometry) -> bool:
+    return covers(b, a)

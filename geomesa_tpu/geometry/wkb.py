@@ -1,0 +1,113 @@
+"""Well-Known Binary codec for the planar geometry model.
+
+Role parity: the reference serializes geometries as WKB/TWKB
+(``geomesa-feature-common/.../serialization/TwkbSerialization.scala``,
+SURVEY.md §2.4) and exposes ``st_geomFromWKB``/``st_asBinary`` Spark UDFs
+(``geomesa-spark-jts/.../udf/GeometricConstructorFunctions.scala``,
+``GeometricOutputFunctions.scala``, SURVEY.md §2.14). This is a from-scratch
+little-endian ISO WKB implementation over numpy coordinate arrays.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+
+__all__ = ["to_wkb", "from_wkb"]
+
+_POINT, _LINESTRING, _POLYGON = 1, 2, 3
+_MULTIPOINT, _MULTILINESTRING, _MULTIPOLYGON = 4, 5, 6
+
+
+def _ring_bytes(c: np.ndarray) -> bytes:
+    return struct.pack("<I", len(c)) + np.ascontiguousarray(
+        c, dtype="<f8"
+    ).tobytes()
+
+
+def to_wkb(g: Geometry) -> bytes:
+    """Serialize as little-endian ISO WKB."""
+    if isinstance(g, Point):
+        return struct.pack("<BIdd", 1, _POINT, g.x, g.y)
+    if isinstance(g, LineString):
+        return struct.pack("<BI", 1, _LINESTRING) + _ring_bytes(g.coords)
+    if isinstance(g, Polygon):
+        rings = g.rings
+        out = [struct.pack("<BII", 1, _POLYGON, len(rings))]
+        out.extend(_ring_bytes(r) for r in rings)
+        return b"".join(out)
+    if isinstance(g, (MultiPoint, MultiLineString, MultiPolygon)):
+        code = {
+            MultiPoint: _MULTIPOINT,
+            MultiLineString: _MULTILINESTRING,
+            MultiPolygon: _MULTIPOLYGON,
+        }[type(g)]
+        out = [struct.pack("<BII", 1, code, len(g.parts))]
+        out.extend(to_wkb(p) for p in g.parts)
+        return b"".join(out)
+    raise TypeError(f"cannot WKB-encode {type(g).__name__}")
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def read(self, fmt: str):
+        vals = struct.unpack_from(fmt, self.data, self.pos)
+        self.pos += struct.calcsize(fmt)
+        return vals
+
+    def coords(self, endian: str, n: int) -> np.ndarray:
+        nbytes = 16 * n
+        a = np.frombuffer(
+            self.data, dtype=f"{endian}f8", count=2 * n, offset=self.pos
+        ).reshape(n, 2)
+        self.pos += nbytes
+        return a.astype(np.float64)
+
+
+def _read_geom(r: _Reader) -> Geometry:
+    (byte_order,) = r.read("<B")
+    endian = "<" if byte_order == 1 else ">"
+    (type_code,) = r.read(f"{endian}I")
+    type_code &= 0xFF  # mask EWKB SRID/Z flags; only 2D supported
+    if type_code == _POINT:
+        x, y = r.read(f"{endian}dd")
+        return Point(x, y)
+    if type_code == _LINESTRING:
+        (n,) = r.read(f"{endian}I")
+        return LineString(r.coords(endian, n))
+    if type_code == _POLYGON:
+        (nrings,) = r.read(f"{endian}I")
+        rings = []
+        for _ in range(nrings):
+            (n,) = r.read(f"{endian}I")
+            rings.append(r.coords(endian, n))
+        return Polygon(rings[0], tuple(rings[1:]))
+    if type_code in (_MULTIPOINT, _MULTILINESTRING, _MULTIPOLYGON):
+        (nparts,) = r.read(f"{endian}I")
+        parts = tuple(_read_geom(r) for _ in range(nparts))
+        cls = {
+            _MULTIPOINT: MultiPoint,
+            _MULTILINESTRING: MultiLineString,
+            _MULTIPOLYGON: MultiPolygon,
+        }[type_code]
+        return cls(parts)
+    raise ValueError(f"unsupported WKB geometry type {type_code}")
+
+
+def from_wkb(data: bytes) -> Geometry:
+    """Parse ISO WKB (either endianness; EWKB type flags masked)."""
+    return _read_geom(_Reader(bytes(data)))

@@ -1,0 +1,13 @@
+"""Spatial analytics surface: geohash + the batched ST_* function library.
+
+Role parity: ``geomesa-utils/.../utils/geohash/`` and the 69-UDF
+``geomesa-spark-jts`` Spark SQL library (SURVEY.md §2.14, §2.18).
+"""
+
+from geomesa_tpu.spatial.geohash import (  # noqa: F401
+    geohash_bbox,
+    geohash_decode,
+    geohash_encode,
+    geohash_neighbors,
+)
+from geomesa_tpu.spatial.st_functions import ST  # noqa: F401

@@ -1,0 +1,122 @@
+"""Vectorized base-32 geohash encode/decode.
+
+Role parity: ``geomesa-utils/src/main/scala/org/locationtech/geomesa/utils/
+geohash/GeoHash.scala`` (SURVEY.md §2.18) and the ``st_geoHash`` family of
+Spark UDFs. Geohash is a bit-interleaved (lon-first) Morton code rendered in
+base-32 — so this reuses the same fixed-point + interleave idiom as the Z
+curves, vectorized over numpy int64 lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.curve.zorder import compact2 as _squash
+from geomesa_tpu.curve.zorder import spread2 as _spread
+
+__all__ = [
+    "geohash_encode",
+    "geohash_decode",
+    "geohash_bbox",
+    "geohash_neighbors",
+]
+
+# 12 chars = 60 bits, the standard maximum (and the most the 31-bit-per-dim
+# spread2 interleave lanes can hold)
+MAX_PRECISION_CHARS = 12
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_BASE32_INV = {c: i for i, c in enumerate(_BASE32)}
+
+
+def geohash_code(lons, lats, precision_bits: int) -> np.ndarray:
+    """The raw interleaved geohash integer (lon bit first), vectorized."""
+    if not 1 <= precision_bits <= 5 * MAX_PRECISION_CHARS:
+        raise ValueError(f"geohash precision must be 1..60 bits: {precision_bits}")
+    lons = np.asarray(lons, dtype=np.float64)
+    lats = np.asarray(lats, dtype=np.float64)
+    lon_bits = (precision_bits + 1) // 2
+    lat_bits = precision_bits // 2
+    li = np.clip(
+        ((lons + 180.0) / 360.0 * (1 << lon_bits)).astype(np.int64),
+        0,
+        (1 << lon_bits) - 1,
+    )
+    la = np.clip(
+        ((lats + 90.0) / 180.0 * (1 << lat_bits)).astype(np.int64),
+        0,
+        (1 << lat_bits) - 1,
+    )
+    # lon comes first counted from the MSB; which parity of bit position that
+    # lands on depends on whether the total bit count is even or odd
+    if precision_bits % 2 == 0:
+        code = (_spread(li) << np.uint64(1)) | _spread(la)
+    else:
+        code = _spread(li) | (_spread(la) << np.uint64(1))
+    return code.astype(np.int64)
+
+
+def geohash_encode(lons, lats, precision_chars: int = 12) -> np.ndarray:
+    """Base-32 geohash strings for arrays of lon/lat (``st_geoHash``)."""
+    bits = precision_chars * 5
+    code = geohash_code(lons, lats, bits).astype(np.uint64)
+    scalar = np.isscalar(lons) or np.ndim(lons) == 0
+    code = np.atleast_1d(code)
+    out = np.empty(len(code), dtype=f"<U{precision_chars}")
+    shifts = [np.uint64(bits - 5 * (k + 1)) for k in range(precision_chars)]
+    chars = np.empty((len(code), precision_chars), dtype="<U1")
+    for k, sh in enumerate(shifts):
+        idx = ((code >> sh) & np.uint64(31)).astype(np.int64)
+        chars[:, k] = np.array(list(_BASE32))[idx]
+    for i in range(len(code)):
+        out[i] = "".join(chars[i])
+    return out[0] if scalar else out
+
+
+def geohash_decode(gh: str) -> tuple[float, float]:
+    """Geohash → (lon, lat) cell-center (``st_geomFromGeoHash`` center)."""
+    xmin, ymin, xmax, ymax = geohash_bbox(gh)
+    return ((xmin + xmax) / 2.0, (ymin + ymax) / 2.0)
+
+
+def geohash_bbox(gh: str) -> tuple[float, float, float, float]:
+    """Geohash → (xmin, ymin, xmax, ymax) cell bounds (``st_box2DFromGeoHash``)."""
+    code = 0
+    for c in gh.lower():
+        code = (code << 5) | _BASE32_INV[c]
+    bits = len(gh) * 5
+    lon_bits = (bits + 1) // 2
+    lat_bits = bits // 2
+    if len(gh) > MAX_PRECISION_CHARS:
+        raise ValueError(f"geohash longer than {MAX_PRECISION_CHARS} chars: {gh!r}")
+    code = np.uint64(code)
+    if bits % 2 == 0:
+        li = int(_squash(code >> np.uint64(1)))
+        la = int(_squash(code))
+    else:
+        li = int(_squash(code))
+        la = int(_squash(code >> np.uint64(1)))
+    lon_size = 360.0 / (1 << lon_bits)
+    lat_size = 180.0 / (1 << lat_bits)
+    xmin = -180.0 + li * lon_size
+    ymin = -90.0 + la * lat_size
+    return (xmin, ymin, xmin + lon_size, ymin + lat_size)
+
+
+def geohash_neighbors(gh: str) -> list[str]:
+    """The 8 neighboring cells at the same precision."""
+    xmin, ymin, xmax, ymax = geohash_bbox(gh)
+    cx, cy = (xmin + xmax) / 2, (ymin + ymax) / 2
+    dx, dy = xmax - xmin, ymax - ymin
+    out = []
+    for oy in (-dy, 0.0, dy):
+        for ox in (-dx, 0.0, dx):
+            if ox == 0.0 and oy == 0.0:
+                continue
+            lon = cx + ox
+            lat = cy + oy
+            if lat <= -90.0 or lat >= 90.0:
+                continue
+            lon = ((lon + 180.0) % 360.0) - 180.0
+            out.append(str(geohash_encode(lon, lat, len(gh))))
+    return out

@@ -1,0 +1,318 @@
+"""ST_* function library, geometry ops, DE-9IM relate, geohash, WKB.
+
+Mirrors the reference's spark-jts test strategy (SURVEY.md §2.14): known-value
+assertions per UDF plus relation truth tables.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import ops
+from geomesa_tpu.geometry.types import (
+    LineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    box,
+)
+from geomesa_tpu.geometry.wkb import from_wkb, to_wkb
+from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
+from geomesa_tpu.spatial import ST, geohash_bbox, geohash_encode, geohash_neighbors
+from geomesa_tpu.spatial.st_functions import st
+
+
+def P(x, y):
+    return Point(x, y)
+
+
+SQ = box(0, 0, 2, 2)  # unit-ish square
+
+
+class TestWkb:
+    @pytest.mark.parametrize(
+        "wkt",
+        [
+            "POINT (1 2)",
+            "LINESTRING (0 0, 1 1, 2 0)",
+            "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+            "MULTIPOINT (0 0, 1 1)",
+            "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+            "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), ((2 2, 3 2, 3 3, 2 3, 2 2)))",
+        ],
+    )
+    def test_round_trip(self, wkt):
+        g = from_wkt(wkt)
+        assert to_wkt(from_wkb(to_wkb(g))) == to_wkt(g)
+
+    def test_point_layout(self):
+        # little-endian, type 1, doubles
+        b = to_wkb(Point(1.0, 2.0))
+        assert b[0] == 1 and int.from_bytes(b[1:5], "little") == 1
+
+
+class TestMeasures:
+    def test_area(self):
+        assert ops.area(SQ) == pytest.approx(4.0)
+        holed = Polygon(SQ.shell, (box(0.5, 0.5, 1.0, 1.0).shell,))
+        assert ops.area(holed) == pytest.approx(4.0 - 0.25)
+        assert ops.area(LineString([[0, 0], [1, 1]])) == 0.0
+
+    def test_length(self):
+        assert ops.length(LineString([[0, 0], [3, 4]])) == pytest.approx(5.0)
+        assert ops.length(SQ) == pytest.approx(8.0)
+
+    def test_centroid(self):
+        c = ops.centroid(SQ)
+        assert (c.x, c.y) == pytest.approx((1.0, 1.0))
+        c = ops.centroid(LineString([[0, 0], [2, 0]]))
+        assert (c.x, c.y) == pytest.approx((1.0, 0.0))
+
+    def test_distance_sphere_known(self):
+        # London -> Paris great-circle ≈ 344 km
+        d = ops.distance_sphere(P(-0.1278, 51.5074), P(2.3522, 48.8566))
+        assert d == pytest.approx(343_500, rel=0.01)
+
+    def test_length_sphere(self):
+        # one degree of longitude at the equator ≈ 111.19 km
+        d = ops.length_sphere(LineString([[0, 0], [1, 0]]))
+        assert d == pytest.approx(111_195, rel=0.001)
+
+
+class TestConstructiveOps:
+    def test_convex_hull(self):
+        g = MultiPoint(tuple(P(x, y) for x, y in [(0, 0), (2, 0), (1, 1), (2, 2), (0, 2), (1, 0.5)]))
+        h = ops.convex_hull(g)
+        assert isinstance(h, Polygon)
+        assert ops.area(h) == pytest.approx(4.0)
+
+    def test_envelope_boundary(self):
+        assert ops.area(ops.envelope(LineString([[0, 0], [2, 1]]))) == pytest.approx(2.0)
+        b = ops.boundary(SQ)
+        assert isinstance(b, LineString) and ops.length(b) == pytest.approx(8.0)
+        bl = ops.boundary(LineString([[0, 0], [1, 0]]))
+        assert isinstance(bl, MultiPoint) and len(bl.parts) == 2
+
+    def test_closest_point(self):
+        cp = ops.closest_point(LineString([[0, 0], [10, 0]]), P(3, 5))
+        assert (cp.x, cp.y) == pytest.approx((3.0, 0.0))
+
+    def test_closest_point_contained(self):
+        # point inside the polygon: distance 0, the point itself is closest
+        cp = ops.closest_point(box(0, 0, 10, 10), P(5, 5))
+        assert (cp.x, cp.y) == (5.0, 5.0)
+        assert ops.distance_sphere(box(0, 0, 10, 10), P(5, 5)) == 0.0
+
+    def test_translate(self):
+        t = ops.translate(P(1, 1), 2, -1)
+        assert (t.x, t.y) == (3.0, 0.0)
+
+    def test_buffer_point(self):
+        buf = ops.buffer_point(P(0, 0), 111_195)  # ~1 degree at equator
+        xmin, ymin, xmax, ymax = buf.bbox
+        assert xmax == pytest.approx(1.0, rel=0.01)
+        assert ymax == pytest.approx(1.0, rel=0.01)
+
+    def test_antimeridian_split(self):
+        g = Polygon(
+            np.array([[170.0, 0], [-170.0, 0], [-170.0, 10], [170.0, 10], [170.0, 0]])
+        )
+        safe = ops.antimeridian_safe(g)
+        assert isinstance(safe, MultiPolygon)
+        assert ops.area(safe) == pytest.approx(200.0)
+
+    def test_antimeridian_split_with_hole(self):
+        g = Polygon(
+            np.array([[170.0, 0], [-170.0, 0], [-170.0, 10], [170.0, 10], [170.0, 0]]),
+            (np.array([[175.0, 2], [-178.0, 2], [-178.0, 8], [175.0, 8], [175.0, 2]]),),
+        )
+        safe = ops.antimeridian_safe(g)
+        assert ops.area(safe) == pytest.approx(200.0 - 42.0)
+
+    def test_validity(self):
+        assert ops.is_valid(SQ)
+        bowtie = Polygon(np.array([[0.0, 0], [2, 2], [2, 0], [0, 2], [0, 0]]))
+        assert not ops.is_valid(bowtie)
+        assert ops.is_simple(LineString([[0, 0], [1, 1]]))
+        assert not ops.is_simple(LineString([[0, 0], [2, 2], [2, 0], [0, 2]]))
+        assert ops.is_ring(LineString(SQ.shell))
+
+
+class TestRelate:
+    def test_overlapping_squares(self):
+        assert ops.relate(box(0, 0, 2, 2), box(1, 1, 3, 3)) == "212101212"
+
+    def test_edge_touching_squares(self):
+        assert ops.relate(box(0, 0, 1, 1), box(1, 0, 2, 1)) == "FF2F11212"
+
+    def test_disjoint_squares(self):
+        assert ops.relate(box(0, 0, 1, 1), box(5, 5, 6, 6)) == "FF2FF1212"
+
+    def test_contains_squares(self):
+        assert ops.relate(box(0, 0, 4, 4), box(1, 1, 2, 2)) == "212FF1FF2"
+
+    def test_equal_squares(self):
+        assert ops.relate(SQ, box(0, 0, 2, 2)) == "2FFF1FFF2"
+        assert ops.equals(SQ, box(0, 0, 2, 2))
+
+    def test_point_in_polygon(self):
+        assert ops.relate(P(1, 1), SQ) == "0FFFFF212"
+        assert ops.relate(SQ, P(1, 1)) == "0F2FF1FF2"
+        assert ops.relate(P(5, 5), SQ) == "FF0FFF212"
+
+    def test_line_crosses_polygon(self):
+        line = LineString([[-1, 1], [3, 1]])
+        m = ops.relate(line, SQ)
+        assert m[0] == "1" and m[2] == "1"  # interior crosses, exits
+        assert ops.crosses(line, SQ)
+
+    def test_crossing_lines(self):
+        a = LineString([[0, 0], [2, 2]])
+        b = LineString([[0, 2], [2, 0]])
+        assert ops.relate(a, b) == "0F1FF0102"
+        assert ops.crosses(a, b)
+        assert not ops.overlaps(a, b)
+
+    def test_overlapping_lines(self):
+        a = LineString([[0, 0], [2, 0]])
+        b = LineString([[1, 0], [3, 0]])
+        m = ops.relate(a, b)
+        assert m[0] == "1"
+        assert ops.overlaps(a, b)
+
+    def test_touching_lines(self):
+        a = LineString([[0, 0], [1, 1]])
+        b = LineString([[1, 1], [2, 0]])
+        assert ops.touches(a, b)
+        assert not ops.crosses(a, b)
+
+    def test_touch_corner_squares(self):
+        assert ops.touches(box(0, 0, 1, 1), box(1, 1, 2, 2))
+
+    def test_overlaps_squares(self):
+        assert ops.overlaps(box(0, 0, 2, 2), box(1, 1, 3, 3))
+        assert not ops.overlaps(box(0, 0, 4, 4), box(1, 1, 2, 2))  # containment
+
+    def test_covers(self):
+        assert ops.covers(box(0, 0, 4, 4), box(1, 1, 2, 2))
+        assert ops.covers(box(0, 0, 4, 4), box(0, 0, 2, 4))  # shared boundary
+        assert not ops.covers(box(0, 0, 2, 2), box(1, 1, 3, 3))
+        assert ops.covered_by(box(1, 1, 2, 2), box(0, 0, 4, 4))
+
+    def test_polygon_in_hole(self):
+        outer = Polygon(box(0, 0, 10, 10).shell, (box(2, 2, 8, 8).shell,))
+        inner = box(4, 4, 6, 6)
+        m = ops.relate(outer, inner)
+        assert m[0] == "F"  # interiors disjoint (inner sits in the hole)
+
+    def test_nested_via_representative_point(self):
+        # concave C-shape vs a square in its notch: centroid would misclassify
+        c_shape = Polygon(
+            np.array([[0.0, 0], [5, 0], [5, 1], [1, 1], [1, 4], [5, 4], [5, 5], [0, 5], [0, 0]])
+        )
+        notch_sq = box(2, 2, 3, 3)
+        m = ops.relate(c_shape, notch_sq)
+        assert m[0] == "F"
+
+
+class TestGeohash:
+    def test_known_value(self):
+        # classic example: Ezequiel's town — geohash "ezs42"
+        assert str(geohash_encode(-5.603, 42.605, 5)) == "ezs42"
+
+    def test_vectorized(self):
+        out = geohash_encode([-5.603, 0.0], [42.605, 0.0], 5)
+        assert list(out) == ["ezs42", "s0000"]
+
+    def test_bbox_round_trip(self):
+        xmin, ymin, xmax, ymax = geohash_bbox("ezs42")
+        assert xmin <= -5.603 <= xmax and ymin <= 42.605 <= ymax
+        assert (xmax - xmin) == pytest.approx(360.0 / 2**13)
+
+    def test_neighbors(self):
+        n = geohash_neighbors("ezs42")
+        assert len(n) == 8 and "ezs42" not in n
+
+    def test_precision_limit(self):
+        with pytest.raises(ValueError):
+            geohash_encode(10.0, 10.0, 13)
+        # max precision round-trips
+        gh = str(geohash_encode(10.0, 10.0, 12))
+        xmin, ymin, xmax, ymax = geohash_bbox(gh)
+        assert xmin <= 10.0 <= xmax and ymin <= 10.0 <= ymax
+
+    def test_encode_decode_random(self):
+        rng = np.random.default_rng(0)
+        lons = rng.uniform(-180, 180, 50)
+        lats = rng.uniform(-90, 90, 50)
+        for gh, lon, lat in zip(geohash_encode(lons, lats, 9), lons, lats):
+            xmin, ymin, xmax, ymax = geohash_bbox(gh)
+            assert xmin <= lon <= xmax and ymin <= lat <= ymax
+
+
+class TestSTRegistry:
+    def test_all_reference_udfs_present(self):
+        # every UDF name registered by the reference's spark-jts module
+        reference_names = [
+            "st_aggregateDistanceSphere", "st_antimeridianSafeGeom", "st_area",
+            "st_asBinary", "st_asGeoJSON", "st_asLatLonText", "st_asText",
+            "st_boundary", "st_box2DFromGeoHash", "st_bufferPoint",
+            "st_byteArray", "st_castToGeometry", "st_castToLineString",
+            "st_castToPoint", "st_castToPolygon", "st_centroid",
+            "st_closestPoint", "st_contains", "st_convexhull", "st_coordDim",
+            "st_covers", "st_crosses", "st_dimension", "st_disjoint",
+            "st_distance", "st_distanceSphere", "st_envelope", "st_equals",
+            "st_exteriorRing", "st_geoHash", "st_geomFromGeoHash",
+            "st_geomFromText", "st_geomFromWKB", "st_geomFromWKT",
+            "st_geometryFromText", "st_geometryN", "st_idlSafeGeom",
+            "st_interiorRingN", "st_intersects", "st_isClosed",
+            "st_isCollection", "st_isEmpty", "st_isRing", "st_isSimple",
+            "st_isValid", "st_length", "st_lengthSphere", "st_lineFromText",
+            "st_mLineFromText", "st_mPointFromText", "st_mPolyFromText",
+            "st_makeBBOX", "st_makeBox2D", "st_makeLine", "st_makePoint",
+            "st_makePointM", "st_numGeometries", "st_numPoints", "st_overlaps",
+            "st_point", "st_pointFromGeoHash", "st_pointFromText",
+            "st_pointFromWKB", "st_pointN", "st_polygon", "st_polygonFromText",
+            "st_relate", "st_relateBool", "st_touches", "st_translate",
+            "st_within", "st_x", "st_y",
+        ]
+        for name in reference_names:
+            assert name.lower() in ST, name
+
+    def test_scalar_calls(self):
+        g = st("st_geomFromText", "POINT (1 2)")
+        assert (st("st_x", g), st("st_y", g)) == (1.0, 2.0)
+        assert st("st_asText", st("st_makeBBOX", 0, 0, 2, 2)) == to_wkt(SQ)
+        assert st("st_contains", SQ, P(1, 1))
+        assert st("st_geoHash", P(-5.603, 42.605), 25) == "ezs42"
+        assert st("st_dimension", SQ) == 2
+        assert "Polygon" in st("st_asGeoJSON", SQ)
+
+    def test_column_calls(self):
+        pts = np.empty(3, dtype=object)
+        pts[:] = [P(1, 1), P(5, 5), P(0, 0)]
+        mask = st("st_contains", SQ, pts)
+        # (0,0) is a corner: boundary contact only, so contains is False (JTS)
+        assert mask.dtype == bool and list(mask) == [True, False, False]
+        cov = st("st_covers", SQ, pts)
+        assert list(cov) == [True, False, True]
+        areas = st("st_area", np.array([SQ, box(0, 0, 1, 1)], dtype=object))
+        assert list(areas) == [4.0, 1.0]
+        # integer accessors keep integer dtype over columns
+        dims = st("st_dimension", np.array([SQ, P(0, 0)], dtype=object))
+        assert dims.dtype == np.int64 and list(dims) == [2, 0]
+
+    def test_wkb_round_trip_udf(self):
+        b = st("st_asBinary", SQ)
+        assert st("st_asText", st("st_geomFromWKB", b)) == to_wkt(SQ)
+
+    def test_make_line_and_polygon(self):
+        line = st("st_makeLine", [P(0, 0), P(1, 0), P(1, 1)])
+        assert st("st_numPoints", line) == 3
+        ring = st("st_makeLine", [P(0, 0), P(1, 0), P(1, 1), P(0, 0)])
+        poly = st("st_polygon", ring)
+        assert st("st_area", poly) == pytest.approx(0.5)
+
+    def test_lat_lon_text(self):
+        txt = st("st_asLatLonText", P(-75.5, 35.25))
+        assert "35°15'" in txt and "N" in txt and "W" in txt
