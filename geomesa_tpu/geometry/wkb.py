@@ -69,32 +69,54 @@ class _Reader:
         self.pos += struct.calcsize(fmt)
         return vals
 
-    def coords(self, endian: str, n: int) -> np.ndarray:
-        nbytes = 16 * n
+    def coords(self, endian: str, n: int, ndim: int) -> np.ndarray:
         a = np.frombuffer(
-            self.data, dtype=f"{endian}f8", count=2 * n, offset=self.pos
-        ).reshape(n, 2)
-        self.pos += nbytes
-        return a.astype(np.float64)
+            self.data, dtype=f"{endian}f8", count=ndim * n, offset=self.pos
+        ).reshape(n, ndim)
+        self.pos += 8 * ndim * n
+        return a[:, :2].astype(np.float64)  # extra Z/M ordinates dropped
+
+
+# EWKB (PostGIS) flag bits on the type word
+_EWKB_Z = 0x80000000
+_EWKB_M = 0x40000000
+_EWKB_SRID = 0x20000000
 
 
 def _read_geom(r: _Reader) -> Geometry:
     (byte_order,) = r.read("<B")
     endian = "<" if byte_order == 1 else ">"
-    (type_code,) = r.read(f"{endian}I")
-    type_code &= 0xFF  # mask EWKB SRID/Z flags; only 2D supported
+    (raw_type,) = r.read(f"{endian}I")
+    ndim = 2
+    if raw_type & (_EWKB_Z | _EWKB_M | _EWKB_SRID):  # PostGIS EWKB
+        if raw_type & _EWKB_Z:
+            ndim += 1
+        if raw_type & _EWKB_M:
+            ndim += 1
+        if raw_type & _EWKB_SRID:
+            r.read(f"{endian}I")  # SRID payload, not modeled
+        type_code = raw_type & 0x0FFFFFFF
+    else:  # ISO WKB: Z=+1000, M=+2000, ZM=+3000
+        type_code = raw_type % 1000
+        flavor = raw_type // 1000
+        if flavor in (1, 2):
+            ndim = 3
+        elif flavor == 3:
+            ndim = 4
+        elif flavor != 0:
+            raise ValueError(f"unsupported WKB geometry type {raw_type}")
     if type_code == _POINT:
-        x, y = r.read(f"{endian}dd")
-        return Point(x, y)
+        vals = r.read(f"{endian}{'d' * ndim}")
+        return Point(vals[0], vals[1])
     if type_code == _LINESTRING:
         (n,) = r.read(f"{endian}I")
-        return LineString(r.coords(endian, n))
+        return LineString(r.coords(endian, n, ndim))
     if type_code == _POLYGON:
         (nrings,) = r.read(f"{endian}I")
         rings = []
         for _ in range(nrings):
             (n,) = r.read(f"{endian}I")
-            rings.append(r.coords(endian, n))
+            rings.append(r.coords(endian, n, ndim))
         return Polygon(rings[0], tuple(rings[1:]))
     if type_code in (_MULTIPOINT, _MULTILINESTRING, _MULTIPOLYGON):
         (nparts,) = r.read(f"{endian}I")
@@ -105,9 +127,13 @@ def _read_geom(r: _Reader) -> Geometry:
             _MULTIPOLYGON: MultiPolygon,
         }[type_code]
         return cls(parts)
-    raise ValueError(f"unsupported WKB geometry type {type_code}")
+    raise ValueError(f"unsupported WKB geometry type {raw_type}")
 
 
 def from_wkb(data: bytes) -> Geometry:
-    """Parse ISO WKB (either endianness; EWKB type flags masked)."""
+    """Parse ISO WKB or PostGIS EWKB (either endianness).
+
+    SRID payloads are skipped and Z/M ordinates dropped — the framework's
+    geometry model is 2D lon/lat.
+    """
     return _read_geom(_Reader(bytes(data)))

@@ -45,6 +45,9 @@ class Query:
     sort_by: tuple[str, bool] | None = None  # (field, descending)
     limit: int | None = None
     hints: dict = field(default_factory=dict)
+    # authorizations for record-level visibility filtering (geomesa-security
+    # role); None = unrestricted, [] = only unlabeled records visible
+    auths: list[str] | None = None
 
     def resolved_filter(self) -> ast.Filter:
         if self.filter is None:

@@ -1,0 +1,7 @@
+"""Record-level security (the ``geomesa-security`` role, SURVEY.md §2.19)."""
+
+from geomesa_tpu.security.visibility import (  # noqa: F401
+    VisibilityExpression,
+    evaluate_column,
+    parse_visibility,
+)

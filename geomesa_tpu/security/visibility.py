@@ -1,0 +1,184 @@
+"""Visibility expression parser/evaluator (record-level security).
+
+Role parity: ``geomesa-security/.../security/VisibilityEvaluator.scala:50``
+(SURVEY.md §2.19) — Accumulo-style visibility expressions like ``admin``,
+``user|admin``, ``alpha&(beta|gamma)``, evaluated against a user's
+authorization set. Per the reference, ``&`` binds tighter than ``|``
+(``user|admin&test`` == ``user|(admin&test)``). Parse results are cached;
+column evaluation vectorizes over the distinct visibility strings in a column
+(typically a handful across millions of rows).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+__all__ = [
+    "VisibilityExpression",
+    "parse_visibility",
+    "evaluate_column",
+    "VisibilityParseError",
+]
+
+# same alphabet as Accumulo Authorizations (VisibilityEvaluator.scala:29-36)
+_AUTH_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-:./"
+)
+
+
+class VisibilityParseError(ValueError):
+    pass
+
+
+class VisibilityExpression:
+    def evaluate(self, auths: frozenset[str]) -> bool:
+        raise NotImplementedError
+
+    def expression(self) -> str:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.expression()
+
+
+class _None(VisibilityExpression):
+    """Empty visibility: visible to everyone."""
+
+    def evaluate(self, auths):
+        return True
+
+    def expression(self):
+        return ""
+
+
+VisibilityNone = _None()
+
+
+@dataclass(frozen=True)
+class _Value(VisibilityExpression):
+    auth: str
+
+    def evaluate(self, auths):
+        return self.auth in auths
+
+    def expression(self):
+        return self.auth
+
+
+@dataclass(frozen=True)
+class _And(VisibilityExpression):
+    children: tuple[VisibilityExpression, ...]
+
+    def evaluate(self, auths):
+        return all(c.evaluate(auths) for c in self.children)
+
+    def expression(self):
+        return "&".join(
+            f"({c.expression()})" if isinstance(c, _Or) else c.expression()
+            for c in self.children
+        )
+
+
+@dataclass(frozen=True)
+class _Or(VisibilityExpression):
+    children: tuple[VisibilityExpression, ...]
+
+    def evaluate(self, auths):
+        return any(c.evaluate(auths) for c in self.children)
+
+    def expression(self):
+        return "|".join(
+            f"({c.expression()})" if isinstance(c, _Or) else c.expression()
+            for c in self.children
+        )
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def error(self, msg: str):
+        raise VisibilityParseError(f"{msg} at position {self.i} in {self.s!r}")
+
+    def peek(self) -> str | None:
+        return self.s[self.i] if self.i < len(self.s) else None
+
+    def parse(self) -> VisibilityExpression:
+        e = self.or_expr()
+        if self.i != len(self.s):
+            self.error("unexpected trailing input")
+        return e
+
+    def or_expr(self) -> VisibilityExpression:
+        terms = [self.and_expr()]
+        while self.peek() == "|":
+            self.i += 1
+            terms.append(self.and_expr())
+        return terms[0] if len(terms) == 1 else _Or(tuple(terms))
+
+    def and_expr(self) -> VisibilityExpression:
+        factors = [self.factor()]
+        while self.peek() == "&":
+            self.i += 1
+            factors.append(self.factor())
+        return factors[0] if len(factors) == 1 else _And(tuple(factors))
+
+    def factor(self) -> VisibilityExpression:
+        c = self.peek()
+        if c == "(":
+            self.i += 1
+            e = self.or_expr()
+            if self.peek() != ")":
+                self.error("expected ')'")
+            self.i += 1
+            return e
+        if c == '"':
+            self.i += 1
+            out = []
+            while (c := self.peek()) not in ('"', None):
+                if c == "\\":
+                    self.i += 1
+                    c = self.peek()
+                    if c is None:
+                        self.error("dangling escape")
+                out.append(c)
+                self.i += 1
+            if self.peek() != '"':
+                self.error("unterminated quote")
+            self.i += 1
+            if not out:
+                self.error("empty quoted auth")
+            return _Value("".join(out))
+        start = self.i
+        while (c := self.peek()) is not None and c in _AUTH_CHARS:
+            self.i += 1
+        if self.i == start:
+            self.error("expected auth token")
+        return _Value(self.s[start : self.i])
+
+
+@lru_cache(maxsize=4096)
+def parse_visibility(expr: str | None) -> VisibilityExpression:
+    """Parse a visibility string; cached (``VisibilityEvaluator.parse``)."""
+    if not expr:
+        return VisibilityNone
+    return _Parser(expr).parse()
+
+
+def evaluate_column(visibilities, auths) -> np.ndarray:
+    """Visibility mask for a column of expression strings vs an auth set.
+
+    Vectorizes over distinct expressions (parse+evaluate once each, broadcast
+    via inverse indices) — the analog of the reference's per-scan filter with
+    its expression cache.
+    """
+    vis = np.asarray(visibilities, dtype=object)
+    aset = frozenset(auths)
+    flat = np.array(["" if v is None else str(v) for v in vis], dtype=object)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    allowed = np.array([parse_visibility(u).evaluate(aset) for u in uniq], dtype=bool)
+    return allowed[inv]

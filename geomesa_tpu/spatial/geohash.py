@@ -27,6 +27,7 @@ MAX_PRECISION_CHARS = 12
 
 _BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
 _BASE32_INV = {c: i for i, c in enumerate(_BASE32)}
+_BASE32_ARR = np.array(list(_BASE32), dtype="<U1")
 
 
 def geohash_code(lons, lats, precision_bits: int) -> np.ndarray:
@@ -62,14 +63,11 @@ def geohash_encode(lons, lats, precision_chars: int = 12) -> np.ndarray:
     code = geohash_code(lons, lats, bits).astype(np.uint64)
     scalar = np.isscalar(lons) or np.ndim(lons) == 0
     code = np.atleast_1d(code)
-    out = np.empty(len(code), dtype=f"<U{precision_chars}")
-    shifts = [np.uint64(bits - 5 * (k + 1)) for k in range(precision_chars)]
     chars = np.empty((len(code), precision_chars), dtype="<U1")
-    for k, sh in enumerate(shifts):
-        idx = ((code >> sh) & np.uint64(31)).astype(np.int64)
-        chars[:, k] = np.array(list(_BASE32))[idx]
-    for i in range(len(code)):
-        out[i] = "".join(chars[i])
+    for k in range(precision_chars):
+        sh = np.uint64(bits - 5 * (k + 1))
+        chars[:, k] = _BASE32_ARR[((code >> sh) & np.uint64(31)).astype(np.int64)]
+    out = np.ascontiguousarray(chars).view(f"<U{precision_chars}").reshape(len(code))
     return out[0] if scalar else out
 
 

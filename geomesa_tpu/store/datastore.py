@@ -74,13 +74,32 @@ class _TypeState:
 
 
 class DataStore:
-    """An in-process spatio-temporal datastore over a pluggable backend."""
+    """An in-process spatio-temporal datastore over a pluggable backend.
 
-    def __init__(self, backend: str | ExecutionBackend = "tpu"):
+    ``audit_writer`` (an :class:`~geomesa_tpu.utils.audit.AuditWriter`) records
+    a ``QueryEvent`` per query; ``metrics`` (a
+    :class:`~geomesa_tpu.utils.metrics.MetricsRegistry`) accumulates
+    query/write counters and timings; ``user`` tags audit records.
+    """
+
+    def __init__(
+        self,
+        backend: str | ExecutionBackend = "tpu",
+        audit_writer=None,
+        metrics=None,
+        user: str = "unknown",
+    ):
         if isinstance(backend, str):
             backend = _BACKENDS[backend]()
         self.backend = backend
         self._types: dict[str, _TypeState] = {}
+        self.audit_writer = audit_writer
+        self.user = user
+        if metrics is None:
+            from geomesa_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
 
     # -- schema CRUD (MetadataBackedDataStore role) --------------------------
     def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
@@ -127,6 +146,7 @@ class DataStore:
                 fids = [f"{type_name}.{base + i}" for i in range(len(data))]
             data = FeatureTable.from_records(st.sft, data, fids)
         self._validate(st.sft, data)
+        self.metrics.counter("store.writes").inc(len(data))
         st.delta.append(data)
         if st.delta.should_compact(st.main_rows):
             self.compact(type_name)
@@ -174,6 +194,16 @@ class DataStore:
                     f"{bad} feature(s) with null date {sft.dtg_field!r}: "
                     "indexed dates must be non-null"
                 )
+        # visibility expressions must parse at write time so one malformed row
+        # can never poison every subsequent auth-filtered read
+        vis_field = sft.user_data.get("geomesa.vis.field")
+        if vis_field:
+            from geomesa_tpu.security.visibility import parse_visibility
+
+            for v in set(
+                "" if v is None else str(v) for v in table.columns[vis_field].values
+            ):
+                parse_visibility(v)  # raises VisibilityParseError on bad input
 
     # -- queries (QueryPlanner.runQuery role) --------------------------------
     def query(
@@ -187,12 +217,18 @@ class DataStore:
                 "pass query options inside the Query object, not as kwargs: "
                 f"{sorted(kwargs)}"
             )
+        import time as _time
+
+        self.metrics.counter("store.queries").inc()
         if st.total_rows == 0:
             empty = FeatureTable.from_records(st.sft, [])
+            self._audit(type_name, q, 0.0, 0.0, 0)
             return QueryResult(empty, np.empty(0, dtype=np.int64))
 
+        t_start = _time.perf_counter()
         f = q.resolved_filter()
         info = None
+        plan_ms = 0.0
         main_n = st.main_rows
         if main_n == 0:
             rows = np.empty(0, dtype=np.int64)
@@ -201,7 +237,9 @@ class DataStore:
             rows = self.backend.select(None, None, None, None, f, st.table)
         else:
             planner = QueryPlanner(st.sft, st.indices, st.stats)
+            t0 = _time.perf_counter()
             plan, f, info = planner.plan(q)
+            plan_ms = (_time.perf_counter() - t0) * 1000.0
             index = st.indices[info.index_name]
             rows = self.backend.select(
                 st.backend_state, index, plan, info.extraction, f, st.table
@@ -217,6 +255,19 @@ class DataStore:
             rows = np.concatenate([rows, drows + main_n])
 
         table = _take_combined(st, delta_table, rows)
+
+        # record-level visibility (geomesa-security role): a schema opting in
+        # via user-data ``geomesa.vis.field`` names a String attribute holding
+        # the per-record visibility expression; rows the caller's auths can't
+        # satisfy are removed before any sampling/aggregation sees them
+        vis_field = st.sft.user_data.get("geomesa.vis.field")
+        if vis_field and q.auths is not None:
+            from geomesa_tpu.security.visibility import evaluate_column
+
+            visible = evaluate_column(table.columns[vis_field].values, q.auths)
+            keep = np.nonzero(visible)[0]
+            table = table.take(keep)
+            rows = rows[keep]
 
         # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of
         # matches, optionally per-group (deterministic every-nth)
@@ -237,6 +288,8 @@ class DataStore:
         if "bin" in q.hints:
             bin_data = _bin_encode(table, q.hints["bin"] or {})
         if density is not None or stats_out is not None or bin_data is not None:
+            scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
+            self._audit(type_name, q, plan_ms, scan_ms, len(table))
             return QueryResult(
                 table, rows, info, density=density, stats=stats_out, bin_data=bin_data
             )
@@ -257,7 +310,31 @@ class DataStore:
             keep = {p: table.columns[p] for p in q.properties}
             table = FeatureTable(table.sft, table.fids, {**keep})
 
+        scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
+        self._audit(type_name, q, plan_ms, scan_ms, len(table))
         return QueryResult(table, rows, info)
+
+    def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float, hits: int) -> None:
+        self.metrics.histogram("store.query.hits").update(hits)
+        self.metrics.histogram("store.query.scan_ms").update(scan_ms)
+        if self.audit_writer is None:
+            return
+        from geomesa_tpu.utils.audit import QueryEvent, now_millis
+
+        filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
+        self.audit_writer.write_event(
+            QueryEvent(
+                store_type=type(self.backend).__name__,
+                type_name=type_name,
+                date=now_millis(),
+                user=self.user,
+                filter=filt,
+                hints=str(sorted(q.hints)) if q.hints else "",
+                plan_time_ms=plan_ms,
+                scan_time_ms=scan_ms,
+                hits=hits,
+            )
+        )
 
     def explain(self, type_name: str, q: Query | str) -> str:
         st = self._state(type_name)

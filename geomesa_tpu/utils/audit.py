@@ -1,0 +1,76 @@
+"""Query audit log (the ``geomesa-utils`` audit + ``QueryEvent`` role).
+
+Role parity: ``geomesa-index-api/.../index/audit/QueryEvent.scala`` and
+``geomesa-utils/.../utils/audit/AuditedEvent.scala`` (SURVEY.md §5): per-query
+records of user, filter, hints, plan/scan timings, and hit counts, written
+through a pluggable ``AuditWriter``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class QueryEvent:
+    """One audited query (``QueryEvent.scala:13``)."""
+
+    store_type: str
+    type_name: str
+    date: int  # epoch millis
+    user: str
+    filter: str
+    hints: str
+    plan_time_ms: float
+    scan_time_ms: float
+    hits: int
+    deleted: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+
+class AuditWriter:
+    """Sink for audited events (``AuditWriter`` role)."""
+
+    def write_event(self, event: QueryEvent) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryAuditWriter(AuditWriter):
+    """Keeps events in a list; the default for tests and notebooks."""
+
+    def __init__(self):
+        self.events: list[QueryEvent] = []
+
+    def write_event(self, event: QueryEvent) -> None:
+        self.events.append(event)
+
+    def query_events(self, type_name: str | None = None) -> list[QueryEvent]:
+        return [
+            e for e in self.events if type_name is None or e.type_name == type_name
+        ]
+
+
+class JsonlAuditWriter(AuditWriter):
+    """Appends one JSON line per event (the audit-table role, greppable)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def write_event(self, event: QueryEvent) -> None:
+        self._fh.write(event.to_json() + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def now_millis() -> int:
+    return int(time.time() * 1000)

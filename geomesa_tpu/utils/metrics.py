@@ -1,0 +1,120 @@
+"""Metrics registry with pluggable reporters.
+
+Role parity: ``geomesa-metrics`` (Dropwizard registry + Ganglia/Graphite/
+CloudWatch/delimited-file reporters, SURVEY.md §2.19). We keep the registry
+shape — named counters, histograms, and timers, snapshot-able and mergeable —
+with a delimited-file reporter and a graphite-format text dump; cloud sinks
+are out of scope in a zero-egress build (stubbed by the text reporters).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Counter:
+    count: int = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+
+
+@dataclass
+class Histogram:
+    """Streaming histogram: count/mean/min/max/variance (Welford)."""
+
+    count: int = 0
+    mean: float = 0.0
+    m2: float = 0.0
+    min: float = math.inf
+    max: float = -math.inf
+
+    def update(self, v: float) -> None:
+        self.count += 1
+        d = v - self.mean
+        self.mean += d / self.count
+        self.m2 += d * (v - self.mean)
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @property
+    def stddev(self) -> float:
+        return math.sqrt(self.m2 / self.count) if self.count else 0.0
+
+
+@dataclass
+class Timer:
+    hist: Histogram = field(default_factory=Histogram)
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.hist.update((time.perf_counter() - t0) * 1000.0)  # ms
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self.counters: dict[str, Counter] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self.timers: dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def histogram(self, name: str) -> Histogram:
+        return self.histograms.setdefault(name, Histogram())
+
+    def timer(self, name: str) -> Timer:
+        return self.timers.setdefault(name, Timer())
+
+    # -- reporters ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        out: dict[str, dict] = {}
+        for k, c in self.counters.items():
+            out[k] = {"type": "counter", "count": c.count}
+        for k, h in self.histograms.items():
+            out[k] = {
+                "type": "histogram",
+                "count": h.count,
+                "mean": h.mean,
+                "min": h.min if h.count else 0.0,
+                "max": h.max if h.count else 0.0,
+                "stddev": h.stddev,
+            }
+        for k, t in self.timers.items():
+            h = t.hist
+            out[k] = {
+                "type": "timer",
+                "count": h.count,
+                "mean_ms": h.mean,
+                "min_ms": h.min if h.count else 0.0,
+                "max_ms": h.max if h.count else 0.0,
+            }
+        return out
+
+    def report_graphite(self, prefix: str = "geomesa") -> str:
+        """Graphite plaintext-protocol dump (``GraphiteReporter`` role)."""
+        ts = int(time.time())
+        lines = []
+        for name, vals in self.snapshot().items():
+            for k, v in vals.items():
+                if k == "type":
+                    continue
+                lines.append(f"{prefix}.{name}.{k} {v} {ts}")
+        return "\n".join(lines)
+
+    def report_delimited(self, path: str, delimiter: str = ",") -> None:
+        """Append a snapshot as delimited rows (``DelimitedFileReporter``)."""
+        ts = int(time.time())
+        with open(path, "a", encoding="utf-8") as fh:
+            for name, vals in self.snapshot().items():
+                typ = vals.pop("type")
+                for k, v in vals.items():
+                    fh.write(delimiter.join([str(ts), typ, name, k, str(v)]) + "\n")

@@ -1,0 +1,182 @@
+"""Visibility security, query audit, and metrics.
+
+Mirrors the reference's ``VisibilityEvaluatorTest`` semantics (`&` binds
+tighter than `|`), auth-filtered reads, and audit/metrics plumbing
+(SURVEY.md §2.19, §5).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.security.visibility import (
+    VisibilityParseError,
+    evaluate_column,
+    parse_visibility,
+)
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.utils.audit import InMemoryAuditWriter, JsonlAuditWriter
+from geomesa_tpu.utils.metrics import MetricsRegistry
+
+
+class TestVisibilityParser:
+    def test_single_auth(self):
+        assert parse_visibility("admin").evaluate(frozenset({"admin"}))
+        assert not parse_visibility("admin").evaluate(frozenset({"user"}))
+
+    def test_empty_visible_to_all(self):
+        assert parse_visibility("").evaluate(frozenset())
+        assert parse_visibility(None).evaluate(frozenset())
+
+    def test_and_or(self):
+        e = parse_visibility("alpha&beta")
+        assert e.evaluate(frozenset({"alpha", "beta"}))
+        assert not e.evaluate(frozenset({"alpha"}))
+        e = parse_visibility("alpha|beta")
+        assert e.evaluate(frozenset({"beta"}))
+        assert not e.evaluate(frozenset({"gamma"}))
+
+    def test_precedence_and_binds_tighter(self):
+        # user|admin&test == user|(admin&test)  (VisibilityEvaluator.scala:43)
+        e = parse_visibility("user|admin&test")
+        assert e.evaluate(frozenset({"user"}))
+        assert e.evaluate(frozenset({"admin", "test"}))
+        assert not e.evaluate(frozenset({"admin"}))
+        # user&admin|test == (user&admin)|test
+        e = parse_visibility("user&admin|test")
+        assert e.evaluate(frozenset({"test"}))
+        assert not e.evaluate(frozenset({"user"}))
+
+    def test_parens(self):
+        e = parse_visibility("alpha&(beta|gamma)")
+        assert e.evaluate(frozenset({"alpha", "gamma"}))
+        assert not e.evaluate(frozenset({"beta", "gamma"}))
+
+    def test_quoted_auth(self):
+        e = parse_visibility('"a b"&c')
+        assert e.evaluate(frozenset({"a b", "c"}))
+
+    def test_round_trip(self):
+        for s in ["admin", "a&b", "a|b&c", "a&(b|c)", "a&b&c|d"]:
+            e = parse_visibility(s)
+            assert parse_visibility(e.expression()).evaluate(
+                frozenset({"a", "b", "c", "d", "admin"})
+            ) == e.evaluate(frozenset({"a", "b", "c", "d", "admin"}))
+
+    @pytest.mark.parametrize("bad", ["a&", "|a", "a b", "(a", 'a&""', "a&&b"])
+    def test_parse_errors(self, bad):
+        with pytest.raises(VisibilityParseError):
+            parse_visibility(bad)
+
+    def test_evaluate_column(self):
+        vis = np.array(["admin", "", "user|admin", "secret&admin", None], dtype=object)
+        mask = evaluate_column(vis, ["admin"])
+        assert list(mask) == [True, True, True, False, True]
+
+
+def _vis_store(backend="oracle"):
+    sft = parse_spec(
+        "tracks",
+        "dtg:Date,*geom:Point:srid=4326,vis:String;geomesa.vis.field='vis'",
+    )
+    ds = DataStore(backend=backend, audit_writer=InMemoryAuditWriter())
+    ds.create_schema(sft)
+    recs = [
+        {"dtg": 1_500_000_000_000 + i, "geom": Point(i, i), "vis": v}
+        for i, v in enumerate(["admin", "", "user|admin", "secret", "admin&ops"])
+    ]
+    ds.write("tracks", FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(5)]))
+    return ds
+
+
+class TestVisibilityQueries:
+    def test_unrestricted_sees_all(self):
+        ds = _vis_store()
+        assert ds.query("tracks").count == 5
+
+    def test_auth_filtering(self):
+        ds = _vis_store()
+        res = ds.query("tracks", Query(auths=["admin"]))
+        assert res.count == 3  # admin, unlabeled, user|admin
+        res = ds.query("tracks", Query(auths=[]))
+        assert res.count == 1  # only unlabeled
+        res = ds.query("tracks", Query(auths=["admin", "ops"]))
+        assert res.count == 4
+
+    def test_malformed_visibility_rejected_at_write(self):
+        sft = parse_spec(
+            "t2", "dtg:Date,*geom:Point:srid=4326,vis:String;geomesa.vis.field='vis'"
+        )
+        ds = DataStore(backend="oracle")
+        ds.create_schema(sft)
+        with pytest.raises(VisibilityParseError):
+            ds.write(
+                "t2",
+                [{"dtg": 1, "geom": Point(0, 0), "vis": "a&&b"}],
+            )
+        # the failed write left nothing behind; valid writes still work
+        assert ds.query("t2").count == 0
+        ds.write("t2", [{"dtg": 1, "geom": Point(0, 0), "vis": "a&b"}])
+        assert ds.query("t2", Query(auths=["a", "b"])).count == 1
+
+    def test_visibility_applies_before_aggregation(self):
+        ds = _vis_store()
+        res = ds.query(
+            "tracks",
+            Query(auths=[], hints={"stats": "Count()"}),
+        )
+        assert res.stats["Count()"].count == 1
+
+
+class TestAudit:
+    def test_events_recorded(self):
+        ds = _vis_store()
+        ds.query("tracks", "BBOX(geom, -1, -1, 2.5, 2.5)")
+        events = ds.audit_writer.query_events("tracks")
+        assert len(events) == 1
+        e = events[0]
+        assert e.hits == 3 and "BBOX" in e.filter and e.user == "unknown"
+        assert e.scan_time_ms >= 0.0
+
+    def test_jsonl_writer(self, tmp_path):
+        path = str(tmp_path / "audit.jsonl")
+        ds = _vis_store()
+        ds.audit_writer = JsonlAuditWriter(path)
+        ds.query("tracks")
+        ds.audit_writer.close()
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["type_name"] == "tracks" and rec["hits"] == 5
+
+
+class TestMetrics:
+    def test_counters_and_histograms(self):
+        ds = _vis_store()
+        ds.query("tracks")
+        ds.query("tracks")
+        snap = ds.metrics.snapshot()
+        assert snap["store.queries"]["count"] == 2
+        assert snap["store.writes"]["count"] == 5
+        assert snap["store.query.hits"]["count"] == 2
+        assert snap["store.query.hits"]["mean"] == 5.0
+
+    def test_timer(self):
+        reg = MetricsRegistry()
+        with reg.timer("t").time():
+            pass
+        assert reg.snapshot()["t"]["count"] == 1
+
+    def test_reporters(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(3)
+        txt = reg.report_graphite("gm")
+        assert "gm.c.count 3 " in txt
+        path = str(tmp_path / "metrics.csv")
+        reg.report_delimited(path)
+        assert "counter,c,count,3" in open(path).read()

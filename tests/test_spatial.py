@@ -50,6 +50,21 @@ class TestWkb:
         b = to_wkb(Point(1.0, 2.0))
         assert b[0] == 1 and int.from_bytes(b[1:5], "little") == 1
 
+    def test_ewkb_srid_and_z(self):
+        import struct
+
+        # PostGIS EWKB: SRID flag carries a 4-byte SRID payload to skip
+        ewkb = struct.pack("<BII", 1, 0x20000001, 4326) + struct.pack("<dd", 1.5, 2.5)
+        g = from_wkb(ewkb)
+        assert (g.x, g.y) == (1.5, 2.5)
+        # EWKB Z flag: 3 ordinates per point, Z dropped
+        zwkb = struct.pack("<BI", 1, 0x80000001) + struct.pack("<ddd", 1.0, 2.0, 9.9)
+        g = from_wkb(zwkb)
+        assert (g.x, g.y) == (1.0, 2.0)
+        # ISO WKB Z: type 1002 = LineString Z
+        iso = struct.pack("<BII", 1, 1002, 2) + struct.pack("<dddddd", 0, 0, 5, 1, 1, 6)
+        assert to_wkt(from_wkb(iso)) == "LINESTRING (0 0, 1 1)"
+
 
 class TestMeasures:
     def test_area(self):
