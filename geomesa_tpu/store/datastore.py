@@ -109,6 +109,11 @@ class DataStore:
             sft = parse_spec(sft, spec)
         if sft.name in self._types:
             raise ValueError(f"schema already exists: {sft.name}")
+        vis_field = sft.user_data.get("geomesa.vis.field")
+        if vis_field and vis_field not in {a.name for a in sft.attributes}:
+            raise ValueError(
+                f"geomesa.vis.field names unknown attribute {vis_field!r}"
+            )
         self._types[sft.name] = _TypeState(sft=sft, indices=build_indices(sft))
         return sft
 
@@ -322,6 +327,7 @@ class DataStore:
         from geomesa_tpu.utils.audit import QueryEvent, now_millis
 
         filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
+        hints = ", ".join(f"{k}={v!r}" for k, v in sorted(q.hints.items()))
         self.audit_writer.write_event(
             QueryEvent(
                 store_type=type(self.backend).__name__,
@@ -329,7 +335,7 @@ class DataStore:
                 date=now_millis(),
                 user=self.user,
                 filter=filt,
-                hints=str(sorted(q.hints)) if q.hints else "",
+                hints=hints,
                 plan_time_ms=plan_ms,
                 scan_time_ms=scan_ms,
                 hits=hits,

@@ -1,0 +1,15 @@
+"""Streaming feature store: message bus, live cache, streaming datastore.
+
+Role parity: ``geomesa-kafka`` (SURVEY.md §2.10) — writes publish change
+messages to a topic, readers maintain a continuously-updated in-memory feature
+cache with a local spatial index and event-time expiry, queries are served
+from the cache.
+"""
+
+from geomesa_tpu.stream.messages import (  # noqa: F401
+    Clear,
+    Delete,
+    GeoMessageSerializer,
+    Put,
+)
+from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore  # noqa: F401

@@ -1,0 +1,82 @@
+"""Live feature cache with spatial index and event-time expiry.
+
+Role parity: ``geomesa-kafka/.../kafka/index/KafkaFeatureCache.scala`` +
+``FeatureStateFactory.scala`` (SURVEY.md §2.10): fid → latest feature state,
+a local spatial index over current positions, and event-time expiry that drops
+features older than a configured age.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+from geomesa_tpu.schema.sft import FeatureType
+from geomesa_tpu.utils.spatial_index import SizeSeparatedBucketIndex, SpatialIndex
+
+__all__ = ["FeatureState", "FeatureCache"]
+
+
+@dataclass
+class FeatureState:
+    fid: str
+    record: dict
+    ts: int  # event time, epoch millis
+    bounds: tuple[float, float, float, float] | None
+
+
+class FeatureCache:
+    def __init__(
+        self,
+        sft: FeatureType,
+        expiry_ms: int | None = None,
+        index: SpatialIndex | None = None,
+    ):
+        self.sft = sft
+        self.expiry_ms = expiry_ms
+        self.index = index if index is not None else SizeSeparatedBucketIndex()
+        self._states: dict[str, FeatureState] = {}
+
+    def put(self, fid: str, record: dict, ts: int) -> None:
+        """Upsert: last write (by arrival order, like the reference) wins."""
+        old = self._states.get(fid)
+        if old is not None and old.bounds is not None:
+            self.index.remove(old.bounds, fid)
+        geom = record.get(self.sft.geom_field) if self.sft.geom_field else None
+        bounds = geom.bbox if geom is not None else None
+        state = FeatureState(fid, record, ts, bounds)
+        self._states[fid] = state
+        if bounds is not None:
+            self.index.insert(bounds, fid, state)
+
+    def delete(self, fid: str) -> None:
+        old = self._states.pop(fid, None)
+        if old is not None and old.bounds is not None:
+            self.index.remove(old.bounds, fid)
+
+    def clear(self) -> None:
+        self._states.clear()
+        self.index.clear()
+
+    def expire(self, now_ms: int) -> int:
+        """Drop features whose event time is older than the expiry window."""
+        if self.expiry_ms is None:
+            return 0
+        cutoff = now_ms - self.expiry_ms
+        stale = [fid for fid, s in self._states.items() if s.ts < cutoff]
+        for fid in stale:
+            self.delete(fid)
+        return len(stale)
+
+    def get(self, fid: str) -> FeatureState | None:
+        return self._states.get(fid)
+
+    def size(self) -> int:
+        return len(self._states)
+
+    def states(self) -> Iterator[FeatureState]:
+        return iter(self._states.values())
+
+    def query_bbox(self, bounds) -> Iterator[FeatureState]:
+        """Candidate states whose envelope bucket overlaps ``bounds``."""
+        return self.index.query(bounds)

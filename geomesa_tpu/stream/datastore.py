@@ -1,0 +1,177 @@
+"""In-process message bus + streaming datastore over live feature caches.
+
+Role parity: ``geomesa-kafka/.../data/KafkaDataStore.scala:52,232,355`` and
+``KafkaCacheLoader.scala`` (SURVEY.md §2.10): one topic per feature type;
+writers publish serialized change messages; each consumer group materializes
+the topic into a :class:`~geomesa_tpu.stream.cache.FeatureCache`; queries run
+against the cache through the same vectorized filter machinery as the batch
+store (the ``KafkaQueryRunner``-over-``LocalQueryRunner`` pattern). The bus is
+in-process (partitions + offsets, synchronous dispatch) — the Kafka broker
+role without a broker; swapping in a real bus only needs `publish`/`poll`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+from geomesa_tpu.stream.cache import FeatureCache
+from geomesa_tpu.stream.messages import Clear, Delete, GeoMessageSerializer, Put
+from geomesa_tpu.store.datastore import QueryResult
+
+__all__ = ["MessageBus", "StreamingDataStore"]
+
+
+class MessageBus:
+    """Minimal in-process topic bus: partitioned append logs + subscribers."""
+
+    def __init__(self, partitions: int = 4):
+        self.partitions = partitions
+        self._logs: dict[str, list[list[bytes]]] = {}
+        self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
+
+    def create_topic(self, topic: str) -> None:
+        self._logs.setdefault(topic, [[] for _ in range(self.partitions)])
+
+    def publish(self, topic: str, key: str, data: bytes) -> None:
+        self.create_topic(topic)
+        part = hash(key) % self.partitions if key else 0
+        self._logs[topic][part].append(data)
+        for cb in self._subscribers.get(topic, []):
+            cb(data)
+
+    def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
+        """Register a consumer; replays the existing log first (offset 0)."""
+        self.create_topic(topic)
+        for part in self._logs[topic]:
+            for data in part:
+                callback(data)
+        self._subscribers.setdefault(topic, []).append(callback)
+
+    def topic_size(self, topic: str) -> int:
+        return sum(len(p) for p in self._logs.get(topic, []))
+
+
+class StreamingDataStore:
+    """Feature store over a message bus (``KafkaDataStore`` role).
+
+    ``expiry_ms``: event-time expiry window for cached features (the
+    reference's ``geomesa.kafka.expiry``); ``None`` keeps everything.
+    """
+
+    def __init__(self, bus: MessageBus | None = None, expiry_ms: int | None = None):
+        self.bus = bus if bus is not None else MessageBus()
+        self.expiry_ms = expiry_ms
+        self._types: dict[str, FeatureType] = {}
+        self._serializers: dict[str, GeoMessageSerializer] = {}
+        self._caches: dict[str, FeatureCache] = {}
+
+    # -- schema --------------------------------------------------------------
+    def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec)
+        if sft.name in self._types:
+            raise ValueError(f"schema already exists: {sft.name}")
+        self._types[sft.name] = sft
+        self._serializers[sft.name] = GeoMessageSerializer(sft)
+        cache = FeatureCache(sft, expiry_ms=self.expiry_ms)
+        self._caches[sft.name] = cache
+        ser = self._serializers[sft.name]
+
+        def consume(data: bytes, _cache=cache, _ser=ser):
+            msg = _ser.deserialize(data)
+            if isinstance(msg, Put):
+                _cache.put(msg.fid, msg.record, msg.ts)
+            elif isinstance(msg, Delete):
+                _cache.delete(msg.fid)
+            elif isinstance(msg, Clear):
+                _cache.clear()
+
+        self.bus.subscribe(self._topic(sft.name), consume)
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._types[name]
+
+    def list_schemas(self) -> list[str]:
+        return sorted(self._types)
+
+    @staticmethod
+    def _topic(type_name: str) -> str:
+        return f"geomesa-{type_name}"
+
+    # -- writes (publish change messages) ------------------------------------
+    def put(self, type_name: str, fid: str, record: dict, ts: int | None = None) -> None:
+        ser = self._serializers[type_name]
+        ts = int(time.time() * 1000) if ts is None else ts
+        self.bus.publish(self._topic(type_name), fid, ser.serialize(Put(fid, record, ts)))
+
+    def delete(self, type_name: str, fid: str, ts: int | None = None) -> None:
+        ser = self._serializers[type_name]
+        ts = int(time.time() * 1000) if ts is None else ts
+        self.bus.publish(self._topic(type_name), fid, ser.serialize(Delete(fid, ts)))
+
+    def clear(self, type_name: str, ts: int | None = None) -> None:
+        ser = self._serializers[type_name]
+        ts = int(time.time() * 1000) if ts is None else ts
+        self.bus.publish(self._topic(type_name), "", ser.serialize(Clear(ts)))
+
+    # -- reads (KafkaQueryRunner role) ---------------------------------------
+    def cache(self, type_name: str) -> FeatureCache:
+        return self._caches[type_name]
+
+    def query(
+        self,
+        type_name: str,
+        q: Query | str | None = None,
+        now_ms: int | None = None,
+        **kwargs,
+    ) -> QueryResult:
+        sft = self._types[type_name]
+        cache = self._caches[type_name]
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
+        cache.expire(now_ms)
+        if isinstance(q, str) or q is None:
+            q = Query(filter=q, **kwargs)
+
+        f = q.resolved_filter()
+
+        # bbox pre-filter through the live spatial index when the filter has
+        # spatial bounds; otherwise all current states are candidates
+        from geomesa_tpu.filter.bounds import extract
+
+        e = extract(f, sft.geom_field, sft.dtg_field)
+        if e.boxes:
+            seen: dict[str, object] = {}
+            for b in e.boxes:
+                for s in cache.query_bbox(b):
+                    seen[s.fid] = s
+            states = list(seen.values())
+        else:
+            states = list(cache.states())
+
+        states.sort(key=lambda s: s.fid)
+        fids = [s.fid for s in states]
+        table = FeatureTable.from_records(sft, [s.record for s in states], fids)
+        mask = f.mask(table)
+        rows = np.nonzero(mask)[0]
+        table = table.take(rows)
+
+        if q.sort_by is not None:
+            fld, desc = q.sort_by
+            keys = table.fids if fld == "id" else table.columns[fld].values
+            order = np.argsort(keys, kind="stable")
+            if desc:
+                order = order[::-1]
+            table = table.take(order)
+            rows = rows[order]
+        if q.limit is not None:
+            table = table.take(np.arange(min(q.limit, len(table))))
+            rows = rows[: q.limit]
+        return QueryResult(table, rows)

@@ -1,0 +1,152 @@
+"""Change messages + binary serialization for the streaming store.
+
+Role parity: ``geomesa-kafka/.../utils/GeoMessageSerializer.scala`` (SURVEY.md
+§2.10): three message kinds — put (upsert a feature), delete (by fid), clear
+(drop everything) — with a compact binary wire format so the bus carries bytes,
+not Python objects. Geometry attributes ride as WKB; dates as int64 epoch
+millis; a null bitmap covers missing attributes.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Any
+
+from geomesa_tpu.geometry.types import Geometry
+from geomesa_tpu.geometry.wkb import from_wkb, to_wkb
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+__all__ = ["Put", "Delete", "Clear", "GeoMessageSerializer"]
+
+_K_PUT, _K_DELETE, _K_CLEAR = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class Put:
+    fid: str
+    record: dict
+    ts: int  # event-time epoch millis
+
+
+@dataclass(frozen=True)
+class Delete:
+    fid: str
+    ts: int
+
+
+@dataclass(frozen=True)
+class Clear:
+    ts: int
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<I", len(b)) + b
+
+
+class _Cursor:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def unpack(self, fmt: str):
+        vals = struct.unpack_from(fmt, self.data, self.pos)
+        self.pos += struct.calcsize(fmt)
+        return vals
+
+    def take(self, n: int) -> bytes:
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def unpack_str(self) -> str:
+        (n,) = self.unpack("<I")
+        return self.take(n).decode("utf-8")
+
+
+class GeoMessageSerializer:
+    """Schema-bound message codec (one per feature type, like the reference)."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+
+    def serialize(self, msg: Put | Delete | Clear) -> bytes:
+        if isinstance(msg, Clear):
+            return struct.pack("<Bq", _K_CLEAR, msg.ts)
+        if isinstance(msg, Delete):
+            return struct.pack("<Bq", _K_DELETE, msg.ts) + _pack_str(msg.fid)
+        out = [struct.pack("<Bq", _K_PUT, msg.ts), _pack_str(msg.fid)]
+        attrs = self.sft.attributes
+        null_bits = 0
+        for i, a in enumerate(attrs):
+            if msg.record.get(a.name) is None:
+                null_bits |= 1 << i
+        out.append(struct.pack("<Q", null_bits))
+        for a in attrs:
+            v = msg.record.get(a.name)
+            if v is None:
+                continue
+            out.append(self._encode_value(a.type, v))
+        return b"".join(out)
+
+    def deserialize(self, data: bytes) -> Put | Delete | Clear:
+        c = _Cursor(data)
+        kind, ts = c.unpack("<Bq")
+        if kind == _K_CLEAR:
+            return Clear(ts)
+        if kind == _K_DELETE:
+            return Delete(c.unpack_str(), ts)
+        fid = c.unpack_str()
+        (null_bits,) = c.unpack("<Q")
+        record: dict[str, Any] = {}
+        for i, a in enumerate(self.sft.attributes):
+            if null_bits & (1 << i):
+                record[a.name] = None
+            else:
+                record[a.name] = self._decode_value(a.type, c)
+        return Put(fid, record, ts)
+
+    @staticmethod
+    def _encode_value(typ: AttributeType, v) -> bytes:
+        if typ.is_geometry:
+            assert isinstance(v, Geometry)
+            b = to_wkb(v)
+            return struct.pack("<I", len(b)) + b
+        if typ == AttributeType.DATE:
+            return struct.pack("<q", int(v))
+        if typ == AttributeType.INT:
+            return struct.pack("<i", int(v))
+        if typ == AttributeType.LONG:
+            return struct.pack("<q", int(v))
+        if typ == AttributeType.FLOAT:
+            return struct.pack("<f", float(v))
+        if typ == AttributeType.DOUBLE:
+            return struct.pack("<d", float(v))
+        if typ == AttributeType.BOOLEAN:
+            return struct.pack("<B", 1 if v else 0)
+        if typ == AttributeType.BYTES:
+            return struct.pack("<I", len(v)) + bytes(v)
+        return _pack_str(str(v))  # STRING/UUID + anything stringly
+
+    @staticmethod
+    def _decode_value(typ: AttributeType, c: _Cursor):
+        if typ.is_geometry:
+            (n,) = c.unpack("<I")
+            return from_wkb(c.take(n))
+        if typ == AttributeType.DATE:
+            return c.unpack("<q")[0]
+        if typ == AttributeType.INT:
+            return c.unpack("<i")[0]
+        if typ == AttributeType.LONG:
+            return c.unpack("<q")[0]
+        if typ == AttributeType.FLOAT:
+            return c.unpack("<f")[0]
+        if typ == AttributeType.DOUBLE:
+            return c.unpack("<d")[0]
+        if typ == AttributeType.BOOLEAN:
+            return bool(c.unpack("<B")[0])
+        if typ == AttributeType.BYTES:
+            (n,) = c.unpack("<I")
+            return c.take(n)
+        return c.unpack_str()

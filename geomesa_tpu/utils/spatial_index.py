@@ -1,0 +1,187 @@
+"""Local in-memory spatial indexes for live feature caches.
+
+Role parity: ``geomesa-utils/.../utils/index/`` (SURVEY.md §2.18) —
+``SpatialIndex`` trait with ``BucketIndex`` (fixed grid of buckets) and
+``SizeSeparatedBucketIndex`` (tiered grids so large geometries don't smear
+across thousands of cells). These back the streaming store's live cache
+(``KafkaFeatureCache`` role, §2.10); the TPU columnar path does NOT use them —
+they exist for low-latency point lookups on the host over mutating data.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator
+
+__all__ = ["SpatialIndex", "BucketIndex", "SizeSeparatedBucketIndex"]
+
+
+class SpatialIndex:
+    """Mutable (envelope, id) → value index (``SpatialIndex`` trait role)."""
+
+    def insert(self, bounds: tuple[float, float, float, float], fid: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def remove(self, bounds: tuple[float, float, float, float], fid: str) -> Any:
+        raise NotImplementedError
+
+    def get(self, bounds: tuple[float, float, float, float], fid: str) -> Any:
+        raise NotImplementedError
+
+    def query(self, bounds: tuple[float, float, float, float]) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def values(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class BucketIndex(SpatialIndex):
+    """Fixed lon/lat grid of buckets (``BucketIndex.scala`` role).
+
+    Each entry is stored in every bucket its envelope overlaps; queries union
+    the buckets covering the query envelope. Best for point data (one bucket
+    per entry).
+    """
+
+    def __init__(
+        self,
+        x_buckets: int = 360,
+        y_buckets: int = 180,
+        extents: tuple[float, float, float, float] = (-180.0, -90.0, 180.0, 90.0),
+    ):
+        self.nx = x_buckets
+        self.ny = y_buckets
+        self.xmin, self.ymin, self.xmax, self.ymax = extents
+        self.dx = (self.xmax - self.xmin) / x_buckets
+        self.dy = (self.ymax - self.ymin) / y_buckets
+        self._buckets: dict[tuple[int, int], dict[str, Any]] = {}
+        self._count = 0
+
+    def _cell_range(self, bounds):
+        bxmin, bymin, bxmax, bymax = bounds
+        i0 = min(max(int((bxmin - self.xmin) / self.dx), 0), self.nx - 1)
+        i1 = min(max(int((bxmax - self.xmin) / self.dx), 0), self.nx - 1)
+        j0 = min(max(int((bymin - self.ymin) / self.dy), 0), self.ny - 1)
+        j1 = min(max(int((bymax - self.ymin) / self.dy), 0), self.ny - 1)
+        return i0, i1, j0, j1
+
+    def insert(self, bounds, fid, value) -> None:
+        i0, i1, j0, j1 = self._cell_range(bounds)
+        fresh = False
+        for i in range(i0, i1 + 1):
+            for j in range(j0, j1 + 1):
+                cell = self._buckets.setdefault((i, j), {})
+                if fid not in cell:
+                    fresh = True
+                cell[fid] = value
+        if fresh:
+            self._count += 1
+
+    def remove(self, bounds, fid) -> Any:
+        i0, i1, j0, j1 = self._cell_range(bounds)
+        out = None
+        hit = False
+        for i in range(i0, i1 + 1):
+            for j in range(j0, j1 + 1):
+                cell = self._buckets.get((i, j))
+                if cell and fid in cell:
+                    out = cell.pop(fid)
+                    hit = True
+                    if not cell:
+                        del self._buckets[(i, j)]
+        if hit:
+            self._count -= 1
+        return out
+
+    def get(self, bounds, fid) -> Any:
+        i0, i1, j0, j1 = self._cell_range(bounds)
+        for i in range(i0, i1 + 1):
+            for j in range(j0, j1 + 1):
+                cell = self._buckets.get((i, j))
+                if cell and fid in cell:
+                    return cell[fid]
+        return None
+
+    def query(self, bounds) -> Iterator[Any]:
+        i0, i1, j0, j1 = self._cell_range(bounds)
+        seen: set[int] = set()
+        for i in range(i0, i1 + 1):
+            for j in range(j0, j1 + 1):
+                cell = self._buckets.get((i, j))
+                if not cell:
+                    continue
+                for fid, v in cell.items():
+                    key = id(v)
+                    if key not in seen:
+                        seen.add(key)
+                        yield v
+
+    def values(self) -> Iterator[Any]:
+        seen: set[int] = set()
+        for cell in self._buckets.values():
+            for v in cell.values():
+                if id(v) not in seen:
+                    seen.add(id(v))
+                    yield v
+
+    def size(self) -> int:
+        return self._count
+
+    def clear(self) -> None:
+        self._buckets.clear()
+        self._count = 0
+
+
+class SizeSeparatedBucketIndex(SpatialIndex):
+    """Tiered grids by geometry extent (``SizeSeparatedBucketIndex.scala``).
+
+    An envelope goes into the coarsest tier whose cell size covers it, so big
+    polygons land in few coarse cells instead of thousands of fine ones.
+    """
+
+    # tier cell sizes in degrees, fine → coarse
+    TIERS = (1.0, 4.0, 16.0, 64.0, 360.0)
+
+    def __init__(self):
+        self._tiers = [
+            BucketIndex(max(int(360 / t), 1), max(int(180 / t), 1)) for t in self.TIERS
+        ]
+
+    def _tier_for(self, bounds) -> BucketIndex:
+        w = bounds[2] - bounds[0]
+        h = bounds[3] - bounds[1]
+        ext = max(w, h)
+        for size, tier in zip(self.TIERS, self._tiers):
+            if ext <= size:
+                return tier
+        return self._tiers[-1]
+
+    def insert(self, bounds, fid, value) -> None:
+        self._tier_for(bounds).insert(bounds, fid, value)
+
+    def remove(self, bounds, fid) -> Any:
+        return self._tier_for(bounds).remove(bounds, fid)
+
+    def get(self, bounds, fid) -> Any:
+        return self._tier_for(bounds).get(bounds, fid)
+
+    def query(self, bounds) -> Iterator[Any]:
+        for tier in self._tiers:
+            yield from tier.query(bounds)
+
+    def values(self) -> Iterator[Any]:
+        for tier in self._tiers:
+            yield from tier.values()
+
+    def size(self) -> int:
+        return sum(t.size() for t in self._tiers)
+
+    def clear(self) -> None:
+        for t in self._tiers:
+            t.clear()

@@ -143,6 +143,20 @@ class TestAudit:
         assert e.hits == 3 and "BBOX" in e.filter and e.user == "unknown"
         assert e.scan_time_ms >= 0.0
 
+    def test_hints_values_recorded(self):
+        ds = _vis_store()
+        ds.query("tracks", Query(hints={"stats": "Count()", "sample": 0.5}))
+        e = ds.audit_writer.events[-1]
+        assert "stats='Count()'" in e.hints and "sample=0.5" in e.hints
+
+    def test_bad_vis_field_rejected_at_create(self):
+        with pytest.raises(ValueError, match="viz"):
+            DataStore(backend="oracle").create_schema(
+                parse_spec(
+                    "bad", "dtg:Date,*geom:Point,vis:String;geomesa.vis.field='viz'"
+                )
+            )
+
     def test_jsonl_writer(self, tmp_path):
         path = str(tmp_path / "audit.jsonl")
         ds = _vis_store()
