@@ -151,7 +151,7 @@ def cmd_export(args):
 
             out.write(to_ipc_bytes(r.table))
         elif args.format == "bin":
-            from geomesa_tpu.store.datastore import _bin_encode
+            from geomesa_tpu.store.reduce import bin_encode as _bin_encode
 
             out.write(_bin_encode(r.table, {"track": args.bin_track, "sort": True}))
         else:

@@ -167,6 +167,10 @@ class DataStore:
         table = (
             delta if st.table is None else FeatureTable.concat([st.table, delta])
         )
+        self._rebuild(st, table)
+
+    def _rebuild(self, st: _TypeState, table: FeatureTable) -> None:
+        """Swap in a new main tier built from ``table`` (delta folded in)."""
         indices = build_indices(st.sft)
         for index in indices.values():
             index.build(table)
@@ -180,6 +184,45 @@ class DataStore:
         st.backend_state = backend_state
         st.stats = stats
         st.delta.clear()
+
+    # -- age-off (AgeOffIterator / DtgAgeOffIterator role) --------------------
+    @staticmethod
+    def _age_off_ttl_ms(sft: FeatureType) -> int | None:
+        """TTL from schema user-data ``geomesa.age.off`` (milliseconds)."""
+        v = sft.user_data.get("geomesa.age.off")
+        return None if v is None else int(v)
+
+    def age_off(self, type_name: str, now_ms: int | None = None) -> int:
+        """Physically drop rows older than the schema's TTL; returns count.
+
+        The query path also masks expired rows on the fly, so ``age_off`` is a
+        maintenance compaction (the reference runs the same logic as a
+        server-side iterator at scan AND at major compaction —
+        ``AgeOffIterator``, SURVEY.md §2.3/§2.6).
+        """
+        st = self._state(type_name)
+        ttl = self._age_off_ttl_ms(st.sft)
+        if ttl is None or st.sft.dtg_field is None or st.total_rows == 0:
+            return 0
+        import time as _time
+
+        cutoff = (int(_time.time() * 1000) if now_ms is None else now_ms) - ttl
+        delta = st.delta.merged()
+        parts = [t for t in (st.table, delta) if t is not None]
+        table = FeatureTable.concat(parts) if len(parts) > 1 else parts[0]
+        keep = table.columns[st.sft.dtg_field].values >= cutoff
+        removed = int((~keep).sum())
+        if removed == 0:
+            return 0
+        if keep.any():
+            self._rebuild(st, table.take(np.nonzero(keep)[0]))
+        else:  # everything expired: reset to empty
+            st.table = None
+            st.indices = build_indices(st.sft)
+            st.backend_state = None
+            st.stats = None
+            st.delta.clear()
+        return removed
 
     @staticmethod
     def _validate(sft: FeatureType, table: FeatureTable) -> None:
@@ -230,6 +273,18 @@ class DataStore:
             self._audit(type_name, q, 0.0, 0.0, 0)
             return QueryResult(empty, np.empty(0, dtype=np.int64))
 
+        # query-time age-off (AgeOffIterator-at-scan role): expired rows are
+        # masked even before a physical age_off() compaction runs
+        ttl = self._age_off_ttl_ms(st.sft)
+        if ttl is not None and st.sft.dtg_field is not None:
+            from dataclasses import replace as _replace
+
+            now_ms = q.hints.get("now_ms")
+            if now_ms is None:
+                now_ms = int(_time.time() * 1000)
+            cut = ast.Compare(">=", st.sft.dtg_field, now_ms - ttl)
+            q = _replace(q, filter=ast.And((q.resolved_filter(), cut)))
+
         t_start = _time.perf_counter()
         f = q.resolved_filter()
         info = None
@@ -261,63 +316,18 @@ class DataStore:
 
         table = _take_combined(st, delta_table, rows)
 
-        # record-level visibility (geomesa-security role): a schema opting in
-        # via user-data ``geomesa.vis.field`` names a String attribute holding
-        # the per-record visibility expression; rows the caller's auths can't
-        # satisfy are removed before any sampling/aggregation sees them
-        vis_field = st.sft.user_data.get("geomesa.vis.field")
-        if vis_field and q.auths is not None:
-            from geomesa_tpu.security.visibility import evaluate_column
+        # shared post-scan pipeline: visibility, sampling, aggregation hints,
+        # sort/limit/projection/CRS (LocalQueryRunner-shape, store/reduce.py)
+        from geomesa_tpu.store.reduce import reduce_result
 
-            visible = evaluate_column(table.columns[vis_field].values, q.auths)
-            keep = np.nonzero(visible)[0]
-            table = table.take(keep)
-            rows = rows[keep]
-
-        # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of
-        # matches, optionally per-group (deterministic every-nth)
-        sample = q.hints.get("sample")
-        if sample:
-            keep = _sample_rows(table, np.arange(len(table)), float(sample), q.hints.get("sample_by"))
-            table = table.take(keep)
-            rows = rows[keep]
-
-        # aggregation hints (density/stats/bin push-down flavors)
-        density = stats_out = bin_data = None
-        if "density" in q.hints:
-            density = _density(table, q.hints["density"] or {})
-        if "stats" in q.hints:
-            from geomesa_tpu.stats.spec import compute_stats
-
-            stats_out = compute_stats(table, q.hints["stats"])
-        if "bin" in q.hints:
-            bin_data = _bin_encode(table, q.hints["bin"] or {})
-        if density is not None or stats_out is not None or bin_data is not None:
-            scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
-            self._audit(type_name, q, plan_ms, scan_ms, len(table))
-            return QueryResult(
-                table, rows, info, density=density, stats=stats_out, bin_data=bin_data
-            )
-
-        # client-side reduce: sort / limit / projection (QueryPlanner.scala:75-98)
-        if q.sort_by is not None:
-            fld, desc = q.sort_by
-            keys = table.fids if fld == "id" else table.columns[fld].values
-            order = np.argsort(keys, kind="stable")
-            if desc:
-                order = order[::-1]
-            table = table.take(order)
-            rows = rows[order]
-        if q.limit is not None:
-            table = table.take(np.arange(min(q.limit, len(table))))
-            rows = rows[: q.limit]
-        if q.properties is not None:
-            keep = {p: table.columns[p] for p in q.properties}
-            table = FeatureTable(table.sft, table.fids, {**keep})
-
+        table, rows, density, stats_out, bin_data = reduce_result(
+            st.sft, table, rows, q
+        )
         scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
         self._audit(type_name, q, plan_ms, scan_ms, len(table))
-        return QueryResult(table, rows, info)
+        return QueryResult(
+            table, rows, info, density=density, stats=stats_out, bin_data=bin_data
+        )
 
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float, hits: int) -> None:
         self.metrics.histogram("store.query.hits").update(hits)
@@ -436,59 +446,3 @@ def _take_combined(st, delta_table, rows: np.ndarray) -> FeatureTable:
     return parts[0] if len(parts) == 1 else FeatureTable.concat(parts)
 
 
-def _sample_rows(table, rows, fraction, sample_by):
-    if fraction <= 0 or fraction >= 1 or len(rows) == 0:
-        return rows
-    nth = int(round(1.0 / fraction))
-    if nth <= 1:  # fractions near 1 round to keep-everything
-        return rows
-    if sample_by is None:
-        return rows[::nth]
-    keys = table.columns[sample_by].values[rows]
-    keep = np.zeros(len(rows), dtype=bool)
-    seen: dict = {}
-    for i, k in enumerate(keys):
-        c = seen.get(k, 0)
-        if c % nth == 0:
-            keep[i] = True
-        seen[k] = c + 1
-    return rows[keep]
-
-
-from geomesa_tpu.schema.columnar import representative_xy as _xy  # noqa: E402
-
-
-def _density(table, opts) -> np.ndarray:
-    """Exact f64 heatmap over the result set (DensityScan role); the sharded
-    device path computes the same grid via ops.density + psum."""
-    width = int(opts.get("width", 256))
-    height = int(opts.get("height", 256))
-    xs, ys = _xy(table)
-    bbox = opts.get("bbox")
-    if bbox is None:
-        bbox = (-180.0, -90.0, 180.0, 90.0)
-    xmin, ymin, xmax, ymax = bbox
-    weight = opts.get("weight_by")
-    w = None
-    if weight:
-        w = table.columns[weight].values.astype(np.float64)
-    grid, _, _ = np.histogram2d(
-        ys, xs, bins=[height, width], range=[[ymin, ymax], [xmin, xmax]], weights=w
-    )
-    return grid
-
-
-def _bin_encode(table, opts) -> bytes:
-    from geomesa_tpu.utils import bin_format
-
-    xs, ys = _xy(table)
-    track = opts.get("track")
-    label = opts.get("label")
-    return bin_format.encode(
-        xs,
-        ys,
-        table.dtg_millis(),
-        track_values=table.columns[track].values if track else table.fids,
-        label_values=table.columns[label].values if label else None,
-        sort_by_time=bool(opts.get("sort", False)),
-    )

@@ -1,0 +1,109 @@
+"""Federated read-only view over multiple stores.
+
+Role parity: ``geomesa-index-api/.../index/view/MergedDataStoreView.scala:31``
++ ``MergedQueryRunner.scala`` (SURVEY.md §2.3): N underlying stores (each
+optionally scoped by a per-store filter) presented as one read-only store;
+queries fan out, per-store results merge, sort/limit/aggregations apply at the
+view level. Mergeable aggregates merge exactly (density grids sum, stat
+sketches are monoids — the reference's reducer pattern, P6/P10 in §2.20).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+from geomesa_tpu.store.datastore import QueryResult
+
+__all__ = ["MergedDataStoreView"]
+
+
+class MergedDataStoreView:
+    """Read-only fan-out over ``[(store, scope_filter_or_None), ...]``."""
+
+    def __init__(self, stores):
+        if not stores:
+            raise ValueError("merged view needs at least one store")
+        self.stores = [s if isinstance(s, tuple) else (s, None) for s in stores]
+
+    def get_schema(self, name: str) -> FeatureType:
+        sft = self.stores[0][0].get_schema(name)
+        for s, _ in self.stores[1:]:
+            other = s.get_schema(name)
+            if [a.name for a in other.attributes] != [a.name for a in sft.attributes]:
+                raise ValueError(f"schema mismatch across stores for {name!r}")
+        return sft
+
+    def list_schemas(self) -> list[str]:
+        names = set(self.stores[0][0].list_schemas())
+        for s, _ in self.stores[1:]:
+            names &= set(s.list_schemas())
+        return sorted(names)
+
+    def query(self, type_name: str, q: Query | str | None = None, **kwargs) -> QueryResult:
+        sft = self.get_schema(type_name)
+        if isinstance(q, str) or q is None:
+            q = Query(filter=q, **kwargs)
+
+        # sub-queries: scope filter ANDed in; view-level reduce steps stripped
+        # (sort/limit re-applied on the merged stream, reference
+        # MergedQueryRunner behavior)
+        tables: list[FeatureTable] = []
+        density = None
+        stats = None
+        bin_parts: list[bytes] = []
+        for store, scope in self.stores:
+            f = q.resolved_filter()
+            if scope is not None:
+                scope_f = scope if isinstance(scope, ast.Filter) else None
+                if scope_f is None:
+                    from geomesa_tpu.filter.cql import parse
+
+                    scope_f = parse(scope)
+                f = ast.And((f, scope_f))
+            sub = replace(q, filter=f, sort_by=None, limit=None)
+            res = store.query(type_name, sub)
+            if res.density is not None:
+                density = res.density if density is None else density + res.density
+            if res.stats is not None:
+                if stats is None:
+                    stats = dict(res.stats)
+                else:
+                    stats = {k: stats[k].merge(v) for k, v in res.stats.items()}
+            if res.bin_data is not None:
+                bin_parts.append(res.bin_data)
+            if res.density is None and res.stats is None and res.bin_data is None:
+                tables.append(res.table)
+
+        if density is not None or stats is not None or bin_parts:
+            empty = FeatureTable.from_records(sft, [])
+            return QueryResult(
+                empty,
+                np.empty(0, dtype=np.int64),
+                density=density,
+                stats=stats,
+                bin_data=b"".join(bin_parts) if bin_parts else None,
+            )
+
+        table = FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
+        rows = np.arange(len(table), dtype=np.int64)
+        if q.sort_by is not None:
+            fld, desc = q.sort_by
+            keys = table.fids if fld == "id" else table.columns[fld].values
+            order = np.argsort(keys, kind="stable")
+            if desc:
+                order = order[::-1]
+            table = table.take(order)
+            rows = rows[order]
+        if q.limit is not None:
+            table = table.take(np.arange(min(q.limit, len(table))))
+            rows = rows[: q.limit]
+        return QueryResult(table, rows)
+
+    def stats_count(self, type_name: str, cql: str | None = None, exact: bool = False):
+        return sum(s.stats_count(type_name, cql, exact) for s, _ in self.stores)

@@ -1,0 +1,144 @@
+"""Shared post-scan result pipeline (client-side reduce).
+
+Role parity: the tail of ``QueryPlanner.runQuery`` (``QueryPlanner.scala:
+68-98``, SURVEY.md §3.3): after the scan produces candidate rows, every query
+runner applies the same steps — record-level visibility, sampling, push-down
+aggregation flavors (density/stats/bin), sort, limit, projection, and CRS
+reprojection. Both the batch :class:`~geomesa_tpu.store.datastore.DataStore`
+and the :class:`~geomesa_tpu.stream.datastore.StreamingDataStore` call
+:func:`reduce_result` so the two stores can never drift semantically (the
+reference shares this via ``LocalQueryRunner``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.schema.columnar import FeatureTable, representative_xy
+from geomesa_tpu.schema.sft import FeatureType
+
+__all__ = ["reduce_result", "sample_rows", "density_grid", "bin_encode"]
+
+
+def sample_rows(table, rows, fraction, sample_by):
+    """Deterministic every-nth sampling (FeatureSampler/SamplingIterator)."""
+    if fraction <= 0 or fraction >= 1 or len(rows) == 0:
+        return rows
+    nth = int(round(1.0 / fraction))
+    if nth <= 1:  # fractions near 1 round to keep-everything
+        return rows
+    if sample_by is None:
+        return rows[::nth]
+    keys = table.columns[sample_by].values[rows]
+    keep = np.zeros(len(rows), dtype=bool)
+    seen: dict = {}
+    for i, k in enumerate(keys):
+        c = seen.get(k, 0)
+        if c % nth == 0:
+            keep[i] = True
+        seen[k] = c + 1
+    return rows[keep]
+
+
+def density_grid(table, opts) -> np.ndarray:
+    """Exact f64 heatmap over the result set (DensityScan role); the sharded
+    device path computes the same grid via ops.density + psum."""
+    width = int(opts.get("width", 256))
+    height = int(opts.get("height", 256))
+    xs, ys = representative_xy(table)
+    bbox = opts.get("bbox")
+    if bbox is None:
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+    xmin, ymin, xmax, ymax = bbox
+    weight = opts.get("weight_by")
+    w = None
+    if weight:
+        w = table.columns[weight].values.astype(np.float64)
+    grid, _, _ = np.histogram2d(
+        ys, xs, bins=[height, width], range=[[ymin, ymax], [xmin, xmax]], weights=w
+    )
+    return grid
+
+
+def bin_encode(table, opts) -> bytes:
+    from geomesa_tpu.utils import bin_format
+
+    xs, ys = representative_xy(table)
+    track = opts.get("track")
+    label = opts.get("label")
+    return bin_format.encode(
+        xs,
+        ys,
+        table.dtg_millis(),
+        track_values=table.columns[track].values if track else table.fids,
+        label_values=table.columns[label].values if label else None,
+        sort_by_time=bool(opts.get("sort", False)),
+    )
+
+
+def reduce_result(sft: FeatureType, table: FeatureTable, rows: np.ndarray, q):
+    """Apply the shared post-scan pipeline for a query.
+
+    Returns ``(table, rows, density, stats, bin_data)``; exactly one of the
+    aggregate slots is non-None when the corresponding hint was set.
+    """
+    # record-level visibility (geomesa-security role): a schema opting in via
+    # user-data ``geomesa.vis.field`` names a String attribute holding the
+    # per-record visibility expression; rows the caller's auths can't satisfy
+    # are removed before any sampling/aggregation sees them
+    vis_field = sft.user_data.get("geomesa.vis.field")
+    if vis_field and q.auths is not None:
+        from geomesa_tpu.security.visibility import evaluate_column
+
+        visible = evaluate_column(table.columns[vis_field].values, q.auths)
+        keep = np.nonzero(visible)[0]
+        table = table.take(keep)
+        rows = rows[keep]
+
+    # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of
+    # matches, optionally per-group (deterministic every-nth)
+    sample = q.hints.get("sample")
+    if sample:
+        keep = sample_rows(
+            table, np.arange(len(table)), float(sample), q.hints.get("sample_by")
+        )
+        table = table.take(keep)
+        rows = rows[keep]
+
+    # aggregation hints (density/stats/bin push-down flavors)
+    density = stats_out = bin_data = None
+    if "density" in q.hints:
+        density = density_grid(table, q.hints["density"] or {})
+    if "stats" in q.hints:
+        from geomesa_tpu.stats.spec import compute_stats
+
+        stats_out = compute_stats(table, q.hints["stats"])
+    if "bin" in q.hints:
+        bin_data = bin_encode(table, q.hints["bin"] or {})
+    if density is not None or stats_out is not None or bin_data is not None:
+        return table, rows, density, stats_out, bin_data
+
+    # client-side reduce: sort / limit / projection (QueryPlanner.scala:75-98)
+    if q.sort_by is not None:
+        fld, desc = q.sort_by
+        keys = table.fids if fld == "id" else table.columns[fld].values
+        order = np.argsort(keys, kind="stable")
+        if desc:
+            order = order[::-1]
+        table = table.take(order)
+        rows = rows[order]
+    if q.limit is not None:
+        table = table.take(np.arange(min(q.limit, len(table))))
+        rows = rows[: q.limit]
+    if q.properties is not None:
+        keep = {p: table.columns[p] for p in q.properties}
+        table = FeatureTable(table.sft, table.fids, {**keep})
+
+    # client-side CRS reprojection (Reprojection.scala role)
+    crs = q.hints.get("crs")
+    if crs:
+        from geomesa_tpu.utils.crs import reproject_table
+
+        table = reproject_table(table, crs)
+
+    return table, rows, None, None, None

@@ -28,33 +28,39 @@ __all__ = ["MessageBus", "StreamingDataStore"]
 
 
 class MessageBus:
-    """Minimal in-process topic bus: partitioned append logs + subscribers."""
+    """Minimal in-process topic bus: one ordered log per topic + subscribers.
+
+    Messages carry a partition tag (key-hash) for parity with the Kafka model,
+    but the log itself is totally ordered so replay preserves publish order —
+    a late consumer replaying cannot see a Clear before the Puts that preceded
+    it (in real Kafka the reference gets this by keying all messages for a
+    feature to one partition and treating Clear as a barrier).
+    """
 
     def __init__(self, partitions: int = 4):
         self.partitions = partitions
-        self._logs: dict[str, list[list[bytes]]] = {}
+        self._logs: dict[str, list[tuple[int, bytes]]] = {}
         self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
 
     def create_topic(self, topic: str) -> None:
-        self._logs.setdefault(topic, [[] for _ in range(self.partitions)])
+        self._logs.setdefault(topic, [])
 
     def publish(self, topic: str, key: str, data: bytes) -> None:
         self.create_topic(topic)
         part = hash(key) % self.partitions if key else 0
-        self._logs[topic][part].append(data)
+        self._logs[topic].append((part, data))
         for cb in self._subscribers.get(topic, []):
             cb(data)
 
     def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
         """Register a consumer; replays the existing log first (offset 0)."""
         self.create_topic(topic)
-        for part in self._logs[topic]:
-            for data in part:
-                callback(data)
+        for _, data in self._logs[topic]:
+            callback(data)
         self._subscribers.setdefault(topic, []).append(callback)
 
     def topic_size(self, topic: str) -> int:
-        return sum(len(p) for p in self._logs.get(topic, []))
+        return len(self._logs.get(topic, []))
 
 
 class StreamingDataStore:
@@ -163,15 +169,11 @@ class StreamingDataStore:
         rows = np.nonzero(mask)[0]
         table = table.take(rows)
 
-        if q.sort_by is not None:
-            fld, desc = q.sort_by
-            keys = table.fids if fld == "id" else table.columns[fld].values
-            order = np.argsort(keys, kind="stable")
-            if desc:
-                order = order[::-1]
-            table = table.take(order)
-            rows = rows[order]
-        if q.limit is not None:
-            table = table.take(np.arange(min(q.limit, len(table))))
-            rows = rows[: q.limit]
-        return QueryResult(table, rows)
+        # same post-scan pipeline as the batch store (visibility, sampling,
+        # aggregation hints, sort/limit/projection/CRS)
+        from geomesa_tpu.store.reduce import reduce_result
+
+        table, rows, density, stats_out, bin_data = reduce_result(sft, table, rows, q)
+        return QueryResult(
+            table, rows, density=density, stats=stats_out, bin_data=bin_data
+        )

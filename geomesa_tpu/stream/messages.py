@@ -69,6 +69,11 @@ class GeoMessageSerializer:
     """Schema-bound message codec (one per feature type, like the reference)."""
 
     def __init__(self, sft: FeatureType):
+        if len(sft.attributes) > 64:
+            raise ValueError(
+                f"GeoMessage null bitmap supports at most 64 attributes; "
+                f"schema {sft.name!r} has {len(sft.attributes)}"
+            )
         self.sft = sft
 
     def serialize(self, msg: Put | Delete | Clear) -> bytes:

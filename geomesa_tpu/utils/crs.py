@@ -1,0 +1,102 @@
+"""Coordinate reference system transforms (result reprojection).
+
+Role parity: ``geomesa-index-api/.../index/utils/Reprojection.scala`` (SURVEY.md
+§2.3) — reproject query results client-side. We implement the pair that covers
+the reference's actual usage (GeoServer map output): EPSG:4326 lon/lat ↔
+EPSG:3857 spherical web-mercator, vectorized over numpy arrays, plus
+whole-table reprojection of the default geometry column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    _Multi,
+)
+
+__all__ = ["transform_coords", "transform_geometry", "reproject_table", "CRS_CODES"]
+
+_R = 6378137.0  # spherical mercator earth radius (EPSG:3857)
+_MAX_LAT = 85.06  # web-mercator clamp
+
+CRS_CODES = ("EPSG:4326", "EPSG:3857")
+
+
+def _to_3857(xs, ys):
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.clip(np.asarray(ys, dtype=np.float64), -_MAX_LAT, _MAX_LAT)
+    mx = np.radians(xs) * _R
+    my = np.log(np.tan(np.pi / 4.0 + np.radians(ys) / 2.0)) * _R
+    return mx, my
+
+
+def _to_4326(xs, ys):
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.asarray(ys, dtype=np.float64)
+    lon = np.degrees(xs / _R)
+    lat = np.degrees(2.0 * np.arctan(np.exp(ys / _R)) - np.pi / 2.0)
+    return lon, lat
+
+
+def transform_coords(xs, ys, source: str, target: str):
+    """Transform coordinate arrays between supported CRS codes."""
+    source, target = source.upper(), target.upper()
+    for crs in (source, target):
+        if crs not in CRS_CODES:
+            raise ValueError(f"unsupported CRS {crs!r}; supported: {CRS_CODES}")
+    if source == target:
+        return np.asarray(xs, np.float64), np.asarray(ys, np.float64)
+    return _to_3857(xs, ys) if target == "EPSG:3857" else _to_4326(xs, ys)
+
+
+def transform_geometry(g: Geometry, source: str, target: str) -> Geometry:
+    if isinstance(g, Point):
+        x, y = transform_coords([g.x], [g.y], source, target)
+        return Point(float(x[0]), float(y[0]))
+    if isinstance(g, LineString):
+        x, y = transform_coords(g.coords[:, 0], g.coords[:, 1], source, target)
+        return LineString(np.stack([x, y], axis=1))
+    if isinstance(g, Polygon):
+        def ring(r):
+            x, y = transform_coords(r[:, 0], r[:, 1], source, target)
+            return np.stack([x, y], axis=1)
+
+        return Polygon(ring(g.shell), tuple(ring(h) for h in g.holes))
+    if isinstance(g, _Multi):
+        return type(g)(tuple(transform_geometry(p, source, target) for p in g.parts))
+    raise TypeError(type(g).__name__)
+
+
+def reproject_table(table, target: str, source: str = "EPSG:4326"):
+    """Reproject a FeatureTable's default geometry column (new table)."""
+    from geomesa_tpu.schema.columnar import FeatureTable, GeometryColumn
+
+    gf = table.sft.geom_field
+    if gf is None or source.upper() == target.upper():
+        return table
+    col = table.columns[gf]
+    if isinstance(col, GeometryColumn) and col.x is not None:
+        x, y = transform_coords(col.x, col.y, source, target)
+        new_col = GeometryColumn(col.type, None, col.valid, x=x, y=y, bounds=None)
+    else:
+        geoms = col.geometries()
+        out = np.empty(len(geoms), dtype=object)
+        bounds = np.empty((len(geoms), 4), dtype=np.float64)
+        for i, g in enumerate(geoms):
+            if g is None:
+                out[i] = None
+                bounds[i] = np.nan
+            else:
+                out[i] = transform_geometry(g, source, target)
+                bounds[i] = out[i].bbox
+        new_col = GeometryColumn(col.type, out, col.valid, bounds=bounds)
+    cols = {**table.columns, gf: new_col}
+    return FeatureTable(table.sft, table.fids, cols)

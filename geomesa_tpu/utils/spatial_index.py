@@ -110,24 +110,23 @@ class BucketIndex(SpatialIndex):
 
     def query(self, bounds) -> Iterator[Any]:
         i0, i1, j0, j1 = self._cell_range(bounds)
-        seen: set[int] = set()
+        seen: set[str] = set()  # dedupe multi-cell entries by fid
         for i in range(i0, i1 + 1):
             for j in range(j0, j1 + 1):
                 cell = self._buckets.get((i, j))
                 if not cell:
                     continue
                 for fid, v in cell.items():
-                    key = id(v)
-                    if key not in seen:
-                        seen.add(key)
+                    if fid not in seen:
+                        seen.add(fid)
                         yield v
 
     def values(self) -> Iterator[Any]:
-        seen: set[int] = set()
+        seen: set[str] = set()
         for cell in self._buckets.values():
-            for v in cell.values():
-                if id(v) not in seen:
-                    seen.add(id(v))
+            for fid, v in cell.items():
+                if fid not in seen:
+                    seen.add(fid)
                     yield v
 
     def size(self) -> int:

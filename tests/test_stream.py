@@ -69,6 +69,13 @@ class TestSpatialIndexes:
         assert list(idx.query((-20, -20, 20, 20))) == ["BIG"]
         assert idx.size() == 1
 
+    def test_distinct_entries_with_equal_values(self):
+        idx = BucketIndex()
+        idx.insert((0, 0, 0, 0), "a", "X")
+        idx.insert((5, 5, 5, 5), "b", "X")  # same (interned) value object
+        assert list(idx.query((-1, -1, 6, 6))) == ["X", "X"]
+        assert len(list(idx.values())) == 2
+
     def test_size_separated_tiers(self):
         idx = SizeSeparatedBucketIndex()
         idx.insert((0, 0, 0.5, 0.5), "small", "S")
@@ -144,6 +151,37 @@ class TestStreamingDataStore:
         # and stays live for subsequent messages
         ds.put("adsb", "f2", {"dtg": 2, "geom": Point(6, 6), "callsign": "B", "alt": 2}, ts=2)
         assert ds2.query("adsb").count == 2
+
+    def test_late_consumer_replay_preserves_clear_ordering(self):
+        bus = MessageBus()
+        ds = StreamingDataStore(bus=bus)
+        ds.create_schema(SFT)
+        ds.put("adsb", "f1", {"dtg": 1, "geom": Point(5, 5), "callsign": "A", "alt": 1}, ts=1)
+        ds.clear("adsb")
+        ds.put("adsb", "f2", {"dtg": 2, "geom": Point(6, 6), "callsign": "B", "alt": 2}, ts=2)
+        late = StreamingDataStore(bus=bus)
+        late.create_schema(SFT)
+        # replay must apply Clear after f1 and before f2: only f2 survives
+        assert [s.fid for s in late.cache("adsb").states()] == ["f2"]
+
+    def test_streaming_visibility_enforced(self):
+        sft = parse_spec(
+            "sec", "dtg:Date,*geom:Point:srid=4326,vis:String;geomesa.vis.field='vis'"
+        )
+        ds = StreamingDataStore()
+        ds.create_schema(sft)
+        ds.put("sec", "open", {"dtg": 1, "geom": Point(0, 0), "vis": ""}, ts=1)
+        ds.put("sec", "secret", {"dtg": 2, "geom": Point(1, 1), "vis": "secret"}, ts=2)
+        assert ds.query("sec").count == 2  # no auths given: unrestricted
+        assert ds.query("sec", Query(auths=[])).count == 1
+        assert ds.query("sec", Query(auths=["secret"])).count == 2
+
+    def test_streaming_aggregation_hints(self):
+        ds = _store()
+        for i in range(10):
+            ds.put("adsb", f"f{i}", {"dtg": i, "geom": Point(i, 0), "callsign": "X", "alt": i}, ts=i)
+        res = ds.query("adsb", Query(hints={"stats": "Count()"}))
+        assert res.stats["Count()"].count == 10
 
     def test_query_parity_vs_brute_force(self):
         ds = _store()

@@ -1,0 +1,147 @@
+"""Merged federated view, age-off TTL, and CRS reprojection.
+
+Role parity checks: ``MergedDataStoreView.scala``, ``AgeOffIterator``/
+``DtgAgeOffIterator``, ``Reprojection.scala`` (SURVEY.md §2.3, §2.6).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.merged import MergedDataStoreView
+from geomesa_tpu.utils.crs import transform_coords, transform_geometry
+
+SPEC = "dtg:Date,*geom:Point:srid=4326,src:String"
+
+
+def _store(name, n, x0, backend="oracle"):
+    sft = parse_spec("pts", SPEC)
+    ds = DataStore(backend=backend)
+    ds.create_schema(sft)
+    recs = [
+        {"dtg": 1_000_000 + i, "geom": Point(x0 + i, 0.0), "src": name}
+        for i in range(n)
+    ]
+    ds.write("pts", recs)
+    return ds
+
+
+class TestMergedView:
+    def test_merged_query(self):
+        view = MergedDataStoreView([_store("a", 5, 0.0), _store("b", 5, 100.0)])
+        assert view.query("pts").count == 10
+        assert view.query("pts", "BBOX(geom, -1, -1, 10, 1)").count == 5
+
+    def test_per_store_scope_filter(self):
+        view = MergedDataStoreView(
+            [(_store("a", 5, 0.0), "src = 'a'"), (_store("b", 5, 100.0), "src = 'nope'")]
+        )
+        assert view.query("pts").count == 5
+
+    def test_merged_sort_limit(self):
+        a = _store("a", 5, 0.0)
+        sft = parse_spec("pts", SPEC)
+        b = DataStore(backend="oracle")
+        b.create_schema(sft)
+        b.write(
+            "pts",
+            [
+                {"dtg": 2_000_000 + i, "geom": Point(100.0 + i, 0.0), "src": "b"}
+                for i in range(5)
+            ],
+        )
+        view = MergedDataStoreView([a, b])
+        res = view.query("pts", Query(sort_by=("dtg", True), limit=3))
+        assert res.count == 3
+        assert list(res.table.columns["src"].values) == ["b", "b", "b"]
+
+    def test_merged_stats_aggregation(self):
+        view = MergedDataStoreView([_store("a", 4, 0.0), _store("b", 6, 100.0)])
+        res = view.query("pts", Query(hints={"stats": "Count()"}))
+        assert res.stats["Count()"].count == 10
+
+    def test_merged_density(self):
+        view = MergedDataStoreView([_store("a", 4, 0.0), _store("b", 6, 100.0)])
+        res = view.query(
+            "pts", Query(hints={"density": {"bbox": (-180, -90, 180, 90), "width": 32, "height": 16}})
+        )
+        assert res.density.sum() == pytest.approx(10.0)
+
+    def test_merged_count(self):
+        view = MergedDataStoreView([_store("a", 4, 0.0), _store("b", 6, 100.0)])
+        assert view.stats_count("pts", exact=True) == 10
+
+
+class TestAgeOff:
+    def _ttl_store(self, backend="oracle"):
+        sft = parse_spec("ttl", SPEC + ";geomesa.age.off='1000'")
+        ds = DataStore(backend=backend)
+        ds.create_schema(sft)
+        recs = [
+            {"dtg": 10_000 + 100 * i, "geom": Point(i, 0.0), "src": "s"}
+            for i in range(10)  # dtg 10000..10900
+        ]
+        ds.write("ttl", recs)
+        return ds
+
+    def test_query_time_masking(self):
+        ds = self._ttl_store()
+        # now=11500, ttl=1000 -> cutoff 10500: keep dtg >= 10500 (5 rows)
+        res = ds.query("ttl", Query(hints={"now_ms": 11_500}))
+        assert res.count == 5
+
+    def test_physical_age_off(self):
+        ds = self._ttl_store()
+        removed = ds.age_off("ttl", now_ms=11_500)
+        assert removed == 5
+        assert ds.query("ttl", Query(hints={"now_ms": 11_500})).count == 5
+        # everything expires
+        assert ds.age_off("ttl", now_ms=100_000) == 5
+        assert ds.query("ttl", Query(hints={"now_ms": 100_000})).count == 0
+        # store still writable after full expiry
+        ds.write("ttl", [{"dtg": 100_000, "geom": Point(0, 0), "src": "s"}])
+        assert ds.query("ttl", Query(hints={"now_ms": 100_100})).count == 1
+
+    def test_tpu_backend_parity(self):
+        a = self._ttl_store("oracle")
+        b = self._ttl_store("tpu")
+        qa = a.query("ttl", Query(hints={"now_ms": 11_300})).count
+        qb = b.query("ttl", Query(hints={"now_ms": 11_300})).count
+        assert qa == qb == 7  # cutoff 10300 keeps dtg 10300..10900
+
+
+class TestReprojection:
+    def test_known_values(self):
+        # equator/prime meridian maps to origin
+        mx, my = transform_coords([0.0], [0.0], "EPSG:4326", "EPSG:3857")
+        assert mx[0] == pytest.approx(0.0, abs=1e-6)
+        assert my[0] == pytest.approx(0.0, abs=1e-6)
+        # known point: lon 180 -> 20037508.34
+        mx, _ = transform_coords([180.0], [0.0], "EPSG:4326", "EPSG:3857")
+        assert mx[0] == pytest.approx(20037508.34, rel=1e-6)
+
+    def test_round_trip(self):
+        rng = np.random.default_rng(2)
+        lons = rng.uniform(-179, 179, 100)
+        lats = rng.uniform(-80, 80, 100)
+        mx, my = transform_coords(lons, lats, "EPSG:4326", "EPSG:3857")
+        lon2, lat2 = transform_coords(mx, my, "EPSG:3857", "EPSG:4326")
+        np.testing.assert_allclose(lon2, lons, atol=1e-9)
+        np.testing.assert_allclose(lat2, lats, atol=1e-9)
+
+    def test_geometry_transform(self):
+        g = transform_geometry(Point(0.0, 45.0), "EPSG:4326", "EPSG:3857")
+        assert g.y == pytest.approx(5621521.48, rel=1e-3)
+
+    def test_query_crs_hint(self):
+        ds = _store("a", 3, 10.0)
+        res = ds.query("pts", Query(hints={"crs": "EPSG:3857"}))
+        col = res.table.geom_column()
+        assert col.x[0] == pytest.approx(1113194.9, rel=1e-4)
+
+    def test_unsupported_crs(self):
+        with pytest.raises(ValueError):
+            transform_coords([0], [0], "EPSG:4326", "EPSG:32633")
