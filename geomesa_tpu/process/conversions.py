@@ -1,0 +1,68 @@
+"""Conversion / attribute-derivation geoprocesses.
+
+Reference: ``geomesa-process`` (SURVEY.md §2.15) — ``ArrowConversionProcess``
+(279), ``BinConversionProcess`` (131), ``DateOffsetProcess``,
+``HashAttributeProcess``. Each converts or derives from a (query-planned)
+result set; here the conversions ride the shared reduce pipeline so they stay
+consistent with the push-down aggregation hints.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import Column, FeatureTable
+
+
+def arrow_conversion(ds, type_name: str, filter=None, dictionary_encode: bool = True) -> bytes:
+    """Query → Arrow IPC stream bytes (``ArrowConversionProcess`` role)."""
+    from geomesa_tpu.io.arrow import to_ipc_bytes
+
+    r = ds.query(type_name, Query(filter=filter))
+    return to_ipc_bytes(r.table)
+
+
+def bin_conversion(
+    ds,
+    type_name: str,
+    filter=None,
+    track: str | None = None,
+    label: str | None = None,
+    sort: bool = False,
+) -> bytes:
+    """Query → BIN track-point byte stream (``BinConversionProcess`` role):
+    16-byte (trackId, dtg, lat, lon) records, 24-byte when labeled."""
+    opts = {"track": track, "label": label, "sort": sort}
+    r = ds.query(type_name, Query(filter=filter, hints={"bin": opts}))
+    return r.bin_data
+
+
+def date_offset(table: FeatureTable, offset_ms: int) -> FeatureTable:
+    """Shift the schema's date attribute by ``offset_ms``
+    (``DateOffsetProcess`` role); other columns are shared, not copied."""
+    dtg = table.sft.dtg_field
+    if dtg is None:
+        raise ValueError(f"schema {table.sft.name} has no date attribute")
+    col = table.columns[dtg]
+    shifted = Column(col.type, col.values + np.int64(offset_ms), col.valid)
+    cols = dict(table.columns)
+    cols[dtg] = shifted
+    return FeatureTable(table.sft, table.fids, cols)
+
+
+def hash_attribute(table: FeatureTable, attribute: str, modulo: int) -> np.ndarray:
+    """Stable per-feature bucket = crc32(str(value)) % modulo
+    (``HashAttributeProcess`` role — deterministic across runs/processes,
+    unlike Python's salted ``hash``). Null attributes hash to bucket 0."""
+    if modulo <= 0:
+        raise ValueError("modulo must be positive")
+    col = table.columns[attribute]
+    valid = col.is_valid()
+    out = np.zeros(len(table), dtype=np.int64)
+    vals = col.values
+    for i in np.nonzero(valid)[0]:
+        out[i] = zlib.crc32(str(vals[i]).encode()) % modulo
+    return out

@@ -1,9 +1,12 @@
-"""Geoprocessing operations: unique, proximity, tube-select, point2point.
+"""Geoprocessing operations: unique, proximity, tube-select, point2point,
+sampling, min/max, density, stats.
 
 Reference: ``geomesa-process`` WPS processes (SURVEY.md §2.15):
 ``UniqueProcess`` (301), ``ProximitySearchProcess``, ``TubeSelectProcess``
-(183) + ``TubeBuilder`` (270), ``Point2PointProcess``. Each pushes work into
-normal (index-planned) queries where possible and vectorizes the rest.
+(183) + ``TubeBuilder`` (270), ``Point2PointProcess``, ``SamplingProcess``,
+``MinMaxProcess``, ``DensityProcess`` (198), ``StatsProcess`` (128),
+``QueryProcess``. Each pushes work into normal (index-planned) queries where
+possible and vectorizes the rest.
 """
 
 from __future__ import annotations
@@ -25,6 +28,47 @@ def unique(ds, type_name: str, attribute: str, filter=None, sort: bool = True):
     if sort:
         out.sort(key=lambda vc: (-vc[1], str(vc[0])))
     return out
+
+
+def sampling(ds, type_name: str, fraction: float, filter=None, threads_or_by=None):
+    """~``fraction`` of the matching features, deterministic every-nth,
+    optionally per-group (``SamplingProcess`` role, rides the ``sample``
+    query hint → SamplingIterator path)."""
+    hints = {"sample": fraction}
+    if threads_or_by:
+        hints["sample_by"] = threads_or_by
+    return ds.query(type_name, Query(filter=filter, hints=hints)).table
+
+
+def min_max(ds, type_name: str, attribute: str, filter=None, cached: bool = True):
+    """(min, max) of an attribute (``MinMaxProcess`` role). With ``cached``
+    and no filter, served from the stats store sketches; otherwise exact via
+    a planned query."""
+    if cached and filter is None:
+        try:
+            return ds.stats_bounds(type_name, attribute)
+        except Exception:
+            pass
+    r = ds.query(type_name, Query(filter=filter, hints={"stats": f"MinMax({attribute})"}))
+    mm = r.stats[f"MinMax({attribute})"]
+    return None if mm.min is None else (mm.min, mm.max)
+
+
+def density(ds, type_name: str, filter=None, bbox=None, width: int = 256, height: int = 256, weight_by=None):
+    """Heatmap grid over matching features (``DensityProcess`` role, rides
+    the ``density`` hint → DensityScan path). Returns (height, width) f64."""
+    opts = {"width": width, "height": height}
+    if bbox is not None:
+        opts["bbox"] = bbox
+    if weight_by:
+        opts["weight_by"] = weight_by
+    return ds.query(type_name, Query(filter=filter, hints={"density": opts})).density
+
+
+def stats(ds, type_name: str, stats_spec: str, filter=None):
+    """Stat sketches over matching features (``StatsProcess`` role, rides the
+    ``stats`` hint → StatsScan path). Returns label → sketch."""
+    return ds.query(type_name, Query(filter=filter, hints={"stats": stats_spec})).stats
 
 
 def proximity(ds, type_name: str, geometries, distance_deg: float, filter=None):

@@ -1,0 +1,100 @@
+"""Track-oriented geoprocesses: route search, track labels.
+
+Reference: ``geomesa-process`` (SURVEY.md §2.15) — ``RouteSearchProcess``
+(309 LoC; features traveling along a route, matched by corridor distance and
+heading alignment) and ``TrackLabelProcess`` (one label point per track — the
+most recent position, used for map labeling).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable, representative_xy
+
+
+def route_search(
+    ds,
+    type_name: str,
+    route: list[tuple[float, float]],
+    buffer_deg: float,
+    heading_field: str | None = None,
+    heading_tolerance_deg: float = 45.0,
+    bidirectional: bool = False,
+    filter=None,
+):
+    """Features travelling along ``route`` (``RouteSearchProcess`` role).
+
+    ``route``: ordered (lon, lat) waypoints. A feature matches when it lies
+    within ``buffer_deg`` of some route segment and — when ``heading_field``
+    is given — its heading is within ``heading_tolerance_deg`` of that
+    segment's bearing (or the reverse bearing too, if ``bidirectional``).
+
+    Primary scan: OR of per-segment buffered bboxes through the planned index
+    path; refine: vectorized point-to-segment distance + heading comparison.
+    """
+    if len(route) < 2:
+        raise ValueError("route requires at least 2 waypoints")
+    sft = ds.get_schema(type_name)
+    pts = np.asarray(route, dtype=np.float64)
+
+    parts = []
+    for i in range(len(pts) - 1):
+        x1 = min(pts[i, 0], pts[i + 1, 0]) - buffer_deg
+        x2 = max(pts[i, 0], pts[i + 1, 0]) + buffer_deg
+        y1 = min(pts[i, 1], pts[i + 1, 1]) - buffer_deg
+        y2 = max(pts[i, 1], pts[i + 1, 1]) + buffer_deg
+        parts.append(ast.BBox(sft.geom_field, x1, y1, x2, y2))
+    f = parts[0] if len(parts) == 1 else ast.Or(parts)
+    if filter is not None:
+        from geomesa_tpu.filter.cql import parse
+
+        base = parse(filter) if isinstance(filter, str) else filter
+        f = ast.And([f, base])
+    r = ds.query(type_name, Query(filter=f))
+    if r.count == 0:
+        return r.table
+
+    xs, ys = representative_xy(r.table)
+    cx, cy = xs[:, None], ys[:, None]
+    x1, y1 = pts[:-1, 0][None, :], pts[:-1, 1][None, :]
+    x2, y2 = pts[1:, 0][None, :], pts[1:, 1][None, :]
+    dx, dy = x2 - x1, y2 - y1
+    len2 = dx * dx + dy * dy
+    with np.errstate(divide="ignore", invalid="ignore"):
+        tproj = np.where(len2 > 0, ((cx - x1) * dx + (cy - y1) * dy) / len2, 0.0)
+    tproj = np.clip(tproj, 0.0, 1.0)
+    d2 = (cx - (x1 + tproj * dx)) ** 2 + (cy - (y1 + tproj * dy)) ** 2
+    ok = d2 <= buffer_deg**2
+
+    if heading_field is not None:
+        # bearing: degrees clockwise from north (navigation convention)
+        seg_bearing = np.degrees(np.arctan2(dx, dy)) % 360.0  # (1, S)
+        col = r.table.columns[heading_field]
+        heading = col.values.astype(np.float64)[:, None] % 360.0
+        diff = np.abs((heading - seg_bearing + 180.0) % 360.0 - 180.0)
+        if bidirectional:
+            diff = np.minimum(diff, 180.0 - diff)
+        aligned = diff <= heading_tolerance_deg
+        if col.valid is not None:
+            aligned &= col.valid[:, None]
+        ok &= aligned
+
+    keep = ok.any(axis=1)
+    return r.table.take(np.nonzero(keep)[0])
+
+
+def track_label(table: FeatureTable, track_field: str) -> FeatureTable:
+    """One label feature per track — the most recent point by the schema's
+    date attribute (``TrackLabelProcess`` role)."""
+    t = table.dtg_millis()
+    groups = table.columns[track_field].values
+    best: dict = {}
+    for i, g in enumerate(groups.astype(object)):
+        j = best.get(g)
+        if j is None or t[i] > t[j]:
+            best[g] = i
+    idx = np.asarray(sorted(best.values()), dtype=np.int64)
+    return table.take(idx)

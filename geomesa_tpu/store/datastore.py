@@ -269,9 +269,18 @@ class DataStore:
 
         self.metrics.counter("store.queries").inc()
         if st.total_rows == 0:
+            # still runs the shared reduce pipeline so aggregation hints
+            # produce empty aggregates (not None) — callers index res.stats
+            from geomesa_tpu.store.reduce import reduce_result
+
             empty = FeatureTable.from_records(st.sft, [])
+            table, rows, density, stats_out, bin_data = reduce_result(
+                st.sft, empty, np.empty(0, dtype=np.int64), q
+            )
             self._audit(type_name, q, 0.0, 0.0, 0)
-            return QueryResult(empty, np.empty(0, dtype=np.int64))
+            return QueryResult(
+                table, rows, density=density, stats=stats_out, bin_data=bin_data
+            )
 
         # query-time age-off (AgeOffIterator-at-scan role): expired rows are
         # masked even before a physical age_off() compaction runs
@@ -361,24 +370,28 @@ class DataStore:
         return info.explain()
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------
-    def stats_count(self, type_name: str, cql: str | None = None, exact: bool = False):
-        """Row count: stored total, sketch estimate, or exact via query."""
+    def stats_count(self, type_name: str, cql=None, exact: bool = False):
+        """Row count: stored total, sketch estimate, or exact via query.
+
+        ``cql`` may be a CQL string or a pre-built filter AST (the merged
+        view passes ASTs so per-store scope filters compose exactly)."""
         st = self._state(type_name)
         if st.total_rows == 0:
             return 0
         if cql is None:
             return st.total_rows
         if exact:
-            return self.query(type_name, cql).count
+            return self.query(type_name, Query(filter=cql)).count
         if st.stats is None:  # only delta-tier data so far: count it exactly
-            return self.query(type_name, cql).count
+            return self.query(type_name, Query(filter=cql)).count
         from geomesa_tpu.curve.binned_time import BinnedTime
         from geomesa_tpu.curve.sfc import z3_sfc
         from geomesa_tpu.filter.bounds import extract as _extract
         from geomesa_tpu.filter.cql import parse as _parse
 
+        f_ast = _parse(cql) if isinstance(cql, str) else cql
         e = _extract(
-            _parse(cql), st.sft.geom_field, st.sft.dtg_field,
+            f_ast, st.sft.geom_field, st.sft.dtg_field,
             attrs=tuple(st.stats.attrs) if st.stats else (),
         )
         est = st.stats.estimate_spatiotemporal(
@@ -391,7 +404,7 @@ class DataStore:
         # count exactly so fresh writes stay visible to estimates
         delta_table = st.delta.merged()
         if delta_table is not None:
-            est += float(_parse(cql).mask(delta_table).sum())
+            est += float(f_ast.mask(delta_table).sum())
         return est
 
     # -- persistence (checkpoint/resume) -------------------------------------

@@ -29,7 +29,15 @@ class MergedDataStoreView:
     def __init__(self, stores):
         if not stores:
             raise ValueError("merged view needs at least one store")
-        self.stores = [s if isinstance(s, tuple) else (s, None) for s in stores]
+        from geomesa_tpu.filter.cql import parse
+
+        # scope filters parsed once here, not per query
+        self.stores = []
+        for s in stores:
+            store, scope = s if isinstance(s, tuple) else (s, None)
+            if scope is not None and not isinstance(scope, ast.Filter):
+                scope = parse(scope)
+            self.stores.append((store, scope))
 
     def get_schema(self, name: str) -> FeatureType:
         sft = self.stores[0][0].get_schema(name)
@@ -57,15 +65,9 @@ class MergedDataStoreView:
         density = None
         stats = None
         bin_parts: list[bytes] = []
+        base_f = q.resolved_filter()
         for store, scope in self.stores:
-            f = q.resolved_filter()
-            if scope is not None:
-                scope_f = scope if isinstance(scope, ast.Filter) else None
-                if scope_f is None:
-                    from geomesa_tpu.filter.cql import parse
-
-                    scope_f = parse(scope)
-                f = ast.And((f, scope_f))
+            f = base_f if scope is None else ast.And((base_f, scope))
             sub = replace(q, filter=f, sort_by=None, limit=None)
             res = store.query(type_name, sub)
             if res.density is not None:
@@ -81,29 +83,42 @@ class MergedDataStoreView:
                 tables.append(res.table)
 
         if density is not None or stats is not None or bin_parts:
+            bin_data = None
+            if bin_parts:
+                bin_opts = q.hints.get("bin") or {}
+                if bin_opts.get("sort"):
+                    # per-store chunks are each time-sorted; a plain join
+                    # would interleave — merge-sort them (BinSorter role)
+                    from geomesa_tpu.utils.bin_format import merge_sorted
+
+                    bin_data = merge_sorted(
+                        bin_parts, labeled=bool(bin_opts.get("label"))
+                    )
+                else:
+                    bin_data = b"".join(bin_parts)
             empty = FeatureTable.from_records(sft, [])
             return QueryResult(
                 empty,
                 np.empty(0, dtype=np.int64),
                 density=density,
                 stats=stats,
-                bin_data=b"".join(bin_parts) if bin_parts else None,
+                bin_data=bin_data,
             )
 
         table = FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
         rows = np.arange(len(table), dtype=np.int64)
-        if q.sort_by is not None:
-            fld, desc = q.sort_by
-            keys = table.fids if fld == "id" else table.columns[fld].values
-            order = np.argsort(keys, kind="stable")
-            if desc:
-                order = order[::-1]
-            table = table.take(order)
-            rows = rows[order]
-        if q.limit is not None:
-            table = table.take(np.arange(min(q.limit, len(table))))
-            rows = rows[: q.limit]
+        from geomesa_tpu.store.reduce import sort_limit
+
+        table, rows = sort_limit(table, rows, q.sort_by, q.limit)
         return QueryResult(table, rows)
 
-    def stats_count(self, type_name: str, cql: str | None = None, exact: bool = False):
-        return sum(s.stats_count(type_name, cql, exact) for s, _ in self.stores)
+    def stats_count(self, type_name: str, cql=None, exact: bool = False):
+        """Count across stores, honoring each store's scope filter."""
+        from geomesa_tpu.filter.cql import parse
+
+        f = parse(cql) if isinstance(cql, str) else cql
+        total = 0
+        for s, scope in self.stores:
+            sub = f if scope is None else (scope if f is None else ast.And((f, scope)))
+            total += s.stats_count(type_name, sub, exact)
+        return total

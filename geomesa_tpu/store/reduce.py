@@ -17,7 +17,24 @@ import numpy as np
 from geomesa_tpu.schema.columnar import FeatureTable, representative_xy
 from geomesa_tpu.schema.sft import FeatureType
 
-__all__ = ["reduce_result", "sample_rows", "density_grid", "bin_encode"]
+__all__ = ["reduce_result", "sample_rows", "density_grid", "bin_encode", "sort_limit"]
+
+
+def sort_limit(table, rows, sort_by, limit):
+    """Shared client-side sort + limit tail (``QueryPlanner.scala:75-98``);
+    also used by the merged view so ordering semantics cannot drift."""
+    if sort_by is not None:
+        fld, desc = sort_by
+        keys = table.fids if fld == "id" else table.columns[fld].values
+        order = np.argsort(keys, kind="stable")
+        if desc:
+            order = order[::-1]
+        table = table.take(order)
+        rows = rows[order]
+    if limit is not None:
+        table = table.take(np.arange(min(limit, len(table))))
+        rows = rows[:limit]
+    return table, rows
 
 
 def sample_rows(table, rows, fraction, sample_by):
@@ -118,27 +135,19 @@ def reduce_result(sft: FeatureType, table: FeatureTable, rows: np.ndarray, q):
     if density is not None or stats_out is not None or bin_data is not None:
         return table, rows, density, stats_out, bin_data
 
-    # client-side reduce: sort / limit / projection (QueryPlanner.scala:75-98)
-    if q.sort_by is not None:
-        fld, desc = q.sort_by
-        keys = table.fids if fld == "id" else table.columns[fld].values
-        order = np.argsort(keys, kind="stable")
-        if desc:
-            order = order[::-1]
-        table = table.take(order)
-        rows = rows[order]
-    if q.limit is not None:
-        table = table.take(np.arange(min(q.limit, len(table))))
-        rows = rows[: q.limit]
-    if q.properties is not None:
-        keep = {p: table.columns[p] for p in q.properties}
-        table = FeatureTable(table.sft, table.fids, {**keep})
+    # client-side reduce: sort / limit / reproject / projection
+    # (QueryPlanner.scala:75-98); CRS runs before the properties projection
+    # so a projection that drops the geometry column can't strand the hint
+    table, rows = sort_limit(table, rows, q.sort_by, q.limit)
 
-    # client-side CRS reprojection (Reprojection.scala role)
     crs = q.hints.get("crs")
     if crs:
         from geomesa_tpu.utils.crs import reproject_table
 
         table = reproject_table(table, crs)
+
+    if q.properties is not None:
+        keep = {p: table.columns[p] for p in q.properties}
+        table = FeatureTable(table.sft, table.fids, {**keep})
 
     return table, rows, None, None, None

@@ -1,0 +1,180 @@
+"""Tests for the extended geoprocess set: route search, track label,
+sampling, min/max, density/stats wrappers, conversion processes
+(reference: geomesa-process suites — SURVEY.md §2.15)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.process.conversions import (
+    arrow_conversion,
+    bin_conversion,
+    date_offset,
+    hash_attribute,
+)
+from geomesa_tpu.process.processes import density, min_max, sampling, stats, unique
+from geomesa_tpu.process.tracks import route_search, track_label
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,heading:Double,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(5)
+    n = 4000
+    lon = rng.uniform(-50, 50, n)
+    lat = rng.uniform(-50, 50, n)
+    heading = rng.uniform(0, 360, n)
+    t = T0 + rng.integers(0, 5 * 86_400_000, n)
+    recs = [
+        {
+            "name": f"trk{i % 8}",
+            "heading": float(heading[i]),
+            "dtg": int(t[i]),
+            "geom": Point(float(lon[i]), float(lat[i])),
+        }
+        for i in range(n)
+    ]
+    store = DataStore(backend="tpu")
+    store.create_schema("r", SPEC)
+    store.write("r", recs, fids=[f"r.{i}" for i in range(n)])
+    return store
+
+
+class TestRouteSearch:
+    ROUTE = [(-20.0, 0.0), (20.0, 0.0)]  # due-east route along the equator
+
+    def test_corridor_only(self, ds):
+        t = route_search(ds, "r", self.ROUTE, buffer_deg=2.0)
+        col = t.geom_column()
+        assert len(t) > 0
+        assert np.all(np.abs(col.y) <= 2.0 + 1e-12)
+        assert np.all((col.x >= -22.0) & (col.x <= 22.0))
+        # parity vs brute force over the full store
+        r = ds.query("r", "INCLUDE")
+        ax, ay = r.table.geom_column().x, r.table.geom_column().y
+        exp = int(((np.abs(ay) <= 2.0) & (ax >= -20) & (ax <= 20)).sum())
+        # corridor includes rounded segment ends (clamped projection), so
+        # features just past the endpoints within buffer also match
+        exp_ends = int(
+            (
+                (np.abs(ay) <= 2.0)
+                & (
+                    ((ax >= -20) & (ax <= 20))
+                    | (np.sqrt((ax + 20) ** 2 + ay**2) <= 2.0)
+                    | (np.sqrt((ax - 20) ** 2 + ay**2) <= 2.0)
+                )
+            ).sum()
+        )
+        assert exp <= len(t) <= exp_ends
+
+    def test_heading_match(self, ds):
+        t_all = route_search(ds, "r", self.ROUTE, buffer_deg=3.0)
+        t_head = route_search(
+            ds, "r", self.ROUTE, buffer_deg=3.0,
+            heading_field="heading", heading_tolerance_deg=30.0,
+        )
+        assert len(t_head) < len(t_all)
+        # east = bearing 90; all matches within 30 degrees of that
+        h = t_head.columns["heading"].values % 360.0
+        diff = np.abs((h - 90.0 + 180.0) % 360.0 - 180.0)
+        assert np.all(diff <= 30.0 + 1e-9)
+
+    def test_bidirectional(self, ds):
+        one = route_search(
+            ds, "r", self.ROUTE, buffer_deg=3.0,
+            heading_field="heading", heading_tolerance_deg=30.0,
+        )
+        both = route_search(
+            ds, "r", self.ROUTE, buffer_deg=3.0,
+            heading_field="heading", heading_tolerance_deg=30.0,
+            bidirectional=True,
+        )
+        assert len(both) > len(one)
+
+
+class TestTrackLabel:
+    def test_latest_per_track(self, ds):
+        r = ds.query("r", "INCLUDE")
+        labels = track_label(r.table, "name")
+        assert len(labels) == 8  # one per track
+        t = r.table.dtg_millis()
+        names = r.table.columns["name"].values
+        for i in range(len(labels)):
+            rec = labels.record(i)
+            sel = names == rec["name"]
+            assert rec["dtg"] == int(t[sel].max())
+
+
+class TestSamplingMinMaxDensityStats:
+    def test_sampling(self, ds):
+        full = ds.query("r", "INCLUDE").count
+        t = sampling(ds, "r", 0.1)
+        assert 0 < len(t) <= full * 0.15
+
+    def test_sampling_by_group(self, ds):
+        t = sampling(ds, "r", 0.25, threads_or_by="name")
+        assert len(t) > 0
+        assert set(t.columns["name"].values) == {f"trk{i}" for i in range(8)}
+
+    def test_min_max_cached_vs_exact(self, ds):
+        cached = min_max(ds, "r", "heading")
+        exact = min_max(ds, "r", "heading", cached=False)
+        assert cached is not None and exact is not None
+        np.testing.assert_allclose(cached, exact)
+        lo, hi = exact
+        assert 0.0 <= lo < hi <= 360.0
+
+    def test_min_max_filtered(self, ds):
+        got = min_max(ds, "r", "dtg", filter="name = 'trk1'")
+        r = ds.query("r", "name = 'trk1'")
+        t = r.table.dtg_millis()
+        assert got == (int(t.min()), int(t.max()))
+
+    def test_density_wrapper(self, ds):
+        grid = density(ds, "r", bbox=(-50, -50, 50, 50), width=64, height=64)
+        assert grid.shape == (64, 64)
+        assert grid.sum() == ds.query("r", "INCLUDE").count
+
+    def test_stats_wrapper(self, ds):
+        out = stats(ds, "r", "Count();MinMax(heading)")
+        assert out["Count()"].count == ds.query("r", "INCLUDE").count
+
+
+class TestConversions:
+    def test_arrow_conversion_roundtrip(self, ds):
+        import pyarrow as pa
+
+        data = arrow_conversion(ds, "r", filter="name = 'trk2'")
+        reader = pa.ipc.open_stream(data)
+        at = reader.read_all()
+        assert at.num_rows == ds.query("r", "name = 'trk2'").count
+
+    def test_bin_conversion(self, ds):
+        from geomesa_tpu.utils import bin_format
+
+        data = bin_conversion(ds, "r", filter="name = 'trk3'", track="name", sort=True)
+        dec = bin_format.decode(data)
+        n = ds.query("r", "name = 'trk3'").count
+        assert len(dec["dtg_secs"]) == n
+        assert np.all(np.diff(dec["dtg_secs"]) >= 0)
+
+    def test_date_offset(self, ds):
+        r = ds.query("r", "INCLUDE")
+        shifted = date_offset(r.table, 86_400_000)
+        np.testing.assert_array_equal(
+            shifted.dtg_millis(), r.table.dtg_millis() + 86_400_000
+        )
+
+    def test_hash_attribute_stable(self, ds):
+        r = ds.query("r", "INCLUDE")
+        h1 = hash_attribute(r.table, "name", 4)
+        h2 = hash_attribute(r.table, "name", 4)
+        np.testing.assert_array_equal(h1, h2)
+        assert h1.min() >= 0 and h1.max() < 4
+        # same value → same bucket
+        names = r.table.columns["name"].values
+        for nm in np.unique(names.astype(object)):
+            assert len(np.unique(h1[names == nm])) == 1

@@ -74,6 +74,57 @@ class TestMergedView:
         view = MergedDataStoreView([_store("a", 4, 0.0), _store("b", 6, 100.0)])
         assert view.stats_count("pts", exact=True) == 10
 
+    def test_scoped_stats_count_matches_query(self):
+        # scope filters must apply to stats_count, not just query()
+        view = MergedDataStoreView(
+            [(_store("a", 1, 0.0), "src = 'a'"), (_store("b", 5, 100.0), "src = 'nope'")]
+        )
+        assert view.stats_count("pts", exact=True) == view.query("pts").count == 1
+        assert view.stats_count("pts", "src = 'a'", exact=True) == 1
+
+    def test_merged_bin_sorted(self):
+        # per-store BIN chunks must merge time-sorted, not concatenate
+        a = _store("a", 5, 0.0)
+        sft = parse_spec("pts", SPEC)
+        b = DataStore(backend="oracle")
+        b.create_schema(sft)
+        b.write(
+            "pts",
+            [  # timestamps interleave with store a's 1_000_000+i
+                {"dtg": 1_000_000 + 10_000 * i + 5_000, "geom": Point(50.0 + i, 0.0), "src": "b"}
+                for i in range(5)
+            ],
+        )
+        view = MergedDataStoreView([a, b])
+        res = view.query("pts", Query(hints={"bin": {"sort": True}}))
+        from geomesa_tpu.utils.bin_format import decode
+
+        dec = decode(res.bin_data)
+        assert len(dec["dtg_secs"]) == 10
+        assert np.all(np.diff(dec["dtg_secs"]) >= 0)
+
+    def test_empty_store_aggregation_hints(self):
+        # an empty store must still return empty aggregates, not None
+        sft = parse_spec("pts", SPEC)
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        res = ds.query("pts", Query(hints={"stats": "MinMax(dtg)"}))
+        assert res.stats is not None and res.stats["MinMax(dtg)"].min is None
+        res = ds.query(
+            "pts", Query(hints={"density": {"bbox": (-180, -90, 180, 90), "width": 8, "height": 8}})
+        )
+        assert res.density is not None and res.density.sum() == 0.0
+        from geomesa_tpu.process.processes import min_max
+
+        assert min_max(ds, "pts", "dtg", cached=False) is None
+
+    def test_crs_hint_with_projection(self):
+        # reprojection must run even when properties exclude the geometry
+        ds = _store("a", 3, 10.0)
+        res = ds.query("pts", Query(properties=["src"], hints={"crs": "EPSG:3857"}))
+        assert res.count == 3
+        assert set(res.table.columns) == {"src"}
+
 
 class TestAgeOff:
     def _ttl_store(self, backend="oracle"):
