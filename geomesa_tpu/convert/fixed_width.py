@@ -1,0 +1,59 @@
+"""Fixed-width text ingest converter.
+
+The ``geomesa-convert-fixedwidth`` role (SURVEY.md §2.16): records are lines,
+attributes are character slices. Columns are cut into a string DataFrame and
+handed to the delimited converter's transform machinery, so the full
+expression language (``point()``, ``date()``, casts, error modes, counters)
+applies unchanged — ``$1``..``$n`` refer to the configured slices in order.
+"""
+
+from __future__ import annotations
+
+import io
+
+import pandas as pd
+
+from geomesa_tpu.convert.delimited import DelimitedConverter, EvaluationContext
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+
+
+class FixedWidthConverter(DelimitedConverter):
+    """Lines of fixed-width fields → FeatureTable.
+
+    ``slices``: [(start, length), ...] zero-based character slices, defining
+    columns ``$1``..``$n`` for the field expressions.
+    """
+
+    def __init__(
+        self,
+        sft: FeatureType,
+        slices: list[tuple[int, int]],
+        fields: dict[str, str],
+        id_field: str | None = None,
+        error_mode: str = "skip",
+    ):
+        super().__init__(
+            sft, fields, id_field=id_field, header=False, error_mode=error_mode
+        )
+        if not slices:
+            raise ValueError("need at least one slice")
+        self.slices = [(int(s), int(w)) for s, w in slices]
+
+    def _frame(self, lines) -> pd.DataFrame:
+        cols = {
+            i: [ln[s : s + w].strip() for ln in lines]
+            for i, (s, w) in enumerate(self.slices)
+        }
+        return pd.DataFrame(cols, dtype=str)
+
+    def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        with open(path) as f:
+            return self.convert_lines(f.read().splitlines(), ctx)
+
+    def convert_str(self, text: str, ctx: EvaluationContext | None = None) -> FeatureTable:
+        return self.convert_lines(io.StringIO(text).read().splitlines(), ctx)
+
+    def convert_lines(self, lines, ctx: EvaluationContext | None = None) -> FeatureTable:
+        lines = [ln for ln in lines if ln.strip()]
+        return self.convert_frame(self._frame(lines), ctx)

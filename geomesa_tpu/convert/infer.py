@@ -1,0 +1,93 @@
+"""Schema type inference from sample data.
+
+The ``TypeInference`` role (``geomesa-convert-common/.../TypeInference.scala``,
+478 LoC — SURVEY.md §2.16): given sample rows, infer per-column types by
+trying progressively wider parses (int → long → double → boolean → date →
+string), detect a lon/lat pair for the default geometry, and emit both an SFT
+spec string and the matching converter field expressions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+
+_LON_NAMES = {"lon", "long", "longitude", "x", "lng"}
+_LAT_NAMES = {"lat", "latitude", "y"}
+
+
+def _non_empty(series: pd.Series) -> pd.Series:
+    s = series.astype(str).str.strip()
+    return s[s != ""]
+
+
+def _infer_column(series: pd.Series) -> str:
+    """One column's sample values → SFT type name."""
+    vals = _non_empty(series)
+    if len(vals) == 0:
+        return "String"
+    nums = pd.to_numeric(vals, errors="coerce")
+    if not nums.isna().any():
+        if (nums == nums.round()).all() and not vals.str.contains(
+            r"[.eE]", regex=True
+        ).any():
+            lo, hi = nums.min(), nums.max()
+            return "Integer" if -(2**31) <= lo and hi < 2**31 else "Long"
+        return "Double"
+    low = vals.str.lower()
+    if low.isin(("true", "false")).all():
+        return "Boolean"
+    parsed = pd.to_datetime(vals, errors="coerce", utc=True, format="mixed")
+    if not parsed.isna().any() and vals.str.contains(r"[-:T/]", regex=True).all():
+        return "Date"
+    return "String"
+
+
+def infer_schema(
+    df_or_path,
+    name: str = "inferred",
+    sample: int = 1000,
+    delimiter: str = ",",
+) -> tuple[FeatureType, dict[str, str]]:
+    """Sample data → (FeatureType, converter ``fields``).
+
+    Accepts a path to a headered delimited file or a DataFrame. A geometry
+    attribute named ``geom`` is synthesized from the first recognizable
+    (lon, lat) column-name pair whose values fit the coordinate domain; the
+    first Date column becomes the default time attribute.
+    """
+    if isinstance(df_or_path, pd.DataFrame):
+        df = df_or_path.head(sample).astype(str)
+    else:
+        df = pd.read_csv(
+            df_or_path, sep=delimiter, dtype=str, keep_default_na=False,
+            na_values=[], nrows=sample,
+        )
+
+    types = {c: _infer_column(df[c]) for c in df.columns}
+
+    lon = lat = None
+    for c in df.columns:
+        cl = str(c).strip().lower()
+        if lon is None and cl in _LON_NAMES and types[c] in ("Integer", "Long", "Double"):
+            v = pd.to_numeric(_non_empty(df[c]), errors="coerce")
+            if len(v) and v.abs().max() <= 180:
+                lon = c
+        elif lat is None and cl in _LAT_NAMES and types[c] in ("Integer", "Long", "Double"):
+            v = pd.to_numeric(_non_empty(df[c]), errors="coerce")
+            if len(v) and v.abs().max() <= 90:
+                lat = c
+
+    parts = []
+    fields: dict[str, str] = {}
+    for c in df.columns:
+        attr = str(c).strip().replace(" ", "_")
+        parts.append(f"{attr}:{types[c]}")
+        fields[attr] = str(c)
+    if lon is not None and lat is not None:
+        parts.append("*geom:Point")
+        fields["geom"] = f"point({lon}, {lat})"
+    spec = ",".join(parts)
+    return parse_spec(name, spec), fields

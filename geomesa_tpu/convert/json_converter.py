@@ -1,0 +1,291 @@
+"""JSON ingest converter: jsonpath-subset field extraction → FeatureTable.
+
+The ``geomesa-convert-json`` role (SURVEY.md §2.16): declarative mappings from
+JSON documents (a whole document, a path to a feature array, or JSON-lines
+files) into typed SFT attributes, sharing the delimited converter's typed
+column builders, error modes, and evaluation counters.
+
+Path grammar (subset of the reference's jsonpath support):
+
+    $                   the record itself
+    $.a.b               nested object fields
+    $.arr[2]            array index
+    $.features[*]       (feature_path only) iterate an array of records
+
+Field expressions: a bare path, ``point(<path>, <path>)`` for lon/lat pairs,
+``geojson(<path>)`` for GeoJSON geometry objects, or
+``concat(<path>, 'lit', ...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import (
+    EvaluationContext,
+    _boolean_column,
+    _date_column,
+    _numeric_column,
+    _split_args,
+)
+from geomesa_tpu.geometry.types import (
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from geomesa_tpu.schema.columnar import (
+    Column,
+    FeatureTable,
+    _geometry_column,
+    point_column,
+)
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+_NUMERIC = {
+    AttributeType.INT,
+    AttributeType.LONG,
+    AttributeType.FLOAT,
+    AttributeType.DOUBLE,
+}
+_STEP = re.compile(r"\.(\w+)|\[(\d+|\*)\]")
+
+
+def _parse_path(path: str):
+    path = path.strip()
+    if not path.startswith("$"):
+        raise ValueError(f"path must start with $: {path!r}")
+    steps = []
+    for m in _STEP.finditer(path, 1):
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) == "*":
+            steps.append("*")
+        else:
+            steps.append(int(m.group(2)))
+    return steps
+
+
+def _walk(obj, steps):
+    for s in steps:
+        if obj is None:
+            return None
+        if s == "*":
+            raise ValueError("[*] is only allowed in feature_path")
+        if isinstance(s, int):
+            obj = obj[s] if isinstance(obj, list) and s < len(obj) else None
+        else:
+            obj = obj.get(s) if isinstance(obj, dict) else None
+    return obj
+
+
+def geojson_geometry(obj):
+    """GeoJSON geometry dict → geometry object (None on null/invalid)."""
+    if not isinstance(obj, dict):
+        return None
+    typ = obj.get("type")
+    c = obj.get("coordinates")
+    try:
+        if typ == "Point":
+            return Point(float(c[0]), float(c[1]))
+        if typ == "LineString":
+            return LineString(c)
+        if typ == "Polygon":
+            return Polygon(c[0], holes=tuple(c[1:]))
+        if typ == "MultiPoint":
+            return MultiPoint([Point(float(p[0]), float(p[1])) for p in c])
+        if typ == "MultiLineString":
+            return MultiLineString([LineString(p) for p in c])
+        if typ == "MultiPolygon":
+            return MultiPolygon([Polygon(p[0], holes=tuple(p[1:])) for p in c])
+    except (TypeError, ValueError, IndexError):
+        return None
+    return None
+
+
+class JsonConverter:
+    """JSON documents → FeatureTable for one schema.
+
+    ``feature_path``: path to the record array (e.g. ``$.features[*]``) or
+    ``$`` for one-record-per-document / JSON-lines input.
+    ``fields``: {attribute: expression}; ``id_field``: expression for ids.
+    """
+
+    def __init__(
+        self,
+        sft: FeatureType,
+        fields: dict[str, str],
+        feature_path: str = "$",
+        id_field: str | None = None,
+        error_mode: str = "skip",
+    ):
+        self.sft = sft
+        self.fields = fields
+        self.id_field = id_field
+        if error_mode not in ("skip", "raise"):
+            raise ValueError(f"error_mode must be skip|raise: {error_mode}")
+        self.error_mode = error_mode
+        steps = _parse_path(feature_path)
+        if "*" in steps:
+            if steps[-1] != "*" or "*" in steps[:-1]:
+                raise ValueError("[*] must be the final feature_path step")
+            self._prefix, self._iterate = steps[:-1], True
+        else:
+            self._prefix, self._iterate = steps, False
+
+    # -- record extraction ---------------------------------------------------
+    def _records(self, text: str) -> list:
+        text = text.strip()
+        if not text:
+            return []
+        if not self._iterate and not text.startswith(("[", "{")):
+            raise ValueError("not a JSON document")
+        if "\n" in text and not text.startswith("["):
+            # JSON-lines: one document per line, feature_path applied per line
+            docs = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        else:
+            docs = [json.loads(text)]
+        records = []
+        for doc in docs:
+            base = _walk(doc, self._prefix)
+            if self._iterate:
+                records.extend(base or [])
+            elif isinstance(base, list):
+                records.extend(base)
+            elif base is not None:
+                records.append(base)
+        return records
+
+    def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        with open(path) as f:
+            return self.convert_str(f.read(), ctx)
+
+    def convert_str(self, text: str, ctx: EvaluationContext | None = None) -> FeatureTable:
+        records = self._records(text)
+        ctx = ctx if ctx is not None else EvaluationContext()
+        n = len(records)
+        cols: dict[str, Column] = {}
+        bad = np.zeros(n, dtype=bool)
+        for a in self.sft.attributes:
+            expr = self.fields.get(a.name, f"$.{a.name}")
+            try:
+                col, col_bad = self._eval(expr, records, a.type)
+            except Exception as e:
+                raise ValueError(f"transform {expr!r} for {a.name!r} failed: {e}") from e
+            cols[a.name] = col
+            bad |= col_bad
+        if bad.any():
+            if self.error_mode == "raise":
+                idx = int(np.nonzero(bad)[0][0])
+                raise ValueError(f"bad record at index {idx}")
+            ctx.failure += int(bad.sum())
+            good = ~bad
+            cols = {k: c.take(good) for k, c in cols.items()}
+        else:
+            good = slice(None)
+        kept = int((~bad).sum())
+        ctx.success += kept
+        if self.id_field:
+            fid_col, _ = self._eval(self.id_field, records, AttributeType.STRING)
+            fids = fid_col.values[good]
+        else:
+            fids = np.arange(n)[good].astype(str).astype(object)
+        return FeatureTable(self.sft, np.asarray(fids, dtype=object), cols)
+
+    # -- expression evaluation ----------------------------------------------
+    def _raw(self, expr: str, records) -> np.ndarray:
+        """Sub-expression → object array of raw strings ('' for null)."""
+        expr = expr.strip()
+        out = np.empty(len(records), dtype=object)
+        if expr.startswith(("'", '"')):
+            out[:] = expr[1:-1]
+            return out
+        if expr.startswith("concat"):
+            m = re.match(r"^concat\s*\((.*)\)$", expr, re.S)
+            parts = [self._raw(a, records) for a in _split_args(m.group(1))]
+            acc = parts[0].astype(str)
+            for p in parts[1:]:
+                acc = np.char.add(acc, p.astype(str))
+            return acc.astype(object)
+        steps = _parse_path(expr)
+        for i, r in enumerate(records):
+            v = _walk(r, steps)
+            out[i] = "" if v is None else (str(v).lower() if isinstance(v, bool) else str(v))
+        return out
+
+    def _values(self, path: str, records) -> list:
+        steps = _parse_path(path)
+        return [_walk(r, steps) for r in records]
+
+    def _eval(self, expr: str, records, typ: AttributeType) -> tuple[Column, np.ndarray]:
+        expr = expr.strip()
+        n = len(records)
+        m = re.match(r"^(\w+)\s*\((.*)\)$", expr, re.S)
+        fn = m.group(1).lower() if m and m.group(1).lower() in (
+            "point", "geojson", "isodate", "millistodate",
+        ) else None
+
+        if fn == "point":
+            ax, ay = _split_args(m.group(2))
+            xs = np.array(
+                [v if isinstance(v, (int, float)) else np.nan for v in self._values(ax, records)],
+                dtype=np.float64,
+            )
+            ys = np.array(
+                [v if isinstance(v, (int, float)) else np.nan for v in self._values(ay, records)],
+                dtype=np.float64,
+            )
+            bad = ~(np.isfinite(xs) & np.isfinite(ys))
+            bad |= (np.abs(np.nan_to_num(xs)) > 180) | (np.abs(np.nan_to_num(ys)) > 90)
+            return point_column(np.where(bad, 0.0, xs), np.where(bad, 0.0, ys)), bad
+
+        if fn == "geojson":
+            (path,) = _split_args(m.group(2))
+            raws = self._values(path, records)
+            geoms = [geojson_geometry(v) for v in raws]
+            bad = np.array(
+                [g is None and v is not None for g, v in zip(geoms, raws)], dtype=bool
+            )
+            return _geometry_column(typ, geoms), bad
+
+        if fn == "isodate":
+            import pandas as pd
+
+            (path,) = _split_args(m.group(2))
+            raw = self._raw(path, records)
+            parsed = pd.to_datetime(pd.Series(raw), errors="coerce", utc=True, format="ISO8601")
+            return _date_column(raw, parsed)
+
+        if fn == "millistodate":
+            (path,) = _split_args(m.group(2))
+            vals = self._values(path, records)
+            nums = np.array(
+                [v if isinstance(v, (int, float)) else 0 for v in vals], dtype=np.int64
+            )
+            bad = np.array(
+                [not isinstance(v, (int, float)) and v is not None for v in vals], dtype=bool
+            )
+            valid = np.array([isinstance(v, (int, float)) for v in vals])
+            return Column(AttributeType.DATE, nums, None if valid.all() else valid), bad
+
+        # bare path / concat / literal, coerced to the target type
+        raw = self._raw(expr, records)
+        if typ in _NUMERIC:
+            return _numeric_column(raw, typ)
+        if typ == AttributeType.DATE:
+            import pandas as pd
+
+            parsed = pd.to_datetime(pd.Series(raw), errors="coerce", utc=True)
+            return _date_column(raw, parsed)
+        if typ == AttributeType.BOOLEAN:
+            return _boolean_column(raw)
+        if typ.is_geometry:
+            geoms = [geojson_geometry(v) for v in self._values(expr, records)]
+            return _geometry_column(typ, geoms), np.zeros(n, dtype=bool)
+        valid = np.array([v != "" for v in raw])
+        return Column(typ, raw, None if valid.all() else valid), np.zeros(n, dtype=bool)

@@ -1,0 +1,50 @@
+"""Feature validators applied after conversion, before write.
+
+The ``SimpleFeatureValidator`` role (``convert2/.../SimpleFeatureValidator``,
+272 LoC — SURVEY.md §2.16): named validators gate converted features before
+ingest. ``has-geo`` requires a non-null geometry, ``has-dtg`` a non-null date,
+``index`` both (the reference's default — rows missing either can't be keyed
+by the Z/XZ indexes), ``none`` disables validation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.schema.columnar import FeatureTable
+
+_NAMES = ("index", "has-geo", "has-dtg", "none")
+
+
+def validation_mask(table: FeatureTable, validators=("index",)) -> np.ndarray:
+    """Boolean keep-mask for ``table`` under the named validators."""
+    ok = np.ones(len(table), dtype=bool)
+    for v in validators:
+        if v not in _NAMES:
+            raise ValueError(f"unknown validator {v!r}; expected one of {_NAMES}")
+        if v == "none":
+            continue
+        if v in ("index", "has-geo") and table.sft.geom_field is not None:
+            ok &= table.geom_column().is_valid()
+        if v in ("index", "has-dtg") and table.sft.dtg_field is not None:
+            ok &= table.columns[table.sft.dtg_field].is_valid()
+    return ok
+
+
+def apply_validators(
+    table: FeatureTable,
+    validators=("index",),
+    ctx=None,
+    error_mode: str = "skip",
+) -> FeatureTable:
+    """Filter (or reject, under ``error_mode='raise'``) invalid features."""
+    ok = validation_mask(table, validators)
+    if ok.all():
+        return table
+    if error_mode == "raise":
+        idx = int(np.nonzero(~ok)[0][0])
+        raise ValueError(f"feature {table.fids[idx]!r} failed validation {validators}")
+    if ctx is not None:
+        ctx.failure += int((~ok).sum())
+        ctx.success -= int((~ok).sum())
+    return table.take(np.nonzero(ok)[0])

@@ -1,0 +1,197 @@
+"""Converter framework extensions: JSON, fixed-width, type inference,
+validators (reference: geomesa-convert suites — SURVEY.md §2.16)."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from geomesa_tpu.convert.delimited import EvaluationContext
+from geomesa_tpu.convert.fixed_width import FixedWidthConverter
+from geomesa_tpu.convert.infer import infer_schema
+from geomesa_tpu.convert.json_converter import JsonConverter, geojson_geometry
+from geomesa_tpu.convert.validate import apply_validators, validation_mask
+from geomesa_tpu.geometry.types import Point, Polygon
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+
+class TestJsonConverter:
+    SFT = parse_spec("j", "name:String,age:Integer,dtg:Date,*geom:Point")
+
+    def conv(self, **kw):
+        return JsonConverter(
+            self.SFT,
+            fields={
+                "name": "$.props.name",
+                "age": "$.props.age",
+                "dtg": "isodate($.when)",
+                "geom": "point($.lon, $.lat)",
+            },
+            feature_path="$.features[*]",
+            id_field="$.id",
+            **kw,
+        )
+
+    def doc(self):
+        return json.dumps(
+            {
+                "features": [
+                    {"id": "a", "props": {"name": "n1", "age": 31},
+                     "when": "2017-07-01T00:00:00Z", "lon": 10.0, "lat": 20.0},
+                    {"id": "b", "props": {"name": "n2", "age": 7},
+                     "when": "2017-07-02T12:00:00Z", "lon": -5.5, "lat": 4.25},
+                ]
+            }
+        )
+
+    def test_feature_array(self):
+        t = self.conv().convert_str(self.doc())
+        assert len(t) == 2
+        assert list(t.fids) == ["a", "b"]
+        r = t.record(0)
+        assert r["name"] == "n1" and r["age"] == 31
+        assert r["dtg"] == 1_498_867_200_000
+        assert r["geom"].x == 10.0 and r["geom"].y == 20.0
+
+    def test_json_lines(self):
+        conv = JsonConverter(
+            self.SFT,
+            fields={
+                "name": "$.name",
+                "age": "$.age",
+                "dtg": "millisToDate($.t)",
+                "geom": "geojson($.geometry)",
+            },
+        )
+        lines = "\n".join(
+            json.dumps(
+                {"name": f"x{i}", "age": i, "t": 1000 * i,
+                 "geometry": {"type": "Point", "coordinates": [i, -i]}}
+            )
+            for i in range(5)
+        )
+        t = conv.convert_str(lines)
+        assert len(t) == 5
+        assert t.record(3)["geom"].x == 3.0
+        np.testing.assert_array_equal(
+            t.dtg_millis(), np.arange(5) * 1000
+        )
+
+    def test_error_modes(self):
+        bad = json.dumps(
+            {
+                "features": [
+                    {"id": "a", "props": {"name": "n1", "age": 1},
+                     "when": "2017-07-01T00:00:00Z", "lon": 10.0, "lat": 20.0},
+                    {"id": "bad", "props": {"name": "n2", "age": 2},
+                     "when": "2017-07-01T00:00:00Z", "lon": 999.0, "lat": 20.0},
+                ]
+            }
+        )
+        ctx = EvaluationContext()
+        t = self.conv().convert_str(bad, ctx)
+        assert len(t) == 1 and ctx.failure == 1 and ctx.success == 1
+        with pytest.raises(ValueError, match="bad record"):
+            self.conv(error_mode="raise").convert_str(bad)
+
+    def test_geojson_geometry_kinds(self):
+        p = geojson_geometry({"type": "Point", "coordinates": [1, 2]})
+        assert isinstance(p, Point)
+        poly = geojson_geometry(
+            {"type": "Polygon", "coordinates": [[[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]]]}
+        )
+        assert isinstance(poly, Polygon)
+        assert geojson_geometry(None) is None
+        assert geojson_geometry({"type": "Point", "coordinates": []}) is None
+
+
+class TestFixedWidth:
+    def test_slices_and_transforms(self):
+        sft = parse_spec("f", "code:String,val:Integer,*geom:Point")
+        #        0-3: code, 3-9: lon, 9-15: lat, 15-18: val
+        lines = [
+            "abc  10.5  20.5  7 ",
+            "xyz -11.25 41.0 42 ",
+        ]
+        conv = FixedWidthConverter(
+            sft,
+            slices=[(0, 3), (3, 7), (10, 6), (16, 3)],
+            fields={"code": "$1", "val": "int($4)", "geom": "point($2, $3)"},
+        )
+        t = conv.convert_lines(lines)
+        assert len(t) == 2
+        assert t.record(0)["code"] == "abc"
+        assert t.record(1)["val"] == 42
+        assert t.record(1)["geom"].x == pytest.approx(-11.25)
+
+
+class TestInference:
+    def test_infer_types_and_geometry(self):
+        df = pd.DataFrame(
+            {
+                "name": ["a", "b", "c"],
+                "count": ["1", "2", "3"],
+                "big": [str(2**40), "5", "6"],
+                "ratio": ["0.5", "1.5", "2.0"],
+                "flag": ["true", "false", "true"],
+                "when": ["2017-07-01T00:00:00Z"] * 3,
+                "lon": ["10.0", "20.0", "30.0"],
+                "lat": ["-5.0", "5.0", "15.0"],
+            }
+        )
+        sft, fields = infer_schema(df, "t")
+        types = {a.name: a.type.name for a in sft.attributes}
+        assert types["name"] == "STRING"
+        assert types["count"] == "INT"
+        assert types["big"] == "LONG"
+        assert types["ratio"] == "DOUBLE"
+        assert types["flag"] == "BOOLEAN"
+        assert types["when"] == "DATE"
+        assert sft.geom_field == "geom"
+        assert fields["geom"] == "point(lon, lat)"
+
+    def test_infer_from_csv(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("id,x,y\n1,5.0,6.0\n2,7.0,8.0\n")
+        sft, fields = infer_schema(str(p), "c")
+        assert sft.geom_field == "geom"
+        assert {a.name for a in sft.attributes} >= {"id", "x", "y", "geom"}
+
+
+class TestValidators:
+    SFT = parse_spec("v", "name:String,dtg:Date,*geom:Point")
+
+    def table(self):
+        return FeatureTable.from_records(
+            self.SFT,
+            [
+                {"name": "ok", "dtg": 1000, "geom": Point(1, 1)},
+                {"name": "nogeo", "dtg": 1000, "geom": None},
+                {"name": "nodtg", "dtg": None, "geom": Point(2, 2)},
+            ],
+        )
+
+    def test_masks(self):
+        t = self.table()
+        np.testing.assert_array_equal(
+            validation_mask(t, ("index",)), [True, False, False]
+        )
+        np.testing.assert_array_equal(
+            validation_mask(t, ("has-geo",)), [True, False, True]
+        )
+        np.testing.assert_array_equal(
+            validation_mask(t, ("has-dtg",)), [True, True, False]
+        )
+        np.testing.assert_array_equal(validation_mask(t, ("none",)), [True] * 3)
+
+    def test_apply(self):
+        ctx = EvaluationContext(success=3)
+        out = apply_validators(self.table(), ("index",), ctx)
+        assert len(out) == 1 and out.record(0)["name"] == "ok"
+        assert ctx.failure == 2 and ctx.success == 1
+        with pytest.raises(ValueError, match="failed validation"):
+            apply_validators(self.table(), ("index",), error_mode="raise")
+        with pytest.raises(ValueError, match="unknown validator"):
+            validation_mask(self.table(), ("bogus",))
