@@ -1,0 +1,6 @@
+"""GeoJSON document API over any datastore."""
+
+from geomesa_tpu.geojson.api import GeoJsonIndex
+from geomesa_tpu.geojson.query import compile_query
+
+__all__ = ["GeoJsonIndex", "compile_query"]

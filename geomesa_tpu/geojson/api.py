@@ -1,0 +1,123 @@
+"""GeoJSON document store facade over a datastore.
+
+Role parity: ``geomesa-geojson/.../GeoJsonGtIndex.scala`` (439 LoC — SURVEY.md
+§2.8): schemaless GeoJSON features stored whole (the document is the value),
+with geometry — and optionally a date path — extracted into indexed attributes
+so the mongo-style query language (:mod:`geomesa_tpu.geojson.query`) rides the
+normal planned index scans; property predicates refine the parsed documents.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from geomesa_tpu.convert.json_converter import geojson_geometry
+from geomesa_tpu.geojson.query import compile_query
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+
+_GEOM = "geom"
+
+
+class GeoJsonIndex:
+    """Spatially-indexed GeoJSON document collections."""
+
+    def __init__(self, store=None):
+        if store is None:
+            from geomesa_tpu.store.datastore import DataStore
+
+            store = DataStore(backend="tpu")
+        self.store = store
+        self._meta: dict[str, dict] = {}
+
+    def create_index(
+        self,
+        name: str,
+        id_path: str | None = None,
+        dtg_path: str | None = None,
+        points: bool = False,
+    ) -> None:
+        """``id_path``/``dtg_path``: dotted document paths (e.g.
+        ``properties.id``); ``points`` promises Point-only geometries (enables
+        the Z2/Z3 point indexes instead of XZ)."""
+        gtype = "Point" if points else "Geometry"
+        spec = f"json:String,dtg:Date,*{_GEOM}:{gtype}" if dtg_path else f"json:String,*{_GEOM}:{gtype}"
+        self.store.create_schema(parse_spec(name, spec))
+        self._meta[name] = {"id_path": id_path, "dtg_path": dtg_path}
+
+    def delete_index(self, name: str) -> None:
+        self.store.delete_schema(name)
+        self._meta.pop(name, None)
+
+    # -- write ---------------------------------------------------------------
+    def add(self, name: str, features) -> list[str]:
+        """Add GeoJSON: a FeatureCollection (dict or JSON string), a single
+        feature, or a list of features. Returns assigned feature ids."""
+        meta = self._meta[name]
+        if isinstance(features, str):
+            features = json.loads(features)
+        if isinstance(features, dict):
+            if features.get("type") == "FeatureCollection":
+                features = features.get("features", [])
+            else:
+                features = [features]
+
+        from geomesa_tpu.geojson.query import _doc_get
+
+        st = self.store.get_schema(name)
+        base = self.store.stats_count(name)
+        recs = []
+        fids = []
+        for i, doc in enumerate(features):
+            g = geojson_geometry(doc.get("geometry"))
+            if g is None:
+                raise ValueError(f"feature {i} has no valid geometry")
+            rec = {"json": json.dumps(doc, separators=(",", ":")), _GEOM: g}
+            if meta["dtg_path"]:
+                rec["dtg"] = _millis(_doc_get(doc, meta["dtg_path"]))
+            if meta["id_path"]:
+                fid = _doc_get(doc, meta["id_path"])
+            else:
+                fid = doc.get("id")
+            fids.append(str(fid) if fid is not None else f"{name}.{base + i}")
+            recs.append(rec)
+        if st.dtg_field and any(r.get("dtg") is None for r in recs):
+            bad = next(i for i, r in enumerate(recs) if r.get("dtg") is None)
+            raise ValueError(f"feature {bad} missing date at {meta['dtg_path']!r}")
+        self.store.write(name, recs, fids=fids)
+        return fids
+
+    # -- read ----------------------------------------------------------------
+    def query(self, name: str, q=None) -> list[dict]:
+        """Run a GeoJSON query → list of parsed feature documents (with the
+        stored feature id filled into ``id`` when absent)."""
+        f, pred = compile_query(q or {}, geom_field=_GEOM)
+        r = self.store.query(name, Query(filter=f))
+        docs = []
+        col = r.table.columns["json"]
+        for i in range(len(r.table)):
+            doc = json.loads(col.values[i])
+            doc.setdefault("id", str(r.table.fids[i]))
+            if pred(doc):
+                docs.append(doc)
+        return docs
+
+    def query_collection(self, name: str, q=None) -> dict:
+        """Like :meth:`query` but wrapped as a FeatureCollection dict."""
+        return {"type": "FeatureCollection", "features": self.query(name, q)}
+
+    def get(self, name: str, ids) -> list[dict]:
+        ids = [ids] if isinstance(ids, str) else list(ids)
+        return self.query(name, {"$id": ids})
+
+
+def _millis(v):
+    if v is None:
+        return None
+    if isinstance(v, (int, float, np.integer)):
+        return int(v)
+    from geomesa_tpu.schema.columnar import _to_millis
+
+    return _to_millis(str(v))

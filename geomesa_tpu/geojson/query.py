@@ -1,0 +1,149 @@
+"""GeoJSON mini query language → (index filter, residual doc predicate).
+
+Role parity: ``geomesa-geojson/.../GeoJsonQuery`` (446 LoC — SURVEY.md §2.8):
+a mongo-style JSON query language over GeoJSON documents. Spatial/temporal/id
+operators compile into the normal filter AST (so they ride the planned Z/XZ
+index scans); property predicates — schemaless, dotted paths into the
+document — become a residual Python predicate applied to the parsed docs.
+
+Supported:
+
+    {}                                     everything
+    {"$bbox": [x1, y1, x2, y2]}            geometry bbox
+    {"$intersects"|"$within"|"$contains": {"$geometry": <geojson geom>}}
+    {"$dwithin": {"$geometry": ..., "$distance": deg}}
+    {"$id": ["id1", ...]}                  feature ids
+    {"properties.a.b": v}                  equality on a document path
+    {"path": {"$lt"|"$lte"|"$gt"|"$gte"|"$ne": v}} | {"path": {"$in": [...]}}
+    {"$and": [q, ...]} / {"$or": [q, ...]} / {"$not": q}
+"""
+
+from __future__ import annotations
+
+import json
+import operator
+
+from geomesa_tpu.filter import ast
+
+_CMP = {
+    "$lt": operator.lt,
+    "$lte": operator.le,
+    "$gt": operator.gt,
+    "$gte": operator.ge,
+    "$ne": operator.ne,
+}
+_SPATIAL = {"$intersects": "intersects", "$within": "within", "$contains": "contains"}
+
+
+def _doc_get(doc: dict, path: str):
+    cur = doc
+    for step in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(step)
+    return cur
+
+
+def _geom_literal(spec: dict):
+    from geomesa_tpu.convert.json_converter import geojson_geometry
+
+    g = geojson_geometry(spec.get("$geometry") if "$geometry" in spec else spec)
+    if g is None:
+        raise ValueError(f"invalid $geometry: {spec!r}")
+    return g
+
+
+class _True:
+    def __call__(self, doc) -> bool:
+        return True
+
+
+def _and(preds):
+    preds = [p for p in preds if not isinstance(p, _True)]
+    if not preds:
+        return _True()
+    return lambda doc: all(p(doc) for p in preds)
+
+
+def compile_query(query, geom_field: str = "geom"):
+    """Query dict (or JSON string) → (ast.Filter, doc_predicate).
+
+    ``doc_predicate(doc) -> bool`` evaluates the schemaless property part
+    against a parsed GeoJSON feature dict; the AST part is index-plannable.
+    """
+    if isinstance(query, str):
+        query = json.loads(query) if query.strip() else {}
+    if not query:
+        return ast.Include(), _True()
+
+    filters: list[ast.Filter] = []
+    preds = []
+    for key, val in query.items():
+        if key == "$and":
+            subs = [compile_query(q, geom_field) for q in val]
+            filters.append(ast.And([f for f, _ in subs]))
+            preds.append(_and([p for _, p in subs]))
+        elif key == "$or":
+            subs = [compile_query(q, geom_field) for q in val]
+            # OR with any residual part can't split between index and doc
+            # predicate: fall back to a full-disjunction doc predicate unless
+            # every branch is residual-free
+            filters.append(ast.Or([f for f, _ in subs]))
+            if any(not isinstance(p, _True) for _, p in subs):
+                raise ValueError(
+                    "$or over property predicates is not supported; "
+                    "use $or of spatial/id terms or restructure the query"
+                )
+            preds.append(_True())
+        elif key == "$not":
+            f, p = compile_query(val, geom_field)
+            if not isinstance(p, _True):
+                raise ValueError("$not over property predicates is not supported")
+            filters.append(ast.Not(f))
+            preds.append(_True())
+        elif key == "$bbox":
+            x1, y1, x2, y2 = val
+            filters.append(ast.BBox(geom_field, x1, y1, x2, y2))
+            preds.append(_True())
+        elif key in _SPATIAL:
+            filters.append(ast.SpatialOp(_SPATIAL[key], geom_field, _geom_literal(val)))
+            preds.append(_True())
+        elif key == "$dwithin":
+            filters.append(
+                ast.SpatialOp(
+                    "dwithin", geom_field, _geom_literal(val),
+                    distance=float(val["$distance"]),
+                )
+            )
+            preds.append(_True())
+        elif key == "$id":
+            ids = [val] if isinstance(val, str) else list(val)
+            filters.append(ast.FidIn(ids))
+            preds.append(_True())
+        elif key.startswith("$"):
+            raise ValueError(f"unknown operator {key!r}")
+        else:  # document property path
+            if isinstance(val, dict):
+                for op, lit in val.items():
+                    if op == "$in":
+                        opts = list(lit)
+                        preds.append(
+                            lambda d, _p=key, _o=opts: _doc_get(d, _p) in _o
+                        )
+                    elif op in _CMP:
+                        def _cmp(d, _p=key, _f=_CMP[op], _l=lit):
+                            v = _doc_get(d, _p)
+                            try:
+                                return v is not None and _f(v, _l)
+                            except TypeError:
+                                return False
+
+                        preds.append(_cmp)
+                    else:
+                        raise ValueError(f"unknown comparison {op!r}")
+            else:
+                preds.append(lambda d, _p=key, _l=val: _doc_get(d, _p) == _l)
+            filters.append(ast.Include())
+
+    f = filters[0] if len(filters) == 1 else ast.And(filters)
+    return f, _and(preds)

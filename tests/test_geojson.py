@@ -1,0 +1,123 @@
+"""GeoJSON document API + query language (reference: geomesa-geojson
+GeoJsonQuery/GeoJsonGtIndex — SURVEY.md §2.8)."""
+
+import json
+
+import pytest
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geojson import GeoJsonIndex, compile_query
+
+
+def feature(i, lon, lat, name, age, when=None):
+    doc = {
+        "type": "Feature",
+        "geometry": {"type": "Point", "coordinates": [lon, lat]},
+        "properties": {"name": name, "age": age, "idx": i},
+    }
+    if when is not None:
+        doc["properties"]["when"] = when
+    return doc
+
+
+@pytest.fixture(scope="module")
+def gj():
+    idx = GeoJsonIndex()
+    idx.create_index("docs", id_path="properties.idx", points=True)
+    feats = [
+        feature(i, lon=float(i * 10 - 40), lat=float(i * 5 - 10), name=f"n{i % 3}", age=20 + i)
+        for i in range(8)
+    ]
+    idx.add("docs", {"type": "FeatureCollection", "features": feats})
+    return idx
+
+
+class TestQueryLanguage:
+    def test_compile_bbox(self):
+        f, pred = compile_query({"$bbox": [-10, -10, 10, 10]})
+        assert isinstance(f, ast.BBox)
+        assert pred({"anything": 1})
+
+    def test_compile_property_residual(self):
+        f, pred = compile_query({"properties.name": "n1"})
+        assert isinstance(f, ast.Include)
+        assert pred({"properties": {"name": "n1"}})
+        assert not pred({"properties": {"name": "n2"}})
+        assert not pred({})
+
+    def test_compile_cmp_ops(self):
+        _, pred = compile_query({"properties.age": {"$gte": 25}})
+        assert pred({"properties": {"age": 25}})
+        assert not pred({"properties": {"age": 24}})
+        _, pred = compile_query({"properties.name": {"$in": ["a", "b"]}})
+        assert pred({"properties": {"name": "b"}})
+        assert not pred({"properties": {"name": "c"}})
+
+    def test_unknown_ops_raise(self):
+        with pytest.raises(ValueError):
+            compile_query({"$frobnicate": 1})
+        with pytest.raises(ValueError):
+            compile_query({"p": {"$regex": "x"}})
+        with pytest.raises(ValueError):
+            compile_query({"$or": [{"properties.a": 1}, {"$bbox": [0, 0, 1, 1]}]})
+
+
+class TestIndex:
+    def test_query_all(self, gj):
+        docs = gj.query("docs", {})
+        assert len(docs) == 8
+
+    def test_bbox_query(self, gj):
+        docs = gj.query("docs", {"$bbox": [-15, -15, 15, 15]})
+        # lons -40,-30,...,30; lats -10,-5,...,25 → i in {3,4,5} have
+        # lon in [-15,15]; lats 5,10,15 all within
+        assert sorted(d["properties"]["idx"] for d in docs) == [3, 4, 5]
+
+    def test_property_query(self, gj):
+        docs = gj.query("docs", {"properties.name": "n1"})
+        assert sorted(d["properties"]["idx"] for d in docs) == [1, 4, 7]
+
+    def test_combined_spatial_and_property(self, gj):
+        docs = gj.query(
+            "docs",
+            {"$and": [{"$bbox": [-45, -15, 5, 15]}, {"properties.age": {"$lt": 23}}]},
+        )
+        assert sorted(d["properties"]["idx"] for d in docs) == [0, 1, 2]
+
+    def test_get_by_id(self, gj):
+        docs = gj.get("docs", "5")
+        assert len(docs) == 1
+        assert docs[0]["properties"]["idx"] == 5
+
+    def test_intersects_polygon(self, gj):
+        poly = {
+            "type": "Polygon",
+            "coordinates": [[[-25, -20], [25, -20], [25, 20], [-25, 20], [-25, -20]]],
+        }
+        docs = gj.query("docs", {"$within": {"$geometry": poly}})
+        got = sorted(d["properties"]["idx"] for d in docs)
+        assert got == [2, 3, 4, 5]  # lons -20..10, lats 0..15 inside
+
+    def test_query_collection_json_str(self, gj):
+        out = gj.query_collection("docs", json.dumps({"$bbox": [-15, -15, 15, 15]}))
+        assert out["type"] == "FeatureCollection"
+        assert len(out["features"]) == 3
+
+
+class TestDtgIndex:
+    def test_dtg_extraction_and_missing(self):
+        idx = GeoJsonIndex()
+        idx.create_index("t", dtg_path="properties.when", points=True)
+        idx.add(
+            "t",
+            [feature(0, 1.0, 2.0, "a", 1, when="2017-07-01T00:00:00Z")],
+        )
+        assert len(idx.query("t", {})) == 1
+        with pytest.raises(ValueError, match="missing date"):
+            idx.add("t", [feature(1, 3.0, 4.0, "b", 2)])
+
+    def test_geometryless_feature_rejected(self):
+        idx = GeoJsonIndex()
+        idx.create_index("g", points=True)
+        with pytest.raises(ValueError, match="no valid geometry"):
+            idx.add("g", [{"type": "Feature", "properties": {}}])

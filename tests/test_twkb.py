@@ -1,0 +1,99 @@
+"""TWKB codec round-trips and size characteristics (reference:
+TwkbSerialization — SURVEY.md §2.4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.twkb import from_twkb, to_twkb
+from geomesa_tpu.geometry.types import (
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from geomesa_tpu.geometry.wkb import to_wkb
+
+
+def _assert_close(a, b, tol):
+    np.testing.assert_allclose(a, b, atol=tol)
+
+
+GEOMS = [
+    Point(12.3456789, -45.6789012),
+    Point(-180.0, 90.0),
+    LineString([[0, 0], [1.5, 2.25], [3.125, -4.0625], [3.125001, -4.0625]]),
+    Polygon([[0, 0], [10, 0], [10, 10], [0, 10]],
+            holes=(np.array([[2, 2], [4, 2], [4, 4], [2, 4]], dtype=float),)),
+    MultiPoint([Point(1, 2), Point(3, 4), Point(-5, -6)]),
+    MultiLineString([LineString([[0, 0], [1, 1]]), LineString([[5, 5], [6, 7], [8, 9]])]),
+    MultiPolygon([
+        Polygon([[0, 0], [2, 0], [2, 2], [0, 2]]),
+        Polygon([[10, 10], [12, 10], [12, 12], [10, 12]]),
+    ]),
+]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("g", GEOMS, ids=[type(g).__name__ + str(i) for i, g in enumerate(GEOMS)])
+    def test_roundtrip_p7(self, g):
+        out = from_twkb(to_twkb(g, precision=7))
+        assert type(out) is type(g)
+        tol = 0.5 * 10**-7
+        if isinstance(g, Point):
+            _assert_close([out.x, out.y], [g.x, g.y], tol)
+        elif isinstance(g, LineString):
+            _assert_close(out.coords, g.coords, tol)
+        elif isinstance(g, Polygon):
+            for ra, rb in zip(out.rings, g.rings):
+                _assert_close(ra, rb, tol)
+        else:
+            assert len(out.parts) == len(g.parts)
+
+    def test_none_roundtrip(self):
+        assert from_twkb(to_twkb(None)) is None
+
+    def test_precision_controls_error(self):
+        p = Point(12.3456789, -45.6789012)
+        for prec in (0, 2, 5, 7):
+            out = from_twkb(to_twkb(p, precision=prec))
+            assert abs(out.x - p.x) <= 0.5 * 10**-prec
+            assert abs(out.y - p.y) <= 0.5 * 10**-prec
+
+    def test_negative_precision(self):
+        # coarse (multiple-of-10) rounding is part of the spec
+        p = Point(12345.0, -6789.0)
+        out = from_twkb(to_twkb(p, precision=-2))
+        assert out.x == pytest.approx(12300.0)
+        assert out.y == pytest.approx(-6800.0)
+
+    def test_invalid_precision(self):
+        with pytest.raises(ValueError):
+            to_twkb(Point(0, 0), precision=12)
+
+
+class TestCompactness:
+    def test_track_much_smaller_than_wkb(self):
+        rng = np.random.default_rng(3)
+        # dense GPS-like track: small deltas between consecutive fixes
+        steps = rng.normal(0, 1e-4, (500, 2))
+        coords = np.cumsum(steps, axis=0) + [10.0, 50.0]
+        ls = LineString(coords)
+        twkb = to_twkb(ls, precision=6)
+        wkb = to_wkb(ls)
+        assert len(twkb) < len(wkb) / 4  # delta varints beat 16B/vertex easily
+        out = from_twkb(twkb)
+        np.testing.assert_allclose(out.coords, ls.coords, atol=0.5 * 10**-6)
+
+    def test_delta_continuity_across_parts(self):
+        # deltas continue across parts/rings (shared `last` cursor) — decode
+        # must mirror encode exactly
+        mp = MultiPolygon([
+            Polygon([[100, 100], [101, 100], [101, 101], [100, 101]]),
+            Polygon([[100.5, 100.5], [100.6, 100.5], [100.6, 100.6], [100.5, 100.6]]),
+        ])
+        out = from_twkb(to_twkb(mp, precision=4))
+        for pa, pb in zip(out.parts, mp.parts):
+            for ra, rb in zip(pa.rings, pb.rings):
+                np.testing.assert_allclose(ra, rb, atol=0.5 * 10**-4)
