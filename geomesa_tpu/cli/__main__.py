@@ -205,6 +205,13 @@ def cmd_stats_histogram(args):
         print(f"[{lo:.4g}, {lo + step * max(1, h.bins // args.bins):.4g}): {c}")
 
 
+def cmd_serve(args):
+    ds = _load(args)
+    from geomesa_tpu.web import serve
+
+    serve(ds, host=args.host, port=args.port)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -283,6 +290,12 @@ def main(argv=None):
     sp.add_argument("-a", "--attribute", required=True)
     sp.add_argument("--bins", type=int, default=10)
     sp.set_defaults(fn=cmd_stats_histogram)
+
+    sp = sub.add_parser("serve", help="REST API over the catalog (geomesa-web role)")
+    common(sp, name=False)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,69 @@
+"""Geometry ↔ GeoJSON dict conversion (export side).
+
+Import side (``geojson_geometry``) lives with the JSON converter
+(:mod:`geomesa_tpu.convert.json_converter`); this is the inverse, used by the
+GeoJSON export format and the REST endpoints (SURVEY.md §2.8/§2.19).
+"""
+
+from __future__ import annotations
+
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+
+__all__ = ["geometry_to_geojson", "table_to_feature_collection"]
+
+
+def _ring(c):
+    return [[float(x), float(y)] for x, y in c]
+
+
+def geometry_to_geojson(g: Geometry | None) -> dict | None:
+    if g is None:
+        return None
+    if isinstance(g, Point):
+        return {"type": "Point", "coordinates": [float(g.x), float(g.y)]}
+    if isinstance(g, LineString):
+        return {"type": "LineString", "coordinates": _ring(g.coords)}
+    if isinstance(g, Polygon):
+        return {"type": "Polygon", "coordinates": [_ring(r) for r in g.rings]}
+    if isinstance(g, MultiPoint):
+        return {
+            "type": "MultiPoint",
+            "coordinates": [[float(p.x), float(p.y)] for p in g.parts],
+        }
+    if isinstance(g, MultiLineString):
+        return {
+            "type": "MultiLineString",
+            "coordinates": [_ring(p.coords) for p in g.parts],
+        }
+    if isinstance(g, MultiPolygon):
+        return {
+            "type": "MultiPolygon",
+            "coordinates": [[_ring(r) for r in p.rings] for p in g.parts],
+        }
+    raise TypeError(f"cannot convert {type(g).__name__} to GeoJSON")
+
+
+def table_to_feature_collection(table) -> dict:
+    """FeatureTable → GeoJSON FeatureCollection dict (dates stay epoch ms)."""
+    gf = table.sft.geom_field
+    feats = []
+    for i in range(len(table)):
+        rec = table.record(i)
+        geom = rec.pop(gf, None) if gf else None
+        feats.append(
+            {
+                "type": "Feature",
+                "id": str(table.fids[i]),
+                "geometry": geometry_to_geojson(geom) if gf else None,
+                "properties": rec,
+            }
+        )
+    return {"type": "FeatureCollection", "features": feats}

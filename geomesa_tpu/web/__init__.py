@@ -1,0 +1,5 @@
+"""REST API over a datastore."""
+
+from geomesa_tpu.web.app import GeoMesaApp, serve
+
+__all__ = ["GeoMesaApp", "serve"]

@@ -1,0 +1,296 @@
+"""REST endpoints over a datastore — stdlib WSGI, no framework.
+
+Role parity: ``geomesa-web`` (SURVEY.md §2.19) — the reference exposes
+Scalatra servlets for stats (``GeoMesaStatsEndpoint.scala``), query audit
+(``QueryAuditEndpoint``), datastore management (``DataStoreServlet``) and a
+GeoJSON REST API (``geomesa-geojson-rest``). Routes:
+
+    GET    /api/version
+    GET    /api/schemas                          list type names
+    POST   /api/schemas                          {"name": ..., "spec": ...}
+    GET    /api/schemas/{name}                   spec + row count
+    DELETE /api/schemas/{name}
+    POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
+    GET    /api/schemas/{name}/query?cql=&limit=&format=geojson|arrow|bin
+    GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
+    GET    /api/schemas/{name}/stats/count?cql=&exact=
+    GET    /api/schemas/{name}/stats/bounds?attr=
+    GET    /api/schemas/{name}/stats/topk?attr=&k=
+    GET    /api/schemas/{name}/density?cql=&bbox=&width=&height=
+    GET    /api/audit?typeName=                  query audit records
+    GET    /api/metrics                          metrics registry snapshot
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from urllib.parse import parse_qs
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+
+__all__ = ["GeoMesaApp", "serve"]
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+_STATUS = {
+    200: "200 OK",
+    201: "201 Created",
+    204: "204 No Content",
+    400: "400 Bad Request",
+    404: "404 Not Found",
+    405: "405 Method Not Allowed",
+    500: "500 Internal Server Error",
+}
+
+
+def _jsonable(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+class GeoMesaApp:
+    """WSGI application over one :class:`DataStore` (or merged view)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.routes = [
+            ("GET", r"^/api/version$", self._version),
+            ("GET", r"^/api/schemas$", self._list_schemas),
+            ("POST", r"^/api/schemas$", self._create_schema),
+            ("GET", r"^/api/schemas/([^/]+)$", self._get_schema),
+            ("DELETE", r"^/api/schemas/([^/]+)$", self._delete_schema),
+            ("POST", r"^/api/schemas/([^/]+)/features$", self._add_features),
+            ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
+            ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
+            ("GET", r"^/api/schemas/([^/]+)/stats/count$", self._stats_count),
+            ("GET", r"^/api/schemas/([^/]+)/stats/bounds$", self._stats_bounds),
+            ("GET", r"^/api/schemas/([^/]+)/stats/topk$", self._stats_topk),
+            ("GET", r"^/api/schemas/([^/]+)/density$", self._density),
+            ("GET", r"^/api/audit$", self._audit),
+            ("GET", r"^/api/metrics$", self._metrics),
+        ]
+
+    # -- WSGI ----------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "/")
+        params = {
+            k: v[0] for k, v in parse_qs(environ.get("QUERY_STRING", "")).items()
+        }
+        try:
+            body = None
+            if method in ("POST", "PUT"):
+                length = int(environ.get("CONTENT_LENGTH") or 0)
+                raw = environ["wsgi.input"].read(length) if length else b""
+                body = json.loads(raw) if raw else None
+            matched_path = False
+            for m, pattern, handler in self.routes:
+                match = re.match(pattern, path)
+                if match:
+                    matched_path = True
+                    if m == method:
+                        status, payload, ctype = handler(*match.groups(), params=params, body=body)
+                        return self._respond(start_response, status, payload, ctype)
+            raise _HttpError(405 if matched_path else 404,
+                             "method not allowed" if matched_path else "not found")
+        except _HttpError as e:
+            return self._respond(
+                start_response, e.status, {"error": e.message}, "application/json"
+            )
+        except KeyError as e:
+            return self._respond(
+                start_response, 404, {"error": str(e)}, "application/json"
+            )
+        except (ValueError, TypeError) as e:
+            return self._respond(
+                start_response, 400, {"error": str(e)}, "application/json"
+            )
+
+    def _respond(self, start_response, status, payload, ctype):
+        if isinstance(payload, (dict, list)):
+            data = json.dumps(_jsonable(payload)).encode()
+        elif payload is None:
+            data = b""
+        else:
+            data = payload
+        start_response(
+            _STATUS[status],
+            [("Content-Type", ctype), ("Content-Length", str(len(data)))],
+        )
+        return [data]
+
+    # -- handlers ------------------------------------------------------------
+    def _version(self, params, body):
+        import geomesa_tpu
+
+        return 200, {"name": "geomesa-tpu", "version": geomesa_tpu.__version__}, "application/json"
+
+    def _list_schemas(self, params, body):
+        return 200, {"schemas": self.store.list_schemas()}, "application/json"
+
+    def _create_schema(self, params, body):
+        if not body or "name" not in body or "spec" not in body:
+            raise _HttpError(400, "body must be {\"name\": ..., \"spec\": ...}")
+        self.store.create_schema(body["name"], body["spec"])
+        return 201, {"created": body["name"]}, "application/json"
+
+    def _get_schema(self, name, params, body):
+        sft = self.store.get_schema(name)
+        return 200, {
+            "name": sft.name,
+            "spec": sft.to_spec(),
+            "attributes": [
+                {"name": a.name, "type": a.type.value} for a in sft.attributes
+            ],
+            "count": self.store.stats_count(name),
+        }, "application/json"
+
+    def _delete_schema(self, name, params, body):
+        self.store.delete_schema(name)
+        return 204, None, "application/json"
+
+    def _add_features(self, name, params, body):
+        if not body:
+            raise _HttpError(400, "expected a GeoJSON FeatureCollection body")
+        feats = body.get("features", [body] if body.get("type") == "Feature" else None)
+        if feats is None:
+            raise _HttpError(400, "expected a GeoJSON FeatureCollection body")
+        from geomesa_tpu.convert.json_converter import geojson_geometry
+
+        sft = self.store.get_schema(name)
+        recs = []
+        fids = []
+        for i, f in enumerate(feats):
+            props = dict(f.get("properties") or {})
+            if sft.geom_field:
+                g = geojson_geometry(f.get("geometry"))
+                if g is None:
+                    raise _HttpError(400, f"feature {i}: missing/invalid geometry")
+                props[sft.geom_field] = g
+            recs.append({a.name: props.get(a.name) for a in sft.attributes})
+            fids.append(str(f["id"]) if "id" in f else None)
+        if any(f is None for f in fids):
+            fids = None
+        n = self.store.write(name, recs, fids=fids)
+        return 201, {"written": n}, "application/json"
+
+    def _parse_query(self, params) -> Query:
+        hints = {}
+        limit = int(params["limit"]) if "limit" in params else None
+        props = params["properties"].split(",") if params.get("properties") else None
+        sort_by = None
+        if params.get("sortBy"):
+            fld = params["sortBy"]
+            desc = fld.startswith("-")
+            sort_by = (fld.lstrip("-"), desc)
+        return Query(
+            filter=params.get("cql") or None,
+            limit=limit,
+            properties=props,
+            sort_by=sort_by,
+            hints=hints,
+        )
+
+    def _query(self, name, params, body):
+        q = self._parse_query(params)
+        fmt = params.get("format", "geojson")
+        r = self.store.query(name, q)
+        if fmt == "geojson":
+            from geomesa_tpu.geometry.geojson import table_to_feature_collection
+
+            return 200, table_to_feature_collection(r.table), "application/geo+json"
+        if fmt == "arrow":
+            from geomesa_tpu.io.arrow import to_ipc_bytes
+
+            return 200, to_ipc_bytes(r.table), "application/vnd.apache.arrow.stream"
+        if fmt == "bin":
+            from geomesa_tpu.store.reduce import bin_encode
+
+            return 200, bin_encode(r.table, {}), "application/octet-stream"
+        raise _HttpError(400, f"unknown format {fmt!r}")
+
+    def _stats(self, name, params, body):
+        spec = params.get("stats")
+        if not spec:
+            raise _HttpError(400, "missing ?stats= spec")
+        r = self.store.query(name, Query(filter=params.get("cql"), hints={"stats": spec}))
+        out = {}
+        for label, sketch in (r.stats or {}).items():
+            d = {
+                k: v for k, v in vars(sketch).items()
+                if not k.startswith("_") and not callable(v)
+            }
+            out[label] = _jsonable(d)
+        return 200, out, "application/json"
+
+    def _stats_count(self, name, params, body):
+        exact = params.get("exact", "false").lower() in ("1", "true", "yes")
+        c = self.store.stats_count(name, params.get("cql"), exact=exact)
+        return 200, {"count": c}, "application/json"
+
+    def _stats_bounds(self, name, params, body):
+        attr = params.get("attr")
+        if not attr:
+            raise _HttpError(400, "missing ?attr=")
+        lo, hi = self.store.stats_bounds(name, attr)
+        return 200, {"attr": attr, "min": lo, "max": hi}, "application/json"
+
+    def _stats_topk(self, name, params, body):
+        attr = params.get("attr")
+        if not attr:
+            raise _HttpError(400, "missing ?attr=")
+        k = int(params.get("k", 10))
+        top = self.store.stats_top_k(name, attr, k)
+        return 200, {"attr": attr, "topk": [[v, int(c)] for v, c in top]}, "application/json"
+
+    def _density(self, name, params, body):
+        opts = {
+            "width": int(params.get("width", 256)),
+            "height": int(params.get("height", 256)),
+        }
+        if params.get("bbox"):
+            opts["bbox"] = tuple(float(v) for v in params["bbox"].split(","))
+        r = self.store.query(
+            name, Query(filter=params.get("cql"), hints={"density": opts})
+        )
+        return 200, {"width": opts["width"], "height": opts["height"],
+                     "grid": r.density}, "application/json"
+
+    def _audit(self, params, body):
+        w = getattr(self.store, "audit_writer", None)
+        events = []
+        if w is not None and hasattr(w, "query_events"):
+            events = [json.loads(e.to_json()) for e in w.query_events(params.get("typeName"))]
+        return 200, {"events": events}, "application/json"
+
+    def _metrics(self, params, body):
+        m = getattr(self.store, "metrics", None)
+        return 200, (m.snapshot() if m is not None else {}), "application/json"
+
+
+def serve(store, host: str = "127.0.0.1", port: int = 8080):
+    """Run the API on wsgiref's simple server (dev/ops tool, not a prod WSGI
+    container — same posture as the reference's embedded servlets)."""
+    from wsgiref.simple_server import make_server
+
+    httpd = make_server(host, port, GeoMesaApp(store))
+    print(f"geomesa-tpu REST on http://{host}:{port}/api")
+    httpd.serve_forever()

@@ -1,0 +1,143 @@
+"""REST endpoint tests via in-process WSGI calls (reference: geomesa-web
+servlets — SURVEY.md §2.19)."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.utils.audit import InMemoryAuditWriter
+from geomesa_tpu.web import GeoMesaApp
+
+
+def call(app, method, path, query="", body=None):
+    """Minimal WSGI client: returns (status_code, headers, bytes)."""
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": query,
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = int(status.split()[0])
+        out["headers"] = dict(headers)
+
+    chunks = app(environ, start_response)
+    return out["status"], out["headers"], b"".join(chunks)
+
+
+def jcall(app, method, path, query="", body=None):
+    status, _, data = call(app, method, path, query, body)
+    return status, json.loads(data) if data else None
+
+
+@pytest.fixture()
+def app():
+    ds = DataStore(backend="tpu", audit_writer=InMemoryAuditWriter())
+    return GeoMesaApp(ds)
+
+
+def _ingest(app, n=50):
+    jcall(app, "POST", "/api/schemas", body={"name": "pts", "spec": "name:String,dtg:Date,*geom:Point"})
+    rng = np.random.default_rng(9)
+    feats = [
+        {
+            "type": "Feature",
+            "id": f"p{i}",
+            "geometry": {"type": "Point",
+                         "coordinates": [float(rng.uniform(-50, 50)), float(rng.uniform(-50, 50))]},
+            "properties": {"name": f"n{i % 4}", "dtg": 1_498_867_200_000 + i * 1000},
+        }
+        for i in range(n)
+    ]
+    status, out = jcall(app, "POST", "/api/schemas/pts/features",
+                        body={"type": "FeatureCollection", "features": feats})
+    assert status == 201 and out["written"] == n
+
+
+class TestSchemaCrud:
+    def test_version(self, app):
+        status, out = jcall(app, "GET", "/api/version")
+        assert status == 200 and out["name"] == "geomesa-tpu"
+
+    def test_create_list_get_delete(self, app):
+        status, out = jcall(app, "POST", "/api/schemas",
+                            body={"name": "t1", "spec": "a:Integer,*geom:Point"})
+        assert status == 201
+        _, out = jcall(app, "GET", "/api/schemas")
+        assert "t1" in out["schemas"]
+        status, out = jcall(app, "GET", "/api/schemas/t1")
+        assert status == 200 and out["count"] == 0
+        assert any(a["name"] == "geom" for a in out["attributes"])
+        status, _ = jcall(app, "DELETE", "/api/schemas/t1")
+        assert status == 204
+        status, _ = jcall(app, "GET", "/api/schemas/t1")
+        assert status == 404
+
+    def test_bad_requests(self, app):
+        status, out = jcall(app, "POST", "/api/schemas", body={"name": "x"})
+        assert status == 400
+        status, _ = jcall(app, "GET", "/api/nope")
+        assert status == 404
+        status, _ = jcall(app, "DELETE", "/api/schemas")
+        assert status == 405
+
+
+class TestQueryAndStats:
+    def test_geojson_query(self, app):
+        _ingest(app)
+        status, out = jcall(app, "GET", "/api/schemas/pts/query",
+                            query="cql=BBOX(geom,-50,-50,0,50)&limit=10")
+        assert status == 200
+        assert out["type"] == "FeatureCollection"
+        assert 0 < len(out["features"]) <= 10
+        f = out["features"][0]
+        assert f["geometry"]["type"] == "Point" and "name" in f["properties"]
+
+    def test_arrow_query(self, app):
+        import pyarrow as pa
+
+        _ingest(app)
+        status, headers, data = call(app, "GET", "/api/schemas/pts/query", "format=arrow")
+        assert status == 200
+        assert headers["Content-Type"] == "application/vnd.apache.arrow.stream"
+        at = pa.ipc.open_stream(data).read_all()
+        assert at.num_rows == 50
+
+    def test_stats_endpoints(self, app):
+        _ingest(app)
+        status, out = jcall(app, "GET", "/api/schemas/pts/stats", "stats=Count()")
+        assert status == 200 and out["Count()"]["count"] == 50
+        status, out = jcall(app, "GET", "/api/schemas/pts/stats/count", "exact=true")
+        assert out["count"] == 50
+        status, out = jcall(app, "GET", "/api/schemas/pts/stats/bounds", "attr=dtg")
+        assert out["min"] == 1_498_867_200_000
+        status, out = jcall(app, "GET", "/api/schemas/pts/stats/topk", "attr=name&k=2")
+        assert len(out["topk"]) == 2
+
+    def test_density(self, app):
+        _ingest(app)
+        status, out = jcall(app, "GET", "/api/schemas/pts/density",
+                            "bbox=-50,-50,50,50&width=16&height=16")
+        assert status == 200
+        grid = np.asarray(out["grid"])
+        assert grid.shape == (16, 16) and grid.sum() == 50
+
+    def test_audit_and_metrics(self, app):
+        _ingest(app)
+        jcall(app, "GET", "/api/schemas/pts/query", "cql=BBOX(geom,0,0,10,10)")
+        status, out = jcall(app, "GET", "/api/audit", "typeName=pts")
+        assert status == 200 and len(out["events"]) >= 1
+        status, out = jcall(app, "GET", "/api/metrics")
+        assert status == 200 and out["store.queries"]["count"] >= 1
+
+    def test_query_invalid_cql(self, app):
+        _ingest(app)
+        status, out = jcall(app, "GET", "/api/schemas/pts/query", "cql=NOT%20VALID(")
+        assert status == 400
