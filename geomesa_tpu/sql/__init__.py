@@ -1,0 +1,5 @@
+"""SQL query layer with spatial predicate pushdown."""
+
+from geomesa_tpu.sql.engine import SqlResult, sql
+
+__all__ = ["sql", "SqlResult"]

@@ -1,0 +1,337 @@
+"""SQL(-subset) engine over a datastore: the Spark-SQL integration analog.
+
+Role parity: ``geomesa-spark-sql`` (SURVEY.md §2.14/§3.5) — the reference
+registers a DataSource relation whose catalyst rules push spatial predicates
+(``st_contains`` etc.) down into the GeoMesa query planner, evaluates residual
+``ST_*`` UDFs per row, and runs SQL aggregates on the scanned RDD. Here the
+equivalent pipeline is: SQL text → (CQL-pushdown WHERE, projection, aggregate
+plan) → planned datastore query → vectorized numpy aggregation.
+
+Supported grammar:
+
+    SELECT <item, ...> FROM <type>
+      [WHERE <predicates>] [GROUP BY <col, ...>]
+      [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
+
+    item      := * | col | agg | fn(col) [AS alias]
+    agg       := COUNT(*) | COUNT(col) | SUM/MIN/MAX/AVG(col)
+    fn        := ST_X | ST_Y | ST_AsText | ST_GeoHash  (per-row scalar UDFs)
+    predicate := CQL comparisons/temporal ops, plus spark-jts spatial calls:
+                 ST_Contains/ST_Within/ST_Intersects/ST_Disjoint(col, g),
+                 ST_DWithin(col, g, dist); g := ST_GeomFromText('wkt')|'wkt'
+
+The WHERE clause is rewritten to CQL and fed to the normal query planner, so
+spatial/temporal/attribute predicates ride the Z/XZ/attribute indexes exactly
+like any other query (the reference's pushdown seam, ``GeoMesaRelation
+.buildScan``).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+
+__all__ = ["sql", "SqlResult", "SqlError"]
+
+
+class SqlError(ValueError):
+    pass
+
+
+@dataclass
+class SqlResult:
+    """Ordered named columns (numpy arrays / object arrays)."""
+
+    columns: dict[str, np.ndarray]
+
+    def __len__(self) -> int:
+        return len(next(iter(self.columns.values()))) if self.columns else 0
+
+    def rows(self) -> list[tuple]:
+        names = list(self.columns)
+        return [
+            tuple(
+                v.item() if isinstance((v := self.columns[c][i]), np.generic) else v
+                for c in names
+            )
+            for i in range(len(self))
+        ]
+
+
+_CLAUSES = re.compile(
+    r"^\s*select\s+(?P<select>.+?)\s+from\s+(?P<from>\w+)"
+    r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_AGGS = ("count", "sum", "min", "max", "avg")
+_SPATIAL = {
+    "st_contains": "CONTAINS",
+    "st_within": "WITHIN",
+    "st_intersects": "INTERSECTS",
+    "st_disjoint": "DISJOINT",
+    "st_dwithin": "DWITHIN",
+}
+
+
+def _split_top(s: str, sep: str = ",") -> list[str]:
+    out, depth, cur, q = [], 0, [], None
+    for ch in s:
+        if q:
+            cur.append(ch)
+            if ch == q:
+                q = None
+        elif ch in "'\"":
+            q = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == sep and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return [p for p in out if p]
+
+
+def _strip_geom_literal(arg: str) -> str:
+    """``ST_GeomFromText('wkt')`` | ``'wkt'`` | bare WKT → bare WKT."""
+    a = arg.strip()
+    m = re.match(r"^st_geomfromtext\s*\(\s*(.+)\s*\)$", a, re.IGNORECASE | re.DOTALL)
+    if m:
+        a = m.group(1).strip()
+    if a and a[0] in "'\"":
+        a = a[1:-1]
+    return a.strip()
+
+
+def _rewrite_where(where: str) -> str:
+    """Replace spark-jts spatial calls with their CQL spellings."""
+    out = []
+    i = 0
+    lower = where.lower()
+    while i < len(where):
+        m = re.compile(r"st_(contains|within|intersects|disjoint|dwithin)\s*\(").match(
+            lower, i
+        )
+        if not m:
+            out.append(where[i])
+            i += 1
+            continue
+        # balanced-paren scan for the call body
+        depth = 1
+        j = m.end()
+        q = None
+        while j < len(where) and depth:
+            ch = where[j]
+            if q:
+                if ch == q:
+                    q = None
+            elif ch in "'\"":
+                q = ch
+            elif ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            j += 1
+        if depth:
+            raise SqlError(f"unbalanced parens in spatial call at {i}")
+        body = where[m.end() : j - 1]
+        args = _split_top(body)
+        name = "st_" + m.group(1)
+        cql_op = _SPATIAL[name]
+        if name == "st_dwithin":
+            if len(args) != 3:
+                raise SqlError("ST_DWithin(col, geom, distance)")
+            col, g, d = args
+            out.append(f"{cql_op}({col}, {_strip_geom_literal(g)}, {d}, degrees)")
+        else:
+            if len(args) != 2:
+                raise SqlError(f"{name}(col, geom)")
+            col, g = args
+            out.append(f"{cql_op}({col}, {_strip_geom_literal(g)})")
+        i = j
+    return "".join(out)
+
+
+@dataclass
+class _Item:
+    kind: str  # "star" | "col" | "agg" | "fn"
+    name: str  # output column name
+    arg: str | None = None  # source column
+    fn: str | None = None  # agg/scalar function name
+
+
+def _parse_item(item: str) -> _Item:
+    m = re.match(r"^(.*?)\s+as\s+(\w+)\s*$", item, re.IGNORECASE | re.DOTALL)
+    alias = None
+    if m:
+        item, alias = m.group(1).strip(), m.group(2)
+    if item == "*":
+        return _Item("star", "*")
+    call = re.match(r"^(\w+)\s*\(\s*(.*?)\s*\)$", item, re.DOTALL)
+    if call:
+        fn = call.group(1).lower()
+        arg = call.group(2)
+        if fn in _AGGS:
+            return _Item("agg", alias or f"{fn}({arg})", arg, fn)
+        if fn in ("st_x", "st_y", "st_astext", "st_geohash"):
+            return _Item("fn", alias or f"{fn}({arg})", arg, fn)
+        raise SqlError(f"unsupported function {fn!r} in select list")
+    if not re.match(r"^\w+$", item):
+        raise SqlError(f"unsupported select item {item!r}")
+    return _Item("col", alias or item, item)
+
+
+def _scalar_fn(fn: str, table, col: str) -> np.ndarray:
+    gc = table.columns[col]
+    if fn in ("st_x", "st_y"):
+        if gc.x is None:
+            raise SqlError(f"{fn} requires a Point column")
+        return (gc.x if fn == "st_x" else gc.y).copy()
+    geoms = gc.geometries()
+    if fn == "st_astext":
+        from geomesa_tpu.geometry.wkt import to_wkt
+
+        return np.array(
+            [None if g is None else to_wkt(g) for g in geoms], dtype=object
+        )
+    if fn == "st_geohash":
+        from geomesa_tpu.spatial.st_functions import st_geohash
+
+        return np.array(
+            [None if g is None else st_geohash(g) for g in geoms], dtype=object
+        )
+    raise SqlError(f"unknown scalar function {fn!r}")
+
+
+def _agg_value(fn: str, arg: str, table, idx: np.ndarray):
+    if fn == "count":
+        if arg == "*":
+            return len(idx)
+        col = table.columns[arg]
+        return int(col.is_valid()[idx].sum())
+    col = table.columns[arg]
+    valid = col.is_valid()[idx]
+    vals = col.values[idx][valid]
+    if len(vals) == 0:
+        return None
+    if fn == "sum":
+        return vals.sum().item()
+    if fn == "min":
+        return vals.min().item() if hasattr(vals.min(), "item") else min(vals)
+    if fn == "max":
+        return vals.max().item() if hasattr(vals.max(), "item") else max(vals)
+    if fn == "avg":
+        return float(np.mean(vals.astype(np.float64)))
+    raise SqlError(f"unknown aggregate {fn!r}")
+
+
+def sql(ds, statement: str) -> SqlResult:
+    """Execute a SQL statement against ``ds`` (DataStore or merged view)."""
+    m = _CLAUSES.match(statement)
+    if not m:
+        raise SqlError(f"cannot parse: {statement!r}")
+    items = [_parse_item(i) for i in _split_top(m.group("select"))]
+    type_name = m.group("from")
+    where = m.group("where")
+    group_by = [g.strip() for g in m.group("group").split(",")] if m.group("group") else None
+    limit = int(m.group("limit")) if m.group("limit") else None
+    order = None
+    if m.group("order"):
+        om = re.match(r"^(\w+)(?:\s+(asc|desc))?$", m.group("order").strip(), re.IGNORECASE)
+        if not om:
+            raise SqlError(f"unsupported ORDER BY {m.group('order')!r}")
+        order = (om.group(1), bool(om.group(2) and om.group(2).lower() == "desc"))
+
+    cql = _rewrite_where(where) if where else None
+    has_agg = any(i.kind == "agg" for i in items)
+
+    if not has_agg:
+        if group_by:
+            raise SqlError("GROUP BY requires aggregate select items")
+        # projection pushdown only when every item is a plain column; scalar
+        # fns need their source column materialized
+        props = None
+        if all(i.kind == "col" for i in items):
+            props = [i.arg for i in items]
+        q = Query(filter=cql, properties=props, sort_by=order, limit=limit)
+        r = ds.query(type_name, q)
+        cols: dict[str, np.ndarray] = {}
+        for it in items:
+            if it.kind == "star":
+                for a in r.table.sft.attributes:
+                    c = r.table.columns[a.name]
+                    cols[a.name] = (
+                        c.geometries() if a.type.is_geometry else c.values
+                    )
+            elif it.kind == "col":
+                c = r.table.columns[it.arg]
+                cols[it.name] = c.geometries() if c.type.is_geometry else c.values
+            else:
+                cols[it.name] = _scalar_fn(it.fn, r.table, it.arg)
+        return SqlResult(cols)
+
+    # aggregate path: scan (with pushdown filter), then vectorized fold
+    r = ds.query(type_name, Query(filter=cql))
+    t = r.table
+    for it in items:
+        if it.kind in ("star", "fn"):
+            raise SqlError("cannot mix aggregates with non-aggregated columns")
+        if it.kind == "col" and (not group_by or it.arg not in group_by):
+            raise SqlError(f"column {it.arg!r} must appear in GROUP BY")
+
+    if not group_by:
+        cols = {
+            it.name: np.array([_agg_value(it.fn, it.arg, t, np.arange(len(t)))], dtype=object)
+            for it in items
+        }
+        return SqlResult(cols)
+
+    keys = [t.columns[g].values.astype(object) for g in group_by]
+    combo = np.array(list(zip(*keys)), dtype=object)
+    seen: dict = {}
+    groups: list[list[int]] = []
+    for i in range(len(t)):
+        k = tuple(combo[i])
+        if k not in seen:
+            seen[k] = len(groups)
+            groups.append([])
+        groups[seen[k]].append(i)
+    group_keys = list(seen)
+    cols = {}
+    for it in items:
+        if it.kind == "col":
+            gi = group_by.index(it.arg)
+            cols[it.name] = np.array([k[gi] for k in group_keys], dtype=object)
+        else:
+            cols[it.name] = np.array(
+                [
+                    _agg_value(it.fn, it.arg, t, np.asarray(g, dtype=np.int64))
+                    for g in groups
+                ],
+                dtype=object,
+            )
+    res = SqlResult(cols)
+    if order is not None:
+        if order[0] not in cols:
+            raise SqlError(f"ORDER BY {order[0]!r} not in select list")
+        perm = np.argsort(cols[order[0]], kind="stable")
+        if order[1]:
+            perm = perm[::-1]
+        res = SqlResult({k: v[perm] for k, v in cols.items()})
+    if limit is not None:
+        res = SqlResult({k: v[:limit] for k, v in res.columns.items()})
+    return res

@@ -1,0 +1,126 @@
+"""SQL layer tests: pushdown, projection, scalar UDFs, aggregates, GROUP BY
+(reference: geomesa-spark-sql — SURVEY.md §2.14/§3.5)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.sql import SqlResult, sql
+from geomesa_tpu.sql.engine import SqlError, _rewrite_where
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(11)
+    n = 2000
+    lon = rng.uniform(-60, 60, n)
+    lat = rng.uniform(-60, 60, n)
+    t = T0 + rng.integers(0, 5 * 86_400_000, n)
+    recs = [
+        {
+            "name": f"c{i % 5}",
+            "val": float(i % 100),
+            "dtg": int(t[i]),
+            "geom": Point(float(lon[i]), float(lat[i])),
+        }
+        for i in range(n)
+    ]
+    store = DataStore(backend="tpu")
+    store.create_schema("ev", "name:String,val:Double,dtg:Date,*geom:Point")
+    store.write("ev", recs, fids=[f"e{i}" for i in range(n)])
+    store._lonlat = (lon, lat)
+    return store
+
+
+class TestRewrite:
+    def test_contains_rewrite(self):
+        out = _rewrite_where(
+            "ST_Contains(geom, ST_GeomFromText('POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))')) AND name = 'x'"
+        )
+        assert out == "CONTAINS(geom, POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))) AND name = 'x'"
+
+    def test_dwithin_rewrite(self):
+        out = _rewrite_where("st_dwithin(geom, 'POINT (5 5)', 2.5)")
+        assert out == "DWITHIN(geom, POINT (5 5), 2.5, degrees)"
+
+    def test_quoted_wkt(self):
+        out = _rewrite_where("ST_Intersects(geom, 'POINT (1 2)')")
+        assert out == "INTERSECTS(geom, POINT (1 2))"
+
+
+class TestSelect:
+    def test_select_star_with_spatial_pushdown(self, ds):
+        res = sql(
+            ds,
+            "SELECT * FROM ev WHERE ST_Within(geom, "
+            "ST_GeomFromText('POLYGON ((-10 -10, 10 -10, 10 10, -10 10, -10 -10))'))",
+        )
+        lon, lat = ds._lonlat
+        exp = int(((lon >= -10) & (lon <= 10) & (lat >= -10) & (lat <= 10)).sum())
+        # boundary-inclusive vs strict within may differ by measure-zero rows
+        assert abs(len(res) - exp) <= 1
+        assert set(res.columns) == {"name", "val", "dtg", "geom"}
+
+    def test_projection_and_order_limit(self, ds):
+        res = sql(ds, "SELECT name, val FROM ev WHERE val >= 95 ORDER BY val DESC LIMIT 7")
+        assert list(res.columns) == ["name", "val"]
+        assert len(res) == 7
+        vals = [r[1] for r in res.rows()]
+        assert vals == sorted(vals, reverse=True)
+        assert vals[0] == 99.0
+
+    def test_scalar_st_functions(self, ds):
+        res = sql(ds, "SELECT st_x(geom) AS x, st_y(geom) AS y FROM ev LIMIT 5")
+        assert list(res.columns) == ["x", "y"]
+        assert len(res) == 5
+        assert np.isfinite(res.columns["x"]).all()
+
+    def test_st_astext(self, ds):
+        res = sql(ds, "SELECT ST_AsText(geom) AS wkt FROM ev LIMIT 2")
+        assert res.columns["wkt"][0].startswith("POINT")
+
+
+class TestAggregates:
+    def test_count_star(self, ds):
+        res = sql(ds, "SELECT COUNT(*) FROM ev")
+        assert res.rows() == [(2000,)]
+
+    def test_filtered_agg(self, ds):
+        res = sql(ds, "SELECT COUNT(*) AS n, MIN(val) AS lo, MAX(val) AS hi "
+                      "FROM ev WHERE name = 'c2'")
+        (n, lo, hi), = res.rows()
+        assert n == 400 and lo == 2.0 and hi == 97.0
+
+    def test_group_by(self, ds):
+        res = sql(ds, "SELECT name, COUNT(*) AS n, AVG(val) AS m FROM ev "
+                      "GROUP BY name ORDER BY name")
+        rows = res.rows()
+        assert len(rows) == 5
+        assert [r[0] for r in rows] == [f"c{i}" for i in range(5)]
+        assert all(r[1] == 400 for r in rows)
+
+    def test_group_by_with_spatial_filter(self, ds):
+        res = sql(ds, "SELECT name, COUNT(*) AS n FROM ev "
+                      "WHERE ST_Intersects(geom, ST_GeomFromText("
+                      "'POLYGON ((-60 -60, 60 -60, 60 0, -60 0, -60 -60))')) "
+                      "GROUP BY name")
+        lon, lat = ds._lonlat
+        exp_total = int((lat <= 0).sum())
+        assert sum(r[1] for r in res.rows()) == exp_total
+
+
+class TestErrors:
+    def test_bad_statement(self, ds):
+        with pytest.raises(SqlError):
+            sql(ds, "DELETE FROM ev")
+
+    def test_non_grouped_column(self, ds):
+        with pytest.raises(SqlError, match="GROUP BY"):
+            sql(ds, "SELECT name, COUNT(*) FROM ev")
+
+    def test_unknown_function(self, ds):
+        with pytest.raises(SqlError, match="unsupported function"):
+            sql(ds, "SELECT frob(name) FROM ev")
