@@ -1,0 +1,108 @@
+"""Column-family projection groups.
+
+Role parity: ``geomesa-index-api/.../conf/ColumnGroups.scala`` (142 LoC —
+SURVEY.md §2.3): a schema can declare named attribute subsets (stored as
+reduced column-family copies in the reference); a query whose projection and
+filter touch only a group's attributes scans the reduced copy. Here a group
+is a reduced set of resident columns — the scan touches fewer HBM arrays —
+declared in SFT user data:
+
+    geomesa.column.groups = "track:name,dtg;viz:name"
+
+The default geometry and date attributes are implicitly part of every group
+(they key the indexes, as in the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.schema.sft import FeatureType
+
+__all__ = ["ColumnGroups", "filter_attributes"]
+
+_KEY = "geomesa.column.groups"
+
+
+def filter_attributes(f: ast.Filter | None) -> set[str]:
+    """Attribute names referenced anywhere in a filter AST."""
+    out: set[str] = set()
+    if f is None:
+        return out
+    stack = [f]
+    while stack:
+        node = stack.pop()
+        for fld in dataclasses.fields(node) if dataclasses.is_dataclass(node) else ():
+            v = getattr(node, fld.name)
+            if fld.name == "prop" and isinstance(v, str):
+                out.add(v)
+            elif isinstance(v, ast.Filter):
+                stack.append(v)
+            elif isinstance(v, (list, tuple)):
+                stack.extend(x for x in v if isinstance(x, ast.Filter))
+    return out
+
+
+class ColumnGroups:
+    """Named attribute subsets for one schema."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        always = {n for n in (sft.geom_field, sft.dtg_field) if n}
+        self.groups: dict[str, set[str]] = {}
+        spec = sft.user_data.get(_KEY, "")
+        for part in str(spec).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, cols = part.partition(":")
+            attrs = {c.strip() for c in cols.split(",") if c.strip()}
+            unknown = attrs - {a.name for a in sft.attributes}
+            if unknown:
+                raise ValueError(f"column group {name!r} names unknown attributes {sorted(unknown)}")
+            self.groups[name.strip()] = attrs | always
+        # the implicit default group: everything
+        self._all = {a.name for a in sft.attributes}
+
+    def group_for(self, properties, f: ast.Filter | None) -> tuple[str, set[str]]:
+        """Smallest group covering the query's projection + filter attributes;
+        falls back to the full ('default') set. Without a projection the
+        default group is read (reference behavior: reduced column families
+        only serve transform queries)."""
+        if properties is None:
+            return "default", set(self._all)
+        needed = set(properties) | filter_attributes(f)
+        needed &= self._all  # 'id' and synthetic names don't bind columns
+        best = None
+        for name, attrs in self.groups.items():
+            if needed <= attrs and (best is None or len(attrs) < len(self.groups[best])):
+                best = name
+        if best is None:
+            return "default", set(self._all)
+        return best, set(self.groups[best])
+
+    def reduced_sft(self, group: str) -> FeatureType:
+        """A schema containing only the group's attributes (original order) —
+        the reference's reduced column-family copy, as a reduced SFT. Used by
+        catalog loads that materialize just one group's columns."""
+        if group == "default":
+            return self.sft
+        keep = self.groups[group]
+        return FeatureType(
+            name=self.sft.name,
+            attributes=[a for a in self.sft.attributes if a.name in keep],
+            default_geom=self.sft.geom_field if self.sft.geom_field in keep else None,
+            user_data={k: v for k, v in self.sft.user_data.items() if k != _KEY},
+        )
+
+    def project(self, table, group: str):
+        """Reduced-column view of a table for a named group."""
+        if group == "default":
+            return table
+        keep = self.groups[group]
+        from geomesa_tpu.schema.columnar import FeatureTable
+
+        return FeatureTable(
+            table.sft, table.fids, {k: c for k, c in table.columns.items() if k in keep}
+        )

@@ -100,6 +100,9 @@ class DataStore:
 
             metrics = MetricsRegistry()
         self.metrics = metrics
+        from geomesa_tpu.utils.timeouts import Watchdog
+
+        self.watchdog = Watchdog()
 
     # -- schema CRUD (MetadataBackedDataStore role) --------------------------
     def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
@@ -295,43 +298,62 @@ class DataStore:
             q = _replace(q, filter=ast.And((q.resolved_filter(), cut)))
 
         t_start = _time.perf_counter()
-        f = q.resolved_filter()
-        info = None
-        plan_ms = 0.0
-        main_n = st.main_rows
-        if main_n == 0:
-            rows = np.empty(0, dtype=np.int64)
-        elif isinstance(self.backend, OracleBackend):
-            # referee path: no planning, brute force
-            rows = self.backend.select(None, None, None, None, f, st.table)
-        else:
-            planner = QueryPlanner(st.sft, st.indices, st.stats)
-            t0 = _time.perf_counter()
-            plan, f, info = planner.plan(q)
-            plan_ms = (_time.perf_counter() - t0) * 1000.0
-            index = st.indices[info.index_name]
-            rows = self.backend.select(
-                st.backend_state, index, plan, info.extraction, f, st.table
+        plan_box = {"info": None, "plan_ms": 0.0}
+
+        def _scan_and_reduce():
+            f = q.resolved_filter()
+            main_n = st.main_rows
+            if main_n == 0:
+                rows = np.empty(0, dtype=np.int64)
+            elif isinstance(self.backend, OracleBackend):
+                # referee path: no planning, brute force
+                rows = self.backend.select(None, None, None, None, f, st.table)
+            else:
+                planner = QueryPlanner(st.sft, st.indices, st.stats)
+                t0 = _time.perf_counter()
+                plan, f, plan_box["info"] = planner.plan(q)
+                plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
+                index = st.indices[plan_box["info"].index_name]
+                rows = self.backend.select(
+                    st.backend_state, index, plan, plan_box["info"].extraction,
+                    f, st.table,
+                )
+            rows = np.sort(rows)
+
+            # hot-tier merge (LambdaQueryRunner role): brute-force the small
+            # unsorted delta and append, row ids offset past the main tier
+            delta_table = st.delta.merged()
+            if delta_table is not None:
+                dmask = f.mask(delta_table)
+                drows = np.nonzero(dmask)[0]
+                rows = np.concatenate([rows, drows + main_n])
+
+            table = _take_combined(st, delta_table, rows)
+
+            # shared post-scan pipeline: visibility, sampling, aggregation
+            # hints, sort/limit/projection/CRS (LocalQueryRunner shape)
+            from geomesa_tpu.store.reduce import reduce_result
+
+            return reduce_result(st.sft, table, rows, q)
+
+        # query watchdog (ThreadManagement role): per-query ``timeout`` hint
+        # in seconds; timed-out scans are abandoned and counted
+        from geomesa_tpu.utils.timeouts import QueryTimeout, run_with_timeout
+
+        timeout_s = q.hints.get("timeout")
+        token = self.watchdog.register(f"{type_name}: {q.filter!r}")
+        try:
+            table, rows, density, stats_out, bin_data = run_with_timeout(
+                _scan_and_reduce, timeout_s
             )
-        rows = np.sort(rows)
-
-        # hot-tier merge (LambdaQueryRunner role): brute-force the small
-        # unsorted delta and append, with row ids offset past the main tier
-        delta_table = st.delta.merged()
-        if delta_table is not None:
-            dmask = f.mask(delta_table)
-            drows = np.nonzero(dmask)[0]
-            rows = np.concatenate([rows, drows + main_n])
-
-        table = _take_combined(st, delta_table, rows)
-
-        # shared post-scan pipeline: visibility, sampling, aggregation hints,
-        # sort/limit/projection/CRS (LocalQueryRunner-shape, store/reduce.py)
-        from geomesa_tpu.store.reduce import reduce_result
-
-        table, rows, density, stats_out, bin_data = reduce_result(
-            st.sft, table, rows, q
-        )
+        except QueryTimeout:
+            self.watchdog.complete(token, timed_out=True)
+            self.metrics.counter("store.query.timeouts").inc()
+            raise
+        else:
+            self.watchdog.complete(token)
+        info = plan_box["info"]
+        plan_ms = plan_box["plan_ms"]
         scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
         self._audit(type_name, q, plan_ms, scan_ms, len(table))
         return QueryResult(

@@ -33,7 +33,19 @@ FORMAT_VERSION = 1
 
 
 def save(ds, path: str, partition_by_time: bool = True) -> dict:
-    """Persist every schema + table of a DataStore; returns the manifest."""
+    """Persist every schema + table of a DataStore; returns the manifest.
+
+    Catalog mutation happens under an exclusive cross-process lock
+    (``DistributedLocking.scala:14`` role — :mod:`geomesa_tpu.utils.locks`),
+    so concurrent writers can't interleave shard renames / manifest flips.
+    """
+    from geomesa_tpu.utils.locks import catalog_lock
+
+    with catalog_lock(path):
+        return _save_locked(ds, path, partition_by_time)
+
+
+def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
     # generation-unique shard names: renames must never clobber files the
@@ -110,8 +122,14 @@ def _partitions(st) -> dict:
     return out
 
 
-def load(path: str, backend: str = "tpu"):
-    """Restore a DataStore (device state rebuilt) from a catalog directory."""
+def load(path: str, backend: str = "tpu", column_group: str | None = None):
+    """Restore a DataStore (device state rebuilt) from a catalog directory.
+
+    ``column_group``: load only that group's columns (ColumnGroups role,
+    SURVEY.md §2.3) — the parquet read materializes the reduced attribute
+    set, so HBM/host residency scales with the group, not the full schema.
+    Schemas without the named group load in full.
+    """
     from geomesa_tpu.schema.columnar import FeatureTable
     from geomesa_tpu.store.datastore import DataStore
 
@@ -122,10 +140,18 @@ def load(path: str, backend: str = "tpu"):
     ds = DataStore(backend=backend)
     for name, meta in manifest["types"].items():
         sft = parse_spec(name, meta["spec"])
+        columns = None
+        if column_group is not None:
+            from geomesa_tpu.schema.column_groups import ColumnGroups
+
+            groups = ColumnGroups(sft)
+            if column_group in groups.groups:
+                sft = groups.reduced_sft(column_group)
+                columns = ["__fid__"] + [a.name for a in sft.attributes]
         ds.create_schema(sft)
         tables = []
         for f in meta["files"]:
-            at = pq.read_table(root / name / f["file"])
+            at = pq.read_table(root / name / f["file"], columns=columns)
             tables.append(from_arrow(sft, at))
         if tables:
             table = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)

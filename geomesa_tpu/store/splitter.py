@@ -1,0 +1,61 @@
+"""Shard split-point computation (the TableSplitter role).
+
+Role parity: ``geomesa-index-api/.../conf/splitter/DefaultSplitter.scala:33``
+(SURVEY.md §2.3): the reference seeds each index's table with initial split
+points (z-prefix patterns, attribute prefix letters, id hex) so load spreads
+across tablet servers before any data arrives. The TPU analog is the *device
+shard boundary*: where the z-sorted columnar store is cut across the mesh's
+data axis. Two flavors:
+
+- :func:`default_splits` — static, config-driven (no data yet): evenly spaced
+  points in the index's key domain, the DefaultSplitter behavior.
+- :func:`balanced_splits` — stats-driven (data resident): quantile cuts of the
+  actual sorted keys so every device holds the same row count — the reference
+  achieves this a-posteriori via tablet splits; we can do it exactly at
+  (re)shard time (SURVEY.md §2.20 P1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["default_splits", "balanced_splits", "shard_of"]
+
+
+def default_splits(index_name: str, n_shards: int, bits: int = 62) -> np.ndarray:
+    """``n_shards - 1`` static split keys for an empty index.
+
+    z2/z3/xz2/xz3: evenly spaced in the key domain (``2^bits``); attr/id:
+    evenly spaced in the first-byte domain, mirroring the reference's
+    hex/alpha prefix patterns.
+    """
+    if n_shards < 1:
+        raise ValueError("n_shards must be >= 1")
+    name = index_name.lower()
+    if name.startswith(("z2", "z3", "xz2", "xz3")):
+        domain = 1 << bits
+        return (np.arange(1, n_shards) * (domain // n_shards)).astype(np.int64)
+    # attribute / id indexes: split the leading byte
+    return (np.arange(1, n_shards) * (256 // max(n_shards, 1))).astype(np.int64)
+
+
+def balanced_splits(sorted_keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Quantile split keys over resident data → equal-count shards.
+
+    Returns ``n_shards - 1`` keys; shard i = rows with
+    ``splits[i-1] <= key < splits[i]``.
+    """
+    if n_shards < 1:
+        raise ValueError("n_shards must be >= 1")
+    n = len(sorted_keys)
+    if n == 0 or n_shards == 1:
+        return np.empty(0, dtype=np.asarray(sorted_keys).dtype)
+    cuts = (np.arange(1, n_shards) * n) // n_shards
+    return np.asarray(sorted_keys)[cuts]
+
+
+def shard_of(keys: np.ndarray, splits: np.ndarray) -> np.ndarray:
+    """Shard id per key under the given split points (searchsorted)."""
+    if len(splits) == 0:
+        return np.zeros(len(keys), dtype=np.int32)
+    return np.searchsorted(splits, keys, side="right").astype(np.int32)

@@ -1,0 +1,56 @@
+"""File-based distributed locking for catalog mutation.
+
+Role parity: ``geomesa-index-api/.../index/utils/DistributedLocking.scala:14``
+(SURVEY.md §2.3): the reference wraps schema create/update/delete in a
+Zookeeper (Curator) lock keyed by the catalog path so concurrent clients can't
+corrupt shared metadata. Here the shared medium is the persisted catalog
+directory, so the lock is an ``fcntl.flock`` on a lockfile inside it — correct
+across processes on one host and over NFS mounts that support flock; the
+multi-slice coordination story (SURVEY.md §5) goes through the job scheduler
+instead of a lock service.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import fcntl
+import os
+import time
+
+__all__ = ["catalog_lock", "LockTimeout"]
+
+
+class LockTimeout(TimeoutError):
+    pass
+
+
+@contextlib.contextmanager
+def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05):
+    """Exclusive advisory lock on ``<path>/.geomesa.lock``.
+
+    ``path`` is created if missing (locking a catalog that doesn't exist yet
+    is the schema-create case).
+    """
+    os.makedirs(path, exist_ok=True)
+    lock_path = os.path.join(path, ".geomesa.lock")
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+                if time.monotonic() >= deadline:
+                    raise LockTimeout(
+                        f"could not lock catalog {path!r} within {timeout_s}s"
+                    ) from None
+                time.sleep(poll_s)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)

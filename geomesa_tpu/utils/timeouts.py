@@ -1,0 +1,69 @@
+"""Query timeout watchdog.
+
+Role parity: ``geomesa-index-api/.../index/utils/ThreadManagement.scala``
+(SURVEY.md §2.3/§5): the reference registers every scan with a watchdog that
+kills it past ``geomesa.query.timeout``. XLA device launches can't be killed
+mid-kernel, but a runaway *query* (huge plan, giant residual refine, slow
+host reduce) is interruptible at the Python layer: the scan runs on a worker
+thread and the caller gives up — and flags the query as abandoned — when the
+deadline passes (the worker's result is discarded when it eventually lands).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+
+__all__ = ["QueryTimeout", "run_with_timeout", "Watchdog"]
+
+
+class QueryTimeout(TimeoutError):
+    pass
+
+
+_EXEC = concurrent.futures.ThreadPoolExecutor(
+    max_workers=8, thread_name_prefix="geomesa-scan"
+)
+
+
+def run_with_timeout(fn, timeout_s: float | None, *args, **kwargs):
+    """Run ``fn`` with a deadline; raises :class:`QueryTimeout` on expiry.
+
+    With ``timeout_s`` None the call is inline (zero overhead) — the common
+    case; the worker-thread hop only happens for queries that opted in.
+    """
+    if timeout_s is None:
+        return fn(*args, **kwargs)
+    fut = _EXEC.submit(fn, *args, **kwargs)
+    try:
+        return fut.result(timeout=timeout_s)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        raise QueryTimeout(f"query exceeded timeout of {timeout_s}s") from None
+
+
+class Watchdog:
+    """Tracks in-flight queries: start/stop registration + abandoned count
+    (the ``ThreadManagement`` bookkeeping; surfaced in metrics)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: dict[int, str] = {}
+        self._next = 0
+        self.abandoned = 0
+
+    def register(self, description: str) -> int:
+        with self._lock:
+            self._next += 1
+            self._active[self._next] = description
+            return self._next
+
+    def complete(self, token: int, timed_out: bool = False) -> None:
+        with self._lock:
+            self._active.pop(token, None)
+            if timed_out:
+                self.abandoned += 1
+
+    def active(self) -> list[str]:
+        with self._lock:
+            return list(self._active.values())

@@ -1,0 +1,218 @@
+"""Operational hardening: split points, catalog locks, query watchdog,
+column groups (reference: DefaultSplitter, DistributedLocking,
+ThreadManagement, ColumnGroups — SURVEY.md §2.3)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.cql import parse
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.column_groups import ColumnGroups, filter_attributes
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.splitter import balanced_splits, default_splits, shard_of
+from geomesa_tpu.utils.locks import LockTimeout, catalog_lock
+from geomesa_tpu.utils.timeouts import QueryTimeout, Watchdog, run_with_timeout
+
+
+class TestSplitter:
+    def test_default_splits_z(self):
+        s = default_splits("z3", 4)
+        assert len(s) == 3
+        assert np.all(np.diff(s) > 0)
+        # evenly spaced across the 62-bit domain
+        assert s[0] == (1 << 62) // 4
+
+    def test_default_splits_attr(self):
+        s = default_splits("attr", 8)
+        assert len(s) == 7 and s[0] == 32
+
+    def test_balanced_splits_equal_counts(self):
+        rng = np.random.default_rng(0)
+        keys = np.sort(rng.integers(0, 1 << 40, 10_000))
+        splits = balanced_splits(keys, 8)
+        sid = shard_of(keys, splits)
+        counts = np.bincount(sid, minlength=8)
+        # skewed data still lands in near-equal shards
+        assert counts.max() - counts.min() <= 2
+
+    def test_balanced_splits_skewed(self):
+        keys = np.sort(np.concatenate([np.zeros(5000, np.int64),
+                                       np.arange(5000, dtype=np.int64) + 10]))
+        splits = balanced_splits(keys, 4)
+        sid = shard_of(keys, splits)
+        counts = np.bincount(sid, minlength=4)
+        assert counts.sum() == 10_000
+        # identical keys can't be split apart; everything else balances
+        assert counts[-1] >= 2000
+
+    def test_degenerate(self):
+        assert len(balanced_splits(np.array([], np.int64), 4)) == 0
+        assert len(default_splits("z2", 1)) == 0
+        assert shard_of(np.arange(5), np.empty(0, np.int64)).tolist() == [0] * 5
+
+
+class TestCatalogLock:
+    def test_exclusive(self, tmp_path):
+        p = str(tmp_path / "cat")
+        order = []
+        with catalog_lock(p):
+            t = threading.Thread(
+                target=lambda: (
+                    [order.append("wait")],
+                    catalog_lock(p, timeout_s=5).__enter__(),
+                    order.append("acquired"),
+                )
+            )
+            t.start()
+            time.sleep(0.2)
+            order.append("release")
+        t.join(5)
+        assert order == ["wait", "release", "acquired"]
+
+    def test_timeout(self, tmp_path):
+        p = str(tmp_path / "cat")
+        with catalog_lock(p):
+            # flock is per-fd, so a second acquisition in another *process*
+            # would block; emulate with a thread + tiny timeout
+            err = []
+
+            def try_lock():
+                try:
+                    with catalog_lock(p, timeout_s=0.2):
+                        pass
+                except LockTimeout as e:
+                    err.append(e)
+
+            t = threading.Thread(target=try_lock)
+            t.start()
+            t.join(5)
+            assert len(err) == 1
+
+    def test_save_concurrent_is_serialized(self, tmp_path):
+        # concurrent saves from SEPARATE PROCESSES (the lock's actual
+        # scenario — flock is cross-process) must serialize, leaving a
+        # consistent loadable catalog
+        import subprocess
+        import sys
+
+        path = str(tmp_path / "cat")
+        script = (
+            "import sys\n"
+            "from geomesa_tpu.geometry.types import Point\n"
+            "from geomesa_tpu.store.datastore import DataStore\n"
+            "ds = DataStore(backend='oracle')\n"
+            "ds.create_schema('t', 'a:Integer,*geom:Point')\n"
+            "ds.write('t', [{'a': i, 'geom': Point(i, i)} for i in range(10)])\n"
+            f"ds.save({path!r})\n"
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script],
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for _ in range(4)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+        from geomesa_tpu.store import persistence
+
+        out = persistence.load(path, backend="oracle")
+        assert out.query("t").count == 10
+
+
+class TestWatchdog:
+    def test_run_inline_without_timeout(self):
+        assert run_with_timeout(lambda: 42, None) == 42
+
+    def test_timeout_raises(self):
+        with pytest.raises(QueryTimeout):
+            run_with_timeout(time.sleep, 0.05, 0.5)
+
+    def test_result_within_deadline(self):
+        assert run_with_timeout(lambda: "ok", 2.0) == "ok"
+
+    def test_query_timeout_hint(self, monkeypatch):
+        ds = DataStore(backend="oracle")
+        ds.create_schema("t", "a:Integer,dtg:Date,*geom:Point")
+        ds.write("t", [{"a": i, "dtg": i, "geom": Point(0, 0)} for i in range(100)])
+        ds.compact("t")  # move rows to the main tier so the scan runs select()
+        # normal query under generous timeout works
+        assert ds.query("t", Query(hints={"timeout": 30.0})).count == 100
+        assert ds.watchdog.abandoned == 0
+        # a deterministically slow scan trips the watchdog
+        orig = type(ds.backend).select
+
+        def slow_select(self, *args, **kwargs):
+            time.sleep(0.5)
+            return orig(self, *args, **kwargs)
+
+        monkeypatch.setattr(type(ds.backend), "select", slow_select)
+        with pytest.raises(QueryTimeout):
+            ds.query("t", Query(hints={"timeout": 0.05}))
+        assert ds.watchdog.abandoned == 1
+        assert ds.metrics.snapshot()["store.query.timeouts"]["count"] == 1
+        assert ds.watchdog.active() == []
+
+    def test_registry(self):
+        w = Watchdog()
+        t1 = w.register("q1")
+        w.register("q2")
+        assert len(w.active()) == 2
+        w.complete(t1)
+        assert w.active() == ["q2"]
+
+
+class TestColumnGroups:
+    SPEC = ("name:String,heading:Double,dtg:Date,*geom:Point;"
+            "geomesa.column.groups='track:name;full:name,heading'")
+
+    def test_parse_and_select(self):
+        sft = parse_spec("cg", self.SPEC)
+        cg = ColumnGroups(sft)
+        # geom + dtg implicitly in every group
+        assert cg.groups["track"] == {"name", "geom", "dtg"}
+        name, attrs = cg.group_for(["name"], parse("BBOX(geom,0,0,1,1)"))
+        assert name == "track"
+        name, _ = cg.group_for(["name", "heading"], None)
+        assert name == "full"
+        name, attrs = cg.group_for(None, None)  # no projection → everything
+        assert name == "default" and attrs == {"name", "heading", "dtg", "geom"}
+
+    def test_filter_attributes(self):
+        f = parse("BBOX(geom,0,0,1,1) AND name = 'x' AND heading > 5")
+        assert filter_attributes(f) == {"geom", "name", "heading"}
+
+    def test_unknown_attr_rejected(self):
+        sft = parse_spec("cg", "a:Integer,*geom:Point;geomesa.column.groups='g:nope'")
+        with pytest.raises(ValueError, match="unknown attributes"):
+            ColumnGroups(sft)
+
+    def test_reduced_sft_and_partial_load(self, tmp_path):
+        sft = parse_spec("cg", self.SPEC)
+        ds = DataStore(backend="oracle")
+        ds.create_schema(sft)
+        ds.write(
+            "cg",
+            [
+                {"name": f"n{i}", "heading": float(i), "dtg": 1000 * i, "geom": Point(i, i)}
+                for i in range(20)
+            ],
+        )
+        path = str(tmp_path / "cat")
+        ds.save(path)
+        from geomesa_tpu.store import persistence
+
+        out = persistence.load(path, backend="oracle", column_group="track")
+        sft2 = out.get_schema("cg")
+        assert [a.name for a in sft2.attributes] == ["name", "dtg", "geom"]
+        r = out.query("cg", "BBOX(geom, -1, -1, 5, 5)")
+        assert r.count == 6
+        assert set(r.table.columns) == {"name", "dtg", "geom"}
