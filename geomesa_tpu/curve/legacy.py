@@ -1,0 +1,87 @@
+"""Back-compat "legacy" curves with the old rounding semantics.
+
+Role parity: ``geomesa-z3/.../curve/LegacyZ2SFC.scala`` / ``LegacyZ3SFC.scala``
+(SURVEY.md §2.1): schemas written by old GeoMesa versions used a normalization
+that scales into ``[0, 2^p - 1]`` with round-half-up instead of equi-width
+floor binning. Data indexed under the old curves must be planned/scanned with
+the same math or range covers miss rows at bin edges — so the legacy curves
+ship alongside the current ones, selectable per schema generation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.curve import normalize
+from geomesa_tpu.curve.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curve.sfc import Z2SFC, Z3SFC
+
+__all__ = ["LegacyNormalizedDimension", "LegacyZ2SFC", "LegacyZ3SFC", "legacy_z3_sfc"]
+
+
+@dataclass(frozen=True)
+class LegacyNormalizedDimension(normalize.NormalizedDimension):
+    """Old normalization: ``round((x-min)/(max-min) * max_index)`` —
+    half-width first/last bins, round-half-up at bin midpoints."""
+
+    def normalize(self, x) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        if np.isnan(x).any():
+            raise ValueError("NaN coordinate cannot be normalized to a curve index")
+        scaled = (x - self.min) / (self.max - self.min) * self.max_index
+        # numpy rounds half-to-even; the JVM's Math.round is half-up
+        out = np.floor(scaled + 0.5)
+        return np.clip(out, 0, self.max_index).astype(np.int64)
+
+    def denormalize(self, i) -> np.ndarray:
+        i = np.minimum(np.asarray(i, dtype=np.float64), self.max_index)
+        return self.min + i * ((self.max - self.min) / self.max_index)
+
+    def bin_lo(self, i) -> np.ndarray:
+        i = np.asarray(i, dtype=np.float64)
+        return self.min + (i - 0.5) * ((self.max - self.min) / self.max_index)
+
+    def bin_hi(self, i) -> np.ndarray:
+        i = np.asarray(i, dtype=np.float64)
+        return self.min + (i + 0.5) * ((self.max - self.min) / self.max_index)
+
+
+class LegacyZ2SFC(Z2SFC):
+    """Z2 with legacy rounding (31 bits/dim)."""
+
+    @property
+    def lon(self) -> normalize.NormalizedDimension:
+        return LegacyNormalizedDimension(-180.0, 180.0, 31)
+
+    @property
+    def lat(self) -> normalize.NormalizedDimension:
+        return LegacyNormalizedDimension(-90.0, 90.0, 31)
+
+
+class LegacyZ3SFC(Z3SFC):
+    """Z3 with legacy rounding (21 bits/dim)."""
+
+    @property
+    def lon(self) -> normalize.NormalizedDimension:
+        return LegacyNormalizedDimension(-180.0, 180.0, 21)
+
+    @property
+    def lat(self) -> normalize.NormalizedDimension:
+        return LegacyNormalizedDimension(-90.0, 90.0, 21)
+
+    @property
+    def time(self) -> normalize.NormalizedDimension:
+        max_offset = float(BinnedTime(self.period).max_offset)
+        return LegacyNormalizedDimension(0.0, max_offset, 21)
+
+
+_CACHE: dict[TimePeriod, LegacyZ3SFC] = {}
+
+
+def legacy_z3_sfc(period: TimePeriod) -> LegacyZ3SFC:
+    """Singleton per period (mirrors ``LegacyZ3SFC`` per-period companions)."""
+    if period not in _CACHE:
+        _CACHE[period] = LegacyZ3SFC(period)
+    return _CACHE[period]

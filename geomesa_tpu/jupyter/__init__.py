@@ -1,0 +1,5 @@
+"""Notebook map display helpers."""
+
+from geomesa_tpu.jupyter.leaflet import density_layer, map_html, show
+
+__all__ = ["map_html", "density_layer", "show"]

@@ -1,0 +1,111 @@
+"""Self-contained Leaflet map HTML for notebooks.
+
+Role parity: ``geomesa-jupyter`` (325 LoC — SURVEY.md §2.19): render query
+results as an interactive Leaflet map in a notebook. Output is a single HTML
+document (Leaflet from its public CDN; data embedded as GeoJSON), usable via
+``IPython.display.HTML`` or saved to a file.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["map_html", "density_layer", "show"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"/>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>#map{{height:{height}px;}}</style></head>
+<body><div id="map"></div><script>
+var map = L.map('map');
+L.tileLayer('https://tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+            {{maxZoom: 19, attribution: '&copy; OpenStreetMap'}}).addTo(map);
+var layers = {layers};
+var group = L.featureGroup();
+layers.forEach(function (spec) {{
+  if (spec.kind === 'geojson') {{
+    L.geoJSON(spec.data, {{
+      style: spec.style,
+      pointToLayer: function (f, latlng) {{
+        return L.circleMarker(latlng, spec.style);
+      }},
+      onEachFeature: function (f, l) {{
+        if (f.properties) {{
+          l.bindPopup(Object.entries(f.properties)
+            .map(function (kv) {{ return kv[0] + ': ' + kv[1]; }}).join('<br/>'));
+        }}
+      }}
+    }}).addTo(group);
+  }} else if (spec.kind === 'density') {{
+    spec.cells.forEach(function (c) {{
+      L.rectangle([[c[1], c[0]], [c[3], c[2]]],
+                  {{stroke: false, fillColor: spec.color,
+                    fillOpacity: c[4]}}).addTo(group);
+    }});
+  }}
+}});
+group.addTo(map);
+var b = group.getBounds();
+if (b.isValid()) {{ map.fitBounds(b.pad(0.1)); }} else {{ map.setView([0,0],2); }}
+</script></body></html>"""
+
+
+def density_layer(grid: np.ndarray, bbox, color: str = "#d53e4f", max_cells: int = 4000) -> dict:
+    """Density grid → rectangle layer spec (cell opacity ∝ weight)."""
+    xmin, ymin, xmax, ymax = bbox
+    h, w = grid.shape
+    gy, gx = np.nonzero(grid)
+    weights = grid[gy, gx]
+    if len(gx) > max_cells:  # keep the heaviest cells
+        top = np.argsort(weights)[-max_cells:]
+        gy, gx, weights = gy[top], gx[top], weights[top]
+    peak = float(weights.max()) if len(weights) else 1.0
+    cw = (xmax - xmin) / w
+    ch = (ymax - ymin) / h
+    cells = [
+        [
+            round(xmin + x * cw, 6),
+            round(ymin + y * ch, 6),
+            round(xmin + (x + 1) * cw, 6),
+            round(ymin + (y + 1) * ch, 6),
+            round(0.15 + 0.85 * float(v) / peak, 3),
+        ]
+        for x, y, v in zip(gx, gy, weights)
+    ]
+    return {"kind": "density", "cells": cells, "color": color}
+
+
+def map_html(*layers, height: int = 500) -> str:
+    """Layers → standalone HTML. Each layer may be a FeatureTable, a GeoJSON
+    FeatureCollection dict, a (table_or_fc, style_dict) tuple, or a
+    :func:`density_layer` spec."""
+    specs = []
+    for layer in layers:
+        style = {"radius": 4, "color": "#3288bd", "weight": 1, "fillOpacity": 0.7}
+        if isinstance(layer, tuple):
+            layer, style = layer[0], {**style, **layer[1]}
+        if isinstance(layer, dict) and layer.get("kind") == "density":
+            specs.append(layer)
+            continue
+        if isinstance(layer, dict):
+            fc = layer
+        else:  # FeatureTable
+            from geomesa_tpu.geometry.geojson import table_to_feature_collection
+
+            fc = table_to_feature_collection(layer)
+        specs.append({"kind": "geojson", "data": fc, "style": style})
+    return _PAGE.format(height=height, layers=json.dumps(specs))
+
+
+def show(*layers, height: int = 500):
+    """IPython display object (falls back to the HTML string)."""
+    html = map_html(*layers, height=height)
+    try:
+        from IPython.display import HTML
+
+        return HTML(html)
+    except ImportError:
+        return html

