@@ -42,7 +42,9 @@ class Z2Index(FeatureIndex):
     def build(self, table: FeatureTable) -> np.ndarray:
         col = table.geom_column()
         z = self.sfc.index(col.x, col.y)
-        perm = np.argsort(z, kind="stable")
+        from geomesa_tpu import native
+
+        perm = native.sort_u64(z)
         self.perm = perm
         self.zs = z[perm]
         self.n = len(table)
@@ -76,7 +78,9 @@ class XZ2Index(FeatureIndex):
     def build(self, table: FeatureTable) -> np.ndarray:
         b = table.geom_column().bounds
         codes = self.sfc.index((b[:, 0], b[:, 1]), (b[:, 2], b[:, 3]))
-        perm = np.argsort(codes, kind="stable")
+        from geomesa_tpu import native
+
+        perm = native.sort_u64(codes)
         self.perm = perm
         self.codes = codes[perm]
         self.n = len(table)

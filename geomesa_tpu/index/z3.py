@@ -90,7 +90,9 @@ class Z3Index(FeatureIndex):
         t_ms = table.dtg_millis()
         bins, offs = self.binned.to_bin_and_offset(t_ms)
         z = self.sfc.index(col.x, col.y, offs)
-        perm = np.lexsort((z, bins))
+        from geomesa_tpu import native
+
+        perm = native.lexsort_bin_z(bins, z)
         self.perm = perm
         self.bins = bins[perm]
         self.offsets = offs[perm]
@@ -163,7 +165,9 @@ class XZ3Index(FeatureIndex):
         codes = self.sfc.index(
             (b[:, 0], b[:, 1], o), (b[:, 2], b[:, 3], o)
         )
-        perm = np.lexsort((codes, bins))
+        from geomesa_tpu import native
+
+        perm = native.lexsort_bin_z(bins, codes)
         self.perm = perm
         self.bins = bins[perm]
         self.codes = codes[perm]

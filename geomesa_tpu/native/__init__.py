@@ -1,55 +1,68 @@
-"""Native (C++) planner components, loaded via ctypes.
+"""Native (C++) runtime components, loaded via ctypes.
 
-Build-on-first-use: ``g++ -O2`` compiles :file:`zranges.cpp` into the package
-directory the first time it's needed (cached by mtime); everything degrades to
-the pure-Python implementations when no toolchain is available.
+Build-on-first-use: ``g++ -O2`` compiles each ``.cpp`` in this directory into
+a shared library alongside it the first time it's needed (cached by mtime);
+everything degrades to the pure-Python implementations when no toolchain is
+available. Components (SURVEY.md §2.9 native checklist):
+
+- ``zranges.cpp`` — z-range decomposition (the sfcurve ``zranges`` role)
+- ``sortmerge.cpp`` — (bin, z) lexsort + LSM sorted-merge for index builds
+  and delta-tier compaction
+- ``delimited.cpp`` — one-pass typed column extraction from delimited text
+  (the ingest data-loader hot path)
 """
 
 from __future__ import annotations
 
 import ctypes
-import os
 import subprocess
 from pathlib import Path
 
 import numpy as np
 
 _DIR = Path(__file__).parent
-_SRC = _DIR / "zranges.cpp"
-_LIB = _DIR / "libzranges.so"
-
-_lib = None
-_load_failed = False
+_libs: dict[str, object] = {}  # name -> CDLL | None (None = load failed)
 
 
-def _ensure_built() -> bool:
-    if _LIB.exists() and (
-        not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime
-    ):
-        return True  # prebuilt .so shipped without source is fine
-    if not _SRC.exists():
-        return False
+def _load_lib(name: str):
+    """Compile (if stale) and dlopen ``<name>.cpp`` → ``lib<name>.so``."""
+    if name in _libs:
+        return _libs[name]
+    src = _DIR / f"{name}.cpp"
+    lib_path = _DIR / f"lib{name}.so"
+    fresh = lib_path.exists() and (
+        not src.exists() or lib_path.stat().st_mtime >= src.stat().st_mtime
+    )
+    if not fresh:
+        if not src.exists():
+            _libs[name] = None
+            return None
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", str(lib_path), str(src)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            _libs[name] = None
+            return None
     try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception:
-        return False
+        _libs[name] = ctypes.CDLL(str(lib_path))
+    except OSError:
+        _libs[name] = None
+    return _libs[name]
 
 
-def _load():
-    global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
-    if not _ensure_built():
-        _load_failed = True
-        return None
-    try:
-        lib = ctypes.CDLL(str(_LIB))
+def available() -> bool:
+    return _zranges_lib() is not None
+
+
+# -- zranges -----------------------------------------------------------------
+
+def _zranges_lib():
+    lib = _load_lib("zranges")
+    if lib is not None and not getattr(lib, "_configured", False):
         lib.geomesa_zranges.restype = ctypes.c_long
         lib.geomesa_zranges.argtypes = [
             ctypes.c_int,
@@ -61,21 +74,15 @@ def _load():
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_long,
         ]
-        _lib = lib
-    except OSError:
-        _load_failed = True
-    return _lib
-
-
-def available() -> bool:
-    return _load() is not None
+        lib._configured = True
+    return lib
 
 
 def zranges_native(
     lows, highs, precision: int, max_ranges: int = 2000, max_recurse: int = 32
 ):
     """C++ z-range decomposition; returns (R, 2) uint64 or None if unavailable."""
-    lib = _load()
+    lib = _zranges_lib()
     if lib is None:
         return None
     dims = len(lows)
@@ -103,3 +110,141 @@ def zranges_native(
         if n < 0:
             return None
     return out[: 2 * n].reshape(n, 2).copy()
+
+
+# -- sort / merge -------------------------------------------------------------
+
+def _sortmerge_lib():
+    lib = _load_lib("sortmerge")
+    if lib is not None and not getattr(lib, "_configured", False):
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.geomesa_sort_bin_z.restype = None
+        lib.geomesa_sort_bin_z.argtypes = [i32p, u64p, ctypes.c_int64, i64p]
+        lib.geomesa_sort_u64.restype = None
+        lib.geomesa_sort_u64.argtypes = [u64p, ctypes.c_int64, i64p]
+        lib.geomesa_merge_bin_z.restype = None
+        lib.geomesa_merge_bin_z.argtypes = [
+            i32p, u64p, ctypes.c_int64, i32p, u64p, ctypes.c_int64, i64p,
+        ]
+        lib._configured = True
+    return lib
+
+
+def lexsort_bin_z(bins: np.ndarray, zs: np.ndarray) -> np.ndarray:
+    """Stable sort permutation by (bin, z); native, else ``np.lexsort``."""
+    lib = _sortmerge_lib()
+    bins = np.ascontiguousarray(bins, dtype=np.int32)
+    zs = np.ascontiguousarray(zs, dtype=np.uint64)
+    if lib is None:
+        return np.lexsort((zs, bins))
+    perm = np.empty(len(zs), dtype=np.int64)
+    lib.geomesa_sort_bin_z(
+        bins.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        zs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(zs),
+        perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return perm
+
+
+def sort_u64(keys: np.ndarray) -> np.ndarray:
+    """Stable sort permutation of uint64 keys.
+
+    numpy's stable argsort on integer keys is already an optimized radix
+    sort and measures faster than the C path for single keys, so this stays
+    numpy; the native win is the *fused* composite sort
+    (:func:`lexsort_bin_z`), which replaces two stable passes with one.
+    """
+    return np.argsort(np.ascontiguousarray(keys, dtype=np.uint64), kind="stable")
+
+
+def merge_bin_z(bins_a, zs_a, bins_b, zs_b) -> np.ndarray:
+    """Gather permutation merging two (bin, z)-sorted runs; indices into the
+    concatenation [a | b] (LSM compaction path). Falls back to lexsort."""
+    a_bins = np.ascontiguousarray(bins_a, dtype=np.int32)
+    a_zs = np.ascontiguousarray(zs_a, dtype=np.uint64)
+    b_bins = np.ascontiguousarray(bins_b, dtype=np.int32)
+    b_zs = np.ascontiguousarray(zs_b, dtype=np.uint64)
+    lib = _sortmerge_lib()
+    if lib is None:
+        return np.lexsort(
+            (np.concatenate([a_zs, b_zs]), np.concatenate([a_bins, b_bins]))
+        )
+    out = np.empty(len(a_zs) + len(b_zs), dtype=np.int64)
+    lib.geomesa_merge_bin_z(
+        a_bins.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        a_zs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(a_zs),
+        b_bins.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        b_zs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(b_zs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+# -- delimited loader ---------------------------------------------------------
+
+F64, I64, DATE_YYYYMMDD = 0, 1, 2
+
+
+def _delimited_lib():
+    lib = _load_lib("delimited")
+    if lib is not None and not getattr(lib, "_configured", False):
+        lib.geomesa_count_lines.restype = ctypes.c_int64
+        lib.geomesa_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.geomesa_parse_delimited.restype = ctypes.c_int64
+        lib.geomesa_parse_delimited.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
+        lib._configured = True
+    return lib
+
+
+def parse_delimited(data: bytes, delim: str, columns: list[tuple[int, int]]):
+    """One-pass typed extraction of ``columns`` = [(zero_based_index, type)]
+    from a delimited byte buffer. Types: F64, I64, DATE_YYYYMMDD (→ epoch
+    ms). Returns ``(arrays, valid)`` per column, or None when the native
+    loader is unavailable. Column indices must be ascending.
+    """
+    lib = _delimited_lib()
+    if lib is None:
+        return None
+    idxs = [c for c, _ in columns]
+    if idxs != sorted(idxs):
+        raise ValueError("column indices must be ascending")
+    n_rows = lib.geomesa_count_lines(data, len(data))
+    n_cols = len(columns)
+    bufs = [np.zeros(max(n_rows, 1), dtype=np.float64) for _ in columns]
+    valid = np.zeros((n_cols, max(n_rows, 1)), dtype=np.uint8)
+    out_ptrs = (ctypes.POINTER(ctypes.c_double) * n_cols)(
+        *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for b in bufs]
+    )
+    got = lib.geomesa_parse_delimited(
+        data,
+        len(data),
+        delim.encode()[0:1],
+        n_cols,
+        (ctypes.c_int32 * n_cols)(*idxs),
+        (ctypes.c_int32 * n_cols)(*[t for _, t in columns]),
+        out_ptrs,
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        max(n_rows, 1),
+    )
+    arrays = []
+    for buf, (_, typ) in zip(bufs, columns):
+        a = buf[:got]
+        if typ != F64:
+            a = a.view(np.int64)[: len(a)]
+        arrays.append(a.copy())
+    return arrays, valid[:, :got].astype(bool)
