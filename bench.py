@@ -1,22 +1,20 @@
-"""Benchmark: GDELT-like Z3 bbox+time query throughput, TPU vs CPU brute force.
+"""Benchmarks for the 5 BASELINE.md configs, TPU vs CPU brute force.
 
-Exercises BASELINE.md config #2 (Z3 spatio-temporal range queries): a batch of
-64 distinct bbox+time-window count queries over synthetic GDELT-shaped events,
-executed with the sharded batched scan step (one device launch + one readback
-per batch — the SPMD fan-out of SURVEY.md §2.20 P4). Prints ONE JSON line:
+Select with ``GEOMESA_BENCH_CONFIG`` (default ``2``, the headline config):
 
-  {"metric": ..., "value": per_query_p50_ms, "unit": "ms", "vs_baseline": x}
+  1  Z2 point BBOX queries, GDELT-1M            (GeoCQEngine/Z2 role)
+  2  Z3 bbox+time range queries, GDELT events   (Z3IndexKeySpace role)
+  3  density heatmap + KNN, 100M points         (DensityScan / KNN process)
+  4  ST_Within spatial join, points × polygons  (spark-jts UDF role)
+  5  XZ2 bbox queries over linestring tracks    (XZ2SFC role)
 
-``vs_baseline`` = CPU per-query p50 / TPU per-query p50 on identical data +
-queries (the reference publishes no numbers — BASELINE.md — so the measured
-in-memory CPU path is the baseline, standing in for GeoCQEngine).
+Each prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...};
+``vs_baseline`` = CPU-per-query / TPU-per-query on identical data + queries
+(the reference publishes no numbers — BASELINE.md — so the measured in-memory
+CPU path is the baseline, standing in for GeoCQEngine).
 
-Parity: TPU counts are asserted EQUAL to the CPU evaluating the same
-int-domain semantics; the f64-vs-int boundary row count is reported (time is
-exact under the DAY period since offsets are millisecond-resolution).
-
-Env knobs: GEOMESA_BENCH_N (default 10M), GEOMESA_BENCH_Q (64),
-GEOMESA_BENCH_ITERS (20).
+Env knobs: GEOMESA_BENCH_N (points), GEOMESA_BENCH_Q (queries),
+GEOMESA_BENCH_ITERS, GEOMESA_BENCH_K (join polygons / knn k).
 """
 
 from __future__ import annotations
@@ -33,7 +31,7 @@ from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
 from geomesa_tpu.curve.sfc import z3_sfc
 from geomesa_tpu.ops.refine import pack_boxes, pack_times
 
-N = int(os.environ.get("GEOMESA_BENCH_N", 10_000_000))
+CONFIG = os.environ.get("GEOMESA_BENCH_CONFIG", "2")
 Q = int(os.environ.get("GEOMESA_BENCH_Q", 64))
 ITERS = int(os.environ.get("GEOMESA_BENCH_ITERS", 20))
 T0 = 1_498_867_200_000  # 2017-07-01, GDELT-era
@@ -44,6 +42,10 @@ CITIES = np.array(
     [[-74, 40.7], [0.1, 51.5], [2.3, 48.8], [116.4, 39.9], [37.6, 55.7],
      [-99.1, 19.4], [28.0, -26.2], [77.2, 28.6], [139.7, 35.7], [31.2, 30.0]]
 )
+
+
+def _n(default: int) -> int:
+    return int(os.environ.get("GEOMESA_BENCH_N", default))
 
 
 def synth_gdelt(n: int, seed: int = 42):
@@ -83,39 +85,44 @@ def make_queries(q: int, seed: int = 7):
     return boxes_f64, windows_ms
 
 
-def main():
-    import jax
+def _p50(fn, iters=ITERS):
+    fn()  # warmup (post-compile)
+    lat_ms = []
+    for _ in range(iters):
+        s = time.perf_counter()
+        fn()
+        lat_ms.append((time.perf_counter() - s) * 1e3)
+    return float(np.percentile(lat_ms, 50))
+
+
+def _sharded_store(lon, lat, t_ms, period=PERIOD):
+    """Host encode + sort + shard columns onto the mesh; returns the batched
+    step inputs shared by configs 1-3."""
     import jax.numpy as jnp
 
+    from geomesa_tpu import native
     from geomesa_tpu.parallel.mesh import make_mesh, shard_columns
-    from geomesa_tpu.parallel.query import make_batched_count_step
 
-    lon, lat, t_ms = synth_gdelt(N)
-
-    # --- build (host ingest path): encode + sort ---
-    binned = BinnedTime(PERIOD)
-    sfc = z3_sfc(PERIOD)
+    binned = BinnedTime(period)
+    sfc = z3_sfc(period)
     t_build = time.perf_counter()
     bins, offs = binned.to_bin_and_offset(t_ms)
     z = sfc.index(lon, lat, offs)
-    perm = np.lexsort((z, bins))
+    perm = native.lexsort_bin_z(bins, z)
     nlon, nlat = norm_lon(31), norm_lat(31)
     xi = nlon.normalize(lon).astype(np.int32)
     yi = nlat.normalize(lat).astype(np.int32)
-    x_s = xi[perm]
-    y_s = yi[perm]
-    bins_s = bins[perm].astype(np.int32)
-    offs_s = offs[perm].astype(np.int32)
+    cols_np = {
+        "x": xi[perm], "y": yi[perm],
+        "bins": bins[perm].astype(np.int32), "offs": offs[perm].astype(np.int32),
+    }
     build_s = time.perf_counter() - t_build
-
     mesh = make_mesh()  # all local devices (1 real chip; 8 on CPU-sim)
-    cols, padded, rows_per_shard = shard_columns(
-        mesh, {"x": x_s, "y": y_s, "bins": bins_s, "offs": offs_s}
-    )
-    step = make_batched_count_step(mesh)
+    cols, padded, rows_per_shard = shard_columns(mesh, cols_np)
+    return mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s, jnp.int32(len(lon))
 
-    # --- query payloads ---
-    boxes_f64, windows_ms = make_queries(Q)
+
+def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
     qboxes = np.stack(
         [
             pack_boxes(
@@ -133,30 +140,41 @@ def main():
         (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
         (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
         qtimes.append(pack_times(np.array([[blo, olo, bhi, ohi]], dtype=np.int32)))
-    qtimes = np.stack(qtimes)
+    return qboxes, np.stack(qtimes)
+
+
+# ---------------------------------------------------------------------------
+# Config 2 (default / headline): Z3 bbox+time batched count queries
+# ---------------------------------------------------------------------------
+
+def bench_z3():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel.query import make_batched_count_step
+
+    N = _n(10_000_000)
+    lon, lat, t_ms = synth_gdelt(N)
+    mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s, true_n = (
+        _sharded_store(lon, lat, t_ms)
+    )
+    step = make_batched_count_step(mesh)
+    boxes_f64, windows_ms = make_queries(Q)
+    qboxes, qtimes = _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat)
     dev_boxes = jnp.asarray(qboxes)
     dev_times = jnp.asarray(qtimes)
-    true_n = jnp.int32(N)
 
     def run_batch():
-        counts = step(
-            cols["x"], cols["y"], cols["bins"], cols["offs"],
-            true_n, dev_boxes, dev_times,
+        return np.asarray(
+            step(cols["x"], cols["y"], cols["bins"], cols["offs"],
+                 true_n, dev_boxes, dev_times)
         )
-        return np.asarray(counts)
 
-    counts = run_batch()  # compile + warmup
-    run_batch()
-
-    lat_ms = []
-    for _ in range(ITERS):
-        s = time.perf_counter()
-        run_batch()
-        lat_ms.append((time.perf_counter() - s) * 1e3)
-    tpu_batch_p50 = float(np.percentile(lat_ms, 50))
+    counts = run_batch()
+    tpu_batch_p50 = _p50(run_batch)
     tpu_per_query = tpu_batch_p50 / Q
 
-    # --- CPU baseline: per-query f64 brute force (GeoCQEngine stand-in) ---
+    # CPU baseline: per-query f64 brute force (GeoCQEngine stand-in)
     cpu_times = []
     cpu_counts_f64 = np.zeros(Q, dtype=np.int64)
     for rep in range(2):
@@ -170,7 +188,7 @@ def main():
         cpu_times.append((time.perf_counter() - s) * 1e3)
     cpu_per_query = float(np.percentile(cpu_times, 50)) / Q
 
-    # --- parity: CPU evaluating the identical int-domain semantics ---
+    # parity: CPU evaluating the identical int-domain semantics
     cpu_counts_int = np.zeros(Q, dtype=np.int64)
     for qi in range(Q):
         bx = qboxes[qi, 0]
@@ -180,9 +198,13 @@ def main():
         before = (bins < bt[2]) | ((bins == bt[2]) & (offs <= bt[3]))
         cpu_counts_int[qi] = int((m & after & before).sum())
     parity = bool((counts.astype(np.int64) == cpu_counts_int).all())
-    boundary_rows = int(np.abs(cpu_counts_int - cpu_counts_f64).sum())
+    assert parity, (
+        "TPU counts diverge from int-domain CPU referee: "
+        f"{counts.tolist()} vs {cpu_counts_int.tolist()}"
+    )
+    import jax as _jax
 
-    result = {
+    return {
         "metric": "gdelt_z3_bbox_time_batched_query_p50_latency",
         "value": round(tpu_per_query, 4),
         "unit": "ms/query",
@@ -190,20 +212,355 @@ def main():
         "detail": {
             "n_points": N,
             "n_queries": Q,
-            "devices": jax.device_count(),
+            "devices": _jax.device_count(),
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "tpu_batch_p50_ms": round(tpu_batch_p50, 3),
             "cpu_per_query_p50_ms": round(cpu_per_query, 3),
             "int_domain_parity": parity,
-            "f64_boundary_rows": boundary_rows,
+            "f64_boundary_rows": int(np.abs(cpu_counts_int - cpu_counts_f64).sum()),
             "total_hits": int(counts.sum()),
             "build_seconds": round(build_s, 2),
         },
     }
-    assert parity, (
-        "TPU counts diverge from int-domain CPU referee: "
-        f"{counts.tolist()} vs {cpu_counts_int.tolist()}"
+
+
+# ---------------------------------------------------------------------------
+# Config 1: Z2 point BBOX-only queries (GDELT-1M, GeoCQEngine role)
+# ---------------------------------------------------------------------------
+
+def bench_z2():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel.query import make_batched_count_step
+
+    N = _n(1_000_000)
+    lon, lat, t_ms = synth_gdelt(N)
+    mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s, true_n = (
+        _sharded_store(lon, lat, t_ms)
     )
+    step = make_batched_count_step(mesh)
+    boxes_f64, _ = make_queries(Q)
+    # time window = everything: bbox-only semantics through the fused step
+    all_time = [(T0 - 1, T0 + (SPAN_DAYS + 1) * 86_400_000)] * Q
+    qboxes, qtimes = _pack_queries(boxes_f64, all_time, binned, nlon, nlat)
+    dev_boxes = jnp.asarray(qboxes)
+    dev_times = jnp.asarray(qtimes)
+
+    def run_batch():
+        return np.asarray(
+            step(cols["x"], cols["y"], cols["bins"], cols["offs"],
+                 true_n, dev_boxes, dev_times)
+        )
+
+    counts = run_batch()
+    tpu_per_query = _p50(run_batch) / Q
+
+    cpu_times = []
+    cpu_counts = np.zeros(Q, dtype=np.int64)
+    for rep in range(2):
+        s = time.perf_counter()
+        for qi, (x1, y1, x2, y2) in enumerate(boxes_f64):
+            cpu_counts[qi] = int(
+                ((lon >= x1) & (lon <= x2) & (lat >= y1) & (lat <= y2)).sum()
+            )
+        cpu_times.append((time.perf_counter() - s) * 1e3)
+    cpu_per_query = float(np.percentile(cpu_times, 50)) / Q
+
+    cpu_int = np.zeros(Q, dtype=np.int64)
+    for qi in range(Q):
+        bx = qboxes[qi, 0]
+        cpu_int[qi] = int(
+            ((xi >= bx[0]) & (xi <= bx[1]) & (yi >= bx[2]) & (yi <= bx[3])).sum()
+        )
+    assert (counts.astype(np.int64) == cpu_int).all()
+    return {
+        "metric": "gdelt_z2_bbox_batched_query_p50_latency",
+        "value": round(tpu_per_query, 4),
+        "unit": "ms/query",
+        "vs_baseline": round(cpu_per_query / tpu_per_query, 2),
+        "detail": {
+            "n_points": N, "n_queries": Q, "devices": jax.device_count(),
+            "cpu_per_query_p50_ms": round(cpu_per_query, 4),
+            "int_domain_parity": True,
+            "f64_boundary_rows": int(np.abs(cpu_int - cpu_counts).sum()),
+            "build_seconds": round(build_s, 2),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config 3: density heatmap + KNN over 100M points
+# ---------------------------------------------------------------------------
+
+def bench_knn_density():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel.query import (
+        make_batched_count_step,
+        make_batched_density_step,
+    )
+
+    N = _n(100_000_000)
+    K = int(os.environ.get("GEOMESA_BENCH_K", 10))
+    qd = min(Q, 16)
+    lon, lat, t_ms = synth_gdelt(N)
+    mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s, true_n = (
+        _sharded_store(lon, lat, t_ms)
+    )
+    dstep = make_batched_density_step(mesh, width=256, height=256)
+    cstep = make_batched_count_step(mesh)
+
+    boxes_f64, windows = make_queries(qd)
+    qboxes, qtimes = _pack_queries(boxes_f64, windows, binned, nlon, nlat)
+    gb = np.stack([qboxes[i, 0] for i in range(qd)])  # xmin xmax ymin ymax int
+    dev_boxes = jnp.asarray(qboxes)
+    dev_times = jnp.asarray(qtimes)
+    dev_gb = jnp.asarray(gb)
+
+    def run_density():
+        return np.asarray(
+            dstep(cols["x"], cols["y"], cols["bins"], cols["offs"],
+                  true_n, dev_boxes, dev_times, dev_gb)
+        )
+
+    grids = run_density()
+    density_p50 = _p50(run_density, iters=max(5, ITERS // 2)) / qd
+
+    # parity: grid mass == count of the same query
+    counts = np.asarray(
+        cstep(cols["x"], cols["y"], cols["bins"], cols["offs"],
+              true_n, dev_boxes, dev_times)
+    )
+    assert np.allclose(grids.sum(axis=(1, 2)), counts), (grids.sum(axis=(1, 2)), counts)
+
+    # KNN: expanding-window device counts until >= K candidates (the
+    # KNearestNeighborSearchProcess shape, window scans on-device)
+    all_time = [(T0 - 1, T0 + (SPAN_DAYS + 1) * 86_400_000)]
+
+    def knn_once(cx, cy):
+        r = 0.25
+        while True:
+            b, t = _pack_queries([(cx - r, cy - r, cx + r, cy + r)], all_time, binned, nlon, nlat)
+            c = int(np.asarray(
+                cstep(cols["x"], cols["y"], cols["bins"], cols["offs"],
+                      true_n, jnp.asarray(b), jnp.asarray(t))
+            )[0])
+            if c >= K or r >= 45.0:
+                return c, r
+            r *= 2.0
+
+    rng = np.random.default_rng(3)
+    knn_pts = [CITIES[rng.integers(0, len(CITIES))] + rng.normal(0, 1, 2) for _ in range(8)]
+    s = time.perf_counter()
+    knn_results = [knn_once(float(p[0]), float(p[1])) for p in knn_pts]
+    knn_p50 = (time.perf_counter() - s) * 1e3 / len(knn_pts)
+
+    # CPU density baseline on identical queries
+    s = time.perf_counter()
+    for qi, ((x1, y1, x2, y2), (lo, hi)) in enumerate(zip(boxes_f64, windows)):
+        m = ((lon >= x1) & (lon <= x2) & (lat >= y1) & (lat <= y2)
+             & (t_ms >= lo) & (t_ms <= hi))
+        np.histogram2d(lat[m], lon[m], bins=[256, 256],
+                       range=[[y1, y2], [x1, x2]])
+    cpu_density = (time.perf_counter() - s) * 1e3 / qd
+
+    return {
+        "metric": "density_256x256_p50_latency_100m",
+        "value": round(density_p50, 4),
+        "unit": "ms/query",
+        "vs_baseline": round(cpu_density / density_p50, 2),
+        "detail": {
+            "n_points": N, "devices": jax.device_count(),
+            "knn_p50_ms": round(knn_p50, 3),
+            "knn_k": K,
+            "knn_all_reached_k": all(c >= K for c, _ in knn_results),
+            "cpu_density_p50_ms": round(cpu_density, 3),
+            "grid_mass_parity": True,
+            "build_seconds": round(build_s, 2),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config 4: ST_Within spatial join, points × polygons
+# ---------------------------------------------------------------------------
+
+def bench_join():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.geometry.types import Polygon
+    from geomesa_tpu.ops.join import pack_polygons, points_in_polygons_count
+
+    N = _n(5_000_000)
+    K = int(os.environ.get("GEOMESA_BENCH_K", 128))
+    lon, lat, _ = synth_gdelt(N)
+    rng = np.random.default_rng(5)
+    polys = []
+    for _i in range(K):
+        cx, cy = CITIES[rng.integers(0, len(CITIES))] + rng.normal(0, 4, 2)
+        w, h = rng.uniform(0.5, 4.0, 2)
+        # convex-ish star blob around a city center
+        ang = np.sort(rng.uniform(0, 2 * np.pi, 12))
+        rad = rng.uniform(0.3, 1.0, 12)
+        ring = np.stack([cx + w * rad * np.cos(ang), cy + h * rad * np.sin(ang)], 1)
+        polys.append(Polygon(ring))
+    verts, bbox, nverts = pack_polygons(polys, max_vertices=16)
+
+    x = jnp.asarray(lon.astype(np.float32))
+    y = jnp.asarray(lat.astype(np.float32))
+    dverts = jnp.asarray(verts)
+    dbbox = jnp.asarray(bbox)
+    counted = jax.jit(points_in_polygons_count)
+
+    def run():
+        return np.asarray(counted(x, y, dverts, dbbox))
+
+    counts = run()
+    tpu_ms = _p50(run, iters=max(5, ITERS // 2))
+    pairs_per_s = N * K / (tpu_ms / 1e3)
+
+    # CPU baseline on a sample, extrapolated per-pair (full brute force at
+    # N×K would take minutes — the reference would run this via Spark)
+    sample = min(N, 200_000)
+    from geomesa_tpu.geometry import predicates as P
+
+    s = time.perf_counter()
+    cpu_counts = np.zeros(K, dtype=np.int64)
+    for ki, p in enumerate(polys):
+        cpu_counts[ki] = int(P.points_within_geom(lon[:sample], lat[:sample], p).sum())
+    cpu_ms_sample = (time.perf_counter() - s) * 1e3
+    cpu_pairs_per_s = sample * K / (cpu_ms_sample / 1e3)
+
+    # parity on the sample: f32 device kernel vs f64 host predicates
+    dev_sample = np.asarray(counted(
+        jnp.asarray(lon[:sample].astype(np.float32)),
+        jnp.asarray(lat[:sample].astype(np.float32)), dverts, dbbox))
+    mismatch = int(np.abs(dev_sample.astype(np.int64) - cpu_counts).sum())
+    return {
+        "metric": "st_within_join_throughput",
+        "value": round(pairs_per_s / 1e9, 4),
+        "unit": "Gpairs/s",
+        "vs_baseline": round(pairs_per_s / cpu_pairs_per_s, 2),
+        "detail": {
+            "n_points": N, "n_polygons": K, "devices": jax.device_count(),
+            "tpu_batch_ms": round(tpu_ms, 2),
+            "cpu_pairs_per_s": round(cpu_pairs_per_s / 1e6, 3),
+            "f32_boundary_mismatch_rows": mismatch,
+            "mismatch_fraction": round(mismatch / (sample * K), 9),
+            "total_hits": int(counts.sum()),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config 5: XZ2 bbox queries over linestring trajectories
+# ---------------------------------------------------------------------------
+
+def bench_xz2():
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu import native
+    from geomesa_tpu.curve.xz import xz2_sfc
+    from geomesa_tpu.parallel.mesh import make_mesh, shard_columns
+    from geomesa_tpu.parallel.query import make_batched_overlap_step
+
+    M = _n(1_000_000)  # number of trajectories
+    rng = np.random.default_rng(9)
+    # GPS-track bounding boxes: short tracks clustered around cities
+    which = rng.integers(0, len(CITIES), M)
+    cx = CITIES[which, 0] + rng.normal(0, 3.0, M)
+    cy = CITIES[which, 1] + rng.normal(0, 2.0, M)
+    w = rng.exponential(0.05, M)
+    h = rng.exponential(0.05, M)
+    xmin = np.clip(cx - w, -180, 180)
+    xmax = np.clip(cx + w, -180, 180)
+    ymin = np.clip(cy - h, -90, 90)
+    ymax = np.clip(cy + h, -90, 90)
+
+    # build: xz2 codes order the store (curve-local rows stay HBM-adjacent);
+    # the scan itself is the fused device overlap pass over int-domain bounds
+    sfc = xz2_sfc(12)
+    nlon, nlat = norm_lon(31), norm_lat(31)
+    t_build = time.perf_counter()
+    codes = sfc.index((xmin, ymin), (xmax, ymax))
+    perm = native.sort_u64(codes)
+    cols_np = {
+        "xmin": nlon.normalize(xmin)[perm].astype(np.int32),
+        "ymin": nlat.normalize(ymin)[perm].astype(np.int32),
+        "xmax": nlon.normalize(xmax)[perm].astype(np.int32),
+        "ymax": nlat.normalize(ymax)[perm].astype(np.int32),
+    }
+    build_s = time.perf_counter() - t_build
+    mesh = make_mesh()
+    cols, padded, rows_per_shard = shard_columns(mesh, cols_np)
+    step = make_batched_overlap_step(mesh)
+
+    boxes_f64, _ = make_queries(Q)
+    qboxes = np.stack(
+        [
+            pack_boxes(
+                np.array(
+                    [[int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+                      int(nlat.normalize(y1)), int(nlat.normalize(y2))]],
+                    dtype=np.int32,
+                )
+            )
+            for x1, y1, x2, y2 in boxes_f64
+        ]
+    )
+    dev_boxes = jnp.asarray(qboxes)
+    true_n = jnp.int32(M)
+
+    def run_batch():
+        return np.asarray(
+            step(cols["xmin"], cols["ymin"], cols["xmax"], cols["ymax"],
+                 true_n, dev_boxes)
+        )
+
+    counts = run_batch()
+    xz_per_query = _p50(run_batch) / Q
+
+    s = time.perf_counter()
+    cpu_counts = []
+    for x1, y1, x2, y2 in boxes_f64:
+        m = (xmin <= x2) & (xmax >= x1) & (ymin <= y2) & (ymax >= y1)
+        cpu_counts.append(int(m.sum()))
+    cpu_per_query = (time.perf_counter() - s) * 1e3 / Q
+
+    # parity in the int domain (f64 boundary rows reported separately)
+    ixmin, iymin = cols_np["xmin"], cols_np["ymin"]
+    ixmax, iymax = cols_np["xmax"], cols_np["ymax"]
+    cpu_int = []
+    for qi in range(Q):
+        b = qboxes[qi, 0]
+        m = (ixmin <= b[1]) & (ixmax >= b[0]) & (iymin <= b[3]) & (iymax >= b[2])
+        cpu_int.append(int(m.sum()))
+    assert counts.astype(np.int64).tolist() == cpu_int, (counts, cpu_int)
+    return {
+        "metric": "xz2_linestring_bbox_query_p50_latency",
+        "value": round(xz_per_query, 4),
+        "unit": "ms/query",
+        "vs_baseline": round(cpu_per_query / xz_per_query, 2),
+        "detail": {
+            "n_trajectories": M, "n_queries": Q, "devices": jax.device_count(),
+            "cpu_per_query_ms": round(cpu_per_query, 4),
+            "int_domain_parity": True,
+            "f64_boundary_rows": int(np.abs(np.array(cpu_int) - np.array(cpu_counts)).sum()),
+            "build_seconds": round(build_s, 2),
+        },
+    }
+
+
+BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,
+           "4": bench_join, "5": bench_xz2}
+
+
+def main():
+    result = BENCHES[CONFIG]()
     print(json.dumps(result))
 
 

@@ -1,0 +1,100 @@
+"""GPX (GPS exchange XML) trajectory converter + predefined OSM-GPX schema.
+
+Role parity: the reference ships predefined SFTs/converters for public
+datasets incl. OSM GPX traces (``geomesa-tools/conf/sfts/`` — SURVEY.md
+§2.16), with XML parsed via its xpath converter module. The OSM-GPX planet
+dump is the BASELINE config-5 trajectory workload: here each ``<trk>``
+becomes a LineString feature (timestamped by its first fix) and, optionally,
+each ``<trkpt>`` a point feature — the two shapes the XZ2 and Z3 indexes
+want.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import LineString, Point
+from geomesa_tpu.schema.columnar import FeatureTable, _to_millis
+from geomesa_tpu.schema.sft import parse_spec
+
+GPX_TRACK_SPEC = (
+    "trackId:String:index=true,name:String,nPoints:Integer,dtg:Date,"
+    "*geom:LineString;geomesa.xz.precision='12'"
+)
+GPX_POINT_SPEC = (
+    "trackId:String:index=true,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+)
+
+
+def gpx_track_sft(name: str = "gpx_tracks"):
+    return parse_spec(name, GPX_TRACK_SPEC)
+
+
+def gpx_point_sft(name: str = "gpx_points"):
+    return parse_spec(name, GPX_POINT_SPEC)
+
+
+def _local(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def parse_gpx(source, as_points: bool = False) -> FeatureTable:
+    """Parse GPX text/path → FeatureTable of tracks (or track points).
+
+    Namespace-agnostic (GPX 1.0/1.1). Tracks without a timestamp get dtg
+    null; tracks with < 2 fixes are skipped in LineString mode.
+    """
+    if isinstance(source, str) and source.lstrip().startswith("<"):
+        root = ET.fromstring(source)
+    else:
+        root = ET.parse(source).getroot()
+
+    tracks = []
+    for ti, trk in enumerate(el for el in root.iter() if _local(el.tag) == "trk"):
+        name = None
+        pts = []
+        times = []
+        for el in trk.iter():
+            tag = _local(el.tag)
+            if tag == "name" and name is None:
+                name = (el.text or "").strip() or None
+            elif tag == "trkpt":
+                lat = float(el.get("lat"))
+                lon = float(el.get("lon"))
+                t = None
+                for sub in el:
+                    if _local(sub.tag) == "time" and sub.text:
+                        t = _to_millis(sub.text.strip())
+                pts.append((lon, lat))
+                times.append(t)
+        if pts:
+            tracks.append((f"trk-{ti}", name, pts, times))
+
+    if as_points:
+        sft = gpx_point_sft()
+        recs = []
+        for tid, _name, pts, times in tracks:
+            for (lon, lat), t in zip(pts, times):
+                recs.append({"trackId": tid, "dtg": t, "geom": Point(lon, lat)})
+        return FeatureTable.from_records(sft, recs)
+
+    sft = gpx_track_sft()
+    recs = []
+    fids = []
+    for tid, name, pts, times in tracks:
+        if len(pts) < 2:
+            continue
+        t0 = next((t for t in times if t is not None), None)
+        recs.append(
+            {
+                "trackId": tid,
+                "name": name,
+                "nPoints": len(pts),
+                "dtg": t0,
+                "geom": LineString(np.asarray(pts, dtype=np.float64)),
+            }
+        )
+        fids.append(tid)
+    return FeatureTable.from_records(sft, recs, fids)

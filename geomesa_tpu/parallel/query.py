@@ -171,6 +171,51 @@ def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
+def make_batched_overlap_step(mesh: Mesh):
+    """Extended-geometry (XZ) throughput path: Q bbox-overlap counts over a
+    store of per-feature bounding boxes, psum over data shards.
+
+    fn(xmin, ymin, xmax, ymax, true_n, boxes (Q, B, 4)) → (Q,) int32, where
+    ``boxes`` packs int-domain [qxlo, qxhi, qylo, qyhi] and a row matches
+    when its bbox intersects any of the query's boxes — the XZ2 scan's
+    overlap test (``XZ2SFC.scala`` ranges + per-row refine) as one fused
+    vectorized pass (SURVEY.md §2.20 P4/P5).
+    """
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(QUERY_AXIS, None, None),
+        ),
+        out_specs=P(QUERY_AXIS),
+        check_vma=False,
+    )
+    def step(xmin, ymin, xmax, ymax, true_n, boxes):
+        base = jax.lax.axis_index(DATA_AXIS) * xmin.shape[0]
+        x1 = xmin[None, None, :]
+        y1 = ymin[None, None, :]
+        x2 = xmax[None, None, :]
+        y2 = ymax[None, None, :]
+        overlap = (
+            (x1 <= boxes[:, :, 1, None])
+            & (x2 >= boxes[:, :, 0, None])
+            & (y1 <= boxes[:, :, 3, None])
+            & (y2 >= boxes[:, :, 2, None])
+        ).any(axis=1)
+        rows_valid = (base + jnp.arange(xmin.shape[0], dtype=jnp.int32)) < true_n
+        counts = (overlap & rows_valid[None, :]).sum(axis=1, dtype=jnp.int32)
+        return jax.lax.psum(counts, DATA_AXIS)
+
+    return step
+
+
 def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
     """Q queries full-scan density grids: (Q, H, W) f32, psum over data shards.
 

@@ -103,6 +103,8 @@ class DataStore:
         from geomesa_tpu.utils.timeouts import Watchdog
 
         self.watchdog = Watchdog()
+        # (scope type-name | None, fn(sft, query) -> query) pairs
+        self._interceptors: list[tuple[str | None, Any]] = []
 
     # -- schema CRUD (MetadataBackedDataStore role) --------------------------
     def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
@@ -150,8 +152,7 @@ class DataStore:
         st = self._state(type_name)
         if isinstance(data, list):
             if fids is None:
-                base = st.total_rows
-                fids = [f"{type_name}.{base + i}" for i in range(len(data))]
+                fids = self._generate_fids(st, len(data), data)
             data = FeatureTable.from_records(st.sft, data, fids)
         self._validate(st.sft, data)
         self.metrics.counter("store.writes").inc(len(data))
@@ -159,6 +160,53 @@ class DataStore:
         if st.delta.should_compact(st.main_rows):
             self.compact(type_name)
         return len(data)
+
+    def _generate_fids(self, st, n: int, records: list) -> list:
+        """Default feature ids. Schemas opting in via user-data
+        ``geomesa.fid.uuid='z3'`` get z3-prefixed ids (the reference writer's
+        Z3 time-UUID default, ``GeoMesaFeatureWriter.scala:81``); otherwise
+        sequential ``<type>.<n>`` ids."""
+        sft = st.sft
+        if (
+            str(sft.user_data.get("geomesa.fid.uuid", "")).lower() == "z3"
+            and sft.geom_field is not None
+            and sft.dtg_field is not None
+        ):
+            from geomesa_tpu.schema.columnar import _to_millis
+            from geomesa_tpu.utils.fid import z3_fids
+
+            lons = np.empty(n)
+            lats = np.empty(n)
+            ts = np.empty(n, dtype=np.int64)
+            ok = True
+            for i, r in enumerate(records):
+                g = r.get(sft.geom_field)
+                t = r.get(sft.dtg_field)
+                if g is None or t is None or not hasattr(g, "bbox"):
+                    ok = False
+                    break
+                x1, y1, x2, y2 = g.bbox
+                lons[i] = (x1 + x2) / 2
+                lats[i] = (y1 + y2) / 2
+                ts[i] = _to_millis(t)
+            if ok:
+                return list(z3_fids(lons, lats, ts, sft.z3_interval))
+        base = st.total_rows
+        return [f"{st.sft.name}.{base + i}" for i in range(n)]
+
+    # -- query interceptors (QueryInterceptor.scala:27 role) ------------------
+    def register_interceptor(self, type_name: str | None, fn) -> None:
+        """Register ``fn(sft, query) -> query`` rewriting queries before
+        planning; ``type_name`` None applies to every schema."""
+        self._interceptors.append((type_name, fn))
+
+    def _intercept(self, type_name: str, sft, q: Query) -> Query:
+        for scope, fn in self._interceptors:
+            if scope is None or scope == type_name:
+                out = fn(sft, q)
+                if out is not None:
+                    q = out
+        return q
 
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
@@ -269,6 +317,11 @@ class DataStore:
                 f"{sorted(kwargs)}"
             )
         import time as _time
+
+        # user query-rewrite hooks run before anything else sees the query
+        # (QueryPlanner.scala:178 configureQuery → interceptors)
+        if self._interceptors:
+            q = self._intercept(type_name, st.sft, q)
 
         self.metrics.counter("store.queries").inc()
         if st.total_rows == 0:

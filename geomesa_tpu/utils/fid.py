@@ -1,0 +1,51 @@
+"""Z3-prefixed feature-id generation.
+
+Role parity: ``geomesa-utils/.../uuid/`` Z3 time-UUIDs (332 LoC — SURVEY.md
+§2.18) used by ``GeoMesaFeatureWriter`` id generation
+(``geotools/GeoMesaFeatureWriter.scala:81``): appended features get ids whose
+leading bytes are the feature's coarse z3, so the ID index clusters
+spatially/temporally alongside the Z3 index and id-range scans of co-located
+features stay contiguous. Format here: 16 hex chars of shard+bin+z3 prefix,
+a dash, then 16 random hex chars.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+
+from geomesa_tpu.curve.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curve.sfc import z3_sfc
+
+__all__ = ["z3_fids", "Z3FidGenerator"]
+
+
+def z3_fids(lons, lats, t_ms, period: TimePeriod = TimePeriod.WEEK) -> np.ndarray:
+    """Vectorized z3-prefixed ids for (lon, lat, epoch-ms) arrays."""
+    lons = np.asarray(lons, dtype=np.float64)
+    lats = np.asarray(lats, dtype=np.float64)
+    t_ms = np.asarray(t_ms, dtype=np.int64)
+    binned = BinnedTime(period)
+    bins, offs = binned.to_bin_and_offset(t_ms)
+    z = z3_sfc(period).index(lons, lats, offs)
+    out = np.empty(len(lons), dtype=object)
+    for i in range(len(lons)):
+        prefix = (int(bins[i]) & 0xFFFF) << 48 | (int(z[i]) >> 16)
+        out[i] = f"{prefix:016x}-{secrets.token_hex(8)}"
+    return out
+
+
+class Z3FidGenerator:
+    """Stateful generator for streaming writers (one call per feature)."""
+
+    def __init__(self, period: TimePeriod = TimePeriod.WEEK):
+        self.period = period
+        self.binned = BinnedTime(period)
+        self.sfc = z3_sfc(period)
+
+    def fid(self, lon: float, lat: float, t_ms: int) -> str:
+        (b,), (o,) = self.binned.to_bin_and_offset(np.array([t_ms]))
+        z = int(self.sfc.index(np.array([lon]), np.array([lat]), np.array([o]))[0])
+        prefix = (int(b) & 0xFFFF) << 48 | (z >> 16)
+        return f"{prefix:016x}-{secrets.token_hex(8)}"

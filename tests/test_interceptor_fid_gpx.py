@@ -1,0 +1,144 @@
+"""Query interceptors, z3-prefixed feature ids, GPX converter
+(reference: QueryInterceptor.scala:27, uuid/Z3 time-UUIDs, OSM-GPX configs —
+SURVEY.md §2.3/§2.16/§2.18)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.gpx import gpx_track_sft, parse_gpx
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.utils.fid import Z3FidGenerator, z3_fids
+
+T0 = 1_498_867_200_000
+
+
+class TestInterceptors:
+    def _ds(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        ds.write("t", [
+            {"name": f"n{i % 3}", "dtg": T0 + i, "geom": Point(i, i)}
+            for i in range(30)
+        ])
+        return ds
+
+    def test_rewrite_applies(self):
+        ds = self._ds()
+
+        def only_n1(sft, q):
+            from dataclasses import replace
+
+            return replace(q, filter=ast.And([q.resolved_filter(),
+                                              ast.Compare("=", "name", "n1")]))
+
+        ds.register_interceptor("t", only_n1)
+        assert ds.query("t").count == 10
+
+    def test_scope(self):
+        ds = self._ds()
+        ds.create_schema("u", "name:String,dtg:Date,*geom:Point")
+        ds.write("u", [{"name": "x", "dtg": T0, "geom": Point(0, 0)}])
+        calls = []
+        ds.register_interceptor("u", lambda sft, q: calls.append(sft.name) or q)
+        ds.query("t")
+        assert calls == []
+        ds.query("u")
+        assert calls == ["u"]
+
+    def test_global_interceptor_and_none_return(self):
+        ds = self._ds()
+        seen = []
+        ds.register_interceptor(None, lambda sft, q: seen.append(1) or None)
+        assert ds.query("t").count == 30  # None return leaves query unchanged
+        assert seen == [1]
+
+
+class TestZ3Fids:
+    def test_vectorized_prefix_clusters(self):
+        lons = np.array([10.0, 10.0001, -120.0])
+        lats = np.array([20.0, 20.0001, -45.0])
+        ts = np.array([T0, T0 + 1000, T0], dtype=np.int64)
+        fids = z3_fids(lons, lats, ts)
+        assert len(set(fids)) == 3  # unique (random suffix)
+        # nearby points share a long id prefix; distant ones don't
+        a, b, c = [f.split("-")[0] for f in fids]
+        assert a[:8] == b[:8]
+        # same time bin ⇒ same leading (bin) chars; the z part must differ
+        assert a[4:10] != c[4:10]
+
+    def test_generator_matches_vectorized_prefix(self):
+        gen = Z3FidGenerator()
+        f1 = gen.fid(10.0, 20.0, T0)
+        f2 = z3_fids([10.0], [20.0], [T0])[0]
+        assert f1.split("-")[0] == f2.split("-")[0]
+
+    def test_store_opt_in(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema(
+            "z", "dtg:Date,*geom:Point;geomesa.fid.uuid='z3'"
+        )
+        ds.write("z", [{"dtg": T0 + i, "geom": Point(10 + i * 1e-4, 20.0)}
+                       for i in range(5)])
+        r = ds.query("z")
+        fids = list(r.table.fids)
+        assert all("-" in f and len(f.split("-")[0]) == 16 for f in fids)
+        # co-located features share the coarse-z prefix
+        prefixes = {f[:8] for f in fids}
+        assert len(prefixes) == 1
+
+    def test_store_default_sequential(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema("s", "dtg:Date,*geom:Point")
+        ds.write("s", [{"dtg": T0, "geom": Point(0, 0)}])
+        assert list(ds.query("s").table.fids) == ["s.0"]
+
+
+GPX = """<?xml version="1.0"?>
+<gpx version="1.1" xmlns="http://www.topografix.com/GPX/1/1">
+ <trk><name>morning ride</name><trkseg>
+  <trkpt lat="47.60" lon="-122.33"><time>2017-07-01T08:00:00Z</time></trkpt>
+  <trkpt lat="47.61" lon="-122.32"><time>2017-07-01T08:05:00Z</time></trkpt>
+  <trkpt lat="47.62" lon="-122.31"><time>2017-07-01T08:10:00Z</time></trkpt>
+ </trkseg></trk>
+ <trk><trkseg>
+  <trkpt lat="40.0" lon="-74.0"/>
+  <trkpt lat="40.1" lon="-74.1"/>
+ </trkseg></trk>
+ <trk><trkseg>
+  <trkpt lat="1.0" lon="1.0"/>
+ </trkseg></trk>
+</gpx>"""
+
+
+class TestGpx:
+    def test_tracks(self):
+        t = parse_gpx(GPX)
+        # 1-point track dropped in LineString mode
+        assert len(t) == 2
+        r0 = t.record(0)
+        assert r0["name"] == "morning ride"
+        assert r0["nPoints"] == 3
+        assert r0["dtg"] == 1_498_896_000_000  # 2017-07-01T08:00Z
+        assert r0["geom"].coords.shape == (3, 2)
+        assert t.record(1)["dtg"] is None
+
+    def test_points_mode(self):
+        t = parse_gpx(GPX, as_points=True)
+        assert len(t) == 6
+        assert t.record(0)["geom"].x == pytest.approx(-122.33)
+
+    def test_ingest_into_store(self):
+        from geomesa_tpu.convert.validate import apply_validators
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema(gpx_track_sft())
+        # drop timestampless tracks before write (the SimpleFeatureValidator
+        # gate — the store rejects null indexed dates)
+        table = apply_validators(parse_gpx(GPX), ("index",))
+        ds.write("gpx_tracks", table)
+        r = ds.query("gpx_tracks", "BBOX(geom, -123, 47, -122, 48)")
+        assert r.count == 1
+        assert r.table.record(0)["name"] == "morning ride"
