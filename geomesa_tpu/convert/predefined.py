@@ -1,0 +1,114 @@
+"""Predefined schemas + converters for common public datasets.
+
+Role parity: ``geomesa-tools/conf/sfts/`` (SURVEY.md §2.16) — the reference
+ships ready-made SFTs/converters for GDELT, GeoLife, OSM, NYC taxi, T-Drive,
+Twitter, marine-cadastre AIS, …; users ingest with ``--converter <name>``
+instead of writing field mappings. The registry here mirrors the high-traffic
+ones; GDELT (:mod:`geomesa_tpu.convert.gdelt`) and OSM-GPX
+(:mod:`geomesa_tpu.convert.gpx`) have dedicated modules.
+"""
+
+from __future__ import annotations
+
+from geomesa_tpu.convert.delimited import DelimitedConverter
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+
+__all__ = ["predefined_sft", "predefined_converter", "PREDEFINED"]
+
+# GeoLife trajectory points (plt files: lat, lon, 0, alt, days, date, time)
+GEOLIFE_SPEC = "userId:String:index=true,altitude:Double,dtg:Date,*geom:Point;geomesa.z3.interval='month'"
+
+# NYC yellow taxi trips (2015-era CSV: pickup side)
+NYCTAXI_SPEC = (
+    "tripId:String,passengers:Integer,distance:Double,totalAmount:Double,"
+    "dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+)
+
+# T-Drive Beijing taxi traces (taxi id, datetime, lon, lat)
+TDRIVE_SPEC = "taxiId:String:index=true,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+
+# Twitter sample (id, user, text, created_at, lon, lat)
+TWITTER_SPEC = (
+    "userId:String:index=true,text:String,dtg:Date,*geom:Point;"
+    "geomesa.z3.interval='day'"
+)
+
+# Marine-cadastre AIS broadcast points
+AIS_SPEC = (
+    "mmsi:String:index=true,sog:Double,cog:Double,heading:Double,"
+    "dtg:Date,*geom:Point;geomesa.z3.interval='day'"
+)
+
+PREDEFINED: dict[str, dict] = {
+    "geolife": {
+        "spec": GEOLIFE_SPEC,
+        "delimiter": ",",
+        "fields": {
+            "userId": "$8",  # caller appends a user-id column when batching files
+            "altitude": "double($4)",
+            "dtg": "date('%Y-%m-%d %H:%M:%S', concat($6, ' ', $7))",
+            "geom": "point($2, $1)",
+        },
+    },
+    "tdrive": {
+        "spec": TDRIVE_SPEC,
+        "delimiter": ",",
+        "fields": {
+            "taxiId": "$1",
+            "dtg": "date('%Y-%m-%d %H:%M:%S', $2)",
+            "geom": "point($3, $4)",
+        },
+        "id_field": "concat($1, '-', $0)",
+    },
+    "twitter": {
+        "spec": TWITTER_SPEC,
+        "delimiter": "\t",
+        "fields": {
+            "userId": "$2",
+            "text": "$3",
+            "dtg": "isodate($4)",
+            "geom": "point($5, $6)",
+        },
+        "id_field": "$1",
+    },
+    "nyctaxi": {
+        "spec": NYCTAXI_SPEC,
+        "delimiter": ",",
+        "fields": {
+            "tripId": "$1",
+            "dtg": "date('%Y-%m-%d %H:%M:%S', $2)",
+            "passengers": "int($4)",
+            "distance": "double($5)",
+            "totalAmount": "double($6)",
+            "geom": "point($7, $8)",
+        },
+        "id_field": "$1",
+    },
+    "marinecadastre-ais": {
+        "spec": AIS_SPEC,
+        "delimiter": ",",
+        "fields": {
+            "mmsi": "$1",
+            "dtg": "isodate($2)",
+            "sog": "double($5)",
+            "cog": "double($6)",
+            "heading": "double($7)",
+            "geom": "point($3, $4)",
+        },
+    },
+}
+
+
+def predefined_sft(name: str, type_name: str | None = None) -> FeatureType:
+    cfg = PREDEFINED[name]
+    return parse_spec(type_name or name.replace("-", "_"), cfg["spec"])
+
+
+def predefined_converter(name: str, type_name: str | None = None) -> DelimitedConverter:
+    cfg = PREDEFINED[name]
+    return DelimitedConverter(
+        predefined_sft(name, type_name),
+        fields=cfg["fields"],
+        id_field=cfg.get("id_field"),
+        delimiter=cfg["delimiter"],
+    )

@@ -222,6 +222,9 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
     ``grid_bounds``: (Q, 4) int32 [xlo, xhi, ylo, yhi] per query.
     """
 
+    use_mxu = jax.default_backend() == "tpu"
+    chunk = 8192  # one-hot chunks: 2 × (chunk × 256) bf16 ≈ 8 MB VMEM
+
     @jax.jit
     @partial(
         shard_map,
@@ -255,9 +258,38 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
             cx = jnp.clip(((xi - xlo) * sx).astype(jnp.int32), 0, width - 1)
             cy = jnp.clip(((yi - ylo) * sy).astype(jnp.int32), 0, height - 1)
             w = mask_q.astype(jnp.float32)
-            flat = jnp.zeros(width * height, dtype=jnp.float32)
-            flat = flat.at[cy * width + cx].add(w)
-            return flat.reshape(height, width)
+            if not use_mxu:
+                flat = jnp.zeros(width * height, dtype=jnp.float32)
+                flat = flat.at[cy * width + cx].add(w)
+                return flat.reshape(height, width)
+
+            # MXU path: grid = Σ_chunks one_hot(cy)ᵀ · (w ⊙ one_hot(cx)) —
+            # the histogram as bf16 matmuls with f32 accumulation (exact for
+            # counts < 2^24), which beats TPU scatter by an order of
+            # magnitude. Masked-out rows get weight 0.
+            n = cx.shape[0]
+            k = -(-n // chunk)
+            pad = k * chunk - n
+            cxp = jnp.pad(cx, (0, pad)).reshape(k, chunk)
+            cyp = jnp.pad(cy, (0, pad)).reshape(k, chunk)
+            wp = jnp.pad(w, (0, pad)).reshape(k, chunk)
+
+            def body(acc, args):
+                cxc, cyc, wc = args
+                rows = jax.nn.one_hot(cyc, height, dtype=jnp.bfloat16)
+                cols = jax.nn.one_hot(cxc, width, dtype=jnp.bfloat16)
+                rows = rows * wc.astype(jnp.bfloat16)[:, None]
+                part = jax.lax.dot_general(
+                    rows, cols,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return acc + part, None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.zeros((height, width), jnp.float32), (cxp, cyp, wp)
+            )
+            return acc
 
         grids = jax.vmap(one)(m, grid_bounds)  # (Ql, H, W)
         return jax.lax.psum(grids, DATA_AXIS)

@@ -1,0 +1,5 @@
+"""Geohash-keyed raster tile storage + mosaicing."""
+
+from geomesa_tpu.raster.store import RasterStore
+
+__all__ = ["RasterStore"]

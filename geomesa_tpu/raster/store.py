@@ -1,0 +1,127 @@
+"""Raster tile store: geohash-keyed chips + bbox mosaic queries.
+
+Role parity: ``geomesa-accumulo-raster/.../AccumuloRasterStore.scala`` (370
+LoC — SURVEY.md §2.6): the reference keys raster chips by geohash at a
+resolution chosen per chip, scans the geohash range covering a query bbox,
+and mosaics the chips client-side. Here chips are numpy arrays keyed the
+same way; the mosaic assembly is vectorized paste into the target grid
+(nearest-neighbor resample), and the geohash cover reuses the shared geohash
+module (``utils/geohash`` role).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.spatial.geohash import geohash_bbox, geohash_encode
+
+__all__ = ["RasterStore"]
+
+
+def encode(lon: float, lat: float, precision_chars: int) -> str:
+    return str(geohash_encode(np.array([lon]), np.array([lat]), precision_chars)[0])
+
+
+def _covering_hashes(x1, y1, x2, y2, precision_chars: int) -> list[str]:
+    """Geohash cells (at a fixed character precision) covering a bbox."""
+    # cell sizes at `precision_chars` characters
+    seed = encode(min(max(x1, -180), 180), min(max(y1, -90), 90), precision_chars)
+    gx1, gy1, gx2, gy2 = geohash_bbox(seed)
+    dx = gx2 - gx1
+    dy = gy2 - gy1
+    out = []
+    y = y1
+    while True:
+        x = x1
+        while True:
+            out.append(encode(min(max(x, -180), 179.9999999), min(max(y, -90), 89.9999999), precision_chars))
+            x += dx
+            if x >= x2 + dx * 0.5 or x > 180:
+                break
+        y += dy
+        if y >= y2 + dy * 0.5 or y > 90:
+            break
+    # dedupe, stable order
+    seen = set()
+    uniq = []
+    for h in out:
+        if h not in seen:
+            seen.add(h)
+            uniq.append(h)
+    return uniq
+
+
+class RasterStore:
+    """Chips stored per (geohash cell, resolution level).
+
+    ``put(array, bbox)`` registers a chip covering ``bbox`` (lon/lat); the
+    store picks the geohash precision whose cell best matches the chip
+    footprint. ``mosaic(bbox, width, height)`` assembles the best-resolution
+    chips into one (height, width) array.
+    """
+
+    def __init__(self):
+        # precision -> {geohash: (chip, bbox)}
+        self.levels: dict[int, dict[str, tuple[np.ndarray, tuple]]] = {}
+
+    @staticmethod
+    def _precision_for(w_deg: float) -> int:
+        # geohash lon cell widths by char count: 45, 11.25, 1.41, 0.35, ...
+        widths = {1: 45.0, 2: 11.25, 3: 1.40625, 4: 0.3515625,
+                  5: 0.0439453125, 6: 0.010986328125}
+        best = min(widths, key=lambda p: abs(widths[p] - w_deg))
+        return best
+
+    def put(self, chip: np.ndarray, bbox: tuple) -> str:
+        x1, y1, x2, y2 = bbox
+        p = self._precision_for(x2 - x1)
+        h = encode((x1 + x2) / 2, (y1 + y2) / 2, p)
+        self.levels.setdefault(p, {})[h] = (np.asarray(chip), (x1, y1, x2, y2))
+        return h
+
+    def count(self) -> int:
+        return sum(len(v) for v in self.levels.values())
+
+    def chips_for(self, bbox: tuple) -> list[tuple[np.ndarray, tuple]]:
+        """Chips intersecting a bbox, finest resolution level first."""
+        x1, y1, x2, y2 = bbox
+        out = []
+        for p in sorted(self.levels, reverse=True):
+            tiles = self.levels[p]
+            for h in _covering_hashes(x1, y1, x2, y2, p):
+                hit = tiles.get(h)
+                if hit is None:
+                    continue
+                _, (cx1, cy1, cx2, cy2) = hit
+                if cx1 <= x2 and cx2 >= x1 and cy1 <= y2 and cy2 >= y1:
+                    out.append(hit)
+        return out
+
+    def mosaic(self, bbox: tuple, width: int, height: int) -> np.ndarray:
+        """Assemble chips into one grid (row 0 = south edge, like density
+        grids); coarser chips fill only where finer ones haven't."""
+        x1, y1, x2, y2 = bbox
+        out = np.zeros((height, width), dtype=np.float64)
+        filled = np.zeros((height, width), dtype=bool)
+        px = (x2 - x1) / width
+        py = (y2 - y1) / height
+        for chip, (cx1, cy1, cx2, cy2) in self.chips_for(bbox):
+            ch, cw = chip.shape[:2]
+            # target pixel window covered by this chip
+            jx1 = max(0, int(np.floor((cx1 - x1) / px)))
+            jx2 = min(width, int(np.ceil((cx2 - x1) / px)))
+            jy1 = max(0, int(np.floor((cy1 - y1) / py)))
+            jy2 = min(height, int(np.ceil((cy2 - y1) / py)))
+            if jx2 <= jx1 or jy2 <= jy1:
+                continue
+            # nearest-neighbor sample chip at the target pixel centers
+            xs = x1 + (np.arange(jx1, jx2) + 0.5) * px
+            ys = y1 + (np.arange(jy1, jy2) + 0.5) * py
+            sx = np.clip(((xs - cx1) / (cx2 - cx1) * cw).astype(int), 0, cw - 1)
+            sy = np.clip(((ys - cy1) / (cy2 - cy1) * ch).astype(int), 0, ch - 1)
+            window = chip[np.ix_(sy, sx)]
+            tgt = out[jy1:jy2, jx1:jx2]
+            mask = ~filled[jy1:jy2, jx1:jx2]
+            tgt[mask] = window[mask]
+            filled[jy1:jy2, jx1:jx2] |= True
+        return out

@@ -1,0 +1,86 @@
+"""Raster tile store + predefined dataset converters (reference:
+AccumuloRasterStore, geomesa-tools/conf/sfts — SURVEY.md §2.6/§2.16)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.predefined import (
+    PREDEFINED,
+    predefined_converter,
+    predefined_sft,
+)
+from geomesa_tpu.raster import RasterStore
+
+
+class TestRasterStore:
+    def test_put_and_mosaic_single(self):
+        rs = RasterStore()
+        chip = np.arange(64, dtype=np.float64).reshape(8, 8)
+        rs.put(chip, (0.0, 0.0, 1.40625, 1.40625))  # ~3-char geohash cell
+        assert rs.count() == 1
+        out = rs.mosaic((0.0, 0.0, 1.40625, 1.40625), 8, 8)
+        np.testing.assert_array_equal(out, chip)
+
+    def test_mosaic_of_adjacent_chips(self):
+        rs = RasterStore()
+        left = np.full((4, 4), 1.0)
+        right = np.full((4, 4), 2.0)
+        w = 1.40625
+        rs.put(left, (0.0, 0.0, w, w))
+        rs.put(right, (w, 0.0, 2 * w, w))
+        out = rs.mosaic((0.0, 0.0, 2 * w, w), 8, 4)
+        assert np.all(out[:, :4] == 1.0)
+        assert np.all(out[:, 4:] == 2.0)
+
+    def test_finer_chips_win(self):
+        rs = RasterStore()
+        coarse = np.full((4, 4), 9.0)
+        fine = np.full((4, 4), 5.0)
+        rs.put(coarse, (0.0, 0.0, 11.25, 11.25))  # 2-char cell
+        rs.put(fine, (0.0, 0.0, 1.40625, 1.40625))  # 3-char cell inside it
+        out = rs.mosaic((0.0, 0.0, 11.25, 11.25), 16, 16)
+        # the fine chip covers the lower-left corner of the target
+        assert out[0, 0] == 5.0
+        assert out[-1, -1] == 9.0
+
+    def test_empty_region(self):
+        rs = RasterStore()
+        rs.put(np.ones((2, 2)), (0.0, 0.0, 1.40625, 1.40625))
+        out = rs.mosaic((100.0, 40.0, 101.0, 41.0), 4, 4)
+        assert np.all(out == 0)
+
+
+class TestPredefined:
+    def test_all_specs_parse(self):
+        for name in PREDEFINED:
+            sft = predefined_sft(name)
+            assert sft.geom_field == "geom"
+            assert sft.dtg_field == "dtg"
+
+    def test_tdrive_roundtrip(self):
+        conv = predefined_converter("tdrive")
+        t = conv.convert_frame(
+            __import__("pandas").DataFrame(
+                [
+                    ["1131", "2008-02-02 13:33:52", "116.36", "39.88"],
+                    ["1131", "2008-02-02 13:38:52", "116.37", "39.89"],
+                ],
+                dtype=str,
+            )
+        )
+        assert len(t) == 2
+        assert t.record(0)["taxiId"] == "1131"
+        assert t.record(0)["geom"].x == pytest.approx(116.36)
+        assert list(t.fids) == ["1131-0", "1131-1"]
+
+    def test_twitter_converter(self):
+        conv = predefined_converter("twitter")
+        t = conv.convert_frame(
+            __import__("pandas").DataFrame(
+                [["42", "u1", "hello world", "2017-07-01T00:00:00Z", "-74.0", "40.7"]],
+                dtype=str,
+            )
+        )
+        assert t.record(0)["userId"] == "u1"
+        assert t.record(0)["dtg"] == 1_498_867_200_000
+        assert list(t.fids) == ["42"]
