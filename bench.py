@@ -123,6 +123,8 @@ def _sharded_store(lon, lat, t_ms, period=PERIOD):
 
 
 def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
+    # every bench query is one box + one window: slots=1 makes the device
+    # kernels evaluate exactly one slot instead of MAX_BOXES/MAX_TIMES
     qboxes = np.stack(
         [
             pack_boxes(
@@ -130,7 +132,8 @@ def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
                     [[int(nlon.normalize(x1)), int(nlon.normalize(x2)),
                       int(nlat.normalize(y1)), int(nlat.normalize(y2))]],
                     dtype=np.int32,
-                )
+                ),
+                slots=1,
             )
             for x1, y1, x2, y2 in boxes_f64
         ]
@@ -139,7 +142,9 @@ def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
     for lo, hi in windows_ms:
         (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
         (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
-        qtimes.append(pack_times(np.array([[blo, olo, bhi, ohi]], dtype=np.int32)))
+        qtimes.append(
+            pack_times(np.array([[blo, olo, bhi, ohi]], dtype=np.int32), slots=1)
+        )
     return qboxes, np.stack(qtimes)
 
 

@@ -69,8 +69,10 @@ def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
     valid = ((lpos < local_n) & (base + lpos < true_n))[None]  # (1, BR, L)
 
     q = boxes_ref.shape[0]
+    # slot counts come from the packed shapes (compile-time): single-box /
+    # single-window batches pay for exactly one slot, not MAX_BOXES/MAX_TIMES
     in_box = jnp.zeros((q, block_rows, LANES), dtype=jnp.bool_)
-    for k in range(MAX_BOXES):
+    for k in range(boxes_ref.shape[1] // 4):
         xlo = boxes_ref[:, 4 * k + 0][:, None, None]
         xhi = boxes_ref[:, 4 * k + 1][:, None, None]
         ylo = boxes_ref[:, 4 * k + 2][:, None, None]
@@ -78,7 +80,7 @@ def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
         in_box |= (x >= xlo) & (x <= xhi) & (y >= ylo) & (y <= yhi)
 
     in_time = jnp.zeros((q, block_rows, LANES), dtype=jnp.bool_)
-    for k in range(MAX_TIMES):
+    for k in range(times_ref.shape[1] // 4):
         blo = times_ref[:, 4 * k + 0][:, None, None]
         olo = times_ref[:, 4 * k + 1][:, None, None]
         bhi = times_ref[:, 4 * k + 2][:, None, None]
@@ -134,8 +136,10 @@ def batched_count(x, y, bins, offs, base, true_n, boxes, times, *,
     nfo = jnp.stack([jnp.asarray(base, jnp.int32),
                      jnp.asarray(true_n, jnp.int32),
                      jnp.asarray(n, jnp.int32)]).reshape(1, 3)
-    boxes2 = boxes.reshape(q, MAX_BOXES * 4)
-    times2 = times.reshape(q, MAX_TIMES * 4)
+    nb4 = boxes.shape[1] * 4
+    nt4 = times.shape[1] * 4
+    boxes2 = boxes.reshape(q, nb4)
+    times2 = times.reshape(q, nt4)
 
     grid = padded // tile
     col_spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
@@ -149,9 +153,9 @@ def batched_count(x, y, bins, offs, base, true_n, boxes, times, *,
             in_specs=[
                 pl.BlockSpec((1, 3), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
-                pl.BlockSpec((q, MAX_BOXES * 4), lambda i: (0, 0),
+                pl.BlockSpec((q, nb4), lambda i: (0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((q, MAX_TIMES * 4), lambda i: (0, 0),
+                pl.BlockSpec((q, nt4), lambda i: (0, 0),
                              memory_space=pltpu.VMEM),
                 col_spec, col_spec, col_spec, col_spec,
             ],

@@ -30,36 +30,38 @@ _BOX_PAD = np.array([1, 0, 1, 0], dtype=np.int32)
 _TIME_PAD = np.array([1, 0, 0, -1], dtype=np.int32)
 
 
-def pack_boxes(boxes_i32: np.ndarray | None) -> np.ndarray:
-    """(B, 4) [xlo, xhi, ylo, yhi] int32 → padded (MAX_BOXES, 4).
+def pack_boxes(boxes_i32: np.ndarray | None, slots: int = MAX_BOXES) -> np.ndarray:
+    """(B, 4) [xlo, xhi, ylo, yhi] int32 → padded (``slots``, 4).
 
     More boxes than slots → collapse to the bounding envelope (still a
-    superset; residual recovers exactness).
+    superset; residual recovers exactness). ``slots`` is a compile-time
+    shape: single-box workloads pass ``slots=1`` so the device kernels skip
+    the padded-slot evaluations entirely.
     """
     if boxes_i32 is None or len(boxes_i32) == 0:
         full = np.array([[0, 2**31 - 1, 0, 2**31 - 1]], dtype=np.int32)
         boxes_i32 = full
     b = np.asarray(boxes_i32, dtype=np.int32)
-    if len(b) > MAX_BOXES:
+    if len(b) > slots:
         b = np.array(
             [[b[:, 0].min(), b[:, 1].max(), b[:, 2].min(), b[:, 3].max()]],
             dtype=np.int32,
         )
-    pad = np.broadcast_to(_BOX_PAD, (MAX_BOXES - len(b), 4))
+    pad = np.broadcast_to(_BOX_PAD, (slots - len(b), 4))
     return np.vstack([b, pad])
 
 
-def pack_times(times_i32: np.ndarray | None) -> np.ndarray:
-    """(T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 → padded (MAX_TIMES, 4)."""
+def pack_times(times_i32: np.ndarray | None, slots: int = MAX_TIMES) -> np.ndarray:
+    """(T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 → padded (``slots``, 4)."""
     if times_i32 is None or len(times_i32) == 0:
         full = np.array([[0, 0, 2**31 - 1, 2**31 - 1]], dtype=np.int32)
         times_i32 = full
     t = np.asarray(times_i32, dtype=np.int32)
-    if len(t) > MAX_TIMES:
+    if len(t) > slots:
         t = np.array(
             [[t[:, 0].min(), 0, t[:, 2].max(), 2**31 - 1]], dtype=np.int32
         )
-    pad = np.broadcast_to(_TIME_PAD, (MAX_TIMES - len(t), 4))
+    pad = np.broadcast_to(_TIME_PAD, (slots - len(t), 4))
     return np.vstack([t, pad])
 
 

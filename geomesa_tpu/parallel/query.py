@@ -244,9 +244,31 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
     )
     def step(x, y, bins, offs, true_n, boxes, times, grid_bounds):
         base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
-        m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)  # (Ql, Nl)
+        n = x.shape[0]
+        rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
 
-        def one(mask_q, gb):
+        # sequential over queries (lax.map): peak memory stays O(N), never
+        # O(Q·N) — 100M-row shards with Q=16 would otherwise materialize
+        # multi-GB (Q, N) temporaries and exhaust HBM
+        def one(args):
+            boxes_q, times_q, gb = args  # (B, 4), (T, 4), (4,)
+            in_box = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(boxes_q.shape[0]):
+                in_box |= (
+                    (x >= boxes_q[k, 0]) & (x <= boxes_q[k, 1])
+                    & (y >= boxes_q[k, 2]) & (y <= boxes_q[k, 3])
+                )
+            in_time = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(times_q.shape[0]):
+                after = (bins > times_q[k, 0]) | (
+                    (bins == times_q[k, 0]) & (offs >= times_q[k, 1])
+                )
+                before = (bins < times_q[k, 2]) | (
+                    (bins == times_q[k, 2]) & (offs <= times_q[k, 3])
+                )
+                in_time |= after & before
+            mask_q = in_box & in_time & rows_valid
+
             xi = x.astype(jnp.float32)
             yi = y.astype(jnp.float32)
             xlo = gb[0].astype(jnp.float32)
@@ -267,7 +289,6 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
             # the histogram as bf16 matmuls with f32 accumulation (exact for
             # counts < 2^24), which beats TPU scatter by an order of
             # magnitude. Masked-out rows get weight 0.
-            n = cx.shape[0]
             k = -(-n // chunk)
             pad = k * chunk - n
             cxp = jnp.pad(cx, (0, pad)).reshape(k, chunk)
@@ -276,11 +297,11 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
 
             def body(acc, args):
                 cxc, cyc, wc = args
-                rows = jax.nn.one_hot(cyc, height, dtype=jnp.bfloat16)
-                cols = jax.nn.one_hot(cxc, width, dtype=jnp.bfloat16)
-                rows = rows * wc.astype(jnp.bfloat16)[:, None]
+                rowsh = jax.nn.one_hot(cyc, height, dtype=jnp.bfloat16)
+                colsh = jax.nn.one_hot(cxc, width, dtype=jnp.bfloat16)
+                rowsh = rowsh * wc.astype(jnp.bfloat16)[:, None]
                 part = jax.lax.dot_general(
-                    rows, cols,
+                    rowsh, colsh,
                     (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -291,7 +312,7 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
             )
             return acc
 
-        grids = jax.vmap(one)(m, grid_bounds)  # (Ql, H, W)
+        grids = jax.lax.map(one, (boxes, times, grid_bounds))  # (Ql, H, W)
         return jax.lax.psum(grids, DATA_AXIS)
 
     return step
