@@ -101,6 +101,47 @@ class Z3Index(FeatureIndex):
         self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
         return perm
 
+    def merge_build(self, table: FeatureTable, prev: "Z3Index", n_prev: int) -> np.ndarray:
+        """LSM-style incremental build: ``table`` = [prev's rows | delta].
+
+        The main tier is already (bin, z)-sorted in ``prev``; only the delta
+        is sorted (small), then linearly merged (``native.merge_bin_z``) —
+        O(n) instead of a full re-sort, the compaction pattern of SURVEY.md
+        §2.11. Result is bit-identical to :meth:`build` on the whole table
+        (stable ties: main rows precede delta rows, as in the full sort).
+        """
+        from geomesa_tpu import native
+
+        n = len(table)
+        if prev.n != n_prev or n_prev == 0 or prev.bins is None:
+            return self.build(table)
+        col = table.geom_column()
+        sl = slice(n_prev, n)
+        d_bins, d_offs = self.binned.to_bin_and_offset(table.dtg_millis()[sl])
+        d_z = self.sfc.index(col.x[sl], col.y[sl], d_offs)
+        d_perm = native.lexsort_bin_z(d_bins, d_z)
+        d_bins_s = d_bins[d_perm]
+        d_z_s = d_z[d_perm]
+        merged = native.merge_bin_z(prev.bins, prev.zs, d_bins_s, d_z_s)
+        in_main = merged < n_prev
+        perm = np.where(
+            in_main,
+            prev.perm[np.minimum(merged, n_prev - 1)],
+            n_prev + d_perm[np.maximum(merged - n_prev, 0)],
+        )
+        self.perm = perm
+        self.bins = np.where(in_main, prev.bins[np.minimum(merged, n_prev - 1)],
+                             d_bins_s[np.maximum(merged - n_prev, 0)])
+        self.zs = np.where(in_main, prev.zs[np.minimum(merged, n_prev - 1)],
+                           d_z_s[np.maximum(merged - n_prev, 0)])
+        self.offsets = np.where(
+            in_main, prev.offsets[np.minimum(merged, n_prev - 1)],
+            d_offs[d_perm][np.maximum(merged - n_prev, 0)],
+        )
+        self.n = n
+        self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
+        return perm
+
     # -- planning ------------------------------------------------------------
     def _bin_span(self, b: int) -> tuple[int, int]:
         i = np.searchsorted(self.bin_values, b)

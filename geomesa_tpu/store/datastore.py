@@ -215,16 +215,27 @@ class DataStore:
         delta = st.delta.merged()
         if delta is None:
             return
+        n_prev = st.main_rows
         table = (
             delta if st.table is None else FeatureTable.concat([st.table, delta])
         )
-        self._rebuild(st, table)
+        self._rebuild(st, table, prev_indices=st.indices, n_prev=n_prev)
 
-    def _rebuild(self, st: _TypeState, table: FeatureTable) -> None:
-        """Swap in a new main tier built from ``table`` (delta folded in)."""
+    def _rebuild(self, st: _TypeState, table: FeatureTable, prev_indices=None,
+                 n_prev: int = 0) -> None:
+        """Swap in a new main tier built from ``table`` (delta folded in).
+
+        Indexes exposing ``merge_build`` fold a sorted delta into the
+        already-sorted previous state linearly (LSM compaction, SURVEY.md
+        §2.11) instead of re-sorting everything.
+        """
         indices = build_indices(st.sft)
-        for index in indices.values():
-            index.build(table)
+        for name, index in indices.items():
+            prev = (prev_indices or {}).get(name)
+            if prev is not None and n_prev > 0 and hasattr(index, "merge_build"):
+                index.merge_build(table, prev, n_prev)
+            else:
+                index.build(table)
         backend_state = self.backend.load(st.sft, table, indices)
         from geomesa_tpu.stats.store_stats import StoreStats
 
