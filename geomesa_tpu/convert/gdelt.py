@@ -9,6 +9,8 @@ ActionGeo_Lat/Long. Raw GDELT v1 events export is tab-delimited, 57 columns.
 
 from __future__ import annotations
 
+import numpy as np
+
 from geomesa_tpu.convert.delimited import DelimitedConverter
 from geomesa_tpu.schema.sft import parse_spec
 
@@ -66,3 +68,93 @@ def gdelt_converter(sft=None) -> DelimitedConverter:
         delimiter="\t",
         header=False,
     )
+
+
+# (attr, 0-based column, native type) for the numeric/date/point hot columns;
+# string attrs go through pandas (native loader is typed-numeric only)
+_NATIVE_COLS = [
+    ("dtg", 1, "date"),
+    ("isRootEvent", 25, "i64"),
+    ("quadClass", 29, "i64"),
+    ("goldsteinScale", 30, "f64"),
+    ("numMentions", 31, "i64"),
+    ("numSources", 32, "i64"),
+    ("numArticles", 33, "i64"),
+    ("avgTone", 34, "f64"),
+    ("lat", 39, "f64"),
+    ("lon", 40, "f64"),
+]
+_STRING_COLS = {
+    "globalEventId": 0, "actor1Code": 5, "actor1Name": 6,
+    "actor1CountryCode": 7, "actor2Code": 15, "actor2Name": 16,
+    "actor2CountryCode": 17, "eventCode": 26, "eventBaseCode": 27,
+    "eventRootCode": 28,
+}
+_INT_ATTRS = {"isRootEvent", "quadClass", "numMentions", "numSources", "numArticles"}
+
+
+def gdelt_fast_table(source, sft=None):
+    """Fast GDELT ingest: numeric/date/point columns extracted by the native
+    C++ loader (:mod:`geomesa_tpu.native`, one pass over the raw bytes),
+    string columns via pandas. Returns a FeatureTable with rows lacking a
+    valid geometry or date dropped (the converter's ``skip`` error mode).
+    Falls back to :func:`gdelt_converter` when the native loader is absent.
+
+    ``source``: path or raw bytes of a GDELT v1 TSV export.
+    """
+    import io
+
+    import pandas as pd
+
+    from geomesa_tpu import native
+    from geomesa_tpu.schema.columnar import Column, FeatureTable, point_column
+    from geomesa_tpu.schema.sft import AttributeType
+
+    sft = sft or gdelt_sft()
+    data = source if isinstance(source, bytes) else open(source, "rb").read()
+
+    typ_map = {"f64": native.F64, "i64": native.I64, "date": native.DATE_YYYYMMDD}
+    out = native.parse_delimited(
+        data, "\t", [(c, typ_map[t]) for _, c, t in _NATIVE_COLS]
+    )
+    if out is None:  # no toolchain: plain converter path
+        return gdelt_converter(sft).convert_path(
+            io.BytesIO(data) if isinstance(source, bytes) else source
+        )
+    arrays, valid = out
+    byname = {name: (arr, valid[i]) for i, (name, _, _) in enumerate(_NATIVE_COLS)
+              for arr in [arrays[i]]}
+
+    lon, lon_ok = byname["lon"]
+    lat, lat_ok = byname["lat"]
+    dtg, dtg_ok = byname["dtg"]
+    keep = (
+        lon_ok & lat_ok & dtg_ok
+        & (np.abs(lon) <= 180) & (np.abs(lat) <= 90)
+    )
+    idx = np.nonzero(keep)[0]
+
+    df = pd.read_csv(
+        io.BytesIO(data), sep="\t", header=None, dtype=str,
+        keep_default_na=False, na_values=[],
+        usecols=sorted(_STRING_COLS.values()),
+        engine="c",
+    )
+    cols: dict[str, Column] = {}
+    for a in sft.attributes:
+        if a.name == "geom":
+            cols["geom"] = point_column(lon[idx], lat[idx])
+        elif a.name == "dtg":
+            cols["dtg"] = Column(AttributeType.DATE, dtg[idx])
+        elif a.name in _STRING_COLS:
+            vals = df[_STRING_COLS[a.name]].to_numpy(dtype=object)[idx]
+            ok = np.array([v != "" for v in vals])
+            cols[a.name] = Column(a.type, vals, None if ok.all() else ok)
+        else:
+            arr, ok = byname[a.name]
+            dtype = np.int32 if a.name in _INT_ATTRS else np.float64
+            cols[a.name] = Column(
+                a.type, arr[idx].astype(dtype), None if ok[idx].all() else ok[idx]
+            )
+    fids = df[0].to_numpy(dtype=object)[idx]
+    return FeatureTable(sft, fids, cols)

@@ -1,0 +1,83 @@
+"""Native GDELT fast ingest: parity with the expression converter
+(reference converter config + data-loader hot path — SURVEY.md §2.16/§2.9)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.gdelt import gdelt_converter, gdelt_fast_table, gdelt_sft
+
+
+def synth_gdelt_tsv(n=500, seed=4, with_bad_rows=True):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        f = [""] * 57
+        f[0] = str(400_000_000 + i)
+        f[1] = f"2017{rng.integers(1, 13):02d}{rng.integers(1, 29):02d}"
+        f[5] = "USA"
+        f[6] = f"ACTOR{i % 9}"
+        f[7] = "US"
+        f[15] = "CHN"
+        f[16] = f"OTHER{i % 5}"
+        f[17] = "CN"
+        f[25] = str(i % 2)
+        f[26] = "043"
+        f[27] = "043"
+        f[28] = "04"
+        f[29] = str(1 + i % 4)
+        f[30] = f"{rng.uniform(-10, 10):.1f}"
+        f[31] = str(int(rng.integers(1, 100)))
+        f[32] = str(int(rng.integers(1, 10)))
+        f[33] = str(int(rng.integers(1, 50)))
+        f[34] = f"{rng.uniform(-20, 20):.6f}"
+        f[39] = f"{rng.uniform(-90, 90):.4f}"
+        f[40] = f"{rng.uniform(-180, 180):.4f}"
+        lines.append("\t".join(f))
+    if with_bad_rows:
+        bad = [""] * 57
+        bad[0] = "badrow"
+        bad[1] = "20170701"
+        # no coordinates -> dropped by both paths
+        lines.append("\t".join(bad))
+    return ("\n".join(lines) + "\n").encode()
+
+
+class TestGdeltFast:
+    def test_parity_with_converter(self, tmp_path):
+        data = synth_gdelt_tsv()
+        p = tmp_path / "gdelt.tsv"
+        p.write_bytes(data)
+        fast = gdelt_fast_table(data)
+        conv = gdelt_converter().convert_path(str(p))
+        assert len(fast) == len(conv) == 500
+        np.testing.assert_array_equal(fast.fids, conv.fids)
+        np.testing.assert_array_equal(fast.dtg_millis(), conv.dtg_millis())
+        np.testing.assert_allclose(fast.geom_column().x, conv.geom_column().x)
+        np.testing.assert_allclose(fast.geom_column().y, conv.geom_column().y)
+        for attr in ("actor1Name", "eventCode", "quadClass", "goldsteinScale",
+                     "numMentions", "avgTone", "isRootEvent"):
+            a = fast.columns[attr].values
+            b = conv.columns[attr].values
+            if a.dtype.kind == "f":
+                np.testing.assert_allclose(a, b.astype(a.dtype))
+            else:
+                np.testing.assert_array_equal(a.astype(str), b.astype(str))
+
+    def test_path_input(self, tmp_path):
+        p = tmp_path / "g.tsv"
+        p.write_bytes(synth_gdelt_tsv(50, with_bad_rows=False))
+        t = gdelt_fast_table(str(p))
+        assert len(t) == 50
+
+    def test_store_roundtrip(self):
+        from geomesa_tpu.store.datastore import DataStore
+
+        t = gdelt_fast_table(synth_gdelt_tsv(300, with_bad_rows=False))
+        ds = DataStore(backend="tpu")
+        ds.create_schema(gdelt_sft())
+        ds.write("gdelt", t)
+        r = ds.query("gdelt", "BBOX(geom, -90, -45, 90, 45)")
+        gx = t.geom_column().x
+        gy = t.geom_column().y
+        exp = int(((gx >= -90) & (gx <= 90) & (gy >= -45) & (gy <= 45)).sum())
+        assert r.count == exp
