@@ -1,0 +1,105 @@
+"""all_to_all resharding: device redistribution equals global sort-split
+(reference: range repartitioning / spatial shuffle — SURVEY.md §2.20 P1/P2,
+§5)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from geomesa_tpu.parallel.mesh import data_shards, make_mesh, shard_columns
+from geomesa_tpu.parallel.reshard import reshard
+from geomesa_tpu.store.splitter import balanced_splits
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()  # all 8 virtual CPU devices, data axis only
+
+
+def _setup(mesh, n, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 1 << 60, n).astype(np.uint64)
+    a = rng.integers(-1000, 1000, n).astype(np.int32)
+    b = np.arange(n, dtype=np.int32)
+    cols, padded, rows_per_shard = shard_columns(
+        mesh, {"key": keys, "a": a, "b": b}
+    )
+    return keys, a, b, cols
+
+
+class TestReshard:
+    def test_matches_global_sort_split(self, mesh):
+        n = 40_000
+        keys, a, b, cols = _setup(mesh, n)
+        shards = data_shards(mesh)
+        splits = balanced_splits(np.sort(keys), shards)
+        key_out, cols_out, counts, overflow = reshard(
+            mesh, cols["key"], n, splits, {"a": cols["a"], "b": cols["b"]}
+        )
+        assert overflow == 0
+        assert counts.sum() == n
+
+        key_np = np.asarray(key_out)
+        a_np = np.asarray(cols_out["a"])
+        b_np = np.asarray(cols_out["b"])
+        per = key_np.shape[0] // shards
+
+        # referee: global sort + contiguous balanced split
+        order = np.argsort(keys, kind="stable")
+        gk = keys[order]
+        # owner uses "number of splits <= key" (shard_of semantics), so the
+        # shard boundary in the sorted referee is the first key >= split
+        bounds = np.concatenate([[0], np.searchsorted(gk, splits, side="left"), [n]])
+        got_all = []
+        for s in range(shards):
+            c = counts[s]
+            sk = key_np[s * per : s * per + c]
+            sa = a_np[s * per : s * per + c]
+            sb = b_np[s * per : s * per + c]
+            # shard owns exactly its split range, locally sorted
+            np.testing.assert_array_equal(sk, gk[bounds[s] : bounds[s + 1]])
+            assert np.all(np.diff(sk.astype(object)) >= 0)
+            # payload rows stayed attached to their keys
+            np.testing.assert_array_equal(sa, a[sb])
+            got_all.append(sb)
+        # every original row landed somewhere exactly once
+        assert sorted(np.concatenate(got_all).tolist()) == list(range(n))
+
+    def test_skewed_keys_overflow_reported(self, mesh):
+        # all keys identical → every row routes to one shard; tiny capacity
+        # must report overflow instead of silently dropping
+        n = 8_000
+        keys = np.full(n, 42, dtype=np.uint64)
+        cols, _, _ = shard_columns(mesh, {"key": keys, "a": np.zeros(n, np.int32)})
+        shards = data_shards(mesh)
+        splits = (np.arange(1, shards) * 1000).astype(np.uint64)
+        from geomesa_tpu.parallel.reshard import make_reshard_step
+
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        step = make_reshard_step(mesh, 1, capacity=16)
+        rep = NamedSharding(mesh, P())
+        out = step(
+            cols["key"],
+            jax.device_put(jnp.int32(n), rep),
+            jax.device_put(jnp.asarray(splits, dtype=np.uint64), rep),
+            cols["a"],
+        )
+        overflow = int(out[-1])
+        counts = np.asarray(out[-2])
+        assert overflow > 0
+        assert counts.sum() + overflow == n
+
+    def test_empty_and_padding(self, mesh):
+        # n not divisible by shards: padding rows must not be routed
+        n = 1003
+        keys, a, b, cols = _setup(mesh, n, seed=5)
+        shards = data_shards(mesh)
+        splits = balanced_splits(np.sort(keys), shards)
+        _, _, counts, overflow = reshard(
+            mesh, cols["key"], n, splits, {"a": cols["a"], "b": cols["b"]}
+        )
+        assert overflow == 0
+        assert counts.sum() == n
