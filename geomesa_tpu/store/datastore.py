@@ -25,6 +25,17 @@ from geomesa_tpu.store.backends import ExecutionBackend, OracleBackend, TpuBacke
 _BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
 
 
+def _pure_bbox_time(f: ast.Filter) -> bool:
+    """True when the filter is a conjunction of spatial-box/temporal
+    primaries only — fully expressible as int-domain (boxes, windows) with no
+    residual, so the batched loose count covers it."""
+    if isinstance(f, (ast.Include, ast.BBox, ast.During, ast.TempOp)):
+        return True
+    if isinstance(f, ast.And):
+        return all(_pure_bbox_time(c) for c in f.children)
+    return False
+
+
 @dataclass
 class QueryResult:
     """Materialized query result + plan trace + optional aggregates.
@@ -423,6 +434,88 @@ class DataStore:
         return QueryResult(
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
+
+    def count_many(self, type_name: str, queries, loose: bool = True):
+        """Batched counts for many queries in ONE device pass.
+
+        The multi-query fan-out path (SURVEY.md §2.20 P4): all bbox+time
+        queries are evaluated against the resident columns in a single fused
+        scan (``ops.pallas_kernels.batched_count``). ``loose`` counts in the
+        int key domain without the exact residual refine — the reference's
+        loose-bbox hint semantics (``QueryHints`` ``geomesa.loose.bbox``);
+        ``loose=False``, mixed-filter queries, or a non-empty hot tier fall
+        back to exact per-query execution.
+        """
+        st = self._state(type_name)
+        qs = [Query(filter=q) if isinstance(q, str) or q is None else q for q in queries]
+
+        def _exact(q):
+            return self.query(type_name, q).count
+
+        dev = None
+        if isinstance(self.backend, TpuBackend) and st.backend_state:
+            dev = st.backend_state.get("z3") or st.backend_state.get("z2")
+        if (
+            not loose
+            or dev is None
+            or st.delta.merged() is not None
+            or st.main_rows == 0
+        ):
+            return [_exact(q) for q in qs]
+
+        from geomesa_tpu.filter.bounds import extract as _extract
+        from geomesa_tpu.ops.refine import pack_boxes, pack_times
+
+        # batchable = conjunctions of spatial/temporal primaries only
+        batchable: list[int] = []
+        payloads = []
+        for i, q in enumerate(qs):
+            f = q.resolved_filter()
+            if not _pure_bbox_time(f) or q.hints or q.auths is not None:
+                continue
+            e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
+            if e.disjoint:
+                payloads.append(None)
+            else:
+                payloads.append(self.backend._payload(st.sft, e))
+            batchable.append(i)
+
+        out = [None] * len(qs)
+        live = [i for i, p in zip(batchable, payloads) if p is not None]
+        for i, p in zip(batchable, payloads):
+            if p is None:
+                out[i] = 0
+        if live:
+            import jax as _jax
+            import jax.numpy as jnp
+
+            boxes = np.stack([payloads[batchable.index(i)][0] for i in live])
+            times = np.stack([payloads[batchable.index(i)][1] for i in live])
+            if _jax.default_backend() == "tpu":
+                from geomesa_tpu.ops.pallas_kernels import batched_count
+
+                counts = np.asarray(
+                    batched_count(
+                        dev.x, dev.y, dev.bins, dev.offs,
+                        jnp.int32(0), jnp.int32(st.main_rows),
+                        jnp.asarray(boxes), jnp.asarray(times),
+                    )
+                )
+            else:
+                from geomesa_tpu.parallel.query import _batched_masks
+
+                m = _batched_masks(
+                    dev.x, dev.y, dev.bins, dev.offs,
+                    jnp.int32(0), jnp.int32(st.main_rows),
+                    jnp.asarray(boxes), jnp.asarray(times),
+                )
+                counts = np.asarray(m.sum(axis=1))
+            for k, i in enumerate(live):
+                out[i] = int(counts[k])
+        for i, q in enumerate(qs):
+            if out[i] is None:
+                out[i] = _exact(q)
+        return out
 
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float, hits: int) -> None:
         self.metrics.histogram("store.query.hits").update(hits)

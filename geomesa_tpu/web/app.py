@@ -79,6 +79,7 @@ class GeoMesaApp:
             ("DELETE", r"^/api/schemas/([^/]+)$", self._delete_schema),
             ("POST", r"^/api/schemas/([^/]+)/features$", self._add_features),
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
+            ("POST", r"^/api/schemas/([^/]+)/count-many$", self._count_many),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
             ("GET", r"^/api/schemas/([^/]+)/stats/count$", self._stats_count),
             ("GET", r"^/api/schemas/([^/]+)/stats/bounds$", self._stats_bounds),
@@ -226,6 +227,18 @@ class GeoMesaApp:
 
             return 200, bin_encode(r.table, {}), "application/octet-stream"
         raise _HttpError(400, f"unknown format {fmt!r}")
+
+    def _count_many(self, name, params, body):
+        """POST {"queries": [cql, ...], "loose": bool} → batched counts in
+        one device pass (DataStore.count_many)."""
+        if not body or "queries" not in body:
+            raise _HttpError(400, 'body must be {"queries": [...]}')
+        if not hasattr(self.store, "count_many"):
+            raise _HttpError(400, "store does not support batched counts")
+        counts = self.store.count_many(
+            name, body["queries"], loose=bool(body.get("loose", True))
+        )
+        return 200, {"counts": counts}, "application/json"
 
     def _stats(self, name, params, body):
         spec = params.get("stats")

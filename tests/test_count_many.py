@@ -1,0 +1,84 @@
+"""Batched multi-query counts (one device pass) — loose-bbox semantics and
+fallbacks (reference: batched scanner fan-out + loose-bbox hint — SURVEY.md
+§2.20 P4, QueryHints)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(13)
+    n = 30_000
+    recs = [
+        {
+            "name": f"n{i % 3}",
+            "dtg": T0 + int(rng.integers(0, 14 * 86_400_000)),
+            "geom": Point(float(rng.uniform(-170, 170)), float(rng.uniform(-85, 85))),
+        }
+        for i in range(n)
+    ]
+    store = DataStore(backend="tpu")
+    store.create_schema("b", "name:String,dtg:Date,*geom:Point")
+    store.write("b", recs)
+    store.compact("b")
+    return store
+
+
+def _queries():
+    rng = np.random.default_rng(3)
+    out = []
+    for _ in range(12):
+        cx, cy = rng.uniform(-120, 120), rng.uniform(-60, 60)
+        w, h = rng.uniform(5, 40), rng.uniform(5, 30)
+        lo = T0 + int(rng.integers(0, 7 * 86_400_000))
+        import datetime
+
+        t1 = datetime.datetime.fromtimestamp(lo / 1000, datetime.timezone.utc)
+        t2 = datetime.datetime.fromtimestamp((lo + 4 * 86_400_000) / 1000, datetime.timezone.utc)
+        out.append(
+            f"BBOX(geom, {cx - w/2:.4f}, {cy - h/2:.4f}, {cx + w/2:.4f}, {cy + h/2:.4f}) "
+            f"AND dtg DURING {t1:%Y-%m-%dT%H:%M:%SZ}/{t2:%Y-%m-%dT%H:%M:%SZ}"
+        )
+    return out
+
+
+class TestCountMany:
+    def test_matches_exact_queries(self, ds):
+        qs = _queries()
+        batched = ds.count_many("b", qs)
+        exact = [ds.query("b", q).count for q in qs]
+        assert batched == exact  # random doubles never sit on cell edges
+        assert sum(batched) > 0
+
+    def test_mixed_filters_fall_back(self, ds):
+        qs = ["name = 'n1'", "BBOX(geom, -50, -50, 50, 50)", "INCLUDE"]
+        batched = ds.count_many("b", qs)
+        exact = [ds.query("b", q).count for q in qs]
+        assert batched == exact
+
+    def test_exact_mode(self, ds):
+        qs = _queries()[:4]
+        assert ds.count_many("b", qs, loose=False) == [
+            ds.query("b", q).count for q in qs
+        ]
+
+    def test_hot_tier_falls_back(self, ds):
+        ds.write("b", [{"name": "hot", "dtg": T0, "geom": Point(0.5, 0.5)}])
+        try:
+            got = ds.count_many("b", ["BBOX(geom, 0, 0, 1, 1)"])
+            assert got == [ds.query("b", "BBOX(geom, 0, 0, 1, 1)").count]
+        finally:
+            ds.compact("b")
+
+    def test_oracle_backend_loops(self):
+        ds2 = DataStore(backend="oracle")
+        ds2.create_schema("o", "dtg:Date,*geom:Point")
+        ds2.write("o", [{"dtg": T0 + i, "geom": Point(i, i)} for i in range(10)])
+        assert ds2.count_many("o", ["BBOX(geom, -1, -1, 4, 4)", "INCLUDE"]) == [5, 10]

@@ -137,6 +137,16 @@ class TestQueryAndStats:
         status, out = jcall(app, "GET", "/api/metrics")
         assert status == 200 and out["store.queries"]["count"] >= 1
 
+    def test_count_many(self, app):
+        _ingest(app)
+        status, out = jcall(
+            app, "POST", "/api/schemas/pts/count-many",
+            body={"queries": ["BBOX(geom, -50, -50, 0, 50)", "INCLUDE"]},
+        )
+        assert status == 200
+        assert out["counts"][1] == 50
+        assert 0 < out["counts"][0] <= 50
+
     def test_query_invalid_cql(self, app):
         _ingest(app)
         status, out = jcall(app, "GET", "/api/schemas/pts/query", "cql=NOT%20VALID(")
