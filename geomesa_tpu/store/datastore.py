@@ -25,14 +25,20 @@ from geomesa_tpu.store.backends import ExecutionBackend, OracleBackend, TpuBacke
 _BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
 
 
-def _pure_bbox_time(f: ast.Filter) -> bool:
+def _pure_bbox_time(f: ast.Filter, sft: FeatureType) -> bool:
     """True when the filter is a conjunction of spatial-box/temporal
-    primaries only — fully expressible as int-domain (boxes, windows) with no
-    residual, so the batched loose count covers it."""
-    if isinstance(f, (ast.Include, ast.BBox, ast.During, ast.TempOp)):
+    primaries on the schema's DEFAULT geometry/date fields — fully
+    expressible as int-domain (boxes, windows) with no residual, so the
+    batched loose count covers it. Predicates on other attributes (which
+    ``bounds.extract`` would silently treat as unconstrained) disqualify."""
+    if isinstance(f, ast.Include):
         return True
+    if isinstance(f, ast.BBox):
+        return f.prop == sft.geom_field
+    if isinstance(f, (ast.During, ast.TempOp)):
+        return f.prop == sft.dtg_field
     if isinstance(f, ast.And):
-        return all(_pure_bbox_time(c) for c in f.children)
+        return all(_pure_bbox_time(c, sft) for c in f.children)
     return False
 
 
@@ -448,6 +454,9 @@ class DataStore:
         """
         st = self._state(type_name)
         qs = [Query(filter=q) if isinstance(q, str) or q is None else q for q in queries]
+        # interceptors see every query exactly as query() would show them
+        if self._interceptors:
+            qs = [self._intercept(type_name, st.sft, q) for q in qs]
 
         def _exact(q):
             return self.query(type_name, q).count
@@ -460,37 +469,41 @@ class DataStore:
             or dev is None
             or st.delta.merged() is not None
             or st.main_rows == 0
+            # TTL masking is injected per-query in query(); loose counts
+            # would include expired rows — take the exact path
+            or self._age_off_ttl_ms(st.sft) is not None
         ):
             return [_exact(q) for q in qs]
 
         from geomesa_tpu.filter.bounds import extract as _extract
-        from geomesa_tpu.ops.refine import pack_boxes, pack_times
 
-        # batchable = conjunctions of spatial/temporal primaries only
-        batchable: list[int] = []
-        payloads = []
+        # batchable = conjunctions of spatial/temporal primaries on the
+        # DEFAULT geometry/date fields only (anything else has residual
+        # semantics the loose kernel can't honor)
+        pending: list[tuple[int, tuple | None]] = []  # (query idx, payload)
         for i, q in enumerate(qs):
             f = q.resolved_filter()
-            if not _pure_bbox_time(f) or q.hints or q.auths is not None:
+            if (
+                not _pure_bbox_time(f, st.sft)
+                or q.hints
+                or q.auths is not None
+                or q.limit is not None
+            ):
                 continue
             e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
-            if e.disjoint:
-                payloads.append(None)
-            else:
-                payloads.append(self.backend._payload(st.sft, e))
-            batchable.append(i)
+            pending.append((i, None if e.disjoint else self.backend._payload(st.sft, e)))
 
-        out = [None] * len(qs)
-        live = [i for i, p in zip(batchable, payloads) if p is not None]
-        for i, p in zip(batchable, payloads):
+        out: list = [None] * len(qs)
+        live = [(i, p) for i, p in pending if p is not None]
+        for i, p in pending:
             if p is None:
                 out[i] = 0
         if live:
             import jax as _jax
             import jax.numpy as jnp
 
-            boxes = np.stack([payloads[batchable.index(i)][0] for i in live])
-            times = np.stack([payloads[batchable.index(i)][1] for i in live])
+            boxes = np.stack([p[0] for _, p in live])
+            times = np.stack([p[1] for _, p in live])
             if _jax.default_backend() == "tpu":
                 from geomesa_tpu.ops.pallas_kernels import batched_count
 
@@ -510,8 +523,12 @@ class DataStore:
                     jnp.asarray(boxes), jnp.asarray(times),
                 )
                 counts = np.asarray(m.sum(axis=1))
-            for k, i in enumerate(live):
+            for k, (i, _) in enumerate(live):
                 out[i] = int(counts[k])
+        # batched queries still hit metrics + the audit trail
+        for i, _ in pending:
+            self.metrics.counter("store.queries").inc()
+            self._audit(type_name, qs[i], 0.0, 0.0, out[i])
         for i, q in enumerate(qs):
             if out[i] is None:
                 out[i] = _exact(q)

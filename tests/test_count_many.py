@@ -82,3 +82,70 @@ class TestCountMany:
         ds2.create_schema("o", "dtg:Date,*geom:Point")
         ds2.write("o", [{"dtg": T0 + i, "geom": Point(i, i)} for i in range(10)])
         assert ds2.count_many("o", ["BBOX(geom, -1, -1, 4, 4)", "INCLUDE"]) == [5, 10]
+
+    def test_non_default_field_predicates_fall_back(self):
+        # TempOp on a NON-default Date attribute must not be loose-batched
+        # (the extraction would silently drop it and count everything)
+        ds2 = DataStore(backend="tpu")
+        ds2.create_schema(
+            "nd", "created:Date,dtg:Date,*geom:Point;geomesa.index.dtg='dtg'"
+        )
+        ds2.write("nd", [
+            {"created": 86_400_000 * i, "dtg": T0 + i, "geom": Point(i, i)}
+            for i in range(10)
+        ])
+        ds2.compact("nd")
+        assert ds2.get_schema("nd").dtg_field == "dtg"
+        q = "created AFTER 1970-01-05T00:00:00Z"  # created > 4 days
+        exact = ds2.query("nd", q).count
+        assert ds2.count_many("nd", [q]) == [exact]
+        assert exact == 5
+
+    def test_limit_falls_back(self, ds):
+        q = Query(filter="BBOX(geom, -170, -85, 170, 85)", limit=5)
+        assert ds.count_many("b", [q]) == [ds.query("b", q).count] == [5]
+
+    def test_interceptors_apply(self, ds):
+        from geomesa_tpu.filter import ast as A
+
+        calls = []
+
+        def scope(sft, q):
+            from dataclasses import replace
+
+            calls.append(1)
+            return replace(
+                q, filter=A.And([q.resolved_filter(),
+                                 A.BBox("geom", 0.0, 0.0, 180.0, 90.0)])
+            )
+
+        ds.register_interceptor("b", scope)
+        try:
+            got = ds.count_many("b", ["INCLUDE"])
+            exact = ds.query("b", "BBOX(geom, 0, 0, 180, 90)").count
+            assert got == [exact]
+            assert calls  # interceptor ran on the batched path
+        finally:
+            ds._interceptors.clear()
+
+    def test_age_off_falls_back(self):
+        ds2 = DataStore(backend="tpu")
+        ds2.create_schema("ttl", "dtg:Date,*geom:Point;geomesa.age.off='1000'")
+        now = 1_700_000_000_000
+        ds2.write("ttl", [
+            {"dtg": now - 10_000, "geom": Point(1, 1)},   # expired
+            {"dtg": now - 100, "geom": Point(2, 2)},      # fresh
+        ])
+        ds2.compact("ttl")
+        q = Query(filter="INCLUDE", hints={"now_ms": now})
+        assert ds2.count_many("ttl", [q]) == [ds2.query("ttl", q).count] == [1]
+
+    def test_batched_counts_audited(self):
+        from geomesa_tpu.utils.audit import InMemoryAuditWriter
+
+        ds2 = DataStore(backend="tpu", audit_writer=InMemoryAuditWriter())
+        ds2.create_schema("a", "dtg:Date,*geom:Point")
+        ds2.write("a", [{"dtg": T0, "geom": Point(1, 1)}])
+        ds2.compact("a")
+        ds2.count_many("a", ["BBOX(geom, 0, 0, 2, 2)", "INCLUDE"])
+        assert len(ds2.audit_writer.query_events("a")) == 2
