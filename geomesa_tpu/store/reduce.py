@@ -57,12 +57,78 @@ def sample_rows(table, rows, fraction, sample_by):
     return rows[keep]
 
 
+def _raster_cells(geom, xmin, ymin, px, py, width, height):
+    """Grid cells covered by a geometry's footprint (cols, rows) —
+    the ``RenderingGrid`` rasterization: lines walk their segments,
+    polygons fill cells whose centers they contain."""
+    from geomesa_tpu.geometry import predicates as P
+    from geomesa_tpu.geometry.types import (
+        LineString,
+        MultiLineString,
+        MultiPolygon,
+        Polygon,
+    )
+
+    def line_cells(coords):
+        cells = set()
+        cx = (coords[:, 0] - xmin) / px
+        cy = (coords[:, 1] - ymin) / py
+        for i in range(len(coords) - 1):
+            steps = int(max(abs(cx[i + 1] - cx[i]), abs(cy[i + 1] - cy[i])) * 2) + 2
+            t = np.linspace(0.0, 1.0, steps)
+            gx = np.floor(cx[i] + (cx[i + 1] - cx[i]) * t).astype(int)
+            gy = np.floor(cy[i] + (cy[i + 1] - cy[i]) * t).astype(int)
+            ok = (gx >= 0) & (gx < width) & (gy >= 0) & (gy < height)
+            cells.update(zip(gx[ok].tolist(), gy[ok].tolist()))
+        return cells
+
+    def poly_cells(poly):
+        bx1, by1, bx2, by2 = poly.bbox
+        jx1 = max(0, int(np.floor((bx1 - xmin) / px)))
+        jx2 = min(width, int(np.ceil((bx2 - xmin) / px)))
+        jy1 = max(0, int(np.floor((by1 - ymin) / py)))
+        jy2 = min(height, int(np.ceil((by2 - ymin) / py)))
+        if jx2 <= jx1 or jy2 <= jy1:
+            return set()
+        gxs = np.arange(jx1, jx2)
+        gys = np.arange(jy1, jy2)
+        ccx = xmin + (gxs + 0.5) * px
+        ccy = ymin + (gys + 0.5) * py
+        mx, my = np.meshgrid(ccx, ccy)
+        inside = P.points_within_geom(mx.ravel(), my.ravel(), poly)
+        gx, gy = np.meshgrid(gxs, gys)
+        out = set(zip(gx.ravel()[inside].tolist(), gy.ravel()[inside].tolist()))
+        # thin polygons can miss every cell center: fall back to the outline
+        return out or line_cells(poly.shell)
+
+    if isinstance(geom, LineString):
+        return line_cells(geom.coords)
+    if isinstance(geom, MultiLineString):
+        out = set()
+        for part in geom.parts:
+            out |= line_cells(part.coords)
+        return out
+    if isinstance(geom, Polygon):
+        return poly_cells(geom)
+    if isinstance(geom, MultiPolygon):
+        out = set()
+        for part in geom.parts:
+            out |= poly_cells(part)
+        return out
+    return set()
+
+
 def density_grid(table, opts) -> np.ndarray:
     """Exact f64 heatmap over the result set (DensityScan role); the sharded
-    device path computes the same grid via ops.density + psum."""
+    device path computes the same grid via ops.density + psum.
+
+    Point features snap to their cell; extended geometries rasterize their
+    footprint (``utils/geotools/RenderingGrid`` role) with the feature's
+    weight spread across touched cells, so grid mass per feature stays equal
+    to its weight.
+    """
     width = int(opts.get("width", 256))
     height = int(opts.get("height", 256))
-    xs, ys = representative_xy(table)
     bbox = opts.get("bbox")
     if bbox is None:
         bbox = (-180.0, -90.0, 180.0, 90.0)
@@ -71,9 +137,38 @@ def density_grid(table, opts) -> np.ndarray:
     w = None
     if weight:
         w = table.columns[weight].values.astype(np.float64)
-    grid, _, _ = np.histogram2d(
-        ys, xs, bins=[height, width], range=[[ymin, ymax], [xmin, xmax]], weights=w
-    )
+
+    gcol = table.geom_column() if table.sft.geom_field else None
+    if gcol is None or gcol.x is not None:  # point schema: vectorized snap
+        xs, ys = representative_xy(table)
+        grid, _, _ = np.histogram2d(
+            ys, xs, bins=[height, width], range=[[ymin, ymax], [xmin, xmax]], weights=w
+        )
+        return grid
+
+    px = (xmax - xmin) / width
+    py = (ymax - ymin) / height
+    grid = np.zeros((height, width), dtype=np.float64)
+    geoms = gcol.geometries()
+    valid = gcol.is_valid()
+    from geomesa_tpu.geometry.types import Point
+
+    for i in range(len(table)):
+        if not valid[i]:
+            continue
+        g = geoms[i]
+        wi = 1.0 if w is None else float(w[i])
+        if isinstance(g, Point):
+            gx = int(np.floor((g.x - xmin) / px))
+            gy = int(np.floor((g.y - ymin) / py))
+            if 0 <= gx < width and 0 <= gy < height:
+                grid[gy, gx] += wi
+            continue
+        cells = _raster_cells(g, xmin, ymin, px, py, width, height)
+        if cells:
+            share = wi / len(cells)
+            for gx, gy in cells:
+                grid[gy, gx] += share
     return grid
 
 

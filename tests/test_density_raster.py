@@ -1,0 +1,72 @@
+"""Density rasterization of extended geometries (RenderingGrid role —
+SURVEY.md §2.3/§2.18): lines spread along their path, polygons fill."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import LineString, Point, Polygon
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.reduce import density_grid
+
+BBOX = (0.0, 0.0, 16.0, 16.0)
+OPTS = {"bbox": BBOX, "width": 16, "height": 16}
+
+
+def _table(geoms):
+    sft = parse_spec("d", "name:String,*geom:Geometry")
+    return FeatureTable.from_records(
+        sft, [{"name": f"g{i}", "geom": g} for i, g in enumerate(geoms)]
+    )
+
+
+class TestRaster:
+    def test_line_spreads_along_path(self):
+        # horizontal line across the middle: one row of cells gets the mass
+        t = _table([LineString([[0.5, 8.5], [15.5, 8.5]])])
+        g = density_grid(t, OPTS)
+        assert g.sum() == pytest.approx(1.0)  # mass conserved
+        assert np.count_nonzero(g[8, :]) == 16
+        assert np.count_nonzero(g) == 16  # only that row touched
+
+    def test_diagonal_line(self):
+        t = _table([LineString([[0.1, 0.1], [15.9, 15.9]])])
+        g = density_grid(t, OPTS)
+        assert g.sum() == pytest.approx(1.0)
+        assert all(g[i, i] > 0 for i in range(16))  # the diagonal is covered
+
+    def test_polygon_fills(self):
+        t = _table([Polygon([[2, 2], [10, 2], [10, 10], [2, 10]])])
+        g = density_grid(t, OPTS)
+        assert g.sum() == pytest.approx(1.0)
+        assert np.count_nonzero(g[2:10, 2:10]) == 64
+        assert g[0, 0] == 0 and g[12, 12] == 0
+
+    def test_mixed_points_and_lines(self):
+        t = _table([
+            Point(4.5, 4.5),
+            LineString([[0.5, 1.5], [7.5, 1.5]]),
+        ])
+        g = density_grid(t, OPTS)
+        assert g.sum() == pytest.approx(2.0)
+        assert g[4, 4] == 1.0
+        assert np.count_nonzero(g[1, :8]) == 8
+
+    def test_thin_polygon_outline_fallback(self):
+        # degenerate sliver missing every cell center still contributes mass
+        t = _table([Polygon([[3.0, 3.01], [12.0, 3.01], [12.0, 3.02], [3.0, 3.02]])])
+        g = density_grid(t, OPTS)
+        assert g.sum() == pytest.approx(1.0)
+
+    def test_store_density_hint_with_lines(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema("lines", "name:String,*geom:LineString")
+        ds.write("lines", [
+            {"name": "a", "geom": LineString([[1, 1], [14, 1]])},
+            {"name": "b", "geom": LineString([[1, 5], [14, 5]])},
+        ])
+        r = ds.query("lines", Query(hints={"density": OPTS}))
+        assert r.density.sum() == pytest.approx(2.0)
+        assert np.count_nonzero(r.density[1, :]) > 10
