@@ -159,3 +159,36 @@ def from_ipc_bytes(sft: FeatureType, data: bytes) -> FeatureTable:
     with pa.ipc.open_stream(pa.BufferReader(data)) as r:
         at = r.read_all()
     return from_arrow(sft, at)
+
+
+def merge_ipc_streams(
+    sft: FeatureType,
+    chunks: list[bytes],
+    sort_by: str | None = None,
+    descending: bool = False,
+    batch_rows: int = 65536,
+) -> bytes:
+    """Merge per-shard/out-of-order IPC chunks into ONE sorted stream.
+
+    The ``DeltaWriter``/``SimpleFeatureArrowIO`` client-side merge role
+    (``geomesa-arrow`` — SURVEY.md §2.13): distributed scans emit Arrow
+    batches per shard in arbitrary order; the reducer merges them, re-sorts
+    by the requested attribute, and re-encodes dictionaries over the merged
+    domain (per-chunk dictionaries are chunk-local and must not leak).
+    """
+    if not chunks:
+        return to_ipc_bytes(FeatureTable.from_records(sft, []))
+    tables = [from_ipc_bytes(sft, c) for c in chunks]
+    merged = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
+    if sort_by is not None:
+        keys = merged.fids if sort_by == "id" else merged.columns[sort_by].values
+        order = np.argsort(keys, kind="stable")
+        if descending:
+            order = order[::-1]
+        merged = merged.take(order)
+    at = to_arrow(merged)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, at.schema) as w:
+        for batch in at.to_batches(max_chunksize=batch_rows):
+            w.write_batch(batch)
+    return sink.getvalue().to_pybytes()
