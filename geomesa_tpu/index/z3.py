@@ -216,6 +216,33 @@ class XZ3Index(FeatureIndex):
         self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
         return perm
 
+    def merge_build(self, table: FeatureTable, prev: "XZ3Index", n_prev: int) -> np.ndarray:
+        """Linear LSM merge of a sorted delta into the sorted main tier
+        (same contract as :meth:`Z3Index.merge_build`)."""
+        from geomesa_tpu import native
+
+        n = len(table)
+        if prev.n != n_prev or n_prev == 0 or prev.bins is None:
+            return self.build(table)
+        col = table.geom_column()
+        b = col.bounds[n_prev:n]
+        d_bins, d_offs = self.binned.to_bin_and_offset(table.dtg_millis()[n_prev:n])
+        o = d_offs.astype(np.float64)
+        d_codes = self.sfc.index((b[:, 0], b[:, 1], o), (b[:, 2], b[:, 3], o))
+        d_perm = native.lexsort_bin_z(d_bins, d_codes)
+        d_bins_s = d_bins[d_perm]
+        d_codes_s = d_codes[d_perm]
+        merged = native.merge_bin_z(prev.bins, prev.codes, d_bins_s, d_codes_s)
+        in_main = merged < n_prev
+        main_i = np.minimum(merged, n_prev - 1)
+        delta_i = np.maximum(merged - n_prev, 0)
+        self.perm = np.where(in_main, prev.perm[main_i], n_prev + d_perm[delta_i])
+        self.bins = np.where(in_main, prev.bins[main_i], d_bins_s[delta_i])
+        self.codes = np.where(in_main, prev.codes[main_i], d_codes_s[delta_i])
+        self.n = n
+        self.bin_values, self.bin_starts = np.unique(self.bins, return_index=True)
+        return self.perm
+
     def _bin_span(self, b: int) -> tuple[int, int]:
         i = np.searchsorted(self.bin_values, b)
         if i == len(self.bin_values) or self.bin_values[i] != b:

@@ -113,23 +113,28 @@ class StrategyDecider:
         f: ast.Filter,
         hints: dict,
         stats=None,
+        trace: list | None = None,
     ) -> tuple[str, Any]:
+        notes = trace if trace is not None else []
         forced = hints.get("index")
         if forced:
             if forced not in indices:
                 raise ValueError(f"forced index {forced!r} not available")
+            notes.append(f"index forced by hint: {forced}")
             return forced, None
         fids = _extract_fids(f)
         if fids is not None and "id" in indices:
             return "id", fids
         if stats is not None and stats.count > 0:
-            name = StrategyDecider._cost_based(indices, e, stats)
+            name = StrategyDecider._cost_based(indices, e, stats, notes)
             if name is not None:
                 return name, None
-        return StrategyDecider._heuristic(indices, e), None
+        name = StrategyDecider._heuristic(indices, e)
+        notes.append(f"heuristic choice (no usable stats): {name}")
+        return name, None
 
     @staticmethod
-    def _cost_based(indices, e: Extraction, stats) -> str | None:
+    def _cost_based(indices, e: Extraction, stats, notes: list | None = None) -> str | None:
         costs: dict[str, float] = {}
         for name, index in indices.items():
             if name == "id":
@@ -171,7 +176,13 @@ class StrategyDecider:
                 costs[name] = est * StrategyDecider.ATTR_COST_MULTIPLIER
         if not costs:
             return None
-        return min(costs.items(), key=lambda kv: kv[1])[0]
+        best = min(costs.items(), key=lambda kv: kv[1])[0]
+        if notes is not None:
+            ranked = ", ".join(
+                f"{n}≈{c:.0f}" for n, c in sorted(costs.items(), key=lambda kv: kv[1])
+            )
+            notes.append(f"cost-based (estimated rows): {ranked} → {best}")
+        return best
 
     @staticmethod
     def _heuristic(indices, e: Extraction) -> str:
@@ -224,9 +235,14 @@ class QueryPlanner:
             f, self.sft.geom_field, self.sft.dtg_field, attrs=self.indexed_attrs
         )
         e = coerce_attr_bounds(self.sft, e)
-        name, fids = StrategyDecider.choose(self.indices, e, f, q.hints, self.stats)
+        notes: list[str] = []
+        name, fids = StrategyDecider.choose(
+            self.indices, e, f, q.hints, self.stats, trace=notes
+        )
         index = self.indices[name]
-        notes = []
+        for attr, bounds in e.attributes.items():
+            if bounds is not None:
+                notes.append(f"attribute bounds: {attr} in {bounds}")
         if fids is not None and isinstance(index, IdIndex):
             plan = index.plan_fids(fids)
             notes.append(f"id lookup on {len(fids)} fids")

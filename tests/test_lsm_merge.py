@@ -76,6 +76,38 @@ class TestMergeBuild:
         np.testing.assert_array_equal(perm, full.build(delta))
 
 
+class TestXZ3MergeBuild:
+    def test_identical_to_full_build(self):
+        from geomesa_tpu.geometry.types import LineString
+        from geomesa_tpu.index.z3 import XZ3Index
+
+        sft = parse_spec("x", "dtg:Date,*geom:LineString")
+        rng = np.random.default_rng(4)
+
+        def lines(n, base):
+            recs = []
+            for i in range(n):
+                x0, y0 = rng.uniform(-170, 170), rng.uniform(-80, 80)
+                recs.append({
+                    "dtg": T0 + int(rng.integers(0, 21 * 86_400_000)),
+                    "geom": LineString([[x0, y0], [x0 + 1, y0 + 0.5]]),
+                })
+            return FeatureTable.from_records(sft, recs, [f"{base}.{i}" for i in range(n)])
+
+        main = lines(5000, "m")
+        delta = lines(400, "d")
+        prev = XZ3Index(sft)
+        prev.build(main)
+        combined = FeatureTable.concat([main, delta])
+        full = XZ3Index(sft)
+        full_perm = full.build(combined)
+        inc = XZ3Index(sft)
+        inc_perm = inc.merge_build(combined, prev, len(main))
+        np.testing.assert_array_equal(inc_perm, full_perm)
+        np.testing.assert_array_equal(inc.codes, full.codes)
+        np.testing.assert_array_equal(inc.bins, full.bins)
+
+
 class TestStoreCompactionParity:
     @pytest.mark.parametrize("backend", ["oracle", "tpu"])
     def test_incremental_compaction_queries(self, backend):
