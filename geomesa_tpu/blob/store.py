@@ -75,24 +75,39 @@ class BlobStore:
         from geomesa_tpu.filter import ast
 
         r = self.store.query(_TYPE, Query(filter=ast.FidIn([blob_id])))
-        if r.count == 0:
+        if r.count == 0 or not self._has_payload(blob_id):
             raise KeyError(f"no such blob: {blob_id!r}")
         meta = r.table.record(0)
-        if self.directory:
-            payload = (self.directory / blob_id).read_bytes()
-        else:
-            payload = self._blobs[blob_id]
+        try:
+            if self.directory:
+                payload = (self.directory / blob_id).read_bytes()
+            else:
+                payload = self._blobs[blob_id]
+        except (FileNotFoundError, KeyError):
+            raise KeyError(f"no such blob: {blob_id!r}") from None
         return payload, meta
+
+    def _has_payload(self, blob_id: str) -> bool:
+        # deletion tombstone IS payload absence (ids are fresh uuid4s, never
+        # re-put), so deletes made through any BlobStore instance over the
+        # same directory are seen by all of them
+        if self.directory:
+            return (self.directory / blob_id).exists()
+        return blob_id in self._blobs
 
     def query_ids(self, cql=None) -> list[tuple[str, str]]:
         """[(blob_id, filename)] matching a CQL/AST filter over the metadata."""
         r = self.store.query(_TYPE, Query(filter=cql))
         names = r.table.columns["filename"].values
-        return [(str(f), str(n)) for f, n in zip(r.table.fids, names)]
+        return [
+            (str(f), str(n))
+            for f, n in zip(r.table.fids, names)
+            if self._has_payload(str(f))
+        ]
 
     def delete(self, blob_id: str) -> None:
         # metadata rows are append-only in the main store; deletion removes
-        # the payload and tombstones the metadata via age-off-style rewrite
+        # the payload, and get/query_ids filter on payload absence
         if self.directory:
             (self.directory / blob_id).unlink(missing_ok=True)
         else:

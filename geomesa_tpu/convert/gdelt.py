@@ -134,12 +134,25 @@ def gdelt_fast_table(source, sft=None):
     )
     idx = np.nonzero(keep)[0]
 
-    df = pd.read_csv(
-        io.BytesIO(data), sep="\t", header=None, dtype=str,
-        keep_default_na=False, na_values=[],
-        usecols=sorted(_STRING_COLS.values()),
-        engine="c",
-    )
+    # row boundaries must match the native parser exactly: pandas defaults
+    # skip blank lines and honor '"' quoting, either of which would shift df
+    # rows relative to the native arrays and silently mispair strings/fids
+    # with coordinates — disable both and verify the row count
+    import csv
+
+    try:
+        df = pd.read_csv(
+            io.BytesIO(data), sep="\t", header=None, dtype=str,
+            keep_default_na=False, na_values=[],
+            usecols=sorted(_STRING_COLS.values()),
+            engine="c", skip_blank_lines=False, quoting=csv.QUOTE_NONE,
+        )
+    except pd.errors.ParserError:
+        df = None  # ragged rows under QUOTE_NONE: take the converter path
+    if df is None or len(df) != len(lon):
+        return gdelt_converter(sft).convert_path(
+            io.BytesIO(data) if isinstance(source, bytes) else source
+        )
     cols: dict[str, Column] = {}
     for a in sft.attributes:
         if a.name == "geom":

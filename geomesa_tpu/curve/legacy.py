@@ -23,29 +23,33 @@ __all__ = ["LegacyNormalizedDimension", "LegacyZ2SFC", "LegacyZ3SFC", "legacy_z3
 
 @dataclass(frozen=True)
 class LegacyNormalizedDimension(normalize.NormalizedDimension):
-    """Old normalization: ``round((x-min)/(max-min) * max_index)`` —
-    half-width first/last bins, round-half-up at bin midpoints."""
+    """Old "semi-normalized" math (``NormalizedDimension.scala:83-87``
+    ``SemiNormalizedDimension``): ``normalize = ceil((x-min)/(max-min)*p)``
+    with ``p = 2^bits - 1`` (== ``max_index`` here), so bin 0 holds only
+    ``x == min`` and every other bin is half-open ``(lo, hi]``."""
 
     def normalize(self, x) -> np.ndarray:
         x = np.asarray(x, dtype=np.float64)
         if np.isnan(x).any():
             raise ValueError("NaN coordinate cannot be normalized to a curve index")
-        scaled = (x - self.min) / (self.max - self.min) * self.max_index
-        # numpy rounds half-to-even; the JVM's Math.round is half-up
-        out = np.floor(scaled + 0.5)
-        return np.clip(out, 0, self.max_index).astype(np.int64)
+        scaled = np.ceil((x - self.min) / (self.max - self.min) * self.max_index)
+        return np.clip(scaled, 0, self.max_index).astype(np.int64)
 
     def denormalize(self, i) -> np.ndarray:
+        # reference: min when i == 0, else (i - 0.5) * range / precision + min
         i = np.minimum(np.asarray(i, dtype=np.float64), self.max_index)
-        return self.min + i * ((self.max - self.min) / self.max_index)
+        mid = self.min + (i - 0.5) * ((self.max - self.min) / self.max_index)
+        return np.where(i == 0, self.min, mid)
 
     def bin_lo(self, i) -> np.ndarray:
+        # bin i covers (min + (i-1)*step, min + i*step]; bin 0 covers {min}
         i = np.asarray(i, dtype=np.float64)
-        return self.min + (i - 0.5) * ((self.max - self.min) / self.max_index)
+        lo = self.min + (i - 1.0) * ((self.max - self.min) / self.max_index)
+        return np.maximum(lo, self.min)
 
     def bin_hi(self, i) -> np.ndarray:
         i = np.asarray(i, dtype=np.float64)
-        return self.min + (i + 0.5) * ((self.max - self.min) / self.max_index)
+        return self.min + i * ((self.max - self.min) / self.max_index)
 
 
 class LegacyZ2SFC(Z2SFC):
@@ -61,7 +65,8 @@ class LegacyZ2SFC(Z2SFC):
 
 
 class LegacyZ3SFC(Z3SFC):
-    """Z3 with legacy rounding (21 bits/dim)."""
+    """Z3 with legacy rounding (21 bits lon/lat, 20-bit time precision —
+    ``LegacyZ3SFC.scala:18-20`` uses ``SemiNormalizedTime(2^20 - 1, ...)``)."""
 
     @property
     def lon(self) -> normalize.NormalizedDimension:
@@ -74,7 +79,7 @@ class LegacyZ3SFC(Z3SFC):
     @property
     def time(self) -> normalize.NormalizedDimension:
         max_offset = float(BinnedTime(self.period).max_offset)
-        return LegacyNormalizedDimension(0.0, max_offset, 21)
+        return LegacyNormalizedDimension(0.0, max_offset, 20)
 
 
 _CACHE: dict[TimePeriod, LegacyZ3SFC] = {}

@@ -27,16 +27,25 @@ MAX_TIMES = 16  # padded time-window slot count
 
 # sentinel rows make padded slots always-false (lo > hi)
 _BOX_PAD = np.array([1, 0, 1, 0], dtype=np.int32)
+# Padding for interval-overlap (XZ) queries: [1,0,1,0] is empty under point
+# containment but a feature bbox spanning the origin corner would overlap it;
+# [qxlo=MAX, qxhi=-1, ...] is unsatisfiable under `x1 <= qxhi & x2 >= qxlo`
+# for non-negative normalized coords.
+_BOX_PAD_OVERLAP = np.array([2**31 - 1, -1, 2**31 - 1, -1], dtype=np.int32)
 _TIME_PAD = np.array([1, 0, 0, -1], dtype=np.int32)
 
 
-def pack_boxes(boxes_i32: np.ndarray | None, slots: int = MAX_BOXES) -> np.ndarray:
+def pack_boxes(
+    boxes_i32: np.ndarray | None, slots: int = MAX_BOXES, overlap: bool = False
+) -> np.ndarray:
     """(B, 4) [xlo, xhi, ylo, yhi] int32 → padded (``slots``, 4).
 
     More boxes than slots → collapse to the bounding envelope (still a
     superset; residual recovers exactness). ``slots`` is a compile-time
     shape: single-box workloads pass ``slots=1`` so the device kernels skip
-    the padded-slot evaluations entirely.
+    the padded-slot evaluations entirely. ``overlap=True`` pads with the
+    interval-overlap-unsatisfiable sentinel (for XZ bbox-overlap scans,
+    where the containment pad is not empty).
     """
     if boxes_i32 is None or len(boxes_i32) == 0:
         full = np.array([[0, 2**31 - 1, 0, 2**31 - 1]], dtype=np.int32)
@@ -47,7 +56,7 @@ def pack_boxes(boxes_i32: np.ndarray | None, slots: int = MAX_BOXES) -> np.ndarr
             [[b[:, 0].min(), b[:, 1].max(), b[:, 2].min(), b[:, 3].max()]],
             dtype=np.int32,
         )
-    pad = np.broadcast_to(_BOX_PAD, (slots - len(b), 4))
+    pad = np.broadcast_to(_BOX_PAD_OVERLAP if overlap else _BOX_PAD, (slots - len(b), 4))
     return np.vstack([b, pad])
 
 

@@ -32,6 +32,18 @@ def make_mesh(n_devices: int | None = None, query_parallel: int = 1) -> Mesh:
     return Mesh(arr, (DATA_AXIS, QUERY_AXIS))
 
 
+_DEFAULT_MESH: Mesh | None = None
+
+
+def default_mesh() -> Mesh:
+    """Process-wide mesh over all local devices (shared so compiled steps
+    memoized per mesh are reused across stores)."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = make_mesh()
+    return _DEFAULT_MESH
+
+
 def data_shards(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS]
 

@@ -100,6 +100,104 @@ def make_select_step(mesh: Mesh):
     return step
 
 
+def make_select_count_step(mesh: Mesh):
+    """Pass 1 of distributed row retrieval: per-shard refine → per-shard hit
+    counts (D,) int32 on host. The counts size pass 2's capacity lanes
+    (the overflow-safe two-phase gather of SURVEY.md §7 "variable-length
+    results on fixed-shape hardware")."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
+        ),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, idx, count, boxes, times):
+        from geomesa_tpu.ops.refine import refine_points
+
+        mask = refine_points(x, y, bins, offs, idx[0], count[0], boxes, times)
+        return mask.sum(dtype=jnp.int32)[None]
+
+    return step
+
+
+def make_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
+    """Pass 2: per-shard refine + on-device compaction of matching *global*
+    row positions into ``capacity`` lanes per shard.
+
+    Returns ``fn(x, y, bins, offs, idx, count, boxes, times) → (positions
+    (D, capacity) int32, hits (D,) int32)`` — positions[d, :hits[d]] are
+    global sorted-order row positions matching on shard d (lanes beyond the
+    hit count hold -1). With ``replicate=True`` the per-shard buffers are
+    ``all_gather``-merged over the data axis so every device holds the full
+    hit list (the reference's client-side merge of BatchScanner partials,
+    done on-fabric — ``AccumuloQueryPlan.scala:136`` role).
+
+    The ArrowScan/QueryPlan.scan role (``ArrowScan.scala:37``,
+    ``QueryPlan.scala:106``): a query that *returns rows*, executed
+    shard-parallel with collectives instead of scan RPC.
+    """
+
+    out_pos = P(None, None) if replicate else P(DATA_AXIS, None)
+    out_cnt = P(None) if replicate else P(DATA_AXIS)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
+        ),
+        out_specs=(out_pos, out_cnt),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, idx, count, boxes, times):
+        from geomesa_tpu.ops.refine import refine_points
+
+        mask = refine_points(x, y, bins, offs, idx[0], count[0], boxes, times)
+        localpos = idx[0]
+        base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
+        # stable stream compaction: prefix-sum destinations, OOB lanes drop
+        dest = jnp.where(mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, capacity)
+        out = jnp.full((capacity,), -1, dtype=jnp.int32)
+        out = out.at[dest].set(base + localpos, mode="drop")
+        hits = mask.sum(dtype=jnp.int32)
+        out = out[None, :]
+        hits = hits[None]
+        if replicate:
+            out = jax.lax.all_gather(out, DATA_AXIS, axis=0, tiled=True)
+            hits = jax.lax.all_gather(hits, DATA_AXIS, axis=0, tiled=True)
+        return out, hits
+
+    return step
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def cached_select_count_step(mesh: Mesh):
+    """Memoized per-mesh count step — jit caches key on function identity,
+    so sharing the closure across DataStore instances avoids recompiles."""
+    return make_select_count_step(mesh)
+
+
+@lru_cache(maxsize=None)
+def cached_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
+    return make_select_gather_step(mesh, capacity, replicate)
+
+
+@lru_cache(maxsize=None)
+def cached_batched_count_step(mesh: Mesh, impl: str = "auto"):
+    return make_batched_count_step(mesh, impl)
+
+
 def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
     """(Ql, Nl) bool: query q matches local row r (int-domain superset test)."""
     xi = x[None, None, :]  # (1, 1, Nl)

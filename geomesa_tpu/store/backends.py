@@ -63,27 +63,46 @@ class OracleBackend(ExecutionBackend):
 
 
 @dataclass
-class _DeviceIndexState:
-    """Per-index device columns, sorted in index order (points only)."""
+class _MeshIndexState:
+    """Per-index mesh-sharded device columns, sorted in index order.
 
-    x: Any  # jnp int32 (n,)
-    y: Any
-    bins: Any
-    offs: Any
+    ``cols`` holds x/y/bins/offs jnp arrays sharded contiguously over the
+    mesh ``data`` axis (curve order = shard order, SURVEY.md §2.20 P1);
+    padding rows live past ``n`` and never appear in scan intervals.
+    """
+
+    cols: dict[str, Any]
+    rows_per_shard: int
+    n: int
 
 
 class TpuBackend(ExecutionBackend):
-    """Sharded-columnar device execution (single-device v1; mesh in parallel/)."""
+    """Mesh-sharded columnar execution: the distributed-scan role of the
+    tablet-server fleet. Row retrieval is two-pass — per-shard refine counts
+    size the capacity lanes, then an on-device compaction gathers matching
+    global row positions per shard (``ArrowScan.scala:37`` /
+    ``QueryPlan.scala:106`` role, collectives instead of scan RPC)."""
 
     name = "tpu"
 
-    def load(self, sft, table, indices):
-        import jax.numpy as jnp
+    def __init__(self, mesh=None):
+        self._mesh = mesh
 
-        state: dict[str, _DeviceIndexState | None] = {}
+    def _get_mesh(self):
+        if self._mesh is None:
+            from geomesa_tpu.parallel.mesh import default_mesh
+
+            self._mesh = default_mesh()
+        return self._mesh
+
+    def load(self, sft, table, indices):
+        from geomesa_tpu.parallel.mesh import shard_columns
+
+        state: dict[str, _MeshIndexState | None] = {}
         nlon = norm_lon(REFINE_PRECISION)
         nlat = norm_lat(REFINE_PRECISION)
         binned = BinnedTime(sft.z3_interval) if sft.dtg_field else None
+        mesh = None
         for name, index in indices.items():
             col = table.geom_column() if sft.geom_field else None
             if (
@@ -94,6 +113,8 @@ class TpuBackend(ExecutionBackend):
             ):
                 state[name] = None  # host path
                 continue
+            if mesh is None:
+                mesh = self._get_mesh()
             perm = index.perm
             xi = nlon.normalize(col.x[perm]).astype(np.int32)
             yi = nlat.normalize(col.y[perm]).astype(np.int32)
@@ -104,11 +125,11 @@ class TpuBackend(ExecutionBackend):
             else:
                 bins = np.zeros(len(table), dtype=np.int32)
                 offs = np.zeros(len(table), dtype=np.int32)
-            state[name] = _DeviceIndexState(
-                x=jnp.asarray(xi),
-                y=jnp.asarray(yi),
-                bins=jnp.asarray(bins),
-                offs=jnp.asarray(offs),
+            cols, padded, rows_per_shard = shard_columns(
+                mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs}
+            )
+            state[name] = _MeshIndexState(
+                cols=cols, rows_per_shard=rows_per_shard, n=len(table)
             )
         return state
 
@@ -164,28 +185,59 @@ class TpuBackend(ExecutionBackend):
             sub = table.take(rows)
             return rows[residual.mask(sub)]
 
-        import jax.numpy as jnp
-
-        from geomesa_tpu.ops.refine import refine_points
-
-        positions, total = gather_indices(intervals)
-        bucket = pad_bucket(max(total, 1))
-        idx = np.zeros(bucket, dtype=np.int32)
-        idx[:total] = positions[:total]
-        boxes, times = self._payload(index.sft, extraction)
-        mask = refine_points(
-            dev.x,
-            dev.y,
-            dev.bins,
-            dev.offs,
-            jnp.asarray(idx),
-            jnp.int32(total),
-            jnp.asarray(boxes),
-            jnp.asarray(times),
-        )
-        mask = np.asarray(mask)[:total]
-        rows = index.perm[positions[:total][mask]]
+        positions = self._mesh_select_positions(dev, index, extraction, intervals)
+        rows = index.perm[positions]
         if isinstance(residual, ast.Include):
             return rows
         sub = table.take(rows)
         return rows[residual.mask(sub)]
+
+    def _mesh_select_positions(
+        self, dev: _MeshIndexState, index, extraction, intervals
+    ) -> np.ndarray:
+        """Distributed two-pass refine → matching sorted-order positions."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.parallel.mesh import data_shards
+        from geomesa_tpu.parallel.query import (
+            cached_select_count_step,
+            cached_select_gather_step,
+            max_shard_candidates,
+            split_intervals_by_shard,
+        )
+
+        mesh = self._get_mesh()
+        n_shards = data_shards(mesh)
+        mx = max_shard_candidates(intervals, dev.rows_per_shard, n_shards)
+        if mx == 0:
+            return np.empty(0, dtype=np.int64)
+        bucket = pad_bucket(mx)
+        idx, counts = split_intervals_by_shard(
+            intervals, dev.rows_per_shard, n_shards, bucket
+        )
+        boxes, times = self._payload(index.sft, extraction)
+        d_idx = jnp.asarray(idx)
+        d_counts = jnp.asarray(counts)
+        d_boxes = jnp.asarray(boxes)
+        d_times = jnp.asarray(times)
+        c = dev.cols
+        per_shard = np.asarray(
+            cached_select_count_step(mesh)(
+                c["x"], c["y"], c["bins"], c["offs"],
+                d_idx, d_counts, d_boxes, d_times,
+            )
+        )
+        top = int(per_shard.max())
+        if top == 0:
+            return np.empty(0, dtype=np.int64)
+        capacity = pad_bucket(top, minimum=128)
+        step = cached_select_gather_step(mesh, capacity)
+        pos, hits = step(
+            c["x"], c["y"], c["bins"], c["offs"],
+            d_idx, d_counts, d_boxes, d_times,
+        )
+        pos = np.asarray(pos)
+        hits = np.asarray(hits)
+        return np.concatenate(
+            [pos[d, : hits[d]] for d in range(n_shards)]
+        ).astype(np.int64)

@@ -117,9 +117,14 @@ class DataStore:
 
             metrics = MetricsRegistry()
         self.metrics = metrics
+        from geomesa_tpu.utils import timeouts as _timeouts
         from geomesa_tpu.utils.timeouts import Watchdog
 
         self.watchdog = Watchdog()
+        # thread-exhaustion signal, sampled live at metrics snapshot time
+        self.metrics.gauge("store.query.abandoned_running").fn = (
+            _timeouts.abandoned_running
+        )
         # (scope type-name | None, fn(sft, query) -> query) pairs
         self._interceptors: list[tuple[str | None, Any]] = []
 
@@ -499,30 +504,31 @@ class DataStore:
             if p is None:
                 out[i] = 0
         if live:
-            import jax as _jax
             import jax.numpy as jnp
+
+            from geomesa_tpu.parallel.query import cached_batched_count_step
 
             boxes = np.stack([p[0] for _, p in live])
             times = np.stack([p[1] for _, p in live])
-            if _jax.default_backend() == "tpu":
-                from geomesa_tpu.ops.pallas_kernels import batched_count
+            # one fused scan over the mesh-sharded columns, counts
+            # psum-merged over the data axis (P4 + P6); the query batch must
+            # divide the mesh query axis — pad with duplicates and discard
+            mesh = self.backend._get_mesh()
+            from geomesa_tpu.parallel.mesh import QUERY_AXIS
 
-                counts = np.asarray(
-                    batched_count(
-                        dev.x, dev.y, dev.bins, dev.offs,
-                        jnp.int32(0), jnp.int32(st.main_rows),
-                        jnp.asarray(boxes), jnp.asarray(times),
-                    )
-                )
-            else:
-                from geomesa_tpu.parallel.query import _batched_masks
-
-                m = _batched_masks(
-                    dev.x, dev.y, dev.bins, dev.offs,
-                    jnp.int32(0), jnp.int32(st.main_rows),
+            qpad = (-len(live)) % mesh.shape[QUERY_AXIS]
+            if qpad:
+                boxes = np.concatenate([boxes, np.repeat(boxes[:1], qpad, 0)])
+                times = np.concatenate([times, np.repeat(times[:1], qpad, 0)])
+            step = cached_batched_count_step(mesh)
+            c = dev.cols
+            counts = np.asarray(
+                step(
+                    c["x"], c["y"], c["bins"], c["offs"],
+                    jnp.int32(st.main_rows),
                     jnp.asarray(boxes), jnp.asarray(times),
                 )
-                counts = np.asarray(m.sum(axis=1))
+            )
             for k, (i, _) in enumerate(live):
                 out[i] = int(counts[k])
         # batched queries still hit metrics + the audit trail

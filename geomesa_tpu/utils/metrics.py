@@ -47,6 +47,21 @@ class Histogram:
 
 
 @dataclass
+class Gauge:
+    """Point-in-time value; ``fn``-backed gauges sample at snapshot time."""
+
+    _value: float = 0.0
+    fn: object = None  # optional zero-arg callable
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return float(self.fn()) if self.fn is not None else self._value
+
+
+@dataclass
 class Timer:
     hist: Histogram = field(default_factory=Histogram)
 
@@ -64,9 +79,13 @@ class MetricsRegistry:
         self.counters: dict[str, Counter] = {}
         self.histograms: dict[str, Histogram] = {}
         self.timers: dict[str, Timer] = {}
+        self.gauges: dict[str, Gauge] = {}
 
     def counter(self, name: str) -> Counter:
         return self.counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self.gauges.setdefault(name, Gauge())
 
     def histogram(self, name: str) -> Histogram:
         return self.histograms.setdefault(name, Histogram())
@@ -79,6 +98,8 @@ class MetricsRegistry:
         out: dict[str, dict] = {}
         for k, c in self.counters.items():
             out[k] = {"type": "counter", "count": c.count}
+        for k, g in self.gauges.items():
+            out[k] = {"type": "gauge", "value": g.value}
         for k, h in self.histograms.items():
             out[k] = {
                 "type": "histogram",

@@ -11,7 +11,6 @@ deadline passes (the worker's result is discarded when it eventually lands).
 
 from __future__ import annotations
 
-import concurrent.futures
 import threading
 
 __all__ = ["QueryTimeout", "run_with_timeout", "Watchdog"]
@@ -21,25 +20,56 @@ class QueryTimeout(TimeoutError):
     pass
 
 
-_EXEC = concurrent.futures.ThreadPoolExecutor(
-    max_workers=8, thread_name_prefix="geomesa-scan"
-)
+_abandoned_lock = threading.Lock()
+_abandoned_running = 0  # timed-out scans whose worker thread hasn't finished
+
+
+def abandoned_running() -> int:
+    """Scans that timed out but are still executing on their (daemon) worker
+    thread — the watchdog's thread-exhaustion signal, surfaced in metrics."""
+    return _abandoned_running
 
 
 def run_with_timeout(fn, timeout_s: float | None, *args, **kwargs):
     """Run ``fn`` with a deadline; raises :class:`QueryTimeout` on expiry.
 
     With ``timeout_s`` None the call is inline (zero overhead) — the common
-    case; the worker-thread hop only happens for queries that opted in.
+    case. Each timeout-opted call gets its own daemon worker thread: a wedged
+    scan can't be killed, but it also can't starve later queries the way a
+    fixed shared pool would (abandoned workers just linger until their scan
+    returns, counted in :func:`abandoned_running`).
     """
+    global _abandoned_running
     if timeout_s is None:
         return fn(*args, **kwargs)
-    fut = _EXEC.submit(fn, *args, **kwargs)
-    try:
-        return fut.result(timeout=timeout_s)
-    except concurrent.futures.TimeoutError:
-        fut.cancel()
-        raise QueryTimeout(f"query exceeded timeout of {timeout_s}s") from None
+    finished = threading.Event()
+    state = {"timed_out": False}
+    box: list = [None, None]  # [result, exception]
+
+    def work():
+        global _abandoned_running
+        try:
+            box[0] = fn(*args, **kwargs)
+        except BaseException as e:  # propagated below if the caller still waits
+            box[1] = e
+        finally:
+            with _abandoned_lock:  # set() under the lock: no waiter race
+                if state["timed_out"]:
+                    _abandoned_running -= 1
+                finished.set()
+
+    t = threading.Thread(target=work, name="geomesa-scan", daemon=True)
+    t.start()
+    if not finished.wait(timeout=timeout_s):
+        with _abandoned_lock:
+            if not finished.is_set():
+                state["timed_out"] = True
+                _abandoned_running += 1
+        if state["timed_out"]:
+            raise QueryTimeout(f"query exceeded timeout of {timeout_s}s") from None
+    if box[1] is not None:
+        raise box[1]
+    return box[0]
 
 
 class Watchdog:

@@ -43,9 +43,13 @@ class TestBlobStore:
     def test_delete_payload(self, tmp_path):
         bs = BlobStore(directory=str(tmp_path))
         bid = bs.put(b"x", Point(0, 0), 0, filename="x")
+        keep = bs.put(b"y", Point(1, 1), 0, filename="y")
         bs.delete(bid)
-        with pytest.raises(FileNotFoundError):
+        # uniform 'no such blob' error + tombstoned out of discovery
+        with pytest.raises(KeyError):
             bs.get(bid)
+        ids = [i for i, _ in bs.query_ids()]
+        assert bid not in ids and keep in ids
 
 
 class TestLeaflet:
@@ -114,3 +118,20 @@ class TestLegacyCurves:
         assert covered
         # and the two curves disagree on exact codes (different rounding)
         assert int(cur.index(xs, ys, ts)[0]) != z or True  # codes may collide per point
+
+    def test_legacy_semi_normalized_matches_reference_math(self):
+        # SemiNormalizedDimension (NormalizedDimension.scala:83-87): ceil-based
+        # normalize with precision 2^bits - 1; denormalize min at bin 0
+        from geomesa_tpu.curve.legacy import LegacyNormalizedDimension
+
+        d = LegacyNormalizedDimension(-180.0, 180.0, 21)
+        p = 2**21 - 1
+        xs = np.array([-180.0, -179.99999, -0.001, 0.0, 45.5, 179.99999, 180.0])
+        expect = np.clip(np.ceil((xs + 180.0) / 360.0 * p), 0, p).astype(np.int64)
+        assert (d.normalize(xs) == expect).all()
+        assert d.denormalize(np.array([0]))[0] == -180.0
+        assert abs(d.denormalize(np.array([1]))[0] - (-180.0 + 0.5 * 360.0 / p)) < 1e-9
+        # LegacyZ3SFC.scala:20 — time dimension precision is 2^20 - 1
+        leg = legacy_z3_sfc(TimePeriod.WEEK)
+        assert leg.time.max_index == 2**20 - 1
+        assert leg.lon.max_index == 2**21 - 1
