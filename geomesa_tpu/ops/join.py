@@ -92,3 +92,175 @@ def points_in_polygons_count(x, y, verts, bbox):
 def points_in_polygons_mask(x, y, verts, bbox):
     """(K, N) bool membership masks — for small K where the full matrix fits."""
     return jax.lax.map(lambda poly: _membership(x, y, poly[0], poly[1]), (verts, bbox))
+
+
+# ---------------------------------------------------------------------------
+# Index-pruned block-sparse join (the 1B × 10K scale path, VERDICT r1 item 4)
+# ---------------------------------------------------------------------------
+
+_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+def pack_polygons_bucketed(polygons, buckets=_BUCKETS):
+    """Group polygons by vertex-count bucket (pow2 padding tiers).
+
+    Returns a list of (ids (Kb,) int64, verts (Kb, V, 2) f32, bbox (Kb, 4)
+    f32, nverts (Kb,) int32) — one entry per non-empty bucket. Removes the
+    round-1 hard cap at 64 vertices: each tier compiles its own kernel shape.
+    """
+    groups: dict[int, list[int]] = {}
+    shells = []
+    for i, p in enumerate(polygons):
+        if isinstance(p, MultiPolygon):
+            p = max(p.parts, key=lambda q: len(q.shell))
+        if not isinstance(p, Polygon):
+            raise ValueError(f"expected polygon, got {p.geom_type}")
+        shells.append(p)
+        nv = len(p.shell)
+        for b in buckets:
+            if nv <= b:
+                groups.setdefault(b, []).append(i)
+                break
+        else:
+            raise ValueError(
+                f"polygon {i} has {nv} vertices > max bucket {buckets[-1]}"
+            )
+    out = []
+    for b in sorted(groups):
+        ids = np.asarray(groups[b], dtype=np.int64)
+        verts, bbox, nverts = pack_polygons(
+            [shells[i] for i in ids], max_vertices=b
+        )
+        out.append((ids, verts, bbox, nverts))
+    return out
+
+
+def polygon_block_plan(
+    sorted_z2: np.ndarray,
+    bbox_deg: np.ndarray,
+    block: int,
+    rows_per_shard: int,
+    n_shards: int,
+    max_ranges: int = 16,
+    sfc=None,
+):
+    """Host planning for the block-sparse join: per-polygon z2 ranges →
+    per-shard LOCAL candidate block ids.
+
+    The store is z2-sorted and cut into fixed blocks of ``block`` rows
+    (``rows_per_shard`` must be a multiple of ``block``). A polygon's
+    candidate set is every block its bbox z-ranges touch — the TPU analog of
+    the reference planning ranges per query then batch-scanning them
+    (SURVEY.md §2.20 P4): fewer, fatter ranges; block granularity keeps
+    device shapes fixed.
+
+    Returns (blk (D, K, MB) int32 local block ids, nblk (D, K) int32) with
+    MB padded to a power of two; padding slots repeat block 0 and are masked
+    by ``nblk``.
+    """
+    from geomesa_tpu.curve.sfc import Z2SFC
+
+    if rows_per_shard % block:
+        raise ValueError(f"rows_per_shard {rows_per_shard} % block {block} != 0")
+    sfc = sfc or Z2SFC()
+    k = len(bbox_deg)
+    blocks_per_shard = rows_per_shard // block
+    per_shard: list[list[np.ndarray]] = [[] for _ in range(n_shards)]
+    max_blocks = 1
+    for p in range(k):
+        xmin, ymin, xmax, ymax = bbox_deg[p]
+        # bboxes arrive f32-rounded (pack_polygons); widen by one f32 ulp so
+        # points whose f64 coords sit just past a rounded-down edge (but whose
+        # f32 rounding lands inside) are never pruned out of the cover
+        xmin = float(np.nextafter(np.float32(xmin), np.float32(-np.inf)))
+        ymin = float(np.nextafter(np.float32(ymin), np.float32(-np.inf)))
+        xmax = float(np.nextafter(np.float32(xmax), np.float32(np.inf)))
+        ymax = float(np.nextafter(np.float32(ymax), np.float32(np.inf)))
+        zr = sfc.ranges([(xmin, ymin, xmax, ymax)], max_ranges=max_ranges)
+        if len(zr) == 0:
+            for d in range(n_shards):
+                per_shard[d].append(np.empty(0, dtype=np.int64))
+            continue
+        starts = np.searchsorted(sorted_z2, zr[:, 0], side="left")
+        ends = np.searchsorted(sorted_z2, zr[:, 1], side="right")
+        keep = ends > starts
+        b_lo = starts[keep] // block
+        b_hi = (ends[keep] - 1) // block + 1
+        # expand spans → unique global block ids (vectorized repeat-arange)
+        lens = b_hi - b_lo
+        tot = int(lens.sum())
+        if tot == 0:
+            gids = np.empty(0, dtype=np.int64)
+        else:
+            gids = np.unique(
+                np.repeat(b_lo, lens)
+                + (np.arange(tot) - np.repeat(np.cumsum(lens) - lens, lens))
+            )
+        owner = gids // blocks_per_shard
+        for d in range(n_shards):
+            local = gids[owner == d] - d * blocks_per_shard
+            per_shard[d].append(local)
+            if len(local) > max_blocks:
+                max_blocks = len(local)
+    mb = 1
+    while mb < max_blocks:
+        mb <<= 1
+    blk = np.zeros((n_shards, k, mb), dtype=np.int32)
+    nblk = np.zeros((n_shards, k), dtype=np.int32)
+    for d in range(n_shards):
+        for p in range(k):
+            ids = per_shard[d][p]
+            blk[d, p, : len(ids)] = ids
+            nblk[d, p] = len(ids)
+    return blk, nblk
+
+
+def make_block_join_step(mesh, block: int):
+    """Sharded block-sparse ST_Within count: every shard tests only its
+    planned candidate blocks per polygon, counts psum-merged over the data
+    axis.
+
+    fn(x, y, true_n, blk (D, K, MB), nblk (D, K), verts (K, V, 2),
+       bbox (K, 4)) → (K,) int32 counts.
+    """
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import DATA_AXIS
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(),
+            P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+            P(), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(x, y, true_n, blk, nblk, verts, bbox):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        mb = blk.shape[2]
+
+        def one(args):
+            b_ids, nb, ring, bb = args  # (MB,), (), (V, 2), (4,)
+            take = b_ids[:, None] * block + jnp.arange(block, dtype=jnp.int32)
+            take = take.reshape(-1)  # (MB·B,) local positions
+            live = (
+                (jnp.arange(mb, dtype=jnp.int32) < nb)[:, None]
+                .repeat(block, axis=1).reshape(-1)
+            ) & ((base + take) < true_n)
+            xs = x[take]
+            ys = y[take]
+            inside = _membership(xs, ys, ring, bb)
+            return (inside & live).sum(dtype=jnp.int32)
+
+        counts = jax.lax.map(one, (blk[0], nblk[0], verts, bbox))
+        return jax.lax.psum(counts, DATA_AXIS)
+
+    return step

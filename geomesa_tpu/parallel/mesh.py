@@ -48,6 +48,20 @@ def data_shards(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS]
 
 
+def pad_query_axis(mesh: Mesh, *arrays):
+    """Pad leading (query-batch) axis with duplicate rows so it divides the
+    mesh query axis; returns (padded arrays tuple, original length). Callers
+    slice results back to the original length."""
+    n = len(arrays[0])
+    pad = (-n) % mesh.shape[QUERY_AXIS]
+    if pad == 0:
+        return arrays, n
+    out = tuple(
+        np.concatenate([a, np.repeat(a[:1], pad, axis=0)]) for a in arrays
+    )
+    return out, n
+
+
 def pad_rows(n: int, shards: int) -> int:
     """Row count padded so every shard gets an equal contiguous slice."""
     return ((n + shards - 1) // shards) * shards

@@ -269,6 +269,60 @@ def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
+def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
+    """Like :func:`make_batched_count_step` but evaluates R independent query
+    batches in ONE dispatch via ``lax.scan`` — boxes (R, Q, B, 4), times
+    (R, Q, T, 4) → (R, Q) counts.
+
+    Purpose: device-time isolation on a tunnel-RTT-dominated rig. Each scan
+    iteration is a full HBM pass with *different* queries (so XLA cannot
+    hoist the body), making per-pass device time measurable as
+    ``(t(R2) - t(R1)) / (R2 - R1)`` with the dispatch RTT cancelled — the
+    memory-bound MFU analog (HBM bytes/s) falls straight out.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    interpret = jax.default_backend() != "tpu"
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(None, QUERY_AXIS, None, None),
+            P(None, QUERY_AXIS, None, None),
+        ),
+        out_specs=P(None, QUERY_AXIS),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, boxes_r, times_r):
+        base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
+
+        def one(carry, bt):
+            boxes, times = bt
+            if impl == "pallas":
+                from geomesa_tpu.ops.pallas_kernels import batched_count
+
+                counts = batched_count(
+                    x, y, bins, offs, base, true_n, boxes, times,
+                    interpret=interpret,
+                )
+            else:
+                m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)
+                counts = m.sum(axis=1, dtype=jnp.int32)
+            return carry, counts
+
+        _, counts_r = jax.lax.scan(one, 0, (boxes_r, times_r))
+        return jax.lax.psum(counts_r, DATA_AXIS)
+
+    return step
+
+
 def make_batched_overlap_step(mesh: Mesh):
     """Extended-geometry (XZ) throughput path: Q bbox-overlap counts over a
     store of per-feature bounding boxes, psum over data shards.
@@ -312,6 +366,66 @@ def make_batched_overlap_step(mesh: Mesh):
         return jax.lax.psum(counts, DATA_AXIS)
 
     return step
+
+
+def make_batched_knn_step(mesh: Mesh, k: int):
+    """Batched multi-point KNN in ONE pass: per-shard distance scan +
+    ``top_k``, candidates ``all_gather``-merged over the data axis and
+    re-ranked — replacing the reference's per-point iterative-deepening
+    window loop (``KNearestNeighborSearchProcess.scala:583``) with a single
+    device-parallel sweep (VERDICT r1 item 7).
+
+    fn(x, y, true_n, qx (Q,) f32 deg, qy (Q,) f32 deg) →
+        (dists (Q, k) f32 degrees, rows (Q, k) int32 global sorted-order
+        positions). Distances are planar f32 degrees (the CPU referee must
+    use the same f32 math; int→f32 coordinate rounding is ~2e-5°).
+    """
+
+    sx = np.float32(360.0 / 2**31)
+    sy = np.float32(180.0 / 2**31)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(),
+            P(QUERY_AXIS), P(QUERY_AXIS),
+        ),
+        out_specs=(P(QUERY_AXIS, None), P(QUERY_AXIS, None)),
+        check_vma=False,
+    )
+    def step(x, y, true_n, qx, qy):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+        xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
+        yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
+
+        def one(q):
+            qxi, qyi = q
+            d2 = (xf - qxi) ** 2 + (yf - qyi) ** 2
+            d2 = jnp.where(valid, d2, jnp.inf)
+            nd, ni = jax.lax.top_k(-d2, k)
+            return -nd, base + ni.astype(jnp.int32)
+
+        # sequential over queries: peak memory O(N), not O(Q·N)
+        dloc, iloc = jax.lax.map(one, (qx, qy))  # (Ql, k) each
+        # merge per-shard candidate heaps across the mesh
+        ad = jax.lax.all_gather(dloc, DATA_AXIS, axis=0)  # (D, Ql, k)
+        ai = jax.lax.all_gather(iloc, DATA_AXIS, axis=0)
+        d_all = jnp.moveaxis(ad, 0, 1).reshape(dloc.shape[0], -1)  # (Ql, D*k)
+        i_all = jnp.moveaxis(ai, 0, 1).reshape(iloc.shape[0], -1)
+        nd, sel = jax.lax.top_k(-d_all, k)
+        rows = jnp.take_along_axis(i_all, sel, axis=1)
+        return jnp.sqrt(-nd), rows
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_batched_knn_step(mesh: Mesh, k: int):
+    return make_batched_knn_step(mesh, k)
 
 
 def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):

@@ -18,7 +18,7 @@ re-runs with a bigger capacity — balanced splits keep the default ample).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -34,6 +34,7 @@ __all__ = ["make_reshard_step", "reshard"]
 _SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
+@lru_cache(maxsize=None)
 def make_reshard_step(mesh: Mesh, n_columns: int, capacity: int):
     """Build the jitted reshard step for ``n_columns`` int32 payload columns.
 
@@ -104,15 +105,24 @@ def make_reshard_step(mesh: Mesh, n_columns: int, capacity: int):
     return step
 
 
-def reshard(mesh: Mesh, key_sharded, true_n: int, splits: np.ndarray, cols: dict):
+def reshard(
+    mesh: Mesh,
+    key_sharded,
+    true_n: int,
+    splits: np.ndarray,
+    cols: dict,
+    capacity: int | None = None,
+):
     """Convenience wrapper: reshard device arrays by ``splits``.
 
     Returns (key_out, cols_out dict, counts (S,), overflow int). ``capacity``
-    auto-sizes to 2× the balanced per-lane load (+margin).
+    (rows per source→destination lane) auto-sizes to 2× the balanced
+    per-lane load (+margin); callers retry with a larger one on overflow.
     """
     shards = data_shards(mesh)
     nloc = key_sharded.shape[0] // shards
-    capacity = max(8, (2 * nloc) // shards + 8)
+    if capacity is None:
+        capacity = max(8, (2 * nloc) // shards + 8)
     step = make_reshard_step(mesh, len(cols), capacity)
     rep = NamedSharding(mesh, P())
     names = list(cols)

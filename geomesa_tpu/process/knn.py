@@ -69,6 +69,60 @@ def knn(
     return r.table.take(order), d[order]
 
 
+def knn_many(ds, type_name: str, points, k: int = 10):
+    """Batched KNN: all query points answered in ONE device pass.
+
+    Device path (TpuBackend): per-shard f32 distance scan + ``top_k``,
+    candidate heaps merged across the mesh
+    (:func:`geomesa_tpu.parallel.query.make_batched_knn_step`) — the
+    reference's per-point window-doubling loop collapses into a single
+    sweep. Other backends fall back to per-point :func:`knn`.
+
+    Returns a list of (table, distances_deg) pairs, one per query point,
+    each holding that point's k nearest features sorted by distance.
+    """
+    from geomesa_tpu.store.backends import TpuBackend
+
+    st = ds._state(type_name)
+    dev = index_name = None
+    if isinstance(ds.backend, TpuBackend):
+        dev, index_name = TpuBackend.point_state(st.backend_state)
+    if (
+        dev is None
+        or st.delta.merged() is not None
+        or st.main_rows == 0
+        # TTL masking is injected per-query in query(); the device columns
+        # still hold expired rows — take the exact per-point path
+        or ds._age_off_ttl_ms(st.sft) is not None
+    ):
+        return [knn(ds, type_name, p, k) for p in points]
+
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel.mesh import pad_query_axis
+    from geomesa_tpu.parallel.query import cached_batched_knn_step
+
+    mesh = ds.backend._get_mesh()
+    kk = min(k, st.main_rows)
+    step = cached_batched_knn_step(mesh, kk)
+    qx = np.array([p.x for p in points], dtype=np.float32)
+    qy = np.array([p.y for p in points], dtype=np.float32)
+    (qx, qy), _ = pad_query_axis(mesh, qx, qy)
+    c = dev.cols
+    dists, pos = step(
+        c["x"], c["y"], jnp.int32(st.main_rows),
+        jnp.asarray(qx), jnp.asarray(qy),
+    )
+    dists = np.asarray(dists)[: len(points)]
+    pos = np.asarray(pos)[: len(points)]
+    perm = st.indices[index_name].perm
+    out = []
+    for qi in range(len(points)):
+        rows = perm[pos[qi]]
+        out.append((st.table.take(rows), dists[qi].astype(np.float64)))
+    return out
+
+
 def _distances(r, point: Point) -> np.ndarray:
     col = r.table.geom_column()
     if col.x is not None:

@@ -95,6 +95,21 @@ class TpuBackend(ExecutionBackend):
             self._mesh = default_mesh()
         return self._mesh
 
+    @staticmethod
+    def point_state(state) -> tuple["_MeshIndexState | None", str | None]:
+        """The preferred point-index device state: (state, index name).
+
+        Shared by every batched device fast path (count_many, knn_many) so
+        index preference stays in one place.
+        """
+        if not state:
+            return None, None
+        for name in ("z3", "z2"):
+            dev = state.get(name)
+            if dev is not None:
+                return dev, name
+        return None, None
+
     def load(self, sft, table, indices):
         from geomesa_tpu.parallel.mesh import shard_columns
 

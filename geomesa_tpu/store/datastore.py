@@ -467,8 +467,8 @@ class DataStore:
             return self.query(type_name, q).count
 
         dev = None
-        if isinstance(self.backend, TpuBackend) and st.backend_state:
-            dev = st.backend_state.get("z3") or st.backend_state.get("z2")
+        if isinstance(self.backend, TpuBackend):
+            dev, _ = TpuBackend.point_state(st.backend_state)
         if (
             not loose
             or dev is None
@@ -513,13 +513,10 @@ class DataStore:
             # one fused scan over the mesh-sharded columns, counts
             # psum-merged over the data axis (P4 + P6); the query batch must
             # divide the mesh query axis — pad with duplicates and discard
-            mesh = self.backend._get_mesh()
-            from geomesa_tpu.parallel.mesh import QUERY_AXIS
+            from geomesa_tpu.parallel.mesh import pad_query_axis
 
-            qpad = (-len(live)) % mesh.shape[QUERY_AXIS]
-            if qpad:
-                boxes = np.concatenate([boxes, np.repeat(boxes[:1], qpad, 0)])
-                times = np.concatenate([times, np.repeat(times[:1], qpad, 0)])
+            mesh = self.backend._get_mesh()
+            (boxes, times), _ = pad_query_axis(mesh, boxes, times)
             step = cached_batched_count_step(mesh)
             c = dev.cols
             counts = np.asarray(

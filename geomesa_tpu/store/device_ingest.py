@@ -1,0 +1,75 @@
+"""Device-side bulk ingest & rebalance: stats-driven splits + all_to_all.
+
+Role parity: ``DefaultSplitter.scala:33`` (stats-driven table cut points) and
+the tablet split/migration rebalancing the reference delegates to its storage
+layer (SURVEY.md §2.20 P1/P8). TPU-native lifecycle step: rows land on the
+mesh in ARRIVAL order (no host sort), split keys are sampled-quantile cuts of
+the *resident* keys, and one ``all_to_all`` reshard routes every row to its
+z-range owner shard with a local sort — after which per-device row counts are
+balanced to within sampling error even for fully skewed geodata (all points
+in one hemisphere). Used by the lambda-tier persister when draining the hot
+tier and by bulk mesh ingest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from geomesa_tpu.parallel.mesh import Mesh, data_shards, shard_columns
+from geomesa_tpu.parallel.reshard import reshard
+from geomesa_tpu.store.splitter import balanced_splits
+
+__all__ = ["sampled_splits", "device_bulk_build"]
+
+
+def sampled_splits(
+    key_sharded, true_n: int, n_shards: int, per_shard_samples: int = 2048
+) -> np.ndarray:
+    """Stats-driven shard cut points from a strided device-side key sample.
+
+    Pulls ~``per_shard_samples × n_shards`` keys (a few KB) instead of the
+    full column — the quantile estimate errs by O(1/samples), far inside the
+    10% balance budget.
+    """
+    total = int(key_sharded.shape[0])
+    want = max(per_shard_samples * n_shards, n_shards)
+    stride = max(1, true_n // want)
+    sample = np.asarray(jax.device_get(key_sharded[:true_n:stride]))
+    return balanced_splits(np.sort(sample), n_shards)
+
+
+def device_bulk_build(mesh: Mesh, keys: np.ndarray, payload: dict):
+    """Arrival-order rows → balanced, per-shard-sorted device store.
+
+    ``keys``: (n,) uint64 curve keys in arrival order; ``payload``: int32
+    columns riding along. Returns (key_out, cols_out, counts, splits):
+    device arrays sharded over the mesh data axis where shard d owns keys in
+    ``[splits[d-1], splits[d])``, locally sorted, with ``counts[d]`` real
+    rows. Overflowing capacity lanes (badly skewed arrival order) retry with
+    doubled capacity — fixed shapes stay compile-cached per capacity.
+    """
+    n = len(keys)
+    shards = data_shards(mesh)
+    cols, padded, rows_per_shard = shard_columns(
+        mesh, {"key": keys.astype(np.uint64), **payload}
+    )
+    splits = sampled_splits(cols["key"], n, shards)
+    payload_dev = {k: cols[k] for k in payload}
+    capacity = None
+    for _ in range(8):
+        key_out, cols_out, counts, ovf = reshard(
+            mesh, cols["key"], n, splits, payload_dev, capacity=capacity
+        )
+        if ovf == 0:
+            return key_out, cols_out, counts, splits
+        capacity = (capacity or max(8, (2 * rows_per_shard) // shards + 8)) * 2
+        if capacity >= rows_per_shard:
+            capacity = rows_per_shard  # one lane can hold a whole shard
+    key_out, cols_out, counts, ovf = reshard(
+        mesh, cols["key"], n, splits, payload_dev, capacity=rows_per_shard
+    )
+    if ovf != 0:
+        raise RuntimeError(f"reshard overflow persisted at full capacity: {ovf}")
+    return key_out, cols_out, counts, splits

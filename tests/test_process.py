@@ -140,3 +140,148 @@ class TestJoin:
         col = r.table.geom_column()
         expected = int(P.points_within_geom(col.x, col.y, poly).sum())
         assert abs(int(counts[0]) - expected) <= 2  # f32 edge tolerance
+
+
+class TestBatchedKnn:
+    def test_knn_many_matches_f32_referee(self):
+        import numpy as np
+
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.store.datastore import DataStore
+
+        rng = np.random.default_rng(21)
+        n = 5000
+        lon = rng.uniform(-120, 120, n)
+        lat = rng.uniform(-60, 60, n)
+        recs = [
+            {"dtg": 1_500_000_000_000 + int(i), "geom": Point(float(lon[i]), float(lat[i]))}
+            for i in range(n)
+        ]
+        ds = DataStore(backend="tpu")
+        ds.create_schema("k", "dtg:Date,*geom:Point")
+        ds.write("k", recs, fids=[str(i) for i in range(n)])
+        pts = [Point(float(x), float(y))
+               for x, y in rng.uniform(-50, 50, (5, 2))]
+        res = knn_many(ds, "k", pts, k=7)
+        assert len(res) == 5
+        # referee in the SAME f32 int-rounded coordinate math as the kernel
+        from geomesa_tpu.curve.normalize import lat as nlat, lon as nlon
+
+        xi = nlon(31).normalize(lon).astype(np.int32)
+        yi = nlat(31).normalize(lat).astype(np.int32)
+        xf = xi.astype(np.float32) * np.float32(360.0 / 2**31) - np.float32(180.0)
+        yf = yi.astype(np.float32) * np.float32(180.0 / 2**31) - np.float32(90.0)
+        for qi, p in enumerate(pts):
+            d2 = (xf - np.float32(p.x)) ** 2 + (yf - np.float32(p.y)) ** 2
+            best = np.sort(d2)[:7].astype(np.float64)
+            got, dist = res[qi]
+            assert len(got) == 7
+            # device math uses f32 FMA: ~1e-5 relative drift vs numpy f32
+            np.testing.assert_allclose(dist**2, best, rtol=1e-4)
+            # fids are the true nearest set (allow ties at the k-th distance)
+            kth = best[-1]
+            must = set(np.nonzero(d2 < kth * (1 - 1e-4))[0].astype(str))
+            assert must.issubset(set(got.fids.tolist()))
+
+    def test_knn_many_falls_back_on_oracle(self):
+        import numpy as np
+
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.store.datastore import DataStore
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema("k2", "dtg:Date,*geom:Point")
+        recs = [{"dtg": i, "geom": Point(i * 0.1, 0.0)} for i in range(50)]
+        ds.write("k2", recs, fids=[str(i) for i in range(50)])
+        res = knn_many(ds, "k2", [Point(0.0, 0.0)], k=3)
+        assert len(res) == 1 and len(res[0][0]) == 3
+        assert set(res[0][0].fids.tolist()) == {"0", "1", "2"}
+
+
+class TestBlockSparseJoin:
+    """Index-pruned block-sparse ST_Within join == brute-force f32 kernel."""
+
+    def test_block_join_matches_brute_force(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        import geomesa_tpu  # noqa: F401
+        from geomesa_tpu import native
+        from geomesa_tpu.curve.sfc import Z2SFC
+        from geomesa_tpu.geometry.types import Polygon
+        from geomesa_tpu.ops.join import (
+            make_block_join_step,
+            pack_polygons,
+            pack_polygons_bucketed,
+            points_in_polygons_count,
+            polygon_block_plan,
+        )
+        from geomesa_tpu.parallel.mesh import data_shards, make_mesh, shard_columns
+
+        rng = np.random.default_rng(33)
+        n = 40_000
+        lon = np.concatenate([rng.normal(10, 5, n // 2), rng.uniform(-170, 170, n - n // 2)])
+        lat = np.concatenate([rng.normal(20, 4, n // 2), rng.uniform(-80, 80, n - n // 2)])
+        sfc = Z2SFC()
+        z = sfc.index(lon, lat)
+        perm = native.sort_u64(z)
+        z_sorted = z[perm]
+        xs = lon[perm].astype(np.float32)
+        ys = lat[perm].astype(np.float32)
+
+        polys = []
+        for _ in range(23):  # odd count exercises padding
+            cx, cy = rng.uniform(-20, 40), rng.uniform(0, 40)
+            ang = np.sort(rng.uniform(0, 2 * np.pi, rng.integers(8, 90)))
+            rad = rng.uniform(0.5, 1.0, len(ang))
+            w, h = rng.uniform(1, 6, 2)
+            ring = np.stack([cx + w * rad * np.cos(ang), cy + h * rad * np.sin(ang)], 1)
+            polys.append(Polygon(ring))
+
+        mesh = make_mesh()
+        shards = data_shards(mesh)
+        block = 512
+        # pad rows so every shard is a whole number of blocks
+        mult = shards * block
+        pad_n = ((n + mult - 1) // mult) * mult
+        padz = np.concatenate([z_sorted, np.full(pad_n - n, 2**63, np.uint64)])
+        cols, padded, rows_per_shard = shard_columns(
+            mesh, {"x": np.concatenate([xs, np.zeros(pad_n - n, np.float32)]),
+                   "y": np.concatenate([ys, np.zeros(pad_n - n, np.float32)])}
+        )
+        assert rows_per_shard % block == 0
+
+        step = make_block_join_step(mesh, block)
+        total_expected = []
+        for ids, verts, bbox, nverts in pack_polygons_bucketed(polys):
+            blk, nblk = polygon_block_plan(
+                padz, bbox.astype(np.float64), block, rows_per_shard, shards
+            )
+            counts = np.asarray(step(
+                cols["x"], cols["y"], jnp.int32(n),
+                jnp.asarray(blk), jnp.asarray(nblk),
+                jnp.asarray(verts), jnp.asarray(bbox),
+            ))
+            # brute force with the identical f32 membership kernel
+            vb, bb, _ = pack_polygons([polys[i] for i in ids],
+                                      max_vertices=verts.shape[1])
+            brute = np.asarray(points_in_polygons_count(
+                jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(vb), jnp.asarray(bb)
+            ))
+            np.testing.assert_array_equal(counts, brute)
+            total_expected.append(int(brute.sum()))
+        assert sum(total_expected) > 100  # non-vacuous
+
+    def test_bucketing_rejects_oversize(self):
+        import numpy as np
+        import pytest
+
+        from geomesa_tpu.geometry.types import Polygon
+        from geomesa_tpu.ops.join import pack_polygons_bucketed
+
+        ang = np.linspace(0, 2 * np.pi, 600)
+        ring = np.stack([np.cos(ang), np.sin(ang)], 1)
+        with pytest.raises(ValueError, match="vertices"):
+            pack_polygons_bucketed([Polygon(ring)])
