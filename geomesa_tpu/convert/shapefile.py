@@ -1,0 +1,244 @@
+"""Shapefile (.shp/.shx/.dbf) reader + point writer.
+
+Role parity: ``geomesa-convert/geomesa-convert-shp`` and the tools' shp
+export (SURVEY.md §2.16/§2.17). Implemented from the public ESRI shapefile
+and dBase III specs: the .shp geometry record stream (Point, PolyLine,
+Polygon), the .dbf fixed-width attribute table, and for export the
+.shp/.shx/.dbf triple for point layers.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import LineString, Point, Polygon
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import AttributeType, FeatureType, parse_spec
+
+__all__ = ["read_shapefile", "write_shapefile", "shapefile_sft"]
+
+SHP_POINT = 1
+SHP_POLYLINE = 3
+SHP_POLYGON = 5
+
+_DBF_TO_ATTR = {"C": AttributeType.STRING, "N": AttributeType.DOUBLE,
+                "F": AttributeType.DOUBLE, "L": AttributeType.BOOLEAN,
+                "D": AttributeType.DATE}
+
+
+def _read_dbf(path: Path):
+    """dBase III: → (field names, attr types, record dicts)."""
+    data = path.read_bytes()
+    n_records = struct.unpack("<I", data[4:8])[0]
+    header_size, record_size = struct.unpack("<HH", data[8:12])
+    fields = []
+    off = 32
+    while data[off] != 0x0D:  # field descriptor terminator
+        raw = data[off : off + 32]
+        name = raw[:11].split(b"\x00")[0].decode("ascii", "replace")
+        ftype = chr(raw[11])
+        length = raw[16]
+        decimals = raw[17]
+        fields.append((name, ftype, length, decimals))
+        off += 32
+    records = []
+    pos = header_size
+    for _ in range(n_records):
+        rec_raw = data[pos : pos + record_size]
+        pos += record_size
+        if not rec_raw or rec_raw[0:1] == b"*":  # deleted
+            continue
+        rec = {}
+        fo = 1
+        for name, ftype, length, decimals in fields:
+            cell = rec_raw[fo : fo + length].decode("ascii", "replace").strip()
+            fo += length
+            if cell == "":
+                rec[name] = None
+            elif ftype in ("N", "F"):
+                rec[name] = int(cell) if ftype == "N" and decimals == 0 and "." not in cell else float(cell)
+            elif ftype == "L":
+                rec[name] = cell in ("T", "t", "Y", "y")
+            elif ftype == "D":  # YYYYMMDD
+                import datetime
+
+                try:
+                    d = datetime.datetime.strptime(cell, "%Y%m%d")
+                    rec[name] = int(d.replace(tzinfo=datetime.timezone.utc).timestamp() * 1000)
+                except ValueError:
+                    rec[name] = None
+            else:
+                rec[name] = cell
+        records.append(rec)
+    return fields, records
+
+
+def _read_shp(path: Path):
+    """→ list of geometries (None for null shapes)."""
+    data = path.read_bytes()
+    (code,) = struct.unpack(">i", data[0:4])
+    if code != 9994:
+        raise ValueError("not a shapefile (bad magic)")
+    geoms = []
+    pos = 100
+    while pos < len(data):
+        _, length_words = struct.unpack(">ii", data[pos : pos + 8])
+        pos += 8
+        body = data[pos : pos + length_words * 2]
+        pos += length_words * 2
+        (stype,) = struct.unpack("<i", body[0:4])
+        if stype == 0:
+            geoms.append(None)
+        elif stype == SHP_POINT:
+            x, y = struct.unpack("<dd", body[4:20])
+            geoms.append(Point(x, y))
+        elif stype in (SHP_POLYLINE, SHP_POLYGON):
+            n_parts, n_points = struct.unpack("<ii", body[36:44])
+            parts = struct.unpack(f"<{n_parts}i", body[44 : 44 + 4 * n_parts])
+            coords = np.frombuffer(
+                body[44 + 4 * n_parts : 44 + 4 * n_parts + 16 * n_points],
+                dtype="<f8",
+            ).reshape(n_points, 2)
+            bounds = list(parts) + [n_points]
+            rings = [
+                np.array(coords[bounds[i] : bounds[i + 1]])
+                for i in range(n_parts)
+            ]
+            if stype == SHP_POLYLINE:
+                geoms.append(LineString(np.vstack(rings)))
+            else:
+                geoms.append(Polygon(rings[0], holes=rings[1:]))
+        else:
+            raise ValueError(f"unsupported shape type: {stype}")
+    return geoms
+
+
+def shapefile_sft(name: str, shp_path: str) -> FeatureType:
+    """Infer a feature type from the .dbf fields + shape type."""
+    base = Path(shp_path).with_suffix("")
+    fields, _ = _read_dbf(base.with_suffix(".dbf"))
+    geoms = _read_shp(base.with_suffix(".shp"))
+    gtype = "Geometry"
+    for g in geoms:
+        if g is not None:
+            gtype = {"Point": "Point", "LineString": "LineString",
+                     "Polygon": "Polygon"}[g.geom_type]
+            break
+    attr_spec = ",".join(
+        f"{n}:{_DBF_TO_ATTR[t].value if t in _DBF_TO_ATTR else 'String'}"
+        for n, t, _, _ in fields
+    )
+    spec = (attr_spec + "," if attr_spec else "") + f"*geom:{gtype}"
+    return parse_spec(name, spec)
+
+
+def read_shapefile(shp_path: str, sft: FeatureType | None = None) -> FeatureTable:
+    """Read .shp + .dbf into a FeatureTable (geometry column = ``geom``)."""
+    base = Path(shp_path).with_suffix("")
+    sft = sft or shapefile_sft(base.name, shp_path)
+    _, records = _read_dbf(base.with_suffix(".dbf"))
+    geoms = _read_shp(base.with_suffix(".shp"))
+    if len(records) != len(geoms):
+        raise ValueError(
+            f".dbf rows ({len(records)}) != .shp shapes ({len(geoms)})"
+        )
+    for rec, g in zip(records, geoms):
+        rec[sft.geom_field or "geom"] = g
+    fids = [f"{sft.name}.{i}" for i in range(len(records))]
+    return FeatureTable.from_records(sft, records, fids)
+
+
+def write_shapefile(table: FeatureTable, shp_path: str) -> None:
+    """Write a POINT FeatureTable as .shp/.shx/.dbf (the shp export role)."""
+    base = Path(shp_path).with_suffix("")
+    col = table.geom_column()
+    if col.x is None:
+        raise ValueError("shapefile export supports point layers only")
+    n = len(table)
+    x, y = col.x, col.y
+
+    # .shp + .shx
+    rec_body = struct.pack("<i", SHP_POINT)
+    rec_len_words = (len(rec_body) + 16) // 2
+    shp_len_words = 50 + n * (4 + rec_len_words)
+    bbox = (
+        (float(x.min()), float(y.min()), float(x.max()), float(y.max()))
+        if n
+        else (0.0, 0.0, 0.0, 0.0)
+    )
+
+    def header(total_words):
+        return (
+            struct.pack(">i20x i", 9994, total_words)
+            + struct.pack("<ii", 1000, SHP_POINT)
+            + struct.pack("<4d", *bbox)
+            + struct.pack("<4d", 0, 0, 0, 0)
+        )
+
+    with open(base.with_suffix(".shp"), "wb") as f, open(
+        base.with_suffix(".shx"), "wb"
+    ) as fx:
+        f.write(header(shp_len_words))
+        fx.write(header(50 + n * 4))
+        offset = 50
+        for i in range(n):
+            f.write(struct.pack(">ii", i + 1, rec_len_words))
+            f.write(struct.pack("<idd", SHP_POINT, float(x[i]), float(y[i])))
+            fx.write(struct.pack(">ii", offset, rec_len_words))
+            offset += 4 + rec_len_words
+
+    # .dbf
+    attrs = [a for a in table.sft.attributes if not a.type.is_geometry]
+
+    def dbf_field(a):
+        if a.type in (AttributeType.INT, AttributeType.LONG):
+            return (a.name[:10], "N", 18, 0)
+        if a.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            return (a.name[:10], "N", 24, 8)
+        if a.type == AttributeType.BOOLEAN:
+            return (a.name[:10], "L", 1, 0)
+        if a.type == AttributeType.DATE:
+            return (a.name[:10], "D", 8, 0)
+        return (a.name[:10], "C", 64, 0)
+
+    fields = [dbf_field(a) for a in attrs]
+    record_size = 1 + sum(f[2] for f in fields)
+    header_size = 32 + 32 * len(fields) + 1
+    with open(base.with_suffix(".dbf"), "wb") as f:
+        f.write(struct.pack("<B3B I HH 20x", 0x03, 24, 1, 1, n,
+                            header_size, record_size))
+        for name, ftype, length, decimals in fields:
+            f.write(
+                name.encode("ascii").ljust(11, b"\x00")
+                + ftype.encode("ascii")
+                + b"\x00" * 4
+                + bytes([length, decimals])
+                + b"\x00" * 14
+            )
+        f.write(b"\x0d")
+        for i in range(n):
+            f.write(b" ")
+            rec = table.record(i)
+            for (name, ftype, length, decimals), a in zip(fields, attrs):
+                v = rec.get(a.name)
+                if v is None:
+                    cell = ""
+                elif ftype == "N" and decimals:
+                    cell = f"{float(v):.{decimals}f}"
+                elif ftype == "N":
+                    cell = str(int(v))
+                elif ftype == "L":
+                    cell = "T" if v else "F"
+                elif ftype == "D":
+                    import datetime
+
+                    cell = datetime.datetime.fromtimestamp(
+                        v / 1000, datetime.timezone.utc
+                    ).strftime("%Y%m%d")
+                else:
+                    cell = str(v)
+                f.write(cell[:length].rjust(length).encode("ascii", "replace"))
+        f.write(b"\x1a")

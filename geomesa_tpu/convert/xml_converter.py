@@ -1,0 +1,202 @@
+"""XML ingest converter: xpath-subset field extraction → FeatureTable.
+
+Role parity: ``geomesa-convert/geomesa-convert-xml`` (SURVEY.md §2.16):
+declarative mappings from XML documents into typed SFT attributes, sharing
+the delimited/JSON converters' typed column builders and error modes.
+
+Path grammar (ElementTree xpath subset, relative to each feature element):
+
+    a/b            nested child elements (text content)
+    @id            attribute of the feature element
+    a/@units       attribute of a nested element
+    .              the feature element's own text
+
+Field expressions: a bare path, ``point(<path>, <path>)`` for lon/lat,
+``wkt(<path>)`` for WKT geometry text, ``concat(<path>, 'lit', ...)``.
+``feature_path`` is an ElementTree ``iterfind`` pattern for the repeating
+feature element (e.g. ``.//row`` or ``items/item``).
+"""
+
+from __future__ import annotations
+
+import re
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import (
+    EvaluationContext,
+    _boolean_column,
+    _date_column,
+    _numeric_column,
+    _split_args,
+)
+from geomesa_tpu.schema.columnar import (
+    Column,
+    FeatureTable,
+    _geometry_column,
+    point_column,
+)
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+_NUMERIC = {
+    AttributeType.INT,
+    AttributeType.LONG,
+    AttributeType.FLOAT,
+    AttributeType.DOUBLE,
+}
+
+__all__ = ["XmlConverter"]
+
+
+def _extract(elem: ET.Element, path: str) -> str:
+    """One path against one element → text ('' when absent)."""
+    path = path.strip()
+    if path == ".":
+        return (elem.text or "").strip()
+    if path.startswith("@"):
+        return str(elem.get(path[1:], ""))
+    if "/@" in path:
+        sub, attr = path.rsplit("/@", 1)
+        child = elem.find(sub)
+        return "" if child is None else str(child.get(attr, ""))
+    child = elem.find(path)
+    return "" if child is None or child.text is None else child.text.strip()
+
+
+class XmlConverter:
+    """XML documents → FeatureTable for one schema.
+
+    ``fields``: {attribute: expression}; ``id_field``: expression for ids.
+    """
+
+    def __init__(
+        self,
+        sft: FeatureType,
+        fields: dict[str, str],
+        feature_path: str = ".//feature",
+        id_field: str | None = None,
+        error_mode: str = "skip",
+    ):
+        self.sft = sft
+        self.fields = fields
+        self.feature_path = feature_path
+        self.id_field = id_field
+        if error_mode not in ("skip", "raise"):
+            raise ValueError(f"error_mode must be skip|raise: {error_mode}")
+        self.error_mode = error_mode
+
+    def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        with open(path, encoding="utf-8") as f:
+            return self.convert_str(f.read(), ctx)
+
+    def convert_str(self, text: str, ctx: EvaluationContext | None = None) -> FeatureTable:
+        root = ET.fromstring(text)
+        elems = (
+            [root]
+            if self.feature_path in (".", "")
+            else list(root.iterfind(self.feature_path))
+        )
+        ctx = ctx if ctx is not None else EvaluationContext()
+        n = len(elems)
+        cols: dict[str, Column] = {}
+        bad = np.zeros(n, dtype=bool)
+        for a in self.sft.attributes:
+            expr = self.fields.get(a.name, a.name)
+            try:
+                col, col_bad = self._eval(expr, elems, a.type)
+            except Exception as e:
+                raise ValueError(
+                    f"transform {expr!r} for {a.name!r} failed: {e}"
+                ) from e
+            cols[a.name] = col
+            bad |= col_bad
+        if bad.any():
+            if self.error_mode == "raise":
+                idx = int(np.nonzero(bad)[0][0])
+                raise ValueError(f"bad record at index {idx}")
+            ctx.failure += int(bad.sum())
+            good = ~bad
+            cols = {k: c.take(good) for k, c in cols.items()}
+        else:
+            good = slice(None)
+        ctx.success += int((~bad).sum())
+        if self.id_field:
+            fid_col, _ = self._eval(self.id_field, elems, AttributeType.STRING)
+            fids = fid_col.values[good]
+        else:
+            fids = np.arange(n)[good].astype(str).astype(object)
+        return FeatureTable(self.sft, np.asarray(fids, dtype=object), cols)
+
+    # -- expression evaluation ------------------------------------------------
+    def _raw(self, expr: str, elems) -> np.ndarray:
+        expr = expr.strip()
+        out = np.empty(len(elems), dtype=object)
+        if expr.startswith(("'", '"')):
+            out[:] = expr[1:-1]
+            return out
+        if expr.startswith("concat"):
+            m = re.match(r"^concat\s*\((.*)\)$", expr, re.S)
+            parts = [self._raw(a, elems) for a in _split_args(m.group(1))]
+            acc = parts[0].astype(str)
+            for p in parts[1:]:
+                acc = np.char.add(acc, p.astype(str))
+            return acc.astype(object)
+        for i, e in enumerate(elems):
+            out[i] = _extract(e, expr)
+        return out
+
+    def _eval(self, expr: str, elems, typ: AttributeType):
+        expr = expr.strip()
+        n = len(elems)
+        m = re.match(r"^(\w+)\s*\((.*)\)$", expr, re.S)
+        fn = (
+            m.group(1).lower()
+            if m and m.group(1).lower() in ("point", "wkt")
+            else None
+        )
+
+        if fn == "point":
+            ax, ay = _split_args(m.group(2))
+            import pandas as pd
+
+            xs = pd.to_numeric(pd.Series(self._raw(ax, elems)), errors="coerce").to_numpy(np.float64)
+            ys = pd.to_numeric(pd.Series(self._raw(ay, elems)), errors="coerce").to_numpy(np.float64)
+            bad = ~(np.isfinite(xs) & np.isfinite(ys))
+            bad |= (np.abs(np.nan_to_num(xs)) > 180) | (np.abs(np.nan_to_num(ys)) > 90)
+            return point_column(np.where(bad, 0.0, xs), np.where(bad, 0.0, ys)), bad
+
+        if fn == "wkt":
+            from geomesa_tpu.geometry.wkt import from_wkt
+
+            (path,) = _split_args(m.group(2))
+            raws = self._raw(path, elems)
+            geoms, bad = [], np.zeros(n, dtype=bool)
+            for i, r in enumerate(raws):
+                if r == "":
+                    geoms.append(None)
+                    continue
+                try:
+                    geoms.append(from_wkt(r))
+                except Exception:
+                    geoms.append(None)
+                    bad[i] = True
+            return _geometry_column(typ, geoms), bad
+
+        raw = self._raw(expr, elems)
+        if typ in _NUMERIC:
+            return _numeric_column(raw, typ)
+        if typ == AttributeType.DATE:
+            import pandas as pd
+
+            parsed = pd.to_datetime(pd.Series(raw), errors="coerce", utc=True)
+            return _date_column(raw, parsed)
+        if typ == AttributeType.BOOLEAN:
+            return _boolean_column(raw)
+        if typ.is_geometry:
+            from geomesa_tpu.geometry.wkt import from_wkt
+
+            geoms = [from_wkt(r) if r else None for r in raw]
+            return _geometry_column(typ, geoms), np.zeros(n, dtype=bool)
+        valid = np.array([v != "" for v in raw])
+        return Column(typ, raw, None if valid.all() else valid), np.zeros(n, dtype=bool)

@@ -225,6 +225,9 @@ class TempOp(Filter):
 # attribute predicates
 # ---------------------------------------------------------------------------
 
+# rows below this skip dictionary building (vocab sort isn't worth it)
+_DICT_THRESHOLD = 1024
+
 _CMP = {
     "=": lambda v, x: v == x,
     "<>": lambda v, x: v != x,
@@ -249,6 +252,21 @@ class Compare(Filter):
             from geomesa_tpu.schema.columnar import _to_millis
 
             lit = _to_millis(lit)
+        # dictionary pushdown (ArrowFilterOptimizer role): string equality
+        # resolves the literal against the vocab ONCE, then compares int32
+        # codes per row instead of python strings
+        if (
+            self.op in ("=", "<>")
+            and isinstance(lit, str)
+            and len(v) >= _DICT_THRESHOLD
+            and col.dictionary() is not None
+        ):
+            vocab, codes = col.dictionary()
+            i = int(np.searchsorted(vocab, lit))
+            hit = i < len(vocab) and vocab[i] == lit
+            eq = (codes == i) if hit else np.zeros(len(v), dtype=bool)
+            valid = col.is_valid()
+            return (eq & valid) if self.op == "=" else (~eq & valid)
         if v.dtype == object:
             f = _CMP[self.op]
             out = np.zeros(len(v), dtype=bool)
@@ -297,6 +315,24 @@ class In(Filter):
 
     def mask(self, table):
         col = table.columns[self.prop]
+        # dictionary pushdown: resolve every literal against the vocab once,
+        # one np.isin over int codes instead of L equality passes
+        if (
+            len(col) >= _DICT_THRESHOLD
+            and all(isinstance(x, str) for x in self.literals)
+            and col.dictionary() is not None
+        ):
+            vocab, codes = col.dictionary()
+            # scalar vocab lookups: python == compares the FULL strings (a
+            # numpy cast would truncate literals to the vocab's fixed width)
+            want = []
+            for lit in self.literals:
+                i = int(np.searchsorted(vocab, lit))
+                if i < len(vocab) and vocab[i] == lit:
+                    want.append(i)
+            if not want:
+                return np.zeros(len(col), dtype=bool)
+            return np.isin(codes, np.array(want)) & col.is_valid()
         out = np.zeros(len(col), dtype=bool)
         for lit in self.literals:
             out |= Compare("=", self.prop, lit).mask(table)
@@ -319,6 +355,14 @@ class Like(Filter):
     def mask(self, table):
         col = table.columns[self.prop]
         rx = self._regex()
+        # dictionary pushdown: run the regex over the (small) vocab once,
+        # then one np.isin over int codes
+        if len(col) >= _DICT_THRESHOLD and col.dictionary() is not None:
+            vocab, codes = col.dictionary()
+            want = np.nonzero(
+                np.array([rx.match(u) is not None for u in vocab], dtype=bool)
+            )[0]
+            return np.isin(codes, want) & col.is_valid()
         valid = col.is_valid()
         out = np.zeros(len(col), dtype=bool)
         for i, v in enumerate(col.values):

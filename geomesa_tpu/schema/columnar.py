@@ -53,6 +53,25 @@ class Column:
             return np.ones(len(self), dtype=bool)  # len() works for lazy geometry columns too
         return self.valid
 
+    def dictionary(self):
+        """Cached (sorted vocab, codes int32) for string columns — the
+        ``ArrowDictionary`` role. Predicates evaluate against the (small)
+        vocab once and compare int codes per row instead of strings
+        (``ArrowFilterOptimizer.scala:1`` pushdown); None for non-strings.
+        """
+        if self.type not in (AttributeType.STRING, AttributeType.UUID):
+            return None
+        cached = self.__dict__.get("_dict")
+        if cached is not None:
+            return cached
+        flat = np.array(
+            [v if isinstance(v, str) else "" for v in self.values], dtype=object
+        ).astype(str)
+        vocab, codes = np.unique(flat, return_inverse=True)
+        out = (vocab, codes.astype(np.int32))
+        self.__dict__["_dict"] = out
+        return out
+
 
 @dataclass
 class GeometryColumn(Column):

@@ -182,3 +182,56 @@ def evaluate_column(visibilities, auths) -> np.ndarray:
     uniq, inv = np.unique(flat, return_inverse=True)
     allowed = np.array([parse_visibility(u).evaluate(aset) for u in uniq], dtype=bool)
     return allowed[inv]
+
+
+def apply_visibility(sft, table, vis_field: str, auths):
+    """Record- OR attribute-level visibility over a feature table.
+
+    A visibility cell without commas is one expression for the whole record
+    (rows failing it are dropped). A comma-separated cell holds one
+    expression PER ATTRIBUTE in schema order (the reference's
+    ``SecurityUtils.FEATURE_VISIBILITY`` convention, enforced server-side by
+    ``KryoVisibilityRowEncoder.scala:1``): attributes the caller's auths
+    can't satisfy are redacted to null, and rows with NO visible attribute
+    are dropped. Returns (table, kept_row_positions).
+    """
+    vis = table.columns[vis_field].values
+    aset = frozenset(auths)
+    flat = np.array(["" if v is None else str(v) for v in vis], dtype=object)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    names = [a.name for a in sft.attributes]
+    n_attr = len(names)
+
+    # per distinct expression: visibility bool per attribute (record-level
+    # expressions broadcast one verdict across every attribute)
+    per_attr = np.empty((len(uniq), n_attr), dtype=bool)
+    for u, expr in enumerate(uniq):
+        if "," in expr:
+            parts = [p.strip() for p in expr.split(",")]
+            parts += [""] * (n_attr - len(parts))
+            per_attr[u] = [
+                parse_visibility(p).evaluate(aset) for p in parts[:n_attr]
+            ]
+        else:
+            per_attr[u] = parse_visibility(expr).evaluate(aset)
+
+    attr_vis = per_attr[inv]  # (n_rows, n_attr)
+    keep = np.nonzero(attr_vis.any(axis=1))[0]
+    table = table.take(keep)
+    attr_vis = attr_vis[keep]
+
+    # redact: merge per-attribute visibility into each column's validity
+    from dataclasses import replace as _replace
+
+    new_cols = {}
+    for j, name in enumerate(names):
+        col = table.columns[name]
+        visible = attr_vis[:, j]
+        if visible.all():
+            new_cols[name] = col
+            continue
+        valid = visible if col.valid is None else (col.valid & visible)
+        new_cols[name] = _replace(col, valid=valid)
+    from geomesa_tpu.schema.columnar import FeatureTable
+
+    return FeatureTable(table.sft, table.fids, new_cols), keep

@@ -230,6 +230,29 @@ class DataStore:
                     q = out
         return q
 
+    def delete_features(self, type_name: str, fids) -> int:
+        """Remove features by id (the ``GeoMesaFeatureWriter`` remove role).
+
+        Rebuilds the main tier without the targeted rows (columnar stores
+        delete by rewrite, like the reference's LSM deletes compact away);
+        returns the number of rows removed.
+        """
+        st = self._state(type_name)
+        want = {str(f) for f in fids}
+        delta = st.delta.merged()
+        tables = [t for t in (st.table, delta) if t is not None and len(t)]
+        if not tables:
+            return 0
+        combined = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
+        keep = np.array([str(f) not in want for f in combined.fids], dtype=bool)
+        removed = int((~keep).sum())
+        if removed == 0:
+            return 0
+        # _rebuild clears the delta only after the new state swaps in — a
+        # failed rebuild must not lose hot-tier rows
+        self._rebuild(st, combined.take(np.nonzero(keep)[0]))
+        return removed
+
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
         reload + stats rebuild). Atomic: state swaps only on success."""
@@ -335,7 +358,10 @@ class DataStore:
             for v in set(
                 "" if v is None else str(v) for v in table.columns[vis_field].values
             ):
-                parse_visibility(v)  # raises VisibilityParseError on bad input
+                # comma lists are per-ATTRIBUTE expressions (attribute-level
+                # visibility); each part must parse on its own
+                for part in v.split(","):
+                    parse_visibility(part.strip())  # raises on bad input
 
     # -- queries (QueryPlanner.runQuery role) --------------------------------
     def query(
@@ -607,16 +633,23 @@ class DataStore:
         return est
 
     # -- persistence (checkpoint/resume) -------------------------------------
-    def save(self, path: str) -> dict:
+    def save(self, path: str, file_format: str = "parquet") -> dict:
         from geomesa_tpu.store import persistence
 
-        return persistence.save(self, path)
+        return persistence.save(self, path, file_format=file_format)
 
     @staticmethod
-    def load(path: str, backend: str = "tpu") -> "DataStore":
+    def load(
+        path: str,
+        backend: str = "tpu",
+        column_group: str | None = None,
+        filter=None,
+    ) -> "DataStore":
         from geomesa_tpu.store import persistence
 
-        return persistence.load(path, backend=backend)
+        return persistence.load(
+            path, backend=backend, column_group=column_group, filter=filter
+        )
 
     def _stats(self, type_name: str):
         st = self._state(type_name)

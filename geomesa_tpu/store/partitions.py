@@ -1,0 +1,212 @@
+"""Partition schemes: directory-layout-as-coarse-index + query-time pruning.
+
+Role parity: ``geomesa-fs-storage-api/.../PartitionScheme.scala`` and the
+scheme implementations in ``geomesa-fs-storage-common/.../partitions/``
+(DateTimeScheme, Z2Scheme, AttributeScheme, CompositeScheme, FlatScheme —
+SURVEY.md §2.12): the partition key doubles as a coarse index, letting a
+query prune whole files before any scan. Schemes are chosen per schema via
+user-data ``geomesa.fs.scheme`` (e.g. ``datetime``, ``z2-4``,
+``attribute:name``, ``datetime,z2-4``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter.bounds import Extraction
+
+__all__ = ["scheme_for", "PartitionScheme"]
+
+
+class PartitionScheme:
+    """Maps rows → partition keys, and filter bounds → keep/skip predicate."""
+
+    name = "flat"
+
+    def keys(self, sft, table) -> np.ndarray:
+        """(n,) object array of partition-key strings."""
+        return np.full(len(table), "all", dtype=object)
+
+    def prune(self, sft, extraction: Extraction | None, key: str) -> bool:
+        """True = partition may contain matches (keep); False = provably not."""
+        return True
+
+
+class FlatScheme(PartitionScheme):
+    name = "flat"
+
+
+class DateTimeScheme(PartitionScheme):
+    """One partition per z3 time bin (``DateTimeScheme`` role; the partition
+    key is the bin ordinal, so interval bounds prune directly)."""
+
+    name = "datetime"
+
+    def keys(self, sft, table) -> np.ndarray:
+        if sft.dtg_field is None:
+            return np.full(len(table), "all", dtype=object)
+        from geomesa_tpu.curve.binned_time import BinnedTime
+
+        bins, _ = BinnedTime(sft.z3_interval).to_bin_and_offset(
+            table.dtg_millis()
+        )
+        return np.array([f"bin{int(b)}" for b in bins], dtype=object)
+
+    def prune(self, sft, extraction, key: str) -> bool:
+        if (
+            extraction is None
+            or extraction.intervals is None
+            or not key.startswith("bin")
+            or sft.dtg_field is None
+        ):
+            return True
+        from geomesa_tpu.curve.binned_time import BinnedTime
+
+        binned = BinnedTime(sft.z3_interval)
+        b = int(key[3:])
+        lo_ms = int(binned.bin_start_millis(np.array([b]))[0])
+        hi_ms = int(binned.bin_start_millis(np.array([b + 1]))[0]) - 1
+        for lo, hi in extraction.intervals:
+            if int(hi) >= lo_ms and int(lo) <= hi_ms:
+                return True
+        return False
+
+
+class Z2Scheme(PartitionScheme):
+    """One partition per ``bits``-per-dimension z2 prefix cell (``Z2Scheme``
+    role): the key is the coarse Morton cell of the geometry centroid, so a
+    bbox prunes to the cells its cover touches."""
+
+    name = "z2"
+
+    def __init__(self, bits: int = 4):
+        if not (1 <= bits <= 12):
+            raise ValueError(f"z2 scheme bits must be in [1, 12]: {bits}")
+        self.bits = bits
+
+    def _cells(self, x, y) -> np.ndarray:
+        from geomesa_tpu.curve import zorder
+        from geomesa_tpu.curve.normalize import lat as nlat, lon as nlon
+
+        xi = nlon(self.bits).normalize(x)
+        yi = nlat(self.bits).normalize(y)
+        return zorder.encode2(xi, yi)
+
+    def keys(self, sft, table) -> np.ndarray:
+        if sft.geom_field is None:
+            return np.full(len(table), "all", dtype=object)
+        col = table.geom_column()
+        if col.x is not None:
+            cx, cy = col.x, col.y
+        elif col.bounds is not None:
+            bb = col.bounds  # (n, 4) xmin ymin xmax ymax
+            cx = (bb[:, 0] + bb[:, 2]) / 2
+            cy = (bb[:, 1] + bb[:, 3]) / 2
+        else:
+            return np.full(len(table), "all", dtype=object)
+        cells = self._cells(cx, cy)
+        return np.array([f"z2_{self.bits}_{int(c)}" for c in cells], dtype=object)
+
+    def prune(self, sft, extraction, key: str) -> bool:
+        if extraction is None or extraction.boxes is None:
+            return True
+        parts = key.split("_")
+        if len(parts) != 3 or parts[0] != "z2":
+            return True
+        bits, cell = int(parts[1]), int(parts[2])
+        if bits != self.bits:
+            return True
+        from geomesa_tpu.curve import zorder
+        from geomesa_tpu.curve.normalize import lat as nlat, lon as nlon
+
+        ix, iy = zorder.decode2(np.array([cell], dtype=np.uint64))
+        nx, ny = nlon(bits), nlat(bits)
+        cell_x1 = float(nx.bin_lo(ix)[0])
+        cell_x2 = float(nx.bin_hi(ix)[0])
+        cell_y1 = float(ny.bin_lo(iy)[0])
+        cell_y2 = float(ny.bin_hi(iy)[0])
+        for x1, y1, x2, y2 in extraction.boxes:
+            if x2 >= cell_x1 and x1 <= cell_x2 and y2 >= cell_y1 and y1 <= cell_y2:
+                return True
+        return False
+
+
+class AttributeScheme(PartitionScheme):
+    """One partition per attribute value (``AttributeScheme`` role); equality
+    bounds on that attribute prune to the matching partition."""
+
+    name = "attribute"
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def keys(self, sft, table) -> np.ndarray:
+        col = table.columns.get(self.field)
+        if col is None:
+            return np.full(len(table), "all", dtype=object)
+        return np.array([f"a_{v}" for v in col.values], dtype=object)
+
+    def prune(self, sft, extraction, key: str) -> bool:
+        bounds = extraction.attributes.get(self.field) if extraction else None
+        if bounds is None or not key.startswith("a_"):
+            return True
+        # prune only on pure equality/IN covers (every interval a point);
+        # range intervals keep everything — conservative over-approximation
+        eqs = set()
+        for lo, hi, lo_inc, hi_inc in bounds:
+            if lo is None or hi is None or lo != hi or not (lo_inc and hi_inc):
+                return True
+            eqs.add(str(lo))
+        return key[2:] in eqs
+
+
+class CompositeScheme(PartitionScheme):
+    """Schemes chained with ``/`` in the key (``CompositeScheme`` role):
+    a partition survives pruning only if every component keeps its part."""
+
+    name = "composite"
+
+    def __init__(self, parts: list[PartitionScheme]):
+        self.parts = parts
+
+    def keys(self, sft, table) -> np.ndarray:
+        all_keys = [p.keys(sft, table) for p in self.parts]
+        return np.array(
+            ["/".join(ks) for ks in zip(*all_keys)], dtype=object
+        )
+
+    def prune(self, sft, extraction, key: str) -> bool:
+        segs = key.split("/")
+        if len(segs) != len(self.parts):
+            return True
+        return all(
+            p.prune(sft, extraction, s) for p, s in zip(self.parts, segs)
+        )
+
+
+def scheme_for(sft) -> PartitionScheme:
+    """Resolve the schema's partition scheme from user-data
+    ``geomesa.fs.scheme`` (comma-separated composite), default ``datetime``.
+    """
+    spec = (sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
+    parts = []
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok == "flat":
+            parts.append(FlatScheme())
+        elif tok == "datetime":
+            parts.append(DateTimeScheme())
+        elif tok.startswith("z2"):
+            bits = int(tok.split("-")[1]) if "-" in tok else 4
+            parts.append(Z2Scheme(bits))
+        elif tok.startswith("attribute:"):
+            parts.append(AttributeScheme(tok.split(":", 1)[1]))
+        else:
+            raise ValueError(f"unknown partition scheme: {tok!r}")
+    if not parts:
+        return FlatScheme()
+    if len(parts) == 1:
+        return parts[0]
+    return CompositeScheme(parts)

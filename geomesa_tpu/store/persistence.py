@@ -32,8 +32,16 @@ MANIFEST = "manifest.json"
 FORMAT_VERSION = 1
 
 
-def save(ds, path: str, partition_by_time: bool = True) -> dict:
+def save(
+    ds, path: str, partition_by_time: bool = True, file_format: str = "parquet"
+) -> dict:
     """Persist every schema + table of a DataStore; returns the manifest.
+
+    Partition layout follows each schema's partition scheme (user-data
+    ``geomesa.fs.scheme`` — datetime/z2/attribute/composite/flat, the
+    ``PartitionScheme.scala`` SPI role); ``partition_by_time=False`` forces
+    flat. ``file_format``: ``"parquet"`` (default) or ``"orc"`` — the two
+    columnar tiers of ``geomesa-fs`` (SURVEY.md §2.12).
 
     Catalog mutation happens under an exclusive cross-process lock
     (``DistributedLocking.scala:14`` role — :mod:`geomesa_tpu.utils.locks`),
@@ -41,11 +49,47 @@ def save(ds, path: str, partition_by_time: bool = True) -> dict:
     """
     from geomesa_tpu.utils.locks import catalog_lock
 
+    if file_format not in ("parquet", "orc"):
+        raise ValueError(f"unsupported format: {file_format!r}")
     with catalog_lock(path):
-        return _save_locked(ds, path, partition_by_time)
+        return _save_locked(ds, path, partition_by_time, file_format)
 
 
-def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
+def _write_table(at: pa.Table, tmp: Path, file_format: str) -> None:
+    if file_format == "orc":
+        from pyarrow import orc
+
+        # ORC writer rejects dictionary-encoded columns: decode first
+        at = at.combine_chunks()
+        cols = []
+        for i, col in enumerate(at.columns):
+            if pa.types.is_dictionary(col.type):
+                col = col.cast(col.type.value_type)
+            cols.append(col)
+        at = pa.table(cols, names=at.column_names)
+        orc.write_table(at, str(tmp))
+    else:
+        pq.write_table(at, tmp)
+
+
+def _read_table(path: Path, file_format: str, columns=None) -> pa.Table:
+    if file_format == "orc":
+        from pyarrow import orc
+
+        at = orc.read_table(str(path), columns=columns)
+        # ORC widens timestamp[ms] → timestamp[ns]; restore the ms unit the
+        # arrow↔columnar mapping expects
+        cols, changed = [], False
+        for col in at.columns:
+            if pa.types.is_timestamp(col.type) and col.type.unit != "ms":
+                col = col.cast(pa.timestamp("ms"))
+                changed = True
+            cols.append(col)
+        return pa.table(cols, names=at.column_names) if changed else at
+    return pq.read_table(path, columns=columns)
+
+
+def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> dict:
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
     # generation-unique shard names: renames must never clobber files the
@@ -58,7 +102,12 @@ def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
             gen = int(json.loads(mpath.read_text()).get("generation", 0)) + 1
         except (ValueError, json.JSONDecodeError):
             gen = 1
-    manifest = {"version": FORMAT_VERSION, "generation": gen, "types": {}}
+    manifest = {
+        "version": FORMAT_VERSION,
+        "generation": gen,
+        "format": file_format,
+        "types": {},
+    }
     staged: list[tuple[Path, Path]] = []  # (tmp, final) shard renames
     for name in ds.list_schemas():
         ds.compact(name)  # fold the hot tier in so the catalog is fully sorted
@@ -67,19 +116,41 @@ def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
         tdir.mkdir(exist_ok=True)
         files = []
         count = 0
+        scheme_spec = "flat"
         if st.table is not None and len(st.table):
             count = len(st.table)
-            parts = _partitions(st) if partition_by_time else {"all": np.arange(count)}
+            if partition_by_time:
+                from geomesa_tpu.store.partitions import scheme_for
+
+                scheme = scheme_for(st.sft)
+                scheme_spec = str(
+                    (st.sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
+                )
+                keys = scheme.keys(st.sft, st.table)
+                parts = {
+                    str(k): np.nonzero(keys == k)[0] for k in np.unique(keys)
+                }
+            else:
+                parts = {"all": np.arange(count)}
             for key, rows in parts.items():
                 at = to_arrow(st.table.take(rows))
-                fn = f"part-{key}-g{gen}.parquet"
+                # short digest disambiguates keys the sanitizer would collide
+                # (e.g. 'v 1' and 'v-1' both sanitize to 'v-1')
+                import hashlib
+
+                safe = "".join(
+                    c if c.isalnum() or c in "._" else "-" for c in str(key)
+                )[:40]
+                digest = hashlib.sha1(str(key).encode()).hexdigest()[:8]
+                fn = f"part-{safe}-{digest}-g{gen}.{file_format}"
                 tmp = tdir / (fn + ".tmp")
-                pq.write_table(at, tmp)
+                _write_table(at, tmp, file_format)
                 staged.append((tmp, tdir / fn))
                 files.append({"file": fn, "rows": int(len(rows)), "partition": str(key)})
         manifest["types"][name] = {
             "spec": st.sft.to_spec(),
             "count": count,
+            "scheme": scheme_spec,
             "files": files,
         }
 
@@ -97,7 +168,7 @@ def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
     for name, meta in manifest["types"].items():
         keep = {f["file"] for f in meta["files"]}
         tdir = root / name
-        for p in tdir.glob("part-*.parquet*"):
+        for p in tdir.glob("part-*"):
             if p.name not in keep:
                 p.unlink()
     for p in root.iterdir():
@@ -108,27 +179,24 @@ def _save_locked(ds, path: str, partition_by_time: bool) -> dict:
     return manifest
 
 
-def _partitions(st) -> dict:
-    """Rows grouped by z3 time bin (coarse time partitioning)."""
-    sft = st.sft
-    if sft.dtg_field is None:
-        return {"all": np.arange(len(st.table))}
-    from geomesa_tpu.curve.binned_time import BinnedTime
-
-    bins, _ = BinnedTime(sft.z3_interval).to_bin_and_offset(st.table.dtg_millis())
-    out = {}
-    for b in np.unique(bins):
-        out[int(b)] = np.nonzero(bins == b)[0]
-    return out
-
-
-def load(path: str, backend: str = "tpu", column_group: str | None = None):
+def load(
+    path: str,
+    backend: str = "tpu",
+    column_group: str | None = None,
+    filter=None,
+):
     """Restore a DataStore (device state rebuilt) from a catalog directory.
 
     ``column_group``: load only that group's columns (ColumnGroups role,
     SURVEY.md §2.3) — the parquet read materializes the reduced attribute
     set, so HBM/host residency scales with the group, not the full schema.
     Schemas without the named group load in full.
+
+    ``filter`` (CQL string or AST): partition PRUNING — only files whose
+    partition key can contain matches are read (the reference's
+    partition-scheme query pruning, ``PartitionScheme.scala`` role). The
+    filter is NOT applied row-wise; the restored store holds every row of
+    the surviving partitions and queries still run normally.
     """
     from geomesa_tpu.schema.columnar import FeatureTable
     from geomesa_tpu.store.datastore import DataStore
@@ -137,9 +205,21 @@ def load(path: str, backend: str = "tpu", column_group: str | None = None):
     manifest = json.loads((root / MANIFEST).read_text())
     if manifest.get("version") != FORMAT_VERSION:
         raise ValueError(f"unsupported catalog version: {manifest.get('version')}")
+    file_format = manifest.get("format", "parquet")
     ds = DataStore(backend=backend)
     for name, meta in manifest["types"].items():
         sft = parse_spec(name, meta["spec"])
+        pruner = None
+        extraction = None
+        if filter is not None:
+            from geomesa_tpu.filter.bounds import extract
+            from geomesa_tpu.filter.cql import parse
+            from geomesa_tpu.store.partitions import scheme_for
+
+            f_ast = parse(filter) if isinstance(filter, str) else filter
+            attrs = tuple(a.name for a in sft.attributes if not a.type.is_geometry)
+            extraction = extract(f_ast, sft.geom_field, sft.dtg_field, attrs)
+            pruner = scheme_for(sft)
         columns = None
         if column_group is not None:
             from geomesa_tpu.schema.column_groups import ColumnGroups
@@ -150,11 +230,18 @@ def load(path: str, backend: str = "tpu", column_group: str | None = None):
                 columns = ["__fid__"] + [a.name for a in sft.attributes]
         ds.create_schema(sft)
         tables = []
+        pruned = 0
         for f in meta["files"]:
-            at = pq.read_table(root / name / f["file"], columns=columns)
+            if pruner is not None and not pruner.prune(
+                sft, extraction, f["partition"]
+            ):
+                pruned += 1
+                continue
+            at = _read_table(root / name / f["file"], file_format, columns=columns)
             tables.append(from_arrow(sft, at))
         if tables:
             table = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
             ds.write(name, table)
             ds.compact(name)  # restored data is the main tier, not hot writes
+        ds.metrics.counter(f"catalog.partitions_pruned.{name}").inc(pruned)
     return ds

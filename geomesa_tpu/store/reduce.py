@@ -194,17 +194,18 @@ def reduce_result(sft: FeatureType, table: FeatureTable, rows: np.ndarray, q):
     Returns ``(table, rows, density, stats, bin_data)``; exactly one of the
     aggregate slots is non-None when the corresponding hint was set.
     """
-    # record-level visibility (geomesa-security role): a schema opting in via
-    # user-data ``geomesa.vis.field`` names a String attribute holding the
-    # per-record visibility expression; rows the caller's auths can't satisfy
-    # are removed before any sampling/aggregation sees them
+    # visibility (geomesa-security role): a schema opting in via user-data
+    # ``geomesa.vis.field`` names a String attribute holding the per-record
+    # visibility expression — OR a comma-separated per-ATTRIBUTE expression
+    # list (the reference's SecurityUtils.FEATURE_VISIBILITY convention /
+    # KryoVisibilityRowEncoder role): rows with no visible attribute are
+    # removed, and individual attributes the caller can't see are redacted
+    # to null before any sampling/aggregation sees them
     vis_field = sft.user_data.get("geomesa.vis.field")
     if vis_field and q.auths is not None:
-        from geomesa_tpu.security.visibility import evaluate_column
+        from geomesa_tpu.security.visibility import apply_visibility
 
-        visible = evaluate_column(table.columns[vis_field].values, q.auths)
-        keep = np.nonzero(visible)[0]
-        table = table.take(keep)
+        table, keep = apply_visibility(sft, table, vis_field, q.auths)
         rows = rows[keep]
 
     # sampling (FeatureSampler / SamplingIterator role): keep ~fraction of

@@ -8,6 +8,7 @@ features older than a configured age.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Iterator
 
@@ -26,6 +27,10 @@ class FeatureState:
 
 
 class FeatureCache:
+    """Thread-safe: all mutators and readers serialize on one RLock, so the
+    threaded consumer group (``KafkaCacheLoader`` role) and concurrent
+    queries share it without torn state."""
+
     def __init__(
         self,
         sft: FeatureType,
@@ -36,47 +41,81 @@ class FeatureCache:
         self.expiry_ms = expiry_ms
         self.index = index if index is not None else SizeSeparatedBucketIndex()
         self._states: dict[str, FeatureState] = {}
+        self._lock = threading.RLock()
 
     def put(self, fid: str, record: dict, ts: int) -> None:
         """Upsert: last write (by arrival order, like the reference) wins."""
-        old = self._states.get(fid)
-        if old is not None and old.bounds is not None:
-            self.index.remove(old.bounds, fid)
-        geom = record.get(self.sft.geom_field) if self.sft.geom_field else None
-        bounds = geom.bbox if geom is not None else None
-        state = FeatureState(fid, record, ts, bounds)
-        self._states[fid] = state
-        if bounds is not None:
-            self.index.insert(bounds, fid, state)
+        with self._lock:
+            old = self._states.get(fid)
+            if old is not None and old.bounds is not None:
+                self.index.remove(old.bounds, fid)
+            geom = record.get(self.sft.geom_field) if self.sft.geom_field else None
+            bounds = geom.bbox if geom is not None else None
+            state = FeatureState(fid, record, ts, bounds)
+            self._states[fid] = state
+            if bounds is not None:
+                self.index.insert(bounds, fid, state)
 
     def delete(self, fid: str) -> None:
-        old = self._states.pop(fid, None)
-        if old is not None and old.bounds is not None:
-            self.index.remove(old.bounds, fid)
+        with self._lock:
+            old = self._states.pop(fid, None)
+            if old is not None and old.bounds is not None:
+                self.index.remove(old.bounds, fid)
+
+    def remove_if_ts(self, fid: str, ts: int) -> bool:
+        """Delete ``fid`` only if its event time still equals ``ts`` — the
+        persister's compare-and-remove, so an update racing a persist never
+        gets dropped (the newer state stays hot)."""
+        with self._lock:
+            s = self._states.get(fid)
+            if s is None or s.ts != ts:
+                return False
+            self.delete(fid)
+            return True
 
     def clear(self) -> None:
-        self._states.clear()
-        self.index.clear()
+        with self._lock:
+            self._states.clear()
+            self.index.clear()
 
     def expire(self, now_ms: int) -> int:
         """Drop features whose event time is older than the expiry window."""
         if self.expiry_ms is None:
             return 0
-        cutoff = now_ms - self.expiry_ms
-        stale = [fid for fid, s in self._states.items() if s.ts < cutoff]
-        for fid in stale:
-            self.delete(fid)
-        return len(stale)
+        with self._lock:
+            cutoff = now_ms - self.expiry_ms
+            stale = [fid for fid, s in self._states.items() if s.ts < cutoff]
+            for fid in stale:
+                self.delete(fid)
+            return len(stale)
+
+    def expired_states(
+        self, now_ms: int, age_ms: int | None = None
+    ) -> list[FeatureState]:
+        """Snapshot of states older than ``age_ms`` (default: the expiry
+        window) WITHOUT removing them — the lambda persister reads these,
+        lands them in the cold store, then :meth:`remove_if_ts` each
+        (``DataStorePersistence.scala:161`` role)."""
+        age = age_ms if age_ms is not None else self.expiry_ms
+        if age is None:
+            return []
+        with self._lock:
+            cutoff = now_ms - age
+            return [s for s in self._states.values() if s.ts < cutoff]
 
     def get(self, fid: str) -> FeatureState | None:
-        return self._states.get(fid)
+        with self._lock:
+            return self._states.get(fid)
 
     def size(self) -> int:
-        return len(self._states)
+        with self._lock:
+            return len(self._states)
 
     def states(self) -> Iterator[FeatureState]:
-        return iter(self._states.values())
+        with self._lock:
+            return iter(list(self._states.values()))
 
     def query_bbox(self, bounds) -> Iterator[FeatureState]:
         """Candidate states whose envelope bucket overlaps ``bounds``."""
-        return self.index.query(bounds)
+        with self._lock:
+            return iter(list(self.index.query(bounds)))

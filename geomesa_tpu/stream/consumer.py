@@ -1,0 +1,96 @@
+"""Threaded consumer group: parallel partition draining into the cache.
+
+Role parity: ``geomesa-kafka/.../data/KafkaCacheLoader.scala:247`` +
+``geomesa-kafka-utils/.../consumer/ThreadedConsumer.scala`` (SURVEY.md
+§2.10): N consumer threads split a topic's partitions, poll batches, and
+apply them to the shared live cache; per-key ordering is preserved because a
+feature id always hashes to one partition. ``Clear`` is a cross-partition
+barrier (the bus publishes it to every partition): consumers rendezvous on
+it, one performs the clear, and only then does any partition move past it —
+so a Put published after a Clear can never be wiped by it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+__all__ = ["ThreadedConsumer"]
+
+
+class ThreadedConsumer:
+    """Drains a topic's partitions into ``apply`` on worker threads.
+
+    ``apply(data: bytes, partition: int) -> bool | None`` must be
+    thread-safe (the feature cache locks internally). Returning ``False``
+    stalls that partition WITHOUT advancing its offset — the message is
+    re-delivered on the next poll (used by cross-partition barriers; a
+    stalled partition never blocks the thread, so one thread owning several
+    partitions cannot deadlock a rendezvous). ``threads`` ≤ partitions; each
+    thread owns a static partition subset (consumer-group assignment).
+    """
+
+    def __init__(
+        self,
+        bus,
+        topic: str,
+        apply: Callable[[bytes, int], None],
+        threads: int = 2,
+        poll_interval_s: float = 0.002,
+    ):
+        self.bus = bus
+        self.topic = topic
+        self.apply = apply
+        self.poll_interval_s = poll_interval_s
+        n_parts = bus.partitions
+        threads = max(1, min(threads, n_parts))
+        self._assignments = [
+            [p for p in range(n_parts) if p % threads == t] for t in range(threads)
+        ]
+        self._offsets = [0] * n_parts
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(parts,), daemon=True,
+                name=f"geomesa-consumer-{topic}-{t}",
+            )
+            for t, parts in enumerate(self._assignments)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self, partitions: list[int]) -> None:
+        while not self._stop.is_set():
+            drained = 0
+            for p in partitions:
+                batch = self.bus.poll(self.topic, p, self._offsets[p], max_n=256)
+                for data in batch:
+                    if self.apply(data, p) is False:
+                        break  # stalled at a barrier; redeliver next poll
+                    self._offsets[p] += 1
+                    drained += 1
+            if drained == 0:
+                self._stop.wait(self.poll_interval_s)
+
+    def lag(self) -> int:
+        """Unconsumed messages across partitions (backpressure signal)."""
+        return sum(
+            self.bus.end_offset(self.topic, p) - self._offsets[p]
+            for p in range(self.bus.partitions)
+        )
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Block until fully caught up (tests / graceful handoff)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.lag() == 0:
+                return True
+            time.sleep(0.002)
+        return self.lag() == 0
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -12,6 +12,7 @@ role without a broker; swapping in a real bus only needs `publish`/`poll`.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable
 
@@ -28,36 +29,71 @@ __all__ = ["MessageBus", "StreamingDataStore"]
 
 
 class MessageBus:
-    """Minimal in-process topic bus: one ordered log per topic + subscribers.
+    """Minimal in-process topic bus: ordered log per topic + subscribers,
+    plus per-partition logs for threaded consumer groups.
 
-    Messages carry a partition tag (key-hash) for parity with the Kafka model,
-    but the log itself is totally ordered so replay preserves publish order —
-    a late consumer replaying cannot see a Clear before the Puts that preceded
-    it (in real Kafka the reference gets this by keying all messages for a
-    feature to one partition and treating Clear as a barrier).
+    Messages carry a partition tag (key-hash) for parity with the Kafka
+    model. The synchronous ``subscribe`` path sees the totally-ordered log;
+    the ``poll`` path (used by :class:`~geomesa_tpu.stream.consumer.
+    ThreadedConsumer`) reads per-partition logs, where per-feature ordering
+    holds because a fid always hashes to the same partition, and ``barrier``
+    messages (Clear) are replicated into every partition so consumers can
+    rendezvous on them.
     """
 
     def __init__(self, partitions: int = 4):
         self.partitions = partitions
+        self._lock = threading.RLock()  # subscribe replays under the lock
         self._logs: dict[str, list[tuple[int, bytes]]] = {}
+        self._plogs: dict[str, list[list[bytes]]] = {}
         self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
 
     def create_topic(self, topic: str) -> None:
-        self._logs.setdefault(topic, [])
+        with self._lock:
+            self._logs.setdefault(topic, [])
+            self._plogs.setdefault(topic, [[] for _ in range(self.partitions)])
 
-    def publish(self, topic: str, key: str, data: bytes) -> None:
+    def publish(
+        self, topic: str, key: str, data: bytes, barrier: bool = False
+    ) -> None:
         self.create_topic(topic)
         part = hash(key) % self.partitions if key else 0
-        self._logs[topic].append((part, data))
-        for cb in self._subscribers.get(topic, []):
+        with self._lock:
+            self._logs[topic].append((part, data))
+            if barrier:
+                for p in range(self.partitions):
+                    self._plogs[topic][p].append(data)
+            else:
+                self._plogs[topic][part].append(data)
+            subs = list(self._subscribers.get(topic, []))
+        for cb in subs:
             cb(data)
 
     def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
-        """Register a consumer; replays the existing log first (offset 0)."""
+        """Register a synchronous consumer; replays the log first (offset 0).
+
+        Replay AND registration happen under the bus lock so a concurrent
+        publish can neither sneak between them (delivering a new message
+        before older backlog) nor be missed.
+        """
         self.create_topic(topic)
-        for _, data in self._logs[topic]:
-            callback(data)
-        self._subscribers.setdefault(topic, []).append(callback)
+        with self._lock:
+            for _, data in self._logs[topic]:
+                callback(data)
+            self._subscribers.setdefault(topic, []).append(callback)
+
+    # -- consumer-group (polling) API ---------------------------------------
+    def poll(self, topic: str, partition: int, offset: int, max_n: int = 256):
+        """Messages [offset, offset+max_n) of one partition's log."""
+        self.create_topic(topic)
+        with self._lock:
+            log = self._plogs[topic][partition]
+            return log[offset : offset + max_n]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        self.create_topic(topic)
+        with self._lock:
+            return len(self._plogs[topic][partition])
 
     def topic_size(self, topic: str) -> int:
         return len(self._logs.get(topic, []))
@@ -70,12 +106,19 @@ class StreamingDataStore:
     reference's ``geomesa.kafka.expiry``); ``None`` keeps everything.
     """
 
-    def __init__(self, bus: MessageBus | None = None, expiry_ms: int | None = None):
+    def __init__(
+        self,
+        bus: MessageBus | None = None,
+        expiry_ms: int | None = None,
+        async_consumers: int = 0,
+    ):
         self.bus = bus if bus is not None else MessageBus()
         self.expiry_ms = expiry_ms
+        self.async_consumers = async_consumers
         self._types: dict[str, FeatureType] = {}
         self._serializers: dict[str, GeoMessageSerializer] = {}
         self._caches: dict[str, FeatureCache] = {}
+        self._consumers: dict[str, object] = {}
 
     # -- schema --------------------------------------------------------------
     def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
@@ -89,6 +132,51 @@ class StreamingDataStore:
         self._caches[sft.name] = cache
         ser = self._serializers[sft.name]
 
+        if self.async_consumers > 0:
+            # parallel partition draining (KafkaCacheLoader role): Clear is a
+            # cross-partition barrier — each partition STALLS at its barrier
+            # copy (offset not advanced, no thread blocking); the last
+            # partition to arrive performs the clear and bumps the barrier
+            # generation, and stalled partitions pass on redelivery
+            from geomesa_tpu.stream.consumer import ThreadedConsumer
+
+            n_parts = self.bus.partitions
+            bstate = {"gen": 0, "arrived": {}}
+            blk = threading.Lock()
+
+            def apply(data: bytes, partition: int, _cache=cache, _ser=ser):
+                msg = _ser.deserialize(data)
+                if isinstance(msg, Put):
+                    _cache.put(msg.fid, msg.record, msg.ts)
+                    return True
+                if isinstance(msg, Delete):
+                    _cache.delete(msg.fid)
+                    return True
+                if isinstance(msg, Clear):
+                    with blk:
+                        g = bstate["arrived"].get(partition)
+                        if g is not None and g < bstate["gen"]:
+                            del bstate["arrived"][partition]  # resolved
+                            return True
+                        if g is None:
+                            bstate["arrived"][partition] = bstate["gen"]
+                        full = len(bstate["arrived"]) == n_parts and all(
+                            v == bstate["gen"] for v in bstate["arrived"].values()
+                        )
+                        if full:
+                            _cache.clear()
+                            bstate["gen"] += 1
+                            del bstate["arrived"][partition]
+                            return True
+                        return False
+                return True
+
+            self._consumers[sft.name] = ThreadedConsumer(
+                self.bus, self._topic(sft.name), apply,
+                threads=self.async_consumers,
+            )
+            return sft
+
         def consume(data: bytes, _cache=cache, _ser=ser):
             msg = _ser.deserialize(data)
             if isinstance(msg, Put):
@@ -100,6 +188,20 @@ class StreamingDataStore:
 
         self.bus.subscribe(self._topic(sft.name), consume)
         return sft
+
+    def consumer(self, type_name: str):
+        """The ThreadedConsumer for a type (None on the synchronous path)."""
+        return self._consumers.get(type_name)
+
+    def drain(self, type_name: str, timeout_s: float = 10.0) -> bool:
+        """Wait until async consumers have applied every published message."""
+        c = self._consumers.get(type_name)
+        return True if c is None else c.drain(timeout_s)
+
+    def close(self) -> None:
+        for c in self._consumers.values():
+            c.close()
+        self._consumers.clear()
 
     def get_schema(self, name: str) -> FeatureType:
         return self._types[name]
@@ -125,7 +227,11 @@ class StreamingDataStore:
     def clear(self, type_name: str, ts: int | None = None) -> None:
         ser = self._serializers[type_name]
         ts = int(time.time() * 1000) if ts is None else ts
-        self.bus.publish(self._topic(type_name), "", ser.serialize(Clear(ts)))
+        # barrier=True replicates the Clear into every partition so the
+        # threaded consumer group can rendezvous on it
+        self.bus.publish(
+            self._topic(type_name), "", ser.serialize(Clear(ts)), barrier=True
+        )
 
     # -- reads (KafkaQueryRunner role) ---------------------------------------
     def cache(self, type_name: str) -> FeatureCache:

@@ -1,0 +1,198 @@
+"""Lambda-architecture store: streaming hot tier + persistent cold tier with
+a BACKGROUND persister thread.
+
+Role parity: ``geomesa-lambda`` (SURVEY.md §2.11) — ``LambdaDataStore.scala``
+(tier composition), ``DataStorePersistence.scala:161`` (the background
+process moving aged-out features from the Kafka tier into the persistent
+store), ``LambdaQueryRunner.scala`` (queries merge both tiers, hot winning on
+fid collisions). Unlike round 1's threshold-triggered compaction inside
+``write()``, persistence here runs on its own thread on a wall-clock cadence,
+and the move is write-cold-first + compare-and-remove so a feature is never
+lost or duplicated even under concurrent updates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+from geomesa_tpu.store.datastore import DataStore, QueryResult
+from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore
+
+__all__ = ["LambdaDataStore"]
+
+
+class LambdaDataStore:
+    """Hot (live cache) + cold (sorted columnar store) with background
+    persistence.
+
+    ``persist_age_ms``: features older than this move to the cold tier on
+    the persister's next pass. ``persist_interval_s``: persister cadence;
+    pass ``None`` to disable the thread (drive :meth:`persist_once`
+    manually, e.g. in tests).
+    """
+
+    def __init__(
+        self,
+        cold: DataStore | None = None,
+        bus: MessageBus | None = None,
+        persist_age_ms: int = 60_000,
+        persist_interval_s: float | None = 1.0,
+        consumers: int = 2,
+    ):
+        self.cold = cold if cold is not None else DataStore(backend="tpu")
+        self.stream = StreamingDataStore(bus=bus, async_consumers=consumers)
+        self.persist_age_ms = persist_age_ms
+        self._stop = threading.Event()
+        self._persist_lock = threading.Lock()
+        # fids known to live in cold (avoids an O(rows) cold scan per tick)
+        self._persisted: dict[str, set] = {}
+        # deletes not yet drained by the consumers: excluded from queries and
+        # from persistence so an in-flight persist can't resurrect them
+        self._tombstones: dict[str, set] = {}
+        self._thread = None
+        if persist_interval_s is not None:
+            self._thread = threading.Thread(
+                target=self._persist_loop, args=(persist_interval_s,),
+                daemon=True, name="geomesa-lambda-persister",
+            )
+            self._thread.start()
+
+    # -- schema / writes ------------------------------------------------------
+    def create_schema(self, sft: FeatureType | str, spec: str | None = None):
+        if isinstance(sft, str):
+            sft = parse_spec(sft, spec)
+        self.stream.create_schema(sft)
+        self.cold.create_schema(sft)
+        return sft
+
+    def list_schemas(self) -> list[str]:
+        return self.stream.list_schemas()
+
+    def write(self, type_name: str, fid: str, record: dict, ts: int | None = None):
+        with self._persist_lock:
+            self._tombstones.get(type_name, set()).discard(fid)  # re-put revives
+        self.stream.put(type_name, fid, record, ts=ts)
+
+    def delete(self, type_name: str, fid: str) -> None:
+        """Delete from BOTH tiers: tombstone first (so a racing persist pass
+        can't resurrect the feature into cold), then the hot-tier message and
+        the synchronous cold delete."""
+        with self._persist_lock:
+            self._tombstones.setdefault(type_name, set()).add(fid)
+            self.stream.delete(type_name, fid)
+            self.cold.delete_features(type_name, [fid])
+            self._persisted.get(type_name, set()).discard(fid)
+
+    # -- background persistence (DataStorePersistence role) -------------------
+    def _persist_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                for name in self.stream.list_schemas():
+                    self.persist_once(name)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    def persist_once(self, type_name: str, now_ms: int | None = None) -> int:
+        """One persister pass: cold-write aged-out hot features, then
+        compare-and-remove them from the hot cache. Returns rows moved."""
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        cache = self.stream.cache(type_name)
+        consumer = self.stream.consumer(type_name)
+        with self._persist_lock:
+            tombs = self._tombstones.get(type_name, set())
+            # a tombstone is spent once the consumers drained past the Delete
+            # and the hot cache no longer holds the fid
+            if tombs and (consumer is None or consumer.lag() == 0):
+                tombs -= {f for f in tombs if cache.get(f) is None}
+            aged = [
+                s
+                for s in cache.expired_states(now, age_ms=self.persist_age_ms)
+                if s.fid not in tombs
+            ]
+            if not aged:
+                return 0
+            sft = self.stream.get_schema(type_name)
+            recs = [s.record for s in aged]
+            fids = [s.fid for s in aged]
+            # land in cold FIRST (queries merge tiers and dedupe, so the
+            # transient overlap is invisible); remove hot only when the state
+            # is unchanged — an update during the write stays hot
+            existing = self._persisted_fids(type_name)
+            fresh = [i for i, f in enumerate(fids) if f not in existing]
+            stale = [i for i in range(len(fids)) if fids[i] in existing]
+            if fresh:
+                self.cold.write(
+                    type_name,
+                    FeatureTable.from_records(
+                        sft, [recs[i] for i in fresh], [fids[i] for i in fresh]
+                    ),
+                )
+            if stale:
+                # an older generation of this fid was persisted before: the
+                # hot state supersedes it — overwrite via delete+write
+                self.cold.delete_features(type_name, [fids[i] for i in stale])
+                self.cold.write(
+                    type_name,
+                    FeatureTable.from_records(
+                        sft, [recs[i] for i in stale], [fids[i] for i in stale]
+                    ),
+                )
+            existing.update(fids)
+            moved = 0
+            for s in aged:
+                if cache.remove_if_ts(s.fid, s.ts):
+                    moved += 1
+            return moved
+
+    def _persisted_fids(self, type_name: str) -> set:
+        """Cold-tier fid set, scanned once per type then maintained
+        incrementally (avoids an O(rows) cold query per persister tick)."""
+        s = self._persisted.get(type_name)
+        if s is None:
+            s = set(self.cold.query(type_name, "INCLUDE").table.fids.tolist())
+            self._persisted[type_name] = s
+        return s
+
+    # -- queries (LambdaQueryRunner role) -------------------------------------
+    def query(self, type_name: str, q: Query | str | None = None, **kwargs):
+        if isinstance(q, str) or q is None:
+            q = Query(filter=q, **kwargs)
+        hot = self.stream.query(type_name, q)
+        cold = self.cold.query(type_name, q)
+        with self._persist_lock:
+            tombs = set(self._tombstones.get(type_name, ()))
+        hot_table = hot.table
+        if tombs:
+            keep_h = np.array(
+                [f not in tombs for f in hot_table.fids], dtype=bool
+            )
+            hot_table = hot_table.take(np.nonzero(keep_h)[0])
+        hot_fids = set(hot_table.fids.tolist())
+        drop = hot_fids | tombs
+        if not drop:
+            return cold
+        # merge tiers: hot wins on fid collisions (it is strictly newer);
+        # tombstoned fids are invisible even before the consumers drain
+        keep = np.array([f not in drop for f in cold.table.fids], dtype=bool)
+        cold_kept = cold.table.take(np.nonzero(keep)[0])
+        merged = (
+            hot_table
+            if len(cold_kept) == 0
+            else FeatureTable.concat([hot_table, cold_kept])
+        )
+        return QueryResult(merged, np.arange(len(merged)))
+
+    def hot_count(self, type_name: str) -> int:
+        return self.stream.cache(type_name).size()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.stream.close()

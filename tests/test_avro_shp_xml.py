@@ -1,0 +1,176 @@
+"""Avro interop (schema evolution), shapefile read/write, XML converter
+(reference: geomesa-feature-avro serde tests, convert-shp/-xml suites)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import LineString, Point, Polygon
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+T0 = 1_498_867_200_000
+
+
+def _table(n=25):
+    sft = parse_spec("av", "name:String,age:Integer,score:Double,flag:Boolean,dtg:Date,*geom:Point")
+    recs = [
+        {"name": f"n{i}" if i % 5 else None, "age": i, "score": i * 0.5,
+         "flag": bool(i % 2), "dtg": T0 + i * 1000,
+         "geom": Point(float(i % 90), float(-i % 45))}
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)])
+
+
+class TestAvro:
+    def test_round_trip(self, tmp_path):
+        from geomesa_tpu.io.avro import read_avro, write_avro
+
+        t = _table()
+        p = tmp_path / "f.avro"
+        write_avro(t, str(p))
+        t2 = read_avro(str(p), reader_sft=t.sft)
+        assert list(t2.fids) == list(t.fids)
+        for i in (0, 5, 24):
+            assert t2.record(i) == t.record(i)
+
+    def test_schema_evolution_add_and_drop(self, tmp_path):
+        from geomesa_tpu.io.avro import read_avro, write_avro
+
+        t = _table(10)
+        p = tmp_path / "f.avro"
+        write_avro(t, str(p))
+        # evolved reader: 'score' dropped, 'city' added (defaults to null)
+        evolved = parse_spec("av", "name:String,age:Integer,city:String,dtg:Date,*geom:Point")
+        t2 = read_avro(str(p), reader_sft=evolved)
+        assert len(t2) == 10
+        r = t2.record(3)
+        assert r["name"] == "n3" and r["age"] == 3
+        assert r["city"] is None
+        assert "score" not in r
+        assert r["geom"] == Point(3.0, 42.0)
+
+    def test_raw_read_exposes_writer_schema(self, tmp_path):
+        from geomesa_tpu.io.avro import read_avro, write_avro
+
+        t = _table(4)
+        p = tmp_path / "f.avro"
+        write_avro(t, str(p))
+        records, fids, writer = read_avro(str(p))
+        assert writer["name"] == "av"
+        assert len(records) == 4 and fids[0] == "f0"
+
+    def test_multi_block(self, tmp_path):
+        from geomesa_tpu.io.avro import read_avro, write_avro
+
+        t = _table(25)
+        p = tmp_path / "f.avro"
+        write_avro(t, str(p), block_rows=7)  # forces 4 blocks
+        t2 = read_avro(str(p), reader_sft=t.sft)
+        assert list(t2.fids) == list(t.fids)
+        assert t2.record(24) == t.record(24)
+
+
+class TestShapefile:
+    def test_point_write_read_round_trip(self, tmp_path):
+        from geomesa_tpu.convert.shapefile import read_shapefile, write_shapefile
+
+        t = _table(12)
+        shp = tmp_path / "pts.shp"
+        write_shapefile(t, str(shp))
+        assert shp.exists() and shp.with_suffix(".dbf").exists() and shp.with_suffix(".shx").exists()
+        t2 = read_shapefile(str(shp))
+        assert len(t2) == 12
+        g1 = t.geom_column()
+        g2 = t2.geom_column()
+        np.testing.assert_allclose(g2.x, g1.x)
+        np.testing.assert_allclose(g2.y, g1.y)
+        r = t2.record(3)
+        assert r["name"] == "n3"
+        assert int(r["age"]) == 3
+        assert abs(float(r["score"]) - 1.5) < 1e-6
+
+    def test_read_into_datastore(self, tmp_path):
+        from geomesa_tpu.convert.shapefile import read_shapefile, write_shapefile
+        from geomesa_tpu.store.datastore import DataStore
+
+        t = _table(30)
+        shp = tmp_path / "pts.shp"
+        write_shapefile(t, str(shp))
+        loaded = read_shapefile(str(shp))
+        ds = DataStore(backend="tpu")
+        ds.create_schema(loaded.sft)
+        ds.write(loaded.sft.name, loaded)
+        assert ds.query(loaded.sft.name, "BBOX(geom, -1, -1, 10, 45)").count > 0
+
+    def test_polygon_read(self, tmp_path):
+        # hand-build a one-polygon .shp + .dbf and read it back
+        import struct
+
+        ring = np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], dtype=np.float64)
+        body = struct.pack("<i", 5) + struct.pack("<4d", 0, 0, 4, 4)
+        body += struct.pack("<ii", 1, len(ring)) + struct.pack("<i", 0)
+        body += ring.astype("<f8").tobytes()
+        rec = struct.pack(">ii", 1, len(body) // 2) + body
+        header = (
+            struct.pack(">i20x i", 9994, (100 + len(rec)) // 2)
+            + struct.pack("<ii", 1000, 5)
+            + struct.pack("<4d", 0, 0, 4, 4)
+            + struct.pack("<4d", 0, 0, 0, 0)
+        )
+        (tmp_path / "poly.shp").write_bytes(header + rec)
+        # minimal dbf: one C field, one record
+        dbf = struct.pack("<B3BIHH20x", 3, 24, 1, 1, 1, 32 + 32 + 1, 1 + 8)
+        dbf += b"name".ljust(11, b"\x00") + b"C" + b"\x00" * 4 + bytes([8, 0]) + b"\x00" * 14
+        dbf += b"\x0d" + b" " + b"zone-a  " + b"\x1a"
+        (tmp_path / "poly.dbf").write_bytes(dbf)
+
+        from geomesa_tpu.convert.shapefile import read_shapefile
+
+        t = read_shapefile(str(tmp_path / "poly.shp"))
+        assert len(t) == 1
+        g = t.record(0)["geom"]
+        assert g.geom_type == "Polygon"
+        assert t.record(0)["name"] == "zone-a"
+
+
+class TestXmlConverter:
+    XML = """<data>
+      <row id="a1"><who>alice</who><lon>10.5</lon><lat>-3.25</lat>
+        <when>2017-07-01T00:00:10Z</when><n units="m">7</n></row>
+      <row id="a2"><who>bob</who><lon>-120.0</lon><lat>45.0</lat>
+        <when>2017-07-02T00:00:00Z</when><n units="ft">9</n></row>
+      <row id="bad"><who>eve</who><lon>999</lon><lat>0</lat>
+        <when>2017-07-03T00:00:00Z</when><n units="m">1</n></row>
+    </data>"""
+
+    def _conv(self, **kw):
+        from geomesa_tpu.convert.xml_converter import XmlConverter
+
+        sft = parse_spec("x", "who:String,n:Integer,units:String,dtg:Date,*geom:Point")
+        fields = {"who": "who", "n": "n", "units": "n/@units",
+                  "dtg": "when", "geom": "point(lon, lat)"}
+        return XmlConverter(sft, fields, feature_path=".//row", id_field="@id", **kw)
+
+    def test_extracts_elements_attrs_and_points(self):
+        t = self._conv().convert_str(self.XML)
+        assert len(t) == 2  # lon=999 row skipped
+        assert list(t.fids) == ["a1", "a2"]
+        r = t.record(0)
+        assert r["who"] == "alice" and r["n"] == 7 and r["units"] == "m"
+        assert r["geom"] == Point(10.5, -3.25)
+        assert r["dtg"] == T0 + 10_000
+
+    def test_raise_mode(self):
+        with pytest.raises(ValueError, match="bad record"):
+            self._conv(error_mode="raise").convert_str(self.XML)
+
+    def test_wkt_expression(self):
+        from geomesa_tpu.convert.xml_converter import XmlConverter
+
+        sft = parse_spec("w", "name:String,*geom:Geometry")
+        xml = "<r><f><name>t</name><g>LINESTRING (0 0, 1 1)</g></f></r>"
+        conv = XmlConverter(sft, {"name": "name", "geom": "wkt(g)"},
+                            feature_path=".//f")
+        t = conv.convert_str(xml)
+        assert t.record(0)["geom"] == LineString(np.array([[0.0, 0.0], [1.0, 1.0]]))

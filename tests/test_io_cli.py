@@ -385,3 +385,86 @@ class TestReviewRegressions:
         ds.write("sd", [{"dtg": T0, "geom": Point(150.0, 80.0)}])  # hot
         est = ds.stats_count("sd", "BBOX(geom, 149, 79, 151, 81)")
         assert est >= 1  # the delta-only row is visible to estimates
+
+
+class TestPartitionSchemes:
+    """PartitionScheme SPI + query-time pruning (PartitionScheme.scala role)."""
+
+    def _spread_table(self, n=60):
+        # points spread over two distinct regions so z2 cells separate them
+        rng = np.random.default_rng(8)
+        sft = parse_spec("pz", "name:String,dtg:Date,*geom:Point;geomesa.fs.scheme='z2-3'")
+        recs = []
+        for i in range(n):
+            if i % 2:
+                x, y = rng.uniform(100, 140), rng.uniform(20, 50)   # east
+            else:
+                x, y = rng.uniform(-140, -100), rng.uniform(-50, -20)  # west
+            recs.append({"name": f"g{i % 3}", "dtg": T0 + i * 1000,
+                         "geom": Point(float(x), float(y))})
+        return sft, FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)])
+
+    def test_z2_scheme_prunes_partitions(self, tmp_path):
+        sft, t = self._spread_table()
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        ds.write("pz", t)
+        m = ds.save(str(tmp_path / "cat"))
+        assert len(m["types"]["pz"]["files"]) >= 2  # east/west split
+        assert m["types"]["pz"]["scheme"] == "z2-3"
+
+        cql = "BBOX(geom, 100, 20, 140, 50)"  # east only
+        pruned_ds = DataStore.load(str(tmp_path / "cat"), filter=cql)
+        full_ds = DataStore.load(str(tmp_path / "cat"))
+        assert pruned_ds.metrics.counter("catalog.partitions_pruned.pz").count > 0
+        # the pruned store answers the pruning query identically
+        a = set(full_ds.query("pz", cql).table.fids.tolist())
+        b = set(pruned_ds.query("pz", cql).table.fids.tolist())
+        assert a == b and len(a) == 30
+
+    def test_attribute_scheme_prunes(self, tmp_path):
+        sft = parse_spec("pa", "name:String,dtg:Date,*geom:Point;geomesa.fs.scheme='attribute:name'")
+        recs = [{"name": f"v{i % 4}", "dtg": T0 + i, "geom": Point(i * 0.1, 0.0)}
+                for i in range(40)]
+        t = FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(40)])
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        ds.write("pa", t)
+        m = ds.save(str(tmp_path / "cat"))
+        assert len(m["types"]["pa"]["files"]) == 4
+
+        pruned = DataStore.load(str(tmp_path / "cat"), filter="name = 'v2'")
+        assert pruned.metrics.counter("catalog.partitions_pruned.pa").count == 3
+        assert pruned.query("pa", "name = 'v2'").count == 10
+
+    def test_composite_scheme_keys(self, tmp_path):
+        sft = parse_spec(
+            "pc", "name:String,dtg:Date,*geom:Point;geomesa.fs.scheme='datetime,z2-2'"
+        )
+        recs = [{"name": "a", "dtg": T0 + i * 86_400_000 * 9,
+                 "geom": Point(-100.0 if i % 2 else 100.0, 0.0)} for i in range(8)]
+        t = FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(8)])
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        ds.write("pc", t)
+        m = ds.save(str(tmp_path / "cat"))
+        keys = {f["partition"] for f in m["types"]["pc"]["files"]}
+        assert all("/" in k for k in keys)  # composite key segments
+        ds2 = DataStore.load(str(tmp_path / "cat"))
+        assert ds2.query("pc", "INCLUDE").count == 8
+
+    def test_orc_round_trip(self, tmp_path):
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("t", SPEC))
+        t = table()
+        ds.write("t", t)
+        m = ds.save(str(tmp_path / "cat_orc"), file_format="orc")
+        assert all(f["file"].endswith(".orc") for f in m["types"]["t"]["files"])
+        ds2 = DataStore.load(str(tmp_path / "cat_orc"))
+        a = ds.query("t", "age >= 10 AND age < 30")
+        b = ds2.query("t", "age >= 10 AND age < 30")
+        assert set(a.table.fids.tolist()) == set(b.table.fids.tolist())
+        # null validity survives the ORC round trip
+        r = ds2.query("t", "INCLUDE").table
+        names = {f: rec for f, rec in zip(r.fids, (r.record(i) for i in range(len(r))))}
+        assert names["f7"]["name"] is None

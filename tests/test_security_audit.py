@@ -194,3 +194,97 @@ class TestMetrics:
         path = str(tmp_path / "metrics.csv")
         reg.report_delimited(path)
         assert "counter,c,count,3" in open(path).read()
+
+
+class TestAttributeVisibility:
+    """Attribute-level visibility (KryoVisibilityRowEncoder role): comma
+    lists redact per attribute; record-level strings still drop whole rows."""
+
+    SPEC = ("name:String,age:Integer,vis:String,dtg:Date,*geom:Point"
+            ";geomesa.vis.field='vis'")
+
+    def _store(self):
+        from geomesa_tpu.geometry.types import Point
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("s", self.SPEC))
+        # attribute order: name, age, vis, dtg, geom
+        recs = [
+            # name needs admin; everything else public
+            {"name": "classified", "age": 1, "vis": "admin,,,,",
+             "dtg": 1_500_000_000_000, "geom": Point(1, 1)},
+            # fully public
+            {"name": "open", "age": 2, "vis": "",
+             "dtg": 1_500_000_000_000, "geom": Point(2, 2)},
+            # whole record needs secret (record-level, no commas)
+            {"name": "hidden", "age": 3, "vis": "secret",
+             "dtg": 1_500_000_000_000, "geom": Point(3, 3)},
+            # every attribute needs secret (attribute-level all-redacted)
+            {"name": "gone", "age": 4, "vis": "secret,secret,secret,secret,secret",
+             "dtg": 1_500_000_000_000, "geom": Point(4, 4)},
+        ]
+        ds.write("s", recs, fids=["a", "b", "c", "d"])
+        return ds
+
+    def test_redaction_and_row_drop(self):
+        from geomesa_tpu.planning.planner import Query
+
+        ds = self._store()
+        r = ds.query("s", Query(filter="INCLUDE", auths=()))
+        # c (record-level secret) and d (no visible attribute) are dropped
+        assert sorted(r.table.fids.tolist()) == ["a", "b"]
+        recs = {f: r.table.record(i) for i, f in enumerate(r.table.fids)}
+        assert recs["a"]["name"] is None      # redacted attribute
+        assert recs["a"]["age"] == 1          # visible attribute survives
+        assert recs["b"]["name"] == "open"
+
+    def test_admin_sees_everything(self):
+        from geomesa_tpu.planning.planner import Query
+
+        ds = self._store()
+        r = ds.query("s", Query(filter="INCLUDE", auths=("admin", "secret")))
+        assert sorted(r.table.fids.tolist()) == ["a", "b", "c", "d"]
+        recs = {f: r.table.record(i) for i, f in enumerate(r.table.fids)}
+        assert recs["a"]["name"] == "classified"
+
+
+class TestDictionaryPushdown:
+    """String predicates resolve against the column dictionary once
+    (ArrowFilterOptimizer role) and must agree with the per-row path."""
+
+    def _table(self, n=5000):
+        from geomesa_tpu.schema.columnar import FeatureTable
+
+        rng = np.random.default_rng(12)
+        sft = parse_spec("d", "name:String,k:Integer")
+        names = np.array([f"cat{i}" for i in rng.integers(0, 40, n)], dtype=object)
+        names[::97] = None  # nulls
+        recs = [{"name": names[i], "k": int(i)} for i in range(n)]
+        return FeatureTable.from_records(sft, recs, [str(i) for i in range(n)])
+
+    def test_eq_in_like_match_row_path(self):
+        from geomesa_tpu.filter import ast
+
+        t = self._table()
+        col = t.columns["name"]
+        assert col.dictionary() is not None
+        for f in (
+            ast.Compare("=", "name", "cat7"),
+            ast.Compare("<>", "name", "cat7"),
+            ast.In("name", ("cat1", "cat2", "nope")),
+            ast.Like("name", "cat1%"),
+        ):
+            fast = f.mask(t)
+            # force the per-row path by shrinking below the threshold
+            small_rows = np.arange(len(t))
+            ref = np.concatenate([
+                type(f).mask(f, t.take(small_rows[i : i + 500]))
+                for i in range(0, len(t), 500)
+            ])
+            np.testing.assert_array_equal(fast, ref), type(f).__name__
+
+    def test_eq_miss_literal(self):
+        from geomesa_tpu.filter import ast
+
+        t = self._table()
+        assert ast.Compare("=", "name", "zzz-not-there").mask(t).sum() == 0

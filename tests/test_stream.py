@@ -201,3 +201,175 @@ class TestStreamingDataStore:
             ds.put("adsb", f"f{i}", {"dtg": i, "geom": Point(i, i), "callsign": "Z", "alt": 100 - i}, ts=i)
         res = ds.query("adsb", Query(filter=None, sort_by=("alt", False), limit=2))
         assert list(res.table.columns["alt"].values[:2]) == [96, 97]
+
+
+class TestThreadedConsumers:
+    def test_async_consumers_apply_all_messages(self):
+        from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore
+
+        sds = StreamingDataStore(bus=MessageBus(partitions=4), async_consumers=3)
+        sds.create_schema("a", "name:String,dtg:Date,*geom:Point")
+        from geomesa_tpu.geometry.types import Point
+
+        for i in range(500):
+            sds.put("a", f"f{i}", {"name": "x", "dtg": i, "geom": Point(i % 90, 0)}, ts=i)
+        assert sds.drain("a", timeout_s=10)
+        assert sds.cache("a").size() == 500
+        sds.close()
+
+    def test_clear_barrier_across_partitions(self):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore
+
+        sds = StreamingDataStore(bus=MessageBus(partitions=4), async_consumers=2)
+        sds.create_schema("b", "dtg:Date,*geom:Point")
+        for i in range(100):
+            sds.put("b", f"f{i}", {"dtg": i, "geom": Point(0, 0)}, ts=i)
+        sds.clear("b")
+        # puts AFTER the clear must survive it
+        for i in range(40):
+            sds.put("b", f"g{i}", {"dtg": i, "geom": Point(1, 1)}, ts=i)
+        assert sds.drain("b", timeout_s=10)
+        fids = {s.fid for s in sds.cache("b").states()}
+        assert fids == {f"g{i}" for i in range(40)}
+        sds.close()
+
+
+class TestLambdaStore:
+    def test_persist_moves_aged_features(self):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=2)
+        lds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        now = 1_500_000_000_000
+        for i in range(60):
+            ts = now - (5000 if i < 40 else 0)  # 40 old, 20 fresh
+            lds.write("t", f"f{i}", {"name": f"n{i}", "dtg": ts,
+                                     "geom": Point(i % 90, i % 45)}, ts=ts)
+        assert lds.stream.drain("t")
+        moved = lds.persist_once("t", now_ms=now)
+        assert moved == 40
+        assert lds.hot_count("t") == 20
+        assert lds.cold.query("t", "INCLUDE").count == 40
+        # merged query sees everything exactly once
+        r = lds.query("t", "INCLUDE")
+        assert sorted(r.table.fids.tolist()) == sorted(f"f{i}" for i in range(60))
+        lds.close()
+
+    def test_update_racing_persist_stays_hot(self):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=1)
+        lds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        now = 1_500_000_000_000
+        lds.write("t", "f0", {"name": "old", "dtg": now - 5000,
+                              "geom": Point(0, 0)}, ts=now - 5000)
+        assert lds.stream.drain("t")
+        # persist the old generation, then update — newer state stays hot
+        assert lds.persist_once("t", now_ms=now) == 1
+        lds.write("t", "f0", {"name": "new", "dtg": now, "geom": Point(1, 1)},
+                  ts=now)
+        assert lds.stream.drain("t")
+        r = lds.query("t", "INCLUDE")
+        assert r.count == 1
+        assert r.table.record(0)["name"] == "new"
+        # a later persist supersedes the cold copy instead of duplicating
+        assert lds.persist_once("t", now_ms=now + 5000) == 1
+        r2 = lds.query("t", "INCLUDE")
+        assert r2.count == 1 and r2.table.record(0)["name"] == "new"
+        lds.close()
+
+    def test_soak_concurrent_ingest_query_persist(self):
+        """Writers + queriers + the persister thread all running: no feature
+        lost, none duplicated (VERDICT r1 item 8 'done' criterion)."""
+        import threading
+        import time as _time
+
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=50, persist_interval_s=0.05,
+                              consumers=3)
+        lds.create_schema("s", "name:String,dtg:Date,*geom:Point")
+        n_writers, per_writer = 4, 150
+        errs = []
+
+        def writer(w):
+            try:
+                for i in range(per_writer):
+                    ts = int(_time.time() * 1000)
+                    lds.write("s", f"w{w}-{i}",
+                              {"name": f"n{w}", "dtg": ts,
+                               "geom": Point((w * 37 + i) % 170 - 80, i % 80 - 40)},
+                              ts=ts)
+                    if i % 25 == 0:
+                        _time.sleep(0.002)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        stop = threading.Event()
+
+        def querier():
+            try:
+                while not stop.is_set():
+                    lds.query("s", "BBOX(geom, -90, -45, 90, 45)")
+                    _time.sleep(0.005)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(n_writers)]
+        qt = threading.Thread(target=querier)
+        qt.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert lds.stream.drain("s", timeout_s=15)
+        _time.sleep(0.3)  # a few persister passes
+        stop.set()
+        qt.join()
+        assert not errs, errs
+        r = lds.query("s", "INCLUDE")
+        expect = {f"w{w}-{i}" for w in range(n_writers) for i in range(per_writer)}
+        got = r.table.fids.tolist()
+        assert len(got) == len(set(got)), "duplicated features"
+        assert set(got) == expect, (
+            f"lost {len(expect - set(got))} / extra {len(set(got) - expect)}"
+        )
+        lds.close()
+
+
+class TestLambdaDelete:
+    def test_delete_spans_both_tiers(self):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=2)
+        lds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        now = 1_500_000_000_000
+        for i in range(10):
+            lds.write("t", f"f{i}", {"name": f"n{i}", "dtg": now - 5000,
+                                     "geom": Point(i, i)}, ts=now - 5000)
+        assert lds.stream.drain("t")
+        assert lds.persist_once("t", now_ms=now) == 10  # all cold now
+        lds.delete("t", "f3")
+        assert lds.stream.drain("t")
+        r = lds.query("t", "INCLUDE")
+        assert sorted(r.table.fids.tolist()) == sorted(
+            f"f{i}" for i in range(10) if i != 3
+        )
+        # a persist pass cannot resurrect the deleted feature
+        lds.persist_once("t", now_ms=now + 10_000)
+        assert "f3" not in set(lds.query("t", "INCLUDE").table.fids.tolist())
+        # re-put after delete revives it
+        lds.write("t", "f3", {"name": "back", "dtg": now, "geom": Point(3, 3)},
+                  ts=now)
+        assert lds.stream.drain("t")
+        got = lds.query("t", "INCLUDE")
+        assert "f3" in set(got.table.fids.tolist())
+        lds.close()
