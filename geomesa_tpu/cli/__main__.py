@@ -16,10 +16,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
 import numpy as np
+
+# The axon site hook force-registers the TPU relay backend at interpreter
+# start, overriding JAX_PLATFORMS — honor an explicit env choice before any
+# backend initializes, so a wedged relay can't hang CLI commands.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax_cfg
+
+    _jax_cfg.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def _load(args):

@@ -393,3 +393,66 @@ class FidIn(Filter):
         return np.fromiter(
             (f in want for f in table.fids), dtype=bool, count=len(table)
         )
+
+
+def _cql_literal(v) -> str:
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    return str(v)
+
+
+def _cql_millis(ms: int) -> str:
+    import datetime
+
+    return (
+        datetime.datetime.fromtimestamp(ms / 1000, datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+    )
+
+
+def to_cql(f: Filter) -> str:
+    """Render an AST back to CQL text (parse(to_cql(f)) round-trips).
+
+    The wire format for shipping filters to REMOTE stores (the federation
+    path, ``MergedDataStoreView`` over DCN) and the explain/audit rendering.
+    """
+    from geomesa_tpu.geometry.wkt import to_wkt
+
+    if isinstance(f, Include):
+        return "INCLUDE"
+    if isinstance(f, Exclude):
+        return "EXCLUDE"
+    if isinstance(f, And):
+        return " AND ".join(f"({to_cql(c)})" for c in f.children)
+    if isinstance(f, Or):
+        return " OR ".join(f"({to_cql(c)})" for c in f.children)
+    if isinstance(f, Not):
+        return f"NOT ({to_cql(f.child)})"
+    if isinstance(f, BBox):
+        return f"BBOX({f.prop}, {f.xmin}, {f.ymin}, {f.xmax}, {f.ymax})"
+    if isinstance(f, SpatialOp):
+        wkt = to_wkt(f.geometry)
+        if f.op == "dwithin":
+            return f"DWITHIN({f.prop}, {wkt}, {f.distance}, kilometers)"
+        return f"{f.op.upper()}({f.prop}, {wkt})"
+    if isinstance(f, During):
+        return f"{f.prop} DURING {_cql_millis(f.lo_millis)}/{_cql_millis(f.hi_millis)}"
+    if isinstance(f, TempOp):
+        return f"{f.prop} {f.op.upper()} {_cql_millis(f.millis)}"
+    if isinstance(f, Compare):
+        return f"{f.prop} {f.op} {_cql_literal(f.literal)}"
+    if isinstance(f, Between):
+        return f"{f.prop} BETWEEN {_cql_literal(f.lo)} AND {_cql_literal(f.hi)}"
+    if isinstance(f, In):
+        vals = ", ".join(_cql_literal(v) for v in f.literals)
+        return f"{f.prop} IN ({vals})"
+    if isinstance(f, Like):
+        return f"{f.prop} LIKE {_cql_literal(f.pattern)}"
+    if isinstance(f, IsNull):
+        return f"{f.prop} IS NULL"
+    if isinstance(f, FidIn):
+        vals = ", ".join(_cql_literal(v) for v in f.fids)
+        return f"IN ({vals})"
+    raise ValueError(f"cannot render {type(f).__name__} to CQL")

@@ -69,6 +69,10 @@ class QueryPlanInfo:
     n_candidates: int
     plan_ms: float
     notes: list[str] = field(default_factory=list)
+    # multi-plan union (FilterSplitter role): [(index_name, IndexPlan,
+    # Extraction)] — when set, the scan is the union of these sub-scans and
+    # ``index_name`` reads "union(...)"
+    sub_plans: list = None
 
     def explain(self) -> str:
         lines = [
@@ -248,6 +252,37 @@ class QueryPlanner:
             notes.append(f"id lookup on {len(fids)} fids")
         else:
             plan = index.plan(e, max_ranges)
+
+        # FilterSplitter role (FilterSplitter.scala:25): a top-level OR whose
+        # arms each bind a DIFFERENT index (e.g. cross-attribute ORs) can run
+        # as a union of tight scans instead of one loose/full scan — taken
+        # when the combined sub-scan candidates undercut the single plan
+        if "index" not in q.hints:
+            union = self._union_plans(f, max_ranges, notes)
+            if union is not None:
+                union_cand = sum(p.n_candidates for _, p, _ in union)
+                if union_cand < plan.n_candidates:
+                    notes.append(
+                        "union plan: "
+                        + " + ".join(
+                            f"{n}({p.n_candidates})" for n, p, _ in union
+                        )
+                        + f" = {union_cand} candidates vs {name}"
+                        f"({plan.n_candidates}) single-index"
+                    )
+                    info = QueryPlanInfo(
+                        type_name=self.sft.name,
+                        filter_str=str(q.filter) if q.filter is not None else "INCLUDE",
+                        index_name="union(" + ",".join(n for n, _, _ in union) + ")",
+                        extraction=e,
+                        n_intervals=sum(len(p.intervals) for _, p, _ in union),
+                        n_candidates=union_cand,
+                        plan_ms=(time.perf_counter() - t0) * 1e3,
+                        notes=notes,
+                        sub_plans=union,
+                    )
+                    return plan, f, info
+
         info = QueryPlanInfo(
             type_name=self.sft.name,
             filter_str=str(q.filter) if q.filter is not None else "INCLUDE",
@@ -259,6 +294,47 @@ class QueryPlanner:
             notes=notes,
         )
         return plan, f, info
+
+    def _union_plans(self, f: ast.Filter, max_ranges: int, notes: list):
+        """CNF alternative: top-level OR → per-arm index plans, or None.
+
+        Every arm must be bounded under SOME index (spatial, temporal,
+        indexed-attribute, or fid bounds) — one unbounded arm makes the union
+        a full scan and the single-plan path is strictly better.
+        """
+        if not isinstance(f, ast.Or) or not (2 <= len(f.children) <= 8):
+            return None
+        from geomesa_tpu.filter.bounds import coerce_attr_bounds
+
+        budget = max(1, max_ranges // len(f.children))
+        subs = []
+        for child in f.children:
+            e_c = extract(
+                child, self.sft.geom_field, self.sft.dtg_field,
+                attrs=self.indexed_attrs,
+            )
+            e_c = coerce_attr_bounds(self.sft, e_c)
+            fids = _extract_fids(child) or (
+                child.fids if isinstance(child, ast.FidIn) else None
+            )
+            bounded = (
+                e_c.spatially_bounded
+                or e_c.temporally_bounded
+                or any(b is not None for b in e_c.attributes.values())
+                or fids is not None
+            )
+            if not bounded:
+                return None
+            name, _ = StrategyDecider.choose(
+                self.indices, e_c, child, {}, self.stats
+            )
+            index = self.indices[name]
+            if fids is not None and isinstance(index, IdIndex):
+                plan = index.plan_fids(list(fids))
+            else:
+                plan = index.plan(e_c, budget)
+            subs.append((name, plan, e_c))
+        return subs
 
 
 def build_indices(sft: FeatureType) -> dict[str, FeatureIndex]:

@@ -425,11 +425,23 @@ class DataStore:
                 t0 = _time.perf_counter()
                 plan, f, plan_box["info"] = planner.plan(q)
                 plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
-                index = st.indices[plan_box["info"].index_name]
-                rows = self.backend.select(
-                    st.backend_state, index, plan, plan_box["info"].extraction,
-                    f, st.table,
-                )
+                info = plan_box["info"]
+                if info.sub_plans:
+                    # FilterSplitter union: scan each arm on its own index
+                    # (full filter as residual keeps each arm exact), union
+                    parts = [
+                        self.backend.select(
+                            st.backend_state, st.indices[n], p, e_c, f, st.table
+                        )
+                        for n, p, e_c in info.sub_plans
+                    ]
+                    rows = np.unique(np.concatenate(parts))
+                else:
+                    index = st.indices[info.index_name]
+                    rows = self.backend.select(
+                        st.backend_state, index, plan, info.extraction,
+                        f, st.table,
+                    )
             rows = np.sort(rows)
 
             # hot-tier merge (LambdaQueryRunner role): brute-force the small

@@ -1,0 +1,84 @@
+"""Remote datastore client: a store whose scans run across an HTTP boundary.
+
+Role parity: the reference federates independent stores with
+``MergedDataStoreView.scala:31`` / ``MergedQueryRunner.scala``; each member
+store reaches its own cluster over the network. Here a
+:class:`RemoteDataStore` speaks to another process's REST endpoint
+(:mod:`geomesa_tpu.web.app`) — filters ship as CQL text
+(:func:`geomesa_tpu.filter.ast.to_cql`), results come back as Arrow IPC —
+and plugs straight into ``MergedDataStoreView``, giving the multi-slice /
+DCN federation story (SURVEY.md §2.20 P10): per-slice plans run where the
+data lives, only Arrow results cross the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+from geomesa_tpu.store.datastore import QueryResult
+
+__all__ = ["RemoteDataStore"]
+
+
+class RemoteDataStore:
+    """Read-only client over a geomesa_tpu REST endpoint.
+
+    Implements the store surface ``MergedDataStoreView`` consumes
+    (``get_schema`` / ``list_schemas`` / ``query`` / ``stats_count``), so a
+    federation can mix in-process stores and remote slices freely.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._schemas: dict[str, FeatureType] = {}
+
+    def _get(self, path: str, params: dict | None = None) -> bytes:
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return r.read()
+
+    def _get_json(self, path: str, params: dict | None = None):
+        return json.loads(self._get(path, params))
+
+    # -- store surface --------------------------------------------------------
+    def list_schemas(self) -> list[str]:
+        return self._get_json("/api/schemas")["schemas"]
+
+    def get_schema(self, name: str) -> FeatureType:
+        if name not in self._schemas:
+            meta = self._get_json(f"/api/schemas/{name}")
+            self._schemas[name] = parse_spec(name, meta["spec"])
+        return self._schemas[name]
+
+    def query(self, type_name: str, q: Query | str | None = None, **kwargs) -> QueryResult:
+        from geomesa_tpu.io.arrow import from_ipc_bytes
+
+        if isinstance(q, str) or q is None:
+            q = Query(filter=q, **kwargs)
+        params = {"format": "arrow"}
+        f = q.resolved_filter()
+        if not isinstance(f, ast.Include):
+            params["cql"] = f if isinstance(f, str) else ast.to_cql(f)
+        if q.limit is not None:
+            params["limit"] = str(q.limit)
+        data = self._get(f"/api/schemas/{type_name}/query", params)
+        table = from_ipc_bytes(self.get_schema(type_name), data)
+        return QueryResult(table, np.arange(len(table)))
+
+    def stats_count(self, type_name: str, cql=None, exact: bool = False) -> float:
+        params = {"exact": "true" if exact else "false"}
+        if cql:
+            params["cql"] = cql if isinstance(cql, str) else ast.to_cql(cql)
+        out = self._get_json(f"/api/schemas/{type_name}/stats/count", params)
+        return float(out["count"])
