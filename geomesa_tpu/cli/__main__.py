@@ -95,6 +95,14 @@ def cmd_ingest(args):
         if args.name not in ds.list_schemas():
             ds.create_schema(gdelt_sft(args.name))
         conv = gdelt_converter(ds.get_schema(args.name))
+    elif args.converter in ("osm-nodes", "osm-ways"):
+        from geomesa_tpu.convert.osm import OsmConverter
+
+        conv = OsmConverter(
+            mode=args.converter.split("-")[1], type_name=args.name
+        )
+        if args.name not in ds.list_schemas():
+            ds.create_schema(conv.sft)
     else:
         sft = ds.get_schema(args.name)
         fields = dict(kv.split("=", 1) for kv in (args.field or []))
@@ -253,7 +261,10 @@ def main(argv=None):
 
     sp = sub.add_parser("ingest")
     common(sp)
-    sp.add_argument("--converter", default="delimited", help="'gdelt' or 'delimited'")
+    sp.add_argument(
+        "--converter", default="delimited",
+        help="'gdelt', 'osm-nodes', 'osm-ways', or 'delimited'",
+    )
     sp.add_argument("--format", default="csv", choices=["csv", "tsv"])
     sp.add_argument("--field", action="append", help="attr=expression mapping")
     sp.add_argument("--id-field", default=None)

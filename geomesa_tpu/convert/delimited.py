@@ -35,6 +35,46 @@ _NUMERIC_DTYPES = {
 }
 
 
+# -- scripting hook (geomesa-convert scripting-module role) -------------------
+# The reference lets converter configs call user scripts (JS) as transform
+# functions; the analog here is a registry of named Python column functions
+# callable from any field expression. A registered fn receives object arrays
+# (one per argument) and returns an array of the same length — columnar, so
+# a script runs once per file, not once per record.
+_CUSTOM_FUNCTIONS: dict[str, object] = {}
+
+
+def register_function(name: str, fn, vectorized: bool = True) -> None:
+    """Expose ``fn`` to converter expressions as ``name(args...)``.
+
+    ``vectorized=False`` wraps a scalar ``fn(*values) -> value`` so per-record
+    scripts still work (at per-record cost, like the reference's JS hook).
+    """
+    key = name.lower()
+    if key in _RESERVED_FNS:
+        raise ValueError(f"{name!r} shadows a builtin transform function")
+    if not vectorized:
+        inner = fn
+
+        def fn(*cols):  # noqa: ANN001 — object arrays in/out
+            return np.array(
+                [inner(*vals) for vals in zip(*cols)], dtype=object
+            )
+
+    _CUSTOM_FUNCTIONS[key] = fn
+
+
+def unregister_function(name: str) -> None:
+    _CUSTOM_FUNCTIONS.pop(name.lower(), None)
+
+
+_RESERVED_FNS = {
+    "point", "date", "millistodate", "isodate", "int", "integer", "long",
+    "float", "double", "string", "bool", "boolean", "concat", "lower",
+    "upper", "trim", "replace", "substr",
+}
+
+
 @dataclass
 class EvaluationContext:
     """Ingest counters (the reference's ``EvaluationContext`` role)."""
@@ -81,6 +121,11 @@ class DelimitedConverter:
             engine="c",
         )
         return self.convert_frame(df, ctx)
+
+    def convert_str(self, text: str, ctx: EvaluationContext | None = None) -> FeatureTable:
+        import io
+
+        return self.convert_path(io.StringIO(text), ctx)
 
     def convert_frame(self, df, ctx: EvaluationContext | None = None) -> FeatureTable:
         ctx = ctx if ctx is not None else EvaluationContext()
@@ -169,6 +214,32 @@ def _raw(expr: str, df, conv) -> np.ndarray:
         out = parts[0]
         for p in parts[1:]:
             out = np.char.add(out.astype(str), p.astype(str)).astype(object)
+        return out
+    if m and m.group(1).lower() in ("lower", "upper", "trim"):
+        (arg,) = _split_args(m.group(2))
+        raw = _raw(arg, df, conv).astype(str)
+        op = {"lower": np.char.lower, "upper": np.char.upper,
+              "trim": np.char.strip}[m.group(1).lower()]
+        return op(raw).astype(object)
+    if m and m.group(1).lower() == "replace":
+        arg, old, new = _split_args(m.group(2))
+        raw = _raw(arg, df, conv).astype(str)
+        return np.char.replace(raw, old.strip("'\""), new.strip("'\"")).astype(object)
+    if m and m.group(1).lower() == "substr":
+        args = _split_args(m.group(2))
+        raw = _raw(args[0], df, conv).astype(str)
+        lo = int(args[1])
+        hi = int(args[2]) if len(args) > 2 else None
+        return np.array([s[lo:hi] for s in raw], dtype=object)
+    if m and m.group(1).lower() in _CUSTOM_FUNCTIONS:
+        fn = _CUSTOM_FUNCTIONS[m.group(1).lower()]
+        parts = [_raw(a, df, conv) for a in _split_args(m.group(2))]
+        out = np.asarray(fn(*parts), dtype=object)
+        if out.shape != (len(df),):
+            raise ValueError(
+                f"custom function {m.group(1)!r} returned shape {out.shape}, "
+                f"expected ({len(df)},)"
+            )
         return out
     raise ValueError(f"cannot evaluate expression: {expr!r}")
 

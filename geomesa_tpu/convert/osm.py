@@ -1,0 +1,233 @@
+"""OpenStreetMap XML converter: nodes → points, ways → linestrings.
+
+Role parity: ``geomesa-convert/geomesa-convert-osm`` (SURVEY.md §2.16)
+ingests OSM planet extracts as two feature shapes — tagged nodes as point
+features and ways as linestrings with node references resolved against the
+node table. The reference streams protobuf/XML per-entity; here the whole
+document's nodes parse into columnar arrays in one pass and way geometries
+resolve via a vectorized id→position lookup (np.searchsorted over the sorted
+node-id column) rather than a per-ref hash probe.
+
+OSM XML shape::
+
+    <osm>
+      <node id="1" lat="48.1" lon="11.5" timestamp="..." user="..." ...>
+        <tag k="amenity" v="cafe"/>
+      </node>
+      <way id="7" timestamp="..." user="...">
+        <nd ref="1"/> <nd ref="2"/>
+        <tag k="highway" v="primary"/>
+      </way>
+    </osm>
+
+``tag_fields`` promotes chosen tag keys to typed attribute columns; all other
+tags land in the ``tags`` column as ``k=v;k=v`` text (the reference keeps a
+single tags attribute too).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import LineString, Point
+from geomesa_tpu.schema.columnar import FeatureTable, _to_millis
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+
+__all__ = [
+    "osm_node_sft",
+    "osm_way_sft",
+    "parse_osm_nodes",
+    "parse_osm_ways",
+    "OsmConverter",
+]
+
+_NODE_BASE = "osmId:Long:index=true,user:String,dtg:Date,tags:String"
+_WAY_BASE = "osmId:Long:index=true,user:String,dtg:Date,nNodes:Integer,tags:String"
+
+
+def osm_node_sft(name: str = "osm_nodes", tag_fields: tuple[str, ...] = ()) -> FeatureType:
+    extra = "".join(f",{k}:String" for k in tag_fields)
+    return parse_spec(
+        name, _NODE_BASE + extra + ",*geom:Point;geomesa.z3.interval='month'"
+    )
+
+
+def osm_way_sft(name: str = "osm_ways", tag_fields: tuple[str, ...] = ()) -> FeatureType:
+    extra = "".join(f",{k}:String" for k in tag_fields)
+    return parse_spec(
+        name, _WAY_BASE + extra + ",*geom:LineString;geomesa.xz.precision='12'"
+    )
+
+
+def _root(source) -> ET.Element:
+    if isinstance(source, str) and source.lstrip().startswith("<"):
+        return ET.fromstring(source)
+    return ET.parse(source).getroot()
+
+
+def _tags_of(elem: ET.Element) -> dict[str, str]:
+    return {
+        t.get("k", ""): t.get("v", "")
+        for t in elem
+        if t.tag == "tag" and t.get("k")
+    }
+
+
+def _meta(elem: ET.Element) -> tuple[str, int | None]:
+    user = elem.get("user") or ""
+    ts = elem.get("timestamp")
+    return user, (_to_millis(ts) if ts else None)
+
+
+def _tag_text(tags: dict[str, str], promoted: tuple[str, ...]) -> str:
+    return ";".join(f"{k}={v}" for k, v in sorted(tags.items()) if k not in promoted)
+
+
+def parse_osm_nodes(
+    source,
+    tag_fields: tuple[str, ...] = (),
+    tagged_only: bool = False,
+    sft: FeatureType | None = None,
+) -> FeatureTable:
+    """OSM XML → point FeatureTable of nodes.
+
+    ``tagged_only`` keeps only nodes carrying at least one tag (untagged
+    nodes are usually just way-geometry vertices — the reference's node
+    ingest offers the same cut).
+    """
+    root = _root(source)
+    sft = sft or osm_node_sft(tag_fields=tag_fields)
+    recs, fids = [], []
+    for el in root:
+        if el.tag != "node":
+            continue
+        tags = _tags_of(el)
+        if tagged_only and not tags:
+            continue
+        try:
+            lat, lon = float(el.get("lat")), float(el.get("lon"))
+        except (TypeError, ValueError):
+            continue  # malformed node: skip (reference error-mode default)
+        if abs(lon) > 180 or abs(lat) > 90:
+            continue
+        user, t = _meta(el)
+        oid = int(el.get("id"))
+        rec = {
+            "osmId": oid,
+            "user": user,
+            "dtg": t,
+            "tags": _tag_text(tags, tag_fields),
+            "geom": Point(lon, lat),
+        }
+        for k in tag_fields:
+            rec[k] = tags.get(k)
+        recs.append(rec)
+        fids.append(f"n{oid}")
+    return FeatureTable.from_records(sft, recs, fids)
+
+
+def parse_osm_ways(
+    source,
+    tag_fields: tuple[str, ...] = (),
+    sft: FeatureType | None = None,
+) -> FeatureTable:
+    """OSM XML → linestring FeatureTable of ways.
+
+    Node refs resolve against the document's own ``<node>`` elements via one
+    sorted-id searchsorted per way batch; ways with unresolvable refs or
+    fewer than 2 resolved nodes are skipped (reference behavior for
+    incomplete extracts).
+    """
+    root = _root(source)
+    sft = sft or osm_way_sft(tag_fields=tag_fields)
+
+    node_ids, node_lon, node_lat = [], [], []
+    ways = []
+    for el in root:
+        if el.tag == "node":
+            try:
+                nid = int(el.get("id"))
+                x, y = float(el.get("lon")), float(el.get("lat"))
+            except (TypeError, ValueError):
+                continue
+            node_ids.append(nid)
+            node_lon.append(x)
+            node_lat.append(y)
+        elif el.tag == "way":
+            refs = [int(nd.get("ref")) for nd in el if nd.tag == "nd"]
+            ways.append((el, refs))
+
+    ids = np.asarray(node_ids, dtype=np.int64)
+    lon = np.asarray(node_lon, dtype=np.float64)
+    lat = np.asarray(node_lat, dtype=np.float64)
+    order = np.argsort(ids, kind="stable")
+    ids_s, lon_s, lat_s = ids[order], lon[order], lat[order]
+
+    recs, fids = [], []
+    for el, refs in ways:
+        if len(refs) < 2:
+            continue
+        r = np.asarray(refs, dtype=np.int64)
+        pos = np.searchsorted(ids_s, r)
+        if (pos >= len(ids_s)).any() or not np.array_equal(ids_s[pos], r):
+            continue  # unresolvable ref: incomplete extract
+        coords = np.stack([lon_s[pos], lat_s[pos]], axis=1)
+        tags = _tags_of(el)
+        user, t = _meta(el)
+        oid = int(el.get("id"))
+        rec = {
+            "osmId": oid,
+            "user": user,
+            "dtg": t,
+            "nNodes": len(refs),
+            "tags": _tag_text(tags, tag_fields),
+            "geom": LineString(coords),
+        }
+        for k in tag_fields:
+            rec[k] = tags.get(k)
+        recs.append(rec)
+        fids.append(f"w{oid}")
+    return FeatureTable.from_records(sft, recs, fids)
+
+
+class OsmConverter:
+    """Converter-shaped facade (``convert_path``/``convert_str``) so OSM plugs
+    into the CLI ingest path like the delimited/JSON/XML/shapefile converters.
+
+    ``mode``: ``"nodes"`` | ``"ways"``.
+    """
+
+    def __init__(
+        self,
+        mode: str = "nodes",
+        tag_fields: tuple[str, ...] = (),
+        tagged_only: bool = False,
+        type_name: str | None = None,
+    ):
+        if mode not in ("nodes", "ways"):
+            raise ValueError(f"mode must be nodes|ways: {mode}")
+        self.mode = mode
+        self.tag_fields = tuple(tag_fields)
+        self.tagged_only = tagged_only
+        self.id_field = "osmId"  # fids derive from osm ids: stable across files
+        if mode == "nodes":
+            self.sft = osm_node_sft(type_name or "osm_nodes", self.tag_fields)
+        else:
+            self.sft = osm_way_sft(type_name or "osm_ways", self.tag_fields)
+
+    def convert_path(self, path, ctx=None) -> FeatureTable:
+        with open(path, encoding="utf-8") as f:
+            return self.convert_str(f.read(), ctx)
+
+    def convert_str(self, text: str, ctx=None) -> FeatureTable:
+        if self.mode == "nodes":
+            out = parse_osm_nodes(
+                text, self.tag_fields, self.tagged_only, sft=self.sft
+            )
+        else:
+            out = parse_osm_ways(text, self.tag_fields, sft=self.sft)
+        if ctx is not None:
+            ctx.success += len(out)
+        return out

@@ -1,0 +1,167 @@
+"""OSM converter (nodes/ways) + converter scripting-function registry."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.delimited import (
+    DelimitedConverter,
+    register_function,
+    unregister_function,
+)
+from geomesa_tpu.convert.osm import (
+    OsmConverter,
+    parse_osm_nodes,
+    parse_osm_ways,
+)
+from geomesa_tpu.schema.sft import parse_spec
+
+OSM_XML = """<?xml version="1.0"?>
+<osm version="0.6">
+  <node id="101" lat="48.137" lon="11.575" user="alice"
+        timestamp="2020-05-01T10:00:00Z">
+    <tag k="amenity" v="cafe"/>
+    <tag k="name" v="Cafe Eins"/>
+  </node>
+  <node id="102" lat="48.140" lon="11.580" user="bob"
+        timestamp="2020-05-02T11:30:00Z"/>
+  <node id="103" lat="48.150" lon="11.590" user="bob"
+        timestamp="2020-05-02T11:31:00Z"/>
+  <node id="999" lat="95.0" lon="200.0" user="bad"/>
+  <way id="7" user="carol" timestamp="2020-06-01T00:00:00Z">
+    <nd ref="101"/> <nd ref="102"/> <nd ref="103"/>
+    <tag k="highway" v="primary"/>
+    <tag k="name" v="Main St"/>
+  </way>
+  <way id="8" user="carol">
+    <nd ref="102"/> <nd ref="77777"/>
+  </way>
+  <way id="9" user="carol">
+    <nd ref="101"/>
+  </way>
+</osm>
+"""
+
+
+class TestOsmNodes:
+    def test_all_nodes(self):
+        t = parse_osm_nodes(OSM_XML)
+        # node 999 has out-of-range coords and is dropped
+        assert len(t) == 3
+        assert list(t.fids) == ["n101", "n102", "n103"]
+        ids = t.columns["osmId"].values
+        assert list(ids) == [101, 102, 103]
+        g = t.geom_column()
+        assert g.x[0] == pytest.approx(11.575)
+        assert g.y[0] == pytest.approx(48.137)
+        assert "amenity=cafe" in t.columns["tags"].values[0]
+        # timestamps parsed to epoch millis
+        assert t.dtg_millis()[0] == 1588327200000
+
+    def test_tagged_only_and_promoted_tags(self):
+        t = parse_osm_nodes(OSM_XML, tag_fields=("amenity",), tagged_only=True)
+        assert len(t) == 1
+        assert t.columns["amenity"].values[0] == "cafe"
+        # promoted key is excluded from the residual tags text
+        assert "amenity" not in t.columns["tags"].values[0]
+        assert "name=Cafe Eins" in t.columns["tags"].values[0]
+
+    def test_converter_facade_queryable(self):
+        from geomesa_tpu.store.datastore import DataStore
+
+        conv = OsmConverter(mode="nodes", type_name="osm_n")
+        table = conv.convert_str(OSM_XML)
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        ds.write("osm_n", table)
+        res = ds.query("osm_n", "BBOX(geom, 11.5, 48.0, 11.6, 48.2)")
+        assert len(res.table) == 3
+
+
+class TestOsmWays:
+    def test_ways_resolved(self):
+        t = parse_osm_ways(OSM_XML)
+        # way 8 has an unresolvable ref, way 9 has <2 nodes: both skipped
+        assert len(t) == 1
+        assert list(t.fids) == ["w7"]
+        assert t.columns["nNodes"].values[0] == 3
+        geom = t.geom_column().values[0]
+        assert geom.coords.shape == (3, 2)
+        np.testing.assert_allclose(geom.coords[0], [11.575, 48.137])
+        np.testing.assert_allclose(geom.coords[2], [11.590, 48.150])
+        assert "highway=primary" in t.columns["tags"].values[0]
+
+    def test_ways_xz2_query(self):
+        from geomesa_tpu.store.datastore import DataStore
+
+        conv = OsmConverter(mode="ways", type_name="osm_w")
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        ds.write("osm_w", conv.convert_str(OSM_XML))
+        hit = ds.query("osm_w", "BBOX(geom, 11.57, 48.13, 11.60, 48.16)")
+        assert len(hit.table) == 1
+        miss = ds.query("osm_w", "BBOX(geom, -10, -10, -5, -5)")
+        assert len(miss.table) == 0
+
+
+class TestScriptingFunctions:
+    def test_string_builtins(self):
+        sft = parse_spec("s", "a:String,b:String,*geom:Point")
+        conv = DelimitedConverter(
+            sft,
+            fields={
+                "a": "upper($1)",
+                "b": "replace(trim($2), 'x', 'y')",
+                "geom": "point($3, $4)",
+            },
+            header=False,
+        )
+        t = conv.convert_str("ab, xo x ,1,2\ncd,xx,3,4\n")
+        assert list(t.columns["a"].values) == ["AB", "CD"]
+        assert list(t.columns["b"].values) == ["yo y", "yy"]
+
+    def test_registered_vectorized(self):
+        register_function("geohash4", lambda c: np.asarray(
+            [s[:4] for s in c], dtype=object))
+        try:
+            sft = parse_spec("s", "g:String,*geom:Point")
+            conv = DelimitedConverter(
+                sft, fields={"g": "geohash4($1)", "geom": "point($2, $3)"},
+                header=False,
+            )
+            t = conv.convert_str("u4pruydq,10,50\n")
+            assert t.columns["g"].values[0] == "u4pr"
+        finally:
+            unregister_function("geohash4")
+
+    def test_registered_scalar(self):
+        register_function(
+            "pad5", lambda v: str(v).zfill(5), vectorized=False)
+        try:
+            sft = parse_spec("s", "g:String,*geom:Point")
+            conv = DelimitedConverter(
+                sft, fields={"g": "pad5($1)", "geom": "point($2, $3)"},
+                header=False,
+            )
+            t = conv.convert_str("42,10,50\n7,11,51\n")
+            assert list(t.columns["g"].values) == ["00042", "00007"]
+        finally:
+            unregister_function("pad5")
+
+    def test_shadow_builtin_rejected(self):
+        with pytest.raises(ValueError):
+            register_function("point", lambda c: c)
+
+    def test_cli_osm_ingest(self, tmp_path):
+        from geomesa_tpu.cli.__main__ import main
+
+        src = tmp_path / "extract.osm"
+        src.write_text(OSM_XML)
+        cat = tmp_path / "cat"
+        main(["ingest", "-c", str(cat), "-n", "osm_cli",
+              "--converter", "osm-nodes", str(src)])
+        dst = tmp_path / "out.csv"
+        main(["export", "-c", str(cat), "-n", "osm_cli",
+              "-q", "BBOX(geom, 11.5, 48.0, 11.6, 48.2)",
+              "--format", "csv", "-o", str(dst)])
+        body = dst.read_text()
+        assert "101" in body and "alice" in body
