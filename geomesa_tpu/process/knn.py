@@ -85,7 +85,7 @@ def knn_many(ds, type_name: str, points, k: int = 10):
 
     st = ds._state(type_name)
     dev = index_name = None
-    if isinstance(ds.backend, TpuBackend):
+    if isinstance(ds.backend, TpuBackend) and ds._device_available():
         dev, index_name = TpuBackend.point_state(st.backend_state)
     if (
         dev is None
@@ -109,12 +109,22 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     qy = np.array([p.y for p in points], dtype=np.float32)
     (qx, qy), _ = pad_query_axis(mesh, qx, qy)
     c = dev.cols
-    dists, pos = step(
-        c["x"], c["y"], jnp.int32(st.main_rows),
-        jnp.asarray(qx), jnp.asarray(qy),
-    )
-    dists = np.asarray(dists)[: len(points)]
-    pos = np.asarray(pos)[: len(points)]
+    try:
+        dists, pos = step(
+            c["x"], c["y"], jnp.int32(st.main_rows),
+            jnp.asarray(qx), jnp.asarray(qy),
+        )
+        # materialize INSIDE the try: jax dispatch is async, so a dead
+        # device often surfaces at transfer time, not at the step() call
+        dists = np.asarray(dists)[: len(points)]
+        pos = np.asarray(pos)[: len(points)]
+    except Exception as e:  # noqa: BLE001 — device failover to exact path
+        if not ds._is_device_error(e):
+            raise
+        ds._trip_device_circuit(e)
+        ds.metrics.counter("store.query.device_failovers").inc()
+        return [knn(ds, type_name, p, k) for p in points]
+    ds._note_device_ok()
     perm = st.indices[index_name].perm
     out = []
     for qi in range(len(points)):

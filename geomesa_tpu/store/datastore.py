@@ -127,6 +127,79 @@ class DataStore:
         )
         # (scope type-name | None, fn(sft, query) -> query) pairs
         self._interceptors: list[tuple[str | None, Any]] = []
+        # device-failure circuit breaker (failure detection/recovery, SURVEY
+        # §5: the reference delegates to the backing store's failover; here
+        # the host columnar table IS the replica, so a dead device degrades
+        # to exact host scans instead of failing queries)
+        self._device_down_until: float = 0.0
+
+    # -- failure detection / recovery -----------------------------------------
+    DEVICE_BACKOFF_S = 30.0  # circuit stays open this long after a failure
+
+    @staticmethod
+    def _is_device_error(e: BaseException) -> bool:
+        """Errors that mean 'the accelerator path died', not 'bad query'.
+
+        jax/jaxlib-raised errors and connection failures qualify outright;
+        bare RuntimeError/OSError only with a device-flavored message, so a
+        host-side logic bug can't masquerade as an outage and hide behind
+        the (correct but slow) brute-force fallback.
+        """
+        mod = type(e).__module__ or ""
+        if mod.startswith(("jax", "jaxlib")):
+            return True
+        if isinstance(e, (ConnectionError, TimeoutError)):
+            return True
+        if isinstance(e, (RuntimeError, OSError)):
+            msg = str(e).lower()
+            return any(
+                s in msg
+                for s in (
+                    "unavailable", "deadline", "backend", "device", "tunnel",
+                    "axon", "tpu", "transfer", "connection", "socket",
+                )
+            )
+        return False
+
+    def _device_available(self) -> bool:
+        import time as _time
+
+        return _time.monotonic() >= self._device_down_until
+
+    def _trip_device_circuit(self, e: BaseException) -> None:
+        import time as _time
+
+        self._device_down_until = _time.monotonic() + self.DEVICE_BACKOFF_S
+        self.metrics.gauge("store.device.circuit_open").set(1.0)
+
+    def _note_device_ok(self) -> None:
+        """A device path just succeeded: close a half-open circuit."""
+        if self._device_down_until:
+            self._device_down_until = 0.0
+            self.metrics.gauge("store.device.circuit_open").set(0.0)
+
+    def recover(self, type_name: str | None = None) -> bool:
+        """Close the device circuit and rebuild device-resident state.
+
+        Call after an accelerator outage (or let the circuit's backoff probe
+        recover lazily). Returns True when device state reloaded cleanly.
+        """
+        self._device_down_until = 0.0
+        self.metrics.gauge("store.device.circuit_open").set(0.0)
+        names = [type_name] if type_name else list(self._types)
+        ok = True
+        for name in names:
+            st = self._types[name]
+            if st.table is None:
+                continue
+            try:
+                st.backend_state = self.backend.load(st.sft, st.table, st.indices)
+            except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                if not self._is_device_error(e):
+                    raise
+                self._trip_device_circuit(e)
+                ok = False
+        return ok
 
     # -- schema CRUD (MetadataBackedDataStore role) --------------------------
     def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
@@ -281,7 +354,14 @@ class DataStore:
                 index.merge_build(table, prev, n_prev)
             else:
                 index.build(table)
-        backend_state = self.backend.load(st.sft, table, indices)
+        try:
+            backend_state = self.backend.load(st.sft, table, indices)
+        except Exception as e:  # noqa: BLE001 — write must not die with the device
+            if not self._is_device_error(e):
+                raise
+            self._trip_device_circuit(e)
+            self.metrics.counter("store.device.load_failures").inc()
+            backend_state = None  # host paths serve until recover()
         from geomesa_tpu.stats.store_stats import StoreStats
 
         stats = StoreStats(st.sft)
@@ -426,22 +506,33 @@ class DataStore:
                 plan, f, plan_box["info"] = planner.plan(q)
                 plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
                 info = plan_box["info"]
-                if info.sub_plans:
-                    # FilterSplitter union: scan each arm on its own index
-                    # (full filter as residual keeps each arm exact), union
-                    parts = [
-                        self.backend.select(
-                            st.backend_state, st.indices[n], p, e_c, f, st.table
+                # circuit open → don't touch the device; exact host scan
+                state = st.backend_state if self._device_available() else None
+                try:
+                    if info.sub_plans:
+                        # FilterSplitter union: scan each arm on its own index
+                        # (full filter as residual keeps each arm exact), union
+                        parts = [
+                            self.backend.select(
+                                state, st.indices[n], p, e_c, f, st.table
+                            )
+                            for n, p, e_c in info.sub_plans
+                        ]
+                        rows = np.unique(np.concatenate(parts))
+                    else:
+                        index = st.indices[info.index_name]
+                        rows = self.backend.select(
+                            state, index, plan, info.extraction, f, st.table,
                         )
-                        for n, p, e_c in info.sub_plans
-                    ]
-                    rows = np.unique(np.concatenate(parts))
+                except Exception as e:  # noqa: BLE001 — failover, re-raise rest
+                    if state is None or not self._is_device_error(e):
+                        raise
+                    self._trip_device_circuit(e)
+                    self.metrics.counter("store.query.device_failovers").inc()
+                    rows = np.nonzero(f.mask(st.table))[0]
                 else:
-                    index = st.indices[info.index_name]
-                    rows = self.backend.select(
-                        st.backend_state, index, plan, info.extraction,
-                        f, st.table,
-                    )
+                    if state is not None:
+                        self._note_device_ok()
             rows = np.sort(rows)
 
             # hot-tier merge (LambdaQueryRunner role): brute-force the small
@@ -505,7 +596,7 @@ class DataStore:
             return self.query(type_name, q).count
 
         dev = None
-        if isinstance(self.backend, TpuBackend):
+        if isinstance(self.backend, TpuBackend) and self._device_available():
             dev, _ = TpuBackend.point_state(st.backend_state)
         if (
             not loose
@@ -557,17 +648,28 @@ class DataStore:
             (boxes, times), _ = pad_query_axis(mesh, boxes, times)
             step = cached_batched_count_step(mesh)
             c = dev.cols
-            counts = np.asarray(
-                step(
-                    c["x"], c["y"], c["bins"], c["offs"],
-                    jnp.int32(st.main_rows),
-                    jnp.asarray(boxes), jnp.asarray(times),
+            try:
+                counts = np.asarray(
+                    step(
+                        c["x"], c["y"], c["bins"], c["offs"],
+                        jnp.int32(st.main_rows),
+                        jnp.asarray(boxes), jnp.asarray(times),
+                    )
                 )
-            )
-            for k, (i, _) in enumerate(live):
-                out[i] = int(counts[k])
+            except Exception as e:  # noqa: BLE001 — failover to exact host path
+                if not self._is_device_error(e):
+                    raise
+                self._trip_device_circuit(e)
+                self.metrics.counter("store.query.device_failovers").inc()
+                counts = None
+            if counts is not None:
+                self._note_device_ok()
+                for k, (i, _) in enumerate(live):
+                    out[i] = int(counts[k])
         # batched queries still hit metrics + the audit trail
         for i, _ in pending:
+            if out[i] is None:
+                continue  # device failover: the exact path audits these
             self.metrics.counter("store.queries").inc()
             self._audit(type_name, qs[i], 0.0, 0.0, out[i])
         for i, q in enumerate(qs):

@@ -1,0 +1,184 @@
+"""Device-failure detection, host failover, circuit breaker, recovery.
+
+Reference stance (SURVEY.md §5): failure recovery is delegated to the backing
+store's replicas; here the host columnar table is the replica, so a dead
+accelerator degrades queries to exact host scans instead of failing them.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.backends import TpuBackend
+from geomesa_tpu.store.datastore import DataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+T0 = 1_600_000_000_000
+
+
+def _make_store(n=500, seed=3):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("evt", SPEC)
+    ds = DataStore(backend="tpu")
+    ds.create_schema(sft)
+    recs = [
+        {
+            "name": f"f{i}",
+            "dtg": T0 + int(rng.integers(0, 6 * 86_400_000)),
+            "geom": Point(float(rng.uniform(-170, 170)), float(rng.uniform(-80, 80))),
+        }
+        for i in range(n)
+    ]
+    ds.write("evt", FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)]))
+    ds.compact("evt")
+    return ds
+
+
+BBOX = "BBOX(geom, -60, -40, 60, 40)"
+
+
+class TestQueryFailover:
+    def test_device_error_fails_over_and_trips_circuit(self, monkeypatch):
+        ds = _make_store()
+        expected = set(ds.query("evt", BBOX).table.fids)
+        assert expected  # non-trivial result set
+
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("UNAVAILABLE: relay tunnel wedged")
+
+        monkeypatch.setattr(ds.backend, "_mesh_select_positions", boom)
+        r = ds.query("evt", BBOX)
+        assert set(r.table.fids) == expected  # exact host failover
+        assert calls["n"] == 1
+        assert ds.metrics.counter("store.query.device_failovers").count == 1
+
+        # circuit open: the next query never touches the device path
+        r2 = ds.query("evt", BBOX)
+        assert set(r2.table.fids) == expected
+        assert calls["n"] == 1
+
+    def test_non_device_error_propagates(self, monkeypatch):
+        ds = _make_store()
+
+        def bad(*a, **k):
+            raise ValueError("planner bug")
+
+        monkeypatch.setattr(ds.backend, "_mesh_select_positions", bad)
+        with pytest.raises(ValueError):
+            ds.query("evt", BBOX)
+        # a logic error must NOT open the device circuit
+        assert ds._device_available()
+
+    def test_recover_closes_circuit(self, monkeypatch):
+        ds = _make_store()
+        orig = type(ds.backend)._mesh_select_positions
+        calls = {"n": 0}
+
+        def flaky(self, *a, **k):
+            calls["n"] += 1
+            raise RuntimeError("DEADLINE_EXCEEDED")
+
+        monkeypatch.setattr(type(ds.backend), "_mesh_select_positions", flaky)
+        ds.query("evt", BBOX)
+        assert not ds._device_available()
+        monkeypatch.setattr(type(ds.backend), "_mesh_select_positions", orig)
+        assert ds.recover("evt")
+        assert ds._device_available()
+        expected = set(ds.query("evt", BBOX).table.fids)
+        # device path used again (flaky stub no longer installed: calls==1)
+        assert calls["n"] == 1
+        assert len(expected) > 0
+
+    def test_count_many_failover(self, monkeypatch):
+        ds = _make_store()
+        qs = [Query(filter=BBOX), Query(filter="BBOX(geom, 0, 0, 50, 30)")]
+        truth = [ds.query("evt", q.filter).count for q in qs]
+
+        from geomesa_tpu.parallel import query as pq
+
+        def boom(mesh):
+            def step(*a, **k):
+                raise RuntimeError("UNAVAILABLE")
+
+            return step
+
+        monkeypatch.setattr(pq, "cached_batched_count_step", boom)
+        # loose kernel counts can exceed exact (superset); failover path is
+        # exact, so with the device dead the counts must equal the truth
+        got = ds.count_many("evt", qs, loose=True)
+        assert got == truth
+        assert ds.metrics.counter("store.query.device_failovers").count >= 1
+
+    def test_knn_many_failover(self, monkeypatch):
+        from geomesa_tpu.process import knn as knn_mod
+
+        ds = _make_store()
+        pts = [Point(10.0, 10.0), Point(-50.0, 20.0)]
+        want = [t.fids.tolist() for t, _ in knn_mod.knn_many(ds, "evt", pts, k=3)]
+
+        def boom(mesh, k):
+            def step(*a, **k2):
+                raise RuntimeError("UNAVAILABLE")
+
+            return step
+
+        monkeypatch.setattr(
+            "geomesa_tpu.parallel.query.cached_batched_knn_step", boom
+        )
+        got = knn_mod.knn_many(ds, "evt", pts, k=3)
+        assert [t.fids.tolist() for t, _ in got] == want
+
+
+class TestLoadFailover:
+    def test_write_survives_device_load_failure(self, monkeypatch):
+        ds = _make_store(n=100)
+
+        def boom(self, sft, table, indices):
+            raise RuntimeError("backend 'axon' unavailable")
+
+        monkeypatch.setattr(TpuBackend, "load", boom)
+        sft = ds.get_schema("evt")
+        extra = FeatureTable.from_records(
+            sft,
+            [{"name": "x", "dtg": T0, "geom": Point(1.0, 2.0)}],
+            ["extra-1"],
+        )
+        ds.write("evt", extra)
+        ds.compact("evt")  # rebuild hits the dead loader → host state
+        assert ds.metrics.counter("store.device.load_failures").count >= 1
+        r = ds.query("evt", "BBOX(geom, 0.5, 1.5, 1.5, 2.5)")
+        assert "extra-1" in set(r.table.fids)
+
+    def test_recover_reloads_device_state(self, monkeypatch):
+        ds = _make_store(n=100)
+        orig = TpuBackend.load
+
+        def boom(self, sft, table, indices):
+            raise RuntimeError("backend 'axon' unavailable")
+
+        monkeypatch.setattr(TpuBackend, "load", boom)
+        sft = ds.get_schema("evt")
+        ds.write(
+            "evt",
+            FeatureTable.from_records(
+                sft, [{"name": "y", "dtg": T0, "geom": Point(3.0, 4.0)}], ["y-1"]
+            ),
+        )
+        ds.compact("evt")
+        st = ds._state("evt")
+        assert st.backend_state is None
+        monkeypatch.setattr(TpuBackend, "load", orig)
+        assert ds.recover()
+        assert st.backend_state is not None
+        # device select serves again, parity vs oracle
+        r = ds.query("evt", BBOX)
+        oracle = DataStore(backend="oracle")
+        oracle.create_schema(parse_spec("evt", SPEC))
+        oracle.write("evt", st.table)
+        assert set(r.table.fids) == set(oracle.query("evt", BBOX).table.fids)
