@@ -103,6 +103,15 @@ def cmd_ingest(args):
         )
         if args.name not in ds.list_schemas():
             ds.create_schema(conv.sft)
+    elif args.converter == "avro":
+        from geomesa_tpu.convert.avro_converter import AvroConverter
+
+        sft = (
+            ds.get_schema(args.name) if args.name in ds.list_schemas() else None
+        )
+        conv = AvroConverter(sft=sft, type_name=args.name)
+        if sft is None:
+            ds.create_schema(conv.infer_from(args.files[0]))
     else:
         sft = ds.get_schema(args.name)
         fields = dict(kv.split("=", 1) for kv in (args.field or []))
@@ -263,7 +272,7 @@ def main(argv=None):
     common(sp)
     sp.add_argument(
         "--converter", default="delimited",
-        help="'gdelt', 'osm-nodes', 'osm-ways', or 'delimited'",
+        help="'gdelt', 'osm-nodes', 'osm-ways', 'avro', or 'delimited'",
     )
     sp.add_argument("--format", default="csv", choices=["csv", "tsv"])
     sp.add_argument("--field", action="append", help="attr=expression mapping")

@@ -1,0 +1,141 @@
+"""Avro ingest converter: object-container files → FeatureTable.
+
+Role parity: ``geomesa-convert/geomesa-convert-avro`` (SURVEY.md §2.16) —
+ingest Avro records as features, resolving writer→reader schemas (field
+reorder/add/drop, the evolution rules in :mod:`geomesa_tpu.io.avro`) and
+optionally renaming fields. Schema inference from the writer schema covers
+the no-config path (the reference's ``TypeInference`` role for Avro input).
+"""
+
+from __future__ import annotations
+
+import io
+
+from geomesa_tpu.io.avro import read_avro, read_writer_schema
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+
+__all__ = ["AvroConverter", "infer_sft_from_avro"]
+
+_AVRO_TO_SPEC = {
+    "string": "String",
+    "int": "Integer",
+    "long": "Long",
+    "float": "Float",
+    "double": "Double",
+    "boolean": "Boolean",
+}
+
+
+def _field_types(writer_schema: dict) -> list[tuple[str, str]]:
+    """(name, avro primitive) pairs, unions-of-null unwrapped."""
+    out = []
+    for f in writer_schema.get("fields", []):
+        t = f["type"]
+        if isinstance(t, list):  # ["null", X] optional union
+            t = next((b for b in t if b != "null"), "null")
+        if isinstance(t, dict):
+            t = t.get("type", "string")
+        out.append((f["name"], t))
+    return out
+
+
+def infer_sft_from_avro(
+    writer_schema: dict, type_name: str | None = None
+) -> FeatureType:
+    """Writer schema → SFT: avro primitives map to attribute types; a
+    ``bytes`` field named like a geometry (``geom``/``geometry``/``*_geom``)
+    becomes the default Point geometry (WKB payload); a ``long`` field named
+    ``dtg``/``date``/``timestamp`` becomes the Date field."""
+    parts = []
+    geom_done = False
+    for name, t in _field_types(writer_schema):
+        if name == "__fid__":
+            continue
+        low = name.lower()
+        if t == "bytes" and not geom_done and (
+            low in ("geom", "geometry") or low.endswith("_geom")
+        ):
+            parts.append(f"*{name}:Geometry")
+            geom_done = True
+        elif t == "long" and low in ("dtg", "date", "timestamp"):
+            parts.append(f"{name}:Date")
+        elif t in _AVRO_TO_SPEC:
+            parts.append(f"{name}:{_AVRO_TO_SPEC[t]}")
+        else:  # unknown/complex: keep the raw value as text
+            parts.append(f"{name}:String")
+    return parse_spec(
+        type_name or writer_schema.get("name", "avro"), ",".join(parts)
+    )
+
+
+class AvroConverter:
+    """Avro container files → FeatureTable for one schema.
+
+    ``sft=None`` infers the schema from the file's writer schema on first
+    convert (available as ``self.sft`` afterwards). ``rename`` maps writer
+    field names → SFT attribute names for mismatched vocabularies.
+    """
+
+    def __init__(
+        self,
+        sft: FeatureType | None = None,
+        rename: dict[str, str] | None = None,
+        type_name: str | None = None,
+    ):
+        self.sft = sft
+        self.rename = dict(rename or {})
+        self.type_name = type_name
+        # "__fid__" when files embed fids (stable across files); None when
+        # read_avro synthesizes per-file row numbers, so multi-file ingest
+        # callers know to qualify them — set per file in convert_bytes
+        self.id_field: str | None = "__fid__"
+
+    def infer_from(self, path) -> FeatureType:
+        """Header-only schema inference (no record decode)."""
+        self.sft = infer_sft_from_avro(read_writer_schema(path), self.type_name)
+        return self.sft
+
+    def convert_path(self, path, ctx=None) -> FeatureTable:
+        with open(path, "rb") as f:
+            return self.convert_bytes(f.read(), ctx)
+
+    def convert_str(self, data, ctx=None) -> FeatureTable:
+        if isinstance(data, str):
+            data = data.encode("latin-1")  # container files are binary
+        return self.convert_bytes(data, ctx)
+
+    def convert_bytes(self, data: bytes, ctx=None) -> FeatureTable:
+        writer = read_writer_schema(io.BytesIO(data))
+        embedded = any(
+            f.get("name") == "__fid__" for f in writer.get("fields", [])
+        )
+        self.id_field = "__fid__" if embedded else None
+        if self.sft is None:
+            self.sft = infer_sft_from_avro(writer, self.type_name)
+        if self.rename:
+            records, fids, _ = read_avro(io.BytesIO(data))
+            records = [
+                {self.rename.get(k, k): v for k, v in r.items()}
+                for r in records
+            ]
+            from geomesa_tpu.geometry.wkb import from_wkb
+
+            geom_fields = {
+                a.name for a in self.sft.attributes if a.type.is_geometry
+            }
+            for rec in records:
+                for g in geom_fields:
+                    if isinstance(rec.get(g), (bytes, bytearray)):
+                        rec[g] = from_wkb(rec[g])
+            known = {a.name for a in self.sft.attributes}
+            records = [
+                {k: v for k, v in r.items() if k in known} for r in records
+            ]
+            table = FeatureTable.from_records(self.sft, records, fids)
+        else:
+            # schema-resolved path (evolution rules apply; WKB decoded)
+            table = read_avro(io.BytesIO(data), reader_sft=self.sft)
+        if ctx is not None:
+            ctx.success += len(table)
+        return table

@@ -25,7 +25,7 @@ from geomesa_tpu.geometry.wkb import from_wkb, to_wkb
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import AttributeType, FeatureType
 
-__all__ = ["avro_schema", "write_avro", "read_avro"]
+__all__ = ["avro_schema", "write_avro", "read_avro", "read_writer_schema"]
 
 MAGIC = b"Obj\x01"
 
@@ -254,19 +254,8 @@ def write_avro(table: FeatureTable, path_or_buf, block_rows: int = 4096) -> None
             buf.close()
 
 
-def read_avro(path_or_buf, reader_sft: FeatureType | None = None):
-    """Read an Avro object-container file → (records, fids, writer_schema).
-
-    With ``reader_sft``, records are resolved onto that schema (evolution);
-    returns a FeatureTable instead.
-    """
-    # slurp once (object-container files are read whole anyway); the source
-    # fd closes immediately and block parsing walks ONE BytesIO linearly
-    if hasattr(path_or_buf, "read"):
-        buf = io.BytesIO(path_or_buf.read())
-    else:
-        with open(path_or_buf, "rb") as f:
-            buf = io.BytesIO(f.read())
+def _read_header(buf) -> tuple[dict, bytes]:
+    """Container header → (writer schema, sync marker); buf left at block 0."""
     if buf.read(4) != MAGIC:
         raise ValueError("not an avro object container file")
     meta = {}
@@ -282,8 +271,33 @@ def read_avro(path_or_buf, reader_sft: FeatureType | None = None):
             meta[k] = _read_bytes(buf)
     if meta.get("avro.codec", b"null") != b"null":
         raise ValueError(f"unsupported codec: {meta['avro.codec']!r}")
-    writer = json.loads(meta["avro.schema"])
-    sync = buf.read(16)
+    return json.loads(meta["avro.schema"]), buf.read(16)
+
+
+def read_writer_schema(path_or_buf) -> dict:
+    """Header-only read → the file's writer schema (no record decode)."""
+    if hasattr(path_or_buf, "read"):
+        schema, _ = _read_header(path_or_buf)
+        return schema
+    with open(path_or_buf, "rb") as f:
+        schema, _ = _read_header(f)
+        return schema
+
+
+def read_avro(path_or_buf, reader_sft: FeatureType | None = None):
+    """Read an Avro object-container file → (records, fids, writer_schema).
+
+    With ``reader_sft``, records are resolved onto that schema (evolution);
+    returns a FeatureTable instead.
+    """
+    # slurp once (object-container files are read whole anyway); the source
+    # fd closes immediately and block parsing walks ONE BytesIO linearly
+    if hasattr(path_or_buf, "read"):
+        buf = io.BytesIO(path_or_buf.read())
+    else:
+        with open(path_or_buf, "rb") as f:
+            buf = io.BytesIO(f.read())
+    writer, sync = _read_header(buf)
     reader_schema = avro_schema(reader_sft) if reader_sft else None
 
     records, fids = [], []
@@ -297,7 +311,10 @@ def read_avro(path_or_buf, reader_sft: FeatureType | None = None):
                 rec = _decode_resolved(block, writer, reader_schema)
             else:
                 rec = _decode_record(block, writer)
-            fids.append(rec.pop("__fid__", str(len(fids))))
+            fid = rec.pop("__fid__", None)
+            # None also covers schema resolution filling a missing writer
+            # field with a null default — synthesize row numbers either way
+            fids.append(str(len(fids)) if fid is None else fid)
             records.append(rec)
         if buf.read(16) != sync:
             raise ValueError("sync marker mismatch (corrupt file)")

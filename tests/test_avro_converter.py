@@ -1,0 +1,168 @@
+"""Avro ingest converter: inference, rename, evolution, store round-trip."""
+
+import io
+
+import numpy as np
+
+from geomesa_tpu.convert.avro_converter import AvroConverter, infer_sft_from_avro
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.io.avro import avro_schema, write_avro
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+
+def _sample_table(n=20, name="evt"):
+    sft = parse_spec(
+        name, "name:String,count:Integer,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+    )
+    rng = np.random.default_rng(1)
+    recs = [
+        {
+            "name": f"n{i}",
+            "count": int(rng.integers(0, 100)),
+            "dtg": 1_600_000_000_000 + i * 60_000,
+            "geom": Point(float(rng.uniform(-170, 170)), float(rng.uniform(-80, 80))),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"n{i}" for i in range(n)])
+
+
+def _avro_bytes(table) -> bytes:
+    buf = io.BytesIO()
+    write_avro(table, buf)
+    return buf.getvalue()
+
+
+class TestAvroConverter:
+    def test_resolved_fast_path(self):
+        t = _sample_table()
+        conv = AvroConverter(sft=t.sft)
+        out = conv.convert_bytes(_avro_bytes(t))
+        assert len(out) == len(t)
+        assert list(out.fids) == list(t.fids)
+        np.testing.assert_allclose(out.geom_column().x, t.geom_column().x)
+
+    def test_inferred_schema(self):
+        t = _sample_table()
+        conv = AvroConverter()  # no SFT: infer from writer schema
+        out = conv.convert_bytes(_avro_bytes(t))
+        assert conv.sft is not None
+        got = {a.name: a.type.name for a in conv.sft.attributes}
+        assert got["name"] == "STRING"
+        assert got["count"] == "INT"
+        assert got["dtg"] == "DATE"
+        assert conv.sft.geom_field == "geom"
+        assert len(out) == len(t)
+        # geometry decoded from WKB bytes (generic Geometry column: bbox SoA)
+        g = out.geom_column()
+        assert g.bounds is not None
+        np.testing.assert_allclose(g.bounds[:, 0], t.geom_column().x)
+
+    def test_infer_sft_mapping(self):
+        schema = avro_schema(_sample_table().sft)
+        sft = infer_sft_from_avro(schema, "inferred")
+        assert sft.name == "inferred"
+        assert sft.dtg_field == "dtg"
+
+    def test_rename(self):
+        t = _sample_table()
+        target = parse_spec(
+            "evt2",
+            "label:String,count:Integer,dtg:Date,*geom:Point",
+        )
+        conv = AvroConverter(sft=target, rename={"name": "label"})
+        out = conv.convert_bytes(_avro_bytes(t))
+        assert list(out.columns["label"].values) == [f"n{i}" for i in range(20)]
+
+    def test_evolution_reader_adds_field(self):
+        t = _sample_table()
+        evolved = parse_spec(
+            "evt",
+            "name:String,count:Integer,flag:Boolean,dtg:Date,*geom:Point",
+        )
+        conv = AvroConverter(sft=evolved)
+        out = conv.convert_bytes(_avro_bytes(t))
+        assert len(out) == len(t)
+        col = out.columns["flag"]
+        assert col.valid is not None and not col.valid.any()  # all null
+
+    def test_header_only_inference(self, tmp_path):
+        t = _sample_table()
+        p = tmp_path / "e.avro"
+        write_avro(t, str(p))
+        conv = AvroConverter()
+        sft = conv.infer_from(str(p))
+        assert sft.dtg_field == "dtg" and sft.geom_field == "geom"
+
+    def test_embedded_fids_detected(self):
+        t = _sample_table()
+        conv = AvroConverter(sft=t.sft)
+        conv.convert_bytes(_avro_bytes(t))
+        # write_avro embeds __fid__: ids are stable, no renumber needed
+        assert conv.id_field == "__fid__"
+
+    def test_foreign_file_without_fids(self):
+        # hand-build a container whose writer schema has NO __fid__ field
+        import json
+        import os
+
+        from geomesa_tpu.io import avro as A
+
+        schema = {
+            "type": "record",
+            "name": "ext",
+            "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "dtg", "type": "long"},
+                {"name": "geom", "type": "bytes"},
+            ],
+        }
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.geometry.wkb import to_wkb
+
+        buf = io.BytesIO()
+        buf.write(A.MAGIC)
+        mb = io.BytesIO()
+        A._write_long(mb, 2)
+        for k, v in (
+            ("avro.schema", json.dumps(schema).encode()),
+            ("avro.codec", b"null"),
+        ):
+            A._write_bytes(mb, k.encode())
+            A._write_bytes(mb, v)
+        A._write_long(mb, 0)
+        buf.write(mb.getvalue())
+        sync = os.urandom(16)
+        buf.write(sync)
+        block = io.BytesIO()
+        for i in range(3):
+            A._encode_record(
+                block, schema,
+                {"name": f"x{i}", "dtg": 1_600_000_000_000 + i,
+                 "geom": to_wkb(Point(float(i), 1.0))},
+            )
+        A._write_long(buf, 3)
+        A._write_long(buf, len(block.getvalue()))
+        buf.write(block.getvalue())
+        buf.write(sync)
+
+        conv = AvroConverter()
+        out = conv.convert_bytes(buf.getvalue())
+        assert conv.id_field is None  # synthesized row-number fids
+        assert len(out) == 3
+        assert list(out.fids) == ["0", "1", "2"]
+
+    def test_store_ingest_roundtrip(self, tmp_path):
+        from geomesa_tpu.store.datastore import DataStore
+
+        t = _sample_table()
+        p = tmp_path / "events.avro"
+        write_avro(t, str(p))
+        conv = AvroConverter()
+        table = conv.convert_path(str(p))
+        ds = DataStore()
+        ds.create_schema(conv.sft)
+        ds.write(conv.sft.name, table)
+        r = ds.query(conv.sft.name, "count >= 0")
+        assert len(r.table) == len(t)
