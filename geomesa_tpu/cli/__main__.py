@@ -231,6 +231,60 @@ def cmd_stats_histogram(args):
         print(f"[{lo:.4g}, {lo + step * max(1, h.bins // args.bins):.4g}): {c}")
 
 
+def cmd_manage_partitions(args):
+    """``manage-partitions`` (geomesa-tools role, SURVEY.md §2.17): list the
+    catalog's persisted partitions per type, or delete one partition's rows
+    (drop + re-save, the reference's delete-partition semantics)."""
+    import json as _json
+
+    from geomesa_tpu.store import persistence
+
+    mpath = Path(args.catalog) / persistence.MANIFEST
+    if not mpath.exists():
+        raise SystemExit(f"no catalog manifest under {args.catalog!r}")
+    manifest = _json.loads(mpath.read_text())
+
+    if args.action == "list":
+        meta = manifest["types"].get(args.name)
+        if meta is None:
+            raise SystemExit(f"unknown type: {args.name!r}")
+        print(f"scheme: {meta.get('scheme', 'flat')}  rows: {meta['count']}")
+        for f in meta["files"]:
+            size = (Path(args.catalog) / args.name / f["file"]).stat().st_size
+            print(f"  {f['partition']:<24} {f['rows']:>10} rows  "
+                  f"{size:>10} bytes  {f['file']}")
+        return
+
+    if args.action == "delete":
+        if not args.partition:
+            raise SystemExit("delete requires --partition KEY")
+        meta = manifest["types"].get(args.name)
+        if meta is None:
+            raise SystemExit(f"unknown type: {args.name!r}")
+        ds = _load(args)
+        st = ds._state(args.name)
+        if st.table is None or len(st.table) == 0:
+            raise SystemExit("type holds no rows")
+        # membership follows the manifest's recorded scheme — the same
+        # partitioning `list` displays — not the schema's current user-data
+        from geomesa_tpu.store.partitions import scheme_from_spec
+
+        scheme = scheme_from_spec(meta.get("scheme", "flat"))
+        keys = scheme.keys(st.sft, st.table)
+        keep = keys != args.partition
+        dropped = int((~keep).sum())
+        if dropped == 0:
+            raise SystemExit(f"no rows in partition {args.partition!r}")
+        # drop by ROW, not by fid: duplicate fids across ingests must not
+        # pull rows out of other partitions
+        ds._rebuild(st, st.table.take(np.nonzero(keep)[0]))
+        _save(ds, args)
+        print(f"deleted partition {args.partition!r}: {dropped} rows")
+        return
+
+    raise SystemExit(f"unknown action: {args.action!r}")
+
+
 def cmd_serve(args):
     ds = _load(args)
     from geomesa_tpu.web import serve
@@ -319,6 +373,15 @@ def main(argv=None):
     sp.add_argument("-a", "--attribute", required=True)
     sp.add_argument("--bins", type=int, default=10)
     sp.set_defaults(fn=cmd_stats_histogram)
+
+    sp = sub.add_parser(
+        "manage-partitions",
+        help="list or delete persisted partitions (geomesa-tools role)",
+    )
+    common(sp)
+    sp.add_argument("action", choices=["list", "delete"])
+    sp.add_argument("--partition", default=None, help="partition key (delete)")
+    sp.set_defaults(fn=cmd_manage_partitions)
 
     sp = sub.add_parser("serve", help="REST API over the catalog (geomesa-web role)")
     common(sp, name=False)

@@ -188,7 +188,13 @@ def scheme_for(sft) -> PartitionScheme:
     """Resolve the schema's partition scheme from user-data
     ``geomesa.fs.scheme`` (comma-separated composite), default ``datetime``.
     """
-    spec = (sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
+    return scheme_from_spec(
+        (sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
+    )
+
+
+def scheme_from_spec(spec) -> PartitionScheme:
+    """Parse a scheme spec string (as recorded in catalog manifests)."""
     parts = []
     for tok in str(spec).split(","):
         tok = tok.strip()

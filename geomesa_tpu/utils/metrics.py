@@ -95,12 +95,15 @@ class MetricsRegistry:
 
     # -- reporters ----------------------------------------------------------
     def snapshot(self) -> dict:
+        # iterate over COPIES: a background reporter (PeriodicReporter)
+        # snapshots while application threads register new metrics, and a
+        # mid-iteration dict insert would kill that interval's report
         out: dict[str, dict] = {}
-        for k, c in self.counters.items():
+        for k, c in list(self.counters.items()):
             out[k] = {"type": "counter", "count": c.count}
-        for k, g in self.gauges.items():
+        for k, g in list(self.gauges.items()):
             out[k] = {"type": "gauge", "value": g.value}
-        for k, h in self.histograms.items():
+        for k, h in list(self.histograms.items()):
             out[k] = {
                 "type": "histogram",
                 "count": h.count,
@@ -109,7 +112,7 @@ class MetricsRegistry:
                 "max": h.max if h.count else 0.0,
                 "stddev": h.stddev,
             }
-        for k, t in self.timers.items():
+        for k, t in list(self.timers.items()):
             h = t.hist
             out[k] = {
                 "type": "timer",
@@ -139,3 +142,59 @@ class MetricsRegistry:
                 typ = vals.pop("type")
                 for k, v in vals.items():
                     fh.write(delimiter.join([str(ts), typ, name, k, str(v)]) + "\n")
+
+
+class PeriodicReporter:
+    """Background scheduled reporter (Dropwizard ``ScheduledReporter`` role).
+
+    Every ``interval_s`` the daemon thread appends a snapshot via
+    ``report_delimited(path)`` (or calls ``fn(registry)`` for a custom sink —
+    the Ganglia/CloudWatch plug point). ``stop()`` wakes the thread and
+    flushes one final report so short-lived processes never lose metrics.
+    """
+
+    def __init__(self, registry: MetricsRegistry, interval_s: float = 60.0,
+                 path: str | None = None, fn=None, delimiter: str = ","):
+        if (path is None) == (fn is None):
+            raise ValueError("pass exactly one of path= or fn=")
+        self.registry = registry
+        self.interval_s = interval_s
+        self._emit = fn if fn is not None else (
+            lambda reg: reg.report_delimited(path, delimiter)
+        )
+        import threading
+
+        self._stop = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._emit(self.registry)
+            except Exception:  # noqa: BLE001 — a sink error must not kill the loop
+                pass
+
+    def start(self) -> "PeriodicReporter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stopped:
+            return  # idempotent: explicit stop + __exit__ must not double-flush
+        self._stopped = True
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            return  # a wedged sink still owns _emit: don't run it concurrently
+        try:
+            self._emit(self.registry)  # final flush
+        except Exception:  # noqa: BLE001 — same tolerance as the loop
+            pass
+
+    def __enter__(self) -> "PeriodicReporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,160 @@
+"""manage-partitions CLI + scheduled metrics reporter."""
+
+import time
+
+import numpy as np
+
+from geomesa_tpu.cli.__main__ import main
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store import persistence
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.utils.metrics import MetricsRegistry, PeriodicReporter
+
+DAY = 86_400_000
+T0 = 1_600_000_000_000  # 2020-09-13
+
+
+def _catalog(tmp_path, n_days=3, per_day=10):
+    sft = parse_spec(
+        "evt", "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+    )
+    ds = DataStore()
+    ds.create_schema(sft)
+    recs, fids = [], []
+    for d in range(n_days):
+        for i in range(per_day):
+            recs.append(
+                {
+                    "name": f"d{d}i{i}",
+                    # 10 days apart: distinct weekly time bins → 3 partitions
+                    "dtg": T0 + d * 10 * DAY + i * 60_000,
+                    "geom": Point(float(i), float(d)),
+                }
+            )
+            fids.append(f"d{d}i{i}")
+    ds.write("evt", FeatureTable.from_records(sft, recs, fids))
+    cat = tmp_path / "cat"
+    persistence.save(ds, str(cat))
+    return cat
+
+
+class TestManagePartitions:
+    def test_list(self, tmp_path, capsys):
+        cat = _catalog(tmp_path)
+        main(["manage-partitions", "-c", str(cat), "-n", "evt", "list"])
+        out = capsys.readouterr().out
+        assert "rows: 30" in out
+        # datetime scheme: one partition line per day
+        assert out.count(" 10 rows") == 3
+
+    def test_delete_partition(self, tmp_path, capsys):
+        cat = _catalog(tmp_path)
+        # find a real partition key from the manifest
+        import json
+
+        manifest = json.loads((cat / persistence.MANIFEST).read_text())
+        keys = [f["partition"] for f in manifest["types"]["evt"]["files"]]
+        victim = keys[0]
+        main(["manage-partitions", "-c", str(cat), "-n", "evt",
+              "delete", "--partition", victim])
+        out = capsys.readouterr().out
+        assert "10 rows" in out
+        manifest2 = json.loads((cat / persistence.MANIFEST).read_text())
+        keys2 = [f["partition"] for f in manifest2["types"]["evt"]["files"]]
+        assert victim not in keys2
+        assert manifest2["types"]["evt"]["count"] == 20
+        # remaining rows still queryable after reload
+        ds = persistence.load(str(cat))
+        assert ds.stats_count("evt", exact=True) == 20
+
+
+    def test_delete_flat_catalog_uses_manifest_scheme(self, tmp_path):
+        # saved flat: `list` shows partition 'all'; delete must agree
+        sft = parse_spec("evt", "name:String,dtg:Date,*geom:Point")
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write(
+            "evt",
+            FeatureTable.from_records(
+                sft,
+                [{"name": "a", "dtg": T0, "geom": Point(1.0, 1.0)},
+                 {"name": "b", "dtg": T0, "geom": Point(2.0, 2.0)}],
+                ["a", "b"],
+            ),
+        )
+        cat = tmp_path / "flatcat"
+        persistence.save(ds, str(cat), partition_by_time=False)
+        import pytest
+
+        with pytest.raises(SystemExit):  # empty after delete is fine to save,
+            # but deleting everything leaves 0 rows -> exercised below; here
+            # just assert the key matches what list shows
+            main(["manage-partitions", "-c", str(cat), "-n", "evt",
+                  "delete", "--partition", "nope"])
+        main(["manage-partitions", "-c", str(cat), "-n", "evt",
+              "delete", "--partition", "all"])
+        ds2 = persistence.load(str(cat))
+        assert ds2.stats_count("evt", exact=True) == 0
+
+    def test_delete_duplicate_fids_row_scoped(self, tmp_path):
+        # same fid in two partitions: deleting one partition keeps the other
+        sft = parse_spec(
+            "evt", "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+        )
+        ds = DataStore()
+        ds.create_schema(sft)
+        ds.write(
+            "evt",
+            FeatureTable.from_records(
+                sft,
+                [{"name": "w0", "dtg": T0, "geom": Point(1.0, 1.0)},
+                 {"name": "w2", "dtg": T0 + 20 * DAY, "geom": Point(2.0, 2.0)}],
+                ["dup", "dup"],  # colliding fids (two separate ingests)
+            ),
+        )
+        cat = tmp_path / "dupcat"
+        persistence.save(ds, str(cat))
+        import json
+
+        manifest = json.loads((cat / persistence.MANIFEST).read_text())
+        files = manifest["types"]["evt"]["files"]
+        assert len(files) == 2
+        main(["manage-partitions", "-c", str(cat), "-n", "evt",
+              "delete", "--partition", files[0]["partition"]])
+        ds2 = persistence.load(str(cat))
+        assert ds2.stats_count("evt", exact=True) == 1
+
+
+class TestPeriodicReporter:
+    def test_reports_on_interval_and_final_flush(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("x").inc(5)
+        path = tmp_path / "metrics.csv"
+        with PeriodicReporter(reg, interval_s=0.05, path=str(path)):
+            time.sleep(0.2)
+        lines = path.read_text().strip().splitlines()
+        # several interval reports plus the stop() flush
+        assert len(lines) >= 2
+        assert any(",counter,x,count,5" in ln for ln in lines)
+
+    def test_custom_sink_and_error_tolerance(self):
+        reg = MetricsRegistry()
+        reg.counter("y").inc()
+        seen = []
+
+        def sink(r):
+            seen.append(r.snapshot()["y"]["count"])
+            raise RuntimeError("sink hiccup")  # must not kill the loop
+
+        rep = PeriodicReporter(reg, interval_s=0.03, fn=sink).start()
+        time.sleep(0.1)
+        rep.stop()
+        assert len(seen) >= 2
+
+    def test_requires_one_sink(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            PeriodicReporter(MetricsRegistry(), path="a", fn=lambda r: None)
