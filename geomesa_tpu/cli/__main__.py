@@ -161,6 +161,19 @@ def _query_of(args):
 def cmd_export(args):
     ds = _load(args)
     r = ds.query(args.name, _query_of(args))
+    if args.format in ("shp", "leaflet") and r.table.sft.geom_field is None:
+        raise SystemExit(f"{args.format} export requires the geometry column "
+                         "(projection dropped it)")
+    if args.format == "shp":
+        # no pre-opened sink: write_shapefile owns the .shp/.shx/.dbf set,
+        # and a validation error must not truncate an existing output
+        if args.output is None or not args.output.endswith(".shp"):
+            raise SystemExit("shp export requires -o OUTPUT.shp")
+        from geomesa_tpu.convert.shapefile import write_shapefile
+
+        write_shapefile(r.table, args.output)
+        print(f"exported {r.count} features", file=sys.stderr)
+        return
     out = sys.stdout.buffer if args.output is None else open(args.output, "wb")
     try:
         if args.format == "csv":
@@ -180,6 +193,30 @@ def cmd_export(args):
             from geomesa_tpu.store.reduce import bin_encode as _bin_encode
 
             out.write(_bin_encode(r.table, {"track": args.bin_track, "sort": True}))
+        elif args.format == "avro":
+            from geomesa_tpu.io.avro import write_avro
+
+            write_avro(r.table, out)
+        elif args.format in ("parquet", "orc"):
+            from geomesa_tpu.io.arrow import to_arrow
+
+            at = to_arrow(r.table, dictionary_encode=False)
+            if args.format == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(at, out)
+            else:
+                import pyarrow.orc as po
+
+                po.write_table(at, out)
+        elif args.format == "gml":
+            from geomesa_tpu.io.gml import to_gml
+
+            out.write(to_gml(r.table))
+        elif args.format == "leaflet":
+            from geomesa_tpu.jupyter import map_html
+
+            out.write(map_html(r.table).encode("utf-8"))
         else:
             raise SystemExit(f"unknown format: {args.format}")
     finally:
@@ -339,7 +376,11 @@ def main(argv=None):
     sp = sub.add_parser("export")
     common(sp)
     sp.add_argument("-q", "--cql", default=None)
-    sp.add_argument("--format", default="csv", choices=["csv", "json", "arrow", "bin"])
+    sp.add_argument(
+        "--format", default="csv",
+        choices=["csv", "json", "arrow", "bin", "avro", "parquet", "orc",
+                 "gml", "leaflet", "shp"],
+    )
     sp.add_argument("-m", "--max", type=int, default=None)
     sp.add_argument("-a", "--attributes", default=None)
     sp.add_argument("--hints", default=None, help="query hints as JSON")

@@ -1,0 +1,100 @@
+"""GML 3.1 export: FeatureTable → a ``wfs:FeatureCollection`` document.
+
+Role parity: the reference CLI exports GML via GeoTools' WFS encoders
+(``export/ExportCommand.scala`` format list, SURVEY.md §2.17). Emission is
+string-building over the columnar arrays (no DOM), one ``featureMember`` per
+row with typed attribute elements and an inline GML geometry.
+"""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import escape
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from geomesa_tpu.schema.columnar import FeatureTable
+
+__all__ = ["to_gml"]
+
+_HEADER = (
+    '<?xml version="1.0" encoding="UTF-8"?>\n'
+    '<wfs:FeatureCollection xmlns:wfs="http://www.opengis.net/wfs" '
+    'xmlns:gml="http://www.opengis.net/gml" '
+    'xmlns:geomesa="http://geomesa.org">\n'
+)
+
+
+def _pos_list(coords: np.ndarray) -> str:
+    return " ".join(f"{x:.8g} {y:.8g}" for x, y in np.asarray(coords))
+
+
+def _gml_geometry(g: Geometry | None) -> str:
+    if g is None:
+        return ""
+    if isinstance(g, Point):
+        return f"<gml:Point><gml:pos>{g.x:.8g} {g.y:.8g}</gml:pos></gml:Point>"
+    if isinstance(g, LineString):
+        return (
+            "<gml:LineString><gml:posList>"
+            f"{_pos_list(g.coords)}</gml:posList></gml:LineString>"
+        )
+    if isinstance(g, Polygon):
+        rings = [
+            "<gml:exterior><gml:LinearRing><gml:posList>"
+            f"{_pos_list(g.shell)}</gml:posList></gml:LinearRing></gml:exterior>"
+        ]
+        for hole in g.holes:
+            rings.append(
+                "<gml:interior><gml:LinearRing><gml:posList>"
+                f"{_pos_list(hole)}</gml:posList></gml:LinearRing></gml:interior>"
+            )
+        return f"<gml:Polygon>{''.join(rings)}</gml:Polygon>"
+    if isinstance(g, (MultiPoint, MultiLineString, MultiPolygon)):
+        members = "".join(
+            f"<gml:geometryMember>{_gml_geometry(p)}</gml:geometryMember>"
+            for p in g.parts
+        )
+        return f"<gml:MultiGeometry>{members}</gml:MultiGeometry>"
+    raise ValueError(f"unsupported geometry: {type(g).__name__}")
+
+
+def to_gml(table: FeatureTable) -> bytes:
+    """FeatureTable → GML 3.1 FeatureCollection bytes."""
+    sft = table.sft
+    name = sft.name
+    geom_field = sft.geom_field
+    geoms = (
+        table.geom_column().geometries() if geom_field is not None else None
+    )
+    attrs = [a for a in sft.attributes if a.name != geom_field]
+    parts = [_HEADER]
+    for i in range(len(table)):
+        fid = escape(str(table.fids[i]), {'"': "&quot;"})  # attribute position
+        parts.append(
+            f'<gml:featureMember><geomesa:{name} gml:id="{fid}">'
+        )
+        for a in attrs:
+            col = table.columns[a.name]
+            if col.valid is not None and not col.valid[i]:
+                continue
+            parts.append(
+                f"<geomesa:{a.name}>{escape(str(col.values[i]))}"
+                f"</geomesa:{a.name}>"
+            )
+        if geoms is not None and geoms[i] is not None:
+            parts.append(
+                f"<geomesa:{geom_field}>{_gml_geometry(geoms[i])}"
+                f"</geomesa:{geom_field}>"
+            )
+        parts.append(f"</geomesa:{name}></gml:featureMember>\n")
+    parts.append("</wfs:FeatureCollection>\n")
+    return "".join(parts).encode("utf-8")

@@ -244,6 +244,20 @@ def reduce_result(sft: FeatureType, table: FeatureTable, rows: np.ndarray, q):
 
     if q.properties is not None:
         keep = {p: table.columns[p] for p in q.properties}
-        table = FeatureTable(table.sft, table.fids, {**keep})
+        # narrow the SFT with the columns: consumers that walk sft.attributes
+        # (avro/gml/shp writers) must see a self-consistent schema, not the
+        # full one with columns missing (TransformSimpleFeature role)
+        from geomesa_tpu.schema.sft import FeatureType
+
+        kept = set(q.properties)
+        sft = FeatureType(
+            name=table.sft.name,
+            attributes=[a for a in table.sft.attributes if a.name in kept],
+            default_geom=(
+                table.sft.geom_field if table.sft.geom_field in kept else None
+            ),
+            user_data=table.sft.user_data,
+        )
+        table = FeatureTable(sft, table.fids, {**keep})
 
     return table, rows, None, None, None

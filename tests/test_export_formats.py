@@ -1,0 +1,146 @@
+"""CLI export format breadth: avro/parquet/orc/gml/leaflet/shp round-trips."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cli.__main__ import main
+from geomesa_tpu.geometry.types import LineString, Point, Polygon
+from geomesa_tpu.io.gml import to_gml
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store import persistence
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_600_000_000_000
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    sft = parse_spec(
+        "evt", "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+    )
+    ds = DataStore()
+    ds.create_schema(sft)
+    recs = [
+        {"name": f"n{i}", "dtg": T0 + i * 1000, "geom": Point(float(i), 10.0)}
+        for i in range(20)
+    ]
+    ds.write("evt", FeatureTable.from_records(sft, recs, [f"n{i}" for i in range(20)]))
+    cat = tmp_path_factory.mktemp("exp") / "cat"
+    persistence.save(ds, str(cat))
+    return cat
+
+
+def _export(catalog, fmt, dst):
+    main(["export", "-c", str(catalog), "-n", "evt",
+          "-q", "BBOX(geom, 4.5, 9, 12.5, 11)", "--format", fmt, "-o", str(dst)])
+
+
+class TestExportFormats:
+    def test_avro(self, catalog, tmp_path):
+        from geomesa_tpu.io.avro import read_avro
+
+        dst = tmp_path / "e.avro"
+        _export(catalog, "avro", dst)
+        records, fids, writer = read_avro(str(dst))
+        assert len(records) == 8
+        assert set(fids) == {f"n{i}" for i in range(5, 13)}
+
+    def test_parquet_and_orc(self, catalog, tmp_path):
+        import pyarrow.orc as po
+        import pyarrow.parquet as pq
+
+        dst = tmp_path / "e.parquet"
+        _export(catalog, "parquet", dst)
+        at = pq.read_table(str(dst))
+        assert at.num_rows == 8
+
+        dst2 = tmp_path / "e.orc"
+        _export(catalog, "orc", dst2)
+        at2 = po.read_table(str(dst2))
+        assert at2.num_rows == 8
+
+    def test_gml(self, catalog, tmp_path):
+        import xml.etree.ElementTree as ET
+
+        dst = tmp_path / "e.gml"
+        _export(catalog, "gml", dst)
+        root = ET.fromstring(dst.read_text())
+        members = [el for el in root.iter() if el.tag.endswith("featureMember")]
+        assert len(members) == 8
+        poses = [el.text for el in root.iter() if el.tag.endswith("pos")]
+        assert "5 10" in poses
+
+    def test_leaflet(self, catalog, tmp_path):
+        dst = tmp_path / "map.html"
+        _export(catalog, "leaflet", dst)
+        html = dst.read_text()
+        assert "L.map(" in html and '"n5"' in html or "n5" in html
+
+    def test_shp(self, catalog, tmp_path):
+        from geomesa_tpu.convert.shapefile import read_shapefile
+
+        dst = tmp_path / "e.shp"
+        _export(catalog, "shp", dst)
+        t = read_shapefile(str(dst))
+        assert len(t) == 8
+
+
+class TestProjectionInteraction:
+    def test_gml_with_projection(self, catalog, tmp_path):
+        dst = tmp_path / "p.gml"
+        main(["export", "-c", str(catalog), "-n", "evt",
+              "-q", "BBOX(geom, 4.5, 9, 12.5, 11)", "--format", "gml",
+              "-a", "name,geom", "-o", str(dst)])
+        doc = dst.read_text()
+        assert "<geomesa:name>" in doc and "<geomesa:dtg>" not in doc
+
+    def test_avro_projection_narrows_schema(self, catalog, tmp_path):
+        from geomesa_tpu.io.avro import read_avro
+
+        dst = tmp_path / "p.avro"
+        main(["export", "-c", str(catalog), "-n", "evt",
+              "-q", "BBOX(geom, 4.5, 9, 12.5, 11)", "--format", "avro",
+              "-a", "name", "-o", str(dst)])
+        records, fids, writer = read_avro(str(dst))
+        names = {f["name"] for f in writer["fields"]}
+        # projected-out attributes are absent from the schema, not null
+        assert "dtg" not in names and "name" in names
+        assert all(r["name"] is not None for r in records)
+
+    def test_shp_projection_without_geom_clean_error(self, catalog, tmp_path):
+        with pytest.raises(SystemExit, match="geometry"):
+            main(["export", "-c", str(catalog), "-n", "evt",
+                  "--format", "shp", "-a", "name",
+                  "-o", str(tmp_path / "p.shp")])
+
+    def test_shp_requires_shp_suffix_and_keeps_existing(self, catalog, tmp_path):
+        dst = tmp_path / "out.dat"
+        dst.write_bytes(b"precious")
+        with pytest.raises(SystemExit, match="OUTPUT.shp"):
+            main(["export", "-c", str(catalog), "-n", "evt",
+                  "--format", "shp", "-o", str(dst)])
+        assert dst.read_bytes() == b"precious"  # not truncated
+
+
+class TestGmlGeometryKinds:
+    def test_line_polygon_multi(self):
+        sft = parse_spec("g", "name:String,*geom:Geometry")
+        recs = [
+            {"name": "ln", "geom": LineString([[0, 0], [1, 1], [2, 0]])},
+            {"name": "pg", "geom": Polygon([[0, 0], [4, 0], [4, 4], [0, 4]])},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["ln", "pg"])
+        doc = to_gml(t).decode()
+        assert "<gml:LineString>" in doc
+        assert "<gml:Polygon>" in doc and "exterior" in doc
+        assert "&" not in doc.replace("&amp;", "").replace("&lt;", "").replace("&gt;", "").replace("&quot;", "").replace("&apos;", "")
+
+    def test_escaping(self):
+        sft = parse_spec("g", "name:String,*geom:Point")
+        t = FeatureTable.from_records(
+            sft, [{"name": "a<b>&c", "geom": Point(1.0, 2.0)}], ["f<&>1"]
+        )
+        doc = to_gml(t).decode()
+        assert "a&lt;b&gt;&amp;c" in doc
+        assert 'gml:id="f&lt;&amp;&gt;1"' in doc
