@@ -160,6 +160,28 @@ def _query_of(args):
 
 def cmd_export(args):
     ds = _load(args)
+    if args.parallel is not None:
+        # distributed export (ExportJob role): N part files + manifest
+        from geomesa_tpu.convert.parallel_export import FORMATS, parallel_export
+
+        if args.parallel < 1:
+            raise SystemExit("--parallel requires N >= 1 workers")
+        if args.format not in FORMATS:
+            raise SystemExit(
+                f"--parallel supports formats: {', '.join(FORMATS)}"
+            )
+        if args.output is None:
+            raise SystemExit("--parallel requires -o OUTPUT_DIR")
+        if Path(args.output).is_file():
+            raise SystemExit(f"-o {args.output!r} is an existing file; "
+                             "--parallel writes a directory")
+        m = parallel_export(
+            ds, args.name, _query_of(args), args.output,
+            fmt=args.format, workers=args.parallel,
+        )
+        print(f"exported {m['rows']} features in {len(m['parts'])} parts",
+              file=sys.stderr)
+        return
     r = ds.query(args.name, _query_of(args))
     if args.format in ("shp", "leaflet") and r.table.sft.geom_field is None:
         raise SystemExit(f"{args.format} export requires the geometry column "
@@ -386,6 +408,10 @@ def main(argv=None):
     sp.add_argument("--hints", default=None, help="query hints as JSON")
     sp.add_argument("--bin-track", default=None)
     sp.add_argument("-o", "--output", default=None)
+    sp.add_argument(
+        "--parallel", type=int, default=None, metavar="N",
+        help="write N-worker partitioned output to -o DIR (ExportJob role)",
+    )
     sp.set_defaults(fn=cmd_export)
 
     sp = sub.add_parser("explain")
