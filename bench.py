@@ -906,9 +906,11 @@ def _probe_backend(max_tries: int = 4) -> tuple[str, int, list[str]]:
     return "cpu-fallback", n_dev, notes
 
 
-def _run_config(cfg: str, retries: int = 1) -> dict:
+def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> dict:
     """One config in a subprocess → its JSON dict (or an error record).
-    Isolation means one crashing/hanging config cannot zero the round."""
+    Isolation means one crashing/hanging config cannot zero the round;
+    ``deadline`` (monotonic) caps the subprocess timeout so the WHOLE run
+    always finishes inside the driver's patience and emits its JSON line."""
     import subprocess
     import sys
 
@@ -917,14 +919,25 @@ def _run_config(cfg: str, retries: int = 1) -> dict:
     env["GEOMESA_BENCH_CHILD"] = "1"
     last_err = "unknown"
     for attempt in range(retries + 1):
+        cap = _TIMEOUTS.get(cfg, 1200)
+        if deadline is not None:
+            remaining = deadline - time.monotonic() - 30  # JSON-assembly margin
+            if remaining < 60:
+                err = (
+                    "wall-clock budget exhausted before start" if attempt == 0
+                    else f"budget exhausted during retries; last: {last_err}"
+                )
+                return {"metric": f"config_{cfg}", "value": None,
+                        "unit": "skipped", "vs_baseline": None, "error": err}
+            cap = min(cap, remaining)
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, timeout=_TIMEOUTS.get(cfg, 1200),
+                capture_output=True, text=True, timeout=cap,
                 env=env,
             )
         except subprocess.TimeoutExpired:
-            last_err = f"timeout after {_TIMEOUTS.get(cfg, 1200)}s"
+            last_err = f"timeout after {int(cap)}s"
             continue
         # last stdout line that parses as a JSON object is the result
         for line in reversed(out.stdout.strip().splitlines()):
@@ -962,7 +975,16 @@ def main():
         return
 
     # driver mode: probe backend (retry/backoff), then run every config in
-    # an isolated subprocess; one JSON line out no matter what fails
+    # an isolated subprocess; one JSON line out no matter what fails.
+    # A global wall-clock budget (GEOMESA_BENCH_BUDGET_S, default 90 min)
+    # bounds the whole run: per-config timeouts shrink to the remaining
+    # budget and configs that can't start are reported as skipped, so the
+    # driver ALWAYS gets the JSON line instead of killing a silent process.
+    budget_s = float(os.environ.get("GEOMESA_BENCH_BUDGET_S", 5400))
+    deadline = time.monotonic() + budget_s
+    # driver runs value a complete sweep over per-config precision: fewer
+    # timing iterations keep all 7 configs inside the budget
+    os.environ.setdefault("GEOMESA_BENCH_ITERS", "12")
     backend, n_devices, notes = _probe_backend()
     if backend == "cpu-fallback" and not os.environ.get("GEOMESA_BENCH_N"):
         # still land numbers, at CPU-feasible scale (flagged via `backend`)
@@ -970,8 +992,12 @@ def main():
         os.environ.setdefault("GEOMESA_BENCH_K", "500")
         notes.append("cpu-fallback: scaled N to 2M, K to 500")
     configs: dict[str, dict] = {}
-    for cfg in sorted(BENCHES):
-        configs[cfg] = _run_config(cfg)
+    # cheap/headline configs first so a tight budget still lands them; any
+    # config missing from the order list still runs (appended, sorted)
+    order = _HEADLINE_ORDER + sorted(set(BENCHES) - set(_HEADLINE_ORDER))
+    for cfg in order:
+        configs[cfg] = _run_config(cfg, deadline=deadline)
+    configs = {k: configs[k] for k in sorted(configs)}
     headline = None
     for cfg in _HEADLINE_ORDER:
         r = configs.get(cfg)

@@ -1,0 +1,16 @@
+"""Un-force the CPU mesh for the opt-in device suite.
+
+The parent ``tests/conftest.py`` pins ``jax_platforms=cpu`` for the default
+suite; when the device suite is explicitly requested, restore automatic
+backend selection BEFORE any test module initializes jax, or the compiled
+path could never run. Run this suite standalone (``pytest tests/tpu/``) —
+mixing it into a full-suite run would flip the backend for every test.
+"""
+
+import os
+
+if os.environ.get("GEOMESA_TPU_DEVICE_TESTS") == "1":
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", None)  # automatic: real backend first
