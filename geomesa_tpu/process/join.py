@@ -17,22 +17,37 @@ from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
 
 
-def join_within(ds, type_name: str, polygons, filter=None):
-    """Exact join: returns list of (polygon_index, row fids ndarray)."""
+def join_scan(ds, type_name: str, geoms, pred: str = "within", filter=None):
+    """Per-geometry index-planned scans: yields (geom_index, result table).
+
+    The shared core of the exact join paths (JoinProcess and the SQL
+    engine's spatial JOIN): each right-side geometry becomes ONE planned
+    query of the left store — Z/XZ ranges + residual — never a cartesian
+    pass. ``pred`` is the predicate applied to the LEFT geometry column
+    (within/contains/intersects); ``None`` geometries yield empty results.
+    """
     sft = ds.get_schema(type_name)
     base = None
     if filter is not None:
         from geomesa_tpu.filter.cql import parse
 
         base = parse(filter) if isinstance(filter, str) else filter
-    out = []
-    for i, poly in enumerate(polygons):
-        f = ast.SpatialOp("within", sft.geom_field, poly)
+    for i, g in enumerate(geoms):
+        if g is None:
+            yield i, None
+            continue
+        f = ast.SpatialOp(pred, sft.geom_field, g)
         if base is not None:
             f = ast.And([f, base])
-        r = ds.query(type_name, Query(filter=f))
-        out.append((i, r.table.fids))
-    return out
+        yield i, ds.query(type_name, Query(filter=f)).table
+
+
+def join_within(ds, type_name: str, polygons, filter=None):
+    """Exact join: returns list of (polygon_index, row fids ndarray)."""
+    return [
+        (i, t.fids if t is not None else np.empty(0, dtype=object))
+        for i, t in join_scan(ds, type_name, polygons, "within", filter)
+    ]
 
 
 def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):

@@ -13,6 +13,10 @@ Supported grammar:
       [WHERE <predicates>] [GROUP BY <col, ...>]
       [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
 
+    SELECT <alias.col|alias.*, ...> FROM <t1> <a> JOIN <t2> <b>
+      ON ST_Within|ST_Contains|ST_Intersects(<alias.geom>, <alias.geom>)
+      [WHERE <left-alias predicates>] [LIMIT <n>]
+
     item      := * | col | agg | fn(col) [AS alias]
     agg       := COUNT(*) | COUNT(col) | SUM/MIN/MAX/AVG(col)
     fn        := ST_X | ST_Y | ST_AsText | ST_GeoHash  (per-row scalar UDFs)
@@ -239,8 +243,144 @@ def _agg_value(fn: str, arg: str, table, idx: np.ndarray):
     raise SqlError(f"unknown aggregate {fn!r}")
 
 
+_JOIN = re.compile(
+    r"^\s*select\s+(?P<select>.+?)\s+"
+    r"from\s+(?P<t1>\w+)\s+(?P<a1>\w+)\s+"
+    r"join\s+(?P<t2>\w+)\s+(?P<a2>\w+)\s+"
+    r"on\s+(?P<pred>st_within|st_contains|st_intersects)\s*\(\s*"
+    r"(?P<xa>\w+)\.(?P<xc>\w+)\s*,\s*(?P<ya>\w+)\.(?P<yc>\w+)\s*\)"
+    r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+# predicate seen from the LEFT row when the args arrive (right, left)
+_FLIP = {"within": "contains", "contains": "within", "intersects": "intersects"}
+
+
+def _map_unquoted(s: str, fn) -> str:
+    """Apply ``fn`` to the non-string-literal segments of a CQL/SQL text
+    (single-quoted literals pass through untouched)."""
+    out, cur, q = [], [], False
+    for ch in s:
+        if ch == "'":
+            seg = "".join(cur)
+            out.append(seg if q else fn(seg))
+            out.append(ch)
+            cur = []
+            q = not q
+        else:
+            cur.append(ch)
+    seg = "".join(cur)
+    out.append(seg if q else fn(seg))
+    return "".join(out)
+
+
+def _sql_join(ds, m) -> SqlResult:
+    """Spatial JOIN: each right-table geometry becomes an index-planned scan
+    of the left table (delegating to :func:`geomesa_tpu.process.join
+    .join_scan` — the JoinProcess core, never a cartesian pass), pairs
+    streamed into alias-qualified columns. Right side should be the smaller
+    relation (polygon sets)."""
+    t1, a1, t2, a2 = m.group("t1"), m.group("a1"), m.group("t2"), m.group("a2")
+    if a1 == a2:
+        raise SqlError(f"duplicate join alias {a1!r}")
+    pred = m.group("pred").lower().removeprefix("st_")
+    xa, xc, ya, yc = m.group("xa"), m.group("xc"), m.group("ya"), m.group("yc")
+    if {xa, ya} != {a1, a2}:
+        raise SqlError("ON predicate must reference both join aliases")
+    # normalize to pred(left.geom, right.geom)
+    if xa == a1:
+        left_col, right_col, left_pred = xc, yc, pred
+    else:
+        left_col, right_col, left_pred = yc, xc, _FLIP[pred]
+    sft1 = ds.get_schema(t1)
+    sft2 = ds.get_schema(t2)
+    if left_col != sft1.geom_field:
+        raise SqlError(f"{a1}.{left_col} is not {t1}'s geometry column")
+    if right_col != sft2.geom_field:
+        raise SqlError(f"{a2}.{right_col} is not {t2}'s geometry column")
+
+    # WHERE pushes to the LEFT scan (strip the alias); right-side or mixed
+    # predicates are not supported in v1 of the join grammar. Alias checks
+    # and rewrites apply outside string literals only.
+    base_cql = None
+    if m.group("where"):
+        w = m.group("where")
+        found_right = False
+
+        def _check(seg):
+            nonlocal found_right
+            if re.search(rf"\b{a2}\s*\.", seg):
+                found_right = True
+            return seg
+
+        _map_unquoted(w, _check)
+        if found_right:
+            raise SqlError("JOIN WHERE may reference only the left alias")
+        base_cql = _rewrite_where(
+            _map_unquoted(w, lambda seg: re.sub(rf"\b{a1}\s*\.", "", seg))
+        )
+
+    # select items: alias.col or alias.* (duplicates collapse, order kept)
+    items: list[tuple[str, str]] = []
+    for raw in _split_top(m.group("select")):
+        im = re.match(r"^(\w+)\.(\w+|\*)$", raw.strip())
+        if not im:
+            raise SqlError(f"join select items must be alias.col: {raw!r}")
+        items.append((im.group(1), im.group(2)))
+    expanded: list[tuple[str, str]] = []
+    for alias, col in items:
+        if alias not in (a1, a2):
+            raise SqlError(f"unknown alias {alias!r}")
+        sft = sft1 if alias == a1 else sft2
+        if col == "*":
+            expanded.extend((alias, attr.name) for attr in sft.attributes)
+        elif col not in {attr.name for attr in sft.attributes}:
+            raise SqlError(f"unknown column {alias}.{col}")
+        else:
+            expanded.append((alias, col))
+    expanded = list(dict.fromkeys(expanded))
+
+    limit = int(m.group("limit")) if m.group("limit") else None
+    right = ds.query(t2, None).table
+    rgeoms = right.geom_column().geometries()
+
+    from geomesa_tpu.process.join import join_scan
+
+    out: dict[str, list] = {f"{alias}.{col}": [] for alias, col in expanded}
+    total = 0
+    for j, lt in join_scan(ds, t1, rgeoms, left_pred, base_cql):
+        n = 0 if lt is None else len(lt)
+        if n == 0:
+            continue
+        if limit is not None:
+            n = min(n, limit - total)
+        for alias, col in expanded:
+            key = f"{alias}.{col}"
+            if alias == a1:
+                c = lt.columns[col]
+                vals = c.geometries() if c.type.is_geometry else c.values
+                out[key].extend(vals[:n])
+            else:
+                c = right.columns[col]
+                v = (
+                    c.geometries()[j] if c.type.is_geometry else c.values[j]
+                )
+                out[key].extend([v] * n)
+        total += n
+        if limit is not None and total >= limit:
+            break
+    return SqlResult(
+        {k: np.asarray(v, dtype=object) for k, v in out.items()}
+    )
+
+
 def sql(ds, statement: str) -> SqlResult:
     """Execute a SQL statement against ``ds`` (DataStore or merged view)."""
+    jm = _JOIN.match(statement)
+    if jm:
+        return _sql_join(ds, jm)
     m = _CLAUSES.match(statement)
     if not m:
         raise SqlError(f"cannot parse: {statement!r}")

@@ -124,3 +124,108 @@ class TestErrors:
     def test_unknown_function(self, ds):
         with pytest.raises(SqlError, match="unsupported function"):
             sql(ds, "SELECT frob(name) FROM ev")
+
+
+class TestSpatialJoin:
+    @pytest.fixture(scope="class")
+    def join_ds(self):
+        from geomesa_tpu.geometry.types import Polygon
+
+        rng = np.random.default_rng(3)
+        n = 1500
+        store = DataStore(backend="tpu")
+        store.create_schema("pts", "name:String,val:Double,*geom:Point")
+        lon = rng.uniform(-50, 50, n)
+        lat = rng.uniform(-50, 50, n)
+        recs = [
+            {"name": f"p{i}", "val": float(i % 10), "geom": Point(float(lon[i]), float(lat[i]))}
+            for i in range(n)
+        ]
+        store.write("pts", recs, fids=[f"p{i}" for i in range(n)])
+        store.create_schema("zones", "zone:String,*geom:Polygon")
+        zones = []
+        for k, (cx, cy) in enumerate([(-20, -20), (0, 0), (25, 25)]):
+            ring = [[cx - 8, cy - 8], [cx + 8, cy - 8], [cx + 8, cy + 8], [cx - 8, cy + 8]]
+            zones.append({"zone": f"z{k}", "geom": Polygon(ring)})
+        store.write("zones", zones, fids=[f"z{k}" for k in range(3)])
+        store._pts = (lon, lat)
+        return store
+
+    def _truth(self, join_ds, zone_boxes):
+        lon, lat = join_ds._pts
+        out = {}
+        for z, (x1, y1, x2, y2) in zone_boxes.items():
+            out[z] = set(
+                np.nonzero((lon > x1) & (lon < x2) & (lat > y1) & (lat < y2))[0]
+            )
+        return out
+
+    ZONES = {"z0": (-28, -28, -12, -12), "z1": (-8, -8, 8, 8), "z2": (17, 17, 33, 33)}
+
+    def test_join_within(self, join_ds):
+        r = sql(
+            join_ds,
+            "SELECT a.name, b.zone FROM pts a JOIN zones b "
+            "ON ST_Within(a.geom, b.geom)",
+        )
+        truth = self._truth(join_ds, self.ZONES)
+        want = sum(len(v) for v in truth.values())
+        assert len(r) == want
+        # spot-check pairing: every returned (name, zone) is a true pair
+        names = r.columns["a.name"]
+        zones = r.columns["b.zone"]
+        for nm, z in zip(names, zones):
+            i = int(nm[1:])
+            assert i in truth[z], (nm, z)
+
+    def test_join_flipped_args(self, join_ds):
+        r1 = sql(join_ds, "SELECT a.name, b.zone FROM pts a JOIN zones b "
+                          "ON ST_Within(a.geom, b.geom)")
+        r2 = sql(join_ds, "SELECT a.name, b.zone FROM pts a JOIN zones b "
+                          "ON ST_Contains(b.geom, a.geom)")
+        assert sorted(zip(r1.columns["a.name"], r1.columns["b.zone"])) == \
+               sorted(zip(r2.columns["a.name"], r2.columns["b.zone"]))
+
+    def test_join_where_pushdown_and_limit(self, join_ds):
+        r = sql(
+            join_ds,
+            "SELECT a.name, a.val, b.zone FROM pts a JOIN zones b "
+            "ON ST_Within(a.geom, b.geom) WHERE a.val > 5 LIMIT 7",
+        )
+        assert len(r) <= 7
+        assert all(float(v) > 5 for v in r.columns["a.val"])
+
+    def test_join_star(self, join_ds):
+        r = sql(join_ds, "SELECT b.*, a.name FROM pts a JOIN zones b "
+                         "ON ST_Intersects(a.geom, b.geom) LIMIT 3")
+        assert set(r.columns) == {"b.zone", "b.geom", "a.name"}
+
+    def test_join_duplicate_items_collapse(self, join_ds):
+        r = sql(join_ds, "SELECT a.name, a.name, b.zone FROM pts a "
+                         "JOIN zones b ON ST_Within(a.geom, b.geom) LIMIT 5")
+        lens = {k: len(v) for k, v in r.columns.items()}
+        assert len(set(lens.values())) == 1  # all columns aligned
+        r.rows()  # must not raise
+
+    def test_join_on_non_geometry_right_col(self, join_ds):
+        with pytest.raises(SqlError, match="geometry column"):
+            sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.zone)")
+
+    def test_join_where_literal_with_alias_text(self, join_ds):
+        # a literal containing "b." must not be mistaken for a right-alias
+        # reference, and the left-alias strip must not rewrite literals
+        r = sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom) WHERE a.name = 'b.x'")
+        assert len(r) == 0  # no point is named 'b.x' — but it parses
+
+    def test_join_errors(self, join_ds):
+        with pytest.raises(SqlError, match="left alias"):
+            sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom) WHERE b.zone = 'z0'")
+        with pytest.raises(SqlError, match="alias.col"):
+            sql(join_ds, "SELECT name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom)")
+        with pytest.raises(SqlError, match="geometry column"):
+            sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.name, b.geom)")
