@@ -97,7 +97,16 @@ def map_html(*layers, height: int = 500) -> str:
 
             fc = table_to_feature_collection(layer)
         specs.append({"kind": "geojson", "data": fc, "style": style})
-    return _PAGE.format(height=height, layers=json.dumps(specs))
+    # escape script-context breakers: feature properties/fids are user data,
+    # and '</script>' inside json.dumps would terminate the <script> block
+    # (stored XSS when served over HTTP). < is valid JSON for '<'.
+    payload = (
+        json.dumps(specs)
+        .replace("<", "\\u003c")
+        .replace(">", "\\u003e")
+        .replace("&", "\\u0026")
+    )
+    return _PAGE.format(height=height, layers=payload)
 
 
 def show(*layers, height: int = 500):

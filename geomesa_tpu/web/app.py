@@ -11,7 +11,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}                   spec + row count
     DELETE /api/schemas/{name}
     POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
-    GET    /api/schemas/{name}/query?cql=&limit=&format=geojson|arrow|bin
+    GET    /api/schemas/{name}/query?cql=&limit=&format=geojson|arrow|bin|avro|gml|leaflet
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
     GET    /api/schemas/{name}/stats/bounds?attr=
@@ -226,6 +226,22 @@ class GeoMesaApp:
             from geomesa_tpu.store.reduce import bin_encode
 
             return 200, bin_encode(r.table, {}), "application/octet-stream"
+        if fmt == "avro":
+            import io as _io
+
+            from geomesa_tpu.io.avro import write_avro
+
+            buf = _io.BytesIO()
+            write_avro(r.table, buf)
+            return 200, buf.getvalue(), "application/avro"
+        if fmt == "gml":
+            from geomesa_tpu.io.gml import to_gml
+
+            return 200, to_gml(r.table), "application/gml+xml"
+        if fmt == "leaflet":
+            from geomesa_tpu.jupyter import map_html
+
+            return 200, map_html(r.table).encode("utf-8"), "text/html"
         raise _HttpError(400, f"unknown format {fmt!r}")
 
     def _count_many(self, name, params, body):

@@ -110,6 +110,25 @@ class TestQueryAndStats:
         at = pa.ipc.open_stream(data).read_all()
         assert at.num_rows == 50
 
+    def test_avro_gml_leaflet_query(self, app):
+        import io as _io
+
+        from geomesa_tpu.io.avro import read_avro
+
+        _ingest(app)
+        status, headers, data = call(app, "GET", "/api/schemas/pts/query", "format=avro")
+        assert status == 200 and headers["Content-Type"] == "application/avro"
+        records, fids, _ = read_avro(_io.BytesIO(data))
+        assert len(records) == 50
+
+        status, headers, data = call(app, "GET", "/api/schemas/pts/query", "format=gml")
+        assert status == 200 and headers["Content-Type"] == "application/gml+xml"
+        assert data.count(b"<gml:featureMember>") == 50
+
+        status, headers, data = call(app, "GET", "/api/schemas/pts/query", "format=leaflet")
+        assert status == 200 and headers["Content-Type"].startswith("text/html")
+        assert b"L.map(" in data
+
     def test_stats_endpoints(self, app):
         _ingest(app)
         status, out = jcall(app, "GET", "/api/schemas/pts/stats", "stats=Count()")
@@ -151,3 +170,24 @@ class TestQueryAndStats:
         _ingest(app)
         status, out = jcall(app, "GET", "/api/schemas/pts/query", "cql=NOT%20VALID(")
         assert status == 400
+
+    def test_leaflet_script_injection_escaped(self, app):
+        # a hostile property value must not break out of the <script> block
+        status, _ = jcall(app, "POST", "/api/schemas", body={
+            "name": "evil", "spec": "name:String,*geom:Point"})
+        assert status == 201
+        status, _ = jcall(app, "POST", "/api/schemas/evil/features", body={
+            "type": "FeatureCollection",
+            "features": [{
+                "type": "Feature", "id": "e1",
+                "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+                "properties": {
+                    "name": "</script><script>alert(1)</script>"},
+            }],
+        })
+        assert status == 201
+        status, headers, data = call(
+            app, "GET", "/api/schemas/evil/query", "format=leaflet")
+        assert status == 200
+        assert b"</script><script>alert" not in data
+        assert b"\\u003c/script\\u003e" in data
