@@ -272,7 +272,7 @@ def _eval(expr: str, df, typ: AttributeType, conv) -> tuple[Column, np.ndarray]:
         (col_arg,) = _split_args(m.group(2))
         raw = _raw(col_arg, df, conv)
         nums = pd.to_numeric(pd.Series(raw), errors="coerce")
-        empty = np.array([s == "" for s in raw])
+        empty = np.array([s == "" for s in raw], dtype=bool)
         nan = nums.isna().to_numpy()
         return (
             Column(AttributeType.DATE, nums.fillna(0).to_numpy(np.int64),
@@ -324,7 +324,7 @@ def _numeric_column(raw: np.ndarray, typ: AttributeType) -> tuple[Column, np.nda
     unparseable cells mark the record bad (the reference converter ingests
     rows with empty optional fields as null attributes)."""
     nums = pd.to_numeric(pd.Series(raw), errors="coerce")
-    empty = np.array([s == "" for s in raw])
+    empty = np.array([s == "" for s in raw], dtype=bool)
     nan = nums.isna().to_numpy()
     valid = ~nan
     col = Column(
@@ -336,7 +336,7 @@ def _numeric_column(raw: np.ndarray, typ: AttributeType) -> tuple[Column, np.nda
 def _date_column(raw: np.ndarray, parsed) -> tuple[Column, np.ndarray]:
     """Date parse with the same empty→null / garbage→bad split."""
     nan = parsed.isna().to_numpy()
-    empty = np.array([s == "" for s in raw])
+    empty = np.array([s == "" for s in raw], dtype=bool)
     vals = np.where(nan, 0, parsed.values.astype("datetime64[ms]").astype(np.int64))
     valid = ~nan
     col = Column(AttributeType.DATE, vals.astype(np.int64), None if valid.all() else valid)

@@ -1,0 +1,106 @@
+"""JDBC-role converter: sqlite → FeatureTable → queryable store."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.delimited import EvaluationContext
+from geomesa_tpu.convert.jdbc import JdbcConverter, ingest_jdbc
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+
+@pytest.fixture()
+def db(tmp_path):
+    path = tmp_path / "events.db"
+    conn = sqlite3.connect(str(path))
+    conn.execute(
+        "CREATE TABLE ev (id TEXT, label TEXT, lon REAL, lat REAL, ts TEXT)"
+    )
+    rows = [
+        (f"e{i}", f"L{i % 3}", -50.0 + i, 10.0 + (i % 5),
+         f"2021-03-0{1 + i % 9}T00:00:00Z")
+        for i in range(30)
+    ]
+    conn.executemany("INSERT INTO ev VALUES (?,?,?,?,?)", rows)
+    # a bad row: NULL coordinates
+    conn.execute("INSERT INTO ev VALUES ('bad', 'L9', NULL, NULL, NULL)")
+    conn.commit()
+    yield conn, str(path)
+    conn.close()
+
+
+SFT = "label:String,dtg:Date,*geom:Point;geomesa.z3.interval='month'"
+
+
+class TestJdbcConverter:
+    def test_convert_by_column_name(self, db):
+        conn, _ = db
+        sft = parse_spec("ev", SFT)
+        conv = JdbcConverter(
+            sft,
+            "SELECT id, label, lon, lat, ts FROM ev",
+            fields={"label": "label", "dtg": "isodate(ts)",
+                    "geom": "point(lon, lat)"},
+            id_field="id",
+        )
+        ctx = EvaluationContext()
+        t = conv.convert_connection(conn, ctx=ctx)
+        assert len(t) == 30  # NULL-coord row skipped
+        assert ctx.failure == 1
+        assert list(t.fids[:2]) == ["e0", "e1"]
+        assert t.columns["label"].values[0] == "L0"
+        g = t.geom_column()
+        np.testing.assert_allclose(g.x[:3], [-50, -49, -48])
+
+    def test_positional_refs_and_params(self, db):
+        conn, _ = db
+        sft = parse_spec("ev", SFT)
+        conv = JdbcConverter(
+            sft,
+            "SELECT id, label, lon, lat, ts FROM ev WHERE label = ?",
+            fields={"label": "$2", "dtg": "isodate($5)",
+                    "geom": "point($3, $4)"},
+            id_field="$1",
+        )
+        t = conv.convert_connection(conn, params=("L1",))
+        assert len(t) == 10
+        assert set(t.columns["label"].values) == {"L1"}
+
+    def test_convert_sqlite_path(self, db):
+        _, path = db
+        sft = parse_spec("ev", SFT)
+        conv = JdbcConverter(
+            sft, "SELECT id, label, lon, lat, ts FROM ev",
+            fields={"label": "label", "dtg": "isodate(ts)",
+                    "geom": "point(lon, lat)"},
+        )
+        t = conv.convert_sqlite(path)
+        assert len(t) == 30
+
+    def test_ingest_and_query(self, db):
+        conn, _ = db
+        ds = DataStore()
+        ds.create_schema(parse_spec("ev", SFT))
+        n = ingest_jdbc(
+            ds, "ev", conn, "SELECT id, label, lon, lat, ts FROM ev",
+            fields={"label": "label", "dtg": "isodate(ts)",
+                    "geom": "point(lon, lat)"},
+            id_field="id",
+        )
+        assert n == 30
+        r = ds.query("ev", "BBOX(geom, -50.5, 9, -45.5, 16) AND label = 'L0'")
+        got = {str(f) for f in r.table.fids}
+        assert got == {"e0", "e3"}
+
+    def test_empty_result(self, db):
+        conn, _ = db
+        sft = parse_spec("ev", SFT)
+        conv = JdbcConverter(
+            sft, "SELECT id, label, lon, lat, ts FROM ev WHERE label = 'zz'",
+            fields={"label": "label", "dtg": "isodate(ts)",
+                    "geom": "point(lon, lat)"},
+        )
+        t = conv.convert_connection(conn)
+        assert len(t) == 0
