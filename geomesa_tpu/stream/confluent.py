@@ -1,0 +1,143 @@
+"""Schema-registry Avro stream messages (the Confluent interop role).
+
+Role parity: ``geomesa-kafka-confluent`` (SURVEY.md §2.10) — stream change
+messages whose feature payloads are Avro records tagged with a registry
+schema id, so independently-evolving producers and consumers interoperate:
+the consumer resolves the producer's WRITER schema (looked up by id) onto
+its own reader schema using the evolution rules in
+:mod:`geomesa_tpu.io.avro` (field reorder / add-with-null / drop).
+
+Wire format (Confluent-compatible framing for the payload):
+
+    [0x00 magic][4B big-endian schema id][1B kind][8B ts]
+    put:    [avro feature record (writer schema; carries __fid__)]
+    delete: [fid]
+    clear:  (nothing further)
+
+The in-process :class:`SchemaRegistry` plays the registry service: ids are
+stable per schema JSON, shared by every serializer bound to it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import threading
+
+from geomesa_tpu.geometry.wkb import from_wkb, to_wkb
+from geomesa_tpu.io.avro import _decode_record, _decode_resolved, _encode_record, avro_schema
+from geomesa_tpu.schema.sft import FeatureType
+from geomesa_tpu.stream.messages import (
+    _K_CLEAR,
+    _K_DELETE,
+    _K_PUT,
+    Clear,
+    Delete,
+    Put,
+    _Cursor,
+    _pack_str,
+)
+
+__all__ = ["SchemaRegistry", "AvroGeoMessageSerializer"]
+
+_MAGIC = 0
+
+
+class SchemaRegistry:
+    """In-process schema registry: canonical-JSON schema ↔ int id.
+
+    The service role of Confluent's registry — ``register`` is idempotent
+    (same schema → same id), ids are dense from 1.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_id: dict[int, dict] = {}
+        self._ids: dict[str, int] = {}
+        self._subjects: dict[str, list[int]] = {}
+
+    def register(self, subject: str, schema: dict) -> int:
+        key = json.dumps(schema, sort_keys=True)
+        with self._lock:
+            sid = self._ids.get(key)
+            if sid is None:
+                sid = len(self._by_id) + 1
+                self._ids[key] = sid
+                self._by_id[sid] = schema
+            versions = self._subjects.setdefault(subject, [])
+            if sid not in versions:
+                versions.append(sid)
+            return sid
+
+    def schema_by_id(self, sid: int) -> dict:
+        schema = self._by_id.get(sid)
+        if schema is None:
+            raise KeyError(f"unknown schema id {sid}")
+        return schema
+
+    def versions(self, subject: str) -> list[int]:
+        """Registered schema ids for a subject, oldest first."""
+        return list(self._subjects.get(subject, []))
+
+
+class AvroGeoMessageSerializer:
+    """Schema-registry-backed message codec for one feature type.
+
+    Drop-in for :class:`~geomesa_tpu.stream.messages.GeoMessageSerializer`
+    (same serialize/deserialize surface), but puts ride as Avro records
+    resolved across schema versions on read.
+    """
+
+    def __init__(self, sft: FeatureType, registry: SchemaRegistry):
+        self.sft = sft
+        self.registry = registry
+        self.schema = avro_schema(sft)
+        self.schema_id = registry.register(sft.name, self.schema)
+        self._geom_fields = {
+            a.name for a in sft.attributes if a.type.is_geometry
+        }
+
+    # -- write ----------------------------------------------------------------
+    def serialize(self, msg: Put | Delete | Clear) -> bytes:
+        head = struct.pack(">BI", _MAGIC, self.schema_id)
+        if isinstance(msg, Clear):
+            return head + struct.pack("<Bq", _K_CLEAR, msg.ts)
+        if isinstance(msg, Delete):
+            return head + struct.pack("<Bq", _K_DELETE, msg.ts) + _pack_str(msg.fid)
+        body = io.BytesIO()
+        rec = dict(msg.record)
+        rec["__fid__"] = msg.fid  # fid rides inside the record (no prefix)
+        for g in self._geom_fields:
+            if rec.get(g) is not None:
+                rec[g] = to_wkb(rec[g])
+        _encode_record(body, self.schema, rec)
+        return head + struct.pack("<Bq", _K_PUT, msg.ts) + body.getvalue()
+
+    # -- read -----------------------------------------------------------------
+    def deserialize(self, data: bytes) -> Put | Delete | Clear:
+        magic, sid = struct.unpack_from(">BI", data, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"bad magic byte {magic}")
+        c = _Cursor(data)
+        c.pos = 5
+        kind, ts = c.unpack("<Bq")
+        if kind == _K_CLEAR:
+            return Clear(ts)
+        if kind == _K_DELETE:
+            return Delete(c.unpack_str(), ts)
+        writer = (
+            self.schema
+            if sid == self.schema_id
+            else self.registry.schema_by_id(sid)
+        )
+        buf = io.BytesIO(data[c.pos :])
+        if writer is self.schema:
+            rec = _decode_record(buf, self.schema)
+        else:  # cross-version producer: resolve writer → our reader schema
+            rec = _decode_resolved(buf, writer, self.schema)
+        fid = str(rec.pop("__fid__", ""))
+        for g in self._geom_fields:
+            if isinstance(rec.get(g), (bytes, bytearray)):
+                rec[g] = from_wkb(rec[g])
+        return Put(fid, rec, ts)

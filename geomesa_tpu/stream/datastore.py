@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable
+from typing import Any, Callable
 
 import numpy as np
 
@@ -116,18 +116,37 @@ class StreamingDataStore:
         self.expiry_ms = expiry_ms
         self.async_consumers = async_consumers
         self._types: dict[str, FeatureType] = {}
-        self._serializers: dict[str, GeoMessageSerializer] = {}
+        # any serialize/deserialize codec (GeoMessageSerializer or the
+        # schema-registry Avro codec from stream/confluent.py)
+        self._serializers: dict[str, Any] = {}
         self._caches: dict[str, FeatureCache] = {}
         self._consumers: dict[str, object] = {}
 
     # -- schema --------------------------------------------------------------
-    def create_schema(self, sft: FeatureType | str, spec: str | None = None) -> FeatureType:
+    def create_schema(
+        self,
+        sft: FeatureType | str,
+        spec: str | None = None,
+        serializer=None,
+    ) -> FeatureType:
+        """``serializer`` overrides the default binary codec — e.g. an
+        :class:`~geomesa_tpu.stream.confluent.AvroGeoMessageSerializer` for
+        schema-registry interop (any object with the same
+        serialize/deserialize surface plugs in)."""
         if isinstance(sft, str):
             sft = parse_spec(sft, spec)
         if sft.name in self._types:
             raise ValueError(f"schema already exists: {sft.name}")
+        bound = getattr(serializer, "sft", sft)
+        if bound is not sft and getattr(bound, "to_spec", lambda: 1)() != sft.to_spec():
+            raise ValueError(
+                f"serializer is bound to schema {getattr(bound, 'name', '?')!r}, "
+                f"not {sft.name!r}"
+            )
         self._types[sft.name] = sft
-        self._serializers[sft.name] = GeoMessageSerializer(sft)
+        self._serializers[sft.name] = (
+            serializer if serializer is not None else GeoMessageSerializer(sft)
+        )
         cache = FeatureCache(sft, expiry_ms=self.expiry_ms)
         self._caches[sft.name] = cache
         ser = self._serializers[sft.name]

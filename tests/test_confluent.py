@@ -1,0 +1,115 @@
+"""Schema-registry Avro stream messages: framing, evolution, stream store."""
+
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.stream.confluent import AvroGeoMessageSerializer, SchemaRegistry
+from geomesa_tpu.stream.messages import Clear, Delete, Put
+
+SPEC_V1 = "name:String,dtg:Date,*geom:Point"
+SPEC_V2 = "name:String,severity:Integer,dtg:Date,*geom:Point"  # adds a field
+
+
+class TestRegistry:
+    def test_idempotent_ids(self):
+        reg = SchemaRegistry()
+        from geomesa_tpu.io.avro import avro_schema
+
+        s1 = avro_schema(parse_spec("e", SPEC_V1))
+        s2 = avro_schema(parse_spec("e", SPEC_V2))
+        assert reg.register("e", s1) == reg.register("e", s1) == 1
+        assert reg.register("e", s2) == 2
+        assert reg.versions("e") == [1, 2]
+        assert reg.schema_by_id(2) == s2
+        with pytest.raises(KeyError):
+            reg.schema_by_id(99)
+
+
+class TestRoundTrip:
+    def test_put_delete_clear(self):
+        reg = SchemaRegistry()
+        ser = AvroGeoMessageSerializer(parse_spec("e", SPEC_V1), reg)
+        put = Put("f1", {"name": "a", "dtg": 1000, "geom": Point(3.0, 4.0)}, 77)
+        out = ser.deserialize(ser.serialize(put))
+        assert out.fid == "f1" and out.ts == 77
+        assert out.record["name"] == "a"
+        assert out.record["geom"].x == 3.0
+        d = ser.deserialize(ser.serialize(Delete("f1", 88)))
+        assert isinstance(d, Delete) and d.fid == "f1"
+        assert isinstance(ser.deserialize(ser.serialize(Clear(99))), Clear)
+
+    def test_null_attribute(self):
+        reg = SchemaRegistry()
+        ser = AvroGeoMessageSerializer(parse_spec("e", SPEC_V1), reg)
+        put = Put("f2", {"name": None, "dtg": 5, "geom": Point(1.0, 2.0)}, 1)
+        out = ser.deserialize(ser.serialize(put))
+        assert out.record["name"] is None
+
+    def test_bad_magic(self):
+        reg = SchemaRegistry()
+        ser = AvroGeoMessageSerializer(parse_spec("e", SPEC_V1), reg)
+        with pytest.raises(ValueError):
+            ser.deserialize(b"\x01\x00\x00\x00\x01rest")
+
+
+class TestEvolution:
+    def test_old_producer_new_consumer(self):
+        # v1 producer writes; v2 consumer (extra 'severity' field) reads:
+        # the missing field resolves to null
+        reg = SchemaRegistry()
+        old = AvroGeoMessageSerializer(parse_spec("e", SPEC_V1), reg)
+        new = AvroGeoMessageSerializer(parse_spec("e", SPEC_V2), reg)
+        wire = old.serialize(
+            Put("f1", {"name": "x", "dtg": 9, "geom": Point(1.0, 1.0)}, 5)
+        )
+        out = new.deserialize(wire)
+        assert out.record["name"] == "x"
+        assert out.record["severity"] is None
+        assert out.record["geom"].y == 1.0
+
+    def test_new_producer_old_consumer(self):
+        # v2 producer writes (with severity); v1 consumer drops the field
+        reg = SchemaRegistry()
+        old = AvroGeoMessageSerializer(parse_spec("e", SPEC_V1), reg)
+        new = AvroGeoMessageSerializer(parse_spec("e", SPEC_V2), reg)
+        wire = new.serialize(
+            Put("f2", {"name": "y", "severity": 3, "dtg": 9,
+                       "geom": Point(2.0, 2.0)}, 5)
+        )
+        out = old.deserialize(wire)
+        assert out.record["name"] == "y"
+        assert "severity" not in out.record
+        assert out.record["geom"].x == 2.0
+
+
+class TestStreamStoreIntegration:
+    def test_bus_roundtrip_with_avro_codec(self):
+        """The stream datastore accepts the drop-in Avro codec."""
+        from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore
+
+        reg = SchemaRegistry()
+        bus = MessageBus()
+        sds = StreamingDataStore(bus=bus)
+        sft = parse_spec("live", SPEC_V1 + ";geomesa.z3.interval='week'")
+        sds.create_schema(sft, serializer=AvroGeoMessageSerializer(sft, reg))
+        sds.put("live", "a", {"name": "a", "dtg": 1_600_000_000_000,
+                              "geom": Point(1.0, 2.0)})
+        sds.put("live", "b", {"name": "b", "dtg": 1_600_000_000_000,
+                              "geom": Point(50.0, 8.0)})
+        r = sds.query("live", "BBOX(geom, 0, 0, 10, 10)")
+        assert set(r.table.fids) == {"a"}
+        sds.delete("live", "a")
+        r = sds.query("live", "BBOX(geom, 0, 0, 10, 10)")
+        assert len(r.table) == 0
+        sds.close()
+
+    def test_mismatched_serializer_rejected(self):
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        reg = SchemaRegistry()
+        other = parse_spec("other", "a:Integer,*geom:Point")
+        sds = StreamingDataStore()
+        sft = parse_spec("live", SPEC_V1)
+        with pytest.raises(ValueError, match="bound to schema"):
+            sds.create_schema(sft, serializer=AvroGeoMessageSerializer(other, reg))
