@@ -78,6 +78,16 @@ def cmd_describe_schema(args):
     print(f"features: {ds.stats_count(args.name)}")
 
 
+def cmd_update_schema(args):
+    ds = _load(args)
+    kw = args.keywords.split(",") if args.keywords else None
+    sft = ds.update_schema(
+        args.name, add=args.add or None, keywords=kw, rename_to=args.rename_to
+    )
+    _save(ds, args)
+    print(f"updated schema {sft.name!r}: {sft.to_spec()}")
+
+
 def cmd_delete_schema(args):
     ds = _load(args)
     ds.delete_schema(args.name)
@@ -376,6 +386,14 @@ def main(argv=None):
     sp = sub.add_parser("describe-schema")
     common(sp)
     sp.set_defaults(fn=cmd_describe_schema)
+
+    sp = sub.add_parser("update-schema")
+    common(sp)
+    sp.add_argument("--add", action="append",
+                    help="attribute spec to append, e.g. severity:Integer")
+    sp.add_argument("--keywords", default=None, help="comma-separated keywords")
+    sp.add_argument("--rename-to", default=None)
+    sp.set_defaults(fn=cmd_update_schema)
 
     sp = sub.add_parser("delete-schema")
     common(sp)

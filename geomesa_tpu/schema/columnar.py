@@ -156,6 +156,16 @@ def point_column(x: np.ndarray, y: np.ndarray, valid=None) -> GeometryColumn:
     return GeometryColumn(AttributeType.POINT, None, valid, x=x, y=y, bounds=bounds)
 
 
+def null_column(typ: AttributeType, n: int) -> Column:
+    """An all-null column of length ``n`` (schema-evolution backfill)."""
+    valid = np.zeros(n, dtype=bool)
+    if typ in _NUMERIC_DTYPES:
+        return Column(typ, np.zeros(n, dtype=_NUMERIC_DTYPES[typ]), valid)
+    if typ == AttributeType.DATE:
+        return Column(typ, np.zeros(n, dtype=np.int64), valid)
+    return Column(typ, np.empty(n, dtype=object), valid)
+
+
 def _scalar_column(typ: AttributeType, values: Iterable[Any]) -> Column:
     values = list(values)
     n = len(values)

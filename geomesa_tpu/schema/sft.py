@@ -123,10 +123,14 @@ class FeatureType:
 
     @property
     def dtg_field(self) -> str | None:
-        """Default date attribute: explicit user-data override, else first Date."""
+        """Default date attribute: explicit user-data override, else first Date.
+
+        An explicit EMPTY override pins 'no default date' — schema evolution
+        uses it so appending a Date attribute can't retroactively become the
+        dtg of a store that never had one."""
         explicit = self.user_data.get("geomesa.index.dtg")
-        if explicit:
-            return explicit
+        if explicit is not None:
+            return explicit or None
         for a in self.attributes:
             if a.type == AttributeType.DATE:
                 return a.name

@@ -217,6 +217,101 @@ class DataStore:
         self._types[sft.name] = _TypeState(sft=sft, indices=build_indices(sft))
         return sft
 
+    def update_schema(
+        self,
+        type_name: str,
+        add: str | list[str] | None = None,
+        keywords: list[str] | None = None,
+        rename_to: str | None = None,
+    ) -> FeatureType:
+        """Schema evolution (``GeoMesaDataStore.updateSchema`` role,
+        ``MetadataBackedDataStore.scala``): append attributes (all-null for
+        existing rows), set keyword user-data, rename the type. Reference
+        semantics are preserved: existing attributes cannot be removed or
+        retyped, and the default geometry cannot change.
+
+        ``add``: attribute spec string(s) in the SFT DSL, e.g.
+        ``"severity:Integer:index=true"``.
+        """
+        st = self._state(type_name)
+        sft = st.sft
+        new_attrs = list(sft.attributes)
+        have = {a.name for a in new_attrs}
+        appended = []
+        if add:
+            specs = [add] if isinstance(add, str) else list(add)
+            for spec in specs:
+                tmp = parse_spec("_tmp", spec)
+                for a in tmp.attributes:
+                    if a.type.is_geometry:
+                        raise ValueError(
+                            "cannot add geometry attributes (reference "
+                            "updateSchema restriction)"
+                        )
+                    if a.name in have:
+                        raise ValueError(f"attribute already exists: {a.name!r}")
+                    new_attrs.append(a)
+                    appended.append(a)
+                    have.add(a.name)
+        user_data = dict(sft.user_data)
+        if keywords is not None:
+            # comma-joined so the value survives the to_spec round-trip
+            user_data["geomesa.keywords"] = ",".join(keywords)
+        from geomesa_tpu.schema.sft import AttributeType as _AT
+
+        if (
+            any(a.type == _AT.DATE for a in appended)
+            and "geomesa.index.dtg" not in user_data
+        ):
+            # pin the pre-evolution dtg ("" = none): an appended all-null
+            # Date column must not become the store's temporal axis
+            user_data["geomesa.index.dtg"] = sft.dtg_field or ""
+        new_name = rename_to or sft.name
+        if rename_to and rename_to != type_name:
+            if rename_to in self._types:
+                raise ValueError(f"schema already exists: {rename_to!r}")
+        new_sft = FeatureType(
+            name=new_name,
+            attributes=new_attrs,
+            default_geom=sft.geom_field,
+            user_data=user_data,
+        )
+
+        # build the evolved table OUTSIDE the swap: main + delta merged in
+        # host code, appended attributes backfilled as null columns — one
+        # rebuild, and any failure leaves the old state fully intact
+        from geomesa_tpu.schema.columnar import null_column
+
+        delta_table = st.delta.merged()
+        parts = [t for t in (st.table, delta_table) if t is not None and len(t)]
+        base = FeatureTable.concat(parts) if len(parts) > 1 else (
+            parts[0] if parts else None
+        )
+        old_sft = st.sft
+        st.sft = new_sft
+        try:
+            if base is not None:
+                cols = dict(base.columns)
+                for a in appended:
+                    cols[a.name] = null_column(a.type, len(base))
+                self._rebuild(st, FeatureTable(new_sft, base.fids, cols))
+            else:
+                st.table = None
+                st.indices = build_indices(new_sft)
+                st.backend_state = None
+                st.delta.clear()
+        except BaseException:
+            st.sft = old_sft  # _rebuild swaps only on success
+            raise
+        if rename_to and rename_to != type_name:
+            self._types[rename_to] = self._types.pop(type_name)
+            # interceptors scoped to the old name follow the rename
+            self._interceptors = [
+                (rename_to if scope == type_name else scope, fn)
+                for scope, fn in self._interceptors
+            ]
+        return new_sft
+
     def get_schema(self, name: str) -> FeatureType:
         return self._state(name).sft
 

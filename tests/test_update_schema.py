@@ -1,0 +1,164 @@
+"""Schema evolution: append attributes, keywords, rename (updateSchema role)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_600_000_000_000
+SPEC = "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+
+
+def _store(n=200):
+    rng = np.random.default_rng(7)
+    ds = DataStore()
+    sft = parse_spec("evt", SPEC)
+    ds.create_schema(sft)
+    recs = [
+        {"name": f"n{i}", "dtg": T0 + i,
+         "geom": Point(float(rng.uniform(-170, 170)), float(rng.uniform(-80, 80)))}
+        for i in range(n)
+    ]
+    ds.write("evt", FeatureTable.from_records(sft, recs, [f"n{i}" for i in range(n)]))
+    return ds
+
+
+class TestUpdateSchema:
+    def test_append_attribute_nulls_existing(self):
+        ds = _store()
+        before = ds.query("evt", "BBOX(geom, -180, -90, 180, 90)").count
+        sft = ds.update_schema("evt", add="severity:Integer")
+        assert [a.name for a in sft.attributes] == ["name", "dtg", "geom", "severity"]
+        r = ds.query("evt", "BBOX(geom, -180, -90, 180, 90)")
+        assert r.count == before
+        col = r.table.columns["severity"]
+        assert col.valid is not None and not col.valid.any()
+        # new writes can populate the new attribute, old rows stay null
+        ds.write("evt", [{"name": "x", "severity": 7, "dtg": T0,
+                          "geom": Point(1.0, 2.0)}], fids=["new1"])
+        got = ds.query("evt", "severity = 7")
+        assert list(got.table.fids) == ["new1"]
+
+    def test_added_indexed_attribute_planned(self):
+        ds = _store()
+        ds.update_schema("evt", add="code:String:index=true")
+        ds.write("evt", [{"name": "y", "code": "abc", "dtg": T0,
+                          "geom": Point(3.0, 4.0)}], fids=["c1"])
+        ds.compact("evt")
+        plan = ds.explain("evt", "code = 'abc'")
+        assert "attr" in plan.lower()
+        assert list(ds.query("evt", "code = 'abc'").table.fids) == ["c1"]
+
+    def test_keywords_and_rename(self):
+        ds = _store(20)
+        sft = ds.update_schema("evt", keywords=["gdelt", "test"],
+                               rename_to="events2")
+        assert sft.name == "events2"
+        assert sft.user_data["geomesa.keywords"] == "gdelt,test"
+        assert "events2" in ds.list_schemas() and "evt" not in ds.list_schemas()
+        assert ds.query("events2", "BBOX(geom, -180, -90, 180, 90)").count == 20
+
+    def test_restrictions(self):
+        ds = _store(10)
+        with pytest.raises(ValueError, match="geometry"):
+            ds.update_schema("evt", add="g2:Point")
+        with pytest.raises(ValueError, match="exists"):
+            ds.update_schema("evt", add="name:String")
+        ds2 = DataStore()
+        ds2.create_schema(parse_spec("other", SPEC))
+        with pytest.raises(KeyError):
+            ds2.update_schema("missing", add="x:Integer")
+
+    def test_persistence_roundtrip(self, tmp_path):
+        from geomesa_tpu.store import persistence
+
+        ds = _store(50)
+        ds.update_schema("evt", add="severity:Integer")
+        ds.write("evt", [{"name": "z", "severity": 3, "dtg": T0,
+                          "geom": Point(5.0, 5.0)}], fids=["z1"])
+        persistence.save(ds, str(tmp_path / "cat"))
+        ds2 = persistence.load(str(tmp_path / "cat"))
+        sft2 = ds2.get_schema("evt")
+        assert any(a.name == "severity" for a in sft2.attributes)
+        assert ds2.query("evt", "severity = 3").count == 1
+        assert ds2.stats_count("evt", exact=True) == 51
+
+    def test_empty_store_evolution(self):
+        ds = DataStore()
+        ds.create_schema(parse_spec("evt", SPEC))
+        sft = ds.update_schema("evt", add="severity:Integer")
+        assert any(a.name == "severity" for a in sft.attributes)
+        ds.write("evt", [{"name": "a", "severity": 1, "dtg": T0,
+                          "geom": Point(0.0, 0.0)}], fids=["a"])
+        assert ds.query("evt", "severity = 1").count == 1
+
+    def test_cli_update_schema(self, tmp_path):
+        from geomesa_tpu.cli.__main__ import main
+        from geomesa_tpu.store import persistence
+
+        ds = _store(10)
+        cat = tmp_path / "cat"
+        persistence.save(ds, str(cat))
+        main(["update-schema", "-c", str(cat), "-n", "evt",
+              "--add", "severity:Integer", "--keywords", "a,b"])
+        ds2 = persistence.load(str(cat))
+        sft = ds2.get_schema("evt")
+        assert any(a.name == "severity" for a in sft.attributes)
+        assert sft.user_data["geomesa.keywords"] == "a,b"
+
+    def test_added_date_does_not_become_dtg(self):
+        ds = DataStore()
+        ds.create_schema(parse_spec("nodtg", "name:String,*geom:Point"))
+        ds.write("nodtg", [{"name": "a", "geom": Point(1.0, 1.0)}], fids=["a"])
+        sft = ds.update_schema("nodtg", add="seen:Date")
+        assert sft.dtg_field is None  # pinned: no retroactive temporal axis
+        # writes without the new date still validate
+        ds.write("nodtg", [{"name": "b", "geom": Point(2.0, 2.0)}], fids=["b"])
+        assert ds.query("nodtg", "BBOX(geom, 0, 0, 3, 3)").count == 2
+
+    def test_existing_dtg_pinned_when_date_added(self):
+        ds = _store(10)
+        sft = ds.update_schema("evt", add="seen:Date")
+        assert sft.dtg_field == "dtg"  # not the appended all-null column
+
+    def test_failed_evolution_leaves_state_intact(self, monkeypatch):
+        ds = _store(20)
+        import geomesa_tpu.store.datastore as dsmod
+
+        def boom(sft):
+            raise RuntimeError("index build exploded")
+
+        monkeypatch.setattr(dsmod, "build_indices", boom)
+        with pytest.raises(RuntimeError):
+            ds.update_schema("evt", add="severity:Integer")
+        sft = ds.get_schema("evt")
+        assert all(a.name != "severity" for a in sft.attributes)
+        monkeypatch.undo()
+        # store still fully functional on the old schema
+        assert ds.query("evt", "BBOX(geom, -180, -90, 180, 90)").count == 20
+
+    def test_rename_keeps_interceptors(self):
+        ds = _store(10)
+        calls = []
+
+        def icp(sft, q):
+            calls.append(1)
+            return q
+
+        ds.register_interceptor("evt", icp)
+        ds.update_schema("evt", rename_to="evt2")
+        ds.query("evt2", "BBOX(geom, -180, -90, 180, 90)")
+        assert calls  # interceptor followed the rename
+
+    def test_evolution_with_pending_delta(self):
+        ds = _store(50)
+        # unsorted hot-tier rows pending at evolution time
+        ds.write("evt", [{"name": "hot", "dtg": T0, "geom": Point(9.0, 9.0)}],
+                 fids=["hot1"])
+        ds.update_schema("evt", add="severity:Integer")
+        r = ds.query("evt", "BBOX(geom, -180, -90, 180, 90)")
+        assert r.count == 51
+        assert "hot1" in set(r.table.fids)
