@@ -101,6 +101,12 @@ def refine_points(x, y, bins, offs, idx, count, boxes, times):
         & (yi <= boxes[None, :, 3])
     ).any(axis=1)
 
+    return in_box & _time_and_valid(bi, oi, times, idx, count)
+
+
+def _time_and_valid(bi, oi, times, idx, count):
+    """Shared (bin, offset) interval test + real-slot mask for both the
+    point-containment and bbox-overlap refines (same time semantics)."""
     after_lo = (bi > times[None, :, 0]) | (
         (bi == times[None, :, 0]) & (oi >= times[None, :, 1])
     )
@@ -108,9 +114,33 @@ def refine_points(x, y, bins, offs, idx, count, boxes, times):
         (bi == times[None, :, 2]) & (oi <= times[None, :, 3])
     )
     in_time = (after_lo & before_hi).any(axis=1)
-
     valid = jnp.arange(idx.shape[0], dtype=jnp.int32) < count
-    return in_box & in_time & valid
+    return in_time & valid
+
+
+def refine_bboxes(bxmin, bxmax, bymin, bymax, bins, offs, idx, count, boxes, times):
+    """Fused gather + int-domain bbox-OVERLAP/time refine for extended
+    geometries (linestrings/polygons): a candidate matches when its feature
+    bbox intervals overlap any query box. ``boxes`` must be packed with
+    ``pack_boxes(..., overlap=True)`` (the containment pad sentinel is not
+    empty under interval overlap). The residual exact predicate recovers
+    strictness on the host — this is the XZ scan's loose superset test
+    (``XZ2IndexKeySpace`` role)."""
+    lo_x = bxmin[idx][:, None]
+    hi_x = bxmax[idx][:, None]
+    lo_y = bymin[idx][:, None]
+    hi_y = bymax[idx][:, None]
+    bi = bins[idx][:, None]
+    oi = offs[idx][:, None]
+
+    overlaps = (
+        (hi_x >= boxes[None, :, 0])
+        & (lo_x <= boxes[None, :, 1])
+        & (hi_y >= boxes[None, :, 2])
+        & (lo_y <= boxes[None, :, 3])
+    ).any(axis=1)
+
+    return overlaps & _time_and_valid(bi, oi, times, idx, count)
 
 
 @jax.jit

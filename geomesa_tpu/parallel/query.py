@@ -100,7 +100,15 @@ def make_select_step(mesh: Mesh):
     return step
 
 
-def make_select_count_step(mesh: Mesh):
+def _refine_for(n_cols: int):
+    """4 device columns (x/y/bins/offs) → point containment refine;
+    6 (xmin/xmax/ymin/ymax/bins/offs) → extended-geometry bbox overlap."""
+    from geomesa_tpu.ops.refine import refine_bboxes, refine_points
+
+    return refine_points if n_cols == 4 else refine_bboxes
+
+
+def _make_count_step(mesh: Mesh, n_cols: int):
     """Pass 1 of distributed row retrieval: per-shard refine → per-shard hit
     counts (D,) int32 on host. The counts size pass 2's capacity lanes
     (the overflow-safe two-phase gather of SURVEY.md §7 "variable-length
@@ -111,29 +119,28 @@ def make_select_count_step(mesh: Mesh):
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            *(P(DATA_AXIS) for _ in range(n_cols)),
             P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
         ),
         out_specs=P(DATA_AXIS),
         check_vma=False,
     )
-    def step(x, y, bins, offs, idx, count, boxes, times):
-        from geomesa_tpu.ops.refine import refine_points
-
-        mask = refine_points(x, y, bins, offs, idx[0], count[0], boxes, times)
+    def step(*args):
+        cols, (idx, count, boxes, times) = args[:n_cols], args[n_cols:]
+        mask = _refine_for(n_cols)(*cols, idx[0], count[0], boxes, times)
         return mask.sum(dtype=jnp.int32)[None]
 
     return step
 
 
-def make_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
+def _make_gather_step(mesh: Mesh, n_cols: int, capacity: int, replicate: bool):
     """Pass 2: per-shard refine + on-device compaction of matching *global*
     row positions into ``capacity`` lanes per shard.
 
-    Returns ``fn(x, y, bins, offs, idx, count, boxes, times) → (positions
-    (D, capacity) int32, hits (D,) int32)`` — positions[d, :hits[d]] are
-    global sorted-order row positions matching on shard d (lanes beyond the
-    hit count hold -1). With ``replicate=True`` the per-shard buffers are
+    Returns ``fn(*cols, idx, count, boxes, times) → (positions (D, capacity)
+    int32, hits (D,) int32)`` — positions[d, :hits[d]] are global
+    sorted-order row positions matching on shard d (lanes beyond the hit
+    count hold -1). With ``replicate=True`` the per-shard buffers are
     ``all_gather``-merged over the data axis so every device holds the full
     hit list (the reference's client-side merge of BatchScanner partials,
     done on-fabric — ``AccumuloQueryPlan.scala:136`` role).
@@ -151,18 +158,17 @@ def make_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            *(P(DATA_AXIS) for _ in range(n_cols)),
             P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
         ),
         out_specs=(out_pos, out_cnt),
         check_vma=False,
     )
-    def step(x, y, bins, offs, idx, count, boxes, times):
-        from geomesa_tpu.ops.refine import refine_points
-
-        mask = refine_points(x, y, bins, offs, idx[0], count[0], boxes, times)
+    def step(*args):
+        cols, (idx, count, boxes, times) = args[:n_cols], args[n_cols:]
+        mask = _refine_for(n_cols)(*cols, idx[0], count[0], boxes, times)
         localpos = idx[0]
-        base = jax.lax.axis_index(DATA_AXIS) * x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * cols[0].shape[0]
         # stable stream compaction: prefix-sum destinations, OOB lanes drop
         dest = jnp.where(mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, capacity)
         out = jnp.full((capacity,), -1, dtype=jnp.int32)
@@ -178,6 +184,29 @@ def make_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
     return step
 
 
+def make_select_count_step(mesh: Mesh):
+    return _make_count_step(mesh, 4)
+
+
+def make_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
+    return _make_gather_step(mesh, 4, capacity, replicate)
+
+
+def make_select_count_step_bbox(mesh: Mesh):
+    """Pass-1 counts for EXTENDED-geometry stores: per-shard bbox-overlap
+    refine over the feature-bbox SoA (xmin/xmax/ymin/ymax int32 columns) —
+    the distributed row-retrieval path for XZ2/XZ3 indexes (linestrings,
+    polygons), where the loose test is interval overlap, not containment.
+    Column order: (xmin, xmax, ymin, ymax, bins, offs)."""
+    return _make_count_step(mesh, 6)
+
+
+def make_select_gather_step_bbox(mesh: Mesh, capacity: int):
+    """Pass-2 gather for extended-geometry stores (see
+    :func:`make_select_gather_step`; refine is bbox overlap)."""
+    return _make_gather_step(mesh, 6, capacity, replicate=False)
+
+
 from functools import lru_cache
 
 
@@ -191,6 +220,16 @@ def cached_select_count_step(mesh: Mesh):
 @lru_cache(maxsize=None)
 def cached_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
     return make_select_gather_step(mesh, capacity, replicate)
+
+
+@lru_cache(maxsize=None)
+def cached_select_count_step_bbox(mesh: Mesh):
+    return make_select_count_step_bbox(mesh)
+
+
+@lru_cache(maxsize=None)
+def cached_select_gather_step_bbox(mesh: Mesh, capacity: int):
+    return make_select_gather_step_bbox(mesh, capacity)
 
 
 @lru_cache(maxsize=None)

@@ -66,14 +66,18 @@ class OracleBackend(ExecutionBackend):
 class _MeshIndexState:
     """Per-index mesh-sharded device columns, sorted in index order.
 
-    ``cols`` holds x/y/bins/offs jnp arrays sharded contiguously over the
-    mesh ``data`` axis (curve order = shard order, SURVEY.md §2.20 P1);
-    padding rows live past ``n`` and never appear in scan intervals.
+    ``cols`` holds the device jnp arrays sharded contiguously over the mesh
+    ``data`` axis (curve order = shard order, SURVEY.md §2.20 P1); padding
+    rows live past ``n`` and never appear in scan intervals. ``kind`` is
+    ``"points"`` (x/y/bins/offs — containment refine) or ``"bboxes"``
+    (xmin/xmax/ymin/ymax/bins/offs — overlap refine for extended
+    geometries, the XZ2/XZ3 device path).
     """
 
     cols: dict[str, Any]
     rows_per_shard: int
     n: int
+    kind: str = "points"
 
 
 class TpuBackend(ExecutionBackend):
@@ -120,19 +124,15 @@ class TpuBackend(ExecutionBackend):
         mesh = None
         for name, index in indices.items():
             col = table.geom_column() if sft.geom_field else None
-            if (
-                col is None
-                or col.x is None
-                or len(table) == 0
-                or name in ("id",)
-            ):
+            if col is None or len(table) == 0 or name in ("id",):
                 state[name] = None  # host path
+                continue
+            if col.x is None and col.bounds is None:
+                state[name] = None
                 continue
             if mesh is None:
                 mesh = self._get_mesh()
             perm = index.perm
-            xi = nlon.normalize(col.x[perm]).astype(np.int32)
-            yi = nlat.normalize(col.y[perm]).astype(np.int32)
             if binned is not None:
                 bins, offs = binned.to_bin_and_offset(table.dtg_millis()[perm])
                 bins = bins.astype(np.int32)
@@ -140,16 +140,54 @@ class TpuBackend(ExecutionBackend):
             else:
                 bins = np.zeros(len(table), dtype=np.int32)
                 offs = np.zeros(len(table), dtype=np.int32)
-            cols, padded, rows_per_shard = shard_columns(
-                mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs}
-            )
-            state[name] = _MeshIndexState(
-                cols=cols, rows_per_shard=rows_per_shard, n=len(table)
-            )
+            if col.x is not None:
+                xi = nlon.normalize(col.x[perm]).astype(np.int32)
+                yi = nlat.normalize(col.y[perm]).astype(np.int32)
+                cols, padded, rows_per_shard = shard_columns(
+                    mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs}
+                )
+                state[name] = _MeshIndexState(
+                    cols=cols, rows_per_shard=rows_per_shard, n=len(table)
+                )
+            else:
+                # extended geometries: shard the bbox SoA for overlap refine.
+                # Null geometries leave NaN bounds — normalize a dummy, then
+                # stamp an unsatisfiable interval so they never match (the
+                # residual filter already excludes them on the host path)
+                b = col.bounds[perm]
+                invalid = (
+                    np.zeros(len(b), dtype=bool)
+                    if col.valid is None
+                    else ~col.valid[perm]
+                )
+                invalid |= ~np.isfinite(b).all(axis=1)
+                if invalid.any():
+                    b = np.where(invalid[:, None], 0.0, b)
+                xmin = nlon.normalize(b[:, 0]).astype(np.int32)
+                ymin = nlat.normalize(b[:, 1]).astype(np.int32)
+                xmax = nlon.normalize(b[:, 2]).astype(np.int32)
+                ymax = nlat.normalize(b[:, 3]).astype(np.int32)
+                if invalid.any():
+                    imax = np.iinfo(np.int32).max
+                    xmin[invalid] = imax
+                    xmax[invalid] = -1  # hi < 0 <= qlo: overlap always false
+                    ymin[invalid] = imax
+                    ymax[invalid] = -1
+                cols, padded, rows_per_shard = shard_columns(
+                    mesh,
+                    {
+                        "xmin": xmin, "ymin": ymin, "xmax": xmax, "ymax": ymax,
+                        "bins": bins, "offs": offs,
+                    },
+                )
+                state[name] = _MeshIndexState(
+                    cols=cols, rows_per_shard=rows_per_shard, n=len(table),
+                    kind="bboxes",
+                )
         return state
 
     # -- refine payload (int-domain superset bounds) -------------------------
-    def _payload(self, sft: FeatureType, e: Extraction):
+    def _payload(self, sft: FeatureType, e: Extraction, overlap: bool = False):
         from geomesa_tpu.ops.refine import pack_boxes, pack_times
 
         nlon = norm_lon(REFINE_PRECISION)
@@ -186,7 +224,7 @@ class TpuBackend(ExecutionBackend):
                 (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
                 quads.append([int(blo), int(olo), int(bhi), int(ohi)])
             times = np.array(quads, dtype=np.int32) if quads else np.empty((0, 4), np.int32)
-        return pack_boxes(boxes), pack_times(times)
+        return pack_boxes(boxes, overlap=overlap), pack_times(times)
 
     def select(self, state, index, plan, extraction, residual, table):
         intervals = plan.intervals
@@ -216,7 +254,9 @@ class TpuBackend(ExecutionBackend):
         from geomesa_tpu.parallel.mesh import data_shards
         from geomesa_tpu.parallel.query import (
             cached_select_count_step,
+            cached_select_count_step_bbox,
             cached_select_gather_step,
+            cached_select_gather_step_bbox,
             max_shard_candidates,
             split_intervals_by_shard,
         )
@@ -230,27 +270,33 @@ class TpuBackend(ExecutionBackend):
         idx, counts = split_intervals_by_shard(
             intervals, dev.rows_per_shard, n_shards, bucket
         )
-        boxes, times = self._payload(index.sft, extraction)
+        bbox_mode = dev.kind == "bboxes"
+        boxes, times = self._payload(index.sft, extraction, overlap=bbox_mode)
         d_idx = jnp.asarray(idx)
         d_counts = jnp.asarray(counts)
         d_boxes = jnp.asarray(boxes)
         d_times = jnp.asarray(times)
         c = dev.cols
-        per_shard = np.asarray(
-            cached_select_count_step(mesh)(
-                c["x"], c["y"], c["bins"], c["offs"],
-                d_idx, d_counts, d_boxes, d_times,
+        if bbox_mode:
+            col_args = (
+                c["xmin"], c["xmax"], c["ymin"], c["ymax"], c["bins"], c["offs"]
             )
+            count_step = cached_select_count_step_bbox(mesh)
+        else:
+            col_args = (c["x"], c["y"], c["bins"], c["offs"])
+            count_step = cached_select_count_step(mesh)
+        per_shard = np.asarray(
+            count_step(*col_args, d_idx, d_counts, d_boxes, d_times)
         )
         top = int(per_shard.max())
         if top == 0:
             return np.empty(0, dtype=np.int64)
         capacity = pad_bucket(top, minimum=128)
-        step = cached_select_gather_step(mesh, capacity)
-        pos, hits = step(
-            c["x"], c["y"], c["bins"], c["offs"],
-            d_idx, d_counts, d_boxes, d_times,
-        )
+        if bbox_mode:
+            step = cached_select_gather_step_bbox(mesh, capacity)
+        else:
+            step = cached_select_gather_step(mesh, capacity)
+        pos, hits = step(*col_args, d_idx, d_counts, d_boxes, d_times)
         pos = np.asarray(pos)
         hits = np.asarray(hits)
         return np.concatenate(
