@@ -237,25 +237,32 @@ def cached_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return make_batched_count_step(mesh, impl)
 
 
-def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
-    """(Ql, Nl) bool: query q matches local row r (int-domain superset test)."""
-    xi = x[None, None, :]  # (1, 1, Nl)
-    yi = y[None, None, :]
+def _batched_time_match(bins, offs, times):
+    """(Q, Nl) bool: row instant inside any of the query's (bin, offset)
+    windows — the ONE place the inclusive interval semantics live for the
+    batched throughput steps (point containment and bbox overlap)."""
     bi = bins[None, None, :]
     oi = offs[None, None, :]
-    in_box = (
-        (xi >= boxes[:, :, 0, None])
-        & (xi <= boxes[:, :, 1, None])
-        & (yi >= boxes[:, :, 2, None])
-        & (yi <= boxes[:, :, 3, None])
-    ).any(axis=1)
     after = (bi > times[:, :, 0, None]) | (
         (bi == times[:, :, 0, None]) & (oi >= times[:, :, 1, None])
     )
     before = (bi < times[:, :, 2, None]) | (
         (bi == times[:, :, 2, None]) & (oi <= times[:, :, 3, None])
     )
-    in_time = (after & before).any(axis=1)
+    return (after & before).any(axis=1)
+
+
+def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
+    """(Ql, Nl) bool: query q matches local row r (int-domain superset test)."""
+    xi = x[None, None, :]  # (1, 1, Nl)
+    yi = y[None, None, :]
+    in_box = (
+        (xi >= boxes[:, :, 0, None])
+        & (xi <= boxes[:, :, 1, None])
+        & (yi >= boxes[:, :, 2, None])
+        & (yi <= boxes[:, :, 3, None])
+    ).any(axis=1)
+    in_time = _batched_time_match(bins, offs, times)
     rows_valid = (base + jnp.arange(x.shape[0], dtype=jnp.int32)) < true_n
     return in_box & in_time & rows_valid[None, :]
 
@@ -362,7 +369,7 @@ def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
-def make_batched_overlap_step(mesh: Mesh):
+def make_batched_overlap_step(mesh: Mesh, with_time: bool = False):
     """Extended-geometry (XZ) throughput path: Q bbox-overlap counts over a
     store of per-feature bounding boxes, psum over data shards.
 
@@ -370,38 +377,46 @@ def make_batched_overlap_step(mesh: Mesh):
     ``boxes`` packs int-domain [qxlo, qxhi, qylo, qyhi] and a row matches
     when its bbox intersects any of the query's boxes — the XZ2 scan's
     overlap test (``XZ2SFC.scala`` ranges + per-row refine) as one fused
-    vectorized pass (SURVEY.md §2.20 P4/P5).
+    vectorized pass (SURVEY.md §2.20 P4/P5). With ``with_time=True`` the
+    signature gains (bins, offs) columns and a (Q, T, 4) times payload
+    (the XZ3 shape; ``count_many``'s loose path for extended stores).
     """
+
+    col_specs = (P(DATA_AXIS),) * (6 if with_time else 4)
+    q_specs = (
+        (P(QUERY_AXIS, None, None), P(QUERY_AXIS, None, None))
+        if with_time
+        else (P(QUERY_AXIS, None, None),)
+    )
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(),
-            P(QUERY_AXIS, None, None),
-        ),
+        in_specs=(*col_specs, P(), *q_specs),
         out_specs=P(QUERY_AXIS),
         check_vma=False,
     )
-    def step(xmin, ymin, xmax, ymax, true_n, boxes):
+    def step(*args):
+        if with_time:
+            xmin, ymin, xmax, ymax, bins, offs, true_n, boxes, times = args
+        else:
+            xmin, ymin, xmax, ymax, true_n, boxes = args
         base = jax.lax.axis_index(DATA_AXIS) * xmin.shape[0]
         x1 = xmin[None, None, :]
         y1 = ymin[None, None, :]
         x2 = xmax[None, None, :]
         y2 = ymax[None, None, :]
-        overlap = (
+        match = (
             (x1 <= boxes[:, :, 1, None])
             & (x2 >= boxes[:, :, 0, None])
             & (y1 <= boxes[:, :, 3, None])
             & (y2 >= boxes[:, :, 2, None])
         ).any(axis=1)
+        if with_time:
+            match = match & _batched_time_match(bins, offs, times)
         rows_valid = (base + jnp.arange(xmin.shape[0], dtype=jnp.int32)) < true_n
-        counts = (overlap & rows_valid[None, :]).sum(axis=1, dtype=jnp.int32)
+        counts = (match & rows_valid[None, :]).sum(axis=1, dtype=jnp.int32)
         return jax.lax.psum(counts, DATA_AXIS)
 
     return step
@@ -465,6 +480,11 @@ def make_batched_knn_step(mesh: Mesh, k: int):
 @lru_cache(maxsize=None)
 def cached_batched_knn_step(mesh: Mesh, k: int):
     return make_batched_knn_step(mesh, k)
+
+
+@lru_cache(maxsize=None)
+def cached_batched_overlap_step(mesh: Mesh, with_time: bool = False):
+    return make_batched_overlap_step(mesh, with_time)
 
 
 def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):

@@ -114,6 +114,18 @@ class TpuBackend(ExecutionBackend):
                 return dev, name
         return None, None
 
+    @staticmethod
+    def bbox_state(state) -> tuple["_MeshIndexState | None", str | None]:
+        """The preferred extended-geometry device state (xz3/xz2): feature
+        bbox SoA for overlap-mode batched fast paths."""
+        if not state:
+            return None, None
+        for name in ("xz3", "xz2"):
+            dev = state.get(name)
+            if dev is not None and dev.kind == "bboxes":
+                return dev, name
+        return None, None
+
     def load(self, sft, table, indices):
         from geomesa_tpu.parallel.mesh import shard_columns
 

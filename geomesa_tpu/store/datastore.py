@@ -690,12 +690,15 @@ class DataStore:
         def _exact(q):
             return self.query(type_name, q).count
 
-        dev = None
+        dev = bbox_dev = None
         if isinstance(self.backend, TpuBackend) and self._device_available():
             dev, _ = TpuBackend.point_state(st.backend_state)
+            if dev is None:
+                # extended-geometry store: loose counts are bbox overlaps
+                bbox_dev, _ = TpuBackend.bbox_state(st.backend_state)
         if (
             not loose
-            or dev is None
+            or (dev is None and bbox_dev is None)
             or st.delta.merged() is not None
             or st.main_rows == 0
             # TTL masking is injected per-query in query(); loose counts
@@ -720,7 +723,12 @@ class DataStore:
             ):
                 continue
             e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
-            pending.append((i, None if e.disjoint else self.backend._payload(st.sft, e)))
+            payload = (
+                None
+                if e.disjoint
+                else self.backend._payload(st.sft, e, overlap=bbox_dev is not None)
+            )
+            pending.append((i, payload))
 
         out: list = [None] * len(qs)
         live = [(i, p) for i, p in pending if p is not None]
@@ -730,7 +738,10 @@ class DataStore:
         if live:
             import jax.numpy as jnp
 
-            from geomesa_tpu.parallel.query import cached_batched_count_step
+            from geomesa_tpu.parallel.query import (
+                cached_batched_count_step,
+                cached_batched_overlap_step,
+            )
 
             boxes = np.stack([p[0] for _, p in live])
             times = np.stack([p[1] for _, p in live])
@@ -741,16 +752,28 @@ class DataStore:
 
             mesh = self.backend._get_mesh()
             (boxes, times), _ = pad_query_axis(mesh, boxes, times)
-            step = cached_batched_count_step(mesh)
-            c = dev.cols
             try:
-                counts = np.asarray(
-                    step(
-                        c["x"], c["y"], c["bins"], c["offs"],
-                        jnp.int32(st.main_rows),
-                        jnp.asarray(boxes), jnp.asarray(times),
+                if bbox_dev is not None:
+                    c = bbox_dev.cols
+                    step = cached_batched_overlap_step(mesh, with_time=True)
+                    counts = np.asarray(
+                        step(
+                            c["xmin"], c["ymin"], c["xmax"], c["ymax"],
+                            c["bins"], c["offs"],
+                            jnp.int32(st.main_rows),
+                            jnp.asarray(boxes), jnp.asarray(times),
+                        )
                     )
-                )
+                else:
+                    c = dev.cols
+                    step = cached_batched_count_step(mesh)
+                    counts = np.asarray(
+                        step(
+                            c["x"], c["y"], c["bins"], c["offs"],
+                            jnp.int32(st.main_rows),
+                            jnp.asarray(boxes), jnp.asarray(times),
+                        )
+                    )
             except Exception as e:  # noqa: BLE001 — failover to exact host path
                 if not self._is_device_error(e):
                     raise

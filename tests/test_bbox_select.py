@@ -176,3 +176,33 @@ class TestBboxMeshSelect:
         state = backend.load(sft, table, indices)  # must not raise
         kinds = {k: getattr(v, "kind", None) for k, v in state.items()}
         assert "bboxes" in kinds.values()
+
+
+class TestCountManyBboxStore:
+    def test_loose_counts_match_exact_for_bbox_queries(self):
+        tpu, oracle = _stores(n=2000, seed=11)
+        queries = [
+            "BBOX(geom, -20, -15, 10, 15)",
+            "BBOX(geom, 100, 20, 140, 60)",
+            "BBOX(geom, -180, -90, 180, 90)",
+            ("BBOX(geom, -60, -40, 60, 40) AND dtg DURING "
+             "2020-09-14T00:00:00Z/2020-09-16T00:00:00Z"),
+        ]
+        got = tpu.count_many("trk", queries, loose=True)
+        want = [oracle.query("trk", q).count for q in queries]
+        # BBOX on extended geometries IS the bbox-overlap predicate, so the
+        # loose device counts equal the exact oracle counts here
+        assert got == want
+        assert tpu.metrics.counter("store.query.device_failovers").count == 0
+
+    def test_disjoint_and_fallback_mix(self):
+        tpu, oracle = _stores(n=500, seed=12)
+        queries = [
+            "BBOX(geom, 200, 90, 210, 95)",           # disjoint -> 0
+            "BBOX(geom, -20, -15, 10, 15)",           # batched
+            "name = 't1'",                            # non-spatial -> exact
+        ]
+        got = tpu.count_many("trk", queries, loose=True)
+        assert got[0] == 0
+        assert got[1] == oracle.query("trk", queries[1]).count
+        assert got[2] == 1
