@@ -206,3 +206,20 @@ class TestCountManyBboxStore:
         assert got[0] == 0
         assert got[1] == oracle.query("trk", queries[1]).count
         assert got[2] == 1
+
+
+class TestPersistenceRoundTrip:
+    def test_track_store_save_load_serves_from_mesh(self, tmp_path):
+        from geomesa_tpu.store import persistence
+
+        tpu, oracle = _stores(n=800, seed=13)
+        persistence.save(tpu, str(tmp_path / "cat"))
+        ds2 = persistence.load(str(tmp_path / "cat"))
+        st = ds2._state("trk")
+        kinds = {k: getattr(v, "kind", None)
+                 for k, v in (st.backend_state or {}).items()}
+        assert "bboxes" in kinds.values()
+        q = QUERIES[0]
+        assert set(ds2.query("trk", q).table.fids) == set(
+            oracle.query("trk", q).table.fids
+        )
