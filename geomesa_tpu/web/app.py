@@ -9,6 +9,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas                          list type names
     POST   /api/schemas                          {"name": ..., "spec": ...}
     GET    /api/schemas/{name}                   spec + row count
+    PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
     POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
     GET    /api/schemas/{name}/query?cql=&limit=&format=geojson|arrow|bin|avro|gml|leaflet
@@ -76,6 +77,7 @@ class GeoMesaApp:
             ("GET", r"^/api/schemas$", self._list_schemas),
             ("POST", r"^/api/schemas$", self._create_schema),
             ("GET", r"^/api/schemas/([^/]+)$", self._get_schema),
+            ("PATCH", r"^/api/schemas/([^/]+)$", self._update_schema),
             ("DELETE", r"^/api/schemas/([^/]+)$", self._delete_schema),
             ("POST", r"^/api/schemas/([^/]+)/features$", self._add_features),
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
@@ -98,7 +100,7 @@ class GeoMesaApp:
         }
         try:
             body = None
-            if method in ("POST", "PUT"):
+            if method in ("POST", "PUT", "PATCH"):
                 length = int(environ.get("CONTENT_LENGTH") or 0)
                 raw = environ["wsgi.input"].read(length) if length else b""
                 body = json.loads(raw) if raw else None
@@ -163,6 +165,33 @@ class GeoMesaApp:
             ],
             "count": self.store.stats_count(name),
         }, "application/json"
+
+    def _update_schema(self, name, params, body):
+        """Schema evolution (updateSchema role): body keys ``add`` (spec
+        string or list of specs), ``keywords`` (list of strings),
+        ``rename_to`` (string)."""
+        if not isinstance(body, dict) or not ({"add", "keywords", "rename_to"} & set(body)):
+            raise _HttpError(400, "expected {add|keywords|rename_to} body")
+        add = body.get("add")
+        if add is not None and not (
+            isinstance(add, str)
+            or (isinstance(add, list) and all(isinstance(s, str) for s in add))
+        ):
+            raise _HttpError(400, "'add' must be a spec string or list of them")
+        keywords = body.get("keywords")
+        if keywords is not None and not (
+            isinstance(keywords, list)
+            and all(isinstance(k, str) for k in keywords)
+        ):
+            raise _HttpError(400, "'keywords' must be a list of strings")
+        rename_to = body.get("rename_to")
+        if rename_to is not None and not isinstance(rename_to, str):
+            raise _HttpError(400, "'rename_to' must be a string")
+        # store ValueErrors map to JSON 400 in __call__
+        sft = self.store.update_schema(
+            name, add=add, keywords=keywords, rename_to=rename_to
+        )
+        return 200, {"name": sft.name, "spec": sft.to_spec()}, "application/json"
 
     def _delete_schema(self, name, params, body):
         self.store.delete_schema(name)

@@ -191,3 +191,36 @@ class TestQueryAndStats:
         assert status == 200
         assert b"</script><script>alert" not in data
         assert b"\\u003c/script\\u003e" in data
+
+    def test_update_schema_endpoint(self, app):
+        _ingest(app)
+        status, out = jcall(app, "PATCH", "/api/schemas/pts",
+                            body={"add": "severity:Integer",
+                                  "keywords": ["a", "b"]})
+        assert status == 200
+        assert "severity:Integer" in out["spec"]
+        status, out = jcall(app, "GET", "/api/schemas/pts")
+        assert any(a["name"] == "severity" for a in out["attributes"])
+        # invalid evolution -> clean 400
+        status, out = jcall(app, "PATCH", "/api/schemas/pts",
+                            body={"add": "g2:Point"})
+        assert status == 400
+
+    def test_update_schema_validation(self, app):
+        _ingest(app)
+        # non-dict body -> clean 400, not a 500 traceback
+        status, out = jcall(app, "PATCH", "/api/schemas/pts",
+                            body="severity:Integer")
+        assert status == 400
+        # unknown keys only -> 400, not a silent no-op
+        status, out = jcall(app, "PATCH", "/api/schemas/pts",
+                            body={"adds": "severity:Integer"})
+        assert status == 400
+        # wrong types -> 400
+        for bad in ({"rename_to": 5}, {"keywords": "gdelt"},
+                    {"add": [1, 2]}):
+            status, out = jcall(app, "PATCH", "/api/schemas/pts", body=bad)
+            assert status == 400, bad
+        # store still listable and unchanged
+        status, out = jcall(app, "GET", "/api/schemas")
+        assert status == 200 and "pts" in out["schemas"]
