@@ -9,8 +9,8 @@ Re-materializes GeoMesa's three load-bearing seams (see SURVEY.md §1):
   :mod:`geomesa_tpu.index`, :mod:`geomesa_tpu.planning`).
 - **Bottom**: pluggable execution backends — a brute-force CPU oracle for parity
   testing and a sharded columnar TPU backend with fused scan/refine/aggregate
-  kernels merged over ICI collectives (:mod:`geomesa_tpu.store.oracle`,
-  :mod:`geomesa_tpu.store.tpu_backend`, :mod:`geomesa_tpu.parallel`).
+  kernels merged over ICI collectives (:mod:`geomesa_tpu.store.backends`,
+  :mod:`geomesa_tpu.parallel`, :mod:`geomesa_tpu.ops`).
 
 Reference capability map: /root/reference (GeoMesa 2.4.0-SNAPSHOT). This is a
 from-scratch TPU-first design, not a port — see SURVEY.md §7.
@@ -21,7 +21,7 @@ import jax as _jax
 # 64-bit mode: spatio-temporal keys are 62/63-bit Morton codes and timestamps are
 # epoch-millis int64; coordinates are f64 on the host side of the seam. The device
 # (TPU) hot path is explicitly typed int32/f32/bf16 throughout (see
-# geomesa_tpu/store/tpu_backend.py) so MXU/VPU work never silently widens.
+# geomesa_tpu/store/backends.py) so MXU/VPU work never silently widens.
 _jax.config.update("jax_enable_x64", True)
 
 __version__ = "0.1.0"
