@@ -13,6 +13,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
+import re
+
 import numpy as np
 
 from geomesa_tpu.geometry import predicates as P
@@ -380,6 +382,66 @@ class IsNull(Filter):
 
 
 @dataclass(frozen=True)
+class JsonPathCompare(Filter):
+    """``jsonPath('<path>', attr) <op> <literal>`` — compare a value inside a
+    JSON-text attribute (the ``KryoJsonSerialization`` role, SURVEY.md §2.4:
+    JSON-path-indexable attributes). Path subset: ``$.a.b[0].c``. A row with
+    unparseable JSON or a missing path never matches (op ``<>`` included —
+    absent is not 'different', it's absent, matching the reference's
+    JSONPath-miss semantics)."""
+
+    op: str  # =, <>, <, <=, >, >=
+    path: str
+    prop: str
+    literal: Any
+
+    _TOKEN = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+
+    def _steps(self):
+        if not self.path.startswith("$"):
+            raise ValueError(f"json path must start with $: {self.path!r}")
+        pos = 1
+        steps = []
+        while pos < len(self.path):
+            m = self._TOKEN.match(self.path, pos)
+            if not m:
+                raise ValueError(f"bad json path at {pos}: {self.path!r}")
+            steps.append(m.group(1) if m.group(1) is not None else int(m.group(2)))
+            pos = m.end()
+        return steps
+
+    def mask(self, table):
+        import json as _json
+
+        steps = self._steps()
+        col = table.columns[self.prop]
+        valid = col.is_valid()
+        values = col.values
+        cmp = _CMP[self.op]
+        out = np.zeros(len(values), dtype=bool)
+        lit = self.literal
+        for i in range(len(values)):
+            if not valid[i]:
+                continue
+            try:
+                v = _json.loads(values[i])
+                for s in steps:
+                    v = v[s]
+            except (ValueError, KeyError, IndexError, TypeError):
+                continue  # missing path / bad JSON: no match
+            try:
+                if isinstance(lit, str) != isinstance(v, str):
+                    continue  # cross-type compares never match
+                if isinstance(lit, bool) != isinstance(v, bool):
+                    continue  # bool is an int subclass: true must not equal 1
+                if cmp(v, lit):
+                    out[i] = True
+            except TypeError:
+                continue
+        return out
+
+
+@dataclass(frozen=True)
 class FidIn(Filter):
     """``IN ('fid1', 'fid2')`` on feature ids (the ID index path)."""
 
@@ -441,6 +503,11 @@ def to_cql(f: Filter) -> str:
         return f"{f.prop} DURING {_cql_millis(f.lo_millis)}/{_cql_millis(f.hi_millis)}"
     if isinstance(f, TempOp):
         return f"{f.prop} {f.op.upper()} {_cql_millis(f.millis)}"
+    if isinstance(f, JsonPathCompare):
+        return (
+            f"jsonPath({_cql_literal(f.path)}, {f.prop}) "
+            f"{f.op} {_cql_literal(f.literal)}"
+        )
     if isinstance(f, Compare):
         return f"{f.prop} {f.op} {_cql_literal(f.literal)}"
     if isinstance(f, Between):

@@ -269,6 +269,34 @@ class _Parser:
                 fids.append(str(self.literal()))
             self.expect(")")
             return ast.FidIn(tuple(fids))
+        if w.upper() == "JSONPATH":
+            # jsonPath('<$.path>', attr) <op> <literal>  — JSON attribute
+            # query (KryoJsonSerialization role); both argument orders accepted
+            self.take_word()
+            self.expect("(")
+
+            def _path_or_ident():
+                self.skip_ws()
+                return (
+                    self.quoted()
+                    if self.s[self.pos : self.pos + 1] in ("'", '"')
+                    else self.take_word()
+                )
+
+            a1 = _path_or_ident()
+            self.expect(",")
+            a2 = _path_or_ident()
+            self.expect(")")
+            path, attr = (a1, a2) if str(a1).startswith("$") else (a2, a1)
+            if not str(path).startswith("$"):
+                raise CQLError(f"jsonPath needs a '$...' path: {a1!r}, {a2!r}")
+            self.skip_ws()
+            for op in ("<>", "<=", ">=", "=", "<", ">"):
+                if self.s.startswith(op, self.pos):
+                    self.pos += len(op)
+                    lit = self.literal()
+                    return ast.JsonPathCompare(op, str(path), str(attr), lit)
+            raise CQLError(f"expected comparison after jsonPath at {self.pos}")
 
         # property-led predicates
         prop = self.take_word()

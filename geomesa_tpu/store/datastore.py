@@ -540,10 +540,10 @@ class DataStore:
 
     # -- queries (QueryPlanner.runQuery role) --------------------------------
     def query(
-        self, type_name: str, q: Query | str | None = None, **kwargs
+        self, type_name: str, q: "Query | str | ast.Filter | None" = None, **kwargs
     ) -> QueryResult:
         st = self._state(type_name)
-        if isinstance(q, str) or q is None:
+        if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q, **kwargs)
         elif kwargs:
             raise ValueError(
@@ -682,7 +682,10 @@ class DataStore:
         back to exact per-query execution.
         """
         st = self._state(type_name)
-        qs = [Query(filter=q) if isinstance(q, str) or q is None else q for q in queries]
+        qs = [
+            Query(filter=q) if isinstance(q, (str, ast.Filter)) or q is None else q
+            for q in queries
+        ]
         # interceptors see every query exactly as query() would show them
         if self._interceptors:
             qs = [self._intercept(type_name, st.sft, q) for q in qs]
@@ -818,9 +821,9 @@ class DataStore:
             )
         )
 
-    def explain(self, type_name: str, q: Query | str) -> str:
+    def explain(self, type_name: str, q: "Query | str | ast.Filter") -> str:
         st = self._state(type_name)
-        if isinstance(q, str):
+        if isinstance(q, (str, ast.Filter)):
             q = Query(filter=q)
         planner = QueryPlanner(st.sft, st.indices, st.stats)
         _, _, info = planner.plan(q)

@@ -53,9 +53,9 @@ class MergedDataStoreView:
             names &= set(s.list_schemas())
         return sorted(names)
 
-    def query(self, type_name: str, q: Query | str | None = None, **kwargs) -> QueryResult:
+    def query(self, type_name: str, q: "Query | str | ast.Filter | None" = None, **kwargs) -> QueryResult:
         sft = self.get_schema(type_name)
-        if isinstance(q, str) or q is None:
+        if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q, **kwargs)
 
         # sub-queries: scope filter ANDed in; view-level reduce steps stripped

@@ -1,0 +1,92 @@
+"""JSON-path attribute queries (KryoJsonSerialization role)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.cql import CQLError, parse
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+
+@pytest.fixture(scope="module")
+def ds():
+    store = DataStore()
+    store.create_schema(parse_spec("ev", "props:String,*geom:Point"))
+    rows = [
+        {"props": '{"kind": "bus", "speed": 12.5, "tags": ["a", "b"]}',
+         "geom": Point(1.0, 1.0)},
+        {"props": '{"kind": "car", "speed": 33.0, "tags": ["c"]}',
+         "geom": Point(2.0, 2.0)},
+        {"props": '{"kind": "car", "nested": {"depth": 2}}',
+         "geom": Point(3.0, 3.0)},
+        {"props": "not json at all", "geom": Point(4.0, 4.0)},
+        {"props": None, "geom": Point(5.0, 5.0)},
+    ]
+    store.write("ev", rows, fids=["bus", "car1", "car2", "bad", "null"])
+    return store
+
+
+class TestJsonPath:
+    def test_equality(self, ds):
+        r = ds.query("ev", "jsonPath('$.kind', props) = 'car'")
+        assert set(r.table.fids) == {"car1", "car2"}
+
+    def test_numeric_compare(self, ds):
+        r = ds.query("ev", "jsonPath('$.speed', props) > 20")
+        assert set(r.table.fids) == {"car1"}
+
+    def test_nested_and_array(self, ds):
+        assert set(
+            ds.query("ev", "jsonPath('$.nested.depth', props) = 2").table.fids
+        ) == {"car2"}
+        assert set(
+            ds.query("ev", "jsonPath('$.tags[1]', props) = 'b'").table.fids
+        ) == {"bus"}
+
+    def test_missing_path_never_matches(self, ds):
+        # <> on a missing path is still no-match (absence, not difference)
+        r = ds.query("ev", "jsonPath('$.missing', props) <> 'x'")
+        assert r.count == 0
+
+    def test_combines_with_spatial(self, ds):
+        r = ds.query(
+            "ev",
+            "BBOX(geom, 0, 0, 2.5, 2.5) AND jsonPath('$.kind', props) = 'car'",
+        )
+        assert set(r.table.fids) == {"car1"}
+
+    def test_argument_orders_and_roundtrip(self, ds):
+        f1 = parse("jsonPath('$.kind', props) = 'bus'")
+        f2 = parse("jsonPath(props, '$.kind') = 'bus'")
+        assert f1 == f2
+        assert parse(ast.to_cql(f1)) == f1  # remote-shipping round-trip
+
+    def test_bad_path_errors(self, ds):
+        with pytest.raises(CQLError):
+            parse("jsonPath('nopath', props) = 1")
+        f = parse("jsonPath('$.a..b', props) = 1")
+        with pytest.raises(ValueError):
+            ds.query("ev", f)
+
+    def test_cross_type_never_matches(self, ds):
+        # string literal vs numeric json value: no match, no crash
+        assert ds.query("ev", "jsonPath('$.speed', props) = '12.5'").count == 0
+
+    def test_bool_does_not_match_int(self, ds2=None):
+        store = DataStore()
+        store.create_schema(parse_spec("b", "props:String,*geom:Point"))
+        store.write("b", [
+            {"props": '{"flag": true, "n": 1}', "geom": Point(1.0, 1.0)},
+        ], fids=["r"])
+        assert store.query("b", "jsonPath('$.flag', props) = 1").count == 0
+        assert store.query("b", "jsonPath('$.n', props) = 1").count == 1
+
+    def test_explain_and_merged_accept_filters(self, ds):
+        from geomesa_tpu.store.merged import MergedDataStoreView
+
+        f = parse("jsonPath('$.kind', props) = 'car'")
+        assert "JsonPathCompare" in ds.explain("ev", f)
+        view = MergedDataStoreView([ds])
+        assert set(view.query("ev", f).table.fids) == {"car1", "car2"}
