@@ -827,7 +827,12 @@ class DataStore:
             q = Query(filter=q)
         planner = QueryPlanner(st.sft, st.indices, st.stats)
         _, _, info = planner.plan(q)
-        return info.explain()
+        out = info.explain()
+        if st.delta.rows:
+            # intervals above cover the SORTED main tier only; pending hot-
+            # tier rows are brute-forced at query time until compact()
+            out += f"\n  Hot tier (unsorted, merged at query time): {st.delta.rows} rows"
+        return out
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------
     def stats_count(self, type_name: str, cql=None, exact: bool = False):
