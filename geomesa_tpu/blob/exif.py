@@ -1,0 +1,167 @@
+"""EXIF GPS extraction: geo-locate JPEG blobs from their own metadata.
+
+Role parity: the reference blobstore's file handlers
+(``geomesa-blobstore`` EXIF/GDAL handler modules, SURVEY.md §2.8) derive a
+blob's footprint from the file itself. This is a dependency-free parser of
+just enough JPEG/TIFF structure to read the EXIF GPS IFD: APP1 segment →
+TIFF header (either endianness) → IFD0 → GPS IFD → latitude/longitude
+rationals (+ optional timestamp), returning a Point and epoch millis.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from geomesa_tpu.geometry.types import Point
+
+__all__ = ["exif_gps", "put_jpeg"]
+
+_TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 7: 1, 9: 4, 10: 8}
+
+
+def _find_app1(data: bytes) -> bytes | None:
+    """The Exif APP1 payload (after the 'Exif\\0\\0' marker), or None."""
+    if data[:2] != b"\xff\xd8":  # SOI
+        return None
+    pos = 2
+    while pos + 4 <= len(data):
+        if data[pos] != 0xFF:
+            return None
+        # JPEG B.1.1.2: any number of 0xFF fill bytes may precede a marker
+        while pos + 4 <= len(data) and data[pos + 1] == 0xFF:
+            pos += 1
+        if pos + 4 > len(data):
+            return None
+        marker = data[pos + 1]
+        if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
+            pos += 2
+            continue
+        (seg_len,) = struct.unpack_from(">H", data, pos + 2)
+        if marker == 0xE1 and data[pos + 4 : pos + 10] == b"Exif\x00\x00":
+            return data[pos + 10 : pos + 2 + seg_len]
+        if marker == 0xDA:  # start of scan: no more metadata segments
+            return None
+        pos += 2 + seg_len
+    return None
+
+
+def _read_ifd(tiff: bytes, offset: int, endian: str) -> dict[int, tuple]:
+    """tag → (type, count, value_or_offset_bytes) for one IFD."""
+    out: dict[int, tuple] = {}
+    if offset + 2 > len(tiff):
+        return out
+    (n,) = struct.unpack_from(endian + "H", tiff, offset)
+    pos = offset + 2
+    for _ in range(n):
+        if pos + 12 > len(tiff):
+            break
+        tag, typ, count = struct.unpack_from(endian + "HHI", tiff, pos)
+        out[tag] = (typ, count, tiff[pos + 8 : pos + 12])
+        pos += 12
+    return out
+
+def _value_offset(entry: tuple, endian: str) -> int:
+    return struct.unpack(endian + "I", entry[2])[0]
+
+
+def _rationals(tiff: bytes, entry: tuple, endian: str) -> list[float]:
+    typ, count, raw = entry
+    if typ not in (5, 10):
+        return []
+    off = _value_offset(entry, endian)
+    out = []
+    for i in range(count):
+        base = off + 8 * i
+        if base + 8 > len(tiff):
+            return []
+        num, den = struct.unpack_from(endian + ("II" if typ == 5 else "ii"), tiff, base)
+        out.append(num / den if den else 0.0)
+    return out
+
+
+def _ascii(tiff: bytes, entry: tuple, endian: str) -> str:
+    typ, count, raw = entry
+    if count <= 4:
+        data = raw[:count]
+    else:
+        off = _value_offset(entry, endian)
+        data = tiff[off : off + count]
+    return data.split(b"\x00")[0].decode("ascii", "replace")
+
+
+def exif_gps(data: bytes):
+    """JPEG bytes → (Point(lon, lat), epoch_ms | None), or None if no GPS.
+
+    Timestamp combines GPSDateStamp (tag 0x1D) + GPSTimeStamp (0x07) when
+    both are present (UTC per the EXIF spec).
+    """
+    tiff = _find_app1(data)
+    if tiff is None or len(tiff) < 8:
+        return None
+    if tiff[:2] == b"II":
+        endian = "<"
+    elif tiff[:2] == b"MM":
+        endian = ">"
+    else:
+        return None
+    (ifd0_off,) = struct.unpack_from(endian + "I", tiff, 4)
+    ifd0 = _read_ifd(tiff, ifd0_off, endian)
+    gps_entry = ifd0.get(0x8825)  # GPS IFD pointer
+    if gps_entry is None:
+        return None
+    gps = _read_ifd(tiff, _value_offset(gps_entry, endian), endian)
+    try:
+        lat_ref = _ascii(tiff, gps[0x01], endian)
+        lat_dms = _rationals(tiff, gps[0x02], endian)
+        lon_ref = _ascii(tiff, gps[0x03], endian)
+        lon_dms = _rationals(tiff, gps[0x04], endian)
+    except KeyError:
+        return None
+    if len(lat_dms) < 3 or len(lon_dms) < 3:
+        return None
+    lat = lat_dms[0] + lat_dms[1] / 60 + lat_dms[2] / 3600
+    lon = lon_dms[0] + lon_dms[1] / 60 + lon_dms[2] / 3600
+    if lat_ref.upper().startswith("S"):
+        lat = -lat
+    if lon_ref.upper().startswith("W"):
+        lon = -lon
+    if abs(lon) > 180 or abs(lat) > 90:
+        return None
+
+    ts_ms = None
+    if 0x1D in gps and 0x07 in gps:
+        try:
+            date = _ascii(tiff, gps[0x1D], endian)  # "YYYY:MM:DD"
+            hms = _rationals(tiff, gps[0x07], endian)
+            y, m, d = (int(p) for p in date.split(":"))
+            import datetime
+
+            ts_ms = int(
+                datetime.datetime(
+                    y, m, d, int(hms[0]), int(hms[1]), int(hms[2]),
+                    tzinfo=datetime.timezone.utc,
+                ).timestamp() * 1000
+            )
+        except (ValueError, IndexError):
+            ts_ms = None
+    return Point(lon, lat), ts_ms
+
+
+def put_jpeg(blobstore, data: bytes | str, filename: str | None = None,
+             dtg_ms: int | None = None) -> str:
+    """Store a JPEG, footprint derived from its EXIF GPS tags (handler role).
+
+    Raises ValueError when the image carries no GPS metadata; ``dtg_ms``
+    overrides (or supplies, when EXIF lacks a GPS timestamp) the date.
+    """
+    from geomesa_tpu.blob.store import normalize_payload
+
+    data, filename = normalize_payload(data, filename)
+    got = exif_gps(data)
+    if got is None:
+        raise ValueError("no EXIF GPS metadata; pass geometry to put() instead")
+    point, exif_ms = got
+    when = dtg_ms if dtg_ms is not None else exif_ms
+    if when is None:
+        raise ValueError("no timestamp: EXIF lacks GPSDate/TimeStamp; pass dtg_ms")
+    return blobstore.put(data, point, when, filename=filename)

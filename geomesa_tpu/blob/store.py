@@ -21,6 +21,18 @@ _SPEC = "filename:String,dtg:Date,*geom:Geometry"
 _TYPE = "geomesa_blobs"
 
 
+def normalize_payload(data, filename: str | None) -> tuple[bytes, str]:
+    """(bytes-or-path, filename?) → (bytes, filename) — shared by put() and
+    the file handlers (blob/exif.py)."""
+    if isinstance(data, (str, Path)):
+        p = Path(data)
+        filename = filename or p.name
+        data = p.read_bytes()
+    if filename is None:
+        raise ValueError("filename required when passing raw bytes")
+    return data, filename
+
+
 class BlobStore:
     """Blobs (bytes or files) + a queryable spatial metadata feature each.
 
@@ -51,12 +63,7 @@ class BlobStore:
         filename: str | None = None,
     ) -> str:
         """Store bytes (or a file path) with its footprint; returns the id."""
-        if isinstance(data, str):
-            p = Path(data)
-            filename = filename or p.name
-            data = p.read_bytes()
-        if filename is None:
-            raise ValueError("filename required when passing raw bytes")
+        data, filename = normalize_payload(data, filename)
         blob_id = uuid.uuid4().hex
         self.store.write(
             _TYPE,

@@ -1,0 +1,120 @@
+"""EXIF GPS blob handler: parse hand-built JPEGs, geo-locate into the store."""
+
+import struct
+
+import pytest
+
+from geomesa_tpu.blob.exif import exif_gps, put_jpeg
+from geomesa_tpu.blob.store import BlobStore
+
+
+def _rat(n, d=1):
+    return struct.pack("<II", n, d)
+
+
+def make_jpeg(lat=(48, 8, 30.0), lat_ref=b"N", lon=(11, 34, 12.0),
+              lon_ref=b"E", with_time=True, endian="<"):
+    """Minimal JPEG: SOI + Exif APP1 (TIFF, IFD0 → GPS IFD) + EOI."""
+    e = endian
+
+    def u16(v):
+        return struct.pack(e + "H", v)
+
+    def u32(v):
+        return struct.pack(e + "I", v)
+
+    # layout (offsets relative to TIFF header start):
+    #  0: TIFF header (8)
+    #  8: IFD0: count(2) + 1 entry(12) + next(4) = 18  -> GPS ptr
+    # 26: GPS IFD: count(2) + N entries(12 each) + next(4)
+    # then value area (rationals/strings)
+    n_gps = 4 + (2 if with_time else 0)
+    gps_off = 26
+    val_off = gps_off + 2 + 12 * n_gps + 4
+
+    def rat3(vals, off):
+        data = b""
+        for v in vals:
+            num = int(round(v * 10000))
+            data += struct.pack(e + "II", num, 10000)
+        return data, off
+
+    vals = b""
+    entries = b""
+
+    def entry(tag, typ, count, value_bytes=None, inline=None):
+        nonlocal vals, entries
+        if inline is not None:
+            entries += u16(tag) + u16(typ) + u32(count) + inline.ljust(4, b"\x00")
+        else:
+            off = val_off + len(vals)
+            entries += u16(tag) + u16(typ) + u32(count) + u32(off)
+            vals += value_bytes
+
+    entry(0x01, 2, 2, inline=lat_ref + b"\x00")          # GPSLatitudeRef
+    entry(0x02, 5, 3, value_bytes=rat3(lat, 0)[0])       # GPSLatitude
+    entry(0x03, 2, 2, inline=lon_ref + b"\x00")          # GPSLongitudeRef
+    entry(0x04, 5, 3, value_bytes=rat3(lon, 0)[0])       # GPSLongitude
+    if with_time:
+        entry(0x07, 5, 3, value_bytes=rat3((10, 30, 0), 0)[0])  # GPSTimeStamp
+        entry(0x1D, 2, 11, value_bytes=b"2021:05:01\x00")       # GPSDateStamp
+
+    gps_ifd = u16(n_gps) + entries + u32(0)
+    ifd0 = u16(1) + (u16(0x8825) + u16(4) + u32(1) + u32(gps_off)) + u32(0)
+    tiff = (b"II" if e == "<" else b"MM") + u16(42) + u32(8) + ifd0 + gps_ifd + vals
+    app1_payload = b"Exif\x00\x00" + tiff
+    app1 = b"\xff\xe1" + struct.pack(">H", len(app1_payload) + 2) + app1_payload
+    return b"\xff\xd8" + app1 + b"\xff\xd9"
+
+
+class TestExifParse:
+    def test_gps_and_time(self):
+        data = make_jpeg()
+        point, ts = exif_gps(data)
+        assert point.x == pytest.approx(11 + 34 / 60 + 12 / 3600, abs=1e-4)
+        assert point.y == pytest.approx(48 + 8 / 60 + 30 / 3600, abs=1e-4)
+        # 2021-05-01T10:30:00Z
+        assert ts == 1619865000000
+
+    def test_hemispheres(self):
+        point, _ = exif_gps(make_jpeg(lat_ref=b"S", lon_ref=b"W"))
+        assert point.x < 0 and point.y < 0
+
+    def test_big_endian_tiff(self):
+        point, ts = exif_gps(make_jpeg(endian=">"))
+        assert point.y == pytest.approx(48.1417, abs=1e-3)
+
+    def test_no_gps_returns_none(self):
+        assert exif_gps(b"\xff\xd8\xff\xd9") is None
+        assert exif_gps(b"not a jpeg") is None
+
+
+class TestBlobHandler:
+    def test_put_jpeg_geolocates(self):
+        bs = BlobStore()
+        blob_id = put_jpeg(bs, make_jpeg(), filename="photo.jpg")
+        ids = bs.query_ids("BBOX(geom, 11, 48, 12, 49)")
+        assert [i for i, _ in ids] == [blob_id]
+        data, meta = bs.get(blob_id)
+        assert meta["filename"] == "photo.jpg"
+        assert bs.query_ids("BBOX(geom, -10, -10, -5, -5)") == []
+
+    def test_put_jpeg_without_gps_raises(self):
+        bs = BlobStore()
+        with pytest.raises(ValueError, match="GPS"):
+            put_jpeg(bs, b"\xff\xd8\xff\xd9", filename="x.jpg")
+
+    def test_no_timestamp_requires_dtg(self):
+        bs = BlobStore()
+        data = make_jpeg(with_time=False)
+        with pytest.raises(ValueError, match="timestamp"):
+            put_jpeg(bs, data, filename="x.jpg")
+        blob_id = put_jpeg(bs, data, filename="x.jpg", dtg_ms=1_600_000_000_000)
+        assert bs.get(blob_id)[1]["dtg"] == 1_600_000_000_000
+
+    def test_fill_bytes_before_marker(self):
+        """JPEG B.1.1.2: 0xFF fill bytes before a marker are legal."""
+        data = make_jpeg()
+        filled = data[:2] + b"\xff" + data[2:]  # fill byte before APP1
+        point, ts = exif_gps(filled)
+        assert point.y == pytest.approx(48.1417, abs=1e-3)
