@@ -84,13 +84,17 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     from geomesa_tpu.store.backends import TpuBackend
 
     st = ds._state(type_name)
+    # coherent snapshot: device residency, count, and permutations must all
+    # come from the same store generation (background compactions race)
+    main, indices, backend_state, _stats, delta_table = st.snapshot()
+    main_n = 0 if main is None else len(main)
     dev = index_name = None
     if isinstance(ds.backend, TpuBackend) and ds._device_available():
-        dev, index_name = TpuBackend.point_state(st.backend_state)
+        dev, index_name = TpuBackend.point_state(backend_state)
     if (
         dev is None
-        or st.delta.merged() is not None
-        or st.main_rows == 0
+        or delta_table is not None
+        or main_n == 0
         # TTL masking is injected per-query in query(); the device columns
         # still hold expired rows — take the exact per-point path
         or ds._age_off_ttl_ms(st.sft) is not None
@@ -103,7 +107,7 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     from geomesa_tpu.parallel.query import cached_batched_knn_step
 
     mesh = ds.backend._get_mesh()
-    kk = min(k, st.main_rows)
+    kk = min(k, main_n)
     step = cached_batched_knn_step(mesh, kk)
     qx = np.array([p.x for p in points], dtype=np.float32)
     qy = np.array([p.y for p in points], dtype=np.float32)
@@ -111,7 +115,7 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     c = dev.cols
     try:
         dists, pos = step(
-            c["x"], c["y"], jnp.int32(st.main_rows),
+            c["x"], c["y"], jnp.int32(main_n),
             jnp.asarray(qx), jnp.asarray(qy),
         )
         # materialize INSIDE the try: jax dispatch is async, so a dead
@@ -125,11 +129,11 @@ def knn_many(ds, type_name: str, points, k: int = 10):
         ds.metrics.counter("store.query.device_failovers").inc()
         return [knn(ds, type_name, p, k) for p in points]
     ds._note_device_ok()
-    perm = st.indices[index_name].perm
+    perm = indices[index_name].perm
     out = []
     for qi in range(len(points)):
         rows = perm[pos[qi]]
-        out.append((st.table.take(rows), dists[qi].astype(np.float64)))
+        out.append((main.take(rows), dists[qi].astype(np.float64)))
     return out
 
 

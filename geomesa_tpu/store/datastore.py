@@ -80,6 +80,39 @@ class _TypeState:
             from geomesa_tpu.store.delta import DeltaTier
 
             self.delta = DeltaTier()
+        import threading
+
+        # `lock` guards the coherent (table, indices, backend_state, stats,
+        # delta) swap vs concurrent readers — a background persister (lambda
+        # role) compacts while queries run, and a reader must never pair a
+        # new table with old index permutations. `mutate_lock` serializes the
+        # MUTATION pipelines end-to-end (compact / delete / age-off / schema
+        # evolution / recover): last-writer-wins swaps between concurrent
+        # mutators would otherwise lose updates.
+        self.lock = threading.RLock()
+        self.mutate_lock = threading.RLock()
+
+    def snapshot(self):
+        """Coherent read of the query-relevant state (one lock hold)."""
+        with self.lock:
+            return (
+                self.table,
+                self.indices,
+                self.backend_state,
+                self.stats,
+                self.delta.merged(),
+            )
+
+    def consume_snapshot(self):
+        """Mutator-side snapshot: state + the number of delta tables the
+        mutation will consume (call ONLY with ``mutate_lock`` held)."""
+        with self.lock:
+            return (
+                self.table,
+                self.indices,
+                self.delta.merged(),
+                len(self.delta.tables),
+            )
 
     @property
     def main_rows(self) -> int:
@@ -190,15 +223,22 @@ class DataStore:
         ok = True
         for name in names:
             st = self._types[name]
-            if st.table is None:
-                continue
-            try:
-                st.backend_state = self.backend.load(st.sft, st.table, st.indices)
-            except Exception as e:  # noqa: BLE001 — degrade, don't fail
-                if not self._is_device_error(e):
-                    raise
-                self._trip_device_circuit(e)
-                ok = False
+            # mutate_lock: a compaction swapping state mid-load would leave
+            # residency for a table that is no longer current
+            with st.mutate_lock:
+                with st.lock:
+                    table, indices = st.table, st.indices
+                if table is None:
+                    continue
+                try:
+                    loaded = self.backend.load(st.sft, table, indices)
+                    with st.lock:
+                        st.backend_state = loaded
+                except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                    if not self._is_device_error(e):
+                        raise
+                    self._trip_device_circuit(e)
+                    ok = False
         return ok
 
     # -- schema CRUD (MetadataBackedDataStore role) --------------------------
@@ -282,27 +322,29 @@ class DataStore:
         # rebuild, and any failure leaves the old state fully intact
         from geomesa_tpu.schema.columnar import null_column
 
-        delta_table = st.delta.merged()
-        parts = [t for t in (st.table, delta_table) if t is not None and len(t)]
-        base = FeatureTable.concat(parts) if len(parts) > 1 else (
-            parts[0] if parts else None
-        )
-        old_sft = st.sft
-        st.sft = new_sft
-        try:
+        with st.mutate_lock:
+            main, _, delta_table, n_tables = st.consume_snapshot()
+            parts = [t for t in (main, delta_table) if t is not None and len(t)]
+            base = FeatureTable.concat(parts) if len(parts) > 1 else (
+                parts[0] if parts else None
+            )
             if base is not None:
                 cols = dict(base.columns)
                 for a in appended:
                     cols[a.name] = null_column(a.type, len(base))
-                self._rebuild(st, FeatureTable(new_sft, base.fids, cols))
+                # sft swaps atomically WITH the rebuilt state: a concurrent
+                # query never pairs the evolved schema with old indices
+                self._rebuild(
+                    st, FeatureTable(new_sft, base.fids, cols),
+                    consumed_tables=n_tables, new_sft=new_sft,
+                )
             else:
-                st.table = None
-                st.indices = build_indices(new_sft)
-                st.backend_state = None
-                st.delta.clear()
-        except BaseException:
-            st.sft = old_sft  # _rebuild swaps only on success
-            raise
+                with st.lock:
+                    st.sft = new_sft
+                    st.table = None
+                    st.indices = build_indices(new_sft)
+                    st.backend_state = None
+                    st.delta.drop_first(n_tables)
         if rename_to and rename_to != type_name:
             self._types[rename_to] = self._types.pop(type_name)
             # interceptors scoped to the old name follow the rename
@@ -346,8 +388,10 @@ class DataStore:
             data = FeatureTable.from_records(st.sft, data, fids)
         self._validate(st.sft, data)
         self.metrics.counter("store.writes").inc(len(data))
-        st.delta.append(data)
-        if st.delta.should_compact(st.main_rows):
+        with st.lock:
+            st.delta.append(data)
+            compact_now = st.delta.should_compact(st.main_rows)
+        if compact_now:
             self.compact(type_name)
         return len(data)
 
@@ -407,42 +451,58 @@ class DataStore:
         """
         st = self._state(type_name)
         want = {str(f) for f in fids}
-        delta = st.delta.merged()
-        tables = [t for t in (st.table, delta) if t is not None and len(t)]
-        if not tables:
-            return 0
-        combined = tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
-        keep = np.array([str(f) not in want for f in combined.fids], dtype=bool)
-        removed = int((~keep).sum())
-        if removed == 0:
-            return 0
-        # _rebuild clears the delta only after the new state swaps in — a
-        # failed rebuild must not lose hot-tier rows
-        self._rebuild(st, combined.take(np.nonzero(keep)[0]))
-        return removed
+        with st.mutate_lock:
+            main, _, delta, n_tables = st.consume_snapshot()
+            tables = [t for t in (main, delta) if t is not None and len(t)]
+            if not tables:
+                return 0
+            combined = (
+                tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
+            )
+            keep = np.array(
+                [str(f) not in want for f in combined.fids], dtype=bool
+            )
+            removed = int((~keep).sum())
+            if removed == 0:
+                return 0
+            # the delta drops only after the new state swaps in — a failed
+            # rebuild must not lose hot-tier rows
+            self._rebuild(
+                st, combined.take(np.nonzero(keep)[0]), consumed_tables=n_tables
+            )
+            return removed
 
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
-        reload + stats rebuild). Atomic: state swaps only on success."""
+        reload + stats rebuild). Atomic: state swaps only on success, and
+        writes landing mid-compaction stay in the hot tier."""
         st = self._state(type_name)
-        delta = st.delta.merged()
-        if delta is None:
-            return
-        n_prev = st.main_rows
-        table = (
-            delta if st.table is None else FeatureTable.concat([st.table, delta])
-        )
-        self._rebuild(st, table, prev_indices=st.indices, n_prev=n_prev)
+        with st.mutate_lock:
+            main, prev_indices, delta, n_tables = st.consume_snapshot()
+            if delta is None:
+                return
+            n_prev = 0 if main is None else len(main)
+            table = delta if main is None else FeatureTable.concat([main, delta])
+            self._rebuild(
+                st, table, prev_indices=prev_indices, n_prev=n_prev,
+                consumed_tables=n_tables,
+            )
 
     def _rebuild(self, st: _TypeState, table: FeatureTable, prev_indices=None,
-                 n_prev: int = 0) -> None:
+                 n_prev: int = 0, consumed_tables: int = 0, new_sft=None) -> None:
         """Swap in a new main tier built from ``table`` (delta folded in).
 
         Indexes exposing ``merge_build`` fold a sorted delta into the
         already-sorted previous state linearly (LSM compaction, SURVEY.md
-        §2.11) instead of re-sorting everything.
+        §2.11) instead of re-sorting everything. ``consumed_tables`` is the
+        delta-table count the caller folded into ``table`` (from
+        :meth:`_TypeState.consume_snapshot`); only those first tables drop
+        from the hot tier, so writes landing during the rebuild survive.
+        ``new_sft`` swaps the schema atomically with the state (evolution).
+        Callers must hold ``st.mutate_lock``.
         """
-        indices = build_indices(st.sft)
+        sft = new_sft if new_sft is not None else st.sft
+        indices = build_indices(sft)
         for name, index in indices.items():
             prev = (prev_indices or {}).get(name)
             if prev is not None and n_prev > 0 and hasattr(index, "merge_build"):
@@ -450,7 +510,7 @@ class DataStore:
             else:
                 index.build(table)
         try:
-            backend_state = self.backend.load(st.sft, table, indices)
+            backend_state = self.backend.load(sft, table, indices)
         except Exception as e:  # noqa: BLE001 — write must not die with the device
             if not self._is_device_error(e):
                 raise
@@ -459,13 +519,16 @@ class DataStore:
             backend_state = None  # host paths serve until recover()
         from geomesa_tpu.stats.store_stats import StoreStats
 
-        stats = StoreStats(st.sft)
+        stats = StoreStats(sft)
         stats.rebuild(table, indices.get("z3"))
-        st.table = table
-        st.indices = indices
-        st.backend_state = backend_state
-        st.stats = stats
-        st.delta.clear()
+        with st.lock:
+            if new_sft is not None:
+                st.sft = new_sft
+            st.table = table
+            st.indices = indices
+            st.backend_state = backend_state
+            st.stats = stats
+            st.delta.drop_first(consumed_tables)
 
     # -- age-off (AgeOffIterator / DtgAgeOffIterator role) --------------------
     @staticmethod
@@ -489,22 +552,28 @@ class DataStore:
         import time as _time
 
         cutoff = (int(_time.time() * 1000) if now_ms is None else now_ms) - ttl
-        delta = st.delta.merged()
-        parts = [t for t in (st.table, delta) if t is not None]
-        table = FeatureTable.concat(parts) if len(parts) > 1 else parts[0]
-        keep = table.columns[st.sft.dtg_field].values >= cutoff
-        removed = int((~keep).sum())
-        if removed == 0:
-            return 0
-        if keep.any():
-            self._rebuild(st, table.take(np.nonzero(keep)[0]))
-        else:  # everything expired: reset to empty
-            st.table = None
-            st.indices = build_indices(st.sft)
-            st.backend_state = None
-            st.stats = None
-            st.delta.clear()
-        return removed
+        with st.mutate_lock:
+            main, _, delta, n_tables = st.consume_snapshot()
+            parts = [t for t in (main, delta) if t is not None]
+            if not parts:  # raced another maintenance pass that emptied it
+                return 0
+            table = FeatureTable.concat(parts) if len(parts) > 1 else parts[0]
+            keep = table.columns[st.sft.dtg_field].values >= cutoff
+            removed = int((~keep).sum())
+            if removed == 0:
+                return 0
+            if keep.any():
+                self._rebuild(
+                    st, table.take(np.nonzero(keep)[0]), consumed_tables=n_tables
+                )
+            else:  # everything expired: reset to empty
+                with st.lock:
+                    st.table = None
+                    st.indices = build_indices(st.sft)
+                    st.backend_state = None
+                    st.stats = None
+                    st.delta.drop_first(n_tables)
+            return removed
 
     @staticmethod
     def _validate(sft: FeatureType, table: FeatureTable) -> None:
@@ -589,42 +658,46 @@ class DataStore:
 
         def _scan_and_reduce():
             f = q.resolved_filter()
-            main_n = st.main_rows
+            # COHERENT state snapshot: a background compaction (lambda
+            # persister) must never let this query pair a new table with old
+            # index permutations or a stale device residency
+            main, indices, backend_state, stats, delta_table = st.snapshot()
+            main_n = 0 if main is None else len(main)
             if main_n == 0:
                 rows = np.empty(0, dtype=np.int64)
             elif isinstance(self.backend, OracleBackend):
                 # referee path: no planning, brute force
-                rows = self.backend.select(None, None, None, None, f, st.table)
+                rows = self.backend.select(None, None, None, None, f, main)
             else:
-                planner = QueryPlanner(st.sft, st.indices, st.stats)
+                planner = QueryPlanner(st.sft, indices, stats)
                 t0 = _time.perf_counter()
                 plan, f, plan_box["info"] = planner.plan(q)
                 plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
                 info = plan_box["info"]
                 # circuit open → don't touch the device; exact host scan
-                state = st.backend_state if self._device_available() else None
+                state = backend_state if self._device_available() else None
                 try:
                     if info.sub_plans:
                         # FilterSplitter union: scan each arm on its own index
                         # (full filter as residual keeps each arm exact), union
                         parts = [
                             self.backend.select(
-                                state, st.indices[n], p, e_c, f, st.table
+                                state, indices[n], p, e_c, f, main
                             )
                             for n, p, e_c in info.sub_plans
                         ]
                         rows = np.unique(np.concatenate(parts))
                     else:
-                        index = st.indices[info.index_name]
+                        index = indices[info.index_name]
                         rows = self.backend.select(
-                            state, index, plan, info.extraction, f, st.table,
+                            state, index, plan, info.extraction, f, main,
                         )
                 except Exception as e:  # noqa: BLE001 — failover, re-raise rest
                     if state is None or not self._is_device_error(e):
                         raise
                     self._trip_device_circuit(e)
                     self.metrics.counter("store.query.device_failovers").inc()
-                    rows = np.nonzero(f.mask(st.table))[0]
+                    rows = np.nonzero(f.mask(main))[0]
                 else:
                     if state is not None:
                         self._note_device_ok()
@@ -632,13 +705,12 @@ class DataStore:
 
             # hot-tier merge (LambdaQueryRunner role): brute-force the small
             # unsorted delta and append, row ids offset past the main tier
-            delta_table = st.delta.merged()
             if delta_table is not None:
                 dmask = f.mask(delta_table)
                 drows = np.nonzero(dmask)[0]
                 rows = np.concatenate([rows, drows + main_n])
 
-            table = _take_combined(st, delta_table, rows)
+            table = _take_combined(st.sft, main, main_n, delta_table, rows)
 
             # shared post-scan pipeline: visibility, sampling, aggregation
             # hints, sort/limit/projection/CRS (LocalQueryRunner shape)
@@ -693,17 +765,20 @@ class DataStore:
         def _exact(q):
             return self.query(type_name, q).count
 
+        # coherent snapshot vs a concurrent background compaction
+        main, _indices, backend_state, _stats, delta_table = st.snapshot()
+        main_n = 0 if main is None else len(main)
         dev = bbox_dev = None
         if isinstance(self.backend, TpuBackend) and self._device_available():
-            dev, _ = TpuBackend.point_state(st.backend_state)
+            dev, _ = TpuBackend.point_state(backend_state)
             if dev is None:
                 # extended-geometry store: loose counts are bbox overlaps
-                bbox_dev, _ = TpuBackend.bbox_state(st.backend_state)
+                bbox_dev, _ = TpuBackend.bbox_state(backend_state)
         if (
             not loose
             or (dev is None and bbox_dev is None)
-            or st.delta.merged() is not None
-            or st.main_rows == 0
+            or delta_table is not None
+            or main_n == 0
             # TTL masking is injected per-query in query(); loose counts
             # would include expired rows — take the exact path
             or self._age_off_ttl_ms(st.sft) is not None
@@ -763,7 +838,7 @@ class DataStore:
                         step(
                             c["xmin"], c["ymin"], c["xmax"], c["ymax"],
                             c["bins"], c["offs"],
-                            jnp.int32(st.main_rows),
+                            jnp.int32(main_n),
                             jnp.asarray(boxes), jnp.asarray(times),
                         )
                     )
@@ -773,7 +848,7 @@ class DataStore:
                     counts = np.asarray(
                         step(
                             c["x"], c["y"], c["bins"], c["offs"],
-                            jnp.int32(st.main_rows),
+                            jnp.int32(main_n),
                             jnp.asarray(boxes), jnp.asarray(times),
                         )
                     )
@@ -916,18 +991,17 @@ class DataStore:
         return self._stats(type_name).cardinality(attr)
 
 
-def _take_combined(st, delta_table, rows: np.ndarray) -> FeatureTable:
+def _take_combined(sft, main, main_n: int, delta_table, rows: np.ndarray) -> FeatureTable:
     """Materialize rows addressed in the virtual (main ++ delta) row space."""
-    main_n = st.main_rows
     parts = []
     main_sel = rows[rows < main_n]
     delta_sel = rows[rows >= main_n] - main_n
     if len(main_sel):
-        parts.append(st.table.take(main_sel))
+        parts.append(main.take(main_sel))
     if len(delta_sel):
         parts.append(delta_table.take(delta_sel))
     if not parts:
-        return FeatureTable.from_records(st.sft, [])
+        return FeatureTable.from_records(sft, [])
     return parts[0] if len(parts) == 1 else FeatureTable.concat(parts)
 
 

@@ -37,15 +37,30 @@ class DeltaTier:
         self.rows += len(table)
 
     def merged(self) -> FeatureTable | None:
-        if not self.tables:
+        """One table view of the tier, or None. PURE — does not consolidate
+        in place, so concurrent readers can never invalidate the count-based
+        consumption contract of :meth:`drop_first`."""
+        tables = list(self.tables)  # appends during iteration stay unseen
+        if not tables:
             return None
-        if len(self.tables) > 1:
-            self.tables = [FeatureTable.concat(self.tables)]
-        return self.tables[0]
+        return tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
 
     def clear(self) -> None:
         self.tables = []
         self.rows = 0
+
+    def drop_first(self, n: int) -> None:
+        """Remove the first ``n`` tables (the set a compaction consumed).
+
+        Appends always land at the END, so writes that arrived after the
+        consuming snapshot survive — a background persister must not lose
+        concurrent writes.
+        """
+        if n <= 0:
+            return
+        dropped = self.tables[:n]
+        self.tables = self.tables[n:]
+        self.rows -= sum(len(t) for t in dropped)
 
     def should_compact(self, main_rows: int) -> bool:
         if self.rows == 0:
