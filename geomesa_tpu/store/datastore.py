@@ -74,6 +74,7 @@ class _TypeState:
     backend_state: Any = None
     stats: Any = None  # StoreStats
     delta: Any = None  # DeltaTier (hot append buffer)
+    fid_seq: int = 0  # monotonic sequential-fid allocator (under `lock`)
 
     def __post_init__(self):
         if self.delta is None:
@@ -158,6 +159,11 @@ class DataStore:
         self.metrics.gauge("store.query.abandoned_running").fn = (
             _timeouts.abandoned_running
         )
+        import threading
+
+        # atomic schema-catalog mutation (create/delete/rename): a threaded
+        # REST server creates schemas concurrently
+        self._schema_lock = threading.Lock()
         # (scope type-name | None, fn(sft, query) -> query) pairs
         self._interceptors: list[tuple[str | None, Any]] = []
         # device-failure circuit breaker (failure detection/recovery, SURVEY
@@ -247,14 +253,16 @@ class DataStore:
             if spec is None:
                 raise ValueError("create_schema('name', 'spec string') requires a spec")
             sft = parse_spec(sft, spec)
-        if sft.name in self._types:
-            raise ValueError(f"schema already exists: {sft.name}")
         vis_field = sft.user_data.get("geomesa.vis.field")
         if vis_field and vis_field not in {a.name for a in sft.attributes}:
             raise ValueError(
                 f"geomesa.vis.field names unknown attribute {vis_field!r}"
             )
-        self._types[sft.name] = _TypeState(sft=sft, indices=build_indices(sft))
+        state = _TypeState(sft=sft, indices=build_indices(sft))
+        with self._schema_lock:  # atomic exists-check + insert
+            if sft.name in self._types:
+                raise ValueError(f"schema already exists: {sft.name}")
+            self._types[sft.name] = state
         return sft
 
     def update_schema(
@@ -346,12 +354,13 @@ class DataStore:
                     st.backend_state = None
                     st.delta.drop_first(n_tables)
         if rename_to and rename_to != type_name:
-            self._types[rename_to] = self._types.pop(type_name)
-            # interceptors scoped to the old name follow the rename
-            self._interceptors = [
-                (rename_to if scope == type_name else scope, fn)
-                for scope, fn in self._interceptors
-            ]
+            with self._schema_lock:
+                self._types[rename_to] = self._types.pop(type_name)
+                # interceptors scoped to the old name follow the rename
+                self._interceptors = [
+                    (rename_to if scope == type_name else scope, fn)
+                    for scope, fn in self._interceptors
+                ]
         return new_sft
 
     def get_schema(self, name: str) -> FeatureType:
@@ -361,7 +370,8 @@ class DataStore:
         return sorted(self._types)
 
     def delete_schema(self, name: str) -> None:
-        del self._types[name]
+        with self._schema_lock:
+            del self._types[name]
 
     def _state(self, name: str) -> _TypeState:
         if name not in self._types:
@@ -425,7 +435,12 @@ class DataStore:
                 ts[i] = _to_millis(t)
             if ok:
                 return list(z3_fids(lons, lats, ts, sft.z3_interval))
-        base = st.total_rows
+        with st.lock:
+            # monotonic per-type sequence: concurrent writers must never
+            # mint the same id (total_rows alone is a check-then-act race)
+            st.fid_seq = max(st.fid_seq, st.total_rows)
+            base = st.fid_seq
+            st.fid_seq += n
         return [f"{st.sft.name}.{base + i}" for i in range(n)]
 
     # -- query interceptors (QueryInterceptor.scala:27 role) ------------------

@@ -19,8 +19,14 @@ from dataclasses import dataclass, field
 class Counter:
     count: int = 0
 
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()  # threaded servers inc concurrently
+
     def inc(self, n: int = 1) -> None:
-        self.count += n
+        with self._lock:
+            self.count += n
 
 
 @dataclass
@@ -33,13 +39,21 @@ class Histogram:
     min: float = math.inf
     max: float = -math.inf
 
+    def __post_init__(self):
+        import threading
+
+        # Welford is a multi-field read-modify-write: interleaved updates
+        # from parallel requests corrupt mean/m2 without the lock
+        self._lock = threading.Lock()
+
     def update(self, v: float) -> None:
-        self.count += 1
-        d = v - self.mean
-        self.mean += d / self.count
-        self.m2 += d * (v - self.mean)
-        self.min = min(self.min, v)
-        self.max = max(self.max, v)
+        with self._lock:
+            self.count += 1
+            d = v - self.mean
+            self.mean += d / self.count
+            self.m2 += d * (v - self.mean)
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
 
     @property
     def stddev(self) -> float:

@@ -344,11 +344,24 @@ class GeoMesaApp:
         return 200, (m.snapshot() if m is not None else {}), "application/json"
 
 
-def serve(store, host: str = "127.0.0.1", port: int = 8080):
+def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True):
     """Run the API on wsgiref's simple server (dev/ops tool, not a prod WSGI
-    container — same posture as the reference's embedded servlets)."""
-    from wsgiref.simple_server import make_server
+    container — same posture as the reference's embedded servlets).
 
-    httpd = make_server(host, port, GeoMesaApp(store))
+    ``threads=True`` (default) handles requests concurrently — the store's
+    per-type snapshot/mutator locking makes parallel queries + background
+    compactions safe; pass False for single-threaded debugging.
+    """
+    import socketserver
+    from wsgiref.simple_server import WSGIServer, make_server
+
+    cls = WSGIServer
+    if threads:
+
+        class _ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
+            daemon_threads = True
+
+        cls = _ThreadingWSGIServer
+    httpd = make_server(host, port, GeoMesaApp(store), server_class=cls)
     print(f"geomesa-tpu REST on http://{host}:{port}/api")
     httpd.serve_forever()

@@ -150,3 +150,28 @@ class TestQueryVsCompaction:
         assert all(f"n{i}" in fids for i in range(1000, 2000))
         assert all(f"n{i}" in fids for i in range(2000, 3000))
         assert len(fids) == 2000
+
+    def test_concurrent_writes_unique_fids(self):
+        """Auto-generated sequential fids never collide across threads."""
+        sft = parse_spec("evt", SPEC)
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        errors: list = []
+
+        def writer():
+            try:
+                for _ in range(20):
+                    ds.write("evt", [{
+                        "name": "w", "dtg": T0, "geom": Point(1.0, 2.0)}])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=writer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors, errors[:2]
+        r = ds.query("evt", None)
+        assert r.count == 80
+        assert len(set(r.table.fids)) == 80  # no duplicate ids
