@@ -1,0 +1,73 @@
+"""Cross-process catalog locking (DistributedLocking role)."""
+
+import multiprocessing as mp
+import time
+
+import pytest
+
+from geomesa_tpu.utils.locks import LockTimeout, catalog_lock
+
+
+def _hold_lock(path, hold_s, started, release):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from geomesa_tpu.utils.locks import catalog_lock as cl
+
+    with cl(path):
+        started.set()
+        release.wait(hold_s)
+
+
+class TestCatalogLock:
+    def test_reentrant_sequential(self, tmp_path):
+        p = str(tmp_path / "cat")
+        with catalog_lock(p):
+            pass
+        with catalog_lock(p):  # released cleanly, reacquirable
+            pass
+        assert (tmp_path / "cat" / ".geomesa.lock").exists()
+
+    def test_cross_process_exclusion(self, tmp_path):
+        p = str(tmp_path / "cat")
+        ctx = mp.get_context("spawn")
+        started = ctx.Event()
+        release = ctx.Event()
+        proc = ctx.Process(target=_hold_lock, args=(p, 30.0, started, release))
+        proc.start()
+        try:
+            assert started.wait(60), "holder never acquired"
+            # the lock is genuinely held by the other PROCESS
+            with pytest.raises(LockTimeout):
+                with catalog_lock(p, timeout_s=0.3, poll_s=0.05):
+                    pass
+            release.set()
+            proc.join(timeout=30)
+            # and acquirable again once the holder exits
+            t0 = time.monotonic()
+            with catalog_lock(p, timeout_s=10.0):
+                pass
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            release.set()
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
+
+    def test_timeout_error_message(self, tmp_path):
+        p = str(tmp_path / "cat")
+        ctx = mp.get_context("spawn")
+        started = ctx.Event()
+        release = ctx.Event()
+        proc = ctx.Process(target=_hold_lock, args=(p, 30.0, started, release))
+        proc.start()
+        try:
+            assert started.wait(60)
+            with pytest.raises(LockTimeout, match="could not lock"):
+                with catalog_lock(p, timeout_s=0.2):
+                    pass
+        finally:
+            release.set()
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
