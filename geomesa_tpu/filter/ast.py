@@ -10,7 +10,7 @@ semantics the device kernels must match).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import re

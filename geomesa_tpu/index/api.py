@@ -13,7 +13,7 @@ candidate slots, not a BatchScanner RPC.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import ClassVar
 
 import numpy as np

@@ -13,7 +13,7 @@ these columns; Arrow IPC interchange is a zero-copy re-labeling
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Iterable
 
 import numpy as np

@@ -15,7 +15,6 @@ path (:mod:`geomesa_tpu.ops.join`).
 from __future__ import annotations
 
 import json
-import math
 
 import numpy as np
 

@@ -14,7 +14,6 @@ device-side update kernels (segment reductions) can share the layout.
 
 from __future__ import annotations
 
-import struct
 from dataclasses import dataclass, field
 
 import numpy as np

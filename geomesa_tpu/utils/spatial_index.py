@@ -10,7 +10,6 @@ they exist for low-latency point lookups on the host over mutating data.
 
 from __future__ import annotations
 
-import math
 from typing import Any, Iterator
 
 __all__ = ["SpatialIndex", "BucketIndex", "SizeSeparatedBucketIndex"]
