@@ -151,3 +151,73 @@ class TestCompiledMeshPath:
             assert got == want, f"{q}: {len(got ^ want)} rows differ"
         # no failover happened: the compiled path really served these
         assert tpu.metrics.counter("store.query.device_failovers").count == 0
+
+        # batched loose counts (fused Pallas scan) agree with exact counts
+        qs = ["BBOX(geom, -60, -40, 60, 40)", "BBOX(geom, 100, 20, 150, 60)"]
+        loose = tpu.count_many("evt", qs, loose=True)
+        exact = [oracle.query("evt", q).count for q in qs]
+        assert loose == exact, (loose, exact)
+
+        # density heatmap (MXU one-hot matmul): mass equals the exact count
+        from geomesa_tpu.planning.planner import Query as _Q
+
+        r = tpu.query("evt", _Q(
+            filter="BBOX(geom, -60, -40, 60, 40)",
+            hints={"density": {"bbox": (-60, -40, 60, 40),
+                               "width": 64, "height": 64}},
+        ))
+        assert r.density is not None
+        assert abs(float(r.density.sum()) - exact[0]) < 1e-3
+
+        # batched device KNN matches brute force
+        from geomesa_tpu.process.knn import knn_many
+
+        pts = [Point(10.0, 10.0), Point(-50.0, 20.0)]
+        got_knn = knn_many(tpu, "evt", pts, k=5)
+        g = tpu._state("evt").table.geom_column()
+        for p, (t_k, d_k) in zip(pts, got_knn):
+            d_all = np.sqrt(
+                (g.x - p.x).astype(np.float32) ** 2
+                + (g.y - p.y).astype(np.float32) ** 2
+            )
+            want_d = np.sort(d_all)[:5]
+            np.testing.assert_allclose(
+                np.sort(d_k), want_d, rtol=1e-3, atol=1e-4
+            )
+        assert tpu.metrics.counter("store.query.device_failovers").count == 0
+
+    def test_track_store_bbox_select_on_device(self, rng):
+        """Extended-geometry (XZ2) mesh retrieval on the real chip."""
+        from geomesa_tpu.geometry.types import LineString
+        from geomesa_tpu.schema.columnar import FeatureTable
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.store.datastore import DataStore
+
+        spec = "name:String,*geom:LineString;geomesa.xz.precision='12'"
+        sft = parse_spec("trk", spec)
+        recs = []
+        for i in range(20_000):
+            cx = float(rng.uniform(-170, 170))
+            cy = float(rng.uniform(-80, 80))
+            pts = np.stack(
+                [cx + np.linspace(0, 0.3, 5), cy + np.linspace(0, 0.2, 5)], 1
+            )
+            recs.append({"name": f"t{i}", "geom": LineString(pts)})
+        table = FeatureTable.from_records(
+            sft, recs, [f"t{i}" for i in range(20_000)]
+        )
+        tpu = DataStore(backend="tpu")
+        tpu.create_schema(sft)
+        tpu.write("trk", table)
+        oracle = DataStore(backend="oracle")
+        oracle.create_schema(parse_spec("trk", spec))
+        oracle.write("trk", table)
+        st = tpu._state("trk")
+        kinds = {k: getattr(v, "kind", None)
+                 for k, v in (st.backend_state or {}).items()}
+        assert "bboxes" in kinds.values()
+        q = "BBOX(geom, -20, -15, 10, 15)"
+        assert set(tpu.query("trk", q).table.fids) == set(
+            oracle.query("trk", q).table.fids
+        )
+        assert tpu.metrics.counter("store.query.device_failovers").count == 0
