@@ -105,23 +105,28 @@ def cmd_ingest(args):
         if args.name not in ds.list_schemas():
             ds.create_schema(gdelt_sft(args.name))
         conv = gdelt_converter(ds.get_schema(args.name))
-    elif args.converter in ("osm-nodes", "osm-ways"):
-        from geomesa_tpu.convert.osm import OsmConverter
+    elif args.converter and args.converter != "delimited":
+        # config file path, predefined dataset, or schema-inferring type name
+        from geomesa_tpu.convert.config import load_converter
 
-        conv = OsmConverter(
-            mode=args.converter.split("-")[1], type_name=args.name
-        )
-        if args.name not in ds.list_schemas():
-            ds.create_schema(conv.sft)
-    elif args.converter == "avro":
-        from geomesa_tpu.convert.avro_converter import AvroConverter
-
-        sft = (
+        existing = (
             ds.get_schema(args.name) if args.name in ds.list_schemas() else None
         )
-        conv = AvroConverter(sft=sft, type_name=args.name)
-        if sft is None:
-            ds.create_schema(conv.infer_from(args.files[0]))
+        conv = load_converter(args.converter, sft=existing, type_name=args.name)
+        if conv.sft is None:
+            conv.infer_from(args.files[0])
+        if existing is None:
+            ds.create_schema(conv.sft)
+        elif [(a.name, a.type) for a in conv.sft.attributes] != [
+            (a.name, a.type) for a in existing.attributes
+        ]:
+            # structural converters (gpx/osm/predefined) define their own
+            # layout — refuse to write it into a differently-shaped schema
+            raise SystemExit(
+                f"converter {args.converter!r} produces "
+                f"({conv.sft.to_spec()}) which does not match the existing "
+                f"schema {args.name!r} ({existing.to_spec()})"
+            )
     else:
         sft = ds.get_schema(args.name)
         fields = dict(kv.split("=", 1) for kv in (args.field or []))
@@ -403,7 +408,10 @@ def main(argv=None):
     common(sp)
     sp.add_argument(
         "--converter", default="delimited",
-        help="'gdelt', 'osm-nodes', 'osm-ways', 'avro', or 'delimited'",
+        help="'delimited' (use --field/--format flags), a converter-config "
+        ".json path, a predefined dataset (gdelt, geolife, tdrive, twitter, "
+        "nyctaxi, marinecadastre-ais), or a schema-inferring type: avro, "
+        "parquet, arrow, shapefile, gpx, gpx-points, osm-nodes, osm-ways",
     )
     sp.add_argument("--format", default="csv", choices=["csv", "tsv"])
     sp.add_argument("--field", action="append", help="attr=expression mapping")

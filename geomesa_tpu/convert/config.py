@@ -1,0 +1,215 @@
+"""Config-driven converter definitions (the HOCON converter-config role).
+
+The reference's ingest converters are *declarative*: a HOCON document names
+the converter type, the field transform expressions, and the options, and a
+factory builds the converter (``geomesa-convert-common/.../convert2/
+SimpleFeatureConverter.scala:26``, ``AbstractConverter``). This module is the
+same seam for this framework with JSON configs::
+
+    {
+      "type": "delimited-text",
+      "sft": "name:String,dtg:Date,*geom:Point",
+      "type-name": "example",
+      "id-field": "$1",
+      "fields": {"name": "$1", "dtg": "isodate($2)", "geom": "point($3, $4)"},
+      "options": {"delimiter": ",", "header": true, "error-mode": "skip"}
+    }
+
+Types: ``delimited-text`` (csv/tsv), ``fixed-width``, ``json``, ``xml``,
+``avro``, ``shapefile``, ``gpx``, ``osm``, ``parquet``, and ``predefined``
+(named dataset configs — the ``geomesa-tools/conf/sfts`` role). Converters
+that infer their own schema (avro/shapefile/parquet/osm/gpx) may omit "sft".
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from geomesa_tpu.schema.sft import FeatureType, parse_spec
+
+
+class ShapefileConverter:
+    """Converter facade over :func:`geomesa_tpu.convert.shapefile.read_shapefile`."""
+
+    def __init__(self, sft: FeatureType | None = None, type_name: str | None = None):
+        self.sft = sft
+        self.type_name = type_name
+        self.id_field = None  # row-number fids: CLI qualifies across files
+
+    def infer_from(self, path) -> FeatureType:
+        from geomesa_tpu.convert.shapefile import shapefile_sft
+
+        self.sft = shapefile_sft(self.type_name or Path(path).stem, path)
+        return self.sft
+
+    def convert_path(self, path, ctx=None):
+        from geomesa_tpu.convert.shapefile import read_shapefile
+
+        if self.sft is None:
+            self.infer_from(path)
+        t = read_shapefile(path, self.sft)
+        if ctx is not None:
+            ctx.success += len(t)
+        return t
+
+
+class GpxConverter:
+    """Converter facade over :func:`geomesa_tpu.convert.gpx.parse_gpx`."""
+
+    def __init__(self, as_points: bool = False, type_name: str | None = None):
+        from geomesa_tpu.convert.gpx import gpx_point_sft, gpx_track_sft
+
+        self.as_points = bool(as_points)
+        self.sft = (
+            gpx_point_sft(type_name or "gpx_points")
+            if self.as_points
+            else gpx_track_sft(type_name or "gpx_tracks")
+        )
+        # track fids are stable trk-N per file only; qualify across files
+        self.id_field = None
+
+    def convert_path(self, path, ctx=None):
+        from geomesa_tpu.convert.gpx import parse_gpx
+
+        t = parse_gpx(path, as_points=self.as_points)
+        if self.sft.name != t.sft.name:
+            t.sft = self.sft  # same attribute layout, caller-chosen name
+        if ctx is not None:
+            ctx.success += len(t)
+        return t
+
+
+def _sft_of(cfg: dict, sft: FeatureType | None) -> FeatureType | None:
+    if sft is not None:
+        return sft
+    spec = cfg.get("sft")
+    if spec is None:
+        return None
+    name = cfg.get("type-name") or cfg.get("type_name") or "features"
+    if isinstance(spec, dict):  # {"name": ..., "spec": ...}
+        return parse_spec(spec.get("name", name), spec["spec"])
+    return parse_spec(name, spec)
+
+
+def converter_from_config(
+    cfg: dict, sft: FeatureType | None = None, type_name: str | None = None
+):
+    """Build a converter from a config dict. ``sft`` overrides cfg["sft"];
+    ``type_name`` (e.g. the CLI schema name) overrides cfg["type-name"]."""
+    typ = cfg.get("type")
+    if not typ:
+        raise ValueError("converter config needs a 'type'")
+    typ = typ.replace("_", "-")
+    opts = dict(cfg.get("options", {}))
+    fields = dict(cfg.get("fields", {}))
+    id_field = cfg.get("id-field") or cfg.get("id_field")
+    error_mode = opts.pop("error-mode", opts.pop("error_mode", "skip"))
+    type_name = type_name or cfg.get("type-name") or cfg.get("type_name")
+    if type_name:
+        cfg = dict(cfg, **{"type-name": type_name})
+    resolved = _sft_of(cfg, sft)
+
+    def need_sft() -> FeatureType:
+        if resolved is None:
+            raise ValueError(f"converter type {typ!r} requires an 'sft'")
+        return resolved
+
+    if typ == "predefined":
+        from geomesa_tpu.convert.predefined import predefined_converter
+
+        return predefined_converter(cfg["name"], type_name)
+    if typ in ("gpx", "gpx-points"):
+        return GpxConverter(
+            as_points=typ == "gpx-points"
+            or bool(opts.pop("as-points", opts.pop("as_points", False))),
+            type_name=type_name,
+        )
+    if typ in ("delimited-text", "delimited", "csv", "tsv"):
+        from geomesa_tpu.convert.delimited import DelimitedConverter
+
+        delim = opts.pop("delimiter", "\t" if typ == "tsv" else ",")
+        return DelimitedConverter(
+            need_sft(), fields, id_field=id_field, delimiter=delim,
+            header=bool(opts.pop("header", False)), error_mode=error_mode,
+        )
+    if typ == "fixed-width":
+        from geomesa_tpu.convert.fixed_width import FixedWidthConverter
+
+        slices = [tuple(s) for s in opts.pop("slices")]
+        return FixedWidthConverter(
+            need_sft(), slices, fields, id_field=id_field, error_mode=error_mode
+        )
+    if typ == "json":
+        from geomesa_tpu.convert.json_converter import JsonConverter
+
+        return JsonConverter(
+            need_sft(), fields,
+            feature_path=opts.pop("feature-path", opts.pop("feature_path", "$")),
+            id_field=id_field, error_mode=error_mode,
+        )
+    if typ == "xml":
+        from geomesa_tpu.convert.xml_converter import XmlConverter
+
+        return XmlConverter(
+            need_sft(), fields,
+            feature_path=opts.pop(
+                "feature-path", opts.pop("feature_path", ".//feature")
+            ),
+            id_field=id_field, error_mode=error_mode,
+        )
+    if typ == "avro":
+        from geomesa_tpu.convert.avro_converter import AvroConverter
+
+        return AvroConverter(
+            sft=resolved, rename=opts.pop("rename", None), type_name=type_name
+        )
+    if typ == "shapefile":
+        return ShapefileConverter(sft=resolved, type_name=type_name)
+    if typ == "osm":
+        from geomesa_tpu.convert.osm import OsmConverter
+
+        return OsmConverter(
+            mode=opts.pop("mode", "nodes"),
+            tag_fields=tuple(opts.pop("tag-fields", opts.pop("tag_fields", ()))),
+            tagged_only=bool(opts.pop("tagged-only", opts.pop("tagged_only", False))),
+            type_name=type_name,
+        )
+    if typ in ("parquet", "arrow"):
+        from geomesa_tpu.convert.parquet_converter import ParquetConverter
+
+        return ParquetConverter(sft=resolved, type_name=type_name)
+    raise ValueError(f"unknown converter type: {typ!r}")
+
+
+def load_converter(
+    name_or_path: str,
+    sft: FeatureType | None = None,
+    type_name: str | None = None,
+):
+    """Resolve a CLI ``--converter`` value: a JSON config file path, a
+    predefined dataset name, or a bare converter type name. ``type_name``
+    names the target schema (overriding any config/inferred name)."""
+    from geomesa_tpu.convert.predefined import PREDEFINED, predefined_converter
+
+    p = Path(name_or_path)
+    if p.suffix == ".json" or (p.is_file() and name_or_path not in PREDEFINED):
+        with open(p, encoding="utf-8") as f:
+            return converter_from_config(json.load(f), sft, type_name)
+    if name_or_path in PREDEFINED:
+        return predefined_converter(name_or_path, type_name)
+    # bare type name: only schema-inferring types make sense without a config
+    if name_or_path in ("avro", "shapefile", "parquet", "arrow", "gpx",
+                        "gpx-points", "osm-nodes", "osm-ways"):
+        if name_or_path.startswith("osm-"):
+            from geomesa_tpu.convert.osm import OsmConverter
+
+            return OsmConverter(
+                mode=name_or_path.split("-")[1], type_name=type_name
+            )
+        return converter_from_config({"type": name_or_path}, sft, type_name)
+    raise ValueError(
+        f"unknown converter {name_or_path!r}: expected a config file path, a "
+        f"predefined dataset ({', '.join(sorted(PREDEFINED))}), or one of "
+        "avro/shapefile/parquet/arrow/gpx/gpx-points/osm-nodes/osm-ways"
+    )

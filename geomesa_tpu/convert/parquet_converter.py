@@ -1,0 +1,141 @@
+"""Parquet / Arrow-IPC file ingest (the ``geomesa-convert-parquet`` role).
+
+The reference ships a Parquet converter module inside ``geomesa-convert``
+(SURVEY.md §2.16) that reads SimpleFeatures back out of the FS-storage
+Parquet layout (``geomesa-fs-storage-parquet/.../SimpleFeatureParquetSchema.scala``).
+Here the equivalent is direct: our canonical Arrow mapping (:mod:`geomesa_tpu.io.arrow`)
+already defines the column layout, so ingest is ``read file → pa.Table →
+from_arrow``, plus writer-schema → SFT inference so files can be ingested
+without a pre-declared schema (the ``TypeInference`` role for columnar files).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pyarrow as pa
+
+from geomesa_tpu.convert.delimited import EvaluationContext
+from geomesa_tpu.io.arrow import from_arrow
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import AttributeDescriptor, AttributeType, FeatureType
+
+_ARROW_SCALAR = {
+    pa.int8(): AttributeType.INT,
+    pa.int16(): AttributeType.INT,
+    pa.int32(): AttributeType.INT,
+    pa.int64(): AttributeType.LONG,
+    pa.float32(): AttributeType.FLOAT,
+    pa.float64(): AttributeType.DOUBLE,
+    pa.bool_(): AttributeType.BOOLEAN,
+    pa.string(): AttributeType.STRING,
+    pa.large_string(): AttributeType.STRING,
+    pa.binary(): AttributeType.BYTES,
+    pa.large_binary(): AttributeType.BYTES,
+}
+
+
+def _attr_type(f: pa.Field) -> AttributeType | None:
+    t = f.type
+    if isinstance(t, pa.DictionaryType):
+        t = t.value_type
+    if pa.types.is_fixed_size_list(t) and t.list_size == 2 and pa.types.is_floating(
+        t.value_type
+    ):
+        return AttributeType.POINT
+    if f.metadata and f.metadata.get(b"geom") == b"wkt":
+        return AttributeType.GEOMETRY
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        return AttributeType.DATE
+    return _ARROW_SCALAR.get(t)
+
+
+def infer_sft_from_arrow(schema: pa.Schema, type_name: str) -> FeatureType:
+    """Arrow schema → SFT. Unmappable columns are skipped (nested lists etc.)."""
+    attrs = []
+    for f in schema:
+        if f.name == "__fid__":
+            continue
+        at = _attr_type(f)
+        if at is not None:
+            attrs.append(AttributeDescriptor(f.name, at))
+    if not attrs:
+        raise ValueError(f"no ingestible columns in arrow schema: {schema.names}")
+    return FeatureType(type_name, attrs)
+
+
+def _normalize(at: pa.Table, sft: FeatureType) -> pa.Table:
+    """Cast date-typed columns to timestamp[ms] so ``from_arrow`` sees the
+    canonical layout regardless of the writer's timestamp unit."""
+    for i, name in enumerate(at.column_names):
+        if name in sft and sft.attr(name).type == AttributeType.DATE:
+            col = at.column(i)
+            if col.type != pa.timestamp("ms"):
+                at = at.set_column(
+                    i, pa.field(name, pa.timestamp("ms")),
+                    col.cast(pa.timestamp("ms")),
+                )
+    return at
+
+
+def read_columnar(path, sft: FeatureType | None = None, type_name: str | None = None):
+    """Read one .parquet / .arrow(.ipc/feather) file → (FeatureTable, sft)."""
+    p = Path(path)
+    if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
+        try:
+            with pa.ipc.open_file(p) as r:
+                at = r.read_all()
+        except pa.ArrowInvalid:  # stream-format file with a file extension
+            with pa.ipc.open_stream(p.read_bytes()) as r:
+                at = r.read_all()
+    else:
+        import pyarrow.parquet as pq
+
+        at = pq.read_table(p)
+    if sft is None:
+        sft = infer_sft_from_arrow(at.schema, type_name or p.stem)
+    return from_arrow(sft, _normalize(at, sft)), sft
+
+
+class ParquetConverter:
+    """Converter facade (``convert_path``/``.sft``/``.id_field``) over
+    :func:`read_columnar`, so columnar files plug into the CLI ingest path
+    exactly like the delimited/JSON/XML/Avro converters."""
+
+    def __init__(self, sft: FeatureType | None = None, type_name: str | None = None):
+        self.sft = sft
+        self.type_name = type_name
+        # row fids come from __fid__ when present (stable across files);
+        # set per file in convert_path, mirroring AvroConverter
+        self.id_field: str | None = "__fid__"
+
+    def infer_from(self, path) -> FeatureType:
+        p = Path(path)
+        if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
+            _, sft = read_columnar(p, None, self.type_name)
+        else:
+            import pyarrow.parquet as pq
+
+            sft = infer_sft_from_arrow(
+                pq.read_schema(p), self.type_name or p.stem
+            )
+        self.sft = sft
+        return sft
+
+    def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        if self.sft is None:
+            self.infer_from(path)
+        table, _ = read_columnar(path, self.sft, self.type_name)
+        self.id_field = "__fid__" if self._has_fids(path) else None
+        if ctx is not None:
+            ctx.success += len(table)
+        return table
+
+    @staticmethod
+    def _has_fids(path) -> bool:
+        p = Path(path)
+        if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
+            return True  # our IPC writers always embed __fid__
+        import pyarrow.parquet as pq
+
+        return "__fid__" in pq.read_schema(p).names

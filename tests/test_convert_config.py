@@ -1,0 +1,298 @@
+"""Config-driven converter SPI + parquet/arrow ingest (reference: HOCON
+converter configs, ``convert2/SimpleFeatureConverter.scala:26``, and the
+geomesa-convert parquet module — SURVEY.md §2.16)."""
+
+import json
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from geomesa_tpu.convert.config import converter_from_config, load_converter
+from geomesa_tpu.convert.delimited import EvaluationContext
+from geomesa_tpu.convert.parquet_converter import ParquetConverter, read_columnar
+from geomesa_tpu.geometry import LineString, Point
+from geomesa_tpu.io.arrow import to_arrow
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import AttributeType, parse_spec
+
+T0 = 1_498_867_200_000
+
+
+def table(n=20, spec="name:String,age:Integer,dtg:Date,*geom:Point", name="t"):
+    sft = parse_spec(name, spec)
+    recs = [
+        {
+            "name": f"n{i}",
+            "age": int(i),
+            "dtg": T0 + i * 1000,
+            "geom": Point(float(i % 10), float(i % 5)),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)])
+
+
+class TestConverterConfig:
+    def test_delimited_config(self, tmp_path):
+        cfg = {
+            "type": "delimited-text",
+            "sft": "name:String,dtg:Date,*geom:Point",
+            "type-name": "pts",
+            "id-field": "$1",
+            "fields": {
+                "name": "$1",
+                "dtg": "isodate($2)",
+                "geom": "point($3, $4)",
+            },
+            "options": {"delimiter": ",", "header": True},
+        }
+        f = tmp_path / "d.csv"
+        f.write_text(
+            "name,when,lon,lat\n"
+            "alpha,2017-07-01T00:00:00Z,10.5,20.5\n"
+            "beta,2017-07-02T00:00:00Z,-5.0,3.25\n"
+        )
+        conv = converter_from_config(cfg)
+        t = conv.convert_path(str(f))
+        assert len(t) == 2
+        assert t.fids.tolist() == ["alpha", "beta"]
+        assert t.record(1)["geom"] == Point(-5.0, 3.25)
+
+    def test_json_config(self, tmp_path):
+        cfg = {
+            "type": "json",
+            "sft": "name:String,*geom:Point",
+            "fields": {"name": "$.props.name", "geom": "geojson($.geometry)"},
+            "options": {"feature-path": "$.features[*]"},
+        }
+        doc = {
+            "features": [
+                {
+                    "props": {"name": "a"},
+                    "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+                },
+                {
+                    "props": {"name": "b"},
+                    "geometry": {"type": "Point", "coordinates": [3.0, 4.0]},
+                },
+            ]
+        }
+        f = tmp_path / "j.json"
+        f.write_text(json.dumps(doc))
+        t = converter_from_config(cfg).convert_path(str(f))
+        assert len(t) == 2
+        assert t.record(0)["name"] == "a"
+        assert t.record(1)["geom"] == Point(3.0, 4.0)
+
+    def test_xml_config(self, tmp_path):
+        cfg = {
+            "type": "xml",
+            "sft": "name:String,*geom:Point",
+            "fields": {"name": "nm", "geom": "point(x, y)"},
+            "options": {"feature-path": ".//row"},
+        }
+        f = tmp_path / "x.xml"
+        f.write_text(
+            "<data><row><nm>a</nm><x>1</x><y>2</y></row>"
+            "<row><nm>b</nm><x>3</x><y>4</y></row></data>"
+        )
+        t = converter_from_config(cfg).convert_path(str(f))
+        assert len(t) == 2
+        assert t.record(1)["name"] == "b"
+
+    def test_fixed_width_config(self, tmp_path):
+        cfg = {
+            "type": "fixed-width",
+            "sft": "code:String,*geom:Point",
+            "fields": {"code": "$1", "geom": "point($2, $3)"},
+            "options": {"slices": [[0, 3], [3, 6], [9, 6]]},
+        }
+        f = tmp_path / "fw.txt"
+        f.write_text("AAA 10.5  20.5\nBBB -5.25  3.75\n")
+        t = converter_from_config(cfg).convert_path(str(f))
+        assert len(t) == 2
+        assert t.record(0)["code"] == "AAA"
+        assert t.record(1)["geom"] == Point(-5.25, 3.75)
+
+    def test_predefined_by_name(self):
+        conv = load_converter("nyctaxi")
+        assert conv.sft.attr("tripId").type == AttributeType.STRING
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError, match="unknown converter type"):
+            converter_from_config({"type": "cobol"})
+        with pytest.raises(ValueError, match="unknown converter"):
+            load_converter("not-a-thing")
+
+    def test_missing_sft(self):
+        with pytest.raises(ValueError, match="requires an 'sft'"):
+            converter_from_config({"type": "json", "fields": {}})
+
+
+class TestParquetIngest:
+    def test_parquet_roundtrip_with_inference(self, tmp_path):
+        t = table(30)
+        f = tmp_path / "t.parquet"
+        pq.write_table(to_arrow(t, dictionary_encode=False), f)
+        conv = ParquetConverter()
+        ctx = EvaluationContext()
+        t2 = conv.convert_path(str(f), ctx)
+        assert ctx.success == 30
+        assert conv.id_field == "__fid__"
+        assert [a.name for a in conv.sft.attributes] == [
+            "name", "age", "dtg", "geom",
+        ]
+        assert conv.sft.attr("geom").type == AttributeType.POINT
+        assert conv.sft.default_geom == "geom"
+        for i in (0, 13, 29):
+            assert t2.record(i) == t.record(i)
+        assert t2.fids.tolist() == t.fids.tolist()
+
+    def test_parquet_dictionary_and_declared_sft(self, tmp_path):
+        t = table(10)
+        f = tmp_path / "t.parquet"
+        pq.write_table(to_arrow(t, dictionary_encode=True), f)
+        t2, sft = read_columnar(f, t.sft)
+        assert sft is t.sft
+        assert t2.record(7)["name"] == "n7"
+
+    def test_arrow_ipc_file(self, tmp_path):
+        import pyarrow as pa
+
+        t = table(12)
+        f = tmp_path / "t.arrow"
+        at = to_arrow(t)
+        with pa.ipc.new_file(str(f), at.schema) as w:
+            w.write_table(at)
+        t2, sft = read_columnar(f)
+        assert len(t2) == 12
+        assert t2.record(3) == t.record(3)
+
+    def test_extended_geometry_column(self, tmp_path):
+        sft = parse_spec("lines", "name:String,*geom:LineString")
+        recs = [
+            {"name": "l0", "geom": LineString([(0, 0), (1, 1), (2, 0)])},
+            {"name": "l1", "geom": LineString([(5, 5), (6, 7)])},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        f = tmp_path / "l.parquet"
+        pq.write_table(to_arrow(t), f)
+        t2, inferred = read_columnar(f)
+        assert inferred.attr("geom").type == AttributeType.GEOMETRY
+        assert t2.record(0)["geom"].bbox == t.record(0)["geom"].bbox
+
+    def test_timestamp_unit_normalization(self, tmp_path):
+        import pyarrow as pa
+
+        at = pa.table(
+            {
+                "dtg": pa.array([T0 * 1000, (T0 + 5000) * 1000]).cast(
+                    pa.timestamp("us")
+                ),
+                "geom": pa.FixedSizeListArray.from_arrays(
+                    pa.array([1.0, 2.0, 3.0, 4.0]), 2
+                ),
+            }
+        )
+        f = tmp_path / "us.parquet"
+        pq.write_table(at, f)
+        t, sft = read_columnar(f, type_name="us_pts")
+        assert sft.attr("dtg").type == AttributeType.DATE
+        assert t.columns["dtg"].values.tolist() == [T0, T0 + 5000]
+
+
+class TestCliIngestFormats:
+    def _run(self, *argv):
+        from geomesa_tpu.cli.__main__ import main
+
+        main(list(argv))
+
+    def test_cli_parquet_ingest(self, tmp_path, capsys):
+        t = table(25)
+        f = tmp_path / "t.parquet"
+        pq.write_table(to_arrow(t), f)
+        cat = str(tmp_path / "cat")
+        self._run(
+            "ingest", "-c", cat, "-n", "pts", "--converter", "parquet",
+            "--backend", "oracle", str(f),
+        )
+        assert "ingested 25" in capsys.readouterr().out
+        self._run(
+            "export", "-c", cat, "-n", "pts", "--backend", "oracle",
+            "-q", "age < 5", "--format", "json",
+        )
+        out = capsys.readouterr().out
+        assert len(out.strip().splitlines()) == 5
+
+    def test_cli_config_file_ingest(self, tmp_path, capsys):
+        cfg = {
+            "type": "delimited-text",
+            "sft": "name:String,dtg:Date,*geom:Point",
+            "id-field": "$1",
+            "fields": {
+                "name": "$1",
+                "dtg": "isodate($2)",
+                "geom": "point($3, $4)",
+            },
+        }
+        cfgf = tmp_path / "conv.json"
+        cfgf.write_text(json.dumps(cfg))
+        f = tmp_path / "d.csv"
+        f.write_text("a,2017-07-01T00:00:00Z,1,2\nb,2017-07-02T00:00:00Z,3,4\n")
+        cat = str(tmp_path / "cat")
+        self._run(
+            "ingest", "-c", cat, "-n", "pts", "--converter", str(cfgf),
+            "--backend", "oracle", str(f),
+        )
+        assert "ingested 2" in capsys.readouterr().out
+
+    def test_cli_predefined_ingest(self, tmp_path, capsys):
+        f = tmp_path / "taxi.csv"
+        f.write_text(
+            "T1,2017-07-01 00:00:00,x,2,1.5,10.0,-73.98,40.75\n"
+            "T2,2017-07-01 00:05:00,x,1,2.5,12.0,-73.99,40.76\n"
+        )
+        cat = str(tmp_path / "cat")
+        self._run(
+            "ingest", "-c", cat, "-n", "taxi", "--converter", "nyctaxi",
+            "--backend", "oracle", str(f),
+        )
+        assert "ingested 2" in capsys.readouterr().out
+
+    def test_cli_structural_mismatch_refused(self, tmp_path):
+        # a pre-existing schema with a different layout must not be silently
+        # relabeled by a structural converter's output (gpx defines its own)
+        cat = str(tmp_path / "cat")
+        self._run(
+            "create-schema", "-c", cat, "-n", "tracks",
+            "--spec", "label:String,severity:Integer,*geom:Point",
+        )
+        f = tmp_path / "a.gpx"
+        f.write_text(
+            '<gpx xmlns="http://www.topografix.com/GPX/1/1"><trk><trkseg>'
+            '<trkpt lat="1" lon="2"/><trkpt lat="1.1" lon="2.1"/>'
+            "</trkseg></trk></gpx>"
+        )
+        with pytest.raises(SystemExit, match="does not match"):
+            self._run(
+                "ingest", "-c", cat, "-n", "tracks", "--converter", "gpx",
+                "--backend", "oracle", str(f),
+            )
+
+    def test_cli_gpx_ingest(self, tmp_path, capsys):
+        gpx = (
+            '<gpx xmlns="http://www.topografix.com/GPX/1/1"><trk><name>r</name>'
+            "<trkseg>"
+            '<trkpt lat="45.0" lon="7.0"><time>2017-07-01T00:00:00Z</time></trkpt>'
+            '<trkpt lat="45.1" lon="7.1"><time>2017-07-01T00:01:00Z</time></trkpt>'
+            "</trkseg></trk></gpx>"
+        )
+        f = tmp_path / "a.gpx"
+        f.write_text(gpx)
+        cat = str(tmp_path / "cat")
+        self._run(
+            "ingest", "-c", cat, "-n", "tracks", "--converter", "gpx",
+            "--backend", "oracle", str(f),
+        )
+        assert "ingested 1" in capsys.readouterr().out
