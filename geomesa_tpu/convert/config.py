@@ -192,15 +192,20 @@ def load_converter(
     names the target schema (overriding any config/inferred name)."""
     from geomesa_tpu.convert.predefined import PREDEFINED, predefined_converter
 
+    _BARE = ("avro", "shapefile", "parquet", "arrow", "gpx", "gpx-points",
+             "osm-nodes", "osm-ways")
     p = Path(name_or_path)
-    if p.suffix == ".json" or (p.is_file() and name_or_path not in PREDEFINED):
+    # known names always win: a stray local file called "avro" must not be
+    # mistaken for a config document
+    if name_or_path not in PREDEFINED and name_or_path not in _BARE and (
+        p.suffix == ".json" or p.is_file()
+    ):
         with open(p, encoding="utf-8") as f:
             return converter_from_config(json.load(f), sft, type_name)
     if name_or_path in PREDEFINED:
         return predefined_converter(name_or_path, type_name)
     # bare type name: only schema-inferring types make sense without a config
-    if name_or_path in ("avro", "shapefile", "parquet", "arrow", "gpx",
-                        "gpx-points", "osm-nodes", "osm-ways"):
+    if name_or_path in _BARE:
         if name_or_path.startswith("osm-"):
             from geomesa_tpu.convert.osm import OsmConverter
 

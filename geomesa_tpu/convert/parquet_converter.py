@@ -78,20 +78,27 @@ def _normalize(at: pa.Table, sft: FeatureType) -> pa.Table:
     return at
 
 
+def _is_ipc(p: Path) -> bool:
+    return p.suffix in (".arrow", ".ipc", ".arrows", ".feather")
+
+
+def _load_arrow(p: Path) -> pa.Table:
+    if _is_ipc(p):
+        try:
+            with pa.ipc.open_file(p) as r:
+                return r.read_all()
+        except pa.ArrowInvalid:  # stream-format file with a file extension
+            with pa.ipc.open_stream(p.read_bytes()) as r:
+                return r.read_all()
+    import pyarrow.parquet as pq
+
+    return pq.read_table(p)
+
+
 def read_columnar(path, sft: FeatureType | None = None, type_name: str | None = None):
     """Read one .parquet / .arrow(.ipc/feather) file → (FeatureTable, sft)."""
     p = Path(path)
-    if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
-        try:
-            with pa.ipc.open_file(p) as r:
-                at = r.read_all()
-        except pa.ArrowInvalid:  # stream-format file with a file extension
-            with pa.ipc.open_stream(p.read_bytes()) as r:
-                at = r.read_all()
-    else:
-        import pyarrow.parquet as pq
-
-        at = pq.read_table(p)
+    at = _load_arrow(p)
     if sft is None:
         sft = infer_sft_from_arrow(at.schema, type_name or p.stem)
     return from_arrow(sft, _normalize(at, sft)), sft
@@ -109,33 +116,34 @@ class ParquetConverter:
         # set per file in convert_path, mirroring AvroConverter
         self.id_field: str | None = "__fid__"
 
+    def _schema(self, p: Path) -> pa.Schema:
+        if _is_ipc(p):
+            try:
+                with pa.ipc.open_file(p) as r:
+                    return r.schema
+            except pa.ArrowInvalid:
+                with pa.ipc.open_stream(p.read_bytes()) as r:
+                    return r.schema
+        import pyarrow.parquet as pq
+
+        return pq.read_schema(p)
+
     def infer_from(self, path) -> FeatureType:
         p = Path(path)
-        if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
-            _, sft = read_columnar(p, None, self.type_name)
-        else:
-            import pyarrow.parquet as pq
-
-            sft = infer_sft_from_arrow(
-                pq.read_schema(p), self.type_name or p.stem
-            )
-        self.sft = sft
-        return sft
+        self.sft = infer_sft_from_arrow(self._schema(p), self.type_name or p.stem)
+        return self.sft
 
     def convert_path(self, path, ctx: EvaluationContext | None = None) -> FeatureTable:
+        at = _load_arrow(Path(path))
         if self.sft is None:
-            self.infer_from(path)
-        table, _ = read_columnar(path, self.sft, self.type_name)
-        self.id_field = "__fid__" if self._has_fids(path) else None
+            self.sft = infer_sft_from_arrow(
+                at.schema, self.type_name or Path(path).stem
+            )
+        # files without an embedded __fid__ get per-file row-number fids,
+        # which collide across files — id_field=None tells multi-file
+        # ingest to qualify them
+        self.id_field = "__fid__" if "__fid__" in at.schema.names else None
+        table = from_arrow(self.sft, _normalize(at, self.sft))
         if ctx is not None:
             ctx.success += len(table)
         return table
-
-    @staticmethod
-    def _has_fids(path) -> bool:
-        p = Path(path)
-        if p.suffix in (".arrow", ".ipc", ".arrows", ".feather"):
-            return True  # our IPC writers always embed __fid__
-        import pyarrow.parquet as pq
-
-        return "__fid__" in pq.read_schema(p).names

@@ -357,3 +357,168 @@ class Z3Histogram(Stat):
     @property
     def total(self) -> int:
         return int(sum(arr.sum() for arr in self.counts.values()))
+
+
+@dataclass
+class Z3Frequency(Stat):
+    """Count-min sketch over (time-bin, coarse z3-cell) keys — approximate
+    spatio-temporal frequency in sublinear space (``Z3Frequency.scala``).
+
+    Where :class:`Z3Histogram` stores one exact array per time bin (memory
+    grows with bin count), this folds every (bin, cell) key into one fixed
+    ``depth × width`` CMS, so long-lived stores can keep selectivity stats
+    over unbounded time spans."""
+
+    bits: int = 12  # coarse cell = top `bits` of the z3 code
+    depth: int = 4
+    width: int = 1 << 12
+    table: np.ndarray = None  # type: ignore[assignment]
+    _seeds: np.ndarray = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.table is None:
+            self.table = np.zeros((self.depth, self.width), dtype=np.int64)
+        if self._seeds is None:
+            self._seeds = np.arange(1, self.depth + 1, dtype=np.uint64) * np.uint64(
+                0x9E3779B97F4A7C15
+            )
+
+    def _keys(self, bins, zs) -> np.ndarray:
+        shift = np.uint64(63 - self.bits)
+        cells = zs.astype(np.uint64) >> shift
+        return (bins.astype(np.uint64) << np.uint64(self.bits + 1)) | cells
+
+    def _hashes(self, keys: np.ndarray) -> np.ndarray:
+        out = np.empty((self.depth, len(keys)), dtype=np.int64)
+        for d in range(self.depth):
+            x = keys * self._seeds[d]
+            x ^= x >> np.uint64(31)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            out[d] = (x % np.uint64(self.width)).astype(np.int64)
+        return out
+
+    def observe_binned(self, bins: np.ndarray, zs: np.ndarray) -> None:
+        if len(bins) == 0:
+            return
+        h = self._hashes(self._keys(np.asarray(bins), np.asarray(zs)))
+        for d in range(self.depth):
+            np.add.at(self.table[d], h[d], 1)
+
+    def observe(self, values):  # pragma: no cover - use observe_binned
+        raise NotImplementedError("use observe_binned(bins, zs)")
+
+    def count(self, b: int, cell: int) -> int:
+        """Point estimate for one (bin, coarse-cell) key (CMS upper bound)."""
+        key = (np.uint64(b) << np.uint64(self.bits + 1)) | np.uint64(cell)
+        h = self._hashes(np.array([key], dtype=np.uint64))
+        return int(min(self.table[d, h[d, 0]] for d in range(self.depth)))
+
+    def estimate_zranges(self, b: int, zranges) -> float:
+        """Estimated rows in a bin covered by inclusive z ranges."""
+        shift = 63 - self.bits
+        cells = set()
+        for zlo, zhi in zranges:
+            cells.update(range(int(zlo) >> shift, (int(zhi) >> shift) + 1))
+        return float(sum(self.count(b, c) for c in cells))
+
+    def merge(self, other):
+        assert (self.depth, self.width, self.bits) == (
+            other.depth, other.width, other.bits,
+        )
+        return Z3Frequency(
+            self.bits, self.depth, self.width, self.table + other.table, self._seeds
+        )
+
+
+@dataclass
+class GroupBy(Stat):
+    """Per-group sub-sketches (``GroupBy.scala``): one sketch per distinct
+    grouping value, each the same mergeable kind."""
+
+    factory: object = None  # () -> Stat
+    groups: dict = field(default_factory=dict)
+
+    def observe_groups(self, keys, values) -> None:
+        keys = np.asarray(keys, dtype=object)
+        values = np.asarray(values)
+        for k in set(keys.tolist()):
+            sub = self.groups.get(k)
+            if sub is None:
+                sub = self.groups[k] = self.factory()
+            sub.observe(values[keys == k])
+
+    def observe(self, values):  # pragma: no cover - use observe_groups
+        raise NotImplementedError("use observe_groups(keys, values)")
+
+    def merge(self, other):
+        assert type(self.factory()) is type(other.factory())  # noqa: E721
+        import copy
+
+        # deep-copy both sides: merged output must not alias live partials
+        # (every other sketch's merge returns fully owned state)
+        out = GroupBy(
+            self.factory, {k: copy.deepcopy(v) for k, v in self.groups.items()}
+        )
+        for k, sub in other.groups.items():
+            out.groups[k] = (
+                copy.deepcopy(sub)
+                if k not in out.groups
+                else out.groups[k].merge(sub)
+            )
+        return out
+
+
+@dataclass
+class CovarianceStats(Stat):
+    """Streaming multivariate mean/covariance (the reference
+    ``DescriptiveStats`` tracks incremental covariance across attributes).
+
+    State: count, d-vector mean, d×d comoment matrix; merged with the
+    parallel (Chan et al.) update, so per-shard partials combine exactly."""
+
+    dims: int = 2
+    count: int = 0
+    mean: np.ndarray = None  # type: ignore[assignment]
+    comoment: np.ndarray = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.mean is None:
+            self.mean = np.zeros(self.dims, dtype=np.float64)
+        if self.comoment is None:
+            self.comoment = np.zeros((self.dims, self.dims), dtype=np.float64)
+
+    def observe(self, values):
+        v = np.asarray(values, dtype=np.float64).reshape(-1, self.dims)
+        v = v[np.isfinite(v).all(axis=1)]
+        if len(v) == 0:
+            return
+        mean_b = v.mean(axis=0)
+        dev = v - mean_b
+        self._combine(len(v), mean_b, dev.T @ dev)
+
+    def _combine(self, n_b: int, mean_b: np.ndarray, c_b: np.ndarray) -> None:
+        n_a = self.count
+        n = n_a + n_b
+        if n == 0:
+            return
+        delta = mean_b - self.mean
+        self.mean = self.mean + delta * (n_b / n)
+        self.comoment = (
+            self.comoment + c_b + np.outer(delta, delta) * (n_a * n_b / n)
+        )
+        self.count = n
+
+    @property
+    def covariance(self) -> np.ndarray:
+        if self.count < 2:
+            return np.zeros((self.dims, self.dims))
+        return self.comoment / (self.count - 1)
+
+    def merge(self, other):
+        assert self.dims == other.dims
+        out = CovarianceStats(
+            self.dims, self.count, self.mean.copy(), self.comoment.copy()
+        )
+        out._combine(other.count, other.mean, other.comoment)
+        return out

@@ -2,7 +2,13 @@
 
 The ``Stat.apply`` parser role (``geomesa-utils/.../utils/stats/Stat.scala``,
 SURVEY.md §2.18): semicolon-separated constructors, attribute names optionally
-quoted. Used by stats query hints and the CLI ``stats-analyze`` commands.
+quoted; a multi-stat spec is the ``SeqStat`` role. Used by stats query hints
+and the CLI ``stats-analyze`` commands. Grouped and spatio-temporal stats::
+
+    GroupBy(category, MinMax(age))      one sub-sketch per distinct value
+    Stats(age, score)                   multivariate mean/covariance
+    Z3Histogram(geom, dtg)              exact per-bin coarse-cell counts
+    Z3Frequency(geom, dtg)              CMS over (bin, cell) keys
 """
 
 from __future__ import annotations
@@ -15,67 +21,150 @@ from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.stats.sketches import (
     Cardinality,
     CountStat,
+    CovarianceStats,
     DescriptiveStats,
     EnumerationStat,
     Frequency,
+    GroupBy,
     Histogram,
     MinMax,
     TopK,
+    Z3Frequency,
+    Z3Histogram,
 )
 
-_CALL = re.compile(r"^\s*(\w+)\s*\(\s*([^)]*)\s*\)\s*$")
+_CALL = re.compile(r"^\s*(\w+)\s*\(\s*(.*?)\s*\)\s*$", re.S)
+
+
+def _split_top(s: str, sep: str) -> list[str]:
+    """Split on ``sep`` outside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in (p.strip() for p in parts) if p]
 
 
 def _args(argstr: str) -> list[str]:
-    return [a.strip().strip("'\"") for a in argstr.split(",") if a.strip()]
+    return [a.strip().strip("'\"") for a in _split_top(argstr, ",")]
 
 
-def parse_stats(spec: str) -> list[tuple[str, str | None, object]]:
-    """Spec → list of (label, attribute|None, sketch instance)."""
+def parse_stats(spec: str) -> list[tuple[str, list[str], object]]:
+    """Spec → list of (label, args, sketch instance)."""
     out = []
-    for part in spec.split(";"):
-        part = part.strip()
-        if not part:
-            continue
+    for part in _split_top(spec, ";"):
         m = _CALL.match(part)
         if not m:
             raise ValueError(f"invalid stat spec: {part!r}")
         name = m.group(1).lower()
         args = _args(m.group(2))
-        attr = args[0] if args else None
         if name == "count":
-            out.append((part, None, CountStat()))
+            out.append((part, [], CountStat()))
         elif name == "minmax":
-            out.append((part, attr, MinMax()))
+            out.append((part, args, MinMax()))
         elif name == "topk":
-            out.append((part, attr, TopK(int(args[1]) if len(args) > 1 else 10)))
+            out.append((part, args, TopK(int(args[1]) if len(args) > 1 else 10)))
         elif name == "enumeration":
-            out.append((part, attr, EnumerationStat()))
+            out.append((part, args, EnumerationStat()))
         elif name == "frequency":
-            out.append((part, attr, Frequency()))
+            out.append((part, args, Frequency()))
         elif name == "cardinality":
-            out.append((part, attr, Cardinality()))
+            out.append((part, args, Cardinality()))
         elif name == "histogram":
             bins = int(args[1]) if len(args) > 1 else 20
             lo = float(args[2]) if len(args) > 2 else 0.0
             hi = float(args[3]) if len(args) > 3 else 1.0
-            out.append((part, attr, Histogram(lo, hi, bins)))
+            out.append((part, args, Histogram(lo, hi, bins)))
         elif name in ("descriptivestats", "stats"):
-            out.append((part, attr, DescriptiveStats()))
+            if len(args) > 1:
+                out.append((part, args, CovarianceStats(dims=len(args))))
+            else:
+                out.append((part, args, DescriptiveStats()))
+        elif name == "groupby":
+            if len(args) != 2:
+                raise ValueError(f"GroupBy needs (attribute, SubStat(...)): {part!r}")
+            sub_spec = args[1]
+            parse_stats(sub_spec)  # validate eagerly
+            out.append(
+                (part, args, GroupBy(lambda s=sub_spec: parse_stats(s)[0][2]))
+            )
+        elif name == "z3histogram":
+            bits = int(args[2]) if len(args) > 2 else 12
+            out.append((part, args, Z3Histogram(bits=bits)))
+        elif name == "z3frequency":
+            bits = int(args[2]) if len(args) > 2 else 12
+            out.append((part, args, Z3Frequency(bits=bits)))
         else:
             raise ValueError(f"unknown stat: {name!r}")
     return out
 
 
+def _bins_and_zs(table: FeatureTable, args: list[str], sel: np.ndarray):
+    """(geom, dtg) columns → (time bins, z3 codes) over valid selected rows."""
+    from geomesa_tpu.curve.binned_time import BinnedTime
+    from geomesa_tpu.curve.sfc import z3_sfc
+
+    sft = table.sft
+    geom = args[0] if args else sft.geom_field
+    dtg = args[1] if len(args) > 1 else sft.dtg_field
+    if geom is None or dtg is None:
+        raise ValueError("z3 stats need geometry and date attributes")
+    col = table.columns[geom]
+    dcol = table.columns[dtg]
+    ok = sel & col.is_valid() & dcol.is_valid()
+    if hasattr(col, "x"):
+        xs, ys = col.x[ok], col.y[ok]
+    else:  # extended geometries: bbox centers
+        xs = (col.bounds[ok, 0] + col.bounds[ok, 2]) / 2
+        ys = (col.bounds[ok, 1] + col.bounds[ok, 3]) / 2
+    t_ms = np.asarray(dcol.values[ok], dtype=np.int64)
+    binned = BinnedTime(sft.z3_interval)
+    bins, offs = binned.to_bin_and_offset(t_ms)
+    return bins, z3_sfc(sft.z3_interval).index(xs, ys, offs)
+
+
+def _observe(table: FeatureTable, args: list[str], sketch, sel: np.ndarray) -> None:
+    """Feed the selected rows into one sketch (recursive for GroupBy)."""
+    if isinstance(sketch, (Z3Histogram, Z3Frequency)):
+        bins, zs = _bins_and_zs(table, args, sel)
+        sketch.observe_binned(bins, zs)
+    elif isinstance(sketch, GroupBy):
+        key_attr, sub_spec = args
+        _, sub_args, _ = parse_stats(sub_spec)[0]
+        kcol = table.columns[key_attr]
+        ok = sel & kcol.is_valid()
+        keys = kcol.values
+        for k in set(keys[ok].tolist()):
+            sub = sketch.groups.get(k)
+            if sub is None:
+                sub = sketch.groups[k] = sketch.factory()
+            _observe(table, sub_args, sub, ok & (keys == k))
+    elif isinstance(sketch, CovarianceStats):
+        ok = sel.copy()
+        for a in args:
+            ok &= table.columns[a].is_valid()
+        cols = [np.asarray(table.columns[a].values, np.float64)[ok] for a in args]
+        sketch.observe(np.stack(cols, axis=1))
+    elif not args:
+        sketch.observe(np.arange(int(sel.sum())))
+    else:
+        col = table.columns[args[0]]
+        sketch.observe(col.values[sel & col.is_valid()])
+
+
 def compute_stats(table: FeatureTable, spec: str) -> dict[str, object]:
     """Evaluate a stat spec over a result table → {label: sketch}."""
     out = {}
-    for label, attr, sketch in parse_stats(spec):
-        if attr is None:
-            sketch.observe(np.arange(len(table)))
-        else:
-            col = table.columns[attr]
-            vals = col.values[col.is_valid()]
-            sketch.observe(vals)
+    sel = np.ones(len(table), dtype=bool)
+    for label, args, sketch in parse_stats(spec):
+        _observe(table, args, sketch, sel)
         out[label] = sketch
     return out

@@ -290,13 +290,25 @@ class GeoMesaApp:
         if not spec:
             raise _HttpError(400, "missing ?stats= spec")
         r = self.store.query(name, Query(filter=params.get("cql"), hints={"stats": spec}))
-        out = {}
-        for label, sketch in (r.stats or {}).items():
-            d = {
-                k: v for k, v in vars(sketch).items()
-                if not k.startswith("_") and not callable(v)
-            }
-            out[label] = _jsonable(d)
+
+        def sketch_dict(s):
+            from geomesa_tpu.stats.sketches import Stat
+
+            d = {}
+            for k, v in vars(s).items():
+                if k.startswith("_") or callable(v):
+                    continue
+                if isinstance(v, Stat):
+                    v = sketch_dict(v)
+                elif isinstance(v, dict):
+                    v = {
+                        str(gk): sketch_dict(gv) if isinstance(gv, Stat) else gv
+                        for gk, gv in v.items()
+                    }
+                d[k] = v
+            return _jsonable(d)
+
+        out = {label: sketch_dict(s) for label, s in (r.stats or {}).items()}
         return 200, out, "application/json"
 
     def _stats_count(self, name, params, body):

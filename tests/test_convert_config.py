@@ -260,6 +260,44 @@ class TestCliIngestFormats:
         )
         assert "ingested 2" in capsys.readouterr().out
 
+    def test_multifile_arrow_without_fids_qualified(self, tmp_path, capsys):
+        # externally-written files with no __fid__ column: per-file row-number
+        # fids must be qualified, not silently collide/overwrite
+        import pyarrow as pa
+
+        for j in range(2):
+            at = pa.table(
+                {
+                    "name": [f"file{j}-{i}" for i in range(10)],
+                    "geom": pa.FixedSizeListArray.from_arrays(
+                        pa.array(np.arange(20, dtype=np.float64) / 10), 2
+                    ),
+                }
+            )
+            with pa.ipc.new_file(str(tmp_path / f"f{j}.feather"), at.schema) as w:
+                w.write_table(at)
+        cat = str(tmp_path / "cat")
+        self._run(
+            "ingest", "-c", cat, "-n", "pts", "--converter", "arrow",
+            "--backend", "oracle",
+            str(tmp_path / "f0.feather"), str(tmp_path / "f1.feather"),
+        )
+        assert "ingested 20" in capsys.readouterr().out
+        self._run(
+            "stats-count", "-c", cat, "-n", "pts", "--backend", "oracle",
+        )
+        assert capsys.readouterr().out.strip() == "20"
+
+    def test_bare_name_beats_local_file(self, tmp_path, monkeypatch):
+        # a stray file named "avro" in cwd must not shadow the bare type
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "avro").write_text("not json at all")
+        from geomesa_tpu.convert.avro_converter import AvroConverter
+        from geomesa_tpu.convert.config import load_converter
+
+        conv = load_converter("avro")
+        assert isinstance(conv, AvroConverter)
+
     def test_cli_structural_mismatch_refused(self, tmp_path):
         # a pre-existing schema with a different layout must not be silently
         # relabeled by a structural converter's output (gpx defines its own)

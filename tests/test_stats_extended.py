@@ -1,0 +1,235 @@
+"""Extended stat sketches: GroupBy, Z3Frequency, multivariate covariance, and
+the grouped/z3 spec DSL (reference: ``GroupBy.scala``, ``Z3Frequency.scala``,
+``DescriptiveStats`` covariance — SURVEY.md §2.18)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.stats.sketches import (
+    CovarianceStats,
+    GroupBy,
+    MinMax,
+    Z3Frequency,
+    Z3Histogram,
+)
+from geomesa_tpu.stats.spec import compute_stats, parse_stats
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "cat:String,age:Integer,score:Double,dtg:Date,*geom:Point"
+
+
+def table(n=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("t", SPEC)
+    recs = [
+        {
+            "cat": f"c{i % 5}",
+            "age": int(rng.integers(0, 100)),
+            "score": float(rng.normal(50, 10)),
+            "dtg": int(T0 + rng.integers(0, 14 * 86_400_000)),
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(n)])
+
+
+class TestZ3Frequency:
+    def _bins_zs(self, n=5000, seed=7):
+        rng = np.random.default_rng(seed)
+        bins = rng.integers(0, 4, n).astype(np.int64)
+        zs = rng.integers(0, 1 << 63, n, dtype=np.int64).astype(np.uint64)
+        return bins, zs
+
+    def test_count_upper_bound(self):
+        bins, zs = self._bins_zs()
+        f = Z3Frequency(bits=8)
+        f.observe_binned(bins, zs)
+        cells = (zs >> np.uint64(63 - 8)).astype(np.int64)
+        # CMS never undercounts
+        for b in range(4):
+            for c in np.unique(cells[bins == b])[:20]:
+                true = int(((bins == b) & (cells == c)).sum())
+                assert f.count(b, int(c)) >= true
+
+    def test_merge_equals_combined(self):
+        bins, zs = self._bins_zs()
+        half = len(bins) // 2
+        a = Z3Frequency(bits=8)
+        a.observe_binned(bins[:half], zs[:half])
+        b = Z3Frequency(bits=8)
+        b.observe_binned(bins[half:], zs[half:])
+        whole = Z3Frequency(bits=8)
+        whole.observe_binned(bins, zs)
+        assert np.array_equal(a.merge(b).table, whole.table)
+
+    def test_estimate_zranges(self):
+        bins, zs = self._bins_zs()
+        f = Z3Frequency(bits=8)
+        f.observe_binned(bins, zs)
+        # whole domain in one bin ≈ that bin's row count (CMS overestimates)
+        est = f.estimate_zranges(0, [(0, (1 << 63) - 1)])
+        true = int((bins == 0).sum())
+        assert est >= true
+        assert est <= true * 3  # collisions bounded at this width
+
+
+class TestGroupBy:
+    def test_observe_and_merge(self):
+        keys = np.array(["a", "b", "a", "c", "b", "a"], dtype=object)
+        vals = np.array([1, 10, 3, 100, 20, 5])
+        g1 = GroupBy(lambda: MinMax())
+        g1.observe_groups(keys[:3], vals[:3])
+        g2 = GroupBy(lambda: MinMax())
+        g2.observe_groups(keys[3:], vals[3:])
+        m = g1.merge(g2)
+        assert set(m.groups) == {"a", "b", "c"}
+        assert (m.groups["a"].min, m.groups["a"].max) == (1, 5)
+        assert (m.groups["b"].min, m.groups["b"].max) == (10, 20)
+        assert (m.groups["c"].min, m.groups["c"].max) == (100, 100)
+
+    def test_merge_does_not_alias_partials(self):
+        a = GroupBy(lambda: MinMax())
+        a.observe_groups(np.array(["x"], dtype=object), np.array([5]))
+        b = GroupBy(lambda: MinMax())
+        b.observe_groups(np.array(["y"], dtype=object), np.array([7]))
+        m = a.merge(b)
+        m.observe_groups(np.array(["x", "y"], dtype=object), np.array([100, 200]))
+        # the inputs' live sub-sketches must be untouched
+        assert (a.groups["x"].min, a.groups["x"].max) == (5, 5)
+        assert (b.groups["y"].min, b.groups["y"].max) == (7, 7)
+
+    def test_multiarg_substat(self):
+        # GroupBy over a multivariate sub-stat, including odd group sizes
+        t = table(501)
+        out = compute_stats(t, "GroupBy(cat, Stats(age, score))")
+        g = out["GroupBy(cat, Stats(age, score))"]
+        cats = t.columns["cat"].values
+        ages = t.columns["age"].values.astype(np.float64)
+        scores = t.columns["score"].values
+        for c, cs in g.groups.items():
+            sel = cats == c
+            assert cs.count == int(sel.sum())
+            assert np.allclose(
+                cs.covariance, np.cov(np.stack([ages[sel], scores[sel]]))
+            )
+
+    def test_z3_substat(self):
+        t = table(300)
+        out = compute_stats(t, "GroupBy(cat, Z3Histogram(geom, dtg))")
+        g = out["GroupBy(cat, Z3Histogram(geom, dtg))"]
+        assert sum(s.total for s in g.groups.values()) == 300
+
+    def test_dsl(self):
+        t = table(500)
+        out = compute_stats(t, "GroupBy(cat, MinMax(age))")
+        g = out["GroupBy(cat, MinMax(age))"]
+        assert set(g.groups) == {f"c{i}" for i in range(5)}
+        ages = t.columns["age"].values
+        cats = t.columns["cat"].values
+        for c in g.groups:
+            sel = cats == c
+            assert g.groups[c].min == int(ages[sel].min())
+            assert g.groups[c].max == int(ages[sel].max())
+
+
+class TestCovariance:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, 1000)
+        y = 2 * x + rng.normal(0, 0.1, 1000)
+        cs = CovarianceStats(dims=2)
+        cs.observe(np.stack([x, y], axis=1))
+        ref = np.cov(np.stack([x, y]))
+        assert np.allclose(cs.covariance, ref)
+        assert np.allclose(cs.mean, [x.mean(), y.mean()])
+
+    def test_merge_exact(self):
+        rng = np.random.default_rng(2)
+        v = rng.normal(5, 2, (900, 3))
+        whole = CovarianceStats(dims=3)
+        whole.observe(v)
+        a = CovarianceStats(dims=3)
+        a.observe(v[:300])
+        b = CovarianceStats(dims=3)
+        b.observe(v[300:])
+        m = a.merge(b)
+        assert np.allclose(m.covariance, whole.covariance)
+        assert m.count == 900
+
+    def test_dsl_multi_attr(self):
+        t = table(800)
+        out = compute_stats(t, "Stats(age, score)")
+        cs = out["Stats(age, score)"]
+        ages = t.columns["age"].values.astype(np.float64)
+        scores = t.columns["score"].values
+        assert np.allclose(cs.covariance, np.cov(np.stack([ages, scores])))
+
+
+class TestZ3SpecDSL:
+    def test_z3histogram_vs_z3frequency(self):
+        t = table(3000)
+        out = compute_stats(t, "Z3Histogram(geom, dtg);Z3Frequency(geom, dtg)")
+        h: Z3Histogram = out["Z3Histogram(geom, dtg)"]
+        f: Z3Frequency = out["Z3Frequency(geom, dtg)"]
+        assert h.total == 3000
+        # per-bin totals agree (CMS whole-domain estimate ≥ exact per bin)
+        for b, arr in h.counts.items():
+            est = f.estimate_zranges(b, [(0, (1 << 63) - 1)])
+            assert est >= arr.sum()
+
+    def test_null_rows_excluded(self):
+        # null geom/dtg rows must not poison z3 stats with phantom bins
+        sft = parse_spec("t", SPEC)
+        recs = [
+            {"cat": "a", "age": 1, "score": 1.0, "dtg": T0, "geom": Point(1, 2)},
+            {"cat": "a", "age": 2, "score": 2.0, "dtg": None, "geom": Point(3, 4)},
+            {"cat": "a", "age": 3, "score": 3.0, "dtg": T0, "geom": None},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b", "c"])
+        out = compute_stats(t, "Z3Histogram(geom, dtg)")
+        assert out["Z3Histogram(geom, dtg)"].total == 1
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError, match="GroupBy"):
+            parse_stats("GroupBy(cat)")
+        with pytest.raises(ValueError, match="unknown stat"):
+            parse_stats("GroupBy(cat, Bogus(x))")
+
+
+class TestQueryHintIntegration:
+    def test_grouped_stats_hint(self):
+        ds = DataStore(backend="oracle")
+        ds.create_schema(parse_spec("evt", SPEC))
+        t = table(400)
+        ds.write("evt", t, fids=t.fids.tolist())
+        r = ds.query(
+            "evt",
+            Query(hints={"stats": "GroupBy(cat, MinMax(age));Stats(age, score)"}),
+        )
+        g = r.stats["GroupBy(cat, MinMax(age))"]
+        assert len(g.groups) == 5
+        assert r.stats["Stats(age, score)"].count == 400
+
+    def test_web_stats_serialization(self):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema(parse_spec("evt", SPEC))
+        t = table(300)
+        ds.write("evt", t, fids=t.fids.tolist())
+        app = GeoMesaApp(ds)
+        import json as _json
+        from urllib.parse import quote
+
+        status, body, ctype = app._stats(
+            "evt", {"stats": "GroupBy(cat, MinMax(age));Z3Frequency(geom, dtg)"}, None
+        )
+        assert status == 200
+        s = _json.dumps(body)  # fully JSON-serializable
+        assert "c0" in s
