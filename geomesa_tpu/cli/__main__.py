@@ -168,6 +168,7 @@ def _query_of(args):
     return Query(
         filter=args.cql,
         limit=getattr(args, "max", None),
+        start_index=getattr(args, "start_index", None),
         hints=hints,
         properties=args.attributes.split(",") if getattr(args, "attributes", None) else None,
     )
@@ -430,6 +431,10 @@ def main(argv=None):
                  "gml", "leaflet", "shp"],
     )
     sp.add_argument("-m", "--max", type=int, default=None)
+    sp.add_argument(
+        "--start-index", type=int, default=None,
+        help="paging offset: rows skipped after sort, before --max",
+    )
     sp.add_argument("-a", "--attributes", default=None)
     sp.add_argument("--hints", default=None, help="query hints as JSON")
     sp.add_argument("--bin-track", default=None)

@@ -44,6 +44,8 @@ class Query:
     properties: list[str] | None = None
     sort_by: tuple[str, bool] | None = None  # (field, descending)
     limit: int | None = None
+    # OGC Query.startIndex paging offset: rows skipped after sort, before limit
+    start_index: int | None = None
     hints: dict = field(default_factory=dict)
     # authorizations for record-level visibility filtering (geomesa-security
     # role); None = unrestricted, [] = only unlabeled records visible

@@ -62,7 +62,7 @@ def parse_stats(spec: str) -> list[tuple[str, list[str], object]]:
     out = []
     for part in _split_top(spec, ";"):
         m = _CALL.match(part)
-        if not m:
+        if not m or part.count("(") != part.count(")"):
             raise ValueError(f"invalid stat spec: {part!r}")
         name = m.group(1).lower()
         args = _args(m.group(2))

@@ -757,6 +757,27 @@ class DataStore:
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
 
+    def query_iter(
+        self,
+        type_name: str,
+        q: "Query | str | ast.Filter | None" = None,
+        batch_rows: int = 65536,
+        **kwargs,
+    ):
+        """Stream query results as bounded ``FeatureTable`` batches — the
+        GeoTools feature-reader / ``CloseableIterator`` role
+        (``GeoMesaDataStore.scala:390``): exports and clients page through
+        results without holding one giant formatted payload."""
+        if batch_rows < 1:
+            raise ValueError("batch_rows must be >= 1")
+
+        def _gen():
+            t = self.query(type_name, q, **kwargs).table
+            for lo in range(0, len(t), batch_rows):
+                yield t.take(np.arange(lo, min(lo + batch_rows, len(t))))
+
+        return _gen()
+
     def count_many(self, type_name: str, queries, loose: bool = True):
         """Batched counts for many queries in ONE device pass.
 
@@ -813,6 +834,7 @@ class DataStore:
                 or q.hints
                 or q.auths is not None
                 or q.limit is not None
+                or q.start_index is not None
             ):
                 continue
             e = _extract(f, st.sft.geom_field, st.sft.dtg_field)

@@ -68,7 +68,7 @@ class MergedDataStoreView:
         base_f = q.resolved_filter()
         for store, scope in self.stores:
             f = base_f if scope is None else ast.And((base_f, scope))
-            sub = replace(q, filter=f, sort_by=None, limit=None)
+            sub = replace(q, filter=f, sort_by=None, limit=None, start_index=None)
             res = store.query(type_name, sub)
             if res.density is not None:
                 density = res.density if density is None else density + res.density
@@ -109,7 +109,7 @@ class MergedDataStoreView:
         rows = np.arange(len(table), dtype=np.int64)
         from geomesa_tpu.store.reduce import sort_limit
 
-        table, rows = sort_limit(table, rows, q.sort_by, q.limit)
+        table, rows = sort_limit(table, rows, q.sort_by, q.limit, q.start_index)
         return QueryResult(table, rows)
 
     def stats_count(self, type_name: str, cql=None, exact: bool = False):

@@ -20,9 +20,15 @@ from geomesa_tpu.schema.sft import FeatureType
 __all__ = ["reduce_result", "sample_rows", "density_grid", "bin_encode", "sort_limit"]
 
 
-def sort_limit(table, rows, sort_by, limit):
-    """Shared client-side sort + limit tail (``QueryPlanner.scala:75-98``);
-    also used by the merged view so ordering semantics cannot drift."""
+def sort_limit(table, rows, sort_by, limit, start_index=None):
+    """Shared client-side sort + paging tail (``QueryPlanner.scala:75-98``;
+    ``start_index`` is the OGC ``Query.startIndex`` offset, applied after the
+    sort and before ``limit``); also used by the merged view so ordering
+    semantics cannot drift."""
+    if start_index is not None and start_index < 0:
+        raise ValueError(f"start_index must be >= 0: {start_index}")
+    if limit is not None and limit < 0:
+        raise ValueError(f"limit must be >= 0: {limit}")
     if sort_by is not None:
         fld, desc = sort_by
         keys = table.fids if fld == "id" else table.columns[fld].values
@@ -31,9 +37,11 @@ def sort_limit(table, rows, sort_by, limit):
             order = order[::-1]
         table = table.take(order)
         rows = rows[order]
-    if limit is not None:
-        table = table.take(np.arange(min(limit, len(table))))
-        rows = rows[:limit]
+    lo = min(int(start_index), len(table)) if start_index else 0
+    hi = len(table) if limit is None else min(lo + limit, len(table))
+    if lo > 0 or hi < len(table):
+        table = table.take(np.arange(lo, hi))
+        rows = rows[lo:hi]
     return table, rows
 
 
@@ -234,7 +242,7 @@ def reduce_result(sft: FeatureType, table: FeatureTable, rows: np.ndarray, q):
     # client-side reduce: sort / limit / reproject / projection
     # (QueryPlanner.scala:75-98); CRS runs before the properties projection
     # so a projection that drops the geometry column can't strand the hint
-    table, rows = sort_limit(table, rows, q.sort_by, q.limit)
+    table, rows = sort_limit(table, rows, q.sort_by, q.limit, q.start_index)
 
     crs = q.hints.get("crs")
     if crs:

@@ -12,7 +12,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
     POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
-    GET    /api/schemas/{name}/query?cql=&limit=&format=geojson|arrow|bin|avro|gml|leaflet
+    GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|leaflet
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
     GET    /api/schemas/{name}/stats/bounds?attr=
@@ -222,9 +222,20 @@ class GeoMesaApp:
         n = self.store.write(name, recs, fids=fids)
         return 201, {"written": n}, "application/json"
 
+    def _int_param(self, params, key):
+        if key not in params:
+            return None
+        try:
+            v = int(params[key])
+        except ValueError:
+            raise _HttpError(400, f"{key} must be an integer: {params[key]!r}")
+        if v < 0:
+            raise _HttpError(400, f"{key} must be >= 0: {v}")
+        return v
+
     def _parse_query(self, params) -> Query:
         hints = {}
-        limit = int(params["limit"]) if "limit" in params else None
+        limit = self._int_param(params, "limit")
         props = params["properties"].split(",") if params.get("properties") else None
         sort_by = None
         if params.get("sortBy"):
@@ -234,6 +245,8 @@ class GeoMesaApp:
         return Query(
             filter=params.get("cql") or None,
             limit=limit,
+            # OGC startIndex paging (use with sortBy for stable pages)
+            start_index=self._int_param(params, "startIndex"),
             properties=props,
             sort_by=sort_by,
             hints=hints,

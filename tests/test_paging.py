@@ -1,0 +1,154 @@
+"""Result paging (OGC Query.startIndex) and streaming reader
+(GeoTools feature-reader / CloseableIterator role — SURVEY.md §1 top seam)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.merged import MergedDataStoreView
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point"
+
+
+def make_store(n=500, backend="oracle", seed=4):
+    rng = np.random.default_rng(seed)
+    ds = DataStore(backend=backend)
+    ds.create_schema(parse_spec("evt", SPEC))
+    recs = [
+        {
+            "name": f"n{i:04d}",
+            "age": int(rng.integers(0, 100)),
+            "dtg": T0 + i * 1000,
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    ds.write("evt", recs, fids=[f"f{i:04d}" for i in range(n)])
+    return ds
+
+
+class TestStartIndex:
+    def test_pages_partition_sorted_results(self):
+        ds = make_store(100)
+        pages = [
+            ds.query(
+                "evt",
+                Query(sort_by=("name", False), start_index=i * 30, limit=30),
+            )
+            for i in range(4)
+        ]
+        names = [r for p in pages for r in p.table.columns["name"].values]
+        assert names == [f"n{i:04d}" for i in range(100)]
+        assert [p.count for p in pages] == [30, 30, 30, 10]
+
+    def test_start_index_without_limit(self):
+        ds = make_store(50)
+        r = ds.query("evt", Query(sort_by=("name", False), start_index=45))
+        assert r.count == 5
+        assert r.table.columns["name"].values[0] == "n0045"
+
+    def test_start_index_past_end(self):
+        ds = make_store(20)
+        r = ds.query("evt", Query(start_index=100, limit=10))
+        assert r.count == 0
+
+    def test_with_filter(self):
+        ds = make_store(200)
+        q = "age >= 50"
+        full = ds.query("evt", Query(filter=q, sort_by=("name", False)))
+        page = ds.query(
+            "evt", Query(filter=q, sort_by=("name", False), start_index=5, limit=10)
+        )
+        assert (
+            page.table.columns["name"].values.tolist()
+            == full.table.columns["name"].values[5:15].tolist()
+        )
+
+    def test_merged_view_pages_globally(self):
+        a, b = make_store(40, seed=1), make_store(40, seed=2)
+        view = MergedDataStoreView([a, b])
+        full = view.query("evt", Query(sort_by=("dtg", False)))
+        page = view.query(
+            "evt", Query(sort_by=("dtg", False), start_index=30, limit=20)
+        )
+        assert (
+            page.table.fids.tolist() == full.table.fids[30:50].tolist()
+        )
+
+    def test_tpu_backend_parity(self):
+        o = make_store(300, backend="oracle")
+        t = make_store(300, backend="tpu")
+        q = Query(
+            filter="BBOX(geom, -90, -45, 90, 45)",
+            sort_by=("name", False),
+            start_index=7,
+            limit=13,
+        )
+        ro, rt = o.query("evt", q), t.query("evt", q)
+        assert ro.table.fids.tolist() == rt.table.fids.tolist()
+
+
+class TestQueryIter:
+    def test_batches_cover_exactly(self):
+        ds = make_store(250)
+        batches = list(ds.query_iter("evt", None, batch_rows=64))
+        assert [len(b) for b in batches] == [64, 64, 64, 58]
+        fids = [f for b in batches for f in b.fids]
+        assert sorted(fids) == sorted(ds.query("evt").table.fids.tolist())
+
+    def test_empty_result(self):
+        ds = make_store(10)
+        assert list(ds.query_iter("evt", "age > 1000")) == []
+
+    def test_bad_batch_rows_eager(self):
+        ds = make_store(5)
+        with pytest.raises(ValueError):
+            ds.query_iter("evt", None, batch_rows=0)  # no iteration needed
+
+    def test_negative_start_index_rejected(self):
+        ds = make_store(10)
+        with pytest.raises(ValueError, match="start_index"):
+            ds.query("evt", Query(start_index=-5))
+        with pytest.raises(ValueError, match="limit"):
+            ds.query("evt", Query(limit=-1))
+
+    def test_count_many_honors_start_index(self):
+        ds = make_store(100, backend="tpu")
+        q = Query(filter="BBOX(geom, -180, -90, 180, 90)", start_index=40)
+        (batched,) = ds.count_many("evt", [q])
+        assert batched == ds.query("evt", q).count == 60
+
+    def test_web_bad_params_400(self):
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = make_store(5)
+        app = GeoMesaApp(ds)
+        for params in ({"startIndex": "abc"}, {"startIndex": "-3"},
+                       {"limit": "x"}):
+            with pytest.raises(_HttpError) as e:
+                app._parse_query(params)
+            assert e.value.status == 400
+
+    def test_malformed_stat_spec_rejected(self):
+        from geomesa_tpu.stats.spec import parse_stats
+
+        with pytest.raises(ValueError, match="invalid stat spec"):
+            parse_stats("Enumeration(a))")
+
+    def test_web_start_index_param(self):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = make_store(30)
+        app = GeoMesaApp(ds)
+        status, body, _ = app._query(
+            "evt",
+            {"sortBy": "name", "startIndex": "25", "limit": "10",
+             "format": "geojson"},
+            None,
+        )
+        assert status == 200
+        assert len(body["features"]) == 5
