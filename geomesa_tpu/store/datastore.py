@@ -770,9 +770,11 @@ class DataStore:
         results without holding one giant formatted payload."""
         if batch_rows < 1:
             raise ValueError("batch_rows must be >= 1")
+        # run the query eagerly so schema/filter errors raise at the call
+        # site, not at the consumer's first next()
+        t = self.query(type_name, q, **kwargs).table
 
         def _gen():
-            t = self.query(type_name, q, **kwargs).table
             for lo in range(0, len(t), batch_rows):
                 yield t.take(np.arange(lo, min(lo + batch_rows, len(t))))
 

@@ -5,8 +5,10 @@ scheme implementations in ``geomesa-fs-storage-common/.../partitions/``
 (DateTimeScheme, Z2Scheme, AttributeScheme, CompositeScheme, FlatScheme —
 SURVEY.md §2.12): the partition key doubles as a coarse index, letting a
 query prune whole files before any scan. Schemes are chosen per schema via
-user-data ``geomesa.fs.scheme`` (e.g. ``datetime``, ``z2-4``,
-``attribute:name``, ``datetime,z2-4``).
+user-data ``geomesa.fs.scheme`` (e.g. ``datetime``, ``z2-4``, ``xz2-6``,
+``attribute:name``, ``datetime,z2-4``). Point schemas partition tightest
+with ``z2``; extended-geometry schemas should use ``xz2`` (enlarged-cell
+semantics keep pruning exact for any feature extent).
 """
 
 from __future__ import annotations
@@ -97,15 +99,23 @@ class Z2Scheme(PartitionScheme):
             return np.full(len(table), "all", dtype=object)
         col = table.geom_column()
         if col.x is not None:
-            cx, cy = col.x, col.y
-        elif col.bounds is not None:
-            bb = col.bounds  # (n, 4) xmin ymin xmax ymax
-            cx = (bb[:, 0] + bb[:, 2]) / 2
-            cy = (bb[:, 1] + bb[:, 3]) / 2
-        else:
+            cells = self._cells(col.x, col.y)
+            return np.array(
+                [f"z2_{self.bits}_{int(c)}" for c in cells], dtype=object
+            )
+        if col.bounds is None:
             return np.full(len(table), "all", dtype=object)
-        cells = self._cells(cx, cy)
-        return np.array([f"z2_{self.bits}_{int(c)}" for c in cells], dtype=object)
+        # extended geometries: the centroid's cell only bounds the feature if
+        # the whole bbox sits in that cell — otherwise the feature must go to
+        # the unprunable spill partition or pruning would drop rows whose
+        # extent reaches into cells the centroid is not in (use the xz2
+        # scheme for extended-geometry schemas; this is the safe fallback)
+        bb = col.bounds  # (n, 4) xmin ymin xmax ymax
+        lo = self._cells(bb[:, 0], bb[:, 1])
+        hi = self._cells(bb[:, 2], bb[:, 3])
+        keys = np.array([f"z2_{self.bits}_{int(c)}" for c in lo], dtype=object)
+        keys[lo != hi] = "all"
+        return keys
 
     def prune(self, sft, extraction, key: str) -> bool:
         if extraction is None or extraction.boxes is None:
@@ -127,6 +137,78 @@ class Z2Scheme(PartitionScheme):
         cell_y2 = float(ny.bin_hi(iy)[0])
         for x1, y1, x2, y2 in extraction.boxes:
             if x2 >= cell_x1 and x1 <= cell_x2 and y2 >= cell_y1 and y1 <= cell_y2:
+                return True
+        return False
+
+
+class XZ2Scheme(PartitionScheme):
+    """Extended-geometry partitioning with XZ enlarged-cell semantics
+    (``XZ2Scheme`` role, after ``XZ2SFC.scala:24``): each feature keys to the
+    finest quad-tree cell (level ≤ ``g``) whose *doubled* extent contains its
+    bbox, anchored at the cell holding the bbox's lower-left corner. Pruning
+    keeps a partition iff its doubled extent intersects a query box — exact
+    for any geometry extent, no spill partition needed."""
+
+    name = "xz2"
+
+    def __init__(self, g: int = 6):
+        if not (1 <= g <= 12):
+            raise ValueError(f"xz2 scheme resolution must be in [1, 12]: {g}")
+        self.g = g
+
+    def _elements(self, bb: np.ndarray):
+        """bbox (n,4) → (level, ix, iy) XZ elements."""
+        w = np.clip(bb[:, 2] - bb[:, 0], 0.0, None)
+        h = np.clip(bb[:, 3] - bb[:, 1], 0.0, None)
+        # finest level where the doubled cell still covers the bbox:
+        # cell_w(l) = 360/2^l, need w <= cell_w(l)  (doubled extent provides
+        # the slack for arbitrary anchor alignment, as in XZ ordering)
+        with np.errstate(divide="ignore"):
+            lw = np.floor(np.log2(np.where(w > 0, 360.0 / w, np.inf)))
+            lh = np.floor(np.log2(np.where(h > 0, 180.0 / h, np.inf)))
+        lvl = np.clip(np.minimum(lw, lh), 0, self.g).astype(np.int64)
+        cw = 360.0 / (2.0**lvl)
+        ch = 180.0 / (2.0**lvl)
+        nx = (2**lvl).astype(np.int64)
+        ix = np.clip(((bb[:, 0] + 180.0) / cw).astype(np.int64), 0, nx - 1)
+        iy = np.clip(((bb[:, 1] + 90.0) / ch).astype(np.int64), 0, nx - 1)
+        return lvl, ix, iy
+
+    def keys(self, sft, table) -> np.ndarray:
+        if sft.geom_field is None:
+            return np.full(len(table), "all", dtype=object)
+        col = table.geom_column()
+        if col.x is not None:
+            bb = np.stack([col.x, col.y, col.x, col.y], axis=1)
+        elif col.bounds is not None:
+            bb = col.bounds
+        else:
+            return np.full(len(table), "all", dtype=object)
+        lvl, ix, iy = self._elements(np.nan_to_num(bb))
+        return np.array(
+            [
+                f"xz2_{self.g}_{int(l)}_{int(i)}_{int(j)}"
+                for l, i, j in zip(lvl, ix, iy)
+            ],
+            dtype=object,
+        )
+
+    def prune(self, sft, extraction, key: str) -> bool:
+        if extraction is None or extraction.boxes is None:
+            return True
+        parts = key.split("_")
+        if len(parts) != 5 or parts[0] != "xz2" or int(parts[1]) != self.g:
+            return True
+        lvl, ix, iy = int(parts[2]), int(parts[3]), int(parts[4])
+        cw = 360.0 / (2.0**lvl)
+        ch = 180.0 / (2.0**lvl)
+        # doubled extent: anchor cell plus one cell width/height of slack
+        x1 = -180.0 + ix * cw
+        y1 = -90.0 + iy * ch
+        x2 = min(x1 + 2 * cw, 180.0)
+        y2 = min(y1 + 2 * ch, 90.0)
+        for qx1, qy1, qx2, qy2 in extraction.boxes:
+            if qx2 >= x1 and qx1 <= x2 and qy2 >= y1 and qy1 <= y2:
                 return True
         return False
 
@@ -204,6 +286,9 @@ def scheme_from_spec(spec) -> PartitionScheme:
             parts.append(FlatScheme())
         elif tok == "datetime":
             parts.append(DateTimeScheme())
+        elif tok.startswith("xz2"):
+            g = int(tok.split("-")[1]) if "-" in tok else 6
+            parts.append(XZ2Scheme(g))
         elif tok.startswith("z2"):
             bits = int(tok.split("-")[1]) if "-" in tok else 4
             parts.append(Z2Scheme(bits))

@@ -72,6 +72,11 @@ class RemoteDataStore:
             params["cql"] = f if isinstance(f, str) else ast.to_cql(f)
         if q.limit is not None:
             params["limit"] = str(q.limit)
+        if q.start_index is not None:
+            params["startIndex"] = str(q.start_index)
+        if q.sort_by is not None:  # pages are only stable under a sort
+            fld, desc = q.sort_by
+            params["sortBy"] = ("-" if desc else "") + fld
         data = self._get(f"/api/schemas/{type_name}/query", params)
         table = from_ipc_bytes(self.get_schema(type_name), data)
         return QueryResult(table, np.arange(len(table)))

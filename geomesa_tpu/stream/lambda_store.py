@@ -163,8 +163,16 @@ class LambdaDataStore:
     def query(self, type_name: str, q: Query | str | None = None, **kwargs):
         if isinstance(q, str) or q is None:
             q = Query(filter=q, **kwargs)
-        hot = self.stream.query(type_name, q)
-        cold = self.cold.query(type_name, q)
+        # tier sub-queries must not page: sort/limit/start_index apply to the
+        # MERGED stream, or each tier independently skips/truncates and rows
+        # are lost (same pattern as MergedDataStoreView)
+        from dataclasses import replace
+
+        from geomesa_tpu.store.reduce import sort_limit
+
+        sub = replace(q, sort_by=None, limit=None, start_index=None)
+        hot = self.stream.query(type_name, sub)
+        cold = self.cold.query(type_name, sub)
         with self._persist_lock:
             tombs = set(self._tombstones.get(type_name, ()))
         hot_table = hot.table
@@ -176,17 +184,23 @@ class LambdaDataStore:
         hot_fids = set(hot_table.fids.tolist())
         drop = hot_fids | tombs
         if not drop:
-            return cold
-        # merge tiers: hot wins on fid collisions (it is strictly newer);
-        # tombstoned fids are invisible even before the consumers drain
-        keep = np.array([f not in drop for f in cold.table.fids], dtype=bool)
-        cold_kept = cold.table.take(np.nonzero(keep)[0])
-        merged = (
-            hot_table
-            if len(cold_kept) == 0
-            else FeatureTable.concat([hot_table, cold_kept])
+            merged = cold.table
+        else:
+            # merge tiers: hot wins on fid collisions (it is strictly newer);
+            # tombstoned fids are invisible even before the consumers drain
+            keep = np.array(
+                [f not in drop for f in cold.table.fids], dtype=bool
+            )
+            cold_kept = cold.table.take(np.nonzero(keep)[0])
+            merged = (
+                hot_table
+                if len(cold_kept) == 0
+                else FeatureTable.concat([hot_table, cold_kept])
+            )
+        merged, rows = sort_limit(
+            merged, np.arange(len(merged)), q.sort_by, q.limit, q.start_index
         )
-        return QueryResult(merged, np.arange(len(merged)))
+        return QueryResult(merged, rows)
 
     def hot_count(self, type_name: str) -> int:
         return self.stream.cache(type_name).size()

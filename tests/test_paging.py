@@ -79,6 +79,53 @@ class TestStartIndex:
             page.table.fids.tolist() == full.table.fids[30:50].tolist()
         )
 
+    def test_lambda_store_pages_merged_stream(self):
+        # hot + cold tiers must page the MERGED stream, not each tier
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=1)
+        lds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        now = 1_500_000_000_000
+        for i in range(10):
+            ts = now - (5000 if i < 5 else 0)  # 5 will persist cold, 5 hot
+            lds.write("t", f"f{i}", {"name": f"n{i}", "dtg": ts,
+                                     "geom": Point(i, i)}, ts=ts)
+        assert lds.stream.drain("t")
+        assert lds.persist_once("t", now_ms=now) == 5
+        full = lds.query("t", Query(sort_by=("name", False)))
+        assert full.count == 10
+        page = lds.query(
+            "t", Query(sort_by=("name", False), start_index=4, limit=4)
+        )
+        assert page.table.fids.tolist() == full.table.fids[4:8].tolist()
+        limited = lds.query("t", Query(limit=4))
+        assert limited.count == 4
+        lds.close()
+
+    def test_remote_store_pages(self):
+        import threading
+        from wsgiref.simple_server import make_server
+
+        from geomesa_tpu.store.remote import RemoteDataStore
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        local = make_store(60)
+        httpd = make_server("127.0.0.1", 0, GeoMesaApp(local))
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            remote = RemoteDataStore(
+                f"http://127.0.0.1:{httpd.server_address[1]}"
+            )
+            q = Query(sort_by=("name", False), start_index=25, limit=10)
+            assert (
+                remote.query("evt", q).table.fids.tolist()
+                == local.query("evt", q).table.fids.tolist()
+            )
+        finally:
+            httpd.shutdown()
+
     def test_tpu_backend_parity(self):
         o = make_store(300, backend="oracle")
         t = make_store(300, backend="tpu")

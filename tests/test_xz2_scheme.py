@@ -1,0 +1,155 @@
+"""XZ2 partition scheme + extended-geometry pruning correctness
+(reference: ``geomesa-fs-storage-common/.../partitions/XZ2Scheme``; the
+enlarged-cell semantics come from ``XZ2SFC.scala:24`` — SURVEY.md §2.12)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import LineString, Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store import persistence
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.partitions import XZ2Scheme, Z2Scheme, scheme_from_spec
+
+T0 = 1_498_867_200_000
+LINE_SPEC = "name:String,dtg:Date,*geom:LineString;geomesa.fs.scheme='%s'"
+
+
+def line_store(scheme: str):
+    sft = parse_spec("lines", LINE_SPEC % scheme)
+    ds = DataStore(backend="oracle")
+    ds.create_schema(sft)
+    recs = [
+        # centroid far west, but reaches into the eastern query box
+        {"name": "long", "dtg": T0,
+         "geom": LineString([(-120.0, 10.0), (100.0, 10.0)])},
+        # small, fully in the east
+        {"name": "east", "dtg": T0,
+         "geom": LineString([(95.0, 9.0), (97.0, 11.0)])},
+        # small, far west — prunable for an eastern query
+        {"name": "west", "dtg": T0,
+         "geom": LineString([(-150.0, -40.0), (-149.0, -39.0)])},
+    ]
+    ds.write("lines", recs, fids=["a", "b", "c"])
+    return ds
+
+
+EAST_BOX = "BBOX(geom, 90, 5, 110, 15)"
+
+
+class TestPrunedLoadCorrectness:
+    @pytest.mark.parametrize("scheme", ["z2-2", "xz2-4"])
+    def test_extended_geoms_survive_pruning(self, tmp_path, scheme):
+        ds = line_store(scheme)
+        persistence.save(ds, str(tmp_path / "cat"))
+        ds2 = persistence.load(str(tmp_path / "cat"), backend="oracle",
+                               filter=EAST_BOX)
+        hits = sorted(ds2.query("lines", EAST_BOX).table.fids.tolist())
+        assert hits == ["a", "b"]  # the long line must not be pruned away
+
+    def test_xz2_actually_prunes(self, tmp_path):
+        ds = line_store("xz2-4")
+        persistence.save(ds, str(tmp_path / "cat"))
+        ds2 = persistence.load(str(tmp_path / "cat"), backend="oracle",
+                               filter=EAST_BOX)
+        # the far-west small feature's fine cell is disjoint from the box
+        assert "c" not in set(ds2.query("lines").table.fids.tolist())
+        assert ds2.metrics.counter("catalog.partitions_pruned.lines").count > 0
+
+
+class TestXZ2Elements:
+    def test_small_feature_fine_level(self):
+        s = XZ2Scheme(g=8)
+        bb = np.array([[10.0, 10.0, 10.1, 10.1]])
+        lvl, ix, iy = s._elements(bb)
+        assert lvl[0] == 8  # tiny bbox keys at the finest level
+        # the doubled extent must contain the bbox
+        cw, ch = 360.0 / 2 ** lvl[0], 180.0 / 2 ** lvl[0]
+        x1, y1 = -180 + ix[0] * cw, -90 + iy[0] * ch
+        assert x1 <= 10.0 and 10.1 <= x1 + 2 * cw
+        assert y1 <= 10.0 and 10.1 <= y1 + 2 * ch
+
+    def test_huge_feature_level_zero(self):
+        s = XZ2Scheme(g=8)
+        bb = np.array([[-170.0, -80.0, 170.0, 80.0]])
+        lvl, _, _ = s._elements(bb)
+        assert lvl[0] == 0
+
+    def test_doubled_extent_invariant_random(self):
+        rng = np.random.default_rng(5)
+        n = 2000
+        x1 = rng.uniform(-180, 179, n)
+        y1 = rng.uniform(-90, 89, n)
+        w = rng.uniform(0, 40, n) * (rng.random(n) < 0.5)  # half are points
+        h = rng.uniform(0, 20, n) * (rng.random(n) < 0.5)
+        bb = np.stack(
+            [x1, y1, np.minimum(x1 + w, 180.0), np.minimum(y1 + h, 90.0)],
+            axis=1,
+        )
+        s = XZ2Scheme(g=6)
+        lvl, ix, iy = s._elements(bb)
+        cw = 360.0 / 2.0**lvl
+        ch = 180.0 / 2.0**lvl
+        cx1 = -180.0 + ix * cw
+        cy1 = -90.0 + iy * ch
+        assert (cx1 <= bb[:, 0] + 1e-9).all()
+        assert (bb[:, 2] <= cx1 + 2 * cw + 1e-9).all()
+        assert (cy1 <= bb[:, 1] + 1e-9).all()
+        assert (bb[:, 3] <= cy1 + 2 * ch + 1e-9).all()
+
+    def test_prune_never_drops_overlapping(self):
+        """Pruned partition ⇒ provably no feature in it can hit the box."""
+        from geomesa_tpu.filter.bounds import Extraction
+
+        rng = np.random.default_rng(6)
+        s = XZ2Scheme(g=5)
+        sft = parse_spec("t", LINE_SPEC % "xz2-5")
+        n = 1000
+        x1 = rng.uniform(-180, 175, n)
+        y1 = rng.uniform(-90, 85, n)
+        bb = np.stack(
+            [x1, y1,
+             np.minimum(x1 + rng.uniform(0, 30, n), 180.0),
+             np.minimum(y1 + rng.uniform(0, 15, n), 90.0)],
+            axis=1,
+        )
+        recs = [
+            {"name": f"l{i}", "dtg": T0,
+             "geom": LineString([(bb[i, 0], bb[i, 1]), (bb[i, 2], bb[i, 3])])}
+            for i in range(n)
+        ]
+        t = FeatureTable.from_records(sft, recs, [str(i) for i in range(n)])
+        keys = s.keys(sft, t)
+        qbox = (0.0, 0.0, 40.0, 20.0)
+        e = Extraction(boxes=[qbox], intervals=None)
+        pruned_keys = {k for k in set(keys) if not s.prune(sft, e, k)}
+        overlaps = (
+            (bb[:, 2] >= qbox[0]) & (bb[:, 0] <= qbox[2])
+            & (bb[:, 3] >= qbox[1]) & (bb[:, 1] <= qbox[3])
+        )
+        for i in np.nonzero(overlaps)[0]:
+            assert keys[i] not in pruned_keys
+
+
+class TestZ2SpillFallback:
+    def test_oversized_features_key_to_spill(self):
+        s = Z2Scheme(bits=2)
+        sft = parse_spec("t", LINE_SPEC % "z2-2")
+        recs = [
+            {"name": "long", "dtg": T0,
+             "geom": LineString([(-120.0, 10.0), (100.0, 10.0)])},
+            {"name": "small", "dtg": T0,
+             "geom": LineString([(95.0, 9.0), (96.0, 10.0)])},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        keys = s.keys(sft, t)
+        assert keys[0] == "all"  # spans cells: unprunable spill partition
+        assert keys[1].startswith("z2_2_")
+
+    def test_spec_roundtrip(self):
+        s = scheme_from_spec("xz2-7")
+        assert isinstance(s, XZ2Scheme) and s.g == 7
+        assert isinstance(scheme_from_spec("xz2"), XZ2Scheme)
+        c = scheme_from_spec("datetime,xz2-4")
+        assert c.name == "composite"
