@@ -364,7 +364,19 @@ def cmd_serve(args):
     ds = _load(args)
     from geomesa_tpu.web import serve
 
-    serve(ds, host=args.host, port=args.port)
+    provider = None
+    if args.auths_header:
+        from geomesa_tpu.security.auth import HeaderAuthorizationsProvider
+
+        provider = HeaderAuthorizationsProvider(args.auths_header)
+    serve(ds, host=args.host, port=args.port, auth_provider=provider)
+
+
+def cmd_compact(args):
+    ds = _load(args)
+    ds.compact(args.name)
+    _save(ds, args)
+    print(f"compacted {args.name!r}: {ds.stats_count(args.name)} rows in main tier")
 
 
 def main(argv=None):
@@ -485,7 +497,18 @@ def main(argv=None):
     common(sp, name=False)
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument(
+        "--auths-header", default=None, metavar="HEADER",
+        help="derive visibility auths from this trusted proxy header "
+        "(AuthorizationsProvider role); absent header = no auths",
+    )
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "compact", help="fold the hot delta tier into the sorted main tier"
+    )
+    common(sp)
+    sp.set_defaults(fn=cmd_compact)
 
     args = p.parse_args(argv)
     try:

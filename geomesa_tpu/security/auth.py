@@ -1,0 +1,54 @@
+"""Authorizations-provider SPI (the ``geomesa-security`` provider role).
+
+Reference: ``geomesa-security/.../AuthorizationsProvider`` (SURVEY.md §2.19)
+— a pluggable component that derives the calling user's visibility
+authorizations from request context, so the serving layer (REST here;
+GeoServer there) never trusts the client to name its own auths. Providers
+return ``None`` for "unrestricted" (an admin/trusted context) or a list of
+authorization tokens checked against feature visibility expressions
+(:mod:`geomesa_tpu.security.visibility`).
+"""
+
+from __future__ import annotations
+
+
+class AuthorizationsProvider:
+    """SPI: request context → authorizations (None = unrestricted)."""
+
+    def auths(self, context: dict) -> list[str] | None:
+        raise NotImplementedError
+
+
+class StaticAuthorizationsProvider(AuthorizationsProvider):
+    """Fixed authorizations for every request (test / single-tenant use)."""
+
+    def __init__(self, auths: list[str] | None):
+        self._auths = None if auths is None else list(auths)
+
+    def auths(self, context: dict) -> list[str] | None:
+        return None if self._auths is None else list(self._auths)
+
+
+class HeaderAuthorizationsProvider(AuthorizationsProvider):
+    """Authorizations from a trusted reverse-proxy header (comma-separated).
+
+    The proxy authenticates the user and asserts their auths in ``header``
+    (default ``X-Geomesa-Auths``); a missing header means NO authorizations
+    (only unlabeled features are visible), never unrestricted — absence of
+    proof must fail closed.
+
+    DEPLOYMENT REQUIREMENT: WSGI collapses ``-`` and ``_`` in header names
+    to one environ key, so a client-sent ``X_Geomesa_Auths`` aliases the
+    trusted header. The fronting proxy MUST drop underscore-spelled header
+    variants (nginx does by default via ``ignore_invalid_headers``; Apache
+    needs ``RequestHeader unset``) in addition to overriding the canonical
+    spelling — otherwise clients can append their own auths."""
+
+    def __init__(self, header: str = "X-Geomesa-Auths"):
+        # WSGI spells header "X-Foo-Bar" as environ key "HTTP_X_FOO_BAR"
+        self.header = header
+        self._environ_key = "HTTP_" + header.upper().replace("-", "_")
+
+    def auths(self, context: dict) -> list[str] | None:
+        raw = context.get(self._environ_key, "")
+        return [a.strip() for a in raw.split(",") if a.strip()]

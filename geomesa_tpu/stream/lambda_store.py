@@ -163,14 +163,20 @@ class LambdaDataStore:
     def query(self, type_name: str, q: Query | str | None = None, **kwargs):
         if isinstance(q, str) or q is None:
             q = Query(filter=q, **kwargs)
-        # tier sub-queries must not page: sort/limit/start_index apply to the
-        # MERGED stream, or each tier independently skips/truncates and rows
-        # are lost (same pattern as MergedDataStoreView)
+        # tier sub-queries must not page or aggregate: sort/limit/start_index
+        # and the reduce-stage hints (density/stats/bin/sample/crs) apply to
+        # the MERGED stream, or each tier independently skips/truncates/
+        # aggregates and the merged answer is wrong (MergedDataStoreView
+        # pattern); scan-stage hints (index/loose_bbox/now_ms/timeout...)
+        # stay on the tier queries
         from dataclasses import replace
 
-        from geomesa_tpu.store.reduce import sort_limit
+        from geomesa_tpu.store.reduce import reduce_result
 
-        sub = replace(q, sort_by=None, limit=None, start_index=None)
+        _REDUCE_HINTS = ("density", "stats", "bin", "sample", "sample_by", "crs")
+        sub_hints = {k: v for k, v in q.hints.items() if k not in _REDUCE_HINTS}
+        sub = replace(q, sort_by=None, limit=None, start_index=None,
+                      hints=sub_hints, properties=None)
         hot = self.stream.query(type_name, sub)
         cold = self.cold.query(type_name, sub)
         with self._persist_lock:
@@ -197,10 +203,15 @@ class LambdaDataStore:
                 if len(cold_kept) == 0
                 else FeatureTable.concat([hot_table, cold_kept])
             )
-        merged, rows = sort_limit(
-            merged, np.arange(len(merged)), q.sort_by, q.limit, q.start_index
+        # one reduce pass over the merged stream: aggregation hints, sort,
+        # paging, projection — visibility was already applied per tier (the
+        # second application is idempotent)
+        sft = self.cold.get_schema(type_name)
+        out = reduce_result(sft, merged, np.arange(len(merged)), q)
+        table, rows, density, stats, bin_data = out
+        return QueryResult(
+            table, rows, density=density, stats=stats, bin_data=bin_data
         )
-        return QueryResult(merged, rows)
 
     def hot_count(self, type_name: str) -> int:
         return self.stream.cache(type_name).size()

@@ -70,8 +70,12 @@ def _jsonable(v):
 class GeoMesaApp:
     """WSGI application over one :class:`DataStore` (or merged view)."""
 
-    def __init__(self, store):
+    def __init__(self, store, auth_provider=None):
+        # auth_provider: security.auth.AuthorizationsProvider — derives the
+        # caller's visibility auths from the request (None = unrestricted,
+        # the single-tenant default)
         self.store = store
+        self.auth_provider = auth_provider
         self.routes = [
             ("GET", r"^/api/version$", self._version),
             ("GET", r"^/api/schemas$", self._list_schemas),
@@ -98,6 +102,10 @@ class GeoMesaApp:
         params = {
             k: v[0] for k, v in parse_qs(environ.get("QUERY_STRING", "")).items()
         }
+        # reserved key: only the provider may set it — never the client
+        params.pop("__auths__", None)
+        if self.auth_provider is not None:
+            params["__auths__"] = self.auth_provider.auths(environ)
         try:
             body = None
             if method in ("POST", "PUT", "PATCH"):
@@ -250,6 +258,7 @@ class GeoMesaApp:
             properties=props,
             sort_by=sort_by,
             hints=hints,
+            auths=params.get("__auths__"),
         )
 
     def _query(self, name, params, body):
@@ -286,6 +295,24 @@ class GeoMesaApp:
             return 200, map_html(r.table).encode("utf-8"), "text/html"
         raise _HttpError(400, f"unknown format {fmt!r}")
 
+    def _restricted_auths(self, name, params):
+        """The caller's auths when visibility enforcement applies, else None.
+
+        Stats/count endpoints normally read pre-computed store-wide sketches;
+        when an auth provider is configured AND the schema labels features
+        (``geomesa.vis.field``), those sketches would leak restricted rows —
+        such requests must recompute over the caller-visible subset."""
+        auths = params.get("__auths__")
+        if auths is None:
+            return None
+        try:
+            sft = self.store.get_schema(name)
+        except KeyError:
+            return None  # handler will 404 on its own store call
+        if not (sft.user_data or {}).get("geomesa.vis.field"):
+            return None
+        return auths
+
     def _count_many(self, name, params, body):
         """POST {"queries": [cql, ...], "loose": bool} → batched counts in
         one device pass (DataStore.count_many)."""
@@ -293,8 +320,13 @@ class GeoMesaApp:
             raise _HttpError(400, 'body must be {"queries": [...]}')
         if not hasattr(self.store, "count_many"):
             raise _HttpError(400, "store does not support batched counts")
+        auths = self._restricted_auths(name, params)
+        queries = body["queries"]
+        if auths is not None:
+            # visibility-filtered counts can't use the loose batched path
+            queries = [Query(filter=c, auths=auths) for c in queries]
         counts = self.store.count_many(
-            name, body["queries"], loose=bool(body.get("loose", True))
+            name, queries, loose=bool(body.get("loose", True))
         )
         return 200, {"counts": counts}, "application/json"
 
@@ -302,7 +334,11 @@ class GeoMesaApp:
         spec = params.get("stats")
         if not spec:
             raise _HttpError(400, "missing ?stats= spec")
-        r = self.store.query(name, Query(filter=params.get("cql"), hints={"stats": spec}))
+        r = self.store.query(
+            name,
+            Query(filter=params.get("cql"), hints={"stats": spec},
+                  auths=params.get("__auths__")),
+        )
 
         def sketch_dict(s):
             from geomesa_tpu.stats.sketches import Stat
@@ -324,16 +360,32 @@ class GeoMesaApp:
         out = {label: sketch_dict(s) for label, s in (r.stats or {}).items()}
         return 200, out, "application/json"
 
+    def _visible_stat(self, name, params, spec: str):
+        """One sketch computed over the caller-visible rows only."""
+        r = self.store.query(
+            name,
+            Query(filter=params.get("cql"), hints={"stats": spec},
+                  auths=params.get("__auths__")),
+        )
+        return r.stats[spec]
+
     def _stats_count(self, name, params, body):
-        exact = params.get("exact", "false").lower() in ("1", "true", "yes")
-        c = self.store.stats_count(name, params.get("cql"), exact=exact)
+        if self._restricted_auths(name, params) is not None:
+            c = self._visible_stat(name, params, "Count()").count
+        else:
+            exact = params.get("exact", "false").lower() in ("1", "true", "yes")
+            c = self.store.stats_count(name, params.get("cql"), exact=exact)
         return 200, {"count": c}, "application/json"
 
     def _stats_bounds(self, name, params, body):
         attr = params.get("attr")
         if not attr:
             raise _HttpError(400, "missing ?attr=")
-        lo, hi = self.store.stats_bounds(name, attr)
+        if self._restricted_auths(name, params) is not None:
+            mm = self._visible_stat(name, params, f"MinMax({attr})")
+            lo, hi = mm.min, mm.max
+        else:
+            lo, hi = self.store.stats_bounds(name, attr)
         return 200, {"attr": attr, "min": lo, "max": hi}, "application/json"
 
     def _stats_topk(self, name, params, body):
@@ -341,7 +393,10 @@ class GeoMesaApp:
         if not attr:
             raise _HttpError(400, "missing ?attr=")
         k = int(params.get("k", 10))
-        top = self.store.stats_top_k(name, attr, k)
+        if self._restricted_auths(name, params) is not None:
+            top = self._visible_stat(name, params, f"TopK({attr}, {k})").top(k)
+        else:
+            top = self.store.stats_top_k(name, attr, k)
         return 200, {"attr": attr, "topk": [[v, int(c)] for v, c in top]}, "application/json"
 
     def _density(self, name, params, body):
@@ -352,7 +407,9 @@ class GeoMesaApp:
         if params.get("bbox"):
             opts["bbox"] = tuple(float(v) for v in params["bbox"].split(","))
         r = self.store.query(
-            name, Query(filter=params.get("cql"), hints={"density": opts})
+            name,
+            Query(filter=params.get("cql"), hints={"density": opts},
+                  auths=params.get("__auths__")),
         )
         return 200, {"width": opts["width"], "height": opts["height"],
                      "grid": r.density}, "application/json"
@@ -369,13 +426,15 @@ class GeoMesaApp:
         return 200, (m.snapshot() if m is not None else {}), "application/json"
 
 
-def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True):
+def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True,
+          auth_provider=None):
     """Run the API on wsgiref's simple server (dev/ops tool, not a prod WSGI
     container — same posture as the reference's embedded servlets).
 
     ``threads=True`` (default) handles requests concurrently — the store's
     per-type snapshot/mutator locking makes parallel queries + background
     compactions safe; pass False for single-threaded debugging.
+    ``auth_provider``: see :class:`geomesa_tpu.security.auth.AuthorizationsProvider`.
     """
     import socketserver
     from wsgiref.simple_server import WSGIServer, make_server
@@ -387,6 +446,9 @@ def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True
             daemon_threads = True
 
         cls = _ThreadingWSGIServer
-    httpd = make_server(host, port, GeoMesaApp(store), server_class=cls)
+    httpd = make_server(
+        host, port, GeoMesaApp(store, auth_provider=auth_provider),
+        server_class=cls,
+    )
     print(f"geomesa-tpu REST on http://{host}:{port}/api")
     httpd.serve_forever()

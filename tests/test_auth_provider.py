@@ -1,0 +1,135 @@
+"""AuthorizationsProvider SPI + REST visibility enforcement (reference:
+``geomesa-security/.../AuthorizationsProvider`` — SURVEY.md §2.19: the
+serving layer derives user auths from trusted context, never the client)."""
+
+import json
+import threading
+from wsgiref.simple_server import make_server
+
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.security.auth import (
+    HeaderAuthorizationsProvider,
+    StaticAuthorizationsProvider,
+)
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.web.app import GeoMesaApp
+
+
+def vis_store():
+    sft = parse_spec(
+        "tracks",
+        "dtg:Date,*geom:Point,vis:String;geomesa.vis.field='vis'",
+    )
+    ds = DataStore(backend="oracle")
+    ds.create_schema(sft)
+    recs = [
+        {"dtg": 1_500_000_000_000 + i, "geom": Point(i, i), "vis": v}
+        for i, v in enumerate(["admin", "", "user|admin", "secret", "admin&ops"])
+    ]
+    ds.write(
+        "tracks",
+        FeatureTable.from_records(sft, recs, [f"f{i}" for i in range(5)]),
+    )
+    return ds
+
+
+class TestProviders:
+    def test_static(self):
+        assert StaticAuthorizationsProvider(["a", "b"]).auths({}) == ["a", "b"]
+        assert StaticAuthorizationsProvider(None).auths({}) is None
+
+    def test_header_parses_and_fails_closed(self):
+        p = HeaderAuthorizationsProvider()
+        assert p.auths({"HTTP_X_GEOMESA_AUTHS": "admin, ops"}) == ["admin", "ops"]
+        # absent or empty header = NO auths, never unrestricted
+        assert p.auths({}) == []
+        assert p.auths({"HTTP_X_GEOMESA_AUTHS": ""}) == []
+
+    def test_custom_header_name(self):
+        p = HeaderAuthorizationsProvider("X-Roles")
+        assert p.auths({"HTTP_X_ROLES": "user"}) == ["user"]
+
+
+class TestRestEnforcement:
+    @pytest.fixture()
+    def server(self):
+        ds = vis_store()
+        app = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
+        httpd = make_server("127.0.0.1", 0, app)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        httpd.shutdown()
+
+    def _query(self, base, headers):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{base}/api/schemas/tracks/query?format=geojson", headers=headers
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def test_no_header_sees_only_unlabeled(self, server):
+        out = self._query(server, {})
+        assert len(out["features"]) == 1
+
+    def test_header_auths_respected(self, server):
+        out = self._query(server, {"X-Geomesa-Auths": "admin"})
+        assert len(out["features"]) == 3
+        out = self._query(server, {"X-Geomesa-Auths": "admin,ops"})
+        assert len(out["features"]) == 4
+
+    def test_client_cannot_inject_reserved_param(self, server):
+        import urllib.request
+
+        # ?__auths__= must be ignored: provider decides, not the client
+        req = urllib.request.Request(
+            f"{server}/api/schemas/tracks/query?format=geojson"
+            "&__auths__=admin,ops,secret"
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert len(out["features"]) == 1  # still unlabeled-only
+
+    def test_stats_endpoints_enforce_auths(self, server):
+        # counts/bounds/top-k must not leak restricted rows (review finding)
+        import urllib.request
+
+        def get(path, auths=None):
+            headers = {} if auths is None else {"X-Geomesa-Auths": auths}
+            req = urllib.request.Request(f"{server}{path}", headers=headers)
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        base = "/api/schemas/tracks/stats"
+        assert get(f"{base}/count")["count"] == 1  # unlabeled only
+        assert get(f"{base}/count", "admin")["count"] == 3
+        assert get(f"{base}/count", "admin,ops,user,secret")["count"] == 5
+        # bounds over visible rows only: unauthenticated sees just row f1
+        b = get(f"{base}/bounds?attr=dtg")
+        assert b["min"] == b["max"] == 1_500_000_000_001
+
+    def test_count_many_enforces_auths(self, server):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{server}/api/schemas/tracks/count-many",
+            data=json.dumps({"queries": ["INCLUDE"]}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["counts"] == [1]
+
+    def test_no_provider_unrestricted(self):
+        ds = vis_store()
+        app = GeoMesaApp(ds)  # single-tenant default
+        status, body, _ = app._query(
+            "tracks", {"format": "geojson"}, None
+        )
+        assert len(body["features"]) == 5

@@ -103,6 +103,29 @@ class TestStartIndex:
         assert limited.count == 4
         lds.close()
 
+    def test_lambda_store_aggregation_hints(self):
+        # aggregates compute over the MERGED stream, including the
+        # fully-persisted (empty hot tier) case (review finding)
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=1)
+        lds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+        now = 1_500_000_000_000
+        for i in range(8):
+            ts = now - (5000 if i < 4 else 0)
+            lds.write("t", f"f{i}", {"name": f"n{i}", "dtg": ts,
+                                     "geom": Point(i, i)}, ts=ts)
+        assert lds.stream.drain("t")
+        assert lds.persist_once("t", now_ms=now) == 4
+        r = lds.query("t", Query(hints={"stats": "Count()"}))
+        assert r.stats["Count()"].count == 8
+        # drain the hot tier fully: cold-only path must still aggregate
+        lds.stream.cache("t").clear()
+        r = lds.query("t", Query(hints={"stats": "Count()"}))
+        assert r.stats["Count()"].count == 4
+        lds.close()
+
     def test_remote_store_pages(self):
         import threading
         from wsgiref.simple_server import make_server
