@@ -853,7 +853,7 @@ _TIMEOUTS = {"1": 900, "2": 1200, "3": 2400, "4": 1800, "5": 900, "6": 1800,
 _HEADLINE_ORDER = ["2", "1", "5", "6", "7", "3", "4"]  # headline preference
 
 
-def _probe_backend(max_tries: int = 4) -> tuple[str, int, list[str]]:
+def _probe_backend(max_tries: int = 3) -> tuple[str, int, list[str]]:
     """Backend init with retry-with-backoff, each attempt a FRESH process
     (a failed in-process jax backend init cannot be retried). Returns
     (backend, device_count, notes); terminal failure falls back to CPU so
@@ -862,19 +862,30 @@ def _probe_backend(max_tries: int = 4) -> tuple[str, int, list[str]]:
     import sys
 
     notes = []
+    # the probe must exercise COMPUTE, not just enumerate devices: a wedged
+    # relay (orphaned session claim) lists devices fine but hangs every
+    # dispatch — detecting that here turns a whole-sweep cascade of
+    # per-config timeouts into one clean CPU fallback
     code = (
         "import os, jax; "
         "p = os.environ.get('JAX_PLATFORMS'); "
         "_ = jax.config.update('jax_platforms', p) if p else None; "
+        "import jax.numpy as jnp; "
+        "v = jax.jit(lambda x: (x + 1).sum())(jnp.arange(128)); "
+        "assert int(v.block_until_ready()) == 8256; "
         "print(jax.default_backend(), jax.device_count())"
     )
     for attempt in range(max_tries):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=150,
-                env=dict(os.environ),
-            )
+        # first attempt allows a cold compile (~40s over the tunnel); once an
+        # attempt has timed out the tunnel is likely wedged — don't let the
+        # probe phase eat 10 minutes of the sweep budget
+        out = _run_with_graceful_timeout(
+            [sys.executable, "-c", code], dict(os.environ),
+            150 if attempt == 0 else 90,
+        )
+        if out is None:
+            notes.append(f"probe attempt {attempt + 1}: timeout")
+        else:
             if out.returncode == 0 and out.stdout.strip():
                 try:
                     # last line guards against site hooks printing to stdout
@@ -887,8 +898,6 @@ def _probe_backend(max_tries: int = 4) -> tuple[str, int, list[str]]:
                     )
             notes.append(f"probe attempt {attempt + 1}: rc={out.returncode} "
                          f"{out.stderr.strip().splitlines()[-1][:200] if out.stderr.strip() else ''}")
-        except subprocess.TimeoutExpired:
-            notes.append(f"probe attempt {attempt + 1}: timeout")
         time.sleep(min(2 ** attempt, 30))
     notes.append("backend unavailable after retries: falling back to CPU")
     import re
@@ -906,6 +915,44 @@ def _probe_backend(max_tries: int = 4) -> tuple[str, int, list[str]]:
     return "cpu-fallback", n_dev, notes
 
 
+def _run_with_graceful_timeout(cmd, env, cap):
+    """Run a config child; on timeout escalate SIGINT → SIGTERM → SIGKILL.
+
+    A hard kill mid-RPC orphans the axon device-relay session claim and
+    wedges the chip for EVERY later config (observed: one slow config
+    cascaded into a whole-sweep timeout). SIGINT raises KeyboardInterrupt in
+    the child, whose BaseException handler prints its JSON error line and
+    exits cleanly — letting the PJRT plugin's teardown release the claim.
+    Returns a CompletedProcess-alike or None if even that timed out."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=cap)
+        return subprocess.CompletedProcess(cmd, proc.returncode, stdout, stderr)
+    except subprocess.TimeoutExpired:
+        pass
+    for sig, grace in ((signal.SIGINT, 20), (signal.SIGTERM, 10)):
+        proc.send_signal(sig)
+        try:
+            stdout, stderr = proc.communicate(timeout=grace)
+            # a JSON line printed on the way out is still a usable result
+            return subprocess.CompletedProcess(
+                cmd, proc.returncode, stdout, stderr
+            )
+        except subprocess.TimeoutExpired:
+            continue
+    proc.kill()
+    try:
+        proc.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
 def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> dict:
     """One config in a subprocess → its JSON dict (or an error record).
     Isolation means one crashing/hanging config cannot zero the round;
@@ -921,7 +968,9 @@ def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> di
     for attempt in range(retries + 1):
         cap = _TIMEOUTS.get(cfg, 1200)
         if deadline is not None:
-            remaining = deadline - time.monotonic() - 30  # JSON-assembly margin
+            # margin covers JSON assembly PLUS the worst-case kill
+            # escalation (SIGINT 20s + SIGTERM 10s + final reap 10s)
+            remaining = deadline - time.monotonic() - 75
             if remaining < 60:
                 err = (
                     "wall-clock budget exhausted before start" if attempt == 0
@@ -930,23 +979,30 @@ def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> di
                 return {"metric": f"config_{cfg}", "value": None,
                         "unit": "skipped", "vs_baseline": None, "error": err}
             cap = min(cap, remaining)
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, timeout=cap,
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
+        out = _run_with_graceful_timeout(
+            [sys.executable, os.path.abspath(__file__)], env, cap
+        )
+        if out is None:
             last_err = f"timeout after {int(cap)}s"
             continue
         # last stdout line that parses as a JSON object is the result
+        parsed = None
         for line in reversed(out.stdout.strip().splitlines()):
             try:
-                parsed = json.loads(line)
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    return parsed
+                cand = json.loads(line)
+                if isinstance(cand, dict) and "metric" in cand:
+                    parsed = cand
+                    break
             except json.JSONDecodeError:
                 continue
+        if parsed is not None:
+            if "KeyboardInterrupt" in str(parsed.get("error", "")):
+                # graceful-stop timeout: same retry semantics as a hard one
+                last_err = f"timeout after {int(cap)}s (stopped gracefully)"
+                parsed["error"] = last_err
+                if attempt < retries:
+                    continue
+            return parsed
         tail = (out.stderr or out.stdout).strip().splitlines()
         last_err = f"rc={out.returncode}: {tail[-1][:300] if tail else 'no output'}"
         time.sleep(2)

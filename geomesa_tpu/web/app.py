@@ -12,7 +12,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
     POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
-    GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|leaflet
+    GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|csv|leaflet
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
     GET    /api/schemas/{name}/stats/bounds?attr=
@@ -106,6 +106,12 @@ class GeoMesaApp:
         params.pop("__auths__", None)
         if self.auth_provider is not None:
             params["__auths__"] = self.auth_provider.auths(environ)
+        # per-request metrics (the servlet AggregatedMetricsFilter role):
+        # counter per route pattern + total, into the store's registry so
+        # /api/metrics reports request rates alongside store counters
+        metrics = getattr(self.store, "metrics", None)
+        if metrics is not None:
+            metrics.counter("web.requests").inc()
         try:
             body = None
             if method in ("POST", "PUT", "PATCH"):
@@ -118,7 +124,18 @@ class GeoMesaApp:
                 if match:
                     matched_path = True
                     if m == method:
-                        status, payload, ctype = handler(*match.groups(), params=params, body=body)
+                        if metrics is not None:
+                            metrics.counter(
+                                f"web.requests.{handler.__name__.lstrip('_')}"
+                            ).inc()
+                            with metrics.timer("web.request_ms").time():
+                                status, payload, ctype = handler(
+                                    *match.groups(), params=params, body=body
+                                )
+                        else:
+                            status, payload, ctype = handler(
+                                *match.groups(), params=params, body=body
+                            )
                         return self._respond(start_response, status, payload, ctype)
             raise _HttpError(405 if matched_path else 404,
                              "method not allowed" if matched_path else "not found")
@@ -165,13 +182,19 @@ class GeoMesaApp:
 
     def _get_schema(self, name, params, body):
         sft = self.store.get_schema(name)
+        if self._restricted_auths(name, params) is not None:
+            # same leak class as the stats endpoints: the store-wide count
+            # reveals restricted rows — report only the caller-visible count
+            count = self._visible_stat(name, params, "Count()").count
+        else:
+            count = self.store.stats_count(name)
         return 200, {
             "name": sft.name,
             "spec": sft.to_spec(),
             "attributes": [
                 {"name": a.name, "type": a.type.value} for a in sft.attributes
             ],
-            "count": self.store.stats_count(name),
+            "count": count,
         }, "application/json"
 
     def _update_schema(self, name, params, body):
@@ -289,6 +312,19 @@ class GeoMesaApp:
             from geomesa_tpu.io.gml import to_gml
 
             return 200, to_gml(r.table), "application/gml+xml"
+        if fmt == "csv":
+            # the analytics CSV endpoint role (geomesa-web-data)
+            import csv as _csv
+            import io as _io
+
+            buf = _io.StringIO()
+            w = _csv.writer(buf)
+            recs = r.records()
+            cols = ["__fid__"] + (list(recs[0]) if recs else [])
+            w.writerow(cols)
+            for fid, rec in zip(r.table.fids, recs):
+                w.writerow([str(fid)] + [str(rec[c]) for c in cols[1:]])
+            return 200, buf.getvalue().encode("utf-8"), "text/csv"
         if fmt == "leaflet":
             from geomesa_tpu.jupyter import map_html
 

@@ -114,6 +114,20 @@ class TestRestEnforcement:
         b = get(f"{base}/bounds?attr=dtg")
         assert b["min"] == b["max"] == 1_500_000_000_001
 
+    def test_schema_endpoint_count_restricted(self, server):
+        import urllib.request
+
+        def get(auths=None):
+            headers = {} if auths is None else {"X-Geomesa-Auths": auths}
+            req = urllib.request.Request(
+                f"{server}/api/schemas/tracks", headers=headers
+            )
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        assert get()["count"] == 1  # not the store-wide 5
+        assert get("admin")["count"] == 3
+
     def test_count_many_enforces_auths(self, server):
         import urllib.request
 

@@ -209,6 +209,31 @@ class TestQueryIter:
         with pytest.raises(ValueError, match="invalid stat spec"):
             parse_stats("Enumeration(a))")
 
+    def test_web_csv_format_and_request_metrics(self):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = make_store(10)
+        app = GeoMesaApp(ds)
+        status, body, ctype = app._query(
+            "evt", {"format": "csv", "limit": "3", "sortBy": "name"}, None
+        )
+        assert status == 200 and ctype == "text/csv"
+        lines = body.decode().strip().splitlines()
+        assert lines[0].startswith("__fid__,")
+        assert len(lines) == 4  # header + 3 rows
+        # request metrics (AggregatedMetricsFilter role) via WSGI path
+        import io as _io
+
+        environ = {
+            "REQUEST_METHOD": "GET",
+            "PATH_INFO": "/api/schemas/evt/query",
+            "QUERY_STRING": "format=geojson",
+            "wsgi.input": _io.BytesIO(b""),
+        }
+        app(environ, lambda *a, **k: None)
+        assert ds.metrics.counter("web.requests").count == 1
+        assert ds.metrics.counter("web.requests.query").count == 1
+
     def test_web_start_index_param(self):
         from geomesa_tpu.web.app import GeoMesaApp
 
