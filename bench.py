@@ -858,7 +858,6 @@ def _probe_backend(max_tries: int = 3) -> tuple[str, int, list[str]]:
     (a failed in-process jax backend init cannot be retried). Returns
     (backend, device_count, notes); terminal failure falls back to CPU so
     the round still lands numbers (flagged in the output)."""
-    import subprocess
     import sys
 
     notes = []
@@ -958,7 +957,6 @@ def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> di
     Isolation means one crashing/hanging config cannot zero the round;
     ``deadline`` (monotonic) caps the subprocess timeout so the WHOLE run
     always finishes inside the driver's patience and emits its JSON line."""
-    import subprocess
     import sys
 
     env = dict(os.environ)

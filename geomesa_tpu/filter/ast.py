@@ -129,19 +129,32 @@ class BBox(Filter):
         return m & col.is_valid()
 
 
+_VECTOR_SPATIAL_OPS = frozenset(
+    {"intersects", "within", "contains", "disjoint", "dwithin", "beyond",
+     "equals"}
+)
+
+
 @dataclass(frozen=True)
 class SpatialOp(Filter):
-    """intersects / within / contains / disjoint / dwithin against a literal."""
+    """Spatial predicate against a literal geometry: intersects / within /
+    contains / disjoint / dwithin / beyond / equals / crosses / touches /
+    overlaps / relate (DE-9IM pattern)."""
 
-    op: str  # "intersects" | "within" | "contains" | "disjoint" | "dwithin"
+    op: str
     prop: str
     geometry: Geometry
-    distance: float = 0.0  # dwithin only (degrees)
+    distance: float = 0.0  # dwithin/beyond only (degrees)
+    pattern: str = ""  # relate only (DE-9IM, e.g. "T*T******")
 
     def mask(self, table):
         col: GeometryColumn = table.columns[self.prop]  # type: ignore[assignment]
         valid = col.is_valid()
-        if col.type == AttributeType.POINT and col.x is not None:
+        if (
+            col.type == AttributeType.POINT
+            and col.x is not None
+            and self.op in _VECTOR_SPATIAL_OPS
+        ):
             m = self._points_mask(col.x, col.y)
         else:
             geoms = col.geometries()
@@ -166,6 +179,13 @@ class SpatialOp(Filter):
             return ~P.points_intersect_geom(xs, ys, g)
         if self.op == "dwithin":
             return P.points_dist2_geom(xs, ys, g) <= self.distance**2
+        if self.op == "beyond":
+            return P.points_dist2_geom(xs, ys, g) > self.distance**2
+        if self.op == "equals":
+            # a point only equals an identical point
+            if not g.is_point:
+                return np.zeros(len(xs), bool)
+            return (xs == g.x) & (ys == g.y)
         raise ValueError(f"unknown spatial op: {self.op}")
 
     def _scalar(self, geom) -> bool:
@@ -180,6 +200,21 @@ class SpatialOp(Filter):
             return P.disjoint(geom, g)
         if self.op == "dwithin":
             return P.dwithin(geom, g, self.distance)
+        if self.op == "beyond":
+            return not P.dwithin(geom, g, self.distance)
+        # DE-9IM-backed predicates (geometry/ops.py from-scratch relate)
+        from geomesa_tpu.geometry import ops as _ops
+
+        if self.op == "equals":
+            return _ops.equals(geom, g)
+        if self.op == "crosses":
+            return _ops.crosses(geom, g)
+        if self.op == "touches":
+            return _ops.touches(geom, g)
+        if self.op == "overlaps":
+            return _ops.overlaps(geom, g)
+        if self.op == "relate":
+            return _ops.relate_bool(geom, g, self.pattern)
         raise ValueError(f"unknown spatial op: {self.op}")
 
 
@@ -343,16 +378,17 @@ class In(Filter):
 
 @dataclass(frozen=True)
 class Like(Filter):
-    """``prop LIKE pattern`` with ``%``/``_`` wildcards."""
+    """``prop LIKE pattern`` with ``%``/``_`` wildcards (``nocase`` = ILIKE)."""
 
     prop: str
     pattern: str
+    nocase: bool = False
 
     def _regex(self):
         import re
 
         esc = re.escape(self.pattern).replace("%", ".*").replace("_", ".")
-        return re.compile("^" + esc + "$")
+        return re.compile("^" + esc + "$", re.IGNORECASE if self.nocase else 0)
 
     def mask(self, table):
         col = table.columns[self.prop]
@@ -496,8 +532,13 @@ def to_cql(f: Filter) -> str:
         return f"BBOX({f.prop}, {f.xmin}, {f.ymin}, {f.xmax}, {f.ymax})"
     if isinstance(f, SpatialOp):
         wkt = to_wkt(f.geometry)
-        if f.op == "dwithin":
-            return f"DWITHIN({f.prop}, {wkt}, {f.distance}, kilometers)"
+        if f.op in ("dwithin", "beyond"):
+            # distance is stored in degrees; render in km so the remote
+            # parser's unit conversion round-trips exactly
+            km = f.distance * 111.320
+            return f"{f.op.upper()}({f.prop}, {wkt}, {km}, kilometers)"
+        if f.op == "relate":
+            return f"RELATE({f.prop}, {wkt}, {_cql_literal(f.pattern)})"
         return f"{f.op.upper()}({f.prop}, {wkt})"
     if isinstance(f, During):
         return f"{f.prop} DURING {_cql_millis(f.lo_millis)}/{_cql_millis(f.hi_millis)}"
@@ -516,7 +557,8 @@ def to_cql(f: Filter) -> str:
         vals = ", ".join(_cql_literal(v) for v in f.literals)
         return f"{f.prop} IN ({vals})"
     if isinstance(f, Like):
-        return f"{f.prop} LIKE {_cql_literal(f.pattern)}"
+        kw = "ILIKE" if f.nocase else "LIKE"
+        return f"{f.prop} {kw} {_cql_literal(f.pattern)}"
     if isinstance(f, IsNull):
         return f"{f.prop} IS NULL"
     if isinstance(f, FidIn):

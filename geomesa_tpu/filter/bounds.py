@@ -266,8 +266,10 @@ def _walk(f: ast.Filter, geom: str | None, dtg: str | None):
     if isinstance(f, ast.BBox) and f.prop == geom:
         return _split_lon([f.bounds]), None
     if isinstance(f, ast.SpatialOp) and f.prop == geom:
-        if f.op == "disjoint":
-            return None, None  # complement of a box: unconstrained
+        if f.op in ("disjoint", "beyond", "relate"):
+            # matches may lie anywhere (relate patterns can encode
+            # disjointness): unconstrained — evaluated as residual only
+            return None, None
         xmin, ymin, xmax, ymax = f.geometry.bbox
         if f.op == "dwithin":
             d = f.distance

@@ -44,6 +44,10 @@ _SPATIAL_OPS = {
     "WITHIN": "within",
     "CONTAINS": "contains",
     "DISJOINT": "disjoint",
+    "CROSSES": "crosses",
+    "TOUCHES": "touches",
+    "OVERLAPS": "overlaps",
+    "EQUALS": "equals",
 }
 
 
@@ -248,7 +252,7 @@ class _Parser:
             geom = self.wkt()
             self.expect(")")
             return ast.SpatialOp(_SPATIAL_OPS[w], prop, geom)
-        if w == "DWITHIN":
+        if w in ("DWITHIN", "BEYOND"):
             self.take_word()
             self.expect("(")
             prop = self.take_word()
@@ -260,7 +264,23 @@ class _Parser:
             units = self.take_word().lower()
             self.expect(")")
             dist = _to_degrees(dist, units)
-            return ast.SpatialOp("dwithin", prop, geom, distance=dist)
+            return ast.SpatialOp(w.lower(), prop, geom, distance=dist)
+        if w == "RELATE":
+            # RELATE(geom, <wkt>, 'DE-9IM pattern')
+            self.take_word()
+            self.expect("(")
+            prop = self.take_word()
+            self.expect(",")
+            geom = self.wkt()
+            self.expect(",")
+            pattern = self.quoted()
+            self.expect(")")
+            pattern = pattern.upper()
+            if len(pattern) != 9 or any(c not in "TF*012" for c in pattern):
+                raise CQLError(
+                    f"RELATE pattern must be 9 chars of TF*012: {pattern!r}"
+                )
+            return ast.SpatialOp("relate", prop, geom, pattern=pattern)
         if w == "IN":  # bare fid filter
             self.take_word()
             self.expect("(")
@@ -327,9 +347,9 @@ class _Parser:
                 lits.append(self.literal())
             self.expect(")")
             return ast.In(prop, tuple(lits))
-        if nxt == "LIKE":
+        if nxt in ("LIKE", "ILIKE"):
             self.take_word()
-            return ast.Like(prop, self.quoted())
+            return ast.Like(prop, self.quoted(), nocase=nxt == "ILIKE")
         if nxt == "IS":
             self.take_word()
             if self.peek_word() == "NOT":

@@ -856,6 +856,8 @@ def relate(a: Geometry, b: Geometry) -> str:
 
 def relate_bool(a: Geometry, b: Geometry, pattern: str) -> bool:
     """Match a DE-9IM pattern (``T``/``F``/``*``/``0``/``1``/``2``)."""
+    if len(pattern) != 9:
+        raise ValueError(f"DE-9IM pattern must be 9 chars: {pattern!r}")
     m = relate(a, b)
     for mc, pc in zip(m, pattern):
         if pc == "*":

@@ -320,7 +320,12 @@ class GeoMesaApp:
             buf = _io.StringIO()
             w = _csv.writer(buf)
             recs = r.records()
-            cols = ["__fid__"] + (list(recs[0]) if recs else [])
+            # header from the RESULT schema (projection-aware), not the first
+            # record — zero-row pages must keep the same columns
+            cols = ["__fid__"] + [
+                a.name for a in r.table.sft.attributes
+                if a.name in r.table.columns
+            ]
             w.writerow(cols)
             for fid, rec in zip(r.table.fids, recs):
                 w.writerow([str(fid)] + [str(rec[c]) for c in cols[1:]])

@@ -1,0 +1,150 @@
+"""Extended CQL predicate grammar: CROSSES/TOUCHES/OVERLAPS/EQUALS/BEYOND/
+RELATE/ILIKE (reference: full ECQL surface via GeoTools + FastFilterFactory
+— SURVEY.md §2.2; DE-9IM backed by the from-scratch relate in geometry/ops)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import extract
+from geomesa_tpu.filter.cql import CQLError, parse as parse_cql
+from geomesa_tpu.geometry import LineString, Point, Polygon
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+LINE_SPEC = "name:String,*geom:LineString"
+POINT_SPEC = "name:String,*geom:Point"
+
+
+def line_table():
+    sft = parse_spec("t", LINE_SPEC)
+    recs = [
+        # crosses the unit-square boundary through its interior
+        {"name": "crossing", "geom": LineString([(-1.0, 0.5), (2.0, 0.5)])},
+        # touches the square only at its corner
+        {"name": "touching", "geom": LineString([(1.0, 1.0), (2.0, 2.0)])},
+        # entirely inside
+        {"name": "inside", "geom": LineString([(0.2, 0.2), (0.8, 0.8)])},
+        # far away
+        {"name": "far", "geom": LineString([(5.0, 5.0), (6.0, 6.0)])},
+    ]
+    return FeatureTable.from_records(sft, recs, ["a", "b", "c", "d"])
+
+
+SQUARE = "POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"
+
+
+class TestNewSpatialPredicates:
+    def test_crosses(self):
+        t = line_table()
+        m = parse_cql(f"CROSSES(geom, {SQUARE})").mask(t)
+        assert m.tolist() == [True, False, False, False]
+
+    def test_touches(self):
+        t = line_table()
+        m = parse_cql(f"TOUCHES(geom, {SQUARE})").mask(t)
+        assert m.tolist() == [False, True, False, False]
+
+    def test_overlaps_lines(self):
+        sft = parse_spec("t", LINE_SPEC)
+        recs = [
+            {"name": "overlap", "geom": LineString([(0.0, 0.0), (2.0, 0.0)])},
+            {"name": "disjoint", "geom": LineString([(0.0, 5.0), (1.0, 5.0)])},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        m = parse_cql("OVERLAPS(geom, LINESTRING (1 0, 3 0))").mask(t)
+        assert m.tolist() == [True, False]
+
+    def test_equals_points(self):
+        sft = parse_spec("t", POINT_SPEC)
+        recs = [
+            {"name": "same", "geom": Point(3.5, -2.25)},
+            {"name": "other", "geom": Point(3.5, -2.26)},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        m = parse_cql("EQUALS(geom, POINT (3.5 -2.25))").mask(t)
+        assert m.tolist() == [True, False]
+
+    def test_beyond(self):
+        sft = parse_spec("t", POINT_SPEC)
+        recs = [
+            {"name": "near", "geom": Point(0.1, 0.0)},
+            {"name": "far", "geom": Point(10.0, 0.0)},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        m = parse_cql("BEYOND(geom, POINT (0 0), 111.320, kilometers)").mask(t)
+        assert m.tolist() == [False, True]
+        # complement of DWITHIN over valid rows
+        dw = parse_cql("DWITHIN(geom, POINT (0 0), 111.320, kilometers)").mask(t)
+        assert np.array_equal(m, ~dw)
+
+    def test_relate_pattern(self):
+        t = line_table()
+        # interior/interior intersection (first cell T) — inside + crossing
+        m = parse_cql(f"RELATE(geom, {SQUARE}, 'T********')").mask(t)
+        assert m.tolist() == [True, False, True, False]
+
+    def test_relate_bad_pattern(self):
+        with pytest.raises(CQLError, match="9 chars"):
+            parse_cql("RELATE(geom, POINT (0 0), 'T*')")
+
+    def test_ilike(self):
+        sft = parse_spec("t", POINT_SPEC)
+        recs = [
+            {"name": "Alpha", "geom": Point(0, 0)},
+            {"name": "beta", "geom": Point(0, 0)},
+        ]
+        t = FeatureTable.from_records(sft, recs, ["a", "b"])
+        assert parse_cql("name ILIKE 'al%'").mask(t).tolist() == [True, False]
+        assert parse_cql("name LIKE 'al%'").mask(t).tolist() == [False, False]
+
+
+class TestBoundsExtraction:
+    def test_constraining_ops_extract_bbox(self):
+        for cql in (f"CROSSES(geom, {SQUARE})", f"TOUCHES(geom, {SQUARE})",
+                    f"OVERLAPS(geom, {SQUARE})", f"EQUALS(geom, {SQUARE})"):
+            e = extract(parse_cql(cql), "geom", None, ())
+            assert e.boxes is not None
+            assert e.boxes[0] == pytest.approx((0.0, 0.0, 1.0, 1.0))
+
+    def test_unconstrained_ops(self):
+        for cql in ("BEYOND(geom, POINT (0 0), 1, kilometers)",
+                    f"RELATE(geom, {SQUARE}, 'FF*FF****')"):
+            e = extract(parse_cql(cql), "geom", None, ())
+            assert e.boxes is None
+
+    def test_beyond_correct_under_planning(self):
+        # BEYOND must not be planned as a bbox scan: rows OUTSIDE the literal
+        # must still be found through the index-planned path
+        from geomesa_tpu.planning.planner import Query
+        from geomesa_tpu.store.datastore import DataStore
+
+        for backend in ("oracle", "tpu"):
+            ds = DataStore(backend=backend)
+            ds.create_schema(parse_spec("pts", POINT_SPEC))
+            recs = [{"name": f"n{i}", "geom": Point(float(i * 20 - 80), 0.0)}
+                    for i in range(9)]
+            ds.write("pts", recs, fids=[f"f{i}" for i in range(9)])
+            r = ds.query("pts", "BEYOND(geom, POINT (0 0), 3000, kilometers)")
+            near = {f"f{i}" for i in range(9)
+                    if abs(i * 20 - 80) <= 3000 / 111.32}
+            assert set(r.table.fids.tolist()) == {f"f{i}" for i in range(9)} - near
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("cql", [
+        f"CROSSES(geom, {SQUARE})",
+        "BEYOND(geom, POINT (0 0), 5.0, kilometers)",
+        "DWITHIN(geom, POINT (1 2), 10.0, kilometers)",
+        f"RELATE(geom, {SQUARE}, 'T*T******')",
+        "name ILIKE 'a%'",
+    ])
+    def test_to_cql_round_trips(self, cql):
+        f1 = parse_cql(cql)
+        f2 = parse_cql(ast.to_cql(f1))
+        assert type(f1) is type(f2)
+        if isinstance(f1, ast.SpatialOp):
+            assert f1.op == f2.op and f1.pattern == f2.pattern
+            assert f1.distance == pytest.approx(f2.distance)
+        if isinstance(f1, ast.Like):
+            assert (f1.pattern, f1.nocase) == (f2.pattern, f2.nocase)
