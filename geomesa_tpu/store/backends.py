@@ -14,6 +14,7 @@ Two implementations, mirroring the reference's two-tier test architecture
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any
 
@@ -79,6 +80,11 @@ class _MeshIndexState:
     n: int
     kind: str = "points"
 
+    @property
+    def nbytes(self) -> int:
+        """Device bytes held by this index's sharded columns (incl. padding)."""
+        return int(sum(int(c.nbytes) for c in self.cols.values()))
+
 
 class TpuBackend(ExecutionBackend):
     """Mesh-sharded columnar execution: the distributed-scan role of the
@@ -89,8 +95,24 @@ class TpuBackend(ExecutionBackend):
 
     name = "tpu"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, max_device_bytes: int | None = None):
         self._mesh = mesh
+        # PER-TYPE HBM residency budget, enforced on each load() (the
+        # hot-tier half of SURVEY.md §2.20 P9 at device granularity):
+        # indexes past the budget stay host-resident — select() already
+        # falls back per index. A store holding T types can reach T × budget;
+        # size accordingly. Env default so operators can set it without code.
+        if max_device_bytes is None:
+            env = os.environ.get("GEOMESA_DEVICE_BUDGET_BYTES")
+            if env:
+                try:
+                    max_device_bytes = int(env)
+                except ValueError:
+                    raise ValueError(
+                        "GEOMESA_DEVICE_BUDGET_BYTES must be an integer "
+                        f"byte count, got {env!r}"
+                    ) from None
+        self.max_device_bytes = max_device_bytes
 
     def _get_mesh(self):
         if self._mesh is None:
@@ -126,6 +148,21 @@ class TpuBackend(ExecutionBackend):
                 return dev, name
         return None, None
 
+    # residency priority when a device-byte budget applies: the batched
+    # fast paths prefer z3/z2 (point containment) then xz3/xz2 (overlap)
+    _LOAD_PRIORITY = ("z3", "z2", "xz3", "xz2")
+
+    @classmethod
+    def residency(cls, state) -> dict[str, int]:
+        """Per-index device bytes for a backend-state snapshot."""
+        if not state:
+            return {}
+        return {
+            name: dev.nbytes
+            for name, dev in state.items()
+            if isinstance(dev, _MeshIndexState)
+        }
+
     def load(self, sft, table, indices):
         from geomesa_tpu.parallel.mesh import shard_columns
 
@@ -134,7 +171,31 @@ class TpuBackend(ExecutionBackend):
         nlat = norm_lat(REFINE_PRECISION)
         binned = BinnedTime(sft.z3_interval) if sft.dtg_field else None
         mesh = None
-        for name, index in indices.items():
+        ordered = sorted(
+            indices.items(),
+            key=lambda kv: (
+                self._LOAD_PRIORITY.index(kv[0])
+                if kv[0] in self._LOAD_PRIORITY
+                else len(self._LOAD_PRIORITY)
+            ),
+        )
+        used_bytes = 0
+        est = 0
+        if self.max_device_bytes is not None:
+            # admission estimate: int32 columns, rows padded up to a multiple
+            # of the data-shard count (parallel/mesh.pad_rows)
+            from geomesa_tpu.parallel.mesh import data_shards
+
+            mesh = self._get_mesh()
+            n_cols = (
+                4 if (sft.geom_field and table.geom_column().x is not None) else 6
+            )
+            est = n_cols * 4 * (len(table) + data_shards(mesh))
+        for name, index in ordered:
+            if self.max_device_bytes is not None:
+                if used_bytes + est > self.max_device_bytes:
+                    state[name] = None  # host path serves this index
+                    continue
             col = table.geom_column() if sft.geom_field else None
             if col is None or len(table) == 0 or name in ("id",):
                 state[name] = None  # host path
@@ -161,6 +222,7 @@ class TpuBackend(ExecutionBackend):
                 state[name] = _MeshIndexState(
                     cols=cols, rows_per_shard=rows_per_shard, n=len(table)
                 )
+                used_bytes += state[name].nbytes
             else:
                 # extended geometries: shard the bbox SoA for overlap refine.
                 # Null geometries leave NaN bounds — normalize a dummy, then
@@ -196,6 +258,7 @@ class TpuBackend(ExecutionBackend):
                     cols=cols, rows_per_shard=rows_per_shard, n=len(table),
                     kind="bboxes",
                 )
+                used_bytes += state[name].nbytes
         return state
 
     # -- refine payload (int-domain superset bounds) -------------------------

@@ -757,6 +757,37 @@ class DataStore:
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
 
+    def device_residency(self, type_name: str) -> dict:
+        """HBM residency report for one type: per-index device bytes, total,
+        and the backend's budget (the managed hot-tier view of SURVEY.md
+        §2.20 P9 — indexes over budget serve from the host path instead)."""
+        st = self._state(type_name)
+        with st.lock:
+            state = st.backend_state
+        per_index = (
+            TpuBackend.residency(state)
+            if isinstance(self.backend, TpuBackend)
+            else {}
+        )
+        return {
+            "indices": per_index,
+            "total_bytes": int(sum(per_index.values())),
+            "budget_bytes": getattr(self.backend, "max_device_bytes", None),
+            "resident": bool(per_index),
+        }
+
+    def evict_device(self, type_name: str) -> None:
+        """Drop one type's device-resident arrays (host stays authoritative;
+        queries fall back to exact host scans). ``recover(type_name)``
+        re-uploads — together the explicit HBM tier controls."""
+        st = self._state(type_name)
+        # mutate_lock: a concurrent rebuild/recover mid backend.load() would
+        # otherwise re-install device state right after this eviction
+        with st.mutate_lock:
+            with st.lock:
+                st.backend_state = None
+        self.metrics.counter("store.device.evictions").inc()
+
     def query_iter(
         self,
         type_name: str,

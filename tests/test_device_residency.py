@@ -1,0 +1,128 @@
+"""HBM residency management: per-index accounting, explicit evict/recover,
+and budget-capped loads (SURVEY.md §2.20 P9 at device granularity — the
+lambda hot/cold pattern applied to device vs host memory)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import LineString, Point
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.backends import TpuBackend
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,dtg:Date,*geom:Point"
+Q = "BBOX(geom, -50, -25, 50, 25) AND dtg AFTER 2017-07-02T00:00:00Z"
+
+
+def fill(ds, n=3000, seed=11):
+    rng = np.random.default_rng(seed)
+    recs = [
+        {
+            "name": f"n{i}",
+            "dtg": T0 + int(rng.integers(0, 10 * 86_400_000)),
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    ds.write("evt", recs, fids=[f"f{i}" for i in range(n)])
+
+
+class TestResidency:
+    def test_report_and_accounting(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("evt", SPEC))
+        fill(ds)
+        r = ds.device_residency("evt")
+        assert r["resident"] and r["total_bytes"] > 0
+        assert set(r["indices"]) >= {"z3"}
+        # nbytes is the sum over the sharded int32 columns
+        assert r["total_bytes"] == sum(r["indices"].values())
+        assert r["budget_bytes"] is None
+
+    def test_evict_then_recover(self):
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("evt", SPEC))
+        fill(ds)
+        oracle = DataStore(backend="oracle")
+        oracle.create_schema(parse_spec("evt", SPEC))
+        fill(oracle)
+        want = set(oracle.query("evt", Q).table.fids.tolist())
+
+        before = ds.query("evt", Q)
+        assert set(before.table.fids.tolist()) == want
+        ds.evict_device("evt")
+        assert not ds.device_residency("evt")["resident"]
+        # host fallback stays exact
+        assert set(ds.query("evt", Q).table.fids.tolist()) == want
+        assert ds.metrics.counter("store.device.evictions").count == 1
+        assert ds.recover("evt")
+        assert ds.device_residency("evt")["resident"]
+        assert set(ds.query("evt", Q).table.fids.tolist()) == want
+
+    def test_budget_zero_keeps_host_exact(self):
+        ds = DataStore(backend=TpuBackend(max_device_bytes=1))
+        ds.create_schema(parse_spec("evt", SPEC))
+        fill(ds, 500)
+        r = ds.device_residency("evt")
+        assert not r["resident"]
+        assert r["budget_bytes"] == 1
+        oracle = DataStore(backend="oracle")
+        oracle.create_schema(parse_spec("evt", SPEC))
+        fill(oracle, 500)
+        assert set(ds.query("evt", Q).table.fids.tolist()) == set(
+            oracle.query("evt", Q).table.fids.tolist()
+        )
+
+    def test_budget_prioritizes_point_indexes(self):
+        # budget for ~one index: z3 (priority) resident, the rest host
+        ds0 = DataStore(backend="tpu")
+        ds0.create_schema(parse_spec("evt", SPEC))
+        fill(ds0, 2000)
+        z3_bytes = ds0.device_residency("evt")["indices"]["z3"]
+
+        ds = DataStore(backend=TpuBackend(max_device_bytes=int(z3_bytes * 1.5)))
+        ds.create_schema(parse_spec("evt", SPEC))
+        fill(ds, 2000)
+        r = ds.device_residency("evt")
+        assert list(r["indices"]) == ["z3"]
+        assert r["total_bytes"] <= int(z3_bytes * 1.5)
+
+    def test_env_budget(self, monkeypatch):
+        monkeypatch.setenv("GEOMESA_DEVICE_BUDGET_BYTES", "123456")
+        assert TpuBackend().max_device_bytes == 123456
+        monkeypatch.setenv("GEOMESA_DEVICE_BUDGET_BYTES", "8G")
+        with pytest.raises(ValueError, match="GEOMESA_DEVICE_BUDGET_BYTES"):
+            TpuBackend()
+        monkeypatch.delenv("GEOMESA_DEVICE_BUDGET_BYTES")
+        assert TpuBackend().max_device_bytes is None
+
+    def test_evict_not_lost_to_concurrent_recover(self):
+        # eviction holds the mutate lock, so it serializes against recover()
+        import threading
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("evt", SPEC))
+        fill(ds, 800)
+        stop = threading.Event()
+        errs = []
+
+        def churn():
+            try:
+                while not stop.is_set():
+                    ds.recover("evt")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for _ in range(10):
+                ds.evict_device("evt")
+                # either state: evicted, or a subsequent recover re-installed
+                # it — but never a torn/partial state; queries stay exact
+                assert ds.query("evt", Q).count >= 0
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errs
