@@ -587,3 +587,74 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
         return jax.lax.psum(grids, DATA_AXIS)
 
     return step
+
+
+def make_ring_knn_step(mesh: Mesh, k: int):
+    """Batched KNN with a RING top-k merge over the data axis (``ppermute``).
+
+    Same contract as :func:`make_batched_knn_step`, different collective
+    topology: instead of ``all_gather``-ing every shard's candidate heap
+    (O(D·k) resident per device), each device keeps a running best-k and
+    passes its heap one hop around the ring for D-1 steps — O(k) payload per
+    hop, the ring-parallel pattern the scaling-book recipe uses for
+    long-sequence attention. Preferable when D·k·Q would pressure VMEM/HBM
+    (large query batches on big meshes); distances are identical to the
+    all_gather form (row choice may differ where k-th distances tie).
+    """
+
+    sx = np.float32(360.0 / 2**31)
+    sy = np.float32(180.0 / 2**31)
+    n_shards = data_shards(mesh)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(),
+            P(QUERY_AXIS), P(QUERY_AXIS),
+        ),
+        out_specs=(P(QUERY_AXIS, None), P(QUERY_AXIS, None)),
+        check_vma=False,
+    )
+    def step(x, y, true_n, qx, qy):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+        xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
+        yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
+
+        def one(q):
+            qxi, qyi = q
+            d2 = (xf - qxi) ** 2 + (yf - qyi) ** 2
+            d2 = jnp.where(valid, d2, jnp.inf)
+            nd, ni = jax.lax.top_k(-d2, k)
+            return -nd, base + ni.astype(jnp.int32)
+
+        # local candidate heaps, sequential over queries (peak memory O(N))
+        dloc, iloc = jax.lax.map(one, (qx, qy))  # (Ql, k) each
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def hop(carry, _):
+            best_d, best_i, ring_d, ring_i = carry
+            # receive the neighbor's heap, fold into the running best-k
+            ring_d = jax.lax.ppermute(ring_d, DATA_AXIS, perm)
+            ring_i = jax.lax.ppermute(ring_i, DATA_AXIS, perm)
+            cat_d = jnp.concatenate([best_d, ring_d], axis=1)  # (Ql, 2k)
+            cat_i = jnp.concatenate([best_i, ring_i], axis=1)
+            nd, sel = jax.lax.top_k(-cat_d, k)
+            best_d = -nd
+            best_i = jnp.take_along_axis(cat_i, sel, axis=1)
+            return (best_d, best_i, ring_d, ring_i), None
+
+        (best_d, best_i, _, _), _ = jax.lax.scan(
+            hop, (dloc, iloc, dloc, iloc), None, length=n_shards - 1
+        )
+        return jnp.sqrt(best_d), best_i
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_ring_knn_step(mesh: Mesh, k: int):
+    return make_ring_knn_step(mesh, k)

@@ -69,7 +69,8 @@ def knn(
     return r.table.take(order), d[order]
 
 
-def knn_many(ds, type_name: str, points, k: int = 10):
+def knn_many(ds, type_name: str, points, k: int = 10,
+             topology: str = "gather"):
     """Batched KNN: all query points answered in ONE device pass.
 
     Device path (TpuBackend): per-shard f32 distance scan + ``top_k``,
@@ -78,9 +79,16 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     reference's per-point window-doubling loop collapses into a single
     sweep. Other backends fall back to per-point :func:`knn`.
 
+    ``topology``: heap-merge collective — ``"gather"`` (all_gather, one
+    round) or ``"ring"`` (ppermute, D-1 hops of O(k) payload — for big
+    meshes × large query batches where D·k·Q pressures memory). Identical
+    distances; row choice may differ where k-th distances tie.
+
     Returns a list of (table, distances_deg) pairs, one per query point,
     each holding that point's k nearest features sorted by distance.
     """
+    if topology not in ("gather", "ring"):
+        raise ValueError(f"topology must be gather|ring: {topology!r}")
     from geomesa_tpu.store.backends import TpuBackend
 
     st = ds._state(type_name)
@@ -104,11 +112,15 @@ def knn_many(ds, type_name: str, points, k: int = 10):
     import jax.numpy as jnp
 
     from geomesa_tpu.parallel.mesh import pad_query_axis
-    from geomesa_tpu.parallel.query import cached_batched_knn_step
+    from geomesa_tpu.parallel.query import (
+        cached_batched_knn_step,
+        cached_ring_knn_step,
+    )
 
     mesh = ds.backend._get_mesh()
     kk = min(k, main_n)
-    step = cached_batched_knn_step(mesh, kk)
+    maker = cached_ring_knn_step if topology == "ring" else cached_batched_knn_step
+    step = maker(mesh, kk)
     qx = np.array([p.x for p in points], dtype=np.float32)
     qy = np.array([p.y for p in points], dtype=np.float32)
     (qx, qy), _ = pad_query_axis(mesh, qx, qy)

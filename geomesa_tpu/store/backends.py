@@ -100,8 +100,10 @@ class TpuBackend(ExecutionBackend):
         # PER-TYPE HBM residency budget, enforced on each load() (the
         # hot-tier half of SURVEY.md §2.20 P9 at device granularity):
         # indexes past the budget stay host-resident — select() already
-        # falls back per index. A store holding T types can reach T × budget;
-        # size accordingly. Env default so operators can set it without code.
+        # falls back per index. The budget counts TOTAL bytes across the
+        # mesh (all shards summed), not per device; a store holding T types
+        # can reach T × budget — size accordingly. Env default so operators
+        # can set it without code.
         if max_device_bytes is None:
             env = os.environ.get("GEOMESA_DEVICE_BUDGET_BYTES")
             if env:

@@ -1,0 +1,93 @@
+"""Ring-collective KNN merge (ppermute over the data axis): identical
+results to the all_gather heap merge, O(k) per-hop payload — the ring
+sequence-parallel pattern over the z-curve axis (SURVEY.md §5)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.parallel.mesh import make_mesh, shard_columns
+from geomesa_tpu.parallel.query import (
+    cached_batched_knn_step,
+    cached_ring_knn_step,
+)
+
+
+def _store(n=4096, seed=5):
+    rng = np.random.default_rng(seed)
+    lon = rng.uniform(-180, 180, n)
+    lat = rng.uniform(-90, 90, n)
+    order = np.lexsort((lat, lon))
+    xi = ((lon[order] + 180.0) / 360.0 * 2**31).astype(np.int32)
+    yi = ((lat[order] + 90.0) / 180.0 * 2**31).astype(np.int32)
+    return xi, yi
+
+
+class TestRingKnn:
+    def test_matches_allgather_merge(self):
+        xi, yi = _store()
+        mesh = make_mesh(8, query_parallel=2)
+        cols, _, _ = shard_columns(mesh, {"x": xi, "y": yi})
+        qx = jnp.asarray(np.linspace(-150, 150, 4, dtype=np.float32))
+        qy = jnp.asarray(np.linspace(-60, 60, 4, dtype=np.float32))
+        k = 7
+        d_ag, r_ag = cached_batched_knn_step(mesh, k)(
+            cols["x"], cols["y"], jnp.int32(len(xi)), qx, qy
+        )
+        d_ring, r_ring = cached_ring_knn_step(mesh, k)(
+            cols["x"], cols["y"], jnp.int32(len(xi)), qx, qy
+        )
+        assert np.allclose(np.asarray(d_ag), np.asarray(d_ring))
+        # same rows modulo equal-distance ties: compare distance multisets
+        # exactly and row sets where distances are strictly increasing
+        d = np.asarray(d_ag)
+        strict = np.diff(d, axis=1) > 0
+        ra, rr = np.asarray(r_ag), np.asarray(r_ring)
+        for q in range(d.shape[0]):
+            if strict[q].all():
+                assert set(ra[q]) == set(rr[q])
+
+    def test_knn_many_ring_topology(self):
+        from geomesa_tpu.geometry import Point
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.store.datastore import DataStore
+
+        rng = np.random.default_rng(2)
+        recs = [
+            {"name": f"n{i}",
+             "geom": Point(float(rng.uniform(-180, 180)),
+                           float(rng.uniform(-90, 90)))}
+            for i in range(3000)
+        ]
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("pts", "name:String,*geom:Point"))
+        ds.write("pts", recs, fids=[f"f{i}" for i in range(3000)])
+        pts = [Point(10.0, 5.0), Point(-45.0, 30.0)]
+        a = knn_many(ds, "pts", pts, k=6, topology="gather")
+        b = knn_many(ds, "pts", pts, k=6, topology="ring")
+        for (ta, da), (tb, db) in zip(a, b):
+            assert np.allclose(da, db)
+            assert sorted(ta.fids.tolist()) == sorted(tb.fids.tolist())
+        import pytest
+
+        with pytest.raises(ValueError, match="topology"):
+            knn_many(ds, "pts", pts, k=2, topology="mesh")
+
+    def test_ring_correct_vs_bruteforce(self):
+        xi, yi = _store(2048, seed=9)
+        mesh = make_mesh(8)
+        cols, _, _ = shard_columns(mesh, {"x": xi, "y": yi})
+        qx = np.array([10.0, -45.0], dtype=np.float32)
+        qy = np.array([5.0, 30.0], dtype=np.float32)
+        k = 5
+        d_ring, rows = cached_ring_knn_step(mesh, k)(
+            cols["x"], cols["y"], jnp.int32(len(xi)), jnp.asarray(qx), jnp.asarray(qy)
+        )
+        d_ring = np.asarray(d_ring)
+        sx, sy = np.float32(360.0 / 2**31), np.float32(180.0 / 2**31)
+        xf = xi.astype(np.float32) * sx - np.float32(180.0)
+        yf = yi.astype(np.float32) * sy - np.float32(90.0)
+        for q in range(2):
+            d2 = (xf - qx[q]) ** 2 + (yf - qy[q]) ** 2
+            want = np.sort(np.sqrt(d2.astype(np.float64)))[:k]
+            assert np.allclose(np.sort(d_ring[q]), want, rtol=1e-5)
