@@ -417,6 +417,50 @@ class IsNull(Filter):
         return ~table.columns[self.prop].is_valid()
 
 
+_PROP_FUNCS = {
+    # name (lowercase) -> value transform over a 1-d column array
+    "strtouppercase": lambda v: np.array([s.upper() if isinstance(s, str) else s for s in v], dtype=object),
+    "strtolowercase": lambda v: np.array([s.lower() if isinstance(s, str) else s for s in v], dtype=object),
+    "strtrim": lambda v: np.array([s.strip() if isinstance(s, str) else s for s in v], dtype=object),
+    "strlength": lambda v: np.array([len(s) if isinstance(s, str) else -1 for s in v], dtype=np.int64),
+    "abs": lambda v: np.abs(np.asarray(v, dtype=np.float64)),
+    "floor": lambda v: np.floor(np.asarray(v, dtype=np.float64)),
+    "ceil": lambda v: np.ceil(np.asarray(v, dtype=np.float64)),
+    "datetolong": lambda v: np.asarray(v, dtype=np.int64),
+}
+
+
+@dataclass(frozen=True)
+class FuncCompare(Filter):
+    """``func(attr) <op> literal`` — property-function predicates (the
+    ``FastFilterFactory`` function-expression role, SURVEY.md §2.2).
+
+    Functions: strToUpperCase, strToLowerCase, strTrim, strLength, abs,
+    floor, ceil, dateToLong. Null attribute values never match."""
+
+    func: str  # lowercase key into _PROP_FUNCS
+    op: str  # =, <>, <, <=, >, >=
+    prop: str
+    literal: Any
+
+    def mask(self, table):
+        col = table.columns[self.prop]
+        v = _PROP_FUNCS[self.func](col.values)
+        lit = self.literal
+        cmp = _CMP[self.op]
+        if v.dtype == object:
+            out = np.zeros(len(v), dtype=bool)
+            for i, val in enumerate(v):
+                if val is None:
+                    continue
+                try:
+                    out[i] = bool(cmp(val, lit))
+                except TypeError:
+                    pass
+            return out & col.is_valid()
+        return cmp(v, lit) & col.is_valid()
+
+
 @dataclass(frozen=True)
 class JsonPathCompare(Filter):
     """``jsonPath('<path>', attr) <op> <literal>`` — compare a value inside a
@@ -549,6 +593,8 @@ def to_cql(f: Filter) -> str:
             f"jsonPath({_cql_literal(f.path)}, {f.prop}) "
             f"{f.op} {_cql_literal(f.literal)}"
         )
+    if isinstance(f, FuncCompare):
+        return f"{f.func}({f.prop}) {f.op} {_cql_literal(f.literal)}"
     if isinstance(f, Compare):
         return f"{f.prop} {f.op} {_cql_literal(f.literal)}"
     if isinstance(f, Between):

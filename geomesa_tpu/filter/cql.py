@@ -107,6 +107,16 @@ class _Parser:
             return True
         return False
 
+    def _comparison_op(self, after: str) -> str:
+        """Consume one of <> <= >= = < > or raise (shared by the jsonPath,
+        property-function, and plain comparison predicate tails)."""
+        self.skip_ws()
+        for op in ("<>", "<=", ">=", "=", "<", ">"):
+            if self.s.startswith(op, self.pos):
+                self.pos += len(op)
+                return op
+        raise CQLError(f"expected comparison after {after} at {self.pos}")
+
     def number(self) -> float:
         self.skip_ws()
         m = _NUMBER.match(self.s, self.pos)
@@ -310,13 +320,23 @@ class _Parser:
             path, attr = (a1, a2) if str(a1).startswith("$") else (a2, a1)
             if not str(path).startswith("$"):
                 raise CQLError(f"jsonPath needs a '$...' path: {a1!r}, {a2!r}")
+            op = self._comparison_op(after="jsonPath")
+            return ast.JsonPathCompare(op, str(path), str(attr), self.literal())
+
+        if w.lower() in ast._PROP_FUNCS:
+            # func(attr) <op> literal — FastFilterFactory function role.
+            # Only a call shape selects this branch: an ATTRIBUTE merely
+            # named 'abs'/'floor'/... must still parse as a plain predicate
+            save = self.pos
+            func = self.take_word().lower()
             self.skip_ws()
-            for op in ("<>", "<=", ">=", "=", "<", ">"):
-                if self.s.startswith(op, self.pos):
-                    self.pos += len(op)
-                    lit = self.literal()
-                    return ast.JsonPathCompare(op, str(path), str(attr), lit)
-            raise CQLError(f"expected comparison after jsonPath at {self.pos}")
+            if self.s.startswith("(", self.pos):
+                self.expect("(")
+                prop = self.take_word()
+                self.expect(")")
+                op = self._comparison_op(after=f"{func}()")
+                return ast.FuncCompare(func, op, prop, self.literal())
+            self.pos = save  # not a call: fall through to property-led
 
         # property-led predicates
         prop = self.take_word()
@@ -362,15 +382,14 @@ class _Parser:
             return ast.IsNull(prop)
 
         # comparison operators
-        self.skip_ws()
-        for op in ("<>", "<=", ">=", "=", "<", ">"):
-            if self.s.startswith(op, self.pos):
-                self.pos += len(op)
-                lit = self.literal()
-                return ast.Compare(op, prop, lit)
-        raise CQLError(
-            f"cannot parse predicate at {self.pos}: {self.s[self.pos:self.pos+30]!r}"
-        )
+        try:
+            op = self._comparison_op(after=f"property {prop!r}")
+        except CQLError:
+            raise CQLError(
+                f"cannot parse predicate at {self.pos}: "
+                f"{self.s[self.pos:self.pos+30]!r}"
+            ) from None
+        return ast.Compare(op, prop, self.literal())
 
 
 _METERS_PER_DEGREE = 111_320.0

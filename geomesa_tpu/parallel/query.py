@@ -422,6 +422,30 @@ def make_batched_overlap_step(mesh: Mesh, with_time: bool = False):
     return step
 
 
+def _local_knn_heaps(x, y, true_n, qx, qy, k):
+    """Per-shard candidate heaps shared by the gather and ring KNN steps:
+    decode int32 coords to planar f32 degrees, mask padded rows, and top_k
+    each query sequentially (peak memory O(N), not O(Q·N)).
+
+    Returns (dists² (Ql, k) ascending, global rows (Ql, k) int32)."""
+    sx = np.float32(360.0 / 2**31)
+    sy = np.float32(180.0 / 2**31)
+    n = x.shape[0]
+    base = jax.lax.axis_index(DATA_AXIS) * n
+    valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+    xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
+    yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
+
+    def one(q):
+        qxi, qyi = q
+        d2 = (xf - qxi) ** 2 + (yf - qyi) ** 2
+        d2 = jnp.where(valid, d2, jnp.inf)
+        nd, ni = jax.lax.top_k(-d2, k)
+        return -nd, base + ni.astype(jnp.int32)
+
+    return jax.lax.map(one, (qx, qy))  # (Ql, k) each
+
+
 def make_batched_knn_step(mesh: Mesh, k: int):
     """Batched multi-point KNN in ONE pass: per-shard distance scan +
     ``top_k``, candidates ``all_gather``-merged over the data axis and
@@ -435,9 +459,6 @@ def make_batched_knn_step(mesh: Mesh, k: int):
     use the same f32 math; int→f32 coordinate rounding is ~2e-5°).
     """
 
-    sx = np.float32(360.0 / 2**31)
-    sy = np.float32(180.0 / 2**31)
-
     @jax.jit
     @partial(
         shard_map,
@@ -450,21 +471,7 @@ def make_batched_knn_step(mesh: Mesh, k: int):
         check_vma=False,
     )
     def step(x, y, true_n, qx, qy):
-        n = x.shape[0]
-        base = jax.lax.axis_index(DATA_AXIS) * n
-        valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
-        xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
-        yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
-
-        def one(q):
-            qxi, qyi = q
-            d2 = (xf - qxi) ** 2 + (yf - qyi) ** 2
-            d2 = jnp.where(valid, d2, jnp.inf)
-            nd, ni = jax.lax.top_k(-d2, k)
-            return -nd, base + ni.astype(jnp.int32)
-
-        # sequential over queries: peak memory O(N), not O(Q·N)
-        dloc, iloc = jax.lax.map(one, (qx, qy))  # (Ql, k) each
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k)
         # merge per-shard candidate heaps across the mesh
         ad = jax.lax.all_gather(dloc, DATA_AXIS, axis=0)  # (D, Ql, k)
         ai = jax.lax.all_gather(iloc, DATA_AXIS, axis=0)
@@ -602,8 +609,6 @@ def make_ring_knn_step(mesh: Mesh, k: int):
     all_gather form (row choice may differ where k-th distances tie).
     """
 
-    sx = np.float32(360.0 / 2**31)
-    sy = np.float32(180.0 / 2**31)
     n_shards = data_shards(mesh)
 
     @jax.jit
@@ -618,21 +623,7 @@ def make_ring_knn_step(mesh: Mesh, k: int):
         check_vma=False,
     )
     def step(x, y, true_n, qx, qy):
-        n = x.shape[0]
-        base = jax.lax.axis_index(DATA_AXIS) * n
-        valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
-        xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
-        yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
-
-        def one(q):
-            qxi, qyi = q
-            d2 = (xf - qxi) ** 2 + (yf - qyi) ** 2
-            d2 = jnp.where(valid, d2, jnp.inf)
-            nd, ni = jax.lax.top_k(-d2, k)
-            return -nd, base + ni.astype(jnp.int32)
-
-        # local candidate heaps, sequential over queries (peak memory O(N))
-        dloc, iloc = jax.lax.map(one, (qx, qy))  # (Ql, k) each
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k)
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
         def hop(carry, _):

@@ -1,0 +1,89 @@
+"""CQL property-function predicates (FastFilterFactory function-expression
+role — SURVEY.md §2.2): func(attr) <op> literal."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.cql import CQLError, parse as parse_cql
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+SPEC = "name:String,age:Integer,score:Double,dtg:Date,*geom:Point"
+T0 = 1_498_867_200_000
+
+
+def table():
+    sft = parse_spec("t", SPEC)
+    recs = [
+        {"name": "Alpha", "age": -5, "score": 1.6, "dtg": T0, "geom": Point(0, 0)},
+        {"name": "beta ", "age": 3, "score": -2.4, "dtg": T0 + 1000, "geom": Point(1, 1)},
+        {"name": None, "age": 10, "score": 0.5, "dtg": T0 + 2000, "geom": Point(2, 2)},
+    ]
+    return FeatureTable.from_records(sft, recs, ["a", "b", "c"])
+
+
+class TestFuncCompare:
+    def test_str_functions(self):
+        t = table()
+        assert parse_cql("strToUpperCase(name) = 'ALPHA'").mask(t).tolist() == [True, False, False]
+        assert parse_cql("strToLowerCase(name) = 'alpha'").mask(t).tolist() == [True, False, False]
+        assert parse_cql("strTrim(name) = 'beta'").mask(t).tolist() == [False, True, False]
+        assert parse_cql("strLength(name) = 5").mask(t).tolist() == [True, True, False]
+
+    def test_numeric_functions(self):
+        t = table()
+        assert parse_cql("abs(age) = 5").mask(t).tolist() == [True, False, False]
+        assert parse_cql("floor(score) = 1").mask(t).tolist() == [True, False, False]
+        assert parse_cql("ceil(score) = -2").mask(t).tolist() == [False, True, False]
+        assert parse_cql("abs(score) > 2").mask(t).tolist() == [False, True, False]
+
+    def test_date_to_long(self):
+        t = table()
+        m = parse_cql(f"dateToLong(dtg) >= {T0 + 1000}").mask(t)
+        assert m.tolist() == [False, True, True]
+
+    def test_null_never_matches(self):
+        t = table()
+        # name is null in row c: no function comparison may match it
+        assert not parse_cql("strLength(name) < 100").mask(t)[2]
+
+    def test_round_trip(self):
+        f1 = parse_cql("strToLowerCase(name) <> 'x'")
+        f2 = parse_cql(ast.to_cql(f1))
+        assert f1 == f2
+
+    def test_composes_with_planning(self):
+        from geomesa_tpu.store.datastore import DataStore
+
+        for backend in ("oracle", "tpu"):
+            ds = DataStore(backend=backend)
+            ds.create_schema(parse_spec("t", SPEC))
+            rng = np.random.default_rng(4)
+            recs = [
+                {"name": f"N{i % 7}", "age": int(rng.integers(-50, 50)),
+                 "score": 0.0, "dtg": T0 + i,
+                 "geom": Point(float(i % 30), float(i % 15))}
+                for i in range(500)
+            ]
+            ds.write("t", recs, fids=[str(i) for i in range(500)])
+            r = ds.query(
+                "t", "BBOX(geom, 0, 0, 10, 10) AND strToLowerCase(name) = 'n3'"
+            )
+            want = {
+                str(i) for i in range(500)
+                if i % 30 <= 10 and i % 15 <= 10 and i % 7 == 3
+            }
+            assert set(r.table.fids.tolist()) == want
+
+    def test_parse_error(self):
+        with pytest.raises(CQLError):
+            parse_cql("strLength(name) LIKE 'x'")
+
+    def test_property_named_like_function(self):
+        # an attribute literally named 'abs' still parses as a plain compare
+        f = parse_cql("abs > 3")
+        assert isinstance(f, ast.Compare) and f.prop == "abs"
+        f = parse_cql("floor BETWEEN 1 AND 2")
+        assert isinstance(f, ast.Between) and f.prop == "floor"
