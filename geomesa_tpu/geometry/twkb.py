@@ -23,7 +23,7 @@ from geomesa_tpu.geometry.types import (
     Polygon,
 )
 
-__all__ = ["to_twkb", "from_twkb"]
+__all__ = ["to_twkb", "from_twkb", "from_twkb_batch"]
 
 _TYPES = {
     Point: 1,
@@ -187,3 +187,95 @@ def from_twkb(data: bytes) -> Geometry | None:
             polys.append(Polygon(rings[0], holes=tuple(rings[1:])))
         return MultiPolygon(polys)
     raise ValueError(f"unknown TWKB type {t}")
+
+
+def from_twkb_batch(blobs) -> np.ndarray:
+    """Decode a column of TWKB blobs → object array of geometries (None for
+    empty/null slots).
+
+    Fast path: one native C++ pass over the concatenated buffer
+    (``native/twkb.cpp``) producing flat count/coord arrays, reassembled here
+    with numpy slicing; falls back to per-blob :func:`from_twkb`.
+    """
+    blobs = list(blobs)
+    n = len(blobs)
+    out = np.empty(n, dtype=object)
+    if n == 0:
+        return out
+    from geomesa_tpu import native
+
+    decoded = None
+    # only pay the concat + offsets build when the fast path can run
+    if all(b is not None for b in blobs) and native._twkb_lib() is not None:
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        for i, b in enumerate(blobs):
+            offsets[i + 1] = offsets[i] + len(b)
+        decoded = native.twkb_decode_batch(b"".join(blobs), offsets)
+    if decoded is None:
+        for i, b in enumerate(blobs):
+            out[i] = None if b is None else from_twkb(b)
+        return out
+
+    types, gpc, npolys, prc, psz, coords = decoded
+    # prefix sums: where each geometry's parts/polys/coords start
+    part_starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(gpc, out=part_starts[1:])
+    poly_starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(npolys, out=poly_starts[1:])
+    coord_of_part = np.zeros(len(psz) + 1, dtype=np.int64)
+    np.cumsum(psz, out=coord_of_part[1:])
+
+    for i in range(n):
+        t = int(types[i])
+        p0 = int(part_starts[i])
+        if t == 0:
+            out[i] = None
+            continue
+        c0 = int(coord_of_part[p0])
+        # slices are COPIED: a retained geometry must not pin the whole
+        # column-wide coords buffer
+        if t == 1:
+            out[i] = Point(coords[c0, 0], coords[c0, 1])
+        elif t == 2:
+            out[i] = LineString(coords[c0 : int(coord_of_part[p0 + 1])].copy())
+        elif t == 3:
+            nr = int(gpc[i])
+            rings = [
+                coords[int(coord_of_part[p0 + j]) : int(coord_of_part[p0 + j + 1])].copy()
+                for j in range(nr)
+            ]
+            out[i] = Polygon(rings[0], holes=tuple(rings[1:]))
+        elif t == 4:
+            k = int(gpc[i])
+            out[i] = MultiPoint(
+                [
+                    Point(coords[int(coord_of_part[p0 + j]), 0],
+                          coords[int(coord_of_part[p0 + j]), 1])
+                    for j in range(k)
+                ]
+            )
+        elif t == 5:
+            k = int(gpc[i])
+            out[i] = MultiLineString(
+                [
+                    LineString(
+                        coords[int(coord_of_part[p0 + j]) : int(coord_of_part[p0 + j + 1])].copy()
+                    )
+                    for j in range(k)
+                ]
+            )
+        elif t == 6:
+            polys = []
+            part = p0
+            for pj in range(int(npolys[i])):
+                nr = int(prc[int(poly_starts[i]) + pj])
+                rings = [
+                    coords[int(coord_of_part[part + j]) : int(coord_of_part[part + j + 1])].copy()
+                    for j in range(nr)
+                ]
+                part += nr
+                polys.append(Polygon(rings[0], holes=tuple(rings[1:])))
+            out[i] = MultiPolygon(polys)
+        else:
+            raise ValueError(f"unknown TWKB type {t}")
+    return out

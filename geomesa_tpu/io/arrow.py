@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
+from geomesa_tpu.geometry.twkb import from_twkb_batch, to_twkb
 from geomesa_tpu.geometry.types import Point
 from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
 from geomesa_tpu.schema.columnar import Column, FeatureTable, GeometryColumn, point_column
@@ -54,13 +55,18 @@ def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
             arrays.append(arr)
         elif a.type.is_geometry:
             gc = col  # type: ignore[assignment]
-            wkts = [
-                None if g is None else to_wkt(g) for g in gc.geometries()
-            ]
-            arr = pa.array(wkts, type=pa.string())
+            # TWKB binary (~4x smaller than WKT; native batch decode on
+            # read; reference-default precision 7 ≈ 1 cm quantization —
+            # lossless for real geodata). None/invalid slots encode as
+            # TWKB-empty, keeping the column non-null so the native batch
+            # decoder takes one pass
+            blobs = [to_twkb(g) for g in gc.geometries()]
+            arr = pa.array(blobs, type=pa.binary())
             if dictionary_encode:
+                # repeated footprints dedup to dictionary codes (the
+                # ArrowDictionary role applies to geometries too)
                 arr = arr.dictionary_encode()
-            fields.append(pa.field(a.name, arr.type, metadata={b"geom": b"wkt"}))
+            fields.append(pa.field(a.name, arr.type, metadata={b"geom": b"twkb"}))
             arrays.append(arr)
         elif a.type == AttributeType.DATE:
             arr = pa.array(col.values, type=pa.timestamp("ms"), mask=mask)
@@ -106,15 +112,21 @@ def from_arrow(sft: FeatureType, atable: pa.Table) -> FeatureTable:
                 cols[a.name] = point_column(xs, ys, valid=valid_mask)
         elif a.type.is_geometry:
             vals = ac.to_pylist()
-            geoms = np.empty(n, dtype=object)
-            valid = np.ones(n, dtype=bool)
+            base_type = (
+                ac.type.value_type
+                if isinstance(ac.type, pa.DictionaryType)
+                else ac.type
+            )
+            if pa.types.is_binary(base_type) or pa.types.is_large_binary(base_type):
+                geoms = from_twkb_batch(vals)  # native batch decode
+            else:  # legacy catalogs: WKT strings
+                geoms = np.empty(n, dtype=object)
+                for i, w in enumerate(vals):
+                    geoms[i] = None if w is None else from_wkt(w)
+            valid = np.array([g is not None for g in geoms], dtype=bool)
             bounds = np.full((n, 4), np.nan)
-            for i, w in enumerate(vals):
-                if w is None:
-                    valid[i] = False
-                else:
-                    g = from_wkt(w)
-                    geoms[i] = g
+            for i, g in enumerate(geoms):
+                if g is not None:
                     bounds[i] = g.bbox
             cols[a.name] = GeometryColumn(
                 a.type, geoms, None if valid.all() else valid, bounds=bounds

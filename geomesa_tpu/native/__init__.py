@@ -248,3 +248,65 @@ def parse_delimited(data: bytes, delim: str, columns: list[tuple[int, int]]):
             a = a.view(np.int64)[: len(a)]
         arrays.append(a.copy())
     return arrays, valid[:, :got].astype(bool)
+
+
+# -- twkb batch decode --------------------------------------------------------
+
+def _twkb_lib():
+    lib = _load_lib("twkb")
+    if lib is not None and not getattr(lib, "_configured", False):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.twkb_scan.restype = ctypes.c_int
+        lib.twkb_scan.argtypes = [u8p, i64p, ctypes.c_int64, i64p, i64p, i64p]
+        lib.twkb_decode.restype = ctypes.c_int
+        lib.twkb_decode.argtypes = [
+            u8p, i64p, ctypes.c_int64, i8p, i32p, i32p, i32p, i32p, f64p,
+        ]
+        lib._configured = True
+    return lib
+
+
+def twkb_decode_batch(buf: bytes, offsets: np.ndarray):
+    """Decode ``n`` concatenated TWKB blobs (``offsets``: (n+1,) int64 into
+    ``buf``) → (types i8 (n,), geom_part_counts i32 (n,), npolys i32 (n,),
+    poly_ring_counts i32, part_sizes i32, coords f64 (pts, 2)) or None when
+    the native library is unavailable or the input is malformed."""
+    lib = _twkb_lib()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    offs = np.ascontiguousarray(offsets, dtype=np.int64)
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    total = np.zeros(3, dtype=np.int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    rc = lib.twkb_scan(
+        raw.ctypes.data_as(u8p), offs.ctypes.data_as(i64p), n,
+        total[0:].ctypes.data_as(i64p), total[1:].ctypes.data_as(i64p),
+        total[2:].ctypes.data_as(i64p),
+    )
+    if rc != 0:
+        return None
+    pts, parts, polys = (int(v) for v in total)
+    types = np.empty(n, dtype=np.int8)
+    gpc = np.empty(n, dtype=np.int32)
+    npolys = np.empty(n, dtype=np.int32)
+    prc = np.empty(max(polys, 1), dtype=np.int32)
+    psz = np.empty(max(parts, 1), dtype=np.int32)
+    coords = np.empty((max(pts, 1), 2), dtype=np.float64)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    rc = lib.twkb_decode(
+        raw.ctypes.data_as(u8p), offs.ctypes.data_as(i64p), n,
+        types.ctypes.data_as(i8p), gpc.ctypes.data_as(i32p),
+        npolys.ctypes.data_as(i32p), prc.ctypes.data_as(i32p),
+        psz.ctypes.data_as(i32p), coords.ctypes.data_as(f64p),
+    )
+    if rc != 0:
+        return None
+    return types, gpc, npolys, prc[:polys], psz[:parts], coords[:pts]

@@ -1,0 +1,206 @@
+// Native batch TWKB decode (the TwkbSerialization hot path, SURVEY.md §2.4).
+//
+// Python's per-coordinate varint loop dominates geometry load time; this
+// decodes a whole column of TWKB blobs in one call into flat arrays the
+// Python side reassembles into geometry objects:
+//
+//   twkb_scan:   sizes pass — total points / parts / polygons
+//   twkb_decode: fill types, per-geometry part counts, per-polygon ring
+//                counts, per-part point counts, and packed (x, y) f64 coords
+//
+// Format exactly matches geometry/twkb.py: head byte = type | zigzag(prec)<<4,
+// meta byte (0x10 = empty), then counts + zigzag-varint deltas (shared
+// running "last" across parts of one geometry).
+
+#include <cstdint>
+#include <cmath>
+
+namespace {
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  // each coordinate is two varints of >= 1 byte each: a claimed count
+  // bigger than remaining_bytes/2 is malformed (also bounds the totals
+  // against overflow, since counts are capped by the buffer size)
+  bool count_ok(uint64_t k) {
+    if (2 * k > (uint64_t)(end - p)) { fail = true; return false; }
+    return true;
+  }
+
+  uint64_t varu() {
+    uint64_t out = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      out |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) return out;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    fail = true;
+    return 0;
+  }
+
+  int64_t zz() {
+    uint64_t v = varu();
+    return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+  }
+};
+
+inline int unzigzag4(int v) { return (v >> 1) ^ -(v & 1); }
+
+}  // namespace
+
+extern "C" {
+
+// Sizes pass. Returns 0 ok, -1 on malformed input.
+int twkb_scan(const uint8_t* buf, const int64_t* offs, int64_t n,
+              int64_t* total_pts, int64_t* total_parts, int64_t* total_polys) {
+  int64_t pts = 0, parts = 0, polys = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Reader r{buf + offs[i], buf + offs[i + 1]};
+    if (r.end - r.p < 2) return -1;
+    uint8_t head = *r.p++;
+    uint8_t meta = *r.p++;
+    int t = head & 0x0F;
+    if (meta & 0x10) continue;  // empty
+    switch (t) {
+      case 1: pts += 1; parts += 1; break;
+      case 2: {
+        uint64_t k = r.varu();
+        if (!r.count_ok(k)) return -1;
+        pts += k; parts += 1; break;
+      }
+      case 3: {
+        uint64_t nr = r.varu();
+        if (!r.count_ok(nr)) return -1;
+        polys += 1; parts += nr;
+        for (uint64_t j = 0; j < nr && !r.fail; ++j) {
+          uint64_t k = r.varu();
+          if (!r.count_ok(k)) return -1;
+          pts += k;
+          for (uint64_t c = 0; c < 2 * k && !r.fail; ++c) r.varu();
+        }
+        break;
+      }
+      case 4: {
+        uint64_t k = r.varu();
+        if (!r.count_ok(k)) return -1;
+        pts += k; parts += k; break;
+      }
+      case 5: {
+        uint64_t np_ = r.varu();
+        if (!r.count_ok(np_)) return -1;
+        parts += np_;
+        for (uint64_t j = 0; j < np_ && !r.fail; ++j) {
+          uint64_t k = r.varu();
+          if (!r.count_ok(k)) return -1;
+          pts += k;
+          for (uint64_t c = 0; c < 2 * k && !r.fail; ++c) r.varu();
+        }
+        break;
+      }
+      case 6: {
+        uint64_t np_ = r.varu();
+        if (!r.count_ok(np_)) return -1;
+        polys += np_;
+        for (uint64_t j = 0; j < np_ && !r.fail; ++j) {
+          uint64_t nr = r.varu();
+          if (!r.count_ok(nr)) return -1;
+          parts += nr;
+          for (uint64_t q = 0; q < nr && !r.fail; ++q) {
+            uint64_t k = r.varu();
+            if (!r.count_ok(k)) return -1;
+            pts += k;
+            for (uint64_t c = 0; c < 2 * k && !r.fail; ++c) r.varu();
+          }
+        }
+        break;
+      }
+      default: return -1;
+    }
+    if (r.fail) return -1;
+  }
+  *total_pts = pts;
+  *total_parts = parts;
+  *total_polys = polys;
+  return 0;
+}
+
+// Decode pass; arrays sized from twkb_scan. types: 0=empty/None, else 1..6.
+int twkb_decode(const uint8_t* buf, const int64_t* offs, int64_t n,
+                int8_t* types, int32_t* geom_part_counts, int32_t* npolys,
+                int32_t* poly_ring_counts, int32_t* part_sizes,
+                double* coords) {
+  int64_t pi = 0;   // part_sizes cursor
+  int64_t ri = 0;   // poly_ring_counts cursor
+  int64_t ci = 0;   // coords cursor (pairs)
+  for (int64_t i = 0; i < n; ++i) {
+    Reader r{buf + offs[i], buf + offs[i + 1]};
+    if (r.end - r.p < 2) return -1;
+    uint8_t head = *r.p++;
+    uint8_t meta = *r.p++;
+    int t = head & 0x0F;
+    double scale = std::pow(10.0, (double)unzigzag4(head >> 4));
+    if (meta & 0x10) {
+      types[i] = 0; geom_part_counts[i] = 0; npolys[i] = 0;
+      continue;
+    }
+    types[i] = (int8_t)t;
+    int64_t lx = 0, ly = 0;
+    auto read_part = [&](uint64_t k) {
+      if (!r.count_ok(k)) return;
+      part_sizes[pi++] = (int32_t)k;
+      for (uint64_t c = 0; c < k && !r.fail; ++c) {
+        lx += r.zz(); ly += r.zz();
+        coords[2 * ci] = (double)lx / scale;
+        coords[2 * ci + 1] = (double)ly / scale;
+        ++ci;
+      }
+    };
+    switch (t) {
+      case 1: geom_part_counts[i] = 1; npolys[i] = 0; read_part(1); break;
+      case 2: geom_part_counts[i] = 1; npolys[i] = 0; read_part(r.varu()); break;
+      case 3: {
+        uint64_t nr = r.varu();
+        geom_part_counts[i] = (int32_t)nr; npolys[i] = 1;
+        poly_ring_counts[ri++] = (int32_t)nr;
+        for (uint64_t j = 0; j < nr && !r.fail; ++j) read_part(r.varu());
+        break;
+      }
+      case 4: {
+        uint64_t k = r.varu();
+        geom_part_counts[i] = (int32_t)k; npolys[i] = 0;
+        for (uint64_t j = 0; j < k && !r.fail; ++j) read_part(1);
+        break;
+      }
+      case 5: {
+        uint64_t np_ = r.varu();
+        geom_part_counts[i] = (int32_t)np_; npolys[i] = 0;
+        for (uint64_t j = 0; j < np_ && !r.fail; ++j) read_part(r.varu());
+        break;
+      }
+      case 6: {
+        uint64_t np_ = r.varu();
+        npolys[i] = (int32_t)np_;
+        int32_t parts = 0;
+        for (uint64_t j = 0; j < np_ && !r.fail; ++j) {
+          uint64_t nr = r.varu();
+          poly_ring_counts[ri++] = (int32_t)nr;
+          parts += (int32_t)nr;
+          for (uint64_t q = 0; q < nr && !r.fail; ++q) read_part(r.varu());
+        }
+        geom_part_counts[i] = parts;
+        break;
+      }
+      default: return -1;
+    }
+    if (r.fail) return -1;
+  }
+  return 0;
+}
+
+}  // extern "C"

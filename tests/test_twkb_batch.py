@@ -1,0 +1,154 @@
+"""Native TWKB batch decode + TWKB-encoded geometry persistence
+(reference: ``TwkbSerialization.scala`` as the compact geometry row format —
+SURVEY.md §2.4; native decoder in ``native/twkb.cpp``)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu.geometry import (
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from geomesa_tpu.geometry.twkb import from_twkb, from_twkb_batch, to_twkb
+from geomesa_tpu.geometry.wkt import to_wkt
+from geomesa_tpu.io.arrow import from_arrow, to_arrow
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+
+SQ = np.array([[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]], dtype=float)
+
+
+def geoms():
+    rng = np.random.default_rng(7)
+    return [
+        None,
+        Point(1.5, -2.25),
+        LineString(np.round(np.cumsum(rng.normal(0, 0.01, (30, 2)), axis=0), 6)),
+        Polygon(SQ, holes=(SQ * 0.3 + 0.2,)),
+        MultiPoint([Point(1, 2), Point(3, 4)]),
+        MultiLineString([LineString([(0, 0), (1, 1)]),
+                         LineString([(2, 2), (3, 3), (4, 2)])]),
+        MultiPolygon([Polygon(SQ), Polygon(SQ + 5, holes=(SQ * 0.2 + 5.3,))]),
+    ]
+
+
+class TestBatchDecode:
+    def test_matches_scalar_decode(self):
+        gs = geoms()
+        blobs = [to_twkb(g) for g in gs]
+        batch = from_twkb_batch(blobs)
+        for b, g in zip(batch, gs):
+            one = from_twkb(to_twkb(g))
+            if g is None:
+                assert b is None and one is None
+                continue
+            assert type(b) is type(one)
+            assert to_wkt(b) == to_wkt(one)
+
+    def test_native_used_and_fast(self):
+        from geomesa_tpu import native
+
+        if native._twkb_lib() is None:
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(1)
+        many = [
+            to_twkb(LineString(np.cumsum(rng.normal(0, 0.01, (40, 2)), axis=0)))
+            for _ in range(2000)
+        ]
+        out = from_twkb_batch(many)
+        ref = [from_twkb(b) for b in many]
+        assert all(np.allclose(a.coords, b.coords) for a, b in zip(out, ref))
+
+    def test_none_blob_fallback(self):
+        blobs = [to_twkb(Point(1, 2)), None, to_twkb(Point(3, 4))]
+        out = from_twkb_batch(blobs)
+        assert out[1] is None and out[0] == Point(1, 2)
+
+    def test_malformed_input_safe(self):
+        from geomesa_tpu import native
+
+        if native._twkb_lib() is None:
+            pytest.skip("no native toolchain")
+        # truncated varint must not crash the native decoder
+        bad = bytes([2, 0, 0xFF])
+        offs = np.array([0, len(bad)], dtype=np.int64)
+        assert native.twkb_decode_batch(bad, offs) is None
+
+
+class TestArrowTwkb:
+    def test_roundtrip_with_nulls(self):
+        sft = parse_spec("t", "name:String,*geom:Geometry")
+        gs = geoms()
+        recs = [{"name": f"g{i}", "geom": g} for i, g in enumerate(gs)]
+        t = FeatureTable.from_records(sft, recs, [str(i) for i in range(len(gs))])
+        at = to_arrow(t)
+        f = at.schema.field("geom")
+        assert f.metadata[b"geom"] == b"twkb"
+        base = f.type.value_type if pa.types.is_dictionary(f.type) else f.type
+        assert pa.types.is_binary(base)
+        t2 = from_arrow(sft, at)
+        for i, g in enumerate(gs):
+            g2 = t2.record(i)["geom"]
+            if g is None:
+                assert g2 is None
+            else:
+                assert to_wkt(g2) == to_wkt(from_twkb(to_twkb(g)))
+
+    def test_legacy_wkt_catalogs_still_read(self):
+        # catalogs written before the TWKB switch hold WKT strings
+        sft = parse_spec("t", "name:String,*geom:LineString")
+        lines = [LineString([(0, 0), (1, 1)]), LineString([(2, 2), (3, 1)])]
+        at = pa.table(
+            {
+                "__fid__": pa.array(["a", "b"]),
+                "name": pa.array(["x", "y"]),
+                "geom": pa.array([to_wkt(g) for g in lines], type=pa.string()),
+            }
+        )
+        t = from_arrow(sft, at)
+        assert to_wkt(t.record(0)["geom"]) == to_wkt(lines[0])
+        assert to_wkt(t.record(1)["geom"]) == to_wkt(lines[1])
+
+    def test_smaller_than_wkt(self):
+        rng = np.random.default_rng(3)
+        sft = parse_spec("t", "*geom:LineString")
+        recs = [
+            {"geom": LineString(np.cumsum(rng.normal(0, 0.01, (50, 2)), axis=0))}
+            for _ in range(200)
+        ]
+        t = FeatureTable.from_records(sft, recs, [str(i) for i in range(200)])
+        at = to_arrow(t)
+        twkb_bytes = at.column("geom").nbytes
+        wkt_bytes = sum(
+            len(to_wkt(r["geom"])) for r in (t.record(i) for i in range(200))
+        )
+        assert twkb_bytes < wkt_bytes / 3
+
+    def test_persistence_roundtrip_queries(self, tmp_path):
+        from geomesa_tpu.store import persistence
+        from geomesa_tpu.store.datastore import DataStore
+
+        sft = parse_spec("lines", "name:String,dtg:Date,*geom:LineString")
+        rng = np.random.default_rng(5)
+        recs = []
+        for i in range(300):
+            x0 = float(rng.uniform(-170, 160))
+            y0 = float(rng.uniform(-80, 70))
+            recs.append(
+                {"name": f"l{i}", "dtg": 1_500_000_000_000 + i,
+                 "geom": LineString([(x0, y0), (x0 + 2, y0 + 1.5)])}
+            )
+        ds = DataStore(backend="oracle")
+        ds.create_schema(sft)
+        ds.write("lines", recs, fids=[str(i) for i in range(300)])
+        persistence.save(ds, str(tmp_path / "cat"))
+        ds2 = persistence.load(str(tmp_path / "cat"), backend="oracle")
+        q = "BBOX(geom, -30, -20, 40, 30)"
+        assert set(ds2.query("lines", q).table.fids.tolist()) == set(
+            ds.query("lines", q).table.fids.tolist()
+        )
