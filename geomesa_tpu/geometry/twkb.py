@@ -279,3 +279,77 @@ def from_twkb_batch(blobs) -> np.ndarray:
         else:
             raise ValueError(f"unknown TWKB type {t}")
     return out
+
+
+def to_twkb_batch(geoms, precision: int = 7):
+    """Encode a column of geometries in one native pass →
+    (buf uint8 array, offsets (n+1,) int64), or None when the native
+    library is unavailable (callers fall back to per-geometry
+    :func:`to_twkb`). Blob ``i`` is ``buf[offsets[i]:offsets[i+1]]``."""
+    if not -8 <= precision <= 7:
+        # zigzag(precision) must fit the 4-bit nibble of the type byte
+        raise ValueError("precision must be in [-8, 7]")
+    from geomesa_tpu import native
+
+    if native._twkb_lib() is None:
+        return None
+    geoms = list(geoms)
+    n = len(geoms)
+    types = np.zeros(n, dtype=np.int8)
+    gpc = np.zeros(n, dtype=np.int32)
+    npolys = np.zeros(n, dtype=np.int32)
+    prc: list[int] = []
+    psz: list[int] = []
+    chunks: list[np.ndarray] = []
+    for i, g in enumerate(geoms):
+        if g is None:
+            continue
+        t = _TYPES[type(g)]
+        types[i] = t
+        if t == 1:
+            gpc[i] = 1
+            psz.append(1)
+            chunks.append(np.array([[g.x, g.y]]))
+        elif t == 2:
+            gpc[i] = 1
+            psz.append(len(g.coords))
+            chunks.append(g.coords)
+        elif t == 3:
+            rings = g.rings
+            gpc[i] = len(rings)
+            npolys[i] = 1
+            prc.append(len(rings))
+            for ring in rings:
+                psz.append(len(ring))
+                chunks.append(ring)
+        elif t == 4:
+            gpc[i] = len(g.parts)
+            for p in g.parts:
+                psz.append(1)
+                chunks.append(np.array([[p.x, p.y]]))
+        elif t == 5:
+            gpc[i] = len(g.parts)
+            for ls in g.parts:
+                psz.append(len(ls.coords))
+                chunks.append(ls.coords)
+        else:  # t == 6
+            npolys[i] = len(g.parts)
+            parts = 0
+            for poly in g.parts:
+                rings = poly.rings
+                prc.append(len(rings))
+                parts += len(rings)
+                for ring in rings:
+                    psz.append(len(ring))
+                    chunks.append(ring)
+            gpc[i] = parts
+    coords = (
+        np.concatenate([np.asarray(c, dtype=np.float64) for c in chunks])
+        if chunks
+        else np.zeros((0, 2))
+    )
+    return native.twkb_encode_batch(
+        types, gpc, npolys,
+        np.asarray(prc, dtype=np.int32), np.asarray(psz, dtype=np.int32),
+        coords, precision,
+    )

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-from geomesa_tpu.geometry.twkb import from_twkb_batch, to_twkb
+from geomesa_tpu.geometry.twkb import from_twkb_batch, to_twkb, to_twkb_batch
 from geomesa_tpu.geometry.types import Point
 from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
 from geomesa_tpu.schema.columnar import Column, FeatureTable, GeometryColumn, point_column
@@ -60,8 +60,22 @@ def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
             # lossless for real geodata). None/invalid slots encode as
             # TWKB-empty, keeping the column non-null so the native batch
             # decoder takes one pass
-            blobs = [to_twkb(g) for g in gc.geometries()]
-            arr = pa.array(blobs, type=pa.binary())
+            packed = to_twkb_batch(gc.geometries())
+            # pa.binary() carries int32 offsets; from_buffers does NOT
+            # validate, so a >2GiB column must take the checked path
+            if packed is not None and int(packed[1][-1]) < 2**31:
+                # native batch encode → BinaryArray built straight from the
+                # (values, offsets) buffers, no per-blob python objects
+                data, offs = packed
+                arr = pa.Array.from_buffers(
+                    pa.binary(), len(table),
+                    [None, pa.py_buffer(offs.astype(np.int32)),
+                     pa.py_buffer(data)],
+                )
+            else:
+                arr = pa.array(
+                    [to_twkb(g) for g in gc.geometries()], type=pa.binary()
+                )
             if dictionary_encode:
                 # repeated footprints dedup to dictionary codes (the
                 # ArrowDictionary role applies to geometries too)

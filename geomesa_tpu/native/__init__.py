@@ -310,3 +310,51 @@ def twkb_decode_batch(buf: bytes, offsets: np.ndarray):
     if rc != 0:
         return None
     return types, gpc, npolys, prc[:polys], psz[:parts], coords[:pts]
+
+
+def twkb_encode_batch(types, gpc, npolys, prc, psz, coords, precision: int = 7):
+    """Encode flat geometry arrays (layout of :func:`twkb_decode_batch`) →
+    (buf uint8 array, offsets (n+1,) int64), or None when unavailable."""
+    lib = _twkb_lib()
+    if lib is None:
+        return None
+    if not getattr(lib, "_enc_configured", False):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.twkb_encode.restype = ctypes.c_int64
+        lib.twkb_encode.argtypes = [
+            i8p, i32p, i32p, i32p, i32p, f64p,
+            ctypes.c_int64, ctypes.c_int, u8p, ctypes.c_int64, i64p,
+        ]
+        lib._enc_configured = True
+    n = len(types)
+    types = np.ascontiguousarray(types, dtype=np.int8)
+    gpc = np.ascontiguousarray(gpc, dtype=np.int32)
+    npolys = np.ascontiguousarray(npolys, dtype=np.int32)
+    prc = np.ascontiguousarray(prc, dtype=np.int32) if len(prc) else np.zeros(1, np.int32)
+    psz = np.ascontiguousarray(psz, dtype=np.int32) if len(psz) else np.zeros(1, np.int32)
+    coords = np.ascontiguousarray(coords, dtype=np.float64)
+    pts = len(coords)
+    # worst case: 2B header + 10B per count varint + 2x10B per coordinate
+    cap = 2 * n + 10 * (len(psz) + len(prc) + n) + 20 * max(pts, 1)
+    buf = np.empty(cap, dtype=np.uint8)
+    offs = np.empty(n + 1, dtype=np.int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    total = lib.twkb_encode(
+        types.ctypes.data_as(i8p), gpc.ctypes.data_as(i32p),
+        npolys.ctypes.data_as(i32p), prc.ctypes.data_as(i32p),
+        psz.ctypes.data_as(i32p),
+        coords.ctypes.data_as(f64p) if pts else np.zeros((1, 2)).ctypes.data_as(f64p),
+        n, int(precision),
+        buf.ctypes.data_as(u8p), cap, offs.ctypes.data_as(i64p),
+    )
+    if total < 0:
+        return None
+    return buf[:total], offs

@@ -204,3 +204,94 @@ int twkb_decode(const uint8_t* buf, const int64_t* offs, int64_t n,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Encode pass: flat arrays (same layout twkb_decode produces) -> concatenated
+// TWKB blobs. out_offs gets n+1 entries; returns total bytes or -1 when
+// out_buf (capacity cap) is too small. Rounding matches numpy (nearest-even).
+int64_t twkb_encode(const int8_t* types, const int32_t* geom_part_counts,
+                    const int32_t* npolys, const int32_t* poly_ring_counts,
+                    const int32_t* part_sizes, const double* coords,
+                    int64_t n, int precision,
+                    uint8_t* out_buf, int64_t cap, int64_t* out_offs) {
+  double scale = std::pow(10.0, (double)precision);
+  int zzprec = (precision << 1) ^ (precision >> 31);
+  int64_t pi = 0, ri = 0, ci = 0, w = 0;
+  auto put = [&](uint8_t b) -> bool {
+    if (w >= cap) return false;
+    out_buf[w++] = b;
+    return true;
+  };
+  auto varu = [&](uint64_t v) -> bool {
+    while (true) {
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) { if (!put(b | 0x80)) return false; }
+      else return put(b);
+    }
+  };
+  auto zz = [&](int64_t v) -> bool {
+    return varu(((uint64_t)v << 1) ^ (uint64_t)(v >> 63));
+  };
+  for (int64_t i = 0; i < n; ++i) {
+    out_offs[i] = w;
+    int t = types[i];
+    if (t == 0) {  // None/empty: empty point, matching to_twkb(None)
+      if (!put((uint8_t)(1 | (zzprec << 4))) || !put(0x10)) return -1;
+      continue;
+    }
+    if (!put((uint8_t)(t | (zzprec << 4))) || !put(0)) return -1;
+    int64_t lx = 0, ly = 0;
+    auto part = [&](int32_t k, bool with_count) -> bool {
+      if (with_count && !varu((uint64_t)k)) return false;
+      for (int32_t c = 0; c < k; ++c) {
+        int64_t x = (int64_t)std::nearbyint(coords[2 * ci] * scale);
+        int64_t y = (int64_t)std::nearbyint(coords[2 * ci + 1] * scale);
+        ++ci;
+        if (!zz(x - lx) || !zz(y - ly)) return false;
+        lx = x; ly = y;
+      }
+      return true;
+    };
+    bool ok = true;
+    switch (t) {
+      case 1: ok = part(part_sizes[pi++], false); break;
+      case 2: ok = part(part_sizes[pi++], true); break;
+      case 3: {
+        int32_t nr = poly_ring_counts[ri++];
+        ok = varu((uint64_t)nr);
+        for (int32_t j = 0; j < nr && ok; ++j) ok = part(part_sizes[pi++], true);
+        break;
+      }
+      case 4: {
+        int32_t k = geom_part_counts[i];
+        ok = varu((uint64_t)k);
+        for (int32_t j = 0; j < k && ok; ++j) ok = part(part_sizes[pi++], false);
+        break;
+      }
+      case 5: {
+        int32_t k = geom_part_counts[i];
+        ok = varu((uint64_t)k);
+        for (int32_t j = 0; j < k && ok; ++j) ok = part(part_sizes[pi++], true);
+        break;
+      }
+      case 6: {
+        int32_t np_ = npolys[i];
+        ok = varu((uint64_t)np_);
+        for (int32_t j = 0; j < np_ && ok; ++j) {
+          int32_t nr = poly_ring_counts[ri++];
+          ok = varu((uint64_t)nr);
+          for (int32_t q = 0; q < nr && ok; ++q) ok = part(part_sizes[pi++], true);
+        }
+        break;
+      }
+      default: return -1;
+    }
+    if (!ok) return -1;
+  }
+  out_offs[n] = w;
+  return w;
+}
+
+}  // extern "C"

@@ -80,6 +80,41 @@ class TestBatchDecode:
         assert native.twkb_decode_batch(bad, offs) is None
 
 
+class TestBatchEncode:
+    def test_byte_identical_to_python(self):
+        from geomesa_tpu import native
+        from geomesa_tpu.geometry.twkb import to_twkb_batch
+
+        if native._twkb_lib() is None:
+            pytest.skip("no native toolchain")
+        gs = geoms()
+        buf, offs = to_twkb_batch(gs)
+        for i, g in enumerate(gs):
+            assert bytes(buf[offs[i] : offs[i + 1]]) == to_twkb(g)
+
+    def test_precision_range_enforced(self):
+        from geomesa_tpu.geometry.twkb import to_twkb_batch
+
+        with pytest.raises(ValueError, match="precision"):
+            to_twkb_batch([Point(1, 2)], precision=9)
+
+    def test_encode_decode_roundtrip(self):
+        from geomesa_tpu.geometry.twkb import to_twkb_batch
+
+        gs = [g for g in geoms()]
+        packed = to_twkb_batch(gs)
+        if packed is None:
+            pytest.skip("no native toolchain")
+        buf, offs = packed
+        blobs = [bytes(buf[offs[i] : offs[i + 1]]) for i in range(len(gs))]
+        out = from_twkb_batch(blobs)
+        for g, d in zip(gs, out):
+            if g is None:
+                assert d is None
+            else:
+                assert to_wkt(d) == to_wkt(from_twkb(to_twkb(g)))
+
+
 class TestArrowTwkb:
     def test_roundtrip_with_nulls(self):
         sft = parse_spec("t", "name:String,*geom:Geometry")
