@@ -7,6 +7,9 @@ Select with ``GEOMESA_BENCH_CONFIG`` (default ``2``, the headline config):
   3  density heatmap + KNN, 100M points         (DensityScan / KNN process)
   4  ST_Within spatial join, points × polygons  (spark-jts UDF role)
   5  XZ2 bbox queries over linestring tracks    (XZ2SFC role)
+  6  distributed row SELECT latency             (ArrowScan / QueryPlan.scan)
+  7  125M single-chip residency + HBM util      (1B ÷ v5e-8 share)
+  8  out-of-core 1B streaming scan              (north-star total, chunked)
 
 Each prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...};
 ``vs_baseline`` = CPU-per-query / TPU-per-query on identical data + queries
@@ -131,10 +134,11 @@ def _sharded_store(lon, lat, t_ms, period=PERIOD):
     return mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s, jnp.int32(len(lon))
 
 
-def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
-    # every bench query is one box + one window: slots=1 makes the device
-    # kernels evaluate exactly one slot instead of MAX_BOXES/MAX_TIMES
-    qboxes = np.stack(
+def _pack_query_boxes(boxes_f64, nlon, nlat, overlap: bool = False):
+    """f64 boxes → stacked normalized-int payloads, one slot per query
+    (slots=1 makes the device kernels evaluate exactly one slot instead of
+    MAX_BOXES)."""
+    return np.stack(
         [
             pack_boxes(
                 np.array(
@@ -143,10 +147,15 @@ def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
                     dtype=np.int32,
                 ),
                 slots=1,
+                **({"overlap": True} if overlap else {}),
             )
             for x1, y1, x2, y2 in boxes_f64
         ]
     )
+
+
+def _pack_queries(boxes_f64, windows_ms, binned, nlon, nlat):
+    qboxes = _pack_query_boxes(boxes_f64, nlon, nlat)
     qtimes = []
     for lo, hi in windows_ms:
         (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
@@ -597,20 +606,7 @@ def bench_xz2():
     step = make_batched_overlap_step(mesh)
 
     boxes_f64, _ = make_queries(Q)
-    qboxes = np.stack(
-        [
-            pack_boxes(
-                np.array(
-                    [[int(nlon.normalize(x1)), int(nlon.normalize(x2)),
-                      int(nlat.normalize(y1)), int(nlat.normalize(y2))]],
-                    dtype=np.int32,
-                ),
-                slots=1,  # one box per query: no padded slots to evaluate
-                overlap=True,
-            )
-            for x1, y1, x2, y2 in boxes_f64
-        ]
-    )
+    qboxes = _pack_query_boxes(boxes_f64, nlon, nlat, overlap=True)
     dev_boxes = jnp.asarray(qboxes)
     true_n = jnp.int32(M)
 
@@ -843,14 +839,147 @@ def bench_resident():
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 8: out-of-core 1B streaming scan — the north-star total, streamed
+# through one chip as resident-share chunks (per-time-bin array groups,
+# SURVEY.md §5 long-context mapping). Chunks are generated ON DEVICE (no
+# host transfer; flagged in detail) and scanned with the same fused batched
+# count step; a plain-XLA mask-sum referee checks every chunk's counts.
+# ---------------------------------------------------------------------------
+
+def bench_stream_1b():
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from geomesa_tpu.parallel.mesh import DATA_AXIS, data_shards, make_mesh
+    from geomesa_tpu.parallel.query import make_batched_count_step
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    mesh = make_mesh()
+    shards = data_shards(mesh)
+    N = _n(125_000_000 if on_accel else 500_000)
+    N -= N % shards
+    total_target = int(
+        os.environ.get(
+            "GEOMESA_BENCH_TOTAL", 1_000_000_000 if on_accel else N * 8
+        )
+    )
+    chunks = max(1, (total_target + N - 1) // N)
+    max_off = 86_400_000 - 1  # PERIOD=DAY offsets; one chunk = one time bin
+
+    sh = NamedSharding(mesh, _P(DATA_AXIS))
+
+    # n static (shapes), seed/chunk_bin traced: ONE compile for all chunks
+    @partial(jax.jit, static_argnums=(1,), out_shardings=(sh, sh, sh, sh))
+    def gen(seed, n, chunk_bin):
+        k = jax.random.PRNGKey(seed)
+        kx, ky, kt = jax.random.split(k, 3)
+        x = jax.random.randint(kx, (n,), 0, 2**31 - 1, dtype=jnp.int32)
+        y = jax.random.randint(ky, (n,), 0, 2**31 - 1, dtype=jnp.int32)
+        offs = jax.random.randint(kt, (n,), 0, max_off, dtype=jnp.int32)
+        bins = jnp.full((n,), 1, dtype=jnp.int32) * chunk_bin
+        return x, y, bins, offs
+
+    # Q spatial boxes (int domain) × full-span time windows
+    nlon, nlat = norm_lon(31), norm_lat(31)
+    boxes_f64, _ = make_queries(Q)
+    qboxes = _pack_query_boxes(boxes_f64, nlon, nlat)
+    qtimes = np.stack(
+        [pack_times(np.array([[0, 0, chunks, max_off]], np.int32), slots=1)] * Q
+    )
+    dev_boxes = jnp.asarray(qboxes)
+    dev_times = jnp.asarray(qtimes)
+    step = make_batched_count_step(mesh)
+
+    @jax.jit
+    def referee(x, y, bins, offs, boxes):
+        # straight-XLA mask sum, independent of the fused step's internals;
+        # sequential over queries (vmap would hold Q x N bools at once)
+        def one(b):
+            m = (x >= b[0, 0]) & (x <= b[0, 1]) & (y >= b[0, 2]) & (y <= b[0, 3])
+            return m.sum(dtype=jnp.int64)
+
+        return jax.lax.map(one, boxes)
+
+    totals = np.zeros(Q, dtype=np.int64)
+    scan_s = 0.0
+    gen_s = 0.0
+    parity_ok = True
+    iters_per_chunk = max(2, min(3, ITERS // 4))
+    for c in range(chunks):
+        t0 = time.perf_counter()
+        x, y, bins, offs = gen(c, N, c)
+        jax.block_until_ready(x)
+        gen_s += time.perf_counter() - t0
+
+        def run():
+            return np.asarray(
+                step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
+            )
+
+        counts = run()  # first call compiles (chunk 0 only)
+        t_chunk = _p50(run, iters=iters_per_chunk)
+        scan_s += t_chunk / 1e3
+        totals += counts.astype(np.int64)
+        ref = np.asarray(referee(x, y, bins, offs, dev_boxes))
+        if not np.array_equal(ref, counts.astype(np.int64)):
+            parity_ok = False
+
+    total_rows = N * chunks
+    rows_per_s = total_rows / max(scan_s, 1e-9)
+    # both sides in row-query pairs/s over IDENTICAL predicates (spatial box
+    # AND the same full-span time window): one fused device pass answers all
+    # Q queries, the CPU baseline evaluates each of the Q queries in turn
+    tpu_rowq_per_s = total_rows * Q / max(scan_s, 1e-9)
+    n_ref = min(N, 2_000_000)
+    rng_h = np.random.default_rng(0)
+    hx = rng_h.integers(0, 2**31 - 1, n_ref, dtype=np.int32)
+    hy = rng_h.integers(0, 2**31 - 1, n_ref, dtype=np.int32)
+    hb = rng_h.integers(0, chunks, n_ref, dtype=np.int32)
+    ho = rng_h.integers(0, max_off, n_ref, dtype=np.int32)
+    tq = np.array([0, 0, chunks, max_off], dtype=np.int32)
+    s = time.perf_counter()
+    for b in qboxes:
+        m = (hx >= b[0, 0]) & (hx <= b[0, 1]) & (hy >= b[0, 2]) & (hy <= b[0, 3])
+        m &= (hb > tq[0]) | ((hb == tq[0]) & (ho >= tq[1]))
+        m &= (hb < tq[2]) | ((hb == tq[2]) & (ho <= tq[3]))
+        _ = m.sum()
+    cpu_rowq_per_s = n_ref * Q / (time.perf_counter() - s)
+
+    return {
+        "metric": "stream_1b_scan_throughput",
+        "value": round(rows_per_s / 1e9, 4),
+        "unit": "Grows/s/chip (each row matched against all Q queries)",
+        "vs_baseline": round(tpu_rowq_per_s / cpu_rowq_per_s, 1),
+        "detail": {
+            "total_rows": total_rows,
+            "chunk_rows": N,
+            "chunks": chunks,
+            "n_queries": Q,
+            "devices": jax.device_count(),
+            "scan_seconds_total": round(scan_s, 2),
+            "gen_seconds_total_on_device": round(gen_s, 2),
+            "referee_parity_all_chunks": parity_ok,
+            "rows_matched_total": int(totals.sum()),
+            "row_queries_per_s": int(tpu_rowq_per_s),
+            "cpu_row_queries_per_s": int(cpu_rowq_per_s),
+            "note": "chunks generated on-device (no host transfer); each "
+                    "chunk scanned against all Q queries in one fused pass",
+        },
+    }
+
+
 BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,
            "4": bench_join, "5": bench_xz2, "6": bench_select,
-           "7": bench_resident}
+           "7": bench_resident, "8": bench_stream_1b}
 
 # per-config wall-clock budget (seconds) for the subprocess runner
 _TIMEOUTS = {"1": 900, "2": 1200, "3": 2400, "4": 1800, "5": 900, "6": 1800,
-             "7": 2400}
-_HEADLINE_ORDER = ["2", "1", "5", "6", "7", "3", "4"]  # headline preference
+             "7": 2400, "8": 2400}
+_HEADLINE_ORDER = ["2", "1", "5", "6", "7", "8", "3", "4"]  # headline preference
 
 
 def _probe_backend(max_tries: int = 3) -> tuple[str, int, list[str]]:
