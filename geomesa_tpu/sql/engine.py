@@ -67,13 +67,41 @@ class SqlResult:
 
 
 _CLAUSES = re.compile(
-    r"^\s*select\s+(?P<select>.+?)\s+from\s+(?P<from>\w+)"
+    r"^\s*select\s+(?P<distinct>distinct\s+)?(?P<select>.+?)\s+from\s+(?P<from>\w+)"
     r"(?:\s+where\s+(?P<where>.+?))?"
     r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+having\s+(?P<having>.+?))?"
     r"(?:\s+order\s+by\s+(?P<order>.+?))?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
+_HAVING = re.compile(
+    r"^\s*(?P<expr>\w+\s*\(\s*(?:\*|\w+)\s*\))\s*(?P<op><>|<=|>=|=|<|>)\s*"
+    r"(?P<lit>-?\d+(?:\.\d+)?)\s*$"
+)
+
+
+def _mask_quotes(s: str) -> str:
+    """Blank the INSIDE of quoted literals (same length) so clause-keyword
+    regexes can't match words like HAVING/GROUP inside a string literal;
+    spans found on the mask are then sliced from the original."""
+    out = []
+    q = None
+    for ch in s:
+        if q is not None:
+            out.append(ch if ch == q else "_")
+            if ch == q:
+                q = None
+        else:
+            if ch in ("'", '"'):
+                q = ch
+            out.append(ch)
+    return "".join(out)
+
+
+def _clause(m: "re.Match", original: str, name: str) -> str | None:
+    a, b = m.span(name)
+    return None if a == -1 else original[a:b]
 _AGGS = ("count", "sum", "min", "max", "avg")
 _SPATIAL = {
     "st_contains": "CONTAINS",
@@ -276,12 +304,14 @@ def _map_unquoted(s: str, fn) -> str:
     return "".join(out)
 
 
-def _sql_join(ds, m) -> SqlResult:
+def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     """Spatial JOIN: each right-table geometry becomes an index-planned scan
     of the left table (delegating to :func:`geomesa_tpu.process.join
     .join_scan` — the JoinProcess core, never a cartesian pass), pairs
     streamed into alias-qualified columns. Right side should be the smaller
-    relation (polygon sets)."""
+    relation (polygon sets). ``m`` may be a match on the quote-masked
+    statement; ``original`` supplies literal-bearing clause text."""
+    original = original if original is not None else m.string
     t1, a1, t2, a2 = m.group("t1"), m.group("a1"), m.group("t2"), m.group("a2")
     if a1 == a2:
         raise SqlError(f"duplicate join alias {a1!r}")
@@ -306,7 +336,7 @@ def _sql_join(ds, m) -> SqlResult:
     # and rewrites apply outside string literals only.
     base_cql = None
     if m.group("where"):
-        w = m.group("where")
+        w = _clause(m, original, "where")
         found_right = False
 
         def _check(seg):
@@ -378,16 +408,21 @@ def _sql_join(ds, m) -> SqlResult:
 
 def sql(ds, statement: str) -> SqlResult:
     """Execute a SQL statement against ``ds`` (DataStore or merged view)."""
-    jm = _JOIN.match(statement)
+    # clause keywords are matched on a quote-masked shadow so a WHERE
+    # literal containing e.g. 'having' cannot hijack clause splitting; the
+    # spans are then sliced from the original statement
+    masked = _mask_quotes(statement)
+    jm = _JOIN.match(masked)
     if jm:
-        return _sql_join(ds, jm)
-    m = _CLAUSES.match(statement)
+        return _sql_join(ds, jm, statement)
+    m = _CLAUSES.match(masked)
     if not m:
         raise SqlError(f"cannot parse: {statement!r}")
-    items = [_parse_item(i) for i in _split_top(m.group("select"))]
+    items = [_parse_item(i) for i in _split_top(_clause(m, statement, "select"))]
     type_name = m.group("from")
-    where = m.group("where")
-    group_by = [g.strip() for g in m.group("group").split(",")] if m.group("group") else None
+    where = _clause(m, statement, "where")
+    group_raw = _clause(m, statement, "group")
+    group_by = [g.strip() for g in group_raw.split(",")] if group_raw else None
     limit = int(m.group("limit")) if m.group("limit") else None
     order = None
     if m.group("order"):
@@ -398,16 +433,28 @@ def sql(ds, statement: str) -> SqlResult:
 
     cql = _rewrite_where(where) if where else None
     has_agg = any(i.kind == "agg" for i in items)
+    distinct = bool(m.group("distinct"))
+    having = _clause(m, statement, "having")
+    if having and not group_by:
+        raise SqlError("HAVING requires GROUP BY")
+    if distinct and (has_agg or group_by):
+        raise SqlError("DISTINCT is not supported with aggregates/GROUP BY")
 
-    if not has_agg:
+    # GROUP BY without aggregate select items is only meaningful with a
+    # HAVING filter (SELECT name ... GROUP BY name HAVING COUNT(*) > n)
+    if not has_agg and not (group_by and having):
         if group_by:
             raise SqlError("GROUP BY requires aggregate select items")
         # projection pushdown only when every item is a plain column; scalar
-        # fns need their source column materialized
+        # fns need their source column materialized. DISTINCT dedupes after
+        # the scan, so the limit must not truncate pre-dedup
         props = None
         if all(i.kind == "col" for i in items):
             props = [i.arg for i in items]
-        q = Query(filter=cql, properties=props, sort_by=order, limit=limit)
+        q = Query(
+            filter=cql, properties=props, sort_by=order,
+            limit=None if distinct else limit,
+        )
         r = ds.query(type_name, q)
         cols: dict[str, np.ndarray] = {}
         for it in items:
@@ -422,6 +469,20 @@ def sql(ds, statement: str) -> SqlResult:
                 cols[it.name] = c.geometries() if c.type.is_geometry else c.values
             else:
                 cols[it.name] = _scalar_fn(it.fn, r.table, it.arg)
+        if distinct:
+            names = list(cols)
+            seen: dict = {}
+            keep: list[int] = []
+            nrows = len(next(iter(cols.values()))) if cols else 0
+            for i in range(nrows):
+                k = tuple(str(cols[c][i]) for c in names)
+                if k not in seen:
+                    seen[k] = True
+                    keep.append(i)
+            idx = np.asarray(keep, dtype=np.int64)
+            cols = {c: v[idx] for c, v in cols.items()}
+            if limit is not None:
+                cols = {c: v[:limit] for c, v in cols.items()}
         return SqlResult(cols)
 
     # aggregate path: scan (with pushdown filter), then vectorized fold
@@ -451,6 +512,29 @@ def sql(ds, statement: str) -> SqlResult:
             groups.append([])
         groups[seen[k]].append(i)
     group_keys = list(seen)
+    if having:
+        hm = _HAVING.match(having)
+        if not hm:
+            raise SqlError(f"unsupported HAVING {having!r} "
+                           "(expected agg(col) <op> number)")
+        hit = _parse_item(hm.group("expr"))
+        if hit.kind != "agg":
+            raise SqlError("HAVING supports aggregate comparisons only")
+        if hit.arg not in ("", "*") and hit.arg not in t.columns:
+            raise SqlError(f"unknown HAVING column {hit.arg!r}")
+        import operator as _op
+
+        ops = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
+               ">": _op.gt, ">=": _op.ge}
+        lit = float(hm.group("lit"))
+        kept = [
+            (k, g)
+            for k, g in zip(group_keys, groups)
+            if (v := _agg_value(hit.fn, hit.arg, t, np.asarray(g, np.int64)))
+            is not None and ops[hm.group("op")](float(v), lit)
+        ]
+        group_keys = [k for k, _ in kept]
+        groups = [g for _, g in kept]
     cols = {}
     for it in items:
         if it.kind == "col":

@@ -229,3 +229,56 @@ class TestSpatialJoin:
         with pytest.raises(SqlError, match="geometry column"):
             sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
                          "ON ST_Within(a.name, b.geom)")
+
+
+class TestDistinctHaving:
+    def test_distinct(self, ds):
+        r = sql(ds, "SELECT DISTINCT name FROM ev")
+        assert sorted(r.columns["name"].tolist()) == [f"c{i}" for i in range(5)]
+
+    def test_distinct_multi_column_with_limit(self, ds):
+        r = sql(ds, "SELECT DISTINCT name, val FROM ev LIMIT 7")
+        assert len(r) == 7
+        rows = set(r.rows())
+        assert len(rows) == 7  # all distinct
+
+    def test_having_filters_groups(self, ds):
+        full = sql(ds, "SELECT name, COUNT(*) AS n FROM ev GROUP BY name")
+        counts = dict(zip(full.columns["name"], full.columns["n"]))
+        cutoff = int(np.median(list(counts.values())))
+        r = sql(
+            ds,
+            f"SELECT name, COUNT(*) AS n FROM ev GROUP BY name "
+            f"HAVING COUNT(*) > {cutoff}",
+        )
+        want = {k for k, v in counts.items() if v > cutoff}
+        assert set(r.columns["name"].tolist()) == want
+
+    def test_having_on_unselected_aggregate(self, ds):
+        r = sql(
+            ds,
+            "SELECT name FROM ev GROUP BY name HAVING AVG(val) >= 0",
+        )
+        assert len(r) == 5  # every group passes; avg not in select list
+
+    def test_having_requires_group_by(self, ds):
+        with pytest.raises(SqlError, match="HAVING requires GROUP BY"):
+            sql(ds, "SELECT COUNT(*) FROM ev HAVING COUNT(*) > 1")
+
+    def test_bad_having_expr(self, ds):
+        with pytest.raises(SqlError, match="unsupported HAVING"):
+            sql(ds, "SELECT name, COUNT(*) FROM ev GROUP BY name HAVING name = 'x'")
+
+    def test_having_keyword_inside_where_literal(self, ds):
+        # WHERE string literals containing clause keywords must not hijack
+        # clause splitting (quote-masked parsing)
+        r = sql(ds, "SELECT name FROM ev WHERE name = 'a having b' LIMIT 5")
+        assert len(r) == 0
+
+    def test_distinct_with_aggregates_rejected(self, ds):
+        with pytest.raises(SqlError, match="DISTINCT"):
+            sql(ds, "SELECT DISTINCT COUNT(*) FROM ev GROUP BY name")
+
+    def test_having_unknown_column(self, ds):
+        with pytest.raises(SqlError, match="unknown HAVING column"):
+            sql(ds, "SELECT name FROM ev GROUP BY name HAVING SUM(bogus) > 0")
