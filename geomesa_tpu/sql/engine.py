@@ -520,19 +520,28 @@ def sql(ds, statement: str) -> SqlResult:
         hit = _parse_item(hm.group("expr"))
         if hit.kind != "agg":
             raise SqlError("HAVING supports aggregate comparisons only")
-        if hit.arg not in ("", "*") and hit.arg not in t.columns:
+        if hit.arg == "*":
+            if hit.fn != "count":
+                raise SqlError(f"{hit.fn.upper()}(*) is not supported")
+        elif hit.arg not in t.columns:
             raise SqlError(f"unknown HAVING column {hit.arg!r}")
         import operator as _op
 
         ops = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
                ">": _op.gt, ">=": _op.ge}
         lit = float(hm.group("lit"))
-        kept = [
-            (k, g)
-            for k, g in zip(group_keys, groups)
-            if (v := _agg_value(hit.fn, hit.arg, t, np.asarray(g, np.int64)))
-            is not None and ops[hm.group("op")](float(v), lit)
-        ]
+        def _passes(g) -> bool:
+            v = _agg_value(hit.fn, hit.arg, t, np.asarray(g, np.int64))
+            if v is None:
+                return False
+            try:
+                return bool(ops[hm.group("op")](float(v), lit))
+            except (TypeError, ValueError):
+                raise SqlError(
+                    f"HAVING {hit.fn.upper()}({hit.arg}) is not numeric"
+                ) from None
+
+        kept = [(k, g) for k, g in zip(group_keys, groups) if _passes(g)]
         group_keys = [k for k, _ in kept]
         groups = [g for _, g in kept]
     cols = {}

@@ -81,6 +81,15 @@ class _TypeState:
             from geomesa_tpu.store.delta import DeltaTier
 
             self.delta = DeltaTier()
+        # plan cache (the reference's SoftThreadLocal plan caches,
+        # QueryPlanner.scala:160): (filter text, forced index) → planned
+        # (IndexPlan, residual AST, info). Entries are valid for the
+        # CURRENT `indices` object only — every state swap clears it, and
+        # both lookup and insert verify `st.indices is <snapshot indices>`
+        # under `lock`, so a stale plan can never pair with fresh indices
+        from collections import OrderedDict
+
+        self.plan_cache: OrderedDict = OrderedDict()
         import threading
 
         # `lock` guards the coherent (table, indices, backend_state, stats,
@@ -353,6 +362,7 @@ class DataStore:
                     st.indices = build_indices(new_sft)
                     st.backend_state = None
                     st.delta.drop_first(n_tables)
+                    st.plan_cache.clear()
         if rename_to and rename_to != type_name:
             with self._schema_lock:
                 self._types[rename_to] = self._types.pop(type_name)
@@ -544,6 +554,7 @@ class DataStore:
             st.backend_state = backend_state
             st.stats = stats
             st.delta.drop_first(consumed_tables)
+            st.plan_cache.clear()
 
     # -- age-off (AgeOffIterator / DtgAgeOffIterator role) --------------------
     @staticmethod
@@ -588,6 +599,7 @@ class DataStore:
                     st.backend_state = None
                     st.stats = None
                     st.delta.drop_first(n_tables)
+                    st.plan_cache.clear()
             return removed
 
     @staticmethod
@@ -684,9 +696,19 @@ class DataStore:
                 # referee path: no planning, brute force
                 rows = self.backend.select(None, None, None, None, f, main)
             else:
-                planner = QueryPlanner(st.sft, indices, stats)
                 t0 = _time.perf_counter()
-                plan, f, plan_box["info"] = planner.plan(q)
+                # TTL stores rewrite the filter with a now_ms cut per call —
+                # the key would never repeat, so don't pay the cache overhead
+                cache_key = None if ttl is not None else self._plan_cache_key(q)
+                cached = self._plan_lookup(st, indices, cache_key)
+                if cached is not None:
+                    plan, f, plan_box["info"] = cached
+                else:
+                    planner = QueryPlanner(st.sft, indices, stats)
+                    plan, f, plan_box["info"] = planner.plan(q)
+                    self._plan_store(
+                        st, indices, cache_key, (plan, f, plan_box["info"])
+                    )
                 plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
                 info = plan_box["info"]
                 # circuit open → don't touch the device; exact host scan
@@ -756,6 +778,46 @@ class DataStore:
         return QueryResult(
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
+
+    _PLAN_CACHE_MAX = 128
+
+    @staticmethod
+    def _plan_cache_key(q: "Query"):
+        """Cache key for a query's PLANNING inputs, or None if uncacheable.
+        Planning reads only the filter and the forced-index hint."""
+        f = q.filter
+        if f is None:
+            text = "INCLUDE"
+        elif isinstance(f, str):
+            text = f
+        else:
+            try:
+                text = ast.to_cql(f)
+            except ValueError:
+                return None
+        return (text, q.hints.get("index"))
+
+    def _plan_lookup(self, st: _TypeState, indices, key):
+        if key is None:
+            return None
+        with st.lock:
+            if st.indices is not indices:
+                return None  # our snapshot is older than the live state
+            hit = st.plan_cache.get(key)
+            if hit is not None:
+                st.plan_cache.move_to_end(key)
+                self.metrics.counter("store.plan_cache.hits").inc()
+            return hit
+
+    def _plan_store(self, st: _TypeState, indices, key, value) -> None:
+        if key is None:
+            return
+        with st.lock:
+            if st.indices is not indices:
+                return  # state swapped since our snapshot: plan is stale
+            st.plan_cache[key] = value
+            while len(st.plan_cache) > self._PLAN_CACHE_MAX:
+                st.plan_cache.popitem(last=False)
 
     def device_residency(self, type_name: str) -> dict:
         """HBM residency report for one type: per-index device bytes, total,

@@ -1,0 +1,116 @@
+"""Query-plan cache (the reference's SoftThreadLocal plan caches,
+``QueryPlanner.scala:160``): repeated filters skip re-planning; every state
+swap invalidates; stale plans can never pair with fresh indices."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.cql import parse as parse_cql
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+SPEC = "name:String,dtg:Date,*geom:Point;geomesa.z3.interval='day'"
+Q = "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2017-07-05T00:00:00Z/2017-07-12T00:00:00Z"
+
+
+def store(n=20_000, backend="tpu", seed=0):
+    rng = np.random.default_rng(seed)
+    ds = DataStore(backend=backend)
+    ds.create_schema(parse_spec("evt", SPEC))
+    recs = [
+        {"name": f"n{i % 9}", "dtg": int(T0 + rng.integers(0, 30 * 86_400_000)),
+         "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90)))}
+        for i in range(n)
+    ]
+    ds.write("evt", recs, fids=[str(i) for i in range(n)])
+    return ds
+
+
+class TestPlanCache:
+    def test_hits_and_identical_results(self):
+        ds = store()
+        r0 = ds.query("evt", Q)
+        assert ds.metrics.counter("store.plan_cache.hits").count == 0
+        for _ in range(5):
+            assert set(ds.query("evt", Q).table.fids.tolist()) == set(
+                r0.table.fids.tolist()
+            )
+        assert ds.metrics.counter("store.plan_cache.hits").count == 5
+
+    def test_ast_filters_cache_via_to_cql(self):
+        ds = store()
+        f = parse_cql(Q)
+        ds.query("evt", Query(filter=f))
+        ds.query("evt", Query(filter=f))
+        # AST filters key by their rendered CQL (distinct from the raw
+        # string form, which renders differently)
+        assert ds.metrics.counter("store.plan_cache.hits").count == 1
+        assert set(ds.query("evt", Query(filter=f)).table.fids.tolist()) == set(
+            ds.query("evt", Q).table.fids.tolist()
+        )
+
+    def test_forced_index_hint_is_part_of_key(self):
+        ds = store()
+        ds.query("evt", Query(filter=Q))
+        r = ds.query("evt", Query(filter=Q, hints={"index": "z2"}))
+        assert r.plan_info.index_name == "z2"
+        # the unhinted query must NOT be served the forced-z2 cached plan
+        r = ds.query("evt", Query(filter=Q))
+        assert r.plan_info.index_name == "z3"
+        st = ds._state("evt")
+        keys = list(st.plan_cache)
+        assert (Q, None) in keys and (Q, "z2") in keys
+
+    def test_invalidated_on_compaction(self):
+        ds = store(5_000)
+        r0 = ds.query("evt", Q)
+        ds.query("evt", Q)  # cached
+        ds.write("evt", [{"name": "zzz", "dtg": T0 + 6 * 86_400_000,
+                          "geom": Point(0.0, 0.0)}], fids=["newrow"])
+        ds.compact("evt")
+        r2 = ds.query("evt", Q)
+        assert "newrow" in set(r2.table.fids.tolist())
+        assert r2.count == r0.count + 1
+
+    def test_lru_bound(self):
+        ds = store(2_000)
+        for i in range(DataStore._PLAN_CACHE_MAX + 40):
+            ds.query("evt", f"BBOX(geom, {i % 170}, 0, {i % 170 + 1}, 1)")
+        st = ds._state("evt")
+        assert len(st.plan_cache) <= DataStore._PLAN_CACHE_MAX
+
+    def test_concurrent_queries_and_compactions(self):
+        ds = store(10_000)
+        oracle = store(10_000, backend="oracle")
+        want = set(oracle.query("evt", Q).table.fids.tolist())
+        stop = threading.Event()
+        errs = []
+
+        def churn():
+            i = 0
+            try:
+                while not stop.is_set():
+                    ds.write("evt", [{"name": "x", "dtg": T0,
+                                      "geom": Point(150.0, 80.0)}],
+                             fids=[f"churn{i}"])
+                    ds.compact("evt")
+                    i += 1
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for _ in range(40):
+                got = {f for f in ds.query("evt", Q).table.fids.tolist()
+                       if not f.startswith("churn")}
+                assert got == want  # churn rows are outside Q's box/window
+        finally:
+            stop.set()
+            t.join(timeout=15)
+        assert not errs

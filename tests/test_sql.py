@@ -282,3 +282,11 @@ class TestDistinctHaving:
     def test_having_unknown_column(self, ds):
         with pytest.raises(SqlError, match="unknown HAVING column"):
             sql(ds, "SELECT name FROM ev GROUP BY name HAVING SUM(bogus) > 0")
+
+    def test_having_star_only_for_count(self, ds):
+        with pytest.raises(SqlError, match=r"AVG\(\*\)"):
+            sql(ds, "SELECT name FROM ev GROUP BY name HAVING AVG(*) > 0")
+
+    def test_having_non_numeric_aggregate(self, ds):
+        with pytest.raises(SqlError, match="not numeric"):
+            sql(ds, "SELECT name FROM ev GROUP BY name HAVING MIN(name) > 0")
