@@ -23,32 +23,16 @@ def encode(lon: float, lat: float, precision_chars: int) -> str:
 
 
 def _covering_hashes(x1, y1, x2, y2, precision_chars: int) -> list[str]:
-    """Geohash cells (at a fixed character precision) covering a bbox."""
-    # cell sizes at `precision_chars` characters
-    seed = encode(min(max(x1, -180), 180), min(max(y1, -90), 90), precision_chars)
-    gx1, gy1, gx2, gy2 = geohash_bbox(seed)
-    dx = gx2 - gx1
-    dy = gy2 - gy1
-    out = []
-    y = y1
-    while True:
-        x = x1
-        while True:
-            out.append(encode(min(max(x, -180), 179.9999999), min(max(y, -90), 89.9999999), precision_chars))
-            x += dx
-            if x >= x2 + dx * 0.5 or x > 180:
-                break
-        y += dy
-        if y >= y2 + dy * 0.5 or y > 90:
-            break
-    # dedupe, stable order
-    seen = set()
-    uniq = []
-    for h in out:
-        if h not in seen:
-            seen.add(h)
-            uniq.append(h)
-    return uniq
+    """Geohash cells (at a fixed character precision) covering a bbox —
+    delegates to the vectorized shared cover (one implementation of the
+    cell-walk edge cases, not two)."""
+    from geomesa_tpu.spatial.geohash import geohashes_in_bbox
+
+    return geohashes_in_bbox(
+        (max(float(x1), -180.0), max(float(y1), -90.0),
+         min(float(x2), 180.0), min(float(y2), 90.0)),
+        precision_chars,
+    )
 
 
 class RasterStore:

@@ -19,6 +19,7 @@ __all__ = [
     "geohash_decode",
     "geohash_bbox",
     "geohash_neighbors",
+    "geohashes_in_bbox",
 ]
 
 # 12 chars = 60 bits, the standard maximum (and the most the 31-bit-per-dim
@@ -118,3 +119,39 @@ def geohash_neighbors(gh: str) -> list[str]:
             lon = ((lon + 180.0) % 360.0) - 180.0
             out.append(str(geohash_encode(lon, lat, len(gh))))
     return out
+
+
+def geohashes_in_bbox(
+    bbox, precision_chars: int = 5, max_hashes: int = 100_000
+) -> list[str]:
+    """Enumerate the geohash cells intersecting a (xmin, ymin, xmax, ymax)
+    box — the ``GeohashUtils`` bbox-iteration role (coarse covers for
+    polygon filters / raster keying). Cells come back column-major: one
+    west→east column at a time, south→north within each column. Raises when
+    the cover would exceed ``max_hashes`` (pick a coarser precision
+    instead)."""
+    if not 1 <= precision_chars <= MAX_PRECISION_CHARS:
+        raise ValueError(f"precision must be 1..12 chars: {precision_chars}")
+    xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+    if xmin > xmax or ymin > ymax:
+        raise ValueError(f"malformed bbox: {bbox}")
+    bits = 5 * precision_chars
+    lon_bits = (bits + 1) // 2
+    lat_bits = bits // 2
+    dx = 360.0 / (1 << lon_bits)
+    dy = 180.0 / (1 << lat_bits)
+    ix0 = int(np.clip((xmin + 180.0) // dx, 0, (1 << lon_bits) - 1))
+    ix1 = int(np.clip((xmax + 180.0) // dx, 0, (1 << lon_bits) - 1))
+    iy0 = int(np.clip((ymin + 90.0) // dy, 0, (1 << lat_bits) - 1))
+    iy1 = int(np.clip((ymax + 90.0) // dy, 0, (1 << lat_bits) - 1))
+    n = (ix1 - ix0 + 1) * (iy1 - iy0 + 1)
+    if n > max_hashes:
+        raise ValueError(
+            f"bbox cover needs {n} geohashes at {precision_chars} chars "
+            f"(max_hashes={max_hashes}); use a coarser precision"
+        )
+    xs = np.arange(ix0, ix1 + 1)
+    ys = np.arange(iy0, iy1 + 1)
+    cx = -180.0 + (np.repeat(xs, len(ys)) + 0.5) * dx
+    cy = -90.0 + (np.tile(ys, len(xs)) + 0.5) * dy
+    return geohash_encode(cx, cy, precision_chars).tolist()

@@ -331,3 +331,33 @@ class TestSTRegistry:
     def test_lat_lon_text(self):
         txt = st("st_asLatLonText", P(-75.5, 35.25))
         assert "35°15'" in txt and "N" in txt and "W" in txt
+
+
+class TestGeohashBboxCover:
+    def test_cover_tight_and_complete(self):
+        from geomesa_tpu.spatial.geohash import (
+            geohash_bbox,
+            geohash_encode,
+            geohashes_in_bbox,
+        )
+
+        box = (-0.6, 51.2, 0.4, 51.7)
+        ghs = geohashes_in_bbox(box, 5)
+        assert len(ghs) == len(set(ghs))
+        for g in ghs:
+            x1, y1, x2, y2 = geohash_bbox(g)
+            assert x2 >= box[0] and x1 <= box[2]
+            assert y2 >= box[1] and y1 <= box[3]
+        for cx, cy in [(box[0], box[1]), (box[2], box[3])]:
+            assert str(geohash_encode([cx], [cy], 5)[0]) in set(ghs)
+
+    def test_limits(self):
+        import pytest
+
+        from geomesa_tpu.spatial.geohash import geohashes_in_bbox
+
+        with pytest.raises(ValueError, match="max_hashes"):
+            geohashes_in_bbox((-180, -90, 180, 90), 6)
+        with pytest.raises(ValueError, match="precision"):
+            geohashes_in_bbox((0, 0, 1, 1), 0)
+        assert len(geohashes_in_bbox((-180, -90, 180, 90), 1)) == 32
