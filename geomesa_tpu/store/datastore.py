@@ -497,6 +497,39 @@ class DataStore:
             )
             return removed
 
+    def update_features(self, type_name: str, data, fids) -> int:
+        """Replace the features with the given ids (the
+        ``GeoMesaFeatureWriter`` MODIFY flavor): delete + append under the
+        mutation lock. Like the reference (no cross-index transactions,
+        ``IndexAdapter.scala:139`` validates-then-writes), the replacement
+        is not atomic for concurrent readers — a query racing the update may
+        briefly miss the row; it never sees both versions after return."""
+        fids = [str(f) for f in fids]
+        if len(set(fids)) != len(fids):
+            raise ValueError("update_features: duplicate fids")
+        if isinstance(data, list):
+            if len(data) != len(fids):
+                raise ValueError(
+                    f"update_features: {len(data)} records for {len(fids)} fids"
+                )
+        elif [str(f) for f in data.fids] != fids:
+            # a table carries its own fids; they must BE the replaced ids or
+            # the delete and the append would target different features
+            raise ValueError("update_features: table fids != fids argument")
+        st = self._state(type_name)
+        with st.mutate_lock:
+            # validate the replacement BEFORE deleting: a malformed update
+            # must fail without destroying the original rows (the reference's
+            # validates-then-writes pattern)
+            table = (
+                FeatureTable.from_records(st.sft, data, fids)
+                if isinstance(data, list)
+                else data
+            )
+            self._validate(st.sft, table)
+            self.delete_features(type_name, fids)
+            return self.write(type_name, table)
+
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
         reload + stats rebuild). Atomic: state swaps only on success, and

@@ -320,3 +320,88 @@ class TestDeltaTier:
             a = set(oracle.query("dp", cql).table.fids.tolist())
             b = set(tpu.query("dp", cql).table.fids.tolist())
             assert a == b, f"delta parity failure for {cql!r}"
+
+
+class TestUpdateFeatures:
+    def _store(self, backend="oracle"):
+        from geomesa_tpu.schema.sft import parse_spec
+
+        ds = DataStore(backend=backend)
+        ds.create_schema(parse_spec("t", "name:String,dtg:Date,*geom:Point"))
+        ds.write(
+            "t",
+            [{"name": f"v{i}", "dtg": 1_500_000_000_000 + i,
+              "geom": Point(float(i), float(i))} for i in range(20)],
+            fids=[f"f{i}" for i in range(20)],
+        )
+        return ds
+
+    def test_replaces_in_place(self):
+        for backend in ("oracle", "tpu"):
+            ds = self._store(backend)
+            n = ds.update_features(
+                "t",
+                [{"name": "updated", "dtg": 1_500_000_100_000,
+                  "geom": Point(99.0, 9.0)}],
+                ["f3"],
+            )
+            assert n == 1
+            r = ds.query("t")
+            assert r.count == 20  # replaced, not appended
+            rec = {rec_["name"] for rec_ in r.records()}
+            assert "updated" in rec and "v3" not in rec
+            hit = ds.query("t", "BBOX(geom, 98, 8, 100, 10)")
+            assert hit.table.fids.tolist() == ["f3"]
+
+    def test_update_new_fid_appends(self):
+        ds = self._store()
+        ds.update_features(
+            "t", [{"name": "new", "dtg": 1, "geom": Point(0.5, 0.5)}], ["brand"]
+        )
+        assert ds.query("t").count == 21
+
+    def test_length_mismatch(self):
+        import pytest
+
+        ds = self._store()
+        with pytest.raises(ValueError, match="records for"):
+            ds.update_features("t", [{"name": "x", "dtg": 1,
+                                      "geom": Point(0, 0)}], ["a", "b"])
+
+    def test_table_fid_mismatch(self):
+        import pytest
+
+        from geomesa_tpu.schema.columnar import FeatureTable
+
+        ds = self._store()
+        t = FeatureTable.from_records(
+            ds.get_schema("t"),
+            [{"name": "x", "dtg": 1, "geom": Point(0, 0)}],
+            ["other"],
+        )
+        with pytest.raises(ValueError, match="table fids"):
+            ds.update_features("t", t, ["f0"])
+
+    def test_invalid_update_preserves_original(self):
+        import pytest
+
+        ds = self._store()
+        before = ds.query("t", "IN ('f3')").records()
+        with pytest.raises(ValueError):
+            ds.update_features(
+                "t", [{"name": "x", "dtg": None, "geom": Point(0, 0)}], ["f3"]
+            )
+        after = ds.query("t", "IN ('f3')").records()
+        assert after == before  # failed update destroyed nothing
+
+    def test_duplicate_fids_rejected(self):
+        import pytest
+
+        ds = self._store()
+        with pytest.raises(ValueError, match="duplicate fids"):
+            ds.update_features(
+                "t",
+                [{"name": "a", "dtg": 1, "geom": Point(0, 0)},
+                 {"name": "b", "dtg": 2, "geom": Point(1, 1)}],
+                ["f1", "f1"],
+            )
