@@ -12,6 +12,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
     POST   /api/schemas/{name}/features          GeoJSON FeatureCollection in
+    PUT    /api/schemas/{name}/features          replace-by-id (WFS-T Update)
+    DELETE /api/schemas/{name}/features?fids=a,b (WFS-T Delete)
     GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|csv|leaflet
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
@@ -84,6 +86,8 @@ class GeoMesaApp:
             ("PATCH", r"^/api/schemas/([^/]+)$", self._update_schema),
             ("DELETE", r"^/api/schemas/([^/]+)$", self._delete_schema),
             ("POST", r"^/api/schemas/([^/]+)/features$", self._add_features),
+            ("PUT", r"^/api/schemas/([^/]+)/features$", self._update_features),
+            ("DELETE", r"^/api/schemas/([^/]+)/features$", self._delete_features),
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
             ("POST", r"^/api/schemas/([^/]+)/count-many$", self._count_many),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
@@ -228,8 +232,10 @@ class GeoMesaApp:
         self.store.delete_schema(name)
         return 204, None, "application/json"
 
-    def _add_features(self, name, params, body):
-        if not body:
+    def _geojson_records(self, name, body, require_id: bool):
+        """GeoJSON FeatureCollection (or bare Feature) body → (records,
+        fids). ``require_id``: modify semantics address features by id."""
+        if not isinstance(body, dict):
             raise _HttpError(400, "expected a GeoJSON FeatureCollection body")
         feats = body.get("features", [body] if body.get("type") == "Feature" else None)
         if feats is None:
@@ -237,9 +243,10 @@ class GeoMesaApp:
         from geomesa_tpu.convert.json_converter import geojson_geometry
 
         sft = self.store.get_schema(name)
-        recs = []
-        fids = []
+        recs, fids = [], []
         for i, f in enumerate(feats):
+            if require_id and "id" not in f:
+                raise _HttpError(400, f"feature {i}: updates require an id")
             props = dict(f.get("properties") or {})
             if sft.geom_field:
                 g = geojson_geometry(f.get("geometry"))
@@ -248,10 +255,35 @@ class GeoMesaApp:
                 props[sft.geom_field] = g
             recs.append({a.name: props.get(a.name) for a in sft.attributes})
             fids.append(str(f["id"]) if "id" in f else None)
+        return recs, fids
+
+    def _add_features(self, name, params, body):
+        recs, fids = self._geojson_records(name, body, require_id=False)
         if any(f is None for f in fids):
-            fids = None
+            fids = None  # auto-generated z3-uuid fids
         n = self.store.write(name, recs, fids=fids)
         return 201, {"written": n}, "application/json"
+
+    def _update_features(self, name, params, body):
+        """WFS-T Update analog: replace features by id (modify writer);
+        store-side ValueError maps to 400 via the dispatch handler."""
+        recs, fids = self._geojson_records(name, body, require_id=True)
+        n = self.store.update_features(name, recs, fids)
+        return 200, {"updated": n}, "application/json"
+
+    def _delete_features(self, name, params, body):
+        """WFS-T Delete analog: ``?fids=a,b,c`` (or body {"fids": [...]})."""
+        fids = [f for f in params.get("fids", "").split(",") if f]
+        if not fids and isinstance(body, dict):
+            fids = body.get("fids")
+        if not (
+            isinstance(fids, list)
+            and fids
+            and all(isinstance(f, str) for f in fids)
+        ):
+            raise _HttpError(400, 'expected ?fids=a,b,c or {"fids": [...]}')
+        n = self.store.delete_features(name, fids)
+        return 200, {"deleted": n}, "application/json"
 
     def _int_param(self, params, key):
         if key not in params:

@@ -224,3 +224,65 @@ class TestQueryAndStats:
         # store still listable and unchanged
         status, out = jcall(app, "GET", "/api/schemas")
         assert status == 200 and "pts" in out["schemas"]
+
+
+class TestFeatureModification:
+    """WFS-T Update/Delete analog endpoints."""
+
+    def _app(self):
+        from geomesa_tpu.geometry import Point
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.store.datastore import DataStore
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema(parse_spec("t", "name:String,*geom:Point"))
+        ds.write("t", [{"name": f"v{i}", "geom": Point(float(i), 0.0)}
+                       for i in range(5)], fids=[f"f{i}" for i in range(5)])
+        return GeoMesaApp(ds), ds
+
+    def test_put_updates_by_id(self):
+        app, ds = self._app()
+        body = {"type": "FeatureCollection", "features": [
+            {"type": "Feature", "id": "f2",
+             "geometry": {"type": "Point", "coordinates": [50.0, 5.0]},
+             "properties": {"name": "replaced"}},
+        ]}
+        status, out, _ = app._update_features("t", {}, body)
+        assert status == 200 and out["updated"] == 1
+        r = ds.query("t", "BBOX(geom, 49, 4, 51, 6)")
+        assert r.table.fids.tolist() == ["f2"]
+        assert ds.query("t").count == 5
+
+    def test_put_requires_ids(self):
+        from geomesa_tpu.web.app import _HttpError
+
+        app, _ = self._app()
+        body = {"type": "FeatureCollection", "features": [
+            {"type": "Feature",
+             "geometry": {"type": "Point", "coordinates": [0.0, 0.0]},
+             "properties": {"name": "x"}},
+        ]}
+        import pytest
+
+        with pytest.raises(_HttpError) as e:
+            app._update_features("t", {}, body)
+        assert e.value.status == 400
+
+    def test_delete_by_fids_param(self):
+        app, ds = self._app()
+        status, out, _ = app._delete_features("t", {"fids": "f1,f3"}, None)
+        assert status == 200 and out["deleted"] == 2
+        assert ds.query("t").count == 3
+        # body form too
+        status, out, _ = app._delete_features("t", {}, {"fids": ["f0"]})
+        assert out["deleted"] == 1
+
+    def test_delete_requires_fids(self):
+        import pytest
+
+        from geomesa_tpu.web.app import _HttpError
+
+        app, _ = self._app()
+        with pytest.raises(_HttpError):
+            app._delete_features("t", {}, None)
