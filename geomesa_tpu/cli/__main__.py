@@ -95,6 +95,20 @@ def cmd_delete_schema(args):
     print(f"deleted schema {args.name!r}")
 
 
+def cmd_delete_features(args):
+    ds = _load(args)
+    if args.fids is not None:
+        fids = [f for f in args.fids.split(",") if f]
+        if not fids:
+            raise SystemExit("--fids must name at least one feature id")
+        n = ds.delete_features(args.name, fids)
+    else:
+        r = ds.query(args.name, args.cql)
+        n = ds.delete_features(args.name, r.table.fids.tolist())
+    _save(ds, args)
+    print(f"deleted {n} features from {args.name!r}")
+
+
 def cmd_ingest(args):
     from geomesa_tpu.convert.delimited import DelimitedConverter, EvaluationContext
 
@@ -509,6 +523,15 @@ def main(argv=None):
     )
     common(sp)
     sp.set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser(
+        "delete-features", help="remove features by id list or CQL filter"
+    )
+    common(sp)
+    g = sp.add_mutually_exclusive_group(required=True)
+    g.add_argument("--fids", help="comma-separated feature ids")
+    g.add_argument("-q", "--cql", help="delete every feature matching")
+    sp.set_defaults(fn=cmd_delete_features)
 
     args = p.parse_args(argv)
     try:

@@ -49,6 +49,7 @@ _STATUS = {
     201: "201 Created",
     204: "204 No Content",
     400: "400 Bad Request",
+    403: "403 Forbidden",
     404: "404 Not Found",
     405: "405 Method Not Allowed",
     500: "500 Internal Server Error",
@@ -118,7 +119,7 @@ class GeoMesaApp:
             metrics.counter("web.requests").inc()
         try:
             body = None
-            if method in ("POST", "PUT", "PATCH"):
+            if method in ("POST", "PUT", "PATCH", "DELETE"):
                 length = int(environ.get("CONTENT_LENGTH") or 0)
                 raw = environ["wsgi.input"].read(length) if length else b""
                 body = json.loads(raw) if raw else None
@@ -264,10 +265,33 @@ class GeoMesaApp:
         n = self.store.write(name, recs, fids=fids)
         return 201, {"written": n}, "application/json"
 
+    def _assert_fids_mutable(self, name, params, fids) -> None:
+        """Visibility guard for mutations: a restricted caller may only
+        touch features it can SEE. Any target that exists outside the
+        caller's visibility is a uniform 403 (not 404 — revealing which ids
+        exist is itself the leak)."""
+        auths = self._restricted_auths(name, params)
+        if auths is None:
+            return
+        from geomesa_tpu.filter import ast as _ast
+
+        fid_filter = _ast.FidIn(tuple(fids))
+        all_ids = set(
+            self.store.query(name, Query(filter=fid_filter)).table.fids.tolist()
+        )
+        visible = set(
+            self.store.query(
+                name, Query(filter=fid_filter, auths=auths)
+            ).table.fids.tolist()
+        )
+        if all_ids - visible:
+            raise _HttpError(403, "forbidden: target features not visible")
+
     def _update_features(self, name, params, body):
         """WFS-T Update analog: replace features by id (modify writer);
         store-side ValueError maps to 400 via the dispatch handler."""
         recs, fids = self._geojson_records(name, body, require_id=True)
+        self._assert_fids_mutable(name, params, fids)
         n = self.store.update_features(name, recs, fids)
         return 200, {"updated": n}, "application/json"
 
@@ -282,6 +306,7 @@ class GeoMesaApp:
             and all(isinstance(f, str) for f in fids)
         ):
             raise _HttpError(400, 'expected ?fids=a,b,c or {"fids": [...]}')
+        self._assert_fids_mutable(name, params, fids)
         n = self.store.delete_features(name, fids)
         return 200, {"deleted": n}, "application/json"
 

@@ -147,3 +147,29 @@ class TestRestEnforcement:
             "tracks", {"format": "geojson"}, None
         )
         assert len(body["features"]) == 5
+
+
+class TestMutationVisibilityGuard:
+    def test_restricted_caller_cannot_touch_hidden_rows(self):
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = vis_store()  # rows: admin, '', user|admin, secret, admin&ops
+        app = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
+        params = {"__auths__": ["admin"]}  # sees f0, f1, f2
+        with pytest.raises(_HttpError) as e:
+            app._delete_features("tracks", {**params, "fids": "f3"}, None)
+        assert e.value.status == 403
+        assert ds.query("tracks").count == 5  # nothing deleted
+        # visible rows remain deletable
+        status, out, _ = app._delete_features(
+            "tracks", {**params, "fids": "f1"}, None
+        )
+        assert status == 200 and out["deleted"] == 1
+
+    def test_unrestricted_caller_unaffected(self):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = vis_store()
+        app = GeoMesaApp(ds)  # no provider
+        status, out, _ = app._delete_features("tracks", {"fids": "f3"}, None)
+        assert status == 200 and out["deleted"] == 1

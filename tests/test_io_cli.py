@@ -468,3 +468,25 @@ class TestPartitionSchemes:
         r = ds2.query("t", "INCLUDE").table
         names = {f: rec for f, rec in zip(r.fids, (r.record(i) for i in range(len(r))))}
         assert names["f7"]["name"] is None
+
+
+class TestDeleteFeaturesCommand:
+    def test_delete_by_fids_and_cql(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        run_cli("create-schema", "-c", cat, "-n", "t",
+                "--spec", "name:String,dtg:Date,*geom:Point")
+        f = tmp_path / "d.csv"
+        f.write_text("\n".join(
+            f"n{i},2017-07-01T00:00:00Z,{i},0" for i in range(10)) + "\n")
+        run_cli("ingest", "-c", cat, "-n", "t", "--backend", "oracle",
+                "--field", "name=$1", "--field", "dtg=isodate($2)",
+                "--field", "geom=point($3, $4)", "--id-field", "$1", str(f))
+        capsys.readouterr()
+        run_cli("delete-features", "-c", cat, "-n", "t",
+                "--backend", "oracle", "--fids", "n0,n1")
+        assert "deleted 2" in capsys.readouterr().out
+        run_cli("delete-features", "-c", cat, "-n", "t",
+                "--backend", "oracle", "-q", "BBOX(geom, 4.5, -1, 7.5, 1)")
+        assert "deleted 3" in capsys.readouterr().out
+        run_cli("stats-count", "-c", cat, "-n", "t", "--backend", "oracle")
+        assert capsys.readouterr().out.strip() == "5"

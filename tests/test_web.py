@@ -286,3 +286,34 @@ class TestFeatureModification:
         app, _ = self._app()
         with pytest.raises(_HttpError):
             app._delete_features("t", {}, None)
+
+
+class TestDeleteBodyOverHttp:
+    def test_delete_body_form_reaches_handler(self):
+        """The WSGI dispatcher must parse DELETE bodies (regression: the
+        documented {"fids": [...]} form was unreachable over real HTTP)."""
+        import io as _io
+        import json as _json
+
+        from geomesa_tpu.geometry import Point
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.store.datastore import DataStore
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema(parse_spec("t", "name:String,*geom:Point"))
+        ds.write("t", [{"name": "a", "geom": Point(0, 0)},
+                       {"name": "b", "geom": Point(1, 1)}], fids=["x", "y"])
+        app = GeoMesaApp(ds)
+        raw = _json.dumps({"fids": ["x"]}).encode()
+        environ = {
+            "REQUEST_METHOD": "DELETE",
+            "PATH_INFO": "/api/schemas/t/features",
+            "QUERY_STRING": "",
+            "CONTENT_LENGTH": str(len(raw)),
+            "wsgi.input": _io.BytesIO(raw),
+        }
+        out = {}
+        app(environ, lambda status, headers: out.update(status=status))
+        assert out["status"].startswith("200")
+        assert ds.query("t").count == 1
