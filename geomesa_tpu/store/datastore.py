@@ -467,12 +467,15 @@ class DataStore:
                     q = out
         return q
 
-    def delete_features(self, type_name: str, fids) -> int:
+    def delete_features(self, type_name: str, fids, visible_to=None) -> int:
         """Remove features by id (the ``GeoMesaFeatureWriter`` remove role).
 
         Rebuilds the main tier without the targeted rows (columnar stores
         delete by rewrite, like the reference's LSM deletes compact away);
-        returns the number of rows removed.
+        returns the number of rows removed. ``visible_to`` (a list of
+        authorizations) enforces record visibility UNDER the mutation lock:
+        targeting any row the auths cannot see raises ``PermissionError`` —
+        the race-proof backstop for the serving layer's pre-check.
         """
         st = self._state(type_name)
         want = {str(f) for f in fids}
@@ -488,6 +491,19 @@ class DataStore:
                 [str(f) not in want for f in combined.fids], dtype=bool
             )
             removed = int((~keep).sum())
+            if visible_to is not None and removed:
+                vis_field = (st.sft.user_data or {}).get("geomesa.vis.field")
+                if vis_field:
+                    from geomesa_tpu.security.visibility import parse_visibility
+
+                    auths = frozenset(visible_to)
+                    vvals = combined.columns[vis_field].values
+                    for i in np.nonzero(~keep)[0]:
+                        expr = vvals[i] if vvals[i] else ""
+                        if not parse_visibility(expr).evaluate(auths):
+                            raise PermissionError(
+                                "target features not visible"
+                            )
             if removed == 0:
                 return 0
             # the delta drops only after the new state swaps in — a failed
@@ -497,13 +513,14 @@ class DataStore:
             )
             return removed
 
-    def update_features(self, type_name: str, data, fids) -> int:
+    def update_features(self, type_name: str, data, fids, visible_to=None) -> int:
         """Replace the features with the given ids (the
         ``GeoMesaFeatureWriter`` MODIFY flavor): delete + append under the
         mutation lock. Like the reference (no cross-index transactions,
         ``IndexAdapter.scala:139`` validates-then-writes), the replacement
         is not atomic for concurrent readers — a query racing the update may
-        briefly miss the row; it never sees both versions after return."""
+        briefly miss the row; it never sees both versions after return.
+        ``visible_to``: see :meth:`delete_features`."""
         fids = [str(f) for f in fids]
         if len(set(fids)) != len(fids):
             raise ValueError("update_features: duplicate fids")
@@ -527,7 +544,7 @@ class DataStore:
                 else data
             )
             self._validate(st.sft, table)
-            self.delete_features(type_name, fids)
+            self.delete_features(type_name, fids, visible_to=visible_to)
             return self.write(type_name, table)
 
     def compact(self, type_name: str) -> None:

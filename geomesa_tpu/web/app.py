@@ -152,6 +152,10 @@ class GeoMesaApp:
             return self._respond(
                 start_response, 404, {"error": str(e)}, "application/json"
             )
+        except PermissionError as e:
+            return self._respond(
+                start_response, 403, {"error": str(e)}, "application/json"
+            )
         except (ValueError, TypeError) as e:
             return self._respond(
                 start_response, 400, {"error": str(e)}, "application/json"
@@ -262,37 +266,43 @@ class GeoMesaApp:
         recs, fids = self._geojson_records(name, body, require_id=False)
         if any(f is None for f in fids):
             fids = None  # auto-generated z3-uuid fids
+        elif fids and self._restricted_auths(name, params) is not None:
+            # explicit ids from a restricted caller could shadow hidden rows,
+            # and any existence check would itself be an oracle — restricted
+            # writers get auto-generated ids only
+            raise _HttpError(
+                403, "explicit feature ids require unrestricted access"
+            )
         n = self.store.write(name, recs, fids=fids)
         return 201, {"written": n}, "application/json"
 
-    def _assert_fids_mutable(self, name, params, fids) -> None:
+    def _assert_fids_mutable(self, name, params, fids):
         """Visibility guard for mutations: a restricted caller may only
-        touch features it can SEE. Any target that exists outside the
-        caller's visibility is a uniform 403 (not 404 — revealing which ids
-        exist is itself the leak)."""
+        address ids it can SEE. Any id NOT in the caller-visible set — hidden
+        or nonexistent alike, so the response can't be used as an existence
+        oracle — is a uniform 403. Returns the caller's auths (for the
+        store-level enforcement that re-checks under the mutation lock,
+        closing the check-then-act race), or None when unrestricted."""
         auths = self._restricted_auths(name, params)
         if auths is None:
-            return
+            return None
         from geomesa_tpu.filter import ast as _ast
 
-        fid_filter = _ast.FidIn(tuple(fids))
-        all_ids = set(
-            self.store.query(name, Query(filter=fid_filter)).table.fids.tolist()
-        )
         visible = set(
             self.store.query(
-                name, Query(filter=fid_filter, auths=auths)
+                name, Query(filter=_ast.FidIn(tuple(fids)), auths=auths)
             ).table.fids.tolist()
         )
-        if all_ids - visible:
+        if set(fids) - visible:
             raise _HttpError(403, "forbidden: target features not visible")
+        return auths
 
     def _update_features(self, name, params, body):
         """WFS-T Update analog: replace features by id (modify writer);
         store-side ValueError maps to 400 via the dispatch handler."""
         recs, fids = self._geojson_records(name, body, require_id=True)
-        self._assert_fids_mutable(name, params, fids)
-        n = self.store.update_features(name, recs, fids)
+        auths = self._assert_fids_mutable(name, params, fids)
+        n = self.store.update_features(name, recs, fids, visible_to=auths)
         return 200, {"updated": n}, "application/json"
 
     def _delete_features(self, name, params, body):
@@ -306,8 +316,8 @@ class GeoMesaApp:
             and all(isinstance(f, str) for f in fids)
         ):
             raise _HttpError(400, 'expected ?fids=a,b,c or {"fids": [...]}')
-        self._assert_fids_mutable(name, params, fids)
-        n = self.store.delete_features(name, fids)
+        auths = self._assert_fids_mutable(name, params, fids)
+        n = self.store.delete_features(name, fids, visible_to=auths)
         return 200, {"deleted": n}, "application/json"
 
     def _int_param(self, params, key):

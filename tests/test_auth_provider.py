@@ -173,3 +173,42 @@ class TestMutationVisibilityGuard:
         app = GeoMesaApp(ds)  # no provider
         status, out, _ = app._delete_features("tracks", {"fids": "f3"}, None)
         assert status == 200 and out["deleted"] == 1
+
+    def test_restricted_post_explicit_ids_rejected(self):
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = vis_store()
+        app = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
+        params = {"__auths__": ["admin"]}
+        body = {"type": "Feature", "id": "f3",
+                "geometry": {"type": "Point", "coordinates": [0.0, 0.0]},
+                "properties": {"vis": "", "dtg": 1}}
+        with pytest.raises(_HttpError) as e:
+            app._add_features("tracks", params, body)
+        assert e.value.status == 403
+        # auto-id writes still allowed
+        body.pop("id")
+        status, out, _ = app._add_features("tracks", params, body)
+        assert status == 201 and out["written"] == 1
+
+    def test_nonexistent_fid_indistinguishable_from_hidden(self):
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = vis_store()
+        app = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
+        params = {"__auths__": ["admin"]}
+        codes = []
+        for fid in ("f3", "no-such-row"):  # hidden vs nonexistent
+            with pytest.raises(_HttpError) as e:
+                app._delete_features("tracks", {**params, "fids": fid}, None)
+            codes.append(e.value.status)
+        assert codes == [403, 403]  # uniform: no existence oracle
+
+    def test_store_level_enforcement_under_lock(self):
+        import pytest as _pytest
+
+        ds = vis_store()
+        with _pytest.raises(PermissionError):
+            ds.delete_features("tracks", ["f3"], visible_to=["admin"])
+        assert ds.query("tracks").count == 5
+        assert ds.delete_features("tracks", ["f1"], visible_to=["admin"]) == 1

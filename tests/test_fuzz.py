@@ -1,0 +1,174 @@
+"""Property fuzzing: CQL round-trip, TWKB codec, paging partition invariant
+(reference analog: the curve/filter property suites of SURVEY.md §4, applied
+to the whole filter/codec surface with generated inputs)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.cql import parse as parse_cql
+from geomesa_tpu.geometry import LineString, MultiLineString, Point, Polygon
+from geomesa_tpu.geometry.twkb import from_twkb, from_twkb_batch, to_twkb, to_twkb_batch
+from geomesa_tpu.geometry.wkt import to_wkt
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+
+
+def _rand_filter(rng) -> str:
+    """One random predicate from the supported grammar."""
+    kind = rng.integers(0, 9)
+    if kind == 0:
+        x1, y1 = rng.uniform(-170, 150), rng.uniform(-80, 60)
+        return f"BBOX(geom, {x1:.3f}, {y1:.3f}, {x1 + 10:.3f}, {y1 + 10:.3f})"
+    if kind == 1:
+        lo = int(rng.integers(1, 14))
+        hi = lo + int(rng.integers(1, 14))
+        return (
+            f"dtg DURING 2017-07-{lo:02d}T00:00:00Z/"
+            f"2017-07-{hi:02d}T00:00:00Z"
+        )
+    if kind == 2:
+        return f"age {rng.choice(['<', '>', '<=', '>=', '=', '<>'])} {int(rng.integers(0, 100))}"
+    if kind == 3:
+        return f"name LIKE 'n{int(rng.integers(0, 9))}%'"
+    if kind == 4:
+        return f"name IN ('n{int(rng.integers(0, 5))}', 'n{int(rng.integers(5, 9))}')"
+    if kind == 5:
+        return "name IS NULL" if rng.random() < 0.5 else "name IS NOT NULL"
+    if kind == 6:
+        x, y = rng.uniform(-170, 160), rng.uniform(-80, 70)
+        return f"DWITHIN(geom, POINT ({x:.3f} {y:.3f}), {rng.uniform(10, 500):.1f}, kilometers)"
+    if kind == 7:
+        return f"age BETWEEN {int(rng.integers(0, 40))} AND {int(rng.integers(41, 99))}"
+    return f"strLength(name) = {int(rng.integers(1, 4))}"
+
+
+def _rand_tree(rng, depth=0) -> str:
+    if depth >= 2 or rng.random() < 0.4:
+        return _rand_filter(rng)
+    op = rng.choice([" AND ", " OR "])
+    parts = [f"({_rand_tree(rng, depth + 1)})" for _ in range(int(rng.integers(2, 4)))]
+    s = op.join(parts)
+    return f"NOT ({s})" if rng.random() < 0.2 else s
+
+
+def _table(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    sft = parse_spec("t", "name:String,age:Integer,dtg:Date,*geom:Point")
+    recs = [
+        {
+            "name": None if i % 17 == 0 else f"n{i % 9}",
+            "age": int(rng.integers(0, 100)),
+            "dtg": int(T0 + rng.integers(0, 28 * 86_400_000)),
+            "geom": Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))),
+        }
+        for i in range(n)
+    ]
+    return FeatureTable.from_records(sft, recs, [str(i) for i in range(n)])
+
+
+class TestCqlFuzz:
+    def test_round_trip_preserves_semantics(self):
+        """parse(to_cql(parse(s))) must select the same rows as parse(s)."""
+        t = _table()
+        rng = np.random.default_rng(42)
+        for i in range(150):
+            s = _rand_tree(rng)
+            f1 = parse_cql(s)
+            f2 = parse_cql(ast.to_cql(f1))
+            m1, m2 = f1.mask(t), f2.mask(t)
+            assert np.array_equal(m1, m2), f"iteration {i}: {s!r}"
+
+    def test_planned_equals_bruteforce(self):
+        """Index-planned execution == brute-force mask for random filters."""
+        t = _table(1200, seed=3)
+        tpu = DataStore(backend="tpu")
+        tpu.create_schema(t.sft)
+        tpu.write("t", t, fids=t.fids.tolist())
+        rng = np.random.default_rng(7)
+        for i in range(40):
+            s = _rand_tree(rng)
+            want = set(t.fids[parse_cql(s).mask(t)].tolist())
+            got = set(tpu.query("t", s).table.fids.tolist())
+            assert got == want, f"iteration {i}: {s!r}"
+
+
+class TestTwkbFuzz:
+    def _rand_geom(self, rng):
+        kind = rng.integers(0, 4)
+        if kind == 0:
+            return Point(
+                round(float(rng.uniform(-180, 180)), 6),
+                round(float(rng.uniform(-90, 90)), 6),
+            )
+        if kind == 1:
+            n = int(rng.integers(2, 40))
+            c = np.round(
+                np.cumsum(rng.normal(0, 0.05, (n, 2)), axis=0)
+                + [rng.uniform(-90, 90), rng.uniform(-45, 45)], 6,
+            )
+            return LineString(c)
+        if kind == 2:
+            cx, cy = rng.uniform(-90, 90), rng.uniform(-45, 45)
+            ang = np.linspace(0, 2 * np.pi, int(rng.integers(4, 12)), endpoint=False)
+            r = rng.uniform(0.5, 3)
+            ring = np.round(
+                np.stack([cx + r * np.cos(ang), cy + r * np.sin(ang)], axis=1), 6
+            )
+            ring = np.vstack([ring, ring[:1]])
+            return Polygon(ring)
+        return MultiLineString(
+            [LineString(np.round(rng.uniform(-50, 50, (3, 2)), 6)) for _ in range(2)]
+        )
+
+    def test_codec_round_trip(self):
+        rng = np.random.default_rng(5)
+        geoms = [self._rand_geom(rng) for _ in range(300)]
+        blobs = [to_twkb(g) for g in geoms]
+        # scalar decode, batch decode, and batch encode must all agree
+        batch_dec = from_twkb_batch(blobs)
+        packed = to_twkb_batch(geoms)
+        for i, g in enumerate(geoms):
+            scalar = from_twkb(blobs[i])
+            assert to_wkt(batch_dec[i]) == to_wkt(scalar)
+            if packed is not None:
+                buf, offs = packed
+                assert bytes(buf[offs[i] : offs[i + 1]]) == blobs[i]
+
+    def test_coordinates_within_quantum(self):
+        rng = np.random.default_rng(6)
+        for _ in range(100):
+            g = self._rand_geom(rng)
+            d = from_twkb(to_twkb(g))
+            assert np.allclose(np.array(g.bbox), np.array(d.bbox), atol=1e-6)
+
+
+class TestPagingFuzz:
+    def test_pages_partition_any_query(self):
+        """start_index pages always partition the sorted full result."""
+        t = _table(800, seed=9)
+        ds = DataStore(backend="tpu")
+        ds.create_schema(t.sft)
+        ds.write("t", t, fids=t.fids.tolist())
+        rng = np.random.default_rng(11)
+        for i in range(15):
+            s = _rand_tree(rng)
+            full = ds.query("t", Query(filter=s, sort_by=("id", False)))
+            size = int(rng.integers(1, 50))
+            pages = []
+            off = 0
+            while True:
+                p = ds.query(
+                    "t",
+                    Query(filter=s, sort_by=("id", False),
+                          start_index=off, limit=size),
+                )
+                if p.count == 0:
+                    break
+                pages.extend(p.table.fids.tolist())
+                off += size
+            assert pages == full.table.fids.tolist(), f"iteration {i}: {s!r}"
