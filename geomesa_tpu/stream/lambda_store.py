@@ -71,10 +71,19 @@ class LambdaDataStore:
         self.cold.create_schema(sft)
         return sft
 
+    def _ensure_hot(self, type_name: str) -> None:
+        """Lazily register a wrapped cold store's schema with the hot tier
+        on first touch — eager registration at wrap time would spawn
+        consumer threads (and persister work) for every cold type, streamed
+        or not."""
+        if type_name not in self.stream.list_schemas():
+            self.stream.create_schema(self.cold.get_schema(type_name))
+
     def list_schemas(self) -> list[str]:
-        return self.stream.list_schemas()
+        return self.cold.list_schemas()
 
     def write(self, type_name: str, fid: str, record: dict, ts: int | None = None):
+        self._ensure_hot(type_name)
         with self._persist_lock:
             self._tombstones.get(type_name, set()).discard(fid)  # re-put revives
         self.stream.put(type_name, fid, record, ts=ts)
@@ -83,6 +92,7 @@ class LambdaDataStore:
         """Delete from BOTH tiers: tombstone first (so a racing persist pass
         can't resurrect the feature into cold), then the hot-tier message and
         the synchronous cold delete."""
+        self._ensure_hot(type_name)
         with self._persist_lock:
             self._tombstones.setdefault(type_name, set()).add(fid)
             self.stream.delete(type_name, fid)
@@ -177,6 +187,7 @@ class LambdaDataStore:
         sub_hints = {k: v for k, v in q.hints.items() if k not in _REDUCE_HINTS}
         sub = replace(q, sort_by=None, limit=None, start_index=None,
                       hints=sub_hints, properties=None)
+        self._ensure_hot(type_name)
         hot = self.stream.query(type_name, sub)
         cold = self.cold.query(type_name, sub)
         with self._persist_lock:
@@ -214,6 +225,8 @@ class LambdaDataStore:
         )
 
     def hot_count(self, type_name: str) -> int:
+        if type_name not in self.stream.list_schemas():
+            return 0  # cold-only type: never streamed
         return self.stream.cache(type_name).size()
 
     def close(self) -> None:

@@ -1,0 +1,192 @@
+"""Capstone lifecycle test: the full 'switching from GeoMesa' user journey
+in one pass — schema DDL → config-driven ingest → CQL breadth → analytics →
+SQL → paging/export → persistence round-trip → streaming tier → HBM tier
+controls → schema evolution → modify/delete — with oracle parity where the
+device path runs. One test crossing every subsystem boundary guards the
+seams the per-module suites can't."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.store import persistence
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_498_867_200_000
+
+
+@pytest.fixture(scope="module")
+def journey(tmp_path_factory):
+    """Build one store through the whole write-side journey, once."""
+    root = tmp_path_factory.mktemp("journey")
+    ds = DataStore(backend="tpu")
+
+    # 1. DDL with index config, TTL off, visibility off (plain analytics)
+    ds.create_schema(
+        "trips",
+        "route:String:index=true,fare:Double,dtg:Date,*geom:Point;"
+        "geomesa.z3.interval='day',geomesa.fs.scheme='datetime'",
+    )
+
+    # 2. config-driven ingest (the HOCON-converter role) from a CSV
+    csv = root / "trips.csv"
+    rng = np.random.default_rng(8)
+    rows = []
+    for i in range(3000):
+        lon = float(rng.uniform(-74.3, -73.7))
+        lat = float(rng.uniform(40.5, 40.95))
+        day = int(rng.integers(1, 27))
+        rows.append(
+            f"T{i},R{i % 7},{float(rng.uniform(3, 80)):.2f},"
+            f"2017-07-{day:02d}T{int(rng.integers(0, 24)):02d}:00:00Z,"
+            f"{lon:.6f},{lat:.6f}"
+        )
+    csv.write_text("\n".join(rows) + "\n")
+    cfg = root / "conv.json"
+    cfg.write_text(json.dumps({
+        "type": "delimited-text",
+        "id-field": "$1",
+        "fields": {
+            "route": "$2", "fare": "double($3)", "dtg": "isodate($4)",
+            "geom": "point($5, $6)",
+        },
+    }))
+    from geomesa_tpu.convert.config import load_converter
+
+    conv = load_converter(str(cfg), sft=ds.get_schema("trips"))
+    t = conv.convert_path(str(csv))
+    assert len(t) == 3000
+    ds.write("trips", t)
+    ds.compact("trips")
+    return ds, root
+
+
+def _oracle_of(ds):
+    o = DataStore(backend="oracle")
+    o.create_schema(ds.get_schema("trips"))
+    full = ds.query("trips")
+    o.write("trips", full.table, fids=full.table.fids.tolist())
+    return o
+
+
+class TestJourney:
+    def test_cql_breadth_with_parity(self, journey):
+        ds, _ = journey
+        oracle = _oracle_of(ds)
+        queries = [
+            "BBOX(geom, -74.05, 40.7, -73.9, 40.85)",
+            "BBOX(geom, -74.2, 40.6, -73.8, 40.9) AND dtg DURING "
+            "2017-07-05T00:00:00Z/2017-07-12T00:00:00Z",
+            "route = 'R3' AND fare > 40",
+            "route IN ('R1', 'R2') AND strLength(route) = 2",
+            "fare BETWEEN 10 AND 20 OR route LIKE 'R6%'",
+            "DWITHIN(geom, POINT (-73.98 40.75), 3, kilometers)",
+        ]
+        for q in queries:
+            a = set(ds.query("trips", q).table.fids.tolist())
+            b = set(oracle.query("trips", q).table.fids.tolist())
+            assert a == b, q
+
+    def test_analytics(self, journey):
+        ds, _ = journey
+        # density grid conserves mass
+        r = ds.query("trips", Query(hints={"density": {
+            "bbox": (-74.3, 40.5, -73.7, 40.95), "width": 64, "height": 64}}))
+        assert float(r.density.sum()) == 3000.0
+        # grouped stats
+        r = ds.query("trips", Query(hints={"stats": "GroupBy(route, Stats(fare))"}))
+        g = r.stats["GroupBy(route, Stats(fare))"]
+        assert len(g.groups) == 7
+        assert sum(s.count for s in g.groups.values()) == 3000
+        # batched KNN, both merge topologies
+        from geomesa_tpu.process.knn import knn_many
+
+        pts = [Point(-73.98, 40.75), Point(-74.1, 40.6)]
+        for topo in ("gather", "ring"):
+            out = knn_many(ds, "trips", pts, k=5, topology=topo)
+            assert all(len(tbl) == 5 for tbl, _ in out)
+
+    def test_sql(self, journey):
+        ds, _ = journey
+        from geomesa_tpu.sql import sql
+
+        r = sql(ds, "SELECT route, COUNT(*) AS n, AVG(fare) AS avg_fare "
+                    "FROM trips GROUP BY route HAVING COUNT(*) > 10 "
+                    "ORDER BY n DESC")
+        assert sum(r.columns["n"]) == 3000
+        assert all(float(v) > 0 for v in r.columns["avg_fare"])
+        d = sql(ds, "SELECT DISTINCT route FROM trips")
+        assert len(d) == 7
+
+    def test_paging_and_arrow_export(self, journey):
+        ds, _ = journey
+        from geomesa_tpu.io.arrow import from_ipc_bytes, to_ipc_bytes
+
+        q = "BBOX(geom, -74.1, 40.6, -73.8, 40.9)"
+        full = ds.query("trips", Query(filter=q, sort_by=("id", False)))
+        paged = []
+        for off in range(0, full.count, 500):
+            p = ds.query("trips", Query(filter=q, sort_by=("id", False),
+                                        start_index=off, limit=500))
+            paged.extend(p.table.fids.tolist())
+        assert paged == full.table.fids.tolist()
+        ipc = to_ipc_bytes(full.table)
+        back = from_ipc_bytes(ds.get_schema("trips"), ipc)
+        assert back.fids.tolist() == full.table.fids.tolist()
+
+    def test_persistence_roundtrip_with_pruning(self, journey):
+        ds, root = journey
+        cat = str(root / "cat")
+        persistence.save(ds, cat)
+        flt = ("BBOX(geom, -75, 40, -73, 41) AND dtg DURING "
+               "2017-07-03T00:00:00Z/2017-07-06T00:00:00Z")
+        ds2 = persistence.load(cat, backend="oracle", filter=flt)
+        assert ds2.metrics.counter("catalog.partitions_pruned.trips").count > 0
+        want = set(ds.query("trips", flt).table.fids.tolist())
+        assert set(ds2.query("trips", flt).table.fids.tolist()) == want
+
+    def test_hbm_tier_controls(self, journey):
+        ds, _ = journey
+        res = ds.device_residency("trips")
+        assert res["resident"]
+        q = "route = 'R1'"
+        want = set(ds.query("trips", q).table.fids.tolist())
+        ds.evict_device("trips")
+        assert set(ds.query("trips", q).table.fids.tolist()) == want
+        assert ds.recover("trips")
+        assert ds.device_residency("trips")["resident"]
+
+    def test_modify_delete_evolve(self, journey):
+        ds, _ = journey
+        n0 = ds.query("trips").count
+        ds.update_features(
+            "trips",
+            [{"route": "R0", "fare": 1.0, "dtg": T0, "geom": Point(-74.0, 40.7)}],
+            ["T17"],
+        )
+        assert ds.query("trips").count == n0
+        assert ds.query("trips", "IN ('T17')").records()[0]["fare"] == 1.0
+        ds.delete_features("trips", ["T18", "T19"])
+        assert ds.query("trips").count == n0 - 2
+        # schema evolution: append an attribute, old rows null
+        ds.update_schema("trips", add="tip:Double")
+        assert ds.query("trips", "tip IS NULL").count == n0 - 2
+
+    def test_streaming_tier(self, journey):
+        ds, _ = journey
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lds = LambdaDataStore(persist_age_ms=1000, persist_interval_s=None,
+                              consumers=2, cold=ds)
+        now = T0 + 30 * 86_400_000
+        for i in range(50):
+            lds.write("trips", f"live{i}",
+                      {"route": "LIVE", "fare": 9.9, "dtg": now,
+                       "geom": Point(-73.9, 40.8)}, ts=now)
+        assert lds.stream.drain("trips")
+        r = lds.query("trips", "route = 'LIVE'")
+        assert r.count == 50
+        lds.close()
