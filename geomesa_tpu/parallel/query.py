@@ -649,3 +649,8 @@ def make_ring_knn_step(mesh: Mesh, k: int):
 @lru_cache(maxsize=None)
 def cached_ring_knn_step(mesh: Mesh, k: int):
     return make_ring_knn_step(mesh, k)
+
+
+@lru_cache(maxsize=None)
+def cached_batched_density_step(mesh: Mesh, width: int, height: int):
+    return make_batched_density_step(mesh, width=width, height=height)

@@ -923,6 +923,76 @@ class DataStore:
 
         return _gen()
 
+    def _batch_gate(self, st: _TypeState, want_bbox: bool):
+        """Shared gate for the batched device fan-outs (count_many /
+        density_many): coherent snapshot, device residency, and the
+        conditions under which loose batched execution is NOT equivalent
+        (hot-tier rows, TTL masking, no resident columns). Returns
+        (main_n, point_state, bbox_state, batchable)."""
+        main, _indices, backend_state, _stats, delta_table = st.snapshot()
+        main_n = 0 if main is None else len(main)
+        dev = bbox_dev = None
+        if isinstance(self.backend, TpuBackend) and self._device_available():
+            dev, _ = TpuBackend.point_state(backend_state)
+            if dev is None and want_bbox:
+                # extended-geometry store: loose tests are bbox overlaps
+                bbox_dev, _ = TpuBackend.bbox_state(backend_state)
+        batchable = not (
+            (dev is None and bbox_dev is None)
+            or delta_table is not None
+            or main_n == 0
+            # TTL masking is injected per-query in query(); loose batched
+            # passes would include expired rows — take the exact path
+            or self._age_off_ttl_ms(st.sft) is not None
+        )
+        return main_n, dev, bbox_dev, batchable
+
+    def _batch_payloads(self, st: _TypeState, qs, overlap: bool, viewport=None):
+        """Shared batchability loop: which queries are pure bbox+time
+        conjunctions on the default geom/date fields (anything else has
+        residual semantics the loose kernels can't honor) → their int-domain
+        payloads. ``viewport``: intersect every query's spatial bounds with
+        this (xmin, ymin, xmax, ymax) box — rows outside it must not match
+        (the density viewport). Returns [(query idx, payload | None)]."""
+        from dataclasses import replace as _replace
+
+        from geomesa_tpu.filter.bounds import extract as _extract
+
+        pending: list[tuple[int, tuple | None]] = []
+        for i, q in enumerate(qs):
+            f = q.resolved_filter()
+            if (
+                not _pure_bbox_time(f, st.sft)
+                or q.hints
+                or q.auths is not None
+                or q.limit is not None
+                or q.start_index is not None
+            ):
+                continue
+            e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
+            if viewport is not None and not e.disjoint:
+                vx1, vy1, vx2, vy2 = viewport
+                boxes = e.boxes if e.boxes is not None else [
+                    (-180.0, -90.0, 180.0, 90.0)
+                ]
+                clipped = []
+                for x1, y1, x2, y2 in boxes:
+                    nx1, ny1 = max(x1, vx1), max(y1, vy1)
+                    nx2, ny2 = min(x2, vx2), min(y2, vy2)
+                    if nx1 <= nx2 and ny1 <= ny2:
+                        clipped.append((nx1, ny1, nx2, ny2))
+                if not clipped:
+                    pending.append((i, None))
+                    continue
+                e = _replace(e, boxes=clipped)
+            payload = (
+                None
+                if e.disjoint
+                else self.backend._payload(st.sft, e, overlap=overlap)
+            )
+            pending.append((i, payload))
+        return pending
+
     def count_many(self, type_name: str, queries, loose: bool = True):
         """Batched counts for many queries in ONE device pass.
 
@@ -946,49 +1016,12 @@ class DataStore:
         def _exact(q):
             return self.query(type_name, q).count
 
-        # coherent snapshot vs a concurrent background compaction
-        main, _indices, backend_state, _stats, delta_table = st.snapshot()
-        main_n = 0 if main is None else len(main)
-        dev = bbox_dev = None
-        if isinstance(self.backend, TpuBackend) and self._device_available():
-            dev, _ = TpuBackend.point_state(backend_state)
-            if dev is None:
-                # extended-geometry store: loose counts are bbox overlaps
-                bbox_dev, _ = TpuBackend.bbox_state(backend_state)
-        if (
-            not loose
-            or (dev is None and bbox_dev is None)
-            or delta_table is not None
-            or main_n == 0
-            # TTL masking is injected per-query in query(); loose counts
-            # would include expired rows — take the exact path
-            or self._age_off_ttl_ms(st.sft) is not None
-        ):
+        main_n, dev, bbox_dev, batchable = self._batch_gate(st, want_bbox=True)
+        if not loose or not batchable:
             return [_exact(q) for q in qs]
-
-        from geomesa_tpu.filter.bounds import extract as _extract
-
-        # batchable = conjunctions of spatial/temporal primaries on the
-        # DEFAULT geometry/date fields only (anything else has residual
-        # semantics the loose kernel can't honor)
-        pending: list[tuple[int, tuple | None]] = []  # (query idx, payload)
-        for i, q in enumerate(qs):
-            f = q.resolved_filter()
-            if (
-                not _pure_bbox_time(f, st.sft)
-                or q.hints
-                or q.auths is not None
-                or q.limit is not None
-                or q.start_index is not None
-            ):
-                continue
-            e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
-            payload = (
-                None
-                if e.disjoint
-                else self.backend._payload(st.sft, e, overlap=bbox_dev is not None)
-            )
-            pending.append((i, payload))
+        pending = self._batch_payloads(
+            st, qs, overlap=bbox_dev is not None
+        )
 
         out: list = [None] * len(qs)
         live = [(i, p) for i, p in pending if p is not None]
@@ -1050,6 +1083,117 @@ class DataStore:
                 continue  # device failover: the exact path audits these
             self.metrics.counter("store.queries").inc()
             self._audit(type_name, qs[i], 0.0, 0.0, out[i])
+        for i, q in enumerate(qs):
+            if out[i] is None:
+                out[i] = _exact(q)
+        return out
+
+    def density_many(
+        self,
+        type_name: str,
+        queries,
+        bbox,
+        width: int = 256,
+        height: int = 256,
+        loose: bool = True,
+    ):
+        """Batched density grids for many queries in ONE device pass: the
+        ``DensityScan`` multi-query fan-out (SURVEY.md §2.20 P4 + P6).
+        Every query rasterizes into the SHARED ``bbox`` viewport at
+        ``width×height``; returns one (height, width) float64 grid per
+        query. Pure bbox+time queries ride the fused device step with grids
+        ``psum``-merged over the data axis (query bounds are intersected
+        with the viewport, so out-of-viewport rows never count); anything
+        else (residual filters, hints incl. ``weight_by``, auths, hot-tier
+        rows, extended geometries) falls back to the exact per-query density
+        hint path. Like :meth:`count_many`, the batched pass tests in the
+        31-bit int key domain (the loose-bbox semantics — boundary-epsilon
+        rows may differ from the exact float path); ``loose=False`` forces
+        the exact path for every query.
+        """
+        st = self._state(type_name)
+        qs = [
+            Query(filter=q) if isinstance(q, (str, ast.Filter)) or q is None else q
+            for q in queries
+        ]
+        if self._interceptors:
+            qs = [self._intercept(type_name, st.sft, q) for q in qs]
+        opts = {"bbox": tuple(bbox), "width": int(width), "height": int(height)}
+
+        def _exact(q):
+            from dataclasses import replace as _replace
+
+            # the shared viewport wins, caller density options (weight_by,
+            # ...) survive
+            caller = q.hints.get("density")
+            merged = {
+                **(caller if isinstance(caller, dict) else {}),
+                **opts,
+            }
+            return self.query(
+                type_name, _replace(q, hints={**q.hints, "density": merged})
+            ).density
+
+        main_n, dev, _bbox_dev, batchable = self._batch_gate(
+            st, want_bbox=False
+        )
+        if not loose or not batchable or dev is None:
+            return [_exact(q) for q in qs]
+        pending = self._batch_payloads(
+            st, qs, overlap=False, viewport=opts["bbox"]
+        )
+
+        out: list = [None] * len(qs)
+        empty_grid = np.zeros((height, width))
+        live = [(i, p) for i, p in pending if p is not None]
+        for i, p in pending:
+            if p is None:
+                out[i] = empty_grid.copy()
+        if live:
+            import jax.numpy as jnp
+
+            from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+            from geomesa_tpu.parallel.mesh import pad_query_axis
+            from geomesa_tpu.parallel.query import cached_batched_density_step
+            from geomesa_tpu.store.backends import REFINE_PRECISION
+
+            nlon = norm_lon(REFINE_PRECISION)
+            nlat = norm_lat(REFINE_PRECISION)
+            x1, y1, x2, y2 = opts["bbox"]
+            gb = np.array(
+                [int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+                 int(nlat.normalize(y1)), int(nlat.normalize(y2))],
+                dtype=np.int32,
+            )
+            boxes = np.stack([p[0] for _, p in live])
+            times = np.stack([p[1] for _, p in live])
+            gbs = np.broadcast_to(gb, (len(live), 4)).copy()
+            mesh = self.backend._get_mesh()
+            (boxes, times, gbs), _ = pad_query_axis(mesh, boxes, times, gbs)
+            c = dev.cols
+            try:
+                grids = np.asarray(
+                    cached_batched_density_step(mesh, width, height)(
+                        c["x"], c["y"], c["bins"], c["offs"],
+                        jnp.int32(main_n),
+                        jnp.asarray(boxes), jnp.asarray(times), jnp.asarray(gbs),
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — failover to exact path
+                if not self._is_device_error(e):
+                    raise
+                self._trip_device_circuit(e)
+                self.metrics.counter("store.query.device_failovers").inc()
+                grids = None
+            if grids is not None:
+                self._note_device_ok()
+                for k, (i, _) in enumerate(live):
+                    out[i] = grids[k].astype(np.float64)
+        for i, _ in pending:
+            if out[i] is None:
+                continue
+            self.metrics.counter("store.queries").inc()
+            self._audit(type_name, qs[i], 0.0, 0.0, int(out[i].sum()))
         for i, q in enumerate(qs):
             if out[i] is None:
                 out[i] = _exact(q)

@@ -149,3 +149,115 @@ class TestCountMany:
         ds2.compact("a")
         ds2.count_many("a", ["BBOX(geom, 0, 0, 2, 2)", "INCLUDE"])
         assert len(ds2.audit_writer.query_events("a")) == 2
+
+
+class TestDensityMany:
+    def _stores(self, n=4000, seed=13):
+        from geomesa_tpu.schema.sft import parse_spec
+
+        rng = np.random.default_rng(seed)
+        recs = [
+            {"name": f"n{i % 5}",
+             "dtg": T0 + int(rng.integers(0, 10 * 86_400_000)),
+             "geom": Point(float(rng.uniform(-60, 60)), float(rng.uniform(-40, 40)))}
+            for i in range(n)
+        ]
+        out = []
+        for backend in ("tpu", "oracle"):
+            ds = DataStore(backend=backend)
+            ds.create_schema(parse_spec("evt", "name:String,dtg:Date,*geom:Point"))
+            ds.write("evt", recs, fids=[str(i) for i in range(n)])
+            ds.compact("evt")
+            out.append(ds)
+        return out
+
+    def test_batched_matches_exact(self):
+        from geomesa_tpu.planning.planner import Query as Q
+
+        tpu, oracle = self._stores()
+        bbox = (-60.0, -40.0, 60.0, 40.0)
+        queries = [
+            "BBOX(geom, -30, -20, 30, 20)",
+            "BBOX(geom, 0, 0, 60, 40) AND dtg DURING "
+            "2017-07-02T00:00:00Z/2017-07-06T00:00:00Z",
+            "BBOX(geom, 100, 50, 120, 60)",  # disjoint from data
+        ]
+        grids = tpu.density_many("evt", queries, bbox, width=64, height=64)
+        assert len(grids) == 3
+        for q, g in zip(queries, grids):
+            exact = oracle.query(
+                "evt",
+                Q(filter=q, hints={"density": {"bbox": bbox, "width": 64,
+                                               "height": 64}}),
+            ).density
+            assert g.shape == (64, 64)
+            assert float(g.sum()) == float(exact.sum()), q
+
+    def test_residual_filters_fall_back_exact(self):
+        tpu, oracle = self._stores(1500)
+        bbox = (-60.0, -40.0, 60.0, 40.0)
+        q = "BBOX(geom, -30, -20, 30, 20) AND name = 'n2'"
+        (g,) = tpu.density_many("evt", [q], bbox, width=32, height=32)
+        from geomesa_tpu.planning.planner import Query as Q
+
+        exact = oracle.query(
+            "evt", Q(filter=q, hints={"density": {"bbox": bbox, "width": 32,
+                                                  "height": 32}})
+        ).density
+        assert float(g.sum()) == float(exact.sum())
+
+    def test_cell_placement_and_full_grid(self):
+        # known single-point placement: a transposed/flipped grid must fail
+        from geomesa_tpu.schema.sft import parse_spec
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("p", "dtg:Date,*geom:Point"))
+        # viewport (0,0)-(40,20), 8x4 grid: cells are 5x5 degrees
+        ds.write("p", [{"dtg": T0, "geom": Point(32.5, 3.0)}], fids=["a"])
+        ds.compact("p")
+        (g,) = ds.density_many("p", ["INCLUDE"], (0, 0, 40, 20),
+                               width=8, height=4)
+        assert g.shape == (4, 8)
+        assert float(g.sum()) == 1.0
+        iy, ix = np.nonzero(g)
+        assert (int(ix[0]), int(iy[0])) == (6, 0)  # x=32.5→col 6, y=3→row 0
+
+    def test_viewport_excludes_outside_rows(self):
+        # rows outside the shared viewport must NOT be clamped into edge
+        # cells by the batched path (review finding)
+        from geomesa_tpu.planning.planner import Query as Q
+        from geomesa_tpu.schema.sft import parse_spec
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("p", "dtg:Date,*geom:Point"))
+        # y off any cell edge: ON-edge rows are the documented loose-vs-
+        # exact boundary epsilon, not what this test checks
+        recs = [{"dtg": T0, "geom": Point(x, 0.4)}
+                for x in (-50.0, -5.0, 5.0, 50.0)]  # 2 inside, 2 outside
+        ds.write("p", recs, fids=list("abcd"))
+        ds.compact("p")
+        viewport = (-10.0, -10.0, 10.0, 10.0)
+        for q in ("INCLUDE", "BBOX(geom, -60, -10, 60, 10)"):
+            (g,) = ds.density_many("p", [q], viewport, width=16, height=16)
+            exact = ds.query(
+                "p", Q(filter=q, hints={"density": {"bbox": viewport,
+                                                    "width": 16, "height": 16}})
+            ).density
+            assert float(g.sum()) == 2.0, q
+            assert np.array_equal(g, exact), q
+
+    def test_weight_by_hint_survives_fallback(self):
+        from geomesa_tpu.planning.planner import Query as Q
+        from geomesa_tpu.schema.sft import parse_spec
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("p", "w:Double,dtg:Date,*geom:Point"))
+        ds.write("p", [{"w": 3.0, "dtg": T0, "geom": Point(1.0, 1.0)}],
+                 fids=["a"])
+        ds.compact("p")
+        (g,) = ds.density_many(
+            "p",
+            [Q(filter="INCLUDE", hints={"density": {"weight_by": "w"}})],
+            (-10, -10, 10, 10), width=8, height=8,
+        )
+        assert float(g.sum()) == 3.0  # weighted, not dropped
