@@ -1118,7 +1118,8 @@ class DataStore:
         ]
         if self._interceptors:
             qs = [self._intercept(type_name, st.sft, q) for q in qs]
-        opts = {"bbox": tuple(bbox), "width": int(width), "height": int(height)}
+        width, height = int(width), int(height)  # one coercion for ALL uses
+        opts = {"bbox": tuple(bbox), "width": width, "height": height}
 
         def _exact(q):
             from dataclasses import replace as _replace

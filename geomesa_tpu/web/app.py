@@ -15,6 +15,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     PUT    /api/schemas/{name}/features          replace-by-id (WFS-T Update)
     DELETE /api/schemas/{name}/features?fids=a,b (WFS-T Delete)
     GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|csv|leaflet
+    POST   /api/schemas/{name}/count-many        batched loose counts
+    POST   /api/schemas/{name}/density-many      batched shared-viewport heatmaps
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
     GET    /api/schemas/{name}/stats/bounds?attr=
@@ -91,6 +93,7 @@ class GeoMesaApp:
             ("DELETE", r"^/api/schemas/([^/]+)/features$", self._delete_features),
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
             ("POST", r"^/api/schemas/([^/]+)/count-many$", self._count_many),
+            ("POST", r"^/api/schemas/([^/]+)/density-many$", self._density_many),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
             ("GET", r"^/api/schemas/([^/]+)/stats/count$", self._stats_count),
             ("GET", r"^/api/schemas/([^/]+)/stats/bounds$", self._stats_bounds),
@@ -437,6 +440,39 @@ class GeoMesaApp:
             name, queries, loose=bool(body.get("loose", True))
         )
         return 200, {"counts": counts}, "application/json"
+
+    def _density_many(self, name, params, body):
+        """POST {"queries": [cql, ...], "bbox": [x1,y1,x2,y2], "width", "height",
+        "loose"} → one shared-viewport heatmap per query in one device pass
+        (DataStore.density_many)."""
+        if not body or "queries" not in body or "bbox" not in body:
+            raise _HttpError(400, 'body must be {"queries": [...], "bbox": [...]}')
+        if not hasattr(self.store, "density_many"):
+            raise _HttpError(400, "store does not support batched density")
+        bbox = body["bbox"]
+        if not (isinstance(bbox, list) and len(bbox) == 4):
+            raise _HttpError(400, "bbox must be [xmin, ymin, xmax, ymax]")
+        width = int(body.get("width", 256))
+        height = int(body.get("height", 256))
+        # clamp client-controlled grid dims: a huge grid is a huge
+        # allocation AND a forever-cached compiled kernel per distinct shape
+        if not (1 <= width <= 4096 and 1 <= height <= 4096):
+            raise _HttpError(400, "width/height must be in [1, 4096]")
+        auths = self._restricted_auths(name, params)
+        queries = body["queries"]
+        if auths is not None:
+            # visibility-filtered grids can't use the loose batched path
+            queries = [Query(filter=c, auths=auths) for c in queries]
+        grids = self.store.density_many(
+            name, queries, tuple(float(v) for v in bbox),
+            width=width, height=height,
+            loose=bool(body.get("loose", True)),
+        )
+        return 200, {
+            "width": width,
+            "height": height,
+            "grids": [g.tolist() for g in grids],
+        }, "application/json"
 
     def _stats(self, name, params, body):
         spec = params.get("stats")

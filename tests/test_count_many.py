@@ -261,3 +261,48 @@ class TestDensityMany:
             (-10, -10, 10, 10), width=8, height=8,
         )
         assert float(g.sum()) == 3.0  # weighted, not dropped
+
+    def test_rest_density_many(self):
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("p", "dtg:Date,*geom:Point"))
+        ds.write("p", [{"dtg": T0, "geom": Point(2.0, 2.0)},
+                       {"dtg": T0, "geom": Point(-3.0, -3.0)}], fids=["a", "b"])
+        ds.compact("p")
+        app = GeoMesaApp(ds)
+        status, out, _ = app._density_many(
+            "p", {},
+            {"queries": ["INCLUDE", "BBOX(geom, 0, 0, 10, 10)"],
+             "bbox": [-10, -10, 10, 10], "width": 8, "height": 8},
+        )
+        assert status == 200
+        g0, g1 = np.array(out["grids"][0]), np.array(out["grids"][1])
+        assert g0.shape == (8, 8)
+        assert float(g0.sum()) == 2.0 and float(g1.sum()) == 1.0
+        import pytest as _pytest
+
+        with _pytest.raises(_HttpError):
+            app._density_many("p", {}, {"queries": ["INCLUDE"]})  # no bbox
+        with _pytest.raises(_HttpError):
+            app._density_many("p", {}, {"queries": ["INCLUDE"],
+                                        "bbox": [1, 2, 3]})
+
+    def test_rest_density_many_dims_clamped(self):
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.web.app import GeoMesaApp, _HttpError
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(parse_spec("p", "dtg:Date,*geom:Point"))
+        app = GeoMesaApp(ds)
+        import pytest as _pytest
+
+        with _pytest.raises(_HttpError, match="4096"):
+            app._density_many(
+                "p", {}, {"queries": ["INCLUDE"], "bbox": [0, 0, 1, 1],
+                          "width": 20000, "height": 64},
+            )
+        # float width coerces instead of crashing
+        assert ds.density_many("p", ["INCLUDE"], (0, 0, 1, 1),
+                               width=8.0, height=4)[0].shape == (4, 8)
