@@ -24,7 +24,7 @@ from geomesa_tpu.geometry.types import (
     Polygon,
 )
 
-__all__ = ["to_wkb", "from_wkb"]
+__all__ = ["to_wkb", "from_wkb", "to_wkb_batch", "from_wkb_batch"]
 
 _POINT, _LINESTRING, _POLYGON = 1, 2, 3
 _MULTIPOINT, _MULTILINESTRING, _MULTIPOLYGON = 4, 5, 6
@@ -137,3 +137,55 @@ def from_wkb(data: bytes) -> Geometry:
     geometry model is 2D lon/lat.
     """
     return _read_geom(_Reader(bytes(data)))
+
+
+# -- batch codec --------------------------------------------------------------
+#
+# Column-level encode/decode with the same (buf, offsets) contract as
+# twkb.to_twkb_batch, used by the lossless Arrow geometry mapping
+# (io/arrow.py). WKB coordinates are raw little-endian f8 so the round trip
+# is bit-exact — unlike TWKB's fixed-point quantization — matching the
+# reference's full-precision double storage
+# (geomesa-fs-storage/.../parquet/io/SimpleFeatureWriteSupport.scala role).
+# The per-geometry coordinate payload is written with one bulk ``tobytes()``
+# per part, so the Python loop is per-part, not per-vertex.
+
+_EMPTY_POINT = struct.pack("<BIdd", 1, _POINT, float("nan"), float("nan"))
+
+
+def to_wkb_batch(geoms) -> tuple[np.ndarray, np.ndarray]:
+    """Encode a column of geometries → (buf uint8 array, offsets (n+1,)
+    int64). ``None`` slots encode as a NaN-coordinate point (the column stays
+    non-null; :func:`from_wkb_batch` restores ``None``)."""
+    geoms = list(geoms)
+    n = len(geoms)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    chunks: list[bytes] = []
+    total = 0
+    for i, g in enumerate(geoms):
+        b = _EMPTY_POINT if g is None else to_wkb(g)
+        chunks.append(b)
+        total += len(b)
+        offsets[i + 1] = total
+    buf = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    return buf, offsets
+
+
+def from_wkb_batch(blobs) -> np.ndarray:
+    """Decode a column of WKB blobs → object array of geometries.
+
+    All-NaN points — the conventional ``POINT EMPTY`` WKB encoding, and what
+    :func:`to_wkb_batch` writes for ``None`` slots — decode to ``None``. A
+    point with ONE NaN ordinate is kept as-is (it is malformed data, not an
+    empty sentinel)."""
+    blobs = list(blobs)
+    out = np.empty(len(blobs), dtype=object)
+    for i, b in enumerate(blobs):
+        if b is None:
+            out[i] = None
+            continue
+        g = from_wkb(b)
+        if isinstance(g, Point) and np.isnan(g.x) and np.isnan(g.y):
+            g = None
+        out[i] = g
+    return out

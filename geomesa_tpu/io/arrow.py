@@ -5,8 +5,9 @@ SimpleFeatures into Arrow vectors (``SimpleFeatureVector``, points as
 fixed-size lists, dictionary-encoded strings) and streams record batches as
 IPC. Here the columnar table *is already* Arrow layout, so conversion is a
 re-labeling: points become ``fixed_size_list<f64, 2>``, dates become
-``timestamp[ms]``, strings are dictionary-encoded, extended geometries ship as
-WKT (dictionary-encodable) plus a bbox struct for client-side filtering.
+``timestamp[ms]``, strings are dictionary-encoded, extended geometries ship
+as binary — lossless WKB by default, or compact fixed-point TWKB on request
+(see :func:`to_arrow`); the codec is recorded in field metadata.
 """
 
 from __future__ import annotations
@@ -16,6 +17,7 @@ import pyarrow as pa
 
 from geomesa_tpu.geometry.twkb import from_twkb_batch, to_twkb, to_twkb_batch
 from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.geometry.wkb import from_wkb_batch, to_wkb_batch
 from geomesa_tpu.geometry.wkt import from_wkt, to_wkt
 from geomesa_tpu.schema.columnar import Column, FeatureTable, GeometryColumn, point_column
 from geomesa_tpu.schema.sft import AttributeType, FeatureType
@@ -32,8 +34,31 @@ _SCALAR_ARROW = {
 }
 
 
-def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
-    """FeatureTable → pyarrow Table (zero-copy where dtypes allow)."""
+def to_arrow(
+    table: FeatureTable,
+    dictionary_encode: bool = True,
+    geometry_encoding: str = "wkb",
+    twkb_precision: int = 7,
+) -> pa.Table:
+    """FeatureTable → pyarrow Table (zero-copy where dtypes allow).
+
+    Extended geometries encode per ``geometry_encoding``:
+
+    - ``"wkb"`` (default): lossless — coordinates round-trip bit-exact, like
+      the reference's full-precision double storage. The canonical mapping
+      for persistence, IPC transport, and federation.
+    - ``"twkb"``: compact fixed-point at ``twkb_precision`` decimal digits
+      (default 7 ≈ 1 cm at the equator — the reference codec's own default).
+      This QUANTIZES coordinates; use it for wire/export compactness where
+      ~1e-7 deg perturbation is acceptable, not for storage that must
+      round-trip exactly.
+
+    The choice is recorded in field metadata (``geom`` = ``wkb``/``twkb``)
+    so :func:`from_arrow` dispatches per column; catalogs written by either
+    encoding (or legacy WKT) stay readable.
+    """
+    if geometry_encoding not in ("wkb", "twkb"):
+        raise ValueError(f"unknown geometry_encoding {geometry_encoding!r}")
     fields = []
     arrays = []
     fields.append(pa.field("__fid__", pa.string()))
@@ -55,16 +80,17 @@ def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
             arrays.append(arr)
         elif a.type.is_geometry:
             gc = col  # type: ignore[assignment]
-            # TWKB binary (~4x smaller than WKT; native batch decode on
-            # read; reference-default precision 7 ≈ 1 cm quantization —
-            # lossless for real geodata). None/invalid slots encode as
-            # TWKB-empty, keeping the column non-null so the native batch
-            # decoder takes one pass
-            packed = to_twkb_batch(gc.geometries())
+            # None/invalid slots encode as an empty sentinel, keeping the
+            # column non-null so the batch decoders take one pass
+            if geometry_encoding == "twkb":
+                # None when the native lib is unavailable (per-blob below)
+                packed = to_twkb_batch(gc.geometries(), precision=twkb_precision)
+            else:
+                packed = to_wkb_batch(gc.geometries())
             # pa.binary() carries int32 offsets; from_buffers does NOT
-            # validate, so a >2GiB column must take the checked path
+            # validate, so a >2GiB column takes large_binary (int64 offsets)
             if packed is not None and int(packed[1][-1]) < 2**31:
-                # native batch encode → BinaryArray built straight from the
+                # batch encode → BinaryArray built straight from the
                 # (values, offsets) buffers, no per-blob python objects
                 data, offs = packed
                 arr = pa.Array.from_buffers(
@@ -72,15 +98,27 @@ def to_arrow(table: FeatureTable, dictionary_encode: bool = True) -> pa.Table:
                     [None, pa.py_buffer(offs.astype(np.int32)),
                      pa.py_buffer(data)],
                 )
-            else:
+            elif packed is not None:
+                data, offs = packed
+                arr = pa.Array.from_buffers(
+                    pa.large_binary(), len(table),
+                    [None, pa.py_buffer(offs.astype(np.int64)),
+                     pa.py_buffer(data)],
+                )
+            else:  # per-blob fallback encodes the SAME codec as the tag
                 arr = pa.array(
-                    [to_twkb(g) for g in gc.geometries()], type=pa.binary()
+                    [to_twkb(g, precision=twkb_precision)
+                     for g in gc.geometries()],
+                    type=pa.large_binary(),
                 )
             if dictionary_encode:
                 # repeated footprints dedup to dictionary codes (the
                 # ArrowDictionary role applies to geometries too)
                 arr = arr.dictionary_encode()
-            fields.append(pa.field(a.name, arr.type, metadata={b"geom": b"twkb"}))
+            fields.append(pa.field(
+                a.name, arr.type,
+                metadata={b"geom": geometry_encoding.encode()},
+            ))
             arrays.append(arr)
         elif a.type == AttributeType.DATE:
             arr = pa.array(col.values, type=pa.timestamp("ms"), mask=mask)
@@ -132,7 +170,14 @@ def from_arrow(sft: FeatureType, atable: pa.Table) -> FeatureTable:
                 else ac.type
             )
             if pa.types.is_binary(base_type) or pa.types.is_large_binary(base_type):
-                geoms = from_twkb_batch(vals)  # native batch decode
+                # field metadata records the codec; catalogs written before
+                # the metadata tag existed are TWKB (the old default)
+                meta = atable.schema.field(a.name).metadata or {}
+                codec = (meta.get(b"geom") or b"twkb").decode()
+                if codec == "wkb":
+                    geoms = from_wkb_batch(vals)
+                else:
+                    geoms = from_twkb_batch(vals)  # native batch decode
             else:  # legacy catalogs: WKT strings
                 geoms = np.empty(n, dtype=object)
                 for i, w in enumerate(vals):

@@ -292,6 +292,10 @@ def twkb_decode_batch(buf: bytes, offsets: np.ndarray):
     if rc != 0:
         return None
     pts, parts, polys = (int(v) for v in total)
+    # a well-formed blob stream cannot claim more coordinates than bytes;
+    # negative/overflowed totals mean malformed counts slipped past the scan
+    if min(pts, parts, polys) < 0 or max(pts, parts, polys) > len(raw):
+        return None
     types = np.empty(n, dtype=np.int8)
     gpc = np.empty(n, dtype=np.int32)
     npolys = np.empty(n, dtype=np.int32)

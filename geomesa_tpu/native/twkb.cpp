@@ -24,9 +24,11 @@ struct Reader {
 
   // each coordinate is two varints of >= 1 byte each: a claimed count
   // bigger than remaining_bytes/2 is malformed (also bounds the totals
-  // against overflow, since counts are capped by the buffer size)
+  // against overflow, since counts are capped by the buffer size).
+  // Division form: `2 * k` would wrap for k >= 2^63, letting a crafted
+  // count pass the check and over-run the arrays sized by twkb_scan.
   bool count_ok(uint64_t k) {
-    if (2 * k > (uint64_t)(end - p)) { fail = true; return false; }
+    if (k > (uint64_t)(end - p) / 2) { fail = true; return false; }
     return true;
   }
 

@@ -520,7 +520,12 @@ class DataStore:
         ``IndexAdapter.scala:139`` validates-then-writes), the replacement
         is not atomic for concurrent readers — a query racing the update may
         briefly miss the row; it never sees both versions after return.
-        ``visible_to``: see :meth:`delete_features`."""
+        ``visible_to``: see :meth:`delete_features`.
+
+        Every target fid must already exist — a missing id raises
+        ``KeyError`` (no silent upsert; WFS-T Update's replace contract),
+        checked under the mutation lock for both restricted and
+        unrestricted callers."""
         fids = [str(f) for f in fids]
         if len(set(fids)) != len(fids):
             raise ValueError("update_features: duplicate fids")
@@ -544,6 +549,26 @@ class DataStore:
                 else data
             )
             self._validate(st.sft, table)
+            # every target must exist (no silent upsert — WFS-T Update is
+            # replace). Fid sets are read per-tier (no delta concat; the
+            # delete below builds the merged view once). Restricted callers
+            # get PermissionError for missing ids — the same error hidden
+            # rows raise — so a 403/404 split cannot become an existence
+            # oracle for rows their auths cannot see.
+            existing: set[str] = set()
+            with st.lock:
+                tiers = [st.table, *st.delta.tables]
+            for t in tiers:
+                if t is not None and len(t):
+                    existing.update(str(f) for f in t.fids)
+            missing = [f for f in fids if f not in existing]
+            if missing:
+                if visible_to is not None:
+                    raise PermissionError("target features not visible")
+                raise KeyError(
+                    f"update_features: no such feature id(s) {missing[:5]}"
+                    + ("..." if len(missing) > 5 else "")
+                )
             self.delete_features(type_name, fids, visible_to=visible_to)
             return self.write(type_name, table)
 

@@ -132,8 +132,22 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
                 }
             else:
                 parts = {"all": np.arange(count)}
+            # lossless WKB by default (reference stores full-precision
+            # doubles); schemas may opt into compact fixed-point TWKB via
+            # user-data — the codec tag in each file's field metadata keeps
+            # catalogs readable either way
+            geom_enc = str(
+                (st.sft.user_data or {}).get("geomesa.fs.geometry-encoding", "wkb")
+            )
+            twkb_prec = int(
+                (st.sft.user_data or {}).get("geomesa.twkb.precision", 7)
+            )
             for key, rows in parts.items():
-                at = to_arrow(st.table.take(rows))
+                at = to_arrow(
+                    st.table.take(rows),
+                    geometry_encoding=geom_enc,
+                    twkb_precision=twkb_prec,
+                )
                 # short digest disambiguates keys the sanitizer would collide
                 # (e.g. 'v 1' and 'v-1' both sanitize to 'v-1')
                 import hashlib

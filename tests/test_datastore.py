@@ -353,12 +353,28 @@ class TestUpdateFeatures:
             hit = ds.query("t", "BBOX(geom, 98, 8, 100, 10)")
             assert hit.table.fids.tolist() == ["f3"]
 
-    def test_update_new_fid_appends(self):
+    def test_update_missing_fid_rejected(self):
+        """No silent upsert (ADVICE r2): updating a nonexistent fid raises
+        and mutates nothing, for restricted and unrestricted callers alike."""
+        import pytest
+
         ds = self._store()
-        ds.update_features(
-            "t", [{"name": "new", "dtg": 1, "geom": Point(0.5, 0.5)}], ["brand"]
-        )
-        assert ds.query("t").count == 21
+        with pytest.raises(KeyError, match="brand"):
+            ds.update_features(
+                "t", [{"name": "new", "dtg": 1, "geom": Point(0.5, 0.5)}],
+                ["brand"],
+            )
+        assert ds.query("t").count == 20
+        # mixed existing+missing must also fail whole, touching nothing
+        before = ds.query("t", "IN ('f3')").records()
+        with pytest.raises(KeyError):
+            ds.update_features(
+                "t",
+                [{"name": "a", "dtg": 1, "geom": Point(0, 0)},
+                 {"name": "b", "dtg": 2, "geom": Point(1, 1)}],
+                ["f3", "nope"],
+            )
+        assert ds.query("t", "IN ('f3')").records() == before
 
     def test_length_mismatch(self):
         import pytest

@@ -79,6 +79,45 @@ class TestBatchDecode:
         offs = np.array([0, len(bad)], dtype=np.int64)
         assert native.twkb_decode_batch(bad, offs) is None
 
+    def test_huge_varint_counts_rejected(self):
+        """Crafted counts near 2^63/2^64 must fail the bounds check, not wrap
+        it: `2 * k` overflowed for k >= 2^63 and the scan then returned
+        garbage totals that under-sized the decode arrays (heap overrun)."""
+        from geomesa_tpu import native
+
+        if native._twkb_lib() is None:
+            pytest.skip("no native toolchain")
+
+        def varint(v):
+            out = bytearray()
+            while True:
+                b = v & 0x7F
+                v >>= 7
+                if v:
+                    out.append(b | 0x80)
+                else:
+                    out.append(b)
+                    return bytes(out)
+
+        for count in (2**63, 2**63 + 1, 2**64 - 1, 2**62, 2**32):
+            for head in (2, 4, 5):  # linestring, multipoint, multiline
+                blob = bytes([head, 0]) + varint(count) + b"\x02" * 2048
+                offs = np.array([0, len(blob)], dtype=np.int64)
+                assert native.twkb_decode_batch(blob, offs) is None, (
+                    head, count
+                )
+            # polygon: ring count huge
+            blob = bytes([3, 0]) + varint(count) + b"\x02" * 2048
+            offs = np.array([0, len(blob)], dtype=np.int64)
+            assert native.twkb_decode_batch(blob, offs) is None
+        # and via the public path (reachable from untrusted ingest): a Python
+        # exception is acceptable, a segfault is not
+        blob = bytes([2, 0]) + varint(2**63) + b"\x02" * 2048
+        try:
+            from_twkb_batch([blob])
+        except (ValueError, MemoryError, OverflowError):
+            pass
+
 
 class TestBatchEncode:
     def test_byte_identical_to_python(self):
@@ -121,7 +160,7 @@ class TestArrowTwkb:
         gs = geoms()
         recs = [{"name": f"g{i}", "geom": g} for i, g in enumerate(gs)]
         t = FeatureTable.from_records(sft, recs, [str(i) for i in range(len(gs))])
-        at = to_arrow(t)
+        at = to_arrow(t, geometry_encoding="twkb")
         f = at.schema.field("geom")
         assert f.metadata[b"geom"] == b"twkb"
         base = f.type.value_type if pa.types.is_dictionary(f.type) else f.type
@@ -133,6 +172,35 @@ class TestArrowTwkb:
                 assert g2 is None
             else:
                 assert to_wkt(g2) == to_wkt(from_twkb(to_twkb(g)))
+
+    def test_default_wkb_roundtrip_bit_exact(self):
+        """The canonical mapping is lossless: coordinates that are NOT
+        representable at any fixed-point precision must round-trip exactly
+        (the TWKB default silently quantized them — ADVICE r2)."""
+        sft = parse_spec("t", "name:String,*geom:Geometry")
+        gs = geoms()
+        recs = [{"name": f"g{i}", "geom": g} for i, g in enumerate(gs)]
+        t = FeatureTable.from_records(sft, recs, [str(i) for i in range(len(gs))])
+        at = to_arrow(t)
+        assert at.schema.field("geom").metadata[b"geom"] == b"wkb"
+        t2 = from_arrow(sft, at)
+        for i, g in enumerate(gs):
+            g2 = t2.record(i)["geom"]
+            if g is None:
+                assert g2 is None
+            else:
+                assert to_wkt(g2) == to_wkt(g)  # full f64 repr, no quantize
+        # adversarial coordinates: irrational-ish doubles survive bit-exact
+        from geomesa_tpu.geometry.types import Point as Pt
+
+        sft2 = parse_spec("p", "*geom:Geometry")
+        pts = [Pt(np.pi * 10**k, -np.e * 10**-k) for k in range(-3, 4)]
+        t3 = FeatureTable.from_records(
+            sft2, [{"geom": p} for p in pts], [str(i) for i in range(len(pts))]
+        )
+        t4 = from_arrow(sft2, to_arrow(t3))
+        for p, r in zip(pts, (t4.record(i)["geom"] for i in range(len(pts)))):
+            assert (r.x, r.y) == (p.x, p.y)
 
     def test_legacy_wkt_catalogs_still_read(self):
         # catalogs written before the TWKB switch hold WKT strings
@@ -157,7 +225,7 @@ class TestArrowTwkb:
             for _ in range(200)
         ]
         t = FeatureTable.from_records(sft, recs, [str(i) for i in range(200)])
-        at = to_arrow(t)
+        at = to_arrow(t, geometry_encoding="twkb")
         twkb_bytes = at.column("geom").nbytes
         wkt_bytes = sum(
             len(to_wkt(r["geom"])) for r in (t.record(i) for i in range(200))

@@ -254,6 +254,20 @@ class TestFeatureModification:
         assert r.table.fids.tolist() == ["f2"]
         assert ds.query("t").count == 5
 
+    def test_put_missing_fid_404(self):
+        # no silent upsert: the store raises KeyError, dispatch maps to 404
+        import pytest
+
+        app, ds = self._app()
+        body = {"type": "FeatureCollection", "features": [
+            {"type": "Feature", "id": "ghost",
+             "geometry": {"type": "Point", "coordinates": [1.0, 1.0]},
+             "properties": {"name": "x"}},
+        ]}
+        with pytest.raises(KeyError):
+            app._update_features("t", {}, body)
+        assert ds.query("t").count == 5
+
     def test_put_requires_ids(self):
         from geomesa_tpu.web.app import _HttpError
 
