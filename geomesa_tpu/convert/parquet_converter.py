@@ -43,7 +43,7 @@ def _attr_type(f: pa.Field) -> AttributeType | None:
         t.value_type
     ):
         return AttributeType.POINT
-    if f.metadata and f.metadata.get(b"geom") in (b"wkt", b"twkb"):
+    if f.metadata and f.metadata.get(b"geom") in (b"wkt", b"twkb", b"wkb"):
         return AttributeType.GEOMETRY
     if pa.types.is_timestamp(t) or pa.types.is_date(t):
         return AttributeType.DATE

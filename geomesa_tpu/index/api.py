@@ -77,8 +77,16 @@ class FeatureIndex:
         raise NotImplementedError
 
     # -- build ---------------------------------------------------------------
-    def build(self, table: FeatureTable) -> np.ndarray:
-        """Compute and retain sort state; returns the permutation."""
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
+        """Compute and retain sort state; returns the permutation.
+
+        ``sorter``: optional device sort — ``sorter(route_key_u64,
+        tiebreak_i32_or_None) -> perm`` (the mesh sample-sort from
+        :func:`geomesa_tpu.store.device_ingest.device_sort_perm`). Indexes
+        whose keys map onto it use it in place of the host sort; others
+        ignore it. Implementations must fall back to the host sort when the
+        composite key cannot be expressed (e.g. out-of-range time bins).
+        """
         raise NotImplementedError
 
     # -- plan ----------------------------------------------------------------

@@ -65,7 +65,8 @@ class AttributeIndex(FeatureIndex):
             if a.indexed and not a.type.is_geometry
         ]
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
+        # attribute keys (strings etc.) don't map onto the u64 device sort
         col = table.columns[self.attribute]
         valid = col.is_valid()
         vals = col.values

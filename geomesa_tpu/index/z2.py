@@ -39,12 +39,17 @@ class Z2Index(FeatureIndex):
     def can_serve(self, e: Extraction) -> bool:
         return True
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
         col = table.geom_column()
         z = self.sfc.index(col.x, col.y)
-        from geomesa_tpu import native
+        # 62-bit z2 fits the device key exactly and cannot reach the
+        # reshard sentinel (all-ones u64)
+        if sorter is not None and len(z) and int(z.max()) != 2**64 - 1:
+            perm = sorter(z, None)
+        else:
+            from geomesa_tpu import native
 
-        perm = native.sort_u64(z)
+            perm = native.sort_u64(z)
         self.perm = perm
         self.zs = z[perm]
         self.n = len(table)
@@ -75,12 +80,15 @@ class XZ2Index(FeatureIndex):
     def can_serve(self, e: Extraction) -> bool:
         return True
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
         b = table.geom_column().bounds
         codes = self.sfc.index((b[:, 0], b[:, 1]), (b[:, 2], b[:, 3]))
-        from geomesa_tpu import native
+        if sorter is not None and len(codes) and int(codes.max()) != 2**64 - 1:
+            perm = sorter(codes, None)
+        else:
+            from geomesa_tpu import native
 
-        perm = native.sort_u64(codes)
+            perm = native.sort_u64(codes)
         self.perm = perm
         self.codes = codes[perm]
         self.n = len(table)
@@ -113,7 +121,7 @@ class IdIndex(FeatureIndex):
     def can_serve(self, e: Extraction) -> bool:
         return True
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
         perm = np.argsort(table.fids, kind="stable")
         self.perm = perm
         self.fids = table.fids[perm]

@@ -32,6 +32,31 @@ from geomesa_tpu.schema.sft import FeatureType
 WORLD = (-180.0, -90.0, 180.0, 90.0)
 
 
+def _lexsort_bin_key(bins: np.ndarray, key: np.ndarray, sorter) -> np.ndarray:
+    """Sort rows by (time-bin, curve key), on device when a sorter is given.
+
+    The 79-bit composite (16-bit bin, 63-bit key) rides the 64-bit device
+    sample sort as ``route = bin<<48 | key>>15`` with the dropped low 15
+    bits as the tiebreak column — exact because ``route`` is a monotone
+    prefix of the wide key. Bins outside u16 (or a sorter failure the
+    caller didn't catch) fall back to the host lexsort.
+    """
+    if sorter is not None and len(bins) and 0 <= int(bins.min()) and int(
+        bins.max()
+    ) < (1 << 16):
+        route = (bins.astype(np.uint64) << np.uint64(48)) | (
+            key.astype(np.uint64) >> np.uint64(15)
+        )
+        # all-ones route == the reshard padding sentinel; that row would be
+        # silently dropped from the permutation — host sort handles it
+        if int(route.max()) != 2**64 - 1:
+            tie = (key.astype(np.uint64) & np.uint64(0x7FFF)).astype(np.int32)
+            return sorter(route, tie)
+    from geomesa_tpu import native
+
+    return native.lexsort_bin_z(bins, key)
+
+
 def time_windows(
     binned: BinnedTime, bin_values: np.ndarray, intervals
 ) -> list[tuple[int, int, int]]:
@@ -85,14 +110,12 @@ class Z3Index(FeatureIndex):
     def can_serve(self, e: Extraction) -> bool:
         return True  # full-domain scan degrades gracefully
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
         col = table.geom_column()
         t_ms = table.dtg_millis()
         bins, offs = self.binned.to_bin_and_offset(t_ms)
         z = self.sfc.index(col.x, col.y, offs)
-        from geomesa_tpu import native
-
-        perm = native.lexsort_bin_z(bins, z)
+        perm = _lexsort_bin_key(bins, z, sorter)
         self.perm = perm
         self.bins = bins[perm]
         self.offsets = offs[perm]
@@ -197,7 +220,7 @@ class XZ3Index(FeatureIndex):
     def can_serve(self, e: Extraction) -> bool:
         return True
 
-    def build(self, table: FeatureTable) -> np.ndarray:
+    def build(self, table: FeatureTable, sorter=None) -> np.ndarray:
         col = table.geom_column()
         b = col.bounds  # (n, 4)
         t_ms = table.dtg_millis()
@@ -206,9 +229,7 @@ class XZ3Index(FeatureIndex):
         codes = self.sfc.index(
             (b[:, 0], b[:, 1], o), (b[:, 2], b[:, 3], o)
         )
-        from geomesa_tpu import native
-
-        perm = native.lexsort_bin_z(bins, codes)
+        perm = _lexsort_bin_key(bins, codes, sorter)
         self.perm = perm
         self.bins = bins[perm]
         self.codes = codes[perm]

@@ -35,13 +35,19 @@ _SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 @lru_cache(maxsize=None)
-def make_reshard_step(mesh: Mesh, n_columns: int, capacity: int):
+def make_reshard_step(mesh: Mesh, n_columns: int, capacity: int,
+                      lex_cols: int = 0):
     """Build the jitted reshard step for ``n_columns`` int32 payload columns.
 
     fn(key_u64, true_n, splits, *cols) →
         (key_out, cols_out, count_per_shard, overflow) where outputs are
         device-sharded (S × S·capacity rows), each shard's first ``count``
         rows key-sorted and owned by that shard's split range.
+
+    ``lex_cols``: the first that-many payload columns act as SECONDARY sort
+    keys after the routing key (applied right-to-left with stable sorts), so
+    a composite key wider than 64 bits — e.g. z3's (bin, 63-bit z) — routes
+    by a coarse uint64 prefix yet lands exactly lexsorted.
     """
     shards = data_shards(mesh)
 
@@ -91,10 +97,17 @@ def make_reshard_step(mesh: Mesh, n_columns: int, capacity: int):
         key_r = route(key, _SENTINEL)
         got = key_r != _SENTINEL
         count = jnp.sum(got, dtype=jnp.int32)
-        # local order: valid rows key-ascending, sentinels last
-        perm = jnp.argsort(jnp.where(got, key_r, _SENTINEL), stable=True)
+        cols_r = tuple(route(c, jnp.zeros((), c.dtype)) for c in cols)
+        # local order: valid rows lexsorted by (key, lex payload cols),
+        # sentinels last. Stable sorts right-to-left = lexsort semantics.
+        perm = jnp.arange(key_r.shape[0], dtype=jnp.int32)
+        for j in range(lex_cols - 1, -1, -1):
+            perm = perm[jnp.argsort(cols_r[j][perm], stable=True)]
+        perm = perm[
+            jnp.argsort(jnp.where(got, key_r, _SENTINEL)[perm], stable=True)
+        ]
         key_out = key_r[perm]
-        cols_out = tuple(route(c, jnp.zeros((), c.dtype))[perm] for c in cols)
+        cols_out = tuple(c[perm] for c in cols_r)
         return (
             key_out,
             *cols_out,
@@ -112,18 +125,21 @@ def reshard(
     splits: np.ndarray,
     cols: dict,
     capacity: int | None = None,
+    lex_cols: int = 0,
 ):
     """Convenience wrapper: reshard device arrays by ``splits``.
 
     Returns (key_out, cols_out dict, counts (S,), overflow int). ``capacity``
     (rows per source→destination lane) auto-sizes to 2× the balanced
     per-lane load (+margin); callers retry with a larger one on overflow.
+    ``lex_cols``: the first that-many of ``cols`` (insertion order) are
+    secondary local-sort keys — see :func:`make_reshard_step`.
     """
     shards = data_shards(mesh)
     nloc = key_sharded.shape[0] // shards
     if capacity is None:
         capacity = max(8, (2 * nloc) // shards + 8)
-    step = make_reshard_step(mesh, len(cols), capacity)
+    step = make_reshard_step(mesh, len(cols), capacity, lex_cols)
     rep = NamedSharding(mesh, P())
     names = list(cols)
     out = step(

@@ -21,7 +21,7 @@ from geomesa_tpu.parallel.mesh import Mesh, data_shards, shard_columns
 from geomesa_tpu.parallel.reshard import reshard
 from geomesa_tpu.store.splitter import balanced_splits
 
-__all__ = ["sampled_splits", "device_bulk_build"]
+__all__ = ["sampled_splits", "device_bulk_build", "device_sort_perm"]
 
 
 def sampled_splits(
@@ -50,6 +50,17 @@ def device_bulk_build(mesh: Mesh, keys: np.ndarray, payload: dict):
     rows. Overflowing capacity lanes (badly skewed arrival order) retry with
     doubled capacity — fixed shapes stay compile-cached per capacity.
     """
+    key_out, cols_out, counts, splits = _reshard_with_retry(
+        mesh, keys, payload
+    )
+    return key_out, cols_out, counts, splits
+
+
+def _reshard_with_retry(mesh: Mesh, keys: np.ndarray, payload: dict,
+                        lex_cols: int = 0):
+    """Shard + split + reshard with capacity-doubling retries on overflow
+    (shared by :func:`device_bulk_build` and :func:`device_sort_perm`).
+    Raises RuntimeError if overflow persists at full per-shard capacity."""
     n = len(keys)
     shards = data_shards(mesh)
     cols, padded, rows_per_shard = shard_columns(
@@ -60,7 +71,8 @@ def device_bulk_build(mesh: Mesh, keys: np.ndarray, payload: dict):
     capacity = None
     for _ in range(8):
         key_out, cols_out, counts, ovf = reshard(
-            mesh, cols["key"], n, splits, payload_dev, capacity=capacity
+            mesh, cols["key"], n, splits, payload_dev,
+            capacity=capacity, lex_cols=lex_cols,
         )
         if ovf == 0:
             return key_out, cols_out, counts, splits
@@ -68,8 +80,56 @@ def device_bulk_build(mesh: Mesh, keys: np.ndarray, payload: dict):
         if capacity >= rows_per_shard:
             capacity = rows_per_shard  # one lane can hold a whole shard
     key_out, cols_out, counts, ovf = reshard(
-        mesh, cols["key"], n, splits, payload_dev, capacity=rows_per_shard
+        mesh, cols["key"], n, splits, payload_dev,
+        capacity=rows_per_shard, lex_cols=lex_cols,
     )
     if ovf != 0:
         raise RuntimeError(f"reshard overflow persisted at full capacity: {ovf}")
     return key_out, cols_out, counts, splits
+
+
+def device_sort_perm(
+    mesh: Mesh, route_key: np.ndarray, tiebreak: np.ndarray | None = None
+) -> np.ndarray:
+    """Distributed sample sort on the mesh → the sorting permutation.
+
+    The index-build path's host ``lexsort`` replacement (SURVEY.md §2.20 P1,
+    the ``DefaultSplitter.scala:33`` stats-driven-cuts role made a device
+    primitive): rows route to their key-range owner shard via stats-driven
+    ``sampled_splits`` + one ``all_to_all``, each shard sorts locally, and
+    concatenating shards in split order yields the global sort. Composite
+    keys wider than 64 bits (z3's (bin, 63-bit z)) pass the high bits as
+    ``route_key`` and the low bits as ``tiebreak`` — the reshard step
+    lexsorts by (route_key, tiebreak), which equals the exact wide-key
+    order whenever ``route_key`` is a monotone prefix of it.
+
+    Returns a (n,) int64 permutation with the same row-set semantics as the
+    host sort (tie ORDER between fully-equal keys may differ; all sorted key
+    products are identical). Raises ValueError for inputs the device path
+    cannot represent (≥ int32 rows; a route key equal to the reshard padding
+    sentinel, which would silently drop the row) and RuntimeError on
+    persistent reshard overflow — callers fall back to the host sort.
+    """
+    n = len(route_key)
+    if n >= 2**31:
+        raise ValueError("device_sort_perm: > int32 rows per build")
+    if n and int(route_key.max()) == 2**64 - 1:
+        raise ValueError("device_sort_perm: route key collides with sentinel")
+    shards = data_shards(mesh)
+    rowid = np.arange(n, dtype=np.int32)
+    payload = {"rowid": rowid}
+    lex = 0
+    if tiebreak is not None:
+        payload = {"tie": tiebreak.astype(np.int32), "rowid": rowid}
+        lex = 1
+    _, cols_out, counts, _splits = _reshard_with_retry(
+        mesh, route_key, payload, lex_cols=lex
+    )
+    # per-shard sorted rowids, concatenated in shard order = global sort.
+    # cols_out["rowid"] is (S * S*capacity) device-sharded; shard d's first
+    # counts[d] rows are real.
+    rid = np.asarray(jax.device_get(cols_out["rowid"]))
+    per_shard = rid.reshape(shards, -1)
+    return np.concatenate(
+        [per_shard[d, : int(counts[d])] for d in range(shards)]
+    ).astype(np.int64)
