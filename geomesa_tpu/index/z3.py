@@ -41,9 +41,15 @@ def _lexsort_bin_key(bins: np.ndarray, key: np.ndarray, sorter) -> np.ndarray:
     prefix of the wide key. Bins outside u16 (or a sorter failure the
     caller didn't catch) fall back to the host lexsort.
     """
-    if sorter is not None and len(bins) and 0 <= int(bins.min()) and int(
-        bins.max()
-    ) < (1 << 16):
+    if (
+        sorter is not None
+        and len(bins)
+        and 0 <= int(bins.min())
+        and int(bins.max()) < (1 << 16)
+        # the route packs key>>15 into 48 bits: keys >= 2^63 (e.g. XZ3 at
+        # extreme precision) would overflow into the bin field — host sort
+        and int(key.max()) < (1 << 63)
+    ):
         route = (bins.astype(np.uint64) << np.uint64(48)) | (
             key.astype(np.uint64) >> np.uint64(15)
         )

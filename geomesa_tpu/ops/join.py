@@ -16,6 +16,8 @@ as a prefilter.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -213,6 +215,116 @@ def polygon_block_plan(
             blk[d, p, : len(ids)] = ids
             nblk[d, p] = len(ids)
     return blk, nblk
+
+
+@lru_cache(maxsize=None)
+def make_block_bbox_count_step(mesh, block: int):
+    """Pass 1 of the row-returning block join: per-shard counts of rows in
+    each polygon's int-domain bbox, over the planned candidate blocks only.
+
+    fn(x, y, true_n, blk (D, K, MB), nblk (D, K), ibox (K, 4) int32
+    [xmin, xmax, ymin, ymax]) → (D, K) int32 per-shard counts. The int
+    test is a SUPERSET of the f64 bbox (normalize is monotone), so a host
+    residual on the gathered rows is exact — the same two-phase contract
+    as distributed select (SURVEY.md §7)."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import DATA_AXIS
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(),
+            P(DATA_AXIS, None, None), P(DATA_AXIS, None), P(),
+        ),
+        out_specs=P(DATA_AXIS, None),
+        check_vma=False,
+    )
+    def step(x, y, true_n, blk, nblk, ibox):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        mb = blk.shape[2]
+
+        def one(args):
+            b_ids, nb, bb = args
+            take = b_ids[:, None] * block + jnp.arange(block, dtype=jnp.int32)
+            take = take.reshape(-1)
+            live = (
+                (jnp.arange(mb, dtype=jnp.int32) < nb)[:, None]
+                .repeat(block, axis=1).reshape(-1)
+            ) & ((base + take) < true_n)
+            xs = x[take]
+            ys = y[take]
+            inside = (
+                (xs >= bb[0]) & (xs <= bb[1]) & (ys >= bb[2]) & (ys <= bb[3])
+            )
+            return (inside & live).sum(dtype=jnp.int32)
+
+        return jax.lax.map(one, (blk[0], nblk[0], ibox))[None, :]
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def make_block_bbox_gather_step(mesh, block: int, capacity: int):
+    """Pass 2: compact each polygon's int-bbox-matching GLOBAL sorted-order
+    row positions into ``capacity`` lanes per shard.
+
+    fn(x, y, true_n, blk, nblk, ibox) → (positions (D, K, capacity) int32,
+    hits (D, K) int32); positions[d, p, :hits[d, p]] are global positions
+    on shard d matching polygon p (unused lanes hold -1)."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import DATA_AXIS
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(),
+            P(DATA_AXIS, None, None), P(DATA_AXIS, None), P(),
+        ),
+        out_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None)),
+        check_vma=False,
+    )
+    def step(x, y, true_n, blk, nblk, ibox):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        mb = blk.shape[2]
+
+        def one(args):
+            b_ids, nb, bb = args
+            take = b_ids[:, None] * block + jnp.arange(block, dtype=jnp.int32)
+            take = take.reshape(-1)
+            live = (
+                (jnp.arange(mb, dtype=jnp.int32) < nb)[:, None]
+                .repeat(block, axis=1).reshape(-1)
+            ) & ((base + take) < true_n)
+            xs = x[take]
+            ys = y[take]
+            mask = (
+                (xs >= bb[0]) & (xs <= bb[1]) & (ys >= bb[2]) & (ys <= bb[3])
+            ) & live
+            dest = jnp.where(
+                mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, capacity
+            )
+            out = jnp.full((capacity,), -1, dtype=jnp.int32)
+            out = out.at[dest].set(base + take, mode="drop")
+            return out, mask.sum(dtype=jnp.int32)
+
+        pos, hits = jax.lax.map(one, (blk[0], nblk[0], ibox))
+        return pos[None], hits[None, :]
+
+    return step
 
 
 def make_block_join_step(mesh, block: int):

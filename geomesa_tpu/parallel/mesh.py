@@ -62,21 +62,26 @@ def pad_query_axis(mesh: Mesh, *arrays):
     return out, n
 
 
-def pad_rows(n: int, shards: int) -> int:
-    """Row count padded so every shard gets an equal contiguous slice."""
-    return ((n + shards - 1) // shards) * shards
+def pad_rows(n: int, shards: int, multiple: int = 1) -> int:
+    """Row count padded so every shard gets an equal contiguous slice of
+    ``multiple``-aligned length (block-granular kernels — the block-sparse
+    join — need ``rows_per_shard % block == 0``)."""
+    unit = shards * max(multiple, 1)
+    return ((n + unit - 1) // unit) * unit
 
 
-def shard_columns(mesh: Mesh, columns: dict[str, np.ndarray], pad_value=0):
+def shard_columns(mesh: Mesh, columns: dict[str, np.ndarray], pad_value=0,
+                  multiple: int = 1):
     """Pad + device_put columns sharded along the mesh ``data`` axis.
 
     Returns (sharded jnp arrays dict, padded_n, rows_per_shard). Padding rows
     carry ``pad_value`` and must be masked by the caller (they never appear in
     scan intervals because intervals are bounded by the true row count).
+    ``multiple``: per-shard row alignment (see :func:`pad_rows`).
     """
     shards = data_shards(mesh)
     n = len(next(iter(columns.values())))
-    padded = pad_rows(max(n, shards), shards)
+    padded = pad_rows(max(n, shards), shards, multiple)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     out = {}
     for name, arr in columns.items():

@@ -422,10 +422,15 @@ def make_batched_overlap_step(mesh: Mesh, with_time: bool = False):
     return step
 
 
-def _local_knn_heaps(x, y, true_n, qx, qy, k):
+def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     """Per-shard candidate heaps shared by the gather and ring KNN steps:
     decode int32 coords to planar f32 degrees, mask padded rows, and top_k
     each query sequentially (peak memory O(N), not O(Q·N)).
+
+    ``ttl``: optional (bins, offs, cut) — rows with (bin, off)
+    lexicographically BELOW cut=(cut_bin, cut_off) are TTL-expired and
+    masked to inf, so a live store's device sweep never surfaces aged-off
+    candidates (the AgeOffIterator-at-scan role on the KNN path).
 
     Returns (dists² (Ql, k) ascending, global rows (Ql, k) int32)."""
     sx = np.float32(360.0 / 2**31)
@@ -433,6 +438,10 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k):
     n = x.shape[0]
     base = jax.lax.axis_index(DATA_AXIS) * n
     valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+    if ttl is not None:
+        bins, offs, cut = ttl
+        live = (bins > cut[0]) | ((bins == cut[0]) & (offs >= cut[1]))
+        valid = valid & live
     xf = x.astype(jnp.float32) * sx - jnp.float32(180.0)
     yf = y.astype(jnp.float32) * sy - jnp.float32(90.0)
 
@@ -446,7 +455,7 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k):
     return jax.lax.map(one, (qx, qy))  # (Ql, k) each
 
 
-def make_batched_knn_step(mesh: Mesh, k: int):
+def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
     """Batched multi-point KNN in ONE pass: per-shard distance scan +
     ``top_k``, candidates ``all_gather``-merged over the data axis and
     re-ranked — replacing the reference's per-point iterative-deepening
@@ -457,21 +466,31 @@ def make_batched_knn_step(mesh: Mesh, k: int):
         (dists (Q, k) f32 degrees, rows (Q, k) int32 global sorted-order
         positions). Distances are planar f32 degrees (the CPU referee must
     use the same f32 math; int→f32 coordinate rounding is ~2e-5°).
+
+    ``with_ttl``: signature becomes fn(x, y, bins, offs, true_n, qx, qy,
+    cut (2,) int32) — rows lex-below cut are expired and masked on device
+    (live-store KNN, VERDICT r2 item 5).
     """
+
+    col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
+    tail_specs = (P(QUERY_AXIS), P(QUERY_AXIS)) + ((P(),) if with_ttl else ())
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(),
-            P(QUERY_AXIS), P(QUERY_AXIS),
-        ),
+        in_specs=(*col_specs, P(), *tail_specs),
         out_specs=(P(QUERY_AXIS, None), P(QUERY_AXIS, None)),
         check_vma=False,
     )
-    def step(x, y, true_n, qx, qy):
-        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k)
+    def step(*args):
+        if with_ttl:
+            x, y, bins, offs, true_n, qx, qy, cut = args
+            ttl = (bins, offs, cut)
+        else:
+            x, y, true_n, qx, qy = args
+            ttl = None
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl)
         # merge per-shard candidate heaps across the mesh
         ad = jax.lax.all_gather(dloc, DATA_AXIS, axis=0)  # (D, Ql, k)
         ai = jax.lax.all_gather(iloc, DATA_AXIS, axis=0)
@@ -485,8 +504,8 @@ def make_batched_knn_step(mesh: Mesh, k: int):
 
 
 @lru_cache(maxsize=None)
-def cached_batched_knn_step(mesh: Mesh, k: int):
-    return make_batched_knn_step(mesh, k)
+def cached_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
+    return make_batched_knn_step(mesh, k, with_ttl)
 
 
 @lru_cache(maxsize=None)
@@ -596,7 +615,7 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
     return step
 
 
-def make_ring_knn_step(mesh: Mesh, k: int):
+def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
     """Batched KNN with a RING top-k merge over the data axis (``ppermute``).
 
     Same contract as :func:`make_batched_knn_step`, different collective
@@ -610,20 +629,25 @@ def make_ring_knn_step(mesh: Mesh, k: int):
     """
 
     n_shards = data_shards(mesh)
+    col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
+    tail_specs = (P(QUERY_AXIS), P(QUERY_AXIS)) + ((P(),) if with_ttl else ())
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS), P(DATA_AXIS), P(),
-            P(QUERY_AXIS), P(QUERY_AXIS),
-        ),
+        in_specs=(*col_specs, P(), *tail_specs),
         out_specs=(P(QUERY_AXIS, None), P(QUERY_AXIS, None)),
         check_vma=False,
     )
-    def step(x, y, true_n, qx, qy):
-        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k)
+    def step(*args):
+        if with_ttl:
+            x, y, bins, offs, true_n, qx, qy, cut = args
+            ttl = (bins, offs, cut)
+        else:
+            x, y, true_n, qx, qy = args
+            ttl = None
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl)
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
         def hop(carry, _):
@@ -647,8 +671,8 @@ def make_ring_knn_step(mesh: Mesh, k: int):
 
 
 @lru_cache(maxsize=None)
-def cached_ring_knn_step(mesh: Mesh, k: int):
-    return make_ring_knn_step(mesh, k)
+def cached_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
+    return make_ring_knn_step(mesh, k, with_ttl)
 
 
 @lru_cache(maxsize=None)

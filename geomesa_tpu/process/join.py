@@ -50,6 +50,187 @@ def join_within(ds, type_name: str, polygons, filter=None):
     ]
 
 
+def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
+                     chunk_budget: int = 32_000_000):
+    """Distributed EXACT spatial join returning row sets per right geometry.
+
+    The mesh path of the SQL engine's spatial JOIN (``GeoMesaRelation.scala:
+    94`` / ``SQLRules.scala`` role, VERDICT r2 item 6): the z2-sorted device
+    layout is cut into fixed blocks; per geometry, only the blocks its bbox
+    z-ranges touch are tested (host planning, ``polygon_block_plan``), an
+    int-domain bbox gather compacts candidate rows on device (a SUPERSET —
+    normalize is monotone), and the exact f64 predicate runs host-side on
+    the few candidates. One device dispatch per chunk, not per geometry.
+
+    Returns ``(snapshot_table, [(i, rows), ...])`` — the coherent snapshot
+    table the row indices refer to (main tier, plus pending delta rows
+    appended when the store is live; a racing compaction cannot skew them)
+    and, per geometry ``i`` in order, the matching row indices. TTL-expired
+    rows are filtered host-side on the candidates, and pending hot-tier
+    rows are predicate-tested host-side and spliced in — live stores stay
+    on the mesh path. Raises ValueError when the store/layout cannot take
+    the device path (caller falls back to :func:`join_scan`); device
+    errors propagate for the caller's circuit breaker.
+
+    ``chunk_budget``: max int32 lanes per gather dispatch (bounds HBM).
+    """
+    import jax.numpy as jnp
+
+    from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+    from geomesa_tpu.geometry import predicates as P
+    from geomesa_tpu.ops.join import (
+        make_block_bbox_count_step,
+        make_block_bbox_gather_step,
+        polygon_block_plan,
+    )
+    from geomesa_tpu.parallel.mesh import data_shards
+    from geomesa_tpu.store.backends import JOIN_BLOCK, REFINE_PRECISION, TpuBackend
+
+    if pred not in ("within", "intersects"):
+        raise ValueError(f"device join: unsupported predicate {pred!r}")
+    if not isinstance(ds.backend, TpuBackend) or not ds._device_available():
+        raise ValueError("device join: TPU backend unavailable")
+    st = ds._state(type_name)
+    main, indices, backend_state, _stats, delta = st.snapshot()
+    dev = (backend_state or {}).get("z2")
+    z2 = indices.get("z2")
+    if dev is None or z2 is None or main is None or len(main) == 0:
+        raise ValueError("device join: no z2 device residency")
+    # age-off: expired rows still sit in the device layout; filter them
+    # host-side on the (few) candidates so mesh and host paths agree
+    ttl = ds._age_off_ttl_ms(st.sft)
+    cutoff_ms = None
+    main_dtg = None
+    if ttl is not None:
+        if st.sft.dtg_field is None:
+            raise ValueError("device join: TTL without dtg field")
+        import time as _time
+
+        cutoff_ms = int(_time.time() * 1000) - ttl
+        main_dtg = main.dtg_millis()
+    block = JOIN_BLOCK
+    if dev.rows_per_shard % block:
+        raise ValueError("device join: layout not block-aligned")
+    mesh = ds.backend._get_mesh()
+    shards = data_shards(mesh)
+    nlon = norm_lon(REFINE_PRECISION)
+    nlat = norm_lat(REFINE_PRECISION)
+    col = main.geom_column()
+    perm = z2.perm
+
+    # f64 bboxes for planning; int-domain bboxes for the device test
+    k = len(geoms)
+    bbox_deg = np.zeros((k, 4))
+    ibox = np.zeros((k, 4), dtype=np.int32)
+    empty = np.zeros(k, dtype=bool)
+    for i, g in enumerate(geoms):
+        if g is None:
+            empty[i] = True
+            continue
+        x1, y1, x2, y2 = g.bbox
+        bbox_deg[i] = (x1, y1, x2, y2)
+        ibox[i] = (
+            int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+            int(nlat.normalize(y1)), int(nlat.normalize(y2)),
+        )
+
+    count_step = make_block_bbox_count_step(mesh, block)
+    true_n = jnp.int32(len(main))
+    out: list[tuple[int, np.ndarray]] = []
+    # chunk geometries so D × Kc × capacity stays inside the lane budget
+    start = 0
+    while start < k:
+        # plan a provisional chunk, then size capacity from real counts
+        kc = min(k - start, 1024)
+        sel = np.arange(start, start + kc)
+        blk, nblk = polygon_block_plan(
+            z2.zs, bbox_deg[sel], block, dev.rows_per_shard, shards
+        )
+        dev_blk = jnp.asarray(blk)
+        dev_nblk = jnp.asarray(nblk)
+        dev_ibox = jnp.asarray(ibox[sel])
+        counts = np.asarray(
+            count_step(dev.cols["x"], dev.cols["y"], true_n,
+                       dev_blk, dev_nblk, dev_ibox)
+        )  # (D, Kc)
+        cap = max(int(counts.max()), 1)
+        cap = 1 << (cap - 1).bit_length()  # pow2: bounded compile variants
+        if shards * kc * cap > chunk_budget:
+            # split the chunk instead of materializing an oversized buffer
+            if kc == 1:
+                # single huge geometry: exact host scan for just this one
+                g = geoms[start]
+                m = (
+                    P.points_within_geom(col.x, col.y, g)
+                    if pred == "within"
+                    else P.points_intersect_geom(col.x, col.y, g)
+                )
+                if main_dtg is not None:
+                    m &= main_dtg >= cutoff_ms
+                out.append((start, np.nonzero(m)[0]))
+                start += 1
+                continue
+            kc = max(1, kc // 2)
+            continue
+        gather = make_block_bbox_gather_step(mesh, block, cap)
+        pos, hits = gather(
+            dev.cols["x"], dev.cols["y"], true_n, dev_blk, dev_nblk, dev_ibox
+        )
+        pos = np.asarray(pos)   # (D, Kc, cap) global sorted positions
+        hits = np.asarray(hits)
+        for j in range(kc):
+            gi = start + j
+            if empty[gi]:
+                out.append((gi, np.empty(0, dtype=np.int64)))
+                continue
+            cand = np.concatenate(
+                [pos[d, j, : hits[d, j]] for d in range(shards)]
+            ).astype(np.int64)
+            rows = perm[cand]  # sorted-order → original row indices
+            g = geoms[gi]
+            m = (
+                P.points_within_geom(col.x[rows], col.y[rows], g)
+                if pred == "within"
+                else P.points_intersect_geom(col.x[rows], col.y[rows], g)
+            )
+            if main_dtg is not None:
+                m &= main_dtg[rows] >= cutoff_ms
+            out.append((gi, rows[m]))
+        start += kc
+
+    if delta is None or not len(delta):
+        return main, out
+
+    # pending hot-tier rows: few (bounded by the compaction threshold) —
+    # evaluate the exact predicate host-side and splice them in, same as
+    # the live-store KNN merge. Row indices >= len(main) address the delta
+    # part of the returned combined snapshot table.
+    from geomesa_tpu.schema.columnar import FeatureTable
+
+    dcol = delta.geom_column()
+    d_keep = np.ones(len(delta), dtype=bool)
+    if dcol.valid is not None:
+        d_keep &= dcol.valid
+    if cutoff_ms is not None:
+        d_keep &= delta.dtg_millis() >= cutoff_ms
+    combined = FeatureTable.concat([main, delta])
+    n_main = len(main)
+    merged: list[tuple[int, np.ndarray]] = []
+    for gi, rows in out:
+        g = geoms[gi]
+        if g is None or not d_keep.any():
+            merged.append((gi, rows))
+            continue
+        dm = (
+            P.points_within_geom(dcol.x, dcol.y, g)
+            if pred == "within"
+            else P.points_intersect_geom(dcol.x, dcol.y, g)
+        ) & d_keep
+        extra = n_main + np.nonzero(dm)[0]
+        merged.append((gi, np.concatenate([rows, extra]) if len(extra) else rows))
+    return combined, merged
+
+
 def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):
     """Bulk join counts: (K,) ndarray of points-inside counts per polygon.
 

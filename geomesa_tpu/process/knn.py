@@ -70,7 +70,7 @@ def knn(
 
 
 def knn_many(ds, type_name: str, points, k: int = 10,
-             topology: str = "gather"):
+             topology: str = "gather", now_ms: int | None = None):
     """Batched KNN: all query points answered in ONE device pass.
 
     Device path (TpuBackend): per-shard f32 distance scan + ``top_k``,
@@ -78,6 +78,13 @@ def knn_many(ds, type_name: str, points, k: int = 10,
     (:func:`geomesa_tpu.parallel.query.make_batched_knn_step`) — the
     reference's per-point window-doubling loop collapses into a single
     sweep. Other backends fall back to per-point :func:`knn`.
+
+    LIVE stores stay on the device path (VERDICT r2 item 5): TTL-expired
+    rows are masked on device (the ``with_ttl`` step variant), and pending
+    hot-tier (delta) rows are ranked host-side and merged into each
+    point's candidate heap — correct because any true neighbor in the main
+    tier is within the main tier's device top-k. ``now_ms`` pins the TTL
+    clock (tests / reproducibility); default wall clock.
 
     ``topology``: heap-merge collective — ``"gather"`` (all_gather, one
     round) or ``"ring"`` (ppermute, D-1 hops of O(k) payload — for big
@@ -99,13 +106,9 @@ def knn_many(ds, type_name: str, points, k: int = 10,
     dev = index_name = None
     if isinstance(ds.backend, TpuBackend) and ds._device_available():
         dev, index_name = TpuBackend.point_state(backend_state)
-    if (
-        dev is None
-        or delta_table is not None
-        or main_n == 0
-        # TTL masking is injected per-query in query(); the device columns
-        # still hold expired rows — take the exact per-point path
-        or ds._age_off_ttl_ms(st.sft) is not None
+    ttl = ds._age_off_ttl_ms(st.sft)
+    if dev is None or main_n == 0 or (
+        ttl is not None and st.sft.dtg_field is None
     ):
         return [knn(ds, type_name, p, k) for p in points]
 
@@ -119,17 +122,36 @@ def knn_many(ds, type_name: str, points, k: int = 10,
 
     mesh = ds.backend._get_mesh()
     kk = min(k, main_n)
+    with_ttl = ttl is not None
+    cutoff_ms = None
+    if with_ttl:
+        import time as _time
+
+        cutoff_ms = (
+            int(_time.time() * 1000) if now_ms is None else now_ms
+        ) - ttl
     maker = cached_ring_knn_step if topology == "ring" else cached_batched_knn_step
-    step = maker(mesh, kk)
+    step = maker(mesh, kk, with_ttl)
     qx = np.array([p.x for p in points], dtype=np.float32)
     qy = np.array([p.y for p in points], dtype=np.float32)
     (qx, qy), _ = pad_query_axis(mesh, qx, qy)
     c = dev.cols
     try:
-        dists, pos = step(
-            c["x"], c["y"], jnp.int32(main_n),
-            jnp.asarray(qx), jnp.asarray(qy),
-        )
+        if with_ttl:
+            from geomesa_tpu.curve.binned_time import BinnedTime
+
+            binned = BinnedTime(st.sft.z3_interval)
+            (cb,), (co,) = binned.to_bin_and_offset(np.array([cutoff_ms]))
+            cut = jnp.asarray(np.array([cb, co], dtype=np.int32))
+            dists, pos = step(
+                c["x"], c["y"], c["bins"], c["offs"], jnp.int32(main_n),
+                jnp.asarray(qx), jnp.asarray(qy), cut,
+            )
+        else:
+            dists, pos = step(
+                c["x"], c["y"], jnp.int32(main_n),
+                jnp.asarray(qx), jnp.asarray(qy),
+            )
         # materialize INSIDE the try: jax dispatch is async, so a dead
         # device often surfaces at transfer time, not at the step() call
         dists = np.asarray(dists)[: len(points)]
@@ -142,10 +164,45 @@ def knn_many(ds, type_name: str, points, k: int = 10,
         return [knn(ds, type_name, p, k) for p in points]
     ds._note_device_ok()
     perm = indices[index_name].perm
+
+    # hot-tier merge: rank pending delta rows host-side (they are few — the
+    # compaction threshold bounds them) and fold into each candidate heap
+    d_x = d_y = d_t = None
+    if delta_table is not None and len(delta_table):
+        dcol = delta_table.geom_column()
+        d_keep = np.ones(len(delta_table), dtype=bool)
+        if dcol.valid is not None:
+            d_keep &= dcol.valid
+        if with_ttl:
+            d_keep &= delta_table.dtg_millis() >= cutoff_ms
+        d_rows = np.nonzero(d_keep)[0]
+        if len(d_rows):
+            d_x = dcol.x[d_rows].astype(np.float32)
+            d_y = dcol.y[d_rows].astype(np.float32)
+            d_t = delta_table.take(d_rows)  # materialized once, reused per point
+
     out = []
     for qi in range(len(points)):
         rows = perm[pos[qi]]
-        out.append((main.take(rows), dists[qi].astype(np.float64)))
+        cand_t = main.take(rows)
+        cand_d = dists[qi].astype(np.float64)
+        # device heaps of a near-empty/expired store can carry inf slots
+        live = np.isfinite(cand_d)
+        if not live.all():
+            cand_t = cand_t.take(np.nonzero(live)[0])
+            cand_d = cand_d[live]
+        if d_x is not None:
+            from geomesa_tpu.schema.columnar import FeatureTable
+
+            dd = np.sqrt(
+                (d_x - np.float32(points[qi].x)) ** 2
+                + (d_y - np.float32(points[qi].y)) ** 2
+            ).astype(np.float64)
+            cand_t = FeatureTable.concat([cand_t, d_t])
+            cand_d = np.concatenate([cand_d, dd])
+        take = min(k, len(cand_d))
+        order = np.argsort(cand_d, kind="stable")[:take]
+        out.append((cand_t.take(order), cand_d[order]))
     return out
 
 

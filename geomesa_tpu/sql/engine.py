@@ -304,6 +304,57 @@ def _map_unquoted(s: str, fn) -> str:
     return "".join(out)
 
 
+def _join_pairs(ds, t1: str, rgeoms, left_pred: str, base_cql):
+    """Join executor: the DISTRIBUTED mesh path when it applies, else the
+    per-geometry index-planned host scan.
+
+    Mesh path (``GeoMesaRelation.scala:94``/``SQLRules.scala`` role,
+    VERDICT r2 item 6): one batched block-sparse candidate gather on the
+    device mesh for ALL right geometries + exact host residual
+    (:func:`geomesa_tpu.process.join.join_rows_device`); the WHERE
+    predicate evaluates as a vectorized AST mask on each candidate set, so
+    pushdown still applies. Any structural mismatch (non-TPU backend, no
+    point layout, unsupported predicate) or device failure falls back to
+    :func:`geomesa_tpu.process.join.join_scan` — same yielded
+    ``(right_index, left_table)`` contract either way."""
+    from geomesa_tpu.process.join import join_rows_device, join_scan
+
+    base = None
+    if base_cql is not None:
+        from geomesa_tpu.filter.cql import parse as _parse_cql
+
+        base = _parse_cql(base_cql)
+    pairs = None
+    # merged views / remote stores lack the device machinery entirely —
+    # an explicit structural test, not exception-driven (a broad
+    # AttributeError catch would also swallow genuine bugs)
+    if hasattr(ds, "_state") and hasattr(ds, "backend"):
+        try:
+            main, pairs = join_rows_device(ds, t1, rgeoms, left_pred)
+        except ValueError:
+            pairs = None  # structural: this store can't take the mesh path
+        except Exception as e:  # noqa: BLE001 — device outage → host fallback
+            if not ds._is_device_error(e):
+                raise
+            ds._trip_device_circuit(e)
+            ds.metrics.counter("store.query.device_failovers").inc()
+            pairs = None
+    if pairs is None:
+        yield from join_scan(ds, t1, rgeoms, left_pred, base_cql)
+        return
+    ds._note_device_ok()
+    for i, rows in pairs:
+        if len(rows) == 0:
+            yield i, None
+            continue
+        lt = main.take(rows)
+        if base is not None:
+            mask = np.asarray(base.mask(lt), dtype=bool)
+            if not mask.all():
+                lt = lt.take(np.nonzero(mask)[0])
+        yield i, lt
+
+
 def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     """Spatial JOIN: each right-table geometry becomes an index-planned scan
     of the left table (delegating to :func:`geomesa_tpu.process.join
@@ -380,7 +431,7 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
 
     out: dict[str, list] = {f"{alias}.{col}": [] for alias, col in expanded}
     total = 0
-    for j, lt in join_scan(ds, t1, rgeoms, left_pred, base_cql):
+    for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql):
         n = 0 if lt is None else len(lt)
         if n == 0:
             continue

@@ -29,6 +29,7 @@ from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType
 
 REFINE_PRECISION = 31  # device coords are 31-bit fixed point (Z2 resolution)
+JOIN_BLOCK = 4096  # block-sparse join granularity; shards pad to multiples
 
 
 class ExecutionBackend:
@@ -184,15 +185,19 @@ class TpuBackend(ExecutionBackend):
         used_bytes = 0
         est = 0
         if self.max_device_bytes is not None:
-            # admission estimate: int32 columns, rows padded up to a multiple
-            # of the data-shard count (parallel/mesh.pad_rows)
-            from geomesa_tpu.parallel.mesh import data_shards
+            # admission estimate: int32 columns at the REAL padded row count
+            # (block-aligned shards — parallel/mesh.pad_rows with the
+            # JOIN_BLOCK multiple — can round small tables up substantially)
+            from geomesa_tpu.parallel.mesh import data_shards, pad_rows
 
             mesh = self._get_mesh()
             n_cols = (
                 4 if (sft.geom_field and table.geom_column().x is not None) else 6
             )
-            est = n_cols * 4 * (len(table) + data_shards(mesh))
+            shards = data_shards(mesh)
+            est = n_cols * 4 * pad_rows(
+                max(len(table), shards), shards, JOIN_BLOCK
+            )
         for name, index in ordered:
             if self.max_device_bytes is not None:
                 if used_bytes + est > self.max_device_bytes:
@@ -218,8 +223,11 @@ class TpuBackend(ExecutionBackend):
             if col.x is not None:
                 xi = nlon.normalize(col.x[perm]).astype(np.int32)
                 yi = nlat.normalize(col.y[perm]).astype(np.int32)
+                # block-aligned shards so block-granular kernels (the
+                # block-sparse join over the z2 layout) divide evenly
                 cols, padded, rows_per_shard = shard_columns(
-                    mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs}
+                    mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs},
+                    multiple=JOIN_BLOCK,
                 )
                 state[name] = _MeshIndexState(
                     cols=cols, rows_per_shard=rows_per_shard, n=len(table)
@@ -255,6 +263,7 @@ class TpuBackend(ExecutionBackend):
                         "xmin": xmin, "ymin": ymin, "xmax": xmax, "ymax": ymax,
                         "bins": bins, "offs": offs,
                     },
+                    multiple=JOIN_BLOCK,
                 )
                 state[name] = _MeshIndexState(
                     cols=cols, rows_per_shard=rows_per_shard, n=len(table),
