@@ -728,11 +728,18 @@ def bench_select():
         if expect != got:
             parity_ok = False
 
-    # Arrow IPC out of the largest result (the ArrowScan deliverable)
+    # Arrow IPC out of the largest result (the ArrowScan deliverable).
+    # One throwaway export first: pyarrow's lazy kernel/memory-pool init
+    # costs ~300 ms ONCE per process and was mistaken for per-export cost
+    # in the r02 record (VERDICT r2 weak #8; steady-state is ~2 ms).
     biggest = results[int(np.argmax(rows_returned))]
-    t0 = time.perf_counter()
-    ipc = to_ipc_bytes(biggest.table)
-    arrow_ms = (time.perf_counter() - t0) * 1e3
+    to_ipc_bytes(biggest.table.take(np.arange(min(4, biggest.count))))
+    reps = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ipc = to_ipc_bytes(biggest.table)
+        reps.append((time.perf_counter() - t0) * 1e3)
+    arrow_ms = float(np.median(reps))
 
     return {
         "metric": "mesh_select_rows_p50_latency",
@@ -841,16 +848,16 @@ def bench_resident():
 
 
 # ---------------------------------------------------------------------------
-# Config 8: out-of-core 1B streaming scan — the north-star total, streamed
-# through one chip as resident-share chunks (per-time-bin array groups,
-# SURVEY.md §5 long-context mapping). Chunks are generated ON DEVICE (no
-# host transfer; flagged in detail) and scanned with the same fused batched
-# count step; a plain-XLA mask-sum referee checks every chunk's counts.
+# Config 8: out-of-core 1B streaming scan — the north-star total streamed
+# HOST → HBM through one chip as resident-share chunks with double-buffered
+# transfers (the FileSystemThreadedReader role, SURVEY.md §2.12; VERDICT r2
+# item 3: real host-resident data, transfer measured, not on-device
+# generation). Each chunk is scanned against all Q queries by the fused
+# count step, and one query's matching rows are RETRIEVED (not just
+# counted) per chunk; a plain-XLA mask-sum referee checks every chunk.
 # ---------------------------------------------------------------------------
 
 def bench_stream_1b():
-    from functools import partial
-
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as _P
@@ -861,28 +868,31 @@ def bench_stream_1b():
     on_accel = jax.default_backend() not in ("cpu",)
     mesh = make_mesh()
     shards = data_shards(mesh)
-    N = _n(125_000_000 if on_accel else 500_000)
+    # chunk sized to HBM budget: 2 chunks resident (double buffer) × 16 B/row
+    N = _n(60_000_000 if on_accel else 500_000)
     N -= N % shards
     total_target = int(
         os.environ.get(
             "GEOMESA_BENCH_TOTAL", 1_000_000_000 if on_accel else N * 8
         )
     )
-    chunks = max(1, (total_target + N - 1) // N)
+    chunks = max(2, (total_target + N - 1) // N)
     max_off = 86_400_000 - 1  # PERIOD=DAY offsets; one chunk = one time bin
 
     sh = NamedSharding(mesh, _P(DATA_AXIS))
 
-    # n static (shapes), seed/chunk_bin traced: ONE compile for all chunks
-    @partial(jax.jit, static_argnums=(1,), out_shardings=(sh, sh, sh, sh))
-    def gen(seed, n, chunk_bin):
-        k = jax.random.PRNGKey(seed)
-        kx, ky, kt = jax.random.split(k, 3)
-        x = jax.random.randint(kx, (n,), 0, 2**31 - 1, dtype=jnp.int32)
-        y = jax.random.randint(ky, (n,), 0, 2**31 - 1, dtype=jnp.int32)
-        offs = jax.random.randint(kt, (n,), 0, max_off, dtype=jnp.int32)
-        bins = jnp.full((n,), 1, dtype=jnp.int32) * chunk_bin
+    def host_chunk(c: int):
+        """Host-RESIDENT chunk c (the parquet-reader stand-in): numpy
+        columns materialized in RAM before any device work is timed."""
+        rng = np.random.default_rng(1000 + c)
+        x = rng.integers(0, 2**31 - 1, N, dtype=np.int32)
+        y = rng.integers(0, 2**31 - 1, N, dtype=np.int32)
+        offs = rng.integers(0, max_off, N, dtype=np.int32)
+        bins = np.full(N, c, dtype=np.int32)
         return x, y, bins, offs
+
+    def put(cols):
+        return tuple(jax.device_put(a, sh) for a in cols)
 
     # Q spatial boxes (int domain) × full-span time windows
     nlon, nlat = norm_lon(31), norm_lat(31)
@@ -905,36 +915,92 @@ def bench_stream_1b():
 
         return jax.lax.map(one, boxes)
 
+    @jax.jit
+    def retrieve_rows(x, y, b):
+        # row RETRIEVAL for one query: top-N matching positions per chunk
+        # (fixed lane count keeps shapes static; N_RET rows come back to
+        # the host as the result set)
+        m = (x >= b[0, 0]) & (x <= b[0, 1]) & (y >= b[0, 2]) & (y <= b[0, 3])
+        score = jnp.where(m, jnp.arange(m.shape[0]), -1)
+        topv, topi = jax.lax.top_k(score, 4096)
+        return topi, (topv >= 0).sum(dtype=jnp.int32), m.sum(dtype=jnp.int32)
+
+    # warm compiles on chunk 0 BEFORE anything is timed
+    warm = put(host_chunk(0))
+    jax.block_until_ready(
+        step(*warm, jnp.int32(N), dev_boxes, dev_times)
+    )
+    jax.block_until_ready(referee(warm[0], warm[1], warm[2], warm[3], dev_boxes))
+    jax.block_until_ready(retrieve_rows(warm[0], warm[1], dev_boxes[0]))
+    del warm
+
+    # -- phase A (untimed): referee-verified correctness pass, every chunk
     totals = np.zeros(Q, dtype=np.int64)
-    scan_s = 0.0
-    gen_s = 0.0
     parity_ok = True
-    iters_per_chunk = max(2, min(3, ITERS // 4))
     for c in range(chunks):
-        t0 = time.perf_counter()
-        x, y, bins, offs = gen(c, N, c)
-        jax.block_until_ready(x)
-        gen_s += time.perf_counter() - t0
-
-        def run():
-            return np.asarray(
-                step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
-            )
-
-        counts = run()  # first call compiles (chunk 0 only)
-        t_chunk = _p50(run, iters=iters_per_chunk)
-        scan_s += t_chunk / 1e3
+        x, y, bins, offs = put(host_chunk(c))
+        counts = np.asarray(
+            step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
+        )
         totals += counts.astype(np.int64)
         ref = np.asarray(referee(x, y, bins, offs, dev_boxes))
         if not np.array_equal(ref, counts.astype(np.int64)):
             parity_ok = False
 
+    # -- phase B (timed): the streaming pipeline. A READER THREAD (the
+    # FileSystemThreadedReader role) materializes chunks into a bounded
+    # queue while the main loop transfers + scans + retrieves — the wall
+    # clock covers EVERYTHING on the critical path (transfers are never
+    # subtracted; host reads overlap via the thread, their busy time is
+    # reported for the overlap story).
+    import queue as _queue
+    import threading as _threading
+
+    qchunks: _queue.Queue = _queue.Queue(maxsize=2)
+    gen_busy = {"s": 0.0}
+
+    def _producer():
+        for c in range(chunks):
+            t0 = time.perf_counter()
+            cols = host_chunk(c)
+            gen_busy["s"] += time.perf_counter() - t0
+            qchunks.put(cols)
+
+    rows_retrieved = 0
+    bytes_h2d = 0
+    transfer_wait_s = 0.0
+    prod = _threading.Thread(target=_producer, daemon=True)
+
+    t_pipe = time.perf_counter()
+    prod.start()
+    cur = put(qchunks.get())  # async H2D; overlaps the next get/scan
+    bytes_h2d += 16 * N
+    for c in range(chunks):
+        nxt = None
+        if c + 1 < chunks:
+            nxt = put(qchunks.get())  # async device_put behind the scan
+            bytes_h2d += 16 * N
+        x, y, bins, offs = cur
+        counts = np.asarray(
+            step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
+        )
+        # row retrieval for query 0 (the ArrowScan-shape deliverable)
+        topi, nret, _m = retrieve_rows(x, y, dev_boxes[0])
+        rows_retrieved += len(np.asarray(topi)[: int(nret)])
+        if nxt is not None:
+            t0 = time.perf_counter()
+            jax.block_until_ready(nxt[0])
+            transfer_wait_s += time.perf_counter() - t0
+        cur = nxt
+    pipeline_s = time.perf_counter() - t_pipe
+    prod.join(timeout=10)
+
     total_rows = N * chunks
-    rows_per_s = total_rows / max(scan_s, 1e-9)
-    # both sides in row-query pairs/s over IDENTICAL predicates (spatial box
-    # AND the same full-span time window): one fused device pass answers all
-    # Q queries, the CPU baseline evaluates each of the Q queries in turn
-    tpu_rowq_per_s = total_rows * Q / max(scan_s, 1e-9)
+    rows_per_s = total_rows / pipeline_s
+    tpu_rowq_per_s = total_rows * Q / pipeline_s
+    # CPU baseline: IDENTICAL predicates (spatial box AND the same
+    # full-span time window) per row, per query — apples-to-apples with
+    # the fused device pass
     n_ref = min(N, 2_000_000)
     rng_h = np.random.default_rng(0)
     hx = rng_h.integers(0, 2**31 - 1, n_ref, dtype=np.int32)
@@ -961,14 +1027,26 @@ def bench_stream_1b():
             "chunks": chunks,
             "n_queries": Q,
             "devices": jax.device_count(),
-            "scan_seconds_total": round(scan_s, 2),
-            "gen_seconds_total_on_device": round(gen_s, 2),
+            "pipeline_seconds_end_to_end": round(pipeline_s, 2),
+            "reader_thread_busy_seconds": round(gen_busy["s"], 2),
+            "transfer_wait_seconds": round(transfer_wait_s, 3),
+            "host_to_device_bytes": bytes_h2d,
+            "h2d_gbytes_per_s_effective": round(
+                bytes_h2d / pipeline_s / 1e9, 2
+            ),
+            "overlap_efficiency": round(
+                1.0 - transfer_wait_s / pipeline_s, 3
+            ),
+            "rows_retrieved_query0": rows_retrieved,
             "referee_parity_all_chunks": parity_ok,
             "rows_matched_total": int(totals.sum()),
             "row_queries_per_s": int(tpu_rowq_per_s),
             "cpu_row_queries_per_s": int(cpu_rowq_per_s),
-            "note": "chunks generated on-device (no host transfer); each "
-                    "chunk scanned against all Q queries in one fused pass",
+            "note": "reader thread materializes host chunks into a bounded "
+                    "queue; main loop double-buffers device_put + fused "
+                    "scan + row retrieval; wall clock includes every "
+                    "transfer (nothing subtracted); parity referee ran as "
+                    "a separate untimed pass over every chunk",
         },
     }
 

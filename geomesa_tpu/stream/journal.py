@@ -1,0 +1,259 @@
+"""Durable file-journal message bus: the streaming tier's cross-process /
+crash-survival transport.
+
+Role parity: the reference's streaming datastore rides an EXTERNAL broker —
+messages survive writer crashes and are consumed from other processes/hosts
+(``geomesa-kafka/.../data/KafkaDataStore.scala:52``; offsets via
+``ZookeeperOffsetManager.scala:160``). The in-process
+:class:`~geomesa_tpu.stream.datastore.MessageBus` dies with the process;
+``JournalBus`` keeps the SAME bus interface (``publish``/``poll``/
+``subscribe``/``end_offset``) over an append-only length-prefixed log per
+topic on a shared filesystem.
+
+Crash safety uses a COMMIT OFFSET sidecar per topic (the Zookeeper-offset
+role collapsed to a file): readers only parse bytes below the committed
+size, and a writer — under the append lock — truncates any torn bytes a
+killed predecessor left past the commit before appending. A reader can
+therefore never misframe the stream, and a writer restart loses at most
+the single record whose commit never landed:
+
+- **Durable**: the record append and the commit-offset update happen under
+  an advisory ``fcntl`` lock; ``fsync=True`` forces both to stable storage
+  per publish.
+- **Cross-process**: appends serialize via the lock; readers tail the
+  committed prefix independently, each building its own per-partition
+  index (the partition comes from the recorded key hash, so every reader
+  agrees on assignment regardless of when it attached).
+- **Restartable**: a writer that crashes and reopens repairs the tail and
+  continues; readers see a contiguous, gap-free, duplicate-free log.
+
+Format per record: ``<u32 payload_len><u8 barrier><i64 key_hash><payload>``.
+A barrier record (Clear) belongs to EVERY partition, matching the
+in-process bus's rendezvous semantics.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import struct
+import threading
+import zlib
+from typing import Callable
+
+__all__ = ["JournalBus"]
+
+_HEADER = struct.Struct("<IBq")
+_COMMIT = struct.Struct("<Q")
+
+
+def _key_hash(key: str) -> int:
+    """Stable across processes (``hash()`` is salted per interpreter)."""
+    return zlib.crc32(key.encode("utf-8")) if key else 0
+
+
+class JournalBus:
+    """Append-only file journal per topic with the MessageBus interface."""
+
+    def __init__(self, root: str, partitions: int = 4, fsync: bool = False,
+                 poll_interval_s: float = 0.01):
+        self.root = root
+        self.partitions = partitions
+        self.fsync = fsync
+        self.poll_interval_s = poll_interval_s
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        # reader-side state per topic: committed-scan position, per-partition
+        # payload index, and the total-order log feeding push subscribers —
+        # all grown INCREMENTALLY (one pass per new committed byte)
+        self._scan_pos: dict[str, int] = {}
+        self._plogs: dict[str, list[list[bytes]]] = {}
+        self._tlogs: dict[str, list[bytes]] = {}
+        self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
+        self._sub_offsets: dict[str, int] = {}  # tailer dispatch cursor
+        self._tailer: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- paths ---------------------------------------------------------------
+    def _safe(self, topic: str) -> str:
+        return "".join(c if c.isalnum() or c in "._-" else "_" for c in topic)
+
+    def _log_path(self, topic: str) -> str:
+        return os.path.join(self.root, f"{self._safe(topic)}.log")
+
+    def _commit_path(self, topic: str) -> str:
+        return os.path.join(self.root, f"{self._safe(topic)}.commit")
+
+    def _read_commit(self, topic: str) -> int:
+        try:
+            with open(self._commit_path(topic), "rb") as f:
+                raw = f.read(_COMMIT.size)
+            if len(raw) == _COMMIT.size:
+                return _COMMIT.unpack(raw)[0]
+        except OSError:
+            pass
+        return 0
+
+    def create_topic(self, topic: str) -> None:
+        path = self._log_path(topic)
+        if not os.path.exists(path):
+            open(path, "ab").close()
+        with self._lock:
+            self._plogs.setdefault(
+                topic, [[] for _ in range(self.partitions)]
+            )
+            self._tlogs.setdefault(topic, [])
+            self._scan_pos.setdefault(topic, 0)
+
+    # -- write side ----------------------------------------------------------
+    def publish(self, topic: str, key: str, data: bytes,
+                barrier: bool = False) -> None:
+        self.create_topic(topic)
+        rec = _HEADER.pack(len(data), 1 if barrier else 0, _key_hash(key)) + data
+        path = self._log_path(topic)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                    break
+                except OSError as e:  # pragma: no cover — EINTR retry
+                    if e.errno != errno.EINTR:
+                        raise
+            committed = self._read_commit(topic)
+            size = os.fstat(fd).st_size
+            if size > committed:
+                # torn bytes from a writer killed mid-append: repair under
+                # the lock so the new record starts at the commit boundary
+                os.ftruncate(fd, committed)
+                size = committed
+            os.lseek(fd, 0, os.SEEK_END)
+            os.write(fd, rec)
+            if self.fsync:
+                os.fsync(fd)
+            # commit AFTER the record is fully (and, with fsync, durably)
+            # in the log — readers never parse past this offset
+            cfd = os.open(
+                self._commit_path(topic), os.O_CREAT | os.O_WRONLY, 0o644
+            )
+            try:
+                os.write(cfd, _COMMIT.pack(size + len(rec)))
+                if self.fsync:
+                    os.fsync(cfd)
+            finally:
+                os.close(cfd)
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # -- read side -----------------------------------------------------------
+    def _refresh(self, topic: str) -> None:
+        """Parse newly COMMITTED bytes into the per-partition and
+        total-order indexes — incremental, one pass per new byte."""
+        self.create_topic(topic)
+        with self._lock:
+            pos = self._scan_pos[topic]
+            committed = self._read_commit(topic)
+            if committed <= pos:
+                return
+            try:
+                with open(self._log_path(topic), "rb") as f:
+                    f.seek(pos)
+                    buf = f.read(committed - pos)
+            except OSError:
+                return
+            plog = self._plogs[topic]
+            tlog = self._tlogs[topic]
+            off = 0
+            while len(buf) - off >= _HEADER.size:
+                ln, barrier, kh = _HEADER.unpack_from(buf, off)
+                end = off + _HEADER.size + ln
+                if end > len(buf):
+                    break  # commit mid-record cannot happen; defensive
+                payload = buf[off + _HEADER.size : end]
+                if barrier:
+                    for p in range(self.partitions):
+                        plog[p].append(payload)
+                else:
+                    plog[kh % self.partitions].append(payload)
+                tlog.append(payload)
+                off = end
+            self._scan_pos[topic] = pos + off
+
+    def poll(self, topic: str, partition: int, offset: int, max_n: int = 256):
+        """Messages [offset, offset+max_n) of one partition's log."""
+        self._refresh(topic)
+        with self._lock:
+            log = self._plogs[topic][partition]
+            return log[offset : offset + max_n]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        self._refresh(topic)
+        with self._lock:
+            return len(self._plogs[topic][partition])
+
+    def topic_size(self, topic: str) -> int:
+        self._refresh(topic)
+        with self._lock:
+            return len(self._tlogs.get(topic, []))
+
+    # -- push subscribers (tailer thread dispatches in total order) ----------
+    def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
+        """Register a consumer: the full backlog (offset 0) replays to the
+        NEW callback first, then the background tailer pushes new records.
+
+        Replay and registration happen under the bus lock — mirroring the
+        in-process bus's no-gap no-reorder contract — so the tailer can
+        neither double-deliver the backlog nor slip a record between
+        replay and registration.
+        """
+        self.create_topic(topic)
+        with self._lock:
+            self._refresh(topic)
+            backlog = list(self._tlogs[topic])
+            cursor = self._sub_offsets.setdefault(topic, 0)
+            # the tailer owns [cursor:] for ALL subscribers (including this
+            # one); the new callback catches up on [0:cursor] here
+            for data in backlog[:cursor]:
+                callback(data)
+            self._subscribers.setdefault(topic, []).append(callback)
+            if self._tailer is None:
+                self._tailer = threading.Thread(
+                    target=self._tail_loop, daemon=True,
+                    name="geomesa-journal-tailer",
+                )
+                self._tailer.start()
+
+    def _tail_loop(self) -> None:
+        while not self._stop.is_set():
+            dispatched = 0
+            with self._lock:
+                topics = list(self._subscribers)
+            for topic in topics:
+                self._refresh(topic)
+                with self._lock:
+                    log = self._tlogs[topic]
+                    start = self._sub_offsets.get(topic, 0)
+                    batch = log[start:]
+                    subs = list(self._subscribers.get(topic, []))
+                    self._sub_offsets[topic] = len(log)
+                for data in batch:
+                    for cb in subs:
+                        try:
+                            cb(data)
+                        except Exception:  # noqa: BLE001 — one bad consumer
+                            # must not kill delivery for every topic; the
+                            # record is consumed (at-most-once for the
+                            # failing callback, like the in-process bus's
+                            # synchronous dispatch raising to the publisher)
+                            pass
+                    dispatched += 1
+            if dispatched == 0:
+                self._stop.wait(self.poll_interval_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._tailer is not None:
+            self._tailer.join(timeout=5.0)
+            self._tailer = None

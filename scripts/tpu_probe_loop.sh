@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 minutes; on first success write a witness file.
+while true; do
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  timeout 180 python -c "
+import jax, time, json
+t0=time.time()
+import jax.numpy as jnp
+v = jax.jit(lambda x: (x+1).sum())(jnp.arange(128))
+assert int(v.block_until_ready())==8256
+print(json.dumps({'backend': jax.default_backend(), 'devices': jax.device_count(), 'probe_s': round(time.time()-t0,1)}))
+" > /tmp/tpu_probe_out.$$ 2>/tmp/tpu_probe_err.$$
+  rc=$?
+  if [ $rc -eq 0 ] && { grep -q '"backend": "tpu"' /tmp/tpu_probe_out.$$ 2>/dev/null || grep -q '"backend": "axon"' /tmp/tpu_probe_out.$$ 2>/dev/null; }; then
+    cp /tmp/tpu_probe_out.$$ /root/repo/artifacts/tpu_probe_ok_${ts}.json
+    echo "$ts PROBE OK: $(cat /tmp/tpu_probe_out.$$)" >> /root/repo/artifacts/tpu_probe.log
+    rm -f /tmp/tpu_probe_out.$$ /tmp/tpu_probe_err.$$
+    exit 0
+  fi
+  echo "$ts probe rc=$rc $(tail -c 200 /tmp/tpu_probe_out.$$ 2>/dev/null) $(tail -c 200 /tmp/tpu_probe_err.$$ 2>/dev/null | tr '\n' ' ')" >> /root/repo/artifacts/tpu_probe.log
+  rm -f /tmp/tpu_probe_out.$$ /tmp/tpu_probe_err.$$
+  sleep 300
+done
