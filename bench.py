@@ -989,7 +989,7 @@ def bench_stream_1b():
         rows_retrieved += len(np.asarray(topi)[: int(nret)])
         if nxt is not None:
             t0 = time.perf_counter()
-            jax.block_until_ready(nxt[0])
+            jax.block_until_ready(nxt)  # ALL four columns, not just x
             transfer_wait_s += time.perf_counter() - t0
         cur = nxt
     pipeline_s = time.perf_counter() - t_pipe

@@ -29,7 +29,12 @@ class Z2Index(FeatureIndex):
 
     def __init__(self, sft: FeatureType):
         super().__init__(sft)
-        self.sfc = Z2SFC()
+        if sft.index_layout == "legacy":
+            from geomesa_tpu.curve.legacy import LegacyZ2SFC
+
+            self.sfc = LegacyZ2SFC()
+        else:
+            self.sfc = Z2SFC()
         self.zs: np.ndarray | None = None
 
     @classmethod

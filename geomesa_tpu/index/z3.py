@@ -101,7 +101,12 @@ class Z3Index(FeatureIndex):
         super().__init__(sft)
         self.period = sft.z3_interval
         self.binned = BinnedTime(self.period)
-        self.sfc = z3_sfc(self.period)
+        if sft.index_layout == "legacy":
+            from geomesa_tpu.curve.legacy import legacy_z3_sfc
+
+            self.sfc = legacy_z3_sfc(self.period)
+        else:
+            self.sfc = z3_sfc(self.period)
         # build products
         self.bins: np.ndarray | None = None  # sorted (n,) int32
         self.zs: np.ndarray | None = None  # sorted (n,) uint64

@@ -153,6 +153,18 @@ class FeatureType:
         return int(self.user_data.get("geomesa.xz.precision", 12))
 
     @property
+    def index_layout(self) -> str:
+        """Index-layout version (``geomesa.index.layout``): ``current``
+        (default) or ``legacy`` — selects the curve generation, the
+        reference's legacy key-space role
+        (``geomesa-index-api/.../index/z3/legacy/``,
+        ``AttributeIndexV7.scala``); persistence stamps it in the catalog
+        manifest so a reload plans with the math the data was indexed
+        under."""
+        v = str(self.user_data.get("geomesa.index.layout", "current"))
+        return "legacy" if v in ("legacy", "1") else "current"
+
+    @property
     def shards(self) -> int:
         """Hash-shard count for hot-spot spreading (``geomesa.z.splits``)."""
         return int(self.user_data.get("geomesa.z.splits", 4))

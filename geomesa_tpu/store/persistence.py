@@ -29,7 +29,14 @@ from geomesa_tpu.io.arrow import from_arrow, to_arrow
 from geomesa_tpu.schema.sft import parse_spec
 
 MANIFEST = "manifest.json"
-FORMAT_VERSION = 1
+# catalog format history (load() accepts every version listed):
+#   1 — rounds 1-2: spec + count + scheme + files
+#   2 — adds per-type "index_layout" stamps ("current" | "legacy") so a
+#       reload plans with the same curve generation the data was indexed
+#       under (the reference's legacy key-space role,
+#       geomesa-index-api/.../index/z3/legacy/, AttributeIndexV7.scala:1)
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 
 def save(
@@ -165,6 +172,7 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
             "spec": st.sft.to_spec(),
             "count": count,
             "scheme": scheme_spec,
+            "index_layout": st.sft.index_layout,
             "files": files,
         }
 
@@ -193,6 +201,31 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
     return manifest
 
 
+def upgrade(path: str) -> int:
+    """Migrate a catalog manifest to the CURRENT format version in place.
+
+    The data files are untouched — only the manifest is rewritten (v1 → v2
+    adds per-type ``index_layout`` stamps derived from each spec's
+    user-data). Returns the version migrated FROM. Atomic: the new manifest
+    replaces the old via rename, so a crash leaves a loadable catalog.
+    """
+    root = Path(path)
+    manifest = json.loads((root / MANIFEST).read_text())
+    version = int(manifest.get("version", 0))
+    if version == FORMAT_VERSION:
+        return version
+    if version not in SUPPORTED_VERSIONS:
+        raise ValueError(f"cannot upgrade catalog version {version}")
+    for name, meta in manifest["types"].items():
+        if "index_layout" not in meta:
+            meta["index_layout"] = parse_spec(name, meta["spec"]).index_layout
+    manifest["version"] = FORMAT_VERSION
+    mtmp = root / (MANIFEST + ".tmp")
+    mtmp.write_text(json.dumps(manifest, indent=2))
+    os.replace(mtmp, root / MANIFEST)
+    return version
+
+
 def load(
     path: str,
     backend: str = "tpu",
@@ -217,12 +250,18 @@ def load(
 
     root = Path(path)
     manifest = json.loads((root / MANIFEST).read_text())
-    if manifest.get("version") != FORMAT_VERSION:
+    if manifest.get("version") not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported catalog version: {manifest.get('version')}")
     file_format = manifest.get("format", "parquet")
     ds = DataStore(backend=backend)
     for name, meta in manifest["types"].items():
         sft = parse_spec(name, meta["spec"])
+        # v2 index-layout stamp wins over (and back-fills) the spec's
+        # user-data, so the reload plans with the curves the data was
+        # indexed under; v1 manifests predate legacy layouts → current
+        layout = meta.get("index_layout")
+        if layout == "legacy":
+            sft.user_data["geomesa.index.layout"] = "legacy"
         pruner = None
         extraction = None
         if filter is not None:

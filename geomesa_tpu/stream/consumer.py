@@ -60,15 +60,21 @@ class ThreadedConsumer:
             t.start()
 
     def _run(self, partitions: list[int]) -> None:
+        trim = getattr(self.bus, "trim", None)  # durable buses free applied
         while not self._stop.is_set():
             drained = 0
             for p in partitions:
                 batch = self.bus.poll(self.topic, p, self._offsets[p], max_n=256)
+                applied = 0
                 for data in batch:
                     if self.apply(data, p) is False:
                         break  # stalled at a barrier; redeliver next poll
                     self._offsets[p] += 1
-                    drained += 1
+                    applied += 1
+                drained += applied
+                if applied and trim is not None:
+                    # bound the bus's in-memory window to unapplied messages
+                    trim(self.topic, p, self._offsets[p])
             if drained == 0:
                 self._stop.wait(self.poll_interval_s)
 

@@ -221,6 +221,11 @@ class StreamingDataStore:
         for c in self._consumers.values():
             c.close()
         self._consumers.clear()
+        # a bus with background machinery (JournalBus tailer) shuts down
+        # with the store; the in-process MessageBus has no close
+        closer = getattr(self.bus, "close", None)
+        if closer is not None:
+            closer()
 
     def get_schema(self, name: str) -> FeatureType:
         return self._types[name]

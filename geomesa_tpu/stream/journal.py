@@ -69,7 +69,13 @@ class JournalBus:
         # all grown INCREMENTALLY (one pass per new committed byte)
         self._scan_pos: dict[str, int] = {}
         self._plogs: dict[str, list[list[bytes]]] = {}
+        self._pbase: dict[str, list[int]] = {}  # trimmed-prefix offsets
+        # total-order log: only the not-yet-dispatched window stays in
+        # memory (_tbase + len(_tlogs) == _tcount always); poll-only
+        # readers keep it empty
         self._tlogs: dict[str, list[bytes]] = {}
+        self._tbase: dict[str, int] = {}
+        self._tcount: dict[str, int] = {}
         self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
         self._sub_offsets: dict[str, int] = {}  # tailer dispatch cursor
         self._tailer: threading.Thread | None = None
@@ -77,7 +83,12 @@ class JournalBus:
 
     # -- paths ---------------------------------------------------------------
     def _safe(self, topic: str) -> str:
-        return "".join(c if c.isalnum() or c in "._-" else "_" for c in topic)
+        # unambiguous escaping: distinct topics can never share a log file
+        # ("evt:1" vs "evt_1"); "_" escapes itself so the mapping inverts
+        return "".join(
+            c if c.isalnum() or c in ".-" else f"_{ord(c):02x}"
+            for c in topic
+        )
 
     def _log_path(self, topic: str) -> str:
         return os.path.join(self.root, f"{self._safe(topic)}.log")
@@ -85,7 +96,11 @@ class JournalBus:
     def _commit_path(self, topic: str) -> str:
         return os.path.join(self.root, f"{self._safe(topic)}.commit")
 
-    def _read_commit(self, topic: str) -> int:
+    def _read_commit(self, topic: str) -> int | None:
+        """Committed byte offset, or None when the sidecar is missing or
+        unreadable — callers must NOT treat None as 0: truncating a
+        non-empty log because its sidecar was lost would destroy committed
+        history (the log, not the sidecar, is the source of truth then)."""
         try:
             with open(self._commit_path(topic), "rb") as f:
                 raw = f.read(_COMMIT.size)
@@ -93,7 +108,38 @@ class JournalBus:
                 return _COMMIT.unpack(raw)[0]
         except OSError:
             pass
-        return 0
+        return None
+
+    def _scan_framed_prefix(self, topic: str, size: int) -> int:
+        """Longest well-framed byte prefix of the log — the commit-offset
+        recovery path when the sidecar is lost."""
+        try:
+            with open(self._log_path(topic), "rb") as f:
+                buf = f.read(size)
+        except OSError:
+            return 0
+        off = 0
+        while len(buf) - off >= _HEADER.size:
+            ln, _b, _k = _HEADER.unpack_from(buf, off)
+            end = off + _HEADER.size + ln
+            if end > len(buf):
+                break
+            off = end
+        return off
+
+    def _write_commit(self, topic: str, value: int) -> None:
+        """Atomic sidecar update (write-temp + rename): lock-free readers
+        can never observe a torn 8-byte value."""
+        path = self._commit_path(topic)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            os.write(fd, _COMMIT.pack(value))
+            if self.fsync:
+                os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
 
     def create_topic(self, topic: str) -> None:
         path = self._log_path(topic)
@@ -103,7 +149,10 @@ class JournalBus:
             self._plogs.setdefault(
                 topic, [[] for _ in range(self.partitions)]
             )
+            self._pbase.setdefault(topic, [0] * self.partitions)
             self._tlogs.setdefault(topic, [])
+            self._tbase.setdefault(topic, 0)
+            self._tcount.setdefault(topic, 0)
             self._scan_pos.setdefault(topic, 0)
 
     # -- write side ----------------------------------------------------------
@@ -123,6 +172,10 @@ class JournalBus:
                         raise
             committed = self._read_commit(topic)
             size = os.fstat(fd).st_size
+            if committed is None:
+                # lost sidecar: recover from the log itself (never assume
+                # 0 — that would truncate committed history away)
+                committed = self._scan_framed_prefix(topic, size)
             if size > committed:
                 # torn bytes from a writer killed mid-append: repair under
                 # the lock so the new record starts at the commit boundary
@@ -134,15 +187,7 @@ class JournalBus:
                 os.fsync(fd)
             # commit AFTER the record is fully (and, with fsync, durably)
             # in the log — readers never parse past this offset
-            cfd = os.open(
-                self._commit_path(topic), os.O_CREAT | os.O_WRONLY, 0o644
-            )
-            try:
-                os.write(cfd, _COMMIT.pack(size + len(rec)))
-                if self.fsync:
-                    os.fsync(cfd)
-            finally:
-                os.close(cfd)
+            self._write_commit(topic, size + len(rec))
         finally:
             fcntl.flock(fd, fcntl.LOCK_UN)
             os.close(fd)
@@ -155,6 +200,13 @@ class JournalBus:
         with self._lock:
             pos = self._scan_pos[topic]
             committed = self._read_commit(topic)
+            if committed is None:
+                # lost sidecar: fall back to the longest well-framed prefix
+                try:
+                    size = os.path.getsize(self._log_path(topic))
+                except OSError:
+                    return
+                committed = self._scan_framed_prefix(topic, size)
             if committed <= pos:
                 return
             try:
@@ -165,6 +217,7 @@ class JournalBus:
                 return
             plog = self._plogs[topic]
             tlog = self._tlogs[topic]
+            has_subs = bool(self._subscribers.get(topic))
             off = 0
             while len(buf) - off >= _HEADER.size:
                 ln, barrier, kh = _HEADER.unpack_from(buf, off)
@@ -177,26 +230,54 @@ class JournalBus:
                         plog[p].append(payload)
                 else:
                     plog[kh % self.partitions].append(payload)
-                tlog.append(payload)
+                # total-order window only buffers for push subscribers;
+                # poll-only readers keep it empty (bounded memory)
+                if has_subs:
+                    tlog.append(payload)
+                else:
+                    self._tbase[topic] += 1
+                self._tcount[topic] += 1
                 off = end
             self._scan_pos[topic] = pos + off
 
     def poll(self, topic: str, partition: int, offset: int, max_n: int = 256):
-        """Messages [offset, offset+max_n) of one partition's log."""
+        """Messages [offset, offset+max_n) of one partition's log. Offsets
+        below a trimmed prefix (see :meth:`trim`) yield from the first
+        retained message."""
         self._refresh(topic)
         with self._lock:
+            base = self._pbase[topic][partition]
             log = self._plogs[topic][partition]
-            return log[offset : offset + max_n]
+            lo = max(offset - base, 0)
+            return log[lo : lo + max_n]
 
     def end_offset(self, topic: str, partition: int) -> int:
         self._refresh(topic)
         with self._lock:
-            return len(self._plogs[topic][partition])
+            return self._pbase[topic][partition] + len(
+                self._plogs[topic][partition]
+            )
 
     def topic_size(self, topic: str) -> int:
         self._refresh(topic)
         with self._lock:
-            return len(self._tlogs.get(topic, []))
+            return self._tcount.get(topic, 0)
+
+    def trim(self, topic: str, partition: int, upto: int) -> int:
+        """Release THIS READER's memory for partition messages below
+        ``upto`` (a consumed offset). The on-disk journal is untouched —
+        durability and late-attaching readers are unaffected; only this
+        process's replay ability for the trimmed prefix goes away. A
+        long-running consumer calls this with its applied offset to bound
+        resident memory. Returns the messages released."""
+        self.create_topic(topic)
+        with self._lock:
+            base = self._pbase[topic][partition]
+            drop = min(max(upto - base, 0), len(self._plogs[topic][partition]))
+            if drop:
+                del self._plogs[topic][partition][:drop]
+                self._pbase[topic][partition] = base + drop
+            return drop
 
     # -- push subscribers (tailer thread dispatches in total order) ----------
     def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
@@ -206,38 +287,87 @@ class JournalBus:
         Replay and registration happen under the bus lock — mirroring the
         in-process bus's no-gap no-reorder contract — so the tailer can
         neither double-deliver the backlog nor slip a record between
-        replay and registration.
+        replay and registration. Already-dispatched records the tailer
+        trimmed from memory replay from the journal FILE.
         """
         self.create_topic(topic)
         with self._lock:
             self._refresh(topic)
-            backlog = list(self._tlogs[topic])
-            cursor = self._sub_offsets.setdefault(topic, 0)
+            total = self._tcount[topic]
+            first = topic not in self._sub_offsets
             # the tailer owns [cursor:] for ALL subscribers (including this
-            # one); the new callback catches up on [0:cursor] here
-            for data in backlog[:cursor]:
-                callback(data)
+            # one); the new callback catches up on [0:cursor] here — from
+            # disk for any part no longer buffered in memory. The FIRST
+            # subscriber catches up on the whole history (records parsed
+            # before any subscriber existed were never buffered).
+            cursor = total if first else self._sub_offsets[topic]
+            tbase = self._tbase[topic]
+            if cursor > 0:
+                if tbase > 0:
+                    backlog = self._disk_payloads(topic, cursor)
+                else:
+                    backlog = self._tlogs[topic][:cursor]
+                for data in backlog:
+                    callback(data)
+            if first:
+                self._sub_offsets[topic] = total
+                del self._tlogs[topic][: max(total - tbase, 0)]
+                self._tbase[topic] = total
             self._subscribers.setdefault(topic, []).append(callback)
             if self._tailer is None:
+                if self._stop.is_set():
+                    self._stop = threading.Event()  # bus reused after close
                 self._tailer = threading.Thread(
                     target=self._tail_loop, daemon=True,
                     name="geomesa-journal-tailer",
                 )
                 self._tailer.start()
 
+    def _disk_payloads(self, topic: str, first_n: int) -> list[bytes]:
+        """First ``first_n`` payloads re-read from the committed journal
+        prefix (late-subscriber replay after the in-memory log trimmed)."""
+        committed = self._read_commit(topic)
+        try:
+            size = os.path.getsize(self._log_path(topic))
+        except OSError:
+            return []
+        if committed is None:
+            committed = self._scan_framed_prefix(topic, size)
+        try:
+            with open(self._log_path(topic), "rb") as f:
+                buf = f.read(min(committed, size))
+        except OSError:
+            return []
+        out: list[bytes] = []
+        off = 0
+        while len(out) < first_n and len(buf) - off >= _HEADER.size:
+            ln, _b, _k = _HEADER.unpack_from(buf, off)
+            end = off + _HEADER.size + ln
+            if end > len(buf):
+                break
+            out.append(buf[off + _HEADER.size : end])
+            off = end
+        return out
+
     def _tail_loop(self) -> None:
-        while not self._stop.is_set():
+        stop = self._stop
+        while not stop.is_set():
             dispatched = 0
             with self._lock:
                 topics = list(self._subscribers)
             for topic in topics:
                 self._refresh(topic)
                 with self._lock:
+                    tbase = self._tbase[topic]
                     log = self._tlogs[topic]
                     start = self._sub_offsets.get(topic, 0)
-                    batch = log[start:]
+                    batch = log[max(start - tbase, 0):]
                     subs = list(self._subscribers.get(topic, []))
-                    self._sub_offsets[topic] = len(log)
+                    self._sub_offsets[topic] = tbase + len(log)
+                    # dispatched records leave memory (steady-state bound);
+                    # late subscribers replay them from disk
+                    del log[: max(start - tbase, 0) + len(batch)]
+                    self._tbase[topic] = self._sub_offsets[topic]
                 for data in batch:
                     for cb in subs:
                         try:
@@ -250,7 +380,7 @@ class JournalBus:
                             pass
                     dispatched += 1
             if dispatched == 0:
-                self._stop.wait(self.poll_interval_s)
+                stop.wait(self.poll_interval_s)
 
     def close(self) -> None:
         self._stop.set()

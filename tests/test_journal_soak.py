@@ -102,16 +102,18 @@ class TestJournalSoak:
         assert fids == {str(i) for i in range(TOTAL)}
 
         # 4) no duplication at the JOURNAL level: every fid appended once
-        #    (the cache would silently dedupe, so check the log itself)
+        #    (the cache would silently dedupe, so check the log itself with
+        #    a FRESH bus — the consumer trimmed the reader bus's window)
         from geomesa_tpu.schema.sft import parse_spec
         from geomesa_tpu.stream.messages import GeoMessageSerializer
 
         ser = GeoMessageSerializer(parse_spec(
             "evt", "name:String,dtg:Date,*geom:Point"
         ))
+        audit_bus = JournalBus(root, partitions=4)
         seen: dict[str, int] = {}
-        for p in range(reader_bus.partitions):
-            for data in reader_bus.poll("geomesa-evt", p, 0, max_n=10**9):
+        for p in range(audit_bus.partitions):
+            for data in audit_bus.poll("geomesa-evt", p, 0, max_n=10**9):
                 fid = ser.deserialize(data).fid
                 seen[fid] = seen.get(fid, 0) + 1
         dups = {f: c for f, c in seen.items() if c != 1}
@@ -148,6 +150,56 @@ class TestJournalSoak:
             w.wait(timeout=60)
             ds.close()
         assert {s.fid for s in ds.cache("evt").states()} == {str(i) for i in range(TOTAL)}
+
+    def test_lost_commit_sidecar_recovers_not_truncates(self, tmp_path):
+        """A missing .commit sidecar must NEVER be read as 'commit 0' — a
+        publish after sidecar loss recovers the framed prefix from the log
+        instead of truncating committed history away."""
+        root = str(tmp_path / "j4")
+        bus = JournalBus(root, partitions=2)
+        for i in range(10):
+            bus.publish("t", str(i), f"msg{i}".encode())
+        os.remove(bus._commit_path("t"))
+        # readers still see everything (framed-prefix fallback)
+        fresh = JournalBus(root, partitions=2)
+        assert fresh.topic_size("t") == 10
+        # a writer restart publishes without destroying history
+        bus2 = JournalBus(root, partitions=2)
+        bus2.publish("t", "new", b"msg-new")
+        assert JournalBus(root, partitions=2).topic_size("t") == 11
+
+    def test_topic_names_never_collide(self, tmp_path):
+        root = str(tmp_path / "j5")
+        bus = JournalBus(root, partitions=1)
+        bus.publish("evt:1", "a", b"colon")
+        bus.publish("evt_1", "a", b"underscore")
+        assert bus._log_path("evt:1") != bus._log_path("evt_1")
+        assert bus.poll("evt:1", 0, 0, 10) == [b"colon"]
+        assert bus.poll("evt_1", 0, 0, 10) == [b"underscore"]
+
+    def test_bus_reusable_after_close_and_trim_bounds_memory(self, tmp_path):
+        root = str(tmp_path / "j6")
+        bus = JournalBus(root, partitions=1, poll_interval_s=0.005)
+        got: list[bytes] = []
+        bus.publish("t", "a", b"one")
+        bus.subscribe("t", got.append)
+        bus.close()
+        # a NEW subscriber after close restarts the tailer and still gets
+        # the full backlog plus new records
+        got2: list[bytes] = []
+        bus.subscribe("t", got2.append)
+        bus.publish("t", "b", b"two")
+        deadline = time.monotonic() + 10
+        while len(got2) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got2 == [b"one", b"two"], got2
+        bus.close()
+        # trim releases the polled window; the journal file keeps everything
+        bus3 = JournalBus(root, partitions=1)
+        assert len(bus3.poll("t", 0, 0, 10)) == 2
+        bus3.trim("t", 0, 2)
+        assert bus3.poll("t", 0, 0, 10) == []  # this reader released it
+        assert len(JournalBus(root, partitions=1).poll("t", 0, 0, 10)) == 2
 
     def test_journal_bus_torn_tail_repaired(self, tmp_path):
         """Torn bytes past the commit offset (writer death mid-append) are
