@@ -157,6 +157,48 @@ class MetricsRegistry:
                 for k, v in vals.items():
                     fh.write(delimiter.join([str(ts), typ, name, k, str(v)]) + "\n")
 
+    # -- external network sinks (geomesa-metrics reporter-config role:
+    # MetricsConfig.scala wires Ganglia/Graphite/CloudWatch reporters from
+    # config; here the two wire protocols those sinks actually speak) ------
+    def push_graphite(self, host: str, port: int, prefix: str = "geomesa",
+                      timeout_s: float = 5.0) -> int:
+        """Push one snapshot to a Carbon/Graphite endpoint over TCP
+        (plaintext protocol — the ``GraphiteReporter`` network role).
+        Returns bytes sent; raises OSError on connection failure (callers
+        like :class:`PeriodicReporter` decide the retry policy)."""
+        import socket
+
+        payload = (self.report_graphite(prefix) + "\n").encode()
+        with socket.create_connection((host, port), timeout=timeout_s) as s:
+            s.sendall(payload)
+        return len(payload)
+
+    def push_statsd(self, host: str, port: int, prefix: str = "geomesa") -> int:
+        """Snapshot values as StatsD ``|g`` (gauge) UDP datagrams — the
+        ingestion path CloudWatch agent / gmond / Telegraf all accept.
+
+        Everything ships as a gauge of the CURRENT value: this registry's
+        counters are cumulative totals, and re-sending a total as a StatsD
+        ``|c`` increment every tick would make aggregators overcount a flat
+        counter forever (``|c`` is a per-flush-window delta). Fire-and-
+        forget (UDP); returns datagrams sent."""
+        import socket
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        n = 0
+        try:
+            for name, vals in self.snapshot().items():
+                for k, v in vals.items():
+                    if k == "type":
+                        continue
+                    sock.sendto(
+                        f"{prefix}.{name}.{k}:{v}|g".encode(), (host, port)
+                    )
+                    n += 1
+        finally:
+            sock.close()
+        return n
+
 
 class PeriodicReporter:
     """Background scheduled reporter (Dropwizard ``ScheduledReporter`` role).
@@ -212,3 +254,24 @@ class PeriodicReporter:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    @classmethod
+    def graphite(cls, registry: MetricsRegistry, host: str, port: int,
+                 interval_s: float = 60.0, prefix: str = "geomesa"):
+        """Scheduled Graphite network reporter (``MetricsConfig`` wiring
+        role): pushes every ``interval_s`` over TCP; connection failures
+        are tolerated per-tick (the loop's sink-error policy)."""
+        return cls(
+            registry, interval_s=interval_s,
+            fn=lambda reg: reg.push_graphite(host, port, prefix=prefix),
+        )
+
+    @classmethod
+    def statsd(cls, registry: MetricsRegistry, host: str, port: int,
+               interval_s: float = 60.0, prefix: str = "geomesa"):
+        """Scheduled StatsD (UDP) reporter — the CloudWatch-agent/gmond
+        ingestion path."""
+        return cls(
+            registry, interval_s=interval_s,
+            fn=lambda reg: reg.push_statsd(host, port, prefix=prefix),
+        )

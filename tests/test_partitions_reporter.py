@@ -158,3 +158,98 @@ class TestPeriodicReporter:
 
         with pytest.raises(ValueError):
             PeriodicReporter(MetricsRegistry(), path="a", fn=lambda r: None)
+
+
+class TestNetworkSinks:
+    """External metrics reporters (geomesa-metrics MetricsConfig role):
+    Graphite TCP plaintext and StatsD UDP against REAL local sockets."""
+
+    def test_push_graphite_tcp(self):
+        import socket
+        import threading
+
+        received = []
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def accept():
+            conn, _ = srv.accept()
+            buf = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            received.append(buf)
+            conn.close()
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        reg = MetricsRegistry()
+        reg.counter("store.writes").inc(7)
+        reg.gauge("hbm.util").set(0.5)
+        sent = reg.push_graphite("127.0.0.1", port, prefix="gm")
+        t.join(timeout=10)
+        srv.close()
+        assert sent > 0 and received
+        text = received[0].decode()
+        lines = [ln for ln in text.strip().splitlines()]
+        assert any(ln.startswith("gm.store.writes.count 7 ") for ln in lines)
+        assert any(ln.startswith("gm.hbm.util.value 0.5 ") for ln in lines)
+        # plaintext protocol: exactly three space-separated fields per line
+        assert all(len(ln.split(" ")) == 3 for ln in lines)
+
+    def test_push_statsd_udp(self):
+        import socket
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.settimeout(5.0)
+        port = srv.getsockname()[1]
+        reg = MetricsRegistry()
+        reg.counter("q.total").inc(3)
+        reg.gauge("circuit.open").set(1.0)
+        n = reg.push_statsd("127.0.0.1", port, prefix="gm")
+        grams = {srv.recv(1024).decode() for _ in range(n)}
+        srv.close()
+        # everything ships as a GAUGE of the current value: cumulative
+        # totals re-sent as |c would make aggregators overcount forever
+        assert "gm.q.total.count:3|g" in grams
+        assert "gm.circuit.open.value:1.0|g" in grams
+
+    def test_scheduled_graphite_reporter_tolerates_down_endpoint(self):
+        import socket
+        import threading
+
+        # endpoint down for the first ticks, then comes up: the loop keeps
+        # trying and eventually delivers
+        reg = MetricsRegistry()
+        reg.counter("z").inc()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        got = []
+
+        rep = PeriodicReporter.graphite(
+            reg, "127.0.0.1", port, interval_s=0.05, prefix="gm"
+        )
+        rep.start()
+        time.sleep(0.15)  # several failed connection attempts
+        srv.listen(1)
+
+        def accept():
+            try:
+                conn, _ = srv.accept()
+                got.append(conn.recv(65536))
+                conn.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        rep.stop()
+        srv.close()
+        assert got and b"gm.z.count" in got[0]
