@@ -206,6 +206,15 @@ def _get_feature(store, p: dict, auths):
         quoted = ",".join("'" + f.replace("'", "''") + "'" for f in fids)
         filters.append(f"IN ({quoted})")
     cql = " AND ".join(f"({f})" for f in filters) if filters else None
+    if cql is not None:
+        # validate NOW so a malformed cql_filter is a protocol error
+        # (ExceptionReport), not a generic JSON 400 from the dispatcher
+        from geomesa_tpu.filter.cql import parse as _parse_cql
+
+        try:
+            _parse_cql(cql)
+        except ValueError as e:
+            raise WfsError("InvalidParameterValue", f"bad filter: {e}") from e
 
     def _int_param(key):
         raw = p.get(key)
@@ -240,10 +249,17 @@ def _get_feature(store, p: dict, auths):
 
     if p.get("resulttype", "").lower() == "hits":
         # numberMatched is the TOTAL match count — paging params do not
-        # apply (WFS 2.0); prefer the stats fast path over materializing
+        # apply (WFS 2.0); prefer the stats fast path over materializing.
+        # The fast path is safe unless the SCHEMA labels features AND the
+        # caller is restricted (the _restricted_auths gate): store-wide
+        # counts would then include rows the caller cannot see.
         n = None
+        sft = store.get_schema(name)
+        restricted = auths is not None and (
+            (sft.user_data or {}).get("geomesa.vis.field")
+        )
         stats_count = getattr(store, "stats_count", None)
-        if stats_count is not None and auths is None:
+        if stats_count is not None and not restricted:
             try:
                 n = int(stats_count(name, cql, exact=True))
             except Exception:  # noqa: BLE001 — fall back to the query path
@@ -261,11 +277,23 @@ def _get_feature(store, p: dict, auths):
         filter=cql, limit=count, start_index=start,
         sort_by=(sort_by, descending) if sort_by else None, auths=auths,
     )
+    fmt = (p.get("outputformat") or "gml").lower()
+    if "json" in fmt:
+        wire = "geojson"
+    elif fmt in ("gml", "gml3", "gml32", "text/xml", "application/xml",
+                 "application/gml+xml", "text/xml; subtype=gml/3.1.1",
+                 "text/xml; subtype=gml/3.2"):
+        wire = "gml"
+    else:
+        # a client asking for an unsupported format must get a protocol
+        # error, never a silently different format
+        raise WfsError(
+            "InvalidParameterValue",
+            f"unsupported outputFormat {p.get('outputformat')!r} "
+            "(supported: GML 3, application/json)",
+        )
     r = store.query(name, q)
     from geomesa_tpu.web.formats import format_table
 
-    fmt = (p.get("outputformat") or "gml").lower()
-    payload, ctype = format_table(
-        r.table, "geojson" if "json" in fmt else "gml"
-    )
+    payload, ctype = format_table(r.table, wire)
     return 200, payload, ctype

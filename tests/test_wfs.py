@@ -180,6 +180,16 @@ class TestWfs:
             request="GetFeature", typeNames="evt", count="abc"
         )
         assert status == 400 and b"ExceptionReport" in body
+        # malformed CQL is a protocol error too
+        status, body, _ = self._call(
+            request="GetFeature", typeNames="evt", cql_filter="BBOX(geom,"
+        )
+        assert status == 400 and b"ExceptionReport" in body
+        # an unsupported outputFormat must error, never silently serve GML
+        status, body, _ = self._call(
+            request="GetFeature", typeNames="evt", outputFormat="shape-zip"
+        )
+        assert status == 400 and b"InvalidParameterValue" in body
 
     def test_visibility_auths_enforced(self):
         sft = parse_spec("sec", "name:String,vis:String,dtg:Date,*geom:Point")
