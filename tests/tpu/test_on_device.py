@@ -221,3 +221,104 @@ class TestCompiledMeshPath:
             oracle.query("trk", q).table.fids
         )
         assert tpu.metrics.counter("store.query.device_failovers").count == 0
+
+
+class TestRound3DevicePaths:
+    """Round-3 device machinery witnessed on hardware: the sample sort the
+    public compact path uses, the block-sparse join gather behind the SQL
+    mesh JOIN, and the TTL-masked live-store KNN."""
+
+    def test_device_sort_perm_on_hardware(self, rng):
+        from geomesa_tpu.parallel.mesh import make_mesh
+        from geomesa_tpu.store.device_ingest import device_sort_perm
+
+        keys = rng.integers(0, 2**62, 200_000, dtype=np.uint64)
+        perm = device_sort_perm(make_mesh(), keys)
+        np.testing.assert_array_equal(keys[perm], np.sort(keys))
+        # wide composite (bin, 63-bit key) with the low-bits tiebreak
+        bins = rng.integers(0, 6, 100_000).astype(np.int32)
+        z = rng.integers(0, 2**63 - 1, 100_000, dtype=np.uint64)
+        route = (bins.astype(np.uint64) << np.uint64(48)) | (z >> np.uint64(15))
+        tie = (z & np.uint64(0x7FFF)).astype(np.int32)
+        perm2 = device_sort_perm(make_mesh(), route, tie)
+        want = np.lexsort((z, bins))
+        np.testing.assert_array_equal(bins[perm2], bins[want])
+        np.testing.assert_array_equal(z[perm2], z[want])
+
+    def test_sql_mesh_join_on_hardware(self, rng):
+        from geomesa_tpu.geometry.types import Point, Polygon
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.sql.engine import sql
+        from geomesa_tpu.store.datastore import DataStore
+
+        n = 500_000
+        lon = rng.uniform(-60, 60, n)
+        lat = rng.uniform(-60, 60, n)
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", "name:String,*geom:Point")
+        ds.write(
+            "pts",
+            [{"name": f"p{i}", "geom": Point(float(lon[i]), float(lat[i]))}
+             for i in range(n)],
+            fids=[f"p{i}" for i in range(n)],
+        )
+        ds.create_schema("zones", "zone:String,*geom:Polygon")
+        polys = []
+        for k in range(8):
+            cx, cy = rng.uniform(-45, 45, 2)
+            ang = np.sort(rng.uniform(0, 2 * np.pi, 10))
+            rad = rng.uniform(3, 9, 10)
+            polys.append({
+                "zone": f"z{k}",
+                "geom": Polygon(np.stack(
+                    [cx + rad * np.cos(ang), cy + rad * np.sin(ang)], 1
+                )),
+            })
+        ds.write("zones", polys, fids=[f"z{k}" for k in range(8)])
+        import geomesa_tpu.process.join as pj
+
+        spy = {"n": 0}
+        real = pj.join_rows_device
+        pj.join_rows_device = lambda *a, **k: (
+            spy.__setitem__("n", spy["n"] + 1), real(*a, **k)
+        )[1]
+        try:
+            r = sql(ds, "SELECT a.name, b.zone FROM pts a JOIN zones b "
+                        "ON ST_Within(a.geom, b.geom)")
+        finally:
+            pj.join_rows_device = real
+        assert spy["n"] == 1, "join did not take the mesh path on hardware"
+        from geomesa_tpu.geometry import predicates as P
+
+        want = sum(
+            int(P.points_within_geom(lon, lat, z["geom"]).sum())
+            for z in polys
+        )
+        assert len(r) == want
+
+    def test_ttl_knn_on_hardware(self, rng):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.schema.sft import parse_spec
+        from geomesa_tpu.store.datastore import DataStore
+
+        t0 = 1_600_000_000_000
+        sft = parse_spec("kt", "dtg:Date,*geom:Point")
+        sft.user_data["geomesa.age.off"] = 3_600_000
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        n = 200_000
+        lon = rng.uniform(-100, 100, n)
+        lat = rng.uniform(-50, 50, n)
+        q = Point(10.0, 10.0)
+        recs = []
+        for i in range(n):
+            fresh = i % 2 == 0
+            g = (Point(float(lon[i]), float(lat[i])) if fresh
+                 else Point(q.x + 1e-5 * (i + 1), q.y))
+            recs.append({"dtg": t0 if fresh else t0 - 7_200_000, "geom": g})
+        ds.write("kt", recs, fids=[str(i) for i in range(n)])
+        ds.compact("kt")
+        res = knn_many(ds, "kt", [q], k=8, now_ms=t0 + 60_000)
+        got = set(res[0][0].fids.tolist())
+        assert not (got & {str(i) for i in range(n) if i % 2 == 1}), got
