@@ -247,7 +247,6 @@ class TestRound3DevicePaths:
 
     def test_sql_mesh_join_on_hardware(self, rng):
         from geomesa_tpu.geometry.types import Point, Polygon
-        from geomesa_tpu.schema.sft import parse_spec
         from geomesa_tpu.sql.engine import sql
         from geomesa_tpu.store.datastore import DataStore
 
@@ -277,17 +276,25 @@ class TestRound3DevicePaths:
         ds.write("zones", polys, fids=[f"z{k}" for k in range(8)])
         import geomesa_tpu.process.join as pj
 
-        spy = {"n": 0}
+        # the spy must record a RETURN, not just a call: the engine
+        # swallows device errors and falls back to the host join, which
+        # would produce identical rows and fake a witnessed mesh path
+        spy = {"returned": 0}
         real = pj.join_rows_device
-        pj.join_rows_device = lambda *a, **k: (
-            spy.__setitem__("n", spy["n"] + 1), real(*a, **k)
-        )[1]
+
+        def spied(*a, **k):
+            out = real(*a, **k)
+            spy["returned"] += 1
+            return out
+
+        pj.join_rows_device = spied
         try:
             r = sql(ds, "SELECT a.name, b.zone FROM pts a JOIN zones b "
                         "ON ST_Within(a.geom, b.geom)")
         finally:
             pj.join_rows_device = real
-        assert spy["n"] == 1, "join did not take the mesh path on hardware"
+        assert spy["returned"] == 1, "mesh join did not complete on hardware"
+        assert ds.metrics.counter("store.query.device_failovers").count == 0
         from geomesa_tpu.geometry import predicates as P
 
         want = sum(
@@ -321,4 +328,7 @@ class TestRound3DevicePaths:
         ds.compact("kt")
         res = knn_many(ds, "kt", [q], k=8, now_ms=t0 + 60_000)
         got = set(res[0][0].fids.tolist())
+        assert len(got) == 8  # full heap of FRESH neighbors, not empty
         assert not (got & {str(i) for i in range(n) if i % 2 == 1}), got
+        # the device path must have served this (no silent host fallback)
+        assert ds.metrics.counter("store.query.device_failovers").count == 0
