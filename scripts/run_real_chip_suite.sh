@@ -1,0 +1,68 @@
+#!/bin/bash
+# One-shot real-chip evidence capture: run the moment the relay tunnel is
+# healthy (see scripts/tpu_probe_loop.sh). Produces timestamped artifacts
+# for every item the round verdicts demand:
+#   1. compute probe witness
+#   2. on-device (Mosaic-compiled) kernel suite  -> artifacts/ + TPU_VALIDATION.md append
+#   3. device validation script output
+#   4. full bench sweep (driver mode)            -> artifacts/bench_tpu_<ts>.json
+#   5. config 7 at >=125M resident (HBM util)    -> artifacts/resident_tpu_<ts>.json
+#   6. config 8 out-of-core 1B                   -> artifacts/stream_tpu_<ts>.json
+# Each step commits its artifact immediately so a mid-run wedge cannot
+# zero the evidence. Never hard-kill this script mid-step: SIGINT only
+# (a SIGKILL mid-RPC orphans the relay session claim and wedges the chip).
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+mkdir -p artifacts
+
+step() {  # step <name> <timeout-s> <cmd...>
+  local name=$1 cap=$2; shift 2
+  echo "== $name =="
+  timeout --signal=INT --kill-after=30 "$cap" "$@" \
+    > "artifacts/${name}_${ts}.log" 2>&1
+  local rc=$?
+  echo "rc=$rc" >> "artifacts/${name}_${ts}.log"
+  git add "artifacts/${name}_${ts}."* 2>/dev/null
+  git commit -q -m "Real-chip artifact: ${name} (${ts})
+
+No-Verification-Needed: generated hardware-run artifact" || true
+  return $rc
+}
+
+# 1. probe: a real jitted compute, not device enumeration
+step probe 200 python -c "
+import jax, time, json
+t0=time.time()
+import jax.numpy as jnp
+v = jax.jit(lambda x: (x+1).sum())(jnp.arange(128))
+assert int(v.block_until_ready())==8256
+print(json.dumps({'backend': jax.default_backend(),
+                  'devices': jax.device_count(),
+                  'probe_s': round(time.time()-t0,1)}))
+" || { echo "tunnel not healthy; aborting"; exit 1; }
+
+# 2. compiled-kernel witness suite
+GEOMESA_TPU_DEVICE_TESTS=1 step on_device_suite 3600 \
+  python -m pytest tests/tpu/ -q -p no:cacheprovider
+
+# 3. device validation script (appends TPU_VALIDATION.md itself)
+step device_validation 1800 python scripts/device_validation.py
+
+# 4. full driver-mode sweep at real scale (budget-bounded)
+GEOMESA_BENCH_BUDGET_S=5400 step bench_sweep 6000 python bench.py
+cp BENCH_DETAIL.json "artifacts/bench_detail_${ts}.json" 2>/dev/null
+git add "artifacts/bench_detail_${ts}.json" BENCH_DETAIL.json 2>/dev/null
+git commit -q -m "Real-chip artifact: bench detail (${ts})
+
+No-Verification-Needed: generated hardware-run artifact" || true
+
+# 5. config 7 alone at full residency (the 1B / v5e-8 share)
+GEOMESA_BENCH_CONFIG=7 GEOMESA_BENCH_N=125000000 \
+  step resident_125m 3600 python bench.py
+
+# 6. config 8 alone at the 1B north-star total
+GEOMESA_BENCH_CONFIG=8 GEOMESA_BENCH_TOTAL=1000000000 \
+  step stream_1b 3600 python bench.py
+
+echo "real-chip suite complete: artifacts/*_${ts}.*"

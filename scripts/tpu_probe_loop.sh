@@ -15,6 +15,8 @@ print(json.dumps({'backend': jax.default_backend(), 'devices': jax.device_count(
     cp /tmp/tpu_probe_out.$$ /root/repo/artifacts/tpu_probe_ok_${ts}.json
     echo "$ts PROBE OK: $(cat /tmp/tpu_probe_out.$$)" >> /root/repo/artifacts/tpu_probe.log
     rm -f /tmp/tpu_probe_out.$$ /tmp/tpu_probe_err.$$
+    # tunnel is healthy: capture the full real-chip evidence suite NOW
+    /root/repo/scripts/run_real_chip_suite.sh >> /root/repo/artifacts/tpu_probe.log 2>&1
     exit 0
   fi
   echo "$ts probe rc=$rc $(tail -c 200 /tmp/tpu_probe_out.$$ 2>/dev/null) $(tail -c 200 /tmp/tpu_probe_err.$$ 2>/dev/null | tr '\n' ' ')" >> /root/repo/artifacts/tpu_probe.log
