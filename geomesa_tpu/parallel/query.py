@@ -267,6 +267,79 @@ def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
     return in_box & in_time & rows_valid[None, :]
 
 
+def make_batched_edge_gather_step(mesh: Mesh, capacity: int):
+    """Boundary-bucket candidate gather for EXACT batched counts.
+
+    The int-domain fused count is a superset of the f64 predicate only at
+    the quantization boundary: interior buckets of a closed f64 box are
+    f64-certain, so every divergent row sits in an EDGE bucket of some box
+    slot. This step compacts, per query × shard, the global sorted-order
+    positions of rows that pass the full int predicate AND sit on a spatial
+    edge bucket — the (tiny) candidate set the host re-tests in f64 to turn
+    the fused count exact (``count_many(loose=False)``; the counting-scan
+    analog of the select path's superset-refine + exact-residual contract).
+
+    fn(x, y, bins, offs, true_n, boxes (Q, B, 4), times (Q, T, 4)) →
+        (positions (Q, D, capacity) int32 global positions (-1 pad),
+         hits (Q, D) int32 TRUE per-shard edge counts). ``hits > capacity``
+    on any shard means that query's lanes truncated — callers fall back
+    to the exact per-query path for it.
+    """
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+        ),
+        out_specs=(P(QUERY_AXIS, DATA_AXIS, None), P(QUERY_AXIS, DATA_AXIS)),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, boxes, times):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+
+        def one(args):
+            boxes_q, times_q = args  # (B, 4), (T, 4)
+            on_edge = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(boxes_q.shape[0]):
+                b = boxes_q[k]
+                inside = (x >= b[0]) & (x <= b[1]) & (y >= b[2]) & (y <= b[3])
+                edge = (x == b[0]) | (x == b[1]) | (y == b[2]) | (y == b[3])
+                on_edge |= inside & edge
+            mask = on_edge & _batched_time_match(
+                bins, offs, times_q[None]
+            )[0] & rows_valid
+            dest = jnp.where(
+                mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, capacity
+            )
+            out = jnp.full((capacity,), -1, dtype=jnp.int32)
+            out = out.at[dest].set(
+                base + jnp.arange(n, dtype=jnp.int32), mode="drop"
+            )
+            # TRUE count (may exceed capacity): hits > capacity flags the
+            # truncated lanes so the host falls back for that query
+            return out, mask.sum(dtype=jnp.int32)
+
+        pos, hits = jax.lax.map(one, (boxes, times))
+        return pos[:, None, :], hits[:, None]
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_batched_edge_gather_step(mesh: Mesh, capacity: int):
+    return make_batched_edge_gather_step(mesh, capacity)
+
+
 def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     """Throughput path: Q queries full-scan counts, psum over data shards.
 

@@ -537,13 +537,33 @@ def sql(ds, statement: str) -> SqlResult:
         return SqlResult(cols)
 
     # aggregate path: scan (with pushdown filter), then vectorized fold
-    r = ds.query(type_name, Query(filter=cql))
-    t = r.table
     for it in items:
         if it.kind in ("star", "fn"):
             raise SqlError("cannot mix aggregates with non-aggregated columns")
         if it.kind == "col" and (not group_by or it.arg not in group_by):
             raise SqlError(f"column {it.arg!r} must appear in GROUP BY")
+
+    # SELECT COUNT(*) alone: the batched-EXACT device count (fused int scan
+    # + edge-bucket residual, count_many(loose=False)) — no row
+    # materialization; count_many itself degrades to the exact query path
+    # for filters/stores the fused pass can't serve
+    if (
+        not group_by
+        and not having
+        and len(items) == 1
+        and items[0].kind == "agg"
+        and items[0].fn == "count"
+        and items[0].arg == "*"
+    ):
+        counter = getattr(ds, "count_many", None)
+        if counter is not None:
+            n = counter(type_name, [Query(filter=cql)], loose=False)[0]
+            return SqlResult(
+                {items[0].name: np.array([n], dtype=object)}
+            )
+
+    r = ds.query(type_name, Query(filter=cql))
+    t = r.table
 
     if not group_by:
         cols = {

@@ -309,7 +309,14 @@ class TpuBackend(ExecutionBackend):
                 (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
                 (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
                 quads.append([int(blo), int(olo), int(bhi), int(ohi)])
-            times = np.array(quads, dtype=np.int32) if quads else np.empty((0, 4), np.int32)
+            if quads:
+                times = np.array(quads, dtype=np.int32)
+            else:
+                # a temporal constraint exists but every interval clamped
+                # AWAY (pre-epoch / beyond MAX_BIN): the predicate is
+                # temporally UNSATISFIABLE — pack an impossible window, not
+                # the no-constraint full window an empty array would become
+                times = np.array([[1, 0, 0, -1]], dtype=np.int32)
         return pack_boxes(boxes, overlap=overlap), pack_times(times)
 
     def select(self, state, index, plan, extraction, residual, table):

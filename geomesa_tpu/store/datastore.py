@@ -1004,12 +1004,17 @@ class DataStore:
         density_many): coherent snapshot, device residency, and the
         conditions under which loose batched execution is NOT equivalent
         (hot-tier rows, TTL masking, no resident columns). Returns
-        (main_n, point_state, bbox_state, batchable)."""
-        main, _indices, backend_state, _stats, delta_table = st.snapshot()
+        (main, main_n, point_state, bbox_state, batchable, perm) — ``perm``
+        maps the point state's sorted positions to original rows (the
+        exact-count correction path needs it)."""
+        main, indices, backend_state, _stats, delta_table = st.snapshot()
         main_n = 0 if main is None else len(main)
         dev = bbox_dev = None
+        perm = None
         if isinstance(self.backend, TpuBackend) and self._device_available():
-            dev, _ = TpuBackend.point_state(backend_state)
+            dev, dev_name = TpuBackend.point_state(backend_state)
+            if dev is not None and dev_name in (indices or {}):
+                perm = indices[dev_name].perm
             if dev is None and want_bbox:
                 # extended-geometry store: loose tests are bbox overlaps
                 bbox_dev, _ = TpuBackend.bbox_state(backend_state)
@@ -1021,7 +1026,7 @@ class DataStore:
             # passes would include expired rows — take the exact path
             or self._age_off_ttl_ms(st.sft) is not None
         )
-        return main_n, dev, bbox_dev, batchable
+        return main, main_n, dev, bbox_dev, batchable, perm
 
     def _batch_payloads(self, st: _TypeState, qs, overlap: bool, viewport=None):
         """Shared batchability loop: which queries are pure bbox+time
@@ -1029,12 +1034,17 @@ class DataStore:
         residual semantics the loose kernels can't honor) → their int-domain
         payloads. ``viewport``: intersect every query's spatial bounds with
         this (xmin, ymin, xmax, ymax) box — rows outside it must not match
-        (the density viewport). Returns [(query idx, payload | None)]."""
+        (the density viewport). Returns [(query idx, payload | None,
+        exactable)] — ``exactable`` is False when packing WIDENED the
+        payload (more boxes/intervals than the kernel slots), i.e. the int
+        result is a superset even beyond edge-bucket quantization and
+        cannot be corrected to exact."""
         from dataclasses import replace as _replace
 
         from geomesa_tpu.filter.bounds import extract as _extract
+        from geomesa_tpu.ops.refine import MAX_BOXES, MAX_TIMES
 
-        pending: list[tuple[int, tuple | None]] = []
+        pending: list[tuple[int, tuple | None, bool]] = []
         for i, q in enumerate(qs):
             f = q.resolved_filter()
             if (
@@ -1058,7 +1068,7 @@ class DataStore:
                     if nx1 <= nx2 and ny1 <= ny2:
                         clipped.append((nx1, ny1, nx2, ny2))
                 if not clipped:
-                    pending.append((i, None))
+                    pending.append((i, None, True))
                     continue
                 e = _replace(e, boxes=clipped)
             payload = (
@@ -1066,7 +1076,10 @@ class DataStore:
                 if e.disjoint
                 else self.backend._payload(st.sft, e, overlap=overlap)
             )
-            pending.append((i, payload))
+            n_boxes = len(e.boxes) if e.boxes is not None else 1
+            n_times = len(e.intervals) if e.intervals is not None else 1
+            exactable = n_boxes <= MAX_BOXES and n_times <= MAX_TIMES
+            pending.append((i, payload, exactable))
         return pending
 
     def count_many(self, type_name: str, queries, loose: bool = True):
@@ -1076,9 +1089,15 @@ class DataStore:
         queries are evaluated against the resident columns in a single fused
         scan (``ops.pallas_kernels.batched_count``). ``loose`` counts in the
         int key domain without the exact residual refine — the reference's
-        loose-bbox hint semantics (``QueryHints`` ``geomesa.loose.bbox``);
-        ``loose=False``, mixed-filter queries, or a non-empty hot tier fall
-        back to exact per-query execution.
+        loose-bbox hint semantics (``QueryHints`` ``geomesa.loose.bbox``).
+
+        ``loose=False`` on a point store STAYS batched: the fused int count
+        plus a device gather of the spatial edge-bucket candidates (the only
+        rows where the int superset can diverge from f64 — interior buckets
+        of a closed box are f64-certain) re-tested host-side against the
+        full filter AST. Mixed-filter queries, widened payloads, extended-
+        geometry stores, or a non-empty hot tier fall back to exact
+        per-query execution.
         """
         st = self._state(type_name)
         qs = [
@@ -1092,18 +1111,27 @@ class DataStore:
         def _exact(q):
             return self.query(type_name, q).count
 
-        main_n, dev, bbox_dev, batchable = self._batch_gate(st, want_bbox=True)
-        if not loose or not batchable:
+        main, main_n, dev, bbox_dev, batchable, perm = self._batch_gate(
+            st, want_bbox=True
+        )
+        # exact batched mode needs the point columns + a position→row map
+        # for the edge-candidate residual; anything else goes per-query
+        if not batchable or (
+            not loose and (dev is None or perm is None or main is None)
+        ):
             return [_exact(q) for q in qs]
         pending = self._batch_payloads(
             st, qs, overlap=bbox_dev is not None
         )
 
         out: list = [None] * len(qs)
-        live = [(i, p) for i, p in pending if p is not None]
-        for i, p in pending:
+        live = [
+            (i, p) for i, p, ok in pending
+            if p is not None and (loose or ok)
+        ]
+        for i, p, ok in pending:
             if p is None:
-                out[i] = 0
+                out[i] = 0  # disjoint filter: exactly zero either mode
         if live:
             import jax.numpy as jnp
 
@@ -1121,6 +1149,7 @@ class DataStore:
 
             mesh = self.backend._get_mesh()
             (boxes, times), _ = pad_query_axis(mesh, boxes, times)
+            edge_pos = edge_hits = None
             try:
                 if bbox_dev is not None:
                     c = bbox_dev.cols
@@ -1143,6 +1172,20 @@ class DataStore:
                             jnp.asarray(boxes), jnp.asarray(times),
                         )
                     )
+                    if not loose:
+                        from geomesa_tpu.parallel.query import (
+                            cached_batched_edge_gather_step,
+                        )
+
+                        cap = 512
+                        gather = cached_batched_edge_gather_step(mesh, cap)
+                        edge_pos, edge_hits = gather(
+                            c["x"], c["y"], c["bins"], c["offs"],
+                            jnp.int32(main_n),
+                            jnp.asarray(boxes), jnp.asarray(times),
+                        )
+                        edge_pos = np.asarray(edge_pos)   # (Qp, D, cap)
+                        edge_hits = np.asarray(edge_hits)  # (Qp, D)
             except Exception as e:  # noqa: BLE001 — failover to exact host path
                 if not self._is_device_error(e):
                     raise
@@ -1151,10 +1194,31 @@ class DataStore:
                 counts = None
             if counts is not None:
                 self._note_device_ok()
-                for k, (i, _) in enumerate(live):
-                    out[i] = int(counts[k])
+                if loose:
+                    for k, (i, _) in enumerate(live):
+                        out[i] = int(counts[k])
+                else:
+                    # exact mode: subtract edge-bucket candidates failing
+                    # the full f64 filter AST (a handful of rows per query)
+                    cap = edge_pos.shape[2]
+                    for k, (i, _) in enumerate(live):
+                        if (edge_hits[k] > cap).any():
+                            continue  # truncated lanes → per-query exact
+                        cand = np.concatenate([
+                            edge_pos[k, d, : edge_hits[k, d]]
+                            for d in range(edge_pos.shape[1])
+                        ]).astype(np.int64)
+                        corr = 0
+                        if len(cand):
+                            rows = perm[cand]
+                            f = qs[i].resolved_filter()
+                            m = np.asarray(
+                                f.mask(main.take(rows)), dtype=bool
+                            )
+                            corr = int((~m).sum())
+                        out[i] = int(counts[k]) - corr
         # batched queries still hit metrics + the audit trail
-        for i, _ in pending:
+        for i, _p, _ok in pending:
             if out[i] is None:
                 continue  # device failover: the exact path audits these
             self.metrics.counter("store.queries").inc()
@@ -1211,7 +1275,7 @@ class DataStore:
                 type_name, _replace(q, hints={**q.hints, "density": merged})
             ).density
 
-        main_n, dev, _bbox_dev, batchable = self._batch_gate(
+        _main, main_n, dev, _bbox_dev, batchable, _perm = self._batch_gate(
             st, want_bbox=False
         )
         if not loose or not batchable or dev is None:
@@ -1222,8 +1286,8 @@ class DataStore:
 
         out: list = [None] * len(qs)
         empty_grid = np.zeros((height, width))
-        live = [(i, p) for i, p in pending if p is not None]
-        for i, p in pending:
+        live = [(i, p) for i, p, _ok in pending if p is not None]
+        for i, p, _ok in pending:
             if p is None:
                 out[i] = empty_grid.copy()
         if live:
@@ -1266,7 +1330,7 @@ class DataStore:
                 self._note_device_ok()
                 for k, (i, _) in enumerate(live):
                     out[i] = grids[k].astype(np.float64)
-        for i, _ in pending:
+        for i, _p, _ok in pending:
             if out[i] is None:
                 continue
             self.metrics.counter("store.queries").inc()

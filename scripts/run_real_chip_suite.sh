@@ -23,7 +23,9 @@ step() {  # step <name> <timeout-s> <cmd...>
     > "artifacts/${name}_${ts}.log" 2>&1
   local rc=$?
   echo "rc=$rc" >> "artifacts/${name}_${ts}.log"
-  git add "artifacts/${name}_${ts}."* 2>/dev/null
+  # include files steps write OUTSIDE artifacts/ (device_validation appends
+  # TPU_VALIDATION.md) — the whole point is nothing stays uncommitted
+  git add "artifacts/${name}_${ts}."* TPU_VALIDATION.md 2>/dev/null
   git commit -q -m "Real-chip artifact: ${name} (${ts})
 
 No-Verification-Needed: generated hardware-run artifact" || true

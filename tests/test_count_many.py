@@ -69,6 +69,76 @@ class TestCountMany:
             ds.query("b", q).count for q in qs
         ]
 
+    def test_exact_mode_stays_batched(self, ds, monkeypatch):
+        """loose=False on a point store must run ONE fused device pass +
+        edge corrections, not Q per-query host executions."""
+        calls = {"query": 0}
+        real = ds.query
+
+        def spy(*a, **k):
+            calls["query"] += 1
+            return real(*a, **k)
+
+        qs = _queries()[:6]
+        want = [ds.query("b", q).count for q in qs]
+        monkeypatch.setattr(ds, "query", spy)
+        got = ds.count_many("b", qs, loose=False)
+        assert got == want
+        # edge corrections touch main.take, never ds.query
+        assert calls["query"] == 0, calls
+
+    def test_out_of_range_time_counts_zero(self, ds):
+        """A temporal constraint that clamps entirely away (pre-epoch /
+        beyond the indexable range) is UNSATISFIABLE — both modes must
+        count 0, not substitute the full time window."""
+        q = ("BBOX(geom, -170, -85, 170, 85) AND dtg DURING "
+             "1960-01-01T00:00:00Z/1960-01-02T00:00:00Z")
+        assert ds.query("b", q).count == 0
+        assert ds.count_many("b", [q], loose=False) == [0]
+        assert ds.count_many("b", [q], loose=True) == [0]
+
+    def test_exact_mode_boundary_adversarial(self):
+        """Rows planted EXACTLY on query box edges (f64) — where the int
+        superset and f64 differ — must count identically to the exact
+        path. This is the case loose counting gets wrong by design."""
+        rng = np.random.default_rng(99)
+        n = 8_000
+        store = DataStore(backend="tpu")
+        store.create_schema("edge", "name:String,dtg:Date,*geom:Point")
+        boxes = [
+            (-10.0, -10.0, 10.0, 10.0),
+            (3.33333333, -20.0, 47.77777, 5.5),
+            (-123.456789, 12.3456789, -100.0001, 44.4),
+        ]
+        recs = []
+        lon = rng.uniform(-170, 170, n)
+        lat = rng.uniform(-85, 85, n)
+        k = 0
+        for x1, y1, x2, y2 in boxes:
+            for bx in (x1, x2):
+                for by in (y1, y2):
+                    for dx in (-1e-9, 0.0, 1e-9):
+                        lon[k] = bx + dx
+                        lat[k] = by + dx
+                        k += 1
+        for i in range(n):
+            recs.append({
+                "name": f"n{i}", "dtg": T0 + int(rng.integers(0, 86_400_000)),
+                "geom": Point(float(lon[i]), float(lat[i])),
+            })
+        store.write("edge", recs, fids=[str(i) for i in range(n)])
+        store.compact("edge")
+        qs = [f"BBOX(geom, {x1}, {y1}, {x2}, {y2})" for x1, y1, x2, y2 in boxes]
+        got = store.count_many("edge", qs, loose=False)
+        want = []
+        for x1, y1, x2, y2 in boxes:
+            want.append(int(
+                ((lon >= x1) & (lon <= x2) & (lat >= y1) & (lat <= y2)).sum()
+            ))
+        assert got == want, (got, want)
+        # and oracle agreement (full AST semantics)
+        assert got == [store.query("edge", q).count for q in qs]
+
     def test_hot_tier_falls_back(self, ds):
         ds.write("b", [{"name": "hot", "dtg": T0, "geom": Point(0.5, 0.5)}])
         try:

@@ -112,6 +112,40 @@ class TestAggregates:
         assert sum(r[1] for r in res.rows()) == exp_total
 
 
+class TestCountFastPath:
+    def test_count_star_uses_batched_exact_device_count(self, monkeypatch):
+        """SELECT COUNT(*) with a pure bbox filter rides the fused device
+        count (exact mode) with ZERO row materialization."""
+        rng = np.random.default_rng(44)
+        n = 10_000
+        ds = DataStore(backend="tpu")
+        ds.create_schema("c", "name:String,dtg:Date,*geom:Point")
+        ds.write(
+            "c",
+            [{"name": f"n{i % 3}", "dtg": 1_600_000_000_000 + i,
+              "geom": Point(float(rng.uniform(-90, 90)),
+                            float(rng.uniform(-45, 45)))}
+             for i in range(n)],
+            fids=[str(i) for i in range(n)],
+        )
+        ds.compact("c")
+        want = ds.query("c", "BBOX(geom, -30, -20, 30, 20)").count
+        calls = {"q": 0}
+        real = ds.query
+        monkeypatch.setattr(
+            ds, "query",
+            lambda *a, **k: (calls.__setitem__("q", calls["q"] + 1),
+                            real(*a, **k))[1],
+        )
+        r = sql(ds, "SELECT COUNT(*) AS n FROM c "
+                    "WHERE BBOX(geom, -30, -20, 30, 20)")
+        assert int(r.columns["n"][0]) == want
+        assert calls["q"] == 0, "COUNT(*) materialized rows via query()"
+        # non-batchable filter still exact through the fallback
+        r2 = sql(ds, "SELECT COUNT(*) AS n FROM c WHERE name = 'n1'")
+        assert int(r2.columns["n"][0]) == real("c", "name = 'n1'").count
+
+
 class TestErrors:
     def test_bad_statement(self, ds):
         with pytest.raises(SqlError):
