@@ -267,57 +267,123 @@ def _batched_masks(x, y, bins, offs, base, true_n, boxes, times):
     return in_box & in_time & rows_valid[None, :]
 
 
-def make_batched_edge_gather_step(mesh: Mesh, capacity: int):
-    """Boundary-bucket candidate gather for EXACT batched counts.
+# Per-slot int predicates for the exact-count path. INVARIANT: these must
+# agree bit-for-bit with the fused count kernels' semantics —
+# `_batched_masks`/`batched_count` (point containment) and
+# `make_batched_overlap_step`'s match expression (interval overlap) — or
+# the superset-minus-correction arithmetic of the exact mode silently
+# breaks. Any inclusivity/layout change there must land here too.
 
-    The int-domain fused count is a superset of the f64 predicate only at
-    the quantization boundary: interior buckets of a closed f64 box are
-    f64-certain, so every divergent row sits in an EDGE bucket of some box
-    slot. This step compacts, per query × shard, the global sorted-order
-    positions of rows that pass the full int predicate AND sit on a spatial
-    edge bucket — the (tiny) candidate set the host re-tests in f64 to turn
-    the fused count exact (``count_many(loose=False)``; the counting-scan
-    analog of the select path's superset-refine + exact-residual contract).
+def _slot_point(x, y, b):
+    """(inside, on_edge) for one containment box slot [xlo, xhi, ylo, yhi]."""
+    inside = (x >= b[0]) & (x <= b[1]) & (y >= b[2]) & (y <= b[3])
+    edge = (x == b[0]) | (x == b[1]) | (y == b[2]) | (y == b[3])
+    return inside, inside & edge
 
-    fn(x, y, bins, offs, true_n, boxes (Q, B, 4), times (Q, T, 4)) →
-        (positions (Q, D, capacity) int32 global positions (-1 pad),
-         hits (Q, D) int32 TRUE per-shard edge counts). ``hits > capacity``
-    on any shard means that query's lanes truncated — callers fall back
-    to the exact per-query path for it.
+
+def _slot_overlap(fxmin, fymin, fxmax, fymax, b):
+    """(overlaps, on_edge) for one overlap box slot: strict int inequality
+    on an axis implies the f64 inequality, so divergence needs equality
+    with the opposing query edge bucket."""
+    inside = (
+        (fxmin <= b[1]) & (fxmax >= b[0])
+        & (fymin <= b[3]) & (fymax >= b[2])
+    )
+    edge = (
+        (fxmin == b[1]) | (fxmax == b[0])
+        | (fymin == b[3]) | (fymax == b[2])
+    )
+    return inside, inside & edge
+
+
+def _slot_time_edge(bins, offs, t):
+    """Rows AT one window's quantized endpoints — where coarse offsets
+    (seconds for week/month bins, minutes for year) can admit rows the
+    exact-ms f64 predicate rejects. Pad slots (unsatisfiable windows) are
+    gated out."""
+    valid = (t[0] < t[2]) | ((t[0] == t[2]) & (t[1] <= t[3]))
+    at_lo = (bins == t[0]) & (offs == t[1])
+    at_hi = (bins == t[2]) & (offs == t[3])
+    return valid & (at_lo | at_hi)
+
+
+def make_batched_edge_gather_step(mesh: Mesh, capacity: int,
+                                  overlap: bool = False):
+    """ONE-pass fused count + boundary-candidate gather for EXACT batched
+    counts.
+
+    The int-domain count is a superset of the f64 predicate only at
+    quantization boundaries: spatial — interior buckets of a closed f64 box
+    are f64-certain (normalization is monotone), so spatial divergence sits
+    in an EDGE bucket of some box slot; temporal — bin offsets are coarser
+    than ms for week/month/year periods, so temporal divergence sits AT a
+    window's quantized (bin, offset) endpoints. This step returns, per
+    query, the full int-domain count (psum over shards) AND the compacted
+    global positions of every edge-or-endpoint candidate — the (tiny) set
+    the host re-tests against the f64 filter AST and subtracts
+    (``count_many(loose=False)``; the counting-scan analog of the select
+    path's superset-refine + exact-residual contract). One sweep serves
+    both outputs, so exact mode costs the same device scan as loose mode.
+
+    Point mode: fn(x, y, bins, offs, true_n, boxes, times).
+    Overlap mode (``overlap=True``): fn(xmin, ymin, xmax, ymax, bins,
+    offs, true_n, boxes, times). Either returns
+        (counts (Q,) int32,
+         positions (Q, D, capacity) int32 global positions (-1 pad),
+         hits (Q, D) int32 TRUE per-shard candidate counts).
+    ``hits > capacity`` on any shard means that query's lanes truncated —
+    callers fall back to the exact per-query path for it.
     """
+
+    n_cols = 6 if overlap else 4
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
+            *(P(DATA_AXIS) for _ in range(n_cols)),
             P(),
             P(QUERY_AXIS, None, None),
             P(QUERY_AXIS, None, None),
         ),
-        out_specs=(P(QUERY_AXIS, DATA_AXIS, None), P(QUERY_AXIS, DATA_AXIS)),
+        out_specs=(
+            P(QUERY_AXIS),
+            P(QUERY_AXIS, DATA_AXIS, None),
+            P(QUERY_AXIS, DATA_AXIS),
+        ),
         check_vma=False,
     )
-    def step(x, y, bins, offs, true_n, boxes, times):
-        n = x.shape[0]
+    def step(*args):
+        cols, (true_n, boxes, times) = args[:n_cols], args[n_cols:]
+        if overlap:
+            fxmin, fymin, fxmax, fymax, bins, offs = cols
+            n = fxmin.shape[0]
+        else:
+            x, y, bins, offs = cols
+            n = x.shape[0]
         base = jax.lax.axis_index(DATA_AXIS) * n
         rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
 
-        def one(args):
-            boxes_q, times_q = args  # (B, 4), (T, 4)
+        def one(args_q):
+            boxes_q, times_q = args_q  # (B, 4), (T, 4)
+            in_box = jnp.zeros((n,), dtype=jnp.bool_)
             on_edge = jnp.zeros((n,), dtype=jnp.bool_)
             for k in range(boxes_q.shape[0]):
                 b = boxes_q[k]
-                inside = (x >= b[0]) & (x <= b[1]) & (y >= b[2]) & (y <= b[3])
-                edge = (x == b[0]) | (x == b[1]) | (y == b[2]) | (y == b[3])
-                on_edge |= inside & edge
-            mask = on_edge & _batched_time_match(
+                if overlap:
+                    ins, edg = _slot_overlap(fxmin, fymin, fxmax, fymax, b)
+                else:
+                    ins, edg = _slot_point(x, y, b)
+                in_box |= ins
+                on_edge |= edg
+            time_edge = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(times_q.shape[0]):
+                time_edge |= _slot_time_edge(bins, offs, times_q[k])
+            in_all = in_box & _batched_time_match(
                 bins, offs, times_q[None]
             )[0] & rows_valid
+            mask = in_all & (on_edge | time_edge)
             dest = jnp.where(
                 mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, capacity
             )
@@ -327,17 +393,22 @@ def make_batched_edge_gather_step(mesh: Mesh, capacity: int):
             )
             # TRUE count (may exceed capacity): hits > capacity flags the
             # truncated lanes so the host falls back for that query
-            return out, mask.sum(dtype=jnp.int32)
+            return in_all.sum(dtype=jnp.int32), out, mask.sum(dtype=jnp.int32)
 
-        pos, hits = jax.lax.map(one, (boxes, times))
-        return pos[:, None, :], hits[:, None]
+        counts, pos, hits = jax.lax.map(one, (boxes, times))
+        return (
+            jax.lax.psum(counts, DATA_AXIS),
+            pos[:, None, :],
+            hits[:, None],
+        )
 
     return step
 
 
 @lru_cache(maxsize=None)
-def cached_batched_edge_gather_step(mesh: Mesh, capacity: int):
-    return make_batched_edge_gather_step(mesh, capacity)
+def cached_batched_edge_gather_step(mesh: Mesh, capacity: int,
+                                    overlap: bool = False):
+    return make_batched_edge_gather_step(mesh, capacity, overlap)
 
 
 def make_batched_count_step(mesh: Mesh, impl: str = "auto"):

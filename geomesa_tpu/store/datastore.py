@@ -1017,7 +1017,9 @@ class DataStore:
                 perm = indices[dev_name].perm
             if dev is None and want_bbox:
                 # extended-geometry store: loose tests are bbox overlaps
-                bbox_dev, _ = TpuBackend.bbox_state(backend_state)
+                bbox_dev, bbox_name = TpuBackend.bbox_state(backend_state)
+                if bbox_dev is not None and bbox_name in (indices or {}):
+                    perm = indices[bbox_name].perm
         batchable = not (
             (dev is None and bbox_dev is None)
             or delta_table is not None
@@ -1091,13 +1093,14 @@ class DataStore:
         int key domain without the exact residual refine — the reference's
         loose-bbox hint semantics (``QueryHints`` ``geomesa.loose.bbox``).
 
-        ``loose=False`` on a point store STAYS batched: the fused int count
-        plus a device gather of the spatial edge-bucket candidates (the only
-        rows where the int superset can diverge from f64 — interior buckets
-        of a closed box are f64-certain) re-tested host-side against the
-        full filter AST. Mixed-filter queries, widened payloads, extended-
-        geometry stores, or a non-empty hot tier fall back to exact
-        per-query execution.
+        ``loose=False`` STAYS batched on both store kinds: the fused int
+        count plus a device gather of the spatial edge-bucket candidates
+        (the only rows where the int superset can diverge from f64 —
+        interior buckets of a closed box are f64-certain, and strict int
+        inequality on an overlap axis implies the f64 inequality)
+        re-tested host-side against the full filter AST. Mixed-filter
+        queries, widened payloads, truncated candidate lanes, or a
+        non-empty hot tier fall back to exact per-query execution.
         """
         st = self._state(type_name)
         qs = [
@@ -1114,10 +1117,10 @@ class DataStore:
         main, main_n, dev, bbox_dev, batchable, perm = self._batch_gate(
             st, want_bbox=True
         )
-        # exact batched mode needs the point columns + a position→row map
+        # exact batched mode needs resident columns + a position→row map
         # for the edge-candidate residual; anything else goes per-query
         if not batchable or (
-            not loose and (dev is None or perm is None or main is None)
+            not loose and (perm is None or main is None)
         ):
             return [_exact(q) for q in qs]
         pending = self._batch_payloads(
@@ -1150,8 +1153,33 @@ class DataStore:
             mesh = self.backend._get_mesh()
             (boxes, times), _ = pad_query_axis(mesh, boxes, times)
             edge_pos = edge_hits = None
+            cap = 512
             try:
-                if bbox_dev is not None:
+                if not loose:
+                    # ONE fused pass returns counts AND the boundary
+                    # candidates — exact mode costs the same device scan
+                    from geomesa_tpu.parallel.query import (
+                        cached_batched_edge_gather_step,
+                    )
+
+                    gather = cached_batched_edge_gather_step(
+                        mesh, cap, overlap=bbox_dev is not None
+                    )
+                    c = (bbox_dev or dev).cols
+                    col_args = (
+                        (c["xmin"], c["ymin"], c["xmax"], c["ymax"],
+                         c["bins"], c["offs"])
+                        if bbox_dev is not None
+                        else (c["x"], c["y"], c["bins"], c["offs"])
+                    )
+                    counts, edge_pos, edge_hits = gather(
+                        *col_args, jnp.int32(main_n),
+                        jnp.asarray(boxes), jnp.asarray(times),
+                    )
+                    counts = np.asarray(counts)
+                    edge_pos = np.asarray(edge_pos)   # (Qp, D, cap)
+                    edge_hits = np.asarray(edge_hits)  # (Qp, D)
+                elif bbox_dev is not None:
                     c = bbox_dev.cols
                     step = cached_batched_overlap_step(mesh, with_time=True)
                     counts = np.asarray(
@@ -1172,20 +1200,6 @@ class DataStore:
                             jnp.asarray(boxes), jnp.asarray(times),
                         )
                     )
-                    if not loose:
-                        from geomesa_tpu.parallel.query import (
-                            cached_batched_edge_gather_step,
-                        )
-
-                        cap = 512
-                        gather = cached_batched_edge_gather_step(mesh, cap)
-                        edge_pos, edge_hits = gather(
-                            c["x"], c["y"], c["bins"], c["offs"],
-                            jnp.int32(main_n),
-                            jnp.asarray(boxes), jnp.asarray(times),
-                        )
-                        edge_pos = np.asarray(edge_pos)   # (Qp, D, cap)
-                        edge_hits = np.asarray(edge_hits)  # (Qp, D)
             except Exception as e:  # noqa: BLE001 — failover to exact host path
                 if not self._is_device_error(e):
                     raise

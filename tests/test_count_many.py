@@ -87,6 +87,45 @@ class TestCountMany:
         # edge corrections touch main.take, never ds.query
         assert calls["query"] == 0, calls
 
+    def test_exact_mode_extended_geometries(self, monkeypatch):
+        """loose=False on an XZ (bbox-overlap) store stays batched and
+        matches the per-query exact path, with track endpoints planted
+        EXACTLY on the query box edges."""
+        from geomesa_tpu.geometry.types import LineString
+
+        rng = np.random.default_rng(55)
+        n = 3_000
+        store = DataStore(backend="tpu")
+        store.create_schema("trk", "name:String,dtg:Date,*geom:LineString")
+        boxes = [(-10.0, -10.0, 10.0, 10.0), (5.123, -30.0, 44.9, 7.7)]
+        recs = []
+        for i in range(n):
+            x0 = float(rng.uniform(-160, 150))
+            y0 = float(rng.uniform(-75, 70))
+            if i < 40:  # endpoints exactly ON a query edge
+                bx = boxes[i % 2]
+                x0 = bx[0] if i % 4 < 2 else bx[2]
+                y0 = bx[1] if i % 8 < 4 else bx[3]
+            recs.append({
+                "name": f"t{i}", "dtg": T0 + i,
+                "geom": LineString([(x0, y0), (x0 + 1.5, y0 + 1.0)]),
+            })
+        store.write("trk", recs, fids=[str(i) for i in range(n)])
+        store.compact("trk")
+        qs = [f"BBOX(geom, {x1}, {y1}, {x2}, {y2})"
+              for x1, y1, x2, y2 in boxes]
+        want = [store.query("trk", q).count for q in qs]
+        calls = {"q": 0}
+        real = store.query
+        monkeypatch.setattr(
+            store, "query",
+            lambda *a, **k: (calls.__setitem__("q", calls["q"] + 1),
+                            real(*a, **k))[1],
+        )
+        got = store.count_many("trk", qs, loose=False)
+        assert got == want, (got, want)
+        assert calls["q"] == 0, "extended-geometry exact count fell back"
+
     def test_out_of_range_time_counts_zero(self, ds):
         """A temporal constraint that clamps entirely away (pre-epoch /
         beyond the indexable range) is UNSATISFIABLE — both modes must
