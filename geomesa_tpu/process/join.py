@@ -137,11 +137,14 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
     count_step = make_block_bbox_count_step(mesh, block)
     true_n = jnp.int32(len(main))
     out: list[tuple[int, np.ndarray]] = []
-    # chunk geometries so D × Kc × capacity stays inside the lane budget
+    # chunk geometries so D × Kc × capacity stays inside the lane budget;
+    # kc_limit persists across budget-overflow retries (halving a local kc
+    # that is recomputed each iteration would loop forever)
     start = 0
+    kc_limit = 1024
     while start < k:
         # plan a provisional chunk, then size capacity from real counts
-        kc = min(k - start, 1024)
+        kc = min(k - start, kc_limit)
         sel = np.arange(start, start + kc)
         blk, nblk = polygon_block_plan(
             z2.zs, bbox_deg[sel], block, dev.rows_per_shard, shards
@@ -159,6 +162,10 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
             # split the chunk instead of materializing an oversized buffer
             if kc == 1:
                 # single huge geometry: exact host scan for just this one
+                if empty[start]:
+                    out.append((start, np.empty(0, dtype=np.int64)))
+                    start += 1
+                    continue
                 g = geoms[start]
                 m = (
                     P.points_within_geom(col.x, col.y, g)
@@ -170,7 +177,7 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
                 out.append((start, np.nonzero(m)[0]))
                 start += 1
                 continue
-            kc = max(1, kc // 2)
+            kc_limit = max(1, kc // 2)
             continue
         gather = make_block_bbox_gather_step(mesh, block, cap)
         pos, hits = gather(
@@ -197,6 +204,10 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
                 m &= main_dtg[rows] >= cutoff_ms
             out.append((gi, rows[m]))
         start += kc
+        # regrow gradually after success: a hard reset to 1024 would re-pay
+        # the whole halving descent (a plan + count dispatch per halving)
+        # for every chunk under a tight budget
+        kc_limit = min(1024, kc_limit * 2)
 
     if delta is None or not len(delta):
         return main, out

@@ -181,29 +181,62 @@ def knn_many(ds, type_name: str, points, k: int = 10,
             d_y = dcol.y[d_rows].astype(np.float32)
             d_t = delta_table.take(d_rows)  # materialized once, reused per point
 
+    # Device TTL masking is at quantized (bin, offset) granularity. Rows the
+    # device EXCLUDED are genuinely expired (quantization floors, so a lower
+    # quantized unit implies a lower exact ms), but rows it KEPT can still be
+    # up to one offset unit below the exact cutoff — re-check the candidates
+    # at exact milliseconds so the device path agrees with the per-point
+    # fallback and join_rows_device. When that check drops anything, the
+    # k-heap is under-filled (a farther fresh row belonged in it): recompute
+    # just that query point host-side over the fresh rows — bounded work,
+    # only points whose top-k touched the ambiguous unit pay it.
+    main_dtg = fresh_rows = fx = fy = None
+    if with_ttl:
+        main_dtg = main.dtg_millis()
+
     out = []
     for qi in range(len(points)):
         rows = perm[pos[qi]]
-        cand_t = main.take(rows)
-        cand_d = dists[qi].astype(np.float64)
-        # device heaps of a near-empty/expired store can carry inf slots
-        live = np.isfinite(cand_d)
+        if main_dtg is not None and not (main_dtg[rows] >= cutoff_ms).all():
+            if fresh_rows is None:  # lazily built, shared across points
+                fresh_rows = np.nonzero(main_dtg >= cutoff_ms)[0]
+                colm = main.geom_column()
+                fx = colm.x[fresh_rows].astype(np.float32)
+                fy = colm.y[fresh_rows].astype(np.float32)
+            dd = _f32_dists(fx, fy, points[qi])
+            near = np.argpartition(dd, kk - 1)[:kk] if kk < len(dd) \
+                else np.arange(len(dd))
+            near = near[np.argsort(dd[near], kind="stable")]
+            rows = fresh_rows[near]
+            cand_t = main.take(rows)
+            cand_d = dd[near].astype(np.float64)
+            live = np.isfinite(cand_d)
+        else:
+            cand_t = main.take(rows)
+            cand_d = dists[qi].astype(np.float64)
+            # device heaps of a near-empty/expired store can carry inf slots
+            live = np.isfinite(cand_d)
         if not live.all():
             cand_t = cand_t.take(np.nonzero(live)[0])
             cand_d = cand_d[live]
         if d_x is not None:
             from geomesa_tpu.schema.columnar import FeatureTable
 
-            dd = np.sqrt(
-                (d_x - np.float32(points[qi].x)) ** 2
-                + (d_y - np.float32(points[qi].y)) ** 2
-            ).astype(np.float64)
+            dd = _f32_dists(d_x, d_y, points[qi]).astype(np.float64)
             cand_t = FeatureTable.concat([cand_t, d_t])
             cand_d = np.concatenate([cand_d, dd])
         take = min(k, len(cand_d))
         order = np.argsort(cand_d, kind="stable")[:take]
         out.append((cand_t.take(order), cand_d[order]))
     return out
+
+
+def _f32_dists(x: np.ndarray, y: np.ndarray, point: Point) -> np.ndarray:
+    """f32 euclidean distances — matches the device kernel's ranking metric,
+    so host-computed candidates merge consistently with device heaps."""
+    return np.sqrt(
+        (x - np.float32(point.x)) ** 2 + (y - np.float32(point.y)) ** 2
+    )
 
 
 def _distances(r, point: Point) -> np.ndarray:

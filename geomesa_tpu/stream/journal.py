@@ -84,17 +84,42 @@ class JournalBus:
     # -- paths ---------------------------------------------------------------
     def _safe(self, topic: str) -> str:
         # unambiguous escaping: distinct topics can never share a log file
-        # ("evt:1" vs "evt_1"); "_" escapes itself so the mapping inverts
+        # ("evt:1" vs "evt_1"). Fixed-width escapes ("_" + exactly 6 hex
+        # digits, enough for any codepoint) keep the mapping injective —
+        # variable-width "_%02x" would collide chr(0x1234) with
+        # chr(0x12) + "34". "_" itself is escaped, so no ambiguity.
+        return "".join(
+            c if c.isalnum() or c in ".-" else f"_{ord(c):06x}"
+            for c in topic
+        )
+
+    def _legacy_safe(self, topic: str) -> str:
+        # the pre-injectivity variable-width escape ("_%02x"); kept only to
+        # migrate journals written before the fixed-width scheme
         return "".join(
             c if c.isalnum() or c in ".-" else f"_{ord(c):02x}"
             for c in topic
         )
 
+    def _migrate_legacy(self, topic: str, new: str, ext: str) -> None:
+        legacy = os.path.join(
+            self.root, f"{self._legacy_safe(topic)}{ext}"
+        )
+        if legacy != new and not os.path.exists(new) and os.path.exists(legacy):
+            try:  # atomic on one filesystem; a racing process's rename wins
+                os.rename(legacy, new)
+            except OSError:
+                pass
+
     def _log_path(self, topic: str) -> str:
-        return os.path.join(self.root, f"{self._safe(topic)}.log")
+        p = os.path.join(self.root, f"{self._safe(topic)}.log")
+        self._migrate_legacy(topic, p, ".log")
+        return p
 
     def _commit_path(self, topic: str) -> str:
-        return os.path.join(self.root, f"{self._safe(topic)}.commit")
+        p = os.path.join(self.root, f"{self._safe(topic)}.commit")
+        self._migrate_legacy(topic, p, ".commit")
+        return p
 
     def _read_commit(self, topic: str) -> int | None:
         """Committed byte offset, or None when the sidecar is missing or

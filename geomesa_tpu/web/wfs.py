@@ -29,6 +29,12 @@ from geomesa_tpu.schema.sft import AttributeType, FeatureType
 
 __all__ = ["handle_wfs"]
 
+
+def _attr(s: str) -> str:
+    # attribute-context escape: saxutils.escape() alone leaves '"' intact,
+    # letting a name containing a quote break out of the attribute value
+    return escape(str(s), {'"': "&quot;"})
+
 _XSD_TYPES = {
     AttributeType.STRING: "xsd:string",
     AttributeType.INT: "xsd:int",
@@ -63,7 +69,7 @@ class WfsError(ValueError):
             '<?xml version="1.0" encoding="UTF-8"?>\n'
             '<ows:ExceptionReport xmlns:ows="http://www.opengis.net/ows/1.1" '
             'version="2.0.0">'
-            f'<ows:Exception exceptionCode="{escape(self.code)}">'
+            f'<ows:Exception exceptionCode="{_attr(self.code)}">'
             f"<ows:ExceptionText>{escape(str(self))}</ows:ExceptionText>"
             "</ows:Exception></ows:ExceptionReport>"
         )
@@ -160,16 +166,16 @@ def _describe(store, p: dict) -> str:
                 or _XSD_TYPES.get(a.type, "xsd:string")
             )
             elems.append(
-                f'<xsd:element name="{escape(a.name)}" type="{t}" '
+                f'<xsd:element name="{_attr(a.name)}" type="{t}" '
                 'minOccurs="0" nillable="true"/>'
             )
         parts.append(
-            f'<xsd:complexType name="{escape(name)}Type">'
+            f'<xsd:complexType name="{_attr(name)}Type">'
             "<xsd:complexContent>"
             '<xsd:extension base="gml:AbstractFeatureType">'
             f"<xsd:sequence>{''.join(elems)}</xsd:sequence>"
             "</xsd:extension></xsd:complexContent></xsd:complexType>"
-            f'<xsd:element name="{escape(name)}" type="{escape(name)}Type" '
+            f'<xsd:element name="{_attr(name)}" type="{_attr(name)}Type" '
             'substitutionGroup="gml:AbstractFeature"/>'
         )
     return (

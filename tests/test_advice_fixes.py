@@ -1,0 +1,214 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md r3).
+
+Each test pins a specific fixed defect:
+- join_rows_device chunk-budget overflow must terminate (kc_limit persists)
+- JournalBus._safe must be injective (fixed-width escapes)
+- WFS XML attribute values must escape double quotes
+- device-path KNN TTL must filter at exact milliseconds, not the quantized
+  (bin, offset) granularity
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point, Polygon
+from geomesa_tpu.store.datastore import DataStore
+
+
+class TestJoinChunkBudget:
+    def test_tiny_budget_terminates_with_correct_rows(self):
+        """A chunk_budget smaller than shards*kc*cap used to replan the same
+        oversized chunk forever (the halved kc was overwritten at the top of
+        the loop). Now kc_limit persists across retries and the join
+        terminates with exact results."""
+        from geomesa_tpu.process.join import join_rows_device
+
+        rng = np.random.default_rng(7)
+        n = 1200
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", "name:String,*geom:Point")
+        lon = rng.uniform(-40, 40, n)
+        lat = rng.uniform(-40, 40, n)
+        ds.write(
+            "pts",
+            [{"name": f"p{i}", "geom": Point(float(lon[i]), float(lat[i]))}
+             for i in range(n)],
+            fids=[f"p{i}" for i in range(n)],
+        )
+        ds.compact("pts")
+        boxes = [(-30, -30, -5, -5), (-10, -10, 15, 15), (5, 5, 30, 30)]
+        geoms = [
+            Polygon([[x1, y1], [x2, y1], [x2, y2], [x1, y2]])
+            for x1, y1, x2, y2 in boxes
+        ]
+        # budget low enough that every multi-geometry chunk overflows and the
+        # loop must halve down to kc == 1 (which takes the exact host path)
+        _, out = join_rows_device(ds, "pts", geoms, chunk_budget=1)
+        assert [gi for gi, _ in out] == [0, 1, 2]
+        for (x1, y1, x2, y2), (_, rows) in zip(boxes, out):
+            want = set(
+                np.nonzero((lon > x1) & (lon < x2) & (lat > y1) & (lat < y2))[0]
+            )
+            assert set(rows.tolist()) == want
+
+    def test_budget_overflow_matches_unbudgeted(self):
+        """A budget that forces several split/retry rounds (but still allows
+        device chunks) returns the same row sets as the default budget."""
+        from geomesa_tpu.process.join import join_rows_device
+
+        rng = np.random.default_rng(8)
+        n = 900
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", "name:String,*geom:Point")
+        lon = rng.uniform(-40, 40, n)
+        lat = rng.uniform(-40, 40, n)
+        ds.write(
+            "pts",
+            [{"name": f"p{i}", "geom": Point(float(lon[i]), float(lat[i]))}
+             for i in range(n)],
+            fids=[f"p{i}" for i in range(n)],
+        )
+        ds.compact("pts")
+        geoms = [
+            Polygon([[cx - 6, cy - 6], [cx + 6, cy - 6],
+                     [cx + 6, cy + 6], [cx - 6, cy + 6]])
+            for cx, cy in [(-20, -20), (0, 0), (20, 20), (-20, 20)]
+        ]
+        _, want = join_rows_device(ds, "pts", geoms)
+        _, got = join_rows_device(ds, "pts", geoms, chunk_budget=40_000)
+        for (gi_w, rows_w), (gi_g, rows_g) in zip(want, got):
+            assert gi_w == gi_g
+            assert set(rows_w.tolist()) == set(rows_g.tolist())
+
+
+class TestJoinNoneGeomTinyBudget:
+    def test_none_geometry_on_kc1_overflow_path(self):
+        """A None geometry reaching the kc==1 budget-overflow host path must
+        yield an empty row set, not an AttributeError."""
+        from geomesa_tpu.process.join import join_rows_device
+
+        rng = np.random.default_rng(9)
+        n = 400
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", "name:String,*geom:Point")
+        ds.write(
+            "pts",
+            [{"name": f"p{i}", "geom": Point(
+                float(rng.uniform(-40, 40)), float(rng.uniform(-40, 40)))}
+             for i in range(n)],
+            fids=[f"p{i}" for i in range(n)],
+        )
+        ds.compact("pts")
+        geoms = [None, Polygon([[-30, -30], [30, -30], [30, 30], [-30, 30]])]
+        _, out = join_rows_device(ds, "pts", geoms, chunk_budget=1)
+        assert out[0][0] == 0 and len(out[0][1]) == 0
+        assert out[1][0] == 1 and len(out[1][1]) > 0
+
+
+class TestJournalTopicEscaping:
+    def test_safe_is_injective_for_hex_lookalikes(self, tmp_path):
+        """chr(0x1234) and chr(0x12) + '34' must map to distinct log files
+        (the old variable-width _%02x escape collided them)."""
+        from geomesa_tpu.stream.journal import JournalBus
+
+        bus = JournalBus(str(tmp_path))
+        a = bus._safe("evt" + chr(0x1234))
+        b = bus._safe("evt" + chr(0x12) + "34")
+        assert a != b
+
+    def test_safe_roundtrip_distinct_topics(self, tmp_path):
+        from geomesa_tpu.stream.journal import JournalBus
+
+        bus = JournalBus(str(tmp_path))
+        topics = ["evt:1", "evt_1", "evt 1", "evt/1", "evt\x121", "evtģ4"]
+        names = {bus._safe(t) for t in topics}
+        assert len(names) == len(topics)
+
+    def test_legacy_journal_files_migrate(self, tmp_path):
+        """Journals written under the old variable-width escape are renamed
+        to the fixed-width name on first access — committed history from a
+        pre-upgrade deployment stays readable."""
+        from geomesa_tpu.stream.journal import JournalBus
+
+        bus1 = JournalBus(str(tmp_path))
+        topic = "evt:1"
+        bus1.publish(topic, "k", b"payload-1")
+        # simulate a pre-upgrade deployment: rename the files to the OLD
+        # escape scheme, then open a fresh bus (the upgraded process)
+        import os
+
+        new_log = bus1._log_path(topic)
+        new_commit = bus1._commit_path(topic)
+        old_base = bus1._legacy_safe(topic)
+        os.rename(new_log, str(tmp_path / f"{old_base}.log"))
+        if os.path.exists(new_commit):
+            os.rename(new_commit, str(tmp_path / f"{old_base}.commit"))
+        bus2 = JournalBus(str(tmp_path))
+        got = [
+            m for part in range(bus2.partitions)
+            for m in bus2.poll(topic, part, 0)
+        ]
+        assert got == [b"payload-1"]
+
+
+class TestWfsAttributeEscaping:
+    def test_attr_escapes_double_quote(self):
+        from geomesa_tpu.web.wfs import _attr
+
+        assert _attr('a"b') == "a&quot;b"
+        assert _attr("a<b>&c") == "a&lt;b&gt;&amp;c"
+
+    def test_exception_report_with_quote_parses(self):
+        import xml.etree.ElementTree as ET
+
+        from geomesa_tpu.web.wfs import WfsError
+
+        err = WfsError('Bad"Code', 'oops "quoted" message')
+        root = ET.fromstring(err.to_xml())
+        exc = root[0]
+        assert exc.attrib["exceptionCode"] == 'Bad"Code'
+
+
+class TestKnnExactMsTtl:
+    def test_same_quantized_offset_still_expired(self):
+        """Rows whose true ms timestamp is below the TTL cutoff but inside
+        the same quantized (bin, offset) unit must not surface from the
+        device KNN path (parity with the host fallback and the mesh join)."""
+        import geomesa_tpu.process.knn as knn_mod
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.schema.sft import parse_spec
+
+        rng = np.random.default_rng(11)
+        n = 600
+        t0 = 1_500_000_000_000  # whole second: quantization boundary
+        ttl = 3_600_000
+        sft = parse_spec("kq", "dtg:Date,*geom:Point")
+        sft.user_data["geomesa.age.off"] = ttl
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        q = Point(5.0, 5.0)
+        now_ms = t0 + ttl + 500  # cutoff = t0 + 500 ms, mid-second
+        recs = []
+        for i in range(n):
+            if i % 2 == 0:  # fresh: after the cutoff
+                recs.append({"dtg": t0 + 600, "geom": Point(
+                    float(rng.uniform(-50, 50)), float(rng.uniform(-50, 50)))})
+            else:  # expired by 400-500 ms but in the SAME second as cutoff;
+                # planted on the query point so a leak would rank first
+                recs.append({"dtg": t0 + 100, "geom": Point(
+                    q.x + 1e-5 * i, q.y)})
+        ds.write("kq", recs, fids=[str(i) for i in range(n)])
+        ds.compact("kq")
+
+        orig = knn_mod.knn
+        knn_mod.knn = lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("TTL store fell back to per-point knn")
+        )
+        try:
+            res = knn_many(ds, "kq", [q], k=8, now_ms=now_ms)
+        finally:
+            knn_mod.knn = orig
+        got, _ = res[0]
+        expired = {str(i) for i in range(n) if i % 2 == 1}
+        assert not (set(got.fids.tolist()) & expired), got.fids
+        assert len(got) == 8  # fresh rows fill the heap
