@@ -61,12 +61,18 @@ def pack_boxes(
 def pack_times(times_i32: np.ndarray | None, slots: int = MAX_TIMES) -> np.ndarray:
     """(T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 → padded (``slots``, 4)."""
     if times_i32 is None or len(times_i32) == 0:
-        full = np.array([[0, 0, 2**31 - 1, 2**31 - 1]], dtype=np.int32)
+        # unconstrained sentinel: off_lo = -1 matches every row (offsets are
+        # >= 0) while its endpoints are unhittable, so the exact-mode edge
+        # test never flags rows of a time-unconstrained query (a real (0, 0)
+        # lo endpoint would mark EVERY row of a no-dtg store as a candidate)
+        full = np.array([[0, -1, 2**31 - 1, 2**31 - 1]], dtype=np.int32)
         times_i32 = full
     t = np.asarray(times_i32, dtype=np.int32)
     if len(t) > slots:
+        # widened payloads are flagged non-exactable by the callers, so the
+        # unhittable -1 lo-offset is safe here too
         t = np.array(
-            [[t[:, 0].min(), 0, t[:, 2].max(), 2**31 - 1]], dtype=np.int32
+            [[t[:, 0].min(), -1, t[:, 2].max(), 2**31 - 1]], dtype=np.int32
         )
     pad = np.broadcast_to(_TIME_PAD, (slots - len(t), 4))
     return np.vstack([t, pad])

@@ -822,3 +822,157 @@ def cached_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
 @lru_cache(maxsize=None)
 def cached_batched_density_step(mesh: Mesh, width: int, height: int):
     return make_batched_density_step(mesh, width=width, height=height)
+
+
+def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
+                          capacity: int):
+    """Fused grouped-aggregation scan: the distributed SQL GROUP BY engine
+    (the ``GeoMesaRelation.scala:94`` / Spark relational-aggregation role,
+    SURVEY.md §2.14) as ONE mesh pass — per shard, a segment-reduce of every
+    value column over the group-id column; partials merged across the data
+    axis with ``psum`` (counts/sums) and ``pmin``/``pmax`` (extrema).
+
+    fn(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times) →
+        (cnt (Q, G) int32      — filter-matching rows per group,
+         first (Q, G) int32    — min ``rowid`` among matching rows
+                                 (int32 max where empty) — callers order
+                                 groups by first-matching-row for host-fold
+                                 parity,
+         vcnt (Q, V, G) int32  — non-null values per group,
+         vsum (Q, V, G) f64,
+         vmin (Q, V, G) f64 (+inf where empty),
+         vmax (Q, V, G) f64 (-inf where empty),
+         edge_pos (Q, D, capacity) int32 global positions (-1 pad),
+         edge_hits (Q, D) int32 true per-shard edge-candidate counts)
+
+    ``gid`` is the int32 group id per row (index-sorted order, same perm as
+    the resident x/y columns); ``rowid`` is the ORIGINAL row index per lane
+    (the perm value); ``vals`` is (V, N) f64 with NaN for nulls.
+    The filter follows the exact-count contract
+    (:func:`make_batched_edge_gather_step`): rows in spatial edge buckets or
+    at quantized time-window endpoints — the only rows where the int-domain
+    superset can diverge from the f64 predicate — are EXCLUDED from the
+    device fold and returned compacted; the host tests them exactly and ADDS
+    the passing ones, which (unlike subtracting false positives) is a sound
+    correction for min/max too. ``hits > capacity`` on any shard means that
+    query's correction set truncated — the caller falls back for it.
+    """
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),        # x
+            P(DATA_AXIS),        # y
+            P(DATA_AXIS),        # bins
+            P(DATA_AXIS),        # offs
+            P(DATA_AXIS),        # gid
+            P(DATA_AXIS),        # rowid
+            P(None, DATA_AXIS),  # vals (V, N)
+            P(),                 # true_n
+            P(QUERY_AXIS, None, None),  # boxes
+            P(QUERY_AXIS, None, None),  # times
+        ),
+        out_specs=(
+            P(QUERY_AXIS, None),
+            P(QUERY_AXIS, None),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, DATA_AXIS, None),
+            P(QUERY_AXIS, DATA_AXIS),
+        ),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times):
+        n = x.shape[0]
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+
+        def one(args_q):
+            boxes_q, times_q = args_q  # (B, 4), (T, 4)
+            in_box = jnp.zeros((n,), dtype=jnp.bool_)
+            on_edge = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(boxes_q.shape[0]):
+                ins, edg = _slot_point(x, y, boxes_q[k])
+                in_box |= ins
+                on_edge |= edg
+            time_edge = jnp.zeros((n,), dtype=jnp.bool_)
+            for k in range(times_q.shape[0]):
+                time_edge |= _slot_time_edge(bins, offs, times_q[k])
+            in_all = (
+                in_box
+                & _batched_time_match(bins, offs, times_q[None])[0]
+                & rows_valid
+            )
+            boundary = in_all & (on_edge | time_edge)
+            fold = in_all & ~(on_edge | time_edge)
+            # non-folding rows route to an overflow segment that is sliced
+            # off — segment ids stay static-shape friendly
+            seg = jnp.where(fold, gid, n_groups)
+            cnt = jax.ops.segment_sum(
+                fold.astype(jnp.int32), seg, num_segments=n_groups + 1
+            )[:n_groups]
+            imax = jnp.int32(np.iinfo(np.int32).max)
+            first = jax.ops.segment_min(
+                jnp.where(fold, rowid, imax), seg,
+                num_segments=n_groups + 1,
+            )[:n_groups]
+            if n_vals:
+                vcnts, vsums, vmins, vmaxs = [], [], [], []
+                for v in range(n_vals):
+                    vv = vals[v]
+                    ok = fold & ~jnp.isnan(vv)
+                    segv = jnp.where(ok, gid, n_groups)
+                    vcnts.append(jax.ops.segment_sum(
+                        ok.astype(jnp.int32), segv,
+                        num_segments=n_groups + 1)[:n_groups])
+                    vsums.append(jax.ops.segment_sum(
+                        jnp.where(ok, vv, 0.0), segv,
+                        num_segments=n_groups + 1)[:n_groups])
+                    vmins.append(jax.ops.segment_min(
+                        jnp.where(ok, vv, jnp.inf), segv,
+                        num_segments=n_groups + 1)[:n_groups])
+                    vmaxs.append(jax.ops.segment_max(
+                        jnp.where(ok, vv, -jnp.inf), segv,
+                        num_segments=n_groups + 1)[:n_groups])
+                vcnt, vsum = jnp.stack(vcnts), jnp.stack(vsums)
+                vmin, vmax = jnp.stack(vmins), jnp.stack(vmaxs)
+            else:
+                vcnt = jnp.zeros((0, n_groups), dtype=jnp.int32)
+                vsum = jnp.zeros((0, n_groups))
+                vmin = jnp.zeros((0, n_groups))
+                vmax = jnp.zeros((0, n_groups))
+            dest = jnp.where(
+                boundary, jnp.cumsum(boundary.astype(jnp.int32)) - 1, capacity
+            )
+            pos = jnp.full((capacity,), -1, dtype=jnp.int32)
+            pos = pos.at[dest].set(
+                base + jnp.arange(n, dtype=jnp.int32), mode="drop"
+            )
+            return (cnt, first, vcnt, vsum, vmin, vmax, pos,
+                    boundary.sum(dtype=jnp.int32))
+
+        cnt, first, vcnt, vsum, vmin, vmax, pos, hits = jax.lax.map(
+            one, (boxes, times)
+        )
+        return (
+            jax.lax.psum(cnt, DATA_AXIS),
+            jax.lax.pmin(first, DATA_AXIS),
+            jax.lax.psum(vcnt, DATA_AXIS),
+            jax.lax.psum(vsum, DATA_AXIS),
+            jax.lax.pmin(vmin, DATA_AXIS),
+            jax.lax.pmax(vmax, DATA_AXIS),
+            pos[:, None, :],
+            hits[:, None],
+        )
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
+                            capacity: int):
+    return make_grouped_agg_step(mesh, n_groups, n_vals, capacity)

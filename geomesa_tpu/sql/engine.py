@@ -457,6 +457,159 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     )
 
 
+_MESH_AGG_TYPES = (
+    "Integer", "Long", "Float", "Double", "Boolean", "Date",
+)
+
+
+def _having_parts(having: str):
+    """Parse a HAVING clause → (agg item, comparison op, literal); shared
+    between the host fold and the mesh path so their validation errors and
+    comparison semantics can never diverge."""
+    import operator as _op
+
+    hm = _HAVING.match(having)
+    if not hm:
+        raise SqlError(f"unsupported HAVING {having!r} "
+                       "(expected agg(col) <op> number)")
+    hit = _parse_item(hm.group("expr"))
+    if hit.kind != "agg":
+        raise SqlError("HAVING supports aggregate comparisons only")
+    if hit.arg == "*" and hit.fn != "count":
+        raise SqlError(f"{hit.fn.upper()}(*) is not supported")
+    ops = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
+           ">": _op.gt, ">=": _op.ge}
+    return hit, ops[hm.group("op")], float(hm.group("lit"))
+
+
+def _having_passes(hit, op, lit: float, v) -> bool:
+    if v is None:
+        return False
+    try:
+        return bool(op(float(v), lit))
+    except (TypeError, ValueError):
+        raise SqlError(
+            f"HAVING {hit.fn.upper()}({hit.arg}) is not numeric"
+        ) from None
+
+
+def _apply_order_limit(res: SqlResult, order, limit) -> SqlResult:
+    cols = res.columns
+    if order is not None:
+        if order[0] not in cols:
+            raise SqlError(f"ORDER BY {order[0]!r} not in select list")
+        perm = np.argsort(cols[order[0]], kind="stable")
+        if order[1]:
+            perm = perm[::-1]
+        res = SqlResult({k: v[perm] for k, v in cols.items()})
+    if limit is not None:
+        res = SqlResult({k: v[:limit] for k, v in res.columns.items()})
+    return res
+
+
+def _mesh_agg_cast(sft, col: str, fn: str, v):
+    """Mirror the host fold's Python result types from the device's f64
+    partials: integral columns return ints for sum/min/max, AVG is float."""
+    if v is None or fn == "avg":
+        return v
+    t = next(a.type.value for a in sft.attributes if a.name == col)
+    if t in ("Integer", "Long", "Date"):
+        return int(round(v))
+    if t == "Boolean":
+        return int(round(v)) if fn == "sum" else bool(round(v))
+    return float(v)
+
+
+def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
+                    order, limit):
+    """Route the aggregate fold to ``DataStore.aggregate_many`` (the fused
+    mesh segment-reduce). Returns the assembled SqlResult, or None when the
+    query cannot ride the device path — the caller's host fold serves it
+    (and raises its own errors, so validation here only ever declines)."""
+    agg = getattr(ds, "aggregate_many", None)
+    if agg is None:
+        return None
+    try:
+        sft = ds.get_schema(type_name)
+    except Exception:  # noqa: BLE001 — host path raises the real error
+        return None
+    attr_types = {a.name: a.type.value for a in sft.attributes}
+    specs = [i for i in items if i.kind == "agg"]
+    hit = hop = lit = None
+    if having:
+        hit, hop, lit = _having_parts(having)
+        specs = specs + [hit]
+    value_cols = []
+    for it in specs:
+        if it.fn not in ("count", "sum", "min", "max", "avg"):
+            return None
+        if it.arg == "*":
+            if it.fn != "count":
+                return None
+            continue
+        if attr_types.get(it.arg) not in _MESH_AGG_TYPES:
+            return None  # strings/geometries: host fold
+        if it.arg not in value_cols:
+            value_cols.append(it.arg)
+    for g in group_by or []:
+        t = attr_types.get(g)
+        if t is None or t not in (*_MESH_AGG_TYPES, "String", "UUID"):
+            return None
+    res = agg(
+        type_name, [Query(filter=cql)], group_by=group_by,
+        value_cols=value_cols,
+    )[0]
+    if res is None:
+        return None
+    groups = res["groups"]
+    cnt = res["count"]
+    vcols = res["cols"]
+
+    def _value(it, g: int):
+        if it.arg == "*":
+            return int(cnt[g])
+        d = vcols[it.arg]
+        n = int(d["count"][g])
+        if it.fn == "count":
+            return n
+        if n == 0:
+            return None
+        if it.fn == "sum":
+            return _mesh_agg_cast(sft, it.arg, "sum", float(d["sum"][g]))
+        if it.fn == "avg":
+            return float(d["sum"][g]) / n
+        v = float(d["min" if it.fn == "min" else "max"][g])
+        return _mesh_agg_cast(sft, it.arg, it.fn, v)
+
+    idx = list(range(len(groups)))
+    if not group_by and not idx:
+        # no-GROUP-BY over zero rows still yields ONE result row
+        # (COUNT = 0, other aggregates NULL) — host-fold parity
+        groups = [()]
+        cnt = np.zeros(1, dtype=np.int64)
+        vcols = {
+            c: {k: np.zeros(1) for k in ("count", "sum", "min", "max")}
+            for c in vcols
+        }
+        idx = [0]
+    if hit is not None:
+        idx = [
+            g for g in idx if _having_passes(hit, hop, lit, _value(hit, g))
+        ]
+    cols: dict[str, np.ndarray] = {}
+    for it in items:
+        if it.kind == "col":
+            gi = group_by.index(it.arg)
+            cols[it.name] = np.array(
+                [groups[g][gi] for g in idx], dtype=object
+            )
+        else:
+            cols[it.name] = np.array(
+                [_value(it, g) for g in idx], dtype=object
+            )
+    return _apply_order_limit(SqlResult(cols), order, limit)
+
+
 def sql(ds, statement: str) -> SqlResult:
     """Execute a SQL statement against ``ds`` (DataStore or merged view)."""
     # clause keywords are matched on a quote-masked shadow so a WHERE
@@ -562,6 +715,16 @@ def sql(ds, statement: str) -> SqlResult:
                 {items[0].name: np.array([n], dtype=object)}
             )
 
+    # distributed aggregation: the fused mesh segment-reduce serves pure
+    # bbox+time-filtered GROUP BY / SUM / MIN / MAX / AVG / COUNT / HAVING
+    # without materializing rows; anything it declines falls through to the
+    # host fold below (which also owns all validation errors)
+    mesh_res = _mesh_aggregate(
+        ds, type_name, cql, items, group_by, having, order, limit
+    )
+    if mesh_res is not None:
+        return mesh_res
+
     r = ds.query(type_name, Query(filter=cql))
     t = r.table
 
@@ -584,35 +747,16 @@ def sql(ds, statement: str) -> SqlResult:
         groups[seen[k]].append(i)
     group_keys = list(seen)
     if having:
-        hm = _HAVING.match(having)
-        if not hm:
-            raise SqlError(f"unsupported HAVING {having!r} "
-                           "(expected agg(col) <op> number)")
-        hit = _parse_item(hm.group("expr"))
-        if hit.kind != "agg":
-            raise SqlError("HAVING supports aggregate comparisons only")
-        if hit.arg == "*":
-            if hit.fn != "count":
-                raise SqlError(f"{hit.fn.upper()}(*) is not supported")
-        elif hit.arg not in t.columns:
+        hit, hop, lit = _having_parts(having)
+        if hit.arg != "*" and hit.arg not in t.columns:
             raise SqlError(f"unknown HAVING column {hit.arg!r}")
-        import operator as _op
-
-        ops = {"=": _op.eq, "<>": _op.ne, "<": _op.lt, "<=": _op.le,
-               ">": _op.gt, ">=": _op.ge}
-        lit = float(hm.group("lit"))
-        def _passes(g) -> bool:
-            v = _agg_value(hit.fn, hit.arg, t, np.asarray(g, np.int64))
-            if v is None:
-                return False
-            try:
-                return bool(ops[hm.group("op")](float(v), lit))
-            except (TypeError, ValueError):
-                raise SqlError(
-                    f"HAVING {hit.fn.upper()}({hit.arg}) is not numeric"
-                ) from None
-
-        kept = [(k, g) for k, g in zip(group_keys, groups) if _passes(g)]
+        kept = [
+            (k, g) for k, g in zip(group_keys, groups)
+            if _having_passes(
+                hit, hop, lit,
+                _agg_value(hit.fn, hit.arg, t, np.asarray(g, np.int64)),
+            )
+        ]
         group_keys = [k for k, _ in kept]
         groups = [g for _, g in kept]
     cols = {}
@@ -628,14 +772,4 @@ def sql(ds, statement: str) -> SqlResult:
                 ],
                 dtype=object,
             )
-    res = SqlResult(cols)
-    if order is not None:
-        if order[0] not in cols:
-            raise SqlError(f"ORDER BY {order[0]!r} not in select list")
-        perm = np.argsort(cols[order[0]], kind="stable")
-        if order[1]:
-            perm = perm[::-1]
-        res = SqlResult({k: v[perm] for k, v in cols.items()})
-    if limit is not None:
-        res = SqlResult({k: v[:limit] for k, v in res.columns.items()})
-    return res
+    return _apply_order_limit(SqlResult(cols), order, limit)

@@ -15,7 +15,7 @@ Two implementations, mirroring the reference's two-tier test architecture
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
@@ -80,6 +80,11 @@ class _MeshIndexState:
     rows_per_shard: int
     n: int
     kind: str = "points"
+    # lazily-staged grouped-aggregation residency (DataStore.aggregate_many):
+    # group-id/value columns keyed by their column tuple. Lives and dies with
+    # this state object, so a compact/ingest that rebuilds the layout also
+    # drops the cache — no invalidation protocol needed.
+    agg_cache: dict = field(default_factory=dict)
 
     @property
     def nbytes(self) -> int:

@@ -1242,6 +1242,381 @@ class DataStore:
                 out[i] = _exact(q)
         return out
 
+    # -- distributed SQL aggregation (GROUP BY on the mesh) ------------------
+
+    _AGG_MAX_GROUPS = 65536  # beyond this the host fold is the better engine
+
+    def _agg_group_ids(self, main, group_by):
+        """Factorize the GROUP BY key columns over ``main`` → (int32 group id
+        per row, group keys as tuples in first-occurrence row order — the
+        order the host fold produces, so results are order-identical)."""
+        n = len(main)
+        if not group_by:
+            return np.zeros(n, dtype=np.int32), [()]
+        ids: list[np.ndarray] = []
+        vocabs: list[list] = []
+        for g in group_by:
+            vals = main.columns[g].values
+            if (
+                isinstance(vals, np.ndarray)
+                and vals.dtype.kind == "f"
+                and np.isnan(vals).any()
+            ):
+                # host parity is impossible: the host fold's per-object dict
+                # makes EVERY NaN key its own group (nan != nan), while
+                # np.unique collapses them — decline the device path
+                raise ValueError("NaN GROUP BY keys take the host fold")
+            try:
+                uniq, inv = np.unique(vals, return_inverse=True)
+                vocabs.append(list(uniq))
+                ids.append(inv.astype(np.int64))
+            except TypeError:
+                # object column with None/mixed values: dict factorize
+                seen: dict = {}
+                inv = np.empty(n, dtype=np.int64)
+                vocab: list = []
+                for i, v in enumerate(vals):
+                    j = seen.get(v)
+                    if j is None:
+                        j = seen[v] = len(vocab)
+                        vocab.append(v)
+                    inv[i] = j
+                vocabs.append(vocab)
+                ids.append(inv)
+        code = ids[0]
+        for k in range(1, len(ids)):
+            base = len(vocabs[k]) + 1
+            if int(code.max(initial=0)) > (2**62) // base:
+                raise ValueError("group key space overflows the device path")
+            code = code * base + ids[k]
+        uniq_codes, first, inv = np.unique(
+            code, return_index=True, return_inverse=True
+        )
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(len(order), dtype=np.int64)
+        rank[order] = np.arange(len(order))
+        gid = rank[inv].astype(np.int32)
+        keys = []
+        for ci in uniq_codes[order]:
+            c = int(ci)
+            parts = []
+            for k in range(len(ids) - 1, 0, -1):
+                base = len(vocabs[k]) + 1
+                parts.append(vocabs[k][c % base])
+                c //= base
+            parts.append(vocabs[0][c])
+            keys.append(tuple(reversed(parts)))
+        return gid, keys
+
+    def _agg_residency(self, dev, main, perm, group_by, value_cols):
+        """Stage (or fetch from ``dev.agg_cache``) the group-id column and a
+        stacked (V, N) f64 value matrix into the mesh layout, aligned with
+        ``dev``'s sharded x/y columns (same perm, same padding). The cache
+        lives on the state object, so compactions that rebuild the layout
+        drop it automatically. Raises TypeError/ValueError for columns the
+        f64 device fold cannot carry (strings, geometries) — callers fall
+        back to the host fold."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from geomesa_tpu.parallel.mesh import (
+            DATA_AXIS,
+            data_shards,
+            pad_rows,
+            shard_columns,
+        )
+        from geomesa_tpu.store.backends import JOIN_BLOCK
+
+        mesh = self.backend._get_mesh()
+        gkey = ("gid", tuple(group_by or ()))
+        cached = dev.agg_cache.get(gkey)
+        if cached is None:
+            gid_orig, keys = self._agg_group_ids(main, group_by)
+            if len(keys) > self._AGG_MAX_GROUPS:
+                raise ValueError("group cardinality beyond the device path")
+            cols, _, _ = shard_columns(
+                mesh, {"gid": gid_orig[perm].astype(np.int32)},
+                multiple=JOIN_BLOCK,
+            )
+            cached = (cols["gid"], gid_orig, keys)
+            dev.agg_cache[gkey] = cached
+        rowid = dev.agg_cache.get(("rowid",))
+        if rowid is None:
+            # original row index per lane: the device computes each group's
+            # first MATCHING row (segment_min), which orders the output
+            # groups exactly as the host fold's first-occurrence-over-
+            # filtered-rows construction does
+            rcols, _, _ = shard_columns(
+                mesh, {"rowid": np.asarray(perm, dtype=np.int32)},
+                multiple=JOIN_BLOCK, pad_value=np.iinfo(np.int32).max,
+            )
+            rowid = rcols["rowid"]
+            dev.agg_cache[("rowid",)] = rowid
+        vkey = ("vals", tuple(value_cols))
+        got = dev.agg_cache.get(vkey)
+        if got is None:
+            host = []
+            for c in value_cols:
+                col = main.columns[c]
+                v = np.asarray(col.values, dtype=np.float64).copy()
+                if col.valid is not None:
+                    v[~col.valid] = np.nan
+                host.append(v)
+            hv = (
+                np.stack(host)
+                if host
+                else np.zeros((0, len(main)), dtype=np.float64)
+            )
+            shards = data_shards(mesh)
+            padded = pad_rows(max(len(main), shards), shards, JOIN_BLOCK)
+            pv = np.zeros((len(value_cols), padded), dtype=np.float64)
+            pv[:, : len(main)] = hv[:, perm]
+            dv = jax.device_put(
+                pv, NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+            )
+            got = (dv, hv)
+            dev.agg_cache[vkey] = got
+        return cached, rowid, got[0], got[1]
+
+    def aggregate_many(self, type_name: str, queries, group_by=None,
+                       value_cols=()):
+        """Batched grouped aggregation on the mesh: ONE fused pass computes,
+        per query, COUNT(*) plus per-value-column count/sum/min/max for
+        every GROUP BY key — a per-shard segment-reduce merged across the
+        data axis with psum (counts/sums) and pmin/pmax (extrema). The
+        distributed relational-aggregation role the reference delegates to
+        Spark (``geomesa-spark-sql/.../GeoMesaRelation.scala:47,94``,
+        SURVEY.md §2.14).
+
+        Returns one entry per query: ``None`` when that query cannot ride
+        the mesh (residual filters beyond bbox+time, hints/auths/limits,
+        truncated edge lanes, non-numeric value columns, TTL stores, device
+        trouble) — callers run their host fold for those — else
+        ``{"groups": [key tuples], "count": (G,) int64, "cols": {col:
+        {"count": (G,) int64, "sum"/"min"/"max": (G,) f64 (NaN = empty)}}}``
+        with groups in first-occurrence row order (host-fold parity) and
+        only groups with at least one matching row included.
+
+        Exactness: the device folds the int-domain interior; edge-bucket
+        rows (the only int/f64 divergence sites) are EXCLUDED on device,
+        re-tested host-side against the full f64 filter AST, and ADDED —
+        sound for min/max, unlike subtracting false positives. Pending
+        hot-tier (delta) rows are folded host-side, so live stores stay on
+        the mesh path. Value sums ride f64 (ints beyond 2**53 lose
+        precision — the documented Spark-parity caveat).
+        """
+        st = self._state(type_name)
+        qs = [
+            Query(filter=q)
+            if isinstance(q, (str, ast.Filter)) or q is None
+            else q
+            for q in queries
+        ]
+        if self._interceptors:
+            qs = [self._intercept(type_name, st.sft, q) for q in qs]
+        out: list = [None] * len(qs)
+        group_by = list(group_by) if group_by else None
+        value_cols = list(value_cols)
+        # TTL stores: expired rows sit in the device layout and a grouped
+        # fold cannot correct them additively — the host fold serves
+        if self._age_off_ttl_ms(st.sft) is not None:
+            return out
+        main, indices, backend_state, _stats, delta = st.snapshot()
+        main_n = 0 if main is None else len(main)
+        dev = dev_name = None
+        if isinstance(self.backend, TpuBackend) and self._device_available():
+            dev, dev_name = TpuBackend.point_state(backend_state)
+        perm = None
+        if dev is not None and dev_name in (indices or {}):
+            perm = indices[dev_name].perm
+        if dev is None or perm is None or main_n == 0:
+            return out
+        for c in (group_by or []) + value_cols:
+            if c not in main.columns:
+                return out
+        try:
+            (dev_gid, gid_orig, keys), dev_rowid, dev_vals, host_vals = (
+                self._agg_residency(dev, main, perm, group_by, value_cols)
+            )
+        except (TypeError, ValueError):
+            return out
+        G = len(keys)
+        pending = self._batch_payloads(st, qs, overlap=False)
+        live = [(i, p) for i, p, ok in pending if p is not None and ok]
+        for i, p, ok in pending:
+            if p is None:  # provably-disjoint filter: zero rows, no groups
+                out[i] = self._assemble_agg_empty(value_cols)
+        if not live:
+            return out
+        import jax.numpy as jnp
+
+        from geomesa_tpu.parallel.mesh import pad_query_axis
+        from geomesa_tpu.parallel.query import cached_grouped_agg_step
+
+        mesh = self.backend._get_mesh()
+        G_pad = 1 << max(0, (G - 1).bit_length())
+        cap = 512
+        boxes = np.stack([p[0] for _, p in live])
+        times = np.stack([p[1] for _, p in live])
+        (boxes, times), _ = pad_query_axis(mesh, boxes, times)
+        try:
+            step = cached_grouped_agg_step(mesh, G_pad, len(value_cols), cap)
+            c = dev.cols
+            res = step(
+                c["x"], c["y"], c["bins"], c["offs"], dev_gid, dev_rowid,
+                dev_vals, jnp.int32(main_n), jnp.asarray(boxes),
+                jnp.asarray(times),
+            )
+            cnt, first, vcnt, vsum, vmin, vmax, epos, ehits = map(
+                np.asarray, res
+            )
+        except Exception as e:  # noqa: BLE001 — failover to the host fold
+            if not self._is_device_error(e):
+                raise
+            self._trip_device_circuit(e)
+            self.metrics.counter("store.query.device_failovers").inc()
+            return out
+        self._note_device_ok()
+        for k, (i, _) in enumerate(live):
+            if (ehits[k] > cap).any():
+                continue  # truncated correction lanes: host fold
+            out[i] = self._assemble_agg(
+                qs[i], main, delta, keys, value_cols,
+                cnt[k, :G].astype(np.int64).copy(),
+                first[k, :G].astype(np.int64).copy(),
+                vcnt[k, :, :G].astype(np.int64).copy(),
+                vsum[k, :, :G].copy(),
+                vmin[k, :, :G].copy(),
+                vmax[k, :, :G].copy(),
+                epos[k], ehits[k], perm, gid_orig, host_vals, group_by,
+            )
+            self.metrics.counter("store.queries").inc()
+            self._audit(type_name, qs[i], 0.0, 0.0, int(cnt[k, :G].sum()))
+        return out
+
+    @staticmethod
+    def _assemble_agg_empty(value_cols):
+        z64 = np.zeros(0, dtype=np.int64)
+        zf = np.zeros(0, dtype=np.float64)
+        return {
+            "groups": [],
+            "count": z64,
+            "cols": {
+                c: {"count": z64, "sum": zf, "min": zf, "max": zf}
+                for c in value_cols
+            },
+        }
+
+    def _assemble_agg(self, q, main, delta, keys, value_cols, cnt, first,
+                      vcnt, vsum, vmin, vmax, epos, ehits, perm, gid_orig,
+                      host_vals, group_by):
+        """Fold the host-side corrections into the device partials: edge
+        candidates re-tested exactly (added, never subtracted) and pending
+        delta rows (which may introduce new group keys). Groups are ordered
+        by their first MATCHING row index — identical to the host fold's
+        first-occurrence-over-filtered-rows construction (delta rows order
+        after the main tier at ``main_n + delta_row``, as in query())."""
+        f = q.resolved_filter()
+        V = len(value_cols)
+        main_n = len(main)
+
+        def _fold_row(g: int, row_order: int, vals_at):
+            cnt[g] += 1
+            first[g] = min(first[g], row_order)
+            for v in range(V):
+                x = vals_at(v)
+                if x is not None and not np.isnan(x):
+                    vcnt[v][g] += 1
+                    vsum[v][g] += x
+                    vmin[v][g] = min(vmin[v][g], x)
+                    vmax[v][g] = max(vmax[v][g], x)
+
+        cand = np.concatenate(
+            [epos[d, : ehits[d]] for d in range(epos.shape[0])]
+        ).astype(np.int64)
+        if len(cand):
+            rows = perm[cand]
+            if f is not None:
+                m = np.asarray(f.mask(main.take(rows)), dtype=bool)
+                rows = rows[m]
+            for r in rows:
+                _fold_row(int(gid_orig[r]), int(r), lambda v: host_vals[v][r])
+
+        keys = list(keys)
+        if delta is not None and len(delta):
+            dm = (
+                np.ones(len(delta), dtype=bool)
+                if f is None
+                else np.asarray(f.mask(delta), dtype=bool)
+            )
+            drows = np.nonzero(dm)[0]
+            if len(drows):
+                key_pos = {kk: i for i, kk in enumerate(keys)}
+                extra_n = 0
+                dvals = [delta.columns[c] for c in value_cols]
+                gcols = [delta.columns[g].values for g in (group_by or [])]
+                for r in drows:
+                    kk = tuple(gc[r] for gc in gcols)
+                    g = key_pos.get(kk)
+                    if g is None:
+                        g = key_pos[kk] = len(keys)
+                        keys.append(kk)
+                        extra_n += 1
+                    if g >= len(cnt):
+                        grow = g + 1 - len(cnt)
+                        cnt = np.concatenate([cnt, np.zeros(grow, np.int64)])
+                        first = np.concatenate(
+                            [first, np.full(grow, np.iinfo(np.int64).max)]
+                        )
+                        vcnt = np.concatenate(
+                            [vcnt, np.zeros((V, grow), np.int64)], axis=1
+                        ) if V else vcnt
+                        vsum = np.concatenate(
+                            [vsum, np.zeros((V, grow))], axis=1
+                        ) if V else vsum
+                        vmin = np.concatenate(
+                            [vmin, np.full((V, grow), np.inf)], axis=1
+                        ) if V else vmin
+                        vmax = np.concatenate(
+                            [vmax, np.full((V, grow), -np.inf)], axis=1
+                        ) if V else vmax
+                    _fold_row(
+                        g, main_n + int(r),
+                        lambda v: (
+                            None
+                            if dvals[v].valid is not None
+                            and not dvals[v].valid[r]
+                            else float(dvals[v].values[r])
+                        ),
+                    )
+        # keep only groups with matching rows (host parity: groups are
+        # formed FROM the matched rows), ordered by first matching row —
+        # the host fold's first-occurrence order; no-GROUP-BY keeps its
+        # single group
+        if group_by:
+            alive = np.nonzero(cnt > 0)[0]
+            alive = alive[np.argsort(first[alive], kind="stable")]
+        else:
+            alive = np.arange(len(cnt))
+        cols = {}
+        for v, c in enumerate(value_cols):
+            mn = vmin[v][alive].astype(np.float64)
+            mx = vmax[v][alive].astype(np.float64)
+            empty = vcnt[v][alive] == 0
+            mn[empty] = np.nan
+            mx[empty] = np.nan
+            cols[c] = {
+                "count": vcnt[v][alive],
+                "sum": vsum[v][alive].astype(np.float64),
+                "min": mn,
+                "max": mx,
+            }
+        return {
+            "groups": [keys[int(i)] for i in alive],
+            "count": cnt[alive],
+            "cols": cols,
+        }
+
     def density_many(
         self,
         type_name: str,

@@ -80,6 +80,7 @@ class JournalBus:
         self._sub_offsets: dict[str, int] = {}  # tailer dispatch cursor
         self._tailer: threading.Thread | None = None
         self._stop = threading.Event()
+        self._migrated: set[tuple[str, str]] = set()
 
     # -- paths ---------------------------------------------------------------
     def _safe(self, topic: str) -> str:
@@ -102,6 +103,12 @@ class JournalBus:
         )
 
     def _migrate_legacy(self, topic: str, new: str, ext: str) -> None:
+        # checked once per (topic, ext) per bus — path lookups are on every
+        # publish/poll, so the steady state must not pay stat calls
+        key = (topic, ext)
+        if key in self._migrated:
+            return
+        self._migrated.add(key)
         legacy = os.path.join(
             self.root, f"{self._legacy_safe(topic)}{ext}"
         )
