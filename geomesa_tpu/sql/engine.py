@@ -733,7 +733,9 @@ def sql(ds, statement: str) -> SqlResult:
             it.name: np.array([_agg_value(it.fn, it.arg, t, np.arange(len(t)))], dtype=object)
             for it in items
         }
-        return SqlResult(cols)
+        # same ORDER BY/LIMIT tail as the grouped and mesh paths — the two
+        # engines must be indistinguishable result-wise
+        return _apply_order_limit(SqlResult(cols), order, limit)
 
     keys = [t.columns[g].values.astype(object) for g in group_by]
     combo = np.array(list(zip(*keys)), dtype=object)

@@ -1352,31 +1352,40 @@ class DataStore:
             )
             rowid = rcols["rowid"]
             dev.agg_cache[("rowid",)] = rowid
-        vkey = ("vals", tuple(value_cols))
-        got = dev.agg_cache.get(vkey)
-        if got is None:
-            host = []
-            for c in value_cols:
+        # value columns cache PER COLUMN (one device + one host copy each,
+        # however many SELECT-list combinations arrive); the per-request
+        # (V, N) matrix is a device-side concat — no host↔device transfer
+        sharding = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+        shards = data_shards(mesh)
+        padded = pad_rows(max(len(main), shards), shards, JOIN_BLOCK)
+        per_dev, per_host = [], []
+        for c in value_cols:
+            got = dev.agg_cache.get(("val", c))
+            if got is None:
                 col = main.columns[c]
                 v = np.asarray(col.values, dtype=np.float64).copy()
                 if col.valid is not None:
                     v[~col.valid] = np.nan
-                host.append(v)
-            hv = (
-                np.stack(host)
-                if host
-                else np.zeros((0, len(main)), dtype=np.float64)
-            )
-            shards = data_shards(mesh)
-            padded = pad_rows(max(len(main), shards), shards, JOIN_BLOCK)
-            pv = np.zeros((len(value_cols), padded), dtype=np.float64)
-            pv[:, : len(main)] = hv[:, perm]
+                pv = np.zeros((1, padded), dtype=np.float64)
+                pv[0, : len(main)] = v[perm]
+                got = (jax.device_put(pv, sharding), v)
+                dev.agg_cache[("val", c)] = got
+            per_dev.append(got[0])
+            per_host.append(got[1])
+        if per_dev:
+            import jax.numpy as jnp
+
+            dv = jax.device_put(jnp.concatenate(per_dev, axis=0), sharding)
+        else:
             dv = jax.device_put(
-                pv, NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+                np.zeros((0, padded), dtype=np.float64), sharding
             )
-            got = (dv, hv)
-            dev.agg_cache[vkey] = got
-        return cached, rowid, got[0], got[1]
+        hv = (
+            np.stack(per_host)
+            if per_host
+            else np.zeros((0, len(main)), dtype=np.float64)
+        )
+        return cached, rowid, dv, hv
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
                        value_cols=()):
@@ -1491,7 +1500,11 @@ class DataStore:
                 epos[k], ehits[k], perm, gid_orig, host_vals, group_by,
             )
             self.metrics.counter("store.queries").inc()
-            self._audit(type_name, qs[i], 0.0, 0.0, int(cnt[k, :G].sum()))
+            # audit the POST-correction total (edge + delta rows included),
+            # matching what count_many/density_many record
+            self._audit(
+                type_name, qs[i], 0.0, 0.0, int(out[i]["count"].sum())
+            )
         return out
 
     @staticmethod

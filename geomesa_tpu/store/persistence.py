@@ -96,6 +96,172 @@ def _read_table(path: Path, file_format: str, columns=None) -> pa.Table:
     return pq.read_table(path, columns=columns)
 
 
+def _stage_type(ds, name: str, root: Path, gen: int,
+                partition_by_time: bool, file_format: str,
+                staged: list) -> dict:
+    """Compact + write one type's shards under temp names (appended to
+    ``staged`` for the caller's atomic rename pass) → its manifest entry."""
+    ds.compact(name)  # fold the hot tier in so the catalog is fully sorted
+    st = ds._state(name)
+    tdir = root / name
+    tdir.mkdir(exist_ok=True)
+    files = []
+    count = 0
+    scheme_spec = "flat"
+    if st.table is not None and len(st.table):
+        count = len(st.table)
+        if partition_by_time:
+            from geomesa_tpu.store.partitions import scheme_for
+
+            scheme = scheme_for(st.sft)
+            scheme_spec = str(
+                (st.sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
+            )
+            keys = scheme.keys(st.sft, st.table)
+            parts = {
+                str(k): np.nonzero(keys == k)[0] for k in np.unique(keys)
+            }
+        else:
+            parts = {"all": np.arange(count)}
+        # lossless WKB by default (reference stores full-precision
+        # doubles); schemas may opt into compact fixed-point TWKB via
+        # user-data — the codec tag in each file's field metadata keeps
+        # catalogs readable either way
+        geom_enc = str(
+            (st.sft.user_data or {}).get("geomesa.fs.geometry-encoding", "wkb")
+        )
+        twkb_prec = int(
+            (st.sft.user_data or {}).get("geomesa.twkb.precision", 7)
+        )
+        for key, rows in parts.items():
+            at = to_arrow(
+                st.table.take(rows),
+                geometry_encoding=geom_enc,
+                twkb_precision=twkb_prec,
+            )
+            # short digest disambiguates keys the sanitizer would collide
+            # (e.g. 'v 1' and 'v-1' both sanitize to 'v-1')
+            import hashlib
+
+            safe = "".join(
+                c if c.isalnum() or c in "._" else "-" for c in str(key)
+            )[:40]
+            digest = hashlib.sha1(str(key).encode()).hexdigest()[:8]
+            fn = f"part-{safe}-{digest}-g{gen}.{file_format}"
+            tmp = tdir / (fn + ".tmp")
+            _write_table(at, tmp, file_format)
+            staged.append((tmp, tdir / fn))
+            files.append(
+                {"file": fn, "rows": int(len(rows)), "partition": str(key)}
+            )
+    return {
+        "spec": st.sft.to_spec(),
+        "count": count,
+        "scheme": scheme_spec,
+        "index_layout": st.sft.index_layout,
+        "files": files,
+    }
+
+
+class SchemaExistsError(ValueError):
+    """Raised by :func:`register_schema` for the losing concurrent creator."""
+
+
+def _read_or_init_manifest(root: Path, file_format: str = "parquet") -> dict:
+    mpath = root / MANIFEST
+    if mpath.exists():
+        manifest = json.loads(mpath.read_text())
+        if manifest.get("version") not in SUPPORTED_VERSIONS:
+            raise ValueError(
+                f"unsupported catalog version: {manifest.get('version')}"
+            )
+        return manifest
+    return {
+        "version": FORMAT_VERSION,
+        "generation": 0,
+        "format": file_format,
+        "types": {},
+    }
+
+
+def _write_manifest(root: Path, manifest: dict) -> None:
+    mtmp = root / (MANIFEST + ".tmp")
+    mtmp.write_text(json.dumps(manifest, indent=2))
+    os.replace(mtmp, root / MANIFEST)
+
+
+def register_schema(path: str, sft) -> dict:
+    """Coordinated schema CREATION in a shared catalog: merge a zero-row
+    entry for ``sft`` into the manifest under the cross-host catalog lock.
+
+    The multi-writer half of the ``DistributedLocking.scala:14`` role
+    (SURVEY.md §2.3): many processes/hosts share one catalog; exactly one
+    concurrent ``register_schema`` of a name wins, losers raise
+    :class:`SchemaExistsError`, and the manifest can never tear (tmp-write
+    + atomic rename, all under :func:`geomesa_tpu.utils.locks.catalog_lock`
+    = flock + expiring lease). Unlike :func:`save` — a whole-store
+    checkpoint that OWNS its catalog — this merges, so writers owning
+    different types coexist (see :func:`save_type`)."""
+    from geomesa_tpu.utils.locks import catalog_lock
+
+    with catalog_lock(path):
+        root = Path(path)
+        manifest = _read_or_init_manifest(root)
+        if sft.name in manifest["types"]:
+            raise SchemaExistsError(
+                f"schema {sft.name!r} already exists in catalog {path!r}"
+            )
+        manifest["types"][sft.name] = {
+            "spec": sft.to_spec(),
+            "count": 0,
+            "scheme": "flat",
+            "index_layout": sft.index_layout,
+            "files": [],
+        }
+        (root / sft.name).mkdir(exist_ok=True)
+        _write_manifest(root, manifest)
+        return manifest
+
+
+def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
+              file_format: str | None = None) -> dict:
+    """Coordinated per-type checkpoint into a SHARED catalog: write ONE
+    type's shards and merge its manifest entry, leaving every other type's
+    entry and files untouched (the multi-writer companion of
+    :func:`register_schema`; :func:`save` remains the whole-store
+    checkpoint). Same crash-safe commit order as :func:`save`: shards
+    rename in, manifest flips atomically, then only THIS type's stale
+    generations are collected. Returns the new manifest entry."""
+    from geomesa_tpu.utils.locks import catalog_lock
+
+    with catalog_lock(path):
+        root = Path(path)
+        root.mkdir(parents=True, exist_ok=True)
+        manifest = _read_or_init_manifest(
+            root, file_format=file_format or "parquet"
+        )
+        fmt = manifest.get("format", "parquet")
+        if file_format is not None and file_format != fmt:
+            raise ValueError(
+                f"catalog format is {fmt!r}; cannot save {file_format!r}"
+            )
+        gen = int(manifest.get("generation", 0)) + 1
+        manifest["generation"] = gen
+        staged: list[tuple[Path, Path]] = []
+        entry = _stage_type(
+            ds, type_name, root, gen, partition_by_time, fmt, staged
+        )
+        manifest["types"][type_name] = entry
+        for tmp, final in staged:
+            os.replace(tmp, final)
+        _write_manifest(root, manifest)
+        keep = {f["file"] for f in entry["files"]}
+        for p in (root / type_name).glob("part-*"):
+            if p.name not in keep:
+                p.unlink()
+        return entry
+
+
 def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> dict:
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
@@ -117,64 +283,9 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
     }
     staged: list[tuple[Path, Path]] = []  # (tmp, final) shard renames
     for name in ds.list_schemas():
-        ds.compact(name)  # fold the hot tier in so the catalog is fully sorted
-        st = ds._state(name)
-        tdir = root / name
-        tdir.mkdir(exist_ok=True)
-        files = []
-        count = 0
-        scheme_spec = "flat"
-        if st.table is not None and len(st.table):
-            count = len(st.table)
-            if partition_by_time:
-                from geomesa_tpu.store.partitions import scheme_for
-
-                scheme = scheme_for(st.sft)
-                scheme_spec = str(
-                    (st.sft.user_data or {}).get("geomesa.fs.scheme", "datetime")
-                )
-                keys = scheme.keys(st.sft, st.table)
-                parts = {
-                    str(k): np.nonzero(keys == k)[0] for k in np.unique(keys)
-                }
-            else:
-                parts = {"all": np.arange(count)}
-            # lossless WKB by default (reference stores full-precision
-            # doubles); schemas may opt into compact fixed-point TWKB via
-            # user-data — the codec tag in each file's field metadata keeps
-            # catalogs readable either way
-            geom_enc = str(
-                (st.sft.user_data or {}).get("geomesa.fs.geometry-encoding", "wkb")
-            )
-            twkb_prec = int(
-                (st.sft.user_data or {}).get("geomesa.twkb.precision", 7)
-            )
-            for key, rows in parts.items():
-                at = to_arrow(
-                    st.table.take(rows),
-                    geometry_encoding=geom_enc,
-                    twkb_precision=twkb_prec,
-                )
-                # short digest disambiguates keys the sanitizer would collide
-                # (e.g. 'v 1' and 'v-1' both sanitize to 'v-1')
-                import hashlib
-
-                safe = "".join(
-                    c if c.isalnum() or c in "._" else "-" for c in str(key)
-                )[:40]
-                digest = hashlib.sha1(str(key).encode()).hexdigest()[:8]
-                fn = f"part-{safe}-{digest}-g{gen}.{file_format}"
-                tmp = tdir / (fn + ".tmp")
-                _write_table(at, tmp, file_format)
-                staged.append((tmp, tdir / fn))
-                files.append({"file": fn, "rows": int(len(rows)), "partition": str(key)})
-        manifest["types"][name] = {
-            "spec": st.sft.to_spec(),
-            "count": count,
-            "scheme": scheme_spec,
-            "index_layout": st.sft.index_layout,
-            "files": files,
-        }
+        manifest["types"][name] = _stage_type(
+            ds, name, root, gen, partition_by_time, file_format, staged
+        )
 
     # crash-safe commit order: new shards land under temp names above and
     # rename into generation-unique final names (never overwriting a file the

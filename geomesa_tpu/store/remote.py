@@ -14,6 +14,7 @@ data lives, only Arrow results cross the wire.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -29,11 +30,18 @@ __all__ = ["RemoteDataStore"]
 
 
 class RemoteDataStore:
-    """Read-only client over a geomesa_tpu REST endpoint.
+    """Client over a geomesa_tpu REST endpoint — reads AND writes.
 
     Implements the store surface ``MergedDataStoreView`` consumes
     (``get_schema`` / ``list_schemas`` / ``query`` / ``stats_count``), so a
-    federation can mix in-process stores and remote slices freely.
+    federation can mix in-process stores and remote slices freely; the
+    write surface (``create_schema`` / ``write`` / ``update_features`` /
+    ``delete_features`` / ``delete_schema``) forwards mutations to the
+    owning process (VERDICT r3 item 3 — the write half of the multi-slice
+    federation, SURVEY.md §2.20 P10). Conflicts surface as the same
+    exception types the local store raises (ValueError for an existing
+    schema, KeyError for missing features), so callers handle local and
+    remote stores uniformly.
     """
 
     def __init__(self, base_url: str, timeout_s: float = 30.0):
@@ -50,6 +58,36 @@ class RemoteDataStore:
 
     def _get_json(self, path: str, params: dict | None = None):
         return json.loads(self._get(path, params))
+
+    def _send(self, method: str, path: str, body: dict | None = None,
+              params: dict | None = None):
+        """JSON mutation request; server 4xx errors re-raise as the local
+        store's exception types (the web layer maps ValueError→400,
+        KeyError→404, PermissionError→403 — invert that mapping here)."""
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                raw = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                raise  # server/proxy trouble is NOT a conflict/validation
+            try:
+                msg = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                msg = str(e)
+            if e.code == 404:
+                raise KeyError(msg) from None
+            if e.code == 403:
+                raise PermissionError(msg) from None
+            raise ValueError(msg) from None
+        return json.loads(raw) if raw else None
 
     # -- store surface --------------------------------------------------------
     def list_schemas(self) -> list[str]:
@@ -87,3 +125,80 @@ class RemoteDataStore:
             params["cql"] = cql if isinstance(cql, str) else ast.to_cql(cql)
         out = self._get_json(f"/api/schemas/{type_name}/stats/count", params)
         return float(out["count"])
+
+    # -- write forwarding (P10 write half) ------------------------------------
+    def create_schema(self, name_or_sft, spec: str | None = None) -> None:
+        """Create a schema on the owning process. Raises ValueError when the
+        type already exists there — concurrent creators race at the owner's
+        in-process serialization, so exactly one wins cluster-wide."""
+        if isinstance(name_or_sft, FeatureType):
+            name, spec = name_or_sft.name, name_or_sft.to_spec()
+        else:
+            name = name_or_sft
+            if spec is None:
+                raise ValueError("create_schema needs (name, spec) or a FeatureType")
+        self._send("POST", "/api/schemas", {"name": name, "spec": spec})
+        self._schemas.pop(name, None)
+
+    def _feature_collection(self, type_name: str, data, fids) -> dict:
+        from geomesa_tpu.geometry.geojson import geometry_to_geojson
+        from geomesa_tpu.geometry.types import Geometry
+
+        sft = self.get_schema(type_name)
+        if isinstance(data, FeatureTable):
+            fids = list(data.fids) if fids is None else list(fids)
+            data = [data.record(i) for i in range(len(data))]
+        feats = []
+        for i, rec in enumerate(data):
+            props = {}
+            geom = None
+            for k, v in rec.items():
+                if isinstance(v, Geometry):
+                    if k == sft.geom_field:
+                        geom = geometry_to_geojson(v)
+                        continue
+                    v = geometry_to_geojson(v)
+                elif isinstance(v, np.generic):
+                    v = v.item()
+                props[k] = v
+            f = {"type": "Feature", "geometry": geom, "properties": props}
+            if fids is not None:
+                f["id"] = str(fids[i])
+            feats.append(f)
+        return {"type": "FeatureCollection", "features": feats}
+
+    def write(self, type_name: str, data, fids=None) -> int:
+        """Append features on the owning process (GeoJSON over the wire)."""
+        body = self._feature_collection(type_name, data, fids)
+        return int(
+            self._send("POST", f"/api/schemas/{type_name}/features", body)
+            ["written"]
+        )
+
+    def update_features(self, type_name: str, data, fids) -> int:
+        """WFS-T Update analog: replace features by id on the owner."""
+        if fids is None:
+            raise ValueError("update_features requires explicit fids")
+        body = self._feature_collection(type_name, data, fids)
+        return int(
+            self._send("PUT", f"/api/schemas/{type_name}/features", body)
+            ["updated"]
+        )
+
+    def delete_features(self, type_name: str, fids) -> int:
+        return int(
+            self._send(
+                "DELETE", f"/api/schemas/{type_name}/features",
+                {"fids": [str(f) for f in fids]},
+            )["deleted"]
+        )
+
+    def delete_schema(self, name: str) -> None:
+        self._send("DELETE", f"/api/schemas/{name}")
+        self._schemas.pop(name, None)
+
+    def update_schema(self, name: str, **changes) -> None:
+        """Schema evolution on the owner: ``add=``/``keywords=``/
+        ``rename_to=`` (the PATCH body keys of the web layer)."""
+        self._send("PATCH", f"/api/schemas/{name}", dict(changes))
+        self._schemas.pop(name, None)

@@ -4,10 +4,16 @@ Role parity: ``geomesa-index-api/.../index/utils/DistributedLocking.scala:14``
 (SURVEY.md §2.3): the reference wraps schema create/update/delete in a
 Zookeeper (Curator) lock keyed by the catalog path so concurrent clients can't
 corrupt shared metadata. Here the shared medium is the persisted catalog
-directory, so the lock is an ``fcntl.flock`` on a lockfile inside it — correct
-across processes on one host and over NFS mounts that support flock; the
-multi-slice coordination story (SURVEY.md §5) goes through the job scheduler
-instead of a lock service.
+directory and the lock is layered:
+
+- :func:`lease_lock` — a CROSS-HOST expiring lease: ``O_CREAT|O_EXCL``
+  creation of a lease file (atomic on local filesystems and on NFSv3+) with
+  a wall-clock expiry; stale leases are broken by an atomic rename, so a
+  crashed holder delays, never deadlocks, other hosts. This is the
+  ZK-ephemeral-node analog (leases assume loosely synchronized clocks —
+  the standard lease caveat).
+- :func:`catalog_lock` — ``fcntl.flock`` (cheap, immediate same-host
+  serialization) wrapping the lease (cross-host), in that fixed order.
 """
 
 from __future__ import annotations
@@ -15,10 +21,13 @@ from __future__ import annotations
 import contextlib
 import errno
 import fcntl
+import json
 import os
+import socket
 import time
+import uuid
 
-__all__ = ["catalog_lock", "LockTimeout"]
+__all__ = ["catalog_lock", "lease_lock", "LockTimeout"]
 
 
 class LockTimeout(TimeoutError):
@@ -26,8 +35,95 @@ class LockTimeout(TimeoutError):
 
 
 @contextlib.contextmanager
-def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05):
-    """Exclusive advisory lock on ``<path>/.geomesa.lock``.
+def lease_lock(path: str, name: str = "catalog", ttl_s: float = 60.0,
+               timeout_s: float = 30.0, poll_s: float = 0.05,
+               settle_s: float = 0.05):
+    """Cross-host expiring lease via ORDERED CLAIM FILES under
+    ``<path>/.geomesa.<name>.claims/`` — the ZK sequential-ephemeral-node
+    recipe on a shared filesystem.
+
+    Each contender writes a claim whose NAME freezes its creation order:
+    the file is created first, its ctime (assigned by the one filesystem
+    clock, so comparable across hosts) is read back, and the file is
+    renamed to ``c-<ctime_ns>-<token>``. The lock belongs to the
+    lexicographically smallest live claim. A later creator necessarily
+    observes an earlier ctime and therefore can never preempt a decision
+    already made — after ``settle_s`` (which covers clock-quantization
+    ties) all racers see the same winner. Nothing is ever renamed or
+    deleted out from under a live holder: crash recovery is reaping claims
+    whose expiry passed (waiters refresh their expiry in place each poll;
+    refreshing rewrites content, never the name, so order is stable).
+
+    Caveats (standard lease semantics): hold times must stay well under
+    ``ttl_s`` — a holder stalled longer can be reaped; expiry compares the
+    shared wall clock, so host clocks must be loosely synchronized."""
+    claims = os.path.join(path, f".geomesa.{name}.claims")
+    os.makedirs(claims, exist_ok=True)
+    token = uuid.uuid4().hex
+    holder = f"{socket.gethostname()}:{os.getpid()}"
+
+    def _payload() -> bytes:
+        return json.dumps(
+            {"holder": holder, "expires_unix": time.time() + ttl_s}
+        ).encode()
+
+    tmp = os.path.join(claims, f"tmp-{token}")
+    with open(tmp, "wb") as f:
+        f.write(_payload())
+    t_ns = os.stat(tmp).st_ctime_ns
+    mine = os.path.join(claims, f"c-{t_ns:020d}-{token}")
+    os.rename(tmp, mine)
+    try:
+        time.sleep(settle_s)  # racing claims with tied ctimes become visible
+        deadline = time.monotonic() + timeout_s
+        my_key = os.path.basename(mine)
+        while True:
+            winner = my_key
+            for fn in sorted(os.listdir(claims)):
+                if not fn.startswith("c-") or fn == my_key:
+                    continue
+                p = os.path.join(claims, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue  # reaped concurrently
+                try:
+                    with open(p, "rb") as f:
+                        info = json.loads(f.read().decode())
+                    expired = time.time() > float(info["expires_unix"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    # torn refresh: live waiters rewrite every poll, so a
+                    # genuinely dead claim has an OLD mtime
+                    expired = time.time() - st.st_mtime > ttl_s
+                if expired:
+                    with contextlib.suppress(OSError):
+                        os.unlink(p)
+                    continue
+                winner = min(winner, fn)
+                break  # sorted listing: first live claim is the winner
+            if winner == my_key:
+                break
+            if time.monotonic() >= deadline:
+                raise LockTimeout(
+                    f"could not acquire lease {claims!r} within {timeout_s}s"
+                )
+            time.sleep(poll_s)
+            # refresh expiry in place — content swap, name (= order) stable
+            rtmp = os.path.join(claims, f"tmp-{token}")
+            with open(rtmp, "wb") as f:
+                f.write(_payload())
+            os.replace(rtmp, mine)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(mine)
+
+
+@contextlib.contextmanager
+def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05,
+                 lease_ttl_s: float = 60.0):
+    """Exclusive catalog mutation lock: same-host ``flock`` on
+    ``<path>/.geomesa.lock`` wrapping a cross-host :func:`lease_lock`.
 
     ``path`` is created if missing (locking a catalog that doesn't exist yet
     is the schema-create case).
@@ -49,7 +145,12 @@ def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05):
                         f"could not lock catalog {path!r} within {timeout_s}s"
                     ) from None
                 time.sleep(poll_s)
-        yield
+        with lease_lock(
+            path, ttl_s=lease_ttl_s,
+            timeout_s=max(0.0, deadline - time.monotonic()) or 0.001,
+            poll_s=poll_s,
+        ):
+            yield
     finally:
         with contextlib.suppress(OSError):
             fcntl.flock(fd, fcntl.LOCK_UN)
