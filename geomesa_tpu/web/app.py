@@ -24,6 +24,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}/density?cql=&bbox=&width=&height=
     GET    /api/audit?typeName=                  query audit records
     GET    /api/metrics                          metrics registry snapshot
+    GET    /wfs?service=WFS&request=...          OGC WFS 2.0 KVP binding
+    GET    /wms?service=WMS&request=...          OGC WMS 1.3.0 (GetMap tiles)
 """
 
 from __future__ import annotations
@@ -103,6 +105,8 @@ class GeoMesaApp:
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
+            # OGC WMS 1.3.0 KVP binding: GetCapabilities + GetMap tiles
+            ("GET", r"^/wms/?$", self._wms),
         ]
 
     # -- WSGI ----------------------------------------------------------------
@@ -531,21 +535,33 @@ class GeoMesaApp:
         m = getattr(self.store, "metrics", None)
         return 200, (m.snapshot() if m is not None else {}), "application/json"
 
-    def _wfs(self, params, body):
-        """OGC WFS 2.0 KVP dispatch (GetCapabilities / DescribeFeatureType /
-        GetFeature). Visibility auths apply exactly as on the native query
-        endpoint; protocol errors return an OGC ExceptionReport."""
-        from geomesa_tpu.web.wfs import WfsError, handle_wfs
-
+    def _ogc(self, handler, error_cls, params):
+        """Shared OGC KVP dispatch: route to the protocol handler, render
+        its error class as the protocol's XML exception report, and apply
+        visibility auths exactly as on the native query endpoints."""
         try:
-            status, body_out, ctype = handle_wfs(
+            status, body_out, ctype = handler(
                 self.store, params, auths=params.get("__auths__")
             )
-        except WfsError as e:
+        except error_cls as e:
             return 400, e.to_xml().encode(), "text/xml"
         if isinstance(body_out, str):
             body_out = body_out.encode()
         return status, body_out, ctype
+
+    def _wfs(self, params, body):
+        """OGC WFS 2.0 KVP binding (GetCapabilities / DescribeFeatureType /
+        GetFeature)."""
+        from geomesa_tpu.web.wfs import WfsError, handle_wfs
+
+        return self._ogc(handle_wfs, WfsError, params)
+
+    def _wms(self, params, body):
+        """OGC WMS 1.3.0 KVP binding (GetCapabilities / GetMap): density
+        heatmap or point tiles over the fused device density path."""
+        from geomesa_tpu.web.wms import WmsError, handle_wms
+
+        return self._ogc(handle_wms, WmsError, params)
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True,
