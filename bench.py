@@ -381,17 +381,21 @@ def bench_knn_density():
     knn_batch_p50 = _p50(lambda: run_knn(), iters=max(5, ITERS // 2))
     knn_per_point = knn_batch_p50 / n_knn
 
-    # CPU KNN baseline + parity referee on a few points (same f32 math)
-    xf = xi.astype(np.float32) * np.float32(360.0 / 2**31) - np.float32(180.0)
-    yf = yi.astype(np.float32) * np.float32(180.0 / 2**31) - np.float32(90.0)
+    # CPU KNN baseline + parity referee on a few points. Ground truth in
+    # f64 over the ORIGINAL coordinates; the device ranks in f32 over
+    # int32-decoded coordinates (XLA may fuse the decode into an FMA with
+    # one rounding where numpy rounds twice), so the k-th radius carries a
+    # derived noise band: d² error ≈ 2·d·ε_coord with ε_coord ≈ 4e-5 deg
+    # (int→f32 decode + query rounding). A blanket relative tolerance
+    # misses this near-origin, where cancellation amplifies decode noise.
     s = time.perf_counter()
     knn_parity = True
     n_ref = min(4, n_knn)
     for qi in range(n_ref):
-        d2 = (xf - np.float32(knn_pts[qi, 0])) ** 2 + (yf - np.float32(knn_pts[qi, 1])) ** 2
+        d2 = (lon - knn_pts[qi, 0]) ** 2 + (lat - knn_pts[qi, 1]) ** 2
         kth = np.partition(d2, K - 1)[K - 1]
-        # device top-k must cover everything strictly inside the k-th radius
-        if not (kd[qi] ** 2 <= kth * (1 + 1e-4)).all():
+        tol = 2.0 * np.sqrt(kth) * 8e-5 + kth * 1e-4 + 1e-8
+        if not (kd[qi].astype(np.float64) ** 2 <= kth + tol).all():
             knn_parity = False
     cpu_knn_per_point = (time.perf_counter() - s) * 1e3 / n_ref
 
@@ -431,8 +435,10 @@ def bench_knn_density():
 def bench_join():
     """Index-pruned block-sparse ST_Within join (VERDICT r1 item 4): points
     z2-sorted and block-partitioned; each polygon tests only the blocks its
-    bbox z-ranges touch. Effective pairs/s = N·K / wall — the apples-to-
-    apples number vs a brute-force engine evaluating every pair."""
+    bbox z-ranges touch. ``value`` is TESTED pair throughput (pairs the
+    kernel actually evaluated / wall — VERDICT r3 weak #2: the headline must
+    not credit skipped work); the index's effective N·K rate and the prune
+    factor are reported separately in the detail."""
     import jax
     import jax.numpy as jnp
 
@@ -450,6 +456,11 @@ def bench_join():
 
     N = _n(100_000_000)
     K = int(os.environ.get("GEOMESA_BENCH_K", 10_000))
+    if jax.default_backend() == "cpu":
+        # fallback hygiene (VERDICT r3 weak #3): the CPU-mesh join at driver
+        # scale burned ~2 min of a wedged round; cap it to seconds
+        N = min(N, 500_000)
+        K = min(K, 64)
     lon, lat, _ = synth_gdelt(N)
     rng = np.random.default_rng(5)
     polys = []
@@ -541,17 +552,23 @@ def bench_join():
     parity_ok = bool((counts[:n_par] == full.astype(np.int64)).all())
 
     return {
-        "metric": "st_within_join_throughput",
-        "value": round(pairs_per_s / 1e9, 4),
+        "metric": "st_within_join_tested_throughput",
+        # headline = pairs the kernel ACTUALLY evaluated per second; the
+        # index's work-avoidance shows up separately (prune_speedup_factor,
+        # effective_gpairs_per_s), never silently inside the headline unit
+        "value": round(tested_per_s / 1e9, 4),
         "unit": "Gpairs/s",
+        # end-to-end speedup for the same logical join (pruning + kernel)
+        # vs the brute-force per-pair CPU engine
         "vs_baseline": round(pairs_per_s / cpu_pairs_per_s, 2),
         "detail": {
             "n_points": N, "n_polygons": K, "devices": jax.device_count(),
             "algorithm": "block-sparse z2-pruned",
             "block_rows": block,
             "tpu_batch_ms": round(tpu_ms, 2),
-            "pruned_pair_fraction": round(pruned_pairs / (N * K), 5),
-            "tested_gpairs_per_s": round(tested_per_s / 1e9, 4),
+            "tested_pair_fraction": round(pruned_pairs / (N * K), 5),
+            "prune_speedup_factor": round(N * K / max(pruned_pairs, 1), 2),
+            "effective_gpairs_per_s": round(pairs_per_s / 1e9, 4),
             "plan_seconds": round(plan_s, 2),
             "cpu_mpairs_per_s": round(cpu_pairs_per_s / 1e6, 3),
             "pruned_vs_full_parity": parity_ok,
@@ -870,10 +887,14 @@ def bench_stream_1b():
     shards = data_shards(mesh)
     # chunk sized to HBM budget: 2 chunks resident (double buffer) × 16 B/row
     N = _n(60_000_000 if on_accel else 500_000)
+    if not on_accel:
+        # fallback hygiene (VERDICT r3 weak #3): the global cpu-fallback N
+        # must not inflate the out-of-core sweep — cap so it runs in seconds
+        N = min(N, 500_000)
     N -= N % shards
     total_target = int(
         os.environ.get(
-            "GEOMESA_BENCH_TOTAL", 1_000_000_000 if on_accel else N * 8
+            "GEOMESA_BENCH_TOTAL", 1_000_000_000 if on_accel else N * 4
         )
     )
     chunks = max(2, (total_target + N - 1) // N)
