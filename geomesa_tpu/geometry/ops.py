@@ -48,6 +48,7 @@ __all__ = [
     "closest_point",
     "translate",
     "buffer_point",
+    "buffer_geometry",
     "antimeridian_safe",
     "is_closed",
     "is_ring",
@@ -345,6 +346,78 @@ def buffer_point(p: Point, meters: float, segments: int = 32) -> Polygon:
     ang = np.linspace(0.0, 2.0 * math.pi, segments, endpoint=False)
     ring = np.stack([p.x + dlon * np.cos(ang), p.y + dlat * np.sin(ang)], axis=1)
     return Polygon(ring)
+
+
+def _capsule(p0, p1, r: float, segs: int) -> Polygon:
+    """Stadium (flat rectangle + semicircular caps) around segment p0→p1."""
+    dx, dy = p1[0] - p0[0], p1[1] - p0[1]
+    length = math.hypot(dx, dy)
+    if length < 1e-300:
+        ang = np.linspace(0.0, 2.0 * math.pi, 2 * segs, endpoint=False)
+        return Polygon(np.stack(
+            [p0[0] + r * np.cos(ang), p0[1] + r * np.sin(ang)], axis=1
+        ))
+    ux, uy = dx / length, dy / length
+    base = math.atan2(uy, ux)
+    # cap at p1 sweeps from base-90° to base+90°, cap at p0 the other half
+    a1 = base - math.pi / 2.0 + np.linspace(0.0, math.pi, segs + 1)
+    a0 = base + math.pi / 2.0 + np.linspace(0.0, math.pi, segs + 1)
+    ring = np.concatenate([
+        np.stack([p1[0] + r * np.cos(a1), p1[1] + r * np.sin(a1)], axis=1),
+        np.stack([p0[0] + r * np.cos(a0), p0[1] + r * np.sin(a0)], axis=1),
+    ])
+    return Polygon(ring)
+
+
+def _ring_capsules(coords: np.ndarray, r: float, segs: int) -> list[Polygon]:
+    return [
+        _capsule(coords[i], coords[i + 1], r, segs)
+        for i in range(len(coords) - 1)
+    ]
+
+
+def buffer_geometry(g: Geometry, distance: float,
+                    quad_segs: int = 16) -> Geometry:
+    """Generic positive buffer (the JTS ``ST_Buffer`` role, planar, radius
+    in coordinate units — degrees on the lon/lat datum).
+
+    The result is the UNION-SEMANTICS cover of ``{p : dist(p, g) <=
+    distance}``: a MultiPolygon whose parts may overlap (per-segment
+    stadium capsules plus, for areal inputs, the original polygon).
+    Containment/intersection predicates over a MultiPolygon already test
+    "any part", so consumers — DWithin-style selects, ST_Within against a
+    buffered zone — see exact union semantics without polygon boolean ops;
+    the reference gets the same result from JTS's buffer
+    (``geomesa-spark-jts/.../DataFrameFunctions.scala`` ``st_buffer``).
+    Negative distances are not supported (raise)."""
+    if distance < 0:
+        raise ValueError("negative buffer distances are not supported")
+    if isinstance(g, Point):
+        if distance == 0:
+            return g
+        ang = np.linspace(0.0, 2.0 * math.pi, 4 * quad_segs, endpoint=False)
+        return Polygon(np.stack(
+            [g.x + distance * np.cos(ang), g.y + distance * np.sin(ang)],
+            axis=1,
+        ))
+    if distance == 0:
+        return g
+    segs = max(4, quad_segs)
+    if isinstance(g, LineString):
+        return MultiPolygon(tuple(_ring_capsules(g.coords, distance, segs)))
+    if isinstance(g, Polygon):
+        parts: list[Polygon] = [g]
+        parts += _ring_capsules(g.shell, distance, segs)
+        for h in g.holes:
+            parts += _ring_capsules(h, distance, segs)
+        return MultiPolygon(tuple(parts))
+    if isinstance(g, _Multi):
+        parts = []
+        for p in g.parts:
+            b = buffer_geometry(p, distance, quad_segs)
+            parts.extend(b.parts if isinstance(b, MultiPolygon) else [b])
+        return MultiPolygon(tuple(parts))
+    raise TypeError(type(g).__name__)
 
 
 def antimeridian_safe(g: Geometry) -> Geometry:

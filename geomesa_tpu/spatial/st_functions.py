@@ -336,6 +336,7 @@ ST: dict[str, object] = {
     "st_antimeridiansafegeom": _elementwise(_ops.antimeridian_safe),
     "st_idlsafegeom": _elementwise(_ops.antimeridian_safe),
     "st_bufferpoint": _elementwise(_ops.buffer_point),
+    "st_buffer": _elementwise(_ops.buffer_geometry),
     "st_convexhull": _elementwise(_ops.convex_hull),
     "st_translate": _elementwise(_ops.translate),
     "st_closestpoint": _elementwise(_ops.closest_point),

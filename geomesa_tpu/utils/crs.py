@@ -1,13 +1,26 @@
-"""Coordinate reference system transforms (result reprojection).
+"""Coordinate reference system kit (result reprojection).
 
 Role parity: ``geomesa-index-api/.../index/utils/Reprojection.scala`` (SURVEY.md
-§2.3) — reproject query results client-side. We implement the pair that covers
-the reference's actual usage (GeoServer map output): EPSG:4326 lon/lat ↔
-EPSG:3857 spherical web-mercator, vectorized over numpy arrays, plus
-whole-table reprojection of the default geometry column.
+§2.3) — the reference reprojects query results client-side through GeoTools'
+CRS machinery. Here a small registry of analytic projections covers the
+codes geospatial clients actually request (VERDICT r3 item 7):
+
+- ``CRS:84`` / ``EPSG:4326`` — WGS84 geographic lon/lat (internal datum)
+- ``EPSG:3857`` — spherical web-mercator (meters)
+- ``EPSG:326xx`` / ``EPSG:327xx`` — WGS84 UTM zones 1-60 N/S, via the
+  Krüger flattening series (the standard 3rd-order n-series: ~0.1 mm
+  round-trip error inside a zone)
+- proj-style strings — ``+proj=longlat``, ``+proj=webmerc``,
+  ``+proj=utm +zone=NN [+south]``
+
+All transforms are vectorized over numpy arrays and route through lon/lat,
+so any supported pair composes. ``reproject_table`` reprojects a
+FeatureTable's default geometry column (the export / WFS ``srsName`` path).
 """
 
 from __future__ import annotations
+
+import re
 
 import numpy as np
 
@@ -22,11 +35,39 @@ from geomesa_tpu.geometry.types import (
     _Multi,
 )
 
-__all__ = ["transform_coords", "transform_geometry", "reproject_table", "CRS_CODES"]
+__all__ = [
+    "transform_coords", "transform_geometry", "reproject_table",
+    "CRS_CODES", "get_crs", "utm_zone_for",
+]
 
 _R = 6378137.0  # spherical mercator earth radius (EPSG:3857)
 _MAX_LAT = 85.06  # web-mercator clamp
 
+# WGS84 ellipsoid + Krüger series constants (3rd order in n)
+_A_WGS84 = 6378137.0
+_F_WGS84 = 1.0 / 298.257223563
+_K0_UTM = 0.9996
+_N = _F_WGS84 / (2.0 - _F_WGS84)
+_A_KR = _A_WGS84 / (1.0 + _N) * (1.0 + _N**2 / 4.0 + _N**4 / 64.0)
+_ALPHA = (
+    _N / 2.0 - 2.0 * _N**2 / 3.0 + 5.0 * _N**3 / 16.0,
+    13.0 * _N**2 / 48.0 - 3.0 * _N**3 / 5.0,
+    61.0 * _N**3 / 240.0,
+)
+_BETA = (
+    _N / 2.0 - 2.0 * _N**2 / 3.0 + 37.0 * _N**3 / 96.0,
+    _N**2 / 48.0 + _N**3 / 15.0,
+    17.0 * _N**3 / 480.0,
+)
+_DELTA = (
+    2.0 * _N - 2.0 * _N**2 / 3.0 - 2.0 * _N**3,
+    7.0 * _N**2 / 3.0 - 8.0 * _N**3 / 5.0,
+    56.0 * _N**3 / 15.0,
+)
+
+# legacy constant kept for callers that introspect "the always-supported
+# pair"; the registry accepts far more — any code get_crs() resolves
+# (4326/CRS:84/3857, UTM EPSG:326xx/327xx, proj strings, urn forms)
 CRS_CODES = ("EPSG:4326", "EPSG:3857")
 
 
@@ -46,15 +87,136 @@ def _to_4326(xs, ys):
     return lon, lat
 
 
+def _tm_forward(lon, lat, lon0: float):
+    """WGS84 transverse mercator (Krüger series) → (easting-from-CM·k0·A,
+    northing·k0·A), i.e. unscaled (η, ξ) premultiplied."""
+    phi = np.radians(np.asarray(lat, np.float64))
+    lam = np.radians(np.asarray(lon, np.float64) - lon0)
+    s2n = 2.0 * np.sqrt(_N) / (1.0 + _N)
+    t = np.sinh(
+        np.arctanh(np.sin(phi)) - s2n * np.arctanh(s2n * np.sin(phi))
+    )
+    xi_p = np.arctan2(t, np.cos(lam))
+    eta_p = np.arctanh(np.sin(lam) / np.sqrt(1.0 + t * t))
+    xi = xi_p.copy()
+    eta = eta_p.copy()
+    for j, a in enumerate(_ALPHA, start=1):
+        xi += a * np.sin(2 * j * xi_p) * np.cosh(2 * j * eta_p)
+        eta += a * np.cos(2 * j * xi_p) * np.sinh(2 * j * eta_p)
+    return _K0_UTM * _A_KR * eta, _K0_UTM * _A_KR * xi
+
+
+def _tm_inverse(E, N, lon0: float):
+    xi = np.asarray(N, np.float64) / (_K0_UTM * _A_KR)
+    eta = np.asarray(E, np.float64) / (_K0_UTM * _A_KR)
+    xi_p = xi.copy()
+    eta_p = eta.copy()
+    for j, b in enumerate(_BETA, start=1):
+        xi_p -= b * np.sin(2 * j * xi) * np.cosh(2 * j * eta)
+        eta_p -= b * np.cos(2 * j * xi) * np.sinh(2 * j * eta)
+    chi = np.arcsin(np.sin(xi_p) / np.cosh(eta_p))
+    phi = chi.copy()
+    for j, d in enumerate(_DELTA, start=1):
+        phi += d * np.sin(2 * j * chi)
+    lam = np.arctan2(np.sinh(eta_p), np.cos(xi_p))
+    return lon0 + np.degrees(lam), np.degrees(phi)
+
+
+class _Crs:
+    """One projection: to/from WGS84 lon/lat (vectorized)."""
+
+    def __init__(self, code: str, to_lonlat, from_lonlat):
+        self.code = code
+        self.to_lonlat = to_lonlat
+        self.from_lonlat = from_lonlat
+
+
+def _lonlat_crs(code: str) -> _Crs:
+    ident = lambda xs, ys: (  # noqa: E731
+        np.asarray(xs, np.float64), np.asarray(ys, np.float64)
+    )
+    return _Crs(code, ident, ident)
+
+
+def _utm_crs(code: str, zone: int, south: bool) -> _Crs:
+    if not 1 <= zone <= 60:
+        raise ValueError(f"UTM zone must be 1-60: {zone}")
+    lon0 = -183.0 + 6.0 * zone  # zone central meridian
+    n0 = 10_000_000.0 if south else 0.0
+
+    def from_lonlat(lon, lat):
+        e, n = _tm_forward(lon, lat, lon0)
+        return e + 500_000.0, n + n0
+
+    def to_lonlat(E, N):
+        return _tm_inverse(
+            np.asarray(E, np.float64) - 500_000.0,
+            np.asarray(N, np.float64) - n0,
+            lon0,
+        )
+
+    return _Crs(code, to_lonlat, from_lonlat)
+
+
+_PROJ_UTM = re.compile(r"\+proj=utm\b")
+_PROJ_ZONE = re.compile(r"\+zone=(\d+)")
+
+
+def get_crs(code: str) -> _Crs:
+    """Resolve a CRS code (``EPSG:nnnn``, ``CRS:84``, ``urn:ogc:def:crs:``
+    forms, or a proj-style ``+proj=...`` string) to its projection."""
+    raw = code.strip()
+    low = raw.lower()
+    if low.startswith("+"):
+        if "+proj=longlat" in low or "+proj=latlong" in low:
+            return _lonlat_crs(raw)
+        if "+proj=webmerc" in low or "+proj=merc" in low:
+            return _Crs(raw, _to_4326, _to_3857)
+        if _PROJ_UTM.search(low):
+            zm = _PROJ_ZONE.search(low)
+            if not zm:
+                raise ValueError(f"proj utm needs +zone=: {code!r}")
+            return _utm_crs(raw, int(zm.group(1)), "+south" in low)
+        raise ValueError(f"unsupported proj string {code!r}")
+    # urn:ogc:def:crs:EPSG::4326 / urn:ogc:def:crs:OGC:1.3:CRS84
+    if low.startswith("urn:"):
+        tail = raw.split(":")[-1]
+        if tail.upper() in ("CRS84", "84"):
+            return _lonlat_crs(raw)
+        raw = f"EPSG:{tail}"
+        low = raw.lower()
+    if low in ("crs:84", "ogc:crs84", "epsg:4326", "wgs84", "4326"):
+        return _lonlat_crs(code)
+    m = re.match(r"epsg:(\d+)$", low)
+    if not m:
+        raise ValueError(f"unsupported CRS {code!r}")
+    num = int(m.group(1))
+    if num == 4326:
+        return _lonlat_crs(code)
+    if num == 3857:
+        return _Crs(code, _to_4326, _to_3857)
+    if 32601 <= num <= 32660:
+        return _utm_crs(code, num - 32600, south=False)
+    if 32701 <= num <= 32760:
+        return _utm_crs(code, num - 32700, south=True)
+    raise ValueError(f"unsupported CRS {code!r}")
+
+
+def utm_zone_for(lon: float, lat: float) -> str:
+    """EPSG code of the UTM zone containing a lon/lat point."""
+    zone = int(np.clip((np.floor((lon + 180.0) / 6.0) % 60) + 1, 1, 60))
+    return f"EPSG:{32600 + zone if lat >= 0 else 32700 + zone}"
+
+
 def transform_coords(xs, ys, source: str, target: str):
-    """Transform coordinate arrays between supported CRS codes."""
-    source, target = source.upper(), target.upper()
-    for crs in (source, target):
-        if crs not in CRS_CODES:
-            raise ValueError(f"unsupported CRS {crs!r}; supported: {CRS_CODES}")
-    if source == target:
+    """Transform coordinate arrays between any two supported CRS (routes
+    through WGS84 lon/lat, so every registered pair composes)."""
+    if source.strip().upper() == target.strip().upper():
         return np.asarray(xs, np.float64), np.asarray(ys, np.float64)
-    return _to_3857(xs, ys) if target == "EPSG:3857" else _to_4326(xs, ys)
+    src = get_crs(source)
+    dst = get_crs(target)
+    lon, lat = src.to_lonlat(xs, ys)
+    return dst.from_lonlat(lon, lat)
 
 
 def transform_geometry(g: Geometry, source: str, target: str) -> Geometry:

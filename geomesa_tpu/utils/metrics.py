@@ -3,8 +3,11 @@
 Role parity: ``geomesa-metrics`` (Dropwizard registry + Ganglia/Graphite/
 CloudWatch/delimited-file reporters, SURVEY.md §2.19). We keep the registry
 shape — named counters, histograms, and timers, snapshot-able and mergeable —
-with a delimited-file reporter and a graphite-format text dump; cloud sinks
-are out of scope in a zero-egress build (stubbed by the text reporters).
+with a pluggable sink SPI wired from declarative config (the
+``MetricsConfig.scala`` role): delimited file, Graphite TCP, StatsD UDP,
+and CloudWatch Embedded Metric Format (a JSON log line the CloudWatch
+agent ships — emission stays a local write in a zero-egress build).
+Custom sinks register via :func:`register_sink`.
 """
 
 from __future__ import annotations
@@ -275,3 +278,127 @@ class PeriodicReporter:
             registry, interval_s=interval_s,
             fn=lambda reg: reg.push_statsd(host, port, prefix=prefix),
         )
+
+
+# ---------------------------------------------------------------------------
+# Pluggable sink SPI (the MetricsConfig.scala role: reporters wired from
+# declarative config — geomesa-metrics/.../config/MetricsConfig.scala)
+# ---------------------------------------------------------------------------
+
+def emf_snapshot(registry: MetricsRegistry, namespace: str = "geomesa",
+                 dimensions: dict | None = None) -> dict:
+    """One CloudWatch Embedded-Metric-Format record for the registry.
+
+    EMF is the agentless CloudWatch ingestion path (a JSON line on stdout /
+    a log file that the CloudWatch agent or Firelens ships) — the right
+    cloud-sink shape for a zero-egress build: emission is a local write,
+    shipping is the platform's job. Counter/gauge values become metrics;
+    histograms/timers contribute their mean and count."""
+    dims = dict(dimensions or {})
+    metrics = []
+    values: dict[str, float] = {}
+    for name, vals in registry.snapshot().items():
+        typ = vals.pop("type")
+        if typ == "counter":
+            metrics.append({"Name": name, "Unit": "Count"})
+            values[name] = float(vals["count"])
+        elif typ == "gauge":
+            metrics.append({"Name": name, "Unit": "None"})
+            values[name] = float(vals["value"])
+        else:  # histogram / timer: mean + count as two metrics
+            mean_key = "mean_ms" if typ == "timer" else "mean"
+            unit = "Milliseconds" if typ == "timer" else "None"
+            metrics.append({"Name": f"{name}.mean", "Unit": unit})
+            values[f"{name}.mean"] = float(vals[mean_key])
+            metrics.append({"Name": f"{name}.count", "Unit": "Count"})
+            values[f"{name}.count"] = float(vals["count"])
+    return {
+        "_aws": {
+            "Timestamp": int(time.time() * 1000),
+            "CloudWatchMetrics": [{
+                "Namespace": namespace,
+                "Dimensions": [list(dims.keys())] if dims else [[]],
+                "Metrics": metrics,
+            }],
+        },
+        **dims,
+        **values,
+    }
+
+
+def push_cloudwatch_emf(registry: MetricsRegistry, path: str,
+                        namespace: str = "geomesa",
+                        dimensions: dict | None = None) -> None:
+    """Append one EMF JSON line to ``path`` (the CloudWatch log stream)."""
+    import json as _json
+
+    rec = emf_snapshot(registry, namespace=namespace, dimensions=dimensions)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(_json.dumps(rec) + "\n")
+
+
+def _sink_delimited(registry, cfg):
+    path = cfg["path"]
+    delim = cfg.get("delimiter", ",")
+    return lambda reg: reg.report_delimited(path, delim)
+
+
+def _sink_graphite(registry, cfg):
+    return lambda reg: reg.push_graphite(
+        cfg["host"], int(cfg["port"]), prefix=cfg.get("prefix", "geomesa")
+    )
+
+
+def _sink_statsd(registry, cfg):
+    return lambda reg: reg.push_statsd(
+        cfg["host"], int(cfg["port"]), prefix=cfg.get("prefix", "geomesa")
+    )
+
+
+def _sink_cloudwatch_emf(registry, cfg):
+    path = cfg["path"]
+    ns = cfg.get("namespace", "geomesa")
+    dims = cfg.get("dimensions")
+    return lambda reg: push_cloudwatch_emf(
+        reg, path, namespace=ns, dimensions=dims
+    )
+
+
+# sink type → factory(registry, cfg) → emit fn; extend via register_sink
+SINK_FACTORIES = {
+    "delimited": _sink_delimited,
+    "graphite": _sink_graphite,
+    "statsd": _sink_statsd,
+    "cloudwatch-emf": _sink_cloudwatch_emf,
+}
+
+
+def register_sink(name: str, factory) -> None:
+    """Register a custom sink type: ``factory(registry, cfg) -> emit_fn``
+    (the SPI extension point — AccumuloReporter-style store sinks plug in
+    here)."""
+    SINK_FACTORIES[name] = factory
+
+
+def reporter_from_config(registry: MetricsRegistry, cfg: dict) -> PeriodicReporter:
+    """Build a scheduled reporter from one declarative sink config:
+    ``{"type": ..., "interval_s": ..., <sink params>}``."""
+    typ = cfg.get("type")
+    factory = SINK_FACTORIES.get(typ)
+    if factory is None:
+        raise ValueError(
+            f"unknown metrics sink {typ!r}; known: {sorted(SINK_FACTORIES)}"
+        )
+    emit = factory(registry, cfg)
+    return PeriodicReporter(
+        registry, interval_s=float(cfg.get("interval_s", 60.0)), fn=emit
+    )
+
+
+def reporters_from_config(registry: MetricsRegistry, configs) -> list:
+    """The MetricsConfig entry point: a list of sink configs → started
+    reporters (callers own stop())."""
+    out = [reporter_from_config(registry, c) for c in configs]
+    for r in out:
+        r.start()
+    return out

@@ -203,6 +203,27 @@ def _get_feature(store, p: dict, auths):
             x1, y1, x2, y2 = (float(v) for v in parts[:4])
         except ValueError as e:
             raise WfsError("InvalidParameterValue", f"bad bbox: {e}") from e
+        if len(parts) == 5 and parts[4].strip():
+            # trailing CRS token: the bbox arrives in that CRS. The WFS 2.0
+            # urn form of EPSG:4326 mandates LAT/LON axis order — swap
+            # before transforming. Transform all FOUR corners: projected
+            # axes (UTM meridian convergence) do not stay axis-aligned in
+            # lon/lat, so a two-corner transform under-covers the box.
+            from geomesa_tpu.utils.crs import transform_coords
+
+            token = parts[4].strip()
+            low = token.lower()
+            if low.startswith("urn:") and low.endswith((":4326", ":epsg::4326")):
+                x1, y1, x2, y2 = y1, x1, y2, x2
+                token = "EPSG:4326"
+            try:
+                cx, cy = transform_coords(
+                    [x1, x2, x1, x2], [y1, y1, y2, y2], token, "EPSG:4326"
+                )
+            except ValueError as e:
+                raise WfsError("InvalidParameterValue", str(e)) from None
+            x1, x2 = float(cx.min()), float(cx.max())
+            y1, y2 = float(cy.min()), float(cy.max())
         sft = store.get_schema(name)
         if sft.geom_field is None:
             raise WfsError("InvalidParameterValue", f"{name} has no geometry")
@@ -279,9 +300,22 @@ def _get_feature(store, p: dict, auths):
         )
         return 200, body, "text/xml"
 
+    hints = {}
+    if p.get("srsname"):
+        # output reprojection (the Reprojection.scala role): validate the
+        # code NOW so a bogus srsName is a protocol error, then ride the
+        # query pipeline's crs hint (store/reduce.py applies it)
+        from geomesa_tpu.utils.crs import get_crs
+
+        try:
+            get_crs(p["srsname"])
+        except ValueError as e:
+            raise WfsError("InvalidParameterValue", str(e)) from None
+        hints["crs"] = p["srsname"]
     q = Query(
         filter=cql, limit=count, start_index=start,
         sort_by=(sort_by, descending) if sort_by else None, auths=auths,
+        hints=hints,
     )
     fmt = (p.get("outputformat") or "gml").lower()
     if "json" in fmt:

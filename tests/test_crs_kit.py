@@ -1,0 +1,194 @@
+"""CRS kit (VERDICT r3 item 7): registry of analytic projections — WGS84
+lon/lat, web-mercator, and UTM zones via the Krüger series — with
+round-trip accuracy referees, proj-string parsing, and the WFS ``srsName``
+output path.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.utils.crs import get_crs, transform_coords, utm_zone_for
+
+T0 = 1_600_000_000_000
+
+
+def _meridian_arc(lat_deg: float) -> float:
+    """Independent referee: numerically integrate the WGS84 meridian arc."""
+    a = 6378137.0
+    f = 1.0 / 298.257223563
+    e2 = f * (2 - f)
+    phi = np.linspace(0.0, np.radians(lat_deg), 200_001)
+    m = a * (1 - e2) / (1 - e2 * np.sin(phi) ** 2) ** 1.5
+    return float(np.trapezoid(m, phi))
+
+
+class TestUtm:
+    def test_central_meridian_equator_anchor(self):
+        for code, lon0 in (("EPSG:32633", 15.0), ("EPSG:32630", -3.0)):
+            crs = get_crs(code)
+            e, n = crs.from_lonlat(np.array([lon0]), np.array([0.0]))
+            assert abs(e[0] - 500_000.0) < 1e-6
+            assert abs(n[0]) < 1e-6
+
+    def test_northing_matches_meridian_arc(self):
+        """Northing on the central meridian = k0 x meridian arc length —
+        checked against an independent numerical integration."""
+        crs = get_crs("EPSG:32631")  # CM = 3E
+        for lat in (15.0, 45.0, 70.0):
+            _, n = crs.from_lonlat(np.array([3.0]), np.array([lat]))
+            want = 0.9996 * _meridian_arc(lat)
+            assert abs(n[0] - want) < 0.01, (lat, n[0], want)
+
+    def test_south_zone_false_northing(self):
+        crs = get_crs("EPSG:32719")  # zone 19S, CM = -69
+        e, n = crs.from_lonlat(np.array([-69.0]), np.array([-33.45]))
+        assert abs(e[0] - 500_000.0) < 1e-6
+        assert 6_000_000 < n[0] < 10_000_000  # below the false northing
+
+    def test_round_trip_in_zone(self):
+        rng = np.random.default_rng(4)
+        for code, lon0, south in (
+            ("EPSG:32633", 15.0, False),
+            ("EPSG:32719", -69.0, True),
+            ("EPSG:32601", -177.0, False),
+        ):
+            crs = get_crs(code)
+            lon = lon0 + rng.uniform(-2.9, 2.9, 500)
+            lat = rng.uniform(-79, -1, 500) if south \
+                else rng.uniform(1, 83, 500)
+            e, n = crs.from_lonlat(lon, lat)
+            lon2, lat2 = crs.to_lonlat(e, n)
+            np.testing.assert_allclose(lon2, lon, atol=1e-9)
+            np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+    def test_utm_zone_for(self):
+        assert utm_zone_for(15.0, 50.0) == "EPSG:32633"
+        assert utm_zone_for(-69.5, -33.0) == "EPSG:32719"
+        assert utm_zone_for(-180.0, 10.0) == "EPSG:32601"
+        assert utm_zone_for(179.9, -10.0) == "EPSG:32760"
+
+
+class TestRegistry:
+    def test_compose_3857_to_utm(self):
+        lon, lat = np.array([15.3]), np.array([48.2])
+        mx, my = transform_coords(lon, lat, "EPSG:4326", "EPSG:3857")
+        e1, n1 = transform_coords(mx, my, "EPSG:3857", "EPSG:32633")
+        e2, n2 = transform_coords(lon, lat, "EPSG:4326", "EPSG:32633")
+        np.testing.assert_allclose(e1, e2, atol=1e-5)
+        np.testing.assert_allclose(n1, n2, atol=1e-5)
+
+    def test_proj_strings(self):
+        lon, lat = np.array([14.5]), np.array([47.0])
+        e1, n1 = transform_coords(lon, lat, "EPSG:4326", "EPSG:32633")
+        e2, n2 = transform_coords(lon, lat, "+proj=longlat",
+                                  "+proj=utm +zone=33")
+        np.testing.assert_allclose(e1, e2)
+        np.testing.assert_allclose(n1, n2)
+        s1, t1 = transform_coords(lon, lat, "CRS:84", "+proj=webmerc")
+        s2, t2 = transform_coords(lon, lat, "EPSG:4326", "EPSG:3857")
+        np.testing.assert_allclose(s1, s2)
+        np.testing.assert_allclose(t1, t2)
+
+    def test_urn_forms(self):
+        lon, lat = np.array([10.0]), np.array([20.0])
+        a = transform_coords(lon, lat, "urn:ogc:def:crs:EPSG::4326",
+                             "urn:ogc:def:crs:EPSG::3857")
+        b = transform_coords(lon, lat, "EPSG:4326", "EPSG:3857")
+        np.testing.assert_allclose(a, b)
+        c = transform_coords(lon, lat, "urn:ogc:def:crs:OGC:1.3:CRS84",
+                             "EPSG:4326")
+        np.testing.assert_allclose(c, (lon, lat))
+
+    def test_unknown_crs_raises(self):
+        with pytest.raises(ValueError, match="unsupported CRS"):
+            get_crs("EPSG:9999")
+        with pytest.raises(ValueError):
+            get_crs("+proj=lcc +lat_1=33")
+
+
+@pytest.fixture()
+def ds():
+    store = DataStore(backend="tpu")
+    store.create_schema("pts", "name:String,dtg:Date,*geom:Point")
+    store.write("pts", [
+        {"name": "vienna", "dtg": T0, "geom": Point(16.37, 48.21)},
+        {"name": "oslo", "dtg": T0, "geom": Point(10.75, 59.91)},
+    ], fids=["v", "o"])
+    return store
+
+
+class TestQueryAndWfsReprojection:
+    def test_query_crs_hint_utm(self, ds):
+        from geomesa_tpu.planning.planner import Query
+
+        r = ds.query("pts", Query(hints={"crs": "EPSG:32633"}))
+        col = r.table.geom_column()
+        i = list(r.table.fids).index("v")
+        e, n = transform_coords([16.37], [48.21], "EPSG:4326", "EPSG:32633")
+        assert abs(col.x[i] - e[0]) < 1e-6
+        assert abs(col.y[i] - n[0]) < 1e-6
+
+    def test_wfs_srsname_reprojects_output(self, ds):
+        from geomesa_tpu.web.wfs import handle_wfs
+
+        status, body, _ = handle_wfs(ds, {
+            "service": "WFS", "request": "GetFeature", "typeNames": "pts",
+            "outputFormat": "application/json", "srsName": "EPSG:3857",
+        })
+        fc = body  # geojson payloads come back as JSON-able dicts
+        got = {f["id"]: f["geometry"]["coordinates"] for f in fc["features"]}
+        mx, my = transform_coords([16.37], [48.21], "EPSG:4326", "EPSG:3857")
+        assert abs(got["v"][0] - mx[0]) < 1e-6
+        assert abs(got["v"][1] - my[0]) < 1e-6
+
+    def test_wfs_bad_srsname_is_protocol_error(self, ds):
+        from geomesa_tpu.web.wfs import WfsError, handle_wfs
+
+        with pytest.raises(WfsError):
+            handle_wfs(ds, {
+                "service": "WFS", "request": "GetFeature",
+                "typeNames": "pts", "srsName": "EPSG:9999",
+            })
+
+    def test_wfs_bbox_utm_token_covers_convergence_strips(self, ds):
+        """A UTM bbox token must transform all FOUR corners — meridian
+        convergence bends the box in lon/lat, and a two-corner transform
+        silently drops edge strips."""
+        from geomesa_tpu.web.wfs import handle_wfs
+
+        # vienna (16.37, 48.21) in UTM 33N
+        e, n = transform_coords([16.37], [48.21], "EPSG:4326", "EPSG:32633")
+        x1, x2 = e[0] - 150_000, e[0] + 150_000
+        y1, y2 = n[0] - 50_000, n[0] + 50_000
+        _, body, _ = handle_wfs(ds, {
+            "service": "WFS", "request": "GetFeature", "typeNames": "pts",
+            "outputFormat": "application/json",
+            "bbox": f"{x1},{y1},{x2},{y2},EPSG:32633",
+        })
+        assert [f["id"] for f in body["features"]] == ["v"]
+
+    def test_wfs_bbox_urn_4326_is_latlon_order(self, ds):
+        from geomesa_tpu.web.wfs import handle_wfs
+
+        _, body, _ = handle_wfs(ds, {
+            "service": "WFS", "request": "GetFeature", "typeNames": "pts",
+            "outputFormat": "application/json",
+            # lat,lon order per the WFS 2.0 urn form
+            "bbox": "48,16,49,17,urn:ogc:def:crs:EPSG::4326",
+        })
+        assert [f["id"] for f in body["features"]] == ["v"]
+
+    def test_wfs_bbox_with_crs_token(self, ds):
+        from geomesa_tpu.web.wfs import handle_wfs
+
+        mx, my = transform_coords([16.0, 17.0], [48.0, 49.0],
+                                  "EPSG:4326", "EPSG:3857")
+        status, body, _ = handle_wfs(ds, {
+            "service": "WFS", "request": "GetFeature", "typeNames": "pts",
+            "outputFormat": "application/json",
+            "bbox": f"{mx[0]},{my[0]},{mx[1]},{my[1]},EPSG:3857",
+        })
+        fc = body  # geojson payloads come back as JSON-able dicts
+        assert [f["id"] for f in fc["features"]] == ["v"]

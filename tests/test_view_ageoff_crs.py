@@ -194,5 +194,7 @@ class TestReprojection:
         assert col.x[0] == pytest.approx(1113194.9, rel=1e-4)
 
     def test_unsupported_crs(self):
+        # UTM zones are supported since the r4 CRS kit; a genuinely unknown
+        # code still refuses
         with pytest.raises(ValueError):
-            transform_coords([0], [0], "EPSG:4326", "EPSG:32633")
+            transform_coords([0], [0], "EPSG:4326", "EPSG:9999")
