@@ -1072,13 +1072,141 @@ def bench_stream_1b():
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 9: distributed GROUP BY (fused grouped segment-reduce)
+# ---------------------------------------------------------------------------
+
+def bench_grouped_agg():
+    """Mesh SQL aggregation (VERDICT r3 item 2 / SURVEY §2.14): Q filtered
+    GROUP BY queries — count/sum/min/max over G groups — in ONE fused
+    device pass (segment-reduce per shard, psum/pmin/pmax merge), vs the
+    host fold (vectorized numpy mask + bincount — the Spark-executor
+    analog) on identical data."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from geomesa_tpu.parallel.mesh import (
+        DATA_AXIS,
+        make_mesh,
+        pad_query_axis,
+        shard_columns,
+    )
+    from geomesa_tpu.parallel.query import cached_grouped_agg_step
+
+    N = _n(50_000_000)
+    if jax.default_backend() == "cpu":
+        N = min(N, 1_000_000)  # fallback hygiene: seconds, not minutes
+    G = int(os.environ.get("GEOMESA_BENCH_G", 1024))
+    rng = np.random.default_rng(17)
+    lon, lat, t_ms = synth_gdelt(N)
+    binned = BinnedTime(PERIOD)
+    bins, offs = binned.to_bin_and_offset(t_ms)
+    nlon, nlat = norm_lon(31), norm_lat(31)
+    t_build = time.perf_counter()
+    xi = nlon.normalize(lon).astype(np.int32)
+    yi = nlat.normalize(lat).astype(np.int32)
+    gid = rng.integers(0, G, N).astype(np.int32)
+    vals = rng.normal(50.0, 20.0, N)
+    mesh = make_mesh()
+    cols, padded, _ = shard_columns(mesh, {
+        "x": xi, "y": yi, "bins": bins.astype(np.int32),
+        "offs": offs.astype(np.int32), "gid": gid,
+        "rowid": np.arange(N, dtype=np.int32),
+    })
+    pv = np.zeros((1, padded))
+    pv[0, :N] = vals
+    dvals = jax.device_put(pv, NamedSharding(mesh, _P(None, DATA_AXIS)))
+    build_s = time.perf_counter() - t_build
+
+    qn = Q
+    boxes_f64, windows = make_queries(qn)
+    qboxes, qtimes = _pack_queries(boxes_f64, windows, binned, nlon, nlat)
+    (qboxes, qtimes), _ = pad_query_axis(mesh, qboxes, qtimes)
+    dev_boxes = jnp.asarray(qboxes)
+    dev_times = jnp.asarray(qtimes)
+    cap = 512
+    G_pad = 1 << (G - 1).bit_length()
+    step = cached_grouped_agg_step(mesh, G_pad, 1, cap)
+
+    def run():
+        out = step(
+            cols["x"], cols["y"], cols["bins"], cols["offs"], cols["gid"],
+            cols["rowid"], dvals, jnp.int32(N), dev_boxes, dev_times,
+        )
+        jax.block_until_ready(out[0])
+        return out
+
+    cnt, _first, vcnt, vsum, _vmn, _vmx, epos, ehits = run()
+    cnt = np.asarray(cnt)
+    vsum = np.asarray(vsum)
+    epos = np.asarray(epos)
+    ehits = np.asarray(ehits)
+    dev_ms = _p50(lambda: run(), iters=max(3, ITERS // 2))
+    per_query_ms = dev_ms / qn
+
+    # host fold baseline (the Spark-executor role): vectorized mask +
+    # bincount per query over the SAME columns, and the parity referee:
+    # device interior counts + edge-candidate counts == full int-domain
+    # match per group (the fold/edge split must lose nothing)
+    n_par = min(4, qn)
+    parity = True
+    s = time.perf_counter()
+    for k in range(n_par):
+        b = qboxes[k]
+        inb = np.zeros(N, dtype=bool)
+        for s_i in range(b.shape[0]):
+            x1, x2, y1, y2 = b[s_i]
+            if x1 > x2:
+                continue
+            inb |= (xi >= x1) & (xi <= x2) & (yi >= y1) & (yi <= y2)
+        inw = np.zeros(N, dtype=bool)
+        for tw in qtimes[k]:
+            lo_b, lo_o, hi_b, hi_o = tw
+            if (lo_b, lo_o) > (hi_b, hi_o):
+                continue
+            after = (bins > lo_b) | ((bins == lo_b) & (offs >= lo_o))
+            before = (bins < hi_b) | ((bins == hi_b) & (offs <= hi_o))
+            inw |= after & before
+        m = inb & inw
+        host_cnt = np.bincount(gid[m], minlength=G)
+        np.bincount(gid[m], weights=vals[m], minlength=G)  # the sum fold
+        if (ehits[k] > cap).any():
+            parity = False
+            continue
+        cand = np.concatenate(
+            [epos[k, d, : ehits[k, d]] for d in range(epos.shape[1])]
+        ).astype(np.int64)
+        edge_cnt = np.bincount(gid[cand], minlength=G) if len(cand) \
+            else np.zeros(G, dtype=np.int64)
+        if not np.array_equal(cnt[k, :G] + edge_cnt, host_cnt):
+            parity = False
+    host_ms = (time.perf_counter() - s) * 1e3 / n_par
+
+    return {
+        "metric": "grouped_agg_p50_latency",
+        "value": round(per_query_ms, 4),
+        "unit": "ms/query",
+        "vs_baseline": round(host_ms / per_query_ms, 2),
+        "detail": {
+            "n_points": N, "groups": G, "queries": qn,
+            "devices": jax.device_count(),
+            "batch_p50_ms": round(dev_ms, 3),
+            "host_fold_ms_per_query": round(host_ms, 3),
+            "group_count_parity": parity,
+            "build_seconds": round(build_s, 2),
+        },
+    }
+
+
 BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,
            "4": bench_join, "5": bench_xz2, "6": bench_select,
-           "7": bench_resident, "8": bench_stream_1b}
+           "7": bench_resident, "8": bench_stream_1b,
+           "9": bench_grouped_agg}
 
 # per-config wall-clock budget (seconds) for the subprocess runner
 _TIMEOUTS = {"1": 900, "2": 1200, "3": 2400, "4": 1800, "5": 900, "6": 1800,
-             "7": 2400, "8": 2400}
+             "7": 2400, "8": 2400, "9": 1200}
 _HEADLINE_ORDER = ["2", "1", "5", "6", "7", "8", "3", "4"]  # headline preference
 
 
