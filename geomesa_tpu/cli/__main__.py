@@ -179,6 +179,16 @@ def _query_of(args):
     hints = {}
     if getattr(args, "hints", None):
         hints = json.loads(args.hints)
+    if getattr(args, "srs", None):
+        # output reprojection (the CLI export --srs role): validate before
+        # the scan so a bad code fails fast
+        from geomesa_tpu.utils.crs import get_crs
+
+        try:
+            get_crs(args.srs)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        hints["crs"] = args.srs
     return Query(
         filter=args.cql,
         limit=getattr(args, "max", None),
@@ -463,6 +473,10 @@ def main(argv=None):
     )
     sp.add_argument("-a", "--attributes", default=None)
     sp.add_argument("--hints", default=None, help="query hints as JSON")
+    sp.add_argument(
+        "--srs", default=None,
+        help="reproject exported geometries (EPSG code / proj string)",
+    )
     sp.add_argument("--bin-track", default=None)
     sp.add_argument("-o", "--output", default=None)
     sp.add_argument(

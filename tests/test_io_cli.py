@@ -490,3 +490,38 @@ class TestDeleteFeaturesCommand:
         assert "deleted 3" in capsys.readouterr().out
         run_cli("stats-count", "-c", cat, "-n", "t", "--backend", "oracle")
         assert capsys.readouterr().out.strip() == "5"
+
+
+class TestExportSrs:
+    def test_export_srs_reprojects(self, tmp_path, capsys):
+        cat = str(tmp_path / "cat")
+        f = tmp_path / "g.tsv"
+        f.write_text(GDELT_ROW)
+        run_cli("ingest", "-c", cat, "-n", "g", "--converter", "gdelt", str(f))
+        capsys.readouterr()
+        run_cli("export", "-c", cat, "-n", "g", "--format", "json",
+                "--srs", "EPSG:3857", "-o", str(tmp_path / "o.json"))
+        capsys.readouterr()
+        import json as _json
+        import re as _re
+
+        rec = _json.loads(
+            (tmp_path / "o.json").read_text().strip().splitlines()[0]
+        )
+        geom_field = next(k for k, v in rec.items() if "POINT" in str(v).upper()
+                          or "Point" in str(v))
+        nums = [float(x) for x in _re.findall(r"-?\d+\.?\d*", rec[geom_field])]
+        # meters, not degrees: web-mercator magnitudes
+        assert any(abs(v) > 10_000 for v in nums), rec[geom_field]
+
+    def test_export_bad_srs_fails_fast(self, tmp_path, capsys):
+        import pytest as _pytest
+
+        cat = str(tmp_path / "cat")
+        f = tmp_path / "g.tsv"
+        f.write_text(GDELT_ROW)
+        run_cli("ingest", "-c", cat, "-n", "g", "--converter", "gdelt", str(f))
+        capsys.readouterr()
+        with _pytest.raises(SystemExit, match="unsupported CRS"):
+            run_cli("export", "-c", cat, "-n", "g", "--format", "json",
+                    "--srs", "EPSG:9999", "-o", str(tmp_path / "o.json"))
