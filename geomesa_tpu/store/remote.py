@@ -126,6 +126,72 @@ class RemoteDataStore:
         out = self._get_json(f"/api/schemas/{type_name}/stats/count", params)
         return float(out["count"])
 
+    def aggregate_many(self, type_name: str, queries, group_by=None,
+                       value_cols=()):
+        """Remote grouped aggregation: ship the query batch, get per-group
+        partials back — the federation surface of the fused mesh
+        segment-reduce (same result shape as DataStore.aggregate_many;
+        None entries mean the owner declined and the caller folds)."""
+        # only PLAIN filters ship: a Query carrying auths/hints/limit/
+        # start_index must decline locally (None) exactly as the local
+        # store's batch gate does — shipping just its filter would compute
+        # aggregates over rows the caller may not see (visibility leak) or
+        # silently drop limit/hint semantics
+        cqls: list = []
+        declined: set[int] = set()
+        for i, q in enumerate(queries):
+            if q is None or isinstance(q, str):
+                cqls.append(q)
+                continue
+            if isinstance(q, Query):
+                if (
+                    q.auths is not None or q.hints or q.limit is not None
+                    or q.start_index is not None
+                ):
+                    declined.add(i)
+                    cqls.append(None)
+                    continue
+                f = q.resolved_filter()
+            else:
+                f = q
+            cqls.append(None if isinstance(f, ast.Include) else ast.to_cql(f))
+        body = {
+            "queries": cqls,
+            "group_by": list(group_by) if group_by else None,
+            "value_cols": list(value_cols),
+        }
+        res = self._send(
+            "POST", f"/api/schemas/{type_name}/aggregate", body
+        )["results"]
+        out = []
+        for i, r in enumerate(res):
+            if i in declined:
+                out.append(None)
+                continue
+            if r is None:
+                out.append(None)
+                continue
+            out.append({
+                "groups": [tuple(k) for k in r["groups"]],
+                "count": np.asarray(r["count"], dtype=np.int64),
+                "cols": {
+                    c: {
+                        "count": np.asarray(d["count"], dtype=np.int64),
+                        "sum": np.asarray(d["sum"], dtype=np.float64),
+                        "min": np.asarray(
+                            [np.nan if v is None else v for v in d["min"]],
+                            dtype=np.float64,
+                        ),
+                        "max": np.asarray(
+                            [np.nan if v is None else v for v in d["max"]],
+                            dtype=np.float64,
+                        ),
+                    }
+                    for c, d in r["cols"].items()
+                },
+            })
+        return out
+
     # -- write forwarding (P10 write half) ------------------------------------
     def create_schema(self, name_or_sft, spec: str | None = None) -> None:
         """Create a schema on the owning process. Raises ValueError when the

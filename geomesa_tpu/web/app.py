@@ -17,6 +17,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|csv|leaflet
     POST   /api/schemas/{name}/count-many        batched loose counts
     POST   /api/schemas/{name}/density-many      batched shared-viewport heatmaps
+    POST   /api/schemas/{name}/aggregate         batched grouped aggregation
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
     GET    /api/schemas/{name}/stats/count?cql=&exact=
     GET    /api/schemas/{name}/stats/bounds?attr=
@@ -96,6 +97,7 @@ class GeoMesaApp:
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
             ("POST", r"^/api/schemas/([^/]+)/count-many$", self._count_many),
             ("POST", r"^/api/schemas/([^/]+)/density-many$", self._density_many),
+            ("POST", r"^/api/schemas/([^/]+)/aggregate$", self._aggregate),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
             ("GET", r"^/api/schemas/([^/]+)/stats/count$", self._stats_count),
             ("GET", r"^/api/schemas/([^/]+)/stats/bounds$", self._stats_bounds),
@@ -406,6 +408,55 @@ class GeoMesaApp:
             name, queries, loose=bool(body.get("loose", True))
         )
         return 200, {"counts": counts}, "application/json"
+
+    def _aggregate(self, name, params, body):
+        """POST {"queries": [cql, ...], "group_by": [cols], "value_cols":
+        [cols]} → per query: null (that query cannot ride the mesh — the
+        caller runs its own fold) or {"groups": [[key, ...], ...], "count":
+        [...], "cols": {col: {"count"/"sum"/"min"/"max": [...]}}} with NaN
+        extrema as null. The fused grouped segment-reduce over HTTP — the
+        federation analog of count-many/density-many."""
+        if not body or "queries" not in body:
+            raise _HttpError(400, 'body must be {"queries": [...]}')
+        agg = getattr(self.store, "aggregate_many", None)
+        if agg is None:
+            raise _HttpError(400, "store does not support batched aggregation")
+        auths = self._restricted_auths(name, params)
+        queries = body["queries"]
+        if auths is not None:
+            # visibility-filtered rows can't ride the batched device fold
+            queries = [Query(filter=c, auths=auths) for c in queries]
+        out = agg(
+            name, queries,
+            group_by=body.get("group_by"),
+            value_cols=body.get("value_cols", []),
+        )
+
+        def _key(v):
+            return v.item() if isinstance(v, np.generic) else v
+
+        def _f(v: float):
+            return None if np.isnan(v) else float(v)
+
+        results = []
+        for r in out:
+            if r is None:
+                results.append(None)
+                continue
+            results.append({
+                "groups": [[_key(k) for k in key] for key in r["groups"]],
+                "count": [int(c) for c in r["count"]],
+                "cols": {
+                    c: {
+                        "count": [int(v) for v in d["count"]],
+                        "sum": [float(v) for v in d["sum"]],
+                        "min": [_f(v) for v in d["min"]],
+                        "max": [_f(v) for v in d["max"]],
+                    }
+                    for c, d in r["cols"].items()
+                },
+            })
+        return 200, {"results": results}, "application/json"
 
     def _density_many(self, name, params, body):
         """POST {"queries": [cql, ...], "bbox": [x1,y1,x2,y2], "width", "height",

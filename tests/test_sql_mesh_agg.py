@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
 from geomesa_tpu.sql.engine import sql
 from geomesa_tpu.store.datastore import DataStore
 
@@ -242,6 +243,137 @@ class TestHostOrderParity:
             r = sql(ds, "SELECT v, COUNT(*) AS n FROM nn GROUP BY v")
             assert len(r) == 3, backend  # two NaN groups + one value group
             assert sorted(r.columns["n"].tolist()) == [1, 1, 1]
+
+
+class TestRemoteAggregation:
+    def test_http_aggregate_parity_and_sql_over_remote(self):
+        """The /aggregate endpoint ships per-group partials; a RemoteDataStore
+        serves sql() GROUP BY with the owner's mesh doing the fold."""
+        import threading
+        from wsgiref.simple_server import make_server
+
+        from geomesa_tpu.store.remote import RemoteDataStore
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        local = _mk("tpu", n=2500)
+        httpd = make_server("127.0.0.1", 0, GeoMesaApp(local))
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            remote = RemoteDataStore(f"http://127.0.0.1:{port}")
+            q = "BBOX(geom, -50, -40, 10, -20)"
+            want = local.aggregate_many(
+                "ev", [q], group_by=["name"], value_cols=["val"]
+            )[0]
+            got = remote.aggregate_many(
+                "ev", [q], group_by=["name"], value_cols=["val"]
+            )[0]
+            assert got["groups"] == want["groups"]
+            np.testing.assert_array_equal(got["count"], want["count"])
+            np.testing.assert_allclose(
+                got["cols"]["val"]["sum"], want["cols"]["val"]["sum"]
+            )
+            sql_q = ("SELECT name, COUNT(*) AS n, SUM(val) AS s FROM ev "
+                     f"WHERE {q} GROUP BY name")
+            assert _sorted_rows(sql(remote, sql_q)) \
+                == _sorted_rows(sql(local, sql_q))
+            # a declining query comes back as None over the wire too
+            out = remote.aggregate_many(
+                "ev", ["cnt >= 7"], group_by=["name"], value_cols=["val"]
+            )
+            assert out == [None]
+            # a Query carrying auths/limit must decline LOCALLY — shipping
+            # just its filter would aggregate over rows the caller may not
+            # see (visibility) or drop limit semantics
+            out = remote.aggregate_many(
+                "ev",
+                [Query(filter=q, auths=["secret"]), Query(filter=q, limit=3)],
+                group_by=["name"], value_cols=["val"],
+            )
+            assert out == [None, None]
+        finally:
+            httpd.shutdown()
+
+
+class TestMeshAggFuzz:
+    def test_random_queries_parity(self):
+        """Property fuzz: random bbox/time filters x random group/value
+        column combinations agree with the host fold exactly."""
+        rng = np.random.default_rng(99)
+        tpu = _mk("tpu", n=3000, seed=31)
+        host = _mk("oracle", n=3000, seed=31)
+        aggs = ["COUNT(*) AS c", "SUM(val) AS s", "MIN(cnt) AS lo",
+                "MAX(val) AS hi", "AVG(cnt) AS m", "COUNT(val) AS nv"]
+        for trial in range(12):
+            x1 = rng.uniform(-60, 40)
+            y1 = rng.uniform(-45, 30)
+            w = rng.uniform(5, 70)
+            h = rng.uniform(5, 50)
+            where = f"BBOX(geom, {x1}, {y1}, {x1 + w}, {y1 + h})"
+            if trial % 3 == 0:
+                t_lo = T0 + int(rng.integers(0, 2 * 86_400_000))
+                import datetime as _dt
+
+                iso = _dt.datetime.fromtimestamp(
+                    t_lo / 1000, _dt.timezone.utc
+                ).strftime("%Y-%m-%dT%H:%M:%SZ")
+                iso2 = _dt.datetime.fromtimestamp(
+                    (t_lo + 86_400_000) / 1000, _dt.timezone.utc
+                ).strftime("%Y-%m-%dT%H:%M:%SZ")
+                where += f" AND dtg DURING {iso}/{iso2}"
+            picks = rng.choice(len(aggs), size=2, replace=False)
+            group = ["name", "cnt"][: int(rng.integers(1, 3))]
+            sel = ", ".join([*group, *(aggs[i] for i in picks)])
+            q = (f"SELECT {sel} FROM ev WHERE {where} "
+                 f"GROUP BY {', '.join(group)}")
+            assert _sorted_rows(sql(tpu, q)) == _sorted_rows(sql(host, q)), q
+
+
+class TestMeshAggConcurrency:
+    def test_aggregate_during_writes_and_compactions(self):
+        """aggregate_many stays coherent while a background thread writes
+        and compacts: counts never regress below the initial row count and
+        never exceed the final one."""
+        import threading
+
+        ds = _mk("tpu", n=2000, seed=41)
+        stop = threading.Event()
+        errs: list = []
+
+        def churn():
+            i = 0
+            try:
+                while not stop.is_set():
+                    ds.write("ev", [{
+                        "name": f"g{i % 7}", "val": 1.0, "cnt": 1,
+                        "dtg": T0, "geom": Point(0.0, 0.0),
+                    }], fids=[f"x{i}"])
+                    if i % 10 == 0:
+                        ds.compact("ev")
+                    i += 1
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            lo = 2000
+            for _ in range(30):
+                out = ds.aggregate_many(
+                    "ev", [None], group_by=["name"], value_cols=["val"]
+                )[0]
+                if out is None:
+                    continue
+                total = int(out["count"].sum())
+                assert total >= lo
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not errs, errs
+        hi = ds.stats_count("ev")
+        out = ds.aggregate_many("ev", [None], group_by=["name"])[0]
+        assert out is not None and int(out["count"].sum()) == hi
 
 
 class TestAggregateManyApi:
