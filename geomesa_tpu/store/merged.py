@@ -122,3 +122,90 @@ class MergedDataStoreView:
             sub = f if scope is None else (scope if f is None else ast.And((f, scope)))
             total += s.stats_count(type_name, sub, exact)
         return total
+
+    def aggregate_many(self, type_name: str, queries, group_by=None,
+                       value_cols=()):
+        """Federated grouped aggregation: push the fold to every member
+        (each runs its own fused mesh pass — or its owner's, over HTTP via
+        RemoteDataStore) and merge the per-group partials at the view level:
+        counts/sums add, extrema min/max, group order is first occurrence
+        across members in member order (the same order the view's merged
+        host fold would produce). A query any member declines is declined
+        (None) for the whole view — the caller's host fold keeps exact
+        semantics rather than mixing engines per slice."""
+        qs = [
+            Query(filter=q) if isinstance(q, (str, ast.Filter)) or q is None
+            else q
+            for q in queries
+        ]
+        # capability check BEFORE any fan-out: one member without the fold
+        # declines the whole view, and earlier members must not burn device
+        # passes / remote round-trips whose results would be discarded
+        if any(
+            getattr(store, "aggregate_many", None) is None
+            for store, _ in self.stores
+        ):
+            return [None] * len(qs)
+        per_member = []
+        for store, scope in self.stores:
+            agg = store.aggregate_many
+            subs = []
+            for q in qs:
+                f = q.resolved_filter()
+                if scope is not None:
+                    f = ast.And((f, scope))
+                subs.append(replace(q, filter=f))
+            per_member.append(
+                agg(type_name, subs, group_by=group_by,
+                    value_cols=value_cols)
+            )
+        out: list = []
+        vcols = list(value_cols)
+        for qi in range(len(qs)):
+            parts = [m[qi] for m in per_member]
+            if any(p is None for p in parts):
+                out.append(None)
+                continue
+            keys: list = []
+            pos: dict = {}
+            cnt: list[int] = []
+            acc = {c: {"count": [], "sum": [], "min": [], "max": []}
+                   for c in vcols}
+            for p in parts:
+                for gi, key in enumerate(p["groups"]):
+                    g = pos.get(key)
+                    if g is None:
+                        g = pos[key] = len(keys)
+                        keys.append(key)
+                        cnt.append(0)
+                        for c in vcols:
+                            acc[c]["count"].append(0)
+                            acc[c]["sum"].append(0.0)
+                            acc[c]["min"].append(np.nan)
+                            acc[c]["max"].append(np.nan)
+                    cnt[g] += int(p["count"][gi])
+                    for c in vcols:
+                        d = p["cols"][c]
+                        acc[c]["count"][g] += int(d["count"][gi])
+                        acc[c]["sum"][g] += float(d["sum"][gi])
+                        for k, fold in (("min", min), ("max", max)):
+                            v = float(d[k][gi])
+                            if np.isnan(v):
+                                continue
+                            cur = acc[c][k][g]
+                            acc[c][k][g] = v if np.isnan(cur) else fold(cur, v)
+            # no-GROUP-BY single groups merge into one row; grouped results
+            # keep only non-empty groups (every member already filters, but
+            # scope-disjoint members contribute zero-count groups never)
+            out.append({
+                "groups": keys,
+                "count": np.asarray(cnt, dtype=np.int64),
+                "cols": {
+                    c: {k: np.asarray(v, dtype=np.float64)
+                        if k != "count"
+                        else np.asarray(v, dtype=np.int64)
+                        for k, v in acc[c].items()}
+                    for c in vcols
+                },
+            })
+        return out

@@ -296,6 +296,51 @@ class TestRemoteAggregation:
             httpd.shutdown()
 
 
+class TestMergedViewAggregation:
+    def _pair(self, backend):
+        a = _mk(backend, n=1500, seed=61)
+        b = _mk(backend, n=1500, seed=62)
+        from geomesa_tpu.store.merged import MergedDataStoreView
+
+        return MergedDataStoreView([a, b])
+
+    def test_federated_group_by_parity(self):
+        """sql() GROUP BY over a merged view pushes per-member mesh folds
+        and merges partials — parity with the all-host merged fold."""
+        tpu = self._pair("tpu")
+        host = self._pair("oracle")
+        for q in (
+            "SELECT name, COUNT(*) AS n, SUM(val) AS s, MIN(val) AS lo, "
+            "MAX(val) AS hi FROM ev GROUP BY name",
+            "SELECT name, COUNT(*) AS n FROM ev "
+            "WHERE BBOX(geom, -50, -40, 10, -20) GROUP BY name",
+            "SELECT COUNT(*) AS n, AVG(val) AS m FROM ev "
+            "WHERE BBOX(geom, -20, -30, 40, 35)",
+        ):
+            assert _sorted_rows(sql(tpu, q)) == _sorted_rows(sql(host, q)), q
+
+    def test_member_decline_declines_view(self):
+        tpu = self._pair("tpu")
+        out = tpu.aggregate_many(
+            "ev", ["cnt >= 7"], group_by=["name"], value_cols=["val"]
+        )
+        assert out == [None]
+
+    def test_scope_filters_apply(self):
+        from geomesa_tpu.store.merged import MergedDataStoreView
+
+        a = _mk("tpu", n=1500, seed=61)
+        b = _mk("tpu", n=1500, seed=62)
+        view = MergedDataStoreView([
+            (a, "BBOX(geom, -60, -45, 0, 0)"),
+            (b, "BBOX(geom, 0, 0, 60, 45)"),
+        ])
+        out = view.aggregate_many("ev", [None], group_by=["name"])[0]
+        assert out is not None
+        want = view.stats_count("ev", None, exact=True)
+        assert int(out["count"].sum()) == int(want)
+
+
 class TestMeshAggFuzz:
     def test_random_queries_parity(self):
         """Property fuzz: random bbox/time filters x random group/value
