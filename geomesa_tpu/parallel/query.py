@@ -825,7 +825,7 @@ def cached_batched_density_step(mesh: Mesh, width: int, height: int):
 
 
 def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
-                          capacity: int):
+                          capacity: int, with_ttl: bool = False):
     """Fused grouped-aggregation scan: the distributed SQL GROUP BY engine
     (the ``GeoMesaRelation.scala:94`` / Spark relational-aggregation role,
     SURVEY.md §2.14) as ONE mesh pass — per shard, a segment-reduce of every
@@ -856,6 +856,15 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
     the passing ones, which (unlike subtracting false positives) is a sound
     correction for min/max too. ``hits > capacity`` on any shard means that
     query's correction set truncated — the caller falls back for it.
+
+    ``with_ttl``: one extra input ``cut`` (2,) int32 — the age-off cutoff's
+    quantized (bin, offset). Rows strictly BELOW the cutoff unit are
+    genuinely expired (quantization floors) and drop entirely; rows
+    strictly AFTER it are genuinely fresh; rows AT the unit are ambiguous
+    at quantized granularity and route to the boundary gather for the
+    host's exact-millisecond re-add — the same additive-exactness scheme
+    as the spatial/temporal edges, so live TTL stores stay on the mesh
+    (the AgeOffIterator-at-scan role on the aggregation path).
     """
 
     @jax.jit
@@ -873,6 +882,7 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
             P(),                 # true_n
             P(QUERY_AXIS, None, None),  # boxes
             P(QUERY_AXIS, None, None),  # times
+            *((P(),) if with_ttl else ()),  # cut (2,)
         ),
         out_specs=(
             P(QUERY_AXIS, None),
@@ -886,10 +896,17 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
         ),
         check_vma=False,
     )
-    def step(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times):
+    def step(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times,
+             *ttl_args):
         n = x.shape[0]
         base = jax.lax.axis_index(DATA_AXIS) * n
         rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
+        ttl_fresh = ttl_edge = None
+        if with_ttl:
+            (cut,) = ttl_args
+            ttl_fresh = (bins > cut[0]) | ((bins == cut[0]) & (offs > cut[1]))
+            ttl_edge = (bins == cut[0]) & (offs == cut[1])
+            rows_valid = rows_valid & (ttl_fresh | ttl_edge)
 
         def one(args_q):
             boxes_q, times_q = args_q  # (B, 4), (T, 4)
@@ -902,6 +919,8 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
             time_edge = jnp.zeros((n,), dtype=jnp.bool_)
             for k in range(times_q.shape[0]):
                 time_edge |= _slot_time_edge(bins, offs, times_q[k])
+            if with_ttl:
+                time_edge |= ttl_edge
             in_all = (
                 in_box
                 & _batched_time_match(bins, offs, times_q[None])[0]
@@ -974,5 +993,5 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
 
 @lru_cache(maxsize=None)
 def cached_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
-                            capacity: int):
-    return make_grouped_agg_step(mesh, n_groups, n_vals, capacity)
+                            capacity: int, with_ttl: bool = False):
+    return make_grouped_agg_step(mesh, n_groups, n_vals, capacity, with_ttl)

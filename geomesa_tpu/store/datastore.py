@@ -26,6 +26,15 @@ from geomesa_tpu.store.backends import ExecutionBackend, OracleBackend, TpuBacke
 _BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
 
 
+def _ttl_cutoff_ms(ttl_ms: int, now_ms: int | None = None) -> int:
+    """THE age-off cutoff: rows with dtg >= cutoff are live. One definition
+    shared by the query-time mask, the mesh aggregation mask, and physical
+    age_off(), so the three can never drift."""
+    import time as _time
+
+    return (int(_time.time() * 1000) if now_ms is None else now_ms) - ttl_ms
+
+
 def _pure_bbox_time(f: ast.Filter, sft: FeatureType) -> bool:
     """True when the filter is a conjunction of spatial-box/temporal
     primaries on the schema's DEFAULT geometry/date fields — fully
@@ -701,9 +710,7 @@ class DataStore:
         ttl = self._age_off_ttl_ms(st.sft)
         if ttl is None or st.sft.dtg_field is None or st.total_rows == 0:
             return 0
-        import time as _time
-
-        cutoff = (int(_time.time() * 1000) if now_ms is None else now_ms) - ttl
+        cutoff = _ttl_cutoff_ms(ttl, now_ms)
         with st.mutate_lock:
             main, _, delta, n_tables = st.consume_snapshot()
             parts = [t for t in (main, delta) if t is not None]
@@ -800,10 +807,10 @@ class DataStore:
         if ttl is not None and st.sft.dtg_field is not None:
             from dataclasses import replace as _replace
 
-            now_ms = q.hints.get("now_ms")
-            if now_ms is None:
-                now_ms = int(_time.time() * 1000)
-            cut = ast.Compare(">=", st.sft.dtg_field, now_ms - ttl)
+            cut = ast.Compare(
+                ">=", st.sft.dtg_field,
+                _ttl_cutoff_ms(ttl, q.hints.get("now_ms")),
+            )
             q = _replace(q, filter=ast.And((q.resolved_filter(), cut)))
 
         t_start = _time.perf_counter()
@@ -1270,9 +1277,12 @@ class DataStore:
             # string columns: the cached dictionary codes (ArrowDictionary
             # role) replace an O(n log n) OBJECT-array sort with int32 work —
             # the dominant cost of cold aggregation staging at 10M+ rows.
-            # Only when every value is a set string: the dictionary maps
-            # invalid values to "", which would collide with a real ""
-            d = col.dictionary() if col.valid is None else None
+            # Only when every value is a SET STRING: the dictionary maps
+            # invalid AND stray non-str values to "", which would collide
+            # with a real "" / diverge from the host fold's raw-value keys
+            d = None
+            if col.valid is None and all(type(v) is str for v in vals):
+                d = col.dictionary()
             if d is not None:
                 vocab, codes = d
                 vocabs.append(list(vocab))
@@ -1400,7 +1410,7 @@ class DataStore:
         return cached, rowid, dv, hv
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
-                       value_cols=()):
+                       value_cols=(), now_ms: int | None = None):
         """Batched grouped aggregation on the mesh: ONE fused pass computes,
         per query, COUNT(*) plus per-value-column count/sum/min/max for
         every GROUP BY key — a per-shard segment-reduce merged across the
@@ -1423,8 +1433,11 @@ class DataStore:
         re-tested host-side against the full f64 filter AST, and ADDED —
         sound for min/max, unlike subtracting false positives. Pending
         hot-tier (delta) rows are folded host-side, so live stores stay on
-        the mesh path. Value sums ride f64 (ints beyond 2**53 lose
-        precision — the documented Spark-parity caveat).
+        the mesh path. TTL stores stay too: rows strictly below the
+        cutoff's quantized unit drop on device, rows AT the ambiguous unit
+        ride the boundary gather for an exact-millisecond host re-add
+        (``now_ms`` pins the clock for tests). Value sums ride f64 (ints
+        beyond 2**53 lose precision — the documented Spark-parity caveat).
         """
         st = self._state(type_name)
         qs = [
@@ -1438,10 +1451,12 @@ class DataStore:
         out: list = [None] * len(qs)
         group_by = list(group_by) if group_by else None
         value_cols = list(value_cols)
-        # TTL stores: expired rows sit in the device layout and a grouped
-        # fold cannot correct them additively — the host fold serves
-        if self._age_off_ttl_ms(st.sft) is not None:
+        ttl = self._age_off_ttl_ms(st.sft)
+        if ttl is not None and st.sft.dtg_field is None:
             return out
+        cutoff_ms = None
+        if ttl is not None:
+            cutoff_ms = _ttl_cutoff_ms(ttl, now_ms)
         main, indices, backend_state, _stats, delta = st.snapshot()
         main_n = 0 if main is None else len(main)
         dev = dev_name = None
@@ -1481,12 +1496,25 @@ class DataStore:
         times = np.stack([p[1] for _, p in live])
         (boxes, times), _ = pad_query_axis(mesh, boxes, times)
         try:
-            step = cached_grouped_agg_step(mesh, G_pad, len(value_cols), cap)
+            step = cached_grouped_agg_step(
+                mesh, G_pad, len(value_cols), cap,
+                with_ttl=cutoff_ms is not None,
+            )
             c = dev.cols
+            ttl_args = ()
+            if cutoff_ms is not None:
+                from geomesa_tpu.curve.binned_time import BinnedTime
+
+                (cb,), (co,) = BinnedTime(
+                    st.sft.z3_interval
+                ).to_bin_and_offset(np.array([cutoff_ms]))
+                ttl_args = (
+                    jnp.asarray(np.array([cb, co], dtype=np.int32)),
+                )
             res = step(
                 c["x"], c["y"], c["bins"], c["offs"], dev_gid, dev_rowid,
                 dev_vals, jnp.int32(main_n), jnp.asarray(boxes),
-                jnp.asarray(times),
+                jnp.asarray(times), *ttl_args,
             )
             cnt, first, vcnt, vsum, vmin, vmax, epos, ehits = map(
                 np.asarray, res
@@ -1510,6 +1538,7 @@ class DataStore:
                 vmin[k, :, :G].copy(),
                 vmax[k, :, :G].copy(),
                 epos[k], ehits[k], perm, gid_orig, host_vals, group_by,
+                cutoff_ms,
             )
             self.metrics.counter("store.queries").inc()
             # audit the POST-correction total (edge + delta rows included),
@@ -1534,13 +1563,15 @@ class DataStore:
 
     def _assemble_agg(self, q, main, delta, keys, value_cols, cnt, first,
                       vcnt, vsum, vmin, vmax, epos, ehits, perm, gid_orig,
-                      host_vals, group_by):
+                      host_vals, group_by, cutoff_ms=None):
         """Fold the host-side corrections into the device partials: edge
-        candidates re-tested exactly (added, never subtracted) and pending
-        delta rows (which may introduce new group keys). Groups are ordered
-        by their first MATCHING row index — identical to the host fold's
-        first-occurrence-over-filtered-rows construction (delta rows order
-        after the main tier at ``main_n + delta_row``, as in query())."""
+        candidates re-tested exactly (added, never subtracted; ``cutoff_ms``
+        adds the exact-millisecond TTL check the device's quantized mask
+        cannot make) and pending delta rows (which may introduce new group
+        keys). Groups are ordered by their first MATCHING row index —
+        identical to the host fold's first-occurrence-over-filtered-rows
+        construction (delta rows order after the main tier at
+        ``main_n + delta_row``, as in query())."""
         f = q.resolved_filter()
         V = len(value_cols)
         main_n = len(main)
@@ -1564,6 +1595,8 @@ class DataStore:
             if f is not None:
                 m = np.asarray(f.mask(main.take(rows)), dtype=bool)
                 rows = rows[m]
+            if cutoff_ms is not None and len(rows):
+                rows = rows[main.dtg_millis()[rows] >= cutoff_ms]
             for r in rows:
                 _fold_row(int(gid_orig[r]), int(r), lambda v: host_vals[v][r])
 
@@ -1574,6 +1607,8 @@ class DataStore:
                 if f is None
                 else np.asarray(f.mask(delta), dtype=bool)
             )
+            if cutoff_ms is not None:
+                dm &= delta.dtg_millis() >= cutoff_ms
             drows = np.nonzero(dm)[0]
             if len(drows):
                 key_pos = {kk: i for i, kk in enumerate(keys)}

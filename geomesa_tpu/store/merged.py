@@ -124,7 +124,7 @@ class MergedDataStoreView:
         return total
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
-                       value_cols=()):
+                       value_cols=(), now_ms: int | None = None):
         """Federated grouped aggregation: push the fold to every member
         (each runs its own fused mesh pass — or its owner's, over HTTP via
         RemoteDataStore) and merge the per-group partials at the view level:
@@ -157,7 +157,7 @@ class MergedDataStoreView:
                 subs.append(replace(q, filter=f))
             per_member.append(
                 agg(type_name, subs, group_by=group_by,
-                    value_cols=value_cols)
+                    value_cols=value_cols, now_ms=now_ms)
             )
         out: list = []
         vcols = list(value_cols)

@@ -127,7 +127,7 @@ class RemoteDataStore:
         return float(out["count"])
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
-                       value_cols=()):
+                       value_cols=(), now_ms: int | None = None):
         """Remote grouped aggregation: ship the query batch, get per-group
         partials back — the federation surface of the fused mesh
         segment-reduce (same result shape as DataStore.aggregate_many;
@@ -160,6 +160,8 @@ class RemoteDataStore:
             "group_by": list(group_by) if group_by else None,
             "value_cols": list(value_cols),
         }
+        if now_ms is not None:
+            body["now_ms"] = int(now_ms)  # pinned TTL clock crosses the wire
         res = self._send(
             "POST", f"/api/schemas/{type_name}/aggregate", body
         )["results"]

@@ -426,10 +426,12 @@ class GeoMesaApp:
         if auths is not None:
             # visibility-filtered rows can't ride the batched device fold
             queries = [Query(filter=c, auths=auths) for c in queries]
+        now_ms = body.get("now_ms")
         out = agg(
             name, queries,
             group_by=body.get("group_by"),
             value_cols=body.get("value_cols", []),
+            now_ms=None if now_ms is None else int(now_ms),
         )
 
         def _key(v):

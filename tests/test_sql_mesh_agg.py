@@ -167,26 +167,82 @@ class TestMeshAggParity:
         ):
             assert _sorted_rows(sql(tpu, q)) == _sorted_rows(sql(host, q))
 
-    def test_ttl_store_falls_back_with_parity(self):
+    def test_ttl_store_serves_on_mesh_with_parity(self, monkeypatch):
+        """TTL stores stay on the mesh path (rows below the quantized
+        cutoff unit drop on device; the ambiguous unit re-adds host-side
+        at exact ms) with full host-fold parity."""
+        import time as _time
+
         from geomesa_tpu.schema.sft import parse_spec
 
+        now = int(_time.time() * 1000)
         results = {}
         for backend in ("tpu", "oracle"):
             sft = parse_spec("tt", "name:String,val:Double,dtg:Date,*geom:Point")
-            sft.user_data["geomesa.age.off"] = 10 * 365 * 86_400_000
+            sft.user_data["geomesa.age.off"] = 3_600_000  # 1h
             ds = DataStore(backend=backend)
             ds.create_schema(sft)
-            ds.write("tt", [
-                {"name": f"g{i % 3}", "val": float(i),
-                 "dtg": T0 + i, "geom": Point(float(i % 50), 0.0)}
-                for i in range(300)
-            ], fids=[str(i) for i in range(300)])
+            recs = []
+            for i in range(400):
+                fresh = i % 2 == 0
+                recs.append({
+                    "name": f"g{i % 3}", "val": float(i),
+                    # expired rows are 2h old; fresh ones a few minutes
+                    "dtg": now - (7_200_000 if not fresh else 120_000 + i),
+                    "geom": Point(float(i % 50), 0.0),
+                })
+            ds.write("tt", recs, fids=[str(i) for i in range(400)])
             ds.compact("tt")
+            if backend == "tpu":
+                calls = {"q": 0}
+                real = ds.query
+                monkeypatch.setattr(
+                    ds, "query",
+                    lambda *a, **k: (calls.__setitem__("q", calls["q"] + 1),
+                                    real(*a, **k))[1],
+                )
             r = sql(ds, "SELECT name, COUNT(*) AS n, SUM(val) AS s FROM tt "
                         "GROUP BY name")
+            if backend == "tpu":
+                assert calls["q"] == 0, "TTL store fell back to the host fold"
+                monkeypatch.undo()
             results[backend] = _sorted_rows(r)
         assert results["tpu"] == results["oracle"]
         assert len(results["tpu"]) == 3
+        # only fresh rows counted
+        assert sum(n for _, n, _ in results["tpu"]) == 200
+
+    def test_ttl_ambiguous_unit_exact_ms(self):
+        """Rows whose timestamp is below the cutoff but inside the SAME
+        quantized (bin, offset) unit must not aggregate (exact-ms parity
+        with the host fold — the device mask alone cannot decide them)."""
+        from geomesa_tpu.schema.sft import parse_spec
+
+        t0 = 1_500_000_000_000  # whole second = quantization boundary
+        ttl = 3_600_000
+        now_ms = t0 + ttl + 500  # cutoff lands mid-second at t0 + 500
+        sft = parse_spec("ta", "name:String,val:Double,dtg:Date,*geom:Point")
+        sft.user_data["geomesa.age.off"] = ttl
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        recs = []
+        for i in range(200):
+            if i % 2 == 0:  # fresh: 100ms after the cutoff, same second
+                recs.append({"name": "g", "val": 1.0, "dtg": t0 + 600,
+                             "geom": Point(1.0, 1.0)})
+            else:  # expired by 400ms, SAME second as the cutoff
+                recs.append({"name": "g", "val": 1000.0, "dtg": t0 + 100,
+                             "geom": Point(1.0, 1.0)})
+        ds.write("ta", recs, fids=[str(i) for i in range(200)])
+        ds.compact("ta")
+        out = ds.aggregate_many(
+            "ta", [None], group_by=["name"], value_cols=["val"],
+            now_ms=now_ms,
+        )[0]
+        assert out is not None
+        assert int(out["count"].sum()) == 100  # expired half excluded
+        assert float(out["cols"]["val"]["sum"][0]) == 100.0
+        assert float(out["cols"]["val"]["max"][0]) == 1.0
 
 
 class TestHostOrderParity:
