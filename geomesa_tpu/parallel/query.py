@@ -824,8 +824,48 @@ def cached_batched_density_step(mesh: Mesh, width: int, height: int):
     return make_batched_density_step(mesh, width=width, height=height)
 
 
+# above this group cardinality the (chunk, G) one-hot's O(n·G) FLOPs and
+# footprint lose to segment_sum's O(n) — "auto" falls back to segments
+_MXU_BINCOUNT_MAX_GROUPS = 2048
+
+
+def _onehot_bincount(ids, num_classes: int, chunk: int = 8192):
+    """Exact bincount as chunked one-hot matmuls (the MXU histogram trick
+    the density step uses for its 2-D variant at ``make_batched_density_
+    step``): bf16 one-hot entries are exactly 0/1, each (1, chunk) ·
+    (chunk, C) product accumulates in f32 — exact because a chunk partial
+    is <= ``chunk`` — and the CROSS-chunk carry rides int32, so totals stay
+    exact at ANY count (an f32 carry would silently round past 2**24).
+
+    ``ids`` (N,) int32 in [0, num_classes); returns (num_classes,) int32.
+    CONTRACT: class ``num_classes - 1`` is a DISCARD class (callers route
+    non-matching rows there and slice it off) — chunk padding joins it, so
+    pad lanes never pollute a real bucket.
+    """
+    n = ids.shape[0]
+    k = -(-n // chunk)
+    pad = k * chunk - n
+    sp = jnp.pad(ids, (0, pad), constant_values=num_classes - 1)
+    sp = sp.reshape(k, chunk)
+
+    def body(acc, sc):
+        oh = jax.nn.one_hot(sc, num_classes, dtype=jnp.bfloat16)
+        ones = jnp.ones((1, chunk), dtype=jnp.bfloat16)
+        part = jax.lax.dot_general(
+            ones, oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part[0].astype(jnp.int32), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros(num_classes, jnp.int32), sp
+    )
+    return acc
+
+
 def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
-                          capacity: int, with_ttl: bool = False):
+                          capacity: int, with_ttl: bool = False,
+                          impl: str = "auto"):
     """Fused grouped-aggregation scan: the distributed SQL GROUP BY engine
     (the ``GeoMesaRelation.scala:94`` / Spark relational-aggregation role,
     SURVEY.md §2.14) as ONE mesh pass — per shard, a segment-reduce of every
@@ -865,7 +905,26 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
     host's exact-millisecond re-add — the same additive-exactness scheme
     as the spatial/temporal edges, so live TTL stores stay on the mesh
     (the AgeOffIterator-at-scan role on the aggregation path).
+
+    ``impl``: how the integer folds (cnt / vcnt) compute. ``"mxu"`` uses
+    the one-hot-matmul bincount (:func:`_onehot_bincount` — the density
+    kernel's scatter-beating trick, exact at any count via an int32
+    cross-chunk carry); ``"segment"`` uses XLA segment_sum; ``"auto"``
+    picks mxu on TPU backends when the group cardinality is small enough
+    that the (chunk, G) one-hot pays for itself — high-cardinality GROUP
+    BY does O(n·G) matmul FLOPs vs segment_sum's O(n), so it falls back.
+    f64 sums and extrema always ride segment ops — matmul accumulation
+    would cost f64 exactness.
     """
+    if impl == "auto":
+        impl = (
+            "mxu"
+            if jax.default_backend() == "tpu"
+            and n_groups <= _MXU_BINCOUNT_MAX_GROUPS
+            else "segment"
+        )
+    if impl not in ("mxu", "segment"):
+        raise ValueError(f"impl must be auto|mxu|segment: {impl!r}")
 
     @jax.jit
     @partial(
@@ -928,12 +987,21 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
             )
             boundary = in_all & (on_edge | time_edge)
             fold = in_all & ~(on_edge | time_edge)
+
+            def bincount(mask):
+                """Rows-matching-``mask`` per group, exactly."""
+                s = jnp.where(mask, gid, n_groups)
+                if impl == "segment":
+                    return jax.ops.segment_sum(
+                        mask.astype(jnp.int32), s,
+                        num_segments=n_groups + 1,
+                    )[:n_groups]
+                return _onehot_bincount(s, n_groups + 1)[:n_groups]
+
             # non-folding rows route to an overflow segment that is sliced
             # off — segment ids stay static-shape friendly
             seg = jnp.where(fold, gid, n_groups)
-            cnt = jax.ops.segment_sum(
-                fold.astype(jnp.int32), seg, num_segments=n_groups + 1
-            )[:n_groups]
+            cnt = bincount(fold)
             imax = jnp.int32(np.iinfo(np.int32).max)
             first = jax.ops.segment_min(
                 jnp.where(fold, rowid, imax), seg,
@@ -945,9 +1013,7 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
                     vv = vals[v]
                     ok = fold & ~jnp.isnan(vv)
                     segv = jnp.where(ok, gid, n_groups)
-                    vcnts.append(jax.ops.segment_sum(
-                        ok.astype(jnp.int32), segv,
-                        num_segments=n_groups + 1)[:n_groups])
+                    vcnts.append(bincount(ok))
                     vsums.append(jax.ops.segment_sum(
                         jnp.where(ok, vv, 0.0), segv,
                         num_segments=n_groups + 1)[:n_groups])
@@ -993,5 +1059,8 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
 
 @lru_cache(maxsize=None)
 def cached_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
-                            capacity: int, with_ttl: bool = False):
-    return make_grouped_agg_step(mesh, n_groups, n_vals, capacity, with_ttl)
+                            capacity: int, with_ttl: bool = False,
+                            impl: str = "auto"):
+    return make_grouped_agg_step(
+        mesh, n_groups, n_vals, capacity, with_ttl, impl
+    )
