@@ -865,14 +865,19 @@ def _onehot_bincount(ids, num_classes: int, chunk: int = 8192):
 
 def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
                           capacity: int, with_ttl: bool = False,
-                          impl: str = "auto"):
+                          impl: str = "auto", overlap: bool = False):
     """Fused grouped-aggregation scan: the distributed SQL GROUP BY engine
     (the ``GeoMesaRelation.scala:94`` / Spark relational-aggregation role,
     SURVEY.md §2.14) as ONE mesh pass — per shard, a segment-reduce of every
     value column over the group-id column; partials merged across the data
     axis with ``psum`` (counts/sums) and ``pmin``/``pmax`` (extrema).
 
-    fn(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times) →
+    Point mode: fn(x, y, bins, offs, gid, rowid, vals, true_n, boxes,
+    times). Overlap mode (``overlap=True``, the XZ2/XZ3 extended-geometry
+    layout): fn(xmin, ymin, xmax, ymax, bins, offs, gid, rowid, vals,
+    true_n, boxes, times) — the spatial test is int-bbox overlap, exact
+    for the envelope-semantics BBOX predicate away from edge buckets.
+    Either returns →
         (cnt (Q, G) int32      — filter-matching rows per group,
          first (Q, G) int32    — min ``rowid`` among matching rows
                                  (int32 max where empty) — callers order
@@ -925,16 +930,14 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
         )
     if impl not in ("mxu", "segment"):
         raise ValueError(f"impl must be auto|mxu|segment: {impl!r}")
+    n_spatial = 6 if overlap else 4
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),        # x
-            P(DATA_AXIS),        # y
-            P(DATA_AXIS),        # bins
-            P(DATA_AXIS),        # offs
+            *(P(DATA_AXIS) for _ in range(n_spatial)),  # spatial+time cols
             P(DATA_AXIS),        # gid
             P(DATA_AXIS),        # rowid
             P(None, DATA_AXIS),  # vals (V, N)
@@ -955,9 +958,15 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
         ),
         check_vma=False,
     )
-    def step(x, y, bins, offs, gid, rowid, vals, true_n, boxes, times,
-             *ttl_args):
-        n = x.shape[0]
+    def step(*args):
+        cols = args[:n_spatial]
+        (gid, rowid, vals, true_n, boxes, times, *ttl_args) = args[n_spatial:]
+        if overlap:
+            fxmin, fymin, fxmax, fymax, bins, offs = cols
+            n = fxmin.shape[0]
+        else:
+            x, y, bins, offs = cols
+            n = x.shape[0]
         base = jax.lax.axis_index(DATA_AXIS) * n
         rows_valid = (base + jnp.arange(n, dtype=jnp.int32)) < true_n
         ttl_fresh = ttl_edge = None
@@ -972,7 +981,12 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
             in_box = jnp.zeros((n,), dtype=jnp.bool_)
             on_edge = jnp.zeros((n,), dtype=jnp.bool_)
             for k in range(boxes_q.shape[0]):
-                ins, edg = _slot_point(x, y, boxes_q[k])
+                if overlap:
+                    ins, edg = _slot_overlap(
+                        fxmin, fymin, fxmax, fymax, boxes_q[k]
+                    )
+                else:
+                    ins, edg = _slot_point(x, y, boxes_q[k])
                 in_box |= ins
                 on_edge |= edg
             time_edge = jnp.zeros((n,), dtype=jnp.bool_)
@@ -1060,7 +1074,7 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
 @lru_cache(maxsize=None)
 def cached_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
                             capacity: int, with_ttl: bool = False,
-                            impl: str = "auto"):
+                            impl: str = "auto", overlap: bool = False):
     return make_grouped_agg_step(
-        mesh, n_groups, n_vals, capacity, with_ttl, impl
+        mesh, n_groups, n_vals, capacity, with_ttl, impl, overlap
     )

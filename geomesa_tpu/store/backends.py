@@ -91,6 +91,18 @@ class _MeshIndexState:
         """Device bytes held by this index's sharded columns (incl. padding)."""
         return int(sum(int(c.nbytes) for c in self.cols.values()))
 
+    def spatial_cols(self) -> tuple:
+        """THE ordered spatial+time column tuple every kernel expects:
+        (x, y, bins, offs) for point layouts, (xmin, ymin, xmax, ymax,
+        bins, offs) for bbox layouts — one definition so the positional
+        contract cannot drift per call site (the kernels accept any int32
+        arrays, so a mis-ordered tuple is silently wrong, not an error)."""
+        c = self.cols
+        if self.kind == "bboxes":
+            return (c["xmin"], c["ymin"], c["xmax"], c["ymax"],
+                    c["bins"], c["offs"])
+        return (c["x"], c["y"], c["bins"], c["offs"])
+
 
 class TpuBackend(ExecutionBackend):
     """Mesh-sharded columnar execution: the distributed-scan role of the

@@ -1172,13 +1172,7 @@ class DataStore:
                     gather = cached_batched_edge_gather_step(
                         mesh, cap, overlap=bbox_dev is not None
                     )
-                    c = (bbox_dev or dev).cols
-                    col_args = (
-                        (c["xmin"], c["ymin"], c["xmax"], c["ymax"],
-                         c["bins"], c["offs"])
-                        if bbox_dev is not None
-                        else (c["x"], c["y"], c["bins"], c["offs"])
-                    )
+                    col_args = (bbox_dev or dev).spatial_cols()
                     counts, edge_pos, edge_hits = gather(
                         *col_args, jnp.int32(main_n),
                         jnp.asarray(boxes), jnp.asarray(times),
@@ -1187,12 +1181,10 @@ class DataStore:
                     edge_pos = np.asarray(edge_pos)   # (Qp, D, cap)
                     edge_hits = np.asarray(edge_hits)  # (Qp, D)
                 elif bbox_dev is not None:
-                    c = bbox_dev.cols
                     step = cached_batched_overlap_step(mesh, with_time=True)
                     counts = np.asarray(
                         step(
-                            c["xmin"], c["ymin"], c["xmax"], c["ymax"],
-                            c["bins"], c["offs"],
+                            *bbox_dev.spatial_cols(),
                             jnp.int32(main_n),
                             jnp.asarray(boxes), jnp.asarray(times),
                         )
@@ -1460,8 +1452,15 @@ class DataStore:
         main, indices, backend_state, _stats, delta = st.snapshot()
         main_n = 0 if main is None else len(main)
         dev = dev_name = None
+        overlap = False
         if isinstance(self.backend, TpuBackend) and self._device_available():
             dev, dev_name = TpuBackend.point_state(backend_state)
+            if dev is None:
+                # extended-geometry store (XZ layout): the spatial fold is
+                # int-bbox OVERLAP — exact for the envelope-semantics BBOX
+                # predicate away from edge buckets
+                dev, dev_name = TpuBackend.bbox_state(backend_state)
+                overlap = dev is not None
         perm = None
         if dev is not None and dev_name in (indices or {}):
             perm = indices[dev_name].perm
@@ -1477,7 +1476,7 @@ class DataStore:
         except (TypeError, ValueError):
             return out
         G = len(keys)
-        pending = self._batch_payloads(st, qs, overlap=False)
+        pending = self._batch_payloads(st, qs, overlap=overlap)
         live = [(i, p) for i, p, ok in pending if p is not None and ok]
         for i, p, ok in pending:
             if p is None:  # provably-disjoint filter: zero rows, no groups
@@ -1498,9 +1497,8 @@ class DataStore:
         try:
             step = cached_grouped_agg_step(
                 mesh, G_pad, len(value_cols), cap,
-                with_ttl=cutoff_ms is not None,
+                with_ttl=cutoff_ms is not None, overlap=overlap,
             )
-            c = dev.cols
             ttl_args = ()
             if cutoff_ms is not None:
                 from geomesa_tpu.curve.binned_time import BinnedTime
@@ -1512,7 +1510,7 @@ class DataStore:
                     jnp.asarray(np.array([cb, co], dtype=np.int32)),
                 )
             res = step(
-                c["x"], c["y"], c["bins"], c["offs"], dev_gid, dev_rowid,
+                *dev.spatial_cols(), dev_gid, dev_rowid,
                 dev_vals, jnp.int32(main_n), jnp.asarray(boxes),
                 jnp.asarray(times), *ttl_args,
             )

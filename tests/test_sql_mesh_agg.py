@@ -245,6 +245,52 @@ class TestMeshAggParity:
         assert float(out["cols"]["val"]["max"][0]) == 1.0
 
 
+class TestExtendedGeometryAggregation:
+    def _mk(self, backend):
+        from geomesa_tpu.geometry.types import LineString
+
+        rng = np.random.default_rng(71)
+        ds = DataStore(backend=backend)
+        ds.create_schema("trk", "name:String,val:Double,dtg:Date,*geom:LineString")
+        recs = []
+        for i in range(1200):
+            cx, cy = rng.uniform(-60, 60), rng.uniform(-45, 45)
+            pts = np.stack([
+                cx + np.cumsum(rng.normal(0, 0.05, 5)),
+                cy + np.cumsum(rng.normal(0, 0.05, 5)),
+            ], axis=1)
+            recs.append({
+                "name": f"g{i % 5}", "val": float(i % 90),
+                "dtg": T0 + i * 1000, "geom": LineString(pts),
+            })
+        ds.write("trk", recs, fids=[str(i) for i in range(1200)])
+        ds.compact("trk")
+        return ds
+
+    def test_xz_store_group_by_on_mesh(self, monkeypatch):
+        """Extended-geometry (XZ bbox-layout) stores aggregate on the mesh
+        via the int-bbox overlap fold, with host parity and zero row
+        materialization."""
+        tpu = self._mk("tpu")
+        host = self._mk("oracle")
+        calls = {"q": 0}
+        real = tpu.query
+        monkeypatch.setattr(
+            tpu, "query",
+            lambda *a, **k: (calls.__setitem__("q", calls["q"] + 1),
+                            real(*a, **k))[1],
+        )
+        for q in (
+            "SELECT name, COUNT(*) AS n, SUM(val) AS s FROM trk "
+            "WHERE BBOX(geom, -40, -30, 20, 25) GROUP BY name",
+            "SELECT name, MIN(val) AS lo, MAX(val) AS hi FROM trk "
+            "GROUP BY name",
+        ):
+            got = _sorted_rows(sql(tpu, q))
+            assert calls["q"] == 0, "extended-geometry agg materialized rows"
+            assert got == _sorted_rows(sql(host, q)), q
+
+
 class TestHostOrderParity:
     def test_group_order_is_first_matching_row(self):
         """Host fold orders groups by first occurrence among FILTERED rows;
