@@ -1199,6 +1199,9 @@ def bench_grouped_agg():
         "detail": {
             "n_points": N, "groups": G, "queries": qn,
             "devices": jax.device_count(),
+            "count_impl": (
+                "mxu-onehot" if jax.default_backend() == "tpu" else "segment"
+            ),
             "batch_p50_ms": round(dev_ms, 3),
             "host_fold_ms_per_query": round(host_ms, 3),
             "group_count_parity": parity,
