@@ -494,14 +494,20 @@ def _having_passes(hit, op, lit: float, v) -> bool:
 
 
 def _apply_order_limit(res: SqlResult, order, limit) -> SqlResult:
+    """``order`` is a list of (column, desc) pairs — multi-key sorts apply
+    keys last-to-first with stable sorts (lexicographic order). Tie
+    behavior is the store's (``store.reduce.stable_order``), so engine
+    paths are order-indistinguishable."""
+    from geomesa_tpu.store.reduce import stable_order
+
     cols = res.columns
-    if order is not None:
-        if order[0] not in cols:
-            raise SqlError(f"ORDER BY {order[0]!r} not in select list")
-        perm = np.argsort(cols[order[0]], kind="stable")
-        if order[1]:
-            perm = perm[::-1]
-        res = SqlResult({k: v[perm] for k, v in cols.items()})
+    if order:
+        for col_name, desc in reversed(order):
+            if col_name not in cols:
+                raise SqlError(f"ORDER BY {col_name!r} not in select list")
+            perm = stable_order(cols[col_name], desc)
+            cols = {k: v[perm] for k, v in cols.items()}
+        res = SqlResult(cols)
     if limit is not None:
         res = SqlResult({k: v[:limit] for k, v in res.columns.items()})
     return res
@@ -630,10 +636,18 @@ def sql(ds, statement: str) -> SqlResult:
     limit = int(m.group("limit")) if m.group("limit") else None
     order = None
     if m.group("order"):
-        om = re.match(r"^(\w+)(?:\s+(asc|desc))?$", m.group("order").strip(), re.IGNORECASE)
-        if not om:
+        order = []
+        for part in _split_top(m.group("order")):
+            om = re.match(
+                r"^(\w+)(?:\s+(asc|desc))?$", part.strip(), re.IGNORECASE
+            )
+            if not om:
+                raise SqlError(f"unsupported ORDER BY {part!r}")
+            order.append(
+                (om.group(1), bool(om.group(2) and om.group(2).lower() == "desc"))
+            )
+        if not order:
             raise SqlError(f"unsupported ORDER BY {m.group('order')!r}")
-        order = (om.group(1), bool(om.group(2) and om.group(2).lower() == "desc"))
 
     cql = _rewrite_where(where) if where else None
     has_agg = any(i.kind == "agg" for i in items)
@@ -649,15 +663,46 @@ def sql(ds, statement: str) -> SqlResult:
     if not has_agg and not (group_by and having):
         if group_by:
             raise SqlError("GROUP BY requires aggregate select items")
+        # DISTINCT over plain columns IS a GROUP BY with no aggregates —
+        # ride the fused mesh fold (zero row materialization, first-
+        # occurrence order, NaN/unsupported types decline to the host
+        # dedup below). ORDER BY is held for the distinct row set.
+        if (
+            distinct and items
+            and all(i.kind == "col" for i in items)
+            # the host path can ORDER BY a non-selected column through the
+            # store's sort pushdown; the mesh fold only has the key columns
+            and all(o[0] in {i.name for i in items} for o in order or [])
+        ):
+            mesh_res = _mesh_aggregate(
+                ds, type_name, cql, items, [i.arg for i in items],
+                None, order, limit,
+            )
+            if mesh_res is not None:
+                return mesh_res
         # projection pushdown only when every item is a plain column; scalar
         # fns need their source column materialized. DISTINCT dedupes after
-        # the scan, so the limit must not truncate pre-dedup
+        # the scan, so the limit must not truncate pre-dedup. Multi-key
+        # ORDER BY sorts here after materialization (the store's sort_by
+        # pushdown is single-key); it must reference select-list columns.
         props = None
         if all(i.kind == "col" for i in items):
             props = [i.arg for i in items]
+        push_sort = post_sort = None
+        if order and len(order) == 1:
+            # resolve a select-list ALIAS back to its source column for the
+            # store pushdown (the store knows schema names, not aliases)
+            fld, desc = order[0]
+            src = next(
+                (i.arg for i in items if i.kind == "col" and i.name == fld),
+                fld,
+            )
+            push_sort = (src, desc)
+        elif order:
+            post_sort = order
         q = Query(
-            filter=cql, properties=props, sort_by=order,
-            limit=None if distinct else limit,
+            filter=cql, properties=props, sort_by=push_sort,
+            limit=None if (distinct or post_sort) else limit,
         )
         r = ds.query(type_name, q)
         cols: dict[str, np.ndarray] = {}
@@ -685,8 +730,9 @@ def sql(ds, statement: str) -> SqlResult:
                     keep.append(i)
             idx = np.asarray(keep, dtype=np.int64)
             cols = {c: v[idx] for c, v in cols.items()}
-            if limit is not None:
-                cols = {c: v[:limit] for c, v in cols.items()}
+            return _apply_order_limit(SqlResult(cols), post_sort, limit)
+        if post_sort:
+            return _apply_order_limit(SqlResult(cols), post_sort, limit)
         return SqlResult(cols)
 
     # aggregate path: scan (with pushdown filter), then vectorized fold

@@ -20,6 +20,17 @@ from geomesa_tpu.schema.sft import FeatureType
 __all__ = ["reduce_result", "sample_rows", "density_grid", "bin_encode", "sort_limit"]
 
 
+def stable_order(values: np.ndarray, desc: bool) -> np.ndarray:
+    """THE stable argsort both directions, shared by the store's sort
+    pushdown and the SQL engine's post-sort so tie order can never diverge
+    between engines: descending keeps tied rows in their ORIGINAL order (a
+    plain ``argsort()[::-1]`` would reverse ties)."""
+    if not desc:
+        return np.argsort(values, kind="stable")
+    n = len(values)
+    return (n - 1 - np.argsort(values[::-1], kind="stable"))[::-1]
+
+
 def sort_limit(table, rows, sort_by, limit, start_index=None):
     """Shared client-side sort + paging tail (``QueryPlanner.scala:75-98``;
     ``start_index`` is the OGC ``Query.startIndex`` offset, applied after the
@@ -32,9 +43,7 @@ def sort_limit(table, rows, sort_by, limit, start_index=None):
     if sort_by is not None:
         fld, desc = sort_by
         keys = table.fids if fld == "id" else table.columns[fld].values
-        order = np.argsort(keys, kind="stable")
-        if desc:
-            order = order[::-1]
+        order = stable_order(keys, desc)
         table = table.take(order)
         rows = rows[order]
     lo = min(int(start_index), len(table)) if start_index else 0

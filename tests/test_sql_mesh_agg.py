@@ -245,6 +245,109 @@ class TestMeshAggParity:
         assert float(out["cols"]["val"]["max"][0]) == 1.0
 
 
+class TestDistinctAndMultiOrder:
+    def test_distinct_rides_mesh_with_parity(self, monkeypatch):
+        """SELECT DISTINCT over plain columns is a GROUP BY with no
+        aggregates: zero row materialization, host parity incl. first-
+        occurrence order and LIMIT."""
+        tpu = _mk("tpu")
+        host = _mk("oracle")
+        calls = {"q": 0}
+        real = tpu.query
+        monkeypatch.setattr(
+            tpu, "query",
+            lambda *a, **k: (calls.__setitem__("q", calls["q"] + 1),
+                            real(*a, **k))[1],
+        )
+        for q in (
+            "SELECT DISTINCT name FROM ev WHERE BBOX(geom, -50, -40, 10, -20)",
+            "SELECT DISTINCT name, cnt FROM ev "
+            "WHERE BBOX(geom, -30, -30, 30, 30)",
+            "SELECT DISTINCT name FROM ev ORDER BY name DESC LIMIT 3",
+        ):
+            got = sql(tpu, q)
+            assert calls["q"] == 0, f"DISTINCT materialized rows: {q}"
+            want = sql(host, q)
+            assert [tuple(r) for r in got.rows()] \
+                == [tuple(r) for r in want.rows()], q
+
+    def test_distinct_limit_first_occurrence_order(self):
+        """LIMIT on un-ORDERed DISTINCT returns the FIRST-seen keys on both
+        engines."""
+        for backend in ("tpu", "oracle"):
+            ds = DataStore(backend=backend)
+            ds.create_schema("d", "name:String,*geom:Point")
+            ds.write("d", [
+                {"name": n, "geom": Point(float(i), 0.0)}
+                for i, n in enumerate(["c", "a", "c", "b", "a", "d"])
+            ], fids=[str(i) for i in range(6)])
+            ds.compact("d")
+            r = sql(ds, "SELECT DISTINCT name FROM d LIMIT 2")
+            assert r.columns["name"].tolist() == ["c", "a"], backend
+
+    def test_distinct_desc_tie_order_parity(self):
+        """Descending sorts keep tied rows in first-occurrence order on
+        BOTH engines (a naive argsort()[::-1] reverses ties and splits the
+        engines under LIMIT)."""
+        for backend in ("tpu", "oracle"):
+            ds = DataStore(backend=backend)
+            ds.create_schema("t2", "name:String,cnt:Integer,*geom:Point")
+            ds.write("t2", [
+                {"name": "a", "cnt": 1, "geom": Point(1.0, 0.0)},
+                {"name": "a", "cnt": 2, "geom": Point(2.0, 0.0)},
+                {"name": "b", "cnt": 5, "geom": Point(3.0, 0.0)},
+            ], fids=["0", "1", "2"])
+            ds.compact("t2")
+            r = sql(ds, "SELECT DISTINCT name, cnt FROM t2 "
+                        "ORDER BY name DESC LIMIT 2")
+            assert [tuple(x) for x in r.rows()] == [("b", 5), ("a", 1)], backend
+
+    def test_order_by_alias(self):
+        for backend in ("tpu", "oracle"):
+            ds = DataStore(backend=backend)
+            ds.create_schema("al", "name:String,*geom:Point")
+            ds.write("al", [
+                {"name": n, "geom": Point(float(i), 0.0)}
+                for i, n in enumerate("cab")
+            ], fids=["0", "1", "2"])
+            ds.compact("al")
+            r = sql(ds, "SELECT name AS n FROM al ORDER BY n")
+            assert r.columns["n"].tolist() == ["a", "b", "c"], backend
+            r2 = sql(ds, "SELECT DISTINCT name AS n FROM al ORDER BY n DESC")
+            assert r2.columns["n"].tolist() == ["c", "b", "a"], backend
+
+    def test_empty_order_by_rejected(self):
+        from geomesa_tpu.sql.engine import SqlError
+
+        ds = _mk("tpu", n=50)
+        with pytest.raises(SqlError, match="ORDER BY"):
+            sql(ds, "SELECT name FROM ev ORDER BY , LIMIT 2")
+
+    def test_multi_key_order_by(self):
+        tpu = _mk("tpu")
+        host = _mk("oracle")
+        q = ("SELECT name, cnt, COUNT(*) AS n FROM ev "
+             "WHERE BBOX(geom, -40, -30, 40, 30) "
+             "GROUP BY name, cnt ORDER BY name ASC, cnt DESC LIMIT 12")
+        got = [tuple(r) for r in sql(tpu, q).rows()]
+        want = [tuple(r) for r in sql(host, q).rows()]
+        assert got == want
+        names = [r[0] for r in got]
+        assert names == sorted(names)
+        for nm in set(names):  # cnt strictly descending within each name
+            cs = [r[1] for r in got if r[0] == nm]
+            assert cs == sorted(cs, reverse=True)
+
+    def test_multi_key_order_plain_select(self):
+        tpu = _mk("tpu")
+        host = _mk("oracle")
+        q = ("SELECT name, cnt FROM ev WHERE BBOX(geom, -20, -20, 20, 20) "
+             "ORDER BY name DESC, cnt ASC LIMIT 20")
+        got = [tuple(r) for r in sql(tpu, q).rows()]
+        assert got == [tuple(r) for r in sql(host, q).rows()]
+        assert len(got) == 20
+
+
 class TestExtendedGeometryAggregation:
     def _mk(self, backend):
         from geomesa_tpu.geometry.types import LineString
