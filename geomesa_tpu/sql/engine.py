@@ -680,18 +680,10 @@ def sql(ds, statement: str) -> SqlResult:
             )
             if mesh_res is not None:
                 return mesh_res
-        # projection pushdown only when every item is a plain column; scalar
-        # fns need their source column materialized. DISTINCT dedupes after
-        # the scan, so the limit must not truncate pre-dedup. Multi-key
-        # ORDER BY sorts here after materialization (the store's sort_by
-        # pushdown is single-key); it must reference select-list columns.
-        props = None
-        if all(i.kind == "col" for i in items):
-            props = [i.arg for i in items]
+        # single-key ORDER BY pushes to the store (aliases resolved to
+        # source columns); multi-key sorts here after materialization
         push_sort = post_sort = None
         if order and len(order) == 1:
-            # resolve a select-list ALIAS back to its source column for the
-            # store pushdown (the store knows schema names, not aliases)
             fld, desc = order[0]
             src = next(
                 (i.arg for i in items if i.kind == "col" and i.name == fld),
@@ -700,6 +692,19 @@ def sql(ds, statement: str) -> SqlResult:
             push_sort = (src, desc)
         elif order:
             post_sort = order
+        # projection pushdown only when every item is a plain column; scalar
+        # fns need their source column materialized. DISTINCT dedupes after
+        # the scan, so the limit must not truncate pre-dedup. A multi-key
+        # sort may reference UNSELECTED schema columns — materialize them
+        # too (they feed the sort keys, never the output columns).
+        props = None
+        if all(i.kind == "col" for i in items):
+            props = [i.arg for i in items]
+            if post_sort:
+                sel = {i.name for i in items}
+                for f, _ in post_sort:
+                    if f not in sel and f not in props:
+                        props.append(f)
         q = Query(
             filter=cql, properties=props, sort_by=push_sort,
             limit=None if (distinct or post_sort) else limit,
@@ -730,9 +735,29 @@ def sql(ds, statement: str) -> SqlResult:
                     keep.append(i)
             idx = np.asarray(keep, dtype=np.int64)
             cols = {c: v[idx] for c, v in cols.items()}
+            # DISTINCT collapses rows: ordering by an unselected column is
+            # ill-defined, so the select-list-only rule applies (SQL's own)
             return _apply_order_limit(SqlResult(cols), post_sort, limit)
         if post_sort:
-            return _apply_order_limit(SqlResult(cols), post_sort, limit)
+            # multi-key sort may reference UNSELECTED schema columns — the
+            # keys come from the materialized table, the perm applies to
+            # the projected output; successive stable sorts, least-
+            # significant key first, give lexicographic order
+            from geomesa_tpu.store.reduce import stable_order
+
+            n_rows = len(next(iter(cols.values()))) if cols else 0
+            perm = np.arange(n_rows)
+            for f, desc in reversed(post_sort):
+                if f in cols:
+                    keys = np.asarray(cols[f])
+                elif f in r.table.columns:
+                    keys = np.asarray(r.table.columns[f].values)
+                else:
+                    raise SqlError(f"ORDER BY {f!r}: unknown column")
+                perm = perm[stable_order(keys[perm], desc)]
+            cols = {k: np.asarray(v)[perm] for k, v in cols.items()}
+            if limit is not None:
+                cols = {k: v[:limit] for k, v in cols.items()}
         return SqlResult(cols)
 
     # aggregate path: scan (with pushdown filter), then vectorized fold

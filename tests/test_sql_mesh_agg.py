@@ -347,6 +347,18 @@ class TestDistinctAndMultiOrder:
         assert got == [tuple(r) for r in sql(host, q).rows()]
         assert len(got) == 20
 
+    def test_multi_key_order_on_unselected_column(self):
+        """Multi-key sort keys may be schema columns outside the select
+        list — they feed the sort, never the output."""
+        tpu = _mk("tpu")
+        host = _mk("oracle")
+        q = ("SELECT name FROM ev WHERE BBOX(geom, -20, -20, 20, 20) "
+             "ORDER BY cnt DESC, val ASC LIMIT 15")
+        got = sql(tpu, q)
+        assert list(got.columns) == ["name"]  # sort keys not in output
+        assert [tuple(r) for r in got.rows()] \
+            == [tuple(r) for r in sql(host, q).rows()]
+
 
 class TestExtendedGeometryAggregation:
     def _mk(self, backend):
