@@ -528,3 +528,43 @@ class TestRound3DevicePaths:
         m = (x >= 0) & (x <= 800_000)
         want = np.bincount(gid[m], minlength=G)
         np.testing.assert_array_equal(np.asarray(mxu[0])[0], want)
+
+    def test_wms_tile_on_hardware(self, rng):
+        """A WMS GetMap heatmap tile served off the real chip: the density
+        grid rides the fused device path and the tile's hot pixels match
+        the exact numpy mask."""
+        import io
+
+        from PIL import Image
+
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.store.datastore import DataStore
+        from geomesa_tpu.web.wms import handle_wms
+
+        n = 200_000
+        lon = rng.uniform(-170, 170, n)
+        lat = rng.uniform(-80, 80, n)
+        ds = DataStore(backend="tpu")
+        ds.create_schema("w", "name:String,*geom:Point")
+        ds.write("w", [
+            {"name": str(i), "geom": Point(float(lon[i]), float(lat[i]))}
+            for i in range(n)
+        ], fids=[str(i) for i in range(n)])
+        ds.compact("w")
+        status, body, ctype = handle_wms(ds, {
+            "service": "WMS", "request": "GetMap", "layers": "w",
+            "crs": "CRS:84", "bbox": "-60,-40,60,40",
+            "width": "64", "height": "64",
+        })
+        assert status == 200 and ctype == "image/png"
+        img = np.asarray(Image.open(io.BytesIO(body)).convert("RGBA"))
+        grids = ds.density_many(
+            "w", [None], (-60.0, -40.0, 60.0, 40.0),
+            width=64, height=64, loose=False,
+        )
+        grid = np.asarray(grids[0])
+        want = int(((lon >= -60) & (lon <= 60)
+                    & (lat >= -40) & (lat <= 40)).sum())
+        assert float(grid.sum()) == want  # exact mass on hardware
+        assert ((img[..., 3] > 0) == (grid[::-1] > 0)).all()
+        assert ds.metrics.counter("store.query.device_failovers").count == 0
