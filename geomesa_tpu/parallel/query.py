@@ -1057,13 +1057,22 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
         cnt, first, vcnt, vsum, vmin, vmax, pos, hits = jax.lax.map(
             one, (boxes, times)
         )
+        # min/max merges are identities on an unsharded data axis — skip the
+        # collective there: a 1-member all-reduce is pure overhead, and the
+        # single-chip relay compiler accepts only Sum all-reduces (psum),
+        # rejecting the min/max lowering outright
+        one_shard = mesh.shape[DATA_AXIS] == 1
+        pmin_ = (lambda v: v) if one_shard else partial(
+            jax.lax.pmin, axis_name=DATA_AXIS)
+        pmax_ = (lambda v: v) if one_shard else partial(
+            jax.lax.pmax, axis_name=DATA_AXIS)
         return (
             jax.lax.psum(cnt, DATA_AXIS),
-            jax.lax.pmin(first, DATA_AXIS),
+            pmin_(first),
             jax.lax.psum(vcnt, DATA_AXIS),
             jax.lax.psum(vsum, DATA_AXIS),
-            jax.lax.pmin(vmin, DATA_AXIS),
-            jax.lax.pmax(vmax, DATA_AXIS),
+            pmin_(vmin),
+            pmax_(vmax),
             pos[:, None, :],
             hits[:, None],
         )

@@ -89,10 +89,10 @@ class TestCompiledScanKernel:
             boxes_np.append([x1, x2, y1, y2])
             times_np.append([b1, o1, b2, o2])
         boxes = np.stack(
-            [pack_boxes(np.array([b], np.int32))[0] for b in boxes_np]
+            [pack_boxes(np.array([b], np.int32)) for b in boxes_np]
         )
         times = np.stack(
-            [pack_times(np.array([t], np.int32))[0] for t in times_np]
+            [pack_times(np.array([t], np.int32)) for t in times_np]
         )
         counts = np.asarray(
             batched_count(
