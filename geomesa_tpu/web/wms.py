@@ -1,5 +1,5 @@
-"""OGC WMS 1.3.0 KVP endpoints: GetCapabilities + GetMap (the map-tile
-rendering surface).
+"""OGC WMS 1.3.0 KVP endpoints: GetCapabilities + GetMap + GetFeatureInfo
+(the map-tile rendering + identify surface).
 
 Role parity: the reference serves heatmaps and styled features to map
 clients through GeoServer WMS (``geomesa-accumulo-gs-plugin/``; the density
@@ -68,6 +68,8 @@ def handle_wms(store, params: dict, auths=None):
         return 200, _capabilities(store), "text/xml"
     if request == "getmap":
         return 200, _get_map(store, p, auths), "image/png"
+    if request == "getfeatureinfo":
+        return _get_feature_info(store, p, auths)
     raise WmsError("OperationNotSupported",
                    f"unsupported request {p.get('request')!r}")
 
@@ -95,7 +97,9 @@ def _capabilities(store) -> str:
         "</Service><Capability>"
         "<Request><GetCapabilities><Format>text/xml</Format>"
         "</GetCapabilities>"
-        "<GetMap><Format>image/png</Format></GetMap></Request>"
+        "<GetMap><Format>image/png</Format></GetMap>"
+        "<GetFeatureInfo><Format>application/json</Format>"
+        "<Format>text/plain</Format></GetFeatureInfo></Request>"
         f"<Layer><Title>geomesa_tpu</Title>{''.join(layers)}</Layer>"
         "</Capability></WMS_Capabilities>"
     )
@@ -135,6 +139,59 @@ def _parse_bbox(p: dict) -> tuple[tuple[float, float, float, float], str]:
     if not (xmin < xmax and ymin < ymax):
         raise WmsError("InvalidParameterValue", "degenerate BBOX")
     return (float(xmin), float(ymin), float(xmax), float(ymax)), crs
+
+
+def _parse_dims(p: dict) -> tuple[int, int]:
+    try:
+        width = int(p.get("width", "256"))
+        height = int(p.get("height", "256"))
+    except ValueError:
+        raise WmsError("InvalidParameterValue", "bad WIDTH/HEIGHT") from None
+    if not (1 <= width <= MAX_DIM and 1 <= height <= MAX_DIM):
+        raise WmsError("InvalidParameterValue",
+                       f"WIDTH/HEIGHT must be in [1, {MAX_DIM}]")
+    return width, height
+
+
+def _merc_y(lat):
+    """Latitude (deg) → unscaled web-mercator y."""
+    return np.log(np.tan(np.pi / 4 + np.radians(lat) / 2))
+
+
+def _merc_bounds(bbox) -> tuple[float, float]:
+    """Tile bbox → (lo, hi) mercator-y row bounds, web-mercator clamped."""
+    _, ymin, _, ymax = bbox
+    return _merc_y(max(ymin, -85.06)), _merc_y(min(ymax, 85.06))
+
+
+def _pixel_lonlat(i: float, j: float, width: int, height: int, bbox,
+                  crs: str) -> tuple[float, float]:
+    """Map image coordinates (i right, j DOWN from the top-left corner,
+    pixel centers at +0.5) to lon/lat, inverting GetMap's rendering: row 0
+    of the PNG is the NORTH edge, and 3857 tiles have rows linear in
+    web-mercator y (``_mercator_resample``)."""
+    xmin, ymin, xmax, ymax = bbox
+    lon = xmin + (i + 0.5) / width * (xmax - xmin)
+    if crs == "EPSG:3857":
+        lo, hi = _merc_bounds(bbox)
+        merc = lo + (height - j - 0.5) / height * (hi - lo)
+        lat = float(np.degrees(2 * np.arctan(np.exp(merc)) - np.pi / 2))
+    else:
+        lat = ymax - (j + 0.5) / height * (ymax - ymin)
+    return lon, lat
+
+
+def _resolve_layer(store, p: dict, key: str):
+    """LAYERS/QUERY_LAYERS → (name, schema); exactly one layer required."""
+    layers = [s for s in (p.get(key) or p.get("layers") or "").split(",") if s]
+    if len(layers) != 1:
+        raise WmsError("LayerNotDefined",
+                       f"exactly one {key.upper()} entry required")
+    name = layers[0]
+    try:
+        return name, store.get_schema(name)
+    except KeyError:
+        raise WmsError("LayerNotDefined", f"no such layer {name!r}") from None
 
 
 def _time_filter(sft, raw: str | None):
@@ -214,8 +271,7 @@ def _mercator_resample(grid: np.ndarray, bbox) -> np.ndarray:
     EPSG:3857 tiles align with basemaps. Nearest-row at tile resolution."""
     h = grid.shape[0]
     _, ymin, _, ymax = bbox
-    my = lambda lat: np.log(np.tan(np.pi / 4 + np.radians(lat) / 2))  # noqa: E731
-    lo, hi = my(max(ymin, -85.06)), my(min(ymax, 85.06))
+    lo, hi = _merc_bounds(bbox)
     # output row centers (linear in mercator y) → source latitude → row
     centers = lo + (np.arange(h) + 0.5) / h * (hi - lo)
     lats = np.degrees(2 * np.arctan(np.exp(centers)) - np.pi / 2)
@@ -257,25 +313,11 @@ def _render_points(store, name, sft, cql, bbox, width, height,
 
 
 def _get_map(store, p: dict, auths=None) -> bytes:
-    layers = [s for s in (p.get("layers") or "").split(",") if s]
-    if len(layers) != 1:
-        raise WmsError("LayerNotDefined", "exactly one LAYERS entry required")
-    name = layers[0]
-    try:
-        sft = store.get_schema(name)
-    except KeyError:
-        raise WmsError("LayerNotDefined", f"no such layer {name!r}") from None
+    name, sft = _resolve_layer(store, p, "layers")
     fmt = (p.get("format") or "image/png").lower()
     if fmt != "image/png":
         raise WmsError("InvalidFormat", f"unsupported FORMAT {fmt!r}")
-    try:
-        width = int(p.get("width", "256"))
-        height = int(p.get("height", "256"))
-    except ValueError:
-        raise WmsError("InvalidParameterValue", "bad WIDTH/HEIGHT") from None
-    if not (1 <= width <= MAX_DIM and 1 <= height <= MAX_DIM):
-        raise WmsError("InvalidParameterValue",
-                       f"WIDTH/HEIGHT must be in [1, {MAX_DIM}]")
+    width, height = _parse_dims(p)
     bbox, crs = _parse_bbox(p)
     transparent = (p.get("transparent", "true").lower() != "false")
     style = (p.get("styles") or "heat").strip().lower() or "heat"
@@ -305,6 +347,75 @@ def _get_map(store, p: dict, auths=None) -> bytes:
     # density grids have row 0 at the SOUTH edge; PNG row 0 is the top
     rgba = rgba[::-1]
     return _encode_png(rgba)
+
+
+def _get_feature_info(store, p: dict, auths=None):
+    """WMS 1.3.0 GetFeatureInfo: the features under a clicked map pixel
+    (the GeoServer identify surface the reference serves through its WMS
+    layer). Takes the GetMap tile geometry plus I/J pixel coordinates
+    (X/Y under the 1.1.x binding), a BUFFER pixel tolerance, and
+    FEATURE_COUNT; returns GeoJSON (``INFO_FORMAT=application/json``) or a
+    plain-text listing (default, matching the WMS spec default)."""
+    from geomesa_tpu.filter.cql import parse as parse_cql
+
+    name, sft = _resolve_layer(store, p, "query_layers")
+    width, height = _parse_dims(p)
+    bbox, crs = _parse_bbox(p)
+    raw_i = p.get("i", p.get("x"))
+    raw_j = p.get("j", p.get("y"))
+    if raw_i is None or raw_j is None:
+        raise WmsError("MissingParameterValue",
+                       "I/J pixel coordinates are required")
+    try:
+        i, j = int(raw_i), int(raw_j)
+    except ValueError:
+        raise WmsError("InvalidPoint", f"bad I/J {raw_i!r}/{raw_j!r}") from None
+    if not (0 <= i < width and 0 <= j < height):
+        raise WmsError("InvalidPoint",
+                       f"I/J ({i}, {j}) outside the {width}x{height} map")
+    try:
+        count = max(1, int(p.get("feature_count", "1")))
+        buf_px = max(0, int(p.get("buffer", "3")))
+    except ValueError:
+        raise WmsError("InvalidParameterValue",
+                       "bad FEATURE_COUNT/BUFFER") from None
+
+    # the search window is the clicked pixel dilated by BUFFER pixels,
+    # mapped through the same pixel->geography transform GetMap renders
+    # with (so a click on a drawn point finds that point, 4326 or 3857)
+    x1, ylo = _pixel_lonlat(i - buf_px - 0.5, j + buf_px + 0.5,
+                            width, height, bbox, crs)
+    x2, yhi = _pixel_lonlat(i + buf_px + 0.5, j - buf_px - 0.5,
+                            width, height, bbox, crs)
+    cql = _cql_for(sft, p)
+    window = (f"BBOX({sft.geom_field}, {min(x1, x2)}, {min(ylo, yhi)}, "
+              f"{max(x1, x2)}, {max(ylo, yhi)})")
+    full = f"{window} AND ({cql})" if cql else window
+    r = store.query(name, Query(filter=parse_cql(full), limit=count,
+                                auths=auths))
+
+    fmt = (p.get("info_format") or "text/plain").lower()
+    if "json" in fmt:
+        import json
+
+        from geomesa_tpu.web.formats import format_table
+
+        payload, _ = format_table(r.table, "geojson")
+        # echo the REQUESTED format as the content type (a client that
+        # validates the response MIME against its INFO_FORMAT must match)
+        return 200, json.dumps(payload), p.get("info_format")
+    if fmt not in ("text/plain", "text"):
+        raise WmsError("InvalidFormat",
+                       f"unsupported INFO_FORMAT {p.get('info_format')!r} "
+                       "(supported: application/json, text/plain)")
+    lines = [f"GetFeatureInfo {name} ({len(r.table)} feature(s))"]
+    attrs = [a.name for a in sft.attributes]
+    for k in range(len(r.table)):
+        rec = r.table.record(k)
+        lines.append(f"fid = {r.table.fids[k]}")
+        for a in attrs:
+            lines.append(f"  {a} = {rec.get(a)}")
+    return 200, "\n".join(lines) + "\n", "text/plain"
 
 
 def _encode_png(rgba: np.ndarray) -> bytes:

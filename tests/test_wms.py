@@ -246,3 +246,154 @@ class TestOverHttp:
                 assert "ServiceException" in root[0].tag
         finally:
             httpd.shutdown()
+
+
+class TestGetFeatureInfo:
+    """GetFeatureInfo: the identify surface — features under a clicked
+    pixel, honoring the exact pixel->geography transform GetMap renders
+    with (4326 lat/lon order, 3857 mercator rows) plus BUFFER,
+    FEATURE_COUNT, CQL_FILTER, and both INFO_FORMATs."""
+
+    def _pixel_of(self, lon, lat, bbox, w, h):
+        xmin, ymin, xmax, ymax = bbox
+        i = int((lon - xmin) / (xmax - xmin) * w)
+        j = int((ymax - lat) / (ymax - ymin) * h)
+        return i, j
+
+    def test_json_identify_hits_known_point(self, ds):
+        import json
+
+        lon, lat = ds._lonlat
+        # target the first feature; world tile in CRS:84 (lon/lat order)
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+        w = h = 512
+        i, j = self._pixel_of(lon[0], lat[0], bbox, w, h)
+        status, body, ctype = handle_wms(ds, {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "CRS:84",
+            "bbox": "-180,-90,180,90", "width": str(w), "height": str(h),
+            "i": str(i), "j": str(j), "buffer": "2", "feature_count": "50",
+            "info_format": "application/json",
+        })
+        assert status == 200 and "json" in ctype
+        fc = json.loads(body)
+        assert fc["type"] == "FeatureCollection"
+        fids = {f["id"] for f in fc["features"]}
+        assert "0" in fids
+        # every returned feature really is within the +-(buffer+1) pixel
+        # window of the click
+        dx = (2 + 1) / w * 360.0
+        dy = (2 + 1) / h * 180.0
+        for f in fc["features"]:
+            fx, fy = f["geometry"]["coordinates"]
+            assert abs(fx - lon[0]) <= dx * 1.5 + 360.0 / w
+            assert abs(fy - lat[0]) <= dy * 1.5 + 180.0 / h
+
+    def test_latlon_axis_order_130(self, ds):
+        import json
+
+        lon, lat = ds._lonlat
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+        w = h = 512
+        i, j = self._pixel_of(lon[0], lat[0], bbox, w, h)
+        # WMS 1.3.0 EPSG:4326: BBOX in lat,lon order — same click, same hit
+        status, body, _ = handle_wms(ds, {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "EPSG:4326",
+            "bbox": "-90,-180,90,180", "width": str(w), "height": str(h),
+            "i": str(i), "j": str(j), "buffer": "2", "feature_count": "50",
+            "info_format": "application/json",
+        })
+        fids = {f["id"] for f in json.loads(body)["features"]}
+        assert "0" in fids
+
+    def test_3857_identify(self, ds):
+        import json
+
+        import numpy as np
+
+        lon, lat = ds._lonlat
+        # mercator world tile: pixel row from the mercator transform
+        w = h = 512
+        R = 6378137.0
+        mx = lambda d: np.radians(d) * R  # noqa: E731
+        my = lambda d: R * np.log(np.tan(np.pi / 4 + np.radians(d) / 2))  # noqa: E731
+        xmin, xmax = mx(-180), mx(180)
+        ymin, ymax = my(-85.0), my(85.0)
+        i = int((mx(lon[0]) - xmin) / (xmax - xmin) * w)
+        j = int((ymax - my(lat[0])) / (ymax - ymin) * h)
+        status, body, _ = handle_wms(ds, {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "EPSG:3857",
+            "bbox": f"{xmin},{ymin},{xmax},{ymax}",
+            "width": str(w), "height": str(h),
+            "i": str(i), "j": str(j), "buffer": "2", "feature_count": "50",
+            "info_format": "application/json",
+        })
+        fids = {f["id"] for f in json.loads(body)["features"]}
+        assert "0" in fids
+
+    def test_text_plain_default_and_feature_count(self, ds):
+        lon, lat = ds._lonlat
+        bbox = (-180.0, -90.0, 180.0, 90.0)
+        i, j = self._pixel_of(lon[0], lat[0], bbox, 512, 512)
+        status, body, ctype = handle_wms(ds, {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "CRS:84",
+            "bbox": "-180,-90,180,90", "width": "512", "height": "512",
+            "i": str(i), "j": str(j), "buffer": "4",
+        })
+        assert ctype == "text/plain"
+        assert "fid = " in body and "name = " in body
+        # FEATURE_COUNT defaults to 1: at most one feature listed
+        assert body.count("fid = ") == 1
+
+    def test_empty_window(self, ds):
+        import json
+
+        # south-west quadrant holds no points (fixture is NE-only)
+        status, body, _ = handle_wms(ds, {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "CRS:84",
+            "bbox": "-180,-90,180,90", "width": "256", "height": "256",
+            "i": "10", "j": "250", "info_format": "application/json",
+        })
+        assert json.loads(body)["features"] == []
+
+    def test_cql_filter_applies(self, ds):
+        import json
+
+        lon, lat = ds._lonlat
+        i, j = self._pixel_of(lon[0], lat[0],
+                              (-180.0, -90.0, 180.0, 90.0), 512, 512)
+        base = {
+            "service": "WMS", "request": "GetFeatureInfo",
+            "query_layers": "pts", "crs": "CRS:84",
+            "bbox": "-180,-90,180,90", "width": "512", "height": "512",
+            "i": str(i), "j": str(j), "buffer": "3", "feature_count": "50",
+            "info_format": "application/json",
+        }
+        _, body, _ = handle_wms(ds, {**base, "cql_filter": "name = 'p0'"})
+        fids = {f["id"] for f in json.loads(body)["features"]}
+        assert fids == {"0"}
+        _, body, _ = handle_wms(
+            ds, {**base, "cql_filter": "name = 'no-such'"})
+        assert json.loads(body)["features"] == []
+
+    def test_errors(self, ds):
+        base = {"service": "WMS", "request": "GetFeatureInfo",
+                "query_layers": "pts", "crs": "CRS:84",
+                "bbox": "-180,-90,180,90", "width": "64", "height": "64"}
+        with pytest.raises(WmsError, match="I/J") as ei:
+            handle_wms(ds, dict(base))
+        assert ei.value.code == "MissingParameterValue"
+        with pytest.raises(WmsError, match="outside") as ei:
+            handle_wms(ds, {**base, "i": "64", "j": "0"})
+        assert ei.value.code == "InvalidPoint"
+        with pytest.raises(WmsError, match="INFO_FORMAT"):
+            handle_wms(ds, {**base, "i": "1", "j": "1",
+                            "info_format": "text/html"})
+        with pytest.raises(WmsError, match="QUERY_LAYERS"):
+            handle_wms(ds, {**{k: v for k, v in base.items()
+                               if k != "query_layers"},
+                            "i": "1", "j": "1"})
