@@ -11,14 +11,15 @@ Supported grammar:
 
     SELECT <item, ...> FROM <type>
       [WHERE <predicates>] [GROUP BY <col, ...>]
-      [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
+      [ORDER BY <col> [ASC|DESC]] [LIMIT <n>] [OFFSET <k>]
 
     SELECT <alias.col|alias.*, ...> FROM <t1> <a> JOIN <t2> <b>
       ON ST_Within|ST_Contains|ST_Intersects(<alias.geom>, <alias.geom>)
       [WHERE <left-alias predicates>] [LIMIT <n>]
 
     item      := * | col | agg | fn(col) [AS alias]
-    agg       := COUNT(*) | COUNT(col) | SUM/MIN/MAX/AVG(col)
+    agg       := COUNT(*) | COUNT(col) | COUNT(DISTINCT col)
+                 | SUM/MIN/MAX/AVG(col)
     fn        := ST_X | ST_Y | ST_AsText | ST_GeoHash  (per-row scalar UDFs)
     predicate := CQL comparisons/temporal ops, plus spark-jts spatial calls:
                  ST_Contains/ST_Within/ST_Intersects/ST_Disjoint(col, g),
@@ -72,7 +73,8 @@ _CLAUSES = re.compile(
     r"(?:\s+group\s+by\s+(?P<group>.+?))?"
     r"(?:\s+having\s+(?P<having>.+?))?"
     r"(?:\s+order\s+by\s+(?P<order>.+?))?"
-    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    r"(?:\s+limit\s+(?P<limit>\d+))?"
+    r"(?:\s+offset\s+(?P<offset>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
 _HAVING = re.compile(
@@ -218,6 +220,19 @@ def _parse_item(item: str) -> _Item:
         fn = call.group(1).lower()
         arg = call.group(2)
         if fn in _AGGS:
+            if re.match(r"^distinct\b", arg, re.IGNORECASE):
+                if fn != "count":
+                    raise SqlError(
+                        f"DISTINCT inside {fn.upper()}() is not supported")
+                dm = re.match(r"^distinct\s+(\w+)$", arg, re.IGNORECASE)
+                if not dm:
+                    raise SqlError(
+                        f"COUNT(DISTINCT ...) takes exactly one column: "
+                        f"{arg!r}")
+                return _Item(
+                    "agg", alias or f"count(distinct {dm.group(1)})",
+                    dm.group(1), "count_distinct",
+                )
             return _Item("agg", alias or f"{fn}({arg})", arg, fn)
         if fn in ("st_x", "st_y", "st_astext", "st_geohash"):
             return _Item("fn", alias or f"{fn}({arg})", arg, fn)
@@ -255,6 +270,19 @@ def _agg_value(fn: str, arg: str, table, idx: np.ndarray):
             return len(idx)
         col = table.columns[arg]
         return int(col.is_valid()[idx].sum())
+    if fn == "count_distinct":
+        col = table.columns[arg]
+        valid = col.is_valid()[idx]
+        if col.type.is_geometry:
+            # point layers keep values=None (x/y arrays); geometries()
+            # materializes either layout, dedup on the wkt-ish repr
+            geoms = col.geometries()[idx][valid]
+            return len({str(g) for g in geoms})
+        vals = col.values[idx][valid]
+        try:
+            return int(len(np.unique(vals)))
+        except TypeError:  # mixed/unorderable object values
+            return len({str(v) for v in vals})
     col = table.columns[arg]
     valid = col.is_valid()[idx]
     vals = col.values[idx][valid]
@@ -493,11 +521,12 @@ def _having_passes(hit, op, lit: float, v) -> bool:
         ) from None
 
 
-def _apply_order_limit(res: SqlResult, order, limit) -> SqlResult:
+def _apply_order_limit(res: SqlResult, order, limit, offset: int = 0) -> SqlResult:
     """``order`` is a list of (column, desc) pairs — multi-key sorts apply
     keys last-to-first with stable sorts (lexicographic order). Tie
     behavior is the store's (``store.reduce.stable_order``), so engine
-    paths are order-indistinguishable."""
+    paths are order-indistinguishable. OFFSET skips rows AFTER the sort
+    (SQL semantics), before LIMIT truncates."""
     from geomesa_tpu.store.reduce import stable_order
 
     cols = res.columns
@@ -508,8 +537,9 @@ def _apply_order_limit(res: SqlResult, order, limit) -> SqlResult:
             perm = stable_order(cols[col_name], desc)
             cols = {k: v[perm] for k, v in cols.items()}
         res = SqlResult(cols)
-    if limit is not None:
-        res = SqlResult({k: v[:limit] for k, v in res.columns.items()})
+    if offset or limit is not None:
+        end = None if limit is None else offset + limit
+        res = SqlResult({k: v[offset:end] for k, v in res.columns.items()})
     return res
 
 
@@ -527,7 +557,7 @@ def _mesh_agg_cast(sft, col: str, fn: str, v):
 
 
 def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
-                    order, limit):
+                    order, limit, offset: int = 0):
     """Route the aggregate fold to ``DataStore.aggregate_many`` (the fused
     mesh segment-reduce). Returns the assembled SqlResult, or None when the
     query cannot ride the device path — the caller's host fold serves it
@@ -613,7 +643,7 @@ def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
             cols[it.name] = np.array(
                 [_value(it, g) for g in idx], dtype=object
             )
-    return _apply_order_limit(SqlResult(cols), order, limit)
+    return _apply_order_limit(SqlResult(cols), order, limit, offset)
 
 
 def sql(ds, statement: str) -> SqlResult:
@@ -634,6 +664,7 @@ def sql(ds, statement: str) -> SqlResult:
     group_raw = _clause(m, statement, "group")
     group_by = [g.strip() for g in group_raw.split(",")] if group_raw else None
     limit = int(m.group("limit")) if m.group("limit") else None
+    offset = int(m.group("offset")) if m.group("offset") else 0
     order = None
     if m.group("order"):
         order = []
@@ -676,7 +707,7 @@ def sql(ds, statement: str) -> SqlResult:
         ):
             mesh_res = _mesh_aggregate(
                 ds, type_name, cql, items, [i.arg for i in items],
-                None, order, limit,
+                None, order, limit, offset,
             )
             if mesh_res is not None:
                 return mesh_res
@@ -707,7 +738,8 @@ def sql(ds, statement: str) -> SqlResult:
                         props.append(f)
         q = Query(
             filter=cql, properties=props, sort_by=push_sort,
-            limit=None if (distinct or post_sort) else limit,
+            limit=None if (distinct or post_sort or limit is None)
+            else limit + offset,
         )
         r = ds.query(type_name, q)
         cols: dict[str, np.ndarray] = {}
@@ -737,7 +769,8 @@ def sql(ds, statement: str) -> SqlResult:
             cols = {c: v[idx] for c, v in cols.items()}
             # DISTINCT collapses rows: ordering by an unselected column is
             # ill-defined, so the select-list-only rule applies (SQL's own)
-            return _apply_order_limit(SqlResult(cols), post_sort, limit)
+            return _apply_order_limit(
+                SqlResult(cols), post_sort, limit, offset)
         if post_sort:
             # multi-key sort may reference UNSELECTED schema columns — the
             # keys come from the materialized table, the perm applies to
@@ -756,9 +789,7 @@ def sql(ds, statement: str) -> SqlResult:
                     raise SqlError(f"ORDER BY {f!r}: unknown column")
                 perm = perm[stable_order(keys[perm], desc)]
             cols = {k: np.asarray(v)[perm] for k, v in cols.items()}
-            if limit is not None:
-                cols = {k: v[:limit] for k, v in cols.items()}
-        return SqlResult(cols)
+        return _apply_order_limit(SqlResult(cols), None, limit, offset)
 
     # aggregate path: scan (with pushdown filter), then vectorized fold
     for it in items:
@@ -782,8 +813,9 @@ def sql(ds, statement: str) -> SqlResult:
         counter = getattr(ds, "count_many", None)
         if counter is not None:
             n = counter(type_name, [Query(filter=cql)], loose=False)[0]
-            return SqlResult(
-                {items[0].name: np.array([n], dtype=object)}
+            return _apply_order_limit(
+                SqlResult({items[0].name: np.array([n], dtype=object)}),
+                None, limit, offset,
             )
 
     # distributed aggregation: the fused mesh segment-reduce serves pure
@@ -791,7 +823,7 @@ def sql(ds, statement: str) -> SqlResult:
     # without materializing rows; anything it declines falls through to the
     # host fold below (which also owns all validation errors)
     mesh_res = _mesh_aggregate(
-        ds, type_name, cql, items, group_by, having, order, limit
+        ds, type_name, cql, items, group_by, having, order, limit, offset
     )
     if mesh_res is not None:
         return mesh_res
@@ -806,7 +838,7 @@ def sql(ds, statement: str) -> SqlResult:
         }
         # same ORDER BY/LIMIT tail as the grouped and mesh paths — the two
         # engines must be indistinguishable result-wise
-        return _apply_order_limit(SqlResult(cols), order, limit)
+        return _apply_order_limit(SqlResult(cols), order, limit, offset)
 
     keys = [t.columns[g].values.astype(object) for g in group_by]
     combo = np.array(list(zip(*keys)), dtype=object)
@@ -845,4 +877,4 @@ def sql(ds, statement: str) -> SqlResult:
                 ],
                 dtype=object,
             )
-    return _apply_order_limit(SqlResult(cols), order, limit)
+    return _apply_order_limit(SqlResult(cols), order, limit, offset)

@@ -401,9 +401,9 @@ def _get_feature_info(store, p: dict, auths=None):
         from geomesa_tpu.web.formats import format_table
 
         payload, _ = format_table(r.table, "geojson")
-        # echo the REQUESTED format as the content type (a client that
-        # validates the response MIME against its INFO_FORMAT must match)
-        return 200, json.dumps(payload), p.get("info_format")
+        # canonical JSON MIME, never the raw request parameter (echoing an
+        # unvalidated value into a response header invites header injection)
+        return 200, json.dumps(payload), "application/json"
     if fmt not in ("text/plain", "text"):
         raise WmsError("InvalidFormat",
                        f"unsupported INFO_FORMAT {p.get('info_format')!r} "

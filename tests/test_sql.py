@@ -461,3 +461,83 @@ class TestDistinctHaving:
     def test_having_non_numeric_aggregate(self, ds):
         with pytest.raises(SqlError, match="not numeric"):
             sql(ds, "SELECT name FROM ev GROUP BY name HAVING MIN(name) > 0")
+
+
+class TestOffsetCountDistinct:
+    """LIMIT ... OFFSET paging and COUNT(DISTINCT col) — the Spark-SQL
+    surface tail (SURVEY.md §2.14)."""
+
+    def test_offset_pages_through_ordered_rows(self, ds):
+        full = sql(ds, "SELECT name, val FROM ev ORDER BY val DESC, name "
+                       "LIMIT 10")
+        page1 = sql(ds, "SELECT name, val FROM ev ORDER BY val DESC, name "
+                        "LIMIT 5")
+        page2 = sql(ds, "SELECT name, val FROM ev ORDER BY val DESC, name "
+                        "LIMIT 5 OFFSET 5")
+        assert page1.rows() + page2.rows() == full.rows()
+
+    def test_offset_without_order(self, ds):
+        full = sql(ds, "SELECT name FROM ev LIMIT 8")
+        tail = sql(ds, "SELECT name FROM ev LIMIT 5 OFFSET 3")
+        assert tail.rows() == full.rows()[3:8]
+
+    def test_offset_no_limit(self, ds):
+        full = sql(ds, "SELECT name FROM ev")
+        rest = sql(ds, "SELECT name FROM ev OFFSET 1990")
+        assert rest.rows() == full.rows()[1990:]
+        assert len(rest) == 10
+
+    def test_offset_past_end_is_empty(self, ds):
+        r = sql(ds, "SELECT name FROM ev LIMIT 5 OFFSET 100000")
+        assert len(r) == 0
+
+    def test_offset_on_group_by(self, ds):
+        full = sql(ds, "SELECT name, COUNT(*) AS n FROM ev GROUP BY name "
+                       "ORDER BY name")
+        page = sql(ds, "SELECT name, COUNT(*) AS n FROM ev GROUP BY name "
+                       "ORDER BY name LIMIT 2 OFFSET 2")
+        assert page.rows() == full.rows()[2:4]
+
+    def test_count_distinct(self, ds):
+        r = sql(ds, "SELECT COUNT(DISTINCT name) AS u FROM ev")
+        assert r.rows() == [(5,)]
+        r = sql(ds, "SELECT COUNT(DISTINCT val) AS u FROM ev")
+        assert r.rows() == [(100,)]
+
+    def test_count_distinct_grouped(self, ds):
+        r = sql(ds, "SELECT name, COUNT(DISTINCT val) AS u FROM ev "
+                    "GROUP BY name ORDER BY name")
+        # vals are i % 100 and names are c{i % 5}: each name sees exactly
+        # the 20 residues val % 100 with matching i % 5
+        assert [row[1] for row in r.rows()] == [20] * 5
+
+    def test_count_distinct_with_where(self, ds):
+        lon, lat = ds._lonlat
+        m = (lon >= 0) & (lon <= 60) & (lat >= -60) & (lat <= 60)
+        names = np.array([f"c{i % 5}" for i in range(len(lon))])
+        want = len(set(names[m]))
+        r = sql(ds, "SELECT COUNT(DISTINCT name) AS u FROM ev "
+                    "WHERE ST_Within(geom, 'POLYGON ((0 -60, 60 -60, "
+                    "60 60, 0 60, 0 -60))')")
+        assert r.rows() == [(want,)]
+
+    def test_distinct_inside_other_aggs_rejected(self, ds):
+        with pytest.raises(SqlError, match="DISTINCT inside SUM"):
+            sql(ds, "SELECT SUM(DISTINCT val) FROM ev")
+
+    def test_count_star_offset(self, ds):
+        # OFFSET past the single COUNT(*) row yields the empty set (SQL
+        # semantics: OFFSET applies to the RESULT rows)
+        assert len(sql(ds, "SELECT COUNT(*) FROM ev OFFSET 1")) == 0
+        assert len(sql(ds, "SELECT COUNT(*) FROM ev OFFSET 0")) == 1
+
+    def test_count_distinct_geometry(self, ds):
+        lon, _ = ds._lonlat
+        r = sql(ds, "SELECT COUNT(DISTINCT geom) AS u FROM ev")
+        assert r.rows() == [(len(lon),)]
+
+    def test_count_distinct_bad_forms(self, ds):
+        with pytest.raises(SqlError, match="exactly one column"):
+            sql(ds, "SELECT COUNT(DISTINCT *) FROM ev")
+        with pytest.raises(SqlError, match="exactly one column"):
+            sql(ds, "SELECT COUNT(DISTINCT name, val) FROM ev")
