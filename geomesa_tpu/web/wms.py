@@ -70,6 +70,8 @@ def handle_wms(store, params: dict, auths=None):
         return 200, _get_map(store, p, auths), "image/png"
     if request == "getfeatureinfo":
         return _get_feature_info(store, p, auths)
+    if request == "getlegendgraphic":
+        return 200, _legend_graphic(store, p), "image/png"
     raise WmsError("OperationNotSupported",
                    f"unsupported request {p.get('request')!r}")
 
@@ -99,7 +101,9 @@ def _capabilities(store) -> str:
         "</GetCapabilities>"
         "<GetMap><Format>image/png</Format></GetMap>"
         "<GetFeatureInfo><Format>application/json</Format>"
-        "<Format>text/plain</Format></GetFeatureInfo></Request>"
+        "<Format>text/plain</Format></GetFeatureInfo>"
+        "<GetLegendGraphic><Format>image/png</Format>"
+        "</GetLegendGraphic></Request>"
         f"<Layer><Title>geomesa_tpu</Title>{''.join(layers)}</Layer>"
         "</Capability></WMS_Capabilities>"
     )
@@ -141,10 +145,10 @@ def _parse_bbox(p: dict) -> tuple[tuple[float, float, float, float], str]:
     return (float(xmin), float(ymin), float(xmax), float(ymax)), crs
 
 
-def _parse_dims(p: dict) -> tuple[int, int]:
+def _parse_dims(p: dict, dw: str = "256", dh: str = "256") -> tuple[int, int]:
     try:
-        width = int(p.get("width", "256"))
-        height = int(p.get("height", "256"))
+        width = int(p.get("width", dw))
+        height = int(p.get("height", dh))
     except ValueError:
         raise WmsError("InvalidParameterValue", "bad WIDTH/HEIGHT") from None
     if not (1 <= width <= MAX_DIM and 1 <= height <= MAX_DIM):
@@ -416,6 +420,35 @@ def _get_feature_info(store, p: dict, auths=None):
         for a in attrs:
             lines.append(f"  {a} = {rec.get(a)}")
     return 200, "\n".join(lines) + "\n", "text/plain"
+
+
+def _legend_graphic(store, p: dict) -> bytes:
+    """WMS GetLegendGraphic (SLD-WMS extension): a PNG legend for the two
+    styles — the heat ramp as a vertical low→high gradient, or the point
+    swatch. Map clients fetch this next to GetMap to label layers."""
+    _resolve_layer(store, p, "layer")  # unknown layers error as elsewhere
+    style = (p.get("style") or p.get("styles") or "heat").strip().lower()
+    width, height = _parse_dims(p, dw="20", dh="128")
+    fmt = (p.get("format") or "image/png").lower()
+    if fmt != "image/png":
+        raise WmsError("InvalidFormat", f"unsupported FORMAT {fmt!r}")
+    if style in ("heat", "density", ""):
+        # one column of the ramp replicated across width, rendered through
+        # _colorize so the legend can never drift from the tile colors;
+        # values span (0, 1] — exact zero means "no data" and renders
+        # transparent, which would blank the legend's low end
+        ramp = _colorize(
+            np.linspace(0.0, 1.0, height + 1,
+                        dtype=np.float64)[1:][:, None], True
+        )
+        rgba = np.repeat(ramp, width, axis=1)
+        rgba = rgba[::-1]  # high values at the TOP of the legend
+    elif style == "points":
+        rgba = np.zeros((height, width, 4), dtype=np.uint8)
+        rgba[:] = (0x1f, 0x78, 0xb4, 255)  # the GetMap point color
+    else:
+        raise WmsError("StyleNotDefined", f"unknown STYLE {style!r}")
+    return _encode_png(rgba)
 
 
 def _encode_png(rgba: np.ndarray) -> bytes:

@@ -397,3 +397,53 @@ class TestGetFeatureInfo:
             handle_wms(ds, {**{k: v for k, v in base.items()
                                if k != "query_layers"},
                             "i": "1", "j": "1"})
+
+
+class TestGetLegendGraphic:
+    def test_heat_legend_gradient(self, ds):
+        status, body, ctype = handle_wms(ds, {
+            "service": "WMS", "request": "GetLegendGraphic",
+            "layer": "pts", "style": "heat",
+            "width": "20", "height": "64",
+        })
+        assert status == 200 and ctype == "image/png"
+        img = _png(body)
+        assert img.shape == (64, 20, 4)
+        # a vertical gradient: the top row is the ramp's hot end (red-ish),
+        # rows vary down the column, all columns identical
+        assert (img[:, 0] == img[:, -1]).all()
+        top, mid = img[0, 0], img[32, 0]
+        assert top[3] == 255 and (top[:3] != mid[:3]).any()
+        assert int(top[0]) > int(top[2]), "hot end should lean red"
+
+    def test_points_legend_swatch(self, ds):
+        _, body, _ = handle_wms(ds, {
+            "service": "WMS", "request": "GetLegendGraphic",
+            "layer": "pts", "style": "points",
+        })
+        img = _png(body)
+        assert (img[..., :3] == (0x1f, 0x78, 0xb4)).all()
+
+    def test_capabilities_advertises(self, ds):
+        _, body, _ = handle_wms(
+            ds, {"service": "WMS", "request": "GetCapabilities"})
+        assert "GetLegendGraphic" in body
+
+    def test_unknown_style(self, ds):
+        with pytest.raises(WmsError, match="unknown STYLE"):
+            handle_wms(ds, {"service": "WMS", "layer": "pts",
+                            "request": "GetLegendGraphic", "style": "nope"})
+
+    def test_unknown_layer_rejected(self, ds):
+        with pytest.raises(WmsError, match="no such layer") as ei:
+            handle_wms(ds, {"service": "WMS", "layer": "ghost",
+                            "request": "GetLegendGraphic"})
+        assert ei.value.code == "LayerNotDefined"
+
+    def test_one_pixel_legend_is_visible(self, ds):
+        _, body, _ = handle_wms(ds, {
+            "service": "WMS", "request": "GetLegendGraphic",
+            "layer": "pts", "style": "heat", "width": "1", "height": "1",
+        })
+        img = _png(body)
+        assert img.shape == (1, 1, 4) and img[0, 0, 3] == 255
