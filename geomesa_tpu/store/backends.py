@@ -30,6 +30,20 @@ from geomesa_tpu.schema.sft import FeatureType
 
 REFINE_PRECISION = 31  # device coords are 31-bit fixed point (Z2 resolution)
 JOIN_BLOCK = 4096  # block-sparse join granularity; shards pad to multiples
+# row-select one-pass threshold: total gather slots (shards x per-shard
+# capacity) below which the count pass is skipped and the gather runs
+# straight at the planner's candidate bound — one device dispatch instead
+# of two (each dispatch is a full host->device round trip; dominant over
+# the relay tunnel). 4M int32 slots = 16 MB of pos transfer worst-case.
+try:
+    _ONE_PASS_MAX_SLOTS = int(
+        os.environ.get("GEOMESA_SELECT_ONE_PASS_SLOTS", str(4 * 1024 * 1024))
+    )
+except ValueError as _e:
+    raise ValueError(
+        "GEOMESA_SELECT_ONE_PASS_SLOTS must be an integer slot count: "
+        f"{os.environ['GEOMESA_SELECT_ONE_PASS_SLOTS']!r}"
+    ) from _e
 
 
 class ExecutionBackend:
@@ -391,22 +405,34 @@ class TpuBackend(ExecutionBackend):
             col_args = (
                 c["xmin"], c["xmax"], c["ymin"], c["ymax"], c["bins"], c["offs"]
             )
-            count_step = cached_select_count_step_bbox(mesh)
         else:
             col_args = (c["x"], c["y"], c["bins"], c["offs"])
-            count_step = cached_select_count_step(mesh)
-        per_shard = np.asarray(
-            count_step(*col_args, d_idx, d_counts, d_boxes, d_times)
-        )
-        top = int(per_shard.max())
-        if top == 0:
-            return np.empty(0, dtype=np.int64)
-        capacity = pad_bucket(top, minimum=128)
-        if bbox_mode:
-            step = cached_select_gather_step_bbox(mesh, capacity)
+        gather = (cached_select_gather_step_bbox if bbox_mode
+                  else cached_select_gather_step)
+        # single-dispatch route: the count pass exists only to TIGHTEN the
+        # gather capacity (matches <= planner candidates), but each extra
+        # dispatch pays a full host->device round trip — ~77 ms over the
+        # relay tunnel vs the few ms the tighter transfer saves. When the
+        # planner's candidate bound is already small, gather straight at
+        # that bound; the two-pass stays for wide scans where an untamed
+        # capacity would dominate transfer and pos-buffer memory.
+        # compare the PADDED capacity (what the gather actually allocates
+        # and transfers), not the raw candidate bound
+        if n_shards * pad_bucket(mx, minimum=128) <= _ONE_PASS_MAX_SLOTS:
+            capacity = pad_bucket(mx, minimum=128)
         else:
-            step = cached_select_gather_step(mesh, capacity)
-        pos, hits = step(*col_args, d_idx, d_counts, d_boxes, d_times)
+            count_step = (cached_select_count_step_bbox if bbox_mode
+                          else cached_select_count_step)(mesh)
+            per_shard = np.asarray(
+                count_step(*col_args, d_idx, d_counts, d_boxes, d_times)
+            )
+            top = int(per_shard.max())
+            if top == 0:
+                return np.empty(0, dtype=np.int64)
+            capacity = pad_bucket(top, minimum=128)
+        pos, hits = gather(mesh, capacity)(
+            *col_args, d_idx, d_counts, d_boxes, d_times
+        )
         pos = np.asarray(pos)
         hits = np.asarray(hits)
         return np.concatenate(

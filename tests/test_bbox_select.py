@@ -223,3 +223,37 @@ class TestPersistenceRoundTrip:
         assert set(ds2.query("trk", q).table.fids) == set(
             oracle.query("trk", q).table.fids
         )
+
+
+class TestSelectDispatchRoutes:
+    """The row-select path has two device routes: one-pass (gather at the
+    planner's candidate bound — one dispatch) and two-pass (count first to
+    tighten capacity — wide scans). Both must yield identical row sets."""
+
+    def test_one_pass_and_two_pass_agree(self, monkeypatch):
+        import geomesa_tpu.store.backends as B
+        from geomesa_tpu.geometry.types import Point
+
+        rng = np.random.default_rng(19)
+        n = 60_000
+        lon = rng.uniform(-60, 60, n)
+        lat = rng.uniform(-45, 45, n)
+        t0 = 1_600_000_000_000
+        ds = DataStore(backend="tpu")
+        ds.create_schema("ev", "dtg:Date,*geom:Point")
+        ds.write("ev", [
+            {"dtg": t0 + int(i), "geom": Point(float(lon[i]), float(lat[i]))}
+            for i in range(n)
+        ], fids=[str(i) for i in range(n)])
+        ds.compact("ev")
+        q = "BBOX(geom, -20, -15, 30, 25)"
+        want = set(np.nonzero(
+            (lon >= -20) & (lon <= 30) & (lat >= -15) & (lat <= 25)
+        )[0].astype(str).tolist())
+
+        monkeypatch.setattr(B, "_ONE_PASS_MAX_SLOTS", 1 << 62)  # force 1-pass
+        one = set(ds.query("ev", q).table.fids.tolist())
+        monkeypatch.setattr(B, "_ONE_PASS_MAX_SLOTS", 0)  # force 2-pass
+        two = set(ds.query("ev", q).table.fids.tolist())
+        assert one == want and two == want
+        assert ds.metrics.counter("store.query.device_failovers").count == 0
