@@ -116,6 +116,31 @@ class Histogram(Stat):
         return float(max(est, 0.0))
 
 
+
+def _hash_basis(values) -> np.ndarray:
+    """uint64 per-value hash basis shared by the CMS and HLL sketches.
+
+    Numeric/bool/datetime arrays use their 64-bit patterns directly
+    (vectorized — the splitmix-style mixers downstream do the avalanche
+    work); object/string payloads fall back to the per-value Python hash.
+    Batch observe and single-value count both route through here, so the
+    basis stays internally consistent."""
+    a = np.asarray(values)
+    if a.dtype.kind in "iu":
+        return a.astype(np.int64, copy=False).view(np.uint64)
+    if a.dtype.kind == "f":
+        b = a.astype(np.float64, copy=False) + 0.0  # fold -0.0 into 0.0
+        return b.view(np.uint64)
+    if a.dtype.kind == "b":
+        return a.astype(np.uint64)
+    if a.dtype.kind == "M":
+        return a.astype("datetime64[ms]").astype(np.int64).view(np.uint64)
+    return np.array(
+        [np.uint64(hash(v) & 0xFFFFFFFFFFFFFFFF) for v in values],
+        dtype=np.uint64,
+    )
+
+
 @dataclass
 class Frequency(Stat):
     """Count-min sketch for per-value frequency (``Frequency.scala`` /
@@ -136,9 +161,7 @@ class Frequency(Stat):
 
     def _hashes(self, values) -> np.ndarray:
         """(depth, n) bucket indices via splitmix-style mixing."""
-        hv = np.array(
-            [np.uint64(hash(v) & 0xFFFFFFFFFFFFFFFF) for v in values], dtype=np.uint64
-        )
+        hv = _hash_basis(values)
         out = np.empty((self.depth, len(hv)), dtype=np.int64)
         for d in range(self.depth):
             x = hv * self._seeds[d]
@@ -154,6 +177,17 @@ class Frequency(Stat):
         h = self._hashes(values)
         for d in range(self.depth):
             np.add.at(self.table[d], h[d], 1)
+
+    def observe_weighted(self, values, counts):
+        """Observe pre-aggregated (unique value, count) pairs — the bulk
+        rebuild path folds each column through np.unique once and feeds
+        the weights here, replacing n per-value updates with u."""
+        if len(values) == 0:
+            return
+        h = self._hashes(values)
+        w = np.asarray(counts, dtype=np.int64)
+        for d in range(self.depth):
+            np.add.at(self.table[d], h[d], w)
 
     def count(self, value) -> int:
         h = self._hashes([value])
@@ -178,9 +212,7 @@ class Cardinality(Stat):
     def observe(self, values):
         if len(values) == 0:
             return
-        hv = np.array(
-            [np.uint64(hash(v) & 0xFFFFFFFFFFFFFFFF) for v in values], dtype=np.uint64
-        )
+        hv = _hash_basis(values)
         x = hv * np.uint64(0x9E3779B97F4A7C15)
         x ^= x >> np.uint64(29)
         x *= np.uint64(0xBF58476D1CE4E5B9)
@@ -227,6 +259,23 @@ class TopK(Stat):
     def observe(self, values):
         for v in values:
             self.counts[v] = self.counts.get(v, 0) + 1
+        if len(self.counts) > self.capacity * 10:
+            keep = sorted(self.counts.items(), key=lambda kv: -kv[1])[: self.capacity * 2]
+            self.counts = dict(keep)
+
+    def observe_weighted(self, values, counts):
+        """Pre-aggregated (unique value, count) pairs. Only the heaviest
+        ``capacity * 10`` uniques can survive pruning, so the top slice is
+        selected vectorized and the Python loop shrinks to that slice —
+        EXACT for a whole-snapshot rebuild (every duplicate is already
+        folded into its count)."""
+        counts = np.asarray(counts)
+        if len(values) > self.capacity * 10:
+            top = np.argpartition(counts, -self.capacity * 10)[-self.capacity * 10:]
+            values = np.asarray(values, dtype=object)[top]
+            counts = counts[top]
+        for v, c in zip(values, counts):
+            self.counts[v] = self.counts.get(v, 0) + int(c)
         if len(self.counts) > self.capacity * 10:
             keep = sorted(self.counts.items(), key=lambda kv: -kv[1])[: self.capacity * 2]
             self.counts = dict(keep)

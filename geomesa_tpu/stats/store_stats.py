@@ -74,9 +74,23 @@ class StoreStats:
                 st.descriptive.observe(v)
             else:
                 st.minmax.observe(vals) if len(vals) else None
-            st.frequency.observe(vals)
-            st.topk.observe(vals)
-            st.cardinality.observe(vals)
+            # fold the column through np.unique ONCE: the CMS takes the
+            # (value, count) pairs weighted, TopK only needs the heavy
+            # slice, and the HLL only distinct values — per-value update
+            # loops collapse to the unique count (bulk rebuilds were
+            # spending ~20 s at 5M rows in exactly these three observes)
+            try:
+                u, c = np.unique(vals, return_counts=True)
+            except TypeError:  # unorderable mixed objects
+                u = c = None
+            if u is not None:
+                st.frequency.observe_weighted(u, c)
+                st.topk.observe_weighted(u, c)
+                st.cardinality.observe(u)
+            else:
+                st.frequency.observe(vals)
+                st.topk.observe(vals)
+                st.cardinality.observe(vals)
             self.attrs[a.name] = st
         if z3_index is not None and z3_index.n and z3_index.zs is not None:
             self.z3hist = Z3Histogram()
@@ -109,10 +123,26 @@ class StoreStats:
         st = self.attrs.get(name)
         if st is None:
             return float(self.count)
+        try:
+            a_type = self.sft.attr(name).type
+        except KeyError:
+            a_type = None
         est = 0.0
         for lo, hi, li, ri in bounds:
             if lo is not None and lo == hi:
-                est += st.frequency.count(lo)
+                # coerce the CQL literal to the column's value type first:
+                # the CMS hash basis is dtype-keyed (int 5 and float 5.0
+                # hash differently), and the observed values carry the
+                # column dtype — both literal directions need mapping
+                q = lo
+                if a_type is not None and a_type.is_numeric:
+                    if isinstance(q, int) and not isinstance(q, bool) \
+                            and a_type.value in ("Double", "Float"):
+                        q = float(q)
+                    elif isinstance(q, float) and q.is_integer() \
+                            and a_type.value in ("Integer", "Long"):
+                        q = int(q)
+                est += st.frequency.count(q)
             elif st.histogram is not None:
                 flo = float(st.histogram.lo if lo is None else lo)
                 fhi = float(st.histogram.hi if hi is None else hi)

@@ -614,10 +614,19 @@ class DataStore:
         """
         if self.backend.name != "tpu" or not self._device_available():
             return None
+        env_thresh = os.environ.get("GEOMESA_DEVICE_SORT_MIN_ROWS")
+        if env_thresh is None:
+            import jax
+
+            if jax.default_backend() != "tpu":
+                # without an explicit opt-in the mesh sample sort only pays
+                # on a real accelerator: on the CPU test mesh its
+                # all_to_all materializations cost ~20x the native host
+                # radix sort (the env knob stays an opt-in anywhere — the
+                # sharding tests set it to exercise the device path)
+                return None
         threshold = int(
-            os.environ.get(
-                "GEOMESA_DEVICE_SORT_MIN_ROWS", self.DEVICE_SORT_MIN_ROWS
-            )
+            env_thresh if env_thresh is not None else self.DEVICE_SORT_MIN_ROWS
         )
         if n_rows < max(threshold, 1):
             return None
