@@ -141,14 +141,26 @@ class TestCompiledMeshPath:
         oracle = DataStore(backend="oracle")
         oracle.create_schema(sft)
         oracle.write("evt", table)
-        for q in (
-            "BBOX(geom, -60, -40, 60, 40)",
-            "BBOX(geom, 10, 10, 20, 20) AND dtg DURING "
-            "2020-09-13T12:00:00Z/2020-09-16T00:00:00Z",
-        ):
-            got = set(tpu.query("evt", q).table.fids)
-            want = set(oracle.query("evt", q).table.fids)
-            assert got == want, f"{q}: {len(got ^ want)} rows differ"
+        # witness BOTH select dispatch routes on hardware: the one-pass
+        # gather (forced via a huge threshold) and the two-pass
+        # count->gather (threshold 0) — both must match the oracle
+        import geomesa_tpu.store.backends as _B
+
+        saved_slots = _B._ONE_PASS_MAX_SLOTS
+        try:
+            for route_slots in (1 << 62, 0):
+                _B._ONE_PASS_MAX_SLOTS = route_slots
+                for q in (
+                    "BBOX(geom, -60, -40, 60, 40)",
+                    "BBOX(geom, 10, 10, 20, 20) AND dtg DURING "
+                    "2020-09-13T12:00:00Z/2020-09-16T00:00:00Z",
+                ):
+                    got = set(tpu.query("evt", q).table.fids)
+                    want = set(oracle.query("evt", q).table.fids)
+                    assert got == want, \
+                        f"{q} (slots={route_slots}): {len(got ^ want)} differ"
+        finally:
+            _B._ONE_PASS_MAX_SLOTS = saved_slots
         # no failover happened: the compiled path really served these
         assert tpu.metrics.counter("store.query.device_failovers").count == 0
 
