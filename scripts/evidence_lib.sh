@@ -1,0 +1,33 @@
+# Shared helpers for the real-chip evidence scripts. Source from a script
+# whose cwd is the repo root. Each step commits its artifact immediately so
+# a mid-run wedge cannot zero the evidence. SIGINT only — a SIGKILL
+# mid-RPC orphans the relay session claim and wedges the chip.
+
+step() {  # step <name> <timeout-s> <cmd...>
+  local name=$1 cap=$2; shift 2
+  echo "== $name =="
+  timeout --signal=INT --kill-after=30 "$cap" "$@" \
+    > "artifacts/${name}_${ts}.log" 2>&1
+  local rc=$?
+  echo "rc=$rc" >> "artifacts/${name}_${ts}.log"
+  # include files steps write OUTSIDE artifacts/ (device_validation appends
+  # TPU_VALIDATION.md) — the whole point is nothing stays uncommitted
+  git add "artifacts/${name}_${ts}."* TPU_VALIDATION.md 2>/dev/null
+  git commit -q -m "Real-chip artifact: ${name} (${ts})
+
+No-Verification-Needed: generated hardware-run artifact" || true
+  return $rc
+}
+
+probe_step() {  # probe_step <name>: a real jitted compute, not enumeration
+  step "$1" 200 python -c "
+import jax, time, json
+t0=time.time()
+import jax.numpy as jnp
+v = jax.jit(lambda x: (x+1).sum())(jnp.arange(128))
+assert int(v.block_until_ready())==8256
+print(json.dumps({'backend': jax.default_backend(),
+                  'devices': jax.device_count(),
+                  'probe_s': round(time.time()-t0,1)}))
+"
+}

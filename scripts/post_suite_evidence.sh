@@ -4,50 +4,27 @@
 # hardware witness of the session's fixes:
 #   1. on-device suite (post-fix code) -> TPU_VALIDATION.md PASS block
 #   2. config 6 standalone (one-pass select route)
-#   3. configs 4 and 9 standalone if the sweep skipped them
-# Same commit-per-step discipline as the main suite. SIGINT only — never
-# SIGKILL a step mid-RPC (orphans the relay session claim).
+#   3. configs 4 and 9 standalone if the SWEEP left them without a value
+#      (standalone runs land in step logs only — BENCH_DETAIL.json is the
+#      sweep's record, so re-invoking this script re-runs them; acceptable)
 set -u
 cd "$(dirname "$0")/.."
 ts=$(date -u +%Y%m%dT%H%M%SZ)
 mkdir -p artifacts
+. scripts/evidence_lib.sh
 
-step() {  # step <name> <timeout-s> <cmd...>
-  local name=$1 cap=$2; shift 2
-  echo "== $name =="
-  timeout --signal=INT --kill-after=30 "$cap" "$@" \
-    > "artifacts/${name}_${ts}.log" 2>&1
-  local rc=$?
-  echo "rc=$rc" >> "artifacts/${name}_${ts}.log"
-  git add "artifacts/${name}_${ts}."* TPU_VALIDATION.md 2>/dev/null
-  git commit -q -m "Real-chip artifact: ${name} (${ts})
-
-No-Verification-Needed: generated hardware-run artifact" || true
-  return $rc
-}
-
-step probe_post 200 python -c "
-import jax, time, json
-t0=time.time()
-import jax.numpy as jnp
-v = jax.jit(lambda x: (x+1).sum())(jnp.arange(128))
-assert int(v.block_until_ready())==8256
-print(json.dumps({'backend': jax.default_backend(),
-                  'devices': jax.device_count(),
-                  'probe_s': round(time.time()-t0,1)}))
-" || { echo "tunnel not healthy; aborting"; exit 1; }
+probe_step probe_post || { echo "tunnel not healthy; aborting"; exit 1; }
 
 step device_validation_postfix 2400 python scripts/device_validation.py
 
 GEOMESA_BENCH_CONFIG=6 step bench_cfg6_onepass 1800 python bench.py
 
 for cfg in 4 9; do
-  if ! python3 - "$cfg" <<'PY'
+  if ! python - "$cfg" <<'PY'
 import json, sys
 d = json.load(open("BENCH_DETAIL.json"))
 c = d.get("configs", {}).get(sys.argv[1], {})
-ok = c.get("value") is not None
-sys.exit(0 if ok else 1)
+sys.exit(0 if c.get("value") is not None else 1)
 PY
   then
     GEOMESA_BENCH_CONFIG=$cfg step "bench_cfg${cfg}" 1800 python bench.py
