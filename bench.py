@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -97,14 +98,33 @@ def make_queries(q: int, seed: int = 7):
     return boxes_f64, windows_ms
 
 
-def _p50(fn, iters=ITERS):
-    fn()  # warmup (post-compile)
+def _p50(fn, iters=ITERS, budget_s=None, warmup=True):
+    """p50 over up to ``iters`` timed runs; with ``budget_s``, stop early once
+    the cumulative timed wall exceeds the budget (≥1 sample always kept, so a
+    slow config degrades to fewer samples instead of a step timeout). Pass
+    ``warmup=False`` when the caller just ran ``fn`` itself — the redundant
+    warmup would double a near-budget config's wall."""
+    t0 = time.perf_counter()
+    if warmup:
+        fn()  # post-compile warmup, counted against the budget
     lat_ms = []
     for _ in range(iters):
         s = time.perf_counter()
         fn()
         lat_ms.append((time.perf_counter() - s) * 1e3)
+        if budget_s is not None and time.perf_counter() - t0 > budget_s:
+            break
     return float(np.percentile(lat_ms, 50))
+
+
+def _mark(msg: str):
+    """Timestamped progress marker on stderr: a step timeout's log shows the
+    phase that consumed the budget instead of a bare rc=124."""
+    print(f"[bench +{time.perf_counter() - _MARK_T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_MARK_T0 = time.perf_counter()
 
 
 def _sharded_store(lon, lat, t_ms, period=PERIOD):
@@ -463,6 +483,7 @@ def bench_join():
         # scale burned ~2 min of a wedged round; cap it to seconds
         N = min(N, 500_000)
         K = min(K, 64)
+    _mark(f"join: synth {N} points, {K} polygons")
     lon, lat, _ = synth_gdelt(N)
     rng = np.random.default_rng(5)
     polys = []
@@ -476,6 +497,7 @@ def bench_join():
         polys.append(Polygon(ring))
 
     # build: z2 sort + block-aligned shard layout
+    _mark("join: build (z2 sort + shard transfer)")
     t_build = time.perf_counter()
     sfc = Z2SFC()
     z = sfc.index(lon, lat)
@@ -495,6 +517,7 @@ def bench_join():
     build_s = time.perf_counter() - t_build
 
     # host planning: per-polygon candidate blocks (the QueryPlanner role)
+    _mark(f"join: plan {K} polygons (build {build_s:.1f}s)")
     t_plan = time.perf_counter()
     buckets = pack_polygons_bucketed(polys)
     plans = []
@@ -519,11 +542,15 @@ def bench_join():
             )))
         return outs
 
+    _mark(f"join: first run ({len(plans)} vertex buckets, plan {plan_s:.1f}s)")
     outs = run()
     counts = np.zeros(K, dtype=np.int64)
     for (ids, *_), o in zip(plans, outs):
         counts[ids] = o
-    tpu_ms = _p50(lambda: run(), iters=max(3, ITERS // 4))
+    _mark("join: timed iterations")
+    tpu_ms = _p50(lambda: run(), iters=max(3, ITERS // 4), budget_s=300,
+                  warmup=False)  # the collect pass above already warmed it
+    _mark(f"join: timed done (p50 {tpu_ms:.0f} ms); cpu baseline")
     pairs_per_s = N * K / (tpu_ms / 1e3)           # effective (vs brute force)
     tested_per_s = pruned_pairs / (tpu_ms / 1e3)   # actually evaluated
 
@@ -544,6 +571,7 @@ def bench_join():
 
     # parity sampling: pruned counts == unpruned f32 device kernel on a
     # polygon subset over the FULL point set
+    _mark("join: parity (unpruned kernel, full point set)")
     n_par = min(K, 8)
     par_polys = [polys[i] for i in range(n_par)]
     vb, bb, _ = pack_polygons(par_polys, max_vertices=128)
@@ -1513,8 +1541,10 @@ def _write_detail(configs, backend, n_devices, notes) -> None:
         "configs": configs,
     }
     try:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_DETAIL.json")
+        # GEOMESA_BENCH_DETAIL redirects the record: CPU rehearsals must not
+        # clobber a committed real-chip BENCH_DETAIL.json
+        path = os.environ.get("GEOMESA_BENCH_DETAIL") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=2)

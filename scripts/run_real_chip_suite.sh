@@ -13,6 +13,9 @@
 # (a SIGKILL mid-RPC orphans the relay session claim and wedges the chip).
 set -u
 cd "$(dirname "$0")/.."
+# a leaked rehearsal redirect would make bench.py write its detail elsewhere
+# while line ~56 archives the stale ./BENCH_DETAIL.json as this run's evidence
+unset GEOMESA_BENCH_DETAIL
 ts=$(date -u +%Y%m%dT%H%M%SZ)
 mkdir -p artifacts
 
