@@ -438,6 +438,7 @@ def bench_knn_density():
         "detail": {
             "n_points": N, "devices": jax.device_count(),
             "knn_k": K, "knn_batch_points": n_knn,
+            "knn_impl": os.environ.get("GEOMESA_KNN_IMPL", "map"),
             "knn_batch_p50_ms": round(knn_batch_p50, 3),
             "knn_parity_f32": knn_parity,
             "cpu_knn_per_point_ms": round(cpu_knn_per_point, 3),

@@ -590,14 +590,18 @@ def _knn_valid_and_degrees(x, y, true_n, ttl):
 def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     """Per-shard candidate heaps shared by the gather and ring KNN steps.
 
-    Two implementations (``GEOMESA_KNN_IMPL``): ``map`` top-ks each query
+    Three implementations (``GEOMESA_KNN_IMPL``): ``map`` top-ks each query
     over the full column sequentially (peak memory O(N), fast on host
     backends where top_k is a cheap selection); ``scan`` streams row
     chunks through a running per-query top-k so the shard is read ONCE
     for ALL queries (the HBM-bound accelerator shape — the map form
-    re-reads the shard Q times). Default ``map`` until the scan form's
-    accelerator win is hardware-measured (CPU mesh: map 0.7 s vs scan
-    2.1 s per 64-query batch at 4M rows — host top_k favors map).
+    re-reads the shard Q times); ``blocked`` replaces the single
+    full-column top-k with per-block batched top-k + a survivor top-k
+    (hierarchical, still exact — targets the accelerator where one
+    10⁸-length ``lax.top_k`` is sort-shaped and serial). Default ``map``
+    until a variant's accelerator win is hardware-measured (CPU mesh:
+    map 0.7 s vs scan 2.1 s per 64-query batch at 4M rows — host top_k
+    favors map).
     The knob is read at TRACE time: set it before the first KNN call of
     the process (compiled steps are memoized per mesh/k).
 
@@ -607,8 +611,11 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     candidates (the AgeOffIterator-at-scan role on the KNN path).
 
     Returns (dists² (Ql, k) ascending, global rows (Ql, k) int32)."""
-    if os.environ.get("GEOMESA_KNN_IMPL", "map") == "scan":
+    impl = os.environ.get("GEOMESA_KNN_IMPL", "map")
+    if impl == "scan":
         return _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl)
+    if impl == "blocked":
+        return _local_knn_heaps_blocked(x, y, true_n, qx, qy, k, ttl)
     base, valid, xf, yf = _knn_valid_and_degrees(x, y, true_n, ttl)
 
     def one(qp):
@@ -621,6 +628,59 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     return jax.lax.map(one, (qx, qy))  # (Ql, k) each
 
 
+_KNN_BLOCK = 2048  # blocked-impl row-block width (lane-aligned, ≫ k)
+
+
+def _pad_to_blocks(base, xf, yf, valid, n, width):
+    """Pad the shard columns to a multiple of ``width`` and reshape to
+    (rows/width, width), returning the matching per-lane GLOBAL row ids
+    with padded-tail ids clamped INTO this shard's range: ``base + n ..``
+    would alias the NEXT shard's real global ids, and a shard with < k
+    live rows would then surface another shard's first rows as neighbors.
+    Shared by the scan and blocked impls — the aliasing guard must stay
+    identical in both."""
+    nb = -(-n // width)
+    pad = nb * width - n
+    if pad:
+        xf = jnp.pad(xf, (0, pad))
+        yf = jnp.pad(yf, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    loc = jnp.minimum(
+        jnp.arange(nb * width, dtype=jnp.int32), jnp.int32(n - 1)
+    )
+    rows = (base + loc).reshape(nb, width)
+    return (xf.reshape(nb, width), yf.reshape(nb, width),
+            valid.reshape(nb, width), rows)
+
+
+def _local_knn_heaps_blocked(x, y, true_n, qx, qy, k, ttl=None):
+    """Hierarchical exact top-k: per-BLOCK top-k over a (n/B, B) view (a
+    cheap batched sort of short rows), then a final top-k over the n/B·k
+    survivors. Exact because every global top-k member is by definition
+    within its own block's top-k. Motivation: a single ``lax.top_k`` over a
+    10⁸-length vector is the dominant cost of the ``map`` impl on an
+    accelerator (sort-shaped, serial in row length), while (nb, 2048)
+    batched top-k tiles onto the VPU; the survivor set is ~k·n/B ≪ n."""
+    base, valid, xf, yf = _knn_valid_and_degrees(x, y, true_n, ttl)
+    n = x.shape[0]
+    bw = int(min(_KNN_BLOCK, max(k, n)))
+    xb, yb, vb, rb = _pad_to_blocks(base, xf, yf, valid, n, bw)
+    kb = min(k, bw)
+
+    def one(qp):
+        qxi, qyi = qp
+        d2 = (xb - qxi) ** 2 + (yb - qyi) ** 2
+        d2 = jnp.where(vb, d2, jnp.inf)
+        nd1, ni1 = jax.lax.top_k(-d2, kb)            # (nb, kb) per-block
+        nd2, sel = jax.lax.top_k(nd1.reshape(-1), k)  # over survivors
+        blk = sel // kb
+        col = jnp.take(ni1.reshape(-1), sel)
+        rows = jnp.take(rb.reshape(-1), blk * bw + col)  # pre-clamped ids
+        return -nd2, rows.astype(jnp.int32)
+
+    return jax.lax.map(one, (qx, qy))  # (Ql, k) each
+
+
 def _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl=None):
     """Streaming variant: row chunks through a running per-query top-k
     (one shard read for all queries; see :func:`_local_knn_heaps`)."""
@@ -629,22 +689,7 @@ def _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl=None):
     q = qx.shape[0]
 
     chunk = int(min(n, _KNN_CHUNK))
-    nchunks = -(-n // chunk)
-    pad = nchunks * chunk - n
-    if pad:
-        xf = jnp.pad(xf, (0, pad))
-        yf = jnp.pad(yf, (0, pad))
-        valid = jnp.pad(valid, (0, pad))
-    xc = xf.reshape(nchunks, chunk)
-    yc = yf.reshape(nchunks, chunk)
-    vc = valid.reshape(nchunks, chunk)
-    # clamp padded-tail ids INTO this shard's range: base + n .. would
-    # alias the NEXT shard's real global ids, and a shard with < k live
-    # rows would then surface another shard's first rows as neighbors
-    loc = jnp.minimum(
-        jnp.arange(nchunks * chunk, dtype=jnp.int32), jnp.int32(n - 1)
-    )
-    rc = (base + loc).reshape(nchunks, chunk)
+    xc, yc, vc, rc = _pad_to_blocks(base, xf, yf, valid, n, chunk)
 
     def body(carry, inp):
         bd, bi = carry  # (Q, k) running best dists² / global rows

@@ -8,6 +8,12 @@ Runs ``tests/tpu/`` with ``GEOMESA_TPU_DEVICE_TESTS=1`` and appends a
 timestamped result block to ``TPU_VALIDATION.md`` — the durable artifact
 that compiled-kernel correctness was witnessed on hardware (round-1 verdict
 weakness: interpret-mode-only CI).
+
+Extra argv is passed through to pytest (e.g. ``-k "wms or journal"`` to
+witness a subset when a full run would exceed the relay window — the block
+header records the subset so a partial witness is honestly labeled).
+``GEOMESA_DEVVAL_TIMEOUT`` overrides the pytest wall cap (default 2700 s;
+the full 13-test suite exceeded the former 1800 s cap over the relay).
 """
 
 from __future__ import annotations
@@ -21,6 +27,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
+    extra = sys.argv[1:]
+    cap = int(os.environ.get("GEOMESA_DEVVAL_TIMEOUT", 2700))
     env = dict(os.environ)
     env["GEOMESA_TPU_DEVICE_TESTS"] = "1"
     env.pop("JAX_PLATFORMS", None)  # let the real backend register
@@ -37,14 +45,14 @@ def main() -> int:
     try:
         out = subprocess.run(
             [sys.executable, "-m", "pytest", "tests/tpu/", "-v", "--tb=short",
-             "-p", "no:cacheprovider"],
-            capture_output=True, text=True, timeout=1800, env=env, cwd=ROOT,
+             "-p", "no:cacheprovider", *extra],
+            capture_output=True, text=True, timeout=cap, env=env, cwd=ROOT,
         )
         stdout, rc = out.stdout, out.returncode
     except subprocess.TimeoutExpired as e:
         stdout = ((e.stdout or b"").decode(errors="replace")
                   if isinstance(e.stdout, bytes) else (e.stdout or ""))
-        stdout += "\n<pytest timed out after 1800s>"
+        stdout += f"\n<pytest timed out after {cap}s>"
         rc = -1
     tail = "\n".join(stdout.strip().splitlines()[-25:])
     import re
@@ -60,7 +68,9 @@ def main() -> int:
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC"
     )
-    block = f"\n## {stamp} — backend `{backend}` — {verdict}\n\n```\n{tail}\n```\n"
+    label = f" — subset `{' '.join(extra)}`" if extra else ""
+    block = (f"\n## {stamp} — backend `{backend}`{label} — {verdict}"
+             f"\n\n```\n{tail}\n```\n")
     path = os.path.join(ROOT, "TPU_VALIDATION.md")
     if not os.path.exists(path):
         with open(path, "w") as f:

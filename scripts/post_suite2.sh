@@ -1,0 +1,26 @@
+#!/bin/bash
+# Second post-suite evidence pass: witness the 5 on-device tests the 1800s
+# cap cut off (TPU_VALIDATION.md 03:47 block: 9/13 PASSED, killed during
+# test_public_compact_device_sort_2m), then measure the three KNN impls on
+# the real chip (scripts/knn_impl_probe.py) to pick config 3's default with
+# data. Run only when no other evidence script holds the chip.
+set -u
+cd "$(dirname "$0")/.."
+unset GEOMESA_BENCH_DETAIL
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+mkdir -p artifacts
+. scripts/evidence_lib.sh
+
+probe_step probe_ps2 || { echo "tunnel not healthy; aborting"; exit 1; }
+
+# inner pytest cap strictly below the outer step cap: a SIGINT arriving
+# first would kill the wrapper before it appends the partial-result block
+GEOMESA_DEVVAL_TIMEOUT=2500 step device_validation_tail 2700 \
+  python scripts/device_validation.py \
+  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile"
+
+# 3 children x 700s < 2400s outer cap: the summary line always prints
+GEOMESA_BENCH_N=16000000 GEOMESA_KNN_PROBE_CHILD_TIMEOUT=700 \
+  step knn_impl_probe 2400 python scripts/knn_impl_probe.py
+
+echo "post-suite-2 evidence complete: artifacts/*_${ts}.*"
