@@ -1,9 +1,16 @@
 #!/bin/bash
-# Second post-suite evidence pass: witness the 5 on-device tests the 1800s
-# cap cut off (TPU_VALIDATION.md 03:47 block: 9/13 PASSED, killed during
-# test_public_compact_device_sort_2m), then measure the three KNN impls on
-# the real chip (scripts/knn_impl_probe.py) to pick config 3's default with
-# data. Run only when no other evidence script holds the chip.
+# Second post-suite evidence pass: re-record cfg6 (first pass died on a
+# backend-init UNAVAILABLE), witness the 5 on-device tests the 1800s cap
+# cut off (TPU_VALIDATION.md 03:47 block: 9/13 PASSED), measure the three
+# KNN impls on the real chip, record config 3 with a verified winner, and
+# push config-7 residency to 250M rows. Run only when no other evidence
+# script holds the chip.
+#
+# Re-runnable: each completed step drops artifacts/.ps2_done_<name>; a rerun
+# (scripts/post_suite2_retry.sh loops on nonzero exit) skips finished steps
+# and the script exits nonzero while any step remains unfinished — a wedge
+# AFTER the probe gate re-engages the retry loop instead of forfeiting the
+# pass.
 set -u
 cd "$(dirname "$0")/.."
 unset GEOMESA_BENCH_DETAIL
@@ -11,16 +18,68 @@ ts=$(date -u +%Y%m%dT%H%M%SZ)
 mkdir -p artifacts
 . scripts/evidence_lib.sh
 
+step_once() {  # step_once <name> <timeout-s> <cmd...> — skip if done before
+  local name=$1
+  [ -e "artifacts/.ps2_done_${name}" ] && { echo "== ${name} (done) =="; return 0; }
+  if step "$@"; then
+    touch "artifacts/.ps2_done_${name}"
+    return 0
+  fi
+  return 1
+}
+
 probe_step probe_ps2 || { echo "tunnel not healthy; aborting"; exit 1; }
+incomplete=0
+
+GEOMESA_BENCH_CONFIG=6 step_once bench_cfg6_retry 1800 python bench.py \
+  || incomplete=1
 
 # inner pytest cap strictly below the outer step cap: a SIGINT arriving
 # first would kill the wrapper before it appends the partial-result block
-GEOMESA_DEVVAL_TIMEOUT=2500 step device_validation_tail 2700 \
+GEOMESA_DEVVAL_TIMEOUT=2500 step_once device_validation_tail 2700 \
   python scripts/device_validation.py \
-  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile"
+  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile" \
+  || incomplete=1
 
 # 3 children x 700s < 2400s outer cap: the summary line always prints
 GEOMESA_BENCH_N=16000000 GEOMESA_KNN_PROBE_CHILD_TIMEOUT=700 \
-  step knn_impl_probe 2400 python scripts/knn_impl_probe.py
+  step_once knn_impl_probe 2400 python scripts/knn_impl_probe.py \
+  || incomplete=1
 
+# if a non-default impl won on hardware AND its results cross-checked,
+# record config 3 with it (standalone step log only — BENCH_DETAIL stays
+# the sweep's record). Parse THIS run's log; a retry that skipped the
+# probe step parses the sentinel'd earlier log it committed.
+probe_log="artifacts/knn_impl_probe_${ts}.log"
+[ -e "$probe_log" ] || probe_log=$(ls -t artifacts/knn_impl_probe_*.log 2>/dev/null | head -1)
+winner=$(PROBE_LOG="$probe_log" python - <<'PY'
+import json, os
+winner = ""
+try:
+    with open(os.environ["PROBE_LOG"]) as f:
+        for line in f:
+            if line.startswith("{") and "winner" in line:
+                d = json.loads(line)
+                # a faster-but-wrong impl must never become the record
+                if d.get("checksums_agree") is True:
+                    winner = d.get("winner") or ""
+except (OSError, KeyError, json.JSONDecodeError):
+    pass
+print(winner)
+PY
+)
+if [ -n "$winner" ] && [ "$winner" != "map" ]; then
+  GEOMESA_BENCH_CONFIG=3 GEOMESA_KNN_IMPL="$winner" \
+    step_once "bench_cfg3_${winner}" 2400 python bench.py || incomplete=1
+fi
+
+# higher-residency witness: 250M rows (4 GB of columns) resident on the one
+# chip — the north star (1B) then needs 4 chips, not 8
+GEOMESA_BENCH_CONFIG=7 GEOMESA_BENCH_N=250000000 \
+  step_once bench_cfg7_250m 2400 python bench.py || incomplete=1
+
+if [ "$incomplete" -ne 0 ]; then
+  echo "post-suite-2 pass incomplete; retry will re-run unfinished steps"
+  exit 1
+fi
 echo "post-suite-2 evidence complete: artifacts/*_${ts}.*"

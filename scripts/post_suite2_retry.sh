@@ -1,0 +1,23 @@
+#!/bin/bash
+# Resilient launcher for post_suite2.sh: wait for any running first-pass
+# evidence script to exit, then retry the second pass every 10 minutes
+# until its probe gate passes and it completes (or the deadline lapses).
+# The wedge history (BASELINE.md round-2/3 notes) shows claims release
+# after minutes-to-hours — a one-shot gate would forfeit the whole pass.
+set -u
+cd "$(dirname "$0")/.."
+deadline=$(( $(date +%s) + ${GEOMESA_PS2_DEADLINE_S:-28800} ))
+
+while pgrep -f "post_suite_evidence.sh" > /dev/null 2>&1; do sleep 60; done
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if bash scripts/post_suite2.sh >> artifacts/post_suite2.out 2>&1; then
+    echo "post_suite2 completed $(date -u +%H:%M)" >> artifacts/post_suite2.out
+    exit 0
+  fi
+  echo "post_suite2 gate failed $(date -u +%H:%M); retry in 10 min" \
+    >> artifacts/post_suite2.out
+  sleep 600
+done
+echo "post_suite2 deadline lapsed" >> artifacts/post_suite2.out
+exit 1
