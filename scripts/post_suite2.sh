@@ -18,13 +18,26 @@ ts=$(date -u +%Y%m%dT%H%M%SZ)
 mkdir -p artifacts
 . scripts/evidence_lib.sh
 
-step_once() {  # step_once <name> <timeout-s> <cmd...> — skip if done before
+step_once() {  # step_once <name> <timeout-s> <cmd...> — skip if done before;
+  # give up after 3 failures (a deterministic failure must not spend the
+  # whole retry window re-running and re-committing the same failing step)
   local name=$1
+  # NB: must be a separate `local` — expansions in one local's arg list see
+  # the PRE-assignment value of variables assigned earlier in the same list
+  local failf="artifacts/.ps2_fail_${name}"
   [ -e "artifacts/.ps2_done_${name}" ] && { echo "== ${name} (done) =="; return 0; }
-  if step "$@"; then
-    touch "artifacts/.ps2_done_${name}"
+  local fails=0
+  [ -e "$failf" ] && fails=$(cat "$failf")
+  if [ "$fails" -ge 3 ]; then
+    echo "== ${name} (failed ${fails}x, giving up — see committed logs) =="
     return 0
   fi
+  if step "$@"; then
+    touch "artifacts/.ps2_done_${name}"
+    rm -f "$failf"
+    return 0
+  fi
+  echo $((fails + 1)) > "$failf"
   return 1
 }
 
@@ -82,4 +95,7 @@ if [ "$incomplete" -ne 0 ]; then
   echo "post-suite-2 pass incomplete; retry will re-run unfinished steps"
   exit 1
 fi
+# clear the pass's state so a future INTENTIONAL re-run runs for real
+# instead of silently skipping every step while claiming fresh evidence
+rm -f artifacts/.ps2_done_* artifacts/.ps2_fail_*
 echo "post-suite-2 evidence complete: artifacts/*_${ts}.*"

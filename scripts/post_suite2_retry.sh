@@ -8,7 +8,16 @@ set -u
 cd "$(dirname "$0")/.."
 deadline=$(( $(date +%s) + ${GEOMESA_PS2_DEADLINE_S:-28800} ))
 
-while pgrep -f "post_suite_evidence.sh" > /dev/null 2>&1; do sleep 60; done
+# deadline applies to the wait too: a wedged first pass must not hang the
+# launcher silently past the window
+while pgrep -f "post_suite_evidence.sh" > /dev/null 2>&1; do
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "post_suite2 deadline lapsed waiting for first pass" \
+      >> artifacts/post_suite2.out
+    exit 1
+  fi
+  sleep 60
+done
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if bash scripts/post_suite2.sh >> artifacts/post_suite2.out 2>&1; then

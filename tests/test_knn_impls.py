@@ -99,6 +99,32 @@ class TestKnnImplEquivalence:
                 )
                 assert (r[qi] >= 0).all() and (r[qi] < max(n, 8)).all(), impl
 
+    def test_fuzz_random_shapes(self, monkeypatch):
+        # seeded fuzz over n (odd / pow2 / sub-block) × k × Q: the three
+        # impls must return the same ascending distance vectors (fusion
+        # noise band) for every trial — the repo's property-fuzz pattern
+        # (tests/test_fuzz.py) applied to the KNN sweep surface
+        rng = np.random.default_rng(123)
+        mesh = make_mesh(8, query_parallel=2)
+        for trial in range(5):
+            n = int(rng.choice([257, 4096, 10_000, 65_537, 1_000]))
+            k = int(rng.choice([1, 3, 16]))
+            q = int(rng.choice([2, 4, 8]))
+            lon, lat, xi, yi = _store(n, seed=trial)
+            cols, _, _ = shard_columns(mesh, {"x": xi, "y": yi})
+            qx = jnp.asarray(rng.uniform(-150, 150, q).astype(np.float32))
+            qy = jnp.asarray(rng.uniform(-60, 60, q).astype(np.float32))
+            outs = {
+                impl: _run(monkeypatch, impl, mesh, cols, n, qx, qy, k)
+                for impl in IMPLS
+            }
+            d_ref = outs["map"][0]
+            for impl in ("scan", "blocked"):
+                np.testing.assert_allclose(
+                    outs[impl][0], d_ref, rtol=3e-5, atol=1e-4,
+                    err_msg=f"trial={trial} impl={impl} n={n} k={k} q={q}",
+                )
+
     def test_blocked_ttl_masking(self, monkeypatch):
         # blocked impl under the TTL signature: expired rows never surface
         n = 4_096
