@@ -86,6 +86,13 @@ if [ -n "$winner" ] && [ "$winner" != "map" ]; then
     step_once "bench_cfg3_${winner}" 2400 python bench.py || incomplete=1
 fi
 
+# configs 9 and 4 never landed on hardware (sweep budget, then the wedge
+# killed the first-pass standalone runs at backend init)
+GEOMESA_BENCH_CONFIG=9 step_once bench_cfg9_hw 1800 python bench.py \
+  || incomplete=1
+GEOMESA_BENCH_CONFIG=4 step_once bench_cfg4_hw 1800 python bench.py \
+  || incomplete=1
+
 # higher-residency witness: 250M rows (4 GB of columns) resident on the one
 # chip — the north star (1B) then needs 4 chips, not 8
 GEOMESA_BENCH_CONFIG=7 GEOMESA_BENCH_N=250000000 \
