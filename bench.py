@@ -117,14 +117,14 @@ def _p50(fn, iters=ITERS, budget_s=None, warmup=True):
     return float(np.percentile(lat_ms, 50))
 
 
+_MARK_T0 = time.perf_counter()
+
+
 def _mark(msg: str):
     """Timestamped progress marker on stderr: a step timeout's log shows the
     phase that consumed the budget instead of a bare rc=124."""
     print(f"[bench +{time.perf_counter() - _MARK_T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
-
-
-_MARK_T0 = time.perf_counter()
 
 
 def _sharded_store(lon, lat, t_ms, period=PERIOD):

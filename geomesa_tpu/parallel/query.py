@@ -587,7 +587,7 @@ def _knn_valid_and_degrees(x, y, true_n, ttl):
     return base, valid, xf, yf
 
 
-def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
+def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None, impl=None):
     """Per-shard candidate heaps shared by the gather and ring KNN steps.
 
     Three implementations (``GEOMESA_KNN_IMPL``): ``map`` top-ks each query
@@ -602,8 +602,10 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     until a variant's accelerator win is hardware-measured (CPU mesh:
     map 0.7 s vs scan 2.1 s per 64-query batch at 4M rows — host top_k
     favors map).
-    The knob is read at TRACE time: set it before the first KNN call of
-    the process (compiled steps are memoized per mesh/k).
+    Selection: an explicit ``impl`` argument overrides the env knob;
+    ``None`` defers to ``GEOMESA_KNN_IMPL``, read at TRACE time — set it
+    before the first KNN call of the process (the ``cached_*`` step
+    wrappers are memoized per mesh/k and remain env-only).
 
     ``ttl``: optional (bins, offs, cut) — rows with (bin, off)
     lexicographically BELOW cut=(cut_bin, cut_off) are TTL-expired and
@@ -611,7 +613,7 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None):
     candidates (the AgeOffIterator-at-scan role on the KNN path).
 
     Returns (dists² (Ql, k) ascending, global rows (Ql, k) int32)."""
-    impl = os.environ.get("GEOMESA_KNN_IMPL", "map")
+    impl = impl or os.environ.get("GEOMESA_KNN_IMPL", "map")
     if impl == "scan":
         return _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl)
     if impl == "blocked":
@@ -711,7 +713,8 @@ def _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl=None):
     return bd, bi
 
 
-def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
+def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
+                          impl: str | None = None):
     """Batched multi-point KNN in ONE pass: per-shard distance scan +
     ``top_k``, candidates ``all_gather``-merged over the data axis and
     re-ranked — replacing the reference's per-point iterative-deepening
@@ -726,6 +729,9 @@ def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
     ``with_ttl``: signature becomes fn(x, y, bins, offs, true_n, qx, qy,
     cut (2,) int32) — rows lex-below cut are expired and masked on device
     (live-store KNN, VERDICT r2 item 5).
+
+    ``impl``: per-shard sweep shape, overriding ``GEOMESA_KNN_IMPL``
+    (``None`` = the env knob; see :func:`_local_knn_heaps`).
     """
 
     col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
@@ -746,7 +752,7 @@ def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
         else:
             x, y, true_n, qx, qy = args
             ttl = None
-        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl)
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl, impl=impl)
         # merge per-shard candidate heaps across the mesh
         ad = jax.lax.all_gather(dloc, DATA_AXIS, axis=0)  # (D, Ql, k)
         ai = jax.lax.all_gather(iloc, DATA_AXIS, axis=0)
@@ -871,7 +877,8 @@ def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
     return step
 
 
-def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
+def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
+                       impl: str | None = None):
     """Batched KNN with a RING top-k merge over the data axis (``ppermute``).
 
     Same contract as :func:`make_batched_knn_step`, different collective
@@ -882,6 +889,8 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
     long-sequence attention. Preferable when D·k·Q would pressure VMEM/HBM
     (large query batches on big meshes); distances are identical to the
     all_gather form (row choice may differ where k-th distances tie).
+    ``impl`` selects the per-shard sweep shape, overriding
+    ``GEOMESA_KNN_IMPL`` (``None`` = the env knob).
     """
 
     n_shards = data_shards(mesh)
@@ -903,7 +912,7 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
         else:
             x, y, true_n, qx, qy = args
             ttl = None
-        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl)
+        dloc, iloc = _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=ttl, impl=impl)
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
         def hop(carry, _):

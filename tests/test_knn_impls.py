@@ -34,9 +34,10 @@ def _decode_f32(xi, yi):
     return x, y
 
 
-def _run(monkeypatch, impl, mesh, cols, n, qx, qy, k):
-    monkeypatch.setenv("GEOMESA_KNN_IMPL", impl)
-    step = make_batched_knn_step(mesh, k)  # fresh trace: knob read here
+def _run(impl, mesh, cols, n, qx, qy, k):
+    # the explicit impl parameter; the env-knob path has its own sentinel
+    # test (test_env_knob_selects_impl)
+    step = make_batched_knn_step(mesh, k, impl=impl)
     d, r = step(cols["x"], cols["y"], jnp.int32(n), qx, qy)
     return np.asarray(d), np.asarray(r)
 
@@ -55,7 +56,7 @@ class TestKnnImplEquivalence:
         # referee in the SAME f32 decode the device uses
         xf, yf = _decode_f32(xi, yi)
         results = {
-            impl: _run(monkeypatch, impl, mesh, cols, n, qx, qy, k)
+            impl: _run(impl, mesh, cols, n, qx, qy, k)
             for impl in IMPLS
         }
         for qi in range(q):
@@ -90,7 +91,7 @@ class TestKnnImplEquivalence:
         d2 = ((xf - 0.0) ** 2 + (yf - 0.0) ** 2).astype(np.float32)
         expect = np.sqrt(np.sort(d2)[:k].astype(np.float32))
         for impl in IMPLS:
-            d, r = _run(monkeypatch, impl, mesh, cols, n, qx, qy, k)
+            d, r = _run(impl, mesh, cols, n, qx, qy, k)
             for qi in range(2):
                 finite = np.isfinite(d[qi])
                 np.testing.assert_allclose(
@@ -115,7 +116,7 @@ class TestKnnImplEquivalence:
             qx = jnp.asarray(rng.uniform(-150, 150, q).astype(np.float32))
             qy = jnp.asarray(rng.uniform(-60, 60, q).astype(np.float32))
             outs = {
-                impl: _run(monkeypatch, impl, mesh, cols, n, qx, qy, k)
+                impl: _run(impl, mesh, cols, n, qx, qy, k)
                 for impl in IMPLS
             }
             d_ref = outs["map"][0]
@@ -124,6 +125,63 @@ class TestKnnImplEquivalence:
                     outs[impl][0], d_ref, rtol=3e-5, atol=1e-4,
                     err_msg=f"trial={trial} impl={impl} n={n} k={k} q={q}",
                 )
+
+    def test_env_knob_selects_impl(self, monkeypatch):
+        # the env knob must actually route to the chosen impl (outputs are
+        # identical across impls BY DESIGN, so equality tests cannot catch a
+        # knob regression — a call-counting sentinel can)
+        from geomesa_tpu.parallel import query as Q
+
+        calls = []
+        real = Q._local_knn_heaps_blocked
+
+        def sentinel(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(Q, "_local_knn_heaps_blocked", sentinel)
+        n = 2_048
+        lon, lat, xi, yi = _store(n, seed=2)
+        mesh = make_mesh(8, query_parallel=2)
+        cols, _, _ = shard_columns(mesh, {"x": xi, "y": yi})
+        qx = jnp.asarray(np.zeros(2, np.float32))
+        qy = jnp.asarray(np.zeros(2, np.float32))
+        monkeypatch.setenv("GEOMESA_KNN_IMPL", "blocked")
+        make_batched_knn_step(mesh, 4)(
+            cols["x"], cols["y"], jnp.int32(n), qx, qy
+        )
+        assert calls, "GEOMESA_KNN_IMPL=blocked did not route to the impl"
+        # an explicit impl= overrides the env knob
+        calls.clear()
+        monkeypatch.setenv("GEOMESA_KNN_IMPL", "map")
+        make_batched_knn_step(mesh, 4, impl="blocked")(
+            cols["x"], cols["y"], jnp.int32(n), qx, qy
+        )
+        assert calls, "explicit impl='blocked' did not override the env knob"
+
+    def test_blocked_through_ring_topology(self, monkeypatch):
+        # the ppermute-ring merge consumes the same per-shard heaps — the
+        # blocked impl must compose with it exactly as map does
+        from geomesa_tpu.parallel.query import make_ring_knn_step
+
+        n = 8_192
+        lon, lat, xi, yi = _store(n, seed=21)
+        mesh = make_mesh(8, query_parallel=2)
+        cols, _, _ = shard_columns(mesh, {"x": xi, "y": yi})
+        k, q = 6, 4
+        qx = jnp.asarray(np.linspace(-120, 120, q, dtype=np.float32))
+        qy = jnp.asarray(np.linspace(-50, 50, q, dtype=np.float32))
+        monkeypatch.setenv("GEOMESA_KNN_IMPL", "map")
+        d_map, _ = make_ring_knn_step(mesh, k)(
+            cols["x"], cols["y"], jnp.int32(n), qx, qy
+        )
+        monkeypatch.setenv("GEOMESA_KNN_IMPL", "blocked")
+        d_blk, _ = make_ring_knn_step(mesh, k)(
+            cols["x"], cols["y"], jnp.int32(n), qx, qy
+        )
+        np.testing.assert_allclose(
+            np.asarray(d_blk), np.asarray(d_map), rtol=3e-5, atol=1e-4
+        )
 
     def test_blocked_ttl_masking(self, monkeypatch):
         # blocked impl under the TTL signature: expired rows never surface
