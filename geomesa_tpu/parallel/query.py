@@ -614,6 +614,12 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None, impl=None):
 
     Returns (dists² (Ql, k) ascending, global rows (Ql, k) int32)."""
     impl = impl or os.environ.get("GEOMESA_KNN_IMPL", "map")
+    if impl not in ("map", "scan", "blocked"):
+        # loud by design: the impls return identical results, so a typo'd
+        # selection silently falling back to map could never be caught by
+        # output checks — it would just benchmark the wrong kernel
+        raise ValueError(f"unknown KNN impl {impl!r} "
+                         "(expected 'map', 'scan', or 'blocked')")
     if impl == "scan":
         return _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl)
     if impl == "blocked":
@@ -713,6 +719,12 @@ def _local_knn_heaps_scan(x, y, true_n, qx, qy, k, ttl=None):
     return bd, bi
 
 
+def _check_knn_impl(impl):
+    if impl not in (None, "map", "scan", "blocked"):
+        raise ValueError(f"unknown KNN impl {impl!r} "
+                         "(expected 'map', 'scan', or 'blocked')")
+
+
 def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
                           impl: str | None = None):
     """Batched multi-point KNN in ONE pass: per-shard distance scan +
@@ -734,6 +746,7 @@ def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
     (``None`` = the env knob; see :func:`_local_knn_heaps`).
     """
 
+    _check_knn_impl(impl)
     col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
     tail_specs = (P(QUERY_AXIS), P(QUERY_AXIS)) + ((P(),) if with_ttl else ())
 
@@ -893,7 +906,9 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
     ``GEOMESA_KNN_IMPL`` (``None`` = the env knob).
     """
 
+    _check_knn_impl(impl)
     n_shards = data_shards(mesh)
+    _check_knn_impl(impl)
     col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
     tail_specs = (P(QUERY_AXIS), P(QUERY_AXIS)) + ((P(),) if with_ttl else ())
 

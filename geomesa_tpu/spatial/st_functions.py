@@ -291,6 +291,7 @@ ST: dict[str, object] = {
         lambda p1, p2: box(min(p1.x, p2.x), min(p1.y, p2.y), max(p1.x, p2.x), max(p1.y, p2.y))
     ),
     "st_makeline": st_make_line,
+    "st_makepolygon": _elementwise(st_make_polygon),
     "st_makepoint": st_make_point,
     "st_makepointm": st_make_point,  # M ordinate not modeled (2D framework)
     "st_point": st_make_point,
@@ -315,6 +316,7 @@ ST: dict[str, object] = {
     "st_isring": _elementwise(_ops.is_ring),
     "st_issimple": _elementwise(_ops.is_simple),
     "st_isvalid": _elementwise(_ops.is_valid),
+    "st_geometrytype": _elementwise(lambda g: type(g).__name__),
     "st_numgeometries": _elementwise(_ops.num_geometries),
     "st_numpoints": _elementwise(_ops.num_points),
     "st_pointn": _elementwise(_ops.point_n),

@@ -20,7 +20,10 @@ Supported grammar:
     item      := * | col | agg | fn(col) [AS alias]
     agg       := COUNT(*) | COUNT(col) | COUNT(DISTINCT col)
                  | SUM/MIN/MAX/AVG(col)
-    fn        := ST_X | ST_Y | ST_AsText | ST_GeoHash  (per-row scalar UDFs)
+    fn        := any single-argument ST_* registry UDF (ST_X, ST_Y,
+                 ST_AsText, ST_GeoHash fast paths; ST_Area, ST_Centroid,
+                 ST_GeometryType, ... via spatial/st_functions.ST —
+                 geometry-valued results surface as WKT text)
     predicate := CQL comparisons/temporal ops, plus spark-jts spatial calls:
                  ST_Contains/ST_Within/ST_Intersects/ST_Disjoint(col, g),
                  ST_DWithin(col, g, dist); g := ST_GeomFromText('wkt')|'wkt'
@@ -234,12 +237,33 @@ def _parse_item(item: str) -> _Item:
                     dm.group(1), "count_distinct",
                 )
             return _Item("agg", alias or f"{fn}({arg})", arg, fn)
-        if fn in ("st_x", "st_y", "st_astext", "st_geohash"):
+        if fn in _UNARY_ST and re.match(r"^\w+$", arg):
+            # unary geometry→value registry UDFs ride the select list (the
+            # reference registers the whole spark-jts library as SQL UDFs,
+            # geomesa-spark-jts/.../DataFrameFunctions.scala); multi-arg /
+            # non-geometry-input UDFs (st_buffer, st_makepoint, casts from
+            # text, predicates) are rejected HERE so a bad query fails as
+            # SqlError at parse, not TypeError at execution
             return _Item("fn", alias or f"{fn}({arg})", arg, fn)
         raise SqlError(f"unsupported function {fn!r} in select list")
     if not re.match(r"^\w+$", item):
         raise SqlError(f"unsupported select item {item!r}")
     return _Item("col", alias or item, item)
+
+
+# select-list ST UDFs: exactly one geometry argument, scalar/geometry out.
+# Multi-arg (st_buffer, st_distance, st_geometryn, ...), text-input
+# constructors, and predicate forms are excluded — the registry carries no
+# arity metadata, so the safe unary surface is enumerated explicitly.
+_UNARY_ST = frozenset({
+    "st_x", "st_y", "st_astext", "st_geohash", "st_asbinary", "st_asgeojson",
+    "st_aslatlontext", "st_area", "st_centroid", "st_length",
+    "st_lengthsphere", "st_boundary", "st_coorddim", "st_dimension",
+    "st_envelope", "st_exteriorring", "st_geometrytype", "st_isclosed",
+    "st_iscollection", "st_isempty", "st_isring", "st_issimple",
+    "st_isvalid", "st_numgeometries", "st_numpoints", "st_convexhull",
+    "st_antimeridiansafegeom", "st_idlsafegeom", "st_casttogeometry",
+})
 
 
 def _scalar_fn(fn: str, table, col: str) -> np.ndarray:
@@ -261,7 +285,27 @@ def _scalar_fn(fn: str, table, col: str) -> np.ndarray:
         return np.array(
             [None if g is None else st_geohash(g) for g in geoms], dtype=object
         )
-    raise SqlError(f"unknown scalar function {fn!r}")
+    # generic single-arg registry UDF; geometry-valued results surface as
+    # WKT (this is a textual SQL result set — the reference's show() does
+    # the same via JTS toString)
+    from geomesa_tpu.geometry.types import Geometry
+    from geomesa_tpu.geometry.wkt import to_wkt
+    from geomesa_tpu.spatial.st_functions import ST
+
+    udf = ST.get(fn)
+    if udf is None or fn not in _UNARY_ST:
+        raise SqlError(f"unknown scalar function {fn!r}")
+    out = []
+    for g in geoms:
+        if g is None:
+            out.append(None)
+            continue
+        try:
+            v = udf(g)
+        except Exception as e:  # keep the sql() error contract
+            raise SqlError(f"{fn}({col}) failed: {e}") from e
+        out.append(to_wkt(v) if isinstance(v, Geometry) else v)
+    return np.array(out, dtype=object)
 
 
 def _agg_value(fn: str, arg: str, table, idx: np.ndarray):

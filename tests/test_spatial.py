@@ -327,6 +327,10 @@ class TestSTRegistry:
         ring = st("st_makeLine", [P(0, 0), P(1, 0), P(1, 1), P(0, 0)])
         poly = st("st_polygon", ring)
         assert st("st_area", poly) == pytest.approx(0.5)
+        poly2 = st("st_makePolygon", ring)  # spark-jts alias of st_polygon
+        assert st("st_area", poly2) == pytest.approx(0.5)
+        assert st("st_geometryType", poly2) == "Polygon"
+        assert st("st_geometryType", P(1, 2)) == "Point"
 
     def test_lat_lon_text(self):
         txt = st("st_asLatLonText", P(-75.5, 35.25))

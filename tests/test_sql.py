@@ -82,6 +82,26 @@ class TestSelect:
         res = sql(ds, "SELECT ST_AsText(geom) AS wkt FROM ev LIMIT 2")
         assert res.columns["wkt"][0].startswith("POINT")
 
+    def test_generic_registry_udfs(self, ds):
+        # any single-arg ST registry UDF rides the select list; geometry
+        # results surface as WKT (the spark-jts SQL-UDF surface role)
+        res = sql(ds, "SELECT ST_GeometryType(geom) AS t, "
+                      "ST_Centroid(geom) AS c, ST_Area(geom) AS a, "
+                      "ST_IsValid(geom) AS v FROM ev LIMIT 3")
+        assert list(res.columns) == ["t", "c", "a", "v"]
+        assert all(t == "Point" for t in res.columns["t"])
+        assert all(c.startswith("POINT") for c in res.columns["c"])
+        assert all(a == 0.0 for a in res.columns["a"])
+        assert all(v is True for v in res.columns["v"])
+
+    def test_unknown_st_function_rejected(self, ds):
+        import pytest
+
+        from geomesa_tpu.sql.engine import SqlError
+
+        with pytest.raises(SqlError, match="unsupported function"):
+            sql(ds, "SELECT ST_Bogus(geom) FROM ev LIMIT 1")
+
 
 class TestAggregates:
     def test_count_star(self, ds):
