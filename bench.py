@@ -625,7 +625,9 @@ def bench_xz2():
     from geomesa_tpu.parallel.mesh import make_mesh, shard_columns
     from geomesa_tpu.parallel.query import make_batched_overlap_step
 
-    M = _n(1_000_000)  # number of trajectories
+    # trajectories; accelerator default 4M (same honest-scale rationale as
+    # config 2: the CPU referee is linear in M, the fused overlap scan not)
+    M = _n(4_000_000 if jax.default_backend() != "cpu" else 1_000_000)
     rng = np.random.default_rng(9)
     # GPS-track bounding boxes: short tracks clustered around cities
     which = rng.integers(0, len(CITIES), M)
