@@ -13,9 +13,10 @@ Supported grammar:
       [WHERE <predicates>] [GROUP BY <col, ...>]
       [ORDER BY <col> [ASC|DESC]] [LIMIT <n>] [OFFSET <k>]
 
-    SELECT <alias.col|alias.*, ...> FROM <t1> <a> JOIN <t2> <b>
+    SELECT <alias.col|alias.*|agg, ...> FROM <t1> <a> JOIN <t2> <b>
       ON ST_Within|ST_Contains|ST_Intersects(<alias.geom>, <alias.geom>)
-      [WHERE <left-alias predicates>] [LIMIT <n>]
+      [WHERE <left-alias predicates>]
+      [GROUP BY <alias.col, ...>] [LIMIT <n>]
 
     item      := * | col | agg | fn(col) [AS alias]
     agg       := COUNT(*) | COUNT(col) | COUNT(DISTINCT col)
@@ -350,6 +351,7 @@ _JOIN = re.compile(
     r"on\s+(?P<pred>st_within|st_contains|st_intersects)\s*\(\s*"
     r"(?P<xa>\w+)\.(?P<xc>\w+)\s*,\s*(?P<ya>\w+)\.(?P<yc>\w+)\s*\)"
     r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
@@ -427,6 +429,169 @@ def _join_pairs(ds, t1: str, rgeoms, left_pred: str, base_cql):
         yield i, lt
 
 
+class _JoinedTable:
+    """Minimal ``table.columns`` shim over materialized join columns so
+    :func:`_agg_value` serves the join fold unchanged."""
+
+    def __init__(self, columns):
+        self.columns = columns
+
+
+def _group_first_occurrence(keys):
+    """Tie rows to first-occurrence groups: ``keys`` (iterable of hashables)
+    → (unique keys in first-seen order, per-group row-index lists). The one
+    grouping idiom shared by DISTINCT, the single-table host fold, and the
+    join fold — the tie-to-first-occurrence semantics must not drift."""
+    seen: dict = {}
+    groups: list[list[int]] = []
+    for i, k in enumerate(keys):
+        g = seen.get(k)
+        if g is None:
+            g = seen[k] = len(groups)
+            groups.append([])
+        groups[g].append(i)
+    return list(seen), groups
+
+
+def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
+                       left_pred, base_cql) -> SqlResult:
+    """``JOIN ... GROUP BY``: first-occurrence host fold over the streamed
+    join pairs — the single-table host fold's semantics applied to the
+    joined relation ("points per zone"). The reference composes these
+    freely through Spark Catalyst (`geomesa-spark-sql/.../SQLRules.scala`);
+    here the join scan stays index-pruned and only the group keys and
+    aggregate argument columns are materialized. HAVING/ORDER BY are not
+    part of the join grammar (LIMIT bounds output groups)."""
+    from geomesa_tpu.schema.columnar import Column
+
+    gcols: list[tuple[str, str]] = []
+    for raw in _split_top(_clause(m, original, "group")):
+        gm = re.match(r"^(\w+)\.(\w+)$", raw.strip())
+        if not gm:
+            raise SqlError(f"join GROUP BY keys must be alias.col: {raw!r}")
+        gcols.append((gm.group(1), gm.group(2)))
+
+    def _attr(alias, col, agg=False):
+        sft = sft1 if alias == a1 else sft2 if alias == a2 else None
+        if sft is None:
+            raise SqlError(f"unknown alias {alias!r}")
+        attr = next((a for a in sft.attributes if a.name == col), None)
+        if attr is None:
+            raise SqlError(f"unknown column {alias}.{col}")
+        if agg and attr.type.is_geometry:
+            raise SqlError(f"cannot aggregate geometry column {alias}.{col}")
+        return attr
+
+    for alias, col in gcols:
+        _attr(alias, col)
+
+    # select items: group keys, COUNT(*), COUNT(DISTINCT alias.col), or
+    # fn(alias.col); value computation delegates to _agg_value so the join
+    # fold can never diverge from the single-table fold (null masks, float64
+    # AVG, distinct semantics)
+    items: list[tuple[str, str, str | None, str, str | None]] = []
+    for raw in _split_top(m.group("select")):
+        raw = raw.strip()
+        am = re.match(r"^(.*?)\s+as\s+(\w+)$", raw, re.IGNORECASE | re.DOTALL)
+        expr, out = (am.group(1).strip(), am.group(2)) if am else (raw, None)
+        call = re.match(r"^(count|sum|avg|min|max)\s*\(\s*(.+?)\s*\)$",
+                        expr, re.IGNORECASE | re.DOTALL)
+        if call:
+            fn, arg = call.group(1).lower(), call.group(2).strip()
+            if arg == "*":
+                if fn != "count":
+                    raise SqlError(f"{fn}(*) is not supported")
+                items.append(("agg", out or "count(*)", None, "*", fn))
+                continue
+            dm = re.match(r"^distinct\s+(.+)$", arg, re.IGNORECASE)
+            if dm:
+                if fn != "count":
+                    raise SqlError("DISTINCT is only supported in COUNT()")
+                fn, arg = "count_distinct", dm.group(1).strip()
+            cm = re.match(r"^(\w+)\.(\w+)$", arg)
+            if not cm:
+                raise SqlError(
+                    f"join aggregate argument must be alias.col: {arg!r}")
+            _attr(cm.group(1), cm.group(2), agg=(fn != "count_distinct"))
+            items.append(
+                ("agg", out or f"{fn}({arg})", cm.group(1), cm.group(2), fn))
+            continue
+        cm = re.match(r"^(\w+)\.(\w+)$", expr)
+        if not cm or (cm.group(1), cm.group(2)) not in gcols:
+            raise SqlError(
+                f"non-aggregate join select item must be a GROUP BY key: "
+                f"{expr!r}")
+        items.append(("key", out or expr, cm.group(1), cm.group(2), None))
+
+    limit = int(m.group("limit")) if m.group("limit") else None
+    right = ds.query(m.group("t2"), None).table
+    rgeoms = right.geom_column().geometries()
+
+    # stream pairs, materializing only the needed columns — values AND
+    # validity, so sentinel-valued NULLs neither pollute aggregates nor
+    # conflate with real zeros in group keys
+    need = list(dict.fromkeys(
+        gcols + [(al, c) for k, _, al, c, _ in items if k == "agg" and al]))
+    vals_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
+    valid_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
+    types = {
+        (alias, col): _attr(alias, col).type for alias, col in need
+    }
+    for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql):
+        n = 0 if lt is None else len(lt)
+        if n == 0:
+            continue
+        for alias, col in need:
+            if alias == a1:
+                c = lt.columns[col]
+                v = c.geometries() if c.type.is_geometry else c.values
+                vals_acc[(alias, col)].extend(v)
+                valid_acc[(alias, col)].extend(c.is_valid())
+            else:
+                c = right.columns[col]
+                v = c.geometries()[j] if c.type.is_geometry else c.values[j]
+                vals_acc[(alias, col)].extend([v] * n)
+                valid_acc[(alias, col)].extend([bool(c.is_valid()[j])] * n)
+
+    joined = {
+        f"{alias}.{col}": Column(
+            types[(alias, col)],
+            np.array(vals_acc[(alias, col)], dtype=object)
+            if types[(alias, col)].is_geometry
+            or types[(alias, col)].name in ("STRING", "UUID", "BYTES")
+            else np.asarray(vals_acc[(alias, col)]),
+            np.asarray(valid_acc[(alias, col)], dtype=bool),
+        )
+        for alias, col in need
+    }
+    shim = _JoinedTable(joined)
+
+    nrows = len(vals_acc[gcols[0]])
+    keys = []
+    for i in range(nrows):
+        keys.append(tuple(
+            vals_acc[kc][i] if valid_acc[kc][i] else None for kc in gcols
+        ))
+    gkeys, groups = _group_first_occurrence(keys)
+    if limit is not None:
+        gkeys, groups = gkeys[:limit], groups[:limit]
+    cols: dict[str, np.ndarray] = {}
+    for kind, name, alias, col, fn in items:
+        if kind == "key":
+            gi = gcols.index((alias, col))
+            cols[name] = np.array([k[gi] for k in gkeys], dtype=object)
+            continue
+        arg = "*" if col == "*" else f"{alias}.{col}"
+        cols[name] = np.array(
+            [
+                _agg_value(fn, arg, shim, np.asarray(g, dtype=np.int64))
+                for g in groups
+            ],
+            dtype=object,
+        )
+    return SqlResult(cols)
+
+
 def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     """Spatial JOIN: each right-table geometry becomes an index-planned scan
     of the left table (delegating to :func:`geomesa_tpu.process.join
@@ -473,6 +638,11 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
             raise SqlError("JOIN WHERE may reference only the left alias")
         base_cql = _rewrite_where(
             _map_unquoted(w, lambda seg: re.sub(rf"\b{a1}\s*\.", "", seg))
+        )
+
+    if m.group("group"):
+        return _join_grouped_fold(
+            ds, m, original, t1, a1, sft1, a2, sft2, left_pred, base_cql
         )
 
     # select items: alias.col or alias.* (duplicates collapse, order kept)
@@ -801,15 +971,11 @@ def sql(ds, statement: str) -> SqlResult:
                 cols[it.name] = _scalar_fn(it.fn, r.table, it.arg)
         if distinct:
             names = list(cols)
-            seen: dict = {}
-            keep: list[int] = []
             nrows = len(next(iter(cols.values()))) if cols else 0
-            for i in range(nrows):
-                k = tuple(str(cols[c][i]) for c in names)
-                if k not in seen:
-                    seen[k] = True
-                    keep.append(i)
-            idx = np.asarray(keep, dtype=np.int64)
+            _, groups = _group_first_occurrence(
+                tuple(str(cols[c][i]) for c in names) for i in range(nrows)
+            )
+            idx = np.asarray([g[0] for g in groups], dtype=np.int64)
             cols = {c: v[idx] for c, v in cols.items()}
             # DISTINCT collapses rows: ordering by an unselected column is
             # ill-defined, so the select-list-only rule applies (SQL's own)
@@ -886,15 +1052,9 @@ def sql(ds, statement: str) -> SqlResult:
 
     keys = [t.columns[g].values.astype(object) for g in group_by]
     combo = np.array(list(zip(*keys)), dtype=object)
-    seen: dict = {}
-    groups: list[list[int]] = []
-    for i in range(len(t)):
-        k = tuple(combo[i])
-        if k not in seen:
-            seen[k] = len(groups)
-            groups.append([])
-        groups[seen[k]].append(i)
-    group_keys = list(seen)
+    group_keys, groups = _group_first_occurrence(
+        tuple(combo[i]) for i in range(len(t))
+    )
     if having:
         hit, hop, lit = _having_parts(having)
         if hit.arg != "*" and hit.arg not in t.columns:

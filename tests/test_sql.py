@@ -273,6 +273,55 @@ class TestSpatialJoin:
                          "ON ST_Within(a.geom, b.geom) WHERE a.name = 'b.x'")
         assert len(r) == 0  # no point is named 'b.x' — but it parses
 
+    def test_join_group_by(self, join_ds):
+        # "points per zone" — the Spark-SQL composition of spatial JOIN
+        # with relational aggregation (GeoMesaRelation + Catalyst role)
+        r = sql(
+            join_ds,
+            "SELECT b.zone, COUNT(*) AS n, AVG(a.val) AS m FROM pts a "
+            "JOIN zones b ON ST_Within(a.geom, b.geom) GROUP BY b.zone",
+        )
+        truth = self._truth(join_ds, self.ZONES)
+        got = {z: (n, m) for z, n, m in r.rows()}
+        for z, idx in truth.items():
+            if not idx:
+                assert z not in got
+                continue
+            vals = [float(i % 10) for i in sorted(idx)]
+            assert got[z][0] == len(idx)
+            assert got[z][1] == pytest.approx(sum(vals) / len(vals))
+
+    def test_join_group_by_null_handling(self):
+        # NULL values must not pollute aggregates (sentinel-zero bug class)
+        # nor conflate with real zeros — same mask semantics as the
+        # single-table _agg_value fold
+        from geomesa_tpu.geometry.types import Polygon
+
+        ds = DataStore(backend="oracle")
+        ds.create_schema("npts", "val:Double,*geom:Point")
+        ds.write("npts", [
+            {"val": 4.0, "geom": Point(1, 1)},
+            {"val": None, "geom": Point(2, 2)},
+            {"val": 8.0, "geom": Point(3, 3)},
+        ])
+        ds.create_schema("nz", "zone:String,*geom:Polygon")
+        ds.write("nz", [{"zone": "all", "geom": Polygon(
+            [[0, 0], [10, 0], [10, 10], [0, 10]])}])
+        r = sql(ds, "SELECT b.zone, COUNT(*) AS n, COUNT(a.val) AS nv, "
+                    "SUM(a.val) AS s, AVG(a.val) AS m, "
+                    "COUNT(DISTINCT a.val) AS d FROM npts a JOIN nz b "
+                    "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
+        (zone, n, nv, s, m, d), = r.rows()
+        assert (zone, n, nv, s, m, d) == ("all", 3, 2, 12.0, 6.0, 2)
+
+    def test_join_group_by_errors(self, join_ds):
+        with pytest.raises(SqlError, match="GROUP BY key"):
+            sql(join_ds, "SELECT a.name, COUNT(*) FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
+        with pytest.raises(SqlError, match="aggregate geometry"):
+            sql(join_ds, "SELECT b.zone, MIN(b.geom) FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
+
     def test_join_errors(self, join_ds):
         with pytest.raises(SqlError, match="left alias"):
             sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
