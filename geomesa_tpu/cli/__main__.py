@@ -292,6 +292,34 @@ def cmd_explain(args):
     print(ds.explain(args.name, args.cql))
 
 
+def cmd_sql(args):
+    """Run one SQL statement against the catalog (the spark-sql shell /
+    GeoMesaRelation role) and print csv or json-lines rows."""
+    import json as _json
+
+    from geomesa_tpu.sql.engine import SqlError, sql
+
+    ds = _load(args)
+    try:
+        res = sql(ds, args.query)
+    except SqlError as e:
+        raise SystemExit(f"sql error: {e}")
+    names = list(res.columns)
+    if args.format == "json":
+        for row in res.rows():
+            print(_json.dumps(
+                {k: (v.item() if hasattr(v, "item") else v)
+                 for k, v in zip(names, row)},
+                default=str))
+        return
+    import csv as _csv
+
+    w = _csv.writer(sys.stdout)
+    w.writerow(names)
+    for row in res.rows():
+        w.writerow(["" if v is None else v for v in row])
+
+
 def cmd_stats_analyze(args):
     ds = _load(args)
     sft = ds.get_schema(args.name)
@@ -489,6 +517,14 @@ def main(argv=None):
     common(sp)
     sp.add_argument("-q", "--cql", required=True)
     sp.set_defaults(fn=cmd_explain)
+
+    sp = sub.add_parser(
+        "sql", help="run a SQL statement against the catalog (spark-sql role)"
+    )
+    common(sp, name=False)
+    sp.add_argument("-q", "--query", required=True, help="SQL statement")
+    sp.add_argument("--format", default="csv", choices=["csv", "json"])
+    sp.set_defaults(fn=cmd_sql)
 
     sp = sub.add_parser("stats-analyze")
     common(sp)

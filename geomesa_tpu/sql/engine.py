@@ -462,7 +462,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     here the join scan stays index-pruned and only the group keys and
     aggregate argument columns are materialized. HAVING/ORDER BY are not
     part of the join grammar (LIMIT bounds output groups)."""
-    from geomesa_tpu.schema.columnar import Column
+    from geomesa_tpu.schema.columnar import Column, GeometryColumn
 
     gcols: list[tuple[str, str]] = []
     for raw in _split_top(_clause(m, original, "group")):
@@ -512,7 +512,11 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
             if not cm:
                 raise SqlError(
                     f"join aggregate argument must be alias.col: {arg!r}")
-            _attr(cm.group(1), cm.group(2), agg=(fn != "count_distinct"))
+            # the geometry guard applies only to arithmetic aggregates —
+            # COUNT / COUNT(DISTINCT) over geometries match the
+            # single-table fold
+            _attr(cm.group(1), cm.group(2),
+                  agg=fn in ("sum", "avg", "min", "max"))
             items.append(
                 ("agg", out or f"{fn}({arg})", cm.group(1), cm.group(2), fn))
             continue
@@ -537,6 +541,16 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     types = {
         (alias, col): _attr(alias, col).type for alias, col in need
     }
+    # right-table columns are constant across pairs: fetch values/validity
+    # once, index [j] inside the loop
+    rcols = {}
+    for alias, col in need:
+        if alias != a1:
+            c = right.columns[col]
+            rcols[col] = (
+                c.geometries() if c.type.is_geometry else c.values,
+                c.is_valid(),
+            )
     for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql):
         n = 0 if lt is None else len(lt)
         if n == 0:
@@ -548,20 +562,27 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
                 vals_acc[(alias, col)].extend(v)
                 valid_acc[(alias, col)].extend(c.is_valid())
             else:
-                c = right.columns[col]
-                v = c.geometries()[j] if c.type.is_geometry else c.values[j]
-                vals_acc[(alias, col)].extend([v] * n)
-                valid_acc[(alias, col)].extend([bool(c.is_valid()[j])] * n)
+                rv, rvalid = rcols[col]
+                vals_acc[(alias, col)].extend([rv[j]] * n)
+                valid_acc[(alias, col)].extend([bool(rvalid[j])] * n)
+
+    def _joined_column(kc):
+        t = types[kc]
+        valid = np.asarray(valid_acc[kc], dtype=bool)
+        if t.is_geometry:
+            # GeometryColumn so count_distinct's geometries() works
+            return GeometryColumn(
+                t, np.array(vals_acc[kc], dtype=object), valid)
+        obj = t.name in ("STRING", "UUID", "BYTES")
+        return Column(
+            t,
+            np.array(vals_acc[kc], dtype=object) if obj
+            else np.asarray(vals_acc[kc]),
+            valid,
+        )
 
     joined = {
-        f"{alias}.{col}": Column(
-            types[(alias, col)],
-            np.array(vals_acc[(alias, col)], dtype=object)
-            if types[(alias, col)].is_geometry
-            or types[(alias, col)].name in ("STRING", "UUID", "BYTES")
-            else np.asarray(vals_acc[(alias, col)]),
-            np.asarray(valid_acc[(alias, col)], dtype=bool),
-        )
+        f"{alias}.{col}": _joined_column((alias, col))
         for alias, col in need
     }
     shim = _JoinedTable(joined)

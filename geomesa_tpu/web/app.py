@@ -8,6 +8,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/version
     GET    /api/schemas                          list type names
     POST   /api/schemas                          {"name": ..., "spec": ...}
+    POST   /api/sql                              {"q": "SELECT ..."} (fail-closed
+                                                 for visibility-restricted callers)
     GET    /api/schemas/{name}                   spec + row count
     PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
@@ -88,6 +90,7 @@ class GeoMesaApp:
             ("GET", r"^/api/version$", self._version),
             ("GET", r"^/api/schemas$", self._list_schemas),
             ("POST", r"^/api/schemas$", self._create_schema),
+            ("POST", r"^/api/sql$", self._sql),
             ("GET", r"^/api/schemas/([^/]+)$", self._get_schema),
             ("PATCH", r"^/api/schemas/([^/]+)$", self._update_schema),
             ("DELETE", r"^/api/schemas/([^/]+)$", self._delete_schema),
@@ -193,6 +196,29 @@ class GeoMesaApp:
 
     def _list_schemas(self, params, body):
         return 200, {"schemas": self.store.list_schemas()}, "application/json"
+
+    def _sql(self, params, body):
+        # fail-closed: the SQL engine's join device path reads store tables
+        # directly, so row visibility is NOT applied inside sql(); a caller
+        # whose auths restrict them to a subset must be refused rather than
+        # silently over-served (same stance as security/auth.py providers)
+        if params.get("__auths__") is not None:
+            raise _HttpError(
+                403, "SQL does not apply row visibility; restricted "
+                "callers are refused (fail-closed)")
+        if not body or not body.get("q"):
+            raise _HttpError(400, "body must be {\"q\": \"SELECT ...\"}")
+        from geomesa_tpu.sql.engine import SqlError, sql as _run_sql
+
+        try:
+            res = _run_sql(self.store, str(body["q"]))
+        except SqlError as e:
+            raise _HttpError(400, f"sql error: {e}")
+        names = list(res.columns)
+        return 200, {
+            "columns": names,
+            "rows": [[_jsonable(v) for v in row] for row in res.rows()],
+        }, "application/json"
 
     def _create_schema(self, params, body):
         if not body or "name" not in body or "spec" not in body:

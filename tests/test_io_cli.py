@@ -265,6 +265,17 @@ class TestCLI:
         run_cli("stats-count", "-c", cat, "-n", "gdelt")
         assert capsys.readouterr().out.strip() == "20"
 
+        run_cli("sql", "-c", cat, "-q", "SELECT COUNT(*) AS n FROM gdelt")
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out[0] == "n" and out[1] == "20"
+
+        run_cli("sql", "-c", cat, "--format", "json",
+                "-q", "SELECT actor1Name, COUNT(*) AS n FROM gdelt "
+                      "GROUP BY actor1Name LIMIT 2")
+        jlines = [json.loads(x) for x in
+                  capsys.readouterr().out.strip().splitlines()]
+        assert jlines and all("actor1Name" in r and "n" in r for r in jlines)
+
         run_cli("stats-top-k", "-c", cat, "-n", "gdelt", "-a", "actor1Name", "-k", "3")
         assert "UNITED STATES" in capsys.readouterr().out
 

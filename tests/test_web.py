@@ -166,6 +166,33 @@ class TestQueryAndStats:
         assert out["counts"][1] == 50
         assert 0 < out["counts"][0] <= 50
 
+    def test_sql_endpoint(self, app):
+        _ingest(app)
+        status, out = jcall(app, "POST", "/api/sql", body={
+            "q": "SELECT name, COUNT(*) AS n FROM pts GROUP BY name"})
+        assert status == 200
+        assert out["columns"] == ["name", "n"]
+        assert sorted(r[0] for r in out["rows"]) == ["n0", "n1", "n2", "n3"]
+        assert sum(r[1] for r in out["rows"]) == 50
+        status, out = jcall(app, "POST", "/api/sql", body={"q": "SELEC x"})
+        assert status == 400 and "sql error" in out["error"]
+        status, _ = jcall(app, "POST", "/api/sql", body={})
+        assert status == 400
+
+    def test_sql_endpoint_fails_closed_for_restricted_callers(self):
+        from geomesa_tpu.security.auth import HeaderAuthorizationsProvider
+        from geomesa_tpu.web import GeoMesaApp
+
+        ds = DataStore(backend="tpu")
+        app2 = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
+        # with an auth provider every caller is visibility-scoped (absent
+        # header = NO auths, never unrestricted) — SQL must refuse rather
+        # than over-serve, since the engine reads store tables directly
+        status, out = jcall(app2, "POST", "/api/sql",
+                            body={"q": "SELECT COUNT(*) FROM pts"})
+        assert status == 403
+        assert "fail-closed" in out["error"]
+
     def test_query_invalid_cql(self, app):
         _ingest(app)
         status, out = jcall(app, "GET", "/api/schemas/pts/query", "cql=NOT%20VALID(")
