@@ -306,11 +306,8 @@ def cmd_sql(args):
         raise SystemExit(f"sql error: {e}")
     names = list(res.columns)
     if args.format == "json":
-        for row in res.rows():
-            print(_json.dumps(
-                {k: (v.item() if hasattr(v, "item") else v)
-                 for k, v in zip(names, row)},
-                default=str))
+        for row in res.rows():  # rows() already unwraps np.generic
+            print(_json.dumps(dict(zip(names, row)), default=str))
         return
     import csv as _csv
 

@@ -206,18 +206,25 @@ class GeoMesaApp:
             raise _HttpError(
                 403, "SQL does not apply row visibility; restricted "
                 "callers are refused (fail-closed)")
-        if not body or not body.get("q"):
+        if not isinstance(body, dict) or not body.get("q"):
             raise _HttpError(400, "body must be {\"q\": \"SELECT ...\"}")
+        from geomesa_tpu.geometry.types import Geometry
+        from geomesa_tpu.geometry.wkt import to_wkt
         from geomesa_tpu.sql.engine import SqlError, sql as _run_sql
 
         try:
             res = _run_sql(self.store, str(body["q"]))
         except SqlError as e:
             raise _HttpError(400, f"sql error: {e}")
-        names = list(res.columns)
+
+        def _cell(v):
+            # geometry-typed projections serialize as WKT (the engine's own
+            # convention for geometry-valued UDF results)
+            return to_wkt(v) if isinstance(v, Geometry) else _jsonable(v)
+
         return 200, {
-            "columns": names,
-            "rows": [[_jsonable(v) for v in row] for row in res.rows()],
+            "columns": list(res.columns),
+            "rows": [[_cell(v) for v in row] for row in res.rows()],
         }, "application/json"
 
     def _create_schema(self, params, body):

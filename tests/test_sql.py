@@ -291,6 +291,22 @@ class TestSpatialJoin:
             assert got[z][0] == len(idx)
             assert got[z][1] == pytest.approx(sum(vals) / len(vals))
 
+    def test_join_group_by_count_only_fast_path(self, join_ds):
+        # no left columns + no WHERE → the device join yields match counts
+        # without materializing rows; results must equal the full fold
+        r = sql(
+            join_ds,
+            "SELECT b.zone, COUNT(*) AS n FROM pts a "
+            "JOIN zones b ON ST_Within(a.geom, b.geom) GROUP BY b.zone",
+        )
+        truth = self._truth(join_ds, self.ZONES)
+        got = dict(r.rows())
+        for z, idx in truth.items():
+            if idx:
+                assert got[z] == len(idx)
+            else:
+                assert z not in got
+
     def test_join_group_by_null_handling(self):
         # NULL values must not pollute aggregates (sentinel-zero bug class)
         # nor conflate with real zeros — same mask semantics as the
