@@ -16,7 +16,8 @@ Supported grammar:
     SELECT <alias.col|alias.*|agg, ...> FROM <t1> <a> JOIN <t2> <b>
       ON ST_Within|ST_Contains|ST_Intersects(<alias.geom>, <alias.geom>)
       [WHERE <left-alias predicates>]
-      [GROUP BY <alias.col, ...>] [LIMIT <n>]
+      [GROUP BY <alias.col, ...>] [HAVING agg(alias.col|*) <op> number]
+      [ORDER BY <name> [ASC|DESC], ...] [LIMIT <n>]
 
     item      := * | col | agg | fn(col) [AS alias]
     agg       := COUNT(*) | COUNT(col) | COUNT(DISTINCT col)
@@ -82,7 +83,7 @@ _CLAUSES = re.compile(
     re.IGNORECASE | re.DOTALL,
 )
 _HAVING = re.compile(
-    r"^\s*(?P<expr>\w+\s*\(\s*(?:\*|\w+)\s*\))\s*(?P<op><>|<=|>=|=|<|>)\s*"
+    r"^\s*(?P<expr>\w+\s*\(\s*(?:\*|[\w.]+)\s*\))\s*(?P<op><>|<=|>=|=|<|>)\s*"
     r"(?P<lit>-?\d+(?:\.\d+)?)\s*$"
 )
 
@@ -352,6 +353,8 @@ _JOIN = re.compile(
     r"(?P<xa>\w+)\.(?P<xc>\w+)\s*,\s*(?P<ya>\w+)\.(?P<yc>\w+)\s*\)"
     r"(?:\s+where\s+(?P<where>.+?))?"
     r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+having\s+(?P<having>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
@@ -470,8 +473,10 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     joined relation ("points per zone"). The reference composes these
     freely through Spark Catalyst (`geomesa-spark-sql/.../SQLRules.scala`);
     here the join scan stays index-pruned and only the group keys and
-    aggregate argument columns are materialized. HAVING/ORDER BY are not
-    part of the join grammar (LIMIT bounds output groups)."""
+    aggregate argument columns are materialized. HAVING filters groups
+    through the shared _having_parts/_agg_value pair; ORDER BY sorts the
+    grouped OUTPUT columns (select-list names); LIMIT bounds output
+    groups after any sort."""
     from geomesa_tpu.schema.columnar import Column, GeometryColumn
 
     gcols: list[tuple[str, str]] = []
@@ -537,6 +542,19 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
                 f"{expr!r}")
         items.append(("key", out or expr, cm.group(1), cm.group(2), None))
 
+    having = _clause(m, original, "having")
+    hit = hop = hlit = None
+    if having:
+        hit, hop, hlit = _having_parts(having)
+        if hit.arg != "*":
+            hm2 = re.match(r"^(\w+)\.(\w+)$", hit.arg)
+            if not hm2:
+                raise SqlError(
+                    f"join HAVING argument must be alias.col: {hit.arg!r}")
+            _attr(hm2.group(1), hm2.group(2),
+                  agg=hit.fn in ("sum", "avg", "min", "max"))
+    order = _parse_order(m.group("order"), dotted=True)
+
     limit = int(m.group("limit")) if m.group("limit") else None
     right = ds.query(m.group("t2"), None).table
     rgeoms = right.geom_column().geometries()
@@ -545,7 +563,10 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     # validity, so sentinel-valued NULLs neither pollute aggregates nor
     # conflate with real zeros in group keys
     need = list(dict.fromkeys(
-        gcols + [(al, c) for k, _, al, c, _ in items if k == "agg" and al]))
+        gcols
+        + [(al, c) for k, _, al, c, _ in items if k == "agg" and al]
+        + ([tuple(hit.arg.split(".", 1))]
+           if hit is not None and hit.arg != "*" else [])))
     vals_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
     valid_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
     types = {
@@ -610,7 +631,20 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
             vals_acc[kc][i] if valid_acc[kc][i] else None for kc in gcols
         ))
     gkeys, groups = _group_first_occurrence(keys)
-    if limit is not None:
+    if hit is not None:
+        kept = [
+            (k, g) for k, g in zip(gkeys, groups)
+            if _having_passes(
+                hit, hop, hlit,
+                _agg_value(hit.fn, hit.arg, shim,
+                           np.asarray(g, dtype=np.int64)),
+            )
+        ]
+        gkeys = [k for k, _ in kept]
+        groups = [g for _, g in kept]
+    if limit is not None and not order:
+        # truncation before aggregation is only sound when no sort can
+        # reorder groups afterwards (HAVING already filtered above)
         gkeys, groups = gkeys[:limit], groups[:limit]
     cols: dict[str, np.ndarray] = {}
     for kind, name, alias, col, fn in items:
@@ -626,7 +660,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
             ],
             dtype=object,
         )
-    return SqlResult(cols)
+    return _apply_order_limit(SqlResult(cols), order, limit)
 
 
 def _sql_join(ds, m, original: str | None = None) -> SqlResult:
@@ -681,6 +715,9 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
         return _join_grouped_fold(
             ds, m, original, t1, a1, sft1, a2, sft2, left_pred, base_cql
         )
+    if m.group("having"):
+        raise SqlError("HAVING requires GROUP BY")
+    order = _parse_order(m.group("order"), dotted=True)
 
     # select items: alias.col or alias.* (duplicates collapse, order kept)
     items: list[tuple[str, str]] = []
@@ -703,6 +740,9 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     expanded = list(dict.fromkeys(expanded))
 
     limit = int(m.group("limit")) if m.group("limit") else None
+    # a sort reorders rows: streaming early-exit on LIMIT is only sound
+    # without ORDER BY (limit then applies after the sort instead)
+    stream_limit = None if order else limit
     right = ds.query(t2, None).table
     rgeoms = right.geom_column().geometries()
 
@@ -714,8 +754,8 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
         n = 0 if lt is None else len(lt)
         if n == 0:
             continue
-        if limit is not None:
-            n = min(n, limit - total)
+        if stream_limit is not None:
+            n = min(n, stream_limit - total)
         for alias, col in expanded:
             key = f"{alias}.{col}"
             if alias == a1:
@@ -729,16 +769,38 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
                 )
                 out[key].extend([v] * n)
         total += n
-        if limit is not None and total >= limit:
+        if stream_limit is not None and total >= stream_limit:
             break
-    return SqlResult(
-        {k: np.asarray(v, dtype=object) for k, v in out.items()}
+    return _apply_order_limit(
+        SqlResult({k: np.asarray(v, dtype=object) for k, v in out.items()}),
+        order, limit if order else None,
     )
 
 
 _MESH_AGG_TYPES = (
     "Integer", "Long", "Float", "Double", "Boolean", "Date",
 )
+
+
+def _parse_order(text: str | None, dotted: bool = False):
+    """ORDER BY clause → [(name, desc)] or None; ``dotted`` admits
+    alias-qualified names (the join grammar). One parser for every path —
+    the single-table and join grammars must not drift."""
+    if not text:
+        return None
+    pat = r"^([\w.]+)(?:\s+(asc|desc))?$" if dotted else \
+        r"^(\w+)(?:\s+(asc|desc))?$"
+    order = []
+    for part in _split_top(text):
+        om = re.match(pat, part.strip(), re.IGNORECASE)
+        if not om:
+            raise SqlError(f"unsupported ORDER BY {part!r}")
+        order.append(
+            (om.group(1), bool(om.group(2) and om.group(2).lower() == "desc"))
+        )
+    if not order:
+        raise SqlError(f"unsupported ORDER BY {text!r}")
+    return order
 
 
 def _having_parts(having: str):
@@ -916,20 +978,7 @@ def sql(ds, statement: str) -> SqlResult:
     group_by = [g.strip() for g in group_raw.split(",")] if group_raw else None
     limit = int(m.group("limit")) if m.group("limit") else None
     offset = int(m.group("offset")) if m.group("offset") else 0
-    order = None
-    if m.group("order"):
-        order = []
-        for part in _split_top(m.group("order")):
-            om = re.match(
-                r"^(\w+)(?:\s+(asc|desc))?$", part.strip(), re.IGNORECASE
-            )
-            if not om:
-                raise SqlError(f"unsupported ORDER BY {part!r}")
-            order.append(
-                (om.group(1), bool(om.group(2) and om.group(2).lower() == "desc"))
-            )
-        if not order:
-            raise SqlError(f"unsupported ORDER BY {m.group('order')!r}")
+    order = _parse_order(m.group("order"))
 
     cql = _rewrite_where(where) if where else None
     has_agg = any(i.kind == "agg" for i in items)

@@ -291,6 +291,32 @@ class TestSpatialJoin:
             assert got[z][0] == len(idx)
             assert got[z][1] == pytest.approx(sum(vals) / len(vals))
 
+    def test_join_group_by_having_order(self, join_ds):
+        truth = self._truth(join_ds, self.ZONES)
+        counts = {z: len(v) for z, v in truth.items() if v}
+        floor = sorted(counts.values())[0]
+        r = sql(
+            join_ds,
+            "SELECT b.zone, COUNT(*) AS n FROM pts a "
+            "JOIN zones b ON ST_Within(a.geom, b.geom) GROUP BY b.zone "
+            f"HAVING COUNT(*) > {floor} ORDER BY n DESC",
+        )
+        rows = r.rows()
+        want = sorted(
+            ((z, n) for z, n in counts.items() if n > floor),
+            key=lambda t: -t[1],
+        )
+        assert [n for _, n in rows] == [n for _, n in want]
+        assert {z for z, _ in rows} == {z for z, _ in want}
+        # HAVING over a left-alias aggregate not in the select list
+        r2 = sql(
+            join_ds,
+            "SELECT b.zone FROM pts a JOIN zones b "
+            "ON ST_Within(a.geom, b.geom) GROUP BY b.zone "
+            "HAVING AVG(a.val) >= 0",
+        )
+        assert set(r2.columns["b.zone"]) == set(counts)
+
     def test_join_group_by_count_only_fast_path(self, join_ds):
         # no left columns + no WHERE → the device join yields match counts
         # without materializing rows; results must equal the full fold
@@ -329,6 +355,27 @@ class TestSpatialJoin:
                     "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
         (zone, n, nv, s, m, d), = r.rows()
         assert (zone, n, nv, s, m, d) == ("all", 3, 2, 12.0, 6.0, 2)
+
+    def test_join_flat_order_by(self, join_ds):
+        r = sql(
+            join_ds,
+            "SELECT a.name, b.zone FROM pts a JOIN zones b "
+            "ON ST_Within(a.geom, b.geom) ORDER BY a.name LIMIT 5",
+        )
+        names = list(r.columns["a.name"])
+        assert len(names) == 5 and names == sorted(names)
+        # a full unsorted run must contain the same first-5 when sorted
+        full = sql(
+            join_ds,
+            "SELECT a.name FROM pts a JOIN zones b "
+            "ON ST_Within(a.geom, b.geom)",
+        )
+        assert names == sorted(full.columns["a.name"])[:5]
+
+    def test_join_having_without_group_rejected(self, join_ds):
+        with pytest.raises(SqlError, match="HAVING requires GROUP BY"):
+            sql(join_ds, "SELECT a.name FROM pts a JOIN zones b "
+                         "ON ST_Within(a.geom, b.geom) HAVING COUNT(*) > 1")
 
     def test_join_group_by_errors(self, join_ds):
         with pytest.raises(SqlError, match="GROUP BY key"):
