@@ -356,6 +356,31 @@ class TestSpatialJoin:
         (zone, n, nv, s, m, d), = r.rows()
         assert (zone, n, nv, s, m, d) == ("all", 3, 2, 12.0, 6.0, 2)
 
+    def test_join_group_by_over_merged_view(self):
+        # federated "points per zone": events split across two members,
+        # zones data on one (schema on all — the reference's intersection
+        # semantics, index/view/package.scala getTypeNames)
+        from geomesa_tpu.geometry.types import Polygon
+        from geomesa_tpu.store.merged import MergedDataStoreView
+
+        a = DataStore(backend="oracle")
+        b = DataStore(backend="oracle")
+        for ds_, lo in ((a, 0), (b, 5)):
+            ds_.create_schema("fev", "name:String,*geom:Point")
+            ds_.write("fev", [
+                {"name": f"m{lo + i}", "geom": Point(lo + i + 0.5, 1)}
+                for i in range(5)
+            ])
+            ds_.create_schema("fz", "zone:String,*geom:Polygon")
+        a.write("fz", [
+            {"zone": "west", "geom": Polygon([[0, 0], [5, 0], [5, 2], [0, 2]])},
+            {"zone": "east", "geom": Polygon([[5, 0], [10, 0], [10, 2], [5, 2]])},
+        ], fids=["w", "e"])
+        view = MergedDataStoreView([a, b])
+        r = sql(view, "SELECT b.zone, COUNT(*) AS n FROM fev a JOIN fz b "
+                      "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
+        assert dict(r.rows()) == {"west": 5, "east": 5}
+
     def test_join_flat_order_by(self, join_ds):
         r = sql(
             join_ds,
