@@ -20,7 +20,29 @@ from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType
 from geomesa_tpu.store.datastore import QueryResult
 
-__all__ = ["MergedDataStoreView"]
+__all__ = ["MergedDataStoreView", "intersection_schema", "intersection_schemas"]
+
+
+def intersection_schema(stores, name: str) -> FeatureType:
+    """The shared multi-store schema contract (the reference's
+    ``MergedDataStoreSchemas`` trait): a type must exist on every member
+    with the same attribute layout. Used by the merged AND routed views —
+    schema-compat rules must not drift between them."""
+    sft = stores[0].get_schema(name)
+    for s in stores[1:]:
+        other = s.get_schema(name)
+        if [a.name for a in other.attributes] != [
+            a.name for a in sft.attributes
+        ]:
+            raise ValueError(f"schema mismatch across stores for {name!r}")
+    return sft
+
+
+def intersection_schemas(stores) -> list[str]:
+    names = set(stores[0].list_schemas())
+    for s in stores[1:]:
+        names &= set(s.list_schemas())
+    return sorted(names)
 
 
 class MergedDataStoreView:
@@ -40,18 +62,10 @@ class MergedDataStoreView:
             self.stores.append((store, scope))
 
     def get_schema(self, name: str) -> FeatureType:
-        sft = self.stores[0][0].get_schema(name)
-        for s, _ in self.stores[1:]:
-            other = s.get_schema(name)
-            if [a.name for a in other.attributes] != [a.name for a in sft.attributes]:
-                raise ValueError(f"schema mismatch across stores for {name!r}")
-        return sft
+        return intersection_schema([s for s, _ in self.stores], name)
 
     def list_schemas(self) -> list[str]:
-        names = set(self.stores[0][0].list_schemas())
-        for s, _ in self.stores[1:]:
-            names &= set(s.list_schemas())
-        return sorted(names)
+        return intersection_schemas([s for s, _ in self.stores])
 
     def query(self, type_name: str, q: "Query | str | ast.Filter | None" = None, **kwargs) -> QueryResult:
         sft = self.get_schema(type_name)

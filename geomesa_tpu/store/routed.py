@@ -1,0 +1,167 @@
+"""Routed querying across multiple stores: each query goes to exactly ONE
+delegate, selected by the attributes its filter references.
+
+Role parity: ``geomesa-index-api/.../index/view/RoutedDataStoreView.scala:31``
++ ``RouteSelectorByAttribute.scala:20`` (SURVEY.md §2.3): unlike the
+fan-out-and-merge :class:`~geomesa_tpu.store.merged.MergedDataStoreView`,
+a routed view sends the whole query to the single store whose declared
+route matches — e.g. id lookups to a key-value-shaped store, bbox+time
+scans to the Z3-indexed store. A query matching no route returns an empty
+result (the reference's ``EmptySimpleFeatureReader``).
+
+Route declarations per store (mirroring ``geomesa.route.attributes``):
+
+- ``"id"`` — the store serving feature-id lookups
+- ``[attr, ...]`` — a route matching filters that reference AT LEAST this
+  attribute set (``routes.forall(names.contains)`` in the reference)
+- ``[]`` — the include/catch-all store (filters referencing no attributes,
+  or no other route matching)
+
+Schema semantics are the merged view's (the reference subclasses
+``MergedDataStoreSchemas``): a type must exist on every member with the
+same attribute layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.schema.sft import FeatureType
+from geomesa_tpu.store.datastore import QueryResult
+from geomesa_tpu.store.merged import intersection_schema, intersection_schemas
+
+__all__ = ["RoutedDataStoreView", "filter_properties"]
+
+
+def filter_properties(f: "ast.Filter | None") -> tuple[set[str], bool]:
+    """(attribute names referenced, has-id-filter) for a filter AST — the
+    ``FilterHelper.propertyNames`` / ``hasIdFilter`` role."""
+    names: set[str] = set()
+    has_fid = False
+
+    def walk(n):
+        nonlocal has_fid
+        if n is None:
+            return
+        if isinstance(n, ast.FidIn):
+            has_fid = True
+            return
+        p = getattr(n, "prop", None)
+        if isinstance(p, str):
+            names.add(p)
+        for c in getattr(n, "children", ()) or ():
+            walk(c)
+        c = getattr(n, "child", None)
+        if isinstance(c, ast.Filter):
+            walk(c)
+
+    walk(f)
+    return names, has_fid
+
+
+class RoutedDataStoreView:
+    """Route-per-query view over ``[(store, routes), ...]``.
+
+    ``routes``: an iterable whose elements are ``"id"``, a list of
+    attribute names (one route), or ``[]`` (the include/catch-all) —
+    several elements declare several routes for the same store.
+    """
+
+    def __init__(self, stores):
+        if not stores:
+            raise ValueError("routed view needs at least one store")
+        self.stores = [s for s, _ in stores]
+        self._mappings: list[tuple[frozenset, object]] = []
+        self._id_store = None
+        self._include = None
+        seen: set[frozenset] = set()
+        for store, routes in stores:
+            if isinstance(routes, str):
+                # a bare string would iterate character-by-character into
+                # bogus single-letter routes — the docstring's contract is
+                # a LIST of route declarations
+                raise ValueError(
+                    f"routes must be a list of declarations, got {routes!r} "
+                    "(did you mean [\"id\"]?)")
+            for r in routes:
+                if isinstance(r, str):
+                    if r.lower() == "id":
+                        if self._id_store is not None:
+                            raise ValueError(
+                                "'id' route is defined more than once")
+                        self._id_store = store
+                        continue
+                    key = frozenset((r,))
+                elif len(r) == 0:
+                    if self._include is not None:
+                        raise ValueError(
+                            "include route is defined more than once")
+                    self._include = store
+                    continue
+                else:
+                    key = frozenset(r)
+                if key in seen:
+                    raise ValueError(
+                        f"route {sorted(key)} is defined more than once")
+                seen.add(key)
+                self._mappings.append((key, store))
+        # most-specific route wins regardless of declaration order: a
+        # {geom} route must not shadow a {geom, dtg} route for a
+        # spatio-temporal query (stable for equal sizes)
+        self._mappings.sort(key=lambda kv: -len(kv[0]))
+
+    # -- schemas: the merged view's semantics (shared helpers) ---------------
+    def get_schema(self, name: str) -> FeatureType:
+        return intersection_schema(self.stores, name)
+
+    def list_schemas(self) -> list[str]:
+        return intersection_schemas(self.stores)
+
+    # -- routing -------------------------------------------------------------
+    def route(self, f: "ast.Filter | None"):
+        """The store serving this filter, or None (no matching route)."""
+        names, has_fid = filter_properties(f)
+
+        def by_attributes():
+            if not names:
+                return None
+            for key, store in self._mappings:
+                if key <= names:
+                    return store
+            return None
+
+        if has_fid and self._id_store is not None:
+            return self._id_store
+        return by_attributes() or self._include
+
+    def query(self, type_name: str, q=None, **kwargs) -> QueryResult:
+        if isinstance(q, (str, ast.Filter)) or q is None:
+            q = Query(filter=q, **kwargs)
+        store = self.route(q.resolved_filter())
+        if store is None:
+            # only the empty-result branch needs the (cross-validated)
+            # view schema; the delegate validates its own on the happy path
+            empty = FeatureTable.from_records(self.get_schema(type_name), [])
+            return QueryResult(empty, np.empty(0, dtype=np.int64))
+        return store.query(type_name, q)
+
+    def stats_count(self, type_name: str, cql=None, exact: bool = False):
+        from geomesa_tpu.filter.cql import parse
+
+        f = parse(cql) if isinstance(cql, str) else cql
+        store = self.route(f)
+        if store is None:
+            return 0
+        return store.stats_count(type_name, cql, exact=exact)
+
+    def explain(self, type_name: str, q=None) -> str:
+        if isinstance(q, (str, ast.Filter)) or q is None:
+            q = Query(filter=q)
+        store = self.route(q.resolved_filter())
+        if store is None:
+            return "Route: none (empty result)"
+        idx = self.stores.index(store)
+        return f"Route: store[{idx}]\n" + store.explain(type_name, q)

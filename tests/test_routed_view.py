@@ -1,0 +1,126 @@
+"""Routed view: each query goes to exactly ONE delegate store picked by the
+filter's attribute set (reference: RoutedDataStoreView.scala:31 +
+RouteSelectorByAttribute.scala:20 — id route, attribute routes, include
+catch-all, no-route → empty result)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.cql import parse
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.routed import RoutedDataStoreView, filter_properties
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point"
+
+
+def _store(tag: str, n: int = 10) -> DataStore:
+    ds = DataStore(backend="oracle")
+    ds.create_schema("ev", SPEC)
+    ds.write("ev", [
+        {"name": f"{tag}{i}", "age": i, "dtg": 1_500_000_000_000 + i,
+         "geom": Point(i, i)}
+        for i in range(n)
+    ], fids=[f"{tag}{i}" for i in range(n)])
+    return ds
+
+
+class TestFilterProperties:
+    def test_names_and_fid(self):
+        names, fid = filter_properties(parse(
+            "BBOX(geom, 0, 0, 5, 5) AND age > 3"))
+        assert names == {"geom", "age"} and not fid
+        names, fid = filter_properties(parse("IN ('a1', 'a2')"))
+        assert names == set() and fid
+        assert filter_properties(None) == (set(), False)
+
+    def test_nested(self):
+        names, fid = filter_properties(parse(
+            "NOT (name LIKE 'x%') OR (age < 2 AND IN ('f'))"))
+        assert names == {"name", "age"} and fid
+
+
+class TestRoutedView:
+    @pytest.fixture()
+    def view(self):
+        spatial = _store("s")
+        ids = _store("i")
+        catchall = _store("c")
+        return (
+            RoutedDataStoreView([
+                (spatial, [["geom", "dtg"], ["geom"]]),
+                (ids, ["id"]),
+                (catchall, [["name"], []]),
+            ]),
+            spatial, ids, catchall,
+        )
+
+    def test_attribute_routes(self, view):
+        v, spatial, ids, catchall = view
+        r = v.query("ev", "BBOX(geom, -1, -1, 3, 3) AND dtg AFTER 2017-01-01T00:00:00Z")
+        assert all(f.startswith("s") for f in r.table.fids)
+        r = v.query("ev", "BBOX(geom, -1, -1, 3, 3)")
+        assert all(f.startswith("s") for f in r.table.fids)
+        r = v.query("ev", "name = 'c4'")
+        assert list(r.table.fids) == ["c4"]
+
+    def test_id_route(self, view):
+        v, *_ = view
+        r = v.query("ev", "IN ('i1', 'i7')")
+        assert sorted(r.table.fids) == ["i1", "i7"]
+
+    def test_include_catchall(self, view):
+        v, _, _, catchall = view
+        # filter referencing no routed attribute set and no names at all
+        r = v.query("ev", None)
+        assert all(f.startswith("c") for f in r.table.fids)
+        # age-only filter matches no route -> include store serves it
+        r = v.query("ev", "age > 7")
+        assert all(f.startswith("c") for f in r.table.fids)
+
+    def test_no_route_empty(self):
+        spatial = _store("s")
+        v = RoutedDataStoreView([(spatial, [["geom"]])])
+        r = v.query("ev", "age > 3")  # no attribute match, no include
+        assert r.count == 0 and len(r.table) == 0
+
+    def test_stats_count_and_explain(self, view):
+        v, *_ = view
+        assert v.stats_count("ev", "BBOX(geom, -1, -1, 3, 3)") > 0
+        assert v.explain("ev", "BBOX(geom, -1, -1, 3, 3)").startswith(
+            "Route: store[0]")
+        assert v.explain("ev", Query()).startswith(
+            "Route: store[2]")  # no names -> the include store serves it
+
+    def test_specific_route_wins_regardless_of_order(self):
+        # a {geom} route declared FIRST must not shadow {geom, dtg}
+        a, b = _store("a"), _store("b")
+        v = RoutedDataStoreView([(a, [["geom"]]), (b, [["geom", "dtg"]])])
+        r = v.query(
+            "ev", "BBOX(geom, -1, -1, 3, 3) AND dtg AFTER 2017-01-01T00:00:00Z")
+        assert all(f.startswith("b") for f in r.table.fids)
+        r = v.query("ev", "BBOX(geom, -1, -1, 3, 3)")
+        assert all(f.startswith("a") for f in r.table.fids)
+
+    def test_bare_string_routes_rejected(self):
+        a = _store("a")
+        with pytest.raises(ValueError, match="list of declarations"):
+            RoutedDataStoreView([(a, "id")])
+
+    def test_duplicate_routes_rejected(self):
+        a, b = _store("a"), _store("b")
+        with pytest.raises(ValueError, match="more than once"):
+            RoutedDataStoreView([(a, [["geom"]]), (b, [["geom"]])])
+        with pytest.raises(ValueError, match="'id' route"):
+            RoutedDataStoreView([(a, ["id"]), (b, ["id"])])
+        with pytest.raises(ValueError, match="include route"):
+            RoutedDataStoreView([(a, [[]]), (b, [[]])])
+
+    def test_schema_semantics(self):
+        a, b = _store("a"), _store("b")
+        v = RoutedDataStoreView([(a, [["geom"]]), (b, [[]])])
+        assert v.list_schemas() == ["ev"]
+        assert [x.name for x in v.get_schema("ev").attributes] == [
+            "name", "age", "dtg", "geom"
+        ]
