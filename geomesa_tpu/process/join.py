@@ -17,7 +17,8 @@ from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
 
 
-def join_scan(ds, type_name: str, geoms, pred: str = "within", filter=None):
+def join_scan(ds, type_name: str, geoms, pred: str = "within", filter=None,
+              auths=None):
     """Per-geometry index-planned scans: yields (geom_index, result table).
 
     The shared core of the exact join paths (JoinProcess and the SQL
@@ -25,6 +26,7 @@ def join_scan(ds, type_name: str, geoms, pred: str = "within", filter=None):
     query of the left store — Z/XZ ranges + residual — never a cartesian
     pass. ``pred`` is the predicate applied to the LEFT geometry column
     (within/contains/intersects); ``None`` geometries yield empty results.
+    ``auths`` scopes every planned query to the caller's row visibility.
     """
     sft = ds.get_schema(type_name)
     base = None
@@ -39,7 +41,7 @@ def join_scan(ds, type_name: str, geoms, pred: str = "within", filter=None):
         f = ast.SpatialOp(pred, sft.geom_field, g)
         if base is not None:
             f = ast.And([f, base])
-        yield i, ds.query(type_name, Query(filter=f)).table
+        yield i, ds.query(type_name, Query(filter=f, auths=auths)).table
 
 
 def join_within(ds, type_name: str, polygons, filter=None):

@@ -382,7 +382,7 @@ def _map_unquoted(s: str, fn) -> str:
 
 
 def _join_pairs(ds, t1: str, rgeoms, left_pred: str, base_cql,
-                count_only: bool = False):
+                count_only: bool = False, auths=None):
     """Join executor: the DISTRIBUTED mesh path when it applies, else the
     per-geometry index-planned host scan.
 
@@ -412,7 +412,9 @@ def _join_pairs(ds, t1: str, rgeoms, left_pred: str, base_cql,
     # merged views / remote stores lack the device machinery entirely —
     # an explicit structural test, not exception-driven (a broad
     # AttributeError catch would also swallow genuine bugs)
-    if hasattr(ds, "_state") and hasattr(ds, "backend"):
+    # the device gather reads store tables directly and cannot apply row
+    # visibility — restricted callers take the auths-aware host scan
+    if auths is None and hasattr(ds, "_state") and hasattr(ds, "backend"):
         try:
             main, pairs = join_rows_device(ds, t1, rgeoms, left_pred)
         except ValueError:
@@ -424,7 +426,8 @@ def _join_pairs(ds, t1: str, rgeoms, left_pred: str, base_cql,
             ds.metrics.counter("store.query.device_failovers").inc()
             pairs = None
     if pairs is None:
-        yield from join_scan(ds, t1, rgeoms, left_pred, base_cql)
+        yield from join_scan(ds, t1, rgeoms, left_pred, base_cql,
+                             auths=auths)
         return
     ds._note_device_ok()
     for i, rows in pairs:
@@ -467,7 +470,7 @@ def _group_first_occurrence(keys):
 
 
 def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
-                       left_pred, base_cql) -> SqlResult:
+                       left_pred, base_cql, auths=None) -> SqlResult:
     """``JOIN ... GROUP BY``: first-occurrence host fold over the streamed
     join pairs — the single-table host fold's semantics applied to the
     joined relation ("points per zone"). The reference composes these
@@ -556,7 +559,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     order = _parse_order(m.group("order"), dotted=True)
 
     limit = int(m.group("limit")) if m.group("limit") else None
-    right = ds.query(m.group("t2"), None).table
+    right = ds.query(m.group("t2"), Query(auths=auths)).table
     rgeoms = right.geom_column().geometries()
 
     # stream pairs, materializing only the needed columns — values AND
@@ -586,7 +589,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     # the device join need only return match counts, never the rows
     count_only = base_cql is None and all(alias != a1 for alias, _ in need)
     for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql,
-                             count_only=count_only):
+                             count_only=count_only, auths=auths):
         if lt is None:
             continue
         n = lt if isinstance(lt, int) else len(lt)
@@ -663,7 +666,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     return _apply_order_limit(SqlResult(cols), order, limit)
 
 
-def _sql_join(ds, m, original: str | None = None) -> SqlResult:
+def _sql_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
     """Spatial JOIN: each right-table geometry becomes an index-planned scan
     of the left table (delegating to :func:`geomesa_tpu.process.join
     .join_scan` — the JoinProcess core, never a cartesian pass), pairs
@@ -713,7 +716,8 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
 
     if m.group("group"):
         return _join_grouped_fold(
-            ds, m, original, t1, a1, sft1, a2, sft2, left_pred, base_cql
+            ds, m, original, t1, a1, sft1, a2, sft2, left_pred, base_cql,
+            auths=auths,
         )
     if m.group("having"):
         raise SqlError("HAVING requires GROUP BY")
@@ -743,14 +747,15 @@ def _sql_join(ds, m, original: str | None = None) -> SqlResult:
     # a sort reorders rows: streaming early-exit on LIMIT is only sound
     # without ORDER BY (limit then applies after the sort instead)
     stream_limit = None if order else limit
-    right = ds.query(t2, None).table
+    right = ds.query(t2, Query(auths=auths)).table
     rgeoms = right.geom_column().geometries()
 
     from geomesa_tpu.process.join import join_scan
 
     out: dict[str, list] = {f"{alias}.{col}": [] for alias, col in expanded}
     total = 0
-    for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql):
+    for j, lt in _join_pairs(ds, t1, rgeoms, left_pred, base_cql,
+                             auths=auths):
         n = 0 if lt is None else len(lt)
         if n == 0:
             continue
@@ -870,7 +875,7 @@ def _mesh_agg_cast(sft, col: str, fn: str, v):
 
 
 def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
-                    order, limit, offset: int = 0):
+                    order, limit, offset: int = 0, auths=None):
     """Route the aggregate fold to ``DataStore.aggregate_many`` (the fused
     mesh segment-reduce). Returns the assembled SqlResult, or None when the
     query cannot ride the device path — the caller's host fold serves it
@@ -905,7 +910,7 @@ def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
         if t is None or t not in (*_MESH_AGG_TYPES, "String", "UUID"):
             return None
     res = agg(
-        type_name, [Query(filter=cql)], group_by=group_by,
+        type_name, [Query(filter=cql, auths=auths)], group_by=group_by,
         value_cols=value_cols,
     )[0]
     if res is None:
@@ -959,15 +964,21 @@ def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
     return _apply_order_limit(SqlResult(cols), order, limit, offset)
 
 
-def sql(ds, statement: str) -> SqlResult:
-    """Execute a SQL statement against ``ds`` (DataStore or merged view)."""
+def sql(ds, statement: str, auths=None) -> SqlResult:
+    """Execute a SQL statement against ``ds`` (DataStore or merged view).
+
+    ``auths``: caller visibility authorizations, threaded into EVERY
+    internal store query (the serving layer's restricted callers see only
+    their rows). Paths that cannot apply row visibility — the fused mesh
+    aggregation and the device join gather — decline automatically and the
+    auths-aware host paths serve instead."""
     # clause keywords are matched on a quote-masked shadow so a WHERE
     # literal containing e.g. 'having' cannot hijack clause splitting; the
     # spans are then sliced from the original statement
     masked = _mask_quotes(statement)
     jm = _JOIN.match(masked)
     if jm:
-        return _sql_join(ds, jm, statement)
+        return _sql_join(ds, jm, statement, auths=auths)
     m = _CLAUSES.match(masked)
     if not m:
         raise SqlError(f"cannot parse: {statement!r}")
@@ -1037,7 +1048,7 @@ def sql(ds, statement: str) -> SqlResult:
                     if f not in sel and f not in props:
                         props.append(f)
         q = Query(
-            filter=cql, properties=props, sort_by=push_sort,
+            filter=cql, properties=props, sort_by=push_sort, auths=auths,
             limit=None if (distinct or post_sort or limit is None)
             else limit + offset,
         )
@@ -1108,7 +1119,8 @@ def sql(ds, statement: str) -> SqlResult:
     ):
         counter = getattr(ds, "count_many", None)
         if counter is not None:
-            n = counter(type_name, [Query(filter=cql)], loose=False)[0]
+            n = counter(
+                type_name, [Query(filter=cql, auths=auths)], loose=False)[0]
             return _apply_order_limit(
                 SqlResult({items[0].name: np.array([n], dtype=object)}),
                 None, limit, offset,
@@ -1119,12 +1131,13 @@ def sql(ds, statement: str) -> SqlResult:
     # without materializing rows; anything it declines falls through to the
     # host fold below (which also owns all validation errors)
     mesh_res = _mesh_aggregate(
-        ds, type_name, cql, items, group_by, having, order, limit, offset
+        ds, type_name, cql, items, group_by, having, order, limit, offset,
+        auths=auths,
     )
     if mesh_res is not None:
         return mesh_res
 
-    r = ds.query(type_name, Query(filter=cql))
+    r = ds.query(type_name, Query(filter=cql, auths=auths))
     t = r.table
 
     if not group_by:

@@ -44,16 +44,26 @@ class RemoteDataStore:
     remote stores uniformly.
     """
 
-    def __init__(self, base_url: str, timeout_s: float = 30.0):
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 forward_auths_header: str | None = None):
+        # forward_auths_header: name of the TRUSTED header the remote's
+        # AuthorizationsProvider is configured with (e.g.
+        # "X-Geomesa-Auths"). When set, auths-scoped queries forward the
+        # caller's auths in that header; when None (default), they FAIL
+        # CLOSED — a remote that is not enforcing visibility must never
+        # silently return unrestricted rows to a restricted caller.
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.forward_auths_header = forward_auths_header
         self._schemas: dict[str, FeatureType] = {}
 
-    def _get(self, path: str, params: dict | None = None) -> bytes:
+    def _get(self, path: str, params: dict | None = None,
+             headers: dict | None = None) -> bytes:
         url = self.base_url + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+        req = urllib.request.Request(url, headers=headers or {})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
             return r.read()
 
     def _get_json(self, path: str, params: dict | None = None):
@@ -115,7 +125,20 @@ class RemoteDataStore:
         if q.sort_by is not None:  # pages are only stable under a sort
             fld, desc = q.sort_by
             params["sortBy"] = ("-" if desc else "") + fld
-        data = self._get(f"/api/schemas/{type_name}/query", params)
+        headers = None
+        if q.auths is not None:
+            # visibility-scoped query against a remote member: forward the
+            # auths in the remote's trusted header, or fail closed — this
+            # client cannot apply row visibility to the remote's rows
+            if self.forward_auths_header is None:
+                raise PermissionError(
+                    "remote member cannot apply caller visibility; "
+                    "configure forward_auths_header to the remote's "
+                    "trusted auths header, or exclude auths-scoped "
+                    "queries from this member")
+            headers = {self.forward_auths_header: ",".join(q.auths)}
+        data = self._get(f"/api/schemas/{type_name}/query", params,
+                         headers=headers)
         table = from_ipc_bytes(self.get_schema(type_name), data)
         return QueryResult(table, np.arange(len(table)))
 

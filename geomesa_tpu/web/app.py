@@ -8,8 +8,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/version
     GET    /api/schemas                          list type names
     POST   /api/schemas                          {"name": ..., "spec": ...}
-    POST   /api/sql                              {"q": "SELECT ..."} (fail-closed
-                                                 for visibility-restricted callers)
+    POST   /api/sql                              {"q": "SELECT ..."} (caller
+                                                 auths scope every row read)
     GET    /api/schemas/{name}                   spec + row count
     PATCH  /api/schemas/{name}                   {"add"|"keywords"|"rename_to"}
     DELETE /api/schemas/{name}
@@ -198,14 +198,6 @@ class GeoMesaApp:
         return 200, {"schemas": self.store.list_schemas()}, "application/json"
 
     def _sql(self, params, body):
-        # fail-closed: the SQL engine's join device path reads store tables
-        # directly, so row visibility is NOT applied inside sql(); a caller
-        # whose auths restrict them to a subset must be refused rather than
-        # silently over-served (same stance as security/auth.py providers)
-        if params.get("__auths__") is not None:
-            raise _HttpError(
-                403, "SQL does not apply row visibility; restricted "
-                "callers are refused (fail-closed)")
         if not isinstance(body, dict) or not body.get("q"):
             raise _HttpError(400, "body must be {\"q\": \"SELECT ...\"}")
         from geomesa_tpu.geometry.types import Geometry
@@ -213,7 +205,11 @@ class GeoMesaApp:
         from geomesa_tpu.sql.engine import SqlError, sql as _run_sql
 
         try:
-            res = _run_sql(self.store, str(body["q"]))
+            # caller auths thread into EVERY internal store query; paths
+            # that cannot apply row visibility (mesh aggregation, device
+            # join gather) decline automatically inside sql()
+            res = _run_sql(self.store, str(body["q"]),
+                           auths=params.get("__auths__"))
         except SqlError as e:
             raise _HttpError(400, f"sql error: {e}")
 

@@ -40,6 +40,25 @@ def server():
 
 
 class TestWriteForwarding:
+    def test_auths_scoped_query_fails_closed_by_default(self, server):
+        # this client cannot apply row visibility to the remote's rows: an
+        # auths-scoped query must raise unless the operator declares the
+        # remote's trusted auths header (never silently over-serve)
+        from geomesa_tpu.planning.planner import Query
+        from geomesa_tpu.store.remote import RemoteDataStore
+
+        local, url = server
+        local.create_schema("fv", "name:String,*geom:Point")
+        local.write("fv", [{"name": "x", "geom": Point(1, 1)}])
+        remote = RemoteDataStore(url)
+        assert remote.query("fv", None).count == 1  # unscoped: fine
+        with pytest.raises(PermissionError, match="visibility"):
+            remote.query("fv", Query(auths=["admin"]))
+        # opt-in forwarding reaches the remote (the test server has no
+        # auth provider, so the header is ignored — transport-level check)
+        fwd = RemoteDataStore(url, forward_auths_header="X-Geomesa-Auths")
+        assert fwd.query("fv", Query(auths=["admin"])).count == 1
+
     def test_full_mutation_lifecycle(self, server):
         from geomesa_tpu.store.remote import RemoteDataStore
 

@@ -356,6 +356,41 @@ class TestSpatialJoin:
         (zone, n, nv, s, m, d), = r.rows()
         assert (zone, n, nv, s, m, d) == ("all", 3, 2, 12.0, 6.0, 2)
 
+    def test_sql_auths_scope_select_agg_and_join(self):
+        # the auths parameter threads into every path: plain select,
+        # aggregation fold, and the join (device gather declines; the
+        # host scan applies visibility per planned query)
+        from geomesa_tpu.geometry.types import Polygon
+        from geomesa_tpu.schema.columnar import FeatureTable
+        from geomesa_tpu.schema.sft import parse_spec
+
+        sft = parse_spec(
+            "vev", "dtg:Date,*geom:Point,vis:String;geomesa.vis.field='vis'"
+        )
+        ds = DataStore(backend="tpu")
+        ds.create_schema(sft)
+        recs = [
+            {"dtg": 1_500_000_000_000, "geom": Point(i, 1), "vis": v}
+            for i, v in enumerate(["admin", "", "admin", "", "secret"])
+        ]
+        ds.write("vev", FeatureTable.from_records(
+            sft, recs, [f"v{i}" for i in range(5)]))
+        ds.create_schema("vz", "zone:String,*geom:Polygon")
+        ds.write("vz", [{"zone": "all", "geom": Polygon(
+            [[-1, 0], [6, 0], [6, 2], [-1, 2]])}])
+
+        assert sql(ds, "SELECT COUNT(*) FROM vev").rows() == [(5,)]
+        assert sql(ds, "SELECT COUNT(*) FROM vev", auths=[]).rows() == [(2,)]
+        assert sql(ds, "SELECT COUNT(*) FROM vev",
+                   auths=["admin"]).rows() == [(4,)]
+        r = sql(ds, "SELECT b.zone, COUNT(*) AS n FROM vev a JOIN vz b "
+                    "ON ST_Within(a.geom, b.geom) GROUP BY b.zone",
+                auths=["admin"])
+        assert r.rows() == [("all", 4)]
+        r2 = sql(ds, "SELECT a.vis FROM vev a JOIN vz b "
+                     "ON ST_Within(a.geom, b.geom)", auths=[])
+        assert len(r2) == 2 and all(v == "" for v in r2.columns["a.vis"])
+
     def test_join_group_by_over_merged_view(self):
         # federated "points per zone": events split across two members,
         # zones data on one (schema on all — the reference's intersection

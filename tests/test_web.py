@@ -12,7 +12,7 @@ from geomesa_tpu.utils.audit import InMemoryAuditWriter
 from geomesa_tpu.web import GeoMesaApp
 
 
-def call(app, method, path, query="", body=None):
+def call(app, method, path, query="", body=None, headers=None):
     """Minimal WSGI client: returns (status_code, headers, bytes)."""
     raw = json.dumps(body).encode() if body is not None else b""
     environ = {
@@ -21,6 +21,7 @@ def call(app, method, path, query="", body=None):
         "QUERY_STRING": query,
         "CONTENT_LENGTH": str(len(raw)),
         "wsgi.input": io.BytesIO(raw),
+        **(headers or {}),
     }
     out = {}
 
@@ -32,8 +33,8 @@ def call(app, method, path, query="", body=None):
     return out["status"], out["headers"], b"".join(chunks)
 
 
-def jcall(app, method, path, query="", body=None):
-    status, _, data = call(app, method, path, query, body)
+def jcall(app, method, path, query="", body=None, headers=None):
+    status, _, data = call(app, method, path, query, body, headers)
     return status, json.loads(data) if data else None
 
 
@@ -179,19 +180,40 @@ class TestQueryAndStats:
         status, _ = jcall(app, "POST", "/api/sql", body={})
         assert status == 400
 
-    def test_sql_endpoint_fails_closed_for_restricted_callers(self):
+    def test_sql_endpoint_scopes_rows_to_caller_auths(self):
+        # caller auths thread into every internal query: a restricted
+        # caller's SQL sees ONLY their visible rows (never over-served)
+        from geomesa_tpu.schema.columnar import FeatureTable
+        from geomesa_tpu.schema.sft import parse_spec
         from geomesa_tpu.security.auth import HeaderAuthorizationsProvider
         from geomesa_tpu.web import GeoMesaApp
 
-        ds = DataStore(backend="tpu")
+        sft = parse_spec(
+            "tracks", "dtg:Date,*geom:Point,vis:String;geomesa.vis.field='vis'"
+        )
+        ds = DataStore(backend="oracle")
+        ds.create_schema(sft)
+        from geomesa_tpu.geometry import Point as _P
+
+        recs = [
+            {"dtg": 1_500_000_000_000 + i, "geom": _P(i, i), "vis": v}
+            for i, v in enumerate(["admin", "", "user|admin", "secret", ""])
+        ]
+        ds.write("tracks", FeatureTable.from_records(
+            sft, recs, [f"f{i}" for i in range(5)]))
         app2 = GeoMesaApp(ds, auth_provider=HeaderAuthorizationsProvider())
-        # with an auth provider every caller is visibility-scoped (absent
-        # header = NO auths, never unrestricted) — SQL must refuse rather
-        # than over-serve, since the engine reads store tables directly
-        status, out = jcall(app2, "POST", "/api/sql",
-                            body={"q": "SELECT COUNT(*) FROM pts"})
-        assert status == 403
-        assert "fail-closed" in out["error"]
+
+        def q(headers):
+            return jcall(app2, "POST", "/api/sql",
+                         body={"q": "SELECT COUNT(*) AS n FROM tracks"},
+                         headers=headers)
+
+        s, o = q({"HTTP_X_GEOMESA_AUTHS": "admin"})
+        assert s == 200 and o["rows"][0][0] == 4  # admin, '', user|admin, ''
+        s, o = q({})  # no header = NO auths: only unrestricted rows
+        assert s == 200 and o["rows"][0][0] == 2
+        s, o = q({"HTTP_X_GEOMESA_AUTHS": "secret"})
+        assert s == 200 and o["rows"][0][0] == 3
 
     def test_query_invalid_cql(self, app):
         _ingest(app)
