@@ -605,7 +605,7 @@ def _local_knn_heaps(x, y, true_n, qx, qy, k, ttl=None, impl=None):
     Selection: an explicit ``impl`` argument overrides the env knob;
     ``None`` defers to ``GEOMESA_KNN_IMPL``, read at TRACE time — set it
     before the first KNN call of the process (the ``cached_*`` step
-    wrappers are memoized per mesh/k and remain env-only).
+    wrappers are memoized per (mesh, k, with_ttl, impl)).
 
     ``ttl``: optional (bins, offs, cut) — rows with (bin, off)
     lexicographically BELOW cut=(cut_bin, cut_off) are TTL-expired and
@@ -779,8 +779,9 @@ def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
 
 
 @lru_cache(maxsize=None)
-def cached_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
-    return make_batched_knn_step(mesh, k, with_ttl)
+def cached_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
+                            impl: str | None = None):
+    return make_batched_knn_step(mesh, k, with_ttl, impl=impl)
 
 
 @lru_cache(maxsize=None)
@@ -908,7 +909,6 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
 
     _check_knn_impl(impl)
     n_shards = data_shards(mesh)
-    _check_knn_impl(impl)
     col_specs = (P(DATA_AXIS),) * (4 if with_ttl else 2)
     tail_specs = (P(QUERY_AXIS), P(QUERY_AXIS)) + ((P(),) if with_ttl else ())
 
@@ -951,8 +951,9 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
 
 
 @lru_cache(maxsize=None)
-def cached_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False):
-    return make_ring_knn_step(mesh, k, with_ttl)
+def cached_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
+                         impl: str | None = None):
+    return make_ring_knn_step(mesh, k, with_ttl, impl=impl)
 
 
 @lru_cache(maxsize=None)

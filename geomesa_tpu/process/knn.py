@@ -70,7 +70,8 @@ def knn(
 
 
 def knn_many(ds, type_name: str, points, k: int = 10,
-             topology: str = "gather", now_ms: int | None = None):
+             topology: str = "gather", now_ms: int | None = None,
+             impl: str | None = None):
     """Batched KNN: all query points answered in ONE device pass.
 
     Device path (TpuBackend): per-shard f32 distance scan + ``top_k``,
@@ -91,12 +92,19 @@ def knn_many(ds, type_name: str, points, k: int = 10,
     meshes × large query batches where D·k·Q pressures memory). Identical
     distances; row choice may differ where k-th distances tie.
 
+    ``impl``: per-shard sweep shape (map/scan/blocked), overriding the
+    ``GEOMESA_KNN_IMPL`` knob; ``None`` defers to it (see
+    :func:`geomesa_tpu.parallel.query._local_knn_heaps`).
+
     Returns a list of (table, distances_deg) pairs, one per query point,
     each holding that point's k nearest features sorted by distance.
     """
     if topology not in ("gather", "ring"):
         raise ValueError(f"topology must be gather|ring: {topology!r}")
+    from geomesa_tpu.parallel.query import _check_knn_impl
     from geomesa_tpu.store.backends import TpuBackend
+
+    _check_knn_impl(impl)  # loud even when the host fallback serves
 
     st = ds._state(type_name)
     # coherent snapshot: device residency, count, and permutations must all
@@ -131,7 +139,7 @@ def knn_many(ds, type_name: str, points, k: int = 10,
             int(_time.time() * 1000) if now_ms is None else now_ms
         ) - ttl
     maker = cached_ring_knn_step if topology == "ring" else cached_batched_knn_step
-    step = maker(mesh, kk, with_ttl)
+    step = maker(mesh, kk, with_ttl, impl=impl)
     qx = np.array([p.x for p in points], dtype=np.float32)
     qy = np.array([p.y for p in points], dtype=np.float32)
     (qx, qy), _ = pad_query_axis(mesh, qx, qy)

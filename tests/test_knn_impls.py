@@ -183,6 +183,38 @@ class TestKnnImplEquivalence:
             np.asarray(d_blk), np.asarray(d_map), rtol=3e-5, atol=1e-4
         )
 
+    def test_knn_many_impl_passthrough(self, monkeypatch):
+        # the process-layer surface threads impl down to the heap sweep
+        from geomesa_tpu.geometry import Point
+        from geomesa_tpu.parallel import query as Q
+        from geomesa_tpu.process.knn import knn_many
+        from geomesa_tpu.store.datastore import DataStore
+
+        calls = []
+        real = Q._local_knn_heaps_blocked
+
+        def sentinel(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(Q, "_local_knn_heaps_blocked", sentinel)
+        # the sentinel fires at TRACE time: memoized steps from earlier
+        # tests would skip tracing, so start from a cold step cache
+        Q.cached_batched_knn_step.cache_clear()
+        Q.cached_ring_knn_step.cache_clear()
+        ds = DataStore(backend="tpu")
+        ds.create_schema("kp", "dtg:Date,*geom:Point")
+        rng = np.random.default_rng(6)
+        ds.write("kp", [
+            {"dtg": 1_500_000_000_000, "geom": Point(
+                float(rng.uniform(-10, 10)), float(rng.uniform(-10, 10)))}
+            for _ in range(500)
+        ])
+        ds.compact("kp")  # fold the hot tier: the device path needs main
+        out = knn_many(ds, "kp", [Point(0, 0), Point(5, 5)], k=4,
+                       impl="blocked")
+        assert calls and len(out) == 2 and all(len(t) == 4 for t, _ in out)
+
     def test_blocked_ttl_masking(self, monkeypatch):
         # blocked impl under the TTL signature: expired rows never surface
         n = 4_096
