@@ -122,7 +122,7 @@ class TestQueryFailover:
         pts = [Point(10.0, 10.0), Point(-50.0, 20.0)]
         want = [t.fids.tolist() for t, _ in knn_mod.knn_many(ds, "evt", pts, k=3)]
 
-        def boom(mesh, k, with_ttl=False):
+        def boom(mesh, k, with_ttl=False, impl=None):
             def step(*a, **k2):
                 raise RuntimeError("UNAVAILABLE")
 
