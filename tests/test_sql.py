@@ -356,6 +356,50 @@ class TestSpatialJoin:
         (zone, n, nv, s, m, d), = r.rows()
         assert (zone, n, nv, s, m, d) == ("all", 3, 2, 12.0, 6.0, 2)
 
+    def test_join_group_by_fuzz_vs_bruteforce(self):
+        # seeded fuzz: random point clouds × random convex-ish zones,
+        # random aggregate sets — the grouped join fold must match a
+        # numpy referee exactly (counts) / approximately (float aggs)
+        from geomesa_tpu.geometry.predicates import points_within_geom
+        from geomesa_tpu.geometry.types import Polygon
+
+        rng = np.random.default_rng(77)
+        for trial in range(4):
+            n = int(rng.integers(50, 400))
+            ds = DataStore(backend="oracle")
+            ds.create_schema("fp", "val:Double,*geom:Point")
+            lon = rng.uniform(0, 20, n)
+            lat = rng.uniform(0, 20, n)
+            vals = np.round(rng.normal(10, 5, n), 3)
+            ds.write("fp", [
+                {"val": float(vals[i]), "geom": Point(lon[i], lat[i])}
+                for i in range(n)
+            ])
+            polys = []
+            for _z in range(int(rng.integers(1, 4))):
+                cx, cy = rng.uniform(3, 17, 2)
+                ang = np.sort(rng.uniform(0, 2 * np.pi, 8))
+                rad = rng.uniform(2, 5, 8)
+                polys.append(Polygon(np.stack(
+                    [cx + rad * np.cos(ang), cy + rad * np.sin(ang)], 1)))
+            ds.create_schema("fz", "zone:String,*geom:Polygon")
+            ds.write("fz", [
+                {"zone": f"z{j}", "geom": p} for j, p in enumerate(polys)
+            ])
+            r = sql(ds, "SELECT b.zone, COUNT(*) AS n, SUM(a.val) AS s, "
+                        "MIN(a.val) AS lo FROM fp a JOIN fz b "
+                        "ON ST_Within(a.geom, b.geom) GROUP BY b.zone")
+            got = {z: (cnt, s, lo) for z, cnt, s, lo in r.rows()}
+            for j, p in enumerate(polys):
+                m = points_within_geom(lon, lat, p)
+                if not m.any():
+                    assert f"z{j}" not in got
+                    continue
+                cnt, s, lo = got[f"z{j}"]
+                assert cnt == int(m.sum()), f"trial={trial} z{j}"
+                assert s == pytest.approx(float(vals[m].sum()))
+                assert lo == pytest.approx(float(vals[m].min()))
+
     def test_sql_auths_scope_select_agg_and_join(self):
         # the auths parameter threads into every path: plain select,
         # aggregation fold, and the join (device gather declines; the
