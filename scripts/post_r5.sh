@@ -1,0 +1,114 @@
+#!/bin/bash
+# Round-5 evidence pass. Ordering per VERDICT r4 item 1: never-witnessed
+# items FIRST (the 5 on-device tests the round-4 wall cap cut off, then
+# configs 9 and 4 which have never produced a hardware number), then the
+# KNN impl probe + config 3, the cfg6 one-pass retry, the raised-scale
+# cfg2/cfg5 defaults (50M/4M), and the cfg7 residency/roofline witness.
+#
+# Re-runnable: each completed step drops artifacts/.r5_done_<name>; a rerun
+# (scripts/post_r5_retry.sh loops on nonzero exit) skips finished steps and
+# the script exits nonzero while any step remains unfinished — a wedge
+# AFTER the probe gate re-engages the retry loop instead of forfeiting the
+# pass. Run only when no other evidence script holds the chip.
+set -u
+cd "$(dirname "$0")/.."
+unset GEOMESA_BENCH_DETAIL
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+mkdir -p artifacts
+. scripts/evidence_lib.sh
+
+step_once() {  # step_once <name> <timeout-s> <cmd...> — skip if done before;
+  # give up after 3 failures (a deterministic failure must not spend the
+  # whole retry window re-running and re-committing the same failing step)
+  local name=$1
+  local failf="artifacts/.r5_fail_${name}"
+  [ -e "artifacts/.r5_done_${name}" ] && { echo "== ${name} (done) =="; return 0; }
+  local fails=0
+  [ -e "$failf" ] && fails=$(cat "$failf")
+  if [ "$fails" -ge 3 ]; then
+    echo "== ${name} (failed ${fails}x, giving up — see committed logs) =="
+    return 0
+  fi
+  if step "$@"; then
+    touch "artifacts/.r5_done_${name}"
+    rm -f "$failf"
+    return 0
+  fi
+  echo $((fails + 1)) > "$failf"
+  return 1
+}
+
+probe_step probe_r5 || { echo "tunnel not healthy; aborting"; exit 1; }
+incomplete=0
+
+# --- never hardware-witnessed: the five suite tests the 1800s cap cut off
+# (inner pytest cap strictly below the outer step cap so the wrapper always
+# appends its partial-result block to TPU_VALIDATION.md)
+GEOMESA_DEVVAL_TIMEOUT=2500 step_once device_validation_r5 2700 \
+  python scripts/device_validation.py \
+  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile" \
+  || incomplete=1
+
+# --- never hardware-witnessed: mesh GROUP BY (r4 flagship) and the join
+GEOMESA_BENCH_CONFIG=9 step_once bench_cfg9_hw 1800 python bench.py \
+  || incomplete=1
+GEOMESA_BENCH_CONFIG=4 step_once bench_cfg4_hw 1800 python bench.py \
+  || incomplete=1
+
+# --- KNN impl probe (3 children x 700s < 2400s outer cap: summary always
+# prints), then config 3 with the hardware-verified winner
+GEOMESA_BENCH_N=16000000 GEOMESA_KNN_PROBE_CHILD_TIMEOUT=700 \
+  step_once knn_impl_probe 2400 python scripts/knn_impl_probe.py \
+  || incomplete=1
+probe_log="artifacts/knn_impl_probe_${ts}.log"
+[ -e "$probe_log" ] || probe_log=$(ls -t artifacts/knn_impl_probe_*.log 2>/dev/null | head -1)
+winner=$(PROBE_LOG="$probe_log" python - <<'PY'
+import json, os
+winner = ""
+try:
+    with open(os.environ["PROBE_LOG"]) as f:
+        for line in f:
+            if line.startswith("{") and "winner" in line:
+                d = json.loads(line)
+                # a faster-but-wrong impl must never become the record
+                if d.get("checksums_agree") is True:
+                    winner = d.get("winner") or ""
+except (OSError, KeyError, json.JSONDecodeError):
+    pass
+print(winner)
+PY
+)
+if [ -n "$winner" ]; then
+  GEOMESA_BENCH_CONFIG=3 GEOMESA_KNN_IMPL="$winner" \
+    step_once "bench_cfg3_${winner}" 2400 python bench.py || incomplete=1
+fi
+
+# --- cfg6 one-pass dispatch: committed r4 number is 0.25x the oracle and
+# the one-pass path has never been measured on chip
+GEOMESA_BENCH_CONFIG=6 step_once bench_cfg6_r5 1800 python bench.py \
+  || incomplete=1
+
+# --- raised accelerator-scale defaults (50M rows / 4M trajectories):
+# committed hardware numbers are 10M/1M
+GEOMESA_BENCH_CONFIG=2 step_once bench_cfg2_50m 2400 python bench.py \
+  || incomplete=1
+GEOMESA_BENCH_CONFIG=5 step_once bench_cfg5_4m 2400 python bench.py \
+  || incomplete=1
+
+# --- cfg7: residency witness at 250M rows (4 GB of columns) + whatever
+# roofline improvements have landed by the time the window opens
+GEOMESA_BENCH_CONFIG=7 step_once bench_cfg7_r5 2400 python bench.py \
+  || incomplete=1
+GEOMESA_BENCH_CONFIG=7 GEOMESA_BENCH_N=250000000 \
+  step_once bench_cfg7_250m 2400 python bench.py || incomplete=1
+
+# --- full 13-test on-device witness (re-runs the 8 already-witnessed too:
+# a full PASSED block in one run is the strongest artifact)
+GEOMESA_DEVVAL_TIMEOUT=3300 step_once device_validation_full 3500 \
+  python scripts/device_validation.py || incomplete=1
+
+if [ "$incomplete" -ne 0 ]; then
+  echo "post-r5 pass incomplete; retry will re-run unfinished steps"
+  exit 1
+fi
+echo "post-r5 evidence complete: artifacts/*_${ts}.*"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Resilient launcher for post_r5.sh: retry every 10 minutes until the probe
+# gate passes and every step completes (or the deadline lapses). The wedge
+# history (BASELINE.md round-2/3 notes) shows relay claims release after
+# minutes-to-hours — a one-shot gate would forfeit the whole pass.
+set -u
+cd "$(dirname "$0")/.."
+deadline=$(( $(date +%s) + ${GEOMESA_R5_DEADLINE_S:-39600} ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if bash scripts/post_r5.sh >> artifacts/post_r5.out 2>&1; then
+    echo "post_r5 completed $(date -u +%H:%M)" >> artifacts/post_r5.out
+    exit 0
+  fi
+  echo "post_r5 gate failed $(date -u +%H:%M); retry in 10 min" \
+    >> artifacts/post_r5.out
+  sleep 600
+done
+echo "post_r5 deadline lapsed" >> artifacts/post_r5.out
+exit 1
