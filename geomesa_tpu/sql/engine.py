@@ -19,6 +19,12 @@ Supported grammar:
       [GROUP BY <alias.col, ...>] [HAVING agg(alias.col|*) <op> number]
       [ORDER BY <name> [ASC|DESC], ...] [LIMIT <n>]
 
+    SELECT <alias.col|alias.*|agg, ...> FROM <t1> <a> JOIN <t2> <b>
+      ON <alias>.<attr> = <alias>.<attr>        -- attribute equi-join
+      [WHERE <conjuncts, each referencing exactly one alias>]
+      [GROUP BY <alias.col, ...>] [HAVING agg(alias.col|*) <op> number]
+      [ORDER BY <name> [ASC|DESC], ...] [LIMIT <n>]
+
     item      := * | col | agg | fn(col) [AS alias]
     agg       := COUNT(*) | COUNT(col) | COUNT(DISTINCT col)
                  | SUM/MIN/MAX/AVG(col)
@@ -143,6 +149,40 @@ def _split_top(s: str, sep: str = ",") -> list[str]:
     if cur:
         out.append("".join(cur).strip())
     return [p for p in out if p]
+
+
+def _split_conjuncts(s: str) -> list[str]:
+    """Split on top-level ``AND`` (case-insensitive, outside quotes and
+    parentheses) — the WHERE-routing unit for the equi-join grammar."""
+    out, depth, q, i, start = [], 0, None, 0, 0
+    low = s.lower()
+    while i < len(s):
+        ch = s[i]
+        if q:
+            if ch == q:
+                q = None
+        elif ch in "'\"":
+            q = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif (
+            depth == 0
+            and low.startswith("and", i)
+            and (i == 0 or not (s[i - 1].isalnum() or s[i - 1] == "_"))
+            and (
+                i + 3 >= len(s)
+                or not (s[i + 3].isalnum() or s[i + 3] == "_")
+            )
+        ):
+            out.append(s[start:i])
+            start = i + 3
+            i += 3
+            continue
+        i += 1
+    out.append(s[start:])
+    return [p.strip() for p in out if p.strip()]
 
 
 def _strip_geom_literal(arg: str) -> str:
@@ -469,19 +509,12 @@ def _group_first_occurrence(keys):
     return list(seen), groups
 
 
-def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
-                       left_pred, base_cql, auths=None) -> SqlResult:
-    """``JOIN ... GROUP BY``: first-occurrence host fold over the streamed
-    join pairs — the single-table host fold's semantics applied to the
-    joined relation ("points per zone"). The reference composes these
-    freely through Spark Catalyst (`geomesa-spark-sql/.../SQLRules.scala`);
-    here the join scan stays index-pruned and only the group keys and
-    aggregate argument columns are materialized. HAVING filters groups
-    through the shared _having_parts/_agg_value pair; ORDER BY sorts the
-    grouped OUTPUT columns (select-list names); LIMIT bounds output
-    groups after any sort."""
-    from geomesa_tpu.schema.columnar import Column, GeometryColumn
-
+def _parse_join_grouped(m, original, a1, sft1, a2, sft2):
+    """Shared ``JOIN ... GROUP BY`` clause machinery for BOTH join forms
+    (spatial ON ST_* and attribute equi-join): parse + validate group keys,
+    select items, HAVING, ORDER BY, LIMIT; compute the materialization set
+    ``need`` and its attribute types. One parser so the two ON forms'
+    grammar and fold semantics cannot drift."""
     gcols: list[tuple[str, str]] = []
     for raw in _split_top(_clause(m, original, "group")):
         gm = re.match(r"^(\w+)\.(\w+)$", raw.strip())
@@ -557,24 +590,86 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
             _attr(hm2.group(1), hm2.group(2),
                   agg=hit.fn in ("sum", "avg", "min", "max"))
     order = _parse_order(m.group("order"), dotted=True)
-
     limit = int(m.group("limit")) if m.group("limit") else None
-    right = ds.query(m.group("t2"), Query(auths=auths)).table
-    rgeoms = right.geom_column().geometries()
-
-    # stream pairs, materializing only the needed columns — values AND
-    # validity, so sentinel-valued NULLs neither pollute aggregates nor
-    # conflate with real zeros in group keys
     need = list(dict.fromkeys(
         gcols
         + [(al, c) for k, _, al, c, _ in items if k == "agg" and al]
         + ([tuple(hit.arg.split(".", 1))]
            if hit is not None and hit.arg != "*" else [])))
-    vals_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
-    valid_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
     types = {
         (alias, col): _attr(alias, col).type for alias, col in need
     }
+    return gcols, items, hit, hop, hlit, order, limit, need, types
+
+
+def _grouped_fold_output(joined, gcols, items, hit, hop, hlit, order,
+                         limit) -> SqlResult:
+    """Shared fold tail for both join forms: first-occurrence grouping over
+    the materialized join columns, HAVING filter through the single-table
+    _having_parts/_agg_value pair, pre-sort LIMIT truncation, aggregate
+    evaluation, ORDER BY over the output columns."""
+    shim = _JoinedTable(joined)
+    kvals = [joined[f"{alias}.{col}"] for alias, col in gcols]
+    kvalid = [c.is_valid() for c in kvals]
+    nrows = len(kvals[0]) if kvals else 0
+    keys = [
+        tuple(
+            c.values[i] if ok[i] else None
+            for c, ok in zip(kvals, kvalid)
+        )
+        for i in range(nrows)
+    ]
+    gkeys, groups = _group_first_occurrence(keys)
+    if hit is not None:
+        kept = [
+            (k, g) for k, g in zip(gkeys, groups)
+            if _having_passes(
+                hit, hop, hlit,
+                _agg_value(hit.fn, hit.arg, shim,
+                           np.asarray(g, dtype=np.int64)),
+            )
+        ]
+        gkeys = [k for k, _ in kept]
+        groups = [g for _, g in kept]
+    if limit is not None and not order:
+        # truncation before aggregation is only sound when no sort can
+        # reorder groups afterwards (HAVING already filtered above)
+        gkeys, groups = gkeys[:limit], groups[:limit]
+    cols: dict[str, np.ndarray] = {}
+    for kind, name, alias, col, fn in items:
+        if kind == "key":
+            gi = gcols.index((alias, col))
+            cols[name] = np.array([k[gi] for k in gkeys], dtype=object)
+            continue
+        arg = "*" if col == "*" else f"{alias}.{col}"
+        cols[name] = np.array(
+            [
+                _agg_value(fn, arg, shim, np.asarray(g, dtype=np.int64))
+                for g in groups
+            ],
+            dtype=object,
+        )
+    return _apply_order_limit(SqlResult(cols), order, limit)
+
+
+def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
+                       left_pred, base_cql, auths=None) -> SqlResult:
+    """``JOIN ... GROUP BY``: first-occurrence host fold over the streamed
+    join pairs — the single-table host fold's semantics applied to the
+    joined relation ("points per zone"). The reference composes these
+    freely through Spark Catalyst (`geomesa-spark-sql/.../SQLRules.scala`);
+    here the join scan stays index-pruned and only the group keys and
+    aggregate argument columns are materialized (streaming — values AND
+    validity, so sentinel-valued NULLs neither pollute aggregates nor
+    conflate with real zeros in group keys)."""
+    from geomesa_tpu.schema.columnar import Column, GeometryColumn
+
+    gcols, items, hit, hop, hlit, order, limit, need, types = \
+        _parse_join_grouped(m, original, a1, sft1, a2, sft2)
+    right = ds.query(m.group("t2"), Query(auths=auths)).table
+    rgeoms = right.geom_column().geometries()
+    vals_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
+    valid_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
     # right-table columns are constant across pairs: fetch values/validity
     # once, index [j] inside the loop
     rcols = {}
@@ -625,45 +720,8 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
         f"{alias}.{col}": _joined_column((alias, col))
         for alias, col in need
     }
-    shim = _JoinedTable(joined)
-
-    nrows = len(vals_acc[gcols[0]])
-    keys = []
-    for i in range(nrows):
-        keys.append(tuple(
-            vals_acc[kc][i] if valid_acc[kc][i] else None for kc in gcols
-        ))
-    gkeys, groups = _group_first_occurrence(keys)
-    if hit is not None:
-        kept = [
-            (k, g) for k, g in zip(gkeys, groups)
-            if _having_passes(
-                hit, hop, hlit,
-                _agg_value(hit.fn, hit.arg, shim,
-                           np.asarray(g, dtype=np.int64)),
-            )
-        ]
-        gkeys = [k for k, _ in kept]
-        groups = [g for _, g in kept]
-    if limit is not None and not order:
-        # truncation before aggregation is only sound when no sort can
-        # reorder groups afterwards (HAVING already filtered above)
-        gkeys, groups = gkeys[:limit], groups[:limit]
-    cols: dict[str, np.ndarray] = {}
-    for kind, name, alias, col, fn in items:
-        if kind == "key":
-            gi = gcols.index((alias, col))
-            cols[name] = np.array([k[gi] for k in gkeys], dtype=object)
-            continue
-        arg = "*" if col == "*" else f"{alias}.{col}"
-        cols[name] = np.array(
-            [
-                _agg_value(fn, arg, shim, np.asarray(g, dtype=np.int64))
-                for g in groups
-            ],
-            dtype=object,
-        )
-    return _apply_order_limit(SqlResult(cols), order, limit)
+    return _grouped_fold_output(
+        joined, gcols, items, hit, hop, hlit, order, limit)
 
 
 def _sql_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
@@ -780,6 +838,226 @@ def _sql_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
         SqlResult({k: np.asarray(v, dtype=object) for k, v in out.items()}),
         order, limit if order else None,
     )
+
+
+_EQUIJOIN = re.compile(
+    r"^\s*select\s+(?P<select>.+?)\s+"
+    r"from\s+(?P<t1>\w+)\s+(?P<a1>\w+)\s+"
+    r"join\s+(?P<t2>\w+)\s+(?P<a2>\w+)\s+"
+    r"on\s+(?P<xa>\w+)\.(?P<xc>\w+)\s*=\s*(?P<ya>\w+)\.(?P<yc>\w+)"
+    r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+having\s+(?P<having>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _equi_key_arrays(lcol, rcol, a1, a2, lc, rc):
+    """Join-key columns → (lkeys, lvalid, rkeys, rvalid) in one comparable,
+    C-sortable domain. Numeric/Date/Boolean pairs meet in int64 when both
+    are integral (exact at any magnitude), else float64; strings meet as
+    fixed-width unicode — numpy's lexical order IS the attribute
+    lexicoder's total order (`index/attribute.py` sorts the same way), so
+    the sorted-merge below walks the same key-space the reference's
+    join index serves lookups from (``AccumuloJoinIndex.scala:45``)."""
+    from geomesa_tpu.schema.sft import AttributeType as T
+
+    for alias, col, t in ((a1, lc, lcol.type), (a2, rc, rcol.type)):
+        if t.is_geometry:
+            raise SqlError(
+                f"equi-join key {alias}.{col} is a geometry column — use "
+                f"the spatial ON ST_*(...) form")
+    stringy = {T.STRING, T.UUID}
+    integral = {T.INT, T.LONG, T.DATE, T.BOOLEAN}
+    numeric = integral | {T.FLOAT, T.DOUBLE}
+
+    def _cast(col, dtype):
+        valid = col.is_valid()
+        vals = col.values
+        if dtype is str:
+            # non-str values (e.g. uuid.UUID objects) key on their str()
+            # form — the lexicoder's canonical text; only INVALID slots may
+            # collapse to "" (they never match: validity gates the merge)
+            out = np.asarray(
+                [(v if isinstance(v, str) else str(v)) if ok else ""
+                 for v, ok in zip(vals, valid)], dtype=str)
+        else:
+            out = np.where(valid, vals, 0).astype(dtype)
+        return out, valid
+
+    if lcol.type in stringy and rcol.type in stringy:
+        lk, lv = _cast(lcol, str)
+        rk, rv = _cast(rcol, str)
+        # meet in one unicode width or searchsorted compares truncated keys
+        width = max(lk.dtype.itemsize, rk.dtype.itemsize) // 4 or 1
+        return (lk.astype(f"U{width}"), lv, rk.astype(f"U{width}"), rv)
+    if lcol.type in numeric and rcol.type in numeric:
+        dt = (np.int64 if lcol.type in integral and rcol.type in integral
+              else np.float64)
+        lk, lv = _cast(lcol, dt)
+        rk, rv = _cast(rcol, dt)
+        return lk, lv, rk, rv
+    raise SqlError(
+        f"incompatible equi-join key types {lcol.type.value} vs "
+        f"{rcol.type.value}")
+
+
+def _equi_pairs(lkeys, lvalid, rkeys, rvalid):
+    """Vectorized sorted-merge inner join → (li, rj) row-index arrays.
+
+    Sort the right side once (O(m log m)), binary-search every left key
+    into it (O(n log m)), expand the hit runs without a Python loop. SQL
+    NULL semantics: invalid keys on either side match nothing. Pair order
+    is left-major with right matches in right-table order (stable sort),
+    so results are deterministic."""
+    ridx = np.flatnonzero(rvalid)
+    order = ridx[np.argsort(rkeys[ridx], kind="stable")]
+    rs = rkeys[order]
+    lo = np.searchsorted(rs, lkeys, side="left")
+    hi = np.searchsorted(rs, lkeys, side="right")
+    cnt = np.where(lvalid, hi - lo, 0).astype(np.int64)
+    total = int(cnt.sum())
+    li = np.repeat(np.arange(len(lkeys), dtype=np.int64), cnt)
+    starts = np.repeat(lo, cnt)
+    run = np.arange(total, dtype=np.int64) - np.repeat(
+        np.concatenate(([0], np.cumsum(cnt)[:-1])), cnt)
+    rj = order[starts + run]
+    return li, rj
+
+
+def _sql_equi_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
+    """Attribute equi-join: ``JOIN ... ON a.attr = b.attr`` over the
+    lexicoder-ordered key-space (the ``AccumuloJoinIndex.scala:45`` /
+    Spark relational-join role the reference reaches through Catalyst,
+    ``GeoMesaRelation.scala:47``). Executed as a host sorted-merge over
+    the two planned scans — WHERE conjuncts referencing exactly one alias
+    push down to that side's index-planned query, so both inputs arrive
+    pre-pruned. Composes with the join grammar's GROUP BY/HAVING/ORDER
+    BY/LIMIT through the same fold helpers as the spatial join (the
+    semantics must not drift between the two ON forms)."""
+    original = original if original is not None else m.string
+    t1, a1, t2, a2 = m.group("t1"), m.group("a1"), m.group("t2"), m.group("a2")
+    if a1 == a2:
+        raise SqlError(f"duplicate join alias {a1!r}")
+    xa, xc, ya, yc = m.group("xa"), m.group("xc"), m.group("ya"), m.group("yc")
+    if {xa, ya} != {a1, a2}:
+        raise SqlError("ON predicate must reference both join aliases")
+    lc, rc = (xc, yc) if xa == a1 else (yc, xc)
+    sft1 = ds.get_schema(t1)
+    sft2 = ds.get_schema(t2)
+    for sft, alias, col, t in ((sft1, a1, lc, t1), (sft2, a2, rc, t2)):
+        if col not in {a.name for a in sft.attributes}:
+            raise SqlError(f"unknown column {alias}.{col} on {t}")
+
+    # WHERE: each top-level conjunct pushes to the single side it
+    # references (index pruning on BOTH scans); mixed conjuncts are out of
+    # the v1 grammar, same as the spatial join's restriction
+    lcql = rcql = None
+    if m.group("where"):
+        w = _clause(m, original, "where")
+        lparts, rparts = [], []
+        for part in _split_conjuncts(w):
+            refs = set()
+
+            def _scan(seg):
+                for am in re.finditer(r"\b(\w+)\s*\.", seg):
+                    refs.add(am.group(1))
+                return seg
+
+            _map_unquoted(part, _scan)
+            refs &= {a1, a2}
+            if refs == {a1}:
+                lparts.append(_map_unquoted(
+                    part, lambda seg: re.sub(rf"\b{a1}\s*\.", "", seg)))
+            elif refs == {a2}:
+                rparts.append(_map_unquoted(
+                    part, lambda seg: re.sub(rf"\b{a2}\s*\.", "", seg)))
+            else:
+                raise SqlError(
+                    f"equi-join WHERE conjunct must reference exactly one "
+                    f"alias: {part.strip()!r}")
+        lcql = _rewrite_where(" AND ".join(lparts)) if lparts else None
+        rcql = _rewrite_where(" AND ".join(rparts)) if rparts else None
+
+    left = ds.query(t1, Query(filter=lcql, auths=auths)).table
+    right = ds.query(t2, Query(filter=rcql, auths=auths)).table
+    li, rj = _equi_pairs(*_equi_key_arrays(
+        left.columns[lc], right.columns[rc], a1, a2, lc, rc))
+
+    def _pair_column(alias, col):
+        """Joined column as (type, values, valid) via fancy indexing —
+        no per-pair Python loop, so 1M-pair joins stay vectorized."""
+        src = left if alias == a1 else right
+        idx = li if alias == a1 else rj
+        c = src.columns[col]
+        v = c.geometries() if c.type.is_geometry else c.values
+        return c.type, np.asarray(v)[idx], c.is_valid()[idx]
+
+    if m.group("group"):
+        return _equi_grouped_fold(
+            m, original, a1, sft1, a2, sft2, _pair_column)
+    if m.group("having"):
+        raise SqlError("HAVING requires GROUP BY")
+    order = _parse_order(m.group("order"), dotted=True)
+    limit = int(m.group("limit")) if m.group("limit") else None
+    if limit is not None and not order:
+        li, rj = li[:limit], rj[:limit]
+
+    items: list[tuple[str, str]] = []
+    for raw in _split_top(m.group("select")):
+        im = re.match(r"^(\w+)\.(\w+|\*)$", raw.strip())
+        if not im:
+            raise SqlError(f"join select items must be alias.col: {raw!r}")
+        items.append((im.group(1), im.group(2)))
+    expanded: list[tuple[str, str]] = []
+    for alias, col in items:
+        if alias not in (a1, a2):
+            raise SqlError(f"unknown alias {alias!r}")
+        sft = sft1 if alias == a1 else sft2
+        if col == "*":
+            expanded.extend((alias, attr.name) for attr in sft.attributes)
+        elif col not in {attr.name for attr in sft.attributes}:
+            raise SqlError(f"unknown column {alias}.{col}")
+        else:
+            expanded.append((alias, col))
+    expanded = list(dict.fromkeys(expanded))
+    out = {}
+    for alias, col in expanded:
+        _, vals, valid = _pair_column(alias, col)
+        vo = np.empty(len(vals), dtype=object)
+        vo[:] = vals
+        vo[~valid] = None
+        out[f"{alias}.{col}"] = vo
+    return _apply_order_limit(SqlResult(out), order, limit if order else None)
+
+
+def _equi_grouped_fold(m, original, a1, sft1, a2, sft2,
+                       pair_column) -> SqlResult:
+    """Equi-join GROUP BY: the shared join-grammar parse + fold tail
+    (:func:`_parse_join_grouped` / :func:`_grouped_fold_output` — the same
+    helpers the spatial join streams through), fed by vectorized joined
+    columns from the sorted-merge pairing."""
+    from geomesa_tpu.schema.columnar import Column, GeometryColumn
+
+    gcols, items, hit, hop, hlit, order, limit, need, types = \
+        _parse_join_grouped(m, original, a1, sft1, a2, sft2)
+    joined = {}
+    for alias, col in need:
+        t, vals, valid = pair_column(alias, col)
+        if t.is_geometry:
+            joined[f"{alias}.{col}"] = GeometryColumn(
+                t, np.asarray(vals, dtype=object), valid)
+        else:
+            obj = t.name in ("STRING", "UUID", "BYTES")
+            joined[f"{alias}.{col}"] = Column(
+                t,
+                np.asarray(vals, dtype=object) if obj else np.asarray(vals),
+                valid,
+            )
+    return _grouped_fold_output(
+        joined, gcols, items, hit, hop, hlit, order, limit)
 
 
 _MESH_AGG_TYPES = (
@@ -979,6 +1257,9 @@ def sql(ds, statement: str, auths=None) -> SqlResult:
     jm = _JOIN.match(masked)
     if jm:
         return _sql_join(ds, jm, statement, auths=auths)
+    em = _EQUIJOIN.match(masked)
+    if em:
+        return _sql_equi_join(ds, em, statement, auths=auths)
     m = _CLAUSES.match(masked)
     if not m:
         raise SqlError(f"cannot parse: {statement!r}")
