@@ -13,3 +13,4 @@ from geomesa_tpu.stream.messages import (  # noqa: F401
     Put,
 )
 from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore  # noqa: F401
+from geomesa_tpu.stream.remote_journal import RemoteJournal  # noqa: F401

@@ -39,7 +39,7 @@ from geomesa_tpu.stream.messages import (
     _pack_str,
 )
 
-__all__ = ["SchemaRegistry", "AvroGeoMessageSerializer"]
+__all__ = ["SchemaRegistry", "HttpSchemaRegistry", "AvroGeoMessageSerializer"]
 
 _MAGIC = 0
 
@@ -79,6 +79,88 @@ class SchemaRegistry:
     def versions(self, subject: str) -> list[int]:
         """Registered schema ids for a subject, oldest first."""
         return list(self._subjects.get(subject, []))
+
+
+class HttpSchemaRegistry:
+    """Client for a LIVE schema-registry service over the Confluent REST
+    protocol (``POST /subjects/<s>/versions``, ``GET /schemas/ids/<id>``) —
+    the ``geomesa-kafka-confluent`` client half
+    (``/root/reference/geomesa-kafka/geomesa-kafka-confluent/``). Same
+    surface as :class:`SchemaRegistry`, so
+    :class:`AvroGeoMessageSerializer` binds to either; works against a
+    real Confluent registry or :mod:`geomesa_tpu.web.app` serving one
+    (``GeoMesaApp(..., schema_registry=...)``).
+
+    Writer schemas are immutable once assigned an id, so ``schema_by_id``
+    responses cache forever; ``register`` caches per canonical schema JSON
+    (the service is idempotent on re-registration)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._by_id: dict[int, dict] = {}
+        self._ids: dict[tuple[str, str], int] = {}
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/vnd.schemaregistry.v1+json"},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    def register(self, subject: str, schema: dict) -> int:
+        import urllib.parse
+
+        # cache key includes the SUBJECT: the same schema registered under
+        # a second subject must still POST, or that subject is never
+        # registered server-side (version listing would 404)
+        key = (subject, json.dumps(schema, sort_keys=True))
+        with self._lock:
+            sid = self._ids.get(key)
+        if sid is not None:
+            return sid
+        out = self._request(
+            "POST",
+            f"/subjects/{urllib.parse.quote(subject, safe='')}/versions",
+            {"schema": json.dumps(schema)},
+        )
+        sid = int(out["id"])
+        with self._lock:
+            self._ids[key] = sid
+            self._by_id[sid] = schema
+        return sid
+
+    def schema_by_id(self, sid: int) -> dict:
+        with self._lock:
+            cached = self._by_id.get(sid)
+        if cached is not None:
+            return cached
+        import urllib.error
+
+        try:
+            out = self._request("GET", f"/schemas/ids/{int(sid)}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(f"unknown schema id {sid}") from None
+            raise
+        schema = json.loads(out["schema"])
+        with self._lock:
+            self._by_id[sid] = schema
+        return schema
+
+    def versions(self, subject: str) -> list[int]:
+        import urllib.parse
+
+        return [int(v) for v in self._request(
+            "GET",
+            f"/subjects/{urllib.parse.quote(subject, safe='')}/versions",
+        )]
 
 
 class AvroGeoMessageSerializer:

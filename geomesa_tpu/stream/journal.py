@@ -381,6 +381,49 @@ class JournalBus:
             off = end
         return out
 
+    def total_poll(self, topic: str, offset: int, max_n: int = 256):
+        """Total-order payloads ``[offset, offset+max_n)`` re-read from the
+        committed journal prefix — the message-offset-addressed form
+        (O(offset) per call: the log is re-framed from byte 0). Long-lived
+        remote tails use :meth:`total_poll_bytes` instead, which reads
+        only new bytes."""
+        return self._disk_payloads(topic, offset + max_n)[offset:]
+
+    def total_poll_bytes(self, topic: str, cursor: int,
+                         max_bytes: int = 1 << 22):
+        """Total-order tail by BYTE cursor: payloads framed from committed
+        byte ``cursor``, plus the next cursor — each call reads only the
+        new bytes, so a long-lived remote subscriber is O(new data), not
+        O(journal) (the ``/api/journal/<topic>/tpoll?cursor=`` path).
+        ``cursor`` is an opaque token: start at 0, always pass back the
+        returned value (it only ever lands on record boundaries)."""
+        committed = self._read_commit(topic)
+        try:
+            size = os.path.getsize(self._log_path(topic))
+        except OSError:
+            return [], cursor
+        if committed is None:
+            committed = self._scan_framed_prefix(topic, size)
+        committed = min(committed, size)
+        if cursor >= committed:
+            return [], cursor
+        try:
+            with open(self._log_path(topic), "rb") as f:
+                f.seek(cursor)
+                buf = f.read(min(committed - cursor, max_bytes))
+        except OSError:
+            return [], cursor
+        out: list[bytes] = []
+        off = 0
+        while len(buf) - off >= _HEADER.size:
+            ln, _b, _k = _HEADER.unpack_from(buf, off)
+            end = off + _HEADER.size + ln
+            if end > len(buf):
+                break  # record straddles the read window: next call gets it
+            out.append(buf[off + _HEADER.size : end])
+            off = end
+        return out, cursor + off
+
     def _tail_loop(self) -> None:
         stop = self._stop
         while not stop.is_set():

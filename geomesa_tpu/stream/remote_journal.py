@@ -1,0 +1,166 @@
+"""Cross-host journal client: the Kafka-broker-over-the-network role.
+
+Role parity: the reference streams between hosts through Kafka brokers
+(``geomesa-kafka/.../KafkaDataStore.scala:52``); here the broker is another
+process's :class:`~geomesa_tpu.stream.journal.JournalBus` exposed over
+``/api/journal`` (:mod:`geomesa_tpu.web.app`). :class:`RemoteJournal`
+implements the :class:`~geomesa_tpu.stream.datastore.MessageBus` surface —
+``publish`` / ``poll`` / ``end_offset`` / ``subscribe`` / ``partitions`` —
+so a :class:`~geomesa_tpu.stream.datastore.StreamingDataStore` on a host
+with NO shared mount consumes another host's live stream unchanged:
+
+    bus = RemoteJournal("http://feeder:8080")
+    store = StreamingDataStore(bus=bus)          # tails the remote topics
+
+``subscribe`` tails the TOTAL-ORDER log (the journal's on-disk frame
+order), matching the in-process bus's synchronous-subscriber semantics —
+barriers included exactly once. The per-partition ``poll`` path is the
+consumer-group protocol (per-key ordering, barriers replicated per
+partition), identical to the local ``JournalBus`` contract.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+import urllib.request
+from typing import Callable
+
+__all__ = ["RemoteJournal"]
+
+
+class RemoteJournal:
+    """MessageBus-surface client over a remote ``/api/journal`` endpoint."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 poll_interval_s: float = 0.1):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._partitions: int | None = None
+        self._stop = threading.Event()
+        self._tailers: list[threading.Thread] = []
+        # last transport error seen by any tailer (None = healthy); a 4xx
+        # stops that tail — see subscribe()
+        self.last_error: Exception | None = None
+
+    # -- plumbing ------------------------------------------------------------
+    def _url(self, topic: str, op: str) -> str:
+        return (f"{self.base_url}/api/journal/"
+                f"{urllib.parse.quote(topic, safe='')}/{op}")
+
+    def _get(self, topic: str, op: str, **params) -> dict:
+        url = self._url(topic, op)
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    # -- MessageBus surface --------------------------------------------------
+    @property
+    def partitions(self) -> int:
+        if self._partitions is None:
+            # any topic name works: /end answers with the bus-wide count
+            self._partitions = int(self._get("_", "end")["partitions"])
+        return self._partitions
+
+    def create_topic(self, topic: str) -> None:
+        """Topics materialize on first publish server-side; nothing to do."""
+
+    def publish(self, topic: str, key: str, data: bytes,
+                barrier: bool = False) -> None:
+        req = urllib.request.Request(
+            self._url(topic, "publish"),
+            data=json.dumps({
+                "key": key,
+                "data_b64": base64.b64encode(data).decode(),
+                "barrier": barrier,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            r.read()
+
+    def poll(self, topic: str, partition: int, offset: int,
+             max_n: int = 256) -> list[bytes]:
+        out = self._get(topic, "poll", partition=partition, offset=offset,
+                        max_n=max_n)
+        return [base64.b64decode(p) for p in out["payloads"]]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        return int(self._get(topic, "end", partition=partition)["end"])
+
+    def topic_size(self, topic: str) -> int:
+        return int(self._get(topic, "end")["size"])
+
+    def total_poll(self, topic: str, offset: int,
+                   max_n: int = 256) -> list[bytes]:
+        out = self._get(topic, "tpoll", offset=offset, max_n=max_n)
+        return [base64.b64decode(p) for p in out["payloads"]]
+
+    def total_poll_cursor(self, topic: str,
+                          cursor: int) -> tuple[list[bytes], int]:
+        """Byte-cursor total-order tail: (payloads, next cursor). Each call
+        reads only new journal bytes server-side — the long-lived
+        subscriber path (start at 0, pass the returned cursor back)."""
+        out = self._get(topic, "tpoll", cursor=cursor)
+        return [base64.b64decode(p) for p in out["payloads"]], int(out["cursor"])
+
+    def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
+        """Tail the remote topic's total-order log from the start (replay,
+        then live) on a daemon thread — the in-process bus's subscriber
+        contract across the HTTP boundary. Callback errors drop that
+        record for that subscriber (same at-most-once posture as the
+        journal's tailer), never the tail itself.
+
+        Transport failures are NOT silently absorbed: a configuration
+        error (HTTP 4xx — e.g. the server has no journal attached) stops
+        the tail immediately, and any transport error is recorded on
+        ``self.last_error``; ``healthy()`` is the liveness signal.
+        Transient 5xx/connection errors keep retrying."""
+
+        def _tail() -> None:
+            import urllib.error
+
+            cursor = 0
+            while not self._stop.is_set():
+                try:
+                    batch, cursor = self.total_poll_cursor(topic, cursor)
+                    self.last_error = None
+                except urllib.error.HTTPError as e:
+                    # 4xx = misconfiguration (wrong server, no journal):
+                    # retrying forever would just look like an idle stream
+                    self.last_error = e
+                    if 400 <= e.code < 500:
+                        return
+                    batch = []
+                except OSError as e:
+                    self.last_error = e  # transient: keep tailing
+                    batch = []
+                if not batch:
+                    self._stop.wait(self.poll_interval_s)
+                    continue
+                for data in batch:
+                    try:
+                        callback(data)
+                    except Exception:  # noqa: BLE001 — one bad consumer
+                        pass
+
+        t = threading.Thread(target=_tail, daemon=True,
+                             name=f"remote-journal-tail-{topic}")
+        self._tailers.append(t)
+        t.start()
+
+    def healthy(self) -> bool:
+        """True while every tailer thread is alive and the last transport
+        round-trip succeeded."""
+        return self.last_error is None and all(
+            t.is_alive() for t in self._tailers
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._tailers:
+            t.join(timeout=5.0)

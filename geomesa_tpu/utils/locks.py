@@ -24,14 +24,106 @@ import fcntl
 import json
 import os
 import socket
+import threading
 import time
 import uuid
 
-__all__ = ["catalog_lock", "lease_lock", "LockTimeout"]
+__all__ = [
+    "catalog_lock", "lease_lock", "http_lease_lock", "LeaseService",
+    "LockTimeout",
+]
 
 
 class LockTimeout(TimeoutError):
     pass
+
+
+class LeaseService:
+    """Server-side lease authority: named expiring leases over HTTP (the
+    Zookeeper-ensemble role collapsed to one coordinator service — the
+    reference's ``DistributedLocking.scala:14`` gets mutual exclusion from
+    ZK; hosts with NO shared filesystem get it from this service via
+    ``/api/lease`` on :mod:`geomesa_tpu.web.app`).
+
+    All decisions happen in one process under one mutex, so correctness
+    needs no clock agreement between clients — only the coordinator's
+    clock times out abandoned leases (crash recovery: a dead holder
+    delays, never deadlocks, other hosts — same posture as
+    :func:`lease_lock`)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # name -> (token, holder, expires_unix)
+        self._leases: dict[str, tuple[str, str, float]] = {}
+
+    def acquire(self, name: str, holder: str, ttl_s: float) -> dict:
+        now = time.time()
+        with self._mu:
+            cur = self._leases.get(name)
+            if cur is not None and cur[2] > now:
+                return {"ok": False, "holder": cur[1], "expires_unix": cur[2]}
+            token = uuid.uuid4().hex
+            self._leases[name] = (token, holder, now + ttl_s)
+            return {"ok": True, "token": token}
+
+    def renew(self, name: str, token: str, ttl_s: float) -> dict:
+        with self._mu:
+            cur = self._leases.get(name)
+            if cur is None or cur[0] != token:
+                return {"ok": False}
+            self._leases[name] = (cur[0], cur[1], time.time() + ttl_s)
+            return {"ok": True}
+
+    def release(self, name: str, token: str) -> dict:
+        with self._mu:
+            cur = self._leases.get(name)
+            # releasing an expired-and-retaken lease must not evict the
+            # new holder: token mismatch is a no-op, not an error
+            if cur is not None and cur[0] == token:
+                del self._leases[name]
+            return {"ok": True}
+
+
+@contextlib.contextmanager
+def http_lease_lock(base_url: str, name: str = "catalog",
+                    ttl_s: float = 60.0, timeout_s: float = 30.0,
+                    poll_s: float = 0.05):
+    """Cross-host expiring lease from a coordinator's ``/api/lease``
+    endpoint (:class:`LeaseService`) — mutual exclusion between hosts with
+    NO shared filesystem. Same interface and caveats as
+    :func:`lease_lock`: hold times must stay well under ``ttl_s``."""
+    import urllib.request
+
+    holder = f"{socket.gethostname()}:{os.getpid()}"
+    base = base_url.rstrip("/")
+
+    def _post(op: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{base}/api/lease/{op}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return json.loads(r.read())
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        out = _post("acquire", {"name": name, "holder": holder,
+                                "ttl_s": ttl_s})
+        if out.get("ok"):
+            token = out["token"]
+            break
+        if time.monotonic() >= deadline:
+            raise LockTimeout(
+                f"could not acquire lease {name!r} from {base!r} within "
+                f"{timeout_s}s (held by {out.get('holder')})"
+            )
+        time.sleep(poll_s)
+    try:
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            _post("release", {"name": name, "token": token})
 
 
 @contextlib.contextmanager
@@ -127,6 +219,11 @@ def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05,
 
     ``path`` is created if missing (locking a catalog that doesn't exist yet
     is the schema-create case).
+
+    When ``GEOMESA_COORDINATOR_URL`` is set the cross-host layer is
+    :func:`http_lease_lock` against that coordinator instead of the
+    filesystem lease — so a shared mount is an optimization, not a
+    requirement, for multi-host catalog mutation.
     """
     os.makedirs(path, exist_ok=True)
     lock_path = os.path.join(path, ".geomesa.lock")
@@ -145,11 +242,27 @@ def catalog_lock(path: str, timeout_s: float = 30.0, poll_s: float = 0.05,
                         f"could not lock catalog {path!r} within {timeout_s}s"
                     ) from None
                 time.sleep(poll_s)
-        with lease_lock(
-            path, ttl_s=lease_ttl_s,
-            timeout_s=max(0.0, deadline - time.monotonic()) or 0.001,
-            poll_s=poll_s,
-        ):
+        coord = os.environ.get("GEOMESA_COORDINATOR_URL")
+        # the lease names a LOGICAL catalog: hosts mounting one catalog at
+        # different local paths must set GEOMESA_CATALOG_LOCK_NAME to the
+        # shared name, else the host-local abspath would give each mount
+        # its own lease (no exclusion at all)
+        lock_name = (os.environ.get("GEOMESA_CATALOG_LOCK_NAME")
+                     or os.path.abspath(path))
+        cross_host = (
+            http_lease_lock(
+                coord, name=lock_name, ttl_s=lease_ttl_s,
+                timeout_s=max(0.0, deadline - time.monotonic()) or 0.001,
+                poll_s=poll_s,
+            )
+            if coord
+            else lease_lock(
+                path, ttl_s=lease_ttl_s,
+                timeout_s=max(0.0, deadline - time.monotonic()) or 0.001,
+                poll_s=poll_s,
+            )
+        )
+        with cross_host:
             yield
     finally:
         with contextlib.suppress(OSError):

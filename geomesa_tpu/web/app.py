@@ -80,13 +80,39 @@ def _jsonable(v):
 class GeoMesaApp:
     """WSGI application over one :class:`DataStore` (or merged view)."""
 
-    def __init__(self, store, auth_provider=None):
+    def __init__(self, store, auth_provider=None, journal=None,
+                 schema_registry=None):
         # auth_provider: security.auth.AuthorizationsProvider — derives the
         # caller's visibility auths from the request (None = unrestricted,
         # the single-tenant default)
+        # journal: a stream.journal.JournalBus to expose over /api/journal
+        # (cross-host stream transport — the Kafka-broker role; None hides
+        # the routes)
+        # schema_registry: a stream.confluent.SchemaRegistry to expose on
+        # the Confluent REST paths (/subjects, /schemas/ids) so remote
+        # producers/consumers share schema ids; None hides the routes
+        from geomesa_tpu.utils.locks import LeaseService
+
         self.store = store
         self.auth_provider = auth_provider
+        self.journal = journal
+        self.schema_registry = schema_registry
+        self.leases = LeaseService()
         self.routes = [
+            # Confluent Schema Registry wire protocol (the
+            # geomesa-kafka-confluent service half)
+            ("POST", r"^/subjects/([^/]+)/versions$", self._registry_register),
+            ("GET", r"^/subjects/([^/]+)/versions$", self._registry_versions),
+            ("GET", r"^/schemas/ids/(\d+)$", self._registry_by_id),
+            # cross-host coordination: named expiring leases (the ZK
+            # DistributedLocking role for hosts with no shared mount)
+            ("POST", r"^/api/lease/(acquire|renew|release)$", self._lease),
+            # cross-host stream transport over the journal (Kafka-broker
+            # role): publish + offset-addressed poll
+            ("POST", r"^/api/journal/([^/]+)/publish$", self._journal_publish),
+            ("GET", r"^/api/journal/([^/]+)/poll$", self._journal_poll),
+            ("GET", r"^/api/journal/([^/]+)/tpoll$", self._journal_tpoll),
+            ("GET", r"^/api/journal/([^/]+)/end$", self._journal_end),
             ("GET", r"^/api/version$", self._version),
             ("GET", r"^/api/schemas$", self._list_schemas),
             ("POST", r"^/api/schemas$", self._create_schema),
@@ -193,6 +219,120 @@ class GeoMesaApp:
         import geomesa_tpu
 
         return 200, {"name": "geomesa-tpu", "version": geomesa_tpu.__version__}, "application/json"
+
+    # -- cross-host coordination (no-shared-mount deployments) ---------------
+    def _lease(self, op, params, body):
+        """Named expiring leases (``utils.locks.LeaseService``): the
+        coordinator half of ``http_lease_lock``. Always 200 — contention
+        is a normal outcome (``ok: false``), not an HTTP error."""
+        b = body or {}
+        name = b.get("name")
+        if not name or not isinstance(name, str):
+            raise _HttpError(400, "body must include a lease 'name'")
+        ttl = float(b.get("ttl_s", 60.0))
+        if op == "acquire":
+            out = self.leases.acquire(name, str(b.get("holder", "?")), ttl)
+        elif op == "renew":
+            out = self.leases.renew(name, str(b.get("token", "")), ttl)
+        else:
+            out = self.leases.release(name, str(b.get("token", "")))
+        return 200, out, "application/json"
+
+    def _require_journal(self):
+        if self.journal is None:
+            raise _HttpError(404, "no journal attached to this server")
+        return self.journal
+
+    # NB: WSGI servers deliver PATH_INFO already percent-decoded (PEP
+    # 3333), so the matched topic/subject group is the literal name — do
+    # NOT unquote again. Names containing '/' are not addressable over
+    # these path routes (journal topics are `geomesa-<type>`, so this
+    # never arises in practice).
+    def _journal_publish(self, topic, params, body):
+        import base64
+
+        bus = self._require_journal()
+        b = body or {}
+        if "data_b64" not in b:
+            raise _HttpError(400, "body must include 'data_b64'")
+        bus.publish(
+            topic, str(b.get("key", "")),
+            base64.b64decode(b["data_b64"]),
+            barrier=bool(b.get("barrier", False)),
+        )
+        return 200, {"ok": True}, "application/json"
+
+    def _journal_poll(self, topic, params, body):
+        import base64
+
+        bus = self._require_journal()
+        partition = self._int_param(params, "partition") or 0
+        offset = self._int_param(params, "offset") or 0
+        max_n = self._int_param(params, "max_n") or 256
+        msgs = bus.poll(topic, partition, offset, max_n)
+        return 200, {
+            "payloads": [base64.b64encode(p).decode() for p in msgs],
+            "end": bus.end_offset(topic, partition),
+        }, "application/json"
+
+    def _journal_tpoll(self, topic, params, body):
+        import base64
+
+        bus = self._require_journal()
+        if "cursor" in params:
+            # byte-cursor tail: O(new data) per call — the long-lived
+            # remote-subscriber path
+            msgs, nxt = bus.total_poll_bytes(
+                topic, self._int_param(params, "cursor") or 0)
+            return 200, {
+                "payloads": [base64.b64encode(p).decode() for p in msgs],
+                "cursor": nxt,
+            }, "application/json"
+        offset = self._int_param(params, "offset") or 0
+        max_n = self._int_param(params, "max_n") or 256
+        msgs = bus.total_poll(topic, offset, max_n)
+        return 200, {
+            "payloads": [base64.b64encode(p).decode() for p in msgs],
+            "size": bus.topic_size(topic),
+        }, "application/json"
+
+    def _journal_end(self, topic, params, body):
+        bus = self._require_journal()
+        partition = self._int_param(params, "partition") or 0
+        return 200, {
+            "end": bus.end_offset(topic, partition),
+            "partitions": bus.partitions,
+            "size": bus.topic_size(topic),
+        }, "application/json"
+
+    # -- Confluent Schema Registry protocol ----------------------------------
+    def _require_registry(self):
+        if self.schema_registry is None:
+            raise _HttpError(404, "no schema registry attached to this server")
+        return self.schema_registry
+
+    def _registry_register(self, subject, params, body):
+        reg = self._require_registry()
+        b = body or {}
+        if "schema" not in b:
+            raise _HttpError(400, 'body must be {"schema": "<avro json>"}')
+        # Confluent wire format carries the schema as a STRING of JSON
+        schema = (json.loads(b["schema"]) if isinstance(b["schema"], str)
+                  else b["schema"])
+        sid = reg.register(subject, schema)
+        return 200, {"id": sid}, "application/json"
+
+    def _registry_versions(self, subject, params, body):
+        reg = self._require_registry()
+        return 200, reg.versions(subject), "application/json"
+
+    def _registry_by_id(self, sid, params, body):
+        reg = self._require_registry()
+        try:
+            schema = reg.schema_by_id(int(sid))
+        except KeyError:
+            raise _HttpError(404, f"schema id {sid} not found")
+        return 200, {"schema": json.dumps(schema)}, "application/json"
 
     def _list_schemas(self, params, body):
         return 200, {"schemas": self.store.list_schemas()}, "application/json"
