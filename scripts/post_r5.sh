@@ -46,7 +46,7 @@ incomplete=0
 # appends its partial-result block to TPU_VALIDATION.md)
 GEOMESA_DEVVAL_TIMEOUT=2500 step_once device_validation_r5 2700 \
   python scripts/device_validation.py \
-  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile" \
+  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile or planned_count" \
   || incomplete=1
 
 # --- never hardware-witnessed: mesh GROUP BY (r4 flagship) and the join
