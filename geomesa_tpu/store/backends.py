@@ -369,6 +369,98 @@ class TpuBackend(ExecutionBackend):
         sub = table.take(rows)
         return rows[residual.mask(sub)]
 
+    def select_many_positions(
+        self, dev: "_MeshIndexState", index, extractions, intervals_list
+    ) -> list[np.ndarray]:
+        """Matching sorted-order positions for MANY queries in TWO device
+        dispatches total (VERDICT r4 item 2 — the multi-query row-retrieval
+        path): a planned pair-count pass sizes the gather EXACTLY, then one
+        block gather serves every query. Dispatch RTT amortizes across the
+        batch the way the fused count/density steps do, and (query, block)
+        pair ids ship host→device in KBs where the per-row candidate slots
+        of :meth:`_mesh_select_positions` ship MBs per query.
+
+        Point layouts only (``dev.kind == "points"``; the block grid rides
+        the JOIN_BLOCK-aligned residency). Counts and gather evaluate the
+        same int-domain predicate, so gather overflow is impossible.
+        """
+        import jax.numpy as jnp
+
+        from geomesa_tpu.parallel.mesh import data_shards
+        from geomesa_tpu.parallel.query import (
+            cached_planned_count_step,
+            cached_planned_gather_step,
+            intervals_to_block_pairs,
+            pad_block_pairs,
+        )
+
+        mesh = self._get_mesh()
+        nq = len(intervals_list)
+        B = JOIN_BLOCK
+        if dev.rows_per_shard % B != 0:
+            raise ValueError(
+                f"residency not block-aligned: {dev.rows_per_shard} % {B}")
+        pair_q, pair_blk = intervals_to_block_pairs(intervals_list, B)
+        empty = [np.empty(0, dtype=np.int64) for _ in range(nq)]
+        if len(pair_q) == 0:
+            return empty
+        chunk = 8
+        budget = pad_bucket(len(pair_q), minimum=chunk)
+        pq, pb = pad_block_pairs(pair_q, pair_blk, budget)
+        payloads = [self._payload(index.sft, e) for e in extractions]
+        # bucket the query-batch dimension too: every compile-time shape
+        # (nqp, budget, capacity) is a bucket, so naturally varying batch
+        # sizes reuse cached executables instead of recompiling per size.
+        # Padded query slots are never referenced by any pair.
+        nqp = pad_bucket(nq, minimum=4)
+        boxes = np.stack(
+            [p[0] for p in payloads]
+            + [np.zeros_like(payloads[0][0])] * (nqp - nq)
+        )
+        times = np.stack(
+            [p[1] for p in payloads]
+            + [np.zeros_like(payloads[0][1])] * (nqp - nq)
+        )
+        args = (
+            *dev.spatial_cols(), jnp.int32(dev.n),
+        )
+        counts = np.asarray(
+            cached_planned_count_step(mesh, nqp, B, budget, chunk)(
+                *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
+                jnp.asarray(boxes[None]), jnp.asarray(times[None]),
+            )
+        )[0]
+        total = int(counts.sum())
+        if total == 0:
+            return empty
+        capacity = pad_bucket(total, minimum=128)
+        buf, hits = cached_planned_gather_step(mesh, B, budget, capacity,
+                                               chunk)(
+            *args, jnp.asarray(pq), jnp.asarray(pb),
+            jnp.asarray(boxes), jnp.asarray(times),
+        )
+        buf = np.asarray(buf)
+        hits = np.asarray(hits)
+        # per-pair spans: a pair's rows sit in its OWNER shard's buffer,
+        # consecutively in pair-index order (the device scan's write order)
+        blocks_per_shard = dev.rows_per_shard // B
+        out: list[list[np.ndarray]] = [[] for _ in range(nq)]
+        off = np.zeros(data_shards(mesh), dtype=np.int64)
+        for p in range(budget):
+            qid = int(pq[p])
+            if qid < 0:
+                continue
+            d = int(pb[p]) // blocks_per_shard
+            h = int(hits[p])
+            if h:
+                out[qid].append(buf[d, off[d]: off[d] + h])
+            off[d] += h
+        return [
+            np.concatenate(o).astype(np.int64) if o
+            else np.empty(0, dtype=np.int64)
+            for o in out
+        ]
+
     def _mesh_select_positions(
         self, dev: _MeshIndexState, index, extraction, intervals
     ) -> np.ndarray:

@@ -1100,6 +1100,143 @@ class DataStore:
             pending.append((i, payload, exactable))
         return pending
 
+    def select_many(self, type_name: str, queries) -> list:
+        """Batched row retrieval: results identical to
+        ``[self.query(type_name, q) for q in queries]`` with the whole
+        batch's device work in TWO dispatches — a planned pair-count pass
+        that sizes the gather exactly, then one block gather serving every
+        query (``TpuBackend.select_many_positions``). Dispatch RTT
+        amortizes across the batch the way the fused count/density paths
+        do (SURVEY.md §2.20 P4; the reference's BatchScanner multi-range
+        scan, ``AccumuloQueryPlan.scala:136`` role). Queries that don't
+        fit the batched shape — sub-plan unions, non-point or
+        non-resident indexes, an open device circuit, the oracle backend,
+        per-query timeouts — transparently run per-query instead, same
+        results either way.
+        """
+        import time as _time
+
+        st = self._state(type_name)
+        qs_raw = [
+            Query(filter=q) if isinstance(q, (str, ast.Filter)) or q is None
+            else q
+            for q in queries
+        ]
+        qs = (
+            [self._intercept(type_name, st.sft, q) for q in qs_raw]
+            if self._interceptors
+            else list(qs_raw)
+        )
+        ttl = self._age_off_ttl_ms(st.sft)
+        if ttl is not None and st.sft.dtg_field is not None:
+            from dataclasses import replace as _replace
+
+            qs = [
+                _replace(
+                    q,
+                    filter=ast.And((
+                        q.resolved_filter(),
+                        ast.Compare(
+                            ">=", st.sft.dtg_field,
+                            _ttl_cutoff_ms(ttl, q.hints.get("now_ms")),
+                        ),
+                    )),
+                )
+                for q in qs
+            ]
+
+        def _fallback(i):
+            # the ORIGINAL query object: query() runs interceptors itself,
+            # so handing it the intercepted copy would intercept twice
+            return self.query(type_name, qs_raw[i])
+
+        if (
+            st.total_rows == 0
+            or isinstance(self.backend, OracleBackend)
+            or not self._device_available()
+        ):
+            return [_fallback(i) for i in range(len(qs))]
+        t_start = _time.perf_counter()
+        main, indices, backend_state, stats, delta_table = st.snapshot()
+        main_n = 0 if main is None else len(main)
+        if main_n == 0 or not backend_state:
+            return [_fallback(i) for i in range(len(qs))]
+
+        planned = []
+        for q in qs:
+            cache_key = None if ttl is not None else self._plan_cache_key(q)
+            cached = self._plan_lookup(st, indices, cache_key)
+            if cached is None:
+                planner = QueryPlanner(st.sft, indices, stats)
+                cached = planner.plan(q)
+                self._plan_store(st, indices, cache_key, cached)
+            planned.append((q, *cached))  # (q, plan, f, info)
+        plan_ms = (_time.perf_counter() - t_start) * 1000.0
+
+        results: list = [None] * len(qs)
+        groups: dict[str, list[int]] = {}
+        for i, (q, plan, f, info) in enumerate(planned):
+            dev = backend_state.get(info.index_name)
+            if (
+                info.sub_plans
+                or dev is None
+                or getattr(dev, "kind", None) != "points"
+                or q.hints.get("timeout") is not None
+            ):
+                results[i] = _fallback(i)
+            else:
+                groups.setdefault(info.index_name, []).append(i)
+
+        from geomesa_tpu.store.reduce import reduce_result
+
+        for index_name, idxs in groups.items():
+            dev = backend_state[index_name]
+            index = indices[index_name]
+            try:
+                pos_lists = self.backend.select_many_positions(
+                    dev, index,
+                    [planned[i][3].extraction for i in idxs],
+                    [planned[i][1].intervals for i in idxs],
+                )
+            except Exception as e:  # noqa: BLE001 — failover, re-raise rest
+                if not self._is_device_error(e):
+                    raise
+                self._trip_device_circuit(e)
+                self.metrics.counter("store.query.device_failovers").inc()
+                for i in idxs:
+                    results[i] = _fallback(i)
+                continue
+            self._note_device_ok()
+            # audit decomposition: the shared device dispatches split
+            # evenly across the batch; each query's host tail (residual +
+            # reduce) is timed individually — a later query's audit row
+            # must not absorb earlier queries' reduce time
+            shared_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
+            for i, positions in zip(idxs, pos_lists):
+                q, plan, f, info = planned[i]
+                tq0 = _time.perf_counter()
+                rows = index.perm[positions]
+                # exact residual: same contract as backend.select (int
+                # superset culled on device, f64 filter settles the rest)
+                if len(rows) and not isinstance(f, ast.Include):
+                    rows = rows[f.mask(main.take(rows))]
+                rows = np.sort(rows)
+                if delta_table is not None:
+                    drows = np.nonzero(f.mask(delta_table))[0]
+                    rows = np.concatenate([rows, drows + main_n])
+                table = _take_combined(st.sft, main, main_n, delta_table,
+                                       rows)
+                tbl, rws, density, stats_out, bin_data = reduce_result(
+                    st.sft, table, rows, q)
+                tail_ms = (_time.perf_counter() - tq0) * 1000.0
+                self._audit(type_name, q, plan_ms / len(qs),
+                            shared_ms / len(idxs) + tail_ms, len(tbl))
+                results[i] = QueryResult(
+                    tbl, rws, info, density=density, stats=stats_out,
+                    bin_data=bin_data,
+                )
+        return results
+
     def count_many(self, type_name: str, queries, loose: bool = True):
         """Batched counts for many queries in ONE device pass.
 
