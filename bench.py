@@ -1728,6 +1728,16 @@ def _compact(r: dict) -> dict:
         "parity": (all(flags) if flags else None),
         "n": d.get("n_points") or d.get("n_trajectories") or d.get("total_rows"),
     }
+    # the config's own CPU-referee time, when it reports one: on
+    # cpu-fallback sweeps an x<1 entry then reads against the referee it
+    # actually raced (availability record), not the hardware baseline
+    ref = next(
+        (v for k, v in d.items()
+         if k.startswith("cpu") and k.endswith("ms") and v is not None),
+        None,
+    )
+    if ref is not None:
+        c["ref_ms"] = ref
     if r.get("error"):
         c["error"] = str(r["error"])[:120]
     return c
