@@ -70,7 +70,7 @@ class RemoteDataStore:
         return json.loads(self._get(path, params))
 
     def _send(self, method: str, path: str, body: dict | None = None,
-              params: dict | None = None):
+              params: dict | None = None, headers: dict | None = None):
         """JSON mutation request; server 4xx errors re-raise as the local
         store's exception types (the web layer maps ValueError→400,
         KeyError→404, PermissionError→403 — invert that mapping here)."""
@@ -78,10 +78,11 @@ class RemoteDataStore:
         if params:
             url += "?" + urllib.parse.urlencode(params)
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {},
-        )
+        hdrs = dict(headers or {})
+        if data:
+            hdrs["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 raw = r.read()
@@ -148,6 +149,45 @@ class RemoteDataStore:
             params["cql"] = cql if isinstance(cql, str) else ast.to_cql(cql)
         out = self._get_json(f"/api/schemas/{type_name}/stats/count", params)
         return float(out["count"])
+
+    def select_many(self, type_name: str, queries) -> list[QueryResult]:
+        """Batched row retrieval over the wire (``POST .../select-many``):
+        the remote owner runs the whole batch's device work in two
+        dispatches (DataStore.select_many) and per-query Arrow IPC tables
+        come back — one HTTP round trip for N queries, the federation
+        analog of the local batch path. Queries may be CQL strings/None
+        or Query objects (filter only; auths follow the same
+        fail-closed/forward-header contract as :meth:`query`)."""
+        import base64
+
+        from geomesa_tpu.io.arrow import from_ipc_bytes
+
+        cqls = []
+        headers = None
+        for q in queries:
+            if isinstance(q, Query):
+                if q.auths is not None:
+                    if self.forward_auths_header is None:
+                        raise PermissionError(
+                            "remote member cannot apply caller visibility; "
+                            "configure forward_auths_header")
+                    headers = {self.forward_auths_header: ",".join(q.auths)}
+                f = q.resolved_filter()
+                cqls.append(
+                    None if isinstance(f, ast.Include)
+                    else (f if isinstance(f, str) else ast.to_cql(f)))
+            else:
+                cqls.append(q if q is None or isinstance(q, str)
+                            else ast.to_cql(q))
+        out = self._send(
+            "POST", f"/api/schemas/{type_name}/select-many",
+            {"queries": cqls}, headers=headers)
+        sft = self.get_schema(type_name)
+        results = []
+        for rec in out["results"]:
+            table = from_ipc_bytes(sft, base64.b64decode(rec["arrow_b64"]))
+            results.append(QueryResult(table, np.arange(len(table))))
+        return results
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
                        value_cols=(), now_ms: int | None = None):

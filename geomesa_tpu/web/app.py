@@ -125,6 +125,7 @@ class GeoMesaApp:
             ("DELETE", r"^/api/schemas/([^/]+)/features$", self._delete_features),
             ("GET", r"^/api/schemas/([^/]+)/query$", self._query),
             ("POST", r"^/api/schemas/([^/]+)/count-many$", self._count_many),
+            ("POST", r"^/api/schemas/([^/]+)/select-many$", self._select_many),
             ("POST", r"^/api/schemas/([^/]+)/density-many$", self._density_many),
             ("POST", r"^/api/schemas/([^/]+)/aggregate$", self._aggregate),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
@@ -577,6 +578,34 @@ class GeoMesaApp:
             name, queries, loose=bool(body.get("loose", True))
         )
         return 200, {"counts": counts}, "application/json"
+
+    def _select_many(self, name, params, body):
+        """POST {"queries": [cql|null, ...]} → {"results": [{"count": n,
+        "arrow_b64": ...}, ...]}: batched row retrieval — the whole
+        batch's device work in two dispatches (DataStore.select_many),
+        per-query Arrow IPC back. The federation surface of the batched
+        read path; caller visibility applies per query through the shared
+        reduce pipeline."""
+        import base64
+
+        from geomesa_tpu.io.arrow import to_ipc_bytes
+
+        if not body or "queries" not in body:
+            raise _HttpError(400, 'body must be {"queries": [...]}')
+        sm = getattr(self.store, "select_many", None)
+        if sm is None:
+            raise _HttpError(400, "store does not support batched selects")
+        auths = self._restricted_auths(name, params)
+        queries = [Query(filter=c, auths=auths) for c in body["queries"]]
+        out = [
+            {
+                "count": int(r.count),
+                "arrow_b64": base64.b64encode(
+                    to_ipc_bytes(r.table)).decode(),
+            }
+            for r in sm(name, queries)
+        ]
+        return 200, {"results": out}, "application/json"
 
     def _aggregate(self, name, params, body):
         """POST {"queries": [cql, ...], "group_by": [cols], "value_cols":

@@ -94,6 +94,30 @@ class TestSelectMany:
         [r] = ds.select_many("o", ["BBOX(geom, 0, 0, 2, 2)"])
         assert list(r.table.fids) == ["f1"]
 
+    def test_remote_select_many_over_http(self, sel_ds):
+        """Federation surface: the whole batch crosses the wire in ONE
+        HTTP round trip, per-query Arrow tables come back identical to
+        the local batch path."""
+        import threading
+        from wsgiref.simple_server import make_server
+
+        from geomesa_tpu.store.remote import RemoteDataStore
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        httpd = make_server("127.0.0.1", 0, GeoMesaApp(sel_ds))
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            remote = RemoteDataStore(f"http://127.0.0.1:{port}")
+            cqls = [c for c in _cqls()][:4]
+            got = remote.select_many("ev", cqls)
+            want = sel_ds.select_many("ev", cqls)
+            for g, w in zip(got, want):
+                assert sorted(g.table.fids) == sorted(w.table.fids)
+        finally:
+            httpd.shutdown()
+
     def test_two_dispatch_budget(self, sel_ds, monkeypatch):
         """The batched path must not dispatch per query: count the backend
         device calls while a 6-query batch runs."""
