@@ -21,6 +21,7 @@ Supported grammar:
 
     SELECT <alias.col|alias.*|agg, ...> FROM <t1> <a> JOIN <t2> <b>
       ON <alias>.<attr> = <alias>.<attr>        -- attribute equi-join
+      [JOIN <tN> <x> ON <bound-alias>.<attr> = <x>.<attr>]...   -- N-way
       [WHERE <conjuncts, each referencing exactly one alias>]
       [GROUP BY <alias.col, ...>] [HAVING agg(alias.col|*) <op> number]
       [ORDER BY <name> [ASC|DESC], ...] [LIMIT <n>]
@@ -509,12 +510,13 @@ def _group_first_occurrence(keys):
     return list(seen), groups
 
 
-def _parse_join_grouped(m, original, a1, sft1, a2, sft2):
-    """Shared ``JOIN ... GROUP BY`` clause machinery for BOTH join forms
-    (spatial ON ST_* and attribute equi-join): parse + validate group keys,
-    select items, HAVING, ORDER BY, LIMIT; compute the materialization set
-    ``need`` and its attribute types. One parser so the two ON forms'
-    grammar and fold semantics cannot drift."""
+def _parse_join_grouped(m, original, alias_sfts, select_text=None):
+    """Shared ``JOIN ... GROUP BY`` clause machinery for EVERY join form
+    (spatial ON ST_*, attribute equi-join, N-way chains): parse + validate
+    group keys, select items, HAVING, ORDER BY, LIMIT; compute the
+    materialization set ``need`` and its attribute types.
+    ``alias_sfts``: ordered {alias: FeatureType}. One parser so the join
+    forms' grammar and fold semantics cannot drift."""
     gcols: list[tuple[str, str]] = []
     for raw in _split_top(_clause(m, original, "group")):
         gm = re.match(r"^(\w+)\.(\w+)$", raw.strip())
@@ -523,7 +525,7 @@ def _parse_join_grouped(m, original, a1, sft1, a2, sft2):
         gcols.append((gm.group(1), gm.group(2)))
 
     def _attr(alias, col, agg=False):
-        sft = sft1 if alias == a1 else sft2 if alias == a2 else None
+        sft = alias_sfts.get(alias)
         if sft is None:
             raise SqlError(f"unknown alias {alias!r}")
         attr = next((a for a in sft.attributes if a.name == col), None)
@@ -541,7 +543,9 @@ def _parse_join_grouped(m, original, a1, sft1, a2, sft2):
     # fold can never diverge from the single-table fold (null masks, float64
     # AVG, distinct semantics)
     items: list[tuple[str, str, str | None, str, str | None]] = []
-    for raw in _split_top(m.group("select")):
+    # multi-join: the select list lives in the HEAD match, not the tail
+    for raw in _split_top(select_text if select_text is not None
+                          else m.group("select")):
         raw = raw.strip()
         am = re.match(r"^(.*?)\s+as\s+(\w+)$", raw, re.IGNORECASE | re.DOTALL)
         expr, out = (am.group(1).strip(), am.group(2)) if am else (raw, None)
@@ -665,7 +669,7 @@ def _join_grouped_fold(ds, m, original, t1, a1, sft1, a2, sft2,
     from geomesa_tpu.schema.columnar import Column, GeometryColumn
 
     gcols, items, hit, hop, hlit, order, limit, need, types = \
-        _parse_join_grouped(m, original, a1, sft1, a2, sft2)
+        _parse_join_grouped(m, original, {a1: sft1, a2: sft2})
     right = ds.query(m.group("t2"), Query(auths=auths)).table
     rgeoms = right.geom_column().geometries()
     vals_acc: dict[tuple[str, str], list] = {kc: [] for kc in need}
@@ -840,20 +844,6 @@ def _sql_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
     )
 
 
-_EQUIJOIN = re.compile(
-    r"^\s*select\s+(?P<select>.+?)\s+"
-    r"from\s+(?P<t1>\w+)\s+(?P<a1>\w+)\s+"
-    r"join\s+(?P<t2>\w+)\s+(?P<a2>\w+)\s+"
-    r"on\s+(?P<xa>\w+)\.(?P<xc>\w+)\s*=\s*(?P<ya>\w+)\.(?P<yc>\w+)"
-    r"(?:\s+where\s+(?P<where>.+?))?"
-    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
-    r"(?:\s+having\s+(?P<having>.+?))?"
-    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
-    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
-    re.IGNORECASE | re.DOTALL,
-)
-
-
 def _equi_key_arrays(lcol, rcol, a1, a2, lc, rc):
     """Join-key columns → (lkeys, lvalid, rkeys, rvalid) in one comparable,
     C-sortable domain. Numeric/Date/Boolean pairs meet in int64 when both
@@ -927,122 +917,17 @@ def _equi_pairs(lkeys, lvalid, rkeys, rvalid):
     return li, rj
 
 
-def _sql_equi_join(ds, m, original: str | None = None, auths=None) -> SqlResult:
-    """Attribute equi-join: ``JOIN ... ON a.attr = b.attr`` over the
-    lexicoder-ordered key-space (the ``AccumuloJoinIndex.scala:45`` /
-    Spark relational-join role the reference reaches through Catalyst,
-    ``GeoMesaRelation.scala:47``). Executed as a host sorted-merge over
-    the two planned scans — WHERE conjuncts referencing exactly one alias
-    push down to that side's index-planned query, so both inputs arrive
-    pre-pruned. Composes with the join grammar's GROUP BY/HAVING/ORDER
-    BY/LIMIT through the same fold helpers as the spatial join (the
-    semantics must not drift between the two ON forms)."""
-    original = original if original is not None else m.string
-    t1, a1, t2, a2 = m.group("t1"), m.group("a1"), m.group("t2"), m.group("a2")
-    if a1 == a2:
-        raise SqlError(f"duplicate join alias {a1!r}")
-    xa, xc, ya, yc = m.group("xa"), m.group("xc"), m.group("ya"), m.group("yc")
-    if {xa, ya} != {a1, a2}:
-        raise SqlError("ON predicate must reference both join aliases")
-    lc, rc = (xc, yc) if xa == a1 else (yc, xc)
-    sft1 = ds.get_schema(t1)
-    sft2 = ds.get_schema(t2)
-    for sft, alias, col, t in ((sft1, a1, lc, t1), (sft2, a2, rc, t2)):
-        if col not in {a.name for a in sft.attributes}:
-            raise SqlError(f"unknown column {alias}.{col} on {t}")
-
-    # WHERE: each top-level conjunct pushes to the single side it
-    # references (index pruning on BOTH scans); mixed conjuncts are out of
-    # the v1 grammar, same as the spatial join's restriction
-    lcql = rcql = None
-    if m.group("where"):
-        w = _clause(m, original, "where")
-        lparts, rparts = [], []
-        for part in _split_conjuncts(w):
-            refs = set()
-
-            def _scan(seg):
-                for am in re.finditer(r"\b(\w+)\s*\.", seg):
-                    refs.add(am.group(1))
-                return seg
-
-            _map_unquoted(part, _scan)
-            refs &= {a1, a2}
-            if refs == {a1}:
-                lparts.append(_map_unquoted(
-                    part, lambda seg: re.sub(rf"\b{a1}\s*\.", "", seg)))
-            elif refs == {a2}:
-                rparts.append(_map_unquoted(
-                    part, lambda seg: re.sub(rf"\b{a2}\s*\.", "", seg)))
-            else:
-                raise SqlError(
-                    f"equi-join WHERE conjunct must reference exactly one "
-                    f"alias: {part.strip()!r}")
-        lcql = _rewrite_where(" AND ".join(lparts)) if lparts else None
-        rcql = _rewrite_where(" AND ".join(rparts)) if rparts else None
-
-    left = ds.query(t1, Query(filter=lcql, auths=auths)).table
-    right = ds.query(t2, Query(filter=rcql, auths=auths)).table
-    li, rj = _equi_pairs(*_equi_key_arrays(
-        left.columns[lc], right.columns[rc], a1, a2, lc, rc))
-
-    def _pair_column(alias, col):
-        """Joined column as (type, values, valid) via fancy indexing —
-        no per-pair Python loop, so 1M-pair joins stay vectorized."""
-        src = left if alias == a1 else right
-        idx = li if alias == a1 else rj
-        c = src.columns[col]
-        v = c.geometries() if c.type.is_geometry else c.values
-        return c.type, np.asarray(v)[idx], c.is_valid()[idx]
-
-    if m.group("group"):
-        return _equi_grouped_fold(
-            m, original, a1, sft1, a2, sft2, _pair_column)
-    if m.group("having"):
-        raise SqlError("HAVING requires GROUP BY")
-    order = _parse_order(m.group("order"), dotted=True)
-    limit = int(m.group("limit")) if m.group("limit") else None
-    if limit is not None and not order:
-        li, rj = li[:limit], rj[:limit]
-
-    items: list[tuple[str, str]] = []
-    for raw in _split_top(m.group("select")):
-        im = re.match(r"^(\w+)\.(\w+|\*)$", raw.strip())
-        if not im:
-            raise SqlError(f"join select items must be alias.col: {raw!r}")
-        items.append((im.group(1), im.group(2)))
-    expanded: list[tuple[str, str]] = []
-    for alias, col in items:
-        if alias not in (a1, a2):
-            raise SqlError(f"unknown alias {alias!r}")
-        sft = sft1 if alias == a1 else sft2
-        if col == "*":
-            expanded.extend((alias, attr.name) for attr in sft.attributes)
-        elif col not in {attr.name for attr in sft.attributes}:
-            raise SqlError(f"unknown column {alias}.{col}")
-        else:
-            expanded.append((alias, col))
-    expanded = list(dict.fromkeys(expanded))
-    out = {}
-    for alias, col in expanded:
-        _, vals, valid = _pair_column(alias, col)
-        vo = np.empty(len(vals), dtype=object)
-        vo[:] = vals
-        vo[~valid] = None
-        out[f"{alias}.{col}"] = vo
-    return _apply_order_limit(SqlResult(out), order, limit if order else None)
-
-
-def _equi_grouped_fold(m, original, a1, sft1, a2, sft2,
-                       pair_column) -> SqlResult:
-    """Equi-join GROUP BY: the shared join-grammar parse + fold tail
-    (:func:`_parse_join_grouped` / :func:`_grouped_fold_output` — the same
-    helpers the spatial join streams through), fed by vectorized joined
-    columns from the sorted-merge pairing."""
+def _equi_grouped_fold(m, original, alias_sfts, pair_column,
+                       select_text=None) -> SqlResult:
+    """Equi-join GROUP BY (2-way and N-way): the shared join-grammar parse
+    + fold tail (:func:`_parse_join_grouped` / :func:`_grouped_fold_output`
+    — the same helpers the spatial join streams through), fed by
+    vectorized joined columns from the sorted-merge pairing."""
     from geomesa_tpu.schema.columnar import Column, GeometryColumn
 
     gcols, items, hit, hop, hlit, order, limit, need, types = \
-        _parse_join_grouped(m, original, a1, sft1, a2, sft2)
+        _parse_join_grouped(m, original, alias_sfts,
+                            select_text=select_text)
     joined = {}
     for alias, col in need:
         t, vals, valid = pair_column(alias, col)
@@ -1058,6 +943,170 @@ def _equi_grouped_fold(m, original, a1, sft1, a2, sft2,
             )
     return _grouped_fold_output(
         joined, gcols, items, hit, hop, hlit, order, limit)
+
+
+_MJ_HEAD = re.compile(
+    r"^\s*select\s+(?P<select>.+?)\s+from\s+(?P<t1>\w+)\s+(?P<a1>\w+)"
+    r"(?=\s+join\b)",
+    re.IGNORECASE | re.DOTALL,
+)
+_MJ_SEG = re.compile(
+    r"\s+join\s+(?P<t>\w+)\s+(?P<a>\w+)\s+"
+    r"on\s+(?P<xa>\w+)\.(?P<xc>\w+)\s*=\s*(?P<ya>\w+)\.(?P<yc>\w+)",
+    re.IGNORECASE,
+)
+_MJ_TAIL = re.compile(
+    r"^(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+having\s+(?P<having>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?))?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
+    """N-way attribute equi-join: ``FROM t1 a JOIN t2 b ON a.x = b.x JOIN
+    t3 c ON b.y = c.y ...`` — the arbitrary relational join chains the
+    reference reaches through Spark Catalyst
+    (``GeoMesaRelation.scala:47``). Executed as a LEFT-DEEP chain of
+    vectorized sorted-merges: each ON links the newly joined table to one
+    already-bound alias; the running result is a set of per-alias row
+    index arrays, re-indexed by each merge (no materialization until the
+    select list). WHERE conjuncts referencing exactly one alias push down
+    to that alias's index-planned scan; GROUP BY/HAVING/ORDER BY/LIMIT
+    compose through the shared join-grammar helpers."""
+    m1 = _MJ_HEAD.match(masked)
+    if not m1:
+        raise SqlError(f"cannot parse multi-join: {original!r}")
+    pos = m1.end()
+    segs = []
+    while True:
+        sm = _MJ_SEG.match(masked, pos)
+        if sm is None:
+            break
+        segs.append(sm)
+        pos = sm.end()
+    if not segs:
+        raise SqlError(f"cannot parse join: {original!r}")
+    tm = _MJ_TAIL.match(masked[pos:])
+    if tm is None:
+        raise SqlError(f"cannot parse join tail: {original[pos:]!r}")
+    # tail spans are relative to masked[pos:] — pair them with the SAME
+    # slice of the original for _clause's span slicing
+    tail_original = original[pos:]
+
+    a1 = m1.group("a1")
+    aliases: dict[str, str] = {a1: m1.group("t1")}
+    for sm in segs:
+        a = sm.group("a")
+        if a in aliases:
+            raise SqlError(f"duplicate join alias {a!r}")
+        aliases[a] = sm.group("t")
+    sfts = {a: ds.get_schema(t) for a, t in aliases.items()}
+
+    # WHERE: each conjunct routes to the one alias it references
+    per_alias: dict[str, list[str]] = {a: [] for a in aliases}
+    if tm.group("where"):
+        w = _clause(tm, tail_original, "where")
+        for part in _split_conjuncts(w):
+            refs = set()
+
+            def _scan(seg):
+                for am in re.finditer(r"\b(\w+)\s*\.", seg):
+                    refs.add(am.group(1))
+                return seg
+
+            _map_unquoted(part, _scan)
+            refs &= set(aliases)
+            if len(refs) != 1:
+                raise SqlError(
+                    f"multi-join WHERE conjunct must reference exactly one "
+                    f"alias: {part.strip()!r}")
+            al = refs.pop()
+            per_alias[al].append(_map_unquoted(
+                part, lambda seg: re.sub(rf"\b{al}\s*\.", "", seg)))
+    tables = {
+        a: ds.query(
+            aliases[a],
+            Query(
+                filter=_rewrite_where(" AND ".join(cs)) if cs else None,
+                auths=auths,
+            ),
+        ).table
+        for a, cs in per_alias.items()
+    }
+
+    def _check_col(alias, col):
+        if col not in {at.name for at in sfts[alias].attributes}:
+            raise SqlError(f"unknown column {alias}.{col}")
+
+    bound: dict[str, np.ndarray] | None = None
+    bound_aliases = {a1}
+    for sm in segs:
+        xa, xc = sm.group("xa"), sm.group("xc")
+        ya, yc = sm.group("ya"), sm.group("yc")
+        new_a = sm.group("a")
+        if xa == new_a and ya in bound_aliases:
+            ba, bc, nc = ya, yc, xc
+        elif ya == new_a and xa in bound_aliases:
+            ba, bc, nc = xa, xc, yc
+        else:
+            raise SqlError(
+                f"ON for {new_a!r} must link it to an already-bound alias")
+        _check_col(ba, bc)
+        _check_col(new_a, nc)
+        lcol = tables[ba].columns[bc]
+        if bound is not None:
+            lcol = lcol.take(bound[ba])
+        li, rj = _equi_pairs(*_equi_key_arrays(
+            lcol, tables[new_a].columns[nc], ba, new_a, bc, nc))
+        if bound is None:
+            bound = {ba: li}
+        else:
+            bound = {al: v[li] for al, v in bound.items()}
+        bound[new_a] = rj
+        bound_aliases.add(new_a)
+
+    def pair_column(alias, col):
+        c = tables[alias].columns[col]
+        idx = bound[alias]
+        v = c.geometries() if c.type.is_geometry else c.values
+        return c.type, np.asarray(v)[idx], c.is_valid()[idx]
+
+    if tm.group("group"):
+        return _equi_grouped_fold(tm, tail_original, sfts, pair_column,
+                                  select_text=m1.group("select"))
+    if tm.group("having"):
+        raise SqlError("HAVING requires GROUP BY")
+    order = _parse_order(tm.group("order"), dotted=True)
+    limit = int(tm.group("limit")) if tm.group("limit") else None
+    if limit is not None and not order:
+        bound = {al: v[:limit] for al, v in bound.items()}
+
+    expanded: list[tuple[str, str]] = []
+    for raw in _split_top(m1.group("select")):
+        im = re.match(r"^(\w+)\.(\w+|\*)$", raw.strip())
+        if not im:
+            raise SqlError(f"join select items must be alias.col: {raw!r}")
+        alias, col = im.group(1), im.group(2)
+        if alias not in aliases:
+            raise SqlError(f"unknown alias {alias!r}")
+        if col == "*":
+            expanded.extend(
+                (alias, at.name) for at in sfts[alias].attributes)
+        else:
+            _check_col(alias, col)
+            expanded.append((alias, col))
+    expanded = list(dict.fromkeys(expanded))
+    out = {}
+    for alias, col in expanded:
+        _, vals, valid = pair_column(alias, col)
+        vo = np.empty(len(vals), dtype=object)
+        vo[:] = vals
+        vo[~valid] = None
+        out[f"{alias}.{col}"] = vo
+    return _apply_order_limit(SqlResult(out), order, limit if order else None)
 
 
 _MESH_AGG_TYPES = (
@@ -1257,9 +1306,18 @@ def sql(ds, statement: str, auths=None) -> SqlResult:
     jm = _JOIN.match(masked)
     if jm:
         return _sql_join(ds, jm, statement, auths=auths)
-    em = _EQUIJOIN.match(masked)
-    if em:
-        return _sql_equi_join(ds, em, statement, auths=auths)
+    # attribute equi-join chains (2-way and N-way): dispatch on STRUCTURE
+    # (head + at least one ON a.x = b.y segment), never on token counts —
+    # a column literally named "join" must keep parsing via _CLAUSES
+    mh = _MJ_HEAD.match(masked)
+    if mh is not None:
+        mpos = mh.end()
+        nsegs = 0
+        while (msm := _MJ_SEG.match(masked, mpos)) is not None:
+            nsegs += 1
+            mpos = msm.end()
+        if nsegs >= 1:
+            return _sql_multi_join(ds, masked, statement, auths=auths)
     m = _CLAUSES.match(masked)
     if not m:
         raise SqlError(f"cannot parse: {statement!r}")

@@ -183,6 +183,112 @@ class TestEquiJoin:
                        "ON a.cust = b.tier")
 
 
+class TestMultiJoin:
+    """N-way equi-join chains (left-deep sorted-merge composition)."""
+
+    @pytest.fixture(scope="class")
+    def tri_ds(self):
+        rng = np.random.default_rng(9)
+        store = DataStore(backend="tpu")
+        # orders -> customers -> regions
+        store.create_schema("ords", "cust:String,amount:Double,*geom:Point")
+        store.create_schema("custs", "cid:String,region:Integer,*geom:Point")
+        store.create_schema("regs", "rid:Integer,rname:String,*geom:Point")
+        n = 300
+        cust = [f"c{int(i)}" for i in rng.integers(0, 30, n)]
+        amount = rng.uniform(1, 100, n).round(2)
+        store.write("ords", [
+            {"cust": cust[i], "amount": float(amount[i]),
+             "geom": Point(0.0, 0.0)}
+            for i in range(n)
+        ], fids=[f"o{i}" for i in range(n)])
+        store.write("custs", [
+            {"cid": f"c{k}", "region": int(k % 5), "geom": Point(0.0, 0.0)}
+            for k in range(30)
+        ], fids=[f"c{k}" for k in range(30)])
+        store.write("regs", [
+            {"rid": k, "rname": f"R{k}", "geom": Point(0.0, 0.0)}
+            for k in range(4)  # region 4 has no row: inner join drops it
+        ], fids=[f"r{k}" for k in range(4)])
+        store._truth = pd.DataFrame({"cust": cust, "amount": amount})
+        return store
+
+    def _referee(self, tri_ds):
+        o = tri_ds._truth
+        c = pd.DataFrame({"cid": [f"c{k}" for k in range(30)],
+                          "region": [k % 5 for k in range(30)]})
+        r = pd.DataFrame({"rid": range(4),
+                          "rname": [f"R{k}" for k in range(4)]})
+        return (o.merge(c, left_on="cust", right_on="cid")
+                 .merge(r, left_on="region", right_on="rid"))
+
+    def test_three_way_parity(self, tri_ds):
+        res = sql(tri_ds,
+                  "SELECT a.cust, a.amount, c.rname FROM ords a "
+                  "JOIN custs b ON a.cust = b.cid "
+                  "JOIN regs c ON b.region = c.rid")
+        want = self._referee(tri_ds)
+        assert len(res) == len(want)
+        got = sorted(zip(res.columns["a.cust"],
+                         (round(float(v), 2) for v in res.columns["a.amount"]),
+                         res.columns["c.rname"]))
+        exp = sorted(zip(want["cust"], want["amount"].round(2),
+                         want["rname"]))
+        assert got == exp
+
+    def test_three_way_group_by(self, tri_ds):
+        res = sql(tri_ds,
+                  "SELECT c.rname, COUNT(*) AS n, SUM(a.amount) AS s "
+                  "FROM ords a JOIN custs b ON a.cust = b.cid "
+                  "JOIN regs c ON b.region = c.rid "
+                  "GROUP BY c.rname ORDER BY c.rname")
+        g = self._referee(tri_ds).groupby("rname").agg(
+            n=("cust", "size"), s=("amount", "sum")).sort_index()
+        assert list(res.columns["c.rname"]) == list(g.index)
+        assert [int(v) for v in res.columns["n"]] == g["n"].tolist()
+        np.testing.assert_allclose(
+            [float(v) for v in res.columns["s"]], g["s"].to_numpy())
+
+    def test_where_routes_in_chain(self, tri_ds):
+        res = sql(tri_ds,
+                  "SELECT a.cust FROM ords a "
+                  "JOIN custs b ON a.cust = b.cid "
+                  "JOIN regs c ON b.region = c.rid "
+                  "WHERE a.amount > 50 AND c.rname = 'R2'")
+        w = self._referee(tri_ds)
+        want = w[(w["amount"] > 50) & (w["rname"] == "R2")]
+        assert len(res) == len(want)
+
+    def test_unbound_on_alias_rejected(self, tri_ds):
+        with pytest.raises(SqlError, match="already-bound"):
+            sql(tri_ds,
+                "SELECT a.cust FROM ords a "
+                "JOIN custs b ON a.cust = b.cid "
+                "JOIN regs c ON d.region = c.rid")
+
+    def test_four_way_chain(self, tri_ds):
+        # self-join the chain one more level: regs joined again by rid
+        res = sql(tri_ds,
+                  "SELECT a.cust, d.rname FROM ords a "
+                  "JOIN custs b ON a.cust = b.cid "
+                  "JOIN regs c ON b.region = c.rid "
+                  "JOIN regs d ON c.rid = d.rid")
+        want = self._referee(tri_ds)  # rid self-join is 1:1
+        assert len(res) == len(want)
+
+
+def test_column_named_join_still_parses():
+    """Dispatch must gate on join STRUCTURE, not token counts: a column
+    literally named ``join`` keeps riding the single-table path."""
+    store = DataStore(backend="tpu")
+    store.create_schema("jt", "join:Integer,*geom:Point")
+    store.write("jt", [{"join": i, "geom": Point(0.0, 0.0)}
+                       for i in range(5)],
+                fids=[f"j{i}" for i in range(5)])
+    res = sql(store, "SELECT join FROM jt WHERE join > 2")
+    assert sorted(int(v) for v in res.columns["join"]) == [3, 4]
+
+
 class TestSplitConjuncts:
     def test_basic(self):
         assert _split_conjuncts("a.x > 1 AND b.y = 2") == \

@@ -181,6 +181,18 @@ class TestCompiledMeshPath:
         assert r.density is not None
         assert abs(float(r.density.sum()) - exact[0]) < 1e-3
 
+        # batched select_many (round 5): the whole batch's rows in two
+        # dispatches, per-query-identical to the oracle
+        sel_qs = [
+            "BBOX(geom, -60, -40, 60, 40)",
+            "BBOX(geom, 10, 10, 20, 20)",
+            "BBOX(geom, 100, 20, 150, 60)",
+        ]
+        batch = tpu.select_many("evt", sel_qs)
+        for q, r_b in zip(sel_qs, batch):
+            assert set(r_b.table.fids) == set(oracle.query("evt", q).table.fids)
+        assert tpu.metrics.counter("store.query.device_failovers").count == 0
+
         # batched device KNN matches brute force
         from geomesa_tpu.process.knn import knn_many
 
