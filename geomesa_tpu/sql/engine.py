@@ -21,7 +21,8 @@ Supported grammar:
 
     SELECT <alias.col|alias.*|agg, ...> FROM <t1> <a> JOIN <t2> <b>
       ON <alias>.<attr> = <alias>.<attr>        -- attribute equi-join
-      [JOIN <tN> <x> ON <bound-alias>.<attr> = <x>.<attr>]...   -- N-way
+      [[LEFT [OUTER]] JOIN <tN> <x>
+        ON <bound-alias>.<attr> = <x>.<attr>]... -- N-way chains
       [WHERE <conjuncts, each referencing exactly one alias>]
       [GROUP BY <alias.col, ...>] [HAVING agg(alias.col|*) <op> number]
       [ORDER BY <name> [ASC|DESC], ...] [LIMIT <n>]
@@ -947,11 +948,11 @@ def _equi_grouped_fold(m, original, alias_sfts, pair_column,
 
 _MJ_HEAD = re.compile(
     r"^\s*select\s+(?P<select>.+?)\s+from\s+(?P<t1>\w+)\s+(?P<a1>\w+)"
-    r"(?=\s+join\b)",
+    r"(?=\s+(?:left\s+(?:outer\s+)?)?join\b)",
     re.IGNORECASE | re.DOTALL,
 )
 _MJ_SEG = re.compile(
-    r"\s+join\s+(?P<t>\w+)\s+(?P<a>\w+)\s+"
+    r"\s+(?P<left>left\s+(?:outer\s+)?)?join\s+(?P<t>\w+)\s+(?P<a>\w+)\s+"
     r"on\s+(?P<xa>\w+)\.(?P<xc>\w+)\s*=\s*(?P<ya>\w+)\.(?P<yc>\w+)",
     re.IGNORECASE,
 )
@@ -973,9 +974,12 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
     vectorized sorted-merges: each ON links the newly joined table to one
     already-bound alias; the running result is a set of per-alias row
     index arrays, re-indexed by each merge (no materialization until the
-    select list). WHERE conjuncts referencing exactly one alias push down
-    to that alias's index-planned scan; GROUP BY/HAVING/ORDER BY/LIMIT
-    compose through the shared join-grammar helpers."""
+    select list). ``LEFT [OUTER] JOIN`` keeps unmatched bound rows with a
+    -1 sentinel for the new alias — its columns surface as SQL NULL and
+    its keys never match downstream joins (NULL-propagation semantics).
+    WHERE conjuncts referencing exactly one alias push down to that
+    alias's index-planned scan; GROUP BY/HAVING/ORDER BY/LIMIT compose
+    through the shared join-grammar helpers."""
     m1 = _MJ_HEAD.match(masked)
     if not m1:
         raise SqlError(f"cannot parse multi-join: {original!r}")
@@ -1041,6 +1045,17 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
         if col not in {at.name for at in sfts[alias].attributes}:
             raise SqlError(f"unknown column {alias}.{col}")
 
+    def _take_masked(col, idx):
+        """Column at ``idx`` with -1 sentinels (unmatched LEFT-JOIN rows)
+        reading as NULL: value slot 0, validity cleared."""
+        miss = idx < 0
+        if not miss.any():
+            return col.take(idx)
+        out = col.take(np.where(miss, 0, idx))
+        valid = out.is_valid() & ~miss
+        out.valid = valid
+        return out
+
     bound: dict[str, np.ndarray] | None = None
     bound_aliases = {a1}
     for sm in segs:
@@ -1057,10 +1072,19 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
         _check_col(ba, bc)
         _check_col(new_a, nc)
         lcol = tables[ba].columns[bc]
+        nl = len(lcol) if bound is None else len(bound[ba])
         if bound is not None:
-            lcol = lcol.take(bound[ba])
+            lcol = _take_masked(lcol, bound[ba])
         li, rj = _equi_pairs(*_equi_key_arrays(
             lcol, tables[new_a].columns[nc], ba, new_a, bc, nc))
+        if sm.group("left"):
+            # unmatched bound rows survive with a -1 sentinel for new_a
+            unmatched = np.setdiff1d(np.arange(nl, dtype=np.int64), li)
+            li = np.concatenate([li, unmatched])
+            rj = np.concatenate(
+                [rj, np.full(len(unmatched), -1, dtype=rj.dtype)])
+            keep = np.argsort(li, kind="stable")  # left-major determinism
+            li, rj = li[keep], rj[keep]
         if bound is None:
             bound = {ba: li}
         else:
@@ -1071,8 +1095,10 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
     def pair_column(alias, col):
         c = tables[alias].columns[col]
         idx = bound[alias]
+        miss = idx < 0
+        safe = np.where(miss, 0, idx)
         v = c.geometries() if c.type.is_geometry else c.values
-        return c.type, np.asarray(v)[idx], c.is_valid()[idx]
+        return c.type, np.asarray(v)[safe], c.is_valid()[safe] & ~miss
 
     if tm.group("group"):
         return _equi_grouped_fold(tm, tail_original, sfts, pair_column,

@@ -277,6 +277,73 @@ class TestMultiJoin:
         assert len(res) == len(want)
 
 
+class TestLeftJoin:
+    def test_left_join_keeps_unmatched(self, eq_ds):
+        res = sql(eq_ds,
+                  "SELECT a.cust, b.tier FROM orders a "
+                  "LEFT JOIN cust b ON a.cust = b.cid")
+        l = eq_ds._truth
+        r = eq_ds._ctruth[eq_ds._ctruth["cid"].notna()]
+        want = l.merge(r, left_on="cust", right_on="cid", how="left")
+        assert len(res) == len(want) == len(l)
+        # unmatched (incl. NULL-key) left rows surface tier as None
+        n_null = sum(1 for v in res.columns["b.tier"] if v is None)
+        assert n_null == int(want["tier"].isna().sum())
+
+    def test_left_outer_spelling(self, eq_ds):
+        r1 = sql(eq_ds, "SELECT a.cust FROM orders a "
+                        "LEFT JOIN cust b ON a.cust = b.cid")
+        r2 = sql(eq_ds, "SELECT a.cust FROM orders a "
+                        "LEFT OUTER JOIN cust b ON a.cust = b.cid")
+        assert len(r1) == len(r2)
+
+    def test_left_join_group_by_counts_nulls(self, eq_ds):
+        res = sql(eq_ds,
+                  "SELECT b.tier, COUNT(*) AS n, COUNT(b.tier) AS nn "
+                  "FROM orders a LEFT JOIN cust b ON a.cust = b.cid "
+                  "GROUP BY b.tier")
+        by_tier = {t: (int(n), int(nn)) for t, n, nn in
+                   zip(res.columns["b.tier"], res.columns["n"],
+                       res.columns["nn"])}
+        # the NULL group exists and COUNT(col) excludes its NULLs
+        assert None in by_tier
+        assert by_tier[None][1] == 0
+        total = sum(n for n, _ in by_tier.values())
+        assert total == len(eq_ds._truth)
+
+    def test_left_then_inner_null_propagation(self, eq_ds):
+        # NULL keys from the left join never match the next inner join
+        store = DataStore(backend="tpu")
+        store.create_schema("x", "k:Integer,*geom:Point")
+        store.create_schema("y", "k:Integer,v:Integer,*geom:Point")
+        store.create_schema("z", "v:Integer,w:String,*geom:Point")
+        store.write("x", [{"k": i, "geom": Point(0.0, 0.0)}
+                          for i in range(4)],
+                    fids=[f"x{i}" for i in range(4)])
+        store.write("y", [{"k": 0, "v": 10, "geom": Point(0.0, 0.0)},
+                          {"k": 1, "v": 11, "geom": Point(0.0, 0.0)}],
+                    fids=["y0", "y1"])
+        store.write("z", [{"v": 10, "w": "ten", "geom": Point(0.0, 0.0)},
+                          {"v": 11, "w": "eleven", "geom": Point(0.0, 0.0)}],
+                    fids=["z0", "z1"])
+        res = sql(store,
+                  "SELECT a.k, c.w FROM x a "
+                  "LEFT JOIN y b ON a.k = b.k "
+                  "JOIN z c ON b.v = c.v")
+        # k=2,3 got NULL v from the left join; the inner join drops them
+        assert sorted(int(v) for v in res.columns["a.k"]) == [0, 1]
+        # but a left-join chain keeps them with NULL w
+        res2 = sql(store,
+                   "SELECT a.k, c.w FROM x a "
+                   "LEFT JOIN y b ON a.k = b.k "
+                   "LEFT JOIN z c ON b.v = c.v")
+        assert len(res2) == 4
+        ws = {int(k): w for k, w in zip(res2.columns["a.k"],
+                                        res2.columns["c.w"])}
+        assert ws[0] == "ten" and ws[1] == "eleven"
+        assert ws[2] is None and ws[3] is None
+
+
 def test_column_named_join_still_parses():
     """Dispatch must gate on join STRUCTURE, not token counts: a column
     literally named ``join`` keeps riding the single-table path."""
