@@ -240,16 +240,18 @@ def cached_batched_count_step(mesh: Mesh, impl: str = "auto"):
 
 @lru_cache(maxsize=None)
 def cached_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
-                              n_pairs: int, chunk: int = 8):
+                              n_pairs: int, chunk: int = 8,
+                              overlap: bool = False):
     return make_planned_count_step(mesh, n_queries, block_rows, n_pairs,
-                                   chunk=chunk)
+                                   chunk=chunk, overlap=overlap)
 
 
 @lru_cache(maxsize=None)
 def cached_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
-                               capacity: int, chunk: int = 8):
+                               capacity: int, chunk: int = 8,
+                               overlap: bool = False):
     return make_planned_gather_step(mesh, block_rows, n_pairs, capacity,
-                                    chunk=chunk)
+                                    chunk=chunk, overlap=overlap)
 
 
 def _batched_time_match(bins, offs, times):
@@ -528,22 +530,43 @@ def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
-def _planned_block_mask(x, y, bins, offs, base, true_n, boxes, times,
-                        si, qj, block_rows: int):
+def _batched_overlap_masks(fxmin, fymin, fxmax, fymax, bins, offs, base,
+                           true_n, boxes, times):
+    """(Q, Nl) bool overlap-mode analog of :func:`_batched_masks` (the
+    XZ bbox layout): row bbox intersects any query box AND the time
+    windows match. MUST agree bit-for-bit with
+    :func:`make_batched_overlap_step`'s inline match and
+    :func:`_slot_overlap` (the exact-mode edge contract)."""
+    x1 = fxmin[None, None, :]
+    y1 = fymin[None, None, :]
+    x2 = fxmax[None, None, :]
+    y2 = fymax[None, None, :]
+    match = (
+        (x1 <= boxes[:, :, 1, None])
+        & (x2 >= boxes[:, :, 0, None])
+        & (y1 <= boxes[:, :, 3, None])
+        & (y2 >= boxes[:, :, 2, None])
+    ).any(axis=1)
+    match = match & _batched_time_match(bins, offs, times)
+    rows_valid = (
+        base + jnp.arange(fxmin.shape[0], dtype=jnp.int32)
+    ) < true_n
+    return match & rows_valid[None, :]
+
+
+def _planned_block_mask(cols, base, true_n, boxes, times, si, qj,
+                        block_rows: int, overlap: bool = False):
     """(block_rows,) bool: rows of the block at local offset ``si``
     matching query ``qj`` — a dynamic slice fed through
-    :func:`_batched_masks`, so the pruned steps share the ONE home of the
-    inclusive predicate semantics with the fused full-scan kernels (they
-    must agree bit-for-bit: config 7's pruned headline and select_many's
-    exact-capacity argument both rest on that parity)."""
-    xs = jax.lax.dynamic_slice(x, (si,), (block_rows,))
-    ys = jax.lax.dynamic_slice(y, (si,), (block_rows,))
-    bs = jax.lax.dynamic_slice(bins, (si,), (block_rows,))
-    os_ = jax.lax.dynamic_slice(offs, (si,), (block_rows,))
-    return _batched_masks(
-        xs, ys, bs, os_, base + si, true_n, boxes[qj][None],
-        times[qj][None],
-    )[0]
+    :func:`_batched_masks` (point containment) or
+    :func:`_batched_overlap_masks` (bbox overlap), so the pruned steps
+    share the ONE home of the inclusive predicate semantics with the
+    fused full-scan kernels (they must agree bit-for-bit: config 7's
+    pruned headline and select_many's exact-capacity argument both rest
+    on that parity)."""
+    sl = [jax.lax.dynamic_slice(c, (si,), (block_rows,)) for c in cols]
+    f = _batched_overlap_masks if overlap else _batched_masks
+    return f(*sl, base + si, true_n, boxes[qj][None], times[qj][None])[0]
 
 
 def intervals_to_block_pairs(intervals_per_query, block_rows: int):
@@ -588,7 +611,8 @@ def pad_block_pairs(pair_q, pair_blk, n_pairs: int):
 
 
 def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
-                            n_pairs: int, chunk: int = 8):
+                            n_pairs: int, chunk: int = 8,
+                            overlap: bool = False):
     """Index-pruned resident count: exact batched counts touching ONLY the
     planner's candidate blocks (VERDICT r4 item 3 — the z-index route that
     lifts the 125M resident scan off the full-scan compute bound).
@@ -613,16 +637,14 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
     its owned blocks, merged with one psum.
     """
     assert n_pairs % chunk == 0, (n_pairs, chunk)
+    n_spatial = 6 if overlap else 4
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
+            *(P(DATA_AXIS) for _ in range(n_spatial)),
             P(),
             P(None, None),              # pair_q (R, P) replicated
             P(None, None),              # pair_blk (R, P)
@@ -632,9 +654,10 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
         out_specs=P(None, QUERY_AXIS),
         check_vma=False,
     )
-    def step(x, y, bins, offs, true_n, pair_q_r, pair_blk_r, boxes_r,
-             times_r):
-        n = x.shape[0]
+    def step(*sargs):
+        cols = sargs[:n_spatial]
+        true_n, pair_q_r, pair_blk_r, boxes_r, times_r = sargs[n_spatial:]
+        n = cols[0].shape[0]
         # a block straddling a shard boundary would be owned by NO shard —
         # a silent undercount; shard with shard_columns(multiple=block_rows)
         assert n % block_rows == 0, (
@@ -661,13 +684,13 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
                 qi = jnp.clip(qloc, 0, ql - 1)
 
                 def count_one(si, qj, ok):
-                    # the block predicate IS _batched_masks on the sliced
-                    # rows — the single home of the inclusive semantics,
-                    # so the pruned path can never drift from the fused
-                    # scan it must match bit-for-bit
+                    # the block predicate IS the fused kernels' mask on
+                    # the sliced rows — the single home of the inclusive
+                    # semantics, so the pruned path can never drift from
+                    # the scan it must match bit-for-bit
                     m = _planned_block_mask(
-                        x, y, bins, offs, base, true_n, boxes, times,
-                        si, qj, block_rows)
+                        cols, base, true_n, boxes, times, si, qj,
+                        block_rows, overlap=overlap)
                     return jnp.where(ok, m.sum(dtype=jnp.int32), 0)
 
                 cnts = jax.vmap(count_one)(s, qi, own)  # (chunk,)
@@ -688,7 +711,8 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
 
 
 def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
-                             capacity: int, chunk: int = 8):
+                             capacity: int, chunk: int = 8,
+                             overlap: bool = False):
     """Batched multi-query row retrieval over planner candidate BLOCKS:
     ONE dispatch serves the whole query batch (the ``select_many`` path —
     dispatch RTTs amortize across queries like the fused count steps, and
@@ -710,16 +734,14 @@ def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
     (same predicate, so overflow is impossible by construction).
     """
     assert n_pairs % chunk == 0, (n_pairs, chunk)
+    n_spatial = 6 if overlap else 4
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
-            P(DATA_AXIS),
+            *(P(DATA_AXIS) for _ in range(n_spatial)),
             P(),
             P(None),
             P(None),
@@ -729,8 +751,10 @@ def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
         out_specs=(P(DATA_AXIS, None), P(None)),
         check_vma=False,
     )
-    def step(x, y, bins, offs, true_n, pair_q, pair_blk, boxes, times):
-        n = x.shape[0]
+    def step(*sargs):
+        cols = sargs[:n_spatial]
+        true_n, pair_q, pair_blk, boxes, times = sargs[n_spatial:]
+        n = cols[0].shape[0]
         assert n % block_rows == 0, (
             f"per-shard rows {n} not a multiple of block_rows {block_rows}")
         base = jax.lax.axis_index(DATA_AXIS) * n
@@ -748,8 +772,8 @@ def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
             def pair_mask(si, qj):
                 # same single-home predicate as the planned count step
                 return _planned_block_mask(
-                    x, y, bins, offs, base, true_n, boxes, times,
-                    si, qj, block_rows)
+                    cols, base, true_n, boxes, times, si, qj,
+                    block_rows, overlap=overlap)
 
             masks = jax.vmap(pair_mask)(s, qi)       # (chunk, block_rows)
             masks = masks & own[:, None]

@@ -380,9 +380,12 @@ class TpuBackend(ExecutionBackend):
         pair ids ship host→device in KBs where the per-row candidate slots
         of :meth:`_mesh_select_positions` ship MBs per query.
 
-        Point layouts only (``dev.kind == "points"``; the block grid rides
-        the JOIN_BLOCK-aligned residency). Counts and gather evaluate the
-        same int-domain predicate, so gather overflow is impossible.
+        Both residency layouts serve: point containment
+        (``dev.kind == "points"``) and bbox overlap (``"bboxes"`` — the
+        XZ extended-geometry layout); the block grid rides the
+        JOIN_BLOCK-aligned residency either way. Counts and gather
+        evaluate the same int-domain predicate, so gather overflow is
+        impossible.
         """
         import jax.numpy as jnp
 
@@ -407,7 +410,10 @@ class TpuBackend(ExecutionBackend):
         chunk = 8
         budget = pad_bucket(len(pair_q), minimum=chunk)
         pq, pb = pad_block_pairs(pair_q, pair_blk, budget)
-        payloads = [self._payload(index.sft, e) for e in extractions]
+        overlap = dev.kind == "bboxes"
+        payloads = [
+            self._payload(index.sft, e, overlap=overlap) for e in extractions
+        ]
         # bucket the query-batch dimension too: every compile-time shape
         # (nqp, budget, capacity) is a bucket, so naturally varying batch
         # sizes reuse cached executables instead of recompiling per size.
@@ -425,7 +431,8 @@ class TpuBackend(ExecutionBackend):
             *dev.spatial_cols(), jnp.int32(dev.n),
         )
         counts = np.asarray(
-            cached_planned_count_step(mesh, nqp, B, budget, chunk)(
+            cached_planned_count_step(mesh, nqp, B, budget, chunk,
+                                      overlap=overlap)(
                 *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
                 jnp.asarray(boxes[None]), jnp.asarray(times[None]),
             )
@@ -435,7 +442,7 @@ class TpuBackend(ExecutionBackend):
             return empty
         capacity = pad_bucket(total, minimum=128)
         buf, hits = cached_planned_gather_step(mesh, B, budget, capacity,
-                                               chunk)(
+                                               chunk, overlap=overlap)(
             *args, jnp.asarray(pq), jnp.asarray(pb),
             jnp.asarray(boxes), jnp.asarray(times),
         )

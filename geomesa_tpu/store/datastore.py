@@ -1109,10 +1109,10 @@ class DataStore:
         amortizes across the batch the way the fused count/density paths
         do (SURVEY.md §2.20 P4; the reference's BatchScanner multi-range
         scan, ``AccumuloQueryPlan.scala:136`` role). Queries that don't
-        fit the batched shape — sub-plan unions, non-point or
-        non-resident indexes, an open device circuit, the oracle backend,
-        per-query timeouts — transparently run per-query instead, same
-        results either way.
+        fit the batched shape — sub-plan unions, non-resident indexes, an
+        open device circuit, the oracle backend, per-query timeouts —
+        transparently run per-query instead, same results either way.
+        Point AND extended-geometry (XZ bbox-layout) stores both batch.
         """
         import time as _time
 
@@ -1180,7 +1180,7 @@ class DataStore:
             if (
                 info.sub_plans
                 or dev is None
-                or getattr(dev, "kind", None) != "points"
+                or getattr(dev, "kind", None) not in ("points", "bboxes")
                 or q.hints.get("timeout") is not None
             ):
                 results[i] = _fallback(i)

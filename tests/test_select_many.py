@@ -94,6 +94,37 @@ class TestSelectMany:
         [r] = ds.select_many("o", ["BBOX(geom, 0, 0, 2, 2)"])
         assert list(r.table.fids) == ["f1"]
 
+    def test_extended_geometry_store_batches(self):
+        """XZ bbox-layout stores batch too (overlap-mode planned steps):
+        linestring tracks, per-query-identical to query()."""
+        from geomesa_tpu.geometry.types import LineString
+
+        rng = np.random.default_rng(23)
+        ds = DataStore(backend="tpu")
+        ds.create_schema("trk", "name:String,*geom:LineString")
+        n = 5000
+        recs = []
+        for i in range(n):
+            x0 = float(rng.uniform(-60, 55))
+            y0 = float(rng.uniform(-60, 55))
+            recs.append({
+                "name": f"t{i}",
+                "geom": LineString([
+                    [x0, y0], [x0 + 2, y0 + 1], [x0 + 4, y0]]),
+            })
+        ds.write("trk", recs, fids=[f"t{i}" for i in range(n)])
+        ds.compact("trk")
+        cqls = [
+            "BBOX(geom, -30, -30, 0, 0)",
+            "BBOX(geom, 10, 10, 40, 40)",
+            "BBOX(geom, 100, 70, 120, 80)",  # empty
+        ]
+        batched = ds.select_many("trk", cqls)
+        for c, r in zip(cqls, batched):
+            want = ds.query("trk", c)
+            assert sorted(r.table.fids) == sorted(want.table.fids), c
+        assert batched[0].count > 0
+
     def test_remote_select_many_over_http(self, sel_ds):
         """Federation surface: the whole batch crosses the wire in ONE
         HTTP round trip, per-query Arrow tables come back identical to
