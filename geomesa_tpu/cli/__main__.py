@@ -418,7 +418,18 @@ def cmd_serve(args):
         from geomesa_tpu.security.auth import HeaderAuthorizationsProvider
 
         provider = HeaderAuthorizationsProvider(args.auths_header)
-    serve(ds, host=args.host, port=args.port, auth_provider=provider)
+    journal = None
+    if args.journal:
+        from geomesa_tpu.stream.journal import JournalBus
+
+        journal = JournalBus(args.journal)
+    registry = None
+    if args.registry:
+        from geomesa_tpu.stream.confluent import SchemaRegistry
+
+        registry = SchemaRegistry()
+    serve(ds, host=args.host, port=args.port, auth_provider=provider,
+          journal=journal, schema_registry=registry)
 
 
 def cmd_compact(args):
@@ -562,6 +573,16 @@ def main(argv=None):
         "--auths-header", default=None, metavar="HEADER",
         help="derive visibility auths from this trusted proxy header "
         "(AuthorizationsProvider role); absent header = no auths",
+    )
+    sp.add_argument(
+        "--journal", default=None, metavar="DIR",
+        help="serve this journal root over /api/journal (cross-host "
+        "stream transport for hosts with no shared mount)",
+    )
+    sp.add_argument(
+        "--registry", action="store_true",
+        help="serve a Confluent-protocol schema registry "
+        "(/subjects, /schemas/ids)",
     )
     sp.set_defaults(fn=cmd_serve)
 

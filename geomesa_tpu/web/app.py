@@ -816,7 +816,7 @@ class GeoMesaApp:
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True,
-          auth_provider=None):
+          auth_provider=None, journal=None, schema_registry=None):
     """Run the API on wsgiref's simple server (dev/ops tool, not a prod WSGI
     container — same posture as the reference's embedded servlets).
 
@@ -824,6 +824,9 @@ def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True
     per-type snapshot/mutator locking makes parallel queries + background
     compactions safe; pass False for single-threaded debugging.
     ``auth_provider``: see :class:`geomesa_tpu.security.auth.AuthorizationsProvider`.
+    ``journal``/``schema_registry``: attach the cross-host stream transport
+    (``/api/journal``) and Confluent-protocol registry (``/subjects``) —
+    see GeoMesaApp; the lease endpoint (``/api/lease``) is always on.
     """
     import socketserver
     from wsgiref.simple_server import WSGIServer, make_server
@@ -836,7 +839,9 @@ def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True
 
         cls = _ThreadingWSGIServer
     httpd = make_server(
-        host, port, GeoMesaApp(store, auth_provider=auth_provider),
+        host, port,
+        GeoMesaApp(store, auth_provider=auth_provider, journal=journal,
+                   schema_registry=schema_registry),
         server_class=cls,
     )
     print(f"geomesa-tpu REST on http://{host}:{port}/api")
