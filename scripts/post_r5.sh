@@ -41,12 +41,20 @@ step_once() {  # step_once <name> <timeout-s> <cmd...> — skip if done before;
 probe_step probe_r5 || { echo "tunnel not healthy; aborting"; exit 1; }
 incomplete=0
 
-# --- never hardware-witnessed: the five suite tests the 1800s cap cut off
-# (inner pytest cap strictly below the outer step cap so the wrapper always
-# appends its partial-result block to TPU_VALIDATION.md)
+# --- the single most important witness first, in its own SHORT step: the
+# mesh GROUP BY (round-4 flagship; FAILED on the 01:14 run with the
+# Sum-only all-reduce error, its fix never ran compiled). A short window
+# must land this even if nothing else fits.
+GEOMESA_DEVVAL_TIMEOUT=800 step_once grouped_agg_witness 900 \
+  python scripts/device_validation.py -k "grouped_agg" \
+  || incomplete=1
+
+# --- the remaining never-hardware-witnessed suite tests (inner pytest cap
+# strictly below the outer step cap so the wrapper always appends its
+# partial-result block to TPU_VALIDATION.md)
 GEOMESA_DEVVAL_TIMEOUT=2500 step_once device_validation_r5 2700 \
   python scripts/device_validation.py \
-  -k "public_compact or grouped_agg or journal or mxu_bincount or wms_tile or planned_count" \
+  -k "public_compact or journal or mxu_bincount or wms_tile or planned_count" \
   || incomplete=1
 
 # --- never hardware-witnessed: mesh GROUP BY (r4 flagship) and the join
