@@ -18,6 +18,9 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     DELETE /api/schemas/{name}/features?fids=a,b (WFS-T Delete)
     GET    /api/schemas/{name}/query?cql=&limit=&startIndex=&format=geojson|arrow|bin|avro|gml|csv|leaflet
     POST   /api/schemas/{name}/count-many        batched loose counts
+    POST   /api/schemas/{name}/select-many       batched row retrieval (whole
+                                                 batch in two device dispatches,
+                                                 per-query Arrow IPC back)
     POST   /api/schemas/{name}/density-many      batched shared-viewport heatmaps
     POST   /api/schemas/{name}/aggregate         batched grouped aggregation
     GET    /api/schemas/{name}/stats?stats=Count();MinMax(a)   sketch stats
@@ -29,6 +32,13 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/metrics                          metrics registry snapshot
     GET    /wfs?service=WFS&request=...          OGC WFS 2.0 KVP binding
     GET    /wms?service=WMS&request=...          OGC WMS 1.3.0 (GetMap tiles)
+    POST   /api/lease/{acquire|renew|release}    cross-host expiring leases
+                                                 (ZK DistributedLocking role)
+    POST   /api/journal/{topic}/publish          cross-host stream transport
+    GET    /api/journal/{topic}/{poll|tpoll|end} (Kafka-broker role; tpoll
+                                                 supports ?cursor= byte tail)
+    POST   /subjects/{s}/versions                Confluent schema registry
+    GET    /subjects/{s}/versions, /schemas/ids/{id}   (service half)
 """
 
 from __future__ import annotations
