@@ -124,6 +124,29 @@ class TestHttpLease:
             waited = time.monotonic() - t0
         assert 0.1 <= waited < 2.0  # waited for expiry, not the timeout
 
+    def test_renew_extends_and_rejects_stale_token(self, coordinator):
+        import json as _json
+        import urllib.request
+
+        _, app, url = coordinator
+        out = app.leases.acquire("r", "h", ttl_s=0.5)
+
+        def _post(op, body):
+            req = urllib.request.Request(
+                f"{url}/api/lease/{op}", data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return _json.loads(r.read())
+
+        # renew over HTTP extends the expiry
+        assert _post("renew", {"name": "r", "token": out["token"],
+                               "ttl_s": 30.0})["ok"]
+        assert app.leases._leases["r"][2] > time.time() + 10
+        # stale/garbage token cannot renew
+        assert not _post("renew", {"name": "r", "token": "nope",
+                                   "ttl_s": 30.0})["ok"]
+        app.leases.release("r", out["token"])
+
     def test_stale_release_does_not_evict_new_holder(self, coordinator):
         _, app, _ = coordinator
         old = app.leases.acquire("n", "h1", ttl_s=0.01)
