@@ -407,7 +407,13 @@ class TpuBackend(ExecutionBackend):
         empty = [np.empty(0, dtype=np.int64) for _ in range(nq)]
         if len(pair_q) == 0:
             return empty
-        chunk = 8
+        # pairs processed per scan step: larger chunks amortize per-step
+        # overhead on accelerators (live gather memory = chunk × JOIN_BLOCK
+        # × 24 B); power of two so the padded budget always divides
+        chunk = int(os.environ.get("GEOMESA_SELECT_BLOCK_CHUNK", "8"))
+        if chunk < 1 or chunk & (chunk - 1):
+            raise ValueError(
+                f"GEOMESA_SELECT_BLOCK_CHUNK must be a power of two: {chunk}")
         budget = pad_bucket(len(pair_q), minimum=chunk)
         pq, pb = pad_block_pairs(pair_q, pair_blk, budget)
         overlap = dev.kind == "bboxes"
