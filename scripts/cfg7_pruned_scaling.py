@@ -1,0 +1,118 @@
+"""Config-7 pruned-scan scaling study (CPU mesh): cover fraction, pair
+counts, and pruned-vs-full pass times across store sizes — the committed
+roofline analysis backing the z-index-pruned headline when hardware
+windows are scarce (VERDICT r4 item 3's alternative acceptance).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python scripts/cfg7_pruned_scaling.py
+
+Emits one JSON line per N with both measured times and the derived
+on-chip projection inputs (bytes touched per pass).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import geomesa_tpu  # noqa: F401, E402
+
+
+def main():
+    import jax.numpy as jnp
+
+    from bench import (
+        _bin_spans,
+        _pack_queries,
+        _plan_query_intervals,
+        _sharded_store,
+        make_queries,
+        synth_gdelt,
+    )
+    from geomesa_tpu.parallel.query import (
+        intervals_to_block_pairs,
+        make_planned_count_step,
+        make_repeated_count_step,
+        pad_block_pairs,
+    )
+
+    Q, R, BLOCK, chunk = 64, 3, 1024, 128
+    for N in (2_000_000, 10_000_000):
+        lon, lat, t_ms = synth_gdelt(N)
+        (mesh, cols, binned, nlon, nlat, xi, yi, bins, offs, build_s,
+         true_n, ex) = _sharded_store(lon, lat, t_ms, block_multiple=BLOCK)
+        spans = _bin_spans(ex["bins_sorted"])
+        all_boxes, all_times, per_batch, totals = [], [], [], []
+        t0 = time.perf_counter()
+        for r in range(R):
+            bf, wm = make_queries(Q, seed=100 + r)
+            qb, qt = _pack_queries(bf, wm, binned, nlon, nlat)
+            all_boxes.append(qb)
+            all_times.append(qt)
+            ivs = _plan_query_intervals(bf, wm, binned, ex["sfc"],
+                                        ex["z_sorted"], spans)
+            q_, b_ = intervals_to_block_pairs(ivs, BLOCK)
+            per_batch.append((q_, b_))
+            totals.append(len(q_))
+        plan_s = time.perf_counter() - t0
+        n_pairs = -(-max(totals) // chunk) * chunk
+        pq = np.stack([pad_block_pairs(q_, b_, n_pairs)[0]
+                       for q_, b_ in per_batch])
+        pb = np.stack([pad_block_pairs(q_, b_, n_pairs)[1]
+                       for q_, b_ in per_batch])
+        boxes_r = jnp.asarray(np.stack(all_boxes))
+        times_r = jnp.asarray(np.stack(all_times))
+        pq_j, pb_j = jnp.asarray(pq), jnp.asarray(pb)
+
+        full = make_repeated_count_step(mesh)
+        pruned = make_planned_count_step(mesh, Q, BLOCK, n_pairs, chunk=chunk)
+        args = (cols["x"], cols["y"], cols["bins"], cols["offs"], true_n)
+
+        def tmed(fn, iters=5):
+            fn()  # warm
+            ts = []
+            for _ in range(iters):
+                s = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - s)
+            return float(np.median(ts)) * 1e3
+
+        cf = np.asarray(full(*args, boxes_r, times_r))
+        cp = np.asarray(pruned(*args, pq_j, pb_j, boxes_r, times_r))
+        parity = bool(np.array_equal(cf, cp))
+        # per-pass = wall over R batches / R: dispatch overhead amortizes
+        # identically for both paths (differencing is too noisy on a
+        # shared CPU host)
+        full_pass = tmed(
+            lambda: np.asarray(full(*args, boxes_r, times_r))) / R
+        pr_pass = tmed(
+            lambda: np.asarray(pruned(*args, pq_j, pb_j, boxes_r,
+                                      times_r))) / R
+        print(json.dumps({
+            "n_rows": N,
+            "queries": Q,
+            "pairs_avg": int(np.mean(totals)),
+            "pairs_max": int(max(totals)),
+            "cover_rows_per_pass": int(n_pairs) * BLOCK,
+            "cover_fraction_of_full_work": round(
+                n_pairs * BLOCK / (N * Q), 5),
+            "gathered_mbytes_per_pass": round(n_pairs * BLOCK * 16 / 1e6, 1),
+            "full_scan_ms_per_pass": round(full_pass, 2),
+            "pruned_ms_per_pass": round(pr_pass, 2),
+            "speedup": round(full_pass / pr_pass, 2),
+            "plan_s_per_batch": round(plan_s / R, 2),
+            "parity": parity,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
