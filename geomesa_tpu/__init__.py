@@ -16,13 +16,25 @@ Reference capability map: /root/reference (GeoMesa 2.4.0-SNAPSHOT). This is a
 from-scratch TPU-first design, not a port — see SURVEY.md §7.
 """
 
-import jax as _jax
+import os as _os
 
-# 64-bit mode: spatio-temporal keys are 62/63-bit Morton codes and timestamps are
-# epoch-millis int64; coordinates are f64 on the host side of the seam. The device
-# (TPU) hot path is explicitly typed int32/f32/bf16 throughout (see
-# geomesa_tpu/store/backends.py) so MXU/VPU work never silently widens.
-_jax.config.update("jax_enable_x64", True)
+if not _os.environ.get("GEOMESA_TPU_NO_JAX"):
+    import jax as _jax
+
+    # 64-bit mode: spatio-temporal keys are 62/63-bit Morton codes and
+    # timestamps are epoch-millis int64; coordinates are f64 on the host side
+    # of the seam. The device (TPU) hot path is explicitly typed int32/f32/bf16
+    # throughout (see geomesa_tpu/store/backends.py) so MXU/VPU work never
+    # silently widens.
+    _jax.config.update("jax_enable_x64", True)
+else:
+    # GEOMESA_TPU_NO_JAX=1 keeps this import JAX-free for tooling that only
+    # needs the pure-Python layers (tpulint in CI: scripts/lint.sh). This
+    # __init__ is the one place that flips jax_enable_x64, so if some later
+    # import in the same process DOES pull in jax, make the flag reach it
+    # through jax's own env-var path — otherwise z-codes and epoch-millis
+    # would silently truncate to 32 bits.
+    _os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 __version__ = "0.1.0"
 

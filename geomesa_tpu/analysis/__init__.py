@@ -1,0 +1,33 @@
+"""tpulint — JAX/Pallas-aware static analysis for geomesa_tpu.
+
+The JVM reference enforces its layer contracts through the type system
+(PAPER.md §1); this package is the equivalent machine check for the
+invariants Python can't type: tracer-safe control flow (J001), sync-free
+hot paths (J002), stable jit caches (J003), the TPU 32-bit dtype
+contract (J004), and lock discipline in the stream layer (C001).
+
+Run it::
+
+    python -m geomesa_tpu.analysis --baseline .tpulint-baseline.json
+
+Pure AST: linted files are parsed, never imported, and this package
+imports neither JAX nor any other geomesa_tpu subsystem (scripts/lint.sh
+sets ``GEOMESA_TPU_NO_JAX=1`` so even the parent package import stays
+JAX-free). See docs/tpulint.md for the rule catalog, waiver syntax, and
+the baseline workflow.
+"""
+
+from geomesa_tpu.analysis.core import (
+    LintConfig,
+    Violation,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "LintConfig", "Violation", "lint_paths", "lint_source",
+    "load_baseline", "write_baseline", "apply_baseline",
+]

@@ -1,0 +1,95 @@
+"""tpulint CLI: ``python -m geomesa_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean against waivers+baseline, 1 = new violations,
+2 = usage error. Set ``GEOMESA_TPU_NO_JAX=1`` to keep the parent
+package import JAX-free (scripts/lint.sh does) — linting itself never
+imports JAX or any linted module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from geomesa_tpu.analysis.core import (
+    LintConfig,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from geomesa_tpu.analysis.report import render_json, render_text
+
+
+def default_target() -> str:
+    """The geomesa_tpu package directory itself."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m geomesa_tpu.analysis",
+        description="tpulint: JAX/Pallas-aware static analysis for "
+                    "geomesa_tpu (rules J001-J004, C001).",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint "
+                             "(default: the geomesa_tpu package)")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="baseline JSON; matching violations don't fail")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite --baseline with current violations "
+                             "and exit 0")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--rules", metavar="IDS",
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--verbose", action="store_true",
+                        help="also list waived/baselined violations")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from geomesa_tpu.analysis.rules import all_rules
+
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}  {rule.title}")
+        return 0
+
+    config = LintConfig(
+        rules=tuple(args.rules.split(",")) if args.rules else None,
+    )
+    paths = args.paths or [default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        violations = lint_paths(paths, config)
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("tpulint: --write-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, violations)
+        kept = sum(1 for v in violations if not v.waived)
+        print(f"tpulint: wrote {kept} entr{'y' if kept == 1 else 'ies'} to "
+              f"{args.baseline}")
+        return 0
+
+    if args.baseline:
+        apply_baseline(violations, load_baseline(args.baseline))
+
+    if args.format == "json":
+        print(render_json(violations))
+    else:
+        print(render_text(violations, verbose=args.verbose))
+    return 0 if all(v.suppressed for v in violations) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

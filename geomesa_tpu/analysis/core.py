@@ -1,0 +1,247 @@
+"""tpulint core: violations, waivers, baselines, and the lint driver.
+
+The analyzer is pure AST — linted files are parsed, never imported, so a
+full-package lint needs no JAX install and runs in a few seconds on a
+bare CPU box (the CI tier-1 budget). Layering contract: nothing in
+``geomesa_tpu.analysis`` may import JAX or any sibling geomesa_tpu
+subsystem; the linter must stay runnable on a bare CPU box.
+
+Suppression model, narrowest to widest:
+
+- per-line waiver: ``# tpulint: disable=J002`` (same line) or
+  ``# tpulint: disable-next-line=J002,C001`` — for reviewed, intentional
+  sites (e.g. the one sanctioned device→host readback of a hot path).
+- baseline file: a committed JSON multiset of known legacy violations
+  (``--baseline .tpulint-baseline.json``). Violations matching a baseline
+  entry report as ``baselined`` and do not fail the run; NEW violations
+  fail. ``--write-baseline`` refreshes the file. Entries are keyed by
+  (rule, path, normalized source line), not line numbers, so unrelated
+  edits don't invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Violation", "LintConfig", "Module", "lint_source", "lint_paths",
+    "load_baseline", "write_baseline", "apply_baseline", "iter_py_files",
+]
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    waived: bool = False
+    baselined: bool = False
+
+    @property
+    def suppressed(self) -> bool:
+        return self.waived or self.baselined
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+
+@dataclass
+class LintConfig:
+    """Rule scoping knobs. Path tuples are package-relative prefixes; a
+    module participates in a path-scoped rule when its package-relative
+    path starts with one of them. ``("",)`` means "everywhere" (the
+    fixture tests use that to lint files outside the package tree)."""
+
+    # J002 hot paths: the device scan/refine/aggregate layers.
+    j002_paths: tuple[str, ...] = ("ops/", "parallel/")
+    # J004 TPU dtype contract: everything that computes keys or runs on
+    # device — curve math feeds the device layout, so 64-bit creep there
+    # flows straight into kernels.
+    j004_paths: tuple[str, ...] = ("curve/", "index/", "ops/", "parallel/")
+    # C001 shared-state heuristics: package-wide — the rule self-scopes to
+    # classes that own a threading lock (the stream layer, lock utilities,
+    # and every other utils/locks user).
+    c001_paths: tuple[str, ...] = ("",)
+    # Names of rules to run; None = all registered.
+    rules: tuple[str, ...] | None = None
+
+    def in_scope(self, relpath: str, prefixes: tuple[str, ...]) -> bool:
+        return any(relpath.startswith(p) for p in prefixes)
+
+
+@dataclass
+class Module:
+    """One parsed file handed to every rule."""
+
+    path: str          # path as reported in violations
+    relpath: str       # package-relative path for rule scoping
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+_WAIVER = re.compile(
+    r"#\s*tpulint:\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)"
+)
+
+
+def _waivers(lines: list[str]) -> dict[int, set[str]]:
+    """Line number → set of waived rule ids ({'all'} waives everything)."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _WAIVER.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")}
+        target = i + 1 if m.group("next") else i
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def package_relpath(path: str) -> str:
+    """Path relative to the geomesa_tpu package root, for rule scoping.
+    Files outside the package keep their basename-ish path (path-scoped
+    rules then simply don't match unless the config says ``("",)``)."""
+    norm = path.replace(os.sep, "/")
+    marker = "geomesa_tpu/"
+    idx = norm.rfind(marker)
+    if idx >= 0:
+        return norm[idx + len(marker):]
+    return norm
+
+
+def lint_source(
+    source: str,
+    path: str,
+    config: LintConfig | None = None,
+    relpath: str | None = None,
+) -> list[Violation]:
+    """Lint one file's source text. Returns ALL violations, with per-line
+    waivers already applied (``waived=True``); baseline matching is a
+    separate pass (:func:`apply_baseline`)."""
+    from geomesa_tpu.analysis.rules import active_rules
+
+    config = config or LintConfig()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(
+            rule="E000", path=path, line=e.lineno or 1, col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )]
+    lines = source.splitlines()
+    mod = Module(
+        path=path,
+        relpath=relpath if relpath is not None else package_relpath(path),
+        source=source,
+        tree=tree,
+        lines=lines,
+    )
+    violations: list[Violation] = []
+    for rule in active_rules(config):
+        for v in rule.check(mod, config):
+            if not v.snippet:
+                v.snippet = mod.snippet(v.line)
+            violations.append(v)
+    waivers = _waivers(lines)
+    for v in violations:
+        waived = waivers.get(v.line, set())
+        if "all" in waived or v.rule in waived:
+            v.waived = True
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache"}
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            out.extend(
+                os.path.join(root, f) for f in sorted(files)
+                if f.endswith(".py")
+            )
+    return out
+
+
+def lint_paths(paths: list[str], config: LintConfig | None = None) -> list[Violation]:
+    violations: list[Violation] = []
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            source = f.read()
+        violations.extend(lint_source(source, fp, config))
+    return violations
+
+
+# -- baseline --------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file → multiset of (rule, path, snippet) keys."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} in {path}")
+    return Counter(
+        (e["rule"], e["path"], e["snippet"]) for e in data.get("entries", [])
+    )
+
+
+def write_baseline(path: str, violations: list[Violation]) -> None:
+    """Persist the still-unsuppressed violations as the new baseline."""
+    entries = [
+        {"rule": v.rule, "path": _portable(v.path), "line": v.line,
+         "snippet": v.snippet}
+        for v in violations if not v.waived
+    ]
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION, "entries": entries}, f,
+                  indent=1)
+        f.write("\n")
+
+
+def _portable(path: str) -> str:
+    """Repo-relative forward-slash path so baselines diff cleanly across
+    machines and operating systems."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    idx = norm.rfind("geomesa_tpu/")
+    return norm[idx:] if idx >= 0 else norm
+
+
+def apply_baseline(violations: list[Violation], baseline: Counter) -> None:
+    """Mark violations covered by the baseline multiset (in file order, so
+    N baseline entries for one snippet cover the first N occurrences)."""
+    remaining = Counter(baseline)
+    for v in violations:
+        if v.waived:
+            continue
+        key = (v.rule, _portable(v.path), v.snippet)
+        if remaining[key] > 0:
+            remaining[key] -= 1
+            v.baselined = True

@@ -1,0 +1,78 @@
+"""tpulint output: human text and a SARIF-ish JSON report."""
+
+from __future__ import annotations
+
+import json
+
+from geomesa_tpu.analysis.core import Violation
+
+
+def summarize(violations: list[Violation]) -> dict:
+    new = [v for v in violations if not v.suppressed]
+    return {
+        "total": len(violations),
+        "new": len(new),
+        "waived": sum(v.waived for v in violations),
+        "baselined": sum(v.baselined for v in violations),
+        "by_rule": {
+            rule: sum(1 for v in new if v.rule == rule)
+            for rule in sorted({v.rule for v in new})
+        },
+    }
+
+
+def render_text(violations: list[Violation], verbose: bool = False) -> str:
+    out = []
+    for v in violations:
+        if v.suppressed and not verbose:
+            continue
+        tag = " [waived]" if v.waived else (" [baselined]" if v.baselined else "")
+        out.append(f"{v.path}:{v.line}:{v.col}: {v.rule}{tag} {v.message}")
+        if v.snippet:
+            out.append(f"    {v.snippet}")
+    s = summarize(violations)
+    out.append(
+        f"tpulint: {s['new']} new violation(s), {s['waived']} waived, "
+        f"{s['baselined']} baselined"
+    )
+    if s["by_rule"]:
+        out.append("  new by rule: " + ", ".join(
+            f"{k}={n}" for k, n in s["by_rule"].items()))
+    return "\n".join(out)
+
+
+def render_json(violations: list[Violation]) -> str:
+    """SARIF-shaped: one run, one result per violation, pass/fail in
+    ``summary`` — enough structure for CI annotation tooling without the
+    full SARIF schema weight."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    rules = all_rules()
+    doc = {
+        "$schema": "tpulint-report",
+        "version": "1.0",
+        "tool": {
+            "name": "tpulint",
+            "rules": [
+                {"id": rid, "shortDescription": rules[rid].title}
+                for rid in sorted(rules)
+            ],
+        },
+        "results": [
+            {
+                "ruleId": v.rule,
+                "level": "note" if v.suppressed else "error",
+                "message": v.message,
+                "location": {"path": v.path, "line": v.line, "col": v.col},
+                "snippet": v.snippet,
+                "suppressed": v.suppressed,
+                "suppression": (
+                    "waiver" if v.waived
+                    else "baseline" if v.baselined else None
+                ),
+            }
+            for v in violations
+        ],
+        "summary": summarize(violations),
+    }
+    return json.dumps(doc, indent=1)

@@ -1,0 +1,53 @@
+"""tpulint rule registry.
+
+A rule is a class with a unique ``id``, a one-line ``title``, and a
+``check(module, config) -> Iterable[Violation]`` method. Registering is
+one decorator:
+
+    from geomesa_tpu.analysis.rules import register
+
+    @register
+    class MyRule:
+        id = "X001"
+        title = "what it catches"
+        def check(self, module, config): ...
+
+Rule modules listed in ``_RULE_MODULES`` are imported on first use; a new
+rule file only needs to be added there (see docs/tpulint.md "Adding a
+rule").
+"""
+
+from __future__ import annotations
+
+from importlib import import_module
+
+RULES: dict[str, object] = {}
+
+_RULE_MODULES = (
+    "geomesa_tpu.analysis.rules.jax_rules",
+    "geomesa_tpu.analysis.rules.concurrency",
+)
+
+
+def register(cls):
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, object]:
+    for mod in _RULE_MODULES:
+        import_module(mod)
+    return RULES
+
+
+def active_rules(config) -> list[object]:
+    rules = all_rules()
+    if config.rules is None:
+        return [rules[k] for k in sorted(rules)]
+    unknown = set(config.rules) - set(rules)
+    if unknown:
+        raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    return [rules[k] for k in sorted(config.rules)]

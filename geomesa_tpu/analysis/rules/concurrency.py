@@ -1,0 +1,217 @@
+"""C001: shared-state heuristics for the stream layer.
+
+Two checks per class in the scoped modules (stream/*, utils/*):
+
+- an instance attribute mutated BOTH inside and outside ``with
+  self._lock`` blocks (``__init__`` excluded — construction is
+  single-threaded; methods named ``*_locked`` are treated as
+  caller-holds-lock, the repo's convention for lock-internal helpers);
+- two locks of one class acquired in opposite nesting orders anywhere in
+  the module (the classic AB/BA deadlock shape).
+
+Heuristics, not proofs: a waiver with a one-line justification is the
+expected answer for intentional lock-free publication (e.g. a monotonic
+counter), and the rule text says so.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from geomesa_tpu.analysis.astutils import ImportMap
+from geomesa_tpu.analysis.core import Module, Violation
+from geomesa_tpu.analysis.rules import register
+
+LOCK_FACTORIES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+})
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "popleft",
+})
+
+
+def _self_attr(node: ast.AST, self_name: str) -> str | None:
+    """``self.X`` (or deeper: ``self.X[i]``, ``self.X.y``) → ``X``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self_name
+        ):
+            return node.attr
+        node = node.value
+    return None
+
+
+@dataclass
+class _Mutation:
+    attr: str
+    line: int
+    col: int
+    locked: bool
+    what: str
+
+
+@dataclass
+class _ClassReport:
+    lock_attrs: set[str] = field(default_factory=set)
+    mutations: list[_Mutation] = field(default_factory=list)
+    # (outer lock, inner lock) -> first line observed
+    lock_orders: dict[tuple[str, str], int] = field(default_factory=dict)
+
+
+class _MethodScan(ast.NodeVisitor):
+    def __init__(self, report: _ClassReport, self_name: str,
+                 held: bool):
+        self.report = report
+        self.self_name = self_name
+        self.lock_stack: list[str] = []
+        self.base_held = held
+
+    @property
+    def locked(self) -> bool:
+        return self.base_held or bool(self.lock_stack)
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr, self.self_name)
+            if attr is not None and attr in self.report.lock_attrs:
+                acquired.append(attr)
+        for attr in acquired:
+            for outer in self.lock_stack:
+                if outer != attr:
+                    pair = (outer, attr)
+                    self.report.lock_orders.setdefault(pair, node.lineno)
+            self.lock_stack.append(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.lock_stack.pop()
+
+    def _record(self, target: ast.AST, node: ast.AST, what: str):
+        attr = _self_attr(target, self.self_name)
+        if attr is None or attr in self.report.lock_attrs:
+            return
+        self.report.mutations.append(_Mutation(
+            attr=attr, line=node.lineno, col=node.col_offset,
+            locked=self.locked, what=what,
+        ))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record(t, node, "assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record(node.target, node, "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record(node.target, node, "assignment")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS:
+            attr = _self_attr(f.value, self.self_name)
+            if attr is not None and attr not in self.report.lock_attrs:
+                self.report.mutations.append(_Mutation(
+                    attr=attr, line=node.lineno, col=node.col_offset,
+                    locked=self.locked, what=f".{f.attr}()",
+                ))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # nested defs (callbacks) run who-knows-where; don't classify
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+
+@register
+class SharedStateHeuristics:
+    id = "C001"
+    title = "attributes mutated with and without the instance lock"
+
+    def check(self, mod: Module, config):
+        if not config.in_scope(mod.relpath, config.c001_paths):
+            return
+        imports = ImportMap(mod.tree)
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            report = _ClassReport()
+            methods = [
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            # pass 1: which attributes are locks?
+            for m in methods:
+                for node in ast.walk(m):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not (isinstance(node.value, ast.Call)
+                            and imports.resolve(node.value.func)
+                            in LOCK_FACTORIES):
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr(t, self_name(m))
+                        if attr is not None:
+                            report.lock_attrs.add(attr)
+            if not report.lock_attrs:
+                continue
+            # pass 2: classify every self.<attr> mutation
+            for m in methods:
+                if m.name == "__init__":
+                    continue
+                scan = _MethodScan(
+                    report, self_name(m), held=m.name.endswith("_locked"))
+                for stmt in m.body:
+                    scan.visit(stmt)
+            by_attr: dict[str, list[_Mutation]] = {}
+            for mut in report.mutations:
+                by_attr.setdefault(mut.attr, []).append(mut)
+            for attr, muts in sorted(by_attr.items()):
+                locked = [m for m in muts if m.locked]
+                unlocked = [m for m in muts if not m.locked]
+                if not (locked and unlocked):
+                    continue
+                locks = "/".join(sorted(report.lock_attrs))
+                for mut in unlocked:
+                    yield Violation(
+                        rule=self.id, path=mod.path, line=mut.line,
+                        col=mut.col, message=(
+                            f"{cls.name}.{attr} is mutated here "
+                            f"({mut.what}) without holding self.{locks}, "
+                            f"but under the lock elsewhere (e.g. line "
+                            f"{locked[0].line}) — move this mutation under "
+                            f"the lock, or waive with a justification if "
+                            f"the publication is intentionally lock-free"),
+                    )
+            # AB/BA ordering
+            for (a, b), line in sorted(report.lock_orders.items(),
+                                       key=lambda kv: kv[1]):
+                if (b, a) in report.lock_orders \
+                        and report.lock_orders[(b, a)] < line:
+                    yield Violation(
+                        rule=self.id, path=mod.path, line=line, col=0,
+                        message=(
+                            f"locks self.{a} -> self.{b} acquired here in "
+                            f"the opposite order of line "
+                            f"{report.lock_orders[(b, a)]} "
+                            f"(self.{b} -> self.{a}): AB/BA deadlock shape "
+                            f"— pick one global order"),
+                    )
+
+
+def self_name(method: ast.FunctionDef) -> str:
+    args = method.args.posonlyargs + method.args.args
+    return args[0].arg if args else "self"

@@ -1,0 +1,447 @@
+"""JAX/Pallas-aware rules: J001 tracer control flow, J002 host syncs in
+hot paths, J003 recompilation hazards, J004 the TPU dtype contract.
+
+All four share one per-module traced-context index: which functions trace
+(jit-decorated, pallas kernels, and functions nested inside those) and
+which local names hold traced values (taint). The index is computed once
+per file and cached on the Module object.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutils import (
+    CACHE_DECORATORS,
+    ImportMap,
+    build_parents,
+    iter_body_stmts,
+    jitted_functions,
+    mentions_traced,
+    nested_functions,
+    pallas_kernels,
+    parse_static_spec,
+    propagate_taint,
+)
+from geomesa_tpu.analysis.core import Module, Violation
+from geomesa_tpu.analysis.rules import register
+
+
+SYNC_FUNCS = {
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+    "jax.device_get": "jax.device_get",
+}
+SYNC_METHODS = frozenset({"item", "tolist"})
+SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+
+JNP_64 = frozenset({
+    "jax.numpy.int64", "jax.numpy.float64", "jax.numpy.uint64",
+})
+NP_64 = frozenset({"numpy.int64", "numpy.float64", "numpy.uint64"})
+STR_64 = frozenset({"int64", "float64", "uint64"})
+
+
+class _TracedIndex:
+    """Traced functions of a module with their taint sets."""
+
+    def __init__(self, mod: Module):
+        self.imports = ImportMap(mod.tree)
+        self.parents = build_parents(mod.tree)
+        # decorator expression nodes (J003 treats those specially)
+        self.deco_nodes: set[ast.AST] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    self.deco_nodes.update(ast.walk(dec))
+        # (fn, tainted names, context label); nested defs inherit taint
+        self.traced: list[tuple[ast.FunctionDef, set[str], str]] = []
+        seen: set[ast.AST] = set()
+
+        def params(fn: ast.FunctionDef) -> set[str]:
+            a = fn.args
+            out = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+            for star in (a.vararg, a.kwarg):
+                if star is not None:
+                    out.add(star.arg)
+            return out
+
+        def collect(fn, initial, label):
+            if fn in seen:
+                return
+            seen.add(fn)
+            tainted = propagate_taint(fn, initial, self.imports)
+            self.traced.append((fn, tainted, label))
+            for nf in nested_functions(fn):
+                collect(nf, params(nf) | tainted, label)
+
+        for fn, spec in jitted_functions(mod.tree, self.imports):
+            collect(fn, params(fn) - spec.static_params(fn),
+                    f"jit-traced function {fn.name!r}")
+        for fn in pallas_kernels(mod.tree, self.imports):
+            collect(fn, params(fn), f"pallas kernel {fn.name!r}")
+        self.traced_fns = {fn for fn, _, _ in self.traced}
+
+
+def traced_index(mod: Module) -> _TracedIndex:
+    idx = mod.__dict__.get("_traced_index")
+    if idx is None:
+        idx = _TracedIndex(mod)
+        mod.__dict__["_traced_index"] = idx
+    return idx
+
+
+def _stmt_exprs(stmt: ast.stmt) -> list[ast.AST]:
+    """Expression roots owned by this statement alone (child statements are
+    visited separately by iter_body_stmts, so compound statements only
+    contribute their headers)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return []
+    return [stmt]
+
+
+def _walk_no_lambda(expr: ast.AST):
+    """Walk an expression without descending into lambdas (deferred bodies
+    are traced at their call site, not here)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _sync_calls(expr: ast.AST, tainted: set[str], imports: ImportMap):
+    """(call node, spelling) for host-sync calls on traced values."""
+    for node in _walk_no_lambda(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        dotted = imports.resolve(f)
+        if dotted in SYNC_FUNCS:
+            if node.args and mentions_traced(node.args[0], tainted, imports):
+                yield node, SYNC_FUNCS[dotted]
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in SYNC_METHODS
+            and not node.args
+            and mentions_traced(f.value, tainted, imports)
+        ):
+            yield node, f".{f.attr}()"
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in SYNC_BUILTINS
+            and len(node.args) == 1
+            and mentions_traced(node.args[0], tainted, imports)
+        ):
+            yield node, f"{f.id}()"
+
+
+@register
+class TracerControlFlow:
+    id = "J001"
+    title = ("Python if/while/assert on traced values inside jit/pallas "
+             "functions")
+
+    def check(self, mod: Module, config):
+        idx = traced_index(mod)
+        for fn, tainted, label in idx.traced:
+            for stmt in iter_body_stmts(fn.body):
+                if isinstance(stmt, (ast.If, ast.While)):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    if mentions_traced(stmt.test, tainted, idx.imports):
+                        yield Violation(
+                            rule=self.id, path=mod.path, line=stmt.lineno,
+                            col=stmt.col_offset,
+                            message=(
+                                f"Python `{kind}` on a traced value inside "
+                                f"{label}: the branch is taken once at trace "
+                                f"time, not per element — use jnp.where / "
+                                f"lax.cond / lax.while_loop (or mark the "
+                                f"argument static)"),
+                        )
+                elif isinstance(stmt, ast.Assert):
+                    if mentions_traced(stmt.test, tainted, idx.imports):
+                        yield Violation(
+                            rule=self.id, path=mod.path, line=stmt.lineno,
+                            col=stmt.col_offset,
+                            message=(
+                                f"`assert` on a traced value inside {label}: "
+                                f"it evaluates the tracer at trace time — "
+                                f"use checkify or debug-mode host asserts"),
+                        )
+
+
+@register
+class HostSyncInHotPath:
+    id = "J002"
+    title = "host<->device syncs in ops/ and parallel/ hot paths"
+
+    def check(self, mod: Module, config):
+        idx = traced_index(mod)
+        # Inside traced code a "sync" is a trace-time conversion of a
+        # tracer — always wrong, flagged everywhere in the package.
+        for fn, tainted, label in idx.traced:
+            for stmt in iter_body_stmts(fn.body):
+                for expr in _stmt_exprs(stmt):
+                    for call, spelling in _sync_calls(
+                        expr, tainted, idx.imports
+                    ):
+                        yield Violation(
+                            rule=self.id, path=mod.path, line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                f"{spelling} on a traced value inside "
+                                f"{label}: forces a trace-time host "
+                                f"conversion — keep the value on device "
+                                f"(jnp ops) or hoist the conversion out of "
+                                f"the traced function"),
+                        )
+        # In hot-path modules, a per-iteration device->host readback inside
+        # a Python loop serializes the pipeline (one dispatch RTT per
+        # element). Single post-loop readbacks are the sanctioned seam.
+        if not config.in_scope(mod.relpath, config.j002_paths):
+            return
+        seen: set[ast.AST] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node in idx.traced_fns:
+                continue
+            host_tainted = propagate_taint(node, set(), idx.imports)
+            if not host_tainted:
+                continue
+            for stmt in iter_body_stmts(node.body):
+                if not isinstance(stmt, (ast.For, ast.While)):
+                    continue
+                for inner in iter_body_stmts(stmt.body):
+                    for expr in _stmt_exprs(inner):
+                        for call, spelling in _sync_calls(
+                            expr, host_tainted, idx.imports
+                        ):
+                            if call in seen:
+                                continue
+                            seen.add(call)
+                            yield Violation(
+                                rule=self.id, path=mod.path,
+                                line=call.lineno, col=call.col_offset,
+                                message=(
+                                    f"{spelling} on a device value inside a "
+                                    f"Python loop in a hot path: each "
+                                    f"iteration blocks on a device->host "
+                                    f"transfer — batch the readback once "
+                                    f"outside the loop"),
+                            )
+
+
+def _has_cache_decorator(fn: ast.FunctionDef, imports: ImportMap) -> bool:
+    return any(
+        imports.resolve(d if not isinstance(d, ast.Call) else d.func)
+        in CACHE_DECORATORS
+        for d in fn.decorator_list
+    )
+
+
+def _cache_covered(tree: ast.Module, imports: ImportMap) -> set[str]:
+    """Names of module-level functions reachable from a memoized
+    (lru_cache/cache-decorated) function — the repo's two-layer factory
+    idiom (``cached_select_count_step`` → ``make_select_count_step`` →
+    ``_make_count_step``) memoizes the OUTER layer, so every factory on
+    that chain builds its jit wrapper a bounded number of times."""
+    fns = {
+        n.name: n for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    edges: dict[str, set[str]] = {}
+    for name, fn in fns.items():
+        refs = {
+            node.id for node in ast.walk(fn)
+            if isinstance(node, ast.Name) and node.id in fns
+        } - {name}
+        edges[name] = refs
+    covered: set[str] = {
+        name for name, fn in fns.items()
+        if _has_cache_decorator(fn, imports)
+    }
+    frontier = list(covered)
+    while frontier:
+        cur = frontier.pop()
+        for ref in edges.get(cur, ()):
+            if ref not in covered:
+                covered.add(ref)
+                frontier.append(ref)
+    return covered
+
+
+def _enclosing_function(node, parents, *, through_decorators=False):
+    prev, cur = node, parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if through_decorators and prev in cur.decorator_list:
+                pass  # arrived via @decorator: keep walking outward
+            else:
+                return cur
+        prev, cur = cur, parents.get(cur)
+    return None
+
+
+@register
+class RecompilationHazard:
+    id = "J003"
+    title = "jax.jit wrappers created per call / unhashable static specs"
+
+    def check(self, mod: Module, config):
+        idx = traced_index(mod)
+        imports, parents = idx.imports, idx.parents
+        covered = _cache_covered(mod.tree, imports)
+        for node in ast.walk(mod.tree):
+            # (a) jax.jit(f)(...) — a fresh wrapper (and compile-cache
+            # entry) per invocation
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Call) \
+                    and imports.is_jit(node.func.func):
+                yield Violation(
+                    rule=self.id, path=mod.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        "jax.jit(f)(...) builds and discards the jit "
+                        "wrapper per call, defeating the compile cache — "
+                        "bind the jitted function once (module level or a "
+                        "cached factory)"),
+                )
+            # (d) unhashable static_argnums/static_argnames spec
+            if isinstance(node, ast.Call):
+                is_jit_call = imports.is_jit(node.func)
+                is_partial_jit = (
+                    imports.resolve(node.func) in {"functools.partial", "partial"}
+                    and node.args and imports.is_jit(node.args[0])
+                )
+                if is_jit_call or is_partial_jit:
+                    for bad in parse_static_spec(node).unhashable_nodes:
+                        yield Violation(
+                            rule=self.id, path=mod.path, line=bad.lineno,
+                            col=bad.col_offset,
+                            message=(
+                                "static_argnums/static_argnames given a "
+                                "mutable (unhashable) literal — use a tuple "
+                                "so the spec (and the jit cache key) stays "
+                                "hashable"),
+                        )
+            # (b)/(c): every jit reference, by context
+            if not ((isinstance(node, (ast.Name, ast.Attribute))
+                     and imports.is_jit(node))):
+                continue
+            # already reported by (a): jax.jit(f)(...) — the reference is
+            # the func of a call that is itself immediately invoked
+            wrap = parents.get(node)
+            if (
+                isinstance(wrap, ast.Call) and wrap.func is node
+                and isinstance(parents.get(wrap), ast.Call)
+                and parents[wrap].func is wrap
+            ):
+                continue
+            # inside a loop (crossing function boundaries only via
+            # decorators): a new wrapper per iteration
+            prev, cur = node, parents.get(node)
+            in_loop = False
+            while cur is not None:
+                if isinstance(cur, (ast.For, ast.While)):
+                    in_loop = True
+                    break
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and prev not in cur.decorator_list:
+                    break
+                prev, cur = cur, parents.get(cur)
+            if in_loop:
+                yield Violation(
+                    rule=self.id, path=mod.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        "jax.jit inside a loop: a fresh wrapper (and "
+                        "recompile) every iteration — hoist the jitted "
+                        "function out of the loop"),
+                )
+                continue
+            # nested jit without a memoized factory around it
+            host = _enclosing_function(node, parents, through_decorators=True)
+            if host is None:
+                continue
+            if not (_has_cache_decorator(host, imports)
+                    or host.name in covered):
+                yield Violation(
+                    rule=self.id, path=mod.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"jax.jit inside {host.name!r}, which is neither "
+                        f"memoized nor reachable from a memoized factory: "
+                        f"the wrapper (and its compile cache) is rebuilt "
+                        f"per call — decorate the factory with "
+                        f"functools.lru_cache or move the jit to module "
+                        f"level (repo idiom: cached_*/make_* layers)"),
+                )
+
+
+@register
+class TpuDtypeContract:
+    id = "J004"
+    title = "64-bit dtypes on the device path (int32/f32/bf16 contract)"
+
+    _IDIOM = ("the device layers are int32/f32/bf16 only; 64-bit keys use "
+              "the emulated uint32-pair idiom (ops/pallas_kernels.py)")
+
+    def check(self, mod: Module, config):
+        if not config.in_scope(mod.relpath, config.j004_paths):
+            return
+        idx = traced_index(mod)
+        imports = idx.imports
+        traced_nodes: set[ast.AST] = set()
+        for fn, _, _ in idx.traced:
+            traced_nodes.update(ast.walk(fn))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                dotted = imports.resolve(node)
+                if dotted in JNP_64:
+                    yield Violation(
+                        rule=self.id, path=mod.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=(f"{dotted.replace('jax.numpy', 'jnp')} is a "
+                                 f"64-bit device dtype: {self._IDIOM}"),
+                    )
+                elif dotted in NP_64 and node in traced_nodes:
+                    yield Violation(
+                        rule=self.id, path=mod.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=(f"{dotted.replace('numpy', 'np')} inside a "
+                                 f"traced function: {self._IDIOM}"),
+                    )
+            elif isinstance(node, ast.Call):
+                in_traced = node in traced_nodes
+                dotted = imports.resolve(node.func)
+                device_call = dotted is not None and (
+                    dotted == "jax" or dotted.startswith("jax."))
+                astype_call = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                )
+                for val in (
+                    [kw.value for kw in node.keywords if kw.arg == "dtype"]
+                    + (node.args[:1] if astype_call else [])
+                ):
+                    if (
+                        isinstance(val, ast.Constant)
+                        and val.value in STR_64
+                        and (device_call or in_traced)
+                    ):
+                        yield Violation(
+                            rule=self.id, path=mod.path, line=val.lineno,
+                            col=val.col_offset,
+                            message=(f'dtype "{val.value}" on the device '
+                                     f'path: {self._IDIOM}'),
+                        )

@@ -229,7 +229,7 @@ def make_block_bbox_count_step(mesh, block: int):
     as distributed select (SURVEY.md §7)."""
     from functools import partial
 
-    from jax import shard_map
+    from geomesa_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from geomesa_tpu.parallel.mesh import DATA_AXIS
@@ -280,7 +280,7 @@ def make_block_bbox_gather_step(mesh, block: int, capacity: int):
     on shard d matching polygon p (unused lanes hold -1)."""
     from functools import partial
 
-    from jax import shard_map
+    from geomesa_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from geomesa_tpu.parallel.mesh import DATA_AXIS
@@ -327,6 +327,7 @@ def make_block_bbox_gather_step(mesh, block: int, capacity: int):
     return step
 
 
+@lru_cache(maxsize=None)
 def make_block_join_step(mesh, block: int):
     """Sharded block-sparse ST_Within count: every shard tests only its
     planned candidate blocks per polygon, counts psum-merged over the data
@@ -337,7 +338,7 @@ def make_block_join_step(mesh, block: int):
     """
     from functools import partial
 
-    from jax import shard_map
+    from geomesa_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from geomesa_tpu.parallel.mesh import DATA_AXIS

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from geomesa_tpu.ops.refine import MAX_BOXES, MAX_TIMES
+from geomesa_tpu.utils.jax_compat import enable_x64
 
 LANES = 128
 
@@ -146,7 +147,7 @@ def batched_count(x, y, bins, offs, base, true_n, boxes, times, *,
                             memory_space=pltpu.VMEM)
     # x64 off while tracing the kernel: Mosaic rejects the i64 index-map /
     # iota constants the global x64 mode would otherwise produce
-    with jax.enable_x64(False):
+    with enable_x64(False):
         counts = pl.pallas_call(
             partial(_count_kernel, block_rows=block_rows),
             grid=(grid,),
@@ -248,7 +249,7 @@ def _elementwise_call(kernel, arrs, n_out, interpret, block_rows=256):
     arrs2 = [a.reshape(shape2) for a in arrs]
     spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         outs = pl.pallas_call(
             kernel,
             grid=(padded // tile,),

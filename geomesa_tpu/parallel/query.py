@@ -19,13 +19,13 @@ Two execution shapes:
 from __future__ import annotations
 
 import os
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from geomesa_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, data_shards
@@ -70,6 +70,7 @@ def max_shard_candidates(intervals: np.ndarray, rows_per_shard: int, n_shards: i
     return best
 
 
+@lru_cache(maxsize=None)
 def make_select_step(mesh: Mesh):
     """Latency path: per-shard gather + refine; returns (mask (D,C), count)."""
 
@@ -206,9 +207,6 @@ def make_select_gather_step_bbox(mesh: Mesh, capacity: int):
     """Pass-2 gather for extended-geometry stores (see
     :func:`make_select_gather_step`; refine is bbox overlap)."""
     return _make_gather_step(mesh, 6, capacity, replicate=False)
-
-
-from functools import lru_cache
 
 
 @lru_cache(maxsize=None)
@@ -476,6 +474,7 @@ def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
+@lru_cache(maxsize=None)
 def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
     """Like :func:`make_batched_count_step` but evaluates R independent query
     batches in ONE dispatch via ``lax.scan`` — boxes (R, Q, B, 4), times
@@ -671,7 +670,11 @@ def make_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
 
             def chunk_body(acc, pc):
                 pq, pb = pc  # (chunk,)
-                start_g = pb.astype(jnp.int64) * block_rows
+                # global row positions are int32 BY CONTRACT (buf/pos lanes,
+                # base = axis_index * n, device_sort_perm's >= 2**31 guard
+                # all wrap/raise first) — i64 here bought an emulated TPU
+                # op, never extra range
+                start_g = pb.astype(jnp.int32) * block_rows
                 local = (start_g - base).astype(jnp.int32)
                 # query ids are global: this query-shard owns [qbase,
                 # qbase+ql); non-owned or padded pairs contribute zero
@@ -763,7 +766,11 @@ def make_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
         def chunk_body(carry, pc):
             buf, off = carry
             pq, pb = pc  # (chunk,)
-            start_g = pb.astype(jnp.int64) * block_rows
+            # global row positions are int32 BY CONTRACT (buf/pos lanes,
+            # base = axis_index * n, device_sort_perm's >= 2**31 guard all
+            # wrap/raise first) — i64 here bought an emulated TPU op,
+            # never extra range
+            start_g = pb.astype(jnp.int32) * block_rows
             local = (start_g - base).astype(jnp.int32)
             own = (pq >= 0) & (local >= 0) & (local + block_rows <= n)
             s = jnp.where(own, local, 0)

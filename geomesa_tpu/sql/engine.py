@@ -978,8 +978,13 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
     -1 sentinel for the new alias — its columns surface as SQL NULL and
     its keys never match downstream joins (NULL-propagation semantics).
     WHERE conjuncts referencing exactly one alias push down to that
-    alias's index-planned scan; GROUP BY/HAVING/ORDER BY/LIMIT compose
-    through the shared join-grammar helpers."""
+    alias's index-planned scan — EXCEPT conjuncts on a LEFT-JOIN-introduced
+    alias, which evaluate after the join (pushdown would pre-filter the
+    right side and let failing matches survive as NULL-extended rows;
+    standard SQL drops them). NULL-extended rows evaluate such conjuncts
+    over an all-null row — ``IS NULL`` passes, comparisons fail — the same
+    two-valued null semantics as the single-table WHERE. GROUP BY/HAVING/
+    ORDER BY/LIMIT compose through the shared join-grammar helpers."""
     m1 = _MJ_HEAD.match(masked)
     if not m1:
         raise SqlError(f"cannot parse multi-join: {original!r}")
@@ -1008,9 +1013,12 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
             raise SqlError(f"duplicate join alias {a!r}")
         aliases[a] = sm.group("t")
     sfts = {a: ds.get_schema(t) for a, t in aliases.items()}
+    left_aliases = {sm.group("a") for sm in segs if sm.group("left")}
 
-    # WHERE: each conjunct routes to the one alias it references
+    # WHERE: each conjunct routes to the one alias it references. Conjuncts
+    # on LEFT-JOIN aliases apply post-join; everything else pushes down.
     per_alias: dict[str, list[str]] = {a: [] for a in aliases}
+    post_join: dict[str, list[str]] = {a: [] for a in aliases}
     if tm.group("where"):
         w = _clause(tm, tail_original, "where")
         for part in _split_conjuncts(w):
@@ -1028,8 +1036,10 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
                     f"multi-join WHERE conjunct must reference exactly one "
                     f"alias: {part.strip()!r}")
             al = refs.pop()
-            per_alias[al].append(_map_unquoted(
-                part, lambda seg: re.sub(rf"\b{al}\s*\.", "", seg)))
+            stripped = _map_unquoted(
+                part, lambda seg: re.sub(rf"\b{al}\s*\.", "", seg))
+            (post_join if al in left_aliases else per_alias)[al].append(
+                stripped)
     tables = {
         a: ds.query(
             aliases[a],
@@ -1051,6 +1061,12 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
         miss = idx < 0
         if not miss.any():
             return col.take(idx)
+        if len(col) == 0:
+            # LEFT-joined empty table: every idx is the sentinel — there is
+            # no slot 0 to mask, so synthesize the all-null column outright
+            from geomesa_tpu.schema.columnar import null_column
+
+            return null_column(col.type, len(idx))
         out = col.take(np.where(miss, 0, idx))
         valid = out.is_valid() & ~miss
         out.valid = valid
@@ -1092,10 +1108,48 @@ def _sql_multi_join(ds, masked: str, original: str, auths=None) -> SqlResult:
         bound[new_a] = rj
         bound_aliases.add(new_a)
 
+    post = {a: cs for a, cs in post_join.items() if cs}
+    if post:
+        from geomesa_tpu.filter.cql import parse as _parse_cql
+        from geomesa_tpu.schema.columnar import FeatureTable, null_column
+
+        nrows = len(next(iter(bound.values())))
+        keep = np.ones(nrows, dtype=bool)
+        for al, cs in post.items():
+            filt = _parse_cql(_rewrite_where(" AND ".join(cs)))
+            t = tables[al]
+            idx = bound[al]
+            miss = idx < 0
+            # NULL-extended rows see the predicate over an all-null row
+            # (IS NULL passes, comparisons fail — the engine's two-valued
+            # null semantics); filters that cannot evaluate on nulls at
+            # all (spatial ops) simply drop those rows
+            try:
+                nt = FeatureTable(
+                    t.sft, np.asarray(["_null"], dtype=object),
+                    {n: null_column(c.type, 1)
+                     for n, c in t.columns.items()},
+                )
+                null_pass = bool(filt.mask(nt)[0])
+            except Exception:  # noqa: BLE001 — fail closed on null rows
+                null_pass = False
+            if len(t):
+                ok = filt.mask(t)[np.where(miss, 0, idx)]
+            else:
+                ok = np.zeros(nrows, dtype=bool)
+            keep &= np.where(miss, null_pass, ok)
+        bound = {a: v[keep] for a, v in bound.items()}
+
     def pair_column(alias, col):
         c = tables[alias].columns[col]
         idx = bound[alias]
         miss = idx < 0
+        if len(c) == 0:
+            # LEFT-joined empty table: idx is all sentinels; synthesize the
+            # NULL-extended output instead of indexing a slot that isn't
+            # there (object array: np.empty initializes to None)
+            return (c.type, np.empty(len(idx), dtype=object),
+                    np.zeros(len(idx), dtype=bool))
         safe = np.where(miss, 0, idx)
         v = c.geometries() if c.type.is_geometry else c.values
         return c.type, np.asarray(v)[safe], c.is_valid()[safe] & ~miss

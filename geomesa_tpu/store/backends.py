@@ -423,8 +423,15 @@ class TpuBackend(ExecutionBackend):
         # bucket the query-batch dimension too: every compile-time shape
         # (nqp, budget, capacity) is a bucket, so naturally varying batch
         # sizes reuse cached executables instead of recompiling per size.
-        # Padded query slots are never referenced by any pair.
-        nqp = pad_bucket(nq, minimum=4)
+        # Padded query slots are never referenced by any pair. The planned
+        # steps split this axis over the mesh query axis, so the bucket must
+        # also divide by it (the pad_query_axis contract) — a pure power-of-
+        # two bucket fails dispatch on query_parallel=3 etc.
+        import math
+
+        from geomesa_tpu.parallel.mesh import QUERY_AXIS
+
+        nqp = math.lcm(pad_bucket(nq, minimum=4), mesh.shape[QUERY_AXIS])
         boxes = np.stack(
             [p[0] for p in payloads]
             + [np.zeros_like(payloads[0][0])] * (nqp - nq)

@@ -467,7 +467,11 @@ class DataStore:
     def register_interceptor(self, type_name: str | None, fn) -> None:
         """Register ``fn(sft, query) -> query`` rewriting queries before
         planning; ``type_name`` None applies to every schema."""
-        self._interceptors.append((type_name, fn))
+        # under the schema lock: the rename path REPLACES the list wholesale
+        # while holding it, and an append racing that swap would land on the
+        # discarded list (registration silently lost)
+        with self._schema_lock:
+            self._interceptors.append((type_name, fn))
 
     def _intercept(self, type_name: str, sft, q: Query) -> Query:
         for scope, fn in self._interceptors:

@@ -163,22 +163,38 @@ class RemoteDataStore:
         from geomesa_tpu.io.arrow import from_ipc_bytes
 
         cqls = []
-        headers = None
+        batch_auths: set[tuple[str, ...] | None] = set()
         for q in queries:
             if isinstance(q, Query):
-                if q.auths is not None:
-                    if self.forward_auths_header is None:
-                        raise PermissionError(
-                            "remote member cannot apply caller visibility; "
-                            "configure forward_auths_header")
-                    headers = {self.forward_auths_header: ",".join(q.auths)}
+                # normalized: auths are a SET of visibility labels, so
+                # ('a','b') and ('b','a') are the same scope
+                batch_auths.add(
+                    None if q.auths is None
+                    else tuple(sorted(set(q.auths))))
                 f = q.resolved_filter()
                 cqls.append(
                     None if isinstance(f, ast.Include)
                     else (f if isinstance(f, str) else ast.to_cql(f)))
             else:
+                batch_auths.add(None)  # bare CQL carries no visibility scope
                 cqls.append(q if q is None or isinstance(q, str)
                             else ast.to_cql(q))
+        headers = None
+        scoped = {a for a in batch_auths if a is not None}
+        if scoped:
+            # ONE auths header covers the whole batch: a mix of different
+            # auths (or auths and unscoped queries) would silently run every
+            # query under one visibility — fail closed, same posture as the
+            # single-query path
+            if len(batch_auths) > 1:
+                raise PermissionError(
+                    "select_many batch mixes queries with different auths; "
+                    "split the batch so each carries one visibility scope")
+            if self.forward_auths_header is None:
+                raise PermissionError(
+                    "remote member cannot apply caller visibility; "
+                    "configure forward_auths_header")
+            headers = {self.forward_auths_header: ",".join(scoped.pop())}
         out = self._send(
             "POST", f"/api/schemas/{type_name}/select-many",
             {"queries": cqls}, headers=headers)

@@ -458,7 +458,14 @@ class JournalBus:
                 stop.wait(self.poll_interval_s)
 
     def close(self) -> None:
-        self._stop.set()
-        if self._tailer is not None:
-            self._tailer.join(timeout=5.0)
-            self._tailer = None
+        # snapshot under the lock (subscribe swaps _stop/_tailer under it);
+        # join OUTSIDE it — the tailer takes the lock per topic and joining
+        # while holding it would deadlock
+        with self._lock:
+            self._stop.set()
+            tailer = self._tailer
+        if tailer is not None:
+            tailer.join(timeout=5.0)
+            with self._lock:
+                if self._tailer is tailer:
+                    self._tailer = None

@@ -1,0 +1,34 @@
+"""Version-bridging imports for the JAX surface this package leans on.
+
+The hot paths are written against the current stable spelling of each
+API; older installed versions keep working through the fallbacks here so
+the device layer has exactly one place that knows about JAX version
+drift (every other module imports the symbol from here).
+"""
+
+from __future__ import annotations
+
+__all__ = ["enable_x64", "shard_map"]
+
+import inspect
+
+try:  # jax >= 0.5 top-level spelling
+    from jax import enable_x64
+except ImportError:  # jax 0.4.x
+    from jax.experimental import enable_x64
+
+try:  # jax >= 0.5: promoted to the top-level namespace
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with the current keyword surface; replication
+    checking is requested as ``check_vma`` and translated to the older
+    ``check_rep`` spelling when that is what the installed JAX accepts."""
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# tpulint gate — the static-analysis half of tier-1.
+#
+# Fast and CPU-only: GEOMESA_TPU_NO_JAX=1 keeps the geomesa_tpu package
+# import JAX-free, and the analyzer itself is pure AST (linted files are
+# parsed, never imported). Exit 0 = clean against waivers + the committed
+# baseline; exit 1 = NEW violations (fix them, waive with justification,
+# or — for tracked legacy debt only — refresh the baseline with
+# --write-baseline). See docs/tpulint.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis \
+    geomesa_tpu/ scripts/ bench.py __graft_entry__.py \
+    --baseline .tpulint-baseline.json "$@"

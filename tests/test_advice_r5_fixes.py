@@ -1,0 +1,190 @@
+"""Regressions for the round-5 advisor findings (ADVICE.md r5).
+
+Each test pins a specific fixed defect:
+- multi-join WHERE conjuncts on a LEFT-JOIN alias must evaluate AFTER the
+  join (pushdown silently kept failing matches as NULL-extended rows)
+- a LEFT-joined EMPTY table must NULL-extend, not IndexError on slot 0
+- RemoteDataStore.select_many must fail closed on mixed per-query auths
+  (one header used to silently cover the whole batch, last query wins)
+- select_many_positions' query-batch bucket must divide the mesh query
+  axis (a pure power-of-two bucket broke query_parallel meshes)
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.sft import parse_spec
+from geomesa_tpu.sql import sql
+from geomesa_tpu.store.datastore import DataStore
+
+
+@pytest.fixture(scope="module")
+def lj_ds():
+    store = DataStore(backend="tpu")
+    store.create_schema("ord", "cust:String,amount:Double,*geom:Point")
+    orecs = [
+        {"cust": c, "amount": float(a), "geom": Point(float(i), 0.0)}
+        for i, (c, a) in enumerate([
+            ("c0", 10.0), ("c0", 20.0), ("c1", 30.0), ("c2", 40.0),
+            ("cX", 50.0),   # no matching customer: NULL-extended
+            (None, 60.0),   # NULL key: never matches
+        ])
+    ]
+    store.write("ord", orecs, fids=[f"o{i}" for i in range(len(orecs))])
+    store.create_schema("cust", "cid:String,tier:Integer,*geom:Point")
+    crecs = [
+        {"cid": f"c{k}", "tier": k, "geom": Point(float(k), 0.0)}
+        for k in range(3)
+    ]
+    store.write("cust", crecs, fids=[f"c{k}" for k in range(3)])
+    store.create_schema("nobody", "cid:String,tier:Integer,*geom:Point")
+    return store
+
+
+class TestLeftJoinWherePostJoin:
+    def test_where_on_left_alias_applies_after_join(self, lj_ds):
+        """WHERE b.tier = 0 after LEFT JOIN: keeps only rows whose MATCHED
+        customer has tier 0; NULL-extended rows and other tiers drop
+        (pushdown used to keep cX/None rows as NULL-extended survivors)."""
+        res = sql(lj_ds,
+                  "SELECT a.cust, a.amount, b.tier FROM ord a "
+                  "LEFT JOIN cust b ON a.cust = b.cid WHERE b.tier = 0")
+        rows = sorted(zip(res.columns["a.cust"], res.columns["a.amount"]))
+        assert rows == [("c0", 10.0), ("c0", 20.0)]
+        assert all(int(v) == 0 for v in res.columns["b.tier"])
+
+    def test_where_is_null_keeps_only_unmatched(self, lj_ds):
+        """The anti-join spelling: IS NULL on the left alias's key keeps
+        exactly the NULL-extended rows."""
+        res = sql(lj_ds,
+                  "SELECT a.cust, b.tier FROM ord a "
+                  "LEFT JOIN cust b ON a.cust = b.cid WHERE b.cid IS NULL")
+        got = list(res.columns["a.cust"])
+        assert len(got) == 2 and None in got and "cX" in got
+        assert all(v is None for v in res.columns["b.tier"])
+
+    def test_inner_alias_where_still_pushes_down(self, lj_ds):
+        """Conjuncts on the base/inner aliases keep their scan pushdown."""
+        res = sql(lj_ds,
+                  "SELECT a.cust, a.amount, b.tier FROM ord a "
+                  "LEFT JOIN cust b ON a.cust = b.cid WHERE a.amount > 35")
+        rows = sorted(zip(res.columns["a.cust"],
+                          res.columns["a.amount"],
+                          res.columns["b.tier"]),
+                      key=lambda r: r[1])
+        assert rows == [("c2", 40.0, 2), ("cX", 50.0, None),
+                        (None, 60.0, None)]
+
+    def test_left_join_empty_table_null_extends(self, lj_ds):
+        """LEFT JOIN against a 0-row table: every bound row survives
+        NULL-extended (used to IndexError indexing slot 0 of an empty
+        column)."""
+        res = sql(lj_ds,
+                  "SELECT a.cust, b.tier FROM ord a "
+                  "LEFT JOIN nobody b ON a.cust = b.cid")
+        assert len(res) == 6
+        assert all(v is None for v in res.columns["b.tier"])
+
+    def test_where_on_empty_left_table_drops_all(self, lj_ds):
+        res = sql(lj_ds,
+                  "SELECT a.cust FROM ord a "
+                  "LEFT JOIN nobody b ON a.cust = b.cid WHERE b.tier = 1")
+        assert len(res) == 0
+
+
+class TestRemoteSelectManyAuths:
+    def _remote(self, header="X-Geomesa-Auths"):
+        from geomesa_tpu.store.remote import RemoteDataStore
+
+        remote = RemoteDataStore("http://unused.invalid",
+                                 forward_auths_header=header)
+        remote._schemas["ev"] = parse_spec("ev", "name:String,*geom:Point")
+        return remote
+
+    def test_mixed_auths_fail_closed(self):
+        remote = self._remote()
+        with pytest.raises(PermissionError, match="different auths"):
+            remote.select_many(
+                "ev", [Query(auths=("A",)), Query(auths=("B",))])
+
+    def test_auths_mixed_with_unscoped_fail_closed(self):
+        remote = self._remote()
+        with pytest.raises(PermissionError, match="different auths"):
+            remote.select_many("ev", [Query(auths=("A",)), "INCLUDE"])
+
+    def test_auths_without_forward_header_fail_closed(self):
+        remote = self._remote(header=None)
+        with pytest.raises(PermissionError, match="forward_auths_header"):
+            remote.select_many("ev", [Query(auths=("A",))])
+
+    def test_same_scope_different_order_accepted(self):
+        """auths are a set of labels: ('a','b') and ('b','a') are one
+        scope, not a mixed batch."""
+        remote = self._remote()
+        seen = {}
+
+        def fake_send(method, path, body=None, params=None, headers=None):
+            seen["headers"] = headers
+            return {"results": []}
+
+        remote._send = fake_send
+        remote.select_many(
+            "ev", [Query(auths=("a", "b")), Query(auths=("b", "a"))])
+        assert seen["headers"] == {"X-Geomesa-Auths": "a,b"}
+
+    def test_uniform_auths_forward_one_header(self):
+        remote = self._remote()
+        seen = {}
+
+        def fake_send(method, path, body=None, params=None, headers=None):
+            seen["headers"] = headers
+            return {"results": []}
+
+        remote._send = fake_send
+        out = remote.select_many(
+            "ev", [Query(auths=("A", "B")), Query(auths=("A", "B"))])
+        assert out == []
+        assert seen["headers"] == {"X-Geomesa-Auths": "A,B"}
+
+    def test_all_unscoped_sends_no_header(self):
+        remote = self._remote(header=None)
+        seen = {}
+
+        def fake_send(method, path, body=None, params=None, headers=None):
+            seen["headers"] = headers
+            return {"results": []}
+
+        remote._send = fake_send
+        remote.select_many("ev", ["INCLUDE", None])
+        assert seen["headers"] is None
+
+
+class TestSelectManyQueryAxisPadding:
+    def test_query_parallel_mesh_dispatches(self):
+        """A query_parallel mesh whose axis exceeds the power-of-two bucket
+        (1 query -> bucket 4, query axis 8) used to fail at dispatch; the
+        bucket now rounds up to a multiple of the mesh query axis."""
+        from geomesa_tpu.parallel.mesh import make_mesh
+        from geomesa_tpu.store.backends import TpuBackend
+
+        mesh = make_mesh(8, query_parallel=8)
+        ds = DataStore(backend=TpuBackend(mesh=mesh))
+        ds.create_schema("ev", "name:String,*geom:Point")
+        rng = np.random.default_rng(3)
+        n = 300
+        lon = rng.uniform(-60, 60, n)
+        lat = rng.uniform(-60, 60, n)
+        ds.write(
+            "ev",
+            [{"name": f"p{i}", "geom": Point(float(lon[i]), float(lat[i]))}
+             for i in range(n)],
+            fids=[f"p{i}" for i in range(n)],
+        )
+        ds.compact("ev")
+        [r] = ds.select_many("ev", ["BBOX(geom, -30, -30, 30, 30)"])
+        want = set(np.nonzero(
+            (lon > -30) & (lon < 30) & (lat > -30) & (lat < 30))[0])
+        got = {int(f[1:]) for f in r.table.fids}
+        assert got == want

@@ -1,0 +1,41 @@
+"""Known-bad C001 fixture: lock-discipline violations."""
+
+import threading
+
+
+class SloppyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def bump_unlocked(self):
+        self._n += 1  # C001 line 17: locked at line 14, bare here
+
+    def add(self, v):
+        with self._lock:
+            self._items.append(v)
+
+    def add_unlocked(self, v):
+        self._items.append(v)  # C001 line 24
+
+
+class OrderSwap:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self._x += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:  # C001: AB/BA order inversion
+                self._x += 1

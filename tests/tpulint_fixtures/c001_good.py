@@ -1,0 +1,44 @@
+"""Known-good C001 fixture: consistent lock discipline."""
+
+import threading
+
+
+class DisciplinedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # construction is single-threaded: no lock needed
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            self._bump_extra_locked()
+
+    def _bump_extra_locked(self):
+        # *_locked suffix: caller holds the lock (repo convention)
+        self._n += 1
+
+    def add(self, v):
+        with self._lock:
+            self._items.append(v)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+
+class OneOrder:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+
+    def first(self):
+        with self._a:
+            with self._b:
+                self._x += 1
+
+    def second(self):
+        with self._a:
+            with self._b:
+                self._x -= 1

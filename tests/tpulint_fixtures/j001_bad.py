@@ -1,0 +1,36 @@
+"""Known-bad J001 fixture: Python control flow on traced values.
+
+Never imported by tests — tpulint parses it; jax need not be installed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x.sum() > 0:  # J001 line 12
+        return x
+    return -x
+
+
+@jax.jit
+def loop_on_tracer(x):
+    while jnp.any(x > 0):  # J001 line 19
+        x = x - 1
+    return x
+
+
+@jax.jit
+def assert_on_tracer(x):
+    assert jnp.all(x > 0)  # J001 line 26
+    return x
+
+
+@jax.jit
+def branch_on_derived(x):
+    m = jnp.abs(x)
+    total = m.sum()
+    if total > 1.0:  # J001 line 34 (taint flows through locals)
+        return m
+    return x

@@ -1,0 +1,31 @@
+"""Known-good J001 fixture: static/structural branching inside jit."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_branch(x):
+    if x.shape[0] > 128:  # shapes are static under tracing
+        return x[:128]
+    return x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_arg_branch(x, mode):
+    if mode == "abs":  # static argument: trace-time branch is intended
+        return jnp.abs(x)
+    return x
+
+
+@jax.jit
+def device_select(x):
+    return jnp.where(x > 0, x, -x)  # the J001-clean spelling
+
+
+def host_branch(x):
+    if x.sum() > 0:  # not traced: plain numpy control flow is fine
+        return x
+    return -x

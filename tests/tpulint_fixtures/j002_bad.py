@@ -1,0 +1,33 @@
+"""Known-bad J002 fixture: host<->device syncs where they hurt."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def trace_time_sync(x):
+    s = float(x.sum())  # J002 line 10: tracer -> host at trace time
+    return x * s
+
+
+@jax.jit
+def trace_time_asarray(x):
+    host = np.asarray(x)  # J002 line 16
+    return jnp.asarray(host)
+
+
+def hot_loop_readback(n):
+    dev = jnp.arange(n)
+    total = 0.0
+    for _ in range(8):
+        total += float(dev.sum())  # J002 line 24: sync per iteration
+    return total
+
+
+def hot_loop_item(n):
+    dev = jnp.arange(n)
+    out = []
+    while len(out) < 4:
+        out.append(dev.max().item())  # J002 line 32
+    return out

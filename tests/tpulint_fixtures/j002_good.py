@@ -1,0 +1,25 @@
+"""Known-good J002 fixture: the sanctioned readback seams."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def stays_on_device(x):
+    return jnp.asarray(x) * jnp.float32(2.0)
+
+
+def batched_readback(n):
+    dev = jnp.arange(n)
+    parts = []
+    for i in range(8):
+        parts.append(dev + i)  # device work accumulates on device
+    return np.asarray(jnp.stack(parts))  # ONE post-loop sync
+
+
+def host_math_in_loop(rows):
+    total = 0
+    for r in rows:
+        total += int(np.asarray(r).sum())  # numpy-only: no device sync
+    return total

@@ -1,0 +1,33 @@
+"""Known-bad J003 fixture: jit wrappers rebuilt per call / bad static specs."""
+
+import jax
+
+
+def immediate_invoke(f, x):
+    return jax.jit(f)(x)  # J003 line 7: wrapper discarded after one call
+
+
+def uncached_factory(scale):
+    @jax.jit  # J003 line 11: enclosing factory is not memoized
+    def step(x):
+        return x * scale
+
+    return step
+
+
+def rebind_per_call(f, x):
+    g = jax.jit(f)  # J003 line 19: fresh wrapper every call
+    return g(x)
+
+
+def jit_in_loop(fns):
+    steps = []
+    for f in fns:
+        steps.append(jax.jit(f))  # J003 line 26: wrapper per iteration
+    return steps
+
+
+unhashable_spec = jax.jit(
+    lambda x, n: x[:n],
+    static_argnums=[1],  # J003 line 32: mutable (unhashable) spec literal
+)

@@ -1,0 +1,39 @@
+"""Known-good J003 fixture: module-level jits and memoized factories."""
+
+from functools import lru_cache, partial
+
+import jax
+
+
+@jax.jit
+def module_level_step(x):
+    return x + 1
+
+
+@partial(jax.jit, static_argnums=(1,))
+def hashable_static_spec(x, n):
+    return x[:n]
+
+
+@lru_cache(maxsize=None)
+def cached_factory(scale):
+    @jax.jit
+    def step(x):
+        return x * scale
+
+    return step
+
+
+def make_inner_step(scale):
+    # uncached layer of the cached_*/make_* idiom: reached only through
+    # cached_wrapper below, so the jit is built a bounded number of times
+    @jax.jit
+    def step(x):
+        return x * scale
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_wrapper(scale):
+    return make_inner_step(scale)

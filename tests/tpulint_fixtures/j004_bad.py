@@ -1,0 +1,21 @@
+"""Known-bad J004 fixture: 64-bit dtypes on the device path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen_keys(z):
+    return z.astype(jnp.int64)  # J004 line 9
+
+
+def device_alloc(n):
+    return jnp.zeros(n, dtype="float64")  # J004 line 13 (string spelling)
+
+
+_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)  # J004 line 16
+
+
+@jax.jit
+def traced_np_widen(x):
+    return x.astype(np.int64)  # J004 line 21 (np 64-bit inside tracing)

@@ -1,0 +1,26 @@
+"""Known-good J004 fixture: the 32-bit device contract and its host seam."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def device_math(z):
+    return z.astype(jnp.int32) * jnp.float32(0.5)
+
+
+def emulated_u64_or(hi, lo, other_hi, other_lo):
+    # the sanctioned wide-key idiom: two uint32 words per 64-bit value
+    u = jnp.uint32
+    return (hi | other_hi) & u(0xFFFFFFFF), (lo | other_lo) & u(0xFFFFFFFF)
+
+
+def host_keys(millis):
+    # HOST numpy math is allowed to be 64-bit — the contract guards the
+    # device side of the seam, not the planner
+    return np.asarray(millis, dtype=np.int64)
+
+
+@jax.jit
+def traced_narrow(x):
+    return x.sum(dtype=jnp.int32)
