@@ -1,0 +1,36 @@
+"""geomesa_tpu.obs — end-to-end query observability.
+
+Three layers (see docs/observability.md):
+
+- :mod:`~geomesa_tpu.obs.trace` — hierarchical spans with ContextVar
+  propagation and a zero-overhead no-op path when disabled.
+- :mod:`~geomesa_tpu.obs.jaxmon` — JAX compile/dispatch telemetry: per-step
+  jit timing, recompile counts keyed by abstract signature (live J003),
+  host↔device transfer bytes.
+- :mod:`~geomesa_tpu.obs.export` — Chrome/Perfetto trace-event JSON and
+  Prometheus text exposition.
+
+This package imports no jax at module level: ``GEOMESA_TPU_NO_JAX=1``
+processes (tpulint in CI) can import every instrumented module.
+"""
+
+from geomesa_tpu.obs.trace import (  # noqa: F401 — the public obs surface
+    NOOP,
+    Span,
+    StageTimeline,
+    active,
+    annotate,
+    collect,
+    current,
+    disable,
+    enable,
+    enabled,
+    drain,
+    recent,
+    span,
+)
+
+__all__ = [
+    "NOOP", "Span", "StageTimeline", "active", "annotate", "collect",
+    "current", "disable", "enable", "enabled", "drain", "recent", "span",
+]

@@ -1,0 +1,156 @@
+"""Trace and metrics exporters: Chrome/Perfetto trace-event JSON and
+Prometheus text exposition.
+
+- :func:`chrome_trace_events` / :func:`write_chrome_trace` — the span trees
+  from :mod:`geomesa_tpu.obs.trace` as Chrome trace-event "complete" (ph=X)
+  events, loadable in ``ui.perfetto.dev`` or ``chrome://tracing``. One file
+  per query (``DataStore.explain(..., analyze=True)`` + ``root=``) or per
+  bench run (``bench.py --trace``).
+
+- :func:`prometheus_text` — any number of
+  :class:`~geomesa_tpu.utils.metrics.MetricsRegistry` snapshots as
+  Prometheus text exposition (version 0.0.4): counters as ``_total``,
+  gauges as-is, histograms/timers as summaries with p50/p95/p99 quantile
+  labels. Wired into ``GET /api/metrics?format=prometheus``
+  (:mod:`geomesa_tpu.web.app`).
+
+No jax anywhere in this module (``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from geomesa_tpu.obs import trace as _trace
+
+__all__ = [
+    "chrome_trace_events", "write_chrome_trace",
+    "prometheus_text", "PROMETHEUS_CONTENT_TYPE",
+]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# -- Chrome / Perfetto trace-event JSON --------------------------------------
+
+def _span_event(s) -> dict:
+    args = {"trace_id": s.trace_id, "span_id": s.span_id}
+    for k, v in s.attrs.items():
+        args[k] = v if isinstance(v, (int, float, bool, str, type(None))) else str(v)
+    return {
+        "name": s.name,
+        "cat": "geomesa",
+        "ph": "X",  # complete event: ts + dur
+        "ts": s.t0_ns / 1e3,  # microseconds
+        "dur": max(s.t1_ns - s.t0_ns, 0) / 1e3,
+        "pid": 1,
+        "tid": s.thread_id,
+        "args": args,
+    }
+
+
+def chrome_trace_events(roots=None) -> list[dict]:
+    """Flatten span trees into trace events. ``roots=None`` exports (and
+    leaves in place) the process buffer of completed root spans."""
+    if roots is None:
+        roots = _trace.recent()
+    elif not isinstance(roots, (list, tuple)):
+        roots = [roots]
+    events = []
+    tids = set()
+    for root in roots:
+        for s in root.walk():
+            events.append(_span_event(s))
+            tids.add(s.thread_id)
+    for tid in sorted(tids):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": f"thread-{tid}"},
+        })
+    return events
+
+
+def write_chrome_trace(path: str, roots=None, drain: bool = False) -> int:
+    """Write one Perfetto-loadable JSON file; returns the event count.
+    ``drain=True`` consumes the process buffer (bench-run semantics)."""
+    if roots is None and drain:
+        roots = _trace.drain()
+    events = chrome_trace_events(roots)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    return len(events)
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    n = _NAME_RE.sub("_", f"{prefix}_{name}" if prefix else name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(f) if not f.is_integer() else str(int(f))
+
+
+def _summary(lines: list, base: str, vals: dict, scale: float, unit: str):
+    """One snapshot histogram/timer as a Prometheus summary."""
+    name = base + unit
+    lines.append(f"# TYPE {name} summary")
+    for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        if key in vals:
+            lines.append(
+                f'{name}{{quantile="{q}"}} {_fmt(vals[key] * scale)}'
+            )
+    count = vals.get("count", 0)
+    mean = vals.get("mean", vals.get("mean_ms", 0.0))
+    lines.append(f"{name}_sum {_fmt(mean * count * scale)}")
+    lines.append(f"{name}_count {_fmt(count)}")
+
+
+def prometheus_text(*registries, prefix: str = "geomesa") -> str:
+    """Text exposition for one or more metric registries (duck-typed on
+    ``snapshot()``). On a name collision the EARLIEST registry wins and
+    later duplicates are dropped — an exposition must never emit the same
+    family twice (pass the authoritative registry first)."""
+    lines: list[str] = []
+    seen: set[str] = set()
+    for reg in registries:
+        if reg is None:
+            continue
+        for raw, vals in sorted(reg.snapshot().items()):
+            typ = vals.get("type")
+            base = _prom_name(raw, prefix)
+            if base in seen:
+                continue
+            seen.add(base)
+            if typ == "counter":
+                lines.append(f"# TYPE {base}_total counter")
+                lines.append(f"{base}_total {_fmt(vals['count'])}")
+            elif typ == "gauge":
+                lines.append(f"# TYPE {base} gauge")
+                lines.append(f"{base} {_fmt(vals['value'])}")
+            elif typ == "histogram":
+                _summary(lines, base, vals, 1.0, "")
+            elif typ == "timer":
+                # timers snapshot in ms; Prometheus wants base seconds
+                sv = {
+                    "count": vals.get("count", 0),
+                    "mean": vals.get("mean_ms", 0.0),
+                }
+                for k in ("p50", "p95", "p99"):
+                    if f"{k}_ms" in vals:
+                        sv[k] = vals[f"{k}_ms"]
+                _summary(lines, base, sv, 1e-3, "_seconds")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,231 @@
+"""JAX compile/dispatch telemetry (the live counterpart to tpulint J003).
+
+Two hooks:
+
+- :func:`install` registers ``jax.monitoring`` duration listeners so every
+  jit compile in the process lands in the telemetry registry as
+  ``jax.compile.*`` histograms (trace time, MLIR lowering, backend
+  compile). Guarded: a ``GEOMESA_TPU_NO_JAX=1`` process never imports jax
+  from here, and a missing/old jax degrades to a no-op.
+
+- :func:`observed` wraps a cached jit step (the ``cached_*_step``
+  factories in :mod:`geomesa_tpu.parallel.query`) with a per-call sampler
+  that keys calls by ABSTRACT SIGNATURE — the (shape, dtype) tuple jax
+  itself caches on. A new signature on an already-warm step is exactly the
+  recompile hazard tpulint's J003 flags statically; here it increments
+  ``jax.jit.recompiles`` live, with per-step compile/dispatch timing
+  histograms and host↔device transfer-byte counters. The sampler itself
+  never calls into jax: it reads ``shape``/``dtype``/``nbytes`` attributes
+  off whatever arguments arrive and nothing else.
+
+Telemetry is always-on and cheap (~1-2 µs per dispatch, against device
+calls that cost milliseconds); SPANS for jit calls are only emitted while
+tracing is active (:mod:`geomesa_tpu.obs.trace`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["GLOBAL", "registry", "install", "observed", "jit_report"]
+
+GLOBAL = None  # lazily-created MetricsRegistry (process-wide jax telemetry)
+_reg_lock = threading.Lock()
+_installed = False
+
+
+def registry():
+    """The process-wide telemetry registry (created on first use)."""
+    global GLOBAL
+    if GLOBAL is None:
+        with _reg_lock:
+            if GLOBAL is None:
+                from geomesa_tpu.utils.metrics import MetricsRegistry
+
+                GLOBAL = MetricsRegistry()
+    return GLOBAL
+
+
+def _on_duration(name: str, secs: float, **_kw) -> None:
+    # e.g. /jax/core/compile/backend_compile_duration → jax.compile.backend_compile
+    if "/compile/" not in name:
+        return
+    tail = name.rsplit("/", 1)[1]
+    if tail.endswith("_duration"):
+        tail = tail[: -len("_duration")]
+    reg = registry()
+    reg.histogram(f"jax.compile.{tail}_ms").update(secs * 1000.0)
+    reg.counter("jax.compile.events").inc()
+
+
+def install() -> bool:
+    """Register the jax.monitoring listeners (idempotent). Returns True when
+    listening; False when jax is gated off or unavailable."""
+    global _installed
+    if _installed:
+        return True
+    if os.environ.get("GEOMESA_TPU_NO_JAX"):
+        return False
+    try:
+        import jax.monitoring as jm
+    except Exception:  # pragma: no cover — no jax in the process
+        return False
+    with _reg_lock:
+        if _installed:
+            return True
+        jm.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+    return True
+
+
+def _abstract_sig(args: tuple) -> tuple:
+    """The jit cache key proxy: (shape, dtype) per array argument, type name
+    for everything else (python scalars don't retrigger compiles on value)."""
+    out = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            out.append((tuple(shape), str(getattr(a, "dtype", ""))))
+        else:
+            out.append(type(a).__name__)
+    return tuple(out)
+
+
+def _nbytes(obj) -> int:
+    """Total nbytes across an array / tuple-of-arrays result (one level of
+    tuple/list nesting — the shapes our steps actually return)."""
+    n = getattr(obj, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(obj, (tuple, list)):
+        return sum(_nbytes(x) for x in obj)
+    return 0
+
+
+def _np_bytes(arrays) -> int:
+    """Total nbytes across the NUMPY members of ``arrays`` — THE
+    host-side-array detection rule for h2d accounting (one definition;
+    device-resident jax arrays never count)."""
+    return sum(
+        int(a.nbytes)
+        for a in arrays
+        if type(a).__module__.startswith("numpy") and hasattr(a, "nbytes")
+    )
+
+
+def count_h2d(*arrays) -> int:
+    """Account host→device staging for numpy arrays about to be
+    ``jnp.asarray``'d / ``device_put`` / passed to a dispatch (transfers
+    the step wrapper cannot see when call sites pre-convert). Non-numpy
+    args are skipped — device-resident columns must not be recounted per
+    dispatch. Returns bytes counted."""
+    total = _np_bytes(arrays)
+    if total:
+        registry().counter("jax.transfer.h2d_bytes").inc(total)
+    return total
+
+
+def observed(name: str, fn):
+    """Wrap one cached jit step with the signature-keyed sampler.
+
+    Applied INSIDE the ``lru_cache`` factories, so each distinct compiled
+    step owns one wrapper and one signature set for the life of the
+    cache. Metric handles are resolved ONCE here (names are fixed per
+    wrapper) so the per-dispatch cost is increments, not name lookups.
+    """
+    from geomesa_tpu.obs import trace as _trace
+
+    sigs: set = set()
+    lock = threading.Lock()
+    reg = registry()
+    calls = reg.counter(f"jax.jit.{name}.calls")
+    compiles = reg.counter(f"jax.jit.{name}.compiles")
+    compile_ms = reg.histogram(f"jax.jit.{name}.compile_dispatch_ms")
+    dispatch_ms = reg.histogram(f"jax.jit.{name}.dispatch_ms")
+    recompiles = reg.counter(f"jax.jit.{name}.recompiles")
+    recompiles_all = reg.counter("jax.jit.recompiles")
+    h2d_bytes = reg.counter("jax.transfer.h2d_bytes")
+    d2h_bytes = reg.counter("jax.transfer.d2h_bytes")
+
+    def wrapper(*args, **kwargs):
+        key = _abstract_sig(args)
+        with lock:
+            is_new = key not in sigs
+            if is_new:
+                sigs.add(key)
+            n_sigs = len(sigs)
+        sp = _trace.span("jit", step=name) if _trace.active() else None
+        t0 = time.perf_counter()
+        try:
+            if sp is not None:
+                with sp:
+                    out = fn(*args, **kwargs)
+            else:
+                out = fn(*args, **kwargs)
+        except BaseException:
+            # the signature only counts once the step SUCCEEDS: a device
+            # error here (circuit-breaker failover) must leave the retry
+            # classified as the compile it really is, not a warm dispatch
+            if is_new:
+                with lock:
+                    sigs.discard(key)
+            raise
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        calls.inc()
+        # transfer denominator: numpy args are about to cross host→device
+        # (call sites that pre-convert account theirs via count_h2d);
+        # result bytes cross back when the caller materializes them
+        h2d = _np_bytes(args)
+        d2h = _nbytes(out)
+        if h2d:
+            h2d_bytes.inc(h2d)
+        if d2h:
+            d2h_bytes.inc(d2h)
+        if is_new:
+            compiles.inc()
+            compile_ms.update(dt_ms)
+            if n_sigs > 1:
+                # a warm step met a fresh abstract signature: the live J003
+                recompiles_all.inc()
+                recompiles.inc()
+        else:
+            dispatch_ms.update(dt_ms)
+        if sp is not None:
+            sp.set(compile=is_new, ms=round(dt_ms, 3),
+                   h2d_bytes=h2d, d2h_bytes=d2h)
+        return out
+
+    wrapper.__name__ = f"observed_{name}"
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+def jit_report() -> dict:
+    """Per-step jit census: calls, distinct-signature compiles, recompiles,
+    and dispatch timing — the live J003 dashboard."""
+    if GLOBAL is None:
+        return {}
+    snap = GLOBAL.snapshot()
+    steps: dict[str, dict] = {}
+    for k, v in snap.items():
+        if not k.startswith("jax.jit."):
+            continue
+        rest = k[len("jax.jit."):]
+        if "." not in rest:
+            continue  # jax.jit.recompiles global counter
+        step, metric = rest.rsplit(".", 1)
+        if metric in ("calls", "compiles", "recompiles"):
+            steps.setdefault(step, {})[metric] = v.get("count", 0)
+        elif metric in ("dispatch_ms", "compile_dispatch_ms"):
+            steps.setdefault(step, {})[metric] = {
+                kk: vv for kk, vv in v.items() if kk != "type"
+            }
+    out = {"steps": steps}
+    if "jax.jit.recompiles" in snap:
+        out["recompiles"] = snap["jax.jit.recompiles"]["count"]
+    for k in ("jax.transfer.h2d_bytes", "jax.transfer.d2h_bytes"):
+        if k in snap:
+            out[k.rsplit(".", 1)[1]] = snap[k]["count"]
+    return out

@@ -1,0 +1,285 @@
+"""Hierarchical trace spans with ContextVar propagation (the obs core).
+
+The per-query timeline the reference never had: every stage of
+``QueryPlanner.runQuery`` (plan → range decomposition → device dispatch →
+refine → reduce → serialize) opens a :class:`Span`; spans nest through a
+``contextvars.ContextVar``, so propagation is correct across the threaded
+web server's request threads and the watchdog's scan worker threads
+(``utils.timeouts.run_with_timeout`` copies the context into its worker)
+without any explicit plumbing.
+
+Zero-overhead contract: with tracing disabled, :func:`span` returns a
+shared no-op context manager after one module-global check and one
+ContextVar read — no allocation, no clock read, and (critically) no jax
+import anywhere in this module, so ``GEOMESA_TPU_NO_JAX=1`` keeps working.
+The bound is asserted by ``tests/test_obs.py``.
+
+Enable globally with :func:`enable` (or ``GEOMESA_TPU_TRACE=<path>`` in the
+environment — bench.py's ``--trace`` sets it), or per-call-tree with
+:func:`collect` (what ``DataStore.explain(..., analyze=True)`` uses).
+Completed root spans land in a bounded in-memory buffer; exporters
+(:mod:`geomesa_tpu.obs.export`) turn them into Chrome/Perfetto trace JSON.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+__all__ = [
+    "Span", "StageTimeline", "span", "collect", "current", "annotate",
+    "enable", "disable", "enabled", "recent", "drain", "NOOP",
+]
+
+_enabled = False  # module-global fast flag (the one check on the no-op path)
+_forced: ContextVar[bool] = ContextVar("geomesa_obs_forced", default=False)
+_current: ContextVar["Span | None"] = ContextVar("geomesa_obs_span", default=None)
+
+_buffer_lock = threading.Lock()
+_MAX_TRACES = 512  # completed root spans retained (ring buffer)
+_traces: deque = deque(maxlen=_MAX_TRACES)
+
+# span/trace ids: a per-process random salt + cheap counter — unique within
+# and across processes without paying uuid4 per span
+_salt = os.urandom(4).hex()
+_ids = itertools.count(1)
+
+
+class Span:
+    """One timed stage. Context manager; children attach automatically via
+    the ContextVar, so concurrent requests build disjoint trees."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "attrs", "children",
+        "t0_ns", "t1_ns", "thread_id", "_token",
+    )
+
+    def __init__(self, name: str, attrs: dict, parent: "Span | None"):
+        self.name = name
+        self.attrs = attrs
+        self.children: list[Span] = []
+        sid = next(_ids)
+        self.span_id = f"{_salt}-{sid:x}"
+        if parent is None:
+            self.trace_id = f"{_salt}-t{sid:x}"
+            self.parent_id = ""
+        else:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        self.t0_ns = 0
+        self.t1_ns = 0
+        self.thread_id = threading.get_ident()
+        self._token = None
+
+    # -- timing ---------------------------------------------------------------
+    @property
+    def duration_ms(self) -> float:
+        end = self.t1_ns if self.t1_ns else time.perf_counter_ns()
+        return (end - self.t0_ns) / 1e6
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        self.t0_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.t1_ns = time.perf_counter_ns()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        parent = None
+        if self._token is not None:
+            prev = self._token.old_value  # Token.MISSING when var was unset
+            _current.reset(self._token)
+            self._token = None
+            if isinstance(prev, Span):
+                parent = prev
+        if parent is not None:
+            # list.append is atomic under the GIL; an abandoned (timed-out)
+            # scan worker may attach late — exporters snapshot via list()
+            parent.children.append(self)
+        else:
+            with _buffer_lock:
+                _traces.append(self)
+
+    # -- introspection --------------------------------------------------------
+    def walk(self):
+        """This span and every descendant, depth-first."""
+        yield self
+        for c in list(self.children):
+            yield from c.walk()
+
+    def find(self, name: str) -> "list[Span]":
+        return [s for s in self.walk() if s.name == name]
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (
+            f"Span({self.name!r}, {self.duration_ms:.3f} ms, "
+            f"children={len(self.children)})"
+        )
+
+
+class _NoopSpan:
+    """The disabled path: one shared instance, every method a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def set(self, **attrs):
+        return self
+
+    # mimic the Span read surface so call sites never branch on type
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = ""
+    attrs: dict = {}
+    children: list = []
+    duration_ms = 0.0
+
+    def walk(self):
+        return iter(())
+
+    def find(self, name):
+        return []
+
+
+NOOP = _NoopSpan()
+
+
+def active() -> bool:
+    """True when spans are being recorded on THIS context (global enable or
+    an enclosing :func:`collect`)."""
+    return _enabled or _forced.get()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(jax_telemetry: bool = True) -> None:
+    """Turn tracing on process-wide. ``jax_telemetry`` also installs the
+    jax.monitoring compile listeners — guarded so a ``GEOMESA_TPU_NO_JAX=1``
+    process never imports jax from here."""
+    global _enabled
+    _enabled = True
+    if jax_telemetry:
+        from geomesa_tpu.obs import jaxmon
+
+        jaxmon.install()
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def span(name: str, **attrs) -> "Span | _NoopSpan":
+    """Open a child span of the current context (a root when none).
+
+    Usage: ``with obs.span("plan", index="z3"): ...`` — returns the shared
+    no-op singleton when tracing is off.
+    """
+    if not _enabled and not _forced.get():
+        return NOOP
+    return Span(name, attrs, _current.get())
+
+
+def current() -> "Span | None":
+    """The innermost live span on this context, or None."""
+    return _current.get()
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the innermost live span (no-op when untraced)."""
+    sp = _current.get()
+    if sp is not None:
+        sp.attrs.update(attrs)
+
+
+@contextmanager
+def collect(name: str = "trace", **attrs):
+    """Force-trace one call tree regardless of the global flag and yield its
+    root span (inspect ``root.children`` after the block). This is the
+    ``EXPLAIN ANALYZE`` mechanism: per-query opt-in with zero ambient cost."""
+    tok = _forced.set(True)
+    root = Span(name, attrs, _current.get())
+    try:
+        with root:
+            yield root
+    finally:
+        _forced.reset(tok)
+
+
+def recent() -> list:
+    """Completed root spans, oldest first (non-destructive)."""
+    with _buffer_lock:
+        return list(_traces)
+
+
+def drain() -> list:
+    """Completed root spans, clearing the buffer (exporter consumption)."""
+    with _buffer_lock:
+        out = list(_traces)
+        _traces.clear()
+    return out
+
+
+class StageTimeline:
+    """A root span flattened to the stage decomposition the acceptance
+    contract names: direct children as (stage, ms) pairs plus an ``other``
+    residual. Child durations are CLAMPED to the root's own window —
+    a still-open child (an abandoned, timed-out scan worker whose span
+    never closed) or one attached late cannot push coverage past wall —
+    so for the sequential query pipeline stage durations sum to wall time
+    by construction (``other`` absorbs untraced gaps)."""
+
+    def __init__(self, root: Span):
+        self.root = root
+        self.wall_ms = root.duration_ms
+        root_end = root.t1_ns if root.t1_ns else time.perf_counter_ns()
+        stages = []
+        for c in list(root.children):
+            child_end = c.t1_ns if c.t1_ns else root_end  # still open
+            lo = max(c.t0_ns, root.t0_ns)
+            hi = min(child_end, root_end)
+            stages.append((c.name, max(hi - lo, 0) / 1e6))
+        covered = sum(ms for _, ms in stages)
+        other = self.wall_ms - covered
+        if other > 1e-6:
+            stages.append(("other", other))
+        self.stages = stages
+
+    def stage_ms(self, name: str) -> float:
+        return sum(ms for n, ms in self.stages if n == name)
+
+    def render(self) -> str:
+        lines = [
+            f"Stage timeline ({self.wall_ms:.3f} ms wall, "
+            f"trace {self.root.trace_id}):"
+        ]
+        for n, ms in self.stages:
+            pct = 100.0 * ms / self.wall_ms if self.wall_ms else 0.0
+            lines.append(f"  {n:<12s} {ms:10.3f} ms  {pct:5.1f}%")
+        return "\n".join(lines)
+
+    __str__ = render
+
+
+# bench.py --trace / operator opt-in without code: enabling via environment
+# here means child worker processes (bench driver mode) inherit tracing
+if os.environ.get("GEOMESA_TPU_TRACE"):
+    enable()

@@ -84,6 +84,7 @@ def shard_columns(mesh: Mesh, columns: dict[str, np.ndarray], pad_value=0,
     padded = pad_rows(max(n, shards), shards, multiple)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     out = {}
+    staged = []
     for name, arr in columns.items():
         if len(arr) != n:
             raise ValueError(f"column {name} length mismatch")
@@ -91,4 +92,10 @@ def shard_columns(mesh: Mesh, columns: dict[str, np.ndarray], pad_value=0,
             pad = np.full(padded - n, pad_value, dtype=arr.dtype)
             arr = np.concatenate([arr, pad])
         out[name] = jax.device_put(arr, sharding)
+        staged.append(arr)
+    # residency staging IS the dominant host→device transfer: account it
+    # in the process-wide telemetry registry (obs.jaxmon)
+    from geomesa_tpu.obs.jaxmon import count_h2d
+
+    count_h2d(*staged)
     return out, padded, padded // shards

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from geomesa_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from geomesa_tpu.obs.jaxmon import observed as _observed
 from geomesa_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, data_shards
 
 
@@ -212,44 +213,59 @@ def make_select_gather_step_bbox(mesh: Mesh, capacity: int):
 @lru_cache(maxsize=None)
 def cached_select_count_step(mesh: Mesh):
     """Memoized per-mesh count step — jit caches key on function identity,
-    so sharing the closure across DataStore instances avoids recompiles."""
-    return make_select_count_step(mesh)
+    so sharing the closure across DataStore instances avoids recompiles.
+
+    Every ``cached_*`` factory wraps its step with
+    :func:`geomesa_tpu.obs.jaxmon.observed`: per-call dispatch timing,
+    compile detection, and recompile counts keyed by abstract signature
+    (the live J003), costing ~1-2 µs per millisecond-scale dispatch."""
+    return _observed("select_count", make_select_count_step(mesh))
 
 
 @lru_cache(maxsize=None)
 def cached_select_gather_step(mesh: Mesh, capacity: int, replicate: bool = False):
-    return make_select_gather_step(mesh, capacity, replicate)
+    return _observed(
+        "select_gather", make_select_gather_step(mesh, capacity, replicate)
+    )
 
 
 @lru_cache(maxsize=None)
 def cached_select_count_step_bbox(mesh: Mesh):
-    return make_select_count_step_bbox(mesh)
+    return _observed("select_count_bbox", make_select_count_step_bbox(mesh))
 
 
 @lru_cache(maxsize=None)
 def cached_select_gather_step_bbox(mesh: Mesh, capacity: int):
-    return make_select_gather_step_bbox(mesh, capacity)
+    return _observed(
+        "select_gather_bbox", make_select_gather_step_bbox(mesh, capacity)
+    )
 
 
 @lru_cache(maxsize=None)
 def cached_batched_count_step(mesh: Mesh, impl: str = "auto"):
-    return make_batched_count_step(mesh, impl)
+    return _observed("batched_count", make_batched_count_step(mesh, impl))
 
 
 @lru_cache(maxsize=None)
 def cached_planned_count_step(mesh: Mesh, n_queries: int, block_rows: int,
                               n_pairs: int, chunk: int = 8,
                               overlap: bool = False):
-    return make_planned_count_step(mesh, n_queries, block_rows, n_pairs,
-                                   chunk=chunk, overlap=overlap)
+    return _observed(
+        "planned_count",
+        make_planned_count_step(mesh, n_queries, block_rows, n_pairs,
+                                chunk=chunk, overlap=overlap),
+    )
 
 
 @lru_cache(maxsize=None)
 def cached_planned_gather_step(mesh: Mesh, block_rows: int, n_pairs: int,
                                capacity: int, chunk: int = 8,
                                overlap: bool = False):
-    return make_planned_gather_step(mesh, block_rows, n_pairs, capacity,
-                                    chunk=chunk, overlap=overlap)
+    return _observed(
+        "planned_gather",
+        make_planned_gather_step(mesh, block_rows, n_pairs, capacity,
+                                 chunk=chunk, overlap=overlap),
+    )
 
 
 def _batched_time_match(bins, offs, times):
@@ -423,7 +439,10 @@ def make_batched_edge_gather_step(mesh: Mesh, capacity: int,
 @lru_cache(maxsize=None)
 def cached_batched_edge_gather_step(mesh: Mesh, capacity: int,
                                     overlap: bool = False):
-    return make_batched_edge_gather_step(mesh, capacity, overlap)
+    return _observed(
+        "batched_edge_gather",
+        make_batched_edge_gather_step(mesh, capacity, overlap),
+    )
 
 
 def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
@@ -1074,12 +1093,16 @@ def make_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
 @lru_cache(maxsize=None)
 def cached_batched_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
                             impl: str | None = None):
-    return make_batched_knn_step(mesh, k, with_ttl, impl=impl)
+    return _observed(
+        "batched_knn", make_batched_knn_step(mesh, k, with_ttl, impl=impl)
+    )
 
 
 @lru_cache(maxsize=None)
 def cached_batched_overlap_step(mesh: Mesh, with_time: bool = False):
-    return make_batched_overlap_step(mesh, with_time)
+    return _observed(
+        "batched_overlap", make_batched_overlap_step(mesh, with_time)
+    )
 
 
 def make_batched_density_step(mesh: Mesh, width: int = 256, height: int = 256):
@@ -1246,12 +1269,17 @@ def make_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
 @lru_cache(maxsize=None)
 def cached_ring_knn_step(mesh: Mesh, k: int, with_ttl: bool = False,
                          impl: str | None = None):
-    return make_ring_knn_step(mesh, k, with_ttl, impl=impl)
+    return _observed(
+        "ring_knn", make_ring_knn_step(mesh, k, with_ttl, impl=impl)
+    )
 
 
 @lru_cache(maxsize=None)
 def cached_batched_density_step(mesh: Mesh, width: int, height: int):
-    return make_batched_density_step(mesh, width=width, height=height)
+    return _observed(
+        "batched_density",
+        make_batched_density_step(mesh, width=width, height=height),
+    )
 
 
 # above this group cardinality the (chunk, G) one-hot's O(n·G) FLOPs and
@@ -1514,6 +1542,9 @@ def make_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
 def cached_grouped_agg_step(mesh: Mesh, n_groups: int, n_vals: int,
                             capacity: int, with_ttl: bool = False,
                             impl: str = "auto", overlap: bool = False):
-    return make_grouped_agg_step(
-        mesh, n_groups, n_vals, capacity, with_ttl, impl, overlap
+    return _observed(
+        "grouped_agg",
+        make_grouped_agg_step(
+            mesh, n_groups, n_vals, capacity, with_ttl, impl, overlap
+        ),
     )

@@ -16,6 +16,7 @@ from typing import Any
 
 import numpy as np
 
+from geomesa_tpu import obs
 from geomesa_tpu.curve.binned_time import BinnedTime
 from geomesa_tpu.filter import ast
 from geomesa_tpu.filter.bounds import Extraction, extract
@@ -249,11 +250,12 @@ class QueryPlanner:
         for attr, bounds in e.attributes.items():
             if bounds is not None:
                 notes.append(f"attribute bounds: {attr} in {bounds}")
-        if fids is not None and isinstance(index, IdIndex):
-            plan = index.plan_fids(fids)
-            notes.append(f"id lookup on {len(fids)} fids")
-        else:
-            plan = index.plan(e, max_ranges)
+        with obs.span("decompose", index=name):
+            if fids is not None and isinstance(index, IdIndex):
+                plan = index.plan_fids(fids)
+                notes.append(f"id lookup on {len(fids)} fids")
+            else:
+                plan = index.plan(e, max_ranges)
 
         # FilterSplitter role (FilterSplitter.scala:25): a top-level OR whose
         # arms each bind a DIFFERENT index (e.g. cross-attribute ORs) can run

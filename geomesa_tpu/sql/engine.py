@@ -1379,6 +1379,15 @@ def sql(ds, statement: str, auths=None) -> SqlResult:
     their rows). Paths that cannot apply row visibility — the fused mesh
     aggregation and the device join gather — decline automatically and the
     auths-aware host paths serve instead."""
+    from geomesa_tpu import obs
+
+    # one span per statement; the store queries/aggregations it issues
+    # nest underneath, so a slow statement decomposes in the trace
+    with obs.span("sql", statement=statement[:200]):
+        return _run_statement(ds, statement, auths)
+
+
+def _run_statement(ds, statement: str, auths=None) -> SqlResult:
     # clause keywords are matched on a quote-masked shadow so a WHERE
     # literal containing e.g. 'having' cannot hijack clause splitting; the
     # spans are then sliced from the original statement

@@ -20,6 +20,7 @@ from typing import Any
 
 import numpy as np
 
+from geomesa_tpu import obs
 from geomesa_tpu.curve.binned_time import BinnedTime
 from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
 from geomesa_tpu.filter import ast
@@ -75,7 +76,8 @@ class OracleBackend(ExecutionBackend):
         return None
 
     def select(self, state, index, plan, extraction, residual, table):
-        return np.nonzero(residual.mask(table))[0]
+        with obs.span("refine", mode="oracle", rows=len(table)):
+            return np.nonzero(residual.mask(table))[0]
 
 
 @dataclass
@@ -357,17 +359,23 @@ class TpuBackend(ExecutionBackend):
         dev = state.get(index.name) if state else None
         if dev is None:
             # host path (extended geometries, id index): expand + residual
-            positions, total = gather_indices(intervals)
-            rows = index.perm[positions[:total]]
-            sub = table.take(rows)
-            return rows[residual.mask(sub)]
+            with obs.span("refine", mode="host", index=index.name):
+                positions, total = gather_indices(intervals)
+                rows = index.perm[positions[:total]]
+                sub = table.take(rows)
+                return rows[residual.mask(sub)]
 
-        positions = self._mesh_select_positions(dev, index, extraction, intervals)
+        with obs.span("dispatch", index=index.name,
+                      intervals=len(intervals)):
+            positions = self._mesh_select_positions(
+                dev, index, extraction, intervals
+            )
         rows = index.perm[positions]
         if isinstance(residual, ast.Include):
             return rows
-        sub = table.take(rows)
-        return rows[residual.mask(sub)]
+        with obs.span("refine", candidates=len(rows)):
+            sub = table.take(rows)
+            return rows[residual.mask(sub)]
 
     def select_many_positions(
         self, dev: "_MeshIndexState", index, extractions, intervals_list
@@ -440,27 +448,32 @@ class TpuBackend(ExecutionBackend):
             [p[1] for p in payloads]
             + [np.zeros_like(payloads[0][1])] * (nqp - nq)
         )
+        from geomesa_tpu.obs.jaxmon import count_h2d
+
+        count_h2d(pq, pb, boxes, times)  # per-batch payload staging
         args = (
             *dev.spatial_cols(), jnp.int32(dev.n),
         )
-        counts = np.asarray(
-            cached_planned_count_step(mesh, nqp, B, budget, chunk,
-                                      overlap=overlap)(
-                *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
-                jnp.asarray(boxes[None]), jnp.asarray(times[None]),
-            )
-        )[0]
+        with obs.span("dispatch.count", queries=nq, pairs=len(pair_q)):
+            counts = np.asarray(
+                cached_planned_count_step(mesh, nqp, B, budget, chunk,
+                                          overlap=overlap)(
+                    *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
+                    jnp.asarray(boxes[None]), jnp.asarray(times[None]),
+                )
+            )[0]
         total = int(counts.sum())
         if total == 0:
             return empty
         capacity = pad_bucket(total, minimum=128)
-        buf, hits = cached_planned_gather_step(mesh, B, budget, capacity,
-                                               chunk, overlap=overlap)(
-            *args, jnp.asarray(pq), jnp.asarray(pb),
-            jnp.asarray(boxes), jnp.asarray(times),
-        )
-        buf = np.asarray(buf)
-        hits = np.asarray(hits)
+        with obs.span("dispatch.gather", capacity=capacity):
+            buf, hits = cached_planned_gather_step(mesh, B, budget, capacity,
+                                                   chunk, overlap=overlap)(
+                *args, jnp.asarray(pq), jnp.asarray(pb),
+                jnp.asarray(boxes), jnp.asarray(times),
+            )
+            buf = np.asarray(buf)
+            hits = np.asarray(hits)
         # per-pair spans: a pair's rows sit in its OWNER shard's buffer,
         # consecutively in pair-index order (the device scan's write order)
         blocks_per_shard = dev.rows_per_shard // B
@@ -508,6 +521,9 @@ class TpuBackend(ExecutionBackend):
         )
         bbox_mode = dev.kind == "bboxes"
         boxes, times = self._payload(index.sft, extraction, overlap=bbox_mode)
+        from geomesa_tpu.obs.jaxmon import count_h2d
+
+        count_h2d(idx, counts, boxes, times)  # per-query payload staging
         d_idx = jnp.asarray(idx)
         d_counts = jnp.asarray(counts)
         d_boxes = jnp.asarray(boxes)

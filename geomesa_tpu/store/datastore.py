@@ -16,6 +16,7 @@ from typing import Any
 
 import numpy as np
 
+from geomesa_tpu import obs
 from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import FeatureIndex
 from geomesa_tpu.planning.planner import Query, QueryPlanner, build_indices
@@ -74,6 +75,29 @@ class QueryResult:
 
     def records(self) -> list[dict]:
         return [self.table.record(i) for i in range(len(self.table))]
+
+
+@dataclass
+class ExplainAnalyze:
+    """``EXPLAIN ANALYZE`` output: the static plan text plus the measured
+    per-stage timeline (:class:`geomesa_tpu.obs.StageTimeline`) of one real
+    execution. ``stages`` durations sum to ``wall_ms`` by construction (an
+    ``other`` residual stage absorbs untraced time)."""
+
+    plan: str
+    timeline: Any
+    hits: int
+
+    @property
+    def stages(self) -> list:
+        return self.timeline.stages
+
+    @property
+    def wall_ms(self) -> float:
+        return self.timeline.wall_ms
+
+    def __str__(self) -> str:
+        return f"{self.plan}\n{self.timeline.render()}\n  Hits: {self.hits}"
 
 
 @dataclass
@@ -412,18 +436,19 @@ class DataStore:
         so a failed write never leaves the store half-applied.
         """
         st = self._state(type_name)
-        if isinstance(data, list):
-            if fids is None:
-                fids = self._generate_fids(st, len(data), data)
-            data = FeatureTable.from_records(st.sft, data, fids)
-        self._validate(st.sft, data)
-        self.metrics.counter("store.writes").inc(len(data))
-        with st.lock:
-            st.delta.append(data)
-            compact_now = st.delta.should_compact(st.main_rows)
-        if compact_now:
-            self.compact(type_name)
-        return len(data)
+        with obs.span("write", type_name=type_name):
+            if isinstance(data, list):
+                if fids is None:
+                    fids = self._generate_fids(st, len(data), data)
+                data = FeatureTable.from_records(st.sft, data, fids)
+            self._validate(st.sft, data)
+            self.metrics.counter("store.writes").inc(len(data))
+            with st.lock:
+                st.delta.append(data)
+                compact_now = st.delta.should_compact(st.main_rows)
+            if compact_now:
+                self.compact(type_name)
+            return len(data)
 
     def _generate_fids(self, st, n: int, records: list) -> list:
         """Default feature ids. Schemas opting in via user-data
@@ -792,6 +817,13 @@ class DataStore:
                 "pass query options inside the Query object, not as kwargs: "
                 f"{sorted(kwargs)}"
             )
+        # the per-query trace root (child when already inside a request or
+        # batch span); every stage below opens a child span, so EXPLAIN
+        # ANALYZE and the Perfetto export read straight off this tree
+        with obs.span("query", type_name=type_name):
+            return self._run_query(st, type_name, q)
+
+    def _run_query(self, st: _TypeState, type_name: str, q: Query) -> QueryResult:
         import time as _time
 
         # user query-rewrite hooks run before anything else sees the query
@@ -806,9 +838,10 @@ class DataStore:
             from geomesa_tpu.store.reduce import reduce_result
 
             empty = FeatureTable.from_records(st.sft, [])
-            table, rows, density, stats_out, bin_data = reduce_result(
-                st.sft, empty, np.empty(0, dtype=np.int64), q
-            )
+            with obs.span("reduce", rows=0):
+                table, rows, density, stats_out, bin_data = reduce_result(
+                    st.sft, empty, np.empty(0, dtype=np.int64), q
+                )
             self._audit(type_name, q, 0.0, 0.0, 0)
             return QueryResult(
                 table, rows, density=density, stats=stats_out, bin_data=bin_data
@@ -846,15 +879,18 @@ class DataStore:
                 # TTL stores rewrite the filter with a now_ms cut per call —
                 # the key would never repeat, so don't pay the cache overhead
                 cache_key = None if ttl is not None else self._plan_cache_key(q)
-                cached = self._plan_lookup(st, indices, cache_key)
-                if cached is not None:
-                    plan, f, plan_box["info"] = cached
-                else:
-                    planner = QueryPlanner(st.sft, indices, stats)
-                    plan, f, plan_box["info"] = planner.plan(q)
-                    self._plan_store(
-                        st, indices, cache_key, (plan, f, plan_box["info"])
-                    )
+                with obs.span("plan") as _plan_sp:
+                    cached = self._plan_lookup(st, indices, cache_key)
+                    if cached is not None:
+                        plan, f, plan_box["info"] = cached
+                        _plan_sp.set(cache="hit")
+                    else:
+                        planner = QueryPlanner(st.sft, indices, stats)
+                        plan, f, plan_box["info"] = planner.plan(q)
+                        self._plan_store(
+                            st, indices, cache_key, (plan, f, plan_box["info"])
+                        )
+                    _plan_sp.set(index=plan_box["info"].index_name)
                 plan_box["plan_ms"] = (_time.perf_counter() - t0) * 1000.0
                 info = plan_box["info"]
                 # circuit open → don't touch the device; exact host scan
@@ -880,7 +916,8 @@ class DataStore:
                         raise
                     self._trip_device_circuit(e)
                     self.metrics.counter("store.query.device_failovers").inc()
-                    rows = np.nonzero(f.mask(main))[0]
+                    with obs.span("refine", mode="failover"):
+                        rows = np.nonzero(f.mask(main))[0]
                 else:
                     if state is not None:
                         self._note_device_ok()
@@ -889,17 +926,19 @@ class DataStore:
             # hot-tier merge (LambdaQueryRunner role): brute-force the small
             # unsorted delta and append, row ids offset past the main tier
             if delta_table is not None:
-                dmask = f.mask(delta_table)
-                drows = np.nonzero(dmask)[0]
-                rows = np.concatenate([rows, drows + main_n])
+                with obs.span("delta", rows=len(delta_table)):
+                    dmask = f.mask(delta_table)
+                    drows = np.nonzero(dmask)[0]
+                    rows = np.concatenate([rows, drows + main_n])
 
-            table = _take_combined(st.sft, main, main_n, delta_table, rows)
+            with obs.span("reduce", rows=len(rows)):
+                table = _take_combined(st.sft, main, main_n, delta_table, rows)
 
-            # shared post-scan pipeline: visibility, sampling, aggregation
-            # hints, sort/limit/projection/CRS (LocalQueryRunner shape)
-            from geomesa_tpu.store.reduce import reduce_result
+                # shared post-scan pipeline: visibility, sampling, aggregation
+                # hints, sort/limit/projection/CRS (LocalQueryRunner shape)
+                from geomesa_tpu.store.reduce import reduce_result
 
-            return reduce_result(st.sft, table, rows, q)
+                return reduce_result(st.sft, table, rows, q)
 
         # query watchdog (ThreadManagement role): per-query ``timeout`` hint
         # in seconds; timed-out scans are abandoned and counted
@@ -1118,6 +1157,13 @@ class DataStore:
         transparently run per-query instead, same results either way.
         Point AND extended-geometry (XZ bbox-layout) stores both batch.
         """
+        queries = list(queries)
+        # ONE batch span; every query lands a per-query child span (the
+        # fallback path through query() and the batched tail both open one)
+        with obs.span("select_many", n_queries=len(queries)):
+            return self._run_select_many(type_name, queries)
+
+    def _run_select_many(self, type_name: str, queries) -> list:
         import time as _time
 
         st = self._state(type_name)
@@ -1167,14 +1213,15 @@ class DataStore:
             return [_fallback(i) for i in range(len(qs))]
 
         planned = []
-        for q in qs:
-            cache_key = None if ttl is not None else self._plan_cache_key(q)
-            cached = self._plan_lookup(st, indices, cache_key)
-            if cached is None:
-                planner = QueryPlanner(st.sft, indices, stats)
-                cached = planner.plan(q)
-                self._plan_store(st, indices, cache_key, cached)
-            planned.append((q, *cached))  # (q, plan, f, info)
+        with obs.span("plan", queries=len(qs)):
+            for q in qs:
+                cache_key = None if ttl is not None else self._plan_cache_key(q)
+                cached = self._plan_lookup(st, indices, cache_key)
+                if cached is None:
+                    planner = QueryPlanner(st.sft, indices, stats)
+                    cached = planner.plan(q)
+                    self._plan_store(st, indices, cache_key, cached)
+                planned.append((q, *cached))  # (q, plan, f, info)
         plan_ms = (_time.perf_counter() - t_start) * 1000.0
 
         results: list = [None] * len(qs)
@@ -1219,22 +1266,29 @@ class DataStore:
             for i, positions in zip(idxs, pos_lists):
                 q, plan, f, info = planned[i]
                 tq0 = _time.perf_counter()
-                rows = index.perm[positions]
-                # exact residual: same contract as backend.select (int
-                # superset culled on device, f64 filter settles the rest)
-                if len(rows) and not isinstance(f, ast.Include):
-                    rows = rows[f.mask(main.take(rows))]
-                rows = np.sort(rows)
-                if delta_table is not None:
-                    drows = np.nonzero(f.mask(delta_table))[0]
-                    rows = np.concatenate([rows, drows + main_n])
-                table = _take_combined(st.sft, main, main_n, delta_table,
-                                       rows)
-                tbl, rws, density, stats_out, bin_data = reduce_result(
-                    st.sft, table, rows, q)
-                tail_ms = (_time.perf_counter() - tq0) * 1000.0
-                self._audit(type_name, q, plan_ms / len(qs),
-                            shared_ms / len(idxs) + tail_ms, len(tbl))
+                # per-query child span: the host tail each batched query
+                # pays individually (residual refine + reduce); the shared
+                # dispatch spans above cover the device half
+                with obs.span("query", batch_index=i, batched=True):
+                    with obs.span("refine", candidates=len(positions)):
+                        rows = index.perm[positions]
+                        # exact residual: same contract as backend.select
+                        # (int superset culled on device, f64 filter
+                        # settles the rest)
+                        if len(rows) and not isinstance(f, ast.Include):
+                            rows = rows[f.mask(main.take(rows))]
+                        rows = np.sort(rows)
+                        if delta_table is not None:
+                            drows = np.nonzero(f.mask(delta_table))[0]
+                            rows = np.concatenate([rows, drows + main_n])
+                    with obs.span("reduce", rows=len(rows)):
+                        table = _take_combined(st.sft, main, main_n,
+                                               delta_table, rows)
+                        tbl, rws, density, stats_out, bin_data = reduce_result(
+                            st.sft, table, rows, q)
+                    tail_ms = (_time.perf_counter() - tq0) * 1000.0
+                    self._audit(type_name, q, plan_ms / len(qs),
+                                shared_ms / len(idxs) + tail_ms, len(tbl))
                 results[i] = QueryResult(
                     tbl, rws, info, density=density, stats=stats_out,
                     bin_data=bin_data,
@@ -1305,10 +1359,12 @@ class DataStore:
             # one fused scan over the mesh-sharded columns, counts
             # psum-merged over the data axis (P4 + P6); the query batch must
             # divide the mesh query axis — pad with duplicates and discard
+            from geomesa_tpu.obs.jaxmon import count_h2d
             from geomesa_tpu.parallel.mesh import pad_query_axis
 
             mesh = self.backend._get_mesh()
             (boxes, times), _ = pad_query_axis(mesh, boxes, times)
+            count_h2d(boxes, times)  # per-batch payload staging
             edge_pos = edge_hits = None
             cap = 512
             try:
@@ -1534,6 +1590,9 @@ class DataStore:
                 pv[0, : len(main)] = v[perm]
                 got = (jax.device_put(pv, sharding), v)
                 dev.agg_cache[("val", c)] = got
+                from geomesa_tpu.obs.jaxmon import count_h2d
+
+                count_h2d(pv)
             per_dev.append(got[0])
             per_host.append(got[1])
         if per_dev:
@@ -1644,6 +1703,9 @@ class DataStore:
         boxes = np.stack([p[0] for _, p in live])
         times = np.stack([p[1] for _, p in live])
         (boxes, times), _ = pad_query_axis(mesh, boxes, times)
+        from geomesa_tpu.obs.jaxmon import count_h2d
+
+        count_h2d(boxes, times)  # per-batch payload staging
         try:
             step = cached_grouped_agg_step(
                 mesh, G_pad, len(value_cols), cap,
@@ -1908,6 +1970,9 @@ class DataStore:
             gbs = np.broadcast_to(gb, (len(live), 4)).copy()
             mesh = self.backend._get_mesh()
             (boxes, times, gbs), _ = pad_query_axis(mesh, boxes, times, gbs)
+            from geomesa_tpu.obs.jaxmon import count_h2d
+
+            count_h2d(boxes, times, gbs)  # per-batch payload staging
             c = dev.cols
             try:
                 grids = np.asarray(
@@ -1946,6 +2011,9 @@ class DataStore:
 
         filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
         hints = ", ".join(f"{k}={v!r}" for k, v in sorted(q.hints.items()))
+        # audit↔trace join: the innermost live span is this query's (the
+        # "query" span in query()/select_many); empty when tracing is off
+        sp = obs.current()
         self.audit_writer.write_event(
             QueryEvent(
                 store_type=type(self.backend).__name__,
@@ -1957,10 +2025,24 @@ class DataStore:
                 plan_time_ms=plan_ms,
                 scan_time_ms=scan_ms,
                 hits=hits,
+                trace_id=sp.trace_id if sp is not None else "",
+                span_id=sp.span_id if sp is not None else "",
             )
         )
 
-    def explain(self, type_name: str, q: "Query | str | ast.Filter") -> str:
+    def explain(
+        self,
+        type_name: str,
+        q: "Query | str | ast.Filter",
+        analyze: bool = False,
+    ) -> "str | ExplainAnalyze":
+        """Static plan explain; ``analyze=True`` additionally EXECUTES the
+        query under a collected trace and returns an :class:`ExplainAnalyze`
+        whose stage timeline (plan → dispatch → refine → reduce, plus an
+        ``other`` residual) sums to the measured wall time. Range
+        decomposition shows as a ``decompose`` span NESTED under ``plan``
+        in the full trace tree (``timeline.root``), not as a top-level
+        stage."""
         st = self._state(type_name)
         if isinstance(q, (str, ast.Filter)):
             q = Query(filter=q)
@@ -1971,7 +2053,18 @@ class DataStore:
             # intervals above cover the SORTED main tier only; pending hot-
             # tier rows are brute-forced at query time until compact()
             out += f"\n  Hot tier (unsorted, merged at query time): {st.delta.rows} rows"
-        return out
+        if not analyze:
+            return out
+        from geomesa_tpu.obs import trace as _trace
+
+        with _trace.collect("explain.analyze", type_name=type_name) as root:
+            res = self.query(type_name, q)
+        qspans = root.find("query")
+        return ExplainAnalyze(
+            plan=out,
+            timeline=_trace.StageTimeline(qspans[0] if qspans else root),
+            hits=res.count,
+        )
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------
     def stats_count(self, type_name: str, cql=None, exact: bool = False):

@@ -190,6 +190,13 @@ class JournalBus:
     # -- write side ----------------------------------------------------------
     def publish(self, topic: str, key: str, data: bytes,
                 barrier: bool = False) -> None:
+        from geomesa_tpu import obs
+
+        with obs.span("journal.publish", topic=topic, bytes=len(data)):
+            self._publish(topic, key, data, barrier)
+
+    def _publish(self, topic: str, key: str, data: bytes,
+                 barrier: bool = False) -> None:
         self.create_topic(topic)
         rec = _HEADER.pack(len(data), 1 if barrier else 0, _key_hash(key)) + data
         path = self._log_path(topic)
@@ -276,7 +283,10 @@ class JournalBus:
         """Messages [offset, offset+max_n) of one partition's log. Offsets
         below a trimmed prefix (see :meth:`trim`) yield from the first
         retained message."""
-        self._refresh(topic)
+        from geomesa_tpu import obs
+
+        with obs.span("journal.poll", topic=topic, partition=partition):
+            self._refresh(topic)
         with self._lock:
             base = self._pbase[topic][partition]
             log = self._plogs[topic][partition]

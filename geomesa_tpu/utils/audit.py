@@ -27,6 +27,10 @@ class QueryEvent:
     scan_time_ms: float
     hits: int
     deleted: bool = False
+    # obs join keys: the trace/span this query ran under (empty when
+    # tracing was off) — audit records join to Perfetto timelines on these
+    trace_id: str = ""
+    span_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)

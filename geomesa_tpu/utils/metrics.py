@@ -34,7 +34,11 @@ class Counter:
 
 @dataclass
 class Histogram:
-    """Streaming histogram: count/mean/min/max/variance (Welford)."""
+    """Streaming histogram: count/mean/min/max/variance (Welford) plus a
+    bounded reservoir (Vitter's algorithm R, ``RESERVOIR_SIZE`` samples) so
+    p50/p95/p99 are available at any stream length in O(1) memory."""
+
+    RESERVOIR_SIZE = 1024
 
     count: int = 0
     mean: float = 0.0
@@ -43,11 +47,19 @@ class Histogram:
     max: float = -math.inf
 
     def __post_init__(self):
+        import random
         import threading
 
         # Welford is a multi-field read-modify-write: interleaved updates
         # from parallel requests corrupt mean/m2 without the lock
         self._lock = threading.Lock()
+        # construction-time publication: no other thread can hold a
+        # reference during __post_init__
+        # tpulint: disable-next-line=C001
+        self._reservoir: list[float] = []
+        # deterministic per-instance stream: quantiles are reproducible in
+        # tests without touching the global random state
+        self._rng = random.Random(0x9E3779B9)
 
     def update(self, v: float) -> None:
         with self._lock:
@@ -57,25 +69,72 @@ class Histogram:
             self.m2 += d * (v - self.mean)
             self.min = min(self.min, v)
             self.max = max(self.max, v)
+            # algorithm R: uniform sample over the whole stream
+            if len(self._reservoir) < self.RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR_SIZE:
+                    self._reservoir[j] = v
 
     @property
     def stddev(self) -> float:
         return math.sqrt(self.m2 / self.count) if self.count else 0.0
 
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
+        """Reservoir quantiles (nearest-rank with linear interpolation).
+        Only the COPY happens under the lock; the O(n log n) sort runs
+        outside it so a metrics scrape never stalls hot-path update()."""
+        with self._lock:
+            sample = list(self._reservoir)
+        sample.sort()
+        if not sample:
+            return [0.0] * len(qs)
+        out = []
+        top = len(sample) - 1
+        for q in qs:
+            pos = q * top
+            lo = int(pos)
+            hi = min(lo + 1, top)
+            frac = pos - lo
+            out.append(sample[lo] * (1.0 - frac) + sample[hi] * frac)
+        return out
+
 
 @dataclass
 class Gauge:
-    """Point-in-time value; ``fn``-backed gauges sample at snapshot time."""
+    """Point-in-time value; ``fn``-backed gauges sample at snapshot time.
+
+    Writes are locked like Counter/Histogram updates: ``set`` from parallel
+    request threads and ``value`` reads from a background reporter must
+    never observe a torn/stale mix (C001 lock discipline — covered by
+    concurrent set/sample assertions in tests/test_obs.py)."""
 
     _value: float = 0.0
     fn: object = None  # optional zero-arg callable
 
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+
     def set(self, v: float) -> None:
-        self._value = v
+        with self._lock:
+            self._value = v
+
+    def add(self, delta: float) -> float:
+        """Atomic read-modify-write increment (a lock-free ``set(value +
+        d)`` from two threads loses one update; this cannot)."""
+        with self._lock:
+            self._value += delta
+            return self._value
 
     @property
     def value(self) -> float:
-        return float(self.fn()) if self.fn is not None else self._value
+        if self.fn is not None:
+            return float(self.fn())  # sampled outside the lock: fn owns its state
+        with self._lock:
+            return self._value
 
 
 @dataclass
@@ -98,17 +157,29 @@ class MetricsRegistry:
         self.timers: dict[str, Timer] = {}
         self.gauges: dict[str, Gauge] = {}
 
+    # accessors check membership before constructing the default: hot
+    # telemetry paths (obs.jaxmon per-dispatch counters) resolve by name
+    # every call, and an eager `setdefault(name, Counter())` would build
+    # and discard a metric + lock per hit. On a racing miss two defaults
+    # may construct; setdefault keeps exactly one (the returned winner).
     def counter(self, name: str) -> Counter:
-        return self.counters.setdefault(name, Counter())
+        c = self.counters.get(name)
+        return c if c is not None else self.counters.setdefault(name, Counter())
 
     def gauge(self, name: str) -> Gauge:
-        return self.gauges.setdefault(name, Gauge())
+        g = self.gauges.get(name)
+        return g if g is not None else self.gauges.setdefault(name, Gauge())
 
     def histogram(self, name: str) -> Histogram:
-        return self.histograms.setdefault(name, Histogram())
+        h = self.histograms.get(name)
+        return (
+            h if h is not None
+            else self.histograms.setdefault(name, Histogram())
+        )
 
     def timer(self, name: str) -> Timer:
-        return self.timers.setdefault(name, Timer())
+        t = self.timers.get(name)
+        return t if t is not None else self.timers.setdefault(name, Timer())
 
     # -- reporters ----------------------------------------------------------
     def snapshot(self) -> dict:
@@ -121,6 +192,7 @@ class MetricsRegistry:
         for k, g in list(self.gauges.items()):
             out[k] = {"type": "gauge", "value": g.value}
         for k, h in list(self.histograms.items()):
+            p50, p95, p99 = h.quantiles()
             out[k] = {
                 "type": "histogram",
                 "count": h.count,
@@ -128,17 +200,33 @@ class MetricsRegistry:
                 "min": h.min if h.count else 0.0,
                 "max": h.max if h.count else 0.0,
                 "stddev": h.stddev,
+                "p50": p50,
+                "p95": p95,
+                "p99": p99,
             }
         for k, t in list(self.timers.items()):
             h = t.hist
+            p50, p95, p99 = h.quantiles()
             out[k] = {
                 "type": "timer",
                 "count": h.count,
                 "mean_ms": h.mean,
                 "min_ms": h.min if h.count else 0.0,
                 "max_ms": h.max if h.count else 0.0,
+                "p50_ms": p50,
+                "p95_ms": p95,
+                "p99_ms": p99,
             }
         return out
+
+    def report_prometheus(self, prefix: str = "geomesa") -> str:
+        """Prometheus text exposition of this registry (counters as
+        ``_total``, histograms/timers as summaries with p50/p95/p99
+        quantile labels) — the exposition behind
+        ``GET /api/metrics?format=prometheus``."""
+        from geomesa_tpu.obs.export import prometheus_text
+
+        return prometheus_text(self, prefix=prefix)
 
     def report_graphite(self, prefix: str = "geomesa") -> str:
         """Graphite plaintext-protocol dump (``GraphiteReporter`` role)."""
@@ -305,13 +393,19 @@ def emf_snapshot(registry: MetricsRegistry, namespace: str = "geomesa",
         elif typ == "gauge":
             metrics.append({"Name": name, "Unit": "None"})
             values[name] = float(vals["value"])
-        else:  # histogram / timer: mean + count as two metrics
-            mean_key = "mean_ms" if typ == "timer" else "mean"
-            unit = "Milliseconds" if typ == "timer" else "None"
+        else:  # histogram / timer: mean + count + quantiles
+            timer = typ == "timer"
+            mean_key = "mean_ms" if timer else "mean"
+            unit = "Milliseconds" if timer else "None"
             metrics.append({"Name": f"{name}.mean", "Unit": unit})
             values[f"{name}.mean"] = float(vals[mean_key])
             metrics.append({"Name": f"{name}.count", "Unit": "Count"})
             values[f"{name}.count"] = float(vals["count"])
+            for q in ("p50", "p95", "p99"):
+                key = f"{q}_ms" if timer else q
+                if key in vals:
+                    metrics.append({"Name": f"{name}.{q}", "Unit": unit})
+                    values[f"{name}.{q}"] = float(vals[key])
     return {
         "_aws": {
             "Timestamp": int(time.time() * 1000),

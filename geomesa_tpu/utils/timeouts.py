@@ -42,14 +42,20 @@ def run_with_timeout(fn, timeout_s: float | None, *args, **kwargs):
     global _abandoned_running
     if timeout_s is None:
         return fn(*args, **kwargs)
+    import contextvars
+
     finished = threading.Event()
     state = {"timed_out": False}
     box: list = [None, None]  # [result, exception]
+    # the worker inherits the caller's context (trace spans propagate via
+    # ContextVar — a timed-out query's scan spans must attach to ITS trace,
+    # not float as orphan roots)
+    ctx = contextvars.copy_context()
 
     def work():
         global _abandoned_running
         try:
-            box[0] = fn(*args, **kwargs)
+            box[0] = ctx.run(fn, *args, **kwargs)
         except BaseException as e:  # propagated below if the caller still waits
             box[1] = e
         finally:

@@ -30,6 +30,7 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}/density?cql=&bbox=&width=&height=
     GET    /api/audit?typeName=                  query audit records
     GET    /api/metrics                          metrics registry snapshot
+    GET    /api/metrics?format=prometheus       Prometheus text exposition
     GET    /wfs?service=WFS&request=...          OGC WFS 2.0 KVP binding
     GET    /wms?service=WMS&request=...          OGC WMS 1.3.0 (GetMap tiles)
     POST   /api/lease/{acquire|renew|release}    cross-host expiring leases
@@ -49,6 +50,7 @@ from urllib.parse import parse_qs
 
 import numpy as np
 
+from geomesa_tpu import obs
 from geomesa_tpu.planning.planner import Query
 
 __all__ = ["GeoMesaApp", "serve"]
@@ -180,18 +182,26 @@ class GeoMesaApp:
                 if match:
                     matched_path = True
                     if m == method:
-                        if metrics is not None:
-                            metrics.counter(
-                                f"web.requests.{handler.__name__.lstrip('_')}"
-                            ).inc()
-                            with metrics.timer("web.request_ms").time():
+                        # one trace root per request: each server thread's
+                        # ContextVar starts empty, so concurrent requests
+                        # build disjoint span trees; the handler's store
+                        # queries/serialization nest underneath
+                        with obs.span(
+                            "http", method=method, path=path,
+                            route=handler.__name__.lstrip("_"),
+                        ):
+                            if metrics is not None:
+                                metrics.counter(
+                                    f"web.requests.{handler.__name__.lstrip('_')}"
+                                ).inc()
+                                with metrics.timer("web.request_ms").time():
+                                    status, payload, ctype = handler(
+                                        *match.groups(), params=params, body=body
+                                    )
+                            else:
                                 status, payload, ctype = handler(
                                     *match.groups(), params=params, body=body
                                 )
-                        else:
-                            status, payload, ctype = handler(
-                                *match.groups(), params=params, body=body
-                            )
                         return self._respond(start_response, status, payload, ctype)
             raise _HttpError(405 if matched_path else 404,
                              "method not allowed" if matched_path else "not found")
@@ -549,7 +559,10 @@ class GeoMesaApp:
         from geomesa_tpu.web.formats import UnknownFormat, format_table
 
         try:
-            payload, ctype = format_table(r.table, fmt)
+            # the pipeline's last stage: payload encoding, timed apart from
+            # the store scan it follows
+            with obs.span("serialize", format=fmt, rows=r.count):
+                payload, ctype = format_table(r.table, fmt)
         except UnknownFormat:
             raise _HttpError(400, f"unknown format {fmt!r}") from None
         return 200, payload, ctype
@@ -794,6 +807,18 @@ class GeoMesaApp:
 
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
+        if params.get("format") == "prometheus":
+            # text exposition for a Prometheus scrape: the store registry
+            # plus the process-wide jax telemetry registry (compile times,
+            # per-step dispatch, recompile counts) when it exists
+            from geomesa_tpu.obs import jaxmon
+            from geomesa_tpu.obs.export import (
+                PROMETHEUS_CONTENT_TYPE,
+                prometheus_text,
+            )
+
+            text = prometheus_text(m, jaxmon.GLOBAL)
+            return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         return 200, (m.snapshot() if m is not None else {}), "application/json"
 
     def _ogc(self, handler, error_cls, params):

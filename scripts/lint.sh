@@ -13,3 +13,8 @@ cd "$(dirname "$0")/.."
 GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis \
     geomesa_tpu/ scripts/ bench.py __graft_entry__.py \
     --baseline .tpulint-baseline.json "$@"
+
+# tracing-overhead smoke gate (the dynamic half): the obs subsystem's span
+# propagation, exporter, and disabled-path overhead bound must hold before
+# any instrumented hot path ships. Runs on the 8-device virtual CPU mesh.
+JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q

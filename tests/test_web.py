@@ -157,6 +157,23 @@ class TestQueryAndStats:
         status, out = jcall(app, "GET", "/api/metrics")
         assert status == 200 and out["store.queries"]["count"] >= 1
 
+    def test_metrics_prometheus_exposition(self, app):
+        _ingest(app)
+        jcall(app, "GET", "/api/schemas/pts/query", "cql=BBOX(geom,0,0,10,10)")
+        status, headers, data = call(
+            app, "GET", "/api/metrics", "format=prometheus"
+        )
+        assert status == 200
+        assert headers["Content-Type"] == "text/plain; version=0.0.4; charset=utf-8"
+        text = data.decode()
+        assert "# TYPE geomesa_store_queries_total counter" in text
+        assert "geomesa_store_queries_total" in text
+        # timers export as summaries with quantile labels
+        assert 'geomesa_web_request_ms_seconds{quantile="0.5"}' in text
+        # the JSON snapshot stays the default
+        status, out = jcall(app, "GET", "/api/metrics")
+        assert status == 200 and isinstance(out, dict)
+
     def test_count_many(self, app):
         _ingest(app)
         status, out = jcall(
