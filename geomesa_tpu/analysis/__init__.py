@@ -1,14 +1,19 @@
-"""tpulint — JAX/Pallas-aware static analysis for geomesa_tpu.
+"""tpulint + tpurace — static analysis for geomesa_tpu.
 
 The JVM reference enforces its layer contracts through the type system
 (PAPER.md §1); this package is the equivalent machine check for the
 invariants Python can't type: tracer-safe control flow (J001), sync-free
 hot paths (J002), stable jit caches (J003), the TPU 32-bit dtype
-contract (J004), and lock discipline in the stream layer (C001).
+contract (J004), lock discipline in the stream layer (C001), waiver
+hygiene (W001), and — via the whole-program ``--race`` pass
+(:mod:`geomesa_tpu.analysis.race`) — guarded-field access (R001),
+lock-order cycles (R002), and blocking calls under hot-path locks
+(R003), with a runtime lock-order sanitizer as the dynamic twin.
 
 Run it::
 
     python -m geomesa_tpu.analysis --baseline .tpulint-baseline.json
+    python -m geomesa_tpu.analysis --race
 
 Pure AST: linted files are parsed, never imported, and this package
 imports neither JAX nor any other geomesa_tpu subsystem (scripts/lint.sh

@@ -31,17 +31,29 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m geomesa_tpu.analysis",
         description="tpulint: JAX/Pallas-aware static analysis for "
-                    "geomesa_tpu (rules J001-J004, C001).",
+                    "geomesa_tpu (rules J001-J004, C001, W001; "
+                    "--race runs the tpurace rules R001-R003).",
     )
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint "
                              "(default: the geomesa_tpu package)")
+    parser.add_argument("--race", action="store_true",
+                        help="run the whole-program tpurace concurrency "
+                             "analysis (R001 guarded-field access, R002 "
+                             "lock-order cycles, R003 blocking under a "
+                             "hot-path lock) instead of the per-module "
+                             "lint rules")
+    parser.add_argument("--guards", action="store_true",
+                        help="with --race: print the inferred guard map "
+                             "(which lock protects which field) and exit")
     parser.add_argument("--baseline", metavar="FILE",
                         help="baseline JSON; matching violations don't fail")
     parser.add_argument("--write-baseline", action="store_true",
                         help="rewrite --baseline with current violations "
                              "and exit 0")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="'json' and 'sarif' both emit SARIF 2.1.0")
     parser.add_argument("--rules", metavar="IDS",
                         help="comma-separated rule ids to run (default all)")
     parser.add_argument("--verbose", action="store_true",
@@ -64,8 +76,59 @@ def main(argv: list[str] | None = None) -> int:
         if not os.path.exists(p):
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
+    if config.rules is not None:
+        from geomesa_tpu.analysis.rules import all_rules as _all_rules
+
+        unknown = set(config.rules) - set(_all_rules())
+        if unknown:
+            print(f"tpulint: unknown rule ids: {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        # a --rules set that selects NOTHING in the chosen mode must be a
+        # usage error, not a vacuous exit 0 (a misconfigured CI gate would
+        # read as clean forever)
+        race_ids = {"R001", "R002", "R003"}
+        requested = set(config.rules)
+        if requested == {"W001"}:
+            # W001 judges waivers against the OTHER rules that ran; alone
+            # it can never emit anything — another vacuous-always-pass
+            print("tpulint: --rules W001 alone judges nothing — select "
+                  "the rules whose waivers it should check too",
+                  file=sys.stderr)
+            return 2
+        if args.race and not requested & (race_ids | {"W001"}):
+            print(f"tpulint: --race with --rules {args.rules} selects no "
+                  f"race rule (R001/R002/R003/W001)", file=sys.stderr)
+            return 2
+        if not args.race and requested <= race_ids:
+            print(f"tpulint: {args.rules} are whole-program race rules — "
+                  f"pass --race to run them", file=sys.stderr)
+            return 2
+
+    if args.guards:
+        if not args.race:
+            print("tpulint: --guards requires --race (the guard map is a "
+                  "tpurace view)", file=sys.stderr)
+            return 2
+        import json
+
+        from geomesa_tpu.analysis.race import guard_map
+        from geomesa_tpu.analysis.race.lockset import load_modules
+
+        # (unknown --rules ids were already rejected above)
+        modules, errors = load_modules(paths)
+        for e in errors:  # a skipped module would silently shrink the map
+            print(f"tpulint: {e.path}:{e.line}: {e.message}",
+                  file=sys.stderr)
+        print(json.dumps(guard_map(modules, config), indent=1))
+        return 0
     try:
-        violations = lint_paths(paths, config)
+        if args.race:
+            from geomesa_tpu.analysis.race import analyze_race_paths
+
+            violations = analyze_race_paths(paths, config)
+        else:
+            violations = lint_paths(paths, config)
     except ValueError as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
@@ -84,7 +147,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.baseline:
         apply_baseline(violations, load_baseline(args.baseline))
 
-    if args.format == "json":
+    if args.format in ("json", "sarif"):
         print(render_json(violations))
     else:
         print(render_text(violations, verbose=args.verbose))

@@ -11,6 +11,15 @@ Suppression model, narrowest to widest:
 - per-line waiver: ``# tpulint: disable=J002`` (same line) or
   ``# tpulint: disable-next-line=J002,C001`` — for reviewed, intentional
   sites (e.g. the one sanctioned device→host readback of a hot path).
+  ``# tpurace: disable=R001`` is the identical syntax for the race
+  rules; the two spellings share one namespace (either prefix waives
+  either family), they just make intent greppable per prong.
+- stale-waiver hygiene (W001): a waiver whose every listed rule ran in
+  the current pass yet suppressed nothing is itself a violation — dead
+  waivers otherwise accumulate and silently license future regressions
+  at that line. Rules that did NOT run in the pass (race rules during a
+  lint pass and vice versa) make the comment unjudgeable, so
+  mixed-prong waivers belong on separate comments.
 - baseline file: a committed JSON multiset of known legacy violations
   (``--baseline .tpulint-baseline.json``). Violations matching a baseline
   entry report as ``baselined`` and do not fail the run; NEW violations
@@ -31,6 +40,7 @@ from dataclasses import dataclass, field
 __all__ = [
     "Violation", "LintConfig", "Module", "lint_source", "lint_paths",
     "load_baseline", "write_baseline", "apply_baseline", "iter_py_files",
+    "parse_module", "waiver_map", "stale_waiver_violations",
 ]
 
 
@@ -70,6 +80,15 @@ class LintConfig:
     # classes that own a threading lock (the stream layer, lock utilities,
     # and every other utils/locks user).
     c001_paths: tuple[str, ...] = ("",)
+    # tpurace (R001-R003) module scope: the whole package by default — the
+    # analysis self-scopes to code that owns or touches locks.
+    race_paths: tuple[str, ...] = ("",)
+    # R003 "hot-path lock" owners: a blocking call is only flagged while a
+    # lock owned by one of these layers is held (the serving path); a lock
+    # in, say, a converter script may legally wrap I/O.
+    r003_paths: tuple[str, ...] = (
+        "store/", "stream/", "obs/", "utils/", "web/", "parallel/",
+    )
     # Names of rules to run; None = all registered.
     rules: tuple[str, ...] | None = None
 
@@ -93,22 +112,109 @@ class Module:
         return ""
 
 
+# Both prongs share one waiver namespace — ``# tpulint:`` and
+# ``# tpurace:`` are interchangeable spellings of the same suppression.
 _WAIVER = re.compile(
-    r"#\s*tpulint:\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"#\s*tpu(?:lint|race):\s*disable(?P<next>-next-line)?\s*=\s*"
     r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)"
 )
 
 
-def _waivers(lines: list[str]) -> dict[int, set[str]]:
-    """Line number → set of waived rule ids ({'all'} waives everything)."""
+@dataclass
+class WaiverComment:
+    """One ``disable=`` comment: where it sits, which line it waives, and
+    the rule ids it names (``{"all"}`` waives everything)."""
+
+    line: int
+    target: int
+    rules: set[str]
+
+
+def _comment_texts(lines: list[str]) -> list[tuple[int, str]]:
+    """(line, text) of REAL ``#`` comments — tokenized, so waiver syntax
+    quoted inside a docstring (e.g. this module's own documentation) is
+    neither a live waiver nor a stale one."""
+    import io
+    import tokenize
+
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(
+            "\n".join(lines) + "\n").readline)
+        return [
+            (t.start[0], t.string) for t in toks
+            if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparseable tail (mid-edit file): fall back to the raw scan
+        return list(enumerate(lines, start=1))
+
+
+def waiver_comments(lines: list[str]) -> list[WaiverComment]:
+    out: list[WaiverComment] = []
+    for i, text in _comment_texts(lines):
+        for m in _WAIVER.finditer(text):
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            target = i + 1 if m.group("next") else i
+            out.append(WaiverComment(line=i, target=target, rules=rules))
+    return out
+
+
+def waiver_map(
+    lines: list[str],
+    comments: list[WaiverComment] | None = None,
+) -> dict[int, set[str]]:
+    """Line number → set of waived rule ids ({'all'} waives everything).
+    Pass ``comments`` (one :func:`waiver_comments` call) to avoid
+    re-tokenizing the file."""
     out: dict[int, set[str]] = {}
-    for i, text in enumerate(lines, start=1):
-        m = _WAIVER.search(text)
-        if not m:
+    for c in comments if comments is not None else waiver_comments(lines):
+        out.setdefault(c.target, set()).update(c.rules)
+    return out
+
+
+def apply_waivers(
+    violations: list[Violation],
+    lines: list[str],
+    comments: list[WaiverComment] | None = None,
+) -> None:
+    """Mark waived violations (same ``comments`` contract as
+    :func:`waiver_map`)."""
+    waivers = waiver_map(lines, comments)
+    for v in violations:
+        waived = waivers.get(v.line, set())
+        if "all" in waived or v.rule in waived:
+            v.waived = True
+
+
+def stale_waiver_violations(
+    lines: list[str],
+    violations: list[Violation],
+    judged_ids: set[str],
+    path: str,
+    comments: list[WaiverComment] | None = None,
+) -> list[Violation]:
+    """W001: waiver comments that suppress nothing.
+
+    A comment is judged only when EVERY rule it names ran in this pass
+    (``judged_ids``) — a lint pass cannot call a race-rule waiver stale,
+    and vice versa. ``disable=all`` is never judged (its scope spans both
+    prongs by construction)."""
+    hit = {(v.line, v.rule) for v in violations}
+    out: list[Violation] = []
+    for c in comments if comments is not None else waiver_comments(lines):
+        rules = c.rules - {"W001"}
+        if not rules or "all" in rules or not rules <= judged_ids:
             continue
-        rules = {r.strip() for r in m.group("rules").split(",")}
-        target = i + 1 if m.group("next") else i
-        out.setdefault(target, set()).update(rules)
+        if any((c.target, r) in hit for r in rules):
+            continue
+        where = "this line" if c.target == c.line else f"line {c.target}"
+        out.append(Violation(
+            rule="W001", path=path, line=c.line, col=0,
+            message=(
+                f"stale waiver: {', '.join(sorted(rules))} suppress(es) "
+                f"nothing on {where} — delete the comment, or fix the rule "
+                f"list (a dead waiver licenses a future regression)"),
+        ))
     return out
 
 
@@ -124,6 +230,28 @@ def package_relpath(path: str) -> str:
     return norm
 
 
+def parse_module(
+    source: str, path: str, relpath: str | None = None
+) -> Module | Violation:
+    """Parse one file into a :class:`Module`, or an E000 violation on a
+    syntax error (shared by the per-module linter and the whole-program
+    race analysis)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Violation(
+            rule="E000", path=path, line=e.lineno or 1, col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )
+    return Module(
+        path=path,
+        relpath=relpath if relpath is not None else package_relpath(path),
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+    )
+
+
 def lint_source(
     source: str,
     path: str,
@@ -136,32 +264,27 @@ def lint_source(
     from geomesa_tpu.analysis.rules import active_rules
 
     config = config or LintConfig()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Violation(
-            rule="E000", path=path, line=e.lineno or 1, col=e.offset or 0,
-            message=f"syntax error: {e.msg}",
-        )]
-    lines = source.splitlines()
-    mod = Module(
-        path=path,
-        relpath=relpath if relpath is not None else package_relpath(path),
-        source=source,
-        tree=tree,
-        lines=lines,
-    )
+    mod = parse_module(source, path, relpath)
+    if isinstance(mod, Violation):
+        return [mod]
     violations: list[Violation] = []
-    for rule in active_rules(config):
-        for v in rule.check(mod, config):
-            if not v.snippet:
-                v.snippet = mod.snippet(v.line)
-            violations.append(v)
-    waivers = _waivers(lines)
+    rules = active_rules(config)
+    for rule in rules:
+        violations.extend(rule.check(mod, config))
+    # W001 judges only the single-module rules that actually ran here; the
+    # whole-program race rules (project=True) are judged by the race driver
+    comments = waiver_comments(mod.lines)
+    if config.rules is None or "W001" in config.rules:
+        judged = {
+            r.id for r in rules
+            if not getattr(r, "project", False) and r.id != "W001"
+        }
+        violations.extend(stale_waiver_violations(
+            mod.lines, violations, judged, path, comments))
     for v in violations:
-        waived = waivers.get(v.line, set())
-        if "all" in waived or v.rule in waived:
-            v.waived = True
+        if not v.snippet:
+            v.snippet = mod.snippet(v.line)
+    apply_waivers(violations, mod.lines, comments)
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations
 

@@ -1,0 +1,36 @@
+"""tpurace — concurrency checking for the serving path, two prongs.
+
+Static (:mod:`~geomesa_tpu.analysis.race.lockset`): an inter-procedural
+lockset analysis over the whole package. It infers the guard map (which
+lock protects which fields, from majority-guarded writes) and flags
+
+- R001 — a guarded field written outside its inferred guard lock,
+- R002 — lock-order inversions (cycles in the static lock acquisition
+  graph, built across call chains and modules),
+- R003 — blocking calls (file/socket I/O, ``jax`` dispatch,
+  ``time.sleep``) made while holding a hot-path lock.
+
+Dynamic (:mod:`~geomesa_tpu.analysis.race.sanitizer`): with
+``GEOMESA_TPU_SANITIZE=1`` the test harness monkey-patches
+``threading.Lock``/``RLock`` creation to record per-thread lock stacks
+into a global lock-order graph — an Eraser-style detector that fails the
+run when real execution acquires locks in cycle-forming orders, even if
+no deadlock happened on this schedule.
+
+Both prongs share tpulint's rule registry, waiver syntax
+(``# tpurace: disable=R001``), baseline file, and the
+``python -m geomesa_tpu.analysis --race`` CLI; like the rest of the
+analysis package they import neither JAX nor any sibling geomesa_tpu
+subsystem. See docs/concurrency.md.
+"""
+
+from geomesa_tpu.analysis.race.lockset import (
+    RACE_RULE_IDS,
+    analyze_modules,
+    analyze_race_paths,
+    guard_map,
+)
+
+__all__ = [
+    "RACE_RULE_IDS", "analyze_modules", "analyze_race_paths", "guard_map",
+]

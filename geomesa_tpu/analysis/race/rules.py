@@ -1,0 +1,56 @@
+"""Registry descriptors for the tpurace rules.
+
+R001-R003 are WHOLE-PROGRAM rules (``project = True``): they reason
+across modules, so their findings come from
+:func:`geomesa_tpu.analysis.race.lockset.analyze_race_paths` (the
+``--race`` CLI mode), not from the per-module ``check`` pass — the
+``check`` here is a no-op so the ids still resolve for ``--list-rules``,
+``--rules`` filtering, waivers, baselines, and SARIF rule metadata.
+
+W001 (stale waivers) is likewise emitted by shared machinery
+(:func:`geomesa_tpu.analysis.core.stale_waiver_violations`) in BOTH
+passes, each judging only the rules that ran in it.
+"""
+
+from __future__ import annotations
+
+from geomesa_tpu.analysis.rules import register
+
+
+@register
+class GuardedFieldBareWrite:
+    id = "R001"
+    title = "field written outside its majority-inferred guard lock"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class LockOrderInversion:
+    id = "R002"
+    title = "lock-order cycle in the static acquisition graph"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class BlockingUnderHotLock:
+    id = "R003"
+    title = "blocking call (I/O, jax dispatch, sleep) under a hot-path lock"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class StaleWaiver:
+    id = "W001"
+    title = "waiver comment that suppresses nothing"
+
+    def check(self, mod, config):
+        return ()  # emitted by core.stale_waiver_violations in each pass

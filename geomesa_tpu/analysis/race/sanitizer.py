@@ -1,0 +1,322 @@
+"""tpurace dynamic prong: an Eraser-style runtime lock-order sanitizer.
+
+With ``GEOMESA_TPU_SANITIZE=1`` (see tests/conftest.py) :func:`install`
+monkey-patches ``threading.Lock`` and ``threading.RLock`` so every lock
+CREATED BY REPO CODE is wrapped in a recorder. Each acquisition appends
+to a per-thread stack and, for every lock already held, inserts an edge
+``held-site → acquired-site`` into one global lock-order graph. An edge
+that closes a cycle is recorded as a violation — the happened-in-wrong-
+order signal: the schedule that actually deadlocks never needs to run,
+two runs (or two threads) acquiring in opposite orders is enough.
+
+Design constraints, in order:
+
+- **Zero behavior change.** Wrappers delegate ``acquire``/``release``
+  to a real ``_thread`` lock; bookkeeping happens only AFTER a
+  successful acquire and never raises into application code. Cycles are
+  collected, not thrown — the pytest session fixture (and
+  ``scripts/lint.sh``) fails the run afterwards.
+- **Bounded overhead.** Lock identity is the CREATION SITE
+  (``file:line``), not the instance — the graph is as small as the
+  code, and a hit on an existing edge is one dict lookup. Stacks are
+  captured only when a NEW edge first appears.
+- **Scope: the repo's locks.** The factory inspects its caller and
+  returns an unwrapped lock for foreign frames (jax, stdlib — including
+  ``threading.py`` itself, so ``Event``/``Condition`` internals keep
+  their native primitives and their ``_release_save`` dance never
+  desyncs our per-thread stacks).
+
+Reentrant acquisition of the SAME lock object records nothing (RLock
+semantics). Site-keyed identity also means nesting two DIFFERENT
+instances of one lock role (same creation site) records no edge:
+instance-order hazards within a single role are out of scope here —
+catching them would need per-instance identity and an address-order
+convention, at per-instance graph cost. The static prong's R002 has the
+same granularity (one node per ``Class.attr``), so the two prongs agree
+on what a "lock" is.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+__all__ = [
+    "install", "uninstall", "installed", "enabled_by_env",
+    "cycle_report", "edges", "reset", "snapshot", "restore",
+    "LockOrderError", "check",
+]
+
+_REPO_MARKERS = ("geomesa_tpu", "tests")
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+# sanitizer-internal state guarded by a REAL (unwrapped) lock
+_state_lock = _real_lock()
+_graph: dict[str, dict[str, dict]] = {}   # site A -> site B -> edge info
+_cycles: list[dict] = []
+_installed = False
+_tls = threading.local()
+
+
+class LockOrderError(AssertionError):
+    """Raised by :func:`check` when the run recorded lock-order cycles."""
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("GEOMESA_TPU_SANITIZE", "") not in ("", "0")
+
+
+def _caller_site(depth: int = 2) -> str | None:
+    """``file:line`` of the frame ``depth`` levels up, or None for frames
+    outside the repo (foreign locks stay unwrapped)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:  # pragma: no cover — interpreter startup edges
+        return None
+    fn = frame.f_code.co_filename.replace(os.sep, "/")
+    parts = fn.split("/")
+    for marker in _REPO_MARKERS:
+        if marker in parts:
+            short = "/".join(parts[parts.index(marker):])
+            return f"{short}:{frame.f_lineno}"
+    return None
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _record_acquire(lock_id: int, site: str) -> None:
+    stack = _held_stack()
+    for oid, _ in stack:
+        if oid == lock_id:  # RLock re-entry: not an ordering event
+            stack.append((lock_id, None))
+            return
+    new_edges = [
+        (held_site, site) for _, held_site in stack
+        if held_site is not None and held_site != site
+        and site not in _graph.get(held_site, ())
+    ]
+    if new_edges:
+        # capture context once per new edge, then check for cycles
+        where = "".join(traceback.format_stack(sys._getframe(2), limit=4))
+        with _state_lock:
+            for a, b in new_edges:
+                dst = _graph.setdefault(a, {})
+                if b in dst:
+                    continue
+                dst[b] = {
+                    "thread": threading.current_thread().name,
+                    "stack": where,
+                }
+                cyc = _find_cycle(b, a)
+                if cyc is not None:
+                    _cycles.append({
+                        "edge": (a, b),
+                        "cycle": [a, b] + cyc[1:],
+                        "thread": threading.current_thread().name,
+                        "stack": where,
+                    })
+    stack.append((lock_id, site))
+
+
+def _record_release(lock_id: int) -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == lock_id:
+            del stack[i]
+            return
+
+
+def _record_release_all(lock_id: int) -> None:
+    """Drop EVERY stack entry for a lock — the Condition._release_save
+    path strips all RLock recursion levels at once."""
+    stack = _held_stack()
+    stack[:] = [e for e in stack if e[0] != lock_id]
+
+
+def _find_cycle(src: str, dst: str) -> list[str] | None:
+    """Path src → dst in the graph (call with _state_lock held); with the
+    new edge dst→src already inserted this closes a cycle."""
+    seen = {src}
+    work = [(src, [src])]
+    while work:
+        node, path = work.pop()
+        for nxt in _graph.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                work.append((nxt, path + [nxt]))
+    return None
+
+
+class _SanitizedLock:
+    """Recorder wrapping a real lock. Delegation is explicit (no
+    ``__getattr__`` magic on the hot path)."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _record_acquire(id(self), self._site)
+            except Exception:  # noqa: BLE001 — never break the app's locking
+                pass
+        return ok
+
+    def release(self):
+        self._inner.release()
+        try:
+            _record_release(id(self))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):  # pragma: no cover — debug aid
+        return f"<SanitizedLock {self._site} wrapping {self._inner!r}>"
+
+
+class _SanitizedRLock(_SanitizedLock):
+    __slots__ = ()
+
+    # acquire/release/__enter__/__exit__ inherit from _SanitizedLock
+    # (re-entry is handled generically in _record_acquire/_record_release).
+
+    # Condition() interop: delegate the RLock internals it probes for.
+    # _release_save/_acquire_restore bracket a Condition.wait — the held
+    # stack must drop the lock across the wait (all recursion levels at
+    # once) and RE-RECORD it on wake, or every post-wait nested
+    # acquisition would be an invisible (or phantom) ordering edge.
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _acquire_restore(self, state):
+        out = self._inner._acquire_restore(state)
+        try:
+            _record_acquire(id(self), self._site)
+        except Exception:  # noqa: BLE001 — never break the app's locking
+            pass
+        return out
+
+    def _release_save(self):
+        try:
+            _record_release_all(id(self))
+        except Exception:  # noqa: BLE001
+            pass
+        return self._inner._release_save()
+
+    def locked(self):  # RLock in 3.12+; probe defensively
+        probe = getattr(self._inner, "locked", None)
+        return probe() if probe is not None else False
+
+
+def _lock_factory():
+    site = _caller_site(depth=2)
+    inner = _real_lock()
+    if site is None:
+        return inner
+    return _SanitizedLock(inner, site)
+
+
+def _rlock_factory():
+    site = _caller_site(depth=2)
+    inner = _real_rlock()
+    if site is None:
+        return inner
+    return _SanitizedRLock(inner, site)
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock``. Idempotent. Only locks created
+    AFTER this call are tracked — the pytest plugin installs before any
+    geomesa_tpu module is imported, so the serving path's locks all land
+    in the graph."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def edges() -> dict[str, list[str]]:
+    """The observed lock-order graph (site → successor sites)."""
+    with _state_lock:
+        return {a: sorted(bs) for a, bs in _graph.items()}
+
+
+def cycle_report() -> list[dict]:
+    """All lock-order cycles observed so far (empty = clean run)."""
+    with _state_lock:
+        return list(_cycles)
+
+
+def reset() -> None:
+    """Drop the graph and cycle list (test isolation)."""
+    with _state_lock:
+        _graph.clear()
+        _cycles.clear()
+
+
+def snapshot() -> tuple:
+    """Copy of the current graph + cycle list — tests that DELIBERATELY
+    create cycles save this first and :func:`restore` it after, so they
+    never mask (or fabricate) findings for the session-end gate."""
+    with _state_lock:
+        return ({a: dict(bs) for a, bs in _graph.items()}, list(_cycles))
+
+
+def restore(snap: tuple) -> None:
+    graph, cycles = snap
+    with _state_lock:
+        _graph.clear()
+        _graph.update({a: dict(bs) for a, bs in graph.items()})
+        _cycles[:] = cycles
+
+
+def check() -> None:
+    """Raise :class:`LockOrderError` if any cycle was recorded — the
+    fail-the-run hook for fixtures and scripts."""
+    report = cycle_report()
+    if not report:
+        return
+    lines = [f"{len(report)} lock-order cycle(s) detected:"]
+    for c in report:
+        lines.append("  cycle: " + " -> ".join(c["cycle"]))
+        lines.append(f"  closing thread: {c['thread']}")
+        lines.append("  at:\n" + c["stack"])
+    raise LockOrderError("\n".join(lines))

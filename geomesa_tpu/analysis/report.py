@@ -1,10 +1,20 @@
-"""tpulint output: human text and a SARIF-ish JSON report."""
+"""tpulint output: human text and a SARIF 2.1.0 report.
+
+The SARIF document is the real schema (version 2.1.0, one ``run`` with
+``tool.driver`` rule metadata, ``results`` with ``physicalLocation``
+regions, in-source ``suppressions``) so CI can ingest it directly —
+GitHub code-scanning upload, ``sarif-tools``, IDE SARIF viewers. The
+run-level roll-up lives in ``runs[0].properties.summary`` (SARIF's
+sanctioned extension point)."""
 
 from __future__ import annotations
 
 import json
 
 from geomesa_tpu.analysis.core import Violation
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
 
 
 def summarize(violations: list[Violation]) -> dict:
@@ -41,38 +51,72 @@ def render_text(violations: list[Violation], verbose: bool = False) -> str:
     return "\n".join(out)
 
 
+def _sarif_result(v: Violation, rule_index: dict[str, int]) -> dict:
+    region = {"startLine": v.line}
+    if v.col:
+        region["startColumn"] = v.col + 1  # SARIF columns are 1-based
+    if v.snippet:
+        region["snippet"] = {"text": v.snippet}
+    result = {
+        "ruleId": v.rule,
+        "level": "note" if v.suppressed else "error",
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": v.path.replace("\\", "/"),
+                    "uriBaseId": "SRCROOT",
+                },
+                "region": region,
+            },
+        }],
+    }
+    if v.rule in rule_index:
+        result["ruleIndex"] = rule_index[v.rule]
+    if v.suppressed:
+        # SARIF semantics: a result with a non-empty suppressions array is
+        # suppressed; "inSource" = waiver comment, "external" = baseline
+        result["suppressions"] = [{
+            "kind": "inSource" if v.waived else "external",
+            "justification": (
+                "per-line tpulint/tpurace waiver" if v.waived
+                else "tracked legacy violation in .tpulint-baseline.json"
+            ),
+        }]
+    return result
+
+
 def render_json(violations: list[Violation]) -> str:
-    """SARIF-shaped: one run, one result per violation, pass/fail in
-    ``summary`` — enough structure for CI annotation tooling without the
-    full SARIF schema weight."""
+    """The SARIF 2.1.0 document (``--format json``/``--format sarif``)."""
     from geomesa_tpu.analysis.rules import all_rules
 
     rules = all_rules()
+    rule_ids = sorted(rules)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
     doc = {
-        "$schema": "tpulint-report",
-        "version": "1.0",
-        "tool": {
-            "name": "tpulint",
-            "rules": [
-                {"id": rid, "shortDescription": rules[rid].title}
-                for rid in sorted(rules)
-            ],
-        },
-        "results": [
-            {
-                "ruleId": v.rule,
-                "level": "note" if v.suppressed else "error",
-                "message": v.message,
-                "location": {"path": v.path, "line": v.line, "col": v.col},
-                "snippet": v.snippet,
-                "suppressed": v.suppressed,
-                "suppression": (
-                    "waiver" if v.waived
-                    else "baseline" if v.baselined else None
-                ),
-            }
-            for v in violations
-        ],
-        "summary": summarize(violations),
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tpulint",
+                    "informationUri":
+                        "https://example.invalid/geomesa_tpu/docs/tpulint.md",
+                    "rules": [
+                        {
+                            "id": rid,
+                            "shortDescription": {"text": rules[rid].title},
+                            "defaultConfiguration": {"level": "error"},
+                        }
+                        for rid in rule_ids
+                    ],
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"description": {"text": "repository root"}},
+            },
+            "results": [_sarif_result(v, rule_index) for v in violations],
+            "properties": {"summary": summarize(violations)},
+        }],
     }
     return json.dumps(doc, indent=1)

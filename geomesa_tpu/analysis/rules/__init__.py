@@ -26,6 +26,7 @@ RULES: dict[str, object] = {}
 _RULE_MODULES = (
     "geomesa_tpu.analysis.rules.jax_rules",
     "geomesa_tpu.analysis.rules.concurrency",
+    "geomesa_tpu.analysis.race.rules",
 )
 
 

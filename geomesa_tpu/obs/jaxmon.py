@@ -75,6 +75,9 @@ def install() -> bool:
     with _reg_lock:
         if _installed:
             return True
+        # one-time listener registration, not a dispatch: the lock exists
+        # precisely to make this registration idempotent under races
+        # tpurace: disable-next-line=R003
         jm.register_event_duration_secs_listener(_on_duration)
         _installed = True
     return True

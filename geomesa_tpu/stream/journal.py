@@ -249,6 +249,11 @@ class JournalBus:
             if committed <= pos:
                 return
             try:
+                # the bus lock IS this read's serialization point: scan
+                # position and the indexes it feeds must advance atomically
+                # with the bytes parsed, and the read is bounded by the
+                # committed offset (page-cache-hot in the steady state)
+                # tpurace: disable-next-line=R003
                 with open(self._log_path(topic), "rb") as f:
                     f.seek(pos)
                     buf = f.read(committed - pos)
@@ -331,39 +336,71 @@ class JournalBus:
         neither double-deliver the backlog nor slip a record between
         replay and registration. Already-dispatched records the tailer
         trimmed from memory replay from the journal FILE.
+
+        Stop/restart is a guarded state transition shared with
+        :meth:`close`: a tailer is bound for life to the stop event
+        current at its creation, the event is only ever swapped for a
+        fresh one when ``self._tailer is None`` (which in turn is only
+        set after the old thread is CONFIRMED dead), and a subscribe that
+        lands mid-close first joins the draining tailer outside the lock.
+        Without the full transition, a subscribe racing close could
+        register against a dying tailer (push delivery silently never
+        resumes) or leave a stale tailer running against the old event
+        next to a fresh one.
         """
         self.create_topic(topic)
-        with self._lock:
-            self._refresh(topic)
-            total = self._tcount[topic]
-            first = topic not in self._sub_offsets
-            # the tailer owns [cursor:] for ALL subscribers (including this
-            # one); the new callback catches up on [0:cursor] here — from
-            # disk for any part no longer buffered in memory. The FIRST
-            # subscriber catches up on the whole history (records parsed
-            # before any subscriber existed were never buffered).
-            cursor = total if first else self._sub_offsets[topic]
-            tbase = self._tbase[topic]
-            if cursor > 0:
-                if tbase > 0:
-                    backlog = self._disk_payloads(topic, cursor)
-                else:
-                    backlog = self._tlogs[topic][:cursor]
-                for data in backlog:
-                    callback(data)
-            if first:
-                self._sub_offsets[topic] = total
-                del self._tlogs[topic][: max(total - tbase, 0)]
-                self._tbase[topic] = total
-            self._subscribers.setdefault(topic, []).append(callback)
-            if self._tailer is None:
-                if self._stop.is_set():
-                    self._stop = threading.Event()  # bus reused after close
-                self._tailer = threading.Thread(
-                    target=self._tail_loop, daemon=True,
-                    name="geomesa-journal-tailer",
-                )
-                self._tailer.start()
+        while True:
+            with self._lock:
+                # close() in flight: _stop is set but its tailer has not
+                # been confirmed dead yet — restart only after it is
+                stale = self._tailer if self._stop.is_set() else None
+                if stale is None or stale is threading.current_thread():
+                    # the second arm: a callback ON the dying tailer
+                    # re-subscribing mid-close cannot join itself —
+                    # register now; the tailer restart happens on the
+                    # next subscribe after close() completes (the normal
+                    # bus-reuse path picks this callback up with it)
+                    self._subscribe_locked(topic, callback)
+                    return
+            stale.join(timeout=5.0)
+            with self._lock:
+                if self._tailer is stale and not stale.is_alive():
+                    self._tailer = None
+
+    def _subscribe_locked(self, topic: str,
+                          callback: Callable[[bytes], None]) -> None:
+        """Replay + register + (re)start the tailer; caller holds the bus
+        lock and has established that no stopping tailer remains."""
+        self._refresh(topic)
+        total = self._tcount[topic]
+        first = topic not in self._sub_offsets
+        # the tailer owns [cursor:] for ALL subscribers (including this
+        # one); the new callback catches up on [0:cursor] here — from
+        # disk for any part no longer buffered in memory. The FIRST
+        # subscriber catches up on the whole history (records parsed
+        # before any subscriber existed were never buffered).
+        cursor = total if first else self._sub_offsets[topic]
+        tbase = self._tbase[topic]
+        if cursor > 0:
+            if tbase > 0:
+                backlog = self._disk_payloads(topic, cursor)
+            else:
+                backlog = self._tlogs[topic][:cursor]
+            for data in backlog:
+                callback(data)
+        if first:
+            self._sub_offsets[topic] = total
+            del self._tlogs[topic][: max(total - tbase, 0)]
+            self._tbase[topic] = total
+        self._subscribers.setdefault(topic, []).append(callback)
+        if self._tailer is None:
+            if self._stop.is_set():
+                self._stop = threading.Event()  # bus reused after close
+            self._tailer = threading.Thread(
+                target=self._tail_loop, daemon=True,
+                name="geomesa-journal-tailer",
+            )
+            self._tailer.start()
 
     def _disk_payloads(self, topic: str, first_n: int) -> list[bytes]:
         """First ``first_n`` payloads re-read from the committed journal
@@ -468,6 +505,8 @@ class JournalBus:
                 stop.wait(self.poll_interval_s)
 
     def close(self) -> None:
+        """Stop the tailer (idempotent; deterministic join). See
+        :meth:`subscribe` for the stop/restart state transition."""
         # snapshot under the lock (subscribe swaps _stop/_tailer under it);
         # join OUTSIDE it — the tailer takes the lock per topic and joining
         # while holding it would deadlock
@@ -477,5 +516,8 @@ class JournalBus:
         if tailer is not None:
             tailer.join(timeout=5.0)
             with self._lock:
-                if self._tailer is tailer:
+                # only a CONFIRMED-dead tailer clears the slot: a wedged
+                # thread must keep blocking restarts (subscribe joins it)
+                # rather than end up running beside a fresh tailer
+                if self._tailer is tailer and not tailer.is_alive():
                     self._tailer = None

@@ -55,6 +55,7 @@ class LambdaDataStore:
         # deletes not yet drained by the consumers: excluded from queries and
         # from persistence so an in-flight persist can't resurrect them
         self._tombstones: dict[str, set] = {}
+        self._closed = False
         self._thread = None
         if persist_interval_s is not None:
             self._thread = threading.Thread(
@@ -230,6 +231,13 @@ class LambdaDataStore:
         return self.stream.cache(type_name).size()
 
     def close(self) -> None:
+        """Deterministic shutdown: the persister thread is JOINED (not
+        abandoned to daemon teardown), then the streaming tier's
+        consumers and bus stop the same way. Idempotent — double-close
+        is a no-op (tests/test_race_stress.py pins both properties)."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

@@ -14,7 +14,22 @@ GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis \
     geomesa_tpu/ scripts/ bench.py __graft_entry__.py \
     --baseline .tpulint-baseline.json "$@"
 
+# tpurace static prong: whole-program lockset / lock-order / blocking-call
+# analysis (R001-R003) over the package, against the same baseline. Zero
+# unwaived violations is the bar — see docs/concurrency.md.
+GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis --race \
+    geomesa_tpu/ --baseline .tpulint-baseline.json
+
 # tracing-overhead smoke gate (the dynamic half): the obs subsystem's span
 # propagation, exporter, and disabled-path overhead bound must hold before
 # any instrumented hot path ships. Runs on the 8-device virtual CPU mesh.
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+
+# tpurace dynamic prong: the Eraser-style lock-order sanitizer wraps every
+# repo lock (tests/conftest.py) while the threaded tier-1 subset drives
+# REAL lock traffic — journal tailer + consumer groups + lambda persister +
+# concurrent store write/query. The session-end gate fails the run unless
+# the observed lock-order graph is cycle-free.
+GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
+    tests/test_concurrency.py tests/test_locks.py -q

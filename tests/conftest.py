@@ -22,6 +22,17 @@ import jax
 # override it back before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 
+# tpurace dynamic prong: GEOMESA_TPU_SANITIZE=1 wraps every lock the repo
+# creates in an Eraser-style lock-order recorder (see
+# geomesa_tpu/analysis/race/sanitizer.py). Install BEFORE any geomesa_tpu
+# submodule import so module-level and instance locks all land in the
+# graph (geomesa_tpu/__init__ itself is lazy and creates none).
+_sanitizer = None
+if os.environ.get("GEOMESA_TPU_SANITIZE", "") not in ("", "0"):
+    from geomesa_tpu.analysis.race import sanitizer as _sanitizer
+
+    _sanitizer.install()
+
 import numpy as np
 import pytest
 
@@ -29,3 +40,14 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_gate():
+    """Under GEOMESA_TPU_SANITIZE=1, fail the run if real execution ever
+    acquired repo locks in cycle-forming orders (the schedule that
+    actually deadlocks never needs to happen — opposite orders on any
+    two threads are enough to flag)."""
+    yield
+    if _sanitizer is not None:
+        _sanitizer.check()

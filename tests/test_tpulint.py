@@ -52,13 +52,15 @@ class TestRuleFixtures:
          [("J004", 9), ("J004", 13), ("J004", 16), ("J004", 21)]),
         ("c001_bad.py",
          [("C001", 17), ("C001", 24), ("C001", 40)]),
+        ("w001_bad.py",
+         [("W001", 6)]),
     ])
     def test_bad_fixture_flagged(self, name, expected):
         assert _lint(name) == expected
 
     @pytest.mark.parametrize("name", [
         "j001_good.py", "j002_good.py", "j003_good.py", "j004_good.py",
-        "c001_good.py",
+        "c001_good.py", "w001_good.py",
     ])
     def test_good_fixture_clean(self, name):
         assert _lint(name) == []
@@ -101,7 +103,20 @@ class TestWaivers:
                "g = jax.jit(lambda x: x, static_argnums=[0])"
                "  # tpulint: disable=C001\n")
         vs = lint_source(src, "w.py", FIXTURE_CFG)
-        assert [v.waived for v in vs] == [False]
+        # the J003 is NOT waived by a C001-scoped comment — and since the
+        # C001 waiver suppresses nothing, it is itself flagged stale
+        assert {(v.rule, v.waived) for v in vs} == {
+            ("J003", False), ("W001", False)}
+
+    def test_docstring_waiver_syntax_is_inert(self):
+        """Waiver syntax QUOTED in a string/docstring is neither a live
+        waiver nor a stale one (core.py documents the syntax in its own
+        module docstring)."""
+        src = ('DOC = """use # tpulint: disable=J003 to waive"""\n'
+               "import jax\n"
+               "g = jax.jit(lambda x: x, static_argnums=[0])\n")
+        vs = lint_source(src, "w.py", FIXTURE_CFG)
+        assert [(v.rule, v.waived) for v in vs] == [("J003", False)]
 
     def test_syntax_error_reported_not_raised(self):
         vs = lint_source("def broken(:\n", "b.py", FIXTURE_CFG)
@@ -171,13 +186,57 @@ class TestCli:
         assert out.returncode == 1
         assert "J003" in out.stdout
 
-    def test_json_report_shape(self):
+    def test_sarif_report_shape(self):
         out = self._run(os.path.join(FIXTURES, "j003_bad.py"),
-                        "--format", "json")
+                        "--format", "sarif")
         doc = json.loads(out.stdout)
-        assert doc["tool"]["name"] == "tpulint"
-        assert {r["ruleId"] for r in doc["results"]} == {"J003"}
-        assert doc["summary"]["new"] == len(doc["results"])
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-2.1.0.json")
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "tpulint"
+        results = run["results"]
+        assert {r["ruleId"] for r in results} == {"J003"}
+        assert run["properties"]["summary"]["new"] == len(results)
+        for r in results:
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+            assert "suppressions" not in r  # new violations: unsuppressed
+        # the driver's rule metadata indexes every registered rule
+        ids = [x["id"] for x in run["tool"]["driver"]["rules"]]
+        assert ids == sorted(ids)
+        for r in results:
+            assert ids[r["ruleIndex"]] == r["ruleId"]
+
+    def test_sarif_marks_suppressions(self):
+        """A waived violation surfaces as a SARIF in-source suppression,
+        not as a dropped result."""
+        from geomesa_tpu.analysis.report import render_json
+        from geomesa_tpu.analysis import lint_source
+
+        src = ("import jax\n"
+               "g = jax.jit(lambda x: x, static_argnums=[0])"
+               "  # tpulint: disable=J003\n")
+        doc = json.loads(render_json(lint_source(src, "w.py", FIXTURE_CFG)))
+        (res,) = doc["runs"][0]["results"]
+        assert res["level"] == "note"
+        assert res["suppressions"][0]["kind"] == "inSource"
+
+    def test_sarif_golden_file(self):
+        """Golden-file pin of the full SARIF document shape for a known
+        fixture (regenerate with tests/tpulint_fixtures/make_sarif_golden.py
+        when the rule registry or report layout changes ON PURPOSE)."""
+        from geomesa_tpu.analysis.report import render_json
+        from geomesa_tpu.analysis import lint_source
+
+        rel = "tests/tpulint_fixtures/j003_bad.py"
+        with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+            src = f.read()
+        doc = json.loads(render_json(lint_source(src, rel, FIXTURE_CFG)))
+        with open(os.path.join(FIXTURES, "sarif_golden.json"),
+                  encoding="utf-8") as f:
+            golden = json.load(f)
+        assert doc == golden
 
     def test_list_rules(self):
         out = self._run("--list-rules")

@@ -1,0 +1,318 @@
+"""tpurace: fixture tests pin exact (rule, line) findings per rule, the
+gate test runs the whole-program analysis over the package against the
+committed baseline, and the sanitizer unit tests drive REAL threads
+through deliberate lock orders.
+
+Like tpulint, the static prong is pure AST (fixtures under
+``tpurace_fixtures/`` are never imported) and runs with JAX gated off.
+The sanitizer tests snapshot/restore the global lock-order graph so a
+deliberately-created cycle can never leak into (or mask findings of)
+the session-end gate that ``GEOMESA_TPU_SANITIZE=1`` arms.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from geomesa_tpu.analysis import (
+    LintConfig,
+    apply_baseline,
+    load_baseline,
+)
+from geomesa_tpu.analysis.race import (
+    RACE_RULE_IDS,
+    analyze_race_paths,
+    guard_map,
+)
+from geomesa_tpu.analysis.race import sanitizer
+from geomesa_tpu.analysis.core import iter_py_files, parse_module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "geomesa_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpurace_fixtures")
+BASELINE = os.path.join(REPO, ".tpulint-baseline.json")
+# fixtures live outside the package tree: open the path-scoped knobs up
+RACE_CFG = LintConfig(race_paths=("",), r003_paths=("",))
+
+
+def _race(name):
+    vs = analyze_race_paths([os.path.join(FIXTURES, name)], RACE_CFG)
+    return [(v.rule, v.line) for v in vs if not v.suppressed]
+
+
+def _modules(paths):
+    out = []
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            mod = parse_module(f.read(), fp)
+        if hasattr(mod, "tree"):  # skip E000 Violations
+            out.append(mod)
+    return out
+
+
+class TestRuleFixtures:
+    """Each rule flags its known-bad fixture at exact lines and stays
+    silent on the known-good twin."""
+
+    @pytest.mark.parametrize("name,expected", [
+        # bare dict.pop + bare counter bump + typed cross-class assignment
+        ("r001_bad.py", [("R001", 29), ("R001", 32), ("R001", 40)]),
+        # cycle closed through the call graph; anchored at the BA nesting
+        ("r002_bad.py", [("R002", 23)]),
+        # sleep + open inside the critical section
+        ("r003_bad.py", [("R003", 15), ("R003", 16)]),
+        # two stale waivers (same-line and next-line forms)
+        ("w001_bad.py", [("W001", 15), ("W001", 19)]),
+    ])
+    def test_bad_fixture_flagged(self, name, expected):
+        assert _race(name) == expected
+
+    @pytest.mark.parametrize("name", [
+        "r001_good.py", "r002_good.py", "r003_good.py", "w001_good.py",
+    ])
+    def test_good_fixture_clean(self, name):
+        assert _race(name) == []
+
+    def test_live_waiver_suppresses_r001(self):
+        vs = analyze_race_paths(
+            [os.path.join(FIXTURES, "w001_good.py")], RACE_CFG)
+        assert [(v.rule, v.waived) for v in vs] == [("R001", True)]
+
+
+class TestGuardMap:
+    def test_fixture_inference(self):
+        gm = guard_map(_modules([os.path.join(FIXTURES, "r001_bad.py")]),
+                       RACE_CFG)
+        items = gm["Registry._items"]
+        # put/replace/_rebuild_locked guarded; evict + Admin.wipe bare
+        assert items["guard"] == "Registry._lock"
+        assert (items["guarded_writes"], items["total_writes"]) == (3, 5)
+        assert gm["Registry._epoch"]["guard"] == "Registry._lock"
+
+    def test_duplicate_class_names_stay_analyzed(self):
+        """The repo has namesake classes (utils/metrics.Histogram vs
+        stats/sketches.Histogram). Bare-name TYPING is unresolvable for
+        them, but the classes themselves must stay in the pass under
+        module-qualified ids — dropping one would silently exempt its
+        locks and writes from R001-R003."""
+        gm = guard_map(_modules([PKG]), LintConfig())
+        qualified = [k for k in gm if k.startswith("utils.metrics.Histogram.")]
+        assert qualified, sorted(gm)
+        assert (gm["utils.metrics.Histogram._reservoir"]["guard"]
+                == "utils.metrics.Histogram._lock")
+
+    def test_package_guard_map_pins_known_guards(self):
+        """The inferred guard map on the REAL tree must keep resolving the
+        repo idioms: the journal's reader-index state behind the bus lock,
+        and _TypeState's snapshot-swap fields behind st.lock even though
+        the writes happen in DataStore methods via a typed local."""
+        gm = guard_map(_modules([PKG]), LintConfig())
+        assert gm["JournalBus._tailer"]["guard"] == "JournalBus._lock"
+        assert gm["_TypeState.table"]["guard"] == "_TypeState.lock"
+        assert gm["_TypeState.indices"]["guard"] == "_TypeState.lock"
+        assert gm["MessageBus._plogs"]["guard"] == "MessageBus._lock"
+        for info in gm.values():
+            assert 2 * info["guarded_writes"] > info["total_writes"]
+
+
+class TestPackageRaceGate:
+    """THE gate: zero unwaived R001/R002/R003 on the committed tree."""
+
+    def test_package_clean_against_baseline(self):
+        vs = analyze_race_paths([PKG], LintConfig())
+        apply_baseline(vs, load_baseline(BASELINE))
+        new = [v for v in vs if not v.suppressed]
+        assert new == [], "\n".join(
+            f"{v.path}:{v.line}: {v.rule} {v.message}" for v in new)
+
+    def test_known_waivers_are_live(self):
+        """The two committed R003 waivers (journal read under the bus
+        lock, jaxmon one-time listener registration) must keep
+        suppressing real findings — if they go stale, W001 fires here."""
+        vs = analyze_race_paths([PKG], LintConfig())
+        waived = {(os.path.basename(v.path), v.rule)
+                  for v in vs if v.waived}
+        assert ("journal.py", "R003") in waived
+        assert ("jaxmon.py", "R003") in waived
+
+
+class TestCliRace:
+    def _run(self, *args):
+        env = dict(os.environ, GEOMESA_TPU_NO_JAX="1")
+        return subprocess.run(
+            [sys.executable, "-m", "geomesa_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+
+    def test_race_gate_exits_zero(self):
+        out = self._run("--race", PKG, "--baseline", BASELINE)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_race_violations_exit_nonzero(self):
+        out = self._run("--race", os.path.join(FIXTURES, "r002_bad.py"))
+        # fixture lives outside the default r003/race scopes, but R002
+        # is path-unscoped: the CLI must still fail on it
+        assert out.returncode == 1
+        assert "R002" in out.stdout
+
+    def test_guards_json(self):
+        out = self._run("--race", "--guards", PKG)
+        assert out.returncode == 0, out.stdout + out.stderr
+        gm = json.loads(out.stdout)
+        assert gm["JournalBus._tailer"]["guard"] == "JournalBus._lock"
+
+    def test_list_rules_includes_race(self):
+        out = self._run("--list-rules")
+        for rid in (*RACE_RULE_IDS, "W001"):
+            assert rid in out.stdout
+
+    def test_rules_filter_applies_in_race_mode(self):
+        # r001_bad has R001 findings only: masking them with --rules R003
+        # must exit clean, selecting R001 must still fail
+        bad = os.path.join(FIXTURES, "r001_bad.py")
+        assert self._run("--race", bad, "--rules", "R003").returncode == 0
+        out = self._run("--race", bad, "--rules", "R001")
+        assert out.returncode == 1 and "R001" in out.stdout
+
+    def test_unknown_rule_id_is_a_usage_error(self):
+        out = self._run("--race", PKG, "--rules", "R999")
+        assert out.returncode == 2
+
+    def test_mode_mismatched_rules_are_a_usage_error(self):
+        """--rules that selects nothing in the chosen mode must not exit
+        0 (a misconfigured CI gate would read as clean forever)."""
+        bad = os.path.join(FIXTURES, "r003_bad.py")
+        out = self._run(bad, "--rules", "R003")  # race rule, no --race
+        assert out.returncode == 2, out.stdout + out.stderr
+        out = self._run("--race", bad, "--rules", "J001")  # lint-only set
+        assert out.returncode == 2, out.stdout + out.stderr
+        out = self._run(bad, "--rules", "W001")  # judges nothing alone
+        assert out.returncode == 2, out.stdout + out.stderr
+
+
+class _SanitizerHarness:
+    """Install (if the env gate didn't already), isolate global state."""
+
+    def __enter__(self):
+        self._was_installed = sanitizer.installed()
+        self._snap = sanitizer.snapshot()
+        if not self._was_installed:
+            sanitizer.install()
+        sanitizer.reset()
+        return sanitizer
+
+    def __exit__(self, *exc):
+        sanitizer.restore(self._snap)
+        if not self._was_installed:
+            sanitizer.uninstall()
+        return False
+
+
+class TestSanitizer:
+    def test_consistent_order_is_clean(self):
+        with _SanitizerHarness() as san:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def work():
+                for _ in range(50):
+                    with a:
+                        with b:
+                            pass
+
+            threads = [threading.Thread(target=work) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert san.cycle_report() == []
+            assert san.edges()  # the A->B edge was recorded
+
+    def test_opposite_orders_cycle_without_deadlocking(self):
+        """The Eraser property: the two orders run at DIFFERENT times, no
+        deadlock ever happens on this schedule — the sanitizer still
+        convicts the order inversion."""
+        with _SanitizerHarness() as san:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+
+            def reversed_order():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=reversed_order)
+            t.start()
+            t.join()
+            report = san.cycle_report()
+            assert len(report) == 1
+            assert len(report[0]["cycle"]) == 3  # A -> B -> A
+            with pytest.raises(sanitizer.LockOrderError):
+                san.check()
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        with _SanitizerHarness() as san:
+            r = threading.RLock()
+
+            def work():
+                with r:
+                    with r:  # re-entry: no self-edge, no cycle
+                        pass
+
+            work()
+            assert san.cycle_report() == []
+
+    def test_wrapping_scope_is_repo_only(self):
+        with _SanitizerHarness():
+            here = threading.Lock()  # created from tests/: wrapped
+            assert type(here).__name__ == "_SanitizedLock"
+            # an Event's internal Condition lock is created inside
+            # threading.py: must stay a native primitive
+            ev = threading.Event()
+            assert "Sanitized" not in type(ev._cond._lock).__name__
+
+    def test_condition_wait_rerecords_held_lock(self):
+        """Condition(our RLock) interop: _release_save drops the lock
+        across wait() and _acquire_restore RE-RECORDS it — an ordering
+        edge taken after the wait must not become invisible."""
+        with _SanitizerHarness() as san:
+            r = threading.RLock()
+            other = threading.Lock()
+            cond = threading.Condition(r)
+            parked = threading.Event()
+
+            def waiter():
+                with cond:
+                    parked.set()
+                    cond.wait(timeout=5.0)
+                    with other:  # edge r -> other, taken AFTER the wait
+                        pass
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            assert parked.wait(timeout=5.0)
+            time.sleep(0.05)  # let the waiter actually park in wait()
+            with cond:
+                cond.notify()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+            r_site, other_site = r._site, other._site
+            assert other_site in san.edges().get(r_site, []), san.edges()
+
+    def test_lock_semantics_preserved(self):
+        with _SanitizerHarness():
+            lk = threading.Lock()
+            assert lk.acquire(False)
+            assert lk.locked()
+            assert not lk.acquire(False)
+            lk.release()
+            assert not lk.locked()

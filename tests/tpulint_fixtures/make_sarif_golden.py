@@ -1,0 +1,32 @@
+"""Regenerate sarif_golden.json (run from the repo root after an
+INTENTIONAL rule-registry or report-layout change)::
+
+    GEOMESA_TPU_NO_JAX=1 python tests/tpulint_fixtures/make_sarif_golden.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from geomesa_tpu.analysis import LintConfig, lint_source  # noqa: E402
+from geomesa_tpu.analysis.report import render_json  # noqa: E402
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    rel = "tests/tpulint_fixtures/j003_bad.py"
+    cfg = LintConfig(j002_paths=("",), j004_paths=("",), c001_paths=("",))
+    with open(os.path.join(here, "j003_bad.py"), encoding="utf-8") as f:
+        src = f.read()
+    doc = json.loads(render_json(lint_source(src, rel, cfg)))
+    out = os.path.join(here, "sarif_golden.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

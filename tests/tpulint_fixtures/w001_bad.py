@@ -1,0 +1,10 @@
+"""W001 known-bad (lint prong): the J003 waiver suppresses nothing."""
+import jax
+
+
+def double(x):
+    return x + x  # tpulint: disable=J003
+
+
+def use(x):
+    return jax.numpy.sum(double(x))

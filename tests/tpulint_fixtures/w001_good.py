@@ -1,0 +1,5 @@
+"""W001 known-good twin (lint prong): the waiver suppresses a REAL J003
+(mutable static_argnums literal), so it is live."""
+import jax
+
+g = jax.jit(lambda x: x, static_argnums=[0])  # tpulint: disable=J003

@@ -1,0 +1,40 @@
+"""R001 known-bad: ``Registry._items``/``_epoch`` are lock-guarded in the
+majority of writes, but written bare in ``evict``/``bump`` and through a
+TYPED cross-class reference in ``Admin.wipe`` (the inter-procedural case
+a single-file rule cannot see)."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._epoch = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._epoch += 1
+
+    def replace(self, items):
+        with self._lock:
+            self._items = dict(items)
+            self._epoch += 1
+
+    def _rebuild_locked(self):
+        self._items.clear()
+
+    def evict(self, k):
+        self._items.pop(k, None)
+
+    def bump(self):
+        self._epoch += 1
+
+
+class Admin:
+    def __init__(self, reg: Registry):
+        self.reg = reg
+
+    def wipe(self):
+        self.reg._items = {}

@@ -1,0 +1,42 @@
+"""R001 known-good twin: every tracked write holds the inferred guard —
+including the ``*_locked`` helper (caller-holds-lock convention) and the
+typed cross-class write, which takes the lock properly."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._epoch = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._epoch += 1
+
+    def replace(self, items):
+        with self._lock:
+            self._items = dict(items)
+            self._epoch += 1
+
+    def _rebuild_locked(self):
+        self._items.clear()
+
+    def evict(self, k):
+        with self._lock:
+            self._items.pop(k, None)
+
+    def bump(self):
+        with self._lock:
+            self._epoch += 1
+
+
+class Admin:
+    def __init__(self, reg: Registry):
+        self.reg = reg
+
+    def wipe(self):
+        with self.reg._lock:
+            self.reg._items = {}

@@ -1,0 +1,24 @@
+"""R002 known-bad: ``ingest`` nests ``_ingest`` → ``_flush`` ONLY through
+the call graph (``_drain``), while ``flush`` nests ``_flush`` →
+``_ingest`` directly — a cycle no single ``with`` block shows."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._ingest = threading.Lock()
+        self._flush = threading.Lock()
+
+    def ingest(self, batch):
+        with self._ingest:
+            self._drain(batch)
+
+    def _drain(self, batch):
+        with self._flush:
+            return list(batch)
+
+    def flush(self):
+        with self._flush:
+            with self._ingest:
+                return None

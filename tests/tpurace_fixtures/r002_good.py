@@ -1,0 +1,23 @@
+"""R002 known-good twin: the same call-graph shape, but every path agrees
+on one global order (``_ingest`` before ``_flush``)."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._ingest = threading.Lock()
+        self._flush = threading.Lock()
+
+    def ingest(self, batch):
+        with self._ingest:
+            self._drain(batch)
+
+    def _drain(self, batch):
+        with self._flush:
+            return list(batch)
+
+    def flush(self):
+        with self._ingest:
+            with self._flush:
+                return None

@@ -1,0 +1,22 @@
+"""R003 known-bad: ``send`` sleeps and does file I/O inside the lock's
+critical section — every other thread queues behind a disk write."""
+
+import threading
+import time
+
+
+class Courier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sent = 0
+
+    def send(self, path, payload):
+        with self._lock:
+            time.sleep(0.01)
+            with open(path, "wb") as f:
+                f.write(payload)
+            self._sent += 1
+
+    def count(self):
+        with self._lock:
+            return self._sent

@@ -1,0 +1,22 @@
+"""R003 known-good twin: the sleep and the write happen OUTSIDE the
+critical section; the lock guards only the counter update."""
+
+import threading
+import time
+
+
+class Courier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sent = 0
+
+    def send(self, path, payload):
+        time.sleep(0.01)
+        with open(path, "wb") as f:
+            f.write(payload)
+        with self._lock:
+            self._sent += 1
+
+    def count(self):
+        with self._lock:
+            return self._sent

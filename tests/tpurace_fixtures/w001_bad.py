@@ -1,0 +1,20 @@
+"""W001 known-bad: both waivers suppress nothing — the R001 waiver sits
+on a properly-locked write, the R003 waiver on a line with no blocking
+call. Dead waivers are themselves violations."""
+
+import threading
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1  # tpurace: disable=R001
+
+    def also(self):
+        with self._lock:
+            # tpurace: disable-next-line=R003
+            self._n += 1

@@ -1,0 +1,22 @@
+"""W001 known-good twin: the waiver suppresses a REAL R001 (intentional
+lock-free publication), so it is live, not stale."""
+
+import threading
+
+
+class Loud:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        with self._lock:
+            self._n += 1
+
+    def c(self):
+        # monotonic hint only; torn reads are acceptable by design
+        self._n += 1  # tpurace: disable=R001
