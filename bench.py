@@ -47,6 +47,12 @@ if "--trace" in sys.argv:
     os.environ["GEOMESA_TPU_TRACE"] = sys.argv[_ti + 1]
     del sys.argv[_ti : _ti + 2]
 
+# --chaos: run the federation chaos bench (tail latency under injected
+# member faults — docs/resilience.md) instead of the config sweep
+if "--chaos" in sys.argv:
+    sys.argv.remove("--chaos")
+    os.environ["GEOMESA_BENCH_CHAOS"] = "1"
+
 # The axon site hook force-registers the TPU relay backend and sets
 # jax_platforms="axon,cpu" at interpreter start, overriding the env var —
 # honor an explicit JAX_PLATFORMS (e.g. the CPU fallback after the backend
@@ -1502,6 +1508,111 @@ def bench_grouped_agg():
     }
 
 
+def bench_chaos():
+    """Federation tail latency under injected member faults (--chaos).
+
+    A 3-member MergedDataStoreView in `partial` mode — one member behind
+    a real HTTP hop with a FaultInjector on its transport (default: 30%
+    injected 503s plus occasional added latency; override with
+    GEOMESA_TPU_FAULTS) — answers a fixed query mix fault-free and then
+    under chaos. Reported: p50/p95/p99 both ways, the degraded-answer
+    fraction, retry/breaker activity, and the p99 inflation factor. The
+    resilience acceptance surface: every query answers either way."""
+    import threading
+    from wsgiref.simple_server import make_server
+
+    from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.resilience import faults
+    from geomesa_tpu.resilience.faults import FaultInjector
+    from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+    from geomesa_tpu.store.datastore import DataStore
+    from geomesa_tpu.store.merged import MergedDataStoreView
+    from geomesa_tpu.store.remote import RemoteDataStore
+    from geomesa_tpu.web.app import GeoMesaApp
+
+    n_per = int(os.environ.get("GEOMESA_BENCH_CHAOS_N", 1500))
+    iters = int(os.environ.get("GEOMESA_BENCH_CHAOS_ITERS", 150))
+    rng = np.random.default_rng(11)
+    t0 = 1_500_000_000_000
+
+    def _member(lo, hi, seed):
+        r = np.random.default_rng(seed)
+        ds = DataStore(backend="tpu")
+        ds.create_schema("c", "name:String,dtg:Date,*geom:Point")
+        ds.write("c", [
+            {"name": f"n{i % 7}", "dtg": t0 + i * 1000,
+             "geom": Point(float(r.uniform(lo, hi)),
+                           float(r.uniform(-60, 60)))}
+            for i in range(n_per)
+        ], fids=[f"{seed}-{i}" for i in range(n_per)])
+        return ds
+
+    west = _member(-170, -60, 1)
+    httpd = make_server("127.0.0.1", 0, GeoMesaApp(west))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        remote = RemoteDataStore(
+            f"http://127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=4, base_delay_s=0.002,
+                              max_delay_s=0.02, seed=3),
+            breaker=CircuitBreaker(endpoint=f":{port}", window=20,
+                                   min_volume=8, failure_rate=0.6,
+                                   cooldown_s=0.2),
+        )
+        view = MergedDataStoreView(
+            [remote, _member(-60, 60, 2), _member(60, 170, 3)],
+            on_member_error="partial",
+        )
+        cqls = [
+            f"BBOX(geom, {x:.0f}, -60, {x + 40:.0f}, 60)"
+            for x in rng.uniform(-170, 130, size=8)
+        ]
+        view.query("c", cqls[0])  # jit/plan warm on every member
+
+        def _run(label):
+            lat, degraded = [], 0
+            for i in range(iters):
+                s = time.perf_counter()
+                r = view.query("c", cqls[i % len(cqls)])
+                lat.append((time.perf_counter() - s) * 1000.0)
+                degraded += int(r.degraded)
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            return {"p50_ms": float(p50), "p95_ms": float(p95),
+                    "p99_ms": float(p99), "degraded": degraded,
+                    "answered": iters}
+
+        clean = _run("clean")
+        inj = faults.from_env()
+        if inj is None:
+            inj = FaultInjector()
+            inj.rule("http", status=503, rate=0.3, seed=42, match=f":{port}")
+            inj.rule("latency", latency_ms=5.0, rate=0.2, seed=7,
+                     match=f":{port}")
+        with inj.activate():
+            chaos = _run("chaos")
+        chaos["injected"] = [
+            {"kind": k, "seen": s, "fired": f} for k, s, f in inj.counts()
+        ]
+        chaos["breaker_opens"] = remote.breaker.open_count
+        inflation = (
+            chaos["p99_ms"] / clean["p99_ms"] if clean["p99_ms"] else None
+        )
+        return {
+            "metric": "chaos_p99_ms",
+            "value": round(chaos["p99_ms"], 3),
+            "unit": "ms (federated query p99 under 30% member 5xx)",
+            "vs_baseline": None if inflation is None else round(inflation, 3),
+            "detail": {
+                "members": 3, "rows_per_member": n_per, "iters": iters,
+                "clean": clean, "chaos": chaos,
+                "every_query_answered": chaos["answered"] == iters,
+            },
+        }
+    finally:
+        httpd.shutdown()
+
+
 BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,
            "4": bench_join, "5": bench_xz2, "6": bench_select,
            "7": bench_resident, "8": bench_stream_1b,
@@ -1713,6 +1824,11 @@ def _child_main():
 
 
 def main():
+    if os.environ.get("GEOMESA_BENCH_CHAOS") == "1":
+        # standalone chaos mode (bench.py --chaos): never part of the
+        # driver sweep — it measures resilience posture, not throughput
+        print(json.dumps(bench_chaos()))
+        return
     if os.environ.get("GEOMESA_BENCH_CHILD") == "1":
         _child_main()
         return

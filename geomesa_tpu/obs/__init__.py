@@ -25,6 +25,7 @@ from geomesa_tpu.obs.trace import (  # noqa: F401 — the public obs surface
     disable,
     enable,
     enabled,
+    event,
     drain,
     recent,
     span,
@@ -32,5 +33,6 @@ from geomesa_tpu.obs.trace import (  # noqa: F401 — the public obs surface
 
 __all__ = [
     "NOOP", "Span", "StageTimeline", "active", "annotate", "collect",
-    "current", "disable", "enable", "enabled", "drain", "recent", "span",
+    "current", "disable", "enable", "enabled", "event", "drain", "recent",
+    "span",
 ]

@@ -63,6 +63,14 @@ def chrome_trace_events(roots=None) -> list[dict]:
         for s in root.walk():
             events.append(_span_event(s))
             tids.add(s.thread_id)
+            for name, t_ns, attrs in list(s.events):
+                # point-in-time span markers (federation member errors,
+                # degradation) as Chrome instant events on the same track
+                events.append({
+                    "name": name, "ph": "i", "s": "t", "pid": 1,
+                    "tid": s.thread_id, "ts": t_ns / 1000.0,
+                    "args": dict(attrs),
+                })
     for tid in sorted(tids):
         events.append({
             "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,

@@ -33,7 +33,7 @@ from contextvars import ContextVar
 
 __all__ = [
     "Span", "StageTimeline", "span", "collect", "current", "annotate",
-    "enable", "disable", "enabled", "recent", "drain", "NOOP",
+    "enable", "disable", "enabled", "event", "recent", "drain", "NOOP",
 ]
 
 _enabled = False  # module-global fast flag (the one check on the no-op path)
@@ -56,13 +56,16 @@ class Span:
 
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "attrs", "children",
-        "t0_ns", "t1_ns", "thread_id", "_token",
+        "events", "t0_ns", "t1_ns", "thread_id", "_token",
     )
 
     def __init__(self, name: str, attrs: dict, parent: "Span | None"):
         self.name = name
         self.attrs = attrs
         self.children: list[Span] = []
+        # point-in-time markers inside this span's window — (name, t_ns,
+        # attrs) — the federation layer's member-error/degradation record
+        self.events: list[tuple] = []
         sid = next(_ids)
         self.span_id = f"{_salt}-{sid:x}"
         if parent is None:
@@ -84,6 +87,12 @@ class Span:
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs) -> "Span":
+        """Record a point-in-time marker on this span (list.append is
+        atomic under the GIL; exporters snapshot via list())."""
+        self.events.append((name, time.perf_counter_ns(), attrs))
         return self
 
     def __enter__(self) -> "Span":
@@ -141,6 +150,9 @@ class _NoopSpan:
     def set(self, **attrs):
         return self
 
+    def event(self, name, **attrs):
+        return self
+
     # mimic the Span read surface so call sites never branch on type
     name = ""
     trace_id = ""
@@ -148,6 +160,7 @@ class _NoopSpan:
     parent_id = ""
     attrs: dict = {}
     children: list = []
+    events: list = []
     duration_ms = 0.0
 
     def walk(self):
@@ -208,6 +221,14 @@ def annotate(**attrs) -> None:
     sp = _current.get()
     if sp is not None:
         sp.attrs.update(attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a point-in-time marker on the innermost live span (no-op
+    when untraced) — e.g. a federation member error inside a query span."""
+    sp = _current.get()
+    if sp is not None:
+        sp.event(name, **attrs)
 
 
 @contextmanager

@@ -1,0 +1,42 @@
+"""geomesa_tpu.resilience — failure handling for the remote/federation stack.
+
+Three layers (see docs/resilience.md):
+
+- :mod:`~geomesa_tpu.resilience.policy` — :class:`RetryPolicy`
+  (exponential backoff + decorrelated jitter, per-policy retry budget,
+  idempotency-aware classification) and the per-endpoint three-state
+  :class:`CircuitBreaker`.
+- :mod:`~geomesa_tpu.resilience.http` — the single ``urlopen`` choke
+  point every remote client uses, the shared server→client error-mapping
+  request helper, and ``X-Geomesa-Deadline-Ms`` deadline propagation.
+- :mod:`~geomesa_tpu.resilience.faults` — the deterministic
+  :class:`FaultInjector` seam (``GEOMESA_TPU_FAULTS`` env spec or
+  programmatic rules with seeded schedules) behind the chaos tests and
+  ``bench.py --chaos``.
+
+This package imports no jax and no store/stream modules: it sits below
+the clients that use it, and ``GEOMESA_TPU_NO_JAX=1`` processes import it
+freely. Its locks (breaker state, retry budget, injector counters) are
+leaves of the lock hierarchy in docs/concurrency.md — nothing blocking
+ever runs under them.
+"""
+
+from geomesa_tpu.resilience.policy import (  # noqa: F401 — public surface
+    MEMBER_FAILURE_TYPES,
+    CircuitBreaker,
+    CircuitOpenError,
+    CorruptPayloadError,
+    RetryPolicy,
+    is_member_failure,
+    retryable,
+)
+
+__all__ = [
+    "MEMBER_FAILURE_TYPES",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "CorruptPayloadError",
+    "RetryPolicy",
+    "is_member_failure",
+    "retryable",
+]

@@ -1,0 +1,303 @@
+"""Deterministic fault injection at the HTTP choke point.
+
+Every remote client in the tree issues its requests through
+:func:`geomesa_tpu.resilience.http.fetch` — ONE ``urlopen`` call site —
+and that choke point consults the active :class:`FaultInjector` before
+sending and after receiving. Tests and ``bench.py --chaos`` drive it
+programmatically; operators (and the CI chaos smoke gate in
+``scripts/lint.sh``) drive it with the ``GEOMESA_TPU_FAULTS`` environment
+spec. No fault ever fires unless an injector with matching rules is
+active, and the inactive path is one module-global read.
+
+Spec grammar (see docs/resilience.md):
+
+    GEOMESA_TPU_FAULTS = rule (";" rule)*
+    rule               = field ("," field)*
+    field              = key "=" value
+
+    keys: kind   refuse | http | latency | truncate | corrupt   (required)
+          match  substring of "METHOD url" this rule applies to (default all)
+          rate   fire probability in [0,1], seeded draw        (default 1.0)
+          seed   per-rule RNG seed                             (default 0)
+          times  stop after this many fires                    (default ∞)
+          after  skip the first N matching calls               (default 0)
+          status HTTP status for kind=http                     (default 503)
+          ms     added latency for kind=latency                (default 50)
+          at     keep this many payload bytes for kind=truncate
+                 (default: half the payload)
+
+Example — 30% 503s on one member plus 50 ms on every journal call:
+
+    GEOMESA_TPU_FAULTS="kind=http,status=503,rate=0.3,seed=7,match=:8081;\
+kind=latency,ms=50,match=/api/journal"
+
+Schedules are deterministic: each rule draws from its own seeded RNG in
+match order, so a given (spec, request sequence) always injects the same
+faults — chaos tests are reproducible, not flaky.
+
+Locking: one leaf lock guards rule counters/RNGs (rules are consulted
+from concurrent client threads). Latency sleeps happen OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+import urllib.error
+
+__all__ = [
+    "FaultInjector",
+    "FaultRule",
+    "active",
+    "from_env",
+    "from_spec",
+    "install",
+    "uninstall",
+]
+
+_KINDS = ("refuse", "http", "latency", "truncate", "corrupt")
+
+
+class FaultRule:
+    """One match → fault mapping with a seeded, counted schedule."""
+
+    def __init__(
+        self,
+        kind: str,
+        match: str = "",
+        rate: float = 1.0,
+        seed: int = 0,
+        times: int | None = None,
+        after: int = 0,
+        status: int = 503,
+        latency_ms: float = 50.0,
+        truncate_at: int | None = None,
+    ):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; known: {_KINDS}")
+        self.kind = kind
+        self.match = match
+        self.rate = float(rate)
+        self.times = times
+        self.after = int(after)
+        self.status = int(status)
+        self.latency_ms = float(latency_ms)
+        self.truncate_at = truncate_at
+        self._rng_seed = seed
+        import random
+
+        self._rng = random.Random(seed)
+        self.seen = 0  # matching calls observed
+        self.fired = 0  # faults actually injected
+
+    def _decide_locked(self) -> bool:
+        """Called with the injector lock held: count the match, draw the
+        seeded schedule, honor after/times bounds."""
+        self.seen += 1
+        if self.seen <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.rate < 1.0 and self._rng.random() >= self.rate:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultInjector:
+    """A set of :class:`FaultRule`\\ s consulted by the HTTP choke point.
+
+    Build programmatically (``inj.rule("http", status=503, rate=0.3)``)
+    or from the env spec (:func:`from_spec`). Activate for a scope with
+    ``with inj.activate(): ...`` or process-wide with :func:`install`.
+    """
+
+    def __init__(self, rules=()):
+        self._lock = threading.Lock()  # leaf: rule counters/RNG draws only
+        self.rules: list[FaultRule] = list(rules)
+
+    def rule(self, kind: str, **kw) -> "FaultInjector":
+        """Append one rule; returns self for chaining."""
+        self.rules.append(FaultRule(kind, **kw))
+        return self
+
+    # -- choke-point hooks ----------------------------------------------------
+    def before_send(self, method: str, url: str) -> None:
+        """Fire pre-send faults: added latency, refused connections, and
+        injected HTTP error responses. Raises exactly what a real failed
+        ``urlopen`` would raise, so client classification code cannot
+        tell injected faults from organic ones."""
+        key = f"{method} {url}"
+        sleep_ms = 0.0
+        err: Exception | None = None
+        with self._lock:
+            for r in self.rules:
+                if r.kind in ("truncate", "corrupt"):
+                    continue
+                if r.match and r.match not in key:
+                    continue
+                if not r._decide_locked():
+                    continue
+                if r.kind == "latency":
+                    sleep_ms += r.latency_ms
+                elif err is None and r.kind == "refuse":
+                    # what urlopen raises for a dead port: URLError
+                    # wrapping the connect-phase OSError
+                    err = urllib.error.URLError(
+                        ConnectionRefusedError(
+                            111, f"[fault] connection refused: {url}")
+                    )
+                elif err is None and r.kind == "http":
+                    err = urllib.error.HTTPError(
+                        url, r.status, f"[fault] injected {r.status}",
+                        None,  # type: ignore[arg-type]
+                        io.BytesIO(b'{"error": "injected fault"}'),
+                    )
+        if sleep_ms > 0:
+            time.sleep(sleep_ms / 1000.0)  # outside the lock
+        if err is not None:
+            raise err
+
+    def after_receive(self, method: str, url: str, data: bytes) -> bytes:
+        """Apply payload faults (truncation / corruption) to a response
+        that 'arrived' — the torn-Arrow-stream failure mode."""
+        key = f"{method} {url}"
+        out = data
+        with self._lock:
+            for r in self.rules:
+                if r.kind not in ("truncate", "corrupt"):
+                    continue
+                if r.match and r.match not in key:
+                    continue
+                if not r._decide_locked():
+                    continue
+                if r.kind == "truncate":
+                    at = r.truncate_at if r.truncate_at is not None else len(out) // 2
+                    out = out[:at]
+                else:  # corrupt: flip bytes in place, keep the length
+                    buf = bytearray(out)
+                    for i in range(0, len(buf), max(1, len(buf) // 16)):
+                        buf[i] ^= 0xA5
+                    out = bytes(buf)
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+    def activate(self):
+        """Context manager: install for the ``with`` block, restoring the
+        previously-installed injector (or the env default) on exit."""
+        return _Activation(self)
+
+    def counts(self) -> list[tuple[str, int, int]]:
+        """(kind, seen, fired) per rule — assertion surface for tests."""
+        with self._lock:
+            return [(r.kind, r.seen, r.fired) for r in self.rules]
+
+
+class _Activation:
+    def __init__(self, inj: FaultInjector):
+        self._inj = inj
+        self._prev: "tuple | None" = None
+
+    def __enter__(self) -> FaultInjector:
+        global _override
+        with _install_lock:
+            self._prev = _override
+            _override = (self._inj,)
+        return self._inj
+
+    def __exit__(self, *exc) -> None:
+        global _override
+        with _install_lock:
+            _override = self._prev
+
+
+# -- spec parsing -------------------------------------------------------------
+
+def from_spec(spec: str) -> FaultInjector:
+    """Parse the ``GEOMESA_TPU_FAULTS`` grammar into an injector."""
+    inj = FaultInjector()
+    for i, rule_text in enumerate(s for s in spec.split(";") if s.strip()):
+        fields: dict[str, str] = {}
+        for part in rule_text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"fault rule field {part!r} is not key=value "
+                    f"(rule {i}: {rule_text!r})")
+            k, v = part.split("=", 1)
+            fields[k.strip()] = v.strip()
+        kind = fields.pop("kind", None)
+        if kind is None:
+            raise ValueError(f"fault rule {i} missing kind=: {rule_text!r}")
+        kw: dict = {}
+        if "match" in fields:
+            kw["match"] = fields.pop("match")
+        for key, cast, dest in (
+            ("rate", float, "rate"), ("seed", int, "seed"),
+            ("times", int, "times"), ("after", int, "after"),
+            ("status", int, "status"), ("ms", float, "latency_ms"),
+            ("at", int, "truncate_at"),
+        ):
+            if key in fields:
+                kw[dest] = cast(fields.pop(key))
+        if fields:
+            raise ValueError(
+                f"unknown fault rule keys {sorted(fields)} in {rule_text!r}")
+        inj.rule(kind, **kw)
+    return inj
+
+
+# -- process-wide installation ------------------------------------------------
+# `_override` holds the explicit override as a 1-tuple (tests, bench
+# --chaos) or None for "no override"; when no override is active the env
+# spec provides the ambient injector, parsed once per distinct spec
+# value. One reference = one atomic swap, so readers never need the lock.
+_install_lock = threading.Lock()
+_override: "tuple[FaultInjector] | None" = None
+_env_cache: tuple[str, FaultInjector] | None = None
+
+
+def install(inj: FaultInjector | None) -> None:
+    """Install a process-wide injector; ``install(None)`` reverts to the
+    ``GEOMESA_TPU_FAULTS`` env default (an EMPTY injector pins a fault-free
+    transport regardless of the environment)."""
+    global _override
+    with _install_lock:
+        _override = None if inj is None else (inj,)
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def from_env() -> FaultInjector | None:
+    """The env-spec injector, or None when ``GEOMESA_TPU_FAULTS`` is unset."""
+    global _env_cache
+    spec = os.environ.get("GEOMESA_TPU_FAULTS")
+    if not spec:
+        return None
+    with _install_lock:
+        if _env_cache is not None and _env_cache[0] == spec:
+            return _env_cache[1]
+    inj = from_spec(spec)  # parse outside the lock
+    with _install_lock:
+        if _env_cache is None or _env_cache[0] != spec:
+            _env_cache = (spec, inj)
+        return _env_cache[1]
+
+
+def active() -> FaultInjector | None:
+    """The injector the choke point should consult right now (explicit
+    override first, env spec otherwise) — None on the fault-free path.
+
+    Lock-free read: ``_override`` is a single reference only ever swapped
+    whole under ``_install_lock``, so the per-request fast path is one
+    global load, no lock."""
+    ov = _override
+    if ov is not None:
+        return ov[0]
+    return from_env()

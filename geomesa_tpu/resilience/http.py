@@ -1,0 +1,172 @@
+"""The one HTTP seam every remote client goes through.
+
+Three jobs, one call site:
+
+- :func:`fetch` is the SINGLE ``urlopen`` in the tree's remote clients —
+  the choke point where :mod:`~geomesa_tpu.resilience.faults` injects
+  connection refusals, 5xx responses, added latency, and payload
+  truncation/corruption. One seam means chaos coverage of every client
+  (store, journal, schema registry) for free.
+- :func:`request` wraps fetch with the resilience envelope: per-endpoint
+  :class:`~geomesa_tpu.resilience.policy.CircuitBreaker` gating,
+  :class:`~geomesa_tpu.resilience.policy.RetryPolicy` with idempotency
+  classification, and end-to-end deadline propagation (the
+  ``X-Geomesa-Deadline-Ms`` header carries the caller's REMAINING budget
+  in milliseconds; a spent budget sheds locally without a round trip).
+- :func:`map_http_error` is the shared server→client error inversion
+  (the web layer maps ValueError→400, KeyError→404, PermissionError→403,
+  QueryTimeout→504; clients invert it here) so GET and mutation paths
+  surface identical exception types — the ``RemoteDataStore._get`` /
+  ``_send`` divergence this replaces leaked raw ``HTTPError`` from reads.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from geomesa_tpu.resilience import faults
+from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
+
+__all__ = ["DEADLINE_HEADER", "fetch", "map_http_error", "request"]
+
+# remaining deadline budget, in milliseconds, at the moment of send: each
+# hop re-derives its own absolute deadline from the budget, so no wall
+# clocks ever need to agree across hosts
+DEADLINE_HEADER = "X-Geomesa-Deadline-Ms"
+
+# socket-timeout slack past the propagated deadline: the REMOTE is the
+# authority on its own expiry (it sheds with a 504 we want to hear); the
+# local socket only backstops a remote that stopped answering entirely
+_DEADLINE_SOCKET_SLACK_S = 0.25
+
+
+def fetch(req: urllib.request.Request, timeout_s: float) -> bytes:
+    """The urlopen choke point: read one full response body, with fault
+    hooks on both sides of the wire. Raises exactly what ``urlopen``
+    raises (plus whatever the active injector fabricates)."""
+    inj = faults.active()
+    method = req.get_method()
+    if inj is not None:
+        inj.before_send(method, req.full_url)
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:  # noqa: S310
+        data = r.read()
+    if inj is not None:
+        data = inj.after_receive(method, req.full_url, data)
+    return data
+
+
+def map_http_error(e: urllib.error.HTTPError):
+    """Invert the web layer's exception→status mapping. 5xx re-raises
+    unchanged (server/proxy trouble is not a conflict/validation error —
+    callers classify it as a member failure)."""
+    if e.code >= 500:
+        raise e
+    try:
+        msg = json.loads(e.read().decode()).get("error", str(e))
+    except Exception:  # noqa: BLE001 — non-JSON error body
+        msg = str(e)
+    if e.code == 404:
+        raise KeyError(msg) from None
+    if e.code == 403:
+        raise PermissionError(msg) from None
+    raise ValueError(msg) from None
+
+
+def _breaker_failure(exc: BaseException) -> bool:
+    """What counts against an endpoint's health: transport errors and 5xx.
+    A 4xx is the endpoint answering correctly (caller-side semantics)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500
+    return isinstance(exc, (OSError, TimeoutError))
+
+
+def request(
+    method: str,
+    url: str,
+    *,
+    params: dict | None = None,
+    body: dict | None = None,
+    headers: dict | None = None,
+    timeout_s: float = 30.0,
+    retry: RetryPolicy | None = None,
+    breaker: CircuitBreaker | None = None,
+    idempotent: bool = True,
+    deadline: Deadline | None = None,
+    map_errors: bool = True,
+    on_retry=None,
+) -> bytes:
+    """One resilient HTTP exchange; returns the raw response body.
+
+    The retry loop re-gates the breaker and re-derives the deadline
+    header on EVERY attempt (a retry after backoff has less budget left
+    than the first try). With ``map_errors`` (the store-client contract)
+    4xx responses surface as the local store's exception types and 504 as
+    :class:`~geomesa_tpu.utils.timeouts.QueryTimeout`.
+    """
+    full = url
+    if params:
+        full += "?" + urllib.parse.urlencode(params)
+    data = None if body is None else json.dumps(body).encode()
+    base_headers = dict(headers or {})
+    if data is not None:
+        base_headers.setdefault("Content-Type", "application/json")
+
+    def attempt() -> bytes:
+        hdrs = dict(base_headers)
+        eff_timeout = timeout_s
+        if deadline is not None:
+            # shed BEFORE the breaker gate: a shed records no outcome, so
+            # gating first could consume a half-open probe slot that is
+            # then never released
+            rem_s = deadline.remaining_s()
+            if rem_s <= 0:
+                # no round trip for a query that cannot finish in time
+                # anyway (the server would 504 it)
+                raise QueryTimeout(
+                    f"deadline spent before request to {url}")
+            hdrs[DEADLINE_HEADER] = str(int(rem_s * 1000) or 1)
+            eff_timeout = min(timeout_s, rem_s + _DEADLINE_SOCKET_SLACK_S)
+        if breaker is not None:
+            breaker.before_call()  # raises CircuitOpenError when open
+        req = urllib.request.Request(
+            full, data=data, method=method, headers=hdrs)
+        try:
+            out = fetch(req, eff_timeout)
+        except QueryTimeout:
+            raise  # local shed: says nothing about endpoint health
+        except Exception as exc:  # noqa: BLE001 — classified for the breaker
+            if breaker is not None:
+                breaker.record(_breaker_failure(exc))
+            if (
+                deadline is not None and deadline.expired()
+                and isinstance(exc, OSError)
+            ):
+                # a transport error after the budget ran out IS the
+                # deadline: surface the uniform timeout type
+                raise QueryTimeout(
+                    f"deadline expired during request to {url}") from exc
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return out
+
+    try:
+        if retry is None:
+            raw = attempt()
+        else:
+            raw = retry.call(attempt, idempotent=idempotent,
+                             on_retry=on_retry)
+    except urllib.error.HTTPError as e:
+        if not map_errors:
+            raise
+        if e.code == 504:
+            # the remote shed/expired the work: the federation-wide
+            # timeout surface, same type the local watchdog raises
+            raise QueryTimeout(f"remote {url} exceeded deadline") from None
+        map_http_error(e)
+        raise AssertionError("unreachable")  # pragma: no cover
+    return raw

@@ -1,0 +1,322 @@
+"""Retry policies and circuit breakers for the remote/federation stack.
+
+Role parity: the reference delegates single-store failure recovery to the
+backing store's replicas (``ThreadManagement.scala`` kills runaway scans,
+HBase/Accumulo replicas absorb region failures — SURVEY.md §5). The
+*distributed* half (``MergedDataStoreView`` over remote slices, §2.20 P10)
+has no such substrate here: one flaky HTTP member is one Python exception.
+This module is that substrate — the per-call retry loop and the
+per-endpoint failure-rate circuit breaker every remote client
+(:class:`~geomesa_tpu.store.remote.RemoteDataStore`,
+:class:`~geomesa_tpu.stream.remote_journal.RemoteJournal`,
+:class:`~geomesa_tpu.stream.confluent.HttpSchemaRegistry`) threads its
+requests through. See docs/resilience.md.
+
+Locking: :class:`CircuitBreaker` and the :class:`RetryPolicy` token budget
+each own one leaf lock (metrics-tier in docs/concurrency.md's hierarchy):
+nothing blocking — no I/O, no sleep, no callbacks — ever runs under them.
+Backoff sleeps happen strictly outside any lock.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import urllib.error
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "CorruptPayloadError",
+    "MEMBER_FAILURE_TYPES",
+    "RetryPolicy",
+    "is_member_failure",
+    "retryable",
+]
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised WITHOUT touching the network when an endpoint's breaker is
+    open — the fail-fast path a federated fan-out uses to skip a member
+    that has already proven unhealthy (partial-results mode) instead of
+    burning its latency budget re-timing-out against it."""
+
+    def __init__(self, endpoint: str, retry_after_s: float):
+        super().__init__(
+            f"circuit open for {endpoint} (retry in {retry_after_s:.2f}s)"
+        )
+        self.endpoint = endpoint
+        self.retry_after_s = retry_after_s
+
+
+class CorruptPayloadError(RuntimeError):
+    """A remote member answered 200 but the payload failed to decode
+    (truncated/corrupt Arrow IPC, garbage JSON). Typed so federation
+    callers can degrade on it like any other member failure instead of
+    surfacing an opaque pyarrow/json traceback."""
+
+
+def _connect_failure(exc: BaseException) -> bool:
+    """True when the failure happened BEFORE the request reached the
+    server (connection refused / DNS / socket connect) — the only class a
+    non-idempotent mutation may safely retry: the server never saw it.
+
+    ``urllib`` wraps connect-phase OSErrors in a plain ``URLError``;
+    ``HTTPError`` (a URLError subclass) means a response came back, so it
+    is explicitly NOT a connect failure."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    return isinstance(exc, ConnectionError)
+
+
+def retryable(exc: BaseException, idempotent: bool) -> bool:
+    """The retry classification gate.
+
+    Idempotent calls (reads: ``query`` / ``stats_count`` / ``select_many``
+    / journal polls) retry on any transport error or 5xx — re-running a
+    read is always safe. Mutations retry ONLY on connect-before-send
+    failures: a 5xx (or a socket that died mid-exchange) may have already
+    applied the write, and replaying it could double-append."""
+    if isinstance(exc, CircuitOpenError):
+        return False  # fail fast: the breaker already decided
+    from geomesa_tpu.utils.timeouts import QueryTimeout
+
+    if isinstance(exc, QueryTimeout):
+        # a spent/blown deadline: retrying burns backoff sleeps and
+        # budget tokens against the same dead budget
+        return False
+    if not idempotent:
+        return _connect_failure(exc)
+    if isinstance(exc, urllib.error.HTTPError):
+        # 504 = the propagated deadline is spent at the remote; a retry
+        # would burn round trips against the same dead budget
+        return exc.code >= 500 and exc.code != 504
+    # URLError (connect), ConnectionError, socket.timeout, raw OSError
+    return isinstance(exc, (urllib.error.URLError, OSError))
+
+
+# the federation's member-failure set: exceptions a `partial`-mode fan-out
+# may degrade on (skip the member, serve the rest). Semantic errors —
+# KeyError/ValueError/PermissionError mapped from 4xx — are NOT here: a
+# missing schema or bad filter is the caller's bug on every member alike.
+# CircuitOpenError/ConnectionError/HTTPError/URLError/timeout ⊂ OSError.
+MEMBER_FAILURE_TYPES: tuple = (OSError, CorruptPayloadError, TimeoutError)
+
+
+def is_member_failure(exc: BaseException) -> bool:
+    return isinstance(exc, MEMBER_FAILURE_TYPES)
+
+
+class RetryPolicy:
+    """Exponential backoff with decorrelated jitter + a per-policy retry
+    budget.
+
+    - Backoff: the AWS "decorrelated jitter" schedule —
+      ``sleep_n = min(cap, uniform(base, sleep_{n-1} * 3))`` — spreads
+      synchronized retry storms across a federated fan-out.
+    - Budget: a token bucket of retries per window shared by every call
+      through this policy. When a member is hard-down, N queued queries
+      must not each burn ``max_attempts`` round-trips; once the bucket is
+      dry, calls fail on their first error (the breaker then opens and
+      stops even that).
+    - Idempotency: ``call(fn, idempotent=False)`` retries only
+      connect-before-send failures (see :func:`retryable`).
+
+    Deterministic in tests: pass ``seed`` (jitter) and ``clock``/``sleep``
+    doubles. The instance is thread-safe; only the token bucket and the
+    jitter RNG are shared state, both guarded by one leaf lock.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay_s: float = 0.05,
+        max_delay_s: float = 2.0,
+        budget: int = 64,
+        budget_window_s: float = 10.0,
+        seed: int | None = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.budget = budget
+        self.budget_window_s = budget_window_s
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()  # leaf: guards rng + bucket only
+        self._rng = random.Random(seed)
+        self._tokens = float(budget)
+        self._refill_at = clock()
+
+    # -- budget ---------------------------------------------------------------
+    def _take_token(self) -> bool:
+        """One retry token, refilled at ``budget / window`` per second."""
+        with self._lock:
+            now = self._clock()
+            dt = now - self._refill_at
+            if dt > 0:
+                self._tokens = min(
+                    float(self.budget),
+                    self._tokens + dt * (self.budget / self.budget_window_s),
+                )
+                self._refill_at = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def budget_remaining(self) -> int:
+        with self._lock:
+            return int(self._tokens)
+
+    # -- backoff --------------------------------------------------------------
+    def next_delay(self, prev_delay_s: float | None = None) -> float:
+        """One decorrelated-jitter step; loop-style callers (the remote
+        journal tailer) feed the previous delay back in."""
+        lo = self.base_delay_s
+        hi = max(lo, (prev_delay_s if prev_delay_s else lo) * 3.0)
+        with self._lock:
+            d = self._rng.uniform(lo, hi)
+        return min(self.max_delay_s, d)
+
+    # -- the retry loop -------------------------------------------------------
+    def call(self, fn, *, idempotent: bool = True, on_retry=None):
+        """Run ``fn()`` with retries. ``on_retry(attempt, delay_s, exc)``
+        observes each scheduled retry (metrics/trace hook). The last
+        error re-raises unchanged when attempts/budget run out."""
+        delay: float | None = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if attempt >= self.max_attempts:
+                    raise
+                if not retryable(exc, idempotent):
+                    raise
+                if not self._take_token():
+                    raise  # budget dry: shed the retry, surface the error
+                delay = self.next_delay(delay)
+                if on_retry is not None:
+                    on_retry(attempt, delay, exc)
+                self._sleep(delay)  # outside every lock
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+class CircuitBreaker:
+    """Per-endpoint three-state breaker: ``closed`` → ``open`` →
+    ``half_open`` → (``closed`` | ``open``).
+
+    Closed: outcomes land in a sliding window of the last ``window``
+    calls; once at least ``min_volume`` outcomes are in and the failure
+    rate reaches ``failure_rate``, the breaker opens. Open: every
+    :meth:`before_call` raises :class:`CircuitOpenError` until
+    ``cooldown_s`` passes, then the breaker half-opens. Half-open: up to
+    ``probes`` trial calls go through; the first success closes the
+    breaker (window reset), the first failure re-opens it (cooldown
+    restarts).
+
+    Thread-safe; one leaf lock, no blocking calls under it. ``clock`` is
+    injectable so state transitions are testable without real sleeps.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        endpoint: str = "?",
+        window: int = 20,
+        min_volume: int = 5,
+        failure_rate: float = 0.5,
+        cooldown_s: float = 5.0,
+        probes: int = 1,
+        clock=time.monotonic,
+    ):
+        self.endpoint = endpoint
+        self.window = window
+        self.min_volume = min_volume
+        self.failure_rate = failure_rate
+        self.cooldown_s = cooldown_s
+        self.probes = probes
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: state machine only
+        self._state = self.CLOSED
+        self._outcomes: list[bool] = []  # True = failure, bounded by window
+        self._opened_at = 0.0
+        self._inflight_probes = 0
+        self.open_count = 0  # lifetime open transitions (metrics surface)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        # lazily promote open → half_open when the cooldown has passed; the
+        # next before_call() will hand out probe slots
+        if (
+            self._state == self.OPEN
+            and self._clock() - self._opened_at >= self.cooldown_s
+        ):
+            self._state = self.HALF_OPEN
+            self._inflight_probes = 0
+        return self._state
+
+    def before_call(self) -> None:
+        """Gate one call: raises :class:`CircuitOpenError` when open (or
+        half-open with every probe slot taken)."""
+        with self._lock:
+            st = self._state_locked()
+            if st == self.CLOSED:
+                return
+            if st == self.HALF_OPEN and self._inflight_probes < self.probes:
+                self._inflight_probes += 1
+                return
+            remaining = self.cooldown_s - (self._clock() - self._opened_at)
+            raise CircuitOpenError(self.endpoint, max(remaining, 0.0))
+
+    def record(self, failure: bool) -> None:
+        with self._lock:
+            st = self._state_locked()
+            if st == self.HALF_OPEN:
+                if self._inflight_probes <= 0:
+                    # a slow call issued BEFORE the trip completing now:
+                    # stale signal, not a probe outcome — it must neither
+                    # close the breaker nor restart the cooldown
+                    return
+                self._inflight_probes -= 1
+                if failure:  # probe failed: re-open, cooldown restarts
+                    self._trip_locked()
+                else:  # probe succeeded: fresh window, endpoint healthy
+                    self._state = self.CLOSED
+                    self._outcomes.clear()
+                return
+            if st == self.OPEN:
+                return  # late completion from before the trip: stale signal
+            self._outcomes.append(failure)
+            if len(self._outcomes) > self.window:
+                del self._outcomes[0]
+            n = len(self._outcomes)
+            if n >= self.min_volume:
+                rate = sum(self._outcomes) / n
+                if rate >= self.failure_rate:
+                    self._trip_locked()
+
+    def _trip_locked(self) -> None:
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+        self._outcomes.clear()
+        self._inflight_probes = 0
+        self.open_count += 1
+
+    def record_success(self) -> None:
+        self.record(False)
+
+    def record_failure(self) -> None:
+        self.record(True)

@@ -68,6 +68,11 @@ class QueryResult:
     density: np.ndarray | None = None  # (height, width) f64 weighted counts
     stats: dict | None = None  # label -> sketch
     bin_data: bytes | None = None
+    # federation partial-results marker (MergedDataStoreView in `partial`
+    # mode): True when one or more members were skipped; member_errors
+    # carries (member_index, exception_type, message) per skipped member
+    degraded: bool = False
+    member_errors: list | None = None
 
     @property
     def count(self) -> int:
@@ -945,17 +950,34 @@ class DataStore:
         from geomesa_tpu.utils.timeouts import QueryTimeout, run_with_timeout
 
         timeout_s = q.hints.get("timeout")
+        # end-to-end deadline (hints["deadline"]: utils.timeouts.Deadline):
+        # the remaining budget CAPS any per-query timeout, and a budget
+        # already spent upstream sheds the scan before any device work —
+        # no worker thread is spawned, so nothing lands in the abandoned
+        # gauge for work that never started
+        deadline = q.hints.get("deadline")
+        if deadline is not None:
+            rem = deadline.remaining_s()
+            if rem <= 0:
+                self.metrics.counter("store.query.timeouts").inc()
+                self.metrics.counter("store.query.deadline_shed").inc()
+                raise QueryTimeout(
+                    f"deadline spent before scan of {type_name!r} started")
+            timeout_s = rem if timeout_s is None else min(timeout_s, rem)
         token = self.watchdog.register(f"{type_name}: {q.filter!r}")
+        timed_out = False
         try:
             table, rows, density, stats_out, bin_data = run_with_timeout(
                 _scan_and_reduce, timeout_s
             )
         except QueryTimeout:
-            self.watchdog.complete(token, timed_out=True)
+            timed_out = True
             self.metrics.counter("store.query.timeouts").inc()
             raise
-        else:
-            self.watchdog.complete(token)
+        finally:
+            # finally: scan errors (not just timeouts) must release the
+            # registration instead of leaking it in the active set
+            self.watchdog.complete(token, timed_out=timed_out)
         info = plan_box["info"]
         plan_ms = plan_box["plan_ms"]
         scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
@@ -1233,6 +1255,7 @@ class DataStore:
                 or dev is None
                 or getattr(dev, "kind", None) not in ("points", "bboxes")
                 or q.hints.get("timeout") is not None
+                or q.hints.get("deadline") is not None
             ):
                 results[i] = _fallback(i)
             else:

@@ -14,8 +14,10 @@ from dataclasses import replace
 
 import numpy as np
 
+from geomesa_tpu import obs
 from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience import MEMBER_FAILURE_TYPES
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType
 from geomesa_tpu.store.datastore import QueryResult
@@ -46,13 +48,44 @@ def intersection_schemas(stores) -> list[str]:
 
 
 class MergedDataStoreView:
-    """Read-only fan-out over ``[(store, scope_filter_or_None), ...]``."""
+    """Read-only fan-out over ``[(store, scope_filter_or_None), ...]``.
 
-    def __init__(self, stores):
+    ``on_member_error`` (docs/resilience.md) picks the federation's
+    failure posture:
+
+    - ``"fail"`` (default, the historical behavior): any member error
+      fails the whole query — strict, every answer is complete.
+    - ``"partial"``: a member failing with a MEMBER failure (transport
+      error, 5xx after retries, open circuit breaker, blown deadline,
+      corrupt payload — :data:`geomesa_tpu.resilience.MEMBER_FAILURE_TYPES`)
+      is skipped; the merged result carries the surviving members' rows,
+      marked ``degraded=True`` with per-member error details, the way
+      query-cache systems serve cached partials under failure (GeoBlocks,
+      arXiv:1908.07753). Semantic errors (missing schema, bad filter —
+      KeyError/ValueError/PermissionError) still fail: they are the
+      caller's bug on every member alike. All members failing fails the
+      query in either mode.
+
+    Degradations are observable: ``metrics`` counters
+    (``federation.member_errors[.i]``, ``federation.degraded_queries``)
+    and an :func:`obs.event` span marker per skipped member.
+    """
+
+    def __init__(self, stores, on_member_error: str = "fail", metrics=None):
         if not stores:
             raise ValueError("merged view needs at least one store")
+        if on_member_error not in ("fail", "partial"):
+            raise ValueError(
+                f"on_member_error must be 'fail' or 'partial', "
+                f"got {on_member_error!r}")
         from geomesa_tpu.filter.cql import parse
 
+        self.on_member_error = on_member_error
+        if metrics is None:
+            from geomesa_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
         # scope filters parsed once here, not per query
         self.stores = []
         for s in stores:
@@ -61,11 +94,71 @@ class MergedDataStoreView:
                 scope = parse(scope)
             self.stores.append((store, scope))
 
+    def _member_run(self, i: int, fn, errors: list):
+        """One member's fan-out leg: ``(ok, result)``. In ``partial``
+        mode a member failure is recorded (metrics + span event + the
+        errors list) and skipped; in ``fail`` mode it propagates."""
+        try:
+            return True, fn()
+        except MEMBER_FAILURE_TYPES as e:
+            if self.on_member_error != "partial":
+                raise
+            errors.append((i, e))
+            self.metrics.counter("federation.member_errors").inc()
+            self.metrics.counter(f"federation.member_errors.{i}").inc()
+            obs.event("member_error", member=i, error=type(e).__name__)
+            return False, None
+
+    @staticmethod
+    def _error_details(errors: list) -> list:
+        return [(i, type(e).__name__, str(e)) for i, e in errors]
+
+    def _note_degraded(self, errors: list, op: str) -> None:
+        self.metrics.counter("federation.degraded_queries").inc()
+        obs.event("degraded", op=op, members_failed=len(errors))
+
     def get_schema(self, name: str) -> FeatureType:
-        return intersection_schema([s for s, _ in self.stores], name)
+        stores = [s for s, _ in self.stores]
+        if self.on_member_error != "partial":
+            return intersection_schema(stores, name)
+        # partial mode: the schema contract holds over the ANSWERING
+        # members — a dead member must not take down the view's whole
+        # schema surface (its data absence is recorded per query by the
+        # fan-outs). Layout mismatches are semantic and still raise.
+        sft = None
+        last: Exception | None = None
+        for s in stores:
+            try:
+                other = s.get_schema(name)
+            except MEMBER_FAILURE_TYPES as e:
+                last = e
+                continue
+            if sft is None:
+                sft = other
+            elif [a.name for a in other.attributes] != [
+                a.name for a in sft.attributes
+            ]:
+                raise ValueError(f"schema mismatch across stores for {name!r}")
+        if sft is None:
+            raise last if last is not None else KeyError(name)
+        return sft
 
     def list_schemas(self) -> list[str]:
-        return intersection_schemas([s for s, _ in self.stores])
+        stores = [s for s, _ in self.stores]
+        if self.on_member_error != "partial":
+            return intersection_schemas(stores)
+        names: set | None = None
+        last: Exception | None = None
+        for s in stores:
+            try:
+                ns = set(s.list_schemas())
+            except MEMBER_FAILURE_TYPES as e:
+                last = e
+                continue
+            names = ns if names is None else names & ns
+        if names is None:
+            raise last if last is not None else ValueError("no members")
+        return sorted(names)
 
     def query(self, type_name: str, q: "Query | str | ast.Filter | None" = None, **kwargs) -> QueryResult:
         sft = self.get_schema(type_name)
@@ -79,11 +172,15 @@ class MergedDataStoreView:
         density = None
         stats = None
         bin_parts: list[bytes] = []
+        errors: list = []
         base_f = q.resolved_filter()
-        for store, scope in self.stores:
+        for i, (store, scope) in enumerate(self.stores):
             f = base_f if scope is None else ast.And((base_f, scope))
             sub = replace(q, filter=f, sort_by=None, limit=None, start_index=None)
-            res = store.query(type_name, sub)
+            ok, res = self._member_run(
+                i, lambda s=store, t=sub: s.query(type_name, t), errors)
+            if not ok:
+                continue
             if res.density is not None:
                 density = res.density if density is None else density + res.density
             if res.stats is not None:
@@ -95,6 +192,13 @@ class MergedDataStoreView:
                 bin_parts.append(res.bin_data)
             if res.density is None and res.stats is None and res.bin_data is None:
                 tables.append(res.table)
+
+        if errors and len(errors) == len(self.stores):
+            # zero members answered: there is no partial to serve
+            raise errors[-1][1]
+        degraded = bool(errors)
+        if degraded:
+            self._note_degraded(errors, "query")
 
         if density is not None or stats is not None or bin_parts:
             bin_data = None
@@ -117,6 +221,8 @@ class MergedDataStoreView:
                 density=density,
                 stats=stats,
                 bin_data=bin_data,
+                degraded=degraded,
+                member_errors=self._error_details(errors) if errors else None,
             )
 
         table = FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
@@ -124,17 +230,32 @@ class MergedDataStoreView:
         from geomesa_tpu.store.reduce import sort_limit
 
         table, rows = sort_limit(table, rows, q.sort_by, q.limit, q.start_index)
-        return QueryResult(table, rows)
+        return QueryResult(
+            table, rows, degraded=degraded,
+            member_errors=self._error_details(errors) if errors else None,
+        )
 
     def stats_count(self, type_name: str, cql=None, exact: bool = False):
-        """Count across stores, honoring each store's scope filter."""
+        """Count across stores, honoring each store's scope filter. In
+        ``partial`` mode a failed member contributes zero (undercount —
+        recorded via metrics/span event; the return type stays a bare
+        number)."""
         from geomesa_tpu.filter.cql import parse
 
         f = parse(cql) if isinstance(cql, str) else cql
         total = 0
-        for s, scope in self.stores:
+        errors: list = []
+        for i, (s, scope) in enumerate(self.stores):
             sub = f if scope is None else (scope if f is None else ast.And((f, scope)))
-            total += s.stats_count(type_name, sub, exact)
+            ok, n = self._member_run(
+                i, lambda s=s, t=sub: s.stats_count(type_name, t, exact),
+                errors)
+            if ok:
+                total += n
+        if errors:
+            if len(errors) == len(self.stores):
+                raise errors[-1][1]
+            self._note_degraded(errors, "stats_count")
         return total
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
@@ -161,7 +282,8 @@ class MergedDataStoreView:
         ):
             return [None] * len(qs)
         per_member = []
-        for store, scope in self.stores:
+        errors: list = []
+        for i, (store, scope) in enumerate(self.stores):
             agg = store.aggregate_many
             subs = []
             for q in qs:
@@ -169,10 +291,20 @@ class MergedDataStoreView:
                 if scope is not None:
                     f = ast.And((f, scope))
                 subs.append(replace(q, filter=f))
-            per_member.append(
-                agg(type_name, subs, group_by=group_by,
-                    value_cols=value_cols, now_ms=now_ms)
-            )
+            ok, partials = self._member_run(
+                i, lambda a=agg, s=subs: a(type_name, s, group_by=group_by,
+                                           value_cols=value_cols,
+                                           now_ms=now_ms),
+                errors)
+            if ok:
+                per_member.append(partials)
+        if errors:
+            if not per_member:
+                raise errors[-1][1]
+            # partial federation fold: surviving members' partials merge;
+            # each result dict below carries the degraded marker
+            self._note_degraded(errors, "aggregate_many")
+        degraded = bool(errors)
         out: list = []
         vcols = list(value_cols)
         for qi in range(len(qs)):
@@ -211,7 +343,7 @@ class MergedDataStoreView:
             # no-GROUP-BY single groups merge into one row; grouped results
             # keep only non-empty groups (every member already filters, but
             # scope-disjoint members contribute zero-count groups never)
-            out.append({
+            rec = {
                 "groups": keys,
                 "count": np.asarray(cnt, dtype=np.int64),
                 "cols": {
@@ -221,5 +353,9 @@ class MergedDataStoreView:
                         for k, v in acc[c].items()}
                     for c in vcols
                 },
-            })
+            }
+            if degraded:
+                rec["degraded"] = True
+                rec["member_errors"] = self._error_details(errors)
+            out.append(rec)
         return out

@@ -14,14 +14,17 @@ data lives, only Arrow results cross the wire.
 from __future__ import annotations
 
 import json
-import urllib.error
-import urllib.parse
-import urllib.request
 
 import numpy as np
 
 from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience import http as rhttp
+from geomesa_tpu.resilience.policy import (
+    CircuitBreaker,
+    CorruptPayloadError,
+    RetryPolicy,
+)
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType, parse_spec
 from geomesa_tpu.store.datastore import QueryResult
@@ -45,60 +48,95 @@ class RemoteDataStore:
     """
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
-                 forward_auths_header: str | None = None):
+                 forward_auths_header: str | None = None,
+                 retry: RetryPolicy | None = None,
+                 breaker: CircuitBreaker | None = None):
         # forward_auths_header: name of the TRUSTED header the remote's
         # AuthorizationsProvider is configured with (e.g.
         # "X-Geomesa-Auths"). When set, auths-scoped queries forward the
         # caller's auths in that header; when None (default), they FAIL
         # CLOSED — a remote that is not enforcing visibility must never
         # silently return unrestricted rows to a restricted caller.
+        #
+        # retry/breaker (docs/resilience.md): every exchange runs through
+        # the resilience envelope — reads retry on 5xx/connect errors with
+        # decorrelated-jitter backoff, mutations retry only on
+        # connect-before-send failures, and the per-endpoint breaker fails
+        # fast (CircuitOpenError) once this member has proven unhealthy.
+        # Pass RetryPolicy(max_attempts=1) to disable retries, or share
+        # one breaker across clients of the same endpoint.
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.forward_auths_header = forward_auths_header
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = (
+            breaker if breaker is not None
+            else CircuitBreaker(endpoint=self.base_url)
+        )
         self._schemas: dict[str, FeatureType] = {}
 
+    def _request(self, method: str, path: str, *, params: dict | None = None,
+                 body: dict | None = None, headers: dict | None = None,
+                 idempotent: bool = True, deadline=None) -> bytes:
+        """One resilient exchange (the shared request helper): retry +
+        breaker + deadline header, with server 4xx errors re-raised as the
+        local store's exception types and 504 as QueryTimeout — GET and
+        mutation paths share ONE error mapping, so ``query`` against a
+        missing type raises the same ``KeyError`` a mutation would."""
+        return rhttp.request(
+            method, self.base_url + path,
+            params=params, body=body, headers=headers,
+            timeout_s=self.timeout_s, retry=self.retry,
+            breaker=self.breaker, idempotent=idempotent,
+            deadline=deadline,
+        )
+
     def _get(self, path: str, params: dict | None = None,
-             headers: dict | None = None) -> bytes:
-        url = self.base_url + path
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, headers=headers or {})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            return r.read()
+             headers: dict | None = None, deadline=None) -> bytes:
+        return self._request("GET", path, params=params, headers=headers,
+                             deadline=deadline)
+
+    def _parse_json(self, raw: bytes):
+        """JSON response → object, with decode failures surfaced as the
+        typed :class:`CorruptPayloadError` — a torn/garbage JSON body from
+        a flaky member is a MEMBER failure the federation can degrade on,
+        exactly like a torn Arrow stream."""
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise CorruptPayloadError(
+                f"undecodable JSON payload ({len(raw)} bytes) from "
+                f"{self.base_url}: {e}"
+            ) from e
 
     def _get_json(self, path: str, params: dict | None = None):
-        return json.loads(self._get(path, params))
+        return self._parse_json(self._get(path, params))
 
     def _send(self, method: str, path: str, body: dict | None = None,
-              params: dict | None = None, headers: dict | None = None):
-        """JSON mutation request; server 4xx errors re-raise as the local
-        store's exception types (the web layer maps ValueError→400,
-        KeyError→404, PermissionError→403 — invert that mapping here)."""
-        url = self.base_url + path
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
-        data = None if body is None else json.dumps(body).encode()
-        hdrs = dict(headers or {})
-        if data:
-            hdrs["Content-Type"] = "application/json"
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=hdrs)
+              params: dict | None = None, headers: dict | None = None,
+              idempotent: bool = False, deadline=None):
+        """JSON request (mutations by default: ``idempotent=False`` limits
+        retries to connect-before-send failures; batched READ posts —
+        select-many/aggregate — pass ``idempotent=True``)."""
+        raw = self._request(method, path, params=params, body=body,
+                            headers=headers, idempotent=idempotent,
+                            deadline=deadline)
+        return self._parse_json(raw) if raw else None
+
+    def _decode_arrow(self, sft: FeatureType, data: bytes) -> FeatureTable:
+        """Arrow IPC payload → table, with decode failures surfaced as the
+        typed :class:`CorruptPayloadError` (a truncated/corrupt stream
+        from a flaky member must read as a MEMBER failure the federation
+        can degrade on, not an opaque pyarrow traceback)."""
+        from geomesa_tpu.io.arrow import from_ipc_bytes
+
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                raw = r.read()
-        except urllib.error.HTTPError as e:
-            if e.code >= 500:
-                raise  # server/proxy trouble is NOT a conflict/validation
-            try:
-                msg = json.loads(e.read().decode()).get("error", str(e))
-            except Exception:  # noqa: BLE001 — non-JSON error body
-                msg = str(e)
-            if e.code == 404:
-                raise KeyError(msg) from None
-            if e.code == 403:
-                raise PermissionError(msg) from None
-            raise ValueError(msg) from None
-        return json.loads(raw) if raw else None
+            return from_ipc_bytes(sft, data)
+        except Exception as e:  # noqa: BLE001 — decode errors are member faults
+            raise CorruptPayloadError(
+                f"undecodable Arrow IPC payload ({len(data)} bytes) from "
+                f"{self.base_url}: {type(e).__name__}: {e}"
+            ) from e
 
     # -- store surface --------------------------------------------------------
     def list_schemas(self) -> list[str]:
@@ -111,8 +149,6 @@ class RemoteDataStore:
         return self._schemas[name]
 
     def query(self, type_name: str, q: Query | str | None = None, **kwargs) -> QueryResult:
-        from geomesa_tpu.io.arrow import from_ipc_bytes
-
         if isinstance(q, str) or q is None:
             q = Query(filter=q, **kwargs)
         params = {"format": "arrow"}
@@ -139,8 +175,9 @@ class RemoteDataStore:
                     "queries from this member")
             headers = {self.forward_auths_header: ",".join(q.auths)}
         data = self._get(f"/api/schemas/{type_name}/query", params,
-                         headers=headers)
-        table = from_ipc_bytes(self.get_schema(type_name), data)
+                         headers=headers,
+                         deadline=q.hints.get("deadline"))
+        table = self._decode_arrow(self.get_schema(type_name), data)
         return QueryResult(table, np.arange(len(table)))
 
     def stats_count(self, type_name: str, cql=None, exact: bool = False) -> float:
@@ -160,12 +197,13 @@ class RemoteDataStore:
         fail-closed/forward-header contract as :meth:`query`)."""
         import base64
 
-        from geomesa_tpu.io.arrow import from_ipc_bytes
-
         cqls = []
+        deadline = None
         batch_auths: set[tuple[str, ...] | None] = set()
         for q in queries:
             if isinstance(q, Query):
+                if deadline is None:
+                    deadline = q.hints.get("deadline")
                 # normalized: auths are a SET of visibility labels, so
                 # ('a','b') and ('b','a') are the same scope
                 batch_auths.add(
@@ -197,11 +235,13 @@ class RemoteDataStore:
             headers = {self.forward_auths_header: ",".join(scoped.pop())}
         out = self._send(
             "POST", f"/api/schemas/{type_name}/select-many",
-            {"queries": cqls}, headers=headers)
+            {"queries": cqls}, headers=headers,
+            idempotent=True,  # a batched READ: safe to replay on 5xx
+            deadline=deadline)
         sft = self.get_schema(type_name)
         results = []
         for rec in out["results"]:
-            table = from_ipc_bytes(sft, base64.b64decode(rec["arrow_b64"]))
+            table = self._decode_arrow(sft, base64.b64decode(rec["arrow_b64"]))
             results.append(QueryResult(table, np.arange(len(table))))
         return results
 
@@ -218,14 +258,23 @@ class RemoteDataStore:
         # silently drop limit/hint semantics
         cqls: list = []
         declined: set[int] = set()
+        deadline = None
         for i, q in enumerate(queries):
             if q is None or isinstance(q, str):
                 cqls.append(q)
                 continue
             if isinstance(q, Query):
+                if deadline is None:
+                    deadline = q.hints.get("deadline")
+                # execution-control hints (deadline/timeout) don't change
+                # RESULTS — the remote enforces the shipped deadline
+                # header itself — so only semantic hints decline
+                semantic_hints = any(
+                    k not in ("deadline", "timeout") for k in q.hints
+                )
                 if (
-                    q.auths is not None or q.hints or q.limit is not None
-                    or q.start_index is not None
+                    q.auths is not None or semantic_hints
+                    or q.limit is not None or q.start_index is not None
                 ):
                     declined.add(i)
                     cqls.append(None)
@@ -242,7 +291,9 @@ class RemoteDataStore:
         if now_ms is not None:
             body["now_ms"] = int(now_ms)  # pinned TTL clock crosses the wire
         res = self._send(
-            "POST", f"/api/schemas/{type_name}/aggregate", body
+            "POST", f"/api/schemas/{type_name}/aggregate", body,
+            idempotent=True,  # a batched READ: safe to replay on 5xx
+            deadline=deadline,
         )["results"]
         out = []
         for i, r in enumerate(res):

@@ -68,11 +68,29 @@ class RoutedDataStoreView:
     ``routes``: an iterable whose elements are ``"id"``, a list of
     attribute names (one route), or ``[]`` (the include/catch-all) —
     several elements declare several routes for the same store.
+
+    ``on_member_error`` (docs/resilience.md): ``"fail"`` (default)
+    propagates the routed store's errors; ``"fallback"`` retries a
+    MEMBER failure (transport error, open breaker — the
+    :data:`geomesa_tpu.resilience.MEMBER_FAILURE_TYPES` set) against the
+    include/catch-all store when one is declared and it is a different
+    store — the degraded-but-answering posture for a routed federation
+    whose catch-all holds a full replica.
     """
 
-    def __init__(self, stores):
+    def __init__(self, stores, on_member_error: str = "fail", metrics=None):
         if not stores:
             raise ValueError("routed view needs at least one store")
+        if on_member_error not in ("fail", "fallback"):
+            raise ValueError(
+                f"on_member_error must be 'fail' or 'fallback', "
+                f"got {on_member_error!r}")
+        self.on_member_error = on_member_error
+        if metrics is None:
+            from geomesa_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
         self.stores = [s for s, _ in stores]
         self._mappings: list[tuple[frozenset, object]] = []
         self._id_store = None
@@ -137,6 +155,25 @@ class RoutedDataStoreView:
             return self._id_store
         return by_attributes() or self._include
 
+    def _with_fallback(self, store, fn):
+        """Run one routed call; in ``fallback`` mode a member failure
+        retries against the include store (when distinct)."""
+        from geomesa_tpu import obs
+        from geomesa_tpu.resilience import MEMBER_FAILURE_TYPES
+
+        try:
+            return fn(store)
+        except MEMBER_FAILURE_TYPES as e:
+            if (
+                self.on_member_error != "fallback"
+                or self._include is None
+                or self._include is store
+            ):
+                raise
+            self.metrics.counter("federation.route_fallbacks").inc()
+            obs.event("route_fallback", error=type(e).__name__)
+            return fn(self._include)
+
     def query(self, type_name: str, q=None, **kwargs) -> QueryResult:
         if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q, **kwargs)
@@ -146,7 +183,7 @@ class RoutedDataStoreView:
             # view schema; the delegate validates its own on the happy path
             empty = FeatureTable.from_records(self.get_schema(type_name), [])
             return QueryResult(empty, np.empty(0, dtype=np.int64))
-        return store.query(type_name, q)
+        return self._with_fallback(store, lambda s: s.query(type_name, q))
 
     def stats_count(self, type_name: str, cql=None, exact: bool = False):
         from geomesa_tpu.filter.cql import parse
@@ -155,7 +192,8 @@ class RoutedDataStoreView:
         store = self.route(f)
         if store is None:
             return 0
-        return store.stats_count(type_name, cql, exact=exact)
+        return self._with_fallback(
+            store, lambda s: s.stats_count(type_name, cql, exact=exact))
 
     def explain(self, type_name: str, q=None) -> str:
         if isinstance(q, (str, ast.Filter)) or q is None:

@@ -93,26 +93,39 @@ class HttpSchemaRegistry:
 
     Writer schemas are immutable once assigned an id, so ``schema_by_id``
     responses cache forever; ``register`` caches per canonical schema JSON
-    (the service is idempotent on re-registration)."""
+    (the service is idempotent on re-registration) — which also makes
+    EVERY call here safe to retry: requests run through the shared
+    resilience choke point (docs/resilience.md) with this client's
+    ``retry`` policy and per-endpoint ``breaker``."""
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 retry=None, breaker=None):
+        from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = (
+            breaker if breaker is not None
+            else CircuitBreaker(endpoint=self.base_url)
+        )
         self._lock = threading.Lock()
         self._by_id: dict[int, dict] = {}
         self._ids: dict[tuple[str, str], int] = {}
 
     def _request(self, method: str, path: str, body: dict | None = None):
-        import urllib.request
+        from geomesa_tpu.resilience import http as rhttp
 
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=None if body is None else json.dumps(body).encode(),
+        # map_errors=False: schema_by_id translates the raw 404 itself;
+        # idempotent=True: registration is idempotent server-side, so
+        # even the POST replays safely on 5xx/connect errors
+        raw = rhttp.request(
+            method, self.base_url + path, body=body,
             headers={"Content-Type": "application/vnd.schemaregistry.v1+json"},
-            method=method,
+            timeout_s=self.timeout_s, retry=self.retry,
+            breaker=self.breaker, idempotent=True, map_errors=False,
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            return json.loads(r.read())
+        return json.loads(raw)
 
     def register(self, subject: str, schema: dict) -> int:
         import urllib.parse

@@ -25,26 +25,58 @@ import base64
 import json
 import threading
 import urllib.parse
-import urllib.request
 from typing import Callable
+
+from geomesa_tpu.resilience import http as rhttp
+from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 
 __all__ = ["RemoteJournal"]
 
 
 class RemoteJournal:
-    """MessageBus-surface client over a remote ``/api/journal`` endpoint."""
+    """MessageBus-surface client over a remote ``/api/journal`` endpoint.
+
+    Resilience (docs/resilience.md): every round trip runs through the
+    shared HTTP choke point with this client's ``retry`` policy and
+    per-endpoint ``breaker``; the subscriber tail loop additionally backs
+    off between retry-exhausted rounds with the policy's
+    decorrelated-jitter schedule (NOT a fixed sleep — a hard-down broker
+    must not be hammered at poll frequency) and surfaces its health
+    through ``metrics``: ``remote_journal.consecutive_failures`` /
+    ``remote_journal.healthy`` gauges and a
+    ``remote_journal.transient_errors`` counter, alongside the
+    ``last_error`` attribute."""
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
-                 poll_interval_s: float = 0.1):
+                 poll_interval_s: float = 0.1,
+                 retry: RetryPolicy | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 metrics=None):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.poll_interval_s = poll_interval_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = (
+            breaker if breaker is not None
+            else CircuitBreaker(endpoint=self.base_url)
+        )
+        if metrics is None:
+            from geomesa_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self.metrics.gauge("remote_journal.healthy").set(1.0)
         self._partitions: int | None = None
         self._stop = threading.Event()
         self._tailers: list[threading.Thread] = []
         # last transport error seen by any tailer (None = healthy); a 4xx
         # stops that tail — see subscribe()
         self.last_error: Exception | None = None
+        # retry-exhausted rounds since the last good poll (mirrored in the
+        # consecutive_failures gauge); several topic tailers share it, so
+        # the read-modify-write is guarded (leaf lock, metrics tier)
+        self._health_lock = threading.Lock()
+        self.consecutive_failures = 0
 
     # -- plumbing ------------------------------------------------------------
     def _url(self, topic: str, op: str) -> str:
@@ -52,11 +84,14 @@ class RemoteJournal:
                 f"{urllib.parse.quote(topic, safe='')}/{op}")
 
     def _get(self, topic: str, op: str, **params) -> dict:
-        url = self._url(topic, op)
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-            return json.loads(r.read())
+        # map_errors=False: subscribe() classifies raw HTTPError codes
+        # (4xx = misconfiguration stops the tail) — don't pre-map them
+        raw = rhttp.request(
+            "GET", self._url(topic, op), params=params or None,
+            timeout_s=self.timeout_s, retry=self.retry,
+            breaker=self.breaker, idempotent=True, map_errors=False,
+        )
+        return json.loads(raw)
 
     # -- MessageBus surface --------------------------------------------------
     @property
@@ -71,17 +106,19 @@ class RemoteJournal:
 
     def publish(self, topic: str, key: str, data: bytes,
                 barrier: bool = False) -> None:
-        req = urllib.request.Request(
-            self._url(topic, "publish"),
-            data=json.dumps({
+        # a MUTATION: idempotent=False retries only connect-before-send
+        # failures (replaying a publish the broker already appended would
+        # duplicate the record)
+        rhttp.request(
+            "POST", self._url(topic, "publish"),
+            body={
                 "key": key,
                 "data_b64": base64.b64encode(data).decode(),
                 "barrier": barrier,
-            }).encode(),
-            headers={"Content-Type": "application/json"},
+            },
+            timeout_s=self.timeout_s, retry=self.retry,
+            breaker=self.breaker, idempotent=False, map_errors=False,
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            r.read()
 
     def poll(self, topic: str, partition: int, offset: int,
              max_n: int = 256) -> list[bytes]:
@@ -118,27 +155,56 @@ class RemoteJournal:
         Transport failures are NOT silently absorbed: a configuration
         error (HTTP 4xx — e.g. the server has no journal attached) stops
         the tail immediately, and any transport error is recorded on
-        ``self.last_error``; ``healthy()`` is the liveness signal.
-        Transient 5xx/connection errors keep retrying."""
+        ``self.last_error`` AND in metrics
+        (``remote_journal.consecutive_failures`` gauge /
+        ``remote_journal.transient_errors`` counter); ``healthy()`` is
+        the liveness signal. Transient 5xx/connection errors keep
+        retrying with the policy's decorrelated-jitter backoff between
+        rounds (each round already retried ``retry.max_attempts`` times
+        inside the transport)."""
+
+        def _note_failure(e: Exception) -> int:
+            with self._health_lock:
+                self.last_error = e
+                self.consecutive_failures += 1
+                n = self.consecutive_failures
+            self.metrics.counter("remote_journal.transient_errors").inc()
+            self.metrics.gauge("remote_journal.consecutive_failures").set(
+                float(n))
+            self.metrics.gauge("remote_journal.healthy").set(0.0)
+            return n
 
         def _tail() -> None:
             import urllib.error
 
             cursor = 0
+            delay: float | None = None
             while not self._stop.is_set():
                 try:
                     batch, cursor = self.total_poll_cursor(topic, cursor)
-                    self.last_error = None
+                    with self._health_lock:
+                        self.last_error = None
+                        self.consecutive_failures = 0
+                    self.metrics.gauge(
+                        "remote_journal.consecutive_failures").set(0.0)
+                    self.metrics.gauge("remote_journal.healthy").set(1.0)
+                    delay = None
                 except urllib.error.HTTPError as e:
                     # 4xx = misconfiguration (wrong server, no journal):
                     # retrying forever would just look like an idle stream
-                    self.last_error = e
+                    _note_failure(e)
                     if 400 <= e.code < 500:
                         return
-                    batch = []
-                except OSError as e:
-                    self.last_error = e  # transient: keep tailing
-                    batch = []
+                    delay = self.retry.next_delay(delay)
+                    self._stop.wait(delay)
+                    continue
+                except (OSError, ValueError) as e:
+                    # transient transport trouble (incl. an open breaker)
+                    # or a torn/garbage JSON body: back off, keep tailing
+                    _note_failure(e)
+                    delay = self.retry.next_delay(delay)
+                    self._stop.wait(delay)
+                    continue
                 if not batch:
                     self._stop.wait(self.poll_interval_s)
                     continue

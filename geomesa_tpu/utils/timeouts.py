@@ -12,12 +12,54 @@ deadline passes (the worker's result is discarded when it eventually lands).
 from __future__ import annotations
 
 import threading
+import time
 
-__all__ = ["QueryTimeout", "run_with_timeout", "Watchdog"]
+__all__ = ["Deadline", "QueryTimeout", "run_with_timeout", "Watchdog"]
 
 
 class QueryTimeout(TimeoutError):
     pass
+
+
+class Deadline:
+    """An absolute point on the MONOTONIC clock a query must finish by.
+
+    The end-to-end timeout unit of the federation stack: a caller makes
+    one ``Deadline.after(2.0)`` and every hop — local scan workers
+    (``Query.hints["deadline"]``), remote calls
+    (:mod:`geomesa_tpu.resilience.http` ships the remaining budget as the
+    ``X-Geomesa-Deadline-Ms`` header), and the web layer's shed check —
+    measures against the SAME budget, so three 1-second hops under a
+    2-second deadline fail at 2 seconds, not 3. Crossing the wire as
+    *remaining milliseconds* (not a wall-clock timestamp) means hosts
+    never need synchronized clocks; each hop re-anchors the budget on its
+    own monotonic clock, losing only the (unmeasured) network transit.
+    """
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: float):
+        self.expires_at = expires_at  # time.monotonic() seconds
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(time.monotonic() + ms / 1000.0)
+
+    def remaining_s(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return f"Deadline(remaining={self.remaining_s():.3f}s)"
 
 
 _abandoned_lock = threading.Lock()
@@ -72,8 +114,17 @@ def run_with_timeout(fn, timeout_s: float | None, *args, **kwargs):
                 state["timed_out"] = True
                 _abandoned_running += 1
         if state["timed_out"]:
-            raise QueryTimeout(f"query exceeded timeout of {timeout_s}s") from None
+            e = QueryTimeout(f"query exceeded timeout of {timeout_s}s")
+            # THIS wrapper's worker is still running: nested wrappers
+            # (web request → store scan) use the marker so one blown
+            # deadline counts ONE abandoned entity, not one per level
+            e.worker_abandoned = True
+            raise e from None
     if box[1] is not None:
+        if isinstance(box[1], QueryTimeout):
+            # our worker finished; the timeout happened DEEPER (an inner
+            # wrapper or a shed) and was already accounted there
+            box[1].worker_abandoned = False
         raise box[1]
     return box[0]
 

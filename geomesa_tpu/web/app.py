@@ -52,6 +52,7 @@ import numpy as np
 
 from geomesa_tpu import obs
 from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.utils.timeouts import QueryTimeout as _QueryTimeout
 
 __all__ = ["GeoMesaApp", "serve"]
 
@@ -72,6 +73,7 @@ _STATUS = {
     404: "404 Not Found",
     405: "405 Method Not Allowed",
     500: "500 Internal Server Error",
+    504: "504 Gateway Timeout",
 }
 
 
@@ -160,10 +162,26 @@ class GeoMesaApp:
         params = {
             k: v[0] for k, v in parse_qs(environ.get("QUERY_STRING", "")).items()
         }
-        # reserved key: only the provider may set it — never the client
+        # reserved keys: only the server may set them — never the client
         params.pop("__auths__", None)
+        params.pop("__deadline__", None)
         if self.auth_provider is not None:
             params["__auths__"] = self.auth_provider.auths(environ)
+        # deadline propagation (X-Geomesa-Deadline-Ms): the caller's
+        # REMAINING budget in ms, re-anchored on this host's monotonic
+        # clock — see geomesa_tpu.resilience.http / docs/resilience.md
+        hdr = environ.get("HTTP_X_GEOMESA_DEADLINE_MS")
+        if hdr is not None:
+            from geomesa_tpu.utils.timeouts import Deadline
+
+            try:
+                params["__deadline__"] = Deadline.after_ms(float(hdr))
+            except ValueError:
+                return self._respond(
+                    start_response, 400,
+                    {"error": f"bad X-Geomesa-Deadline-Ms header: {hdr!r}"},
+                    "application/json",
+                )
         # per-request metrics (the servlet AggregatedMetricsFilter role):
         # counter per route pattern + total, into the store's registry so
         # /api/metrics reports request rates alongside store counters
@@ -195,12 +213,12 @@ class GeoMesaApp:
                                     f"web.requests.{handler.__name__.lstrip('_')}"
                                 ).inc()
                                 with metrics.timer("web.request_ms").time():
-                                    status, payload, ctype = handler(
-                                        *match.groups(), params=params, body=body
+                                    status, payload, ctype = self._run_handler(
+                                        handler, match.groups(), params, body
                                     )
                             else:
-                                status, payload, ctype = handler(
-                                    *match.groups(), params=params, body=body
+                                status, payload, ctype = self._run_handler(
+                                    handler, match.groups(), params, body
                                 )
                         return self._respond(start_response, status, payload, ctype)
             raise _HttpError(405 if matched_path else 404,
@@ -208,6 +226,13 @@ class GeoMesaApp:
         except _HttpError as e:
             return self._respond(
                 start_response, e.status, {"error": e.message}, "application/json"
+            )
+        except _QueryTimeout as e:
+            # a spent/blown deadline — shed before work or expired during
+            # it — answers 504 so the caller's client maps it back to its
+            # own QueryTimeout (the end-to-end timeout contract)
+            return self._respond(
+                start_response, 504, {"error": str(e)}, "application/json"
             )
         except KeyError as e:
             return self._respond(
@@ -221,6 +246,50 @@ class GeoMesaApp:
             return self._respond(
                 start_response, 400, {"error": str(e)}, "application/json"
             )
+
+    def _run_handler(self, handler, groups, params, body):
+        """Dispatch one matched route under the request's deadline.
+
+        No deadline: a plain call (zero overhead). With one: work whose
+        budget is already spent is shed with 504 BEFORE the handler runs
+        (no scan, no device work); otherwise the handler runs under
+        :func:`run_with_timeout` registered with the store's Watchdog, so
+        a blown budget abandons the worker thread, counts it, and still
+        answers 504 — the ThreadManagement posture applied per hop."""
+        deadline = params.get("__deadline__")
+        if deadline is None:
+            return handler(*groups, params=params, body=body)
+        from geomesa_tpu.utils.timeouts import run_with_timeout
+
+        metrics = getattr(self.store, "metrics", None)
+        rem_s = deadline.remaining_s()
+        if rem_s <= 0:
+            if metrics is not None:
+                metrics.counter("web.deadline.shed").inc()
+            raise _QueryTimeout("deadline spent before processing began")
+        wd = getattr(self.store, "watchdog", None)
+        token = None
+        if wd is not None:
+            token = wd.register(
+                f"http {handler.__name__.lstrip('_')} "
+                f"(deadline {rem_s * 1000:.0f}ms)")
+        abandoned = False
+        try:
+            return run_with_timeout(
+                handler, rem_s, *groups, params=params, body=body)
+        except _QueryTimeout as e:
+            # only count THIS request abandoned when OUR worker is the
+            # one still running — a store scan that already shed/expired
+            # (and counted itself) re-raises with the marker cleared
+            abandoned = getattr(e, "worker_abandoned", True)
+            if metrics is not None:
+                metrics.counter("web.deadline.expired").inc()
+            raise
+        finally:
+            # finally: a handler error (404/400/403) must release the
+            # registration too, not leak it in the active set forever
+            if token is not None:
+                wd.complete(token, timed_out=abandoned)
 
     def _respond(self, start_response, status, payload, ctype):
         if isinstance(payload, (dict, list)):
@@ -534,6 +603,11 @@ class GeoMesaApp:
 
     def _parse_query(self, params) -> Query:
         hints = {}
+        if params.get("__deadline__") is not None:
+            # the store's own scan honors the remaining budget too: it
+            # sheds before device work when the budget is gone and caps
+            # its watchdog timeout at the remaining time
+            hints["deadline"] = params["__deadline__"]
         limit = self._int_param(params, "limit")
         props = params["properties"].split(",") if params.get("properties") else None
         sort_by = None

@@ -33,3 +33,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
     tests/test_concurrency.py tests/test_locks.py -q
+
+# chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
+# spec exported — deterministic tests pin their own (empty) injector and
+# must be unperturbed, while the chaos-smoke tests adopt the ambient 30%
+# 5xx + latency and must still answer every federated query. See
+# docs/resilience.md.
+GEOMESA_TPU_FAULTS="kind=http,status=503,rate=0.3,seed=11,match=/api/;kind=latency,ms=2,rate=0.2,seed=12,match=/api/" \
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q

@@ -125,7 +125,7 @@ class TestRemoteSelectManyAuths:
         remote = self._remote()
         seen = {}
 
-        def fake_send(method, path, body=None, params=None, headers=None):
+        def fake_send(method, path, body=None, params=None, headers=None, **kw):
             seen["headers"] = headers
             return {"results": []}
 
@@ -138,7 +138,7 @@ class TestRemoteSelectManyAuths:
         remote = self._remote()
         seen = {}
 
-        def fake_send(method, path, body=None, params=None, headers=None):
+        def fake_send(method, path, body=None, params=None, headers=None, **kw):
             seen["headers"] = headers
             return {"results": []}
 
@@ -152,7 +152,7 @@ class TestRemoteSelectManyAuths:
         remote = self._remote(header=None)
         seen = {}
 
-        def fake_send(method, path, body=None, params=None, headers=None):
+        def fake_send(method, path, body=None, params=None, headers=None, **kw):
             seen["headers"] = headers
             return {"results": []}
 

@@ -1,0 +1,897 @@
+"""Federation resilience layer (docs/resilience.md): retry policies,
+circuit breakers, fault injection, deadline propagation, and
+partial-result degradation across the remote/federation stack.
+
+Doubles as the CI chaos smoke gate: scripts/lint.sh re-runs this file
+with GEOMESA_TPU_FAULTS set — every test here pins its own injector
+(the autouse fixture installs an EMPTY one, overriding the ambient env
+spec), except the chaos tests, which adopt the ambient spec when one is
+present and must still pass under it."""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience import faults as rfaults
+from geomesa_tpu.resilience import http as rhttp
+from geomesa_tpu.resilience.faults import FaultInjector, from_spec
+from geomesa_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpenError,
+    CorruptPayloadError,
+    RetryPolicy,
+    retryable,
+)
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.merged import MergedDataStoreView
+from geomesa_tpu.store.remote import RemoteDataStore
+from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
+
+T0 = 1_500_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """Every test starts with a pinned EMPTY injector (deterministic
+    transport even when the chaos gate exports GEOMESA_TPU_FAULTS) and
+    leaves the process-wide install state untouched."""
+    rfaults.install(FaultInjector())
+    yield
+    rfaults.uninstall()
+
+
+def _http_error(code=503):
+    return urllib.error.HTTPError(
+        "http://x", code, "boom", None, io.BytesIO(b"{}"))
+
+
+def _refused():
+    return urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+
+
+def _filled_store(lo=-170.0, hi=170.0, seed=1, n=400, name="f"):
+    rng = np.random.default_rng(seed)
+    ds = DataStore(backend="tpu")
+    ds.create_schema(name, "name:String,dtg:Date,*geom:Point")
+    ds.write(name, [
+        {"name": f"n{i % 9}", "dtg": T0 + i * 1000,
+         "geom": Point(float(rng.uniform(lo, hi)),
+                       float(rng.uniform(-40, 40)))}
+        for i in range(n)
+    ], fids=[f"{seed}-{i}" for i in range(n)])
+    return ds
+
+
+@pytest.fixture(scope="module")
+def remote_server(tmp_path_factory):
+    """One real HTTP server over a real store (module-scoped; tests pick
+    their fault rules per-test, so sharing the server is safe)."""
+    from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+    from geomesa_tpu.stream.journal import JournalBus
+    from geomesa_tpu.web.app import GeoMesaApp
+
+    class _Quiet(WSGIRequestHandler):
+        def log_message(self, *a):  # keep fault-heavy runs readable
+            pass
+
+    store = _filled_store(seed=1)
+    bus = JournalBus(str(tmp_path_factory.mktemp("journal")), partitions=2)
+    httpd = make_server("127.0.0.1", 0, GeoMesaApp(store, journal=bus),
+                        handler_class=_Quiet)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield store, f"http://127.0.0.1:{port}", port
+    httpd.shutdown()
+    bus.close()
+
+
+def _fast_retry(**kw):
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("max_delay_s", 0.01)
+    kw.setdefault("seed", 1)
+    return RetryPolicy(**kw)
+
+
+class TestRetryPolicy:
+    def test_backoff_bounded_and_deterministic(self):
+        a = RetryPolicy(base_delay_s=0.05, max_delay_s=1.0, seed=9)
+        b = RetryPolicy(base_delay_s=0.05, max_delay_s=1.0, seed=9)
+        d = prev = None
+        seq_a, seq_b = [], []
+        for _ in range(8):
+            d = a.next_delay(d)
+            prev = b.next_delay(prev)
+            seq_a.append(d)
+            seq_b.append(prev)
+            assert 0.05 <= d <= 1.0
+        assert seq_a == seq_b  # same seed, same schedule
+
+    def test_idempotent_retries_5xx_then_succeeds(self):
+        sleeps = []
+        p = RetryPolicy(max_attempts=4, seed=2, sleep=sleeps.append)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise _http_error(503)
+            return "ok"
+
+        assert p.call(flaky, idempotent=True) == "ok"
+        assert calls[0] == 3 and len(sleeps) == 2
+
+    def test_mutation_does_not_retry_5xx(self):
+        p = RetryPolicy(max_attempts=4, sleep=lambda s: None)
+        calls = [0]
+
+        def failing():
+            calls[0] += 1
+            raise _http_error(500)
+
+        with pytest.raises(urllib.error.HTTPError):
+            p.call(failing, idempotent=False)
+        assert calls[0] == 1  # the server may have applied the write
+
+    def test_mutation_retries_connect_before_send(self):
+        p = RetryPolicy(max_attempts=3, seed=3, sleep=lambda s: None)
+        calls = [0]
+
+        def refused_once():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise _refused()
+            return "ok"
+
+        assert p.call(refused_once, idempotent=False) == "ok"
+        assert calls[0] == 2
+
+    def test_504_is_not_retryable(self):
+        assert not retryable(_http_error(504), idempotent=True)
+        assert retryable(_http_error(503), idempotent=True)
+
+    def test_circuit_open_is_not_retryable(self):
+        assert not retryable(CircuitOpenError("e", 1.0), idempotent=True)
+
+    def test_query_timeout_is_not_retryable(self):
+        # QueryTimeout ⊂ TimeoutError ⊂ OSError: the subclass must be
+        # carved out or spent deadlines would retry with backoff sleeps
+        assert not retryable(QueryTimeout("spent"), idempotent=True)
+        assert not retryable(QueryTimeout("spent"), idempotent=False)
+
+    def test_retry_budget_sheds_retries_when_dry(self):
+        now = [0.0]  # frozen clock: no refill
+        p = RetryPolicy(max_attempts=3, budget=2, budget_window_s=100.0,
+                        clock=lambda: now[0], sleep=lambda s: None, seed=4)
+        calls = [0]
+
+        def failing():
+            calls[0] += 1
+            raise _http_error(503)
+
+        with pytest.raises(urllib.error.HTTPError):
+            p.call(failing)  # burns both tokens (2 retries + give-up)
+        assert calls[0] == 3
+        calls[0] = 0
+        with pytest.raises(urllib.error.HTTPError):
+            p.call(failing)  # budget dry: first error surfaces
+        assert calls[0] == 1
+        now[0] = 100.0  # window elapsed: bucket refills
+        calls[0] = 0
+        with pytest.raises(urllib.error.HTTPError):
+            p.call(failing)
+        assert calls[0] == 3
+
+
+class TestCircuitBreaker:
+    def _breaker(self, t, **kw):
+        kw.setdefault("window", 10)
+        kw.setdefault("min_volume", 4)
+        kw.setdefault("failure_rate", 0.5)
+        kw.setdefault("cooldown_s", 5.0)
+        return CircuitBreaker("ep", clock=lambda: t[0], **kw)
+
+    def test_closed_to_open_at_failure_rate(self):
+        t = [0.0]
+        b = self._breaker(t)
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "closed"  # below min_volume
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError):
+            b.before_call()
+
+    def test_half_open_probe_closes_on_success(self):
+        t = [0.0]
+        b = self._breaker(t)
+        for _ in range(4):
+            b.record_failure()
+        t[0] = 6.0  # cooldown passed
+        assert b.state == "half_open"
+        b.before_call()  # the probe slot
+        with pytest.raises(CircuitOpenError):
+            b.before_call()  # only `probes` trial calls go through
+        b.record_success()
+        assert b.state == "closed"
+        b.before_call()  # healthy again
+
+    def test_half_open_probe_failure_reopens(self):
+        t = [0.0]
+        b = self._breaker(t)
+        for _ in range(4):
+            b.record_failure()
+        t[0] = 6.0
+        b.before_call()
+        b.record_failure()
+        assert b.state == "open" and b.open_count == 2
+        t[0] = 7.0  # cooldown restarted at t=6: still open
+        with pytest.raises(CircuitOpenError):
+            b.before_call()
+
+    def test_stale_completion_is_not_a_probe_outcome(self):
+        # a slow call issued BEFORE the trip, completing during half-open,
+        # must neither close the breaker nor restart the cooldown
+        t = [0.0]
+        b = self._breaker(t)
+        for _ in range(4):
+            b.record_failure()
+        t[0] = 6.0
+        assert b.state == "half_open"
+        b.record_success()  # stale: no probe in flight
+        assert b.state == "half_open"
+        b.record_failure()  # stale failure: cooldown must NOT restart
+        assert b.state == "half_open"
+        b.before_call()  # the real probe
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_mixed_traffic_below_rate_stays_closed(self):
+        t = [0.0]
+        b = self._breaker(t, failure_rate=0.75)
+        for i in range(30):
+            b.record(i % 2 == 0)  # ≤60% failures in any window < 75%
+        assert b.state == "closed"
+
+
+class TestFaultSpec:
+    def test_spec_round_trip(self):
+        inj = from_spec(
+            "kind=http,status=502,rate=0.25,seed=3,match=:9,times=5;"
+            "kind=latency,ms=7;kind=truncate,at=16,match=/query")
+        kinds = [r.kind for r in inj.rules]
+        assert kinds == ["http", "latency", "truncate"]
+        r = inj.rules[0]
+        assert (r.status, r.rate, r.times, r.match) == (502, 0.25, 5, ":9")
+        assert inj.rules[2].truncate_at == 16
+
+    def test_spec_errors(self):
+        with pytest.raises(ValueError):
+            from_spec("kind=nope")
+        with pytest.raises(ValueError):
+            from_spec("rate=0.5")  # missing kind
+        with pytest.raises(ValueError):
+            from_spec("kind=http,bogus=1")
+
+    def test_seeded_schedule_is_deterministic(self):
+        def pattern():
+            inj = FaultInjector().rule("http", rate=0.3, seed=11)
+            out = []
+            for _ in range(50):
+                try:
+                    inj.before_send("GET", "http://h/x")
+                    out.append(0)
+                except urllib.error.HTTPError:
+                    out.append(1)
+            return out
+
+        p1, p2 = pattern(), pattern()
+        assert p1 == p2 and 5 < sum(p1) < 25
+
+    def test_after_and_times_bound_the_schedule(self):
+        inj = FaultInjector().rule("refuse", after=2, times=3)
+        outcomes = []
+        for _ in range(8):
+            try:
+                inj.before_send("GET", "http://h/x")
+                outcomes.append(0)
+            except urllib.error.URLError:
+                outcomes.append(1)
+        assert outcomes == [0, 0, 1, 1, 1, 0, 0, 0]
+
+    def test_install_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("GEOMESA_TPU_FAULTS", "kind=refuse")
+        assert rfaults.active() is not None
+        assert rfaults.active().rules == []  # autouse EMPTY override wins
+        rfaults.uninstall()
+        amb = rfaults.active()
+        assert amb is not None and amb.rules[0].kind == "refuse"
+
+
+class TestErrorMapping:
+    """Satellite: _get must map HTTPError exactly like _send — reads
+    against a missing type raise KeyError, not raw HTTPError."""
+
+    def test_reads_raise_local_exception_types(self, remote_server):
+        _, url, _ = remote_server
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        with pytest.raises(KeyError):
+            remote.get_schema("no-such-type")
+        with pytest.raises(KeyError):
+            remote.query("no-such-type", "INCLUDE")
+        with pytest.raises(KeyError):
+            remote.stats_count("no-such-type")
+
+    def test_bad_cql_maps_to_value_error(self, remote_server):
+        _, url, _ = remote_server
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        with pytest.raises(ValueError):
+            remote.query("f", "THIS IS NOT CQL ???")
+
+
+class TestRetryIntegration:
+    def test_read_survives_transient_refusals(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("refuse", times=2, match=f":{port}")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=4))
+        with inj.activate():
+            r = remote.query("f", "name = 'n3'")
+        assert r.count > 0
+        assert inj.counts()[0][2] == 2  # both injected faults were eaten
+
+    def test_read_survives_transient_5xx(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("http", status=503, times=2,
+                                   match=f":{port}")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=4))
+        with inj.activate():
+            assert remote.stats_count("f", exact=True) == 400
+
+    def test_mutation_fails_fast_on_5xx(self, remote_server):
+        _, url, port = remote_server
+        # scoped to the WRITE path: the schema prefetch is a read and may
+        # legitimately retry
+        inj = FaultInjector().rule("http", status=500,
+                                   match=f":{port}/api/schemas/f/features")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=4))
+        remote.get_schema("f")
+        with inj.activate():
+            with pytest.raises(urllib.error.HTTPError):
+                remote.write("f", [{"name": "x", "dtg": T0,
+                                    "geom": Point(0.0, 0.0)}])
+        assert inj.counts()[0][1] == 1  # exactly one attempt: no replay
+
+    def test_mutation_retries_refused_connection(self, remote_server):
+        local, url, port = remote_server
+        before = local.stats_count("f", exact=True)
+        inj = FaultInjector().rule("refuse", times=1, match=f":{port}")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=3))
+        with inj.activate():
+            n = remote.write("f", [{"name": "x", "dtg": T0,
+                                    "geom": Point(0.0, 0.0)}],
+                             fids=["retry-w-0"])
+        assert n == 1
+        assert local.stats_count("f", exact=True) == before + 1
+
+
+class TestCorruptPayload:
+    """Satellite: truncated/corrupt Arrow from a member is a TYPED error,
+    and partial mode degrades on it instead of failing the federation."""
+
+    def test_truncated_arrow_raises_typed_error(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("truncate", truncate_at=20,
+                                   match=f":{port}/api/schemas/f/query")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        with inj.activate():
+            with pytest.raises(CorruptPayloadError) as ei:
+                remote.query("f", "INCLUDE")
+        assert "Arrow" in str(ei.value) and url in str(ei.value)
+
+    def test_corrupt_arrow_raises_typed_error(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("corrupt",
+                                   match=f":{port}/api/schemas/f/query")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        with inj.activate():
+            with pytest.raises(CorruptPayloadError):
+                remote.query("f", "INCLUDE")
+
+    def test_truncated_json_raises_typed_error(self, remote_server):
+        # JSON endpoints get the same typed treatment as Arrow ones
+        _, url, port = remote_server
+        inj = FaultInjector().rule("truncate", truncate_at=5,
+                                   match=f":{port}/api/schemas/f/stats")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        with inj.activate():
+            with pytest.raises(CorruptPayloadError) as ei:
+                remote.stats_count("f")
+        assert "JSON" in str(ei.value)
+
+    def test_partial_mode_degrades_on_corrupt_member(self, remote_server):
+        _, url, port = remote_server
+        east = _filled_store(seed=2, n=150)
+        inj = FaultInjector().rule("truncate", truncate_at=20,
+                                   match=f":{port}/api/schemas/f/query")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        view = MergedDataStoreView([remote, east], on_member_error="partial")
+        with inj.activate():
+            with obs.collect("probe") as root:
+                r = view.query("f", "INCLUDE")
+        assert r.degraded
+        assert r.count == 150  # the surviving member's rows
+        assert r.member_errors == [
+            (0, "CorruptPayloadError", r.member_errors[0][2])
+        ]
+        assert view.metrics.counter("federation.member_errors").count == 1
+        assert [e[0] for e in root.events] == ["member_error", "degraded"]
+
+    def test_fail_mode_raises_on_corrupt_member(self, remote_server):
+        _, url, port = remote_server
+        east = _filled_store(seed=2, n=150)
+        inj = FaultInjector().rule("truncate", truncate_at=20,
+                                   match=f":{port}/api/schemas/f/query")
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=1))
+        view = MergedDataStoreView([remote, east])  # default: fail
+        with inj.activate():
+            with pytest.raises(CorruptPayloadError):
+                view.query("f", "INCLUDE")
+
+
+@pytest.fixture(scope="module")
+def slow_server():
+    """A server whose store sleeps mid-query — the deadline-expiry hop."""
+    from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+    from geomesa_tpu.web.app import GeoMesaApp
+
+    class _Quiet(WSGIRequestHandler):
+        def log_message(self, *a):
+            pass
+
+    store = _filled_store(seed=5, n=120)
+
+    def slow(sft, query):
+        time.sleep(0.4)
+        return query
+
+    store.register_interceptor("f", slow)
+    httpd = make_server("127.0.0.1", 0, GeoMesaApp(store),
+                        handler_class=_Quiet)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield store, f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+class TestDeadline:
+    def test_deadline_basics(self):
+        d = Deadline.after_ms(50)
+        assert 0 < d.remaining_ms() <= 50
+        assert not d.expired()
+        d2 = Deadline.after(-1)
+        assert d2.expired() and d2.remaining_s() < 0
+
+    def test_expired_deadline_sheds_before_sending(self):
+        # dead port: a connect attempt would raise URLError, but the
+        # pre-send shed must win — QueryTimeout without a round trip
+        remote = RemoteDataStore("http://127.0.0.1:9",
+                                 retry=_fast_retry(max_attempts=1))
+        q = Query(filter=None, hints={"deadline": Deadline.after(-1)})
+        with pytest.raises(QueryTimeout):
+            remote.query("f", q)
+
+    def test_local_store_sheds_expired_deadline(self):
+        ds = _filled_store(seed=7, n=60)
+        with pytest.raises(QueryTimeout):
+            ds.query("f", Query(hints={"deadline": Deadline.after(-1)}))
+        assert ds.metrics.counter("store.query.deadline_shed").count == 1
+
+    def test_server_sheds_spent_budget_with_504(self, remote_server):
+        _, url, _ = remote_server
+        req = urllib.request.Request(
+            url + "/api/schemas/f/query?format=arrow",
+            headers={"X-Geomesa-Deadline-Ms": "0"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 504
+        assert "deadline" in json.loads(ei.value.read().decode())["error"]
+
+    def test_deadline_request_error_releases_watchdog(self, remote_server):
+        # a 404 on a deadline-carrying request must release the watchdog
+        # registration (not leak it in the active set forever)
+        store, url, _ = remote_server
+        req = urllib.request.Request(
+            url + "/api/schemas/no-such-type/query",
+            headers={"X-Geomesa-Deadline-Ms": "5000"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 404
+        assert not [a for a in store.watchdog.active()
+                    if a.startswith("http ")]
+
+    def test_bad_deadline_header_is_400(self, remote_server):
+        _, url, _ = remote_server
+        req = urllib.request.Request(
+            url + "/api/schemas/f/query",
+            headers={"X-Geomesa-Deadline-Ms": "soon"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+    def test_deadline_shed_does_not_consume_half_open_probe(self):
+        # a shed records no breaker outcome, so it must not eat the
+        # half-open probe slot (that would wedge the breaker half-open)
+        t = [0.0]
+        b = CircuitBreaker("ep", min_volume=2, cooldown_s=5.0,
+                           clock=lambda: t[0])
+        b.record_failure()
+        b.record_failure()
+        t[0] = 6.0
+        assert b.state == "half_open"
+        with pytest.raises(QueryTimeout):
+            rhttp.request("GET", "http://127.0.0.1:9/x", breaker=b,
+                          deadline=Deadline.after(-1))
+        b.before_call()  # the probe slot is still there
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_two_hop_deadline_expires_at_remote(self, slow_server):
+        """Satellite: federated query with a 2-hop budget expires AT the
+        remote (504), the client maps it to QueryTimeout, and the
+        abandoned-worker gauge drains back to zero."""
+        from geomesa_tpu.utils import timeouts as uto
+
+        store, url = slow_server
+        remote = RemoteDataStore(url, retry=_fast_retry(max_attempts=2))
+        east = _filled_store(seed=6, n=60)
+        view = MergedDataStoreView([remote, east])  # fail mode: surfaces
+        abandoned_before = store.watchdog.abandoned
+        q = Query(filter=None, hints={"deadline": Deadline.after_ms(150)})
+        t0 = time.perf_counter()
+        with pytest.raises(QueryTimeout):
+            view.query("f", q)
+        # enforced at ~150ms, far before the 400ms sleep completes
+        assert time.perf_counter() - t0 < 0.35
+        # exactly ONE abandoned entity per blown request — the nested
+        # web-request/store-scan wrappers must not double-count
+        assert store.watchdog.abandoned == abandoned_before + 1
+        assert store.metrics.counter("web.deadline.expired").count >= 1
+        deadline = time.monotonic() + 5.0
+        while uto.abandoned_running() and time.monotonic() < deadline:
+            time.sleep(0.02)  # the abandoned worker finishes its sleep
+        assert uto.abandoned_running() == 0
+
+    def test_partial_mode_degrades_on_slow_member_timeout(self, slow_server):
+        # the slow member blows its own SOCKET timeout (no shared
+        # deadline: the healthy member must keep its full budget) and the
+        # federation serves the survivor
+        _, url = slow_server
+        remote = RemoteDataStore(url, timeout_s=0.1,
+                                 retry=_fast_retry(max_attempts=1))
+        east = _filled_store(seed=6, n=60)
+        view = MergedDataStoreView([remote, east],
+                                   on_member_error="partial")
+        r = view.query("f", "INCLUDE")
+        assert r.degraded and r.count == 60
+        assert r.member_errors[0][0] == 0
+
+
+class TestPartialFederation:
+    """The acceptance scenario: 30% 5xx on one of three members."""
+
+    def _view(self, url, port, mode, times=None, rate=0.3):
+        inj = FaultInjector().rule(
+            "http", status=503, rate=rate, seed=13, times=times,
+            match=f":{port}")
+        flaky = RemoteDataStore(
+            url,
+            # no client-side retries: every injected 5xx must reach the
+            # federation layer (and the breaker) undampened
+            retry=_fast_retry(max_attempts=1),
+            breaker=CircuitBreaker(endpoint=f":{port}", window=10,
+                                   min_volume=4, failure_rate=0.25,
+                                   cooldown_s=0.15),
+        )
+        view = MergedDataStoreView(
+            [flaky, _filled_store(seed=3, n=200), _filled_store(seed=4, n=200)],
+            on_member_error=mode,
+        )
+        return view, flaky, inj
+
+    def test_partial_answers_every_query_and_breaker_cycles(
+            self, remote_server):
+        _, url, port = remote_server
+        view, flaky, inj = self._view(url, port, "partial", times=30)
+        degraded = 0
+        with inj.activate():
+            for _ in range(40):
+                r = view.query("f", "name = 'n1'")
+                assert r.count >= 0  # every query answers
+                degraded += int(r.degraded)
+        assert degraded >= 1  # failures surfaced as partials, not errors
+        assert flaky.breaker.open_count >= 1  # opened after threshold
+        # the member recovers (no more faults): after the cooldown the
+        # half-open probe succeeds, the breaker closes, answers are
+        # complete again
+        time.sleep(0.2)
+        r = view.query("f", "name = 'n1'")
+        assert flaky.breaker.state == "closed"
+        assert not r.degraded
+        assert view.metrics.counter("federation.degraded_queries").count >= 1
+
+    def test_open_breaker_skips_member_fast(self, remote_server):
+        _, url, port = remote_server
+        view, flaky, inj = self._view(url, port, "partial", rate=1.0)
+        with inj.activate():
+            for _ in range(6):
+                view.query("f", "name = 'n1'")
+        assert flaky.breaker.state == "open"
+        # breaker open: the member is skipped WITHOUT a round trip
+        seen_before = sum(s for _, s, _ in inj.counts())
+        with inj.activate():
+            r = view.query("f", "name = 'n1'")
+        assert r.degraded
+        assert r.member_errors[0][1] == "CircuitOpenError"
+        assert sum(s for _, s, _ in inj.counts()) == seen_before
+
+    def test_fail_mode_raises(self, remote_server):
+        _, url, port = remote_server
+        view, _, inj = self._view(url, port, "fail", rate=1.0)
+        with inj.activate():
+            with pytest.raises(urllib.error.HTTPError):
+                view.query("f", "name = 'n1'")
+
+    def test_all_members_failing_raises_even_in_partial(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("refuse", match=f":{port}")
+        view = MergedDataStoreView(
+            [RemoteDataStore(url, retry=_fast_retry(max_attempts=1))],
+            on_member_error="partial")
+        with inj.activate():
+            with pytest.raises(urllib.error.URLError):
+                view.query("f", "INCLUDE")
+
+    def test_stats_count_partial(self, remote_server):
+        _, url, port = remote_server
+        inj = FaultInjector().rule("refuse", match=f":{port}")
+        east = _filled_store(seed=3, n=200)
+        view = MergedDataStoreView(
+            [RemoteDataStore(url, retry=_fast_retry(max_attempts=1)), east],
+            on_member_error="partial")
+        with inj.activate():
+            assert view.stats_count("f", exact=True) == 200
+        assert view.metrics.counter("federation.member_errors").count == 1
+
+    def test_aggregate_many_partial_marks_degraded(self):
+        # stub members: one hard-down, one answering with fixed partials —
+        # the view must merge the survivor and mark the result degraded
+        base = _filled_store(seed=3, n=10)
+
+        class Down:
+            def get_schema(self, name):
+                return base.get_schema(name)
+
+            def aggregate_many(self, *a, **kw):
+                raise ConnectionError("member down")
+
+        class Up:
+            def get_schema(self, name):
+                return base.get_schema(name)
+
+            def aggregate_many(self, type_name, queries, group_by=None,
+                               value_cols=(), now_ms=None):
+                return [{
+                    "groups": [("a",), ("b",)],
+                    "count": np.asarray([3, 4], dtype=np.int64),
+                    "cols": {},
+                } for _ in queries]
+
+        view = MergedDataStoreView([Down(), Up()], on_member_error="partial")
+        out = view.aggregate_many("f", ["INCLUDE"], group_by=["name"])
+        assert out[0]["degraded"] is True
+        assert out[0]["member_errors"][0][1] == "ConnectionError"
+        assert int(out[0]["count"].sum()) == 7
+        assert view.metrics.counter("federation.member_errors").count == 1
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            MergedDataStoreView([_filled_store(n=10)], on_member_error="eh")
+
+
+class TestRoutedFallback:
+    def _stores(self):
+        a = _filled_store(seed=8, n=80)
+        b = _filled_store(seed=9, n=80)
+
+        class Flaky:
+            """Member-failure facade over a real store."""
+
+            def __init__(self, ds):
+                self.ds = ds
+                self.calls = 0
+
+            def get_schema(self, name):
+                return self.ds.get_schema(name)
+
+            def list_schemas(self):
+                return self.ds.list_schemas()
+
+            def query(self, *a, **kw):
+                self.calls += 1
+                raise ConnectionError("member down")
+
+            def stats_count(self, *a, **kw):
+                self.calls += 1
+                raise ConnectionError("member down")
+
+        return Flaky(a), b
+
+    def test_fallback_to_include_store(self):
+        from geomesa_tpu.store.routed import RoutedDataStoreView
+
+        flaky, include = self._stores()
+        view = RoutedDataStoreView(
+            [(flaky, [["name"]]), (include, [[]])],
+            on_member_error="fallback")
+        r = view.query("f", "name = 'n1'")
+        assert flaky.calls == 1 and r.count > 0
+        assert view.metrics.counter("federation.route_fallbacks").count == 1
+
+    def test_fail_mode_propagates(self):
+        from geomesa_tpu.store.routed import RoutedDataStoreView
+
+        flaky, include = self._stores()
+        view = RoutedDataStoreView([(flaky, [["name"]]), (include, [[]])])
+        with pytest.raises(ConnectionError):
+            view.query("f", "name = 'n1'")
+
+
+class TestJournalResilience:
+    """Satellite: the remote journal tailer backs off with the policy
+    (no fixed sleep) and surfaces health through utils/metrics."""
+
+    def test_tailer_backs_off_and_recovers(self, remote_server):
+        from geomesa_tpu.stream.remote_journal import RemoteJournal
+
+        _, url, port = remote_server
+        inj = FaultInjector().rule("refuse", times=4,
+                                   match=f":{port}/api/journal")
+        got: list[bytes] = []
+        rj = RemoteJournal(
+            url, poll_interval_s=0.02,
+            retry=_fast_retry(max_attempts=1),  # every refusal hits the loop
+            breaker=CircuitBreaker(endpoint=f":{port}", min_volume=10_000),
+        )
+        with inj.activate():
+            rj.subscribe("t-resil", got.append)
+            deadline = time.monotonic() + 5.0
+            while (rj.metrics.counter(
+                    "remote_journal.transient_errors").count < 4
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            rj.publish("t-resil", "k", b"after-the-storm")
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+        try:
+            assert got == [b"after-the-storm"]
+            m = rj.metrics
+            assert m.counter("remote_journal.transient_errors").count >= 4
+            assert m.gauge("remote_journal.consecutive_failures").value == 0.0
+            assert m.gauge("remote_journal.healthy").value == 1.0
+            assert rj.healthy()
+        finally:
+            rj.close()
+
+
+class TestChaosSmoke:
+    """Runs MEANINGFULLY under the lint.sh chaos gate: when
+    GEOMESA_TPU_FAULTS is exported these tests adopt the ambient spec
+    (plus a port-scoped default otherwise) and must still answer."""
+
+    def _ambient_or(self, default: FaultInjector) -> FaultInjector:
+        rfaults.uninstall()  # drop the autouse empty override
+        return rfaults.from_env() or default
+
+    def test_partial_federation_answers_under_ambient_chaos(
+            self, remote_server):
+        _, url, port = remote_server
+        inj = self._ambient_or(
+            FaultInjector()
+            .rule("http", status=503, rate=0.3, seed=21, match=f":{port}")
+            .rule("latency", latency_ms=2.0, rate=0.2, seed=22,
+                  match=f":{port}"))
+        view = MergedDataStoreView(
+            [RemoteDataStore(url, retry=_fast_retry(max_attempts=4)),
+             _filled_store(seed=3, n=200)],
+            on_member_error="partial")
+        with inj.activate():
+            for i in range(25):
+                r = view.query("f", f"name = 'n{i % 9}'")
+                assert r.count >= 0  # answered, degraded or not
+        assert True  # surviving the storm IS the assertion
+
+    def test_retries_absorb_ambient_chaos_on_single_client(
+            self, remote_server):
+        local, url, port = remote_server
+        inj = self._ambient_or(
+            FaultInjector().rule("http", status=503, rate=0.3, seed=23,
+                                 match=f":{port}"))
+        # generous attempts: ambient chaos gates may inject aggressively
+        remote = RemoteDataStore(url, retry=_fast_retry(
+            max_attempts=6, budget=10_000))
+        view = MergedDataStoreView([remote, _filled_store(seed=3, n=200)],
+                                   on_member_error="partial")
+        with inj.activate():
+            counts = [view.query("f", "name = 'n2'").count
+                      for _ in range(10)]
+        assert max(counts) == view.stores[1][0].query(
+            "f", "name = 'n2'").count + local.query("f", "name = 'n2'").count
+
+
+class TestSpanEvents:
+    def test_span_events_export_as_instant_events(self):
+        from geomesa_tpu.obs.export import chrome_trace_events
+
+        with obs.collect("probe") as root:
+            obs.event("member_error", member=2, error="URLError")
+        assert [e[0] for e in root.events] == ["member_error"]
+        evts = chrome_trace_events(root)
+        inst = [e for e in evts if e["ph"] == "i"]
+        assert len(inst) == 1 and inst[0]["name"] == "member_error"
+        assert inst[0]["args"] == {"member": 2, "error": "URLError"}
+
+    def test_event_is_noop_without_live_span(self):
+        obs.event("orphan", x=1)  # must not raise, must not record
+        assert obs.current() is None
+
+
+class TestOverhead:
+    def test_resilience_envelope_under_2pct_of_cached_select(
+            self, remote_server):
+        """Acceptance bound, measured the way the obs overhead gate is:
+        (envelope invocations per query = 1) x (no-fault envelope cost)
+        must be < 2% of the path the envelope actually rides — the
+        REMOTE cached select's own p50 (local selects never enter the
+        resilience layer)."""
+        _, url, _ = remote_server
+        remote = RemoteDataStore(url)
+        cql = "BBOX(geom, -50, -40, 50, 40)"
+        remote.query("f", cql)  # schema cache + server jit/plan warm
+        lat = []
+        for _ in range(15):
+            t0 = time.perf_counter_ns()
+            remote.query("f", cql)
+            lat.append(time.perf_counter_ns() - t0)
+        p50_ns = float(np.percentile(lat, 50))
+
+        policy = RetryPolicy()
+        breaker = CircuitBreaker("ep")
+
+        def envelope():
+            # exactly what rhttp.request adds per no-fault exchange
+            breaker.before_call()
+            rfaults.active()
+            breaker.record_success()
+            return None
+
+        def one_pass():
+            t0 = time.perf_counter_ns()
+            for _ in range(1000):
+                policy.call(envelope)
+            return (time.perf_counter_ns() - t0) / 1000.0
+
+        per_call = min(one_pass() for _ in range(3))
+        assert per_call < 0.02 * p50_ns, (
+            f"resilience envelope {per_call:.0f} ns >= 2% of remote "
+            f"cached select p50 {p50_ns:.0f} ns")
