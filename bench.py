@@ -1595,6 +1595,16 @@ def bench_chaos():
             {"kind": k, "seen": s, "fired": f} for k, s, f in inj.counts()
         ]
         chaos["breaker_opens"] = remote.breaker.open_count
+        # the new observability surfaces under chaos: the faulted member's
+        # 5-minute SLO burn rate and the flight recorder's anomaly tally
+        tk = view.slo.tracker("federation.member", key="0")
+        chaos["member0_burn_rate_5m"] = round(tk.burn_rate(300.0), 3)
+        from geomesa_tpu.obs import flight as _flight
+
+        chaos["flight_anomalies"] = sum(
+            1 for r in _flight.get().records()
+            if r.source == "federation" and r.anomalies
+        )
         inflation = (
             chaos["p99_ms"] / clean["p99_ms"] if clean["p99_ms"] else None
         )

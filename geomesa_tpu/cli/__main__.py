@@ -439,6 +439,35 @@ def cmd_compact(args):
     print(f"compacted {args.name!r}: {ds.stats_count(args.name)} rows in main tier")
 
 
+def cmd_obs_flight(args):
+    """Pull a server's query-audit flight recorder (``GET
+    /api/obs/flight``) and render it — the operator's first stop after a
+    burn-rate alert (docs/operations.md runbook)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/api/obs/flight?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    print(f"flight recorder: {doc['record_count']} recorded, "
+          f"{doc['dump_count']} anomaly dumps"
+          + (f", last dump {doc['last_dump']}" if doc.get("last_dump") else ""))
+    print(f"{'ts':>14s} {'op':<12s} {'type':<14s} {'ms':>9s} {'rows':>7s} "
+          f"{'flags':<18s} plan")
+    for rec in doc.get("records", []):
+        flags = ",".join(rec.get("anomalies") or ()) or "-"
+        members = rec.get("members") or []
+        extra = ""
+        if members:
+            bad = sum(1 for m in members if m[1] != "ok")
+            extra = f" [{len(members) - bad}/{len(members)} members ok]"
+        print(f"{rec['ts']:>14.3f} {rec['op']:<12s} {rec['type_name']:<14s} "
+              f"{rec['latency_ms']:>9.2f} {rec['rows']:>7d} {flags:<18s} "
+              f"{rec['plan'][:60]}{extra}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -600,6 +629,19 @@ def main(argv=None):
     g.add_argument("--fids", help="comma-separated feature ids")
     g.add_argument("-q", "--cql", help="delete every feature matching")
     sp.set_defaults(fn=cmd_delete_features)
+
+    sp = sub.add_parser("obs", help="observability surfaces (flight recorder)")
+    obs_sub = sp.add_subparsers(dest="obs_command", required=True)
+    fl = obs_sub.add_parser(
+        "flight", help="pull a server's query-audit flight recorder"
+    )
+    fl.add_argument("--url", required=True,
+                    help="server base URL, e.g. http://host:8080")
+    fl.add_argument("--limit", type=int, default=32)
+    fl.add_argument("--timeout", type=float, default=10.0)
+    fl.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table rendering")
+    fl.set_defaults(fn=cmd_obs_flight)
 
     args = p.parse_args(argv)
     try:

@@ -1,14 +1,20 @@
 """geomesa_tpu.obs — end-to-end query observability.
 
-Three layers (see docs/observability.md):
+Five layers (see docs/observability.md):
 
 - :mod:`~geomesa_tpu.obs.trace` — hierarchical spans with ContextVar
-  propagation and a zero-overhead no-op path when disabled.
+  propagation, a zero-overhead no-op path when disabled, and the
+  federation trace contract (``X-Geomesa-Trace`` inject/extract, span
+  subtree serialize/graft for stitched cross-process trees).
 - :mod:`~geomesa_tpu.obs.jaxmon` — JAX compile/dispatch telemetry: per-step
   jit timing, recompile counts keyed by abstract signature (live J003),
   host↔device transfer bytes.
 - :mod:`~geomesa_tpu.obs.export` — Chrome/Perfetto trace-event JSON and
   Prometheus text exposition.
+- :mod:`~geomesa_tpu.obs.flight` — the always-on query-audit flight
+  recorder (bounded ring + anomaly dumps).
+- :mod:`~geomesa_tpu.obs.slo` — SLO objectives, multi-window burn rates,
+  error-budget exposition.
 
 This package imports no jax at module level: ``GEOMESA_TPU_NO_JAX=1``
 processes (tpulint in CI) can import every instrumented module.
@@ -16,8 +22,11 @@ processes (tpulint in CI) can import every instrumented module.
 
 from geomesa_tpu.obs.trace import (  # noqa: F401 — the public obs surface
     NOOP,
+    TRACE_HEADER,
+    TRACE_RETURN_HEADER,
     Span,
     StageTimeline,
+    TraceContext,
     active,
     annotate,
     collect,
@@ -26,13 +35,20 @@ from geomesa_tpu.obs.trace import (  # noqa: F401 — the public obs surface
     enable,
     enabled,
     event,
+    extract,
     drain,
+    graft_serialized,
+    inject,
+    propagated,
     recent,
+    serialize_subtree,
     span,
 )
 
 __all__ = [
     "NOOP", "Span", "StageTimeline", "active", "annotate", "collect",
     "current", "disable", "enable", "enabled", "event", "drain", "recent",
-    "span",
+    "span", "TRACE_HEADER", "TRACE_RETURN_HEADER", "TraceContext",
+    "extract", "graft_serialized", "inject", "propagated",
+    "serialize_subtree",
 ]

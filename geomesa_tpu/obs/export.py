@@ -34,7 +34,7 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # -- Chrome / Perfetto trace-event JSON --------------------------------------
 
-def _span_event(s) -> dict:
+def _span_event(s, tid: int) -> dict:
     args = {"trace_id": s.trace_id, "span_id": s.span_id}
     for k, v in s.attrs.items():
         args[k] = v if isinstance(v, (int, float, bool, str, type(None))) else str(v)
@@ -45,36 +45,52 @@ def _span_event(s) -> dict:
         "ts": s.t0_ns / 1e3,  # microseconds
         "dur": max(s.t1_ns - s.t0_ns, 0) / 1e3,
         "pid": 1,
-        "tid": s.thread_id,
+        "tid": tid,
         "args": args,
     }
 
 
 def chrome_trace_events(roots=None) -> list[dict]:
     """Flatten span trees into trace events. ``roots=None`` exports (and
-    leaves in place) the process buffer of completed root spans."""
+    leaves in place) the process buffer of completed root spans.
+
+    Tracks are keyed by ``(trace_id, thread_id)``, NOT the raw thread id:
+    two concurrent federated queries served by the same pool thread (or a
+    grafted remote subtree whose thread ids collide with local ones) must
+    land on separate tracks, and each span's instant events must pin to
+    ITS track — raw-thread keying interleaved them (the concurrent-export
+    regression in tests/test_obs_federation.py)."""
     if roots is None:
         roots = _trace.recent()
     elif not isinstance(roots, (list, tuple)):
         roots = [roots]
     events = []
-    tids = set()
+    tracks: dict = {}  # (trace_id, thread_id) -> synthetic tid
+
+    def _tid(s) -> int:
+        key = (s.trace_id, s.thread_id)
+        tid = tracks.get(key)
+        if tid is None:
+            tid = tracks[key] = len(tracks) + 1
+        return tid
+
     for root in roots:
         for s in root.walk():
-            events.append(_span_event(s))
-            tids.add(s.thread_id)
+            tid = _tid(s)
+            events.append(_span_event(s, tid))
             for name, t_ns, attrs in list(s.events):
                 # point-in-time span markers (federation member errors,
-                # degradation) as Chrome instant events on the same track
+                # degradation) as Chrome instant events on the SPAN's track
                 events.append({
                     "name": name, "ph": "i", "s": "t", "pid": 1,
-                    "tid": s.thread_id, "ts": t_ns / 1000.0,
+                    "tid": tid, "ts": t_ns / 1000.0,
                     "args": dict(attrs),
                 })
-    for tid in sorted(tids):
+    for (trace_id, thread_id), tid in sorted(tracks.items(),
+                                             key=lambda kv: kv[1]):
         events.append({
             "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-            "args": {"name": f"thread-{tid}"},
+            "args": {"name": f"{trace_id} thread-{thread_id}"},
         })
     return events
 

@@ -1,0 +1,324 @@
+"""Always-on query-audit flight recorder.
+
+Role parity: the reference's query audit trail (``QueryAuditEndpoint`` /
+``AuditWriter``) is an always-on operational record, not an opt-in
+debugging tool. This module is that record for the federation era: a
+lock-guarded bounded ring buffer holding one :class:`QueryAuditRecord`
+per COMPLETED query — trace id, plan summary, per-member outcomes,
+degraded flag, rows, latency — cheap enough to stay on in production
+(the <2% bound on the cached-jit select path is asserted by
+``tests/test_obs_federation.py`` and gated in ``scripts/lint.sh``).
+
+Anomalies — a blown deadline, an open circuit breaker, a degraded
+(partial) result, latency above the slow threshold — additionally
+trigger a *flight dump*: one Perfetto-loadable JSON file containing the
+triggering query's full span tree plus the recent ring contents, written
+to ``dump_dir`` (``GEOMESA_TPU_FLIGHT_DIR``). Dumps are rate-limited
+(``min_dump_interval_s``) so an anomaly storm costs one file, not one
+per query. When tracing is active the dump waits for the triggering
+trace's ROOT span to complete (via :func:`trace.on_root_complete`), so
+the file holds the whole stitched federated tree, remote subtrees
+included.
+
+Surfaces: ``GET /api/obs/flight`` (:mod:`geomesa_tpu.web.app`) and
+``geomesa-tpu obs flight`` (:mod:`geomesa_tpu.cli`).
+
+Locking: one leaf lock guards the ring + pending-anomaly table (same
+tier as the metrics registry locks — docs/concurrency.md). File I/O and
+trace-tree serialization always run OUTSIDE it. No jax anywhere
+(``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+from geomesa_tpu.obs import trace as _trace
+
+__all__ = [
+    "FlightRecorder", "QueryAuditRecord", "get", "install", "record",
+]
+
+# operator knobs (read once at recorder construction)
+FLIGHT_DIR_ENV = "GEOMESA_TPU_FLIGHT_DIR"
+SLOW_MS_ENV = "GEOMESA_TPU_FLIGHT_SLOW_MS"
+
+# anomaly kinds (QueryAuditRecord.anomalies entries)
+A_DEADLINE = "deadline"
+A_BREAKER = "breaker_open"
+A_DEGRADED = "degraded"
+A_SLOW = "slow"
+
+
+@dataclass
+class QueryAuditRecord:
+    """One completed query, as the flight recorder remembers it."""
+
+    ts: float  # unix seconds at completion
+    op: str  # "query" | "select_many" | "stats_count" | ...
+    type_name: str
+    source: str  # "store" | "federation" | ...
+    plan: str  # filter / plan summary text
+    latency_ms: float
+    rows: int
+    trace_id: str = ""
+    bytes_out: int = 0
+    degraded: bool = False
+    # per-member outcomes for federated queries:
+    # (member_index, "ok" | "error:<Type>", member_ms)
+    members: list = field(default_factory=list)
+    # stage -> ms latency breakdown (plan/scan/... where the caller has it)
+    breakdown: dict = field(default_factory=dict)
+    anomalies: tuple = ()
+
+
+class FlightRecorder:
+    """Bounded, lock-guarded ring of :class:`QueryAuditRecord` plus the
+    anomaly-dump machinery. Thread-safe; one leaf lock, no blocking calls
+    under it."""
+
+    def __init__(self, capacity: int = 2048,
+                 slow_ms: float | None = None,
+                 dump_dir: str | None = None,
+                 min_dump_interval_s: float = 30.0,
+                 clock=time.time):
+        if slow_ms is None:
+            slow_ms = float(os.environ.get(SLOW_MS_ENV, "1000"))
+        if dump_dir is None:
+            dump_dir = os.environ.get(FLIGHT_DIR_ENV) or None
+        self.slow_ms = slow_ms
+        self.dump_dir = dump_dir
+        self.min_dump_interval_s = min_dump_interval_s
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: ring + pending + dump clock
+        self._ring: deque = deque(maxlen=capacity)
+        # anomalies waiting for their trace's root span to complete (the
+        # dump wants the WHOLE stitched tree); bounded so a listener that
+        # never fires (root abandoned) cannot grow it forever
+        self._pending: dict[str, QueryAuditRecord] = {}
+        self._pending_cap = 64
+        self._listener_installed = False
+        self._last_dump_at = -float("inf")
+        self._dump_seq = 0  # filename sequencing, counts attempts
+        self.record_count = 0
+        self.dump_count = 0  # SUCCESSFUL dumps only (the operator surface)
+        self.last_dump_path: str | None = None
+
+    # -- the hot path ---------------------------------------------------------
+    def record(self, rec: QueryAuditRecord) -> QueryAuditRecord:
+        """Append one completed-query record. Anomaly classification is
+        cheap (flag checks); dump work is deferred. The ring stores plain
+        tuples — :class:`QueryAuditRecord` materializes on READ
+        (:meth:`records`), keeping the always-on write path to one time
+        read, a few comparisons, and a locked deque append (the <2%
+        bound gated in scripts/lint.sh)."""
+        anomalies = self.record_values(
+            rec.ts, rec.op, rec.type_name, rec.source, rec.plan,
+            rec.latency_ms, rec.rows, rec.trace_id, rec.bytes_out,
+            rec.degraded, rec.members, rec.breakdown, rec.anomalies,
+        )
+        rec.anomalies = anomalies
+        return rec
+
+    def record_values(self, ts, op, type_name, source, plan, latency_ms,
+                      rows, trace_id, bytes_out, degraded, members,
+                      breakdown, anomalies) -> tuple:
+        """Positional hot path (what :func:`record` at module level
+        calls); returns the final anomaly tuple."""
+        if degraded and A_DEGRADED not in anomalies:
+            anomalies = anomalies + (A_DEGRADED,)
+        if latency_ms > self.slow_ms and A_SLOW not in anomalies:
+            anomalies = anomalies + (A_SLOW,)
+        row = (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
+               bytes_out, degraded, members, breakdown, anomalies)
+        dump_now = False
+        install_listener = False
+        # a trace owned by a REMOTE caller never parks: the local
+        # (propagated) root completing is not the stitched tree
+        # completing — the caller's recorder dumps on its side
+        remote_owned = _trace.remote_owned()
+        with self._lock:
+            self._ring.append(row)
+            self.record_count += 1
+            if anomalies and self.dump_dir and not remote_owned:
+                if trace_id and _trace.active():
+                    # the triggering root span is still open (we are inside
+                    # it): park the record; _on_root dumps when it closes.
+                    # A full table evicts its OLDEST entry (a root that
+                    # never completed) rather than dropping the new one.
+                    if (trace_id not in self._pending
+                            and len(self._pending) >= self._pending_cap):
+                        self._pending.pop(next(iter(self._pending)))
+                    self._pending[trace_id] = row
+                    if not self._listener_installed:
+                        self._listener_installed = True
+                        install_listener = True
+                else:
+                    dump_now = True
+        if install_listener:
+            _trace.on_root_complete(self._on_root)
+        if dump_now:
+            self._dump(row, root=None)
+        return anomalies
+
+    @staticmethod
+    def _materialize(row: tuple) -> QueryAuditRecord:
+        (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
+         bytes_out, degraded, members, breakdown, anomalies) = row
+        return QueryAuditRecord(
+            ts=ts, op=op, type_name=type_name, source=source, plan=plan,
+            latency_ms=latency_ms, rows=rows, trace_id=trace_id,
+            bytes_out=bytes_out, degraded=degraded,
+            members=list(members) if members else [],
+            breakdown=dict(breakdown) if breakdown else {},
+            anomalies=anomalies,
+        )
+
+    # -- anomaly dumps --------------------------------------------------------
+    def _on_root(self, root) -> None:
+        if root.parent_id:
+            # a PROPAGATED root (a remote caller's sampled request tree,
+            # web/app.py): the caller owns the trace and dumps the full
+            # stitched tree on its side — dumping each member request's
+            # fragment here would fire once per RPC with a partial tree
+            return
+        with self._lock:
+            row = self._pending.pop(root.trace_id, None)
+        if row is not None:
+            self._dump(row, root)
+
+    def _dump(self, row: tuple, root) -> None:
+        """Write one flight-dump file (throttled). Runs outside the ring
+        lock: serialization + file I/O must never stall the hot path.
+        ``dump_count``/``last_dump_path`` move only on a SUCCESSFUL
+        write, and a failed write releases its throttle reservation — a
+        full disk must not both report phantom dumps and suppress the
+        next real one for a whole interval."""
+        with self._lock:
+            now = self._clock()
+            prev_last = self._last_dump_at
+            if now - prev_last < self.min_dump_interval_s:
+                return
+            self._last_dump_at = now  # reservation: one writer per window
+            recent = list(self._ring)
+            seq = self._dump_seq
+            self._dump_seq += 1
+        rec = self._materialize(row)
+        if root is None and rec.trace_id:
+            # tracing was on but the root closed before record() ran (or
+            # closed without the listener): take it from the trace buffer
+            for r in reversed(_trace.recent()):
+                if r.trace_id == rec.trace_id:
+                    root = r
+                    break
+        from geomesa_tpu.obs.export import chrome_trace_events
+
+        events = chrome_trace_events([root] if root is not None else [])
+        payload = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            # Perfetto ignores unknown top-level keys; operators (and the
+            # CLI) read the flight section directly
+            "flight": {
+                "trigger": asdict(rec),
+                "recent": [asdict(self._materialize(r))
+                           for r in recent[-256:]],
+            },
+        }
+        tag = rec.trace_id or f"seq{seq}"
+        path = os.path.join(
+            self.dump_dir, f"flight-{int(rec.ts * 1000)}-{tag}.json")
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+        except OSError:
+            # a full/readonly disk must not fail the query path — and the
+            # failed attempt must not hold the throttle window (unless a
+            # concurrent successful dump re-reserved it meanwhile)
+            with self._lock:
+                if self._last_dump_at == now:
+                    self._last_dump_at = prev_last
+            return
+        with self._lock:
+            self.dump_count += 1
+            self.last_dump_path = path
+
+    def dump(self, path: str) -> int:
+        """Operator-requested dump of the current ring (no anomaly
+        needed); returns the record count written."""
+        recent = self.records()
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"flight": {"recent": [asdict(r) for r in recent]}}, fh)
+        return len(recent)
+
+    # -- read surface ---------------------------------------------------------
+    def records(self) -> list:
+        """Ring contents as :class:`QueryAuditRecord`, oldest first
+        (non-destructive; materialized from the stored tuples)."""
+        with self._lock:
+            rows = list(self._ring)
+        return [self._materialize(r) for r in rows]
+
+    def snapshot(self, limit: int = 64) -> dict:
+        """The ``/api/obs/flight`` payload: newest ``limit`` records plus
+        recorder health."""
+        with self._lock:
+            rows = list(self._ring)[-limit:]
+            count, dumps, last = (self.record_count, self.dump_count,
+                                  self.last_dump_path)
+        return {
+            "records": [asdict(self._materialize(r)) for r in rows],
+            "record_count": count,
+            "dump_count": dumps,
+            "last_dump": last,
+            "capacity": self._ring.maxlen,
+            "slow_ms": self.slow_ms,
+            "dump_dir": self.dump_dir,
+        }
+
+
+# process-wide recorder: always on (recording is cheap; DUMPS only happen
+# when a dump_dir is configured). Tests swap it with install().
+_recorder = FlightRecorder()
+
+
+def get() -> FlightRecorder:
+    return _recorder
+
+
+def install(rec: FlightRecorder) -> FlightRecorder:
+    """Swap the process recorder (test isolation / reconfiguration);
+    returns the previous one. The outgoing recorder's root-completion
+    listener is deregistered (and re-registers on demand if the recorder
+    is ever installed again) so repeated swaps never accumulate stale
+    listeners or let a retired recorder keep writing dumps."""
+    global _recorder
+    prev, _recorder = _recorder, rec
+    with prev._lock:
+        had_listener = prev._listener_installed
+        prev._listener_installed = False
+        prev._pending.clear()
+    if had_listener:
+        _trace.remove_root_listener(prev._on_root)
+    return prev
+
+
+def record(op: str, type_name: str, *, source: str = "store",
+           plan: str = "", latency_ms: float = 0.0, rows: int = 0,
+           bytes_out: int = 0, degraded: bool = False, members=None,
+           breakdown=None, anomalies: tuple = ()) -> None:
+    """Record one completed query on the process recorder (the store /
+    federation call-site helper — trace id is taken from the live span).
+    The always-on hot path: no dataclass is built here."""
+    sp = _trace.current()
+    _recorder.record_values(
+        time.time(), op, type_name, source, plan, latency_ms, rows,
+        sp.trace_id if sp is not None else "", bytes_out, degraded,
+        members or (), breakdown or (), tuple(anomalies),
+    )

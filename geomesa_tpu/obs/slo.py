@@ -1,0 +1,266 @@
+"""SLO objectives and multi-window burn-rate tracking.
+
+The production triad's third leg (docs/observability.md): latency /
+availability objectives per index, endpoint, or federation member, with
+multi-window burn rates (5m / 1h — the Google SRE multi-window
+multi-burn-rate alerting shape) and error-budget accounting.
+
+Mechanics: each tracker keeps time-bucketed good/bad counters (10 s
+buckets, enough for the 1 h window in O(1) memory) for the burn-rate
+math, plus a fixed ring of the most recent latencies for the quantile
+surface (p50/p95/p99 on the member scoreboard — same nearest-rank
+interpolation as the metrics registry's Histogram reservoirs, but a
+recent-window sample and a single O(1) index store per observation: the
+SLO engine sits on the always-on query path, where the reservoir's
+per-update RNG draw is too expensive). An observation is *bad* when the
+call failed, or — for latency objectives — when it succeeded slower
+than ``latency_ms``.
+
+Definitions:
+
+- ``burn_rate(window)`` = (observed error rate over the window) /
+  (allowed error rate ``1 - target``). 1.0 = burning the budget exactly
+  at the sustainable rate; 14.4 on the 1 h window is the classic
+  page-now threshold.
+- ``budget_remaining(window)`` = 1 − errors / (total × (1 − target)),
+  clamped to [0, 1]: the fraction of the window's error budget left.
+
+Exposition: :meth:`SloEngine.prometheus_lines` emits
+``geomesa_slo_burn_rate`` / ``geomesa_slo_budget_remaining`` gauges with
+``slo=`` / ``key=`` / ``window=`` labels; the web layer appends them to
+``GET /api/metrics?format=prometheus``.
+
+Locking: one leaf lock per engine guards the tracker table and bucket
+counters (metrics tier in docs/concurrency.md); Histogram updates run
+OUTSIDE it (the histogram owns its own leaf lock). No jax anywhere
+(``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["SloEngine", "SloObjective", "SloTracker", "window_label"]
+
+_BUCKET_S = 10.0  # counter granularity; 1h window = 360 buckets
+_LAT_RING = 512  # recent latencies kept per tracker for quantiles
+
+
+def window_label(window_s: float) -> str:
+    if window_s % 3600 == 0:
+        return f"{int(window_s // 3600)}h"
+    if window_s % 60 == 0:
+        return f"{int(window_s // 60)}m"
+    return f"{int(window_s)}s"
+
+
+class SloObjective:
+    """One objective definition: availability target plus an optional
+    latency threshold (a slow success burns budget too)."""
+
+    __slots__ = ("name", "target", "latency_ms", "windows")
+
+    def __init__(self, name: str, target: float = 0.999,
+                 latency_ms: float | None = None,
+                 windows: tuple = (300.0, 3600.0)):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if not windows:
+            raise ValueError("at least one window required")
+        self.name = name
+        self.target = target
+        self.latency_ms = latency_ms
+        self.windows = tuple(float(w) for w in windows)
+
+
+class SloTracker:
+    """Bucketed good/bad counters + a recent-latency ring for one
+    (objective, key) pair. Bucket mutation is guarded by the OWNING
+    engine's lock (passed in) — one lock per engine keeps the hot path
+    at a single acquisition."""
+
+    __slots__ = ("objective", "key", "_buckets", "_lock", "_lat", "_lat_n")
+
+    def __init__(self, objective: SloObjective, key: str, lock):
+        self.objective = objective
+        self.key = key
+        self._lock = lock
+        # (bucket_start_s, total, bad), oldest first, pruned to the
+        # longest window on append
+        self._buckets: deque = deque()
+        # fixed ring of the most recent latencies: one index store per
+        # observation, quantile sorting happens only at read time
+        self._lat: list[float] = [0.0] * _LAT_RING
+        self._lat_n = 0
+
+    def _observe_locked(self, ok: bool, latency_ms, now: float) -> None:
+        start = now - (now % _BUCKET_S)
+        if self._buckets and self._buckets[-1][0] == start:
+            b = self._buckets[-1]
+            b[1] += 1
+            b[2] += 0 if ok else 1
+        else:
+            self._buckets.append([start, 1, 0 if ok else 1])
+            horizon = now - max(self.objective.windows) - _BUCKET_S
+            while self._buckets and self._buckets[0][0] < horizon:
+                self._buckets.popleft()
+        if latency_ms is not None:
+            self._lat[self._lat_n % _LAT_RING] = latency_ms
+            self._lat_n += 1
+
+    def latency_quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
+        """Quantiles over the recent-latency ring (nearest-rank with
+        linear interpolation, sorted OUTSIDE the lock)."""
+        with self._lock:
+            n = min(self._lat_n, _LAT_RING)
+            sample = self._lat[:n]
+        sample.sort()
+        if not sample:
+            return [0.0] * len(qs)
+        out = []
+        top = len(sample) - 1
+        for q in qs:
+            pos = q * top
+            lo = int(pos)
+            hi = min(lo + 1, top)
+            frac = pos - lo
+            out.append(sample[lo] * (1.0 - frac) + sample[hi] * frac)
+        return out
+
+    def _counts(self, window_s: float, now: float) -> tuple[int, int]:
+        lo = now - window_s
+        total = bad = 0
+        with self._lock:
+            for start, t, b in self._buckets:
+                if start + _BUCKET_S > lo:
+                    total += t
+                    bad += b
+        return total, bad
+
+    def burn_rate(self, window_s: float, now: float | None = None,
+                  _clock=time.monotonic) -> float:
+        total, bad = self._counts(window_s, _clock() if now is None else now)
+        if total == 0:
+            return 0.0
+        allowed = 1.0 - self.objective.target
+        return (bad / total) / allowed
+
+    def budget_remaining(self, window_s: float, now: float | None = None,
+                         _clock=time.monotonic) -> float:
+        total, bad = self._counts(window_s, _clock() if now is None else now)
+        if total == 0:
+            return 1.0
+        allowed = total * (1.0 - self.objective.target)
+        return max(0.0, min(1.0, 1.0 - bad / allowed))
+
+
+class SloEngine:
+    """A set of objectives + their per-key trackers. ``observe`` is the
+    hot path: one lock acquisition plus one (unlocked-tier) histogram
+    update."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: trackers table + buckets
+        self._objectives: dict[str, SloObjective] = {}
+        self._trackers: dict[tuple[str, str], SloTracker] = {}
+
+    def objective(self, name: str, target: float = 0.999,
+                  latency_ms: float | None = None,
+                  windows: tuple = (300.0, 3600.0)) -> SloObjective:
+        """Define (or redefine) one objective."""
+        obj = SloObjective(name, target, latency_ms, windows)
+        with self._lock:
+            self._objectives[name] = obj
+        return obj
+
+    def tracker(self, name: str, key: str = "") -> SloTracker:
+        with self._lock:
+            obj = self._objectives.get(name)
+            if obj is None:
+                obj = self._objectives[name] = SloObjective(name)
+            tk = self._trackers.get((name, key))
+            if tk is None:
+                tk = self._trackers[(name, key)] = SloTracker(
+                    obj, key, self._lock)
+        return tk
+
+    def observe(self, name: str, ok: bool,
+                latency_ms: float | None = None, key: str = "") -> None:
+        """One observation against objective ``name`` (auto-defined with
+        defaults on first sight), optionally split by ``key`` (a
+        federation member index, an index name, an endpoint). The hot
+        path: a lock-free tracker-table hit (dict reads are GIL-atomic;
+        misses fall back to the locked create) plus ONE lock acquisition
+        for the bucket + latency-ring update."""
+        tk = self._trackers.get((name, key))
+        if tk is None:
+            tk = self.tracker(name, key)
+        good = ok
+        if (
+            good
+            and latency_ms is not None
+            and tk.objective.latency_ms is not None
+            and latency_ms > tk.objective.latency_ms
+        ):
+            good = False  # a slow success burns latency-objective budget
+        now = self._clock()
+        with self._lock:
+            tk._observe_locked(good, latency_ms, now)
+
+    def trackers(self) -> list[SloTracker]:
+        with self._lock:
+            return list(self._trackers.values())
+
+    # -- read surfaces --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON surface (the ``/api/metrics`` default format)."""
+        now = self._clock()
+        out: dict = {}
+        for tk in self.trackers():
+            label = tk.objective.name + (f".{tk.key}" if tk.key else "")
+            p50, p95, p99 = tk.latency_quantiles()
+            out[label] = {
+                "target": tk.objective.target,
+                "latency_ms": tk.objective.latency_ms,
+                "windows": {
+                    window_label(w): {
+                        "burn_rate": tk.burn_rate(w, now),
+                        "budget_remaining": tk.budget_remaining(w, now),
+                    }
+                    for w in tk.objective.windows
+                },
+                "p50_ms": p50,
+                "p95_ms": p95,
+                "p99_ms": p99,
+            }
+        return out
+
+    def prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
+        """``slo_burn_rate`` / ``slo_budget_remaining`` gauges with
+        slo/key/window labels (empty when nothing has been observed)."""
+        trackers = self.trackers()
+        if not trackers:
+            return []
+        now = self._clock()
+        burn = [f"# TYPE {prefix}_slo_burn_rate gauge"]
+        budget = [f"# TYPE {prefix}_slo_budget_remaining gauge"]
+        for tk in trackers:
+            labels = f'slo="{tk.objective.name}"'
+            if tk.key:
+                labels += f',key="{tk.key}"'
+            for w in tk.objective.windows:
+                wl = f'{labels},window="{window_label(w)}"'
+                burn.append(
+                    f"{prefix}_slo_burn_rate{{{wl}}} "
+                    f"{tk.burn_rate(w, now):.6g}")
+                budget.append(
+                    f"{prefix}_slo_budget_remaining{{{wl}}} "
+                    f"{tk.budget_remaining(w, now):.6g}")
+        return burn + budget
+
+    def prometheus_text(self, prefix: str = "geomesa") -> str:
+        lines = self.prometheus_lines(prefix)
+        return "\n".join(lines) + "\n" if lines else ""

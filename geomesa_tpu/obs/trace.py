@@ -23,10 +23,13 @@ Completed root spans land in a bounded in-memory buffer; exporters
 
 from __future__ import annotations
 
+import base64
 import itertools
+import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from contextlib import contextmanager
 from contextvars import ContextVar
@@ -34,11 +37,31 @@ from contextvars import ContextVar
 __all__ = [
     "Span", "StageTimeline", "span", "collect", "current", "annotate",
     "enable", "disable", "enabled", "event", "recent", "drain", "NOOP",
+    "TRACE_HEADER", "TRACE_RETURN_HEADER", "TraceContext", "inject",
+    "extract", "propagated", "remote_owned", "serialize_subtree",
+    "deserialize_subtree", "graft_serialized", "on_root_complete",
+    "remove_root_listener", "unsampled_join",
 ]
+
+# -- cross-process trace context (docs/observability.md § Distributed
+# tracing). The request header carries ``trace_id;parent_span_id;flags``
+# (flags bit 0 = sampled, W3C-traceparent style); the response header
+# carries back a compact serialized span subtree the client grafts under
+# its RPC span, so one federated query reads as ONE stitched tree.
+TRACE_HEADER = "X-Geomesa-Trace"
+TRACE_RETURN_HEADER = "X-Geomesa-Trace-Return"
 
 _enabled = False  # module-global fast flag (the one check on the no-op path)
 _forced: ContextVar[bool] = ContextVar("geomesa_obs_forced", default=False)
 _current: ContextVar["Span | None"] = ContextVar("geomesa_obs_span", default=None)
+# True inside a server-side `propagated` tree: the REMOTE caller owns this
+# trace (the flight recorder must not park anomaly dumps on it — the
+# local propagated root completing is not the stitched tree completing)
+_remote_owned: ContextVar[bool] = ContextVar("geomesa_obs_remote", default=False)
+# True inside a request joined from an UNSAMPLED incoming context:
+# downstream inject() must carry flags=0 so the next hop does not
+# force-record either — the flag is honored END TO END, not just here
+_unsampled: ContextVar[bool] = ContextVar("geomesa_obs_unsampled", default=False)
 
 _buffer_lock = threading.Lock()
 _MAX_TRACES = 512  # completed root spans retained (ring buffer)
@@ -48,6 +71,10 @@ _traces: deque = deque(maxlen=_MAX_TRACES)
 # and across processes without paying uuid4 per span
 _salt = os.urandom(4).hex()
 _ids = itertools.count(1)
+
+# completed-root listeners: fn(root_span), registered by the flight
+# recorder so anomaly dumps fire only once the whole tree is closed
+_root_listeners: list = []
 
 
 class Span:
@@ -118,6 +145,14 @@ class Span:
         else:
             with _buffer_lock:
                 _traces.append(self)
+            # completed-root listeners (the flight recorder's anomaly-dump
+            # trigger): called OUTSIDE the buffer lock, errors swallowed —
+            # a broken listener must never fail the traced call itself
+            for fn in list(_root_listeners):
+                try:
+                    fn(self)
+                except Exception:  # noqa: BLE001 — observer, not participant
+                    pass
 
     # -- introspection --------------------------------------------------------
     def walk(self):
@@ -243,6 +278,206 @@ def collect(name: str = "trace", **attrs):
             yield root
     finally:
         _forced.reset(tok)
+
+
+def on_root_complete(fn) -> None:
+    """Register ``fn(root_span)`` to run whenever a root span completes
+    (after it lands in the buffer; called outside every obs lock)."""
+    _root_listeners.append(fn)
+
+
+def remove_root_listener(fn) -> None:
+    try:
+        _root_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+# -- cross-process propagation (the federation trace contract) ---------------
+
+class TraceContext:
+    """Parsed ``X-Geomesa-Trace`` header: the caller's trace identity plus
+    the sampled flag a remote member must honor."""
+
+    __slots__ = ("trace_id", "parent_span_id", "sampled")
+
+    def __init__(self, trace_id: str, parent_span_id: str, sampled: bool):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.sampled = sampled
+
+    def header_value(self) -> str:
+        return f"{self.trace_id};{self.parent_span_id};{int(self.sampled)}"
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (f"TraceContext({self.trace_id!r}, {self.parent_span_id!r}, "
+                f"sampled={self.sampled})")
+
+
+def inject() -> str | None:
+    """Header value for the innermost live span (None when untraced) —
+    what the HTTP choke point stamps on every outbound RPC. A locally
+    originated trace is sampled (we ARE recording it); a tree joined from
+    an unsampled upstream context stays unsampled downstream."""
+    sp = _current.get()
+    if sp is None:
+        return None
+    flags = 0 if _unsampled.get() else 1
+    return f"{sp.trace_id};{sp.span_id};{flags}"
+
+
+@contextmanager
+def unsampled_join():
+    """Mark this call tree as joined from an UNSAMPLED incoming context:
+    local spans may still record (ids join the caller's trace), but
+    outbound :func:`inject` carries flags=0 so downstream members are not
+    force-recorded — honoring the caller's sampling decision end to end
+    (the web layer wraps unsampled-context requests in this)."""
+    tok = _unsampled.set(True)
+    try:
+        yield
+    finally:
+        _unsampled.reset(tok)
+
+
+def extract(header: str | None) -> TraceContext | None:
+    """Parse an incoming ``X-Geomesa-Trace`` header. Malformed values
+    yield None (propagation is best-effort, never a request error)."""
+    if not header:
+        return None
+    parts = header.split(";")
+    if len(parts) != 3 or not parts[0] or not parts[1]:
+        return None
+    trace_id, parent_id, flags = parts
+    if any(len(p) > 128 for p in parts):
+        return None  # defensive: a hostile header must not bloat every span
+    return TraceContext(trace_id, parent_id, flags.strip() == "1")
+
+
+@contextmanager
+def propagated(name: str, ctx: TraceContext, **attrs):
+    """Server-side trace join: force-record one call tree as a child of
+    the remote caller's span (the ``collect`` mechanism with the caller's
+    ids), honoring the sampled flag — this is how a remote member's spans
+    end up inside the federated caller's stitched tree."""
+    tok = _forced.set(True)
+    rtok = _remote_owned.set(True)
+    root = Span(name, attrs, _current.get())
+    root.trace_id = ctx.trace_id
+    root.parent_id = ctx.parent_span_id
+    try:
+        with root:
+            yield root
+    finally:
+        _remote_owned.reset(rtok)
+        _forced.reset(tok)
+
+
+def remote_owned() -> bool:
+    """True when this context's trace is owned by a remote caller (we are
+    inside a server-side ``propagated`` tree)."""
+    return _remote_owned.get()
+
+
+def _prim(v):
+    if isinstance(v, (int, float, bool, str, type(None))):
+        return v
+    s = str(v)
+    return s if len(s) <= 200 else s[:197] + "..."
+
+
+def _span_doc(s: Span, base_ns: int, depth: int) -> dict:
+    d = {
+        "n": s.name,
+        "i": s.span_id,
+        "th": s.thread_id,
+        "o": s.t0_ns - base_ns,
+        "d": max((s.t1_ns or s.t0_ns) - s.t0_ns, 0),
+        "a": {k: _prim(v) for k, v in s.attrs.items()},
+    }
+    evs = [[n, t - base_ns, {k: _prim(v) for k, v in a.items()}]
+           for n, t, a in list(s.events)]
+    if evs:
+        d["e"] = evs
+    if depth > 0 and s.children:
+        d["c"] = [_span_doc(c, base_ns, depth - 1) for c in list(s.children)]
+    elif s.children:
+        d["pruned"] = len(s.children)
+    return d
+
+
+def serialize_subtree(root: Span, max_bytes: int = 48_000) -> str:
+    """One span tree as a compact, header-safe string (JSON → zlib →
+    base64). Timestamps ship RELATIVE to the root's start, so the clock
+    domains of two hosts never need to agree. Oversized trees prune the
+    deepest levels first until the encoding fits ``max_bytes``."""
+    for depth in (64, 6, 3, 1, 0):
+        doc = _span_doc(root, root.t0_ns, depth)
+        enc = base64.b64encode(
+            zlib.compress(json.dumps(doc, separators=(",", ":")).encode())
+        ).decode("ascii")
+        if len(enc) <= max_bytes:
+            return enc
+    return enc  # depth 0: a single span always fits in practice
+
+
+def _build_span(doc: dict, trace_id: str, base_ns: int) -> Span:
+    sp = Span(str(doc.get("n", "?")), dict(doc.get("a") or {}), None)
+    sp.trace_id = trace_id
+    sp.span_id = str(doc.get("i", sp.span_id))
+    sp.thread_id = int(doc.get("th", 0))
+    sp.t0_ns = base_ns + int(doc.get("o", 0))
+    sp.t1_ns = sp.t0_ns + int(doc.get("d", 0))
+    for n, t, a in doc.get("e", ()):
+        sp.events.append((str(n), base_ns + int(t), dict(a)))
+    if doc.get("pruned"):
+        sp.attrs["children_pruned"] = int(doc["pruned"])
+    for c in doc.get("c", ()):
+        child = _build_span(c, trace_id, base_ns)
+        child.parent_id = sp.span_id
+        sp.children.append(child)
+    return sp
+
+
+# inflated-payload ceiling for remote-supplied subtrees: a 64 KB header
+# (http.client's line limit) crafted as a zlib bomb must not expand into
+# hundreds of MB on the client — decompression stops at this many bytes
+_MAX_INFLATED_BYTES = 4 * 1024 * 1024
+
+
+def _decode_subtree_doc(encoded: str) -> dict:
+    d = zlib.decompressobj()
+    raw = d.decompress(base64.b64decode(encoded), _MAX_INFLATED_BYTES)
+    if d.unconsumed_tail:
+        raise ValueError(
+            f"serialized span subtree inflates past {_MAX_INFLATED_BYTES} B")
+    return json.loads(raw.decode())
+
+
+def deserialize_subtree(encoded: str, trace_id: str = "",
+                        base_ns: int = 0) -> Span:
+    """Inverse of :func:`serialize_subtree`: a real :class:`Span` tree
+    (walk/find/exporters all work), re-anchored at ``base_ns``."""
+    return _build_span(_decode_subtree_doc(encoded), trace_id, base_ns)
+
+
+def graft_serialized(parent: Span, encoded: str) -> Span | None:
+    """Graft a remote member's serialized subtree under the local RPC
+    span: the remote root becomes a child of ``parent``, its ids rebased
+    onto the parent's trace and its clock re-anchored inside the RPC
+    window (centered — the residual on either side reads as network
+    time). Returns the grafted root, or None on a malformed payload."""
+    try:
+        doc = _decode_subtree_doc(encoded)
+    except Exception:  # noqa: BLE001 — a torn header must not fail the call
+        return None
+    elapsed = (parent.t1_ns or time.perf_counter_ns()) - parent.t0_ns
+    remote_dur = int(doc.get("d", 0))
+    base = parent.t0_ns + max((elapsed - remote_dur) // 2, 0)
+    root = _build_span(doc, parent.trace_id, base)
+    root.parent_id = parent.span_id
+    parent.children.append(root)
+    return root
 
 
 def recent() -> list:

@@ -1,6 +1,6 @@
 """The one HTTP seam every remote client goes through.
 
-Three jobs, one call site:
+Four jobs, one call site:
 
 - :func:`fetch` is the SINGLE ``urlopen`` in the tree's remote clients —
   the choke point where :mod:`~geomesa_tpu.resilience.faults` injects
@@ -18,6 +18,12 @@ Three jobs, one call site:
   QueryTimeout→504; clients invert it here) so GET and mutation paths
   surface identical exception types — the ``RemoteDataStore._get`` /
   ``_send`` divergence this replaces leaked raw ``HTTPError`` from reads.
+- distributed-trace propagation (docs/observability.md): every traced
+  exchange runs under an ``rpc`` span that injects ``X-Geomesa-Trace``,
+  records attempts/retries/breaker-state/deadline-budget, and grafts the
+  remote's returned span subtree (``X-Geomesa-Trace-Return``) so every
+  federated query reads as ONE stitched tree. One choke point means
+  every client (store, journal, schema registry) propagates for free.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from geomesa_tpu.obs import trace as _trace
 from geomesa_tpu.resilience import faults
 from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
@@ -48,15 +55,22 @@ def fetch(req: urllib.request.Request, timeout_s: float) -> bytes:
     """The urlopen choke point: read one full response body, with fault
     hooks on both sides of the wire. Raises exactly what ``urlopen``
     raises (plus whatever the active injector fabricates)."""
+    return _fetch(req, timeout_s)[0]
+
+
+def _fetch(req: urllib.request.Request, timeout_s: float):
+    """fetch plus the response headers — :func:`request` needs them for
+    the ``X-Geomesa-Trace-Return`` span subtree."""
     inj = faults.active()
     method = req.get_method()
     if inj is not None:
         inj.before_send(method, req.full_url)
     with urllib.request.urlopen(req, timeout=timeout_s) as r:  # noqa: S310
         data = r.read()
+        headers = r.headers
     if inj is not None:
         data = inj.after_receive(method, req.full_url, data)
-    return data
+    return data, headers
 
 
 def map_http_error(e: urllib.error.HTTPError):
@@ -106,6 +120,16 @@ def request(
     than the first try). With ``map_errors`` (the store-client contract)
     4xx responses surface as the local store's exception types and 504 as
     :class:`~geomesa_tpu.utils.timeouts.QueryTimeout`.
+
+    Tracing (docs/observability.md § Distributed tracing): when the
+    caller is traced, the whole exchange runs under one ``rpc`` span that
+    (a) injects ``X-Geomesa-Trace`` so the remote member's spans join
+    this trace, (b) records attempts/retries, breaker state, and the
+    remaining deadline budget as span attributes — each scheduled retry
+    is a span event — and (c) grafts the remote's returned span subtree
+    (``X-Geomesa-Trace-Return``) underneath itself, so the caller sees
+    ONE stitched tree per federated query. Untraced calls pay one
+    no-op-span check.
     """
     full = url
     if params:
@@ -115,58 +139,92 @@ def request(
     if data is not None:
         base_headers.setdefault("Content-Type", "application/json")
 
-    def attempt() -> bytes:
-        hdrs = dict(base_headers)
-        eff_timeout = timeout_s
-        if deadline is not None:
-            # shed BEFORE the breaker gate: a shed records no outcome, so
-            # gating first could consume a half-open probe slot that is
-            # then never released
-            rem_s = deadline.remaining_s()
-            if rem_s <= 0:
-                # no round trip for a query that cannot finish in time
-                # anyway (the server would 504 it)
-                raise QueryTimeout(
-                    f"deadline spent before request to {url}")
-            hdrs[DEADLINE_HEADER] = str(int(rem_s * 1000) or 1)
-            eff_timeout = min(timeout_s, rem_s + _DEADLINE_SOCKET_SLACK_S)
-        if breaker is not None:
-            breaker.before_call()  # raises CircuitOpenError when open
-        req = urllib.request.Request(
-            full, data=data, method=method, headers=hdrs)
-        try:
-            out = fetch(req, eff_timeout)
-        except QueryTimeout:
-            raise  # local shed: says nothing about endpoint health
-        except Exception as exc:  # noqa: BLE001 — classified for the breaker
-            if breaker is not None:
-                breaker.record(_breaker_failure(exc))
-            if (
-                deadline is not None and deadline.expired()
-                and isinstance(exc, OSError)
-            ):
-                # a transport error after the budget ran out IS the
-                # deadline: surface the uniform timeout type
-                raise QueryTimeout(
-                    f"deadline expired during request to {url}") from exc
-            raise
-        if breaker is not None:
-            breaker.record_success()
-        return out
+    with _trace.span("rpc", method=method, endpoint=url) as rpc:
+        traced = isinstance(rpc, _trace.Span)
+        n_attempts = 0
+        last_headers = None
 
-    try:
-        if retry is None:
-            raw = attempt()
-        else:
-            raw = retry.call(attempt, idempotent=idempotent,
-                             on_retry=on_retry)
-    except urllib.error.HTTPError as e:
-        if not map_errors:
-            raise
-        if e.code == 504:
-            # the remote shed/expired the work: the federation-wide
-            # timeout surface, same type the local watchdog raises
-            raise QueryTimeout(f"remote {url} exceeded deadline") from None
-        map_http_error(e)
-        raise AssertionError("unreachable")  # pragma: no cover
-    return raw
+        def attempt() -> bytes:
+            nonlocal n_attempts, last_headers
+            n_attempts += 1
+            hdrs = dict(base_headers)
+            if traced:
+                tr = _trace.inject()  # current span IS the rpc span
+                if tr:
+                    hdrs[_trace.TRACE_HEADER] = tr
+                rpc.set(attempts=n_attempts)
+                if breaker is not None:
+                    rpc.set(breaker=breaker.state)
+            eff_timeout = timeout_s
+            if deadline is not None:
+                # shed BEFORE the breaker gate: a shed records no outcome,
+                # so gating first could consume a half-open probe slot
+                # that is then never released
+                rem_s = deadline.remaining_s()
+                if rem_s <= 0:
+                    # no round trip for a query that cannot finish in time
+                    # anyway (the server would 504 it)
+                    raise QueryTimeout(
+                        f"deadline spent before request to {url}")
+                hdrs[DEADLINE_HEADER] = str(int(rem_s * 1000) or 1)
+                eff_timeout = min(timeout_s, rem_s + _DEADLINE_SOCKET_SLACK_S)
+                if traced:
+                    rpc.set(deadline_remaining_ms=round(rem_s * 1000.0, 1))
+            if breaker is not None:
+                breaker.before_call()  # raises CircuitOpenError when open
+            req = urllib.request.Request(
+                full, data=data, method=method, headers=hdrs)
+            try:
+                out, resp_headers = _fetch(req, eff_timeout)
+            except QueryTimeout:
+                raise  # local shed: says nothing about endpoint health
+            except Exception as exc:  # noqa: BLE001 — classified for the breaker
+                if breaker is not None:
+                    breaker.record(_breaker_failure(exc))
+                if (
+                    deadline is not None and deadline.expired()
+                    and isinstance(exc, OSError)
+                ):
+                    # a transport error after the budget ran out IS the
+                    # deadline: surface the uniform timeout type
+                    raise QueryTimeout(
+                        f"deadline expired during request to {url}") from exc
+                raise
+            if breaker is not None:
+                breaker.record_success()
+            last_headers = resp_headers
+            return out
+
+        def _on_retry(attempt_n: int, delay_s: float, exc) -> None:
+            if traced:
+                rpc.set(retries=attempt_n)
+                rpc.event(
+                    "retry", attempt=attempt_n,
+                    delay_ms=round(delay_s * 1000.0, 2),
+                    error=type(exc).__name__,
+                )
+            if on_retry is not None:
+                on_retry(attempt_n, delay_s, exc)
+
+        try:
+            if retry is None:
+                raw = attempt()
+            else:
+                raw = retry.call(attempt, idempotent=idempotent,
+                                 on_retry=_on_retry)
+        except urllib.error.HTTPError as e:
+            if not map_errors:
+                raise
+            if e.code == 504:
+                # the remote shed/expired the work: the federation-wide
+                # timeout surface, same type the local watchdog raises
+                raise QueryTimeout(f"remote {url} exceeded deadline") from None
+            map_http_error(e)
+            raise AssertionError("unreachable")  # pragma: no cover
+        if traced and last_headers is not None:
+            enc = last_headers.get(_trace.TRACE_RETURN_HEADER)
+            if enc:
+                # the remote member's span subtree joins this trace as a
+                # child of the rpc span (clock re-anchored inside it)
+                _trace.graft_serialized(rpc, enc)
+        return raw

@@ -199,6 +199,13 @@ class DataStore:
 
             metrics = MetricsRegistry()
         self.metrics = metrics
+        # SLO engine (docs/observability.md § SLOs): one availability/
+        # latency observation per completed or timed-out query, exposed
+        # as burn-rate gauges on GET /api/metrics?format=prometheus
+        from geomesa_tpu.obs.slo import SloEngine
+
+        self.slo = SloEngine()
+        self.slo.objective("store.query", target=0.999)
         from geomesa_tpu.utils import timeouts as _timeouts
         from geomesa_tpu.utils.timeouts import Watchdog
 
@@ -961,6 +968,7 @@ class DataStore:
             if rem <= 0:
                 self.metrics.counter("store.query.timeouts").inc()
                 self.metrics.counter("store.query.deadline_shed").inc()
+                self.slo.observe("store.query", ok=False, key=type_name)
                 raise QueryTimeout(
                     f"deadline spent before scan of {type_name!r} started")
             timeout_s = rem if timeout_s is None else min(timeout_s, rem)
@@ -973,6 +981,9 @@ class DataStore:
         except QueryTimeout:
             timed_out = True
             self.metrics.counter("store.query.timeouts").inc()
+            self.slo.observe(
+                "store.query", ok=False, key=type_name,
+                latency_ms=(_time.perf_counter() - t_start) * 1000.0)
             raise
         finally:
             # finally: scan errors (not just timeouts) must release the
@@ -2028,11 +2039,22 @@ class DataStore:
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float, hits: int) -> None:
         self.metrics.histogram("store.query.hits").update(hits)
         self.metrics.histogram("store.query.scan_ms").update(scan_ms)
+        filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
+        # always-on observability: one flight-recorder audit record + one
+        # SLO availability observation per completed query (both leaf-lock
+        # appends — the <2% cached-jit bound is gated in scripts/lint.sh)
+        from geomesa_tpu.obs import flight as _flight
+
+        _flight.record(
+            op="query", type_name=type_name, source="store", plan=filt,
+            latency_ms=plan_ms + scan_ms, rows=hits,
+            breakdown={"plan": plan_ms, "scan": scan_ms},
+        )
+        self.slo.observe("store.query", ok=True, key=type_name,
+                         latency_ms=plan_ms + scan_ms)
         if self.audit_writer is None:
             return
         from geomesa_tpu.utils.audit import QueryEvent, now_millis
-
-        filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
         hints = ", ".join(f"{k}={v!r}" for k, v in sorted(q.hints.items()))
         # audit↔trace join: the innermost live span is this query's (the
         # "query" span in query()/select_many); empty when tracing is off

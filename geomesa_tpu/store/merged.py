@@ -10,17 +10,20 @@ sketches are monoids — the reference's reducer pattern, P6/P10 in §2.20).
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace
 
 import numpy as np
 
 from geomesa_tpu import obs
 from geomesa_tpu.filter import ast
+from geomesa_tpu.obs import flight as _flight
 from geomesa_tpu.planning.planner import Query
-from geomesa_tpu.resilience import MEMBER_FAILURE_TYPES
+from geomesa_tpu.resilience import MEMBER_FAILURE_TYPES, CircuitOpenError
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType
 from geomesa_tpu.store.datastore import QueryResult
+from geomesa_tpu.utils.timeouts import QueryTimeout
 
 __all__ = ["MergedDataStoreView", "intersection_schema", "intersection_schemas"]
 
@@ -71,7 +74,8 @@ class MergedDataStoreView:
     and an :func:`obs.event` span marker per skipped member.
     """
 
-    def __init__(self, stores, on_member_error: str = "fail", metrics=None):
+    def __init__(self, stores, on_member_error: str = "fail", metrics=None,
+                 slo=None, slo_target: float = 0.999):
         if not stores:
             raise ValueError("merged view needs at least one store")
         if on_member_error not in ("fail", "partial"):
@@ -86,6 +90,17 @@ class MergedDataStoreView:
 
             metrics = MetricsRegistry()
         self.metrics = metrics
+        # SLO engine (docs/observability.md § SLOs): every member fan-out
+        # leg is one availability observation against
+        # ``federation.member`` keyed by member index — the burn-rate /
+        # error-budget surface behind GET /api/metrics?format=prometheus
+        # and the member_health() scoreboard
+        if slo is None:
+            from geomesa_tpu.obs.slo import SloEngine
+
+            slo = SloEngine()
+        self.slo = slo
+        self.slo.objective("federation.member", target=slo_target)
         # scope filters parsed once here, not per query
         self.stores = []
         for s in stores:
@@ -94,13 +109,21 @@ class MergedDataStoreView:
                 scope = parse(scope)
             self.stores.append((store, scope))
 
-    def _member_run(self, i: int, fn, errors: list):
+    def _member_run(self, i: int, fn, errors: list, outcomes: list | None = None):
         """One member's fan-out leg: ``(ok, result)``. In ``partial``
-        mode a member failure is recorded (metrics + span event + the
-        errors list) and skipped; in ``fail`` mode it propagates."""
+        mode a member failure is recorded (metrics + SLO + span event +
+        the errors list) and skipped; in ``fail`` mode it propagates.
+        ``outcomes`` (when passed) collects the flight-recorder member
+        summary: ``(i, "ok" | "error:<Type>", ms)``."""
+        t0 = time.perf_counter()
         try:
-            return True, fn()
+            out = fn()
         except MEMBER_FAILURE_TYPES as e:
+            ms = (time.perf_counter() - t0) * 1000.0
+            self.slo.observe("federation.member", ok=False,
+                             latency_ms=ms, key=str(i))
+            if outcomes is not None:
+                outcomes.append((i, f"error:{type(e).__name__}", ms))
             if self.on_member_error != "partial":
                 raise
             errors.append((i, e))
@@ -108,6 +131,89 @@ class MergedDataStoreView:
             self.metrics.counter(f"federation.member_errors.{i}").inc()
             obs.event("member_error", member=i, error=type(e).__name__)
             return False, None
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.slo.observe("federation.member", ok=True,
+                         latency_ms=ms, key=str(i))
+        if outcomes is not None:
+            outcomes.append((i, "ok", ms))
+        return True, out
+
+    @staticmethod
+    def _anomalies(errors: list) -> tuple:
+        """Flight-recorder anomaly classification of a fan-out's member
+        failures (degraded/slow are detected by the recorder itself)."""
+        out: list[str] = []
+        for _, e in errors:
+            kind = None
+            if isinstance(e, CircuitOpenError):
+                kind = _flight.A_BREAKER
+            elif isinstance(e, QueryTimeout):
+                kind = _flight.A_DEADLINE
+            if kind is not None and kind not in out:
+                out.append(kind)
+        return tuple(out)
+
+    def member_health(self) -> list:
+        """The per-member health scoreboard (docs/observability.md):
+        rolling 5-minute success rate, latency quantiles from the SLO
+        tracker's reservoir, breaker state where the member exposes one,
+        and the cumulative error count — what ``/api/metrics`` and
+        ``explain`` surface for operators."""
+        out = []
+        for i, (store, _) in enumerate(self.stores):
+            tk = self.slo.tracker("federation.member", key=str(i))
+            win = min(tk.objective.windows)
+            p50, p95, p99 = tk.latency_quantiles()
+            breaker = getattr(store, "breaker", None)
+            errs = self.metrics.counters.get(f"federation.member_errors.{i}")
+            out.append({
+                "member": i,
+                "store": getattr(store, "base_url", type(store).__name__),
+                "success_rate": 1.0 - tk.burn_rate(win) * (
+                    1.0 - tk.objective.target),
+                "budget_remaining": tk.budget_remaining(win),
+                "window": int(win),
+                "p50_ms": p50,
+                "p95_ms": p95,
+                "p99_ms": p99,
+                "breaker": breaker.state if breaker is not None else None,
+                "errors": errs.count if errs is not None else 0,
+            })
+        return out
+
+    def explain(self, type_name: str, q=None) -> str:
+        """Federated EXPLAIN: each member's own plan explain (where the
+        member supports it) plus the health scoreboard, so a degraded
+        member is visible right where the operator is reading plans."""
+        if isinstance(q, (str, ast.Filter)) or q is None:
+            q = Query(filter=q)
+        lines = [f"Federated plan over {len(self.stores)} members "
+                 f"(on_member_error={self.on_member_error}):"]
+        base_f = q.resolved_filter()
+        for i, (store, scope) in enumerate(self.stores):
+            f = base_f if scope is None else ast.And((base_f, scope))
+            sub = replace(q, filter=f, sort_by=None, limit=None,
+                          start_index=None)
+            ex = getattr(store, "explain", None)
+            lines.append(f"-- member {i}: "
+                         f"{getattr(store, 'base_url', type(store).__name__)}")
+            if ex is None:
+                lines.append("   (no explain surface)")
+                continue
+            try:
+                lines.append("   " + str(ex(type_name, sub)).replace(
+                    "\n", "\n   "))
+            except MEMBER_FAILURE_TYPES as e:
+                lines.append(f"   (unavailable: {type(e).__name__}: {e})")
+        lines.append("Member health:")
+        for h in self.member_health():
+            lines.append(
+                f"  member {h['member']} [{h['store']}]: "
+                f"success={h['success_rate']:.3f} "
+                f"budget={h['budget_remaining']:.2f} "
+                f"p95={h['p95_ms']:.1f}ms "
+                f"breaker={h['breaker'] or '-'} errors={h['errors']}")
+        return "\n".join(lines)
 
     @staticmethod
     def _error_details(errors: list) -> list:
@@ -161,9 +267,43 @@ class MergedDataStoreView:
         return sorted(names)
 
     def query(self, type_name: str, q: "Query | str | ast.Filter | None" = None, **kwargs) -> QueryResult:
-        sft = self.get_schema(type_name)
         if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q, **kwargs)
+        t_start = time.perf_counter()
+        outcomes: list = []
+        # one federation span per query: member RPC spans (and their
+        # grafted remote subtrees) nest under it, member-error/degraded
+        # events attach to it — the stitched tree's local frame
+        with obs.span("federation.query", type=type_name,
+                      members=len(self.stores)):
+            filt = q.filter if isinstance(q.filter, str) else str(
+                q.filter or "INCLUDE")
+            try:
+                res, errors = self._query_fanout(type_name, q, outcomes)
+            except MEMBER_FAILURE_TYPES as e:
+                # whole-query failure (all members down, or fail mode):
+                # the always-on record must not miss the worst outcomes
+                _flight.record(
+                    op="query", type_name=type_name, source="federation",
+                    plan=filt,
+                    latency_ms=(time.perf_counter() - t_start) * 1000.0,
+                    rows=0, degraded=True, members=outcomes,
+                    anomalies=self._anomalies([(None, e)]),
+                )
+                raise
+            # always-on audit record; anomalies (degraded result, open
+            # breaker, blown member deadline) trigger the flight dump
+            _flight.record(
+                op="query", type_name=type_name, source="federation",
+                plan=filt,
+                latency_ms=(time.perf_counter() - t_start) * 1000.0,
+                rows=res.count, degraded=res.degraded, members=outcomes,
+                anomalies=self._anomalies(errors),
+            )
+        return res
+
+    def _query_fanout(self, type_name: str, q: Query, outcomes: list):
+        sft = self.get_schema(type_name)
 
         # sub-queries: scope filter ANDed in; view-level reduce steps stripped
         # (sort/limit re-applied on the merged stream, reference
@@ -178,7 +318,8 @@ class MergedDataStoreView:
             f = base_f if scope is None else ast.And((base_f, scope))
             sub = replace(q, filter=f, sort_by=None, limit=None, start_index=None)
             ok, res = self._member_run(
-                i, lambda s=store, t=sub: s.query(type_name, t), errors)
+                i, lambda s=store, t=sub: s.query(type_name, t), errors,
+                outcomes)
             if not ok:
                 continue
             if res.density is not None:
@@ -223,7 +364,7 @@ class MergedDataStoreView:
                 bin_data=bin_data,
                 degraded=degraded,
                 member_errors=self._error_details(errors) if errors else None,
-            )
+            ), errors
 
         table = FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
         rows = np.arange(len(table), dtype=np.int64)
@@ -233,7 +374,7 @@ class MergedDataStoreView:
         return QueryResult(
             table, rows, degraded=degraded,
             member_errors=self._error_details(errors) if errors else None,
-        )
+        ), errors
 
     def stats_count(self, type_name: str, cql=None, exact: bool = False):
         """Count across stores, honoring each store's scope filter. In

@@ -27,6 +27,7 @@ import threading
 import urllib.parse
 from typing import Callable
 
+from geomesa_tpu.obs import trace as _trace
 from geomesa_tpu.resilience import http as rhttp
 from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 
@@ -161,9 +162,16 @@ class RemoteJournal:
         the liveness signal. Transient 5xx/connection errors keep
         retrying with the policy's decorrelated-jitter backoff between
         rounds (each round already retried ``retry.max_attempts`` times
-        inside the transport)."""
+        inside the transport).
 
-        def _note_failure(e: Exception) -> int:
+        Tracing: the tail session owns ONE stable root span
+        (``journal.tail``) for its whole lifetime — per-poll RPC spans
+        nest under it and consecutive-failure/backoff state attaches as
+        span EVENTS, instead of every poll minting a fresh orphan root
+        that floods the trace buffer. Old poll children are trimmed so a
+        long-lived session's tree stays bounded."""
+
+        def _note_failure(e: Exception, session, delay_s: float | None) -> int:
             with self._health_lock:
                 self.last_error = e
                 self.consecutive_failures += 1
@@ -172,6 +180,9 @@ class RemoteJournal:
             self.metrics.gauge("remote_journal.consecutive_failures").set(
                 float(n))
             self.metrics.gauge("remote_journal.healthy").set(0.0)
+            session.event(
+                "tail_error", error=type(e).__name__, consecutive=n,
+                backoff_ms=round((delay_s or 0.0) * 1000.0, 2))
             return n
 
         def _tail() -> None:
@@ -179,40 +190,91 @@ class RemoteJournal:
 
             cursor = 0
             delay: float | None = None
-            while not self._stop.is_set():
-                try:
-                    batch, cursor = self.total_poll_cursor(topic, cursor)
-                    with self._health_lock:
-                        self.last_error = None
-                        self.consecutive_failures = 0
-                    self.metrics.gauge(
-                        "remote_journal.consecutive_failures").set(0.0)
-                    self.metrics.gauge("remote_journal.healthy").set(1.0)
-                    delay = None
-                except urllib.error.HTTPError as e:
-                    # 4xx = misconfiguration (wrong server, no journal):
-                    # retrying forever would just look like an idle stream
-                    _note_failure(e)
-                    if 400 <= e.code < 500:
-                        return
-                    delay = self.retry.next_delay(delay)
-                    self._stop.wait(delay)
-                    continue
-                except (OSError, ValueError) as e:
-                    # transient transport trouble (incl. an open breaker)
-                    # or a torn/garbage JSON body: back off, keep tailing
-                    _note_failure(e)
-                    delay = self.retry.next_delay(delay)
-                    self._stop.wait(delay)
-                    continue
-                if not batch:
-                    self._stop.wait(self.poll_interval_s)
-                    continue
-                for data in batch:
+            polls = 0
+            failing = False
+            # the session's stable root span: this thread's context is
+            # empty, so it IS a root; it closes (and lands in the trace
+            # buffer) when the tail stops. Managed manually (not `with`)
+            # because tracing may be enabled mid-session — the loop then
+            # opens the session LATE, so per-poll rpc spans still nest
+            # under one root instead of flooding the buffer as orphans.
+            session = _trace.span("journal.tail", topic=topic,
+                                  endpoint=self.base_url)
+            session.__enter__()
+            try:
+
+                def _trim() -> None:
+                    # bound the long-lived tree on BOTH the healthy and
+                    # the failing path — a days-long outage appends one
+                    # rpc child + one tail_error event per round, so the
+                    # trim must not hide behind a successful poll
+                    # (single-writer trim; exporters snapshot via list())
+                    if isinstance(session, _trace.Span):
+                        if len(session.children) > 64:
+                            del session.children[:-64]
+                        if len(session.events) > 128:
+                            del session.events[:-128]
+
+                while not self._stop.is_set():
+                    if session is _trace.NOOP and _trace.enabled():
+                        # tracing turned on mid-session: open the stable
+                        # root NOW (this thread's context is still empty)
+                        session = _trace.span(
+                            "journal.tail", topic=topic,
+                            endpoint=self.base_url)
+                        session.__enter__()
                     try:
-                        callback(data)
-                    except Exception:  # noqa: BLE001 — one bad consumer
-                        pass
+                        batch, cursor = self.total_poll_cursor(topic, cursor)
+                        polls += 1
+                        with self._health_lock:
+                            self.last_error = None
+                            self.consecutive_failures = 0
+                        self.metrics.gauge(
+                            "remote_journal.consecutive_failures").set(0.0)
+                        self.metrics.gauge("remote_journal.healthy").set(1.0)
+                        delay = None
+                        if failing:
+                            failing = False
+                            session.event("tail_recovered", polls=polls)
+                        if isinstance(session, _trace.Span):
+                            session.set(polls=polls, cursor=cursor)
+                        _trim()
+                    except urllib.error.HTTPError as e:
+                        # 4xx = misconfiguration (wrong server, no
+                        # journal): retrying forever would just look like
+                        # an idle stream
+                        failing = True
+                        if 400 <= e.code < 500:
+                            _note_failure(e, session, None)
+                            session.event("tail_stopped", status=e.code)
+                            return
+                        delay = self.retry.next_delay(delay)
+                        _note_failure(e, session, delay)
+                        _trim()
+                        self._stop.wait(delay)
+                        continue
+                    except (OSError, ValueError) as e:
+                        # transient transport trouble (incl. an open
+                        # breaker) or a torn/garbage JSON body: back off,
+                        # keep tailing
+                        failing = True
+                        delay = self.retry.next_delay(delay)
+                        _note_failure(e, session, delay)
+                        _trim()
+                        self._stop.wait(delay)
+                        continue
+                    if not batch:
+                        self._stop.wait(self.poll_interval_s)
+                        continue
+                    for data in batch:
+                        try:
+                            callback(data)
+                        except Exception:  # noqa: BLE001 — one bad consumer
+                            pass
+            finally:
+                # close the session root (it lands in the trace buffer);
+                # NOOP when tracing never came on
+                session.__exit__(None, None, None)
 
         t = threading.Thread(target=_tail, daemon=True,
                              name=f"remote-journal-tail-{topic}")

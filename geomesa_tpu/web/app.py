@@ -51,6 +51,7 @@ from urllib.parse import parse_qs
 import numpy as np
 
 from geomesa_tpu import obs
+from geomesa_tpu.obs import trace as _obstrace
 from geomesa_tpu.planning.planner import Query
 from geomesa_tpu.utils.timeouts import QueryTimeout as _QueryTimeout
 
@@ -148,6 +149,7 @@ class GeoMesaApp:
             ("GET", r"^/api/schemas/([^/]+)/stats/topk$", self._stats_topk),
             ("GET", r"^/api/schemas/([^/]+)/density$", self._density),
             ("GET", r"^/api/audit$", self._audit),
+            ("GET", r"^/api/obs/flight$", self._obs_flight),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -182,6 +184,11 @@ class GeoMesaApp:
                     {"error": f"bad X-Geomesa-Deadline-Ms header: {hdr!r}"},
                     "application/json",
                 )
+        # trace propagation (X-Geomesa-Trace): join the remote caller's
+        # trace; a sampled context force-records this request's tree and
+        # returns it serialized so the caller grafts it under its RPC span
+        # (the stitched federated tree — docs/observability.md)
+        ctx = _obstrace.extract(environ.get("HTTP_X_GEOMESA_TRACE"))
         # per-request metrics (the servlet AggregatedMetricsFilter role):
         # counter per route pattern + total, into the store's registry so
         # /api/metrics reports request rates alongside store counters
@@ -204,13 +211,37 @@ class GeoMesaApp:
                         # ContextVar starts empty, so concurrent requests
                         # build disjoint span trees; the handler's store
                         # queries/serialization nest underneath
-                        with obs.span(
-                            "http", method=method, path=path,
-                            route=handler.__name__.lstrip("_"),
-                        ):
+                        route = handler.__name__.lstrip("_")
+                        if ctx is not None and ctx.sampled:
+                            span_cm = _obstrace.propagated(
+                                "http", ctx, method=method, path=path,
+                                route=route)
+                        else:
+                            span_cm = obs.span(
+                                "http", method=method, path=path, route=route)
+                        from contextlib import nullcontext
+
+                        # an unsampled incoming context must stay unsampled
+                        # on OUR outbound hops too (fan-out to members):
+                        # honoring the flag end to end, not just locally
+                        join_cm = (
+                            _obstrace.unsampled_join()
+                            if ctx is not None and not ctx.sampled
+                            else nullcontext()
+                        )
+                        with span_cm as sp, join_cm:
+                            if (
+                                ctx is not None and not ctx.sampled
+                                and isinstance(sp, _obstrace.Span)
+                            ):
+                                # unsampled context + local tracing on: the
+                                # ids still join the caller's trace (honoring
+                                # the flag means not FORCING a record)
+                                sp.trace_id = ctx.trace_id
+                                sp.parent_id = ctx.parent_span_id
                             if metrics is not None:
                                 metrics.counter(
-                                    f"web.requests.{handler.__name__.lstrip('_')}"
+                                    f"web.requests.{route}"
                                 ).inc()
                                 with metrics.timer("web.request_ms").time():
                                     status, payload, ctype = self._run_handler(
@@ -220,7 +251,15 @@ class GeoMesaApp:
                                 status, payload, ctype = self._run_handler(
                                     handler, match.groups(), params, body
                                 )
-                        return self._respond(start_response, status, payload, ctype)
+                        extra = None
+                        if ctx is not None and ctx.sampled:
+                            extra = [(
+                                _obstrace.TRACE_RETURN_HEADER,
+                                _obstrace.serialize_subtree(sp),
+                            )]
+                        return self._respond(
+                            start_response, status, payload, ctype,
+                            extra_headers=extra)
             raise _HttpError(405 if matched_path else 404,
                              "method not allowed" if matched_path else "not found")
         except _HttpError as e:
@@ -291,17 +330,18 @@ class GeoMesaApp:
             if token is not None:
                 wd.complete(token, timed_out=abandoned)
 
-    def _respond(self, start_response, status, payload, ctype):
+    def _respond(self, start_response, status, payload, ctype,
+                 extra_headers=None):
         if isinstance(payload, (dict, list)):
             data = json.dumps(_jsonable(payload)).encode()
         elif payload is None:
             data = b""
         else:
             data = payload
-        start_response(
-            _STATUS[status],
-            [("Content-Type", ctype), ("Content-Length", str(len(data)))],
-        )
+        headers = [("Content-Type", ctype), ("Content-Length", str(len(data)))]
+        if extra_headers:
+            headers.extend(extra_headers)
+        start_response(_STATUS[status], headers)
         return [data]
 
     # -- handlers ------------------------------------------------------------
@@ -879,8 +919,19 @@ class GeoMesaApp:
             events = [json.loads(e.to_json()) for e in w.query_events(params.get("typeName"))]
         return 200, {"events": events}, "application/json"
 
+    def _obs_flight(self, params, body):
+        """The query-audit flight recorder (``geomesa-tpu obs flight``
+        pulls this): newest records, dump state, recorder config."""
+        from geomesa_tpu.obs import flight
+
+        limit = self._int_param(params, "limit")
+        return 200, flight.get().snapshot(limit=limit or 64), "application/json"
+
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
+        # the store's SLO engine (DataStore and MergedDataStoreView both
+        # carry one): burn rates / budgets ride the same scrape
+        slo_engine = getattr(self.store, "slo", None)
         if params.get("format") == "prometheus":
             # text exposition for a Prometheus scrape: the store registry
             # plus the process-wide jax telemetry registry (compile times,
@@ -892,8 +943,20 @@ class GeoMesaApp:
             )
 
             text = prometheus_text(m, jaxmon.GLOBAL)
+            if slo_engine is not None:
+                text += slo_engine.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
-        return 200, (m.snapshot() if m is not None else {}), "application/json"
+        out = m.snapshot() if m is not None else {}
+        if slo_engine is not None:
+            slo_snap = slo_engine.snapshot()
+            if slo_snap:
+                out["slo"] = slo_snap
+        # federated stores surface their per-member health scoreboard
+        # (rolling success rate, p95, breaker state) alongside the metrics
+        health = getattr(self.store, "member_health", None)
+        if health is not None:
+            out["federation_members"] = health()
+        return 200, out, "application/json"
 
     def _ogc(self, handler, error_cls, params):
         """Shared OGC KVP dispatch: route to the protocol handler, render

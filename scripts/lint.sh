@@ -25,6 +25,14 @@ GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis --race \
 # any instrumented hot path ships. Runs on the 8-device virtual CPU mesh.
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 
+# federation observability gate: distributed-trace stitching across live
+# in-process members, the ALWAYS-ON flight-recorder overhead bound (<2%
+# on the cached-jit select path), Perfetto track association under
+# concurrency, and SLO burn-rate exposition. The flight/slo locks it
+# exercises are leaves of the canonical hierarchy — the --race pass above
+# must stay clean with them in the tree (docs/concurrency.md).
+JAX_PLATFORMS=cpu python -m pytest tests/test_obs_federation.py -q
+
 # tpurace dynamic prong: the Eraser-style lock-order sanitizer wraps every
 # repo lock (tests/conftest.py) while the threaded tier-1 subset drives
 # REAL lock traffic — journal tailer + consumer groups + lambda persister +

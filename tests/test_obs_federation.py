@@ -1,0 +1,845 @@
+"""Federation-wide observability: distributed trace propagation and
+stitching, the always-on query-audit flight recorder, SLO burn rates,
+and the per-member health scoreboard (docs/observability.md).
+
+Doubles as the CI federation-observability gate in scripts/lint.sh —
+including the ALWAYS-ON flight-recorder overhead bound (<2% on the
+cached-jit select path) and the Perfetto (trace_id, thread) track
+regression.
+
+The acceptance pin (TestStitchedFederation::test_acceptance_federated_
+trace_flight_slo): a federated query through MergedDataStoreView over
+two live in-process HTTP members — one under GEOMESA_TPU_FAULTS-style
+5xx injection — produces ONE stitched trace with client spans, both
+members' remote span subtrees, retry-attempt span attributes, and a
+degraded-result span event; the flight recorder captures the audit
+record and an anomaly dump; the Prometheus exposition shows non-zero
+slo_burn_rate for the failing member.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.obs import flight as obs_flight
+from geomesa_tpu.obs import trace as obs_trace
+from geomesa_tpu.obs.export import chrome_trace_events
+from geomesa_tpu.obs.flight import FlightRecorder, QueryAuditRecord
+from geomesa_tpu.obs.slo import SloEngine, window_label
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience import faults as rfaults
+from geomesa_tpu.resilience.faults import FaultInjector
+from geomesa_tpu.resilience.policy import RetryPolicy
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.merged import MergedDataStoreView
+from geomesa_tpu.store.remote import RemoteDataStore
+from geomesa_tpu.web.app import GeoMesaApp
+
+T0 = 1_500_000_000_000
+CQL = "BBOX(geom,-180,-90,180,90)"
+
+
+@pytest.fixture(autouse=True)
+def _iso():
+    """Per-test isolation: tracing off + empty buffers, a pinned empty
+    fault injector, a fresh flight recorder (dumps off unless the test
+    configures a dir), and no leaked root-completion listeners."""
+    obs.disable()
+    obs.drain()
+    rfaults.install(FaultInjector())
+    prev_rec = obs_flight.install(
+        FlightRecorder(dump_dir=None, min_dump_interval_s=0.0))
+    listeners = list(obs_trace._root_listeners)
+    yield
+    obs_trace._root_listeners[:] = listeners
+    obs_flight.install(prev_rec)
+    rfaults.uninstall()
+    obs.disable()
+    obs.drain()
+
+
+def _filled_store(seed=1, n=80, name="f"):
+    rng = np.random.default_rng(seed)
+    ds = DataStore(backend="tpu")
+    ds.create_schema(name, "name:String,dtg:Date,*geom:Point")
+    ds.write(name, [
+        {"name": f"n{i % 5}", "dtg": T0 + i * 1000,
+         "geom": Point(float(rng.uniform(-170, 170)),
+                       float(rng.uniform(-40, 40)))}
+        for i in range(n)
+    ], fids=[f"{seed}-{i}" for i in range(n)])
+    return ds
+
+
+def _serve(app):
+    from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+    class _Quiet(WSGIRequestHandler):
+        def log_message(self, *a):
+            pass
+
+    httpd = make_server("127.0.0.1", 0, app, handler_class=_Quiet)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    return httpd, f"http://127.0.0.1:{port}", port
+
+
+@pytest.fixture(scope="module")
+def members(tmp_path_factory):
+    """Two live in-process HTTP members over real stores (module-scoped;
+    fault rules are picked per test, so sharing is safe)."""
+    from geomesa_tpu.stream.journal import JournalBus
+
+    out = []
+    buses = []
+    for seed in (1, 2):
+        store = _filled_store(seed=seed)
+        bus = JournalBus(str(tmp_path_factory.mktemp(f"jnl{seed}")),
+                         partitions=2)
+        httpd, url, port = _serve(GeoMesaApp(store, journal=bus))
+        out.append((store, url, port))
+        buses.append(bus)
+    yield out
+    for (store, _, _), bus in zip(out, buses):
+        bus.close()
+    # httpd shutdown: daemon threads; sockets die with the process
+
+
+def _fast_retry(**kw):
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("max_delay_s", 0.01)
+    kw.setdefault("seed", 1)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# header contract + span serialization (unit)
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_inject_extract_roundtrip(self):
+        with obs.collect("root") as root:
+            hdr = obs_trace.inject()
+            assert hdr is not None
+            ctx = obs_trace.extract(hdr)
+            assert ctx.trace_id == root.trace_id
+            assert ctx.parent_span_id == root.span_id
+            assert ctx.sampled
+        assert obs_trace.inject() is None  # untraced: no header
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "a;b", "a;b;c;d", ";;1", "t;;1", "x" * 300 + ";s;1",
+    ])
+    def test_extract_malformed(self, bad):
+        assert obs_trace.extract(bad) is None
+
+    def test_unsampled_flag_parsed(self):
+        ctx = obs_trace.extract("tid;sid;0")
+        assert ctx is not None and not ctx.sampled
+
+    def test_inject_honors_unsampled_join_downstream(self):
+        """A tree joined from an unsampled context must inject flags=0 on
+        its own outbound hops — the caller's sampling decision survives
+        the fan-out instead of being silently upgraded."""
+        with obs.collect("r"):
+            assert obs_trace.inject().endswith(";1")
+            with obs_trace.unsampled_join():
+                hdr = obs_trace.inject()
+                assert hdr.endswith(";0")
+                assert not obs_trace.extract(hdr).sampled
+            assert obs_trace.inject().endswith(";1")  # scope-bounded
+
+    def test_serialize_roundtrip_with_events(self):
+        with obs.collect("remote") as root:
+            with obs.span("scan", index="z3") as s:
+                s.event("hit", n=3)
+        enc = obs_trace.serialize_subtree(root)
+        sp = obs_trace.deserialize_subtree(enc, "trace-x", 5_000)
+        assert [x.name for x in sp.walk()] == ["remote", "scan"]
+        assert all(x.trace_id == "trace-x" for x in sp.walk())
+        scan = sp.children[0]
+        assert scan.attrs["index"] == "z3"
+        assert scan.events[0][0] == "hit" and scan.events[0][2] == {"n": 3}
+        assert sp.t0_ns == 5_000 and sp.t1_ns >= sp.t0_ns
+        # relative event/child times stay inside the root window
+        assert sp.t0_ns <= scan.t0_ns <= scan.t1_ns <= sp.t1_ns + 1
+
+    def test_serialize_prunes_oversized_trees(self):
+        import os as _os
+
+        with obs.collect("big") as root:
+            for i in range(400):
+                # incompressible payloads so zlib cannot dodge the cap
+                with obs.span(f"child{i}", payload=_os.urandom(60).hex()):
+                    pass
+        enc = obs_trace.serialize_subtree(root, max_bytes=2_000)
+        assert len(enc) <= 2_000
+        sp = obs_trace.deserialize_subtree(enc)
+        # pruned levels are marked, not silently dropped
+        assert sp.attrs.get("children_pruned", 0) > 0 or len(sp.children) < 400
+
+    def test_decompression_bomb_capped(self):
+        """A hostile member's X-Geomesa-Trace-Return must not expand into
+        hundreds of MB client-side: inflation stops at the cap, graft
+        ignores the payload, deserialize raises."""
+        import base64 as b64
+        import zlib
+
+        bomb = b64.b64encode(zlib.compress(b"\x00" * 64_000_000)).decode()
+        with obs.collect("c"):
+            with obs.span("rpc") as rpc:
+                pass
+        assert obs_trace.graft_serialized(rpc, bomb) is None
+        assert rpc.children == []
+        with pytest.raises(ValueError, match="inflates past"):
+            obs_trace.deserialize_subtree(bomb)
+
+    def test_graft_reanchors_inside_rpc_window(self):
+        with obs.collect("client"):
+            with obs.span("rpc") as rpc:
+                time.sleep(0.002)
+            # serialize a shorter 'remote' tree and graft it post-close
+            with obs.collect("remote") as remote:
+                pass
+        enc = obs_trace.serialize_subtree(remote)
+        grafted = obs_trace.graft_serialized(rpc, enc)
+        assert grafted is rpc.children[-1]
+        assert grafted.trace_id == rpc.trace_id
+        assert grafted.parent_id == rpc.span_id
+        assert rpc.t0_ns <= grafted.t0_ns
+        assert grafted.t1_ns <= rpc.t1_ns + 1
+        # garbage payload: ignored, never raises
+        assert obs_trace.graft_serialized(rpc, "!!not-base64!!") is None
+
+
+# ---------------------------------------------------------------------------
+# live round-trip through the web app (fault injection active)
+# ---------------------------------------------------------------------------
+
+class TestPropagationRoundTrip:
+    def test_retried_rpc_grafts_remote_subtree(self, members):
+        """One 503 then success: the RPC span shows the retry (attempt
+        count attribute + retry event) AND carries the remote member's
+        grafted span subtree in the same trace."""
+        _, url, port = members[0]
+        rfaults.install(FaultInjector().rule(
+            "http", status=503, times=1,
+            match=f"{port}/api/schemas/f/query"))
+        rds = RemoteDataStore(url, retry=_fast_retry())
+        with obs.collect("client") as root:
+            res = rds.query("f", CQL)
+        assert res.count == 80
+        rpcs = [s for s in root.find("rpc")
+                if "/query" in s.attrs.get("endpoint", "")]
+        assert len(rpcs) == 1
+        rpc = rpcs[0]
+        # the satellite pin: retried attempt count visible on the RPC span
+        assert rpc.attrs["attempts"] == 2
+        assert rpc.attrs["retries"] == 1
+        retry_events = [e for e in rpc.events if e[0] == "retry"]
+        assert len(retry_events) == 1
+        assert retry_events[0][2]["error"] == "HTTPError"
+        # remote subtree grafted, same trace end to end
+        https = rpc.find("http")
+        assert https and https[0].attrs["route"] == "query"
+        assert {s.trace_id for s in root.walk()} == {root.trace_id}
+        # remote serialize span nests under the remote http span
+        assert https[0].find("serialize")
+
+    def test_sampled_flag_honored_by_server(self, members):
+        """flags=0 joins ids without forcing a record: the server must
+        not return a span subtree for an unsampled context."""
+        _, url, _ = members[0]
+
+        def _get(flags):
+            req = urllib.request.Request(
+                f"{url}/api/version",
+                headers={obs_trace.TRACE_HEADER: f"tid-1;sid-1;{flags}"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.headers.get(obs_trace.TRACE_RETURN_HEADER)
+
+        assert _get(1) is not None
+        assert _get(0) is None
+
+    def test_malformed_trace_header_ignored(self, members):
+        _, url, _ = members[0]
+        req = urllib.request.Request(
+            f"{url}/api/version",
+            headers={obs_trace.TRACE_HEADER: "garbage"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get(obs_trace.TRACE_RETURN_HEADER) is None
+
+    def test_returned_subtree_joins_callers_trace_ids(self, members):
+        """The raw wire contract, no client grafting involved: a sampled
+        header alone makes the server return its span subtree."""
+        _, url, _ = members[0]
+        req = urllib.request.Request(
+            f"{url}/api/schemas/f/query?format=arrow",
+            headers={obs_trace.TRACE_HEADER: "trace-7;span-7;1"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            enc = r.headers[obs_trace.TRACE_RETURN_HEADER]
+        sp = obs_trace.deserialize_subtree(enc, "trace-7")
+        assert sp.name == "http"
+        assert sp.find("query"), "store query span missing from subtree"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin
+# ---------------------------------------------------------------------------
+
+class TestStitchedFederation:
+    def test_acceptance_federated_trace_flight_slo(self, members, tmp_path):
+        from geomesa_tpu.resilience.policy import CircuitBreaker
+
+        obs_flight.install(FlightRecorder(
+            dump_dir=str(tmp_path), min_dump_interval_s=0.0,
+            slow_ms=60_000.0))
+        _, url_a, _ = members[0]
+        _, url_b, port_b = members[1]
+        # member B: first query succeeds (its subtree is in the stitched
+        # tree), every later query 5xx-injects — the deterministic analog
+        # of the GEOMESA_TPU_FAULTS env grammar used by the chaos gate
+        rfaults.install(FaultInjector().rule(
+            "http", status=503, after=1,
+            match=f"{port_b}/api/schemas/f/query"))
+        ra = RemoteDataStore(url_a, retry=_fast_retry())
+        # long cooldown: the tripped breaker must still read "open" by the
+        # time the scoreboard asserts run
+        rb = RemoteDataStore(url_b, retry=_fast_retry(),
+                             breaker=CircuitBreaker(endpoint=url_b,
+                                                    cooldown_s=300.0))
+        view = MergedDataStoreView([ra, rb], on_member_error="partial")
+        results = []
+        with obs.collect("client") as root:
+            for _ in range(6):
+                results.append(view.query("f", CQL))
+
+        # partial results: q1 complete, later queries degraded
+        assert results[0].count == 160 and not results[0].degraded
+        assert all(r.degraded for r in results[1:])
+        assert all(r.count == 80 for r in results[1:])
+
+        # ONE stitched trace
+        assert {s.trace_id for s in root.walk()} == {root.trace_id}
+        fed = root.find("federation.query")
+        assert len(fed) == 6
+        # client spans + BOTH members' remote span subtrees
+        remote_routes = {
+            (h.attrs.get("route"), rpc.attrs["endpoint"])
+            for rpc in root.find("rpc") for h in rpc.find("http")
+        }
+        assert any(url_a in ep for r, ep in remote_routes if r == "query")
+        assert any(url_b in ep for r, ep in remote_routes if r == "query")
+        # retry-attempt span attributes on member B's failing RPCs
+        b_rpcs = [s for s in root.find("rpc")
+                  if url_b in s.attrs.get("endpoint", "")
+                  and "/query" in s.attrs["endpoint"]]
+        assert any(s.attrs.get("retries", 0) >= 1 for s in b_rpcs)
+        assert any(s.attrs.get("attempts", 0) >= 2 for s in b_rpcs)
+        # degraded-result span events
+        events = [e for f in fed for e in f.events]
+        assert any(e[0] == "member_error" and e[2]["member"] == 1
+                   for e in events)
+        assert any(e[0] == "degraded" for e in events)
+
+        # flight recorder: audit records for every federated query, the
+        # degraded ones anomalous; breaker_open shows once B's breaker
+        # trips mid-run
+        recs = [r for r in obs_flight.get().records()
+                if r.source == "federation"]
+        assert len(recs) == 6
+        assert not recs[0].degraded and recs[0].anomalies == ()
+        assert all(r.degraded and "degraded" in r.anomalies
+                   for r in recs[1:])
+        assert any("breaker_open" in r.anomalies for r in recs)
+        assert all(r.trace_id == root.trace_id for r in recs)
+        assert recs[1].members[1][1].startswith("error:")
+        # anomaly dump written when the root completed, with the full
+        # stitched tree inside
+        dumps = sorted(tmp_path.glob("flight-*.json"))
+        assert dumps, "no anomaly dump written"
+        doc = json.loads(dumps[-1].read_text())
+        assert doc["flight"]["trigger"]["trace_id"] == root.trace_id
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"client", "federation.query", "rpc"} <= names
+        assert any(r["degraded"] for r in doc["flight"]["recent"])
+
+        # SLO: non-zero burn rate for the failing member through the
+        # Prometheus endpoint of a front app over the view
+        _, front_url, _ = _serve(GeoMesaApp(view))
+        with urllib.request.urlopen(
+                front_url + "/api/metrics?format=prometheus",
+                timeout=10) as r:
+            text = r.read().decode()
+        burn = {}
+        for ln in text.splitlines():
+            if ln.startswith("geomesa_slo_burn_rate{") and 'window="5m"' in ln:
+                labels, val = ln.rsplit(" ", 1)
+                burn[labels] = float(val)
+        failing = [v for k, v in burn.items()
+                   if 'slo="federation.member"' in k and 'key="1"' in k]
+        healthy = [v for k, v in burn.items()
+                   if 'slo="federation.member"' in k and 'key="0"' in k]
+        assert failing and failing[0] > 0.0
+        assert healthy and healthy[0] == 0.0
+
+        # member scoreboard: breaker open, degraded success rate
+        health = view.member_health()
+        assert health[0]["breaker"] == "closed"
+        assert health[1]["breaker"] == "open"
+        assert health[1]["success_rate"] < health[0]["success_rate"]
+        assert health[1]["errors"] >= 4
+        # ... and the same scoreboard in the JSON metrics + explain
+        with urllib.request.urlopen(front_url + "/api/metrics",
+                                    timeout=10) as r:
+            snap = json.load(r)
+        assert snap["federation_members"][1]["breaker"] == "open"
+        assert "slo" in snap
+        ex = view.explain("f", CQL)
+        assert "Member health" in ex and "breaker=open" in ex
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def _rec(i: int, **kw):
+    kw.setdefault("ts", time.time())
+    kw.setdefault("op", "query")
+    kw.setdefault("type_name", f"t{i % 7}")
+    kw.setdefault("source", "store")
+    kw.setdefault("plan", f"plan-{i}")
+    kw.setdefault("latency_ms", float(i))
+    kw.setdefault("rows", i)
+    return QueryAuditRecord(**kw)
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_no_torn_records_concurrent(self):
+        """8 writers, bounded ring: capacity holds, every surviving
+        record is internally consistent (plan/rows/latency agree), and
+        the total count is exact."""
+        fr = FlightRecorder(capacity=64, dump_dir=None)
+        n_threads, per = 8, 200
+
+        def writer(t):
+            for i in range(per):
+                k = t * per + i
+                fr.record(_rec(k, plan=f"plan-{k}", rows=k,
+                               latency_ms=float(k)))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        recs = fr.records()
+        assert len(recs) == 64  # ring bound holds
+        assert fr.record_count == n_threads * per
+        for r in recs:  # torn-record check: fields written together
+            k = r.rows
+            assert r.plan == f"plan-{k}"
+            assert r.latency_ms == float(k)
+
+    def test_anomaly_dump_contains_triggering_trace(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=0.0,
+                            slow_ms=10_000.0)
+        obs_flight.install(fr)
+        with obs.collect("slowquery") as root:
+            with obs.span("scan"):
+                pass
+            obs_flight.record(op="query", type_name="f", degraded=True,
+                              latency_ms=5.0, rows=1)
+        # dump fires when the root completes, with the whole tree
+        dumps = list(tmp_path.glob("flight-*.json"))
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["flight"]["trigger"]["trace_id"] == root.trace_id
+        assert doc["flight"]["trigger"]["anomalies"] == ["degraded"]
+        assert {"slowquery", "scan"} <= {e["name"]
+                                         for e in doc["traceEvents"]}
+
+    def test_dump_without_tracing_and_throttle(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path),
+                            min_dump_interval_s=3600.0)
+        fr.record(_rec(1, degraded=True))
+        fr.record(_rec(2, degraded=True))
+        assert len(list(tmp_path.glob("flight-*.json"))) == 1  # throttled
+        assert fr.dump_count == 1
+
+    def test_failed_dump_releases_throttle_and_counts_nothing(self, tmp_path):
+        """A full/readonly dump dir: no phantom dump_count, no stale
+        last_dump, and the throttle window is released so the NEXT
+        anomaly (with a healthy disk) dumps immediately."""
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("x")  # makedirs(dump_dir) will raise
+        fr = FlightRecorder(dump_dir=str(blocker),
+                            min_dump_interval_s=3600.0)
+        fr.record(_rec(1, degraded=True))
+        assert fr.dump_count == 0 and fr.last_dump_path is None
+        good = tmp_path / "dumps"
+        fr.dump_dir = str(good)
+        fr.record(_rec(2, degraded=True))  # inside the 1h window
+        assert fr.dump_count == 1
+        assert list(good.glob("flight-*.json"))
+
+    def test_slow_threshold_flags(self):
+        fr = FlightRecorder(dump_dir=None, slow_ms=50.0)
+        fast = fr.record(_rec(1, latency_ms=10.0))
+        slow = fr.record(_rec(2, latency_ms=80.0))
+        assert fast.anomalies == ()
+        assert slow.anomalies == ("slow",)
+
+    def test_remote_owned_traces_never_park_pending(self, tmp_path):
+        """A federation member serving a sampled request must NOT park
+        anomaly dumps keyed by the caller's trace (the local propagated
+        root completing is not the stitched tree completing): the caller
+        dumps on its side, and parking here would fill the pending table
+        until the member's own dump feature died silently."""
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=0.0)
+        obs_flight.install(fr)
+        ctx = obs_trace.TraceContext("remote-trace", "remote-span", True)
+        for _ in range(3):
+            with obs_trace.propagated("http", ctx):
+                obs_flight.record(op="query", type_name="f",
+                                  degraded=True, latency_ms=1.0)
+        assert fr._pending == {}
+        assert fr.dump_count == 0
+        assert not list(tmp_path.glob("flight-*.json"))
+        # records themselves still land in the ring (the audit surface)
+        assert all(r.degraded for r in fr.records())
+
+    def test_pending_table_evicts_oldest_not_newest(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=0.0)
+        fr._pending_cap = 4
+        obs_flight.install(fr)
+        obs.enable(jax_telemetry=False)
+        try:
+            for i in range(6):
+                # six distinct never-completing traces: the table must
+                # keep the NEWEST four
+                sp = obs_trace.Span(f"r{i}", {}, None)
+                sp.__enter__()
+                obs_flight.record(op="query", type_name="f", degraded=True)
+                tok, sp._token = sp._token, None  # abandon: root never closes
+                obs_trace._current.reset(tok)
+        finally:
+            obs.disable()
+        assert len(fr._pending) == 4
+        kept = list(fr._pending)
+        assert all(any(r.trace_id == t for r in fr.records()[-4:])
+                   for t in kept)
+
+    def test_install_deregisters_stale_listener(self, tmp_path):
+        first = FlightRecorder(dump_dir=str(tmp_path),
+                               min_dump_interval_s=0.0)
+        obs_flight.install(first)
+        with obs.collect("r"):
+            obs_flight.record(op="query", type_name="f", degraded=True)
+        assert first._on_root in obs_trace._root_listeners
+        second = FlightRecorder(dump_dir=None)
+        obs_flight.install(second)
+        assert first._on_root not in obs_trace._root_listeners
+        assert not first._listener_installed  # re-registers if reinstalled
+        assert first._pending == {}
+
+    def test_flight_endpoint_and_store_wiring(self, members):
+        """DataStore._audit feeds the recorder on every query; the web
+        surface serves it."""
+        _, url, _ = members[0]
+        rds = RemoteDataStore(url, retry=_fast_retry())
+        rds.query("f", CQL)
+        with urllib.request.urlopen(url + "/api/obs/flight?limit=8",
+                                    timeout=10) as r:
+            doc = json.load(r)
+        assert doc["record_count"] >= 1
+        assert doc["records"], "no audit records served"
+        last = doc["records"][-1]
+        assert last["source"] == "store" and last["op"] == "query"
+        assert "scan" in last["breakdown"]
+
+    def test_always_on_overhead_under_2pct(self):
+        """The lint.sh gate: one flight record + one SLO observation per
+        query (what _audit adds, untraced) must cost < 2% of the
+        cached-jit select path's own p50."""
+        ds = _filled_store(seed=9, n=400, name="pts")
+        ds.compact("pts")  # the main-tier device path, not the hot tier
+        sel = ("BBOX(geom,-50,-40,50,40) AND dtg DURING "
+               "2017-07-14T02:40:00Z/2017-07-14T02:41:00Z")
+        ds.query("pts", sel)  # compile + plan-cache warm
+        lat = []
+        for _ in range(15):
+            t0 = time.perf_counter_ns()
+            ds.query("pts", sel)
+            lat.append(time.perf_counter_ns() - t0)
+        p50_ns = float(np.percentile(lat, 50))
+
+        eng = SloEngine()
+        N = 5_000
+
+        def per_call_ns():
+            t0 = time.perf_counter_ns()
+            for i in range(N):
+                obs_flight.record(op="query", type_name="pts", plan=CQL,
+                                  latency_ms=1.0, rows=10,
+                                  breakdown={"plan": 0.1, "scan": 0.9})
+                eng.observe("store.query", ok=True, key="pts",
+                            latency_ms=1.0)
+            return (time.perf_counter_ns() - t0) / N
+
+        cost = min(per_call_ns() for _ in range(3))
+        assert cost < 0.02 * p50_ns, (
+            f"always-on flight+slo cost {cost:.0f} ns "
+            f">= 2% of query p50 {p50_ns:.0f} ns")
+
+
+# ---------------------------------------------------------------------------
+# Perfetto track association (satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestPerfettoTracks:
+    def test_concurrent_traces_same_thread_get_distinct_tracks(self):
+        """Two federated queries' traces recorded on the SAME thread:
+        spans and their instant events must key tracks by
+        (trace_id, thread), never interleave on the raw thread id."""
+        roots = []
+        for tag in ("q1", "q2"):
+            with obs.collect(tag) as root:
+                with obs.span("federation.query") as f:
+                    f.event("member_error", member=1, error="HTTPError",
+                            tag=tag)
+            roots.append(root)
+        assert roots[0].thread_id == roots[1].thread_id  # same real thread
+        events = chrome_trace_events(roots)
+        span_tid = {}  # trace_id -> tids of its X events
+        for e in events:
+            if e["ph"] == "X":
+                span_tid.setdefault(e["args"]["trace_id"], set()).add(e["tid"])
+        t1, t2 = roots[0].trace_id, roots[1].trace_id
+        assert span_tid[t1].isdisjoint(span_tid[t2])
+        # each instant event sits on ITS OWN trace's track
+        for e in events:
+            if e["ph"] == "i":
+                tag = e["args"]["tag"]
+                want = t1 if tag == "q1" else t2
+                assert e["tid"] in span_tid[want], (
+                    f"instant {e['args']} on foreign track {e['tid']}")
+
+    def test_grafted_remote_threads_get_own_tracks(self, members):
+        _, url, _ = members[0]
+        rds = RemoteDataStore(url, retry=_fast_retry())
+        with obs.collect("client") as root:
+            rds.query("f", CQL)
+        events = chrome_trace_events(root)
+        # one pid, metadata names every (trace, thread) track
+        meta = [e for e in events if e["ph"] == "M"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert {e["tid"] for e in xs} == {e["tid"] for e in meta}
+        assert all(root.trace_id in e["args"]["name"] for e in meta)
+
+
+# ---------------------------------------------------------------------------
+# RemoteJournal tailer session span (satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestTailerSessionSpan:
+    def test_stable_root_per_tail_session_no_orphans(self, members):
+        from geomesa_tpu.stream.remote_journal import RemoteJournal
+
+        _, url, _ = members[0]
+        store, _, _ = members[0]
+        got = []
+        obs.enable(jax_telemetry=False)
+        try:
+            rj = RemoteJournal(url, poll_interval_s=0.005,
+                               retry=_fast_retry())
+            rj.subscribe("topicX", got.append)
+            # publish through the server so the tailer sees real traffic
+            import base64
+
+            body = json.dumps({
+                "key": "k", "data_b64": base64.b64encode(b"v1").decode(),
+            }).encode()
+            req = urllib.request.Request(
+                url + "/api/journal/topicX/publish", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+            deadline = time.time() + 10
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [b"v1"]
+            rj.close()
+        finally:
+            obs.disable()
+        roots = obs.drain()
+        tails = [r for r in roots if r.name == "journal.tail"]
+        # ONE stable session root; per-poll rpc spans nest under it
+        assert len(tails) == 1
+        session = tails[0]
+        assert session.attrs["topic"] == "topicX"
+        assert session.attrs["polls"] >= 1
+        assert all(c.name == "rpc" for c in session.children)
+        assert len(session.children) <= 64  # long-session bound
+        # the bugfix pin: NO orphan rpc roots from the tail loop
+        assert [r.name for r in roots if r.name == "rpc"] == []
+
+    def test_failure_and_backoff_recorded_as_events(self):
+        from geomesa_tpu.stream.remote_journal import RemoteJournal
+
+        obs.enable(jax_telemetry=False)
+        try:
+            rj = RemoteJournal("http://127.0.0.1:9", timeout_s=0.2,
+                               poll_interval_s=0.005, retry=_fast_retry())
+            rj.subscribe("t", lambda b: None)
+            deadline = time.time() + 10
+            while rj.consecutive_failures < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert rj.consecutive_failures >= 2
+            assert not rj.healthy()
+            rj.close()
+        finally:
+            obs.disable()
+        tails = [r for r in obs.drain() if r.name == "journal.tail"]
+        assert len(tails) == 1
+        errs = [e for e in tails[0].events if e[0] == "tail_error"]
+        assert len(errs) >= 2
+        # consecutive-failure counter climbs; backoff state attached
+        assert [e[2]["consecutive"] for e in errs[:2]] == [1, 2]
+        assert all(e[2]["backoff_ms"] >= 0 for e in errs)
+
+    def test_tracing_enabled_mid_session_still_no_orphans(self, members):
+        """Tracing turned on AFTER subscribe(): the tail loop opens its
+        stable root late — per-poll rpc spans must still nest under one
+        session root, not flood the buffer as orphan roots."""
+        from geomesa_tpu.stream.remote_journal import RemoteJournal
+
+        _, url, _ = members[0]
+        rj = RemoteJournal(url, poll_interval_s=0.005, retry=_fast_retry())
+        rj.subscribe("late-topic", lambda b: None)  # tracing OFF here
+        time.sleep(0.05)
+        obs.enable(jax_telemetry=False)
+        try:
+            time.sleep(0.25)  # several traced polls
+            rj.close()
+        finally:
+            obs.disable()
+        roots = obs.drain()
+        tails = [r for r in roots if r.name == "journal.tail"]
+        assert len(tails) == 1
+        assert tails[0].attrs.get("polls", 0) >= 1
+        assert all(c.name == "rpc" for c in tails[0].children)
+        assert [r.name for r in roots if r.name == "rpc"] == []
+
+    def test_session_tree_bounded_during_persistent_outage(self):
+        """The trim must run on the FAILURE path too: a long outage
+        appends one rpc child + one tail_error event per round, and the
+        session tree has to stay bounded without a single successful
+        poll."""
+        from geomesa_tpu.stream.remote_journal import RemoteJournal
+
+        obs.enable(jax_telemetry=False)
+        try:
+            rj = RemoteJournal(
+                "http://127.0.0.1:9", timeout_s=0.2, poll_interval_s=0.001,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.0005,
+                                  max_delay_s=0.002, seed=5))
+            rj.subscribe("t", lambda b: None)
+            deadline = time.time() + 30
+            while rj.consecutive_failures < 140 and time.time() < deadline:
+                time.sleep(0.02)
+            assert rj.consecutive_failures >= 140, "outage loop too slow"
+            rj.close()
+        finally:
+            obs.disable()
+        tails = [r for r in obs.drain() if r.name == "journal.tail"]
+        assert len(tails) == 1
+        assert len(tails[0].children) <= 64
+        assert len(tails[0].events) <= 128
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+class TestSloEngine:
+    def test_burn_rate_and_budget_math(self):
+        t = [1000.0]
+        eng = SloEngine(clock=lambda: t[0])
+        eng.objective("api", target=0.99, windows=(300.0, 3600.0))
+        for i in range(100):
+            eng.observe("api", ok=(i % 10 != 0), latency_ms=5.0)  # 10% bad
+        tk = eng.tracker("api")
+        # 10% errors against a 1% budget: burning 10x
+        assert tk.burn_rate(300.0, now=t[0]) == pytest.approx(10.0)
+        assert tk.budget_remaining(300.0, now=t[0]) == 0.0
+        # outside the 5m window the errors age out; 1h still sees them
+        t[0] += 1200.0
+        eng.observe("api", ok=True, latency_ms=5.0)
+        assert tk.burn_rate(300.0, now=t[0]) == pytest.approx(0.0)
+        assert tk.burn_rate(3600.0, now=t[0]) > 0.0
+
+    def test_no_data_is_healthy(self):
+        eng = SloEngine()
+        tk = eng.tracker("idle")
+        assert tk.burn_rate(300.0) == 0.0
+        assert tk.budget_remaining(300.0) == 1.0
+        assert eng.prometheus_text() != ""  # tracker exists -> lines exist
+
+    def test_latency_objective_burns_on_slow_success(self):
+        t = [0.0]
+        eng = SloEngine(clock=lambda: t[0])
+        eng.objective("lat", target=0.9, latency_ms=100.0)
+        eng.observe("lat", ok=True, latency_ms=50.0)
+        eng.observe("lat", ok=True, latency_ms=500.0)  # slow success
+        tk = eng.tracker("lat")
+        # 1 of 2 bad against a 10% budget
+        assert tk.burn_rate(300.0, now=t[0]) == pytest.approx(5.0)
+        p50, p95, p99 = tk.latency_quantiles()
+        assert p95 > 100.0
+
+    def test_prometheus_exposition_shape(self):
+        eng = SloEngine()
+        eng.objective("federation.member", target=0.999)
+        eng.observe("federation.member", ok=False, latency_ms=3.0, key="2")
+        text = eng.prometheus_text()
+        assert "# TYPE geomesa_slo_burn_rate gauge" in text
+        assert "# TYPE geomesa_slo_budget_remaining gauge" in text
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("geomesa_slo_burn_rate{"))
+        assert 'slo="federation.member"' in line
+        assert 'key="2"' in line and 'window="5m"' in line
+        assert float(line.rsplit(" ", 1)[1]) > 0.0
+
+    def test_window_labels(self):
+        assert window_label(300.0) == "5m"
+        assert window_label(3600.0) == "1h"
+        assert window_label(45.0) == "45s"
+
+    def test_engine_snapshot_json(self):
+        eng = SloEngine()
+        eng.observe("x", ok=True, latency_ms=2.0, key="a")
+        snap = eng.snapshot()
+        assert "x.a" in snap
+        assert "5m" in snap["x.a"]["windows"]
+        assert snap["x.a"]["windows"]["5m"]["budget_remaining"] == 1.0
+
+    def test_datastore_observes_queries_and_timeouts(self):
+        from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
+
+        ds = _filled_store(seed=3, n=50)
+        ds.query("f", CQL)
+        tk = ds.slo.tracker("store.query", key="f")
+        assert tk.burn_rate(300.0) == 0.0
+        spent = Deadline.after_ms(0.0)
+        with pytest.raises(QueryTimeout):
+            ds.query("f", Query(filter=CQL, hints={"deadline": spent}))
+        assert tk.burn_rate(300.0) > 0.0

@@ -434,7 +434,11 @@ class TestCorruptPayload:
             (0, "CorruptPayloadError", r.member_errors[0][2])
         ]
         assert view.metrics.counter("federation.member_errors").count == 1
-        assert [e[0] for e in root.events] == ["member_error", "degraded"]
+        # degradations mark the view's own federation.query span (one per
+        # query since the distributed-tracing work), inside this trace
+        fed = root.find("federation.query")
+        assert len(fed) == 1
+        assert [e[0] for e in fed[0].events] == ["member_error", "degraded"]
 
     def test_fail_mode_raises_on_corrupt_member(self, remote_server):
         _, url, port = remote_server
