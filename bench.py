@@ -53,6 +53,30 @@ if "--chaos" in sys.argv:
     sys.argv.remove("--chaos")
     os.environ["GEOMESA_BENCH_CHAOS"] = "1"
 
+
+def _pop_flag_arg(flag: str) -> "str | None":
+    """Remove ``flag <value>`` from argv; returns the value or None."""
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv):
+        print(f"usage: bench.py [{flag} <path>]", file=sys.stderr)
+        sys.exit(2)
+    v = sys.argv[i + 1]
+    del sys.argv[i : i + 2]
+    return v
+
+
+# continuous perf-regression gate (docs/operations.md § Benchmarks):
+#   --regress <baseline.json>          compare a fresh median-of-K run
+#                                      against the committed baseline;
+#                                      exit 1 on >threshold regression
+#   --regress-capture <out.json>       write a fresh baseline file
+#   --regress-report <path>            also write the full report JSON
+_REGRESS_BASELINE = _pop_flag_arg("--regress")
+_REGRESS_CAPTURE = _pop_flag_arg("--regress-capture")
+_REGRESS_REPORT = _pop_flag_arg("--regress-report")
+
 # The axon site hook force-registers the TPU relay backend and sets
 # jax_platforms="axon,cpu" at interpreter start, overriding the env var —
 # honor an explicit JAX_PLATFORMS (e.g. the CPU fallback after the backend
@@ -71,6 +95,24 @@ from geomesa_tpu.ops.refine import pack_boxes, pack_times
 CONFIG = os.environ.get("GEOMESA_BENCH_CONFIG", "2")
 Q = int(os.environ.get("GEOMESA_BENCH_Q", 64))
 ITERS = int(os.environ.get("GEOMESA_BENCH_ITERS", 20))
+
+# THE canonical headline unit per config — one registry so every unit
+# survives `_compact`'s fixed-width field intact (config 8's old prose
+# unit truncated to "Grows/s/chip (each row m" in the driver record;
+# explanatory prose now rides in each config's detail, never the unit).
+# tests/test_bench_harness.py pins the round-trip.
+UNITS = {
+    "1": "ms/query",
+    "2": "ms/query",
+    "3": "ms/point",
+    "4": "Gpairs/s",
+    "5": "ms/query",
+    "6": "ms/query",
+    "7": "ms/query",
+    "8": "Grows/s/chip",
+    "9": "ms/query",
+    "chaos": "ms p99",
+}
 T0 = 1_498_867_200_000  # 2017-07-01, GDELT-era
 PERIOD = TimePeriod.DAY  # ms offsets: time predicate exact in int domain
 SPAN_DAYS = 30
@@ -357,7 +399,7 @@ def bench_z3():
     return {
         "metric": "gdelt_z3_bbox_time_batched_query_p50_latency",
         "value": round(tpu_per_query, 4),
-        "unit": "ms/query",
+        "unit": UNITS["2"],
         "vs_baseline": round(cpu_per_query / tpu_per_query, 2),
         "detail": {
             "n_points": N,
@@ -427,7 +469,7 @@ def bench_z2():
     return {
         "metric": "gdelt_z2_bbox_batched_query_p50_latency",
         "value": round(tpu_per_query, 4),
-        "unit": "ms/query",
+        "unit": UNITS["1"],
         "vs_baseline": round(cpu_per_query / tpu_per_query, 2),
         "detail": {
             "n_points": N, "n_queries": Q, "devices": jax.device_count(),
@@ -540,7 +582,7 @@ def bench_knn_density():
     return {
         "metric": "knn_batched_p50_latency_100m",
         "value": round(knn_per_point, 4),
-        "unit": "ms/point",
+        "unit": UNITS["3"],
         "vs_baseline": round(cpu_knn_per_point / knn_per_point, 2),
         "detail": {
             "n_points": N, "devices": jax.device_count(),
@@ -695,7 +737,7 @@ def bench_join():
         # index's work-avoidance shows up separately (prune_speedup_factor,
         # effective_gpairs_per_s), never silently inside the headline unit
         "value": round(tested_per_s / 1e9, 4),
-        "unit": "Gpairs/s",
+        "unit": UNITS["4"],
         # end-to-end speedup for the same logical join (pruning + kernel)
         # vs the brute-force per-pair CPU engine
         "vs_baseline": round(pairs_per_s / cpu_pairs_per_s, 2),
@@ -795,7 +837,7 @@ def bench_xz2():
     return {
         "metric": "xz2_linestring_bbox_query_p50_latency",
         "value": round(xz_per_query, 4),
-        "unit": "ms/query",
+        "unit": UNITS["5"],
         "vs_baseline": round(cpu_per_query / xz_per_query, 2),
         "detail": {
             "n_trajectories": M, "n_queries": Q, "devices": jax.device_count(),
@@ -938,7 +980,7 @@ def bench_select():
     return {
         "metric": "mesh_select_rows_p50_latency",
         "value": round(head, 3),
-        "unit": "ms/query",
+        "unit": UNITS["6"],
         "vs_baseline": round(cpu_per_query / head, 2),
         "detail": {
             "mode": "batched-select-many" if use_batched else "per-query",
@@ -1134,7 +1176,7 @@ def bench_resident():
     return {
         "metric": "resident_125m_scan_device_time_per_query",
         "value": round(head_ms_q, 5),
-        "unit": "ms/query",
+        "unit": UNITS["7"],
         "vs_baseline": round(head_x, 2),
         "detail": {
             "path": "z-index-pruned" if use_pruned else "full-scan",
@@ -1346,7 +1388,8 @@ def bench_stream_1b():
     return {
         "metric": "stream_1b_scan_throughput",
         "value": round(rows_per_s / 1e9, 4),
-        "unit": "Grows/s/chip (each row matched against all Q queries)",
+        "unit": UNITS["8"],
+        "unit_note": "each row matched against all Q queries",
         "vs_baseline": round(tpu_rowq_per_s / cpu_rowq_per_s, 1),
         "detail": {
             "total_rows": total_rows,
@@ -1492,7 +1535,7 @@ def bench_grouped_agg():
     return {
         "metric": "grouped_agg_p50_latency",
         "value": round(per_query_ms, 4),
-        "unit": "ms/query",
+        "unit": UNITS["9"],
         "vs_baseline": round(host_ms / per_query_ms, 2),
         "detail": {
             "n_points": N, "groups": G, "queries": qn,
@@ -1611,7 +1654,8 @@ def bench_chaos():
         return {
             "metric": "chaos_p99_ms",
             "value": round(chaos["p99_ms"], 3),
-            "unit": "ms (federated query p99 under 30% member 5xx)",
+            "unit": UNITS["chaos"],
+            "unit_note": "federated query p99 under 30% member 5xx",
             "vs_baseline": None if inflation is None else round(inflation, 3),
             "detail": {
                 "members": 3, "rows_per_member": n_per, "iters": iters,
@@ -1789,6 +1833,208 @@ def _run_config(cfg: str, retries: int = 1, deadline: float | None = None) -> di
             "vs_baseline": None, "error": last_err}
 
 
+# ---------------------------------------------------------------------------
+# Continuous perf-regression gate (--regress / --regress-capture)
+# ---------------------------------------------------------------------------
+# Median-of-K noise-aware comparison of a fresh run against a committed
+# baseline (a --regress-capture file, a BENCH_DETAIL.json from a real-chip
+# round, or a prior --regress-report). Exit 0 = no parity config regressed
+# beyond the threshold; exit 1 = regression (or a config that failed to
+# produce a number / lost result-set parity — both are gate failures).
+# Knobs: GEOMESA_BENCH_REGRESS_K (median-of-K, default 3),
+# GEOMESA_BENCH_REGRESS_PCT (threshold, default 15),
+# GEOMESA_BENCH_REGRESS_CONFIGS (comma list, default = baseline configs),
+# GEOMESA_BENCH_INJECT_SLOWDOWN (self-test factor: worsens the measured
+# value before comparison so the gate's own red path stays testable),
+# GEOMESA_BENCH_REGRESS_MEASURED (reuse a prior report's measured values
+# instead of re-running — the deterministic red leg in scripts/bench_gate.sh).
+
+
+def _unit_direction(unit: str) -> str:
+    """Which way is worse: ``lower``-is-better (latency units) or
+    ``higher``-is-better (throughput units, marked by ``/s``)."""
+    return "higher" if "/s" in (unit or "") else "lower"
+
+
+def _load_regress_baseline(path: str) -> dict:
+    """``cfg -> {"value", "unit", "parity"}`` from any of the three
+    on-disk shapes: a ``--regress-capture`` file, a ``BENCH_DETAIL.json``
+    sweep record, or a ``--regress-report`` (its *measured* values become
+    the baseline)."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = {}
+    for cfg, r in (doc.get("configs") or {}).items():
+        if not isinstance(r, dict):
+            continue
+        value = r.get("value", r.get("measured"))
+        if value is None:
+            continue
+        parity = r.get("parity")
+        if parity is None:
+            flags = _parity_flags(r.get("detail") or {})
+            parity = all(flags) if flags else None
+        out[cfg] = {
+            "value": float(value),
+            "unit": r.get("unit") or UNITS.get(cfg, ""),
+            "parity": parity,
+        }
+    return out
+
+
+def _regress_compare(baseline: float, measured: float, unit: str,
+                     threshold_pct: float, slowdown: float = 1.0) -> dict:
+    """One config's verdict. ``delta_pct`` is positive-when-worse in the
+    unit's direction; ``slowdown`` > 1 synthetically worsens the measured
+    value first (the gate's self-test)."""
+    direction = _unit_direction(unit)
+    if direction == "lower":
+        adj = measured * slowdown
+        delta_pct = (adj - baseline) / baseline * 100.0
+    else:
+        adj = measured / slowdown
+        delta_pct = (baseline - adj) / baseline * 100.0
+    out = {
+        "baseline": baseline,
+        "measured": measured,
+        "unit": unit,
+        "direction": direction,
+        "delta_pct": round(delta_pct, 2),
+        "regressed": delta_pct > threshold_pct,
+    }
+    if slowdown != 1.0:
+        out["injected_slowdown"] = slowdown
+        out["adjusted"] = round(adj, 6)
+    return out
+
+
+def _regress_verdict(b: dict, m: dict, threshold_pct: float,
+                     slowdown: float = 1.0) -> dict:
+    """One config's full verdict: the speed comparison plus the gating
+    decision. Speed noise on a config with NO parity referee never blocks
+    a merge (``gating`` False), but LOSING result-set parity on a fresh
+    run always does — a wrong answer is worse than a slow one, so a
+    parity failure gates even where speed alone would not."""
+    verdict = _regress_compare(
+        b["value"], m["value"], b["unit"], threshold_pct, slowdown)
+    verdict["parity"] = m.get("parity")
+    verdict["values"] = m.get("values")
+    parity_failure = m.get("parity") is False
+    if parity_failure:
+        verdict["regressed"] = True
+        verdict["parity_failure"] = True
+    verdict["gating"] = bool(b.get("parity") is True or parity_failure)
+    return verdict
+
+
+def _regress_measure(cfg: str, k: int, deadline: float) -> dict:
+    """Median-of-K measurement of one config, each run an isolated
+    subprocess (the sweep's crash/hang containment applies here too)."""
+    values, units, parities, errors = [], [], [], []
+    for _ in range(k):
+        r = _run_config(cfg, retries=0, deadline=deadline)
+        if r.get("value") is None:
+            errors.append(str(r.get("error", "no value")))
+            continue
+        values.append(float(r["value"]))
+        units.append(r.get("unit") or UNITS.get(cfg, ""))
+        flags = _parity_flags(r.get("detail") or {})
+        parities.append(all(flags) if flags else None)
+    if not values:
+        return {"value": None, "error": "; ".join(errors)[:300]}
+    seen = [p for p in parities if p is not None]
+    return {
+        "value": float(np.median(values)),
+        "values": [round(v, 6) for v in values],
+        "unit": units[0],
+        "parity": all(seen) if seen else None,
+        "k": len(values),
+    }
+
+
+def _regress_selected(base: dict) -> list:
+    sel = os.environ.get("GEOMESA_BENCH_REGRESS_CONFIGS", "")
+    if sel.strip():
+        return [c.strip() for c in sel.split(",") if c.strip()]
+    return sorted(c for c in base if c in BENCHES) or ["2"]
+
+
+def _regress_env() -> tuple:
+    k = int(os.environ.get("GEOMESA_BENCH_REGRESS_K", "3"))
+    threshold = float(os.environ.get("GEOMESA_BENCH_REGRESS_PCT", "15"))
+    budget_s = float(os.environ.get("GEOMESA_BENCH_BUDGET_S", 5400))
+    return max(k, 1), threshold, time.monotonic() + budget_s
+
+
+def _regress_capture_main(out_path: str) -> None:
+    """``--regress-capture``: measure the selected configs and write a
+    baseline file the next ``--regress`` run compares against."""
+    k, _, deadline = _regress_env()
+    cfgs = _regress_selected(dict.fromkeys(BENCHES))
+    doc = {"kind": "bench-regress-baseline", "k": k, "configs": {}}
+    ok = True
+    for cfg in cfgs:
+        _mark(f"regress-capture: config {cfg} x{k}")
+        m = _regress_measure(cfg, k, deadline)
+        doc["configs"][cfg] = m
+        ok = ok and m.get("value") is not None
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({"metric": "regress_capture", "value": len(cfgs),
+                      "unit": "configs", "vs_baseline": None,
+                      "detail": {"path": out_path, "ok": ok}}))
+    sys.exit(0 if ok else 1)
+
+
+def _regress_main(baseline_path: str) -> None:
+    """``--regress <baseline.json>``: the gate itself."""
+    base = _load_regress_baseline(baseline_path)
+    k, threshold, deadline = _regress_env()
+    slowdown = float(os.environ.get("GEOMESA_BENCH_INJECT_SLOWDOWN", "1.0"))
+    reuse_path = os.environ.get("GEOMESA_BENCH_REGRESS_MEASURED")
+    reuse = _load_regress_baseline(reuse_path) if reuse_path else None
+    report = {
+        "kind": "bench-regress-report",
+        "baseline": baseline_path,
+        "k": k,
+        "threshold_pct": threshold,
+        "injected_slowdown": slowdown,
+        "configs": {},
+    }
+    regressed = []
+    for cfg in _regress_selected(base):
+        b = base.get(cfg)
+        if b is None:
+            report["configs"][cfg] = {"skipped": "not in baseline"}
+            continue
+        if reuse is not None:
+            m = reuse.get(cfg) or {"value": None,
+                                   "error": "not in measured-reuse file"}
+        else:
+            _mark(f"regress: config {cfg} x{k} vs {b['value']} {b['unit']}")
+            m = _regress_measure(cfg, k, deadline)
+        if m.get("value") is None:
+            # a config that cannot produce a number cannot prove it did
+            # not regress — the gate fails closed
+            report["configs"][cfg] = {
+                "baseline": b["value"], "measured": None,
+                "error": m.get("error", "no value"), "regressed": True,
+            }
+            regressed.append(cfg)
+            continue
+        verdict = _regress_verdict(b, m, threshold, slowdown)
+        report["configs"][cfg] = verdict
+        if verdict["regressed"] and verdict["gating"]:
+            regressed.append(cfg)
+    report["regressed"] = regressed
+    report["ok"] = not regressed
+    if _REGRESS_REPORT:
+        with open(_REGRESS_REPORT, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    sys.exit(0 if not regressed else 1)
+
+
 def _trace_path(suffix_config: bool) -> str | None:
     p = os.environ.get("GEOMESA_TPU_TRACE")
     if not p:
@@ -1834,6 +2080,12 @@ def _child_main():
 
 
 def main():
+    if _REGRESS_CAPTURE:
+        _regress_capture_main(_REGRESS_CAPTURE)
+        return
+    if _REGRESS_BASELINE:
+        _regress_main(_REGRESS_BASELINE)
+        return
     if os.environ.get("GEOMESA_BENCH_CHAOS") == "1":
         # standalone chaos mode (bench.py --chaos): never part of the
         # driver sweep — it measures resilience posture, not throughput

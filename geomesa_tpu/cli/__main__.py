@@ -468,6 +468,32 @@ def cmd_obs_flight(args):
               f"{rec['plan'][:60]}{extra}")
 
 
+def cmd_obs_costs(args):
+    """Pull a server's per-(type, plan-signature) observed-cost table
+    (``GET /api/obs/costs``) — p50/p95 device-ms and wall-ms per plan
+    shape, the capacity-planning companion to ``obs flight``
+    (docs/observability.md § Device telemetry & cost profiles)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/api/obs/costs?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    entries = doc.get("entries", [])
+    print(f"cost profiles: {doc.get('entry_count', len(entries))} "
+          f"(type, plan-signature) entries")
+    print(f"{'type':<14s} {'signature':<28s} {'n':>6s} {'prof':>5s} "
+          f"{'wall p50':>9s} {'wall p95':>9s} {'dev p50':>8s} "
+          f"{'rows p50':>9s} {'scan B p50':>11s}")
+    for e in entries:
+        print(f"{e['type']:<14s} {e['signature']:<28s} {e['count']:>6d} "
+              f"{e['profiled']:>5d} {e['wall_ms_p50']:>9.2f} "
+              f"{e['wall_ms_p95']:>9.2f} {e['device_ms_p50']:>8.2f} "
+              f"{e['rows_p50']:>9.1f} {int(e['bytes_scanned_p50']):>11d}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="geomesa-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -630,18 +656,29 @@ def main(argv=None):
     g.add_argument("-q", "--cql", help="delete every feature matching")
     sp.set_defaults(fn=cmd_delete_features)
 
-    sp = sub.add_parser("obs", help="observability surfaces (flight recorder)")
+    sp = sub.add_parser(
+        "obs", help="observability surfaces (flight recorder, cost profiles)"
+    )
     obs_sub = sp.add_subparsers(dest="obs_command", required=True)
+
+    def obs_common(osp):
+        osp.add_argument("--url", required=True,
+                         help="server base URL, e.g. http://host:8080")
+        osp.add_argument("--limit", type=int, default=32)
+        osp.add_argument("--timeout", type=float, default=10.0)
+        osp.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the table rendering")
+
     fl = obs_sub.add_parser(
         "flight", help="pull a server's query-audit flight recorder"
     )
-    fl.add_argument("--url", required=True,
-                    help="server base URL, e.g. http://host:8080")
-    fl.add_argument("--limit", type=int, default=32)
-    fl.add_argument("--timeout", type=float, default=10.0)
-    fl.add_argument("--json", action="store_true",
-                    help="raw JSON instead of the table rendering")
+    obs_common(fl)
     fl.set_defaults(fn=cmd_obs_flight)
+    co = obs_sub.add_parser(
+        "costs", help="pull a server's per-plan-shape observed-cost table"
+    )
+    obs_common(co)
+    co.set_defaults(fn=cmd_obs_costs)
 
     args = p.parse_args(argv)
     try:

@@ -1,6 +1,6 @@
 """geomesa_tpu.obs — end-to-end query observability.
 
-Five layers (see docs/observability.md):
+Six layers (see docs/observability.md):
 
 - :mod:`~geomesa_tpu.obs.trace` — hierarchical spans with ContextVar
   propagation, a zero-overhead no-op path when disabled, and the
@@ -15,6 +15,9 @@ Five layers (see docs/observability.md):
   recorder (bounded ring + anomaly dumps).
 - :mod:`~geomesa_tpu.obs.slo` — SLO objectives, multi-window burn rates,
   error-budget exposition.
+- :mod:`~geomesa_tpu.obs.devmon` — device telemetry: the HBM residency
+  ledger, sampled per-query device-time attribution (devprof), and the
+  per-(type, plan-signature) observed-cost table.
 
 This package imports no jax at module level: ``GEOMESA_TPU_NO_JAX=1``
 processes (tpulint in CI) can import every instrumented module.

@@ -1,0 +1,601 @@
+"""Device telemetry: HBM residency ledger, per-query device-time
+attribution, and the online cost-profile table.
+
+The rest of the obs stack sees the *host* pipeline end to end; this module
+opens the device black box with three substrates (docs/observability.md
+§ Device telemetry & cost profiles):
+
+- :class:`ResidencyLedger` — every device allocation the backend makes
+  (:meth:`geomesa_tpu.store.backends.TpuBackend.load`, the grouped-agg
+  staging cache) registers here as (type, index, column group, bytes),
+  and unregisters automatically when the owning state object is dropped
+  (eviction, reload, compaction — a ``weakref.finalize`` per entry, so no
+  invalidation protocol can be forgotten). Exposes live
+  ``geomesa_device_resident_bytes{type,index,group}`` gauges, headroom
+  against the backend's ``max_device_bytes`` budget, and the
+  host-resident-spill report — the accounting layer a buffer-pool
+  eviction policy (ROADMAP item 1) sits on.
+
+- :func:`profiled` / :class:`DevProfile` — the sampled per-query
+  device-time attribution mode (``GEOMESA_TPU_DEVPROF`` env or the
+  ``devprof`` query hint). While a profile is active on the context,
+  :func:`geomesa_tpu.obs.jaxmon.observed` brackets each cached-jit
+  dispatch with ``block_until_ready`` timing so the query's wall time
+  splits into compile / dispatch / device-compute / h2d / d2h. The
+  OFF path costs one module-global flag check per dispatch (the <2%
+  bound on the cached-jit select path is asserted in
+  ``tests/test_devmon.py`` and gated in ``scripts/lint.sh``).
+
+- :class:`CostTable` — attribution records aggregate into an online
+  per-(type, plan-signature) cost profile (p50/p95 device-ms and wall-ms,
+  bytes scanned, rows returned), served at ``GET /api/obs/costs`` and
+  rendered by ``explain(analyze=True)`` as predicted-vs-actual. Read-only
+  for now: it is exactly the observed-cost table the adaptive planner
+  (ROADMAP item 3) will consume.
+
+Locking: the ledger and cost table each own one leaf lock (same tier as
+the metrics-registry locks — docs/concurrency.md); no blocking calls run
+under either. No jax at module level (``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import threading
+import weakref
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+__all__ = [
+    "DEVPROF_ENV", "CostTable", "DevProfile", "ResidencyLedger",
+    "costs", "current_profile", "device_report", "install", "ledger",
+    "plan_signature", "profiled", "prometheus_text", "sampled",
+]
+
+DEVPROF_ENV = "GEOMESA_TPU_DEVPROF"
+
+# canonical column-group names (the residency unit ROADMAP item 1's
+# eviction policy will reason about)
+GROUP_SPATIAL = "spatial"  # x/y/bins/offs point layout
+GROUP_BBOX = "bbox"  # xmin/ymin/xmax/ymax/bins/offs overlap layout
+GROUP_AGG = "agg"  # grouped-aggregation staging (gid/rowid/value cols)
+
+
+# -- HBM residency ledger -----------------------------------------------------
+
+class ResidencyLedger:
+    """Process-wide registry of live device allocations.
+
+    Entries are (type, index, group, bytes), keyed by an opaque token;
+    when an ``owner`` object is supplied at registration the entry
+    auto-unregisters when that object is garbage collected — the drop /
+    donate / reload paths need no explicit bookkeeping, they just stop
+    referencing the old state. One leaf lock; every method is O(entries)
+    or better and never blocks under it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # leaf: entries + spills + budget
+        self._seq = 0
+        self._entries: dict[int, tuple] = {}  # token -> (type, index, group, bytes)
+        self._finalizers: dict[int, object] = {}
+        # host-resident spill report: (type, index) -> estimated bytes the
+        # budget refused (the index serves from the host path instead)
+        self._spills: dict[tuple, int] = {}
+        self._budget: int | None = None
+        self.register_count = 0  # lifetime registrations (ops surface)
+
+    # -- write surface (the backend's side) -----------------------------------
+    def set_budget(self, budget_bytes: int | None) -> None:
+        with self._lock:
+            self._budget = budget_bytes
+
+    def begin_load(self, type_name: str) -> None:
+        """A fresh load for ``type_name`` is starting: clear its spill
+        report (the load re-records any indexes that still don't fit)."""
+        self.clear_spills(type_name)
+
+    def register(self, type_name: str, index: str, group: str,
+                 nbytes: int, owner=None) -> int:
+        """Record one live device allocation; returns the entry token.
+        With ``owner``, the entry unregisters itself when ``owner`` is
+        garbage collected (the state-object lifetime IS the allocation
+        lifetime for every backend path)."""
+        with self._lock:
+            self._seq += 1
+            token = self._seq
+            self._entries[token] = (type_name, index, group, int(nbytes))
+            self.register_count += 1
+        if owner is not None:
+            fin = weakref.finalize(owner, self.unregister, token)
+            fin.atexit = False  # telemetry: never delay interpreter exit
+            with self._lock:
+                self._finalizers[token] = fin
+        return token
+
+    def unregister(self, token: int) -> None:
+        with self._lock:
+            self._entries.pop(token, None)
+            self._finalizers.pop(token, None)
+
+    def record_spill(self, type_name: str, index: str, est_bytes: int) -> None:
+        with self._lock:
+            self._spills[(type_name, index)] = int(est_bytes)
+
+    def clear_spills(self, type_name: str) -> None:
+        with self._lock:
+            for k in [k for k in self._spills if k[0] == type_name]:
+                del self._spills[k]
+
+    # -- read surface ---------------------------------------------------------
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e[3] for e in self._entries.values())
+
+    def type_bytes(self, type_name: str) -> int:
+        with self._lock:
+            return sum(
+                e[3] for e in self._entries.values() if e[0] == type_name
+            )
+
+    def index_bytes(self, type_name: str, index: str) -> int:
+        """Live device bytes held by one (type, index) across groups —
+        the bytes-scanned denominator the cost table records."""
+        with self._lock:
+            return sum(
+                e[3] for e in self._entries.values()
+                if e[0] == type_name and e[1] == index
+            )
+
+    def resident(self) -> dict:
+        """``{type: {index: {group: bytes}}}`` for every live entry
+        (entries sharing a key sum — reload overlap windows show both)."""
+        out: dict = {}
+        with self._lock:
+            entries = list(self._entries.values())
+        for t, i, g, b in entries:
+            grp = out.setdefault(t, {}).setdefault(i, {})
+            grp[g] = grp.get(g, 0) + b
+        return out
+
+    def snapshot(self) -> dict:
+        """The ``device`` section of ``/api/metrics``: per-(type, index,
+        group) resident bytes, budget headroom, and the spill report.
+
+        ``budget_bytes`` applies PER TYPE (the ``TpuBackend`` contract —
+        a store holding T types can reach T × budget), so
+        ``headroom_bytes`` reports the MOST CONSTRAINED type: budget
+        minus the largest per-type total. A single-type process reads it
+        as plain budget-minus-resident."""
+        with self._lock:
+            entries = list(self._entries.values())
+            spills = dict(self._spills)
+            budget = self._budget
+            registered = self.register_count
+        resident: dict = {}
+        total = 0
+        per_type: dict = {}
+        for t, i, g, b in entries:
+            grp = resident.setdefault(t, {}).setdefault(i, {})
+            grp[g] = grp.get(g, 0) + b
+            per_type[t] = per_type.get(t, 0) + b
+            total += b
+        return {
+            "resident": resident,
+            "total_bytes": total,
+            "budget_bytes": budget,
+            "headroom_bytes": (
+                budget - max(per_type.values(), default=0)
+                if budget is not None else None
+            ),
+            "spilled": {f"{t}.{i}": b for (t, i), b in spills.items()},
+            "spilled_bytes": sum(spills.values()),
+            "register_count": registered,
+        }
+
+    def prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
+        """Labeled residency gauges (the exposition
+        :func:`geomesa_tpu.obs.export.prometheus_text` cannot emit —
+        appended to the scrape the way the SLO engine's lines are)."""
+        snap = self.snapshot()
+        lines = [f"# TYPE {prefix}_device_resident_bytes gauge"]
+        for t, per_index in sorted(snap["resident"].items()):
+            for i, per_group in sorted(per_index.items()):
+                for g, b in sorted(per_group.items()):
+                    lines.append(
+                        f'{prefix}_device_resident_bytes'
+                        f'{{type="{t}",index="{i}",group="{g}"}} {b}'
+                    )
+        lines.append(f"# TYPE {prefix}_device_resident_bytes_total gauge")
+        lines.append(
+            f"{prefix}_device_resident_bytes_total {snap['total_bytes']}")
+        if snap["budget_bytes"] is not None:
+            lines.append(f"# TYPE {prefix}_device_budget_bytes gauge")
+            lines.append(
+                f"{prefix}_device_budget_bytes {snap['budget_bytes']}")
+            lines.append(f"# TYPE {prefix}_device_headroom_bytes gauge")
+            lines.append(
+                f"{prefix}_device_headroom_bytes {snap['headroom_bytes']}")
+        if snap["spilled"]:
+            lines.append(f"# TYPE {prefix}_device_spilled_bytes gauge")
+            for key, b in sorted(snap["spilled"].items()):
+                t, _, i = key.rpartition(".")
+                lines.append(
+                    f'{prefix}_device_spilled_bytes'
+                    f'{{type="{t}",index="{i}"}} {b}'
+                )
+        return lines
+
+
+# -- per-query device-time attribution ---------------------------------------
+
+class DevProfile:
+    """Accumulator for one profiled query's device-time attribution.
+
+    Stage totals (ms): ``compile`` (cold jit trace+lower+compile),
+    ``dispatch`` (warm host-side dispatch until the async call returns),
+    ``device_compute`` (``block_until_ready`` wait), ``h2d`` (timed
+    host→device staging of numpy arguments), ``d2h`` (timed
+    materialization of results back to host). Byte counters ride along.
+    Locked: the watchdog may run the scan on a worker thread while the
+    caller's thread owns the context (contexts are copied into workers)."""
+
+    __slots__ = ("_lock", "compile_ms", "dispatch_ms", "device_ms",
+                 "h2d_ms", "d2h_ms", "h2d_bytes", "d2h_bytes",
+                 "dispatches", "compiles", "steps")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compile_ms = 0.0
+        self.dispatch_ms = 0.0
+        self.device_ms = 0.0
+        self.h2d_ms = 0.0
+        self.d2h_ms = 0.0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.dispatches = 0
+        self.compiles = 0
+        self.steps: dict[str, dict] = {}
+
+    def add(self, step: str, *, compile_ms=0.0, dispatch_ms=0.0,
+            device_ms=0.0, h2d_ms=0.0, d2h_ms=0.0,
+            h2d_bytes=0, d2h_bytes=0) -> None:
+        with self._lock:
+            self.compile_ms += compile_ms
+            self.dispatch_ms += dispatch_ms
+            self.device_ms += device_ms
+            self.h2d_ms += h2d_ms
+            self.d2h_ms += d2h_ms
+            self.h2d_bytes += h2d_bytes
+            self.d2h_bytes += d2h_bytes
+            self.dispatches += 1
+            if compile_ms:
+                self.compiles += 1
+            s = self.steps.setdefault(
+                step, {"calls": 0, "ms": 0.0, "device_ms": 0.0})
+            s["calls"] += 1
+            s["ms"] += compile_ms + dispatch_ms + device_ms
+            s["device_ms"] += device_ms
+
+    @property
+    def total_ms(self) -> float:
+        return (self.compile_ms + self.dispatch_ms + self.device_ms
+                + self.h2d_ms + self.d2h_ms)
+
+    def breakdown(self) -> dict:
+        """The flight-record / explain payload: stage → ms splits plus
+        transfer bytes and dispatch counts."""
+        with self._lock:
+            return {
+                "compile": round(self.compile_ms, 3),
+                "dispatch": round(self.dispatch_ms, 3),
+                "device_compute": round(self.device_ms, 3),
+                "h2d": round(self.h2d_ms, 3),
+                "d2h": round(self.d2h_ms, 3),
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_bytes": self.d2h_bytes,
+                "dispatches": self.dispatches,
+                "compiles": self.compiles,
+                "steps": {k: dict(v) for k, v in self.steps.items()},
+            }
+
+
+_prof_var: ContextVar["DevProfile | None"] = ContextVar(
+    "geomesa_devprof", default=None)
+_active_lock = threading.Lock()
+_active_count = 0
+# THE one check jaxmon.observed pays per dispatch when profiling is off:
+# a module-global bool, flipped only while >=1 profiled() context is live
+PROFILING = False
+
+# deterministic-enough per-process sampler stream (independent of the
+# global random state so tests that seed random stay unperturbed)
+_sampler = random.Random()
+_sampler_lock = threading.Lock()
+
+
+def env_rate() -> float:
+    """The ``GEOMESA_TPU_DEVPROF`` sampling rate: unset/0 → off, ``1`` /
+    ``true`` → every query, a float in (0, 1] → that fraction. Read per
+    call so operators (and tests) can flip it live."""
+    raw = os.environ.get(DEVPROF_ENV, "").strip().lower()
+    if not raw or raw in ("0", "false", "off", "no"):
+        return 0.0
+    if raw in ("1", "true", "on", "yes"):
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+def sampled(hint=None) -> bool:
+    """Should THIS query be device-profiled? An explicit per-query hint
+    (``hints={"devprof": True/False}``) always wins; otherwise sample at
+    the env rate."""
+    if hint is not None:
+        return bool(hint)
+    rate = env_rate()
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    with _sampler_lock:
+        return _sampler.random() < rate
+
+
+def current_profile() -> "DevProfile | None":
+    """The live profile on this context (None when this query is not
+    being profiled). Callers gate on :data:`PROFILING` first so the off
+    path never pays the ContextVar read."""
+    return _prof_var.get()
+
+
+@contextmanager
+def profiled():
+    """Activate device-time attribution for this call tree. Nested
+    activations share the OUTER profile (``explain(analyze=True)``
+    wraps ``query()``, which may itself sample — the records must land
+    in one accumulator, not split across two)."""
+    global PROFILING
+    existing = _prof_var.get()
+    if existing is not None:
+        yield existing
+        return
+    prof = DevProfile()
+    with _active_lock:
+        _active_count_inc()
+    tok = _prof_var.set(prof)
+    try:
+        yield prof
+    finally:
+        _prof_var.reset(tok)
+        with _active_lock:
+            _active_count_dec()
+
+
+def _active_count_inc():
+    global _active_count, PROFILING
+    _active_count += 1
+    PROFILING = True
+
+
+def _active_count_dec():
+    global _active_count, PROFILING
+    _active_count -= 1
+    PROFILING = _active_count > 0
+
+
+# -- plan signatures ----------------------------------------------------------
+
+def plan_signature(info, q=None) -> str:
+    """The cost-table key for one executed plan: index choice, union arm
+    count, aggregation kind, and a log2 bucket of the interval count —
+    the plan *shape*, not the literal predicate, so repeated queries of
+    the same shape share one cost profile (what the adaptive planner
+    needs: costs per strategy, not per filter string)."""
+    agg = "rows"
+    if q is not None:
+        hints = getattr(q, "hints", None) or {}
+        for kind in ("density", "stats", "bin"):
+            if hints.get(kind):
+                agg = kind
+                break
+    if info is None:
+        return f"scan:{agg}"
+    parts = [getattr(info, "index_name", None) or "none"]
+    n_iv = getattr(info, "n_intervals", 0)
+    if n_iv:
+        # next-power-of-two bucket: plan WIDTH matters, exact count is noise
+        parts.append(f"iv{1 << max(int(n_iv) - 1, 0).bit_length()}")
+    parts.append(agg)
+    return ":".join(parts)
+
+
+# -- online cost profiles -----------------------------------------------------
+
+class _Quantiles:
+    """Bounded reservoir (algorithm R) + count/sum — the same shape as
+    :class:`geomesa_tpu.utils.metrics.Histogram` without the import (this
+    module stays dependency-free for ``GEOMESA_TPU_NO_JAX`` processes).
+    NOT thread-safe on its own: the owning :class:`CostTable` lock guards
+    every update/read."""
+
+    __slots__ = ("count", "total", "_res", "_rng")
+    SIZE = 256
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self._res: list[float] = []
+        self._rng = random.Random(0x5DEECE66D)
+
+    def update(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if len(self._res) < self.SIZE:
+            self._res.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.SIZE:
+                self._res[j] = v
+
+    def quantile(self, q: float) -> float:
+        if not self._res:
+            return 0.0
+        s = sorted(self._res)
+        pos = q * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        frac = pos - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+class _CostEntry:
+    __slots__ = ("wall_ms", "device_ms", "rows", "bytes_scanned", "count",
+                 "profiled_count")
+
+    def __init__(self):
+        self.wall_ms = _Quantiles()
+        self.device_ms = _Quantiles()
+        self.rows = _Quantiles()
+        self.bytes_scanned = _Quantiles()
+        self.count = 0
+        self.profiled_count = 0
+
+
+class CostTable:
+    """Online per-(type, plan-signature) observed-cost aggregation.
+
+    Every completed query observes wall-ms / rows / bytes-scanned; queries
+    that ran under :func:`profiled` additionally observe device-ms. Read
+    surfaces: :meth:`snapshot` (``GET /api/obs/costs``) and
+    :meth:`predict` (``explain`` predicted-vs-actual). Bounded: least-
+    recently-observed signatures evict past ``max_entries``."""
+
+    def __init__(self, max_entries: int = 512):
+        from collections import OrderedDict
+
+        self._lock = threading.Lock()  # leaf: the entry table
+        self._entries: "OrderedDict[tuple, _CostEntry]" = OrderedDict()
+        self.max_entries = max_entries
+
+    def observe(self, type_name: str, signature: str, *,
+                wall_ms: float, device_ms: float | None = None,
+                rows: int = 0, bytes_scanned: int = 0) -> None:
+        if not _finite(wall_ms):
+            return  # a clock anomaly must never poison a reservoir
+        key = (type_name, signature)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = _CostEntry()
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)
+            else:
+                self._entries.move_to_end(key)
+            e.count += 1
+            e.wall_ms.update(float(wall_ms))
+            e.rows.update(float(rows))
+            if bytes_scanned:
+                e.bytes_scanned.update(float(bytes_scanned))
+            if device_ms is not None:
+                e.profiled_count += 1
+                e.device_ms.update(float(device_ms))
+
+    def predict(self, type_name: str, signature: str) -> dict | None:
+        """The table's current p50 cost for one plan shape (None when the
+        shape has never been observed) — what ``explain`` shows as
+        *predicted* and the adaptive planner will rank strategies by."""
+        with self._lock:
+            e = self._entries.get((type_name, signature))
+            if e is None:
+                return None
+            return {
+                "wall_ms_p50": round(e.wall_ms.quantile(0.5), 3),
+                "device_ms_p50": (
+                    round(e.device_ms.quantile(0.5), 3)
+                    if e.profiled_count else None
+                ),
+                "observations": e.count,
+            }
+
+    def snapshot(self, limit: int = 256) -> dict:
+        with self._lock:
+            items = list(self._entries.items())[-limit:]
+            rows = []
+            for (t, sig), e in items:
+                rows.append({
+                    "type": t,
+                    "signature": sig,
+                    "count": e.count,
+                    "profiled": e.profiled_count,
+                    "wall_ms_p50": round(e.wall_ms.quantile(0.5), 3),
+                    "wall_ms_p95": round(e.wall_ms.quantile(0.95), 3),
+                    "device_ms_p50": round(e.device_ms.quantile(0.5), 3),
+                    "device_ms_p95": round(e.device_ms.quantile(0.95), 3),
+                    "rows_p50": round(e.rows.quantile(0.5), 1),
+                    "bytes_scanned_p50": round(
+                        e.bytes_scanned.quantile(0.5), 0),
+                })
+        rows.sort(key=lambda r: (r["type"], r["signature"]))
+        return {"entries": rows, "entry_count": len(rows)}
+
+
+# -- process-wide singletons --------------------------------------------------
+
+_ledger = ResidencyLedger()
+_costs = CostTable()
+
+
+def ledger() -> ResidencyLedger:
+    return _ledger
+
+
+def costs() -> CostTable:
+    return _costs
+
+
+def install(new_ledger: ResidencyLedger | None = None,
+            new_costs: CostTable | None = None) -> tuple:
+    """Swap the process singletons (test isolation); returns the previous
+    (ledger, costs) pair. Entries registered against the OLD ledger keep
+    unregistering against it — their finalizers captured the instance."""
+    global _ledger, _costs
+    prev = (_ledger, _costs)
+    if new_ledger is not None:
+        _ledger = new_ledger
+    if new_costs is not None:
+        _costs = new_costs
+    return prev
+
+
+def device_report() -> dict:
+    """The ``device`` section of ``/api/metrics``: the residency snapshot
+    plus process-wide transfer totals from the jax telemetry registry."""
+    out = _ledger.snapshot()
+    transfers = {"h2d_bytes": 0, "d2h_bytes": 0}
+    from geomesa_tpu.obs import jaxmon
+
+    if jaxmon.GLOBAL is not None:
+        snap = jaxmon.GLOBAL.snapshot()
+        for k, short in (("jax.transfer.h2d_bytes", "h2d_bytes"),
+                         ("jax.transfer.d2h_bytes", "d2h_bytes")):
+            if k in snap:
+                transfers[short] = snap[k].get("count", 0)
+    out["transfers"] = transfers
+    out["devprof_rate"] = env_rate()
+    return out
+
+
+def prometheus_text(prefix: str = "geomesa") -> str:
+    lines = _ledger.prometheus_lines(prefix)
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+# math import kept honest: _Quantiles interpolation uses pure arithmetic,
+# but a NaN wall-ms (a clock anomaly) must never poison a reservoir
+def _finite(v: float) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)

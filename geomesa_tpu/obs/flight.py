@@ -74,6 +74,10 @@ class QueryAuditRecord:
     members: list = field(default_factory=list)
     # stage -> ms latency breakdown (plan/scan/... where the caller has it)
     breakdown: dict = field(default_factory=dict)
+    # devprof attribution for sampled queries (obs.devmon): compile /
+    # dispatch / device_compute / h2d / d2h ms + transfer bytes; empty
+    # when the query was not profiled
+    device: dict = field(default_factory=dict)
     anomalies: tuple = ()
 
 
@@ -121,13 +125,14 @@ class FlightRecorder:
             rec.ts, rec.op, rec.type_name, rec.source, rec.plan,
             rec.latency_ms, rec.rows, rec.trace_id, rec.bytes_out,
             rec.degraded, rec.members, rec.breakdown, rec.anomalies,
+            rec.device,
         )
         rec.anomalies = anomalies
         return rec
 
     def record_values(self, ts, op, type_name, source, plan, latency_ms,
                       rows, trace_id, bytes_out, degraded, members,
-                      breakdown, anomalies) -> tuple:
+                      breakdown, anomalies, device=()) -> tuple:
         """Positional hot path (what :func:`record` at module level
         calls); returns the final anomaly tuple."""
         if degraded and A_DEGRADED not in anomalies:
@@ -135,7 +140,7 @@ class FlightRecorder:
         if latency_ms > self.slow_ms and A_SLOW not in anomalies:
             anomalies = anomalies + (A_SLOW,)
         row = (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
-               bytes_out, degraded, members, breakdown, anomalies)
+               bytes_out, degraded, members, breakdown, anomalies, device)
         dump_now = False
         install_listener = False
         # a trace owned by a REMOTE caller never parks: the local
@@ -169,13 +174,14 @@ class FlightRecorder:
     @staticmethod
     def _materialize(row: tuple) -> QueryAuditRecord:
         (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
-         bytes_out, degraded, members, breakdown, anomalies) = row
+         bytes_out, degraded, members, breakdown, anomalies, device) = row
         return QueryAuditRecord(
             ts=ts, op=op, type_name=type_name, source=source, plan=plan,
             latency_ms=latency_ms, rows=rows, trace_id=trace_id,
             bytes_out=bytes_out, degraded=degraded,
             members=list(members) if members else [],
             breakdown=dict(breakdown) if breakdown else {},
+            device=dict(device) if device else {},
             anomalies=anomalies,
         )
 
@@ -312,7 +318,7 @@ def install(rec: FlightRecorder) -> FlightRecorder:
 def record(op: str, type_name: str, *, source: str = "store",
            plan: str = "", latency_ms: float = 0.0, rows: int = 0,
            bytes_out: int = 0, degraded: bool = False, members=None,
-           breakdown=None, anomalies: tuple = ()) -> None:
+           breakdown=None, anomalies: tuple = (), device=None) -> None:
     """Record one completed query on the process recorder (the store /
     federation call-site helper — trace id is taken from the live span).
     The always-on hot path: no dataclass is built here."""
@@ -320,5 +326,5 @@ def record(op: str, type_name: str, *, source: str = "store",
     _recorder.record_values(
         time.time(), op, type_name, source, plan, latency_ms, rows,
         sp.trace_id if sp is not None else "", bytes_out, degraded,
-        members or (), breakdown or (), tuple(anomalies),
+        members or (), breakdown or (), tuple(anomalies), device or (),
     )

@@ -28,8 +28,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 
-__all__ = ["GLOBAL", "registry", "install", "observed", "jit_report"]
+from geomesa_tpu.obs import devmon as _devmon
+
+__all__ = ["GLOBAL", "registry", "install", "observed", "jit_report",
+           "count_h2d"]
 
 GLOBAL = None  # lazily-created MetricsRegistry (process-wide jax telemetry)
 _reg_lock = threading.Lock()
@@ -118,16 +122,126 @@ def _np_bytes(arrays) -> int:
     )
 
 
+# per-thread record of arrays a call site already accounted via count_h2d:
+# the next observed() dispatch on the thread must NOT count them again when
+# the same numpy array is passed straight into the step. Weak references —
+# never ids — so a recycled id after GC can't alias a fresh array, and the
+# pending set never pins a multi-GB staging buffer alive.
+_h2d_pending = threading.local()
+_H2D_PENDING_CAP = 256
+
+
+def _note_pending_h2d(arrays) -> None:
+    refs = getattr(_h2d_pending, "refs", None)
+    if refs is None:
+        refs = _h2d_pending.refs = []
+    for a in arrays:
+        if type(a).__module__.startswith("numpy") and hasattr(a, "nbytes"):
+            try:
+                refs.append(weakref.ref(a))
+            except TypeError:  # un-weakref-able numpy subclass: skip dedupe
+                pass
+    if len(refs) > _H2D_PENDING_CAP:
+        del refs[:-_H2D_PENDING_CAP]
+
+
+def _consume_pending_h2d() -> set:
+    """The identity set of pre-counted arrays, cleared per dispatch (the
+    dedupe window IS one dispatch — staged payloads feed the very next
+    step)."""
+    refs = getattr(_h2d_pending, "refs", None)
+    if not refs:
+        return set()
+    out = {id(a) for a in (r() for r in refs) if a is not None}
+    refs.clear()
+    return out
+
+
 def count_h2d(*arrays) -> int:
     """Account host→device staging for numpy arrays about to be
     ``jnp.asarray``'d / ``device_put`` / passed to a dispatch (transfers
     the step wrapper cannot see when call sites pre-convert). Non-numpy
     args are skipped — device-resident columns must not be recounted per
-    dispatch. Returns bytes counted."""
+    dispatch. Arrays counted here are remembered (weakly, per thread) so
+    a call site that passes the SAME numpy array straight into the next
+    ``observed()`` dispatch is not double-counted. Returns bytes
+    counted."""
     total = _np_bytes(arrays)
     if total:
         registry().counter("jax.transfer.h2d_bytes").inc(total)
+        _note_pending_h2d(arrays)
     return total
+
+
+def _block_ready(obj) -> None:
+    """Wait for every array in ``obj`` (one level of tuple/list nesting —
+    the shapes our steps return) to finish on device."""
+    if hasattr(obj, "block_until_ready"):
+        obj.block_until_ready()
+    elif isinstance(obj, (tuple, list)):
+        for x in obj:
+            _block_ready(x)
+
+
+def _materialize(obj) -> None:
+    """Force the device→host copy the caller is about to pay (the d2h
+    half of the attribution bracket)."""
+    import numpy as np
+
+    if hasattr(obj, "block_until_ready"):
+        np.asarray(obj)
+    elif isinstance(obj, (tuple, list)):
+        for x in obj:
+            _materialize(x)
+
+
+def _profiled_call(fn, args, kwargs, sp):
+    """The sampled devprof bracket around one dispatch: timed host→device
+    staging of numpy arguments (converted here so the step receives
+    device arrays — identical semantics, but the transfer is visible),
+    the dispatch call itself, a ``block_until_ready`` device wait, and a
+    timed device→host materialization of the results. Returns
+    ``((h2d_ms, call_ms, device_ms, d2h_ms), out)``. Only ever runs
+    inside a live :func:`geomesa_tpu.obs.devmon.profiled` context."""
+    import jax
+
+    pc = time.perf_counter
+    cm = sp if sp is not None else _NullCtx()
+    with cm:
+        t0 = pc()
+        staged = []
+        converted = []
+        for a in args:
+            if type(a).__module__.startswith("numpy") and hasattr(a, "nbytes"):
+                d = jax.device_put(a)
+                staged.append(d)
+                converted.append(d)
+            else:
+                staged.append(a)
+        if converted:
+            _block_ready(converted)
+        t1 = pc()
+        out = fn(*staged, **kwargs)
+        t2 = pc()
+        _block_ready(out)
+        t3 = pc()
+        _materialize(out)
+        t4 = pc()
+    return (
+        ((t1 - t0) * 1000.0, (t2 - t1) * 1000.0,
+         (t3 - t2) * 1000.0, (t4 - t3) * 1000.0),
+        out,
+    )
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
 
 
 def observed(name: str, fn):
@@ -160,9 +274,16 @@ def observed(name: str, fn):
                 sigs.add(key)
             n_sigs = len(sigs)
         sp = _trace.span("jit", step=name) if _trace.active() else None
+        # devprof: ONE module-global bool check on the off path (<2%
+        # bound); the ContextVar read happens only while some profiled()
+        # context is live anywhere in the process
+        prof = _devmon.current_profile() if _devmon.PROFILING else None
+        prof_detail = None
         t0 = time.perf_counter()
         try:
-            if sp is not None:
+            if prof is not None:
+                prof_detail, out = _profiled_call(fn, args, kwargs, sp)
+            elif sp is not None:
                 with sp:
                     out = fn(*args, **kwargs)
             else:
@@ -178,9 +299,17 @@ def observed(name: str, fn):
         dt_ms = (time.perf_counter() - t0) * 1000.0
         calls.inc()
         # transfer denominator: numpy args are about to cross host→device
-        # (call sites that pre-convert account theirs via count_h2d);
+        # (call sites that pre-convert account theirs via count_h2d —
+        # dedupe by array identity so the SAME array arriving here after a
+        # count_h2d on this thread is never counted twice per dispatch);
         # result bytes cross back when the caller materializes them
-        h2d = _np_bytes(args)
+        pre = _consume_pending_h2d()
+        h2d = sum(
+            int(a.nbytes)
+            for a in args
+            if type(a).__module__.startswith("numpy")
+            and hasattr(a, "nbytes") and id(a) not in pre
+        )
         d2h = _nbytes(out)
         if h2d:
             h2d_bytes.inc(h2d)
@@ -198,6 +327,18 @@ def observed(name: str, fn):
         if sp is not None:
             sp.set(compile=is_new, ms=round(dt_ms, 3),
                    h2d_bytes=h2d, d2h_bytes=d2h)
+        if prof is not None and prof_detail is not None:
+            h2d_ms, call_ms, device_ms, d2h_ms = prof_detail
+            prof.add(
+                name,
+                compile_ms=call_ms if is_new else 0.0,
+                dispatch_ms=0.0 if is_new else call_ms,
+                device_ms=device_ms, h2d_ms=h2d_ms, d2h_ms=d2h_ms,
+                h2d_bytes=h2d, d2h_bytes=d2h,
+            )
+            if sp is not None:
+                sp.set(device_ms=round(device_ms, 3),
+                       h2d_ms=round(h2d_ms, 3), d2h_ms=round(d2h_ms, 3))
         return out
 
     wrapper.__name__ = f"observed_{name}"

@@ -200,8 +200,17 @@ class TpuBackend(ExecutionBackend):
         }
 
     def load(self, sft, table, indices):
+        from geomesa_tpu.obs import devmon
         from geomesa_tpu.parallel.mesh import shard_columns
 
+        # HBM residency ledger: every device allocation this load makes is
+        # registered (type, index, column group, bytes) and auto-unregisters
+        # when the state object is dropped (evict/reload/compact); indexes
+        # the budget refuses land in the host-resident spill report instead
+        ledger = devmon.ledger()
+        type_name = getattr(sft, "name", "?")
+        ledger.begin_load(type_name)
+        ledger.set_budget(self.max_device_bytes)
         state: dict[str, _MeshIndexState | None] = {}
         nlon = norm_lon(REFINE_PRECISION)
         nlat = norm_lat(REFINE_PRECISION)
@@ -232,17 +241,18 @@ class TpuBackend(ExecutionBackend):
                 max(len(table), shards), shards, JOIN_BLOCK
             )
         for name, index in ordered:
-            if self.max_device_bytes is not None:
-                if used_bytes + est > self.max_device_bytes:
-                    state[name] = None  # host path serves this index
-                    continue
             col = table.geom_column() if sft.geom_field else None
             if col is None or len(table) == 0 or name in ("id",):
-                state[name] = None  # host path
+                state[name] = None  # host path BY DESIGN — never a spill
                 continue
             if col.x is None and col.bounds is None:
                 state[name] = None
                 continue
+            if self.max_device_bytes is not None:
+                if used_bytes + est > self.max_device_bytes:
+                    state[name] = None  # host path serves this index
+                    ledger.record_spill(type_name, name, est)
+                    continue
             if mesh is None:
                 mesh = self._get_mesh()
             perm = index.perm
@@ -266,6 +276,8 @@ class TpuBackend(ExecutionBackend):
                     cols=cols, rows_per_shard=rows_per_shard, n=len(table)
                 )
                 used_bytes += state[name].nbytes
+                ledger.register(type_name, name, devmon.GROUP_SPATIAL,
+                                state[name].nbytes, owner=state[name])
             else:
                 # extended geometries: shard the bbox SoA for overlap refine.
                 # Null geometries leave NaN bounds — normalize a dummy, then
@@ -303,6 +315,8 @@ class TpuBackend(ExecutionBackend):
                     kind="bboxes",
                 )
                 used_bytes += state[name].nbytes
+                ledger.register(type_name, name, devmon.GROUP_BBOX,
+                                state[name].nbytes, owner=state[name])
         return state
 
     # -- refine payload (int-domain superset bounds) -------------------------

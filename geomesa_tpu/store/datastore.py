@@ -87,11 +87,17 @@ class ExplainAnalyze:
     """``EXPLAIN ANALYZE`` output: the static plan text plus the measured
     per-stage timeline (:class:`geomesa_tpu.obs.StageTimeline`) of one real
     execution. ``stages`` durations sum to ``wall_ms`` by construction (an
-    ``other`` residual stage absorbs untraced time)."""
+    ``other`` residual stage absorbs untraced time). ``device`` is the
+    devprof attribution of the analyzed run (compile / dispatch /
+    device-compute / h2d / d2h, ms + bytes); ``cost`` is the cost table's
+    predicted-vs-actual for this plan shape (predicted is the table's p50
+    BEFORE this run observed into it)."""
 
     plan: str
     timeline: Any
     hits: int
+    device: "dict | None" = None
+    cost: "dict | None" = None
 
     @property
     def stages(self) -> list:
@@ -102,7 +108,25 @@ class ExplainAnalyze:
         return self.timeline.wall_ms
 
     def __str__(self) -> str:
-        return f"{self.plan}\n{self.timeline.render()}\n  Hits: {self.hits}"
+        out = f"{self.plan}\n{self.timeline.render()}"
+        if self.device:
+            out += "\n  Device time:"
+            for k in ("compile", "dispatch", "device_compute", "h2d", "d2h"):
+                out += f"\n    {k:<15s} {self.device.get(k, 0.0):10.3f} ms"
+            out += (f"\n    transfers       h2d {self.device.get('h2d_bytes', 0)} B"
+                    f" / d2h {self.device.get('d2h_bytes', 0)} B"
+                    f" ({self.device.get('dispatches', 0)} dispatches)")
+        if self.cost:
+            pred = self.cost.get("predicted")
+            pred_ms = pred.get("wall_ms_p50") if pred else None
+            out += (
+                f"\n  Cost profile [{self.cost.get('signature')}]: "
+                + (f"predicted {pred_ms} ms p50 "
+                   f"(n={pred.get('observations')})" if pred
+                   else "no prior observations")
+                + f", actual {self.cost.get('actual_ms')} ms"
+            )
+        return out + f"\n  Hits: {self.hits}"
 
 
 @dataclass
@@ -833,6 +857,15 @@ class DataStore:
         # batch span); every stage below opens a child span, so EXPLAIN
         # ANALYZE and the Perfetto export read straight off this tree
         with obs.span("query", type_name=type_name):
+            # sampled device-time attribution (GEOMESA_TPU_DEVPROF env or
+            # the per-query "devprof" hint): every cached-jit dispatch in
+            # this tree brackets with block_until_ready timing, and the
+            # breakdown lands in the flight record + cost table (_audit)
+            from geomesa_tpu.obs import devmon
+
+            if devmon.sampled(q.hints.get("devprof")):
+                with devmon.profiled():
+                    return self._run_query(st, type_name, q)
             return self._run_query(st, type_name, q)
 
     def _run_query(self, st: _TypeState, type_name: str, q: Query) -> QueryResult:
@@ -992,7 +1025,7 @@ class DataStore:
         info = plan_box["info"]
         plan_ms = plan_box["plan_ms"]
         scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
-        self._audit(type_name, q, plan_ms, scan_ms, len(table))
+        self._audit(type_name, q, plan_ms, scan_ms, len(table), info=info)
         return QueryResult(
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
@@ -1066,6 +1099,11 @@ class DataStore:
         with st.mutate_lock:
             with st.lock:
                 st.backend_state = None
+        # the ledger entries unregister themselves when the dropped state
+        # is collected; the spill report is explicit bookkeeping, clear it
+        from geomesa_tpu.obs import devmon
+
+        devmon.ledger().clear_spills(type_name)
         self.metrics.counter("store.device.evictions").inc()
 
     def query_iter(
@@ -1562,7 +1600,8 @@ class DataStore:
             keys.append(tuple(reversed(parts)))
         return gid, keys
 
-    def _agg_residency(self, dev, main, perm, group_by, value_cols):
+    def _agg_residency(self, dev, main, perm, group_by, value_cols,
+                       type_name: str = "?", index_name: str = "?"):
         """Stage (or fetch from ``dev.agg_cache``) the group-id column and a
         stacked (V, N) f64 value matrix into the mesh layout, aligned with
         ``dev``'s sharded x/y columns (same perm, same padding). The cache
@@ -1594,6 +1633,14 @@ class DataStore:
             )
             cached = (cols["gid"], gid_orig, keys)
             dev.agg_cache[gkey] = cached
+            # agg staging is device residency too: ledger it under the
+            # "agg" column group (dies with `dev`, so unregistration rides
+            # the same finalizer as the spatial columns)
+            from geomesa_tpu.obs import devmon
+
+            devmon.ledger().register(
+                type_name, index_name, devmon.GROUP_AGG,
+                int(cols["gid"].nbytes), owner=dev)
         rowid = dev.agg_cache.get(("rowid",))
         if rowid is None:
             # original row index per lane: the device computes each group's
@@ -1606,6 +1653,11 @@ class DataStore:
             )
             rowid = rcols["rowid"]
             dev.agg_cache[("rowid",)] = rowid
+            from geomesa_tpu.obs import devmon
+
+            devmon.ledger().register(
+                type_name, index_name, devmon.GROUP_AGG,
+                int(rowid.nbytes), owner=dev)
         # value columns cache PER COLUMN (one device + one host copy each,
         # however many SELECT-list combinations arrive); the per-request
         # (V, N) matrix is a device-side concat — no host↔device transfer
@@ -1624,9 +1676,13 @@ class DataStore:
                 pv[0, : len(main)] = v[perm]
                 got = (jax.device_put(pv, sharding), v)
                 dev.agg_cache[("val", c)] = got
+                from geomesa_tpu.obs import devmon
                 from geomesa_tpu.obs.jaxmon import count_h2d
 
                 count_h2d(pv)
+                devmon.ledger().register(
+                    type_name, index_name, devmon.GROUP_AGG,
+                    int(got[0].nbytes), owner=dev)
             per_dev.append(got[0])
             per_host.append(got[1])
         if per_dev:
@@ -1714,7 +1770,9 @@ class DataStore:
                 return out
         try:
             (dev_gid, gid_orig, keys), dev_rowid, dev_vals, host_vals = (
-                self._agg_residency(dev, main, perm, group_by, value_cols)
+                self._agg_residency(dev, main, perm, group_by, value_cols,
+                                    type_name=type_name,
+                                    index_name=dev_name or "?")
             )
         except (TypeError, ValueError):
             return out
@@ -2036,19 +2094,45 @@ class DataStore:
                 out[i] = _exact(q)
         return out
 
-    def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float, hits: int) -> None:
+    def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float,
+               hits: int, info=None) -> None:
         self.metrics.histogram("store.query.hits").update(hits)
         self.metrics.histogram("store.query.scan_ms").update(scan_ms)
         filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
         # always-on observability: one flight-recorder audit record + one
-        # SLO availability observation per completed query (both leaf-lock
-        # appends — the <2% cached-jit bound is gated in scripts/lint.sh)
+        # SLO availability observation + one cost-table observation per
+        # completed query (all leaf-lock appends — the <2% cached-jit
+        # bound is gated in scripts/lint.sh). A query that ran under
+        # devprof additionally carries its device-time breakdown.
+        from geomesa_tpu.obs import devmon
         from geomesa_tpu.obs import flight as _flight
 
+        prof = devmon.current_profile() if devmon.PROFILING else None
+        device = prof.breakdown() if prof is not None else None
+        # only FULLY PLANNED, individually timed executions feed the cost
+        # table: batched paths audit with amortized-zero timings and no
+        # plan info, and an empty store audits 0 ms — letting those in
+        # would pull every p50 toward zero under the wrong signature
+        # (the table is the adaptive planner's training signal)
+        if info is not None:
+            sig = devmon.plan_signature(info, q)
+            index_name = getattr(info, "index_name", None) or ""
+            devmon.costs().observe(
+                type_name, sig,
+                wall_ms=plan_ms + scan_ms,
+                device_ms=(device["device_compute"] + device["dispatch"]
+                           + device["compile"]) if device else None,
+                rows=hits,
+                bytes_scanned=(
+                    devmon.ledger().index_bytes(type_name, index_name)
+                    if index_name and "union" not in index_name else 0
+                ),
+            )
         _flight.record(
             op="query", type_name=type_name, source="store", plan=filt,
             latency_ms=plan_ms + scan_ms, rows=hits,
             breakdown={"plan": plan_ms, "scan": scan_ms},
+            device=device or {},
         )
         self.slo.observe("store.query", ok=True, key=type_name,
                          latency_ms=plan_ms + scan_ms)
@@ -2100,15 +2184,32 @@ class DataStore:
             out += f"\n  Hot tier (unsorted, merged at query time): {st.delta.rows} rows"
         if not analyze:
             return out
+        from geomesa_tpu.obs import devmon
         from geomesa_tpu.obs import trace as _trace
 
+        # predicted cost BEFORE the run (query() observes into the table);
+        # the analyzed execution always runs under devprof so the stage
+        # rows split into compile / dispatch / device-compute / h2d / d2h
+        sig = devmon.plan_signature(info, q)
+        predicted = devmon.costs().predict(type_name, sig)
+        import time as _time
+
         with _trace.collect("explain.analyze", type_name=type_name) as root:
-            res = self.query(type_name, q)
+            with devmon.profiled() as prof:
+                t0 = _time.perf_counter()
+                res = self.query(type_name, q)
+                actual_ms = (_time.perf_counter() - t0) * 1000.0
         qspans = root.find("query")
         return ExplainAnalyze(
             plan=out,
             timeline=_trace.StageTimeline(qspans[0] if qspans else root),
             hits=res.count,
+            device=prof.breakdown(),
+            cost={
+                "signature": sig,
+                "predicted": predicted,
+                "actual_ms": round(actual_ms, 3),
+            },
         )
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------

@@ -29,7 +29,10 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}/stats/topk?attr=&k=
     GET    /api/schemas/{name}/density?cql=&bbox=&width=&height=
     GET    /api/audit?typeName=                  query audit records
-    GET    /api/metrics                          metrics registry snapshot
+    GET    /api/obs/flight?limit=                query-audit flight recorder
+    GET    /api/obs/costs?limit=                 per-plan-shape cost profiles
+    GET    /api/metrics                          metrics snapshot (+ device
+                                                 HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
     GET    /wfs?service=WFS&request=...          OGC WFS 2.0 KVP binding
     GET    /wms?service=WMS&request=...          OGC WMS 1.3.0 (GetMap tiles)
@@ -150,6 +153,7 @@ class GeoMesaApp:
             ("GET", r"^/api/schemas/([^/]+)/density$", self._density),
             ("GET", r"^/api/audit$", self._audit),
             ("GET", r"^/api/obs/flight$", self._obs_flight),
+            ("GET", r"^/api/obs/costs$", self._obs_costs),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -927,6 +931,16 @@ class GeoMesaApp:
         limit = self._int_param(params, "limit")
         return 200, flight.get().snapshot(limit=limit or 64), "application/json"
 
+    def _obs_costs(self, params, body):
+        """The per-(type, plan-signature) observed-cost table
+        (``geomesa-tpu obs costs`` pulls this): p50/p95 device-ms and
+        wall-ms, rows, bytes scanned — the adaptive planner's training
+        signal, read-only for now."""
+        from geomesa_tpu.obs import devmon
+
+        limit = self._int_param(params, "limit")
+        return 200, devmon.costs().snapshot(limit=limit or 256), "application/json"
+
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
         # the store's SLO engine (DataStore and MergedDataStoreView both
@@ -936,7 +950,7 @@ class GeoMesaApp:
             # text exposition for a Prometheus scrape: the store registry
             # plus the process-wide jax telemetry registry (compile times,
             # per-step dispatch, recompile counts) when it exists
-            from geomesa_tpu.obs import jaxmon
+            from geomesa_tpu.obs import devmon, jaxmon
             from geomesa_tpu.obs.export import (
                 PROMETHEUS_CONTENT_TYPE,
                 prometheus_text,
@@ -945,8 +959,15 @@ class GeoMesaApp:
             text = prometheus_text(m, jaxmon.GLOBAL)
             if slo_engine is not None:
                 text += slo_engine.prometheus_text()
+            # device telemetry: labeled HBM residency/budget/spill gauges
+            text += devmon.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
+        # device section: per-(type, index, group) resident bytes, budget
+        # headroom, spill report, process transfer totals (obs.devmon)
+        from geomesa_tpu.obs import devmon
+
+        out["device"] = devmon.device_report()
         if slo_engine is not None:
             slo_snap = slo_engine.snapshot()
             if slo_snap:

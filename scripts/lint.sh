@@ -33,14 +33,27 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 # must stay clean with them in the tree (docs/concurrency.md).
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs_federation.py -q
 
+# device-telemetry gate: the HBM residency ledger must agree with
+# TpuBackend.residency() on every load path, the devprof off-path must
+# hold the <2% overhead bound on the cached-jit select path, and the
+# h2d dedupe / cost-table / flight-record wiring must round-trip. See
+# docs/observability.md § Device telemetry & cost profiles.
+JAX_PLATFORMS=cpu python -m pytest tests/test_devmon.py -q
+
+# perf-regression smoke gate: one REAL tiny-N capture, then deterministic
+# green (must pass) / red (injected 20% slowdown must fail) legs plus the
+# committed-baseline loader leg — see scripts/bench_gate.sh.
+scripts/bench_gate.sh
+
 # tpurace dynamic prong: the Eraser-style lock-order sanitizer wraps every
 # repo lock (tests/conftest.py) while the threaded tier-1 subset drives
 # REAL lock traffic — journal tailer + consumer groups + lambda persister +
-# concurrent store write/query. The session-end gate fails the run unless
-# the observed lock-order graph is cycle-free.
+# concurrent store write/query (and the devmon ledger's concurrent
+# registration paths). The session-end gate fails the run unless the
+# observed lock-order graph is cycle-free.
 GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
-    tests/test_concurrency.py tests/test_locks.py -q
+    tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

@@ -102,6 +102,139 @@ class TestSubSecondBoundaryParity:
             self._parity_sweep(seed)
 
 
+def _bench_mod(name="bench_units_mod"):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(name, "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_config_units_round_trip_through_compact():
+    """Every canonical config unit must survive the driver's compact
+    emission intact (config 8's old prose unit truncated to
+    'Grows/s/chip (each row m' in BENCH_DETAIL/BENCH_rNN records)."""
+    mod = _bench_mod()
+    assert set(mod.BENCHES) <= set(mod.UNITS)
+    for cfg, unit in mod.UNITS.items():
+        r = {"metric": f"m_{cfg}", "value": 1.0, "unit": unit,
+             "vs_baseline": 1.0, "detail": {"row_set_parity": True}}
+        c = mod._compact(r)
+        assert c["u"] == unit, (cfg, unit, c["u"])
+        # and through a full JSON round trip
+        assert json.loads(json.dumps(c))["u"] == unit
+
+
+class TestRegressGate:
+    """Pure-function coverage of the perf-regression gate (the live
+    red/green smoke runs in scripts/bench_gate.sh)."""
+
+    def test_unit_direction(self):
+        mod = _bench_mod()
+        assert mod._unit_direction("ms/query") == "lower"
+        assert mod._unit_direction("ms p99") == "lower"
+        assert mod._unit_direction("Gpairs/s") == "higher"
+        assert mod._unit_direction("Grows/s/chip") == "higher"
+
+    def test_compare_lower_is_better(self):
+        mod = _bench_mod()
+        # 10% slower: inside the 15% threshold
+        v = mod._regress_compare(10.0, 11.0, "ms/query", 15.0)
+        assert not v["regressed"] and v["delta_pct"] == 10.0
+        # 20% slower: regression
+        v = mod._regress_compare(10.0, 12.0, "ms/query", 15.0)
+        assert v["regressed"] and v["delta_pct"] == 20.0
+        # faster is never a regression
+        v = mod._regress_compare(10.0, 5.0, "ms/query", 15.0)
+        assert not v["regressed"] and v["delta_pct"] < 0
+
+    def test_compare_higher_is_better(self):
+        mod = _bench_mod()
+        v = mod._regress_compare(1.0, 0.8, "Grows/s/chip", 15.0)
+        assert v["regressed"] and v["delta_pct"] == pytest.approx(20.0)
+        v = mod._regress_compare(1.0, 1.2, "Grows/s/chip", 15.0)
+        assert not v["regressed"]
+
+    def test_injected_slowdown_trips_threshold(self):
+        """The gate's self-test contract: identical measurements plus a
+        synthetic 20% slowdown must regress at the 15% threshold, in
+        BOTH unit directions."""
+        mod = _bench_mod()
+        v = mod._regress_compare(10.0, 10.0, "ms/query", 15.0, slowdown=1.2)
+        assert v["regressed"] and v["delta_pct"] == pytest.approx(20.0)
+        assert v["injected_slowdown"] == 1.2
+        v = mod._regress_compare(2.0, 2.0, "Grows/s/chip", 15.0, slowdown=1.2)
+        assert v["regressed"]
+        # and must NOT trip without the injection
+        v = mod._regress_compare(10.0, 10.0, "ms/query", 15.0)
+        assert not v["regressed"]
+
+    def test_parity_loss_always_gates(self):
+        """Losing result-set parity on the fresh run fails the gate even
+        at unchanged speed, and even on a config whose baseline had no
+        parity referee (a wrong answer is worse than a slow one)."""
+        mod = _bench_mod()
+        b = {"value": 10.0, "unit": "ms/query", "parity": True}
+        v = mod._regress_verdict(b, {"value": 10.0, "parity": False}, 15.0)
+        assert v["regressed"] and v["gating"] and v["parity_failure"]
+        b_noref = {"value": 10.0, "unit": "ms/query", "parity": None}
+        v = mod._regress_verdict(b_noref, {"value": 10.0, "parity": False},
+                                 15.0)
+        assert v["regressed"] and v["gating"]
+        # speed noise on a no-referee config reports but does not gate
+        v = mod._regress_verdict(b_noref, {"value": 20.0, "parity": None},
+                                 15.0)
+        assert v["regressed"] and not v["gating"]
+        # the ordinary case: parity config, speed regression, gates
+        v = mod._regress_verdict(b, {"value": 20.0, "parity": True}, 15.0)
+        assert v["regressed"] and v["gating"] and "parity_failure" not in v
+
+    def test_baseline_loader_accepts_all_three_shapes(self, tmp_path):
+        mod = _bench_mod()
+        # 1. a --regress-capture file
+        cap = tmp_path / "cap.json"
+        cap.write_text(json.dumps({
+            "kind": "bench-regress-baseline",
+            "configs": {"2": {"value": 5.0, "unit": "ms/query",
+                              "parity": True}},
+        }))
+        base = mod._load_regress_baseline(str(cap))
+        assert base["2"] == {"value": 5.0, "unit": "ms/query", "parity": True}
+        # 2. a BENCH_DETAIL.json sweep record (parity from detail flags)
+        det = tmp_path / "detail.json"
+        det.write_text(json.dumps({
+            "backend": "tpu",
+            "configs": {
+                "2": {"value": 5.4, "unit": "ms/query",
+                      "detail": {"int_domain_parity": True,
+                                 "row_set_parity": True}},
+                "8": {"value": None, "unit": "error"},
+            },
+        }))
+        base = mod._load_regress_baseline(str(det))
+        assert base["2"]["parity"] is True
+        assert "8" not in base  # value-less configs never become baselines
+        # 3. a --regress-report file: measured values become the baseline
+        rep = tmp_path / "report.json"
+        rep.write_text(json.dumps({
+            "kind": "bench-regress-report",
+            "configs": {"2": {"baseline": 5.0, "measured": 5.5,
+                              "unit": "ms/query", "parity": True}},
+        }))
+        base = mod._load_regress_baseline(str(rep))
+        assert base["2"]["value"] == 5.5
+
+    def test_committed_detail_loads_as_baseline(self):
+        """The committed real-chip sweep record must stay loadable — the
+        production gate is `bench.py --regress BENCH_DETAIL.json`."""
+        mod = _bench_mod()
+        base = mod._load_regress_baseline("BENCH_DETAIL.json")
+        assert base, "BENCH_DETAIL.json yielded no baseline configs"
+        for cfg, b in base.items():
+            assert b["value"] is not None and b["unit"], cfg
+
+
 def test_driver_line_compact_and_parseable(tmp_path):
     """Driver-mode emission contract: the LAST stdout line parses as JSON,
     stays under the driver's ~4 KB tail capture, and carries per-config

@@ -174,6 +174,52 @@ class TestQueryAndStats:
         status, out = jcall(app, "GET", "/api/metrics")
         assert status == 200 and isinstance(out, dict)
 
+    def test_metrics_device_section(self, app):
+        """The device-telemetry satellite: /api/metrics carries a
+        ``device`` section (resident bytes by type/index/group, budget
+        headroom, transfer totals) in JSON and labeled residency gauges
+        in the Prometheus exposition."""
+        from geomesa_tpu.obs import devmon
+
+        prev = devmon.install(devmon.ResidencyLedger(), devmon.CostTable())
+        try:
+            _ingest(app, n=1500)  # enough rows to go device-resident
+            status, out = jcall(app, "GET", "/api/metrics")
+            assert status == 200
+            dev = out["device"]
+            assert dev["total_bytes"] > 0
+            assert "pts" in dev["resident"]
+            groups = dev["resident"]["pts"]["z3"]
+            assert groups.get("spatial", 0) > 0
+            assert dev["transfers"]["h2d_bytes"] >= 0
+            assert "headroom_bytes" in dev and "spilled" in dev
+            status, _, data = call(
+                app, "GET", "/api/metrics", "format=prometheus")
+            text = data.decode()
+            assert ("geomesa_device_resident_bytes"
+                    '{type="pts",index="z3",group="spatial"}') in text
+            assert "geomesa_device_resident_bytes_total" in text
+        finally:
+            devmon.install(*prev)
+
+    def test_obs_costs_endpoint(self, app):
+        from geomesa_tpu.obs import devmon
+
+        prev = devmon.install(devmon.ResidencyLedger(), devmon.CostTable())
+        try:
+            _ingest(app, n=1500)
+            jcall(app, "GET", "/api/schemas/pts/query",
+                  "cql=BBOX(geom,0,0,10,10)")
+            status, out = jcall(app, "GET", "/api/obs/costs")
+            assert status == 200
+            assert out["entry_count"] >= 1
+            e = next(r for r in out["entries"] if r["type"] == "pts")
+            assert e["count"] >= 1 and e["wall_ms_p50"] > 0
+            assert {"signature", "device_ms_p50", "rows_p50",
+                    "bytes_scanned_p50"} <= set(e)
+        finally:
+            devmon.install(*prev)
+
     def test_count_many(self, app):
         _ingest(app)
         status, out = jcall(
