@@ -61,6 +61,7 @@ DEVPROF_ENV = "GEOMESA_TPU_DEVPROF"
 GROUP_SPATIAL = "spatial"  # x/y/bins/offs point layout
 GROUP_BBOX = "bbox"  # xmin/ymin/xmax/ymax/bins/offs overlap layout
 GROUP_AGG = "agg"  # grouped-aggregation staging (gid/rowid/value cols)
+GROUP_PYRAMID = "pyramid"  # GeoBlocks pre-aggregation pyramid levels
 
 
 # -- HBM residency ledger -----------------------------------------------------
@@ -257,6 +258,14 @@ class DevProfile:
         self.dispatches = 0
         self.compiles = 0
         self.steps: dict[str, dict] = {}
+
+    def note_h2d(self, nbytes: int) -> None:
+        """Attribute pre-staged payload bytes (``jaxmon.count_h2d`` with a
+        query-side label) to THIS query, without counting a dispatch.
+        Pool-labeled staging (a buffer-pool warm-up the query merely
+        triggered) never lands here — per-query h2d splits stay truthful."""
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
 
     def add(self, step: str, *, compile_ms=0.0, dispatch_ms=0.0,
             device_ms=0.0, h2d_ms=0.0, d2h_ms=0.0,
@@ -480,7 +489,30 @@ class CostTable:
 
         self._lock = threading.Lock()  # leaf: the entry table
         self._entries: "OrderedDict[tuple, _CostEntry]" = OrderedDict()
+        self._ticks: dict[tuple, int] = {}
         self.max_entries = max_entries
+
+    def tick(self, type_name: str, name: str) -> int:
+        """Monotonic per-(type, name) consult counter. Routing policies
+        (``planner.choose_agg_path``) schedule periodic probes of the
+        losing route off this — NOT off observation counts, which the
+        winning route freezes by starving the loser of observations."""
+        key = (type_name, name)
+        with self._lock:
+            n = self._ticks.get(key, 0) + 1
+            self._ticks[key] = n
+        return n
+
+    def forget(self, type_name: str) -> None:
+        """Drop every signature row and consult tick of one type. A
+        deleted or renamed schema must not hand its observed cost profile
+        (or its probe phase) to an unrelated future type of the same
+        name."""
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == type_name]:
+                del self._entries[k]
+            for k in [k for k in self._ticks if k[0] == type_name]:
+                del self._ticks[k]
 
     def observe(self, type_name: str, signature: str, *,
                 wall_ms: float, device_ms: float | None = None,
@@ -582,7 +614,8 @@ def device_report() -> dict:
     if jaxmon.GLOBAL is not None:
         snap = jaxmon.GLOBAL.snapshot()
         for k, short in (("jax.transfer.h2d_bytes", "h2d_bytes"),
-                         ("jax.transfer.d2h_bytes", "d2h_bytes")):
+                         ("jax.transfer.d2h_bytes", "d2h_bytes"),
+                         ("jax.transfer.h2d_bytes.pool", "h2d_bytes_pool")):
             if k in snap:
                 transfers[short] = snap[k].get("count", 0)
     out["transfers"] = transfers

@@ -157,19 +157,32 @@ def _consume_pending_h2d() -> set:
     return out
 
 
-def count_h2d(*arrays) -> int:
+def count_h2d(*arrays, label: str | None = None) -> int:
     """Account host→device staging for numpy arrays about to be
     ``jnp.asarray``'d / ``device_put`` / passed to a dispatch (transfers
     the step wrapper cannot see when call sites pre-convert). Non-numpy
     args are skipped — device-resident columns must not be recounted per
     dispatch. Arrays counted here are remembered (weakly, per thread) so
     a call site that passes the SAME numpy array straight into the next
-    ``observed()`` dispatch is not double-counted. Returns bytes
-    counted."""
+    ``observed()`` dispatch is not double-counted. Returns bytes counted.
+
+    ``label``: attribution bucket, additionally counted under
+    ``jax.transfer.h2d_bytes.<label>``. Bytes a buffer-pool warm-up/miss
+    stages (``label="pool"``) belong to the POOL, not to the query that
+    happened to trigger the warm-up: they are excluded from the live
+    devprof profile, so per-query h2d splits stay truthful. Unlabeled
+    (query-side) staging IS attributed to the profiled query."""
     total = _np_bytes(arrays)
     if total:
-        registry().counter("jax.transfer.h2d_bytes").inc(total)
+        reg = registry()
+        reg.counter("jax.transfer.h2d_bytes").inc(total)
+        if label:
+            reg.counter(f"jax.transfer.h2d_bytes.{label}").inc(total)
         _note_pending_h2d(arrays)
+        if label != "pool" and _devmon.PROFILING:
+            prof = _devmon.current_profile()
+            if prof is not None:
+                prof.note_h2d(total)
     return total
 
 

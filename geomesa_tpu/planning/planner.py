@@ -341,6 +341,42 @@ class QueryPlanner:
         return subs
 
 
+AGG_PROBE_EVERY = 16  # routing consults between probes of the loser
+
+
+def choose_agg_path(cost_table, type_name: str,
+                    min_observations: int = 8) -> str:
+    """Route one eligible grouped aggregation: the GeoBlocks pyramid
+    (``"pyramid"``) or the fused device scan (``"scan"``).
+
+    Consults the devmon observed-cost table (``/api/obs/costs`` — the
+    ROADMAP item-3 feedback loop): once BOTH routes have enough
+    observations under this type, the lower p50 wins; until then the
+    pyramid is the default — repeated polygon/bbox aggregations are
+    exactly the workload it exists for, and its boundary refinement is
+    O(perimeter) where the scan is O(n). A verdict is not a ratchet:
+    every ``AGG_PROBE_EVERY``-th consult for the type routes to the
+    LOSING path, so both cost profiles stay fresh and the decision can
+    flip when the data or workload shifts. The probe schedule rides the
+    cost table's per-type consult counter (:meth:`CostTable.tick`) —
+    never the observation counts, which the winner freezes by starving
+    the loser of observations (a scan-only workload would otherwise
+    probe forever at a stuck multiple, and a pyramid-only one would
+    never measure the scan at all)."""
+    pyr = cost_table.predict(type_name, "gagg:pyramid")
+    scan = cost_table.predict(type_name, "gagg:scan")
+    scan_wins = (
+        pyr is not None
+        and scan is not None
+        and pyr.get("observations", 0) >= min_observations
+        and scan.get("observations", 0) >= min_observations
+        and scan["wall_ms_p50"] < pyr["wall_ms_p50"]
+    )
+    if cost_table.tick(type_name, "gagg:route") % AGG_PROBE_EVERY == 0:
+        return "pyramid" if scan_wins else "scan"  # probe the loser
+    return "scan" if scan_wins else "pyramid"
+
+
 def build_indices(sft: FeatureType) -> dict[str, FeatureIndex]:
     """Instantiate the index set for a schema (``IndexManager`` role).
 

@@ -15,6 +15,7 @@ Two implementations, mirroring the reference's two-tier test architecture
 from __future__ import annotations
 
 import os
+from contextlib import ExitStack
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -50,8 +51,14 @@ except ValueError as _e:
 class ExecutionBackend:
     name = "base"
 
-    def load(self, sft: FeatureType, table: FeatureTable, indices: dict) -> Any:
-        """(Re)build backend state for a snapshot of the data."""
+    def load(self, sft: FeatureType, table: FeatureTable, indices: dict,
+             fingerprint=None) -> Any:
+        """(Re)build backend state for a snapshot of the data.
+
+        ``fingerprint`` identifies the MAIN-TIER snapshot (the owning
+        type's rebuild epoch): backends with a buffer pool use it to
+        re-admit donated buffers from an identical prior load without
+        re-staging (delta-only writes keep the fingerprint stable)."""
         raise NotImplementedError
 
     def select(
@@ -72,7 +79,7 @@ class OracleBackend(ExecutionBackend):
 
     name = "oracle"
 
-    def load(self, sft, table, indices):
+    def load(self, sft, table, indices, fingerprint=None):
         return None
 
     def select(self, state, index, plan, extraction, residual, table):
@@ -120,6 +127,46 @@ class _MeshIndexState:
         return (c["x"], c["y"], c["bins"], c["offs"])
 
 
+def _slot_clearer(state: dict, name: str):
+    """Pool-eviction callback: clear the index's slot in the backend-state
+    dict so subsequent snapshots take the exact host path. Queries that
+    already snapshotted the state keep their reference — the arrays stay
+    alive until the last reader drops them, so eviction never invalidates
+    an in-flight dispatch."""
+
+    def _clear():
+        state[name] = None
+
+    return _clear
+
+
+def time_quads(sft: FeatureType, intervals) -> "np.ndarray | None":
+    """Interval list → (T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 quads
+    (the kernels' and the GeoBlocks pyramid's shared time payload), or
+    None for no temporal constraint. Every interval clamping away yields
+    the unsatisfiable quad — a temporally-impossible predicate must not
+    become a full-window scan."""
+    if intervals is None or not sft.dtg_field:
+        return None
+    binned = BinnedTime(sft.z3_interval)
+    from geomesa_tpu.curve.binned_time import MAX_BIN
+
+    quads = []
+    for lo, hi in intervals:
+        lo = max(int(lo), 0)
+        # last indexable millisecond: one before the start of bin MAX_BIN+1
+        hi_cap = int(binned.bin_start_millis(np.array([MAX_BIN + 1]))[0]) - 1
+        hi = min(int(hi), hi_cap)
+        if hi < lo:
+            continue
+        (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
+        (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
+        quads.append([int(blo), int(olo), int(bhi), int(ohi)])
+    if quads:
+        return np.array(quads, dtype=np.int32)
+    return np.array([[1, 0, 0, -1]], dtype=np.int32)
+
+
 class TpuBackend(ExecutionBackend):
     """Mesh-sharded columnar execution: the distributed-scan role of the
     tablet-server fleet. Row retrieval is two-pass — per-shard refine counts
@@ -129,8 +176,19 @@ class TpuBackend(ExecutionBackend):
 
     name = "tpu"
 
-    def __init__(self, mesh=None, max_device_bytes: int | None = None):
+    def __init__(self, mesh=None, max_device_bytes: int | None = None,
+                 pool=None):
         self._mesh = mesh
+        # shared HBM buffer pool (store/bufferpool.py): pins hot buffers
+        # across queries, evicts by SLO-weighted access frequency under
+        # the GEOMESA_TPU_HBM process budget, and re-admits donated
+        # buffers on fingerprint-stable reloads. One pool per backend so
+        # test stores never fight over same-named types.
+        if pool is None:
+            from geomesa_tpu.store.bufferpool import BufferPool
+
+            pool = BufferPool()
+        self.pool = pool
         # PER-TYPE HBM residency budget, enforced on each load() (the
         # hot-tier half of SURVEY.md §2.20 P9 at device granularity):
         # indexes past the budget stay host-resident — select() already
@@ -199,8 +257,9 @@ class TpuBackend(ExecutionBackend):
             if isinstance(dev, _MeshIndexState)
         }
 
-    def load(self, sft, table, indices):
+    def load(self, sft, table, indices, fingerprint=None):
         from geomesa_tpu.obs import devmon
+        from geomesa_tpu.store.bufferpool import register_residency
         from geomesa_tpu.parallel.mesh import shard_columns
 
         # HBM residency ledger: every device allocation this load makes is
@@ -211,6 +270,11 @@ class TpuBackend(ExecutionBackend):
         type_name = getattr(sft, "name", "?")
         ledger.begin_load(type_name)
         ledger.set_budget(self.max_device_bytes)
+        # retire this type's live pool entries: fingerprint-stable states
+        # (same main tier — recover() after a pressure eviction, reloads
+        # across delta-only writes) park in the donation stash for
+        # zero-copy re-admission below; anything stale is freed
+        self.pool.release(type_name, keep_fingerprint=fingerprint)
         state: dict[str, _MeshIndexState | None] = {}
         nlon = norm_lon(REFINE_PRECISION)
         nlat = norm_lat(REFINE_PRECISION)
@@ -226,7 +290,8 @@ class TpuBackend(ExecutionBackend):
         )
         used_bytes = 0
         est = 0
-        if self.max_device_bytes is not None:
+        if (self.max_device_bytes is not None
+                or self.pool.max_total_bytes is not None):
             # admission estimate: int32 columns at the REAL padded row count
             # (block-aligned shards — parallel/mesh.pad_rows with the
             # JOIN_BLOCK multiple — can round small tables up substantially)
@@ -240,83 +305,125 @@ class TpuBackend(ExecutionBackend):
             est = n_cols * 4 * pad_rows(
                 max(len(table), shards), shards, JOIN_BLOCK
             )
-        for name, index in ordered:
-            col = table.geom_column() if sft.geom_field else None
-            if col is None or len(table) == 0 or name in ("id",):
-                state[name] = None  # host path BY DESIGN — never a spill
-                continue
-            if col.x is None and col.bounds is None:
-                state[name] = None
-                continue
-            if self.max_device_bytes is not None:
-                if used_bytes + est > self.max_device_bytes:
-                    state[name] = None  # host path serves this index
+        # buffers admitted by THIS load stay pinned until the loop ends:
+        # a later (lower-priority) index's ensure_room must not evict the
+        # just-staged higher-priority one — fresh entries have hits=0 and
+        # would otherwise be the coldest eviction candidates, inverting
+        # _LOAD_PRIORITY and wasting the h2d staging just paid for. With
+        # release() having retired this type's prior entries above, load-
+        # pressure evictions can only fall on OTHER types' cold buffers.
+        load_pins = ExitStack()
+        try:
+            for name, index in ordered:
+                col = table.geom_column() if sft.geom_field else None
+                if col is None or len(table) == 0 or name in ("id",):
+                    state[name] = None  # host path BY DESIGN — never a spill
+                    continue
+                if col.x is None and col.bounds is None:
+                    state[name] = None
+                    continue
+                if self.max_device_bytes is not None:
+                    if used_bytes + est > self.max_device_bytes:
+                        state[name] = None  # host path serves this index
+                        ledger.record_spill(type_name, name, est)
+                        # a donated state for a refused index would hold the
+                        # very bytes the budget just declined — free it
+                        self.pool.drop_donated(type_name, name)
+                        continue
+                # donation fast path: an identical prior load of this index
+                # (same fingerprint = same main tier) parked in the pool's
+                # stash — re-admit it without staging a byte host→device.
+                # The evictor rebinds to THIS load's state dict.
+                donated = self.pool.take_donated(
+                    type_name, name, fingerprint,
+                    on_evict=_slot_clearer(state, name))
+                if donated is not None:
+                    state[name] = donated
+                    used_bytes += donated.nbytes
+                    load_pins.enter_context(self.pool.pinned(type_name, name))
+                    continue
+                # process-level pool budget (GEOMESA_TPU_HBM): make room by
+                # evicting the coldest unpinned buffers (other types' first,
+                # by SLO-weighted access frequency); an immovable working set
+                # spills this index to the host path, same as per-type
+                if not self.pool.ensure_room(est or 0):
+                    state[name] = None
                     ledger.record_spill(type_name, name, est)
                     continue
-            if mesh is None:
-                mesh = self._get_mesh()
-            perm = index.perm
-            if binned is not None:
-                bins, offs = binned.to_bin_and_offset(table.dtg_millis()[perm])
-                bins = bins.astype(np.int32)
-                offs = offs.astype(np.int32)
-            else:
-                bins = np.zeros(len(table), dtype=np.int32)
-                offs = np.zeros(len(table), dtype=np.int32)
-            if col.x is not None:
-                xi = nlon.normalize(col.x[perm]).astype(np.int32)
-                yi = nlat.normalize(col.y[perm]).astype(np.int32)
-                # block-aligned shards so block-granular kernels (the
-                # block-sparse join over the z2 layout) divide evenly
-                cols, padded, rows_per_shard = shard_columns(
-                    mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs},
-                    multiple=JOIN_BLOCK,
-                )
-                state[name] = _MeshIndexState(
-                    cols=cols, rows_per_shard=rows_per_shard, n=len(table)
-                )
-                used_bytes += state[name].nbytes
-                ledger.register(type_name, name, devmon.GROUP_SPATIAL,
-                                state[name].nbytes, owner=state[name])
-            else:
-                # extended geometries: shard the bbox SoA for overlap refine.
-                # Null geometries leave NaN bounds — normalize a dummy, then
-                # stamp an unsatisfiable interval so they never match (the
-                # residual filter already excludes them on the host path)
-                b = col.bounds[perm]
-                invalid = (
-                    np.zeros(len(b), dtype=bool)
-                    if col.valid is None
-                    else ~col.valid[perm]
-                )
-                invalid |= ~np.isfinite(b).all(axis=1)
-                if invalid.any():
-                    b = np.where(invalid[:, None], 0.0, b)
-                xmin = nlon.normalize(b[:, 0]).astype(np.int32)
-                ymin = nlat.normalize(b[:, 1]).astype(np.int32)
-                xmax = nlon.normalize(b[:, 2]).astype(np.int32)
-                ymax = nlat.normalize(b[:, 3]).astype(np.int32)
-                if invalid.any():
-                    imax = np.iinfo(np.int32).max
-                    xmin[invalid] = imax
-                    xmax[invalid] = -1  # hi < 0 <= qlo: overlap always false
-                    ymin[invalid] = imax
-                    ymax[invalid] = -1
-                cols, padded, rows_per_shard = shard_columns(
-                    mesh,
-                    {
-                        "xmin": xmin, "ymin": ymin, "xmax": xmax, "ymax": ymax,
-                        "bins": bins, "offs": offs,
-                    },
-                    multiple=JOIN_BLOCK,
-                )
-                state[name] = _MeshIndexState(
-                    cols=cols, rows_per_shard=rows_per_shard, n=len(table),
-                    kind="bboxes",
-                )
-                used_bytes += state[name].nbytes
-                ledger.register(type_name, name, devmon.GROUP_BBOX,
-                                state[name].nbytes, owner=state[name])
+                if mesh is None:
+                    mesh = self._get_mesh()
+                perm = index.perm
+                if binned is not None:
+                    bins, offs = binned.to_bin_and_offset(table.dtg_millis()[perm])
+                    bins = bins.astype(np.int32)
+                    offs = offs.astype(np.int32)
+                else:
+                    bins = np.zeros(len(table), dtype=np.int32)
+                    offs = np.zeros(len(table), dtype=np.int32)
+                if col.x is not None:
+                    xi = nlon.normalize(col.x[perm]).astype(np.int32)
+                    yi = nlat.normalize(col.y[perm]).astype(np.int32)
+                    # block-aligned shards so block-granular kernels (the
+                    # block-sparse join over the z2 layout) divide evenly
+                    cols, padded, rows_per_shard = shard_columns(
+                        mesh, {"x": xi, "y": yi, "bins": bins, "offs": offs},
+                        multiple=JOIN_BLOCK,
+                    )
+                    state[name] = _MeshIndexState(
+                        cols=cols, rows_per_shard=rows_per_shard, n=len(table)
+                    )
+                    used_bytes += state[name].nbytes
+                    register_residency(
+                        self.pool, type_name, name, devmon.GROUP_SPATIAL,
+                        state[name].nbytes, owner=state[name],
+                        fingerprint=fingerprint,
+                        on_evict=_slot_clearer(state, name))
+                    load_pins.enter_context(self.pool.pinned(type_name, name))
+                else:
+                    # extended geometries: shard the bbox SoA for overlap refine.
+                    # Null geometries leave NaN bounds — normalize a dummy, then
+                    # stamp an unsatisfiable interval so they never match (the
+                    # residual filter already excludes them on the host path)
+                    b = col.bounds[perm]
+                    invalid = (
+                        np.zeros(len(b), dtype=bool)
+                        if col.valid is None
+                        else ~col.valid[perm]
+                    )
+                    invalid |= ~np.isfinite(b).all(axis=1)
+                    if invalid.any():
+                        b = np.where(invalid[:, None], 0.0, b)
+                    xmin = nlon.normalize(b[:, 0]).astype(np.int32)
+                    ymin = nlat.normalize(b[:, 1]).astype(np.int32)
+                    xmax = nlon.normalize(b[:, 2]).astype(np.int32)
+                    ymax = nlat.normalize(b[:, 3]).astype(np.int32)
+                    if invalid.any():
+                        imax = np.iinfo(np.int32).max
+                        xmin[invalid] = imax
+                        xmax[invalid] = -1  # hi < 0 <= qlo: overlap always false
+                        ymin[invalid] = imax
+                        ymax[invalid] = -1
+                    cols, padded, rows_per_shard = shard_columns(
+                        mesh,
+                        {
+                            "xmin": xmin, "ymin": ymin, "xmax": xmax, "ymax": ymax,
+                            "bins": bins, "offs": offs,
+                        },
+                        multiple=JOIN_BLOCK,
+                    )
+                    state[name] = _MeshIndexState(
+                        cols=cols, rows_per_shard=rows_per_shard, n=len(table),
+                        kind="bboxes",
+                    )
+                    used_bytes += state[name].nbytes
+                    register_residency(
+                        self.pool, type_name, name, devmon.GROUP_BBOX,
+                        state[name].nbytes, owner=state[name],
+                        fingerprint=fingerprint,
+                        on_evict=_slot_clearer(state, name))
+                    load_pins.enter_context(self.pool.pinned(type_name, name))
+        finally:
+            load_pins.close()
         return state
 
     # -- refine payload (int-domain superset bounds) -------------------------
@@ -339,31 +446,7 @@ class TpuBackend(ExecutionBackend):
                 ],
                 dtype=np.int32,
             )
-        times = None
-        if e.intervals is not None and sft.dtg_field:
-            binned = BinnedTime(sft.z3_interval)
-            max_off = int(binned.max_offset)
-            from geomesa_tpu.curve.binned_time import MAX_BIN
-
-            quads = []
-            for lo, hi in e.intervals:
-                lo = max(int(lo), 0)
-                # last indexable millisecond: one before the start of bin MAX_BIN+1
-                hi_cap = int(binned.bin_start_millis(np.array([MAX_BIN + 1]))[0]) - 1
-                hi = min(int(hi), hi_cap)
-                if hi < lo:
-                    continue
-                (blo,), (olo,) = binned.to_bin_and_offset(np.array([lo]))
-                (bhi,), (ohi,) = binned.to_bin_and_offset(np.array([hi]))
-                quads.append([int(blo), int(olo), int(bhi), int(ohi)])
-            if quads:
-                times = np.array(quads, dtype=np.int32)
-            else:
-                # a temporal constraint exists but every interval clamped
-                # AWAY (pre-epoch / beyond MAX_BIN): the predicate is
-                # temporally UNSATISFIABLE — pack an impossible window, not
-                # the no-constraint full window an empty array would become
-                times = np.array([[1, 0, 0, -1]], dtype=np.int32)
+        times = time_quads(sft, e.intervals)
         return pack_boxes(boxes, overlap=overlap), pack_times(times)
 
     def select(self, state, index, plan, extraction, residual, table):
@@ -371,16 +454,33 @@ class TpuBackend(ExecutionBackend):
         if len(intervals) == 0:
             return np.empty(0, dtype=np.int64)
         dev = state.get(index.name) if state else None
+        type_name = getattr(index.sft, "name", "?")
         if dev is None:
-            # host path (extended geometries, id index): expand + residual
+            # host path: expand + residual. A pool MISS only when this
+            # index COULD have been resident (a device-servable layout
+            # over a non-empty geometry table — i.e. it was evicted or
+            # budget-spilled); host-by-design indexes (id, geometry-less
+            # types) must not drown the hit rate in noise
+            if (
+                state
+                and index.name in self._LOAD_PRIORITY
+                and len(table)
+                and index.sft.geom_field is not None
+            ):
+                self.pool.note_miss(type_name, index.name)
             with obs.span("refine", mode="host", index=index.name):
                 positions, total = gather_indices(intervals)
                 rows = index.perm[positions[:total]]
                 sub = table.take(rows)
                 return rows[residual.mask(sub)]
 
-        with obs.span("dispatch", index=index.name,
-                      intervals=len(intervals)):
+        # access-frequency accounting + dispatch pin: a pinned buffer is
+        # never an eviction victim, so the scan below cannot lose its
+        # columns mid-flight
+        self.pool.touch(type_name, index.name)
+        with self.pool.pinned(type_name, index.name), \
+                obs.span("dispatch", index=index.name,
+                         intervals=len(intervals)):
             positions = self._mesh_select_positions(
                 dev, index, extraction, intervals
             )
@@ -468,26 +568,31 @@ class TpuBackend(ExecutionBackend):
         args = (
             *dev.spatial_cols(), jnp.int32(dev.n),
         )
-        with obs.span("dispatch.count", queries=nq, pairs=len(pair_q)):
-            counts = np.asarray(
-                cached_planned_count_step(mesh, nqp, B, budget, chunk,
-                                          overlap=overlap)(
-                    *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
-                    jnp.asarray(boxes[None]), jnp.asarray(times[None]),
+        # pool accounting + pin: the batch's two dispatches read the same
+        # resident columns; pinned buffers are never eviction victims
+        type_name = getattr(index.sft, "name", "?")
+        self.pool.touch(type_name, index.name)
+        with self.pool.pinned(type_name, index.name):
+            with obs.span("dispatch.count", queries=nq, pairs=len(pair_q)):
+                counts = np.asarray(
+                    cached_planned_count_step(mesh, nqp, B, budget, chunk,
+                                              overlap=overlap)(
+                        *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
+                        jnp.asarray(boxes[None]), jnp.asarray(times[None]),
+                    )
+                )[0]
+            total = int(counts.sum())
+            if total == 0:
+                return empty
+            capacity = pad_bucket(total, minimum=128)
+            with obs.span("dispatch.gather", capacity=capacity):
+                buf, hits = cached_planned_gather_step(
+                    mesh, B, budget, capacity, chunk, overlap=overlap)(
+                    *args, jnp.asarray(pq), jnp.asarray(pb),
+                    jnp.asarray(boxes), jnp.asarray(times),
                 )
-            )[0]
-        total = int(counts.sum())
-        if total == 0:
-            return empty
-        capacity = pad_bucket(total, minimum=128)
-        with obs.span("dispatch.gather", capacity=capacity):
-            buf, hits = cached_planned_gather_step(mesh, B, budget, capacity,
-                                                   chunk, overlap=overlap)(
-                *args, jnp.asarray(pq), jnp.asarray(pb),
-                jnp.asarray(boxes), jnp.asarray(times),
-            )
-            buf = np.asarray(buf)
-            hits = np.asarray(hits)
+                buf = np.asarray(buf)
+                hits = np.asarray(hits)
         # per-pair spans: a pair's rows sit in its OWNER shard's buffer,
         # consecutively in pair-index order (the device scan's write order)
         blocks_per_shard = dev.rows_per_shard // B

@@ -1,0 +1,397 @@
+"""Shared HBM buffer pool / residency manager (ROADMAP item 1).
+
+The backend's per-type ``max_device_bytes`` budget decides what a single
+type may hold (over-budget indexes spill to the host path exactly as
+before); the pool layers the CROSS-query, cross-type policy on top:
+
+- **Pinning**: every device-resident (type, index, column-group) buffer
+  registers here; the pool holds the strong reference that keeps the
+  owning state object (and its device arrays) alive between queries.
+- **Eviction**: a process-level HBM budget (``GEOMESA_TPU_HBM`` bytes, or
+  the constructor argument) caps TOTAL residency. Admission sums the
+  per-entry byte counts recorded at registration — the same values
+  handed to the devmon residency ledger, which remains the reporting
+  source of truth (agreement is pinned in tests; live ledger sums are
+  not used for admission because a mid-rebuild type briefly has old and
+  new rows ledgered at once). When a load needs room, the coldest
+  unpinned buffers go first, ordered by SLO-weighted
+  access frequency: ``(slo_weight, hits, last_used)`` ascending, so a
+  type burning its SLO budget keeps its buffers over an idle one. A
+  buffer that is **pinned** (a dispatch is reading it right now) is
+  never a victim — eviction mid-dispatch is impossible by construction.
+- **Donation**: an evicted (or released-for-reload) state object parks in
+  a victim stash keyed by its load *fingerprint* (the owning type's
+  rebuild epoch). Delta writes don't bump the rebuild epoch — the main
+  tier is unchanged — so donated buffers stay reusable across hot-tier
+  appends; the next ``load``/``recover`` at the same fingerprint
+  re-admits them without re-staging a single byte host→device. The
+  stash is the FIRST thing reclaimed when room is needed (it is spare
+  capacity, not working set).
+
+Evicted groups land in the ledger's spill report (``type``,
+``index:group``) so the ops surface shows what the budget pushed out.
+
+Locking: ONE leaf lock (docs/concurrency.md). Eviction callbacks and
+reference drops (which trigger device deallocation + the ledger's
+weakref finalizers) always run AFTER the lock is released — no foreign
+lock and no blocking call is ever taken under it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+
+__all__ = ["HBM_ENV", "BufferPool", "register_residency"]
+
+HBM_ENV = "GEOMESA_TPU_HBM"  # process-level pool budget, in bytes
+
+
+def _env_budget() -> int | None:
+    raw = os.environ.get(HBM_ENV)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{HBM_ENV} must be an integer byte count, got {raw!r}"
+        ) from None
+
+
+class _Entry:
+    """One pooled residency unit: every column group registered for one
+    (type, index) owner object. Access stats live here; the strong
+    ``owner`` reference IS the pin that keeps the device arrays alive."""
+
+    __slots__ = ("type_name", "index", "groups", "owner", "on_evict",
+                 "fingerprint", "hits", "last_used", "pins")
+
+    def __init__(self, type_name, index, owner, fingerprint, on_evict):
+        self.type_name = type_name
+        self.index = index
+        self.groups: dict[str, int] = {}
+        self.owner = owner
+        self.on_evict = on_evict
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.last_used = 0
+        self.pins = 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(self.groups.values())
+
+
+class BufferPool:
+    """See module docstring. One instance per :class:`TpuBackend`."""
+
+    def __init__(self, max_total_bytes: int | None = None):
+        if max_total_bytes is None:
+            max_total_bytes = _env_budget()
+        self.max_total_bytes = max_total_bytes
+        self._lock = threading.Lock()  # leaf: entries/stash/stats only
+        self._entries: dict[tuple, _Entry] = {}  # (type, index) -> entry
+        # victim stash: (type, index, fingerprint) -> _Entry (insertion
+        # order = donation order; reclaimed oldest-first)
+        self._donated: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._clock = 0
+        # SLO weight per type (>= 1.0): higher = keep resident longer.
+        # DataStore feeds this from the SLO engine's remaining budget.
+        self._weights: dict[str, float] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.donations = 0
+        self.reuses = 0
+
+    # -- accounting source of truth -------------------------------------------
+    @staticmethod
+    def _ledger():
+        from geomesa_tpu.obs import devmon
+
+        return devmon.ledger()
+
+    # -- registration (the backend's side) ------------------------------------
+    def register(self, type_name: str, index: str, group: str, nbytes: int,
+                 owner, fingerprint=None, on_evict=None) -> None:
+        """Pin one column group. Groups registered for the same
+        (type, index) with the same owner merge into one entry (they share
+        one lifetime); a different owner replaces the entry (reload)."""
+        with self._lock:
+            key = (type_name, index)
+            e = self._entries.get(key)
+            if e is None or e.owner is not owner:
+                e = self._entries[key] = _Entry(
+                    type_name, index, owner, fingerprint, on_evict)
+            e.groups[group] = e.groups.get(group, 0) + int(nbytes)
+            if on_evict is not None:
+                e.on_evict = on_evict
+            if fingerprint is not None:
+                e.fingerprint = fingerprint
+            self._clock += 1
+            e.last_used = self._clock
+
+    def touch(self, type_name: str, index: str) -> bool:
+        """Access-frequency accounting: a dispatch is about to read this
+        buffer. Returns True (hit) when the buffer is pooled."""
+        with self._lock:
+            e = self._entries.get((type_name, index))
+            if e is None:
+                self.misses += 1
+                return False
+            self._clock += 1
+            e.hits += 1
+            e.last_used = self._clock
+            self.hits += 1
+            return True
+
+    def note_miss(self, type_name: str, index: str) -> None:
+        """A dispatch wanted resident buffers that are not pooled (host
+        fallback)."""
+        with self._lock:
+            self.misses += 1
+
+    def note_slo(self, type_name: str, budget_remaining: float) -> None:
+        """SLO feedback: weight = 2 - remaining budget fraction, so a type
+        with an exhausted error budget scores double an untroubled one."""
+        w = 2.0 - min(max(float(budget_remaining), 0.0), 1.0)
+        with self._lock:
+            self._weights[type_name] = max(w, 1.0)
+
+    # -- pinning (dispatch protection) ----------------------------------------
+    @contextmanager
+    def pinned(self, type_name: str, index: str):
+        """Hold while a dispatch reads the buffers of (type, index): a
+        pinned entry is never an eviction victim."""
+        key = (type_name, index)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.pins += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                e2 = self._entries.get(key)
+                if e2 is not None and e2 is e:
+                    e2.pins = max(e2.pins - 1, 0)
+
+    # -- eviction / room management -------------------------------------------
+    def _score(self, e: _Entry) -> tuple:
+        """Eviction order key, ascending = colder. SLO-weighted access
+        frequency: weight first (protect burning types), then lifetime
+        hits, then recency."""
+        w = self._weights.get(e.type_name, 1.0)
+        return (w, e.hits, e.last_used)
+
+    def _usage(self) -> int:
+        """Bytes this pool manages: the per-entry group bytes recorded at
+        registration — the SAME values the devmon ledger was handed, so
+        the two agree in steady state (pinned in tests/test_bufferpool).
+        Summed per entry rather than queried live from the ledger for
+        two reasons: foreign allocations (another store's same-named
+        type) must not count against this budget, and during a rebuild
+        the old state's ledger rows linger until the swap — live ledger
+        sums would double-count the type and over-evict mid-load."""
+        with self._lock:
+            return (
+                sum(e.nbytes for e in self._entries.values())
+                + sum(e.nbytes for e in self._donated.values())
+            )
+
+    def ensure_room(self, need_bytes: int) -> bool:
+        """Make ``need_bytes`` of budget headroom, reclaiming the donated
+        stash first (it is spare capacity, not working set), then
+        evicting the coldest unpinned live entries. Returns False when
+        the remaining (pinned) working set cannot fit the request — the
+        caller spills to host, exactly as a per-type over-budget load
+        does. Reference drops happen OUTSIDE the pool lock: deallocation
+        runs the ledger's weakref finalizers."""
+        if self.max_total_bytes is None:
+            return True
+
+        def _headroom() -> int:
+            return self.max_total_bytes - self._usage()
+
+        if _headroom() >= need_bytes:
+            return True
+        # 1) reclaim the stash, oldest donation first
+        while _headroom() < need_bytes:
+            with self._lock:
+                if not self._donated:
+                    break
+                _, victim = self._donated.popitem(last=False)
+            victim = None  # noqa: F841 — ref drop IS the reclamation
+        if _headroom() >= need_bytes:
+            return True
+        # 2) evict cold live entries (never pinned ones); room is needed
+        #    NOW, so pressure evictions free immediately instead of
+        #    parking in the stash
+        while True:
+            with self._lock:
+                candidates = [
+                    e for e in self._entries.values() if e.pins == 0
+                ]
+                victim = (
+                    min(candidates, key=self._score) if candidates else None
+                )
+                if victim is not None:
+                    del self._entries[(victim.type_name, victim.index)]
+                    self.evictions += 1
+            if victim is None:  # only pinned working set left
+                return _headroom() >= need_bytes
+            self._after_evict(victim)
+            victim = None  # the last strong ref: device bytes free here
+            if _headroom() >= need_bytes:
+                return True
+
+    def _after_evict(self, e: _Entry) -> None:
+        """Post-eviction bookkeeping, OUTSIDE the pool lock: clear the
+        owner's slot (host path serves from now on) and record the spill."""
+        if e.on_evict is not None:
+            try:
+                e.on_evict()
+            except Exception:  # noqa: BLE001 — bookkeeping must not throw
+                pass
+        ledger = self._ledger()
+        for group, nbytes in e.groups.items():
+            ledger.record_spill(e.type_name, f"{e.index}:{group}", nbytes)
+
+    # -- release / donation (reload seam) -------------------------------------
+    def release(self, type_name: str, keep_fingerprint=None) -> None:
+        """A fresh load for ``type_name`` is starting: retire its live
+        entries. Entries whose fingerprint matches ``keep_fingerprint``
+        (same main tier — e.g. a recover() after a budget eviction, or a
+        reload across delta-only writes) move to the donation stash for
+        zero-copy re-admission; anything else is dropped (data changed)."""
+        drop: list[_Entry] = []
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == type_name]:
+                e = self._entries.pop(key)
+                if (keep_fingerprint is not None
+                        and e.fingerprint == keep_fingerprint):
+                    self.donations += 1
+                    self._donated[(e.type_name, e.index, e.fingerprint)] = e
+                else:
+                    drop.append(e)
+            # stale stash entries of this type with a DIFFERENT fingerprint
+            # can never be re-admitted — free them now
+            for key in [k for k in self._donated
+                        if k[0] == type_name and k[2] != keep_fingerprint]:
+                drop.append(self._donated.pop(key))
+        del drop  # refs drop outside the lock
+
+    def take_donated(self, type_name: str, index: str, fingerprint,
+                     on_evict=None):
+        """Re-admit a donated buffer set: returns the stashed owner state
+        (its ledger entries never unregistered — accounting is
+        continuous) or None. ``on_evict`` MUST be the slot-clearer bound
+        to the caller's NEW state dict — the stashed closure points at
+        the discarded one, and a later eviction through it would free
+        nothing while the live slot kept serving."""
+        if fingerprint is None:
+            return None
+        with self._lock:
+            e = self._donated.pop((type_name, index, fingerprint), None)
+            if e is None:
+                return None
+            self.reuses += 1
+            key = (type_name, index)
+            self._entries[key] = e
+            if on_evict is not None:
+                e.on_evict = on_evict
+            self._clock += 1
+            e.last_used = self._clock
+            return e.owner
+
+    def drop_donated(self, type_name: str, index: str) -> None:
+        """Free any stashed donation for one (type, index) — a load whose
+        budget refused the index must not leave its old buffers holding
+        the very bytes it declined."""
+        drop = []
+        with self._lock:
+            for key in [k for k in self._donated
+                        if k[0] == type_name and k[1] == index]:
+                drop.append(self._donated.pop(key))
+        del drop
+
+    def purge(self, type_name: str) -> None:
+        """Drop every live and donated entry of one type (explicit
+        ``evict_device`` — operator intent: free the HBM now)."""
+        drop = []
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == type_name]:
+                drop.append(self._entries.pop(key))
+            for key in [k for k in self._donated if k[0] == type_name]:
+                drop.append(self._donated.pop(key))
+        del drop
+
+    # -- read surface ---------------------------------------------------------
+    def donated_bytes(self, type_name: str | None = None) -> int:
+        with self._lock:
+            return sum(
+                e.nbytes for e in self._donated.values()
+                if type_name is None or e.type_name == type_name
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = [
+                {
+                    "type": e.type_name,
+                    "index": e.index,
+                    "groups": dict(e.groups),
+                    "bytes": e.nbytes,
+                    "hits": e.hits,
+                    "pinned": e.pins > 0,
+                }
+                for e in self._entries.values()
+            ]
+            return {
+                "budget_bytes": self.max_total_bytes,
+                "entries": sorted(
+                    entries, key=lambda d: (d["type"], d["index"])),
+                "resident_bytes": sum(d["bytes"] for d in entries),
+                "donated_bytes": sum(
+                    e.nbytes for e in self._donated.values()),
+                "donated_count": len(self._donated),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "donations": self.donations,
+                "reuses": self.reuses,
+                "slo_weights": dict(self._weights),
+            }
+
+    def prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
+        snap = self.snapshot()
+        lines = []
+        for name in ("hits", "misses", "evictions"):
+            lines.append(f"# TYPE {prefix}_pool_{name} counter")
+            lines.append(f"{prefix}_pool_{name} {snap[name]}")
+        lines.append(f"# TYPE {prefix}_pool_resident_bytes gauge")
+        lines.append(
+            f"{prefix}_pool_resident_bytes {snap['resident_bytes']}")
+        lines.append(f"# TYPE {prefix}_pool_donated_bytes gauge")
+        lines.append(f"{prefix}_pool_donated_bytes {snap['donated_bytes']}")
+        return lines
+
+
+def register_residency(pool: BufferPool, type_name: str, index: str,
+                       group: str, nbytes: int, owner,
+                       fingerprint=None, on_evict=None) -> None:
+    """Register one device allocation with BOTH accounting systems in one
+    call — the devmon residency ledger (reporting; unregisters via the
+    owner's finalizer) and the buffer pool (budget admission/eviction).
+    Every call site that hands the pair identical values by hand is one
+    edit away from desynchronizing them: bytes resident in HBM but
+    invisible to the budget, or budgeted bytes the ledger never reports."""
+    from geomesa_tpu.obs import devmon
+
+    devmon.ledger().register(type_name, index, group, int(nbytes),
+                             owner=owner)
+    pool.register(type_name, index, group, int(nbytes), owner=owner,
+                  fingerprint=fingerprint, on_evict=on_evict)

@@ -98,6 +98,9 @@ class ExplainAnalyze:
     hits: int
     device: "dict | None" = None
     cost: "dict | None" = None
+    # buffer-pool / query-cache gauges at analyze time (hit/miss/eviction
+    # counters + pyramid bytes — DataStore.cache_report)
+    cache: "dict | None" = None
 
     @property
     def stages(self) -> list:
@@ -126,6 +129,20 @@ class ExplainAnalyze:
                    else "no prior observations")
                 + f", actual {self.cost.get('actual_ms')} ms"
             )
+        if self.cache:
+            ac = self.cache.get("agg_cache") or {}
+            pool = self.cache.get("pool") or {}
+            out += (
+                f"\n  Cache: agg hits {ac.get('hits', 0)} / misses "
+                f"{ac.get('misses', 0)} / evictions "
+                f"{ac.get('evictions', 0)}; pool hits "
+                f"{pool.get('hits', 0)} / misses {pool.get('misses', 0)}"
+                f" / evictions {pool.get('evictions', 0)}"
+            )
+            pb = self.cache.get("pyramid_bytes") or {}
+            if pb:
+                out += "; pyramid bytes " + ", ".join(
+                    f"{t}={b}" for t, b in sorted(pb.items()))
         return out + f"\n  Hits: {self.hits}"
 
 
@@ -138,6 +155,12 @@ class _TypeState:
     stats: Any = None  # StoreStats
     delta: Any = None  # DeltaTier (hot append buffer)
     fid_seq: int = 0  # monotonic sequential-fid allocator (under `lock`)
+    # main-tier rebuild epoch: bumps on every state swap (compact, delete,
+    # age-off, evolution). With the delta tier's mutation version it forms
+    # the DATA EPOCH stamped on cached aggregates (ops/geoblocks.py) and
+    # the buffer pool's donation fingerprint — delta-only writes bump the
+    # version but not this, so donated main-tier buffers stay reusable
+    epoch: int = 0
 
     def __post_init__(self):
         if self.delta is None:
@@ -153,6 +176,10 @@ class _TypeState:
         from collections import OrderedDict
 
         self.plan_cache: OrderedDict = OrderedDict()
+        # GeoBlocks pre-aggregation pyramids, one per (group_by tuple,
+        # value_cols tuple): immutable, stamped with the data epoch at
+        # build time, dropped wholesale on every rebuild (under `lock`)
+        self.pyramids: dict = {}
         import threading
 
         # `lock` guards the coherent (table, indices, backend_state, stats,
@@ -175,6 +202,15 @@ class _TypeState:
                 self.stats,
                 self.delta.merged(),
             )
+
+    def data_epoch(self) -> tuple:
+        """The (rebuild epoch, delta version) pair every mutation advances
+        monotonically. Cache users MUST read this BEFORE taking the data
+        snapshot they compute from: a mutation racing the computation then
+        stamps the entry with a pair that never recurs — a guaranteed
+        future MISS, never a stale hit."""
+        with self.lock:
+            return (self.epoch, self.delta.version)
 
     def consume_snapshot(self):
         """Mutator-side snapshot: state + the number of delta tables the
@@ -230,6 +266,12 @@ class DataStore:
 
         self.slo = SloEngine()
         self.slo.objective("store.query", target=0.999)
+        # GeoBlocks query cache (ops/geoblocks.py): exact-repeat grouped
+        # aggregations served straight from cache, epoch-validated so a
+        # write can never leave a stale answer servable
+        from geomesa_tpu.ops.geoblocks import QueryCache
+
+        self.agg_cache = QueryCache()
         from geomesa_tpu.utils import timeouts as _timeouts
         from geomesa_tpu.utils.timeouts import Watchdog
 
@@ -312,11 +354,14 @@ class DataStore:
             # residency for a table that is no longer current
             with st.mutate_lock:
                 with st.lock:
-                    table, indices = st.table, st.indices
+                    table, indices, epoch = st.table, st.indices, st.epoch
                 if table is None:
                     continue
                 try:
-                    loaded = self.backend.load(st.sft, table, indices)
+                    # same main tier → same fingerprint: buffers a pool-
+                    # pressure eviction donated re-admit without staging
+                    loaded = self.backend.load(
+                        st.sft, table, indices, fingerprint=epoch)
                     with st.lock:
                         st.backend_state = loaded
                 except Exception as e:  # noqa: BLE001 — degrade, don't fail
@@ -433,6 +478,8 @@ class DataStore:
                     st.backend_state = None
                     st.delta.drop_first(n_tables)
                     st.plan_cache.clear()
+                    st.pyramids.clear()
+                    st.epoch += 1
         if rename_to and rename_to != type_name:
             with self._schema_lock:
                 self._types[rename_to] = self._types.pop(type_name)
@@ -441,6 +488,17 @@ class DataStore:
                     (rename_to if scope == type_name else scope, fn)
                     for scope, fn in self._interceptors
                 ]
+            # device residency, cached aggregates, cost rows are all keyed
+            # by type NAME: a rebuild above registered them under the OLD
+            # name, where they would leak forever (and poison a future
+            # schema reusing that name). Drop the device state so the next
+            # query rebuilds under the new name, then purge the old key.
+            st = self._types[rename_to]
+            with st.mutate_lock:
+                with st.lock:
+                    st.backend_state = None
+                    st.pyramids.clear()
+            self._purge_type_name(type_name)
         return new_sft
 
     def get_schema(self, name: str) -> FeatureType:
@@ -452,6 +510,26 @@ class DataStore:
     def delete_schema(self, name: str) -> None:
         with self._schema_lock:
             del self._types[name]
+        # a recreated same-name type RESTARTS its rebuild epoch and delta
+        # version at the same values, so everything keyed by type name
+        # must die with the schema: cached aggregates (the epoch tuple
+        # recurs — the successor would read the dead table's answers as
+        # current), pool entries/donations (a fingerprint collision would
+        # re-admit the dead table's device columns as the new state), the
+        # spill report, and the observed cost profile + probe phase
+        self._purge_type_name(name)
+
+    def _purge_type_name(self, name: str) -> None:
+        """Drop every store/pool/telemetry artifact keyed by a type NAME
+        whose schema no longer answers for it (delete, rename)."""
+        self.agg_cache.invalidate(name)
+        pool = getattr(self.backend, "pool", None)
+        if pool is not None:
+            pool.purge(name)
+        from geomesa_tpu.obs import devmon
+
+        devmon.ledger().clear_spills(name)
+        devmon.costs().forget(name)
 
     def _state(self, name: str) -> _TypeState:
         if name not in self._types:
@@ -725,6 +803,10 @@ class DataStore:
         """
         sft = new_sft if new_sft is not None else st.sft
         indices = build_indices(sft)
+        # the NEXT rebuild epoch (mutate_lock serializes mutators, so the
+        # increment is race-free): the backend load's donation fingerprint
+        # and, at swap, the new data-epoch component
+        next_epoch = st.epoch + 1
         sorter = self._device_sorter(len(table))
         for name, index in indices.items():
             prev = (prev_indices or {}).get(name)
@@ -744,7 +826,8 @@ class DataStore:
             else:
                 index.build(table)
         try:
-            backend_state = self.backend.load(sft, table, indices)
+            backend_state = self.backend.load(
+                sft, table, indices, fingerprint=next_epoch)
         except Exception as e:  # noqa: BLE001 — write must not die with the device
             if not self._is_device_error(e):
                 raise
@@ -764,6 +847,8 @@ class DataStore:
             st.stats = stats
             st.delta.drop_first(consumed_tables)
             st.plan_cache.clear()
+            st.pyramids.clear()  # built from the OLD main tier
+            st.epoch = next_epoch
 
     # -- age-off (AgeOffIterator / DtgAgeOffIterator role) --------------------
     @staticmethod
@@ -807,6 +892,8 @@ class DataStore:
                     st.stats = None
                     st.delta.drop_first(n_tables)
                     st.plan_cache.clear()
+                    st.pyramids.clear()
+                    st.epoch += 1
             return removed
 
     @staticmethod
@@ -1070,6 +1157,37 @@ class DataStore:
             while len(st.plan_cache) > self._PLAN_CACHE_MAX:
                 st.plan_cache.popitem(last=False)
 
+    def cache_report(self) -> dict:
+        """The buffer-pool / query-cache / pyramid gauge block
+        (docs/observability.md § Buffer pool & query cache): served by
+        ``GET /api/metrics`` and rendered by ``explain(analyze=True)``."""
+        pool = getattr(self.backend, "pool", None)
+        pyramid_bytes = {}
+        for name, st in list(self._types.items()):
+            with st.lock:
+                total = sum(
+                    p.nbytes for p, _stamp in st.pyramids.values()
+                    if p is not None
+                )
+            if total:
+                pyramid_bytes[name] = total
+        return {
+            "agg_cache": self.agg_cache.snapshot(),
+            "pyramid_bytes": pyramid_bytes,
+            "pool": pool.snapshot() if pool is not None else None,
+        }
+
+    def cache_prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
+        lines = self.agg_cache.prometheus_lines(prefix)
+        rep = self.cache_report()
+        lines.append(f"# TYPE {prefix}_pyramid_bytes gauge")
+        for t, b in sorted(rep["pyramid_bytes"].items()):
+            lines.append(f'{prefix}_pyramid_bytes{{type="{t}"}} {b}')
+        pool = getattr(self.backend, "pool", None)
+        if pool is not None:
+            lines += pool.prometheus_lines(prefix)
+        return lines
+
     def device_residency(self, type_name: str) -> dict:
         """HBM residency report for one type: per-index device bytes, total,
         and the backend's budget (the managed hot-tier view of SURVEY.md
@@ -1099,6 +1217,15 @@ class DataStore:
         with st.mutate_lock:
             with st.lock:
                 st.backend_state = None
+                # pyramids hold the device count mirrors (ledger group
+                # "pyramid"): they must not outlive an explicit eviction
+                st.pyramids.clear()
+        # explicit eviction is operator intent to free the HBM NOW: the
+        # pool's pins AND its donation stash for this type both drop (a
+        # stashed copy would silently keep the bytes resident)
+        pool = getattr(self.backend, "pool", None)
+        if pool is not None:
+            pool.purge(type_name)
         # the ledger entries unregister themselves when the dropped state
         # is collected; the spill report is explicit bookkeeping, clear it
         from geomesa_tpu.obs import devmon
@@ -1621,9 +1748,21 @@ class DataStore:
         from geomesa_tpu.store.backends import JOIN_BLOCK
 
         mesh = self.backend._get_mesh()
+        # the process-level pool budget covers agg staging too: every
+        # device allocation below asks for room first (evicting colder
+        # buffers), and a refusal raises ValueError → the host fold
+        padded_est = pad_rows(
+            max(len(main), data_shards(mesh)), data_shards(mesh), JOIN_BLOCK
+        )
+
+        def _room(nbytes: int, what: str) -> None:
+            if not self.backend.pool.ensure_room(int(nbytes)):
+                raise ValueError(f"device budget refuses agg {what}")
+
         gkey = ("gid", tuple(group_by or ()))
         cached = dev.agg_cache.get(gkey)
         if cached is None:
+            _room(padded_est * 4, "group-id staging")
             gid_orig, keys = self._agg_group_ids(main, group_by)
             if len(keys) > self._AGG_MAX_GROUPS:
                 raise ValueError("group cardinality beyond the device path")
@@ -1635,14 +1774,17 @@ class DataStore:
             dev.agg_cache[gkey] = cached
             # agg staging is device residency too: ledger it under the
             # "agg" column group (dies with `dev`, so unregistration rides
-            # the same finalizer as the spatial columns)
+            # the same finalizer as the spatial columns); the pool entry
+            # for (type, index) absorbs the bytes — same owner, same pins
             from geomesa_tpu.obs import devmon
+            from geomesa_tpu.store.bufferpool import register_residency
 
-            devmon.ledger().register(
-                type_name, index_name, devmon.GROUP_AGG,
+            register_residency(
+                self.backend.pool, type_name, index_name, devmon.GROUP_AGG,
                 int(cols["gid"].nbytes), owner=dev)
         rowid = dev.agg_cache.get(("rowid",))
         if rowid is None:
+            _room(padded_est * 4, "row-id staging")
             # original row index per lane: the device computes each group's
             # first MATCHING row (segment_min), which orders the output
             # groups exactly as the host fold's first-occurrence-over-
@@ -1654,9 +1796,10 @@ class DataStore:
             rowid = rcols["rowid"]
             dev.agg_cache[("rowid",)] = rowid
             from geomesa_tpu.obs import devmon
+            from geomesa_tpu.store.bufferpool import register_residency
 
-            devmon.ledger().register(
-                type_name, index_name, devmon.GROUP_AGG,
+            register_residency(
+                self.backend.pool, type_name, index_name, devmon.GROUP_AGG,
                 int(rowid.nbytes), owner=dev)
         # value columns cache PER COLUMN (one device + one host copy each,
         # however many SELECT-list combinations arrive); the per-request
@@ -1668,6 +1811,7 @@ class DataStore:
         for c in value_cols:
             got = dev.agg_cache.get(("val", c))
             if got is None:
+                _room(padded * 8, "value-column staging")
                 col = main.columns[c]
                 v = np.asarray(col.values, dtype=np.float64).copy()
                 if col.valid is not None:
@@ -1678,11 +1822,16 @@ class DataStore:
                 dev.agg_cache[("val", c)] = got
                 from geomesa_tpu.obs import devmon
                 from geomesa_tpu.obs.jaxmon import count_h2d
+                from geomesa_tpu.store.bufferpool import register_residency
 
-                count_h2d(pv)
-                devmon.ledger().register(
-                    type_name, index_name, devmon.GROUP_AGG,
-                    int(got[0].nbytes), owner=dev)
+                # pool warm-up staging, attributed to the POOL: the query
+                # that happened to trigger the miss must not absorb these
+                # bytes in its devprof h2d split (satellite red/green in
+                # tests/test_geoblocks.py)
+                count_h2d(pv, label="pool")
+                register_residency(
+                    self.backend.pool, type_name, index_name,
+                    devmon.GROUP_AGG, int(got[0].nbytes), owner=dev)
             per_dev.append(got[0])
             per_host.append(got[1])
         if per_dev:
@@ -1699,6 +1848,191 @@ class DataStore:
             else np.zeros((0, len(main)), dtype=np.float64)
         )
         return cached, rowid, dv, hv
+
+    # -- GeoBlocks helpers (ops/geoblocks.py) --------------------------------
+
+    @staticmethod
+    def _agg_cache_key(q, group_by, value_cols):
+        """Exact-repeat aggregation cache key: the literal predicate text
+        plus GROUP BY and value columns. None = uncacheable (hints, auths,
+        paging, or an un-serializable filter)."""
+        if (q.hints or q.auths is not None or q.limit is not None
+                or q.start_index is not None):
+            return None
+        base = DataStore._plan_cache_key(q)
+        if base is None:
+            return None
+        return (base[0], tuple(group_by or ()), tuple(value_cols or ()))
+
+    def _pyramid_extraction(self, st, q):
+        """The query's Extraction when it can ride the pyramid: a pure
+        bbox+time conjunction over the default geom/date fields with at
+        most ONE box and ONE interval (the interior/boundary decomposition
+        is per-rectangle). None = take the fused or host path."""
+        f = q.resolved_filter()
+        if (
+            not _pure_bbox_time(f, st.sft)
+            or q.hints
+            or q.auths is not None
+            or q.limit is not None
+            or q.start_index is not None
+        ):
+            return None
+        from geomesa_tpu.filter.bounds import extract as _extract
+
+        e = _extract(f, st.sft.geom_field, st.sft.dtg_field)
+        if e.boxes is not None and len(e.boxes) != 1:
+            return None
+        if e.intervals is not None and len(e.intervals) != 1:
+            return None
+        return e
+
+    def _pyramid(self, st: _TypeState, type_name: str, main, group_by,
+                 value_cols, main_epoch: int):
+        """The (group_by, value_cols) pre-aggregation pyramid for the
+        CURRENT main tier, built lazily once per rebuild epoch (an O(n)
+        host pass — one stable sort — amortized over every subsequent
+        aggregate). None when the shape can't ride: non-point geometries,
+        string/geometry value columns, group cardinality or byte cap
+        exceeded — the failure is remembered per epoch so it isn't
+        retried per query."""
+        pkey = (tuple(group_by or ()), tuple(value_cols))
+        with st.lock:
+            cached = st.pyramids.get(pkey)
+        if cached is not None:
+            pyr, stamp = cached
+            if stamp == main_epoch:
+                if pyr is not None and pyr.device.get("cnt") is not None:
+                    # a recover()-path backend.load parked this pyramid's
+                    # pool entry in the donation stash (release keeps
+                    # same-fingerprint entries) while the mirror kept
+                    # serving from st.pyramids — re-admit it: stash bytes
+                    # are reclaimable spare capacity, these are working
+                    # set and must stay budget-accounted and evictable
+                    pool = getattr(self.backend, "pool", None)
+                    if pool is not None:
+                        pool.take_donated(
+                            type_name, _pyramid_index_name(pkey),
+                            main_epoch,
+                            on_evict=_pyramid_evictor(st, pkey, pyr))
+                return pyr  # pyr may be None: the remembered failure
+        from geomesa_tpu.ops.geoblocks import AggPyramid
+
+        import time as _time
+
+        t0 = _time.perf_counter()
+        pyr = None
+        try:
+            col = main.geom_column() if st.sft.geom_field else None
+            if col is None or col.x is None:
+                raise ValueError("pyramid needs point geometries")
+            gid_orig, keys = self._agg_group_ids(main, group_by)
+            if len(keys) > self._AGG_MAX_GROUPS:
+                raise ValueError("group cardinality beyond the pyramid")
+            from geomesa_tpu.curve.binned_time import BinnedTime
+            from geomesa_tpu.curve.normalize import (
+                lat as norm_lat,
+                lon as norm_lon,
+            )
+            from geomesa_tpu.store.backends import REFINE_PRECISION
+
+            xi = norm_lon(REFINE_PRECISION).normalize(col.x).astype(np.int64)
+            yi = norm_lat(REFINE_PRECISION).normalize(col.y).astype(np.int64)
+            if st.sft.dtg_field:
+                bins, _offs = BinnedTime(
+                    st.sft.z3_interval).to_bin_and_offset(main.dtg_millis())
+            else:
+                bins = np.zeros(len(main), dtype=np.int64)
+            vals = []
+            for c in value_cols:
+                cv = main.columns[c]
+                v = np.asarray(cv.values, dtype=np.float64).copy()
+                if cv.valid is not None:
+                    v[~cv.valid] = np.nan
+                vals.append(v)
+            vmat = (np.stack(vals) if vals
+                    else np.zeros((0, len(main)), dtype=np.float64))
+            pyr = AggPyramid(xi, yi, bins, gid_orig, keys, vmat,
+                             epoch=main_epoch)
+        except (TypeError, ValueError):
+            pyr = None
+        if pyr is not None:
+            self._pyramid_mirror(st, type_name, pkey, pyr, main_epoch)
+            self.metrics.histogram("store.agg.pyramid_build_ms").update(
+                (_time.perf_counter() - t0) * 1000.0)
+        with st.lock:
+            if st.epoch == main_epoch:
+                st.pyramids[pkey] = (pyr, main_epoch)
+        return pyr
+
+    def _pyramid_mirror(self, st, type_name, pkey, pyr, main_epoch) -> None:
+        """Device mirror of the finest level's count partials — the layout
+        a fused device kernel reads — registered with the residency ledger
+        and pinned/evictable through the buffer pool. The staging bytes
+        are POOL traffic, not any query's (jaxmon ``label="pool"``).
+        Best-effort: an open device circuit just skips the mirror."""
+        pool = getattr(self.backend, "pool", None)
+        if pool is None or not self._device_available():
+            return
+        try:
+            import jax
+
+            from geomesa_tpu.obs import devmon
+            from geomesa_tpu.obs.jaxmon import count_h2d
+
+            host = pyr.levels[-1].cnt.astype(np.int32)
+            if not pool.ensure_room(int(host.nbytes)):
+                return  # pool budget refuses the mirror: host-only pyramid
+            count_h2d(host, label="pool")
+            dev = jax.device_put(host)
+            pyr.device["cnt"] = dev
+            devmon.ledger().register(
+                type_name, "geoblocks", devmon.GROUP_PYRAMID,
+                int(dev.nbytes), owner=pyr)
+            # pool key is per-PYRAMID (pkey = group_by + value_cols), not
+            # per-type: a second aggregation shape registering under the
+            # same key would REPLACE the first's entry (bufferpool
+            # register semantics) while st.pyramids still held its mirror
+            # resident — bytes in HBM invisible to the budget, evictor
+            # lost
+            pool.register(
+                type_name, _pyramid_index_name(pkey), devmon.GROUP_PYRAMID,
+                int(dev.nbytes), owner=pyr, fingerprint=main_epoch,
+                on_evict=_pyramid_evictor(st, pkey, pyr))
+        except Exception as e:  # noqa: BLE001 — mirror is optional
+            if not self._is_device_error(e):
+                raise
+            self._trip_device_circuit(e)
+
+    def _pyramid_answer(self, q, st, main, delta, pyr, e, value_cols,
+                        group_by):
+        """One exact grouped aggregate from the pyramid: interior partials
+        + boundary rows refined against the full f64 filter AST + the
+        delta fold — the same correction machinery the fused device path
+        feeds (:meth:`_assemble_agg`)."""
+        from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+        from geomesa_tpu.store.backends import REFINE_PRECISION, time_quads
+
+        box = None
+        if e.boxes is not None:
+            nlon = norm_lon(REFINE_PRECISION)
+            nlat = norm_lat(REFINE_PRECISION)
+            x1, y1, x2, y2 = e.boxes[0]
+            box = (int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+                   int(nlat.normalize(y1)), int(nlat.normalize(y2)))
+        window = None
+        quads = time_quads(st.sft, e.intervals)
+        if quads is not None:
+            blo, olo, bhi, ohi = (int(v) for v in quads[0])
+            if (blo, olo) > (bhi, ohi):  # clamped to unsatisfiable
+                return self._assemble_agg_empty(value_cols)
+            window = (blo, olo, bhi, ohi)
+        cnt, first, vcnt, vsum, vmin, vmax, brows = pyr.answer(box, window)
+        return self._assemble_agg(
+            q, main, delta, pyr.keys, value_cols,
+            cnt, first, vcnt, vsum, vmin, vmax,
+            np.sort(brows), pyr.gid, pyr.host_vals, group_by,
+        )
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
                        value_cols=(), now_ms: int | None = None):
@@ -1748,8 +2082,82 @@ class DataStore:
         cutoff_ms = None
         if ttl is not None:
             cutoff_ms = _ttl_cutoff_ms(ttl, now_ms)
+        # DATA EPOCH first, snapshot second (_TypeState.data_epoch): a
+        # mutation landing between the two leaves cache entries stamped
+        # with a pair that never recurs — a miss, never a stale hit
+        epoch = st.data_epoch()
         main, indices, backend_state, _stats, delta = st.snapshot()
         main_n = 0 if main is None else len(main)
+        if main_n == 0:
+            return out
+        for c in (group_by or []) + value_cols:
+            if c not in main.columns:
+                return out
+
+        # -- GeoBlocks tier (ops/geoblocks.py): the epoch-validated query
+        # cache serves exact repeats outright; eligible misses route to
+        # the pre-aggregation pyramid when the cost-table consult agrees.
+        # The oracle backend stays a pure brute-force referee, and TTL
+        # stores stay on the fused path (their answers are clock-relative).
+        import time as _time
+
+        from geomesa_tpu.obs import devmon as _devmon
+
+        devmon_costs = _devmon.costs()
+        cache_ctx = None
+        if isinstance(self.backend, TpuBackend) and ttl is None:
+            cache_ctx = {"epoch": epoch, "keys": {}}
+            for i, q in enumerate(qs):
+                key = self._agg_cache_key(q, group_by, value_cols)
+                if key is None:
+                    continue
+                cache_ctx["keys"][i] = key
+                hit = self.agg_cache.get(type_name, key, epoch)
+                if hit is not None:
+                    out[i] = hit
+                    self.metrics.counter("store.queries").inc()
+                    self.metrics.counter("store.agg.cache_hits").inc()
+                    self._audit(type_name, q, 0.0, 0.0,
+                                int(hit["count"].sum()))
+            from geomesa_tpu.ops import geoblocks as _geoblocks
+            from geomesa_tpu.planning.planner import choose_agg_path
+
+            if _geoblocks.enabled() and choose_agg_path(
+                    devmon_costs, type_name) == "pyramid":
+                pyr = None
+                for i, q in enumerate(qs):
+                    if out[i] is not None:
+                        continue
+                    e = self._pyramid_extraction(st, q)
+                    if e is None:
+                        continue
+                    if e.disjoint:
+                        out[i] = self._assemble_agg_empty(value_cols)
+                        continue
+                    if pyr is None:
+                        pyr = self._pyramid(st, type_name, main, group_by,
+                                            value_cols, epoch[0])
+                        if pyr is None:
+                            break  # shape can't ride: fused/host paths
+                    t0 = _time.perf_counter()
+                    res = self._pyramid_answer(
+                        q, st, main, delta, pyr, e, value_cols, group_by)
+                    if res is None:
+                        continue
+                    out[i] = res
+                    wall = (_time.perf_counter() - t0) * 1000.0
+                    total = int(res["count"].sum())
+                    self.metrics.counter("store.queries").inc()
+                    self.metrics.counter("store.agg.pyramid_served").inc()
+                    devmon_costs.observe(type_name, "gagg:pyramid",
+                                         wall_ms=wall, rows=total)
+                    self._audit(type_name, q, 0.0, wall, total)
+                    key = cache_ctx["keys"].get(i)
+                    if key is not None:
+                        self.agg_cache.put(type_name, key, epoch, res)
+            if all(o is not None for o in out):
+                return out
+
         dev = dev_name = None
         overlap = False
         if isinstance(self.backend, TpuBackend) and self._device_available():
@@ -1763,11 +2171,8 @@ class DataStore:
         perm = None
         if dev is not None and dev_name in (indices or {}):
             perm = indices[dev_name].perm
-        if dev is None or perm is None or main_n == 0:
+        if dev is None or perm is None:
             return out
-        for c in (group_by or []) + value_cols:
-            if c not in main.columns:
-                return out
         try:
             (dev_gid, gid_orig, keys), dev_rowid, dev_vals, host_vals = (
                 self._agg_residency(dev, main, perm, group_by, value_cols,
@@ -1777,13 +2182,22 @@ class DataStore:
         except (TypeError, ValueError):
             return out
         G = len(keys)
-        pending = self._batch_payloads(st, qs, overlap=overlap)
+        # only unanswered lanes pay extraction/packing (cache- and
+        # pyramid-served queries are done)
+        todo = [i for i in range(len(qs)) if out[i] is None]
+        pending = [
+            (todo[j], p, ok)
+            for j, p, ok in self._batch_payloads(
+                st, [qs[i] for i in todo], overlap=overlap)
+        ]
         live = [(i, p) for i, p, ok in pending if p is not None and ok]
         for i, p, ok in pending:
-            if p is None:  # provably-disjoint filter: zero rows, no groups
+            if p is None:
+                # provably-disjoint filter: zero rows, no groups
                 out[i] = self._assemble_agg_empty(value_cols)
         if not live:
             return out
+        t_scan0 = _time.perf_counter()
         import jax.numpy as jnp
 
         from geomesa_tpu.parallel.mesh import pad_query_axis
@@ -1813,14 +2227,18 @@ class DataStore:
                 ttl_args = (
                     jnp.asarray(np.array([cb, co], dtype=np.int32)),
                 )
-            res = step(
-                *dev.spatial_cols(), dev_gid, dev_rowid,
-                dev_vals, jnp.int32(main_n), jnp.asarray(boxes),
-                jnp.asarray(times), *ttl_args,
-            )
-            cnt, first, vcnt, vsum, vmin, vmax, epos, ehits = map(
-                np.asarray, res
-            )
+            # pool pin: the fused pass reads the resident columns — a
+            # pinned buffer is never an eviction victim mid-dispatch
+            self.backend.pool.touch(type_name, dev_name)
+            with self.backend.pool.pinned(type_name, dev_name):
+                res = step(
+                    *dev.spatial_cols(), dev_gid, dev_rowid,
+                    dev_vals, jnp.int32(main_n), jnp.asarray(boxes),
+                    jnp.asarray(times), *ttl_args,
+                )
+                cnt, first, vcnt, vsum, vmin, vmax, epos, ehits = map(
+                    np.asarray, res
+                )
         except Exception as e:  # noqa: BLE001 — failover to the host fold
             if not self._is_device_error(e):
                 raise
@@ -1828,9 +2246,17 @@ class DataStore:
             self.metrics.counter("store.query.device_failovers").inc()
             return out
         self._note_device_ok()
+        # cost decomposition: the shared device dispatch splits evenly
+        # across the batch; each lane's host assembly is timed on its own
+        # (a later lane's observation must not absorb earlier assemblies)
+        shared_ms = (_time.perf_counter() - t_scan0) * 1000.0 / len(live)
         for k, (i, _) in enumerate(live):
             if (ehits[k] > cap).any():
                 continue  # truncated correction lanes: host fold
+            tq0 = _time.perf_counter()
+            ecand = np.concatenate(
+                [epos[k, d, : ehits[k, d]] for d in range(epos.shape[1])]
+            ).astype(np.int64)
             out[i] = self._assemble_agg(
                 qs[i], main, delta, keys, value_cols,
                 cnt[k, :G].astype(np.int64).copy(),
@@ -1839,7 +2265,8 @@ class DataStore:
                 vsum[k, :, :G].copy(),
                 vmin[k, :, :G].copy(),
                 vmax[k, :, :G].copy(),
-                epos[k], ehits[k], perm, gid_orig, host_vals, group_by,
+                perm[ecand] if len(ecand) else ecand,
+                gid_orig, host_vals, group_by,
                 cutoff_ms,
             )
             self.metrics.counter("store.queries").inc()
@@ -1848,6 +2275,16 @@ class DataStore:
             self._audit(
                 type_name, qs[i], 0.0, 0.0, int(out[i]["count"].sum())
             )
+            if cache_ctx is not None:
+                key = cache_ctx["keys"].get(i)
+                if key is not None:
+                    self.agg_cache.put(
+                        type_name, key, cache_ctx["epoch"], out[i])
+                devmon_costs.observe(
+                    type_name, "gagg:scan",
+                    wall_ms=shared_ms
+                    + (_time.perf_counter() - tq0) * 1000.0,
+                    rows=int(out[i]["count"].sum()))
         return out
 
     @staticmethod
@@ -1864,14 +2301,15 @@ class DataStore:
         }
 
     def _assemble_agg(self, q, main, delta, keys, value_cols, cnt, first,
-                      vcnt, vsum, vmin, vmax, epos, ehits, perm, gid_orig,
+                      vcnt, vsum, vmin, vmax, cand_rows, gid_orig,
                       host_vals, group_by, cutoff_ms=None):
-        """Fold the host-side corrections into the device partials: edge
-        candidates re-tested exactly (added, never subtracted; ``cutoff_ms``
-        adds the exact-millisecond TTL check the device's quantized mask
-        cannot make) and pending delta rows (which may introduce new group
-        keys). Groups are ordered by their first MATCHING row index —
-        identical to the host fold's first-occurrence-over-filtered-rows
+        """Fold the host-side corrections into the pre-aggregated partials
+        (device interior OR pyramid interior — both feed this): boundary/
+        edge candidate rows re-tested exactly (added, never subtracted;
+        ``cutoff_ms`` adds the exact-millisecond TTL check a quantized
+        mask cannot make) and pending delta rows (which may introduce new
+        group keys). Groups are ordered by their first MATCHING row index
+        — identical to the host fold's first-occurrence-over-filtered-rows
         construction (delta rows order after the main tier at
         ``main_n + delta_row``, as in query())."""
         f = q.resolved_filter()
@@ -1889,11 +2327,8 @@ class DataStore:
                     vmin[v][g] = min(vmin[v][g], x)
                     vmax[v][g] = max(vmax[v][g], x)
 
-        cand = np.concatenate(
-            [epos[d, : ehits[d]] for d in range(epos.shape[0])]
-        ).astype(np.int64)
-        if len(cand):
-            rows = perm[cand]
+        if len(cand_rows):
+            rows = cand_rows
             if f is not None:
                 m = np.asarray(f.mask(main.take(rows)), dtype=bool)
                 rows = rows[m]
@@ -2136,6 +2571,18 @@ class DataStore:
         )
         self.slo.observe("store.query", ok=True, key=type_name,
                          latency_ms=plan_ms + scan_ms)
+        # SLO → buffer-pool feedback, sampled (1/32 queries): a type
+        # burning its error budget weighs heavier in eviction scoring, so
+        # its buffers stay resident while an idle type's go first
+        pool = getattr(self.backend, "pool", None)
+        if pool is not None:
+            self._slo_feed = getattr(self, "_slo_feed", 0) + 1
+            if self._slo_feed % 32 == 1:
+                pool.note_slo(
+                    type_name,
+                    self.slo.tracker("store.query", type_name)
+                    .budget_remaining(300.0),
+                )
         if self.audit_writer is None:
             return
         from geomesa_tpu.utils.audit import QueryEvent, now_millis
@@ -2210,6 +2657,7 @@ class DataStore:
                 "predicted": predicted,
                 "actual_ms": round(actual_ms, 3),
             },
+            cache=self.cache_report(),
         )
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------
@@ -2292,6 +2740,28 @@ class DataStore:
 
     def stats_cardinality(self, type_name: str, attr: str) -> float:
         return self._stats(type_name).cardinality(attr)
+
+
+def _pyramid_index_name(pkey) -> str:
+    """Pool entry name for one pyramid's device mirror — unique per
+    aggregation shape so two shapes on a type never share (and clobber)
+    one pool entry. Shows in the spill report as ``geoblocks[...]``."""
+    group_by, value_cols = pkey
+    return "geoblocks[%s;%s]" % (",".join(group_by), ",".join(value_cols))
+
+
+def _pyramid_evictor(st: "_TypeState", pkey, pyr):
+    """Pool-eviction callback for a pyramid's device mirror: drop the
+    whole pyramid from the type state (it rebuilds lazily on the next
+    eligible aggregate). Runs outside every pool lock."""
+
+    def _evict():
+        with st.lock:
+            cached = st.pyramids.get(pkey)
+            if cached is not None and cached[0] is pyr:
+                del st.pyramids[pkey]
+
+    return _evict
 
 
 def _take_combined(sft, main, main_n: int, delta_table, rows: np.ndarray) -> FeatureTable:

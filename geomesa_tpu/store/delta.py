@@ -30,10 +30,16 @@ class DeltaTier:
 
     tables: list[FeatureTable] = field(default_factory=list)
     rows: int = 0
+    # monotonic mutation counter: bumps on every append/clear/drop so
+    # epoch-validated caches (the GeoBlocks query cache, lambda-tier warm
+    # paths) can prove a cached answer predates no hot-tier change. Never
+    # decreases — a stale epoch stamp can only cause a cache MISS.
+    version: int = 0
 
     def append(self, table: FeatureTable) -> None:
         self.tables.append(table)
         self.rows += len(table)
+        self.version += 1
 
     def merged(self) -> FeatureTable | None:
         """One table view of the tier, or None. PURE — does not consolidate
@@ -47,6 +53,7 @@ class DeltaTier:
     def clear(self) -> None:
         self.tables = []
         self.rows = 0
+        self.version += 1
 
     def drop_first(self, n: int) -> None:
         """Remove the first ``n`` tables (the set a compaction consumed).
@@ -60,6 +67,7 @@ class DeltaTier:
         dropped = self.tables[:n]
         self.tables = self.tables[n:]
         self.rows -= sum(len(t) for t in dropped)
+        self.version += 1
 
     def should_compact(self, main_rows: int) -> bool:
         if self.rows == 0:

@@ -42,10 +42,22 @@ class FeatureCache:
         self.index = index if index is not None else SizeSeparatedBucketIndex()
         self._states: dict[str, FeatureState] = {}
         self._lock = threading.RLock()
+        # monotonic mutation counter (the lambda-tier analog of
+        # DeltaTier.version): every put/delete/clear/expire bumps it, so a
+        # warm-path cache layered over the hot tier (the GeoBlocks query
+        # cache validating a lambda-store aggregate) can prove its cached
+        # answer predates no hot mutation — a stale stamp can only MISS
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
 
     def put(self, fid: str, record: dict, ts: int) -> None:
         """Upsert: last write (by arrival order, like the reference) wins."""
         with self._lock:
+            self._version += 1
             old = self._states.get(fid)
             if old is not None and old.bounds is not None:
                 self.index.remove(old.bounds, fid)
@@ -58,6 +70,7 @@ class FeatureCache:
 
     def delete(self, fid: str) -> None:
         with self._lock:
+            self._version += 1
             old = self._states.pop(fid, None)
             if old is not None and old.bounds is not None:
                 self.index.remove(old.bounds, fid)
@@ -75,6 +88,7 @@ class FeatureCache:
 
     def clear(self) -> None:
         with self._lock:
+            self._version += 1
             self._states.clear()
             self.index.clear()
 

@@ -83,6 +83,19 @@ class LambdaDataStore:
     def list_schemas(self) -> list[str]:
         return self.cold.list_schemas()
 
+    def data_epoch(self, type_name: str) -> tuple:
+        """The lambda-tier data epoch: the cold store's (rebuild epoch,
+        delta version) pair plus the hot cache's mutation version
+        (``FeatureCache.version``). Monotone per component, so any cache
+        layered over the merged view (the GeoBlocks warm path) can stamp
+        entries with it — a hot put/delete/expiry or a cold mutation each
+        advance it, and a stale stamp can only MISS."""
+        st = self.cold._state(type_name)
+        hot = 0
+        if type_name in self.stream.list_schemas():
+            hot = self.stream.cache(type_name).version
+        return (*st.data_epoch(), hot)
+
     def write(self, type_name: str, fid: str, record: dict, ts: int | None = None):
         self._ensure_hot(type_name)
         with self._persist_lock:

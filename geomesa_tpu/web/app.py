@@ -961,6 +961,11 @@ class GeoMesaApp:
                 text += slo_engine.prometheus_text()
             # device telemetry: labeled HBM residency/budget/spill gauges
             text += devmon.prometheus_text()
+            # buffer pool + GeoBlocks query cache: geomesa_cache_{hits,
+            # misses,evictions}, geomesa_pool_* and pyramid-bytes gauges
+            cache_lines = getattr(self.store, "cache_prometheus_lines", None)
+            if cache_lines is not None:
+                text += "\n".join(cache_lines()) + "\n"
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -968,6 +973,10 @@ class GeoMesaApp:
         from geomesa_tpu.obs import devmon
 
         out["device"] = devmon.device_report()
+        # buffer-pool / query-cache / pyramid gauge block
+        cache_report = getattr(self.store, "cache_report", None)
+        if cache_report is not None:
+            out["cache"] = cache_report()
         if slo_engine is not None:
             slo_snap = slo_engine.snapshot()
             if slo_snap:

@@ -29,7 +29,11 @@ export GEOMESA_BENCH_N="${GEOMESA_BENCH_N:-20000}"
 export GEOMESA_BENCH_Q="${GEOMESA_BENCH_Q:-8}"
 export GEOMESA_BENCH_ITERS="${GEOMESA_BENCH_ITERS:-4}"
 export GEOMESA_BENCH_REGRESS_K="${GEOMESA_BENCH_REGRESS_K:-2}"
-export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2}"
+# config 9 rides the gate as the grouped-aggregation PARITY leg: its
+# pyramid-vs-f64-fold, warm-cache-byte-identity, and fused-step parity
+# flags all gate (a parity loss on a fresh run always fails, regardless
+# of speed) — the 0.16x path of BENCH_r05 can never silently regress again
+export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,9}"
 
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT

@@ -40,9 +40,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs_federation.py -q
 # docs/observability.md § Device telemetry & cost profiles.
 JAX_PLATFORMS=cpu python -m pytest tests/test_devmon.py -q
 
+# buffer-pool + GeoBlocks gate (ISSUE 7): SLO-weighted eviction under the
+# GEOMESA_TPU_HBM budget with ledger agreement and pin protection, exact
+# pyramid-vs-scan parity, the write→aggregate epoch red/green, and the
+# pool-attributed h2d split. See docs/observability.md § Buffer pool &
+# query cache.
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_bufferpool.py tests/test_geoblocks.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
-# committed-baseline loader leg — see scripts/bench_gate.sh.
+# committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
+# rides it as the grouped-aggregation parity leg.
 scripts/bench_gate.sh
 
 # tpurace dynamic prong: the Eraser-style lock-order sanitizer wraps every
@@ -53,7 +62,8 @@ scripts/bench_gate.sh
 # observed lock-order graph is cycle-free.
 GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
-    tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py -q
+    tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
+    tests/test_geoblocks.py tests/test_bufferpool.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

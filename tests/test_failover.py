@@ -139,7 +139,7 @@ class TestLoadFailover:
     def test_write_survives_device_load_failure(self, monkeypatch):
         ds = _make_store(n=100)
 
-        def boom(self, sft, table, indices):
+        def boom(self, sft, table, indices, fingerprint=None):
             raise RuntimeError("backend 'axon' unavailable")
 
         monkeypatch.setattr(TpuBackend, "load", boom)
@@ -159,7 +159,7 @@ class TestLoadFailover:
         ds = _make_store(n=100)
         orig = TpuBackend.load
 
-        def boom(self, sft, table, indices):
+        def boom(self, sft, table, indices, fingerprint=None):
             raise RuntimeError("backend 'axon' unavailable")
 
         monkeypatch.setattr(TpuBackend, "load", boom)

@@ -202,6 +202,32 @@ class TestQueryAndStats:
         finally:
             devmon.install(*prev)
 
+    def test_metrics_cache_gauges(self, app):
+        """ISSUE 7 satellite: geomesa_cache_{hits,misses,evictions},
+        pool gauges, and pyramid-bytes ride the Prometheus scrape, and
+        the JSON snapshot carries the cache report block."""
+        _ingest(app, n=1500)
+        # drive one grouped aggregate so the cache/pyramid have traffic
+        app.store.aggregate_many(
+            "pts", ["BBOX(geom, 0, 0, 40, 40)"], group_by=None,
+            value_cols=[])
+        app.store.aggregate_many(
+            "pts", ["BBOX(geom, 0, 0, 40, 40)"], group_by=None,
+            value_cols=[])
+        status, _, data = call(
+            app, "GET", "/api/metrics", "format=prometheus")
+        assert status == 200
+        text = data.decode()
+        for name in ("geomesa_cache_hits", "geomesa_cache_misses",
+                     "geomesa_cache_evictions", "geomesa_pool_hits",
+                     "geomesa_pool_resident_bytes"):
+            assert name in text
+        status, out = jcall(app, "GET", "/api/metrics")
+        assert status == 200
+        cache = out["cache"]
+        assert cache["agg_cache"]["hits"] >= 1
+        assert "pyramid_bytes" in cache and "pool" in cache
+
     def test_obs_costs_endpoint(self, app):
         from geomesa_tpu.obs import devmon
 
