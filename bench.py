@@ -1202,19 +1202,22 @@ def bench_resident():
 
 
 # ---------------------------------------------------------------------------
-# Config 8: out-of-core 1B streaming scan — the north-star total streamed
-# HOST → HBM through one chip as resident-share chunks with double-buffered
-# transfers (the FileSystemThreadedReader role, SURVEY.md §2.12; VERDICT r2
-# item 3: real host-resident data, transfer measured, not on-device
-# generation). Each chunk is scanned against all Q queries by the fused
-# count step, and one query's matching rows are RETRIEVED (not just
-# counted) per chunk; a plain-XLA mask-sum referee checks every chunk.
+# Config 8: out-of-core 1B streaming scan — the PRODUCT path. The north-star
+# total streams HOST → HBM through one chip via the subscription-matrix
+# engine (stream/matrix.py + stream/pipeline.py): Q standing queries
+# registered on a SubscriptionMatrix, chunks fed through the
+# DeviceStreamScanner's bounded queue (reader-thread backpressure), the
+# scanner double-buffering device_put behind the fused count+gather scan
+# and delivering per-subscription hit batches. A plain-XLA mask-sum
+# referee (independent of the fused kernel) checks every chunk's counts,
+# and a small journal-tier leg proves the same deliveries arrive through
+# StreamingDataStore.subscribe_query end-to-end.
 # ---------------------------------------------------------------------------
 
 @lru_cache(maxsize=None)
-def _stream_1b_steps():
-    """Referee + retrieval steps for the 1B streaming sweep, built once so
-    repeated sweeps reuse the compiled executables (tpulint J003)."""
+def _stream_1b_referee():
+    """Straight-XLA referee for the streaming sweep, built once so repeated
+    sweeps reuse the compiled executable (tpulint J003)."""
     import jax
     import jax.numpy as jnp
 
@@ -1228,17 +1231,7 @@ def _stream_1b_steps():
 
         return jax.lax.map(one, boxes)
 
-    @jax.jit
-    def retrieve_rows(x, y, b):
-        # row RETRIEVAL for one query: top-N matching positions per chunk
-        # (fixed lane count keeps shapes static; N_RET rows come back to
-        # the host as the result set)
-        m = (x >= b[0, 0]) & (x <= b[0, 1]) & (y >= b[0, 2]) & (y <= b[0, 3])
-        score = jnp.where(m, jnp.arange(m.shape[0]), -1)
-        topv, topi = jax.lax.top_k(score, 4096)
-        return topi, (topv >= 0).sum(dtype=jnp.int32), m.sum(dtype=jnp.int32)
-
-    return referee, retrieve_rows
+    return referee
 
 
 def bench_stream_1b():
@@ -1246,8 +1239,10 @@ def bench_stream_1b():
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as _P
 
+    from geomesa_tpu.obs import jaxmon
     from geomesa_tpu.parallel.mesh import DATA_AXIS, data_shards, make_mesh
-    from geomesa_tpu.parallel.query import make_batched_count_step
+    from geomesa_tpu.stream.matrix import SubscriptionMatrix
+    from geomesa_tpu.stream.pipeline import DeviceStreamScanner
 
     on_accel = jax.default_backend() not in ("cpu",)
     mesh = make_mesh()
@@ -1258,7 +1253,10 @@ def bench_stream_1b():
         # fallback hygiene (VERDICT r3 weak #3): the global cpu-fallback N
         # must not inflate the out-of-core sweep — cap so it runs in seconds
         N = min(N, 500_000)
-    N -= N % shards
+    # scanner chunk unit: shard- and lane-aligned, floored so a tiny-N
+    # rehearsal on a many-shard mesh can't round N to zero
+    unit = shards * 128
+    N = max(N - N % unit, unit)
     total_target = int(
         os.environ.get(
             "GEOMESA_BENCH_TOTAL", 1_000_000_000 if on_accel else N * 4
@@ -1279,53 +1277,63 @@ def bench_stream_1b():
         bins = np.full(N, c, dtype=np.int32)
         return x, y, bins, offs
 
-    def put(cols):
-        return tuple(jax.device_put(a, sh) for a in cols)
-
-    # Q spatial boxes (int domain) × full-span time windows
+    # Q standing queries: spatial boxes (int domain) × full-span time
+    # windows, registered on the PRODUCT subscription matrix
     nlon, nlat = norm_lon(31), norm_lat(31)
     boxes_f64, _ = make_queries(Q)
     qboxes = _pack_query_boxes(boxes_f64, nlon, nlat)
-    qtimes = np.stack(
-        [pack_times(np.array([[0, 0, chunks, max_off]], np.int32), slots=1)] * Q
+    full_window = np.array([[0, 0, chunks, max_off]], np.int32)
+    matrix = SubscriptionMatrix(
+        mesh=mesh, box_slots=1, time_slots=1, topk=128
     )
-    dev_boxes = jnp.asarray(qboxes)
-    dev_times = jnp.asarray(qtimes)
-    step = make_batched_count_step(mesh)
-    referee, retrieve_rows = _stream_1b_steps()
+    per_chunk: dict[int, dict] = {}  # seq → {qi: count} (scan-thread only)
+    positions_delivered = [0]
 
-    # warm compiles on chunk 0 BEFORE anything is timed
-    warm = put(host_chunk(0))
-    jax.block_until_ready(
-        step(*warm, jnp.int32(N), dev_boxes, dev_times)
-    )
-    jax.block_until_ready(referee(warm[0], warm[1], warm[2], warm[3], dev_boxes))
-    jax.block_until_ready(retrieve_rows(warm[0], warm[1], dev_boxes[0]))
-    del warm
+    def _mk_cb(qi):
+        def cb(batch):
+            per_chunk.setdefault(batch.chunk, {})[qi] = batch.count
+            positions_delivered[0] += len(batch.positions)
 
-    # -- phase A (untimed): referee-verified correctness pass, every chunk
-    totals = np.zeros(Q, dtype=np.int64)
-    parity_ok = True
+        return cb
+
+    sids = [
+        matrix.subscribe_packed(qboxes[i], full_window, _mk_cb(i))
+        for i in range(Q)
+    ]
+    referee = _stream_1b_referee()
+
+    # warm compiles with the EXACT production shapes (sharded N-row chunk,
+    # current capacity bucket) BEFORE anything is timed
+    warm_cols = host_chunk(0)
+    warm_dev = tuple(jax.device_put(a, sh) for a in warm_cols)
+    snap0 = matrix.snapshot()
+    jax.block_until_ready(referee(*warm_dev, snap0.boxes_dev))
+    matrix.scan_chunk(snap0, *warm_dev, jnp.int32(N))
+
+    # -- phase A (untimed): independent straight-XLA referee, every chunk
+    expected: list[np.ndarray] = []
     for c in range(chunks):
-        x, y, bins, offs = put(host_chunk(c))
-        counts = np.asarray(
-            step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
+        dev = (
+            warm_dev if c == 0
+            else tuple(jax.device_put(a, sh) for a in host_chunk(c))
         )
-        totals += counts.astype(np.int64)
-        ref = np.asarray(referee(x, y, bins, offs, dev_boxes))
-        if not np.array_equal(ref, counts.astype(np.int64)):
-            parity_ok = False
+        expected.append(np.asarray(referee(*dev, snap0.boxes_dev)))
+    del warm_dev
 
-    # -- phase B (timed): the streaming pipeline. A READER THREAD (the
-    # FileSystemThreadedReader role) materializes chunks into a bounded
-    # queue while the main loop transfers + scans + retrieves — the wall
-    # clock covers EVERYTHING on the critical path (transfers are never
-    # subtracted; host reads overlap via the thread, their busy time is
-    # reported for the overlap story).
-    import queue as _queue
+    # -- phase B (timed): the PRODUCT pipeline. A reader thread (the
+    # FileSystemThreadedReader role) materializes host chunks and pushes
+    # them through the scanner's BOUNDED queue (blocking submit = the
+    # backpressure contract); the scanner thread double-buffers device_put
+    # behind the fused count+gather scan and delivers per-subscription hit
+    # batches. Wall clock covers everything from first submit to the last
+    # delivery (transfers never subtracted).
     import threading as _threading
 
-    qchunks: _queue.Queue = _queue.Queue(maxsize=2)
+    scanner = DeviceStreamScanner(
+        matrix, chunk_rows=N, max_pending_chunks=2, topic="bench8",
+        keep_tags=False,
+    )
+    assert scanner.chunk_rows == N
     gen_busy = {"s": 0.0}
 
     def _producer():
@@ -1333,36 +1341,51 @@ def bench_stream_1b():
             t0 = time.perf_counter()
             cols = host_chunk(c)
             gen_busy["s"] += time.perf_counter() - t0
-            qchunks.put(cols)
+            scanner.submit_chunk(*cols, block=True)
 
-    rows_retrieved = 0
-    bytes_h2d = 0
-    transfer_wait_s = 0.0
+    census0 = jaxmon.jit_report()
     prod = _threading.Thread(target=_producer, daemon=True)
-
     t_pipe = time.perf_counter()
     prod.start()
-    cur = put(qchunks.get())  # async H2D; overlaps the next get/scan
-    bytes_h2d += 16 * N
-    for c in range(chunks):
-        nxt = None
-        if c + 1 < chunks:
-            nxt = put(qchunks.get())  # async device_put behind the scan
-            bytes_h2d += 16 * N
-        x, y, bins, offs = cur
-        counts = np.asarray(
-            step(x, y, bins, offs, jnp.int32(N), dev_boxes, dev_times)
-        )
-        # row retrieval for query 0 (the ArrowScan-shape deliverable)
-        topi, nret, _m = retrieve_rows(x, y, dev_boxes[0])
-        rows_retrieved += len(np.asarray(topi)[: int(nret)])
-        if nxt is not None:
-            t0 = time.perf_counter()
-            jax.block_until_ready(nxt)  # ALL four columns, not just x
-            transfer_wait_s += time.perf_counter() - t0
-        cur = nxt
+    prod.join()
+    drained = scanner.drain(timeout_s=3600.0)
     pipeline_s = time.perf_counter() - t_pipe
-    prod.join(timeout=10)
+    census1 = jaxmon.jit_report()
+    stats = scanner.stats()
+    # freeze alongside the rest of the pipeline accounting window: the
+    # untimed churn leg below re-submits chunk 0 and would inflate it
+    positions_in_window = positions_delivered[0]
+
+    # parity: every chunk's delivered counts == the referee's (a missing
+    # delivery means count 0)
+    parity_ok = drained
+    for c in range(chunks):
+        got = per_chunk.get(c, {})
+        for qi in range(Q):
+            if int(got.get(qi, 0)) != int(expected[c][qi]):
+                parity_ok = False
+    totals = np.sum(expected, axis=0, dtype=np.int64)
+
+    # -- steady-path churn (untimed): subscription remove + re-add inside
+    # the capacity bucket, one more chunk through the pipeline — must not
+    # trigger a single jit recompile (the J003 contract the matrix's
+    # power-of-two buckets exist for)
+    cap_before = matrix.capacity()
+    matrix.unsubscribe(sids[-1])
+    matrix.subscribe_packed(qboxes[Q - 1], full_window, lambda b: None)
+    churn0 = jaxmon.jit_report()
+    scanner.submit_chunk(*host_chunk(0), block=True)
+    churn_ok = scanner.drain(timeout_s=600.0)
+    churn1 = jaxmon.jit_report()
+    churn_recompiles = (
+        churn1.get("recompiles", 0) - churn0.get("recompiles", 0)
+    )
+    scanner.close()
+
+    # -- journal-tier leg (untimed): the same deliveries through
+    # StreamingDataStore.subscribe_query over a JournalBus — the bus-fed
+    # product path end-to-end (decode → hub → scanner → HitBatch)
+    journal_deliveries, journal_parity = _stream_journal_leg()
 
     total_rows = N * chunks
     rows_per_s = total_rows / pipeline_s
@@ -1385,40 +1408,97 @@ def bench_stream_1b():
         _ = m.sum()
     cpu_rowq_per_s = n_ref * Q / (time.perf_counter() - s)
 
+    transfer_wait_s = stats["transfer_wait_s"]
     return {
         "metric": "stream_1b_scan_throughput",
         "value": round(rows_per_s / 1e9, 4),
         "unit": UNITS["8"],
-        "unit_note": "each row matched against all Q queries",
+        "unit_note": "each row matched against all Q standing queries",
         "vs_baseline": round(tpu_rowq_per_s / cpu_rowq_per_s, 1),
         "detail": {
             "total_rows": total_rows,
             "chunk_rows": N,
             "chunks": chunks,
             "n_queries": Q,
+            "matrix_capacity": cap_before,
             "devices": jax.device_count(),
             "pipeline_seconds_end_to_end": round(pipeline_s, 2),
             "reader_thread_busy_seconds": round(gen_busy["s"], 2),
             "transfer_wait_seconds": round(transfer_wait_s, 3),
-            "host_to_device_bytes": bytes_h2d,
+            "transfer_wait_fraction_of_wall": round(
+                transfer_wait_s / pipeline_s, 4
+            ),
+            "host_to_device_bytes": stats["h2d_bytes"],
             "h2d_gbytes_per_s_effective": round(
-                bytes_h2d / pipeline_s / 1e9, 2
+                stats["h2d_bytes"] / pipeline_s / 1e9, 2
             ),
             "overlap_efficiency": round(
                 1.0 - transfer_wait_s / pipeline_s, 3
             ),
-            "rows_retrieved_query0": rows_retrieved,
+            "positions_delivered": positions_in_window,
             "referee_parity_all_chunks": parity_ok,
+            "journal_leg_deliveries": journal_deliveries,
+            "journal_leg_parity": journal_parity,
             "rows_matched_total": int(totals.sum()),
             "row_queries_per_s": int(tpu_rowq_per_s),
             "cpu_row_queries_per_s": int(cpu_rowq_per_s),
-            "note": "reader thread materializes host chunks into a bounded "
-                    "queue; main loop double-buffers device_put + fused "
-                    "scan + row retrieval; wall clock includes every "
-                    "transfer (nothing subtracted); parity referee ran as "
-                    "a separate untimed pass over every chunk",
+            "steady_recompiles": (
+                census1.get("recompiles", 0) - census0.get("recompiles", 0)
+            ),
+            "churn_recompiles": churn_recompiles,
+            "churn_chunk_scanned": churn_ok,
+            "note": "PRODUCT path: reader thread submits host chunks "
+                    "through DeviceStreamScanner's bounded queue; the "
+                    "scanner double-buffers device_put behind the fused "
+                    "count+gather SubscriptionMatrix scan and delivers "
+                    "per-subscription HitBatches; wall clock includes "
+                    "every transfer (nothing subtracted); straight-XLA "
+                    "referee ran as a separate untimed pass over every "
+                    "chunk; churn leg = unsubscribe/resubscribe inside "
+                    "the bucket, zero recompiles required",
         },
     }
+
+
+def _stream_journal_leg(rows: int = 512):
+    """Small untimed end-to-end leg: standing query over a real JournalBus
+    through ``StreamingDataStore.subscribe_query`` — proves the bus-fed
+    decode → hub → scanner path delivers exactly the rows the store's own
+    query path matches. Returns ``(deliveries, parity)``."""
+    import tempfile
+
+    from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.stream.datastore import StreamingDataStore
+    from geomesa_tpu.stream.journal import JournalBus
+
+    with tempfile.TemporaryDirectory(prefix="geomesa-bench8-") as root:
+        ds = StreamingDataStore(bus=JournalBus(root, partitions=2))
+        try:
+            ds.create_schema("bench8", "dtg:Date,*geom:Point")
+            hits = []
+            ds.subscribe_query(
+                "bench8", "BBOX(geom, -45, -45, 45, 45)", hits.append,
+                chunk_rows=256, flush_interval_s=0.01,
+            )
+            rng = np.random.default_rng(42)
+            lon = rng.uniform(-170, 170, rows)
+            lat = rng.uniform(-80, 80, rows)
+            for i in range(rows):
+                ds.put(
+                    "bench8", f"f{i}",
+                    {"dtg": T0 + i, "geom": Point(lon[i], lat[i])},
+                    ts=T0 + i,
+                )
+            # END-TO-END drain: tail_lag (async tailer) → consumer → hub.
+            # hub.drain alone races records still pending in the tailer —
+            # an intermittent parity=False on a slow tick, and config 8
+            # gates CI
+            ok = ds.drain("bench8", timeout_s=60.0)
+            delivered = sum(b.count for b in hits)
+            want = ds.query("bench8", "BBOX(geom, -45, -45, 45, 45)").count
+            return delivered, bool(ok and delivered == want)
+        finally:
+            ds.close()
 
 
 # ---------------------------------------------------------------------------

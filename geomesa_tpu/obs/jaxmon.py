@@ -130,6 +130,13 @@ def _np_bytes(arrays) -> int:
 _h2d_pending = threading.local()
 _H2D_PENDING_CAP = 256
 
+# count_h2d labels whose bytes belong to a SHARED subsystem, not to the
+# query that happened to be live when they staged: the buffer pool's
+# warm-up staging (ISSUE 7) and the stream scanner's chunk pipeline —
+# excluded from the devprof h2d split so a concurrent profiled query's
+# attribution stays truthful (pinned in tests/test_stream_matrix.py).
+_DEVPROF_EXTERNAL = frozenset({"pool", "stream"})
+
 
 def _note_pending_h2d(arrays) -> None:
     refs = getattr(_h2d_pending, "refs", None)
@@ -167,11 +174,13 @@ def count_h2d(*arrays, label: str | None = None) -> int:
     ``observed()`` dispatch is not double-counted. Returns bytes counted.
 
     ``label``: attribution bucket, additionally counted under
-    ``jax.transfer.h2d_bytes.<label>``. Bytes a buffer-pool warm-up/miss
-    stages (``label="pool"``) belong to the POOL, not to the query that
-    happened to trigger the warm-up: they are excluded from the live
-    devprof profile, so per-query h2d splits stay truthful. Unlabeled
-    (query-side) staging IS attributed to the profiled query."""
+    ``jax.transfer.h2d_bytes.<label>``. Bytes staged by a SHARED subsystem
+    — a buffer-pool warm-up/miss (``label="pool"``) or the stream
+    scanner's chunk pipeline (``label="stream"``) — belong to that
+    subsystem, not to the query that happened to be live: they are
+    excluded from the live devprof profile, so per-query h2d splits stay
+    truthful. Unlabeled (query-side) staging IS attributed to the
+    profiled query."""
     total = _np_bytes(arrays)
     if total:
         reg = registry()
@@ -179,7 +188,7 @@ def count_h2d(*arrays, label: str | None = None) -> int:
         if label:
             reg.counter(f"jax.transfer.h2d_bytes.{label}").inc(total)
         _note_pending_h2d(arrays)
-        if label != "pool" and _devmon.PROFILING:
+        if label not in _DEVPROF_EXTERNAL and _devmon.PROFILING:
             prof = _devmon.current_profile()
             if prof is not None:
                 prof.note_h2d(total)

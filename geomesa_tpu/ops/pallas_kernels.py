@@ -43,15 +43,14 @@ LANES = 128
 # ---------------------------------------------------------------------------
 
 
-def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
-                  out_ref, acc_ref, *, block_rows: int):
-    """One grid step: score a (block_rows, 128) row tile against all queries."""
-    i = pl.program_id(0)
+def _tile_mask(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
+               i, block_rows: int):
+    """Score one (block_rows, 128) row tile against all queries.
 
-    @pl.when(i == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
+    Returns ``(mask (Q, BR, L) bool, gpos (BR, L) int32 GLOBAL row
+    positions)`` — the predicate evaluation shared by the count-only and
+    the fused count+hits kernels (one definition; the two outputs must
+    never drift)."""
     x = x_ref[:][None]  # (1, BR, L)
     y = y_ref[:][None]
     bb = b_ref[:][None]
@@ -90,7 +89,21 @@ def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
         before = (bb < bhi) | ((bb == bhi) & (oo <= ohi))
         in_time |= after & before
 
-    m = (in_box & in_time & valid).astype(jnp.int32)
+    return in_box & in_time & valid, base + lpos
+
+
+def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
+                  out_ref, acc_ref, *, block_rows: int):
+    """One grid step: score a (block_rows, 128) row tile against all queries."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    mask, _ = _tile_mask(nfo_ref, boxes_ref, times_ref, x_ref, y_ref,
+                         b_ref, o_ref, i, block_rows)
+    m = mask.astype(jnp.int32)
     # reduce over sublanes only — a (Q, LANES) per-lane partial keeps every
     # vector 2D (Mosaic layout inference rejects narrow reshapes); the final
     # 128-lane fold happens host-side. explicit dtype: global x64 mode must
@@ -100,6 +113,37 @@ def _count_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref, o_ref,
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
         out_ref[:] = acc_ref[:]
+
+
+def _count_hits_kernel(nfo_ref, boxes_ref, times_ref, x_ref, y_ref, b_ref,
+                       o_ref, out_cnt_ref, out_pos_ref, acc_cnt_ref,
+                       acc_pos_ref, *, block_rows: int):
+    """Fused count + hit-position grid step (the subscription-matrix scan):
+    per-lane count partials AND the most recent matched GLOBAL row position
+    per lane (-1 = no match in that lane), accumulated across the grid in
+    VMEM — one HBM pass serves both outputs."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_cnt_ref[:] = jnp.zeros_like(acc_cnt_ref)
+        acc_pos_ref[:] = jnp.full_like(acc_pos_ref, -1)
+
+    mask, gpos = _tile_mask(nfo_ref, boxes_ref, times_ref, x_ref, y_ref,
+                            b_ref, o_ref, i, block_rows)
+    acc_cnt_ref[:] = acc_cnt_ref[:] + jnp.sum(
+        mask.astype(jnp.int32), axis=1, dtype=jnp.int32
+    )
+    # per-lane max over sublanes: rows are laid out row-major, so a larger
+    # gpos IS a more recent row — the lane scoreboard keeps the newest
+    # match per 128-row residue class without any sort/scatter
+    posq = jnp.where(mask, gpos[None], -1)
+    acc_pos_ref[:] = jnp.maximum(acc_pos_ref[:], jnp.max(posq, axis=1))
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_cnt_ref[:] = acc_cnt_ref[:]
+        out_pos_ref[:] = acc_pos_ref[:]
 
 
 @partial(jax.jit, static_argnames=("interpret", "block_rows"))
@@ -167,6 +211,77 @@ def batched_count(x, y, bins, offs, base, true_n, boxes, times, *,
             interpret=interpret,
         )(nfo, boxes2, times2, x2, y2, b2, o2)
     return counts.sum(axis=1, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("interpret", "block_rows"))
+def batched_count_hits(x, y, bins, offs, base, true_n, boxes, times, *,
+                       interpret: bool = False, block_rows: int = 32):
+    """Q bbox+time count queries PLUS hit positions, one HBM pass.
+
+    The subscription-matrix scan variant of :func:`batched_count`: same
+    inputs and predicate semantics, but each grid step also keeps, per
+    query and per 128-row lane, the most recent matched GLOBAL row
+    position in a VMEM scoreboard — so counting and row retrieval for all
+    Q standing queries cost exactly one pass over the chunk.
+
+    Returns:
+      counts: (Q,) int32 per-query match counts for this slice.
+      lane_pos: (Q, 128) int32 — newest matched global row position per
+        lane (-1 = that lane never matched). Callers ``top_k`` the lanes
+        for the newest-match sample; counts stay exact regardless.
+    """
+    n = x.shape[0]
+    q = boxes.shape[0]
+    tile = block_rows * LANES
+    padded = ((n + tile - 1) // tile) * tile
+    if padded != n:
+        pad = padded - n
+        x = jnp.pad(x, (0, pad))
+        y = jnp.pad(y, (0, pad))
+        bins = jnp.pad(bins, (0, pad))
+        offs = jnp.pad(offs, (0, pad))
+    shape2 = (padded // LANES, LANES)
+    x2 = x.reshape(shape2)
+    y2 = y.reshape(shape2)
+    b2 = bins.reshape(shape2)
+    o2 = offs.reshape(shape2)
+
+    nfo = jnp.stack([jnp.asarray(base, jnp.int32),
+                     jnp.asarray(true_n, jnp.int32),
+                     jnp.asarray(n, jnp.int32)]).reshape(1, 3)
+    nb4 = boxes.shape[1] * 4
+    nt4 = times.shape[1] * 4
+    boxes2 = boxes.reshape(q, nb4)
+    times2 = times.reshape(q, nt4)
+
+    grid = padded // tile
+    col_spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((q, LANES), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    with enable_x64(False):
+        counts, lane_pos = pl.pallas_call(
+            partial(_count_hits_kernel, block_rows=block_rows),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((1, 3), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((q, nb4), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((q, nt4), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                col_spec, col_spec, col_spec, col_spec,
+            ],
+            out_specs=[out_spec, out_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((q, LANES), jnp.int32),
+                jax.ShapeDtypeStruct((q, LANES), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((q, LANES), jnp.int32),
+                            pltpu.VMEM((q, LANES), jnp.int32)],
+            interpret=interpret,
+        )(nfo, boxes2, times2, x2, y2, b2, o2)
+    return counts.sum(axis=1, dtype=jnp.int32), lane_pos
 
 
 # ---------------------------------------------------------------------------

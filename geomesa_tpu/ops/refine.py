@@ -58,6 +58,19 @@ def pack_boxes(
     return np.vstack([b, pad])
 
 
+def unsat_rows(box_slots: int, time_slots: int) -> tuple[np.ndarray, np.ndarray]:
+    """The fully-unsatisfiable payload pair: every box slot empty (lo > hi),
+    every time window ending before it starts — a query that matches
+    NOTHING while keeping the packed shapes. The one definition of the
+    sentinel, shared by the planner's provably-disjoint branch and the
+    subscription matrix's masked slots (if the encoding ever changes, both
+    must move together or masked slots start matching rows)."""
+    return (
+        pack_boxes(_BOX_PAD[None], slots=box_slots),
+        pack_times(_TIME_PAD[None], slots=time_slots),
+    )
+
+
 def pack_times(times_i32: np.ndarray | None, slots: int = MAX_TIMES) -> np.ndarray:
     """(T, 4) [bin_lo, off_lo, bin_hi, off_hi] int32 → padded (``slots``, 4)."""
     if times_i32 is None or len(times_i32) == 0:

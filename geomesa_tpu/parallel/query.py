@@ -493,6 +493,90 @@ def make_batched_count_step(mesh: Mesh, impl: str = "auto"):
     return step
 
 
+def make_matrix_scan_step(mesh: Mesh, topk: int, impl: str = "auto"):
+    """Subscription-matrix scan: Q standing queries over one streamed chunk
+    in ONE fused pass — per-query match counts AND a newest-match position
+    sample, psum/gathered over data shards.
+
+    fn(x, y, bins, offs, true_n, boxes (Q, B, 4), times (Q, T, 4)) →
+    (counts (Q,) int32, positions (Q, D, topk) int32 global chunk row
+    positions, -1 padded).
+
+    Counts are EXACT (bit-identical to :func:`make_batched_count_step` on
+    the same payloads). Positions are a newest-match SAMPLE: each data
+    shard keeps the most recent matched row per 128-row lane (the pallas
+    scoreboard of :func:`geomesa_tpu.ops.pallas_kernels.batched_count_hits`;
+    the jnp path computes the identical lane-max) and returns its top-k —
+    at most one position per (shard, lane), every returned position a true
+    match. ``impl`` as in :func:`make_batched_count_step`.
+    """
+    from geomesa_tpu.ops.pallas_kernels import LANES as _LANES
+
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    interpret = jax.default_backend() != "tpu"
+    k = min(topk, _LANES)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(),
+            P(QUERY_AXIS, None, None),
+            P(QUERY_AXIS, None, None),
+        ),
+        out_specs=(P(QUERY_AXIS), P(QUERY_AXIS, DATA_AXIS, None)),
+        check_vma=False,
+    )
+    def step(x, y, bins, offs, true_n, boxes, times):
+        n = x.shape[0]
+        if n % _LANES:
+            raise ValueError(
+                f"matrix scan needs per-shard rows % {_LANES} == 0, got {n}"
+            )
+        base = jax.lax.axis_index(DATA_AXIS) * n
+        if impl == "pallas":
+            from geomesa_tpu.ops.pallas_kernels import batched_count_hits
+
+            counts, lane_pos = batched_count_hits(
+                x, y, bins, offs, base, true_n, boxes, times,
+                interpret=interpret,
+            )
+        else:
+            m = _batched_masks(x, y, bins, offs, base, true_n, boxes, times)
+            counts = m.sum(axis=1, dtype=jnp.int32)
+            gpos = base + jnp.arange(n, dtype=jnp.int32)
+            lane_pos = jnp.where(m, gpos[None, :], jnp.int32(-1)).reshape(
+                m.shape[0], n // _LANES, _LANES
+            ).max(axis=1)
+        top, _ = jax.lax.top_k(lane_pos, k)
+        if k < topk:
+            top = jnp.pad(top, ((0, 0), (0, topk - k)), constant_values=-1)
+        return jax.lax.psum(counts, DATA_AXIS), top[:, None, :]
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_matrix_scan_step(mesh: Mesh, topk: int, q_cap: int,
+                            impl: str = "auto"):
+    """Memoized matrix-scan step, ONE observed identity per (mesh, topk,
+    capacity bucket): growing the subscription matrix into the next
+    power-of-two bucket compiles a NEW step (a first compile on a fresh
+    identity, never a J003 recompile on a warm one), and the steady path —
+    subscription add/remove inside a bucket — reuses the compiled
+    executable with zero recompiles (pinned in tests/test_stream_matrix.py
+    via the jaxmon census)."""
+    return _observed(
+        f"matrix_scan_q{q_cap}", make_matrix_scan_step(mesh, topk, impl)
+    )
+
+
 @lru_cache(maxsize=None)
 def make_repeated_count_step(mesh: Mesh, impl: str = "auto"):
     """Like :func:`make_batched_count_step` but evaluates R independent query

@@ -341,6 +341,116 @@ class QueryPlanner:
         return subs
 
 
+def _standing_dimension(f, geom: str | None, dtg: str | None) -> str:
+    """Dimension tag (``space``/``time``/``both``/``all``/``none``) for a
+    filter the subscription matrix evaluates EXACTLY in the int domain.
+
+    The matrix runs NO residual filter after the device scan — unlike the
+    store's query path, where extraction only has to be a sound superset
+    because the full predicate re-applies afterwards. Any clause that
+    extraction would widen or drop (attribute predicates, NOT, fid
+    filters, non-BBOX spatial ops whose envelope over-covers, ORs that
+    mix dimensions — the matrix evaluates ``(any box) AND (any window)``)
+    therefore raises instead of silently over-delivering."""
+    if isinstance(f, ast.Include):
+        return "all"
+    if isinstance(f, ast.Exclude):
+        return "none"
+    if isinstance(f, ast.BBox) and f.prop == geom:
+        return "space"
+    if isinstance(f, (ast.During, ast.TempOp)) and f.prop == dtg:
+        return "time"
+    if isinstance(f, ast.Between) and f.prop == dtg:
+        return "time"
+    if (isinstance(f, ast.Compare) and f.prop == dtg
+            and f.op in ("=", "<", "<=", ">", ">=")):
+        return "time"
+    if isinstance(f, ast.And):
+        tags = [_standing_dimension(c, geom, dtg) for c in f.children]
+        if "none" in tags:
+            return "none"
+        tags = [t for t in tags if t != "all"]
+        if not tags:
+            return "all"
+        if all(t == "space" for t in tags):
+            return "space"
+        if all(t == "time" for t in tags):
+            return "time"
+        return "both"
+    if isinstance(f, ast.Or):
+        tags = [_standing_dimension(c, geom, dtg) for c in f.children]
+        if "all" in tags:
+            return "all"
+        tags = [t for t in tags if t != "none"]
+        if not tags:
+            return "none"
+        if all(t == "space" for t in tags):
+            return "space"
+        if all(t == "time" for t in tags):
+            return "time"
+        raise ValueError(
+            "standing queries cannot OR spatial with temporal clauses — "
+            "the matrix evaluates (any box) AND (any window), a strict "
+            f"superset of such a predicate: {f!r}"
+        )
+    raise ValueError(
+        "standing queries evaluate bbox + time-window predicates only; no "
+        f"residual filter runs after the device scan: unsupported clause {f!r}"
+    )
+
+
+def standing_query_payload(sft: FeatureType, predicate,
+                           box_slots: int = 2, time_slots: int = 2):
+    """Decompose a STANDING query (bbox + time-window predicate) into one
+    subscription-matrix row: packed int-domain box and time-range payloads,
+    the exact encoding the batched count kernels consume
+    (``pack_boxes``/``pack_times`` over the planner's bounds extraction —
+    the per-query analog of ``TpuBackend._payload``).
+
+    ``predicate`` is a CQL string, a filter AST node, or a
+    :class:`Query`. Returns ``(boxes (box_slots, 4) int32, times
+    (time_slots, 4) int32)``. Like every int-domain payload this is a
+    SUPERSET test at quantization boundaries — standing-query deliveries
+    are int-domain matches (docs/streaming.md § Semantics). A provably
+    disjoint predicate packs to the unsatisfiable sentinel (matches
+    nothing) instead of a full scan. Raises ``ValueError`` for any clause
+    the matrix cannot evaluate exactly (attribute predicates, ``NOT``,
+    fid filters, non-BBOX spatial ops, ORs mixing space with time): no
+    residual filter runs after the device scan, so accepting one would
+    deliver rows the predicate rejects.
+    """
+    # lazy: backends imports planner — the payload helpers live there
+    from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+    from geomesa_tpu.ops.refine import pack_boxes, pack_times, unsat_rows
+    from geomesa_tpu.store.backends import REFINE_PRECISION, time_quads
+
+    q = predicate if isinstance(predicate, Query) else Query(filter=predicate)
+    f = q.resolved_filter()
+    # reject predicates the matrix cannot evaluate exactly (raises) —
+    # deliveries would otherwise be an UNBOUNDED superset, not the
+    # documented quantization-boundary one
+    _standing_dimension(f, sft.geom_field, sft.dtg_field)
+    e = extract(f, sft.geom_field, sft.dtg_field)
+    if e.disjoint:
+        return unsat_rows(box_slots, time_slots)
+    boxes_i32 = None
+    if e.boxes is not None:
+        nlon = norm_lon(REFINE_PRECISION)
+        nlat = norm_lat(REFINE_PRECISION)
+        boxes_i32 = np.array(
+            [
+                [int(nlon.normalize(x1)), int(nlon.normalize(x2)),
+                 int(nlat.normalize(y1)), int(nlat.normalize(y2))]
+                for x1, y1, x2, y2 in e.boxes
+            ],
+            dtype=np.int32,
+        )
+    return (
+        pack_boxes(boxes_i32, slots=box_slots),
+        pack_times(time_quads(sft, e.intervals), slots=time_slots),
+    )
+
+
 AGG_PROBE_EVERY = 16  # routing consults between probes of the loser
 
 

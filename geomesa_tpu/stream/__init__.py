@@ -14,3 +14,22 @@ from geomesa_tpu.stream.messages import (  # noqa: F401
 )
 from geomesa_tpu.stream.datastore import MessageBus, StreamingDataStore  # noqa: F401
 from geomesa_tpu.stream.remote_journal import RemoteJournal  # noqa: F401
+
+_LAZY = {
+    # the subscription-matrix engine pulls in jax (parallel/query) — load
+    # on first touch so `import geomesa_tpu.stream` stays jax-free
+    "SubscriptionMatrix": ("geomesa_tpu.stream.matrix", "SubscriptionMatrix"),
+    "HitBatch": ("geomesa_tpu.stream.matrix", "HitBatch"),
+    "DeviceStreamScanner": (
+        "geomesa_tpu.stream.pipeline", "DeviceStreamScanner"),
+    "SubscriptionHub": ("geomesa_tpu.stream.pipeline", "SubscriptionHub"),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])

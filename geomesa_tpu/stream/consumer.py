@@ -28,6 +28,16 @@ class ThreadedConsumer:
     stalled partition never blocks the thread, so one thread owning several
     partitions cannot deadlock a rendezvous). ``threads`` ≤ partitions; each
     thread owns a static partition subset (consumer-group assignment).
+
+    Idle polling backs off ADAPTIVELY instead of spinning at
+    ``poll_interval_s``: each empty round grows the sleep by the
+    decorrelated-jitter schedule (``resilience.policy.RetryPolicy`` — the
+    same jitter that spreads federated retry storms), capped at
+    ``idle_max_s``, and any traffic resets it to the base — so a quiet
+    topic costs ~10 polls/s instead of 500, while a busy one still drains
+    at full rate. Per-topic lag and poll-rate gauges land in
+    :mod:`geomesa_tpu.stream.telemetry`
+    (``geomesa_stream_lag{topic}`` on ``/api/metrics?format=prometheus``).
     """
 
     def __init__(
@@ -37,11 +47,19 @@ class ThreadedConsumer:
         apply: Callable[[bytes, int], None],
         threads: int = 2,
         poll_interval_s: float = 0.002,
+        idle_max_s: float = 0.1,
     ):
+        from geomesa_tpu.resilience.policy import RetryPolicy
+
         self.bus = bus
         self.topic = topic
         self.apply = apply
         self.poll_interval_s = poll_interval_s
+        self.idle_max_s = idle_max_s
+        # jitter source only (next_delay); the retry machinery is unused
+        self._idle = RetryPolicy(
+            base_delay_s=poll_interval_s, max_delay_s=idle_max_s
+        )
         n_parts = bus.partitions
         threads = max(1, min(threads, n_parts))
         self._assignments = [
@@ -60,7 +78,13 @@ class ThreadedConsumer:
             t.start()
 
     def _run(self, partitions: list[int]) -> None:
+        import time as _time
+
+        from geomesa_tpu.stream import telemetry
+
         trim = getattr(self.bus, "trim", None)  # durable buses free applied
+        delay: float | None = None
+        next_lag_t = 0.0
         while not self._stop.is_set():
             drained = 0
             for p in partitions:
@@ -76,7 +100,29 @@ class ThreadedConsumer:
                     # bound the bus's in-memory window to unapplied messages
                     trim(self.topic, p, self._offsets[p])
             if drained == 0:
-                self._stop.wait(self.poll_interval_s)
+                # decorrelated exponential backoff while idle; reset on
+                # traffic (fixed 2 ms spins burned a core per quiet topic)
+                delay = self._idle.next_delay(delay)
+                telemetry.note_poll(self.topic, 0, delay)
+                # lag is NOT necessarily 0 here: a partition stalled at a
+                # barrier drains nothing while messages keep queueing —
+                # but throttle like the busy branch: the first idle rounds
+                # after traffic spin at the 2 ms base delay
+                now = _time.monotonic()
+                if now >= next_lag_t:
+                    next_lag_t = now + 0.25
+                    telemetry.set_lag(self.topic, self.lag())
+                self._stop.wait(delay)
+            else:
+                delay = None
+                telemetry.note_poll(self.topic, drained, 0.0)
+                # lag() pays bus.end_offset per partition (a commit-sidecar
+                # read on JournalBus) — a gauge doesn't need that on EVERY
+                # busy round, so throttle it on the hot consume path
+                now = _time.monotonic()
+                if now >= next_lag_t:
+                    next_lag_t = now + 0.25
+                    telemetry.set_lag(self.topic, self.lag())
 
     def lag(self) -> int:
         """Unconsumed messages across partitions (backpressure signal)."""

@@ -82,6 +82,17 @@ class MessageBus:
                 callback(data)
             self._subscribers.setdefault(topic, []).append(callback)
 
+    def unsubscribe(self, topic: str, callback: Callable[[bytes], None]) -> bool:
+        """Remove a subscriber registered via :meth:`subscribe`; missing
+        registrations are a no-op (idempotent detach)."""
+        with self._lock:
+            subs = self._subscribers.get(topic, [])
+            try:
+                subs.remove(callback)
+                return True
+            except ValueError:
+                return False
+
     # -- consumer-group (polling) API ---------------------------------------
     def poll(self, topic: str, partition: int, offset: int, max_n: int = 256):
         """Messages [offset, offset+max_n) of one partition's log."""
@@ -121,6 +132,12 @@ class StreamingDataStore:
         self._serializers: dict[str, Any] = {}
         self._caches: dict[str, FeatureCache] = {}
         self._consumers: dict[str, object] = {}
+        # standing-query hubs (subscribe_query), one per type — the shared
+        # HubRegistry owns the subscribe-before-attach ordering and the
+        # leaf-lock discipline (stream/pipeline.py, jax-free at import)
+        from geomesa_tpu.stream.pipeline import HubRegistry
+
+        self._hubs = HubRegistry()
 
     # -- schema --------------------------------------------------------------
     def create_schema(
@@ -212,12 +229,79 @@ class StreamingDataStore:
         """The ThreadedConsumer for a type (None on the synchronous path)."""
         return self._consumers.get(type_name)
 
+    # -- standing queries (fused device scan) ---------------------------------
+    def subscribe_query(self, type_name: str, predicate, callback,
+                        **hub_cfg) -> int:
+        """Register a STANDING query: ``callback`` receives a
+        :class:`~geomesa_tpu.stream.matrix.HitBatch` (count delta + newest
+        matched rows) for every appended batch that matches ``predicate``
+        (bbox + time-window CQL, decomposed through the planner).
+
+        Unlike per-row host callbacks, all standing queries of a type are
+        evaluated together as ONE fused ``(rows × queries)`` device pass
+        per chunk (:class:`~geomesa_tpu.stream.pipeline.SubscriptionHub`
+        feeding a :class:`~geomesa_tpu.stream.pipeline.DeviceStreamScanner`);
+        the first subscription replays the topic backlog through the
+        scanner (the bus ``subscribe`` contract), so historical matches
+        deliver too. Backpressure is observational: the hub's ``lag()``
+        plus the consumer-group ``lag()`` upstream (docs/streaming.md).
+        Returns the subscription id."""
+        sft = self._types[type_name]
+        from geomesa_tpu.stream.pipeline import SubscriptionHub
+
+        topic = self._topic(type_name)
+
+        def attach(hub):
+            self.bus.subscribe(topic, hub.ingest)
+            # detach handle: close_all stops a shared/reused bus from
+            # feeding the closed scanner
+            return lambda: self.bus.unsubscribe(topic, hub.ingest)
+
+        return self._hubs.subscribe(
+            type_name, predicate, callback,
+            make_hub=lambda: SubscriptionHub(
+                sft, self._serializers[type_name], topic=topic, **hub_cfg
+            ),
+            attach=attach,
+            cfg=hub_cfg,
+        )
+
+    def unsubscribe_query(self, type_name: str, sid: int) -> bool:
+        return self._hubs.unsubscribe(type_name, sid)
+
+    def query_hub(self, type_name: str):
+        """The type's SubscriptionHub (None before any subscribe_query)."""
+        return self._hubs.get(type_name)
+
     def drain(self, type_name: str, timeout_s: float = 10.0) -> bool:
-        """Wait until async consumers have applied every published message."""
+        """Wait until every published message is VISIBLE end to end: the
+        bus tailer has delivered it (``JournalBus.tail_lag`` — an async
+        bus dispatches push callbacks from a background thread, so
+        ``query``/standing-query deliveries otherwise race the tail),
+        async consumers have applied it, and the type's standing-query
+        hub (if any) has scanned it."""
+        deadline = time.monotonic() + timeout_s
+        tail_lag = getattr(self.bus, "tail_lag", None)
+        if tail_lag is not None:
+            topic = self._topic(type_name)
+            while tail_lag(topic) > 0:
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.002)
         c = self._consumers.get(type_name)
-        return True if c is None else c.drain(timeout_s)
+        if c is not None and not c.drain(
+            max(deadline - time.monotonic(), 0.0)
+        ):
+            return False
+        hub = self.query_hub(type_name)
+        if hub is not None and not hub.drain(
+            max(deadline - time.monotonic(), 0.0)
+        ):
+            return False
+        return True
 
     def close(self) -> None:
+        self._hubs.close_all()
         for c in self._consumers.values():
             c.close()
         self._consumers.clear()

@@ -57,11 +57,12 @@ class JournalBus:
     """Append-only file journal per topic with the MessageBus interface."""
 
     def __init__(self, root: str, partitions: int = 4, fsync: bool = False,
-                 poll_interval_s: float = 0.01):
+                 poll_interval_s: float = 0.01, idle_max_s: float = 0.1):
         self.root = root
         self.partitions = partitions
         self.fsync = fsync
         self.poll_interval_s = poll_interval_s
+        self.idle_max_s = idle_max_s  # adaptive idle-backoff cap (_tail_loop)
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         # reader-side state per topic: committed-scan position, per-partition
@@ -78,9 +79,22 @@ class JournalBus:
         self._tcount: dict[str, int] = {}
         self._subscribers: dict[str, list[Callable[[bytes], None]]] = {}
         self._sub_offsets: dict[str, int] = {}  # tailer dispatch cursor
+        # dispatched-THROUGH cursor: advances only after every subscriber
+        # callback for a batch has returned (unlike _sub_offsets, which
+        # advances when the batch is claimed) — the tail_lag()/drain
+        # quiescence signal
+        self._dispatched: dict[str, int] = {}
         self._tailer: threading.Thread | None = None
         self._stop = threading.Event()
         self._migrated: set[tuple[str, str]] = set()
+        # standing-query hubs (subscribe_query): the shared HubRegistry
+        # (stream/pipeline.py, jax-free at import) owns the
+        # subscribe-before-attach ordering and the leaf-lock discipline —
+        # hub creation spawns a scan thread and bus registration may join
+        # a draining tailer, so neither runs under the bus lock
+        from geomesa_tpu.stream.pipeline import HubRegistry
+
+        self._hubs = HubRegistry()
 
     # -- paths ---------------------------------------------------------------
     def _safe(self, topic: str) -> str:
@@ -310,6 +324,20 @@ class JournalBus:
         with self._lock:
             return self._tcount.get(topic, 0)
 
+    def tail_lag(self, topic: str) -> int:
+        """Committed records the background tailer has NOT yet delivered to
+        every push subscriber — the feed-side quiescence signal
+        (``tail_lag() == 0`` means all published records have been handed
+        to all subscriber callbacks AND those callbacks returned). Topics
+        with no push subscribers report 0 (nothing to dispatch)."""
+        self._refresh(topic)
+        with self._lock:
+            if topic not in self._sub_offsets:
+                return 0
+            return max(
+                self._tcount.get(topic, 0) - self._dispatched.get(topic, 0), 0
+            )
+
     def trim(self, topic: str, partition: int, upto: int) -> int:
         """Release THIS READER's memory for partition messages below
         ``upto`` (a consumed offset). The on-disk journal is untouched —
@@ -390,6 +418,7 @@ class JournalBus:
                 callback(data)
         if first:
             self._sub_offsets[topic] = total
+            self._dispatched[topic] = total  # replay above was synchronous
             del self._tlogs[topic][: max(total - tbase, 0)]
             self._tbase[topic] = total
         self._subscribers.setdefault(topic, []).append(callback)
@@ -401,6 +430,19 @@ class JournalBus:
                 name="geomesa-journal-tailer",
             )
             self._tailer.start()
+
+    def unsubscribe(self, topic: str, callback: Callable[[bytes], None]) -> bool:
+        """Remove a push subscriber; missing registrations are a no-op.
+        The tailer keeps advancing the topic cursor for any remaining
+        subscribers (and stays dispatch-idle on the topic otherwise) —
+        detaching never rewinds or re-delivers."""
+        with self._lock:
+            subs = self._subscribers.get(topic, [])
+            try:
+                subs.remove(callback)
+                return True
+            except ValueError:
+                return False
 
     def _disk_payloads(self, topic: str, first_n: int) -> list[bytes]:
         """First ``first_n`` payloads re-read from the committed journal
@@ -472,41 +514,127 @@ class JournalBus:
         return out, cursor + off
 
     def _tail_loop(self) -> None:
+        from geomesa_tpu.obs import jaxmon, trace as _trace
+        from geomesa_tpu.resilience.policy import RetryPolicy
+        from geomesa_tpu.stream import telemetry
+
         stop = self._stop
-        while not stop.is_set():
-            dispatched = 0
-            with self._lock:
-                topics = list(self._subscribers)
-            for topic in topics:
-                self._refresh(topic)
+        errors = jaxmon.registry().counter("stream.callback_errors")
+        # decorrelated-jitter idle backoff (reset on traffic): a quiet bus
+        # polls ~10x/s instead of spinning at poll_interval_s
+        idle = RetryPolicy(base_delay_s=self.poll_interval_s,
+                           max_delay_s=self.idle_max_s)
+        delay: float | None = None
+        # ONE stable root span per tailer session (the local-bus analog of
+        # RemoteJournal's journal.tail session): callback failures attach
+        # as span EVENTS so a broken consumer shows up in flight records
+        # instead of vanishing into a swallowed except. Managed manually —
+        # tracing may come on mid-session.
+        session = _trace.span("journal.tail", bus=self.root)
+        session.__enter__()
+        try:
+            while not stop.is_set():
+                if session is _trace.NOOP and _trace.enabled():
+                    session = _trace.span("journal.tail", bus=self.root)
+                    session.__enter__()
+                dispatched = 0
                 with self._lock:
-                    tbase = self._tbase[topic]
-                    log = self._tlogs[topic]
-                    start = self._sub_offsets.get(topic, 0)
-                    batch = log[max(start - tbase, 0):]
-                    subs = list(self._subscribers.get(topic, []))
-                    self._sub_offsets[topic] = tbase + len(log)
-                    # dispatched records leave memory (steady-state bound);
-                    # late subscribers replay them from disk
-                    del log[: max(start - tbase, 0) + len(batch)]
-                    self._tbase[topic] = self._sub_offsets[topic]
-                for data in batch:
-                    for cb in subs:
-                        try:
-                            cb(data)
-                        except Exception:  # noqa: BLE001 — one bad consumer
-                            # must not kill delivery for every topic; the
-                            # record is consumed (at-most-once for the
-                            # failing callback, like the in-process bus's
-                            # synchronous dispatch raising to the publisher)
-                            pass
-                    dispatched += 1
-            if dispatched == 0:
-                stop.wait(self.poll_interval_s)
+                    topics = list(self._subscribers)
+                for topic in topics:
+                    self._refresh(topic)
+                    with self._lock:
+                        tbase = self._tbase[topic]
+                        log = self._tlogs[topic]
+                        start = self._sub_offsets.get(topic, 0)
+                        batch = log[max(start - tbase, 0):]
+                        subs = list(self._subscribers.get(topic, []))
+                        end = tbase + len(log)
+                        self._sub_offsets[topic] = end
+                        # dispatched records leave memory (steady-state
+                        # bound); late subscribers replay them from disk
+                        del log[: max(start - tbase, 0) + len(batch)]
+                        self._tbase[topic] = end
+                    for data in batch:
+                        for cb in subs:
+                            try:
+                                cb(data)
+                            except Exception as e:  # noqa: BLE001
+                                # one bad consumer must not kill delivery
+                                # for every topic; the record stays
+                                # consumed (at-most-once for the failing
+                                # callback) — but the failure is COUNTED
+                                # and lands on the session span, never
+                                # silently swallowed
+                                errors.inc()
+                                telemetry.note_callback_error(topic)
+                                if isinstance(session, _trace.Span):
+                                    session.event(
+                                        "callback_error", topic=topic,
+                                        error=type(e).__name__,
+                                    )
+                        dispatched += 1
+                    if batch:
+                        with self._lock:
+                            # dispatched-THROUGH only moves once every
+                            # callback has seen the batch (tail_lag's
+                            # happens-before edge)
+                            self._dispatched[topic] = end
+                        telemetry.note_poll(topic, len(batch), 0.0,
+                                            loop="tailer")
+                    if isinstance(session, _trace.Span):
+                        # bound the long-lived session tree (remote-journal
+                        # pattern: single-writer trim, exporters snapshot)
+                        if len(session.events) > 128:
+                            del session.events[:-128]
+                if dispatched == 0:
+                    delay = idle.next_delay(delay)
+                    for topic in topics:
+                        telemetry.note_poll(topic, 0, delay,
+                                            loop="tailer")
+                    stop.wait(delay)
+                else:
+                    delay = None
+        finally:
+            session.__exit__(None, None, None)
+
+    # -- standing queries (fused device scan) --------------------------------
+    def subscribe_query(self, topic: str, serializer, predicate,
+                        callback, **hub_cfg) -> int:
+        """Standing-query subscription over a journal topic: instead of a
+        per-row host callback, appended records batch through the
+        :class:`~geomesa_tpu.stream.pipeline.SubscriptionHub` — decoded
+        with ``serializer`` (which carries the feature type), scanned as
+        one fused ``(rows × queries)`` device pass per chunk, with
+        per-subscription hit deliveries (docs/streaming.md). Returns the
+        subscription id (``unsubscribe_query`` to remove)."""
+        from geomesa_tpu.stream.pipeline import SubscriptionHub
+
+        def attach(hub):
+            self.subscribe(topic, hub.ingest)
+            # detach handle: close_all stops a reused bus from feeding
+            # the closed scanner after its tailer restarts
+            return lambda: self.unsubscribe(topic, hub.ingest)
+
+        return self._hubs.subscribe(
+            topic, predicate, callback,
+            make_hub=lambda: SubscriptionHub(
+                serializer.sft, serializer, topic=topic, **hub_cfg
+            ),
+            attach=attach,
+            cfg=hub_cfg,
+        )
+
+    def unsubscribe_query(self, topic: str, sid: int) -> bool:
+        return self._hubs.unsubscribe(topic, sid)
+
+    def query_hub(self, topic: str):
+        """The topic's SubscriptionHub (None before any subscribe_query)."""
+        return self._hubs.get(topic)
 
     def close(self) -> None:
         """Stop the tailer (idempotent; deterministic join). See
         :meth:`subscribe` for the stop/restart state transition."""
+        self._hubs.close_all()
         # snapshot under the lock (subscribe swaps _stop/_tailer under it);
         # join OUTSIDE it — the tailer takes the lock per topic and joining
         # while holding it would deadlock

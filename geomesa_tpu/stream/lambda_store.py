@@ -102,6 +102,21 @@ class LambdaDataStore:
             self._tombstones.get(type_name, set()).discard(fid)  # re-put revives
         self.stream.put(type_name, fid, record, ts=ts)
 
+    def subscribe_query(self, type_name: str, predicate, callback,
+                        **hub_cfg) -> int:
+        """Standing query over the lambda tier's LIVE stream: every write
+        flows through the hot tier's bus, so subscriptions see each
+        appended feature exactly once regardless of when the persister
+        later moves it cold (see
+        :meth:`~geomesa_tpu.stream.datastore.StreamingDataStore.subscribe_query`)."""
+        self._ensure_hot(type_name)
+        return self.stream.subscribe_query(
+            type_name, predicate, callback, **hub_cfg
+        )
+
+    def unsubscribe_query(self, type_name: str, sid: int) -> bool:
+        return self.stream.unsubscribe_query(type_name, sid)
+
     def delete(self, type_name: str, fid: str) -> None:
         """Delete from BOTH tiers: tombstone first (so a racing persist pass
         can't resurrect the feature into cold), then the hot-tier message and

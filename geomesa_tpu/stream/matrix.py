@@ -1,0 +1,395 @@
+"""Subscription matrix: Q standing queries as ONE ``(rows × queries)``
+device problem.
+
+The streaming tier's old delivery path evaluated every standing predicate
+per row on the host (``stream/datastore.py`` ``consume`` callbacks) — at Q
+concurrent subscriptions each appended row paid Q python predicate
+evaluations, which is exactly where BENCH_r05's 1B-row streaming scan fell
+to 0.1× the CPU baseline. The many-core evaluation in PAPERS.md shows
+batch-parallel filter evaluation is where wide hardware dominates, so this
+module turns the subscription set into device-resident QUERY MATRICES:
+
+- every standing query decomposes (``planning.planner.standing_query_payload``
+  — the same planner bounds extraction and ``pack_boxes``/``pack_times``
+  int-domain encoding the batched count kernels already consume) into one
+  row of a packed ``(capacity, B, 4)`` box matrix and ``(capacity, T, 4)``
+  time matrix;
+- capacity is a POWER-OF-TWO BUCKET (tpulint J003): subscription add and
+  remove rewrite rows in place — inactive slots hold an unsatisfiable
+  sentinel payload, so membership churn never changes the compiled step's
+  shapes, and only crossing a bucket boundary compiles a new (cached,
+  per-bucket) executable. The jaxmon recompile census pins the steady
+  path at ZERO recompiles (tests/test_stream_matrix.py).
+- a scan is one fused count+gather pass
+  (:func:`geomesa_tpu.parallel.query.cached_matrix_scan_step` /
+  ``ops.pallas_kernels.batched_count_hits``): per-subscription match
+  counts (exact) AND a newest-match row-position sample come back from a
+  single pass over the chunk.
+
+Semantics: deliveries are INT-DOMAIN matches — the same superset-at-
+quantization-boundaries contract as every other int payload in the tree
+(``ops/refine.py``). Counts are exact in that domain and byte-equal to a
+per-query referee scan over identical payloads.
+
+Locking: ``SubscriptionMatrix._lock`` is a LEAF (docs/concurrency.md) —
+device uploads and the scan dispatch run strictly OUTSIDE it; scans use
+an immutable snapshot so subscription churn during a scan affects the
+NEXT chunk, never a half-applied current one.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["SubscriptionMatrix", "HitBatch", "MatrixSnapshot",
+           "envelope_hit", "envelope_hits"]
+
+DEFAULT_BOX_SLOTS = 2
+DEFAULT_TIME_SLOTS = 2
+DEFAULT_TOPK = 64
+MIN_CAPACITY = 8
+
+
+def _unsat_rows(box_slots: int, time_slots: int):
+    """The inactive-slot payload: every slot unsatisfiable, so a masked
+    slot matches nothing while keeping the matrix shape — and therefore
+    the compiled step — fixed. One shared sentinel definition
+    (``ops.refine.unsat_rows``, also the planner's disjoint branch)."""
+    from geomesa_tpu.ops.refine import unsat_rows
+
+    return unsat_rows(box_slots, time_slots)
+
+
+def envelope_hit(boxes: np.ndarray, times: np.ndarray, ix1: int, ix2: int,
+                 iy1: int, iy2: int, b: int, o: int) -> bool:
+    """Host-side int-domain test of one EXTENDED feature — normalized
+    envelope ``[ix1, ix2] × [iy1, iy2]`` at time instant ``(bin, offset)``
+    — against one subscription's packed payload.
+
+    The device kernel tests point containment; an extended geometry needs
+    bbox OVERLAP (its envelope may straddle a query box whose interior
+    its center never enters), so the scanner routes these few rows here
+    (``DeviceStreamScanner`` wide-row refine) — the point kernel's
+    containment widened to envelope overlap, still a superset in the int
+    domain. Empty slots (lo > hi: the unsatisfiable sentinel / the
+    ``pack_boxes`` pad) are SKIPPED rather than compared — ``[1, 0, 1, 0]``
+    is empty under containment but an envelope spanning that corner would
+    "overlap" it. Time uses the kernel's exact (bin, offset) window
+    comparisons."""
+    return bool(envelope_hits(
+        boxes, times,
+        np.asarray([ix1]), np.asarray([ix2]),
+        np.asarray([iy1]), np.asarray([iy2]),
+        np.asarray([b]), np.asarray([o]),
+    )[0])
+
+
+def envelope_hits(boxes: np.ndarray, times: np.ndarray,
+                  ix1: np.ndarray, ix2: np.ndarray,
+                  iy1: np.ndarray, iy2: np.ndarray,
+                  b: np.ndarray, o: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`envelope_hit`: all W wide rows of a chunk against
+    one subscription's payload in O(slots) numpy passes — a W-length bool
+    mask, never W×slots interpreted comparisons (the scan thread calls
+    this once per subscription per chunk)."""
+    in_box = np.zeros(len(ix1), bool)
+    for xlo, xhi, ylo, yhi in boxes:
+        if xlo > xhi or ylo > yhi:
+            continue  # empty slot — never an overlap candidate
+        in_box |= (ix1 <= xhi) & (ix2 >= xlo) & (iy1 <= yhi) & (iy2 >= ylo)
+    if not in_box.any():
+        return in_box
+    in_time = np.zeros(len(ix1), bool)
+    for blo, olo, bhi, ohi in times:
+        after = (b > blo) | ((b == blo) & (o >= olo))
+        before = (b < bhi) | ((b == bhi) & (o <= ohi))
+        in_time |= after & before
+    return in_box & in_time
+
+
+@dataclass(frozen=True)
+class HitBatch:
+    """One subscription's delivery for one scanned chunk."""
+
+    sid: int
+    predicate: object  # the subscribed predicate (CQL text / Query / None)
+    count: int  # matches in this chunk — the count DELTA
+    total: int  # cumulative matches delivered to this subscription
+    positions: np.ndarray  # newest-match global stream row positions (≤ topk)
+    tags: list | None  # caller row tags (fids) for ``positions``, if kept
+    chunk: int  # chunk sequence number
+    base: int  # global stream position of this chunk's row 0
+    rows: int  # true rows in this chunk
+
+
+class _Sub:
+    __slots__ = ("sid", "predicate", "callback", "boxes", "times")
+
+    def __init__(self, sid, predicate, callback, boxes, times):
+        self.sid = sid
+        self.predicate = predicate
+        self.callback = callback
+        self.boxes = boxes
+        self.times = times
+
+
+@dataclass(frozen=True)
+class MatrixSnapshot:
+    """Immutable view of the matrix at one epoch: the scan-side contract.
+
+    ``sids[slot]`` maps matrix row → subscription id (None = masked);
+    ``subs`` resolves ids to callbacks. Device arrays are uploaded once
+    per epoch and reused until the next membership change — a steady
+    matrix pays ZERO h2d per chunk."""
+
+    epoch: int
+    capacity: int
+    sids: tuple
+    subs: dict
+    boxes_dev: object
+    times_dev: object
+
+
+class SubscriptionMatrix:
+    """Registry of standing queries materialized as device query matrices.
+
+    ``sft`` drives predicate decomposition (:meth:`subscribe`); pass
+    ``None`` when only pre-packed payloads are registered
+    (:meth:`subscribe_packed` — the bench path, whose rows are already
+    normalized ints). ``box_slots``/``time_slots`` are the per-subscription
+    payload widths (compile-time shapes; a predicate with more boxes
+    collapses to its envelope — still a superset)."""
+
+    def __init__(self, sft=None, mesh=None, box_slots: int = DEFAULT_BOX_SLOTS,
+                 time_slots: int = DEFAULT_TIME_SLOTS, topk: int = DEFAULT_TOPK,
+                 min_capacity: int = MIN_CAPACITY, impl: str = "auto"):
+        if min_capacity < 1 or (min_capacity & (min_capacity - 1)):
+            raise ValueError("min_capacity must be a power of two")
+        if topk < 1:
+            raise ValueError("topk must be >= 1")
+        self.sft = sft
+        self._mesh = mesh
+        self.box_slots = box_slots
+        self.time_slots = time_slots
+        self.topk = topk
+        self.min_capacity = min_capacity
+        self.impl = impl
+        self._unsat_boxes, self._unsat_times = _unsat_rows(
+            box_slots, time_slots
+        )
+        self._lock = threading.Lock()  # leaf — see module docstring
+        self._subs: dict[int, _Sub] = {}
+        self._slots: list[int | None] = [None] * min_capacity
+        self._boxes = np.tile(self._unsat_boxes[None], (min_capacity, 1, 1))
+        self._times = np.tile(self._unsat_times[None], (min_capacity, 1, 1))
+        self._epoch = 0
+        self._dev: tuple | None = None  # (epoch, boxes_dev, times_dev)
+        self._next_sid = 1
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from geomesa_tpu.parallel.mesh import default_mesh
+
+            self._mesh = default_mesh()
+        return self._mesh
+
+    # -- registry -------------------------------------------------------------
+    def subscribe(self, predicate, callback) -> int:
+        """Register a standing query (CQL / filter AST / Query); returns the
+        subscription id. The predicate decomposes through the planner into
+        this matrix's packed row encoding."""
+        if self.sft is None:
+            raise ValueError(
+                "matrix built without an sft: use subscribe_packed"
+            )
+        from geomesa_tpu.planning.planner import standing_query_payload
+
+        boxes, times = standing_query_payload(
+            self.sft, predicate, self.box_slots, self.time_slots
+        )
+        return self._add(predicate, callback, boxes, times)
+
+    def subscribe_packed(self, boxes, times, callback,
+                         predicate=None) -> int:
+        """Register a pre-packed int-domain payload: ``boxes (≤box_slots,
+        4)``, ``times (≤time_slots, 4)`` int32 (the
+        ``pack_boxes``/``pack_times`` row encoding)."""
+        from geomesa_tpu.ops.refine import pack_boxes, pack_times
+
+        b = np.asarray(boxes, np.int32).reshape(-1, 4)
+        t = np.asarray(times, np.int32).reshape(-1, 4)
+        return self._add(
+            predicate, callback,
+            pack_boxes(b, slots=self.box_slots),
+            pack_times(t, slots=self.time_slots),
+        )
+
+    def _add(self, predicate, callback, boxes, times) -> int:
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            try:
+                slot = self._slots.index(None)
+            except ValueError:
+                slot = len(self._slots)
+                self._grow_locked()
+            sub = _Sub(sid, predicate, callback, boxes, times)
+            self._subs[sid] = sub
+            self._slots[slot] = sid
+            self._boxes[slot] = boxes
+            self._times[slot] = times
+            self._epoch += 1
+            self._dev = None
+        return sid
+
+    def unsubscribe(self, sid: int) -> bool:
+        """Deactivate a subscription: its slot is masked with the
+        unsatisfiable payload (no shape change); the bucket shrinks —
+        compacting live rows into the next-smaller power of two — once
+        occupancy falls to a quarter."""
+        with self._lock:
+            sub = self._subs.pop(sid, None)
+            if sub is None:
+                return False
+            slot = self._slots.index(sid)
+            self._slots[slot] = None
+            self._boxes[slot] = self._unsat_boxes
+            self._times[slot] = self._unsat_times
+            cap = len(self._slots)
+            if cap > self.min_capacity and len(self._subs) <= cap // 4:
+                self._shrink_locked(cap // 2)
+            self._epoch += 1
+            self._dev = None
+        return True
+
+    def _grow_locked(self) -> None:
+        cap = len(self._slots)
+        new_cap = cap * 2
+        boxes = np.tile(self._unsat_boxes[None], (new_cap, 1, 1))
+        times = np.tile(self._unsat_times[None], (new_cap, 1, 1))
+        boxes[:cap] = self._boxes
+        times[:cap] = self._times
+        self._boxes, self._times = boxes, times
+        self._slots.extend([None] * cap)
+
+    def _shrink_locked(self, new_cap: int) -> None:
+        new_cap = max(new_cap, self.min_capacity)
+        boxes = np.tile(self._unsat_boxes[None], (new_cap, 1, 1))
+        times = np.tile(self._unsat_times[None], (new_cap, 1, 1))
+        slots: list[int | None] = [None] * new_cap
+        i = 0
+        for sid in self._slots:
+            if sid is None:
+                continue
+            slots[i] = sid
+            boxes[i] = self._subs[sid].boxes
+            times[i] = self._subs[sid].times
+            i += 1
+        self._boxes, self._times, self._slots = boxes, times, slots
+
+    def capacity(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    # -- scan side ------------------------------------------------------------
+    def snapshot(self) -> MatrixSnapshot:
+        """The scan-side view: slot→sid map plus device-resident query
+        matrices. The device upload happens OUTSIDE the matrix lock (jax
+        dispatch never runs under it) and is cached per epoch, so a steady
+        subscription set stages its matrices exactly once."""
+        with self._lock:
+            epoch = self._epoch
+            cap = len(self._slots)
+            sids = tuple(self._slots)
+            subs = {sid: self._subs[sid] for sid in sids if sid is not None}
+            dev = self._dev if (self._dev and self._dev[0] == epoch) else None
+            host = None if dev else (self._boxes.copy(), self._times.copy())
+        if dev is None:
+            import jax.numpy as jnp
+
+            from geomesa_tpu.obs.jaxmon import count_h2d
+
+            host_b, host_t = host
+            # matrix uploads belong to the STREAM, not to whichever query
+            # happens to be profiled concurrently (ISSUE 7's pool rule)
+            count_h2d(host_b, host_t, label="stream")
+            dev = (epoch, jnp.asarray(host_b), jnp.asarray(host_t))
+            with self._lock:
+                if self._epoch == epoch:
+                    self._dev = dev
+        return MatrixSnapshot(
+            epoch=epoch, capacity=cap, sids=sids, subs=subs,
+            boxes_dev=dev[1], times_dev=dev[2],
+        )
+
+    def scan_chunk(self, snapshot: MatrixSnapshot, x, y, bins, offs, true_n):
+        """One fused pass of staged device columns against the snapshot's
+        matrices → ``(counts (cap,) int64, positions (cap, D, topk))``
+        materialized on host. Callers map slot → sid via the snapshot."""
+        from geomesa_tpu.parallel.query import cached_matrix_scan_step
+
+        step = cached_matrix_scan_step(
+            self.mesh, self.topk, snapshot.capacity, self.impl
+        )
+        counts, pos = step(
+            x, y, bins, offs, true_n, snapshot.boxes_dev, snapshot.times_dev
+        )
+        return np.asarray(counts).astype(np.int64), np.asarray(pos)
+
+    def scan_host(self, x, y, bins, offs):
+        """Convenience single-shot scan of HOST int32 columns (tests, small
+        batches): pads/shards, runs the fused pass, returns ``(snapshot,
+        counts, positions (cap, ≤topk) per-slot matched positions, newest
+        first)``. The production streaming path uses
+        :class:`~geomesa_tpu.stream.pipeline.DeviceStreamScanner` instead,
+        which double-buffers transfers."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from geomesa_tpu.obs.jaxmon import count_h2d
+        from geomesa_tpu.ops.pallas_kernels import LANES
+        from geomesa_tpu.parallel.mesh import DATA_AXIS, data_shards
+
+        n = len(x)
+        shards = data_shards(self.mesh)
+        unit = shards * LANES
+        padded = ((max(n, 1) + unit - 1) // unit) * unit
+        cols = []
+        for a in (x, y, bins, offs):
+            a = np.asarray(a, np.int32)
+            if padded != n:
+                a = np.concatenate(
+                    [a, np.zeros(padded - n, np.int32)]
+                )
+            cols.append(a)
+        count_h2d(*cols, label="stream")
+        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        dev = [jax.device_put(a, sh) for a in cols]
+        snap = self.snapshot()
+        counts, pos = self.scan_chunk(snap, *dev, jnp.int32(n))
+        merged = [merge_positions(pos[s], self.topk)
+                  for s in range(snap.capacity)]
+        return snap, counts, merged
+
+
+def merge_positions(pos_shards: np.ndarray, topk: int) -> np.ndarray:
+    """Merge one slot's per-shard position lanes ``(D, topk)`` into the
+    newest-first global sample (drop -1 pads, descending, ≤ topk)."""
+    p = pos_shards.reshape(-1)
+    p = p[p >= 0]
+    if len(p) > 1:
+        p = np.sort(p)[::-1]
+    return p[:topk]

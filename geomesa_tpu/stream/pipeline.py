@@ -1,0 +1,736 @@
+"""Device stream scanner: the productized bench-8 double-buffered pipeline.
+
+The 1B-row streaming sweep (bench 8) proved the pattern — a bounded queue
+of host-resident chunks, ``device_put`` of chunk c+1 issued BEHIND the
+fused scan of chunk c so the H2D transfer overlaps compute, transfer-wait
+measured (never subtracted) — but the pattern lived inline in the bench.
+:class:`DeviceStreamScanner` is that pipeline as a subsystem: it owns the
+scan thread, the double buffer, the per-subscription hit delivery of a
+:class:`~geomesa_tpu.stream.matrix.SubscriptionMatrix`, transfer-wait
+accounting (``stream/telemetry.py``), and a deterministic, idempotent
+shutdown (sanitizer-verified; docs/streaming.md § Shutdown).
+
+Two feeding modes share the pipeline:
+
+- :meth:`submit_chunk` — pre-built column chunks through a BOUNDED queue;
+  the producer blocks when ``max_pending_chunks`` are in flight (the
+  bench-8 reader-thread contract: backpressure by blocking).
+- :meth:`submit_rows` — row fragments (the bus-fed path): the scan thread
+  cuts full chunks as they fill and flushes a partial chunk after
+  ``flush_interval_s`` of quiet, padded to the fixed chunk shape so the
+  compiled step never sees a new signature. This path never blocks the
+  bus callback; backpressure is observational via :meth:`lag` (and the
+  journal consumer's ``lag()`` upstream).
+
+:class:`SubscriptionHub` bridges a message-bus topic onto the scanner:
+it decodes ``Put`` messages, normalizes (lon, lat, dtg) into the
+int-domain scan columns, and keeps per-chunk fid tags so deliveries can
+name the matching features.
+
+Locking (docs/concurrency.md): the scanner condition lock and the matrix
+lock are LEAVES — chunk staging, the scan dispatch, and subscriber
+callbacks all run strictly outside them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from geomesa_tpu.stream.matrix import (
+    HitBatch,
+    SubscriptionMatrix,
+    envelope_hits,
+    merge_positions,
+)
+from geomesa_tpu.stream import telemetry
+
+__all__ = ["DeviceStreamScanner", "SubscriptionHub", "HubRegistry"]
+
+
+class _Chunk:
+    __slots__ = ("seq", "base", "rows", "cols", "tags", "env")
+
+    def __init__(self, seq, base, rows, cols, tags, env=None):
+        self.seq = seq
+        self.base = base
+        self.rows = rows  # true rows (cols are padded to the fixed shape)
+        self.cols = cols  # (x, y, bins, offs) np int32, len == chunk_rows
+        self.tags = tags  # per-true-row tags (fids) or None
+        # wide (extended-geometry) rows: [(local_idx, ix1, ix2, iy1, iy2)]
+        # — their x/y columns hold the -1 sentinel (no packed box matches a
+        # negative coordinate, so the device pass never counts them) and
+        # the scan thread refines them host-side via envelope_hits
+        self.env = env
+
+
+class DeviceStreamScanner:
+    """Double-buffered streaming scan of a subscription matrix."""
+
+    def __init__(self, matrix: SubscriptionMatrix, chunk_rows: int = 65536,
+                 max_pending_chunks: int = 2, flush_interval_s: float = 0.05,
+                 topic: str = "stream", keep_tags: bool = True):
+        from geomesa_tpu.ops.pallas_kernels import LANES
+        from geomesa_tpu.parallel.mesh import data_shards
+
+        self.matrix = matrix
+        shards = data_shards(matrix.mesh)
+        unit = shards * LANES
+        # fixed chunk shape: shard- and lane-aligned so the compiled step
+        # sees ONE signature for full and partial (padded) chunks alike
+        self.chunk_rows = ((max(chunk_rows, unit) + unit - 1) // unit) * unit
+        if matrix.topk > self.chunk_rows // shards:
+            raise ValueError("topk exceeds per-shard rows of one chunk")
+        self.max_pending_chunks = max(1, max_pending_chunks)
+        self.flush_interval_s = flush_interval_s
+        self.topic = topic
+        self.keep_tags = keep_tags
+        self._lock = threading.Lock()  # leaf: buffers, queue, stats
+        self._cv = threading.Condition(self._lock)
+        self._frags: list[tuple] = []  # (x, y, bins, offs, tags) fragments
+        self._buffered = 0
+        self._chunks: deque[_Chunk] = deque()
+        self._seq = 0
+        self._rows_in = 0  # rows accepted (global stream positions)
+        self._rows_scanned = 0
+        self._chunks_scanned = 0
+        self._totals: dict[int, int] = {}  # sid → delivered total (scan thread)
+        self._stats = {
+            "chunks": 0, "rows": 0, "h2d_bytes": 0, "transfer_wait_s": 0.0,
+            "scan_s": 0.0, "deliveries": 0, "callback_errors": 0,
+            "scan_errors": 0,
+        }
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"geomesa-stream-scan-{topic}",
+        )
+        self._thread.start()
+
+    # -- feeding --------------------------------------------------------------
+    def submit_rows(self, x, y, bins, offs, tags=None,
+                    envelopes=None) -> None:
+        """Append rows (np int32 columns) to the scan stream. Never blocks —
+        the bus dispatch thread must not stall; watch :meth:`lag`.
+
+        ``envelopes``: optional per-row ``None | (ix1, ix2, iy1, iy2)``
+        normalized int envelopes for EXTENDED geometries. Wide rows get
+        the -1 x/y sentinel (the device pass never matches them) and are
+        refined host-side against each subscription's payload
+        (:func:`~geomesa_tpu.stream.matrix.envelope_hit`) at delivery —
+        bbox overlap, not center containment."""
+        n = len(x)
+        if n == 0:
+            return
+        if len(y) != n or len(bins) != n or len(offs) != n:
+            raise ValueError("column length mismatch")
+        if tags is not None and len(tags) != n:
+            raise ValueError("tags length mismatch")
+        x = np.asarray(x, np.int32)
+        y = np.asarray(y, np.int32)
+        env = None
+        if envelopes is not None:
+            if len(envelopes) != n:
+                raise ValueError("envelopes length mismatch")
+            wide = [i for i, e in enumerate(envelopes) if e is not None]
+            if wide:
+                x = x.copy()
+                y = y.copy()
+                x[wide] = -1
+                y[wide] = -1
+                env = list(envelopes)
+        frag = (
+            x, y,
+            np.asarray(bins, np.int32), np.asarray(offs, np.int32),
+            list(tags) if (tags is not None and self.keep_tags) else None,
+            env,
+        )
+        with self._cv:
+            if self._closed:
+                return
+            self._frags.append(frag)
+            self._buffered += n
+            self._rows_in += n
+            while self._buffered >= self.chunk_rows:
+                self._cut_locked(self.chunk_rows)
+            self._cv.notify_all()
+
+    def submit_chunk(self, x, y, bins, offs, tags=None,
+                     block: bool = True) -> bool:
+        """Submit one pre-built chunk through the bounded pipeline queue.
+        With ``block=True`` the caller waits while ``max_pending_chunks``
+        chunks are already in flight — the reader-thread backpressure
+        contract. Returns False if the scanner is closed."""
+        with self._cv:
+            if self._closed:
+                return False
+            if self._buffered:
+                # row-mode fragments flush first so stream positions stay
+                # in submission order
+                self._cut_locked(min(self._buffered, self.chunk_rows))
+            while (
+                block
+                and len(self._chunks) >= self.max_pending_chunks
+                and not self._closed
+            ):
+                # Condition.wait RELEASES the lock while blocked — this
+                # is the bounded-queue backpressure rendezvous itself
+                # tpurace: disable-next-line=R003
+                self._cv.wait(0.05)
+            if self._closed:
+                return False
+            self._append_chunk_locked(x, y, bins, offs, tags)
+            self._cv.notify_all()
+        return True
+
+    def _append_chunk_locked(self, x, y, bins, offs, tags) -> None:
+        n = len(x)
+        cols = []
+        for a in (x, y, bins, offs):
+            a = np.asarray(a, np.int32)
+            if n < self.chunk_rows:
+                a = np.concatenate(
+                    [a, np.zeros(self.chunk_rows - n, np.int32)]
+                )
+            elif n > self.chunk_rows:
+                raise ValueError(
+                    f"chunk of {n} rows exceeds chunk_rows={self.chunk_rows}"
+                )
+            cols.append(a)
+        self._chunks.append(_Chunk(
+            self._seq, self._rows_in,
+            n, tuple(cols),
+            list(tags) if (tags is not None and self.keep_tags) else None,
+        ))
+        self._seq += 1
+        self._rows_in += n
+
+    def _cut_locked(self, take: int) -> None:
+        """Concatenate buffered fragments and emit the first ``take`` rows
+        as one chunk (padded to the fixed shape); the remainder stays
+        buffered. Caller holds the lock; numpy concat only — no I/O."""
+        xs, ys, bs, os_, tags, envs = [], [], [], [], [], []
+        # materialize the per-row tag/env lists only when some fragment
+        # actually carries them — the common bus-fed chunk (no fid tags
+        # kept, no extended geometries) must not allocate and discard two
+        # chunk_rows-length Python lists per cut while holding the lock
+        have_tags = any(f[4] is not None for f in self._frags)
+        have_env = any(f[5] is not None for f in self._frags)
+        for fx, fy, fb, fo, ft, fe in self._frags:
+            xs.append(fx)
+            ys.append(fy)
+            bs.append(fb)
+            os_.append(fo)
+            if have_tags:
+                tags.extend(ft if ft is not None else [None] * len(fx))
+            if have_env:
+                envs.extend(fe if fe is not None else [None] * len(fx))
+        x = np.concatenate(xs)
+        y = np.concatenate(ys)
+        b = np.concatenate(bs)
+        o = np.concatenate(os_)
+        rest = len(x) - take
+        self._frags = (
+            [(x[take:], y[take:], b[take:], o[take:],
+              tags[take:] if have_tags else None,
+              envs[take:] if have_env else None)] if rest else []
+        )
+        self._buffered = rest
+        base = self._rows_in - rest - take
+        cols = []
+        for a in (x[:take], y[:take], b[:take], o[:take]):
+            if take < self.chunk_rows:
+                a = np.concatenate(
+                    [a, np.zeros(self.chunk_rows - take, np.int32)]
+                )
+            cols.append(a)
+        env = (
+            [(i, *e) for i, e in enumerate(envs[:take]) if e is not None]
+            if have_env else None
+        )
+        self._chunks.append(_Chunk(
+            self._seq, base, take, tuple(cols),
+            tags[:take] if have_tags else None,
+            env or None,
+        ))
+        self._seq += 1
+
+    # -- pipeline -------------------------------------------------------------
+    def _next_chunk(self):
+        """Block until a chunk is available, a quiet partial buffer is due
+        for flush, or shutdown. Returns None to exit."""
+        deadline = None
+        with self._cv:
+            while True:
+                # stop FIRST: close() promises "after the in-flight chunk",
+                # so queued-but-unstarted chunks are dropped (drain() first
+                # for a graceful flush) — otherwise a deep backlog could
+                # outlive close()'s bounded join and leave the thread alive
+                if self._stop.is_set():
+                    return None
+                if self._chunks:
+                    chunk = self._chunks.popleft()
+                    self._cv.notify_all()  # wake bounded submitters
+                    return chunk
+                if self._buffered:
+                    now = time.monotonic()
+                    if deadline is None:
+                        deadline = now + self.flush_interval_s
+                    if now >= deadline:
+                        self._cut_locked(self._buffered)
+                        continue
+                    # CV wait releases the lock (flush-deadline sleep)
+                    # tpurace: disable-next-line=R003
+                    self._cv.wait(deadline - now)
+                else:
+                    deadline = None
+                    # CV wait releases the lock (idle work-arrival wait)
+                    # tpurace: disable-next-line=R003
+                    self._cv.wait(self.flush_interval_s)
+
+    def _stage(self, chunk: _Chunk):
+        """Async device_put of one chunk's columns (sharded over the data
+        axis) — accounted as STREAM staging (``jax.transfer.h2d_bytes.
+        stream``), never against a concurrently profiled query."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from geomesa_tpu.obs.jaxmon import count_h2d
+        from geomesa_tpu.parallel.mesh import DATA_AXIS
+
+        nbytes = count_h2d(*chunk.cols, label="stream")
+        sh = NamedSharding(self.matrix.mesh, P(DATA_AXIS))
+        dev = tuple(jax.device_put(a, sh) for a in chunk.cols)
+        with self._lock:
+            self._stats["h2d_bytes"] += nbytes
+        return dev + (jnp.int32(chunk.rows),), chunk
+
+    def _drop_failed(self, chunk: _Chunk) -> None:
+        """A chunk whose staging/scan/delivery raised: count it, mark its
+        rows scanned (drain must terminate; one poisoned chunk must not
+        wedge the pipeline), and keep the scan thread ALIVE — a dead scan
+        thread would silently stop every standing query of the topic, the
+        same failure mode the tailer's swallowed callbacks had."""
+        from geomesa_tpu.obs import jaxmon
+
+        jaxmon.registry().counter("stream.scan_errors").inc()
+        telemetry.note_scan_error(self.topic)
+        with self._lock:
+            self._stats["scan_errors"] += 1
+        # _cv wraps the same lock; separate block so progress counters and
+        # the stats table each sit under their canonical guard
+        with self._cv:
+            self._chunks_scanned += 1
+            self._rows_scanned += chunk.rows
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        import jax
+
+        pending = None  # staged (device cols, chunk) for the NEXT scan
+        while True:
+            if pending is None:
+                chunk = self._next_chunk()
+                if chunk is None:
+                    break
+                try:
+                    pending = self._stage(chunk)
+                except Exception:  # noqa: BLE001 — scan thread must live
+                    self._drop_failed(chunk)
+                    continue
+            staged, chunk = pending
+            pending = None
+            # prefetch: stage the following chunk BEHIND this chunk's scan
+            # (the double buffer — transfer overlaps compute)
+            nxt = None
+            with self._cv:
+                if self._chunks:
+                    nxt = self._chunks.popleft()
+                    self._cv.notify_all()
+            if nxt is not None:
+                try:
+                    pending = self._stage(nxt)
+                except Exception:  # noqa: BLE001
+                    self._drop_failed(nxt)
+            try:
+                t0 = time.perf_counter()
+                snap = self.matrix.snapshot()
+                counts, pos = self.matrix.scan_chunk(snap, *staged)
+                scan_s = time.perf_counter() - t0
+                wait_s = 0.0
+                if pending is not None:
+                    t1 = time.perf_counter()
+                    jax.block_until_ready(pending[0])  # ALL columns
+                    wait_s = time.perf_counter() - t1
+                self._deliver(snap, counts, pos, chunk)
+            except Exception:  # noqa: BLE001 — scan thread must live
+                self._drop_failed(chunk)
+                continue
+            with self._cv:
+                self._chunks_scanned += 1
+                self._rows_scanned += chunk.rows
+                st = self._stats
+                st["chunks"] += 1
+                st["rows"] += chunk.rows
+                st["transfer_wait_s"] += wait_s
+                st["scan_s"] += scan_s
+                lag = self._rows_in - self._rows_scanned
+                self._cv.notify_all()
+            telemetry.note_scan(
+                self.topic, chunk.rows, wait_s,
+                int(np.sum([c.nbytes for c in chunk.cols])),
+            )
+            telemetry.set_scan_lag(self.topic, lag)
+        # drop any un-scanned work deterministically on shutdown
+        with self._cv:
+            self._chunks.clear()
+            self._frags = []
+            self._buffered = 0
+            self._cv.notify_all()
+
+    def _deliver(self, snap, counts, pos, chunk: _Chunk) -> None:
+        """Per-subscription hit delivery for one chunk: count delta + the
+        newest-match position sample (+ row tags when kept). Wide rows
+        (extended geometries, x/y = -1 device sentinel) refine host-side
+        here — envelope overlap against each subscription's packed payload
+        — and fold into the same delivery. Callback errors are counted,
+        never propagated — one bad consumer must not stall the pipeline
+        (same posture as the journal tailer)."""
+        wide: dict[int, np.ndarray] = {}  # sid → matched wide local idxs
+        if chunk.env:
+            env = np.asarray(chunk.env, dtype=np.int64)
+            idx = env[:, 0]
+            ex1, ex2, ey1, ey2 = env[:, 1], env[:, 2], env[:, 3], env[:, 4]
+            wb = chunk.cols[2][idx].astype(np.int64)
+            wo = chunk.cols[3][idx].astype(np.int64)
+            for sid, sub in snap.subs.items():
+                m = envelope_hits(sub.boxes, sub.times,
+                                  ex1, ex2, ey1, ey2, wb, wo)
+                if m.any():
+                    wide[sid] = idx[m]
+        delivered = 0
+        for slot, sid in enumerate(snap.sids):
+            if sid is None:
+                continue
+            c = int(counts[slot])
+            ex = wide.get(sid)
+            if ex is not None:
+                c += len(ex)
+            if c == 0:
+                continue
+            sub = snap.subs[sid]
+            local = merge_positions(pos[slot], self.matrix.topk)
+            # int64 BEFORE adding base: global stream positions outlive
+            # int32 after ~2.1B accepted rows
+            local = local.astype(np.int64)
+            if ex is not None:
+                local = np.sort(np.concatenate(
+                    [local, ex]
+                ))[::-1][: self.matrix.topk]
+            tags = None
+            if chunk.tags is not None:
+                tags = [chunk.tags[int(p)] for p in local]
+            total = self._totals.get(sid, 0) + c
+            self._totals[sid] = total
+            batch = HitBatch(
+                sid=sid, predicate=sub.predicate, count=c, total=total,
+                positions=np.int64(chunk.base) + local, tags=tags,
+                chunk=chunk.seq, base=chunk.base, rows=chunk.rows,
+            )
+            try:
+                sub.callback(batch)
+                delivered += 1
+            except Exception:  # noqa: BLE001 — one bad consumer
+                from geomesa_tpu.obs import jaxmon
+
+                jaxmon.registry().counter("stream.callback_errors").inc()
+                telemetry.note_callback_error(self.topic)
+                with self._lock:
+                    self._stats["callback_errors"] += 1
+        if delivered:
+            with self._lock:
+                self._stats["deliveries"] += delivered
+            telemetry.note_deliveries(self.topic, delivered)
+
+    # -- introspection / lifecycle -------------------------------------------
+    def total(self, sid: int) -> int:
+        """Cumulative matches delivered to one subscription."""
+        return self._totals.get(sid, 0)
+
+    def lag(self) -> int:
+        """Rows accepted but not yet scanned (the backpressure signal)."""
+        with self._lock:
+            return self._rows_in - self._rows_scanned
+
+    def rows_in(self) -> int:
+        with self._lock:
+            return self._rows_in
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Flush the partial buffer and block until every accepted row has
+        been scanned and delivered."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            if self._buffered:
+                self._cut_locked(self._buffered)
+                self._cv.notify_all()
+            while self._rows_scanned < self._rows_in:
+                if self._stop.is_set():
+                    return False
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                # CV wait releases the lock (drain rendezvous)
+                # tpurace: disable-next-line=R003
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    def close(self) -> None:
+        """Deterministic idempotent shutdown: stop after the in-flight
+        chunk, join the scan thread, reject further submissions. Call
+        :meth:`drain` first for a graceful flush."""
+        with self._cv:
+            if self._closed:
+                already = True
+            else:
+                already = False
+                self._closed = True
+                self._stop.set()
+            self._cv.notify_all()
+        if not already:
+            self._thread.join(timeout=10.0)
+
+
+class SubscriptionHub:
+    """Bus-topic → scanner bridge: decode, normalize, batch, scan.
+
+    One hub per (topic, feature type). ``ingest`` is the bus subscriber
+    callback: ``Put`` messages become int-domain scan rows ((lon, lat)
+    normalized exactly like ``TpuBackend._payload``'s query side, dtg →
+    (bin, offset) via the type's Z3 interval); ``Delete``/``Clear`` are
+    ignored — standing queries watch the APPEND stream. Deliveries carry
+    fid tags for the sampled positions."""
+
+    def __init__(self, sft, serializer, topic: str, mesh=None,
+                 chunk_rows: int = 8192, topk: int = 64,
+                 box_slots: int = 2, time_slots: int = 2,
+                 flush_interval_s: float = 0.05,
+                 max_pending_chunks: int = 2):
+        from geomesa_tpu.curve.binned_time import BinnedTime
+        from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
+
+        self.sft = sft
+        self.serializer = serializer
+        self.topic = topic
+        self.matrix = SubscriptionMatrix(
+            sft, mesh=mesh, box_slots=box_slots, time_slots=time_slots,
+            topk=topk,
+        )
+        self.scanner = DeviceStreamScanner(
+            self.matrix, chunk_rows=chunk_rows,
+            max_pending_chunks=max_pending_chunks,
+            flush_interval_s=flush_interval_s, topic=topic,
+        )
+        self._binned = BinnedTime(sft.z3_interval)
+        self._nlon = norm_lon(31)
+        self._nlat = norm_lat(31)
+        self._rows_ingested = 0
+
+    def ingest(self, data: bytes) -> None:
+        from geomesa_tpu.stream.messages import Put
+
+        if self.matrix.active_count() == 0:
+            # no standing queries: don't pay decode + normalize + chunk +
+            # device scan per row against an all-masked matrix. Rows
+            # appended in this window deliver to nobody either way — a
+            # subscription added later only sees subsequent chunks (the
+            # snapshot contract), so dropping here is observably identical.
+            return
+        msg = self.serializer.deserialize(data)
+        if not isinstance(msg, Put):
+            return
+        geom = (
+            msg.record.get(self.sft.geom_field)
+            if self.sft.geom_field else None
+        )
+        if geom is None:
+            return  # nothing to match spatially; standing queries are spatial
+        x1, y1, x2, y2 = geom.bbox
+        ms = msg.record.get(self.sft.dtg_field) if self.sft.dtg_field else None
+        if not isinstance(ms, (int, float)):
+            ms = msg.ts
+        bins, offs = self._binned.to_bin_and_offset(
+            np.array([int(ms)], np.int64)
+        )
+        ix1 = int(self._nlon.normalize(x1))
+        iy1 = int(self._nlat.normalize(y1))
+        if x1 == x2 and y1 == y2:
+            # point: the device containment kernel is exact for it
+            env = None
+        else:
+            # extended geometry: its envelope may straddle a query box its
+            # center never enters — route through the wide-row host refine
+            # (bbox overlap, matrix.envelope_hit), not center containment
+            env = [(ix1, int(self._nlon.normalize(x2)),
+                    iy1, int(self._nlat.normalize(y2)))]
+        self.scanner.submit_rows(
+            np.array([ix1], np.int32),
+            np.array([iy1], np.int32),
+            bins.astype(np.int32), offs.astype(np.int32),
+            tags=[msg.fid],
+            envelopes=env,
+        )
+        self._rows_ingested += 1
+
+    # -- delegation -----------------------------------------------------------
+    def subscribe(self, predicate, callback) -> int:
+        return self.matrix.subscribe(predicate, callback)
+
+    def unsubscribe(self, sid: int) -> bool:
+        return self.matrix.unsubscribe(sid)
+
+    def rows_ingested(self) -> int:
+        return self._rows_ingested
+
+    def lag(self) -> int:
+        return self.scanner.lag()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        return self.scanner.drain(timeout_s)
+
+    def close(self) -> None:
+        self.scanner.close()
+
+
+class HubRegistry:
+    """Key → :class:`SubscriptionHub` table shared by the standing-query
+    front doors (``StreamingDataStore.subscribe_query``,
+    ``JournalBus.subscribe_query``) so their lifecycle logic cannot drift.
+
+    It owns the one ORDERING rule both callers must obey: the standing
+    query registers on the hub's matrix BEFORE ``attach`` wires the hub's
+    ``ingest`` onto the bus — bus registration synchronously replays the
+    topic backlog, and a replay into an empty matrix would silently drop
+    every historical match. The inverse ordering is enforced for every
+    LATER subscriber: it waits for the first subscriber's ``attach`` to
+    finish (the per-key ``armed`` event) before registering its matrix
+    row, or a thread landing between the table insert and the replay
+    would receive the backlog the first-subscription-only contract says
+    it must not see. ``_lock`` is a LEAF guarding only the tables
+    (docs/concurrency.md); hub construction spawns a scan thread,
+    ``attach`` may join a draining tailer, and the armed wait blocks —
+    all run strictly outside it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hubs: dict[str, SubscriptionHub] = {}
+        self._cfgs: dict[str, dict] = {}
+        self._armed: dict[str, threading.Event] = {}
+        self._detaches: dict[str, object] = {}
+
+    def subscribe(self, key: str, predicate, callback, make_hub,
+                  attach, cfg: dict | None = None) -> int:
+        """``attach(hub)`` wires ``hub.ingest`` onto the caller's bus and
+        may return a detach callable — ``close_all`` invokes it so a
+        shared or reused bus stops feeding the closed scanner."""
+        cfg = cfg or {}
+        with self._lock:
+            hub = self._hubs.get(key)
+            armed = self._armed.get(key)
+        fresh = False
+        if hub is None:
+            # hub construction OUTSIDE the lock (it spawns a scan thread
+            # and may initialize the device mesh); a concurrent first
+            # subscriber may win the table race — the loser's hub closes
+            candidate = make_hub()
+            with self._lock:
+                hub = self._hubs.get(key)
+                if hub is None:
+                    self._hubs[key] = hub = candidate
+                    self._cfgs[key] = cfg
+                    self._armed[key] = armed = threading.Event()
+                    fresh = True
+                else:
+                    armed = self._armed[key]
+            if not fresh:
+                candidate.close()
+        if not fresh:
+            with self._lock:
+                existing = self._cfgs.get(key, {})
+            if cfg and cfg != existing:
+                # the hub is built once per key; silently dropping a LATER
+                # subscriber's different chunk/flush config would hand it
+                # the first subscriber's delivery cadence without warning
+                raise ValueError(
+                    f"hub for {key!r} already configured with "
+                    f"{existing!r}; differing hub_cfg {cfg!r} "
+                    "applies only to the first subscription"
+                )
+            # wait (outside every lock) until the first subscriber's
+            # attach has replayed the backlog — registering before it
+            # would deliver the backlog to this subscription too
+            armed.wait()
+            with self._lock:
+                live = self._hubs.get(key) is hub
+            if not live:
+                # the first subscriber failed and rolled the hub back
+                # (its armed.set() released this wait) — become the
+                # first subscriber of a fresh hub instead
+                return self.subscribe(key, predicate, callback, make_hub,
+                                      attach, cfg)
+            return hub.subscribe(predicate, callback)
+        try:
+            sid = hub.subscribe(predicate, callback)
+            detach = attach(hub)  # replays the backlog — matrix armed above
+        except BaseException:
+            # roll the table back so the key is retryable — and set the
+            # armed event so a concurrent waiter re-checks instead of
+            # blocking on a hub that will never attach
+            with self._lock:
+                if self._hubs.get(key) is hub:
+                    del self._hubs[key]
+                    self._cfgs.pop(key, None)
+                    self._armed.pop(key, None)
+            armed.set()
+            hub.close()
+            raise
+        armed.set()
+        if detach is not None:
+            with self._lock:
+                self._detaches[key] = detach
+        return sid
+
+    def unsubscribe(self, key: str, sid: int) -> bool:
+        with self._lock:
+            hub = self._hubs.get(key)
+        return hub.unsubscribe(sid) if hub is not None else False
+
+    def get(self, key: str):
+        with self._lock:
+            return self._hubs.get(key)
+
+    def close_all(self) -> None:
+        with self._lock:
+            hubs = list(self._hubs.values())
+            detaches = list(self._detaches.values())
+            self._hubs.clear()
+            self._cfgs.clear()
+            self._armed.clear()
+            self._detaches.clear()
+        for detach in detaches:
+            # stop the bus feeding first: a shared/reused bus would
+            # otherwise decode + normalize every record into a dead
+            # scanner forever (and stack a second ingest beside it on
+            # the next subscribe_query)
+            detach()
+        for hub in hubs:
+            hub.close()

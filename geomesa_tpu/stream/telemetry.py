@@ -1,0 +1,208 @@
+"""Process-wide streaming telemetry: per-topic lag / poll / scan gauges.
+
+The streaming tier runs on background threads (journal tailer, consumer
+groups, the device stream scanner) whose health is invisible to the
+request path — this module is the one place they all report into, and
+the web layer renders it on ``/api/metrics`` (JSON ``stream`` section)
+and ``/api/metrics?format=prometheus``:
+
+- ``geomesa_stream_lag{topic}`` — unconsumed bus messages behind the
+  head (consumer groups / the journal tailer).
+- ``geomesa_stream_scan_lag{topic}`` — rows the device scanner has
+  accepted but not yet scanned. A SEPARATE gauge from ``lag``: the
+  consumer and the scanner poll the same topic string, and one shared
+  key would let an idle consumer's 0 overwrite a saturated scanner's
+  backlog (the backpressure signal; docs/streaming.md § Backpressure).
+- ``geomesa_stream_polls_total{topic,loop}`` /
+  ``geomesa_stream_poll_rows_total{topic,loop}`` — poll-rate counters,
+  labeled per polling LOOP (``consumer`` / ``tailer``): both loops poll
+  the same topic string, and one shared key would double-count every
+  record and make the rate read 2× the real throughput.
+- ``geomesa_stream_poll_backoff_seconds{topic,loop}`` — the CURRENT idle
+  backoff (0 under traffic; grows toward the cap while idle — the
+  adaptive-backoff health check). Per loop for the same reason: a busy
+  consumer must not zero the gauge of an idle tailer (last-writer-wins
+  flapping would defeat the runbook's "at the cap means quiet" rule).
+- ``geomesa_stream_callback_errors_total{topic}`` — subscriber callbacks
+  that raised (mirrors the ``stream.callback_errors`` registry counter).
+- ``geomesa_stream_scan_errors_total{topic}`` — chunks dropped because
+  staging/scan/delivery raised (the scan thread stays alive; mirrors
+  ``stream.scan_errors``).
+- ``geomesa_stream_scan_rows_total`` / ``_scan_chunks_total`` /
+  ``_transfer_wait_seconds_total`` / ``_h2d_bytes_total`` /
+  ``_deliveries_total`` — the device scanner's pipeline accounting.
+
+One leaf lock guards the table; nothing is called while it is held
+(docs/concurrency.md).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "set_lag", "set_scan_lag", "note_poll", "note_callback_error",
+    "note_scan", "note_scan_error", "note_deliveries", "report",
+    "prometheus_lines", "prometheus_text", "reset",
+]
+
+_lock = threading.Lock()
+_topics: dict[str, dict] = {}
+
+_ZERO = {
+    "lag": 0, "scan_lag": 0, "callback_errors": 0, "scan_chunks": 0,
+    "scan_rows": 0, "transfer_wait_s": 0.0, "h2d_bytes": 0,
+    "deliveries": 0, "scan_errors": 0,
+}
+_POLL_ZERO = {"polls": 0, "poll_rows": 0, "poll_backoff_s": 0.0}
+
+
+def _t(topic: str) -> dict:
+    st = _topics.get(topic)
+    if st is None:
+        st = dict(_ZERO)
+        st["poll_loops"] = {}
+        _topics[topic] = st
+    return st
+
+
+def _loop(st: dict, loop: str) -> dict:
+    ls = st["poll_loops"].get(loop)
+    if ls is None:
+        ls = dict(_POLL_ZERO)
+        st["poll_loops"][loop] = ls
+    return ls
+
+
+def set_lag(topic: str, lag: int) -> None:
+    """Bus-side lag: unconsumed messages (consumer groups, tailer)."""
+    with _lock:
+        _t(topic)["lag"] = int(lag)
+
+
+def set_scan_lag(topic: str, lag: int) -> None:
+    """Scanner-side lag: rows accepted but not yet scanned."""
+    with _lock:
+        _t(topic)["scan_lag"] = int(lag)
+
+
+def note_poll(topic: str, drained: int, backoff_s: float = 0.0,
+              loop: str = "consumer") -> None:
+    """One poll round of one polling ``loop`` (``consumer``/``tailer``):
+    ``drained`` rows dispatched, ``backoff_s`` the idle delay chosen for
+    the NEXT round (0 under traffic)."""
+    with _lock:
+        ls = _loop(_t(topic), loop)
+        ls["polls"] += 1
+        ls["poll_rows"] += int(drained)
+        ls["poll_backoff_s"] = float(backoff_s)
+
+
+def note_callback_error(topic: str) -> None:
+    with _lock:
+        _t(topic)["callback_errors"] += 1
+
+
+def note_scan(topic: str, rows: int, transfer_wait_s: float,
+              h2d_bytes: int) -> None:
+    with _lock:
+        st = _t(topic)
+        st["scan_chunks"] += 1
+        st["scan_rows"] += int(rows)
+        st["transfer_wait_s"] += float(transfer_wait_s)
+        st["h2d_bytes"] += int(h2d_bytes)
+
+
+def note_scan_error(topic: str) -> None:
+    """A chunk whose staging/scan/delivery raised — dropped, rows marked
+    scanned, the scan thread stays alive."""
+    with _lock:
+        _t(topic)["scan_errors"] += 1
+
+
+def note_deliveries(topic: str, n: int) -> None:
+    with _lock:
+        _t(topic)["deliveries"] += int(n)
+
+
+def report() -> dict:
+    """Snapshot of every topic's stream gauges (the JSON metrics block).
+    Poll stats come back per loop under ``poll_loops`` plus flat compat
+    aggregates: ``polls``/``poll_rows`` sum over loops, ``poll_backoff_s``
+    is the max (an idle loop's backoff must not be masked by a busy one)."""
+    with _lock:
+        out = {}
+        for topic, st in _topics.items():
+            d = {k: v for k, v in st.items() if k != "poll_loops"}
+            loops = {lp: dict(ls) for lp, ls in st["poll_loops"].items()}
+            d["poll_loops"] = loops
+            d["polls"] = sum(ls["polls"] for ls in loops.values())
+            d["poll_rows"] = sum(ls["poll_rows"] for ls in loops.values())
+            d["poll_backoff_s"] = max(
+                (ls["poll_backoff_s"] for ls in loops.values()), default=0.0
+            )
+            out[topic] = d
+        return out
+
+
+def reset() -> None:
+    """Drop all state (tests)."""
+    with _lock:
+        _topics.clear()
+
+
+def _esc(label: str) -> str:
+    return label.replace("\\", "\\\\").replace('"', '\\"')
+
+
+_PROM = [
+    ("lag", "geomesa_stream_lag", "gauge"),
+    ("scan_lag", "geomesa_stream_scan_lag", "gauge"),
+    ("callback_errors", "geomesa_stream_callback_errors_total", "counter"),
+    ("scan_chunks", "geomesa_stream_scan_chunks_total", "counter"),
+    ("scan_rows", "geomesa_stream_scan_rows_total", "counter"),
+    ("transfer_wait_s", "geomesa_stream_transfer_wait_seconds_total",
+     "counter"),
+    ("h2d_bytes", "geomesa_stream_h2d_bytes_total", "counter"),
+    ("deliveries", "geomesa_stream_deliveries_total", "counter"),
+    ("scan_errors", "geomesa_stream_scan_errors_total", "counter"),
+]
+
+
+_PROM_POLL = [
+    ("polls", "geomesa_stream_polls_total", "counter"),
+    ("poll_rows", "geomesa_stream_poll_rows_total", "counter"),
+    ("poll_backoff_s", "geomesa_stream_poll_backoff_seconds", "gauge"),
+]
+
+
+def prometheus_lines() -> list[str]:
+    snap = report()
+    if not snap:
+        return []
+    lines: list[str] = []
+    for key, name, kind in _PROM:
+        lines.append(f"# TYPE {name} {kind}")
+        for topic in sorted(snap):
+            v = snap[topic][key]
+            lines.append(f'{name}{{topic="{_esc(topic)}"}} {v}')
+    # poll metrics carry the polling-loop label (consumer vs tailer poll
+    # the SAME topic — one shared series would double-count throughput
+    # and flap the backoff gauge between unrelated loops)
+    for key, name, kind in _PROM_POLL:
+        emitted_type = False
+        for topic in sorted(snap):
+            for loop in sorted(snap[topic]["poll_loops"]):
+                if not emitted_type:
+                    lines.append(f"# TYPE {name} {kind}")
+                    emitted_type = True
+                v = snap[topic]["poll_loops"][loop][key]
+                lines.append(
+                    f'{name}{{topic="{_esc(topic)}",loop="{_esc(loop)}"}} {v}'
+                )
+    return lines
+
+
+def prometheus_text() -> str:
+    lines = prometheus_lines()
+    return "\n".join(lines) + "\n" if lines else ""

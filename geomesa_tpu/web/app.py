@@ -966,6 +966,11 @@ class GeoMesaApp:
             cache_lines = getattr(self.store, "cache_prometheus_lines", None)
             if cache_lines is not None:
                 text += "\n".join(cache_lines()) + "\n"
+            # streaming tier: per-topic lag / poll-rate / scanner pipeline
+            # gauges (geomesa_stream_lag{topic} is the backpressure signal)
+            from geomesa_tpu.stream import telemetry as stream_telemetry
+
+            text += stream_telemetry.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -986,6 +991,13 @@ class GeoMesaApp:
         health = getattr(self.store, "member_health", None)
         if health is not None:
             out["federation_members"] = health()
+        # streaming tier: per-topic lag/poll/scan gauges (empty dict when
+        # no stream threads have reported)
+        from geomesa_tpu.stream import telemetry as stream_telemetry
+
+        stream_report = stream_telemetry.report()
+        if stream_report:
+            out["stream"] = stream_report
         return 200, out, "application/json"
 
     def _ogc(self, handler, error_cls, params):

@@ -32,8 +32,11 @@ export GEOMESA_BENCH_REGRESS_K="${GEOMESA_BENCH_REGRESS_K:-2}"
 # config 9 rides the gate as the grouped-aggregation PARITY leg: its
 # pyramid-vs-f64-fold, warm-cache-byte-identity, and fused-step parity
 # flags all gate (a parity loss on a fresh run always fails, regardless
-# of speed) — the 0.16x path of BENCH_r05 can never silently regress again
-export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,9}"
+# of speed) — the 0.16x path of BENCH_r05 can never silently regress again.
+# Config 8 rides it as the STREAMING parity leg (ISSUE 8): the
+# subscription-matrix product path's straight-XLA referee parity and the
+# journal-tier delivery parity both gate every run.
+export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,8,9}"
 
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT

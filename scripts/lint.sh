@@ -48,10 +48,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_devmon.py -q
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_bufferpool.py tests/test_geoblocks.py -q
 
+# subscription-matrix gate (ISSUE 8): fused-matrix counts byte-equal to
+# the per-query referee across bucket growth/shrink, zero recompiles on
+# the steady path (jaxmon census), add/remove under concurrent appends
+# with no missed/duplicated deliveries, and the stream-labeled h2d
+# attribution split. See docs/streaming.md.
+JAX_PLATFORMS=cpu python -m pytest tests/test_stream_matrix.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
-# rides it as the grouped-aggregation parity leg.
+# rides it as the grouped-aggregation parity leg; config 8 as the
+# streaming (subscription-matrix product path) parity leg.
 scripts/bench_gate.sh
 
 # tpurace dynamic prong: the Eraser-style lock-order sanitizer wraps every
@@ -63,7 +71,8 @@ scripts/bench_gate.sh
 GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
-    tests/test_geoblocks.py tests/test_bufferpool.py -q
+    tests/test_geoblocks.py tests/test_bufferpool.py \
+    tests/test_stream_matrix.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and
