@@ -492,6 +492,21 @@ def cmd_obs_costs(args):
               f"{e['profiled']:>5d} {e['wall_ms_p50']:>9.2f} "
               f"{e['wall_ms_p95']:>9.2f} {e['device_ms_p50']:>8.2f} "
               f"{e['rows_p50']:>9.1f} {int(e['bytes_scanned_p50']):>11d}")
+    cal = doc.get("calibration") or {}
+    rows = cal.get("entries", [])
+    if rows:
+        overall = cal.get("overall_mean_abs_rel_err")
+        print(f"\ncalibration (predicted vs actual): {len(rows)} plan "
+              f"shapes, overall MAPE "
+              + (f"{overall:.1%}" if overall is not None else "n/a"))
+        print(f"{'type':<14s} {'signature':<28s} {'n':>6s} {'MAPE':>7s} "
+              f"{'bias':>7s} {'last pred':>10s} {'last act':>10s}")
+        for e in rows:
+            print(f"{e['type']:<14s} {e['signature']:<28s} "
+                  f"{e['count']:>6d} {e['mean_abs_rel_err']:>6.1%} "
+                  f"{e['mean_signed_rel_err']:>+6.1%} "
+                  f"{e['last_predicted_ms']:>10.2f} "
+                  f"{e['last_actual_ms']:>10.2f}")
 
 
 def main(argv=None):

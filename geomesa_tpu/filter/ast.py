@@ -611,3 +611,88 @@ def to_cql(f: Filter) -> str:
         vals = ", ".join(_cql_literal(v) for v in f.fids)
         return f"IN ({vals})"
     raise ValueError(f"cannot render {type(f).__name__} to CQL")
+
+
+# ---------------------------------------------------------------------------
+# residual evaluation on candidate rows (the refine hot path)
+# ---------------------------------------------------------------------------
+
+# leaf nodes whose mask() reads exactly table.columns[node.prop]
+_PROP_LEAVES = (
+    BBox, SpatialOp, During, TempOp, Compare, Between, In, Like, IsNull,
+    FuncCompare, JsonPathCompare,
+)
+
+
+def column_refs(f: Filter) -> tuple[set, bool, bool]:
+    """``(props, uses_fids, opaque)`` — the attribute columns ``f.mask()``
+    reads, whether it reads ``table.fids``, and True when the tree holds a
+    node this walker doesn't know (the caller must materialize the full
+    table). The contract every :data:`_PROP_LEAVES` node upholds: its mask
+    touches ``table.columns[self.prop]`` and nothing else."""
+    props: set = set()
+    fids = False
+    opaque = False
+
+    def walk(n):
+        nonlocal fids, opaque
+        if isinstance(n, (Include, Exclude)):
+            return
+        if isinstance(n, (And, Or)):
+            for c in n.children:
+                walk(c)
+        elif isinstance(n, Not):
+            walk(n.child)
+        elif isinstance(n, FidIn):
+            fids = True
+        elif isinstance(n, _PROP_LEAVES):
+            props.add(n.prop)
+        else:
+            opaque = True
+
+    walk(f)
+    return props, fids, opaque
+
+
+def _residual_take(col, idx):
+    """Column slice for residual evaluation: point-geometry columns take
+    coordinates/bounds WITHOUT gathering the lazy object array (a 14k-row
+    object fancy-index costs more than the whole mask; ``geometries()``
+    rebuilds Points from x/y if some later consumer asks)."""
+    if isinstance(col, GeometryColumn) and col.x is not None:
+        return GeometryColumn(
+            col.type,
+            None,
+            None if col.valid is None else col.valid[idx],
+            x=col.x[idx],
+            y=col.y[idx],
+            bounds=None if col.bounds is None else col.bounds[idx],
+        )
+    return col.take(idx)
+
+
+def residual_mask(f: Filter, table: FeatureTable, rows: np.ndarray) -> np.ndarray:
+    """``f.mask(table.take(rows))`` without materializing columns the
+    filter never reads — byte-identical result (pinned in
+    ``tests/test_costmodel.py``), a fraction of the cost on wide tables:
+    the full ``take`` gathers every column (object fids included) only for
+    the mask to read two of them. Unknown filter nodes fall back to the
+    full take, so third-party Filter subclasses stay correct."""
+    rows = np.asarray(rows)
+    if isinstance(f, Include):
+        return np.ones(len(rows), dtype=bool)
+    if isinstance(f, Exclude):
+        return np.zeros(len(rows), dtype=bool)
+    props, fids, opaque = column_refs(f)
+    if opaque:
+        return np.asarray(f.mask(table.take(rows)), dtype=bool)
+    cols = {p: _residual_take(table.columns[p], rows) for p in props
+            if p in table.columns}
+    if len(cols) < len(props):
+        # unknown column: surface the same KeyError the full path raises
+        return np.asarray(f.mask(table.take(rows)), dtype=bool)
+    sub_fids = (
+        table.fids[rows] if fids else np.empty(len(rows), dtype=object)
+    )
+    sub = FeatureTable(table.sft, sub_fids, cols)
+    return np.asarray(f.mask(sub), dtype=bool)

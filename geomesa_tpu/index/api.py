@@ -13,7 +13,7 @@ candidate slots, not a BatchScanner RPC.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import ClassVar
 
 import numpy as np
@@ -33,10 +33,20 @@ class IndexPlan:
     ``exact``: True when interval membership alone implies a filter match for
     the *primary* predicate (no z false positives — e.g. full-domain scans);
     the full residual filter is applied downstream regardless.
+
+    ``exec_cache``: backend-owned dispatch-payload memo. Plans live in the
+    store's plan cache and repeat verbatim for repeated filters; the TPU
+    backend stashes the derived per-shard split and the staged device
+    payloads here (keyed by layout shape) so the cached-plan path pays
+    ZERO host planning/staging per query. Entries are only valid for a
+    layout with the same (rows_per_shard, kind) — the key carries both —
+    and the plan cache itself is dropped on every state swap, so a stale
+    payload can never pair with fresh residency. Excluded from equality.
     """
 
     intervals: np.ndarray
     exact: bool = False
+    exec_cache: dict = field(default_factory=dict, compare=False, repr=False)
 
     @property
     def n_candidates(self) -> int:

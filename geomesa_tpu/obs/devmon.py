@@ -432,14 +432,22 @@ class _Quantiles:
     NOT thread-safe on its own: the owning :class:`CostTable` lock guards
     every update/read."""
 
-    __slots__ = ("count", "total", "_res", "_rng")
+    __slots__ = ("count", "total", "_res", "_rng", "_qcache")
     SIZE = 256
+    # above this many observations, quantiles serve from a cache refreshed
+    # every CACHE_DELTA updates — predict() rides the per-query audit hot
+    # path (the adaptive planner consults it every dispatch) and a fresh
+    # reservoir sort per call would erode the <2% overhead bound. Below
+    # the threshold quantiles stay exact (small-sample tests pin values).
+    CACHE_MIN = 64
+    CACHE_DELTA = 16
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self._res: list[float] = []
         self._rng = random.Random(0x5DEECE66D)
+        self._qcache: dict[float, tuple[int, float]] = {}
 
     def update(self, v: float) -> None:
         self.count += 1
@@ -454,12 +462,18 @@ class _Quantiles:
     def quantile(self, q: float) -> float:
         if not self._res:
             return 0.0
+        hit = self._qcache.get(q)
+        if (hit is not None and self.count > self.CACHE_MIN
+                and self.count - hit[0] < self.CACHE_DELTA):
+            return hit[1]
         s = sorted(self._res)
         pos = q * (len(s) - 1)
         lo = int(pos)
         hi = min(lo + 1, len(s) - 1)
         frac = pos - lo
-        return s[lo] * (1.0 - frac) + s[hi] * frac
+        v = s[lo] * (1.0 - frac) + s[hi] * frac
+        self._qcache[q] = (self.count, v)
+        return v
 
 
 class _CostEntry:
@@ -547,11 +561,37 @@ class CostTable:
                 return None
             return {
                 "wall_ms_p50": round(e.wall_ms.quantile(0.5), 3),
+                "wall_ms_p95": round(e.wall_ms.quantile(0.95), 3),
                 "device_ms_p50": (
                     round(e.device_ms.quantile(0.5), 3)
                     if e.profiled_count else None
                 ),
                 "observations": e.count,
+            }
+
+    def predict_prefix(self, type_name: str, prefix: str) -> dict | None:
+        """Aggregated profile over every signature of one type starting
+        with ``prefix`` — how the adaptive planner reads STRATEGY-level
+        costs (audit signatures are ``index:ivN:agg``; the strategy
+        decision keys by ``index:`` alone). Observation-weighted means of
+        the per-signature p50/p95 (a strategy's profile is dominated by
+        the shapes it actually serves); None when nothing matches."""
+        with self._lock:
+            matched = [
+                e for (t, sig), e in self._entries.items()
+                if t == type_name and sig.startswith(prefix)
+            ]
+            if not matched:
+                return None
+            n = sum(e.count for e in matched)
+            p50 = sum(e.wall_ms.quantile(0.5) * e.count for e in matched) / n
+            p95 = sum(e.wall_ms.quantile(0.95) * e.count for e in matched) / n
+            return {
+                "wall_ms_p50": round(p50, 3),
+                "wall_ms_p95": round(p95, 3),
+                "device_ms_p50": None,
+                "observations": n,
+                "signatures": len(matched),
             }
 
     def snapshot(self, limit: int = 256) -> dict:

@@ -76,6 +76,19 @@ class QueryPlanInfo:
     # Extraction)] — when set, the scan is the union of these sub-scans and
     # ``index_name`` reads "union(...)"
     sub_plans: list = None
+    # adaptive-planner decision record (planning/costmodel.py): how the
+    # strategy was chosen ("cost-model" | "cost-model/slo" | "probe" |
+    # "stats" | "heuristic" | "forced" | "fid"), the model's predicted
+    # wall-ms for the winner (None before training), the stats row
+    # estimate, and every REJECTED alternative as {name, est_rows,
+    # observed_ms_p50, observations} — what explain() renders
+    strategy_source: str = ""
+    predicted_ms: float | None = None
+    est_rows: float | None = None
+    alternatives: list = None
+    # high-selectivity fast path: decomposition ran with the reduced range
+    # budget and the union search was skipped (CHEAP_SELECT_ROWS)
+    cheap: bool = False
 
     def explain(self) -> str:
         lines = [
@@ -86,6 +99,26 @@ class QueryPlanInfo:
             f"  Scan intervals: {self.n_intervals} covering {self.n_candidates} rows",
             f"  Planning time: {self.plan_ms:.2f} ms",
         ]
+        if self.strategy_source:
+            head = f"  Strategy: {self.strategy_source}"
+            if self.est_rows is not None:
+                head += f", estimated {self.est_rows:.0f} rows"
+            if self.predicted_ms is not None:
+                head += f", predicted {self.predicted_ms} ms p50"
+            if self.cheap:
+                head += " [cheap fast path]"
+            lines.append(head)
+        for alt in self.alternatives or []:
+            obs_txt = (
+                f"observed {alt['observed_ms_p50']} ms p50"
+                f" (n={alt['observations']})"
+                if alt.get("observed_ms_p50") is not None
+                else "no observations"
+            )
+            lines.append(
+                f"  Rejected: {alt['name']} ≈ {alt['est_rows']:.0f} rows, "
+                + obs_txt
+            )
         lines += [f"  Note: {n}" for n in self.notes]
         return "\n".join(lines)
 
@@ -109,6 +142,12 @@ class StrategyDecider:
     specificity heuristic (id > attr-equality > z3 > z2 > full scan) without
     stats. Attribute-index costs get a residual-work multiplier (the
     reference's join-cost penalty for reduced attribute indexes).
+
+    The static estimate is only the SEED: pass ``type_name`` and a
+    ``cost_model`` (:mod:`geomesa_tpu.planning.costmodel`) and the decision
+    upgrades to learned per-(type, index) observed costs once every
+    competing strategy is trained, with bounded probes of losing strategies
+    and SLO-aware tie-breaking (see docs/planning.md).
     """
 
     ATTR_COST_MULTIPLIER = 2.0
@@ -121,27 +160,101 @@ class StrategyDecider:
         hints: dict,
         stats=None,
         trace: list | None = None,
+        type_name: str | None = None,
+        cost_model=None,
+        under_burn: bool = False,
+        decision: dict | None = None,
     ) -> tuple[str, Any]:
         notes = trace if trace is not None else []
+        dec = decision if decision is not None else {}
+        dec.setdefault("alternatives", [])
         forced = hints.get("index")
         if forced:
             if forced not in indices:
                 raise ValueError(f"forced index {forced!r} not available")
             notes.append(f"index forced by hint: {forced}")
+            dec["source"] = "forced"
             return forced, None
         fids = _extract_fids(f)
         if fids is not None and "id" in indices:
+            dec["source"] = "fid"
             return "id", fids
         if stats is not None and stats.count > 0:
-            name = StrategyDecider._cost_based(indices, e, stats, notes)
-            if name is not None:
+            est = StrategyDecider.estimate_rows(indices, e, stats)
+            if est:
+                if cost_model is not None and type_name and len(est) > 1:
+                    name = StrategyDecider._model_based(
+                        est, type_name, cost_model, under_burn, notes, dec
+                    )
+                else:
+                    name = min(est.items(), key=lambda kv: kv[1])[0]
+                    ranked = ", ".join(
+                        f"{n}≈{c:.0f}"
+                        for n, c in sorted(est.items(), key=lambda kv: kv[1])
+                    )
+                    notes.append(
+                        f"cost-based (estimated rows): {ranked} → {name}")
+                    dec["source"] = "stats"
+                    dec["alternatives"] = [
+                        {"name": n, "est_rows": c, "observed_ms_p50": None,
+                         "observations": 0}
+                        for n, c in sorted(
+                            est.items(), key=lambda kv: kv[1])
+                        if n != name
+                    ]
+                dec["est_rows"] = est[name]
                 return name, None
         name = StrategyDecider._heuristic(indices, e)
         notes.append(f"heuristic choice (no usable stats): {name}")
+        dec["source"] = "heuristic"
         return name, None
 
     @staticmethod
-    def _cost_based(indices, e: Extraction, stats, notes: list | None = None) -> str | None:
+    def _model_based(est: dict, type_name: str, cost_model, under_burn: bool,
+                     notes: list, dec: dict) -> str:
+        """Rank strategies through the cost model: stats row estimates
+        seed the candidates (signature prefix = the index name, matching
+        every audit signature the strategy produced), learned p50 wall-ms
+        takes over once all candidates are trained, and the probe schedule
+        re-measures losers so no profile freezes."""
+        from geomesa_tpu.planning.costmodel import Candidate
+
+        cands = [
+            Candidate(n, f"{n}:", est_rows=r, prefix=True)
+            for n, r in est.items()
+        ]
+        win, ranked, source = cost_model.choose(
+            type_name, "strategy", cands, under_burn=under_burn
+        )
+        rank_txt = ", ".join(
+            f"{c.name}≈{c.predicted_ms}ms" if c.predicted_ms is not None
+            else f"{c.name}≈{c.est_rows:.0f}rows"
+            for c in ranked
+        )
+        notes.append(f"adaptive ({source}): {rank_txt} → {win.name}")
+        dec["source"] = source
+        dec["predicted_ms"] = win.predicted_ms
+        dec["alternatives"] = [
+            {
+                "name": c.name,
+                "est_rows": c.est_rows,
+                "observed_ms_p50": (
+                    c.observed.get("wall_ms_p50") if c.observed else None
+                ),
+                "observations": (
+                    c.observed.get("observations", 0) if c.observed else 0
+                ),
+            }
+            for c in ranked[1:]
+        ]
+        return win.name
+
+    @staticmethod
+    def estimate_rows(indices, e: Extraction, stats) -> dict[str, float]:
+        """Per-strategy stats row estimates (the ``CostBasedStrategyDecider``
+        table): every servable index → estimated matching rows, attribute
+        indexes penalized by :data:`ATTR_COST_MULTIPLIER`. Empty when no
+        index can be costed (caller falls back to the heuristic)."""
         costs: dict[str, float] = {}
         for name, index in indices.items():
             if name == "id":
@@ -181,15 +294,7 @@ class StrategyDecider:
                     continue  # can't serve
                 est = stats.estimate_attr(attr, bounds)
                 costs[name] = est * StrategyDecider.ATTR_COST_MULTIPLIER
-        if not costs:
-            return None
-        best = min(costs.items(), key=lambda kv: kv[1])[0]
-        if notes is not None:
-            ranked = ", ".join(
-                f"{n}≈{c:.0f}" for n, c in sorted(costs.items(), key=lambda kv: kv[1])
-            )
-            notes.append(f"cost-based (estimated rows): {ranked} → {best}")
-        return best
+        return costs
 
     @staticmethod
     def _heuristic(indices, e: Extraction) -> str:
@@ -218,21 +323,45 @@ def _z3_est_sfc(index):
     return z3_sfc(index.sft.z3_interval)
 
 
+# high-selectivity fast path (the bench-6 regression fix): when stats
+# estimate at most this many matching rows, decomposition runs with the
+# reduced range budget below and the union search is skipped outright —
+# planning cost scales with range count, and a query returning a few
+# thousand rows must not pay a 2000-range decomposition to save device
+# work it doesn't have (results are identical either way: coarser ranges
+# only widen the int-domain candidate superset the exact residual culls)
+CHEAP_SELECT_ROWS = 4096
+CHEAP_MAX_RANGES = 64
+
+
 class QueryPlanner:
-    """Plans one query over one feature type's built indexes."""
+    """Plans one query over one feature type's built indexes.
+
+    ``cost_model``: the adaptive cost model consulted for strategy choice
+    (default: the process singleton, :func:`geomesa_tpu.planning.costmodel.
+    model`); pass ``False`` to force the static stats-only decider (the
+    union-arm sub-planner does — per-arm probes would make union plans
+    nondeterministic)."""
 
     def __init__(
-        self, sft: FeatureType, indices: dict[str, FeatureIndex], stats=None
+        self, sft: FeatureType, indices: dict[str, FeatureIndex], stats=None,
+        cost_model=None,
     ):
         self.sft = sft
         self.indices = indices
         self.stats = stats
+        if cost_model is None:
+            from geomesa_tpu.planning import costmodel
+
+            cost_model = costmodel.model()
+        self.cost_model = cost_model or None  # False → None (static)
         self.indexed_attrs = tuple(
             name.split(":", 1)[1] for name in indices if name.startswith("attr:")
         )
 
     def plan(
-        self, q: Query, max_ranges: int = DEFAULT_MAX_RANGES
+        self, q: Query, max_ranges: int = DEFAULT_MAX_RANGES,
+        under_burn: bool = False,
     ) -> tuple[IndexPlan, ast.Filter, QueryPlanInfo]:
         t0 = time.perf_counter()
         f = q.resolved_filter()
@@ -243,13 +372,37 @@ class QueryPlanner:
         )
         e = coerce_attr_bounds(self.sft, e)
         notes: list[str] = []
+        dec: dict = {}
         name, fids = StrategyDecider.choose(
-            self.indices, e, f, q.hints, self.stats, trace=notes
+            self.indices, e, f, q.hints, self.stats, trace=notes,
+            type_name=self.sft.name, cost_model=self.cost_model,
+            under_burn=under_burn, decision=dec,
         )
         index = self.indices[name]
         for attr, bounds in e.attributes.items():
             if bounds is not None:
                 notes.append(f"attribute bounds: {attr} in {bounds}")
+        est_rows = dec.get("est_rows")
+        # cheap means SELECTIVE, not small-absolute: a tiny store's full
+        # scan estimates under the row threshold but deserves the whole
+        # machinery; and a top-level OR keeps the union search — that IS
+        # the machinery built for it (a cross-attribute OR's single-index
+        # plan can be a full scan the union beats by orders of magnitude)
+        cheap = (
+            fids is None
+            and not isinstance(f, ast.Or)
+            and est_rows is not None
+            and est_rows <= CHEAP_SELECT_ROWS
+            and self.stats is not None
+            and est_rows <= 0.25 * max(self.stats.count, 1)
+        )
+        if cheap:
+            max_ranges = min(max_ranges, CHEAP_MAX_RANGES)
+            notes.append(
+                f"cheap fast path: ≈{est_rows:.0f} rows ≤ "
+                f"{CHEAP_SELECT_ROWS} — range budget {max_ranges}, "
+                "union search skipped"
+            )
         with obs.span("decompose", index=name):
             if fids is not None and isinstance(index, IdIndex):
                 plan = index.plan_fids(fids)
@@ -261,7 +414,7 @@ class QueryPlanner:
         # arms each bind a DIFFERENT index (e.g. cross-attribute ORs) can run
         # as a union of tight scans instead of one loose/full scan — taken
         # when the combined sub-scan candidates undercut the single plan
-        if "index" not in q.hints:
+        if "index" not in q.hints and not cheap:
             union = self._union_plans(f, max_ranges, notes)
             if union is not None:
                 union_cand = sum(p.n_candidates for _, p, _ in union)
@@ -284,6 +437,9 @@ class QueryPlanner:
                         plan_ms=(time.perf_counter() - t0) * 1e3,
                         notes=notes,
                         sub_plans=union,
+                        strategy_source="union",
+                        est_rows=dec.get("est_rows"),
+                        alternatives=dec.get("alternatives"),
                     )
                     return plan, f, info
 
@@ -296,6 +452,11 @@ class QueryPlanner:
             n_candidates=plan.n_candidates,
             plan_ms=(time.perf_counter() - t0) * 1e3,
             notes=notes,
+            strategy_source=dec.get("source", ""),
+            predicted_ms=dec.get("predicted_ms"),
+            est_rows=dec.get("est_rows"),
+            alternatives=dec.get("alternatives"),
+            cheap=cheap,
         )
         return plan, f, info
 
@@ -451,7 +612,10 @@ def standing_query_payload(sft: FeatureType, predicate,
     )
 
 
-AGG_PROBE_EVERY = 16  # routing consults between probes of the loser
+# routing consults between probes of the loser — now THE shared probe
+# cadence of every cost-model decision (planning/costmodel.PROBE_EVERY);
+# re-exported here because the agg path defined it first
+from geomesa_tpu.planning.costmodel import PROBE_EVERY as AGG_PROBE_EVERY  # noqa: E402
 
 
 def choose_agg_path(cost_table, type_name: str,
@@ -459,32 +623,22 @@ def choose_agg_path(cost_table, type_name: str,
     """Route one eligible grouped aggregation: the GeoBlocks pyramid
     (``"pyramid"``) or the fused device scan (``"scan"``).
 
-    Consults the devmon observed-cost table (``/api/obs/costs`` — the
-    ROADMAP item-3 feedback loop): once BOTH routes have enough
-    observations under this type, the lower p50 wins; until then the
-    pyramid is the default — repeated polygon/bbox aggregations are
-    exactly the workload it exists for, and its boundary refinement is
-    O(perimeter) where the scan is O(n). A verdict is not a ratchet:
-    every ``AGG_PROBE_EVERY``-th consult for the type routes to the
-    LOSING path, so both cost profiles stay fresh and the decision can
-    flip when the data or workload shifts. The probe schedule rides the
-    cost table's per-type consult counter (:meth:`CostTable.tick`) —
-    never the observation counts, which the winner freezes by starving
-    the loser of observations (a scan-only workload would otherwise
-    probe forever at a stuck multiple, and a pyramid-only one would
-    never measure the scan at all)."""
-    pyr = cost_table.predict(type_name, "gagg:pyramid")
-    scan = cost_table.predict(type_name, "gagg:scan")
-    scan_wins = (
-        pyr is not None
-        and scan is not None
-        and pyr.get("observations", 0) >= min_observations
-        and scan.get("observations", 0) >= min_observations
-        and scan["wall_ms_p50"] < pyr["wall_ms_p50"]
-    )
-    if cost_table.tick(type_name, "gagg:route") % AGG_PROBE_EVERY == 0:
-        return "pyramid" if scan_wins else "scan"  # probe the loser
-    return "scan" if scan_wins else "pyramid"
+    Delegates to the generalized cost-model decision engine
+    (:meth:`geomesa_tpu.planning.costmodel.CostModel.choose_agg_path`)
+    over the given observed-cost table — the original tick/probe
+    mechanism, now shared by the strategy decider, the select dispatch
+    route, and the join kernel choice: once BOTH routes have enough
+    observations under this type the lower p50 wins (until then the
+    pyramid is the seeded default — repeated polygon/bbox aggregation is
+    exactly its regime), and every ``AGG_PROBE_EVERY``-th consult routes
+    to the LOSING path so neither profile freezes. The probe schedule
+    rides the cost table's per-type consult counter
+    (:meth:`CostTable.tick`) — never observation counts, which the
+    winner freezes by starving the loser of observations."""
+    from geomesa_tpu.planning.costmodel import CostModel
+
+    return CostModel(table=cost_table).choose_agg_path(
+        type_name, min_observations)
 
 
 def build_indices(sft: FeatureType) -> dict[str, FeatureIndex]:

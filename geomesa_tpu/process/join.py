@@ -92,6 +92,9 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
         raise ValueError(f"device join: unsupported predicate {pred!r}")
     if not isinstance(ds.backend, TpuBackend) or not ds._device_available():
         raise ValueError("device join: TPU backend unavailable")
+    import time as _time
+
+    _t0 = _time.perf_counter()
     st = ds._state(type_name)
     main, indices, backend_state, _stats, delta = st.snapshot()
     dev = (backend_state or {}).get("z2")
@@ -211,6 +214,8 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
         # for every chunk under a tight budget
         kc_limit = min(1024, kc_limit * 2)
 
+    _observe_join(ds, type_name, "block", _t0,
+                  sum(len(r) for _, r in out))
     if delta is None or not len(delta):
         return main, out
 
@@ -249,9 +254,13 @@ def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):
 
     Runs the f32 crossing-number kernel over the full point store on device.
     """
+    import time as _time
+
     import jax.numpy as jnp
 
     from geomesa_tpu.ops.join import pack_polygons, points_in_polygons_count
+
+    _t0 = _time.perf_counter()
 
     ds.compact(type_name)  # bulk path scans the main tier only
     st = ds._state(type_name)
@@ -267,4 +276,92 @@ def join_within_device(ds, type_name: str, polygons, max_vertices: int = 64):
         jnp.asarray(verts),
         jnp.asarray(bbox),
     )
-    return np.asarray(counts)
+    counts = np.asarray(counts)
+    _observe_join(ds, type_name, "dense", _t0, int(counts.sum()))
+    return counts
+
+
+def _observe_join(ds, type_name: str, route: str, t0: float,
+                  rows: int) -> None:
+    """Record one join execution under its plan signature
+    (``join:block`` / ``join:dense``) — the cost model's training signal
+    for :func:`join_counts_auto`'s route choice."""
+    import time as _time
+
+    from geomesa_tpu.obs import devmon
+
+    devmon.costs().observe(
+        type_name, f"join:{route}",
+        wall_ms=(_time.perf_counter() - t0) * 1000.0, rows=rows,
+    )
+
+
+def measured_pair_density(ds, type_name: str, geoms) -> float | None:
+    """MEASURED candidate-pair density of a join: candidate rows a z2
+    range plan admits (searchsorted over the HOST z2 keys — no block
+    expansion, no device work) over the brute-force
+    ``points x geometries`` pair count, clamped to [0, 1]. None when the
+    store has no z2 device layout to plan against (the block route can't
+    run at all)."""
+    from geomesa_tpu.ops.join import planned_candidate_rows
+    from geomesa_tpu.store.backends import TpuBackend
+
+    if not isinstance(ds.backend, TpuBackend):
+        return None
+    st = ds._state(type_name)
+    main, indices, backend_state, _stats, _delta = st.snapshot()
+    dev = (backend_state or {}).get("z2")
+    z2 = indices.get("z2")
+    if dev is None or z2 is None or main is None or not len(main):
+        return None
+    k = sum(1 for g in geoms if g is not None)
+    if k == 0:
+        return 0.0
+    bbox_deg = np.array(
+        [g.bbox for g in geoms if g is not None], dtype=np.float64
+    )
+    # searchsorted row-count estimate — the block route (if chosen) does
+    # its own full block planning exactly once, not twice
+    cand = planned_candidate_rows(z2.zs, bbox_deg)
+    return min(float(int(cand.sum())) / float(len(main) * k), 1.0)
+
+
+def join_counts_auto(ds, type_name: str, polygons, max_vertices: int = 64):
+    """Adaptive join counts: per-polygon points-inside counts via the
+    route the cost model picks — ``"block"`` (the index-pruned
+    block-sparse gather + exact f64 host refine,
+    :func:`join_rows_device`) or ``"dense"`` (the full f32
+    crossing-number pass, :func:`join_within_device`). Returns
+    ``(counts (K,) int64, route)``.
+
+    The seed comes from the MEASURED pair density (how many candidate
+    rows the block plan would actually test): sparse joins — polygons
+    touching few z2 blocks — seed the block route, dense ones the full
+    pass. Observed wall per route lands under the ``join:block`` /
+    ``join:dense`` plan signatures, so once both routes are trained the
+    measured p50 decides, and the model's probe cadence re-measures the
+    loser (docs/planning.md). Note the documented f32 tolerance of the
+    dense kernel (~1e-5 deg at polygon edges); callers needing exact
+    parity should call :func:`join_rows_device` directly."""
+    from geomesa_tpu.planning import costmodel
+
+    density = measured_pair_density(ds, type_name, polygons)
+    route = "dense"
+    if density is not None:
+        route = costmodel.model().choose_join_path(type_name, density)
+    if route == "block":
+        try:
+            _snap, pairs = join_rows_device(ds, type_name, polygons)
+            counts = np.zeros(len(polygons), dtype=np.int64)
+            for i, rows in pairs:
+                counts[i] = len(rows)
+            return counts, route
+        except ValueError:
+            route = "dense"  # layout can't take the block path after all
+    return (
+        np.asarray(
+            join_within_device(ds, type_name, polygons, max_vertices),
+            dtype=np.int64,
+        ),
+        route,
+    )

@@ -152,6 +152,45 @@ class StoreStats:
                 est += self.count * 0.1
         return min(est, float(self.count))
 
+    def estimate_filter_rows(self, f) -> float:
+        """Composed row estimate for a filter or pre-extracted bounds —
+        THE single estimation entry point (satellite of ROADMAP item 3):
+        the spatio-temporal Z3Histogram estimate min'd with every bounded
+        attribute's estimate (``Frequency`` point counts for equality,
+        ``Histogram.estimate_range`` for ranges — composed inside
+        :meth:`estimate_attr`), so the planner, the cost model, and
+        ``stats_count`` all share one definition instead of reaching into
+        individual sketches. Accepts a filter AST or an
+        :class:`~geomesa_tpu.filter.bounds.Extraction`."""
+        from geomesa_tpu.curve.binned_time import BinnedTime
+        from geomesa_tpu.curve.sfc import z3_sfc
+        from geomesa_tpu.filter.bounds import extract as _extract
+
+        if isinstance(f, Extraction):
+            e = f
+        else:
+            e = _extract(
+                f, self.sft.geom_field, self.sft.dtg_field,
+                attrs=tuple(self.attrs),
+            )
+        if e.disjoint:
+            return 0.0
+        est = self.estimate_spatiotemporal(
+            e, z3_sfc(self.sft.z3_interval), BinnedTime(self.sft.z3_interval)
+        )
+        for name, bounds in e.attributes.items():
+            if bounds is not None:
+                est = min(est, self.estimate_attr(name, bounds))
+        return float(min(max(est, 0.0), self.count))
+
+    def selectivity(self, f) -> float:
+        """Estimated matching fraction in [0, 1] for a filter AST /
+        Extraction — :meth:`estimate_filter_rows` over the snapshot count
+        (0.0 on an empty snapshot). The cost model's seed signal."""
+        if self.count <= 0:
+            return 0.0
+        return self.estimate_filter_rows(f) / float(self.count)
+
     # -- public stats API (GeoMesaStats.getCount/getBounds/getMinMax) --------
     def min_max(self, attr: str) -> MinMax:
         return self.attrs[attr].minmax

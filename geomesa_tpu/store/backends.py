@@ -32,6 +32,11 @@ from geomesa_tpu.schema.sft import FeatureType
 
 REFINE_PRECISION = 31  # device coords are 31-bit fixed point (Z2 resolution)
 JOIN_BLOCK = 4096  # block-sparse join granularity; shards pad to multiples
+# per-plan dispatch-payload memo cap (IndexPlan.exec_cache): total idx/count
+# slots above this re-derive per query instead of pinning device arrays the
+# ledger/pool don't account for (128 cached plans x wide-scan splits would
+# silently hold MBs of HBM outside the budget)
+_EXEC_MEMO_MAX_SLOTS = 1 << 18  # 256k slots ≈ 2 MB of int32 per plan
 # row-select one-pass threshold: total gather slots (shards x per-shard
 # capacity) below which the count pass is skipped and the gather runs
 # straight at the planner's candidate bound — one device dispatch instead
@@ -450,6 +455,8 @@ class TpuBackend(ExecutionBackend):
         return pack_boxes(boxes, overlap=overlap), pack_times(times)
 
     def select(self, state, index, plan, extraction, residual, table):
+        import time as _time
+
         intervals = plan.intervals
         if len(intervals) == 0:
             return np.empty(0, dtype=np.int64)
@@ -471,25 +478,48 @@ class TpuBackend(ExecutionBackend):
             with obs.span("refine", mode="host", index=index.name):
                 positions, total = gather_indices(intervals)
                 rows = index.perm[positions[:total]]
-                sub = table.take(rows)
-                return rows[residual.mask(sub)]
+                return rows[ast.residual_mask(residual, table, rows)]
 
+        # adaptive dispatch route (planning/costmodel.py): "twopass" is the
+        # per-query candidate-slot count+gather; "planned" runs the batched
+        # block-pair steps with a singleton batch — the SAME compiled
+        # executables select_many uses, so both modes share one jit cache
+        # (the bench-6 fast path). Observed wall per route feeds the cost
+        # table under sel:twopass / sel:planned, and the model's probe
+        # schedule keeps the losing route measured so the verdict can flip
+        # with hardware (dispatch-RTT-bound links favor stable shapes;
+        # local backends favor the tighter candidate gather).
+        from geomesa_tpu.obs import devmon
+        from geomesa_tpu.planning import costmodel
+
+        route = "twopass"
+        if dev.rows_per_shard % JOIN_BLOCK == 0:
+            route = costmodel.model().choose_select_route(type_name)
         # access-frequency accounting + dispatch pin: a pinned buffer is
         # never an eviction victim, so the scan below cannot lose its
         # columns mid-flight
         self.pool.touch(type_name, index.name)
+        t0 = _time.perf_counter()
         with self.pool.pinned(type_name, index.name), \
                 obs.span("dispatch", index=index.name,
-                         intervals=len(intervals)):
-            positions = self._mesh_select_positions(
-                dev, index, extraction, intervals
-            )
+                         intervals=len(intervals), route=route):
+            if route == "planned":
+                positions = self.select_many_positions(
+                    dev, index, [extraction], [intervals])[0]
+            else:
+                positions = self._mesh_select_positions(
+                    dev, index, extraction, intervals, plan=plan
+                )
+        devmon.costs().observe(
+            type_name, f"sel:{route}",
+            wall_ms=(_time.perf_counter() - t0) * 1000.0,
+            rows=len(positions),
+        )
         rows = index.perm[positions]
         if isinstance(residual, ast.Include):
             return rows
         with obs.span("refine", candidates=len(rows)):
-            sub = table.take(rows)
-            return rows[residual.mask(sub)]
+            return rows[ast.residual_mask(residual, table, rows)]
 
     def select_many_positions(
         self, dev: "_MeshIndexState", index, extractions, intervals_list
@@ -614,9 +644,18 @@ class TpuBackend(ExecutionBackend):
         ]
 
     def _mesh_select_positions(
-        self, dev: _MeshIndexState, index, extraction, intervals
+        self, dev: _MeshIndexState, index, extraction, intervals, plan=None
     ) -> np.ndarray:
-        """Distributed two-pass refine → matching sorted-order positions."""
+        """Distributed two-pass refine → matching sorted-order positions.
+
+        ``plan``: the owning :class:`~geomesa_tpu.index.api.IndexPlan`,
+        when the caller has one — its ``exec_cache`` memoizes the derived
+        per-shard interval split and the staged device payloads, so a plan
+        served from the store's plan cache dispatches with ZERO host
+        re-derivation or re-staging (the dominant host cost of the steady
+        per-query select path). The memo key carries the layout shape; a
+        reload with a different shape misses instead of mis-pairing.
+        """
         import jax.numpy as jnp
 
         from geomesa_tpu.parallel.mesh import data_shards
@@ -631,22 +670,54 @@ class TpuBackend(ExecutionBackend):
 
         mesh = self._get_mesh()
         n_shards = data_shards(mesh)
-        mx = max_shard_candidates(intervals, dev.rows_per_shard, n_shards)
+        bbox_mode = dev.kind == "bboxes"
+        memo_key = ("twopass", id(mesh), dev.rows_per_shard, dev.kind)
+        memo = plan.exec_cache.get(memo_key) if plan is not None else None
+        if memo is None:
+            mx = max_shard_candidates(intervals, dev.rows_per_shard, n_shards)
+            if mx == 0:
+                memo = (0, None, None, None, None)
+                if plan is not None:
+                    plan.exec_cache[memo_key] = memo
+                return np.empty(0, dtype=np.int64)
+            bucket = pad_bucket(mx)
+            idx, counts = split_intervals_by_shard(
+                intervals, dev.rows_per_shard, n_shards, bucket
+            )
+            boxes, times = self._payload(
+                index.sft, extraction, overlap=bbox_mode)
+            from geomesa_tpu.obs.jaxmon import count_h2d
+
+            count_h2d(idx, counts, boxes, times)  # per-query payload staging
+            memo = (
+                mx,
+                jnp.asarray(idx), jnp.asarray(counts),
+                jnp.asarray(boxes), jnp.asarray(times),
+            )
+            # memoize only payloads under the per-plan slot cap — a wide
+            # scan's (n_shards, bucket) split can reach MBs per plan and
+            # those re-derive per query (their cost is scan-dominated
+            # anyway). Memoized bytes ARE device residency: register them
+            # in the ledger under the "planmemo" group with the PLAN as
+            # owner, so the footprint shows in the residency gauges /
+            # budget headroom and unregisters itself when the plan cache
+            # drops the plan (LRU or state swap). Not pool-evictable by
+            # design: the per-plan cap bounds each entry and the plan
+            # cache's 128-entry LRU bounds the aggregate.
+            if (plan is not None
+                    and n_shards * bucket <= _EXEC_MEMO_MAX_SLOTS):
+                plan.exec_cache[memo_key] = memo
+                from geomesa_tpu.obs import devmon
+
+                devmon.ledger().register(
+                    getattr(index.sft, "name", "?"), index.name,
+                    "planmemo",
+                    sum(int(a.nbytes) for a in memo[1:]),
+                    owner=plan,
+                )
+        mx, d_idx, d_counts, d_boxes, d_times = memo
         if mx == 0:
             return np.empty(0, dtype=np.int64)
-        bucket = pad_bucket(mx)
-        idx, counts = split_intervals_by_shard(
-            intervals, dev.rows_per_shard, n_shards, bucket
-        )
-        bbox_mode = dev.kind == "bboxes"
-        boxes, times = self._payload(index.sft, extraction, overlap=bbox_mode)
-        from geomesa_tpu.obs.jaxmon import count_h2d
-
-        count_h2d(idx, counts, boxes, times)  # per-query payload staging
-        d_idx = jnp.asarray(idx)
-        d_counts = jnp.asarray(counts)
-        d_boxes = jnp.asarray(boxes)
-        d_times = jnp.asarray(times)
         c = dev.cols
         if bbox_mode:
             col_args = (

@@ -129,6 +129,23 @@ class ExplainAnalyze:
                    else "no prior observations")
                 + f", actual {self.cost.get('actual_ms')} ms"
             )
+            cal = self.cost.get("calibration_error")
+            if cal is not None:
+                out += f" (calibration error {cal:.1%})"
+            src = self.cost.get("strategy_source")
+            if src:
+                out += f"\n  Strategy source: {src}"
+            for alt in self.cost.get("alternatives") or []:
+                obs_txt = (
+                    f"observed {alt['observed_ms_p50']} ms p50"
+                    f" (n={alt['observations']})"
+                    if alt.get("observed_ms_p50") is not None
+                    else "no observations"
+                )
+                out += (
+                    f"\n  Rejected: {alt['name']} ≈ "
+                    f"{alt['est_rows']:.0f} rows, {obs_txt}"
+                )
         if self.cache:
             ac = self.cache.get("agg_cache") or {}
             pool = self.cache.get("pool") or {}
@@ -527,9 +544,11 @@ class DataStore:
         if pool is not None:
             pool.purge(name)
         from geomesa_tpu.obs import devmon
+        from geomesa_tpu.planning import costmodel
 
         devmon.ledger().clear_spills(name)
         devmon.costs().forget(name)
+        costmodel.model().forget(name)
 
     def _state(self, name: str) -> _TypeState:
         if name not in self._types:
@@ -1018,7 +1037,8 @@ class DataStore:
                         _plan_sp.set(cache="hit")
                     else:
                         planner = QueryPlanner(st.sft, indices, stats)
-                        plan, f, plan_box["info"] = planner.plan(q)
+                        plan, f, plan_box["info"] = planner.plan(
+                            q, under_burn=self._under_burn(type_name))
                         self._plan_store(
                             st, indices, cache_key, (plan, f, plan_box["info"])
                         )
@@ -1119,6 +1139,18 @@ class DataStore:
 
     _PLAN_CACHE_MAX = 128
 
+    def _under_burn(self, type_name: str) -> bool:
+        """Is this type burning its error budget? Fed to the planner's
+        SLO-aware tie-breaking: under burn, near-tied strategies resolve
+        to the lower-variance plan. Computed only on plan-cache misses."""
+        try:
+            return (
+                self.slo.tracker("store.query", type_name).burn_rate(300.0)
+                > 1.0
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a plan
+            return False
+
     @staticmethod
     def _plan_cache_key(q: "Query"):
         """Cache key for a query's PLANNING inputs, or None if uncacheable.
@@ -1149,6 +1181,13 @@ class DataStore:
 
     def _plan_store(self, st: _TypeState, indices, key, value) -> None:
         if key is None:
+            return
+        # a probe-tick plan deliberately took the LOSING strategy so its
+        # cost profile stays fresh — caching it would replay the loser
+        # for every later identical query, turning a bounded 1-in-16
+        # exploration into a permanent per-filter regression. The next
+        # identical query replans (a non-probe tick) and caches normally.
+        if getattr(value[2], "strategy_source", "") == "probe":
             return
         with st.lock:
             if st.indices is not indices:
@@ -1417,7 +1456,8 @@ class DataStore:
                 cached = self._plan_lookup(st, indices, cache_key)
                 if cached is None:
                     planner = QueryPlanner(st.sft, indices, stats)
-                    cached = planner.plan(q)
+                    cached = planner.plan(
+                        q, under_burn=self._under_burn(type_name))
                     self._plan_store(st, indices, cache_key, cached)
                 planned.append((q, *cached))  # (q, plan, f, info)
         plan_ms = (_time.perf_counter() - t_start) * 1000.0
@@ -1475,7 +1515,7 @@ class DataStore:
                         # (int superset culled on device, f64 filter
                         # settles the rest)
                         if len(rows) and not isinstance(f, ast.Include):
-                            rows = rows[f.mask(main.take(rows))]
+                            rows = rows[ast.residual_mask(f, main, rows)]
                         rows = np.sort(rows)
                         if delta_table is not None:
                             drows = np.nonzero(f.mask(delta_table))[0]
@@ -1630,9 +1670,7 @@ class DataStore:
                         if len(cand):
                             rows = perm[cand]
                             f = qs[i].resolved_filter()
-                            m = np.asarray(
-                                f.mask(main.take(rows)), dtype=bool
-                            )
+                            m = ast.residual_mask(f, main, rows)
                             corr = int((~m).sum())
                         out[i] = int(counts[k]) - corr
         # batched queries still hit metrics + the audit trail
@@ -2330,8 +2368,7 @@ class DataStore:
         if len(cand_rows):
             rows = cand_rows
             if f is not None:
-                m = np.asarray(f.mask(main.take(rows)), dtype=bool)
-                rows = rows[m]
+                rows = rows[ast.residual_mask(f, main, rows)]
             if cutoff_ms is not None and len(rows):
                 rows = rows[main.dtg_millis()[rows] >= cutoff_ms]
             for r in rows:
@@ -2552,7 +2589,13 @@ class DataStore:
         if info is not None:
             sig = devmon.plan_signature(info, q)
             index_name = getattr(info, "index_name", None) or ""
-            devmon.costs().observe(
+            costs = devmon.costs()
+            # predicted-vs-actual calibration: read the table's p50 BEFORE
+            # this run observes into it (what the planner would have
+            # predicted), then feed the error into the cost model's drift
+            # report (/api/obs/costs "calibration" section)
+            predicted = costs.predict(type_name, sig)
+            costs.observe(
                 type_name, sig,
                 wall_ms=plan_ms + scan_ms,
                 device_ms=(device["device_compute"] + device["dispatch"]
@@ -2563,6 +2606,13 @@ class DataStore:
                     if index_name and "union" not in index_name else 0
                 ),
             )
+            if predicted is not None and predicted.get("observations", 0) >= 4:
+                from geomesa_tpu.planning import costmodel
+
+                costmodel.model().record_calibration(
+                    type_name, sig,
+                    predicted["wall_ms_p50"], plan_ms + scan_ms,
+                )
         _flight.record(
             op="query", type_name=type_name, source="store", plan=filt,
             latency_ms=plan_ms + scan_ms, rows=hits,
@@ -2647,6 +2697,8 @@ class DataStore:
                 res = self.query(type_name, q)
                 actual_ms = (_time.perf_counter() - t0) * 1000.0
         qspans = root.find("query")
+        from geomesa_tpu.planning.costmodel import calibration_error
+
         return ExplainAnalyze(
             plan=out,
             timeline=_trace.StageTimeline(qspans[0] if qspans else root),
@@ -2656,6 +2708,17 @@ class DataStore:
                 "signature": sig,
                 "predicted": predicted,
                 "actual_ms": round(actual_ms, 3),
+                # relative prediction error for THIS run (None before the
+                # table has a prediction) — the per-query view of the
+                # /api/obs/costs calibration report
+                "calibration_error": (
+                    round(calibration_error(
+                        predicted["wall_ms_p50"], actual_ms), 4)
+                    if predicted else None
+                ),
+                "strategy_source": getattr(info, "strategy_source", ""),
+                # the decider's rejected alternatives with their estimates
+                "alternatives": getattr(info, "alternatives", None) or [],
             },
             cache=self.cache_report(),
         )
@@ -2675,22 +2738,13 @@ class DataStore:
             return self.query(type_name, Query(filter=cql)).count
         if st.stats is None:  # only delta-tier data so far: count it exactly
             return self.query(type_name, Query(filter=cql)).count
-        from geomesa_tpu.curve.binned_time import BinnedTime
-        from geomesa_tpu.curve.sfc import z3_sfc
-        from geomesa_tpu.filter.bounds import extract as _extract
         from geomesa_tpu.filter.cql import parse as _parse
 
         f_ast = _parse(cql) if isinstance(cql, str) else cql
-        e = _extract(
-            f_ast, st.sft.geom_field, st.sft.dtg_field,
-            attrs=tuple(st.stats.attrs) if st.stats else (),
-        )
-        est = st.stats.estimate_spatiotemporal(
-            e, z3_sfc(st.sft.z3_interval), BinnedTime(st.sft.z3_interval)
-        )
-        for name, bounds in e.attributes.items():
-            if bounds is not None:
-                est = min(est, st.stats.estimate_attr(name, bounds))
+        # the composed sketch estimate (StoreStats.estimate_filter_rows):
+        # one definition shared with the planner's cheap-path gate and the
+        # cost model's seeds
+        est = st.stats.estimate_filter_rows(f_ast)
         # stats cover the main tier only; the hot delta is small enough to
         # count exactly so fresh writes stay visible to estimates
         delta_table = st.delta.merged()

@@ -935,11 +935,17 @@ class GeoMesaApp:
         """The per-(type, plan-signature) observed-cost table
         (``geomesa-tpu obs costs`` pulls this): p50/p95 device-ms and
         wall-ms, rows, bytes scanned — the adaptive planner's training
-        signal, read-only for now."""
+        signal — plus the cost model's ``calibration`` report
+        (predicted-vs-actual drift per plan shape: mean absolute relative
+        error, signed bias, sample counts), so a model that has gone
+        stale is visible before it costs latency (docs/planning.md)."""
         from geomesa_tpu.obs import devmon
+        from geomesa_tpu.planning import costmodel
 
         limit = self._int_param(params, "limit")
-        return 200, devmon.costs().snapshot(limit=limit or 256), "application/json"
+        out = devmon.costs().snapshot(limit=limit or 256)
+        out["calibration"] = costmodel.model().calibration_report()
+        return 200, out, "application/json"
 
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)

@@ -36,7 +36,11 @@ export GEOMESA_BENCH_REGRESS_K="${GEOMESA_BENCH_REGRESS_K:-2}"
 # Config 8 rides it as the STREAMING parity leg (ISSUE 8): the
 # subscription-matrix product path's straight-XLA referee parity and the
 # journal-tier delivery parity both gate every run.
-export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,8,9}"
+# Config 6 rides it as the SELECT parity leg (ISSUE 9): per-query and
+# batched row-set parity plus the plan-overhead bound (host planning <5%
+# of query wall on the cached path) gate every run — the adaptive
+# planner's fast path can never silently regress select again.
+export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,6,8,9}"
 
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT

@@ -48,6 +48,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_devmon.py -q
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_bufferpool.py tests/test_geoblocks.py -q
 
+# adaptive-planner gate (ISSUE 9): the cost-model decision engine
+# (seeded ranking, learned override, bounded probe cadence, SLO
+# tie-breaking), the planner golden grid, residual-mask refine parity,
+# the select dispatch-route red/green vs the oracle, the zero-recompile
+# census pin on the steady select path, and calibration reporting. See
+# docs/planning.md.
+JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q
+
 # subscription-matrix gate (ISSUE 8): fused-matrix counts byte-equal to
 # the per-query referee across bucket growth/shrink, zero recompiles on
 # the steady path (jaxmon census), add/remove under concurrent appends

@@ -42,6 +42,25 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_cost_state():
+    """Per-test isolation of the adaptive-planner feedback state: the
+    process-global observed-cost table and cost model accumulate across
+    queries (probe ticks, learned route verdicts, calibration), so a
+    suite run would otherwise leak one test's training into the next
+    test's strategy choices — order-fragile by construction (the same
+    lesson test_geoblocks learned for the agg route). Tests that manage
+    their own installs simply stack on top; both restore on teardown."""
+    from geomesa_tpu.obs import devmon
+    from geomesa_tpu.planning import costmodel
+
+    prev = devmon.install(new_costs=devmon.CostTable())
+    prev_model = costmodel.install()
+    yield
+    devmon.install(new_costs=prev[1])
+    costmodel.install(prev_model)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _lock_order_gate():
     """Under GEOMESA_TPU_SANITIZE=1, fail the run if real execution ever

@@ -243,6 +243,9 @@ class TestQueryAndStats:
             assert e["count"] >= 1 and e["wall_ms_p50"] > 0
             assert {"signature", "device_ms_p50", "rows_p50",
                     "bytes_scanned_p50"} <= set(e)
+            # the adaptive cost model's calibration report rides along
+            cal = out["calibration"]
+            assert {"entries", "entry_count", "samples"} <= set(cal)
         finally:
             devmon.install(*prev)
 
