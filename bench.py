@@ -77,6 +77,15 @@ _REGRESS_BASELINE = _pop_flag_arg("--regress")
 _REGRESS_CAPTURE = _pop_flag_arg("--regress-capture")
 _REGRESS_REPORT = _pop_flag_arg("--regress-report")
 
+# --capture-workload <dir>: record every bench query as a workload wide
+# event (obs.workload JSONL capture) so `geomesa-tpu replay` can re-run
+# the bench's exact query mix against a changed planner/cost model —
+# docs/observability.md § Usage metering & workload replay. Set via env
+# BEFORE geomesa_tpu imports so child bench processes inherit capture.
+_CAPTURE_WORKLOAD = _pop_flag_arg("--capture-workload")
+if _CAPTURE_WORKLOAD:
+    os.environ["GEOMESA_TPU_WORKLOAD_DIR"] = _CAPTURE_WORKLOAD
+
 # The axon site hook force-registers the TPU relay backend and sets
 # jax_platforms="axon,cpu" at interpreter start, overriding the env var —
 # honor an explicit JAX_PLATFORMS (e.g. the CPU fallback after the backend

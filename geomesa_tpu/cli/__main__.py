@@ -443,9 +443,19 @@ def cmd_obs_flight(args):
     """Pull a server's query-audit flight recorder (``GET
     /api/obs/flight``) and render it — the operator's first stop after a
     burn-rate alert (docs/operations.md runbook)."""
+    import urllib.parse
     import urllib.request
 
-    url = args.url.rstrip("/") + f"/api/obs/flight?limit={args.limit}"
+    qp = {"limit": args.limit}
+    # server-side filters (the recorder applies them before the limit)
+    if getattr(args, "tenant", None):
+        qp["tenant"] = args.tenant
+    if getattr(args, "type", None):
+        qp["type"] = args.type
+    if getattr(args, "anomalies", False):
+        qp["anomalies"] = 1
+    url = (args.url.rstrip("/") + "/api/obs/flight?"
+           + urllib.parse.urlencode(qp))
     with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
         doc = json.load(r)
     if args.json:
@@ -454,8 +464,8 @@ def cmd_obs_flight(args):
     print(f"flight recorder: {doc['record_count']} recorded, "
           f"{doc['dump_count']} anomaly dumps"
           + (f", last dump {doc['last_dump']}" if doc.get("last_dump") else ""))
-    print(f"{'ts':>14s} {'op':<12s} {'type':<14s} {'ms':>9s} {'rows':>7s} "
-          f"{'flags':<18s} plan")
+    print(f"{'ts':>14s} {'op':<12s} {'type':<14s} {'tenant':<12s} "
+          f"{'ms':>9s} {'rows':>7s} {'flags':<18s} plan")
     for rec in doc.get("records", []):
         flags = ",".join(rec.get("anomalies") or ()) or "-"
         members = rec.get("members") or []
@@ -464,6 +474,7 @@ def cmd_obs_flight(args):
             bad = sum(1 for m in members if m[1] != "ok")
             extra = f" [{len(members) - bad}/{len(members)} members ok]"
         print(f"{rec['ts']:>14.3f} {rec['op']:<12s} {rec['type_name']:<14s} "
+              f"{(rec.get('tenant') or '-'):<12s} "
               f"{rec['latency_ms']:>9.2f} {rec['rows']:>7d} {flags:<18s} "
               f"{rec['plan'][:60]}{extra}")
 
@@ -507,6 +518,97 @@ def cmd_obs_costs(args):
                   f"{e['mean_signed_rel_err']:>+6.1%} "
                   f"{e['last_predicted_ms']:>10.2f} "
                   f"{e['last_actual_ms']:>10.2f}")
+
+
+def cmd_obs_tenants(args):
+    """Pull a server's per-tenant usage accounting (``GET
+    /api/obs/tenants``): rolling-window counters, heavy-hitter query
+    shapes, per-tenant SLO burn — the capacity-attribution surface
+    (docs/observability.md § Usage metering & workload replay)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/api/obs/tenants?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    print(f"tenants: {doc['tenant_count']} tracked, "
+          f"{doc['observe_count']} queries metered (top-K={doc['k']})")
+    print(f"{'tenant':<20s} {'q (5m)':>8s} {'rows (5m)':>10s} "
+          f"{'wall ms (5m)':>13s} {'dev ms (5m)':>12s} {'q (life)':>9s} "
+          f"{'burn 5m':>8s}")
+    for t in doc.get("tenants", []):
+        w = t["windows"].get("5m", {})
+        life = t["lifetime"]
+        slo = t.get("slo", {})
+        print(f"{t['tenant']:<20s} {w.get('queries', 0):>8d} "
+              f"{w.get('rows', 0):>10d} {w.get('wall_ms', 0.0):>13.1f} "
+              f"{w.get('device_ms', 0.0):>12.1f} {life['queries']:>9d} "
+              f"{slo.get('burn_rate_5m', 0.0):>8.2f}")
+    hitters = doc.get("heavy_hitters", [])
+    if hitters:
+        print(f"\nheavy hitters (wall-ms, overestimate <= error):")
+        print(f"{'tenant':<20s} {'type':<14s} {'signature':<28s} "
+              f"{'wall ms':>10s} {'err ms':>8s}")
+        for h in hitters:
+            print(f"{h['tenant']:<20s} {h['type']:<14s} "
+                  f"{h['signature']:<28s} {h['wall_ms']:>10.1f} "
+                  f"{h['error_ms']:>8.1f}")
+
+
+def cmd_replay(args):
+    """Replay a captured workload (``GEOMESA_TPU_WORKLOAD_DIR`` capture)
+    against a catalog or a live server and print the recorded-vs-replayed
+    report — the replay-before-deploy workflow (docs/operations.md)."""
+    from geomesa_tpu.obs import replay as _replay
+
+    remote = bool(args.url)
+    if args.url:
+        from geomesa_tpu.store.remote import RemoteDataStore
+
+        store = RemoteDataStore(args.url, timeout_s=args.timeout)
+    else:
+        if not args.catalog:
+            raise SystemExit("replay needs -c CATALOG or --url URL")
+        store = _load(args)
+    doc = _replay.run(
+        store, args.workload,
+        tenant=args.tenant, type_name=args.type, source=args.source,
+        speed=args.speed, limit=args.limit, remote=remote,
+    )
+    if args.report:
+        _replay.write_report(doc, args.report)
+    if doc["events"] == 0:
+        # a filter that matched nothing verified nothing — never a pass
+        raise SystemExit(
+            "error: no captured events matched the filters "
+            f"(skipped {doc.get('skipped', 0)} non-replayable) — "
+            "check --tenant/--type/--source and the capture path")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        skipped = doc.get("skipped", 0)
+        print(f"replayed {doc['events']} events ({doc['mode']}): "
+              f"parity {'OK' if doc['parity_ok'] else 'FAILED'}, "
+              f"{doc['errors']} errors"
+              + (f", {skipped} skipped (not forwardable over --url)"
+                 if skipped else ""))
+        print(f"{'signature':<32s} {'n':>6s} {'rec p50':>9s} "
+              f"{'rep p50':>9s} {'rec p95':>9s} {'rep p95':>9s} parity")
+        for sig, s in doc["signatures"].items():
+            print(f"{sig:<32s} {s['n']:>6d} "
+                  f"{s['recorded_ms']['p50']:>9.2f} "
+                  f"{s['replayed_ms']['p50']:>9.2f} "
+                  f"{s['recorded_ms']['p95']:>9.2f} "
+                  f"{s['replayed_ms']['p95']:>9.2f} "
+                  f"{'ok' if s['parity'] else 'MISMATCH'}")
+        for m in doc.get("row_mismatches", []):
+            print(f"  mismatch seq={m['seq']} [{m['signature']}]: "
+                  f"recorded {m['recorded_rows']} != replayed "
+                  f"{m.get('replayed_rows')} {m.get('error') or ''}")
+    if not doc["parity_ok"]:
+        raise SystemExit(2)
 
 
 def main(argv=None):
@@ -688,12 +790,52 @@ def main(argv=None):
         "flight", help="pull a server's query-audit flight recorder"
     )
     obs_common(fl)
+    fl.add_argument("--tenant", default=None,
+                    help="only records of this tenant (server-side filter)")
+    fl.add_argument("--type", default=None,
+                    help="only records of this feature type")
+    fl.add_argument("--anomalies", action="store_true",
+                    help="only records with anomaly flags")
     fl.set_defaults(fn=cmd_obs_flight)
     co = obs_sub.add_parser(
         "costs", help="pull a server's per-plan-shape observed-cost table"
     )
     obs_common(co)
     co.set_defaults(fn=cmd_obs_costs)
+    te = obs_sub.add_parser(
+        "tenants", help="pull a server's per-tenant usage accounting"
+    )
+    obs_common(te)
+    te.set_defaults(fn=cmd_obs_tenants)
+
+    sp = sub.add_parser(
+        "replay",
+        help="replay a captured workload against a catalog or live server "
+        "(recorded-vs-replayed report; exit 2 on row-parity failure)",
+    )
+    sp.add_argument("-c", "--catalog", default=None, help="catalog directory")
+    sp.add_argument("--backend", default="tpu", choices=["tpu", "oracle"])
+    sp.add_argument("--url", default=None,
+                    help="replay against a live server instead of a catalog")
+    sp.add_argument("--workload", required=True,
+                    help="capture directory (GEOMESA_TPU_WORKLOAD_DIR) or "
+                    "a single capture .jsonl file")
+    sp.add_argument("--tenant", default=None, help="replay one tenant only")
+    sp.add_argument("--type", default=None, help="replay one type only")
+    sp.add_argument("--source", default=None,
+                    help="capture tier to re-issue: store | federation "
+                    "(default: all — pick one for in-process captures)")
+    sp.add_argument("--speed", type=float, default=None,
+                    help="open-loop at recorded inter-arrival / SPEED "
+                    "(default: closed-loop at max speed)")
+    sp.add_argument("--limit", type=int, default=None,
+                    help="replay at most N events")
+    sp.add_argument("--report", default=None,
+                    help="write the full report JSON here (loadable as a "
+                    "bench.py --regress baseline)")
+    sp.add_argument("--timeout", type=float, default=30.0)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_replay)
 
     args = p.parse_args(argv)
     try:

@@ -18,6 +18,12 @@ Six layers (see docs/observability.md):
 - :mod:`~geomesa_tpu.obs.devmon` — device telemetry: the HBM residency
   ledger, sampled per-query device-time attribution (devprof), and the
   per-(type, plan-signature) observed-cost table.
+- :mod:`~geomesa_tpu.obs.usage` — tenant-attributed usage metering:
+  per-tenant rolling counters, the (tenant, type, plan-signature)
+  heavy-hitter sketch, per-tenant SLOs, bounded-cardinality exposition.
+- :mod:`~geomesa_tpu.obs.workload` / :mod:`~geomesa_tpu.obs.replay` —
+  workload capture (one JSONL wide event per query) and the
+  deterministic replay harness with recorded-vs-replayed reports.
 
 This package imports no jax at module level: ``GEOMESA_TPU_NO_JAX=1``
 processes (tpulint in CI) can import every instrumented module.

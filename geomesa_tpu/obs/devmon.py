@@ -50,8 +50,10 @@ from contextvars import ContextVar
 
 __all__ = [
     "DEVPROF_ENV", "CostTable", "DevProfile", "ResidencyLedger",
-    "costs", "current_profile", "device_report", "install", "ledger",
-    "plan_signature", "profiled", "prometheus_text", "sampled",
+    "cost_sidecar_path", "costs", "current_profile", "device_report",
+    "install", "ledger", "load_cost_snapshot", "plan_signature",
+    "profiled", "prometheus_text", "purge_persisted_costs",
+    "sampled", "save_cost_snapshot",
 ]
 
 DEVPROF_ENV = "GEOMESA_TPU_DEVPROF"
@@ -449,6 +451,19 @@ class _Quantiles:
         self._rng = random.Random(0x5DEECE66D)
         self._qcache: dict[float, tuple[int, float]] = {}
 
+    def to_state(self) -> dict:
+        """JSON-able state (cost-profile persistence — the reservoir IS
+        the learned distribution; the RNG restarts, which only changes
+        which FUTURE samples replace which slots)."""
+        return {"count": self.count, "total": self.total,
+                "res": [round(v, 4) for v in self._res]}
+
+    def load_state(self, state: dict) -> None:
+        self.count = int(state.get("count", 0))
+        self.total = float(state.get("total", 0.0))
+        self._res = [float(v) for v in state.get("res", [])][:self.SIZE]
+        self._qcache = {}
+
     def update(self, v: float) -> None:
         self.count += 1
         self.total += v
@@ -594,6 +609,54 @@ class CostTable:
                 "signatures": len(matched),
             }
 
+    # -- persistence (docs/observability.md § Cost-model persistence) ---------
+    def to_state(self) -> dict:
+        """The table's full learned state as JSON-able data: per-(type,
+        signature) reservoirs + counts, plus the consult ticks (probe
+        cadence must survive a restart too, or every reopened store
+        re-probes from scratch)."""
+        with self._lock:
+            entries = []
+            for (t, sig), e in self._entries.items():
+                entries.append({
+                    "type": t, "signature": sig, "count": e.count,
+                    "profiled_count": e.profiled_count,
+                    "wall_ms": e.wall_ms.to_state(),
+                    "device_ms": e.device_ms.to_state(),
+                    "rows": e.rows.to_state(),
+                    "bytes_scanned": e.bytes_scanned.to_state(),
+                })
+            ticks = [[t, n, v] for (t, n), v in self._ticks.items()]
+        return {"entries": entries, "ticks": ticks}
+
+    def load_state(self, state: dict) -> None:
+        """Restore a :meth:`to_state` snapshot. Merge by richness: a
+        snapshot row only lands when it has MORE observations than the
+        live same-key entry — loading at store open must not wipe (or
+        regress) profiles another open store already learned past the
+        snapshot; unrelated live rows are never touched."""
+        for row in state.get("entries", []):
+            key = (row["type"], row["signature"])
+            e = _CostEntry()
+            e.count = int(row.get("count", 0))
+            e.profiled_count = int(row.get("profiled_count", 0))
+            e.wall_ms.load_state(row.get("wall_ms", {}))
+            e.device_ms.load_state(row.get("device_ms", {}))
+            e.rows.load_state(row.get("rows", {}))
+            e.bytes_scanned.load_state(row.get("bytes_scanned", {}))
+            with self._lock:
+                live = self._entries.get(key)
+                if live is not None and live.count >= e.count:
+                    continue
+                self._entries[key] = e
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)
+        for t, n, v in state.get("ticks", []):
+            with self._lock:
+                key = (t, n)
+                self._ticks[key] = max(self._ticks.get(key, 0), int(v))
+
     def snapshot(self, limit: int = 256) -> dict:
         with self._lock:
             items = list(self._entries.items())[-limit:]
@@ -666,6 +729,107 @@ def device_report() -> dict:
 def prometheus_text(prefix: str = "geomesa") -> str:
     lines = _ledger.prometheus_lines(prefix)
     return "\n".join(lines) + "\n" if lines else ""
+
+
+# -- cost-profile persistence (the GEOMESA_TPU_WORKLOAD_DIR sidecar) ----------
+# Learned p50 rankings and calibration survive restarts: the cost table
+# (+ the cost model's calibration entries) snapshot to costs.json next to
+# the workload capture, loaded at store open (store.persistence.load) and
+# saved at catalog save. Schema delete/rename purges the persisted rows
+# along with the live ones (DataStore._purge_type_name).
+
+COSTS_SIDECAR = "costs.json"
+
+
+def cost_sidecar_path(path: str | None = None) -> "str | None":
+    """The sidecar file path: explicit, or derived from
+    ``GEOMESA_TPU_WORKLOAD_DIR`` (None when neither is set)."""
+    if path is not None:
+        return path
+    d = os.environ.get("GEOMESA_TPU_WORKLOAD_DIR") or None
+    return os.path.join(d, COSTS_SIDECAR) if d else None
+
+
+def save_cost_snapshot(path: str | None = None) -> "str | None":
+    """Persist the live cost table + calibration state; returns the path
+    written (None when no sidecar location is configured). Atomic
+    (tmp + replace): a crash mid-save must not truncate the previous
+    snapshot."""
+    import json
+
+    p = cost_sidecar_path(path)
+    if p is None:
+        return None
+    from geomesa_tpu.planning import costmodel
+
+    doc = {
+        "kind": "geomesa-cost-snapshot",
+        "costs": _costs.to_state(),
+        "calibration": costmodel.model().calibration_state(),
+    }
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    tmp = p + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, p)
+    return p
+
+
+def load_cost_snapshot(path: str | None = None) -> bool:
+    """Load a persisted snapshot into the live table + cost model (no-op
+    when the sidecar is absent/unreadable — a missing or corrupt snapshot
+    must never fail a store open). Returns True when state loaded."""
+    import json
+
+    p = cost_sidecar_path(path)
+    if p is None or not os.path.exists(p):
+        return False
+    try:
+        with open(p, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    if doc.get("kind") != "geomesa-cost-snapshot":
+        return False
+    _costs.load_state(doc.get("costs", {}))
+    from geomesa_tpu.planning import costmodel
+
+    costmodel.model().load_calibration_state(doc.get("calibration", {}))
+    return True
+
+
+def purge_persisted_costs(type_name: str, path: str | None = None) -> None:
+    """Drop one type's rows from the persisted sidecar (schema delete/
+    rename: the successor type must not inherit the dead type's learned
+    profile across a restart). Best-effort — a read-only sidecar never
+    fails the schema operation."""
+    import json
+
+    p = cost_sidecar_path(path)
+    if p is None or not os.path.exists(p):
+        return
+    try:
+        with open(p, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        costs_state = doc.get("costs", {})
+        costs_state["entries"] = [
+            e for e in costs_state.get("entries", [])
+            if e.get("type") != type_name
+        ]
+        costs_state["ticks"] = [
+            t for t in costs_state.get("ticks", []) if t[0] != type_name
+        ]
+        cal = doc.get("calibration", {})
+        cal["entries"] = [
+            e for e in cal.get("entries", [])
+            if e.get("type") != type_name
+        ]
+        tmp = p + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, p)
+    except (OSError, ValueError):
+        return
 
 
 # math import kept honest: _Quantiles interpolation uses pure arithmetic,

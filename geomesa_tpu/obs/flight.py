@@ -69,6 +69,16 @@ class QueryAuditRecord:
     trace_id: str = ""
     bytes_out: int = 0
     degraded: bool = False
+    # tenant attribution (obs.usage): the calling identity the web layer
+    # extracted (X-Geomesa-Tenant / auth principal), "" when the query
+    # ran outside any tenant context (embedded use, tests)
+    tenant: str = ""
+    # the caller's visibility auths at execution time (None = unrestricted)
+    auths: tuple | None = None
+    # the executed plan's cost-table key (devmon.plan_signature) and the
+    # model's pre-run p50 prediction — what replay reports key on
+    plan_signature: str = ""
+    predicted_ms: float | None = None
     # per-member outcomes for federated queries:
     # (member_index, "ok" | "error:<Type>", member_ms)
     members: list = field(default_factory=list)
@@ -125,14 +135,17 @@ class FlightRecorder:
             rec.ts, rec.op, rec.type_name, rec.source, rec.plan,
             rec.latency_ms, rec.rows, rec.trace_id, rec.bytes_out,
             rec.degraded, rec.members, rec.breakdown, rec.anomalies,
-            rec.device,
+            rec.device, rec.tenant, rec.auths, rec.plan_signature,
+            rec.predicted_ms,
         )
         rec.anomalies = anomalies
         return rec
 
     def record_values(self, ts, op, type_name, source, plan, latency_ms,
                       rows, trace_id, bytes_out, degraded, members,
-                      breakdown, anomalies, device=()) -> tuple:
+                      breakdown, anomalies, device=(), tenant="",
+                      auths=None, plan_signature="",
+                      predicted_ms=None) -> tuple:
         """Positional hot path (what :func:`record` at module level
         calls); returns the final anomaly tuple."""
         if degraded and A_DEGRADED not in anomalies:
@@ -140,7 +153,8 @@ class FlightRecorder:
         if latency_ms > self.slow_ms and A_SLOW not in anomalies:
             anomalies = anomalies + (A_SLOW,)
         row = (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
-               bytes_out, degraded, members, breakdown, anomalies, device)
+               bytes_out, degraded, members, breakdown, anomalies, device,
+               tenant, auths, plan_signature, predicted_ms)
         dump_now = False
         install_listener = False
         # a trace owned by a REMOTE caller never parks: the local
@@ -174,7 +188,8 @@ class FlightRecorder:
     @staticmethod
     def _materialize(row: tuple) -> QueryAuditRecord:
         (ts, op, type_name, source, plan, latency_ms, rows, trace_id,
-         bytes_out, degraded, members, breakdown, anomalies, device) = row
+         bytes_out, degraded, members, breakdown, anomalies, device,
+         tenant, auths, plan_signature, predicted_ms) = row
         return QueryAuditRecord(
             ts=ts, op=op, type_name=type_name, source=source, plan=plan,
             latency_ms=latency_ms, rows=rows, trace_id=trace_id,
@@ -183,6 +198,10 @@ class FlightRecorder:
             breakdown=dict(breakdown) if breakdown else {},
             device=dict(device) if device else {},
             anomalies=anomalies,
+            tenant=tenant,
+            auths=tuple(auths) if auths is not None else None,
+            plan_signature=plan_signature,
+            predicted_ms=predicted_ms,
         )
 
     # -- anomaly dumps --------------------------------------------------------
@@ -271,13 +290,25 @@ class FlightRecorder:
             rows = list(self._ring)
         return [self._materialize(r) for r in rows]
 
-    def snapshot(self, limit: int = 64) -> dict:
+    def snapshot(self, limit: int = 64, tenant: str | None = None,
+                 type_name: str | None = None,
+                 anomalies_only: bool = False) -> dict:
         """The ``/api/obs/flight`` payload: newest ``limit`` records plus
-        recorder health."""
+        recorder health. Optional server-side filters (``?tenant=`` /
+        ``?type=`` / ``?anomalies=1``) apply BEFORE the limit, so "the
+        last 64 anomalous records of tenant X" needs no client-side scan
+        of the whole ring."""
         with self._lock:
-            rows = list(self._ring)[-limit:]
+            rows = list(self._ring)
             count, dumps, last = (self.record_count, self.dump_count,
                                   self.last_dump_path)
+        if tenant is not None:
+            rows = [r for r in rows if r[14] == tenant]
+        if type_name is not None:
+            rows = [r for r in rows if r[2] == type_name]
+        if anomalies_only:
+            rows = [r for r in rows if r[12]]
+        rows = rows[-limit:]
         return {
             "records": [asdict(self._materialize(r)) for r in rows],
             "record_count": count,
@@ -318,7 +349,9 @@ def install(rec: FlightRecorder) -> FlightRecorder:
 def record(op: str, type_name: str, *, source: str = "store",
            plan: str = "", latency_ms: float = 0.0, rows: int = 0,
            bytes_out: int = 0, degraded: bool = False, members=None,
-           breakdown=None, anomalies: tuple = (), device=None) -> None:
+           breakdown=None, anomalies: tuple = (), device=None,
+           tenant: str = "", auths=None, plan_signature: str = "",
+           predicted_ms=None) -> None:
     """Record one completed query on the process recorder (the store /
     federation call-site helper — trace id is taken from the live span).
     The always-on hot path: no dataclass is built here."""
@@ -327,4 +360,5 @@ def record(op: str, type_name: str, *, source: str = "store",
         time.time(), op, type_name, source, plan, latency_ms, rows,
         sp.trace_id if sp is not None else "", bytes_out, degraded,
         members or (), breakdown or (), tuple(anomalies), device or (),
+        tenant, auths, plan_signature, predicted_ms,
     )

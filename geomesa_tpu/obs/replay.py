@@ -1,0 +1,277 @@
+"""Deterministic workload replay — re-run captured production traffic
+against a live store and diff the outcome against the recording
+(docs/observability.md § Usage metering & workload replay).
+
+A planner / cost-model / admission-control change is only trustworthy
+under a REALISTIC query mix (PAPERS.md, *Large-Scale Geospatial
+Processing on Multi-Core and Many-Core Processors*: batch-parallel
+evaluation results hold under real workloads, not synthetic uniform
+benches). This harness closes the loop: capture yesterday's traffic with
+:mod:`geomesa_tpu.obs.workload`, apply the change, replay, and read the
+recorded-vs-replayed report before deploying.
+
+Modes:
+
+- **closed-loop** (default): queries re-issue back-to-back at max speed,
+  in the deterministic capture order (``(ts_arrival, seq)``) — the
+  throughput / parity mode.
+- **open-loop** (``speed=...``): queries re-issue at the RECORDED
+  inter-arrival spacing divided by the speed factor (2.0 = twice as
+  fast) — the latency-under-load mode, preserving the workload's burst
+  structure.
+
+Every replayed query runs under the recorded tenant's context
+(:func:`geomesa_tpu.obs.usage.tenant_context`), so metering, flight
+records, and federated RPC attribution behave exactly as they did in
+production. Row-count parity per query is the correctness check: a
+planner change may move latency, but a changed ANSWER fails the replay.
+
+The report keys latency comparisons by plan signature (p50/p95/p99
+recorded vs replayed) and serializes in the shape
+``bench.py --regress`` loads as a baseline (a ``configs`` map of
+``{"value", "unit", "parity"}``), so replay reports slot into the
+existing perf-regression tooling. Surfaces: ``geomesa-tpu replay`` (CLI)
+and :func:`run` here. No jax anywhere (``GEOMESA_TPU_NO_JAX=1`` safe —
+the STORE does the device work).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from geomesa_tpu.obs import usage as _usage
+from geomesa_tpu.obs import workload as _workload
+
+__all__ = ["load_events", "replay", "run", "write_report"]
+
+# ops the harness knows how to re-issue (every captured shape today is a
+# per-query audit event; batched paths audit per member query)
+_REPLAYABLE_OPS = ("query",)
+
+
+def load_events(path_or_dir: str, *, tenant: str | None = None,
+                type_name: str | None = None, source: str | None = None,
+                ops=_REPLAYABLE_OPS, limit: int | None = None) -> list[dict]:
+    """Captured events in deterministic replay order, filtered. ``source``
+    picks the capture tier to re-issue (``"store"`` for a single-store
+    capture, ``"federation"`` for a frontend capture — replaying BOTH
+    from one in-process capture would double-issue every federated
+    query)."""
+    events = _workload.read_events(path_or_dir)
+    if ops:
+        events = [e for e in events if e.get("op") in ops]
+    if tenant is not None:
+        events = [e for e in events if e.get("tenant") == tenant]
+    if type_name is not None:
+        events = [e for e in events if e.get("type") == type_name]
+    if source is not None:
+        events = [e for e in events if e.get("source") == source]
+    if limit is not None:
+        events = events[:limit]
+    return events
+
+
+def _query_of(event: dict):
+    """Rebuild the re-issuable Query from one wide event."""
+    from geomesa_tpu.planning.planner import Query
+
+    filt = event.get("filter") or None
+    if filt == "INCLUDE":
+        filt = None
+    hints = dict(event.get("hints") or {})
+    if event.get("tenant"):
+        hints["tenant"] = event["tenant"]
+    auths = event.get("auths")
+    return Query(filter=filt, hints=hints,
+                 auths=list(auths) if auths is not None else None)
+
+
+def replay(store, events, *, speed: float | None = None,
+           remote: bool = False,
+           _sleep=time.sleep, _clock=time.perf_counter) -> list[dict]:
+    """Re-issue ``events`` against ``store``; returns one outcome dict per
+    event: replayed latency/rows, row parity vs the recording, and the
+    error type for a query that no longer executes (a dropped schema, an
+    unparseable reconstructed filter — counted, never fatal: a replay
+    must survive the store having moved on).
+
+    ``speed=None`` → closed-loop (max speed). ``speed=s`` → open-loop at
+    the recorded inter-arrival times divided by ``s``.
+
+    ``remote=True`` (the ``--url`` path): the RemoteDataStore query
+    surface forwards filter/limit/sort only — an event carrying other
+    hints (density/stats/bin reshape the row count) or recorded auths
+    (the client fails closed without the remote's trusted header) CANNOT
+    round-trip faithfully, so it is SKIPPED and counted rather than
+    replayed into a guaranteed false parity failure.
+
+    Capture is SUSPENDED for the duration: replaying a directory the
+    process is also capturing into would append every replayed query
+    back onto the recording it is reading (and eventually rotate the
+    original traffic off disk)."""
+    prev_journal = _workload.install(None)
+    try:
+        return _replay_inner(store, events, speed=speed, remote=remote,
+                             _sleep=_sleep, _clock=_clock)
+    finally:
+        _workload.install(prev_journal)
+
+
+# aggregation hints reshape what "rows" means in the audit record (a
+# density audit records grid mass, a stats audit sketch rows): replayed
+# row counts are NOT comparable, so these events replay for latency but
+# sit out the parity verdict
+_AGG_HINTS = ("density", "stats", "bin")
+
+
+def _replay_inner(store, events, *, speed, remote, _sleep, _clock):
+    out: list[dict] = []
+    t0 = _clock()
+    base_arrival = events[0].get("ts_arrival", 0.0) if events else 0.0
+    for e in events:
+        if remote:
+            blocked_hints = set(e.get("hints") or {}) - {"tenant"}
+            if blocked_hints or e.get("auths") is not None:
+                out.append({
+                    "seq": e.get("seq"),
+                    "plan_signature": e.get("plan_signature", ""),
+                    "skipped": ("hints " + ",".join(sorted(blocked_hints))
+                                if blocked_hints else "auths")
+                               + " not forwardable over --url",
+                })
+                continue
+        if speed:
+            due = (e.get("ts_arrival", 0.0) - base_arrival) / speed
+            lag = due - (_clock() - t0)
+            if lag > 0:
+                _sleep(lag)
+        res = {
+            "seq": e.get("seq"),
+            "plan_signature": e.get("plan_signature", ""),
+            "tenant": e.get("tenant", ""),
+            "type": e.get("type", ""),
+            "recorded_ms": float(e.get("latency_ms", 0.0)),
+            "recorded_rows": int(e.get("rows", 0)),
+        }
+        try:
+            q = _query_of(e)
+            with _usage.tenant_context(e.get("tenant")):
+                tq = _clock()
+                r = store.query(e["type"], q)
+                res["replayed_ms"] = (_clock() - tq) * 1000.0
+            res["replayed_rows"] = int(r.count)
+            if any(h in (e.get("hints") or {}) for h in _AGG_HINTS):
+                # aggregation audits record grid/sketch mass, not row
+                # count — latency compares, row parity abstains
+                res["parity"] = None
+            else:
+                res["parity"] = (
+                    res["replayed_rows"] == res["recorded_rows"])
+        except Exception as exc:  # noqa: BLE001 — a replay surveys, not crashes
+            res["error"] = f"{type(exc).__name__}: {exc}"[:200]
+            res["parity"] = False
+        out.append(res)
+    return out
+
+
+def _quantiles(vals: list[float]) -> dict:
+    if not vals:
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    s = sorted(vals)
+    top = len(s) - 1
+
+    def q(p: float) -> float:
+        pos = p * top
+        lo = int(pos)
+        hi = min(lo + 1, top)
+        frac = pos - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+    return {"p50": round(q(0.5), 3), "p95": round(q(0.95), 3),
+            "p99": round(q(0.99), 3)}
+
+
+def report(events: list[dict], outcomes: list[dict],
+           mode: str = "closed-loop") -> dict:
+    """The recorded-vs-replayed comparison, keyed by plan signature.
+
+    ``configs`` is the ``bench.py --regress``-loadable section: one entry
+    per signature, ``value`` = replayed p50 ms, ``parity`` = every
+    replayed query of that shape returned the recorded row count."""
+    skipped = [o for o in outcomes if "skipped" in o]
+    outcomes = [o for o in outcomes if "skipped" not in o]
+    by_sig: dict[str, list[dict]] = {}
+    for o in outcomes:
+        by_sig.setdefault(o.get("plan_signature") or "?", []).append(o)
+    sigs = {}
+    configs = {}
+    mismatches = []
+    errors = 0
+    for sig, rows in sorted(by_sig.items()):
+        ok_rows = [r for r in rows if "error" not in r]
+        errors += len(rows) - len(ok_rows)
+        rec = _quantiles([r["recorded_ms"] for r in rows])
+        rep = _quantiles([r["replayed_ms"] for r in ok_rows])
+        # parity=None (aggregation-hinted events) abstains: only an
+        # actual False (row mismatch / error) fails the shape
+        parity = all(r.get("parity") is not False for r in rows)
+        for r in rows:
+            if r.get("parity") is False and len(mismatches) < 16:
+                mismatches.append({
+                    "seq": r.get("seq"), "signature": sig,
+                    "recorded_rows": r.get("recorded_rows"),
+                    "replayed_rows": r.get("replayed_rows"),
+                    "error": r.get("error"),
+                })
+        sigs[sig] = {
+            "n": len(rows),
+            "recorded_ms": rec,
+            "replayed_ms": rep,
+            "parity": parity,
+            "speedup_p50": (
+                round(rec["p50"] / rep["p50"], 3) if rep["p50"] else None
+            ),
+        }
+        configs[f"replay:{sig}"] = {
+            "value": rep["p50"],
+            "unit": "ms/query",
+            "parity": parity,
+        }
+    n = len(outcomes)
+    return {
+        "kind": "workload-replay-report",
+        "mode": mode,
+        "events": n,
+        "skipped": len(skipped),
+        "errors": errors,
+        # vacuous truth guard: a replay that issued NOTHING verified
+        # nothing — it must not read as a pass in a gate. None abstains
+        # (aggregation-hinted events compare latency, not row counts).
+        "parity_ok": bool(outcomes) and all(
+            o.get("parity") is not False for o in outcomes),
+        "row_mismatches": mismatches,
+        "signatures": sigs,
+        "recorded_ms": _quantiles([o["recorded_ms"] for o in outcomes]),
+        "replayed_ms": _quantiles(
+            [o["replayed_ms"] for o in outcomes if "replayed_ms" in o]),
+        "configs": configs,
+    }
+
+
+def run(store, path_or_dir: str, *, tenant: str | None = None,
+        type_name: str | None = None, source: str | None = None,
+        speed: float | None = None, limit: int | None = None,
+        remote: bool = False) -> dict:
+    """Load → replay → report in one call (what the CLI and the bench
+    gate's smoke leg drive)."""
+    events = load_events(path_or_dir, tenant=tenant, type_name=type_name,
+                         source=source, limit=limit)
+    outcomes = replay(store, events, speed=speed, remote=remote)
+    return report(events, outcomes,
+                  mode=f"open-loop x{speed}" if speed else "closed-loop")
+
+
+def write_report(doc: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)

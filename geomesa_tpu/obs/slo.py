@@ -210,6 +210,14 @@ class SloEngine:
         with self._lock:
             tk._observe_locked(good, latency_ms, now)
 
+    def forget(self, name: str, key: str = "") -> None:
+        """Drop one (objective, key) tracker — callers with bounded key
+        spaces (the usage meter's tenant table) evict trackers alongside
+        their own entries so an unbounded key stream cannot grow the
+        engine or its exposition."""
+        with self._lock:
+            self._trackers.pop((name, key), None)
+
     def trackers(self) -> list[SloTracker]:
         with self._lock:
             return list(self._trackers.values())
@@ -245,12 +253,19 @@ class SloEngine:
         if not trackers:
             return []
         now = self._clock()
+
+        def esc(v: str) -> str:
+            # text-exposition label escaping: keys can carry arbitrary
+            # strings (tenant ids, filter-derived names)
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
         burn = [f"# TYPE {prefix}_slo_burn_rate gauge"]
         budget = [f"# TYPE {prefix}_slo_budget_remaining gauge"]
         for tk in trackers:
-            labels = f'slo="{tk.objective.name}"'
+            labels = f'slo="{esc(tk.objective.name)}"'
             if tk.key:
-                labels += f',key="{tk.key}"'
+                labels += f',key="{esc(tk.key)}"'
             for w in tk.objective.windows:
                 wl = f'{labels},window="{window_label(w)}"'
                 burn.append(

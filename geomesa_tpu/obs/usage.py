@@ -1,0 +1,440 @@
+"""Tenant-attributed usage metering — the accounting half of the usage &
+workload plane (docs/observability.md § Usage metering & workload replay).
+
+GeoMesa's audit tier records every query WITH the calling identity
+(``AuditProvider``/``AuditWriter`` — PAPER.md §1's index-api layer); the
+reproduction's telemetry was rich per-query but anonymous. This module
+closes that gap: every completed query is attributed to a *tenant* — the
+``X-Geomesa-Tenant`` header (or auth-context principal) the web layer
+extracted, :data:`DEFAULT_TENANT` for anonymous traffic — and accumulates
+into
+
+- per-tenant rolling-window counters (queries, rows, bytes_out, wall-ms,
+  and devprof device-ms) over the same 10 s bucket scheme as
+  :mod:`geomesa_tpu.obs.slo`, plus lifetime totals;
+- a :class:`SpaceSaving` top-K heavy-hitter sketch over
+  ``(tenant, type, plan-signature)`` weighted by wall-ms, so "which
+  tenant/query-shape is burning the budget" is answerable in O(K)
+  counters no matter how many distinct shapes flow through;
+- per-tenant SLO objectives riding the existing
+  :class:`~geomesa_tpu.obs.slo.SloEngine` (objective ``tenant.query``
+  keyed by tenant) — burn rates and error budgets per tenant, the signal
+  ROADMAP item 4's admission controller will shed traffic by.
+
+Read surfaces: ``GET /api/obs/tenants`` (:meth:`UsageMeter.snapshot`),
+``geomesa-tpu obs tenants`` (CLI), and ``geomesa_tenant_*{tenant=...}``
+gauges appended to ``GET /api/metrics?format=prometheus`` with BOUNDED
+label cardinality: the top-K tenants by window wall-ms get their own
+series, everything else rolls up into ``tenant="other"`` — the scrape can
+never exceed K+1 label values per metric regardless of tenant churn.
+
+Tenant context: the web layer binds the request's tenant to a ContextVar
+(:func:`tenant_context`); the store's ``_audit`` reads it (after an
+explicit ``hints["tenant"]``), and :mod:`geomesa_tpu.resilience.http`
+propagates it on federated RPCs as ``X-Geomesa-Tenant`` so member-side
+records attribute to the ORIGINAL caller, not the federation frontend.
+
+Locking: one leaf lock guards the tenant table + sketch (metrics tier in
+docs/concurrency.md — never nested inside another lock, no blocking calls
+under it; the SLO engine owns its own leaf lock). No jax anywhere
+(``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+__all__ = [
+    "DEFAULT_TENANT", "TENANT_HEADER", "TENANT_K_ENV", "SpaceSaving",
+    "UsageMeter", "current_tenant", "get", "install", "observe",
+    "tenant_context",
+]
+
+# the trusted tenant header (web layer + resilience/http propagation);
+# WSGI spells it HTTP_X_GEOMESA_TENANT
+TENANT_HEADER = "X-Geomesa-Tenant"
+DEFAULT_TENANT = "anonymous"
+# top-K size for the heavy-hitter sketch AND the prometheus label bound
+TENANT_K_ENV = "GEOMESA_TPU_TENANT_K"
+
+_BUCKET_S = 10.0  # rolling-counter granularity (matches obs/slo.py)
+_WINDOWS = (300.0, 3600.0)  # 5m / 1h
+# counter fields, in bucket-array order
+_FIELDS = ("queries", "rows", "bytes_out", "wall_ms", "device_ms")
+
+# request-scoped tenant identity (set by the web layer / replay harness;
+# read by DataStore._audit and resilience.http)
+_tenant_var: ContextVar[str | None] = ContextVar("geomesa_tenant",
+                                                 default=None)
+
+
+def current_tenant(default: str | None = DEFAULT_TENANT) -> str | None:
+    """The tenant bound to this context; ``default`` when unbound."""
+    t = _tenant_var.get()
+    return t if t else default
+
+
+@contextmanager
+def tenant_context(tenant: str | None):
+    """Bind ``tenant`` for the duration of a request / replayed query.
+    ``None``/empty binds nothing (the ambient tenant, if any, persists)."""
+    if not tenant:
+        yield
+        return
+    tok = _tenant_var.set(str(tenant))
+    try:
+        yield
+    finally:
+        _tenant_var.reset(tok)
+
+
+def escape_label(v: str) -> str:
+    """Prometheus text-exposition label-value escaping (backslash, quote,
+    newline). Tenant ids come from a CLIENT-controlled header — an
+    unescaped ``"`` would malform the whole scrape payload, which strict
+    consumers reject wholesale."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def env_k() -> int:
+    """The configured top-K (sketch capacity and prometheus label bound);
+    clamped to [1, 1024]."""
+    try:
+        k = int(os.environ.get(TENANT_K_ENV, "16"))
+    except ValueError:
+        k = 16
+    return min(max(k, 1), 1024)
+
+
+# -- SpaceSaving heavy hitters ------------------------------------------------
+
+class SpaceSaving:
+    """Metwally et al.'s SpaceSaving sketch: exactly ``capacity`` monitored
+    keys; an unmonitored arrival evicts the current minimum and inherits
+    its count as overestimation ``error``. Guarantees: every key with true
+    weight > W/capacity (W = total weight seen) is monitored, and each
+    reported count overestimates the true weight by at most its recorded
+    ``error``. NOT thread-safe on its own — the owning meter's lock guards
+    every offer/read."""
+
+    __slots__ = ("capacity", "_counts", "_errors", "total")
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._counts: dict = {}
+        self._errors: dict = {}
+        self.total = 0.0
+
+    def offer(self, key, weight: float = 1.0) -> None:
+        self.total += weight
+        c = self._counts.get(key)
+        if c is not None:
+            self._counts[key] = c + weight
+            return
+        if len(self._counts) < self.capacity:
+            self._counts[key] = weight
+            self._errors[key] = 0.0
+            return
+        # evict the minimum; the newcomer inherits its count as error
+        mk = min(self._counts, key=self._counts.__getitem__)
+        mv = self._counts.pop(mk)
+        self._errors.pop(mk)
+        self._counts[key] = mv + weight
+        self._errors[key] = mv
+
+    def top(self, k: int | None = None) -> list:
+        """``[(key, count, error)]`` sorted by count descending; ``count``
+        overestimates the true weight by at most ``error``."""
+        items = sorted(self._counts.items(), key=lambda kv: -kv[1])
+        if k is not None:
+            items = items[:k]
+        return [(key, c, self._errors[key]) for key, c in items]
+
+
+# -- per-tenant rolling counters ----------------------------------------------
+
+class _TenantUsage:
+    """Bucketed rolling counters + lifetime totals for one tenant. Bucket
+    mutation is guarded by the OWNING meter's lock."""
+
+    __slots__ = ("tenant", "_buckets", "lifetime", "last_seen")
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        # (bucket_start_s, [queries, rows, bytes_out, wall_ms, device_ms]),
+        # oldest first, pruned to the longest window on append
+        self._buckets: list = []
+        self.lifetime = [0, 0, 0, 0.0, 0.0]
+        self.last_seen = 0.0
+
+    def _observe_locked(self, now: float, queries: int, rows: int,
+                        bytes_out: int, wall_ms: float,
+                        device_ms: float) -> None:
+        self.last_seen = now
+        vals = (queries, rows, bytes_out, wall_ms, device_ms)
+        for i, v in enumerate(vals):
+            self.lifetime[i] += v
+        start = now - (now % _BUCKET_S)
+        if self._buckets and self._buckets[-1][0] == start:
+            b = self._buckets[-1][1]
+            for i, v in enumerate(vals):
+                b[i] += v
+        else:
+            self._buckets.append((start, list(vals)))
+            horizon = now - max(_WINDOWS) - _BUCKET_S
+            while self._buckets and self._buckets[0][0] < horizon:
+                self._buckets.pop(0)
+
+    def window_locked(self, window_s: float, now: float) -> dict:
+        lo = now - window_s
+        acc = [0, 0, 0, 0.0, 0.0]
+        for start, vals in self._buckets:
+            if start + _BUCKET_S > lo:
+                for i, v in enumerate(vals):
+                    acc[i] += v
+        return dict(zip(_FIELDS, acc))
+
+
+# -- the meter ----------------------------------------------------------------
+
+class UsageMeter:
+    """Process-wide per-tenant usage accounting.
+
+    ``observe`` is the always-on hot path: ONE lock acquisition for the
+    tenant bucket + sketch update, plus one (own-leaf-lock) SLO engine
+    observation — the same cost class as the flight recorder append, so
+    the <2% cached-select bound holds with metering on
+    (``tests/test_usage_workload.py``).
+
+    The tenant table is bounded (``max_tenants``): past the cap the
+    least-recently-seen tenant folds its LIFETIME totals into the
+    ``other`` rollup and is dropped — an unbounded tenant-id stream (a
+    misbehaving client minting fresh ids) cannot grow process memory.
+    """
+
+    OTHER = "other"
+
+    def __init__(self, k: int | None = None, max_tenants: int = 256,
+                 slo=None, slo_target: float = 0.999,
+                 slo_latency_ms: float | None = 1000.0,
+                 clock=time.time):
+        self.k = k if k is not None else env_k()
+        self.max_tenants = max(max_tenants, self.k + 1)
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: tenant table + sketch
+        self._tenants: dict[str, _TenantUsage] = {}
+        # lifetime totals folded out of evicted tenants (the "other" row)
+        self._other = _TenantUsage(self.OTHER)
+        self._sketch = SpaceSaving(self.k)
+        if slo is None:
+            from geomesa_tpu.obs.slo import SloEngine
+
+            slo = SloEngine()
+        self.slo = slo
+        self.slo.objective("tenant.query", target=slo_target,
+                           latency_ms=slo_latency_ms)
+        self.observe_count = 0
+
+    # -- hot path -------------------------------------------------------------
+    def observe(self, tenant: str | None, type_name: str, signature: str,
+                *, rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
+                device_ms: float = 0.0, ok: bool = True) -> None:
+        """Account one completed query. ``device_ms`` is the devprof
+        attribution total when the query was sampled (0 otherwise — the
+        per-tenant device-ms series is a sampled estimate, reconciling
+        with devmon's own attribution within the sampling error)."""
+        t = str(tenant) if tenant else DEFAULT_TENANT
+        now = self._clock()
+        with self._lock:
+            u = self._tenants.get(t)
+            if u is None:
+                u = self._tenants[t] = _TenantUsage(t)
+            # observe BEFORE any eviction: a just-created tenant has the
+            # newest last_seen, so the fold-out always takes the oldest
+            u._observe_locked(now, 1, int(rows), int(bytes_out),
+                              float(wall_ms), float(device_ms))
+            evicted = (self._evict_locked()
+                       if len(self._tenants) > self.max_tenants else None)
+            self._sketch.offer((t, type_name, signature),
+                               max(float(wall_ms), 0.0))
+            self.observe_count += 1
+        # per-tenant SLO (own leaf lock, taken strictly AFTER ours is
+        # released): a slow query burns the tenant's latency budget — what
+        # admission control will shed by. Evicting a tenant drops its
+        # tracker too, so the engine (and its exposition) stays bounded by
+        # the table cap even under an unbounded tenant-id stream.
+        if evicted is not None:
+            self.slo.forget("tenant.query", evicted)
+        self.slo.observe("tenant.query", ok=ok, latency_ms=wall_ms, key=t)
+
+    def note_bytes_out(self, tenant: str | None, nbytes: int) -> None:
+        """Attribute response payload bytes (the web layer's serialized
+        size — the store cannot know it) to a tenant without counting a
+        query."""
+        t = str(tenant) if tenant else DEFAULT_TENANT
+        now = self._clock()
+        with self._lock:
+            u = self._tenants.get(t)
+            if u is None:
+                u = self._tenants[t] = _TenantUsage(t)
+            u._observe_locked(now, 0, 0, int(nbytes), 0.0, 0.0)
+            evicted = (self._evict_locked()
+                       if len(self._tenants) > self.max_tenants else None)
+        if evicted is not None:
+            self.slo.forget("tenant.query", evicted)
+
+    def _evict_locked(self) -> str:
+        """Fold the least-recently-seen tenant into ``other``; returns
+        the evicted tenant id (its SLO tracker is dropped by the caller
+        OUTSIDE this lock)."""
+        victim = min(self._tenants.values(), key=lambda u: u.last_seen)
+        del self._tenants[victim.tenant]
+        for i, v in enumerate(victim.lifetime):
+            self._other.lifetime[i] += v
+        return victim.tenant
+
+    # -- read surfaces --------------------------------------------------------
+    def _ranked_locked(self, now: float) -> list:
+        """Tenants ranked by 5m-window wall-ms (ties: lifetime wall-ms) —
+        the ordering both the snapshot and the prometheus top-K use."""
+        return sorted(
+            self._tenants.values(),
+            key=lambda u: (-u.window_locked(_WINDOWS[0], now)["wall_ms"],
+                           -u.lifetime[3], u.tenant),
+        )
+
+    def snapshot(self, limit: int | None = None) -> dict:
+        """The ``GET /api/obs/tenants`` payload: per-tenant window +
+        lifetime counters (ranked by recent wall-ms), the heavy-hitter
+        table, and per-tenant SLO burn/budget."""
+        now = self._clock()
+        with self._lock:
+            ranked = self._ranked_locked(now)
+            if limit is not None:
+                ranked = ranked[:limit]
+            tenants = []
+            for u in ranked:
+                tenants.append({
+                    "tenant": u.tenant,
+                    "windows": {
+                        _wlabel(w): u.window_locked(w, now) for w in _WINDOWS
+                    },
+                    "lifetime": dict(zip(_FIELDS, list(u.lifetime))),
+                })
+            hitters = [
+                {"tenant": key[0], "type": key[1], "signature": key[2],
+                 "wall_ms": round(c, 3), "error_ms": round(err, 3)}
+                for key, c, err in self._sketch.top()
+            ]
+            other = dict(zip(_FIELDS, list(self._other.lifetime)))
+            n_tenants = len(self._tenants)
+            observed = self.observe_count
+            sketch_total = self._sketch.total
+        # SLO section OUTSIDE the meter lock (engine owns its own)
+        for t in tenants:
+            tk = self.slo.tracker("tenant.query", t["tenant"])
+            t["slo"] = {
+                "burn_rate_5m": tk.burn_rate(300.0),
+                "budget_remaining_5m": tk.budget_remaining(300.0),
+            }
+        return {
+            "tenants": tenants,
+            "tenant_count": n_tenants,
+            "other_lifetime": other,
+            "heavy_hitters": hitters,
+            "heavy_hitter_total_ms": round(sketch_total, 3),
+            "k": self.k,
+            "observe_count": observed,
+        }
+
+    def prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
+        """``geomesa_tenant_*`` gauges with a ``tenant`` label, bounded to
+        K+1 label values: the top-K tenants by recent wall-ms plus one
+        ``other`` rollup summing every remaining tenant AND the evicted
+        fold-in — totals reconcile with the unlabeled counters exactly.
+        The per-tenant SLO burn/budget gauges (``geomesa_tenant_slo_*``,
+        distinct metric names so the store engine's ``geomesa_slo_*``
+        ``# TYPE`` headers are never duplicated) are emitted for the SAME
+        top-K tenants only — the K+1 cardinality bound holds across
+        every ``geomesa_tenant_*`` series, not just the counters."""
+        now = self._clock()
+        with self._lock:
+            if not self._tenants and not self._other.lifetime[0]:
+                return []
+            ranked = self._ranked_locked(now)
+            top, rest = ranked[:self.k], ranked[self.k:]
+            rows = [(u.tenant, list(u.lifetime)) for u in top]
+            other = list(self._other.lifetime)
+            for u in rest:
+                for i, v in enumerate(u.lifetime):
+                    other[i] += v
+        rows.append((self.OTHER, other))
+        names = ("queries_total", "rows_total", "bytes_out_total",
+                 "wall_ms_total", "device_ms_total")
+        lines: list[str] = []
+        for i, name in enumerate(names):
+            metric = f"{prefix}_tenant_{name}"
+            lines.append(f"# TYPE {metric} counter")
+            for tenant, vals in rows:
+                v = vals[i]
+                v = f"{v:.6g}" if isinstance(v, float) else str(v)
+                lines.append(
+                    f'{metric}{{tenant="{escape_label(tenant)}"}} {v}')
+        burn = [f"# TYPE {prefix}_tenant_slo_burn_rate gauge"]
+        budget = [f"# TYPE {prefix}_tenant_slo_budget_remaining gauge"]
+        for tenant, _ in rows[:-1]:  # top-K only; "other" has no tracker
+            tk = self.slo.tracker("tenant.query", tenant)
+            for w in tk.objective.windows:
+                lbl = (f'tenant="{escape_label(tenant)}",'
+                       f'window="{_wlabel(w)}"')
+                burn.append(
+                    f"{prefix}_tenant_slo_burn_rate{{{lbl}}} "
+                    f"{tk.burn_rate(w):.6g}")
+                budget.append(
+                    f"{prefix}_tenant_slo_budget_remaining{{{lbl}}} "
+                    f"{tk.budget_remaining(w):.6g}")
+        lines.extend(burn)
+        lines.extend(budget)
+        return lines
+
+    def prometheus_text(self, prefix: str = "geomesa") -> str:
+        lines = self.prometheus_lines(prefix)
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+def _wlabel(w: float) -> str:
+    from geomesa_tpu.obs.slo import window_label
+
+    return window_label(w)
+
+
+# -- process-wide meter -------------------------------------------------------
+
+_meter = UsageMeter()
+
+
+def get() -> UsageMeter:
+    return _meter
+
+
+def install(meter: UsageMeter) -> UsageMeter:
+    """Swap the process meter (test isolation); returns the previous."""
+    global _meter
+    prev, _meter = _meter, meter
+    return prev
+
+
+def observe(tenant: str | None, type_name: str, signature: str, *,
+            rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
+            device_ms: float = 0.0, ok: bool = True) -> None:
+    """Module-level hot-path helper (what ``DataStore._audit`` calls)."""
+    _meter.observe(tenant, type_name, signature, rows=rows,
+                   bytes_out=bytes_out, wall_ms=wall_ms,
+                   device_ms=device_ms, ok=ok)

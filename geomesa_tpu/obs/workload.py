@@ -1,0 +1,322 @@
+"""Workload capture journal — the record half of the usage & workload
+plane (docs/observability.md § Usage metering & workload replay).
+
+Every completed query's :class:`~geomesa_tpu.obs.flight.QueryAuditRecord`
+serializes as ONE structured wide event (JSON line) to a size-capped
+rotating capture file under ``GEOMESA_TPU_WORKLOAD_DIR`` — recording
+enough to RE-ISSUE the query (op, type, filter text, re-issuable hints,
+arrival timestamp, tenant/auths) plus what it cost (latency, rows,
+plan signature, the cost model's prediction), so
+
+- :mod:`geomesa_tpu.obs.replay` can re-run yesterday's real traffic
+  against a changed planner/cost-model/admission config and diff the
+  latency distributions per plan shape, and
+- the capture doubles as an audit trail joinable to the flight recorder
+  and devmon attribution by (trace_id, ts).
+
+Capture is OPT-IN by environment: with ``GEOMESA_TPU_WORKLOAD_DIR``
+unset, the hot path is one module-global bool check
+(:data:`ENABLED` — same pattern as ``devmon.PROFILING``), preserving the
+<2% cached-select bound. With capture ON, events buffer in memory and
+flush in batches (``flush_every``), so the per-query cost stays an
+append + an occasional amortized batch write.
+
+Rotation: ``capture.jsonl`` is the live file; past ``max_bytes`` it
+rotates to ``capture.1.jsonl`` … ``capture.<max_files-1>.jsonl`` (oldest
+deleted). Every event carries a process-monotonic ``seq`` so readers can
+re-establish deterministic total order across rotated files even when
+two queries complete in the same clock tick.
+
+Locking (docs/concurrency.md): ``_lock`` is a LEAF guarding the buffer +
+sequence counter (no blocking calls under it); ``_flush_lock`` is taken
+BEFORE ``_lock`` and serializes file I/O, so flushes from concurrent
+threads write buffered batches in seq order. No jax anywhere
+(``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = [
+    "ENABLED", "WORKLOAD_DIR_ENV", "WorkloadJournal", "flush", "get",
+    "install", "read_events", "record",
+]
+
+WORKLOAD_DIR_ENV = "GEOMESA_TPU_WORKLOAD_DIR"
+MAX_MB_ENV = "GEOMESA_TPU_WORKLOAD_MAX_MB"
+MAX_FILES_ENV = "GEOMESA_TPU_WORKLOAD_FILES"
+
+CAPTURE_FILE = "capture.jsonl"
+
+# THE one check the per-query audit path pays when capture is off
+ENABLED = False
+
+# hints that survive capture → replay: plain-data knobs a re-issued query
+# can carry verbatim. Live objects (deadline handles), identity (tenant —
+# captured as its own field), and sampling toggles are dropped.
+_REPLAYABLE_HINTS = (
+    "index", "loose_bbox", "density", "stats", "bin", "sampling",
+    "sample_by", "now_ms", "tenant",
+)
+
+
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return str(v)
+
+
+class WorkloadJournal:
+    """Rotating JSONL writer for query wide events.
+
+    ``append`` is the hot path: serialize OUTSIDE any lock, enqueue under
+    the leaf lock, flush a full buffer in one batched write. ``flush()``
+    forces the buffer to disk (tests, process shutdown, CLI capture)."""
+
+    def __init__(self, directory: str, max_bytes: int | None = None,
+                 max_files: int | None = None, flush_every: int = 256):
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    float(os.environ.get(MAX_MB_ENV, "64")) * 1024 * 1024)
+            except ValueError:
+                max_bytes = 64 * 1024 * 1024
+        if max_files is None:
+            try:
+                max_files = int(os.environ.get(MAX_FILES_ENV, "4"))
+            except ValueError:
+                max_files = 4
+        self.directory = directory
+        self.max_bytes = max(int(max_bytes), 4096)
+        self.max_files = max(int(max_files), 1)
+        self.flush_every = max(int(flush_every), 1)
+        self._flush_lock = threading.Lock()  # ordering: flush_lock → lock
+        self._lock = threading.Lock()  # leaf: buffer + seq
+        self._buf: list[str] = []
+        self._seq = 0
+        self.event_count = 0  # lifetime appends (ops surface)
+        self.dropped_count = 0  # failed batch writes (full disk)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, CAPTURE_FILE)
+
+    # -- write surface --------------------------------------------------------
+    def append(self, event: dict) -> None:
+        """Append one wide event (a dict of JSON-able values; ``seq`` is
+        stamped here). The write is buffered; a full buffer flushes in
+        one batch."""
+        with self._lock:
+            self._seq += 1
+            event = dict(event, seq=self._seq)
+            # serialize under the lock: the seq stamp and the line's place
+            # in the buffer must agree (serialization is dict→str CPU work,
+            # not blocking I/O — the R003 concern is file/socket waits)
+            self._buf.append(json.dumps(event, separators=(",", ":")))
+            self.event_count += 1
+            need_flush = len(self._buf) >= self.flush_every
+        if need_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write every buffered line. ``_flush_lock`` (held across the
+        buffer swap AND the file write) keeps concurrent flushes in seq
+        order; a failed write (full/readonly disk) drops the batch and
+        counts it — capture must never fail the query path."""
+        with self._flush_lock:
+            with self._lock:
+                if not self._buf:
+                    return
+                lines, self._buf = self._buf, []
+            data = "\n".join(lines) + "\n"
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+                self._rotate_if_needed(len(data))
+                # _flush_lock exists to serialize exactly this I/O (batch
+                # ordering across threads); the hot append path never
+                # blocks on it
+                # tpulint: disable-next-line=R003
+                with open(self.path, "a", encoding="utf-8") as fh:
+                    fh.write(data)
+            except OSError:
+                with self._lock:
+                    self.dropped_count += len(lines)
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Size-capped rotation (called under ``_flush_lock``)."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        # capture.(n-1) dies; capture.i → capture.(i+1); capture → capture.1
+        oldest = self._rotated(self.max_files - 1)
+        if self.max_files == 1:
+            os.replace(self.path, self.path + ".tmp")
+            os.remove(self.path + ".tmp")
+            return
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 2, 0, -1):
+            src = self._rotated(i)
+            if os.path.exists(src):
+                os.replace(src, self._rotated(i + 1))
+        os.replace(self.path, self._rotated(1))
+
+    def _rotated(self, i: int) -> str:
+        return os.path.join(self.directory, f"capture.{i}.jsonl")
+
+    # -- read surface ---------------------------------------------------------
+    def files(self) -> list[str]:
+        """Capture files, OLDEST first (rotated high→low, then live)."""
+        out = []
+        for i in range(self.max_files - 1, 0, -1):
+            p = self._rotated(i)
+            if os.path.exists(p):
+                out.append(p)
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+
+def read_events(path_or_dir: str) -> list[dict]:
+    """Load captured events from a capture directory (EVERY rotated file
+    present on disk — globbed, so reading never depends on the writing
+    process's ``max_files`` config — oldest first) or a single JSONL
+    file; returns them sorted by ``(ts_arrival, seq)`` — the
+    deterministic replay order. Truncated tail lines (a crash
+    mid-write) are skipped, not fatal."""
+    if os.path.isdir(path_or_dir):
+        import glob as _glob
+
+        rotated = []
+        for p in _glob.glob(os.path.join(path_or_dir, "capture.*.jsonl")):
+            stem = os.path.basename(p)[len("capture."):-len(".jsonl")]
+            if stem.isdigit():
+                rotated.append((int(stem), p))
+        # highest rotation index = oldest
+        paths = [p for _, p in sorted(rotated, reverse=True)]
+        live = os.path.join(path_or_dir, CAPTURE_FILE)
+        if os.path.exists(live):
+            paths.append(live)
+    else:
+        paths = [path_or_dir]
+    events: list[dict] = []
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line
+    events.sort(key=lambda e: (e.get("ts_arrival", 0.0), e.get("seq", 0)))
+    return events
+
+
+# -- process-wide journal (env-gated) -----------------------------------------
+
+_journal: WorkloadJournal | None = None
+_resolved = False  # env resolution ran (or install() overrode it)
+_init_lock = threading.Lock()
+
+
+def _env_journal() -> "WorkloadJournal | None":
+    d = os.environ.get(WORKLOAD_DIR_ENV) or None
+    if d is None:
+        return None
+    return WorkloadJournal(d)
+
+
+def get() -> "WorkloadJournal | None":
+    """The process journal (None when capture is disabled). Created
+    lazily from ``GEOMESA_TPU_WORKLOAD_DIR`` on first call; an explicit
+    :func:`install` (including ``install(None)``) pins the choice."""
+    global _journal, ENABLED, _resolved
+    if not _resolved:
+        with _init_lock:
+            if not _resolved:
+                _journal = _env_journal()
+                ENABLED = _journal is not None
+                _resolved = True
+    return _journal
+
+
+def install(journal: "WorkloadJournal | None") -> "WorkloadJournal | None":
+    """Swap the process journal (tests / ``bench.py --capture-workload``);
+    ``None`` disables capture. Returns the previous journal."""
+    global _journal, ENABLED, _resolved
+    with _init_lock:
+        prev, _journal = _journal, journal
+        ENABLED = journal is not None
+        _resolved = True
+    return prev
+
+
+def flush() -> None:
+    j = _journal
+    if j is not None:
+        j.flush()
+
+
+def record(*, ts: float, op: str, type_name: str, source: str,
+           filter_text: str, hints: dict | None, tenant: str,
+           auths, plan_signature: str, predicted_ms,
+           latency_ms: float, rows: int, bytes_out: int = 0,
+           trace_id: str = "", device_ms: float = 0.0,
+           degraded: bool = False) -> None:
+    """Append one query wide event to the process journal (no-op unless
+    capture is enabled — callers gate on :data:`ENABLED` first so the off
+    path costs one module-global check)."""
+    j = get()
+    if j is None:
+        return
+    safe_hints = None
+    if hints:
+        safe_hints = {
+            k: _json_safe(v) for k, v in hints.items()
+            if k in _REPLAYABLE_HINTS
+        }
+    j.append({
+        # arrival = completion - latency: replay paces by arrival time
+        "ts_arrival": round(ts - latency_ms / 1000.0, 6),
+        "ts": round(ts, 6),
+        "op": op,
+        "type": type_name,
+        "source": source,
+        "filter": filter_text,
+        "hints": safe_hints or None,
+        "tenant": tenant,
+        "auths": list(auths) if auths is not None else None,
+        "plan_signature": plan_signature,
+        "predicted_ms": predicted_ms,
+        "latency_ms": round(float(latency_ms), 3),
+        "rows": int(rows),
+        "bytes_out": int(bytes_out),
+        "trace_id": trace_id,
+        "device_ms": round(float(device_ms), 3),
+        "degraded": bool(degraded),
+    })
+
+
+# resolve the env gate at import: the operator path sets
+# GEOMESA_TPU_WORKLOAD_DIR before the process starts, and hot-path
+# callers gate on the ENABLED bool alone (tests pin a journal with
+# install(), which re-resolves)
+get()
+
+# buffered tail events land on interpreter exit (bench runs, CLI tools);
+# flush() on a disabled journal is a no-op
+import atexit  # noqa: E402 — registered after the env gate resolves
+
+atexit.register(flush)

@@ -286,6 +286,43 @@ class CostModel:
             for k in [k for k in self._cal if k[0] == type_name]:
                 del self._cal[k]
 
+    # -- persistence (the workload-dir cost sidecar, obs.devmon) -------------
+    def calibration_state(self) -> dict:
+        """JSON-able calibration state — saved with the cost-table
+        snapshot so predicted-vs-actual drift accounting survives
+        restarts alongside the p50 rankings it judges."""
+        with self._cal_lock:
+            entries = [
+                {"type": t, "signature": sig, "count": e.count,
+                 "abs_rel_err_sum": round(e.abs_rel_err_sum, 6),
+                 "signed_rel_err_sum": round(e.signed_rel_err_sum, 6),
+                 "last_predicted": round(e.last_predicted, 4),
+                 "last_actual": round(e.last_actual, 4)}
+                for (t, sig), e in self._cal.items()
+            ]
+        return {"entries": entries}
+
+    def load_calibration_state(self, state: dict) -> None:
+        """Restore :meth:`calibration_state` (same merge-by-richness
+        semantics as ``CostTable.load_state``: a snapshot row never
+        regresses a live entry that has already learned past it)."""
+        for row in state.get("entries", []):
+            key = (row["type"], row["signature"])
+            e = _Calibration()
+            e.count = int(row.get("count", 0))
+            e.abs_rel_err_sum = float(row.get("abs_rel_err_sum", 0.0))
+            e.signed_rel_err_sum = float(row.get("signed_rel_err_sum", 0.0))
+            e.last_predicted = float(row.get("last_predicted", 0.0))
+            e.last_actual = float(row.get("last_actual", 0.0))
+            with self._cal_lock:
+                live = self._cal.get(key)
+                if live is not None and live.count >= e.count:
+                    continue
+                self._cal[key] = e
+                self._cal.move_to_end(key)
+                while len(self._cal) > self._cal_max:
+                    self._cal.popitem(last=False)
+
     def calibration_report(self) -> dict:
         """The drift surface served with ``GET /api/obs/costs``: per-(type,
         signature) mean absolute relative error (MAPE vs actual), signed

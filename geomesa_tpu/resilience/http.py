@@ -34,16 +34,24 @@ import urllib.parse
 import urllib.request
 
 from geomesa_tpu.obs import trace as _trace
+from geomesa_tpu.obs import usage as _usage
 from geomesa_tpu.resilience import faults
 from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
 
-__all__ = ["DEADLINE_HEADER", "fetch", "map_http_error", "request"]
+__all__ = ["DEADLINE_HEADER", "TENANT_HEADER", "fetch", "map_http_error",
+           "request"]
 
 # remaining deadline budget, in milliseconds, at the moment of send: each
 # hop re-derives its own absolute deadline from the budget, so no wall
 # clocks ever need to agree across hosts
 DEADLINE_HEADER = "X-Geomesa-Deadline-Ms"
+
+# tenant propagation (docs/observability.md § Usage metering): a
+# federated RPC carries the ORIGINAL caller's tenant so the member's
+# flight/usage records attribute to the end user, not to the federation
+# frontend. One choke point = every remote client propagates for free.
+TENANT_HEADER = _usage.TENANT_HEADER
 
 # socket-timeout slack past the propagated deadline: the REMOTE is the
 # authority on its own expiry (it sheds with a 504 we want to hear); the
@@ -138,6 +146,12 @@ def request(
     base_headers = dict(headers or {})
     if data is not None:
         base_headers.setdefault("Content-Type", "application/json")
+    # tenant context → header (one ContextVar read per exchange; absent
+    # outside a request/replay context). An explicit caller-set header
+    # wins — the web layer's trust posture stays with the proxy.
+    tenant = _usage.current_tenant(default=None)
+    if tenant and TENANT_HEADER not in base_headers:
+        base_headers[TENANT_HEADER] = tenant
 
     with _trace.span("rpc", method=method, endpoint=url) as rpc:
         traced = isinstance(rpc, _trace.Span)

@@ -549,6 +549,9 @@ class DataStore:
         devmon.ledger().clear_spills(name)
         devmon.costs().forget(name)
         costmodel.model().forget(name)
+        # the PERSISTED cost sidecar too: a restart must not resurrect a
+        # deleted/renamed type's profile for an unrelated successor
+        devmon.purge_persisted_costs(name)
 
     def _state(self, name: str) -> _TypeState:
         if name not in self._types:
@@ -1109,6 +1112,7 @@ class DataStore:
                 self.metrics.counter("store.query.timeouts").inc()
                 self.metrics.counter("store.query.deadline_shed").inc()
                 self.slo.observe("store.query", ok=False, key=type_name)
+                self._meter_failed(type_name, q, 0.0)
                 raise QueryTimeout(
                     f"deadline spent before scan of {type_name!r} started")
             timeout_s = rem if timeout_s is None else min(timeout_s, rem)
@@ -1120,10 +1124,11 @@ class DataStore:
             )
         except QueryTimeout:
             timed_out = True
+            wall = (_time.perf_counter() - t_start) * 1000.0
             self.metrics.counter("store.query.timeouts").inc()
             self.slo.observe(
-                "store.query", ok=False, key=type_name,
-                latency_ms=(_time.perf_counter() - t_start) * 1000.0)
+                "store.query", ok=False, key=type_name, latency_ms=wall)
+            self._meter_failed(type_name, q, wall)
             raise
         finally:
             # finally: scan errors (not just timeouts) must release the
@@ -2566,6 +2571,18 @@ class DataStore:
                 out[i] = _exact(q)
         return out
 
+    def _meter_failed(self, type_name: str, q: Query, wall_ms: float) -> None:
+        """Tenant accounting for queries that never reach ``_audit``
+        (deadline shed, watchdog timeout): the heaviest tenants are
+        exactly the ones that time out, and an admission controller
+        metering only SUCCESSES would never shed them. Burns the
+        tenant's SLO budget (ok=False) and accrues the wall time spent."""
+        from geomesa_tpu.obs import usage
+
+        tenant = q.hints.get("tenant") or usage.current_tenant()
+        usage.observe(tenant, type_name, "timeout", wall_ms=wall_ms,
+                      ok=False)
+
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float,
                hits: int, info=None) -> None:
         self.metrics.histogram("store.query.hits").update(hits)
@@ -2576,18 +2593,24 @@ class DataStore:
         # completed query (all leaf-lock appends — the <2% cached-jit
         # bound is gated in scripts/lint.sh). A query that ran under
         # devprof additionally carries its device-time breakdown.
-        from geomesa_tpu.obs import devmon
+        from geomesa_tpu.obs import devmon, usage, workload
         from geomesa_tpu.obs import flight as _flight
 
         prof = devmon.current_profile() if devmon.PROFILING else None
         device = prof.breakdown() if prof is not None else None
+        # tenant attribution (obs.usage): an explicit per-query hint wins
+        # (the web layer sets it from X-Geomesa-Tenant); otherwise the
+        # request-scoped context the web layer / replay harness bound —
+        # anonymous embedded callers land on the default tenant
+        tenant = q.hints.get("tenant") or usage.current_tenant()
+        sig = devmon.plan_signature(info, q)
+        predicted = None
         # only FULLY PLANNED, individually timed executions feed the cost
         # table: batched paths audit with amortized-zero timings and no
         # plan info, and an empty store audits 0 ms — letting those in
         # would pull every p50 toward zero under the wrong signature
         # (the table is the adaptive planner's training signal)
         if info is not None:
-            sig = devmon.plan_signature(info, q)
             index_name = getattr(info, "index_name", None) or ""
             costs = devmon.costs()
             # predicted-vs-actual calibration: read the table's p50 BEFORE
@@ -2613,14 +2636,39 @@ class DataStore:
                     type_name, sig,
                     predicted["wall_ms_p50"], plan_ms + scan_ms,
                 )
+        predicted_ms = predicted["wall_ms_p50"] if predicted else None
+        device_ms = (device["device_compute"] + device["dispatch"]
+                     + device["compile"]) if device else 0.0
         _flight.record(
             op="query", type_name=type_name, source="store", plan=filt,
             latency_ms=plan_ms + scan_ms, rows=hits,
             breakdown={"plan": plan_ms, "scan": scan_ms},
             device=device or {},
+            tenant=tenant or "", auths=q.auths,
+            plan_signature=sig, predicted_ms=predicted_ms,
         )
         self.slo.observe("store.query", ok=True, key=type_name,
                          latency_ms=plan_ms + scan_ms)
+        # per-tenant usage metering (obs.usage): one leaf-lock append, the
+        # same cost class as the flight record — the accounting substrate
+        # ROADMAP item 4's admission controller consumes
+        usage.observe(
+            tenant, type_name, sig, rows=hits,
+            wall_ms=plan_ms + scan_ms, device_ms=device_ms,
+        )
+        # workload capture (obs.workload): one wide event per query when
+        # GEOMESA_TPU_WORKLOAD_DIR is set; the off path is one bool check
+        if workload.ENABLED:
+            import time as _time
+
+            workload.record(
+                ts=_time.time(), op="query", type_name=type_name,
+                source="store", filter_text=filt, hints=q.hints,
+                tenant=tenant or "", auths=q.auths, plan_signature=sig,
+                predicted_ms=predicted_ms,
+                latency_ms=plan_ms + scan_ms, rows=hits,
+                device_ms=device_ms,
+            )
         # SLO → buffer-pool feedback, sampled (1/32 queries): a type
         # burning its error budget weighs heavier in eviction scoring, so
         # its buffers stay resident while an idle type's go first

@@ -278,28 +278,52 @@ class MergedDataStoreView:
                       members=len(self.stores)):
             filt = q.filter if isinstance(q.filter, str) else str(
                 q.filter or "INCLUDE")
+            # federation-level tenant attribution: the frontend's request
+            # context (member stores attribute their own legs via the
+            # propagated X-Geomesa-Tenant header — resilience/http.py)
+            from geomesa_tpu.obs import usage as _usage
+            from geomesa_tpu.obs import workload as _workload
+
+            tenant = q.hints.get("tenant") or _usage.current_tenant()
             try:
                 res, errors = self._query_fanout(type_name, q, outcomes)
             except MEMBER_FAILURE_TYPES as e:
                 # whole-query failure (all members down, or fail mode):
                 # the always-on record must not miss the worst outcomes
+                ms = (time.perf_counter() - t_start) * 1000.0
                 _flight.record(
                     op="query", type_name=type_name, source="federation",
-                    plan=filt,
-                    latency_ms=(time.perf_counter() - t_start) * 1000.0,
+                    plan=filt, latency_ms=ms,
                     rows=0, degraded=True, members=outcomes,
                     anomalies=self._anomalies([(None, e)]),
+                    tenant=tenant or "", auths=q.auths,
                 )
+                _usage.observe(tenant, type_name, "federation", rows=0,
+                               wall_ms=ms, ok=False)
                 raise
             # always-on audit record; anomalies (degraded result, open
             # breaker, blown member deadline) trigger the flight dump
+            ms = (time.perf_counter() - t_start) * 1000.0
             _flight.record(
                 op="query", type_name=type_name, source="federation",
-                plan=filt,
-                latency_ms=(time.perf_counter() - t_start) * 1000.0,
+                plan=filt, latency_ms=ms,
                 rows=res.count, degraded=res.degraded, members=outcomes,
                 anomalies=self._anomalies(errors),
+                tenant=tenant or "", auths=q.auths,
             )
+            # view-level metering under the "federation" pseudo-signature:
+            # in-process member stores meter their own legs per plan shape,
+            # so the device-ms attribution stays with the store tier
+            _usage.observe(tenant, type_name, "federation", rows=res.count,
+                           wall_ms=ms, ok=not res.degraded)
+            if _workload.ENABLED:
+                _workload.record(
+                    ts=time.time(), op="query", type_name=type_name,
+                    source="federation", filter_text=filt, hints=q.hints,
+                    tenant=tenant or "", auths=q.auths,
+                    plan_signature="federation", predicted_ms=None,
+                    latency_ms=ms, rows=res.count, degraded=res.degraded,
+                )
         return res
 
     def _query_fanout(self, type_name: str, q: Query, outcomes: list):

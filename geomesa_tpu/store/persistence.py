@@ -309,6 +309,12 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
             import shutil
 
             shutil.rmtree(p)
+    # cost-model persistence: snapshot the learned cost profiles +
+    # calibration alongside the catalog save (no-op unless
+    # GEOMESA_TPU_WORKLOAD_DIR names a sidecar location)
+    from geomesa_tpu.obs import devmon
+
+    devmon.save_cost_snapshot()
     return manifest
 
 
@@ -408,4 +414,11 @@ def load(
             ds.write(name, table)
             ds.compact(name)  # restored data is the main tier, not hot writes
         ds.metrics.counter(f"catalog.partitions_pruned.{name}").inc(pruned)
+    # cost-model persistence (docs/observability.md § Cost-model
+    # persistence): learned per-(type, plan-signature) p50 rankings +
+    # calibration reload from the GEOMESA_TPU_WORKLOAD_DIR sidecar, so
+    # the adaptive planner opens warm instead of re-probing from scratch
+    from geomesa_tpu.obs import devmon
+
+    devmon.load_cost_snapshot()
     return ds

@@ -29,8 +29,10 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/schemas/{name}/stats/topk?attr=&k=
     GET    /api/schemas/{name}/density?cql=&bbox=&width=&height=
     GET    /api/audit?typeName=                  query audit records
-    GET    /api/obs/flight?limit=                query-audit flight recorder
+    GET    /api/obs/flight?limit=&tenant=&type=&anomalies=1
+                                                 query-audit flight recorder
     GET    /api/obs/costs?limit=                 per-plan-shape cost profiles
+    GET    /api/obs/tenants?limit=               per-tenant usage accounting
     GET    /api/metrics                          metrics snapshot (+ device
                                                  HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
@@ -55,6 +57,7 @@ import numpy as np
 
 from geomesa_tpu import obs
 from geomesa_tpu.obs import trace as _obstrace
+from geomesa_tpu.obs import usage as _usage
 from geomesa_tpu.planning.planner import Query
 from geomesa_tpu.utils.timeouts import QueryTimeout as _QueryTimeout
 
@@ -154,6 +157,7 @@ class GeoMesaApp:
             ("GET", r"^/api/audit$", self._audit),
             ("GET", r"^/api/obs/flight$", self._obs_flight),
             ("GET", r"^/api/obs/costs$", self._obs_costs),
+            ("GET", r"^/api/obs/tenants$", self._obs_tenants),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -171,8 +175,18 @@ class GeoMesaApp:
         # reserved keys: only the server may set them — never the client
         params.pop("__auths__", None)
         params.pop("__deadline__", None)
+        params.pop("__tenant__", None)
         if self.auth_provider is not None:
             params["__auths__"] = self.auth_provider.auths(environ)
+        # tenant attribution (obs.usage / docs/observability.md § Usage
+        # metering): the caller's X-Geomesa-Tenant assertion (same proxy-
+        # trust posture as X-Geomesa-Auths — the fronting proxy must own
+        # this header), bound to the request context below so every store
+        # audit record and outbound federated RPC attributes to it;
+        # absent header = the default (anonymous) tenant
+        tenant = (environ.get("HTTP_X_GEOMESA_TENANT") or "").strip()
+        if tenant:
+            params["__tenant__"] = tenant
         # deadline propagation (X-Geomesa-Deadline-Ms): the caller's
         # REMAINING budget in ms, re-anchored on this host's monotonic
         # clock — see geomesa_tpu.resilience.http / docs/resilience.md
@@ -233,7 +247,8 @@ class GeoMesaApp:
                             if ctx is not None and not ctx.sampled
                             else nullcontext()
                         )
-                        with span_cm as sp, join_cm:
+                        with span_cm as sp, join_cm, \
+                                _usage.tenant_context(tenant):
                             if (
                                 ctx is not None and not ctx.sampled
                                 and isinstance(sp, _obstrace.Span)
@@ -261,9 +276,19 @@ class GeoMesaApp:
                                 _obstrace.TRACE_RETURN_HEADER,
                                 _obstrace.serialize_subtree(sp),
                             )]
-                        return self._respond(
+                        out = self._respond(
                             start_response, status, payload, ctype,
                             extra_headers=extra)
+                        if out and out[0]:
+                            # response-payload bytes attribute to the
+                            # tenant (the store can't see serialization);
+                            # headerless traffic accrues under the
+                            # default (anonymous) tenant — egress
+                            # attribution must not undercount the bulk
+                            # of an unlabeled deployment's load
+                            _usage.get().note_bytes_out(
+                                tenant or None, len(out[0]))
+                        return out
             raise _HttpError(405 if matched_path else 404,
                              "method not allowed" if matched_path else "not found")
         except _HttpError as e:
@@ -647,6 +672,11 @@ class GeoMesaApp:
 
     def _parse_query(self, params) -> Query:
         hints = {}
+        if params.get("__tenant__"):
+            # tenant rides the query object too (the audit record's
+            # primary source; the context var covers paths that build
+            # their own Query instances)
+            hints["tenant"] = params["__tenant__"]
         if params.get("__deadline__") is not None:
             # the store's own scan honors the remaining budget too: it
             # sheds before device work when the budget is gone and caps
@@ -925,11 +955,29 @@ class GeoMesaApp:
 
     def _obs_flight(self, params, body):
         """The query-audit flight recorder (``geomesa-tpu obs flight``
-        pulls this): newest records, dump state, recorder config."""
+        pulls this): newest records, dump state, recorder config.
+        Server-side filters: ``?tenant=``, ``?type=``, ``?anomalies=1``
+        (applied before the limit)."""
         from geomesa_tpu.obs import flight
 
         limit = self._int_param(params, "limit")
-        return 200, flight.get().snapshot(limit=limit or 64), "application/json"
+        anomalies = params.get("anomalies", "").lower() in ("1", "true",
+                                                            "yes")
+        return 200, flight.get().snapshot(
+            limit=limit or 64,
+            tenant=params.get("tenant") or None,
+            type_name=params.get("type") or None,
+            anomalies_only=anomalies,
+        ), "application/json"
+
+    def _obs_tenants(self, params, body):
+        """Per-tenant usage accounting (``geomesa-tpu obs tenants`` pulls
+        this): rolling-window + lifetime counters per tenant, the
+        (tenant, type, plan-signature) heavy-hitter table, and per-tenant
+        SLO burn — docs/observability.md § Usage metering & workload
+        replay."""
+        limit = self._int_param(params, "limit")
+        return 200, _usage.get().snapshot(limit=limit), "application/json"
 
     def _obs_costs(self, params, body):
         """The per-(type, plan-signature) observed-cost table
@@ -977,6 +1025,10 @@ class GeoMesaApp:
             from geomesa_tpu.stream import telemetry as stream_telemetry
 
             text += stream_telemetry.prometheus_text()
+            # tenant usage: geomesa_tenant_* counters with BOUNDED label
+            # cardinality (top-K tenants + an "other" rollup) plus the
+            # per-tenant SLO burn gauges
+            text += _usage.get().prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -1004,6 +1056,10 @@ class GeoMesaApp:
         stream_report = stream_telemetry.report()
         if stream_report:
             out["stream"] = stream_report
+        # tenant usage accounting (full detail at GET /api/obs/tenants)
+        meter = _usage.get()
+        if meter.observe_count:
+            out["tenants"] = meter.snapshot(limit=16)
         return 200, out, "application/json"
 
     def _ogc(self, handler, error_cls, params):

@@ -45,15 +45,15 @@ export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,6,8,9}"
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT
 
-echo "[bench-gate] 1/4 capture (real measurement, K=$GEOMESA_BENCH_REGRESS_K)"
+echo "[bench-gate] 1/5 capture (real measurement, K=$GEOMESA_BENCH_REGRESS_K)"
 python bench.py --regress-capture "$tmp/baseline.json"
 
-echo "[bench-gate] 2/4 green: regress vs capture must pass"
+echo "[bench-gate] 2/5 green: regress vs capture must pass"
 GEOMESA_BENCH_REGRESS_MEASURED="$tmp/baseline.json" \
     python bench.py --regress "$tmp/baseline.json" \
     --regress-report "$tmp/report.json"
 
-echo "[bench-gate] 3/4 red: injected 20% slowdown must FAIL the gate"
+echo "[bench-gate] 3/5 red: injected 20% slowdown must FAIL the gate"
 if GEOMESA_BENCH_INJECT_SLOWDOWN=1.2 \
     GEOMESA_BENCH_REGRESS_MEASURED="$tmp/baseline.json" \
     python bench.py --regress "$tmp/baseline.json" >/dev/null; then
@@ -61,9 +61,17 @@ if GEOMESA_BENCH_INJECT_SLOWDOWN=1.2 \
     exit 1
 fi
 
-echo "[bench-gate] 4/4 committed baseline loads and passes against itself"
+echo "[bench-gate] 4/5 committed baseline loads and passes against itself"
 GEOMESA_BENCH_REGRESS_CONFIGS="" \
     GEOMESA_BENCH_REGRESS_MEASURED=BENCH_DETAIL.json \
     python bench.py --regress BENCH_DETAIL.json >/dev/null
+
+# capture → replay → parity smoke (ISSUE 11): a tiny two-tenant workload
+# captured with GEOMESA_TPU_WORKLOAD_DIR, replayed closed-loop, must
+# reproduce byte-identical per-query row counts, emit a per-signature
+# recorded-vs-replayed report loadable as a --regress baseline, and hold
+# the K+1 tenant label-cardinality bound on the prometheus exposition.
+echo "[bench-gate] 5/5 workload capture -> replay -> parity smoke"
+python scripts/replay_smoke.py
 
 echo "[bench-gate] OK"

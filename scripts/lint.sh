@@ -56,6 +56,17 @@ JAX_PLATFORMS=cpu python -m pytest \
 # docs/planning.md.
 JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q
 
+# usage & workload plane gate (ISSUE 11): per-tenant metering accuracy
+# vs hand-counted totals, the SpaceSaving heavy-hitter error bound and
+# the K+1 prometheus label-cardinality cap, capture→replay round-trip
+# with row-count parity and deterministic event ordering, tenant
+# propagation across a 2-member federated view, and the <2% overhead
+# bound on the cached-jit select path with capture + metering ON. The
+# usage meter and workload journal locks are leaves of the canonical
+# hierarchy (docs/concurrency.md) — the --race pass above must stay
+# clean with them in the tree.
+JAX_PLATFORMS=cpu python -m pytest tests/test_usage_workload.py -q
+
 # subscription-matrix gate (ISSUE 8): fused-matrix counts byte-equal to
 # the per-query referee across bucket growth/shrink, zero recompiles on
 # the steady path (jaxmon census), add/remove under concurrent appends
@@ -80,7 +91,7 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
     tests/test_geoblocks.py tests/test_bufferpool.py \
-    tests/test_stream_matrix.py -q
+    tests/test_stream_matrix.py tests/test_usage_workload.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

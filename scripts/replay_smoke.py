@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Capture → replay → parity smoke leg (scripts/bench_gate.sh leg 5).
+
+Builds a tiny store, runs a mixed two-tenant query workload with capture
+ON, replays the capture closed-loop against the same store, and asserts:
+
+- byte-identical row counts per replayed query (row parity — the
+  correctness contract of docs/observability.md § Usage metering &
+  workload replay),
+- a recorded-vs-replayed p50/p95 report per plan signature, loadable by
+  ``bench.py --regress`` as a baseline (``configs`` shape),
+- bounded tenant label cardinality on the prometheus exposition
+  (<= K+1 tenant label values per metric),
+- deterministic capture order (strictly increasing seq).
+
+Fast and CPU-only (tiny N, cached-jit steady state): ~seconds.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable from any cwd: the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from geomesa_tpu.geometry.types import Point  # noqa: E402
+from geomesa_tpu.obs import replay, usage, workload  # noqa: E402
+from geomesa_tpu.store.datastore import DataStore  # noqa: E402
+
+T0 = 1500000000000
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="replay-smoke-")
+    prev_journal = workload.install(workload.WorkloadJournal(tmp))
+    prev_meter = usage.install(usage.UsageMeter(k=4))
+    try:
+        rng = np.random.default_rng(7)
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", "name:String,dtg:Date,*geom:Point")
+        ds.write("pts", [
+            {"name": f"n{i % 5}", "dtg": T0 + i * 1000,
+             "geom": Point(float(rng.uniform(-170, 170)),
+                           float(rng.uniform(-40, 40)))}
+            for i in range(400)
+        ], fids=[f"s-{i}" for i in range(400)])
+        ds.compact("pts")
+
+        filters = [
+            "BBOX(geom,-50,-40,50,40)",
+            "BBOX(geom,-170,-40,0,40)",
+            "name = 'n1'",
+            None,
+        ]
+        tenants = ["acme", "globex"]
+        from geomesa_tpu.planning.planner import Query
+
+        for i in range(12):
+            f = filters[i % len(filters)]
+            t = tenants[i % len(tenants)]
+            with usage.tenant_context(t):
+                ds.query("pts", Query(filter=f))
+        workload.flush()
+
+        events = replay.load_events(tmp)
+        if not events:
+            print("FAIL: no events captured", file=sys.stderr)
+            return 1
+        seqs = [e["seq"] for e in sorted(events, key=lambda e: e["seq"])]
+        if seqs != sorted(set(seqs)) or len(seqs) != 12:
+            print(f"FAIL: capture order not deterministic: {seqs}",
+                  file=sys.stderr)
+            return 1
+
+        doc = replay.run(ds, tmp)
+        if not doc["parity_ok"]:
+            print("FAIL: row parity lost:\n"
+                  + json.dumps(doc["row_mismatches"], indent=2),
+                  file=sys.stderr)
+            return 1
+        if not doc["signatures"] or not doc["configs"]:
+            print("FAIL: empty replay report", file=sys.stderr)
+            return 1
+        # the report loads as a bench --regress baseline
+        rpt = os.path.join(tmp, "replay-report.json")
+        replay.write_report(doc, rpt)
+        import bench
+
+        base = bench._load_regress_baseline(rpt)
+        if not base or not all("value" in v for v in base.values()):
+            print("FAIL: replay report not loadable as regress baseline",
+                  file=sys.stderr)
+            return 1
+
+        # tenant label cardinality on the scrape: <= K+1 per metric
+        meter = usage.get()
+        lines = [ln for ln in meter.prometheus_lines()
+                 if ln.startswith("geomesa_tenant_queries_total{")]
+        if len(lines) > meter.k + 1:
+            print(f"FAIL: tenant label cardinality {len(lines)} > "
+                  f"K+1 ({meter.k + 1})", file=sys.stderr)
+            return 1
+        print(f"replay-smoke OK: {doc['events']} events, "
+              f"{len(doc['signatures'])} signatures, parity OK, "
+              f"{len(lines)} tenant series")
+        return 0
+    finally:
+        workload.install(prev_journal)
+        usage.install(prev_meter)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
