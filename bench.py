@@ -1871,6 +1871,7 @@ def bench_chaos():
         inflation = (
             chaos["p99_ms"] / clean["p99_ms"] if clean["p99_ms"] else None
         )
+        serving = _chaos_serving_leg(port, inj, n_per, iters)
         return {
             "metric": "chaos_p99_ms",
             "value": round(chaos["p99_ms"], 3),
@@ -1881,10 +1882,100 @@ def bench_chaos():
                 "members": 3, "rows_per_member": n_per, "iters": iters,
                 "clean": clean, "chaos": chaos,
                 "every_query_answered": chaos["answered"] == iters,
+                "serving": serving,
             },
         }
     finally:
         httpd.shutdown()
+
+
+def _chaos_serving_leg(port: int, inj, n_per: int, iters: int) -> dict:
+    """The ISSUE 12 serving-plane chaos leg: a 3-member sharded
+    federation (consistent-hash Z-prefix router, 3 shards) with one
+    member behind the faulted HTTP hop (same 30% 5xx + latency rules),
+    driven by a two-tenant query mix with admission control OFF and then
+    ON. Reported per mode: p99 of answered queries, the shed fraction
+    (admission on: the hog tenant's offered load over its rate), and
+    the degraded-answer fraction — the acceptance surface is BOUNDED
+    p99 with admission on while only the over-rate tenant sheds."""
+    from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.obs import usage as _usage
+    from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+    from geomesa_tpu.serving.admission import AdmissionController
+    from geomesa_tpu.serving.shards import ShardedDataStoreView
+    from geomesa_tpu.store.datastore import DataStore
+    from geomesa_tpu.store.remote import RemoteDataStore
+
+    rng = np.random.default_rng(17)
+    t0 = 1_500_000_000_000
+    remote = RemoteDataStore(
+        f"http://127.0.0.1:{port}",
+        retry=RetryPolicy(max_attempts=4, base_delay_s=0.002,
+                          max_delay_s=0.02, seed=5),
+        breaker=CircuitBreaker(endpoint=f":{port}", window=20,
+                               min_volume=8, failure_rate=0.6,
+                               cooldown_s=0.2),
+    )
+    view = ShardedDataStoreView(
+        [remote, DataStore(backend="tpu"), DataStore(backend="tpu")],
+        n_shards=3, on_member_error="partial",
+    )
+    view.create_schema("s", "name:String,dtg:Date,*geom:Point")
+    view.write("s", [
+        {"name": f"n{i % 7}", "dtg": t0 + i * 1000,
+         "geom": Point(float(rng.uniform(-170, 170)),
+                       float(rng.uniform(-60, 60)))}
+        for i in range(n_per)
+    ], fids=[f"sv-{i}" for i in range(n_per)])
+    view.compact("s")
+    cqls = [
+        f"BBOX(geom, {x:.0f}, -60, {x + 40:.0f}, 60)"
+        for x in rng.uniform(-170, 130, size=8)
+    ]
+    view.query("s", cqls[0])  # warm
+    tenants = ["hog", "hog", "hog", "polite"]  # hog offers 3x polite
+
+    def _run(admission):
+        lat, degraded, shed = [], 0, {"hog": 0, "polite": 0}
+        answered = 0
+        for i in range(iters):
+            tenant = tenants[i % len(tenants)]
+            if admission is not None:
+                d = admission.admit(tenant, "normal")
+                if not d.admitted:
+                    shed[tenant] += 1
+                    continue
+            s = time.perf_counter()
+            with _usage.tenant_context(tenant):
+                r = view.query("s", cqls[i % len(cqls)])
+            lat.append((time.perf_counter() - s) * 1000.0)
+            answered += 1
+            degraded += int(r.degraded)
+        p50, p95, p99 = (
+            np.percentile(lat, [50, 95, 99]) if lat else (0.0, 0.0, 0.0))
+        total_shed = sum(shed.values())
+        return {
+            "p50_ms": float(p50), "p95_ms": float(p95),
+            "p99_ms": float(p99), "answered": answered,
+            "degraded_fraction": round(degraded / max(answered, 1), 3),
+            "shed_fraction": round(total_shed / iters, 3),
+            "shed_by_tenant": shed,
+        }
+
+    with inj.activate():
+        off = _run(None)
+        # per-tenant rate well under the hog's offered load: the hog
+        # sheds, the polite tenant (1/4 of traffic) stays admitted
+        ac = AdmissionController(
+            rate_qps=float(os.environ.get("GEOMESA_BENCH_ADMIT_RATE", 50)),
+            burst=8.0, min_rate_qps=0.5, metrics=view.metrics)
+        on = _run(ac)
+    return {
+        "shards": 3, "members": 3,
+        "admission_off": off, "admission_on": on,
+        "p99_bounded": bool(
+            on["p99_ms"] <= max(off["p99_ms"], 1e-9) * 1.5 + 5.0),
+    }
 
 
 BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,

@@ -428,8 +428,16 @@ def cmd_serve(args):
         from geomesa_tpu.stream.confluent import SchemaRegistry
 
         registry = SchemaRegistry()
+    admission = None
+    if args.admit or args.admit_rate is not None:
+        from geomesa_tpu.serving.admission import AdmissionController
+
+        admission = AdmissionController(
+            rate_qps=args.admit_rate,
+            metrics=getattr(ds, "metrics", None))
     serve(ds, host=args.host, port=args.port, auth_provider=provider,
-          journal=journal, schema_registry=registry)
+          journal=journal, schema_registry=registry, admission=admission,
+          coalesce_ms=args.coalesce_ms)
 
 
 def cmd_compact(args):
@@ -745,6 +753,21 @@ def main(argv=None):
         "--auths-header", default=None, metavar="HEADER",
         help="derive visibility auths from this trusted proxy header "
         "(AuthorizationsProvider role); absent header = no auths",
+    )
+    sp.add_argument(
+        "--admit", action="store_true",
+        help="enable per-tenant admission control (429 + Retry-After "
+        "sheds, SLO-budget-tied refill — docs/serving.md)",
+    )
+    sp.add_argument(
+        "--admit-rate", type=float, default=None, metavar="QPS",
+        help="per-tenant admission rate (implies --admit; default "
+        "GEOMESA_TPU_ADMIT_RATE or 50)",
+    )
+    sp.add_argument(
+        "--coalesce-ms", type=float, default=None, metavar="MS",
+        help="request-coalescing batch window (default "
+        "GEOMESA_TPU_COALESCE_MS or 2; 0 disables)",
     )
     sp.add_argument(
         "--journal", default=None, metavar="DIR",

@@ -53,6 +53,10 @@ A_DEADLINE = "deadline"
 A_BREAKER = "breaker_open"
 A_DEGRADED = "degraded"
 A_SLOW = "slow"
+# an admission-control shed (serving/admission.py): the request never
+# reached the store — the record exists so "who is being shed and why"
+# is answerable from the flight recorder alone
+A_SHED = "shed"
 
 
 @dataclass

@@ -245,11 +245,16 @@ class UsageMeter:
     # -- hot path -------------------------------------------------------------
     def observe(self, tenant: str | None, type_name: str, signature: str,
                 *, rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
-                device_ms: float = 0.0, ok: bool = True) -> None:
+                device_ms: float = 0.0, ok: bool = True,
+                slo: bool = True) -> None:
         """Account one completed query. ``device_ms`` is the devprof
         attribution total when the query was sampled (0 otherwise — the
         per-tenant device-ms series is a sampled estimate, reconciling
-        with devmon's own attribution within the sampling error)."""
+        with devmon's own attribution within the sampling error).
+        ``slo=False`` skips the tenant's SLO observation: admission
+        SHEDS are metered this way — a shed feeding back into the very
+        budget that caused it would lock the tenant out forever
+        (docs/serving.md § Admission)."""
         t = str(tenant) if tenant else DEFAULT_TENANT
         now = self._clock()
         with self._lock:
@@ -272,7 +277,9 @@ class UsageMeter:
         # the table cap even under an unbounded tenant-id stream.
         if evicted is not None:
             self.slo.forget("tenant.query", evicted)
-        self.slo.observe("tenant.query", ok=ok, latency_ms=wall_ms, key=t)
+        if slo:
+            self.slo.observe("tenant.query", ok=ok, latency_ms=wall_ms,
+                             key=t)
 
     def note_bytes_out(self, tenant: str | None, nbytes: int) -> None:
         """Attribute response payload bytes (the web layer's serialized
@@ -433,8 +440,9 @@ def install(meter: UsageMeter) -> UsageMeter:
 
 def observe(tenant: str | None, type_name: str, signature: str, *,
             rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
-            device_ms: float = 0.0, ok: bool = True) -> None:
+            device_ms: float = 0.0, ok: bool = True,
+            slo: bool = True) -> None:
     """Module-level hot-path helper (what ``DataStore._audit`` calls)."""
     _meter.observe(tenant, type_name, signature, rows=rows,
                    bytes_out=bytes_out, wall_ms=wall_ms,
-                   device_ms=device_ms, ok=ok)
+                   device_ms=device_ms, ok=ok, slo=slo)

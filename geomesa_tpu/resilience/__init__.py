@@ -26,6 +26,7 @@ from geomesa_tpu.resilience.policy import (  # noqa: F401 — public surface
     CircuitBreaker,
     CircuitOpenError,
     CorruptPayloadError,
+    RateLimitedError,
     RetryPolicy,
     is_member_failure,
     retryable,
@@ -36,6 +37,7 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "CorruptPayloadError",
+    "RateLimitedError",
     "RetryPolicy",
     "is_member_failure",
     "retryable",

@@ -36,7 +36,11 @@ import urllib.request
 from geomesa_tpu.obs import trace as _trace
 from geomesa_tpu.obs import usage as _usage
 from geomesa_tpu.resilience import faults
-from geomesa_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+from geomesa_tpu.resilience.policy import (
+    CircuitBreaker,
+    RateLimitedError,
+    RetryPolicy,
+)
 from geomesa_tpu.utils.timeouts import Deadline, QueryTimeout
 
 __all__ = ["DEADLINE_HEADER", "TENANT_HEADER", "fetch", "map_http_error",
@@ -228,7 +232,23 @@ def request(
                                  on_retry=_on_retry)
         except urllib.error.HTTPError as e:
             if not map_errors:
+                # raw-error callers (remote journal, schema registry)
+                # classify HTTPError codes themselves — 429 included
                 raise
+            if e.code == 429:
+                # the remote's admission controller shed this request
+                # (serving/admission.py): surface the typed, honor-the-
+                # Retry-After error — the retry loop above never retried
+                # it (classified non-retryable), so a shedding member
+                # costs ONE round trip, not a retry storm
+                ra = None
+                try:
+                    hdr = e.headers.get("Retry-After") if e.headers else None
+                    ra = float(hdr) if hdr else None
+                except (TypeError, ValueError):
+                    ra = None
+                raise RateLimitedError(
+                    url, 1.0 if ra is None else ra) from None
             if e.code == 504:
                 # the remote shed/expired the work: the federation-wide
                 # timeout surface, same type the local watchdog raises

@@ -30,6 +30,7 @@ __all__ = [
     "CircuitOpenError",
     "CorruptPayloadError",
     "MEMBER_FAILURE_TYPES",
+    "RateLimitedError",
     "RetryPolicy",
     "is_member_failure",
     "retryable",
@@ -45,6 +46,25 @@ class CircuitOpenError(ConnectionError):
     def __init__(self, endpoint: str, retry_after_s: float):
         super().__init__(
             f"circuit open for {endpoint} (retry in {retry_after_s:.2f}s)"
+        )
+        self.endpoint = endpoint
+        self.retry_after_s = retry_after_s
+
+
+class RateLimitedError(ConnectionError):
+    """A member answered ``429 Too Many Requests`` — its admission
+    controller (serving/admission.py) shed the request. Classified
+    NON-retryable in :func:`retryable`: the server's ``Retry-After``
+    (carried here as ``retry_after_s``) is an explicit back-off
+    instruction, and a local retry loop hammering a shedding endpoint
+    is a retry storm by construction. A ``ConnectionError`` subclass so
+    partial-mode federations degrade on a shed member like any other
+    member failure (:data:`MEMBER_FAILURE_TYPES`)."""
+
+    def __init__(self, endpoint: str, retry_after_s: float):
+        super().__init__(
+            f"rate limited by {endpoint} "
+            f"(retry after {retry_after_s:.2f}s)"
         )
         self.endpoint = endpoint
         self.retry_after_s = retry_after_s
@@ -82,6 +102,14 @@ def retryable(exc: BaseException, idempotent: bool) -> bool:
     applied the write, and replaying it could double-append."""
     if isinstance(exc, CircuitOpenError):
         return False  # fail fast: the breaker already decided
+    if isinstance(exc, RateLimitedError):
+        return False  # the endpoint TOLD us to back off (Retry-After)
+    if isinstance(exc, urllib.error.HTTPError) and exc.code == 429:
+        # an admission shed: already non-retryable under both branches
+        # below (<500 for reads, response-received for mutations), but
+        # the classification is a CONTRACT — a retry storm against a
+        # shedding endpoint defeats the shed (docs/serving.md)
+        return False
     from geomesa_tpu.utils.timeouts import QueryTimeout
 
     if isinstance(exc, QueryTimeout):

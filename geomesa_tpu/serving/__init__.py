@@ -1,0 +1,45 @@
+"""geomesa_tpu.serving — the production serving plane (ROADMAP item 4).
+
+Three cooperating pieces in front of the store tier (docs/serving.md):
+
+- :mod:`~geomesa_tpu.serving.admission` — per-tenant admission control:
+  token buckets whose refill rate is tied to the tenant's SLO error
+  budget (read live from the :mod:`geomesa_tpu.obs.usage` meter's
+  ``tenant.query`` objective), with priority classes so the lowest
+  priority sheds first under burn. Rejected requests answer
+  ``429 Too Many Requests`` + ``Retry-After``.
+- :mod:`~geomesa_tpu.serving.coalesce` — request coalescing: a
+  batch-window collector that groups concurrent compatible queries per
+  ``(type, op)`` into ONE ``DataStore.select_many`` / ``count_many`` /
+  ``aggregate_many`` device dispatch and demultiplexes the results back
+  to each waiting request thread — batch-parallel predicate evaluation
+  is where the accelerator wins (PAPERS.md), so N concurrent HTTP
+  queries should share one dispatch, not pay N serialized ones.
+- :mod:`~geomesa_tpu.serving.shards` — sharded federation: a
+  consistent-hash shard router keyed by Z-prefix (reusing
+  :mod:`geomesa_tpu.store.splitter` splits) over N federated members,
+  so writes AND reads both partition; reads fan out only to the members
+  whose shards a plan's ranges intersect and merge through the
+  :class:`~geomesa_tpu.store.merged.MergedDataStoreView` machinery
+  (resilience / degraded semantics intact).
+
+Admission and coalescing import no jax (``GEOMESA_TPU_NO_JAX=1`` safe);
+the shard router sits on the store tier. All serving locks are leaves of
+the canonical hierarchy (docs/concurrency.md).
+"""
+
+from geomesa_tpu.serving.admission import (  # noqa: F401 — public surface
+    AdmissionController,
+    AdmissionDecision,
+    PRIORITIES,
+    PRIORITY_HEADER,
+)
+from geomesa_tpu.serving.coalesce import Coalescer  # noqa: F401
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "Coalescer",
+    "PRIORITIES",
+    "PRIORITY_HEADER",
+]

@@ -1,0 +1,332 @@
+"""Per-tenant admission control — the serving plane's front gate.
+
+The north star is heavy multi-tenant traffic; until now the web tier
+admitted everything and let deadlines blow downstream. This module sheds
+at the door instead: every query-serving request passes one
+:class:`AdmissionController` check keyed by the caller's tenant
+(``X-Geomesa-Tenant``) and priority class (``X-Geomesa-Priority``),
+answering ``429 Too Many Requests`` + ``Retry-After`` when the tenant is
+over its rate.
+
+Mechanics (docs/serving.md § Admission):
+
+- One token bucket per tenant: capacity ``burst`` tokens, refilled at
+  ``rate_qps`` tokens/second **scaled by the tenant's live SLO error
+  budget** — ``effective_rate = max(min_rate_qps, rate_qps *
+  budget_remaining)`` where ``budget_remaining`` is the ``tenant.query``
+  objective's 5-minute error budget read from the usage meter's SLO
+  engine (the ISSUE 11 substrate). A tenant burning its budget refills
+  slowly and sheds under load; a healthy tenant refills at full rate.
+  The feedback loop is stable by construction: sheds do NOT burn the
+  tenant's SLO (they are metered with ``slo=False``), so a shed tenant's
+  budget recovers as its bad queries age out of the window.
+- Priority classes ``high`` / ``normal`` / ``low``: each class reserves
+  a fraction of the bucket it may not draw below (``low`` 30 %,
+  ``normal`` 10 %, ``high`` 0 %), so under pressure the lowest-priority
+  traffic sheds FIRST and a high-priority request is never shed while
+  low-priority traffic is still being admitted — shedding order is a
+  structural property of the thresholds, not a scheduling race.
+- Every decision lands in the metrics registry
+  (``serving.admission.{admitted,shed}[.<priority>]`` counters), shed
+  decisions additionally land in the usage meter (signature
+  ``admission.shed``, no SLO burn) and the flight recorder (anomaly
+  ``shed``), and the controller's own labeled exposition
+  (``geomesa_admission_*`` series, tenant labels bounded to the top-K
+  shedders + an ``other`` rollup) rides
+  ``GET /api/metrics?format=prometheus``.
+
+Determinism: ``clock`` is injectable (monotonic seconds), so refill and
+Retry-After math is testable without real sleeps.
+
+Locking: one leaf lock guards the bucket table and counters (metrics
+tier in docs/concurrency.md). The SLO budget read happens strictly
+BEFORE the lock is taken (the engine owns its own leaf lock); nothing
+blocking ever runs under ours. No jax anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = [
+    "ADMIT_BURST_ENV", "ADMIT_MIN_RATE_ENV", "ADMIT_RATE_ENV",
+    "AdmissionController", "AdmissionDecision", "PRIORITIES",
+    "PRIORITY_HEADER",
+]
+
+# the caller's priority-class assertion (same proxy-trust posture as
+# X-Geomesa-Tenant: the fronting proxy owns it); WSGI spells it
+# HTTP_X_GEOMESA_PRIORITY
+PRIORITY_HEADER = "X-Geomesa-Priority"
+
+PRIORITIES = ("high", "normal", "low")
+# fraction of the bucket each class may not draw below: low sheds first,
+# high drains the bucket to zero before it ever sheds
+_RESERVE = {"high": 0.0, "normal": 0.10, "low": 0.30}
+
+ADMIT_RATE_ENV = "GEOMESA_TPU_ADMIT_RATE"        # tokens/s per tenant
+ADMIT_BURST_ENV = "GEOMESA_TPU_ADMIT_BURST"      # bucket capacity
+ADMIT_MIN_RATE_ENV = "GEOMESA_TPU_ADMIT_MIN_RATE"  # refill floor under burn
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class AdmissionDecision:
+    """One admit/shed verdict. ``retry_after_s`` is meaningful only when
+    shed: the time until the caller's priority class crosses back over
+    its reserve threshold at the CURRENT refill rate."""
+
+    admitted: bool
+    tenant: str
+    priority: str
+    retry_after_s: float = 0.0
+    reason: str = "ok"  # "ok" | "rate" (bucket below the class reserve)
+    tokens: float = 0.0
+
+
+class _Bucket:
+    """One tenant's token bucket. Mutation is guarded by the OWNING
+    controller's lock."""
+
+    __slots__ = ("tokens", "refilled_at", "last_seen", "admitted", "shed")
+
+    def __init__(self, burst: float, now: float):
+        self.tokens = burst
+        self.refilled_at = now
+        self.last_seen = now
+        self.admitted = 0
+        self.shed = 0
+
+
+class AdmissionController:
+    """Process-wide per-tenant admission control.
+
+    ``meter``: the :class:`~geomesa_tpu.obs.usage.UsageMeter` whose
+    ``tenant.query`` SLO objective supplies the live budget signal
+    (default: the process meter). ``admit`` is the hot path: one SLO
+    budget read (the engine's own leaf lock) + one lock acquisition for
+    the bucket update; shed side effects (flight record, usage counter)
+    run strictly outside the lock.
+    """
+
+    def __init__(self, rate_qps: float | None = None,
+                 burst: float | None = None,
+                 min_rate_qps: float | None = None,
+                 meter=None, metrics=None, max_tenants: int = 256,
+                 slo_window_s: float = 300.0, clock=time.monotonic):
+        self.rate_qps = (rate_qps if rate_qps is not None
+                         else _env_float(ADMIT_RATE_ENV, 50.0))
+        self.burst = (burst if burst is not None
+                      else _env_float(ADMIT_BURST_ENV, 2.0 * self.rate_qps))
+        self.min_rate_qps = (min_rate_qps if min_rate_qps is not None
+                             else _env_float(ADMIT_MIN_RATE_ENV, 1.0))
+        if self.rate_qps <= 0 or self.burst <= 0:
+            raise ValueError("rate_qps and burst must be > 0")
+        self.min_rate_qps = min(max(self.min_rate_qps, 1e-6), self.rate_qps)
+        if meter is None:
+            from geomesa_tpu.obs import usage as _usage
+
+            meter = _usage.get()
+        self.meter = meter
+        self.metrics = metrics
+        self.max_tenants = max(int(max_tenants), 2)
+        self.slo_window_s = slo_window_s
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: bucket table + counters
+        self._buckets: dict[str, _Bucket] = {}
+        # evicted tenants' decision totals fold here (bounded exposition)
+        self._other_admitted = 0
+        self._other_shed = 0
+        self.admitted_count = 0
+        self.shed_count = 0
+        # per-priority totals owned HERE (not read back from an optional
+        # external registry: the exposition must stay internally
+        # consistent with the unlabeled totals on the same scrape)
+        self._pri_admitted = dict.fromkeys(PRIORITIES, 0)
+        self._pri_shed = dict.fromkeys(PRIORITIES, 0)
+
+    # -- the live SLO signal --------------------------------------------------
+    def budget_remaining(self, tenant: str) -> float:
+        """The tenant's ``tenant.query`` error budget left in the
+        controller's window, in [0, 1] (1.0 = untouched)."""
+        tk = self.meter.slo.tracker("tenant.query", tenant)
+        return tk.budget_remaining(self.slo_window_s)
+
+    def effective_rate(self, tenant: str) -> float:
+        """Refill rate for this tenant right now: full rate scaled by
+        budget remaining, floored at ``min_rate_qps`` so a fully burned
+        tenant still trickles back instead of locking out forever."""
+        return max(self.min_rate_qps, self.rate_qps
+                   * self.budget_remaining(tenant))
+
+    # -- the hot path ---------------------------------------------------------
+    def admit(self, tenant: str | None, priority: str = "normal",
+              cost: float = 1.0) -> AdmissionDecision:
+        """Gate one request. Unknown priorities are treated as
+        ``normal`` (a bad header must not become a privilege escalation
+        OR a denial)."""
+        from geomesa_tpu.obs.usage import DEFAULT_TENANT
+
+        t = str(tenant) if tenant else DEFAULT_TENANT
+        p = priority.strip().lower() if priority else "normal"
+        if p not in _RESERVE:
+            p = "normal"
+        # SLO budget read BEFORE our lock (the engine owns its own leaf)
+        rate = self.effective_rate(t)
+        reserve = _RESERVE[p] * self.burst
+        now = self._clock()
+        with self._lock:
+            b = self._buckets.get(t)
+            if b is None:
+                b = self._buckets[t] = _Bucket(self.burst, now)
+                if len(self._buckets) > self.max_tenants:
+                    self._evict_locked(keep=t)
+            dt = now - b.refilled_at
+            if dt > 0:
+                b.tokens = min(self.burst, b.tokens + dt * rate)
+                b.refilled_at = now
+            b.last_seen = now
+            if b.tokens - cost >= reserve:
+                b.tokens -= cost
+                b.admitted += 1
+                self.admitted_count += 1
+                self._pri_admitted[p] += 1
+                decision = AdmissionDecision(True, t, p, tokens=b.tokens)
+            else:
+                b.shed += 1
+                self.shed_count += 1
+                self._pri_shed[p] += 1
+                retry = (reserve + cost - b.tokens) / rate
+                decision = AdmissionDecision(
+                    False, t, p, retry_after_s=max(retry, 1e-3),
+                    reason="rate", tokens=b.tokens)
+        # side effects strictly OUTSIDE the lock
+        self._note(decision)
+        return decision
+
+    def _evict_locked(self, keep: str) -> None:
+        """Fold the least-recently-seen bucket (never ``keep``) into the
+        ``other`` rollup — an unbounded tenant-id stream cannot grow the
+        table or the exposition."""
+        victim_t = min(
+            (t for t in self._buckets if t != keep),
+            key=lambda t: self._buckets[t].last_seen,
+            default=None,
+        )
+        if victim_t is not None:
+            v = self._buckets.pop(victim_t)
+            self._other_admitted += v.admitted
+            self._other_shed += v.shed
+
+    def _note(self, d: AdmissionDecision) -> None:
+        m = self.metrics
+        if m is not None:
+            if d.admitted:
+                m.counter("serving.admission.admitted").inc()
+                m.counter(f"serving.admission.admitted.{d.priority}").inc()
+            else:
+                m.counter("serving.admission.shed").inc()
+                m.counter(f"serving.admission.shed.{d.priority}").inc()
+        if d.admitted:
+            return
+        # a shed decision is an operator-facing anomaly AND a usage
+        # signal: meter it against the tenant WITHOUT burning its SLO
+        # (shed feedback into the budget would lock the tenant out)
+        self.meter.observe(
+            d.tenant, "", "admission.shed", rows=0, wall_ms=0.0,
+            slo=False,
+        )
+        from geomesa_tpu.obs import flight as _flight
+
+        _flight.record(
+            op="admission", type_name="", source="serving",
+            plan=f"shed priority={d.priority} "
+                 f"retry_after={d.retry_after_s:.3f}s",
+            latency_ms=0.0, rows=0, tenant=d.tenant,
+            anomalies=(_flight.A_SHED,),
+        )
+
+    # -- read surfaces --------------------------------------------------------
+    def snapshot(self, limit: int | None = None) -> dict:
+        """The JSON surface (``/api/metrics`` ``admission`` section)."""
+        with self._lock:
+            rows = sorted(
+                self._buckets.items(),
+                key=lambda kv: (-kv[1].shed, -kv[1].admitted, kv[0]),
+            )
+            if limit is not None:
+                rows = rows[:limit]
+            tenants = [
+                {"tenant": t, "admitted": b.admitted, "shed": b.shed,
+                 "tokens": round(b.tokens, 3)}
+                for t, b in rows
+            ]
+            out = {
+                "rate_qps": self.rate_qps,
+                "burst": self.burst,
+                "min_rate_qps": self.min_rate_qps,
+                "admitted": self.admitted_count,
+                "shed": self.shed_count,
+                "tenant_count": len(self._buckets),
+                "other": {"admitted": self._other_admitted,
+                          "shed": self._other_shed},
+                "tenants": tenants,
+            }
+        for t in out["tenants"]:
+            t["budget_remaining"] = round(
+                self.budget_remaining(t["tenant"]), 4)
+        return out
+
+    def prometheus_lines(self, prefix: str = "geomesa", k: int = 16) -> list:
+        """``geomesa_admission_*`` series: per-priority totals (3 label
+        values each) plus per-tenant shed counters bounded to the top-K
+        shedders + an ``other`` rollup (the usage meter's cardinality
+        posture)."""
+        from geomesa_tpu.obs.usage import escape_label
+
+        with self._lock:
+            if not self._buckets and not (self._other_admitted
+                                          or self._other_shed):
+                return []
+            per_pri_admit = dict(self._pri_admitted)
+            per_pri_shed = dict(self._pri_shed)
+            ranked = sorted(self._buckets.items(),
+                            key=lambda kv: (-kv[1].shed, kv[0]))
+            top, rest = ranked[:k], ranked[k:]
+            shed_rows = [(t, b.shed) for t, b in top]
+            other_shed = self._other_shed + sum(b.shed for _, b in rest)
+            admitted, shed = self.admitted_count, self.shed_count
+        lines = [f"# TYPE {prefix}_admission_admitted_total counter"]
+        lines.append(f"{prefix}_admission_admitted_total {admitted}")
+        for p in PRIORITIES:
+            lines.append(
+                f'{prefix}_admission_admitted_priority_total'
+                f'{{priority="{p}"}} {per_pri_admit[p]}')
+        lines.append(f"# TYPE {prefix}_admission_shed_total counter")
+        lines.append(f"{prefix}_admission_shed_total {shed}")
+        for p in PRIORITIES:
+            lines.append(
+                f'{prefix}_admission_shed_priority_total'
+                f'{{priority="{p}"}} {per_pri_shed[p]}')
+        lines.append(f"# TYPE {prefix}_admission_shed_tenant_total counter")
+        for t, n in shed_rows:
+            lines.append(
+                f'{prefix}_admission_shed_tenant_total'
+                f'{{tenant="{escape_label(t)}"}} {n}')
+        lines.append(
+            f'{prefix}_admission_shed_tenant_total{{tenant="other"}} '
+            f'{other_shed}')
+        return lines
+
+    def prometheus_text(self, prefix: str = "geomesa") -> str:
+        lines = self.prometheus_lines(prefix)
+        return "\n".join(lines) + "\n" if lines else ""

@@ -1,0 +1,293 @@
+"""Request coalescing — concurrent compatible queries share ONE device
+dispatch.
+
+Batch-parallel predicate evaluation is where the accelerator wins
+(PAPERS.md: many-core geospatial processing; the same insight behind the
+ISSUE 8 subscription matrix): ``DataStore.select_many`` answers N
+queries in two device dispatches, but the web tier was dispatching every
+concurrent HTTP query as its own device problem. The
+:class:`Coalescer` closes that gap with a batch-window collector:
+
+- the FIRST request for an idle ``(type, op, auth-scope)`` key opens a
+  batch and dispatches it IMMEDIATELY — sparse traffic pays zero added
+  latency;
+- requests arriving while a dispatch for their key is already in
+  flight gather into the NEXT batch (backpressure batching): its
+  leader waits for the in-flight dispatch to complete — capped at the
+  coalesce window (``~1-5 ms``, ``GEOMESA_TPU_COALESCE_MS``) — then
+  runs the whole gathered batch as ONE ``select_many`` /
+  ``count_many`` / ``aggregate_many`` call and demultiplexes results
+  (or the error) back to every waiter. Under sustained concurrency the
+  steady state is one batched dispatch per round trip, width = the
+  arrival rate × dispatch time, with the window only bounding the
+  worst-case added wait;
+- per-query auths / hints / deadlines are preserved: queries ride the
+  batch as full ``Query`` objects (the store's batched paths apply
+  visibility and reduce semantics per query), and a query whose
+  deadline cannot survive the window **bypasses** it and executes
+  immediately;
+- per-query tenant attribution survives batching: the submitter's
+  request-context tenant is stamped into ``hints["tenant"]`` before the
+  query joins the batch, so the store's ``_audit`` meters EACH member
+  query against ITS tenant even though the dispatch runs on the
+  leader's thread (pinned in tests/test_serving.py).
+
+Observability: coalesce width rides the ``serving.coalesce.width``
+histogram (dispatches = its count, queries = its sum — fewer dispatches
+than queries is the win), bypasses/orphans are counters, and every
+request's span gets a ``coalesced`` event with the batch width.
+
+Locking: one leaf lock guards the open-batch table (metrics tier in
+docs/concurrency.md). The leader's window sleep, the batched store
+call, and every ``Event.wait`` run strictly OUTSIDE it. No jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import replace
+
+__all__ = ["COALESCE_MS_ENV", "Coalescer", "env_window_s"]
+
+COALESCE_MS_ENV = "GEOMESA_TPU_COALESCE_MS"
+_DEFAULT_MS = 2.0
+# a deadline shorter than this many windows bypasses coalescing: the
+# window sleep must never be the thing that blows a tight budget
+_DEADLINE_BYPASS_FACTOR = 2.0
+
+
+def env_window_s() -> float:
+    """The configured coalesce window in seconds (<= 0 disables)."""
+    try:
+        ms = float(os.environ.get(COALESCE_MS_ENV, _DEFAULT_MS))
+    except ValueError:
+        ms = _DEFAULT_MS
+    return max(ms, 0.0) / 1000.0
+
+
+class _Slot:
+    __slots__ = ("q", "result", "error")
+
+    def __init__(self, q):
+        self.q = q
+        self.result = None
+        self.error = None
+
+
+class _Batch:
+    __slots__ = ("items", "done", "go", "width")
+
+    def __init__(self):
+        self.items: list[_Slot] = []
+        self.done = threading.Event()
+        # leader release: set at creation when the key is idle
+        # (immediate dispatch), else by the in-flight dispatch
+        # completing — the window caps the wait either way
+        self.go = threading.Event()
+        self.width = 0
+
+
+class Coalescer:
+    """Batch-window collector over one store.
+
+    ``submit(type_name, op, q)`` returns exactly what the uncoalesced
+    call would: op ``select`` → a ``QueryResult`` (==
+    ``store.query(type_name, q)``), ``count`` → a number, ``aggregate``
+    → one aggregation record or None. A store without the batched
+    surface executes singly (no window sleep)."""
+
+    OPS = ("select", "count", "aggregate")
+
+    def __init__(self, store, window_s: float | None = None, metrics=None,
+                 wait_timeout_s: float = 30.0):
+        self.store = store
+        self.window_s = env_window_s() if window_s is None else window_s
+        if metrics is None:
+            metrics = getattr(store, "metrics", None)
+        if metrics is None:
+            from geomesa_tpu.utils.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self.wait_timeout_s = wait_timeout_s
+        self._lock = threading.Lock()  # leaf: open-batch table only
+        self._open: dict[tuple, _Batch] = {}
+        self._inflight: set[tuple] = set()  # keys mid-dispatch
+        # plain counters for the acceptance math (dispatches < queries)
+        self.dispatch_count = 0
+        self.query_count = 0
+        self.max_width = 0
+
+    # -- batch-key compatibility ----------------------------------------------
+    @staticmethod
+    def _key(type_name: str, op: str, q, kwargs: dict) -> tuple:
+        # auth scope is part of compatibility: queries under different
+        # visibility must never share a batch — a remote-backed
+        # select_many fails CLOSED on mixed auths (blast radius), and
+        # scope-homogeneous batches keep that contract moot
+        auths = (None if q.auths is None
+                 else tuple(sorted(set(q.auths))))
+        if op == "count":
+            return (type_name, op, auths, bool(kwargs.get("loose", True)))
+        if op == "aggregate":
+            gb = kwargs.get("group_by")
+            return (type_name, op, auths,
+                    tuple(gb) if gb else None,
+                    tuple(kwargs.get("value_cols") or ()),
+                    kwargs.get("now_ms"))
+        return (type_name, op, auths)
+
+    def _batch_fn(self, op: str):
+        if op == "select":
+            return getattr(self.store, "select_many", None)
+        if op == "count":
+            return getattr(self.store, "count_many", None)
+        if op == "aggregate":
+            return getattr(self.store, "aggregate_many", None)
+        raise ValueError(f"unknown coalesce op {op!r}")
+
+    # -- the request path -----------------------------------------------------
+    def submit(self, type_name: str, op: str, q, **kwargs):
+        """One request's query. Blocks until ITS result is ready (at
+        most window + batched-dispatch time) and returns it; the
+        leader's store error propagates to every batchmate."""
+        from geomesa_tpu import obs
+
+        fn = self._batch_fn(op)
+        if fn is None or self.window_s <= 0:
+            return self._single(type_name, op, q, fn, kwargs)
+        deadline = q.hints.get("deadline") if q.hints else None
+        if (
+            deadline is not None
+            and deadline.remaining_s()
+            < self.window_s * _DEADLINE_BYPASS_FACTOR
+        ):
+            # the window would eat a meaningful slice of the remaining
+            # budget: execute immediately, never coalesce
+            self.metrics.counter("serving.coalesce.bypass_deadline").inc()
+            obs.event("coalesce_bypass", reason="deadline")
+            return self._single(type_name, op, q, fn, kwargs)
+        q = self._stamp_tenant(q)
+        key = self._key(type_name, op, q, kwargs)
+        slot = _Slot(q)
+        with self._lock:
+            batch = self._open.get(key)
+            leader = batch is None
+            if leader:
+                batch = self._open[key] = _Batch()
+                if key not in self._inflight:
+                    # idle key: dispatch immediately, zero added latency
+                    batch.go.set()
+            batch.items.append(slot)
+        if leader:
+            # gather while any in-flight dispatch for this key drains;
+            # the window caps the wait (go fires early on completion,
+            # and was pre-set when the key was idle)
+            batch.go.wait(self.window_s)
+            with self._lock:
+                if self._open.get(key) is batch:
+                    del self._open[key]
+                self._inflight.add(key)
+            try:
+                # the leader's thread runs the batched dispatch: the
+                # store's own spans (select_many + per-query children)
+                # land in ITS trace tree
+                self._execute(type_name, op, batch, kwargs)
+            finally:
+                with self._lock:
+                    self._inflight.discard(key)
+                    nxt = self._open.get(key)
+                if nxt is not None:
+                    # release the batch that gathered behind us — the
+                    # backpressure handoff (outside every lock)
+                    nxt.go.set()
+            obs.event("coalesced", width=batch.width, op=op, leader=True)
+        else:
+            # the follower's tree still shows ITS query: a span whose
+            # duration is the wait for the shared dispatch (this
+            # request's real store latency), carrying the coalesce
+            # linkage as an event
+            with obs.span("query", coalesced=True, op=op):
+                if not batch.done.wait(self.wait_timeout_s):
+                    # defensive: a wedged leader must not strand the
+                    # request — fall back to a single execution (counted)
+                    self.metrics.counter("serving.coalesce.orphaned").inc()
+                    return self._single(type_name, op, q, fn, kwargs)
+                obs.event("coalesced", width=batch.width, op=op,
+                          leader=False)
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def _stamp_tenant(self, q):
+        """Resolve the submitter's tenant NOW (its request context) and
+        pin it on the query: the batched dispatch runs on the leader's
+        thread, whose ambient tenant must not absorb the whole batch's
+        usage attribution."""
+        from geomesa_tpu.obs import usage as _usage
+
+        if q.hints and q.hints.get("tenant"):
+            return q
+        return replace(q, hints={**(q.hints or {}),
+                                 "tenant": _usage.current_tenant()})
+
+    def _single(self, type_name: str, op: str, q, fn, kwargs):
+        """Uncoalesced execution (store lacks the batched op, window
+        off, deadline bypass, or orphaned waiter)."""
+        if op == "select":
+            # the ordinary query path: full individual plan/audit
+            return self.store.query(type_name, q)
+        if fn is not None:
+            return self._dispatch(type_name, op, fn, [q], kwargs)[0]
+        if op == "count":
+            return self.store.query(type_name, q).count
+        raise ValueError(
+            f"store has no batched surface for op {op!r}")
+
+    def _dispatch(self, type_name: str, op: str, fn, qs: list, kwargs):
+        if op == "select":
+            return fn(type_name, qs)
+        if op == "count":
+            return fn(type_name, qs, loose=bool(kwargs.get("loose", True)))
+        return fn(
+            type_name, qs,
+            group_by=kwargs.get("group_by"),
+            value_cols=kwargs.get("value_cols", ()),
+            now_ms=kwargs.get("now_ms"),
+        )
+
+    def _execute(self, type_name: str, op: str, batch: _Batch,
+                 kwargs: dict) -> None:
+        """The leader's half: ONE batched store call, results (or the
+        error) demultiplexed to every slot. Runs outside every lock."""
+        batch.width = len(batch.items)
+        self.metrics.histogram("serving.coalesce.width").update(batch.width)
+        self.metrics.counter("serving.coalesce.dispatches").inc()
+        self.metrics.counter("serving.coalesce.queries").inc(batch.width)
+        with self._lock:
+            self.dispatch_count += 1
+            self.query_count += batch.width
+            if batch.width > self.max_width:
+                self.max_width = batch.width
+        fn = self._batch_fn(op)
+        try:
+            if op == "select" and batch.width == 1:
+                # nothing coalesced: run the ordinary query path so the
+                # single query keeps its full individual plan/audit
+                # (batched dispatches deliberately don't feed the
+                # adaptive planner's cost table — a width-1 batch must
+                # not starve it). Results are identical either way.
+                results = [self.store.query(type_name, batch.items[0].q)]
+            else:
+                results = self._dispatch(
+                    type_name, op, fn, [s.q for s in batch.items], kwargs)
+            for slot, r in zip(batch.items, results):
+                slot.result = r
+        except BaseException as e:  # noqa: BLE001 — every waiter gets it
+            for slot in batch.items:
+                slot.error = e
+        finally:
+            batch.done.set()
+        if batch.items and batch.items[0].error is not None:
+            raise batch.items[0].error

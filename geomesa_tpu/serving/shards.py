@@ -1,0 +1,410 @@
+"""Sharded federation — a consistent-hash shard router keyed by Z-prefix.
+
+ROADMAP item 4's horizontal story: one store per host stops scaling when
+the working set outgrows one device's HBM. This module partitions a
+feature type across N federated members by Z2 key prefix (the same key
+domain :mod:`geomesa_tpu.store.splitter` seeds device shard boundaries
+from), so **writes and reads both partition**:
+
+- :class:`ShardRouter` cuts the 62-bit Z2 domain into ``n_shards``
+  contiguous key ranges (``splitter.default_splits``) and assigns each
+  shard to a member via a consistent-hash ring (members × virtual
+  nodes): resizing the member set moves only the departed/arrived
+  member's shards, never reshuffles the survivors (docs/serving.md
+  § Shard-map lifecycle).
+- :class:`ShardedDataStoreView` subclasses
+  :class:`~geomesa_tpu.store.merged.MergedDataStoreView`, so the merge,
+  resilience (``on_member_error="partial"`` degraded answers), SLO and
+  flight-recorder semantics are LITERALLY the merged view's — it only
+  narrows the fan-out: a query runs against exactly the members whose
+  shards its plan's Z-ranges intersect (``_member_subset``), and writes
+  split records by their geometry's Z2 key (fid hash for geometry-less
+  rows) so each row lives on exactly ONE member.
+
+Member dedup is load-bearing: several shards routinely map to the same
+member (n_shards > n_members by design), and two overlapping Z-prefix
+ranges landing on one member must fan out to it ONCE — a per-shard
+fan-out would double-count every matching row on that member
+(red/green pinned in tests/test_serving.py).
+
+Fid- and attribute-only filters extract no spatial bounds → they fan
+out to ALL members (deterministically — rows are spatially placed, a
+fid could live anywhere); disjoint filters fan out to NONE.
+
+The router is immutable after construction (no locks); the view adds no
+locks beyond the merged view's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from bisect import bisect_right
+from dataclasses import replace
+
+import numpy as np
+
+from geomesa_tpu import obs
+from geomesa_tpu.curve.sfc import Z2SFC
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import extract
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.schema.columnar import FeatureTable
+from geomesa_tpu.store.merged import MergedDataStoreView
+from geomesa_tpu.store.splitter import default_splits, shard_of
+
+__all__ = ["ShardRouter", "ShardedDataStoreView"]
+
+_Z2_BITS = 62  # 31 bits/dim Morton — the splitter's z2 key domain
+
+
+def _hash64(s: str) -> int:
+    """Stable 64-bit hash (sha1 prefix): ring placement must not depend
+    on PYTHONHASHSEED."""
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+class ShardRouter:
+    """Z-prefix shard map + consistent-hash member assignment.
+
+    ``members``: hashable member ids (the sharded view uses positional
+    indices). ``n_shards`` contiguous Z2 key ranges; each shard's id
+    hashes onto the ring and is owned by the first member clockwise.
+    """
+
+    def __init__(self, members, n_shards: int | None = None,
+                 virtual_nodes: int = 32):
+        self.members = list(members)
+        if not self.members:
+            raise ValueError("shard router needs at least one member")
+        if n_shards is None:
+            n_shards = max(8, 4 * len(self.members))
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = int(n_shards)
+        self.virtual_nodes = int(virtual_nodes)
+        self._pos = {m: i for i, m in enumerate(self.members)}
+        # shard boundaries: n_shards-1 evenly spaced keys in the 62-bit
+        # z2 domain (the device shard-boundary seeding reused at the
+        # federation tier)
+        self.splits = default_splits("z2", self.n_shards, bits=_Z2_BITS)
+        ring = sorted(
+            (_hash64(f"{m!r}#{v}"), i)
+            for i, m in enumerate(self.members)
+            for v in range(self.virtual_nodes)
+        )
+        self._ring_keys = [h for h, _ in ring]
+        self._ring_pos = [i for _, i in ring]
+        self.shard_member = [
+            self.members[self._locate(_hash64(f"shard:{s}"))]
+            for s in range(self.n_shards)
+        ]
+        self._sfc = Z2SFC()
+
+    def _locate(self, h: int) -> int:
+        i = bisect_right(self._ring_keys, h) % len(self._ring_keys)
+        return self._ring_pos[i]
+
+    def with_members(self, members) -> "ShardRouter":
+        """A new router over a resized member set, same shard cuts: the
+        consistent-hash ring guarantees only shards owned by departed
+        (or claimed by arrived) members move (pinned in tests)."""
+        return ShardRouter(members, self.n_shards, self.virtual_nodes)
+
+    # -- key → shard → member -------------------------------------------------
+    def keys_for(self, x, y) -> np.ndarray:
+        """Z2 keys for point coordinates (the write-partition keying)."""
+        return self._sfc.index(np.asarray(x, dtype=np.float64),
+                               np.asarray(y, dtype=np.float64))
+
+    def fid_key(self, fid: str) -> int:
+        """Deterministic key for a geometry-less row: fid hash folded
+        into the 62-bit shard domain."""
+        return _hash64(f"fid:{fid}") >> 2
+
+    def shards_of_keys(self, keys) -> np.ndarray:
+        z = np.asarray(keys, dtype=np.uint64).astype(np.int64)
+        return shard_of(z, self.splits)
+
+    def member_for_shard(self, shard: int):
+        return self.shard_member[int(shard)]
+
+    # -- plan-range → shard intersection --------------------------------------
+    def shards_for_boxes(self, boxes) -> list[int]:
+        """Shard ids whose key range any of the boxes' Z-range covering
+        intersects (each z-interval covers a contiguous shard run)."""
+        zr = self._sfc.ranges(list(boxes))
+        shards: set[int] = set()
+        for lo, hi in zr:
+            s_lo = int(np.searchsorted(self.splits, np.int64(lo),
+                                       side="right"))
+            s_hi = int(np.searchsorted(self.splits, np.int64(hi),
+                                       side="right"))
+            shards.update(range(s_lo, s_hi + 1))
+        return sorted(shards)
+
+    def members_for_filter(self, f, sft) -> list | None:
+        """Member ids a query with this filter must fan out to, DEDUPED
+        (the double-count fix: overlapping Z-prefix ranges on one member
+        fan out to it once). ``None`` = all members (no spatial bounds:
+        fid/attribute-only filters fan out everywhere, deterministically);
+        ``[]`` = provably disjoint (no fan-out at all).
+
+        Extended-geometry types (non-point: polygons, lines) fan out to
+        ALL members when any constraint survives: rows partition by
+        their envelope CENTER's key, but a query box can intersect a
+        geometry whose center key lies far outside the box's Z-ranges —
+        pruning by the box would silently drop matching rows (red/green
+        pinned in tests/test_serving.py). A disjoint filter still fans
+        nowhere: it matches nothing regardless of geometry extent."""
+        if f is None or isinstance(f, ast.Include):
+            return None
+        e = extract(f, sft.geom_field, sft.dtg_field)
+        if e.disjoint:
+            return []
+        if sft.geom_field and not sft.geom_is_points:
+            return None
+        if not e.boxes:
+            return None
+        shards = self.shards_for_boxes(e.boxes)
+        seen: set = set()
+        out: list = []
+        for s in shards:
+            m = self.shard_member[s]
+            if m not in seen:
+                seen.add(m)
+                out.append(m)
+        # stable member order (declaration order), not shard order
+        out.sort(key=self._pos.__getitem__)
+        return out
+
+
+class ShardedDataStoreView(MergedDataStoreView):
+    """Shard-partitioned federation over ``[store, ...]``.
+
+    Reads: the merged view's fan-out/merge/resilience machinery, fanned
+    only to the members the plan's Z-ranges intersect. Writes: schema
+    CRUD applies to every member; ``write`` partitions records by Z2 key
+    so each row lands on exactly one member (write failures raise — a
+    partial write is a correctness error, not a degraded answer).
+    """
+
+    def __init__(self, stores, n_shards: int | None = None,
+                 on_member_error: str = "fail", metrics=None, slo=None,
+                 slo_target: float = 0.999, virtual_nodes: int = 32):
+        super().__init__(stores, on_member_error=on_member_error,
+                         metrics=metrics, slo=slo, slo_target=slo_target)
+        self.router = ShardRouter(
+            list(range(len(self.stores))), n_shards=n_shards,
+            virtual_nodes=virtual_nodes)
+
+    # -- the fan-out narrowing hook (store/merged.py) -------------------------
+    def _member_subset(self, type_name: str, f) -> list | None:
+        try:
+            sft = self.get_schema(type_name)
+        except Exception:  # noqa: BLE001 — let the member call surface it
+            return None
+        return self.router.members_for_filter(f, sft)
+
+    # -- write surface --------------------------------------------------------
+    def create_schema(self, name_or_sft, spec: str | None = None) -> None:
+        for store, _ in self.stores:
+            store.create_schema(name_or_sft, spec)
+
+    def delete_schema(self, name: str) -> None:
+        for store, _ in self.stores:
+            store.delete_schema(name)
+
+    def update_schema(self, name: str, **changes):
+        out = None
+        for store, _ in self.stores:
+            out = store.update_schema(name, **changes)
+        return out
+
+    def compact(self, type_name: str) -> None:
+        """Compact every member that supports it (remote members run
+        their own compactions — the method is absent on the client)."""
+        for store, _ in self.stores:
+            fn = getattr(store, "compact", None)
+            if fn is not None:
+                fn(type_name)
+
+    def _record_members(self, sft, records, fids) -> np.ndarray:
+        """Member position per record: geometry rows key by their
+        envelope center's Z2 code, geometry-less rows by fid hash (row
+        index when fids are auto-generated) — deterministic either way."""
+        from geomesa_tpu.geometry.types import Geometry
+        from geomesa_tpu.geometry.wkt import from_wkt
+
+        n = len(records)
+        keys = np.zeros(n, dtype=np.uint64)
+        xs, ys, geom_rows = [], [], []
+        for i, rec in enumerate(records):
+            g = rec.get(sft.geom_field) if sft.geom_field else None
+            if isinstance(g, str):
+                # WKT accepted anywhere a geometry is (the columnar
+                # tier's GeoTools convention) — it must place by its
+                # COORDINATES, not the fid hash, or point-schema reads
+                # (which prune fan-out by the query box) can never
+                # reach the row
+                g = from_wkt(g)
+            if isinstance(g, Geometry):
+                x0, y0, x1, y1 = g.bbox
+                xs.append((x0 + x1) / 2.0)
+                ys.append((y0 + y1) / 2.0)
+                geom_rows.append(i)
+            else:
+                basis = str(fids[i]) if fids is not None else str(i)
+                keys[i] = np.uint64(self.router.fid_key(basis))
+        if geom_rows:
+            keys[np.asarray(geom_rows)] = self.router.keys_for(xs, ys)
+        shards = self.router.shards_of_keys(keys)
+        return np.asarray(
+            [self.router.member_for_shard(s) for s in shards],
+            dtype=np.int64)
+
+    def write(self, type_name: str, data, fids=None) -> int:
+        sft = self.get_schema(type_name)
+        if isinstance(data, FeatureTable):
+            if fids is None:
+                fids = list(data.fids)
+            data = [data.record(i) for i in range(len(data))]
+        records = list(data)
+        if fids is not None:
+            fids = [str(f) for f in fids]
+            if len(fids) != len(records):
+                raise ValueError("fids length must match records")
+        members = self._record_members(sft, records, fids)
+        total = 0
+        with obs.span("federation.write", type=type_name,
+                      rows=len(records)):
+            for m in sorted(set(members.tolist())):
+                idx = np.nonzero(members == m)[0]
+                store, _ = self.stores[m]
+                total += store.write(
+                    type_name, [records[i] for i in idx],
+                    fids=[fids[i] for i in idx] if fids is not None
+                    else None,
+                )
+        return total
+
+    # -- batched read surface -------------------------------------------------
+    def _normalize(self, queries) -> list:
+        return [
+            Query(filter=q)
+            if isinstance(q, (str, ast.Filter)) or q is None else q
+            for q in queries
+        ]
+
+    def _fan_plan(self, type_name: str, qs: list):
+        """Per-query member subsets + the member → query-index map."""
+        subs = [
+            self._member_subset(type_name, q.resolved_filter()) for q in qs
+        ]
+        per_member: dict[int, list[int]] = {}
+        for i, sub in enumerate(subs):
+            targets = range(len(self.stores)) if sub is None else sub
+            for m in targets:
+                per_member.setdefault(m, []).append(i)
+        return subs, per_member
+
+    def _member_sub_query(self, q: Query, scope):
+        f = q.resolved_filter()
+        if scope is not None:
+            f = ast.And((f, scope))
+        return replace(q, filter=f, sort_by=None, limit=None,
+                       start_index=None)
+
+    def select_many(self, type_name: str, queries) -> list:
+        """Batched row retrieval across the shard set: each member runs
+        ITS OWN batched ``select_many`` over the queries that intersect
+        it (one device-dispatch pair per member), and per-query tables
+        merge at the view with sort/limit re-applied — the merged view's
+        query-path semantics, batch-shaped."""
+        from geomesa_tpu.store.datastore import QueryResult
+        from geomesa_tpu.store.reduce import sort_limit
+
+        qs = self._normalize(queries)
+        sft = self.get_schema(type_name)
+        subs, per_member = self._fan_plan(type_name, qs)
+        tables: list[list] = [[] for _ in qs]
+        failed: list[list] = [[] for _ in qs]
+        errors: list = []
+        with obs.span("federation.select_many", type=type_name,
+                      n_queries=len(qs), members=len(per_member)):
+            for m in sorted(per_member):
+                store, scope = self.stores[m]
+                idxs = per_member[m]
+                subqs = [self._member_sub_query(qs[i], scope)
+                         for i in idxs]
+                sm = getattr(store, "select_many", None)
+                if sm is not None:
+                    fn = lambda s=sm, sq=subqs: s(type_name, sq)  # noqa: E731
+                else:
+                    fn = lambda s=store, sq=subqs: [  # noqa: E731
+                        s.query(type_name, q1) for q1 in sq]
+                ok, res = self._member_run(m, fn, errors)
+                if not ok:
+                    for i in idxs:
+                        failed[i].append(m)
+                    continue
+                for i, r in zip(idxs, res):
+                    tables[i].append(r.table)
+        if errors and len(errors) == len(per_member):
+            raise errors[-1][1]
+        if errors:
+            self._note_degraded(errors, "select_many")
+        out: list = []
+        for i, q in enumerate(qs):
+            parts = tables[i]
+            if not parts:
+                table = FeatureTable.from_records(sft, [])
+            elif len(parts) == 1:
+                table = parts[0]
+            else:
+                table = FeatureTable.concat(parts)
+            rows = np.arange(len(table), dtype=np.int64)
+            table, rows = sort_limit(table, rows, q.sort_by, q.limit,
+                                     q.start_index)
+            degraded = bool(failed[i])
+            out.append(QueryResult(
+                table, rows, degraded=degraded,
+                member_errors=self._error_details(
+                    [e for e in errors if e[0] in failed[i]])
+                if degraded else None,
+            ))
+        return out
+
+    def count_many(self, type_name: str, queries, loose: bool = True):
+        """Batched counts across the shard set: member counts sum per
+        query (rows partition — each row counts on exactly one member).
+        In partial mode a failed member contributes zero (undercount,
+        recorded), the merged view's ``stats_count`` posture."""
+        qs = self._normalize(queries)
+        self.get_schema(type_name)  # surface missing types uniformly
+        subs, per_member = self._fan_plan(type_name, qs)
+        totals = [0] * len(qs)
+        errors: list = []
+        with obs.span("federation.count_many", type=type_name,
+                      n_queries=len(qs), members=len(per_member)):
+            for m in sorted(per_member):
+                store, scope = self.stores[m]
+                idxs = per_member[m]
+                subqs = [self._member_sub_query(qs[i], scope)
+                         for i in idxs]
+                cm = getattr(store, "count_many", None)
+                if cm is not None:
+                    fn = lambda s=cm, sq=subqs: s(  # noqa: E731
+                        type_name, sq, loose=loose)
+                else:
+                    fn = lambda s=store, sq=subqs: [  # noqa: E731
+                        s.query(type_name, q1).count for q1 in sq]
+                ok, res = self._member_run(m, fn, errors)
+                if not ok:
+                    continue
+                for i, c in zip(idxs, res):
+                    totals[i] += int(c)
+        if errors and len(errors) == len(per_member):
+            raise errors[-1][1]
+        if errors:
+            self._note_degraded(errors, "count_many")
+        return totals

@@ -36,6 +36,20 @@ def _ttl_cutoff_ms(ttl_ms: int, now_ms: int | None = None) -> int:
     return (int(_time.time() * 1000) if now_ms is None else now_ms) - ttl_ms
 
 
+# hints that are pure execution METADATA (attribution, never semantics):
+# they change neither results nor the execution contract, so the batched
+# and cached paths must not decline on them — the serving coalescer
+# (serving/coalesce.py) stamps "tenant" on every query it batches so a
+# shared dispatch meters each member query against ITS tenant
+_METADATA_HINTS = frozenset({"tenant"})
+
+
+def _semantic_hints(q) -> bool:
+    """True when the query carries hints that can alter results or the
+    execution contract (everything except the metadata set above)."""
+    return any(k not in _METADATA_HINTS for k in q.hints)
+
+
 def _pure_bbox_time(f: ast.Filter, sft: FeatureType) -> bool:
     """True when the filter is a conjunction of spatial-box/temporal
     primaries on the schema's DEFAULT geometry/date fields — fully
@@ -1352,7 +1366,7 @@ class DataStore:
             f = q.resolved_filter()
             if (
                 not _pure_bbox_time(f, st.sft)
-                or q.hints
+                or _semantic_hints(q)
                 or q.auths is not None
                 or q.limit is not None
                 or q.start_index is not None
@@ -1899,8 +1913,8 @@ class DataStore:
         """Exact-repeat aggregation cache key: the literal predicate text
         plus GROUP BY and value columns. None = uncacheable (hints, auths,
         paging, or an un-serializable filter)."""
-        if (q.hints or q.auths is not None or q.limit is not None
-                or q.start_index is not None):
+        if (_semantic_hints(q) or q.auths is not None
+                or q.limit is not None or q.start_index is not None):
             return None
         base = DataStore._plan_cache_key(q)
         if base is None:
@@ -1915,7 +1929,7 @@ class DataStore:
         f = q.resolved_filter()
         if (
             not _pure_bbox_time(f, st.sft)
-            or q.hints
+            or _semantic_hints(q)
             or q.auths is not None
             or q.limit is not None
             or q.start_index is not None

@@ -219,6 +219,24 @@ class MergedDataStoreView:
     def _error_details(errors: list) -> list:
         return [(i, type(e).__name__, str(e)) for i, e in errors]
 
+    def _member_subset(self, type_name: str, f) -> list | None:
+        """Member indices a query with this filter must fan out to:
+        ``None`` = all (the merged view's default), ``[]`` = none (a
+        provably disjoint filter). The sharded federation
+        (:class:`geomesa_tpu.serving.shards.ShardedDataStoreView`)
+        overrides this to narrow fan-out to the members whose Z-prefix
+        shards the plan's ranges intersect — member indices stay the
+        DECLARED positions, so SLO keys, metrics counters and the
+        health scoreboard attribute stably across differing subsets."""
+        return None
+
+    def _fan_targets(self, type_name: str, f) -> list:
+        """``[(member_index, (store, scope)), ...]`` for one fan-out."""
+        subset = self._member_subset(type_name, f)
+        if subset is None:
+            return list(enumerate(self.stores))
+        return [(i, self.stores[i]) for i in subset]
+
     def _note_degraded(self, errors: list, op: str) -> None:
         self.metrics.counter("federation.degraded_queries").inc()
         obs.event("degraded", op=op, members_failed=len(errors))
@@ -338,7 +356,21 @@ class MergedDataStoreView:
         bin_parts: list[bytes] = []
         errors: list = []
         base_f = q.resolved_filter()
-        for i, (store, scope) in enumerate(self.stores):
+        targets = self._fan_targets(type_name, base_f)
+        if not targets:
+            # provably disjoint under the shard map: no member can hold
+            # a matching row. Aggregation-hinted queries (density /
+            # stats / bin) still fan to ONE member so the zero answer
+            # keeps its channel shape (a zero grid, empty sketches) —
+            # a disjoint filter matches nothing on ANY member, so one
+            # member's answer IS the global answer. Plain row queries
+            # answer empty without any fan-out.
+            if any(k in q.hints for k in ("density", "stats", "bin")):
+                targets = [(0, self.stores[0])]
+            else:
+                empty = FeatureTable.from_records(sft, [])
+                return QueryResult(empty, np.empty(0, dtype=np.int64)), []
+        for i, (store, scope) in targets:
             f = base_f if scope is None else ast.And((base_f, scope))
             sub = replace(q, filter=f, sort_by=None, limit=None, start_index=None)
             ok, res = self._member_run(
@@ -358,8 +390,8 @@ class MergedDataStoreView:
             if res.density is None and res.stats is None and res.bin_data is None:
                 tables.append(res.table)
 
-        if errors and len(errors) == len(self.stores):
-            # zero members answered: there is no partial to serve
+        if errors and len(errors) == len(targets):
+            # zero ATTEMPTED members answered: no partial to serve
             raise errors[-1][1]
         degraded = bool(errors)
         if degraded:
@@ -410,7 +442,8 @@ class MergedDataStoreView:
         f = parse(cql) if isinstance(cql, str) else cql
         total = 0
         errors: list = []
-        for i, (s, scope) in enumerate(self.stores):
+        targets = self._fan_targets(type_name, f)
+        for i, (s, scope) in targets:
             sub = f if scope is None else (scope if f is None else ast.And((f, scope)))
             ok, n = self._member_run(
                 i, lambda s=s, t=sub: s.stats_count(type_name, t, exact),
@@ -418,7 +451,7 @@ class MergedDataStoreView:
             if ok:
                 total += n
         if errors:
-            if len(errors) == len(self.stores):
+            if len(errors) == len(targets):
                 raise errors[-1][1]
             self._note_degraded(errors, "stats_count")
         return total
@@ -446,9 +479,20 @@ class MergedDataStoreView:
             for store, _ in self.stores
         ):
             return [None] * len(qs)
+        # fan only to the members ANY query of the batch can touch (the
+        # sharded view's subset hook; None = all, the merged default)
+        subset_u: set | None = set()
+        for q in qs:
+            s = self._member_subset(type_name, q.resolved_filter())
+            if s is None:
+                subset_u = None
+                break
+            subset_u.update(s)
+        targets = (list(enumerate(self.stores)) if subset_u is None
+                   else [(i, self.stores[i]) for i in sorted(subset_u)])
         per_member = []
         errors: list = []
-        for i, (store, scope) in enumerate(self.stores):
+        for i, (store, scope) in targets:
             agg = store.aggregate_many
             subs = []
             for q in qs:

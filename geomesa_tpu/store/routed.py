@@ -76,9 +76,24 @@ class RoutedDataStoreView:
     include/catch-all store when one is declared and it is a different
     store — the degraded-but-answering posture for a routed federation
     whose catch-all holds a full replica.
+
+    ``shard_router`` (docs/serving.md): a
+    :class:`geomesa_tpu.serving.shards.ShardRouter` whose member ids are
+    positions into ``stores``. When set, a spatially-constrained filter
+    whose plan ranges intersect EXACTLY ONE member's shards routes to
+    that member (the data lives there — writes partition by the same
+    map); multi-shard spatial filters fall through to the attribute
+    routes / include store, because a routed view sends each query to
+    one delegate (fan-out + merge is
+    :class:`~geomesa_tpu.serving.shards.ShardedDataStoreView`'s job).
+    Fid and attribute-only filters extract no spatial bounds and keep
+    their classic DETERMINISTIC routes — id store first, then the
+    most-specific attribute route, then include — regardless of the
+    router (pinned in tests/test_serving.py).
     """
 
-    def __init__(self, stores, on_member_error: str = "fail", metrics=None):
+    def __init__(self, stores, on_member_error: str = "fail", metrics=None,
+                 shard_router=None):
         if not stores:
             raise ValueError("routed view needs at least one store")
         if on_member_error not in ("fail", "fallback"):
@@ -86,6 +101,7 @@ class RoutedDataStoreView:
                 f"on_member_error must be 'fail' or 'fallback', "
                 f"got {on_member_error!r}")
         self.on_member_error = on_member_error
+        self.shard_router = shard_router
         if metrics is None:
             from geomesa_tpu.utils.metrics import MetricsRegistry
 
@@ -139,8 +155,14 @@ class RoutedDataStoreView:
         return intersection_schemas(self.stores)
 
     # -- routing -------------------------------------------------------------
-    def route(self, f: "ast.Filter | None"):
-        """The store serving this filter, or None (no matching route)."""
+    def route(self, f: "ast.Filter | None", type_name: str | None = None):
+        """The store serving this filter, or None (no matching route).
+
+        Precedence (each step deterministic): fid filters → the id
+        store; single-shard-owner spatial filters → that member (when a
+        ``shard_router`` is configured and ``type_name`` is known);
+        attribute routes (most-specific first, declaration order on
+        ties); the include store."""
         names, has_fid = filter_properties(f)
 
         def by_attributes():
@@ -153,7 +175,28 @@ class RoutedDataStoreView:
 
         if has_fid and self._id_store is not None:
             return self._id_store
+        if (
+            self.shard_router is not None
+            and type_name is not None
+            and not has_fid
+        ):
+            owner = self._shard_owner(f, type_name)
+            if owner is not None:
+                return owner
         return by_attributes() or self._include
+
+    def _shard_owner(self, f, type_name: str):
+        """The single member owning every shard this filter's plan
+        ranges intersect, or None (unconstrained / multi-owner /
+        unknown type — the classic routes decide)."""
+        try:
+            sft = self.get_schema(type_name)
+        except Exception:  # noqa: BLE001 — delegate surfaces missing types
+            return None
+        members = self.shard_router.members_for_filter(f, sft)
+        if members is not None and len(members) == 1:
+            return self.stores[members[0]]
+        return None
 
     def _with_fallback(self, store, fn):
         """Run one routed call; in ``fallback`` mode a member failure
@@ -177,7 +220,7 @@ class RoutedDataStoreView:
     def query(self, type_name: str, q=None, **kwargs) -> QueryResult:
         if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q, **kwargs)
-        store = self.route(q.resolved_filter())
+        store = self.route(q.resolved_filter(), type_name)
         if store is None:
             # only the empty-result branch needs the (cross-validated)
             # view schema; the delegate validates its own on the happy path
@@ -189,7 +232,7 @@ class RoutedDataStoreView:
         from geomesa_tpu.filter.cql import parse
 
         f = parse(cql) if isinstance(cql, str) else cql
-        store = self.route(f)
+        store = self.route(f, type_name)
         if store is None:
             return 0
         return self._with_fallback(
@@ -198,7 +241,7 @@ class RoutedDataStoreView:
     def explain(self, type_name: str, q=None) -> str:
         if isinstance(q, (str, ast.Filter)) or q is None:
             q = Query(filter=q)
-        store = self.route(q.resolved_filter())
+        store = self.route(q.resolved_filter(), type_name)
         if store is None:
             return "Route: none (empty result)"
         idx = self.stores.index(store)

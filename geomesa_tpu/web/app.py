@@ -79,6 +79,7 @@ _STATUS = {
     403: "403 Forbidden",
     404: "404 Not Found",
     405: "405 Method Not Allowed",
+    429: "429 Too Many Requests",
     500: "500 Internal Server Error",
     504: "504 Gateway Timeout",
 }
@@ -98,11 +99,33 @@ def _jsonable(v):
     return v
 
 
+_ADMISSION_ROUTES = frozenset({
+    # query-serving work subject to admission control: everything that
+    # can reach a scan / device dispatch. Ops surfaces (metrics, obs,
+    # audit, version), schema CRUD, writes, and the coordination planes
+    # (lease/journal/registry) are exempt — shedding a metrics scrape
+    # would blind the operator to the shed itself.
+    "_query", "_sql", "_count_many", "_select_many", "_density_many",
+    "_aggregate", "_stats", "_stats_count", "_stats_bounds",
+    "_stats_topk", "_density", "_wfs", "_wms",
+})
+
+
 class GeoMesaApp:
-    """WSGI application over one :class:`DataStore` (or merged view)."""
+    """WSGI application over one :class:`DataStore` (or merged view).
+
+    ``admission``: a :class:`geomesa_tpu.serving.admission.
+    AdmissionController` gating the query-serving routes (None = admit
+    everything, the classic behavior); shed requests answer 429 +
+    ``Retry-After``. ``coalesce_ms``: the request-coalescing batch
+    window (None = ``GEOMESA_TPU_COALESCE_MS``, default 2 ms; <= 0
+    disables) — concurrent compatible ``/query`` requests share one
+    batched device dispatch (docs/serving.md).
+    """
 
     def __init__(self, store, auth_provider=None, journal=None,
-                 schema_registry=None):
+                 schema_registry=None, admission=None,
+                 coalesce_ms: float | None = None):
         # auth_provider: security.auth.AuthorizationsProvider — derives the
         # caller's visibility auths from the request (None = unrestricted,
         # the single-tenant default)
@@ -119,6 +142,16 @@ class GeoMesaApp:
         self.journal = journal
         self.schema_registry = schema_registry
         self.leases = LeaseService()
+        self.admission = admission
+        from geomesa_tpu.serving.coalesce import Coalescer, env_window_s
+
+        window_s = (env_window_s() if coalesce_ms is None
+                    else max(float(coalesce_ms), 0.0) / 1000.0)
+        self.coalescer = (
+            Coalescer(store, window_s=window_s,
+                      metrics=getattr(store, "metrics", None))
+            if window_s > 0 else None
+        )
         self.routes = [
             # Confluent Schema Registry wire protocol (the
             # geomesa-kafka-confluent service half)
@@ -225,6 +258,39 @@ class GeoMesaApp:
                 if match:
                     matched_path = True
                     if m == method:
+                        if (
+                            self.admission is not None
+                            and handler.__name__ in _ADMISSION_ROUTES
+                        ):
+                            # the serving plane's front gate: per-tenant
+                            # token bucket + priority class; a shed
+                            # answers 429 + Retry-After BEFORE any scan
+                            # or device work (docs/serving.md)
+                            import math
+
+                            decision = self.admission.admit(
+                                tenant or None,
+                                environ.get("HTTP_X_GEOMESA_PRIORITY")
+                                or "normal",
+                            )
+                            if not decision.admitted:
+                                if metrics is not None:
+                                    metrics.counter("web.shed").inc()
+                                return self._respond(
+                                    start_response, 429,
+                                    {
+                                        "error": "admission shed: tenant "
+                                                 "over rate/budget",
+                                        "retry_after_s": round(
+                                            decision.retry_after_s, 3),
+                                    },
+                                    "application/json",
+                                    extra_headers=[(
+                                        "Retry-After",
+                                        str(max(1, math.ceil(
+                                            decision.retry_after_s))),
+                                    )],
+                                )
                         # one trace root per request: each server thread's
                         # ContextVar starts empty, so concurrent requests
                         # build disjoint span trees; the handler's store
@@ -703,7 +769,15 @@ class GeoMesaApp:
     def _query(self, name, params, body):
         q = self._parse_query(params)
         fmt = params.get("format", "geojson")
-        r = self.store.query(name, q)
+        if self.coalescer is not None:
+            # request coalescing (docs/serving.md): concurrent /query
+            # requests for the same type share ONE select_many device
+            # dispatch; per-query auths/hints/deadlines are preserved,
+            # and a deadline too tight for the window bypasses it. A
+            # store without select_many executes singly (no window).
+            r = self.coalescer.submit(name, "select", q)
+        else:
+            r = self.store.query(name, q)
         from geomesa_tpu.web.formats import UnknownFormat, format_table
 
         try:
@@ -1029,6 +1103,10 @@ class GeoMesaApp:
             # cardinality (top-K tenants + an "other" rollup) plus the
             # per-tenant SLO burn gauges
             text += _usage.get().prometheus_text()
+            # admission control: geomesa_admission_* admitted/shed
+            # series (per-priority + bounded per-tenant shed counters)
+            if self.admission is not None:
+                text += self.admission.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -1060,6 +1138,17 @@ class GeoMesaApp:
         meter = _usage.get()
         if meter.observe_count:
             out["tenants"] = meter.snapshot(limit=16)
+        # serving plane: admission decisions + coalesce effectiveness
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot(limit=16)
+        if self.coalescer is not None and self.coalescer.dispatch_count:
+            c = self.coalescer
+            out["coalesce"] = {
+                "window_ms": c.window_s * 1000.0,
+                "dispatches": c.dispatch_count,
+                "queries": c.query_count,
+                "max_width": c.max_width,
+            }
         return 200, out, "application/json"
 
     def _ogc(self, handler, error_cls, params):
@@ -1092,7 +1181,8 @@ class GeoMesaApp:
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True,
-          auth_provider=None, journal=None, schema_registry=None):
+          auth_provider=None, journal=None, schema_registry=None,
+          admission=None, coalesce_ms: float | None = None):
     """Run the API on wsgiref's simple server (dev/ops tool, not a prod WSGI
     container — same posture as the reference's embedded servlets).
 
@@ -1117,7 +1207,8 @@ def serve(store, host: str = "127.0.0.1", port: int = 8080, threads: bool = True
     httpd = make_server(
         host, port,
         GeoMesaApp(store, auth_provider=auth_provider, journal=journal,
-                   schema_registry=schema_registry),
+                   schema_registry=schema_registry, admission=admission,
+                   coalesce_ms=coalesce_ms),
         server_class=cls,
     )
     print(f"geomesa-tpu REST on http://{host}:{port}/api")

@@ -74,6 +74,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_usage_workload.py -q
 # attribution split. See docs/streaming.md.
 JAX_PLATFORMS=cpu python -m pytest tests/test_stream_matrix.py -q
 
+# serving-plane gate (ISSUE 12): per-tenant admission control (token
+# refill under deterministic time injection, priority shed ordering,
+# SLO-budget-tied refill, 429 + Retry-After incl. the RemoteDataStore
+# no-retry-storm contract), request coalescing (concurrent requests
+# share one batched dispatch, byte-identical results, per-tenant
+# metering of coalesced batches), and the consistent-hash sharded
+# federation (write partitioning, fan-out pruning, member dedup
+# double-count fix, degraded semantics). See docs/serving.md.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
@@ -91,7 +101,8 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race_stress.py tests/test_stream.py tests/test_journal_soak.py \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
     tests/test_geoblocks.py tests/test_bufferpool.py \
-    tests/test_stream_matrix.py tests/test_usage_workload.py -q
+    tests/test_stream_matrix.py tests/test_usage_workload.py \
+    tests/test_serving.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and
