@@ -495,6 +495,8 @@ def cmd_obs_costs(args):
     import urllib.request
 
     url = args.url.rstrip("/") + f"/api/obs/costs?limit={args.limit}"
+    if getattr(args, "member", None) is not None:
+        url += f"&member={args.member}"
     with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
         doc = json.load(r)
     if args.json:
@@ -511,6 +513,15 @@ def cmd_obs_costs(args):
               f"{e['profiled']:>5d} {e['wall_ms_p50']:>9.2f} "
               f"{e['wall_ms_p95']:>9.2f} {e['device_ms_p50']:>8.2f} "
               f"{e['rows_p50']:>9.1f} {int(e['bytes_scanned_p50']):>11d}")
+    members = doc.get("members") or []
+    if members:
+        print("\nper-member observed cost (federated fan-out legs):")
+        print(f"{'member':>6s} {'store':<22s} {'type':<14s} {'op':<12s} "
+              f"{'n':>6s} {'wall p50':>9s} {'wall p95':>9s}")
+        for m in members:
+            print(f"{m['member']:>6d} {m['store']:<22s} {m['type']:<14s} "
+                  f"{m['op']:<12s} {m['count']:>6d} "
+                  f"{m['wall_ms_p50']:>9.2f} {m['wall_ms_p95']:>9.2f}")
     cal = doc.get("calibration") or {}
     rows = cal.get("entries", [])
     if rows:
@@ -565,12 +576,84 @@ def cmd_obs_tenants(args):
                   f"{h['error_ms']:>8.1f}")
 
 
+def cmd_obs_audit(args):
+    """Pull a server's continuous correctness auditor (``GET
+    /api/obs/audit``): per-kind checked/passed/diverged/abstained
+    counters, recent divergences with repro-bundle paths, invariant-
+    sweep results — the divergence-triage entry point
+    (docs/operations.md runbook)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/api/obs/audit?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    print(f"auditor: rate={doc['rate']} queue={doc['queue_depth']} "
+          f"dropped={doc['dropped']} errors={doc['errors']} "
+          f"bundles={doc['bundles_written']}"
+          + (f" -> {doc['bundle_dir']}" if doc.get("bundle_dir") else ""))
+    print(f"{'kind':<22s} {'checked':>8s} {'passed':>8s} "
+          f"{'diverged':>9s} {'abstained':>10s}")
+    for kind, c in sorted(doc.get("checks", {}).items()):
+        print(f"{kind:<22s} {c['checked']:>8d} {c['passed']:>8d} "
+              f"{c['diverged']:>9d} {c['abstained']:>10d}")
+    for d in doc.get("divergences", []):
+        print(f"\nDIVERGED [{d['kind']}] {d['type_name']}: {d['detail']}")
+        if d.get("minimized"):
+            print(f"  minimized: {d['minimized']}")
+        if d.get("bundle_path"):
+            print(f"  bundle:    {d['bundle_path']} "
+                  f"(geomesa-tpu replay --bundle)")
+    sweeps = doc.get("sweeps", {})
+    if sweeps:
+        print("\ninvariant sweeps:")
+        for name, r in sorted(sweeps.items()):
+            state = ("VIOLATED" if r.get("violations")
+                     else "abstained" if r.get("abstained")
+                     and not r.get("checked") else "ok")
+            print(f"  {name:<18s} checked={r.get('checked', 0):<5d} "
+                  f"abstained={r.get('abstained', 0):<4d} {state}")
+            for v in r.get("violations", [])[:4]:
+                print(f"    ! {v}")
+
+
 def cmd_replay(args):
     """Replay a captured workload (``GEOMESA_TPU_WORKLOAD_DIR`` capture)
     against a catalog or a live server and print the recorded-vs-replayed
-    report — the replay-before-deploy workflow (docs/operations.md)."""
+    report — the replay-before-deploy workflow (docs/operations.md).
+    ``--bundle`` instead re-executes one audit repro bundle and reports
+    whether its divergence reproduces (exit 3 when it does not)."""
     from geomesa_tpu.obs import replay as _replay
 
+    if args.bundle:
+        if not args.catalog:
+            raise SystemExit("replay --bundle needs -c CATALOG")
+        store = _load(args)
+        doc = _replay.replay_bundle(store, args.bundle)
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            print(f"bundle check={doc['check']} type={doc['type']}")
+            print(f"recorded divergence: {doc['recorded_detail']}")
+            o = doc["original"]
+            print(f"original predicate:  "
+                  f"{'DIVERGES' if o['diverged'] else 'matches referee'}"
+                  + (f" ({o.get('detail')})" if o.get("detail") else ""))
+            m = doc.get("minimized")
+            if m is not None:
+                print(
+                    f"minimized predicate: "
+                    f"{'DIVERGES' if m['diverged'] else 'matches referee'}"
+                    f" [{m['filter']}]")
+            print("reproduced" if doc["reproduced"] else "NOT reproduced")
+        if not doc["reproduced"]:
+            raise SystemExit(3)
+        return
+
+    if not args.workload:
+        raise SystemExit("replay needs --workload DIR|FILE or --bundle PATH")
     remote = bool(args.url)
     if args.url:
         from geomesa_tpu.store.remote import RemoteDataStore
@@ -824,12 +907,19 @@ def main(argv=None):
         "costs", help="pull a server's per-plan-shape observed-cost table"
     )
     obs_common(co)
+    co.add_argument("--member", type=int, default=None,
+                    help="only one federated member's per-member cost rows")
     co.set_defaults(fn=cmd_obs_costs)
     te = obs_sub.add_parser(
         "tenants", help="pull a server's per-tenant usage accounting"
     )
     obs_common(te)
     te.set_defaults(fn=cmd_obs_tenants)
+    au = obs_sub.add_parser(
+        "audit", help="pull a server's continuous correctness auditor"
+    )
+    obs_common(au)
+    au.set_defaults(fn=cmd_obs_audit)
 
     sp = sub.add_parser(
         "replay",
@@ -840,9 +930,13 @@ def main(argv=None):
     sp.add_argument("--backend", default="tpu", choices=["tpu", "oracle"])
     sp.add_argument("--url", default=None,
                     help="replay against a live server instead of a catalog")
-    sp.add_argument("--workload", required=True,
+    sp.add_argument("--workload", default=None,
                     help="capture directory (GEOMESA_TPU_WORKLOAD_DIR) or "
                     "a single capture .jsonl file")
+    sp.add_argument("--bundle", default=None,
+                    help="an audit repro bundle (GEOMESA_TPU_AUDIT_DIR "
+                    "repro-*.json): re-execute its diverging query live + "
+                    "referee and report reproduction (exit 3 if not)")
     sp.add_argument("--tenant", default=None, help="replay one tenant only")
     sp.add_argument("--type", default=None, help="replay one type only")
     sp.add_argument("--source", default=None,

@@ -57,6 +57,11 @@ A_SLOW = "slow"
 # reached the store — the record exists so "who is being shed and why"
 # is answerable from the flight recorder alone
 A_SHED = "shed"
+# a correctness divergence (obs/audit.py): the live answer disagreed
+# with the independent referee re-execution, or an invariant sweep
+# found structural drift — the highest-severity anomaly the recorder
+# carries (a wrong answer outranks a slow one)
+A_DIVERGE = "diverge"
 
 
 @dataclass

@@ -43,7 +43,7 @@ import time
 from geomesa_tpu.obs import usage as _usage
 from geomesa_tpu.obs import workload as _workload
 
-__all__ = ["load_events", "replay", "run", "write_report"]
+__all__ = ["load_events", "replay", "replay_bundle", "run", "write_report"]
 
 # ops the harness knows how to re-issue (every captured shape today is a
 # per-query audit event; batched paths audit per member query)
@@ -270,6 +270,18 @@ def run(store, path_or_dir: str, *, tenant: str | None = None,
     outcomes = replay(store, events, speed=speed, remote=remote)
     return report(events, outcomes,
                   mode=f"open-loop x{speed}" if speed else "closed-loop")
+
+
+def replay_bundle(store, path: str) -> dict:
+    """Re-execute one audit repro bundle (``geomesa-tpu replay
+    --bundle``): run the diverging query's live path AND the
+    independent referee against ``store`` — for both the original and
+    the delta-debugged minimized predicate — and report whether the
+    divergence reproduces. Runs in audit shadow, so a diagnostic replay
+    never trains the planner or bills a tenant."""
+    from geomesa_tpu.obs import audit as _audit
+
+    return _audit.replay_bundle(store, path)
 
 
 def write_report(doc: dict, path: str) -> None:

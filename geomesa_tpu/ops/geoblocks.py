@@ -457,6 +457,15 @@ class QueryCache:
                 "evictions": self.evictions,
             }
 
+    def entries_snapshot(self) -> list:
+        """``[(type_name, key, epoch), ...]`` for every live entry — the
+        auditor's invariant-sweep surface (obs/audit.py): an entry's
+        epoch must never be stamped AHEAD of its type's live epoch, and
+        entries must not outlive their schema."""
+        with self._lock:
+            return [(t, k, e) for (t, k), (e, _res)
+                    in self._entries.items()]
+
     def prometheus_lines(self, prefix: str = "geomesa") -> list[str]:
         snap = self.snapshot()
         lines = []

@@ -1,0 +1,192 @@
+"""Independent referee execution path for the correctness auditor.
+
+Every fast path in the store — the device refine kernels, the exec-cache
+memoized select, the cheap-select route, the GeoBlocks pyramid + query
+cache, coalesced batches, sharded fan-out — ultimately promises the same
+answer as one thing: a host-side f64 evaluation of the full filter AST
+over the base data. This module IS that one thing, kept deliberately
+independent of all of them: no Z-decomposition, no planner, no device
+kernels, no pyramid/cache/memo — a plain NumPy scan over a coherent
+(main, delta) snapshot (the same brute force :class:`OracleBackend`
+uses, factored out so the auditor does not depend on backend plumbing).
+
+The auditor (:mod:`geomesa_tpu.obs.audit`) re-executes sampled live
+queries here and compares:
+
+- selects: fid MULTISET equality (sorted fid lists — duplicate fids
+  across ingests must not mask a dropped row),
+- counts: exact integer equality,
+- grouped aggregations: group-keyed count/sum/min/max with an f64
+  relative tolerance on the folded floats (two correct summation orders
+  may differ in the last ulps; a wrong row never hides inside 1e-9).
+
+No jax anywhere (``GEOMESA_TPU_NO_JAX=1`` safe).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "agg_equal", "fid_sets_equal", "referee_agg", "referee_count",
+    "referee_select",
+]
+
+# relative tolerance for folded f64 values (sum/min/max): order-of-
+# summation noise, not a correctness band — counts are always exact
+F64_RTOL = 1e-9
+
+
+def referee_select(sft, main, delta, q) -> list[str]:
+    """Matching fids (sorted list of str) for one query, evaluated
+    host-side over the (main, delta) snapshot: full f64 filter mask plus
+    record-level visibility for the query's auths. The caller guarantees
+    the query carries no limit/paging/sampling (the auditor's
+    eligibility gate), so the fid multiset is deterministic."""
+    f = q.resolved_filter()
+    vis_field = (sft.user_data or {}).get("geomesa.vis.field")
+    out: list[str] = []
+    for t in (main, delta):
+        if t is None or len(t) == 0:
+            continue
+        rows = np.nonzero(np.asarray(f.mask(t), dtype=bool))[0]
+        if len(rows) == 0:
+            continue
+        if q.auths is not None and vis_field:
+            from geomesa_tpu.security.visibility import apply_visibility
+
+            sub, _keep = apply_visibility(
+                sft, t.take(rows), vis_field, q.auths)
+            out.extend(str(x) for x in sub.fids)
+        else:
+            out.extend(str(t.fids[r]) for r in rows)
+    out.sort()
+    return out
+
+
+def referee_count(sft, main, delta, q) -> int:
+    return len(referee_select(sft, main, delta, q))
+
+
+def referee_agg(sft, main, delta, q, group_by, value_cols,
+                cutoff_ms: int | None = None) -> dict:
+    """Grouped aggregation by brute force: f64 filter mask, optional
+    exact-millisecond TTL cutoff, then per-group-key count/sum/min/max
+    over the value columns (NaN/invalid skipped — the
+    ``DataStore._agg_residency`` convention). Returns
+    ``{key_tuple: {"count": n, "cols": {col: [count, sum, min, max]}}}``
+    — order-insensitive by construction, so the comparison cannot be
+    broken by a legitimate group-ordering difference."""
+    f = q.resolved_filter()
+    group_by = list(group_by or [])
+    value_cols = list(value_cols or [])
+    acc: dict = {}
+    for t in (main, delta):
+        if t is None or len(t) == 0:
+            continue
+        m = np.asarray(f.mask(t), dtype=bool)
+        if cutoff_ms is not None and sft.dtg_field is not None:
+            m &= t.dtg_millis() >= cutoff_ms
+        rows = np.nonzero(m)[0]
+        if len(rows) == 0:
+            continue
+        gcols = [t.columns[g].values for g in group_by]
+        vcols = []
+        for c in value_cols:
+            col = t.columns[c]
+            v = np.asarray(col.values, dtype=np.float64).copy()
+            if col.valid is not None:
+                v[~col.valid] = np.nan
+            vcols.append(v)
+        for r in rows:
+            key = tuple(gc[r] for gc in gcols)
+            g = acc.get(key)
+            if g is None:
+                g = acc[key] = {
+                    "count": 0,
+                    "cols": {c: [0, 0.0, np.inf, -np.inf]
+                             for c in value_cols},
+                }
+            g["count"] += 1
+            for ci, c in enumerate(value_cols):
+                x = vcols[ci][r]
+                if np.isnan(x):
+                    continue
+                s = g["cols"][c]
+                s[0] += 1
+                s[1] += x
+                s[2] = min(s[2], x)
+                s[3] = max(s[3], x)
+    return acc
+
+
+def live_agg_map(result: dict, value_cols) -> dict:
+    """A live ``aggregate_many`` result dict, re-keyed into the referee's
+    order-insensitive shape for comparison."""
+    out: dict = {}
+    for gi, key in enumerate(result["groups"]):
+        cols = {}
+        for c in value_cols:
+            d = result["cols"][c]
+            cols[c] = [int(d["count"][gi]), float(d["sum"][gi]),
+                       float(d["min"][gi]), float(d["max"][gi])]
+        out[tuple(key)] = {"count": int(result["count"][gi]), "cols": cols}
+    return out
+
+
+def fid_sets_equal(live: list, ref: list) -> tuple[bool, str]:
+    """Sorted fid multiset comparison → (equal, human-readable detail)."""
+    if list(live) == list(ref):
+        return True, ""
+    ls, rs = set(live), set(ref)
+    missing = sorted(rs - ls)[:5]
+    extra = sorted(ls - rs)[:5]
+    detail = (f"live={len(live)} referee={len(ref)} rows"
+              + (f"; missing from live: {missing}" if missing else "")
+              + (f"; extra in live: {extra}" if extra else ""))
+    if not missing and not extra:
+        detail += "; duplicate-multiplicity mismatch"
+    return False, detail
+
+
+def _close(a: float, b: float) -> bool:
+    if np.isnan(a) and np.isnan(b):
+        return True
+    if np.isinf(a) or np.isinf(b):
+        return a == b
+    return abs(a - b) <= F64_RTOL * (1.0 + max(abs(a), abs(b)))
+
+
+def agg_equal(live_map: dict, ref_map: dict) -> tuple[bool, str]:
+    """Order-insensitive grouped-aggregate comparison: exact counts,
+    f64-tolerance sums/extrema. Empty groups on either side (count 0)
+    are ignored — both engines emit only matched groups, but the guard
+    costs nothing."""
+    live = {k: v for k, v in live_map.items() if v["count"]}
+    ref = {k: v for k, v in ref_map.items() if v["count"]}
+    if set(live) != set(ref):
+        only_l = sorted(str(k) for k in set(live) - set(ref))[:3]
+        only_r = sorted(str(k) for k in set(ref) - set(live))[:3]
+        return False, (f"group keys differ: live-only={only_l} "
+                       f"referee-only={only_r}")
+    for key, lg in live.items():
+        rg = ref[key]
+        if lg["count"] != rg["count"]:
+            return False, (f"group {key!r}: count live={lg['count']} "
+                           f"referee={rg['count']}")
+        for c, ls in lg["cols"].items():
+            rgc = rg["cols"].get(c)
+            if rgc is None:
+                return False, f"group {key!r}: live-only column {c!r}"
+            if ls[0] != rgc[0]:
+                return False, (f"group {key!r} col {c!r}: valid-count "
+                               f"live={ls[0]} referee={rgc[0]}")
+            if ls[0] == 0:
+                continue  # both empty: min/max sentinels need not match
+            for stat, li, ri in (("sum", ls[1], rgc[1]),
+                                 ("min", ls[2], rgc[2]),
+                                 ("max", ls[3], rgc[3])):
+                if not _close(li, ri):
+                    return False, (f"group {key!r} col {c!r}: {stat} "
+                                   f"live={li!r} referee={ri!r}")
+    return True, ""

@@ -57,7 +57,7 @@ __all__ = [
     "uninstall",
 ]
 
-_KINDS = ("refuse", "http", "latency", "truncate", "corrupt")
+_KINDS = ("refuse", "http", "latency", "truncate", "corrupt", "flip")
 
 
 class FaultRule:
@@ -134,8 +134,8 @@ class FaultInjector:
         err: Exception | None = None
         with self._lock:
             for r in self.rules:
-                if r.kind in ("truncate", "corrupt"):
-                    continue
+                if r.kind in ("truncate", "corrupt", "flip"):
+                    continue  # payload / device-state faults: not transport
                 if r.match and r.match not in key:
                     continue
                 if not r._decide_locked():
@@ -181,6 +181,26 @@ class FaultInjector:
                     for i in range(0, len(buf), max(1, len(buf) // 16)):
                         buf[i] ^= 0xA5
                     out = bytes(buf)
+        return out
+
+    def device_flips(self, type_name: str) -> list[FaultRule]:
+        """Fired ``kind=flip`` rules for one device-state load (the
+        DEVICE-corruption fault: ``TpuBackend.load`` consults this and
+        flips one staged column value per fired rule — the silent-wrong-
+        answer failure mode the correctness auditor exists to catch;
+        obs/audit.py). ``match`` filters by feature-type name; ``at``
+        picks the flipped row (default 0); ``rate``/``times``/``after``
+        schedule as for transport faults."""
+        out: list[FaultRule] = []
+        with self._lock:
+            for r in self.rules:
+                if r.kind != "flip":
+                    continue
+                if r.match and r.match not in type_name:
+                    continue
+                if not r._decide_locked():
+                    continue
+                out.append(r)
         return out
 
     # -- lifecycle ------------------------------------------------------------

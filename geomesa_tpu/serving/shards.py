@@ -110,6 +110,31 @@ class ShardRouter:
         (or claimed by arrived) members move (pinned in tests)."""
         return ShardRouter(members, self.n_shards, self.virtual_nodes)
 
+    def coverage_violations(self) -> list[str]:
+        """Invariant-sweep surface (obs/audit.py): the shard cuts must
+        partition the 62-bit Z2 domain — strictly increasing in-range
+        splits (disjoint AND total by construction of contiguous
+        ranges) — and every shard must be owned by exactly one LIVE
+        member. Returns violation strings, empty when healthy."""
+        out: list[str] = []
+        splits = np.asarray(self.splits, dtype=np.int64)
+        if len(splits) != self.n_shards - 1:
+            out.append(f"{len(splits)} splits for {self.n_shards} shards")
+        if len(splits) and not (np.diff(splits) > 0).all():
+            out.append("shard splits not strictly increasing "
+                       "(ranges overlap or are empty)")
+        if len(splits) and (splits[0] < 0
+                            or int(splits[-1]) >= (1 << _Z2_BITS)):
+            out.append("shard splits outside the 62-bit Z2 domain")
+        if len(self.shard_member) != self.n_shards:
+            out.append(f"{len(self.shard_member)} owners for "
+                       f"{self.n_shards} shards")
+        live = set(self.members)
+        for s, m in enumerate(self.shard_member):
+            if m not in live:
+                out.append(f"shard {s} owned by departed member {m!r}")
+        return out
+
     # -- key → shard → member -------------------------------------------------
     def keys_for(self, x, y) -> np.ndarray:
         """Z2 keys for point coordinates (the write-partition keying)."""
@@ -342,7 +367,8 @@ class ShardedDataStoreView(MergedDataStoreView):
                 else:
                     fn = lambda s=store, sq=subqs: [  # noqa: E731
                         s.query(type_name, q1) for q1 in sq]
-                ok, res = self._member_run(m, fn, errors)
+                ok, res = self._member_run(
+                    m, fn, errors, cost=(type_name, "select_many"))
                 if not ok:
                     for i in idxs:
                         failed[i].append(m)
@@ -398,7 +424,8 @@ class ShardedDataStoreView(MergedDataStoreView):
                 else:
                     fn = lambda s=store, sq=subqs: [  # noqa: E731
                         s.query(type_name, q1).count for q1 in sq]
-                ok, res = self._member_run(m, fn, errors)
+                ok, res = self._member_run(
+                    m, fn, errors, cost=(type_name, "count_many"))
                 if not ok:
                     continue
                 for i, c in zip(idxs, res):

@@ -429,7 +429,48 @@ class TpuBackend(ExecutionBackend):
                     load_pins.enter_context(self.pool.pinned(type_name, name))
         finally:
             load_pins.close()
+        # deterministic device-corruption fault injection (resilience/
+        # faults.py kind="flip"): flips ONE staged device-column value so
+        # the correctness auditor's red legs have a real silent-wrong-
+        # answer to catch. Consulted only when an injector is active —
+        # the fault-free path is one module-global read.
+        from geomesa_tpu.resilience import faults as _faults
+
+        inj = _faults.active()
+        if inj is not None:
+            self._apply_device_flips(inj, type_name, state)
         return state
+
+    @staticmethod
+    def _apply_device_flips(inj, type_name: str, state: dict) -> None:
+        """Apply fired ``kind=flip`` rules: XOR bit 30 into row ``at``
+        of the x/xmin column of EVERY resident index layout — a large
+        silent coordinate corruption the host table does NOT share, so
+        whichever index the planner scans diverges from the referee on
+        exactly the rows the flipped coordinate moves across a query
+        boundary (one flipped value per resident layout; the strategy
+        decider picks the layout freely, so a single-index flip would
+        make the red leg depend on planner mood)."""
+        rules = inj.device_flips(type_name)
+        if not rules:
+            return
+        import jax
+
+        for r in rules:
+            for dev in state.values():
+                if not isinstance(dev, _MeshIndexState):
+                    continue
+                col = "x" if dev.kind == "points" else "xmin"
+                arr = dev.cols[col]
+                host = np.asarray(arr).copy()
+                flat = host.reshape(-1)
+                row = (r.truncate_at or 0) % max(len(flat), 1)
+                flat[row] = np.int32(int(flat[row]) ^ (1 << 30))
+                sharding = getattr(arr, "sharding", None)
+                dev.cols[col] = (
+                    jax.device_put(host, sharding) if sharding is not None
+                    else jax.device_put(host)
+                )
 
     # -- refine payload (int-domain superset bounds) -------------------------
     def _payload(self, sft: FeatureType, e: Extraction, overlap: bool = False):
@@ -510,11 +551,17 @@ class TpuBackend(ExecutionBackend):
                 positions = self._mesh_select_positions(
                     dev, index, extraction, intervals, plan=plan
                 )
-        devmon.costs().observe(
-            type_name, f"sel:{route}",
-            wall_ms=(_time.perf_counter() - t0) * 1000.0,
-            rows=len(positions),
-        )
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        if not _obsaudit.in_shadow():
+            # audit-shadow re-executions (the divergence minimizer runs
+            # the live path repeatedly) must not train the sel:* route
+            # profiles — same hygiene as the _audit-level exclusions
+            devmon.costs().observe(
+                type_name, f"sel:{route}",
+                wall_ms=(_time.perf_counter() - t0) * 1000.0,
+                rows=len(positions),
+            )
         rows = index.perm[positions]
         if isinstance(residual, ast.Include):
             return rows

@@ -115,6 +115,11 @@ class ExplainAnalyze:
     # buffer-pool / query-cache gauges at analyze time (hit/miss/eviction
     # counters + pyramid bytes — DataStore.cache_report)
     cache: "dict | None" = None
+    # correctness-audit verdict for the analyzed execution (obs/audit.py:
+    # pass / diverged / abstained), present when auditing is enabled —
+    # the analyzed query runs with the "audit" hint and the auditor
+    # drains synchronously so the verdict is available here
+    audit: "dict | None" = None
 
     @property
     def stages(self) -> list:
@@ -174,6 +179,12 @@ class ExplainAnalyze:
             if pb:
                 out += "; pyramid bytes " + ", ".join(
                     f"{t}={b}" for t, b in sorted(pb.items()))
+        if self.audit:
+            out += (f"\n  Audit: {self.audit.get('verdict')} "
+                    f"({self.audit.get('kind')}"
+                    + (f": {self.audit['detail']}"
+                       if self.audit.get("detail") else "")
+                    + ")")
         return out + f"\n  Hits: {self.hits}"
 
 
@@ -1027,6 +1038,25 @@ class DataStore:
             )
             q = _replace(q, filter=ast.And((q.resolved_filter(), cut)))
 
+        # correctness-audit tagging (obs/audit.py): the off path is one
+        # module-global bool plus a dict lookup. A sampled (or
+        # hint-tagged) query captures the DATA EPOCH *before* the scan
+        # snapshot — a write landing in between moves the live epoch
+        # past the captured one, and the shadow re-check then abstains
+        # instead of alarming (the capture-order rule cached aggregates
+        # already follow)
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        audit_epoch = None
+        if (not _obsaudit.in_shadow()
+                and (_obsaudit.ENABLED or q.hints.get("audit"))
+                and _obsaudit.eligible_select(q)
+                # eligibility FIRST: an ineligible (density/limit) query
+                # must not burn a sampling tick — the configured rate
+                # applies to auditable traffic
+                and (q.hints.get("audit") or _obsaudit.sampled())):
+            audit_epoch = st.data_epoch()
+
         t_start = _time.perf_counter()
         plan_box = {"info": None, "plan_ms": 0.0}
 
@@ -1125,7 +1155,8 @@ class DataStore:
             if rem <= 0:
                 self.metrics.counter("store.query.timeouts").inc()
                 self.metrics.counter("store.query.deadline_shed").inc()
-                self.slo.observe("store.query", ok=False, key=type_name)
+                if not _obsaudit.in_shadow():
+                    self.slo.observe("store.query", ok=False, key=type_name)
                 self._meter_failed(type_name, q, 0.0)
                 raise QueryTimeout(
                     f"deadline spent before scan of {type_name!r} started")
@@ -1140,8 +1171,9 @@ class DataStore:
             timed_out = True
             wall = (_time.perf_counter() - t_start) * 1000.0
             self.metrics.counter("store.query.timeouts").inc()
-            self.slo.observe(
-                "store.query", ok=False, key=type_name, latency_ms=wall)
+            if not _obsaudit.in_shadow():
+                self.slo.observe(
+                    "store.query", ok=False, key=type_name, latency_ms=wall)
             self._meter_failed(type_name, q, wall)
             raise
         finally:
@@ -1152,6 +1184,12 @@ class DataStore:
         plan_ms = plan_box["plan_ms"]
         scan_ms = (_time.perf_counter() - t_start) * 1000.0 - plan_ms
         self._audit(type_name, q, plan_ms, scan_ms, len(table), info=info)
+        if audit_epoch is not None:
+            # shadow re-execution against the independent referee: the
+            # LIVE answer (post-reduce fids) rides along so the check
+            # compares without re-running this path
+            _obsaudit.get().enqueue_select(
+                self, type_name, q, audit_epoch, table)
         return QueryResult(
             table, rows, info, density=density, stats=stats_out, bin_data=bin_data
         )
@@ -1462,6 +1500,17 @@ class DataStore:
             or not self._device_available()
         ):
             return [_fallback(i) for i in range(len(qs))]
+        # audit epoch for the batched tail (the coalescer's shared
+        # dispatches and the sharded view's per-member batches both land
+        # here): read BEFORE the snapshot so a racing write abstains
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        audit_epoch = None
+        if not _obsaudit.in_shadow() and (
+                _obsaudit.ENABLED
+                or any(q.hints.get("audit") for q in qs)):
+            audit_epoch = st.data_epoch()
+
         t_start = _time.perf_counter()
         main, indices, backend_state, stats, delta_table = st.snapshot()
         main_n = 0 if main is None else len(main)
@@ -1551,6 +1600,12 @@ class DataStore:
                     tbl, rws, info, density=density, stats=stats_out,
                     bin_data=bin_data,
                 )
+                if (audit_epoch is not None
+                        and _obsaudit.eligible_select(q)
+                        and (q.hints.get("audit")
+                             or (_obsaudit.ENABLED and _obsaudit.sampled()))):
+                    _obsaudit.get().enqueue_select(
+                        self, type_name, q, audit_epoch, tbl)
         return results
 
     def count_many(self, type_name: str, queries, loose: bool = True):
@@ -1582,6 +1637,18 @@ class DataStore:
 
         def _exact(q):
             return self.query(type_name, q).count
+
+        # audit epoch for batched EXACT counts (loose counts are a
+        # documented int-domain superset — comparing them to the exact
+        # referee would alarm by design, so only loose=False audits);
+        # read BEFORE the _batch_gate snapshot so racing writes abstain
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        audit_epoch = None
+        if (not loose and not _obsaudit.in_shadow()
+                and (_obsaudit.ENABLED
+                     or any(q.hints.get("audit") for q in qs))):
+            audit_epoch = st.data_epoch()
 
         main, main_n, dev, bbox_dev, batchable, perm = self._batch_gate(
             st, want_bbox=True
@@ -1698,6 +1765,12 @@ class DataStore:
                 continue  # device failover: the exact path audits these
             self.metrics.counter("store.queries").inc()
             self._audit(type_name, qs[i], 0.0, 0.0, out[i])
+            if (audit_epoch is not None
+                    and _obsaudit.eligible_select(qs[i])
+                    and (qs[i].hints.get("audit")
+                         or (_obsaudit.ENABLED and _obsaudit.sampled()))):
+                _obsaudit.get().enqueue_count(
+                    self, type_name, qs[i], audit_epoch, int(out[i]))
         for i, q in enumerate(qs):
             if out[i] is None:
                 out[i] = _exact(q)
@@ -2093,6 +2166,35 @@ class DataStore:
 
     def aggregate_many(self, type_name: str, queries, group_by=None,
                        value_cols=(), now_ms: int | None = None):
+        """See :meth:`_aggregate_many_impl` (the engine). This wrapper
+        adds the correctness-audit hook: sampled (or hint-tagged)
+        answered lanes enqueue a shadow grouped-agg comparison against
+        the independent referee, stamped with the data epoch the engine
+        read BEFORE its snapshot (abstain-on-write semantics)."""
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        box: dict = {}
+        out = self._aggregate_many_impl(
+            type_name, queries, group_by=group_by, value_cols=value_cols,
+            now_ms=now_ms, audit_box=box)
+        if "epoch" in box and not _obsaudit.in_shadow():
+            qs = box["qs"]
+            for i, r in enumerate(out):
+                if r is None:
+                    continue  # declined: the caller's host fold answers
+                q = qs[i]
+                if (_obsaudit.eligible_agg(q)
+                        and (q.hints.get("audit")
+                             or (_obsaudit.ENABLED and _obsaudit.sampled()))):
+                    _obsaudit.get().enqueue_agg(
+                        self, type_name, q, box["epoch"], r,
+                        group_by, value_cols,
+                        cutoff_ms=box.get("cutoff_ms"))
+        return out
+
+    def _aggregate_many_impl(self, type_name: str, queries, group_by=None,
+                             value_cols=(), now_ms: int | None = None,
+                             audit_box: dict | None = None):
         """Batched grouped aggregation on the mesh: ONE fused pass computes,
         per query, COUNT(*) plus per-value-column count/sum/min/max for
         every GROUP BY key — a per-shard segment-reduce merged across the
@@ -2143,6 +2245,12 @@ class DataStore:
         # mutation landing between the two leaves cache entries stamped
         # with a pair that never recurs — a miss, never a stale hit
         epoch = st.data_epoch()
+        if audit_box is not None:
+            # the audit wrapper stamps its shadow checks with the SAME
+            # pre-snapshot epoch (and the normalized/intercepted queries)
+            audit_box["epoch"] = epoch
+            audit_box["qs"] = qs
+            audit_box["cutoff_ms"] = cutoff_ms
         main, indices, backend_state, _stats, delta = st.snapshot()
         main_n = 0 if main is None else len(main)
         if main_n == 0:
@@ -2158,9 +2266,16 @@ class DataStore:
         # stores stay on the fused path (their answers are clock-relative).
         import time as _time
 
+        from geomesa_tpu.obs import audit as _obsaudit
         from geomesa_tpu.obs import devmon as _devmon
 
         devmon_costs = _devmon.costs()
+        # audit-shadow re-executions must not train the gagg route
+        # verdict (the same hygiene _audit applies to the cost table)
+        _observe_gagg = (
+            (lambda *a, **k: None) if _obsaudit.in_shadow()
+            else devmon_costs.observe
+        )
         cache_ctx = None
         if isinstance(self.backend, TpuBackend) and ttl is None:
             cache_ctx = {"epoch": epoch, "keys": {}}
@@ -2206,8 +2321,8 @@ class DataStore:
                     total = int(res["count"].sum())
                     self.metrics.counter("store.queries").inc()
                     self.metrics.counter("store.agg.pyramid_served").inc()
-                    devmon_costs.observe(type_name, "gagg:pyramid",
-                                         wall_ms=wall, rows=total)
+                    _observe_gagg(type_name, "gagg:pyramid",
+                                  wall_ms=wall, rows=total)
                     self._audit(type_name, q, 0.0, wall, total)
                     key = cache_ctx["keys"].get(i)
                     if key is not None:
@@ -2337,7 +2452,7 @@ class DataStore:
                 if key is not None:
                     self.agg_cache.put(
                         type_name, key, cache_ctx["epoch"], out[i])
-                devmon_costs.observe(
+                _observe_gagg(
                     type_name, "gagg:scan",
                     wall_ms=shared_ms
                     + (_time.perf_counter() - tq0) * 1000.0,
@@ -2590,15 +2705,30 @@ class DataStore:
         (deadline shed, watchdog timeout): the heaviest tenants are
         exactly the ones that time out, and an admission controller
         metering only SUCCESSES would never shed them. Burns the
-        tenant's SLO budget (ok=False) and accrues the wall time spent."""
+        tenant's SLO budget (ok=False) and accrues the wall time spent.
+        Audit-shadow executions are excluded (same hygiene as
+        :meth:`_audit`)."""
+        from geomesa_tpu.obs import audit as _obsaudit
         from geomesa_tpu.obs import usage
 
+        if _obsaudit.in_shadow():
+            return
         tenant = q.hints.get("tenant") or usage.current_tenant()
         usage.observe(tenant, type_name, "timeout", wall_ms=wall_ms,
                       ok=False)
 
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float,
                hits: int, info=None) -> None:
+        # audit-shadow executions (obs/audit.py: referee comparisons,
+        # the divergence minimizer, bundle replay) are invisible to the
+        # feedback planes — cost table, usage metering, SLO burn,
+        # workload capture — the same rule ISSUE 11's replay applies to
+        # capture: the auditor must never train the planner it audits,
+        # bill a tenant for verification, or recapture itself
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        if _obsaudit.in_shadow():
+            return
         self.metrics.histogram("store.query.hits").update(hits)
         self.metrics.histogram("store.query.scan_ms").update(scan_ms)
         filt = q.filter if isinstance(q.filter, str) else str(q.filter or "INCLUDE")
@@ -2753,11 +2883,27 @@ class DataStore:
         predicted = devmon.costs().predict(type_name, sig)
         import time as _time
 
+        # under active auditing the analyzed execution is audit-tagged
+        # and the auditor drains synchronously, so the verdict renders
+        # as the `Audit:` line of this ExplainAnalyze
+        from dataclasses import replace as _q_replace
+
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        q_run = q
+        if _obsaudit.enabled():
+            q_run = _q_replace(q, hints={**q.hints, "audit": True})
         with _trace.collect("explain.analyze", type_name=type_name) as root:
             with devmon.profiled() as prof:
                 t0 = _time.perf_counter()
-                res = self.query(type_name, q)
+                res = self.query(type_name, q_run)
                 actual_ms = (_time.perf_counter() - t0) * 1000.0
+        audit_verdict = None
+        if _obsaudit.enabled():
+            aud = _obsaudit.get()
+            aud.drain()
+            audit_verdict = aud.last_verdict(
+                type_name, _obsaudit.filter_text(q_run))
         qspans = root.find("query")
         from geomesa_tpu.planning.costmodel import calibration_error
 
@@ -2783,6 +2929,7 @@ class DataStore:
                 "alternatives": getattr(info, "alternatives", None) or [],
             },
             cache=self.cache_report(),
+            audit=audit_verdict,
         )
 
     # -- stats API (GeoMesaStats role: exact or estimated) -------------------

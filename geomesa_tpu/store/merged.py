@@ -108,13 +108,27 @@ class MergedDataStoreView:
             if scope is not None and not isinstance(scope, ast.Filter):
                 scope = parse(scope)
             self.stores.append((store, scope))
+        # per-member observed-cost aggregates (ROADMAP item-3 leftover:
+        # per-shard cost asymmetry): every fan-out leg records its wall
+        # ms under (member, type, op) — served as the `members` section
+        # of GET /api/obs/costs (?member= filter), the member column of
+        # `geomesa-tpu obs costs`, and the explain scoreboard, so one
+        # slow shard is visible as a COST asymmetry, not just an SLO one
+        import threading
 
-    def _member_run(self, i: int, fn, errors: list, outcomes: list | None = None):
+        self._member_cost_lock = threading.Lock()  # leaf: the table only
+        self._member_costs: dict = {}
+
+    def _member_run(self, i: int, fn, errors: list, outcomes: list | None = None,
+                    cost: tuple | None = None):
         """One member's fan-out leg: ``(ok, result)``. In ``partial``
         mode a member failure is recorded (metrics + SLO + span event +
         the errors list) and skipped; in ``fail`` mode it propagates.
         ``outcomes`` (when passed) collects the flight-recorder member
-        summary: ``(i, "ok" | "error:<Type>", ms)``."""
+        summary: ``(i, "ok" | "error:<Type>", ms)``. ``cost`` —
+        ``(type_name, op)`` — records the leg's wall into the per-member
+        observed-cost table (successful legs only: a fail-fast breaker
+        leg's near-zero wall is not the member's cost of doing the work)."""
         t0 = time.perf_counter()
         try:
             out = fn()
@@ -134,9 +148,53 @@ class MergedDataStoreView:
         ms = (time.perf_counter() - t0) * 1000.0
         self.slo.observe("federation.member", ok=True,
                          latency_ms=ms, key=str(i))
+        if cost is not None:
+            self._note_member_cost(i, cost[0], cost[1], ms)
         if outcomes is not None:
             outcomes.append((i, "ok", ms))
         return True, out
+
+    def _note_member_cost(self, i: int, type_name: str, op: str,
+                          ms: float) -> None:
+        from geomesa_tpu.obs.devmon import _Quantiles
+
+        key = (i, type_name, op)
+        with self._member_cost_lock:
+            ent = self._member_costs.get(key)
+            if ent is None:
+                ent = self._member_costs[key] = [0, _Quantiles()]
+                # bounded: (members × types × ops) is small by
+                # construction, but a type-churning workload must not
+                # grow it forever
+                while len(self._member_costs) > 512:
+                    self._member_costs.pop(next(iter(self._member_costs)))
+            ent[0] += 1
+            ent[1].update(ms)
+
+    def member_costs_snapshot(self, member: int | None = None) -> list:
+        """Per-(member, type, op) observed wall-ms aggregates — the
+        `members` section of ``GET /api/obs/costs`` (``?member=``
+        filters), rendered by ``geomesa-tpu obs costs`` and the merged
+        ``explain`` scoreboard."""
+        with self._member_cost_lock:
+            items = list(self._member_costs.items())
+        out = []
+        for (i, type_name, op), (n, qs) in items:
+            if member is not None and i != member:
+                continue
+            out.append({
+                "member": i,
+                "store": getattr(self.stores[i][0], "base_url",
+                                 type(self.stores[i][0]).__name__)
+                if i < len(self.stores) else "?",
+                "type": type_name,
+                "op": op,
+                "count": n,
+                "wall_ms_p50": round(qs.quantile(0.5), 3),
+                "wall_ms_p95": round(qs.quantile(0.95), 3),
+            })
+        out.sort(key=lambda r: (r["member"], r["type"], r["op"]))
+        return out
 
     @staticmethod
     def _anomalies(errors: list) -> tuple:
@@ -213,6 +271,15 @@ class MergedDataStoreView:
                 f"budget={h['budget_remaining']:.2f} "
                 f"p95={h['p95_ms']:.1f}ms "
                 f"breaker={h['breaker'] or '-'} errors={h['errors']}")
+        costs = self.member_costs_snapshot()
+        rows = [c for c in costs if c["type"] == type_name]
+        if rows:
+            lines.append("Member cost asymmetry (observed wall ms):")
+            for c in rows:
+                lines.append(
+                    f"  member {c['member']} {c['op']:<12s} "
+                    f"n={c['count']:<5d} p50={c['wall_ms_p50']:.2f} "
+                    f"p95={c['wall_ms_p95']:.2f}")
         return "\n".join(lines)
 
     @staticmethod
@@ -375,7 +442,7 @@ class MergedDataStoreView:
             sub = replace(q, filter=f, sort_by=None, limit=None, start_index=None)
             ok, res = self._member_run(
                 i, lambda s=store, t=sub: s.query(type_name, t), errors,
-                outcomes)
+                outcomes, cost=(type_name, "query"))
             if not ok:
                 continue
             if res.density is not None:
@@ -447,7 +514,7 @@ class MergedDataStoreView:
             sub = f if scope is None else (scope if f is None else ast.And((f, scope)))
             ok, n = self._member_run(
                 i, lambda s=s, t=sub: s.stats_count(type_name, t, exact),
-                errors)
+                errors, cost=(type_name, "stats_count"))
             if ok:
                 total += n
         if errors:
@@ -504,7 +571,7 @@ class MergedDataStoreView:
                 i, lambda a=agg, s=subs: a(type_name, s, group_by=group_by,
                                            value_cols=value_cols,
                                            now_ms=now_ms),
-                errors)
+                errors, cost=(type_name, "aggregate"))
             if ok:
                 per_member.append(partials)
         if errors:

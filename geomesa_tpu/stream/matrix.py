@@ -303,6 +303,36 @@ class SubscriptionMatrix:
         with self._lock:
             return self._epoch
 
+    def standing(self) -> list:
+        """``[(sid, predicate), ...]`` for every active subscription —
+        the auditor's standing-count cross-check surface."""
+        with self._lock:
+            return [(sid, sub.predicate) for sid, sub in self._subs.items()]
+
+    def validate_sentinels(self) -> list[str]:
+        """Invariant-sweep surface (obs/audit.py): every MASKED slot must
+        hold the unsatisfiable sentinel payload — a freed slot that
+        could still match would deliver ghost hits against whatever
+        subscription later reuses it. Returns violation strings."""
+        out: list[str] = []
+        with self._lock:
+            for slot, sid in enumerate(self._slots):
+                if sid is not None:
+                    continue
+                if not (np.array_equal(self._boxes[slot], self._unsat_boxes)
+                        and np.array_equal(self._times[slot],
+                                           self._unsat_times)):
+                    out.append(f"slot {slot}: masked but payload differs "
+                               "from the unsat sentinel")
+                    continue
+                # defense in depth: the sentinel itself must be
+                # unsatisfiable — every box slot empty (xlo > xhi), so no
+                # row can pass the spatial test whatever the time rows say
+                b = self._boxes[slot]
+                if not (b[:, 0] > b[:, 1]).all():
+                    out.append(f"slot {slot}: sentinel box rows satisfiable")
+        return out
+
     # -- scan side ------------------------------------------------------------
     def snapshot(self) -> MatrixSnapshot:
         """The scan-side view: slot→sid map plus device-resident query

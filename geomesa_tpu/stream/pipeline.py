@@ -88,6 +88,16 @@ class DeviceStreamScanner:
         self.flush_interval_s = flush_interval_s
         self.topic = topic
         self.keep_tags = keep_tags
+        # event-time watermark support (stream/telemetry.py freshness
+        # gauges): (bin, offset) rows convert back to epoch ms when the
+        # matrix knows its schema's Z3 interval; packed-payload matrices
+        # (bench) skip watermarks
+        self._binned = None
+        sft = getattr(matrix, "sft", None)
+        if sft is not None and getattr(sft, "dtg_field", None):
+            from geomesa_tpu.curve.binned_time import BinnedTime
+
+            self._binned = BinnedTime(sft.z3_interval)
         self._lock = threading.Lock()  # leaf: buffers, queue, stats
         self._cv = threading.Condition(self._lock)
         self._frags: list[tuple] = []  # (x, y, bins, offs, tags) fragments
@@ -414,9 +424,25 @@ class DeviceStreamScanner:
                 if m.any():
                     wide[sid] = idx[m]
         delivered = 0
+        # per-(topic, subscription) delivery watermark: the newest EVENT
+        # time each active subscription has been evaluated THROUGH —
+        # advanced per scanned chunk whether or not it matched (a
+        # rare-match subscription's freshness must not freeze while the
+        # scanner is fully current); freshness gauges derive end-to-end
+        # event-time lag from it at scrape time (docs/streaming.md)
+        wm_ms = None
+        if self._binned is not None and chunk.rows:
+            wb_all = np.asarray(
+                chunk.cols[2][: chunk.rows], dtype=np.int64)
+            wo_all = np.asarray(
+                chunk.cols[3][: chunk.rows], dtype=np.int64)
+            wm_ms = int(self._binned.from_bin_and_offset(
+                wb_all, wo_all).max())
         for slot, sid in enumerate(snap.sids):
             if sid is None:
                 continue
+            if wm_ms is not None:
+                telemetry.note_watermark(self.topic, sid, wm_ms)
             c = int(counts[slot])
             ex = wide.get(sid)
             if ex is not None:
@@ -544,6 +570,12 @@ class SubscriptionHub:
         self._nlon = norm_lon(31)
         self._nlat = norm_lat(31)
         self._rows_ingested = 0
+        # rows already ingested when each subscription registered: the
+        # auditor's standing-count sweep only compares subscriptions
+        # that observed the WHOLE stream (base 0 — registered before any
+        # ingest, or first-with-backlog-replay); later subscribers see a
+        # suffix by contract and must abstain, not alarm
+        self._sub_base: dict[int, int] = {}
 
     def ingest(self, data: bytes) -> None:
         from geomesa_tpu.stream.messages import Put
@@ -593,9 +625,17 @@ class SubscriptionHub:
 
     # -- delegation -----------------------------------------------------------
     def subscribe(self, predicate, callback) -> int:
-        return self.matrix.subscribe(predicate, callback)
+        sid = self.matrix.subscribe(predicate, callback)
+        self._sub_base[sid] = self._rows_ingested
+        return sid
+
+    def sub_base(self, sid: int) -> int:
+        """Rows already ingested when ``sid`` registered (see
+        ``_sub_base``); unknown sids report as late joiners."""
+        return self._sub_base.get(sid, 1 << 62)
 
     def unsubscribe(self, sid: int) -> bool:
+        self._sub_base.pop(sid, None)
         return self.matrix.unsubscribe(sid)
 
     def rows_ingested(self) -> int:
@@ -717,6 +757,12 @@ class HubRegistry:
     def get(self, key: str):
         with self._lock:
             return self._hubs.get(key)
+
+    def items(self) -> list:
+        """``[(key, hub), ...]`` — the auditor's standing-count sweep
+        iterates live hubs through this (obs/audit.py)."""
+        with self._lock:
+            return list(self._hubs.items())
 
     def close_all(self) -> None:
         with self._lock:

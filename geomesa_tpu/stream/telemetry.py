@@ -39,15 +39,19 @@ One leaf lock guards the table; nothing is called while it is held
 from __future__ import annotations
 
 import threading
+import time
 
 __all__ = [
     "set_lag", "set_scan_lag", "note_poll", "note_callback_error",
-    "note_scan", "note_scan_error", "note_deliveries", "report",
-    "prometheus_lines", "prometheus_text", "reset",
+    "note_scan", "note_scan_error", "note_deliveries", "note_watermark",
+    "report", "prometheus_lines", "prometheus_text", "reset",
 ]
 
 _lock = threading.Lock()
 _topics: dict[str, dict] = {}
+# per-topic subscription-watermark cardinality bound: a churny topic
+# must not grow the exposition without limit (oldest sid evicted)
+_MAX_WATERMARK_SUBS = 64
 
 _ZERO = {
     "lag": 0, "scan_lag": 0, "callback_errors": 0, "scan_chunks": 0,
@@ -62,6 +66,7 @@ def _t(topic: str) -> dict:
     if st is None:
         st = dict(_ZERO)
         st["poll_loops"] = {}
+        st["watermarks"] = {}
         _topics[topic] = st
     return st
 
@@ -125,15 +130,38 @@ def note_deliveries(topic: str, n: int) -> None:
         _t(topic)["deliveries"] += int(n)
 
 
+def note_watermark(topic: str, subscription, watermark_ms: int,
+                   clock=time.time) -> None:
+    """Per-(topic, subscription) delivery watermark: the newest EVENT
+    time (epoch ms) delivered to this standing subscription. The
+    freshness gauge (``geomesa_stream_freshness_ms``) is derived at
+    report time as now − watermark — end-to-end event-time lag, the
+    staleness signal the standing-query runbook reads
+    (docs/streaming.md). Monotone per subscription: a late chunk never
+    regresses it."""
+    with _lock:
+        wm = _t(topic)["watermarks"]
+        key = str(subscription)
+        prev = wm.get(key)
+        if prev is not None and prev[0] >= watermark_ms:
+            wm[key] = (prev[0], clock())
+            return
+        if prev is None and len(wm) >= _MAX_WATERMARK_SUBS:
+            wm.pop(next(iter(wm)))
+        wm[key] = (int(watermark_ms), clock())
+
+
 def report() -> dict:
     """Snapshot of every topic's stream gauges (the JSON metrics block).
     Poll stats come back per loop under ``poll_loops`` plus flat compat
     aggregates: ``polls``/``poll_rows`` sum over loops, ``poll_backoff_s``
     is the max (an idle loop's backoff must not be masked by a busy one)."""
+    now_ms = time.time() * 1000.0
     with _lock:
         out = {}
         for topic, st in _topics.items():
-            d = {k: v for k, v in st.items() if k != "poll_loops"}
+            d = {k: v for k, v in st.items()
+                 if k not in ("poll_loops", "watermarks")}
             loops = {lp: dict(ls) for lp, ls in st["poll_loops"].items()}
             d["poll_loops"] = loops
             d["polls"] = sum(ls["polls"] for ls in loops.values())
@@ -141,6 +169,12 @@ def report() -> dict:
             d["poll_backoff_s"] = max(
                 (ls["poll_backoff_s"] for ls in loops.values()), default=0.0
             )
+            # freshness derived at read time: now − event-time watermark
+            d["watermarks"] = {
+                sub: {"watermark_ms": wm,
+                      "freshness_ms": round(max(now_ms - wm, 0.0), 1)}
+                for sub, (wm, _at) in st["watermarks"].items()
+            }
             out[topic] = d
         return out
 
@@ -199,6 +233,21 @@ def prometheus_lines() -> list[str]:
                 v = snap[topic]["poll_loops"][loop][key]
                 lines.append(
                     f'{name}{{topic="{_esc(topic)}",loop="{_esc(loop)}"}} {v}'
+                )
+    # per-(topic, subscription) delivery watermark + derived freshness
+    # (bounded to _MAX_WATERMARK_SUBS subscriptions per topic)
+    for key, name in (("watermark_ms", "geomesa_stream_watermark_ms"),
+                      ("freshness_ms", "geomesa_stream_freshness_ms")):
+        emitted_type = False
+        for topic in sorted(snap):
+            for sub in sorted(snap[topic]["watermarks"]):
+                if not emitted_type:
+                    lines.append(f"# TYPE {name} gauge")
+                    emitted_type = True
+                v = snap[topic]["watermarks"][sub][key]
+                lines.append(
+                    f'{name}{{topic="{_esc(topic)}",'
+                    f'subscription="{_esc(sub)}"}} {v}'
                 )
     return lines
 

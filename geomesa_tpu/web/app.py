@@ -31,8 +31,10 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/audit?typeName=                  query audit records
     GET    /api/obs/flight?limit=&tenant=&type=&anomalies=1
                                                  query-audit flight recorder
-    GET    /api/obs/costs?limit=                 per-plan-shape cost profiles
+    GET    /api/obs/costs?limit=&member=         per-plan-shape cost profiles
+                                                 (+ per-member aggregates)
     GET    /api/obs/tenants?limit=               per-tenant usage accounting
+    GET    /api/obs/audit?limit=                 continuous correctness auditor
     GET    /api/metrics                          metrics snapshot (+ device
                                                  HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
@@ -191,6 +193,7 @@ class GeoMesaApp:
             ("GET", r"^/api/obs/flight$", self._obs_flight),
             ("GET", r"^/api/obs/costs$", self._obs_costs),
             ("GET", r"^/api/obs/tenants$", self._obs_tenants),
+            ("GET", r"^/api/obs/audit$", self._obs_audit),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -1067,7 +1070,25 @@ class GeoMesaApp:
         limit = self._int_param(params, "limit")
         out = devmon.costs().snapshot(limit=limit or 256)
         out["calibration"] = costmodel.model().calibration_report()
+        # per-member observed-cost aggregates (merged/sharded views):
+        # ?member=N filters to one member's rows
+        member_costs = getattr(self.store, "member_costs_snapshot", None)
+        if member_costs is not None:
+            out["members"] = member_costs(
+                member=self._int_param(params, "member"))
         return 200, out, "application/json"
+
+    def _obs_audit(self, params, body):
+        """The continuous correctness auditor (``geomesa-tpu obs audit``
+        pulls this): per-kind checked/passed/diverged/abstained
+        counters, queue health, recent divergence reports (with repro-
+        bundle paths), and the latest invariant-sweep results —
+        docs/observability.md § Continuous correctness auditing."""
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        limit = self._int_param(params, "limit")
+        return 200, _obsaudit.get().snapshot(limit=limit or 32), \
+            "application/json"
 
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
@@ -1107,6 +1128,11 @@ class GeoMesaApp:
             # series (per-priority + bounded per-tenant shed counters)
             if self.admission is not None:
                 text += self.admission.prometheus_text()
+            # correctness auditor: geomesa_audit_* per-kind checked/
+            # passed/diverged/abstained counters
+            from geomesa_tpu.obs import audit as _obsaudit
+
+            text += _obsaudit.get().prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -1138,6 +1164,12 @@ class GeoMesaApp:
         meter = _usage.get()
         if meter.observe_count:
             out["tenants"] = meter.snapshot(limit=16)
+        # correctness auditor (full detail at GET /api/obs/audit)
+        from geomesa_tpu.obs import audit as _obsaudit
+
+        aud = _obsaudit.get()
+        if aud.checked or _obsaudit.ENABLED:
+            out["audit"] = aud.snapshot(limit=8)
         # serving plane: admission decisions + coalesce effectiveness
         if self.admission is not None:
             out["admission"] = self.admission.snapshot(limit=16)

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Bench-gate leg 7: continuous-correctness-auditor smoke (ISSUE 13).
+
+Two deterministic legs over a tiny CPU-mesh store:
+
+- GREEN — a clean mixed workload (selects across plan shapes, exact
+  batched counts, grouped aggregations through cache/pyramid/scan, plus
+  a concurrent writer) audited at ``GEOMESA_TPU_AUDIT=1.0`` must pass
+  100% of its resolved checks: ZERO divergences and zero false alarms —
+  epoch races under the concurrent writer may only ABSTAIN. The
+  invariant sweeps must come back clean too.
+
+- RED — an injected one-row device-column corruption (the deterministic
+  ``kind=flip`` FaultInjector rule) must produce >= 1 divergence with a
+  repro bundle that REPLAYS to the same divergence via the
+  ``geomesa-tpu replay --bundle`` machinery. The gate fails if the
+  auditor stays silent.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from geomesa_tpu.geometry.types import Point  # noqa: E402
+from geomesa_tpu.obs import audit  # noqa: E402
+from geomesa_tpu.obs import replay as obs_replay  # noqa: E402
+from geomesa_tpu.resilience import faults  # noqa: E402
+from geomesa_tpu.store.datastore import DataStore  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"[audit-smoke] FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def build_store(n=400) -> DataStore:
+    ds = DataStore(backend="tpu")
+    ds.create_schema(
+        "evt", "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326")
+    ds.write("evt", [
+        {"name": f"n{i}", "age": i % 7,
+         "dtg": 1_600_000_000_000 + i * 1000,
+         "geom": Point(-100 + i * 0.05, 10 + i * 0.02)}
+        for i in range(n)
+    ])
+    ds.compact("evt")
+    return ds
+
+
+QUERIES = [
+    "BBOX(geom, -101, 9, -80, 30)",
+    "BBOX(geom, -95, 11, -90, 14)",
+    "BBOX(geom, -101, 9, -80, 30) AND age >= 3",
+    ("BBOX(geom, -101, 9, -80, 30) AND "
+     "dtg DURING 2020-09-13T00:00:00Z/2020-09-14T00:00:00Z"),
+]
+
+
+def run_workload(ds: DataStore, aud, with_writer: bool) -> None:
+    stop = threading.Event()
+    writer = None
+    if with_writer:
+        def write_loop():
+            i = 0
+            while not stop.is_set():
+                ds.write("evt", [{
+                    "name": f"w{i}", "age": i % 7,
+                    "dtg": 1_600_000_000_000 + i,
+                    "geom": Point(-90.0, 12.0)}])
+                i += 1
+
+        writer = threading.Thread(target=write_loop)
+        writer.start()
+    try:
+        for _round in range(3):
+            for q in QUERIES:
+                ds.query("evt", q)
+            ds.count_many("evt", QUERIES[:2], loose=False)
+            ds.aggregate_many("evt", [QUERIES[0]], group_by=["age"],
+                              value_cols=["age"])
+            aud.drain()
+    finally:
+        if writer is not None:
+            stop.set()
+            writer.join()
+    aud.drain()
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="geomesa-audit-smoke-")
+
+    # ---- GREEN: clean workload (incl. a concurrent writer) -----------------
+    ds = build_store()
+    aud = audit.ContinuousAuditor(rate=1.0, autostart=False,
+                                  bundle_dir=os.path.join(tmp, "bundles"))
+    prev = audit.install(aud)
+    try:
+        # phase 1 — quiet store: every check must RESOLVE and pass
+        run_workload(ds, aud, with_writer=False)
+        quiet = aud.snapshot()["checks"]
+        quiet_passed = sum(c["passed"] for c in quiet.values())
+        if quiet_passed < 10:
+            fail(f"quiet phase resolved too little: {quiet}")
+        if sum(c["diverged"] for c in quiet.values()):
+            fail(f"quiet phase diverged: {quiet}")
+        # phase 2 — concurrent writer: epoch races may only ABSTAIN
+        run_workload(ds, aud, with_writer=True)
+        snap = aud.snapshot()
+        checks = snap["checks"]
+        total = sum(c["checked"] for c in checks.values())
+        resolved = sum(c["passed"] for c in checks.values())
+        diverged = sum(c["diverged"] for c in checks.values())
+        abstained = sum(c["abstained"] for c in checks.values())
+        if total == 0:
+            fail("green leg audited nothing")
+        if diverged:
+            fail(f"green leg diverged {diverged}x: {snap['divergences']}")
+        if resolved + abstained != total:
+            fail(f"green leg lost checks: {checks}")
+        if snap["errors"]:
+            fail(f"green leg referee errors: {snap['errors']}")
+        # invariant sweeps over the same store come back clean
+        sw = audit.InvariantSweeper(auditor=aud)
+        sw.attach_store(ds)
+        for r in sw.sweep_once():
+            if r["violations"]:
+                fail(f"green sweep {r['check']} violated: "
+                     f"{r['violations']}")
+        print(f"[audit-smoke] green OK: {total} checks, "
+              f"{resolved} passed, {abstained} abstained "
+              f"(concurrent writer), 0 diverged")
+
+        # ---- RED: injected corruption must be caught -----------------------
+        aud2 = audit.ContinuousAuditor(
+            rate=1.0, autostart=False,
+            bundle_dir=os.path.join(tmp, "bundles-red"))
+        audit.install(aud2)
+        ds2 = build_store()
+        inj = faults.FaultInjector().rule("flip", match="evt",
+                                          truncate_at=5)
+        faults.install(inj)
+        try:
+            ds2.recover("evt")
+        finally:
+            faults.uninstall()
+        if not any(r.fired for r in inj.rules):
+            fail("flip fault never fired")
+        run_workload(ds2, aud2, with_writer=False)
+        snap = aud2.snapshot()
+        diverged = sum(c["diverged"] for c in snap["checks"].values())
+        if diverged < 1:
+            fail("auditor stayed SILENT on injected device corruption")
+        bundles = [d for d in snap["divergences"] if d["bundle_path"]]
+        if not bundles:
+            fail("divergence produced no repro bundle")
+        doc = obs_replay.replay_bundle(ds2, bundles[-1]["bundle_path"])
+        if not doc["reproduced"]:
+            fail(f"bundle did not reproduce: {doc}")
+        print(f"[audit-smoke] red OK: {diverged} divergence(s), bundle "
+              f"replayed (minimized: {bundles[-1]['minimized']})")
+        print("[audit-smoke] OK")
+    finally:
+        audit.install(prev)
+        audit.set_rate(0.0)
+
+
+if __name__ == "__main__":
+    main()

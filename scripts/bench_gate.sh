@@ -45,15 +45,15 @@ export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,6,8,9}"
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT
 
-echo "[bench-gate] 1/6 capture (real measurement, K=$GEOMESA_BENCH_REGRESS_K)"
+echo "[bench-gate] 1/7 capture (real measurement, K=$GEOMESA_BENCH_REGRESS_K)"
 python bench.py --regress-capture "$tmp/baseline.json"
 
-echo "[bench-gate] 2/6 green: regress vs capture must pass"
+echo "[bench-gate] 2/7 green: regress vs capture must pass"
 GEOMESA_BENCH_REGRESS_MEASURED="$tmp/baseline.json" \
     python bench.py --regress "$tmp/baseline.json" \
     --regress-report "$tmp/report.json"
 
-echo "[bench-gate] 3/6 red: injected 20% slowdown must FAIL the gate"
+echo "[bench-gate] 3/7 red: injected 20% slowdown must FAIL the gate"
 if GEOMESA_BENCH_INJECT_SLOWDOWN=1.2 \
     GEOMESA_BENCH_REGRESS_MEASURED="$tmp/baseline.json" \
     python bench.py --regress "$tmp/baseline.json" >/dev/null; then
@@ -61,7 +61,7 @@ if GEOMESA_BENCH_INJECT_SLOWDOWN=1.2 \
     exit 1
 fi
 
-echo "[bench-gate] 4/6 committed baseline loads and passes against itself"
+echo "[bench-gate] 4/7 committed baseline loads and passes against itself"
 GEOMESA_BENCH_REGRESS_CONFIGS="" \
     GEOMESA_BENCH_REGRESS_MEASURED=BENCH_DETAIL.json \
     python bench.py --regress BENCH_DETAIL.json >/dev/null
@@ -71,7 +71,7 @@ GEOMESA_BENCH_REGRESS_CONFIGS="" \
 # reproduce byte-identical per-query row counts, emit a per-signature
 # recorded-vs-replayed report loadable as a --regress baseline, and hold
 # the K+1 tenant label-cardinality bound on the prometheus exposition.
-echo "[bench-gate] 5/6 workload capture -> replay -> parity smoke"
+echo "[bench-gate] 5/7 workload capture -> replay -> parity smoke"
 python scripts/replay_smoke.py
 
 # serving-plane smoke (ISSUE 12): replay a tiny captured two-tenant
@@ -80,7 +80,17 @@ python scripts/replay_smoke.py
 # coalesce width > 1 (fewer device dispatches than queries), and shed
 # correctness (the over-budget tenant answers 429 + Retry-After while
 # the healthy tenant keeps answering 200). See docs/serving.md.
-echo "[bench-gate] 6/6 serving: admission + coalescing replay parity smoke"
+echo "[bench-gate] 6/7 serving: admission + coalescing replay parity smoke"
 python scripts/serving_smoke.py
+
+# correctness-auditor smoke (ISSUE 13): green leg — a clean mixed
+# workload (selects, exact batched counts, grouped aggs, concurrent
+# writer) at GEOMESA_TPU_AUDIT=1.0 audits with ZERO divergences (epoch
+# races may only abstain) and clean invariant sweeps; red leg — an
+# injected one-row device corruption (FaultInjector kind=flip) must
+# produce >= 1 divergence with a repro bundle that replays to the same
+# divergence. The gate fails if the auditor stays silent.
+echo "[bench-gate] 7/7 correctness auditor: green + red (injected corruption)"
+python scripts/audit_smoke.py
 
 echo "[bench-gate] OK"

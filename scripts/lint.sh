@@ -84,6 +84,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_stream_matrix.py -q
 # double-count fix, degraded semantics). See docs/serving.md.
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 
+# continuous-correctness-auditor gate (ISSUE 13): referee parity on
+# clean stores, injected device-corruption caught with a replayable
+# minimized repro bundle, epoch-race abstention under concurrent
+# writes, feedback-plane hygiene (audit traffic invisible to cost
+# table / usage / SLO / capture), invariant-sweep red/greens (pyramid,
+# query-cache epochs, matrix sentinels, shard coverage, standing
+# counts), and the <2% off-path bound at 0% sampling. See
+# docs/observability.md § Continuous correctness auditing.
+JAX_PLATFORMS=cpu python -m pytest tests/test_audit.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
@@ -102,7 +112,7 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
     tests/test_geoblocks.py tests/test_bufferpool.py \
     tests/test_stream_matrix.py tests/test_usage_workload.py \
-    tests/test_serving.py -q
+    tests/test_serving.py tests/test_audit.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

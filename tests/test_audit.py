@@ -1,0 +1,710 @@
+"""Continuous correctness auditor (obs/audit.py + ops/referee.py).
+
+The ISSUE 13 gate: sampled shadow re-execution against the independent
+referee catches an injected single-row device corruption (bundle written,
+``replay --bundle`` reproducing it), epoch races abstain instead of
+alarming under concurrent writes, the delta-debug minimizer shrinks a
+4-conjunct predicate to the one faulty clause, the invariant sweeps go
+red on seeded structural drift, audit traffic stays out of every
+feedback plane, and the 0%-sampling off path holds the <2% bound on the
+cached-jit select path.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.obs import audit, devmon, flight, usage, workload
+from geomesa_tpu.obs import replay as obs_replay
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience import faults
+from geomesa_tpu.store.datastore import DataStore
+
+CQL = "BBOX(geom, -101, 9, -80, 30)"
+CQL4 = ("BBOX(geom, -101, 9, -80, 30) AND age >= 0 AND age <= 100 "
+        "AND dtg DURING 2020-09-13T00:00:00Z/2020-09-14T00:00:00Z")
+
+
+def _store(n=200, compact=True):
+    ds = DataStore(backend="tpu")
+    ds.create_schema(
+        "evt", "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326")
+    recs = [
+        {"name": f"n{i}", "age": i % 7,
+         "dtg": 1_600_000_000_000 + i * 1000,
+         "geom": Point(-100 + i * 0.1, 10 + i * 0.05)}
+        for i in range(n)
+    ]
+    ds.write("evt", recs)
+    if compact:
+        ds.compact("evt")
+    return ds
+
+
+@pytest.fixture()
+def auditor(tmp_path):
+    """A synchronous (drain-driven) rate-1.0 auditor with a bundle dir,
+    installed for the test and restored after."""
+    aud = audit.ContinuousAuditor(
+        rate=1.0, autostart=False, bundle_dir=str(tmp_path / "bundles"))
+    prev = audit.install(aud)
+    yield aud
+    audit.install(prev)
+    audit.set_rate(0.0)
+
+
+@pytest.fixture()
+def fresh_flight():
+    prev = flight.install(flight.FlightRecorder(dump_dir=None))
+    yield flight.get()
+    flight.install(prev)
+
+
+def _corrupt(ds, row=3):
+    """Flip one device-column value through the deterministic
+    FaultInjector rule (resilience/faults.py kind=flip) + reload."""
+    inj = faults.FaultInjector().rule("flip", match="evt", truncate_at=row)
+    faults.install(inj)
+    try:
+        ds.recover("evt")
+    finally:
+        faults.uninstall()
+    return inj
+
+
+class TestReferee:
+    def test_select_matches_live_on_clean_store(self):
+        ds = _store()
+        from geomesa_tpu.ops import referee
+
+        q = Query(filter=CQL)
+        live = sorted(str(f) for f in ds.query("evt", q).table.fids)
+        st = ds._types["evt"]
+        main, _i, _b, _s, delta = st.snapshot()
+        assert referee.referee_select(st.sft, main, delta, q) == live
+
+    def test_delta_tier_rows_included(self):
+        ds = _store(100, compact=True)
+        ds.write("evt", [{"name": "x", "age": 1,
+                          "dtg": 1_600_000_000_000,
+                          "geom": Point(-90.0, 12.0)}])
+        from geomesa_tpu.ops import referee
+
+        q = Query(filter=CQL)
+        st = ds._types["evt"]
+        main, _i, _b, _s, delta = st.snapshot()
+        ref = referee.referee_select(st.sft, main, delta, q)
+        assert len(ref) == ds.query("evt", q).count
+
+    def test_agg_equal_tolerates_summation_noise(self):
+        from geomesa_tpu.ops import referee
+
+        a = {("k",): {"count": 3, "cols": {"v": [3, 1.0, 0.1, 0.7]}}}
+        b = {("k",): {"count": 3,
+                      "cols": {"v": [3, 1.0 + 1e-12, 0.1, 0.7]}}}
+        assert referee.agg_equal(a, b)[0]
+        b[("k",)]["count"] = 4
+        ok, detail = referee.agg_equal(a, b)
+        assert not ok and "count" in detail
+
+
+class TestShadowAudit:
+    def test_clean_store_audits_pass(self, auditor):
+        ds = _store()
+        ds.query("evt", CQL)
+        out = ds.aggregate_many("evt", [CQL], group_by=["age"],
+                                value_cols=["age"])
+        assert out[0] is not None
+        assert auditor.drain() == 2
+        snap = auditor.snapshot()
+        assert snap["checks"]["select"]["passed"] == 1
+        assert snap["checks"]["agg"]["passed"] == 1
+        assert snap["checks"]["select"]["diverged"] == 0
+        assert snap["checks"]["agg"]["diverged"] == 0
+
+    def test_count_many_exact_audits(self, auditor):
+        ds = _store()
+        counts = ds.count_many("evt", [CQL], loose=False)
+        assert counts == [200]
+        auditor.drain()
+        assert auditor.snapshot()["checks"]["count"]["passed"] == 1
+
+    def test_loose_counts_never_audited(self, auditor):
+        ds = _store()
+        ds.count_many("evt", [CQL], loose=True)
+        auditor.drain()
+        assert auditor.snapshot()["checks"]["count"]["checked"] == 0
+
+    def test_hint_audits_at_zero_rate(self, auditor):
+        audit.set_rate(0.0)
+        ds = _store()
+        ds.query("evt", Query(filter=CQL, hints={"audit": True}))
+        ds.query("evt", CQL)  # untagged: not audited
+        assert auditor.drain() == 1
+        assert auditor.snapshot()["checks"]["select"]["passed"] == 1
+
+    def test_ineligible_shapes_skip(self, auditor):
+        ds = _store()
+        ds.query("evt", Query(filter=CQL, limit=5))
+        ds.query("evt", Query(filter=CQL, hints={"density": {}}))
+        auditor.drain()
+        assert auditor.snapshot()["checks"]["select"]["checked"] == 0
+
+
+class TestDivergence:
+    def test_corruption_caught_bundle_replays(self, auditor, fresh_flight):
+        """The end-to-end acceptance pin: an injected one-row device
+        corruption is caught by shadow re-execution within K sampled
+        queries, emits A_DIVERGE + non-zero diverged counters, writes a
+        repro bundle, and the bundle replays to the same divergence."""
+        ds = _store()
+        _corrupt(ds, row=3)
+        caught = None
+        for k in range(8):  # detected within K sampled queries
+            ds.query("evt", CQL)
+            auditor.drain()
+            if auditor.snapshot()["checks"]["select"]["diverged"]:
+                caught = k
+                break
+        assert caught is not None
+        snap = auditor.snapshot()
+        div = snap["divergences"][-1]
+        assert div["kind"] == "select"
+        assert "missing from live" in div["detail"]
+        # prometheus counter non-zero
+        text = auditor.prometheus_text()
+        assert 'geomesa_audit_diverged_total{kind="select"} 1' in text
+        # A_DIVERGE flight anomaly
+        anom = [r for r in fresh_flight.records()
+                if flight.A_DIVERGE in (r.anomalies or ())]
+        assert anom and anom[-1].source == "audit"
+        # the bundle replays to the same divergence on the live store
+        assert div["bundle_path"]
+        doc = obs_replay.replay_bundle(ds, div["bundle_path"])
+        assert doc["reproduced"]
+        assert doc["original"]["diverged"]
+        # a healthy store does NOT reproduce it (exit-3 contract)
+        clean = _store()
+        doc2 = obs_replay.replay_bundle(clean, div["bundle_path"])
+        assert not doc2["reproduced"]
+
+    def test_minimizer_shrinks_to_faulty_clause(self, auditor):
+        """A 4-conjunct predicate minimizes to the one faulty clause:
+        the non-spatial conjuncts drop (the divergence persists without
+        them) and the surviving BBOX halves toward the corrupted row."""
+        ds = _store()
+        _corrupt(ds, row=3)
+        ds.query("evt", CQL4)
+        auditor.drain()
+        snap = auditor.snapshot()
+        assert snap["checks"]["select"]["diverged"] == 1
+        minimized = snap["divergences"][-1]["minimized"]
+        assert "AND" not in minimized  # one clause survives
+        assert minimized.startswith("BBOX")  # the faulty (spatial) one
+        # and it shrank: the minimized box is narrower than the original
+        from geomesa_tpu.filter.cql import parse
+
+        m = parse(minimized)
+        assert (m.xmax - m.xmin) < ((-80) - (-101)) / 2
+
+    def test_minimize_predicate_unit(self):
+        """ddmin semantics on a synthetic oracle: divergence persists
+        while the candidate still matches the faulty point."""
+        from geomesa_tpu.filter.cql import parse
+
+        f = parse(CQL4)
+        faulty = (-99.7, 10.15)  # row 3's point
+
+        def diverges(cand):
+            # evaluate the candidate against a one-row table
+            from geomesa_tpu.schema.columnar import FeatureTable
+            from geomesa_tpu.schema.sft import parse_spec
+
+            sft = parse_spec(
+                "t", "age:Integer,dtg:Date,*geom:Point:srid=4326")
+            t = FeatureTable.from_records(sft, [{
+                "age": 3, "dtg": 1_600_000_003_000,
+                "geom": Point(*faulty)}], ["f0"])
+            return bool(cand.mask(t)[0])
+
+        m = audit.minimize_predicate(f, diverges, max_checks=64)
+        # 1-minimal: one clause survives (the symmetric oracle lets
+        # ddmin keep whichever divergence-preserving leaf it reaches
+        # first), narrowed down to a sliver around the faulty point
+        assert not isinstance(m, (ast.And, ast.Or))
+        assert diverges(m)  # still covers the faulty point
+        if isinstance(m, ast.BBox):
+            assert (m.xmax - m.xmin) < 1e-3
+        else:
+            assert isinstance(m, ast.During)
+            assert (m.hi_millis - m.lo_millis) <= 4
+
+    def test_epoch_race_abstains_never_alarms(self, auditor):
+        """A write landing between capture and re-check moves the epoch:
+        the check abstains. Under a concurrent writer hammering the
+        store, rate-1.0 auditing must produce ZERO divergences."""
+        ds = _store()
+        ds.query("evt", CQL)
+        # mutate before the drain: the queued check's epoch is stale
+        ds.write("evt", [{"name": "z", "age": 1,
+                          "dtg": 1_600_000_000_000,
+                          "geom": Point(-90.0, 12.0)}])
+        auditor.drain()
+        snap = auditor.snapshot()
+        assert snap["checks"]["select"]["abstained"] == 1
+        assert snap["checks"]["select"]["diverged"] == 0
+
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                ds.write("evt", [{"name": f"w{i}", "age": 1,
+                                  "dtg": 1_600_000_000_000 + i,
+                                  "geom": Point(-90.0, 12.0)}])
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(10):
+                ds.query("evt", CQL)
+                auditor.drain()
+        finally:
+            stop.set()
+            t.join()
+        auditor.drain()
+        snap = auditor.snapshot()
+        assert snap["checks"]["select"]["diverged"] == 0  # abstain, never alarm
+
+
+class TestFeedbackHygiene:
+    def test_audit_executions_invisible_to_feedback_planes(
+            self, auditor, tmp_path):
+        """Satellite bugfix red/green: the auditor's own executions (the
+        minimizer re-runs the live path repeatedly) must not land in the
+        cost table, usage meter, SLO burn, or workload capture."""
+        ds = _store()
+        prev_meter = usage.install(usage.UsageMeter())
+        prev_journal = workload.install(
+            workload.WorkloadJournal(str(tmp_path / "cap"), flush_every=1))
+        try:
+            # GREEN CONTROL: a normal query moves all four planes
+            ds.query("evt", CQL)
+            auditor.drain()  # the clean audit itself must not move them
+            meter = usage.get()
+            base_obs = meter.observe_count
+            base_events = workload.get().event_count
+            base_cost = devmon.costs().snapshot()["entry_count"]
+            base_slo = ds.slo.tracker("store.query", "evt").burn_rate(300.0)
+            assert base_obs >= 1 and base_events >= 1
+
+            # RED TRIGGER: a divergence runs the minimizer (many shadow
+            # live re-executions) — none of them may meter/train/burn
+            _corrupt(ds, row=3)
+            ds.query("evt", CQL)
+            live_obs_after_query = meter.observe_count
+            auditor.drain()
+            assert auditor.snapshot()["checks"]["select"]["diverged"] == 1
+            assert meter.observe_count == live_obs_after_query
+            assert workload.get().event_count == base_events + 1  # the live one
+            # the cost table saw only the LIVE queries' signatures, and
+            # per-signature counts did not grow during the drain
+            snap_before = devmon.costs().snapshot()
+            auditor.drain()
+            assert devmon.costs().snapshot() == snap_before
+        finally:
+            usage.install(prev_meter)
+            workload.install(prev_journal)
+
+    def test_shadow_context_flag(self):
+        assert not audit.in_shadow()
+        with audit.shadow():
+            assert audit.in_shadow()
+        assert not audit.in_shadow()
+
+
+class TestSweeper:
+    def test_pyramid_reconciles_then_catches_corruption(self):
+        ds = _store(300)
+        st = ds._types["evt"]
+        pyr = ds._pyramid(st, "evt", st.table, ["age"], ["age"], st.epoch)
+        assert pyr is not None
+        aud = audit.ContinuousAuditor(rate=0.0, autostart=False)
+        sw = audit.InvariantSweeper(auditor=aud)
+        sw.attach_store(ds)
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert res["pyramid"]["checked"] > 0
+        assert res["pyramid"]["violations"] == []
+        assert res["ledger"]["violations"] == []
+        assert res["query_cache"]["violations"] == []
+        # seed drift: bump one non-empty partial
+        nz = np.argwhere(pyr.levels[-1].cnt > 0)[0]
+        pyr.levels[-1].cnt[tuple(nz)] += 1
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert res["pyramid"]["violations"]
+        counters = aud.snapshot()["checks"]
+        assert counters["sweep:pyramid"]["diverged"] == 1
+
+    def test_query_cache_epoch_invariants(self):
+        ds = _store()
+        ds.aggregate_many("evt", [CQL], group_by=["age"],
+                          value_cols=["age"])
+        aud = audit.ContinuousAuditor(rate=0.0, autostart=False)
+        sw = audit.InvariantSweeper(auditor=aud)
+        sw.attach_store(ds)
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert res["query_cache"]["checked"] >= 1
+        assert res["query_cache"]["violations"] == []
+        # seed a future-stamped entry: served-after-epoch-catches-up bug
+        ds.agg_cache.put("evt", ("fake",), (10**6, 10**6),
+                         {"groups": [], "count": np.zeros(0, np.int64),
+                          "cols": {}})
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert any("ahead of live" in v
+                   for v in res["query_cache"]["violations"])
+        # and an entry outliving its schema
+        ds.agg_cache.invalidate()
+        ds.agg_cache.put("ghost", ("k",), (0, 0),
+                         {"groups": [], "count": np.zeros(0, np.int64),
+                          "cols": {}})
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert any("deleted/renamed" in v
+                   for v in res["query_cache"]["violations"])
+
+    def test_matrix_sentinels_red_green(self):
+        from geomesa_tpu.stream.matrix import SubscriptionMatrix
+
+        m = SubscriptionMatrix()
+        sid = m.subscribe_packed(np.array([[0, 100, 0, 100]]),
+                                 np.array([[0, 0, 1, 0]]), lambda b: None)
+        m.unsubscribe(sid)
+        assert m.validate_sentinels() == []
+        # corrupt a masked slot: make its box satisfiable
+        slot = m._slots.index(None)
+        m._boxes[slot, 0] = [0, 100, 0, 100]
+        out = m.validate_sentinels()
+        assert out and "slot" in out[0]
+
+    def test_shard_coverage_red_green(self):
+        from geomesa_tpu.serving.shards import ShardRouter
+
+        r = ShardRouter([0, 1, 2], n_shards=8)
+        assert r.coverage_violations() == []
+        r.shard_member[3] = 99  # departed member owns a shard
+        assert any("departed" in v for v in r.coverage_violations())
+
+    def test_standing_counts_cross_check(self):
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        sds = StreamingDataStore()
+        sds.create_schema("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+        hits = []
+        sid = sds.subscribe_query("t", "BBOX(geom, -101, 9, -80, 30)",
+                                  hits.append)
+        for i in range(40):
+            sds.put("t", f"f{i}", {
+                "name": f"n{i}", "dtg": 1_600_000_000_000 + i * 1000,
+                "geom": Point(-100 + i * 0.1, 10 + i * 0.05)})
+        assert sds.drain("t")
+        aud = audit.ContinuousAuditor(rate=0.0, autostart=False)
+        sw = audit.InvariantSweeper(auditor=aud)
+        sw.attach_stream(sds)
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert res["standing_counts"]["checked"] == 1
+        assert res["standing_counts"]["violations"] == []
+        # seed a missed delivery: cumulative total below the exact count
+        hub = sds.query_hub("t")
+        hub.scanner._totals[sid] -= 2
+        res = {r["check"]: r for r in sw.sweep_once()}
+        assert any("missed deliveries" in v
+                   for v in res["standing_counts"]["violations"])
+        sds.close()
+
+    def test_sweep_queries_stay_out_of_feedback_planes(self):
+        """The standing-count sweep issues real store.query calls: they
+        run in shadow, so a sweep never meters usage, trains the cost
+        table, or gets sampled into a fresh audit check."""
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        sds = StreamingDataStore()
+        sds.create_schema("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+        sds.subscribe_query("t", "BBOX(geom, -101, 9, -80, 30)",
+                            lambda b: None)
+        for i in range(10):
+            sds.put("t", f"f{i}", {
+                "name": f"n{i}", "dtg": 1_600_000_000_000 + i * 1000,
+                "geom": Point(-100 + i * 0.1, 10 + i * 0.05)})
+        assert sds.drain("t")
+        aud = audit.ContinuousAuditor(rate=1.0, autostart=False)
+        prev = audit.install(aud)
+        prev_meter = usage.install(usage.UsageMeter())
+        try:
+            sw = audit.InvariantSweeper(auditor=aud)
+            sw.attach_stream(sds)
+            res = {r["check"]: r for r in sw.sweep_once()}
+            assert res["standing_counts"]["checked"] == 1
+            assert usage.get().observe_count == 0
+            assert aud.queue_depth() == 0  # sweep query not re-sampled
+        finally:
+            usage.install(prev_meter)
+            audit.install(prev)
+            audit.set_rate(0.0)
+        sds.close()
+
+    def test_sweeper_thread_lifecycle(self):
+        aud = audit.ContinuousAuditor(rate=0.0, autostart=False)
+        sw = audit.InvariantSweeper(auditor=aud, interval_s=0.01)
+        sw.attach_store(_store(50))
+        sw.start()
+        deadline = time.monotonic() + 5.0
+        while sw.sweep_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sw.close()
+        sw.close()  # idempotent
+        assert sw.sweep_count >= 1
+
+
+class TestStreamFreshness:
+    def test_watermark_and_freshness_gauges(self):
+        from geomesa_tpu.stream import telemetry
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        telemetry.reset()
+        sds = StreamingDataStore()
+        sds.create_schema("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+        sds.subscribe_query("t", "BBOX(geom, -101, 9, -80, 30)",
+                            lambda b: None)
+        last_ms = 1_600_000_000_000 + 39 * 1000
+        for i in range(40):
+            sds.put("t", f"f{i}", {
+                "name": f"n{i}", "dtg": 1_600_000_000_000 + i * 1000,
+                "geom": Point(-100 + i * 0.1, 10 + i * 0.05)})
+        assert sds.drain("t")
+        rep = telemetry.report()["geomesa-t"]
+        (sub, wm), = rep["watermarks"].items()
+        # week-binned offsets are second-granular: the watermark is the
+        # newest event time rounded down to its offset unit
+        assert abs(wm["watermark_ms"] - last_ms) < 1000
+        assert wm["freshness_ms"] > 0
+        lines = telemetry.prometheus_lines()
+        assert any("geomesa_stream_watermark_ms{" in ln for ln in lines)
+        assert any("geomesa_stream_freshness_ms{" in ln for ln in lines)
+        sds.close()
+        telemetry.reset()
+
+
+class TestMemberCosts:
+    def test_per_member_aggregates_and_filter(self):
+        from geomesa_tpu.store.merged import MergedDataStoreView
+
+        m0, m1 = _store(60), _store(80)
+        view = MergedDataStoreView([m0, m1])
+        for _ in range(3):
+            view.query("evt", CQL)
+        view.stats_count("evt", CQL)
+        rows = view.member_costs_snapshot()
+        assert {r["member"] for r in rows} == {0, 1}
+        ops = {r["op"] for r in rows}
+        assert "query" in ops and "stats_count" in ops
+        q_rows = [r for r in rows if r["op"] == "query"]
+        assert all(r["count"] == 3 for r in q_rows)
+        assert all(r["wall_ms_p50"] > 0 for r in q_rows)
+        only0 = view.member_costs_snapshot(member=0)
+        assert {r["member"] for r in only0} == {0}
+        text = view.explain("evt", CQL)
+        assert "Member cost asymmetry" in text
+
+    def test_costs_endpoint_member_section(self):
+        from geomesa_tpu.store.merged import MergedDataStoreView
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        view = MergedDataStoreView([_store(50), _store(50)])
+        view.query("evt", CQL)
+        app = GeoMesaApp(view, coalesce_ms=0)
+        status, doc = _jcall(app, "GET", "/api/obs/costs")
+        assert status == 200
+        assert {m["member"] for m in doc["members"]} == {0, 1}
+        status, doc = _jcall(app, "GET", "/api/obs/costs", "member=1")
+        assert {m["member"] for m in doc["members"]} == {1}
+
+
+def _jcall(app, method, path, query="", body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method, "PATH_INFO": path,
+        "QUERY_STRING": query, "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = int(status.split()[0])
+
+    chunks = app(environ, start_response)
+    data = b"".join(chunks)
+    return out["status"], json.loads(data) if data else None
+
+
+class TestSurfaces:
+    def test_obs_audit_endpoint_and_metrics(self, auditor):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = _store()
+        ds.query("evt", CQL)
+        auditor.drain()
+        app = GeoMesaApp(ds, coalesce_ms=0)
+        status, doc = _jcall(app, "GET", "/api/obs/audit")
+        assert status == 200
+        assert doc["checks"]["select"]["passed"] == 1
+        # prometheus exposition carries the audit series
+        raw = json.dumps(None)
+        environ = {
+            "REQUEST_METHOD": "GET", "PATH_INFO": "/api/metrics",
+            "QUERY_STRING": "format=prometheus", "CONTENT_LENGTH": "0",
+            "wsgi.input": io.BytesIO(b""),
+        }
+        out = {}
+
+        def start_response(status_line, headers):
+            out["status"] = status_line
+
+        text = b"".join(app(environ, start_response)).decode()
+        assert "geomesa_audit_checked_total" in text
+        assert 'geomesa_audit_passed_total{kind="select"} 1' in text
+
+    def test_explain_analyze_audit_line(self, auditor):
+        ds = _store()
+        ea = ds.explain("evt", CQL, analyze=True)
+        assert ea.audit is not None
+        assert ea.audit["verdict"] == "pass"
+        assert "Audit: pass (select)" in str(ea)
+
+    def test_queue_bound_drops_counted(self):
+        aud = audit.ContinuousAuditor(rate=1.0, autostart=False,
+                                      max_queue=2)
+        prev = audit.install(aud)
+        try:
+            ds = _store(50)
+            for _ in range(5):
+                ds.query("evt", CQL)
+            assert aud.queue_depth() == 2
+            assert aud.dropped == 3
+            aud.drain()
+        finally:
+            audit.install(prev)
+            audit.set_rate(0.0)
+
+    def test_install_swap_back_revives_auditor_and_rate(self):
+        """install(old) after old was swapped out must revive its
+        worker (a closed auditor would silently drop every enqueue) and
+        restore ITS sampling rate."""
+        a = audit.ContinuousAuditor(rate=1.0, autostart=True)
+        prev = audit.install(a)
+        b = audit.ContinuousAuditor(rate=0.0, autostart=True)
+        audit.install(b)  # closes a, rate now 0
+        assert not audit.ENABLED
+        audit.install(a)  # swap back: revived, rate 1.0 again
+        try:
+            assert audit.ENABLED
+            ds = _store(50)
+            ds.query("evt", CQL)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if a.snapshot()["checks"]["select"]["passed"]:
+                    break
+                time.sleep(0.01)
+            assert a.snapshot()["checks"]["select"]["passed"] == 1
+            assert a.dropped == 0
+        finally:
+            audit.install(prev)
+            audit.set_rate(0.0)
+
+    def test_ineligible_queries_do_not_burn_sampling_ticks(self):
+        """Eligibility is checked BEFORE the sampling tick: a workload
+        dominated by density queries must not erode the configured rate
+        over auditable selects."""
+        aud = audit.ContinuousAuditor(rate=1.0, autostart=False)
+        prev = audit.install(aud)
+        try:
+            ds = _store(50)
+            for _ in range(5):
+                ds.query("evt", Query(filter=CQL, hints={"density": {}}))
+            ds.query("evt", CQL)  # the eligible one still samples
+            assert aud.queue_depth() == 1
+            aud.drain()
+        finally:
+            audit.install(prev)
+            audit.set_rate(0.0)
+
+    def test_worker_thread_runs_checks(self):
+        aud = audit.ContinuousAuditor(rate=1.0, autostart=True)
+        prev = audit.install(aud)
+        try:
+            ds = _store(50)
+            ds.query("evt", CQL)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if aud.snapshot()["checks"]["select"]["checked"]:
+                    break
+                time.sleep(0.01)
+            assert aud.snapshot()["checks"]["select"]["passed"] == 1
+        finally:
+            audit.install(prev)  # closes the worker
+            audit.set_rate(0.0)
+
+
+class TestOverhead:
+    def test_off_path_overhead_under_2pct(self):
+        """Acceptance bound: the always-on auditor at 0% sampling adds
+        one module-global bool + one ContextVar read + a hints lookup
+        per query — measured against the cached-jit select path's p50
+        (the devmon/flight bound's methodology)."""
+        assert audit.ENABLED is False
+        ds = _store(1500)
+        ds.query("evt", CQL)  # compile + plan-cache warm
+        lat = []
+        for _ in range(15):
+            t0 = time.perf_counter_ns()
+            ds.query("evt", CQL)
+            lat.append(time.perf_counter_ns() - t0)
+        p50_ns = float(np.percentile(lat, 50))
+        q = Query(filter=CQL)
+        N = 200_000
+        t0 = time.perf_counter_ns()
+        for _ in range(N):
+            # the REAL added per-query work at 0% sampling: the enabled
+            # flag, the shadow check _audit pays, and the hint lookup
+            if (audit.ENABLED and not audit.in_shadow()
+                    and audit.sampled()) or q.hints.get("audit"):
+                pass
+            audit.in_shadow()
+        per_query = (time.perf_counter_ns() - t0) / N
+        assert per_query < 0.02 * p50_ns, (
+            f"audit off-path {per_query:.0f} ns >= 2% of p50 "
+            f"{p50_ns:.0f} ns")
+
+
+class TestBundleFormat:
+    def test_bundle_is_issue11_event_shaped(self, auditor):
+        ds = _store()
+        _corrupt(ds)
+        ds.query("evt", Query(filter=CQL, hints={"audit": True},
+                              auths=None))
+        auditor.drain()
+        path = auditor.snapshot()["divergences"][-1]["bundle_path"]
+        doc = audit.load_bundle(path)
+        ev = doc["event"]
+        # the ISSUE 11 wide-event keys replay/load_events understand
+        for key in ("ts_arrival", "op", "type", "filter", "hints",
+                    "tenant", "auths", "plan_signature", "latency_ms"):
+            assert key in ev
+        assert doc["epoch"] and doc["minimized"]
+        assert doc["live"] is not None
