@@ -409,8 +409,83 @@ def cmd_manage_partitions(args):
     raise SystemExit(f"unknown action: {args.action!r}")
 
 
+def cmd_wal(args):
+    """Offline WAL inspection (no store open, no lock taken): what is in
+    the journal, what the manifest already covers, and how many acked
+    records a recovery would replay."""
+    import json as _json
+
+    from geomesa_tpu.store import wal as walmod
+    from geomesa_tpu.stream.journal import JournalBus
+
+    bus = JournalBus(args.dir, partitions=1)
+    stamps: dict[str, int] = {}
+    global_floor = 0
+    if args.catalog:
+        from geomesa_tpu.store import persistence
+
+        mpath = Path(args.catalog) / persistence.MANIFEST
+        if mpath.exists():
+            wstamp = _json.loads(mpath.read_text()).get("wal") or {}
+            global_floor = int(wstamp.get("seq", 0))
+            stamps = {str(k): int(v)
+                      for k, v in (wstamp.get("topics") or {}).items()}
+    topics = [t for t in bus.topics()
+              if t == walmod.SCHEMA_TOPIC or t.startswith("wal.t.")]
+    report = {"dir": args.dir, "topics": [], "unreplayed_tail": 0}
+    for topic in sorted(topics):
+        records = seq_lo = seq_hi = tail = 0
+        by_op: dict[str, int] = {}
+        for _s, _e, payload in bus.iter_records(topic):
+            try:
+                hdr, _ = walmod.decode_record(payload)
+            except (ValueError, KeyError):
+                continue
+            seq = int(hdr.get("seq", 0))
+            records += 1
+            seq_lo = seq if seq_lo == 0 else min(seq_lo, seq)
+            seq_hi = max(seq_hi, seq)
+            by_op[hdr.get("op", "?")] = by_op.get(hdr.get("op", "?"), 0) + 1
+            if seq > stamps.get(topic, global_floor):
+                tail += 1
+        report["topics"].append({
+            "topic": topic,
+            "type": walmod.type_for(topic),
+            "records": records,
+            "ops": by_op,
+            "seq_range": [seq_lo, seq_hi],
+            "head_bytes": bus.head_offset(topic),
+            "committed_bytes": bus.committed_offset(topic),
+            "manifest_floor": stamps.get(topic),
+            "unreplayed_tail": tail,
+        })
+        report["unreplayed_tail"] += tail
+    bus.close()
+    if args.json:
+        print(_json.dumps(report, indent=2))
+        return
+    print(f"WAL {args.dir}")
+    for t in report["topics"]:
+        ops = ",".join(f"{k}:{v}" for k, v in sorted(t["ops"].items()))
+        floor = t["manifest_floor"]
+        print(f"  {t['topic']:<32} records={t['records']:<6} "
+              f"seq={t['seq_range'][0]}..{t['seq_range'][1]} "
+              f"head={t['head_bytes']} committed={t['committed_bytes']} "
+              f"floor={'-' if floor is None else floor} "
+              f"tail={t['unreplayed_tail']}  [{ops}]")
+    if args.catalog:
+        print(f"unreplayed tail (records a recovery would replay): "
+              f"{report['unreplayed_tail']}")
+
+
 def cmd_serve(args):
-    ds = _load(args)
+    if getattr(args, "recover", False) or getattr(args, "wal", None):
+        from geomesa_tpu.store.datastore import DataStore
+
+        ds = DataStore.open(args.catalog, backend=args.backend,
+                            recover=True, wal_dir=args.wal)
+    else:
+        ds = _load(args)
     from geomesa_tpu.web import serve
 
     provider = None
@@ -862,7 +937,33 @@ def main(argv=None):
         help="serve a Confluent-protocol schema registry "
         "(/subjects, /schemas/ids)",
     )
+    sp.add_argument(
+        "--recover", action="store_true",
+        help="open the catalog through the durability plane: take the "
+        "WAL lock, load the checkpoint, replay the acked WAL tail, and "
+        "journal every mutation while serving (docs/operations.md "
+        "§ Durability & recovery)",
+    )
+    sp.add_argument(
+        "--wal", default=None, metavar="DIR",
+        help="WAL directory (implies --recover; default GEOMESA_TPU_WAL "
+        "or <catalog>/wal)",
+    )
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "wal",
+        help="inspect a durability WAL: per-topic records/bytes/seq "
+        "ranges, trimmed heads, manifest replay floors, unreplayed tail",
+    )
+    sp.add_argument("--dir", required=True, metavar="DIR",
+                    help="the WAL directory (GEOMESA_TPU_WAL)")
+    sp.add_argument("-c", "--catalog", default=None,
+                    help="catalog directory: diff the manifest's replay "
+                    "floors against the journal (shows the unreplayed "
+                    "tail a crash would recover)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_wal)
 
     sp = sub.add_parser(
         "compact", help="fold the hot delta tier into the sorted main tier"

@@ -880,6 +880,7 @@ class InvariantSweeper:
                 out.append(self.check_pyramids(store))
                 out.append(self.check_ledger(store))
                 out.append(self.check_query_cache(store))
+                out.append(self.check_wal(store))
             for view in self._targets(self._views):
                 out.append(self.check_shard_coverage(view))
             for m in self._targets(self._matrices):
@@ -893,6 +894,85 @@ class InvariantSweeper:
         return out
 
     # -- individual checks ----------------------------------------------------
+    def check_wal(self, store) -> dict:
+        """WAL/checkpoint invariants for durability-plane stores
+        (docs/operations.md § Durability & recovery): every type's applied
+        seq is at/below the WAL's seq high-water (an applied mutation the
+        journal never issued a seq for cannot exist); each topic's trimmed
+        head never passes its commit offset (a trim past the commit would
+        have destroyed un-checkpointed records); and the manifest's replay
+        floors never exceed the live applied seqs (a stamp ahead of the
+        state would make recovery skip acked records). No-WAL stores
+        report zero checks."""
+        result = {"check": "wal", "checked": 0, "violations": [],
+                  "abstained": 0}
+        wal = getattr(store, "_wal", None)
+        if wal is None:
+            return result
+        live: dict[str, int] = {}
+        for name, st in list(store._types.items()):
+            with st.lock:
+                live[name] = st.wal_seq
+        # high-water read AFTER the applied seqs: a write landing between
+        # the two reads makes the (stale) seq <= the (fresh) high-water —
+        # the reverse order false-alarmed on every concurrent write
+        high = wal.seq_highwater()
+        for name, seq in live.items():
+            result["checked"] += 1
+            if seq > high:
+                result["violations"].append(
+                    f"{name}: applied wal_seq {seq} > seq high-water {high}")
+        try:
+            for topic in wal.topics():
+                result["checked"] += 1
+                head = wal.bus.head_offset(topic)
+                # the RAW sidecar value: committed_offset() clamps to
+                # max(commit, head), which would make this check
+                # unfalsifiable
+                raw = wal.bus._read_commit(topic)
+                if raw is None:
+                    result["abstained"] += 1
+                elif head > raw:
+                    result["violations"].append(
+                        f"{topic}: trimmed head {head} > commit {raw}")
+        except OSError:
+            result["abstained"] += 1
+        catalog = getattr(store, "_wal_catalog", None)
+        if catalog:
+            import json as _json
+            import os as _os
+
+            from geomesa_tpu.store import persistence as _persist
+            from geomesa_tpu.store import wal as _walmod
+
+            mpath = _os.path.join(catalog, _persist.MANIFEST)
+            try:
+                stamps = (_json.loads(open(mpath).read())
+                          .get("wal", {}).get("topics", {}))
+            except (OSError, ValueError):
+                stamps = {}
+            for topic, stamp in stamps.items():
+                name = _walmod.type_for(topic)
+                if name is None or name not in live:
+                    continue
+                result["checked"] += 1
+                if int(stamp) > live[name]:
+                    # a concurrent checkpoint can stamp between our two
+                    # reads — re-read before concluding (and the schema
+                    # may have been deleted meanwhile: abstain, the next
+                    # checkpoint drops its stamp)
+                    st2 = store._types.get(name)
+                    if st2 is None:
+                        result["abstained"] += 1
+                        continue
+                    with st2.lock:
+                        now = st2.wal_seq
+                    if int(stamp) > now:
+                        result["violations"].append(
+                            f"{topic}: manifest stamp {stamp} > live "
+                            f"applied seq {now}")
+        return result
+
     def check_pyramids(self, store) -> dict:
         """Pyramid partials reconcile against base per (bin, cell) on a
         rotating cell sample: the finest level's per-group counts for K

@@ -51,13 +51,14 @@ __all__ = [
     "FaultInjector",
     "FaultRule",
     "active",
+    "crash_point",
     "from_env",
     "from_spec",
     "install",
     "uninstall",
 ]
 
-_KINDS = ("refuse", "http", "latency", "truncate", "corrupt", "flip")
+_KINDS = ("refuse", "http", "latency", "truncate", "corrupt", "flip", "crash")
 
 
 class FaultRule:
@@ -134,8 +135,8 @@ class FaultInjector:
         err: Exception | None = None
         with self._lock:
             for r in self.rules:
-                if r.kind in ("truncate", "corrupt", "flip"):
-                    continue  # payload / device-state faults: not transport
+                if r.kind in ("truncate", "corrupt", "flip", "crash"):
+                    continue  # payload / device-state / process faults
                 if r.match and r.match not in key:
                     continue
                 if not r._decide_locked():
@@ -202,6 +203,31 @@ class FaultInjector:
                     continue
                 out.append(r)
         return out
+
+    def maybe_crash(self, point: str) -> None:
+        """Fire ``kind=crash`` rules matching a named sync point: the
+        process dies by SIGKILL — no atexit, no flush, no cleanup — the
+        durability plane's kill-and-recover failure mode
+        (docs/operations.md § Durability & recovery). ``match`` filters
+        by crash-point name (``wal.post_append_pre_commit``,
+        ``ckpt.pre_manifest_replace``, ``recover.mid_replay``, ...);
+        ``rate``/``times``/``after`` schedule as for transport faults."""
+        die = False
+        with self._lock:
+            for r in self.rules:
+                if r.kind != "crash":
+                    continue
+                if r.match and r.match not in point:
+                    continue
+                if r._decide_locked():
+                    die = True
+                    break
+        if die:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)  # outside the lock
+            # unreachable on POSIX; belt-and-braces for exotic platforms
+            os._exit(137)
 
     # -- lifecycle ------------------------------------------------------------
     def activate(self):
@@ -308,6 +334,18 @@ def from_env() -> FaultInjector | None:
         if _env_cache is None or _env_cache[0] != spec:
             _env_cache = (spec, inj)
         return _env_cache[1]
+
+
+def crash_point(name: str) -> None:
+    """Named kill-point for the crash harness (``scripts/crash_smoke.py``):
+    the durability-critical code paths (WAL group commit, checkpoint
+    commit order, recovery replay) call this at their crash-consistency
+    boundaries; an active injector with a matching ``kind=crash`` rule
+    SIGKILLs the process there. The inactive path is one global read —
+    the same zero-cost posture as the transport hooks."""
+    inj = active()
+    if inj is not None:
+        inj.maybe_crash(name)
 
 
 def active() -> FaultInjector | None:

@@ -203,6 +203,14 @@ class _TypeState:
     # the buffer pool's donation fingerprint — delta-only writes bump the
     # version but not this, so donated main-tier buffers stay reusable
     epoch: int = 0
+    # durability plane (store/wal.py): seq of the last WAL record whose
+    # effect is in this in-memory state (updated under `lock` with the
+    # apply; checkpoint stamps read it under `wal_lock`), and a per-state
+    # identity so an incremental checkpoint can never reuse a manifest
+    # entry across a delete+recreate of the same type name (the epoch
+    # tuple restarts at the same values there)
+    wal_seq: int = 0
+    ident: str = ""
 
     def __post_init__(self):
         if self.delta is None:
@@ -233,6 +241,15 @@ class _TypeState:
         # mutators would otherwise lose updates.
         self.lock = threading.RLock()
         self.mutate_lock = threading.RLock()
+        # WAL ordering guard — held across (apply + WAL append) so the
+        # per-type journal's seq order always equals the apply order, and
+        # by the checkpointer while stamping this type's applied seq.
+        # Hierarchy: wal_lock > mutate_lock > lock (docs/concurrency.md).
+        self.wal_lock = threading.RLock()
+        if not self.ident:
+            import uuid
+
+            self.ident = uuid.uuid4().hex
 
     def snapshot(self):
         """Coherent read of the query-relevant state (one lock hold)."""
@@ -289,6 +306,7 @@ class DataStore:
         audit_writer=None,
         metrics=None,
         user: str = "unknown",
+        wal_dir: str | None = None,
     ):
         if isinstance(backend, str):
             backend = _BACKENDS[backend]()
@@ -334,6 +352,29 @@ class DataStore:
         # the host columnar table IS the replica, so a dead device degrades
         # to exact host scans instead of failing queries)
         self._device_down_until: float = 0.0
+        # durability plane (store/wal.py; docs/operations.md § Durability
+        # & recovery): with GEOMESA_TPU_WAL (or wal_dir=) every mutating
+        # op journals before it acks; DataStore.open(recover=True) replays
+        # the tail over the last checkpoint
+        self._wal = None
+        self._wal_replay = False  # recovery/load applies without journaling
+        self._wal_schema_seq = 0  # last APPLIED schema-op seq (schema_lock)
+        self._wal_catalog: str | None = None
+        self._wal_ckpt = None
+        self._wal_unreplayed = False
+        if wal_dir is None:
+            wal_dir = os.environ.get("GEOMESA_TPU_WAL") or None
+        if wal_dir:
+            from geomesa_tpu.store.wal import WriteAheadLog
+
+            self._wal = WriteAheadLog(wal_dir)
+            # attaching over a journal with RETAINED records without
+            # replaying them (DataStore.open does; a plain construct —
+            # e.g. GEOMESA_TPU_WAL ambient on a CLI load — does not)
+            # must not mutate or checkpoint: a save would trim, and new
+            # stamps would shadow, acked history that was never applied.
+            # open() clears the flag once the tail is accounted for.
+            self._wal_unreplayed = self._wal.has_records()
 
     # -- failure detection / recovery -----------------------------------------
     DEVICE_BACKOFF_S = 30.0  # circuit stays open this long after a failure
@@ -425,10 +466,31 @@ class DataStore:
                 f"geomesa.vis.field names unknown attribute {vis_field!r}"
             )
         state = _TypeState(sft=sft, indices=build_indices(sft))
-        with self._schema_lock:  # atomic exists-check + insert
-            if sft.name in self._types:
-                raise ValueError(f"schema already exists: {sft.name}")
-            self._types[sft.name] = state
+        ticket = None
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            with self._wal.schema_lock:
+                with self._schema_lock:  # atomic exists-check + insert
+                    if sft.name in self._types:
+                        raise ValueError(f"schema already exists: {sft.name}")
+                    self._types[sft.name] = state
+                # schema-topic appends order under wal.schema_lock (held
+                # here), not the per-type wal_lock the data ops use
+                # tpurace: disable-next-line=R001
+                ticket = self._wal.append(
+                    _walmod.SCHEMA_TOPIC,
+                    {"op": "create_schema", "name": sft.name,
+                     "spec": sft.to_spec(),
+                     "index_layout": sft.index_layout})
+                self._wal_schema_seq = ticket.seq
+        else:
+            with self._schema_lock:  # atomic exists-check + insert
+                if sft.name in self._types:
+                    raise ValueError(f"schema already exists: {sft.name}")
+                self._types[sft.name] = state
+        if ticket is not None:
+            self._wal.commit(ticket)
         return sft
 
     def update_schema(
@@ -447,6 +509,31 @@ class DataStore:
         ``add``: attribute spec string(s) in the SFT DSL, e.g.
         ``"severity:Integer:index=true"``.
         """
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            st = self._state(type_name)
+            with self._wal.schema_lock, st.wal_lock:
+                new_sft = self._apply_update_schema(
+                    type_name, add, keywords, rename_to)
+                ticket = self._wal.append(
+                    _walmod.SCHEMA_TOPIC,
+                    {"op": "update_schema", "type": type_name,
+                     "add": ([add] if isinstance(add, str) else
+                             list(add) if add else None),
+                     "keywords": keywords, "rename_to": rename_to})
+                self._wal_schema_seq = ticket.seq
+            self._wal.commit(ticket)
+            return new_sft
+        return self._apply_update_schema(type_name, add, keywords, rename_to)
+
+    def _apply_update_schema(
+        self,
+        type_name: str,
+        add: str | list[str] | None = None,
+        keywords: list[str] | None = None,
+        rename_to: str | None = None,
+    ) -> FeatureType:
         st = self._state(type_name)
         sft = st.sft
         new_attrs = list(sft.attributes)
@@ -550,6 +637,23 @@ class DataStore:
         return sorted(self._types)
 
     def delete_schema(self, name: str) -> None:
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            with self._wal.schema_lock:
+                self._apply_delete_schema(name)
+                # schema-topic appends order under wal.schema_lock (held
+                # here), not the per-type wal_lock the data ops use
+                # tpurace: disable-next-line=R001
+                ticket = self._wal.append(
+                    _walmod.SCHEMA_TOPIC,
+                    {"op": "delete_schema", "name": name})
+                self._wal_schema_seq = ticket.seq
+            self._wal.commit(ticket)
+            return
+        self._apply_delete_schema(name)
+
+    def _apply_delete_schema(self, name: str) -> None:
         with self._schema_lock:
             del self._types[name]
         # a recreated same-name type RESTARTS its rebuild epoch and delta
@@ -595,6 +699,12 @@ class DataStore:
         139-149``): rows with a null default geometry or null dtg are
         rejected, and main-tier state only swaps in after every index builds,
         so a failed write never leaves the store half-applied.
+
+        With the durability plane attached (``GEOMESA_TPU_WAL`` /
+        ``wal_dir=``) the write is journaled under the type's WAL order
+        lock and the return — the ACK — waits for the record's
+        group-commit durability: a SIGKILL after return can never lose it
+        (docs/operations.md § Durability & recovery).
         """
         st = self._state(type_name)
         with obs.span("write", type_name=type_name):
@@ -603,13 +713,49 @@ class DataStore:
                     fids = self._generate_fids(st, len(data), data)
                 data = FeatureTable.from_records(st.sft, data, fids)
             self._validate(st.sft, data)
-            self.metrics.counter("store.writes").inc(len(data))
-            with st.lock:
-                st.delta.append(data)
-                compact_now = st.delta.should_compact(st.main_rows)
+            ticket = None
+            if self._wal_active():
+                from geomesa_tpu.io.arrow import to_ipc_bytes
+                from geomesa_tpu.store import wal as _walmod
+
+                payload = to_ipc_bytes(data)
+                with st.wal_lock:
+                    compact_now = self._apply_write(st, data)
+                    ticket = self._wal.append(
+                        _walmod.topic_for(type_name), {"op": "write"}, payload)
+                    with st.lock:
+                        st.wal_seq = ticket.seq
+            else:
+                compact_now = self._apply_write(st, data)
+            if ticket is not None:
+                self._wal.commit(ticket)  # durability before the ack
             if compact_now:
                 self.compact(type_name)
             return len(data)
+
+    def _apply_write(self, st: _TypeState, data) -> bool:
+        self.metrics.counter("store.writes").inc(len(data))
+        with st.lock:
+            st.delta.append(data)
+            return st.delta.should_compact(st.main_rows)
+
+    def _wal_active(self) -> bool:
+        """Journal this mutation? False on the WAL-off path (one attribute
+        check — the <2% write-overhead bound) and during recovery replay /
+        checkpoint load (the records being applied ARE the journal).
+        Raises if the attached journal still holds an unreplayed tail —
+        mutating over un-recovered acked history must fail loudly, not
+        shadow it (open with ``DataStore.open(catalog, recover=True)``)."""
+        if self._wal is None or self._wal_replay:
+            return False
+        if self._wal_unreplayed:
+            from geomesa_tpu.store.wal import WalTailError
+
+            raise WalTailError(
+                f"WAL {self._wal.path!r} holds un-replayed acked records; "
+                f"this store was attached without recovery — open the "
+                f"catalog with DataStore.open(..., recover=True)")
+        return True
 
     def _generate_fids(self, st, n: int, records: list) -> list:
         """Default feature ids. Schemas opting in via user-data
@@ -679,6 +825,26 @@ class DataStore:
         """
         st = self._state(type_name)
         want = {str(f) for f in fids}
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            ticket = None
+            with st.wal_lock:
+                removed = self._apply_delete(st, want, visible_to)
+                if removed:  # no state change → nothing to journal
+                    ticket = self._wal.append(
+                        _walmod.topic_for(type_name),
+                        {"op": "delete", "fids": sorted(want),
+                         "visible_to": (None if visible_to is None
+                                        else list(visible_to))})
+                    with st.lock:
+                        st.wal_seq = ticket.seq
+            if ticket is not None:
+                self._wal.commit(ticket)
+            return removed
+        return self._apply_delete(st, want, visible_to)
+
+    def _apply_delete(self, st: _TypeState, want: set, visible_to) -> int:
         with st.mutate_lock:
             main, _, delta, n_tables = st.consume_snapshot()
             tables = [t for t in (main, delta) if t is not None and len(t)]
@@ -739,7 +905,10 @@ class DataStore:
             # the delete and the append would target different features
             raise ValueError("update_features: table fids != fids argument")
         st = self._state(type_name)
-        with st.mutate_lock:
+        # wal_lock OUTSIDE mutate_lock: the inner delete/write journal
+        # under wal_lock, and wal_lock > mutate_lock is the canonical
+        # order (docs/concurrency.md) — taking mutate first would invert
+        with st.wal_lock, st.mutate_lock:
             # validate the replacement BEFORE deleting: a malformed update
             # must fail without destroying the original rows (the reference's
             # validates-then-writes pattern)
@@ -769,8 +938,52 @@ class DataStore:
                     f"update_features: no such feature id(s) {missing[:5]}"
                     + ("..." if len(missing) > 5 else "")
                 )
+            # wal_lock is ALREADY HELD (outer, reentrant) — the inner
+            # delete/write re-acquire it, so the static mutate->wal edge
+            # seen here cannot deadlock against the canonical wal->mutate
+            # order
+            # tpurace: disable-next-line=R002
             self.delete_features(type_name, fids, visible_to=visible_to)
             return self.write(type_name, table)
+
+    def clear(self, type_name: str) -> int:
+        """Drop every row of a type, keeping the schema (the bus tier's
+        ``Clear`` barrier as a store op; WFS-T "delete all" role). Returns
+        the rows removed. Journaled like every other mutation — a
+        recovered store is empty exactly when the acked state was."""
+        st = self._state(type_name)
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            ticket = None
+            with st.wal_lock:
+                removed = self._apply_clear(st)
+                if removed:
+                    ticket = self._wal.append(
+                        _walmod.topic_for(type_name), {"op": "clear"})
+                    with st.lock:
+                        st.wal_seq = ticket.seq
+            if ticket is not None:
+                self._wal.commit(ticket)
+            return removed
+        return self._apply_clear(st)
+
+    def _apply_clear(self, st: _TypeState) -> int:
+        with st.mutate_lock:
+            with st.lock:
+                removed = st.total_rows
+                if removed == 0:
+                    return 0
+                n_tables = len(st.delta.tables)
+                st.table = None
+                st.indices = build_indices(st.sft)
+                st.backend_state = None
+                st.stats = None
+                st.delta.drop_first(n_tables)
+                st.plan_cache.clear()
+                st.pyramids.clear()
+                st.epoch += 1
+            return removed
 
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
@@ -916,6 +1129,30 @@ class DataStore:
         ttl = self._age_off_ttl_ms(st.sft)
         if ttl is None or st.sft.dtg_field is None or st.total_rows == 0:
             return 0
+        if now_ms is None:
+            # resolve the clock BEFORE journaling: a replayed age-off must
+            # drop exactly the rows the live one did
+            import time as _time
+
+            now_ms = int(_time.time() * 1000)
+        if self._wal_active():
+            from geomesa_tpu.store import wal as _walmod
+
+            ticket = None
+            with st.wal_lock:
+                removed = self._apply_age_off(st, now_ms, ttl)
+                if removed:
+                    ticket = self._wal.append(
+                        _walmod.topic_for(type_name),
+                        {"op": "age_off", "now_ms": int(now_ms)})
+                    with st.lock:
+                        st.wal_seq = ticket.seq
+            if ticket is not None:
+                self._wal.commit(ticket)
+            return removed
+        return self._apply_age_off(st, now_ms, ttl)
+
+    def _apply_age_off(self, st: _TypeState, now_ms: int, ttl: int) -> int:
         cutoff = _ttl_cutoff_ms(ttl, now_ms)
         with st.mutate_lock:
             main, _, delta, n_tables = st.consume_snapshot()
@@ -2979,6 +3216,188 @@ class DataStore:
         return persistence.load(
             path, backend=backend, column_group=column_group, filter=filter
         )
+
+    # -- durability plane (checkpoint + WAL recovery) -------------------------
+    @classmethod
+    def open(
+        cls,
+        path: str,
+        backend: str = "tpu",
+        recover: bool = False,
+        wal_dir: str | None = None,
+        checkpointer: bool = True,
+        ckpt_bytes: int | None = None,
+        ckpt_interval_s: float | None = None,
+    ) -> "DataStore":
+        """Open a durable catalog: WAL lock → checkpoint load → WAL-tail
+        replay (docs/operations.md § Durability & recovery).
+
+        ``wal_dir`` defaults to ``GEOMESA_TPU_WAL`` or ``<path>/wal``. The
+        cross-process catalog lock is taken FIRST, so a double-open fails
+        fast with :class:`~geomesa_tpu.store.wal.WalLockedError` before
+        any state loads. With ``recover=True`` the WAL tail past the
+        manifest stamps replays exactly-once in global seq order (typed
+        records are idempotent by seq; schema ops interleave in order);
+        without it, an unreplayed tail raises
+        :class:`~geomesa_tpu.store.wal.WalTailError` instead of being
+        silently dropped. ``checkpointer=True`` starts the background
+        incremental checkpointer (WAL-bytes / interval triggers,
+        deterministic shutdown via :meth:`close`)."""
+        import json as _json
+        import time as _time
+
+        from geomesa_tpu.resilience import faults as _faults
+        from geomesa_tpu.store import persistence
+        from geomesa_tpu.store import wal as _walmod
+        from pathlib import Path as _Path
+
+        if wal_dir is None:
+            wal_dir = os.environ.get("GEOMESA_TPU_WAL") or os.path.join(
+                path, "wal")
+        ds = cls(backend=backend, wal_dir=wal_dir)
+        ds._wal_catalog = path
+        wal = ds._wal
+        try:
+            # a SIGKILLed checkpoint leaves its catalog-lease claim behind
+            # and every later save would wait out the full TTL on it; we
+            # hold the exclusive WAL lock, so dead local claims are safe
+            # to reap now
+            from geomesa_tpu.utils.locks import reap_dead_claims
+
+            reap_dead_claims(path)
+            stamps: dict[str, int] = {}
+            global_floor = 0
+            ds._wal_replay = True
+            try:
+                mpath = _Path(path) / persistence.MANIFEST
+                manifest = None
+                if mpath.exists():
+                    manifest = _json.loads(mpath.read_text())
+                    persistence.load(path, backend=backend, into=ds)
+                if manifest and manifest.get("wal"):
+                    wstamp = manifest["wal"]
+                    global_floor = int(wstamp.get("seq", 0))
+                    stamps = {str(k): int(v)
+                              for k, v in (wstamp.get("topics") or {}).items()}
+                    # re-issuing a stamped seq would make the NEXT replay
+                    # skip the acked write that reused it
+                    wal.ensure_seq_floor(
+                        max([global_floor, *stamps.values()] or [0]))
+                    ds._wal_schema_seq = stamps.get(_walmod.SCHEMA_TOPIC, 0)
+                    for name in ds.list_schemas():
+                        st = ds._state(name)
+                        st.wal_seq = stamps.get(_walmod.topic_for(name), 0)
+                        ident = (manifest.get("types", {})
+                                 .get(name, {}).get("ident"))
+                        if ident:
+                            st.ident = ident
+                tail = wal.records_after(stamps, default_floor=global_floor)
+                if tail and not recover:
+                    raise _walmod.WalTailError(
+                        f"catalog {path!r} has {len(tail)} acked WAL "
+                        f"record(s) past the last checkpoint; open with "
+                        f"recover=True to replay them")
+                if tail:
+                    t0 = _time.perf_counter()
+                    with obs.span("store.recover", catalog=path,
+                                  records=len(tail)):
+                        for seq, topic, hdr, payload in tail:
+                            _faults.crash_point("recover.mid_replay")
+                            ds._wal_apply(seq, topic, hdr, payload)
+                    _walmod._note(
+                        recoveries=1, replayed_records=len(tail),
+                        replay_ms_total=(_time.perf_counter() - t0) * 1000.0)
+            finally:
+                ds._wal_replay = False
+            # the tail (if any) is replayed and the stamps are live:
+            # mutation/checkpointing can no longer shadow acked history
+            ds._wal_unreplayed = False
+            if checkpointer:
+                ds._wal_ckpt = _walmod.WalCheckpointer(
+                    ds, path, bytes_trigger=ckpt_bytes,
+                    interval_s=ckpt_interval_s)
+            return ds
+        except BaseException:
+            wal.close()
+            raise
+
+    def _wal_apply(self, seq: int, topic: str, hdr: dict,
+                   payload: bytes) -> None:
+        """Apply one replayed WAL record to the in-memory state. Data ops
+        are exact (same pre-state → same effect); schema ops are
+        EFFECT-IDEMPOTENT — a checkpoint staged mid-save can already
+        reflect a schema op whose seq is above the schema stamp, so an
+        already-applied create/evolve/rename/delete skips (counted)."""
+        from geomesa_tpu.io.arrow import from_ipc_bytes
+        from geomesa_tpu.store import wal as _walmod
+
+        op = hdr.get("op")
+        if topic == _walmod.SCHEMA_TOPIC:
+            try:
+                if op == "create_schema":
+                    if hdr["name"] in self._types:
+                        _walmod._note(replay_skipped=1)
+                    else:
+                        sft = parse_spec(hdr["name"], hdr["spec"])
+                        if hdr.get("index_layout") == "legacy":
+                            sft.user_data["geomesa.index.layout"] = "legacy"
+                        self.create_schema(sft)
+                elif op == "delete_schema":
+                    if hdr["name"] not in self._types:
+                        _walmod._note(replay_skipped=1)
+                    else:
+                        self.delete_schema(hdr["name"])
+                elif op == "update_schema":
+                    tname = hdr["type"]
+                    if tname not in self._types:
+                        _walmod._note(replay_skipped=1)  # renamed/gone: done
+                    else:
+                        self.update_schema(
+                            tname, add=hdr.get("add"),
+                            keywords=hdr.get("keywords"),
+                            rename_to=hdr.get("rename_to"))
+            except ValueError:
+                # already-applied evolution (attribute exists / rename
+                # target exists): the checkpoint was newer than the stamp
+                _walmod._note(replay_skipped=1)
+            # recovery replay is single-threaded and runs before the
+            # store is shared with any other thread
+            # tpurace: disable-next-line=R001
+            self._wal_schema_seq = max(self._wal_schema_seq, seq)
+            return
+        name = _walmod.type_for(topic)
+        if name is None or name not in self._types:
+            # stale incarnation (type deleted before the checkpoint) or a
+            # topic whose create never acked: nothing to apply to
+            _walmod._note(replay_skipped=1)
+            return
+        st = self._state(name)
+        if op == "write":
+            self.write(name, from_ipc_bytes(st.sft, payload))
+        elif op == "delete":
+            self.delete_features(name, hdr["fids"],
+                                 visible_to=hdr.get("visible_to"))
+        elif op == "clear":
+            self.clear(name)
+        elif op == "age_off":
+            self.age_off(name, now_ms=hdr["now_ms"])
+        else:
+            _walmod._note(replay_skipped=1)
+            return
+        with st.lock:
+            st.wal_seq = max(st.wal_seq, seq)
+
+    def close(self) -> None:
+        """Deterministic shutdown of the durability plane: stop the
+        background checkpointer, flush pending group commits, release the
+        cross-process catalog lock. Idempotent; a plain (WAL-less) store
+        is a no-op."""
+        ck = self._wal_ckpt
+        if ck is not None:
+            self._wal_ckpt = None
+            ck.close()
+        if self._wal is not None:
+            self._wal.close()
 
     def _stats(self, type_name: str):
         st = self._state(type_name)

@@ -40,7 +40,8 @@ SUPPORTED_VERSIONS = (1, 2)
 
 
 def save(
-    ds, path: str, partition_by_time: bool = True, file_format: str = "parquet"
+    ds, path: str, partition_by_time: bool = True,
+    file_format: str = "parquet", durable: bool | None = None,
 ) -> dict:
     """Persist every schema + table of a DataStore; returns the manifest.
 
@@ -49,6 +50,21 @@ def save(
     ``PartitionScheme.scala`` SPI role); ``partition_by_time=False`` forces
     flat. ``file_format``: ``"parquet"`` (default) or ``"orc"`` — the two
     columnar tiers of ``geomesa-fs`` (SURVEY.md §2.12).
+
+    ``durable=True`` fsyncs shard contents BEFORE their renames and the
+    parent directories after (plus the manifest and catalog root): without
+    it, a machine crash shortly after the rename can surface an
+    empty/torn shard under the committed name — rename orders metadata,
+    not data. Defaults ON for WAL-mode checkpoints (the durability plane's
+    RPO contract, docs/operations.md § Durability & recovery) and off for
+    plain saves (SIGKILL-only durability needs no fsync).
+
+    WAL-mode saves additionally stamp ``(global seq, per-topic applied
+    seq)`` into the manifest — the recovery replay floor — and durably
+    trim committed WAL segments below the stamps afterwards, and they are
+    INCREMENTAL: a type whose ``(ident, data epoch, wal seq)`` stamp is
+    unchanged since the previous manifest reuses its shard files instead
+    of rewriting them.
 
     Catalog mutation happens under an exclusive cross-process lock
     (``DistributedLocking.scala:14`` role — :mod:`geomesa_tpu.utils.locks`),
@@ -59,7 +75,27 @@ def save(
     if file_format not in ("parquet", "orc"):
         raise ValueError(f"unsupported format: {file_format!r}")
     with catalog_lock(path):
-        return _save_locked(ds, path, partition_by_time, file_format)
+        return _save_locked(ds, path, partition_by_time, file_format,
+                            durable=durable)
+
+
+def _fsync_file(path) -> None:
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path) -> None:
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:  # pragma: no cover — platform-dependent
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _write_table(at: pa.Table, tmp: Path, file_format: str) -> None:
@@ -163,6 +199,34 @@ def _stage_type(ds, name: str, root: Path, gen: int,
     }
 
 
+def _stage_or_reuse(ds, name: str, root: Path, gen: int,
+                    partition_by_time: bool, file_format: str,
+                    staged: list, prev_entry: dict | None) -> dict:
+    """Incremental-checkpoint staging: a type whose ``(ident, data epoch,
+    wal seq)`` matches the previous manifest entry has had NO mutation
+    since that checkpoint — reuse its entry (shard files untouched)
+    instead of re-compacting and rewriting. The ident guard keeps a
+    delete+recreate of the same name (whose epoch tuple restarts at the
+    same values) from resurrecting the dead table's files."""
+    st = ds._state(name)
+    if prev_entry is not None and prev_entry.get("ident") == st.ident:
+        with st.lock:
+            unchanged = (
+                prev_entry.get("data_epoch") == list(st.data_epoch())
+                and prev_entry.get("wal_seq") == st.wal_seq
+                and prev_entry.get("spec") == st.sft.to_spec()
+            )
+        if unchanged:
+            from geomesa_tpu.store import wal as _walmod
+
+            _walmod._note(checkpoint_skipped_types=1)
+            return dict(prev_entry)
+    entry = _stage_type(ds, name, root, gen, partition_by_time,
+                        file_format, staged)
+    entry["data_epoch"] = list(st.data_epoch())
+    return entry
+
+
 class SchemaExistsError(ValueError):
     """Raised by :func:`register_schema` for the losing concurrent creator."""
 
@@ -224,7 +288,7 @@ def register_schema(path: str, sft) -> dict:
 
 
 def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
-              file_format: str | None = None) -> dict:
+              file_format: str | None = None, durable: bool = False) -> dict:
     """Coordinated per-type checkpoint into a SHARED catalog: write ONE
     type's shards and merge its manifest entry, leaving every other type's
     entry and files untouched (the multi-writer companion of
@@ -234,6 +298,14 @@ def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
     generations are collected. Returns the new manifest entry."""
     from geomesa_tpu.utils.locks import catalog_lock
 
+    if getattr(ds, "_wal", None) is not None:
+        # a per-type merge would rewrite this type's shards while leaving
+        # the manifest's WAL replay floors stale — the next recovery would
+        # re-apply already-persisted records (duplicate rows). WAL-mode
+        # stores checkpoint through save() (whole-store, stamp-coordinated).
+        raise ValueError(
+            "save_type is not supported on a WAL-attached store; use "
+            "DataStore.save (the WAL-stamped whole-store checkpoint)")
     with catalog_lock(path):
         root = Path(path)
         root.mkdir(parents=True, exist_ok=True)
@@ -252,9 +324,18 @@ def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
             ds, type_name, root, gen, partition_by_time, fmt, staged
         )
         manifest["types"][type_name] = entry
+        dirs = set()
         for tmp, final in staged:
+            if durable:  # see save(): rename orders metadata, not data
+                _fsync_file(tmp)
             os.replace(tmp, final)
+            dirs.add(final.parent)
+        if durable:
+            for d in dirs:
+                _fsync_dir(d)
         _write_manifest(root, manifest)
+        if durable:
+            _fsync_dir(root)
         keep = {f["file"] for f in entry["files"]}
         for p in (root / type_name).glob("part-*"):
             if p.name not in keep:
@@ -262,17 +343,37 @@ def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
         return entry
 
 
-def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> dict:
+def _save_locked(ds, path: str, partition_by_time: bool, file_format: str,
+                 durable: bool | None = None) -> dict:
+    from geomesa_tpu.resilience import faults as _faults
+
+    wal = getattr(ds, "_wal", None)
+    if wal is not None and getattr(ds, "_wal_unreplayed", False):
+        # stamping + trimming around a tail that was never applied would
+        # DESTROY acked history (the post-save trim reclaims below the
+        # stamps) — recovery must account for it first
+        from geomesa_tpu.store.wal import WalTailError
+
+        raise WalTailError(
+            f"WAL {wal.path!r} holds un-replayed acked records; refusing "
+            f"to checkpoint over them — open the catalog with "
+            f"DataStore.open(..., recover=True) first")
+    if durable is None:
+        durable = wal is not None
     root = Path(path)
     root.mkdir(parents=True, exist_ok=True)
     # generation-unique shard names: renames must never clobber files the
     # *live* manifest references, or a crash between shard renames and the
     # manifest flip would leave a hybrid (old manifest → new data) checkpoint
     gen = 0
+    prev_types: dict = {}
     mpath = root / MANIFEST
     if mpath.exists():
         try:
-            gen = int(json.loads(mpath.read_text()).get("generation", 0)) + 1
+            prev = json.loads(mpath.read_text())
+            gen = int(prev.get("generation", 0)) + 1
+            if prev.get("format", "parquet") == file_format:
+                prev_types = prev.get("types", {})
         except (ValueError, json.JSONDecodeError):
             gen = 1
     manifest = {
@@ -281,22 +382,77 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
         "format": file_format,
         "types": {},
     }
+    wal_stamps: dict | None = None
+    if wal is not None:
+        from geomesa_tpu.store import wal as _walmod
+
+        # schema stamp + type list captured ATOMICALLY under the WAL's
+        # schema-order lock: every schema op at/below the stamp is in this
+        # list; ops after it carry larger seqs and replay over the
+        # checkpoint (docs/operations.md § Durability & recovery)
+        with wal.schema_lock:
+            names = ds.list_schemas()
+            wal_stamps = {
+                "seq": wal.seq_highwater(),
+                "topics": {_walmod.SCHEMA_TOPIC: ds._wal_schema_seq},
+            }
+    else:
+        names = ds.list_schemas()
     staged: list[tuple[Path, Path]] = []  # (tmp, final) shard renames
-    for name in ds.list_schemas():
-        manifest["types"][name] = _stage_type(
-            ds, name, root, gen, partition_by_time, file_format, staged
-        )
+    for name in names:
+        if wal is not None:
+            st = ds._state(name)
+            # wal_lock: the applied-seq stamp and the staged snapshot must
+            # be the same instant — a write between them would be covered
+            # by neither the checkpoint nor the replay floor
+            with st.wal_lock:
+                entry = _stage_or_reuse(
+                    ds, name, root, gen, partition_by_time, file_format,
+                    staged, prev_types.get(name))
+                with st.lock:
+                    entry["ident"] = st.ident
+                    entry["wal_seq"] = st.wal_seq
+                wal_stamps["topics"][_walmod.topic_for(name)] = entry["wal_seq"]
+            manifest["types"][name] = entry
+        else:
+            manifest["types"][name] = _stage_type(
+                ds, name, root, gen, partition_by_time, file_format, staged
+            )
+    if wal_stamps is not None:
+        manifest["wal"] = wal_stamps
 
     # crash-safe commit order: new shards land under temp names above and
     # rename into generation-unique final names (never overwriting a file the
     # old manifest references); the manifest then replaces atomically, and
     # lastly stale generations are garbage-collected — a crash at any point
-    # leaves either the old or the new checkpoint loadable intact
-    for tmp, final in staged:
+    # leaves either the old or the new checkpoint loadable intact.
+    # durable mode additionally fsyncs shard CONTENTS before each rename
+    # and the parent directories after: rename orders metadata, not data —
+    # without the data sync a machine crash can surface an empty shard
+    # under the committed name (the satellite-1 torn-shard bug)
+    dirs = set()
+    for i, (tmp, final) in enumerate(staged):
+        if i:
+            _faults.crash_point("ckpt.mid_shard_renames")
+        if durable:
+            _fsync_file(tmp)
         os.replace(tmp, final)
+        dirs.add(final.parent)
+    if durable:
+        for d in dirs:
+            _fsync_dir(d)
+    _faults.crash_point("ckpt.pre_manifest_replace")
     mtmp = root / (MANIFEST + ".tmp")
     mtmp.write_text(json.dumps(manifest, indent=2))
+    if durable:
+        _fsync_file(mtmp)
     os.replace(mtmp, root / MANIFEST)
+    if durable:
+        _fsync_dir(root)
+    if wal is not None:
+        # the manifest is committed: everything below the stamps is
+        # durably covered — reclaim it so WAL disk stays bounded
+        wal.note_checkpoint(wal_stamps["topics"], wal_stamps["seq"])
 
     for name, meta in manifest["types"].items():
         keep = {f["file"] for f in meta["files"]}
@@ -304,8 +460,19 @@ def _save_locked(ds, path: str, partition_by_time: bool, file_format: str) -> di
         for p in tdir.glob("part-*"):
             if p.name not in keep:
                 p.unlink()
+    wal_path = None
+    if wal is not None:
+        try:
+            wal_path = Path(wal.path).resolve()
+        except OSError:  # pragma: no cover
+            pass
     for p in root.iterdir():
         if p.is_dir() and p.name not in manifest["types"]:
+            # the durability WAL lives INSIDE the catalog by default
+            # (<catalog>/wal): deleted-type GC must never eat it
+            if p.name == "wal" or (wal_path is not None
+                                   and p.resolve() == wal_path):
+                continue
             import shutil
 
             shutil.rmtree(p)
@@ -348,6 +515,7 @@ def load(
     backend: str = "tpu",
     column_group: str | None = None,
     filter=None,
+    into=None,
 ):
     """Restore a DataStore (device state rebuilt) from a catalog directory.
 
@@ -361,6 +529,10 @@ def load(
     partition-scheme query pruning, ``PartitionScheme.scala`` role). The
     filter is NOT applied row-wise; the restored store holds every row of
     the surviving partitions and queries still run normally.
+
+    ``into``: restore into an EXISTING empty DataStore instead of
+    constructing one — the recovery path (``DataStore.open``) loads the
+    checkpoint into the store that already holds the WAL lock.
     """
     from geomesa_tpu.schema.columnar import FeatureTable
     from geomesa_tpu.store.datastore import DataStore
@@ -370,7 +542,37 @@ def load(
     if manifest.get("version") not in SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported catalog version: {manifest.get('version')}")
     file_format = manifest.get("format", "parquet")
-    ds = DataStore(backend=backend)
+    if into is not None:
+        if into.list_schemas():
+            raise ValueError("load(into=) requires an empty DataStore")
+        ds = into
+    else:
+        ds = DataStore(backend=backend)
+    # a WAL-attached store (into= from recovery, or an ambient
+    # GEOMESA_TPU_WAL) must NOT journal its own checkpoint restore: the
+    # rows being written ARE the persisted history, and journaling them
+    # would replay them a second time over the next recovery
+    prev_replay = getattr(ds, "_wal_replay", False)
+    if getattr(ds, "_wal", None) is not None:
+        ds._wal_replay = True
+    try:
+        _load_types(ds, root, manifest, file_format, column_group, filter)
+    finally:
+        ds._wal_replay = prev_replay
+    # cost-model persistence (docs/observability.md § Cost-model
+    # persistence): learned per-(type, plan-signature) p50 rankings +
+    # calibration reload from the GEOMESA_TPU_WORKLOAD_DIR sidecar, so
+    # the adaptive planner opens warm instead of re-probing from scratch
+    from geomesa_tpu.obs import devmon
+
+    devmon.load_cost_snapshot()
+    return ds
+
+
+def _load_types(ds, root: Path, manifest: dict, file_format: str,
+                column_group, filter) -> None:
+    from geomesa_tpu.schema.columnar import FeatureTable
+
     for name, meta in manifest["types"].items():
         sft = parse_spec(name, meta["spec"])
         # v2 index-layout stamp wins over (and back-fills) the spec's
@@ -414,11 +616,3 @@ def load(
             ds.write(name, table)
             ds.compact(name)  # restored data is the main tier, not hot writes
         ds.metrics.counter(f"catalog.partitions_pruned.{name}").inc(pruned)
-    # cost-model persistence (docs/observability.md § Cost-model
-    # persistence): learned per-(type, plan-signature) p50 rankings +
-    # calibration reload from the GEOMESA_TPU_WORKLOAD_DIR sidecar, so
-    # the adaptive planner opens warm instead of re-probing from scratch
-    from geomesa_tpu.obs import devmon
-
-    devmon.load_cost_snapshot()
-    return ds

@@ -1,0 +1,564 @@
+"""Write-ahead durability plane: acked writes survive SIGKILL.
+
+The reference inherits write durability from its backends — Accumulo/HBase
+ride their own WALs and the Kafka tier persists via the external broker
+(``KafkaDataStore.scala``'s offset-managed crash survival) — so a JVM crash
+never loses an acked mutation. Here the store is in-process: between
+checkpoints (:mod:`geomesa_tpu.store.persistence`) the delta tier is
+memory-only. This module closes that gap (docs/operations.md § Durability &
+recovery):
+
+- every mutating DataStore op (write / delete / clear / age-off, schema
+  create / delete / rename / evolve) appends a typed, seq-stamped record to
+  a per-type :class:`~geomesa_tpu.stream.journal.JournalBus` topic under
+  ``GEOMESA_TPU_WAL`` (or ``DataStore(wal_dir=)``) and only ACKS — returns
+  to the caller — once the record is durably committed;
+- appends batch through GROUP COMMIT: the first waiter becomes the flush
+  leader, gathers everything enqueued behind the in-flight flush (plus an
+  optional ``GEOMESA_TPU_WAL_FLUSH_MS`` window), and lands the batch as ONE
+  journal append + commit flip (+ one fsync in ``group`` mode) — an idle
+  writer pays no window, so acked-write p99 stays near the WAL-off
+  baseline;
+- checkpoints stamp ``(global seq, per-topic applied seq)`` into the
+  catalog manifest; recovery (``DataStore.open(catalog, recover=True)``)
+  loads the checkpoint then replays exactly the records above the stamps,
+  in global seq order, and committed segments below the stamps are durably
+  head-trimmed (:meth:`JournalBus.trim`) so disk use is bounded;
+- a cross-process ``flock`` on ``<wal_dir>/wal.lock`` is held for the WAL's
+  lifetime: a second open of the same catalog fails fast with
+  :class:`WalLockedError` (and a SIGKILLed holder releases implicitly —
+  kernel-owned, no stale-lease window).
+
+Fsync modes (``GEOMESA_TPU_WAL_FSYNC``): ``off`` — no fsync; acked writes
+survive process death (SIGKILL: the page cache outlives the process) but a
+MACHINE crash can lose the un-synced tail. ``group`` (default) — one fsync
+per group-commit batch; machine-crash RPO is one batch. ``each`` — fsync
+per record; the strictest RPO, the slowest acks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+
+from geomesa_tpu.stream.journal import JournalBus, TrimmedError  # noqa: F401
+
+__all__ = [
+    "SCHEMA_TOPIC", "WalLockedError", "WalTailError", "WriteAheadLog",
+    "WalCheckpointer", "topic_for", "type_for", "prometheus_text",
+    "wal_metrics",
+]
+
+SCHEMA_TOPIC = "wal.__schema__"
+_TOPIC_PREFIX = "wal.t."
+_REC = struct.Struct("<I")  # u32 json-header length prefix inside a payload
+
+
+class WalLockedError(RuntimeError):
+    """Another live process holds this WAL's catalog lock (double-open)."""
+
+
+class WalTailError(RuntimeError):
+    """The WAL holds acked records past the checkpoint but the catalog was
+    opened without ``recover=True`` — refusing to silently drop them."""
+
+
+def topic_for(type_name: str) -> str:
+    """The per-feature-type WAL topic name."""
+    return _TOPIC_PREFIX + type_name
+
+
+def type_for(topic: str) -> str | None:
+    """Inverse of :func:`topic_for`; None for the schema topic."""
+    if topic.startswith(_TOPIC_PREFIX):
+        return topic[len(_TOPIC_PREFIX):]
+    return None
+
+
+def encode_record(seq: int, hdr: dict, payload: bytes = b"") -> bytes:
+    h = dict(hdr)
+    h["seq"] = int(seq)
+    hb = json.dumps(h, sort_keys=True).encode("utf-8")
+    return _REC.pack(len(hb)) + hb + payload
+
+
+def decode_record(data: bytes) -> tuple[dict, bytes]:
+    (n,) = _REC.unpack_from(data, 0)
+    hdr = json.loads(data[_REC.size : _REC.size + n].decode("utf-8"))
+    return hdr, data[_REC.size + n :]
+
+
+# -- process-wide WAL/recovery metrics ----------------------------------------
+# module-global like the devmon ledger: one durability plane per process is
+# the normal shape, and the exposition (web/app.py prometheus branch) must
+# not need a store reference. All counters under one leaf lock.
+_metrics_lock = threading.Lock()
+_METRICS: dict[str, float] = {
+    "records": 0, "bytes": 0, "flushes": 0, "fsyncs": 0,
+    "group_max": 0, "ack_wait_ms_total": 0.0,
+    "trims": 0, "trimmed_bytes": 0,
+    "checkpoints": 0, "checkpoint_skipped_types": 0,
+    "recoveries": 0, "replayed_records": 0, "replay_skipped": 0,
+    "replay_ms_total": 0.0,
+}
+
+
+def _note(**kw) -> None:
+    with _metrics_lock:
+        for k, v in kw.items():
+            if k == "group_max":
+                _METRICS[k] = max(_METRICS[k], v)
+            else:
+                _METRICS[k] += v
+
+
+def wal_metrics() -> dict:
+    """Snapshot of the process-wide WAL/recovery counters."""
+    with _metrics_lock:
+        return dict(_METRICS)
+
+
+def reset_metrics() -> None:
+    """Test isolation: zero the process-wide counters."""
+    with _metrics_lock:
+        for k in _METRICS:
+            _METRICS[k] = 0
+
+
+def prometheus_text() -> str:
+    """``geomesa_wal_*`` / ``geomesa_recovery_*`` exposition lines
+    (appended to ``GET /api/metrics?format=prometheus``)."""
+    m = wal_metrics()
+    rows = [
+        ("geomesa_wal_records_total", "counter",
+         "WAL records durably appended", m["records"]),
+        ("geomesa_wal_bytes_total", "counter",
+         "WAL bytes durably appended", m["bytes"]),
+        ("geomesa_wal_flushes_total", "counter",
+         "group-commit flush batches", m["flushes"]),
+        ("geomesa_wal_fsyncs_total", "counter",
+         "fsync calls issued by the WAL", m["fsyncs"]),
+        ("geomesa_wal_group_width_max", "gauge",
+         "largest group-commit batch observed", m["group_max"]),
+        ("geomesa_wal_ack_wait_ms_total", "counter",
+         "total milliseconds writers waited for durability acks",
+         m["ack_wait_ms_total"]),
+        ("geomesa_wal_trims_total", "counter",
+         "durable head-trims after checkpoints", m["trims"]),
+        ("geomesa_wal_trimmed_bytes_total", "counter",
+         "WAL bytes reclaimed by head-trims", m["trimmed_bytes"]),
+        ("geomesa_wal_checkpoints_total", "counter",
+         "WAL-stamped checkpoints", m["checkpoints"]),
+        ("geomesa_recovery_total", "counter",
+         "checkpoint+WAL recoveries completed", m["recoveries"]),
+        ("geomesa_recovery_replayed_records_total", "counter",
+         "WAL records replayed by recoveries", m["replayed_records"]),
+        ("geomesa_recovery_replay_skipped_total", "counter",
+         "stale/idempotent WAL records skipped during replay",
+         m["replay_skipped"]),
+        ("geomesa_recovery_replay_ms_total", "counter",
+         "total milliseconds spent replaying WAL tails",
+         m["replay_ms_total"]),
+    ]
+    out = []
+    for name, kind, help_, v in rows:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name} {v}")
+    return "\n".join(out) + "\n"
+
+
+class _Ticket:
+    """One enqueued record's durability handle: ``wait`` blocks until the
+    group-commit flush covering it has committed (or re-raises the flush
+    failure)."""
+
+    __slots__ = ("seq", "event", "error")
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self.event = threading.Event()
+        self.error: BaseException | None = None
+
+    def wait(self, timeout: float = 60.0) -> None:
+        if not self.event.wait(timeout):
+            raise TimeoutError("WAL group-commit flush did not complete")
+        if self.error is not None:
+            raise self.error
+
+
+class WriteAheadLog:
+    """The durability journal: per-type topics on a :class:`JournalBus`,
+    group-commit batched appends, seq stamping, checkpoint-coordinated
+    trimming, and the cross-process catalog lock."""
+
+    def __init__(self, path: str, fsync_mode: str | None = None,
+                 flush_window_s: float | None = None):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        if fsync_mode is None:
+            fsync_mode = os.environ.get("GEOMESA_TPU_WAL_FSYNC", "group")
+        if fsync_mode not in ("off", "group", "each"):
+            raise ValueError(f"unknown WAL fsync mode {fsync_mode!r}")
+        self.fsync_mode = fsync_mode
+        if flush_window_s is None:
+            flush_window_s = float(
+                os.environ.get("GEOMESA_TPU_WAL_FLUSH_MS", "0")) / 1000.0
+        self.flush_window_s = flush_window_s
+        # the red-leg chaos switch (scripts/crash_smoke.py --red): ack
+        # BEFORE durability — the exact bug the harness must detect
+        self.unsafe = os.environ.get("GEOMESA_TPU_WAL_UNSAFE") == "1"
+        self._acquire_lock()
+        # commit sidecars sync with the batch (publish_many fsync arg);
+        # the bus-level default stays off
+        self.bus = JournalBus(path, partitions=1, fsync=False)
+        self._seq_lock = threading.Lock()  # leaf: seq allocation only
+        self._seq = self._scan_max_seq() + 1
+        # schema-op ordering guard: create/delete/evolve/rename hold this
+        # across (apply + append) so schema-topic seq order == apply order;
+        # checkpoint stamp capture holds it too (docs/concurrency.md)
+        self.schema_lock = threading.RLock()
+        # group-commit state: _gc_lock (leaf) guards the pending batch;
+        # _flush_lock serializes physical flushes (leader election)
+        self._gc_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._pending: list[tuple[str, bytes]] = []
+        self._waiters: list[_Ticket] = []
+        self._closed = False
+        # checkpointer trigger: bytes appended since the last stamped
+        # checkpoint (reset by note_checkpoint)
+        self._bytes_since_ckpt = 0
+
+    # -- lifecycle ------------------------------------------------------------
+    def _acquire_lock(self) -> None:
+        import errno
+        import fcntl
+
+        lock_path = os.path.join(self.path, "wal.lock")
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            holder = ""
+            try:
+                holder = os.read(fd, 256).decode("utf-8", "replace").strip()
+            except OSError:
+                pass
+            os.close(fd)
+            if e.errno in (errno.EACCES, errno.EAGAIN):
+                raise WalLockedError(
+                    f"WAL catalog {self.path!r} is locked by another live "
+                    f"process ({holder or 'holder unknown'}); double-open "
+                    f"refused") from None
+            raise
+        os.ftruncate(fd, 0)
+        os.write(fd, f"{socket.gethostname()}:{os.getpid()}".encode())
+        self._lock_fd = fd
+
+    def close(self) -> None:
+        """Flush pending records, release the catalog lock, stop the bus —
+        deterministic and idempotent."""
+        with self._gc_lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            return
+        try:
+            self.flush()
+        finally:
+            self.bus.close()
+            try:
+                os.close(self._lock_fd)
+            except OSError:  # pragma: no cover
+                pass
+
+    def abandon(self) -> None:
+        """Crash SIMULATION for in-process tests: drop the catalog lock
+        and bus WITHOUT flushing pending acks — the state a SIGKILL
+        leaves behind. Never call this on a production store."""
+        with self._gc_lock:
+            self._closed = True
+            self._pending.clear()
+            self._waiters.clear()
+        self.bus.close()
+        try:
+            os.close(self._lock_fd)
+        except OSError:  # pragma: no cover
+            pass
+
+    # -- append / group commit ------------------------------------------------
+    def append(self, topic: str, hdr: dict, payload: bytes = b"") -> _Ticket:
+        """Assign the next global seq and enqueue one typed record for the
+        next group-commit flush. The caller holds the scope's ordering
+        lock (the type's ``wal_lock`` / :attr:`schema_lock`) so per-topic
+        seq order equals apply order; durability is NOT yet established —
+        call :meth:`commit` on the ticket before acking the client."""
+        with self._gc_lock:
+            if self._closed:
+                raise RuntimeError(f"WAL {self.path!r} is closed")
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        rec = encode_record(seq, hdr, payload)
+        t = _Ticket(seq)
+        if self.unsafe:
+            # RED LEG ONLY (scripts/crash_smoke.py --red): the ack
+            # precedes durability — the record idles in the pending buffer
+            # behind a deferred flush, and the crash point fires while
+            # EARLIER acked records are still unflushed: the injected
+            # acked-write loss the harness must detect
+            from geomesa_tpu.resilience import faults as _faults
+
+            with self._gc_lock:
+                if self._pending:
+                    _faults.crash_point("wal.unsafe_ack_window")
+                self._pending.append((topic, rec))
+                self._waiters.append(t)
+            t.event.set()
+            threading.Timer(0.05, self._unsafe_flush).start()
+            return t
+        with self._gc_lock:
+            self._pending.append((topic, rec))
+            self._waiters.append(t)
+        return t
+
+    def _unsafe_flush(self) -> None:
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 — the acks already happened
+            pass
+
+    def commit(self, ticket: _Ticket, timeout: float = 60.0) -> None:
+        """Block until ``ticket``'s record is durable. Leader-based group
+        commit: whoever gets the flush lock first flushes EVERYTHING
+        pending (gathering an optional ``flush_window_s``); waiters that
+        arrive mid-flush gather into the next batch behind it. An idle
+        writer flushes immediately — no fixed window tax."""
+        t0 = time.perf_counter()
+        while not ticket.event.is_set():
+            with self._flush_lock:
+                if ticket.event.is_set():
+                    break
+                if self.flush_window_s > 0:
+                    # the flush lock EXISTS to serialize the flush,
+                    # including its optional gather window — followers
+                    # keep enqueueing under _gc_lock meanwhile
+                    # tpurace: disable-next-line=R003
+                    time.sleep(self.flush_window_s)  # gather followers
+                self._flush_locked()
+        _note(ack_wait_ms_total=(time.perf_counter() - t0) * 1000.0)
+        ticket.wait(timeout)
+
+    def flush(self) -> None:
+        """Drain every pending record to the journal (checkpoint barrier,
+        shutdown)."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._gc_lock:
+            batch, waiters = self._pending, self._waiters
+            self._pending, self._waiters = [], []
+        if not batch:
+            return
+        by_topic: dict[str, list[tuple[str, bytes]]] = {}
+        for topic, rec in batch:
+            by_topic.setdefault(topic, []).append(("", rec))
+        fsync = {"off": False, "group": "group", "each": "each"}[self.fsync_mode]
+        err: BaseException | None = None
+        nbytes = 0
+        published: set[str] = set()
+        try:
+            for topic, recs in by_topic.items():
+                # exclusive pinned writer (the catalog lock guarantees
+                # single-writer): the steady flush is write + commit flip,
+                # not open/lock/read/close per batch. Idempotent per call —
+                # a failed flush UNPINS (the repair path), and this re-pin
+                # restores the invariant via ftruncate-to-commit
+                self.bus.pin_writer(topic)
+                start, end = self.bus.publish_many(
+                    topic, recs, fsync=fsync, crash_points=True)
+                published.add(topic)
+                nbytes += end - start
+        except BaseException as e:  # noqa: BLE001 — waiters must wake
+            err = e
+        if err is not None:
+            # a transient flush failure (ENOSPC, EIO) must not LOSE the
+            # records: the failing op raises (its ack never happened) but
+            # the in-memory apply already stands — re-enqueue the
+            # un-COMMITTED records at the head so the next flush retries
+            # them in order. Topics whose publish_many returned are
+            # committed and must not re-enqueue (a same-seq duplicate
+            # would replay twice); a topic that failed MID-publish left
+            # only an un-committed torn tail the next append repairs.
+            with self._gc_lock:
+                self._pending[:0] = [
+                    (t, r) for t, r in batch if t not in published]
+        for w in waiters:
+            w.error = err
+            w.event.set()
+        if err is None:
+            _note(records=len(batch), bytes=nbytes, flushes=1,
+                  group_max=len(batch),
+                  fsyncs=(0 if self.fsync_mode == "off"
+                          else len(batch) if self.fsync_mode == "each"
+                          else len(by_topic)))
+            with self._gc_lock:
+                self._bytes_since_ckpt += nbytes
+        if err is not None:
+            raise err
+
+    # -- recovery / checkpoint coordination -----------------------------------
+    def seq_highwater(self) -> int:
+        """The last seq handed out (records at/below it are either durable,
+        pending, or belong to ops that never acked)."""
+        with self._seq_lock:
+            return self._seq - 1
+
+    def ensure_seq_floor(self, floor: int) -> None:
+        """Never hand out a seq at/below ``floor``. Recovery calls this
+        with the manifest's global stamp: a checkpoint can stamp seqs of
+        enqueued-but-unflushed records (they are IN the checkpoint image),
+        so after a crash the on-disk max can sit BELOW the stamp — resuming
+        from the disk max alone would re-issue stamped seqs and the NEXT
+        replay would skip those acked writes as already-covered."""
+        with self._seq_lock:
+            self._seq = max(self._seq, int(floor) + 1)
+
+    @property
+    def bytes_since_checkpoint(self) -> int:
+        with self._gc_lock:
+            return self._bytes_since_ckpt
+
+    def topics(self) -> list[str]:
+        """WAL topics present on disk (schema topic + per-type topics)."""
+        return [t for t in self.bus.topics()
+                if t == SCHEMA_TOPIC or t.startswith(_TOPIC_PREFIX)]
+
+    def has_records(self) -> bool:
+        """Any retained (committed, untrimmed) records on disk? A plain
+        ``DataStore(wal_dir=)`` attach over such a journal has NOT
+        replayed them — mutating/checkpointing that store could trim or
+        shadow acked history, so the store gates on this until a
+        recovery (``DataStore.open``) accounts for the tail."""
+        return any(
+            self.bus.committed_offset(t) > self.bus.head_offset(t)
+            for t in self.topics()
+        )
+
+    def _scan_max_seq(self) -> int:
+        """Largest seq present in the on-disk logs (resume point)."""
+        high = 0
+        for topic in self.topics():
+            for _s, _e, payload in self.bus.iter_records(topic):
+                try:
+                    hdr, _ = decode_record(payload)
+                    high = max(high, int(hdr.get("seq", 0)))
+                except (ValueError, KeyError, json.JSONDecodeError):
+                    continue  # unreadable record: replay will surface it
+        return high
+
+    def records_after(self, stamps: dict[str, int], default_floor: int = 0):
+        """Every durable record with ``seq > stamps.get(topic,
+        default_floor)``, merged across topics in GLOBAL seq order — the
+        recovery replay stream. ``default_floor`` is the checkpoint's
+        global seq: topics the manifest does not stamp (deleted / stale
+        incarnations, or types created after the checkpoint) replay only
+        their post-checkpoint records."""
+        out: list[tuple[int, str, dict, bytes]] = []
+        for topic in self.topics():
+            floor = stamps.get(topic, default_floor)
+            for _s, _e, payload in self.bus.iter_records(topic):
+                hdr, body = decode_record(payload)
+                seq = int(hdr["seq"])
+                if seq > floor:
+                    out.append((seq, topic, hdr, body))
+        out.sort(key=lambda r: r[0])
+        return out
+
+    def note_checkpoint(self, stamps: dict[str, int], global_seq: int) -> None:
+        """A checkpoint with these per-topic applied-seq stamps just
+        committed: durably head-trim every topic below its stamp (topics
+        the manifest no longer stamps trim below the global seq — dead
+        incarnations drain; records of types created after the stamp
+        capture carry larger seqs and survive) and reset the byte
+        trigger."""
+        for topic in self.topics():
+            floor = stamps.get(topic, global_seq)
+            boundary = None
+            for start, end, payload in self.bus.iter_records(topic):
+                try:
+                    hdr, _ = decode_record(payload)
+                except (ValueError, json.JSONDecodeError):
+                    break
+                if int(hdr.get("seq", 0)) > floor:
+                    break
+                boundary = end
+            if boundary is not None:
+                trimmed = self.bus.trim(topic, boundary)
+                if trimmed:
+                    _note(trims=1, trimmed_bytes=trimmed)
+        with self._gc_lock:
+            self._bytes_since_ckpt = 0
+        _note(checkpoints=1)
+
+
+class WalCheckpointer:
+    """Background incremental checkpointer: saves the store's catalog when
+    the WAL grows past ``bytes_trigger`` (``GEOMESA_TPU_WAL_CKPT_BYTES``,
+    default 64 MiB) or every ``interval_s`` (``GEOMESA_TPU_WAL_CKPT_
+    INTERVAL_S``, default off). Deterministic shutdown: :meth:`close` sets
+    the stop event and joins the thread; a checkpoint failure is counted
+    and retried on the next trigger, never fatal."""
+
+    POLL_S = 0.2
+
+    def __init__(self, ds, catalog_path: str,
+                 bytes_trigger: int | None = None,
+                 interval_s: float | None = None):
+        self.ds = ds
+        self.catalog_path = catalog_path
+        if bytes_trigger is None:
+            bytes_trigger = int(
+                os.environ.get("GEOMESA_TPU_WAL_CKPT_BYTES", str(1 << 26)))
+        if interval_s is None:
+            interval_s = float(
+                os.environ.get("GEOMESA_TPU_WAL_CKPT_INTERVAL_S", "0"))
+        self.bytes_trigger = bytes_trigger
+        self.interval_s = interval_s
+        self.errors = 0
+        self.checkpoints = 0
+        self._stop = threading.Event()
+        self._last = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="geomesa-wal-checkpointer")
+        self._thread.start()
+
+    def _due(self) -> bool:
+        wal = getattr(self.ds, "_wal", None)
+        if wal is None:
+            return False
+        if self.bytes_trigger and wal.bytes_since_checkpoint >= self.bytes_trigger:
+            return wal.bytes_since_checkpoint > 0
+        if self.interval_s and (time.monotonic() - self._last) >= self.interval_s:
+            return wal.bytes_since_checkpoint > 0
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.POLL_S):
+            if not self._due():
+                continue
+            try:
+                self.ds.save(self.catalog_path)
+                self.checkpoints += 1
+            except Exception:  # noqa: BLE001 — retried on the next trigger
+                self.errors += 1
+            self._last = time.monotonic()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30.0)

@@ -48,6 +48,7 @@ class ThreadedConsumer:
         threads: int = 2,
         poll_interval_s: float = 0.002,
         idle_max_s: float = 0.1,
+        durable_trim: bool = False,
     ):
         from geomesa_tpu.resilience.policy import RetryPolicy
 
@@ -56,6 +57,21 @@ class ThreadedConsumer:
         self.apply = apply
         self.poll_interval_s = poll_interval_s
         self.idle_max_s = idle_max_s
+        # durable_trim: a CHECKPOINTED consumer (its applied offsets are
+        # its checkpoint) also truncates the journal's disk HEAD below the
+        # fully-applied prefix (JournalBus.trim_applied) so a long-lived
+        # topic stops growing without bound — docs/streaming.md. Off by
+        # default: head-trimming is destructive for other readers of the
+        # same journal directory.
+        self.durable_trim = bool(
+            durable_trim and hasattr(bus, "enable_trim_tracking"))
+        if self.durable_trim:
+            bus.enable_trim_tracking(topic)
+        self._trim_lock = None
+        if self.durable_trim:
+            import threading as _threading
+
+            self._trim_lock = _threading.Lock()
         # jitter source only (next_delay); the retry machinery is unused
         self._idle = RetryPolicy(
             base_delay_s=poll_interval_s, max_delay_s=idle_max_s
@@ -85,6 +101,7 @@ class ThreadedConsumer:
         trim = getattr(self.bus, "trim", None)  # durable buses free applied
         delay: float | None = None
         next_lag_t = 0.0
+        next_disk_trim_t = 0.0
         while not self._stop.is_set():
             drained = 0
             for p in partitions:
@@ -99,6 +116,20 @@ class ThreadedConsumer:
                 if applied and trim is not None:
                     # bound the bus's in-memory window to unapplied messages
                     trim(self.topic, p, self._offsets[p])
+            if drained and self.durable_trim:
+                # throttled disk head-trim below the fully-applied prefix
+                # (one rewrite per window, not per record); offsets read
+                # outside locks are safe — trim_applied only advances over
+                # records EVERY partition has applied, so a stale read can
+                # only under-trim
+                now = _time.monotonic()
+                if now >= next_disk_trim_t and self._trim_lock.acquire(
+                        blocking=False):
+                    try:
+                        next_disk_trim_t = now + 0.25
+                        self.bus.trim_applied(self.topic, list(self._offsets))
+                    finally:
+                        self._trim_lock.release()
             if drained == 0:
                 # decorrelated exponential backoff while idle; reset on
                 # traffic (fixed 2 ms spins burned a core per quiet topic)

@@ -30,6 +30,16 @@ the single record whose commit never landed:
 Format per record: ``<u32 payload_len><u8 barrier><i64 key_hash><payload>``.
 A barrier record (Clear) belongs to EVERY partition, matching the
 in-process bus's rendezvous semantics.
+
+Head truncation (:meth:`JournalBus.trim` with two arguments): the log head
+can be durably dropped below a LOGICAL byte offset once a checkpoint (the
+WAL manifest stamp, a consumer's applied offset) covers it, so neither the
+durability WAL nor long-lived stream topics grow without bound. Trimmed
+files carry a fixed header (``GMJL`` magic + base byte/record offsets);
+logical offsets — commit sidecar values, ``total_poll_bytes`` cursors —
+NEVER shift, and a reader whose cursor falls below the retained head gets
+a typed :class:`TrimmedError`, never misframed bytes. Legacy headerless
+logs read as base 0 and gain the header on their first trim.
 """
 
 from __future__ import annotations
@@ -42,15 +52,56 @@ import threading
 import zlib
 from typing import Callable
 
-__all__ = ["JournalBus"]
+__all__ = ["JournalBus", "TrimmedError"]
 
 _HEADER = struct.Struct("<IBq")
 _COMMIT = struct.Struct("<Q")
+# optional log-file header, present once a log has been head-trimmed:
+# magic, format version, pad, base LOGICAL byte offset of the first
+# retained byte, count of records wholly below it
+_MAGIC = b"GMJL"
+_FILEHDR = struct.Struct("<4sBxxxQQ")
+
+
+class TrimmedError(RuntimeError):
+    """A reader asked for journal bytes below the durably trimmed head.
+
+    The retained log is intact — only history below the checkpointed trim
+    point is gone. Callers restart from the current head (``cursor=0`` on
+    :meth:`JournalBus.total_poll_bytes` resumes at the first retained
+    record) or from their own checkpoint above it."""
+
+
+def _parse_filehdr(buf: bytes) -> tuple[int, int, int]:
+    """``(base_bytes, base_records, header_len)`` from a log file's first
+    bytes; legacy headerless logs → ``(0, 0, 0)``."""
+    if len(buf) >= _FILEHDR.size and buf[: len(_MAGIC)] == _MAGIC:
+        _m, _v, base, brecs = _FILEHDR.unpack(buf[: _FILEHDR.size])
+        return int(base), int(brecs), _FILEHDR.size
+    return 0, 0, 0
 
 
 def _key_hash(key: str) -> int:
     """Stable across processes (``hash()`` is salted per interpreter)."""
     return zlib.crc32(key.encode("utf-8")) if key else 0
+
+
+def _unsafe_name(safe: str) -> str:
+    """Inverse of :meth:`JournalBus._safe` (fixed-width ``_xxxxxx`` hex
+    escapes) — topic discovery from on-disk file names."""
+    out, i = [], 0
+    while i < len(safe):
+        c = safe[i]
+        if c == "_" and i + 6 < len(safe):
+            try:
+                out.append(chr(int(safe[i + 1 : i + 7], 16)))
+                i += 7
+                continue
+            except ValueError:
+                pass
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 class JournalBus:
@@ -71,6 +122,27 @@ class JournalBus:
         self._scan_pos: dict[str, int] = {}
         self._plogs: dict[str, list[list[bytes]]] = {}
         self._pbase: dict[str, list[int]] = {}  # trimmed-prefix offsets
+        # absolute record index where THIS process's scan began (the log's
+        # base_records at first refresh): disk replay below it means some
+        # other process head-trimmed under us → TrimmedError, never a
+        # silently shortened backlog
+        self._rec_base: dict[str, int] = {}
+        # durable-trim tracking (enable_trim_tracking): per-record
+        # (partition, logical_end_byte) metadata in total order, so a
+        # checkpointed consumer's per-partition applied offsets map back
+        # to a safe head-trim byte boundary (trim_applied)
+        self._trim_track: set[str] = set()
+        self._rec_meta: dict[str, list[tuple[int, int]]] = {}
+        self._rec_meta_pcounts: dict[str, list[int]] = {}
+        # pinned writers (pin_writer): an EXCLUSIVE long-lived appender —
+        # the WAL, which owns its whole directory via the catalog lock —
+        # keeps the log fd open and flocked across appends, with header
+        # and commit offset cached, so the group-commit hot path is
+        # write + sidecar flip instead of open/lock/read/close per flush.
+        # _pin_mu serializes pinned appends with head-trims (a trim
+        # replaces the inode and must re-pin).
+        self._pin_mu = threading.Lock()
+        self._pinned: dict[str, list] = {}  # topic -> [fd, base, hdr, committed]
         # total-order log: only the not-yet-dispatched window stays in
         # memory (_tbase + len(_tlogs) == _tcount always); poll-only
         # readers keep it empty
@@ -156,24 +228,37 @@ class JournalBus:
             pass
         return None
 
-    def _scan_framed_prefix(self, topic: str, size: int) -> int:
-        """Longest well-framed byte prefix of the log — the commit-offset
-        recovery path when the sidecar is lost."""
+    def _log_head(self, topic: str) -> tuple[int, int, int]:
+        """``(base_bytes, base_records, header_len)`` of the topic's log
+        file (all zero for legacy/missing logs)."""
         try:
             with open(self._log_path(topic), "rb") as f:
-                buf = f.read(size)
+                return _parse_filehdr(f.read(_FILEHDR.size))
+        except OSError:
+            return 0, 0, 0
+
+    def _scan_framed_prefix(self, topic: str, size: int | None = None) -> int:
+        """Longest well-framed LOGICAL byte prefix of the log — the
+        commit-offset recovery path when the sidecar is lost. ``size``
+        optionally bounds the PHYSICAL bytes considered (a writer's
+        fstat under the append lock)."""
+        try:
+            with open(self._log_path(topic), "rb") as f:
+                buf = f.read(size) if size is not None else f.read()
         except OSError:
             return 0
-        off = 0
+        base, _brecs, hdrlen = _parse_filehdr(buf)
+        off = hdrlen
         while len(buf) - off >= _HEADER.size:
             ln, _b, _k = _HEADER.unpack_from(buf, off)
             end = off + _HEADER.size + ln
             if end > len(buf):
                 break
             off = end
-        return off
+        return base + (off - hdrlen)
 
-    def _write_commit(self, topic: str, value: int) -> None:
+    def _write_commit(self, topic: str, value: int,
+                      fsync: bool | None = None) -> None:
         """Atomic sidecar update (write-temp + rename): lock-free readers
         can never observe a torn 8-byte value."""
         path = self._commit_path(topic)
@@ -181,7 +266,7 @@ class JournalBus:
         fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
         try:
             os.write(fd, _COMMIT.pack(value))
-            if self.fsync:
+            if self.fsync if fsync is None else fsync:
                 os.fsync(fd)
         finally:
             os.close(fd)
@@ -211,36 +296,188 @@ class JournalBus:
 
     def _publish(self, topic: str, key: str, data: bytes,
                  barrier: bool = False) -> None:
-        self.create_topic(topic)
-        rec = _HEADER.pack(len(data), 1 if barrier else 0, _key_hash(key)) + data
+        self._append_records(topic, [(key, data, barrier)], fsync=self.fsync)
+
+    def publish_many(self, topic: str, records, fsync=None,
+                     crash_points: bool = False) -> tuple[int, int]:
+        """Group-commit append: all of ``records`` (``(key, data)`` or
+        ``(key, data, barrier)`` tuples) land under ONE append lock with
+        ONE commit-offset update — the WAL's batched flush (one fsync per
+        batch instead of per record; docs/operations.md § Durability &
+        recovery). ``fsync``: ``False`` never syncs; ``True`` syncs the
+        log AND the commit sidecar once after the batch; ``"group"``
+        syncs the log once but lets the sidecar ride the page cache (a
+        machine crash truncates back to the last synced commit — RPO one
+        batch, the group mode's documented contract — while SIGKILL
+        loses nothing); ``"each"`` syncs after every record plus the
+        sidecar (the strictest RPO); ``None`` inherits the bus default.
+        Returns the batch's ``(start, end)`` logical byte offsets.
+        ``crash_points``: consult the fault injector's named kill points
+        between records and before the commit flip (the crash harness's
+        torn-batch / unacked-tail windows)."""
+        recs = [r if len(r) == 3 else (r[0], r[1], False) for r in records]
+        return self._append_records(
+            topic, recs, fsync=self.fsync if fsync is None else fsync,
+            crash_points=crash_points)
+
+    def _locked_log_fd(self, topic: str) -> int:
+        """Open + exclusively flock the topic's log, re-opening if a
+        concurrent head-trim replaced the inode between open and lock
+        (appending to the unlinked old inode would silently lose the
+        record)."""
         path = self._log_path(topic)
-        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
-        try:
-            while True:
+        while True:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                while True:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_EX)
+                        break
+                    except OSError as e:  # pragma: no cover — EINTR retry
+                        if e.errno != errno.EINTR:
+                            raise
                 try:
-                    fcntl.flock(fd, fcntl.LOCK_EX)
-                    break
-                except OSError as e:  # pragma: no cover — EINTR retry
-                    if e.errno != errno.EINTR:
-                        raise
+                    if os.fstat(fd).st_ino == os.stat(path).st_ino:
+                        return fd
+                except OSError:
+                    pass  # replaced mid-lock: retry
+            except BaseException:
+                os.close(fd)
+                raise
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def pin_writer(self, topic: str) -> None:
+        """Pin an exclusive long-lived writer for a topic: the log fd
+        stays open and flocked, the tail is repaired ONCE, and the commit
+        offset is cached — later appends skip the per-publish open/lock/
+        read cycle. ONLY for single-writer topics (the durability WAL,
+        whose catalog lock already guarantees exclusivity): a second
+        process's publish would block on the held flock forever."""
+        with self._pin_mu:
+            self._pin_locked(topic)
+
+    def _pin_locked(self, topic: str) -> None:
+        if topic in self._pinned:
+            return
+        self.create_topic(topic)
+        fd = self._locked_log_fd(topic)
+        base, _brecs, hdrlen = _parse_filehdr(os.pread(fd, _FILEHDR.size, 0))
+        committed = self._read_commit(topic)
+        size = os.fstat(fd).st_size
+        if committed is None:
+            committed = self._scan_framed_prefix(topic, size)
+        committed = max(committed, base)
+        if base + (size - hdrlen) > committed:
+            os.ftruncate(fd, hdrlen + (committed - base))
+        os.lseek(fd, 0, os.SEEK_END)
+        # the commit sidecar rides a pinned fd too: an exclusive writer
+        # updates it with one 8-byte pwrite instead of tmp+rename per
+        # flush (readers of a torn value fall back to the framed-prefix
+        # scan — the sidecar is a hint, the log is the truth)
+        cfd = os.open(self._commit_path(topic),
+                      os.O_CREAT | os.O_RDWR, 0o644)
+        os.pwrite(cfd, _COMMIT.pack(committed), 0)
+        self._pinned[topic] = [fd, base, hdrlen, committed, cfd]
+
+    def _unpin_locked(self, topic: str) -> None:
+        pin = self._pinned.pop(topic, None)
+        if pin is not None:
+            try:
+                fcntl.flock(pin[0], fcntl.LOCK_UN)
+            finally:
+                os.close(pin[0])
+                os.close(pin[4])
+
+    def unpin_all(self) -> None:
+        with self._pin_mu:
+            for topic in list(self._pinned):
+                self._unpin_locked(topic)
+
+    @staticmethod
+    def _write_all(fd: int, buf: bytes) -> None:
+        """os.write until everything landed: a short write (ENOSPC
+        edge, >RW_MAX buffers) must never let the commit offset advance
+        past bytes that were not written."""
+        view = memoryview(buf)
+        while view:
+            n = os.write(fd, view)
+            view = view[n:]
+
+    def _write_records(self, fd: int, recs, fsync, crash_points: bool,
+                       committed: int) -> int:
+        """The ONE record-append loop shared by the pinned and unpinned
+        paths (frame pack, per-record/batch fsync, named crash points);
+        returns the new committed offset. The caller flips the commit."""
+        from geomesa_tpu.resilience import faults as _faults
+
+        for i, (key, data, barrier) in enumerate(recs):
+            if crash_points and i:
+                _faults.crash_point("wal.mid_group_commit")
+            self._write_all(fd, _HEADER.pack(
+                len(data), 1 if barrier else 0, _key_hash(key)) + data)
+            committed += _HEADER.size + len(data)
+            if fsync == "each":
+                os.fsync(fd)
+        if fsync and fsync != "each":
+            os.fsync(fd)
+        if crash_points:
+            # the widest unacked window: bytes are in the log but the
+            # commit offset still points below them — recovery MUST
+            # truncate them as torn, never misframe
+            _faults.crash_point("wal.post_append_pre_commit")
+        return committed
+
+    def _append_records(self, topic: str, recs, fsync,
+                        crash_points: bool = False) -> tuple[int, int]:
+        self.create_topic(topic)
+        with self._pin_mu:
+            pin = self._pinned.get(topic)
+            if pin is not None:
+                fd, _base, _hdrlen, committed, cfd = pin
+                start = committed
+                try:
+                    committed = self._write_records(
+                        fd, recs, fsync, crash_points, committed)
+                    os.pwrite(cfd, _COMMIT.pack(committed), 0)
+                    if fsync and fsync not in ("group",):
+                        # tpurace: disable-next-line=R003
+                        os.fsync(cfd)
+                except BaseException:
+                    # a failed flush leaves the fd positioned past
+                    # un-committed bytes while the cached offset is stale:
+                    # drop the pin — the next append's slow path (or
+                    # re-pin) repairs via ftruncate-to-commit, so a retry
+                    # can never misframe or duplicate
+                    self._unpin_locked(topic)
+                    raise
+                pin[3] = committed
+                return start, committed
+        fd = self._locked_log_fd(topic)
+        try:
+            base, _brecs, hdrlen = _parse_filehdr(os.pread(fd, _FILEHDR.size, 0))
             committed = self._read_commit(topic)
             size = os.fstat(fd).st_size
             if committed is None:
                 # lost sidecar: recover from the log itself (never assume
                 # 0 — that would truncate committed history away)
                 committed = self._scan_framed_prefix(topic, size)
-            if size > committed:
+            committed = max(committed, base)
+            if base + (size - hdrlen) > committed:
                 # torn bytes from a writer killed mid-append: repair under
                 # the lock so the new record starts at the commit boundary
-                os.ftruncate(fd, committed)
-                size = committed
+                os.ftruncate(fd, hdrlen + (committed - base))
             os.lseek(fd, 0, os.SEEK_END)
-            os.write(fd, rec)
-            if self.fsync:
-                os.fsync(fd)
-            # commit AFTER the record is fully (and, with fsync, durably)
-            # in the log — readers never parse past this offset
-            self._write_commit(topic, size + len(rec))
+            start = committed
+            committed = self._write_records(
+                fd, recs, fsync, crash_points, committed)
+            # commit AFTER the records are fully (and, with fsync, durably)
+            # in the log — readers never parse past this offset. "group"
+            # skips the sidecar sync: its loss truncates back to the last
+            # synced commit, which IS the mode's one-batch RPO
+            self._write_commit(topic, committed,
+                               fsync=bool(fsync) and fsync != "group")
+            return start, committed
         finally:
             fcntl.flock(fd, fcntl.LOCK_UN)
             os.close(fd)
@@ -255,12 +492,10 @@ class JournalBus:
             committed = self._read_commit(topic)
             if committed is None:
                 # lost sidecar: fall back to the longest well-framed prefix
-                try:
-                    size = os.path.getsize(self._log_path(topic))
-                except OSError:
-                    return
-                committed = self._scan_framed_prefix(topic, size)
+                committed = self._scan_framed_prefix(topic)
             if committed <= pos:
+                # base <= committed always (trim clamps at the commit), so
+                # nothing-new also means pos is at/above any trimmed head
                 return
             try:
                 # the bus lock IS this read's serialization point: scan
@@ -269,13 +504,28 @@ class JournalBus:
                 # committed offset (page-cache-hot in the steady state)
                 # tpurace: disable-next-line=R003
                 with open(self._log_path(topic), "rb") as f:
-                    f.seek(pos)
+                    base, brecs, hdrlen = _parse_filehdr(f.read(_FILEHDR.size))
+                    if pos < base:
+                        if pos == 0 and self._tcount[topic] == 0:
+                            # fresh attach to a head-trimmed log: the scan
+                            # begins at the first retained record — nothing
+                            # below was ever promised to this process
+                            pos = base
+                            self._rec_base[topic] = brecs
+                        else:
+                            raise TrimmedError(
+                                f"journal {topic!r}: scan position {pos} is "
+                                f"below the trimmed head {base}")
+                    elif pos == 0:
+                        self._rec_base.setdefault(topic, 0)
+                    f.seek(hdrlen + (pos - base))
                     buf = f.read(committed - pos)
             except OSError:
                 return
             plog = self._plogs[topic]
             tlog = self._tlogs[topic]
             has_subs = bool(self._subscribers.get(topic))
+            track = topic in self._trim_track
             off = 0
             while len(buf) - off >= _HEADER.size:
                 ln, barrier, kh = _HEADER.unpack_from(buf, off)
@@ -288,6 +538,10 @@ class JournalBus:
                         plog[p].append(payload)
                 else:
                     plog[kh % self.partitions].append(payload)
+                if track:
+                    # (-1 = barrier: belongs to every partition)
+                    self._rec_meta[topic].append(
+                        (-1 if barrier else kh % self.partitions, pos + end))
                 # total-order window only buffers for push subscribers;
                 # poll-only readers keep it empty (bounded memory)
                 if has_subs:
@@ -338,13 +592,16 @@ class JournalBus:
                 self._tcount.get(topic, 0) - self._dispatched.get(topic, 0), 0
             )
 
-    def trim(self, topic: str, partition: int, upto: int) -> int:
-        """Release THIS READER's memory for partition messages below
-        ``upto`` (a consumed offset). The on-disk journal is untouched —
-        durability and late-attaching readers are unaffected; only this
-        process's replay ability for the trimmed prefix goes away. A
-        long-running consumer calls this with its applied offset to bound
-        resident memory. Returns the messages released."""
+    def trim(self, topic: str, partition: int, upto: int | None = None) -> int:
+        """Two forms. ``trim(topic, partition, upto)`` releases THIS
+        READER's memory for partition messages below ``upto`` (a consumed
+        offset); the on-disk journal is untouched — durability and
+        late-attaching readers are unaffected. ``trim(topic,
+        below_offset)`` (two arguments) durably truncates the LOG HEAD
+        below a logical byte offset — see :meth:`trim_log`. Both return
+        what they released (messages / bytes)."""
+        if upto is None:
+            return self.trim_log(topic, partition)
         self.create_topic(topic)
         with self._lock:
             base = self._pbase[topic][partition]
@@ -353,6 +610,187 @@ class JournalBus:
                 del self._plogs[topic][partition][:drop]
                 self._pbase[topic][partition] = base + drop
             return drop
+
+    def trim_log(self, topic: str, below_offset: int) -> int:
+        """Durable log-HEAD truncation: committed records wholly below
+        logical byte ``below_offset`` leave the disk (clamped to the
+        commit offset and snapped DOWN to a record boundary — a record is
+        never split). Logical offsets never shift: the retained tail is
+        rewritten under the append lock behind a header stamping the new
+        base, the commit sidecar is untouched, and the replacement is
+        atomic (tmp + fsync + rename) so a crash leaves either the old or
+        the new file intact. Readers whose cursor falls below the new
+        head raise :class:`TrimmedError`. Returns the bytes trimmed."""
+        self.create_topic(topic)
+        with self._pin_mu:
+            # a pinned writer holds the flock and its inode dies with the
+            # rewrite: release, trim, re-pin on the new inode
+            repin = topic in self._pinned
+            if repin:
+                self._unpin_locked(topic)
+            try:
+                return self._trim_log_locked(topic, below_offset)
+            finally:
+                if repin:
+                    self._pin_locked(topic)
+
+    def _trim_log_locked(self, topic: str, below_offset: int) -> int:
+        path = self._log_path(topic)
+        fd = self._locked_log_fd(topic)
+        try:
+            base, brecs, hdrlen = _parse_filehdr(os.pread(fd, _FILEHDR.size, 0))
+            size = os.fstat(fd).st_size
+            committed = self._read_commit(topic)
+            if committed is None:
+                committed = self._scan_framed_prefix(topic, size)
+            committed = max(committed, base)
+            below = min(below_offset, committed)
+            if below <= base:
+                return 0
+            buf = os.pread(fd, max(committed - base, 0), hdrlen)
+            off, dropped = 0, 0
+            while len(buf) - off >= _HEADER.size:
+                ln, _b, _k = _HEADER.unpack_from(buf, off)
+                end = off + _HEADER.size + ln
+                if end > len(buf) or base + end > below:
+                    break
+                off = end
+                dropped += 1
+            if off == 0:
+                return 0
+            boundary = base + off
+            tmp = f"{path}.trim.{os.getpid()}"
+            tfd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+            try:
+                os.write(tfd, _FILEHDR.pack(_MAGIC, 1, boundary,
+                                            brecs + dropped))
+                os.write(tfd, buf[off:])  # committed suffix; torn tail drops
+                # always durable: a machine crash after the rename must not
+                # surface an empty retained tail under the committed name
+                os.fsync(tfd)
+            finally:
+                os.close(tfd)
+            os.replace(tmp, path)
+            try:
+                dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:  # pragma: no cover — platform-dependent
+                pass
+            with self._lock:
+                if topic in self._trim_track and self._rec_meta.get(topic):
+                    meta = self._rec_meta[topic]
+                    keep = 0
+                    counts = self._rec_meta_pcounts[topic]
+                    while keep < len(meta) and meta[keep][1] <= boundary:
+                        p = meta[keep][0]
+                        for q in (range(self.partitions) if p < 0 else (p,)):
+                            counts[q] += 1
+                        keep += 1
+                    del meta[:keep]
+            return off
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # -- checkpointed-consumer durable trim -----------------------------------
+    def enable_trim_tracking(self, topic: str) -> None:
+        """Start recording per-record (partition, end-byte) metadata for a
+        topic so :meth:`trim_applied` can map a consumer's per-partition
+        applied offsets back to a safe head-trim boundary. Memory is
+        bounded by the trim cadence (metadata drops with each trim)."""
+        self.create_topic(topic)
+        with self._lock:
+            if topic in self._trim_track:
+                return
+            # only records parsed AFTER enabling are trackable: snapshot
+            # the per-partition counts consumed so far as the floor
+            self._trim_track.add(topic)
+            self._rec_meta[topic] = []
+            self._rec_meta_pcounts[topic] = [
+                self._pbase[topic][p] + len(self._plogs[topic][p])
+                for p in range(self.partitions)
+            ]
+
+    def trim_applied(self, topic: str, applied: list[int]) -> int:
+        """Durably trim the log head below every record all of whose
+        partitions' consumers have applied it: ``applied[p]`` is partition
+        ``p``'s applied message offset (this process's view — the same
+        offsets :class:`~geomesa_tpu.stream.consumer.ThreadedConsumer`
+        keeps). Walks tracked records in total order, stops at the first
+        unapplied one, and hands the boundary to :meth:`trim_log`.
+        Returns the bytes trimmed (0 when tracking is off or nothing new
+        is coverable)."""
+        with self._lock:
+            meta = self._rec_meta.get(topic)
+            if not meta:
+                return 0
+            counts = list(self._rec_meta_pcounts[topic])
+            boundary = None
+            for part, end in meta:
+                parts = range(self.partitions) if part < 0 else (part,)
+                if any(applied[p] <= counts[p] for p in parts):
+                    break
+                for p in parts:
+                    counts[p] += 1
+                boundary = end
+        if boundary is None:
+            return 0
+        return self.trim_log(topic, boundary)
+
+    def iter_records(self, topic: str):
+        """Yield ``(start_logical, end_logical, payload)`` for every
+        committed, retained record — the WAL's replay/trim framing surface
+        and the ``geomesa-tpu wal`` inspection path. Reads one committed
+        snapshot; records appended after the call starts are not seen."""
+        committed = self._read_commit(topic)
+        try:
+            with open(self._log_path(topic), "rb") as f:
+                buf = f.read()
+        except OSError:
+            return
+        base, _brecs, hdrlen = _parse_filehdr(buf)
+        if committed is None:
+            committed = self._scan_framed_prefix(topic)
+        limit = hdrlen + max(min(committed, base + len(buf) - hdrlen) - base, 0)
+        off = hdrlen
+        while limit - off >= _HEADER.size:
+            ln, _b, _k = _HEADER.unpack_from(buf, off)
+            end = off + _HEADER.size + ln
+            if end > limit:
+                break
+            yield (base + off - hdrlen, base + end - hdrlen,
+                   buf[off + _HEADER.size : end])
+            off = end
+
+    def topics(self) -> list[str]:
+        """Topics present ON DISK under this bus root (unescaped names) —
+        the recovery path's topic discovery; in-memory-only topics that
+        never published are not listed."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for fn in sorted(names):
+            if fn.endswith(".log"):
+                out.append(_unsafe_name(fn[: -len(".log")]))
+        return out
+
+    def head_offset(self, topic: str) -> int:
+        """Logical byte offset of the first retained record (the durably
+        trimmed head; 0 for never-trimmed logs)."""
+        return self._log_head(topic)[0]
+
+    def committed_offset(self, topic: str) -> int:
+        """The committed logical byte offset (sidecar value, or the
+        framed-prefix recovery value when the sidecar is lost)."""
+        committed = self._read_commit(topic)
+        if committed is None:
+            committed = self._scan_framed_prefix(topic)
+        return max(committed, self._log_head(topic)[0])
 
     # -- push subscribers (tailer thread dispatches in total order) ----------
     def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> None:
@@ -445,28 +883,39 @@ class JournalBus:
                 return False
 
     def _disk_payloads(self, topic: str, first_n: int) -> list[bytes]:
-        """First ``first_n`` payloads re-read from the committed journal
-        prefix (late-subscriber replay after the in-memory log trimmed)."""
+        """First ``first_n`` payloads OF THIS PROCESS'S VIEW re-read from
+        the committed journal prefix (late-subscriber replay after the
+        in-memory log trimmed). Raises :class:`TrimmedError` if a durable
+        head-trim since this process attached removed records the view
+        still addresses."""
         committed = self._read_commit(topic)
         try:
-            size = os.path.getsize(self._log_path(topic))
-        except OSError:
-            return []
-        if committed is None:
-            committed = self._scan_framed_prefix(topic, size)
-        try:
             with open(self._log_path(topic), "rb") as f:
-                buf = f.read(min(committed, size))
+                buf = f.read()
         except OSError:
             return []
+        base, brecs, hdrlen = _parse_filehdr(buf)
+        with self._lock:
+            rec_base = self._rec_base.get(topic, 0)
+        if brecs > rec_base:
+            raise TrimmedError(
+                f"journal {topic!r}: records below index {brecs} were "
+                f"durably trimmed; replay from index {rec_base} is gone")
+        if committed is None:
+            committed = self._scan_framed_prefix(topic)
+        limit = hdrlen + max(min(committed, base + len(buf) - hdrlen) - base, 0)
         out: list[bytes] = []
-        off = 0
-        while len(out) < first_n and len(buf) - off >= _HEADER.size:
+        skip = rec_base - brecs
+        off = hdrlen
+        while len(out) < first_n and limit - off >= _HEADER.size:
             ln, _b, _k = _HEADER.unpack_from(buf, off)
             end = off + _HEADER.size + ln
-            if end > len(buf):
+            if end > limit:
                 break
-            out.append(buf[off + _HEADER.size : end])
+            if skip > 0:
+                skip -= 1
+            else:
+                out.append(buf[off + _HEADER.size : end])
             off = end
         return out
 
@@ -491,14 +940,23 @@ class JournalBus:
             size = os.path.getsize(self._log_path(topic))
         except OSError:
             return [], cursor
+        base, _brecs, hdrlen = self._log_head(topic)
         if committed is None:
             committed = self._scan_framed_prefix(topic, size)
-        committed = min(committed, size)
+        committed = max(min(committed, base + size - hdrlen), base)
+        if cursor == 0:
+            # 0 = "from the start of RETAINED history": a fresh tail of a
+            # head-trimmed topic begins at the first surviving record
+            cursor = base
+        elif cursor < base:
+            raise TrimmedError(
+                f"journal {topic!r}: cursor {cursor} is below the durably "
+                f"trimmed head {base}")
         if cursor >= committed:
             return [], cursor
         try:
             with open(self._log_path(topic), "rb") as f:
-                f.seek(cursor)
+                f.seek(hdrlen + (cursor - base))
                 buf = f.read(min(committed - cursor, max_bytes))
         except OSError:
             return [], cursor
@@ -541,7 +999,20 @@ class JournalBus:
                 with self._lock:
                     topics = list(self._subscribers)
                 for topic in topics:
-                    self._refresh(topic)
+                    try:
+                        self._refresh(topic)
+                    except TrimmedError:
+                        # another process durably trimmed above this
+                        # tailer's cursor: fast-forward to the retained
+                        # head — COUNTED, the gap is never silent
+                        errors.inc()
+                        telemetry.note_callback_error(topic)
+                        base = self._log_head(topic)[0]
+                        with self._lock:
+                            self._scan_pos[topic] = max(
+                                self._scan_pos[topic], base)
+                        if isinstance(session, _trace.Span):
+                            session.event("trimmed_gap", topic=topic)
                     with self._lock:
                         tbase = self._tbase[topic]
                         log = self._tlogs[topic]
@@ -634,6 +1105,7 @@ class JournalBus:
     def close(self) -> None:
         """Stop the tailer (idempotent; deterministic join). See
         :meth:`subscribe` for the stop/restart state transition."""
+        self.unpin_all()
         self._hubs.close_all()
         # snapshot under the lock (subscribe swaps _stop/_tailer under it);
         # join OUTSIDE it — the tailer takes the lock per topic and joining

@@ -30,7 +30,7 @@ import uuid
 
 __all__ = [
     "catalog_lock", "lease_lock", "http_lease_lock", "LeaseService",
-    "LockTimeout",
+    "LockTimeout", "reap_dead_claims",
 ]
 
 
@@ -209,6 +209,52 @@ def lease_lock(path: str, name: str = "catalog", ttl_s: float = 60.0,
     finally:
         with contextlib.suppress(OSError):
             os.unlink(mine)
+
+
+def reap_dead_claims(path: str, name: str = "catalog") -> int:
+    """Remove lease claims held by DEAD processes of THIS host (pid probe
+    via ``kill(pid, 0)``), regardless of expiry. A SIGKILLed checkpoint
+    leaves its claim behind and every later :func:`catalog_lock` waits out
+    the full TTL on it; crash recovery (``DataStore.open`` — which holds
+    the exclusive WAL catalog lock, so no live writer can be racing) calls
+    this to skip that dead time. Claims from other hosts (whose liveness
+    we cannot probe) are left to the normal expiry path. Returns the
+    claims reaped."""
+    claims = os.path.join(path, f".geomesa.{name}.claims")
+    host = socket.gethostname()
+    reaped = 0
+    try:
+        names = os.listdir(claims)
+    except OSError:
+        return 0
+    for fn in names:
+        # never touch tmp- files: a LIVE contender may be mid-write on one
+        # (claim creation / per-poll refresh) — only settled c- claims
+        if not fn.startswith("c-"):
+            continue
+        p = os.path.join(claims, fn)
+        try:
+            with open(p, "rb") as f:
+                raw = f.read().decode()
+            info = json.loads(raw)
+            holder = str(info.get("holder", ""))
+            h_host, _, h_pid = holder.rpartition(":")
+            pid = int(h_pid)
+        except (OSError, ValueError, KeyError, TypeError):
+            # unreadable/torn content is NOT evidence of death (a live
+            # refresh may be racing); leave it to the normal expiry path
+            continue
+        if h_host != host:
+            continue
+        try:
+            os.kill(pid, 0)  # raises if the holder is gone
+        except ProcessLookupError:
+            with contextlib.suppress(OSError):
+                os.unlink(p)
+                reaped += 1
+        except OSError:
+            continue
+    return reaped
 
 
 @contextlib.contextmanager

@@ -1133,6 +1133,11 @@ class GeoMesaApp:
             from geomesa_tpu.obs import audit as _obsaudit
 
             text += _obsaudit.get().prometheus_text()
+            # durability plane: geomesa_wal_* append/flush/trim counters +
+            # geomesa_recovery_* replay counters (store/wal.py)
+            from geomesa_tpu.store import wal as _walmod
+
+            text += _walmod.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -1170,6 +1175,13 @@ class GeoMesaApp:
         aud = _obsaudit.get()
         if aud.checked or _obsaudit.ENABLED:
             out["audit"] = aud.snapshot(limit=8)
+        # durability plane: WAL append/ack/trim + recovery replay counters
+        # (only once a WAL has written — plain stores skip the section)
+        from geomesa_tpu.store import wal as _walmod
+
+        wal_m = _walmod.wal_metrics()
+        if any(wal_m.values()):
+            out["wal"] = wal_m
         # serving plane: admission decisions + coalesce effectiveness
         if self.admission is not None:
             out["admission"] = self.admission.snapshot(limit=16)

@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Kill-and-recover chaos harness for the durability plane (ISSUE 14).
+
+Loops N cycles of: spawn a writer+query workload in a subprocess against a
+WAL-backed catalog, SIGKILL it — either at a NAMED crash point injected via
+``GEOMESA_TPU_FAULTS="kind=crash,match=<point>,..."`` (the worker kills
+itself inside the durability-critical window) or at a RANDOM moment (the
+driver kills it from outside) — then restart with ``DataStore.open(...,
+recover=True)`` and verify:
+
+- ZERO acked-write loss: every batch the worker acked to its append-only
+  ``ack.log`` is fully present after recovery (and acked deletes stay
+  deleted);
+- NO half-applied unacked write: a batch whose intent was logged but never
+  acked is either fully present or fully absent;
+- no duplicates (exactly-once replay);
+- referee parity (``ops/referee.py``: host-side f64 recount of a query mix
+  vs the live query path) and clean invariant sweeps
+  (``obs/audit.InvariantSweeper``, including the WAL/checkpoint check).
+
+Named crash points cycled (then random kills): wal.post_append_pre_commit,
+wal.mid_group_commit, ckpt.mid_shard_renames, ckpt.pre_manifest_replace,
+recover.mid_replay.
+
+Red leg (``--red``): ``GEOMESA_TPU_WAL_UNSAFE=1`` makes the WAL ack BEFORE
+durability and a crash is injected inside that window — an acked-write LOSS
+by construction. The harness must DETECT it: ``--red`` exits 0 only when
+the verification fails (the detector works), non-zero when it stays silent.
+
+CI: ``scripts/bench_gate.sh`` leg 8 runs both legs. Knobs:
+GEOMESA_CRASH_CYCLES (driver loop count), GEOMESA_CRASH_ROWS (rows per
+write batch), GEOMESA_CRASH_TIMEOUT_S.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NAMED_POINTS = [
+    "wal.post_append_pre_commit",
+    "wal.mid_group_commit",
+    "ckpt.mid_shard_renames",
+    "ckpt.pre_manifest_replace",
+    "recover.mid_replay",
+]
+SPEC = "name:String,v:Integer,dtg:Date,*geom:Point:srid=4326"
+TYPE = "evt"
+T0 = 1_498_867_200_000
+QUERY_MIX = [
+    "BBOX(geom, -10, -10, 10, 10)",
+    "BBOX(geom, -45, -30, 45, 30) AND v > 40",
+    "BBOX(geom, -180, -90, 180, 90)",
+]
+
+
+def _rows(batch: int, n: int):
+    from geomesa_tpu.geometry import Point
+
+    rng = random.Random(batch)
+    return [
+        {
+            "name": f"b{batch}",
+            "v": rng.randrange(90),
+            "dtg": T0 + (batch * 1000 + j) * 1000,
+            "geom": Point(rng.uniform(-80, 80), rng.uniform(-55, 55)),
+        }
+        for j in range(n)
+    ]
+
+
+def _fids(batch: int, n: int) -> list[str]:
+    return [f"b{batch:06d}.{j}" for j in range(n)]
+
+
+def _parse_acklog(path: str):
+    """→ (acked write batches {batch: n}, acked deleted fids, intents
+    without ack, max batch id ever INTENDED). Batch ids must never be
+    reused across incarnations: an unacked-but-durable batch is ALLOWED
+    to survive recovery, and a new same-id batch would collide with its
+    fids — restarts resume above every intent, acked or not."""
+    acked: dict[int, int] = {}
+    deleted: set[str] = set()
+    open_intents: dict[str, tuple] = {}
+    max_batch = -1
+    if not os.path.exists(path):
+        return acked, deleted, [], max_batch
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            if parts[0] == "WI":  # write intent: WI <batch> <n>
+                open_intents[f"w{parts[1]}"] = ("write", int(parts[1]),
+                                                int(parts[2]))
+                max_batch = max(max_batch, int(parts[1]))
+            elif parts[0] == "WA":  # write ack
+                acked[int(parts[1])] = int(parts[2])
+                open_intents.pop(f"w{parts[1]}", None)
+            elif parts[0] == "DI":  # delete intent: DI <fid,fid,...>
+                open_intents["d" + parts[1]] = ("delete", parts[1].split(","))
+            elif parts[0] == "DA":  # delete ack
+                deleted.update(parts[1].split(","))
+                open_intents.pop("d" + parts[1], None)
+    return acked, deleted, list(open_intents.values()), max_batch
+
+
+def worker(workdir: str) -> None:
+    """The killed process: open-with-recovery, then write/delete/query on
+    several threads (concurrent writers exercise group-commit batching —
+    the wal.mid_group_commit window needs width > 1) until SIGKILLed.
+    Acks land in ack.log only AFTER the store acked; a periodic explicit
+    ``ds.save`` keeps the ckpt.* crash points hot alongside the background
+    checkpointer."""
+    import threading
+
+    from geomesa_tpu.store.datastore import DataStore
+
+    catalog = os.path.join(workdir, "catalog")
+    ds = DataStore.open(catalog, recover=True)
+    if TYPE not in ds.list_schemas():
+        ds.create_schema(TYPE, SPEC)
+    ack_path = os.path.join(workdir, "ack.log")
+    acked, deleted, _, max_batch = _parse_acklog(ack_path)
+    rows = int(os.environ.get("GEOMESA_CRASH_ROWS", "40"))
+    n_threads = int(os.environ.get("GEOMESA_CRASH_THREADS", "3"))
+    ack = open(ack_path, "a", buffering=1)
+    ack_lock = threading.Lock()
+    start = max_batch + 1
+
+    def _loop(tid: int) -> None:
+        batch = start + tid
+        rng = random.Random(batch * 7919 + 13)
+        mine: list[int] = []
+        while True:
+            n = 1 + rng.randrange(rows)
+            with ack_lock:
+                ack.write(f"WI {batch} {n}\n")
+            ds.write(TYPE, _rows(batch, n), fids=_fids(batch, n))
+            with ack_lock:
+                ack.write(f"WA {batch} {n}\n")
+            acked[batch] = n
+            mine.append(batch)
+            if len(mine) % 5 == 4 and len(mine) > 2:
+                # delete a couple of rows from one of OUR older acked
+                # batches (thread-owned: no cross-thread delete races)
+                victim = rng.choice(mine[:-1])
+                fids = [f for f in _fids(victim, acked[victim])[:2]
+                        if f not in deleted]
+                if fids:
+                    key = ",".join(fids)
+                    with ack_lock:
+                        ack.write(f"DI {key}\n")
+                    ds.delete_features(TYPE, fids)
+                    with ack_lock:
+                        ack.write(f"DA {key}\n")
+                    deleted.update(fids)
+            if len(mine) % 3 == 0:
+                ds.query(TYPE, rng.choice(QUERY_MIX))
+            if tid == 0 and len(mine) % 40 == 39:
+                ds.save(catalog)  # explicit checkpoint: ckpt.* points fire
+            batch += n_threads
+
+    threads = [threading.Thread(target=_loop, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()  # pragma: no cover — the process dies by SIGKILL
+
+
+def verify(workdir: str) -> dict:
+    """Recover and check the durability contract; returns a report dict
+    with ``ok``/``errors``."""
+    import numpy as np  # noqa: F401 — referee dependency
+
+    from geomesa_tpu.obs.audit import InvariantSweeper
+    from geomesa_tpu.ops.referee import fid_sets_equal, referee_select
+    from geomesa_tpu.planning.planner import Query
+    from geomesa_tpu.store.datastore import DataStore
+
+    catalog = os.path.join(workdir, "catalog")
+    acked, deleted, open_intents, _max_batch = _parse_acklog(
+        os.path.join(workdir, "ack.log"))
+    errors: list[str] = []
+    t0 = time.perf_counter()
+    ds = DataStore.open(catalog, recover=True, checkpointer=False)
+    recover_ms = (time.perf_counter() - t0) * 1000.0
+    try:
+        live: dict[str, int] = {}
+        if TYPE in ds.list_schemas():
+            st = ds._state(TYPE)
+            with st.lock:
+                tiers = [st.table, *st.delta.tables]
+            for t in tiers:
+                if t is not None and len(t):
+                    for f in t.fids:
+                        live[str(f)] = live.get(str(f), 0) + 1
+        dups = [f for f, c in live.items() if c > 1]
+        if dups:
+            errors.append(f"duplicate fids after recovery: {dups[:5]}")
+        expected = {
+            f for b, n in acked.items() for f in _fids(b, n)
+        } - deleted
+        lost = sorted(expected - set(live))
+        if lost:
+            errors.append(
+                f"ACKED-WRITE LOSS: {len(lost)} fids missing, e.g. {lost[:5]}")
+        resurrected = sorted(deleted & set(live))
+        if resurrected:
+            errors.append(f"acked delete undone: {resurrected[:5]}")
+        # anything beyond expected must be a whole unacked intent batch
+        # (all-or-nothing), never a partial one
+        extra = set(live) - expected
+        allowed: set[str] = set()
+        for intent in open_intents:
+            if intent[0] == "write":
+                _k, b, n = intent
+                bfids = set(_fids(b, n))
+                present = bfids & set(live)
+                if present and present != bfids:
+                    errors.append(
+                        f"HALF-APPLIED unacked write batch {b}: "
+                        f"{len(present)}/{len(bfids)} rows present")
+                allowed |= bfids
+            else:  # unacked delete: rows may be present or absent — but
+                # absence must cover the WHOLE target set
+                _k, fids = intent
+                gone = set(fids) - set(live)
+                if gone and gone != set(fids) - deleted:
+                    errors.append(f"HALF-APPLIED unacked delete {fids}")
+        stray = extra - allowed
+        if stray:
+            errors.append(f"unexplained rows after recovery: "
+                          f"{sorted(stray)[:5]}")
+        # referee parity on the query mix (ISSUE-13 referee)
+        if TYPE in ds.list_schemas():
+            st = ds._state(TYPE)
+            main, _idx, _bs, _stats, delta = st.snapshot()
+            for cql in QUERY_MIX:
+                q = Query(filter=cql)
+                live_fids = sorted(
+                    str(f) for f in ds.query(TYPE, cql).table.fids)
+                ref = referee_select(st.sft, main, delta, q)
+                same, why = fid_sets_equal(live_fids, ref)
+                if not same:
+                    errors.append(f"referee parity broke on {cql!r}: {why}")
+        sweeper = InvariantSweeper()
+        sweeper.attach_store(ds)
+        for check in sweeper.sweep_once():
+            if check["violations"]:
+                errors.append(
+                    f"invariant sweep {check['check']}: "
+                    f"{check['violations'][:3]}")
+    finally:
+        ds.close()
+    return {
+        "ok": not errors,
+        "errors": errors,
+        "acked_batches": len(acked),
+        "acked_rows": int(sum(acked.values())),
+        "recover_ms": round(recover_ms, 2),
+    }
+
+
+def drive(workdir: str, cycles: int, red: bool, points: list[str],
+          rows: int, timeout_s: float) -> int:
+    os.makedirs(workdir, exist_ok=True)
+    base_env = dict(os.environ)
+    base_env["GEOMESA_CRASH_ROWS"] = str(rows)
+    # frequent background checkpoints so ckpt.* crash points actually fire
+    base_env.setdefault("GEOMESA_TPU_WAL_CKPT_BYTES", "20000")
+    rng = random.Random(int(base_env.get("GEOMESA_CRASH_SEED", "1234")))
+    results = []
+    for cycle in range(cycles):
+        env = dict(base_env)
+        if red:
+            point = "wal.unsafe_ack_window"
+            env["GEOMESA_TPU_WAL_UNSAFE"] = "1"
+            env["GEOMESA_TPU_FAULTS"] = (
+                f"kind=crash,match={point},after={4 + rng.randrange(20)}")
+        elif points:
+            point = points[cycle % len(points)]
+            env["GEOMESA_TPU_FAULTS"] = (
+                f"kind=crash,match={point},after={rng.randrange(6)}")
+        elif cycle < len(NAMED_POINTS) or rng.random() < 0.6:
+            point = NAMED_POINTS[cycle % len(NAMED_POINTS)]
+            env["GEOMESA_TPU_FAULTS"] = (
+                f"kind=crash,match={point},after={rng.randrange(6)}")
+        else:
+            point = "random"
+            env.pop("GEOMESA_TPU_FAULTS", None)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--dir", workdir],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        kill_mode = "self"
+        deadline = time.monotonic() + timeout_s
+        random_kill_at = time.monotonic() + rng.uniform(2.0, 5.0)
+        while proc.poll() is None:
+            now = time.monotonic()
+            if point == "random" and now >= random_kill_at:
+                proc.send_signal(signal.SIGKILL)
+                kill_mode = "driver"
+                break
+            if now >= deadline:
+                # crash point never fired this cycle (e.g. recover.* with
+                # an empty tail): kill from outside — still a valid cycle
+                proc.send_signal(signal.SIGKILL)
+                kill_mode = "timeout"
+                break
+            time.sleep(0.02)
+        stderr = b""
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            proc.kill()
+            proc.communicate()
+        if proc.returncode not in (-signal.SIGKILL,):
+            # the worker must die by SIGKILL, never exit cleanly or crash
+            # with a python error (that would be a bug, not a chaos kill)
+            print(f"[crash-smoke] cycle {cycle} ({point}): worker exited "
+                  f"rc={proc.returncode}, not SIGKILL", file=sys.stderr)
+            sys.stderr.write(stderr.decode("utf-8", "replace")[-2000:] + "\n")
+            return 1
+        report = verify(workdir)
+        report.update({"cycle": cycle, "point": point, "kill": kill_mode})
+        results.append(report)
+        status = "OK" if report["ok"] else "LOSS/VIOLATION"
+        print(f"[crash-smoke] cycle {cycle:>3} point={point:<28} "
+              f"kill={kill_mode:<7} acked_rows={report['acked_rows']:<6} "
+              f"recover_ms={report['recover_ms']:<8} {status}")
+        if not report["ok"]:
+            for e in report["errors"]:
+                print(f"[crash-smoke]   {e}")
+            if red:
+                print("[crash-smoke] RED leg: injected acked-write loss "
+                      "was DETECTED (the harness works)")
+                return 0
+            return 1
+    if red:
+        print("[crash-smoke] RED leg FAILED: unsafe acks + injected crash "
+              "produced no detected loss — the harness is silent",
+              file=sys.stderr)
+        return 1
+    total = sum(r["acked_rows"] for r in results[-1:])
+    print(f"[crash-smoke] {cycles} kill/recover cycles, zero acked-write "
+          f"loss ({total} rows surviving)")
+    return 0
+
+
+def main() -> int:
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--verify-only", action="store_true",
+                   help="run only the recovery verification on --dir")
+    p.add_argument("--dir", default=None,
+                   help="work directory (default: a fresh temp dir)")
+    p.add_argument("--cycles", type=int,
+                   default=int(os.environ.get("GEOMESA_CRASH_CYCLES", "25")))
+    p.add_argument("--point", action="append", default=None,
+                   help="restrict to specific named crash point(s)")
+    p.add_argument("--rows", type=int,
+                   default=int(os.environ.get("GEOMESA_CRASH_ROWS", "40")))
+    p.add_argument("--timeout", type=float, default=float(
+        os.environ.get("GEOMESA_CRASH_TIMEOUT_S", "25")))
+    p.add_argument("--red", action="store_true",
+                   help="loss-detector self-test: unsafe acks + injected "
+                   "crash MUST be detected (exit 0 = detected)")
+    args = p.parse_args()
+    if args.worker:
+        worker(args.dir)
+        return 0  # pragma: no cover — the worker dies by SIGKILL
+    workdir = args.dir or tempfile.mkdtemp(prefix="geomesa-crash-")
+    if args.verify_only:
+        report = verify(workdir)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    return drive(workdir, args.cycles, args.red, args.point or [],
+                 args.rows, args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 # docs/observability.md § Continuous correctness auditing.
 JAX_PLATFORMS=cpu python -m pytest tests/test_audit.py -q
 
+# durability plane (ISSUE 14): WAL journaling of acked writes + group
+# commit, checkpoint stamps / exactly-once replay / head trims, the
+# kill-at-every-named-crash-point matrix (real SIGKILL subprocesses),
+# double-open lock, fsync-before-rename red/green, overhead bounds.
+# See docs/operations.md § Durability & recovery.
+JAX_PLATFORMS=cpu python -m pytest tests/test_durability.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
@@ -112,7 +119,7 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
     tests/test_geoblocks.py tests/test_bufferpool.py \
     tests/test_stream_matrix.py tests/test_usage_workload.py \
-    tests/test_serving.py tests/test_audit.py -q
+    tests/test_serving.py tests/test_audit.py tests/test_durability.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

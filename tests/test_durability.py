@@ -1,0 +1,654 @@
+"""Durability plane (ISSUE 14): WAL journaling of acked writes,
+crash-consistent incremental checkpoints, exactly-once recovery replay,
+durable journal head-trims, the kill-at-every-named-crash-point matrix,
+and the double-open lock contract. docs/operations.md § Durability &
+recovery."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import Point
+from geomesa_tpu.store.datastore import DataStore
+from geomesa_tpu.store.wal import (
+    SCHEMA_TOPIC,
+    WalLockedError,
+    WalTailError,
+    WriteAheadLog,
+    topic_for,
+    wal_metrics,
+)
+from geomesa_tpu.stream.journal import JournalBus, TrimmedError
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326"
+T0 = 1_498_867_200_000
+BBOX_ALL = "BBOX(geom, -180, -90, 180, 90)"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def recs(n, base=0):
+    return [
+        {"name": f"n{i % 5}", "age": i % 90, "dtg": T0 + i * 1000,
+         "geom": Point(float(i % 90 - 45), float(i % 60 - 30))}
+        for i in range(base, base + n)
+    ]
+
+
+def fids(n, tag):
+    return [f"{tag}.{i}" for i in range(n)]
+
+
+def open_store(cat, **kw):
+    kw.setdefault("recover", True)
+    kw.setdefault("checkpointer", False)
+    return DataStore.open(str(cat), **kw)
+
+
+def count(ds, t="evt"):
+    return ds.query(t, BBOX_ALL).count
+
+
+# -- WAL core -----------------------------------------------------------------
+class TestWalRecovery:
+    def test_acked_writes_survive_simulated_kill(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(40), fids=fids(40, "a"))
+        ds.delete_features("evt", ["a.1", "a.2"])
+        ds._wal.abandon()  # the in-process SIGKILL stand-in
+        ds2 = open_store(cat)
+        assert count(ds2) == 38
+        live = {str(f) for f in ds2.query("evt", BBOX_ALL).table.fids}
+        assert "a.1" not in live and "a.3" in live
+        ds2.close()
+
+    def test_checkpoint_stamps_replay_floor_exactly_once(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(10), fids=fids(10, "a"))
+        ds.save(str(cat))
+        man = json.loads((cat / "manifest.json").read_text())
+        assert man["wal"]["topics"][topic_for("evt")] > 0
+        assert SCHEMA_TOPIC in man["wal"]["topics"]
+        # records below the stamp must NOT re-apply over the checkpoint
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        assert count(ds2) == 10  # not 20
+        ds2.close()
+
+    def test_tail_past_checkpoint_replays(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(10), fids=fids(10, "a"))
+        ds.save(str(cat))
+        ds.write("evt", recs(7, 100), fids=fids(7, "b"))
+        ds.clear("evt")
+        ds.write("evt", recs(3, 200), fids=fids(3, "c"))
+        ds.age_off("evt")  # no TTL → no-op, must not journal garbage
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        assert count(ds2) == 3
+        assert {str(f) for f in ds2.query("evt", BBOX_ALL).table.fids} == {
+            "c.0", "c.1", "c.2"}
+        ds2.close()
+
+    def test_recover_false_refuses_tail(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(3))
+        ds._wal.abandon()
+        with pytest.raises(WalTailError):
+            open_store(cat, recover=False)
+        ds2 = open_store(cat)  # and recover=True still works after
+        assert count(ds2) == 3
+        ds2.close()
+
+    def test_schema_ops_interleave_in_order(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(4), fids=fids(4, "a"))
+        ds.update_schema("evt", add="sev:Integer")
+        ds.write("evt", [{"name": "x", "age": 1, "dtg": T0, "sev": 7,
+                          "geom": Point(0, 0)}], fids=["s.0"])
+        ds.update_schema("evt", rename_to="evt2")
+        ds.write("evt2", recs(2, 50), fids=fids(2, "p"))
+        ds.delete_schema("evt2")
+        ds.create_schema("evt2", SPEC)
+        ds.write("evt2", recs(1, 90), fids=fids(1, "q"))
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        # the delete+recreate means only the post-recreate row survives
+        assert ds2.list_schemas() == ["evt2"]
+        assert count(ds2, "evt2") == 1
+        attrs = {a.name for a in ds2.get_schema("evt2").attributes}
+        assert "sev" not in attrs  # the recreated schema, not the evolved one
+        ds2.close()
+
+    def test_update_features_replays(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(5), fids=fids(5, "a"))
+        ds.update_features(
+            "evt", [{"name": "upd", "age": 99, "dtg": T0,
+                     "geom": Point(1, 1)}], ["a.2"])
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        assert count(ds2) == 5
+        res = ds2.query("evt", BBOX_ALL)
+        row = [res.table.record(i) for i, f in enumerate(res.table.fids)
+               if str(f) == "a.2"]
+        assert row and row[0]["name"] == "upd"
+        ds2.close()
+
+    def test_double_open_fails_fast_then_succeeds(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        with pytest.raises(WalLockedError):
+            open_store(cat)
+        ds.close()
+        ds2 = open_store(cat)
+        assert ds2.list_schemas() == ["evt"]
+        ds2.close()
+
+    def test_double_open_from_second_process(self, tmp_path):
+        """The satellite pin: a SECOND PROCESS opening a WAL catalog fails
+        fast with the typed error, then succeeds after release."""
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        code = (
+            "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+            "from geomesa_tpu.store.datastore import DataStore\n"
+            "from geomesa_tpu.store.wal import WalLockedError\n"
+            "try:\n"
+            f"    DataStore.open({str(cat)!r}, recover=True, "
+            "checkpointer=False)\n"
+            "    print('OPENED')\n"
+            "except WalLockedError:\n"
+            "    print('LOCKED')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=120)
+        assert out.stdout.strip() == "LOCKED", (out.stdout, out.stderr[-800:])
+        ds.close()
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=120)
+        assert out.stdout.strip() == "OPENED", (out.stdout, out.stderr[-800:])
+
+    def test_wal_trimmed_after_checkpoint(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        for b in range(5):
+            ds.write("evt", recs(20, b * 100), fids=fids(20, f"b{b}"))
+        topic = topic_for("evt")
+        before = ds._wal.bus.committed_offset(topic)
+        assert ds._wal.bus.head_offset(topic) == 0
+        ds.save(str(cat))
+        # committed segments below the manifest stamp left the disk
+        assert ds._wal.bus.head_offset(topic) == before
+        assert ds._wal.bytes_since_checkpoint == 0
+        # and the catalog still recovers losslessly afterwards
+        ds.write("evt", recs(5, 900), fids=fids(5, "z"))
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        assert count(ds2) == 105
+        ds2.close()
+
+    def test_incremental_checkpoint_reuses_unchanged_types(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("hot", SPEC)
+        ds.create_schema("cold", SPEC)
+        ds.write("hot", recs(10), fids=fids(10, "h"))
+        ds.write("cold", recs(10), fids=fids(10, "c"))
+        ds.save(str(cat))
+        man1 = json.loads((cat / "manifest.json").read_text())
+        ds.write("hot", recs(5, 50), fids=fids(5, "h2"))
+        skipped0 = wal_metrics()["checkpoint_skipped_types"]
+        ds.save(str(cat))
+        man2 = json.loads((cat / "manifest.json").read_text())
+        # cold reused (same shard files), hot restaged (new generation)
+        assert ([f["file"] for f in man2["types"]["cold"]["files"]] ==
+                [f["file"] for f in man1["types"]["cold"]["files"]])
+        assert ([f["file"] for f in man2["types"]["hot"]["files"]] !=
+                [f["file"] for f in man1["types"]["hot"]["files"]])
+        assert wal_metrics()["checkpoint_skipped_types"] == skipped0 + 1
+        # a delete+recreate of the same name must NOT reuse (ident guard)
+        ds.delete_schema("cold")
+        ds.create_schema("cold", SPEC)
+        ds.save(str(cat))
+        man3 = json.loads((cat / "manifest.json").read_text())
+        assert man3["types"]["cold"]["files"] == []
+        ds.close()
+        ds2 = open_store(cat)
+        assert count(ds2, "cold") == 0 and count(ds2, "hot") == 15
+        ds2.close()
+
+    def test_background_checkpointer_triggers_and_stops(self, tmp_path):
+        cat = tmp_path / "cat"
+        ds = DataStore.open(str(cat), recover=True, checkpointer=True,
+                            ckpt_bytes=2000)
+        ds.create_schema("evt", SPEC)
+        deadline = time.monotonic() + 30
+        b = 0
+        while not (cat / "manifest.json").exists():
+            ds.write("evt", recs(20, b * 100), fids=fids(20, f"b{b}"))
+            b += 1
+            if time.monotonic() > deadline:
+                pytest.fail("background checkpointer never triggered")
+            time.sleep(0.05)
+        ds.close()  # deterministic: joins the checkpointer thread
+        assert not ds._wal_ckpt
+        man = json.loads((cat / "manifest.json").read_text())
+        assert "wal" in man
+
+    def test_wal_off_write_path_overhead_under_2pct(self, tmp_path):
+        """The non-durable write path pays ONE gate branch per write
+        (docs/operations.md pins it < 2% of the cheapest write)."""
+        ds = DataStore(backend="tpu")
+        ds.create_schema("evt", SPEC)
+        data = recs(256)
+        walls = []
+        for b in range(30):
+            t = time.perf_counter()
+            ds.write("evt", data, fids=fids(256, f"b{b}"))
+            walls.append(time.perf_counter() - t)
+        write_s = float(np.percentile(walls, 50))
+        t = time.perf_counter()
+        for _ in range(20000):
+            ds._wal_active()
+        gate_s = (time.perf_counter() - t) / 20000
+        assert gate_s / write_s < 0.02, (gate_s, write_s)
+
+    def test_group_commit_p99_within_3x_wal_off(self, tmp_path):
+        """Acceptance pin: group-commit batching (fsync off — the
+        kill-and-recover durability mode the crash harness proves) keeps
+        acked-write p99 within 3x the WAL-off baseline. The two paths are
+        measured INTERLEAVED (off then wal per iteration) so an ambient
+        load spike lands in both distributions — the pin bounds the
+        product, not CI scheduler noise — and re-measures up to three
+        times."""
+        def _timed_write(ds, st, data, tag):
+            e0 = st.epoch
+            t = time.perf_counter()
+            ds.write("evt", data, fids=fids(512, tag))
+            wall = time.perf_counter() - t
+            # synchronous compactions (identical on both paths) excluded
+            return None if st.epoch != e0 else wall
+
+        for attempt in range(3):
+            ds_off = DataStore(backend="tpu")
+            ds_off.create_schema("evt", SPEC)
+            os.environ["GEOMESA_TPU_WAL_FSYNC"] = "off"
+            try:
+                wdir = tmp_path / f"wal{attempt}"
+                ds_wal = DataStore(backend="tpu", wal_dir=str(wdir))
+            finally:
+                del os.environ["GEOMESA_TPU_WAL_FSYNC"]
+            ds_wal.create_schema("evt", SPEC)
+            st_off, st_wal = ds_off._state("evt"), ds_wal._state("evt")
+            data = recs(512)
+            for w in range(3):  # warmup: compiles, first journal I/O
+                ds_off.write("evt", data, fids=fids(512, f"w{w}"))
+                ds_wal.write("evt", data, fids=fids(512, f"w{w}"))
+            off, wal = [], []
+            for b in range(80):
+                o = _timed_write(ds_off, st_off, data, f"b{b}")
+                w = _timed_write(ds_wal, st_wal, data, f"b{b}")
+                if o is not None:
+                    off.append(o)
+                if w is not None:
+                    wal.append(w)
+            ds_wal._wal.close()
+            p99_off = float(np.percentile(off, 99))
+            p99_wal = float(np.percentile(wal, 99))
+            if p99_wal <= 3.0 * p99_off:
+                return
+        pytest.fail(f"group-commit p99 {p99_wal * 1e3:.3f}ms > 3x WAL-off "
+                    f"{p99_off * 1e3:.3f}ms")
+
+    def test_group_commit_batches_concurrent_writers(self, tmp_path):
+        import threading
+
+        os.environ["GEOMESA_TPU_WAL_FLUSH_MS"] = "4"
+        try:
+            ds = DataStore(backend="tpu", wal_dir=str(tmp_path / "wal"))
+        finally:
+            del os.environ["GEOMESA_TPU_WAL_FLUSH_MS"]
+        ds.create_schema("evt", SPEC)
+        m0 = wal_metrics()
+        n_threads, per = 6, 8
+
+        def w(t):
+            for b in range(per):
+                ds.write("evt", recs(4, t * 1000 + b * 10),
+                         fids=fids(4, f"t{t}.{b}"))
+
+        threads = [__import__("threading").Thread(target=w, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        m1 = wal_metrics()
+        records = m1["records"] - m0["records"]
+        flushes = m1["flushes"] - m0["flushes"]
+        assert records == n_threads * per
+        assert flushes < records  # batching happened
+        assert m1["group_max"] >= 2
+        assert count(ds) == n_threads * per * 4
+        ds._wal.close()
+
+    def test_transient_flush_failure_never_loses_records(self, tmp_path):
+        """A failed group-commit flush (ENOSPC-style) raises to the caller
+        (no ack) but must NOT lose the journaled-but-unflushed record:
+        un-committed records re-enqueue and ride the next flush — so a
+        schema create whose flush failed still recovers, and every later
+        acked write to that type survives (review finding: the create
+        record vanishing made recovery silently skip the type's writes)."""
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        real = ds._wal.bus.publish_many
+        boom = {"n": 1}
+
+        def flaky(*a, **kw):
+            if boom["n"]:
+                boom["n"] -= 1
+                raise OSError(28, "No space left on device")
+            return real(*a, **kw)
+
+        ds._wal.bus.publish_many = flaky
+        with pytest.raises(OSError):
+            ds.create_schema("evt", SPEC)
+        assert "evt" in ds.list_schemas()  # applied; ack failed
+        ds._wal.bus.publish_many = real
+        ds.write("evt", recs(5), fids=fids(5, "a"))  # flush carries both
+        ds._wal.abandon()
+        ds2 = open_store(cat)
+        assert count(ds2) == 5
+        ds2.close()
+
+    def test_unrecovered_attach_cannot_shadow_or_trim_tail(self, tmp_path):
+        """Attaching a plain store (the ambient-GEOMESA_TPU_WAL shape) to
+        a journal that still holds acked records must refuse to mutate or
+        checkpoint: a save would trim — destroy — history that was never
+        replayed (review finding). DataStore.open remains the recovery
+        door."""
+        from geomesa_tpu.store import persistence
+
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(4), fids=fids(4, "a"))
+        wal_dir = ds._wal.path
+        ds._wal.abandon()  # crash with an unreplayed tail
+        plain = DataStore(backend="tpu", wal_dir=wal_dir)
+        with pytest.raises(WalTailError):
+            plain.create_schema("evt2", SPEC)
+        with pytest.raises(WalTailError):
+            persistence.save(plain, str(cat))
+        plain._wal.close()
+        # recovery still works and loses nothing
+        ds2 = open_store(cat)
+        assert count(ds2) == 4
+        ds2.close()
+
+    def test_save_type_refuses_wal_store(self, tmp_path):
+        """save_type would rewrite shards without moving the WAL replay
+        floors — the next recovery would duplicate rows — so WAL-mode
+        stores must use the stamped whole-store save."""
+        from geomesa_tpu.store import persistence
+
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(3))
+        with pytest.raises(ValueError, match="WAL"):
+            persistence.save_type(ds, str(cat), "evt")
+        ds.close()
+
+    def test_sweeper_wal_invariant(self, tmp_path):
+        from geomesa_tpu.obs.audit import InvariantSweeper
+
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(10), fids=fids(10, "a"))
+        ds.save(str(cat))
+        sweeper = InvariantSweeper()
+        sweeper.attach_store(ds)
+        wal_checks = [r for r in sweeper.sweep_once() if r["check"] == "wal"]
+        assert wal_checks and wal_checks[0]["checked"] > 0
+        assert wal_checks[0]["violations"] == []
+        # red: an applied seq the journal never issued must be flagged
+        st = ds._state("evt")
+        with st.lock:
+            st.wal_seq = ds._wal.seq_highwater() + 50
+        bad = [r for r in sweeper.sweep_once() if r["check"] == "wal"]
+        assert bad[0]["violations"]
+        ds.close()
+
+    def test_wal_prometheus_exposition(self, tmp_path):
+        from geomesa_tpu.store import wal as walmod
+
+        ds = open_store(tmp_path / "cat")
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(3))
+        text = walmod.prometheus_text()
+        for series in ("geomesa_wal_records_total",
+                       "geomesa_wal_flushes_total",
+                       "geomesa_recovery_replayed_records_total"):
+            assert f"# TYPE {series}" in text
+        ds.close()
+
+    def test_cli_wal_inspection(self, tmp_path, capsys):
+        from geomesa_tpu.cli.__main__ import main as cli_main
+
+        cat = tmp_path / "cat"
+        ds = open_store(cat)
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(6), fids=fids(6, "a"))
+        ds.save(str(cat))
+        ds.write("evt", recs(2, 50), fids=fids(2, "b"))
+        ds._wal.flush()
+        wal_dir = ds._wal.path
+        ds.close()
+        cli_main(["wal", "--dir", wal_dir, "--catalog", str(cat), "--json"])
+        report = json.loads(capsys.readouterr().out)
+        assert report["unreplayed_tail"] == 1  # the post-checkpoint write
+        evt = [t for t in report["topics"] if t["type"] == "evt"][0]
+        assert evt["ops"].get("write") == 1  # below-stamp records trimmed
+
+
+# -- persistence fsync satellite ----------------------------------------------
+class TestDurableCheckpointFsync:
+    def _save_with_volatile_fs(self, tmp_path, monkeypatch, durable):
+        """Emulate the machine-crash page-cache loss the satellite-1 bug
+        exposes: shard files whose CONTENTS were never fsynced before the
+        rename read back empty under the committed name."""
+        from geomesa_tpu.store import persistence
+
+        synced: set = set()
+        real = persistence._fsync_file
+        monkeypatch.setattr(persistence, "_fsync_file",
+                            lambda p: (synced.add(str(p)), real(p)))
+        ds = DataStore(backend="tpu")
+        ds.create_schema("evt", SPEC)
+        ds.write("evt", recs(20), fids=fids(20, "a"))
+        cat = tmp_path / "cat"
+        persistence.save(ds, str(cat), durable=durable)
+        lost = 0
+        for tdir in cat.iterdir():
+            if not tdir.is_dir():
+                continue
+            for shard in tdir.glob("part-*"):
+                if str(shard) + ".tmp" not in synced:
+                    shard.write_bytes(b"")  # the page cache never landed
+                    lost += 1
+        return cat, lost
+
+    def test_red_without_durable_a_crash_tears_the_shard(
+            self, tmp_path, monkeypatch):
+        cat, lost = self._save_with_volatile_fs(tmp_path, monkeypatch,
+                                                durable=False)
+        assert lost > 0
+        with pytest.raises(Exception):
+            ds = DataStore.load(str(cat))
+            assert count(ds) == 20  # unreachable unless silently wrong
+
+    def test_green_durable_fsyncs_contents_before_rename(
+            self, tmp_path, monkeypatch):
+        cat, lost = self._save_with_volatile_fs(tmp_path, monkeypatch,
+                                                durable=True)
+        assert lost == 0
+        ds = DataStore.load(str(cat))
+        assert count(ds) == 20
+
+
+# -- journal head-trim satellite ----------------------------------------------
+class TestJournalTrim:
+    def test_trim_keeps_logical_offsets_and_types_errors(self, tmp_path):
+        bus = JournalBus(str(tmp_path), partitions=2)
+        bus.publish_many("t", [(f"k{i}", b"m%03d" % i) for i in range(12)])
+        rec = list(bus.iter_records("t"))
+        below = rec[5][0]
+        assert bus.trim("t", below) > 0  # the 2-arg durable form
+        assert bus.head_offset("t") == below
+        # logical cursors survive: resuming from a pre-trim cursor ABOVE
+        # the head still frames correctly
+        out, cur = bus.total_poll_bytes("t", rec[7][0])
+        assert out[0] == b"m007"
+        # cursor 0 = start of retained; below-head cursors are typed errors
+        out, _ = bus.total_poll_bytes("t", 0)
+        assert out[0] == b"m005"
+        with pytest.raises(TrimmedError):
+            bus.total_poll_bytes("t", max(below - 1, 1))
+        # a second trim below the head is a no-op
+        assert bus.trim("t", below) == 0
+        # appends continue beyond the trim
+        bus.publish_many("t", [("x", b"after")])
+        assert list(bus.iter_records("t"))[-1][2] == b"after"
+        bus.close()
+
+    def test_trim_memory_form_still_works(self, tmp_path):
+        bus = JournalBus(str(tmp_path), partitions=2)
+        for i in range(6):
+            bus.publish("t", f"k{i}", b"x%d" % i)
+        end0 = bus.end_offset("t", 0)
+        assert bus.trim("t", 0, end0) >= 0  # 3-arg in-memory release
+        assert bus.end_offset("t", 0) == end0  # offsets unaffected
+        bus.close()
+
+    def test_fresh_reader_attaches_at_head(self, tmp_path):
+        bus = JournalBus(str(tmp_path), partitions=1)
+        bus.publish_many("t", [(f"k{i}", b"r%d" % i) for i in range(8)])
+        below = list(bus.iter_records("t"))[4][0]
+        bus.trim("t", below)
+        bus.close()
+        bus2 = JournalBus(str(tmp_path), partitions=1)
+        assert bus2.end_offset("t", 0) == 4  # only retained records
+        got = []
+        bus2.subscribe("t", got.append)
+        assert got == [b"r4", b"r5", b"r6", b"r7"]
+        bus2.close()
+
+    def test_established_reader_below_trim_gets_typed_error(self, tmp_path):
+        bus = JournalBus(str(tmp_path), partitions=1)
+        bus.publish_many("t", [(f"k{i}", b"r%d" % i) for i in range(4)])
+        assert bus.end_offset("t", 0) == 4  # reader state established
+        other = JournalBus(str(tmp_path), partitions=1)
+        other.publish_many("t", [("k", b"r4")])
+        below = list(other.iter_records("t"))[4][1]  # END of record 4
+        other.trim("t", below)  # trims ABOVE the first bus's scan position
+        other.close()
+        with pytest.raises(TrimmedError):
+            bus.end_offset("t", 0)
+        bus.close()
+
+    def test_checkpointed_consumer_durable_trim(self, tmp_path):
+        from geomesa_tpu.stream.consumer import ThreadedConsumer
+
+        bus = JournalBus(str(tmp_path), partitions=2)
+        seen = []
+        consumer = ThreadedConsumer(bus, "t", lambda d, p: seen.append(d),
+                                    threads=2, durable_trim=True)
+        for i in range(30):
+            bus.publish("t", f"k{i}", b"c%02d" % i)
+        assert consumer.drain(10.0)
+        assert len(seen) == 30
+        # the fully-applied prefix leaves the disk (throttled: poke once)
+        bus.trim_applied("t", list(consumer._offsets))
+        assert bus.head_offset("t") > 0
+        committed = bus.committed_offset("t")
+        assert bus.head_offset("t") <= committed
+        consumer.close()
+        bus.close()
+        # a fresh process sees only the retained tail — bounded disk
+        bus2 = JournalBus(str(tmp_path), partitions=2)
+        retained = len(list(bus2.iter_records("t")))
+        assert retained < 30
+        bus2.close()
+
+    def test_tail_repair_with_header(self, tmp_path):
+        """Torn bytes past the commit offset are truncated on the next
+        append even after the log gained a trim header."""
+        bus = JournalBus(str(tmp_path), partitions=1)
+        bus.publish_many("t", [(f"k{i}", b"ok%d" % i) for i in range(5)])
+        bus.trim("t", list(bus.iter_records("t"))[2][0])
+        with open(bus._log_path("t"), "ab") as f:
+            f.write(b"\xde\xad\xbe\xef-torn-tail")
+        bus.publish_many("t", [("k", b"after-repair")])
+        payloads = [p for _s, _e, p in bus.iter_records("t")]
+        assert payloads == [b"ok2", b"ok3", b"ok4", b"after-repair"]
+        bus.close()
+
+
+# -- the kill matrix (real SIGKILL subprocesses) ------------------------------
+class TestCrashMatrix:
+    """One kill/recover cycle per NAMED crash point via the harness
+    driver (real SIGKILL subprocesses); every restart must recover to
+    referee parity with zero acked loss — scripts/crash_smoke.py
+    verifies all four durability contracts per cycle."""
+
+    @pytest.mark.parametrize("points", [
+        ["wal.post_append_pre_commit", "wal.mid_group_commit"],
+        ["ckpt.mid_shard_renames", "ckpt.pre_manifest_replace",
+         "recover.mid_replay"],
+    ])
+    def test_kill_matrix(self, tmp_path, points):
+        cmd = [sys.executable, os.path.join(REPO, "scripts", "crash_smoke.py"),
+               "--dir", str(tmp_path / "work"),
+               "--cycles", str(len(points)), "--rows", "20"]
+        for p in points:
+            cmd += ["--point", p]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GEOMESA_CRASH_TIMEOUT_S="45")
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=400, env=env, cwd=REPO)
+        assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-800:])
+        assert "zero acked-write loss" in out.stdout
+
+    def test_red_leg_detects_injected_loss(self, tmp_path):
+        """GEOMESA_TPU_WAL_UNSAFE acks before durability; the harness must
+        DETECT the loss (exit 0 = detector fired), never stay silent."""
+        cmd = [sys.executable, os.path.join(REPO, "scripts", "crash_smoke.py"),
+               "--dir", str(tmp_path / "work"), "--red", "--cycles", "3",
+               "--rows", "20"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GEOMESA_CRASH_TIMEOUT_S="45")
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=400, env=env, cwd=REPO)
+        assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-800:])
+        assert "DETECTED" in out.stdout
