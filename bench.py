@@ -127,6 +127,7 @@ UNITS = {
     "7": "ms/query",
     "8": "Grows/s/chip",
     "9": "ms/query",
+    "10": "ms/query",
     "chaos": "ms p99",
     "durability": "ms/write p99",
 }
@@ -1779,6 +1780,149 @@ def bench_grouped_agg():
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 10: trajectory plane — batched device corridor tube-select vs the
+# demoted host process path, plus interlink exact-pair parity (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def bench_trajectory():
+    import jax
+
+    from geomesa_tpu.obs import jaxmon
+    from geomesa_tpu.process.processes import tube_select as host_tube
+    from geomesa_tpu.schema.columnar import Column, FeatureTable, point_column
+    from geomesa_tpu.schema.sft import AttributeType, parse_spec
+    from geomesa_tpu.store.datastore import DataStore
+    from geomesa_tpu.trajectory.corridor import CorridorSpec, tube_select_many
+    from geomesa_tpu.trajectory.interlink import interlink, interlink_referee
+
+    N = _n(2_000_000 if jax.default_backend() != "cpu" else 300_000)
+    qs = min(Q, 16)
+    rng = np.random.default_rng(15)
+
+    # tracked movers: entities drift between city clusters over the span
+    n_tracks = max(N // 256, 32)
+    which = rng.integers(0, len(CITIES), n_tracks)
+    tx = CITIES[which, 0] + rng.normal(0, 3.0, n_tracks)
+    ty = CITIES[which, 1] + rng.normal(0, 2.0, n_tracks)
+    owner = rng.integers(0, n_tracks, N)
+    lon = np.clip(tx[owner] + rng.normal(0, 1.0, N), -179.9, 179.9)
+    lat = np.clip(ty[owner] + rng.normal(0, 0.8, N), -89.9, 89.9)
+    t_ms = T0 + rng.integers(0, SPAN_DAYS * 86_400_000, N)
+    track_ids = np.char.add("t", owner.astype(str)).astype(object)
+
+    sft = parse_spec("tracks", "track:String,dtg:Date,*geom:Point")
+    fids = np.arange(N).astype(str).astype(object)
+    table = FeatureTable.from_columns(
+        sft, fids,
+        {"track": Column(AttributeType.STRING, track_ids),
+         "dtg": Column(AttributeType.DATE, t_ms.astype(np.int64)),
+         "geom": point_column(lon, lat)},
+    )
+    ds = DataStore(backend="tpu")
+    ds.create_schema(sft)
+    t_build = time.perf_counter()
+    ds.write("tracks", table)
+    ds.compact("tracks")
+    build_s = time.perf_counter() - t_build
+
+    # randomized corridor grid (incl. the time-buffer leg; heading legs
+    # ride tests/test_trajectory.py — this store has no heading column)
+    specs = []
+    for _ in range(qs):
+        npts = int(rng.integers(2, 4))
+        city = CITIES[rng.integers(0, len(CITIES))]
+        xs = np.sort(city[0] + rng.uniform(-6, 6, npts))
+        ys = city[1] + rng.uniform(-4, 4, npts)
+        ts = T0 + np.sort(rng.integers(0, SPAN_DAYS * 86_400_000, npts))
+        specs.append(CorridorSpec.tube(
+            [(float(x), float(y), int(t)) for x, y, t in zip(xs, ys, ts)],
+            float(rng.uniform(0.3, 1.2)),
+            int(rng.integers(1, 48)) * 3_600_000))
+
+    _mark("trajectory: device corridor warm + parity")
+    dev_res = tube_select_many(ds, "tracks", specs, route="device")  # warm
+    census0 = jaxmon.jit_report()
+    dt = []
+    for _ in range(max(3, ITERS // 4)):
+        s = time.perf_counter()
+        dev_res = tube_select_many(ds, "tracks", specs, route="device")
+        dt.append((time.perf_counter() - s) * 1e3 / qs)
+    dev_p50 = float(np.percentile(dt, 50))
+    census1 = jaxmon.jit_report()
+    recompiles = (census1.get("recompiles", 0) - census0.get("recompiles", 0))
+
+    # the DEMOTED host referee path: one full per-query process call each
+    _mark("trajectory: demoted host referee path")
+    host_res = []
+    ht = []
+    for spec in specs:
+        track = [(x, y, t) for (x, y), t in zip(spec.pts, spec.ts)]
+        s = time.perf_counter()
+        r = host_tube(ds, "tracks", track, spec.buffer_deg,
+                      spec.time_buffer_ms)
+        ht.append((time.perf_counter() - s) * 1e3)
+        host_res.append(r)
+    host_p50 = float(np.percentile(ht, 50))
+
+    corridor_parity = all(
+        sorted(map(str, d.fids)) == sorted(map(str, h.fids))
+        for d, h in zip(dev_res, host_res))
+
+    # interlink leg: exact pair set vs the nested-loop f64 referee on the
+    # 2D and XZ3 time-lifted legs (small stores — the referee is O(L·R))
+    _mark("trajectory: interlink pair-recall parity (2D + XZ3)")
+    from geomesa_tpu.planning.planner import Query as _Q
+
+    def _pts(name, n, seed):
+        s = np.random.default_rng(seed)
+        lds = DataStore(backend="tpu")
+        lds.create_schema(parse_spec(name, "dtg:Date,*geom:Point"))
+        lds.write(name, FeatureTable.from_columns(
+            parse_spec(name, "dtg:Date,*geom:Point"),
+            np.arange(n).astype(str).astype(object),
+            {"dtg": Column(AttributeType.DATE,
+                           T0 + s.integers(0, 86_400_000, n)),
+             "geom": point_column(s.uniform(-20, 20, n),
+                                  s.uniform(-10, 10, n))}))
+        lds.compact(name)
+        return lds
+
+    lds = _pts("L", 1500, 31)
+    rds = _pts("R", 3000, 32)
+    lt = lds.query("L", _Q()).table
+    rt = rds.query("R", _Q()).table
+    s = time.perf_counter()
+    link2d = interlink(lds, "L", rds, "R", pred="dwithin", distance=0.4)
+    link_ms = (time.perf_counter() - s) * 1e3
+    link2d_parity = link2d == interlink_referee(lt, rt, "dwithin", 0.4)
+    link3d = interlink(lds, "L", rds, "R", pred="dwithin", distance=0.4,
+                       time_buffer_ms=3_600_000)
+    link3d_parity = link3d == interlink_referee(
+        lt, rt, "dwithin", 0.4, 3_600_000)
+
+    return {
+        "metric": "tube_select_corridor_p50_latency",
+        "value": round(dev_p50, 3),
+        "unit": UNITS["10"],
+        "vs_baseline": round(host_p50 / max(dev_p50, 1e-9), 2),
+        "detail": {
+            "n_points": N, "n_tracks": n_tracks, "n_corridors": qs,
+            "devices": jax.device_count(),
+            "cpu_host_path_ms": round(host_p50, 3),
+            "corridor_row_set_parity": corridor_parity,
+            "steady_recompiles": int(recompiles),
+            "zero_recompile_parity": bool(recompiles == 0),
+            "interlink_pairs_2d": len(link2d),
+            "interlink_pairs_xz3": len(link3d),
+            "interlink_2d_pair_parity": link2d_parity,
+            "interlink_xz3_pair_parity": link3d_parity,
+            "interlink_ms": round(link_ms, 2),
+            "build_seconds": round(build_s, 2),
+        },
+    }
+
+
 def bench_durability():
     """Acked-write latency across WAL durability modes (--durability).
 
@@ -2125,11 +2269,11 @@ def _chaos_serving_leg(port: int, inj, n_per: int, iters: int) -> dict:
 BENCHES = {"1": bench_z2, "2": bench_z3, "3": bench_knn_density,
            "4": bench_join, "5": bench_xz2, "6": bench_select,
            "7": bench_resident, "8": bench_stream_1b,
-           "9": bench_grouped_agg}
+           "9": bench_grouped_agg, "10": bench_trajectory}
 
 # per-config wall-clock budget (seconds) for the subprocess runner
 _TIMEOUTS = {"1": 900, "2": 1200, "3": 2400, "4": 1800, "5": 900, "6": 1800,
-             "7": 2400, "8": 2400, "9": 1200}
+             "7": 2400, "8": 2400, "9": 1200, "10": 1200}
 _HEADLINE_ORDER = ["2", "1", "5", "6", "7", "8", "3", "4"]  # headline preference
 
 

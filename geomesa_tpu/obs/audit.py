@@ -706,6 +706,35 @@ class ContinuousAuditor:
             return None  # a full disk must not fail the audit path
         return path
 
+    # -- external-plane feed (trajectory corridor/interlink shadow checks) ----
+    def note_check(self, kind: str, ok: bool, type_name: str = "",
+                   detail: str = "", abstain: bool = False) -> None:
+        """Fold one externally-executed shadow comparison into the audit
+        counters (``geomesa_audit_*{kind=...}``) — the trajectory plane's
+        corridor/interlink engines compare their device results against
+        the demoted host referees themselves (inside ``shadow()``) and
+        report the verdict here; divergences raise the same ``A_DIVERGE``
+        flight anomaly as query divergences."""
+        with self._lock:
+            self._count(self.checked, kind)
+            if abstain:
+                self._count(self.abstained, kind)
+            elif ok:
+                self._count(self.passed, kind)
+            else:
+                self._count(self.diverged, kind)
+        if not ok and not abstain:
+            from geomesa_tpu.obs import flight as _flight
+
+            report = DivergenceReport(
+                ts=self._clock(), kind=kind, type_name=type_name,
+                filter_text="", epoch=None, detail=detail)
+            with self._lock:
+                self.divergences.append(report)
+            _flight.record(
+                op=kind, type_name=type_name, source="audit",
+                plan=detail[:200], rows=0, anomalies=(_flight.A_DIVERGE,))
+
     # -- sweeper feed ---------------------------------------------------------
     def note_sweep(self, name: str, result: dict) -> None:
         kind = f"sweep:{name}"
@@ -814,6 +843,7 @@ class InvariantSweeper:
         self._views: list = []  # weakrefs to ShardedDataStoreView
         self._streams: list = []  # weakrefs to streaming stores
         self._matrices: list = []  # weakrefs to SubscriptionMatrix
+        self._tracks: list = []  # weakrefs to trajectory TrackState
         self._pyr_cursor = 0  # rotating cell-sample cursor
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -840,6 +870,9 @@ class InvariantSweeper:
 
     def attach_matrix(self, matrix) -> None:
         self._attach(self._matrices, matrix)
+
+    def attach_track_state(self, state) -> None:
+        self._attach(self._tracks, state)
 
     def start(self) -> None:
         with self._lock:
@@ -887,6 +920,8 @@ class InvariantSweeper:
                 out.append(self.check_matrix_sentinels(m))
             for s in self._targets(self._streams):
                 out.append(self.check_standing_counts(s))
+            for ts in self._targets(self._tracks):
+                out.append(self.check_track_state(ts))
         for r in out:
             self.auditor.note_sweep(r["check"], r)
         with self._lock:
@@ -1167,6 +1202,18 @@ class InvariantSweeper:
         result = {"check": "matrix_sentinels", "checked": 1,
                   "violations": [], "abstained": 0}
         result["violations"] = matrix.validate_sentinels()
+        return result
+
+    def check_track_state(self, state) -> dict:
+        """Trajectory track-state CSR invariants (trajectory/state.py):
+        entity offsets start at 0, never decrease, and sum to the row
+        count; every entity's timestamps are nondecreasing in layout
+        order — a violated CSR silently mis-aggregates EVERY per-entity
+        statistic, which no query-level shadow check can see."""
+        result = {"check": "track_state", "checked": 1,
+                  "violations": [], "abstained": 0,
+                  "type_name": getattr(state, "type_name", "")}
+        result["violations"] = state.validate()
         return result
 
     def check_standing_counts(self, store) -> dict:

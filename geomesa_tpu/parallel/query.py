@@ -1366,6 +1366,93 @@ def cached_batched_density_step(mesh: Mesh, width: int, height: int):
     )
 
 
+def make_corridor_step(heading: bool, bidirectional: bool):
+    """Fused corridor kernel: N candidate rows × Q corridors × S segments
+    in ONE device pass (the trajectory plane's tube-select/route-search
+    engine, :mod:`geomesa_tpu.trajectory.corridor`).
+
+    fn(cx, cy (N,) f32, bins, offs (N,) int32, hdg (N,) f32,
+       segs (Q, S, 4) f32 [x1, y1, x2, y2], tq (Q, S, 4) int32 time quads,
+       brg (Q, S) f32 segment bearings (deg CW from N),
+       buf2_lo, buf2_hi, tol_lo, tol_hi (Q,) f32)
+    → (cand (Q, N) bool, sure (Q, N) bool).
+
+    Per (corridor, segment, row): clamped point-to-segment distance² in
+    f32 plus the EXACT int-domain (bin, offset) time-window test (the
+    ``ops.refine`` comparisons — time semantics can never drift from the
+    scan kernels). f32 cannot decide boundary rows the way the f64
+    referee does, so the kernel answers in the repo's two-band contract:
+    ``cand`` uses the WIDENED thresholds (``buf2_hi`` / ``tol_hi`` — a
+    superset: a row outside it is f64-certainly out) and ``sure`` the
+    NARROWED ones (f64-certainly in); callers refine only ``cand & ~sure``
+    rows host-side in f64 (:func:`geomesa_tpu.trajectory.corridor.
+    corridor_masks_f64`). NaN headings fail both bands (IEEE compares are
+    False) — matching the host rule that an invalid heading is never
+    aligned. Padded segments carry the unsatisfiable time quad; padded
+    corridors carry negative ``buf2`` bands; padded rows are sliced off
+    by the caller. ``jax.lax.map`` over corridors bounds the live mask to
+    (S, N) — candidate sets are query results, far below store N, so the
+    step is a plain jit (no mesh sharding), like the polygon-join kernels.
+    """
+
+    @jax.jit
+    def step(cx, cy, bins, offs, hdg, segs, tq, brg,
+             buf2_lo, buf2_hi, tol_lo, tol_hi):
+        def one(args):
+            sg, t, b, b2lo, b2hi, tlo, thi = args
+            x1, y1 = sg[:, 0][:, None], sg[:, 1][:, None]
+            x2, y2 = sg[:, 2][:, None], sg[:, 3][:, None]
+            dx, dy = x2 - x1, y2 - y1
+            len2 = dx * dx + dy * dy
+            safe = jnp.where(len2 > 0, len2, 1.0)
+            tp = ((cx[None, :] - x1) * dx + (cy[None, :] - y1) * dy) / safe
+            tp = jnp.clip(jnp.where(len2 > 0, tp, 0.0), 0.0, 1.0)
+            d2 = (cx[None, :] - (x1 + tp * dx)) ** 2 + (
+                cy[None, :] - (y1 + tp * dy)) ** 2
+            after = (bins[None, :] > t[:, 0:1]) | (
+                (bins[None, :] == t[:, 0:1]) & (offs[None, :] >= t[:, 1:2]))
+            before = (bins[None, :] < t[:, 2:3]) | (
+                (bins[None, :] == t[:, 2:3]) & (offs[None, :] <= t[:, 3:4]))
+            ok = after & before
+            cand = ok & (d2 <= b2hi)
+            sure = ok & (d2 <= b2lo)
+            if heading:
+                diff = jnp.abs(
+                    jnp.mod(hdg[None, :] - b[:, None] + 180.0, 360.0) - 180.0)
+                if bidirectional:
+                    diff = jnp.minimum(diff, 180.0 - diff)
+                # a >=360° tolerance means UNCONSTRAINED (the _pack
+                # sentinel for corridors without a heading predicate in
+                # a mixed batch): accept explicitly — `NaN <= 360` is
+                # False, so relying on the numeric compare would drop
+                # NaN-heading rows from corridors that never asked for
+                # heading, diverging from the f64 semantics
+                cand &= (diff <= thi) | (thi >= 360.0)
+                sure &= (diff <= tlo) | (tlo >= 360.0)
+            return cand.any(axis=0), sure.any(axis=0)
+
+        return jax.lax.map(
+            one, (segs, tq, brg, buf2_lo, buf2_hi, tol_lo, tol_hi))
+
+    return step
+
+
+@lru_cache(maxsize=None)
+def cached_corridor_step(n_cap: int, s_cap: int, q_cap: int,
+                         heading: bool, bidirectional: bool):
+    """Memoized corridor step, ONE observed identity per (row bucket,
+    segment bucket, corridor bucket, heading/bidirectional variant) —
+    the same J003 discipline as :func:`cached_matrix_scan_step`: crossing
+    a bucket is a first compile on a fresh identity, and the steady
+    corridor path (same buckets, new payloads) is pinned at ZERO
+    recompiles by the jaxmon census (tests/test_trajectory.py)."""
+    tag = ("_h" if heading else "") + ("_b" if bidirectional else "")
+    return _observed(
+        f"corridor_n{n_cap}_s{s_cap}_q{q_cap}{tag}",
+        make_corridor_step(heading, bidirectional),
+    )
+
+
 # above this group cardinality the (chunk, G) one-hot's O(n·G) FLOPs and
 # footprint lose to segment_sum's O(n) — "auto" falls back to segments
 _MXU_BINCOUNT_MAX_GROUPS = 2048

@@ -127,6 +127,11 @@ def tube_select(
     ``track``: [(lon, lat, epoch_ms), ...] ordered waypoints. Implemented as
     one OR-of-segments query (each segment = bbox+time window primary bounds)
     followed by an exact per-segment (distance, time-interpolation) refine.
+
+    DEMOTED to the audit referee: the product path is the batched device
+    corridor engine (:func:`geomesa_tpu.trajectory.corridor.
+    tube_select_device`), which shadow-compares sampled results against
+    this host path through the ISSUE-13 audit plane (docs/trajectory.md).
     """
     sft = ds.get_schema(type_name)
     if len(track) < 2:

@@ -73,11 +73,18 @@ def route_search(
         # bearing: degrees clockwise from north (navigation convention)
         seg_bearing = np.degrees(np.arctan2(dx, dy)) % 360.0  # (1, S)
         col = r.table.columns[heading_field]
-        heading = col.values.astype(np.float64)[:, None] % 360.0
-        diff = np.abs((heading - seg_bearing + 180.0) % 360.0 - 180.0)
+        raw = col.values.astype(np.float64)[:, None]
+        # NaN headings are NOT-ALIGNED by explicit mask — previously
+        # ``NaN % 360.0`` propagated NaN into the comparison, which read
+        # all-False only by accident of IEEE compare semantics (and
+        # sprayed invalid-value warnings); the mask states the rule
+        finite = np.isfinite(raw)
+        with np.errstate(invalid="ignore"):
+            heading = np.where(finite, raw, 0.0) % 360.0
+            diff = np.abs((heading - seg_bearing + 180.0) % 360.0 - 180.0)
         if bidirectional:
             diff = np.minimum(diff, 180.0 - diff)
-        aligned = diff <= heading_tolerance_deg
+        aligned = finite & (diff <= heading_tolerance_deg)
         if col.valid is not None:
             aligned &= col.valid[:, None]
         ok &= aligned
@@ -88,13 +95,24 @@ def route_search(
 
 def track_label(table: FeatureTable, track_field: str) -> FeatureTable:
     """One label feature per track — the most recent point by the schema's
-    date attribute (``TrackLabelProcess`` role)."""
+    date attribute (``TrackLabelProcess`` role).
+
+    Vectorized: lexsort by (track, time, descending-row) and take each
+    group's last sorted element — the max-time row, ties resolved to the
+    LOWEST original row (the historical dict-loop rule, pinned red/green
+    in tests/test_trajectory.py). Output rows stay in ascending original
+    order, exactly as before.
+    """
+    n = len(table)
+    if n == 0:
+        return table
     t = table.dtg_millis()
-    groups = table.columns[track_field].values
-    best: dict = {}
-    for i, g in enumerate(groups.astype(object)):
-        j = best.get(g)
-        if j is None or t[i] > t[j]:
-            best[g] = i
-    idx = np.asarray(sorted(best.values()), dtype=np.int64)
+    groups = table.columns[track_field].values.astype(object)
+    _ents, codes = np.unique(groups, return_inverse=True)
+    # tertiary key: descending row index, so among equal (track, time)
+    # rows the SMALLEST original index sorts last and wins the label
+    order = np.lexsort((-np.arange(n), t, codes))
+    sorted_codes = codes[order]
+    last = np.nonzero(np.r_[sorted_codes[1:] != sorted_codes[:-1], True])[0]
+    idx = np.sort(order[last]).astype(np.int64)
     return table.take(idx)

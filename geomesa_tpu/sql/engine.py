@@ -94,6 +94,17 @@ _HAVING = re.compile(
     r"^\s*(?P<expr>\w+\s*\(\s*(?:\*|[\w.]+)\s*\))\s*(?P<op><>|<=|>=|=|<|>)\s*"
     r"(?P<lit>-?\d+(?:\.\d+)?)\s*$"
 )
+# trajectory table functions (docs/trajectory.md § SQL surface):
+#   SELECT * FROM TUBE_SELECT('type', 'x y t, x y t, ...', buffer,
+#                             time_buffer_ms [, 'cql']) [LIMIT n]
+#   SELECT * FROM TRACK_STATS('type', 'track_field' [, 'cql']) [LIMIT n]
+#   SELECT * FROM ST_LINK('ltype', 'rtype', 'pred' [, distance
+#                         [, time_buffer_ms]]) [LIMIT n]
+_TABLE_FN = re.compile(
+    r"^\s*select\s+\*\s+from\s+(?P<fn>tube_select|track_stats|st_link)"
+    r"\s*\((?P<args>.*)\)\s*(?:limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
 
 
 def _mask_quotes(s: str) -> str:
@@ -1371,6 +1382,103 @@ def _mesh_aggregate(ds, type_name: str, cql, items, group_by, having,
     return _apply_order_limit(SqlResult(cols), order, limit, offset)
 
 
+def _fn_args(m: "re.Match", original: str) -> list:
+    """Parse a table function's argument list (span sliced from the
+    ORIGINAL statement — the mask blanked quoted content): quoted
+    strings → str, bare numerics → int/float."""
+    a, b = m.span("args")
+    out = []
+    for part in _split_top(original[a:b]):
+        p = part.strip()
+        if len(p) >= 2 and p[0] in "'\"" and p[-1] == p[0]:
+            out.append(p[1:-1])
+        else:
+            try:
+                out.append(int(p))
+            except ValueError:
+                try:
+                    out.append(float(p))
+                except ValueError:
+                    raise SqlError(
+                        f"bad table-function argument {p!r}") from None
+    return out
+
+
+def _parse_track(text: str) -> list:
+    """'x y t, x y t, ...' (or ';'-separated) → [(lon, lat, epoch_ms)]."""
+    out = []
+    for wp in re.split(r"[,;]", text):
+        wp = wp.strip()
+        if not wp:
+            continue
+        parts = wp.split()
+        if len(parts) != 3:
+            raise SqlError(
+                f"tube waypoint must be 'x y epoch_ms', got {wp!r}")
+        out.append((float(parts[0]), float(parts[1]), int(float(parts[2]))))
+    return out
+
+
+def _table_cols(table) -> dict:
+    """FeatureTable → SqlResult column dict (fid + every attribute, the
+    ``SELECT *`` materialization rule)."""
+    cols: dict = {"__fid__": np.asarray(table.fids, dtype=object)}
+    for a in table.sft.attributes:
+        c = table.columns[a.name]
+        cols[a.name] = c.geometries() if a.type.is_geometry else c.values
+    return cols
+
+
+def _sql_table_function(ds, m: "re.Match", original: str,
+                        auths=None) -> SqlResult:
+    """The trajectory plane's SQL surface (docs/trajectory.md):
+    ``TUBE_SELECT`` (corridor engine), ``TRACK_STATS`` (batched
+    per-entity aggregation), ``ST_LINK`` (two-store interlink — both
+    sides resolve against ``ds``, which for a federated view is the
+    merged surface)."""
+    fn = m.group("fn").lower()
+    args = _fn_args(m, original)
+    limit = int(m.group("limit")) if m.group("limit") else None
+
+    def need(lo: int, hi: int, sig: str):
+        if not (lo <= len(args) <= hi):
+            raise SqlError(f"{fn.upper()} expects {sig}")
+
+    if fn == "tube_select":
+        need(4, 5, "('type', 'x y t, ...', buffer_deg, time_buffer_ms"
+                   " [, 'cql'])")
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        table = tube_select_device(
+            ds, str(args[0]), _parse_track(str(args[1])), float(args[2]),
+            int(args[3]), filter=(str(args[4]) if len(args) > 4 else None),
+            auths=auths)
+        res = SqlResult(_table_cols(table))
+    elif fn == "track_stats":
+        need(2, 3, "('type', 'track_field' [, 'cql'])")
+        from geomesa_tpu.trajectory.state import track_stats
+
+        res = SqlResult(track_stats(
+            ds, str(args[0]), str(args[1]),
+            filter=(str(args[2]) if len(args) > 2 else None),
+            auths=auths))
+    else:  # st_link
+        need(3, 5, "('ltype', 'rtype', 'pred' [, distance"
+                   " [, time_buffer_ms]])")
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        pairs = interlink(
+            ds, str(args[0]), ds, str(args[1]), pred=str(args[2]).lower(),
+            distance=(float(args[3]) if len(args) > 3 else 0.0),
+            time_buffer_ms=(int(args[4]) if len(args) > 4 else None),
+            auths=auths)
+        res = SqlResult({
+            "left_fid": np.asarray([p[0] for p in pairs], dtype=object),
+            "right_fid": np.asarray([p[1] for p in pairs], dtype=object),
+        })
+    return _apply_order_limit(res, None, limit, 0)
+
+
 def sql(ds, statement: str, auths=None) -> SqlResult:
     """Execute a SQL statement against ``ds`` (DataStore or merged view).
 
@@ -1392,6 +1500,9 @@ def _run_statement(ds, statement: str, auths=None) -> SqlResult:
     # literal containing e.g. 'having' cannot hijack clause splitting; the
     # spans are then sliced from the original statement
     masked = _mask_quotes(statement)
+    tf = _TABLE_FN.match(masked)
+    if tf:
+        return _sql_table_function(ds, tf, statement, auths=auths)
     jm = _JOIN.match(masked)
     if jm:
         return _sql_join(ds, jm, statement, auths=auths)

@@ -681,6 +681,13 @@ class DataStore:
         # the PERSISTED cost sidecar too: a restart must not resurrect a
         # deleted/renamed type's profile for an unrelated successor
         devmon.purge_persisted_costs(name)
+        # cached trajectory track states are epoch-fingerprinted by the
+        # SAME restarting (rebuild epoch, delta version) tuple — a
+        # recreated same-name type could collide and serve the dead
+        # table's per-entity aggregates as current
+        from geomesa_tpu.trajectory import state as _traj_state
+
+        _traj_state.invalidate(self, name)
 
     def _state(self, name: str) -> _TypeState:
         if name not in self._types:

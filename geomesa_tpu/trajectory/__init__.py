@@ -1,0 +1,30 @@
+"""Trajectory plane: device-parallel track analytics (ROADMAP item 5).
+
+Three cooperating pieces over the ``geomesa-process`` tier's track
+workloads (PAPER.md §1 — tube-select, track ops):
+
+- :mod:`geomesa_tpu.trajectory.state` — device-resident per-entity track
+  layout (time-sorted rows + CSR entity offsets, pinned through the
+  buffer pool under ledger group ``"tracks"``) and batched per-entity
+  track aggregation via segment-reduce.
+- :mod:`geomesa_tpu.trajectory.corridor` — tube-select and route-search
+  re-cast as ONE ``(rows × corridors)`` device problem (the batched
+  corridor kernel, :func:`geomesa_tpu.parallel.query.cached_corridor_
+  step`), with the host process paths demoted to the audit referee.
+- :mod:`geomesa_tpu.trajectory.interlink` — batched ST_* predicate
+  linking between two stores (2D and XZ3 time-lifted 3D) via XZ-range
+  candidate pairing plus the blocked device join.
+
+Exposed as SQL table functions (``TUBE_SELECT`` / ``TRACK_STATS`` /
+``ST_LINK``, :mod:`geomesa_tpu.sql.engine`) and HTTP endpoints
+(:mod:`geomesa_tpu.web.app`) so the serving plane covers trajectory
+traffic. See docs/trajectory.md.
+"""
+
+from geomesa_tpu.trajectory.corridor import (  # noqa: F401
+    CorridorSpec, route_search_device, tube_select_device, tube_select_many,
+)
+from geomesa_tpu.trajectory.interlink import interlink, interlink_referee  # noqa: F401
+from geomesa_tpu.trajectory.state import (  # noqa: F401
+    TrackState, build_track_state, track_stats, track_stats_host,
+)

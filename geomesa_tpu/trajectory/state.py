@@ -1,0 +1,480 @@
+"""Device-resident per-entity track layout + batched track aggregation.
+
+The ``geomesa-process`` track tier (``TrackLabelProcess``, the per-track
+halves of ``TubeBuilder`` — PAPER.md §1) survives host-side as Python
+loops over ``groups.astype(object)``; at millions of entities that is the
+grouped-aggregation regime where BENCH_r05 fell to 0.16×. This module
+builds ONE planned columnar scan into a track layout the device can
+segment-reduce:
+
+- rows sorted by ``(track, time)`` (stable lexsort), entity boundaries as
+  CSR offsets — the classic segmented layout, so every per-entity
+  aggregate is one ``jax.ops.segment_sum`` over contiguous segments;
+- device columns (x/y f32, per-step seconds f32, entity ids int32) are
+  pinned through the ISSUE-7 :class:`~geomesa_tpu.store.bufferpool.
+  BufferPool` under ledger group ``"tracks"`` and fingerprinted by the
+  store's ``(rebuild epoch, delta version)`` DATA EPOCH — any write
+  (delta included) invalidates with one tuple compare, eviction under
+  HBM pressure just restages on next use;
+- :func:`track_stats` answers length / duration / average speed /
+  heading change / dwell / last-position label for EVERY entity in one
+  fused pass (:func:`cached_track_stats_step`), with
+  :func:`track_stats_host` as the independent f64 referee.
+
+Step-bearing semantics (shared by device kernel and host referee so they
+cannot drift): a step's bearing is defined only when its length is
+positive; heading change accumulates ``|wrap(b_i - b_{i-1})|`` over
+consecutive DEFINED-bearing step pairs within an entity; dwell sums step
+durations whose step length is ≤ ``dwell_eps_deg``.
+
+Locking: ``TrackState._lock`` and the manager cache lock are LEAVES
+(docs/concurrency.md) — device staging runs outside both.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from functools import lru_cache
+
+import numpy as np
+
+from geomesa_tpu.planning.planner import Query
+
+__all__ = [
+    "TrackState", "build_track_state", "cached_track_stats_step",
+    "get_track_state", "track_stats", "track_stats_host",
+]
+
+LEDGER_GROUP = "tracks"  # devmon residency ledger group for track columns
+MIN_ROW_BUCKET = 1024  # power-of-two row-padding floor (J003 shape bucket)
+DEFAULT_DWELL_EPS_DEG = 1e-4
+
+
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """THE trajectory plane's shape-bucket rule (shared with
+    :mod:`geomesa_tpu.trajectory.corridor` so the two planes' padding
+    discipline cannot diverge): smallest power of two ≥ max(n, floor)."""
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+_pow2 = pow2_bucket  # module-local alias
+
+
+class _DeviceSlot:
+    """One staging's device columns. A FRESH slot per staging is the
+    accounting unit: the ledger entry finalizes when the slot dies, and
+    the pool's same-(type, key) entry REPLACES on the next staging's
+    different owner — re-registering the TrackState itself would merge
+    group bytes across evict/restage cycles and double-count."""
+
+    __slots__ = ("cols", "n_cap", "e_cap", "__weakref__")
+
+    def __init__(self, cols, n_cap, e_cap):
+        self.cols = cols
+        self.n_cap = n_cap
+        self.e_cap = e_cap
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(int(a.nbytes) for a in self.cols))
+
+
+class TrackState:
+    """One (type, track-field, filter) snapshot in segmented track layout.
+
+    Host truth: ``order`` (row permutation of the scanned table), ``t_ms``
+    int64 times, ``x``/``y`` f64 coords, ``entities`` (E,) object keys and
+    ``offsets`` (E+1,) int64 CSR — entity ``e`` owns sorted rows
+    ``offsets[e]:offsets[e+1]``. Device columns stage lazily and drop on
+    pool eviction (``_dev`` cleared; next use restages)."""
+
+    def __init__(self, type_name: str, track_field: str, epoch,
+                 entities, offsets, table, order, t_ms, x, y,
+                 filter_text: str = "", auths=None):
+        self.type_name = type_name
+        self.track_field = track_field
+        self.epoch = epoch
+        self.filter_text = filter_text
+        self.auths = None if auths is None else tuple(sorted(auths))
+        self.entities = entities
+        self.offsets = offsets
+        self.table = table  # the scanned snapshot table (sorted via order)
+        self.order = order
+        self.t_ms = t_ms
+        self.x = x
+        self.y = y
+        self._lock = threading.Lock()  # leaf: device slot only
+        self._dev = None  # (x32, y32, dt32, sid, first, n_cap, e_cap)
+        self._pool = None
+
+    @property
+    def n(self) -> int:
+        return len(self.order)
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entities)
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the staged columns (0 while unstaged)."""
+        slot = self._dev
+        return 0 if slot is None else slot.nbytes
+
+    # -- device staging -------------------------------------------------------
+    def _evict(self) -> None:
+        """Pool-eviction callback: drop the device slot (restage on use)."""
+        with self._lock:
+            self._dev = None
+
+    def device_columns(self, pool=None):
+        """The padded device columns, staging (and pool-registering) on
+        first use: ``(x32, y32, dt_s, sid, first, n_cap, e_cap)``. Pads
+        carry ``sid == n_entities`` (a discard segment past every real
+        entity) and ``first=True`` so they contribute nothing."""
+        with self._lock:
+            if self._dev is not None:
+                s = self._dev
+                return tuple(s.cols) + (s.n_cap, s.e_cap)
+        import jax.numpy as jnp
+
+        from geomesa_tpu.obs.jaxmon import count_h2d
+
+        n = self.n
+        n_cap = _pow2(max(n, 1), MIN_ROW_BUCKET)
+        e_cap = _pow2(self.n_entities + 1)
+        sid = np.full(n_cap, self.n_entities, dtype=np.int32)
+        first = np.ones(n_cap, dtype=bool)
+        x32 = np.zeros(n_cap, dtype=np.float32)
+        y32 = np.zeros(n_cap, dtype=np.float32)
+        dt32 = np.zeros(n_cap, dtype=np.float32)
+        if n:
+            ent_ids = np.repeat(
+                np.arange(self.n_entities, dtype=np.int32),
+                np.diff(self.offsets).astype(np.int64))
+            sid[:n] = ent_ids
+            f = np.zeros(n, dtype=bool)
+            f[self.offsets[:-1]] = True
+            first[:n] = f
+            x32[:n] = self.x.astype(np.float32)
+            y32[:n] = self.y.astype(np.float32)
+            dt = np.zeros(n, dtype=np.float64)
+            dt[1:] = (self.t_ms[1:] - self.t_ms[:-1]) / 1000.0
+            dt[f] = 0.0
+            dt32[:n] = dt.astype(np.float32)
+        cols = [x32, y32, dt32, sid, first]
+        # track staging belongs to the trajectory plane, not whichever
+        # query happens to be profiled concurrently (the ISSUE-7 rule)
+        count_h2d(*cols, label="tracks")
+        slot = _DeviceSlot(
+            tuple(jnp.asarray(a) for a in cols), n_cap, e_cap)
+        register = False
+        with self._lock:
+            if self._dev is None:
+                self._dev = slot
+                self._pool = pool
+                register = pool is not None
+            slot = self._dev
+        if register:
+            from geomesa_tpu.store.bufferpool import register_residency
+
+            register_residency(
+                pool, self.type_name, self._pool_key(), LEDGER_GROUP,
+                slot.nbytes, owner=slot, fingerprint=self.epoch,
+                on_evict=self._evict)
+        return tuple(slot.cols) + (slot.n_cap, slot.e_cap)
+
+    def _pool_key(self) -> str:
+        """Pool/ledger entry key. DISTINCT per (field, filter, auths):
+        two concurrently-live states (an auth-restricted caller beside an
+        unrestricted one, or two long filters sharing a prefix) must not
+        collide on one pool entry — the pool replaces same-key entries on
+        a different owner WITHOUT evicting the old slot, which would
+        leave the older state's device columns alive but unbudgeted."""
+        key = f"tracks:{self.track_field}"
+        if self.filter_text or self.auths is not None:
+            import hashlib
+
+            scope = repr((self.filter_text, self.auths)).encode()
+            key += f"[{hashlib.sha1(scope).hexdigest()[:10]}]"
+        return key
+
+    def release(self) -> None:
+        """Drop the device slot (manager invalidation). The pool's
+        (type, tracks:field) entry still holds the old slot until the
+        SUCCESSOR state's staging replaces it (different owner, same
+        key) or pressure evicts it — the same cold-buffer lifecycle as
+        any other residency unit; schema delete/rename purges by type
+        name through the existing ``pool.purge`` path."""
+        with self._lock:
+            self._dev = None
+            self._pool = None
+
+    # -- invariants (obs/audit.py InvariantSweeper surface) -------------------
+    def validate(self) -> list[str]:
+        """Structural CSR invariants: offsets start at 0, end at the row
+        count, never decrease; every entity's times are nondecreasing.
+        Returns violation strings (empty = clean)."""
+        out: list[str] = []
+        off = np.asarray(self.offsets, dtype=np.int64)
+        if len(off) != self.n_entities + 1:
+            out.append(
+                f"offsets length {len(off)} != entities+1 "
+                f"{self.n_entities + 1}")
+            return out
+        if len(off) and off[0] != 0:
+            out.append(f"offsets[0] = {off[0]} != 0")
+        if len(off) and off[-1] != self.n:
+            out.append(f"offsets[-1] = {off[-1]} != rows {self.n}")
+        if np.any(np.diff(off) < 0):
+            out.append("offsets decrease")
+            return out
+        if self.n:
+            d = np.diff(self.t_ms)
+            boundary = np.zeros(self.n - 1, dtype=bool)
+            inner = off[1:-1]
+            boundary[inner[(inner > 0) & (inner < self.n)] - 1] = True
+            bad = np.nonzero((d < 0) & ~boundary)[0]
+            if len(bad):
+                out.append(
+                    f"time not monotone within entity at sorted rows "
+                    f"{bad[:4].tolist()}")
+        return out
+
+
+def _data_epoch(ds, type_name: str):
+    """The store's (rebuild epoch, delta version) pair, or None when the
+    store does not expose one (remote/merged callers skip caching)."""
+    try:
+        return ds._state(type_name).data_epoch()
+    except (AttributeError, KeyError):
+        return None
+
+
+def build_track_state(ds, type_name: str, track_field: str,
+                      filter=None, auths=None) -> TrackState:
+    """ONE planned columnar scan → segmented track layout.
+
+    The DATA EPOCH is read BEFORE the scan (the ISSUE-13 rule): a racing
+    write can only make the cached state look stale, never fresh.
+    ``auths``: record-level visibility for the scan — a restricted
+    caller's state holds only the rows it may see."""
+    epoch = _data_epoch(ds, type_name)
+    r = ds.query(type_name, Query(filter=filter, auths=auths))
+    t = r.table
+    from geomesa_tpu.schema.columnar import representative_xy
+
+    if track_field not in t.columns:
+        raise KeyError(f"{type_name!r} has no attribute {track_field!r}")
+    tms = t.dtg_millis()
+    groups = t.columns[track_field].values.astype(object)
+    if len(t):
+        ents, codes = np.unique(groups, return_inverse=True)
+        # tertiary key: DESCENDING row index, so among equal (track,
+        # time) rows the LOWEST original row sorts last — the layout's
+        # last-of-entity row (the TRACK_STATS label) then resolves ties
+        # exactly like process/tracks.track_label (pinned there
+        # red/green); a plain stable sort would pick the HIGHEST row
+        order = np.lexsort((-np.arange(len(t)), tms, codes))
+        sorted_codes = codes[order]
+        starts = np.nonzero(
+            np.r_[True, sorted_codes[1:] != sorted_codes[:-1]])[0]
+        offsets = np.concatenate(
+            [starts, [len(t)]]).astype(np.int64)
+        xs, ys = representative_xy(t)
+    else:
+        ents = np.empty(0, dtype=object)
+        order = np.empty(0, dtype=np.int64)
+        offsets = np.zeros(1, dtype=np.int64)
+        xs = ys = np.empty(0, dtype=np.float64)
+        tms = np.empty(0, dtype=np.int64)
+    filter_text = "" if filter is None else str(filter)
+    return TrackState(
+        type_name, track_field, epoch, ents, offsets,
+        t.take(order) if len(t) else t, order,
+        tms[order] if len(t) else tms,
+        xs[order].astype(np.float64) if len(t) else xs,
+        ys[order].astype(np.float64) if len(t) else ys,
+        filter_text=filter_text, auths=auths,
+    )
+
+
+# -- manager cache (epoch-fingerprinted) --------------------------------------
+
+_lock = threading.Lock()  # leaf: the manager cache table
+_states: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def get_track_state(ds, type_name: str, track_field: str,
+                    filter=None, auths=None) -> TrackState:
+    """The cached track state for (store, type, field, filter, auths),
+    rebuilt when the store's data epoch moved (delta writes included —
+    the epoch check is one tuple compare, so invalidation costs
+    nothing). ``auths`` is part of the cache key: a restricted caller
+    must never be served an unrestricted caller's cached rows."""
+    key = (type_name, track_field,
+           "" if filter is None else str(filter),
+           None if auths is None else tuple(sorted(auths)))
+    epoch = _data_epoch(ds, type_name)
+    with _lock:
+        table = _states.get(ds)
+        st = table.get(key) if table else None
+    if st is not None and epoch is not None and st.epoch == epoch:
+        return st
+    fresh = build_track_state(ds, type_name, track_field, filter=filter,
+                              auths=auths)
+    with _lock:
+        table = _states.get(ds)
+        if table is None:
+            table = {}
+            _states[ds] = table
+        prev = table.get(key)
+        table[key] = fresh
+    if prev is not None:
+        prev.release()
+    return fresh
+
+
+def invalidate(ds, type_name: str | None = None) -> None:
+    """Drop cached states (schema delete/rename hygiene; tests)."""
+    with _lock:
+        table = _states.get(ds)
+        if not table:
+            return
+        keys = [k for k in table
+                if type_name is None or k[0] == type_name]
+        dropped = [table.pop(k) for k in keys]
+    for st in dropped:
+        st.release()
+
+
+# -- the fused per-entity aggregation -----------------------------------------
+
+@lru_cache(maxsize=None)
+def cached_track_stats_step(n_cap: int, e_cap: int):
+    """Memoized segment-reduce step, one observed identity per (row
+    bucket, entity bucket) — same zero-steady-recompile contract as
+    :func:`geomesa_tpu.parallel.query.cached_corridor_step`.
+
+    fn(x, y, dt, sid, first, dwell_eps) → (length_deg, duration_s,
+    heading_change_deg, dwell_s), each (e_cap,) f32; callers slice the
+    real entity count. All f32 (J004) — the f64 referee is
+    :func:`track_stats_host`."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.obs.jaxmon import observed
+
+    @jax.jit
+    def step(x, y, dt, sid, first, dwell_eps):
+        dx = x - jnp.concatenate([x[:1], x[:-1]])
+        dy = y - jnp.concatenate([y[:1], y[:-1]])
+        dist = jnp.where(first, 0.0, jnp.sqrt(dx * dx + dy * dy))
+        step_dt = jnp.where(first, 0.0, dt)
+        length = jax.ops.segment_sum(dist, sid, num_segments=e_cap)
+        duration = jax.ops.segment_sum(step_dt, sid, num_segments=e_cap)
+        # step bearings (deg CW from N); defined only for moving steps
+        brg = jnp.degrees(jnp.arctan2(dx, dy))
+        moved = ~first & (dist > 0)
+        pbrg = jnp.concatenate([brg[:1], brg[:-1]])
+        pmoved = jnp.concatenate([jnp.zeros(1, bool), moved[:-1]])
+        turn = jnp.abs(jnp.mod(brg - pbrg + 180.0, 360.0) - 180.0)
+        turn = jnp.where(moved & pmoved, turn, 0.0)
+        heading_change = jax.ops.segment_sum(turn, sid, num_segments=e_cap)
+        dwell = jax.ops.segment_sum(
+            jnp.where(dist <= dwell_eps, step_dt, 0.0), sid,
+            num_segments=e_cap)
+        return length, duration, heading_change, dwell
+
+    return observed(f"track_stats_n{n_cap}_e{e_cap}", step)
+
+
+def track_stats(ds, type_name: str, track_field: str, filter=None,
+                dwell_eps_deg: float = DEFAULT_DWELL_EPS_DEG,
+                state: TrackState | None = None, auths=None) -> dict:
+    """Batched per-entity track aggregation: every entity's length /
+    duration / avg speed / heading change / dwell / last-position label
+    in one fused device pass over the cached track state. Returns a
+    column dict (the SQL ``TRACK_STATS`` / HTTP surface). ``auths``
+    scopes the underlying scan (and the cache entry) to the caller's
+    visible rows."""
+    import jax.numpy as jnp
+
+    st = state or get_track_state(ds, type_name, track_field,
+                                  filter=filter, auths=auths)
+    pool = getattr(getattr(ds, "backend", None), "pool", None)
+    x32, y32, dt32, sid, first, n_cap, e_cap = st.device_columns(pool=pool)
+    step = cached_track_stats_step(n_cap, e_cap)
+    length, duration, hc, dwell = step(
+        x32, y32, dt32, sid, first, jnp.float32(dwell_eps_deg))
+    e = st.n_entities
+    length = np.asarray(length)[:e].astype(np.float64)
+    duration = np.asarray(duration)[:e].astype(np.float64)
+    hc = np.asarray(hc)[:e].astype(np.float64)
+    dwell = np.asarray(dwell)[:e].astype(np.float64)
+    return _assemble(st, length, duration, hc, dwell)
+
+
+def track_stats_host(state: TrackState,
+                     dwell_eps_deg: float = DEFAULT_DWELL_EPS_DEG) -> dict:
+    """Independent f64 NumPy referee with the identical step-bearing
+    semantics — the parity oracle for :func:`track_stats` and the audit
+    plane's comparison surface (no jax anywhere)."""
+    st = state
+    n, e = st.n, st.n_entities
+    length = np.zeros(e)
+    duration = np.zeros(e)
+    hc = np.zeros(e)
+    dwell = np.zeros(e)
+    if n:
+        first = np.zeros(n, dtype=bool)
+        first[st.offsets[:-1]] = True
+        dx = np.diff(st.x, prepend=st.x[:1])
+        dy = np.diff(st.y, prepend=st.y[:1])
+        dist = np.where(first, 0.0, np.hypot(dx, dy))
+        dt = np.zeros(n)
+        dt[1:] = (st.t_ms[1:] - st.t_ms[:-1]) / 1000.0
+        dt[first] = 0.0
+        sid = np.repeat(np.arange(e), np.diff(st.offsets).astype(np.int64))
+        length = np.bincount(sid, weights=dist, minlength=e)
+        duration = np.bincount(sid, weights=dt, minlength=e)
+        with np.errstate(invalid="ignore"):
+            brg = np.degrees(np.arctan2(dx, dy))
+        moved = ~first & (dist > 0)
+        pmoved = np.r_[False, moved[:-1]]
+        turn = np.abs(np.mod(brg - np.r_[brg[:1], brg[:-1]] + 180.0, 360.0)
+                      - 180.0)
+        turn = np.where(moved & pmoved, turn, 0.0)
+        hc = np.bincount(sid, weights=turn, minlength=e)
+        dwell = np.bincount(
+            sid, weights=np.where(dist <= dwell_eps_deg, dt, 0.0),
+            minlength=e)
+    return _assemble(st, length, duration, hc, dwell)
+
+
+def _assemble(st: TrackState, length, duration, hc, dwell) -> dict:
+    last = np.maximum(st.offsets[1:] - 1, 0).astype(np.int64)
+    firsts = st.offsets[:-1].astype(np.int64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        speed = np.where(duration > 0, length / np.maximum(duration, 1e-12),
+                         0.0)
+    e = st.n_entities
+    return {
+        "track": np.asarray(st.entities, dtype=object),
+        "rows": np.diff(st.offsets).astype(np.int64),
+        "length_deg": length,
+        "duration_s": duration,
+        "avg_speed_deg_s": speed,
+        "heading_change_deg": hc,
+        "dwell_s": dwell,
+        "first_ms": (st.t_ms[firsts] if e else np.empty(0, np.int64)),
+        "last_ms": (st.t_ms[last] if e else np.empty(0, np.int64)),
+        "last_x": (st.x[last] if e else np.empty(0)),
+        "last_y": (st.y[last] if e else np.empty(0)),
+        "last_fid": (st.table.fids[last] if e
+                     else np.empty(0, dtype=object)),
+    }

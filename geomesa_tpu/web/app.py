@@ -110,6 +110,9 @@ _ADMISSION_ROUTES = frozenset({
     "_query", "_sql", "_count_many", "_select_many", "_density_many",
     "_aggregate", "_stats", "_stats_count", "_stats_bounds",
     "_stats_topk", "_density", "_wfs", "_wms",
+    # trajectory plane (docs/trajectory.md): corridor scans, track
+    # aggregation, and interlink joins are all scan-class work
+    "_tube_select", "_track_stats", "_link",
 })
 
 
@@ -184,6 +187,11 @@ class GeoMesaApp:
             ("POST", r"^/api/schemas/([^/]+)/select-many$", self._select_many),
             ("POST", r"^/api/schemas/([^/]+)/density-many$", self._density_many),
             ("POST", r"^/api/schemas/([^/]+)/aggregate$", self._aggregate),
+            # trajectory plane: corridor scans + batched track aggregation
+            # + two-store interlink (docs/trajectory.md § HTTP surface)
+            ("POST", r"^/api/schemas/([^/]+)/tube-select$", self._tube_select),
+            ("POST", r"^/api/schemas/([^/]+)/track-stats$", self._track_stats),
+            ("POST", r"^/api/link$", self._link),
             ("GET", r"^/api/schemas/([^/]+)/stats$", self._stats),
             ("GET", r"^/api/schemas/([^/]+)/stats/count$", self._stats_count),
             ("GET", r"^/api/schemas/([^/]+)/stats/bounds$", self._stats_bounds),
@@ -791,6 +799,93 @@ class GeoMesaApp:
         except UnknownFormat:
             raise _HttpError(400, f"unknown format {fmt!r}") from None
         return 200, payload, ctype
+
+    def _tube_select(self, name, params, body):
+        """POST {"track": [[x, y, epoch_ms], ...], "buffer_deg": f,
+        "time_buffer_ms": n, "filter"?: cql, "format"?: fmt} → matching
+        features through the batched device corridor engine."""
+        if not body or "track" not in body:
+            raise _HttpError(400, 'body must include "track"')
+        try:
+            track = [(float(x), float(y), int(t)) for x, y, t in body["track"]]
+            buf = float(body.get("buffer_deg", 0.0))
+            tb = int(body.get("time_buffer_ms", 0))
+        except (TypeError, ValueError) as e:
+            raise _HttpError(400, f"bad tube-select body: {e}") from None
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+        from geomesa_tpu.web.formats import UnknownFormat, format_table
+
+        try:
+            table = tube_select_device(
+                self.store, name, track, buf, tb,
+                filter=body.get("filter"), auths=params.get("__auths__"))
+        except ValueError as e:
+            raise _HttpError(400, str(e)) from None
+        except KeyError as e:
+            raise _HttpError(404, str(e)) from None
+        fmt = body.get("format", params.get("format", "geojson"))
+        try:
+            with obs.span("serialize", format=fmt, rows=len(table)):
+                payload, ctype = format_table(table, fmt)
+        except UnknownFormat:
+            raise _HttpError(400, f"unknown format {fmt!r}") from None
+        return 200, payload, ctype
+
+    def _track_stats(self, name, params, body):
+        """POST {"track_field": str, "filter"?: cql, "dwell_eps_deg"?: f}
+        → per-entity track aggregates (one fused device pass)."""
+        if not body or "track_field" not in body:
+            raise _HttpError(400, 'body must include "track_field"')
+        from geomesa_tpu.trajectory.state import (
+            DEFAULT_DWELL_EPS_DEG, track_stats)
+
+        try:
+            stats = track_stats(
+                self.store, name, str(body["track_field"]),
+                filter=body.get("filter"),
+                dwell_eps_deg=float(
+                    body.get("dwell_eps_deg", DEFAULT_DWELL_EPS_DEG)),
+                auths=params.get("__auths__"))
+        except (TypeError, ValueError) as e:
+            raise _HttpError(400, f"bad track-stats request: {e}") from None
+        except KeyError as e:
+            raise _HttpError(404, str(e)) from None
+        n = len(stats["track"])
+        return 200, {
+            "entities": n,
+            "columns": {k: [_jsonable(x) if isinstance(x, (np.generic,))
+                            else (x if isinstance(x, (int, float, str))
+                                  else str(x))
+                            for x in v.tolist()]
+                        for k, v in stats.items()},
+        }, "application/json"
+
+    def _link(self, params, body):
+        """POST {"left": type, "right": type, "pred"?: "intersects"|
+        "dwithin", "distance"?: f, "time_buffer_ms"?: n, "left_filter"?,
+        "right_filter"?} → exact interlink pair set (2D / XZ3 legs)."""
+        if not body or "left" not in body or "right" not in body:
+            raise _HttpError(400, 'body must include "left" and "right"')
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        tb = body.get("time_buffer_ms")
+        try:
+            pairs = interlink(
+                self.store, str(body["left"]), self.store,
+                str(body["right"]), pred=body.get("pred", "intersects"),
+                distance=float(body.get("distance", 0.0)),
+                time_buffer_ms=(None if tb is None else int(tb)),
+                lfilter=body.get("left_filter"),
+                rfilter=body.get("right_filter"),
+                auths=params.get("__auths__"))
+        except ValueError as e:
+            raise _HttpError(400, str(e)) from None
+        except KeyError as e:
+            raise _HttpError(404, str(e)) from None
+        return 200, {
+            "count": len(pairs),
+            "pairs": [[lf, rf] for lf, rf in pairs],
+        }, "application/json"
 
     def _restricted_auths(self, name, params):
         """The caller's auths when visibility enforcement applies, else None.

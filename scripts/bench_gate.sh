@@ -40,7 +40,11 @@ export GEOMESA_BENCH_REGRESS_K="${GEOMESA_BENCH_REGRESS_K:-2}"
 # batched row-set parity plus the plan-overhead bound (host planning <5%
 # of query wall on the cached path) gate every run — the adaptive
 # planner's fast path can never silently regress select again.
-export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,6,8,9}"
+# Config 10 rides it as the TRAJECTORY parity leg (ISSUE 15): tube-select
+# row-set parity of the device corridor path vs the demoted host referee
+# (zero steady-state recompiles pinned), and interlink exact pair-set
+# parity vs the nested-loop f64 referee on the 2D and XZ3 legs.
+export GEOMESA_BENCH_REGRESS_CONFIGS="${GEOMESA_BENCH_REGRESS_CONFIGS:-2,6,8,9,10}"
 
 tmp="$(mktemp -d)"
 trap 'rm -rf "$tmp"' EXIT

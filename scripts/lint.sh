@@ -94,6 +94,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 # docs/observability.md § Continuous correctness auditing.
 JAX_PLATFORMS=cpu python -m pytest tests/test_audit.py -q
 
+# trajectory plane (ISSUE 15): the device corridor engine's randomized-
+# grid parity vs the demoted host tube-select/route-search (incl. heading
+# + time-buffer legs) with the zero-steady-recompile census pin, track-
+# state CSR invariants + batched per-entity aggregation parity vs the f64
+# referee, interlink exact pair parity vs the nested-loop referee on the
+# 2D and XZ3 time-lifted legs, XZ curve ranges-superset property tests,
+# and the SQL/HTTP/audit surfaces. See docs/trajectory.md.
+JAX_PLATFORMS=cpu python -m pytest tests/test_trajectory.py -q
+
 # durability plane (ISSUE 14): WAL journaling of acked writes + group
 # commit, checkpoint stamps / exactly-once replay / head trims, the
 # kill-at-every-named-crash-point matrix (real SIGKILL subprocesses),
@@ -119,7 +128,8 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_concurrency.py tests/test_locks.py tests/test_devmon.py \
     tests/test_geoblocks.py tests/test_bufferpool.py \
     tests/test_stream_matrix.py tests/test_usage_workload.py \
-    tests/test_serving.py tests/test_audit.py tests/test_durability.py -q
+    tests/test_serving.py tests/test_audit.py tests/test_durability.py \
+    tests/test_trajectory.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

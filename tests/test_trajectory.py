@@ -1,0 +1,884 @@
+"""Trajectory plane (ISSUE 15): device corridor engine, track state +
+batched per-entity aggregation, XZ interlink joins, XZ curve coverage,
+process satellites, SQL/HTTP surfaces, and the audit-plane wiring.
+
+The acceptance pins: tube-select on the device corridor path matches the
+demoted host referee across a randomized grid (incl. heading and
+time-buffer legs) with ZERO steady-state recompiles (jaxmon census);
+interlink returns the EXACT pair set of a nested-loop f64 referee on 2D
+and XZ3 time-lifted legs; XZSFC.ranges is a superset cover of index()
+codes for random extended boxes.
+"""
+
+import json
+from io import BytesIO
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point, Polygon
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_600_000_000_000
+
+
+def _track_store(n=400, n_tracks=16, seed=7, heading=True, name="trk"):
+    ds = DataStore(backend="tpu")
+    spec = "track:String,dtg:Date,*geom:Point:srid=4326"
+    if heading:
+        spec = "track:String,heading:Double,dtg:Date,*geom:Point:srid=4326"
+    ds.create_schema(name, spec)
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        rec = {
+            "track": f"t{i % n_tracks}",
+            "dtg": T0 + i * 60_000,
+            "geom": Point(float(rng.uniform(-12, 12)),
+                          float(rng.uniform(-6, 6))),
+        }
+        if heading:
+            rec["heading"] = (None if i % 13 == 0
+                              else float(rng.uniform(0, 360)))
+        recs.append(rec)
+    ds.write(name, recs)
+    ds.compact(name)
+    return ds
+
+
+def _fids(table):
+    return sorted(str(f) for f in table.fids)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: vectorized track_label (output order + tie rule pinned)
+# ---------------------------------------------------------------------------
+
+class TestTrackLabel:
+    @staticmethod
+    def _legacy(table, track_field):
+        """The historical dict-loop, kept verbatim as the red/green
+        reference: latest time wins, ties keep the EARLIEST row."""
+        t = table.dtg_millis()
+        groups = table.columns[track_field].values
+        best = {}
+        for i, g in enumerate(groups.astype(object)):
+            j = best.get(g)
+            if j is None or t[i] > t[j]:
+                best[g] = i
+        return np.asarray(sorted(best.values()), dtype=np.int64)
+
+    def test_matches_legacy_loop(self):
+        from geomesa_tpu.process.tracks import track_label
+
+        ds = _track_store(300, n_tracks=11, seed=3, heading=False)
+        t = ds.query("trk", Query()).table
+        got = track_label(t, "track")
+        want = t.take(self._legacy(t, "track"))
+        assert list(got.fids) == list(want.fids)
+
+    def test_tie_keeps_earliest_row(self):
+        """Duplicate (track, time) rows: the legacy loop kept the first
+        row it saw — the vectorized reduction must pin the same rule."""
+        from geomesa_tpu.process.tracks import track_label
+        from geomesa_tpu.schema.columnar import FeatureTable
+        from geomesa_tpu.schema.sft import parse_spec
+
+        sft = parse_spec(
+            "ties", "track:String,dtg:Date,*geom:Point:srid=4326")
+        recs = [
+            {"track": "a", "dtg": T0 + 5, "geom": Point(0, 0)},
+            {"track": "a", "dtg": T0 + 9, "geom": Point(1, 0)},  # winner
+            {"track": "a", "dtg": T0 + 9, "geom": Point(2, 0)},  # later tie
+            {"track": "b", "dtg": T0 + 1, "geom": Point(3, 0)},
+            {"track": "b", "dtg": T0 + 1, "geom": Point(4, 0)},  # later tie
+        ]
+        t = FeatureTable.from_records(
+            sft, recs, fids=[f"f{i}" for i in range(len(recs))])
+        got = track_label(t, "track")
+        assert list(got.fids) == ["f1", "f3"]
+        assert list(got.fids) == list(t.take(self._legacy(t, "track")).fids)
+
+    def test_empty_table(self):
+        from geomesa_tpu.process.tracks import track_label
+
+        ds = _track_store(5, heading=False)
+        t = ds.query("trk", Query(filter="track = 'nope'")).table
+        assert len(track_label(t, "track")) == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: route_search NaN-heading mask
+# ---------------------------------------------------------------------------
+
+class TestRouteSearchHeadings:
+    def test_nan_heading_rows_never_aligned(self):
+        """A NaN heading must be explicitly not-aligned: rows spatially
+        inside the corridor but with a null/NaN heading are excluded,
+        while identical rows with an aligned heading match."""
+        from geomesa_tpu.process.tracks import route_search
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(
+            "rs", "heading:Double,dtg:Date,*geom:Point:srid=4326")
+        # route due-east (bearing 90); all rows on the route line
+        recs = [
+            {"heading": 90.0, "dtg": T0, "geom": Point(0.5, 0.0)},
+            {"heading": None, "dtg": T0, "geom": Point(1.0, 0.0)},
+            {"heading": float("nan"), "dtg": T0, "geom": Point(1.5, 0.0)},
+            {"heading": 270.0, "dtg": T0, "geom": Point(2.0, 0.0)},
+        ]
+        ds.write("rs", recs)
+        ds.compact("rs")
+        r = route_search(ds, "rs", [(0.0, 0.0), (3.0, 0.0)], 0.2,
+                         heading_field="heading", heading_tolerance_deg=30)
+        assert len(r) == 1
+        assert float(r.columns["heading"].values[0]) == 90.0
+        # bidirectional admits the reverse heading but still never NaN
+        r2 = route_search(ds, "rs", [(0.0, 0.0), (3.0, 0.0)], 0.2,
+                          heading_field="heading", heading_tolerance_deg=30,
+                          bidirectional=True)
+        assert len(r2) == 2
+
+
+# ---------------------------------------------------------------------------
+# Track state + batched per-entity aggregation
+# ---------------------------------------------------------------------------
+
+class TestTrackState:
+    def test_csr_layout_and_invariants(self):
+        from geomesa_tpu.trajectory.state import build_track_state
+
+        ds = _track_store(300, n_tracks=9, seed=11, heading=False)
+        st = build_track_state(ds, "trk", "track")
+        assert st.n_entities == 9
+        assert st.offsets[0] == 0 and st.offsets[-1] == st.n == 300
+        assert st.validate() == []
+        # per-entity rows are time-sorted and single-track
+        for e in range(st.n_entities):
+            lo, hi = st.offsets[e], st.offsets[e + 1]
+            assert np.all(np.diff(st.t_ms[lo:hi]) >= 0)
+            vals = st.table.columns["track"].values[lo:hi]
+            assert len(set(vals.astype(object))) == 1
+
+    def test_stats_parity_vs_host_referee(self):
+        from geomesa_tpu.trajectory.state import (
+            build_track_state, track_stats, track_stats_host)
+
+        ds = _track_store(500, n_tracks=20, seed=5, heading=False)
+        st = build_track_state(ds, "trk", "track")
+        dev = track_stats(ds, "trk", "track", state=st)
+        host = track_stats_host(st)
+        for k in ("length_deg", "duration_s", "avg_speed_deg_s",
+                  "heading_change_deg", "dwell_s"):
+            np.testing.assert_allclose(dev[k], host[k], rtol=5e-3, atol=1e-3)
+        for k in ("rows", "first_ms", "last_ms"):
+            assert list(dev[k]) == list(host[k])
+        # labels are the last row per entity
+        assert list(dev["last_fid"]) == [
+            str(st.table.fids[st.offsets[e + 1] - 1])
+            for e in range(st.n_entities)]
+
+    def test_dwell_counts_stationary_time(self):
+        from geomesa_tpu.trajectory.state import (
+            build_track_state, track_stats_host)
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema("dw", "track:String,dtg:Date,*geom:Point:srid=4326")
+        recs = (
+            [{"track": "a", "dtg": T0 + i * 1000, "geom": Point(1.0, 1.0)}
+             for i in range(5)]  # parked 4 s
+            + [{"track": "a", "dtg": T0 + 5000 + i * 1000,
+                "geom": Point(1.0 + 0.1 * (i + 1), 1.0)} for i in range(3)]
+        )
+        ds.write("dw", recs)
+        st = build_track_state(ds, "dw", "track")
+        host = track_stats_host(st)
+        assert host["dwell_s"][0] == pytest.approx(4.0)
+        assert host["duration_s"][0] == pytest.approx(7.0)
+
+    def test_epoch_invalidation_on_write(self):
+        from geomesa_tpu.trajectory import state as tstate
+
+        ds = _track_store(100, n_tracks=4, seed=2, heading=False)
+        st1 = tstate.get_track_state(ds, "trk", "track")
+        assert tstate.get_track_state(ds, "trk", "track") is st1  # cached
+        ds.write("trk", [{"track": "t0", "dtg": T0 + 10**9,
+                          "geom": Point(0, 0)}])  # delta write bumps epoch
+        st2 = tstate.get_track_state(ds, "trk", "track")
+        assert st2 is not st1
+        assert st2.n == st1.n + 1
+
+    def test_device_columns_register_in_ledger(self):
+        from geomesa_tpu.obs import devmon
+        from geomesa_tpu.trajectory.state import (
+            LEDGER_GROUP, build_track_state)
+
+        ds = _track_store(128, n_tracks=4, seed=9, heading=False)
+        st = build_track_state(ds, "trk", "track")
+        st.device_columns(pool=ds.backend.pool)
+        snap = devmon.ledger().snapshot()
+        groups = {g for idx in snap["resident"].get("trk", {}).values()
+                  for g in idx}
+        assert LEDGER_GROUP in groups
+        # eviction callback drops the device slot; next use restages
+        st._evict()
+        assert st.nbytes == 0
+        assert st.device_columns(pool=None)[0] is not None
+
+    def test_delete_recreate_never_serves_stale_state(self):
+        """Review pin: a recreated same-name type RESTARTS its (rebuild
+        epoch, delta version) tuple, so the cached state's epoch can
+        collide — delete_schema must purge cached track states."""
+        from geomesa_tpu.trajectory import state as tstate
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema("tt", "track:String,dtg:Date,*geom:Point:srid=4326")
+        ds.write("tt", [{"track": "old", "dtg": T0 + i,
+                         "geom": Point(0, 0)} for i in range(10)])
+        st1 = tstate.get_track_state(ds, "tt", "track")
+        assert list(st1.entities) == ["old"]
+        ds.delete_schema("tt")
+        ds.create_schema("tt", "track:String,dtg:Date,*geom:Point:srid=4326")
+        ds.write("tt", [{"track": "new", "dtg": T0 + i,
+                         "geom": Point(1, 1)} for i in range(10)])
+        st2 = tstate.get_track_state(ds, "tt", "track")
+        assert list(st2.entities) == ["new"]
+
+    def test_label_tie_rule_matches_track_label(self):
+        """Review pin: TRACK_STATS' last-position label resolves equal
+        (track, max-time) ties to the LOWEST original row — the same
+        rule the vectorized track_label pins — so the two label surfaces
+        can never disagree on the same table."""
+        from geomesa_tpu.process.tracks import track_label
+        from geomesa_tpu.trajectory.state import (
+            build_track_state, track_stats_host)
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema("tie", "track:String,dtg:Date,*geom:Point:srid=4326")
+        ds.write("tie", [
+            {"track": "a", "dtg": T0 + 9, "geom": Point(1, 0)},  # winner
+            {"track": "a", "dtg": T0 + 9, "geom": Point(2, 0)},  # later tie
+            {"track": "a", "dtg": T0 + 5, "geom": Point(0, 0)},
+        ], fids=["f0", "f1", "f2"])
+        st = build_track_state(ds, "tie", "track")
+        stats = track_stats_host(st)
+        t = ds.query("tie", Query()).table
+        assert list(stats["last_fid"]) == list(track_label(t, "track").fids)
+        assert list(stats["last_fid"]) == ["f0"]
+
+    def test_pool_keys_distinct_per_filter_and_auths(self):
+        """Review pin: concurrently-live states for the same (type,
+        field) but different filter/auths register under DISTINCT pool
+        keys — a shared key would let the pool replace the older entry
+        while its device columns stay alive unbudgeted."""
+        from geomesa_tpu.trajectory.state import TrackState
+
+        def key(filter_text="", auths=None):
+            st = TrackState.__new__(TrackState)
+            st.track_field = "track"
+            st.filter_text = filter_text
+            st.auths = None if auths is None else tuple(sorted(auths))
+            return st._pool_key()
+
+        long_a = "x = '" + "a" * 80 + "1'"
+        long_b = "x = '" + "a" * 80 + "2'"
+        keys = {key(), key(auths=[]), key(auths=["a"]),
+                key(long_a), key(long_b)}
+        assert len(keys) == 5
+
+    def test_auths_key_cached_states_apart(self):
+        """Review pin: auths are part of the cache key AND thread into
+        the scan — a restricted caller must never read an unrestricted
+        caller's cached rows."""
+        from geomesa_tpu.trajectory import state as tstate
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema(
+            "vt", "track:String,vis:String,dtg:Date,"
+            "*geom:Point:srid=4326;geomesa.vis.field='vis'")
+        ds.write("vt", [
+            {"track": "a", "vis": "", "dtg": T0, "geom": Point(0, 0)},
+            {"track": "b", "vis": "secret", "dtg": T0, "geom": Point(1, 1)},
+        ])
+        open_st = tstate.get_track_state(ds, "vt", "track", auths=None)
+        restricted = tstate.get_track_state(ds, "vt", "track", auths=[])
+        assert restricted is not open_st
+        assert list(restricted.entities) == ["a"]
+        assert set(open_st.entities) == {"a", "b"}
+
+    def test_sweeper_track_state_red_green(self):
+        from geomesa_tpu.obs import audit
+        from geomesa_tpu.trajectory.state import build_track_state
+
+        ds = _track_store(60, n_tracks=3, seed=4, heading=False)
+        st = build_track_state(ds, "trk", "track")
+        aud = audit.ContinuousAuditor(rate=0.0, autostart=False)
+        sweeper = audit.InvariantSweeper(auditor=aud)
+        sweeper.attach_track_state(st)
+        results = sweeper.sweep_once()
+        track = [r for r in results if r["check"] == "track_state"]
+        assert track and track[0]["violations"] == []
+        assert aud.passed.get("sweep:track_state", 0) == 1
+        # red: corrupt the time order inside an entity
+        st.t_ms = st.t_ms.copy()
+        lo, hi = int(st.offsets[0]), int(st.offsets[1])
+        assert hi - lo >= 2
+        st.t_ms[lo], st.t_ms[hi - 1] = st.t_ms[hi - 1], st.t_ms[lo]
+        results = sweeper.sweep_once()
+        track = [r for r in results if r["check"] == "track_state"]
+        assert track[0]["violations"]
+        assert aud.diverged.get("sweep:track_state", 0) == 1
+        # red: broken CSR
+        st2 = build_track_state(ds, "trk", "track")
+        st2.offsets = st2.offsets.copy()
+        st2.offsets[-1] += 1
+        assert any("offsets[-1]" in v for v in st2.validate())
+
+
+# ---------------------------------------------------------------------------
+# Corridor engine: randomized-grid parity vs the demoted host paths
+# ---------------------------------------------------------------------------
+
+class TestCorridor:
+    def test_tube_select_randomized_grid_parity(self):
+        """Device corridor path == host tube_select across a randomized
+        grid of tracks × buffers × time buffers."""
+        from geomesa_tpu.process.processes import tube_select as host_tube
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(450, n_tracks=18, seed=21, heading=False)
+        rng = np.random.default_rng(77)
+        for trial in range(6):
+            npts = int(rng.integers(2, 5))
+            xs = np.sort(rng.uniform(-11, 11, npts))
+            ys = rng.uniform(-5, 5, npts)
+            ts = np.sort(rng.integers(0, 450 * 60_000, npts)) + T0
+            track = [(float(x), float(y), int(t))
+                     for x, y, t in zip(xs, ys, ts)]
+            buf = float(rng.uniform(0.3, 3.0))
+            tb = int(rng.integers(1, 120)) * 60_000
+            dev = tube_select_device(ds, "trk", track, buf, tb)
+            host = host_tube(ds, "trk", track, buf, tb)
+            assert _fids(dev) == _fids(host), (trial, buf, tb)
+
+    def test_route_search_heading_legs_parity(self):
+        from geomesa_tpu.process.tracks import route_search as host_route
+        from geomesa_tpu.trajectory.corridor import route_search_device
+
+        ds = _track_store(400, n_tracks=10, seed=31, heading=True)
+        rng = np.random.default_rng(13)
+        for trial in range(4):
+            npts = int(rng.integers(2, 4))
+            route = [(float(x), float(y))
+                     for x, y in zip(np.sort(rng.uniform(-10, 10, npts)),
+                                     rng.uniform(-4, 4, npts))]
+            buf = float(rng.uniform(0.5, 2.5))
+            tol = float(rng.uniform(20, 90))
+            bidir = bool(trial % 2)
+            dev = route_search_device(
+                ds, "trk", route, buf, heading_field="heading",
+                heading_tolerance_deg=tol, bidirectional=bidir)
+            host = host_route(
+                ds, "trk", route, buf, heading_field="heading",
+                heading_tolerance_deg=tol, bidirectional=bidir)
+            assert _fids(dev) == _fids(host), (trial, buf, tol, bidir)
+
+    def test_batched_many_matches_singles_and_host_route(self):
+        from geomesa_tpu.trajectory.corridor import (
+            CorridorSpec, tube_select_many)
+
+        ds = _track_store(300, n_tracks=12, seed=41, heading=False)
+        specs = [
+            CorridorSpec.tube([(-8, -3, T0), (0, 0, T0 + 10**7),
+                               (8, 3, T0 + 2 * 10**7)], 1.2, 3_600_000),
+            CorridorSpec.tube([(-4, 4, T0 + 10**6),
+                               (6, -4, T0 + 10**7)], 0.8, 1_800_000),
+            CorridorSpec.route([(-10, 0), (10, 0)], 1.5),
+        ]
+        batched = tube_select_many(ds, "trk", specs)
+        host = tube_select_many(ds, "trk", specs, route="host")
+        dev = tube_select_many(ds, "trk", specs, route="device")
+        for b, h, d in zip(batched, host, dev):
+            assert _fids(b) == _fids(h) == _fids(d)
+
+    def test_zero_steady_state_recompiles(self):
+        """THE J003 pin: repeated corridor scans at steady bucket shapes
+        never recompile (jaxmon census), matching the subscription-matrix
+        contract."""
+        from geomesa_tpu.obs import jaxmon
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(350, n_tracks=8, seed=51, heading=False)
+        track = [(-8.0, -3.0, T0), (8.0, 3.0, T0 + 2 * 10**7)]
+        tube_select_device(ds, "trk", track, 1.0, 3_600_000,
+                           )  # warm: compiles the bucket's step
+        before = jaxmon.jit_report()
+        steps = [s for s in before["steps"] if s.startswith("corridor_")]
+        assert steps, before["steps"].keys()
+        for i in range(4):
+            shifted = [(x + 0.1 * i, y, t) for x, y, t in track]
+            tube_select_device(ds, "trk", shifted, 1.0 + 0.05 * i,
+                               3_600_000)
+        after = jaxmon.jit_report()
+        assert (after.get("recompiles", 0) - before.get("recompiles", 0)) == 0
+
+    def test_cost_model_routes_and_observes(self):
+        from geomesa_tpu.obs import devmon
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(200, n_tracks=6, seed=61, heading=False)
+        track = [(-5.0, -2.0, T0), (5.0, 2.0, T0 + 10**7)]
+        tube_select_device(ds, "trk", track, 1.0, 3_600_000)
+        snap = devmon.costs().snapshot()
+        sigs = {e["signature"] for e in snap.get("entries", [])
+                if e["type"] == "trk"}
+        assert any(s.startswith("traj:corridor-") for s in sigs), sigs
+
+    def test_empty_candidates(self):
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(50, n_tracks=2, seed=71, heading=False)
+        out = tube_select_device(
+            ds, "trk", [(100.0, 80.0, T0), (101.0, 81.0, T0 + 1000)],
+            0.1, 1000)
+        assert len(out) == 0
+
+    def test_mixed_batch_nan_headings_stay_in_unconstrained_corridors(self):
+        """Review pin: in a batch mixing heading-constrained and plain
+        corridors, rows with NaN/invalid headings must still match the
+        PLAIN corridors on the device route (the unconstrained-tolerance
+        sentinel is accepted explicitly — a finite stand-in silently
+        dropped them, because NaN compares False)."""
+        from geomesa_tpu.trajectory.corridor import (
+            CorridorSpec, tube_select_many)
+
+        ds = _track_store(250, n_tracks=8, seed=121, heading=True)
+        specs = [
+            CorridorSpec.route([(-10, 0), (10, 0)], 2.0,
+                               heading_tolerance_deg=40),
+            CorridorSpec.route([(-10, 0), (10, 0)], 2.0),  # unconstrained
+        ]
+        dev = tube_select_many(ds, "trk", specs, heading_field="heading",
+                               route="device")
+        host = tube_select_many(ds, "trk", specs, heading_field="heading",
+                                route="host")
+        assert _fids(dev[0]) == _fids(host[0])
+        assert _fids(dev[1]) == _fids(host[1])
+        # the unconstrained corridor must include NaN-heading rows the
+        # constrained one excludes (the store seeds nulls every 13th row)
+        t = dev[1]
+        h = t.columns["heading"]
+        nan_rows = (~h.is_valid()) | ~np.isfinite(
+            h.values.astype(np.float64))
+        assert nan_rows.any()
+
+
+# ---------------------------------------------------------------------------
+# Interlink: exact pair parity vs the nested-loop f64 referee
+# ---------------------------------------------------------------------------
+
+def _link_store(name, n, poly=False, seed=0, span_ms=86_400_000):
+    ds = DataStore(backend="tpu")
+    spec = "dtg:Date,*geom:" + ("Polygon" if poly else "Point") + ":srid=4326"
+    ds.create_schema(name, spec)
+    r = np.random.default_rng(seed)
+    recs = []
+    for _ in range(n):
+        x, y = float(r.uniform(-20, 20)), float(r.uniform(-10, 10))
+        if poly:
+            w, h = float(r.uniform(0.1, 2)), float(r.uniform(0.1, 2))
+            g = Polygon(np.array([[x, y], [x + w, y], [x + w, y + h],
+                                  [x, y + h], [x, y]]))
+        else:
+            g = Point(x, y)
+        recs.append({"dtg": T0 + int(r.integers(0, span_ms)), "geom": g})
+    ds.write(name, recs)
+    ds.compact(name)
+    return ds
+
+
+class TestInterlink:
+    @pytest.fixture(scope="class")
+    def stores(self):
+        return (_link_store("L", 100, poly=True, seed=1),
+                _link_store("R", 250, poly=False, seed=2))
+
+    def _tables(self, stores):
+        lds, rds = stores
+        return (lds.query("L", Query()).table, rds.query("R", Query()).table)
+
+    @pytest.mark.parametrize("pred,dist,tb", [
+        ("intersects", 0.0, None),
+        ("dwithin", 0.6, None),
+        ("intersects", 0.0, 3_600_000),  # XZ3 time-lifted
+        ("dwithin", 0.4, 7_200_000),  # XZ3 + distance
+    ])
+    def test_exact_pair_parity(self, stores, pred, dist, tb):
+        from geomesa_tpu.trajectory.interlink import (
+            interlink, interlink_referee)
+
+        lds, rds = stores
+        lt, rt = self._tables(stores)
+        live = interlink(lds, "L", rds, "R", pred=pred, distance=dist,
+                         time_buffer_ms=tb)
+        ref = interlink_referee(lt, rt, pred=pred, distance=dist,
+                                time_buffer_ms=tb)
+        assert live == ref
+        assert (len(live) > 0) or pred == "intersects"  # grids do link
+
+    def test_block_route_parity(self, stores):
+        """The blocked-device-join pairing (ops/join block kernels via
+        join_rows_device) returns the same exact pair set."""
+        from geomesa_tpu.trajectory.interlink import (
+            interlink, interlink_referee)
+
+        lds, rds = stores
+        lt, rt = self._tables(stores)
+        live = interlink(lds, "L", rds, "R", pred="intersects",
+                         route="block")
+        assert live == interlink_referee(lt, rt, pred="intersects")
+
+    def test_point_point_dwithin(self):
+        from geomesa_tpu.trajectory.interlink import (
+            interlink, interlink_referee)
+
+        a = _link_store("A", 120, seed=5)
+        b = _link_store("B", 120, seed=6)
+        at = a.query("A", Query()).table
+        bt = b.query("B", Query()).table
+        live = interlink(a, "A", b, "B", pred="dwithin", distance=1.0)
+        assert live == interlink_referee(at, bt, "dwithin", 1.0)
+        assert len(live) > 0
+
+    def test_unsupported_predicate_raises(self, stores):
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        lds, rds = stores
+        with pytest.raises(ValueError, match="unsupported predicate"):
+            interlink(lds, "L", rds, "R", pred="crosses")
+
+    def test_forced_block_route_refuses_unservable_constraints(self, stores):
+        """Review pin: a forced block route cannot apply rfilter/auths/
+        the time lift — it must refuse rather than silently widen."""
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        lds, rds = stores
+        for kw in ({"rfilter": "INCLUDE"}, {"auths": []},
+                   {"time_buffer_ms": 1000}):
+            with pytest.raises(ValueError, match="route='block'"):
+                interlink(lds, "L", rds, "R", route="block", **kw)
+
+    def test_link_members_federated(self):
+        from geomesa_tpu.store.merged import MergedDataStoreView
+        from geomesa_tpu.trajectory.interlink import (
+            interlink_referee, link_members)
+
+        a = _link_store("evt", 80, seed=8)
+        b = _link_store("evt", 80, seed=9)
+        view = MergedDataStoreView([a, b])
+        at = a.query("evt", Query()).table
+        bt = b.query("evt", Query()).table
+        pairs = link_members(view, 0, "evt", 1, pred="dwithin",
+                             distance=0.8)
+        assert pairs == interlink_referee(at, bt, "dwithin", 0.8)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: XZ curve coverage (property + lenient edge cases)
+# ---------------------------------------------------------------------------
+
+class TestXZCurves:
+    def test_ranges_superset_of_index_2d(self):
+        """For random extended boxes and random query windows: every box
+        INTERSECTING the window has its sequence code inside some range
+        of the window's cover — the XZ soundness contract the interlink
+        pruning and the xz index scans both lean on."""
+        from geomesa_tpu.curve.xz import xz2_sfc
+
+        sfc = xz2_sfc(12)
+        rng = np.random.default_rng(17)
+        n = 300
+        x1 = rng.uniform(-179, 178, n)
+        y1 = rng.uniform(-89, 88, n)
+        w = rng.exponential(1.0, n)
+        h = rng.exponential(1.0, n)
+        x2 = np.minimum(x1 + w, 180.0)
+        y2 = np.minimum(y1 + h, 90.0)
+        codes = sfc.index((x1, y1), (x2, y2))
+        for _ in range(12):
+            qx1, qy1 = rng.uniform(-170, 150), rng.uniform(-80, 70)
+            qx2 = qx1 + rng.uniform(0.5, 25)
+            qy2 = qy1 + rng.uniform(0.5, 15)
+            ranges = sfc.ranges([((qx1, qy1), (qx2, qy2))])
+            hits = (x2 >= qx1) & (x1 <= qx2) & (y2 >= qy1) & (y1 <= qy2)
+            for c in codes[hits]:
+                assert np.any((ranges[:, 0] <= c) & (c <= ranges[:, 1])), (
+                    f"code {c} of an intersecting box not covered")
+
+    def test_ranges_superset_of_index_3d_time_lifted(self):
+        from geomesa_tpu.curve.xz import XZSFC
+
+        sfc = XZSFC(g=10, dims=3, mins=(-180.0, -90.0, 0.0),
+                    maxs=(180.0, 90.0, 1000.0))
+        rng = np.random.default_rng(23)
+        n = 200
+        x1 = rng.uniform(-170, 160, n)
+        y1 = rng.uniform(-85, 80, n)
+        t = rng.uniform(0, 1000, n)
+        x2 = np.minimum(x1 + rng.exponential(0.8, n), 180.0)
+        y2 = np.minimum(y1 + rng.exponential(0.8, n), 90.0)
+        codes = sfc.index((x1, y1, t), (x2, y2, t))
+        for _ in range(8):
+            qx1, qy1 = rng.uniform(-160, 120), rng.uniform(-75, 55)
+            qt1 = rng.uniform(0, 900)
+            win = ((qx1, qy1, qt1),
+                   (qx1 + rng.uniform(1, 30), qy1 + rng.uniform(1, 20),
+                    qt1 + rng.uniform(10, 100)))
+            ranges = sfc.ranges([win])
+            (wlo, whi) = win
+            hits = ((x2 >= wlo[0]) & (x1 <= whi[0])
+                    & (y2 >= wlo[1]) & (y1 <= whi[1])
+                    & (t >= wlo[2]) & (t <= whi[2]))
+            for c in codes[hits]:
+                assert np.any((ranges[:, 0] <= c) & (c <= ranges[:, 1]))
+
+    def test_lenient_normalization_clamps(self):
+        """Out-of-domain boxes clamp per dim (the lenient contract): a
+        box hanging past the antimeridian/domain edge indexes like its
+        clamped self, and degenerate (point) boxes get full depth."""
+        from geomesa_tpu.curve.xz import xz2_sfc
+
+        sfc = xz2_sfc(12)
+        over = sfc.index(([-200.0], [-95.0]), ([200.0], [95.0]))
+        clamped = sfc.index(([-180.0], [-90.0]), ([180.0], [90.0]))
+        assert over[0] == clamped[0]
+        # a point box never exceeds max_code and sits at full depth
+        pt = sfc.index(([10.0], [10.0]), ([10.0], [10.0]))
+        assert 0 <= int(pt[0]) < sfc.max_code
+        edge = sfc.index(([180.0], [90.0]), ([180.0], [90.0]))
+        assert 0 <= int(edge[0]) < sfc.max_code
+        # lenient windows clamp the same way: full-domain cover contains
+        # every index code
+        ranges = sfc.ranges([((-999.0, -999.0), (999.0, 999.0))])
+        for c in (over[0], pt[0], edge[0]):
+            assert np.any((ranges[:, 0] <= int(c)) & (int(c) <= ranges[:, 1]))
+
+    def test_point_and_extended_codes_stay_in_domain(self):
+        from geomesa_tpu.curve.xz import xz2_sfc
+
+        sfc = xz2_sfc(12)
+        rng = np.random.default_rng(29)
+        x1 = rng.uniform(-180, 179, 500)
+        y1 = rng.uniform(-90, 89, 500)
+        x2 = np.minimum(x1 + rng.exponential(2.0, 500), 180.0)
+        y2 = np.minimum(y1 + rng.exponential(2.0, 500), 90.0)
+        codes = sfc.index((x1, y1), (x2, y2))
+        assert np.all(codes < sfc.max_code)
+
+
+# ---------------------------------------------------------------------------
+# SQL + HTTP surfaces
+# ---------------------------------------------------------------------------
+
+class TestSqlSurface:
+    @pytest.fixture(scope="class")
+    def ds(self):
+        ds = _track_store(200, n_tracks=5, seed=81, heading=False)
+        z = np.random.default_rng(82)
+        ds.create_schema("zones", "dtg:Date,*geom:Point:srid=4326")
+        ds.write("zones", [
+            {"dtg": T0 + int(z.integers(0, 10**7)),
+             "geom": Point(float(z.uniform(-10, 10)),
+                           float(z.uniform(-5, 5)))}
+            for _ in range(40)])
+        ds.compact("zones")
+        return ds
+
+    def test_tube_select_fn(self, ds):
+        from geomesa_tpu.sql.engine import sql
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        stmt = (f"SELECT * FROM TUBE_SELECT('trk', "
+                f"'-8 -3 {T0}, 8 3 {T0 + 2 * 10**7}', 1.5, 3600000)")
+        r = sql(ds, stmt)
+        want = tube_select_device(
+            ds, "trk", [(-8, -3, T0), (8, 3, T0 + 2 * 10**7)],
+            1.5, 3_600_000)
+        assert sorted(r.columns["__fid__"]) == _fids(want)
+
+    def test_track_stats_fn(self, ds):
+        from geomesa_tpu.sql.engine import sql
+
+        r = sql(ds, "SELECT * FROM TRACK_STATS('trk', 'track')")
+        assert len(r) == 5
+        assert "length_deg" in r.columns and "avg_speed_deg_s" in r.columns
+        r2 = sql(ds, "SELECT * FROM TRACK_STATS('trk', 'track') LIMIT 2")
+        assert len(r2) == 2
+
+    def test_st_link_fn(self, ds):
+        from geomesa_tpu.sql.engine import sql
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        r = sql(ds, "SELECT * FROM ST_LINK('trk', 'zones', 'dwithin', 0.5)")
+        want = interlink(ds, "trk", ds, "zones", pred="dwithin",
+                         distance=0.5)
+        assert list(zip(r.columns["left_fid"], r.columns["right_fid"])) \
+            == want
+
+    def test_bad_args_raise(self, ds):
+        from geomesa_tpu.sql.engine import SqlError, sql
+
+        with pytest.raises(SqlError):
+            sql(ds, "SELECT * FROM TUBE_SELECT('trk')")
+        with pytest.raises(SqlError):
+            sql(ds, "SELECT * FROM TUBE_SELECT('trk', 'x y', 1.0, 10)")
+
+    def test_plain_sql_still_parses(self, ds):
+        from geomesa_tpu.sql.engine import sql
+
+        r = sql(ds, "SELECT track, COUNT(*) AS n FROM trk GROUP BY track")
+        assert len(r) == 5
+
+
+class TestWebSurface:
+    @pytest.fixture(scope="class")
+    def app(self):
+        from geomesa_tpu.web.app import GeoMesaApp
+
+        ds = _track_store(150, n_tracks=4, seed=91, heading=False)
+        z = np.random.default_rng(92)
+        ds.create_schema("zones", "dtg:Date,*geom:Point:srid=4326")
+        ds.write("zones", [
+            {"dtg": T0 + int(z.integers(0, 10**7)),
+             "geom": Point(float(z.uniform(-10, 10)),
+                           float(z.uniform(-5, 5)))}
+            for _ in range(30)])
+        ds.compact("zones")
+        return GeoMesaApp(ds, coalesce_ms=0)
+
+    def _post(self, app, path, body):
+        raw = json.dumps(body).encode()
+        env = {"REQUEST_METHOD": "POST", "PATH_INFO": path,
+               "QUERY_STRING": "", "CONTENT_LENGTH": str(len(raw)),
+               "wsgi.input": BytesIO(raw)}
+        out = {}
+
+        def sr(status, headers):
+            out["status"] = int(status.split()[0])
+
+        payload = b"".join(app(env, sr))
+        return out["status"], payload
+
+    def test_tube_select_endpoint(self, app):
+        s, b = self._post(app, "/api/schemas/trk/tube-select", {
+            "track": [[-8, -3, T0], [8, 3, T0 + 2 * 10**7]],
+            "buffer_deg": 1.5, "time_buffer_ms": 3_600_000})
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["type"] == "FeatureCollection"
+
+    def test_track_stats_endpoint(self, app):
+        s, b = self._post(app, "/api/schemas/trk/track-stats",
+                          {"track_field": "track"})
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["entities"] == 4
+        assert len(doc["columns"]["length_deg"]) == 4
+
+    def test_link_endpoint(self, app):
+        s, b = self._post(app, "/api/link", {
+            "left": "trk", "right": "zones", "pred": "dwithin",
+            "distance": 0.5})
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["count"] == len(doc["pairs"])
+
+    def test_bad_bodies_400(self, app):
+        assert self._post(app, "/api/schemas/trk/tube-select", {})[0] == 400
+        assert self._post(app, "/api/schemas/trk/track-stats", {})[0] == 400
+        assert self._post(app, "/api/link", {"left": "trk"})[0] == 400
+
+    def test_admission_covers_trajectory_routes(self):
+        from geomesa_tpu.web.app import _ADMISSION_ROUTES
+
+        assert {"_tube_select", "_track_stats", "_link"} \
+            <= _ADMISSION_ROUTES
+
+
+# ---------------------------------------------------------------------------
+# Audit-plane wiring (satellite 6)
+# ---------------------------------------------------------------------------
+
+class TestAuditWiring:
+    @pytest.fixture()
+    def auditor(self):
+        from geomesa_tpu.obs import audit
+
+        aud = audit.ContinuousAuditor(rate=1.0, autostart=False)
+        prev = audit.install(aud)
+        yield aud
+        audit.install(prev)
+        audit.set_rate(0.0)
+
+    def test_corridor_shadow_check_passes(self, auditor):
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(150, n_tracks=5, seed=101, heading=False)
+        tube_select_device(
+            ds, "trk", [(-6.0, -2.0, T0), (6.0, 2.0, T0 + 10**7)],
+            1.0, 3_600_000)
+        assert auditor.checked.get("corridor", 0) >= 1
+        assert auditor.diverged.get("corridor", 0) == 0
+        assert auditor.passed.get("corridor", 0) >= 1
+
+    def test_interlink_shadow_check_passes(self, auditor):
+        from geomesa_tpu.trajectory.interlink import interlink
+
+        a = _link_store("A", 60, seed=15)
+        b = _link_store("B", 60, seed=16)
+        interlink(a, "A", b, "B", pred="dwithin", distance=0.8,
+                  route="xz")
+        assert auditor.checked.get("interlink", 0) >= 1
+        assert auditor.diverged.get("interlink", 0) == 0
+
+    def test_note_check_divergence_raises_anomaly(self, auditor):
+        from geomesa_tpu.obs import flight
+
+        prev = flight.install(flight.FlightRecorder(dump_dir=None))
+        try:
+            auditor.note_check("corridor", False, type_name="trk",
+                               detail="live=1 referee=2 rows")
+            assert auditor.diverged.get("corridor", 0) == 1
+            assert len(auditor.divergences) == 1
+            recs = flight.get().snapshot(limit=8)["records"]
+            assert any(flight.A_DIVERGE in (r.get("anomalies") or ())
+                       or "diverge" in str(r.get("anomalies", "")).lower()
+                       for r in recs)
+        finally:
+            flight.install(prev)
+
+    def test_prometheus_exposes_new_kinds(self, auditor):
+        auditor.note_check("corridor", True)
+        auditor.note_check("interlink", True, abstain=True)
+        text = auditor.prometheus_text()
+        assert 'geomesa_audit_passed_total{kind="corridor"} 1' in text
+        assert 'geomesa_audit_abstained_total{kind="interlink"} 1' in text
+
+    def test_shadow_traffic_trains_nothing(self, auditor):
+        """The corridor audit's referee runs inside audit.shadow(): the
+        traj:* cost profiles must see exactly ONE live observation, and
+        the shadow tube_select query must not add a second."""
+        from geomesa_tpu.obs import devmon
+        from geomesa_tpu.trajectory.corridor import tube_select_device
+
+        ds = _track_store(100, n_tracks=4, seed=111, heading=False)
+        tube_select_device(
+            ds, "trk", [(-5.0, -2.0, T0), (5.0, 2.0, T0 + 10**7)],
+            1.0, 3_600_000)
+        snap = devmon.costs().snapshot()
+        traj = [e for e in snap.get("entries", [])
+                if e["type"] == "trk"
+                and e["signature"].startswith("traj:")]
+        assert sum(e.get("count", 0) for e in traj) == 1
