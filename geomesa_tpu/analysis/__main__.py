@@ -1,9 +1,20 @@
 """tpulint CLI: ``python -m geomesa_tpu.analysis [paths...]``.
 
+Three prongs share this entry point: the per-module lint rules
+(default), ``--race`` (tpurace R001-R003), and ``--flow`` (tpuflow
+F001-F003 over the contract registry); ``--all-prongs`` runs all three
+in one invocation and, with ``--format sarif``, emits one log with one
+run per prong.
+
 Exit codes: 0 = clean against waivers+baseline, 1 = new violations,
-2 = usage error. Set ``GEOMESA_TPU_NO_JAX=1`` to keep the parent
+2 = usage error, 3 = the analysis itself crashed (a crash must never
+read as a clean run). Set ``GEOMESA_TPU_NO_JAX=1`` to keep the parent
 package import JAX-free (scripts/lint.sh does) — linting itself never
 imports JAX or any linted module.
+
+``--changed-only`` reuses content-hash caches under ``.tpulint-cache/``
+(unchanged files/trees skip re-analysis); ``--full`` forces a fresh run
+while still refreshing the caches.
 """
 
 from __future__ import annotations
@@ -13,13 +24,21 @@ import os
 import sys
 
 from geomesa_tpu.analysis.core import (
+    AnalysisCrash,
     LintConfig,
     apply_baseline,
     lint_paths,
     load_baseline,
     write_baseline,
 )
-from geomesa_tpu.analysis.report import render_json, render_text
+from geomesa_tpu.analysis.report import (
+    render_json,
+    render_json_multi,
+    render_text,
+)
+
+_RACE_IDS = frozenset({"R001", "R002", "R003"})
+_FLOW_IDS = frozenset({"F001", "F002", "F003"})
 
 
 def default_target() -> str:
@@ -27,12 +46,13 @@ def default_target() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def main(argv: list[str] | None = None) -> int:
+def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m geomesa_tpu.analysis",
         description="tpulint: JAX/Pallas-aware static analysis for "
                     "geomesa_tpu (rules J001-J004, C001, W001; "
-                    "--race runs the tpurace rules R001-R003).",
+                    "--race runs the tpurace rules R001-R003; --flow "
+                    "runs the tpuflow contract rules F001-F003).",
     )
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint "
@@ -43,14 +63,35 @@ def main(argv: list[str] | None = None) -> int:
                              "lock-order cycles, R003 blocking under a "
                              "hot-path lock) instead of the per-module "
                              "lint rules")
+    parser.add_argument("--flow", action="store_true",
+                        help="run the whole-program tpuflow contract "
+                             "analysis (F001 epoch/invalidation coherence, "
+                             "F002 shadow-plane taint, F003 two-band f64 "
+                             "discipline)")
+    parser.add_argument("--all-prongs", action="store_true",
+                        help="run lint + race + flow in one invocation "
+                             "(with --format sarif: one log, one run per "
+                             "prong)")
     parser.add_argument("--guards", action="store_true",
                         help="with --race: print the inferred guard map "
                              "(which lock protects which field) and exit")
+    parser.add_argument("--contracts", action="store_true",
+                        help="with --flow: print the declared contract "
+                             "inventory (cache surfaces, mutations, "
+                             "feedback sinks, shadow roots/guards, device "
+                             "bands) and exit")
     parser.add_argument("--baseline", metavar="FILE",
                         help="baseline JSON; matching violations don't fail")
     parser.add_argument("--write-baseline", action="store_true",
                         help="rewrite --baseline with current violations "
                              "and exit 0")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="reuse .tpulint-cache/ content-hash caches; "
+                             "unchanged files (lint) and unchanged trees "
+                             "(race/flow) skip re-analysis")
+    parser.add_argument("--full", action="store_true",
+                        help="ignore caches and re-analyze everything "
+                             "(still refreshes the caches)")
     parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text",
                         help="'json' and 'sarif' both emit SARIF 2.1.0")
@@ -59,7 +100,92 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--verbose", action="store_true",
                         help="also list waived/baselined violations")
     parser.add_argument("--list-rules", action="store_true")
-    args = parser.parse_args(argv)
+    return parser
+
+
+def _validate_rules(args, config: LintConfig) -> int | None:
+    """Reject --rules selections that are vacuous in the chosen mode (a
+    misconfigured CI gate must not read as clean forever)."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    unknown = set(config.rules) - set(all_rules())
+    if unknown:
+        print(f"tpulint: unknown rule ids: {sorted(unknown)}",
+              file=sys.stderr)
+        return 2
+    requested = set(config.rules)
+    if requested == {"W001"}:
+        # W001 judges waivers against the OTHER rules that ran; alone
+        # it can never emit anything — another vacuous-always-pass
+        print("tpulint: --rules W001 alone judges nothing — select "
+              "the rules whose waivers it should check too",
+              file=sys.stderr)
+        return 2
+    if args.all_prongs:
+        return None  # every registered rule runs in one prong or another
+    if args.race and not requested & (_RACE_IDS | {"W001"}):
+        print(f"tpulint: --race with --rules {args.rules} selects no "
+              f"race rule (R001/R002/R003/W001)", file=sys.stderr)
+        return 2
+    if args.flow and not requested & (_FLOW_IDS | {"W001"}):
+        print(f"tpulint: --flow with --rules {args.rules} selects no "
+              f"flow rule (F001/F002/F003/W001)", file=sys.stderr)
+        return 2
+    if not args.race and not args.flow:
+        if requested <= _RACE_IDS:
+            print(f"tpulint: {args.rules} are whole-program race rules — "
+                  f"pass --race to run them", file=sys.stderr)
+            return 2
+        if requested <= _FLOW_IDS:
+            print(f"tpulint: {args.rules} are whole-program flow rules — "
+                  f"pass --flow to run them", file=sys.stderr)
+            return 2
+        if requested <= (_RACE_IDS | _FLOW_IDS):
+            print(f"tpulint: {args.rules} mixes race and flow rules — "
+                  f"pass --race/--flow (or --all-prongs)", file=sys.stderr)
+            return 2
+    return None
+
+
+def _analyze(args, config: LintConfig, paths: list[str]):
+    """(prong_name, violations) pairs for the selected mode(s), routed
+    through the incremental caches when --changed-only asked for them."""
+    from geomesa_tpu.analysis.flow import analyze_flow_paths
+    from geomesa_tpu.analysis.incremental import (
+        analyze_whole_cached,
+        lint_paths_cached,
+    )
+    from geomesa_tpu.analysis.race import analyze_race_paths
+
+    use_cache = args.changed_only and not args.full
+    caching = args.changed_only or args.full
+
+    def run_lint():
+        if caching:
+            return lint_paths_cached(paths, config, use_cache=use_cache)
+        return lint_paths(paths, config)
+
+    def run_whole(mode, fn):
+        if caching:
+            return analyze_whole_cached(mode, fn, paths, config,
+                                        use_cache=use_cache)
+        return fn(paths, config)
+
+    if args.all_prongs:
+        return [
+            ("tpulint", run_lint()),
+            ("tpurace", run_whole("race", analyze_race_paths)),
+            ("tpuflow", run_whole("flow", analyze_flow_paths)),
+        ]
+    if args.race:
+        return [("tpurace", run_whole("race", analyze_race_paths))]
+    if args.flow:
+        return [("tpuflow", run_whole("flow", analyze_flow_paths))]
+    return [("tpulint", run_lint())]
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
 
     if args.list_rules:
         from geomesa_tpu.analysis.rules import all_rules
@@ -77,33 +203,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
     if config.rules is not None:
-        from geomesa_tpu.analysis.rules import all_rules as _all_rules
-
-        unknown = set(config.rules) - set(_all_rules())
-        if unknown:
-            print(f"tpulint: unknown rule ids: {sorted(unknown)}",
-                  file=sys.stderr)
-            return 2
-        # a --rules set that selects NOTHING in the chosen mode must be a
-        # usage error, not a vacuous exit 0 (a misconfigured CI gate would
-        # read as clean forever)
-        race_ids = {"R001", "R002", "R003"}
-        requested = set(config.rules)
-        if requested == {"W001"}:
-            # W001 judges waivers against the OTHER rules that ran; alone
-            # it can never emit anything — another vacuous-always-pass
-            print("tpulint: --rules W001 alone judges nothing — select "
-                  "the rules whose waivers it should check too",
-                  file=sys.stderr)
-            return 2
-        if args.race and not requested & (race_ids | {"W001"}):
-            print(f"tpulint: --race with --rules {args.rules} selects no "
-                  f"race rule (R001/R002/R003/W001)", file=sys.stderr)
-            return 2
-        if not args.race and requested <= race_ids:
-            print(f"tpulint: {args.rules} are whole-program race rules — "
-                  f"pass --race to run them", file=sys.stderr)
-            return 2
+        rc = _validate_rules(args, config)
+        if rc is not None:
+            return rc
 
     if args.guards:
         if not args.race:
@@ -117,21 +219,48 @@ def main(argv: list[str] | None = None) -> int:
 
         # (unknown --rules ids were already rejected above)
         modules, errors = load_modules(paths)
-        for e in errors:  # a skipped module would silently shrink the map
+        for e in errors:
             print(f"tpulint: {e.path}:{e.line}: {e.message}",
                   file=sys.stderr)
         print(json.dumps(guard_map(modules, config), indent=1))
-        return 0
-    try:
-        if args.race:
-            from geomesa_tpu.analysis.race import analyze_race_paths
+        # a parse failure silently shrinks the map: that is an incomplete
+        # analysis, not a clean one — it must not exit 0
+        return 1 if errors else 0
 
-            violations = analyze_race_paths(paths, config)
-        else:
-            violations = lint_paths(paths, config)
+    if args.contracts:
+        if not args.flow:
+            print("tpulint: --contracts requires --flow (the inventory is "
+                  "a tpuflow view)", file=sys.stderr)
+            return 2
+        import json
+
+        from geomesa_tpu.analysis.flow import contract_inventory
+        from geomesa_tpu.analysis.race.lockset import load_modules
+
+        modules, errors = load_modules(paths)
+        for e in errors:
+            print(f"tpulint: {e.path}:{e.line}: {e.message}",
+                  file=sys.stderr)
+        print(json.dumps(contract_inventory(modules, config), indent=1))
+        return 1 if errors else 0
+
+    try:
+        prong_runs = _analyze(args, config, paths)
     except ValueError as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
+    except AnalysisCrash as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 3
+    except Exception as e:
+        # any other mid-analysis crash (an ImportError under
+        # GEOMESA_TPU_NO_JAX=1, a bug in a whole-program pass) must exit
+        # loudly — never as a clean empty report
+        print(f"tpulint: internal error during analysis: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 3
+
+    violations = [v for _, vs in prong_runs for v in vs]
 
     if args.write_baseline:
         if not args.baseline:
@@ -145,10 +274,15 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.baseline:
-        apply_baseline(violations, load_baseline(args.baseline))
+        baseline = load_baseline(args.baseline)
+        for _, vs in prong_runs:
+            apply_baseline(vs, baseline)
 
     if args.format in ("json", "sarif"):
-        print(render_json(violations))
+        if len(prong_runs) > 1:
+            print(render_json_multi(prong_runs))
+        else:
+            print(render_json(violations))
     else:
         print(render_text(violations, verbose=args.verbose))
     return 0 if all(v.suppressed for v in violations) else 1

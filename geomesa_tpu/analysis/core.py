@@ -41,7 +41,22 @@ __all__ = [
     "Violation", "LintConfig", "Module", "lint_source", "lint_paths",
     "load_baseline", "write_baseline", "apply_baseline", "iter_py_files",
     "parse_module", "waiver_map", "stale_waiver_violations",
+    "finalize_module_violations", "AnalysisCrash",
 ]
+
+
+class AnalysisCrash(Exception):
+    """A rule/prong crashed mid-analysis. The CLI turns this into exit
+    code 3 NAMING the failing file — a crash must never read as a clean
+    "0 findings" run (a silently-skipped file is an unlinted file)."""
+
+    def __init__(self, path: str, where: str, cause: BaseException):
+        self.path = path
+        self.where = where
+        self.cause = cause
+        super().__init__(
+            f"analysis crashed in {where} while processing {path}: "
+            f"{type(cause).__name__}: {cause}")
 
 
 @dataclass
@@ -112,10 +127,12 @@ class Module:
         return ""
 
 
-# Both prongs share one waiver namespace — ``# tpulint:`` and
-# ``# tpurace:`` are interchangeable spellings of the same suppression.
+# All three prongs share one waiver namespace — ``# tpulint:``,
+# ``# tpurace:``, and ``# tpuflow:`` are interchangeable spellings of the
+# same suppression (intent stays greppable per prong; W001 judges them
+# all through this single tokenizer).
 _WAIVER = re.compile(
-    r"#\s*tpu(?:lint|race):\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"#\s*tpu(?:lint|race|flow):\s*disable(?P<next>-next-line)?\s*=\s*"
     r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)"
 )
 
@@ -218,6 +235,33 @@ def stale_waiver_violations(
     return out
 
 
+def finalize_module_violations(
+    mod: Module,
+    violations: list[Violation],
+    judged_ids: set[str],
+    emit_w001: bool = True,
+) -> list[Violation]:
+    """The one waiver-finalization pass every prong shares: tokenize the
+    module's waiver comments ONCE, append W001 stale-waiver findings
+    (judged against ``judged_ids`` — the rules that actually ran), fill
+    snippets, and mark waived violations. Returns the W001 findings it
+    appended (already in ``violations``' final state for waiver marking).
+
+    tpulint, tpurace, and tpuflow all route through here so the three
+    prongs cannot drift on waiver syntax or staleness semantics."""
+    comments = waiver_comments(mod.lines)
+    stale: list[Violation] = []
+    if emit_w001:
+        stale = stale_waiver_violations(
+            mod.lines, violations, judged_ids, mod.path, comments)
+        violations.extend(stale)
+    for v in violations:
+        if not v.snippet:
+            v.snippet = mod.snippet(v.line)
+    apply_waivers(violations, mod.lines, comments)
+    return stale
+
+
 def package_relpath(path: str) -> str:
     """Path relative to the geomesa_tpu package root, for rule scoping.
     Files outside the package keep their basename-ish path (path-scoped
@@ -270,21 +314,20 @@ def lint_source(
     violations: list[Violation] = []
     rules = active_rules(config)
     for rule in rules:
-        violations.extend(rule.check(mod, config))
+        try:
+            violations.extend(rule.check(mod, config))
+        except Exception as e:
+            raise AnalysisCrash(path, f"rule {rule.id}", e) from e
     # W001 judges only the single-module rules that actually ran here; the
-    # whole-program race rules (project=True) are judged by the race driver
-    comments = waiver_comments(mod.lines)
-    if config.rules is None or "W001" in config.rules:
-        judged = {
-            r.id for r in rules
-            if not getattr(r, "project", False) and r.id != "W001"
-        }
-        violations.extend(stale_waiver_violations(
-            mod.lines, violations, judged, path, comments))
-    for v in violations:
-        if not v.snippet:
-            v.snippet = mod.snippet(v.line)
-    apply_waivers(violations, mod.lines, comments)
+    # whole-program race/flow rules (project=True) are judged by their own
+    # drivers through the same finalize pass
+    judged = {
+        r.id for r in rules
+        if not getattr(r, "project", False) and r.id != "W001"
+    }
+    finalize_module_violations(
+        mod, violations, judged,
+        emit_w001=config.rules is None or "W001" in config.rules)
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations
 

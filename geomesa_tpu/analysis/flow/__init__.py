@@ -1,0 +1,19 @@
+"""tpuflow — the contract-driven whole-program dataflow prong.
+
+``python -m geomesa_tpu.analysis --flow`` is the CLI spelling;
+:mod:`geomesa_tpu.analysis.contracts` is the declaration vocabulary the
+live code imports; :mod:`geomesa_tpu.analysis.flow.rules` documents the
+F001/F002/F003 rule families."""
+
+from geomesa_tpu.analysis.flow.rules import (
+    FLOW_RULE_IDS,
+    active_flow_rules,
+    analyze_flow_modules,
+    analyze_flow_paths,
+    contract_inventory,
+)
+
+__all__ = [
+    "FLOW_RULE_IDS", "active_flow_rules", "analyze_flow_modules",
+    "analyze_flow_paths", "contract_inventory",
+]

@@ -1,0 +1,313 @@
+"""Pure-AST extraction of :mod:`geomesa_tpu.analysis.contracts` markers.
+
+The live code imports the (no-op) decorators; this scanner reads them
+back OFF THE AST — decorated modules are parsed, never imported, so the
+flow prong keeps tpulint's no-JAX/no-sibling-import layering contract.
+Decorator spellings canonicalize through each module's :class:`ImportMap`
+(``@contracts.cache_surface`` and ``from ... import cache_surface`` are
+the same marker), and every argument must be a literal — a computed
+contract cannot be checked statically and is itself an F001 finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from geomesa_tpu.analysis.contracts import DEATH_KINDS, MUTATION_KINDS
+from geomesa_tpu.analysis.core import Module, Violation
+from geomesa_tpu.analysis.race.lockset import _module_id
+
+__all__ = ["Contracts", "scan_contracts", "DEATH_KINDS", "MUTATION_KINDS"]
+
+_NS = "geomesa_tpu.analysis.contracts."
+
+
+@dataclass
+class CacheSurface:
+    name: str
+    keyed_by: str
+    epoch: str | None
+    purge: tuple[str, ...]
+    immutable: bool
+    owner: str                      # human label ("QueryCache", "mod:fn")
+    owner_class: str | None         # project-keyed class name, if a class
+    module: Module
+    line: int
+    purge_keys: list = field(default_factory=list)  # resolved summary keys
+
+
+@dataclass
+class MutationDecl:
+    kind: str
+    invalidates: tuple[str, ...]
+    key: tuple                      # summary key of the decorated function
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class FnDecl:
+    """A bare function marker: sink / shadow guard."""
+
+    key: tuple
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class ShadowRoot:
+    keys: tuple                     # every entry key (all methods, for a class)
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class BandDecl:
+    key: tuple
+    label: str
+    certain: bool
+    cand: bool
+    refine: bool
+    module: Module
+    line: int
+
+
+@dataclass
+class Contracts:
+    surfaces: list[CacheSurface] = field(default_factory=list)
+    mutations: list[MutationDecl] = field(default_factory=list)
+    sinks: list[FnDecl] = field(default_factory=list)
+    shadow_roots: list[ShadowRoot] = field(default_factory=list)
+    guards: list[FnDecl] = field(default_factory=list)
+    bands: list[BandDecl] = field(default_factory=list)
+    # malformed declarations (non-literal args, unknown kinds) — F001
+    errors: list[Violation] = field(default_factory=list)
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return _BAD
+
+
+_BAD = object()
+
+
+def _kwargs(call: ast.Call, module: Module, out: Contracts) -> dict | None:
+    kw = {}
+    for k in call.keywords:
+        if k.arg is None:
+            out.errors.append(_decl_error(
+                module, call, "contract arguments cannot be **-splatted"))
+            return None
+        v = _literal(k.value)
+        if v is _BAD:
+            out.errors.append(_decl_error(
+                module, call,
+                f"contract argument {k.arg!r} must be a literal "
+                f"(a computed contract cannot be checked statically)"))
+            return None
+        kw[k.arg] = v
+    return kw
+
+
+def _decl_error(module: Module, node: ast.AST, msg: str) -> Violation:
+    return Violation(
+        rule="F001", path=module.path, line=node.lineno, col=node.col_offset,
+        message=f"malformed contract declaration: {msg}")
+
+
+def _tuple_of_str(val, default=()) -> tuple[str, ...]:
+    if val is None:
+        return tuple(default)
+    if isinstance(val, str):
+        return (val,)
+    return tuple(str(x) for x in val)
+
+
+class _Scanner:
+    def __init__(self, project, contracts: Contracts):
+        self.project = project
+        self.out = contracts
+        # ast node -> the name _Project keyed the class under (handles
+        # the ambiguous-namesake re-keying)
+        self.node_class = {
+            id(info.node): keyed for keyed, info in project.classes.items()
+        }
+
+    def scan(self, module: Module) -> None:
+        imports = self.project.imports[module.relpath]
+        mid = _module_id(module.relpath)
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                keyed = self.node_class.get(id(node), node.name)
+                self._decorators(module, imports, node, cls=keyed)
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._decorators(module, imports, m, cls=keyed,
+                                         method=m.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorators(module, imports, node,
+                                 fn_key=("fn", mid, node.name))
+
+    # -- one decorated definition -------------------------------------------
+    def _decorators(self, module, imports, node, cls=None, method=None,
+                    fn_key=None) -> None:
+        if method is not None:
+            fn_key = ("method", cls, method)
+            label = f"{cls}.{method}"
+        elif fn_key is not None:
+            label = f"{fn_key[1]}:{fn_key[2]}"
+        else:
+            label = cls
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = imports.resolve(target)
+            if dotted is None or not dotted.startswith(_NS):
+                continue
+            marker = dotted[len(_NS):]
+            if isinstance(dec, ast.Call):
+                kw = _kwargs(dec, module, self.out)
+                if kw is None:
+                    continue
+            else:
+                kw = {}
+            self._one(module, node, dec, marker, kw, label, cls, method,
+                      fn_key)
+
+    def _one(self, module, node, dec, marker, kw, label, cls, method,
+             fn_key) -> None:
+        line = dec.lineno
+        if marker == "cache_surface":
+            name = kw.get("name")
+            keyed_by = kw.get("keyed_by")
+            if not name or not keyed_by:
+                self.out.errors.append(_decl_error(
+                    module, dec,
+                    "cache_surface requires name= and keyed_by="))
+                return
+            self.out.surfaces.append(CacheSurface(
+                name=str(name), keyed_by=str(keyed_by),
+                epoch=kw.get("epoch"),
+                purge=_tuple_of_str(kw.get("purge")),
+                immutable=bool(kw.get("immutable", False)),
+                owner=label,
+                owner_class=cls if method is None else None,
+                module=module, line=line))
+        elif marker == "mutation":
+            if fn_key is None:
+                self.out.errors.append(_decl_error(
+                    module, dec, "@mutation applies to functions/methods, "
+                    "not classes"))
+                return
+            kind = kw.get("kind")
+            if kind not in MUTATION_KINDS:
+                self.out.errors.append(_decl_error(
+                    module, dec,
+                    f"unknown mutation kind {kind!r} (expected one of "
+                    f"{sorted(MUTATION_KINDS)})"))
+                return
+            self.out.mutations.append(MutationDecl(
+                kind=kind, invalidates=_tuple_of_str(kw.get("invalidates")),
+                key=fn_key, label=label, module=module, line=line))
+        elif marker == "feedback_sink":
+            if fn_key is None:
+                self.out.errors.append(_decl_error(
+                    module, dec, "@feedback_sink applies to "
+                    "functions/methods, not classes"))
+                return
+            self.out.sinks.append(FnDecl(
+                key=fn_key, label=label, module=module, line=line))
+        elif marker == "shadow_plane":
+            if fn_key is not None:
+                keys = (fn_key,)
+            else:
+                info = self.project.classes.get(cls)
+                keys = tuple(
+                    ("method", cls, m)
+                    for m in (info.methods if info else ())
+                )
+            self.out.shadow_roots.append(ShadowRoot(
+                keys=keys, label=label, module=module, line=line))
+        elif marker == "shadow_guard":
+            if fn_key is None:
+                self.out.errors.append(_decl_error(
+                    module, dec, "@shadow_guard applies to "
+                    "functions/methods, not classes"))
+                return
+            self.out.guards.append(FnDecl(
+                key=fn_key, label=label, module=module, line=line))
+        elif marker == "device_band":
+            if fn_key is None:
+                self.out.errors.append(_decl_error(
+                    module, dec, "@device_band applies to "
+                    "functions/methods, not classes"))
+                return
+            roles = {k for k in ("certain", "cand", "refine") if kw.get(k)}
+            if len(roles) != 1:
+                self.out.errors.append(_decl_error(
+                    module, dec, "device_band requires exactly one of "
+                    "certain/cand/refine"))
+                return
+            self.out.bands.append(BandDecl(
+                key=fn_key, label=label,
+                certain=bool(kw.get("certain")), cand=bool(kw.get("cand")),
+                refine=bool(kw.get("refine")), module=module, line=line))
+
+
+def scan_contracts(project, modules: list[Module]) -> Contracts:
+    """Every contract declaration in ``modules``, keyed into ``project``'s
+    summary-key namespace (the one :func:`build_flow_graph` emits)."""
+    out = Contracts()
+    scanner = _Scanner(project, out)
+    for mod in modules:
+        scanner.scan(mod)
+    return out
+
+
+def resolve_purge_specs(project, contracts: Contracts) -> None:
+    """Fill each surface's ``purge_keys`` from its ``purge`` spec strings.
+
+    Spellings: a bare name is a method of the decorated class, or a
+    module-level function of the declaring module; ``Class.method``
+    crosses classes; ``pkg.mod:fn`` crosses modules. An unresolvable
+    spec is an F001 declaration error — a purge the analyzer cannot
+    find is a purge reviewers cannot find either."""
+    for s in contracts.surfaces:
+        mid = _module_id(s.module.relpath)
+        for spec in s.purge:
+            key = _resolve_purge(project, s, mid, spec)
+            if key is None:
+                contracts.errors.append(Violation(
+                    rule="F001", path=s.module.path, line=s.line, col=0,
+                    message=(
+                        f"cache surface '{s.name}': purge spec {spec!r} "
+                        f"does not resolve to a known function (bare "
+                        f"method, 'Class.method', or 'pkg.mod:fn')")))
+            else:
+                s.purge_keys.append(key)
+
+
+def _resolve_purge(project, s: CacheSurface, mid: str, spec: str):
+    if ":" in spec:
+        mod_part, _, fn = spec.partition(":")
+        return project.local_fn_key(f"{mod_part}.{fn}")
+    if "." in spec:
+        cls_part, _, m = spec.rpartition(".")
+        cls = (cls_part if cls_part in project.classes
+               else project.resolve_class(cls_part))
+        if cls is not None and m in project.classes[cls].methods:
+            return ("method", cls, m)
+        return None
+    if s.owner_class is not None:
+        info = project.classes.get(s.owner_class)
+        if info is not None and spec in info.methods:
+            return ("method", s.owner_class, spec)
+    if spec in project.functions.get(mid, {}):
+        return ("fn", mid, spec)
+    return None

@@ -1,0 +1,42 @@
+"""Registry descriptors for the tpuflow rules.
+
+F001-F003 are WHOLE-PROGRAM rules (``project = True``): their findings
+come from :func:`geomesa_tpu.analysis.flow.rules.analyze_flow_paths`
+(the ``--flow`` CLI mode), not the per-module ``check`` pass — the
+``check`` here is a no-op so the ids still resolve for ``--list-rules``,
+``--rules`` filtering, waivers, baselines, and SARIF rule metadata
+(same pattern as the tpurace descriptors)."""
+
+from __future__ import annotations
+
+from geomesa_tpu.analysis.rules import register
+
+
+@register
+class EpochInvalidationCoherence:
+    id = "F001"
+    title = "cache surface not invalidated by a declared mutation path"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class ShadowPlaneTaint:
+    id = "F002"
+    title = "shadow-plane execution reaches a feedback sink unguarded"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class TwoBandDtypeTaint:
+    id = "F003"
+    title = "f64 in a certain-band decision, or an unrefined cand band"
+    project = True
+
+    def check(self, mod, config):
+        return ()

@@ -1,0 +1,608 @@
+"""tpuflow: contract-driven whole-program dataflow rules (F001-F003).
+
+The third analysis prong. It rides the same project index and
+per-function summaries as tpurace (:func:`build_flow_graph` — the scan
+with CROSS-module call edges enabled), and checks the semantic contracts
+declared through :mod:`geomesa_tpu.analysis.contracts`:
+
+- **F001 epoch/invalidation coherence** — every declared mutation path
+  must REACH (through the call graph) a declared purge of every cache
+  surface it invalidates; name-keyed surfaces must die on name death
+  (delete/delete_schema/rename — the ISSUE-7 recreate collision);
+  epoch-keyed surfaces must declare a monotonic epoch; a non-immutable
+  surface no mutation invalidates (and no monotonic epoch validates) is
+  an undead cache.
+- **F002 shadow-plane taint** — code reachable from a ``@shadow_plane``
+  root (auditor, sweeper, referee execution) must not reach a
+  ``@feedback_sink`` except through a function that consults a
+  ``@shadow_guard`` (``audit.in_shadow``/``audit.shadow``). A non-root
+  function referencing a guard is shadow-aware and trusted to gate its
+  own sinks; a ROOT referencing a guard is not a barrier (otherwise the
+  auditor's own ``with shadow():`` wrapper would vacuously bless every
+  path below it).
+- **F003 two-band f64 discipline** — ``certain``-band functions must be
+  free of f64 (dtype references, astype, refine calls); every
+  ``cand``-band superset must flow into a ``refine`` call or be returned
+  to a caller (which then inherits the obligation, to a fixpoint).
+
+Heuristics, not proofs: the expected answer for a reviewed intentional
+site is a ``# tpuflow: disable=Fxxx`` waiver with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from dataclasses import dataclass
+
+from geomesa_tpu.analysis.core import (
+    LintConfig,
+    Module,
+    Violation,
+    finalize_module_violations,
+)
+from geomesa_tpu.analysis.race.lockset import (
+    _FnScan,
+    _FnSummary,
+    build_flow_graph,
+    load_modules,
+)
+from geomesa_tpu.analysis.flow.contracts_scan import (
+    DEATH_KINDS,
+    Contracts,
+    resolve_purge_specs,
+    scan_contracts,
+)
+
+__all__ = [
+    "FLOW_RULE_IDS", "analyze_flow_modules", "analyze_flow_paths",
+    "contract_inventory", "active_flow_rules",
+]
+
+FLOW_RULE_IDS = ("F001", "F002", "F003")
+
+
+def active_flow_rules(config: LintConfig) -> set[str]:
+    if config.rules is None:
+        return set(FLOW_RULE_IDS)
+    return set(config.rules) & set(FLOW_RULE_IDS)
+
+
+# ---------------------------------------------------------------------------
+# call-graph helpers
+# ---------------------------------------------------------------------------
+
+def _adjacency(summaries) -> dict[tuple, list[tuple]]:
+    return {k: [c.callee for c in s.calls] for k, s in summaries.items()}
+
+
+def _reachable(adj: dict[tuple, list[tuple]], start: tuple) -> set[tuple]:
+    seen = {start}
+    stack = [start]
+    while stack:
+        k = stack.pop()
+        for nxt in adj.get(k, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen
+
+
+def _fn_node(project, key):
+    kind, a, b = key
+    if kind == "fn":
+        return project.functions.get(a, {}).get(b)
+    info = project.classes.get(a)
+    return info.methods.get(b) if info is not None else None
+
+
+# ---------------------------------------------------------------------------
+# F001: epoch/invalidation coherence
+# ---------------------------------------------------------------------------
+
+def _check_f001(project, summaries, contracts: Contracts) -> list[Violation]:
+    out: list[Violation] = []
+    adj = _adjacency(summaries)
+    by_name: dict[str, object] = {}
+    for s in contracts.surfaces:
+        if s.name in by_name:
+            out.append(Violation(
+                rule="F001", path=s.module.path, line=s.line, col=0,
+                message=(f"duplicate cache surface name '{s.name}' "
+                         f"(first declared by {by_name[s.name].owner})")))
+            continue
+        by_name[s.name] = s
+
+    invalidated_by: dict[str, list] = defaultdict(list)
+    for m in contracts.mutations:
+        for nm in m.invalidates:
+            if nm not in by_name:
+                out.append(Violation(
+                    rule="F001", path=m.module.path, line=m.line, col=0,
+                    message=(f"mutation '{m.label}' invalidates unknown "
+                             f"cache surface '{nm}' (no @cache_surface "
+                             f"declares that name)")))
+                continue
+            invalidated_by[nm].append(m)
+
+    # (pair) every declared mutation→surface edge must reach a purge
+    for m in contracts.mutations:
+        reach = None
+        for nm in m.invalidates:
+            s = by_name.get(nm)
+            if s is None or s.immutable or not s.purge_keys:
+                continue
+            if reach is None:
+                reach = _reachable(adj, m.key)
+            if not any(pk in reach for pk in s.purge_keys):
+                purges = ", ".join(sorted(
+                    f"{k[1]}.{k[2]}" for k in s.purge_keys))
+                out.append(Violation(
+                    rule="F001", path=m.module.path, line=m.line, col=0,
+                    message=(
+                        f"mutation '{m.label}' ({m.kind}) declares it "
+                        f"invalidates cache surface '{nm}' but no declared "
+                        f"purge ({purges}) is reachable from it through "
+                        f"the call graph — the cache survives this "
+                        f"mutation")))
+
+    for s in contracts.surfaces:
+        if s is not by_name.get(s.name) or s.immutable:
+            continue
+        muts = invalidated_by.get(s.name, [])
+        # (death) name-keyed caches must die with the name: the ISSUE-7
+        # delete→recreate collision restarts the per-type epoch tuple at
+        # equal values, so epoch stamps alone can serve a dead table
+        if s.keyed_by == "type_name":
+            if not any(m.kind in DEATH_KINDS for m in muts):
+                out.append(Violation(
+                    rule="F001", path=s.module.path, line=s.line, col=0,
+                    message=(
+                        f"cache surface '{s.name}' is keyed by type_name "
+                        f"but no death mutation "
+                        f"({'/'.join(sorted(DEATH_KINDS))}) declares it — "
+                        f"a deleted-then-recreated type would serve the "
+                        f"dead table's entries")))
+        # (epoch) epoch-keyed caches must prove the stamp is monotonic
+        elif s.keyed_by == "epoch" and s.epoch != "monotonic":
+            out.append(Violation(
+                rule="F001", path=s.module.path, line=s.line, col=0,
+                message=(
+                    f"cache surface '{s.name}' is keyed by epoch but does "
+                    f"not declare epoch='monotonic' — an epoch tuple that "
+                    f"can restart at an equal value re-validates dead "
+                    f"entries")))
+        # (orphan) nothing invalidates it and no monotonic epoch
+        # self-validates entries: an undead cache
+        if not muts and not (s.keyed_by == "epoch"
+                             and s.epoch == "monotonic"):
+            out.append(Violation(
+                rule="F001", path=s.module.path, line=s.line, col=0,
+                message=(
+                    f"cache surface '{s.name}' is declared but no "
+                    f"@mutation invalidates it and no monotonic epoch "
+                    f"validates its entries — either declare the mutation "
+                    f"paths or mark it immutable=True")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# F002: shadow-plane taint
+# ---------------------------------------------------------------------------
+
+def _check_f002(project, summaries, contracts: Contracts) -> list[Violation]:
+    out: list[Violation] = []
+    guards = {g.key for g in contracts.guards}
+    sinks = {d.key: d for d in contracts.sinks}
+    if not sinks:
+        return out
+    root_keys: set[tuple] = set()
+    for r in contracts.shadow_roots:
+        root_keys.update(r.keys)
+    seen_sites: set[tuple] = set()
+    for root in contracts.shadow_roots:
+        for rk in root.keys:
+            if rk not in summaries:
+                continue
+            visited = {rk}
+            stack = [rk]
+            while stack:
+                k = stack.pop()
+                s = summaries[k]
+                # a non-root function that consults a shadow guard is
+                # shadow-aware: trusted to gate its own sinks, traversal
+                # stops. Roots are never barriers — the auditor's own
+                # shadow() wrapper must not bless everything below it.
+                if k not in root_keys and any(
+                    c.callee in guards for c in s.calls
+                ):
+                    continue
+                for c in s.calls:
+                    if c.callee in guards:
+                        continue
+                    if c.callee in sinks:
+                        site = (s.module.path, c.line, c.callee)
+                        if site in seen_sites:
+                            continue
+                        seen_sites.add(site)
+                        d = sinks[c.callee]
+                        out.append(Violation(
+                            rule="F002", path=s.module.path, line=c.line,
+                            col=0,
+                            message=(
+                                f"shadow-plane code (rooted at "
+                                f"{root.label}) reaches feedback sink "
+                                f"{d.label} with no shadow guard on the "
+                                f"path — audit traffic would train/bill "
+                                f"this sink; gate it behind in_shadow() "
+                                f"or hoist it out of the shadow plane")))
+                        continue
+                    if c.callee in summaries and c.callee not in visited:
+                        visited.add(c.callee)
+                        stack.append(c.callee)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# F003: two-band f64 dtype discipline
+# ---------------------------------------------------------------------------
+
+_F64_SUFFIXES = (".float64", ".f64", ".double")
+
+
+def _f64_reference(node: ast.AST, imports) -> str | None:
+    """What (if anything) makes this node an f64 construction."""
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        dotted = imports.resolve(node)
+        if dotted is not None and (
+            dotted == "float64" or dotted.endswith(_F64_SUFFIXES)
+        ):
+            return dotted
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype":
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Constant) and a.value == "float64":
+                    return ".astype('float64')"
+        for k in node.keywords:
+            if k.arg == "dtype" and isinstance(k.value, ast.Constant) \
+                    and k.value.value == "float64":
+                return "dtype='float64'"
+    return None
+
+
+def _check_f003_certain(project, summaries, contracts) -> list[Violation]:
+    out: list[Violation] = []
+    refines = {b.key for b in contracts.bands if b.refine}
+    for band in contracts.bands:
+        if not band.certain:
+            continue
+        key = band.key
+        fn = _fn_node(project, key)
+        if fn is None or key not in summaries:
+            continue
+        s = summaries[key]
+        imports = project.imports[s.module.relpath]
+        for node in ast.walk(fn):
+            what = _f64_reference(node, imports)
+            if what is not None:
+                out.append(Violation(
+                    rule="F003", path=s.module.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"certain-band function {band.label} references "
+                        f"f64 ({what}) — certain decisions must stay in "
+                        f"the f32 device band; route exact work through a "
+                        f"@device_band(refine=True) function")))
+        for c in s.calls:
+            if c.callee in refines:
+                out.append(Violation(
+                    rule="F003", path=s.module.path, line=c.line, col=0,
+                    message=(
+                        f"certain-band function {band.label} calls the "
+                        f"f64 refine {c.callee[1]}.{c.callee[2]} — "
+                        f"certain results must not depend on host f64 "
+                        f"refinement")))
+    return out
+
+
+@dataclass
+class _Taint:
+    line: int
+    col: int
+    provider: str
+    satisfied: bool = False
+
+
+class _CandScan(_FnScan):
+    """Forward taint pass: a cand-provider call taints its result (and a
+    factory-returned step propagates — calling a tainted name yields a
+    tainted value); taint is retired by flowing into a refine call or a
+    return statement (the caller inherits the obligation)."""
+
+    def __init__(self, project, summary, fn, providers, refines):
+        super().__init__(project, summary, fn, cross_module=True)
+        self.providers = providers      # key -> label
+        self.refines = refines          # set of keys
+        self.tainted: dict[str, _Taint] = {}
+        self.refined: set[str] = set()  # names holding refine output
+        self.taints: list[_Taint] = []
+        self.returns_taint = False
+        self._claimed: set[int] = set()
+
+    def _is_refined(self, expr: ast.AST) -> bool:
+        """Does this value derive from a refine call (directly or via a
+        name that holds refine output)?"""
+        if isinstance(expr, ast.Call) and self._callee_key(expr.func) \
+                in self.refines:
+            return True
+        return any(
+            isinstance(sub, ast.Name) and sub.id in self.refined
+            for sub in ast.walk(expr)
+        )
+
+    def _merge_refined(self, target: ast.AST) -> None:
+        """Refine output merged into ``target``: the band it carried is
+        retired (``out[band_rows] |= exact`` — the two-band pattern), so
+        the name is clean from here on and imposes no obligation on
+        callers it is returned to."""
+        root = target
+        while isinstance(root, (ast.Subscript, ast.Attribute, ast.Starred)):
+            root = root.value
+        if isinstance(root, ast.Name):
+            t = self.tainted.pop(root.id, None)
+            if t is not None:
+                t.satisfied = True
+            self.refined.add(root.id)
+
+    def _value_taint(self, expr: ast.AST) -> _Taint | None:
+        if isinstance(expr, ast.Call):
+            key = self._callee_key(expr.func)
+            if key in self.refines:
+                return None  # refined output is clean by definition
+            if key in self.providers:
+                self._claimed.add(id(expr))
+                t = _Taint(expr.lineno, expr.col_offset,
+                           self.providers[key])
+                self.taints.append(t)
+                return t
+            f = expr.func
+            if isinstance(f, ast.Name) and f.id in self.tainted:
+                return self.tainted[f.id]  # calling the tainted step fn
+        if isinstance(expr, ast.Name):
+            return self.tainted.get(expr.id)
+        if isinstance(expr, ast.IfExp):
+            return (self._value_taint(expr.body)
+                    or self._value_taint(expr.orelse))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                t = self._value_taint(el)
+                if t is not None:
+                    return t
+            return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return self.tainted[sub.id]
+        return None
+
+    def _bind(self, target: ast.AST, t: _Taint) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, t)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, t)
+        elif isinstance(target, ast.Name):
+            self.tainted[target.id] = t
+        else:
+            # stored into an attribute/subscript: escapes local analysis
+            t.satisfied = True
+
+    def visit_Assign(self, node: ast.Assign):
+        t = self._value_taint(node.value)
+        if t is not None:
+            for tgt in node.targets:
+                self._bind(tgt, t)
+        elif self._is_refined(node.value):
+            for tgt in node.targets:
+                self._merge_refined(tgt)
+        super().visit_Assign(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            t = self._value_taint(node.value)
+            if t is not None:
+                self._bind(node.target, t)
+            elif self._is_refined(node.value):
+                self._merge_refined(node.target)
+        super().visit_AnnAssign(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if self._is_refined(node.value):
+            self._merge_refined(node.target)
+        else:
+            t = self._value_taint(node.value)
+            if t is not None:
+                self._bind(node.target, t)
+        super().visit_AugAssign(node)
+
+    def visit_Return(self, node: ast.Return):
+        if node.value is not None:
+            t = self._value_taint(node.value)
+            if t is not None:
+                t.satisfied = True
+                self.returns_taint = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        key = self._callee_key(node.func)
+        if key in self.refines:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                        self.tainted[sub.id].satisfied = True
+                    elif isinstance(sub, ast.Call):
+                        # refine(cand_fn(...)) — direct composition
+                        if self._callee_key(sub.func) in self.providers:
+                            self._claimed.add(id(sub))
+        elif key in self.providers and id(node) not in self._claimed:
+            # bare provider call whose result is discarded
+            self._claimed.add(id(node))
+            self.taints.append(_Taint(
+                node.lineno, node.col_offset, self.providers[key]))
+        super().visit_Call(node)
+
+
+def _check_f003_cand(project, summaries, contracts) -> list[Violation]:
+    providers = {b.key: b.label for b in contracts.bands if b.cand}
+    refines = {b.key for b in contracts.bands if b.refine}
+    if not providers:
+        return []
+    results: dict[tuple, _CandScan] = {}
+    pending = set(providers)
+    while pending:
+        callers = [
+            k for k, s in summaries.items()
+            if any(c.callee in pending for c in s.calls)
+        ]
+        pending = set()
+        for key in callers:
+            fn = _fn_node(project, key)
+            if fn is None:
+                continue
+            s = summaries[key]
+            scratch = _FnSummary(key=key, name=s.name, cls=s.cls,
+                                 module=s.module)
+            scan = _CandScan(project, scratch, fn, providers, refines)
+            for stmt in fn.body:
+                scan.visit(stmt)
+            results[key] = scan
+            if scan.returns_taint and key not in providers:
+                # this function RETURNS an unrefined cand superset: its
+                # callers inherit the refine obligation (fixpoint)
+                label = (f"{key[1]}.{key[2]}" if key[0] == "method"
+                         else f"{key[1]}:{key[2]}")
+                providers[key] = label
+                pending.add(key)
+    out: list[Violation] = []
+    for key, scan in results.items():
+        for t in scan.taints:
+            if t.satisfied:
+                continue
+            out.append(Violation(
+                rule="F003", path=scan.mod.path, line=t.line, col=t.col,
+                message=(
+                    f"candidate-band superset from {t.provider} never "
+                    f"reaches an f64 refine — pass it to a "
+                    f"@device_band(refine=True) function or return it to "
+                    f"a caller that does (an unrefined cand band ships "
+                    f"false positives)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_flow_modules(modules: list[Module],
+                         config: LintConfig | None = None) -> list[Violation]:
+    """Run F001/F002/F003 over a parsed module set (waivers/baseline are
+    the caller's passes, same contract as ``analyze_modules``)."""
+    config = config or LintConfig()
+    active = active_flow_rules(config)
+    project, summaries = build_flow_graph(modules, config)
+    contracts = scan_contracts(project, modules)
+    resolve_purge_specs(project, contracts)
+    violations: list[Violation] = list(contracts.errors)
+    if "F001" in active:
+        violations.extend(_check_f001(project, summaries, contracts))
+    if "F002" in active:
+        violations.extend(_check_f002(project, summaries, contracts))
+    if "F003" in active:
+        violations.extend(_check_f003_certain(project, summaries, contracts))
+        violations.extend(_check_f003_cand(project, summaries, contracts))
+    violations = [v for v in violations if v.rule in active]
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def analyze_flow_paths(paths: list[str],
+                       config: LintConfig | None = None) -> list[Violation]:
+    """The ``--flow`` entry point: parse every file, run the contract
+    dataflow analysis, and apply the shared waiver/staleness passes."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    config = config or LintConfig()
+    if config.rules is not None:
+        unknown = set(config.rules) - set(all_rules())
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    modules, violations = load_modules(paths)
+    violations = list(violations)
+    violations.extend(analyze_flow_modules(modules, config))
+    by_path: dict[str, list[Violation]] = defaultdict(list)
+    for v in violations:
+        by_path[v.path].append(v)
+    judged = active_flow_rules(config)
+    emit_w001 = config.rules is None or "W001" in config.rules
+    for mod in modules:
+        vs = by_path.get(mod.path, [])
+        violations.extend(finalize_module_violations(
+            mod, vs, judged, emit_w001=emit_w001))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def contract_inventory(modules: list[Module],
+                       config: LintConfig | None = None) -> dict:
+    """The ``--flow --contracts`` view: every declared surface, mutation,
+    sink, shadow root/guard, and band role, with declaration sites."""
+    config = config or LintConfig()
+    project, _ = build_flow_graph(modules, config)
+    contracts = scan_contracts(project, modules)
+    resolve_purge_specs(project, contracts)
+
+    def at(module, line):
+        return f"{module.relpath}:{line}"
+
+    return {
+        "cache_surfaces": [
+            {
+                "name": s.name, "keyed_by": s.keyed_by, "epoch": s.epoch,
+                "immutable": s.immutable, "owner": s.owner,
+                "purge": list(s.purge),
+                "declared_at": at(s.module, s.line),
+            }
+            for s in sorted(contracts.surfaces, key=lambda s: s.name)
+        ],
+        "mutations": [
+            {
+                "fn": m.label, "kind": m.kind,
+                "invalidates": list(m.invalidates),
+                "declared_at": at(m.module, m.line),
+            }
+            for m in sorted(contracts.mutations,
+                            key=lambda m: (m.label, m.kind))
+        ],
+        "feedback_sinks": [
+            {"fn": d.label, "declared_at": at(d.module, d.line)}
+            for d in sorted(contracts.sinks, key=lambda d: d.label)
+        ],
+        "shadow_planes": [
+            {"name": r.label, "entry_points": len(r.keys),
+             "declared_at": at(r.module, r.line)}
+            for r in sorted(contracts.shadow_roots, key=lambda r: r.label)
+        ],
+        "shadow_guards": [
+            {"fn": d.label, "declared_at": at(d.module, d.line)}
+            for d in sorted(contracts.guards, key=lambda d: d.label)
+        ],
+        "device_bands": [
+            {
+                "fn": b.label,
+                "role": ("certain" if b.certain
+                         else "cand" if b.cand else "refine"),
+                "declared_at": at(b.module, b.line),
+            }
+            for b in sorted(contracts.bands, key=lambda b: b.label)
+        ],
+    }

@@ -1,0 +1,171 @@
+"""Incremental analysis: content-hash caching for the three prongs.
+
+``--changed-only`` makes the CI gate stop re-analyzing ~240 unchanged
+files per prong. The cache is keyed by CONTENT, not git state or
+mtimes — a byte-identical tree always hits, an edited file always
+misses — so it is equivalent to git-diff scoping without trusting the
+index, and works in a dirty checkout.
+
+Two cache shapes, matching the two analysis shapes:
+
+- the per-module lint prong caches each file's violation list under its
+  source digest (``lint.json``): an edit re-lints exactly that file;
+- the whole-program race/flow prongs reason across modules, so any edit
+  can change any finding: their runs are cached under a digest of the
+  WHOLE file set (``race.json``/``flow.json``) — an unchanged tree is
+  free, any edit re-runs the prong.
+
+Every cache entry also carries a fingerprint of the analyzer itself
+(registered rule ids + the config's scoping knobs), so upgrading a rule
+or re-scoping a path invalidates everything. ``--full`` bypasses reads
+but still refreshes the cache; deleting ``.tpulint-cache/`` is always
+safe. Waived flags are content-derived and cached; baseline matching is
+run-specific and is re-applied by the caller after load.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from geomesa_tpu.analysis.core import (
+    LintConfig,
+    Violation,
+    iter_py_files,
+    lint_source,
+)
+
+__all__ = [
+    "cache_root", "lint_paths_cached", "analyze_whole_cached",
+    "CACHE_DIR_NAME",
+]
+
+CACHE_VERSION = 1
+CACHE_DIR_NAME = ".tpulint-cache"
+
+
+def cache_root() -> str:
+    """``$TPULINT_CACHE_DIR`` or ``./.tpulint-cache`` (lint.sh runs from
+    the repo root; tests point this at a tmp dir)."""
+    return os.environ.get(
+        "TPULINT_CACHE_DIR", os.path.join(os.getcwd(), CACHE_DIR_NAME))
+
+
+def _digest(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _fingerprint(config: LintConfig, mode: str) -> str:
+    """Analyzer identity: cached results are only valid for the same
+    rule set and the same scoping config that produced them."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    return _digest(json.dumps({
+        "mode": mode,
+        "config": repr(config),
+        "rules": sorted(all_rules()),
+        "version": CACHE_VERSION,
+    }))
+
+
+def _v_to_dict(v: Violation) -> dict:
+    return {
+        "rule": v.rule, "path": v.path, "line": v.line, "col": v.col,
+        "message": v.message, "snippet": v.snippet, "waived": v.waived,
+    }
+
+
+def _v_from_dict(d: dict) -> Violation:
+    return Violation(
+        rule=d["rule"], path=d["path"], line=d["line"], col=d["col"],
+        message=d["message"], snippet=d["snippet"], waived=d["waived"],
+    )
+
+
+def _load(path: str, fingerprint: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (data.get("version") != CACHE_VERSION
+            or data.get("fingerprint") != fingerprint):
+        return None
+    return data
+
+
+def _save(path: str, data: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)  # atomic: a killed run never corrupts the cache
+
+
+def lint_paths_cached(
+    paths: list[str],
+    config: LintConfig | None = None,
+    root: str | None = None,
+    use_cache: bool = True,
+) -> list[Violation]:
+    """Per-file cached spelling of ``lint_paths``: unchanged files reuse
+    their cached violation lists, edited files re-lint, and the cache is
+    rewritten with whatever this run saw."""
+    config = config or LintConfig()
+    root = root if root is not None else cache_root()
+    fp_path = os.path.join(root, "lint.json")
+    fingerprint = _fingerprint(config, "lint")
+    cached = (_load(fp_path, fingerprint) or {}) if use_cache else {}
+    files_cache: dict = cached.get("files", {})
+    out: list[Violation] = []
+    new_files: dict = {}
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            source = f.read()
+        d = _digest(source)
+        entry = files_cache.get(fp)
+        if entry is not None and entry.get("digest") == d:
+            vs = [_v_from_dict(x) for x in entry["violations"]]
+        else:
+            vs = lint_source(source, fp, config)
+        new_files[fp] = {
+            "digest": d, "violations": [_v_to_dict(v) for v in vs],
+        }
+        out.extend(vs)
+    _save(fp_path, {
+        "version": CACHE_VERSION, "fingerprint": fingerprint,
+        "files": new_files,
+    })
+    return out
+
+
+def analyze_whole_cached(
+    mode: str,
+    analyze_fn,
+    paths: list[str],
+    config: LintConfig | None = None,
+    root: str | None = None,
+    use_cache: bool = True,
+) -> list[Violation]:
+    """Whole-run cache for the race/flow prongs: hash every analyzed
+    file; an identical file set reuses the previous run's findings, any
+    difference re-runs ``analyze_fn(paths, config)`` in full (the
+    analyses are cross-module — there is no sound per-file slice)."""
+    config = config or LintConfig()
+    root = root if root is not None else cache_root()
+    fp_path = os.path.join(root, f"{mode}.json")
+    fingerprint = _fingerprint(config, mode)
+    tree = _digest(json.dumps([
+        (fp, _digest(open(fp, encoding="utf-8").read()))
+        for fp in iter_py_files(paths)
+    ]))
+    cached = _load(fp_path, fingerprint) if use_cache else None
+    if cached is not None and cached.get("tree") == tree:
+        return [_v_from_dict(x) for x in cached["violations"]]
+    violations = analyze_fn(paths, config)
+    _save(fp_path, {
+        "version": CACHE_VERSION, "fingerprint": fingerprint,
+        "tree": tree, "violations": [_v_to_dict(v) for v in violations],
+    })
+    return violations

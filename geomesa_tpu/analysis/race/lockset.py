@@ -59,15 +59,13 @@ from geomesa_tpu.analysis.core import (
     LintConfig,
     Module,
     Violation,
-    apply_waivers,
     iter_py_files,
     parse_module,
-    stale_waiver_violations,
 )
 
 __all__ = [
     "RACE_RULE_IDS", "analyze_modules", "analyze_race_paths", "guard_map",
-    "load_modules",
+    "load_modules", "build_flow_graph",
 ]
 
 RACE_RULE_IDS = ("R001", "R002", "R003")
@@ -184,6 +182,10 @@ class _Project:
         self.lock_home: dict[str, str] = {}
         # module_id -> top-level function defs
         self.functions: dict[str, dict[str, ast.FunctionDef]] = {}
+        # (module_id, fn) -> class its return annotation resolves to —
+        # lets cross-module scans type ``devmon.costs().forget(...)``
+        # through the ``def costs() -> CostTable`` accessor idiom
+        self.fn_returns: dict[tuple[str, str], str] = {}
 
         for mod in modules:
             imports = ImportMap(mod.tree)
@@ -209,6 +211,33 @@ class _Project:
         # all class names must exist first)
         for info in list(self.classes.values()):
             self._type_class(info)
+        self._fn_homes: dict[str, list[str]] = defaultdict(list)
+        for mod in modules:
+            mid = _module_id(mod.relpath)
+            imports = self.imports[mod.relpath]
+            for name, fn in self.functions[mid].items():
+                self._fn_homes[name].append(mid)
+                ret = self._ann_class(fn.returns, imports)
+                if ret:
+                    self.fn_returns[(mid, name)] = ret
+
+    def local_fn_key(self, dotted: str | None) -> tuple | None:
+        """Canonical ``geomesa_tpu.<mod>.<fn>`` path → ``("fn", mid, fn)``
+        when the target is a known top-level function of an analyzed
+        module (the cross-module half of the call graph). Fixture trees
+        analyzed from outside the package have path-derived module ids, so
+        an import path is also matched as a module-id suffix."""
+        if dotted is None or "." not in dotted:
+            return None
+        head, _, name = dotted.rpartition(".")
+        if head.startswith("geomesa_tpu."):
+            head = head[len("geomesa_tpu."):]
+        if name in self.functions.get(head, {}):
+            return ("fn", head, name)
+        for mid in self._fn_homes.get(name, ()):
+            if mid.endswith("." + head) or head.endswith("." + mid):
+                return ("fn", mid, name)
+        return None
 
     # -- pass 1a: class inventory -------------------------------------------
     def _index_class(self, mod, imports, node: ast.ClassDef) -> None:
@@ -353,7 +382,7 @@ def _self_attr_of(node: ast.AST, self_name: str) -> str | None:
 
 class _FnScan(ast.NodeVisitor):
     def __init__(self, project: _Project, summary: _FnSummary,
-                 fn: ast.FunctionDef):
+                 fn: ast.FunctionDef, *, cross_module: bool = False):
         self.p = project
         self.s = summary
         self.mod = summary.module
@@ -361,6 +390,11 @@ class _FnScan(ast.NodeVisitor):
         self.mid = _module_id(self.mod.relpath)
         self.cls = summary.cls
         self.self_name = _self_name(fn) if self.cls is not None else None
+        # opt-in (flow prong only): resolve imported geomesa_tpu functions
+        # to call-graph edges and type accessor-call returns. Kept OFF for
+        # the race prong so its edge set — and therefore R001-R003
+        # findings and the committed baseline — stays byte-identical.
+        self.cross_module = cross_module
         self.held: list[str] = []
         self.var_class: dict[str, str] = {}
         # annotated params type locals too
@@ -396,6 +430,15 @@ class _FnScan(ast.NodeVisitor):
                 recv = self._expr_class(f.value)
                 if recv is not None and recv in self.p.classes:
                     return self.p.classes[recv].method_returns.get(f.attr)
+            if self.cross_module:
+                # module-level accessor returns: ``devmon.costs()`` types
+                # as CostTable through ``def costs() -> CostTable``
+                key = self.p.local_fn_key(self.imports.resolve(f))
+                if key is None and isinstance(f, ast.Name):
+                    if f.id in self.p.functions.get(self.mid, {}):
+                        key = ("fn", self.mid, f.id)
+                if key is not None:
+                    return self.p.fn_returns.get((key[1], key[2]))
             return None
         if isinstance(expr, ast.IfExp):
             return self._expr_class(expr.body) or self._expr_class(expr.orelse)
@@ -522,12 +565,17 @@ class _FnScan(ast.NodeVisitor):
         if isinstance(f, ast.Name):
             if f.id in self.p.functions.get(self.mid, {}):
                 return ("fn", self.mid, f.id)
+            if self.cross_module:
+                return self.p.local_fn_key(self.imports.resolve(f))
             return None
         if isinstance(f, ast.Attribute):
             recv = self._expr_class(f.value)
             if recv is not None and recv in self.p.classes:
                 if f.attr in self.p.classes[recv].methods:
                     return ("method", recv, f.attr)
+            if self.cross_module:
+                # ``_traj_state.invalidate(...)`` through a module alias
+                return self.p.local_fn_key(self.imports.resolve(f))
         return None
 
     # nested defs / lambdas run who-knows-where; don't attribute their
@@ -558,16 +606,19 @@ def _flat_targets(t: ast.AST):
 # pass 3: inter-procedural propagation + rule evaluation
 # ---------------------------------------------------------------------------
 
-def _summaries(project: _Project, config: LintConfig) -> dict[tuple, _FnSummary]:
+def _summaries(project: _Project, config: LintConfig, *,
+               prefixes: tuple[str, ...] | None = None,
+               cross_module: bool = False) -> dict[tuple, _FnSummary]:
     out: dict[tuple, _FnSummary] = {}
+    scope = prefixes if prefixes is not None else config.race_paths
     for mod in project.modules:
-        if not config.in_scope(mod.relpath, config.race_paths):
+        if not config.in_scope(mod.relpath, scope):
             continue
         mid = _module_id(mod.relpath)
         for name, fn in project.functions[mid].items():
             key = ("fn", mid, name)
             s = _FnSummary(key=key, name=name, cls=None, module=mod)
-            scan = _FnScan(project, s, fn)
+            scan = _FnScan(project, s, fn, cross_module=cross_module)
             for stmt in fn.body:
                 scan.visit(stmt)
             out[key] = s
@@ -577,7 +628,7 @@ def _summaries(project: _Project, config: LintConfig) -> dict[tuple, _FnSummary]
             for mname, m in info.methods.items():
                 key = ("method", cname, mname)
                 s = _FnSummary(key=key, name=mname, cls=info, module=mod)
-                scan = _FnScan(project, s, m)
+                scan = _FnScan(project, s, m, cross_module=cross_module)
                 for stmt in m.body:
                     scan.visit(stmt)
                 out[key] = s
@@ -887,11 +938,27 @@ def load_modules(paths: list[str]) -> tuple[list[Module], list[Violation]]:
     return modules, errors
 
 
+def build_flow_graph(
+    modules: list[Module], config: LintConfig | None = None,
+) -> tuple[_Project, dict[tuple, _FnSummary]]:
+    """Shared fixpoint machinery for the flow prong: index the project
+    and scan every function with CROSS-MODULE call-graph edges enabled
+    (``devmon.costs().forget(...)`` and ``_traj_state.invalidate(...)``
+    resolve). The race prong keeps its narrower edge set — this helper
+    exists so F-rules ride the same type inference without perturbing
+    R001-R003 results."""
+    config = config or LintConfig()
+    project = _Project(modules)
+    summaries = _summaries(project, config, prefixes=("",),
+                           cross_module=True)
+    return project, summaries
+
+
 def analyze_race_paths(paths: list[str],
                        config: LintConfig | None = None) -> list[Violation]:
     """The ``--race`` entry point: parse every file, run the whole-program
     analysis, apply per-line waivers, and flag stale tpurace waivers."""
-    from geomesa_tpu.analysis.core import waiver_comments
+    from geomesa_tpu.analysis.core import finalize_module_violations
     from geomesa_tpu.analysis.rules import all_rules
 
     config = config or LintConfig()
@@ -910,15 +977,7 @@ def analyze_race_paths(paths: list[str],
     emit_w001 = config.rules is None or "W001" in config.rules
     for mod in modules:
         vs = by_path.get(mod.path, [])
-        comments = waiver_comments(mod.lines)
-        if emit_w001:
-            stale = stale_waiver_violations(
-                mod.lines, vs, judged, mod.path, comments)
-            violations.extend(stale)
-            vs = vs + stale
-        for v in vs:
-            if not v.snippet:
-                v.snippet = mod.snippet(v.line)
-        apply_waivers(vs, mod.lines, comments)
+        violations.extend(finalize_module_violations(
+            mod, vs, judged, emit_w001=emit_w001))
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations

@@ -86,37 +86,69 @@ def _sarif_result(v: Violation, rule_index: dict[str, int]) -> dict:
     return result
 
 
+def _sarif_run(driver: str, violations: list[Violation],
+               rule_ids: list[str], rules: dict) -> dict:
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    return {
+        "tool": {
+            "driver": {
+                "name": driver,
+                "informationUri":
+                    "https://example.invalid/geomesa_tpu/docs/tpulint.md",
+                "rules": [
+                    {
+                        "id": rid,
+                        "shortDescription": {"text": rules[rid].title},
+                        "defaultConfiguration": {"level": "error"},
+                    }
+                    for rid in rule_ids
+                ],
+            },
+        },
+        "originalUriBaseIds": {
+            "SRCROOT": {"description": {"text": "repository root"}},
+        },
+        "results": [_sarif_result(v, rule_index) for v in violations],
+        "properties": {"summary": summarize(violations)},
+    }
+
+
 def render_json(violations: list[Violation]) -> str:
     """The SARIF 2.1.0 document (``--format json``/``--format sarif``)."""
     from geomesa_tpu.analysis.rules import all_rules
 
     rules = all_rules()
-    rule_ids = sorted(rules)
-    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
     doc = {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
-        "runs": [{
-            "tool": {
-                "driver": {
-                    "name": "tpulint",
-                    "informationUri":
-                        "https://example.invalid/geomesa_tpu/docs/tpulint.md",
-                    "rules": [
-                        {
-                            "id": rid,
-                            "shortDescription": {"text": rules[rid].title},
-                            "defaultConfiguration": {"level": "error"},
-                        }
-                        for rid in rule_ids
-                    ],
-                },
-            },
-            "originalUriBaseIds": {
-                "SRCROOT": {"description": {"text": "repository root"}},
-            },
-            "results": [_sarif_result(v, rule_index) for v in violations],
-            "properties": {"summary": summarize(violations)},
-        }],
+        "runs": [_sarif_run("tpulint", violations, sorted(rules), rules)],
     }
     return json.dumps(doc, indent=1)
+
+
+# which registered rule ids each prong's SARIF driver advertises; W001 is
+# shared hygiene and appears under every driver (each prong judges it)
+_PRONG_RULE_FILTERS = {
+    "tpulint": lambda rid: rid[:1] not in ("R", "F"),
+    "tpurace": lambda rid: rid[:1] == "R" or rid == "W001",
+    "tpuflow": lambda rid: rid[:1] == "F" or rid == "W001",
+}
+
+
+def render_json_multi(prong_runs: list[tuple[str, list[Violation]]]) -> str:
+    """One SARIF log with one run per prong (``--all-prongs``): each run
+    carries its own driver name and only that prong's rule metadata, so
+    code-scanning ingestion attributes findings to the right tool."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    rules = all_rules()
+    runs = []
+    for driver, violations in prong_runs:
+        keep = _PRONG_RULE_FILTERS[driver]
+        rule_ids = [rid for rid in sorted(rules) if keep(rid)]
+        runs.append(_sarif_run(driver, violations, rule_ids, rules))
+    return json.dumps({
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": runs,
+    }, indent=1)

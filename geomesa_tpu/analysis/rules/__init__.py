@@ -27,6 +27,7 @@ _RULE_MODULES = (
     "geomesa_tpu.analysis.rules.jax_rules",
     "geomesa_tpu.analysis.rules.concurrency",
     "geomesa_tpu.analysis.race.rules",
+    "geomesa_tpu.analysis.flow.registry",
 )
 
 

@@ -68,6 +68,8 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from dataclasses import asdict, dataclass, field
 
+from geomesa_tpu.analysis.contracts import shadow_guard, shadow_plane
+
 __all__ = [
     "AUDIT_DIR_ENV", "AUDIT_ENV", "ContinuousAuditor", "DivergenceReport",
     "InvariantSweeper", "enabled", "get", "in_shadow", "install",
@@ -140,6 +142,7 @@ _shadow_var: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "geomesa_audit_shadow", default=False)
 
 
+@shadow_guard
 def in_shadow() -> bool:
     """True inside an auditor-triggered execution: the store's feedback
     planes (cost table, usage metering, SLO burn, workload capture)
@@ -148,6 +151,7 @@ def in_shadow() -> bool:
     return _shadow_var.get()
 
 
+@shadow_guard
 @contextmanager
 def shadow():
     token = _shadow_var.set(True)
@@ -327,6 +331,7 @@ class _Check:
         self.ts = time.time()
 
 
+@shadow_plane
 class ContinuousAuditor:
     """Bounded low-priority shadow-re-execution worker.
 
@@ -819,6 +824,7 @@ class ContinuousAuditor:
 
 # -- invariant sweeps ---------------------------------------------------------
 
+@shadow_plane
 class InvariantSweeper:
     """Periodic validator of structural invariants shadow queries cannot
     see. Attach surfaces (``attach_store`` / ``attach_view`` /

@@ -48,6 +48,8 @@ import weakref
 from contextlib import contextmanager
 from contextvars import ContextVar
 
+from geomesa_tpu.analysis.contracts import cache_surface, feedback_sink
+
 __all__ = [
     "DEVPROF_ENV", "CostTable", "DevProfile", "ResidencyLedger",
     "cost_sidecar_path", "costs", "current_profile", "device_report",
@@ -68,6 +70,8 @@ GROUP_PYRAMID = "pyramid"  # GeoBlocks pre-aggregation pyramid levels
 
 # -- HBM residency ledger -----------------------------------------------------
 
+@cache_surface(name="spill-ledger", keyed_by="type_name",
+               purge=("clear_spills",))
 class ResidencyLedger:
     """Process-wide registry of live device allocations.
 
@@ -504,6 +508,8 @@ class _CostEntry:
         self.profiled_count = 0
 
 
+@cache_surface(name="device-cost-table", keyed_by="type_name",
+               purge=("forget",))
 class CostTable:
     """Online per-(type, plan-signature) observed-cost aggregation.
 
@@ -543,6 +549,7 @@ class CostTable:
             for k in [k for k in self._ticks if k[0] == type_name]:
                 del self._ticks[k]
 
+    @feedback_sink
     def observe(self, type_name: str, signature: str, *,
                 wall_ms: float, device_ms: float | None = None,
                 rows: int = 0, bytes_scanned: int = 0) -> None:
@@ -750,6 +757,8 @@ def cost_sidecar_path(path: str | None = None) -> "str | None":
     return os.path.join(d, COSTS_SIDECAR) if d else None
 
 
+@cache_surface(name="persisted-cost-sidecar", keyed_by="type_name",
+               purge=("purge_persisted_costs",))
 def save_cost_snapshot(path: str | None = None) -> "str | None":
     """Persist the live cost table + calibration state; returns the path
     written (None when no sidecar location is configured). Atomic

@@ -42,6 +42,8 @@ import threading
 import time
 from collections import deque
 
+from geomesa_tpu.analysis.contracts import feedback_sink
+
 __all__ = ["SloEngine", "SloObjective", "SloTracker", "window_label"]
 
 _BUCKET_S = 10.0  # counter granularity; 1h window = 360 buckets
@@ -187,6 +189,7 @@ class SloEngine:
                     obj, key, self._lock)
         return tk
 
+    @feedback_sink
     def observe(self, name: str, ok: bool,
                 latency_ms: float | None = None, key: str = "") -> None:
         """One observation against objective ``name`` (auto-defined with

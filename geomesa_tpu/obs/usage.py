@@ -48,6 +48,8 @@ import time
 from contextlib import contextmanager
 from contextvars import ContextVar
 
+from geomesa_tpu.analysis.contracts import feedback_sink
+
 __all__ = [
     "DEFAULT_TENANT", "TENANT_HEADER", "TENANT_K_ENV", "SpaceSaving",
     "UsageMeter", "current_tenant", "get", "install", "observe",
@@ -243,6 +245,7 @@ class UsageMeter:
         self.observe_count = 0
 
     # -- hot path -------------------------------------------------------------
+    @feedback_sink
     def observe(self, tenant: str | None, type_name: str, signature: str,
                 *, rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
                 device_ms: float = 0.0, ok: bool = True,
@@ -438,6 +441,7 @@ def install(meter: UsageMeter) -> UsageMeter:
     return prev
 
 
+@feedback_sink
 def observe(tenant: str | None, type_name: str, signature: str, *,
             rows: int = 0, bytes_out: int = 0, wall_ms: float = 0.0,
             device_ms: float = 0.0, ok: bool = True,

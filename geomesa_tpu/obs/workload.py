@@ -40,6 +40,8 @@ import json
 import os
 import threading
 
+from geomesa_tpu.analysis.contracts import feedback_sink
+
 __all__ = [
     "ENABLED", "WORKLOAD_DIR_ENV", "WorkloadJournal", "flush", "get",
     "install", "read_events", "record",
@@ -269,6 +271,7 @@ def flush() -> None:
         j.flush()
 
 
+@feedback_sink
 def record(*, ts: float, op: str, type_name: str, source: str,
            filter_text: str, hints: dict | None, tenant: str,
            auths, plan_signature: str, predicted_ms,

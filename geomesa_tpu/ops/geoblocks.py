@@ -49,6 +49,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from geomesa_tpu.analysis.contracts import cache_surface
+
 __all__ = ["AggPyramid", "QueryCache", "enabled", "PYRAMID_ENV",
            "PYRAMID_BYTES_ENV"]
 
@@ -386,6 +388,8 @@ class AggPyramid:
 
 # -- epoch-validated query cache ----------------------------------------------
 
+@cache_surface(name="geoblocks-query-cache", keyed_by="type_name",
+               purge=("invalidate",))
 class QueryCache:
     """Exact-repeat aggregation cache, keyed by (plan signature, literal
     predicate, GROUP BY, value columns) and validated by the owning

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from geomesa_tpu.analysis.contracts import shadow_plane
+
 __all__ = [
     "agg_equal", "fid_sets_equal", "referee_agg", "referee_count",
     "referee_select",
@@ -37,6 +39,7 @@ __all__ = [
 F64_RTOL = 1e-9
 
 
+@shadow_plane
 def referee_select(sft, main, delta, q) -> list[str]:
     """Matching fids (sorted list of str) for one query, evaluated
     host-side over the (main, delta) snapshot: full f64 filter mask plus
@@ -64,10 +67,12 @@ def referee_select(sft, main, delta, q) -> list[str]:
     return out
 
 
+@shadow_plane
 def referee_count(sft, main, delta, q) -> int:
     return len(referee_select(sft, main, delta, q))
 
 
+@shadow_plane
 def referee_agg(sft, main, delta, q, group_by, value_cols,
                 cutoff_ms: int | None = None) -> dict:
     """Grouped aggregation by brute force: f64 filter mask, optional

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from geomesa_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from geomesa_tpu.analysis.contracts import device_band
 from geomesa_tpu.obs.jaxmon import observed as _observed
 from geomesa_tpu.parallel.mesh import DATA_AXIS, QUERY_AXIS, data_shards
 
@@ -1366,6 +1367,7 @@ def cached_batched_density_step(mesh: Mesh, width: int, height: int):
     )
 
 
+@device_band(certain=True)
 def make_corridor_step(heading: bool, bidirectional: bool):
     """Fused corridor kernel: N candidate rows × Q corridors × S segments
     in ONE device pass (the trajectory plane's tube-select/route-search
@@ -1437,6 +1439,7 @@ def make_corridor_step(heading: bool, bidirectional: bool):
     return step
 
 
+@device_band(cand=True)
 @lru_cache(maxsize=None)
 def cached_corridor_step(n_cap: int, s_cap: int, q_cap: int,
                          heading: bool, bidirectional: bool):

@@ -44,6 +44,8 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+from geomesa_tpu.analysis.contracts import cache_surface, feedback_sink
+
 __all__ = [
     "Candidate", "CostModel", "MIN_OBSERVATIONS", "PROBE_EVERY",
     "PROBE_MAX_RATIO", "TIE_BAND", "install", "model",
@@ -107,6 +109,8 @@ def calibration_error(predicted_ms: float, actual_ms: float) -> float:
     return abs(predicted_ms - actual_ms) / max(actual_ms, 1e-6)
 
 
+@cache_surface(name="planner-calibration-table", keyed_by="type_name",
+               purge=("forget",))
 class CostModel:
     """The decision engine: rank candidates by learned cost when every
     candidate is trained, by stats seeds otherwise; probe the loser on a
@@ -260,6 +264,7 @@ class CostModel:
         return win.name
 
     # -- calibration ---------------------------------------------------------
+    @feedback_sink
     def record_calibration(self, type_name: str, signature: str,
                            predicted_ms: float, actual_ms: float) -> None:
         err = calibration_error(predicted_ms, actual_ms)

@@ -44,6 +44,8 @@ import threading
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from geomesa_tpu.analysis.contracts import cache_surface
+
 __all__ = ["HBM_ENV", "BufferPool", "register_residency"]
 
 HBM_ENV = "GEOMESA_TPU_HBM"  # process-level pool budget, in bytes
@@ -85,6 +87,7 @@ class _Entry:
         return sum(self.groups.values())
 
 
+@cache_surface(name="buffer-pool", keyed_by="type_name", purge=("purge",))
 class BufferPool:
     """See module docstring. One instance per :class:`TpuBackend`."""
 

@@ -12,17 +12,21 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
 from geomesa_tpu import obs
+from geomesa_tpu.analysis.contracts import cache_surface, feedback_sink, mutation
 from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import FeatureIndex
 from geomesa_tpu.planning.planner import Query, QueryPlanner, build_indices
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.schema.sft import FeatureType, parse_spec
 from geomesa_tpu.store.backends import ExecutionBackend, OracleBackend, TpuBackend
+
+if TYPE_CHECKING:
+    from geomesa_tpu.store.bufferpool import BufferPool
 
 _BACKENDS = {"oracle": OracleBackend, "tpu": TpuBackend}
 
@@ -188,6 +192,15 @@ class ExplainAnalyze:
         return out + f"\n  Hits: {self.hits}"
 
 
+# both derived-data caches ride this state object: the plan cache is
+# valid only for the current `indices` object (identity-checked on every
+# lookup/insert), the pyramids only for the current data epoch — and the
+# epoch IS monotonic within one _TypeState lifetime (both caches die
+# with the object, so the delete+recreate restart cannot serve them)
+@cache_surface(name="plan-cache", keyed_by="indices-identity",
+               purge=("purge_derived",))
+@cache_surface(name="agg-pyramids", keyed_by="epoch", epoch="monotonic",
+               purge=("purge_derived",))
 @dataclass
 class _TypeState:
     sft: FeatureType
@@ -250,6 +263,20 @@ class _TypeState:
             import uuid
 
             self.ident = uuid.uuid4().hex
+
+    def purge_derived(self) -> None:
+        """Drop BOTH derived-data caches (plan cache + GeoBlocks
+        pyramids) — the one invalidation point every state swap calls
+        under ``lock``. The declared purge target of the ``plan-cache``
+        and ``agg-pyramids`` cache surfaces above: keeping the two
+        ``clear()`` calls in one place is what lets the ``--flow`` F001
+        pass prove every mutation path reaches them."""
+        # every caller swaps state under `lock`; the helper exists so the
+        # two clears cannot drift apart, not to introduce a lock scope
+        # tpurace: disable-next-line=R001
+        self.plan_cache.clear()
+        # tpurace: disable-next-line=R001
+        self.pyramids.clear()
 
     def snapshot(self):
         """Coherent read of the query-relevant state (one lock hold)."""
@@ -493,6 +520,11 @@ class DataStore:
             self._wal.commit(ticket)
         return sft
 
+    @mutation(kind="evolve", invalidates=("plan-cache", "agg-pyramids"))
+    @mutation(kind="rename", invalidates=(
+        "geoblocks-query-cache", "buffer-pool", "device-cost-table",
+        "spill-ledger", "planner-calibration-table",
+        "persisted-cost-sidecar", "track-state-cache"))
     def update_schema(
         self,
         type_name: str,
@@ -606,8 +638,7 @@ class DataStore:
                     st.indices = build_indices(new_sft)
                     st.backend_state = None
                     st.delta.drop_first(n_tables)
-                    st.plan_cache.clear()
-                    st.pyramids.clear()
+                    st.purge_derived()
                     st.epoch += 1
         if rename_to and rename_to != type_name:
             with self._schema_lock:
@@ -636,6 +667,10 @@ class DataStore:
     def list_schemas(self) -> list[str]:
         return sorted(self._types)
 
+    @mutation(kind="delete_schema", invalidates=(
+        "geoblocks-query-cache", "buffer-pool", "device-cost-table",
+        "spill-ledger", "planner-calibration-table",
+        "persisted-cost-sidecar", "track-state-cache"))
     def delete_schema(self, name: str) -> None:
         if self._wal_active():
             from geomesa_tpu.store import wal as _walmod
@@ -669,7 +704,7 @@ class DataStore:
         """Drop every store/pool/telemetry artifact keyed by a type NAME
         whose schema no longer answers for it (delete, rename)."""
         self.agg_cache.invalidate(name)
-        pool = getattr(self.backend, "pool", None)
+        pool: "BufferPool | None" = getattr(self.backend, "pool", None)
         if pool is not None:
             pool.purge(name)
         from geomesa_tpu.obs import devmon
@@ -695,6 +730,7 @@ class DataStore:
         return self._types[name]
 
     # -- writes (GeoMesaFeatureWriter + lambda hot-tier roles) ---------------
+    @mutation(kind="write", invalidates=("plan-cache", "agg-pyramids"))
     def write(self, type_name: str, data, fids=None) -> int:
         """Append features (FeatureTable or list of record dicts).
 
@@ -820,6 +856,7 @@ class DataStore:
                     q = out
         return q
 
+    @mutation(kind="delete", invalidates=("plan-cache", "agg-pyramids"))
     def delete_features(self, type_name: str, fids, visible_to=None) -> int:
         """Remove features by id (the ``GeoMesaFeatureWriter`` remove role).
 
@@ -953,6 +990,7 @@ class DataStore:
             self.delete_features(type_name, fids, visible_to=visible_to)
             return self.write(type_name, table)
 
+    @mutation(kind="clear", invalidates=("plan-cache", "agg-pyramids"))
     def clear(self, type_name: str) -> int:
         """Drop every row of a type, keeping the schema (the bus tier's
         ``Clear`` barrier as a store op; WFS-T "delete all" role). Returns
@@ -987,11 +1025,11 @@ class DataStore:
                 st.backend_state = None
                 st.stats = None
                 st.delta.drop_first(n_tables)
-                st.plan_cache.clear()
-                st.pyramids.clear()
+                st.purge_derived()
                 st.epoch += 1
             return removed
 
+    @mutation(kind="write", invalidates=("plan-cache", "agg-pyramids"))
     def compact(self, type_name: str) -> None:
         """Merge the delta tier into the sorted main tier (re-sort + device
         reload + stats rebuild). Atomic: state swaps only on success, and
@@ -1113,8 +1151,7 @@ class DataStore:
             st.backend_state = backend_state
             st.stats = stats
             st.delta.drop_first(consumed_tables)
-            st.plan_cache.clear()
-            st.pyramids.clear()  # built from the OLD main tier
+            st.purge_derived()  # pyramids were built from the OLD main tier
             st.epoch = next_epoch
 
     # -- age-off (AgeOffIterator / DtgAgeOffIterator role) --------------------
@@ -1124,6 +1161,7 @@ class DataStore:
         v = sft.user_data.get("geomesa.age.off")
         return None if v is None else int(v)
 
+    @mutation(kind="age_off", invalidates=("plan-cache", "agg-pyramids"))
     def age_off(self, type_name: str, now_ms: int | None = None) -> int:
         """Physically drop rows older than the schema's TTL; returns count.
 
@@ -1182,8 +1220,7 @@ class DataStore:
                     st.backend_state = None
                     st.stats = None
                     st.delta.drop_first(n_tables)
-                    st.plan_cache.clear()
-                    st.pyramids.clear()
+                    st.purge_derived()
                     st.epoch += 1
             return removed
 
@@ -1480,6 +1517,7 @@ class DataStore:
                 self.metrics.counter("store.plan_cache.hits").inc()
             return hit
 
+    @feedback_sink
     def _plan_store(self, st: _TypeState, indices, key, value) -> None:
         if key is None:
             return

@@ -44,6 +44,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from geomesa_tpu.analysis.contracts import cache_surface
+
 __all__ = ["SubscriptionMatrix", "HitBatch", "MatrixSnapshot",
            "envelope_hit", "envelope_hits"]
 
@@ -153,6 +155,8 @@ class MatrixSnapshot:
     times_dev: object
 
 
+@cache_surface(name="matrix-device-mirror", keyed_by="epoch",
+               epoch="monotonic")
 class SubscriptionMatrix:
     """Registry of standing queries materialized as device query matrices.
 

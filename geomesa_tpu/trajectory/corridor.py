@@ -40,6 +40,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from geomesa_tpu.analysis.contracts import device_band
 from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
 
@@ -158,6 +159,7 @@ def prune_filter(sft, specs, base=None) -> ast.Filter:
     return f
 
 
+@device_band(refine=True)
 def corridor_masks_f64(xs, ys, tms, hdg, specs) -> np.ndarray:
     """EXACT f64 corridor membership: (Q, N) bool over the given rows.
 

@@ -39,6 +39,7 @@ from functools import lru_cache
 
 import numpy as np
 
+from geomesa_tpu.analysis.contracts import cache_surface, device_band
 from geomesa_tpu.planning.planner import Query
 
 __all__ = [
@@ -309,6 +310,8 @@ _lock = threading.Lock()  # leaf: the manager cache table
 _states: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
+@cache_surface(name="track-state-cache", keyed_by="type_name",
+               purge=("invalidate",))
 def get_track_state(ds, type_name: str, track_field: str,
                     filter=None, auths=None) -> TrackState:
     """The cached track state for (store, type, field, filter, auths),
@@ -354,6 +357,9 @@ def invalidate(ds, type_name: str | None = None) -> None:
 
 # -- the fused per-entity aggregation -----------------------------------------
 
+@cache_surface(name="track-stats-step-memo", keyed_by="shape-bucket",
+               immutable=True)
+@device_band(certain=True)
 @lru_cache(maxsize=None)
 def cached_track_stats_step(n_cap: int, e_cap: int):
     """Memoized segment-reduce step, one observed identity per (row

@@ -10,15 +10,19 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis \
+# All three static prongs in ONE invocation (--all-prongs): the
+# per-module lint rules (J001-J004, C001, W001), the tpurace
+# whole-program lockset / lock-order / blocking-call analysis
+# (R001-R003, docs/concurrency.md), and the tpuflow contract dataflow
+# pass (F001 epoch/invalidation coherence, F002 shadow-plane taint,
+# F003 two-band f64 discipline — docs/tpulint.md § Flow rules), all
+# against the same committed baseline and waiver namespace.
+# --changed-only reuses the .tpulint-cache/ content-hash caches so an
+# unchanged tree re-verifies in a fraction of the full wall time; pass
+# --full to force a fresh analysis (it still refreshes the caches).
+GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis --all-prongs \
     geomesa_tpu/ scripts/ bench.py __graft_entry__.py \
-    --baseline .tpulint-baseline.json "$@"
-
-# tpurace static prong: whole-program lockset / lock-order / blocking-call
-# analysis (R001-R003) over the package, against the same baseline. Zero
-# unwaived violations is the bar — see docs/concurrency.md.
-GEOMESA_TPU_NO_JAX=1 python -m geomesa_tpu.analysis --race \
-    geomesa_tpu/ --baseline .tpulint-baseline.json
+    --baseline .tpulint-baseline.json --changed-only "$@"
 
 # tracing-overhead smoke gate (the dynamic half): the obs subsystem's span
 # propagation, exporter, and disabled-path overhead bound must hold before

@@ -1,0 +1,33 @@
+"""F001 bad: a mutation that never reaches its declared purge, a
+name-keyed surface with no death mutation, a mutation naming an unknown
+surface, and an epoch surface with no monotonic proof (never imported —
+pure-AST fixture)."""
+
+from geomesa_tpu.analysis.contracts import cache_surface, mutation
+
+
+@cache_surface(name="tile-cache", keyed_by="type_name",
+               purge=("invalidate",))
+class TileCache:
+    def __init__(self):
+        self.entries = {}
+
+    def invalidate(self, type_name):
+        self.entries.pop(type_name, None)
+
+
+@cache_surface(name="layout-cache", keyed_by="epoch")
+class LayoutCache:
+    def __init__(self):
+        self.by_epoch = {}
+
+
+@mutation(kind="write", invalidates=("tile-cache",))
+def write_rows(cache: "TileCache", rows):
+    # BUG: never calls TileCache.invalidate — the cache survives the write
+    cache.entries.setdefault("t", []).extend(rows)
+
+
+@mutation(kind="delete", invalidates=("missing-cache",))
+def delete_rows(cache: "TileCache", fids):
+    cache.invalidate("t")

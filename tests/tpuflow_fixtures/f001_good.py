@@ -1,0 +1,37 @@
+"""F001 good twin: every mutation reaches its declared purge, the
+name-keyed surface dies on delete_schema, the epoch surface declares a
+monotonic stamp, and the memo surface is immutable."""
+
+from geomesa_tpu.analysis.contracts import cache_surface, mutation
+
+
+@cache_surface(name="tile-cache-ok", keyed_by="type_name",
+               purge=("invalidate",))
+class TileCache:
+    def __init__(self):
+        self.entries = {}
+
+    def invalidate(self, type_name):
+        self.entries.pop(type_name, None)
+
+
+@cache_surface(name="layout-cache-ok", keyed_by="epoch", epoch="monotonic")
+class LayoutCache:
+    def __init__(self):
+        self.by_epoch = {}
+
+
+@cache_surface(name="step-memo-ok", keyed_by="shape-bucket", immutable=True)
+def cached_step(n_cap):
+    return n_cap
+
+
+@mutation(kind="write", invalidates=("tile-cache-ok",))
+def write_rows(cache: "TileCache", rows):
+    cache.entries.setdefault("t", []).extend(rows)
+    cache.invalidate("t")
+
+
+@mutation(kind="delete_schema", invalidates=("tile-cache-ok",))
+def drop_type(cache: "TileCache", type_name):
+    cache.invalidate(type_name)

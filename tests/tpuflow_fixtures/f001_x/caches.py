@@ -1,0 +1,15 @@
+"""Cross-module F001 fixture: the cache surface and its purge live
+here; the mutation that must reach them lives in ``store.py``, two call
+hops away through ``hub.HubRegistry.close_all``."""
+
+from geomesa_tpu.analysis.contracts import cache_surface
+
+
+@cache_surface(name="shard-cache", keyed_by="type_name",
+               purge=("drop_all",))
+class ShardCache:
+    def __init__(self):
+        self.by_type = {}
+
+    def drop_all(self, type_name):
+        self.by_type.pop(type_name, None)

@@ -1,0 +1,12 @@
+"""The middle hop: nothing here is contract-decorated — reaching the
+purge through ``close_all`` requires the cross-module call graph."""
+
+
+class HubRegistry:
+    def __init__(self):
+        self.members = []
+
+    def close_all(self, cache: "ShardCache", type_name):
+        for m in self.members:
+            m.close()
+        cache.drop_all(type_name)

@@ -1,0 +1,17 @@
+"""The mutations: ``drop_schema`` purges only THROUGH
+``HubRegistry.close_all`` (must count as reachable — the cross-module
+half of F001); ``drop_schema_leaky`` never reaches the purge and must
+still be flagged."""
+
+from geomesa_tpu.analysis.contracts import mutation
+
+
+@mutation(kind="delete_schema", invalidates=("shard-cache",))
+def drop_schema(hub: "HubRegistry", cache, type_name):
+    hub.close_all(cache, type_name)
+
+
+@mutation(kind="rename", invalidates=("shard-cache",))
+def drop_schema_leaky(hub: "HubRegistry", cache, type_name):
+    # BUG: forgets the hub teardown — the shard cache outlives the name
+    hub.members = []

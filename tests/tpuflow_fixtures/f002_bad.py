@@ -1,0 +1,39 @@
+"""F002 bad: shadow-plane code reaches a feedback sink with no guard
+on the path — and a ROOT's own guard reference must not bless the sink
+call below it (the auditor's ``with shadow():`` wrapper rule)."""
+
+from geomesa_tpu.analysis.contracts import (
+    feedback_sink,
+    shadow_guard,
+    shadow_plane,
+)
+
+_IN_SHADOW = False
+
+
+@shadow_guard
+def shadow():
+    return _IN_SHADOW
+
+
+class Meter:
+    @feedback_sink
+    def observe(self, ms):
+        pass
+
+
+@shadow_plane
+def run_audit(meter: "Meter"):
+    replay(meter)
+
+
+def replay(meter: "Meter"):
+    meter.observe(1.0)
+
+
+@shadow_plane
+def sweep(meter: "Meter"):
+    # a root consulting the guard is NOT a barrier: its wrapper would
+    # vacuously bless everything below it
+    shadow()
+    meter.observe(2.0)

@@ -1,0 +1,31 @@
+"""F002 good twin: the sink-calling function consults the shadow guard,
+so it is shadow-aware and trusted to gate its own feedback."""
+
+from geomesa_tpu.analysis.contracts import (
+    feedback_sink,
+    shadow_guard,
+    shadow_plane,
+)
+
+_IN_SHADOW = False
+
+
+@shadow_guard
+def in_shadow():
+    return _IN_SHADOW
+
+
+class Meter:
+    @feedback_sink
+    def observe(self, ms):
+        pass
+
+
+@shadow_plane
+def run_audit(meter: "Meter"):
+    replay(meter)
+
+
+def replay(meter: "Meter"):
+    if not in_shadow():
+        meter.observe(1.0)

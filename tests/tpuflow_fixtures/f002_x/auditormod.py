@@ -1,0 +1,12 @@
+"""The shadow root: a @shadow_plane class whose replay path reaches the
+sink in ``meters.py`` through ``pipelinemod.run_shard``."""
+
+from geomesa_tpu.analysis.contracts import shadow_plane
+
+from f002_x.pipelinemod import run_shard
+
+
+@shadow_plane
+class Auditor:
+    def replay_one(self, store, q, costs):
+        return run_shard(store, q, costs)

@@ -1,0 +1,10 @@
+"""Cross-module F002 fixture: the feedback sink lives here; the shadow
+root and the leaking call chain live two modules away."""
+
+from geomesa_tpu.analysis.contracts import feedback_sink
+
+
+class CostMeter:
+    @feedback_sink
+    def observe(self, sig, ms):
+        pass

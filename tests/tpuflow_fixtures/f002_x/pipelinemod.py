@@ -1,0 +1,8 @@
+"""The middle hop: plain pipeline code, unaware it can run under the
+auditor — its unguarded sink call is the cross-module F002 finding."""
+
+
+def run_shard(store, q, costs: "CostMeter"):
+    ms = store.execute(q)
+    costs.observe("sig", ms)
+    return ms

@@ -1,0 +1,31 @@
+"""F003 bad: f64 inside the certain band (a dtype reference AND a call
+into the refine), plus a cand-band superset whose decision is taken
+without ever reaching the f64 refine."""
+
+import numpy as np
+
+from geomesa_tpu.analysis.contracts import device_band
+
+
+@device_band(refine=True)
+def refine_exact(xs, rows):
+    return xs[rows].astype("float64") > 0.5
+
+
+@device_band(certain=True)
+def certain_step(xs):
+    hi = np.float64(1.0)
+    exact = refine_exact(xs, None)
+    return (xs * hi) > 0.5, exact
+
+
+@device_band(cand=True)
+def cand_step(xs):
+    return xs > 0.2
+
+
+def alert_on_rows(xs, log):
+    cand = cand_step(xs)
+    if cand.any():
+        # BUG: alerting on the widened superset ships false positives
+        log.append("hit")

@@ -1,0 +1,43 @@
+"""F003 good twin: the certain band stays f32; the cand superset
+narrows through the two-band merge (``out[band] |= exact`` — refine
+output merged into the band retires the obligation); a returned band
+hands the obligation to the caller, who refines it."""
+
+import numpy as np
+
+from geomesa_tpu.analysis.contracts import device_band
+
+
+@device_band(refine=True)
+def refine_exact(xs, rows):
+    return xs[rows].astype("float64") > 0.5
+
+
+@device_band(certain=True)
+def certain_step(xs):
+    return (xs.astype(np.float32) * np.float32(0.5)) > 0.25
+
+
+@device_band(cand=True)
+def cand_step(xs):
+    return xs > 0.2, xs > 0.8
+
+
+def select_rows(xs):
+    cand, sure = cand_step(xs)
+    out = sure.copy()
+    band = cand & ~sure
+    exact = refine_exact(xs, band)
+    out[band] |= exact
+    return out
+
+
+def forward_band(xs):
+    # returning the superset hands the refine obligation to the caller
+    return cand_step(xs)
+
+
+def caller_refines(xs):
+    cand, sure = forward_band(xs)
+    band = cand & ~sure
+    return sure, refine_exact(xs, band)

@@ -1,0 +1,28 @@
+"""Seed regression (ISSUE 13): the shadow-meter shape.
+
+Pre-fix, auditor-replayed queries flowed through the same completion
+path as live traffic and TRAINED the cost table / billed the usage
+meter — audit traffic steering the planner it audits. The contract
+shape below reproduces it: the shadow-plane replay reaches the
+``observe`` feedback sink through an unguarded shared helper — F002
+must flag the sink call."""
+
+from geomesa_tpu.analysis.contracts import feedback_sink, shadow_plane
+
+
+class CostTable:
+    @feedback_sink
+    def observe(self, sig, ms):
+        pass
+
+
+def run_select(store, q, costs: "CostTable"):
+    ms = store.execute(q)
+    costs.observe("sig", ms)
+    return ms
+
+
+@shadow_plane
+class Auditor:
+    def replay_one(self, store, q, costs):
+        return run_select(store, q, costs)

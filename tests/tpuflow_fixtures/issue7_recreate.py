@@ -1,0 +1,31 @@
+"""Seed regression (ISSUE 7): the recreate-serves-dead-cache shape.
+
+The pre-fix buffer pool kept per-type device buffers fingerprinted by an
+epoch tuple that RESTARTED on delete_schema + create_schema, so a
+recreated type was served the dead table's staged buffers. The contract
+shape below reproduces it: a type_name-keyed surface whose declared
+mutations purge on every WRITE path but never on a death
+(delete_schema/rename) — F001's death check must flag the surface."""
+
+from geomesa_tpu.analysis.contracts import cache_surface, mutation
+
+
+@cache_surface(name="staged-buffers", keyed_by="type_name",
+               purge=("purge",))
+class StagedPool:
+    def __init__(self):
+        self.live = {}
+
+    def purge(self, type_name):
+        self.live.pop(type_name, None)
+
+
+@mutation(kind="write", invalidates=("staged-buffers",))
+def write_rows(pool: "StagedPool", type_name, rows):
+    pool.live.setdefault(type_name, []).extend(rows)
+    pool.purge(type_name)
+
+
+@mutation(kind="delete", invalidates=("staged-buffers",))
+def delete_rows(pool: "StagedPool", type_name, fids):
+    pool.purge(type_name)

@@ -1,0 +1,15 @@
+"""Stale tpuflow waivers: the flow prong judges F rules, so a waiver
+naming one on a clean line is dead weight (W001) — same-line and
+next-line forms."""
+
+from geomesa_tpu.analysis.contracts import device_band
+
+
+@device_band(certain=True)
+def certain_step(xs):
+    # tpuflow: disable-next-line=F003
+    return xs * 2
+
+
+def helper(xs):
+    return certain_step(xs)  # tpuflow: disable=F001

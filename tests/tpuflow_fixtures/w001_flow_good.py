@@ -1,0 +1,14 @@
+"""A LIVE tpuflow waiver: it suppresses a real F003 finding, so the
+stale-waiver scan stays silent and the file gates clean."""
+
+import numpy as np
+
+from geomesa_tpu.analysis.contracts import device_band
+
+
+@device_band(certain=True)
+def certain_step(xs):
+    # reviewed: the constant feeds a host-side debug threshold only
+    # tpuflow: disable-next-line=F003
+    hi = np.float64(0.5)
+    return xs > hi
