@@ -2659,11 +2659,21 @@ def _maybe_write_trace(suffix_config: bool) -> None:
 
 
 def _run_one_config():
-    """One config under its own span so the Perfetto timeline has a root."""
+    """One config under a FORCED trace root: the Perfetto timeline gets
+    its root, and the round record gets a per-config ``exemplar_trace_id``
+    — the lens-exemplar contract applied to bench rounds, so a committed
+    round's numbers resolve to a sample trace (via ``--trace`` output or
+    the run's trace buffer), the way a lens bucket resolves to its p99
+    exemplar."""
     from geomesa_tpu import obs
 
-    with obs.span(f"bench.config_{CONFIG}"):
-        return BENCHES[CONFIG]()
+    with obs.collect(f"bench.config_{CONFIG}") as root:
+        result = BENCHES[CONFIG]()
+    if isinstance(result, dict):
+        d = result.setdefault("detail", {})
+        if isinstance(d, dict):
+            d.setdefault("exemplar_trace_id", root.trace_id)
+    return result
 
 
 def _child_main():
@@ -2814,6 +2824,11 @@ def _compact(r: dict) -> dict:
     )
     if ref is not None:
         c["ref_ms"] = ref
+    # per-config trace exemplar: one sample run's trace id (resolvable
+    # against the --trace Perfetto file / trace buffer — the query-lens
+    # exemplar contract applied to bench rounds)
+    if d.get("exemplar_trace_id"):
+        c["trace"] = d["exemplar_trace_id"]
     if r.get("error"):
         c["error"] = str(r["error"])[:120]
     return c

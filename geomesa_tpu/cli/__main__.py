@@ -694,6 +694,73 @@ def cmd_obs_audit(args):
                 print(f"    ! {v}")
 
 
+def cmd_obs_lens(args):
+    """Pull a server's retained profiling plane (``GET /api/obs/lens``):
+    per-(type, plan-signature) live-window quantiles, retained latency
+    history, trace exemplars, and the regression sentinel's alarms —
+    the "since when is this signature slow" surface
+    (docs/observability.md § Query lens & host-roundtrip ledger)."""
+    import urllib.parse
+    import urllib.request
+
+    qp = {"limit": args.limit, "window": args.window}
+    if getattr(args, "type", None):
+        qp["type"] = args.type
+    url = (args.url.rstrip("/") + "/api/obs/lens?"
+           + urllib.parse.urlencode(qp))
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    sent = doc.get("sentinel", {})
+    print(f"query lens: {doc['series']} series, "
+          f"{doc['observe_count']} observed; sentinel: "
+          f"{len(sent.get('alarms', []))} active alarms, "
+          f"{sent.get('regressions_total', 0)} regressions total")
+    print(f"{'type':<14s} {'signature':<28s} {'n':>6s} {'p50':>8s} "
+          f"{'p95':>8s} {'p99':>8s} {'max':>8s} {'disp':>6s} exemplar")
+    for e in doc.get("entries", []):
+        w = e["window"]
+        ex = e.get("exemplars") or []
+        tid = ex[0]["trace_id"][:16] if ex else "-"
+        print(f"{e['type']:<14s} {e['signature']:<28s} {w['count']:>6d} "
+              f"{w['p50_ms']:>8.2f} {w['p95_ms']:>8.2f} "
+              f"{w['p99_ms']:>8.2f} {w['max_ms']:>8.2f} "
+              f"{w['dispatches']:>6d} {tid}")
+    for a in sent.get("alarms", []):
+        print(f"\nREGRESSED [{a['cause']}] {a['type']} {a['signature']}: "
+              f"live {a['live_ms']:.2f} ms vs ref {a['ref_ms']:.2f} ms "
+              f"({a['factor']:.2f}x, n={a['live_count']})")
+
+
+def cmd_obs_fusion(args):
+    """Pull a server's host-roundtrip fusion-opportunity report (``GET
+    /api/obs/fusion``): plan signatures ranked by host-choreography
+    share — the work list for whole-plan device compilation
+    (docs/observability.md § fusion-report workflow)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + f"/api/obs/fusion?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    entries = doc.get("entries", [])
+    print(f"fusion report: {len(entries)} (type, plan-signature) entries "
+          f"ranked by host-choreography share")
+    print(f"{'type':<14s} {'signature':<28s} {'n':>6s} {'host%':>6s} "
+          f"{'disp/q':>7s} {'sync/q':>7s} {'gap ms':>9s} {'sync ms':>9s} "
+          f"{'wall ms':>9s}")
+    for e in entries:
+        print(f"{e['type']:<14s} {e['signature']:<28s} {e['queries']:>6d} "
+              f"{e['host_share'] * 100:>5.1f}% "
+              f"{e['dispatches_per_query']:>7.2f} "
+              f"{e['syncs_per_query']:>7.2f} {e['host_gap_ms']:>9.2f} "
+              f"{e['sync_ms']:>9.2f} {e['wall_ms']:>9.2f}")
+
+
 def cmd_replay(args):
     """Replay a captured workload (``GEOMESA_TPU_WORKLOAD_DIR`` capture)
     against a catalog or a live server and print the recorded-vs-replayed
@@ -1021,6 +1088,24 @@ def main(argv=None):
     )
     obs_common(au)
     au.set_defaults(fn=cmd_obs_audit)
+    le = obs_sub.add_parser(
+        "lens",
+        help="pull a server's retained per-plan-signature latency history "
+        "(quantiles, exemplars, regression sentinel)",
+    )
+    obs_common(le)
+    le.add_argument("--window", type=float, default=300.0,
+                    help="live quantile window in seconds")
+    le.add_argument("--type", default=None,
+                    help="only series of this feature type")
+    le.set_defaults(fn=cmd_obs_lens)
+    fu = obs_sub.add_parser(
+        "fusion-report",
+        help="pull a server's host-roundtrip fusion report (signatures "
+        "ranked by host-choreography share)",
+    )
+    obs_common(fu)
+    fu.set_defaults(fn=cmd_obs_fusion)
 
     sp = sub.add_parser(
         "replay",

@@ -24,6 +24,13 @@ Six layers (see docs/observability.md):
 - :mod:`~geomesa_tpu.obs.workload` / :mod:`~geomesa_tpu.obs.replay` —
   workload capture (one JSONL wide event per query) and the
   deterministic replay harness with recorded-vs-replayed reports.
+- :mod:`~geomesa_tpu.obs.lens` — the retained profiling plane: per
+  (type, plan-signature) time-bucketed latency histograms with trace
+  exemplars, true Prometheus histogram families, and the live
+  regression sentinel (``A_REGRESSION``).
+- :mod:`~geomesa_tpu.obs.ledger` — the host-roundtrip ledger: per-query
+  dispatch/sync/host-gap accounting rolled up into the per-signature
+  fusion-opportunity report.
 
 This package imports no jax at module level: ``GEOMESA_TPU_NO_JAX=1``
 processes (tpulint in CI) can import every instrumented module.

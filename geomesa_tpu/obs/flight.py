@@ -62,6 +62,14 @@ A_SHED = "shed"
 # found structural drift — the highest-severity anomaly the recorder
 # carries (a wrong answer outranks a slow one)
 A_DIVERGE = "diverge"
+# a sustained latency regression (obs/lens.py): the sentinel found a
+# plan signature's live window p50/p99 above factor x its rolling
+# reference window or committed BENCH baseline
+A_REGRESSION = "regression"
+# a recompile storm (obs/jaxmon.py): the live J003 census crossed the
+# per-window recompile threshold — some step is being re-traced on a
+# hot path (shape churn, a missing pad bucket)
+A_RECOMPILE = "recompile_storm"
 
 
 @dataclass

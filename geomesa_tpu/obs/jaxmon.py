@@ -29,11 +29,13 @@ import os
 import threading
 import time
 import weakref
+from collections import deque
 
 from geomesa_tpu.obs import devmon as _devmon
+from geomesa_tpu.obs import ledger as _ledger
 
 __all__ = ["GLOBAL", "registry", "install", "observed", "jit_report",
-           "count_h2d"]
+           "count_h2d", "recompile_census"]
 
 GLOBAL = None  # lazily-created MetricsRegistry (process-wide jax telemetry)
 _reg_lock = threading.Lock()
@@ -195,6 +197,69 @@ def count_h2d(*arrays, label: str | None = None) -> int:
     return total
 
 
+# -- recompile census → flight recorder (A_RECOMPILE) -------------------------
+# The live J003 dashboard already counts recompiles; the census turns a
+# BURST of them into one operator signal: >= GEOMESA_TPU_RECOMPILE_STORM
+# recompiles inside a GEOMESA_TPU_RECOMPILE_WINDOW_S window raises ONE
+# rate-limited A_RECOMPILE flight anomaly (the recorder's dump throttle
+# bounds file output). Recompiles are rare by design (the zero-recompile
+# census pins in tests/test_costmodel.py), so this path is cold.
+_RECOMPILE_WINDOW_S = float(
+    os.environ.get("GEOMESA_TPU_RECOMPILE_WINDOW_S", "60"))
+_RECOMPILE_STORM = int(os.environ.get("GEOMESA_TPU_RECOMPILE_STORM", "3"))
+_census_lock = threading.Lock()  # leaf: census window + storm clock
+_census_times: deque = deque(maxlen=256)  # (ts, step) inside the window
+_census_last_storm = -float("inf")
+_census_storms = 0
+
+
+def _note_recompile(step: str) -> None:
+    now = time.time()
+    burst = 0
+    with _census_lock:
+        global _census_last_storm, _census_storms
+        _census_times.append((now, step))
+        horizon = now - _RECOMPILE_WINDOW_S
+        while _census_times and _census_times[0][0] < horizon:
+            _census_times.popleft()
+        n = len(_census_times)
+        if (n >= _RECOMPILE_STORM
+                and now - _census_last_storm >= _RECOMPILE_WINDOW_S):
+            _census_last_storm = now  # one anomaly per window
+            _census_storms += 1
+            burst = n
+    if burst:
+        from geomesa_tpu.obs import flight as _flight
+
+        _flight.record(
+            "jit.recompile", "", source="jaxmon",
+            plan=(f"{burst} recompiles in {_RECOMPILE_WINDOW_S:.0f}s "
+                  f"window (latest step: {step})"),
+            anomalies=(_flight.A_RECOMPILE,),
+        )
+
+
+def recompile_census() -> dict:
+    """The census state (``/api/metrics`` + tests): recompiles inside the
+    current window, the storm threshold, and storms raised so far."""
+    with _census_lock:
+        return {
+            "window_s": _RECOMPILE_WINDOW_S,
+            "threshold": _RECOMPILE_STORM,
+            "in_window": len(_census_times),
+            "storms": _census_storms,
+        }
+
+
+def _census_reset() -> None:
+    """Test hook: clear the census window and storm clock."""
+    with _census_lock:
+        global _census_last_storm, _census_storms
+        _census_times.clear()
+        _census_last_storm = -float("inf")
+        _census_storms = 0
+
+
 def _block_ready(obj) -> None:
     """Wait for every array in ``obj`` (one level of tuple/list nesting —
     the shapes our steps return) to finish on device."""
@@ -243,7 +308,8 @@ def _profiled_call(fn, args, kwargs, sp):
         if converted:
             _block_ready(converted)
         t1 = pc()
-        out = fn(*staged, **kwargs)
+        with _DISPATCH_GATE:
+            out = fn(*staged, **kwargs)
         t2 = pc()
         _block_ready(out)
         t3 = pc()
@@ -264,6 +330,19 @@ class _NullCtx:
 
     def __exit__(self, *exc):
         return None
+
+
+# One process-wide enqueue gate for sharded dispatches. JAX requires
+# multi-device computations to be ENQUEUED in the same order on every
+# device; two threads racing execute_sharded can invert the per-device
+# queue order and deadlock the collective rendezvous (observed as reader
+# threads parked in ``array._value`` while a third pjit never finishes).
+# Enqueue is async and returns in microseconds — results are awaited
+# OUTSIDE the gate — so concurrent queries still overlap on device; the
+# gate only pins the cross-device launch order. RLock, not Lock: a step
+# that re-enters Python (host fallback inside a wrapped step) must not
+# self-deadlock.
+_DISPATCH_GATE = threading.RLock()
 
 
 def observed(name: str, fn):
@@ -307,9 +386,11 @@ def observed(name: str, fn):
                 prof_detail, out = _profiled_call(fn, args, kwargs, sp)
             elif sp is not None:
                 with sp:
-                    out = fn(*args, **kwargs)
+                    with _DISPATCH_GATE:
+                        out = fn(*args, **kwargs)
             else:
-                out = fn(*args, **kwargs)
+                with _DISPATCH_GATE:
+                    out = fn(*args, **kwargs)
         except BaseException:
             # the signature only counts once the step SUCCEEDS: a device
             # error here (circuit-breaker failover) must leave the retry
@@ -318,7 +399,8 @@ def observed(name: str, fn):
                 with lock:
                     sigs.discard(key)
             raise
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1000.0
         calls.inc()
         # transfer denominator: numpy args are about to cross host→device
         # (call sites that pre-convert account theirs via count_h2d —
@@ -337,6 +419,11 @@ def observed(name: str, fn):
             h2d_bytes.inc(h2d)
         if d2h:
             d2h_bytes.inc(d2h)
+        # roundtrip ledger (obs.ledger): one ContextVar read when no query
+        # context is open; the on path charges this dispatch's span +
+        # transfer bytes to the live query's ledger
+        _ledger.note_dispatch(t0, t1, compiled=is_new,
+                              h2d_bytes=h2d, d2h_bytes=d2h)
         if is_new:
             compiles.inc()
             compile_ms.update(dt_ms)
@@ -344,6 +431,7 @@ def observed(name: str, fn):
                 # a warm step met a fresh abstract signature: the live J003
                 recompiles_all.inc()
                 recompiles.inc()
+                _note_recompile(name)
         else:
             dispatch_ms.update(dt_ms)
         if sp is not None:

@@ -486,6 +486,31 @@ def recent() -> list:
         return list(_traces)
 
 
+def find_trace(trace_id: str) -> "Span | None":
+    """The completed root span with this trace_id (newest wins), or None.
+    Exemplar resolution: the query lens's tail buckets retain trace ids
+    (obs/lens.py); this turns one back into its stitched span tree while
+    it is still inside the completed-roots ring."""
+    if not trace_id:
+        return None
+    with _buffer_lock:
+        for root in reversed(_traces):
+            if root.trace_id == trace_id:
+                return root
+    return None
+
+
+def span_doc(root: "Span", max_depth: int = 64) -> dict:
+    """One span tree as plain JSON — the web layer's exemplar-resolution
+    payload (``GET /api/obs/lens?trace=``). Same compact keys as the
+    federation wire doc (n/i/o/d/a/e/c, offsets relative to the root's
+    start) plus the absolute anchor so clients can line trees up."""
+    d = _span_doc(root, root.t0_ns, max_depth)
+    d["trace_id"] = root.trace_id
+    d["t0_ns"] = root.t0_ns
+    return d
+
+
 def drain() -> list:
     """Completed root spans, clearing the buffer (exporter consumption)."""
     with _buffer_lock:

@@ -1,1 +1,15 @@
-"""geomesa_tpu subpackage."""
+"""geomesa_tpu subpackage.
+
+Re-exports :func:`plan_signature` — the canonical (type, plan-shape) key.
+One string keys four per-plan surfaces: the adaptive cost table
+(:mod:`geomesa_tpu.planning.costmodel`), the query lens's retained
+latency rings (:mod:`geomesa_tpu.obs.lens`), the host-roundtrip ledger's
+fusion-opportunity rollups (:mod:`geomesa_tpu.obs.ledger`), and flight
+audit records. Planning consumers import it from here; the definition
+lives in :mod:`geomesa_tpu.obs.devmon` (kept jax-free) so telemetry-only
+processes never pull the planner's index machinery.
+"""
+
+from geomesa_tpu.obs.devmon import plan_signature  # noqa: F401
+
+__all__ = ["plan_signature"]

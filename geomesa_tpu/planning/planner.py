@@ -90,10 +90,19 @@ class QueryPlanInfo:
     # budget and the union search was skipped (CHEAP_SELECT_ROWS)
     cheap: bool = False
 
+    def signature(self, q=None) -> str:
+        """The shared plan-shape key (``devmon.plan_signature``): what the
+        adaptive cost table, the query lens, and the roundtrip ledger all
+        key their per-plan profiles by. Exposed here so explain output and
+        lens/fusion-report entries cross-reference without re-deriving."""
+        from geomesa_tpu.obs import devmon as _devmon
+        return _devmon.plan_signature(self, q)
+
     def explain(self) -> str:
         lines = [
             f"Planning '{self.type_name}' {self.filter_str}",
             f"  Index: {self.index_name}",
+            f"  Signature: {self.signature()}",
             f"  Spatial bounds: {self.extraction.boxes}",
             f"  Temporal bounds: {self.extraction.intervals}",
             f"  Scan intervals: {self.n_intervals} covering {self.n_candidates} rows",

@@ -221,16 +221,26 @@ class Coalescer:
         return slot.result
 
     def _stamp_tenant(self, q):
-        """Resolve the submitter's tenant NOW (its request context) and
-        pin it on the query: the batched dispatch runs on the leader's
-        thread, whose ambient tenant must not absorb the whole batch's
-        usage attribution."""
+        """Resolve the submitter's tenant AND trace NOW (its request
+        context) and pin them on the query: the batched dispatch runs on
+        the leader's thread, whose ambient tenant must not absorb the
+        whole batch's usage attribution — and whose batch span must not
+        claim every follower's lens exemplar (the stamped trace_id keeps
+        each coalesced query's exemplar resolvable to the SUBMITTER's
+        stitched tree, disjoint from the leader's)."""
+        from geomesa_tpu import obs
         from geomesa_tpu.obs import usage as _usage
 
-        if q.hints and q.hints.get("tenant"):
+        extra = {}
+        if not (q.hints and q.hints.get("tenant")):
+            extra["tenant"] = _usage.current_tenant()
+        if not (q.hints and q.hints.get("trace_id")):
+            sp = obs.current()
+            if sp is not None and sp.trace_id:
+                extra["trace_id"] = sp.trace_id
+        if not extra:
             return q
-        return replace(q, hints={**(q.hints or {}),
-                                 "tenant": _usage.current_tenant()})
+        return replace(q, hints={**(q.hints or {}), **extra})
 
     def _single(self, type_name: str, op: str, q, fn, kwargs):
         """Uncoalesced execution (store lacks the batched op, window

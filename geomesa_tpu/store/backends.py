@@ -22,6 +22,7 @@ from typing import Any
 import numpy as np
 
 from geomesa_tpu import obs
+from geomesa_tpu.obs import ledger as _rtledger
 from geomesa_tpu.curve.binned_time import BinnedTime
 from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
 from geomesa_tpu.filter import ast
@@ -651,7 +652,8 @@ class TpuBackend(ExecutionBackend):
         self.pool.touch(type_name, index.name)
         with self.pool.pinned(type_name, index.name):
             with obs.span("dispatch.count", queries=nq, pairs=len(pair_q)):
-                counts = np.asarray(
+                # inter-stage host sync: the pair counts size the gather
+                counts = _rtledger.materialize(
                     cached_planned_count_step(mesh, nqp, B, budget, chunk,
                                               overlap=overlap)(
                         *args, jnp.asarray(pq[None]), jnp.asarray(pb[None]),
@@ -668,8 +670,8 @@ class TpuBackend(ExecutionBackend):
                     *args, jnp.asarray(pq), jnp.asarray(pb),
                     jnp.asarray(boxes), jnp.asarray(times),
                 )
-                buf = np.asarray(buf)
-                hits = np.asarray(hits)
+                buf = _rtledger.materialize(buf)
+                hits = _rtledger.materialize(hits)
         # per-pair spans: a pair's rows sit in its OWNER shard's buffer,
         # consecutively in pair-index order (the device scan's write order)
         blocks_per_shard = dev.rows_per_shard // B
@@ -788,7 +790,10 @@ class TpuBackend(ExecutionBackend):
         else:
             count_step = (cached_select_count_step_bbox if bbox_mode
                           else cached_select_count_step)(mesh)
-            per_shard = np.asarray(
+            # the inter-stage host sync of the two-pass route: the count
+            # result must land on host before the gather capacity exists
+            # (ledger.materialize = np.asarray + roundtrip sync accounting)
+            per_shard = _rtledger.materialize(
                 count_step(*col_args, d_idx, d_counts, d_boxes, d_times)
             )
             top = int(per_shard.max())
@@ -798,8 +803,8 @@ class TpuBackend(ExecutionBackend):
         pos, hits = gather(mesh, capacity)(
             *col_args, d_idx, d_counts, d_boxes, d_times
         )
-        pos = np.asarray(pos)
-        hits = np.asarray(hits)
+        pos = _rtledger.materialize(pos)
+        hits = _rtledger.materialize(hits)
         return np.concatenate(
             [pos[d, : hits[d]] for d in range(n_shards)]
         ).astype(np.int64)

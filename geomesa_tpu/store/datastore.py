@@ -524,7 +524,8 @@ class DataStore:
     @mutation(kind="rename", invalidates=(
         "geoblocks-query-cache", "buffer-pool", "device-cost-table",
         "spill-ledger", "planner-calibration-table",
-        "persisted-cost-sidecar", "track-state-cache"))
+        "persisted-cost-sidecar", "track-state-cache",
+        "query-lens", "roundtrip-ledger"))
     def update_schema(
         self,
         type_name: str,
@@ -670,7 +671,8 @@ class DataStore:
     @mutation(kind="delete_schema", invalidates=(
         "geoblocks-query-cache", "buffer-pool", "device-cost-table",
         "spill-ledger", "planner-calibration-table",
-        "persisted-cost-sidecar", "track-state-cache"))
+        "persisted-cost-sidecar", "track-state-cache",
+        "query-lens", "roundtrip-ledger"))
     def delete_schema(self, name: str) -> None:
         if self._wal_active():
             from geomesa_tpu.store import wal as _walmod
@@ -713,6 +715,15 @@ class DataStore:
         devmon.ledger().clear_spills(name)
         devmon.costs().forget(name)
         costmodel.model().forget(name)
+        # the retained profiling plane (obs.lens) and the roundtrip rollup
+        # (obs.ledger) key series by type name too: a recreated same-name
+        # type must not inherit its predecessor's latency history or
+        # fusion ranking (and the sentinel must not compare across them)
+        from geomesa_tpu.obs import lens as _lensmod
+        from geomesa_tpu.obs import ledger as _rtledger
+
+        _lensmod.get().forget(name)
+        _rtledger.table().forget(name)
         # the PERSISTED cost sidecar too: a restart must not resurrect a
         # deleted/renamed type's profile for an unrelated successor
         devmon.purge_persisted_costs(name)
@@ -1277,11 +1288,16 @@ class DataStore:
             # this tree brackets with block_until_ready timing, and the
             # breakdown lands in the flight record + cost table (_audit)
             from geomesa_tpu.obs import devmon
+            from geomesa_tpu.obs import ledger as _rtledger
 
-            if devmon.sampled(q.hints.get("devprof")):
-                with devmon.profiled():
-                    return self._run_query(st, type_name, q)
-            return self._run_query(st, type_name, q)
+            # host-roundtrip ledger (obs.ledger): every device dispatch /
+            # host sync under this query charges the per-query ledger;
+            # _audit folds it into the per-signature fusion rollup
+            with _rtledger.roundtrip():
+                if devmon.sampled(q.hints.get("devprof")):
+                    with devmon.profiled():
+                        return self._run_query(st, type_name, q)
+                return self._run_query(st, type_name, q)
 
     def _run_query(self, st: _TypeState, type_name: str, q: Query) -> QueryResult:
         import time as _time
@@ -1737,7 +1753,14 @@ class DataStore:
         # ONE batch span; every query lands a per-query child span (the
         # fallback path through query() and the batched tail both open one)
         with obs.span("select_many", n_queries=len(queries)):
-            return self._run_select_many(type_name, queries)
+            # one SHARED roundtrip ledger for the whole batch: the batched
+            # dispatches charge every member query's signature (the
+            # coalescer attribution contract); per-query fallbacks open
+            # their own nested ledger inside query()
+            from geomesa_tpu.obs import ledger as _rtledger
+
+            with _rtledger.roundtrip():
+                return self._run_select_many(type_name, queries)
 
     def _run_select_many(self, type_name: str, queries) -> list:
         import time as _time
@@ -1876,8 +1899,11 @@ class DataStore:
                         tbl, rws, density, stats_out, bin_data = reduce_result(
                             st.sft, table, rows, q)
                     tail_ms = (_time.perf_counter() - tq0) * 1000.0
+                    from geomesa_tpu.obs import devmon as _devmon
+
                     self._audit(type_name, q, plan_ms / len(qs),
-                                shared_ms / len(idxs) + tail_ms, len(tbl))
+                                shared_ms / len(idxs) + tail_ms, len(tbl),
+                                sig=_devmon.plan_signature(info, q))
                 results[i] = QueryResult(
                     tbl, rws, info, density=density, stats=stats_out,
                     bin_data=bin_data,
@@ -3000,7 +3026,7 @@ class DataStore:
                       ok=False)
 
     def _audit(self, type_name: str, q: Query, plan_ms: float, scan_ms: float,
-               hits: int, info=None) -> None:
+               hits: int, info=None, sig: str | None = None) -> None:
         # audit-shadow executions (obs/audit.py: referee comparisons,
         # the divergence minimizer, bundle replay) are invisible to the
         # feedback planes — cost table, usage metering, SLO burn,
@@ -3029,7 +3055,11 @@ class DataStore:
         # request-scoped context the web layer / replay harness bound —
         # anonymous embedded callers land on the default tenant
         tenant = q.hints.get("tenant") or usage.current_tenant()
-        sig = devmon.plan_signature(info, q)
+        # batched paths (select_many) pass their planned signature
+        # explicitly: they audit with info=None (amortized timings must
+        # not train the cost table) but their lens/ledger attribution
+        # still keys on the REAL plan signature
+        sig = sig if sig is not None else devmon.plan_signature(info, q)
         predicted = None
         # only FULLY PLANNED, individually timed executions feed the cost
         # table: batched paths audit with amortized-zero timings and no
@@ -3075,6 +3105,28 @@ class DataStore:
         )
         self.slo.observe("store.query", ok=True, key=type_name,
                          latency_ms=plan_ms + scan_ms)
+        # retained profiling plane (obs.lens) + roundtrip rollup
+        # (obs.ledger): the lens takes the latency histogram point with
+        # the submitter's trace exemplar (a coalesced follower's stamped
+        # trace_id hint wins over the leader's batch span, so exemplars
+        # resolve to DISJOINT stitched trees); the rollup charges this
+        # query's dispatch/sync/host-gap ledger to its plan signature —
+        # every member of a coalesced batch charges the SHARED ledger once
+        from geomesa_tpu.obs import ledger as _rtledger
+        from geomesa_tpu.obs import lens as _lensmod
+
+        ql = _rtledger.current()
+        trace_id = q.hints.get("trace_id") or ""
+        if not trace_id:
+            sp = obs.current()
+            trace_id = sp.trace_id if sp is not None else ""
+        _lensmod.get().observe(
+            type_name, sig, latency_ms=plan_ms + scan_ms, rows=hits,
+            dispatches=ql.dispatches if ql is not None else 0,
+            trace_id=trace_id)
+        if ql is not None:
+            _rtledger.table().charge(type_name, sig, ql,
+                                     wall_ms=plan_ms + scan_ms)
         # per-tenant usage metering (obs.usage): one leaf-lock append, the
         # same cost class as the flight record — the accounting substrate
         # ROADMAP item 4's admission controller consumes

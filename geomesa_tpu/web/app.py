@@ -35,6 +35,14 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
                                                  (+ per-member aggregates)
     GET    /api/obs/tenants?limit=               per-tenant usage accounting
     GET    /api/obs/audit?limit=                 continuous correctness auditor
+    GET    /api/obs/lens?limit=&window=&type=    retained per-plan-signature
+                                                 latency history + exemplars
+                                                 (+ regression sentinel state)
+    GET    /api/obs/lens?trace=<id>              resolve one exemplar trace_id
+                                                 to its stitched span tree
+    GET    /api/obs/fusion?limit=                host-roundtrip fusion report
+                                                 (signatures ranked by host-
+                                                 choreography share)
     GET    /api/metrics                          metrics snapshot (+ device
                                                  HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
@@ -202,6 +210,11 @@ class GeoMesaApp:
             ("GET", r"^/api/obs/costs$", self._obs_costs),
             ("GET", r"^/api/obs/tenants$", self._obs_tenants),
             ("GET", r"^/api/obs/audit$", self._obs_audit),
+            # profiling plane: retained latency history + trace exemplars,
+            # the host-roundtrip fusion report (docs/observability.md
+            # § Query lens & host-roundtrip ledger)
+            ("GET", r"^/api/obs/lens$", self._obs_lens),
+            ("GET", r"^/api/obs/fusion$", self._obs_fusion),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -1185,6 +1198,50 @@ class GeoMesaApp:
         return 200, _obsaudit.get().snapshot(limit=limit or 32), \
             "application/json"
 
+    def _obs_lens(self, params, body):
+        """The retained profiling plane (``geomesa-tpu obs lens`` pulls
+        this): per-(type, plan-signature) time-bucketed latency history,
+        live-window quantiles, trace exemplars (each resolvable to a
+        stitched span tree), plus the regression sentinel's alarm state —
+        docs/observability.md § Query lens & host-roundtrip ledger."""
+        from geomesa_tpu.obs import lens as _lensmod
+        from geomesa_tpu.obs import trace as _obstrace
+
+        trace_id = params.get("trace")
+        if trace_id:
+            # exemplar resolution: bucket → trace_id → stitched span tree,
+            # straight off the completed-roots ring (404 once it ages out)
+            root = _obstrace.find_trace(trace_id)
+            if root is None:
+                return 404, {"error": f"trace not found: {trace_id!r}"}, \
+                    "application/json"
+            return 200, _obstrace.span_doc(root), "application/json"
+
+        limit = self._int_param(params, "limit")
+        try:
+            window_s = float(params.get("window") or 300.0)
+        except ValueError:
+            return 400, {"error": f"bad window: {params['window']!r}"}, \
+                "application/json"
+        out = _lensmod.get().snapshot(
+            limit=limit or 50, window_s=window_s,
+            type_name=params.get("type") or None)
+        out["sentinel"] = _lensmod.sentinel().snapshot()
+        return 200, out, "application/json"
+
+    def _obs_fusion(self, params, body):
+        """The host-roundtrip fusion-opportunity report (``geomesa-tpu
+        obs fusion-report`` pulls this): plan signatures ranked by
+        host-choreography share — dispatches/syncs per query, inter-stage
+        host gaps, transfer bytes. The work list for whole-plan device
+        compilation (ROADMAP item 1)."""
+        from geomesa_tpu.obs import ledger as _rtledger
+
+        limit = self._int_param(params, "limit")
+        return 200, {
+            "entries": _rtledger.table().fusion_report(limit=limit or 50),
+        }, "application/json"
+
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
         # the store's SLO engine (DataStore and MergedDataStoreView both
@@ -1233,6 +1290,13 @@ class GeoMesaApp:
             from geomesa_tpu.store import wal as _walmod
 
             text += _walmod.prometheus_text()
+            # query lens: TRUE histogram families (geomesa_lens_latency_ms
+            # _bucket/_sum/_count with le labels) per (type, signature),
+            # plus the regression sentinel's gauge + counter
+            from geomesa_tpu.obs import lens as _lensmod
+
+            text += _lensmod.get().prometheus_text()
+            text += _lensmod.sentinel().prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget
@@ -1277,6 +1341,14 @@ class GeoMesaApp:
         wal_m = _walmod.wal_metrics()
         if any(wal_m.values()):
             out["wal"] = wal_m
+        # query lens summary (full detail at GET /api/obs/lens): only once
+        # something has been observed — plain scrapes skip the section
+        from geomesa_tpu.obs import lens as _lensmod
+
+        lens_obj = _lensmod.get()
+        if lens_obj.observe_count:
+            out["lens"] = lens_obj.snapshot(limit=8)
+            out["lens"]["sentinel"] = _lensmod.sentinel().snapshot()
         # serving plane: admission decisions + coalesce effectiveness
         if self.admission is not None:
             out["admission"] = self.admission.snapshot(limit=16)

@@ -114,6 +114,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_trajectory.py -q
 # See docs/operations.md § Durability & recovery.
 JAX_PLATFORMS=cpu python -m pytest tests/test_durability.py -q
 
+# query-lens gate (ISSUE 17): the retained per-(type, plan-signature)
+# profiling plane — window quantiles off merged histogram bins, trace
+# exemplars resolving bucket → trace_id → span tree, the host-roundtrip
+# ledger's staged-vs-fused dispatch attribution (staged >= 2 dispatches
+# + >= 1 sync per query; cached fused path exactly 1), coalesced-batch
+# attribution to every member signature, the regression sentinel
+# red/green (one 2x window fires A_REGRESSION; 10 steady windows fire
+# nothing), the recompile census, parser-checked TRUE Prometheus
+# histogram families, and the <2% always-on lens+ledger overhead bound
+# on the cached-jit select path. See docs/observability.md § Query lens
+# & host-roundtrip ledger.
+JAX_PLATFORMS=cpu python -m pytest tests/test_lens.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
