@@ -1,10 +1,12 @@
 """tpulint CLI: ``python -m geomesa_tpu.analysis [paths...]``.
 
-Three prongs share this entry point: the per-module lint rules
-(default), ``--race`` (tpurace R001-R003), and ``--flow`` (tpuflow
-F001-F003 over the contract registry); ``--all-prongs`` runs all three
-in one invocation and, with ``--format sarif``, emits one log with one
-run per prong.
+Four prongs share this entry point: the per-module lint rules
+(default), ``--race`` (tpurace R001-R003), ``--flow`` (tpuflow
+F001-F003 over the contract registry), and ``--sync`` (tpusync
+S001-S004 dispatch/host-sync budgets; add ``--reconcile ledger.json``
+to check the static bounds against a live-exported host-roundtrip
+ledger); ``--all-prongs`` runs all four in one invocation and, with
+``--format sarif``, emits one log with one run per prong.
 
 Exit codes: 0 = clean against waivers+baseline, 1 = new violations,
 2 = usage error, 3 = the analysis itself crashed (a crash must never
@@ -39,6 +41,7 @@ from geomesa_tpu.analysis.report import (
 
 _RACE_IDS = frozenset({"R001", "R002", "R003"})
 _FLOW_IDS = frozenset({"F001", "F002", "F003"})
+_SYNC_IDS = frozenset({"S001", "S002", "S003", "S004"})
 
 
 def default_target() -> str:
@@ -52,7 +55,8 @@ def _build_parser() -> argparse.ArgumentParser:
         description="tpulint: JAX/Pallas-aware static analysis for "
                     "geomesa_tpu (rules J001-J004, C001, W001; "
                     "--race runs the tpurace rules R001-R003; --flow "
-                    "runs the tpuflow contract rules F001-F003).",
+                    "runs the tpuflow contract rules F001-F003; --sync "
+                    "runs the tpusync budget rules S001-S004).",
     )
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint "
@@ -68,10 +72,22 @@ def _build_parser() -> argparse.ArgumentParser:
                              "analysis (F001 epoch/invalidation coherence, "
                              "F002 shadow-plane taint, F003 two-band f64 "
                              "discipline)")
+    parser.add_argument("--sync", action="store_true",
+                        help="run the whole-program tpusync budget "
+                             "analysis (S001 dispatch budget exceeded, "
+                             "S002 host sync in a sync-free region, S003 "
+                             "loop-carried dispatch, S004 unmodeled "
+                             "jit boundary)")
+    parser.add_argument("--reconcile", metavar="FILE",
+                        help="with --sync: check declared dispatch "
+                             "budgets against a live host-roundtrip "
+                             "ledger snapshot (geomesa-tpu obs "
+                             "ledger-export); a measured rate above the "
+                             "static bound is an S001 finding")
     parser.add_argument("--all-prongs", action="store_true",
-                        help="run lint + race + flow in one invocation "
-                             "(with --format sarif: one log, one run per "
-                             "prong)")
+                        help="run lint + race + flow + sync in one "
+                             "invocation (with --format sarif: one log, "
+                             "one run per prong)")
     parser.add_argument("--guards", action="store_true",
                         help="with --race: print the inferred guard map "
                              "(which lock protects which field) and exit")
@@ -131,7 +147,11 @@ def _validate_rules(args, config: LintConfig) -> int | None:
         print(f"tpulint: --flow with --rules {args.rules} selects no "
               f"flow rule (F001/F002/F003/W001)", file=sys.stderr)
         return 2
-    if not args.race and not args.flow:
+    if args.sync and not requested & (_SYNC_IDS | {"W001"}):
+        print(f"tpulint: --sync with --rules {args.rules} selects no "
+              f"sync rule (S001/S002/S003/S004/W001)", file=sys.stderr)
+        return 2
+    if not args.race and not args.flow and not args.sync:
         if requested <= _RACE_IDS:
             print(f"tpulint: {args.rules} are whole-program race rules — "
                   f"pass --race to run them", file=sys.stderr)
@@ -140,9 +160,14 @@ def _validate_rules(args, config: LintConfig) -> int | None:
             print(f"tpulint: {args.rules} are whole-program flow rules — "
                   f"pass --flow to run them", file=sys.stderr)
             return 2
-        if requested <= (_RACE_IDS | _FLOW_IDS):
-            print(f"tpulint: {args.rules} mixes race and flow rules — "
-                  f"pass --race/--flow (or --all-prongs)", file=sys.stderr)
+        if requested <= _SYNC_IDS:
+            print(f"tpulint: {args.rules} are whole-program sync rules — "
+                  f"pass --sync to run them", file=sys.stderr)
+            return 2
+        if requested <= (_RACE_IDS | _FLOW_IDS | _SYNC_IDS):
+            print(f"tpulint: {args.rules} mixes whole-program prongs — "
+                  f"pass --race/--flow/--sync (or --all-prongs)",
+                  file=sys.stderr)
             return 2
     return None
 
@@ -156,6 +181,10 @@ def _analyze(args, config: LintConfig, paths: list[str]):
         lint_paths_cached,
     )
     from geomesa_tpu.analysis.race import analyze_race_paths
+    from geomesa_tpu.analysis.sync import (
+        analyze_sync_paths,
+        load_ledger_export,
+    )
 
     use_cache = args.changed_only and not args.full
     caching = args.changed_only or args.full
@@ -171,16 +200,28 @@ def _analyze(args, config: LintConfig, paths: list[str]):
                                         use_cache=use_cache)
         return fn(paths, config)
 
+    def run_sync():
+        if args.reconcile:
+            # ledger contents are outside the tree fingerprint — a
+            # cached result could mask a fresh divergence, so reconcile
+            # always analyzes live
+            entries = load_ledger_export(args.reconcile)
+            return analyze_sync_paths(paths, config, reconcile=entries)
+        return run_whole("sync", analyze_sync_paths)
+
     if args.all_prongs:
         return [
             ("tpulint", run_lint()),
             ("tpurace", run_whole("race", analyze_race_paths)),
             ("tpuflow", run_whole("flow", analyze_flow_paths)),
+            ("tpusync", run_sync()),
         ]
     if args.race:
         return [("tpurace", run_whole("race", analyze_race_paths))]
     if args.flow:
         return [("tpuflow", run_whole("flow", analyze_flow_paths))]
+    if args.sync:
+        return [("tpusync", run_sync())]
     return [("tpulint", run_lint())]
 
 
@@ -226,6 +267,15 @@ def main(argv: list[str] | None = None) -> int:
         # a parse failure silently shrinks the map: that is an incomplete
         # analysis, not a clean one — it must not exit 0
         return 1 if errors else 0
+
+    if args.reconcile and not (args.sync or args.all_prongs):
+        print("tpulint: --reconcile requires --sync (budgets are a "
+              "tpusync view)", file=sys.stderr)
+        return 2
+    if args.reconcile and not os.path.exists(args.reconcile):
+        print(f"tpulint: --reconcile: no such file: {args.reconcile}",
+              file=sys.stderr)
+        return 2
 
     if args.contracts:
         if not args.flow:

@@ -44,13 +44,31 @@ Vocabulary:
 - :func:`device_band` — two-band f64 discipline roles: ``certain``
   functions must stay free of f64, ``cand`` results must flow into a
   ``refine`` call (or be returned to a caller that does) — F003.
+
+The dispatch/host-sync budget markers below feed the FOURTH prong,
+``--sync`` (:mod:`geomesa_tpu.analysis.sync`), which proves the fusion
+work of ROADMAP item 1 statically — worst-case dispatch counts over the
+cross-module call graph, host-sync reachability, loop-carried dispatch:
+
+- :func:`dispatch_budget` — an upper bound on device dispatches one
+  call may issue (S001), optionally tied to runtime plan signatures so
+  ``--sync --reconcile`` can compare the static bound against the
+  host-roundtrip ledger's measured counts.
+- :func:`host_sync_free` — no host↔device sync is reachable before the
+  function returns (S002); intentional awaits retire with a
+  ``# tpusync: retire`` comment at the site.
+- :func:`choreography_boundary` — the one sanctioned stage-orchestration
+  layer: per-item fallback loops inside it are by-design host
+  choreography, exempt from S003/S004 and contributing zero dispatch
+  cost to callers (budgeted methods of a boundary class opt back in).
 """
 
 from __future__ import annotations
 
 __all__ = [
     "cache_surface", "mutation", "feedback_sink", "shadow_plane",
-    "shadow_guard", "device_band", "MUTATION_KINDS", "DEATH_KINDS",
+    "shadow_guard", "device_band", "dispatch_budget", "host_sync_free",
+    "choreography_boundary", "MUTATION_KINDS", "DEATH_KINDS",
 ]
 
 # The mutation taxonomy F001 reasons over. ``DEATH_KINDS`` are the
@@ -133,3 +151,48 @@ def device_band(*, certain=False, cand=False, refine=False):
         return fn
 
     return deco
+
+
+def dispatch_budget(n, *, signatures=()):
+    """Declare that one call of this function issues at most ``n`` device
+    dispatches, worst case, through the whole cross-module call graph
+    (a dispatch = one invocation of a ``cached_*_step`` step or a
+    jit-compiled ``parallel/query`` callable). ``n`` must be a literal
+    int — the ``--sync`` prong computes the structural worst case
+    (branches take the max arm, constant-trip loops multiply, a
+    non-constant loop around a dispatch is unbounded) and S001 fires
+    with the witness chain when it exceeds ``n``.
+
+    ``signatures``: optional :func:`fnmatch.fnmatch` globs over runtime
+    plan signatures (``geomesa_tpu.obs.devmon.plan_signature`` — e.g.
+    ``"z2:iv16:rows"``; ``"*:rows"`` covers every row-select plan).
+    ``--sync --reconcile ledger.json`` matches exported ledger rows
+    against these globs and flags any signature whose MEASURED
+    dispatches-per-query exceed the declared bound — a divergence means
+    a boundary op the static model missed, or a wrong contract."""
+
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+def host_sync_free(fn):
+    """Declare that no host↔device synchronization — ``block_until_ready``,
+    ``.item()``, ``np.asarray`` of a device value, an implicit
+    ``bool()``/``float()`` coercion, ``obs.ledger.materialize`` — is
+    reachable through the call graph before this function returns
+    (S002). The intentional await that ends a device pipeline retires
+    with ``# tpusync: retire`` on the site's line (mirroring F003's
+    refine-merge retirement)."""
+    return fn
+
+
+def choreography_boundary(obj):
+    """Mark a class or function as the sanctioned stage-orchestration
+    layer (the datastore facade): its per-query fallback loops and
+    routing are host choreography BY DESIGN. The ``--sync`` prong skips
+    S003/S004 inside it and charges callers zero dispatch cost for
+    calling into it, so staged paths don't drown the report. A method
+    carrying its own :func:`dispatch_budget` opts back into S001."""
+    return obj

@@ -127,12 +127,12 @@ class Module:
         return ""
 
 
-# All three prongs share one waiver namespace — ``# tpulint:``,
-# ``# tpurace:``, and ``# tpuflow:`` are interchangeable spellings of the
-# same suppression (intent stays greppable per prong; W001 judges them
-# all through this single tokenizer).
+# All four prongs share one waiver namespace — ``# tpulint:``,
+# ``# tpurace:``, ``# tpuflow:``, and ``# tpusync:`` are interchangeable
+# spellings of the same suppression (intent stays greppable per prong;
+# W001 judges them all through this single tokenizer).
 _WAIVER = re.compile(
-    r"#\s*tpu(?:lint|race|flow):\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"#\s*tpu(?:lint|race|flow|sync):\s*disable(?P<next>-next-line)?\s*=\s*"
     r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)"
 )
 

@@ -129,9 +129,10 @@ def render_json(violations: list[Violation]) -> str:
 # which registered rule ids each prong's SARIF driver advertises; W001 is
 # shared hygiene and appears under every driver (each prong judges it)
 _PRONG_RULE_FILTERS = {
-    "tpulint": lambda rid: rid[:1] not in ("R", "F"),
+    "tpulint": lambda rid: rid[:1] not in ("R", "F", "S"),
     "tpurace": lambda rid: rid[:1] == "R" or rid == "W001",
     "tpuflow": lambda rid: rid[:1] == "F" or rid == "W001",
+    "tpusync": lambda rid: rid[:1] == "S" or rid == "W001",
 }
 
 

@@ -28,6 +28,7 @@ _RULE_MODULES = (
     "geomesa_tpu.analysis.rules.concurrency",
     "geomesa_tpu.analysis.race.rules",
     "geomesa_tpu.analysis.flow.registry",
+    "geomesa_tpu.analysis.sync.registry",
 )
 
 

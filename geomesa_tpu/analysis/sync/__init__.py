@@ -1,0 +1,23 @@
+"""tpusync — the static dispatch/host-sync budget prong.
+
+``python -m geomesa_tpu.analysis --sync`` is the CLI spelling (add
+``--reconcile ledger.json`` to check static budgets against a
+live-exported host-roundtrip ledger);
+:mod:`geomesa_tpu.analysis.contracts` holds the ``dispatch_budget`` /
+``host_sync_free`` / ``choreography_boundary`` vocabulary;
+:mod:`geomesa_tpu.analysis.sync.rules` documents the S001-S004 rule
+families."""
+
+from geomesa_tpu.analysis.sync.rules import (
+    LEDGER_EXPORT_KIND,
+    SYNC_RULE_IDS,
+    active_sync_rules,
+    analyze_sync_modules,
+    analyze_sync_paths,
+    load_ledger_export,
+)
+
+__all__ = [
+    "LEDGER_EXPORT_KIND", "SYNC_RULE_IDS", "active_sync_rules",
+    "analyze_sync_modules", "analyze_sync_paths", "load_ledger_export",
+]

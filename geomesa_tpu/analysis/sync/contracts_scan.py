@@ -1,0 +1,207 @@
+"""AST scan for the tpusync contract vocabulary.
+
+Reads ``@dispatch_budget`` / ``@host_sync_free`` /
+``@choreography_boundary`` declarations off the parsed tree (same
+resolve-the-decorator-through-the-ImportMap discipline as the flow
+prong's :mod:`~geomesa_tpu.analysis.flow.contracts_scan`, and the same
+malformed-declaration rule: a contract the scanner cannot read
+statically is itself a finding — S001 here, since every sync contract
+ultimately bounds dispatch work).
+
+The flow scanner silently ignores these markers (unknown names fall
+through its dispatch) and this one ignores the flow vocabulary, so the
+two namespaces coexist on one decorated definition.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from geomesa_tpu.analysis.core import Module, Violation
+from geomesa_tpu.analysis.race.lockset import _module_id
+
+_NS = "geomesa_tpu.analysis.contracts."
+
+
+@dataclass
+class BudgetDecl:
+    """One ``@dispatch_budget(n, signatures=...)`` declaration."""
+
+    key: tuple                  # summary key of the decorated function
+    n: int
+    signatures: tuple[str, ...]
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class SyncFreeDecl:
+    key: tuple
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class ChoreoDecl:
+    keys: tuple                 # every entry key (all methods, for a class)
+    label: str
+    module: Module
+    line: int
+
+
+@dataclass
+class SyncContracts:
+    budgets: list[BudgetDecl] = field(default_factory=list)
+    sync_free: list[SyncFreeDecl] = field(default_factory=list)
+    choreo: list[ChoreoDecl] = field(default_factory=list)
+    # malformed declarations — S001 (an unreadable budget bounds nothing)
+    errors: list[Violation] = field(default_factory=list)
+
+    def choreo_keys(self) -> set[tuple]:
+        out: set[tuple] = set()
+        for c in self.choreo:
+            out.update(c.keys)
+        return out
+
+
+def _decl_error(module: Module, node: ast.AST, msg: str) -> Violation:
+    return Violation(
+        rule="S001", path=module.path, line=node.lineno, col=node.col_offset,
+        message=f"malformed sync contract declaration: {msg}")
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return _BAD
+
+
+_BAD = object()
+
+
+class _Scanner:
+    def __init__(self, project, contracts: SyncContracts):
+        self.project = project
+        self.out = contracts
+        self.node_class = {
+            id(info.node): keyed for keyed, info in project.classes.items()
+        }
+
+    def scan(self, module: Module) -> None:
+        imports = self.project.imports[module.relpath]
+        mid = _module_id(module.relpath)
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                keyed = self.node_class.get(id(node), node.name)
+                self._decorators(module, imports, node, cls=keyed)
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._decorators(module, imports, m, cls=keyed,
+                                         method=m.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorators(module, imports, node,
+                                 fn_key=("fn", mid, node.name))
+
+    def _decorators(self, module, imports, node, cls=None, method=None,
+                    fn_key=None) -> None:
+        if method is not None:
+            fn_key = ("method", cls, method)
+            label = f"{cls}.{method}"
+        elif fn_key is not None:
+            label = f"{fn_key[1]}:{fn_key[2]}"
+        else:
+            label = cls
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = imports.resolve(target)
+            if dotted is None or not dotted.startswith(_NS):
+                continue
+            marker = dotted[len(_NS):]
+            if marker == "dispatch_budget":
+                self._budget(module, dec, label, fn_key)
+            elif marker == "host_sync_free":
+                self._sync_free(module, dec, label, fn_key)
+            elif marker == "choreography_boundary":
+                self._choreo(module, dec, label, cls, method, fn_key)
+            # flow vocabulary (cache_surface, device_band, ...) falls
+            # through — the flow scanner owns it
+
+    def _budget(self, module, dec, label, fn_key) -> None:
+        if fn_key is None:
+            self.out.errors.append(_decl_error(
+                module, dec, "@dispatch_budget applies to "
+                "functions/methods, not classes"))
+            return
+        if not isinstance(dec, ast.Call) or not dec.args:
+            self.out.errors.append(_decl_error(
+                module, dec, "@dispatch_budget requires a literal int "
+                "bound: @dispatch_budget(n)"))
+            return
+        n = _literal(dec.args[0])
+        if n is _BAD or not isinstance(n, int) or isinstance(n, bool) \
+                or n < 0 or len(dec.args) > 1:
+            self.out.errors.append(_decl_error(
+                module, dec, "@dispatch_budget bound must be one literal "
+                "non-negative int (a computed budget cannot be checked "
+                "statically)"))
+            return
+        sigs: tuple[str, ...] = ()
+        for k in dec.keywords:
+            if k.arg != "signatures":
+                self.out.errors.append(_decl_error(
+                    module, dec,
+                    f"unknown @dispatch_budget argument {k.arg!r}"))
+                return
+            v = _literal(k.value)
+            if isinstance(v, str):
+                v = (v,)
+            if v is _BAD or not isinstance(v, (tuple, list)) \
+                    or not all(isinstance(s, str) for s in v):
+                self.out.errors.append(_decl_error(
+                    module, dec, "signatures= must be a literal str or "
+                    "tuple of plan-signature globs"))
+                return
+            sigs = tuple(v)
+        self.out.budgets.append(BudgetDecl(
+            key=fn_key, n=n, signatures=sigs, label=label,
+            module=module, line=dec.lineno))
+
+    def _sync_free(self, module, dec, label, fn_key) -> None:
+        if fn_key is None:
+            self.out.errors.append(_decl_error(
+                module, dec, "@host_sync_free applies to "
+                "functions/methods, not classes"))
+            return
+        if isinstance(dec, ast.Call):
+            self.out.errors.append(_decl_error(
+                module, dec, "@host_sync_free takes no arguments"))
+            return
+        self.out.sync_free.append(SyncFreeDecl(
+            key=fn_key, label=label, module=module, line=dec.lineno))
+
+    def _choreo(self, module, dec, label, cls, method, fn_key) -> None:
+        if isinstance(dec, ast.Call):
+            self.out.errors.append(_decl_error(
+                module, dec, "@choreography_boundary takes no arguments"))
+            return
+        if fn_key is not None:
+            keys = (fn_key,)
+        else:
+            info = self.project.classes.get(cls)
+            keys = tuple(
+                ("method", cls, m) for m in (info.methods if info else ())
+            )
+        self.out.choreo.append(ChoreoDecl(
+            keys=keys, label=label, module=module, line=dec.lineno))
+
+
+def scan_sync_contracts(project, modules: list[Module]) -> SyncContracts:
+    out = SyncContracts()
+    scanner = _Scanner(project, out)
+    for mod in modules:
+        scanner.scan(mod)
+    return out

@@ -1,0 +1,52 @@
+"""Registry descriptors for the tpusync rules.
+
+S001-S004 are WHOLE-PROGRAM rules (``project = True``): their findings
+come from :func:`geomesa_tpu.analysis.sync.rules.analyze_sync_paths`
+(the ``--sync`` CLI mode), not the per-module ``check`` pass — the
+``check`` here is a no-op so the ids still resolve for ``--list-rules``,
+``--rules`` filtering, waivers, baselines, and SARIF rule metadata
+(same pattern as the tpurace/tpuflow descriptors)."""
+
+from __future__ import annotations
+
+from geomesa_tpu.analysis.rules import register
+
+
+@register
+class DispatchBudgetExceeded:
+    id = "S001"
+    title = "worst-case (or ledger-measured) dispatches above the budget"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class HostSyncReachable:
+    id = "S002"
+    title = "host sync reachable inside a host_sync_free/device_band region"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class LoopCarriedDispatch:
+    id = "S003"
+    title = "dispatch inside a loop with a non-constant trip count"
+    project = True
+
+    def check(self, mod, config):
+        return ()
+
+
+@register
+class UnmodeledBoundary:
+    id = "S004"
+    title = "raw jax.jit/pmap call bypassing the cached_* step factories"
+    project = True
+
+    def check(self, mod, config):
+        return ()

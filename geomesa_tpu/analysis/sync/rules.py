@@ -1,0 +1,978 @@
+"""tpusync: static dispatch/host-sync budget rules (S001-S004).
+
+The fourth analysis prong. ROADMAP item 1 (whole-plan device
+compilation — ONE dispatch per query) has a *measured* work list in the
+host-roundtrip ledger (``obs fusion-report``); this prong is the
+*proof* side: it classifies device-boundary operations off the AST,
+propagates worst-case dispatch counts over the same cross-module
+call-graph machinery as tpurace/tpuflow, and checks them against the
+budgets the live code declares through
+:mod:`geomesa_tpu.analysis.contracts`:
+
+- **S001 dispatch budget exceeded** — a ``@dispatch_budget(n)``
+  function whose structural worst case (branches take the max arm,
+  constant-trip loops multiply, calls add the callee's fixpoint cost)
+  exceeds ``n``, reported with the witness call chain. Malformed sync
+  contract declarations land here too, as do ``--reconcile``
+  divergences (a ledger-measured dispatch rate above the static bound).
+- **S002 host sync reachable in a sync-free region** — a
+  ``block_until_ready`` / ``.item()`` / ``np.asarray``-of-device-value /
+  implicit coercion / ``obs.ledger.materialize`` site reachable through
+  the call graph from a ``@host_sync_free`` or
+  ``@device_band(certain=True)`` function. The intentional await that
+  ends a pipeline retires with ``# tpusync: retire`` on its line
+  (``retire-next-line`` from the line above), read through the shared
+  tokenizer so docstring mentions stay inert.
+- **S003 loop-carried dispatch** — a dispatch site (or a call chain
+  with positive dispatch cost) inside a Python loop whose trip count is
+  not a compile-time constant: the per-iteration host-roundtrip
+  serialization the batched paths exist to eliminate.
+- **S004 unmodeled boundary** — a raw ``jax.jit``/``jax.pmap`` CALL
+  expression (decorator uses are fine) outside the ``cached_*`` factory
+  discipline: invisible to the roundtrip ledger and to this analysis,
+  so nothing can budget it.
+
+What counts as a *dispatch site*: invoking a step built by the
+``cached_*_step``/``make_*_step`` factory family (``parallel/query.py``
+and fixtures alike — recognized by name through the ImportMap, including
+the ``gather = (f_bbox if bbox else f)`` aliasing idiom), and calling a
+project function decorated ``@jax.jit``/``@partial(jax.jit, ...)``.
+``@choreography_boundary`` functions are the sanctioned orchestration
+layer: exempt from S003/S004 and zero-cost to callers (a budgeted
+method of a boundary class opts back into S001).
+
+Heuristics, not proofs: the expected answer for a reviewed intentional
+site is a ``# tpusync: disable=Sxxx`` waiver with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import defaultdict
+from dataclasses import dataclass
+from fnmatch import fnmatch
+
+from geomesa_tpu.analysis.core import (
+    LintConfig,
+    Module,
+    Violation,
+    _comment_texts,
+    finalize_module_violations,
+)
+from geomesa_tpu.analysis.race.lockset import (
+    _FnScan,
+    _FnSummary,
+    _Project,
+    _module_id,
+    load_modules,
+)
+from geomesa_tpu.analysis.sync.contracts_scan import (
+    SyncContracts,
+    scan_sync_contracts,
+)
+
+__all__ = [
+    "SYNC_RULE_IDS", "LEDGER_EXPORT_KIND", "active_sync_rules",
+    "analyze_sync_modules", "analyze_sync_paths", "load_ledger_export",
+]
+
+SYNC_RULE_IDS = ("S001", "S002", "S003", "S004")
+
+#: A worst case at or above this is reported as "unbounded" (a dispatch
+#: under a non-constant loop, or recursion through a dispatch site).
+INF = 10 ** 9
+
+#: The export contract shared with ``obs/ledger.py`` — ``--reconcile``
+#: refuses anything else (a silent schema drift would fake a clean
+#: reconciliation).
+LEDGER_EXPORT_KIND = "geomesa-tpu-roundtrip-ledger"
+LEDGER_EXPORT_SCHEMA_VERSION = 1
+
+_JIT = frozenset({
+    "jax.jit", "jax.pmap", "jax.pjit", "jax.experimental.pjit.pjit",
+})
+
+_RETIRE = re.compile(r"#\s*tpusync:\s*retire(?P<next>-next-line)?\b")
+
+
+def active_sync_rules(config: LintConfig) -> set[str]:
+    if config.rules is None:
+        return set(SYNC_RULE_IDS)
+    return set(config.rules) & set(SYNC_RULE_IDS)
+
+
+def _factory_name(name: str) -> bool:
+    """The step-factory naming discipline: ``cached_*_step*`` /
+    ``make_*_step*`` (``parallel/query.py``'s J003 idiom)."""
+    seg = name.rsplit(".", 1)[-1]
+    return "_step" in seg and seg.lstrip("_").startswith(
+        ("cached_", "make_"))
+
+
+def _key_label(key: tuple) -> str:
+    return (f"{key[1]}.{key[2]}" if key[0] == "method"
+            else f"{key[1]}:{key[2]}")
+
+
+def _has_jit_decorator(fn: ast.AST, imports) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if imports.resolve(target) in _JIT:
+            return True
+        if isinstance(dec, ast.Call) \
+                and imports.resolve(dec.func) == "functools.partial" \
+                and dec.args and imports.resolve(dec.args[0]) in _JIT:
+            return True
+    return False
+
+
+def _jit_decorated_keys(project: _Project) -> set[tuple]:
+    """Project callables that ARE one dispatch per call: top-level
+    functions / methods decorated ``@jax.jit`` (or
+    ``@partial(jax.jit, ...)``). Calling one is a modeled boundary op,
+    not an S004 escape."""
+    out: set[tuple] = set()
+    for mod in project.modules:
+        imports = project.imports[mod.relpath]
+        mid = _module_id(mod.relpath)
+        for name, fn in project.functions[mid].items():
+            if _has_jit_decorator(fn, imports):
+                out.add(("fn", mid, name))
+    for cname, info in project.classes.items():
+        imports = project.imports[info.module.relpath]
+        for mname, m in info.methods.items():
+            if _has_jit_decorator(m, imports):
+                out.add(("method", cname, mname))
+    return out
+
+
+def _retired_lines(mod: Module) -> set[int]:
+    """Lines whose sync sites a ``# tpusync: retire`` comment blesses."""
+    out: set[int] = set()
+    for i, text in _comment_texts(mod.lines):
+        for m in _RETIRE.finditer(text):
+            out.add(i + 1 if m.group("next") else i)
+    return out
+
+
+def _const_trips(it: ast.AST):
+    """Compile-time-constant trip count of a ``for`` iterable, or None."""
+    if isinstance(it, (ast.Tuple, ast.List, ast.Set)):
+        return len(it.elts)
+    if isinstance(it, ast.Constant) and isinstance(it.value, (str, bytes)):
+        return len(it.value)
+    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name):
+        if it.func.id == "range" and it.args and not it.keywords:
+            vals = []
+            for a in it.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                    vals.append(a.value)
+                elif (isinstance(a, ast.UnaryOp)
+                        and isinstance(a.op, ast.USub)
+                        and isinstance(a.operand, ast.Constant)
+                        and isinstance(a.operand.value, int)):
+                    vals.append(-a.operand.value)
+                else:
+                    return None
+            try:
+                return len(range(*vals))
+            except (TypeError, ValueError):
+                return None
+        if it.func.id in ("enumerate", "reversed", "sorted", "tuple",
+                          "list") and it.args:
+            return _const_trips(it.args[0])
+        if it.func.id == "zip" and it.args:
+            ts = [_const_trips(a) for a in it.args]
+            if ts and all(t is not None for t in ts):
+                return min(ts)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function boundary scan → cost IR + sync/S004 sites
+# ---------------------------------------------------------------------------
+#
+# The IR is a tiny worst-case-cost tree built structurally from the
+# statement list (so the S001 evaluation and the S003 loop walk share
+# one shape):
+#
+#   ("seq",  [items])            cost = sum
+#   ("max",  [items])            cost = max (if/elif/else arms, try paths)
+#   ("loop", trips|None, body, line)
+#                                cost = trips × body; None trips with a
+#                                positive body cost = INF (and S003)
+#   ("site", line, label)        one dispatch
+#   ("call", key, line)          the callee's fixpoint cost
+
+
+@dataclass
+class _SyncSite:
+    line: int
+    col: int
+    what: str
+    retired: bool = False
+
+
+@dataclass
+class _FnSync:
+    key: tuple
+    label: str
+    module: Module
+    ir: tuple
+    calls: list[tuple]              # callee keys (S002 adjacency)
+    sync_sites: list[_SyncSite]
+    s004: list[tuple]               # (line, col, dotted)
+
+
+class _SyncScan(_FnScan):
+    """Boundary-op classifier: rides _FnScan's ImportMap/typing and
+    cross-module callee resolution, but drives statements structurally
+    (building the cost IR) instead of via generic traversal."""
+
+    def __init__(self, project, summary, fn, jit_fns: set[tuple]):
+        super().__init__(project, summary, fn, cross_module=True)
+        self.jit_fns = jit_fns
+        self.events: list[tuple] = []       # ("site", ...) | ("call", ...)
+        self.sync_sites: list[_SyncSite] = []
+        self.s004: list[tuple] = []
+        self.tainted: set[str] = set()      # device-resident locals
+        self.step_vars: set[str] = set()    # locals holding a built step
+        self.factory_vars: set[str] = set()  # locals aliasing a factory
+        self._device_calls: set[int] = set()  # id(Call) → device value
+        self._step_calls: set[int] = set()    # id(Call) → step callable
+
+    # -- structural statement driver ----------------------------------------
+    def scan(self, fn: ast.AST) -> tuple:
+        return ("seq", self._eval_block(fn.body))
+
+    def _eval_block(self, stmts) -> list:
+        items: list = []
+        for st in stmts:
+            items.extend(self._eval_stmt(st))
+        return items
+
+    def _eval_stmt(self, st: ast.stmt) -> list:
+        if isinstance(st, ast.If):
+            items = self._leaf(st.test)
+            self._implicit_bool(st.test)
+            arms = [("seq", self._eval_block(st.body)),
+                    ("seq", self._eval_block(st.orelse))]
+            return items + [("max", arms)]
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            items = self._leaf(st.iter)
+            trips = _const_trips(st.iter)
+            body = ("seq", self._eval_block(st.body)
+                    + self._eval_block(st.orelse))
+            return items + [("loop", trips, body, st.lineno)]
+        if isinstance(st, ast.While):
+            items = self._leaf(st.test)
+            self._implicit_bool(st.test)
+            body = ("seq", self._eval_block(st.body)
+                    + self._eval_block(st.orelse))
+            # a while's trip count is never a static constant
+            return items + [("loop", None, body, st.lineno)]
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            items = []
+            for it in st.items:
+                items += self._leaf(it.context_expr)
+            return items + self._eval_block(st.body)
+        if isinstance(st, ast.Try):
+            main = ("seq", self._eval_block(st.body)
+                    + self._eval_block(st.orelse))
+            arms = [main] + [("seq", self._eval_block(h.body))
+                             for h in st.handlers]
+            return [("max", arms)] + self._eval_block(st.finalbody)
+        if isinstance(st, getattr(ast, "Match", ())):
+            items = self._leaf(st.subject)
+            arms = [("seq", self._eval_block(c.body)) for c in st.cases]
+            return items + [("max", arms)]
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            # nested defs run who-knows-when; same rule as _FnScan
+            return []
+        return self._leaf(st)
+
+    def _leaf(self, node: ast.AST) -> list:
+        """Visit one leaf statement/expression; the boundary events it
+        produced become IR items in source order."""
+        mark = len(self.events)
+        self.visit(node)
+        items = self.events[mark:]
+        del self.events[mark:]
+        return items
+
+    def _implicit_bool(self, test: ast.AST) -> None:
+        nm = None
+        if isinstance(test, ast.Name):
+            nm = test.id
+        elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                and isinstance(test.operand, ast.Name):
+            nm = test.operand.id
+        if nm is not None and nm in self.tainted:
+            self.sync_sites.append(_SyncSite(
+                test.lineno, test.col_offset,
+                f"implicit bool() of device value {nm!r} in a branch test"))
+
+    # -- classification ------------------------------------------------------
+    def _ref_name(self, f: ast.AST) -> str | None:
+        dotted = self.imports.resolve(f)
+        if dotted is not None:
+            return dotted.rsplit(".", 1)[-1]
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        return None
+
+    def _is_factory_ref(self, f: ast.AST) -> bool:
+        if isinstance(f, ast.IfExp):
+            # the (f_bbox if bbox_mode else f)(mesh) selection idiom
+            return (self._is_factory_ref(f.body)
+                    and self._is_factory_ref(f.orelse))
+        if isinstance(f, ast.Name) and f.id in self.factory_vars:
+            return True
+        name = self._ref_name(f)
+        return name is not None and _factory_name(name)
+
+    def _yields_step(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and self._is_factory_ref(node.func)
+
+    def _arg_tainted(self, a: ast.AST) -> bool:
+        if isinstance(a, ast.Name):
+            return a.id in self.tainted
+        if isinstance(a, ast.Call):
+            return id(a) in self._device_calls
+        if isinstance(a, (ast.Subscript, ast.Attribute)):
+            return self._arg_tainted(a.value)
+        return False
+
+    def _visit_args(self, node: ast.Call) -> None:
+        for a in node.args:
+            self.visit(a)
+        for k in node.keywords:
+            self.visit(k.value)
+
+    def visit_Call(self, node: ast.Call):  # noqa: C901 — one classifier
+        f = node.func
+        dotted = self.imports.resolve(f)
+
+        # dispatch sites: invoking a built step (inline or via a local),
+        # or calling a @jax.jit-decorated project function
+        site = None
+        if isinstance(f, ast.Call) and self._yields_step(f):
+            site = f"{self._ref_name(f.func) or 'step'}(...)(...)"
+            self._step_calls.add(id(f))
+            self._visit_args(f)
+        elif isinstance(f, ast.Name) and f.id in self.step_vars:
+            site = f"{f.id}(...)"
+        else:
+            callee = self._callee_key(f)
+            if callee is not None and callee in self.jit_fns:
+                site = f"{self._ref_name(f)}(...) [@jax.jit]"
+        if site is not None:
+            self.events.append(("site", node.lineno, site))
+            self._device_calls.add(id(node))
+            self._visit_args(node)
+            if isinstance(f, ast.Attribute):
+                self.visit(f.value)
+            return
+
+        # a bare factory call builds a step (compile-cached: zero cost)
+        if self._yields_step(node):
+            self._step_calls.add(id(node))
+            self._visit_args(node)
+            return
+
+        # sync sites
+        sync = None
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            sync = ".block_until_ready()"
+        elif isinstance(f, ast.Attribute) and f.attr in ("item", "tolist") \
+                and self._arg_tainted(f.value):
+            sync = f".{f.attr}() on a device value"
+        elif dotted in ("numpy.asarray", "numpy.array") and node.args \
+                and self._arg_tainted(node.args[0]):
+            sync = f"{dotted} of a device value"
+        elif dotted == "jax.device_get":
+            sync = "jax.device_get"
+        elif dotted == "geomesa_tpu.obs.ledger.materialize":
+            sync = "obs.ledger.materialize (device→host readback)"
+        elif isinstance(f, ast.Name) and f.id in ("bool", "float", "int") \
+                and node.args and self._arg_tainted(node.args[0]):
+            sync = f"{f.id}() coercion of a device value"
+        if sync is not None:
+            self.sync_sites.append(_SyncSite(
+                node.lineno, node.col_offset, sync))
+            self._visit_args(node)
+            if isinstance(f, ast.Attribute):
+                self.visit(f.value)
+            return
+
+        # transfers: the result lives on device
+        if dotted in ("jax.device_put", "jax.numpy.asarray",
+                      "jax.numpy.array"):
+            self._device_calls.add(id(node))
+            self._visit_args(node)
+            return
+
+        # S004: a raw jit wrapper built outside the factory discipline
+        if dotted in _JIT:
+            self.s004.append((node.lineno, node.col_offset, dotted))
+            self._visit_args(node)
+            return
+
+        # ordinary call → call-graph edge
+        callee = self._callee_key(f)
+        if callee is not None:
+            self.events.append(("call", callee, node.lineno))
+        self._visit_args(node)
+        if isinstance(f, ast.Attribute):
+            self.visit(f.value)
+        elif not isinstance(f, ast.Name):
+            self.visit(f)
+
+    # a comprehension is a loop with a non-constant trip count: boundary
+    # events inside it wrap into an unbounded-loop IR node so S001/S003
+    # see ``[step(c) for c in chunks]`` for what it is
+    def _comprehension(self, node):
+        mark = len(self.events)
+        self.generic_visit(node)
+        items = self.events[mark:]
+        del self.events[mark:]
+        if items:
+            self.events.append(
+                ("loop", None, ("seq", items), node.lineno))
+
+    visit_ListComp = _comprehension
+    visit_SetComp = _comprehension
+    visit_DictComp = _comprehension
+    visit_GeneratorExp = _comprehension
+
+    # -- taint/step binding --------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        super().visit_Assign(node)
+        self._bind_targets(node.targets, node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        super().visit_AnnAssign(node)
+        if node.value is not None:
+            self._bind_targets([node.target], node.value)
+
+    def _value_kind(self, v: ast.AST) -> str | None:
+        if isinstance(v, ast.Call):
+            if id(v) in self._device_calls:
+                return "device"
+            if id(v) in self._step_calls:
+                return "step"
+            return None
+        if isinstance(v, ast.Name):
+            if v.id in self.tainted:
+                return "device"
+            if v.id in self.step_vars:
+                return "step"
+            if v.id in self.factory_vars:
+                return "factory"
+            return "factory" if self._is_factory_ref(v) else None
+        if isinstance(v, ast.Attribute):
+            if self._is_factory_ref(v):
+                return "factory"
+            return self._value_kind(v.value) if isinstance(
+                v.value, ast.Name) and v.value.id in self.tainted else None
+        if isinstance(v, ast.Subscript):
+            base = v.value
+            if isinstance(base, ast.Name) and base.id in self.tainted:
+                return "device"
+            return None
+        if isinstance(v, ast.IfExp):
+            a, b = self._value_kind(v.body), self._value_kind(v.orelse)
+            if a == b:
+                return a
+            return "device" if "device" in (a, b) else None
+        return None
+
+    def _bind_targets(self, targets, value) -> None:
+        kind = self._value_kind(value)
+        for t in targets:
+            for el in _iter_names(t):
+                self.tainted.discard(el)
+                self.step_vars.discard(el)
+                self.factory_vars.discard(el)
+                if kind == "device":
+                    self.tainted.add(el)
+                elif kind == "step":
+                    self.step_vars.add(el)
+                elif kind == "factory":
+                    self.factory_vars.add(el)
+
+
+def _iter_names(t: ast.AST):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _iter_names(el)
+    elif isinstance(t, ast.Starred):
+        yield from _iter_names(t.value)
+    elif isinstance(t, ast.Name):
+        yield t.id
+
+
+def _scan_functions(project: _Project,
+                    jit_fns: set[tuple]) -> dict[tuple, _FnSync]:
+    out: dict[tuple, _FnSync] = {}
+
+    def one(key, name, cls, mod, fn):
+        s = _FnSummary(key=key, name=name, cls=cls, module=mod)
+        scan = _SyncScan(project, s, fn, jit_fns)
+        ir = scan.scan(fn)
+        calls = [e[1] for e in _iter_ir_events(ir) if e[0] == "call"]
+        out[key] = _FnSync(
+            key=key, label=_key_label(key), module=mod, ir=ir,
+            calls=calls, sync_sites=scan.sync_sites, s004=scan.s004)
+
+    for mod in project.modules:
+        mid = _module_id(mod.relpath)
+        for name, fn in project.functions[mid].items():
+            one(("fn", mid, name), name, None, mod, fn)
+        for cname, info in project.classes.items():
+            if info.module is not mod:
+                continue
+            for mname, m in info.methods.items():
+                one(("method", cname, mname), mname, info, mod, m)
+    return out
+
+
+def _iter_ir_events(ir: tuple):
+    kind = ir[0]
+    if kind in ("seq", "max"):
+        for it in ir[1]:
+            yield from _iter_ir_events(it)
+    elif kind == "loop":
+        yield from _iter_ir_events(ir[2])
+    else:
+        yield ir
+
+
+# ---------------------------------------------------------------------------
+# worst-case dispatch cost: IR evaluation + call-graph fixpoint
+# ---------------------------------------------------------------------------
+
+def _cost_eval(ir: tuple, costs: dict, choreo: set[tuple],
+               group: frozenset = frozenset()) -> int:
+    """Worst case of one IR tree. ``group``: the evaluating function's
+    own choreography-boundary keys — absorption applies only at edges
+    crossing INTO a boundary from outside it, so a budgeted method of a
+    boundary class still sees the real cost of its intra-class callees
+    (the opt-back-into-S001 semantics)."""
+    kind = ir[0]
+    if kind == "seq":
+        return min(INF, sum(_cost_eval(i, costs, choreo, group)
+                            for i in ir[1]))
+    if kind == "max":
+        return max((_cost_eval(i, costs, choreo, group) for i in ir[1]),
+                   default=0)
+    if kind == "loop":
+        body = _cost_eval(ir[2], costs, choreo, group)
+        if body == 0:
+            return 0
+        if ir[1] is None:
+            return INF
+        return min(INF, ir[1] * body)
+    if kind == "site":
+        return 1
+    # ("call", key, line)
+    if ir[1] in choreo and ir[1] not in group:
+        return 0
+    return costs.get(ir[1], 0)
+
+
+def _choreo_groups(contracts: SyncContracts) -> dict[tuple, frozenset]:
+    """key → every key sharing a choreography declaration with it (a
+    class declaration groups all its methods)."""
+    out: dict[tuple, frozenset] = {}
+    for c in contracts.choreo:
+        ks = frozenset(c.keys)
+        for k in c.keys:
+            out[k] = out.get(k, frozenset()) | ks
+    return out
+
+
+def _fixpoint_costs(fns: dict[tuple, _FnSync], choreo: set[tuple],
+                    groups: dict[tuple, frozenset]) -> dict[tuple, int]:
+    costs = {k: 0 for k in fns}
+    rounds = min(len(fns) + 2, 200)
+    for _ in range(rounds):
+        changed = False
+        for k, fs in fns.items():
+            c = _cost_eval(fs.ir, costs, choreo, groups.get(k, frozenset()))
+            if c != costs[k]:
+                costs[k] = c
+                changed = True
+        if not changed:
+            return costs
+    # still moving after the cap: recursion through a dispatch site —
+    # the worst case is unbounded
+    for k, fs in fns.items():
+        if _cost_eval(fs.ir, costs, choreo,
+                      groups.get(k, frozenset())) != costs[k]:
+            costs[k] = INF
+    return costs
+
+
+def _cost_str(c: int) -> str:
+    return "unbounded" if c >= INF else str(c)
+
+
+def _mult_str(m: int) -> str:
+    if m <= 1:
+        return ""
+    return " ×unbounded-loop" if m >= INF else f" ×{m} (loop)"
+
+
+def _witness(key: tuple, fns: dict[tuple, _FnSync], costs: dict,
+             choreo: set[tuple], groups: dict[tuple, frozenset],
+             depth: int = 0) -> list[str]:
+    """The worst-case path, human-readable: direct contributors of
+    *key*'s IR, then the costliest callee expanded (bounded depth)."""
+    fs = fns.get(key)
+    if fs is None or depth > 3:
+        return []
+    group = groups.get(key, frozenset())
+    parts: list[tuple[int, str, int, tuple | None]] = []
+
+    def walk(node: tuple, mult: int) -> None:
+        kind = node[0]
+        if kind == "seq":
+            for it in node[1]:
+                walk(it, mult)
+        elif kind == "max":
+            best, bc = None, 0
+            for it in node[1]:
+                c = _cost_eval(it, costs, choreo, group)
+                if c > bc:
+                    best, bc = it, c
+            if best is not None:
+                walk(best, mult)
+        elif kind == "loop":
+            if _cost_eval(node[2], costs, choreo, group) > 0:
+                trips = node[1] if node[1] is not None else INF
+                walk(node[2], min(INF, mult * trips))
+        elif kind == "site":
+            parts.append((node[1], node[2], mult, None))
+        else:  # call
+            c = 0 if (node[1] in choreo and node[1] not in group) \
+                else costs.get(node[1], 0)
+            if c > 0:
+                parts.append((node[2], _key_label(node[1]), mult, node[1]))
+
+    walk(fs.ir, 1)
+    lines = []
+    deepest: tuple | None = None
+    deepest_cost = 0
+    for line, what, mult, callee in parts[:6]:
+        if callee is None:
+            lines.append(f"line {line}: {what} dispatch{_mult_str(mult)}")
+        else:
+            c = costs.get(callee, 0)
+            lines.append(
+                f"line {line}: call {what} "
+                f"[{_cost_str(c)}]{_mult_str(mult)}")
+            if c > deepest_cost:
+                deepest, deepest_cost = callee, c
+    if deepest is not None:
+        sub = _witness(deepest, fns, costs, choreo, groups, depth + 1)
+        if sub:
+            lines.append(f"→ inside {_key_label(deepest)}: "
+                         + "; ".join(sub[:3]))
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# S001: declared budget vs structural worst case (+ reconcile)
+# ---------------------------------------------------------------------------
+
+def _check_s001(fns, costs, choreo, groups, contracts: SyncContracts):
+    out: list[Violation] = []
+    for b in contracts.budgets:
+        if b.key not in fns:
+            continue
+        worst = costs.get(b.key, 0)
+        if worst <= b.n:
+            continue
+        chain = "; ".join(_witness(b.key, fns, costs, choreo, groups)) \
+            or "no direct witness (cost carried by callees)"
+        out.append(Violation(
+            rule="S001", path=b.module.path, line=b.line, col=0,
+            message=(
+                f"@dispatch_budget({b.n}) exceeded on {b.label}: "
+                f"worst case is {_cost_str(worst)} dispatch(es) — "
+                f"{chain}")))
+    return out
+
+
+def load_ledger_export(path: str) -> list[dict]:
+    """Parse + validate an ``obs ledger-export`` snapshot. A wrong kind
+    or schema version is a usage error (CLI exit 2), not a finding."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"--reconcile {path}: not JSON ({e})") from e
+    if not isinstance(doc, dict) or doc.get("kind") != LEDGER_EXPORT_KIND:
+        raise ValueError(
+            f"--reconcile {path}: not a roundtrip-ledger export "
+            f"(expected kind={LEDGER_EXPORT_KIND!r}, "
+            f"got {doc.get('kind') if isinstance(doc, dict) else doc!r})")
+    if doc.get("schema_version") != LEDGER_EXPORT_SCHEMA_VERSION:
+        raise ValueError(
+            f"--reconcile {path}: unsupported schema_version "
+            f"{doc.get('schema_version')!r} (this analyzer speaks "
+            f"{LEDGER_EXPORT_SCHEMA_VERSION})")
+    entries = doc.get("entries")
+    if not isinstance(entries, list) or not all(
+            isinstance(e, dict) for e in entries):
+        raise ValueError(f"--reconcile {path}: entries must be a list "
+                         f"of objects")
+    return entries
+
+
+def _check_reconcile(contracts: SyncContracts, entries: list[dict]):
+    """Measured dispatches/query above the static bound for any plan
+    signature a budget claims — either a boundary op the model missed
+    or a wrong contract; both are S001 findings at the declaration."""
+    out: list[Violation] = []
+    sig_budgets = [b for b in contracts.budgets if b.signatures]
+    for e in entries:
+        sig = e.get("signature")
+        queries = e.get("queries") or 0
+        dispatches = e.get("dispatches") or 0
+        if not isinstance(sig, str) or not queries:
+            continue
+        matching = [b for b in sig_budgets
+                    if any(fnmatch(sig, g) for g in b.signatures)]
+        if not matching:
+            continue
+        decl = max(matching, key=lambda b: b.n)
+        measured = dispatches / queries
+        if measured <= decl.n + 1e-9:
+            continue
+        out.append(Violation(
+            rule="S001", path=decl.module.path, line=decl.line, col=0,
+            message=(
+                f"ledger reconcile: signature {sig!r} measured "
+                f"{dispatches} dispatches over {queries} query(ies) "
+                f"({measured:.2f}/query) — above the declared "
+                f"@dispatch_budget({decl.n}) on {decl.label}; either a "
+                f"boundary op this analysis cannot see or a wrong "
+                f"contract (both are findings)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# S002: host sync reachable from a sync-free region
+# ---------------------------------------------------------------------------
+
+def _check_s002(fns, contracts: SyncContracts, bands):
+    roots: list[tuple[tuple, str]] = [
+        (d.key, f"@host_sync_free {d.label}") for d in contracts.sync_free
+    ] + [
+        (b.key, f"@device_band(certain=True) {b.label}")
+        for b in bands if b.certain
+    ]
+    adj = {k: fs.calls for k, fs in fns.items()}
+    out: list[Violation] = []
+    seen: set[tuple] = set()
+    retired_cache: dict[str, set[int]] = {}
+    for root, root_label in roots:
+        if root not in fns:
+            continue
+        parent: dict[tuple, tuple | None] = {root: None}
+        stack = [root]
+        while stack:
+            k = stack.pop()
+            for nxt in adj.get(k, ()):
+                if nxt in fns and nxt not in parent:
+                    parent[nxt] = k
+                    stack.append(nxt)
+        for key in parent:
+            fs = fns[key]
+            rel = fs.module.relpath
+            if rel not in retired_cache:
+                retired_cache[rel] = _retired_lines(fs.module)
+            retired = retired_cache[rel]
+            for site in fs.sync_sites:
+                if site.line in retired:
+                    continue
+                dedup = (fs.module.path, site.line, site.what)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                chain_keys: list[tuple] = []
+                k: tuple | None = key
+                while k is not None:
+                    chain_keys.append(k)
+                    k = parent[k]
+                chain = " → ".join(
+                    _key_label(c) for c in reversed(chain_keys))
+                out.append(Violation(
+                    rule="S002", path=fs.module.path, line=site.line,
+                    col=site.col,
+                    message=(
+                        f"host sync ({site.what}) reachable from "
+                        f"{root_label} via {chain} — move the await past "
+                        f"the sync-free region, or mark the intentional "
+                        f"pipeline end with `# tpusync: retire`")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# S003: loop-carried dispatch
+# ---------------------------------------------------------------------------
+
+def _check_s003(fns, costs, choreo, groups):
+    out: list[Violation] = []
+    for key, fs in fns.items():
+        if key in choreo:
+            continue
+        group = groups.get(key, frozenset())
+        reported: set[tuple] = set()
+
+        def walk(node: tuple, loop_line: int | None) -> None:
+            kind = node[0]
+            if kind in ("seq", "max"):
+                for it in node[1]:
+                    walk(it, loop_line)
+            elif kind == "loop":
+                walk(node[2], node[3] if node[1] is None else loop_line)
+            elif kind == "site" and loop_line is not None:
+                mark = (node[1], node[2])
+                if mark not in reported:
+                    reported.add(mark)
+                    out.append(Violation(
+                        rule="S003", path=fs.module.path, line=node[1],
+                        col=0,
+                        message=(
+                            f"loop-carried dispatch in {fs.label}: "
+                            f"{node[2]} runs inside the loop at line "
+                            f"{loop_line} whose trip count is not a "
+                            f"compile-time constant — one host roundtrip "
+                            f"per iteration; batch the work into one "
+                            f"dispatch or bound the loop statically")))
+            elif kind == "call" and loop_line is not None:
+                c = 0 if (node[1] in choreo and node[1] not in group) \
+                    else costs.get(node[1], 0)
+                if c > 0:
+                    mark = (node[2], node[1])
+                    if mark not in reported:
+                        reported.add(mark)
+                        out.append(Violation(
+                            rule="S003", path=fs.module.path, line=node[2],
+                            col=0,
+                            message=(
+                                f"loop-carried dispatch in {fs.label}: "
+                                f"call to {_key_label(node[1])} "
+                                f"({_cost_str(c)} dispatch(es)) inside "
+                                f"the non-constant loop at line "
+                                f"{loop_line} — one host roundtrip per "
+                                f"iteration; batch the work into one "
+                                f"dispatch or bound the loop statically")))
+
+        walk(fs.ir, None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# S004: unmodeled boundary
+# ---------------------------------------------------------------------------
+
+def _check_s004(fns, choreo):
+    out: list[Violation] = []
+    for key, fs in fns.items():
+        if not fs.s004 or key in choreo:
+            continue
+        if _factory_name(key[2]):
+            continue  # the sanctioned jit-wrapper construction layer
+        mid = key[1] if key[0] == "fn" else _module_id(
+            fs.module.relpath)
+        if mid.endswith("parallel.query"):
+            continue
+        for line, col, dotted in fs.s004:
+            out.append(Violation(
+                rule="S004", path=fs.module.path, line=line, col=col,
+                message=(
+                    f"unmodeled device boundary in {fs.label}: raw "
+                    f"{dotted}(...) call bypasses the cached_*_step "
+                    f"factory family — invisible to the roundtrip "
+                    f"ledger and to dispatch budgets; route it through "
+                    f"a cached_* factory in parallel/query.py (or mark "
+                    f"the layer @choreography_boundary)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_sync_modules(modules: list[Module],
+                         config: LintConfig | None = None,
+                         reconcile: list[dict] | None = None):
+    """Run S001-S004 over a parsed module set (waivers/baseline are the
+    caller's passes, same contract as ``analyze_modules``)."""
+    from geomesa_tpu.analysis.flow.contracts_scan import scan_contracts
+
+    config = config or LintConfig()
+    active = active_sync_rules(config)
+    project = _Project(modules)
+    jit_fns = _jit_decorated_keys(project)
+    fns = _scan_functions(project, jit_fns)
+    contracts = scan_sync_contracts(project, modules)
+    # device_band(certain) regions are sync-free by the same contract —
+    # reuse the flow prong's declarations (its errors are its findings)
+    bands = scan_contracts(project, modules).bands
+    choreo = contracts.choreo_keys()
+    groups = _choreo_groups(contracts)
+    costs = _fixpoint_costs(fns, choreo, groups)
+
+    violations: list[Violation] = list(contracts.errors)
+    if "S001" in active:
+        violations.extend(_check_s001(fns, costs, choreo, groups, contracts))
+        if reconcile is not None:
+            violations.extend(_check_reconcile(contracts, reconcile))
+    if "S002" in active:
+        violations.extend(_check_s002(fns, contracts, bands))
+    if "S003" in active:
+        violations.extend(_check_s003(fns, costs, choreo, groups))
+    if "S004" in active:
+        violations.extend(_check_s004(fns, choreo))
+    violations = [v for v in violations if v.rule in active]
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def analyze_sync_paths(paths: list[str],
+                       config: LintConfig | None = None,
+                       reconcile: list[dict] | None = None):
+    """The ``--sync`` entry point: parse every file, run the budget
+    analysis, and apply the shared waiver/staleness passes."""
+    from geomesa_tpu.analysis.rules import all_rules
+
+    config = config or LintConfig()
+    if config.rules is not None:
+        unknown = set(config.rules) - set(all_rules())
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    modules, violations = load_modules(paths)
+    violations = list(violations)
+    violations.extend(analyze_sync_modules(modules, config,
+                                           reconcile=reconcile))
+    by_path: dict[str, list[Violation]] = defaultdict(list)
+    for v in violations:
+        by_path[v.path].append(v)
+    judged = active_sync_rules(config)
+    emit_w001 = config.rules is None or "W001" in config.rules
+    for mod in modules:
+        vs = by_path.get(mod.path, [])
+        violations.extend(finalize_module_violations(
+            mod, vs, judged, emit_w001=emit_w001))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations

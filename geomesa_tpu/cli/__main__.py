@@ -761,6 +761,27 @@ def cmd_obs_fusion(args):
               f"{e['sync_ms']:>9.2f} {e['wall_ms']:>9.2f}")
 
 
+def cmd_obs_ledger_export(args):
+    """Pull a server's raw roundtrip-ledger rollup (``GET
+    /api/obs/ledger?format=json``) in the stable reconcile-export schema
+    and write it to ``--output`` (stdout by default) — the measured side
+    of ``python -m geomesa_tpu.analysis --sync --reconcile``."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/api/obs/ledger?format=json"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    text = json.dumps(doc, indent=2)
+    if args.output and args.output != "-":
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        print(f"wrote {len(doc.get('entries', []))} ledger entries "
+              f"(schema_version={doc.get('schema_version')}) to "
+              f"{args.output}", file=sys.stderr)
+    else:
+        print(text)
+
+
 def cmd_replay(args):
     """Replay a captured workload (``GEOMESA_TPU_WORKLOAD_DIR`` capture)
     against a catalog or a live server and print the recorded-vs-replayed
@@ -1106,6 +1127,16 @@ def main(argv=None):
     )
     obs_common(fu)
     fu.set_defaults(fn=cmd_obs_fusion)
+    lx = obs_sub.add_parser(
+        "ledger-export",
+        help="pull a server's raw roundtrip-ledger rollup in the stable "
+        "reconcile-export schema (tpusync --reconcile input)",
+    )
+    obs_common(lx)
+    lx.add_argument("-o", "--output", default=None,
+                    help="write the export here instead of stdout ('-' = "
+                    "stdout)")
+    lx.set_defaults(fn=cmd_obs_ledger_export)
 
     sp = sub.add_parser(
         "replay",

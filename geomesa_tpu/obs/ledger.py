@@ -48,7 +48,16 @@ from geomesa_tpu.analysis.contracts import cache_surface, feedback_sink
 __all__ = [
     "QueryLedger", "LedgerTable", "roundtrip", "current", "note_dispatch",
     "materialize", "table", "install",
+    "EXPORT_KIND", "EXPORT_SCHEMA_VERSION",
 ]
+
+# stable export schema consumed by `python -m geomesa_tpu.analysis --sync
+# --reconcile` (analysis/sync/rules.py mirrors both constants; a version
+# bump there must land together with one here). The export is raw rollup
+# counters, NOT the derived fusion_report ranking — reconciliation needs
+# exact dispatch totals, not shares.
+EXPORT_KIND = "geomesa-tpu-roundtrip-ledger"
+EXPORT_SCHEMA_VERSION = 1
 
 _led_var: ContextVar[QueryLedger | None] = ContextVar(
     "geomesa_roundtrip_ledger", default=None)
@@ -272,6 +281,35 @@ class LedgerTable:
 
     def snapshot(self) -> dict:
         return {"entries": self.fusion_report(limit=_MAX_ENTRIES)}
+
+    def export(self) -> dict:
+        """The stable reconcile-export document (``obs ledger-export``,
+        ``GET /api/obs/ledger?format=json``): one entry per (type, plan
+        signature) with the raw rollup counters. Consumers key off
+        ``kind`` + ``schema_version`` and must reject anything else."""
+        with self._lock:
+            items = sorted(self._rows.items())
+        return {
+            "kind": EXPORT_KIND,
+            "schema_version": EXPORT_SCHEMA_VERSION,
+            "entries": [
+                {
+                    "type": type_name,
+                    "signature": sig,
+                    "queries": row.queries,
+                    "dispatches": row.dispatches,
+                    "compiles": row.compiles,
+                    "syncs": row.syncs,
+                    "dispatch_ms": round(row.dispatch_ms, 3),
+                    "sync_ms": round(row.sync_ms, 3),
+                    "host_gap_ms": round(row.host_gap_ms, 3),
+                    "wall_ms": round(row.wall_ms, 3),
+                    "h2d_bytes": row.h2d_bytes,
+                    "d2h_bytes": row.d2h_bytes,
+                }
+                for (type_name, sig), row in items
+            ],
+        }
 
 
 _table = LedgerTable()

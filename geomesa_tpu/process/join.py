@@ -158,6 +158,10 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
         dev_nblk = jnp.asarray(nblk)
         dev_ibox = jnp.asarray(ibox[sel])
         counts = np.asarray(
+            # chunked by the lane budget on purpose: the geometry set can
+            # exceed what one launch may materialize, and the overflow retry
+            # (kc_limit halving) needs the per-chunk counts on host
+            # tpusync: disable-next-line=S003
             count_step(dev.cols["x"], dev.cols["y"], true_n,
                        dev_blk, dev_nblk, dev_ibox)
         )  # (D, Kc)
@@ -185,6 +189,8 @@ def join_rows_device(ds, type_name: str, geoms, pred: str = "within",
             kc_limit = max(1, kc // 2)
             continue
         gather = make_block_bbox_gather_step(mesh, block, cap)
+        # second dispatch of the count+gather pair; same chunking rationale
+        # tpusync: disable-next-line=S003
         pos, hits = gather(
             dev.cols["x"], dev.cols["y"], true_n, dev_blk, dev_nblk, dev_ibox
         )

@@ -22,6 +22,7 @@ from typing import Any
 import numpy as np
 
 from geomesa_tpu import obs
+from geomesa_tpu.analysis.contracts import dispatch_budget
 from geomesa_tpu.obs import ledger as _rtledger
 from geomesa_tpu.curve.binned_time import BinnedTime
 from geomesa_tpu.curve.normalize import lat as norm_lat, lon as norm_lon
@@ -496,6 +497,7 @@ class TpuBackend(ExecutionBackend):
         times = time_quads(sft, e.intervals)
         return pack_boxes(boxes, overlap=overlap), pack_times(times)
 
+    @dispatch_budget(2, signatures=("*:rows",))
     def select(self, state, index, plan, extraction, residual, table):
         import time as _time
 
@@ -569,6 +571,7 @@ class TpuBackend(ExecutionBackend):
         with obs.span("refine", candidates=len(rows)):
             return rows[ast.residual_mask(residual, table, rows)]
 
+    @dispatch_budget(2)
     def select_many_positions(
         self, dev: "_MeshIndexState", index, extractions, intervals_list
     ) -> list[np.ndarray]:
@@ -692,6 +695,7 @@ class TpuBackend(ExecutionBackend):
             for o in out
         ]
 
+    @dispatch_budget(2)
     def _mesh_select_positions(
         self, dev: _MeshIndexState, index, extraction, intervals, plan=None
     ) -> np.ndarray:

@@ -17,7 +17,13 @@ from typing import TYPE_CHECKING, Any
 import numpy as np
 
 from geomesa_tpu import obs
-from geomesa_tpu.analysis.contracts import cache_surface, feedback_sink, mutation
+from geomesa_tpu.analysis.contracts import (
+    cache_surface,
+    choreography_boundary,
+    dispatch_budget,
+    feedback_sink,
+    mutation,
+)
 from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import FeatureIndex
 from geomesa_tpu.planning.planner import Query, QueryPlanner, build_indices
@@ -318,6 +324,7 @@ class _TypeState:
         return self.main_rows + self.delta.rows
 
 
+@choreography_boundary
 class DataStore:
     """An in-process spatio-temporal datastore over a pluggable backend.
 
@@ -325,6 +332,15 @@ class DataStore:
     a ``QueryEvent`` per query; ``metrics`` (a
     :class:`~geomesa_tpu.utils.metrics.MetricsRegistry`) accumulates
     query/write counters and timings; ``user`` tags audit records.
+
+    The facade is the sanctioned stage-orchestration layer
+    (``@choreography_boundary``, tpusync): per-query routing and
+    fallback loops in here are host choreography BY DESIGN, and callers
+    are charged zero static dispatch cost for calling in. The batched
+    entry points below carry their own ``@dispatch_budget`` contracts,
+    which opt them back into the S001 worst-case check — those bounds
+    (and the runtime ledger's measured rates, via ``--sync
+    --reconcile``) are where the fusion guarantees live.
     """
 
     def __init__(
@@ -1735,6 +1751,7 @@ class DataStore:
             pending.append((i, payload, exactable))
         return pending
 
+    @dispatch_budget(2, signatures=("*:rows",))
     def select_many(self, type_name: str, queries) -> list:
         """Batched row retrieval: results identical to
         ``[self.query(type_name, q) for q in queries]`` with the whole
@@ -2472,6 +2489,7 @@ class DataStore:
             np.sort(brows), pyr.gid, pyr.host_vals, group_by,
         )
 
+    @dispatch_budget(1, signatures=("*:stats",))
     def aggregate_many(self, type_name: str, queries, group_by=None,
                        value_cols=(), now_ms: int | None = None):
         """See :meth:`_aggregate_many_impl` (the engine). This wrapper

@@ -44,7 +44,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from geomesa_tpu.analysis.contracts import cache_surface
+from geomesa_tpu.analysis.contracts import cache_surface, dispatch_budget
 
 __all__ = ["SubscriptionMatrix", "HitBatch", "MatrixSnapshot",
            "envelope_hit", "envelope_hits"]
@@ -368,6 +368,7 @@ class SubscriptionMatrix:
             boxes_dev=dev[1], times_dev=dev[2],
         )
 
+    @dispatch_budget(1)
     def scan_chunk(self, snapshot: MatrixSnapshot, x, y, bins, offs, true_n):
         """One fused pass of staged device columns against the snapshot's
         matrices → ``(counts (cap,) int64, positions (cap, D, topk))``

@@ -40,7 +40,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from geomesa_tpu.analysis.contracts import device_band
+from geomesa_tpu.analysis.contracts import (
+    device_band,
+    dispatch_budget,
+    host_sync_free,
+)
 from geomesa_tpu.filter import ast
 from geomesa_tpu.planning.planner import Query
 
@@ -271,6 +275,7 @@ def _choose_route(type_name: str) -> str:
     return win.name
 
 
+@dispatch_budget(2)
 def tube_select_many(ds, type_name: str, specs, filter=None,
                      heading_field: str | None = None,
                      route: str | None = None, auths=None):
@@ -320,9 +325,50 @@ def tube_select_many(ds, type_name: str, specs, filter=None,
     return out
 
 
+@dispatch_budget(2)
 def _device_masks(sft, specs, xs, ys, tms, hdg) -> np.ndarray:
-    """The device route: padded corridor matrices through the fused
-    kernel, then f64 re-check of the ``cand & ~sure`` band only."""
+    """The device route. One fused kernel dispatch normally; a batch
+    mixing uni- and bidirectional heading constraints compiles one
+    kernel variant per directionality, so it splits into two
+    homogeneous :func:`_corridor_kernel` calls — the worst case the
+    dispatch budget declares."""
+    heading = hdg is not None and any(
+        s.heading_tolerance_deg is not None for s in specs)
+    bidirectional = heading and any(
+        s.bidirectional for s in specs
+        if s.heading_tolerance_deg is not None)
+    if bidirectional and not all(
+            s.bidirectional for s in specs
+            if s.heading_tolerance_deg is not None):
+        # one kernel variant per batch: mixed directionality splits
+        uni = [s for s in specs if not (s.heading_tolerance_deg is not None
+                                        and s.bidirectional)]
+        bi = [s for s in specs if s.heading_tolerance_deg is not None
+              and s.bidirectional]
+        m = np.zeros((len(specs), len(xs)), dtype=bool)
+        mu = _corridor_kernel(sft, uni, xs, ys, tms, hdg)
+        mb = _corridor_kernel(sft, bi, xs, ys, tms, hdg)
+        iu = ib = 0
+        for qi, s in enumerate(specs):
+            if s.heading_tolerance_deg is not None and s.bidirectional:
+                m[qi] = mb[ib]
+                ib += 1
+            else:
+                m[qi] = mu[iu]
+                iu += 1
+        return m
+    else:
+        return _corridor_kernel(sft, specs, xs, ys, tms, hdg)
+
+
+@dispatch_budget(1)
+@host_sync_free
+def _corridor_kernel(sft, specs, xs, ys, tms, hdg) -> np.ndarray:
+    """One fused corridor dispatch over a directionality-homogeneous
+    spec batch: padded corridor matrices through the fused kernel, then
+    f64 re-check of the ``cand & ~sure`` band only. Sync-free up to the
+    single retired readback of the two band masks — no hidden
+    inter-stage await on the corridor path."""
     import jax.numpy as jnp
 
     from geomesa_tpu.curve.binned_time import BinnedTime
@@ -341,26 +387,6 @@ def _device_masks(sft, specs, xs, ys, tms, hdg) -> np.ndarray:
     bidirectional = heading and any(
         s.bidirectional for s in specs
         if s.heading_tolerance_deg is not None)
-    if bidirectional and not all(
-            s.bidirectional for s in specs
-            if s.heading_tolerance_deg is not None):
-        # one kernel variant per batch: mixed directionality splits
-        uni = [s for s in specs if not (s.heading_tolerance_deg is not None
-                                        and s.bidirectional)]
-        bi = [s for s in specs if s.heading_tolerance_deg is not None
-              and s.bidirectional]
-        m = np.zeros((len(specs), n), dtype=bool)
-        mu = _device_masks(sft, uni, xs, ys, tms, hdg)
-        mb = _device_masks(sft, bi, xs, ys, tms, hdg)
-        iu = ib = 0
-        for qi, s in enumerate(specs):
-            if s.heading_tolerance_deg is not None and s.bidirectional:
-                m[qi] = mb[ib]
-                ib += 1
-            else:
-                m[qi] = mu[iu]
-                iu += 1
-        return m
 
     def pad(a, dtype):
         out = np.zeros(n_cap, dtype=dtype)
@@ -382,8 +408,8 @@ def _device_masks(sft, specs, xs, ys, tms, hdg) -> np.ndarray:
         jnp.asarray(ph), jnp.asarray(segs), jnp.asarray(tq),
         jnp.asarray(brg), jnp.asarray(b2lo), jnp.asarray(b2hi),
         jnp.asarray(tlo), jnp.asarray(thi))
-    cand = np.asarray(cand)[: len(specs), :n]
-    sure = np.asarray(sure)[: len(specs), :n]
+    cand = np.asarray(cand)[: len(specs), :n]  # tpusync: retire
+    sure = np.asarray(sure)[: len(specs), :n]  # tpusync: retire
     out = sure.copy()
     band = cand & ~sure
     for qi in np.nonzero(band.any(axis=1))[0]:

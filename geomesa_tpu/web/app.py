@@ -43,6 +43,10 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
     GET    /api/obs/fusion?limit=                host-roundtrip fusion report
                                                  (signatures ranked by host-
                                                  choreography share)
+    GET    /api/obs/ledger?format=json           raw roundtrip-ledger rollup
+                                                 in the stable reconcile-
+                                                 export schema (tpusync
+                                                 --reconcile input)
     GET    /api/metrics                          metrics snapshot (+ device
                                                  HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
@@ -215,6 +219,7 @@ class GeoMesaApp:
             # § Query lens & host-roundtrip ledger)
             ("GET", r"^/api/obs/lens$", self._obs_lens),
             ("GET", r"^/api/obs/fusion$", self._obs_fusion),
+            ("GET", r"^/api/obs/ledger$", self._obs_ledger),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -1241,6 +1246,21 @@ class GeoMesaApp:
         return 200, {
             "entries": _rtledger.table().fusion_report(limit=limit or 50),
         }, "application/json"
+
+    def _obs_ledger(self, params, body):
+        """The raw roundtrip-ledger rollup in the stable reconcile-export
+        schema (``kind`` + ``schema_version`` + per-(type, signature)
+        counter entries) — what ``geomesa-tpu obs ledger-export`` writes
+        and ``python -m geomesa_tpu.analysis --sync --reconcile`` reads.
+        ``?format=json`` is accepted (and is the only format) so callers
+        can pin the content negotiation they mean."""
+        from geomesa_tpu.obs import ledger as _rtledger
+
+        fmt = params.get("format")
+        if fmt not in (None, "json"):
+            return 400, {"error": f"unsupported format: {fmt!r}"}, \
+                "application/json"
+        return 200, _rtledger.table().export(), "application/json"
 
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)

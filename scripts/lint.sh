@@ -10,13 +10,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# All three static prongs in ONE invocation (--all-prongs): the
+# All four static prongs in ONE invocation (--all-prongs): the
 # per-module lint rules (J001-J004, C001, W001), the tpurace
 # whole-program lockset / lock-order / blocking-call analysis
-# (R001-R003, docs/concurrency.md), and the tpuflow contract dataflow
+# (R001-R003, docs/concurrency.md), the tpuflow contract dataflow
 # pass (F001 epoch/invalidation coherence, F002 shadow-plane taint,
-# F003 two-band f64 discipline — docs/tpulint.md § Flow rules), all
-# against the same committed baseline and waiver namespace.
+# F003 two-band f64 discipline — docs/tpulint.md § Flow rules), and
+# the tpusync dispatch/host-sync budget pass (S001 budget exceeded,
+# S002 sync in a sync-free region, S003 loop-carried dispatch, S004
+# unmodeled jit boundary — docs/tpulint.md § Sync rules), all against
+# the same committed baseline and waiver namespace.
 # --changed-only reuses the .tpulint-cache/ content-hash caches so an
 # unchanged tree re-verifies in a fraction of the full wall time; pass
 # --full to force a fresh analysis (it still refreshes the caches).

@@ -414,7 +414,7 @@ class TestSarifMultiProng:
         assert out.returncode == 0, out.stderr
         doc = json.loads(out.stdout)
         names = [r["tool"]["driver"]["name"] for r in doc["runs"]]
-        assert names == ["tpulint", "tpurace", "tpuflow"]
+        assert names == ["tpulint", "tpurace", "tpuflow", "tpusync"]
         flow_rules = {r["id"] for r in
                       doc["runs"][2]["tool"]["driver"]["rules"]}
         assert {"F001", "F002", "F003"} <= flow_rules

@@ -1,7 +1,12 @@
-"""Regenerate sarif_golden.json (run from the repo root after an
-INTENTIONAL rule-registry or report-layout change)::
+"""Regenerate sarif_golden.json + sarif_multi_golden.json (run from the
+repo root after an INTENTIONAL rule-registry or report-layout change)::
 
     GEOMESA_TPU_NO_JAX=1 python tests/tpulint_fixtures/make_sarif_golden.py
+
+``sarif_golden.json`` pins the single-run document (``--format sarif``);
+``sarif_multi_golden.json`` pins the ``--all-prongs`` one-run-per-prong
+document — tpulint, tpurace, tpuflow, tpusync in that order, each with
+only its own rule metadata.
 """
 
 import json
@@ -11,7 +16,13 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from geomesa_tpu.analysis import LintConfig, lint_source  # noqa: E402
-from geomesa_tpu.analysis.report import render_json  # noqa: E402
+from geomesa_tpu.analysis.flow import analyze_flow_paths  # noqa: E402
+from geomesa_tpu.analysis.race import analyze_race_paths  # noqa: E402
+from geomesa_tpu.analysis.report import (  # noqa: E402
+    render_json,
+    render_json_multi,
+)
+from geomesa_tpu.analysis.sync import analyze_sync_paths  # noqa: E402
 
 
 def main() -> None:
@@ -24,6 +35,19 @@ def main() -> None:
     out = os.path.join(here, "sarif_golden.json")
     with open(out, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out}")
+
+    # repo-relative target so result URIs stay portable in the golden
+    multi = json.loads(render_json_multi([
+        ("tpulint", lint_source(src, rel, cfg)),
+        ("tpurace", analyze_race_paths([rel], cfg)),
+        ("tpuflow", analyze_flow_paths([rel], cfg)),
+        ("tpusync", analyze_sync_paths([rel], cfg)),
+    ]))
+    out = os.path.join(here, "sarif_multi_golden.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(multi, f, indent=1)
         f.write("\n")
     print(f"wrote {out}")
 

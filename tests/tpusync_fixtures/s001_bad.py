@@ -1,0 +1,32 @@
+"""S001 bad: declared dispatch budgets below the structural worst case
+— a two-dispatch sequence under budget 1, a constant-trip loop that
+multiplies past the bound, and a malformed declaration (which is itself
+an S001: a contract that cannot be checked is a wrong contract)."""
+
+from geomesa_tpu.analysis.contracts import dispatch_budget
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+@dispatch_budget(1)
+def two_pass(mesh, xs):
+    step = cached_probe_step(mesh)
+    counts = step(xs)
+    hits = step(counts)
+    return hits
+
+
+@dispatch_budget(2)
+def looped(mesh, xs):
+    step = cached_probe_step(mesh)
+    out = None
+    for _ in range(4):
+        out = step(xs)
+    return out
+
+
+@dispatch_budget("lots")
+def malformed(mesh, xs):
+    return cached_probe_step(mesh)(xs)

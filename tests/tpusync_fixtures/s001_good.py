@@ -1,0 +1,47 @@
+"""S001 good: the same shapes inside their budgets — branches cost the
+max arm (not the sum), a constant loop within bound, and a callee behind
+a @choreography_boundary facade absorbed to zero from outside it."""
+
+from geomesa_tpu.analysis.contracts import (
+    choreography_boundary,
+    dispatch_budget,
+)
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+@dispatch_budget(1)
+def either_arm(mesh, xs, fast):
+    step = cached_probe_step(mesh)
+    if fast:
+        out = step(xs)
+    else:
+        out = step(step_input(xs))
+    return out
+
+
+def step_input(xs):
+    return xs
+
+
+@dispatch_budget(2)
+def bounded_loop(mesh, xs):
+    step = cached_probe_step(mesh)
+    out = None
+    for _ in range(2):
+        out = step(xs)
+    return out
+
+
+@choreography_boundary
+def orchestrate(mesh, xs):
+    step = cached_probe_step(mesh)
+    return step(step(step(xs)))
+
+
+@dispatch_budget(0)
+def delegates(mesh, xs):
+    # the facade's dispatches are its own accounting problem
+    return orchestrate(mesh, xs)

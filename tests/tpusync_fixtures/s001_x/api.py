@@ -1,0 +1,12 @@
+"""S001 cross-module bad half: the budget holder only *calls* — every
+dispatch it pays for lives one module away, so the finding requires the
+whole-program cost fixpoint."""
+
+from geomesa_tpu.analysis.contracts import dispatch_budget
+
+from . import work
+
+
+@dispatch_budget(1)
+def select(mesh, xs):
+    return work.count_and_gather(mesh, xs)

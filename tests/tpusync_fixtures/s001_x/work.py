@@ -1,0 +1,15 @@
+"""S001 cross-module: the two-dispatch worker the api budget cannot
+cover. No budget here — the violation lands on the declaration."""
+
+
+def cached_count_step(mesh):
+    return lambda x: x
+
+
+def cached_gather_step(mesh):
+    return lambda x: x
+
+
+def count_and_gather(mesh, xs):
+    counts = cached_count_step(mesh)(xs)
+    return cached_gather_step(mesh)(counts)

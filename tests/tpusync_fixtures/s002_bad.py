@@ -1,0 +1,40 @@
+"""S002 bad: host syncs reachable from sync-free regions — a direct
+block_until_ready, an np.asarray of a device value in the region
+itself, an obs.ledger.materialize readback two calls down the graph,
+and an implicit bool() coercion in a branch test inside a
+@device_band(certain=True) kernel wrapper."""
+
+import numpy as np
+
+from geomesa_tpu.analysis.contracts import device_band, host_sync_free
+from geomesa_tpu.obs import ledger
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+@host_sync_free
+def staged(mesh, xs):
+    step = cached_probe_step(mesh)
+    dev = step(xs)
+    dev.block_until_ready()
+    host = np.asarray(dev)
+    return finishes(host)
+
+
+def finishes(out):
+    return materialized(out)
+
+
+def materialized(out):
+    return ledger.materialize(out)
+
+
+@device_band(certain=True)
+def certain_region(mesh, xs):
+    step = cached_probe_step(mesh)
+    dev = step(xs)
+    if dev:
+        return dev
+    return xs

@@ -1,0 +1,27 @@
+"""S002 good: the intentional await that ENDS the pipeline retires in
+source — same-line and next-line forms — and a sync that is not
+reachable from any sync-free root stays unflagged."""
+
+import numpy as np
+
+from geomesa_tpu.analysis.contracts import host_sync_free
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+@host_sync_free
+def staged(mesh, xs):
+    step = cached_probe_step(mesh)
+    dev = step(xs)
+    out = np.asarray(dev)  # tpusync: retire
+    # tpusync: retire-next-line
+    tail = np.asarray(step(out))
+    return tail
+
+
+def plain_host_path(mesh, xs):
+    # no sync-free root reaches this: an ordinary materialization
+    dev = cached_probe_step(mesh)(xs)
+    return np.asarray(dev)

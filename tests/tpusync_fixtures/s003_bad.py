@@ -1,0 +1,32 @@
+"""S003 bad: loop-carried dispatch — a step invoked directly in a
+``for`` over a runtime iterable, the same roundtrip hidden behind a
+helper call inside a ``while``, and the comprehension form."""
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+def per_chunk(mesh, chunks):
+    step = cached_probe_step(mesh)
+    out = []
+    for c in chunks:
+        out.append(step(c))
+    return out
+
+
+def one_chunk(mesh, c):
+    return cached_probe_step(mesh)(c)
+
+
+def drain(mesh, queue):
+    results = []
+    while queue:
+        c = queue.pop()
+        results.append(one_chunk(mesh, c))
+    return results
+
+
+def mapped(mesh, chunks):
+    step = cached_probe_step(mesh)
+    return [step(c) for c in chunks]

@@ -1,0 +1,30 @@
+"""S003 good: bounded or sanctioned iteration — a compile-time-constant
+trip count, a loop whose body never dispatches, and the per-chunk
+streaming loop of a @choreography_boundary orchestrator."""
+
+from geomesa_tpu.analysis.contracts import choreography_boundary
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+def double_buffered(mesh, xs):
+    step = cached_probe_step(mesh)
+    out = None
+    for _ in range(2):
+        out = step(xs)
+    return out
+
+
+def host_only_loop(chunks):
+    total = 0
+    for c in chunks:
+        total += len(c)
+    return total
+
+
+@choreography_boundary
+def stream(mesh, chunks):
+    step = cached_probe_step(mesh)
+    return [step(c) for c in chunks]

@@ -1,0 +1,14 @@
+"""S004 bad: a raw jit wrapper built outside the cached_* factory
+discipline — invisible to the roundtrip ledger, so nothing can budget
+the dispatches it mints."""
+
+import jax
+
+
+def ad_hoc_wrapper(fn):
+    return jax.jit(fn)
+
+
+def ad_hoc_pmap(fn):
+    wrapped = jax.pmap(fn, axis_name="data")
+    return wrapped

@@ -1,0 +1,29 @@
+"""S004 good: every sanctioned jit construction — the decorator form,
+the cached_*_step factory layer itself, and a @choreography_boundary
+orchestrator that owns its wrappers."""
+
+from functools import lru_cache, partial
+
+import jax
+
+from geomesa_tpu.analysis.contracts import choreography_boundary
+
+
+@jax.jit
+def decorated_step(x):
+    return x
+
+
+@partial(jax.jit, static_argnums=(0,))
+def decorated_static(n, x):
+    return x
+
+
+@lru_cache(maxsize=None)
+def cached_probe_step(mesh):
+    return jax.jit(lambda x: x)
+
+
+@choreography_boundary
+def orchestrate(fn):
+    return jax.jit(fn)

@@ -1,0 +1,15 @@
+"""W001 under the sync prong: stale tpusync waivers — nothing on these
+lines trips an S rule, so the waivers themselves are findings. Same-line
+and next-line forms."""
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+x = 1  # tpusync: disable=S003
+
+
+# tpusync: disable-next-line=S004
+def quiet(mesh, xs):
+    return cached_probe_step(mesh)(xs)

@@ -1,0 +1,17 @@
+"""A LIVE tpusync waiver: it suppresses a real S003 finding (the
+per-chunk retry loop is chunked by design), so the stale-waiver scan
+stays silent and the file gates clean."""
+
+
+def cached_probe_step(mesh):
+    return lambda x: x
+
+
+def chunked(mesh, chunks):
+    step = cached_probe_step(mesh)
+    out = []
+    for c in chunks:
+        # reviewed: chunking bounds device memory, not a fusion miss
+        # tpusync: disable-next-line=S003
+        out.append(step(c))
+    return out
