@@ -782,6 +782,47 @@ def cmd_obs_ledger_export(args):
         print(text)
 
 
+def cmd_obs_shards(args):
+    """Pull a server's shard-routing state (``GET /api/obs/shards``):
+    generation, per-shard ownership, LIVE migration records (state,
+    rows shipped/replayed, dual-ledger size), coverage violations, and
+    the process-wide migration counters — the elasticity triage surface
+    (docs/operations.md § Migration triage)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/api/obs/shards"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    counters = doc.get("migration_counters", {})
+    print("migrations: " + "  ".join(
+        f"{k}={v}" for k, v in counters.items()))
+    if "shard_member" not in doc:
+        print("not a sharded federation (single member)")
+        return
+    print(f"generation {doc.get('generation')}  "
+          f"members={doc.get('members')}  "
+          f"inflight_writes={doc.get('inflight_writes', 0)}")
+    owners: dict = {}
+    for s, m in enumerate(doc.get("shard_member", [])):
+        owners.setdefault(m, []).append(s)
+    for m in sorted(owners, key=str):
+        print(f"  member {m}: shards {owners[m]}")
+    migs = doc.get("migrations", [])
+    if migs:
+        print(f"{len(migs)} live migration(s):")
+        for mig in migs:
+            print(f"  shard {mig['shard']}: {mig['src']} -> {mig['dst']} "
+                  f"state={mig['state']} shipped={mig['rows_shipped']} "
+                  f"replayed={mig['rows_replayed']} "
+                  f"dual_fids={mig['dual_fids']}")
+    bad = doc.get("coverage_violations", [])
+    if bad:
+        print(f"COVERAGE VIOLATIONS: {bad}")
+
+
 def cmd_replay(args):
     """Replay a captured workload (``GEOMESA_TPU_WORKLOAD_DIR`` capture)
     against a catalog or a live server and print the recorded-vs-replayed
@@ -1137,6 +1178,13 @@ def main(argv=None):
                     help="write the export here instead of stdout ('-' = "
                     "stdout)")
     lx.set_defaults(fn=cmd_obs_ledger_export)
+    sh = obs_sub.add_parser(
+        "shards",
+        help="pull a server's shard map, live migration states, and "
+        "migration counters",
+    )
+    obs_common(sh)
+    sh.set_defaults(fn=cmd_obs_shards)
 
     sp = sub.add_parser(
         "replay",

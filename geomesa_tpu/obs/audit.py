@@ -850,6 +850,7 @@ class InvariantSweeper:
         self._streams: list = []  # weakrefs to streaming stores
         self._matrices: list = []  # weakrefs to SubscriptionMatrix
         self._tracks: list = []  # weakrefs to trajectory TrackState
+        self._pools: list = []  # weakrefs to BufferPool (tier coherence)
         self._pyr_cursor = 0  # rotating cell-sample cursor
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -879,6 +880,9 @@ class InvariantSweeper:
 
     def attach_track_state(self, state) -> None:
         self._attach(self._tracks, state)
+
+    def attach_pool(self, pool) -> None:
+        self._attach(self._pools, pool)
 
     def start(self) -> None:
         with self._lock:
@@ -928,6 +932,8 @@ class InvariantSweeper:
                 out.append(self.check_standing_counts(s))
             for ts in self._targets(self._tracks):
                 out.append(self.check_track_state(ts))
+            for pool in self._targets(self._pools):
+                out.append(self.check_tiering(pool))
         for r in out:
             self.auditor.note_sweep(r["check"], r)
         with self._lock:
@@ -1199,6 +1205,22 @@ class InvariantSweeper:
             result["checked"] = 0
             return result
         result["violations"] = router.coverage_violations()
+        return result
+
+    def check_tiering(self, pool) -> dict:
+        """Buffer-pool tier coherence (serving/elastic.py): a demoted
+        (type, index) lives in exactly one lower tier, the warm tier
+        respects its RAM budget, cold entries have their on-disk file,
+        and demoted bytes are not still ledgered as device-resident —
+        a two-tier copy or a stale ledger row would make the ops surface
+        report HBM the device freed long ago."""
+        result = {"check": "tiering", "checked": 1,
+                  "violations": [], "abstained": 0}
+        tier = getattr(pool, "_tiering", None)
+        if tier is None:
+            result["checked"] = 0
+            return result
+        result["violations"] = tier.coherence_violations()
         return result
 
     def check_matrix_sentinels(self, matrix) -> dict:

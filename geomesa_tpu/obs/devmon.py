@@ -126,6 +126,25 @@ class ResidencyLedger:
             self._entries.pop(token, None)
             self._finalizers.pop(token, None)
 
+    def unregister_matching(self, type_name: str, index: str) -> int:
+        """Drop every live entry of one ``(type, index)`` — the tiering
+        policy's demotion path (serving/elastic.py): the owner object
+        stays ALIVE holding host/disk copies, so its GC finalizer cannot
+        fire, yet the bytes have left the device and must leave the
+        ledger with them (the ledger-vs-residency agreement pinned in
+        tests). The orphaned finalizers later no-op against the already-
+        removed tokens. Returns the bytes unregistered."""
+        with self._lock:
+            tokens = [
+                t for t, e in self._entries.items()
+                if e[0] == type_name and e[1] == index
+            ]
+            freed = 0
+            for t in tokens:
+                freed += self._entries.pop(t)[3]
+                self._finalizers.pop(t, None)
+            return freed
+
     def record_spill(self, type_name: str, index: str, est_bytes: int) -> None:
         with self._lock:
             self._spills[(type_name, index)] = int(est_bytes)

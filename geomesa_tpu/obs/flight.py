@@ -70,6 +70,12 @@ A_REGRESSION = "regression"
 # per-window recompile threshold — some step is being re-traced on a
 # hot path (shape churn, a missing pad bucket)
 A_RECOMPILE = "recompile_storm"
+# a live shard migration stalled (serving/elastic.py): a pre-cutover
+# drain or catch-up replay blew its timeout and the migrator rolled the
+# move back, or the post-cutover drain timed out and the source's copies
+# were retained (unreachable but undropped) — either way an operator
+# should look before retrying (docs/operations.md § Migration triage)
+A_MIGRATION = "migration_stall"
 
 
 @dataclass

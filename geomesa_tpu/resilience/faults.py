@@ -337,12 +337,16 @@ def from_env() -> FaultInjector | None:
 
 
 def crash_point(name: str) -> None:
-    """Named kill-point for the crash harness (``scripts/crash_smoke.py``):
-    the durability-critical code paths (WAL group commit, checkpoint
-    commit order, recovery replay) call this at their crash-consistency
-    boundaries; an active injector with a matching ``kind=crash`` rule
-    SIGKILLs the process there. The inactive path is one global read —
-    the same zero-cost posture as the transport hooks."""
+    """Named kill-point for the crash harnesses (``scripts/crash_smoke.py``,
+    ``scripts/rebalance_smoke.py``): the durability-critical code paths
+    (WAL group commit, checkpoint commit order, recovery replay) call
+    this at their crash-consistency boundaries, and the shard migrator
+    (serving/elastic.py) brackets every protocol step with ``elastic.*``
+    points (``pre_ship``, ``mid_ship``, ``pre_dual``, ``mid_catchup``,
+    ``pre_cutover``, ``pre_source_drop``); an active injector with a
+    matching ``kind=crash`` rule SIGKILLs the process there. The
+    inactive path is one global read — the same zero-cost posture as
+    the transport hooks."""
     inj = active()
     if inj is not None:
         inj.maybe_crash(name)

@@ -38,6 +38,7 @@ from geomesa_tpu.obs import usage as _usage
 from geomesa_tpu.resilience import faults
 from geomesa_tpu.resilience.policy import (
     CircuitBreaker,
+    MemberDrainingError,
     RateLimitedError,
     RetryPolicy,
 )
@@ -104,10 +105,33 @@ def map_http_error(e: urllib.error.HTTPError):
 
 def _breaker_failure(exc: BaseException) -> bool:
     """What counts against an endpoint's health: transport errors and 5xx.
-    A 4xx is the endpoint answering correctly (caller-side semantics)."""
+    A 4xx is the endpoint answering correctly (caller-side semantics); a
+    declared drain (:class:`MemberDrainingError`) is the endpoint
+    answering correctly too — planned, cooperative unavailability must
+    not push the breaker toward open (a membership change is not an
+    outage)."""
+    if isinstance(exc, MemberDrainingError):
+        return False
     if isinstance(exc, urllib.error.HTTPError):
         return exc.code >= 500
     return isinstance(exc, (OSError, TimeoutError))
+
+
+def _as_draining(exc: BaseException, url: str) -> MemberDrainingError | None:
+    """503 WITH ``Retry-After`` is a draining member's declared signal
+    (docs/operations.md § Drain procedure) — typed at the choke point so
+    every client classifies it identically. A bare 503 (a proxy dying,
+    an overloaded server with no plan) stays a generic 5xx."""
+    if not isinstance(exc, urllib.error.HTTPError) or exc.code != 503:
+        return None
+    hdr = exc.headers.get("Retry-After") if exc.headers else None
+    if not hdr:
+        return None
+    try:
+        ra = float(hdr)
+    except (TypeError, ValueError):
+        return None
+    return MemberDrainingError(url, ra)
 
 
 def request(
@@ -197,6 +221,13 @@ def request(
             except QueryTimeout:
                 raise  # local shed: says nothing about endpoint health
             except Exception as exc:  # noqa: BLE001 — classified for the breaker
+                drain = _as_draining(exc, url)
+                if drain is not None:
+                    if breaker is not None:
+                        # the endpoint answered exactly as designed: a
+                        # drain outcome is a SUCCESS for breaker health
+                        breaker.record(False)
+                    raise drain from exc
                 if breaker is not None:
                     breaker.record(_breaker_failure(exc))
                 if (

@@ -30,6 +30,7 @@ __all__ = [
     "CircuitOpenError",
     "CorruptPayloadError",
     "MEMBER_FAILURE_TYPES",
+    "MemberDrainingError",
     "RateLimitedError",
     "RetryPolicy",
     "is_member_failure",
@@ -70,6 +71,38 @@ class RateLimitedError(ConnectionError):
         self.retry_after_s = retry_after_s
 
 
+class MemberDrainingError(ConnectionError):
+    """A member answered ``503 Service Unavailable`` WITH a
+    ``Retry-After`` header — the elastic federation's drain signal
+    (docs/operations.md § Drain procedure): the member is alive and
+    finishing in-flight work but wants no new requests while its shards
+    migrate away. Distinct from a generic 5xx on every axis:
+
+    - **reads** are retryable-with-backoff (:func:`retryable` returns
+      True for idempotent calls): the shard map is about to move, and
+      the retry — delayed by at least ``retry_after_s``, honored as a
+      floor by :meth:`RetryPolicy.call` — lands on the new owner.
+    - **writes** are NOT retryable here: the sharded view re-reads its
+      router generation and re-routes the failed slice immediately
+      instead of hammering the draining member.
+    - it never counts against the circuit breaker
+      (``resilience/http.py``): a drain is planned, cooperative
+      unavailability — burning the breaker toward open would turn every
+      membership change into a synthetic outage.
+
+    A ``ConnectionError`` subclass so partial-mode federations degrade
+    on a draining member like any other member failure
+    (:data:`MEMBER_FAILURE_TYPES`)."""
+
+    def __init__(self, endpoint: str, retry_after_s: float):
+        super().__init__(
+            f"member draining at {endpoint} "
+            f"(retry after {retry_after_s:.2f}s)"
+        )
+        self.endpoint = endpoint
+        self.retry_after_s = retry_after_s
+
+
 class CorruptPayloadError(RuntimeError):
     """A remote member answered 200 but the payload failed to decode
     (truncated/corrupt Arrow IPC, garbage JSON). Typed so federation
@@ -104,6 +137,12 @@ def retryable(exc: BaseException, idempotent: bool) -> bool:
         return False  # fail fast: the breaker already decided
     if isinstance(exc, RateLimitedError):
         return False  # the endpoint TOLD us to back off (Retry-After)
+    if isinstance(exc, MemberDrainingError):
+        # a planned drain: reads retry (after the server's Retry-After,
+        # honored as a delay floor in RetryPolicy.call — the shard map
+        # is moving and the retry lands on the new owner); writes
+        # re-route through a fresh router generation instead
+        return idempotent
     if isinstance(exc, urllib.error.HTTPError) and exc.code == 429:
         # an admission shed: already non-retryable under both branches
         # below (<500 for reads, response-received for mutations), but
@@ -231,6 +270,14 @@ class RetryPolicy:
                 if not self._take_token():
                     raise  # budget dry: shed the retry, surface the error
                 delay = self.next_delay(delay)
+                # a draining member's Retry-After is a delay FLOOR (the
+                # server knows when its cutover lands), capped by the
+                # policy's own ceiling so a hostile header cannot park
+                # the caller indefinitely
+                retry_after = getattr(exc, "retry_after_s", None)
+                if retry_after:
+                    delay = max(
+                        delay, min(float(retry_after), self.max_delay_s))
                 if on_retry is not None:
                     on_retry(attempt, delay, exc)
                 self._sleep(delay)  # outside every lock

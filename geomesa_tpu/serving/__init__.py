@@ -21,11 +21,21 @@ Three cooperating pieces in front of the store tier (docs/serving.md):
   so writes AND reads both partition; reads fan out only to the members
   whose shards a plan's ranges intersect and merge through the
   :class:`~geomesa_tpu.store.merged.MergedDataStoreView` machinery
-  (resilience / degraded semantics intact).
+  (resilience / degraded semantics intact). Routing is generational
+  (:class:`~geomesa_tpu.serving.shards.RouterGeneration`): every shard
+  map is immutable and changes install atomically as a new generation.
+- :mod:`~geomesa_tpu.serving.elastic` — the elasticity plane on top of
+  the federation: :class:`~geomesa_tpu.serving.elastic.ShardMigrator`
+  (WAL-backed zero-downtime live shard movement),
+  :class:`~geomesa_tpu.serving.elastic.FederationAutoscaler`
+  (SLO/admission/HBM-driven membership proposals), and
+  :class:`~geomesa_tpu.serving.elastic.TieringPolicy` (HBM → host RAM →
+  disk buffer demotion for the buffer pool).
 
 Admission and coalescing import no jax (``GEOMESA_TPU_NO_JAX=1`` safe);
 the shard router sits on the store tier. All serving locks are leaves of
-the canonical hierarchy (docs/concurrency.md).
+the canonical hierarchy (docs/concurrency.md) except the migrator lock,
+which nests above the store locks it drives.
 """
 
 from geomesa_tpu.serving.admission import (  # noqa: F401 — public surface

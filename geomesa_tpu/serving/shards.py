@@ -11,7 +11,19 @@ from), so **writes and reads both partition**:
   shard to a member via a consistent-hash ring (members × virtual
   nodes): resizing the member set moves only the departed/arrived
   member's shards, never reshuffles the survivors (docs/serving.md
-  § Shard-map lifecycle).
+  § Shard-map lifecycle). An explicit ``assignments`` override pins
+  individual shards to members regardless of the ring — the live
+  migrator's intermediate maps (``with_assignment``) and the recovery
+  journal round-trip through it.
+- :class:`RouterGeneration` wraps one immutable router in an epoch: the
+  view holds exactly ONE current generation and swaps it atomically
+  (``swap_generation``); every operation snapshots the generation ONCE
+  and routes entirely off that snapshot, so a concurrent swap can never
+  split one write batch (or one query's fan-out + merge) across two
+  shard maps. A generation also carries the in-flight
+  :class:`ShardMigration` records that make reads fan to the UNION of
+  old and new owners and writes dual-apply during a live migration
+  (``serving/elastic.py``).
 - :class:`ShardedDataStoreView` subclasses
   :class:`~geomesa_tpu.store.merged.MergedDataStoreView`, so the merge,
   resilience (``on_member_error="partial"`` degraded answers), SLO and
@@ -25,20 +37,30 @@ Member dedup is load-bearing: several shards routinely map to the same
 member (n_shards > n_members by design), and two overlapping Z-prefix
 ranges landing on one member must fan out to it ONCE — a per-shard
 fan-out would double-count every matching row on that member
-(red/green pinned in tests/test_serving.py).
+(red/green pinned in tests/test_serving.py). During a migration's
+dual-apply window the same machinery absorbs the old/new-owner union
+fan: row results additionally dedup by fid at the merge (both owners
+hold the dual-applied rows), while additive reads (counts, stats,
+aggregations, density) keep fanning to the AUTHORITATIVE owner only —
+a union would double-count every dual-applied row.
 
 Fid- and attribute-only filters extract no spatial bounds → they fan
 out to ALL members (deterministically — rows are spatially placed, a
 fid could live anywhere); disjoint filters fan out to NONE.
 
-The router is immutable after construction (no locks); the view adds no
-locks beyond the merged view's.
+The router is immutable after construction (no locks); a generation
+adds one Condition guarding its in-flight write refcount (the
+migrator's drain barrier, docs/concurrency.md § elastic plane).
 """
 
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
+import uuid
 from bisect import bisect_right
+from contextlib import contextmanager
 from dataclasses import replace
 
 import numpy as np
@@ -48,13 +70,22 @@ from geomesa_tpu.curve.sfc import Z2SFC
 from geomesa_tpu.filter import ast
 from geomesa_tpu.filter.bounds import extract
 from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.resilience.policy import MemberDrainingError
 from geomesa_tpu.schema.columnar import FeatureTable
 from geomesa_tpu.store.merged import MergedDataStoreView
 from geomesa_tpu.store.splitter import default_splits, shard_of
 
-__all__ = ["ShardRouter", "ShardedDataStoreView"]
+__all__ = [
+    "MIG_DUAL", "MIG_SHIPPING", "RouterGeneration", "ShardMigration",
+    "ShardRouter", "ShardedDataStoreView",
+]
 
 _Z2_BITS = 62  # 31 bits/dim Morton — the splitter's z2 key domain
+
+# live-migration states carried on a RouterGeneration (docs/serving.md
+# § Shard-map lifecycle: stable → shipping → dual-apply → cutover)
+MIG_SHIPPING = "shipping"      # snapshot in flight; routing unchanged
+MIG_DUAL = "dual_apply"        # writes dual-apply, row reads union-fan
 
 
 def _hash64(s: str) -> int:
@@ -69,10 +100,14 @@ class ShardRouter:
     ``members``: hashable member ids (the sharded view uses positional
     indices). ``n_shards`` contiguous Z2 key ranges; each shard's id
     hashes onto the ring and is owned by the first member clockwise.
+    ``assignments`` ({shard → member}) pins individual shards over the
+    ring's choice — only overrides that actually differ from the ring
+    are retained (``self.assignments``), so a pure-ring router always
+    reports ``assignments == {}`` no matter how it was built.
     """
 
     def __init__(self, members, n_shards: int | None = None,
-                 virtual_nodes: int = 32):
+                 virtual_nodes: int = 32, assignments=None):
         self.members = list(members)
         if not self.members:
             raise ValueError("shard router needs at least one member")
@@ -98,6 +133,21 @@ class ShardRouter:
             self.members[self._locate(_hash64(f"shard:{s}"))]
             for s in range(self.n_shards)
         ]
+        self.assignments: dict[int, object] = {}
+        if assignments:
+            live = set(self.members)
+            for s, m in assignments.items():
+                s = int(s)
+                if not (0 <= s < self.n_shards):
+                    raise ValueError(
+                        f"assignment for shard {s} outside "
+                        f"[0, {self.n_shards})")
+                if m not in live:
+                    raise ValueError(
+                        f"shard {s} assigned to non-member {m!r}")
+                if m != self.shard_member[s]:
+                    self.shard_member[s] = m
+                    self.assignments[s] = m
         self._sfc = Z2SFC()
 
     def _locate(self, h: int) -> int:
@@ -107,8 +157,47 @@ class ShardRouter:
     def with_members(self, members) -> "ShardRouter":
         """A new router over a resized member set, same shard cuts: the
         consistent-hash ring guarantees only shards owned by departed
-        (or claimed by arrived) members move (pinned in tests)."""
+        (or claimed by arrived) members move (pinned in tests). Drops
+        any pinned assignments — this is the OFFLINE membership change
+        (data does not follow); the migrator composes
+        ``with_assignment`` steps for the live one."""
         return ShardRouter(members, self.n_shards, self.virtual_nodes)
+
+    def with_assignment(self, shard: int, member) -> "ShardRouter":
+        """Copy with ONE shard reassigned and every other shard pinned
+        to its current owner — the migrator's cutover step: exactly one
+        shard moves per generation, never a ring reshuffle."""
+        pinned = dict(enumerate(self.shard_member))
+        pinned[int(shard)] = member
+        return ShardRouter(self.members, self.n_shards,
+                           self.virtual_nodes, assignments=pinned)
+
+    def with_member_added(self, member) -> "ShardRouter":
+        """Copy with one member joined but owning NOTHING yet (every
+        shard pinned to its current owner): membership precedes
+        ownership, so the autoscaler can add a member and then migrate
+        shards onto it one generation at a time."""
+        if member in self._pos:
+            raise ValueError(f"member {member!r} already present")
+        pinned = dict(enumerate(self.shard_member))
+        return ShardRouter(self.members + [member], self.n_shards,
+                           self.virtual_nodes, assignments=pinned)
+
+    def with_member_removed(self, member) -> "ShardRouter":
+        """Copy with one drained member departed. The member must own
+        no shards (drain first — the migrator's job); ownership of
+        every shard is pinned, so survivors never reshuffle."""
+        if member not in self._pos:
+            raise ValueError(f"member {member!r} not present")
+        owned = [s for s, m in enumerate(self.shard_member) if m == member]
+        if owned:
+            raise ValueError(
+                f"member {member!r} still owns shards {owned}: "
+                "drain (migrate) before removal")
+        pinned = dict(enumerate(self.shard_member))
+        return ShardRouter([m for m in self.members if m != member],
+                           self.n_shards, self.virtual_nodes,
+                           assignments=pinned)
 
     def coverage_violations(self) -> list[str]:
         """Invariant-sweep surface (obs/audit.py): the shard cuts must
@@ -133,6 +222,9 @@ class ShardRouter:
         for s, m in enumerate(self.shard_member):
             if m not in live:
                 out.append(f"shard {s} owned by departed member {m!r}")
+        for s in self.assignments:
+            if not (0 <= int(s) < self.n_shards):
+                out.append(f"pinned assignment for unknown shard {s}")
         return out
 
     # -- key → shard → member -------------------------------------------------
@@ -153,6 +245,9 @@ class ShardRouter:
     def member_for_shard(self, shard: int):
         return self.shard_member[int(shard)]
 
+    def shards_of_member(self, member) -> list[int]:
+        return [s for s, m in enumerate(self.shard_member) if m == member]
+
     # -- plan-range → shard intersection --------------------------------------
     def shards_for_boxes(self, boxes) -> list[int]:
         """Shard ids whose key range any of the boxes' Z-range covering
@@ -166,6 +261,25 @@ class ShardRouter:
                                        side="right"))
             shards.update(range(s_lo, s_hi + 1))
         return sorted(shards)
+
+    def shards_for_filter(self, f, sft) -> list | None:
+        """Shard ids a query with this filter can touch: ``None`` = all
+        shards (no spatial bounds — fid/attribute-only filters, or
+        extended-geometry types whose rows partition by envelope CENTER
+        while a query box can intersect a geometry centered far outside
+        it); ``[]`` = provably disjoint. The shard-level half of
+        :meth:`members_for_filter`, shared with the generation's
+        union-read routing so the two can never diverge."""
+        if f is None or isinstance(f, ast.Include):
+            return None
+        e = extract(f, sft.geom_field, sft.dtg_field)
+        if e.disjoint:
+            return []
+        if sft.geom_field and not sft.geom_is_points:
+            return None
+        if not e.boxes:
+            return None
+        return self.shards_for_boxes(e.boxes)
 
     def members_for_filter(self, f, sft) -> list | None:
         """Member ids a query with this filter must fan out to, DEDUPED
@@ -181,16 +295,11 @@ class ShardRouter:
         pruning by the box would silently drop matching rows (red/green
         pinned in tests/test_serving.py). A disjoint filter still fans
         nowhere: it matches nothing regardless of geometry extent."""
-        if f is None or isinstance(f, ast.Include):
+        shards = self.shards_for_filter(f, sft)
+        if shards is None:
             return None
-        e = extract(f, sft.geom_field, sft.dtg_field)
-        if e.disjoint:
+        if not shards:
             return []
-        if sft.geom_field and not sft.geom_is_points:
-            return None
-        if not e.boxes:
-            return None
-        shards = self.shards_for_boxes(e.boxes)
         seen: set = set()
         out: list = []
         for s in shards:
@@ -203,6 +312,189 @@ class ShardRouter:
         return out
 
 
+class ShardMigration:
+    """One in-flight shard migration's MUTABLE record, shared between
+    the generations that carry it and the migrator
+    (``serving/elastic.py``).
+
+    ``dual_fids`` is the exactly-once ledger of the dual-apply window:
+    a writer records a row's fid here BEFORE the source apply commits
+    it to the WAL, so when the migrator's tail replay later sees that
+    record it knows the destination already has (or is about to get)
+    the row via the dual path and skips it — and a dual-applied DELETE
+    recorded here can never be resurrected on the destination by an
+    older replayed write. ``lock`` serializes destination applies for
+    this shard between the dual-write path and the replay loop (the
+    check-then-apply pairs must not interleave); it is held only for
+    the dual window of one shard and nests ABOVE the member stores'
+    locks (docs/concurrency.md § elastic plane).
+    """
+
+    __slots__ = ("shard", "src", "dst", "state", "dual_fids", "lock",
+                 "rows_shipped", "rows_replayed", "started_ts")
+
+    def __init__(self, shard: int, src, dst, state: str = MIG_SHIPPING):
+        self.shard = int(shard)
+        self.src = src
+        self.dst = dst
+        self.state = state
+        self.dual_fids: set[str] = set()
+        self.lock = threading.Lock()
+        self.rows_shipped = 0
+        self.rows_replayed = 0
+        self.started_ts = time.time()
+
+    def with_state(self, state: str) -> "ShardMigration":
+        """A copy sharing the dual ledger/lock — the migrator advances
+        state by installing a NEW generation carrying the new record,
+        never by mutating one visible to in-flight snapshots."""
+        m = ShardMigration(self.shard, self.src, self.dst, state)
+        m.dual_fids = self.dual_fids
+        m.lock = self.lock
+        m.rows_shipped = self.rows_shipped
+        m.rows_replayed = self.rows_replayed
+        m.started_ts = self.started_ts
+        return m
+
+    def snapshot(self) -> dict:
+        return {
+            "shard": self.shard,
+            "src": self.src,
+            "dst": self.dst,
+            "state": self.state,
+            "rows_shipped": int(self.rows_shipped),
+            "rows_replayed": int(self.rows_replayed),
+            "dual_fids": len(self.dual_fids),
+            "age_s": round(time.time() - self.started_ts, 3),
+        }
+
+
+class RouterGeneration:
+    """One epoch of the shard map: an immutable router + the in-flight
+    migrations riding it + an in-flight WRITE refcount.
+
+    The view reads ``view._generation`` exactly once per operation and
+    routes entirely off the snapshot — the satellite fix for the torn
+    mid-swap read — and write operations bracket themselves with
+    :meth:`op` so the migrator can ``wait_idle`` a superseded
+    generation before capturing the tail-replay stop seq (every write
+    routed by the OLD map is durably in the WAL below the stop)."""
+
+    def __init__(self, router: ShardRouter, generation: int = 0,
+                 migrations=()):
+        self.router = router
+        self.generation = int(generation)
+        self.migrations: dict[int, ShardMigration] = {
+            int(m.shard): m for m in migrations
+        }
+        self._cv = threading.Condition()
+        self._inflight = 0
+
+    def advance(self, router: ShardRouter | None = None,
+                migrations=None) -> "RouterGeneration":
+        return RouterGeneration(
+            router if router is not None else self.router,
+            self.generation + 1,
+            tuple(self.migrations.values())
+            if migrations is None else migrations,
+        )
+
+    # -- write drain barrier --------------------------------------------------
+    @contextmanager
+    def op(self):
+        """Bracket one write operation routed by this generation."""
+        with self._cv:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cv.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until no write routed by this generation is in flight
+        (the migrator's drain before stop-seq capture / source drop).
+        Returns False on timeout."""
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        with self._cv:
+            while self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+    # -- routing with migrations overlaid -------------------------------------
+    def dual_migration(self, shard: int) -> ShardMigration | None:
+        m = self.migrations.get(int(shard))
+        return m if m is not None and m.state == MIG_DUAL else None
+
+    def write_members_for_shard(self, shard: int) -> tuple:
+        """(authoritative, *extras): writes always apply to the owner;
+        a dual-apply migration adds the destination."""
+        owner = self.router.member_for_shard(shard)
+        mig = self.dual_migration(shard)
+        if mig is not None and mig.dst != owner:
+            return (owner, mig.dst)
+        return (owner,)
+
+    def read_members_for_shards(self, shards) -> list:
+        """Deduped UNION of old and new owners over ``shards`` (None =
+        every shard) — the row-read fan during dual-apply. Merge-side
+        fid dedup makes the double fan safe (both owners hold the
+        dual-applied rows)."""
+        router = self.router
+        if shards is None:
+            shards = range(router.n_shards)
+        seen: set = set()
+        out: list = []
+        for s in shards:
+            for m in self.write_members_for_shard(s):
+                if m not in seen:
+                    seen.add(m)
+                    out.append(m)
+        out.sort(key=router._pos.__getitem__)
+        return out
+
+    def authoritative_members_for_shards(self, shards) -> list:
+        """Deduped CURRENT owners only — the additive-read fan (counts,
+        stats, aggregations, density sum across members; a union fan
+        would double-count every dual-applied row)."""
+        router = self.router
+        if shards is None:
+            shards = range(router.n_shards)
+        seen: set = set()
+        out: list = []
+        for s in shards:
+            m = router.member_for_shard(s)
+            if m not in seen:
+                seen.add(m)
+                out.append(m)
+        out.sort(key=router._pos.__getitem__)
+        return out
+
+    def snapshot(self) -> dict:
+        router = self.router
+        with self._cv:
+            inflight = self._inflight
+        return {
+            "generation": self.generation,
+            "members": list(router.members),
+            "n_shards": router.n_shards,
+            "virtual_nodes": router.virtual_nodes,
+            "assignments": {
+                str(s): m for s, m in sorted(router.assignments.items())
+            },
+            "shard_member": list(router.shard_member),
+            "migrations": [
+                m.snapshot() for _, m in sorted(self.migrations.items())
+            ],
+            "inflight_writes": inflight,
+        }
+
+
 class ShardedDataStoreView(MergedDataStoreView):
     """Shard-partitioned federation over ``[store, ...]``.
 
@@ -211,6 +503,11 @@ class ShardedDataStoreView(MergedDataStoreView):
     CRUD applies to every member; ``write`` partitions records by Z2 key
     so each row lands on exactly one member (write failures raise — a
     partial write is a correctness error, not a degraded answer).
+
+    The shard map lives in ONE atomic slot (``_generation``); every
+    operation snapshots it once. ``router`` stays available as a
+    property for the sweeper/ops surfaces (assigning it swaps in a
+    fresh generation — the pre-elastic offline idiom keeps working).
     """
 
     def __init__(self, stores, n_shards: int | None = None,
@@ -218,17 +515,133 @@ class ShardedDataStoreView(MergedDataStoreView):
                  slo_target: float = 0.999, virtual_nodes: int = 32):
         super().__init__(stores, on_member_error=on_member_error,
                          metrics=metrics, slo=slo, slo_target=slo_target)
-        self.router = ShardRouter(
+        self._gen_lock = threading.Lock()  # swap serialization only
+        self._generation = RouterGeneration(ShardRouter(
             list(range(len(self.stores))), n_shards=n_shards,
-            virtual_nodes=virtual_nodes)
+            virtual_nodes=virtual_nodes))
+        # per-operation generation pin: the merge step must dedup with
+        # the SAME generation that computed the fan-out, not whatever is
+        # current by merge time (same thread: fan-out and merge both run
+        # on the caller's thread inside one _query_fanout)
+        self._op_gen = threading.local()
 
-    # -- the fan-out narrowing hook (store/merged.py) -------------------------
+    # -- generation surface ---------------------------------------------------
+    @property
+    def router(self) -> ShardRouter:
+        return self._generation.router
+
+    @router.setter
+    def router(self, r: ShardRouter) -> None:
+        self.swap_generation(RouterGeneration(
+            r, self._generation.generation + 1))
+
+    def swap_generation(self, gen: RouterGeneration) -> RouterGeneration:
+        """Install a new shard-map generation atomically; returns the
+        superseded one (the migrator drains it). Generations must move
+        forward — a stale swap is a migrator bug, not a race to absorb."""
+        with self._gen_lock:
+            prev = self._generation
+            if gen.generation <= prev.generation:
+                raise ValueError(
+                    f"generation must advance: {gen.generation} after "
+                    f"{prev.generation}")
+            self._generation = gen
+            return prev
+
+    def with_members(self, members) -> RouterGeneration:
+        """OFFLINE membership change (assignment only — data does not
+        follow; ``serving.elastic.ShardMigrator.apply_membership`` is
+        the live one). Returns the new generation."""
+        gen = self._generation
+        new = gen.advance(router=gen.router.with_members(members),
+                          migrations=())
+        self.swap_generation(new)
+        return new
+
+    def add_member(self, store, scope=None) -> int:
+        """Join one store to the federation without granting it any
+        shards (membership precedes ownership): the migrator moves data
+        onto it one shard-generation at a time. Returns the new member
+        index."""
+        from geomesa_tpu.filter.cql import parse
+
+        if scope is not None and not isinstance(scope, ast.Filter):
+            scope = parse(scope)
+        with self._gen_lock:
+            self.stores.append((store, scope))
+            m = len(self.stores) - 1
+            prev = self._generation
+            self._generation = prev.advance(
+                router=prev.router.with_member_added(m))
+        return m
+
+    def remove_member(self, member: int) -> None:
+        """Depart one DRAINED member from the shard map. The store stays
+        in ``self.stores`` positionally (member indices are stable SLO /
+        metrics keys); it simply owns nothing and receives no fan-out."""
+        gen = self._generation
+        self.swap_generation(gen.advance(
+            router=gen.router.with_member_removed(member)))
+
+    def shards_snapshot(self) -> dict:
+        """The ops surface behind GET /api/obs/shards and
+        ``geomesa-tpu obs shards``."""
+        gen = self._generation
+        snap = gen.snapshot()
+        snap["coverage_violations"] = gen.router.coverage_violations()
+        snap["n_stores"] = len(self.stores)
+        return snap
+
+    # -- the fan-out narrowing hooks (store/merged.py) ------------------------
     def _member_subset(self, type_name: str, f) -> list | None:
+        """Additive-read fan: AUTHORITATIVE owners only (counts, stats,
+        aggregations and density SUM across members — a union fan would
+        double-count every dual-applied row)."""
+        gen = self._generation
+        self._op_gen.gen = gen
+        shards = self._shards_for(gen, type_name, f)
+        if shards is not None and not shards:
+            return []
+        return gen.authoritative_members_for_shards(shards)
+
+    def _member_subset_rows(self, type_name: str, f) -> list | None:
+        """Row-read fan: the UNION of old and new owners during a
+        dual-apply migration (fid dedup at the merge makes the double
+        fan safe); identical to the authoritative fan otherwise."""
+        gen = self._generation
+        self._op_gen.gen = gen
+        shards = self._shards_for(gen, type_name, f)
+        if shards is not None and not shards:
+            return []
+        return gen.read_members_for_shards(shards)
+
+    def _shards_for(self, gen: RouterGeneration, type_name: str, f):
         try:
             sft = self.get_schema(type_name)
         except Exception:  # noqa: BLE001 — let the member call surface it
             return None
-        return self.router.members_for_filter(f, sft)
+        return gen.router.shards_for_filter(f, sft)
+
+    def _merge_member_tables(self, tables: list) -> FeatureTable:
+        """Merge seam override: during a dual-apply window both owners
+        return the dual-applied rows — dedup by fid (first occurrence
+        wins; the copies are identical) using the SAME generation the
+        fan-out snapshotted."""
+        table = super()._merge_member_tables(tables)
+        gen = getattr(self._op_gen, "gen", None)
+        if gen is None or not gen.migrations or len(tables) < 2:
+            return table
+        return self._dedup_fids(table)
+
+    @staticmethod
+    def _dedup_fids(table: FeatureTable) -> FeatureTable:
+        fids = np.asarray(table.fids)
+        if len(fids) < 2:
+            return table
+        _, first = np.unique(fids, return_index=True)
+        if len(first) == len(fids):
+            return table
+        return table.take(np.sort(first))
 
     # -- write surface --------------------------------------------------------
     def create_schema(self, name_or_sft, spec: str | None = None) -> None:
@@ -253,10 +666,13 @@ class ShardedDataStoreView(MergedDataStoreView):
             if fn is not None:
                 fn(type_name)
 
-    def _record_members(self, sft, records, fids) -> np.ndarray:
-        """Member position per record: geometry rows key by their
-        envelope center's Z2 code, geometry-less rows by fid hash (row
-        index when fids are auto-generated) — deterministic either way."""
+    def _record_shards(self, sft, records, fids,
+                       router: ShardRouter) -> np.ndarray:
+        """Shard id per record: geometry rows key by their envelope
+        center's Z2 code, geometry-less rows by fid hash (row index
+        when fids are auto-generated) — deterministic either way.
+        ``router`` is the operation's snapshot: keying and placement
+        MUST come from one generation (the torn-read satellite fix)."""
         from geomesa_tpu.geometry.types import Geometry
         from geomesa_tpu.geometry.wkt import from_wkt
 
@@ -279,13 +695,18 @@ class ShardedDataStoreView(MergedDataStoreView):
                 geom_rows.append(i)
             else:
                 basis = str(fids[i]) if fids is not None else str(i)
-                keys[i] = np.uint64(self.router.fid_key(basis))
+                keys[i] = np.uint64(router.fid_key(basis))
         if geom_rows:
-            keys[np.asarray(geom_rows)] = self.router.keys_for(xs, ys)
-        shards = self.router.shards_of_keys(keys)
+            keys[np.asarray(geom_rows)] = router.keys_for(xs, ys)
+        return router.shards_of_keys(keys)
+
+    def _record_members(self, sft, records, fids) -> np.ndarray:
+        """Member position per record (kept for callers/tests that pin
+        the placement contract; routes off one generation snapshot)."""
+        router = self._generation.router
+        shards = self._record_shards(sft, records, fids, router)
         return np.asarray(
-            [self.router.member_for_shard(s) for s in shards],
-            dtype=np.int64)
+            [router.member_for_shard(s) for s in shards], dtype=np.int64)
 
     def write(self, type_name: str, data, fids=None) -> int:
         sft = self.get_schema(type_name)
@@ -298,19 +719,103 @@ class ShardedDataStoreView(MergedDataStoreView):
             fids = [str(f) for f in fids]
             if len(fids) != len(records):
                 raise ValueError("fids length must match records")
-        members = self._record_members(sft, records, fids)
-        total = 0
-        with obs.span("federation.write", type=type_name,
-                      rows=len(records)):
-            for m in sorted(set(members.tolist())):
-                idx = np.nonzero(members == m)[0]
-                store, _ = self.stores[m]
-                total += store.write(
-                    type_name, [records[i] for i in idx],
-                    fids=[fids[i] for i in idx] if fids is not None
-                    else None,
-                )
+        gen = self._generation  # ONE snapshot: keying, placement, dual
+        if fids is None and gen.migrations:
+            # dual-apply needs the SAME fid on both owners; member-side
+            # auto-generation would mint two different ones, and the
+            # tail replay's fid ledger could match neither
+            fids = [uuid.uuid4().hex for _ in records]
+        with gen.op():
+            shards = self._record_shards(sft, records, fids, gen.router)
+            owners = np.asarray(
+                [gen.router.member_for_shard(s) for s in shards],
+                dtype=np.int64)
+            total = 0
+            with obs.span("federation.write", type=type_name,
+                          rows=len(records)):
+                # exactly-once ledger FIRST: a dual row's fid must be in
+                # dual_fids before the source apply commits it to the
+                # WAL, or the tail replay could double-apply it
+                dual: dict[int, list[int]] = {}
+                for shard, mig in gen.migrations.items():
+                    if mig.state != MIG_DUAL:
+                        continue
+                    idx = np.nonzero(shards == shard)[0]
+                    if len(idx):
+                        dual[shard] = idx.tolist()
+                        with mig.lock:
+                            mig.dual_fids.update(fids[i] for i in idx)
+                rerouted: list[int] = []
+                for m in sorted(set(owners.tolist())):
+                    idx = np.nonzero(owners == m)[0]
+                    store, _ = self.stores[m]
+                    try:
+                        total += store.write(
+                            type_name, [records[i] for i in idx],
+                            fids=[fids[i] for i in idx] if fids is not None
+                            else None,
+                        )
+                    except MemberDrainingError:
+                        # the member declared a drain (503 + Retry-After):
+                        # its shards are moving — re-route this slice
+                        # through a FRESH generation instead of retrying
+                        # against the draining owner. One re-route only:
+                        # if the map has not advanced, the drain signal
+                        # is ahead of the control plane and must surface.
+                        if self._generation is gen:
+                            raise
+                        rerouted.extend(idx.tolist())
+                # dual extras: apply to each migration destination under
+                # the migration lock (serialized against the tail
+                # replay's check-then-apply)
+                for shard, idx in dual.items():
+                    mig = gen.migrations[shard]
+                    dst_store, _ = self.stores[mig.dst]
+                    if mig.dst in set(owners[idx].tolist()):
+                        continue  # destination already the owner
+                    with mig.lock:
+                        dst_store.write(
+                            type_name, [records[i] for i in idx],
+                            fids=[fids[i] for i in idx])
+        if rerouted:
+            total += self.write(
+                type_name, [records[i] for i in rerouted],
+                fids=[fids[i] for i in rerouted] if fids is not None
+                else None)
         return total
+
+    def delete_features(self, type_name: str, fids, visible_to=None) -> int:
+        """Federation-level delete: a fid alone cannot be mapped back to
+        a shard (geometry rows key by their coordinates), so the delete
+        fans to EVERY live member — each removes what it holds. During a
+        dual-apply window the fids are recorded in every active
+        migration's ledger first (a replayed older write must never
+        resurrect a deleted row on the destination)."""
+        self.get_schema(type_name)
+        gen = self._generation
+        want = [str(f) for f in fids]
+        with gen.op():
+            duals = [m for m in gen.migrations.values()
+                     if m.state == MIG_DUAL]
+            for mig in duals:
+                with mig.lock:
+                    mig.dual_fids.update(want)
+            members = gen.authoritative_members_for_shards(None)
+            removed = 0
+            with obs.span("federation.delete", type=type_name,
+                          fids=len(want)):
+                for m in members:
+                    store, _ = self.stores[m]
+                    removed += store.delete_features(
+                        type_name, want, visible_to=visible_to)
+                for mig in duals:
+                    if mig.dst in members:
+                        continue
+                    dst_store, _ = self.stores[mig.dst]
+                    with mig.lock:
+                        dst_store.delete_features(
+                            type_name, want, visible_to=visible_to)
+        return removed
 
     # -- batched read surface -------------------------------------------------
     def _normalize(self, queries) -> list:
@@ -320,15 +825,25 @@ class ShardedDataStoreView(MergedDataStoreView):
             for q in queries
         ]
 
-    def _fan_plan(self, type_name: str, qs: list):
-        """Per-query member subsets + the member → query-index map."""
-        subs = [
-            self._member_subset(type_name, q.resolved_filter()) for q in qs
-        ]
+    def _fan_plan(self, gen: RouterGeneration, type_name: str, qs: list,
+                  rows: bool):
+        """Per-query member subsets + the member → query-index map, all
+        routed off ONE generation snapshot (the torn-read satellite
+        fix). ``rows`` picks the union fan (row reads) vs the
+        authoritative fan (sums)."""
+        subs = []
+        for q in qs:
+            f = q.resolved_filter()
+            shards = self._shards_for(gen, type_name, f)
+            if shards is not None and not shards:
+                subs.append([])
+            elif rows:
+                subs.append(gen.read_members_for_shards(shards))
+            else:
+                subs.append(gen.authoritative_members_for_shards(shards))
         per_member: dict[int, list[int]] = {}
         for i, sub in enumerate(subs):
-            targets = range(len(self.stores)) if sub is None else sub
-            for m in targets:
+            for m in sub:
                 per_member.setdefault(m, []).append(i)
         return subs, per_member
 
@@ -350,7 +865,8 @@ class ShardedDataStoreView(MergedDataStoreView):
 
         qs = self._normalize(queries)
         sft = self.get_schema(type_name)
-        subs, per_member = self._fan_plan(type_name, qs)
+        gen = self._generation
+        subs, per_member = self._fan_plan(gen, type_name, qs, rows=True)
         tables: list[list] = [[] for _ in qs]
         failed: list[list] = [[] for _ in qs]
         errors: list = []
@@ -388,6 +904,8 @@ class ShardedDataStoreView(MergedDataStoreView):
                 table = parts[0]
             else:
                 table = FeatureTable.concat(parts)
+                if gen.migrations:
+                    table = self._dedup_fids(table)
             rows = np.arange(len(table), dtype=np.int64)
             table, rows = sort_limit(table, rows, q.sort_by, q.limit,
                                      q.start_index)
@@ -402,12 +920,14 @@ class ShardedDataStoreView(MergedDataStoreView):
 
     def count_many(self, type_name: str, queries, loose: bool = True):
         """Batched counts across the shard set: member counts sum per
-        query (rows partition — each row counts on exactly one member).
+        query (rows partition — each row counts on exactly one member,
+        so the fan is AUTHORITATIVE owners only even mid-migration).
         In partial mode a failed member contributes zero (undercount,
         recorded), the merged view's ``stats_count`` posture."""
         qs = self._normalize(queries)
         self.get_schema(type_name)  # surface missing types uniformly
-        subs, per_member = self._fan_plan(type_name, qs)
+        gen = self._generation
+        subs, per_member = self._fan_plan(gen, type_name, qs, rows=False)
         totals = [0] * len(qs)
         errors: list = []
         with obs.span("federation.count_many", type=type_name,

@@ -109,6 +109,18 @@ class BufferPool:
         self.evictions = 0
         self.donations = 0
         self.reuses = 0
+        # optional HBM→RAM→disk demotion ladder (serving/elastic.py);
+        # consulted OUTSIDE the pool lock — it does array export and
+        # file I/O
+        self._tiering = None
+
+    def attach_tiering(self, policy) -> None:
+        """Attach a :class:`~geomesa_tpu.serving.elastic.TieringPolicy`:
+        evicted/reclaimed entries demote to host RAM (then disk) instead
+        of freeing outright, and donation-stash misses consult the lower
+        tiers before the caller re-stages from the columnar tier."""
+        self._tiering = policy
+        policy.bind_pool(self)
 
     # -- accounting source of truth -------------------------------------------
     @staticmethod
@@ -221,12 +233,19 @@ class BufferPool:
 
         if _headroom() >= need_bytes:
             return True
-        # 1) reclaim the stash, oldest donation first
+        # 1) reclaim the stash, oldest donation first — demoted to the
+        #    lower tiers when a tiering policy is attached (outside the
+        #    lock: demotion exports arrays host-side)
         while _headroom() < need_bytes:
             with self._lock:
                 if not self._donated:
                     break
                 _, victim = self._donated.popitem(last=False)
+            if self._tiering is not None:
+                try:
+                    self._tiering.demote_entry(victim)
+                except Exception:  # noqa: BLE001 — degrade to a plain drop
+                    pass
             victim = None  # noqa: F841 — ref drop IS the reclamation
         if _headroom() >= need_bytes:
             return True
@@ -252,8 +271,15 @@ class BufferPool:
                 return True
 
     def _after_evict(self, e: _Entry) -> None:
-        """Post-eviction bookkeeping, OUTSIDE the pool lock: clear the
-        owner's slot (host path serves from now on) and record the spill."""
+        """Post-eviction bookkeeping, OUTSIDE the pool lock: demote to
+        the lower tiers when attached (the owner survives holding host
+        copies), clear the owner's slot (host path serves from now on),
+        and record the spill."""
+        if self._tiering is not None:
+            try:
+                self._tiering.demote_entry(e)
+            except Exception:  # noqa: BLE001 — degrade to a plain eviction
+                pass
         if e.on_evict is not None:
             try:
                 e.on_evict()
@@ -285,7 +311,11 @@ class BufferPool:
             for key in [k for k in self._donated
                         if k[0] == type_name and k[2] != keep_fingerprint]:
                 drop.append(self._donated.pop(key))
+            tier = self._tiering
         del drop  # refs drop outside the lock
+        if tier is not None:
+            # demoted copies at a superseded fingerprint are unpromotable
+            tier.invalidate(type_name, keep_fingerprint)
 
     def take_donated(self, type_name: str, index: str, fingerprint,
                      on_evict=None):
@@ -299,11 +329,27 @@ class BufferPool:
             return None
         with self._lock:
             e = self._donated.pop((type_name, index, fingerprint), None)
-            if e is None:
-                return None
+            if e is not None:
+                self.reuses += 1
+                key = (type_name, index)
+                self._entries[key] = e
+                if on_evict is not None:
+                    e.on_evict = on_evict
+                self._clock += 1
+                e.last_used = self._clock
+                return e.owner
+            tier = self._tiering
+        if tier is None:
+            return None
+        # stash miss: the lower tiers may hold a demoted copy. Promotion
+        # (disk/host → device staging + ledger re-registration) runs
+        # OUTSIDE the pool lock; only the re-admission takes it.
+        e = tier.take(type_name, index, fingerprint)
+        if e is None:
+            return None
+        with self._lock:
             self.reuses += 1
-            key = (type_name, index)
-            self._entries[key] = e
+            self._entries[(type_name, index)] = e
             if on_evict is not None:
                 e.on_evict = on_evict
             self._clock += 1
@@ -330,7 +376,10 @@ class BufferPool:
                 drop.append(self._entries.pop(key))
             for key in [k for k in self._donated if k[0] == type_name]:
                 drop.append(self._donated.pop(key))
+            tier = self._tiering
         del drop
+        if tier is not None:
+            tier.invalidate(type_name)  # purge reaches every tier
 
     # -- read surface ---------------------------------------------------------
     def donated_bytes(self, type_name: str | None = None) -> int:

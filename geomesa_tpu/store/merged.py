@@ -297,12 +297,31 @@ class MergedDataStoreView:
         health scoreboard attribute stably across differing subsets."""
         return None
 
-    def _fan_targets(self, type_name: str, f) -> list:
-        """``[(member_index, (store, scope)), ...]`` for one fan-out."""
-        subset = self._member_subset(type_name, f)
+    def _member_subset_rows(self, type_name: str, f) -> list | None:
+        """Row-read variant of :meth:`_member_subset`: the sharded view
+        widens this to the UNION of old and new owners during a live
+        shard migration's dual-apply window (row results dedup by fid
+        at the merge), while additive reads — counts, stats sketches,
+        density grids, grouped aggregations, everything that SUMS
+        across members — keep the authoritative subset (a union fan
+        would double-count every dual-applied row). The merged default:
+        the two fans are identical."""
+        return self._member_subset(type_name, f)
+
+    def _fan_targets(self, type_name: str, f, rows: bool = False) -> list:
+        """``[(member_index, (store, scope)), ...]`` for one fan-out.
+        ``rows=True`` marks a row-returning read (union fan allowed)."""
+        subset = (self._member_subset_rows(type_name, f) if rows
+                  else self._member_subset(type_name, f))
         if subset is None:
             return list(enumerate(self.stores))
         return [(i, self.stores[i]) for i in subset]
+
+    def _merge_member_tables(self, tables: list) -> FeatureTable:
+        """Merge seam for per-member row results: the sharded view
+        overrides this to dedup dual-applied rows by fid while a live
+        shard migration union-fans reads."""
+        return FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
 
     def _note_degraded(self, errors: list, op: str) -> None:
         self.metrics.counter("federation.degraded_queries").inc()
@@ -423,7 +442,8 @@ class MergedDataStoreView:
         bin_parts: list[bytes] = []
         errors: list = []
         base_f = q.resolved_filter()
-        targets = self._fan_targets(type_name, base_f)
+        row_read = not any(k in q.hints for k in ("density", "stats", "bin"))
+        targets = self._fan_targets(type_name, base_f, rows=row_read)
         if not targets:
             # provably disjoint under the shard map: no member can hold
             # a matching row. Aggregation-hinted queries (density /
@@ -489,7 +509,7 @@ class MergedDataStoreView:
                 member_errors=self._error_details(errors) if errors else None,
             ), errors
 
-        table = FeatureTable.concat(tables) if len(tables) > 1 else tables[0]
+        table = self._merge_member_tables(tables)
         rows = np.arange(len(table), dtype=np.int64)
         from geomesa_tpu.store.reduce import sort_limit
 

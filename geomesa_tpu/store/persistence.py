@@ -343,6 +343,134 @@ def save_type(ds, path: str, type_name: str, partition_by_time: bool = True,
         return entry
 
 
+SHARD_MANIFEST = "shard.json"
+
+
+def save_shard(ds, type_name: str, path: str, selector, *,
+               durable: bool = True, file_format: str = "parquet") -> dict:
+    """Shard-scoped export of ONE type's row subset, stamped with the
+    source's WAL replay floor — the live-migration ship format
+    (serving/elastic.py).
+
+    Unlike :func:`save_type` — which REFUSES WAL-attached stores because
+    merging one type's shards into a shared catalog leaves the
+    manifest's replay floors stale — this writes a standalone
+    self-contained bundle that never touches the source's catalog or
+    trims its WAL, so it is safe on a live WAL-mode store: the snapshot
+    and the floor are captured at the SAME instant under the type's
+    ``wal_lock`` (the write path's commit lock), which means every
+    record with seq > ``wal_floor`` is exactly the tail the destination
+    must replay on top of the bundle — no gap, no overlap.
+
+    ``selector(table) -> bool mask | row indices`` picks the shard's
+    rows (the caller owns the keying — the router's shard function
+    stays in the serving layer). Layout::
+
+        <path>/
+          shard.json            # type, spec, rows, wal_floor, file
+          rows.<format>         # the selected rows (absent when empty)
+
+    ``durable`` fsyncs the data file before its rename and the bundle
+    directory after (same rationale as :func:`save`). Returns the shard
+    manifest. Non-WAL stores export with ``wal_floor = None``.
+    """
+    from geomesa_tpu.schema.columnar import FeatureTable
+
+    if file_format not in ("parquet", "orc"):
+        raise ValueError(f"unsupported format: {file_format!r}")
+    st = ds._state(type_name)
+    wal = getattr(ds, "_wal", None)
+
+    def _capture():
+        # lock order matches the mutation paths: wal_lock > mutate_lock
+        # > lock (docs/concurrency.md) — holding wal_lock blocks every
+        # WAL-mode mutation, so rows and floor are one consistent cut
+        with st.mutate_lock:
+            main, _, delta, _ = st.consume_snapshot()
+        tables = [t for t in (main, delta) if t is not None and len(t)]
+        if not tables:
+            return None
+        return tables[0] if len(tables) == 1 else FeatureTable.concat(tables)
+
+    if wal is not None:
+        with st.wal_lock:
+            combined = _capture()
+            with st.lock:
+                floor = st.wal_seq
+    else:
+        combined = _capture()
+        floor = None
+
+    # row selection + file I/O run OUTSIDE every store lock: the captured
+    # tables are immutable snapshots
+    if combined is None:
+        table = None
+    else:
+        rows = np.asarray(selector(combined))
+        if rows.dtype == bool:
+            rows = np.nonzero(rows)[0]
+        table = combined.take(rows) if len(rows) else None
+
+    root = Path(path)
+    root.mkdir(parents=True, exist_ok=True)
+    manifest = {
+        "version": 1,
+        "type": type_name,
+        "spec": st.sft.to_spec(),
+        "rows": 0 if table is None else int(len(table)),
+        "wal_floor": floor,
+        "format": file_format,
+        "file": None,
+    }
+    if table is not None:
+        geom_enc = str(
+            (st.sft.user_data or {}).get("geomesa.fs.geometry-encoding",
+                                         "wkb"))
+        twkb_prec = int(
+            (st.sft.user_data or {}).get("geomesa.twkb.precision", 7))
+        at = to_arrow(table, geometry_encoding=geom_enc,
+                      twkb_precision=twkb_prec)
+        fn = f"rows.{file_format}"
+        tmp = root / (fn + ".tmp")
+        _write_table(at, tmp, file_format)
+        if durable:
+            _fsync_file(tmp)
+        os.replace(tmp, root / fn)
+        manifest["file"] = fn
+    mtmp = root / (SHARD_MANIFEST + ".tmp")
+    mtmp.write_text(json.dumps(manifest, indent=2))
+    if durable:
+        _fsync_file(mtmp)
+    os.replace(mtmp, root / SHARD_MANIFEST)
+    if durable:
+        _fsync_dir(root)
+    return manifest
+
+
+def load_shard(ds, path: str) -> int:
+    """Bulk-load a :func:`save_shard` bundle into ``ds`` (the migration
+    destination). The type must already exist with a matching attribute
+    layout; the rows append through the NORMAL write path — on a
+    WAL-attached destination they journal like any other write, so a
+    destination crash after cutover recovers them from its own WAL.
+    Returns the number of rows loaded."""
+    root = Path(path)
+    manifest = json.loads((root / SHARD_MANIFEST).read_text())
+    type_name = manifest["type"]
+    sft = ds.get_schema(type_name)
+    want = parse_spec(type_name, manifest["spec"])
+    if [a.name for a in want.attributes] != [a.name for a in sft.attributes]:
+        raise ValueError(
+            f"shard bundle schema mismatch for {type_name!r}")
+    if not manifest.get("file"):
+        return 0
+    at = _read_table(root / manifest["file"],
+                     manifest.get("format", "parquet"))
+    table = from_arrow(sft, at)
+    ds.write(type_name, table)
+    return len(table)
+
+
 def _save_locked(ds, path: str, partition_by_time: bool, file_format: str,
                  durable: bool | None = None) -> dict:
     from geomesa_tpu.resilience import faults as _faults

@@ -480,6 +480,23 @@ class WriteAheadLog:
         out.sort(key=lambda r: r[0])
         return out
 
+    def records_between(self, topic: str, floor: int, ceiling: int):
+        """Durable records of ONE topic with ``floor < seq <= ceiling``,
+        in seq order — the live-migration tail-replay stream
+        (serving/elastic.py): the shard bundle's ``wal_floor`` bounds it
+        below, the migrator's post-drain stop seq bounds it above, so
+        the destination replays exactly the records the snapshot missed
+        and the dual-apply window has not delivered."""
+        floor, ceiling = int(floor), int(ceiling)
+        out: list[tuple[int, dict, bytes]] = []
+        for _s, _e, payload in self.bus.iter_records(topic):
+            hdr, body = decode_record(payload)
+            seq = int(hdr["seq"])
+            if floor < seq <= ceiling:
+                out.append((seq, hdr, body))
+        out.sort(key=lambda r: r[0])
+        return out
+
     def note_checkpoint(self, stamps: dict[str, int], global_seq: int) -> None:
         """A checkpoint with these per-topic applied-seq stamps just
         committed: durably head-trim every topic below its stamp (topics

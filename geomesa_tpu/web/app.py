@@ -47,6 +47,8 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
                                                  in the stable reconcile-
                                                  export schema (tpusync
                                                  --reconcile input)
+    GET    /api/obs/shards                       shard map + live migration
+                                                 states + migration counters
     GET    /api/metrics                          metrics snapshot (+ device
                                                  HBM residency section)
     GET    /api/metrics?format=prometheus       Prometheus text exposition
@@ -220,6 +222,9 @@ class GeoMesaApp:
             ("GET", r"^/api/obs/lens$", self._obs_lens),
             ("GET", r"^/api/obs/fusion$", self._obs_fusion),
             ("GET", r"^/api/obs/ledger$", self._obs_ledger),
+            # elasticity plane: shard map + live migration states +
+            # process-wide migration counters (docs/operations.md)
+            ("GET", r"^/api/obs/shards$", self._obs_shards),
             ("GET", r"^/api/metrics$", self._metrics),
             # OGC WFS 2.0 KVP binding (GeoServer-plugin role, web/wfs.py)
             ("GET", r"^/wfs/?$", self._wfs),
@@ -1262,6 +1267,24 @@ class GeoMesaApp:
                 "application/json"
         return 200, _rtledger.table().export(), "application/json"
 
+    def _obs_shards(self, params, body):
+        """The sharded federation's routing state (``geomesa-tpu obs
+        shards`` pulls this): current generation, members, per-shard
+        ownership, LIVE migration records (state / rows shipped+replayed
+        / dual-ledger size), coverage violations, and the process-wide
+        migration state counters. Stores without a shard router answer
+        with just the counters — the caller learns this serves a single
+        member, not an error."""
+        from geomesa_tpu.serving import elastic as _elastic
+
+        out = {"migration_counters": _elastic.migration_metrics()}
+        snap = getattr(self.store, "shards_snapshot", None)
+        if snap is not None:
+            out.update(snap())
+        else:
+            out["sharded"] = False
+        return 200, out, "application/json"
+
     def _metrics(self, params, body):
         m = getattr(self.store, "metrics", None)
         # the store's SLO engine (DataStore and MergedDataStoreView both
@@ -1317,6 +1340,11 @@ class GeoMesaApp:
 
             text += _lensmod.get().prometheus_text()
             text += _lensmod.sentinel().prometheus_text()
+            # elastic plane: geomesa_shard_migrations_total{state},
+            # geomesa_tier_bytes{tier,type}, geomesa_autoscaler_* totals
+            from geomesa_tpu.serving import elastic as _elastic
+
+            text += _elastic.prometheus_text()
             return 200, text.encode(), PROMETHEUS_CONTENT_TYPE
         out = m.snapshot() if m is not None else {}
         # device section: per-(type, index, group) resident bytes, budget

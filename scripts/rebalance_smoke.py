@@ -1,0 +1,474 @@
+"""Live-rebalance chaos harness (ISSUE 19; bench_gate leg 10).
+
+The elastic counterpart of ``crash_smoke.py``: a worker process runs a
+THREE-member in-process federation (each member a WAL-backed
+:class:`DataStore` under its own catalog) behind a
+:class:`ShardedDataStoreView`, with writer threads pushing acked batches
+(write-intent / write-ack lines in ``ack.log``, exactly the crash-smoke
+ledger) while a migration thread continuously rebalances shards between
+members through :class:`~geomesa_tpu.serving.elastic.ShardMigrator`.
+The driver SIGKILLs the worker mid-migration — at the named
+``elastic.*`` crash points (pre_ship, mid_ship, pre_dual, mid_catchup,
+pre_cutover, pre_source_drop) or at a random instant — then verifies
+the elastic contract end to end:
+
+- ``ShardMigrator.recover()`` resolves the journaled in-flight
+  migration (rollback before the cutover journal entry, roll-forward
+  after), and the recovered shard map has zero ``coverage_violations``;
+- every ACKED write is present EXACTLY once, on its shard's
+  authoritative owner — zero loss, zero duplication, no acked delete
+  resurrected, no stray copies lingering on non-owners;
+- each member passes ISSUE-13 referee parity on a query mix, and the
+  invariant sweeper (stores + sharded view) reports nothing;
+- write p99 DURING migrations stays within an envelope of the steady
+  p99 (``GEOMESA_REBALANCE_P99_FACTOR`` x, with an absolute floor of
+  ``GEOMESA_REBALANCE_P99_FLOOR_MS`` — zero-downtime, quantified).
+
+``--red`` is the loss-detector self-test: ``GEOMESA_TPU_ELASTIC_UNSAFE``
+disables the dual-apply state, so writes landing on the migrating shard
+after the catch-up stop seq never reach the destination and vanish at
+the post-cutover source drop. The harness MUST detect the loss (exit 0
+= detected); a silent red leg fails the gate — the referee is being
+trusted to catch real migration bugs, so it must provably catch an
+injected one.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TYPE = "pts"
+SPEC = "name:String,dtg:Date,*geom:Point"
+T0 = 1_500_000_000_000
+N_MEMBERS = 3
+N_SHARDS = 8
+
+ELASTIC_POINTS = [
+    "elastic.pre_ship", "elastic.mid_ship", "elastic.pre_dual",
+    "elastic.mid_catchup", "elastic.pre_cutover",
+    "elastic.pre_source_drop",
+]
+
+QUERY_MIX = [
+    "BBOX(geom,-170,-80,170,80)",
+    "name='n1'",
+    "BBOX(geom,-60,-30,60,30) AND name='n0'",
+]
+
+
+def _fids(batch: int, n: int) -> list:
+    return [f"b{batch}r{i}" for i in range(n)]
+
+
+def _rows(batch: int, n: int) -> list:
+    from geomesa_tpu.geometry.types import Point
+
+    rng = random.Random(batch * 6151 + 7)
+    return [
+        {"name": f"n{i % 3}", "dtg": T0 + batch * 1000 + i,
+         "geom": Point(rng.uniform(-170.0, 170.0),
+                       rng.uniform(-60.0, 60.0))}
+        for i in range(n)
+    ]
+
+
+def _parse_acklog(path: str):
+    """Same intent/ack discipline as crash_smoke: WI before the write,
+    WA only after the view acked; DI/DA for deletes. Returns (acked
+    {batch: n}, deleted fids, open intents, max batch seen)."""
+    acked: dict[int, int] = {}
+    deleted: set = set()
+    open_intents: dict = {}
+    max_batch = -1
+    if not os.path.exists(path):
+        return acked, deleted, [], max_batch
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            if parts[0] == "WI":
+                open_intents[f"w{parts[1]}"] = ("write", int(parts[1]),
+                                                int(parts[2]))
+                max_batch = max(max_batch, int(parts[1]))
+            elif parts[0] == "WA":
+                acked[int(parts[1])] = int(parts[2])
+                open_intents.pop(f"w{parts[1]}", None)
+            elif parts[0] == "DI":
+                open_intents["d" + parts[1]] = ("delete", parts[1].split(","))
+            elif parts[0] == "DA":
+                deleted.update(parts[1].split(","))
+                open_intents.pop("d" + parts[1], None)
+    return acked, deleted, list(open_intents.values()), max_batch
+
+
+def _open_federation(workdir: str, checkpointer: bool = True):
+    from geomesa_tpu.serving.elastic import ShardMigrator
+    from geomesa_tpu.serving.shards import ShardedDataStoreView
+    from geomesa_tpu.store.datastore import DataStore
+
+    stores = [
+        DataStore.open(os.path.join(workdir, f"m{i}"), recover=True,
+                       checkpointer=checkpointer)
+        for i in range(N_MEMBERS)
+    ]
+    view = ShardedDataStoreView(stores, n_shards=N_SHARDS)
+    if TYPE not in stores[0].list_schemas():
+        view.create_schema(TYPE, SPEC)
+    mig = ShardMigrator(
+        view,
+        os.path.join(workdir, "journal.json"),
+        os.path.join(workdir, "bundles"),
+        dual_window_s=float(os.environ.get("GEOMESA_REBALANCE_DUAL_S",
+                                           "0.3")),
+        drain_timeout_s=15.0,
+    )
+    return stores, view, mig
+
+
+def worker(workdir: str) -> None:
+    """The killed process: recover the journaled shard map, then write
+    (with intent/ack logging and latency capture) on several threads
+    while a migration thread rebalances shards nonstop — until the
+    driver's injected ``elastic.*`` crash point (or a random SIGKILL)
+    ends it mid-protocol."""
+    import threading
+
+    stores, view, mig = _open_federation(workdir)
+    mig.recover()
+    ack_path = os.path.join(workdir, "ack.log")
+    acked, deleted, _, max_batch = _parse_acklog(ack_path)
+    ack = open(ack_path, "a", buffering=1)
+    lat = open(os.path.join(workdir, "lat.log"), "a", buffering=1)
+    ack_lock = threading.Lock()
+    n_threads = int(os.environ.get("GEOMESA_REBALANCE_THREADS", "3"))
+    rows = int(os.environ.get("GEOMESA_REBALANCE_ROWS", "12"))
+    start = max_batch + 1
+
+    def _writer(tid: int) -> None:
+        batch = start + tid
+        rng = random.Random(batch * 7919 + 13)
+        mine: list[int] = []
+        while True:
+            n = 1 + rng.randrange(rows)
+            with ack_lock:
+                ack.write(f"WI {batch} {n}\n")
+            moving = 1 if view._generation.migrations else 0
+            t0 = time.perf_counter()
+            view.write(TYPE, _rows(batch, n), fids=_fids(batch, n))
+            ms = (time.perf_counter() - t0) * 1000.0
+            with ack_lock:
+                ack.write(f"WA {batch} {n}\n")
+                lat.write(f"L {ms:.3f} {moving}\n")
+            acked[batch] = n
+            mine.append(batch)
+            if len(mine) % 7 == 5 and len(mine) > 2:
+                victim = rng.choice(mine[:-1])
+                fids = [f for f in _fids(victim, acked[victim])[:2]
+                        if f not in deleted]
+                if fids:
+                    key = ",".join(fids)
+                    with ack_lock:
+                        ack.write(f"DI {key}\n")
+                    view.delete_features(TYPE, fids)
+                    with ack_lock:
+                        ack.write(f"DA {key}\n")
+                    deleted.update(fids)
+            if len(mine) % 5 == 0:
+                view.query(TYPE, rng.choice(QUERY_MIX))
+            batch += n_threads
+
+    def _rebalancer() -> None:
+        from geomesa_tpu.serving.elastic import MigrationError
+
+        rng = random.Random(int(os.environ.get("GEOMESA_CRASH_SEED",
+                                               "1234")) + 1)
+        while True:
+            router = view.router
+            loads = {m: len(router.shards_of_member(m))
+                     for m in router.members}
+            donor = max(loads, key=lambda m: loads[m])
+            recip = min(loads, key=lambda m: loads[m])
+            if donor == recip or not loads[donor]:
+                time.sleep(0.1)
+                continue
+            owned = router.shards_of_member(donor)
+            try:
+                mig.migrate(owned[rng.randrange(len(owned))], recip)
+            except MigrationError:
+                pass  # rolled back — the federation keeps serving
+            assert view.router.coverage_violations() == []
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=_writer, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    threads.append(threading.Thread(target=_rebalancer, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()  # pragma: no cover — the process dies by SIGKILL
+
+
+def verify(workdir: str) -> dict:
+    """Reopen the federation, run migration recovery, and check the
+    elastic contract (module docstring); returns ``ok``/``errors``."""
+    from geomesa_tpu.obs.audit import InvariantSweeper
+    from geomesa_tpu.ops.referee import fid_sets_equal, referee_select
+    from geomesa_tpu.planning.planner import Query
+
+    acked, deleted, open_intents, _mb = _parse_acklog(
+        os.path.join(workdir, "ack.log"))
+    errors: list = []
+    t0 = time.perf_counter()
+    stores, view, mig = _open_federation(workdir, checkpointer=False)
+    recovery = mig.recover()
+    recover_ms = (time.perf_counter() - t0) * 1000.0
+    try:
+        router = view.router
+        bad = router.coverage_violations()
+        if bad:
+            errors.append(f"coverage violations after recovery: {bad[:3]}")
+        sft = view.get_schema(TYPE)
+        # raw per-member row census (NOT through the view: view-level
+        # dedup must not be allowed to mask a double-applied row)
+        owner_count: dict = {}
+        stray: list = []
+        unacked_ok = {
+            f for it in open_intents if it[0] == "write"
+            for f in _fids(it[1], it[2])
+        }
+        for m, ds in enumerate(stores):
+            st = ds._state(TYPE)
+            with st.lock:
+                tiers = [st.table, *st.delta.tables]
+            for t in tiers:
+                if t is None or not len(t):
+                    continue
+                shards = mig._shards_of_table(sft, t, router)
+                for f, s in zip(t.fids, shards):
+                    f = str(f)
+                    if router.member_for_shard(int(s)) == m:
+                        owner_count[f] = owner_count.get(f, 0) + 1
+                    elif f not in unacked_ok:
+                        stray.append((f, m))
+        expected = {
+            f for b, n in acked.items() for f in _fids(b, n)
+        } - deleted
+        lost = sorted(expected - set(owner_count))
+        if lost:
+            errors.append(f"ACKED-WRITE LOSS: {len(lost)} fids missing "
+                          f"after rebalance, e.g. {lost[:5]}")
+        dups = sorted(f for f, c in owner_count.items() if c > 1)
+        if dups:
+            errors.append(f"DUPLICATED rows after rebalance: {dups[:5]}")
+        resurrected = sorted(deleted & set(owner_count))
+        if resurrected:
+            errors.append(f"acked delete undone: {resurrected[:5]}")
+        if stray:
+            errors.append(
+                f"{len(stray)} rows on non-owner members after recovery, "
+                f"e.g. {stray[:3]}")
+        # ISSUE-13 referee parity, per member
+        for m, ds in enumerate(stores):
+            st = ds._state(TYPE)
+            main, _idx, _bs, _stats, delta = st.snapshot()
+            for cql in QUERY_MIX[:2]:
+                live = sorted(
+                    str(f) for f in ds.query(TYPE, cql).table.fids)
+                same, why = fid_sets_equal(
+                    live, referee_select(st.sft, main, delta,
+                                         Query(filter=cql)))
+                if not same:
+                    errors.append(
+                        f"referee parity broke on member {m} {cql!r}: "
+                        f"{why}")
+        sweeper = InvariantSweeper()
+        for ds in stores:
+            sweeper.attach_store(ds)
+        sweeper.attach_view(view)
+        for check in sweeper.sweep_once():
+            if check["check"] == "ledger":
+                # the devmon ledger is process-global; three same-typed
+                # members in ONE process triple-count against each
+                # store's resident bytes — structurally inapplicable
+                # here (single-store agreement is pinned in tests)
+                continue
+            if check["violations"]:
+                errors.append(f"invariant sweep {check['check']}: "
+                              f"{check['violations'][:3]}")
+    finally:
+        for ds in stores:
+            ds.close()
+    return {
+        "ok": not errors,
+        "errors": errors,
+        "recovery": (recovery or {}).get("action", "none"),
+        "acked_rows": int(sum(acked.values())),
+        "recover_ms": round(recover_ms, 2),
+    }
+
+
+def _percentile(xs: list, q: float) -> float:
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+
+def check_latency(workdir: str) -> tuple:
+    """Steady vs during-migration write p99 from the worker's latency
+    log. Returns (ok, detail) — abstains (ok) below 50 samples a side."""
+    steady: list = []
+    moving: list = []
+    path = os.path.join(workdir, "lat.log")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 3 and parts[0] == "L":
+                    (moving if parts[2] == "1" else steady).append(
+                        float(parts[1]))
+    detail = {
+        "steady_n": len(steady), "moving_n": len(moving),
+        "steady_p99_ms": round(_percentile(steady, 0.99), 3),
+        "moving_p99_ms": round(_percentile(moving, 0.99), 3),
+    }
+    if len(steady) < 50 or len(moving) < 50:
+        return True, detail
+    factor = float(os.environ.get("GEOMESA_REBALANCE_P99_FACTOR", "3"))
+    floor = float(os.environ.get("GEOMESA_REBALANCE_P99_FLOOR_MS", "100"))
+    bound = max(factor * detail["steady_p99_ms"], floor)
+    return detail["moving_p99_ms"] <= bound, detail
+
+
+def drive(workdir: str, cycles: int, red: bool, points: list,
+          timeout_s: float) -> int:
+    os.makedirs(workdir, exist_ok=True)
+    base_env = dict(os.environ)
+    rng = random.Random(int(base_env.get("GEOMESA_CRASH_SEED", "1234")))
+    tag = "rebalance-smoke"
+    for cycle in range(cycles):
+        env = dict(base_env)
+        if red:
+            point = "unsafe_dual_window"
+            env["GEOMESA_TPU_ELASTIC_UNSAFE"] = "1"
+            env["GEOMESA_REBALANCE_DUAL_S"] = "1.0"
+            env.pop("GEOMESA_TPU_FAULTS", None)
+        elif points:
+            point = points[cycle % len(points)]
+            env["GEOMESA_TPU_FAULTS"] = (
+                f"kind=crash,match={point},after={rng.randrange(3)}")
+        elif rng.random() < 0.8:
+            point = ELASTIC_POINTS[cycle % len(ELASTIC_POINTS)]
+            env["GEOMESA_TPU_FAULTS"] = (
+                f"kind=crash,match={point},after={rng.randrange(3)}")
+        else:
+            point = "random"
+            env.pop("GEOMESA_TPU_FAULTS", None)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--dir", workdir],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        kill_mode = "self"
+        deadline = time.monotonic() + timeout_s
+        # the red leg (and 'random') kills from outside after the loss
+        # window has had time to open across several full migrations
+        outside_kill_at = time.monotonic() + rng.uniform(4.0, 7.0)
+        while proc.poll() is None:
+            now = time.monotonic()
+            if point in ("random", "unsafe_dual_window") \
+                    and now >= outside_kill_at:
+                proc.send_signal(signal.SIGKILL)
+                kill_mode = "driver"
+                break
+            if now >= deadline:
+                proc.send_signal(signal.SIGKILL)
+                kill_mode = "timeout"
+                break
+            time.sleep(0.02)
+        stderr = b""
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            proc.kill()
+            proc.communicate()
+        if proc.returncode not in (-signal.SIGKILL,):
+            print(f"[{tag}] cycle {cycle} ({point}): worker exited "
+                  f"rc={proc.returncode}, not SIGKILL", file=sys.stderr)
+            sys.stderr.write(stderr.decode("utf-8", "replace")[-2000:]
+                             + "\n")
+            return 1
+        report = verify(workdir)
+        status = "OK" if report["ok"] else "LOSS/VIOLATION"
+        print(f"[{tag}] cycle {cycle:>3} point={point:<26} "
+              f"kill={kill_mode:<7} acked_rows={report['acked_rows']:<6} "
+              f"recovery={report['recovery']:<14} "
+              f"recover_ms={report['recover_ms']:<8} {status}")
+        if not report["ok"]:
+            for e in report["errors"]:
+                print(f"[{tag}]   {e}")
+            if red:
+                print(f"[{tag}] RED leg: injected dual-apply loss window "
+                      "was DETECTED (the referee works)")
+                return 0
+            return 1
+    if red:
+        print(f"[{tag}] RED leg FAILED: the disabled dual-apply window "
+              "produced no detected loss — the harness is silent",
+              file=sys.stderr)
+        return 1
+    lat_ok, lat = check_latency(workdir)
+    print(f"[{tag}] latency: steady p99={lat['steady_p99_ms']}ms "
+          f"(n={lat['steady_n']}), during-migration "
+          f"p99={lat['moving_p99_ms']}ms (n={lat['moving_n']})")
+    if not lat_ok:
+        print(f"[{tag}] during-migration p99 outside the envelope",
+              file=sys.stderr)
+        return 1
+    print(f"[{tag}] {cycles} kill/recover cycles, zero acked-write loss "
+          "across live rebalances")
+    return 0
+
+
+def main() -> int:
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--verify-only", action="store_true",
+                   help="run only the recovery verification on --dir")
+    p.add_argument("--dir", default=None,
+                   help="work directory (default: a fresh temp dir)")
+    p.add_argument("--cycles", type=int, default=int(
+        os.environ.get("GEOMESA_REBALANCE_CYCLES", "8")))
+    p.add_argument("--point", action="append", default=None,
+                   help="restrict to specific elastic.* crash point(s)")
+    p.add_argument("--timeout", type=float, default=float(
+        os.environ.get("GEOMESA_REBALANCE_TIMEOUT_S", "30")))
+    p.add_argument("--red", action="store_true",
+                   help="loss-detector self-test: the unsafe dual window "
+                   "MUST be detected (exit 0 = detected)")
+    args = p.parse_args()
+    if args.worker:
+        worker(args.dir)
+        return 0  # pragma: no cover — the worker dies by SIGKILL
+    workdir = args.dir or tempfile.mkdtemp(prefix="geomesa-rebalance-")
+    if args.verify_only:
+        report = verify(workdir)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    return drive(workdir, args.cycles, args.red, args.point or [],
+                 args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,920 @@
+"""Elastic federation tests (ISSUE 19): WAL-backed live shard
+migration (ship → dual-apply → cutover, crash recovery via the elastic
+journal), zero-downtime membership change, the HBM → RAM → disk tiering
+ladder, the autoscaler control plane, and the draining-member signal.
+See docs/serving.md § Shard-map lifecycle and docs/operations.md."""
+
+import email
+import json
+import os
+import threading
+import urllib.error
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.obs import devmon
+from geomesa_tpu.obs import flight as obs_flight
+from geomesa_tpu.resilience import faults
+from geomesa_tpu.resilience.policy import MemberDrainingError, RetryPolicy
+from geomesa_tpu.serving import elastic
+from geomesa_tpu.serving.elastic import (
+    FederationAutoscaler,
+    MigrationError,
+    ShardMigrator,
+    TieringPolicy,
+)
+from geomesa_tpu.serving.shards import (
+    MIG_DUAL,
+    ShardedDataStoreView,
+    ShardMigration,
+    ShardRouter,
+)
+from geomesa_tpu.store import persistence
+from geomesa_tpu.store.bufferpool import BufferPool, register_residency
+from geomesa_tpu.store.datastore import DataStore
+
+T0 = 1_500_000_000_000
+SPEC = "name:String,dtg:Date,*geom:Point"
+
+
+# -- federation helpers -------------------------------------------------------
+
+def _open_fed(root, members=3, n_shards=8, **mig_kw):
+    stores = [
+        DataStore.open(str(root / f"m{i}"), recover=True,
+                       checkpointer=False)
+        for i in range(members)
+    ]
+    view = ShardedDataStoreView(stores, n_shards=n_shards)
+    if "pts" not in stores[0].list_schemas():
+        view.create_schema("pts", SPEC)
+    mig_kw.setdefault("dual_window_s", 0.05)
+    mig_kw.setdefault("drain_timeout_s", 10.0)
+    migrator = ShardMigrator(
+        view, str(root / "journal.json"), str(root / "bundles"), **mig_kw)
+    return view, stores, migrator
+
+
+def _close(stores):
+    for s in stores:
+        s.close()
+
+
+def _write_rows(view, n, prefix="f", seed=7):
+    rng = np.random.default_rng(seed)
+    recs = [
+        {"name": f"n{i % 3}", "dtg": T0 + i * 1000,
+         "geom": Point(float(rng.uniform(-170, 170)),
+                       float(rng.uniform(-60, 60)))}
+        for i in range(n)
+    ]
+    fids = [f"{prefix}{i}" for i in range(n)]
+    view.write("pts", recs, fids=fids)
+    return recs, fids
+
+
+def _recs_for_shard(view, router, shard, n, prefix, seed=None):
+    """Records that the write path's own keying places on ``shard``
+    (geometry rows key by coordinates, so the fid choice is free)."""
+    sft = view.get_schema("pts")
+    rng = np.random.default_rng(shard * 31 + 1 if seed is None else seed)
+    recs: list = []
+    while len(recs) < n:
+        cand = [
+            {"name": "t", "dtg": T0,
+             "geom": Point(float(rng.uniform(-170, 170)),
+                           float(rng.uniform(-60, 60)))}
+            for _ in range(128)
+        ]
+        shards = view._record_shards(
+            sft, cand, [str(i) for i in range(len(cand))], router)
+        recs.extend(c for c, s in zip(cand, shards) if int(s) == shard)
+    recs = recs[:n]
+    return recs, [f"{prefix}{i}" for i in range(n)]
+
+
+def _census(stores):
+    """fid -> [member indices holding it] across the federation."""
+    out: dict = {}
+    for m, s in enumerate(stores):
+        if "pts" not in s.list_schemas():
+            continue
+        for f in s.query("pts", None).table.fids:
+            out.setdefault(str(f), []).append(m)
+    return out
+
+
+# -- the migrator -------------------------------------------------------------
+
+class TestShardMigrator:
+    def test_migrate_zero_loss_under_concurrent_writes(self, tmp_path):
+        view, stores, mig = _open_fed(tmp_path)
+        try:
+            _, base = _write_rows(view, 90)
+            router = view._generation.router
+            shard = 0
+            src = router.member_for_shard(shard)
+            dst = next(m for m in router.members if m != src)
+            errs: list = []
+            stop = threading.Event()
+            written: list = []
+
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    fid = f"w{i}"
+                    try:
+                        view.write("pts", [{
+                            "name": "w", "dtg": T0 + i,
+                            "geom": Point(float(i % 170), 10.0)}],
+                            fids=[fid])
+                        written.append(fid)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                        return
+                    i += 1
+
+            t = threading.Thread(target=writer)
+            t.start()
+            try:
+                out = mig.migrate(shard, dst)
+            finally:
+                stop.set()
+                t.join(10)
+            assert not errs
+            assert out["shard"] == shard and out["dst"] == dst
+            gen = view._generation
+            assert gen.router.member_for_shard(shard) == dst
+            assert gen.router.coverage_violations() == []
+            assert not gen.migrations
+            # every acked row exactly once, across base + concurrent
+            census = _census(stores)
+            for f in base + written:
+                assert census.get(f) is not None, f"lost acked row {f}"
+                assert len(census[f]) == 1, f"duplicated row {f}"
+            # the source holds nothing of the migrated shard any more
+            sft = view.get_schema("pts")
+            table = stores[src].query("pts", None).table
+            if len(table):
+                shards = mig._shards_of_table(sft, table, gen.router)
+                assert not (shards == shard).any()
+            assert mig.history and mig.history[-1] is out
+        finally:
+            _close(stores)
+
+    def test_tail_replay_applies_post_floor_writes_and_deletes(
+            self, tmp_path, monkeypatch):
+        view, stores, mig = _open_fed(tmp_path)
+        try:
+            _write_rows(view, 40)
+            router = view._generation.router
+            shard = 1
+            src = router.member_for_shard(shard)
+            dst = next(m for m in router.members if m != src)
+            pre_recs, pre_fids = _recs_for_shard(
+                view, router, shard, 5, "pre")
+            view.write("pts", pre_recs, fids=pre_fids)
+            tail_recs, tail_fids = _recs_for_shard(
+                view, router, shard, 4, "tail", seed=99)
+            victim = pre_fids[0]
+            real = persistence.save_shard
+
+            def patched(ds, type_name, path, selector, **kw):
+                man = real(ds, type_name, path, selector, **kw)
+                # past the floor, before the stop capture: these land in
+                # the WAL tail the catch-up replay must apply
+                ds.write(type_name, tail_recs, fids=tail_fids)
+                ds.delete_features(type_name, [victim])
+                return man
+
+            monkeypatch.setattr(persistence, "save_shard", patched)
+            out = mig.migrate(shard, dst)
+            assert out["rows_replayed"] >= len(tail_fids)
+            census = _census(stores)
+            for f in tail_fids:
+                assert census.get(f) == [dst], f"tail row {f}: {census.get(f)}"
+            # the replayed delete removed the shipped copy
+            assert victim not in census
+            for f in pre_fids[1:]:
+                assert census.get(f) == [dst]
+        finally:
+            _close(stores)
+
+    def test_catchup_timeout_rolls_back_with_anomaly(
+            self, tmp_path, monkeypatch):
+        rec = obs_flight.FlightRecorder()
+        prev = obs_flight.install(rec)
+        view, stores, mig = _open_fed(tmp_path, catchup_timeout_s=-1.0)
+        try:
+            _write_rows(view, 30)
+            router = view._generation.router
+            shard = 2
+            src = router.member_for_shard(shard)
+            dst = next(m for m in router.members if m != src)
+            pre_recs, pre_fids = _recs_for_shard(
+                view, router, shard, 3, "pre")
+            view.write("pts", pre_recs, fids=pre_fids)
+            tail_recs, tail_fids = _recs_for_shard(
+                view, router, shard, 1, "tail", seed=5)
+            real = persistence.save_shard
+
+            def patched(ds, type_name, path, selector, **kw):
+                man = real(ds, type_name, path, selector, **kw)
+                ds.write(type_name, tail_recs, fids=tail_fids)
+                return man
+
+            monkeypatch.setattr(persistence, "save_shard", patched)
+            before = elastic.migration_metrics()
+            with pytest.raises(MigrationError, match="rolled back"):
+                mig.migrate(shard, dst)
+            after = elastic.migration_metrics()
+            assert after.get("rolled_back", 0) == \
+                before.get("rolled_back", 0) + 1
+            assert after.get("failed", 0) == before.get("failed", 0) + 1
+            gen = view._generation
+            assert gen.router.member_for_shard(shard) == src
+            assert not gen.migrations
+            assert gen.router.coverage_violations() == []
+            # destination cleaned: no shipped or tail copies survive
+            census = _census(stores)
+            for f in pre_fids + tail_fids:
+                assert dst not in census.get(f, [])
+            assert json.loads(
+                (tmp_path / "journal.json").read_text())["phase"] == "stable"
+            stalls = [r for r in rec.records()
+                      if obs_flight.A_MIGRATION in r.anomalies]
+            assert stalls and stalls[0].source == "elastic"
+        finally:
+            _close(stores)
+            obs_flight.install(prev)
+
+    def test_recover_rolls_back_after_mid_ship_crash(
+            self, tmp_path, monkeypatch):
+        view, stores, mig = _open_fed(tmp_path)
+        _, base = _write_rows(view, 40)
+        router = view._generation.router
+        shard = 0
+        src = router.member_for_shard(shard)
+        dst = next(m for m in router.members if m != src)
+        monkeypatch.setattr(
+            persistence, "load_shard",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("died")))
+        with pytest.raises(RuntimeError, match="died"):
+            mig.migrate(shard, dst)
+        _close(stores)  # the "crash": journal is stuck at shipping
+        monkeypatch.undo()
+        view2, stores2, mig2 = _open_fed(tmp_path)
+        try:
+            out = mig2.recover()
+            assert out["phase"] == "shipping"
+            assert out["action"] == "rolled_back"
+            gen = view2._generation
+            assert gen.router.member_for_shard(shard) == src
+            assert gen.router.coverage_violations() == []
+            census = _census(stores2)
+            for f in base:
+                assert len(census.get(f, [])) == 1
+            # a second recover finds the stable journal: a no-op
+            assert mig2.recover()["action"] == "none"
+        finally:
+            _close(stores2)
+
+    def test_recover_rolls_forward_after_cutover_crash(
+            self, tmp_path, monkeypatch):
+        view, stores, mig = _open_fed(tmp_path)
+        _, base = _write_rows(view, 40)
+        router = view._generation.router
+        shard = 3
+        src = router.member_for_shard(shard)
+        dst = next(m for m in router.members if m != src)
+        real = faults.crash_point
+
+        def patched(name):
+            if name == "elastic.pre_cutover":
+                raise RuntimeError("killed at cutover")
+            real(name)
+
+        monkeypatch.setattr(faults, "crash_point", patched)
+        with pytest.raises(RuntimeError, match="killed at cutover"):
+            mig.migrate(shard, dst)
+        _close(stores)
+        monkeypatch.undo()
+        view2, stores2, mig2 = _open_fed(tmp_path)
+        try:
+            out = mig2.recover()
+            assert out["phase"] == "cutover"
+            assert out["action"] == "rolled_forward"
+            gen = view2._generation
+            assert gen.router.member_for_shard(shard) == dst
+            assert gen.router.coverage_violations() == []
+            census = _census(stores2)
+            for f in base:
+                assert len(census.get(f, [])) == 1, f"row {f}: {census.get(f)}"
+            # the source kept nothing of the rolled-forward shard
+            sft = view2.get_schema("pts")
+            table = stores2[src].query("pts", None).table
+            if len(table):
+                shards = mig2._shards_of_table(sft, table, gen.router)
+                assert not (shards == shard).any()
+        finally:
+            _close(stores2)
+
+    def test_validation_errors(self, tmp_path):
+        view, stores, mig = _open_fed(tmp_path)
+        try:
+            router = view._generation.router
+            shard = 0
+            src = router.member_for_shard(shard)
+            with pytest.raises(MigrationError, match="already owned"):
+                mig.migrate(shard, src)
+            with pytest.raises(MigrationError, match="not a member"):
+                mig.migrate(shard, 99)
+        finally:
+            _close(stores)
+        # WAL-less members cannot host a live migration source
+        plain = [DataStore(backend="tpu") for _ in range(2)]
+        v = ShardedDataStoreView(plain, n_shards=4)
+        v.create_schema("pts", SPEC)
+        m = ShardMigrator(v, str(tmp_path / "j2.json"), str(tmp_path / "b2"))
+        shard = 0
+        src = v._generation.router.member_for_shard(shard)
+        dst = 1 - src
+        with pytest.raises(MigrationError, match="WAL"):
+            m.migrate(shard, dst)
+
+    def test_live_membership_change_departure(self, tmp_path):
+        view, stores, mig = _open_fed(tmp_path, n_shards=4)
+        try:
+            _, base = _write_rows(view, 60)
+            plan = mig.plan_membership([0, 1])
+            assert all(p["action"] in ("migrate", "remove") for p in plan)
+            assert {p["member"] for p in plan
+                    if p["action"] == "remove"} == {2}
+            done = mig.apply_membership([0, 1])
+            assert done == plan
+            gen = view._generation
+            assert gen.router.coverage_violations() == []
+            assert gen.router.shards_of_member(2) == []
+            assert set(gen.router.shard_member) <= {0, 1}
+            census = _census(stores)
+            for f in base:
+                assert len(census.get(f, [])) == 1
+            assert view.stats_count("pts") == 60
+        finally:
+            _close(stores)
+
+    def test_membership_join_requires_add_member_first(self, tmp_path):
+        view, stores, mig = _open_fed(tmp_path, n_shards=4)
+        try:
+            plan = mig.plan_membership([0, 1, 2, 3])
+            assert plan[0] == {"action": "add", "member": 3}
+            with pytest.raises(MigrationError, match="add_member"):
+                mig.apply_membership([0, 1, 2, 3])
+            # after the join the plan is pure migrates onto the newcomer
+            m3 = DataStore.open(str(tmp_path / "m3"), recover=True,
+                                checkpointer=False)
+            try:
+                assert view.add_member(m3) == 3
+                if "pts" not in m3.list_schemas():
+                    m3.create_schema("pts", SPEC)
+                plan = mig.plan_membership([0, 1, 2, 3])
+                assert plan and all(p["action"] == "migrate" for p in plan)
+                assert all(p["dst"] == 3 for p in plan)
+            finally:
+                m3.close()
+        finally:
+            _close(stores)
+
+
+# -- satellite 3: router movement properties ---------------------------------
+
+class TestRouterMovementProperties:
+    def test_departure_moves_only_departed_members_shards(self):
+        rng = np.random.default_rng(11)
+        for _ in range(12):
+            n_members = int(rng.integers(2, 6))
+            n_shards = int(rng.choice([4, 8, 16, 33]))
+            vnodes = int(rng.choice([8, 32, 64]))
+            members = [f"m{i}" for i in range(n_members)]
+            r = ShardRouter(members, n_shards, virtual_nodes=vnodes)
+            gone = members[int(rng.integers(0, n_members))]
+            keep = [m for m in members if m != gone]
+            if not keep:
+                continue
+            r2 = r.with_members(keep)
+            assert r2.coverage_violations() == []
+            for s in range(n_shards):
+                if r.shard_member[s] != r2.shard_member[s]:
+                    assert r.shard_member[s] == gone
+
+    def test_addition_moves_shards_only_to_the_newcomer(self):
+        rng = np.random.default_rng(13)
+        for _ in range(12):
+            n_members = int(rng.integers(2, 6))
+            n_shards = int(rng.choice([4, 8, 16, 33]))
+            vnodes = int(rng.choice([8, 32, 64]))
+            members = [f"m{i}" for i in range(n_members)]
+            r = ShardRouter(members, n_shards, virtual_nodes=vnodes)
+            r2 = r.with_members([*members, "new"])
+            assert r2.coverage_violations() == []
+            for s in range(n_shards):
+                if r.shard_member[s] != r2.shard_member[s]:
+                    assert r2.shard_member[s] == "new"
+
+    def test_coverage_clean_across_every_step_of_a_plan(self):
+        """A multi-step membership plan (join, pinned reassignments one
+        shard at a time, departure) keeps total coverage at EVERY
+        intermediate router — no shard is ever unowned or double-owned."""
+        rng = np.random.default_rng(17)
+        for _ in range(6):
+            n_shards = int(rng.choice([4, 8, 16]))
+            r = ShardRouter([0, 1, 2], n_shards,
+                            virtual_nodes=int(rng.choice([8, 32])))
+            steps = [r]
+            r = r.with_member_added(3)
+            steps.append(r)
+            target = ShardRouter([0, 1, 3], n_shards, r.virtual_nodes)
+            for s in range(n_shards):
+                if r.shard_member[s] != target.shard_member[s]:
+                    r = r.with_assignment(s, target.shard_member[s])
+                    steps.append(r)
+            assert r.shards_of_member(2) == []
+            r = r.with_member_removed(2)
+            steps.append(r)
+            for step in steps:
+                assert step.coverage_violations() == []
+            assert set(r.shard_member) <= {0, 1, 3}
+
+
+# -- satellite 1: one router snapshot per operation ---------------------------
+
+class TestGenerationSnapshotHammer:
+    def test_concurrent_with_members_never_tears_an_operation(self):
+        """Red/green for the torn-read fix: every operation keys, places
+        and fans off ONE generation snapshot, so a concurrent membership
+        flip (same member set — the ring is identical, only the
+        generation churns) can never split one write across two maps or
+        crash a read mid-fan."""
+        stores = [DataStore(backend="tpu") for _ in range(3)]
+        view = ShardedDataStoreView(stores, n_shards=12)
+        view.create_schema("pts", SPEC)
+        _write_rows(view, 60, prefix="base")
+        errs: list = []
+        stop = threading.Event()
+
+        def flipper():
+            for _ in range(200):
+                if stop.is_set():
+                    return
+                try:
+                    view.with_members([0, 1, 2])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        def writer(tag):
+            rng = np.random.default_rng(hash(tag) % 2**31)
+            for i in range(30):
+                try:
+                    view.write("pts", [{
+                        "name": tag, "dtg": T0 + i,
+                        "geom": Point(float(rng.uniform(-170, 170)),
+                                      float(rng.uniform(-60, 60)))}],
+                        fids=[f"{tag}{i}"])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        def reader():
+            for _ in range(60):
+                try:
+                    view.query("pts", "BBOX(geom,-180,-90,180,90)")
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        threads = [threading.Thread(target=flipper),
+                   threading.Thread(target=writer, args=("wa",)),
+                   threading.Thread(target=writer, args=("wb",)),
+                   threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        stop.set()
+        assert not errs, errs[:3]
+        assert view.stats_count("pts") == 60 + 30 + 30
+        fid_sets = [set(str(f) for f in s.query("pts", None).table.fids)
+                    for s in stores]
+        for i in range(3):
+            for j in range(i + 1, 3):
+                assert not (fid_sets[i] & fid_sets[j])
+
+
+# -- satellite 2: the draining-member signal ----------------------------------
+
+def _http_503(retry_after=None):
+    hdrs = email.message_from_string(
+        f"Retry-After: {retry_after}\n" if retry_after is not None else "")
+    return urllib.error.HTTPError(
+        "http://a/api", 503, "unavailable", hdrs, None)
+
+
+class TestMemberDraining:
+    def test_503_with_retry_after_maps_to_typed_error(self):
+        from geomesa_tpu.resilience.http import _as_draining
+
+        d = _as_draining(_http_503("1.5"), "http://a/api")
+        assert isinstance(d, MemberDrainingError)
+        assert d.retry_after_s == 1.5
+        # a bare 503 (proxy death, no drain plan) stays a generic 5xx
+        assert _as_draining(_http_503(), "u") is None
+        assert _as_draining(_http_503("soon"), "u") is None
+        e500 = urllib.error.HTTPError(
+            "u", 500, "boom", email.message_from_string(""), None)
+        assert _as_draining(e500, "u") is None
+
+    def test_drain_is_not_a_breaker_failure(self):
+        from geomesa_tpu.resilience.http import _breaker_failure
+
+        assert _breaker_failure(MemberDrainingError("u", 1.0)) is False
+        assert _breaker_failure(_http_503("1.0")) is True  # raw 5xx is
+
+    def test_read_retry_honors_retry_after_floor(self):
+        sleeps: list = []
+        pol = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                          max_delay_s=5.0, seed=1,
+                          sleep=sleeps.append)
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise MemberDrainingError("http://a", 0.5)
+            return "ok"
+
+        assert pol.call(fn, idempotent=True) == "ok"
+        assert len(sleeps) == 2
+        assert all(s >= 0.5 for s in sleeps)  # the server's floor held
+
+    def test_write_does_not_retry_a_drain(self):
+        pol = RetryPolicy(max_attempts=4, base_delay_s=0.001, seed=1,
+                          sleep=lambda s: None)
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise MemberDrainingError("http://a", 0.1)
+
+        with pytest.raises(MemberDrainingError):
+            pol.call(fn, idempotent=False)
+        assert calls[0] == 1  # immediate: the write re-routes instead
+
+    def test_view_write_reroutes_on_drain_after_map_advance(self):
+        class Draining:
+            def __init__(self, ds, view_ref):
+                self._ds = ds
+                self._view_ref = view_ref
+                self.drains = 0
+
+            def write(self, *a, **k):
+                if self.drains == 0:
+                    self.drains += 1
+                    # the control plane advanced the map concurrently
+                    self._view_ref[0].with_members([0, 1, 2])
+                    raise MemberDrainingError("http://m", 0.2)
+                return self._ds.write(*a, **k)
+
+            def __getattr__(self, name):
+                return getattr(self._ds, name)
+
+        view_ref: list = [None]
+        inner = [DataStore(backend="tpu") for _ in range(3)]
+        wrapped = [Draining(inner[0], view_ref), inner[1], inner[2]]
+        view = ShardedDataStoreView(wrapped, n_shards=8)
+        view_ref[0] = view
+        view.create_schema("pts", SPEC)
+        _write_rows(view, 40)
+        assert wrapped[0].drains == 1  # the drain fired and re-routed
+        assert view.stats_count("pts") == 40
+
+    def test_view_write_surfaces_drain_when_map_is_stale(self):
+        class AlwaysDraining:
+            def __init__(self, ds):
+                self._ds = ds
+
+            def write(self, *a, **k):
+                raise MemberDrainingError("http://m", 0.2)
+
+            def __getattr__(self, name):
+                return getattr(self._ds, name)
+
+        inner = [DataStore(backend="tpu") for _ in range(2)]
+        view = ShardedDataStoreView(
+            [AlwaysDraining(inner[0]), inner[1]], n_shards=8)
+        view.create_schema("pts", SPEC)
+        # an unchanged generation means the drain signal is ahead of the
+        # control plane: surface it, do not spin
+        with pytest.raises(MemberDrainingError):
+            _write_rows(view, 40)
+
+
+# -- the tiering ladder -------------------------------------------------------
+
+class _Owner:
+    """A pool owner shaped like the backend's residency states: device
+    columns in a ``cols`` dict."""
+
+    def __init__(self, n=100):
+        self.cols = {"v": np.arange(n, dtype=np.float64)}
+
+
+class TestTieringPolicy:
+    def _demoted(self, type_name, policy, nbytes=800,
+                 budget=1000, fingerprint="fp"):
+        pool = BufferPool(max_total_bytes=budget)
+        pool.attach_tiering(policy)
+        owner = _Owner(nbytes // 8)
+        register_residency(pool, type_name, "z3", "cols", nbytes, owner,
+                           fingerprint=fingerprint)
+        assert devmon.ledger().type_bytes(type_name) >= nbytes
+        pool.release(type_name, keep_fingerprint=fingerprint)  # → stash
+        assert pool.ensure_room(budget - 100)  # stash reclaim demotes
+        return pool, owner
+
+    def test_demote_to_ram_then_promote_restores_ledger(self):
+        t = "tier_ram_t"
+        policy = TieringPolicy(ram_budget=1 << 30, disk_dir=None)
+        pool, owner = self._demoted(t, policy)
+        assert policy.demotions_ram == 1
+        assert policy.tier_bytes()["ram"].get(t) == 800
+        # the ledger followed the bytes off the device
+        assert devmon.ledger().type_bytes(t) == 0
+        assert isinstance(owner.cols["v"], np.ndarray)
+        assert policy.coherence_violations() == []
+        got = pool.take_donated(t, "z3", "fp")
+        assert got is owner
+        assert policy.promotions == 1
+        assert devmon.ledger().type_bytes(t) == 800  # re-registered
+        assert np.array_equal(np.asarray(owner.cols["v"]),
+                              np.arange(100, dtype=np.float64))
+        assert policy.tier_bytes()["ram"] == {}
+        assert (t, "z3") in pool._entries  # re-admitted live
+        devmon.ledger().unregister_matching(t, "z3")
+
+    def test_ram_overflow_spills_to_disk_and_promotes_back(self, tmp_path):
+        t = "tier_disk_t"
+        policy = TieringPolicy(ram_budget=100,
+                               disk_dir=str(tmp_path / "cold"))
+        pool, owner = self._demoted(t, policy)
+        assert policy.demotions_disk == 1
+        assert owner.cols == {}  # the RAM actually freed
+        tiers = policy.tier_bytes()
+        assert tiers["ram"] == {} and tiers["disk"].get(t) == 800
+        files = list((tmp_path / "cold").glob("tier-*.npz"))
+        assert len(files) == 1
+        assert policy.coherence_violations() == []
+        got = pool.take_donated(t, "z3", "fp")
+        assert got is owner
+        assert np.array_equal(np.asarray(owner.cols["v"]),
+                              np.arange(100, dtype=np.float64))
+        assert not files[0].exists()  # promoted copy left the cold tier
+        assert devmon.ledger().type_bytes(t) == 800
+        devmon.ledger().unregister_matching(t, "z3")
+
+    def test_no_disk_dir_degrades_overflow_to_a_drop(self):
+        t = "tier_drop_t"
+        policy = TieringPolicy(ram_budget=100, disk_dir=None)
+        pool, owner = self._demoted(t, policy)
+        assert policy.drops == 1
+        assert policy.tier_bytes() == {"ram": {}, "disk": {}}
+        assert pool.take_donated(t, "z3", "fp") is None  # gone for real
+
+    def test_invalidate_drops_all_fingerprints_when_unpinned(self):
+        t = "tier_inv_t"
+        policy = TieringPolicy(ram_budget=1 << 30, disk_dir=None)
+        pool, _ = self._demoted(t, policy)
+        pool.purge(t)  # reaches every tier
+        assert policy.tier_bytes()["ram"] == {}
+        assert pool.take_donated(t, "z3", "fp") is None
+
+    def test_coherence_violations_catch_breakage(self, tmp_path):
+        t = "tier_coh_t"
+        policy = TieringPolicy(ram_budget=100,
+                               disk_dir=str(tmp_path / "cold"))
+        self._demoted(t, policy)
+        (f,) = (tmp_path / "cold").glob("tier-*.npz")
+        os.unlink(f)
+        bad = policy.coherence_violations()
+        assert any("missing its on-disk file" in v for v in bad)
+        # a stale device-ledger row for a demoted entry also flags
+        holder = _Owner(8)
+        devmon.ledger().register(t, "z3", "cols", 64, owner=holder)
+        bad = policy.coherence_violations()
+        assert any("still ledgered" in v for v in bad)
+        devmon.ledger().unregister_matching(t, "z3")
+
+    def test_sweeper_runs_the_tier_coherence_check(self):
+        from geomesa_tpu.obs.audit import InvariantSweeper
+
+        t = "tier_sweep_t"
+        policy = TieringPolicy(ram_budget=1 << 30, disk_dir=None)
+        pool, _ = self._demoted(t, policy)
+        sw = InvariantSweeper()
+        sw.attach_pool(pool)
+        out = [r for r in sw.sweep_once() if r["check"] == "tiering"]
+        assert len(out) == 1
+        assert out[0]["checked"] == 1 and out[0]["violations"] == []
+        # a pool with no tiering attached abstains instead of failing
+        bare = BufferPool(max_total_bytes=10)
+        sw2 = InvariantSweeper()
+        sw2.attach_pool(bare)
+        out2 = [r for r in sw2.sweep_once() if r["check"] == "tiering"]
+        assert out2[0]["checked"] == 0
+
+    def test_env_knobs_configure_the_policy(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(elastic.TIER_RAM_ENV, "12345")
+        monkeypatch.setenv(elastic.TIER_DIR_ENV, str(tmp_path))
+        p = TieringPolicy()
+        assert p.ram_budget == 12345 and p.disk_dir == str(tmp_path)
+        monkeypatch.setenv(elastic.TIER_RAM_ENV, "lots")
+        with pytest.raises(ValueError, match="integer byte count"):
+            TieringPolicy()
+
+
+# -- the autoscaler control plane ---------------------------------------------
+
+class TestFederationAutoscaler:
+    def _view(self, members=3, n_shards=9):
+        stores = [DataStore(backend="tpu") for _ in range(members)]
+        view = ShardedDataStoreView(stores, n_shards=n_shards)
+        view.create_schema("pts", SPEC)
+        return view
+
+    def test_slo_burn_proposes_rebalance_to_healthy_member(self, monkeypatch):
+        view = self._view()
+        monkeypatch.setattr(view, "member_health", lambda: [
+            {"member": 0, "budget_remaining": 0.1},
+            {"member": 1, "budget_remaining": 0.9},
+            {"member": 2, "budget_remaining": 0.9},
+        ])
+        sc = FederationAutoscaler(view)
+        props = sc.evaluate()
+        moves = [p for p in props if p["action"] == "rebalance"]
+        assert moves and moves[0]["src"] == 0
+        assert moves[0]["dst"] in (1, 2)
+        assert moves[0]["shard"] in \
+            view._generation.router.shards_of_member(0)
+        snap = sc.snapshot()
+        assert snap["evals"] == 1 and snap["proposals_total"] >= 1
+
+    def test_admission_shed_pressure_proposes_capacity(self, monkeypatch):
+        class Shedding:
+            admitted_count = 10
+            shed_count = 30
+
+        view = self._view()
+        monkeypatch.setattr(view, "member_health", lambda: [])
+        sc = FederationAutoscaler(view, admission=Shedding())
+        props = sc.evaluate()
+        adds = [p for p in props if p["action"] == "add"]
+        assert adds and "shedding" in adds[0]["reason"]
+
+    def test_hbm_pressure_proposes_capacity(self, monkeypatch):
+        t = "scaler_hbm_t"
+        pool = BufferPool(max_total_bytes=1000)
+        owner = _Owner(120)
+        register_residency(pool, t, "z3", "cols", 960, owner)
+        view = self._view()
+        monkeypatch.setattr(view, "member_health", lambda: [])
+        sc = FederationAutoscaler(view, pool=pool, hbm_headroom_frac=0.1)
+        try:
+            props = sc.evaluate()
+            assert any(p["action"] == "add"
+                       and "HBM headroom" in p["reason"] for p in props)
+        finally:
+            devmon.ledger().unregister_matching(t, "z3")
+
+    def test_idle_member_attracts_a_shard(self, monkeypatch):
+        view = self._view()
+        monkeypatch.setattr(view, "member_health", lambda: [])
+        view.add_member(DataStore(backend="tpu"))  # owns nothing yet
+        sc = FederationAutoscaler(view)
+        props = sc.evaluate()
+        moves = [p for p in props if p["action"] == "rebalance"]
+        assert moves and moves[0]["dst"] == 3
+
+    def test_no_proposals_while_a_migration_is_in_flight(self, monkeypatch):
+        view = self._view()
+        monkeypatch.setattr(view, "member_health", lambda: [
+            {"member": 0, "budget_remaining": 0.0}])
+        gen = view._generation
+        view.swap_generation(gen.advance(
+            migrations=(ShardMigration(0, 0, 1, MIG_DUAL),)))
+        sc = FederationAutoscaler(view)
+        assert sc.evaluate() == []  # let the in-flight move settle
+
+    def test_step_executes_bounded_moves_through_the_migrator(
+            self, tmp_path, monkeypatch):
+        view, stores, mig = _open_fed(tmp_path, n_shards=4)
+        try:
+            _write_rows(view, 30)
+            router = view._generation.router
+            src = router.member_for_shard(0)
+            monkeypatch.setattr(view, "member_health", lambda: [
+                {"member": src, "budget_remaining": 0.0}])
+            sc = FederationAutoscaler(view, migrator=mig,
+                                      auto_execute=True,
+                                      max_moves_per_eval=1)
+            props = sc.step()
+            assert any(p["action"] == "rebalance" for p in props)
+            assert sc.snapshot()["executed_total"] == 1
+            gen = view._generation
+            assert gen.router.coverage_violations() == []
+            assert view.stats_count("pts") == 30
+        finally:
+            _close(stores)
+
+
+# -- observability surfaces ---------------------------------------------------
+
+class TestElasticObservability:
+    def _call(self, app, method, path, query=""):
+        import io
+
+        environ = {
+            "REQUEST_METHOD": method, "PATH_INFO": path,
+            "QUERY_STRING": query, "CONTENT_LENGTH": "0",
+            "wsgi.input": io.BytesIO(b""),
+        }
+        out = {}
+
+        def start_response(status, headers):
+            out["status"] = int(status.split()[0])
+            out["headers"] = dict(headers)
+
+        body = b"".join(app(environ, start_response))
+        return out["status"], body
+
+    def test_migration_counters_and_prometheus_lines(self):
+        before = elastic.migration_metrics()
+        elastic._count_migration("started")
+        after = elastic.migration_metrics()
+        assert after["started"] == before.get("started", 0) + 1
+        text = elastic.prometheus_text()
+        assert 'geomesa_shard_migrations_total{state="started"}' in text
+        assert "geomesa_autoscaler_evals_total" in text
+
+    def test_tier_bytes_exposition(self):
+        t = "tier_prom_t"
+        policy = TieringPolicy(ram_budget=1 << 30, disk_dir=None)
+        pool = BufferPool(max_total_bytes=1000)
+        pool.attach_tiering(policy)
+        owner = _Owner(100)
+        register_residency(pool, t, "z3", "cols", 800, owner,
+                           fingerprint="fp")
+        pool.release(t, keep_fingerprint="fp")
+        pool.ensure_room(900)
+        text = elastic.prometheus_text()
+        assert (f'geomesa_tier_bytes{{tier="ram",type="{t}"}} 800'
+                in text)
+
+    def test_obs_shards_route_on_a_sharded_view(self):
+        from geomesa_tpu.web import GeoMesaApp
+
+        stores = [DataStore(backend="tpu") for _ in range(2)]
+        view = ShardedDataStoreView(stores, n_shards=4)
+        view.create_schema("pts", SPEC)
+        app = GeoMesaApp(view, coalesce_ms=0)
+        status, body = self._call(app, "GET", "/api/obs/shards")
+        assert status == 200
+        doc = json.loads(body)
+        assert "migration_counters" in doc
+        assert doc["coverage_violations"] == []
+        assert doc["n_stores"] == 2
+        assert doc["migrations"] == []
+
+    def test_obs_shards_route_on_a_plain_store(self):
+        from geomesa_tpu.web import GeoMesaApp
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", SPEC)
+        app = GeoMesaApp(ds, coalesce_ms=0)
+        status, body = self._call(app, "GET", "/api/obs/shards")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["sharded"] is False
+        assert "migration_counters" in doc
+
+    def test_metrics_exposition_includes_elastic_families(self):
+        from geomesa_tpu.web import GeoMesaApp
+
+        ds = DataStore(backend="tpu")
+        ds.create_schema("pts", SPEC)
+        app = GeoMesaApp(ds, coalesce_ms=0)
+        status, body = self._call(app, "GET", "/api/metrics",
+                                  "format=prometheus")
+        assert status == 200
+        assert b"geomesa_shard_migrations_total" in body
