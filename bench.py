@@ -1427,8 +1427,10 @@ def bench_stream_1b():
 
     # -- journal-tier leg (untimed): the same deliveries through
     # StreamingDataStore.subscribe_query over a JournalBus — the bus-fed
-    # product path end-to-end (decode → hub → scanner → HitBatch)
-    journal_deliveries, journal_parity = _stream_journal_leg()
+    # product path end-to-end (decode → hub → scanner → HitBatch), now
+    # also the stream-lens gate (delivery quantiles + on-time fraction)
+    journal_deliveries, journal_parity, journal_delivery = \
+        _stream_journal_leg()
 
     total_rows = N * chunks
     rows_per_s = total_rows / pipeline_s
@@ -1482,6 +1484,18 @@ def bench_stream_1b():
             "referee_parity_all_chunks": parity_ok,
             "journal_leg_deliveries": journal_deliveries,
             "journal_leg_parity": journal_parity,
+            # stream-lens delivery accounting from the journal leg (the
+            # bus-fed path carries real event times, so lateness is
+            # judged); delivery_parity gates that the always-on lens
+            # actually recorded the deliveries — bench_gate.sh trips on
+            # any *parity* key reading False
+            "delivery_p50_ms": journal_delivery.get("p50_ms"),
+            "delivery_p99_ms": journal_delivery.get("p99_ms"),
+            "delivery_on_time_fraction": journal_delivery.get(
+                "on_time_fraction"),
+            "delivery_parity": bool(
+                journal_parity
+                and journal_delivery.get("p50_ms") is not None),
             "rows_matched_total": int(totals.sum()),
             "row_queries_per_s": int(tpu_rowq_per_s),
             "cpu_row_queries_per_s": int(cpu_rowq_per_s),
@@ -1507,10 +1521,14 @@ def _stream_journal_leg(rows: int = 512):
     """Small untimed end-to-end leg: standing query over a real JournalBus
     through ``StreamingDataStore.subscribe_query`` — proves the bus-fed
     decode → hub → scanner path delivers exactly the rows the store's own
-    query path matches. Returns ``(deliveries, parity)``."""
+    query path matches, and harvests the stream lens's delivery
+    accounting for this leg (bus append → HitBatch p50/p99 + on-time
+    fraction — wall-clock event times so lateness judgement is live).
+    Returns ``(deliveries, parity, delivery_stats)``."""
     import tempfile
 
     from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.obs import streamlens as _sl
     from geomesa_tpu.stream.datastore import StreamingDataStore
     from geomesa_tpu.stream.journal import JournalBus
 
@@ -1526,11 +1544,15 @@ def _stream_journal_leg(rows: int = 512):
             rng = np.random.default_rng(42)
             lon = rng.uniform(-170, 170, rows)
             lat = rng.uniform(-80, 80, rows)
+            # wall-clock event times (not T0): the on-time/late judgement
+            # compares event time against now − allowed_lateness, and this
+            # leg is the bench's live sample of it
+            base_ms = int(time.time() * 1000)
             for i in range(rows):
                 ds.put(
                     "bench8", f"f{i}",
-                    {"dtg": T0 + i, "geom": Point(lon[i], lat[i])},
-                    ts=T0 + i,
+                    {"dtg": base_ms + i, "geom": Point(lon[i], lat[i])},
+                    ts=base_ms + i,
                 )
             # END-TO-END drain: tail_lag (async tailer) → consumer → hub.
             # hub.drain alone races records still pending in the tailer —
@@ -1539,7 +1561,22 @@ def _stream_journal_leg(rows: int = 512):
             ok = ds.drain("bench8", timeout_s=60.0)
             delivered = sum(b.count for b in hits)
             want = ds.query("bench8", "BBOX(geom, -45, -45, 45, 45)").count
-            return delivered, bool(ok and delivered == want)
+            dstats = {"p50_ms": None, "p99_ms": None,
+                      "on_time_fraction": None}
+            rep = _sl.get().report(window_s=3600.0)
+            # this leg's series: the one with event-time judgement (the
+            # timed pipeline's packed matrix carries no event time)
+            for t in rep["topics"]:
+                for e in t["subscriptions"]:
+                    w = e["window"]
+                    if w["count"] and w["on_time_fraction"] is not None:
+                        dstats = {
+                            "p50_ms": w["p50_ms"],
+                            "p99_ms": w["p99_ms"],
+                            "on_time_fraction": w["on_time_fraction"],
+                        }
+                        break
+            return delivered, bool(ok and delivered == want), dstats
         finally:
             ds.close()
 

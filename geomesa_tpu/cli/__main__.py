@@ -734,6 +734,71 @@ def cmd_obs_lens(args):
               f"({a['factor']:.2f}x, n={a['live_count']})")
 
 
+def cmd_obs_stream_report(args):
+    """Pull a server's standing-query scale report (``GET
+    /api/obs/stream``): per topic, subscriptions ranked by scan-cost
+    share with delivery p50/p99, on-time/late accounting, and a
+    chunk-trace exemplar each, plus the capacity section (occupancy,
+    churn, predicted next bucket-crossing recompile, HBM bytes per
+    subscription ×1M) and the backlog sentinel's alarms —
+    docs/operations.md § Standing-query health."""
+    import urllib.parse
+    import urllib.request
+
+    qp = {"limit": args.limit, "window": args.window}
+    if getattr(args, "topic", None):
+        qp["topic"] = args.topic
+    url = (args.url.rstrip("/") + "/api/obs/stream?"
+           + urllib.parse.urlencode(qp))
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:  # noqa: S310
+        doc = json.load(r)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    sent = doc.get("sentinel", {})
+    print(f"stream lens: {doc['observe_count']} observations; sentinel: "
+          f"{len(sent.get('alarms', []))} active alarms, "
+          f"{sent.get('backlogs_total', 0)} backlogs total")
+    for t in doc.get("topics", []):
+        cap = t.get("capacity", {})
+        print(f"\ntopic {t['topic']}: {t['series']} subscriptions tracked")
+        if cap.get("observed"):
+            nxt = cap.get("next_bucket_crossing", {})
+            eta = nxt.get("eta_s")
+            print(f"  capacity {cap['active']}/{cap['capacity']} "
+                  f"(occupancy {cap['occupancy']:.0%}), "
+                  f"churn {cap['churn_per_s']:.3g}/s; next recompile in "
+                  f"{nxt.get('adds_until_grow')} adds"
+                  + (f" (~{eta:.0f}s)" if eta is not None else "")
+                  + f"; HBM {cap['hbm_bytes_per_subscription']} B/sub "
+                  f"({cap['hbm_bytes_at_1m'] / 1e6:.1f} MB at 1M)")
+            if cap.get("dropped_rows"):
+                print(f"  dropped: {cap['dropped_rows']} rows in "
+                      f"{cap['dropped_chunks']} poisoned chunks")
+        print(f"  {'sub':<8s} {'cost%':>6s} {'hits':>8s} {'deliv':>6s} "
+              f"{'p50':>8s} {'p99':>8s} {'on-time':>8s} exemplar")
+        for e in t.get("subscriptions", []):
+            w = e["window"]
+            ex = e.get("exemplars") or []
+            tid = ex[0]["trace_id"][:16] if ex else "-"
+            frac = w.get("on_time_fraction")
+            print(f"  {e['subscription']:<8s} "
+                  f"{e['cost_share'] * 100:>5.1f}% {e['hit_rows']:>8d} "
+                  f"{e['deliveries']:>6d} {w['p50_ms']:>8.2f} "
+                  f"{w['p99_ms']:>8.2f} "
+                  f"{(f'{frac:.1%}' if frac is not None else '-'):>8s} "
+                  f"{tid}")
+        other = t.get("other")
+        if other:
+            print(f"  other: {other['series']} evicted series, "
+                  f"cost {other['cost']:.1f}, {other['hit_rows']} hits")
+    for a in sent.get("alarms", []):
+        print(f"\nBACKLOG [{a['cause']}] {a['topic']}: "
+              f"{a['value']:.6g} over {a['threshold']:.6g} "
+              f"(scan_lag={a['scan_lag']}, freshness={a['freshness_ms']} ms, "
+              f"burn={a['burn_rate']})")
+
+
 def cmd_obs_fusion(args):
     """Pull a server's host-roundtrip fusion-opportunity report (``GET
     /api/obs/fusion``): plan signatures ranked by host-choreography
@@ -1185,6 +1250,18 @@ def main(argv=None):
     )
     obs_common(sh)
     sh.set_defaults(fn=cmd_obs_shards)
+    sr = obs_sub.add_parser(
+        "stream-report",
+        help="pull a server's standing-query scale report (subscriptions "
+        "ranked by scan-cost share + delivery p99, capacity section, "
+        "backlog sentinel)",
+    )
+    obs_common(sr)
+    sr.add_argument("--window", type=float, default=300.0,
+                    help="live quantile window in seconds")
+    sr.add_argument("--topic", default=None,
+                    help="only this topic's subscriptions")
+    sr.set_defaults(fn=cmd_obs_stream_report)
 
     sp = sub.add_parser(
         "replay",

@@ -76,6 +76,16 @@ A_RECOMPILE = "recompile_storm"
 # were retained (unreachable but undropped) — either way an operator
 # should look before retrying (docs/operations.md § Migration triage)
 A_MIGRATION = "migration_stall"
+# a standing-query backlog burn (obs/streamlens.py): the backlog sentinel
+# found a topic's watermark freshness, scanner queue depth, or
+# stream.delivery SLO burn rate sustained past threshold — deliveries are
+# falling behind the stream (docs/operations.md § Standing-query health)
+A_BACKLOG = "backlog"
+# a poisoned streaming chunk (stream/pipeline.py _drop_failed): staging /
+# scan / delivery raised and the chunk was dropped with its rows marked
+# scanned — every active subscription of the topic silently missed those
+# rows, which is why this is an anomaly and not just a counter
+A_STREAM_ERROR = "stream_error"
 
 
 @dataclass

@@ -49,8 +49,8 @@ from geomesa_tpu.analysis.contracts import (cache_surface, feedback_sink,
                                             shadow_plane)
 
 __all__ = [
-    "LatencyLens", "RegressionSentinel", "BUCKET_EDGES_MS", "get", "install",
-    "sentinel", "install_sentinel",
+    "HistogramRing", "LatencyLens", "RegressionSentinel", "BUCKET_EDGES_MS",
+    "get", "install", "sentinel", "install_sentinel",
 ]
 
 # fixed log-scale latency bin edges (ms). Fixed — not adaptive — so bucket
@@ -132,12 +132,26 @@ def _fmt_le(edge: float) -> str:
     return str(int(edge)) if float(edge).is_integer() else str(edge)
 
 
-@cache_surface(name="query-lens", keyed_by="type_name", purge=("forget",))
-class LatencyLens:
-    """The retained profiling plane: bounded time-bucketed latency
-    histogram rings per (type, plan signature), with trace exemplars.
-    Series for a dropped/renamed type are purged via :meth:`forget`
-    (``DataStore._purge_type_name``)."""
+class HistogramRing:
+    """The shared histogram-ring base: the per-key series table, the
+    cardinality valve, the time-bucket ring append, the exemplar
+    replace-min, and the merged-window histogram math.
+
+    Both lenses — the query lens below and the stream delivery lens
+    (:mod:`geomesa_tpu.obs.streamlens`) — are subclasses, so the ring /
+    valve / exemplar semantics cannot drift between the two planes.
+    Subclasses pick their bucket and series classes via ``_bucket_cls`` /
+    ``_series_cls`` (extra ``__slots__`` on top of :class:`_LensBucket`)
+    and may override :meth:`_evict_locked` with their own valve policy
+    (the query lens drops the longest-idle series; the stream lens drops
+    the cheapest and folds it into an ``other`` rollup).
+
+    Locking: ONE leaf lock for the series table + buckets (metrics tier,
+    docs/concurrency.md) — every ``*_locked`` helper assumes it is held;
+    nothing is called while holding it."""
+
+    _bucket_cls = _LensBucket
+    _series_cls = _Series
 
     def __init__(self, bucket_s: float = _BUCKET_S, ring: int = _RING,
                  max_series: int = _MAX_SERIES, clock=time.time):
@@ -146,8 +160,91 @@ class LatencyLens:
         self._max_series = max_series
         self._clock = clock
         self._lock = threading.Lock()  # leaf: series table + buckets
-        self._series: dict[tuple[str, str], _Series] = {}
+        self._series: dict[tuple, object] = {}
         self.observe_count = 0
+
+    # -- shared machinery (caller holds self._lock) ---------------------------
+    def _evict_locked(self) -> None:
+        """Cardinality valve: drop the series with the oldest newest-
+        bucket (longest idle). Subclasses may override the policy."""
+        idle = min(
+            self._series,
+            key=lambda k: (self._series[k].buckets[-1].start
+                           if self._series[k].buckets else 0.0))
+        del self._series[idle]
+
+    def _bucket_locked(self, key: tuple, now: float):
+        """The series' bucket covering ``now`` (creating series and
+        bucket as needed; the valve runs on series creation)."""
+        series = self._series.get(key)
+        if series is None:
+            if len(self._series) >= self._max_series:
+                self._evict_locked()
+            series = self._series[key] = self._series_cls(self._ring)
+        start = now - (now % self.bucket_s)
+        if series.buckets and series.buckets[-1].start == start:
+            return series.buckets[-1]
+        b = self._bucket_cls(start)
+        series.buckets.append(b)  # deque(maxlen) prunes the ring
+        return b
+
+    @staticmethod
+    def _exemplar_locked(b, latency_ms: float, trace_id: str,
+                         now: float) -> None:
+        """Replace-min exemplar keep: the bucket retains its slowest
+        ``EXEMPLARS_PER_BUCKET`` traced observations."""
+        ex = b.exemplars
+        if len(ex) < EXEMPLARS_PER_BUCKET:
+            ex.append([latency_ms, trace_id, now])
+        else:
+            mi = min(range(len(ex)), key=lambda j: ex[j][0])
+            if latency_ms > ex[mi][0]:
+                ex[mi] = [latency_ms, trace_id, now]
+
+    def _window_locked(self, key: tuple, start_s: float, end_s: float,
+                       fold=None) -> tuple:
+        """Merge buckets intersecting ``[start_s, end_s)`` →
+        ``(bins, count, sum_ms, max_ms)``; ``fold(bucket)`` runs per
+        merged bucket so subclasses accumulate their extra counters."""
+        bins = [0] * _N_BINS
+        count = 0
+        sum_ms = 0.0
+        max_ms = 0.0
+        series = self._series.get(key)
+        if series is not None:
+            for b in series.buckets:
+                if b.start + self.bucket_s > start_s and b.start < end_s:
+                    for i, c in enumerate(b.bins):
+                        bins[i] += c
+                    count += b.count
+                    sum_ms += b.sum_ms
+                    max_ms = max(max_ms, b.max_ms)
+                    if fold is not None:
+                        fold(b)
+        return bins, count, sum_ms, max_ms
+
+    def _exemplar_rows_locked(self, key: tuple) -> list:
+        series = self._series.get(key)
+        rows = []
+        if series is not None:
+            for b in series.buckets:
+                for ms, tid, ts in b.exemplars:
+                    rows.append({"latency_ms": round(ms, 3),
+                                 "trace_id": tid, "ts": ts,
+                                 "bucket": b.start})
+        return rows
+
+    def series_keys(self) -> list:
+        with self._lock:
+            return list(self._series)
+
+
+@cache_surface(name="query-lens", keyed_by="type_name", purge=("forget",))
+class LatencyLens(HistogramRing):
+    """The retained profiling plane: bounded time-bucketed latency
+    histogram rings per (type, plan signature), with trace exemplars.
+    Series for a dropped/renamed type are purged via :meth:`forget`
+    (``DataStore._purge_type_name``)."""
 
     # -- the hot path ---------------------------------------------------------
     @feedback_sink
@@ -162,23 +259,7 @@ class LatencyLens:
         key = (type_name, signature)
         bin_i = bisect_left(BUCKET_EDGES_MS, latency_ms)
         with self._lock:
-            series = self._series.get(key)
-            if series is None:
-                if len(self._series) >= self._max_series:
-                    # cardinality valve: drop the series with the oldest
-                    # newest-bucket (longest idle)
-                    idle = min(
-                        self._series,
-                        key=lambda k: (self._series[k].buckets[-1].start
-                                       if self._series[k].buckets else 0.0))
-                    del self._series[idle]
-                series = self._series[key] = _Series(self._ring)
-            start = now - (now % self.bucket_s)
-            if series.buckets and series.buckets[-1].start == start:
-                b = series.buckets[-1]
-            else:
-                b = _LensBucket(start)
-                series.buckets.append(b)  # deque(maxlen) prunes the ring
+            b = self._bucket_locked(key, now)
             b.bins[bin_i] += 1
             b.count += 1
             b.sum_ms += latency_ms
@@ -187,13 +268,7 @@ class LatencyLens:
             b.rows += rows
             b.dispatches += dispatches
             if trace_id:
-                ex = b.exemplars
-                if len(ex) < EXEMPLARS_PER_BUCKET:
-                    ex.append([latency_ms, trace_id, now])
-                else:
-                    mi = min(range(len(ex)), key=lambda j: ex[j][0])
-                    if latency_ms > ex[mi][0]:
-                        ex[mi] = [latency_ms, trace_id, now]
+                self._exemplar_locked(b, latency_ms, trace_id, now)
             self.observe_count += 1
 
     # -- maintenance ----------------------------------------------------------
@@ -203,34 +278,23 @@ class LatencyLens:
             for key in [k for k in self._series if k[0] == type_name]:
                 del self._series[key]
 
-    def series_keys(self) -> list:
-        with self._lock:
-            return list(self._series)
-
     # -- read surfaces --------------------------------------------------------
     def window_stats(self, type_name: str, signature: str,
                      start_s: float, end_s: float) -> dict:
         """Merged stats over buckets intersecting ``[start_s, end_s)``:
         count / sum / p50 / p95 / p99 / max / rows / dispatches. The
         sentinel's comparison primitive."""
-        bins = [0] * _N_BINS
-        count = 0
-        sum_ms = 0.0
-        max_ms = 0.0
-        rows = 0
-        dispatches = 0
+        extra = {"rows": 0, "dispatches": 0}
+
+        def fold(b):
+            extra["rows"] += b.rows
+            extra["dispatches"] += b.dispatches
+
         with self._lock:
-            series = self._series.get((type_name, signature))
-            if series is not None:
-                for b in series.buckets:
-                    if b.start + self.bucket_s > start_s and b.start < end_s:
-                        for i, c in enumerate(b.bins):
-                            bins[i] += c
-                        count += b.count
-                        sum_ms += b.sum_ms
-                        max_ms = max(max_ms, b.max_ms)
-                        rows += b.rows
-                        dispatches += b.dispatches
+            bins, count, sum_ms, max_ms = self._window_locked(
+                (type_name, signature), start_s, end_s, fold)
+        rows = extra["rows"]
+        dispatches = extra["dispatches"]
         return {
             "count": count,
             "sum_ms": sum_ms,
@@ -250,14 +314,7 @@ class LatencyLens:
         against ``trace.recent()`` (and flight dumps) to the stitched
         span tree."""
         with self._lock:
-            series = self._series.get((type_name, signature))
-            rows = []
-            if series is not None:
-                for b in series.buckets:
-                    for ms, tid, ts in b.exemplars:
-                        rows.append({"latency_ms": round(ms, 3),
-                                     "trace_id": tid, "ts": ts,
-                                     "bucket": b.start})
+            rows = self._exemplar_rows_locked((type_name, signature))
         rows.sort(key=lambda r: -r["latency_ms"])
         return rows[:limit]
 

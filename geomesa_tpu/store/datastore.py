@@ -541,7 +541,7 @@ class DataStore:
         "geoblocks-query-cache", "buffer-pool", "device-cost-table",
         "spill-ledger", "planner-calibration-table",
         "persisted-cost-sidecar", "track-state-cache",
-        "query-lens", "roundtrip-ledger"))
+        "query-lens", "roundtrip-ledger", "stream-lens"))
     def update_schema(
         self,
         type_name: str,
@@ -688,7 +688,7 @@ class DataStore:
         "geoblocks-query-cache", "buffer-pool", "device-cost-table",
         "spill-ledger", "planner-calibration-table",
         "persisted-cost-sidecar", "track-state-cache",
-        "query-lens", "roundtrip-ledger"))
+        "query-lens", "roundtrip-ledger", "stream-lens"))
     def delete_schema(self, name: str) -> None:
         if self._wal_active():
             from geomesa_tpu.store import wal as _walmod
@@ -740,6 +740,13 @@ class DataStore:
 
         _lensmod.get().forget(name)
         _rtledger.table().forget(name)
+        # the stream lens keys delivery history by TOPIC, and the topic
+        # convention is type-name-derived — a recreated same-name type's
+        # standing subscriptions must not inherit the dead type's
+        # delivery histograms, lateness counters, or capacity history
+        from geomesa_tpu.obs import streamlens as _streamlens
+
+        _streamlens.get().forget(f"geomesa-{name}")
         # the PERSISTED cost sidecar too: a restart must not resurrect a
         # deleted/renamed type's profile for an unrelated successor
         devmon.purge_persisted_costs(name)

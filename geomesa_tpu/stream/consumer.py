@@ -96,6 +96,7 @@ class ThreadedConsumer:
     def _run(self, partitions: list[int]) -> None:
         import time as _time
 
+        from geomesa_tpu.obs import trace as _trace
         from geomesa_tpu.stream import telemetry
 
         trim = getattr(self.bus, "trim", None)  # durable buses free applied
@@ -107,11 +108,20 @@ class ThreadedConsumer:
             for p in partitions:
                 batch = self.bus.poll(self.topic, p, self._offsets[p], max_n=256)
                 applied = 0
-                for data in batch:
-                    if self.apply(data, p) is False:
-                        break  # stalled at a barrier; redeliver next poll
-                    self._offsets[p] += 1
-                    applied += 1
+                # one stream.poll span per non-empty batch: the ROOT the
+                # device scanner's retroactive cut/stage/scan/deliver
+                # spans stitch under — a traced ingest reads as ONE tree
+                # (docs/streaming.md § Stream lens). NOOP when untraced:
+                # the idle loop never pays a span allocation.
+                sp = (_trace.span("stream.poll", topic=self.topic,
+                                  partition=p, n=len(batch))
+                      if batch else _trace.NOOP)
+                with sp:
+                    for data in batch:
+                        if self.apply(data, p) is False:
+                            break  # stalled at a barrier; redeliver next poll
+                        self._offsets[p] += 1
+                        applied += 1
                 drained += applied
                 if applied and trim is not None:
                     # bound the bus's in-memory window to unapplied messages

@@ -128,14 +128,18 @@ class HitBatch:
 
 
 class _Sub:
-    __slots__ = ("sid", "predicate", "callback", "boxes", "times")
+    __slots__ = ("sid", "predicate", "callback", "boxes", "times", "tenant")
 
-    def __init__(self, sid, predicate, callback, boxes, times):
+    def __init__(self, sid, predicate, callback, boxes, times, tenant=None):
         self.sid = sid
         self.predicate = predicate
         self.callback = callback
         self.boxes = boxes
         self.times = times
+        # tenant stamped at subscribe time (usage metering of standing
+        # deliveries); None = unmetered (direct matrix users, shadow-plane
+        # subscribers)
+        self.tenant = tenant
 
 
 @dataclass(frozen=True)
@@ -202,10 +206,12 @@ class SubscriptionMatrix:
         return self._mesh
 
     # -- registry -------------------------------------------------------------
-    def subscribe(self, predicate, callback) -> int:
+    def subscribe(self, predicate, callback, tenant=None) -> int:
         """Register a standing query (CQL / filter AST / Query); returns the
         subscription id. The predicate decomposes through the planner into
-        this matrix's packed row encoding."""
+        this matrix's packed row encoding. ``tenant`` (stamped by the
+        standing-query front doors) attributes deliveries in the usage
+        meter; None leaves them unmetered."""
         if self.sft is None:
             raise ValueError(
                 "matrix built without an sft: use subscribe_packed"
@@ -215,10 +221,10 @@ class SubscriptionMatrix:
         boxes, times = standing_query_payload(
             self.sft, predicate, self.box_slots, self.time_slots
         )
-        return self._add(predicate, callback, boxes, times)
+        return self._add(predicate, callback, boxes, times, tenant)
 
     def subscribe_packed(self, boxes, times, callback,
-                         predicate=None) -> int:
+                         predicate=None, tenant=None) -> int:
         """Register a pre-packed int-domain payload: ``boxes (≤box_slots,
         4)``, ``times (≤time_slots, 4)`` int32 (the
         ``pack_boxes``/``pack_times`` row encoding)."""
@@ -230,9 +236,10 @@ class SubscriptionMatrix:
             predicate, callback,
             pack_boxes(b, slots=self.box_slots),
             pack_times(t, slots=self.time_slots),
+            tenant,
         )
 
-    def _add(self, predicate, callback, boxes, times) -> int:
+    def _add(self, predicate, callback, boxes, times, tenant=None) -> int:
         with self._lock:
             sid = self._next_sid
             self._next_sid += 1
@@ -241,7 +248,7 @@ class SubscriptionMatrix:
             except ValueError:
                 slot = len(self._slots)
                 self._grow_locked()
-            sub = _Sub(sid, predicate, callback, boxes, times)
+            sub = _Sub(sid, predicate, callback, boxes, times, tenant)
             self._subs[sid] = sub
             self._slots[slot] = sid
             self._boxes[slot] = boxes
@@ -306,6 +313,13 @@ class SubscriptionMatrix:
     def epoch(self) -> int:
         with self._lock:
             return self._epoch
+
+    def slot_bytes(self) -> int:
+        """Device bytes ONE subscription slot occupies: its packed box and
+        time rows, 4 int32 coordinates each — the stream lens's HBM
+        bytes-per-subscription figure (extrapolated ×1M in the scale
+        report's capacity section)."""
+        return (self.box_slots + self.time_slots) * 4 * 4
 
     def standing(self) -> list:
         """``[(sid, predicate), ...]`` for every active subscription —

@@ -40,6 +40,8 @@ from collections import deque
 
 import numpy as np
 
+from geomesa_tpu.obs import streamlens as _streamlens
+from geomesa_tpu.obs import trace as _trace
 from geomesa_tpu.stream.matrix import (
     HitBatch,
     SubscriptionMatrix,
@@ -52,9 +54,12 @@ __all__ = ["DeviceStreamScanner", "SubscriptionHub", "HubRegistry"]
 
 
 class _Chunk:
-    __slots__ = ("seq", "base", "rows", "cols", "tags", "env")
+    __slots__ = ("seq", "base", "rows", "cols", "tags", "env",
+                 "t_first", "t_cut", "t_stage0", "t_staged", "t_scan0",
+                 "wait_s", "span")
 
-    def __init__(self, seq, base, rows, cols, tags, env=None):
+    def __init__(self, seq, base, rows, cols, tags, env=None,
+                 t_first=None, span=None):
         self.seq = seq
         self.base = base
         self.rows = rows  # true rows (cols are padded to the fixed shape)
@@ -65,6 +70,23 @@ class _Chunk:
         # negative coordinate, so the device pass never counts them) and
         # the scan thread refines them host-side via envelope_hits
         self.env = env
+        # stage stamps (perf_counter seconds) — the stream lens's
+        # queue-wait / pad-flush / h2d / scan decomposition source
+        # (docs/streaming.md § Stream lens): t_first = oldest row's
+        # submit time (≈ bus append on the bus-fed path), t_cut = chunk
+        # cut from the fragment buffer, then staging/scan stamps from
+        # the pipeline; wait_s = measured transfer wait attributed to
+        # THIS chunk's staging by the double buffer
+        self.t_cut = time.perf_counter()
+        self.t_first = t_first if t_first is not None else self.t_cut
+        self.t_stage0 = 0.0
+        self.t_staged = 0.0
+        self.t_scan0 = 0.0
+        self.wait_s = 0.0
+        # the submitting context's live span (None untraced): the chunk's
+        # stage spans stitch under it retroactively after delivery, and
+        # its trace_id becomes the delivery-histogram exemplar
+        self.span = span
 
 
 class DeviceStreamScanner:
@@ -72,7 +94,8 @@ class DeviceStreamScanner:
 
     def __init__(self, matrix: SubscriptionMatrix, chunk_rows: int = 65536,
                  max_pending_chunks: int = 2, flush_interval_s: float = 0.05,
-                 topic: str = "stream", keep_tags: bool = True):
+                 topic: str = "stream", keep_tags: bool = True,
+                 allowed_lateness_ms: float = 30_000.0):
         from geomesa_tpu.ops.pallas_kernels import LANES
         from geomesa_tpu.parallel.mesh import data_shards
 
@@ -98,9 +121,19 @@ class DeviceStreamScanner:
             from geomesa_tpu.curve.binned_time import BinnedTime
 
             self._binned = BinnedTime(sft.z3_interval)
+        self.allowed_lateness_ms = allowed_lateness_ms
+        # per-subscription delivered event-time watermark — scan-thread
+        # private (lateness is judged and advanced only in _deliver), so
+        # no lock guards it
+        self._wm: dict[int, int] = {}
+        # flight-recorder type name for stream anomalies (A_STREAM_ERROR)
+        self._type_name = (
+            getattr(getattr(matrix, "sft", None), "name", None) or topic
+        )
         self._lock = threading.Lock()  # leaf: buffers, queue, stats
         self._cv = threading.Condition(self._lock)
-        self._frags: list[tuple] = []  # (x, y, bins, offs, tags) fragments
+        # (x, y, bins, offs, tags, env, t_in, span) fragments
+        self._frags: list[tuple] = []
         self._buffered = 0
         self._chunks: deque[_Chunk] = deque()
         self._seq = 0
@@ -158,6 +191,8 @@ class DeviceStreamScanner:
             np.asarray(bins, np.int32), np.asarray(offs, np.int32),
             list(tags) if (tags is not None and self.keep_tags) else None,
             env,
+            time.perf_counter(),  # submit stamp → the chunk's t_first
+            _trace.current() if _trace.active() else None,
         )
         with self._cv:
             if self._closed:
@@ -175,6 +210,8 @@ class DeviceStreamScanner:
         With ``block=True`` the caller waits while ``max_pending_chunks``
         chunks are already in flight — the reader-thread backpressure
         contract. Returns False if the scanner is closed."""
+        t_in = time.perf_counter()
+        sp = _trace.current() if _trace.active() else None
         with self._cv:
             if self._closed:
                 return False
@@ -193,11 +230,13 @@ class DeviceStreamScanner:
                 self._cv.wait(0.05)
             if self._closed:
                 return False
-            self._append_chunk_locked(x, y, bins, offs, tags)
+            self._append_chunk_locked(x, y, bins, offs, tags,
+                                      t_first=t_in, span=sp)
             self._cv.notify_all()
         return True
 
-    def _append_chunk_locked(self, x, y, bins, offs, tags) -> None:
+    def _append_chunk_locked(self, x, y, bins, offs, tags,
+                             t_first=None, span=None) -> None:
         n = len(x)
         cols = []
         for a in (x, y, bins, offs):
@@ -215,6 +254,7 @@ class DeviceStreamScanner:
             self._seq, self._rows_in,
             n, tuple(cols),
             list(tags) if (tags is not None and self.keep_tags) else None,
+            t_first=t_first, span=span,
         ))
         self._seq += 1
         self._rows_in += n
@@ -230,7 +270,7 @@ class DeviceStreamScanner:
         # chunk_rows-length Python lists per cut while holding the lock
         have_tags = any(f[4] is not None for f in self._frags)
         have_env = any(f[5] is not None for f in self._frags)
-        for fx, fy, fb, fo, ft, fe in self._frags:
+        for fx, fy, fb, fo, ft, fe, _ti, _sp in self._frags:
             xs.append(fx)
             ys.append(fy)
             bs.append(fb)
@@ -239,6 +279,13 @@ class DeviceStreamScanner:
                 tags.extend(ft if ft is not None else [None] * len(fx))
             if have_env:
                 envs.extend(fe if fe is not None else [None] * len(fx))
+        # the chunk inherits the OLDEST fragment's submit stamp (latency
+        # is measured from the first still-waiting row) and the first
+        # traced fragment's span; the remainder keeps the newest
+        # fragment's stamp — its rows arrived last
+        t_first = self._frags[0][6]
+        span = next((f[7] for f in self._frags if f[7] is not None), None)
+        rest_t, rest_sp = self._frags[-1][6], self._frags[-1][7]
         x = np.concatenate(xs)
         y = np.concatenate(ys)
         b = np.concatenate(bs)
@@ -247,7 +294,8 @@ class DeviceStreamScanner:
         self._frags = (
             [(x[take:], y[take:], b[take:], o[take:],
               tags[take:] if have_tags else None,
-              envs[take:] if have_env else None)] if rest else []
+              envs[take:] if have_env else None,
+              rest_t, rest_sp)] if rest else []
         )
         self._buffered = rest
         base = self._rows_in - rest - take
@@ -266,6 +314,7 @@ class DeviceStreamScanner:
             self._seq, base, take, tuple(cols),
             tags[:take] if have_tags else None,
             env or None,
+            t_first=t_first, span=span,
         ))
         self._seq += 1
 
@@ -313,9 +362,11 @@ class DeviceStreamScanner:
         from geomesa_tpu.obs.jaxmon import count_h2d
         from geomesa_tpu.parallel.mesh import DATA_AXIS
 
+        chunk.t_stage0 = time.perf_counter()
         nbytes = count_h2d(*chunk.cols, label="stream")
         sh = NamedSharding(self.matrix.mesh, P(DATA_AXIS))
         dev = tuple(jax.device_put(a, sh) for a in chunk.cols)
+        chunk.t_staged = time.perf_counter()
         with self._lock:
             self._stats["h2d_bytes"] += nbytes
         return dev + (jnp.int32(chunk.rows),), chunk
@@ -326,10 +377,22 @@ class DeviceStreamScanner:
         wedge the pipeline), and keep the scan thread ALIVE — a dead scan
         thread would silently stop every standing query of the topic, the
         same failure mode the tailer's swallowed callbacks had."""
-        from geomesa_tpu.obs import jaxmon
+        from geomesa_tpu.obs import flight, jaxmon
 
         jaxmon.registry().counter("stream.scan_errors").inc()
         telemetry.note_scan_error(self.topic)
+        _streamlens.get().note_dropped(self.topic, chunk.rows)
+        # a poisoned chunk is a delivery-correctness event, not just a
+        # counter: every active subscription of the topic silently missed
+        # these rows (the recorder's dump throttle bounds a drop storm)
+        flight.record(
+            "stream.scan", self._type_name, source="stream",
+            plan=(f"poisoned chunk dropped: seq={chunk.seq} "
+                  f"base={chunk.base} rows={chunk.rows} "
+                  f"subscriptions={self.matrix.active_count()}"),
+            rows=chunk.rows, plan_signature="stream.scan",
+            anomalies=(flight.A_STREAM_ERROR,),
+        )
         with self._lock:
             self._stats["scan_errors"] += 1
         # _cv wraps the same lock; separate block so progress counters and
@@ -369,6 +432,7 @@ class DeviceStreamScanner:
                     self._drop_failed(nxt)
             try:
                 t0 = time.perf_counter()
+                chunk.t_scan0 = t0
                 snap = self.matrix.snapshot()
                 # one dispatch per streamed chunk is the design: the scanner
                 # double-buffers H2D against the scan, so the loop-carried
@@ -381,7 +445,10 @@ class DeviceStreamScanner:
                     t1 = time.perf_counter()
                     jax.block_until_ready(pending[0])  # ALL columns
                     wait_s = time.perf_counter() - t1
-                self._deliver(snap, counts, pos, chunk)
+                    # transfer wait is the PENDING chunk's staging cost:
+                    # its lens h2d stage must carry it, not this chunk's
+                    pending[1].wait_s += wait_s
+                self._deliver(snap, counts, pos, chunk, scan_s)
             except Exception:  # noqa: BLE001 — scan thread must live
                 self._drop_failed(chunk)
                 continue
@@ -407,24 +474,50 @@ class DeviceStreamScanner:
             self._buffered = 0
             self._cv.notify_all()
 
-    def _deliver(self, snap, counts, pos, chunk: _Chunk) -> None:
+    def _deliver(self, snap, counts, pos, chunk: _Chunk,
+                 scan_s: float = 0.0) -> None:
         """Per-subscription hit delivery for one chunk: count delta + the
         newest-match position sample (+ row tags when kept). Wide rows
         (extended geometries, x/y = -1 device sentinel) refine host-side
         here — envelope overlap against each subscription's packed payload
         — and fold into the same delivery. Callback errors are counted,
         never propagated — one bad consumer must not stall the pipeline
-        (same posture as the journal tailer)."""
+        (same posture as the journal tailer).
+
+        This is also where the stream lens feeds (docs/streaming.md
+        § Stream lens): per subscription, a cost observation every chunk
+        (``hits + refine_rows + 0.01 × rows`` — attribution folded out of
+        outputs the fused scan already computed) and, for subscriptions
+        that matched, a delivery-latency observation decomposed from the
+        chunk's stage stamps, judged on-time/late against the
+        subscription's event-time watermark + ``allowed_lateness_ms``,
+        with the chunk's trace id as exemplar. Tenant-stamped
+        subscriptions meter delivered rows into the usage meter under
+        ``standing.delivery`` (shadow traffic stays unmetered)."""
+        from geomesa_tpu.obs import audit as _audit
+        from geomesa_tpu.obs import usage as _usage
+
+        t_deliver0 = time.perf_counter()
+        lens = _streamlens.get()
+        lens.note_matrix(
+            self.topic, capacity=snap.capacity, active=len(snap.subs),
+            epoch=snap.epoch, slot_bytes=self.matrix.slot_bytes(),
+        )
         wide: dict[int, np.ndarray] = {}  # sid → matched wide local idxs
+        refine_s: dict[int, float] = {}  # sid → host refine seconds
+        n_wide = 0
         if chunk.env:
             env = np.asarray(chunk.env, dtype=np.int64)
+            n_wide = len(env)
             idx = env[:, 0]
             ex1, ex2, ey1, ey2 = env[:, 1], env[:, 2], env[:, 3], env[:, 4]
             wb = chunk.cols[2][idx].astype(np.int64)
             wo = chunk.cols[3][idx].astype(np.int64)
             for sid, sub in snap.subs.items():
+                r0 = time.perf_counter()
                 m = envelope_hits(sub.boxes, sub.times,
                                   ex1, ex2, ey1, ey2, wb, wo)
+                refine_s[sid] = time.perf_counter() - r0
                 if m.any():
                     wide[sid] = idx[m]
         delivered = 0
@@ -435,23 +528,55 @@ class DeviceStreamScanner:
         # scanner is fully current); freshness gauges derive end-to-end
         # event-time lag from it at scrape time (docs/streaming.md)
         wm_ms = None
+        ev_min_ms = None
         if self._binned is not None and chunk.rows:
             wb_all = np.asarray(
                 chunk.cols[2][: chunk.rows], dtype=np.int64)
             wo_all = np.asarray(
                 chunk.cols[3][: chunk.rows], dtype=np.int64)
-            wm_ms = int(self._binned.from_bin_and_offset(
-                wb_all, wo_all).max())
+            ev = self._binned.from_bin_and_offset(wb_all, wo_all)
+            wm_ms = int(ev.max())
+            ev_min_ms = int(ev.min())
+        # stage decomposition shared by every delivery of this chunk
+        # (STAGES order: queue_wait, pad_flush, h2d, scan, refine, fanout)
+        pad_ms = max(chunk.t_cut - chunk.t_first, 0.0) * 1e3
+        queue_ms = (max(chunk.t_stage0 - chunk.t_cut, 0.0)
+                    + max(chunk.t_scan0 - chunk.t_staged, 0.0)) * 1e3
+        h2d_ms = (max(chunk.t_staged - chunk.t_stage0, 0.0)
+                  + chunk.wait_s) * 1e3
+        scan_ms = scan_s * 1e3
+        trace_id = chunk.span.trace_id if chunk.span is not None else ""
+        wall_ms = time.time() * 1000.0
+        active = max(len(snap.subs), 1)
+        row_cost = chunk.rows * _streamlens.SCAN_ROW_WEIGHT
         for slot, sid in enumerate(snap.sids):
             if sid is None:
                 continue
+            # on-time vs this subscription's own watermark: late when the
+            # chunk carries rows BEHIND the event time already delivered
+            # (out-of-order data) or when its oldest row's event time has
+            # fallen more than allowed_lateness_ms behind the wall clock
+            # (processing fell behind — the injected-stall signature)
+            on_time = None
             if wm_ms is not None:
                 telemetry.note_watermark(self.topic, sid, wm_ms)
+                prev = self._wm.get(sid)
+                on_time = (
+                    (prev is None or ev_min_ms >= prev)
+                    and wall_ms - ev_min_ms <= self.allowed_lateness_ms
+                )
+                if prev is None or wm_ms > prev:
+                    self._wm[sid] = wm_ms
             c = int(counts[slot])
             ex = wide.get(sid)
             if ex is not None:
                 c += len(ex)
+            cost = c + n_wide + row_cost
             if c == 0:
+                # cost + lateness accounting only — the delivery histogram
+                # holds real deliveries
+                lens.observe_delivery(self.topic, sid, cost=cost,
+                                      on_time=on_time)
                 continue
             sub = snap.subs[sid]
             local = merge_positions(pos[slot], self.matrix.topk)
@@ -482,10 +607,56 @@ class DeviceStreamScanner:
                 telemetry.note_callback_error(self.topic)
                 with self._lock:
                     self._stats["callback_errors"] += 1
+            t_done = time.perf_counter()
+            latency_ms = max(t_done - chunk.t_first, 0.0) * 1e3
+            lens.observe_delivery(
+                self.topic, sid, latency_ms=latency_ms,
+                stages=(queue_ms, pad_ms, h2d_ms, scan_ms,
+                        refine_s.get(sid, 0.0) * 1e3,
+                        max(t_done - t_deliver0, 0.0) * 1e3),
+                hit_rows=c, cost=cost, on_time=on_time, trace_id=trace_id,
+            )
+            tenant = getattr(sub, "tenant", None)
+            if tenant is not None and not _audit.in_shadow():
+                # the subscription's share of the fused pass as device
+                # time; slo=False — standing deliveries have their own
+                # stream.delivery objective on the lens engine
+                _usage.observe(
+                    tenant, self._type_name, "standing.delivery",
+                    rows=c, wall_ms=latency_ms,
+                    device_ms=scan_ms / active, slo=False,
+                )
         if delivered:
             with self._lock:
                 self._stats["deliveries"] += delivered
             telemetry.note_deliveries(self.topic, delivered)
+        self._attach_spans(chunk, scan_s, t_deliver0)
+
+    def _attach_spans(self, chunk: _Chunk, scan_s: float,
+                      t_deliver0: float) -> None:
+        """Retroactively stitch this chunk's stage spans under the
+        submitting context's span, so a traced ``submit_rows`` (the bus
+        consumer's ``stream.poll`` root) reads as ONE tree: poll → cut →
+        stage → scan → deliver. Spans are hand-stamped in the
+        perf_counter domain (``Span.t0_ns`` is perf_counter_ns) and
+        appended after the fact — late child attach is the documented
+        exporter contract (obs/trace.py: snapshots via list())."""
+        parent = chunk.span
+        if parent is None:
+            return
+        t_done = time.perf_counter()
+        for name, lo, hi in (
+            ("stream.cut", chunk.t_first, chunk.t_cut),
+            ("stream.stage", chunk.t_stage0, chunk.t_staged),
+            ("stream.scan", chunk.t_scan0, chunk.t_scan0 + scan_s),
+            ("stream.deliver", t_deliver0, t_done),
+        ):
+            sp = _trace.Span(
+                name, {"topic": self.topic, "seq": chunk.seq,
+                       "rows": chunk.rows}, parent)
+            sp.t0_ns = int(lo * 1e9)
+            sp.t1_ns = int(max(hi, lo) * 1e9)
+            parent.children.append(sp)
 
     # -- introspection / lifecycle -------------------------------------------
     def total(self, sid: int) -> int:
@@ -629,7 +800,14 @@ class SubscriptionHub:
 
     # -- delegation -----------------------------------------------------------
     def subscribe(self, predicate, callback) -> int:
-        sid = self.matrix.subscribe(predicate, callback)
+        from geomesa_tpu.obs import audit as _audit
+        from geomesa_tpu.obs import usage as _usage
+
+        # tenant stamped at subscribe time: deliveries meter under
+        # standing.delivery for THIS tenant. Shadow-plane subscribers
+        # (sweeper/audit referees) stay unstamped → unmetered.
+        tenant = None if _audit.in_shadow() else _usage.current_tenant()
+        sid = self.matrix.subscribe(predicate, callback, tenant=tenant)
         self._sub_base[sid] = self._rows_ingested
         return sid
 

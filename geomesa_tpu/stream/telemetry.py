@@ -49,9 +49,13 @@ __all__ = [
 
 _lock = threading.Lock()
 _topics: dict[str, dict] = {}
-# per-topic subscription-watermark cardinality bound: a churny topic
-# must not grow the exposition without limit (oldest sid evicted)
-_MAX_WATERMARK_SUBS = 64
+# per-topic watermark TABLE bound — a memory ceiling, not the exposition
+# bound. The exposition (report()/prometheus) valves to the stream lens's
+# top-K-by-cost ranking plus an `other` rollup (_valve_watermarks), so
+# the surface stays bounded AND representative; the table itself holds up
+# to this many subscriptions, evicting the cheapest (by lens cost) when
+# a new one arrives at the ceiling.
+_MAX_WATERMARK_SUBS = 4096
 
 _ZERO = {
     "lag": 0, "scan_lag": 0, "callback_errors": 0, "scan_chunks": 0,
@@ -130,6 +134,20 @@ def note_deliveries(topic: str, n: int) -> None:
         _t(topic)["deliveries"] += int(n)
 
 
+def _cheapest_watermark_sub(topic: str):
+    """The lens's cheapest-ranked subscription for ``topic`` (eviction
+    candidate), or None when the lens has no ranking. Called strictly
+    OUTSIDE ``_lock`` — the lens lock and this lock are both leaves and
+    must never nest (docs/concurrency.md)."""
+    try:
+        from geomesa_tpu.obs import streamlens as _sl
+
+        rank = _sl.get().cost_rank(topic)
+    except Exception:  # noqa: BLE001 — telemetry must not fail on obs
+        return None
+    return rank[-1][0] if rank else None
+
+
 def note_watermark(topic: str, subscription, watermark_ms: int,
                    clock=time.time) -> None:
     """Per-(topic, subscription) delivery watermark: the newest EVENT
@@ -138,27 +156,89 @@ def note_watermark(topic: str, subscription, watermark_ms: int,
     report time as now − watermark — end-to-end event-time lag, the
     staleness signal the standing-query runbook reads
     (docs/streaming.md). Monotone per subscription: a late chunk never
-    regresses it."""
+    regresses it. At the table ceiling a NEW subscription evicts the
+    lens's cheapest-by-cost ranked one (FIFO fallback when the lens has
+    no ranking) — the expensive subscriptions the scale report tracks
+    keep their gauges."""
+    key = str(subscription)
+    now = clock()
     with _lock:
         wm = _t(topic)["watermarks"]
-        key = str(subscription)
         prev = wm.get(key)
         if prev is not None and prev[0] >= watermark_ms:
-            wm[key] = (prev[0], clock())
+            wm[key] = (prev[0], now)
             return
-        if prev is None and len(wm) >= _MAX_WATERMARK_SUBS:
-            wm.pop(next(iter(wm)))
-        wm[key] = (int(watermark_ms), clock())
+        if prev is not None or len(wm) < _MAX_WATERMARK_SUBS:
+            wm[key] = (int(watermark_ms), now)
+            return
+    # ceiling overflow (rare: a NEW subscription at a full table) — pick
+    # the victim outside the lock, then re-check and evict under it
+    victim = _cheapest_watermark_sub(topic)
+    with _lock:
+        wm = _t(topic)["watermarks"]
+        if key not in wm and len(wm) >= _MAX_WATERMARK_SUBS:
+            if victim is None or victim not in wm or victim == key:
+                victim = next(iter(wm))  # FIFO fallback
+            wm.pop(victim, None)
+        wm[key] = (int(watermark_ms), now)
 
 
-def report() -> dict:
+def _exposition_top_k() -> int:
+    try:
+        from geomesa_tpu.obs import streamlens as _sl
+
+        return _sl.TOP_K
+    except Exception:  # noqa: BLE001
+        return 64
+
+
+def _cost_order(topic: str) -> list:
+    """Subscriptions of ``topic`` most-expensive-first per the stream
+    lens (empty when unavailable). Never called under ``_lock``."""
+    try:
+        from geomesa_tpu.obs import streamlens as _sl
+
+        return [sub for sub, _cost in _sl.get().cost_rank(topic)]
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def _valve_watermarks(topic: str, raw: dict, now_ms: float) -> dict:
+    """The watermark/freshness exposition valve: at most top-K-by-cost
+    subscriptions individually plus one ``other`` rollup (oldest
+    watermark / worst freshness / count of the rest) — bounded AND
+    representative, replacing the old hard-64 silent drop. ``other``
+    only appears on overflow, so low-cardinality topics read exactly as
+    before."""
+
+    def entry(wm: int) -> dict:
+        return {"watermark_ms": wm,
+                "freshness_ms": round(max(now_ms - wm, 0.0), 1)}
+
+    top_k = _exposition_top_k()
+    if len(raw) <= top_k:
+        return {sub: entry(wm) for sub, (wm, _at) in raw.items()}
+    pos = {s: i for i, s in enumerate(_cost_order(topic))}
+    ranked = sorted(raw, key=lambda s: (pos.get(s, len(pos)), s))
+    out = {sub: entry(raw[sub][0]) for sub in ranked[:top_k]}
+    rest = ranked[top_k:]
+    oldest = min(raw[s][0] for s in rest)
+    out["other"] = dict(entry(oldest), count=len(rest))
+    return out
+
+
+def report(now_ms: float | None = None) -> dict:
     """Snapshot of every topic's stream gauges (the JSON metrics block).
     Poll stats come back per loop under ``poll_loops`` plus flat compat
     aggregates: ``polls``/``poll_rows`` sum over loops, ``poll_backoff_s``
-    is the max (an idle loop's backoff must not be masked by a busy one)."""
-    now_ms = time.time() * 1000.0
+    is the max (an idle loop's backoff must not be masked by a busy one).
+    ``now_ms`` pins the freshness clock (the backlog sentinel passes its
+    evaluation time so thresholds are deterministic under test clocks)."""
+    if now_ms is None:
+        now_ms = time.time() * 1000.0
     with _lock:
         out = {}
+        raw_wm = {}
         for topic, st in _topics.items():
             d = {k: v for k, v in st.items()
                  if k not in ("poll_loops", "watermarks")}
@@ -169,14 +249,14 @@ def report() -> dict:
             d["poll_backoff_s"] = max(
                 (ls["poll_backoff_s"] for ls in loops.values()), default=0.0
             )
-            # freshness derived at read time: now − event-time watermark
-            d["watermarks"] = {
-                sub: {"watermark_ms": wm,
-                      "freshness_ms": round(max(now_ms - wm, 0.0), 1)}
-                for sub, (wm, _at) in st["watermarks"].items()
-            }
+            raw_wm[topic] = dict(st["watermarks"])
             out[topic] = d
-        return out
+    # freshness derived at read time (now − event-time watermark); the
+    # valve ranks via the lens OUTSIDE the telemetry lock (leaf locks
+    # never nest)
+    for topic, d in out.items():
+        d["watermarks"] = _valve_watermarks(topic, raw_wm[topic], now_ms)
+    return out
 
 
 def reset() -> None:
@@ -234,8 +314,9 @@ def prometheus_lines() -> list[str]:
                 lines.append(
                     f'{name}{{topic="{_esc(topic)}",loop="{_esc(loop)}"}} {v}'
                 )
-    # per-(topic, subscription) delivery watermark + derived freshness
-    # (bounded to _MAX_WATERMARK_SUBS subscriptions per topic)
+    # per-(topic, subscription) delivery watermark + derived freshness —
+    # valved by report() to the lens's top-K-by-cost subscriptions plus
+    # the `other` rollup (subscription="other", only on overflow)
     for key, name in (("watermark_ms", "geomesa_stream_watermark_ms"),
                       ("freshness_ms", "geomesa_stream_freshness_ms")):
         emitted_type = False

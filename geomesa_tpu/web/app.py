@@ -40,6 +40,12 @@ GeoJSON REST API (``geomesa-geojson-rest``). Routes:
                                                  (+ regression sentinel state)
     GET    /api/obs/lens?trace=<id>              resolve one exemplar trace_id
                                                  to its stitched span tree
+    GET    /api/obs/stream?limit=&window=&topic= standing-query scale report:
+                                                 subscriptions ranked by cost
+                                                 share + delivery p99, capacity
+                                                 section, backlog sentinel
+    GET    /api/obs/stream?trace=<id>            resolve one delivery exemplar
+                                                 to its stitched span tree
     GET    /api/obs/fusion?limit=                host-roundtrip fusion report
                                                  (signatures ranked by host-
                                                  choreography share)
@@ -220,6 +226,9 @@ class GeoMesaApp:
             # the host-roundtrip fusion report (docs/observability.md
             # § Query lens & host-roundtrip ledger)
             ("GET", r"^/api/obs/lens$", self._obs_lens),
+            # stream lens: per-subscription delivery histograms + the
+            # standing-query scale report (docs/streaming.md § Stream lens)
+            ("GET", r"^/api/obs/stream$", self._obs_stream),
             ("GET", r"^/api/obs/fusion$", self._obs_fusion),
             ("GET", r"^/api/obs/ledger$", self._obs_ledger),
             # elasticity plane: shard map + live migration states +
@@ -1239,6 +1248,39 @@ class GeoMesaApp:
         out["sentinel"] = _lensmod.sentinel().snapshot()
         return 200, out, "application/json"
 
+    def _obs_stream(self, params, body):
+        """The standing-query scale report (``geomesa-tpu obs
+        stream-report`` pulls this): per topic, subscriptions ranked by
+        scan-cost share with delivery-latency quantiles / stage
+        decomposition / on-time-late accounting / chunk-trace exemplars,
+        the capacity section (occupancy, churn, predicted next
+        bucket-crossing recompile, HBM-per-subscription ×1M), and the
+        backlog sentinel's alarm state — docs/streaming.md § Stream lens
+        & delivery SLOs. ``?trace=`` resolves a delivery exemplar exactly
+        like ``/api/obs/lens?trace=``."""
+        from geomesa_tpu.obs import streamlens as _slmod
+        from geomesa_tpu.obs import trace as _obstrace
+
+        trace_id = params.get("trace")
+        if trace_id:
+            root = _obstrace.find_trace(trace_id)
+            if root is None:
+                return 404, {"error": f"trace not found: {trace_id!r}"}, \
+                    "application/json"
+            return 200, _obstrace.span_doc(root), "application/json"
+
+        limit = self._int_param(params, "limit")
+        try:
+            window_s = float(params.get("window") or 300.0)
+        except ValueError:
+            return 400, {"error": f"bad window: {params['window']!r}"}, \
+                "application/json"
+        out = _slmod.get().report(
+            window_s=window_s, limit=limit or 50,
+            topic=params.get("topic") or None)
+        out["sentinel"] = _slmod.sentinel().snapshot()
+        return 200, out, "application/json"
+
     def _obs_fusion(self, params, body):
         """The host-roundtrip fusion-opportunity report (``geomesa-tpu
         obs fusion-report`` pulls this): plan signatures ranked by
@@ -1340,6 +1382,13 @@ class GeoMesaApp:
 
             text += _lensmod.get().prometheus_text()
             text += _lensmod.sentinel().prometheus_text()
+            # stream lens: geomesa_stream_delivery_* histogram families
+            # per (topic, subscription) — top-K-by-cost + `other` rollup —
+            # plus the stream.delivery SLO gauges and the backlog sentinel
+            from geomesa_tpu.obs import streamlens as _slmod
+
+            text += _slmod.get().prometheus_text()
+            text += _slmod.sentinel().prometheus_text()
             # elastic plane: geomesa_shard_migrations_total{state},
             # geomesa_tier_bytes{tier,type}, geomesa_autoscaler_* totals
             from geomesa_tpu.serving import elastic as _elastic
@@ -1397,6 +1446,13 @@ class GeoMesaApp:
         if lens_obj.observe_count:
             out["lens"] = lens_obj.snapshot(limit=8)
             out["lens"]["sentinel"] = _lensmod.sentinel().snapshot()
+        # stream lens summary (full detail at GET /api/obs/stream)
+        from geomesa_tpu.obs import streamlens as _slmod
+
+        stream_lens = _slmod.get()
+        if stream_lens.observe_count:
+            out["stream_lens"] = stream_lens.report(limit=8)
+            out["stream_lens"]["sentinel"] = _slmod.sentinel().snapshot()
         # serving plane: admission decisions + coalesce effectiveness
         if self.admission is not None:
             out["admission"] = self.admission.snapshot(limit=16)

@@ -35,7 +35,11 @@ export GEOMESA_BENCH_REGRESS_K="${GEOMESA_BENCH_REGRESS_K:-2}"
 # of speed) — the 0.16x path of BENCH_r05 can never silently regress again.
 # Config 8 rides it as the STREAMING parity leg (ISSUE 8): the
 # subscription-matrix product path's straight-XLA referee parity and the
-# journal-tier delivery parity both gate every run.
+# journal-tier delivery parity both gate every run. Its detail also
+# carries the stream-lens delivery profile (ISSUE 20) —
+# delivery_p50_ms/p99_ms + on_time_fraction from the journal leg — so
+# a delivery-latency regression is visible in the same capture the
+# parity legs gate.
 # Config 6 rides it as the SELECT parity leg (ISSUE 9): per-query and
 # batched row-set parity plus the plan-overhead bound (host planning <5%
 # of query wall on the cached path) gate every run — the adaptive

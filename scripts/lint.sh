@@ -130,6 +130,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_durability.py -q
 # & host-roundtrip ledger.
 JAX_PLATFORMS=cpu python -m pytest tests/test_lens.py -q
 
+# stream-lens gate (ISSUE 20): per-(topic, subscription) delivery
+# observability — stage-decomposed delivery histograms off per-chunk
+# stamps (an injected queue stall must read as queue-wait-dominated,
+# not scan-dominated), event-time on-time/late accounting vs the
+# per-subscription watermark, the 100x-skew scale report ranking with
+# a chunk-trace exemplar resolving through /api/obs/stream?trace=, the
+# consumer-stall on-time→late flip latching exactly ONE A_BACKLOG, the
+# watermark-gauge top-K-by-cost valve red/green, poisoned-chunk
+# A_STREAM_ERROR + dropped accounting, standing.delivery tenant
+# metering with the shadow-plane guard, parser-checked TRUE Prometheus
+# histograms, zero steady-state recompiles, and the <2% always-on
+# lens+stamps bound on the fused matrix-scan path. See docs/streaming.md
+# § Stream lens & delivery SLOs.
+JAX_PLATFORMS=cpu python -m pytest tests/test_streamlens.py -q
+
 # perf-regression smoke gate: one REAL tiny-N capture, then deterministic
 # green (must pass) / red (injected 20% slowdown must fail) legs plus the
 # committed-baseline loader leg — see scripts/bench_gate.sh. Config 9
@@ -149,7 +164,7 @@ GEOMESA_TPU_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_geoblocks.py tests/test_bufferpool.py \
     tests/test_stream_matrix.py tests/test_usage_workload.py \
     tests/test_serving.py tests/test_audit.py tests/test_durability.py \
-    tests/test_trajectory.py -q
+    tests/test_trajectory.py tests/test_streamlens.py -q
 
 # chaos smoke gate: the resilience suite re-runs with an AMBIENT fault
 # spec exported — deterministic tests pin their own (empty) injector and

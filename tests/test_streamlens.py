@@ -1,0 +1,904 @@
+"""stream-lens tests (ISSUE 20): per-(topic, subscription) delivery
+observability — stage-decomposed delivery histograms, event-time
+on-time/late accounting, cost attribution + the standing-query scale
+report, the watermark-gauge valve, the backlog sentinel, and the
+poisoned-chunk / tenant-metering satellites.
+
+Acceptance pins (see docs/streaming.md § Stream lens & delivery SLOs):
+
+- two-subscription workload where one matches 100x the rows: the report
+  ranks it first and its delivery histogram carries a chunk-trace
+  exemplar that resolves through ``GET /api/obs/stream?trace=``;
+- an injected consumer stall flips windows from on-time to late and
+  latches exactly ONE ``A_BACKLOG`` flight anomaly;
+- a traced ingest through the bus consumer reads as ONE stitched span
+  tree: poll -> cut -> stage -> scan -> deliver;
+- an injected queue stall shows a queue-wait-dominated stage breakdown,
+  not a scan-dominated one;
+- the always-on lens + stage stamps cost <= 2% of the fused scan path
+  and the steady streaming path stays at zero recompiles;
+- watermark/freshness gauges are bounded top-K-by-cost with an ``other``
+  rollup (red/green), replacing the old hard-64 silent drop;
+- Prometheus ``geomesa_stream_delivery_*`` is a TRUE histogram family —
+  checked by parsing, not eye.
+"""
+
+import io
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.obs import audit as obs_audit
+from geomesa_tpu.obs import flight as obs_flight
+from geomesa_tpu.obs import jaxmon
+from geomesa_tpu.obs import streamlens as sl_mod
+from geomesa_tpu.obs import trace as obs_trace
+from geomesa_tpu.obs import usage as usage_mod
+from geomesa_tpu.obs.flight import A_BACKLOG, A_STREAM_ERROR, FlightRecorder
+from geomesa_tpu.obs.streamlens import (
+    SCAN_ROW_WEIGHT,
+    STAGES,
+    TOP_K,
+    BacklogSentinel,
+    StreamLens,
+)
+from geomesa_tpu.stream import telemetry
+from geomesa_tpu.stream.matrix import SubscriptionMatrix
+from geomesa_tpu.stream.pipeline import DeviceStreamScanner
+
+WORLD = [[-(2**31 - 1), 2**31 - 1, -(2**31 - 1), 2**31 - 1]]
+ALL_TIME = [[-(2**31 - 1), 0, 2**31 - 1, 0]]
+
+
+@pytest.fixture(autouse=True)
+def _iso():
+    """Per-test isolation: tracing off + drained buffers, fresh flight
+    recorder / stream lens / sentinel / usage meter singletons, reset
+    stream telemetry and recompile census."""
+    telemetry.reset()
+    obs.disable()
+    obs.drain()
+    prev_rec = obs_flight.install(
+        FlightRecorder(dump_dir=None, min_dump_interval_s=0.0))
+    prev_lens = sl_mod.install(StreamLens())
+    prev_sent = sl_mod.install_sentinel(BacklogSentinel())
+    prev_meter = usage_mod.install(usage_mod.UsageMeter())
+    jaxmon._census_reset()
+    listeners = list(obs_trace._root_listeners)
+    yield
+    obs_trace._root_listeners[:] = listeners
+    sl_mod.sentinel().close()
+    sl_mod.install_sentinel(prev_sent)
+    sl_mod.install(prev_lens)
+    usage_mod.install(prev_meter)
+    obs_flight.install(prev_rec)
+    jaxmon._census_reset()
+    telemetry.reset()
+    obs.disable()
+    obs.drain()
+
+
+def _cols(n=3000, seed=0, nbins=4):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, 1000, n).astype(np.int32),
+        rng.integers(0, 1000, n).astype(np.int32),
+        rng.integers(0, nbins, n).astype(np.int32),
+        rng.integers(0, 100, n).astype(np.int32),
+    )
+
+
+def _boxes(i):
+    return [[i * 37 % 500, i * 37 % 500 + 200,
+             i * 53 % 400, i * 53 % 400 + 300]]
+
+
+def call(app, method, path, query="", body=None, headers=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": query,
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    for k, v in (headers or {}).items():
+        environ["HTTP_" + k.upper().replace("-", "_")] = v
+    out = {}
+
+    def start_response(status, headers_):
+        out["status"] = int(status.split()[0])
+        out["headers"] = dict(headers_)
+
+    chunks = app(environ, start_response)
+    return out["status"], out["headers"], b"".join(chunks)
+
+
+def _serve(app):
+    import threading
+    from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+    class _Quiet(WSGIRequestHandler):
+        def log_message(self, *a):
+            pass
+
+    httpd = make_server("127.0.0.1", 0, app, handler_class=_Quiet)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    return httpd, f"http://127.0.0.1:{port}"
+
+
+def _app():
+    from geomesa_tpu.store.datastore import DataStore
+    from geomesa_tpu.web.app import GeoMesaApp
+
+    return GeoMesaApp(DataStore(backend="tpu"), coalesce_ms=0)
+
+
+def _tree_names(doc):
+    names = set()
+
+    def _walk(d):
+        names.add(d["n"])
+        for c in d.get("c", ()):
+            _walk(c)
+
+    _walk(doc)
+    return names
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prometheus(text):
+    """Minimal text-exposition parser: family types + samples with label
+    dicts. Raises on a malformed line — the conformance check."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _hash, _t, name, kind = line.split()
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        name, raw_labels, raw_val = m.groups()
+        labels = dict(_LABEL_RE.findall(raw_labels or ""))
+        samples.append((name, labels, float(raw_val)))
+    return types, samples
+
+
+# ---------------------------------------------------------------------------
+# StreamLens core: delivery windows, stages, lateness, cost, valve
+# ---------------------------------------------------------------------------
+
+class TestStreamLensCore:
+    def test_delivery_window_merges_stages_and_lateness(self):
+        lens = StreamLens(bucket_s=10.0)
+        t = 10_000.0
+        stages = (5.0, 1.0, 2.0, 8.0, 0.5, 0.25)
+        for _ in range(8):
+            lens.observe_delivery("t", 1, latency_ms=20.0, stages=stages,
+                                  hit_rows=3, cost=5.0, on_time=True,
+                                  now=t)
+        lens.observe_delivery("t", 1, latency_ms=400.0, stages=stages,
+                              hit_rows=1, cost=2.0, on_time=False, now=t)
+        # a no-match chunk: cost + lateness land, the histogram does not
+        lens.observe_delivery("t", 1, cost=1.5, on_time=True, now=t)
+        w = lens.window_stats("t", 1, t - 60, t + 1)
+        assert w["count"] == 9  # only real deliveries
+        assert w["chunks"] == 10
+        assert w["hit_rows"] == 25
+        assert w["on_time"] == 9 and w["late"] == 1
+        assert w["on_time_fraction"] == pytest.approx(0.9)
+        assert w["cost"] == pytest.approx(8 * 5.0 + 2.0 + 1.5)
+        assert w["max_ms"] == 400.0
+        assert 10.0 < w["p50_ms"] <= 25.0
+        for i, name in enumerate(STAGES):
+            assert w["stage_ms"][name] == pytest.approx(stages[i] * 9,
+                                                        rel=1e-6)
+
+    def test_event_timeless_topic_has_no_on_time_fraction(self):
+        lens = StreamLens(bucket_s=10.0)
+        lens.observe_delivery("packed", 0, latency_ms=5.0, cost=1.0,
+                              on_time=None, now=10_000.0)
+        w = lens.window_stats("packed", 0, 0.0, 1e9)
+        assert w["count"] == 1
+        assert w["on_time"] == 0 and w["late"] == 0
+        assert w["on_time_fraction"] is None
+
+    def test_valve_evicts_cheapest_into_topic_other(self):
+        """Unlike the query lens's longest-idle valve, the stream valve
+        evicts the CHEAPEST series and folds it into the topic's
+        ``other`` rollup — totals stay reconcilable."""
+        lens = StreamLens(bucket_s=10.0, max_series=2)
+        t = 10_000.0
+        lens.observe_delivery("t", "a", latency_ms=1.0, hit_rows=4,
+                              cost=50.0, on_time=True, now=t)
+        lens.observe_delivery("t", "b", latency_ms=1.0, hit_rows=2,
+                              cost=1.0, on_time=True, now=t)
+        lens.observe_delivery("t", "c", latency_ms=1.0, hit_rows=1,
+                              cost=7.0, on_time=False, now=t)
+        assert lens.cost_rank("t") == [("a", 50.0), ("c", 7.0)]
+        rep = lens.report(topic="t")
+        (tp,) = rep["topics"]
+        assert [e["subscription"] for e in tp["subscriptions"]] == ["a", "c"]
+        assert tp["other"] == {"series": 1, "cost": 1.0, "hit_rows": 2,
+                               "deliveries": 1, "on_time": 1, "late": 0}
+        # the evicted series' cost still counts into the shares
+        assert tp["subscriptions"][0]["cost_share"] == pytest.approx(
+            50.0 / 58.0, abs=1e-3)
+
+    def test_report_ranks_by_cost_share(self):
+        lens = StreamLens(bucket_s=10.0)
+        t = 10_000.0
+        for _ in range(4):
+            lens.observe_delivery("t", "hot", latency_ms=2.0, hit_rows=100,
+                                  cost=101.0, on_time=True, now=t)
+            lens.observe_delivery("t", "cold", latency_ms=2.0, hit_rows=1,
+                                  cost=2.0, on_time=True, now=t)
+        (tp,) = lens.report(topic="t")["topics"]
+        first, second = tp["subscriptions"]
+        assert first["subscription"] == "hot"
+        assert first["cost_share"] > 0.9 > second["cost_share"]
+        assert first["hit_rows"] == 400
+
+    def test_forget_purges_topic_and_slo_tracker(self):
+        lens = StreamLens(bucket_s=10.0)
+        lens.observe_delivery("t", 1, latency_ms=2.0, cost=1.0,
+                              on_time=True, now=10_000.0)
+        lens.note_dropped("t", 7)
+        assert lens.cost_rank("t")
+        lens.forget("t")
+        assert lens.cost_rank("t") == []
+        assert lens.report(topic="t")["topics"] == []
+
+    def test_capacity_section_predicts_bucket_crossing(self):
+        lens = StreamLens(bucket_s=10.0)
+        # 2 adds over 10 s against capacity 8 -> growth 0.2/s, 5 slots
+        # of headroom ~ 25 s to the next power-of-two recompile
+        lens.note_matrix("t", capacity=8, active=1, epoch=1,
+                         slot_bytes=64, now=10_000.0)
+        lens.note_matrix("t", capacity=8, active=3, epoch=3,
+                         slot_bytes=64, now=10_010.0)
+        lens.observe_delivery("t", 1, cost=1.0, now=10_010.0)
+        (tp,) = lens.report(topic="t")["topics"]
+        cap = tp["capacity"]
+        assert cap["observed"] and cap["capacity"] == 8
+        assert cap["active"] == 3
+        assert cap["occupancy"] == pytest.approx(3 / 8)
+        assert cap["growth_per_s"] == pytest.approx(0.2)
+        assert cap["next_bucket_crossing"]["adds_until_grow"] == 6
+        assert cap["next_bucket_crossing"]["eta_s"] == pytest.approx(25.0)
+        assert cap["hbm_bytes_per_subscription"] == 64
+        assert cap["hbm_bytes_at_1m"] == 64_000_000
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: TRUE histogram + bounded top-K with `other`
+# ---------------------------------------------------------------------------
+
+class TestPrometheusStream:
+    def test_true_histogram_family_and_counters(self):
+        lens = StreamLens(bucket_s=10.0)
+        t = 10_000.0
+        for ms in [0.3, 3.0, 3.0, 40.0, 400.0]:
+            lens.observe_delivery("t", "s", latency_ms=ms, hit_rows=2,
+                                  cost=3.0, on_time=True, now=t)
+        lens.observe_delivery("t", "s", latency_ms=9.0, cost=1.0,
+                              on_time=False, now=t)
+        lens.note_dropped("t", 123)
+        types, samples = _parse_prometheus(lens.prometheus_text())
+        assert types["geomesa_stream_delivery_ms"] == "histogram"
+        assert types["geomesa_stream_delivery_on_time_total"] == "counter"
+        assert types["geomesa_stream_delivery_late_total"] == "counter"
+        assert types["geomesa_stream_delivery_cost_units_total"] == "counter"
+        assert types["geomesa_stream_delivery_dropped_rows_total"] == \
+            "counter"
+        by = {}
+        for name, labels, val in samples:
+            by[(name, labels.get("le"))] = val
+        # cumulative le buckets, +Inf == _count
+        buckets = sorted(
+            ((float(le.replace("+Inf", "inf")), v)
+             for (name, le), v in by.items()
+             if name == "geomesa_stream_delivery_ms_bucket"),
+            key=lambda p: p[0])
+        assert all(b1 <= b2 for (_, b1), (_, b2)
+                   in zip(buckets, buckets[1:]))
+        assert buckets[-1][1] == by[("geomesa_stream_delivery_ms_count",
+                                     None)] == 6
+        assert by[("geomesa_stream_delivery_on_time_total", None)] == 5
+        assert by[("geomesa_stream_delivery_late_total", None)] == 1
+        assert by[("geomesa_stream_delivery_hit_rows_total", None)] == 10
+        assert by[("geomesa_stream_delivery_dropped_rows_total",
+                   None)] == 123
+        # the lens's own SLO engine exposes under the _stream prefix so
+        # # TYPE headers never collide with the store engine's
+        text = lens.prometheus_text()
+        assert "geomesa_stream_slo_burn_rate" in text
+        assert "# TYPE geomesa_slo_burn_rate" not in text
+
+    def test_exposition_bounded_at_top_k_with_other_rollup(self):
+        lens = StreamLens(bucket_s=10.0, max_series=1024)
+        t = 10_000.0
+        n = TOP_K + 5
+        for i in range(n):
+            lens.observe_delivery("t", i, latency_ms=2.0, hit_rows=1,
+                                  cost=float(i + 1), on_time=True, now=t)
+        _types, samples = _parse_prometheus(lens.prometheus_text())
+        subs = {lab["subscription"] for _n, lab, _v in samples
+                if "subscription" in lab}
+        assert "other" in subs
+        assert len(subs) == TOP_K + 1  # TOP_K individuals + the rollup
+        # the 5 cheapest spill; the rollup carries their cost sum
+        assert str(n - 1) in subs and "0" not in subs
+        other_cost = next(
+            v for name, lab, v in samples
+            if name == "geomesa_stream_delivery_cost_units_total"
+            and lab.get("subscription") == "other")
+        assert other_cost == pytest.approx(sum(range(1, 6)))
+
+
+# ---------------------------------------------------------------------------
+# Watermark/freshness gauge valve (satellite: red/green)
+# ---------------------------------------------------------------------------
+
+class TestWatermarkValve:
+    def test_green_low_cardinality_reads_exactly_as_before(self):
+        now_ms = time.time() * 1000.0
+        for sid in range(3):
+            telemetry.note_watermark("t", sid, int(now_ms) - 100)
+        wm = telemetry.report(now_ms=now_ms)["t"]["watermarks"]
+        assert set(wm) == {"0", "1", "2"}
+        assert "other" not in wm
+        assert wm["1"]["freshness_ms"] == pytest.approx(100.0, abs=5.0)
+
+    def test_red_overflow_keeps_top_k_by_cost_plus_other(self):
+        """> TOP_K subscriptions on one topic: the expensive ones keep
+        their individual gauges, the cheap tail folds into ``other``
+        (count + oldest watermark) — bounded AND representative."""
+        lens = sl_mod.get()
+        now_ms = time.time() * 1000.0
+        n = TOP_K + 16
+        for i in range(n):
+            # sub 77 is the most expensive; costs otherwise rise with i
+            lens.observe_delivery("t", i, cost=(1e6 if i == 77 else
+                                                float(i)), now=now_ms / 1e3)
+            telemetry.note_watermark("t", i, int(now_ms) - 1000 - i)
+        wm = telemetry.report(now_ms=now_ms)["t"]["watermarks"]
+        assert len(wm) == TOP_K + 1
+        assert "77" in wm  # top-cost survives
+        assert "other" in wm and wm["other"]["count"] == 16
+        # the 16 cheapest (costs 0..15, minus the promoted 77) spill
+        assert "3" not in wm
+        # other reports the OLDEST spilled watermark (worst freshness)
+        spilled = [i for i in range(16) if i != 77][:16]
+        assert wm["other"]["watermark_ms"] == int(now_ms) - 1000 - max(
+            spilled)
+
+    def test_table_ceiling_evicts_lens_cheapest(self, monkeypatch):
+        monkeypatch.setattr(telemetry, "_MAX_WATERMARK_SUBS", 4)
+        lens = sl_mod.get()
+        now_ms = int(time.time() * 1000)
+        for sid, cost in [("0", 10.0), ("1", 10.0), ("2", 0.1),
+                          ("3", 10.0)]:
+            lens.observe_delivery("t", sid, cost=cost)
+            telemetry.note_watermark("t", sid, now_ms)
+        telemetry.note_watermark("t", "9", now_ms)  # overflow
+        wm = telemetry.report(now_ms=float(now_ms))["t"]["watermarks"]
+        assert set(wm) == {"0", "1", "3", "9"}  # "2" (cheapest) evicted
+
+    def test_watermark_is_monotone_per_subscription(self):
+        now_ms = int(time.time() * 1000)
+        telemetry.note_watermark("t", "1", now_ms)
+        telemetry.note_watermark("t", "1", now_ms - 50_000)  # late chunk
+        wm = telemetry.report(now_ms=float(now_ms))["t"]["watermarks"]
+        assert wm["1"]["watermark_ms"] == now_ms
+
+
+# ---------------------------------------------------------------------------
+# Backlog sentinel: causes, latch-once, recovery, flight anomaly
+# ---------------------------------------------------------------------------
+
+class TestBacklogSentinel:
+    def test_freshness_cause_needs_nonzero_queue(self):
+        s = BacklogSentinel(freshness_ms=30_000.0)
+        stale = int(time.time() * 1000) - 120_000
+        telemetry.note_watermark("t", "1", stale)
+        # fully drained scanner: stale watermark alone must NOT alarm
+        telemetry.set_scan_lag("t", 0)
+        assert s.evaluate_once() == []
+        telemetry.set_scan_lag("t", 42)
+        raised = s.evaluate_once()
+        assert [a["cause"] for a in raised] == ["freshness"]
+        assert raised[0]["topic"] == "t"
+        # latched: the episode raises exactly once
+        assert s.evaluate_once() == []
+        recs = [r for r in obs_flight.get().records()
+                if A_BACKLOG in r.anomalies]
+        assert len(recs) == 1
+        assert recs[0].plan_signature == "stream.delivery"
+        # recovery clears the latch; a NEW episode re-raises
+        telemetry.note_watermark("t", "1", int(time.time() * 1000))
+        telemetry.set_scan_lag("t", 0)
+        assert s.evaluate_once() == []
+        assert s.snapshot()["alarms"] == []
+        telemetry.note_watermark("t", "2", stale)
+        telemetry.set_scan_lag("t", 9)
+        assert len(s.evaluate_once()) == 1
+
+    def test_queue_depth_cause(self):
+        s = BacklogSentinel(max_scan_lag=10)
+        telemetry.set_scan_lag("deep", 5_000)
+        raised = s.evaluate_once()
+        assert [a["cause"] for a in raised] == ["queue_depth"]
+        assert raised[0]["value"] == 5_000.0
+
+    def test_slo_burn_cause_from_late_deliveries(self):
+        lens = StreamLens(bucket_s=10.0)
+        for _ in range(20):
+            lens.observe_delivery("burny", 1, latency_ms=3.0, cost=1.0,
+                                  on_time=False)
+        s = BacklogSentinel(lens=lens, burn_factor=2.0)
+        raised = s.evaluate_once()
+        assert [a["cause"] for a in raised] == ["slo_burn"]
+        assert raised[0]["burn_rate"] >= 2.0
+
+    def test_sentinel_runs_in_audit_shadow(self):
+        seen = {}
+        s = BacklogSentinel()
+        orig = s._evaluate
+
+        def probe(now):
+            seen["shadow"] = obs_audit.in_shadow()
+            return orig(now)
+
+        s._evaluate = probe
+        s.evaluate_once()
+        assert seen["shadow"] is True
+
+    def test_prometheus_backlog_gauge(self):
+        s = BacklogSentinel(max_scan_lag=1)
+        telemetry.set_scan_lag("t", 50)
+        s.evaluate_once()
+        types, samples = _parse_prometheus(s.prometheus_text())
+        assert types["geomesa_stream_backlog"] == "gauge"
+        assert ("geomesa_stream_backlog",
+                {"topic": "t", "cause": "queue_depth"}, 1.0) in samples
+        assert ("geomesa_stream_backlogs_total", {}, 1.0) in samples
+
+
+# ---------------------------------------------------------------------------
+# The acceptance pin: two-subscription workload end to end
+# ---------------------------------------------------------------------------
+
+class TestScaleReportEndToEnd:
+    def test_hot_sub_ranks_first_exemplar_resolves_stall_flips_late(self):
+        """One subscription matching ~100x the rows ranks first with a
+        resolvable chunk-trace exemplar; an injected consumer stall
+        flips its windows on-time -> late and latches exactly ONE
+        A_BACKLOG."""
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        ds = StreamingDataStore()
+        ds.create_schema("adsb", "dtg:Date,*geom:Point")
+        topic = ds._topic("adsb")
+        hot_hits, cold_hits = [], []
+        cfg = dict(chunk_rows=256, flush_interval_s=0.005)
+        hot = ds.subscribe_query("adsb", "BBOX(geom,-170,-80,170,80)",
+                                 hot_hits.append, **cfg)
+        cold = ds.subscribe_query("adsb", "BBOX(geom,100,50,102,52)",
+                                  cold_hits.append, **cfg)
+        base_ms = int(time.time() * 1000)
+        try:
+            obs.enable(jax_telemetry=False)
+            try:
+                with obs_trace.span("ingest.batch", n=200):
+                    for i in range(200):
+                        # 2 rows inside the cold box, the rest outside it
+                        # (hot matches everything): a ~100x hit skew
+                        pt = (Point(101.0, 51.0) if i < 2 else
+                              Point((i * 1.7) % 140 - 70,
+                                    (i * 0.7) % 100 - 50))
+                        ds.put("adsb", f"f{i}", {"dtg": base_ms + i,
+                                                 "geom": pt},
+                               ts=base_ms + i)
+                assert ds.drain("adsb", 60.0)
+            finally:
+                obs.disable()
+            assert sum(b.count for b in hot_hits) == 200
+            assert sum(b.count for b in cold_hits) == 2
+
+            rep = sl_mod.get().report(topic=topic)
+            (tp,) = rep["topics"]
+            first, second = tp["subscriptions"]
+            assert first["subscription"] == str(hot)
+            assert second["subscription"] == str(cold)
+            assert first["hit_rows"] == 100 * second["hit_rows"]
+            assert first["cost_share"] > second["cost_share"]
+            assert first["late"] == 0 and first["on_time"] > 0
+            assert first["window"]["on_time_fraction"] == 1.0
+            cap = tp["capacity"]
+            assert cap["observed"] and cap["active"] == 2
+            assert cap["hbm_bytes_at_1m"] == \
+                cap["hbm_bytes_per_subscription"] * 1_000_000
+
+            # the delivery histogram's exemplar resolves to the stitched
+            # span tree through the SAME endpoint the report lives on
+            assert first["exemplars"], "traced ingest must leave exemplars"
+            tid = first["exemplars"][0]["trace_id"]
+            app = _app()
+            s, _h, b = call(app, "GET", "/api/obs/stream",
+                            query=f"trace={tid}")
+            assert s == 200
+            doc = json.loads(b)
+            assert doc["trace_id"] == tid and doc["n"] == "ingest.batch"
+            assert {"stream.cut", "stream.stage", "stream.scan",
+                    "stream.deliver"} <= _tree_names(doc)
+
+            # injected consumer stall: the scan path sleeps past the
+            # allowed lateness, so every window it delivers is LATE
+            hub = ds.query_hub("adsb")
+            hub.scanner.allowed_lateness_ms = 200.0
+            real = hub.matrix.scan_chunk
+
+            def stalled(*a, **kw):
+                time.sleep(0.5)
+                return real(*a, **kw)
+
+            hub.matrix.scan_chunk = stalled
+            try:
+                now2 = int(time.time() * 1000)
+                for i in range(40):
+                    ds.put("adsb", f"g{i}",
+                           {"dtg": now2 + i,
+                            "geom": Point(float(i % 60 - 30), 0.0)},
+                           ts=now2 + i)
+                assert ds.drain("adsb", 60.0)
+            finally:
+                hub.matrix.scan_chunk = real
+            (tp2,) = sl_mod.get().report(topic=topic)["topics"]
+            first2 = tp2["subscriptions"][0]
+            assert first2["subscription"] == str(hot)
+            assert first2["late"] > 0  # flipped on-time -> late
+
+            # ... and the sentinel latches exactly ONE A_BACKLOG
+            sent = sl_mod.sentinel()
+            raised = sent.evaluate_once()
+            assert [a["topic"] for a in raised] == [topic]
+            assert raised[0]["cause"] == "slo_burn"
+            assert sent.evaluate_once() == []  # latched, not re-raised
+            recs = [r for r in obs_flight.get().records()
+                    if A_BACKLOG in r.anomalies]
+            assert len(recs) == 1
+            assert len(sent.snapshot()["alarms"]) == 1
+        finally:
+            ds.close()
+
+
+# ---------------------------------------------------------------------------
+# Trace stitching through the bus consumer (satellite)
+# ---------------------------------------------------------------------------
+
+class TestStitchedTrace:
+    def test_consumer_poll_root_stitches_one_tree(self):
+        """A traced bus batch reads as ONE span tree: the consumer's
+        ``stream.poll`` root with the scanner's retroactive cut / stage /
+        scan / deliver children, reachable from /api/obs/stream?trace=."""
+        from geomesa_tpu.stream.consumer import ThreadedConsumer
+        from geomesa_tpu.stream.datastore import MessageBus
+
+        m = SubscriptionMatrix()
+        hits = []
+        sid = m.subscribe_packed(WORLD, ALL_TIME, hits.append)
+        sc = DeviceStreamScanner(m, chunk_rows=256, flush_interval_s=0.005,
+                                 topic="traced")
+        bus = MessageBus(partitions=1)
+        for i in range(5):
+            bus.publish("traced", f"k{i}", str(i).encode())
+
+        def apply(data, p):
+            v = np.int32(int(data.decode()))
+            sc.submit_rows(np.array([v]), np.array([v]),
+                           np.zeros(1, np.int32), np.zeros(1, np.int32))
+            return True
+
+        obs.enable(jax_telemetry=False)
+        cons = ThreadedConsumer(bus, "traced", apply, threads=1)
+        try:
+            assert cons.drain(30.0)
+            assert sc.drain(30.0)
+        finally:
+            obs.disable()
+            cons.close()
+            sc.close()
+        assert sum(b.count for b in hits) == 5
+        roots = [r for r in obs.recent() if r.name == "stream.poll"]
+        assert len(roots) == 1  # one batch -> ONE tree
+        ex = sl_mod.get().exemplars("traced", sid)
+        assert ex and ex[0]["trace_id"] == roots[0].trace_id
+        s, _h, b = call(_app(), "GET", "/api/obs/stream",
+                        query=f"trace={ex[0]['trace_id']}")
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["n"] == "stream.poll"
+        assert {"stream.cut", "stream.stage", "stream.scan",
+                "stream.deliver"} <= _tree_names(doc)
+
+    def test_injected_queue_stall_dominates_breakdown(self):
+        """A pipeline stall (slow downstream consumer) must show up as
+        QUEUE WAIT in the stage decomposition, not get smeared into the
+        scan stage — the triage signal the runbook reads."""
+        # warm the fused step at this exact (chunk_rows, capacity) so
+        # the measured chunks hit the compile cache
+        wm = SubscriptionMatrix()
+        wm.subscribe_packed(WORLD, ALL_TIME, lambda b: None)
+        warm = DeviceStreamScanner(wm, chunk_rows=512, topic="warmup")
+        try:
+            assert warm.submit_chunk(*_cols(512, seed=1))
+            assert warm.drain(60.0)
+        finally:
+            warm.close()
+
+        m = SubscriptionMatrix()
+        slow = {"left": 1}
+
+        def cb(b):
+            if slow["left"]:
+                slow["left"] -= 1
+                time.sleep(0.35)  # the injected downstream stall
+
+        sid = m.subscribe_packed(WORLD, ALL_TIME, cb)
+        sc = DeviceStreamScanner(m, chunk_rows=512, topic="stall")
+        try:
+            for s in range(3):
+                assert sc.submit_chunk(*_cols(512, seed=10 + s))
+            assert sc.drain(60.0)
+        finally:
+            sc.close()
+        w = sl_mod.get().window_stats("stall", sid, 0.0, time.time() + 1)
+        assert w["count"] == 3
+        sm = w["stage_ms"]
+        assert sm["queue_wait"] >= 250.0  # chunks queued behind the stall
+        assert sm["queue_wait"] > sm["scan"]
+        assert sm["queue_wait"] > sm["h2d"]
+
+
+# ---------------------------------------------------------------------------
+# Poisoned chunk -> A_STREAM_ERROR + dropped accounting (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPoisonedChunk:
+    def test_drop_raises_stream_error_anomaly_and_counts_rows(self):
+        m = SubscriptionMatrix()
+        got = {"n": 0}
+        m.subscribe_packed(WORLD, ALL_TIME,
+                           lambda b: got.__setitem__("n", got["n"] + b.count))
+        real = m.scan_chunk
+        boom = {"left": 1}
+
+        def flaky(*a, **kw):
+            if boom["left"]:
+                boom["left"] -= 1
+                raise RuntimeError("injected scan failure")
+            return real(*a, **kw)
+
+        m.scan_chunk = flaky
+        sc = DeviceStreamScanner(m, chunk_rows=512, flush_interval_s=0.01,
+                                 topic="poison")
+        try:
+            x, y, bins, offs = _cols(1024, seed=11)
+            assert sc.submit_chunk(x[:512], y[:512], bins[:512], offs[:512])
+            assert sc.drain(60.0)
+            assert sc.submit_chunk(x[512:], y[512:], bins[512:], offs[512:])
+            assert sc.drain(60.0)
+            assert got["n"] == 512  # the second chunk delivered normally
+        finally:
+            sc.close()
+        recs = [r for r in obs_flight.get().records()
+                if A_STREAM_ERROR in r.anomalies]
+        assert len(recs) == 1
+        assert recs[0].rows == 512
+        assert "subscriptions=1" in recs[0].plan
+        (tp,) = sl_mod.get().report(topic="poison")["topics"]
+        assert tp["capacity"]["dropped_rows"] == 512
+        assert tp["capacity"]["dropped_chunks"] == 1
+        text = sl_mod.get().prometheus_text()
+        assert ('geomesa_stream_delivery_dropped_rows_total'
+                '{topic="poison"} 512') in text
+
+
+# ---------------------------------------------------------------------------
+# Tenant attribution of standing deliveries (satellite)
+# ---------------------------------------------------------------------------
+
+class TestTenantMetering:
+    def test_deliveries_meter_under_standing_delivery_signature(self):
+        from geomesa_tpu.geometry.types import Point
+        from geomesa_tpu.stream.datastore import StreamingDataStore
+
+        ds = StreamingDataStore()
+        ds.create_schema("tnt", "dtg:Date,*geom:Point")
+        cfg = dict(chunk_rows=256, flush_interval_s=0.005)
+        try:
+            with usage_mod.tenant_context("acme"):
+                ds.subscribe_query("tnt", "BBOX(geom,-10,-10,10,10)",
+                                   lambda b: None, **cfg)
+            # a shadow-plane subscriber (sweeper/referee) stays
+            # unstamped -> its deliveries never meter
+            with obs_audit.shadow():
+                ds.subscribe_query("tnt", "BBOX(geom,-10,-10,10,10)",
+                                   lambda b: None, **cfg)
+            now = int(time.time() * 1000)
+            for i in range(8):
+                ds.put("tnt", f"f{i}", {"dtg": now + i,
+                                        "geom": Point(float(i), 0.0)},
+                       ts=now + i)
+            assert ds.drain("tnt", 60.0)
+        finally:
+            ds.close()
+        snap = usage_mod.get().snapshot()
+        tenants = {t["tenant"] for t in snap["tenants"]}
+        assert "acme" in tenants
+        hitters = [h for h in snap["heavy_hitters"]
+                   if h["signature"] == "standing.delivery"]
+        assert hitters, "standing deliveries must reach the usage sketch"
+        assert {h["tenant"] for h in hitters} == {"acme"}
+        assert all(h["type"] == "tnt" for h in hitters)
+
+
+# ---------------------------------------------------------------------------
+# Overhead + zero steady-state recompiles (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestOverhead:
+    def test_lens_cost_under_2pct_of_fused_scan(self):
+        """The always-on budget: one observe_delivery per (subscription x
+        chunk) — the lens's whole per-chunk add — must cost <= 2% of one
+        fused scan pass."""
+        m = SubscriptionMatrix()
+        sids = [m.subscribe_packed(_boxes(i), ALL_TIME, lambda b: None)
+                for i in range(4)]
+        cols = _cols(16384, seed=7)
+        m.scan_host(*cols)  # compile + warm
+        lat = []
+        for _ in range(10):
+            t0 = time.perf_counter_ns()
+            m.scan_host(*cols)
+            lat.append(time.perf_counter_ns() - t0)
+        p50_ns = float(np.percentile(lat, 50))
+
+        lens = StreamLens()
+        stages = (1.0, 0.2, 0.3, 2.0, 0.1, 0.4)
+        N = 5_000
+
+        def per_call_ns():
+            t0 = time.perf_counter_ns()
+            for _ in range(N):
+                lens.observe_delivery("bench", 7, latency_ms=3.0,
+                                      stages=stages, hit_rows=5,
+                                      cost=12.5, on_time=True, trace_id="")
+            return (time.perf_counter_ns() - t0) / N
+
+        per_chunk = min(per_call_ns() for _ in range(3)) * len(sids)
+        assert per_chunk < 0.02 * p50_ns, (
+            f"stream-lens always-on cost {per_chunk:.0f} ns/chunk "
+            f">= 2% of fused scan p50 {p50_ns:.0f} ns")
+
+    def test_steady_streaming_with_lens_zero_recompiles(self):
+        m = SubscriptionMatrix()
+        m.subscribe_packed(WORLD, ALL_TIME, lambda b: None)
+        sc = DeviceStreamScanner(m, chunk_rows=512, topic="census")
+        try:
+            assert sc.submit_chunk(*_cols(512, seed=0))
+            assert sc.drain(60.0)  # warm: compiles the bucket's step
+            before = jaxmon.jit_report()
+            count0 = sl_mod.get().observe_count
+            for s in range(4):
+                assert sc.submit_chunk(*_cols(512, seed=1 + s))
+            assert sc.drain(60.0)
+            after = jaxmon.jit_report()
+            assert (after.get("recompiles", 0)
+                    - before.get("recompiles", 0)) == 0
+            assert sl_mod.get().observe_count > count0  # lens was live
+        finally:
+            sc.close()
+
+
+# ---------------------------------------------------------------------------
+# Web API + CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestWebApi:
+    def _feed(self):
+        lens = sl_mod.get()
+        t = time.time()
+        for _ in range(3):
+            lens.observe_delivery("web", 1, latency_ms=4.0, hit_rows=2,
+                                  cost=5.0, on_time=True, now=t)
+        lens.note_matrix("web", capacity=8, active=1, epoch=1,
+                         slot_bytes=64, now=t)
+
+    def test_obs_stream_endpoint(self):
+        self._feed()
+        app = _app()
+        s, _h, b = call(app, "GET", "/api/obs/stream")
+        assert s == 200
+        doc = json.loads(b)
+        (tp,) = doc["topics"]
+        assert tp["topic"] == "web"
+        e = tp["subscriptions"][0]
+        assert {"cost_share", "window", "exemplars"} <= set(e)
+        assert {"p50_ms", "p99_ms", "on_time_fraction",
+                "stage_ms"} <= set(e["window"])
+        assert doc["sentinel"]["alarms"] == []
+
+    def test_obs_stream_bad_window_is_400_unknown_trace_404(self):
+        app = _app()
+        s, _h, _b = call(app, "GET", "/api/obs/stream",
+                         query="window=bogus")
+        assert s == 400
+        s, _h, _b = call(app, "GET", "/api/obs/stream",
+                         query="trace=deadbeef-t99")
+        assert s == 404
+
+    def test_metrics_scrape_carries_stream_families(self):
+        self._feed()
+        app = _app()
+        s, _h, b = call(app, "GET", "/api/metrics",
+                        query="format=prometheus")
+        assert s == 200
+        text = b.decode()
+        assert "# TYPE geomesa_stream_delivery_ms histogram" in text
+        assert "geomesa_stream_delivery_ms_bucket" in text
+        assert "geomesa_stream_backlogs_total" in text
+        types, _samples = _parse_prometheus(
+            "\n".join(ln for ln in text.splitlines()
+                      if "geomesa_stream" in ln))
+        assert types["geomesa_stream_delivery_ms"] == "histogram"
+
+    def test_metrics_json_carries_stream_lens_section(self):
+        self._feed()
+        s, _h, b = call(_app(), "GET", "/api/metrics")
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["stream_lens"]["topics"]
+        assert "sentinel" in doc["stream_lens"]
+
+
+class TestCli:
+    def test_obs_stream_report(self, capsys):
+        from geomesa_tpu.cli.__main__ import main
+
+        lens = sl_mod.get()
+        t = time.time()
+        for _ in range(2):
+            lens.observe_delivery("cli", 3, latency_ms=6.0, hit_rows=4,
+                                  cost=7.0, on_time=True, now=t)
+        lens.note_matrix("cli", capacity=8, active=1, epoch=1,
+                         slot_bytes=64, now=t)
+        httpd, url = _serve(_app())
+        try:
+            main(["obs", "stream-report", "--url", url])
+            out = capsys.readouterr().out
+            assert "stream lens:" in out
+            assert "topic cli" in out
+            assert "cost%" in out and "on-time" in out
+            assert "HBM 64 B/sub" in out
+            main(["obs", "stream-report", "--url", url, "--json"])
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["topics"][0]["topic"] == "cli"
+        finally:
+            httpd.shutdown()
